@@ -1,0 +1,12 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh so sharding
+tests run anywhere (SURVEY.md §7; multi-chip hardware is not available)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("VTPU_FAKE_DEVICES", "")  # never touch real TPU in tests

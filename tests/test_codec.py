@@ -1,0 +1,55 @@
+"""Round-trip tests for the annotation wire codecs (ref: util_test.go:25-50,
+extended — the reference only covers two cases)."""
+
+import pytest
+
+from vtpu.utils import codec
+from vtpu.utils.types import ChipInfo, ContainerDevice
+
+
+def chips():
+    return [
+        ChipInfo("tpu-v5e-0000", 10, 16384, 100, "TPU-v5e", True, (0, 0, 0)),
+        ChipInfo("tpu-v5e-0001", 10, 16384, 100, "TPU-v5e", False, (1, 0, 0)),
+        ChipInfo("tpu-nocoords", 4, 8192, 100, "TPU-v4", True, None),
+    ]
+
+
+def test_node_devices_roundtrip():
+    enc = codec.encode_node_devices(chips())
+    assert enc.endswith(":")
+    dec = codec.decode_node_devices(enc)
+    assert dec == chips()
+
+
+def test_node_devices_empty():
+    assert codec.encode_node_devices([]) == ""
+    assert codec.decode_node_devices("") == []
+
+
+def test_node_devices_malformed():
+    with pytest.raises(ValueError):
+        codec.decode_node_devices("a,b,c:")
+
+
+def test_container_devices_roundtrip():
+    devs = [
+        ContainerDevice("tpu-v5e-0000", "TPU", 4096, 25),
+        ContainerDevice("tpu-v5e-0001", "TPU", 0, 0),
+    ]
+    assert codec.decode_container_devices(codec.encode_container_devices(devs)) == devs
+
+
+def test_pod_devices_roundtrip():
+    pd = [
+        [ContainerDevice("a", "TPU", 1024, 30)],
+        [],
+        [ContainerDevice("b", "TPU", 2048, 0), ContainerDevice("c", "TPU", 2048, 0)],
+    ]
+    enc = codec.encode_pod_devices(pd)
+    assert enc.count(";") == 2
+    assert codec.decode_pod_devices(enc) == pd
+
+
+def test_pod_devices_empty():
+    assert codec.decode_pod_devices("") == []

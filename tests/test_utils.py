@@ -1,0 +1,261 @@
+"""Tests for node lock, resource parsing, and the allocation handshake
+helpers (ref gaps: score.go / util.go allocation protocol were untested)."""
+
+import pytest
+
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.k8s.objects import get_annotations
+from vtpu.utils import codec
+from vtpu.utils.allocate import (
+    erase_next_device_type_from_annotation,
+    get_next_device_request,
+    get_pending_pod,
+    pod_allocation_failed,
+    pod_allocation_try_success,
+)
+from vtpu.utils.nodelock import (
+    NodeLockError,
+    lock_node,
+    release_node_lock,
+    set_node_lock,
+)
+from vtpu.utils.resources import pod_requests_any, resource_reqs
+from vtpu.utils.types import BindPhase, ContainerDevice, annotations, resources
+
+
+def tpu_container(n=1, mem=None, mem_pct=None, cores=None, name="main"):
+    limits = {resources.chip: n}
+    if mem is not None:
+        limits[resources.memory] = mem
+    if mem_pct is not None:
+        limits[resources.memory_percentage] = mem_pct
+    if cores is not None:
+        limits[resources.cores] = cores
+    return {"name": name, "resources": {"limits": limits}}
+
+
+# -- node lock ------------------------------------------------------------
+
+
+def test_node_lock_take_release():
+    c = FakeClient()
+    c.create_node(new_node("n1"))
+    set_node_lock(c, "n1")
+    assert annotations.NODE_LOCK in get_annotations(c.get_node("n1"))
+    with pytest.raises(NodeLockError):
+        set_node_lock(c, "n1")
+    release_node_lock(c, "n1")
+    assert annotations.NODE_LOCK not in get_annotations(c.get_node("n1"))
+
+
+def test_node_lock_breaks_stale():
+    c = FakeClient()
+    c.create_node(new_node("n1", {annotations.NODE_LOCK: "2000-01-01T00:00:00Z"}))
+    lock_node(c, "n1", backoff_s=0)  # stale lock (year 2000) must be broken
+    annos = get_annotations(c.get_node("n1"))
+    assert annos[annotations.NODE_LOCK] != "2000-01-01T00:00:00Z"
+
+
+def test_node_lock_contended_times_out():
+    c = FakeClient()
+    c.create_node(new_node("n1"))
+    set_node_lock(c, "n1")
+    with pytest.raises(NodeLockError):
+        lock_node(c, "n1", retries=2, backoff_s=0)
+
+
+# -- resource parsing -----------------------------------------------------
+
+
+def test_resource_reqs_defaults_to_full_chip():
+    pod = new_pod("p", containers=[tpu_container(n=2)])
+    reqs = resource_reqs(pod)
+    assert len(reqs) == 1 and len(reqs[0]) == 1
+    r = reqs[0][0]
+    assert r.nums == 2 and r.memreq == 0 and r.mem_percentage == 100 and r.coresreq == 0
+
+
+def test_resource_reqs_explicit_mem_cores():
+    pod = new_pod("p", containers=[tpu_container(mem=4096, cores=25)])
+    r = resource_reqs(pod)[0][0]
+    assert r.memreq == 4096 and r.coresreq == 25
+
+
+def test_resource_reqs_percentage():
+    pod = new_pod("p", containers=[tpu_container(mem_pct=25)])
+    r = resource_reqs(pod)[0][0]
+    assert r.memreq == 0 and r.mem_percentage == 25
+
+
+def test_resource_reqs_default_mem_from_config():
+    pod = new_pod("p", containers=[tpu_container()])
+    r = resource_reqs(pod, default_mem=2048)[0][0]
+    assert r.memreq == 2048
+
+
+def test_resource_reqs_quantity_suffixes():
+    pod = new_pod(
+        "p",
+        containers=[{"name": "c", "resources": {"limits": {resources.chip: "1", resources.memory: "4Gi"}}}],
+    )
+    assert resource_reqs(pod)[0][0].memreq == 4096
+
+
+def test_resource_reqs_requests_fallback():
+    pod = new_pod(
+        "p",
+        containers=[{"name": "c", "resources": {"requests": {resources.chip: 1}}}],
+    )
+    assert resource_reqs(pod)[0][0].nums == 1
+    assert pod_requests_any(pod)
+
+
+def test_non_tpu_pod():
+    pod = new_pod("p", containers=[{"name": "c", "resources": {}}])
+    assert resource_reqs(pod) == [[]]
+    assert not pod_requests_any(pod)
+
+
+# -- allocation handshake -------------------------------------------------
+
+
+def make_assigned_pod(client, node="n1", phase=BindPhase.ALLOCATING):
+    devs = [[ContainerDevice("chip-0", "TPU", 4096, 25)]]
+    pod = new_pod(
+        "w",
+        containers=[tpu_container(mem=4096, cores=25)],
+        annotations={
+            annotations.ASSIGNED_NODE: node,
+            annotations.BIND_PHASE: phase,
+            annotations.BIND_TIME: "100",
+            annotations.ASSIGNED_IDS: codec.encode_pod_devices(devs),
+            annotations.DEVICES_TO_ALLOCATE: codec.encode_pod_devices(devs),
+        },
+        node_name=node,
+    )
+    return client.create_pod(pod)
+
+
+def test_allocation_handshake_flow():
+    c = FakeClient()
+    c.create_node(new_node("n1"))
+    set_node_lock(c, "n1")
+    pod = make_assigned_pod(c)
+
+    pending = get_pending_pod(c, "n1")
+    assert pending is not None and pending["metadata"]["name"] == "w"
+
+    devs = get_next_device_request("TPU", pending)
+    assert [d.uuid for d in devs] == ["chip-0"]
+
+    erase_next_device_type_from_annotation(c, "TPU", pending)
+    fresh = c.get_pod("default", "w")
+    assert get_annotations(fresh)[annotations.DEVICES_TO_ALLOCATE] == ""
+
+    pod_allocation_try_success(c, pending)
+    fresh = c.get_pod("default", "w")
+    assert get_annotations(fresh)[annotations.BIND_PHASE] == BindPhase.SUCCESS
+    # node lock released
+    assert annotations.NODE_LOCK not in get_annotations(c.get_node("n1"))
+    assert pod is not None
+
+
+def test_allocation_failure_releases_lock():
+    c = FakeClient()
+    c.create_node(new_node("n1"))
+    set_node_lock(c, "n1")
+    make_assigned_pod(c)
+    pending = get_pending_pod(c, "n1")
+    pod_allocation_failed(c, pending)
+    fresh = c.get_pod("default", "w")
+    assert get_annotations(fresh)[annotations.BIND_PHASE] == BindPhase.FAILED
+    assert annotations.NODE_LOCK not in get_annotations(c.get_node("n1"))
+
+
+def test_pending_pod_none():
+    c = FakeClient()
+    c.create_node(new_node("n1"))
+    assert get_pending_pod(c, "n1") is None
+
+
+def test_try_success_waits_for_other_family():
+    """A second pending container entry must hold back success."""
+    c = FakeClient()
+    c.create_node(new_node("n1"))
+    set_node_lock(c, "n1")
+    devs = [
+        [ContainerDevice("chip-0", "TPU", 1024, 0)],
+        [ContainerDevice("chip-1", "TPU", 1024, 0)],
+    ]
+    pod = new_pod(
+        "w2",
+        containers=[tpu_container(), tpu_container(name="side")],
+        annotations={
+            annotations.ASSIGNED_NODE: "n1",
+            annotations.BIND_PHASE: BindPhase.ALLOCATING,
+            annotations.DEVICES_TO_ALLOCATE: codec.encode_pod_devices(devs),
+        },
+        node_name="n1",
+    )
+    c.create_pod(pod)
+    pending = get_pending_pod(c, "n1")
+    erase_next_device_type_from_annotation(c, "TPU", pending)
+    pod_allocation_try_success(c, pending)
+    fresh = c.get_pod("default", "w2")
+    # one container still pending ⇒ phase unchanged, lock still held
+    assert get_annotations(fresh)[annotations.BIND_PHASE] == BindPhase.ALLOCATING
+    assert annotations.NODE_LOCK in get_annotations(c.get_node("n1"))
+
+
+# -- review regressions ---------------------------------------------------
+
+
+def test_node_lock_race_is_exclusive():
+    """Two takers racing on the same observed state: exactly one wins
+    (optimistic concurrency via resourceVersion, ref nodelock.go:60-61)."""
+    import vtpu.utils.nodelock as nl
+    from vtpu.k8s.errors import Conflict
+
+    c = FakeClient()
+    c.create_node(new_node("n1"))
+    node = c.get_node("n1")
+    rv = node["metadata"]["resourceVersion"]
+    c.patch_node_annotations("n1", {annotations.NODE_LOCK: "x"}, resource_version=rv)
+    with pytest.raises(Conflict):
+        c.patch_node_annotations("n1", {annotations.NODE_LOCK: "y"}, resource_version=rv)
+    assert nl  # imported for symmetry
+
+
+def test_node_lock_stale_break_on_last_retry_acquires():
+    c = FakeClient()
+    c.create_node(new_node("n1", {annotations.NODE_LOCK: "2000-01-01T00:00:00Z"}))
+    lock_node(c, "n1", retries=1, backoff_s=0)  # must acquire, not raise
+    assert annotations.NODE_LOCK in get_annotations(c.get_node("n1"))
+
+
+def test_release_respects_fresh_holder():
+    from vtpu.utils.nodelock import release_node_lock as rel
+
+    c = FakeClient()
+    c.create_node(new_node("n1", {annotations.NODE_LOCK: "fresh-holder"}))
+    rel(c, "n1", expected_value="stale-value-we-saw")
+    # lock untouched: the holder changed since we observed staleness
+    assert get_annotations(c.get_node("n1"))[annotations.NODE_LOCK] == "fresh-holder"
+
+
+def test_negative_coords_roundtrip():
+    chips = [
+        __import__("vtpu.utils.types", fromlist=["ChipInfo"]).ChipInfo(
+            "u", 1, 1024, 100, "TPU-v5e", True, (-1, 0, 2)
+        )
+    ]
+    assert codec.decode_node_devices(codec.encode_node_devices(chips))[0].coords == (-1, 0, 2)
+
+
+def test_quantity_decimal_vs_binary():
+    pod_g = new_pod("p", containers=[{"name": "c", "resources": {"limits": {resources.chip: 1, resources.memory: "16G"}}}])
+    pod_gi = new_pod("p", containers=[{"name": "c", "resources": {"limits": {resources.chip: 1, resources.memory: "16Gi"}}}])
+    g = resource_reqs(pod_g)[0][0].memreq
+    gi = resource_reqs(pod_gi)[0][0].memreq
+    assert gi == 16384
+    assert g == int(16 * 1000**3 / 1024**2)  # 15258 MiB — decimal ≠ binary

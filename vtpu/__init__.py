@@ -1,0 +1,24 @@
+"""vtpu — TPU sharing and topology-aware scheduling for Kubernetes.
+
+A TPU-native framework with the capabilities of the 4paradigm/zhengbingxian
+`k8s-vgpu-scheduler` (reference at /root/reference): it makes TPU chips
+first-class *shareable* Kubernetes resources.
+
+Components (see SURVEY.md for the reference layer map):
+- ``vtpu.utils``      shared types, annotation codecs, node lock (ref pkg/util)
+- ``vtpu.k8s``        minimal Kubernetes REST client + in-memory fake (ref pkg/k8sutil)
+- ``vtpu.device``     chip discovery: fake JSON provider, libtpu/PJRT, ICI topology
+                      (ref pkg/device-plugin/mlu/cndev + cntopo)
+- ``vtpu.scheduler``  scheduler extender: filter/score/bind, webhook, registry
+                      (ref pkg/scheduler)
+- ``vtpu.plugin``     kubelet device plugin (ref pkg/device-plugin)
+- ``vtpu.monitor``    node monitor: shared-region reader, Prometheus exporter
+                      (ref cmd/vGPUmonitor)
+- ``vtpu.shim``       in-container enforcement runtime (ref lib/nvidia/libvgpu.so;
+                      native interposer in cpp/)
+- ``vtpu.models``     ai-benchmark workload models, JAX/flax (ref benchmarks/)
+- ``vtpu.ops``        Pallas TPU kernels for workload hot ops
+- ``vtpu.parallel``   mesh/sharding helpers for multi-chip tenants
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,16 @@
+"""Minimal Kubernetes client layer (ref: pkg/k8sutil, client-go usage).
+
+Objects are plain dicts shaped exactly like the Kubernetes JSON API — the
+same property that makes the reference's annotation bus inspectable with
+kubectl keeps this layer thin and testable.  `FakeClient` is the in-memory
+analog of client-go's fake.NewSimpleClientset (SURVEY.md §4: "a fake clientset
+can simulate the whole register→filter→bind→allocate handshake in-process").
+"""
+
+from vtpu.k8s.fake import FakeClient  # noqa: F401
+from vtpu.k8s.objects import (  # noqa: F401
+    get_annotations,
+    new_node,
+    new_pod,
+    pod_uid,
+)

@@ -1,0 +1,191 @@
+"""Real Kubernetes REST client over the stdlib (no client-go equivalent here).
+
+Ref: pkg/k8sutil/client.go:33-48 — in-cluster config with $KUBECONFIG
+fallback.  Implements exactly the verbs the framework needs: get/list nodes
+and pods, merge-patch annotations, create pod bindings.  Patches use
+``application/merge-patch+json`` so a ``null`` value deletes an annotation —
+the same semantics the fake client implements.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+from vtpu.k8s.errors import Conflict  # noqa: E402
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, body: str) -> None:
+        super().__init__(f"kubernetes API error {status}: {body[:200]}")
+        self.status = status
+        self.body = body
+
+
+class Client:
+    """Token-auth REST client. In-cluster by default; set ``base_url`` /
+    ``token`` / ``ca_file`` explicitly for out-of-cluster use (e.g. pointing
+    at a kind cluster or a test apiserver)."""
+
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        token: Optional[str] = None,
+        ca_file: Optional[str] = None,
+        insecure: bool = False,
+    ) -> None:
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not in-cluster (KUBERNETES_SERVICE_HOST unset) and no base_url given"
+                )
+            base_url = f"https://{host}:{port}"
+        self.base_url = base_url.rstrip("/")
+        if token is None and os.path.exists(os.path.join(_SA_DIR, "token")):
+            with open(os.path.join(_SA_DIR, "token")) as f:
+                token = f.read().strip()
+        self.token = token
+        if ca_file is None and os.path.exists(os.path.join(_SA_DIR, "ca.crt")):
+            ca_file = os.path.join(_SA_DIR, "ca.crt")
+        if insecure:
+            self._ctx: Optional[ssl.SSLContext] = ssl._create_unverified_context()
+        elif ca_file:
+            self._ctx = ssl.create_default_context(cafile=ca_file)
+        else:
+            self._ctx = ssl.create_default_context() if self.base_url.startswith("https") else None
+
+    # -- low level --------------------------------------------------------
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        content_type: str = "application/json",
+        params: Optional[Dict[str, str]] = None,
+    ) -> dict:
+        url = self.base_url + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as resp:
+                raw = resp.read()
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")) from e
+        return json.loads(raw) if raw else {}
+
+    # -- nodes ------------------------------------------------------------
+    def get_node(self, name: str) -> dict:
+        return self._request("GET", f"/api/v1/nodes/{name}")
+
+    def list_nodes(self) -> List[dict]:
+        return self._request("GET", "/api/v1/nodes").get("items", [])
+
+    def patch_node_annotations(
+        self,
+        name: str,
+        annotations: Dict[str, Optional[str]],
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        # ref: PatchNodeAnnotations (util.go:262-284).  Unconditional updates
+        # use merge-patch; conditional ones (the node lock) use a JSON patch
+        # whose leading `test` op on resourceVersion makes the apiserver
+        # reject the write if the node changed since it was read — the
+        # optimistic concurrency the reference gets from client-go Update().
+        if resource_version is None:
+            patch = {"metadata": {"annotations": annotations}}
+            return self._request(
+                "PATCH", f"/api/v1/nodes/{name}", patch, "application/merge-patch+json"
+            )
+        ops = [
+            {"op": "test", "path": "/metadata/resourceVersion", "value": resource_version}
+        ]
+        for k, v in annotations.items():
+            path = "/metadata/annotations/" + k.replace("~", "~0").replace("/", "~1")
+            if v is None:
+                ops.append({"op": "remove", "path": path})
+            else:
+                ops.append({"op": "add", "path": path, "value": v})
+        try:
+            return self._request(
+                "PATCH", f"/api/v1/nodes/{name}", ops, "application/json-patch+json"
+            )
+        except ApiError as e:
+            if e.status in (409, 422):
+                raise Conflict(str(e)) from e
+            raise
+
+    # -- pods -------------------------------------------------------------
+    def get_pod(self, namespace: str, name: str) -> dict:
+        return self._request("GET", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+    def list_pods(self, node_name: Optional[str] = None) -> List[dict]:
+        params = {}
+        if node_name is not None:
+            params["fieldSelector"] = f"spec.nodeName={node_name}"
+        return self._request("GET", "/api/v1/pods", params=params or None).get("items", [])
+
+    def patch_pod_annotations(
+        self, namespace: str, name: str, annotations: Dict[str, Optional[str]]
+    ) -> dict:
+        patch = {"metadata": {"annotations": annotations}}
+        return self._request(
+            "PATCH",
+            f"/api/v1/namespaces/{namespace}/pods/{name}",
+            patch,
+            "application/merge-patch+json",
+        )
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        # ref: scheduler.go:402-442 — POST Binding subresource
+        binding = {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {"name": name, "namespace": namespace},
+            "target": {"apiVersion": "v1", "kind": "Node", "name": node_name},
+        }
+        self._request(
+            "POST", f"/api/v1/namespaces/{namespace}/pods/{name}/binding", binding
+        )
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._request("DELETE", f"/api/v1/namespaces/{namespace}/pods/{name}")
+
+
+def new_client() -> Client:
+    """In-cluster, else $VTPU_APISERVER + $VTPU_TOKEN (test/dev).
+
+    TLS verification stays ON by default; point $VTPU_CA_FILE at the
+    cluster CA, or set $VTPU_INSECURE_SKIP_TLS_VERIFY=true explicitly (the
+    same opt-in shape as kubectl's --insecure-skip-tls-verify)."""
+    if os.environ.get("KUBERNETES_SERVICE_HOST"):
+        return Client()
+    base = os.environ.get("VTPU_APISERVER")
+    if not base:
+        raise RuntimeError("set VTPU_APISERVER for out-of-cluster use")
+    insecure = os.environ.get("VTPU_INSECURE_SKIP_TLS_VERIFY", "").lower() in (
+        "1",
+        "true",
+        "yes",
+    )
+    return Client(
+        base_url=base,
+        token=os.environ.get("VTPU_TOKEN"),
+        ca_file=os.environ.get("VTPU_CA_FILE"),
+        insecure=insecure,
+    )

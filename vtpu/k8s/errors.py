@@ -1,0 +1,9 @@
+"""Shared client exceptions (real and fake clients raise the same types)."""
+
+
+class NotFound(Exception):
+    pass
+
+
+class Conflict(Exception):
+    """Optimistic-concurrency conflict (resourceVersion mismatch)."""

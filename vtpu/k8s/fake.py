@@ -1,0 +1,149 @@
+"""In-memory fake Kubernetes client.
+
+Analog of client-go's fake.NewSimpleClientset used by the reference's tests
+(ref: SURVEY.md §4).  Implements the same surface as `vtpu.k8s.client.Client`
+with merge-patch annotation semantics (value None deletes the key), so the
+entire register→filter→bind→allocate handshake runs in-process without a
+cluster.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+from vtpu.k8s.errors import Conflict, NotFound  # noqa: F401  (re-export)
+
+
+class FakeClient:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, dict] = {}
+        self._pods: Dict[str, dict] = {}  # key: ns/name
+        self._rv = 0
+        # hooks for tests: called after each mutation with (kind, obj)
+        self.on_mutate: Optional[Callable[[str, dict], None]] = None
+
+    # -- helpers ----------------------------------------------------------
+    def _bump(self, obj: dict) -> None:
+        self._rv += 1
+        obj["metadata"]["resourceVersion"] = str(self._rv)
+
+    @staticmethod
+    def _key(namespace: str, name: str) -> str:
+        return f"{namespace}/{name}"
+
+    def _notify(self, kind: str, obj: dict) -> None:
+        if self.on_mutate is not None:
+            self.on_mutate(kind, copy.deepcopy(obj))
+
+    # -- nodes ------------------------------------------------------------
+    def create_node(self, node: dict) -> dict:
+        with self._lock:
+            name = node["metadata"]["name"]
+            self._bump(node)
+            self._nodes[name] = copy.deepcopy(node)
+            return copy.deepcopy(node)
+
+    def get_node(self, name: str) -> dict:
+        with self._lock:
+            if name not in self._nodes:
+                raise NotFound(f"node {name}")
+            return copy.deepcopy(self._nodes[name])
+
+    def list_nodes(self) -> List[dict]:
+        with self._lock:
+            return [copy.deepcopy(n) for n in self._nodes.values()]
+
+    def patch_node_annotations(
+        self,
+        name: str,
+        annotations: Dict[str, Optional[str]],
+        resource_version: Optional[str] = None,
+    ) -> dict:
+        """Merge-patch metadata.annotations; None deletes (ref:
+        PatchNodeAnnotations util.go:262-284).  When ``resource_version`` is
+        given the patch is conditional and raises Conflict on mismatch —
+        the optimistic-concurrency semantics of client-go's Update() that the
+        reference's node lock relies on (nodelock.go:60-61)."""
+        with self._lock:
+            if name not in self._nodes:
+                raise NotFound(f"node {name}")
+            node = self._nodes[name]
+            if (
+                resource_version is not None
+                and node["metadata"].get("resourceVersion") != resource_version
+            ):
+                raise Conflict(f"node {name}: resourceVersion mismatch")
+            annos = node["metadata"].setdefault("annotations", {})
+            for k, v in annotations.items():
+                if v is None:
+                    annos.pop(k, None)
+                else:
+                    annos[k] = v
+            self._bump(node)
+            self._notify("Node", node)
+            return copy.deepcopy(node)
+
+    # -- pods -------------------------------------------------------------
+    def create_pod(self, pod: dict) -> dict:
+        with self._lock:
+            k = self._key(pod["metadata"].get("namespace", "default"), pod["metadata"]["name"])
+            self._bump(pod)
+            self._pods[k] = copy.deepcopy(pod)
+            self._notify("Pod", pod)
+            return copy.deepcopy(pod)
+
+    def get_pod(self, namespace: str, name: str) -> dict:
+        with self._lock:
+            k = self._key(namespace, name)
+            if k not in self._pods:
+                raise NotFound(f"pod {k}")
+            return copy.deepcopy(self._pods[k])
+
+    def list_pods(self, node_name: Optional[str] = None) -> List[dict]:
+        """List pods, optionally filtered by spec.nodeName (the field selector
+        the device plugin uses to find its pending pod, ref util.go:55-80)."""
+        with self._lock:
+            pods = [copy.deepcopy(p) for p in self._pods.values()]
+        if node_name is not None:
+            pods = [p for p in pods if p.get("spec", {}).get("nodeName") == node_name]
+        return pods
+
+    def patch_pod_annotations(
+        self, namespace: str, name: str, annotations: Dict[str, Optional[str]]
+    ) -> dict:
+        with self._lock:
+            k = self._key(namespace, name)
+            if k not in self._pods:
+                raise NotFound(f"pod {k}")
+            pod = self._pods[k]
+            annos = pod["metadata"].setdefault("annotations", {})
+            for key, v in annotations.items():
+                if v is None:
+                    annos.pop(key, None)
+                else:
+                    annos[key] = v
+            self._bump(pod)
+            self._notify("Pod", pod)
+            return copy.deepcopy(pod)
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        """POST pods/<name>/binding analog (ref: scheduler.go:428)."""
+        with self._lock:
+            k = self._key(namespace, name)
+            if k not in self._pods:
+                raise NotFound(f"pod {k}")
+            pod = self._pods[k]
+            pod.setdefault("spec", {})["nodeName"] = node_name
+            self._bump(pod)
+            self._notify("Pod", pod)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            k = self._key(namespace, name)
+            pod = self._pods.pop(k, None)
+            if pod is not None:
+                self._notify("PodDeleted", pod)

@@ -1,0 +1,66 @@
+"""Helpers over dict-shaped Kubernetes objects."""
+
+from __future__ import annotations
+
+import uuid as _uuid
+from typing import Dict, List, Optional
+
+
+def new_node(name: str, annotations: Optional[Dict[str, str]] = None) -> dict:
+    return {
+        "apiVersion": "v1",
+        "kind": "Node",
+        "metadata": {"name": name, "annotations": dict(annotations or {})},
+        "status": {},
+    }
+
+
+def new_pod(
+    name: str,
+    namespace: str = "default",
+    containers: Optional[List[dict]] = None,
+    annotations: Optional[Dict[str, str]] = None,
+    uid: Optional[str] = None,
+    node_name: Optional[str] = None,
+) -> dict:
+    """Build a minimal pod object.  Each container:
+    ``{"name": ..., "resources": {"limits": {...}, "requests": {...}}}``.
+    """
+    pod = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "uid": uid or str(_uuid.uuid4()),
+            "annotations": dict(annotations or {}),
+            "labels": {},
+        },
+        "spec": {"containers": list(containers or [])},
+        "status": {"phase": "Pending"},
+    }
+    if node_name:
+        pod["spec"]["nodeName"] = node_name
+    return pod
+
+
+def get_annotations(obj: dict) -> Dict[str, str]:
+    return obj.setdefault("metadata", {}).setdefault("annotations", {})
+
+
+def pod_uid(pod: dict) -> str:
+    return pod["metadata"]["uid"]
+
+
+def pod_key(pod: dict) -> str:
+    return f"{pod['metadata'].get('namespace', 'default')}/{pod['metadata']['name']}"
+
+
+def container_limits(container: dict) -> Dict[str, str]:
+    res = container.get("resources") or {}
+    limits = dict(res.get("limits") or {})
+    # limits→requests fallback (ref: pkg/k8sutil/pod.go:27-119 uses limits,
+    # falling back to requests when a limit is absent)
+    for k, v in (res.get("requests") or {}).items():
+        limits.setdefault(k, v)
+    return limits

@@ -1,0 +1,21 @@
+"""Shared types, codecs and cluster utilities (ref: pkg/util, pkg/k8sutil)."""
+
+from vtpu.utils.types import (  # noqa: F401
+    BindPhase,
+    ChipInfo,
+    ContainerDevice,
+    ContainerDeviceRequest,
+    HandshakeState,
+    KNOWN_DEVICES,
+    PodDevices,
+    annotations,
+    resources,
+)
+from vtpu.utils.codec import (  # noqa: F401
+    decode_container_devices,
+    decode_node_devices,
+    decode_pod_devices,
+    encode_container_devices,
+    encode_node_devices,
+    encode_pod_devices,
+)

@@ -1,0 +1,115 @@
+"""Allocation handshake helpers shared by scheduler and device plugin.
+
+Ref: pkg/util/util.go:55-260 — the subtle part of the protocol (SURVEY.md §7
+"hard part 4").  Sequence per pod:
+
+  scheduler Filter  → writes ASSIGNED_IDS + DEVICES_TO_ALLOCATE annotations
+  scheduler Bind    → node lock taken, BIND_PHASE=allocating, Binding posted
+  kubelet Allocate  → plugin finds the pending pod on its node, pops the next
+                      device request for its device type from
+                      DEVICES_TO_ALLOCATE, injects env/mounts
+  plugin            → try-success: when DEVICES_TO_ALLOCATE drains empty,
+                      BIND_PHASE=success and the node lock is released;
+                      on any failure BIND_PHASE=failed + lock released.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from vtpu.k8s.objects import get_annotations
+from vtpu.utils import codec
+from vtpu.utils.nodelock import release_node_lock
+from vtpu.utils.types import BindPhase, ContainerDevice, annotations
+
+log = logging.getLogger(__name__)
+
+
+def get_pending_pod(client, node_name: str) -> Optional[dict]:
+    """Find the pod currently mid-allocation on this node (ref:
+    GetPendingPod util.go:55-80).  The node lock serialises binds per node, so
+    at most one pod should be in ``allocating`` at a time; if several are
+    found (lock expiry race) the earliest bind-time wins."""
+    pending = []
+    for pod in client.list_pods(node_name=node_name):
+        annos = get_annotations(pod)
+        if annos.get(annotations.BIND_PHASE) == BindPhase.ALLOCATING:
+            pending.append(pod)
+    if not pending:
+        # Binding may not have propagated spec.nodeName yet; fall back to the
+        # scheduler's assignment annotation.
+        for pod in client.list_pods():
+            annos = get_annotations(pod)
+            if (
+                annos.get(annotations.BIND_PHASE) == BindPhase.ALLOCATING
+                and annos.get(annotations.ASSIGNED_NODE) == node_name
+            ):
+                pending.append(pod)
+    if not pending:
+        return None
+    pending.sort(key=lambda p: get_annotations(p).get(annotations.BIND_TIME, ""))
+    return pending[0]
+
+
+def get_next_device_request(device_type: str, pod: dict) -> List[ContainerDevice]:
+    """Pop-view: first container's device list of ``device_type`` still in
+    DEVICES_TO_ALLOCATE (ref: GetNextDeviceRequest util.go:174-191)."""
+    annos = get_annotations(pod)
+    to_alloc = codec.decode_pod_devices(annos.get(annotations.DEVICES_TO_ALLOCATE, ""))
+    for ctr_devs in to_alloc:
+        if ctr_devs and all(d.type == device_type for d in ctr_devs):
+            return ctr_devs
+    raise LookupError(f"no pending {device_type} request in pod annotations")
+
+
+def erase_next_device_type_from_annotation(client, device_type: str, pod: dict) -> None:
+    """Remove the first container entry of ``device_type`` and re-patch
+    (ref: EraseNextDeviceTypeFromAnnotation util.go:193-221)."""
+    annos = get_annotations(pod)
+    to_alloc = codec.decode_pod_devices(annos.get(annotations.DEVICES_TO_ALLOCATE, ""))
+    out, erased = [], False
+    for ctr_devs in to_alloc:
+        if not erased and ctr_devs and all(d.type == device_type for d in ctr_devs):
+            erased = True
+            out.append([])  # keep container position; an empty list encodes ''
+        else:
+            out.append(ctr_devs)
+    # trailing/full-empty → store the encoded (possibly empty) string
+    enc = codec.encode_pod_devices(out)
+    if all(not c for c in out):
+        enc = ""
+    client.patch_pod_annotations(
+        pod["metadata"]["namespace"], pod["metadata"]["name"],
+        {annotations.DEVICES_TO_ALLOCATE: enc},
+    )
+
+
+def pod_allocation_try_success(client, pod: dict) -> None:
+    """If DEVICES_TO_ALLOCATE has drained, flip to success and release the
+    node lock (ref: PodAllocationTrySuccess/Success util.go:223-247)."""
+    fresh = client.get_pod(pod["metadata"]["namespace"], pod["metadata"]["name"])
+    remaining = get_annotations(fresh).get(annotations.DEVICES_TO_ALLOCATE, "")
+    if remaining.strip(";"):
+        return  # another device family still pending
+    client.patch_pod_annotations(
+        pod["metadata"]["namespace"], pod["metadata"]["name"],
+        {annotations.BIND_PHASE: BindPhase.SUCCESS},
+    )
+    node = get_annotations(fresh).get(annotations.ASSIGNED_NODE)
+    if node:
+        release_node_lock(client, node)
+
+
+def pod_allocation_failed(client, pod: dict) -> None:
+    """Ref: PodAllocationFailed (util.go:249-260)."""
+    client.patch_pod_annotations(
+        pod["metadata"]["namespace"], pod["metadata"]["name"],
+        {annotations.BIND_PHASE: BindPhase.FAILED},
+    )
+    node = get_annotations(pod).get(annotations.ASSIGNED_NODE)
+    if node:
+        try:
+            release_node_lock(client, node)
+        except Exception:  # noqa: BLE001 — failure path must not raise
+            log.exception("failed to release node lock on %s", node)

@@ -1,0 +1,116 @@
+"""String codecs for the annotation wire format.
+
+Ref: pkg/util/util.go:82-172 (EncodeNodeDevices/DecodeNodeDevices,
+Encode/DecodeContainerDevices, Encode/DecodePodDevices).  Annotations are the
+cross-process RPC bus; these strings ARE the API between the device plugin and
+the scheduler, so they are versioned by shape and covered by round-trip tests
+(tests/test_codec.py) — a gap in the reference (only 2 cases in util_test.go).
+
+Wire shapes:
+  node register   chip(,)fields joined by ':'
+                  ``uuid,count,hbm_mb,cores,type,x-y-z,health:...``
+  container devs  ``uuid,type,usedmem,usedcores`` joined by ':'
+  pod devices     container lists joined by ';'
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from vtpu.utils.types import ChipInfo, ContainerDevice, PodDevices
+
+_FIELD = ","
+_DEV = ":"
+_CTR = ";"
+
+
+def _coords_str(coords: Optional[tuple]) -> str:
+    # '.'-separated so negative coordinates round-trip; '-' is the None
+    # sentinel and can never collide with a coordinate list.
+    if coords is None:
+        return "-"
+    return ".".join(str(int(c)) for c in coords)
+
+
+def _parse_coords(s: str) -> Optional[tuple]:
+    if s in ("", "-"):
+        return None
+    return tuple(int(p) for p in s.split("."))
+
+
+def encode_node_devices(chips: List[ChipInfo]) -> str:
+    """Ref: EncodeNodeDevices (util.go:107-114) — ``id,count,devmem,type,health:``."""
+    out = []
+    for c in chips:
+        out.append(
+            _FIELD.join(
+                [
+                    c.uuid,
+                    str(c.count),
+                    str(c.hbm_mb),
+                    str(c.cores),
+                    c.type,
+                    _coords_str(c.coords),
+                    "true" if c.health else "false",
+                ]
+            )
+        )
+    return _DEV.join(out) + _DEV if out else ""
+
+
+def decode_node_devices(s: str) -> List[ChipInfo]:
+    """Ref: DecodeNodeDevices (util.go:82-105). Tolerates trailing ':'."""
+    chips: List[ChipInfo] = []
+    for tok in s.split(_DEV):
+        if not tok:
+            continue
+        f = tok.split(_FIELD)
+        if len(f) != 7:
+            raise ValueError(f"malformed node device token: {tok!r}")
+        chips.append(
+            ChipInfo(
+                uuid=f[0],
+                count=int(f[1]),
+                hbm_mb=int(f[2]),
+                cores=int(f[3]),
+                type=f[4],
+                coords=_parse_coords(f[5]),
+                health=f[6] == "true",
+            )
+        )
+    return chips
+
+
+def encode_container_devices(devs: List[ContainerDevice]) -> str:
+    """Ref: EncodeContainerDevices (util.go:116-124) — ``uuid,type,mem,cores:``."""
+    out = [
+        _FIELD.join([d.uuid, d.type, str(d.usedmem), str(d.usedcores)]) for d in devs
+    ]
+    return _DEV.join(out) + _DEV if out else ""
+
+
+def decode_container_devices(s: str) -> List[ContainerDevice]:
+    """Ref: DecodeContainerDevices (util.go:134-160)."""
+    devs: List[ContainerDevice] = []
+    for tok in s.split(_DEV):
+        if not tok:
+            continue
+        f = tok.split(_FIELD)
+        if len(f) != 4:
+            raise ValueError(f"malformed container device token: {tok!r}")
+        devs.append(
+            ContainerDevice(uuid=f[0], type=f[1], usedmem=int(f[2]), usedcores=int(f[3]))
+        )
+    return devs
+
+
+def encode_pod_devices(pd: PodDevices) -> str:
+    """Ref: EncodePodDevices (util.go:126-132) — container lists joined by ';'."""
+    return _CTR.join(encode_container_devices(c) for c in pd)
+
+
+def decode_pod_devices(s: str) -> PodDevices:
+    """Ref: DecodePodDevices (util.go:162-172)."""
+    if not s:
+        return []
+    return [decode_container_devices(tok) for tok in s.split(_CTR)]

@@ -1,0 +1,122 @@
+"""Distributed node lock via node annotation.
+
+Ref: pkg/util/nodelock.go:50-136 — the lock is the annotation
+``vtpu.io/mutex.lock`` holding an RFC3339 timestamp.  Taken by the scheduler
+at Bind, released by the device plugin after Allocate (or on failure).  A
+stale lock auto-expires after NODE_LOCK_EXPIRE_S (5 min) so a crashed holder
+cannot wedge the node (ref nodelock.go:126-134).
+
+Mutual exclusion is real, not best-effort: acquisition is a conditional
+patch guarded by the node's resourceVersion (the optimistic-concurrency
+semantics the reference gets from client-go Update(), nodelock.go:60-61), so
+two schedulers racing for the same node cannot both win — one gets a
+Conflict and retries.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import time
+from typing import Optional
+
+from vtpu.k8s.errors import Conflict
+from vtpu.utils.types import NODE_LOCK_EXPIRE_S, NODE_LOCK_RETRIES, annotations
+
+log = logging.getLogger(__name__)
+
+
+class NodeLockError(Exception):
+    pass
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _fmt(t: datetime.datetime) -> str:
+    return t.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _parse(s: str) -> datetime.datetime:
+    return datetime.datetime.strptime(s, "%Y-%m-%dT%H:%M:%SZ").replace(
+        tzinfo=datetime.timezone.utc
+    )
+
+
+def set_node_lock(client, node_name: str) -> None:
+    """Attempt to take the lock once (ref: SetNodeLock nodelock.go:50-79).
+    Conditional on the observed resourceVersion: a concurrent taker causes a
+    Conflict, surfaced as NodeLockError."""
+    node = client.get_node(node_name)
+    meta = node.get("metadata", {})
+    annos = meta.get("annotations") or {}
+    if annotations.NODE_LOCK in annos:
+        raise NodeLockError(f"node {node_name} already locked")
+    try:
+        client.patch_node_annotations(
+            node_name,
+            {annotations.NODE_LOCK: _fmt(_now())},
+            resource_version=meta.get("resourceVersion"),
+        )
+    except Conflict as e:
+        raise NodeLockError(f"node {node_name}: lost lock race") from e
+
+
+def release_node_lock(client, node_name: str, expected_value: Optional[str] = None) -> None:
+    """Ref: ReleaseNodeLock (nodelock.go:81-111).  When ``expected_value`` is
+    given (the stale-break path) the release is conditional: if some other
+    holder re-took the lock since we observed it, leave it alone."""
+    node = client.get_node(node_name)
+    meta = node.get("metadata", {})
+    annos = meta.get("annotations") or {}
+    held = annos.get(annotations.NODE_LOCK)
+    if held is None:
+        return
+    if expected_value is not None and held != expected_value:
+        return  # a different (fresh) holder — not ours to break
+    try:
+        client.patch_node_annotations(
+            node_name,
+            {annotations.NODE_LOCK: None},
+            resource_version=meta.get("resourceVersion") if expected_value is not None else None,
+        )
+    except Conflict:
+        log.info("node %s lock changed while breaking stale lock; leaving it", node_name)
+
+
+def lock_node(
+    client, node_name: str, retries: int = NODE_LOCK_RETRIES, backoff_s: float = 0.1
+) -> None:
+    """Take the lock with retries; break stale locks (ref: LockNode
+    nodelock.go:113-136 — 5 retries, expiry after 5 minutes).  Breaking a
+    stale lock is followed by an immediate re-acquire attempt within the
+    same iteration, so a stale break on the last retry still acquires."""
+    last: Exception = NodeLockError("unreachable")
+    for i in range(retries):
+        try:
+            set_node_lock(client, node_name)
+            return
+        except NodeLockError as e:
+            last = e
+            node = client.get_node(node_name)
+            annos = node.get("metadata", {}).get("annotations") or {}
+            held = annos.get(annotations.NODE_LOCK)
+            if held:
+                try:
+                    age = (_now() - _parse(held)).total_seconds()
+                except ValueError:
+                    age = NODE_LOCK_EXPIRE_S + 1  # unparseable ⇒ treat as stale
+                if age > NODE_LOCK_EXPIRE_S:
+                    log.warning(
+                        "breaking stale node lock on %s (age %.0fs)", node_name, age
+                    )
+                    release_node_lock(client, node_name, expected_value=held)
+                    try:
+                        set_node_lock(client, node_name)
+                        return
+                    except NodeLockError as e2:
+                        last = e2
+                        continue
+            time.sleep(backoff_s * (2**i))
+    raise last

@@ -1,0 +1,85 @@
+"""Pod spec → per-container chip requests.
+
+Ref: pkg/k8sutil/pod.go:27-119 (`Resourcereqs`) — walks containers, reads the
+managed resource limits (falling back to requests), applies scheduler
+defaults.  Returns ``[[ContainerDeviceRequest, ...], ...]`` — one inner list
+per container, one entry per device family (TPU is the only family here, but
+the shape keeps a second accelerator family pluggable like the reference's
+NVIDIA/MLU pair).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from vtpu.k8s.objects import container_limits
+from vtpu.utils.types import (
+    MEM_PERCENTAGE_UNSET,
+    ContainerDeviceRequest,
+    DEVICE_TYPE_TPU,
+    resources,
+)
+
+
+def _as_int(v) -> int:
+    if isinstance(v, (int, float)):
+        return int(v)
+    s = str(v).strip()
+    # Canonical unit is MiB (matching hbm_mb as the plugin registers it, a
+    # MiB quantity).  k8s quantity suffixes are converted exactly: decimal
+    # suffixes go through bytes so "16G" (16e9 B) ≠ "16Gi" (2^34 B).
+    for suf, bytes_mul in (
+        ("Gi", 1024**3),
+        ("Mi", 1024**2),
+        ("Ki", 1024),
+        ("G", 1000**3),
+        ("M", 1000**2),
+        ("k", 1000),
+    ):
+        if s.endswith(suf):
+            return int(float(s[: -len(suf)]) * bytes_mul / 1024**2)
+    return int(float(s))
+
+
+def resource_reqs(
+    pod: dict, default_mem: int = 0, default_cores: int = 0
+) -> List[List[ContainerDeviceRequest]]:
+    """Parse all containers' chip requests.
+
+    Defaults (ref pod.go + scheduler config): mem → ``default_mem`` MB if
+    configured, else 100% of chip HBM; cores → ``default_cores``.
+    """
+    out: List[List[ContainerDeviceRequest]] = []
+    for ctr in pod.get("spec", {}).get("containers", []):
+        limits = container_limits(ctr)
+        reqs: List[ContainerDeviceRequest] = []
+        n = _as_int(limits.get(resources.chip, 0))
+        if n > 0:
+            mem = _as_int(limits.get(resources.memory, 0))
+            mem_pct = _as_int(limits.get(resources.memory_percentage, MEM_PERCENTAGE_UNSET))
+            if mem == 0 and mem_pct == MEM_PERCENTAGE_UNSET:
+                if default_mem > 0:
+                    mem = default_mem
+                else:
+                    mem_pct = 100
+            cores = _as_int(limits.get(resources.cores, default_cores))
+            reqs.append(
+                ContainerDeviceRequest(
+                    nums=n,
+                    type=DEVICE_TYPE_TPU,
+                    memreq=mem,
+                    mem_percentage=mem_pct,
+                    coresreq=cores,
+                )
+            )
+        out.append(reqs)
+    return out
+
+
+def pod_requests_any(pod: dict) -> bool:
+    """True if any container requests a managed chip resource (webhook gate,
+    ref webhook.go:90-110)."""
+    for ctr in pod.get("spec", {}).get("containers", []):
+        if _as_int(container_limits(ctr).get(resources.chip, 0)) > 0:
+            return True
+    return False

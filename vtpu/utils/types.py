@@ -1,0 +1,174 @@
+"""Core types and annotation/resource constants.
+
+TPU-native rebuild of the reference's shared type layer
+(ref: pkg/util/types.go:19-109).  The Kubernetes annotation bus is the RPC
+fabric of the whole framework: node annotations carry the device registry and
+the distributed node lock; pod annotations carry the device assignment and the
+bind-phase handshake (ref: SURVEY.md §1, §3.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+# --------------------------------------------------------------------------
+# Annotation keys (ref: pkg/util/types.go:19-66 — `4pd.io/*` family).
+# We use the `vtpu.io/` domain.  Keys are constants; *resource names* are
+# configurable (see `resources` below), mirroring values.yaml:8-17.
+# --------------------------------------------------------------------------
+
+
+class annotations:
+    """Annotation keys used on nodes and pods."""
+
+    # -- pod: assignment written by the scheduler at filter time
+    ASSIGNED_NODE = "vtpu.io/tpu-node"             # ref 4pd.io/vgpu-node
+    ASSIGNED_TIME = "vtpu.io/tpu-time"             # ref 4pd.io/vgpu-time
+    ASSIGNED_IDS = "vtpu.io/tpu-ids"               # ref 4pd.io/vgpu-ids-new
+    DEVICES_TO_ALLOCATE = "vtpu.io/devices-to-allocate"
+    # -- pod: bind handshake
+    BIND_PHASE = "vtpu.io/bind-phase"              # allocating | success | failed
+    BIND_TIME = "vtpu.io/bind-time"
+    # -- pod: chip-type selectors (ref nvidia.com/use-gputype, nouse-gputype)
+    USE_TPUTYPE = "vtpu.io/use-tputype"
+    NOUSE_TPUTYPE = "vtpu.io/nouse-tputype"
+    # -- node: registry + handshake (per device vendor; TPU is the primary)
+    NODE_HANDSHAKE = "vtpu.io/node-handshake-tpu"  # ref 4pd.io/node-handshake
+    NODE_REGISTER = "vtpu.io/node-tpu-register"    # ref 4pd.io/node-nvidia-register
+    NODE_TOPOLOGY = "vtpu.io/node-tpu-topology"    # TPU extension: slice topology
+    # -- node: distributed mutex (ref 4pd.io/mutex.lock, pkg/util/nodelock.go)
+    NODE_LOCK = "vtpu.io/mutex.lock"
+    # -- webhook escape hatch (ref charts/.../webhook.yaml:16-29 label)
+    WEBHOOK_IGNORE_LABEL = "vtpu.io/webhook"
+
+
+class BindPhase:
+    ALLOCATING = "allocating"
+    SUCCESS = "success"
+    FAILED = "failed"
+
+
+class HandshakeState:
+    """Node handshake state machine (ref: pkg/scheduler/scheduler.go:143-229).
+
+    plugin writes  ``Reported <ts>``; scheduler acks ``Requesting_<ts>``;
+    if the plugin does not re-report within HANDSHAKE_TIMEOUT_S the scheduler
+    expels the node's devices and marks ``Deleted_<ts>``.
+    """
+
+    REPORTED = "Reported"
+    REQUESTING = "Requesting"
+    DELETED = "Deleted"
+
+
+# Timing constants (ref: register.go:104-115 → 30 s; scheduler.go:143 → 15 s;
+# scheduler.go:166-184 → 60 s timeout; nodelock.go:126-134 → 5 min expiry).
+REGISTER_INTERVAL_S = 30
+REGISTER_RETRY_S = 5
+REGISTRY_POLL_INTERVAL_S = 15
+HANDSHAKE_TIMEOUT_S = 60
+NODE_LOCK_EXPIRE_S = 300
+NODE_LOCK_RETRIES = 5
+
+# Max chips a node may register; ref caps at 100 (util.DeviceLimit) for GPUs,
+# a TPU host has at most 8 local chips but we keep headroom for fake fixtures.
+DEVICE_LIMIT = 100
+
+# Default split count per chip (ref DeviceSplitCount, chart default 10).
+DEFAULT_SPLIT_COUNT = 10
+
+
+# --------------------------------------------------------------------------
+# Resource names — configurable, like the reference's --resource-name family
+# (ref: pkg/util/util.go:36-48 GlobalFlagSet; charts values.yaml:8-17).
+# --------------------------------------------------------------------------
+
+
+class _ResourceNames:
+    def __init__(self) -> None:
+        self.chip = "google.com/tpu"                # ref nvidia.com/gpu
+        self.memory = "google.com/tpumem"           # ref nvidia.com/gpumem (MB)
+        self.memory_percentage = "google.com/tpumem-percentage"
+        self.cores = "google.com/tpucores"          # percent of chip compute
+        self.priority = "google.com/priority"
+
+    def configure(self, **kw: str) -> None:
+        for k, v in kw.items():
+            if not hasattr(self, k):
+                raise KeyError(f"unknown resource name field: {k}")
+            setattr(self, k, v)
+
+
+resources = _ResourceNames()
+
+# Sentinel: "memory given as percentage, percentage not set" (ref
+# pkg/k8sutil/pod.go — mem-percentage default 101 sentinel).
+MEM_PERCENTAGE_UNSET = 101
+
+
+# --------------------------------------------------------------------------
+# Device registry / request / assignment types (ref: pkg/util/types.go:92-109)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ChipInfo:
+    """One schedulable chip as registered in the node annotation.
+
+    Ref: `DeviceInfo{ID, Count, Devmem, Type, Health}` (pkg/api proto +
+    register.go:56-82).  TPU extensions: ``cores`` capacity (always 100,
+    percent), and ``coords`` — the chip's (x,y,z) position in the node's ICI
+    mesh, which the topology-aware allocator consumes (ref analog: cntopo
+    ring enumeration, pkg/device-plugin/mlu/cntopo/cntopo.go:58-98).
+    """
+
+    uuid: str
+    count: int            # split slots advertised (DeviceSplitCount)
+    hbm_mb: int           # total HBM in MB (after memory scaling)
+    cores: int            # compute capacity in percent units (100)
+    type: str             # e.g. "TPU-v5e" (ref "NVIDIA-<model>")
+    health: bool
+    coords: Optional[tuple] = None  # (x, y, z) in the local ICI mesh
+
+    def clone(self) -> "ChipInfo":
+        return dataclasses.replace(self)
+
+
+@dataclasses.dataclass
+class ContainerDevice:
+    """One chip share assigned to a container (ref: util.ContainerDevice)."""
+
+    uuid: str
+    type: str
+    usedmem: int    # MB
+    usedcores: int  # percent
+
+
+@dataclasses.dataclass
+class ContainerDeviceRequest:
+    """Parsed per-container chip request (ref: util.ContainerDeviceRequest).
+
+    ``nums`` chips of ``type``, each granted ``memreq`` MB (or
+    ``mem_percentage`` % of chip HBM when memreq == 0) and ``coresreq`` % of
+    compute.  coresreq == 100 means exclusive (ref score.go:203-209).
+    """
+
+    nums: int
+    type: str
+    memreq: int
+    mem_percentage: int
+    coresreq: int
+
+
+# PodDevices: per-container assigned device lists.
+PodDevices = List[List[ContainerDevice]]
+
+# Device "vendors" known to the registry loop, handshake-anno → register-anno
+# (ref: util.KnownDevice map, pkg/util/types.go:79-83).  A second entry can be
+# added for another accelerator family without touching the scheduler.
+KNOWN_DEVICES = {
+    annotations.NODE_HANDSHAKE: annotations.NODE_REGISTER,
+}
+
+DEVICE_TYPE_TPU = "TPU"
