@@ -1,0 +1,319 @@
+"""Scheduler core + HTTP routes + webhook tests: the registry handshake
+state machine, end-to-end filter→bind over HTTP, and admission mutation.
+(ref: no equivalent tests exist upstream; SURVEY.md §4 implications)"""
+
+import json
+import urllib.request
+
+import pytest
+
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.k8s.objects import get_annotations
+from vtpu.scheduler import Scheduler, SchedulerConfig
+from vtpu.scheduler.routes import serve
+from vtpu.scheduler.webhook import handle_admission_review, mutate_pod
+from vtpu.utils import codec
+from vtpu.utils.types import (
+    BindPhase,
+    ChipInfo,
+    HandshakeState,
+    annotations,
+    resources,
+)
+
+
+def register_node(client, name="n1", n_chips=4, topology="2x2x1", hbm=16384):
+    chips = [
+        ChipInfo(f"{name}-chip-{i}", 10, hbm, 100, "TPU-v5e", True,
+                 (i % 2, i // 2, 0))
+        for i in range(n_chips)
+    ]
+    client.create_node(new_node(name))
+    client.patch_node_annotations(
+        name,
+        {
+            annotations.NODE_REGISTER: codec.encode_node_devices(chips),
+            annotations.NODE_TOPOLOGY: topology,
+            annotations.NODE_HANDSHAKE: f"{HandshakeState.REPORTED} 2026-01-01T00:00:00Z",
+        },
+    )
+    return chips
+
+
+def tpu_pod(name="p", n=1, mem=None, pct=None, cores=None, annos=None):
+    limits = {resources.chip: n}
+    if mem is not None:
+        limits[resources.memory] = mem
+    if pct is not None:
+        limits[resources.memory_percentage] = pct
+    if cores is not None:
+        limits[resources.cores] = cores
+    return new_pod(
+        name,
+        containers=[{"name": "main", "resources": {"limits": limits}}],
+        annotations=annos,
+    )
+
+
+# -- registry handshake ---------------------------------------------------
+
+
+def test_registry_ingests_reported_node():
+    c = FakeClient()
+    register_node(c)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    info = s.nodes.get("n1")
+    assert info is not None and len(info.devices) == 4
+    assert info.topology == "2x2x1"
+    hs = get_annotations(c.get_node("n1"))[annotations.NODE_HANDSHAKE]
+    assert hs.startswith(HandshakeState.REQUESTING)
+
+
+def test_registry_expels_dead_node():
+    c = FakeClient()
+    register_node(c)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    assert s.nodes.get("n1") is not None
+    # simulate a stale Requesting_<old-ts> (plugin died, no re-report)
+    c.patch_node_annotations(
+        "n1",
+        {annotations.NODE_HANDSHAKE: f"{HandshakeState.REQUESTING}_2000-01-01T00:00:00Z"},
+    )
+    s.register_from_node_annotations()
+    assert s.nodes.get("n1") is None
+    hs = get_annotations(c.get_node("n1"))[annotations.NODE_HANDSHAKE]
+    assert hs.startswith(HandshakeState.DELETED)
+
+
+def test_registry_node_recovers_after_rereport():
+    c = FakeClient()
+    chips = register_node(c)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    c.patch_node_annotations(
+        "n1",
+        {annotations.NODE_HANDSHAKE: f"{HandshakeState.REQUESTING}_2000-01-01T00:00:00Z"},
+    )
+    s.register_from_node_annotations()  # expelled
+    # plugin comes back and re-reports
+    c.patch_node_annotations(
+        "n1",
+        {
+            annotations.NODE_REGISTER: codec.encode_node_devices(chips),
+            annotations.NODE_HANDSHAKE: f"{HandshakeState.REPORTED} 2026-01-01T00:10:00Z",
+        },
+    )
+    s.register_from_node_annotations()
+    assert s.nodes.get("n1") is not None
+
+
+# -- filter / bind --------------------------------------------------------
+
+
+def test_filter_assigns_and_annotates():
+    c = FakeClient()
+    register_node(c)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    pod = c.create_pod(tpu_pod(mem=4096, cores=25))
+    res = s.filter(pod, ["n1"])
+    assert res.error == "" and res.node == "n1"
+    annos = get_annotations(c.get_pod("default", "p"))
+    assert annos[annotations.ASSIGNED_NODE] == "n1"
+    assigned = codec.decode_pod_devices(annos[annotations.ASSIGNED_IDS])
+    assert assigned[0][0].usedmem == 4096 and assigned[0][0].usedcores == 25
+    assert annos[annotations.DEVICES_TO_ALLOCATE] == annos[annotations.ASSIGNED_IDS]
+
+
+def test_filter_non_tpu_pod_passthrough():
+    c = FakeClient()
+    register_node(c)
+    s = Scheduler(c)
+    pod = c.create_pod(new_pod("plain", containers=[{"name": "c", "resources": {}}]))
+    res = s.filter(pod, ["n1", "other"])
+    assert res.node is None and res.error == ""
+
+
+def test_filter_no_capacity():
+    c = FakeClient()
+    register_node(c, n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    pod = c.create_pod(tpu_pod("big", n=2))
+    res = s.filter(pod, ["n1"])
+    assert res.error and res.node is None
+    assert "n1" in res.failed
+
+
+def test_filter_respects_prior_assignments():
+    """4-way share then a 5th full-chip pod must fail on a 1-chip node."""
+    c = FakeClient()
+    register_node(c, n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    for i in range(4):
+        pod = c.create_pod(tpu_pod(f"share-{i}", pct=25))
+        res = s.filter(pod, ["n1"])
+        assert res.node == "n1", res.error
+    full = c.create_pod(tpu_pod("full", pct=25))
+    res = s.filter(full, ["n1"])
+    assert res.node is None  # 4×25% HBM booked; no room
+
+
+def test_filter_binpack_across_nodes():
+    c = FakeClient()
+    register_node(c, "n1", n_chips=1)
+    register_node(c, "n2", n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    p1 = c.create_pod(tpu_pod("a", pct=25))
+    assert s.filter(p1, ["n1", "n2"]).node == "n1" or True  # either node first
+    first = get_annotations(c.get_pod("default", "a"))[annotations.ASSIGNED_NODE]
+    p2 = c.create_pod(tpu_pod("b", pct=25))
+    assert s.filter(p2, ["n1", "n2"]).node == first  # binpack sticks together
+
+
+def test_bind_locks_and_binds():
+    c = FakeClient()
+    register_node(c)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    pod = c.create_pod(tpu_pod(mem=1024))
+    s.filter(pod, ["n1"])
+    err = s.bind("default", "p", "n1")
+    assert err is None
+    fresh = c.get_pod("default", "p")
+    assert fresh["spec"]["nodeName"] == "n1"
+    assert get_annotations(fresh)[annotations.BIND_PHASE] == BindPhase.ALLOCATING
+    assert annotations.NODE_LOCK in get_annotations(c.get_node("n1"))
+
+
+def test_bind_failure_releases_lock():
+    c = FakeClient()
+    register_node(c)
+    s = Scheduler(c)
+    err = s.bind("default", "missing-pod", "n1")
+    assert err is not None
+    assert annotations.NODE_LOCK not in get_annotations(c.get_node("n1"))
+
+
+def test_scheduler_state_rebuild_from_annotations():
+    """Scheduler restart: assignments recovered from pod annotations
+    (ref scheduler.go:75-95 — the crash-safety story)."""
+    c = FakeClient()
+    register_node(c, n_chips=1)
+    s1 = Scheduler(c)
+    s1.register_from_node_annotations()
+    pod = c.create_pod(tpu_pod("survivor", pct=60))
+    s1.filter(pod, ["n1"])
+    # fresh scheduler instance — same cluster state
+    s2 = Scheduler(c)
+    s2.register_from_node_annotations()
+    s2.ingest_pods()
+    res = s2.filter(c.create_pod(tpu_pod("second", pct=60)), ["n1"])
+    assert res.node is None  # 60% already booked by survivor
+
+
+# -- HTTP routes ----------------------------------------------------------
+
+
+@pytest.fixture()
+def http_sched():
+    c = FakeClient()
+    register_node(c)
+    s = Scheduler(c, SchedulerConfig(http_bind="127.0.0.1:0"))
+    s.register_from_node_annotations()
+    srv, _ = serve(s)
+    yield c, s, f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def _post(url, body):
+    req = urllib.request.Request(
+        url, json.dumps(body).encode(), {"Content-Type": "application/json"}
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_http_filter_bind_flow(http_sched):
+    c, s, base = http_sched
+    pod = c.create_pod(tpu_pod(mem=2048, cores=10))
+    out = _post(base + "/filter", {"Pod": pod, "NodeNames": ["n1"]})
+    assert out["Error"] == "" and out["NodeNames"] == ["n1"]
+    out = _post(
+        base + "/bind",
+        {"PodName": "p", "PodNamespace": "default", "PodUID": pod["metadata"]["uid"],
+         "Node": "n1"},
+    )
+    assert out["Error"] == ""
+    assert c.get_pod("default", "p")["spec"]["nodeName"] == "n1"
+
+
+def test_http_metrics_and_health(http_sched):
+    c, s, base = http_sched
+    pod = c.create_pod(tpu_pod(mem=2048))
+    _post(base + "/filter", {"Pod": pod, "NodeNames": ["n1"]})
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "vtpu_device_memory_limit_bytes" in text
+    assert "vtpu_pod_memory_allocated_bytes" in text
+    with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+        assert r.read() == b"ok"
+
+
+def test_http_bad_json(http_sched):
+    _, _, base = http_sched
+    req = urllib.request.Request(
+        base + "/filter", b"{not json", {"Content-Type": "application/json"}
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+
+
+# -- webhook --------------------------------------------------------------
+
+
+def test_webhook_sets_scheduler_name():
+    pod = tpu_pod(mem=1024)
+    ops = mutate_pod(pod, SchedulerConfig())
+    assert {"op": "add", "path": "/spec/schedulerName", "value": "vtpu-scheduler"} in ops
+
+
+def test_webhook_skips_non_tpu_pod():
+    body = {
+        "apiVersion": "admission.k8s.io/v1",
+        "request": {"uid": "u1", "object": new_pod("plain", containers=[{"name": "c"}])},
+    }
+    out = handle_admission_review(body, SchedulerConfig())
+    assert out["response"]["allowed"] and "patch" not in out["response"]
+
+
+def test_webhook_priority_env():
+    pod = tpu_pod(mem=1024)
+    pod["spec"]["containers"][0]["resources"]["limits"][resources.priority] = 1
+    ops = mutate_pod(pod, SchedulerConfig())
+    env_ops = [o for o in ops if "env" in o["path"]]
+    assert env_ops and env_ops[0]["value"][0]["name"] == "TPU_TASK_PRIORITY"
+
+
+def test_webhook_privileged_container_skipped():
+    pod = tpu_pod(mem=1024)
+    pod["spec"]["containers"][0]["securityContext"] = {"privileged": True}
+    ops = mutate_pod(pod, SchedulerConfig())
+    assert ops == []  # privileged ⇒ untouched (ref webhook.go:59-71)
+
+
+def test_webhook_admission_review_roundtrip():
+    import base64
+
+    pod = tpu_pod(mem=1024)
+    body = {"apiVersion": "admission.k8s.io/v1", "request": {"uid": "u2", "object": pod}}
+    out = handle_admission_review(body, SchedulerConfig())
+    resp = out["response"]
+    assert resp["uid"] == "u2" and resp["allowed"]
+    patch = json.loads(base64.b64decode(resp["patch"]))
+    assert any(op["path"] == "/spec/schedulerName" for op in patch)

@@ -1,0 +1,155 @@
+"""Table tests for the fit/score engine (ref gap: score.go:156-250 had no
+tests despite being the most bug-prone logic — SURVEY.md §4)."""
+
+from vtpu.scheduler.score import (
+    DeviceUsage,
+    NodeUsage,
+    check_type,
+    fit_pod,
+    fits_device,
+    score_node,
+    snapshot,
+)
+from vtpu.utils.types import ContainerDeviceRequest, annotations
+
+
+def dev(uuid="d0", used=0, usedmem=0, usedcores=0, count=10, totalmem=16384,
+        health=True, type_="TPU-v5e", coords=None):
+    return DeviceUsage(
+        uuid=uuid, type=type_, health=health, count=count, used=used,
+        totalmem=totalmem, usedmem=usedmem, totalcores=100, usedcores=usedcores,
+        coords=coords,
+    )
+
+
+def req(nums=1, mem=0, pct=101, cores=0):
+    return ContainerDeviceRequest(
+        nums=nums, type="TPU", memreq=mem, mem_percentage=pct, coresreq=cores
+    )
+
+
+# -- fits_device ----------------------------------------------------------
+
+
+def test_fits_basic():
+    assert fits_device(dev(), req(mem=4096, cores=25), {})
+
+
+def test_unhealthy_never_fits():
+    assert not fits_device(dev(health=False), req(mem=1), {})
+
+
+def test_split_slots_exhausted():
+    assert not fits_device(dev(used=10, count=10), req(mem=1), {})
+
+
+def test_memory_exhausted():
+    assert not fits_device(dev(usedmem=16000), req(mem=1024), {})
+    assert fits_device(dev(usedmem=15360), req(mem=1024), {})
+
+
+def test_cores_exhausted():
+    assert not fits_device(dev(usedcores=80), req(mem=1, cores=30), {})
+    assert fits_device(dev(usedcores=70), req(mem=1, cores=30), {})
+
+
+def test_exclusive_request_needs_virgin_chip():
+    # coresreq=100 ⇒ exclusive (ref score.go:203-209)
+    assert fits_device(dev(), req(mem=1024, cores=100), {})
+    assert not fits_device(dev(used=1, usedmem=10), req(mem=1024, cores=100), {})
+
+
+def test_exclusive_occupant_blocks_everyone():
+    # usedcores=100 blocks even coresreq=0 (ref score.go:203-209)
+    assert not fits_device(dev(used=1, usedcores=100), req(mem=1, cores=0), {})
+
+
+def test_percentage_request_scales_with_chip():
+    d = dev(totalmem=10000, usedmem=7600)
+    assert not fits_device(d, req(pct=25), {})   # wants 2500, has 2400
+    assert fits_device(d, req(pct=24), {})
+
+
+def test_mem_percentage_unset_means_whole_chip():
+    assert fits_device(dev(), req(), {})             # 100% of free chip
+    assert not fits_device(dev(usedmem=1), req(), {})
+
+
+# -- type selectors -------------------------------------------------------
+
+
+def test_check_type_vendor_prefix():
+    assert check_type({}, dev(type_="TPU-v5e"), req())
+    assert not check_type({}, dev(type_="GPU-A100"), req())
+
+
+def test_use_tputype_selector():
+    annos = {annotations.USE_TPUTYPE: "v5e,v5p"}
+    assert check_type(annos, dev(type_="TPU-v5e"), req())
+    assert not check_type(annos, dev(type_="TPU-v4"), req())
+
+
+def test_nouse_tputype_selector():
+    annos = {annotations.NOUSE_TPUTYPE: "v4"}
+    assert check_type(annos, dev(type_="TPU-v5e"), req())
+    assert not check_type(annos, dev(type_="TPU-v4"), req())
+
+
+# -- fit_pod --------------------------------------------------------------
+
+
+def test_fit_pod_two_containers_share_one_chip():
+    node = NodeUsage("n", [dev()])
+    got = fit_pod(node, [[req(mem=4096, cores=25)], [req(mem=4096, cores=25)]], {})
+    assert got is not None
+    assert got[0][0].uuid == "d0" and got[1][0].uuid == "d0"
+    assert node.devices[0].usedmem == 8192 and node.devices[0].used == 2
+
+
+def test_fit_pod_books_pessimistically():
+    node = NodeUsage("n", [dev(totalmem=8192)])
+    # two containers each wanting 75% cannot share one chip
+    assert fit_pod(node, [[req(mem=6144)], [req(mem=6144)]], {}) is None
+
+
+def test_fit_pod_binpack_prefers_loaded_chip():
+    node = NodeUsage("n", [dev("empty"), dev("busy", used=1, usedmem=4096)])
+    got = fit_pod(node, [[req(mem=1024)]], {}, policy="binpack")
+    assert got[0][0].uuid == "busy"
+
+
+def test_fit_pod_spread_prefers_free_chip():
+    node = NodeUsage("n", [dev("empty"), dev("busy", used=1, usedmem=4096)])
+    got = fit_pod(node, [[req(mem=1024)]], {}, policy="spread")
+    assert got[0][0].uuid == "empty"
+
+
+def test_fit_pod_gang_uses_rectangle():
+    devs = [
+        dev(f"c{i}", coords=(x, y, 0))
+        for i, (x, y) in enumerate((x, y) for y in range(4) for x in range(4))
+    ]
+    node = NodeUsage("n", devs, topology="4x4x1")
+    got = fit_pod(node, [[req(nums=4, mem=1024)]], {})
+    assert got is not None and len(got[0]) == 4
+    coords = sorted(
+        tuple(d.coords) for d in node.devices if d.uuid in {c.uuid for c in got[0]}
+    )
+    xs = {c[0] for c in coords}
+    ys = {c[1] for c in coords}
+    assert len(xs) == 2 and len(ys) == 2, coords  # 2x2 square, not a line
+
+
+def test_fit_pod_gang_insufficient():
+    node = NodeUsage("n", [dev("a"), dev("b")])
+    assert fit_pod(node, [[req(nums=3, mem=1)]], {}) is None
+
+
+# -- score_node -----------------------------------------------------------
+
+
+def test_score_binpack_vs_spread():
+    busy = snapshot("busy", [dev(used=5, usedmem=8192, usedcores=50)], "")
+    free = snapshot("free", [dev()], "")
+    assert score_node(busy, "binpack") > score_node(free, "binpack")
+    assert score_node(free, "spread") > score_node(busy, "spread")

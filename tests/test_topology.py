@@ -1,0 +1,195 @@
+"""Topology + allocator suites (ref: spider_test.go/board_test.go — 900 LoC
+of table-driven specs against fabricated device maps; same idea, TPU shapes)."""
+
+import pytest
+
+from vtpu.device import FakeProvider, Topology
+from vtpu.device.allocator import (
+    AllocationError,
+    IciAllocator,
+    POLICY_BEST_EFFORT,
+    POLICY_GUARANTEED,
+    POLICY_RESTRICTED,
+)
+from vtpu.device.topology import (
+    box_shapes,
+    compactness,
+    enumerate_rectangles,
+    parse_topology,
+    ring_count,
+)
+
+
+# -- topology parsing -----------------------------------------------------
+
+
+def test_parse_topology_specs():
+    assert parse_topology("2x2x1") == (2, 2, 1)
+    assert parse_topology("4x4") == (4, 4, 1)
+    assert parse_topology("8") == (8, 1, 1)
+    assert parse_topology("v5litepod-8") == (2, 4, 1)
+    assert parse_topology("v5p-16") == (2, 2, 2)
+
+
+def test_parse_topology_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_topology("2x2x2x2")
+    with pytest.raises(ValueError):
+        parse_topology("0x4")
+
+
+def test_neighbors_mesh_and_torus():
+    mesh = Topology((4, 4, 1))
+    assert set(mesh.neighbors((0, 0, 0))) == {(1, 0, 0), (0, 1, 0)}
+    torus = Topology((4, 4, 1), wrap=(True, True, False))
+    assert set(torus.neighbors((0, 0, 0))) == {
+        (1, 0, 0),
+        (3, 0, 0),
+        (0, 1, 0),
+        (0, 3, 0),
+    }
+
+
+def test_connectivity():
+    t = Topology((4, 4, 1))
+    assert t.is_connected([(0, 0, 0), (1, 0, 0), (1, 1, 0)])
+    assert not t.is_connected([(0, 0, 0), (2, 0, 0)])
+    assert not t.is_connected([])
+
+
+# -- rectangle enumeration ------------------------------------------------
+
+
+def test_box_shapes():
+    assert (2, 2, 1) in box_shapes(4, (4, 4, 1))
+    assert (4, 1, 1) in box_shapes(4, (4, 4, 1))
+    assert all(a * b * c == 4 for a, b, c in box_shapes(4, (4, 4, 1)))
+    assert box_shapes(5, (2, 2, 1)) == []  # 5 doesn't fit anywhere
+
+
+def test_enumerate_rectangles_respects_availability():
+    t = Topology((2, 2, 1))
+    # one chip busy → no 4-rectangle, three 1-rectangles less
+    avail = frozenset({(0, 0, 0), (1, 0, 0), (0, 1, 0)})
+    rects4 = list(enumerate_rectangles(t, 4, avail))
+    assert rects4 == []
+    rects2 = list(enumerate_rectangles(t, 2, avail))
+    coords_sets = {r[2] for r in rects2}
+    assert frozenset({(0, 0, 0), (1, 0, 0)}) in coords_sets
+    assert frozenset({(0, 1, 0), (1, 1, 0)}) not in coords_sets
+
+
+def test_ring_count_shapes():
+    assert ring_count((1, 1, 1)) == 0
+    assert ring_count((2, 1, 1)) == 1
+    assert ring_count((3, 1, 1)) == 0   # odd line cannot close a ring
+    assert ring_count((2, 2, 1)) == 2
+    assert ring_count((2, 4, 1)) == 2
+    assert ring_count((2, 3, 1)) == 1
+
+
+def test_compactness_prefers_squares():
+    assert compactness((2, 2, 1)) > compactness((4, 1, 1))
+    assert compactness((2, 2, 2)) > compactness((8, 1, 1))
+
+
+# -- allocator ------------------------------------------------------------
+
+
+def chips_from_fixture(topology="4x4x1", busy=()):
+    p = FakeProvider({"model": "TPU-v5e", "topology": topology})
+    chips = p.enumerate()
+    return p, [c for c in chips if tuple(c.coords) not in set(busy)]
+
+
+def test_allocate_prefers_square():
+    p, avail = chips_from_fixture()
+    alloc = IciAllocator(p.topology())
+    got = alloc.allocate(avail, 4)
+    coords = sorted(tuple(c.coords) for c in got)
+    # a 2x2 square, not a 4x1 line
+    xs = {c[0] for c in coords}
+    ys = {c[1] for c in coords}
+    assert len(xs) == 2 and len(ys) == 2, coords
+
+
+def test_allocate_avoids_busy_chips():
+    p, avail = chips_from_fixture(busy=[(0, 0, 0), (1, 1, 0)])
+    alloc = IciAllocator(p.topology())
+    got = alloc.allocate(avail, 4)
+    coords = {tuple(c.coords) for c in got}
+    assert (0, 0, 0) not in coords and (1, 1, 0) not in coords
+
+
+def test_guaranteed_fails_without_rectangle():
+    # checkerboard availability: connected pairs exist, no 2x2 and no 2x1?
+    # actually a checkerboard has no adjacent pair at all
+    busy = [(x, y, 0) for x in range(4) for y in range(4) if (x + y) % 2]
+    p, avail = chips_from_fixture(busy=busy)
+    alloc = IciAllocator(p.topology(), POLICY_GUARANTEED)
+    with pytest.raises(AllocationError):
+        alloc.allocate(avail, 4)
+
+
+def test_best_effort_falls_back():
+    busy = [(x, y, 0) for x in range(4) for y in range(4) if (x + y) % 2]
+    p, avail = chips_from_fixture(busy=busy)
+    alloc = IciAllocator(p.topology(), POLICY_BEST_EFFORT)
+    got = alloc.allocate(avail, 4)
+    assert len(got) == 4
+
+
+def test_restricted_gates_even_sizes():
+    busy = [(x, y, 0) for x in range(4) for y in range(4) if (x + y) % 2]
+    p, avail = chips_from_fixture(busy=busy)
+    alloc = IciAllocator(p.topology(), POLICY_RESTRICTED)
+    with pytest.raises(AllocationError):
+        alloc.allocate(avail, 2)  # even size needs a ring-capable rectangle
+
+
+def test_unhealthy_skipped():
+    p, avail = chips_from_fixture("2x2x1")
+    p.set_health(avail[0].uuid, False)
+    alloc = IciAllocator(p.topology(), POLICY_BEST_EFFORT)
+    with pytest.raises(AllocationError):
+        alloc.allocate(p.enumerate(), 4)
+    got = alloc.allocate(p.enumerate(), 2)
+    assert all(c.healthy for c in got)
+
+
+def test_insufficient_chips():
+    p, avail = chips_from_fixture("2x2x1")
+    alloc = IciAllocator(p.topology())
+    with pytest.raises(AllocationError):
+        alloc.allocate(avail, 5)
+
+
+def test_coordless_chips_first_n():
+    chips = FakeProvider(
+        {"model": "TPU-v5e", "topology": "1x1x1",
+         "chips": [{"uuid": f"c{i}", "coords": None} for i in range(4)]}
+    ).enumerate()
+    alloc = IciAllocator(Topology((1, 1, 1)))
+    got = alloc.allocate(chips, 2)
+    assert [c.uuid for c in got] == ["c0", "c1"]
+
+
+# -- fake provider --------------------------------------------------------
+
+
+def test_fake_provider_synthesizes_chips():
+    p = FakeProvider({"model": "TPU-v5e", "topology": "2x4x1", "hbm_mb": 16384})
+    chips = p.enumerate()
+    assert len(chips) == 8
+    assert all(c.hbm_mb == 16384 for c in chips)
+    assert chips[0].coords == (0, 0, 0)
+
+
+def test_fake_provider_from_file(tmp_path):
+    import json
+
+    f = tmp_path / "fixture.json"
+    f.write_text(json.dumps({"model": "TPU-v4", "topology": "2x2x2"}))
+    p = FakeProvider(str(f))
+    assert len(p.enumerate()) == 8
+    assert p.topology().dims == (2, 2, 2)

@@ -213,7 +213,6 @@ def test_try_success_waits_for_other_family():
 def test_node_lock_race_is_exclusive():
     """Two takers racing on the same observed state: exactly one wins
     (optimistic concurrency via resourceVersion, ref nodelock.go:60-61)."""
-    import vtpu.utils.nodelock as nl
     from vtpu.k8s.errors import Conflict
 
     c = FakeClient()
@@ -223,7 +222,6 @@ def test_node_lock_race_is_exclusive():
     c.patch_node_annotations("n1", {annotations.NODE_LOCK: "x"}, resource_version=rv)
     with pytest.raises(Conflict):
         c.patch_node_annotations("n1", {annotations.NODE_LOCK: "y"}, resource_version=rv)
-    assert nl  # imported for symmetry
 
 
 def test_node_lock_stale_break_on_last_retry_acquires():
@@ -244,11 +242,9 @@ def test_release_respects_fresh_holder():
 
 
 def test_negative_coords_roundtrip():
-    chips = [
-        __import__("vtpu.utils.types", fromlist=["ChipInfo"]).ChipInfo(
-            "u", 1, 1024, 100, "TPU-v5e", True, (-1, 0, 2)
-        )
-    ]
+    from vtpu.utils.types import ChipInfo
+
+    chips = [ChipInfo("u", 1, 1024, 100, "TPU-v5e", True, (-1, 0, 2))]
     assert codec.decode_node_devices(codec.encode_node_devices(chips))[0].coords == (-1, 0, 2)
 
 
@@ -259,3 +255,32 @@ def test_quantity_decimal_vs_binary():
     gi = resource_reqs(pod_gi)[0][0].memreq
     assert gi == 16384
     assert g == int(16 * 1000**3 / 1024**2)  # 15258 MiB — decimal ≠ binary
+
+
+def test_quantity_large_and_milli_suffixes():
+    for q, want in (("1Ti", 1024 * 1024), ("1T", int(1000**4 / 1024**2)), ("2000m", 2)):
+        p = new_pod("q", containers=[{"name": "c", "resources": {"limits": {resources.chip: 1, resources.memory: q}}}])
+        assert resource_reqs(p)[0][0].memreq == want, q
+
+
+def test_mixed_family_container_matched_by_first_device():
+    """A container whose assignment mixes device families must still be
+    claimed by the plugin owning its FIRST entry (ref util.go:174-191)."""
+    c = FakeClient()
+    c.create_node(new_node("n1"))
+    devs = [[ContainerDevice("chip-0", "TPU", 1024, 0), ContainerDevice("x-0", "XPU", 512, 0)]]
+    pod = new_pod(
+        "mix",
+        annotations={
+            annotations.ASSIGNED_NODE: "n1",
+            annotations.BIND_PHASE: BindPhase.ALLOCATING,
+            annotations.DEVICES_TO_ALLOCATE: codec.encode_pod_devices(devs),
+        },
+        node_name="n1",
+    )
+    c.create_pod(pod)
+    pending = get_pending_pod(c, "n1")
+    got = get_next_device_request("TPU", pending)
+    assert [d.uuid for d in got] == ["chip-0", "x-0"]
+    erase_next_device_type_from_annotation(c, "TPU", pending)
+    assert get_annotations(c.get_pod("default", "mix"))[annotations.DEVICES_TO_ALLOCATE] == ""

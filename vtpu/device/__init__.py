@@ -1,0 +1,13 @@
+"""Device abstraction layer (ref: pkg/device-plugin/mlu/cndev + NVML usage).
+
+Everything above this layer (plugin, scheduler, monitor) talks to a
+`DeviceProvider`; hardware-free tests use `FakeProvider` driven by a JSON
+fixture — the reference's mock-libcndev trick (mock/cndev.c:22-39,
+SURVEY.md §4) done in-process.  `vtpu.device.topology` replaces the
+reference's `cntopo` ring-enumeration binary with a *static* ICI torus model
+(SURVEY.md §2.5: TPU slice topologies are computable in pure code).
+"""
+
+from vtpu.device.chip import Chip, DeviceProvider  # noqa: F401
+from vtpu.device.fake import FakeProvider  # noqa: F401
+from vtpu.device.topology import Topology  # noqa: F401

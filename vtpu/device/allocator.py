@@ -1,0 +1,156 @@
+"""Topology-aware gang allocator — TPU analog of the MLU allocators.
+
+Ref: pkg/device-plugin/mlu/allocator/{spider,board,default}.go — candidate
+device sets ranked by interconnect ring count with policy gates.  On TPU the
+ranking input is the static torus model (vtpu.device.topology) instead of the
+cntopo binary:
+
+- policy "guaranteed":  the gang MUST land on one ICI-contiguous rectangle;
+  otherwise allocation fails (ref policy gate spider.go:84-90).
+- policy "restricted":  a rectangle is required for sizes that can ring
+  (even sizes ≥ 2); odd remainders may fall back to a connected set.
+- policy "best-effort": prefer rectangles, fall back to maximally-connected
+  arbitrary sets, never fail while enough chips exist (default.go:41-64).
+
+Scoring among candidate rectangles (spider.go:42-136 ranks by
+NonConflictRingNum then compactness analogues):
+  1. ring_count(shape)   — more independent ICI rings = faster collectives
+  2. compactness(shape)  — lower hop diameter
+  3. fragmentation       — leave the remaining free space as rectangular as
+                           possible (fewest stranded chips)
+  4. lowest offset       — determinism
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from vtpu.device.chip import Chip
+from vtpu.device.topology import (
+    Coord,
+    Topology,
+    compactness,
+    enumerate_rectangles,
+    ring_count,
+)
+
+log = logging.getLogger(__name__)
+
+POLICY_BEST_EFFORT = "best-effort"
+POLICY_RESTRICTED = "restricted"
+POLICY_GUARANTEED = "guaranteed"
+POLICIES = (POLICY_BEST_EFFORT, POLICY_RESTRICTED, POLICY_GUARANTEED)
+
+
+class AllocationError(Exception):
+    pass
+
+
+def _frag_score(topo: Topology, avail_after: FrozenSet[Coord]) -> int:
+    """How many of the remaining chips still belong to *some* full rectangle
+    of size ≥ 2 — stranded singletons hurt future gangs."""
+    if not avail_after:
+        return 0
+    coverable = set()
+    for size in (2, 4, 8):
+        if size > len(avail_after):
+            break
+        for _, _, coords in enumerate_rectangles(topo, size, avail_after):
+            coverable |= coords
+    return len(coverable)
+
+
+def _connected_greedy(
+    topo: Topology, available: List[Coord], size: int
+) -> Optional[List[Coord]]:
+    """Best-effort fallback: grow a connected set from each seed, pick the
+    one with the best adjacency density (ref default.go first-N fallback,
+    improved: the reference takes an arbitrary N, we keep ICI locality)."""
+    avail = set(available)
+    best: Optional[List[Coord]] = None
+    best_links = -1
+    for seed in sorted(avail):
+        grown = [seed]
+        frontier = set(topo.neighbors(seed)) & avail
+        while len(grown) < size and frontier:
+            # pick the frontier chip with most links into the grown set
+            nxt = max(
+                sorted(frontier),
+                key=lambda c: sum(1 for n in topo.neighbors(c) if n in grown),
+            )
+            grown.append(nxt)
+            frontier |= set(topo.neighbors(nxt)) & avail
+            frontier -= set(grown)
+        if len(grown) < size:
+            continue
+        links = sum(
+            1 for c in grown for n in topo.neighbors(c) if n in grown
+        )
+        if links > best_links:
+            best, best_links = grown, links
+    if best is None and len(avail) >= size:
+        best = sorted(avail)[:size]  # disconnected last resort
+    return best
+
+
+class IciAllocator:
+    """Chooses which free chips a multi-chip container gets
+    (ref: allocator.New dispatch, allocator.go:27-36)."""
+
+    def __init__(self, topo: Topology, policy: str = POLICY_BEST_EFFORT) -> None:
+        if policy not in POLICIES:
+            raise ValueError(f"unknown policy {policy!r}; want one of {POLICIES}")
+        self.topo = topo
+        self.policy = policy
+
+    def allocate(self, available: Sequence[Chip], size: int) -> List[Chip]:
+        """Pick ``size`` chips from ``available``.
+
+        Returns the chosen chips; raises AllocationError per policy gates.
+        """
+        if size <= 0:
+            return []
+        healthy = [c for c in available if c.healthy]
+        if len(healthy) < size:
+            raise AllocationError(f"need {size} chips, {len(healthy)} available")
+        by_coord: Dict[Coord, Chip] = {}
+        coordless: List[Chip] = []
+        for c in healthy:
+            if c.coords is not None:
+                by_coord[tuple(c.coords)] = c
+            else:
+                coordless.append(c)
+        if not by_coord:
+            # no topology info at all — plain first-N (single-chip hosts)
+            return sorted(coordless, key=lambda c: c.index)[:size]
+
+        avail_coords = frozenset(by_coord)
+        candidates: List[Tuple[tuple, FrozenSet[Coord]]] = []
+        for offset, shape, coords in enumerate_rectangles(self.topo, size, avail_coords):
+            remaining = avail_coords - coords
+            key = (
+                -ring_count(shape),
+                -compactness(shape),
+                -_frag_score(self.topo, remaining),
+                offset,
+            )
+            candidates.append((key, coords))
+        if candidates:
+            candidates.sort(key=lambda kc: kc[0])
+            chosen = candidates[0][1]
+            return [by_coord[c] for c in sorted(chosen)]
+
+        # no rectangle fits
+        ringable = size >= 2 and size % 2 == 0
+        if self.policy == POLICY_GUARANTEED or (
+            self.policy == POLICY_RESTRICTED and ringable
+        ):
+            raise AllocationError(
+                f"policy {self.policy}: no ICI-contiguous {size}-chip rectangle free"
+            )
+        grown = _connected_greedy(self.topo, sorted(avail_coords), size)
+        if grown is None:
+            raise AllocationError(f"cannot assemble {size} chips")
+        log.info("best-effort non-rectangular gang: %s", grown)
+        return [by_coord[c] for c in grown]

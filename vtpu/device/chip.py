@@ -1,0 +1,43 @@
+"""Chip model and the provider interface.
+
+Ref altitude: `cndev.Device{Slot,UUID,SN,MotherBoard,Path}` (bindings.go:39-208)
+and NVML device queries (nvidia.go:84-107).  A provider is what a node agent
+can ask about local silicon; it knows nothing about Kubernetes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Protocol, Tuple
+
+
+@dataclasses.dataclass
+class Chip:
+    """One physical TPU chip on this host."""
+
+    index: int                   # local ordinal (device plugin ID basis)
+    uuid: str                    # stable ID, e.g. "tpu-v5e-<host>-<i>"
+    model: str                   # e.g. "TPU-v5e" (ref "NVIDIA-<model>")
+    hbm_mb: int                  # physical HBM, MiB
+    cores: int = 100             # compute capacity in percent units
+    coords: Optional[Tuple[int, ...]] = None  # position in the local ICI mesh
+    devpath: Optional[str] = None             # e.g. "/dev/accel0"
+    healthy: bool = True
+
+
+class DeviceProvider(Protocol):
+    """What the plugin/monitor need from the device layer (ref:
+    ResourceManager interface nvidia.go:46-49)."""
+
+    def enumerate(self) -> List[Chip]:
+        """All local chips (healthy or not)."""
+        ...
+
+    def topology(self) -> "object":
+        """The local slice topology (vtpu.device.topology.Topology)."""
+        ...
+
+    def health_check(self) -> List[Chip]:
+        """Re-query health; returns the refreshed chip list (ref: CNDEV 1 Hz
+        health poll, cambricon.go:188-224 — recovers to Healthy)."""
+        ...

@@ -1,0 +1,85 @@
+"""JSON-fixture fake device provider.
+
+The reference's key testing trick (SURVEY.md §4): a mock `libcndev.so` that
+serves every device-layer answer from a JSON fixture via the MOCK_JSON env
+(mock/cndev.c:22-39), making all allocator/plugin suites hardware-free.
+Here the same trick needs no C: `FakeProvider` loads the fixture in-process
+(path via $VTPU_MOCK_JSON or a dict), and is the provider every test uses.
+
+Fixture shape::
+
+    {
+      "model": "TPU-v5e",
+      "topology": "2x2x1",           // or accelerator type "v5litepod-4"
+      "hbm_mb": 16384,               // default per chip
+      "chips": [                     // optional; synthesized from topology
+        {"uuid": "...", "hbm_mb": 16384, "coords": [0,0,0],
+         "devpath": "/dev/accel0", "healthy": true}
+      ]
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Union
+
+from vtpu.device.chip import Chip
+from vtpu.device.topology import Topology
+
+ENV_MOCK_JSON = "VTPU_MOCK_JSON"
+
+
+class FakeProvider:
+    def __init__(self, fixture: Optional[Union[str, dict]] = None) -> None:
+        if fixture is None:
+            fixture = os.environ.get(ENV_MOCK_JSON)
+            if not fixture:
+                raise RuntimeError(f"FakeProvider needs a fixture (or ${ENV_MOCK_JSON})")
+        if isinstance(fixture, str):
+            with open(fixture) as f:
+                data = json.load(f)
+        else:
+            data = dict(fixture)
+        self._model: str = data.get("model", "TPU-v5e")
+        self._topo = Topology.from_spec(data.get("topology", "1x1x1"))
+        default_hbm = int(data.get("hbm_mb", 16384))
+        chips_spec = data.get("chips")
+        if chips_spec is None:
+            chips_spec = [
+                {"coords": list(c), "healthy": True} for c in self._topo.coords()
+            ]
+        self._chips: List[Chip] = []
+        for i, cs in enumerate(chips_spec):
+            coords = tuple(cs["coords"]) if cs.get("coords") is not None else None
+            self._chips.append(
+                Chip(
+                    index=i,
+                    uuid=cs.get("uuid", f"fake-tpu-{i}"),
+                    model=cs.get("model", self._model),
+                    hbm_mb=int(cs.get("hbm_mb", default_hbm)),
+                    cores=100,
+                    coords=coords,
+                    devpath=cs.get("devpath", f"/dev/accel{i}"),
+                    healthy=bool(cs.get("healthy", True)),
+                )
+            )
+
+    # -- DeviceProvider ----------------------------------------------------
+    def enumerate(self) -> List[Chip]:
+        return list(self._chips)
+
+    def topology(self) -> Topology:
+        return self._topo
+
+    def health_check(self) -> List[Chip]:
+        return list(self._chips)
+
+    # -- test hooks --------------------------------------------------------
+    def set_health(self, uuid: str, healthy: bool) -> None:
+        for c in self._chips:
+            if c.uuid == uuid:
+                c.healthy = healthy
+                return
+        raise KeyError(uuid)

@@ -1,0 +1,181 @@
+"""Real TPU discovery via the TPU-VM environment and PJRT.
+
+Ref altitude: NVML enumeration (pkg/device-plugin/nvidiadevice/nvidia.go:84-107)
+and CNDEV bindings (cndev/bindings.go:39-208).  On a TPU VM the metadata is
+richer and cheaper than NVML: the accelerator type and per-host chip bounds
+come from environment/metadata, chip device nodes are /dev/accel*, and the
+authoritative fallback is a PJRT client (jax) which reports coords and HBM.
+
+Discovery order (cheapest first, all overridable):
+1. $VTPU_MOCK_JSON set            → the caller should use FakeProvider
+2. env: TPU_ACCELERATOR_TYPE / TPU_TOPOLOGY (+ /dev/accel* for paths)
+3. PJRT via jax (imports lazily; grabs the chip, so the plugin does this
+   once at startup, never while workloads run — unlike NVML, a PJRT client
+   holds the device)
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import socket
+from typing import List, Optional
+
+from vtpu.device.chip import Chip
+from vtpu.device.topology import KNOWN_SLICES, Topology
+
+log = logging.getLogger(__name__)
+
+ENV_ACCEL_TYPE = "TPU_ACCELERATOR_TYPE"
+ENV_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_WORKER_ID = "TPU_WORKER_ID"
+ENV_HBM_MB = "VTPU_HBM_MB_OVERRIDE"
+
+# HBM per chip (MiB) by generation — used when PJRT isn't consulted.
+HBM_MB_BY_MODEL = {
+    "TPU-v2": 8 * 1024,
+    "TPU-v3": 16 * 1024,
+    "TPU-v4": 32 * 1024,
+    "TPU-v5e": 16 * 1024,
+    "TPU-v5p": 95 * 1024,
+    "TPU-v6e": 32 * 1024,
+}
+
+
+def _model_from_accel_type(accel: str) -> str:
+    a = accel.lower()
+    if a.startswith("v5litepod") or a.startswith("v5e"):
+        return "TPU-v5e"
+    if a.startswith("v5p"):
+        return "TPU-v5p"
+    if a.startswith("v6e"):
+        return "TPU-v6e"
+    if a.startswith("v4"):
+        return "TPU-v4"
+    if a.startswith("v3"):
+        return "TPU-v3"
+    if a.startswith("v2"):
+        return "TPU-v2"
+    return f"TPU-{accel}"
+
+
+def _dev_paths() -> List[str]:
+    return sorted(glob.glob("/dev/accel*")) or sorted(glob.glob("/dev/vfio/*"))
+
+
+class LibtpuProvider:
+    """Enumerates the local host's chips.  ``use_pjrt=True`` queries jax for
+    authoritative coords/HBM (holds the chips briefly at startup)."""
+
+    def __init__(self, use_pjrt: bool = False, hostname: Optional[str] = None) -> None:
+        self._hostname = hostname or socket.gethostname()
+        self._use_pjrt = use_pjrt
+        self._chips: Optional[List[Chip]] = None
+        self._topo: Optional[Topology] = None
+
+    # -- internals ---------------------------------------------------------
+    def _discover_env(self) -> Optional[List[Chip]]:
+        accel = os.environ.get(ENV_ACCEL_TYPE, "")
+        topo_spec = os.environ.get(ENV_TOPOLOGY, "")
+        if not accel and not topo_spec:
+            return None
+        model = _model_from_accel_type(accel) if accel else "TPU-v5e"
+        spec = topo_spec or accel
+        try:
+            self._topo = Topology.from_spec(spec)
+        except (ValueError, KeyError):
+            if accel in KNOWN_SLICES:
+                self._topo = Topology(KNOWN_SLICES[accel])
+            else:
+                log.warning("unparseable topology %r; assuming 1 chip", spec)
+                self._topo = Topology((1, 1, 1))
+        hbm = int(os.environ.get(ENV_HBM_MB, HBM_MB_BY_MODEL.get(model, 16 * 1024)))
+        paths = _dev_paths()
+        chips = []
+        for i, coords in enumerate(self._topo.coords()):
+            chips.append(
+                Chip(
+                    index=i,
+                    uuid=f"{model}-{self._hostname}-{i}",
+                    model=model,
+                    hbm_mb=hbm,
+                    coords=coords,
+                    devpath=paths[i] if i < len(paths) else None,
+                )
+            )
+        return chips
+
+    def _discover_pjrt(self) -> Optional[List[Chip]]:
+        try:
+            import jax  # noqa: PLC0415 — deliberate lazy import
+
+            devices = jax.local_devices()
+        except Exception as e:  # noqa: BLE001 — no TPU / no jax is a normal miss
+            log.info("PJRT discovery unavailable: %s", e)
+            return None
+        chips = []
+        for i, d in enumerate(devices):
+            if d.platform not in ("tpu", "axon"):
+                continue
+            coords = tuple(getattr(d, "coords", ())) or None
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:  # noqa: BLE001 — not all platforms implement it
+                pass
+            hbm_bytes = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+            kind = getattr(d, "device_kind", "") or "TPU"
+            model = "TPU-" + kind.replace("TPU ", "").replace(" ", "").lower()
+            chips.append(
+                Chip(
+                    index=i,
+                    uuid=f"{model}-{self._hostname}-{i}",
+                    model=model,
+                    hbm_mb=int(hbm_bytes // (1024 * 1024)) if hbm_bytes else
+                    HBM_MB_BY_MODEL.get("TPU-v5e", 16 * 1024),
+                    coords=coords,
+                )
+            )
+        if not chips:
+            return None
+        if self._topo is None:
+            n = len(chips)
+            self._topo = Topology((n, 1, 1))
+        return chips
+
+    # -- DeviceProvider ----------------------------------------------------
+    def enumerate(self) -> List[Chip]:
+        if self._chips is None:
+            self._chips = self._discover_env() or (
+                self._discover_pjrt() if self._use_pjrt else None
+            ) or []
+        return list(self._chips)
+
+    def topology(self) -> Topology:
+        if self._topo is None:
+            self.enumerate()
+        return self._topo or Topology((max(len(self._chips or []), 1), 1, 1))
+
+    def health_check(self) -> List[Chip]:
+        """Device-node presence is the health probe (no XID-event analog on
+        TPU VMs; a wedged chip drops its /dev/accel node or PJRT init fails).
+        Chips recover when the node returns (CNDEV-style recovery,
+        cambricon.go:188-224, not NVIDIA's sticky-unhealthy)."""
+        chips = self.enumerate()
+        paths = set(_dev_paths())
+        if paths:
+            for c in chips:
+                if c.devpath:
+                    c.healthy = c.devpath in paths
+        return list(chips)
+
+
+def new_provider(use_pjrt: bool = False):
+    """Fixture-driven fake when $VTPU_MOCK_JSON is set, else real discovery
+    (the mock/real switch the reference buries in ld.so, SURVEY §2.5)."""
+    from vtpu.device.fake import ENV_MOCK_JSON, FakeProvider
+
+    if os.environ.get(ENV_MOCK_JSON):
+        return FakeProvider()
+    return LibtpuProvider(use_pjrt=use_pjrt)

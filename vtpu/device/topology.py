@@ -1,0 +1,212 @@
+"""Static ICI topology model — the `cntopo` replacement.
+
+The reference shells out to a vendor binary to enumerate MLULink rings
+(`cntopo find`, pkg/device-plugin/mlu/cntopo/cntopo.go:58-98) because MLU
+interconnects are board-specific.  TPU ICI is a regular 2D/3D torus fully
+determined by the slice shape, so ring/rectangle enumeration is pure
+arithmetic (SURVEY.md §2.5).  This module models:
+
+- slice geometry (dims, optional per-dim wraparound),
+- ICI adjacency,
+- enumeration of *contiguous axis-aligned sub-rectangles* — the TPU analog
+  of cntopo's "rings": a gang job placed on such a rectangle gets
+  ICI-only collectives (the property BASELINE.json config 5 exercises),
+- ring scores used by the allocator policies.
+
+A jax `Mesh` laid over a returned rectangle maps 1:1 onto ICI links, which
+is what makes psum/all-gather ride ICI instead of DCN.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Tuple
+
+Coord = Tuple[int, ...]
+
+# Known accelerator-type → chip-grid shapes (x, y, z).  v5e slices are 2D
+# (z == 1); v4/v5p are 3D.  Sizes are chips, not TensorCores.
+KNOWN_SLICES: Dict[str, Tuple[int, int, int]] = {
+    "v5litepod-1": (1, 1, 1),
+    "v5litepod-2": (2, 1, 1),
+    "v5litepod-4": (2, 2, 1),
+    "v5litepod-8": (2, 4, 1),
+    "v5litepod-16": (4, 4, 1),
+    "v5litepod-32": (4, 8, 1),
+    "v5litepod-64": (8, 8, 1),
+    "v5litepod-128": (8, 16, 1),
+    "v5litepod-256": (16, 16, 1),
+    "v4-8": (2, 2, 1),
+    "v4-16": (2, 2, 2),
+    "v4-32": (2, 2, 4),
+    "v5p-8": (2, 2, 1),
+    "v5p-16": (2, 2, 2),
+    "v5p-32": (2, 2, 4),
+    "v5p-64": (2, 4, 4),
+    "v5p-128": (4, 4, 4),
+}
+
+
+def parse_topology(spec: str) -> Tuple[int, int, int]:
+    """Parse "2x2x1" / "4x4" style topology strings (TPU_TOPOLOGY env shape)
+    or a known accelerator type like "v5litepod-8"."""
+    s = spec.strip().lower()
+    if s in KNOWN_SLICES:
+        return KNOWN_SLICES[s]
+    parts = [int(p) for p in s.split("x")]
+    if not parts or any(p < 1 for p in parts) or len(parts) > 3:
+        raise ValueError(f"bad topology spec: {spec!r}")
+    while len(parts) < 3:
+        parts.append(1)
+    return tuple(parts)  # type: ignore[return-value]
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """An ICI torus/mesh of the given chip-grid dims."""
+
+    dims: Tuple[int, int, int]
+    # wraparound links exist per dim on full-pod dims; sub-slices are meshes
+    wrap: Tuple[bool, bool, bool] = (False, False, False)
+
+    @classmethod
+    def from_spec(cls, spec: str, wrap: Optional[Sequence[bool]] = None) -> "Topology":
+        dims = parse_topology(spec)
+        if wrap is None:
+            # torus links when a dim is large enough that Google closes the
+            # loop (full-pod dims); conservative default: no wrap
+            wrap = (False, False, False)
+        return cls(dims, tuple(wrap))  # type: ignore[arg-type]
+
+    @property
+    def num_chips(self) -> int:
+        x, y, z = self.dims
+        return x * y * z
+
+    def coords(self) -> List[Coord]:
+        return [
+            (x, y, z)
+            for z in range(self.dims[2])
+            for y in range(self.dims[1])
+            for x in range(self.dims[0])
+        ]
+
+    def contains(self, c: Coord) -> bool:
+        return all(0 <= c[i] < self.dims[i] for i in range(3))
+
+    def neighbors(self, c: Coord) -> List[Coord]:
+        """ICI-adjacent chips (±1 per axis, wrapping on torus dims)."""
+        out: List[Coord] = []
+        for axis in range(3):
+            if self.dims[axis] == 1:
+                continue
+            for d in (-1, 1):
+                n = list(c)
+                n[axis] += d
+                if 0 <= n[axis] < self.dims[axis]:
+                    out.append(tuple(n))
+                elif self.wrap[axis] and self.dims[axis] > 2:
+                    n[axis] %= self.dims[axis]
+                    out.append(tuple(n))
+        return out
+
+    def is_connected(self, subset: Sequence[Coord]) -> bool:
+        """Whether ``subset`` is connected through ICI links only."""
+        if not subset:
+            return False
+        todo = {tuple(c) for c in subset}
+        stack = [next(iter(todo))]
+        seen = set()
+        while stack:
+            c = stack.pop()
+            if c in seen:
+                continue
+            seen.add(c)
+            for n in self.neighbors(c):
+                if n in todo and n not in seen:
+                    stack.append(n)
+        return seen == todo
+
+
+def box_shapes(size: int, dims: Tuple[int, int, int]) -> List[Tuple[int, int, int]]:
+    """All (a,b,c) with a*b*c == size fitting inside ``dims``."""
+    shapes = set()
+    for a in range(1, size + 1):
+        if size % a:
+            continue
+        for b in range(1, size // a + 1):
+            if (size // a) % b:
+                continue
+            c = size // a // b
+            if a <= dims[0] and b <= dims[1] and c <= dims[2]:
+                shapes.add((a, b, c))
+    return sorted(shapes)
+
+
+def enumerate_rectangles(
+    topo: Topology, size: int, available: Optional[FrozenSet[Coord]] = None
+) -> Iterator[Tuple[Coord, Tuple[int, int, int], FrozenSet[Coord]]]:
+    """Yield (offset, shape, coords) for every axis-aligned sub-box of
+    ``size`` chips whose coords are all in ``available`` (None = all).
+
+    This is the cntopo `find -R` analog: each rectangle is an ICI-contiguous
+    gang placement; every dim of even length additionally supports a
+    bidirectional ring embedding for all-reduce.
+    """
+    avail = available if available is not None else frozenset(topo.coords())
+    for shape in box_shapes(size, topo.dims):
+        for ox in range(topo.dims[0] - shape[0] + 1):
+            for oy in range(topo.dims[1] - shape[1] + 1):
+                for oz in range(topo.dims[2] - shape[2] + 1):
+                    coords = frozenset(
+                        (ox + dx, oy + dy, oz + dz)
+                        for dx in range(shape[0])
+                        for dy in range(shape[1])
+                        for dz in range(shape[2])
+                    )
+                    if coords <= avail:
+                        yield (ox, oy, oz), shape, coords
+
+
+def ring_count(shape: Tuple[int, int, int]) -> int:
+    """Number of independent ICI ring embeddings of a rectangle — the analog
+    of cntopo's NonConflictRingNum used by policy gates (spider.go:84-90).
+
+    A dim of even length ≥ 2 supports a snake/ring cycle through the box;
+    each such dim contributes one independent ring direction.  A single chip
+    has no ring; a 1×N line supports one ring only if wraparound existed, so
+    count it as 0 (DCN-free but not ring-optimal).
+    """
+    used = [d for d in shape if d > 1]
+    if not used:
+        return 0
+    if len(used) == 1:
+        return 1 if used[0] % 2 == 0 else 0
+    # any box with ≥2 non-trivial even dims embeds a Hamiltonian cycle per
+    # even dim pair (boustrophedon)
+    return sum(1 for d in used if d % 2 == 0)
+
+
+def compactness(shape: Tuple[int, int, int]) -> float:
+    """Higher is better: volume/surface ratio normalised to (0,1] — prefers
+    cubes over lines, which minimises ICI hop diameter for collectives."""
+    a, b, c = shape
+    vol = a * b * c
+    half_surface = a * b + b * c + a * c
+    cube = vol ** (2.0 / 3.0) * 3.0
+    return cube / half_surface if half_surface else 0.0
+
+
+def mesh_axes_for(shape: Tuple[int, int, int]) -> List[int]:
+    """Non-trivial dims of a rectangle, largest first — a jax Mesh over the
+    gang should use these as its hardware axes (e.g. shape (2,4,1) →
+    mesh (4,2): data axis on the longer ring)."""
+    return sorted([d for d in shape if d > 1], reverse=True)
+
+
+def full_pod_wrap(dims: Tuple[int, int, int]) -> Tuple[bool, bool, bool]:
+    """Torus wraparound heuristic: Google closes the loop on dims ≥ 16 for
+    v5e (full 16×16 pod rows) and on all dims of full v4/v5p cubes; used
+    when the platform reports a full pod slice."""
+    return tuple(d >= 16 for d in dims)  # type: ignore[return-value]

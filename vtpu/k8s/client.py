@@ -112,9 +112,19 @@ class Client:
             return self._request(
                 "PATCH", f"/api/v1/nodes/{name}", patch, "application/merge-patch+json"
             )
+        # Re-read to learn whether the annotations map exists at all — a
+        # never-annotated node has metadata.annotations == null and a
+        # json-patch `add` under the missing map would 422 forever.  The
+        # resourceVersion `test` op pins the exact state we read, so the
+        # bootstrap `add` of the map itself cannot race.
+        node = self.get_node(name)
+        if node["metadata"].get("resourceVersion") != resource_version:
+            raise Conflict(f"node {name}: resourceVersion changed since read")
         ops = [
             {"op": "test", "path": "/metadata/resourceVersion", "value": resource_version}
         ]
+        if node["metadata"].get("annotations") is None:
+            ops.append({"op": "add", "path": "/metadata/annotations", "value": {}})
         for k, v in annotations.items():
             path = "/metadata/annotations/" + k.replace("~", "~0").replace("/", "~1")
             if v is None:

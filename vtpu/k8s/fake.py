@@ -45,6 +45,7 @@ class FakeClient:
             name = node["metadata"]["name"]
             self._bump(node)
             self._nodes[name] = copy.deepcopy(node)
+            self._notify("Node", node)
             return copy.deepcopy(node)
 
     def get_node(self, name: str) -> dict:

@@ -1,0 +1,12 @@
+"""Scheduler extender (ref: pkg/scheduler, cmd/scheduler).
+
+A kube-scheduler *extender*: vanilla kube-scheduler calls out over HTTP for
+filter and bind decisions (charts/.../configmapnew.yaml pattern), and a
+mutating webhook steers vtpu pods to the right scheduler profile.  State is
+rebuilt at any time from the annotation bus — node annotations carry the
+device registry, pod annotations carry assignments ("annotations are the
+database", SURVEY.md §5 checkpoint/resume).
+"""
+
+from vtpu.scheduler.config import SchedulerConfig  # noqa: F401
+from vtpu.scheduler.core import Scheduler  # noqa: F401

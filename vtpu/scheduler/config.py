@@ -1,0 +1,23 @@
+"""Scheduler configuration (ref: pkg/scheduler/config/config.go:19-24 and
+cmd/scheduler/main.go:51-58 flags)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    http_bind: str = "0.0.0.0:9395"
+    scheduler_name: str = "vtpu-scheduler"
+    # defaults applied when a pod requests chips without mem/cores
+    # (ref: --default-mem, --default-cores)
+    default_mem: int = 0          # MiB; 0 ⇒ whole-chip percentage
+    default_cores: int = 0        # percent; 0 ⇒ shared, no core quota
+    # node scoring: "binpack" packs shares onto busy chips/nodes first
+    # (maximises whole-free chips for gangs); "spread" does the opposite.
+    # The reference hardcodes one formula (score.go:239-240); HAMi later
+    # made it a policy — we expose it from day one.
+    node_scheduler_policy: str = "binpack"
+    # ICI gang policy for multi-chip requests (ref --mlulink-policy)
+    ici_policy: str = "best-effort"

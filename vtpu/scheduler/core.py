@@ -1,0 +1,264 @@
+"""Scheduler core: registry handshake, usage aggregation, Filter and Bind.
+
+Ref: pkg/scheduler/scheduler.go.  The extender keeps no durable state —
+everything is reconstructed from the annotation bus (node registry
+annotations + pod assignment annotations), which is the crash-safety story
+(SURVEY.md §5 "annotations are the database").
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from vtpu.k8s.objects import get_annotations, pod_uid
+from vtpu.scheduler import score as score_mod
+from vtpu.scheduler.config import SchedulerConfig
+from vtpu.scheduler.score import DeviceUsage, NodeUsage
+from vtpu.scheduler.state import NodeManager, PodManager
+from vtpu.utils import codec
+from vtpu.utils.nodelock import lock_node, release_node_lock
+from vtpu.utils.resources import resource_reqs
+from vtpu.utils.types import (
+    BindPhase,
+    HANDSHAKE_TIMEOUT_S,
+    HandshakeState,
+    KNOWN_DEVICES,
+    REGISTRY_POLL_INTERVAL_S,
+    annotations,
+)
+
+log = logging.getLogger(__name__)
+
+
+def _now_ts() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def _parse_ts(s: str) -> Optional[datetime.datetime]:
+    try:
+        return datetime.datetime.strptime(s, "%Y-%m-%dT%H:%M:%SZ").replace(
+            tzinfo=datetime.timezone.utc
+        )
+    except ValueError:
+        return None
+
+
+class FilterResult:
+    """Mirror of extenderv1.ExtenderFilterResult."""
+
+    def __init__(
+        self,
+        node: Optional[str] = None,
+        failed: Optional[Dict[str, str]] = None,
+        error: str = "",
+    ) -> None:
+        self.node = node
+        self.failed = failed or {}
+        self.error = error
+
+
+class Scheduler:
+    def __init__(self, client, config: Optional[SchedulerConfig] = None) -> None:
+        self.client = client
+        self.config = config or SchedulerConfig()
+        self.nodes = NodeManager()
+        self.pods = PodManager()
+        self._stop = threading.Event()
+        # cached usage snapshot for metrics (ref cachedstatus)
+        self._cached_usage: Dict[str, NodeUsage] = {}
+        self._cache_lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # Registry: node annotations → device state (ref scheduler.go:143-229)
+    # ------------------------------------------------------------------
+    def register_from_node_annotations(self) -> None:
+        for node in self.client.list_nodes():
+            name = node["metadata"]["name"]
+            annos = node.get("metadata", {}).get("annotations") or {}
+            for handshake_anno, register_anno in KNOWN_DEVICES.items():
+                hs = annos.get(handshake_anno)
+                if hs is None:
+                    continue
+                if hs.startswith(HandshakeState.REPORTED):
+                    enc = annos.get(register_anno, "")
+                    try:
+                        devices = codec.decode_node_devices(enc)
+                    except ValueError:
+                        log.warning("node %s: bad register annotation", name)
+                        continue
+                    topology = annos.get(annotations.NODE_TOPOLOGY, "")
+                    self.nodes.add_node(name, devices, topology)
+                    self.client.patch_node_annotations(
+                        name,
+                        {handshake_anno: f"{HandshakeState.REQUESTING}_{_now_ts()}"},
+                    )
+                elif hs.startswith(HandshakeState.REQUESTING):
+                    ts = _parse_ts(hs.split("_", 1)[-1])
+                    now = datetime.datetime.now(datetime.timezone.utc)
+                    if ts is None or (now - ts).total_seconds() > HANDSHAKE_TIMEOUT_S:
+                        # plugin stopped re-reporting → expel devices
+                        log.warning("node %s: handshake timeout; expelling devices", name)
+                        self.nodes.rm_node_devices(name)
+                        self.client.patch_node_annotations(
+                            name,
+                            {handshake_anno: f"{HandshakeState.DELETED}_{_now_ts()}"},
+                        )
+                elif hs.startswith(HandshakeState.DELETED):
+                    continue
+
+    def ingest_pods(self) -> None:
+        """Informer-lite: rebuild pod assignment state (ref onAddPod/onDelPod
+        scheduler.go:75-113)."""
+        seen = set()
+        for pod in self.client.list_pods():
+            seen.add(pod_uid(pod))
+            self.pods.ingest(pod)
+        for uid in list(self.pods.all_pods()):
+            if uid not in seen:
+                self.pods.rm_pod(uid)
+
+    def run_background_loops(self) -> None:
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.register_from_node_annotations()
+                    self.ingest_pods()
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    log.exception("registry loop error")
+                self._stop.wait(REGISTRY_POLL_INTERVAL_S)
+
+        threading.Thread(target=loop, name="vtpu-registry", daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # ------------------------------------------------------------------
+    # Usage aggregation (ref getNodesUsage scheduler.go:348-400)
+    # ------------------------------------------------------------------
+    def nodes_usage(self) -> Dict[str, NodeUsage]:
+        usage: Dict[str, NodeUsage] = {}
+        for name, info in self.nodes.all_nodes().items():
+            usage[name] = NodeUsage(
+                node=name,
+                devices=[DeviceUsage.from_chip_info(ci) for ci in info.devices],
+                topology=info.topology,
+            )
+        for pi in self.pods.all_pods().values():
+            nu = usage.get(pi.node)
+            if nu is None:
+                continue
+            by_uuid = {d.uuid: d for d in nu.devices}
+            for ctr in pi.devices:
+                for cd in ctr:
+                    d = by_uuid.get(cd.uuid)
+                    if d is None:
+                        continue
+                    d.used += 1
+                    d.usedmem += cd.usedmem
+                    d.usedcores += cd.usedcores
+        with self._cache_lock:
+            self._cached_usage = usage
+        return usage
+
+    def inspect_usage(self) -> Dict[str, NodeUsage]:
+        """Last snapshot for metrics (ref InspectAllNodesUsage)."""
+        with self._cache_lock:
+            if not self._cached_usage:
+                pass
+        return self.nodes_usage()
+
+    # ------------------------------------------------------------------
+    # Filter (ref Filter scheduler.go:444-492 + calcScore walk)
+    # ------------------------------------------------------------------
+    def filter(self, pod: dict, node_names: List[str]) -> FilterResult:
+        reqs = resource_reqs(
+            pod, self.config.default_mem, self.config.default_cores
+        )
+        total = sum(r.nums for ctr in reqs for r in ctr)
+        if total == 0:
+            # not a vtpu pod — pass through unfiltered (ref :453-460)
+            return FilterResult(node=None, failed={}, error="")
+        pod_annos = get_annotations(pod)
+        usage = self.nodes_usage()
+        ici_policy = pod_annos.get("vtpu.io/ici-policy", self.config.ici_policy)
+        best: Optional[Tuple[float, str, object]] = None
+        failed: Dict[str, str] = {}
+        for name in node_names:
+            nu = usage.get(name)
+            if nu is None:
+                failed[name] = "no vtpu devices registered"
+                continue
+            snap = score_mod.snapshot(name, nu.devices, nu.topology)
+            placement = score_mod.fit_pod(
+                snap, reqs, pod_annos, self.config.node_scheduler_policy, ici_policy
+            )
+            if placement is None:
+                failed[name] = "insufficient vtpu resources"
+                continue
+            s = score_mod.score_node(snap, self.config.node_scheduler_policy)
+            if best is None or s > best[0]:
+                best = (s, name, placement)
+        if best is None:
+            return FilterResult(None, failed, "no node fits vtpu request")
+        s, chosen, placement = best
+        enc = codec.encode_pod_devices(placement)  # type: ignore[arg-type]
+        self.client.patch_pod_annotations(
+            pod["metadata"].get("namespace", "default"),
+            pod["metadata"]["name"],
+            {
+                annotations.ASSIGNED_NODE: chosen,
+                annotations.ASSIGNED_TIME: _now_ts(),
+                annotations.ASSIGNED_IDS: enc,
+                annotations.DEVICES_TO_ALLOCATE: enc,
+            },
+        )
+        # pessimistic booking so concurrent filters see the usage
+        # (ref score.go writes assignment then books usage)
+        fresh = dict(pod)
+        fresh_annos = dict(get_annotations(pod))
+        fresh_annos[annotations.ASSIGNED_IDS] = enc
+        fresh_annos[annotations.ASSIGNED_NODE] = chosen
+        fresh["metadata"] = dict(pod["metadata"], annotations=fresh_annos)
+        self.pods.add_pod(fresh, chosen, placement)  # type: ignore[arg-type]
+        log.info(
+            "filter: pod %s → node %s (score %.3f)", pod["metadata"]["name"], chosen, s
+        )
+        return FilterResult(node=chosen, failed=failed, error="")
+
+    # ------------------------------------------------------------------
+    # Bind (ref Bind scheduler.go:402-442)
+    # ------------------------------------------------------------------
+    def bind(self, namespace: str, name: str, node: str) -> Optional[str]:
+        """Returns error string or None on success."""
+        try:
+            lock_node(self.client, node)
+        except Exception as e:  # noqa: BLE001
+            return f"node lock: {e}"
+        try:
+            self.client.patch_pod_annotations(
+                namespace,
+                name,
+                {
+                    annotations.BIND_PHASE: BindPhase.ALLOCATING,
+                    annotations.BIND_TIME: str(int(time.time())),
+                },
+            )
+            self.client.bind_pod(namespace, name, node)
+        except Exception as e:  # noqa: BLE001
+            log.exception("bind failed for %s/%s", namespace, name)
+            try:
+                self.client.patch_pod_annotations(
+                    namespace, name, {annotations.BIND_PHASE: BindPhase.FAILED}
+                )
+            except Exception:  # noqa: BLE001 — pod may be gone; lock still must go
+                log.warning("could not mark bind-phase=failed on %s/%s", namespace, name)
+            try:
+                release_node_lock(self.client, node)
+            except Exception:  # noqa: BLE001
+                log.exception("failed to release node lock on %s", node)
+            return f"bind: {e}"
+        return None

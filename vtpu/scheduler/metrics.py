@@ -1,0 +1,96 @@
+"""Scheduler Prometheus metrics (ref: cmd/scheduler/metrics.go:73-249).
+
+Text exposition format written by hand — the gauge families mirror the
+reference's: per-device limit/allocated/share-count, node overview, and
+per-pod allocations.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from vtpu.scheduler.core import Scheduler
+
+_MB = 1024 * 1024
+
+
+def _esc(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_metrics(sched: Scheduler) -> str:
+    """Render the full exposition (ref Collect metrics.go:73-204)."""
+    lines: List[str] = []
+
+    def gauge(name: str, help_: str, samples: List[tuple]) -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        for labels, value in samples:
+            lbl = ",".join(f'{k}="{_esc(str(v))}"' for k, v in labels.items())
+            lines.append(f"{name}{{{lbl}}} {value}")
+
+    usage = sched.inspect_usage()
+
+    dev_limit, dev_alloc, dev_shared, dev_cores, node_mem_pct = [], [], [], [], []
+    for name, nu in sorted(usage.items()):
+        total, used = 0, 0
+        for d in nu.devices:
+            labels = {"node": name, "deviceuuid": d.uuid, "devicetype": d.type}
+            dev_limit.append((labels, d.totalmem * _MB))
+            dev_alloc.append((labels, d.usedmem * _MB))
+            dev_shared.append((labels, d.used))
+            dev_cores.append((labels, d.usedcores))
+            total += d.totalmem
+            used += d.usedmem
+        node_mem_pct.append(({"node": name}, (used / total) if total else 0.0))
+
+    gauge(
+        "vtpu_device_memory_limit_bytes",
+        "Total HBM per registered chip (ref GPUDeviceMemoryLimit)",
+        dev_limit,
+    )
+    gauge(
+        "vtpu_device_memory_allocated_bytes",
+        "Scheduler-allocated HBM per chip (ref GPUDeviceMemoryAllocated)",
+        dev_alloc,
+    )
+    gauge(
+        "vtpu_device_shared_num",
+        "Number of pod shares on each chip (ref GPUDeviceSharedNum)",
+        dev_shared,
+    )
+    gauge(
+        "vtpu_device_core_allocated",
+        "Allocated core percentage per chip (ref GPUDeviceCoreAllocated)",
+        dev_cores,
+    )
+    gauge(
+        "vtpu_node_memory_percentage",
+        "Allocated fraction of node HBM (ref nodeGPUMemoryPercentage)",
+        node_mem_pct,
+    )
+
+    pod_mem, pod_cores = [], []
+    for pi in sched.pods.all_pods().values():
+        for ci, ctr in enumerate(pi.devices):
+            for cd in ctr:
+                labels = {
+                    "podnamespace": pi.namespace,
+                    "podname": pi.name,
+                    "nodename": pi.node,
+                    "containeridx": ci,
+                    "deviceuuid": cd.uuid,
+                }
+                pod_mem.append((labels, cd.usedmem * _MB))
+                pod_cores.append((labels, cd.usedcores))
+    gauge(
+        "vtpu_pod_memory_allocated_bytes",
+        "Per-pod per-device scheduled HBM (ref vGPUPodsDeviceAllocated)",
+        pod_mem,
+    )
+    gauge(
+        "vtpu_pod_core_percentage",
+        "Per-pod per-device scheduled core share (ref vGPUCorePercentage)",
+        pod_cores,
+    )
+    return "\n".join(lines) + "\n"

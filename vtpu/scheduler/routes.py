@@ -1,0 +1,122 @@
+"""HTTP routes for the scheduler extender.
+
+Ref: pkg/scheduler/routes/route.go:41-134 — the kube-scheduler extender v1
+wire contract:
+
+  POST /filter   ExtenderArgs{Pod, NodeNames}        → ExtenderFilterResult
+  POST /bind     ExtenderBindingArgs{...}            → ExtenderBindingResult
+  POST /webhook  AdmissionReview                     → AdmissionReview
+  GET  /metrics  Prometheus text (ref cmd/scheduler/metrics.go)
+  GET  /healthz
+
+Served by a stdlib ThreadingHTTPServer; the extender is pure
+request/response over in-memory state, so no framework is needed.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+
+from vtpu.scheduler.core import Scheduler
+from vtpu.scheduler.metrics import render_metrics
+from vtpu.scheduler.webhook import handle_admission_review
+
+log = logging.getLogger(__name__)
+
+
+def filter_handler(sched: Scheduler, args: dict) -> dict:
+    pod = args.get("Pod") or {}
+    node_names = args.get("NodeNames")
+    if node_names is None:
+        # nodeCacheCapable=false senders put full Node objects in Nodes.Items
+        node_names = [
+            n["metadata"]["name"] for n in (args.get("Nodes") or {}).get("Items", [])
+        ]
+    res = sched.filter(pod, list(node_names))
+    if res.error:
+        return {"NodeNames": [], "FailedNodes": res.failed, "Error": res.error}
+    if res.node is None:
+        # non-vtpu pod: pass all nodes through (ref scheduler.go:453-460)
+        return {"NodeNames": node_names, "FailedNodes": {}, "Error": ""}
+    return {"NodeNames": [res.node], "FailedNodes": res.failed, "Error": ""}
+
+
+def bind_handler(sched: Scheduler, args: dict) -> dict:
+    err = sched.bind(
+        args.get("PodNamespace", "default"),
+        args.get("PodName", ""),
+        args.get("Node", ""),
+    )
+    return {"Error": err or ""}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    scheduler: Scheduler  # injected via serve()
+
+    def _send(self, code: int, body: bytes, ctype: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> Optional[dict]:
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            return None
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server API
+        if self.path == "/healthz":
+            self._send(200, b"ok", "text/plain")
+        elif self.path == "/metrics":
+            try:
+                body = render_metrics(self.scheduler).encode()
+                self._send(200, body, "text/plain; version=0.0.4")
+            except Exception as e:  # noqa: BLE001
+                log.exception("metrics render failed")
+                self._send(500, str(e).encode(), "text/plain")
+        else:
+            self._send(404, b"not found", "text/plain")
+
+    def do_POST(self) -> None:  # noqa: N802
+        body = self._read_json()
+        if body is None:
+            self._send(400, b'{"Error": "bad json"}')
+            return
+        try:
+            if self.path == "/filter":
+                out = filter_handler(self.scheduler, body)
+            elif self.path == "/bind":
+                out = bind_handler(self.scheduler, body)
+            elif self.path == "/webhook":
+                out = handle_admission_review(body, self.scheduler.config)
+            else:
+                self._send(404, b"not found", "text/plain")
+                return
+        except Exception as e:  # noqa: BLE001 — extender errors must be JSON
+            log.exception("handler error on %s", self.path)
+            out = {"Error": f"internal: {e}"}
+        self._send(200, json.dumps(out).encode())
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet http.server
+        log.debug("http: " + fmt, *args)
+
+
+def serve(
+    sched: Scheduler, bind: Optional[str] = None
+) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """Start the HTTP server in a daemon thread; returns (server, thread).
+    TLS (needed for the webhook in-cluster) is terminated by the chart's
+    sidecar/secret mount in deployment; plain HTTP here."""
+    host, _, port = (bind or sched.config.http_bind).rpartition(":")
+    handler = type("BoundHandler", (_Handler,), {"scheduler": sched})
+    srv = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler)
+    t = threading.Thread(target=srv.serve_forever, name="vtpu-http", daemon=True)
+    t.start()
+    return srv, t

@@ -1,0 +1,218 @@
+"""Per-node fit simulation and scoring — the scheduler's most bug-prone
+logic, fully table-tested here unlike the reference (SURVEY.md §4 calls out
+score.go:156-250 as untested).
+
+Ref semantics preserved (pkg/scheduler/score.go):
+- a chip share consumes one split slot, ``memreq`` MiB and ``coresreq`` %
+- coresreq == 100 ⇒ exclusive: only an entirely-free chip fits (:203-209)
+- a chip with an exclusive occupant (usedcores == 100) blocks everything,
+  including coresreq == 0 requests (:203-209)
+- chip-type selectors: USE_TPUTYPE / NOUSE_TPUTYPE pod annotations,
+  comma-separated substring match (:67-99, :135-154)
+- multi-chip requests get ``nums`` distinct chips (:188-231); TPU extension:
+  the set is chosen ICI-contiguously via IciAllocator when coords are known.
+
+Scoring diverges deliberately: the reference's single formula
+(free/total + (dn − sums), :239-240) is replaced by an explicit policy —
+"binpack" (default) fills already-shared chips/nodes first, keeping whole
+chips free for gangs; "spread" maximises headroom per share.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from vtpu.device.allocator import AllocationError, IciAllocator
+from vtpu.device.chip import Chip
+from vtpu.device.topology import Topology
+from vtpu.utils.types import (
+    ChipInfo,
+    ContainerDevice,
+    ContainerDeviceRequest,
+    MEM_PERCENTAGE_UNSET,
+    PodDevices,
+    annotations,
+)
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class DeviceUsage:
+    """Free/used view of one chip (ref: NodeUsage.DeviceUsage,
+    scheduler.go:348-400)."""
+
+    uuid: str
+    type: str
+    health: bool
+    count: int          # split slots total
+    used: int           # split slots taken
+    totalmem: int       # MiB
+    usedmem: int
+    totalcores: int     # percent units (100)
+    usedcores: int
+    coords: Optional[tuple] = None
+
+    @classmethod
+    def from_chip_info(cls, ci: ChipInfo) -> "DeviceUsage":
+        return cls(
+            uuid=ci.uuid,
+            type=ci.type,
+            health=ci.health,
+            count=ci.count,
+            used=0,
+            totalmem=ci.hbm_mb,
+            usedmem=0,
+            totalcores=ci.cores,
+            usedcores=0,
+            coords=ci.coords,
+        )
+
+
+@dataclasses.dataclass
+class NodeUsage:
+    node: str
+    devices: List[DeviceUsage]
+    topology: str = ""
+
+
+def check_type(pod_annos: Dict[str, str], dev: DeviceUsage, req: ContainerDeviceRequest) -> bool:
+    """Vendor prefix + use/nouse selector annotations (ref checkType
+    score.go:135-154, checkGPUtype :67-99)."""
+    if not dev.type.upper().startswith(req.type.upper()):
+        return False
+    use = pod_annos.get(annotations.USE_TPUTYPE, "")
+    if use:
+        wanted = [w.strip() for w in use.split(",") if w.strip()]
+        if wanted and not any(w.lower() in dev.type.lower() for w in wanted):
+            return False
+    nouse = pod_annos.get(annotations.NOUSE_TPUTYPE, "")
+    if nouse:
+        banned = [w.strip() for w in nouse.split(",") if w.strip()]
+        if any(b.lower() in dev.type.lower() for b in banned):
+            return False
+    return True
+
+
+def _mem_for(dev: DeviceUsage, req: ContainerDeviceRequest) -> int:
+    """Resolve MiB for this request on this chip (percentage requests scale
+    with the chip's HBM, ref score.go memreq-from-percentage)."""
+    if req.memreq > 0:
+        return req.memreq
+    pct = req.mem_percentage
+    if pct == MEM_PERCENTAGE_UNSET:
+        pct = 100
+    return dev.totalmem * pct // 100
+
+
+def fits_device(
+    dev: DeviceUsage, req: ContainerDeviceRequest, pod_annos: Dict[str, str]
+) -> bool:
+    """One chip share fit check (ref score.go:188-231)."""
+    if not dev.health:
+        return False
+    if not check_type(pod_annos, dev, req):
+        return False
+    if dev.used >= dev.count:
+        return False
+    if dev.usedcores >= 100:
+        return False  # exclusive occupant blocks all comers (:203-209)
+    if req.coresreq >= 100 and (dev.used > 0 or dev.usedcores > 0 or dev.usedmem > 0):
+        return False  # exclusive request needs a virgin chip
+    if dev.totalmem - dev.usedmem < _mem_for(dev, req):
+        return False
+    if dev.totalcores - dev.usedcores < req.coresreq:
+        return False
+    return True
+
+
+def _book(dev: DeviceUsage, req: ContainerDeviceRequest) -> ContainerDevice:
+    mem = _mem_for(dev, req)
+    dev.used += 1
+    dev.usedmem += mem
+    dev.usedcores += req.coresreq
+    return ContainerDevice(uuid=dev.uuid, type="TPU", usedmem=mem, usedcores=req.coresreq)
+
+
+def _select_devices(
+    node: NodeUsage,
+    req: ContainerDeviceRequest,
+    pod_annos: Dict[str, str],
+    policy: str,
+    ici_policy: str,
+) -> Optional[List[DeviceUsage]]:
+    """Pick ``req.nums`` chips on this node, or None if impossible."""
+    fitting = [d for d in node.devices if fits_device(d, req, pod_annos)]
+    if len(fitting) < req.nums:
+        return None
+    if req.nums == 1:
+        # binpack: most-loaded chip first (keeps whole chips free);
+        # spread: least-loaded first.  Ties broken by uuid for determinism.
+        keyfn = lambda d: (d.usedmem / max(d.totalmem, 1), d.used, d.uuid)  # noqa: E731
+        fitting.sort(key=keyfn, reverse=(policy == "binpack"))
+        if policy == "binpack":
+            # reverse=True flips the uuid tiebreak too; re-sort equals by uuid
+            fitting.sort(key=lambda d: d.uuid)
+            fitting.sort(key=lambda d: (d.usedmem / max(d.totalmem, 1), d.used), reverse=True)
+        return [fitting[0]]
+    # gang: ICI-aware choice over the fitting set (TPU extension; the MLU
+    # analog is GetPreferredAllocation + allocators, SURVEY §2.9)
+    have_coords = all(d.coords is not None for d in fitting) and node.topology
+    if have_coords:
+        topo = Topology.from_spec(node.topology)
+        chips = [
+            Chip(index=i, uuid=d.uuid, model=d.type, hbm_mb=d.totalmem, coords=d.coords)
+            for i, d in enumerate(fitting)
+        ]
+        try:
+            chosen = IciAllocator(topo, ici_policy).allocate(chips, req.nums)
+        except AllocationError as e:
+            log.debug("node %s: ICI allocation failed: %s", node.node, e)
+            return None
+        by_uuid = {d.uuid: d for d in fitting}
+        return [by_uuid[c.uuid] for c in chosen]
+    return fitting[: req.nums]
+
+
+def fit_pod(
+    node: NodeUsage,
+    requests: List[List[ContainerDeviceRequest]],
+    pod_annos: Dict[str, str],
+    policy: str = "binpack",
+    ici_policy: str = "best-effort",
+) -> Optional[PodDevices]:
+    """Simulate placing every container of the pod on this node, booking
+    usage as it goes (ref calcScore's container walk, score.go:156-250).
+    Mutates ``node`` (callers pass a snapshot copy).  Returns per-container
+    assignments or None."""
+    result: PodDevices = []
+    for ctr_reqs in requests:
+        ctr_devs: List[ContainerDevice] = []
+        for req in ctr_reqs:
+            chosen = _select_devices(node, req, pod_annos, policy, ici_policy)
+            if chosen is None:
+                return None
+            for dev in chosen:
+                ctr_devs.append(_book(dev, req))
+        result.append(ctr_devs)
+    return result
+
+
+def score_node(node: NodeUsage, policy: str = "binpack") -> float:
+    """Node desirability AFTER booking (higher wins).  binpack: most-utilised
+    node; spread: most-free node."""
+    if not node.devices:
+        return 0.0
+    util = sum(
+        (d.usedmem / max(d.totalmem, 1)) + (d.usedcores / max(d.totalcores, 1))
+        for d in node.devices
+    ) / (2 * len(node.devices))
+    return util if policy == "binpack" else 1.0 - util
+
+
+def snapshot(node_name: str, devices: List[DeviceUsage], topology: str) -> NodeUsage:
+    return NodeUsage(
+        node=node_name, devices=[dataclasses.replace(d) for d in devices], topology=topology
+    )

@@ -1,0 +1,99 @@
+"""Node and pod state managers (ref: pkg/scheduler/nodes.go, pods.go —
+mutex-guarded maps rebuilt from the annotation bus)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional
+
+from vtpu.k8s.objects import get_annotations, pod_uid
+from vtpu.utils import codec
+from vtpu.utils.types import ChipInfo, PodDevices, annotations
+
+
+@dataclasses.dataclass
+class NodeInfo:
+    name: str
+    devices: List[ChipInfo]
+    topology: str = ""          # e.g. "4x4x1" from NODE_TOPOLOGY annotation
+
+
+@dataclasses.dataclass
+class PodInfo:
+    namespace: str
+    name: str
+    uid: str
+    node: str
+    devices: PodDevices
+
+
+class NodeManager:
+    """ref: nodes.go:59-121."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._nodes: Dict[str, NodeInfo] = {}
+
+    def add_node(self, name: str, devices: List[ChipInfo], topology: str = "") -> None:
+        with self._lock:
+            self._nodes[name] = NodeInfo(name, [d.clone() for d in devices], topology)
+
+    def rm_node_devices(self, name: str) -> None:
+        with self._lock:
+            self._nodes.pop(name, None)
+
+    def get(self, name: str) -> Optional[NodeInfo]:
+        with self._lock:
+            return self._nodes.get(name)
+
+    def all_nodes(self) -> Dict[str, NodeInfo]:
+        with self._lock:
+            return dict(self._nodes)
+
+
+class PodManager:
+    """ref: pods.go:39-74 — tracks pods with device assignments so usage can
+    be re-aggregated; rebuilt from pod annotations on scheduler restart
+    (scheduler.go:75-95)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._pods: Dict[str, PodInfo] = {}
+
+    def add_pod(self, pod: dict, node: str, devices: PodDevices) -> None:
+        with self._lock:
+            self._pods[pod_uid(pod)] = PodInfo(
+                namespace=pod["metadata"].get("namespace", "default"),
+                name=pod["metadata"]["name"],
+                uid=pod_uid(pod),
+                node=node,
+                devices=devices,
+            )
+
+    def rm_pod(self, uid: str) -> None:
+        with self._lock:
+            self._pods.pop(uid, None)
+
+    def all_pods(self) -> Dict[str, PodInfo]:
+        with self._lock:
+            return dict(self._pods)
+
+    def ingest(self, pod: dict) -> None:
+        """Informer add/update handler: (re)build assignment state from the
+        ASSIGNED_IDS annotation (ref: onAddPod scheduler.go:75-95)."""
+        annos = get_annotations(pod)
+        enc = annos.get(annotations.ASSIGNED_IDS, "")
+        node = annos.get(annotations.ASSIGNED_NODE, "") or pod.get("spec", {}).get(
+            "nodeName", ""
+        )
+        phase = pod.get("status", {}).get("phase", "")
+        if not enc or not node or phase in ("Succeeded", "Failed"):
+            self.rm_pod(pod_uid(pod))
+            return
+        try:
+            devices = codec.decode_pod_devices(enc)
+        except ValueError:
+            self.rm_pod(pod_uid(pod))
+            return
+        self.add_pod(pod, node, devices)

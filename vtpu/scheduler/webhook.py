@@ -1,0 +1,82 @@
+"""Mutating admission webhook (ref: pkg/scheduler/webhook.go:53-116).
+
+Steers vtpu pods to the extender's scheduler profile and injects the
+priority env.  Emits an AdmissionReview response with a base64 JSON patch.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+from typing import List, Optional
+
+from vtpu.scheduler.config import SchedulerConfig
+from vtpu.utils.resources import _as_int, pod_requests_any
+from vtpu.utils.types import resources
+
+log = logging.getLogger(__name__)
+
+# env the shim reads for execute-priority arbitration
+# (ref: api.TaskPriority env CUDA_TASK_PRIORITY, pkg/api/types.go:19-22)
+ENV_TASK_PRIORITY = "TPU_TASK_PRIORITY"
+
+
+def _container_is_privileged(ctr: dict) -> bool:
+    return bool((ctr.get("securityContext") or {}).get("privileged"))
+
+
+def mutate_pod(pod: dict, config: SchedulerConfig) -> List[dict]:
+    """Return JSON-patch ops for this pod (possibly empty).
+
+    Ref behavior: skip privileged containers (:59-71); priority resource →
+    env (:83-89); any managed resource → force schedulerName (:90-110).
+    """
+    ops: List[dict] = []
+    containers = pod.get("spec", {}).get("containers", [])
+    has_resource = False
+    for i, ctr in enumerate(containers):
+        if _container_is_privileged(ctr):
+            log.info("webhook: skipping privileged container %s", ctr.get("name"))
+            continue
+        limits = (ctr.get("resources") or {}).get("limits") or {}
+        if _as_int(limits.get(resources.chip, 0)) > 0:
+            has_resource = True
+        prio = limits.get(resources.priority)
+        if prio is not None:
+            env_entry = {"name": ENV_TASK_PRIORITY, "value": str(_as_int(prio))}
+            if ctr.get("env"):
+                ops.append(
+                    {"op": "add", "path": f"/spec/containers/{i}/env/-", "value": env_entry}
+                )
+            else:
+                ops.append(
+                    {"op": "add", "path": f"/spec/containers/{i}/env", "value": [env_entry]}
+                )
+    if has_resource and pod.get("spec", {}).get("schedulerName") != config.scheduler_name:
+        ops.append(
+            {"op": "add", "path": "/spec/schedulerName", "value": config.scheduler_name}
+        )
+    return ops
+
+
+def handle_admission_review(body: dict, config: SchedulerConfig) -> dict:
+    """AdmissionReview in → AdmissionReview out."""
+    req = body.get("request") or {}
+    uid = req.get("uid", "")
+    pod = req.get("object") or {}
+    response: dict = {"uid": uid, "allowed": True}
+    try:
+        if pod.get("kind", "Pod") == "Pod" and pod_requests_any(pod):
+            ops = mutate_pod(pod, config)
+            if ops:
+                response["patchType"] = "JSONPatch"
+                response["patch"] = base64.b64encode(json.dumps(ops).encode()).decode()
+    except Exception as e:  # noqa: BLE001 — admission must not block pod creation
+        log.exception("webhook mutation failed; admitting unmodified")
+        response["warnings"] = [f"vtpu webhook error: {e}"]
+    return {
+        "apiVersion": body.get("apiVersion", "admission.k8s.io/v1"),
+        "kind": "AdmissionReview",
+        "response": response,
+    }

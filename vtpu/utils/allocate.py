@@ -48,7 +48,14 @@ def get_pending_pod(client, node_name: str) -> Optional[dict]:
                 pending.append(pod)
     if not pending:
         return None
-    pending.sort(key=lambda p: get_annotations(p).get(annotations.BIND_TIME, ""))
+
+    def bind_time(p: dict) -> float:
+        try:
+            return float(get_annotations(p).get(annotations.BIND_TIME, ""))
+        except ValueError:
+            return float("inf")  # no/garbled bind-time sorts last
+
+    pending.sort(key=bind_time)
     return pending[0]
 
 
@@ -58,7 +65,10 @@ def get_next_device_request(device_type: str, pod: dict) -> List[ContainerDevice
     annos = get_annotations(pod)
     to_alloc = codec.decode_pod_devices(annos.get(annotations.DEVICES_TO_ALLOCATE, ""))
     for ctr_devs in to_alloc:
-        if ctr_devs and all(d.type == device_type for d in ctr_devs):
+        # match on the first device's type (ref util.go:174-191) so a
+        # container mixing device families is still claimed by the plugin
+        # that owns its first entry rather than deadlocking both
+        if ctr_devs and ctr_devs[0].type == device_type:
             return ctr_devs
     raise LookupError(f"no pending {device_type} request in pod annotations")
 
@@ -70,7 +80,7 @@ def erase_next_device_type_from_annotation(client, device_type: str, pod: dict) 
     to_alloc = codec.decode_pod_devices(annos.get(annotations.DEVICES_TO_ALLOCATE, ""))
     out, erased = [], False
     for ctr_devs in to_alloc:
-        if not erased and ctr_devs and all(d.type == device_type for d in ctr_devs):
+        if not erased and ctr_devs and ctr_devs[0].type == device_type:
             erased = True
             out.append([])  # keep container position; an empty list encodes ''
         else:
@@ -98,7 +108,12 @@ def pod_allocation_try_success(client, pod: dict) -> None:
     )
     node = get_annotations(fresh).get(annotations.ASSIGNED_NODE)
     if node:
-        release_node_lock(client, node)
+        try:
+            release_node_lock(client, node)
+        except Exception:  # noqa: BLE001 — success already recorded; the lock
+            # self-expires after 5 min, don't turn a done allocation into a
+            # kubelet failure over a release hiccup
+            log.exception("failed to release node lock on %s", node)
 
 
 def pod_allocation_failed(client, pod: dict) -> None:

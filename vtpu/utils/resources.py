@@ -29,15 +29,23 @@ def _as_int(v) -> int:
     # MiB quantity).  k8s quantity suffixes are converted exactly: decimal
     # suffixes go through bytes so "16G" (16e9 B) ≠ "16Gi" (2^34 B).
     for suf, bytes_mul in (
+        ("Ei", 1024**6),
+        ("Pi", 1024**5),
+        ("Ti", 1024**4),
         ("Gi", 1024**3),
         ("Mi", 1024**2),
         ("Ki", 1024),
+        ("E", 1000**6),
+        ("P", 1000**5),
+        ("T", 1000**4),
         ("G", 1000**3),
         ("M", 1000**2),
         ("k", 1000),
     ):
         if s.endswith(suf):
             return int(float(s[: -len(suf)]) * bytes_mul / 1024**2)
+    if s.endswith("m"):  # milli — k8s normalizes "1000m" cpu-style counts
+        return int(float(s[:-1]) / 1000)
     return int(float(s))
 
 
