@@ -239,23 +239,35 @@ def _post(url, body):
 
 
 def test_http_filter_bind_flow(http_sched):
+    """Canonical lowercase extender-v1 wire keys (k8s JSON tags)."""
     c, s, base = http_sched
     pod = c.create_pod(tpu_pod(mem=2048, cores=10))
-    out = _post(base + "/filter", {"Pod": pod, "NodeNames": ["n1"]})
-    assert out["Error"] == "" and out["NodeNames"] == ["n1"]
+    out = _post(base + "/filter", {"pod": pod, "nodenames": ["n1"]})
+    assert out["error"] == "" and out["nodenames"] == ["n1"]
     out = _post(
         base + "/bind",
-        {"PodName": "p", "PodNamespace": "default", "PodUID": pod["metadata"]["uid"],
-         "Node": "n1"},
+        {"podName": "p", "podNamespace": "default", "podUID": pod["metadata"]["uid"],
+         "node": "n1"},
     )
-    assert out["Error"] == ""
+    assert out["error"] == ""
     assert c.get_pod("default", "p")["spec"]["nodeName"] == "n1"
+
+
+def test_http_filter_nodes_items_form(http_sched):
+    """nodeCacheCapable=false senders pass full Node objects in nodes.items."""
+    c, s, base = http_sched
+    pod = c.create_pod(tpu_pod("itemform", mem=1024))
+    out = _post(
+        base + "/filter",
+        {"pod": pod, "nodes": {"items": [{"metadata": {"name": "n1"}}]}},
+    )
+    assert out["error"] == "" and out["nodenames"] == ["n1"]
 
 
 def test_http_metrics_and_health(http_sched):
     c, s, base = http_sched
     pod = c.create_pod(tpu_pod(mem=2048))
-    _post(base + "/filter", {"Pod": pod, "NodeNames": ["n1"]})
+    _post(base + "/filter", {"pod": pod, "nodenames": ["n1"]})
     with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
         text = r.read().decode()
     assert "vtpu_device_memory_limit_bytes" in text
@@ -317,3 +329,41 @@ def test_webhook_admission_review_roundtrip():
     assert resp["uid"] == "u2" and resp["allowed"]
     patch = json.loads(base64.b64decode(resp["patch"]))
     assert any(op["path"] == "/spec/schedulerName" for op in patch)
+
+
+# -- review regressions ---------------------------------------------------
+
+
+def test_refilter_after_bind_failure_not_wedged():
+    """A pod whose bind failed must not see its own stale booking as
+    occupancy on the retry (else it is permanently Pending)."""
+    c = FakeClient()
+    register_node(c, n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    pod = c.create_pod(tpu_pod("retry", pct=100))  # whole node's chip
+    assert s.filter(pod, ["n1"]).node == "n1"
+    # bind fails (simulate by not binding); kube-scheduler retries filter
+    res = s.filter(c.get_pod("default", "retry"), ["n1"])
+    assert res.node == "n1", res.error  # own booking excluded
+
+
+def test_concurrent_filters_no_double_booking():
+    """Two pods racing for the last chip capacity: exactly one wins."""
+    import threading
+
+    c = FakeClient()
+    register_node(c, n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    pods = [c.create_pod(tpu_pod(f"race-{i}", pct=60)) for i in range(2)]
+    results = []
+
+    def run(p):
+        results.append(s.filter(p, ["n1"]))
+
+    ts = [threading.Thread(target=run, args=(p,)) for p in pods]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    winners = [r for r in results if r.node == "n1"]
+    assert len(winners) == 1  # 60% + 60% > 100% — only one may fit

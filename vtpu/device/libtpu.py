@@ -140,8 +140,17 @@ class LibtpuProvider:
         if not chips:
             return None
         if self._topo is None:
-            n = len(chips)
-            self._topo = Topology((n, 1, 1))
+            # derive the grid from observed coords — a fabricated linear
+            # shape would contradict 2D/3D coords and break rectangle
+            # enumeration for every gang
+            coords = [c.coords for c in chips if c.coords]
+            if coords and all(len(c) == len(coords[0]) for c in coords):
+                dims = [max(c[i] for c in coords) + 1 for i in range(len(coords[0]))]
+                while len(dims) < 3:
+                    dims.append(1)
+                self._topo = Topology(tuple(dims[:3]))
+            else:
+                self._topo = Topology((len(chips), 1, 1))
         return chips
 
     # -- DeviceProvider ----------------------------------------------------

@@ -1,0 +1,10 @@
+"""Kubelet device plugin (ref: pkg/device-plugin, cmd/device-plugin).
+
+Advertises split-count fake devices per TPU chip, registers the chip
+inventory into node annotations every 30 s, and converts the scheduler's
+pod-annotation assignments into container env/mount injections for the
+enforcement shim at Allocate time.
+"""
+
+from vtpu.plugin.cache import DeviceCache  # noqa: F401
+from vtpu.plugin.config import PluginConfig  # noqa: F401

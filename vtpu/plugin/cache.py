@@ -1,0 +1,84 @@
+"""Device cache with health fan-out.
+
+Ref: pkg/device-plugin/nvidiadevice/cache.go (NVIDIA, sticky-unhealthy) and
+pkg/device-plugin/mlu/cache.go (CNDEV 1 Hz poll, recovers).  We poll the
+provider and notify subscribers on any health transition — recovery
+included, the CNDEV behavior, which the NVIDIA path lacks (FIXME at
+plugin.go:271-272).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import Callable, Dict, List
+
+from vtpu.device.chip import Chip
+
+log = logging.getLogger(__name__)
+
+
+def _snap(chips: List[Chip]) -> List[Chip]:
+    # snapshot copies: providers may return live objects they mutate in
+    # place, which would defeat the old-vs-new health comparison
+    return [dataclasses.replace(c) for c in chips]
+
+
+class DeviceCache:
+    def __init__(self, provider, poll_interval_s: float = 1.0) -> None:
+        self.provider = provider
+        self.poll_interval_s = poll_interval_s
+        self._lock = threading.RLock()
+        self._chips: List[Chip] = _snap(provider.enumerate())
+        self._subs: Dict[str, Callable[[List[Chip]], None]] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def chips(self) -> List[Chip]:
+        with self._lock:
+            return list(self._chips)
+
+    def subscribe(self, name: str, fn: Callable[[List[Chip]], None]) -> None:
+        """fn is called with the full refreshed chip list on any health
+        transition (ref cache.go fan-out of unhealthy events)."""
+        with self._lock:
+            self._subs[name] = fn
+
+    def unsubscribe(self, name: str) -> None:
+        with self._lock:
+            self._subs.pop(name, None)
+
+    def _poll_once(self) -> None:
+        fresh = _snap(self.provider.health_check())
+        with self._lock:
+            old = {c.uuid: c.healthy for c in self._chips}
+            changed = [
+                c for c in fresh if old.get(c.uuid) is not None and old[c.uuid] != c.healthy
+            ]
+            self._chips = fresh
+            subs = list(self._subs.values())
+        if changed:
+            for c in changed:
+                log.warning(
+                    "chip %s health: %s", c.uuid, "recovered" if c.healthy else "UNHEALTHY"
+                )
+            for fn in subs:
+                try:
+                    fn(list(fresh))
+                except Exception:  # noqa: BLE001
+                    log.exception("health subscriber failed")
+
+    def start(self) -> None:
+        def loop() -> None:
+            while not self._stop.wait(self.poll_interval_s):
+                try:
+                    self._poll_once()
+                except Exception:  # noqa: BLE001
+                    log.exception("health poll failed")
+
+        self._thread = threading.Thread(target=loop, name="vtpu-health", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,68 @@
+"""Device-plugin configuration (ref: pkg/device-plugin/config/config.go:19-26
++ per-node overrides readFromConfigFile, cmd/device-plugin/nvidia/main.go:85)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class PluginConfig:
+    node_name: str = ""
+    resource_name: str = "google.com/tpu"
+    # how many shares each chip is split into (ref DeviceSplitCount)
+    device_split_count: int = 10
+    # advertise N× the physical HBM (oversubscription, ref DeviceMemoryScaling)
+    device_memory_scaling: float = 1.0
+    device_cores_scaling: float = 1.0
+    disable_core_limit: bool = False
+    # where the plugin's own gRPC socket lives
+    socket_dir: str = "/var/lib/kubelet/device-plugins"
+    socket_name: str = "vtpu.sock"
+    # host dir holding the enforcement shim artifacts to mount into pods
+    shim_host_dir: str = "/usr/local/vtpu"
+    # container-visible shared-region dir (ref /tmp/vgpu)
+    container_cache_dir: str = "/tmp/vtpu"
+    # host root for per-container cache dirs (ref /usr/local/vgpu/containers)
+    cache_host_root: str = "/usr/local/vtpu/containers"
+    # TPU_CORE_UTILIZATION_POLICY: default | force | disable (ref docs/config.md)
+    core_utilization_policy: str = "default"
+    ici_policy: str = "best-effort"
+
+    @classmethod
+    def from_env(cls, config_file: Optional[str] = None) -> "PluginConfig":
+        cfg = cls()
+        cfg.node_name = os.environ.get("NODE_NAME", os.uname().nodename)
+        for field, env in (
+            ("device_split_count", "VTPU_DEVICE_SPLIT_COUNT"),
+            ("device_memory_scaling", "VTPU_DEVICE_MEMORY_SCALING"),
+            ("device_cores_scaling", "VTPU_DEVICE_CORES_SCALING"),
+        ):
+            v = os.environ.get(env)
+            if v:
+                setattr(cfg, field, type(getattr(cfg, field))(float(v)))
+        if os.environ.get("VTPU_RESOURCE_NAME"):
+            cfg.resource_name = os.environ["VTPU_RESOURCE_NAME"]
+        # per-node overrides from a ConfigMap-mounted JSON file
+        # (ref main.go:85-108: devicememoryscaling/devicesplitcount per node)
+        path = config_file or os.environ.get("VTPU_NODE_CONFIG", "/config/config.json")
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                for entry in data.get("nodeconfig", []):
+                    if entry.get("name") == cfg.node_name:
+                        if "devicememoryscaling" in entry:
+                            cfg.device_memory_scaling = float(entry["devicememoryscaling"])
+                        if "devicesplitcount" in entry:
+                            cfg.device_split_count = int(entry["devicesplitcount"])
+                        log.info("applied per-node config overrides for %s", cfg.node_name)
+            except (OSError, ValueError, json.JSONDecodeError):
+                log.exception("bad node config file %s; using defaults", path)
+        return cfg

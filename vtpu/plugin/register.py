@@ -1,0 +1,91 @@
+"""Annotation registrar — the node side of the registration bus.
+
+Ref: pkg/device-plugin/nvidiadevice/register.go:56-115 — every 30 s the
+plugin re-queries devices and patches the node annotations:
+``vtpu.io/node-handshake-tpu = "Reported <ts>"`` plus the encoded device
+list, which the scheduler's 15 s poll ingests (§3.4).  The annotation bus
+replaced gRPC registration in the reference (CHANGELOG v2.2) because it
+survives firewalls and is kubectl-inspectable — we keep that property.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+from typing import List
+
+from vtpu.plugin.cache import DeviceCache
+from vtpu.plugin.config import PluginConfig
+from vtpu.utils import codec
+from vtpu.utils.types import (
+    ChipInfo,
+    HandshakeState,
+    REGISTER_INTERVAL_S,
+    REGISTER_RETRY_S,
+    annotations,
+)
+
+log = logging.getLogger(__name__)
+
+
+def build_device_infos(cache: DeviceCache, cfg: PluginConfig) -> List[ChipInfo]:
+    """Chip → registration record (ref apiDevices register.go:56-82:
+    Count=split, Devmem=mem×scaling, Type, Health)."""
+    out = []
+    for chip in cache.chips():
+        out.append(
+            ChipInfo(
+                uuid=chip.uuid,
+                count=cfg.device_split_count,
+                hbm_mb=int(chip.hbm_mb * cfg.device_memory_scaling),
+                cores=int(chip.cores * cfg.device_cores_scaling),
+                type=chip.model,
+                health=chip.healthy,
+                coords=chip.coords,
+            )
+        )
+    return out
+
+
+def register_once(client, cache: DeviceCache, cfg: PluginConfig) -> None:
+    """Ref: RegistrInAnnotation register.go:84-102."""
+    infos = build_device_infos(cache, cfg)
+    topo = cache.provider.topology()
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+    client.patch_node_annotations(
+        cfg.node_name,
+        {
+            annotations.NODE_HANDSHAKE: f"{HandshakeState.REPORTED} {ts}",
+            annotations.NODE_REGISTER: codec.encode_node_devices(infos),
+            annotations.NODE_TOPOLOGY: "x".join(str(d) for d in topo.dims),
+        },
+    )
+
+
+class Registrar:
+    """ref WatchAndRegister register.go:104-115 (30 s loop, 5 s on error)."""
+
+    def __init__(self, client, cache: DeviceCache, cfg: PluginConfig) -> None:
+        self.client = client
+        self.cache = cache
+        self.cfg = cfg
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        def loop() -> None:
+            while not self._stop.is_set():
+                try:
+                    register_once(self.client, self.cache, self.cfg)
+                    delay = REGISTER_INTERVAL_S
+                except Exception:  # noqa: BLE001
+                    log.exception("node registration failed; retrying")
+                    delay = REGISTER_RETRY_S
+                self._stop.wait(delay)
+
+        self._thread = threading.Thread(target=loop, name="vtpu-registrar", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,294 @@
+"""The vtpu kubelet device plugin.
+
+Ref: pkg/device-plugin/nvidiadevice/plugin.go — a gRPC server on a unix
+socket under /var/lib/kubelet/device-plugins that
+
+1. advertises ``device_split_count`` fake device IDs per physical chip
+   (``<uuid>-<k>``, ref apiDevices plugin.go:446-467) so kubelet lets
+   ``split_count`` pods share one chip;
+2. on ``Allocate`` ignores kubelet's arbitrary fake-ID picks and instead
+   reads the *scheduler's* chip assignment from the pod annotation
+   (DEVICES_TO_ALLOCATE), emitting the shim env/mount ABI (§3.3);
+3. answers ``GetPreferredAllocation`` with ICI-rectangle picks — the MLU
+   topology-aware mode (server.go:441-491), which NVIDIA's plugin disables.
+
+The shim ABI (consumed by vtpu.shim + cpp/ interposer):
+  TPU_DEVICE_MEMORY_LIMIT_<i>  per-chip HBM quota, MiB
+  TPU_DEVICE_CORES_LIMIT       core percentage quota
+  VTPU_VISIBLE_UUIDS           assigned chip uuids, comma-joined
+  TPU_VISIBLE_CHIPS            local chip indices (libtpu convention)
+  TPU_DEVICE_MEMORY_SHARED_CACHE  shared-region file path template
+  VTPU_OVERSUBSCRIBE           "true" when memory scaling > 1
+  TPU_CORE_UTILIZATION_POLICY  default|force|disable
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent import futures
+from typing import Dict, List, Optional
+
+import grpc
+
+from vtpu.device.allocator import AllocationError, IciAllocator
+from vtpu.plugin import api
+from vtpu.plugin import v1beta1_pb2 as pb
+from vtpu.plugin.cache import DeviceCache
+from vtpu.plugin.config import PluginConfig
+from vtpu.utils import allocate as alloc_util
+from vtpu.utils.types import DEVICE_TYPE_TPU
+
+log = logging.getLogger(__name__)
+
+
+def split_device_ids(uuid: str, split_count: int) -> List[str]:
+    return [f"{uuid}-{k}" for k in range(split_count)]
+
+
+def fake_id_to_uuid(fake_id: str) -> str:
+    return fake_id.rsplit("-", 1)[0]
+
+
+class VtpuDevicePlugin(api.DevicePluginServicer):
+    def __init__(self, client, cache: DeviceCache, cfg: PluginConfig) -> None:
+        self.client = client
+        self.cache = cache
+        self.cfg = cfg
+        self._gen = 0
+        self._cond = threading.Condition()
+        self._stopped = threading.Event()
+        cache.subscribe("plugin", self._on_health_change)
+
+    # ------------------------------------------------------------------
+    def _on_health_change(self, _chips) -> None:
+        with self._cond:
+            self._gen += 1
+            self._cond.notify_all()
+
+    def _api_devices(self) -> List[pb.Device]:
+        """ref apiDevices plugin.go:446-467."""
+        out = []
+        for chip in self.cache.chips():
+            health = "Healthy" if chip.healthy else "Unhealthy"
+            for fid in split_device_ids(chip.uuid, self.cfg.device_split_count):
+                out.append(pb.Device(ID=fid, health=health))
+        return out
+
+    # -- gRPC methods ----------------------------------------------------
+    def GetDevicePluginOptions(self, request, context):  # noqa: N802
+        return pb.DevicePluginOptions(get_preferred_allocation_available=True)
+
+    def ListAndWatch(self, request, context):  # noqa: N802
+        """Initial device list + resend on any health transition
+        (ref plugin.go:264-277)."""
+        last_gen = -1
+        while not self._stopped.is_set():
+            with self._cond:
+                if self._gen == last_gen:
+                    self._cond.wait(timeout=5.0)
+                if self._gen == last_gen:
+                    continue
+                last_gen = self._gen
+            yield pb.ListAndWatchResponse(devices=self._api_devices())
+
+    def GetPreferredAllocation(self, request, context):  # noqa: N802
+        """ICI-aware preferred picks over kubelet's available fake IDs
+        (ref MLU server.go:441-491; NVIDIA leaves this empty)."""
+        resp = pb.PreferredAllocationResponse()
+        chips_by_uuid = {c.uuid: c for c in self.cache.chips()}
+        topo = self.cache.provider.topology()
+        for creq in request.container_requests:
+            chosen: List[str] = []
+            # group available fake IDs per chip
+            per_chip: Dict[str, List[str]] = {}
+            for fid in creq.available_deviceIDs:
+                per_chip.setdefault(fake_id_to_uuid(fid), []).append(fid)
+            must = list(creq.must_include_deviceIDs)
+            need = creq.allocation_size - len(must)
+            # chips already pinned by must-include cannot be re-picked, or
+            # the response would contain duplicate IDs
+            must_chips = {fake_id_to_uuid(fid) for fid in must}
+            avail_chips = [
+                chips_by_uuid[u]
+                for u in per_chip
+                if u in chips_by_uuid and u not in must_chips
+            ]
+            try:
+                picked = IciAllocator(topo, self.cfg.ici_policy).allocate(
+                    avail_chips, max(need, 0)
+                )
+                for chip in picked:
+                    chosen.append(per_chip[chip.uuid][0])
+            except AllocationError as e:
+                log.info("preferred allocation fallback: %s", e)
+                flat = [fid for fids in per_chip.values() for fid in fids]
+                chosen = flat[: max(need, 0)]
+            resp.container_responses.append(
+                pb.ContainerPreferredAllocationResponse(deviceIDs=must + chosen)
+            )
+        return resp
+
+    # ------------------------------------------------------------------
+    def _container_response(
+        self, devs, pod: dict
+    ) -> pb.ContainerAllocateResponse:
+        """Build env/mount/device injection (ref plugin.go:353-392)."""
+        cfg = self.cfg
+        resp = pb.ContainerAllocateResponse()
+        chips_by_uuid = {c.uuid: c for c in self.cache.chips()}
+        indices = []
+        for i, cd in enumerate(devs):
+            resp.envs[f"TPU_DEVICE_MEMORY_LIMIT_{i}"] = str(cd.usedmem)
+            chip = chips_by_uuid.get(cd.uuid)
+            if chip is not None:
+                indices.append(str(chip.index))
+                if chip.devpath:
+                    resp.devices.append(
+                        pb.DeviceSpec(
+                            container_path=chip.devpath,
+                            host_path=chip.devpath,
+                            permissions="rw",
+                        )
+                    )
+        cores = max((cd.usedcores for cd in devs), default=0)
+        if cores and not cfg.disable_core_limit:
+            resp.envs["TPU_DEVICE_CORES_LIMIT"] = str(cores)
+        resp.envs["VTPU_VISIBLE_UUIDS"] = ",".join(cd.uuid for cd in devs)
+        if indices:
+            resp.envs["TPU_VISIBLE_CHIPS"] = ",".join(indices)
+            resp.envs["TPU_VISIBLE_DEVICES"] = ",".join(indices)
+        resp.envs["TPU_DEVICE_MEMORY_SHARED_CACHE"] = (
+            f"{cfg.container_cache_dir}/vtpu.cache"
+        )
+        if cfg.device_memory_scaling > 1.0:
+            resp.envs["VTPU_OVERSUBSCRIBE"] = "true"
+        if cfg.core_utilization_policy != "default":
+            resp.envs["TPU_CORE_UTILIZATION_POLICY"] = cfg.core_utilization_policy
+        # mounts: shim artifacts + per-container shared-region dir (§3.3)
+        pod_uid = pod["metadata"]["uid"]
+        cache_host = f"{cfg.cache_host_root}/{pod_uid}_{len(indices)}"
+        resp.mounts.append(
+            pb.Mount(container_path=cfg.container_cache_dir, host_path=cache_host)
+        )
+        resp.mounts.append(
+            pb.Mount(container_path="/tmp/vtpulock", host_path="/tmp/vtpulock")
+        )
+        shim_lib = os.path.join(cfg.shim_host_dir, "libvtpu_shim.so")
+        preload = os.path.join(cfg.shim_host_dir, "ld.so.preload")
+        if os.path.exists(shim_lib):
+            resp.mounts.append(
+                pb.Mount(
+                    container_path="/usr/local/vtpu/libvtpu_shim.so",
+                    host_path=shim_lib,
+                    read_only=True,
+                )
+            )
+            if os.path.exists(preload):
+                resp.mounts.append(
+                    pb.Mount(
+                        container_path="/etc/ld.so.preload",
+                        host_path=preload,
+                        read_only=True,
+                    )
+                )
+        return resp
+
+    def Allocate(self, request, context):  # noqa: N802
+        """ref plugin.go:318-392 + §3.3 call stack."""
+        if len(request.container_requests) > 1:
+            # one container per Allocate (ref :320-322)
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                "multiple container requests in one Allocate are unsupported",
+            )
+        creq = request.container_requests[0]
+        pending = alloc_util.get_pending_pod(self.client, self.cfg.node_name)
+        if pending is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "no pod pending allocation on this node",
+            )
+        try:
+            devs = alloc_util.get_next_device_request(DEVICE_TYPE_TPU, pending)
+            if len(devs) != len(creq.devicesIDs):
+                raise LookupError(
+                    f"annotation has {len(devs)} devices, kubelet asked "
+                    f"{len(creq.devicesIDs)}"
+                )
+            alloc_util.erase_next_device_type_from_annotation(
+                self.client, DEVICE_TYPE_TPU, pending
+            )
+            resp = pb.AllocateResponse()
+            resp.container_responses.append(self._container_response(devs, pending))
+        except Exception as e:  # noqa: BLE001 — any failure must unwind the handshake
+            log.exception("Allocate failed")
+            alloc_util.pod_allocation_failed(self.client, pending)
+            context.abort(grpc.StatusCode.INTERNAL, f"vtpu allocate: {e}")
+        alloc_util.pod_allocation_try_success(self.client, pending)
+        return resp
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._cond:
+            self._cond.notify_all()
+
+
+class PluginServer:
+    """Socket lifecycle + kubelet registration (ref plugin.go:150-262 and
+    the fsnotify restart loop in cmd/device-plugin/nvidia/main.go:211-215).
+    Crash-loop guard: ≤5 restarts/hour (ref plugin.go:190-218)."""
+
+    MAX_RESTARTS_PER_HOUR = 5
+
+    def __init__(self, servicer: VtpuDevicePlugin, cfg: PluginConfig) -> None:
+        self.servicer = servicer
+        self.cfg = cfg
+        self.server: Optional[grpc.Server] = None
+        self._restarts: List[float] = []
+
+    @property
+    def socket_path(self) -> str:
+        return os.path.join(self.cfg.socket_dir, self.cfg.socket_name)
+
+    def serve(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        os.makedirs(self.cfg.socket_dir, exist_ok=True)
+        self.server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+        api.add_device_plugin_servicer(self.servicer, self.server)
+        self.server.add_insecure_port(f"unix://{self.socket_path}")
+        self.server.start()
+        log.info("device plugin serving on %s", self.socket_path)
+
+    def register_with_kubelet(self, kubelet_socket: str = api.KUBELET_SOCKET) -> None:
+        with grpc.insecure_channel(f"unix://{kubelet_socket}") as ch:
+            api.RegistrationStub(ch).Register(
+                pb.RegisterRequest(
+                    version=api.VERSION,
+                    endpoint=self.cfg.socket_name,
+                    resource_name=self.cfg.resource_name,
+                    options=pb.DevicePluginOptions(
+                        get_preferred_allocation_available=True
+                    ),
+                ),
+                timeout=10,
+            )
+        log.info("registered %s with kubelet", self.cfg.resource_name)
+
+    def allow_restart(self) -> bool:
+        now = time.time()
+        self._restarts = [t for t in self._restarts if now - t < 3600]
+        if len(self._restarts) >= self.MAX_RESTARTS_PER_HOUR:
+            return False
+        self._restarts.append(now)
+        return True
+
+    def stop(self) -> None:
+        self.servicer.stop()
+        if self.server is not None:
+            self.server.stop(grace=1)
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)

@@ -71,6 +71,10 @@ class Scheduler:
         # cached usage snapshot for metrics (ref cachedstatus)
         self._cached_usage: Dict[str, NodeUsage] = {}
         self._cache_lock = threading.Lock()
+        # serialises the snapshot→select→book critical section: concurrent
+        # /filter requests (HA schedulers, parallel binds) must not both see
+        # the same chip as free
+        self._filter_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # Registry: node annotations → device state (ref scheduler.go:143-229)
@@ -139,7 +143,11 @@ class Scheduler:
     # ------------------------------------------------------------------
     # Usage aggregation (ref getNodesUsage scheduler.go:348-400)
     # ------------------------------------------------------------------
-    def nodes_usage(self) -> Dict[str, NodeUsage]:
+    def nodes_usage(self, exclude_uid: Optional[str] = None) -> Dict[str, NodeUsage]:
+        """Aggregate registry totals minus per-pod bookings.  ``exclude_uid``
+        drops one pod's own booking — a pod being *re*-filtered after a bind
+        failure must not see its previous assignment as occupancy, or it can
+        never be rescheduled."""
         usage: Dict[str, NodeUsage] = {}
         for name, info in self.nodes.all_nodes().items():
             usage[name] = NodeUsage(
@@ -147,7 +155,9 @@ class Scheduler:
                 devices=[DeviceUsage.from_chip_info(ci) for ci in info.devices],
                 topology=info.topology,
             )
-        for pi in self.pods.all_pods().values():
+        for uid, pi in self.pods.all_pods().items():
+            if uid == exclude_uid:
+                continue
             nu = usage.get(pi.node)
             if nu is None:
                 continue
@@ -165,10 +175,11 @@ class Scheduler:
         return usage
 
     def inspect_usage(self) -> Dict[str, NodeUsage]:
-        """Last snapshot for metrics (ref InspectAllNodesUsage)."""
+        """Cached snapshot for metrics scrapes (ref InspectAllNodesUsage);
+        falls back to a fresh aggregation when nothing is cached yet."""
         with self._cache_lock:
-            if not self._cached_usage:
-                pass
+            if self._cached_usage:
+                return self._cached_usage
         return self.nodes_usage()
 
     # ------------------------------------------------------------------
@@ -183,7 +194,13 @@ class Scheduler:
             # not a vtpu pod — pass through unfiltered (ref :453-460)
             return FilterResult(node=None, failed={}, error="")
         pod_annos = get_annotations(pod)
-        usage = self.nodes_usage()
+        with self._filter_lock:
+            return self._filter_locked(pod, node_names, reqs, pod_annos)
+
+    def _filter_locked(
+        self, pod: dict, node_names: List[str], reqs, pod_annos
+    ) -> FilterResult:
+        usage = self.nodes_usage(exclude_uid=pod_uid(pod))
         ici_policy = pod_annos.get("vtpu.io/ici-policy", self.config.ici_policy)
         best: Optional[Tuple[float, str, object]] = None
         failed: Dict[str, str] = {}

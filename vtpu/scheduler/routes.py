@@ -28,30 +28,46 @@ from vtpu.scheduler.webhook import handle_admission_review
 log = logging.getLogger(__name__)
 
 
+def _get(args: dict, *keys, default=None):
+    """Tolerant lookup: kube-scheduler's extender v1 wire uses lowercase
+    JSON tags (pod, nodenames, failedNodes, podName…); accept a couple of
+    casings so hand-rolled test harnesses also work."""
+    for k in keys:
+        if k in args:
+            return args[k]
+    return default
+
+
 def filter_handler(sched: Scheduler, args: dict) -> dict:
-    pod = args.get("Pod") or {}
-    node_names = args.get("NodeNames")
+    """ExtenderArgs → ExtenderFilterResult.  Canonical wire keys follow
+    k8s.io/kube-scheduler/extender/v1 JSON tags: {"pod", "nodenames",
+    "nodes"} in; {"nodenames", "failedNodes", "error"} out."""
+    pod = _get(args, "pod", "Pod") or {}
+    node_names = _get(args, "nodenames", "NodeNames")
     if node_names is None:
-        # nodeCacheCapable=false senders put full Node objects in Nodes.Items
+        # nodeCacheCapable=false senders put full Node objects in nodes.items
+        nodes = _get(args, "nodes", "Nodes") or {}
         node_names = [
-            n["metadata"]["name"] for n in (args.get("Nodes") or {}).get("Items", [])
+            n["metadata"]["name"] for n in _get(nodes, "items", "Items", default=[])
         ]
     res = sched.filter(pod, list(node_names))
     if res.error:
-        return {"NodeNames": [], "FailedNodes": res.failed, "Error": res.error}
+        return {"nodenames": [], "failedNodes": res.failed, "error": res.error}
     if res.node is None:
         # non-vtpu pod: pass all nodes through (ref scheduler.go:453-460)
-        return {"NodeNames": node_names, "FailedNodes": {}, "Error": ""}
-    return {"NodeNames": [res.node], "FailedNodes": res.failed, "Error": ""}
+        return {"nodenames": node_names, "failedNodes": {}, "error": ""}
+    return {"nodenames": [res.node], "failedNodes": res.failed, "error": ""}
 
 
 def bind_handler(sched: Scheduler, args: dict) -> dict:
+    """ExtenderBindingArgs {"podName","podNamespace","podUID","node"} →
+    ExtenderBindingResult {"error"}."""
     err = sched.bind(
-        args.get("PodNamespace", "default"),
-        args.get("PodName", ""),
-        args.get("Node", ""),
+        _get(args, "podNamespace", "PodNamespace", default="default"),
+        _get(args, "podName", "PodName", default=""),
+        _get(args, "node", "Node", default=""),
     )
-    return {"Error": err or ""}
+    return {"error": err or ""}
 
 
 class _Handler(BaseHTTPRequestHandler):

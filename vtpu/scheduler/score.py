@@ -150,12 +150,14 @@ def _select_devices(
     if req.nums == 1:
         # binpack: most-loaded chip first (keeps whole chips free);
         # spread: least-loaded first.  Ties broken by uuid for determinism.
-        keyfn = lambda d: (d.usedmem / max(d.totalmem, 1), d.used, d.uuid)  # noqa: E731
-        fitting.sort(key=keyfn, reverse=(policy == "binpack"))
-        if policy == "binpack":
-            # reverse=True flips the uuid tiebreak too; re-sort equals by uuid
-            fitting.sort(key=lambda d: d.uuid)
-            fitting.sort(key=lambda d: (d.usedmem / max(d.totalmem, 1), d.used), reverse=True)
+        sign = -1 if policy == "binpack" else 1
+        fitting.sort(
+            key=lambda d: (
+                sign * (d.usedmem / max(d.totalmem, 1)),
+                sign * d.used,
+                d.uuid,
+            )
+        )
         return [fitting[0]]
     # gang: ICI-aware choice over the fitting set (TPU extension; the MLU
     # analog is GetPreferredAllocation + allocators, SURVEY §2.9)
