@@ -1,0 +1,193 @@
+/* mock_pjrt — a minimal fake PJRT plugin for hardware-free interposer
+ * tests (the reference's mock-libcndev trick, SURVEY.md §4, applied to
+ * PJRT).  Implements just enough of the C API for libvtpu_shim.so to wrap:
+ * client/device enumeration, host→device buffers with real sizes, buffer
+ * destroy, compile/executable size, execute (spins for MOCK_PJRT_EXEC_US
+ * microseconds), and memory stats.
+ *
+ * Env knobs: MOCK_PJRT_DEVICES (default 1), MOCK_PJRT_HBM_MB (default
+ * 16384), MOCK_PJRT_EXEC_US (default 1000).
+ */
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include <string>
+#include <vector>
+
+#include "pjrt_c_api.h"
+
+namespace {
+
+struct MockError {
+  std::string msg;
+  PJRT_Error_Code code;
+};
+
+struct MockDevice {
+  int index;
+};
+
+struct MockClient {
+  std::vector<PJRT_Device*> devices;
+};
+
+struct MockBuffer {
+  uint64_t size;
+  MockDevice* device;
+};
+
+struct MockExecutable {
+  int64_t code_size;
+};
+
+int env_int(const char* k, int def) {
+  const char* v = getenv(k);
+  return v ? atoi(v) : def;
+}
+
+void err_destroy(PJRT_Error_Destroy_Args* a) {
+  delete reinterpret_cast<MockError*>(a->error);
+}
+void err_message(PJRT_Error_Message_Args* a) {
+  auto* e = reinterpret_cast<const MockError*>(a->error);
+  a->message = e->msg.c_str();
+  a->message_size = e->msg.size();
+}
+PJRT_Error* err_getcode(PJRT_Error_GetCode_Args* a) {
+  a->code = reinterpret_cast<const MockError*>(a->error)->code;
+  return nullptr;
+}
+
+PJRT_Error* client_create(PJRT_Client_Create_Args* a) {
+  auto* c = new MockClient();
+  int n = env_int("MOCK_PJRT_DEVICES", 1);
+  for (int i = 0; i < n; i++) {
+    auto* d = new MockDevice{i};
+    c->devices.push_back(reinterpret_cast<PJRT_Device*>(d));
+  }
+  a->client = reinterpret_cast<PJRT_Client*>(c);
+  return nullptr;
+}
+
+PJRT_Error* client_destroy(PJRT_Client_Destroy_Args* a) {
+  auto* c = reinterpret_cast<MockClient*>(a->client);
+  for (auto* d : c->devices) delete reinterpret_cast<MockDevice*>(d);
+  delete c;
+  return nullptr;
+}
+
+PJRT_Error* client_devices(PJRT_Client_AddressableDevices_Args* a) {
+  auto* c = reinterpret_cast<MockClient*>(a->client);
+  a->addressable_devices = c->devices.data();
+  a->num_addressable_devices = c->devices.size();
+  return nullptr;
+}
+
+uint64_t dtype_bytes(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+      return 8;
+    case PJRT_Buffer_Type_F32:
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+      return 4;
+    case PJRT_Buffer_Type_BF16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+      return 2;
+    default:
+      return 1;
+  }
+}
+
+PJRT_Error* buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* a) {
+  uint64_t n = 1;
+  for (size_t i = 0; i < a->num_dims; i++) n *= (uint64_t)a->dims[i];
+  auto* b = new MockBuffer{n * dtype_bytes(a->type),
+                           reinterpret_cast<MockDevice*>(a->device)};
+  a->buffer = reinterpret_cast<PJRT_Buffer*>(b);
+  /* done_with_host_buffer event: callers in tests pass nullptr-tolerant
+   * paths; leave null. */
+  a->done_with_host_buffer = nullptr;
+  return nullptr;
+}
+
+PJRT_Error* buffer_size(PJRT_Buffer_OnDeviceSizeInBytes_Args* a) {
+  a->on_device_size_in_bytes =
+      reinterpret_cast<MockBuffer*>(a->buffer)->size;
+  return nullptr;
+}
+
+PJRT_Error* buffer_destroy(PJRT_Buffer_Destroy_Args* a) {
+  delete reinterpret_cast<MockBuffer*>(a->buffer);
+  return nullptr;
+}
+
+PJRT_Error* client_compile(PJRT_Client_Compile_Args* a) {
+  auto* e = new MockExecutable{env_int("MOCK_PJRT_CODE_BYTES", 1 << 20)};
+  a->executable = reinterpret_cast<PJRT_LoadedExecutable*>(e);
+  return nullptr;
+}
+
+PJRT_Error* loaded_get_executable(PJRT_LoadedExecutable_GetExecutable_Args* a) {
+  a->executable = reinterpret_cast<PJRT_Executable*>(a->loaded_executable);
+  return nullptr;
+}
+
+PJRT_Error* exec_code_size(PJRT_Executable_SizeOfGeneratedCodeInBytes_Args* a) {
+  a->size_in_bytes =
+      reinterpret_cast<MockExecutable*>(a->executable)->code_size;
+  return nullptr;
+}
+
+PJRT_Error* loaded_destroy(PJRT_LoadedExecutable_Destroy_Args* a) {
+  delete reinterpret_cast<MockExecutable*>(a->executable);
+  return nullptr;
+}
+
+PJRT_Error* loaded_execute(PJRT_LoadedExecutable_Execute_Args* a) {
+  (void)a;
+  long us = env_int("MOCK_PJRT_EXEC_US", 1000);
+  struct timespec ts = {us / 1000000L, (us % 1000000L) * 1000L};
+  nanosleep(&ts, nullptr);
+  return nullptr;
+}
+
+PJRT_Error* device_memstats(PJRT_Device_MemoryStats_Args* a) {
+  a->bytes_in_use = 0;
+  a->bytes_limit = (int64_t)env_int("MOCK_PJRT_HBM_MB", 16384) * 1024 * 1024;
+  a->bytes_limit_is_set = true;
+  return nullptr;
+}
+
+PJRT_Api g_mock_api;
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  memset(&g_mock_api, 0, sizeof(g_mock_api));
+  g_mock_api.struct_size = PJRT_Api_STRUCT_SIZE;
+  g_mock_api.pjrt_api_version.major_version = PJRT_API_MAJOR;
+  g_mock_api.pjrt_api_version.minor_version = PJRT_API_MINOR;
+  g_mock_api.PJRT_Error_Destroy = err_destroy;
+  g_mock_api.PJRT_Error_Message = err_message;
+  g_mock_api.PJRT_Error_GetCode = err_getcode;
+  g_mock_api.PJRT_Client_Create = client_create;
+  g_mock_api.PJRT_Client_Destroy = client_destroy;
+  g_mock_api.PJRT_Client_AddressableDevices = client_devices;
+  g_mock_api.PJRT_Client_BufferFromHostBuffer = buffer_from_host;
+  g_mock_api.PJRT_Buffer_OnDeviceSizeInBytes = buffer_size;
+  g_mock_api.PJRT_Buffer_Destroy = buffer_destroy;
+  g_mock_api.PJRT_Client_Compile = client_compile;
+  g_mock_api.PJRT_LoadedExecutable_GetExecutable = loaded_get_executable;
+  g_mock_api.PJRT_Executable_SizeOfGeneratedCodeInBytes = exec_code_size;
+  g_mock_api.PJRT_LoadedExecutable_Destroy = loaded_destroy;
+  g_mock_api.PJRT_LoadedExecutable_Execute = loaded_execute;
+  g_mock_api.PJRT_Device_MemoryStats = device_memstats;
+  return &g_mock_api;
+}

@@ -1,0 +1,138 @@
+/* region_tool — CLI over the shared region, for ops debugging and for
+ * cross-language tests against the Python mirror
+ * (vtpu/monitor/shared_region.py).
+ *
+ * Usage:
+ *   region_tool init   <path> <uuid:limit_mb:cores> [...]
+ *   region_tool add    <path> <pid> <dev> <kind:buffer|program> <bytes> [--oversubscribe]
+ *   region_tool sub    <path> <pid> <dev> <kind> <bytes>
+ *   region_tool reap   <path>
+ *   region_tool dump   <path>          # JSON to stdout
+ */
+#include <inttypes.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "shared_region.h"
+
+static int cmd_init(const char* path, int argc, char** argv) {
+  char uuids[VTPU_MAX_DEVICES][VTPU_UUID_LEN];
+  uint64_t limits[VTPU_MAX_DEVICES];
+  int32_t cores[VTPU_MAX_DEVICES];
+  int n = 0;
+  memset(uuids, 0, sizeof(uuids));
+  for (int i = 0; i < argc && n < VTPU_MAX_DEVICES; i++, n++) {
+    char buf[256];
+    strncpy(buf, argv[i], sizeof(buf) - 1);
+    buf[sizeof(buf) - 1] = 0;
+    char* u = strtok(buf, ":");
+    char* l = strtok(NULL, ":");
+    char* c = strtok(NULL, ":");
+    if (!u || !l || !c) {
+      fprintf(stderr, "bad device spec: %s\n", argv[i]);
+      return 2;
+    }
+    strncpy(uuids[n], u, VTPU_UUID_LEN - 1);
+    limits[n] = strtoull(l, NULL, 10) * 1024ull * 1024ull;
+    cores[n] = (int32_t)atoi(c);
+  }
+  vtpu_shared_region* r = vtpu_region_open(path);
+  if (!r) {
+    perror("open");
+    return 1;
+  }
+  if (vtpu_region_set_devices(r, n, uuids, limits, cores) != 0) {
+    fprintf(stderr, "set_devices failed (device count mismatch?)\n");
+    return 1;
+  }
+  vtpu_region_close(r);
+  return 0;
+}
+
+static int kind_of(const char* s) { return strcmp(s, "program") == 0 ? 1 : 0; }
+
+static int cmd_dump(const char* path) {
+  vtpu_shared_region* r = vtpu_region_open(path);
+  if (!r) {
+    perror("open");
+    return 1;
+  }
+  vtpu_region_lock(r);
+  printf("{\"magic\":%u,\"version\":%u,\"num_devices\":%d,", r->magic,
+         r->version, r->num_devices);
+  printf("\"utilization_switch\":%d,\"recent_kernel\":%d,\"devices\":[",
+         r->utilization_switch, r->recent_kernel);
+  for (int i = 0; i < r->num_devices; i++) {
+    uint64_t used = 0;
+    for (int p = 0; p < VTPU_MAX_PROCS; p++)
+      if (r->procs[p].status == 1) used += r->procs[p].used[i].total_bytes;
+    printf("%s{\"uuid\":\"%s\",\"limit_bytes\":%" PRIu64
+           ",\"core_limit\":%d,\"used_bytes\":%" PRIu64 "}",
+           i ? "," : "", r->uuids[i], r->limit_bytes[i], r->core_limit[i],
+           used);
+  }
+  printf("],\"procs\":[");
+  int first = 1;
+  for (int p = 0; p < VTPU_MAX_PROCS; p++) {
+    if (r->procs[p].status != 1) continue;
+    printf("%s{\"pid\":%d,\"priority\":%d,\"used\":[", first ? "" : ",",
+           r->procs[p].pid, r->procs[p].priority);
+    for (int i = 0; i < r->num_devices; i++) {
+      printf("%s{\"buffer\":%" PRIu64 ",\"program\":%" PRIu64
+             ",\"total\":%" PRIu64 "}",
+             i ? "," : "", r->procs[p].used[i].buffer_bytes,
+             r->procs[p].used[i].program_bytes,
+             r->procs[p].used[i].total_bytes);
+    }
+    printf("]}");
+    first = 0;
+  }
+  printf("]}\n");
+  vtpu_region_unlock(r);
+  vtpu_region_close(r);
+  return 0;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: region_tool <init|add|sub|reap|dump> <path> ...\n");
+    return 2;
+  }
+  const char* cmd = argv[1];
+  const char* path = argv[2];
+  if (strcmp(cmd, "init") == 0) return cmd_init(path, argc - 3, argv + 3);
+  if (strcmp(cmd, "dump") == 0) return cmd_dump(path);
+  if (strcmp(cmd, "reap") == 0) {
+    vtpu_shared_region* r = vtpu_region_open(path);
+    if (!r) return 1;
+    vtpu_region_reap_dead(r);
+    vtpu_region_close(r);
+    return 0;
+  }
+  if (strcmp(cmd, "add") == 0 || strcmp(cmd, "sub") == 0) {
+    if (argc < 7) {
+      fprintf(stderr, "usage: region_tool %s <path> <pid> <dev> <kind> <bytes>\n",
+              cmd);
+      return 2;
+    }
+    vtpu_shared_region* r = vtpu_region_open(path);
+    if (!r) return 1;
+    int32_t pid = (int32_t)atoi(argv[3]);
+    int dev = atoi(argv[4]);
+    int kind = kind_of(argv[5]);
+    uint64_t bytes = strtoull(argv[6], NULL, 10);
+    int rc = 0;
+    if (strcmp(cmd, "add") == 0) {
+      int over = argc > 7 && strcmp(argv[7], "--oversubscribe") == 0;
+      rc = vtpu_region_try_add(r, pid, dev, kind, bytes, over);
+      if (rc != 0) fprintf(stderr, "QUOTA_EXCEEDED\n");
+    } else {
+      vtpu_region_sub(r, pid, dev, kind, bytes);
+    }
+    vtpu_region_close(r);
+    return rc == 0 ? 0 : 3;
+  }
+  fprintf(stderr, "unknown command %s\n", cmd);
+  return 2;
+}

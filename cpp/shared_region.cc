@@ -1,0 +1,239 @@
+/* Shared-region implementation.
+ *
+ * Concurrency model (ref libvgpu.so's semaphore + file lock +
+ * fix_lock_shrreg dead-owner recovery, SURVEY.md §5 race detection):
+ * - creation race: O_EXCL temp + rename, then flock during init
+ * - steady state: CAS spinlock in the region; owner_pid lets a waiter
+ *   reclaim the lock if the holder died (kill(pid, 0) probe).
+ */
+#include "shared_region.h"
+
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/file.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+static void msleep(long ms) {
+  struct timespec ts = {ms / 1000, (ms % 1000) * 1000000L};
+  nanosleep(&ts, NULL);
+}
+
+vtpu_shared_region* vtpu_region_open(const char* path) {
+  int fd = open(path, O_RDWR | O_CREAT, 0666);
+  if (fd < 0) return NULL;
+  /* file lock serialises first-time init across processes */
+  if (flock(fd, LOCK_EX) != 0) {
+    close(fd);
+    return NULL;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return NULL;
+  }
+  int fresh = st.st_size < (off_t)sizeof(vtpu_shared_region);
+  if (fresh && ftruncate(fd, sizeof(vtpu_shared_region)) != 0) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return NULL;
+  }
+  void* p = mmap(NULL, sizeof(vtpu_shared_region), PROT_READ | PROT_WRITE,
+                 MAP_SHARED, fd, 0);
+  if (p == MAP_FAILED) {
+    flock(fd, LOCK_UN);
+    close(fd);
+    return NULL;
+  }
+  vtpu_shared_region* r = (vtpu_shared_region*)p;
+  if (fresh || r->magic != VTPU_REGION_MAGIC) {
+    memset(r, 0, sizeof(*r));
+    r->magic = VTPU_REGION_MAGIC;
+    r->version = VTPU_REGION_VERSION;
+    r->initialized = 1;
+  } else if (r->version != VTPU_REGION_VERSION) {
+    munmap(p, sizeof(vtpu_shared_region));
+    flock(fd, LOCK_UN);
+    close(fd);
+    return NULL;
+  }
+  flock(fd, LOCK_UN);
+  close(fd); /* mmap survives the close */
+  return r;
+}
+
+int vtpu_region_close(vtpu_shared_region* r) {
+  if (!r) return 0;
+  return munmap(r, sizeof(vtpu_shared_region));
+}
+
+int vtpu_region_set_devices(vtpu_shared_region* r, int n,
+                            const char uuids[][VTPU_UUID_LEN],
+                            const uint64_t* limit_bytes,
+                            const int32_t* core_limit) {
+  if (!r || n < 0 || n > VTPU_MAX_DEVICES) return -1;
+  vtpu_region_lock(r);
+  if (r->num_devices == 0) {
+    r->num_devices = n;
+    for (int i = 0; i < n; i++) {
+      strncpy(r->uuids[i], uuids[i], VTPU_UUID_LEN - 1);
+      r->limit_bytes[i] = limit_bytes[i];
+      r->core_limit[i] = core_limit[i];
+    }
+  } else if (r->num_devices != n) {
+    vtpu_region_unlock(r);
+    return -1;
+  }
+  vtpu_region_unlock(r);
+  return 0;
+}
+
+static int pid_alive(int32_t pid) {
+  if (pid <= 0) return 0;
+  return kill(pid, 0) == 0 || errno == EPERM;
+}
+
+void vtpu_region_lock(vtpu_shared_region* r) {
+  int spins = 0;
+  for (;;) {
+    if (__sync_bool_compare_and_swap(&r->lock, 0, 1)) {
+      r->owner_pid = (int32_t)getpid();
+      __sync_synchronize();
+      return;
+    }
+    if (++spins > 1000) { /* ~1 s: check for a dead owner */
+      int32_t owner = r->owner_pid;
+      if (owner != 0 && !pid_alive(owner)) {
+        /* dead-owner recovery (ref fix_lock_shrreg): steal only if the
+         * owner field still names the dead pid */
+        if (__sync_bool_compare_and_swap(&r->owner_pid, owner,
+                                         (int32_t)getpid())) {
+          r->lock = 1;
+          __sync_synchronize();
+          return;
+        }
+      }
+      spins = 0;
+    }
+    msleep(1);
+  }
+}
+
+void vtpu_region_unlock(vtpu_shared_region* r) {
+  r->owner_pid = 0;
+  __sync_synchronize();
+  r->lock = 0;
+}
+
+int vtpu_region_register_proc(vtpu_shared_region* r, int32_t pid,
+                              int32_t priority) {
+  vtpu_region_lock(r);
+  int free_slot = -1;
+  for (int i = 0; i < VTPU_MAX_PROCS; i++) {
+    if (r->procs[i].status == 1 && r->procs[i].pid == pid) {
+      vtpu_region_unlock(r);
+      return i;
+    }
+    if (free_slot < 0 && r->procs[i].status == 0) free_slot = i;
+  }
+  if (free_slot < 0) {
+    /* all slots busy: reap the dead and retry once */
+    for (int i = 0; i < VTPU_MAX_PROCS; i++) {
+      if (r->procs[i].status == 1 && !pid_alive(r->procs[i].pid)) {
+        memset(&r->procs[i], 0, sizeof(r->procs[i]));
+        if (free_slot < 0) free_slot = i;
+      }
+    }
+  }
+  if (free_slot >= 0) {
+    memset(&r->procs[free_slot], 0, sizeof(r->procs[free_slot]));
+    r->procs[free_slot].pid = pid;
+    r->procs[free_slot].status = 1;
+    r->procs[free_slot].priority = priority;
+    r->proc_num++;
+  }
+  vtpu_region_unlock(r);
+  return free_slot;
+}
+
+void vtpu_region_unregister_proc(vtpu_shared_region* r, int32_t pid) {
+  vtpu_region_lock(r);
+  for (int i = 0; i < VTPU_MAX_PROCS; i++) {
+    if (r->procs[i].status == 1 && r->procs[i].pid == pid) {
+      memset(&r->procs[i], 0, sizeof(r->procs[i]));
+      if (r->proc_num > 0) r->proc_num--;
+    }
+  }
+  vtpu_region_unlock(r);
+}
+
+void vtpu_region_reap_dead(vtpu_shared_region* r) {
+  vtpu_region_lock(r);
+  for (int i = 0; i < VTPU_MAX_PROCS; i++) {
+    if (r->procs[i].status == 1 && !pid_alive(r->procs[i].pid)) {
+      memset(&r->procs[i], 0, sizeof(r->procs[i]));
+      if (r->proc_num > 0) r->proc_num--;
+    }
+  }
+  vtpu_region_unlock(r);
+}
+
+static uint64_t device_usage_nolock(vtpu_shared_region* r, int dev) {
+  uint64_t total = 0;
+  for (int i = 0; i < VTPU_MAX_PROCS; i++) {
+    if (r->procs[i].status == 1) total += r->procs[i].used[dev].total_bytes;
+  }
+  return total;
+}
+
+uint64_t vtpu_region_device_usage(vtpu_shared_region* r, int dev) {
+  if (dev < 0 || dev >= VTPU_MAX_DEVICES) return 0;
+  vtpu_region_lock(r);
+  uint64_t v = device_usage_nolock(r, dev);
+  vtpu_region_unlock(r);
+  return v;
+}
+
+int vtpu_region_try_add(vtpu_shared_region* r, int32_t pid, int dev, int kind,
+                        uint64_t bytes, int oversubscribe) {
+  if (dev < 0 || dev >= VTPU_MAX_DEVICES) return -1;
+  int slot = vtpu_region_register_proc(r, pid, 0);
+  if (slot < 0) return -1;
+  vtpu_region_lock(r);
+  uint64_t limit = r->limit_bytes[dev];
+  if (!oversubscribe && limit > 0 &&
+      device_usage_nolock(r, dev) + bytes > limit) {
+    vtpu_region_unlock(r); /* check_oom: reject (ref add_gpu_device_memory_usage) */
+    return -1;
+  }
+  vtpu_device_usage* u = &r->procs[slot].used[dev];
+  if (kind == 1)
+    u->program_bytes += bytes;
+  else
+    u->buffer_bytes += bytes;
+  u->total_bytes = u->program_bytes + u->buffer_bytes;
+  vtpu_region_unlock(r);
+  return 0;
+}
+
+void vtpu_region_sub(vtpu_shared_region* r, int32_t pid, int dev, int kind,
+                     uint64_t bytes) {
+  if (dev < 0 || dev >= VTPU_MAX_DEVICES) return;
+  vtpu_region_lock(r);
+  for (int i = 0; i < VTPU_MAX_PROCS; i++) {
+    if (r->procs[i].status == 1 && r->procs[i].pid == pid) {
+      vtpu_device_usage* u = &r->procs[i].used[dev];
+      uint64_t* field = (kind == 1) ? &u->program_bytes : &u->buffer_bytes;
+      *field = (*field >= bytes) ? *field - bytes : 0;
+      u->total_bytes = u->program_bytes + u->buffer_bytes;
+      break;
+    }
+  }
+  vtpu_region_unlock(r);
+}

@@ -1,0 +1,109 @@
+/* vtpu shared region — the cross-process accounting fabric.
+ *
+ * TPU rebuild of the reference's mmap'd shared region
+ * (cmd/vGPUmonitor/cudevshr.go:15-72 mirrors the C layout of
+ * libvgpu.so's multiprocess_memory_limit.c).  One file per container
+ * (mounted at /tmp/vtpu/vtpu.cache inside, host path
+ * /usr/local/vtpu/containers/<podUID>_<n>/vtpu.cache), written by the
+ * in-container enforcement shim, read by the node monitor.
+ *
+ * Layout is fixed and mirrored byte-for-byte by
+ * vtpu/monitor/shared_region.py (ctypes); bump VTPU_REGION_VERSION on any
+ * change.  All multi-byte fields are native-endian (region files never
+ * cross hosts).
+ */
+#ifndef VTPU_SHARED_REGION_H_
+#define VTPU_SHARED_REGION_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define VTPU_REGION_MAGIC 0x76545055u /* "vTPU" */
+#define VTPU_REGION_VERSION 1
+#define VTPU_MAX_DEVICES 16
+#define VTPU_MAX_PROCS 64
+#define VTPU_UUID_LEN 64
+
+/* per-process, per-device usage breakdown (ref sharedRegionT.procs[].used:
+ * contextSize/moduleSize/bufferSize → program/buffer on TPU) */
+typedef struct vtpu_device_usage {
+  uint64_t program_bytes; /* compiled executables resident in HBM */
+  uint64_t buffer_bytes;  /* live device buffers */
+  uint64_t total_bytes;   /* program + buffer (denormalised for readers) */
+} vtpu_device_usage;
+
+typedef struct vtpu_proc_slot {
+  int32_t pid;     /* in-container pid */
+  int32_t hostpid; /* host pid (filled by monitor feedback, ref setHostPid) */
+  int32_t status;  /* 0 free, 1 live */
+  int32_t priority; /* TPU_TASK_PRIORITY of this proc (0 high, 1 low) */
+  vtpu_device_usage used[VTPU_MAX_DEVICES];
+} vtpu_proc_slot;
+
+typedef struct vtpu_shared_region {
+  uint32_t magic;
+  uint32_t version;
+  int32_t initialized; /* 1 once init completed (ref initializedFlag) */
+  int32_t owner_pid;   /* pid holding `lock`, for dead-owner recovery
+                          (ref fix_lock_shrreg / CHANGELOG v2.2.7) */
+  int32_t lock;        /* 0 free, 1 held — CAS spinlock */
+  int32_t num_devices;
+  int32_t utilization_switch; /* monitor-written: 0 enforce core limits,
+                                 1 suspend (priority arbitration,
+                                 ref feedback.go CheckPriority) */
+  int32_t recent_kernel; /* decayed activity counter (ref Observe) */
+  char uuids[VTPU_MAX_DEVICES][VTPU_UUID_LEN];
+  uint64_t limit_bytes[VTPU_MAX_DEVICES];   /* HBM quota per device */
+  int32_t core_limit[VTPU_MAX_DEVICES];     /* percent per device */
+  int32_t proc_num;
+  int32_t _pad;
+  uint64_t reserved[8];
+  vtpu_proc_slot procs[VTPU_MAX_PROCS];
+} vtpu_shared_region;
+
+/* ---- lifecycle ---- */
+
+/* mmap (creating + initialising if needed) the region at `path`.
+ * Registration of devices happens on first init from the limit arrays.
+ * Returns NULL on failure. */
+vtpu_shared_region* vtpu_region_open(const char* path);
+int vtpu_region_close(vtpu_shared_region* r);
+
+/* initialise device table (first process wins; later calls validate). */
+int vtpu_region_set_devices(vtpu_shared_region* r, int n,
+                            const char uuids[][VTPU_UUID_LEN],
+                            const uint64_t* limit_bytes,
+                            const int32_t* core_limit);
+
+/* ---- locking (cross-process; dead-owner safe) ---- */
+void vtpu_region_lock(vtpu_shared_region* r);
+void vtpu_region_unlock(vtpu_shared_region* r);
+
+/* ---- process slots ---- */
+/* find-or-create the slot for `pid`; returns slot index or -1. */
+int vtpu_region_register_proc(vtpu_shared_region* r, int32_t pid,
+                              int32_t priority);
+void vtpu_region_unregister_proc(vtpu_shared_region* r, int32_t pid);
+/* reap slots whose pid is gone (ref clear_proc_slot_nolock). */
+void vtpu_region_reap_dead(vtpu_shared_region* r);
+
+/* ---- accounting ---- */
+/* attempt to add `bytes` of `kind` (0=buffer, 1=program) for pid on device
+ * dev; returns 0 on success, -1 if it would exceed limit_bytes[dev]
+ * (the check_oom analog). Oversubscribe mode skips the reject. */
+int vtpu_region_try_add(vtpu_shared_region* r, int32_t pid, int dev, int kind,
+                        uint64_t bytes, int oversubscribe);
+void vtpu_region_sub(vtpu_shared_region* r, int32_t pid, int dev, int kind,
+                     uint64_t bytes);
+/* total usage across procs for device dev (ref get_gpu_memory_usage). */
+uint64_t vtpu_region_device_usage(vtpu_shared_region* r, int dev);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* VTPU_SHARED_REGION_H_ */

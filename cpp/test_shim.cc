@@ -1,0 +1,150 @@
+/* test_shim — drives libvtpu_shim.so wrapped around mock_pjrt.so.
+ *
+ * Exercises the quota-enforcement path end-to-end without hardware:
+ * client create → buffers under quota (ok) → buffer past quota
+ * (RESOURCE_EXHAUSTED from the shim) → destroy frees quota → execute is
+ * paced → MemoryStats reports the quota as the limit.
+ *
+ * Exits 0 on success; prints TAP-ish lines.
+ */
+#include <dlfcn.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#include "pjrt_c_api.h"
+
+#define CHECK(cond, name)                          \
+  do {                                             \
+    if (cond) {                                    \
+      printf("ok - %s\n", name);                   \
+    } else {                                       \
+      printf("not ok - %s\n", name);               \
+      return 1;                                    \
+    }                                              \
+  } while (0)
+
+static const PJRT_Api* api;
+
+static PJRT_Buffer* make_buffer(PJRT_Client* client, PJRT_Device* dev,
+                                int64_t mib, PJRT_Error** err_out) {
+  static int64_t dims[1];
+  dims[0] = mib * 1024 * 1024; /* U8 → bytes */
+  PJRT_Client_BufferFromHostBuffer_Args a;
+  memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  a.client = client;
+  static char byte = 0;
+  a.data = &byte;
+  a.type = PJRT_Buffer_Type_U8;
+  a.dims = dims;
+  a.num_dims = 1;
+  a.device = dev;
+  *err_out = api->PJRT_Client_BufferFromHostBuffer(&a);
+  return a.buffer;
+}
+
+static void destroy_error(PJRT_Error* e) {
+  PJRT_Error_Destroy_Args d;
+  memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+  d.error = e;
+  api->PJRT_Error_Destroy(&d);
+}
+
+int main(int argc, char** argv) {
+  const char* shim = argc > 1 ? argv[1] : "build/libvtpu_shim.so";
+  void* h = dlopen(shim, RTLD_NOW);
+  if (!h) {
+    fprintf(stderr, "dlopen %s: %s\n", shim, dlerror());
+    return 1;
+  }
+  auto get = reinterpret_cast<const PJRT_Api* (*)()>(dlsym(h, "GetPjrtApi"));
+  CHECK(get != nullptr, "shim exports GetPjrtApi");
+  api = get();
+  CHECK(api != nullptr, "GetPjrtApi returns table");
+
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == nullptr, "client create");
+
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = ca.client;
+  CHECK(api->PJRT_Client_AddressableDevices(&da) == nullptr, "devices");
+  CHECK(da.num_addressable_devices >= 1, "at least one device");
+  PJRT_Device* dev0 = da.addressable_devices[0];
+
+  /* quota is TPU_DEVICE_MEMORY_LIMIT_0=64 (MiB) set by the runner */
+  PJRT_Error* err = nullptr;
+  PJRT_Buffer* b1 = make_buffer(ca.client, dev0, 40, &err);
+  CHECK(err == nullptr && b1 != nullptr, "40MiB under 64MiB quota allowed");
+
+  PJRT_Buffer* b2 = make_buffer(ca.client, dev0, 40, &err);
+  CHECK(err != nullptr && b2 == nullptr, "second 40MiB rejected past quota");
+  if (err) {
+    PJRT_Error_GetCode_Args gc;
+    memset(&gc, 0, sizeof(gc));
+    gc.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+    gc.error = err;
+    api->PJRT_Error_GetCode(&gc);
+    CHECK(gc.code == PJRT_Error_Code_RESOURCE_EXHAUSTED,
+          "rejection code is RESOURCE_EXHAUSTED");
+    PJRT_Error_Message_Args m;
+    memset(&m, 0, sizeof(m));
+    m.struct_size = PJRT_Error_Message_Args_STRUCT_SIZE;
+    m.error = err;
+    api->PJRT_Error_Message(&m);
+    CHECK(strstr(m.message, "vtpu") != nullptr, "error message names vtpu");
+    destroy_error(err);
+  }
+
+  /* free the first buffer, then the allocation fits again */
+  PJRT_Buffer_Destroy_Args bd;
+  memset(&bd, 0, sizeof(bd));
+  bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  bd.buffer = b1;
+  CHECK(api->PJRT_Buffer_Destroy(&bd) == nullptr, "destroy frees quota");
+  PJRT_Buffer* b3 = make_buffer(ca.client, dev0, 40, &err);
+  CHECK(err == nullptr && b3 != nullptr, "40MiB fits after free");
+
+  /* memory stats show the QUOTA, not the mock's 16GiB */
+  PJRT_Device_MemoryStats_Args ms;
+  memset(&ms, 0, sizeof(ms));
+  ms.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms.device = dev0;
+  CHECK(api->PJRT_Device_MemoryStats(&ms) == nullptr, "memory stats");
+  CHECK(ms.bytes_limit == 64LL * 1024 * 1024,
+        "bytes_limit reports the 64MiB quota");
+  CHECK(ms.bytes_in_use >= 40LL * 1024 * 1024, "bytes_in_use tracks usage");
+
+  /* compile registers program bytes; execute is paced to the core limit */
+  PJRT_Client_Compile_Args cc;
+  memset(&cc, 0, sizeof(cc));
+  cc.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  cc.client = ca.client;
+  CHECK(api->PJRT_Client_Compile(&cc) == nullptr, "compile");
+
+  PJRT_LoadedExecutable_Execute_Args ea;
+  memset(&ea, 0, sizeof(ea));
+  ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea.executable = cc.executable;
+  struct timespec t0, t1;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  const int kIters = 5;
+  for (int i = 0; i < kIters; i++)
+    CHECK(api->PJRT_LoadedExecutable_Execute(&ea) == nullptr, "execute");
+  clock_gettime(CLOCK_MONOTONIC, &t1);
+  double total_ms = (t1.tv_sec - t0.tv_sec) * 1e3 +
+                    (t1.tv_nsec - t0.tv_nsec) / 1e6;
+  /* mock exec = 1ms; TPU_DEVICE_CORES_LIMIT=25 → ≥4ms/iter expected */
+  double per = total_ms / kIters;
+  CHECK(per >= 3.0, "execute paced to ~25% duty cycle");
+
+  printf("# per-execute %.2f ms (mock work 1 ms, quota 25%%)\n", per);
+  printf("all shim tests passed\n");
+  return 0;
+}

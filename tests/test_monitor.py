@@ -1,0 +1,203 @@
+"""Monitor tests: pathmonitor scan/GC, metrics exposition, feedback
+arbiter, and the cooperative shim runtime quota semantics."""
+
+import os
+import time
+import urllib.request
+
+import pytest
+
+from vtpu.monitor.feedback import observe_once
+from vtpu.monitor.metrics import render_node_metrics, serve_metrics
+from vtpu.monitor.pathmonitor import REGION_FILENAME, PathMonitor
+from vtpu.monitor.shared_region import RegionFile
+from vtpu.shim import QuotaExceeded, ShimRuntime
+
+
+def make_container_region(root, pod_uid, n="0", uuids=("tpu-0",), limit_mb=100,
+                          pid=100, used_mb=10, priority=0):
+    d = os.path.join(root, f"{pod_uid}_{n}")
+    os.makedirs(d, exist_ok=True)
+    r = RegionFile(os.path.join(d, REGION_FILENAME), create=True)
+    r.set_devices(list(uuids), [limit_mb << 20] * len(uuids), [50] * len(uuids))
+    r.register_proc(pid, priority)
+    r.add_usage(pid, 0, used_mb << 20)
+    r.close()
+    return d
+
+
+# -- pathmonitor ----------------------------------------------------------
+
+
+def test_pathmonitor_picks_up_and_drops(tmp_path):
+    root = str(tmp_path)
+    make_container_region(root, "pod-aaa")
+    pm = PathMonitor(root)
+    entries = pm.scan()
+    assert "pod-aaa_0" in entries and entries["pod-aaa_0"].region is not None
+    assert entries["pod-aaa_0"].pod_uid == "pod-aaa"
+    # dir removed externally → entry dropped
+    import shutil
+
+    shutil.rmtree(os.path.join(root, "pod-aaa_0"))
+    assert "pod-aaa_0" not in pm.scan()
+    pm.close()
+
+
+def test_pathmonitor_gc_stale(tmp_path):
+    root = str(tmp_path)
+    d = make_container_region(root, "pod-gone")
+    old = time.time() - 1000
+    os.utime(d, (old, old))
+    pm = PathMonitor(root)
+    pm.scan(known_pod_uids=set())  # pod no longer exists, dir stale → GC
+    assert not os.path.exists(d)
+    # a FRESH dir whose pod is gone is kept (grace period, ref :83-92)
+    d2 = make_container_region(root, "pod-fresh")
+    pm.scan(known_pod_uids=set())
+    assert os.path.exists(d2)
+    pm.close()
+
+
+# -- metrics --------------------------------------------------------------
+
+
+def test_node_metrics_renders_usage_and_violations(tmp_path):
+    root = str(tmp_path)
+    make_container_region(root, "pod-1", used_mb=10, limit_mb=100)
+    make_container_region(root, "pod-2", n="1", used_mb=120, limit_mb=100)  # violation
+    pm = PathMonitor(root)
+    pods = {
+        "pod-1": {"metadata": {"name": "w1", "namespace": "ns", "uid": "pod-1"}},
+        "pod-2": {"metadata": {"name": "w2", "namespace": "ns", "uid": "pod-2"}},
+    }
+    text = render_node_metrics(pm, provider=None, pods_by_uid=pods)
+    assert 'vtpu_container_device_memory_usage_bytes{ctr="pod-1_0"' in text
+    assert f'{10 << 20}' in text
+    viol = [
+        l for l in text.splitlines()
+        if l.startswith("vtpu_container_quota_violation") and l.endswith(" 1")
+    ]
+    assert len(viol) == 1 and "pod-2" in viol[0]
+    pm.close()
+
+
+def test_metrics_http_server(tmp_path):
+    root = str(tmp_path)
+    make_container_region(root, "pod-h")
+    pm = PathMonitor(root)
+    srv, _ = serve_metrics(pm, bind="127.0.0.1:0")
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "vtpu_container_device_memory_usage_bytes" in text
+    srv.shutdown()
+    pm.close()
+
+
+# -- feedback arbiter -----------------------------------------------------
+
+
+def test_feedback_suspends_high_priority_throttle(tmp_path):
+    root = str(tmp_path)
+    make_container_region(root, "pod-hi", pid=11, priority=0)
+    make_container_region(root, "pod-lo", n="1", pid=22, priority=1)
+    pm = PathMonitor(root)
+    pm.scan()
+    # mark the high-priority region active
+    hi = pm.entries["pod-hi_0"].region
+    hi.region.recent_kernel = 10
+    observe_once(pm)
+    assert hi.region.utilization_switch == 1  # unthrottled
+    lo = pm.entries["pod-lo_1"].region
+    assert lo.region.utilization_switch == 0  # still enforced
+    # activity decays → switch drops back
+    observe_once(pm)
+    observe_once(pm)
+    observe_once(pm)
+    assert hi.region.utilization_switch == 0
+    pm.close()
+
+
+# -- cooperative shim runtime ---------------------------------------------
+
+
+def test_shim_runtime_quota(tmp_path):
+    rt = ShimRuntime(
+        limits_bytes=[50 << 20],
+        core_limit=100,
+        region_path=str(tmp_path / "rt.cache"),
+        uuids=["tpu-0"],
+    )
+    rt.try_alloc(40 << 20)
+    with pytest.raises(QuotaExceeded):
+        rt.try_alloc(20 << 20)
+    rt.free(30 << 20)
+    rt.try_alloc(20 << 20)  # fits after free
+    stats = rt.memory_stats()
+    assert stats["bytes_limit"] == 50 << 20
+    assert stats["bytes_in_use"] == 30 << 20
+    rt.close()
+
+
+def test_shim_runtime_two_tenants_share_region(tmp_path):
+    path = str(tmp_path / "share.cache")
+    a = ShimRuntime(limits_bytes=[100 << 20], region_path=path, uuids=["tpu-0"], pid=1)
+    b = ShimRuntime(limits_bytes=[100 << 20], region_path=path, uuids=["tpu-0"], pid=2)
+    a.try_alloc(60 << 20)
+    with pytest.raises(QuotaExceeded):
+        b.try_alloc(60 << 20)  # sees tenant a's usage through the region
+    b.try_alloc(30 << 20)
+    a.close()
+    b.close()
+
+
+def test_shim_runtime_oversubscribe(tmp_path):
+    rt = ShimRuntime(
+        limits_bytes=[10 << 20],
+        region_path=str(tmp_path / "ov.cache"),
+        uuids=["tpu-0"],
+        oversubscribe=True,
+    )
+    rt.try_alloc(50 << 20)  # no reject in oversubscribe mode
+    rt.close()
+
+
+def test_shim_runtime_throttle_paces(tmp_path):
+    rt = ShimRuntime(
+        limits_bytes=[], core_limit=25, region_path=str(tmp_path / "t.cache")
+    )
+    # use a plain sleepy function: 10ms work → ≥40ms per call at 25%
+    def work():
+        time.sleep(0.01)
+        return 42
+
+    paced = rt.throttled(work)
+    t0 = time.monotonic()
+    assert paced() == 42
+    dt = time.monotonic() - t0
+    assert dt >= 0.035
+
+
+# -- node RPC -------------------------------------------------------------
+
+
+def test_noderpc_serves_usage(tmp_path):
+    import grpc
+
+    from vtpu.monitor import noderpc_pb2 as pb
+    from vtpu.monitor.noderpc import NodeVtpuStub, serve_noderpc
+
+    root = str(tmp_path)
+    make_container_region(root, "pod-rpc", used_mb=12, limit_mb=64)
+    pm = PathMonitor(root)
+    server, port = serve_noderpc(pm, bind="127.0.0.1:0")
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        reply = NodeVtpuStub(ch).GetNodeVtpu(pb.GetNodeVtpuRequest(), timeout=10)
+    assert len(reply.containers) == 1
+    c = reply.containers[0]
+    assert c.pod_uid == "pod-rpc"
+    assert c.devices[0].used_bytes == 12 << 20
+    assert c.devices[0].limit_bytes == 64 << 20
+    server.stop(grace=None)
+    pm.close()

@@ -243,3 +243,63 @@ def test_restart_guard():
     srv = PluginServer(VtpuDevicePlugin(FakeClient(), cache, cfg), cfg)
     assert all(srv.allow_restart() for _ in range(5))
     assert not srv.allow_restart()  # 6th within the hour refused
+
+
+# -- review regressions ---------------------------------------------------
+
+
+def test_allocate_empty_request_invalid(rig):
+    *_, stub = rig
+    with pytest.raises(grpc.RpcError) as ei:
+        stub.Allocate(pb.AllocateRequest(), timeout=5)
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_allocate_creates_host_dirs(rig):
+    client, provider, cfg, cache, servicer, srv, stub = rig
+    import os
+
+    register_once(client, cache, cfg)
+    sched = Scheduler(client)
+    sched.register_from_node_annotations()
+    pod = client.create_pod(tpu_pod_spec("dirs"))
+    sched.filter(pod, ["tpu-node"])
+    sched.bind("default", "dirs", "tpu-node")
+    assigned = codec.decode_pod_devices(
+        get_annotations(client.get_pod("default", "dirs"))[annotations.DEVICES_TO_ALLOCATE]
+    )
+    req = pb.AllocateRequest()
+    req.container_requests.append(
+        pb.ContainerAllocateRequest(
+            devicesIDs=[split_device_ids(assigned[0][0].uuid, cfg.device_split_count)[0]]
+        )
+    )
+    resp = stub.Allocate(req, timeout=5)
+    mounts = {m.container_path: m.host_path for m in resp.container_responses[0].mounts}
+    host_cache = mounts["/tmp/vtpu"]
+    assert os.path.isdir(host_cache)  # exists before kubelet bind-mounts
+    uid = pod["metadata"]["uid"]
+    assert host_cache.endswith(f"{uid}_0")
+
+
+def test_preferred_allocation_anchors_on_must_include(rig):
+    *_, stub = rig
+    # pin the chip at (0,0); available others across the 2x2 grid
+    must = [split_device_ids("fake-tpu-0", 1)[0]]
+    avail = must + [split_device_ids(u, 1)[0] for u in
+                    ("fake-tpu-1", "fake-tpu-2", "fake-tpu-3")]
+    req = pb.PreferredAllocationRequest()
+    req.container_requests.append(
+        pb.ContainerPreferredAllocationRequest(
+            available_deviceIDs=avail,
+            must_include_deviceIDs=must,
+            allocation_size=2,
+        )
+    )
+    resp = stub.GetPreferredAllocation(req, timeout=5)
+    ids = list(resp.container_responses[0].deviceIDs)
+    assert len(ids) == len(set(ids)) == 2
+    chosen = {fake_id_to_uuid(i) for i in ids}
+    assert "fake-tpu-0" in chosen
+    # (0,0) anchors → partner must be ICI-adjacent: (1,0)=tpu-1 or (0,1)=tpu-2
+    assert chosen in ({"fake-tpu-0", "fake-tpu-1"}, {"fake-tpu-0", "fake-tpu-2"})

@@ -367,3 +367,19 @@ def test_concurrent_filters_no_double_booking():
     [t.join() for t in ts]
     winners = [r for r in results if r.node == "n1"]
     assert len(winners) == 1  # 60% + 60% > 100% — only one may fit
+
+
+def test_bind_failure_unbooks_capacity():
+    """Other pods must see the capacity a bind-failed pod was holding."""
+    c = FakeClient()
+    register_node(c, n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    a = c.create_pod(tpu_pod("hog", pct=100))
+    assert s.filter(a, ["n1"]).node == "n1"
+    # bind fails: pod vanished between filter and bind
+    c.delete_pod("default", "hog")
+    assert s.bind("default", "hog", "n1") is not None
+    s.ingest_pods()
+    b = c.create_pod(tpu_pod("next", pct=100))
+    assert s.filter(b, ["n1"]).node == "n1"  # capacity visible again

@@ -62,14 +62,39 @@ def _frag_score(topo: Topology, avail_after: FrozenSet[Coord]) -> int:
 
 
 def _connected_greedy(
-    topo: Topology, available: List[Coord], size: int
+    topo: Topology, available: List[Coord], size: int,
+    seeds: Optional[List[Coord]] = None,
 ) -> Optional[List[Coord]]:
     """Best-effort fallback: grow a connected set from each seed, pick the
     one with the best adjacency density (ref default.go first-N fallback,
-    improved: the reference takes an arbitrary N, we keep ICI locality)."""
+    improved: the reference takes an arbitrary N, we keep ICI locality).
+    ``seeds`` restricts the starting points (pinned must-include chips)."""
     avail = set(available)
     best: Optional[List[Coord]] = None
     best_links = -1
+    if seeds:
+        # pinned chips: grow one set containing ALL of them
+        grown = [c for c in seeds if c in avail]
+        if len(grown) > size:
+            return None
+        frontier = set()
+        for c in grown:
+            frontier |= set(topo.neighbors(c)) & avail
+        frontier -= set(grown)
+        while len(grown) < size and frontier:
+            nxt = max(
+                sorted(frontier),
+                key=lambda c: sum(1 for n in topo.neighbors(c) if n in grown),
+            )
+            grown.append(nxt)
+            frontier |= set(topo.neighbors(nxt)) & avail
+            frontier -= set(grown)
+        if len(grown) == size:
+            return grown
+        # pinned chips may be isolated: pad with remaining nearest coords
+        rest = sorted(avail - set(grown))
+        grown += rest[: size - len(grown)]
+        return grown if len(grown) == size else None
     for seed in sorted(avail):
         grown = [seed]
         frontier = set(topo.neighbors(seed)) & avail
@@ -104,30 +129,43 @@ class IciAllocator:
         self.topo = topo
         self.policy = policy
 
-    def allocate(self, available: Sequence[Chip], size: int) -> List[Chip]:
-        """Pick ``size`` chips from ``available``.
-
-        Returns the chosen chips; raises AllocationError per policy gates.
-        """
-        if size <= 0:
-            return []
-        healthy = [c for c in available if c.healthy]
-        if len(healthy) < size:
-            raise AllocationError(f"need {size} chips, {len(healthy)} available")
+    def allocate(
+        self,
+        available: Sequence[Chip],
+        size: int,
+        must_include: Sequence[Chip] = (),
+    ) -> List[Chip]:
+        """Pick ``size`` chips from ``available`` (plus ``must_include``,
+        which are pinned into the result — the GetPreferredAllocation
+        contract: the rectangle must be anchored on them, not computed
+        beside them).  Raises AllocationError per policy gates."""
+        must = list(must_include)
+        if size <= len(must):
+            return must[:size]
+        healthy = [c for c in available if c.healthy and c not in must]
+        if len(healthy) + len(must) < size:
+            raise AllocationError(
+                f"need {size} chips, {len(healthy) + len(must)} available"
+            )
         by_coord: Dict[Coord, Chip] = {}
         coordless: List[Chip] = []
-        for c in healthy:
+        for c in list(must) + healthy:
             if c.coords is not None:
                 by_coord[tuple(c.coords)] = c
             else:
                 coordless.append(c)
+        must_coords = frozenset(
+            tuple(c.coords) for c in must if c.coords is not None
+        )
         if not by_coord:
             # no topology info at all — plain first-N (single-chip hosts)
-            return sorted(coordless, key=lambda c: c.index)[:size]
+            return (must + sorted(coordless, key=lambda c: c.index))[:size]
 
         avail_coords = frozenset(by_coord)
         candidates: List[Tuple[tuple, FrozenSet[Coord]]] = []
         for offset, shape, coords in enumerate_rectangles(self.topo, size, avail_coords):
+            if not must_coords <= coords:
+                continue  # rectangle must contain every pinned chip
             remaining = avail_coords - coords
             key = (
                 -ring_count(shape),
@@ -149,7 +187,8 @@ class IciAllocator:
             raise AllocationError(
                 f"policy {self.policy}: no ICI-contiguous {size}-chip rectangle free"
             )
-        grown = _connected_greedy(self.topo, sorted(avail_coords), size)
+        seeds = sorted(must_coords) if must_coords else None
+        grown = _connected_greedy(self.topo, sorted(avail_coords), size, seeds=seeds)
         if grown is None:
             raise AllocationError(f"cannot assemble {size} chips")
         log.info("best-effort non-rectangular gang: %s", grown)

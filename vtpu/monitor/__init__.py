@@ -1,0 +1,9 @@
+"""Node monitor (ref: cmd/vGPUmonitor).
+
+Reads the mmap'd shared regions written by the in-container shim, exports
+per-container Prometheus metrics on :9394, GCs stale container dirs, and
+runs the priority feedback arbiter (which the reference ships disabled).
+"""
+
+from vtpu.monitor.pathmonitor import PathMonitor  # noqa: F401
+from vtpu.monitor.shared_region import RegionFile, open_region  # noqa: F401

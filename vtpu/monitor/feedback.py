@@ -1,0 +1,65 @@
+"""Priority feedback arbiter (ref: cmd/vGPUmonitor/feedback.go:164-254).
+
+The reference ships this disabled (main.go:26 comments out watchAndFeedback)
+— we ship it working: every tick the arbiter decays each region's
+``recent_kernel`` activity counter and flips ``utilization_switch`` so that
+when any HIGH-priority (priority 0) process was recently active, LOW-priority
+regions get their core throttling *tightened* (switch stays 0 = enforce) and
+high-priority regions get their throttle suspended (switch 1).  When no
+high-priority work is active, everyone's limits enforce normally.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Iterable
+
+from vtpu.monitor.pathmonitor import PathMonitor
+
+log = logging.getLogger(__name__)
+
+ACTIVITY_THRESHOLD = 1  # recent_kernel above this = "recently active"
+
+
+def observe_once(pathmon: PathMonitor) -> None:
+    """One arbitration pass (ref Observe + CheckPriority feedback.go:164-222)."""
+    entries = [e for e in pathmon.entries.values() if e.region is not None]
+    # classify regions by the min priority of their live procs (0 = high)
+    high_active = False
+    activity = {}
+    for e in entries:
+        act = e.region.decay_recent_kernel()
+        procs = e.region.live_procs()
+        prio = min((p["priority"] for p in procs), default=1)
+        activity[e.dirname] = (act, prio)
+        if prio == 0 and act > ACTIVITY_THRESHOLD:
+            high_active = True
+    for e in entries:
+        act, prio = activity[e.dirname]
+        if prio == 0 and high_active:
+            # high-priority task running: it gets unthrottled
+            e.region.set_utilization_switch(1)
+        else:
+            e.region.set_utilization_switch(0)
+
+
+class FeedbackLoop:
+    def __init__(self, pathmon: PathMonitor, interval_s: float = 5.0) -> None:
+        self.pathmon = pathmon
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        def loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.pathmon.scan()
+                    observe_once(self.pathmon)
+                except Exception:  # noqa: BLE001
+                    log.exception("feedback pass failed")
+
+        threading.Thread(target=loop, name="vtpu-feedback", daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()

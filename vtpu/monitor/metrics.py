@@ -1,0 +1,143 @@
+"""Node-monitor Prometheus exporter (ref: cmd/vGPUmonitor/metrics.go:140-246).
+
+Serves :9394/metrics — host chip stats from the device provider plus
+per-container real usage read from the shared regions.  This is where the
+BASELINE "HBM-quota violations" metric comes from: usage > limit in any
+region is a violation.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from vtpu.monitor.pathmonitor import PathMonitor
+
+log = logging.getLogger(__name__)
+
+_MB = 1024 * 1024
+
+
+def _esc(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_node_metrics(
+    pathmon: PathMonitor,
+    provider=None,
+    pods_by_uid: Optional[Dict[str, dict]] = None,
+) -> str:
+    lines: List[str] = []
+
+    def gauge(name: str, help_: str, samples: List[Tuple[dict, float]]) -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        for labels, value in samples:
+            lbl = ",".join(f'{k}="{_esc(str(v))}"' for k, v in labels.items())
+            lines.append(f"{name}{{{lbl}}} {value}")
+
+    # host-level chip inventory (ref HostGPUMemoryUsage/HostCoreUtilization)
+    host_mem = []
+    if provider is not None:
+        for chip in provider.enumerate():
+            host_mem.append(
+                ({"deviceuuid": chip.uuid, "devicetype": chip.model},
+                 chip.hbm_mb * _MB)
+            )
+    gauge("vtpu_host_device_memory_bytes", "Physical HBM per local chip", host_mem)
+
+    usage_s, limit_s, breakdown_s, violation_s = [], [], [], []
+    entries = pathmon.scan(
+        set(pods_by_uid) if pods_by_uid is not None else None
+    )
+    for name, entry in sorted(entries.items()):
+        if entry.region is None:
+            continue
+        pod = (pods_by_uid or {}).get(entry.pod_uid, {})
+        podname = pod.get("metadata", {}).get("name", "")
+        podns = pod.get("metadata", {}).get("namespace", "")
+        uuids = entry.region.device_uuids()
+        limits = entry.region.limits()
+        usage = entry.region.usage()
+        for i, uuid in enumerate(uuids):
+            labels = {
+                "ctr": name,
+                "podname": podname,
+                "podnamespace": podns,
+                "vdeviceid": i,
+                "deviceuuid": uuid,
+            }
+            usage_s.append((labels, usage[i]["total"]))
+            limit_s.append((labels, limits[i]))
+            for kind in ("buffer", "program"):
+                breakdown_s.append((dict(labels, kind=kind), usage[i][kind]))
+            violation_s.append(
+                (labels, 1 if limits[i] and usage[i]["total"] > limits[i] else 0)
+            )
+    gauge(
+        "vtpu_container_device_memory_usage_bytes",
+        "Real per-container per-vdevice HBM usage (ref vGPU_device_memory_usage_in_bytes)",
+        usage_s,
+    )
+    gauge(
+        "vtpu_container_device_memory_limit_bytes",
+        "Per-container per-vdevice HBM quota (ref vGPU_device_memory_limit_in_bytes)",
+        limit_s,
+    )
+    gauge(
+        "vtpu_container_device_memory_breakdown_bytes",
+        "Usage split by kind (ref Device_memory_desc_of_container)",
+        breakdown_s,
+    )
+    gauge(
+        "vtpu_container_quota_violation",
+        "1 when a container exceeds its HBM quota (BASELINE acceptance metric)",
+        violation_s,
+    )
+    return "\n".join(lines) + "\n"
+
+
+def serve_metrics(
+    pathmon: PathMonitor,
+    provider=None,
+    pods_fn=None,
+    bind: str = "0.0.0.0:9394",
+) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """ref metrics.go — :9394/metrics endpoint."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                body = b"ok"
+                ctype = "text/plain"
+            elif self.path == "/metrics":
+                try:
+                    pods = pods_fn() if pods_fn else None
+                    body = render_node_metrics(pathmon, provider, pods).encode()
+                    ctype = "text/plain; version=0.0.4"
+                except Exception as e:  # noqa: BLE001
+                    log.exception("metrics render failed")
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+                    return
+            else:
+                self.send_response(404)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # quiet
+            log.debug("monitor http: " + fmt, *args)
+
+    host, _, port = bind.rpartition(":")
+    srv = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)
+    t = threading.Thread(target=srv.serve_forever, name="vtpu-monitor-http", daemon=True)
+    t.start()
+    return srv, t

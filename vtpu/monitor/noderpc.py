@@ -1,0 +1,88 @@
+"""Node info gRPC service (ref: cmd/vGPUmonitor/noderpc + pathmonitor.go:116-140).
+
+The reference registers this server with unimplemented methods; vtpu serves
+real data from the shared regions.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent import futures
+from typing import Optional, Tuple
+
+import grpc
+
+from vtpu.monitor import noderpc_pb2 as pb
+from vtpu.monitor.pathmonitor import PathMonitor
+
+log = logging.getLogger(__name__)
+
+SERVICE = "vtpunoderpc.NodeVtpuInfo"
+
+
+def _container_usage(entry) -> pb.ContainerUsage:
+    cu = pb.ContainerUsage(ctr_id=entry.dirname, pod_uid=entry.pod_uid)
+    r = entry.region
+    if r is None:
+        return cu
+    uuids = r.device_uuids()
+    limits = r.limits()
+    cores = r.core_limits()
+    usage = r.usage()
+    for i, uuid in enumerate(uuids):
+        cu.devices.append(
+            pb.DeviceUsage(
+                uuid=uuid,
+                limit_bytes=limits[i],
+                used_bytes=usage[i]["total"],
+                buffer_bytes=usage[i]["buffer"],
+                program_bytes=usage[i]["program"],
+                core_limit=cores[i],
+            )
+        )
+    cu.proc_num = len(r.live_procs())
+    return cu
+
+
+class NodeVtpuServicer:
+    def __init__(self, pathmon: PathMonitor) -> None:
+        self.pathmon = pathmon
+
+    def GetNodeVtpu(self, request, context):  # noqa: N802
+        reply = pb.NodeVtpuReply()
+        entries = self.pathmon.scan()
+        for name, entry in sorted(entries.items()):
+            if request.ctr_id and name != request.ctr_id:
+                continue
+            reply.containers.append(_container_usage(entry))
+        return reply
+
+
+def serve_noderpc(
+    pathmon: PathMonitor, bind: str = "0.0.0.0:9395"
+) -> Tuple[grpc.Server, int]:
+    """Returns (server, bound_port) — port matters when binding :0."""
+    servicer = NodeVtpuServicer(pathmon)
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    handlers = {
+        "GetNodeVtpu": grpc.unary_unary_rpc_method_handler(
+            servicer.GetNodeVtpu,
+            request_deserializer=pb.GetNodeVtpuRequest.FromString,
+            response_serializer=pb.NodeVtpuReply.SerializeToString,
+        )
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+    )
+    port = server.add_insecure_port(bind)
+    server.start()
+    return server, port
+
+
+class NodeVtpuStub:
+    def __init__(self, channel: grpc.Channel) -> None:
+        self.GetNodeVtpu = channel.unary_unary(
+            f"/{SERVICE}/GetNodeVtpu",
+            request_serializer=pb.GetNodeVtpuRequest.SerializeToString,
+            response_deserializer=pb.NodeVtpuReply.FromString,
+        )

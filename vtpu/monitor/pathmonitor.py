@@ -1,0 +1,84 @@
+"""Cache-dir scanner and GC (ref: cmd/vGPUmonitor/pathmonitor.go:29-114).
+
+Walks /usr/local/vtpu/containers/<podUID>_<n>/, mmaps each vtpu.cache into
+a RegionFile, validates the owning pod still exists, and GCs dirs whose pod
+is gone and whose mtime is stale (300 s).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import time
+from typing import Dict, Optional
+
+from vtpu.monitor.shared_region import RegionFile, open_region
+
+log = logging.getLogger(__name__)
+
+GC_GRACE_S = 300  # ref pathmonitor.go:83-92
+REGION_FILENAME = "vtpu.cache"
+
+
+class ContainerEntry:
+    def __init__(self, dirname: str, path: str, region: Optional[RegionFile]) -> None:
+        self.dirname = dirname          # "<podUID>_<n>"
+        self.path = path
+        self.region = region
+
+    @property
+    def pod_uid(self) -> str:
+        return self.dirname.rsplit("_", 1)[0]
+
+
+class PathMonitor:
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.entries: Dict[str, ContainerEntry] = {}
+
+    def scan(self, known_pod_uids: Optional[set] = None) -> Dict[str, ContainerEntry]:
+        """One monitorpath pass (ref :72-114): pick up new dirs, drop+GC
+        stale ones.  ``known_pod_uids`` of None skips pod validation."""
+        if not os.path.isdir(self.root):
+            return self.entries
+        seen = set()
+        for name in sorted(os.listdir(self.root)):
+            d = os.path.join(self.root, name)
+            if not os.path.isdir(d):
+                continue
+            seen.add(name)
+            if name not in self.entries:
+                cache = os.path.join(d, REGION_FILENAME)
+                region = open_region(cache) if os.path.exists(cache) else None
+                self.entries[name] = ContainerEntry(name, d, region)
+                if region:
+                    log.info("monitoring new container region %s", name)
+            elif self.entries[name].region is None:
+                # region file may appear after the dir (mount then first touch)
+                cache = os.path.join(d, REGION_FILENAME)
+                if os.path.exists(cache):
+                    self.entries[name].region = open_region(cache)
+            if known_pod_uids is not None:
+                entry = self.entries[name]
+                if entry.pod_uid not in known_pod_uids:
+                    age = time.time() - os.path.getmtime(d)
+                    if age > GC_GRACE_S:
+                        log.info("GC stale container dir %s (age %.0fs)", name, age)
+                        if entry.region:
+                            entry.region.close()
+                        shutil.rmtree(d, ignore_errors=True)
+                        self.entries.pop(name, None)
+                        seen.discard(name)
+        for name in list(self.entries):
+            if name not in seen:
+                e = self.entries.pop(name)
+                if e.region:
+                    e.region.close()
+        return self.entries
+
+    def close(self) -> None:
+        for e in self.entries.values():
+            if e.region:
+                e.region.close()
+        self.entries.clear()

@@ -1,0 +1,215 @@
+"""ctypes mirror of the shared region (cpp/shared_region.h).
+
+The monitor reads regions written by the in-container shim, exactly like
+the reference's Go mirror of the C layout (cmd/vGPUmonitor/cudevshr.go:15-72
+mirroring libvgpu.so's struct).  Layout must match cpp/shared_region.h
+byte-for-byte — guarded by tests/test_region.py which round-trips a region
+file through the C `region_tool`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+from typing import Dict, List, Optional
+
+VTPU_REGION_MAGIC = 0x76545055
+VTPU_REGION_VERSION = 1
+MAX_DEVICES = 16
+MAX_PROCS = 64
+UUID_LEN = 64
+
+
+class DeviceUsage(ctypes.Structure):
+    _fields_ = [
+        ("program_bytes", ctypes.c_uint64),
+        ("buffer_bytes", ctypes.c_uint64),
+        ("total_bytes", ctypes.c_uint64),
+    ]
+
+
+class ProcSlot(ctypes.Structure):
+    _fields_ = [
+        ("pid", ctypes.c_int32),
+        ("hostpid", ctypes.c_int32),
+        ("status", ctypes.c_int32),
+        ("priority", ctypes.c_int32),
+        ("used", DeviceUsage * MAX_DEVICES),
+    ]
+
+
+class SharedRegion(ctypes.Structure):
+    _fields_ = [
+        ("magic", ctypes.c_uint32),
+        ("version", ctypes.c_uint32),
+        ("initialized", ctypes.c_int32),
+        ("owner_pid", ctypes.c_int32),
+        ("lock", ctypes.c_int32),
+        ("num_devices", ctypes.c_int32),
+        ("utilization_switch", ctypes.c_int32),
+        ("recent_kernel", ctypes.c_int32),
+        ("uuids", (ctypes.c_char * UUID_LEN) * MAX_DEVICES),
+        ("limit_bytes", ctypes.c_uint64 * MAX_DEVICES),
+        ("core_limit", ctypes.c_int32 * MAX_DEVICES),
+        ("proc_num", ctypes.c_int32),
+        ("_pad", ctypes.c_int32),
+        ("reserved", ctypes.c_uint64 * 8),
+        ("procs", ProcSlot * MAX_PROCS),
+    ]
+
+
+REGION_SIZE = ctypes.sizeof(SharedRegion)
+
+
+class RegionFile:
+    """mmap a region file read-write (ref mmapcachefile cudevshr.go:112-127).
+    The monitor only mutates utilization_switch / hostpid fields."""
+
+    def __init__(self, path: str, create: bool = False) -> None:
+        self.path = path
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        fd = os.open(path, flags, 0o666)
+        try:
+            if os.fstat(fd).st_size < REGION_SIZE:
+                if not create:
+                    raise ValueError(f"{path}: too small for a vtpu region")
+                os.ftruncate(fd, REGION_SIZE)
+            self._mm = mmap.mmap(fd, REGION_SIZE)
+        finally:
+            os.close(fd)
+        self.region = SharedRegion.from_buffer(self._mm)
+        if create and self.region.magic == 0:
+            self.region.magic = VTPU_REGION_MAGIC
+            self.region.version = VTPU_REGION_VERSION
+            self.region.initialized = 1
+        magic, version = self.region.magic, self.region.version
+        if magic != VTPU_REGION_MAGIC:
+            self.close()
+            raise ValueError(f"{path}: bad magic {magic:#x}")
+        if version != VTPU_REGION_VERSION:
+            self.close()
+            raise ValueError(f"{path}: region version {version}")
+
+    # -- read side -------------------------------------------------------
+    def device_uuids(self) -> List[str]:
+        r = self.region
+        return [r.uuids[i].value.decode() for i in range(r.num_devices)]
+
+    def limits(self) -> List[int]:
+        r = self.region
+        return [r.limit_bytes[i] for i in range(r.num_devices)]
+
+    def core_limits(self) -> List[int]:
+        r = self.region
+        return [r.core_limit[i] for i in range(r.num_devices)]
+
+    def usage(self) -> List[Dict[str, int]]:
+        """Per-device totals across live procs (ref getvGPUMemoryInfo)."""
+        r = self.region
+        out = []
+        for d in range(r.num_devices):
+            buf = prog = 0
+            for p in range(MAX_PROCS):
+                if r.procs[p].status == 1:
+                    buf += r.procs[p].used[d].buffer_bytes
+                    prog += r.procs[p].used[d].program_bytes
+            out.append({"buffer": buf, "program": prog, "total": buf + prog})
+        return out
+
+    def live_procs(self) -> List[Dict[str, int]]:
+        r = self.region
+        out = []
+        for p in range(MAX_PROCS):
+            slot = r.procs[p]
+            if slot.status == 1:
+                out.append(
+                    {
+                        "pid": slot.pid,
+                        "hostpid": slot.hostpid,
+                        "priority": slot.priority,
+                        "total_bytes": sum(
+                            slot.used[d].total_bytes for d in range(r.num_devices)
+                        ),
+                    }
+                )
+        return out
+
+    # -- monitor write side ---------------------------------------------
+    def set_utilization_switch(self, value: int) -> None:
+        self.region.utilization_switch = value
+
+    def set_hostpid(self, pid: int, hostpid: int) -> None:
+        r = self.region
+        for p in range(MAX_PROCS):
+            if r.procs[p].status == 1 and r.procs[p].pid == pid:
+                r.procs[p].hostpid = hostpid
+
+    def decay_recent_kernel(self) -> int:
+        """ref Observe (feedback.go): halve the activity counter, return the
+        pre-decay value."""
+        v = self.region.recent_kernel
+        self.region.recent_kernel = v // 2
+        return v
+
+    # -- writer side (used by the cooperative Python shim) ----------------
+    def set_devices(self, uuids: List[str], limits: List[int], cores: List[int]) -> None:
+        r = self.region
+        if r.num_devices == 0:
+            n = min(len(uuids), MAX_DEVICES)
+            r.num_devices = n
+            for i in range(n):
+                r.uuids[i].value = uuids[i].encode()[: UUID_LEN - 1]
+                r.limit_bytes[i] = limits[i]
+                r.core_limit[i] = cores[i]
+
+    def register_proc(self, pid: int, priority: int = 0) -> int:
+        r = self.region
+        for p in range(MAX_PROCS):
+            if r.procs[p].status == 1 and r.procs[p].pid == pid:
+                return p
+        for p in range(MAX_PROCS):
+            if r.procs[p].status == 0:
+                ctypes.memset(ctypes.byref(r.procs[p]), 0, ctypes.sizeof(ProcSlot))
+                r.procs[p].pid = pid
+                r.procs[p].status = 1
+                r.procs[p].priority = priority
+                r.proc_num += 1
+                return p
+        return -1
+
+    def add_usage(self, pid: int, dev: int, bytes_: int, kind: str = "buffer") -> None:
+        r = self.region
+        for p in range(MAX_PROCS):
+            if r.procs[p].status == 1 and r.procs[p].pid == pid:
+                u = r.procs[p].used[dev]
+                if kind == "program":
+                    u.program_bytes += bytes_
+                else:
+                    u.buffer_bytes += bytes_
+                u.total_bytes = u.program_bytes + u.buffer_bytes
+                return
+
+    def sub_usage(self, pid: int, dev: int, bytes_: int, kind: str = "buffer") -> None:
+        r = self.region
+        for p in range(MAX_PROCS):
+            if r.procs[p].status == 1 and r.procs[p].pid == pid:
+                u = r.procs[p].used[dev]
+                if kind == "program":
+                    u.program_bytes = max(0, u.program_bytes - bytes_)
+                else:
+                    u.buffer_bytes = max(0, u.buffer_bytes - bytes_)
+                u.total_bytes = u.program_bytes + u.buffer_bytes
+                return
+
+    def close(self) -> None:
+        # release the ctypes view before unmapping
+        self.region = None  # type: ignore[assignment]
+        self._mm.close()
+
+
+def open_region(path: str, create: bool = False) -> Optional[RegionFile]:
+    try:
+        return RegionFile(path, create=create)
+    except (OSError, ValueError):
+        return None

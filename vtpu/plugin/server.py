@@ -107,25 +107,29 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
             for fid in creq.available_deviceIDs:
                 per_chip.setdefault(fake_id_to_uuid(fid), []).append(fid)
             must = list(creq.must_include_deviceIDs)
-            need = creq.allocation_size - len(must)
-            # chips already pinned by must-include cannot be re-picked, or
-            # the response would contain duplicate IDs
-            must_chips = {fake_id_to_uuid(fid) for fid in must}
+            must_chips_uuids = {fake_id_to_uuid(fid) for fid in must}
+            must_chips = [
+                chips_by_uuid[u] for u in must_chips_uuids if u in chips_by_uuid
+            ]
             avail_chips = [
                 chips_by_uuid[u]
                 for u in per_chip
-                if u in chips_by_uuid and u not in must_chips
+                if u in chips_by_uuid and u not in must_chips_uuids
             ]
             try:
+                # anchor the rectangle ON the pinned chips so must+chosen is
+                # one contiguous gang, not a pinned chip plus a far corner
                 picked = IciAllocator(topo, self.cfg.ici_policy).allocate(
-                    avail_chips, max(need, 0)
+                    avail_chips, creq.allocation_size, must_include=must_chips
                 )
                 for chip in picked:
+                    if chip.uuid in must_chips_uuids:
+                        continue  # already present via `must`
                     chosen.append(per_chip[chip.uuid][0])
             except AllocationError as e:
                 log.info("preferred allocation fallback: %s", e)
                 flat = [fid for fids in per_chip.values() for fid in fids]
-                chosen = flat[: max(need, 0)]
+                chosen = flat[: max(creq.allocation_size - len(must), 0)]
             resp.container_responses.append(
                 pb.ContainerPreferredAllocationResponse(deviceIDs=must + chosen)
             )
@@ -167,9 +171,24 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
             resp.envs["VTPU_OVERSUBSCRIBE"] = "true"
         if cfg.core_utilization_policy != "default":
             resp.envs["TPU_CORE_UTILIZATION_POLICY"] = cfg.core_utilization_policy
-        # mounts: shim artifacts + per-container shared-region dir (§3.3)
+        # mounts: shim artifacts + per-container shared-region dir (§3.3).
+        # The host dirs must exist before kubelet bind-mounts them (runc
+        # rejects missing sources), and the name must be unique PER
+        # CONTAINER — ordinal = how many cache dirs this pod already has
+        # (Allocate is called once per container, serialised by the node
+        # lock; ref hostdir /usr/local/vgpu/containers/<podUID>_<ctr>).
         pod_uid = pod["metadata"]["uid"]
-        cache_host = f"{cfg.cache_host_root}/{pod_uid}_{len(indices)}"
+        try:
+            os.makedirs(cfg.cache_host_root, exist_ok=True)
+            ordinal = len(
+                [d for d in os.listdir(cfg.cache_host_root)
+                 if d.startswith(f"{pod_uid}_")]
+            )
+        except OSError:
+            ordinal = 0
+        cache_host = f"{cfg.cache_host_root}/{pod_uid}_{ordinal}"
+        os.makedirs(cache_host, exist_ok=True)
+        os.makedirs("/tmp/vtpulock", exist_ok=True)
         resp.mounts.append(
             pb.Mount(container_path=cfg.container_cache_dir, host_path=cache_host)
         )
@@ -198,11 +217,12 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
 
     def Allocate(self, request, context):  # noqa: N802
         """ref plugin.go:318-392 + §3.3 call stack."""
-        if len(request.container_requests) > 1:
-            # one container per Allocate (ref :320-322)
+        if len(request.container_requests) != 1:
+            # exactly one container per Allocate (ref :320-322)
             context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
-                "multiple container requests in one Allocate are unsupported",
+                f"Allocate expects exactly 1 container request, "
+                f"got {len(request.container_requests)}",
             )
         creq = request.container_requests[0]
         pending = alloc_util.get_pending_pod(self.client, self.cfg.node_name)

@@ -68,9 +68,6 @@ class Scheduler:
         self.nodes = NodeManager()
         self.pods = PodManager()
         self._stop = threading.Event()
-        # cached usage snapshot for metrics (ref cachedstatus)
-        self._cached_usage: Dict[str, NodeUsage] = {}
-        self._cache_lock = threading.Lock()
         # serialises the snapshot→select→book critical section: concurrent
         # /filter requests (HA schedulers, parallel binds) must not both see
         # the same chip as free
@@ -170,16 +167,12 @@ class Scheduler:
                     d.used += 1
                     d.usedmem += cd.usedmem
                     d.usedcores += cd.usedcores
-        with self._cache_lock:
-            self._cached_usage = usage
         return usage
 
     def inspect_usage(self) -> Dict[str, NodeUsage]:
-        """Cached snapshot for metrics scrapes (ref InspectAllNodesUsage);
-        falls back to a fresh aggregation when nothing is cached yet."""
-        with self._cache_lock:
-            if self._cached_usage:
-                return self._cached_usage
+        """Fresh aggregation for metrics scrapes (ref InspectAllNodesUsage).
+        Always recomputed: a cached snapshot taken mid-filter (with a pod's
+        own booking excluded) would under-report until the next filter."""
         return self.nodes_usage()
 
     # ------------------------------------------------------------------
@@ -273,6 +266,13 @@ class Scheduler:
                 )
             except Exception:  # noqa: BLE001 — pod may be gone; lock still must go
                 log.warning("could not mark bind-phase=failed on %s/%s", namespace, name)
+            # drop the phantom booking so OTHER pods see the capacity again
+            # while this one sits in kube-scheduler backoff
+            try:
+                pod = self.client.get_pod(namespace, name)
+                self.pods.rm_pod(pod_uid(pod))
+            except Exception:  # noqa: BLE001
+                pass
             try:
                 release_node_lock(self.client, node)
             except Exception:  # noqa: BLE001

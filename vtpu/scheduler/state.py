@@ -88,7 +88,12 @@ class PodManager:
             "nodeName", ""
         )
         phase = pod.get("status", {}).get("phase", "")
-        if not enc or not node or phase in ("Succeeded", "Failed"):
+        bind_phase = annos.get(annotations.BIND_PHASE, "")
+        # bind-failed pods hold no devices — keeping their booking would
+        # phantom-occupy the node while kube-scheduler backs the pod off
+        if not enc or not node or phase in ("Succeeded", "Failed") or (
+            bind_phase == "failed"
+        ):
             self.rm_pod(pod_uid(pod))
             return
         try:

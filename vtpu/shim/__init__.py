@@ -1,0 +1,12 @@
+"""In-container enforcement runtime (ref: lib/nvidia/libvgpu.so layer).
+
+Two tiers, same env ABI (emitted by the device plugin's Allocate):
+
+1. ``cpp/libvtpu_shim.so`` — the native PJRT C-API interposer; enforcement
+   for arbitrary, non-cooperative workloads (any framework speaking PJRT).
+2. ``vtpu.shim.runtime`` (this package) — a cooperative Python runtime for
+   JAX tenants: same accounting + pacing semantics, in-process, and the
+   engine behind bench.py's multi-tenant sharing run.
+"""
+
+from vtpu.shim.runtime import ShimRuntime, QuotaExceeded  # noqa: F401

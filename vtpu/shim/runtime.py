@@ -1,0 +1,174 @@
+"""Cooperative Python enforcement runtime for JAX tenants.
+
+Implements the same semantics as the native interposer (cpp/vtpu_shim.cc)
+inside a Python process: per-device HBM accounting against the env-ABI
+quota with reject-on-exceed, program-bytes accounting at jit-compile,
+core-percentage pacing of executions, and the shared-region write path the
+node monitor reads.  ``bench.py`` builds its multi-tenant sharing run on
+this class (ref: the reference's benchmarks run under libvgpu.so the same
+way, README.md:212-225).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+from vtpu.monitor.shared_region import RegionFile, open_region
+
+log = logging.getLogger(__name__)
+
+
+class QuotaExceeded(MemoryError):
+    """HBM quota exhausted (the check_oom reject, ref libvgpu.so)."""
+
+
+def _env_limits() -> List[int]:
+    out = []
+    i = 0
+    while True:
+        v = os.environ.get(f"TPU_DEVICE_MEMORY_LIMIT_{i}")
+        if v is None:
+            break
+        out.append(int(v) * 1024 * 1024)
+        i += 1
+    return out
+
+
+class ShimRuntime:
+    """One tenant's view of a shared chip.
+
+    Parameters mirror the env ABI; explicit args override env (tests and
+    bench run several tenants in one process)."""
+
+    def __init__(
+        self,
+        limits_bytes: Optional[List[int]] = None,
+        core_limit: Optional[int] = None,
+        region_path: Optional[str] = None,
+        uuids: Optional[List[str]] = None,
+        pid: Optional[int] = None,
+        priority: Optional[int] = None,
+        oversubscribe: Optional[bool] = None,
+    ) -> None:
+        self.limits = limits_bytes if limits_bytes is not None else _env_limits()
+        self.core_limit = (
+            core_limit
+            if core_limit is not None
+            else int(os.environ.get("TPU_DEVICE_CORES_LIMIT", "100") or 100)
+        )
+        if os.environ.get("TPU_CORE_UTILIZATION_POLICY") == "disable":
+            self.core_limit = 100
+        self.oversubscribe = (
+            oversubscribe
+            if oversubscribe is not None
+            else os.environ.get("VTPU_OVERSUBSCRIBE") == "true"
+        )
+        self.priority = (
+            priority
+            if priority is not None
+            else int(os.environ.get("TPU_TASK_PRIORITY", "0") or 0)
+        )
+        self.pid = pid if pid is not None else os.getpid()
+        path = region_path or os.environ.get(
+            "TPU_DEVICE_MEMORY_SHARED_CACHE", "/tmp/vtpu/vtpu.cache"
+        )
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.region: Optional[RegionFile] = open_region(path, create=True)
+        if self.region is not None:
+            names = uuids or (
+                os.environ.get("VTPU_VISIBLE_UUIDS", "tpu-0").split(",")
+            )
+            self.region.set_devices(
+                names,
+                (self.limits + [0] * len(names))[: len(names)],
+                [self.core_limit] * len(names),
+            )
+            self.region.register_proc(self.pid, self.priority)
+        # local (per-tenant) accounting mirrors the region
+        self._local: Dict[int, int] = {}
+
+    # ------------------------------------------------------------------
+    def limit_for(self, dev: int) -> int:
+        if dev < len(self.limits):
+            return self.limits[dev]
+        return 0
+
+    def device_usage(self, dev: int) -> int:
+        if self.region is not None:
+            return self.region.usage()[dev]["total"] if dev < self.region.region.num_devices else 0
+        return self._local.get(dev, 0)
+
+    def try_alloc(self, nbytes: int, dev: int = 0, kind: str = "buffer") -> None:
+        """Account an allocation; raise QuotaExceeded when over quota
+        (unless oversubscribe)."""
+        limit = self.limit_for(dev)
+        if limit and not self.oversubscribe:
+            if self.device_usage(dev) + nbytes > limit:
+                raise QuotaExceeded(
+                    f"vtpu: device {dev} quota {limit} B exceeded "
+                    f"(in use {self.device_usage(dev)}, want {nbytes})"
+                )
+        if self.region is not None:
+            self.region.add_usage(self.pid, dev, nbytes, kind)
+        self._local[dev] = self._local.get(dev, 0) + nbytes
+
+    def free(self, nbytes: int, dev: int = 0, kind: str = "buffer") -> None:
+        if self.region is not None:
+            self.region.sub_usage(self.pid, dev, nbytes, kind)
+        self._local[dev] = max(0, self._local.get(dev, 0) - nbytes)
+
+    # ------------------------------------------------------------------
+    def device_put(self, x, dev: int = 0):
+        """jax.device_put through the quota (accounts the array bytes)."""
+        import jax
+        import numpy as np
+
+        nbytes = int(np.asarray(x).nbytes) if not hasattr(x, "nbytes") else int(x.nbytes)
+        self.try_alloc(nbytes, dev)
+        return jax.device_put(x)
+
+    def throttled(self, fn: Callable) -> Callable:
+        """Wrap a (jitted) callable with core-percentage pacing — the
+        utilization-watcher analog: after each call, sleep
+        (100-q)/q × call-time, keeping the duty cycle at q%.  The monitor's
+        utilization_switch suspends throttling (priority arbitration)."""
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t0 = time.monotonic()
+            out = fn(*args, **kwargs)
+            # block_until_ready so the measured time covers device work
+            try:
+                import jax
+
+                out = jax.block_until_ready(out)
+            except Exception:  # noqa: BLE001 — non-jax return values
+                pass
+            dt = time.monotonic() - t0
+            if self.region is not None:
+                self.region.region.recent_kernel += 1
+                suspended = self.region.region.utilization_switch == 1
+            else:
+                suspended = False
+            q = self.core_limit
+            if 0 < q < 100 and not suspended:
+                time.sleep(dt * (100 - q) / q)
+            return out
+
+        return wrapper
+
+    def memory_stats(self, dev: int = 0) -> Dict[str, int]:
+        """Quota-aware stats (the nvidia-smi-equivalence surface)."""
+        return {
+            "bytes_limit": self.limit_for(dev),
+            "bytes_in_use": self.device_usage(dev),
+        }
+
+    def close(self) -> None:
+        if self.region is not None:
+            self.region.close()
+            self.region = None
