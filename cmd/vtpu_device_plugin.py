@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""vtpu-device-plugin — kubelet device plugin daemon.
+
+Ref: cmd/device-plugin/nvidia/main.go:110-239.  Serves the device-plugin
+gRPC API, registers with kubelet, runs the 30 s annotation registrar and
+the health poll, and restarts the plugin when the kubelet socket is
+recreated (the fsnotify pattern, done by mtime polling here).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# allow `python3 cmd/<name>.py` from anywhere (the image sets PYTHONPATH=/app,
+# but a bare checkout run must find the package next to cmd/)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--device-split-count", type=int, default=None)
+    p.add_argument("--device-memory-scaling", type=float, default=None)
+    p.add_argument("--device-cores-scaling", type=float, default=None)
+    p.add_argument("--resource-name", default=None)
+    p.add_argument("--node-config", default=None, help="per-node JSON overrides")
+    p.add_argument("--kubelet-socket",
+                   default="/var/lib/kubelet/device-plugins/kubelet.sock")
+    p.add_argument("--use-pjrt-discovery", action="store_true",
+                   help="query PJRT for chips at startup (holds the chips briefly)")
+    p.add_argument("--debug", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    log = logging.getLogger("vtpu-device-plugin")
+
+    from vtpu.device.libtpu import new_provider
+    from vtpu.k8s.client import new_client
+    from vtpu.plugin.cache import DeviceCache
+    from vtpu.plugin.config import PluginConfig
+    from vtpu.plugin.register import Registrar
+    from vtpu.plugin.server import PluginServer, VtpuDevicePlugin
+
+    cfg = PluginConfig.from_env(args.node_config)
+    for field, val in (
+        ("device_split_count", args.device_split_count),
+        ("device_memory_scaling", args.device_memory_scaling),
+        ("device_cores_scaling", args.device_cores_scaling),
+        ("resource_name", args.resource_name),
+    ):
+        if val is not None:
+            setattr(cfg, field, val)
+
+    provider = new_provider(use_pjrt=args.use_pjrt_discovery)
+    chips = provider.enumerate()
+    if not chips:
+        log.error("no TPU chips discovered; exiting")
+        return 1
+    log.info("discovered %d chips: %s", len(chips), [c.uuid for c in chips])
+
+    client = new_client()
+    cache = DeviceCache(provider)
+    cache.start()
+    registrar = Registrar(client, cache, cfg)
+    registrar.start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+
+    servicer = VtpuDevicePlugin(client, cache, cfg)
+    srv = PluginServer(servicer, cfg)
+
+    def kubelet_mtime() -> float:
+        try:
+            return os.stat(args.kubelet_socket).st_mtime
+        except OSError:
+            return 0.0
+
+    while not stop.is_set():
+        srv.serve()
+        try:
+            srv.register_with_kubelet(args.kubelet_socket)
+        except Exception:  # noqa: BLE001 — kubelet may be restarting
+            log.exception("kubelet registration failed; retrying in 5s")
+            srv.stop()
+            if stop.wait(5):
+                break
+            if not srv.allow_restart():
+                log.error("too many restarts; exiting")
+                return 1
+            servicer = VtpuDevicePlugin(client, cache, cfg)
+            srv = PluginServer(servicer, cfg)
+            continue
+        seen = kubelet_mtime()
+        # watch for kubelet restarts (socket recreation ⇒ re-register;
+        # ref fsnotify watcher main.go:211-215)
+        while not stop.wait(5):
+            now = kubelet_mtime()
+            if now != seen:
+                log.info("kubelet socket changed; restarting plugin")
+                if not srv.allow_restart():
+                    log.error("too many restarts within the hour; exiting")
+                    return 1
+                srv.stop()
+                servicer = VtpuDevicePlugin(client, cache, cfg)
+                srv = PluginServer(servicer, cfg)
+                break
+        else:
+            break
+
+    srv.stop()
+    registrar.stop()
+    cache.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

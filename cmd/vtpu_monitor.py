@@ -1,0 +1,83 @@
+#!/usr/bin/env python3
+"""vtpu-monitor — node monitor daemon.
+
+Ref: cmd/vGPUmonitor/main.go.  Scans the per-container shared regions,
+serves Prometheus metrics (:9394) and the node info gRPC (:9396), runs the
+GC and the priority feedback arbiter (which the reference ships disabled).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# allow `python3 cmd/<name>.py` from anywhere (the image sets PYTHONPATH=/app,
+# but a bare checkout run must find the package next to cmd/)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--containers-root", default="/usr/local/vtpu/containers")
+    p.add_argument("--metrics-bind", default="0.0.0.0:9394")
+    p.add_argument("--noderpc-bind", default="0.0.0.0:9396")
+    p.add_argument("--feedback-interval", type=float, default=5.0)
+    p.add_argument("--disable-feedback", action="store_true")
+    p.add_argument("--debug", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    from vtpu.monitor.feedback import FeedbackLoop
+    from vtpu.monitor.metrics import serve_metrics
+    from vtpu.monitor.noderpc import serve_noderpc
+    from vtpu.monitor.pathmonitor import PathMonitor
+
+    pods_fn = None
+    try:
+        from vtpu.k8s.client import new_client
+
+        client = new_client()
+        node = __import__("os").environ.get("NODE_NAME")
+
+        def pods_fn():  # noqa: F811 — deliberate rebind
+            return {
+                p["metadata"]["uid"]: p for p in client.list_pods(node_name=node)
+            }
+
+    except Exception:  # noqa: BLE001 — monitor works standalone too
+        logging.info("no cluster access; running without pod join/GC")
+
+    pm = PathMonitor(args.containers_root)
+    metrics_srv, _ = serve_metrics(pm, pods_fn=pods_fn, bind=args.metrics_bind)
+    rpc_srv, _ = serve_noderpc(pm, bind=args.noderpc_bind)
+    fb = None
+    if not args.disable_feedback:
+        fb = FeedbackLoop(pm, args.feedback_interval)
+        fb.start()
+    logging.info(
+        "vtpu-monitor: metrics %s, noderpc %s", args.metrics_bind, args.noderpc_bind
+    )
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    metrics_srv.shutdown()
+    rpc_srv.stop(grace=1)
+    if fb:
+        fb.stop()
+    pm.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,76 @@
+#!/usr/bin/env python3
+"""vtpu-scheduler — scheduler extender + webhook server.
+
+Ref: cmd/scheduler/main.go:47-85.  Flags mirror the reference's
+(--http_bind, --scheduler-name, --default-mem, --default-cores) plus the
+vtpu policy knobs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# allow `python3 cmd/<name>.py` from anywhere (the image sets PYTHONPATH=/app,
+# but a bare checkout run must find the package next to cmd/)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--http_bind", default="0.0.0.0:9395")
+    p.add_argument("--scheduler-name", default="vtpu-scheduler")
+    p.add_argument("--default-mem", type=int, default=0, help="MiB")
+    p.add_argument("--default-cores", type=int, default=0, help="percent")
+    p.add_argument("--node-scheduler-policy", default="binpack",
+                   choices=["binpack", "spread"])
+    p.add_argument("--ici-policy", default="best-effort",
+                   choices=["best-effort", "restricted", "guaranteed"])
+    p.add_argument("--resource-name", default=None,
+                   help="managed chip resource (default google.com/tpu)")
+    p.add_argument("--debug", action="store_true")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    from vtpu.k8s.client import new_client
+    from vtpu.scheduler import Scheduler, SchedulerConfig
+    from vtpu.scheduler.routes import serve
+    from vtpu.utils.types import resources
+
+    if args.resource_name:
+        resources.configure(chip=args.resource_name)
+
+    client = new_client()
+    cfg = SchedulerConfig(
+        http_bind=args.http_bind,
+        scheduler_name=args.scheduler_name,
+        default_mem=args.default_mem,
+        default_cores=args.default_cores,
+        node_scheduler_policy=args.node_scheduler_policy,
+        ici_policy=args.ici_policy,
+    )
+    sched = Scheduler(client, cfg)
+    sched.run_background_loops()
+    srv, _ = serve(sched)
+    logging.info("vtpu-scheduler serving on %s", args.http_bind)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    srv.shutdown()
+    sched.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

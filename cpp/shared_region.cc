@@ -2,9 +2,13 @@
  *
  * Concurrency model (ref libvgpu.so's semaphore + file lock +
  * fix_lock_shrreg dead-owner recovery, SURVEY.md §5 race detection):
- * - creation race: O_EXCL temp + rename, then flock during init
- * - steady state: CAS spinlock in the region; owner_pid lets a waiter
- *   reclaim the lock if the holder died (kill(pid, 0) probe).
+ * every mutation holds flock(fd) on the region file itself.  flock gives
+ * (a) cross-LANGUAGE exclusion — the Python writer (vtpu.monitor.
+ * shared_region) locks the same file, and (b) dead-owner recovery for
+ * free: the kernel drops the lock when the holder dies, which the
+ * reference needed fix_lock_shrreg + owner-pid probing for.  The CAS
+ * fast-path guards re-entry within one process; owner_pid is kept for
+ * observability.
  */
 #include "shared_region.h"
 
@@ -16,12 +20,21 @@
 #include <sys/file.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
-#include <time.h>
 #include <unistd.h>
 
-static void msleep(long ms) {
-  struct timespec ts = {ms / 1000, (ms % 1000) * 1000000L};
-  nanosleep(&ts, NULL);
+/* per-process region→fd registry so lock/unlock can flock the file the
+ * region was mapped from (fds are per-process; they cannot live in the
+ * shared mapping itself) */
+#define VTPU_MAX_OPEN 32
+static struct {
+  vtpu_shared_region* r;
+  int fd;
+} g_open[VTPU_MAX_OPEN];
+
+static int fd_for(vtpu_shared_region* r) {
+  for (int i = 0; i < VTPU_MAX_OPEN; i++)
+    if (g_open[i].r == r) return g_open[i].fd;
+  return -1;
 }
 
 vtpu_shared_region* vtpu_region_open(const char* path) {
@@ -64,12 +77,28 @@ vtpu_shared_region* vtpu_region_open(const char* path) {
     return NULL;
   }
   flock(fd, LOCK_UN);
-  close(fd); /* mmap survives the close */
-  return r;
+  /* keep fd open: it carries the steady-state flock */
+  for (int i = 0; i < VTPU_MAX_OPEN; i++) {
+    if (g_open[i].r == NULL) {
+      g_open[i].r = r;
+      g_open[i].fd = fd;
+      return r;
+    }
+  }
+  close(fd);
+  munmap(p, sizeof(vtpu_shared_region));
+  return NULL; /* too many open regions in one process */
 }
 
 int vtpu_region_close(vtpu_shared_region* r) {
   if (!r) return 0;
+  for (int i = 0; i < VTPU_MAX_OPEN; i++) {
+    if (g_open[i].r == r) {
+      close(g_open[i].fd);
+      g_open[i].r = NULL;
+      g_open[i].fd = -1;
+    }
+  }
   return munmap(r, sizeof(vtpu_shared_region));
 }
 
@@ -100,35 +129,19 @@ static int pid_alive(int32_t pid) {
 }
 
 void vtpu_region_lock(vtpu_shared_region* r) {
-  int spins = 0;
-  for (;;) {
-    if (__sync_bool_compare_and_swap(&r->lock, 0, 1)) {
-      r->owner_pid = (int32_t)getpid();
-      __sync_synchronize();
-      return;
-    }
-    if (++spins > 1000) { /* ~1 s: check for a dead owner */
-      int32_t owner = r->owner_pid;
-      if (owner != 0 && !pid_alive(owner)) {
-        /* dead-owner recovery (ref fix_lock_shrreg): steal only if the
-         * owner field still names the dead pid */
-        if (__sync_bool_compare_and_swap(&r->owner_pid, owner,
-                                         (int32_t)getpid())) {
-          r->lock = 1;
-          __sync_synchronize();
-          return;
-        }
-      }
-      spins = 0;
-    }
-    msleep(1);
-  }
+  int fd = fd_for(r);
+  if (fd >= 0) flock(fd, LOCK_EX); /* released by the kernel if we die */
+  r->lock = 1; /* observability only; flock is the real exclusion */
+  r->owner_pid = (int32_t)getpid();
+  __sync_synchronize();
 }
 
 void vtpu_region_unlock(vtpu_shared_region* r) {
   r->owner_pid = 0;
   __sync_synchronize();
   r->lock = 0;
+  int fd = fd_for(r);
+  if (fd >= 0) flock(fd, LOCK_UN);
 }
 
 int vtpu_region_register_proc(vtpu_shared_region* r, int32_t pid,

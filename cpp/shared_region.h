@@ -48,9 +48,10 @@ typedef struct vtpu_shared_region {
   uint32_t magic;
   uint32_t version;
   int32_t initialized; /* 1 once init completed (ref initializedFlag) */
-  int32_t owner_pid;   /* pid holding `lock`, for dead-owner recovery
-                          (ref fix_lock_shrreg / CHANGELOG v2.2.7) */
-  int32_t lock;        /* 0 free, 1 held — CAS spinlock */
+  int32_t owner_pid;   /* current holder, observability (real exclusion and
+                          dead-owner recovery come from flock on the region
+                          file — ref fix_lock_shrreg / CHANGELOG v2.2.7) */
+  int32_t lock;        /* 0 free, 1 held — observational mirror of flock */
   int32_t num_devices;
   int32_t utilization_switch; /* monitor-written: 0 enforce core limits,
                                  1 suspend (priority arbitration,

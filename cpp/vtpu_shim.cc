@@ -314,7 +314,7 @@ PJRT_Error* wrap_CreateUninitializedBuffer(
     PJRT_Client_CreateUninitializedBuffer_Args* args) {
   PJRT_Error* err = g_real->PJRT_Client_CreateUninitializedBuffer(args);
   if (err) return err;
-  if (account_buffer(args->buffer, nullptr) != 0) {
+  if (account_buffer(args->buffer, args->device) != 0) {
     destroy_real_buffer(args->buffer);
     args->buffer = nullptr;
     return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
@@ -463,10 +463,13 @@ extern "C" const PJRT_Api* GetPjrtApi() {
     }
     /* copy the real table, then substitute wrappers */
     memset(&g_api, 0, sizeof(g_api));
-    memcpy(&g_api, g_real,
-           g_real->struct_size < sizeof(g_api) ? g_real->struct_size
-                                               : sizeof(g_api));
-    g_api.struct_size = PJRT_Api_STRUCT_SIZE;
+    size_t copy = g_real->struct_size < sizeof(g_api) ? g_real->struct_size
+                                                      : sizeof(g_api);
+    memcpy(&g_api, g_real, copy);
+    /* never advertise fields beyond what the real plugin provides — a
+     * larger struct_size over zeroed tail pointers would be a segfault
+     * waiting in any caller that gates on struct_size */
+    g_api.struct_size = copy;
     g_api.PJRT_Error_Destroy = wrap_Error_Destroy;
     g_api.PJRT_Error_Message = wrap_Error_Message;
     g_api.PJRT_Error_GetCode = wrap_Error_GetCode;

@@ -1,12 +1,21 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh so sharding
-tests run anywhere (SURVEY.md §7; multi-chip hardware is not available)."""
+tests run anywhere (SURVEY.md §7; multi-chip hardware is not available).
+
+Note: this image's sitecustomize imports jax at interpreter start and the
+ambient env pins JAX_PLATFORMS=axon (the real-TPU tunnel), so the env var
+alone is baked before conftest runs — the jax.config update below is the
+authoritative override.  Tests must never touch the real chip.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("VTPU_FAKE_DEVICES", "")  # never touch real TPU in tests
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -303,3 +303,26 @@ def test_preferred_allocation_anchors_on_must_include(rig):
     assert "fake-tpu-0" in chosen
     # (0,0) anchors → partner must be ICI-adjacent: (1,0)=tpu-1 or (0,1)=tpu-2
     assert chosen in ({"fake-tpu-0", "fake-tpu-1"}, {"fake-tpu-0", "fake-tpu-2"})
+
+
+def test_preferred_allocation_multi_share_one_chip(rig):
+    """allocation_size counts shares: 3 shares may land on 2 chips."""
+    must = [split_device_ids("fake-tpu-0", 4)[0]]
+    avail = must + split_device_ids("fake-tpu-0", 4)[1:3] + [
+        split_device_ids("fake-tpu-1", 4)[0]
+    ]
+    req = pb.PreferredAllocationRequest()
+    req.container_requests.append(
+        pb.ContainerPreferredAllocationRequest(
+            available_deviceIDs=avail,
+            must_include_deviceIDs=must,
+            allocation_size=3,
+        )
+    )
+    resp = stub_call = rig[-1].GetPreferredAllocation(req, timeout=5)
+    ids = list(resp.container_responses[0].deviceIDs)
+    assert len(ids) == 3 and len(set(ids)) == 3
+    assert must[0] in ids
+    # pinned-chip shares preferred before spilling to another chip
+    same_chip = [i for i in ids if fake_id_to_uuid(i) == "fake-tpu-0"]
+    assert len(same_chip) == 3

@@ -152,7 +152,7 @@ class IciAllocator:
         for c in list(must) + healthy:
             if c.coords is not None:
                 by_coord[tuple(c.coords)] = c
-            else:
+            elif c not in must:  # must chips stay only in `must`
                 coordless.append(c)
         must_coords = frozenset(
             tuple(c.coords) for c in must if c.coords is not None

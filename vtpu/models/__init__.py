@@ -1,0 +1,12 @@
+"""Workload models — the ai-benchmark equivalents the reference benches with
+(ref: benchmarks/ai-benchmark/, README.md:193-206 test matrix):
+
+  Resnet-V2-50 / Resnet-V2-152  (inference + training)
+  VGG-16, DeepLab, LSTM
+
+Written TPU-first in flax: NHWC layouts, bfloat16 compute with fp32 params,
+channel counts that tile onto the 128-lane MXU, no data-dependent Python
+control flow under jit.
+"""
+
+from vtpu.models.registry import MODELS, create_model  # noqa: F401

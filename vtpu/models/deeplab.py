@@ -1,0 +1,65 @@
+"""DeepLab-v3-style semantic segmentation (ref: ai-benchmark DeepLab rows,
+BASELINE.md rows 4/9): ResNet-V2 backbone with output-stride 16 via atrous
+convs in the last stage, ASPP head, dense per-pixel logits."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from vtpu.models.resnet import BottleneckV2
+
+
+class ASPP(nn.Module):
+    """Atrous spatial pyramid pooling (1x1 + three atrous 3x3 + image pool)."""
+
+    filters: int = 256
+    rates: Tuple[int, ...] = (6, 12, 18)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        branches = [nn.Conv(self.filters, (1, 1), dtype=self.dtype)(x)]
+        for r in self.rates:
+            branches.append(
+                nn.Conv(self.filters, (3, 3), kernel_dilation=(r, r),
+                        padding="SAME", dtype=self.dtype)(x)
+            )
+        pooled = jnp.mean(x, axis=(1, 2), keepdims=True)
+        pooled = nn.Conv(self.filters, (1, 1), dtype=self.dtype)(pooled)
+        pooled = jnp.broadcast_to(
+            pooled, (x.shape[0], x.shape[1], x.shape[2], self.filters)
+        )
+        branches.append(pooled)
+        y = jnp.concatenate(branches, axis=-1)
+        y = nn.Conv(self.filters, (1, 1), dtype=self.dtype)(y)
+        return nn.relu(y)
+
+
+class DeepLabV3(nn.Module):
+    num_classes: int = 21
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        n, h, w, _ = x.shape
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                # output-stride 16: stage 3 keeps stride 1 (atrous instead)
+                strides = (2, 2) if i in (1, 2) and j == 0 else (1, 1)
+                x = BottleneckV2(self.num_filters * 2**i, strides=strides,
+                                 dtype=self.dtype)(x)
+        x = ASPP(dtype=self.dtype)(x)
+        x = nn.Conv(self.num_classes, (1, 1), dtype=jnp.float32)(x)
+        # bilinear upsample back to input resolution
+        x = jax.image.resize(x, (n, h, w, self.num_classes), "bilinear")
+        return x.astype(jnp.float32)

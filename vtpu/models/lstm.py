@@ -1,0 +1,34 @@
+"""LSTM sentiment-style model (ref: ai-benchmark LSTM rows, BASELINE.md
+rows 5/10: hidden 1024, sequence 300).
+
+TPU-first: the recurrence is a `lax.scan` over an `nn.OptimizedLSTMCell`
+(one fused gate matmul per step — MXU-friendly), not a Python loop; static
+sequence length so XLA unrolls nothing and tiles everything.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LSTMClassifier(nn.Module):
+    hidden: int = 1024
+    num_classes: int = 2
+    vocab: int = 30000
+    embed: int = 512
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens):
+        # tokens: [batch, seq] int32
+        x = nn.Embed(self.vocab, self.embed, dtype=self.dtype)(tokens)
+        cell = nn.OptimizedLSTMCell(self.hidden, dtype=self.dtype)
+        scan = nn.RNN(cell)  # lax.scan under the hood
+        y = scan(x)
+        # last hidden state → logits
+        x = y[:, -1, :]
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,29 @@
+"""Model registry keyed by the ai-benchmark test names (BASELINE.md rows)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+from vtpu.models.deeplab import DeepLabV3
+from vtpu.models.lstm import LSTMClassifier
+from vtpu.models.resnet import ResNetV2_50, ResNetV2_101, ResNetV2_152
+from vtpu.models.vgg import VGG16
+
+# name -> (ctor, example input shape fn(batch))  (shapes from README.md:193-206)
+MODELS: Dict[str, Tuple[Callable, Callable[[int], tuple], Any]] = {
+    "resnet50": (ResNetV2_50, lambda b: (b, 346, 346, 3), jnp.float32),
+    "resnet101": (ResNetV2_101, lambda b: (b, 256, 256, 3), jnp.float32),
+    "resnet152": (ResNetV2_152, lambda b: (b, 256, 256, 3), jnp.float32),
+    "vgg16": (VGG16, lambda b: (b, 224, 224, 3), jnp.float32),
+    "deeplab": (DeepLabV3, lambda b: (b, 512, 512, 3), jnp.float32),
+    "lstm": (LSTMClassifier, lambda b: (b, 300), jnp.int32),
+}
+
+
+def create_model(name: str, **kwargs):
+    if name not in MODELS:
+        raise KeyError(f"unknown model {name!r}; have {sorted(MODELS)}")
+    ctor, shape_fn, in_dtype = MODELS[name]
+    return ctor(**kwargs), shape_fn, in_dtype

@@ -1,0 +1,83 @@
+"""ResNet-V2 (pre-activation) — the ai-benchmark flagship
+(ref: benchmarks/ai-benchmark runs Resnet-V2-50 and Resnet-V2-152;
+BASELINE.md rows 1-2).
+
+TPU-first choices: NHWC (XLA's native conv layout on TPU), bfloat16
+activations with fp32 params/batch-stats, filter counts in multiples that
+tile the 128×128 MXU, and an optional `remat` on the bottleneck to trade
+FLOPs for HBM on training.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckV2(nn.Module):
+    """Pre-activation bottleneck (BN→ReLU→conv ×3 + identity)."""
+
+    filters: int
+    strides: Tuple[int, int] = (1, 1)
+    dtype: Any = jnp.bfloat16
+    norm: ModuleDef = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x):
+        needs_proj = x.shape[-1] != self.filters * 4 or self.strides != (1, 1)
+        preact = self.norm(use_running_average=False, dtype=self.dtype,
+                           name="preact_bn")(x)
+        preact = nn.relu(preact)
+        shortcut = x
+        if needs_proj:
+            shortcut = nn.Conv(self.filters * 4, (1, 1), self.strides,
+                               use_bias=False, dtype=self.dtype,
+                               name="proj")(preact)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(preact)
+        y = self.norm(use_running_average=False, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False, dtype=self.dtype)(y)
+        y = self.norm(use_running_average=False, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        return shortcut + y
+
+
+class ResNetV2(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv_root")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        block = BottleneckV2
+        if self.remat:
+            block = nn.remat(BottleneckV2)  # jax.checkpoint: HBM↓, FLOPs↑
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = block(self.num_filters * 2**i, strides=strides,
+                          dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=False, dtype=self.dtype,
+                         name="final_bn")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ResNetV2_50 = functools.partial(ResNetV2, stage_sizes=(3, 4, 6, 3))
+ResNetV2_101 = functools.partial(ResNetV2, stage_sizes=(3, 4, 23, 3))
+ResNetV2_152 = functools.partial(ResNetV2, stage_sizes=(3, 8, 36, 3))

@@ -1,0 +1,33 @@
+"""VGG-16 (ref: ai-benchmark VGG-16 rows, BASELINE.md row 3)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# (filters, n_convs) per stage — classic VGG-16 configuration D
+_CFG = ((64, 2), (128, 2), (256, 3), (512, 3), (512, 3))
+
+
+class VGG16(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    cfg: Sequence = _CFG
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for filters, n in self.cfg:
+            for _ in range(n):
+                x = nn.Conv(filters, (3, 3), padding="SAME", dtype=self.dtype)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(4096, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(4096, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

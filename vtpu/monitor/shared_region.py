@@ -9,7 +9,9 @@ file through the C `region_tool`.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
+import fcntl
 import mmap
 import os
 from typing import Dict, List, Optional
@@ -76,8 +78,13 @@ class RegionFile:
                     raise ValueError(f"{path}: too small for a vtpu region")
                 os.ftruncate(fd, REGION_SIZE)
             self._mm = mmap.mmap(fd, REGION_SIZE)
-        finally:
+        except BaseException:
             os.close(fd)
+            raise
+        # fd stays open: it carries the cross-process flock that both this
+        # mirror and the C library (cpp/shared_region.cc) take around every
+        # mutation — same file, same lock, released by the kernel on death
+        self._fd = fd
         self.region = SharedRegion.from_buffer(self._mm)
         if create and self.region.magic == 0:
             self.region.magic = VTPU_REGION_MAGIC
@@ -90,6 +97,14 @@ class RegionFile:
         if version != VTPU_REGION_VERSION:
             self.close()
             raise ValueError(f"{path}: region version {version}")
+
+    @contextlib.contextmanager
+    def _locked(self):
+        fcntl.flock(self._fd, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
 
     # -- read side -------------------------------------------------------
     def device_uuids(self) -> List[str]:
@@ -106,6 +121,10 @@ class RegionFile:
 
     def usage(self) -> List[Dict[str, int]]:
         """Per-device totals across live procs (ref getvGPUMemoryInfo)."""
+        with self._locked():
+            return self._usage_nolock()
+
+    def _usage_nolock(self) -> List[Dict[str, int]]:
         r = self.region
         out = []
         for d in range(r.num_devices):
@@ -140,6 +159,10 @@ class RegionFile:
         self.region.utilization_switch = value
 
     def set_hostpid(self, pid: int, hostpid: int) -> None:
+        with self._locked():
+            self._set_hostpid_nolock(pid, hostpid)
+
+    def _set_hostpid_nolock(self, pid: int, hostpid: int) -> None:
         r = self.region
         for p in range(MAX_PROCS):
             if r.procs[p].status == 1 and r.procs[p].pid == pid:
@@ -148,12 +171,17 @@ class RegionFile:
     def decay_recent_kernel(self) -> int:
         """ref Observe (feedback.go): halve the activity counter, return the
         pre-decay value."""
-        v = self.region.recent_kernel
-        self.region.recent_kernel = v // 2
-        return v
+        with self._locked():
+            v = self.region.recent_kernel
+            self.region.recent_kernel = v // 2
+            return v
 
     # -- writer side (used by the cooperative Python shim) ----------------
     def set_devices(self, uuids: List[str], limits: List[int], cores: List[int]) -> None:
+        with self._locked():
+            self._set_devices_nolock(uuids, limits, cores)
+
+    def _set_devices_nolock(self, uuids: List[str], limits: List[int], cores: List[int]) -> None:
         r = self.region
         if r.num_devices == 0:
             n = min(len(uuids), MAX_DEVICES)
@@ -164,6 +192,10 @@ class RegionFile:
                 r.core_limit[i] = cores[i]
 
     def register_proc(self, pid: int, priority: int = 0) -> int:
+        with self._locked():
+            return self._register_proc_nolock(pid, priority)
+
+    def _register_proc_nolock(self, pid: int, priority: int = 0) -> int:
         r = self.region
         for p in range(MAX_PROCS):
             if r.procs[p].status == 1 and r.procs[p].pid == pid:
@@ -178,7 +210,25 @@ class RegionFile:
                 return p
         return -1
 
+    def try_add(self, pid: int, dev: int, bytes_: int, kind: str = "buffer",
+                limit: int = 0, oversubscribe: bool = False) -> bool:
+        """Atomic check-and-add under one flock (the check_oom analog,
+        mirroring vtpu_region_try_add): returns False when adding would
+        exceed ``limit`` (0 = unlimited)."""
+        with self._locked():
+            self._register_proc_nolock(pid)
+            if limit and not oversubscribe:
+                used = sum(d["total"] for d in self._usage_nolock()[dev:dev + 1])
+                if used + bytes_ > limit:
+                    return False
+            self._add_usage_nolock(pid, dev, bytes_, kind)
+            return True
+
     def add_usage(self, pid: int, dev: int, bytes_: int, kind: str = "buffer") -> None:
+        with self._locked():
+            self._add_usage_nolock(pid, dev, bytes_, kind)
+
+    def _add_usage_nolock(self, pid: int, dev: int, bytes_: int, kind: str = "buffer") -> None:
         r = self.region
         for p in range(MAX_PROCS):
             if r.procs[p].status == 1 and r.procs[p].pid == pid:
@@ -191,6 +241,10 @@ class RegionFile:
                 return
 
     def sub_usage(self, pid: int, dev: int, bytes_: int, kind: str = "buffer") -> None:
+        with self._locked():
+            self._sub_usage_nolock(pid, dev, bytes_, kind)
+
+    def _sub_usage_nolock(self, pid: int, dev: int, bytes_: int, kind: str = "buffer") -> None:
         r = self.region
         for p in range(MAX_PROCS):
             if r.procs[p].status == 1 and r.procs[p].pid == pid:
@@ -206,6 +260,7 @@ class RegionFile:
         # release the ctypes view before unmapping
         self.region = None  # type: ignore[assignment]
         self._mm.close()
+        os.close(self._fd)
 
 
 def open_region(path: str, create: bool = False) -> Optional[RegionFile]:

@@ -1,0 +1,111 @@
+"""Blockwise (flash-style) attention Pallas kernel.
+
+Online-softmax over KV blocks so the [S, S] score matrix never hits HBM —
+the HBM-bandwidth win that matters at long sequence lengths.  QK^T and
+PV ride the MXU per block.  Used standalone and as the per-shard inner op
+of ring attention (vtpu.parallel.ring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                 sm_scale: float):
+    # q_ref: [block_q, d]; k_ref/v_ref: [S, d]; grid dim 0 walks q blocks
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+    seq_len = k_ref.shape[0]
+    block_q = q.shape[0]
+    q_idx = pl.program_id(0)
+
+    def body(start, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T  # [block_q, block_k] on the MXU
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = start * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + p @ v
+        return acc, m_new, l_new
+
+    d = v_ref.shape[-1]
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(
+        0, seq_len // block_k, body, (acc0, m0, l0)
+    )
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu",)
+    except RuntimeError:
+        return False
+
+
+def reference_attention(q, k, v, causal: bool = False):
+    """Plain XLA attention (correctness oracle + fallback)."""
+    sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128):
+    """q,k,v: [batch, heads, seq, d] (or [seq, d]).  Static shapes only."""
+    if q.ndim == 2:
+        return _flash_2d(q, k, v, causal, block_q, block_k)
+    batch_shape = q.shape[:-2]
+    flat_q = q.reshape((-1,) + q.shape[-2:])
+    flat_k = k.reshape((-1,) + k.shape[-2:])
+    flat_v = v.reshape((-1,) + v.shape[-2:])
+    out = jax.vmap(
+        lambda a, b, c: _flash_2d(a, b, c, causal, block_q, block_k)
+    )(flat_q, flat_k, flat_v)
+    return out.reshape(batch_shape + q.shape[-2:])
+
+
+def _flash_2d(q, k, v, causal, block_q, block_k):
+    seq_q, d = q.shape
+    seq_k = k.shape[0]
+    if seq_q % block_q or seq_k % block_k:
+        return reference_attention(q, k, v, causal)
+    sm_scale = d**-0.5
+    return pl.pallas_call(
+        functools.partial(
+            _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale
+        ),
+        out_shape=jax.ShapeDtypeStruct((seq_q, d), q.dtype),
+        grid=(seq_q // block_q,),
+        in_specs=[
+            pl.BlockSpec((block_q, d), lambda i: (i, 0)),
+            pl.BlockSpec((seq_k, d), lambda i: (0, 0)),
+            pl.BlockSpec((seq_k, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_q, d), lambda i: (i, 0)),
+        interpret=not _on_tpu(),
+    )(q, k, v)

@@ -1,0 +1,10 @@
+"""Multi-chip parallelism helpers for vtpu tenants.
+
+The scheduler hands a gang pod an ICI-contiguous rectangle (SURVEY.md §2.9);
+this package turns that rectangle into a `jax.sharding.Mesh` and provides
+the sharding rules tenants run on it: data/tensor-parallel train steps and
+ring attention (sequence parallelism over ICI via ppermute).
+"""
+
+from vtpu.parallel.mesh import mesh_from_rectangle, make_mesh  # noqa: F401
+from vtpu.parallel.ring import ring_attention  # noqa: F401

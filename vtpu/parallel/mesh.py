@@ -1,0 +1,51 @@
+"""Mesh construction from gang rectangles.
+
+A gang pod's chips arrive as an axis-aligned box (offset, shape) chosen by
+the allocator; laying the `Mesh` axes along the box's own dims keeps every
+mesh-axis collective on direct ICI links (the scaling-book recipe: pick a
+mesh congruent to the hardware, annotate shardings, let XLA insert the
+collectives).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(axis_names: Sequence[str] = ("dp", "tp"),
+              shape: Optional[Tuple[int, ...]] = None,
+              devices=None) -> Mesh:
+    """General mesh over the visible devices.  Default: dp × tp with tp
+    along the innermost (fastest-ICI) dimension."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs)
+    if shape is None:
+        # squarest 2-way factorization, tp innermost
+        tp = 1
+        for f in range(int(n**0.5), 0, -1):
+            if n % f == 0:
+                tp = f
+                break
+        shape = (n // tp, tp)
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def mesh_from_rectangle(shape: Tuple[int, ...],
+                        axis_names: Optional[Sequence[str]] = None,
+                        devices=None) -> Mesh:
+    """Mesh whose axes mirror a gang rectangle's non-trivial dims, largest
+    first (vtpu.device.topology.mesh_axes_for)."""
+    dims = sorted([d for d in shape if d > 1], reverse=True) or [1]
+    if axis_names is None:
+        axis_names = [f"ici{i}" for i in range(len(dims))]
+    devs = list(devices if devices is not None else jax.devices())
+    want = int(np.prod(dims))
+    if len(devs) < want:
+        raise ValueError(f"rectangle {shape} needs {want} devices, have {len(devs)}")
+    arr = np.array(devs[:want]).reshape(dims)
+    return Mesh(arr, tuple(axis_names))

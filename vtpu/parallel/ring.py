@@ -1,0 +1,76 @@
+"""Ring attention — sequence parallelism over the ICI ring.
+
+Long-context attention where the sequence is sharded across chips: each
+chip holds one Q/K/V shard, computes blockwise attention against the KV
+shard it currently holds, then `ppermute`s the KV shard one hop around the
+ring.  After N hops every Q shard has attended to the full sequence, with
+online-softmax merging partial results — no chip ever materialises the full
+sequence (HBM) and all transfers are neighbor-to-neighbor ICI.
+
+Implemented with shard_map + lax.ppermute; the per-shard inner op is the
+Pallas flash kernel (vtpu.ops.attention) on TPU, the XLA reference off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from vtpu.ops.attention import NEG_INF, reference_attention
+
+
+def _partial_attention(q, k, v, sm_scale):
+    """Blockwise partials for one KV shard: returns (acc, m, l)."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def _merge(acc1, m1, l1, acc2, m2, l2):
+    """Online-softmax merge of two partial attention results."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    return acc1 * a1 + acc2 * a2, m, l1 * a1 + l2 * a2
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp"):
+    """q,k,v: [batch, heads, seq, d] with seq sharded over mesh axis
+    ``axis``.  Returns attention output with the same sharding."""
+    n_shards = mesh.shape[axis]
+    sm_scale = q.shape[-1] ** -0.5
+
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def shard_fn(q_s, k_s, v_s):
+        # first hop outside the loop so the carry is data-derived (its
+        # sharding/vma type then matches across loop iterations)
+        acc, m, l = _partial_attention(q_s, k_s, v_s, sm_scale)
+        k_cur = jax.lax.ppermute(k_s, axis, perm)
+        v_cur = jax.lax.ppermute(v_s, axis, perm)
+
+        def hop(i, carry):
+            acc, m, l, k_c, v_c = carry
+            a, mm, ll = _partial_attention(q_s, k_c, v_c, sm_scale)
+            acc, m, l = _merge(acc, m, l, a, mm, ll)
+            # rotate KV one hop around the ring (neighbor ICI transfer)
+            k_n = jax.lax.ppermute(k_c, axis, perm)
+            v_n = jax.lax.ppermute(v_c, axis, perm)
+            return acc, m, l, k_n, v_n
+
+        acc, m, l, _, _ = jax.lax.fori_loop(
+            0, n_shards - 1, hop, (acc, m, l, k_cur, v_cur)
+        )
+        return (acc / jnp.maximum(l, 1e-30)).astype(q_s.dtype)
+
+    spec = P(None, None, axis, None)
+    return jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

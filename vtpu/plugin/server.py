@@ -96,40 +96,71 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
 
     def GetPreferredAllocation(self, request, context):  # noqa: N802
         """ICI-aware preferred picks over kubelet's available fake IDs
-        (ref MLU server.go:441-491; NVIDIA leaves this empty)."""
+        (ref MLU server.go:441-491; NVIDIA leaves this empty).
+
+        allocation_size counts fake IDs (shares), not chips: several shares
+        of one chip are legal.  Preference order: (1) more shares of chips
+        already pinned by must-include (locality), (2) shares of extra chips
+        chosen by the ICI allocator anchored on the pinned chips, (3) plain
+        fill.  The response always has exactly allocation_size unique IDs
+        when enough are available.
+        """
         resp = pb.PreferredAllocationResponse()
         chips_by_uuid = {c.uuid: c for c in self.cache.chips()}
         topo = self.cache.provider.topology()
         for creq in request.container_requests:
-            chosen: List[str] = []
-            # group available fake IDs per chip
+            must = list(creq.must_include_deviceIDs)
+            total = creq.allocation_size
+            if total <= len(must):
+                resp.container_responses.append(
+                    pb.ContainerPreferredAllocationResponse(deviceIDs=must[:total])
+                )
+                continue
+            # available shares per chip, minus the pinned IDs themselves
             per_chip: Dict[str, List[str]] = {}
             for fid in creq.available_deviceIDs:
+                if fid in must:
+                    continue
                 per_chip.setdefault(fake_id_to_uuid(fid), []).append(fid)
-            must = list(creq.must_include_deviceIDs)
-            must_chips_uuids = {fake_id_to_uuid(fid) for fid in must}
-            must_chips = [
-                chips_by_uuid[u] for u in must_chips_uuids if u in chips_by_uuid
-            ]
-            avail_chips = [
-                chips_by_uuid[u]
-                for u in per_chip
-                if u in chips_by_uuid and u not in must_chips_uuids
-            ]
-            try:
-                # anchor the rectangle ON the pinned chips so must+chosen is
-                # one contiguous gang, not a pinned chip plus a far corner
-                picked = IciAllocator(topo, self.cfg.ici_policy).allocate(
-                    avail_chips, creq.allocation_size, must_include=must_chips
-                )
-                for chip in picked:
-                    if chip.uuid in must_chips_uuids:
-                        continue  # already present via `must`
-                    chosen.append(per_chip[chip.uuid][0])
-            except AllocationError as e:
-                log.info("preferred allocation fallback: %s", e)
-                flat = [fid for fids in per_chip.values() for fid in fids]
-                chosen = flat[: max(creq.allocation_size - len(must), 0)]
+            must_chip_uuids = {fake_id_to_uuid(fid) for fid in must}
+            need = total - len(must)
+            chosen: List[str] = []
+            # (1) extra shares of the pinned chips first
+            for u in sorted(must_chip_uuids):
+                while need > 0 and per_chip.get(u):
+                    chosen.append(per_chip[u].pop(0))
+                    need -= 1
+            if need > 0:
+                must_chips = [
+                    chips_by_uuid[u] for u in must_chip_uuids if u in chips_by_uuid
+                ]
+                avail_chips = [
+                    chips_by_uuid[u]
+                    for u, fids in per_chip.items()
+                    if u in chips_by_uuid and fids and u not in must_chip_uuids
+                ]
+                order: List[str] = []
+                try:
+                    n_chips = min(need, len(avail_chips)) + len(must_chips)
+                    picked = IciAllocator(topo, self.cfg.ici_policy).allocate(
+                        avail_chips, n_chips, must_include=must_chips
+                    )
+                    order = [c.uuid for c in picked if c.uuid not in must_chip_uuids]
+                except AllocationError as e:
+                    log.info("preferred allocation fallback: %s", e)
+                    order = [u for u in sorted(per_chip) if per_chip[u]]
+                # (2) one share per chip in ICI order, then (3) round-robin
+                # remaining shares until the size is met
+                progress = True
+                while need > 0 and progress:
+                    progress = False
+                    for u in order:
+                        if need <= 0:
+                            break
+                        if per_chip.get(u):
+                            chosen.append(per_chip[u].pop(0))
+                            need -= 1
+                            progress = True
             resp.container_responses.append(
                 pb.ContainerPreferredAllocationResponse(deviceIDs=must + chosen)
             )
@@ -178,14 +209,12 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
         # (Allocate is called once per container, serialised by the node
         # lock; ref hostdir /usr/local/vgpu/containers/<podUID>_<ctr>).
         pod_uid = pod["metadata"]["uid"]
-        try:
-            os.makedirs(cfg.cache_host_root, exist_ok=True)
-            ordinal = len(
-                [d for d in os.listdir(cfg.cache_host_root)
-                 if d.startswith(f"{pod_uid}_")]
-            )
-        except OSError:
-            ordinal = 0
+        os.makedirs(cfg.cache_host_root, exist_ok=True)
+        # first FREE ordinal (a count would collide with survivors after a
+        # GC gap and silently merge two containers' regions)
+        ordinal = 0
+        while os.path.exists(f"{cfg.cache_host_root}/{pod_uid}_{ordinal}"):
+            ordinal += 1
         cache_host = f"{cfg.cache_host_root}/{pod_uid}_{ordinal}"
         os.makedirs(cache_host, exist_ok=True)
         os.makedirs("/tmp/vtpulock", exist_ok=True)

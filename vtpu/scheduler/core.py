@@ -242,8 +242,12 @@ class Scheduler:
     # ------------------------------------------------------------------
     # Bind (ref Bind scheduler.go:402-442)
     # ------------------------------------------------------------------
-    def bind(self, namespace: str, name: str, node: str) -> Optional[str]:
-        """Returns error string or None on success."""
+    def bind(
+        self, namespace: str, name: str, node: str, pod_uid: str = ""
+    ) -> Optional[str]:
+        """Returns error string or None on success.  ``pod_uid`` (from
+        ExtenderBindingArgs) lets the failure path unbook a pod that has
+        already vanished from the API."""
         try:
             lock_node(self.client, node)
         except Exception as e:  # noqa: BLE001
@@ -268,11 +272,15 @@ class Scheduler:
                 log.warning("could not mark bind-phase=failed on %s/%s", namespace, name)
             # drop the phantom booking so OTHER pods see the capacity again
             # while this one sits in kube-scheduler backoff
-            try:
-                pod = self.client.get_pod(namespace, name)
-                self.pods.rm_pod(pod_uid(pod))
-            except Exception:  # noqa: BLE001
-                pass
+            if pod_uid:
+                self.pods.rm_pod(pod_uid)
+            else:
+                try:
+                    pod = self.client.get_pod(namespace, name)
+                    self.pods.rm_pod(pod["metadata"]["uid"])
+                except Exception:  # noqa: BLE001 — pod gone AND no uid given;
+                    # the next ingest_pods sweep reconciles
+                    pass
             try:
                 release_node_lock(self.client, node)
             except Exception:  # noqa: BLE001

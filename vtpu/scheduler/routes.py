@@ -66,6 +66,7 @@ def bind_handler(sched: Scheduler, args: dict) -> dict:
         _get(args, "podNamespace", "PodNamespace", default="default"),
         _get(args, "podName", "PodName", default=""),
         _get(args, "node", "Node", default=""),
+        pod_uid=_get(args, "podUID", "PodUID", default=""),
     )
     return {"error": err or ""}
 

@@ -104,16 +104,25 @@ class ShimRuntime:
 
     def try_alloc(self, nbytes: int, dev: int = 0, kind: str = "buffer") -> None:
         """Account an allocation; raise QuotaExceeded when over quota
-        (unless oversubscribe)."""
+        (unless oversubscribe).  Check-and-add is atomic under the region's
+        cross-process flock — two tenants racing for the last bytes cannot
+        both be admitted."""
         limit = self.limit_for(dev)
-        if limit and not self.oversubscribe:
-            if self.device_usage(dev) + nbytes > limit:
+        if self.region is not None:
+            ok = self.region.try_add(
+                self.pid, dev, nbytes, kind, limit=limit,
+                oversubscribe=self.oversubscribe,
+            )
+            if not ok:
                 raise QuotaExceeded(
                     f"vtpu: device {dev} quota {limit} B exceeded "
                     f"(in use {self.device_usage(dev)}, want {nbytes})"
                 )
-        if self.region is not None:
-            self.region.add_usage(self.pid, dev, nbytes, kind)
+        elif limit and not self.oversubscribe:
+            if self._local.get(dev, 0) + nbytes > limit:
+                raise QuotaExceeded(
+                    f"vtpu: device {dev} quota {limit} B exceeded"
+                )
         self._local[dev] = self._local.get(dev, 0) + nbytes
 
     def free(self, nbytes: int, dev: int = 0, kind: str = "buffer") -> None:
