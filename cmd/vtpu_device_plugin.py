@@ -72,15 +72,38 @@ def main(argv=None) -> int:
     client = new_client()
     cache = DeviceCache(provider)
     cache.start()
-    registrar = Registrar(client, cache, cfg)
+    # in mixed partition mode, core-partitioned chips are kubelet-allocated
+    # and never registered to the scheduler (the MIG behavior)
+    reg_filter = (
+        (lambda c: c.tensorcores <= 1)
+        if cfg.partition_strategy == "mixed"
+        else None
+    )
+    registrar = Registrar(client, cache, cfg, chip_filter=reg_filter)
     registrar.start()
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
 
-    servicer = VtpuDevicePlugin(client, cache, cfg)
-    srv = PluginServer(servicer, cfg)
+    from vtpu.plugin.strategy import new_partition_strategy
+
+    # one kubelet plugin per partition-strategy spec (mixed mode adds a
+    # server per TensorCore shape, ref mig-strategy.go:169-210)
+    strategy = new_partition_strategy(cfg.partition_strategy)
+
+    def build_servers():
+        return [
+            PluginServer(s.servicer, cfg, s.resource_name, s.socket_name)
+            for s in strategy.get_plugins(client, cache, cfg)
+        ]
+
+    servers = build_servers()
+    restart_guard = servers[0]
+
+    def stop_all():
+        for s in servers:
+            s.stop()
 
     def kubelet_mtime() -> float:
         try:
@@ -89,19 +112,19 @@ def main(argv=None) -> int:
             return 0.0
 
     while not stop.is_set():
-        srv.serve()
         try:
-            srv.register_with_kubelet(args.kubelet_socket)
+            for s in servers:
+                s.serve()
+                s.register_with_kubelet(args.kubelet_socket)
         except Exception:  # noqa: BLE001 — kubelet may be restarting
             log.exception("kubelet registration failed; retrying in 5s")
-            srv.stop()
+            stop_all()
             if stop.wait(5):
                 break
-            if not srv.allow_restart():
+            if not restart_guard.allow_restart():
                 log.error("too many restarts; exiting")
                 return 1
-            servicer = VtpuDevicePlugin(client, cache, cfg)
-            srv = PluginServer(servicer, cfg)
+            servers = build_servers()
             continue
         seen = kubelet_mtime()
         # watch for kubelet restarts (socket recreation ⇒ re-register;
@@ -110,17 +133,16 @@ def main(argv=None) -> int:
             now = kubelet_mtime()
             if now != seen:
                 log.info("kubelet socket changed; restarting plugin")
-                if not srv.allow_restart():
+                if not restart_guard.allow_restart():
                     log.error("too many restarts within the hour; exiting")
                     return 1
-                srv.stop()
-                servicer = VtpuDevicePlugin(client, cache, cfg)
-                srv = PluginServer(servicer, cfg)
+                stop_all()
+                servers = build_servers()
                 break
         else:
             break
 
-    srv.stop()
+    stop_all()
     registrar.stop()
     cache.stop()
     return 0

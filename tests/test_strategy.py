@@ -1,0 +1,140 @@
+"""TensorCore partition strategy tests (the MIG-strategy analog,
+ref pkg/device-plugin/nvidiadevice/mig-strategy.go)."""
+
+import pytest
+
+from vtpu.device import FakeProvider
+from vtpu.device.chip import tensorcores_for_model
+from vtpu.k8s import FakeClient
+from vtpu.plugin import v1beta1_pb2 as pb
+from vtpu.plugin.cache import DeviceCache
+from vtpu.plugin.config import PluginConfig
+from vtpu.plugin.register import build_device_infos
+from vtpu.plugin.strategy import (
+    CorePartitionPlugin,
+    MixedStrategy,
+    core_device_id,
+    new_partition_strategy,
+    parse_core_device_id,
+    partition_resource_name,
+)
+
+
+V5P_FIXTURE = {
+    "model": "TPU-v5p",
+    "topology": "2x2x1",
+    "hbm_mb": 96 * 1024,
+    "tensorcores": 2,
+}
+V5E_FIXTURE = {"model": "TPU-v5e", "topology": "2x2x1", "hbm_mb": 16384}
+
+
+def make_rig(fixture):
+    client = FakeClient()
+    provider = FakeProvider(fixture)
+    cache = DeviceCache(provider, poll_interval_s=1000)
+    cfg = PluginConfig(node_name="n1", device_split_count=4)
+    return client, cache, cfg
+
+
+def test_tensorcores_by_model():
+    assert tensorcores_for_model("TPU-v5p") == 2
+    assert tensorcores_for_model("TPU-v4") == 2
+    assert tensorcores_for_model("TPU-v5e") == 1
+    assert tensorcores_for_model("TPU-v5litepod") == 1
+
+
+def test_core_id_roundtrip():
+    fid = core_device_id("tpu-v5p-h-3", 1)
+    assert parse_core_device_id(fid) == ("tpu-v5p-h-3", 1)
+
+
+def test_resource_shape_name():
+    # ref mig-<g>g.<gb>gb naming, mig-strategy.go:181
+    assert partition_resource_name("google.com/tpu", 1, 48) == "google.com/tpucore-1c.48gb"
+
+
+def test_single_strategy_unsupported():
+    # ref migStrategySingle panics (mig-strategy.go:155-160)
+    with pytest.raises(ValueError):
+        new_partition_strategy("single")
+    with pytest.raises(ValueError):
+        new_partition_strategy("bogus")
+
+
+def test_none_strategy_one_plugin():
+    client, cache, cfg = make_rig(V5P_FIXTURE)
+    specs = new_partition_strategy("none").get_plugins(client, cache, cfg)
+    assert len(specs) == 1
+    assert specs[0].resource_name == cfg.resource_name
+    assert specs[0].uses_scheduler
+
+
+def test_mixed_strategy_builds_shape_plugins():
+    client, cache, cfg = make_rig(V5P_FIXTURE)
+    specs = MixedStrategy().get_plugins(client, cache, cfg)
+    # main plugin + one per distinct core shape (all v5p chips share one)
+    assert len(specs) == 2
+    main, core = specs
+    assert main.resource_name == cfg.resource_name
+    assert core.resource_name == "google.com/tpucore-1c.48gb"
+    assert not core.uses_scheduler
+    # main plugin advertises nothing — every v5p chip is partitioned
+    assert main.servicer._api_devices() == []
+    # core plugin advertises 2 cores × 4 chips, exclusive (no splits)
+    devs = core.servicer._api_devices()
+    assert len(devs) == 8
+    assert all(d.health == "Healthy" for d in devs)
+
+
+def test_mixed_strategy_v5e_all_on_main():
+    client, cache, cfg = make_rig(V5E_FIXTURE)
+    specs = MixedStrategy().get_plugins(client, cache, cfg)
+    assert len(specs) == 1  # nothing to partition
+    assert len(specs[0].servicer._api_devices()) == 4 * cfg.device_split_count
+
+
+def test_core_plugin_allocate_direct_env():
+    """Core allocation bypasses the scheduler handshake
+    (ref MIG allocate via env list, plugin.go:285-315)."""
+    client, cache, cfg = make_rig(V5P_FIXTURE)
+    chips = cache.chips()
+    plugin = CorePartitionPlugin(cache, cfg, shape_gb=48)
+    req = pb.AllocateRequest()
+    creq = req.container_requests.add()
+    creq.devicesIDs.append(core_device_id(chips[0].uuid, 0))
+    creq.devicesIDs.append(core_device_id(chips[0].uuid, 1))
+    creq.devicesIDs.append(core_device_id(chips[2].uuid, 0))
+    resp = plugin.Allocate(req, None)
+    envs = resp.container_responses[0].envs
+    assert envs["TPU_VISIBLE_CHIPS"] == f"{chips[0].index},{chips[2].index}"
+    assert envs["VTPU_VISIBLE_CORES"] == (
+        f"{chips[0].index}:0,{chips[0].index}:1,{chips[2].index}:0"
+    )
+    # per-core HBM = chip HBM / tensorcores
+    assert envs["TPU_DEVICE_MEMORY_LIMIT_0"] == str(96 * 1024 // 2)
+    # device nodes mounted once per chip
+    assert len(resp.container_responses[0].devices) == 2
+
+
+def test_mixed_registrar_excludes_partitioned_chips():
+    """Partitioned chips never reach the scheduler's registry
+    (ref: MIG devices are kubelet-managed, not extender-scheduled)."""
+    client, cache, cfg = make_rig(V5P_FIXTURE)
+    infos = build_device_infos(cache, cfg, chip_filter=lambda c: c.tensorcores <= 1)
+    assert infos == []
+    client2, cache2, cfg2 = make_rig(V5E_FIXTURE)
+    infos2 = build_device_infos(cache2, cfg2, chip_filter=lambda c: c.tensorcores <= 1)
+    assert len(infos2) == 4
+
+
+def test_core_plugin_health_propagates():
+    client, cache, cfg = make_rig(V5P_FIXTURE)
+    provider = cache.provider
+    plugin = CorePartitionPlugin(cache, cfg, shape_gb=48)
+    uuid = cache.chips()[0].uuid
+    provider.set_health(uuid, False)
+    cache._poll_once()
+    devs = plugin._api_devices()
+    sick = [d for d in devs if d.health == "Unhealthy"]
+    assert len(sick) == 2  # both cores of the sick chip

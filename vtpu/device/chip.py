@@ -23,6 +23,21 @@ class Chip:
     coords: Optional[Tuple[int, ...]] = None  # position in the local ICI mesh
     devpath: Optional[str] = None             # e.g. "/dev/accel0"
     healthy: bool = True
+    # physical TensorCores on the chip (v4/v5p: 2, v5e: 1) — the unit the
+    # partition strategy (vtpu.plugin.strategy, the MIG analog) carves at
+    tensorcores: int = 1
+
+
+# model substring → TensorCores per chip; single-core models default to 1
+TENSORCORES_BY_MODEL = {"v2": 2, "v3": 2, "v4": 2, "v5p": 2}
+
+
+def tensorcores_for_model(model: str) -> int:
+    m = model.lower()
+    for key, n in TENSORCORES_BY_MODEL.items():
+        if key in m:
+            return n
+    return 1
 
 
 class DeviceProvider(Protocol):

@@ -25,7 +25,7 @@ import json
 import os
 from typing import List, Optional, Union
 
-from vtpu.device.chip import Chip
+from vtpu.device.chip import Chip, tensorcores_for_model
 from vtpu.device.topology import Topology
 
 ENV_MOCK_JSON = "VTPU_MOCK_JSON"
@@ -63,6 +63,15 @@ class FakeProvider:
                     coords=coords,
                     devpath=cs.get("devpath", f"/dev/accel{i}"),
                     healthy=bool(cs.get("healthy", True)),
+                    tensorcores=int(
+                        cs.get(
+                            "tensorcores",
+                            data.get(
+                                "tensorcores",
+                                tensorcores_for_model(cs.get("model", self._model)),
+                            ),
+                        )
+                    ),
                 )
             )
 

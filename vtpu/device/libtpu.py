@@ -22,7 +22,7 @@ import os
 import socket
 from typing import List, Optional
 
-from vtpu.device.chip import Chip
+from vtpu.device.chip import Chip, tensorcores_for_model
 from vtpu.device.topology import KNOWN_SLICES, Topology
 
 log = logging.getLogger(__name__)
@@ -102,6 +102,7 @@ class LibtpuProvider:
                     hbm_mb=hbm,
                     coords=coords,
                     devpath=paths[i] if i < len(paths) else None,
+                    tensorcores=tensorcores_for_model(model),
                 )
             )
         return chips
@@ -135,6 +136,7 @@ class LibtpuProvider:
                     hbm_mb=int(hbm_bytes // (1024 * 1024)) if hbm_bytes else
                     HBM_MB_BY_MODEL.get("TPU-v5e", 16 * 1024),
                     coords=coords,
+                    tensorcores=tensorcores_for_model(model),
                 )
             )
         if not chips:

@@ -34,6 +34,9 @@ class PluginConfig:
     # TPU_CORE_UTILIZATION_POLICY: default | force | disable (ref docs/config.md)
     core_utilization_policy: str = "default"
     ici_policy: str = "best-effort"
+    # TensorCore partition strategy: none | single | mixed
+    # (ref migStrategy, mig-strategy.go:46-56 + docs/config.md)
+    partition_strategy: str = "none"
 
     @classmethod
     def from_env(cls, config_file: Optional[str] = None) -> "PluginConfig":
@@ -49,6 +52,8 @@ class PluginConfig:
                 setattr(cfg, field, type(getattr(cfg, field))(float(v)))
         if os.environ.get("VTPU_RESOURCE_NAME"):
             cfg.resource_name = os.environ["VTPU_RESOURCE_NAME"]
+        if os.environ.get("VTPU_PARTITION_STRATEGY"):
+            cfg.partition_strategy = os.environ["VTPU_PARTITION_STRATEGY"]
         # per-node overrides from a ConfigMap-mounted JSON file
         # (ref main.go:85-108: devicememoryscaling/devicesplitcount per node)
         path = config_file or os.environ.get("VTPU_NODE_CONFIG", "/config/config.json")
@@ -62,6 +67,8 @@ class PluginConfig:
                             cfg.device_memory_scaling = float(entry["devicememoryscaling"])
                         if "devicesplitcount" in entry:
                             cfg.device_split_count = int(entry["devicesplitcount"])
+                        if "partitionstrategy" in entry:
+                            cfg.partition_strategy = str(entry["partitionstrategy"])
                         log.info("applied per-node config overrides for %s", cfg.node_name)
             except (OSError, ValueError, json.JSONDecodeError):
                 log.exception("bad node config file %s; using defaults", path)

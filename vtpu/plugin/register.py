@@ -29,11 +29,18 @@ from vtpu.utils.types import (
 log = logging.getLogger(__name__)
 
 
-def build_device_infos(cache: DeviceCache, cfg: PluginConfig) -> List[ChipInfo]:
+def build_device_infos(
+    cache: DeviceCache, cfg: PluginConfig, chip_filter=None
+) -> List[ChipInfo]:
     """Chip → registration record (ref apiDevices register.go:56-82:
-    Count=split, Devmem=mem×scaling, Type, Health)."""
+    Count=split, Devmem=mem×scaling, Type, Health).  ``chip_filter``
+    excludes core-partitioned chips in mixed partition mode — those are
+    allocated by kubelet directly, never by the scheduler (the MIG
+    behavior, plugin.go:285-315)."""
     out = []
     for chip in cache.chips():
+        if chip_filter is not None and not chip_filter(chip):
+            continue
         out.append(
             ChipInfo(
                 uuid=chip.uuid,
@@ -48,9 +55,11 @@ def build_device_infos(cache: DeviceCache, cfg: PluginConfig) -> List[ChipInfo]:
     return out
 
 
-def register_once(client, cache: DeviceCache, cfg: PluginConfig) -> None:
+def register_once(
+    client, cache: DeviceCache, cfg: PluginConfig, chip_filter=None
+) -> None:
     """Ref: RegistrInAnnotation register.go:84-102."""
-    infos = build_device_infos(cache, cfg)
+    infos = build_device_infos(cache, cfg, chip_filter)
     topo = cache.provider.topology()
     ts = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
     client.patch_node_annotations(
@@ -66,10 +75,13 @@ def register_once(client, cache: DeviceCache, cfg: PluginConfig) -> None:
 class Registrar:
     """ref WatchAndRegister register.go:104-115 (30 s loop, 5 s on error)."""
 
-    def __init__(self, client, cache: DeviceCache, cfg: PluginConfig) -> None:
+    def __init__(
+        self, client, cache: DeviceCache, cfg: PluginConfig, chip_filter=None
+    ) -> None:
         self.client = client
         self.cache = cache
         self.cfg = cfg
+        self.chip_filter = chip_filter
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -77,7 +89,7 @@ class Registrar:
         def loop() -> None:
             while not self._stop.is_set():
                 try:
-                    register_once(self.client, self.cache, self.cfg)
+                    register_once(self.client, self.cache, self.cfg, self.chip_filter)
                     delay = REGISTER_INTERVAL_S
                 except Exception:  # noqa: BLE001
                     log.exception("node registration failed; retrying")

@@ -53,10 +53,16 @@ def fake_id_to_uuid(fake_id: str) -> str:
 
 
 class VtpuDevicePlugin(api.DevicePluginServicer):
-    def __init__(self, client, cache: DeviceCache, cfg: PluginConfig) -> None:
+    def __init__(
+        self, client, cache: DeviceCache, cfg: PluginConfig, chip_filter=None
+    ) -> None:
         self.client = client
         self.cache = cache
         self.cfg = cfg
+        # which chips this plugin advertises (the mixed partition strategy
+        # keeps multi-TensorCore chips off the shared plugin,
+        # ref mig-strategy.go:169-210)
+        self.chip_filter = chip_filter or (lambda c: True)
         self._gen = 0
         self._cond = threading.Condition()
         self._stopped = threading.Event()
@@ -72,6 +78,8 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
         """ref apiDevices plugin.go:446-467."""
         out = []
         for chip in self.cache.chips():
+            if not self.chip_filter(chip):
+                continue
             health = "Healthy" if chip.healthy else "Unhealthy"
             for fid in split_device_ids(chip.uuid, self.cfg.device_split_count):
                 out.append(pb.Device(ID=fid, health=health))
@@ -292,15 +300,25 @@ class PluginServer:
 
     MAX_RESTARTS_PER_HOUR = 5
 
-    def __init__(self, servicer: VtpuDevicePlugin, cfg: PluginConfig) -> None:
+    def __init__(
+        self,
+        servicer: api.DevicePluginServicer,
+        cfg: PluginConfig,
+        resource_name: Optional[str] = None,
+        socket_name: Optional[str] = None,
+    ) -> None:
+        """resource/socket overrides let the partition strategy run one
+        server per resource shape (ref mig-strategy.go:169-210)."""
         self.servicer = servicer
         self.cfg = cfg
+        self.resource_name = resource_name or cfg.resource_name
+        self.socket_name = socket_name or cfg.socket_name
         self.server: Optional[grpc.Server] = None
         self._restarts: List[float] = []
 
     @property
     def socket_path(self) -> str:
-        return os.path.join(self.cfg.socket_dir, self.cfg.socket_name)
+        return os.path.join(self.cfg.socket_dir, self.socket_name)
 
     def serve(self) -> None:
         if os.path.exists(self.socket_path):
@@ -317,15 +335,15 @@ class PluginServer:
             api.RegistrationStub(ch).Register(
                 pb.RegisterRequest(
                     version=api.VERSION,
-                    endpoint=self.cfg.socket_name,
-                    resource_name=self.cfg.resource_name,
+                    endpoint=self.socket_name,
+                    resource_name=self.resource_name,
                     options=pb.DevicePluginOptions(
                         get_preferred_allocation_available=True
                     ),
                 ),
                 timeout=10,
             )
-        log.info("registered %s with kubelet", self.cfg.resource_name)
+        log.info("registered %s with kubelet", self.resource_name)
 
     def allow_restart(self) -> bool:
         now = time.time()

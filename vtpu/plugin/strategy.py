@@ -1,0 +1,248 @@
+"""TensorCore partition strategies — the MIG-strategy analog.
+
+Ref: pkg/device-plugin/nvidiadevice/mig-strategy.go — ``NewMigStrategy``
+(:46) dispatches ``none`` / ``single`` (panics, unsupported :155-160) /
+``mixed`` (:169-210, one kubelet plugin per MIG resource shape
+``nvidia.com/mig-<g>g.<gb>gb``), and MIG allocation bypasses the
+scheduler handshake entirely: the plugin answers ``Allocate`` with a
+direct env device list (plugin.go:285-315).
+
+TPU analog: v2/v3/v4/v5p chips carry TWO TensorCores each, individually
+schedulable by libtpu (per-core visibility envs); v5e chips carry one.
+The ``mixed`` strategy carves every multi-core chip into per-core
+exclusive devices advertised under a shaped resource name
+``google.com/tpucore-1c.<gb>gb`` (the ``mig-<g>g.<gb>gb`` naming scheme),
+while single-core chips stay on the main shared-resource plugin.  Core
+devices are exclusive (no split shares) — matching MIG slices, which the
+vGPU splitter never subdivides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from typing import List, Sequence
+
+from vtpu.device.chip import Chip
+from vtpu.plugin import api
+from vtpu.plugin import v1beta1_pb2 as pb
+from vtpu.plugin.cache import DeviceCache
+from vtpu.plugin.config import PluginConfig
+
+log = logging.getLogger(__name__)
+
+STRATEGY_NONE = "none"
+STRATEGY_SINGLE = "single"
+STRATEGY_MIXED = "mixed"
+
+
+def core_device_id(chip_uuid: str, core: int) -> str:
+    """Fake-ID for one TensorCore (ref MIG device IDs, mig.go)."""
+    return f"{chip_uuid}-core{core}"
+
+
+def parse_core_device_id(fid: str) -> tuple:
+    uuid, _, core = fid.rpartition("-core")
+    return uuid, int(core)
+
+
+def partition_resource_name(prefix: str, ncores: int, gb: int) -> str:
+    """``<domain>/tpucore-<n>c.<gb>gb`` (ref mig-<g>g.<gb>gb shape names,
+    mig-strategy.go:181)."""
+    domain = prefix.split("/")[0]
+    return f"{domain}/tpucore-{ncores}c.{gb}gb"
+
+
+@dataclasses.dataclass
+class PluginSpec:
+    """One kubelet plugin to run: a resource name + its servicer.
+
+    Ref: migStrategyMixed.GetPlugins returns one NvidiaDevicePlugin per
+    resource (mig-strategy.go:169-210)."""
+
+    resource_name: str
+    socket_name: str
+    servicer: api.DevicePluginServicer
+    # whether this plugin participates in the scheduler annotation
+    # handshake (main resource) or allocates directly (core shapes)
+    uses_scheduler: bool = True
+
+
+class CorePartitionPlugin(api.DevicePluginServicer):
+    """Kubelet plugin for one TensorCore shape.
+
+    ListAndWatch advertises one exclusive device per core of every
+    partitioned chip; Allocate maps kubelet's picks straight to the shim
+    env ABI without consulting the scheduler (ref MIG allocate via env
+    list, plugin.go:285-315).
+    """
+
+    def __init__(self, cache: DeviceCache, cfg: PluginConfig, shape_gb: int) -> None:
+        self.cache = cache
+        self.cfg = cfg
+        self.shape_gb = shape_gb
+        self._gen = 0
+        self._cond = threading.Condition()
+        self._stopped = threading.Event()
+        cache.subscribe(f"core-plugin-{shape_gb}gb", self._on_health_change)
+
+    def _on_health_change(self, _chips) -> None:
+        with self._cond:
+            self._gen += 1
+            self._cond.notify_all()
+
+    def _partitioned_chips(self) -> List[Chip]:
+        return [
+            c
+            for c in self.cache.chips()
+            if c.tensorcores > 1 and _core_gb(c) == self.shape_gb
+        ]
+
+    def _api_devices(self) -> List[pb.Device]:
+        out = []
+        for chip in self._partitioned_chips():
+            health = "Healthy" if chip.healthy else "Unhealthy"
+            for j in range(chip.tensorcores):
+                out.append(pb.Device(ID=core_device_id(chip.uuid, j), health=health))
+        return out
+
+    # -- gRPC methods ----------------------------------------------------
+    def GetDevicePluginOptions(self, request, context):  # noqa: N802
+        return pb.DevicePluginOptions()
+
+    def ListAndWatch(self, request, context):  # noqa: N802
+        last_gen = -1
+        while not self._stopped.is_set():
+            with self._cond:
+                if self._gen == last_gen:
+                    self._cond.wait(timeout=5.0)
+                if self._gen == last_gen:
+                    continue
+                last_gen = self._gen
+            yield pb.ListAndWatchResponse(devices=self._api_devices())
+
+    def Allocate(self, request, context):  # noqa: N802
+        """Direct env injection per container (ref plugin.go:285-315:
+        MIG allocate never touches pod annotations)."""
+        chips_by_uuid = {c.uuid: c for c in self.cache.chips()}
+        resp = pb.AllocateResponse()
+        for creq in request.container_requests:
+            cresp = pb.ContainerAllocateResponse()
+            indices: List[str] = []
+            cores: List[str] = []
+            for i, fid in enumerate(creq.devicesIDs):
+                uuid, core = parse_core_device_id(fid)
+                chip = chips_by_uuid.get(uuid)
+                if chip is None:
+                    context.abort(
+                        api.grpc.StatusCode.INVALID_ARGUMENT,
+                        f"unknown core device {fid}",
+                    )
+                idx = str(chip.index)
+                if idx not in indices:
+                    indices.append(idx)
+                    if chip.devpath:
+                        cresp.devices.append(
+                            pb.DeviceSpec(
+                                container_path=chip.devpath,
+                                host_path=chip.devpath,
+                                permissions="rw",
+                            )
+                        )
+                cores.append(f"{chip.index}:{core}")
+                cresp.envs[f"TPU_DEVICE_MEMORY_LIMIT_{i}"] = str(
+                    chip.hbm_mb // chip.tensorcores
+                )
+            cresp.envs["TPU_VISIBLE_CHIPS"] = ",".join(indices)
+            cresp.envs["TPU_VISIBLE_DEVICES"] = ",".join(indices)
+            # chip:core pairs so libtpu-side per-core isolation can be set
+            # up by the shim (our analog of CUDA_VISIBLE_DEVICES for MIG)
+            cresp.envs["VTPU_VISIBLE_CORES"] = ",".join(cores)
+            cresp.envs["TPU_DEVICE_MEMORY_SHARED_CACHE"] = (
+                f"{self.cfg.container_cache_dir}/vtpu.cache"
+            )
+            resp.container_responses.append(cresp)
+        return resp
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._cond:
+            self._cond.notify_all()
+
+
+def _core_gb(chip: Chip) -> int:
+    return max(1, (chip.hbm_mb // chip.tensorcores) // 1024)
+
+
+class PartitionStrategy:
+    """ref: Strategy interface, mig-strategy.go:40-44."""
+
+    def get_plugins(
+        self, client, cache: DeviceCache, cfg: PluginConfig
+    ) -> List[PluginSpec]:
+        raise NotImplementedError
+
+
+class NoneStrategy(PartitionStrategy):
+    """Whole chips only — every chip on the main shared plugin
+    (ref migStrategyNone, mig-strategy.go:147-153)."""
+
+    def get_plugins(self, client, cache, cfg) -> List[PluginSpec]:
+        from vtpu.plugin.server import VtpuDevicePlugin
+
+        return [
+            PluginSpec(
+                resource_name=cfg.resource_name,
+                socket_name=cfg.socket_name,
+                servicer=VtpuDevicePlugin(client, cache, cfg),
+            )
+        ]
+
+
+class MixedStrategy(PartitionStrategy):
+    """Single-core chips on the main shared plugin; each multi-core chip
+    carved into per-core exclusive devices, one plugin per distinct
+    ``tpucore-1c.<gb>gb`` shape (ref migStrategyMixed.GetPlugins,
+    mig-strategy.go:169-210)."""
+
+    def get_plugins(self, client, cache, cfg) -> List[PluginSpec]:
+        from vtpu.plugin.server import VtpuDevicePlugin
+
+        specs = [
+            PluginSpec(
+                resource_name=cfg.resource_name,
+                socket_name=cfg.socket_name,
+                servicer=VtpuDevicePlugin(
+                    client, cache, cfg, chip_filter=lambda c: c.tensorcores <= 1
+                ),
+            )
+        ]
+        shapes = sorted(
+            {_core_gb(c) for c in cache.chips() if c.tensorcores > 1}
+        )
+        for gb in shapes:
+            name = partition_resource_name(cfg.resource_name, 1, gb)
+            specs.append(
+                PluginSpec(
+                    resource_name=name,
+                    socket_name=f"vtpu-core-{gb}gb.sock",
+                    servicer=CorePartitionPlugin(cache, cfg, gb),
+                    uses_scheduler=False,
+                )
+            )
+        return specs
+
+
+def new_partition_strategy(name: str) -> PartitionStrategy:
+    """ref NewMigStrategy mig-strategy.go:46-56; ``single`` is unsupported
+    there too (panics at :155-160 — we raise instead)."""
+    if name in ("", STRATEGY_NONE):
+        return NoneStrategy()
+    if name == STRATEGY_MIXED:
+        return MixedStrategy()
+    if name == STRATEGY_SINGLE:
+        raise ValueError(
+            "partition strategy 'single' is unsupported (ref mig-strategy.go:155)"
+        )
+    raise ValueError(f"unknown partition strategy {name!r}")
