@@ -36,6 +36,9 @@ def main(argv=None) -> int:
                    default="/var/lib/kubelet/device-plugins/kubelet.sock")
     p.add_argument("--use-pjrt-discovery", action="store_true",
                    help="query PJRT for chips at startup (holds the chips briefly)")
+    p.add_argument("--device-family", default="tpu", choices=["tpu", "pjrt"],
+                   help="accelerator family to serve (pjrt = second family, "
+                        "the MLU-daemon analog)")
     p.add_argument("--debug", action="store_true")
     args = p.parse_args(argv)
 
@@ -62,7 +65,16 @@ def main(argv=None) -> int:
         if val is not None:
             setattr(cfg, field, val)
 
-    provider = new_provider(use_pjrt=args.use_pjrt_discovery)
+    if args.device_family == "pjrt":
+        cfg.device_family = "pjrt"
+        if cfg.resource_name == "google.com/tpu" and args.resource_name is None:
+            from vtpu.utils.types import resources as _res
+            cfg.resource_name = _res.pjrt_chip
+        cfg.socket_name = "vtpu-pjrt.sock"
+        from vtpu.device.pjrt import PjrtProvider
+        provider = PjrtProvider()
+    else:
+        provider = new_provider(use_pjrt=args.use_pjrt_discovery)
     chips = provider.enumerate()
     if not chips:
         log.error("no TPU chips discovered; exiting")

@@ -34,6 +34,9 @@ def main(argv=None) -> int:
                    choices=["best-effort", "restricted", "guaranteed"])
     p.add_argument("--resource-name", default=None,
                    help="managed chip resource (default google.com/tpu)")
+    p.add_argument("--grpc-bind", default="",
+                   help="serve the legacy DeviceService.Register stream "
+                        "here (e.g. 0.0.0.0:9090; ref scheduler.go:231-266)")
     p.add_argument("--debug", action="store_true")
     args = p.parse_args(argv)
 
@@ -63,11 +66,28 @@ def main(argv=None) -> int:
     srv, _ = serve(sched)
     logging.info("vtpu-scheduler serving on %s", args.http_bind)
 
+    grpc_server = None
+    if args.grpc_bind:
+        import grpc as grpclib
+        from concurrent import futures
+
+        from vtpu.api.register_service import add_device_service
+
+        # each node's Register stream holds a worker thread for its whole
+        # lifetime — size the pool for cluster scale, not request rate
+        grpc_server = grpclib.server(futures.ThreadPoolExecutor(max_workers=256))
+        add_device_service(sched.legacy_register_servicer(), grpc_server)
+        grpc_server.add_insecure_port(args.grpc_bind)
+        grpc_server.start()
+        logging.info("legacy register gRPC on %s", args.grpc_bind)
+
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     srv.shutdown()
+    if grpc_server is not None:
+        grpc_server.stop(grace=1)
     sched.stop()
     return 0
 

@@ -1,0 +1,152 @@
+"""Multi-vendor coexistence — the second accelerator family (generic PJRT)
+alongside TPU, the shape the reference proves with its MLU backend
+(ref util.KnownDevice pkg/util/types.go:79-83, §2.4)."""
+
+from vtpu.device.pjrt import PjrtProvider
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.scheduler import Scheduler
+from vtpu.utils import codec
+from vtpu.utils.resources import resource_reqs
+from vtpu.utils.types import (
+    ChipInfo,
+    DEVICE_TYPE_PJRT,
+    DEVICE_TYPE_TPU,
+    annotations,
+    resources,
+)
+
+
+def chip(uuid, type_):
+    return ChipInfo(
+        uuid=uuid, count=4, hbm_mb=16384, cores=100, type=type_, health=True
+    )
+
+
+def pod_with(limits, name="p"):
+    return new_pod(
+        name, containers=[{"name": "main", "resources": {"limits": limits}}]
+    )
+
+
+def test_resource_reqs_parses_both_families():
+    p = pod_with(
+        {
+            resources.chip: 2,
+            resources.memory_percentage: 50,
+            resources.pjrt_chip: 1,
+            resources.pjrt_memory: 2048,
+        }
+    )
+    reqs = resource_reqs(p)[0]
+    assert len(reqs) == 2
+    tpu, pjrt = reqs
+    assert tpu.type == DEVICE_TYPE_TPU and tpu.nums == 2
+    assert pjrt.type == DEVICE_TYPE_PJRT and pjrt.nums == 1
+    assert pjrt.memreq == 2048
+
+
+def test_pjrt_only_pod_detected():
+    from vtpu.utils.resources import pod_requests_any
+
+    assert pod_requests_any(pod_with({resources.pjrt_chip: 1}))
+
+
+def register_both_families(client, name="n1"):
+    """Simulate two registrar daemons (tpu + pjrt) on one node."""
+    tpu_enc = codec.encode_node_devices([chip("tpu-0", "TPU-v5e")])
+    pjrt_enc = codec.encode_node_devices([chip("pjrt-0", "PJRT-cpu")])
+    client.create_node(
+        new_node(
+            name,
+            annotations={
+                annotations.NODE_HANDSHAKE: "Reported 2026-01-01T00:00:00Z",
+                annotations.NODE_REGISTER: tpu_enc,
+                annotations.NODE_HANDSHAKE_PJRT: "Reported 2026-01-01T00:00:00Z",
+                annotations.NODE_REGISTER_PJRT: pjrt_enc,
+            },
+        )
+    )
+
+
+def test_registry_ingests_both_families():
+    client = FakeClient()
+    register_both_families(client)
+    sched = Scheduler(client)
+    sched.register_from_node_annotations()
+    info = sched.nodes.get("n1")
+    assert info is not None
+    assert {d.uuid for d in info.devices} == {"tpu-0", "pjrt-0"}
+
+
+def test_families_do_not_cross_schedule():
+    client = FakeClient()
+    register_both_families(client)
+    sched = Scheduler(client)
+    sched.register_from_node_annotations()
+
+    tpu_pod = client.create_pod(
+        pod_with({resources.chip: 1, resources.memory_percentage: 25}, "tp")
+    )
+    res = sched.filter(tpu_pod, ["n1"])
+    assert res.node == "n1"
+    enc = client.get_pod("default", "tp")["metadata"]["annotations"][
+        annotations.ASSIGNED_IDS
+    ]
+    devs = codec.decode_pod_devices(enc)
+    assert devs[0][0].uuid == "tpu-0"
+    assert devs[0][0].type == DEVICE_TYPE_TPU
+
+    pjrt_pod = client.create_pod(pod_with({resources.pjrt_chip: 1}, "pp"))
+    res2 = sched.filter(pjrt_pod, ["n1"])
+    assert res2.node == "n1"
+    enc2 = client.get_pod("default", "pp")["metadata"]["annotations"][
+        annotations.ASSIGNED_IDS
+    ]
+    devs2 = codec.decode_pod_devices(enc2)
+    assert devs2[0][0].uuid == "pjrt-0"
+    assert devs2[0][0].type == DEVICE_TYPE_PJRT
+
+
+def test_one_family_expelled_other_survives():
+    client = FakeClient()
+    register_both_families(client)
+    sched = Scheduler(client)
+    sched.register_from_node_annotations()
+    sched.nodes.rm_node_devices("n1", source=annotations.NODE_HANDSHAKE_PJRT)
+    info = sched.nodes.get("n1")
+    assert info is not None
+    assert {d.uuid for d in info.devices} == {"tpu-0"}
+
+
+def test_mixed_family_pod_gets_both():
+    client = FakeClient()
+    register_both_families(client)
+    sched = Scheduler(client)
+    sched.register_from_node_annotations()
+    p = client.create_pod(
+        pod_with(
+            {
+                resources.chip: 1,
+                resources.memory_percentage: 25,
+                resources.pjrt_chip: 1,
+            },
+            "both",
+        )
+    )
+    res = sched.filter(p, ["n1"])
+    assert res.node == "n1"
+    enc = client.get_pod("default", "both")["metadata"]["annotations"][
+        annotations.ASSIGNED_IDS
+    ]
+    devs = codec.decode_pod_devices(enc)[0]
+    assert {d.type for d in devs} == {DEVICE_TYPE_TPU, DEVICE_TYPE_PJRT}
+
+
+def test_pjrt_provider_cpu_enumeration():
+    """PjrtProvider over the test process's CPU devices (conftest forces
+    an 8-device CPU platform)."""
+    prov = PjrtProvider(platform="cpu")
+    chips = prov.enumerate()
+    assert len(chips) >= 1
+    assert all(c.model == "PJRT-cpu" for c in chips)
+    assert prov.health_check() == chips

@@ -1,0 +1,175 @@
+"""Node-validity checks (scheduler-framework shim analog, ref pkg/util/k8s/
++ the bypassed checkNodeValidity at scheduler.go:358-364)."""
+
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.scheduler import Scheduler, SchedulerConfig
+from vtpu.scheduler.nodecheck import (
+    check_node_validity,
+    matches_node_affinity,
+    matches_node_selector,
+    node_schedulable,
+    tolerates_node_taints,
+)
+from vtpu.utils.types import resources
+
+
+def node(labels=None, taints=None, unschedulable=False):
+    n = new_node("n1")
+    if labels:
+        n["metadata"]["labels"] = labels
+    spec = n.setdefault("spec", {})
+    if taints:
+        spec["taints"] = taints
+    if unschedulable:
+        spec["unschedulable"] = True
+    return n
+
+
+def pod(selector=None, affinity=None, tolerations=None):
+    p = {"metadata": {"name": "p", "namespace": "default", "uid": "u1"}, "spec": {}}
+    if selector:
+        p["spec"]["nodeSelector"] = selector
+    if affinity:
+        p["spec"]["affinity"] = {"nodeAffinity": affinity}
+    if tolerations:
+        p["spec"]["tolerations"] = tolerations
+    return p
+
+
+def test_unschedulable():
+    assert node_schedulable(node())
+    assert not node_schedulable(node(unschedulable=True))
+    assert check_node_validity(pod(), node(unschedulable=True)) is not None
+
+
+def test_node_selector():
+    n = node(labels={"pool": "tpu", "zone": "a"})
+    assert matches_node_selector(pod(selector={"pool": "tpu"}), n)
+    assert not matches_node_selector(pod(selector={"pool": "gpu"}), n)
+    assert matches_node_selector(pod(), n)
+
+
+def test_node_affinity_in_notin_exists():
+    n = node(labels={"tpu": "v5e", "size": "4"})
+    req = lambda *terms: {  # noqa: E731
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": list(terms)
+        }
+    }
+    expr = lambda k, op, *v: {"key": k, "operator": op, "values": list(v)}  # noqa: E731
+    assert matches_node_affinity(
+        pod(affinity=req({"matchExpressions": [expr("tpu", "In", "v5e", "v5p")]})), n
+    )
+    assert not matches_node_affinity(
+        pod(affinity=req({"matchExpressions": [expr("tpu", "NotIn", "v5e")]})), n
+    )
+    assert matches_node_affinity(
+        pod(affinity=req({"matchExpressions": [{"key": "tpu", "operator": "Exists"}]})),
+        n,
+    )
+    # OR across terms: one failing + one passing term = pass
+    assert matches_node_affinity(
+        pod(
+            affinity=req(
+                {"matchExpressions": [expr("tpu", "In", "v5p")]},
+                {"matchExpressions": [expr("size", "Gt", "2")]},
+            )
+        ),
+        n,
+    )
+    # AND within a term
+    assert not matches_node_affinity(
+        pod(
+            affinity=req(
+                {
+                    "matchExpressions": [
+                        expr("tpu", "In", "v5e"),
+                        expr("size", "Lt", "2"),
+                    ]
+                }
+            )
+        ),
+        n,
+    )
+
+
+def test_taints_tolerations():
+    taint = {"key": "tpu", "value": "dedicated", "effect": "NoSchedule"}
+    n = node(taints=[taint])
+    assert not tolerates_node_taints(pod(), n)
+    assert tolerates_node_taints(
+        pod(tolerations=[{"key": "tpu", "operator": "Exists"}]), n
+    )
+    assert tolerates_node_taints(
+        pod(
+            tolerations=[
+                {"key": "tpu", "value": "dedicated", "effect": "NoSchedule"}
+            ]
+        ),
+        n,
+    )
+    assert not tolerates_node_taints(
+        pod(tolerations=[{"key": "tpu", "value": "other"}]), n
+    )
+    # PreferNoSchedule is soft — never blocks
+    soft = node(taints=[{"key": "x", "effect": "PreferNoSchedule"}])
+    assert tolerates_node_taints(pod(), soft)
+
+
+def test_missing_node_passes():
+    assert check_node_validity(pod(), None) is None
+
+
+def tpu_pod(name="p1"):
+    return new_pod(
+        name,
+        containers=[
+            {
+                "name": "main",
+                "resources": {
+                    "limits": {resources.chip: 1, resources.memory_percentage: 25}
+                },
+            }
+        ],
+    )
+
+
+def register_node(client, sched, name="n1", **nodekw):
+    n = node(**nodekw)
+    n["metadata"]["name"] = name
+    client.create_node(n)
+    from vtpu.utils import codec
+    from vtpu.utils.types import ChipInfo
+
+    infos = [
+        ChipInfo(
+            uuid=f"{name}-tpu-0",
+            count=4,
+            hbm_mb=16384,
+            cores=100,
+            type="TPU-v5e",
+            health=True,
+        )
+    ]
+    sched.nodes.add_node(name, infos)
+
+
+def test_filter_rejects_cordoned_node():
+    client = FakeClient()
+    sched = Scheduler(client)
+    register_node(client, sched, "good")
+    register_node(client, sched, "cordoned", unschedulable=True)
+    sched.register_from_node_annotations()  # populates the node-object cache
+    p = client.create_pod(tpu_pod())
+    res = sched.filter(p, ["cordoned", "good"])
+    assert res.node == "good"
+    assert "cordoned" in res.failed
+
+
+def test_filter_validity_check_can_be_disabled():
+    client = FakeClient()
+    sched = Scheduler(client, SchedulerConfig(node_validity_check=False))
+    register_node(client, sched, "cordoned", unschedulable=True)
+    p = client.create_pod(tpu_pod())
+    res = sched.filter(p, ["cordoned"])
+    assert res.node == "cordoned"  # reference behavior: bypassed

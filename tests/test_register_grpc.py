@@ -1,0 +1,124 @@
+"""Legacy gRPC DeviceService.Register stream tests (cross-process contract
+#6; ref pkg/api/device_register.proto + scheduler.go:231-266)."""
+
+import time
+from concurrent import futures
+
+import grpc
+import pytest
+
+from vtpu.api import DeviceInfo, RegisterRequest
+from vtpu.api.register_service import (
+    add_device_service,
+    chipinfo_from_proto,
+    chipinfo_to_proto,
+    stream_register,
+)
+from vtpu.k8s import FakeClient
+from vtpu.scheduler import Scheduler
+from vtpu.utils.types import ChipInfo
+
+
+def make_infos(n=2):
+    return [
+        ChipInfo(
+            uuid=f"tpu-{i}",
+            count=4,
+            hbm_mb=16384,
+            cores=100,
+            type="TPU-v5e",
+            health=True,
+            coords=(i, 0, 0),
+        )
+        for i in range(n)
+    ]
+
+
+def test_chipinfo_proto_roundtrip():
+    for c in make_infos():
+        back = chipinfo_from_proto(chipinfo_to_proto(c))
+        assert back.uuid == c.uuid
+        assert back.hbm_mb == c.hbm_mb
+        assert back.coords == c.coords
+        assert back.health == c.health
+
+
+def test_chipinfo_proto_no_coords():
+    c = ChipInfo(uuid="x", count=1, hbm_mb=1, cores=100, type="t", health=False)
+    back = chipinfo_from_proto(chipinfo_to_proto(c))
+    assert back.coords is None
+    assert back.health is False
+
+
+@pytest.fixture()
+def rig():
+    sched = Scheduler(FakeClient())
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    add_device_service(sched.legacy_register_servicer(), server)
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    yield sched, ch
+    ch.close()
+    server.stop(grace=None)
+
+
+def test_stream_ingests_devices(rig):
+    sched, ch = rig
+    stream_register(ch, "nodeA", [make_infos(2)], timeout=5)
+    # the reply returns after the stream closes — on_disconnect has then
+    # expelled the devices (ref: stream loss = node death)
+    assert sched.nodes.get("nodeA") is None or not sched.nodes.get("nodeA").devices
+
+
+def test_open_stream_devices_visible(rig):
+    """While the stream lives, the node's devices are schedulable; when it
+    drops, they are expelled (ref scheduler.go:258-264)."""
+    sched, ch = rig
+    import queue
+    import threading
+
+    from vtpu.api.register_service import DeviceServiceStub
+
+    q = queue.Queue()
+
+    def gen():
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            yield item
+
+    q.put(
+        RegisterRequest(
+            node="nodeB",
+            devices=[
+                DeviceInfo(id="tpu-9", count=4, devmem=16384, type="TPU-v5e", health=True)
+            ],
+        )
+    )
+    # the stream_unary call blocks until the stream closes → drive it from
+    # a thread while the main thread observes scheduler state
+    t = threading.Thread(
+        target=lambda: DeviceServiceStub(ch).Register(gen(), timeout=10),
+        daemon=True,
+    )
+    t.start()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        info = sched.nodes.get("nodeB")
+        if info is not None and info.devices:
+            break
+        time.sleep(0.02)
+    info = sched.nodes.get("nodeB")
+    assert info is not None and info.devices[0].uuid == "tpu-9"
+    q.put(None)  # close the stream
+    t.join(timeout=5)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        info = sched.nodes.get("nodeB")
+        if info is None or not info.devices:
+            break
+        time.sleep(0.02)
+    info = sched.nodes.get("nodeB")
+    assert info is None or not info.devices

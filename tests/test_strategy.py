@@ -111,8 +111,11 @@ def test_core_plugin_allocate_direct_env():
     assert envs["VTPU_VISIBLE_CORES"] == (
         f"{chips[0].index}:0,{chips[0].index}:1,{chips[2].index}:0"
     )
-    # per-core HBM = chip HBM / tensorcores
-    assert envs["TPU_DEVICE_MEMORY_LIMIT_0"] == str(96 * 1024 // 2)
+    # LIMIT_<i> indexed by visible-chip position: chip0 owns BOTH cores →
+    # full chip HBM; chip2 owns one core → half
+    assert envs["TPU_DEVICE_MEMORY_LIMIT_0"] == str(96 * 1024)
+    assert envs["TPU_DEVICE_MEMORY_LIMIT_1"] == str(96 * 1024 // 2)
+    assert f"TPU_DEVICE_MEMORY_LIMIT_2" not in envs
     # device nodes mounted once per chip
     assert len(resp.container_responses[0].devices) == 2
 

@@ -263,9 +263,11 @@ def test_quantity_large_and_milli_suffixes():
         assert resource_reqs(p)[0][0].memreq == want, q
 
 
-def test_mixed_family_container_matched_by_first_device():
-    """A container whose assignment mixes device families must still be
-    claimed by the plugin owning its FIRST entry (ref util.go:174-191)."""
+def test_mixed_family_container_each_plugin_claims_own():
+    """A container whose assignment mixes device families is drained one
+    family at a time: each vendor's plugin pops only its own entries, the
+    other family's stay pending (ref GetNextDeviceRequest/Erase…
+    util.go:174-221 run once per vendor plugin)."""
     c = FakeClient()
     c.create_node(new_node("n1"))
     devs = [[ContainerDevice("chip-0", "TPU", 1024, 0), ContainerDevice("x-0", "XPU", 512, 0)]]
@@ -281,6 +283,16 @@ def test_mixed_family_container_matched_by_first_device():
     c.create_pod(pod)
     pending = get_pending_pod(c, "n1")
     got = get_next_device_request("TPU", pending)
-    assert [d.uuid for d in got] == ["chip-0", "x-0"]
+    assert [d.uuid for d in got] == ["chip-0"]
     erase_next_device_type_from_annotation(c, "TPU", pending)
+    remaining = get_annotations(c.get_pod("default", "mix"))[
+        annotations.DEVICES_TO_ALLOCATE
+    ]
+    left = codec.decode_pod_devices(remaining)
+    assert [d.uuid for d in left[0]] == ["x-0"]
+    # second family drains the rest
+    pending = get_pending_pod(c, "n1")
+    got2 = get_next_device_request("XPU", pending)
+    assert [d.uuid for d in got2] == ["x-0"]
+    erase_next_device_type_from_annotation(c, "XPU", pending)
     assert get_annotations(c.get_pod("default", "mix"))[annotations.DEVICES_TO_ALLOCATE] == ""

@@ -1,0 +1,23 @@
+"""Legacy gRPC device-registration API (cross-process contract #6).
+
+Ref: pkg/api/device_register.proto + the generated device_register.pb.go
+(1,289 LoC we replace with protoc's python output) and the scheduler-side
+stream handler (pkg/scheduler/scheduler.go:231-266).  Env-name constants
+mirror pkg/api/types.go:19-22.
+"""
+
+from vtpu.api.device_register_pb2 import (  # noqa: F401
+    DeviceInfo,
+    RegisterReply,
+    RegisterRequest,
+)
+from vtpu.api.register_service import (  # noqa: F401
+    DeviceServiceStub,
+    add_device_service,
+    stream_register,
+)
+
+# container env knobs (ref pkg/api/types.go:19-22: CUDA_TASK_PRIORITY,
+# GPU_CORE_UTILIZATION_POLICY)
+TASK_PRIORITY_ENV = "TPU_TASK_PRIORITY"
+CORE_UTILIZATION_POLICY_ENV = "TPU_CORE_UTILIZATION_POLICY"

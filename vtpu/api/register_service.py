@@ -1,0 +1,124 @@
+"""gRPC glue for the legacy DeviceService.Register stream.
+
+Ref: pkg/scheduler/scheduler.go:231-266 — the scheduler consumes a
+client-streamed device list, ingesting each message into the node manager
+and removing the node's devices when the stream breaks.  Service glue is
+hand-written (no grpc_python_plugin in this image; same approach as
+vtpu/plugin/api.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Iterable, Optional, Sequence, Tuple
+
+import grpc
+
+from vtpu.api import device_register_pb2 as pb
+from vtpu.utils.types import ChipInfo
+
+log = logging.getLogger(__name__)
+
+SERVICE = "vtpuapi.DeviceService"
+
+
+def chipinfo_from_proto(d: pb.DeviceInfo) -> ChipInfo:
+    coords = None
+    if d.coords:
+        coords = tuple(int(x) for x in d.coords.split(","))
+    return ChipInfo(
+        uuid=d.id,
+        count=d.count,
+        hbm_mb=int(d.devmem),
+        cores=100,
+        type=d.type,
+        health=d.health,
+        coords=coords,
+    )
+
+
+def chipinfo_to_proto(c: ChipInfo) -> pb.DeviceInfo:
+    return pb.DeviceInfo(
+        id=c.uuid,
+        count=c.count,
+        devmem=c.hbm_mb,
+        type=c.type,
+        health=c.health,
+        coords=",".join(str(x) for x in c.coords) if c.coords else "",
+    )
+
+
+class DeviceRegisterServicer:
+    """Scheduler-side stream consumer (ref Register scheduler.go:231-266).
+
+    ``on_register(node, [ChipInfo])`` is called per message;
+    ``on_disconnect(node)`` when the stream ends or errors — the caller
+    (the scheduler) removes the node's devices there, the reference's
+    crash-detection semantics."""
+
+    def __init__(
+        self,
+        on_register: Callable[[str, Sequence[ChipInfo]], None],
+        on_disconnect: Callable[[str], None],
+    ) -> None:
+        self.on_register = on_register
+        self.on_disconnect = on_disconnect
+
+    def Register(self, request_iterator, context):  # noqa: N802
+        node: Optional[str] = None
+        try:
+            for req in request_iterator:
+                node = req.node
+                self.on_register(node, [chipinfo_from_proto(d) for d in req.devices])
+        finally:
+            # stream closed (cleanly or not): expel the node's devices
+            # (ref scheduler.go:258-264 "node disconnected")
+            if node is not None:
+                log.info("register stream from %s closed; expelling devices", node)
+                self.on_disconnect(node)
+        return pb.RegisterReply()
+
+
+def add_device_service(servicer: DeviceRegisterServicer, server: grpc.Server) -> None:
+    handlers = {
+        "Register": grpc.stream_unary_rpc_method_handler(
+            servicer.Register,
+            request_deserializer=pb.RegisterRequest.FromString,
+            response_serializer=pb.RegisterReply.SerializeToString,
+        ),
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+    )
+
+
+class DeviceServiceStub:
+    """Node-agent side (the reference's plugin once used this before the
+    annotation bus; kept as a fallback registrar transport)."""
+
+    def __init__(self, channel: grpc.Channel) -> None:
+        self._register = channel.stream_unary(
+            f"/{SERVICE}/Register",
+            request_serializer=pb.RegisterRequest.SerializeToString,
+            response_deserializer=pb.RegisterReply.FromString,
+        )
+
+    def Register(self, request_iterator, timeout=None):  # noqa: N802
+        return self._register(request_iterator, timeout=timeout)
+
+
+def stream_register(
+    channel: grpc.Channel,
+    node: str,
+    batches: Iterable[Sequence[ChipInfo]],
+    timeout: Optional[float] = None,
+) -> pb.RegisterReply:
+    """Push device-list batches over one stream (client helper)."""
+
+    def gen():
+        for infos in batches:
+            yield pb.RegisterRequest(
+                node=node, devices=[chipinfo_to_proto(c) for c in infos]
+            )
+
+    return DeviceServiceStub(channel).Register(gen(), timeout=timeout)

@@ -1,0 +1,83 @@
+"""Second accelerator family: generic PJRT device provider.
+
+The reference proves its multi-vendor shape with a whole second backend
+(Cambricon MLU: cndev bindings + own plugin, §2.4).  vtpu's second family
+is any non-TPU PJRT-visible accelerator (GPU via PJRT, or host CPU devices
+in dev clusters) — enumerated through the same JAX/PJRT client the TPU
+path uses, registered under the ``vtpu.io/node-pjrt-register`` annotation,
+and scheduled by the *unchanged* scheduler (the point of the
+KNOWN_DEVICES map, ref util.KnownDevice pkg/util/types.go:79-83).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import List, Optional
+
+from vtpu.device.chip import Chip
+from vtpu.device.topology import Topology
+
+log = logging.getLogger(__name__)
+
+ENV_PJRT_PLATFORM = "VTPU_PJRT_PLATFORM"   # e.g. "cpu", "gpu"; default: any non-TPU
+ENV_PJRT_MEM_MB = "VTPU_PJRT_MEM_MB"       # per-device memory when PJRT reports none
+
+
+class PjrtProvider:
+    """DeviceProvider over ``jax.local_devices()`` for non-TPU platforms."""
+
+    def __init__(self, platform: Optional[str] = None) -> None:
+        self._platform = platform or os.environ.get(ENV_PJRT_PLATFORM)
+        self._hostname = os.uname().nodename
+        self._chips: Optional[List[Chip]] = None
+
+    def _discover(self) -> List[Chip]:
+        try:
+            # the daemon lives forever — it must never hold the accelerators'
+            # memory itself (GPU PJRT preallocates ~75% per device by default)
+            os.environ.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
+            import jax  # noqa: PLC0415 — deliberate lazy import
+
+            devices = jax.local_devices()
+        except Exception as e:  # noqa: BLE001 — no jax runtime is a normal miss
+            log.info("PJRT discovery unavailable: %s", e)
+            return []
+        default_mb = int(os.environ.get(ENV_PJRT_MEM_MB, 16 * 1024))
+        chips = []
+        for d in devices:
+            if self._platform:
+                if d.platform != self._platform:
+                    continue
+            elif d.platform in ("tpu", "axon"):
+                continue  # TPUs belong to the primary family
+            stats = {}
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:  # noqa: BLE001 — cpu devices have no stats
+                pass
+            hbm_bytes = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+            model = f"PJRT-{d.platform}"
+            chips.append(
+                Chip(
+                    index=len(chips),
+                    uuid=f"{model}-{self._hostname}-{d.id}",
+                    model=model,
+                    hbm_mb=int(hbm_bytes // 2**20) if hbm_bytes else default_mb,
+                    coords=None,
+                )
+            )
+        return chips
+
+    # -- DeviceProvider ----------------------------------------------------
+    def enumerate(self) -> List[Chip]:
+        if self._chips is None:
+            self._chips = self._discover()
+        return list(self._chips)
+
+    def topology(self) -> Topology:
+        n = len(self.enumerate())
+        return Topology((max(n, 1), 1, 1), wrap=(False, False, False))
+
+    def health_check(self) -> List[Chip]:
+        return self.enumerate()

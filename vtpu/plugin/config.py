@@ -16,6 +16,10 @@ log = logging.getLogger(__name__)
 class PluginConfig:
     node_name: str = ""
     resource_name: str = "google.com/tpu"
+    # which accelerator family this plugin daemon serves: "tpu" (primary)
+    # or "pjrt" (second family; ref the MLU plugin as a separate daemon,
+    # cmd/device-plugin/mlu/main.go)
+    device_family: str = "tpu"
     # how many shares each chip is split into (ref DeviceSplitCount)
     device_split_count: int = 10
     # advertise N× the physical HBM (oversubscription, ref DeviceMemoryScaling)
@@ -37,6 +41,28 @@ class PluginConfig:
     # TensorCore partition strategy: none | single | mixed
     # (ref migStrategy, mig-strategy.go:46-56 + docs/config.md)
     partition_strategy: str = "none"
+
+    @property
+    def handshake_anno(self) -> str:
+        from vtpu.utils.types import annotations
+
+        if self.device_family == "pjrt":
+            return annotations.NODE_HANDSHAKE_PJRT
+        return annotations.NODE_HANDSHAKE
+
+    @property
+    def register_anno(self) -> str:
+        from vtpu.utils.types import annotations
+
+        if self.device_family == "pjrt":
+            return annotations.NODE_REGISTER_PJRT
+        return annotations.NODE_REGISTER
+
+    @property
+    def device_type(self) -> str:
+        from vtpu.utils.types import DEVICE_TYPE_PJRT, DEVICE_TYPE_TPU
+
+        return DEVICE_TYPE_PJRT if self.device_family == "pjrt" else DEVICE_TYPE_TPU
 
     @classmethod
     def from_env(cls, config_file: Optional[str] = None) -> "PluginConfig":

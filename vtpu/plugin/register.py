@@ -62,14 +62,13 @@ def register_once(
     infos = build_device_infos(cache, cfg, chip_filter)
     topo = cache.provider.topology()
     ts = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
-    client.patch_node_annotations(
-        cfg.node_name,
-        {
-            annotations.NODE_HANDSHAKE: f"{HandshakeState.REPORTED} {ts}",
-            annotations.NODE_REGISTER: codec.encode_node_devices(infos),
-            annotations.NODE_TOPOLOGY: "x".join(str(d) for d in topo.dims),
-        },
-    )
+    annos = {
+        cfg.handshake_anno: f"{HandshakeState.REPORTED} {ts}",
+        cfg.register_anno: codec.encode_node_devices(infos),
+    }
+    if cfg.device_family == "tpu":
+        annos[annotations.NODE_TOPOLOGY] = "x".join(str(d) for d in topo.dims)
+    client.patch_node_annotations(cfg.node_name, annos)
 
 
 class Registrar:

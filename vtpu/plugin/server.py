@@ -39,7 +39,6 @@ from vtpu.plugin import v1beta1_pb2 as pb
 from vtpu.plugin.cache import DeviceCache
 from vtpu.plugin.config import PluginConfig
 from vtpu.utils import allocate as alloc_util
-from vtpu.utils.types import DEVICE_TYPE_TPU
 
 log = logging.getLogger(__name__)
 
@@ -269,14 +268,14 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
                 "no pod pending allocation on this node",
             )
         try:
-            devs = alloc_util.get_next_device_request(DEVICE_TYPE_TPU, pending)
+            devs = alloc_util.get_next_device_request(self.cfg.device_type, pending)
             if len(devs) != len(creq.devicesIDs):
                 raise LookupError(
                     f"annotation has {len(devs)} devices, kubelet asked "
                     f"{len(creq.devicesIDs)}"
                 )
             alloc_util.erase_next_device_type_from_annotation(
-                self.client, DEVICE_TYPE_TPU, pending
+                self.client, self.cfg.device_type, pending
             )
             resp = pb.AllocateResponse()
             resp.container_responses.append(self._container_response(devs, pending))

@@ -22,7 +22,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-from typing import List, Sequence
+from typing import Dict, List, Sequence
 
 from vtpu.device.chip import Chip
 from vtpu.plugin import api
@@ -131,7 +131,9 @@ class CorePartitionPlugin(api.DevicePluginServicer):
             cresp = pb.ContainerAllocateResponse()
             indices: List[str] = []
             cores: List[str] = []
-            for i, fid in enumerate(creq.devicesIDs):
+            owned: Dict[str, int] = {}  # chip uuid → cores owned
+            chip_order: List[Chip] = []
+            for fid in creq.devicesIDs:
                 uuid, core = parse_core_device_id(fid)
                 chip = chips_by_uuid.get(uuid)
                 if chip is None:
@@ -142,6 +144,7 @@ class CorePartitionPlugin(api.DevicePluginServicer):
                 idx = str(chip.index)
                 if idx not in indices:
                     indices.append(idx)
+                    chip_order.append(chip)
                     if chip.devpath:
                         cresp.devices.append(
                             pb.DeviceSpec(
@@ -150,9 +153,15 @@ class CorePartitionPlugin(api.DevicePluginServicer):
                                 permissions="rw",
                             )
                         )
+                owned[uuid] = owned.get(uuid, 0) + 1
                 cores.append(f"{chip.index}:{core}")
+            # LIMIT_<i> is indexed by visible-chip position (the shim ABI,
+            # server.py docstring); owning all cores of a chip grants its
+            # full HBM
+            for i, chip in enumerate(chip_order):
+                share = min(owned[chip.uuid], chip.tensorcores)
                 cresp.envs[f"TPU_DEVICE_MEMORY_LIMIT_{i}"] = str(
-                    chip.hbm_mb // chip.tensorcores
+                    chip.hbm_mb * share // chip.tensorcores
                 )
             cresp.envs["TPU_VISIBLE_CHIPS"] = ",".join(indices)
             cresp.envs["TPU_VISIBLE_DEVICES"] = ",".join(indices)

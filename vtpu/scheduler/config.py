@@ -21,3 +21,7 @@ class SchedulerConfig:
     node_scheduler_policy: str = "binpack"
     # ICI gang policy for multi-chip requests (ref --mlulink-policy)
     ici_policy: str = "best-effort"
+    # run node-validity checks (cordon/selector/affinity/taints) in Filter
+    # — the scheduler-framework-shim analog the reference keeps bypassed
+    # (checkNodeValidity, scheduler.go:358-364); vtpu ships it enabled
+    node_validity_check: bool = True

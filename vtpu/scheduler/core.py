@@ -15,6 +15,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from vtpu.k8s.objects import get_annotations, pod_uid
+from vtpu.scheduler import nodecheck
 from vtpu.scheduler import score as score_mod
 from vtpu.scheduler.config import SchedulerConfig
 from vtpu.scheduler.score import DeviceUsage, NodeUsage
@@ -72,12 +73,17 @@ class Scheduler:
         # /filter requests (HA schedulers, parallel binds) must not both see
         # the same chip as free
         self._filter_lock = threading.Lock()
+        # node objects cached by the 15 s registry poll — node-validity
+        # checks read these instead of issuing per-Filter API GETs
+        self._node_objs: Dict[str, dict] = {}
 
     # ------------------------------------------------------------------
     # Registry: node annotations → device state (ref scheduler.go:143-229)
     # ------------------------------------------------------------------
     def register_from_node_annotations(self) -> None:
-        for node in self.client.list_nodes():
+        nodes = self.client.list_nodes()
+        self._node_objs = {n["metadata"]["name"]: n for n in nodes}
+        for node in nodes:
             name = node["metadata"]["name"]
             annos = node.get("metadata", {}).get("annotations") or {}
             for handshake_anno, register_anno in KNOWN_DEVICES.items():
@@ -92,7 +98,9 @@ class Scheduler:
                         log.warning("node %s: bad register annotation", name)
                         continue
                     topology = annos.get(annotations.NODE_TOPOLOGY, "")
-                    self.nodes.add_node(name, devices, topology)
+                    self.nodes.add_node(
+                        name, devices, topology, source=handshake_anno
+                    )
                     self.client.patch_node_annotations(
                         name,
                         {handshake_anno: f"{HandshakeState.REQUESTING}_{_now_ts()}"},
@@ -103,7 +111,7 @@ class Scheduler:
                     if ts is None or (now - ts).total_seconds() > HANDSHAKE_TIMEOUT_S:
                         # plugin stopped re-reporting → expel devices
                         log.warning("node %s: handshake timeout; expelling devices", name)
-                        self.nodes.rm_node_devices(name)
+                        self.nodes.rm_node_devices(name, source=handshake_anno)
                         self.client.patch_node_annotations(
                             name,
                             {handshake_anno: f"{HandshakeState.DELETED}_{_now_ts()}"},
@@ -121,6 +129,24 @@ class Scheduler:
         for uid in list(self.pods.all_pods()):
             if uid not in seen:
                 self.pods.rm_pod(uid)
+
+    def legacy_register_servicer(self):
+        """Legacy gRPC DeviceService.Register consumer (ref Register
+        scheduler.go:231-266): messages ingest into the node manager;
+        stream loss expels the node's devices.  Superseded by the
+        annotation bus but kept as a fallback transport (contract #6)."""
+        from vtpu.api.register_service import DeviceRegisterServicer
+
+        # scoped to its own source so a dropped stream expels only the
+        # gRPC-registered devices, never the annotation-registered ones
+        return DeviceRegisterServicer(
+            on_register=lambda node, infos: self.nodes.add_node(
+                node, list(infos), source="legacy-grpc"
+            ),
+            on_disconnect=lambda node: self.nodes.rm_node_devices(
+                node, source="legacy-grpc"
+            ),
+        )
 
     def run_background_loops(self) -> None:
         def loop() -> None:
@@ -178,7 +204,15 @@ class Scheduler:
     # ------------------------------------------------------------------
     # Filter (ref Filter scheduler.go:444-492 + calcScore walk)
     # ------------------------------------------------------------------
-    def filter(self, pod: dict, node_names: List[str]) -> FilterResult:
+    def filter(
+        self,
+        pod: dict,
+        node_names: List[str],
+        node_objs: Optional[Dict[str, dict]] = None,
+    ) -> FilterResult:
+        """``node_objs``: full Node objects when the caller has them
+        (nodeCacheCapable=false extenders send them in nodes.items) —
+        otherwise validity checks fall back to the registry poll's cache."""
         reqs = resource_reqs(
             pod, self.config.default_mem, self.config.default_cores
         )
@@ -188,16 +222,22 @@ class Scheduler:
             return FilterResult(node=None, failed={}, error="")
         pod_annos = get_annotations(pod)
         with self._filter_lock:
-            return self._filter_locked(pod, node_names, reqs, pod_annos)
+            return self._filter_locked(pod, node_names, reqs, pod_annos, node_objs)
 
     def _filter_locked(
-        self, pod: dict, node_names: List[str], reqs, pod_annos
+        self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs=None
     ) -> FilterResult:
         usage = self.nodes_usage(exclude_uid=pod_uid(pod))
         ici_policy = pod_annos.get("vtpu.io/ici-policy", self.config.ici_policy)
         best: Optional[Tuple[float, str, object]] = None
         failed: Dict[str, str] = {}
         for name in node_names:
+            if self.config.node_validity_check:
+                node_obj = (node_objs or {}).get(name) or self._node_objs.get(name)
+                reason = nodecheck.check_node_validity(pod, node_obj)
+                if reason is not None:
+                    failed[name] = reason
+                    continue
             nu = usage.get(name)
             if nu is None:
                 failed[name] = "no vtpu devices registered"

@@ -1,0 +1,127 @@
+"""Node-validity checks — the scheduler-framework shim analog.
+
+Ref: pkg/util/k8s/ builds a fake ``framework.Handle`` + snapshot so the
+upstream NodeUnschedulable and NodeAffinity plugins can run standalone
+(framework.go:141, snapshot.go:33); the call sits bypassed at
+scheduler.go:358-364.  vtpu implements the same checks natively — node
+cordon state, nodeSelector/nodeAffinity matching, taints vs tolerations —
+and ships them ENABLED (config ``node_validity_check``), since the vanilla
+scheduler's own filters normally run first but HA extender deployments and
+direct API callers benefit from the second line of defense.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+
+def node_schedulable(node: dict) -> bool:
+    """NodeUnschedulable plugin analog: reject cordoned nodes."""
+    return not (node.get("spec") or {}).get("unschedulable", False)
+
+
+def _match_expression(labels: Dict[str, str], expr: dict) -> bool:
+    key = expr.get("key", "")
+    op = expr.get("operator", "")
+    values = expr.get("values") or []
+    has = key in labels
+    val = labels.get(key)
+    if op == "In":
+        return has and val in values
+    if op == "NotIn":
+        return not has or val not in values
+    if op == "Exists":
+        return has
+    if op == "DoesNotExist":
+        return not has
+    if op == "Gt":
+        try:
+            return has and int(val) > int(values[0])
+        except (ValueError, IndexError, TypeError):
+            return False
+    if op == "Lt":
+        try:
+            return has and int(val) < int(values[0])
+        except (ValueError, IndexError, TypeError):
+            return False
+    log.warning("unknown nodeAffinity operator %r", op)
+    return False
+
+
+def _match_selector_term(labels: Dict[str, str], term: dict) -> bool:
+    """All matchExpressions of one term must hold (terms OR together)."""
+    return all(_match_expression(labels, e) for e in term.get("matchExpressions") or [])
+
+
+def matches_node_selector(pod: dict, node: dict) -> bool:
+    """pod.spec.nodeSelector ⊆ node labels."""
+    selector = (pod.get("spec") or {}).get("nodeSelector") or {}
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+def matches_node_affinity(pod: dict, node: dict) -> bool:
+    """requiredDuringSchedulingIgnoredDuringExecution — NodeAffinity
+    plugin analog; preferred terms only influence scoring upstream and are
+    ignored here, as in the reference's filter-only shim."""
+    affinity = ((pod.get("spec") or {}).get("affinity") or {}).get("nodeAffinity") or {}
+    required = affinity.get("requiredDuringSchedulingIgnoredDuringExecution")
+    if not required:
+        return True
+    labels = (node.get("metadata") or {}).get("labels") or {}
+    terms = required.get("nodeSelectorTerms") or []
+    if not terms:
+        return True
+    return any(_match_selector_term(labels, t) for t in terms)
+
+
+def _tolerates(tolerations: List[dict], taint: dict) -> bool:
+    for tol in tolerations:
+        effect_ok = not tol.get("effect") or tol.get("effect") == taint.get("effect")
+        op = tol.get("operator", "Equal")
+        if op == "Exists":
+            key_ok = not tol.get("key") or tol.get("key") == taint.get("key")
+            if key_ok and effect_ok:
+                return True
+        else:  # Equal
+            if (
+                tol.get("key") == taint.get("key")
+                and tol.get("value", "") == taint.get("value", "")
+                and effect_ok
+            ):
+                return True
+    return False
+
+
+def tolerates_node_taints(pod: dict, node: dict) -> bool:
+    """TaintToleration filter analog: every NoSchedule/NoExecute taint
+    must be tolerated (PreferNoSchedule is soft and ignored)."""
+    taints = (node.get("spec") or {}).get("taints") or []
+    tolerations = (pod.get("spec") or {}).get("tolerations") or []
+    for taint in taints:
+        if taint.get("effect") not in ("NoSchedule", "NoExecute"):
+            continue
+        if not _tolerates(tolerations, taint):
+            return False
+    return True
+
+
+def check_node_validity(pod: dict, node: Optional[dict]) -> Optional[str]:
+    """Returns a failure reason, or None when the node passes.  A missing
+    node object passes — the extender may know nodes only from the
+    annotation registry, and kube-scheduler's own filters have already
+    run (ref: checkNodeValidity bypass, scheduler.go:358-364)."""
+    if node is None:
+        return None
+    if not node_schedulable(node):
+        return "node is unschedulable (cordoned)"
+    if not matches_node_selector(pod, node):
+        return "pod nodeSelector does not match node labels"
+    if not matches_node_affinity(pod, node):
+        return "pod nodeAffinity does not match node"
+    if not tolerates_node_taints(pod, node):
+        return "pod does not tolerate node taints"
+    return None

@@ -44,13 +44,15 @@ def filter_handler(sched: Scheduler, args: dict) -> dict:
     "nodes"} in; {"nodenames", "failedNodes", "error"} out."""
     pod = _get(args, "pod", "Pod") or {}
     node_names = _get(args, "nodenames", "NodeNames")
+    node_objs = None
     if node_names is None:
         # nodeCacheCapable=false senders put full Node objects in nodes.items
+        # — keep the objects so validity checks need no API round-trips
         nodes = _get(args, "nodes", "Nodes") or {}
-        node_names = [
-            n["metadata"]["name"] for n in _get(nodes, "items", "Items", default=[])
-        ]
-    res = sched.filter(pod, list(node_names))
+        items = _get(nodes, "items", "Items", default=[])
+        node_names = [n["metadata"]["name"] for n in items]
+        node_objs = {n["metadata"]["name"]: n for n in items}
+    res = sched.filter(pod, list(node_names), node_objs=node_objs)
     if res.error:
         return {"nodenames": [], "failedNodes": res.failed, "error": res.error}
     if res.node is None:

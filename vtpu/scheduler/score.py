@@ -133,7 +133,12 @@ def _book(dev: DeviceUsage, req: ContainerDeviceRequest) -> ContainerDevice:
     dev.used += 1
     dev.usedmem += mem
     dev.usedcores += req.coresreq
-    return ContainerDevice(uuid=dev.uuid, type="TPU", usedmem=mem, usedcores=req.coresreq)
+    # record the request's family, not a hardcoded one — a PJRT-family
+    # share must round-trip as PJRT so Allocate pops the right queue
+    # (ref GetNextDeviceRequest is per-type, util.go:174-191)
+    return ContainerDevice(
+        uuid=dev.uuid, type=req.type, usedmem=mem, usedcores=req.coresreq
+    )
 
 
 def _select_devices(

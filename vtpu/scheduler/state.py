@@ -17,6 +17,10 @@ class NodeInfo:
     name: str
     devices: List[ChipInfo]
     topology: str = ""          # e.g. "4x4x1" from NODE_TOPOLOGY annotation
+    # per-family device lists ("tpu", "pjrt", …): the registry loop calls
+    # add_node once per vendor annotation and must not clobber the other
+    # family's devices (ref: addNode is per-KnownDevice, scheduler.go:143-229)
+    by_source: Dict[str, List[ChipInfo]] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -35,13 +39,40 @@ class NodeManager:
         self._lock = threading.RLock()
         self._nodes: Dict[str, NodeInfo] = {}
 
-    def add_node(self, name: str, devices: List[ChipInfo], topology: str = "") -> None:
+    def add_node(
+        self,
+        name: str,
+        devices: List[ChipInfo],
+        topology: str = "",
+        source: str = "default",
+    ) -> None:
+        """Replace the ``source`` family's devices on the node, keeping
+        other families' (one registrar daemon per vendor reports
+        independently)."""
         with self._lock:
-            self._nodes[name] = NodeInfo(name, [d.clone() for d in devices], topology)
+            info = self._nodes.get(name)
+            if info is None:
+                info = NodeInfo(name, [], topology)
+                self._nodes[name] = info
+            if topology:
+                info.topology = topology
+            info.by_source[source] = [d.clone() for d in devices]
+            info.devices = [d for devs in info.by_source.values() for d in devs]
 
-    def rm_node_devices(self, name: str) -> None:
+    def rm_node_devices(self, name: str, source: Optional[str] = None) -> None:
+        """Expel one family's devices (handshake timeout is per-vendor) or
+        the whole node when ``source`` is None."""
         with self._lock:
-            self._nodes.pop(name, None)
+            if source is None:
+                self._nodes.pop(name, None)
+                return
+            info = self._nodes.get(name)
+            if info is None:
+                return
+            info.by_source.pop(source, None)
+            info.devices = [d for devs in info.by_source.values() for d in devs]
+            if not info.devices:
+                self._nodes.pop(name, None)
 
     def get(self, name: str) -> Optional[NodeInfo]:
         with self._lock:

@@ -65,11 +65,12 @@ def get_next_device_request(device_type: str, pod: dict) -> List[ContainerDevice
     annos = get_annotations(pod)
     to_alloc = codec.decode_pod_devices(annos.get(annotations.DEVICES_TO_ALLOCATE, ""))
     for ctr_devs in to_alloc:
-        # match on the first device's type (ref util.go:174-191) so a
-        # container mixing device families is still claimed by the plugin
-        # that owns its first entry rather than deadlocking both
-        if ctr_devs and ctr_devs[0].type == device_type:
-            return ctr_devs
+        # a container may mix device families (e.g. TPU + generic-PJRT);
+        # each family's plugin claims only its own entries — the other
+        # family's stay pending for that plugin's Allocate
+        mine = [d for d in ctr_devs if d.type == device_type]
+        if mine:
+            return mine
     raise LookupError(f"no pending {device_type} request in pod annotations")
 
 
@@ -80,9 +81,11 @@ def erase_next_device_type_from_annotation(client, device_type: str, pod: dict) 
     to_alloc = codec.decode_pod_devices(annos.get(annotations.DEVICES_TO_ALLOCATE, ""))
     out, erased = [], False
     for ctr_devs in to_alloc:
-        if not erased and ctr_devs and ctr_devs[0].type == device_type:
+        if not erased and any(d.type == device_type for d in ctr_devs):
             erased = True
-            out.append([])  # keep container position; an empty list encodes ''
+            # drop only this family's entries; another family's devices in
+            # the same container stay pending for their own plugin
+            out.append([d for d in ctr_devs if d.type != device_type])
         else:
             out.append(ctr_devs)
     # trailing/full-empty → store the encoded (possibly empty) string

@@ -16,6 +16,7 @@ from vtpu.k8s.objects import container_limits
 from vtpu.utils.types import (
     MEM_PERCENTAGE_UNSET,
     ContainerDeviceRequest,
+    DEVICE_TYPE_PJRT,
     DEVICE_TYPE_TPU,
     resources,
 )
@@ -80,6 +81,20 @@ def resource_reqs(
                     coresreq=cores,
                 )
             )
+        # second accelerator family (ref pod.go: one request list entry per
+        # vendor — NVIDIA and MLU there, TPU and generic-PJRT here)
+        n2 = _as_int(limits.get(resources.pjrt_chip, 0))
+        if n2 > 0:
+            mem2 = _as_int(limits.get(resources.pjrt_memory, 0))
+            reqs.append(
+                ContainerDeviceRequest(
+                    nums=n2,
+                    type=DEVICE_TYPE_PJRT,
+                    memreq=mem2,
+                    mem_percentage=MEM_PERCENTAGE_UNSET if mem2 else 100,
+                    coresreq=0,
+                )
+            )
         out.append(reqs)
     return out
 
@@ -88,6 +103,9 @@ def pod_requests_any(pod: dict) -> bool:
     """True if any container requests a managed chip resource (webhook gate,
     ref webhook.go:90-110)."""
     for ctr in pod.get("spec", {}).get("containers", []):
-        if _as_int(container_limits(ctr).get(resources.chip, 0)) > 0:
+        limits = container_limits(ctr)
+        if _as_int(limits.get(resources.chip, 0)) > 0:
+            return True
+        if _as_int(limits.get(resources.pjrt_chip, 0)) > 0:
             return True
     return False

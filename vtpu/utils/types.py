@@ -37,6 +37,11 @@ class annotations:
     NODE_HANDSHAKE = "vtpu.io/node-handshake-tpu"  # ref 4pd.io/node-handshake
     NODE_REGISTER = "vtpu.io/node-tpu-register"    # ref 4pd.io/node-nvidia-register
     NODE_TOPOLOGY = "vtpu.io/node-tpu-topology"    # TPU extension: slice topology
+    # -- node: second accelerator family — generic PJRT devices (the
+    # multi-vendor shape the reference proves with MLU:
+    # 4pd.io/node-handshake-mlu + node-mlu-register, types.go:79-83)
+    NODE_HANDSHAKE_PJRT = "vtpu.io/node-handshake-pjrt"
+    NODE_REGISTER_PJRT = "vtpu.io/node-pjrt-register"
     # -- node: distributed mutex (ref 4pd.io/mutex.lock, pkg/util/nodelock.go)
     NODE_LOCK = "vtpu.io/mutex.lock"
     # -- webhook escape hatch (ref charts/.../webhook.yaml:16-29 label)
@@ -92,6 +97,10 @@ class _ResourceNames:
         self.memory_percentage = "google.com/tpumem-percentage"
         self.cores = "google.com/tpucores"          # percent of chip compute
         self.priority = "google.com/priority"
+        # second accelerator family (ref --mlu-name/--mlu-memory,
+        # pkg/util/util.go:36-48): any non-TPU PJRT-visible device
+        self.pjrt_chip = "vtpu.io/pjrt"
+        self.pjrt_memory = "vtpu.io/pjrtmem"
 
     def configure(self, **kw: str) -> None:
         for k, v in kw.items():
@@ -169,6 +178,8 @@ PodDevices = List[List[ContainerDevice]]
 # added for another accelerator family without touching the scheduler.
 KNOWN_DEVICES = {
     annotations.NODE_HANDSHAKE: annotations.NODE_REGISTER,
+    annotations.NODE_HANDSHAKE_PJRT: annotations.NODE_REGISTER_PJRT,
 }
 
 DEVICE_TYPE_TPU = "TPU"
+DEVICE_TYPE_PJRT = "PJRT"
