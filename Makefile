@@ -1,0 +1,48 @@
+# Build surface (ref: Makefile:1-34 — build/test/tidy/docker targets).
+# Components: native shim (cpp/), generated protos, python package, tests,
+# bench, docker image, helm chart lint.
+
+VERSION ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
+IMG ?= vtpu/vtpu
+PY ?= python3
+
+.PHONY: all build shim proto test test-native bench image chart clean tidy
+
+all: build
+
+build: shim proto
+
+shim:
+	$(MAKE) -C cpp
+
+proto:
+	$(MAKE) -C protos
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# native unit tests: shim against the mock PJRT plugin (same env the
+# pytest runner in tests/test_region.py uses)
+test-native: shim
+	mkdir -p /tmp/vtpu-make-test
+	cd cpp && TPU_DEVICE_MEMORY_LIMIT_0=64 TPU_DEVICE_CORES_LIMIT=25 \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/shim.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
+	  ./build/test_shim && rm -rf /tmp/vtpu-make-test
+
+bench:
+	$(PY) bench.py
+
+image:
+	docker build -t $(IMG):$(VERSION) -f docker/Dockerfile .
+
+chart:
+	helm lint charts/vtpu
+
+tidy:
+	$(PY) -m compileall -q vtpu cmd
+
+clean:
+	$(MAKE) -C cpp clean
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

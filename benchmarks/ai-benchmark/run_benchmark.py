@@ -1,0 +1,175 @@
+#!/usr/bin/env python3
+"""ai-benchmark analog — the reference's published test matrix on TPU.
+
+Runs the five BASELINE.md model rows (ResNet-V2-50/152, VGG-16, DeepLab,
+LSTM) in inference and training mode and prints img/s per row, matching the
+reference's ai-benchmark suite (ref: benchmarks/ai-benchmark/,
+README.md:176-225).  Honors the shim env contract, so the same script is
+the workload for all three deployment configs:
+
+  stock-device-plugin/                exclusive chip, no quotas
+  vtpu-device-plugin/                 shared chip, hard HBM quota
+  vtpu-device-plugin-oversubscribe/   quota > physical share (virtual HBM)
+
+Quota env (set by the vtpu device plugin at Allocate, SURVEY.md §3.3):
+  TPU_DEVICE_MEMORY_LIMIT_0  per-device HBM quota (MiB suffix "m" ok)
+  TPU_DEVICE_CORES_LIMIT     percent of compute
+When present, steps run under the ShimRuntime (accounting + throttle),
+i.e. the same enforcement the in-container C++ shim applies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+# (batch, mode) rows from BASELINE.md / reference README.md:193-206
+ROWS = [
+    ("resnet50", 50, "inference"),
+    ("resnet152", 10, "inference"),
+    ("vgg16", 20, "inference"),
+    ("deeplab", 2, "inference"),
+    ("lstm", 100, "inference"),
+    ("resnet50", 20, "training"),
+    ("resnet152", 10, "training"),
+    ("vgg16", 2, "training"),
+    ("deeplab", 1, "training"),
+    ("lstm", 10, "training"),
+]
+
+
+def build_step(name: str, batch: int, mode: str):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from vtpu.models.registry import create_model
+
+    model, shape_fn, in_dtype = create_model(name)
+    rng = jax.random.PRNGKey(0)
+    shape = shape_fn(batch)
+    x = (
+        jnp.ones(shape, in_dtype)
+        if in_dtype != jnp.int32
+        else jnp.zeros(shape, in_dtype)
+    )
+    variables = model.init(rng, x)
+
+    if mode == "inference":
+
+        @jax.jit
+        def step(v, inp):
+            out = model.apply(v, inp, mutable=["batch_stats"])
+            return out[0]
+
+        state = variables
+    else:
+        import flax
+
+        params = variables["params"]
+        rest = {k: v for k, v in variables.items() if k != "params"}
+        tx = optax.sgd(1e-3, momentum=0.9)
+        opt_state = tx.init(params)
+        nclass = 1000 if name != "lstm" else 2
+        labels = jnp.zeros((batch,), jnp.int32)
+
+        @jax.jit
+        def step(state, inp):
+            params, rest, opt_state = state
+
+            def loss_fn(p):
+                out, updates = model.apply(
+                    {"params": p, **rest}, inp, mutable=["batch_stats"]
+                )
+                logits = out if out.ndim == 2 else out.reshape(batch, -1)[:, :nclass]
+                logp = jax.nn.log_softmax(logits[:, :nclass].astype(jnp.float32))
+                return -jnp.mean(logp[jnp.arange(batch), labels]), updates
+
+            (loss, updates), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            upd, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, upd)
+            return (params, updates or rest, opt_state), loss
+
+        state = (params, rest, opt_state)
+        del flax
+
+    return step, state, x
+
+
+def timed_imgs_per_s(step, state, x, batch, mode, seconds, shim=None):
+    import jax
+
+    paced = shim.throttled(step) if shim is not None else step
+    # warmup/compile
+    out = paced(state, x)
+    jax.block_until_ready(out)
+    if mode == "training":
+        state = out[0]
+    n = 0
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < seconds:
+        out = paced(state, x)
+        jax.block_until_ready(out)
+        if mode == "training":
+            state = out[0]
+        n += batch
+    return n / (time.monotonic() - t0)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seconds", type=float, default=10.0, help="window per row")
+    p.add_argument("--rows", default="", help="comma list, e.g. resnet50:50:inference")
+    p.add_argument("--json", action="store_true", help="one JSON line per row")
+    args = p.parse_args(argv)
+
+    import jax
+
+    rows = ROWS
+    if args.rows:
+        rows = []
+        for spec in args.rows.split(","):
+            name, batch, mode = spec.split(":")
+            rows.append((name, int(batch), mode))
+
+    shim = None
+    if os.environ.get("TPU_DEVICE_MEMORY_LIMIT_0"):
+        from vtpu.shim import ShimRuntime
+
+        shim = ShimRuntime()
+        print(
+            f"# shim active: hbm quota {shim.limit_for(0)} B, "
+            f"core limit {shim.core_limit}%",
+            file=sys.stderr,
+        )
+
+    platform = jax.devices()[0].platform
+    print(f"# ai-benchmark on {platform} ({jax.devices()[0]})", file=sys.stderr)
+    for name, batch, mode in rows:
+        step, state, x = build_step(name, batch, mode)
+        rate = timed_imgs_per_s(step, state, x, batch, mode, args.seconds, shim)
+        if args.json:
+            print(
+                json.dumps(
+                    {"model": name, "batch": batch, "mode": mode,
+                     "img_per_s": round(rate, 2), "platform": platform}
+                ),
+                flush=True,
+            )
+        else:
+            print(f"{name:10s} {mode:9s} batch={batch:<4d} {rate:8.2f} img/s",
+                  flush=True)
+    if shim is not None:
+        shim.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+#!/usr/bin/env python3
+"""testcollector — standalone Prometheus example collector with fake data.
+
+Scaffolding parity with the reference's collector sandbox
+(ref: cmd/vGPUmonitor/testcollector/main.go, SURVEY.md §2.6): serves the
+monitor's gauge families filled with synthetic zones/values so dashboards
+and scrape configs can be developed without a node, a chip, or a shared
+region.  Usage: `python3 cmd/testcollector.py --bind 0.0.0.0:9394`.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import argparse
+import random
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def render_fake_metrics() -> str:
+    """Synthetic samples for every family the real monitor exports
+    (shape of vtpu.monitor.metrics.render_node_metrics)."""
+    node = "fake-node"
+    rng = random.Random(int(time.time()) // 15)
+    lines = []
+
+    def gauge(name, help_, samples):
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        for labels, v in samples:
+            lab = ",".join(f'{k}="{v2}"' for k, v2 in labels.items())
+            lines.append(f"{name}{{{lab}}} {v}")
+
+    hbm_total = 16 * 1024**3
+    gauge(
+        "HostTPUMemoryUsage",
+        "Host-level HBM usage in bytes (fake).",
+        [
+            ({"nodeid": node, "deviceuuid": f"fake-tpu-{i}"},
+             rng.randint(0, hbm_total))
+            for i in range(4)
+        ],
+    )
+    gauge(
+        "HostCoreUtilization",
+        "Host-level TensorCore utilization percent (fake).",
+        [
+            ({"nodeid": node, "deviceuuid": f"fake-tpu-{i}"}, rng.randint(0, 100))
+            for i in range(4)
+        ],
+    )
+    for pod in ("demo-a", "demo-b"):
+        dev = {"podnamespace": "default", "podname": pod, "ctrname": "main",
+               "vdeviceid": "0", "deviceuuid": "fake-tpu-0"}
+        gauge(
+            "vTPU_device_memory_usage_in_bytes",
+            "Per-container vTPU HBM usage (fake).",
+            [(dev, rng.randint(0, hbm_total // 4))],
+        )
+        gauge(
+            "vTPU_device_memory_limit_in_bytes",
+            "Per-container vTPU HBM quota (fake).",
+            [(dev, hbm_total // 4)],
+        )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--bind", default="0.0.0.0:9394")
+    args = p.parse_args(argv)
+    host, port = args.bind.rsplit(":", 1)
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = render_fake_metrics().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+    srv = ThreadingHTTPServer((host, int(port)), Handler)
+    print(f"testcollector: fake metrics on http://{args.bind}/metrics")
+    srv.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

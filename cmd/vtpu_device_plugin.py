@@ -71,6 +71,9 @@ def main(argv=None) -> int:
             from vtpu.utils.types import resources as _res
             cfg.resource_name = _res.pjrt_chip
         cfg.socket_name = "vtpu-pjrt.sock"
+        # family-scoped region mount point: a mixed-family container gets
+        # BOTH families' cache mounts, which must not share a path
+        cfg.container_cache_dir = "/tmp/vtpu-pjrt"
         from vtpu.device.pjrt import PjrtProvider
         provider = PjrtProvider()
     else:

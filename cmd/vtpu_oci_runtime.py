@@ -1,0 +1,43 @@
+#!/usr/bin/env python3
+"""vtpu-oci-runtime — OCI runtime wrapper (vestigial escape hatch).
+
+Wraps the real OCI runtime (runc): on a `create` invocation it loads the
+bundle's config.json, injects the vtpu prestart hook + shim env, flushes
+it back, then execs the real runtime with the original argv.  Parity with
+the reference's retired modified nvidia-container-runtime
+(ref: pkg/oci/, SURVEY.md §2.7).  Not deployed by the chart — the device
+plugin's Allocate mount path is the supported injection mechanism.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from vtpu.oci.runtime import SyscallExecRuntime
+from vtpu.oci.spec import FileSpec, inject_prestart_hook, spec_path_from_args
+from vtpu.utils.types import PRESTART_PROGRAM
+
+DEFAULT_RUNTIME = "/usr/bin/runc"
+
+
+def main(argv=None) -> int:
+    args = list(sys.argv if argv is None else argv)
+    real = os.environ.get("VTPU_OCI_RUNTIME", DEFAULT_RUNTIME)
+    if "create" in args[1:]:
+        spec = FileSpec(spec_path_from_args(args[1:]))
+        spec.load()
+        spec.modify(
+            lambda s: inject_prestart_hook(
+                s, PRESTART_PROGRAM, ["VTPU_SHIM=/usr/local/vtpu/libvtpu_shim.so"]
+            )
+        )
+        spec.flush()
+    SyscallExecRuntime(real).exec(args)
+    return 1  # unreachable: exec replaced the process
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,8 +37,24 @@ def main(argv=None) -> int:
     p.add_argument("--grpc-bind", default="",
                    help="serve the legacy DeviceService.Register stream "
                         "here (e.g. 0.0.0.0:9090; ref scheduler.go:231-266)")
+    p.add_argument("--grpc-workers", type=int, default=256,
+                   help="max concurrent legacy Register streams (one per "
+                        "legacy-transport node; streams beyond this queue)")
+    p.add_argument("--cert-file", default="",
+                   help="TLS cert for the webhook listener (ref TLS flags, "
+                        "cmd/scheduler/main.go:51-58)")
+    p.add_argument("--key-file", default="")
+    p.add_argument("--webhook-bind", default="0.0.0.0:9443",
+                   help="dedicated HTTPS listener for the admission webhook "
+                        "when --cert/key are set; the main --http_bind "
+                        "listener stays plain HTTP for the kube-scheduler "
+                        "extender calls and metrics scrapes")
     p.add_argument("--debug", action="store_true")
     args = p.parse_args(argv)
+    if bool(args.cert_file) != bool(args.key_file):
+        # validate before any cluster state is touched (Scheduler's
+        # background loops patch node annotations as soon as they start)
+        p.error("--cert-file and --key-file must be given together")
 
     logging.basicConfig(
         level=logging.DEBUG if args.debug else logging.INFO,
@@ -63,8 +79,20 @@ def main(argv=None) -> int:
     )
     sched = Scheduler(client, cfg)
     sched.run_background_loops()
+    # main listener: plain HTTP — the kube-scheduler sidecar's extender
+    # config (urlPrefix http://127.0.0.1:<port>) and Prometheus scrape it
     srv, _ = serve(sched)
     logging.info("vtpu-scheduler serving on %s", args.http_bind)
+    # webhook listener: TLS on its own port (the apiserver requires HTTPS)
+    webhook_srv = None
+    if args.cert_file and args.key_file:
+        webhook_srv, _ = serve(
+            sched,
+            bind=args.webhook_bind,
+            cert_file=args.cert_file,
+            key_file=args.key_file,
+        )
+        logging.info("vtpu-webhook serving on %s (TLS)", args.webhook_bind)
 
     grpc_server = None
     if args.grpc_bind:
@@ -74,18 +102,30 @@ def main(argv=None) -> int:
         from vtpu.api.register_service import add_device_service
 
         # each node's Register stream holds a worker thread for its whole
-        # lifetime — size the pool for cluster scale, not request rate
-        grpc_server = grpclib.server(futures.ThreadPoolExecutor(max_workers=256))
+        # lifetime — size the pool for cluster scale (node count), not
+        # request rate; --grpc-workers bounds legacy-transport nodes
+        grpc_server = grpclib.server(
+            futures.ThreadPoolExecutor(max_workers=args.grpc_workers)
+        )
         add_device_service(sched.legacy_register_servicer(), grpc_server)
-        grpc_server.add_insecure_port(args.grpc_bind)
+        if grpc_server.add_insecure_port(args.grpc_bind) == 0:
+            logging.error("cannot bind legacy register gRPC to %s", args.grpc_bind)
+            sched.stop()
+            srv.shutdown()
+            if webhook_srv is not None:
+                webhook_srv.shutdown()
+            return 1
         grpc_server.start()
-        logging.info("legacy register gRPC on %s", args.grpc_bind)
+        logging.info("legacy register gRPC on %s (%d worker slots)",
+                     args.grpc_bind, args.grpc_workers)
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     srv.shutdown()
+    if webhook_srv is not None:
+        webhook_srv.shutdown()
     if grpc_server is not None:
         grpc_server.stop(grace=1)
     sched.stop()

@@ -1,0 +1,85 @@
+/* vtpu-prestart — in-container partition seeder for the second device
+ * family (ref: the smlu-containerd PostStart pattern, webhook.go:73-80 +
+ * server.go:326-331).  Reads the family's env ABI and seeds the shared
+ * region's device table (uuids, HBM limits, core limits) so the monitor
+ * sees the quota immediately; the PJRT shim also self-initializes, so this
+ * hook is a warm-up, not a correctness dependency (PostStart is not
+ * ordered before the entrypoint).
+ *
+ * Env (PJRT_* for the second family; falls back to TPU_* so the binary is
+ * family-agnostic):
+ *   <P>_DEVICE_MEMORY_SHARED_CACHE  region file (default /tmp/vtpu-pjrt/vtpu.cache)
+ *   VTPU_PJRT_VISIBLE_UUIDS | VTPU_VISIBLE_UUIDS   comma-joined uuids
+ *   <P>_DEVICE_MEMORY_LIMIT_<i>     per-device quota, MiB
+ *   <P>_DEVICE_CORES_LIMIT          percent of compute
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+
+#include "shared_region.h"
+
+static const char* env2(const char* a, const char* b) {
+  const char* v = getenv(a);
+  return v ? v : getenv(b);
+}
+
+int main(void) {
+  const char* pfx = getenv("PJRT_DEVICE_MEMORY_LIMIT_0") ? "PJRT" : "TPU";
+  char key[128];
+  snprintf(key, sizeof(key), "%s_DEVICE_MEMORY_SHARED_CACHE", pfx);
+  const char* path = getenv(key);
+  if (!path) path = "/tmp/vtpu-pjrt/vtpu.cache";
+
+  const char* uuids_env = env2("VTPU_PJRT_VISIBLE_UUIDS", "VTPU_VISIBLE_UUIDS");
+  if (!uuids_env || !*uuids_env) {
+    fprintf(stderr, "vtpu-prestart: no visible uuids; nothing to seed\n");
+    return 0; /* non-fatal: hook must not kill the container */
+  }
+
+  char uuids[VTPU_MAX_DEVICES][VTPU_UUID_LEN];
+  uint64_t limits[VTPU_MAX_DEVICES];
+  int32_t cores[VTPU_MAX_DEVICES];
+  memset(uuids, 0, sizeof(uuids));
+
+  snprintf(key, sizeof(key), "%s_DEVICE_CORES_LIMIT", pfx);
+  const char* cl = getenv(key);
+  int32_t core_limit = cl ? atoi(cl) : 100;
+
+  char buf[4096];
+  strncpy(buf, uuids_env, sizeof(buf) - 1);
+  buf[sizeof(buf) - 1] = 0;
+  int n = 0;
+  for (char* u = strtok(buf, ","); u && n < VTPU_MAX_DEVICES;
+       u = strtok(NULL, ",")) {
+    strncpy(uuids[n], u, VTPU_UUID_LEN - 1);
+    snprintf(key, sizeof(key), "%s_DEVICE_MEMORY_LIMIT_%d", pfx, n);
+    const char* lim = getenv(key);
+    limits[n] = lim ? strtoull(lim, NULL, 10) * 1024ull * 1024ull : 0;
+    cores[n] = core_limit;
+    n++;
+  }
+
+  /* region dir is the per-container mount; create-if-missing like the shim */
+  char dir[512];
+  strncpy(dir, path, sizeof(dir) - 1);
+  dir[sizeof(dir) - 1] = 0;
+  char* slash = strrchr(dir, '/');
+  if (slash && slash != dir) {
+    *slash = 0;
+    mkdir(dir, 0777);
+  }
+
+  vtpu_shared_region* r = vtpu_region_open(path);
+  if (!r) {
+    perror("vtpu-prestart: region open");
+    return 0; /* non-fatal */
+  }
+  if (vtpu_region_set_devices(r, n, uuids, limits, cores) != 0)
+    fprintf(stderr, "vtpu-prestart: set_devices failed\n");
+  else
+    fprintf(stderr, "vtpu-prestart: seeded %d device(s) in %s\n", n, path);
+  vtpu_region_close(r);
+  return 0;
+}

@@ -38,6 +38,8 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <strings.h>
+#include <sys/stat.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -60,6 +62,7 @@ struct ShimConfig {
   int core_policy_disable = 0;
   const char* region_path = nullptr;
   const char* real_plugin = nullptr;
+  const char* env_prefix = "TPU"; /* "TPU" | "PJRT" (VTPU_SHIM_FAMILY) */
 };
 
 ShimConfig g_cfg;
@@ -78,21 +81,42 @@ std::unordered_map<void*, Acct> g_programs;
 std::unordered_map<void*, int> g_device_index; /* PJRT_Device* → local idx */
 
 void load_config() {
+  /* family-scoped env namespace: primary family is TPU_*, the second
+   * device family gets PJRT_*.  One loaded shim instance has ONE config —
+   * a process that opens clients for BOTH families in a mixed-family
+   * container must pick which family this shim enforces via
+   * VTPU_SHIM_FAMILY=tpu|pjrt (set it in the client-launching wrapper);
+   * the un-shimmed family is still seeded/visible through its
+   * vtpu-prestart region and the node monitor.  Default: TPU_* wins. */
+  const char* fam = getenv("VTPU_SHIM_FAMILY");
+  const char* pfx;
+  if (fam && strcasecmp(fam, "pjrt") == 0)
+    pfx = "PJRT";
+  else if (fam && strcasecmp(fam, "tpu") == 0)
+    pfx = "TPU";
+  else
+    pfx = getenv("TPU_DEVICE_MEMORY_LIMIT_0") ? "TPU" : "PJRT";
+  g_cfg.env_prefix = pfx;
   char key[64];
   for (int i = 0; i < VTPU_MAX_DEVICES; i++) {
-    snprintf(key, sizeof(key), "TPU_DEVICE_MEMORY_LIMIT_%d", i);
+    snprintf(key, sizeof(key), "%s_DEVICE_MEMORY_LIMIT_%d", pfx, i);
     const char* v = getenv(key);
     if (v) g_cfg.limit_bytes[i] = strtoull(v, nullptr, 10) * 1024ull * 1024ull;
   }
-  const char* c = getenv("TPU_DEVICE_CORES_LIMIT");
+  snprintf(key, sizeof(key), "%s_DEVICE_CORES_LIMIT", pfx);
+  const char* c = getenv(key);
   if (c) g_cfg.core_limit = atoi(c);
   const char* o = getenv("VTPU_OVERSUBSCRIBE");
   g_cfg.oversubscribe = (o && strcmp(o, "true") == 0);
-  const char* p = getenv("TPU_TASK_PRIORITY");
+  snprintf(key, sizeof(key), "%s_TASK_PRIORITY", pfx);
+  const char* p = getenv(key);
+  if (!p) p = getenv("TPU_TASK_PRIORITY");
   if (p) g_cfg.priority = atoi(p);
-  const char* pol = getenv("TPU_CORE_UTILIZATION_POLICY");
+  snprintf(key, sizeof(key), "%s_CORE_UTILIZATION_POLICY", pfx);
+  const char* pol = getenv(key);
   if (pol && strcmp(pol, "disable") == 0) g_cfg.core_policy_disable = 1;
-  g_cfg.region_path = getenv("TPU_DEVICE_MEMORY_SHARED_CACHE");
+  snprintf(key, sizeof(key), "%s_DEVICE_MEMORY_SHARED_CACHE", pfx);
+  g_cfg.region_path = getenv(key);
   if (!g_cfg.region_path) g_cfg.region_path = "/tmp/vtpu/vtpu.cache";
   g_cfg.real_plugin = getenv("VTPU_REAL_PJRT_PLUGIN");
   if (!g_cfg.real_plugin)
@@ -242,13 +266,30 @@ void destroy_real_buffer(PJRT_Buffer* buf) {
 PJRT_Error* wrap_Client_Create(PJRT_Client_Create_Args* args) {
   PJRT_Error* err = g_real->PJRT_Client_Create(args);
   if (err) return err;
-  /* open the shared region and publish limits */
+  /* open the shared region and publish limits; create the parent dir if
+   * the mount is absent (bare-host runs) — a missing region must not
+   * silently disable enforcement */
+  {
+    char dir[512];
+    snprintf(dir, sizeof(dir), "%s", g_cfg.region_path);
+    char* slash = strrchr(dir, '/');
+    if (slash && slash != dir) {
+      *slash = 0;
+      mkdir(dir, 0777);
+    }
+  }
   g_region = vtpu_region_open(g_cfg.region_path);
   if (g_region) {
     char uuids[VTPU_MAX_DEVICES][VTPU_UUID_LEN];
     memset(uuids, 0, sizeof(uuids));
     int32_t cores[VTPU_MAX_DEVICES];
-    const char* visible = getenv("VTPU_VISIBLE_UUIDS");
+    /* family-scoped lookup order, consistent with load_config */
+    int is_pjrt = strcmp(g_cfg.env_prefix, "PJRT") == 0;
+    const char* visible = is_pjrt ? getenv("VTPU_PJRT_VISIBLE_UUIDS")
+                                  : getenv("VTPU_VISIBLE_UUIDS");
+    if (!visible)
+      visible = is_pjrt ? getenv("VTPU_VISIBLE_UUIDS")
+                        : getenv("VTPU_PJRT_VISIBLE_UUIDS");
     int n = 0;
     if (visible) {
       char tmp[1024];

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Image build + push (ref: hack/build.sh — docker build with version ldflags;
+# here version is baked via VTPU_VERSION env into the image labels).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+VERSION="${VERSION:-$(git describe --tags --always --dirty 2>/dev/null || echo dev)}"
+IMAGE="${IMAGE:-vtpu/vtpu}"
+PUSH="${PUSH:-false}"
+
+echo "building ${IMAGE}:${VERSION}"
+docker build \
+  --build-arg VTPU_VERSION="${VERSION}" \
+  -t "${IMAGE}:${VERSION}" \
+  -t "${IMAGE}:latest" \
+  -f docker/Dockerfile .
+
+docker build \
+  -t "${IMAGE}-ai-benchmark:${VERSION}" \
+  -f benchmarks/ai-benchmark/Dockerfile .
+
+if [ "${PUSH}" = "true" ]; then
+  docker push "${IMAGE}:${VERSION}"
+  docker push "${IMAGE}:latest"
+  docker push "${IMAGE}-ai-benchmark:${VERSION}"
+fi

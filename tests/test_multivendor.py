@@ -142,6 +142,83 @@ def test_mixed_family_pod_gets_both():
     assert {d.type for d in devs} == {DEVICE_TYPE_TPU, DEVICE_TYPE_PJRT}
 
 
+def test_mixed_family_container_responses_do_not_collide(tmp_path):
+    """A mixed-family container receives BOTH families' merged
+    ContainerAllocateResponses — env names and mount paths must be
+    disjoint, like the reference's CUDA_* vs CAMBRICON_* namespaces."""
+    from vtpu.device.fake import FakeProvider
+    from vtpu.plugin.cache import DeviceCache
+    from vtpu.plugin.config import PluginConfig
+    from vtpu.plugin.server import VtpuDevicePlugin
+    from vtpu.utils.types import ContainerDevice, PRESTART_PROGRAM
+
+    client = FakeClient()
+    responses = {}
+    for family, cache_dir in (("tpu", "/tmp/vtpu"), ("pjrt", "/tmp/vtpu-pjrt")):
+        cfg = PluginConfig(
+            node_name="n1",
+            device_family=family,
+            container_cache_dir=cache_dir,
+            shim_host_dir=str(tmp_path / "shim"),
+            cache_host_root=str(tmp_path / f"containers-{family}"),
+        )
+        provider = FakeProvider({"model": "X", "topology": "1x1x1"})
+        cache = DeviceCache(provider, poll_interval_s=3600)
+        plugin = VtpuDevicePlugin(client, cache, cfg)
+        devs = [ContainerDevice(uuid=f"{family}-0", type=family.upper(),
+                                usedmem=1024, usedcores=50)]
+        pod = {"metadata": {"uid": f"uid-{family}", "name": "p",
+                            "namespace": "default"}}
+        responses[family] = plugin._container_response(devs, pod)
+        cache.stop()
+    tpu_env = set(responses["tpu"].envs)
+    pjrt_env = set(responses["pjrt"].envs)
+    assert tpu_env.isdisjoint(pjrt_env), tpu_env & pjrt_env
+    tpu_mounts = {m.container_path for m in responses["tpu"].mounts}
+    pjrt_mounts = {m.container_path for m in responses["pjrt"].mounts}
+    # the only shared path is the (identical, shared) lock dir
+    assert tpu_mounts & pjrt_mounts <= {"/tmp/vtpulock"}
+    assert "PJRT_DEVICE_MEMORY_LIMIT_0" in pjrt_env
+    assert "TPU_DEVICE_MEMORY_LIMIT_0" in tpu_env
+    # with the prestart helper present on the host, the pjrt family mounts
+    # it at the path the webhook's PostStart hook execs
+    import os
+    os.makedirs(tmp_path / "shim", exist_ok=True)
+    (tmp_path / "shim" / "vtpu-prestart").write_bytes(b"")
+    cfg = PluginConfig(
+        node_name="n1", device_family="pjrt",
+        container_cache_dir="/tmp/vtpu-pjrt",
+        shim_host_dir=str(tmp_path / "shim"),
+        cache_host_root=str(tmp_path / "containers-pjrt"),
+    )
+    provider = FakeProvider({"model": "X", "topology": "1x1x1"})
+    cache = DeviceCache(provider, poll_interval_s=3600)
+    plugin = VtpuDevicePlugin(client, cache, cfg)
+    resp = plugin._container_response(
+        [ContainerDevice(uuid="pjrt-0", type="PJRT", usedmem=1024, usedcores=50)],
+        {"metadata": {"uid": "uid-2", "name": "p", "namespace": "default"}},
+    )
+    cache.stop()
+    assert PRESTART_PROGRAM in {m.container_path for m in resp.mounts}
+
+
+def test_legacy_grpc_and_annotation_register_dedup():
+    """A node registering over BOTH transports must not double-count chips
+    (same-uuid dedup across sources; newest wins)."""
+    from vtpu.utils.types import ChipInfo as CI
+
+    client = FakeClient()
+    sched = Scheduler(client)
+    chips = [CI(uuid="u0", count=4, hbm_mb=16384, cores=100,
+                type=DEVICE_TYPE_TPU, health=True)]
+    sched.nodes.add_node("n1", chips, source="legacy-grpc")
+    sched.nodes.add_node("n1", chips, source=annotations.NODE_HANDSHAKE)
+    info = sched.nodes.get("n1")
+    assert len(info.devices) == 1  # not 2
+    # and the stale transport's empty source was dropped entirely
+    assert list(info.by_source) == [annotations.NODE_HANDSHAKE]
+
+
 def test_pjrt_provider_cpu_enumeration():
     """PjrtProvider over the test process's CPU devices (conftest forces
     an 8-device CPU platform)."""
@@ -150,3 +227,21 @@ def test_pjrt_provider_cpu_enumeration():
     assert len(chips) >= 1
     assert all(c.model == "PJRT-cpu" for c in chips)
     assert prov.health_check() == chips
+
+
+def test_pjrt_provider_health_reprobe():
+    """health_check re-derives liveness each call: a uuid that vanishes
+    from fresh discovery flips unhealthy and recovers when it returns."""
+    prov = PjrtProvider(platform="cpu")
+    chips = prov.enumerate()
+    assert chips and all(c.healthy for c in chips)
+    victim = chips[0].uuid
+    real_discover = prov._discover
+    prov._discover = lambda: [c for c in real_discover() if c.uuid != victim]
+    after = prov.health_check()
+    assert [c for c in after if c.uuid == victim][0].healthy is False
+    # device set stays pinned (kubelet identity stability)
+    assert {c.uuid for c in after} == {c.uuid for c in chips}
+    prov._discover = real_discover
+    recovered = prov.health_check()
+    assert [c for c in recovered if c.uuid == victim][0].healthy is True

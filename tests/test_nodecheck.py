@@ -93,6 +93,34 @@ def test_node_affinity_in_notin_exists():
     )
 
 
+def test_node_affinity_match_fields():
+    """matchFields (metadata.name) must not vacuously pass (the NodeAffinity
+    plugin honors it; a matchFields-only term once matched every node)."""
+    n = node(labels={})
+    n["metadata"]["name"] = "node-a"
+    req = lambda *terms: {  # noqa: E731
+        "requiredDuringSchedulingIgnoredDuringExecution": {
+            "nodeSelectorTerms": list(terms)
+        }
+    }
+    field = lambda op, *v: {  # noqa: E731
+        "matchFields": [{"key": "metadata.name", "operator": op, "values": list(v)}]
+    }
+    assert matches_node_affinity(pod(affinity=req(field("In", "node-a"))), n)
+    assert not matches_node_affinity(pod(affinity=req(field("In", "node-b"))), n)
+    assert not matches_node_affinity(pod(affinity=req(field("NotIn", "node-a"))), n)
+    # unknown field key fails closed
+    bad = {"matchFields": [{"key": "spec.providerID", "operator": "In", "values": ["x"]}]}
+    assert not matches_node_affinity(pod(affinity=req(bad)), n)
+    # fields AND expressions within one term
+    n["metadata"]["labels"] = {"tpu": "v5e"}
+    both = {
+        "matchExpressions": [{"key": "tpu", "operator": "In", "values": ["v5e"]}],
+        "matchFields": [{"key": "metadata.name", "operator": "In", "values": ["node-a"]}],
+    }
+    assert matches_node_affinity(pod(affinity=req(both)), n)
+
+
 def test_taints_tolerations():
     taint = {"key": "tpu", "value": "dedicated", "effect": "NoSchedule"}
     n = node(taints=[taint])

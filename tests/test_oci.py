@@ -1,0 +1,117 @@
+"""OCI runtime-wrapper tests (ref shape: pkg/oci/runtime_exec_test.go:28-100
+— mock-exec capture + invalid-path constructor cases; spec load/modify/flush
+round-trip)."""
+
+import json
+import os
+
+import pytest
+
+from vtpu.oci.runtime import SyscallExecRuntime
+from vtpu.oci.spec import FileSpec, inject_prestart_hook, spec_path_from_args
+
+
+# -- SyscallExecRuntime ---------------------------------------------------
+
+
+def test_runtime_invalid_path_rejected(tmp_path):
+    with pytest.raises(ValueError):
+        SyscallExecRuntime(str(tmp_path / "missing"))
+    d = tmp_path / "adir"
+    d.mkdir()
+    with pytest.raises(ValueError):
+        SyscallExecRuntime(str(d))
+    f = tmp_path / "notexec"
+    f.write_text("x")
+    f.chmod(0o644)
+    with pytest.raises(ValueError):
+        SyscallExecRuntime(str(f))
+
+
+def make_exec_target(tmp_path):
+    f = tmp_path / "runc"
+    f.write_text("#!/bin/sh\n")
+    f.chmod(0o755)
+    return str(f)
+
+
+def test_runtime_mock_exec_capture(tmp_path):
+    target = make_exec_target(tmp_path)
+    calls = []
+    rt = SyscallExecRuntime(
+        target, exec_fn=lambda p, argv, env: calls.append((p, argv))
+    )
+    # a mocked exec returns ⇒ the wrapper must treat that as an error
+    # (ref runtime_exec.go:75-79 "unexpected return from exec")
+    with pytest.raises(RuntimeError, match="unexpected return"):
+        rt.exec(["vtpu-oci-runtime", "create", "--bundle", "/b", "cid"])
+    (path, argv), = calls
+    assert path == target
+    # argv[0] is forced to the real runtime path; rest passes through
+    assert argv == [target, "create", "--bundle", "/b", "cid"]
+
+
+def test_runtime_exec_fn_error_propagates(tmp_path):
+    target = make_exec_target(tmp_path)
+
+    def boom(p, argv, env):
+        raise OSError("exec failed")
+
+    rt = SyscallExecRuntime(target, exec_fn=boom)
+    with pytest.raises(OSError, match="exec failed"):
+        rt.exec(["x", "state", "cid"])
+
+
+# -- FileSpec -------------------------------------------------------------
+
+
+def test_spec_load_modify_flush_roundtrip(tmp_path):
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps({"process": {"env": ["A=1"]}, "ociVersion": "1.0.2"}))
+    spec = FileSpec(str(p))
+    spec.load()
+    spec.modify(
+        lambda s: inject_prestart_hook(s, "/usr/local/vtpu/vtpu-prestart", ["B=2"])
+    )
+    spec.flush()
+    out = json.loads(p.read_text())
+    assert out["process"]["env"] == ["A=1", "B=2"]
+    assert out["hooks"]["prestart"] == [{"path": "/usr/local/vtpu/vtpu-prestart"}]
+    assert out["ociVersion"] == "1.0.2"  # untouched fields survive
+
+
+def test_spec_modify_without_load_fails(tmp_path):
+    spec = FileSpec(str(tmp_path / "c.json"))
+    with pytest.raises(RuntimeError):
+        spec.modify(lambda s: None)
+    with pytest.raises(RuntimeError):
+        spec.flush()
+
+
+def test_inject_prestart_hook_idempotent():
+    s = {}
+    for _ in range(2):
+        inject_prestart_hook(s, "/p", ["E=1"])
+    assert s["hooks"]["prestart"] == [{"path": "/p"}]
+    assert s["process"]["env"] == ["E=1"]
+
+
+# -- bundle argv parsing --------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "args,expect_dir",
+    [
+        (["create", "--bundle", "/b1", "cid"], "/b1"),
+        (["create", "--bundle=/b2", "cid"], "/b2"),
+        (["create", "-b=/b3", "cid"], "/b3"),
+    ],
+)
+def test_spec_path_from_args(args, expect_dir):
+    assert spec_path_from_args(args) == os.path.join(expect_dir, "config.json")
+
+
+def test_spec_path_defaults_to_cwd():
+    assert spec_path_from_args(["state", "cid"]) == os.path.join(
+        os.getcwd(), "config.json"
+    )

@@ -11,6 +11,7 @@ from vtpu.k8s import FakeClient, new_node, new_pod
 from vtpu.k8s.objects import get_annotations
 from vtpu.scheduler import Scheduler, SchedulerConfig
 from vtpu.scheduler.routes import serve
+from vtpu.scheduler import webhook
 from vtpu.scheduler.webhook import handle_admission_review, mutate_pod
 from vtpu.utils import codec
 from vtpu.utils.types import (
@@ -286,6 +287,34 @@ def test_http_bad_json(http_sched):
     assert ei.value.code == 400
 
 
+def test_serve_tls(tmp_path):
+    """The webhook listener speaks TLS when given cert/key (the chart's
+    certgen secret; ref extender TLS flags cmd/scheduler/main.go:51-58)."""
+    import ssl
+    import subprocess
+
+    crt, key = str(tmp_path / "tls.crt"), str(tmp_path / "tls.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", "1", "-subj", "/CN=localhost"],
+        check=True, capture_output=True,
+    )
+    client = FakeClient()
+    sched = Scheduler(client, SchedulerConfig(http_bind="127.0.0.1:0"))
+    srv, _ = serve(sched, cert_file=crt, key_file=key)
+    try:
+        port = srv.server_address[1]
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        body = urllib.request.urlopen(
+            f"https://127.0.0.1:{port}/healthz", context=ctx, timeout=10
+        ).read()
+        assert body == b"ok"
+    finally:
+        srv.shutdown()
+
+
 # -- webhook --------------------------------------------------------------
 
 
@@ -310,6 +339,46 @@ def test_webhook_priority_env():
     ops = mutate_pod(pod, SchedulerConfig())
     env_ops = [o for o in ops if "env" in o["path"]]
     assert env_ops and env_ops[0]["value"][0]["name"] == "TPU_TASK_PRIORITY"
+
+
+def test_webhook_pjrt_pod_gets_scheduler_name():
+    pod = new_pod(
+        "pj",
+        containers=[
+            {"name": "main", "resources": {"limits": {resources.pjrt_chip: 1}}}
+        ],
+    )
+    ops = mutate_pod(pod, SchedulerConfig())
+    assert {"op": "add", "path": "/spec/schedulerName", "value": "vtpu-scheduler"} in ops
+
+
+def test_webhook_pjrt_mem_poststart_hook():
+    # second-family mem limit ⇒ PostStart prestart program injected
+    # (ref webhook.go:73-80 smlu-containerd PostStart)
+    pod = new_pod(
+        "pj",
+        containers=[
+            {
+                "name": "main",
+                "resources": {
+                    "limits": {resources.pjrt_chip: 1, resources.pjrt_memory: 4096}
+                },
+            }
+        ],
+    )
+    ops = mutate_pod(pod, SchedulerConfig())
+    hook_ops = [o for o in ops if "lifecycle" in o["path"]]
+    assert hook_ops, ops
+    cmd = hook_ops[0]["value"]["postStart"]["exec"]["command"]
+    # guarded exec: a missing helper must be a no-op, not a crash loop
+    assert cmd[:2] == ["/bin/sh", "-c"] and webhook.PRESTART_PROGRAM in cmd[2]
+    assert "|| true" in cmd[2]
+    # idempotent: an existing postStart hook is left alone
+    pod["spec"]["containers"][0]["lifecycle"] = {
+        "postStart": {"exec": {"command": ["/bin/true"]}}
+    }
+    ops2 = mutate_pod(pod, SchedulerConfig())
+    assert not [o for o in ops2 if "lifecycle" in o["path"]]
 
 
 def test_webhook_privileged_container_skipped():

@@ -16,8 +16,3 @@ from vtpu.api.register_service import (  # noqa: F401
     add_device_service,
     stream_register,
 )
-
-# container env knobs (ref pkg/api/types.go:19-22: CUDA_TASK_PRIORITY,
-# GPU_CORE_UTILIZATION_POLICY)
-TASK_PRIORITY_ENV = "TPU_TASK_PRIORITY"
-CORE_UTILIZATION_POLICY_ENV = "TPU_CORE_UTILIZATION_POLICY"

@@ -80,4 +80,22 @@ class PjrtProvider:
         return Topology((max(n, 1), 1, 1), wrap=(False, False, False))
 
     def health_check(self) -> List[Chip]:
-        return self.enumerate()
+        """Re-probe liveness each poll (DeviceCache contract; the libtpu
+        provider re-probes /dev nodes the same way).  The device *set* is
+        pinned at first enumeration — kubelet identity must stay stable —
+        but each chip's health is re-derived: a uuid missing from a fresh
+        PJRT enumeration (died/hot-unplugged/runtime wedged) flips
+        unhealthy, and recovers when it reappears (the CNDEV recovery
+        semantics, cambricon.go:188-224)."""
+        import dataclasses
+
+        base = self.enumerate()
+        alive = {c.uuid for c in self._discover()}
+        out = [
+            dataclasses.replace(c, healthy=(c.uuid in alive))
+            if (c.uuid in alive) != c.healthy
+            else c
+            for c in base
+        ]
+        self._chips = out
+        return list(out)

@@ -1,0 +1,18 @@
+"""OCI runtime shim (vestigial parity layer).
+
+The reference keeps a remnant of its v1.x modified `nvidia-container-runtime`
+(ref: pkg/oci/{runtime.go,runtime_exec.go:30-79,spec.go:29-102}; dropped in
+v2.2 per CHANGELOG "modified nvidia-container-runtime is no longer needed",
+SURVEY.md §2.7).  We keep the same shape for the same reason: an escape hatch
+for container runtimes whose kubelet device-plugin path cannot mount the shim
+— an OCI runtime wrapper that loads the container's `config.json`, injects
+the vtpu prestart hook + env, flushes it back, then execs the real runtime.
+
+Nothing in the framework imports this package; `cmd/vtpu_oci_runtime.py`
+exposes it for operators who need the wrapper path.
+"""
+
+from vtpu.oci.runtime import Runtime, SyscallExecRuntime
+from vtpu.oci.spec import FileSpec, Spec
+
+__all__ = ["Runtime", "SyscallExecRuntime", "Spec", "FileSpec"]

@@ -1,0 +1,44 @@
+"""Runtime interface + exec-replacing wrapper (ref: pkg/oci/runtime.go,
+runtime_exec.go:30-79)."""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, List, Optional, Protocol
+
+log = logging.getLogger(__name__)
+
+ExecFn = Callable[[str, List[str], dict], None]
+
+
+class Runtime(Protocol):
+    """An OCI runtime: receives the full argv of the calling runtime
+    invocation (ref runtime.go Runtime interface)."""
+
+    def exec(self, args: List[str]) -> None: ...
+
+
+class SyscallExecRuntime:
+    """Replaces the current process with the real runtime binary
+    (ref runtime_exec.go:30-79; `exec` injectable for tests, the
+    WithMockExec trick of runtime_mock.go)."""
+
+    def __init__(self, path: str, exec_fn: Optional[ExecFn] = None) -> None:
+        if not os.path.isfile(path):
+            raise ValueError(f"invalid path {path!r}: not a file")
+        if not os.access(path, os.X_OK):
+            raise ValueError(f"specified path {path!r} is not an executable file")
+        self.path = path
+        self._exec: ExecFn = exec_fn or (
+            lambda p, argv, env: os.execve(p, argv, env)
+        )
+
+    def exec(self, args: List[str]) -> None:
+        """Exec the wrapped runtime; argv[0] is forced to the real path
+        (ref runtime_exec.go:64-79)."""
+        argv = [self.path] + list(args[1:])
+        self._exec(self.path, argv, dict(os.environ))
+        # a real exec never returns; reaching here means the injected
+        # exec_fn was a mock OR the exec failed silently
+        raise RuntimeError(f"unexpected return from exec {self.path!r}")

@@ -1,0 +1,73 @@
+"""File-backed OCI spec: load / modify / flush (ref: pkg/oci/spec.go:29-102),
+plus the bundle-dir argv parsing the modified runtime used to locate
+`config.json`."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, List, Optional, Protocol
+
+SpecModifier = Callable[[dict], None]
+
+
+class Spec(Protocol):
+    def load(self) -> None: ...
+    def flush(self) -> None: ...
+    def modify(self, fn: SpecModifier) -> None: ...
+
+
+class FileSpec:
+    """Encapsulates a file-backed OCI spec: read, mutate in place, write back
+    truncating (ref spec.go:56-102)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.spec: Optional[dict] = None
+
+    def load(self) -> None:
+        with open(self.path) as f:
+            self.spec = json.load(f)
+
+    def modify(self, fn: SpecModifier) -> None:
+        if self.spec is None:
+            raise RuntimeError("no spec loaded for modification")
+        fn(self.spec)
+
+    def flush(self) -> None:
+        if self.spec is None:
+            raise RuntimeError("no spec loaded to flush")
+        with open(self.path, "w") as f:
+            json.dump(self.spec, f)
+
+
+def spec_path_from_args(args: List[str]) -> str:
+    """Locate the OCI bundle's config.json from runtime argv: honors both
+    `--bundle <dir>` and `--bundle=<dir>`; defaults to the CWD (the OCI
+    runtime contract the modified nvidia-container-runtime relied on)."""
+    bundle = os.getcwd()
+    it = iter(range(len(args)))
+    for i in it:
+        a = args[i]
+        if a == "--bundle" or a == "-b":
+            if i + 1 < len(args):
+                bundle = args[i + 1]
+        elif a.startswith("--bundle="):
+            bundle = a.split("=", 1)[1]
+        elif a.startswith("-b="):
+            bundle = a.split("=", 1)[1]
+    return os.path.join(bundle, "config.json")
+
+
+def inject_prestart_hook(spec: dict, program: str, envs: List[str]) -> None:
+    """SpecModifier: add the vtpu prestart hook + env to an OCI spec — the
+    mutation the modified runtime applied before exec'ing runc."""
+    proc = spec.setdefault("process", {})
+    env = proc.setdefault("env", [])
+    for e in envs:
+        if e not in env:
+            env.append(e)
+    hooks = spec.setdefault("hooks", {})
+    prestart = hooks.setdefault("prestart", [])
+    if not any(h.get("path") == program for h in prestart):
+        prestart.append({"path": program})

@@ -64,6 +64,22 @@ class PluginConfig:
 
         return DEVICE_TYPE_PJRT if self.device_family == "pjrt" else DEVICE_TYPE_TPU
 
+    @property
+    def env_prefix(self) -> str:
+        """Family-scoped env namespace, so a mixed-family container's two
+        merged ContainerAllocateResponses cannot clobber each other (the
+        reference's two vendors are disjoint the same way: CUDA_* vs
+        CAMBRICON_*)."""
+        return "PJRT" if self.device_family == "pjrt" else "TPU"
+
+    @property
+    def visible_uuids_env(self) -> str:
+        return (
+            "VTPU_PJRT_VISIBLE_UUIDS"
+            if self.device_family == "pjrt"
+            else "VTPU_VISIBLE_UUIDS"
+        )
+
     @classmethod
     def from_env(cls, config_file: Optional[str] = None) -> "PluginConfig":
         cfg = cls()

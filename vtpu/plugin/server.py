@@ -39,6 +39,7 @@ from vtpu.plugin import v1beta1_pb2 as pb
 from vtpu.plugin.cache import DeviceCache
 from vtpu.plugin.config import PluginConfig
 from vtpu.utils import allocate as alloc_util
+from vtpu.utils import types
 
 log = logging.getLogger(__name__)
 
@@ -179,11 +180,12 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
     ) -> pb.ContainerAllocateResponse:
         """Build env/mount/device injection (ref plugin.go:353-392)."""
         cfg = self.cfg
+        pfx = cfg.env_prefix  # family-scoped: TPU_* / PJRT_* never collide
         resp = pb.ContainerAllocateResponse()
         chips_by_uuid = {c.uuid: c for c in self.cache.chips()}
         indices = []
         for i, cd in enumerate(devs):
-            resp.envs[f"TPU_DEVICE_MEMORY_LIMIT_{i}"] = str(cd.usedmem)
+            resp.envs[f"{pfx}_DEVICE_MEMORY_LIMIT_{i}"] = str(cd.usedmem)
             chip = chips_by_uuid.get(cd.uuid)
             if chip is not None:
                 indices.append(str(chip.index))
@@ -197,18 +199,18 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
                     )
         cores = max((cd.usedcores for cd in devs), default=0)
         if cores and not cfg.disable_core_limit:
-            resp.envs["TPU_DEVICE_CORES_LIMIT"] = str(cores)
-        resp.envs["VTPU_VISIBLE_UUIDS"] = ",".join(cd.uuid for cd in devs)
+            resp.envs[f"{pfx}_DEVICE_CORES_LIMIT"] = str(cores)
+        resp.envs[cfg.visible_uuids_env] = ",".join(cd.uuid for cd in devs)
         if indices:
-            resp.envs["TPU_VISIBLE_CHIPS"] = ",".join(indices)
-            resp.envs["TPU_VISIBLE_DEVICES"] = ",".join(indices)
-        resp.envs["TPU_DEVICE_MEMORY_SHARED_CACHE"] = (
+            resp.envs[f"{pfx}_VISIBLE_CHIPS"] = ",".join(indices)
+            resp.envs[f"{pfx}_VISIBLE_DEVICES"] = ",".join(indices)
+        resp.envs[f"{pfx}_DEVICE_MEMORY_SHARED_CACHE"] = (
             f"{cfg.container_cache_dir}/vtpu.cache"
         )
         if cfg.device_memory_scaling > 1.0:
             resp.envs["VTPU_OVERSUBSCRIBE"] = "true"
         if cfg.core_utilization_policy != "default":
-            resp.envs["TPU_CORE_UTILIZATION_POLICY"] = cfg.core_utilization_policy
+            resp.envs[f"{pfx}_CORE_UTILIZATION_POLICY"] = cfg.core_utilization_policy
         # mounts: shim artifacts + per-container shared-region dir (§3.3).
         # The host dirs must exist before kubelet bind-mounts them (runc
         # rejects missing sources), and the name must be unique PER
@@ -231,6 +233,18 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
         resp.mounts.append(
             pb.Mount(container_path="/tmp/vtpulock", host_path="/tmp/vtpulock")
         )
+        # second family: mount the prestart helper the webhook's PostStart
+        # hook execs (ref server.go:326-331 mounting smlu-containerd)
+        if cfg.device_family == "pjrt":
+            prestart_host = os.path.join(cfg.shim_host_dir, "vtpu-prestart")
+            if os.path.exists(prestart_host):
+                resp.mounts.append(
+                    pb.Mount(
+                        container_path=types.PRESTART_PROGRAM,
+                        host_path=prestart_host,
+                        read_only=True,
+                    )
+                )
         shim_lib = os.path.join(cfg.shim_host_dir, "libvtpu_shim.so")
         preload = os.path.join(cfg.shim_host_dir, "ld.so.preload")
         if os.path.exists(shim_lib):

@@ -51,9 +51,20 @@ def _match_expression(labels: Dict[str, str], expr: dict) -> bool:
     return False
 
 
-def _match_selector_term(labels: Dict[str, str], term: dict) -> bool:
-    """All matchExpressions of one term must hold (terms OR together)."""
-    return all(_match_expression(labels, e) for e in term.get("matchExpressions") or [])
+def _match_selector_term(labels: Dict[str, str], term: dict, node: dict) -> bool:
+    """All matchExpressions AND matchFields of one term must hold (terms OR
+    together).  matchFields supports the one field k8s defines,
+    ``metadata.name``; an unknown field never matches (fail closed)."""
+    if not all(_match_expression(labels, e) for e in term.get("matchExpressions") or []):
+        return False
+    for f in term.get("matchFields") or []:
+        if f.get("key") != "metadata.name":
+            log.warning("unsupported matchFields key %r", f.get("key"))
+            return False
+        name = (node.get("metadata") or {}).get("name", "")
+        if not _match_expression({"metadata.name": name}, f):
+            return False
+    return True
 
 
 def matches_node_selector(pod: dict, node: dict) -> bool:
@@ -75,7 +86,7 @@ def matches_node_affinity(pod: dict, node: dict) -> bool:
     terms = required.get("nodeSelectorTerms") or []
     if not terms:
         return True
-    return any(_match_selector_term(labels, t) for t in terms)
+    return any(_match_selector_term(labels, t, node) for t in terms)
 
 
 def _tolerates(tolerations: List[dict], taint: dict) -> bool:

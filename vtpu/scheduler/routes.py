@@ -128,14 +128,40 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def serve(
-    sched: Scheduler, bind: Optional[str] = None
+    sched: Scheduler,
+    bind: Optional[str] = None,
+    cert_file: Optional[str] = None,
+    key_file: Optional[str] = None,
 ) -> Tuple[ThreadingHTTPServer, threading.Thread]:
-    """Start the HTTP server in a daemon thread; returns (server, thread).
-    TLS (needed for the webhook in-cluster) is terminated by the chart's
-    sidecar/secret mount in deployment; plain HTTP here."""
+    """Start the HTTP(S) server in a daemon thread; returns (server, thread).
+    With cert_file/key_file the listener speaks TLS — required for the
+    in-cluster webhook (ref: the extender's TLS flags,
+    cmd/scheduler/main.go:51-58; certs provisioned by the chart's certgen
+    Job)."""
+    if bool(cert_file) != bool(key_file):
+        raise ValueError("TLS needs both cert_file and key_file (got one)")
     host, _, port = (bind or sched.config.http_bind).rpartition(":")
     handler = type("BoundHandler", (_Handler,), {"scheduler": sched})
     srv = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler)
+    if cert_file and key_file:
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(cert_file, key_file)
+        # wrap with deferred handshake: the handshake then runs on first
+        # read inside the per-connection worker thread (with a timeout),
+        # so a stalled client can't block the single accept loop
+        srv.socket = ctx.wrap_socket(
+            srv.socket, server_side=True, do_handshake_on_connect=False
+        )
+        real_get_request = srv.get_request
+
+        def get_request():
+            sock, addr = real_get_request()
+            sock.settimeout(30.0)
+            return sock, addr
+
+        srv.get_request = get_request  # type: ignore[method-assign]
     t = threading.Thread(target=srv.serve_forever, name="vtpu-http", daemon=True)
     t.start()
     return srv, t

@@ -57,6 +57,20 @@ class NodeManager:
             if topology:
                 info.topology = topology
             info.by_source[source] = [d.clone() for d in devices]
+            # same-uuid dedup across sources: a node registering over BOTH
+            # the annotation bus and the legacy gRPC stream must not
+            # double-count its chips (newest registration of a uuid wins;
+            # ref: gRPC registration superseded by annotations, CHANGELOG
+            # v2.2 — both transports stay live during migration)
+            new_uuids = {d.uuid for d in devices}
+            for src, devs in list(info.by_source.items()):
+                if src == source:
+                    continue
+                kept = [d for d in devs if d.uuid not in new_uuids]
+                if len(kept) != len(devs):
+                    info.by_source[src] = kept
+                if not kept:
+                    info.by_source.pop(src, None)
             info.devices = [d for devs in info.by_source.values() for d in devs]
 
     def rm_node_devices(self, name: str, source: Optional[str] = None) -> None:

@@ -21,6 +21,14 @@ log = logging.getLogger(__name__)
 # (ref: api.TaskPriority env CUDA_TASK_PRIORITY, pkg/api/types.go:19-22)
 ENV_TASK_PRIORITY = "TPU_TASK_PRIORITY"
 
+# Second-family partition helper injected as a PostStart hook when the
+# container carries a PJRT memory limit (ref webhook.go:73-80: MLU-mem
+# containers get PostStart exec /usr/bin/smlu-containerd, the userspace
+# daemon that programs the kernel split device).  Our analog seeds the
+# shim's shared region; PostStart runs concurrently with the entrypoint,
+# so the shim also self-initializes — the hook only warms the region.
+from vtpu.utils.types import PRESTART_PROGRAM  # noqa: E402  (re-export)
+
 
 def _container_is_privileged(ctr: dict) -> bool:
     return bool((ctr.get("securityContext") or {}).get("privileged"))
@@ -40,8 +48,44 @@ def mutate_pod(pod: dict, config: SchedulerConfig) -> List[dict]:
             log.info("webhook: skipping privileged container %s", ctr.get("name"))
             continue
         limits = (ctr.get("resources") or {}).get("limits") or {}
-        if _as_int(limits.get(resources.chip, 0)) > 0:
+        if (
+            _as_int(limits.get(resources.chip, 0)) > 0
+            or _as_int(limits.get(resources.pjrt_chip, 0)) > 0
+        ):
             has_resource = True
+        if _as_int(limits.get(resources.pjrt_memory, 0)) > 0 and not (
+            ctr.get("lifecycle") or {}
+        ).get("postStart"):
+            # guard the exec: the helper is mounted only by the pjrt
+            # plugin's Allocate, and PostStart failures crash-loop the
+            # container — a missing binary must stay a no-op warm-up
+            hook = {
+                "postStart": {
+                    "exec": {
+                        "command": [
+                            "/bin/sh",
+                            "-c",
+                            f"[ -x {PRESTART_PROGRAM} ] && {PRESTART_PROGRAM} || true",
+                        ]
+                    }
+                }
+            }
+            if ctr.get("lifecycle"):
+                ops.append(
+                    {
+                        "op": "add",
+                        "path": f"/spec/containers/{i}/lifecycle/postStart",
+                        "value": hook["postStart"],
+                    }
+                )
+            else:
+                ops.append(
+                    {
+                        "op": "add",
+                        "path": f"/spec/containers/{i}/lifecycle",
+                        "value": hook,
+                    }
+                )
         prio = limits.get(resources.priority)
         if prio is not None:
             env_entry = {"name": ENV_TASK_PRIORITY, "value": str(_as_int(prio))}

@@ -83,6 +83,11 @@ DEVICE_LIMIT = 100
 # Default split count per chip (ref DeviceSplitCount, chart default 10).
 DEFAULT_SPLIT_COUNT = 10
 
+# In-container partition helper for the second device family, injected as a
+# PostStart hook by the webhook and mounted by the plugin's Allocate
+# (ref webhook.go:73-80 — the /usr/bin/smlu-containerd pattern).
+PRESTART_PROGRAM = "/usr/local/vtpu/vtpu-prestart"
+
 
 # --------------------------------------------------------------------------
 # Resource names — configurable, like the reference's --resource-name family
