@@ -129,6 +129,72 @@ def test_ring_attention_matches_full():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3)
 
 
+def test_hybrid_mesh_axes_and_psum():
+    """dcn-outer hybrid mesh: 2 slices × 4-chip ICI; psum over both tiers
+    sums all shards."""
+    from jax.sharding import PartitionSpec as P
+
+    from vtpu.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh((2, 2), ici_axis_names=("dp", "tp"), num_slices=2)
+    assert dict(mesh.shape) == {"dcn": 2, "dp": 2, "tp": 2}
+    x = jnp.ones((8, 4), jnp.float32)
+    total = jax.shard_map(
+        lambda s: jax.lax.psum(jax.lax.psum(jax.lax.psum(s, "tp"), "dp"), "dcn"),
+        mesh=mesh,
+        in_specs=P(("dcn", "dp", "tp"), None),
+        out_specs=P(None, None),
+    )(x)
+    assert float(total[0, 0]) == 8.0
+    # too few devices → explicit error
+    try:
+        make_hybrid_mesh((8,), num_slices=2)
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
+def test_ulysses_attention_matches_full():
+    """All-to-all SP: seq→head reshard, local full attention, reshard back
+    == unsharded attention (heads=8 divides the 8-device axis)."""
+    from jax.sharding import Mesh
+
+    from vtpu.parallel.ulysses import ulysses_attention
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("sp",))
+    n = len(devs)
+    rng = jax.random.PRNGKey(3)
+    q, k, v = (
+        jax.random.normal(r, (2, n, 8 * n, 32), jnp.float32)
+        for r in jax.random.split(rng, 3)
+    )
+    got = ulysses_attention(q, k, v, mesh, axis="sp")
+    want = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3)
+    # causal variant agrees too
+    got_c = ulysses_attention(q, k, v, mesh, axis="sp", causal=True)
+    want_c = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(got_c), np.asarray(want_c), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_ulysses_rejects_indivisible_heads():
+    from jax.sharding import Mesh
+
+    from vtpu.parallel.ulysses import ulysses_attention
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("sp",))
+    q = jnp.ones((1, 3, 16, 8), jnp.float32)  # 3 heads on 8 devices
+    try:
+        ulysses_attention(q, q, q, mesh, axis="sp")
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass
+
+
 # -- graft entries --------------------------------------------------------
 
 

@@ -1,0 +1,98 @@
+"""Trace-span tests (SURVEY §5: the reference ships no tracing — spans
+around Filter/Bind/Allocate are the rebuild's addition)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.scheduler import Scheduler, SchedulerConfig
+from vtpu.scheduler.routes import serve
+from vtpu.utils import codec, trace
+from vtpu.utils.types import ChipInfo, annotations as A, resources as R
+
+
+@pytest.fixture(autouse=True)
+def _tracing_on():
+    trace.clear()
+    trace.tracing(True)
+    yield
+    trace.tracing(False)
+    trace.clear()
+
+
+def make_sched():
+    client = FakeClient()
+    client.create_node(new_node("n1"))
+    enc = codec.encode_node_devices(
+        [ChipInfo(uuid="c0", count=4, hbm_mb=16384, cores=100,
+                  type="TPU-v5e", health=True)]
+    )
+    client.patch_node_annotations(
+        "n1", {A.NODE_HANDSHAKE: "Reported 2026-07-29T00:00:00Z",
+               A.NODE_REGISTER: enc}
+    )
+    sched = Scheduler(client, SchedulerConfig(http_bind="127.0.0.1:0"))
+    sched.register_from_node_annotations()
+    return client, sched
+
+
+def test_span_records_timing_and_attrs():
+    with trace.span("x", a=1) as sp:
+        sp["b"] = 2
+    (rec,) = trace.recent_spans()
+    assert rec["name"] == "x" and rec["ok"] and rec["a"] == 1 and rec["b"] == 2
+    assert rec["dur_ms"] >= 0
+
+
+def test_span_records_errors_and_reraises():
+    with pytest.raises(ValueError):
+        with trace.span("boom"):
+            raise ValueError("nope")
+    (rec,) = trace.recent_spans()
+    assert rec["ok"] is False and "ValueError" in rec["error"]
+
+
+def test_span_noop_when_disabled():
+    trace.tracing(False)
+    with trace.span("quiet") as sp:
+        assert sp == {}
+    assert trace.recent_spans() == []
+
+
+def test_filter_and_bind_emit_spans():
+    client, sched = make_sched()
+    pod = client.create_pod(
+        new_pod("p", containers=[
+            {"name": "m", "resources": {"limits": {R.chip: 1, R.memory: 512}}}
+        ])
+    )
+    res = sched.filter(pod, ["n1"])
+    assert res.node == "n1"
+    sched.bind("default", "p", "n1")
+    names = [s["name"] for s in trace.recent_spans()]
+    assert "filter" in names and "bind" in names
+    fspan = [s for s in trace.recent_spans() if s["name"] == "filter"][0]
+    assert fspan["node"] == "n1" and fspan["ok"]
+
+
+def test_spans_http_endpoint():
+    client, sched = make_sched()
+    pod = client.create_pod(
+        new_pod("p", containers=[
+            {"name": "m", "resources": {"limits": {R.chip: 1}}}
+        ])
+    )
+    sched.filter(pod, ["n1"])
+    srv, _ = serve(sched)
+    try:
+        port = srv.server_address[1]
+        body = json.loads(
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/spans", timeout=10
+            ).read()
+        )
+        assert any(s["name"] == "filter" for s in body)
+    finally:
+        srv.shutdown()

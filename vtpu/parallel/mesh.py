@@ -35,6 +35,55 @@ def make_mesh(axis_names: Sequence[str] = ("dp", "tp"),
     return Mesh(arr, tuple(axis_names))
 
 
+def make_hybrid_mesh(
+    ici_shape: Tuple[int, ...],
+    ici_axis_names: Sequence[str] = ("dp", "tp"),
+    dcn_axis_name: str = "dcn",
+    num_slices: Optional[int] = None,
+    devices=None,
+) -> Mesh:
+    """Two-tier mesh for multi-slice jobs: ``dcn`` is the outermost axis
+    (slice index — data-center network between slices), the inner axes lie
+    within each slice's ICI torus.  The scaling-book recipe: keep
+    bandwidth-hungry collectives (tp/sp) on inner/ICI axes and put only
+    gradient all-reduce-shaped traffic on the dcn axis.
+
+    Devices are grouped by ``slice_index`` when the runtime exposes it
+    (multi-slice TPU), so every inner-axis neighbor pair shares a slice;
+    virtual CPU meshes and single slices fall back to enumeration order —
+    one code path, testable anywhere.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    devs.sort(
+        key=lambda d: (getattr(d, "slice_index", 0) or 0, d.id)
+    )
+    per_slice = int(np.prod(ici_shape))
+    if num_slices is None:
+        num_slices = len(devs) // per_slice
+    want = per_slice * num_slices
+    if len(devs) < want or want == 0:
+        raise ValueError(
+            f"hybrid mesh {ici_shape}×{num_slices} slices needs {want} "
+            f"devices, have {len(devs)}"
+        )
+    picked = devs[:want]
+    slice_ids = {getattr(d, "slice_index", None) for d in picked}
+    if len(slice_ids - {None}) > 1:
+        # real multi-slice hardware: each inner-axis group must live inside
+        # ONE slice, or tp/sp collectives silently ride DCN — the exact
+        # hazard this helper exists to prevent
+        for s in range(num_slices):
+            group = picked[s * per_slice:(s + 1) * per_slice]
+            ids = {getattr(d, "slice_index", None) for d in group}
+            if len(ids) > 1:
+                raise ValueError(
+                    f"ici group {s} spans slices {sorted(ids)}; "
+                    f"ici_shape {ici_shape} exceeds one slice's chips"
+                )
+    arr = np.array(picked).reshape((num_slices,) + tuple(ici_shape))
+    return Mesh(arr, (dcn_axis_name,) + tuple(ici_axis_names))
+
+
 def mesh_from_rectangle(shape: Tuple[int, ...],
                         axis_names: Optional[Sequence[str]] = None,
                         devices=None) -> Mesh:

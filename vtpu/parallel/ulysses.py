@@ -1,0 +1,65 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second canonical long-context scheme next to ring attention
+(vtpu.parallel.ring): instead of rotating KV shards around the ICI ring,
+each chip swaps its *sequence* sharding for a *head* sharding with one
+all-to-all, computes full-sequence attention for its subset of heads
+(Pallas flash kernel locally), then swaps back.  Two all-to-alls total
+per attention — cheaper than N-1 ring hops when heads ≥ chips and the
+all-to-all rides a well-connected ICI rectangle.
+
+Layout contract: inputs [batch, heads, seq, d] with seq sharded on mesh
+axis ``axis``; heads must divide by the axis size.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from vtpu.ops.attention import reference_attention
+
+
+def _local_attention(q, k, v, causal: bool):
+    # full-sequence attention over this chip's head subset; flash kernel
+    # on TPU, XLA reference elsewhere (same dispatch as ring's inner op).
+    # Kernel failures must surface — a silent fallback would materialize
+    # the [seq, seq] score matrix on exactly the workloads Ulysses targets.
+    from vtpu.ops.attention import _on_tpu, flash_attention
+
+    if _on_tpu():
+        return flash_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, causal=causal)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                      causal: bool = False):
+    """q,k,v: [batch, heads, seq, d], seq sharded over ``axis``; returns
+    output with identical sharding."""
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(
+            f"heads ({q.shape[1]}) must divide by mesh axis {axis!r} ({n})"
+        )
+
+    def shard_fn(q_s, k_s, v_s):
+        # [b, H, s/n, d] per chip → all-to-all → [b, H/n, s, d]
+        def seq_to_heads(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=1, concat_axis=2, tiled=True
+            )
+
+        def heads_to_seq(x):
+            return jax.lax.all_to_all(
+                x, axis, split_axis=2, concat_axis=1, tiled=True
+            )
+
+        qh, kh, vh = seq_to_heads(q_s), seq_to_heads(k_s), seq_to_heads(v_s)
+        oh = _local_attention(qh, kh, vh, causal)
+        return heads_to_seq(oh)
+
+    spec = P(None, None, axis, None)
+    return jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

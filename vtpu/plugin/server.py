@@ -39,7 +39,7 @@ from vtpu.plugin import v1beta1_pb2 as pb
 from vtpu.plugin.cache import DeviceCache
 from vtpu.plugin.config import PluginConfig
 from vtpu.utils import allocate as alloc_util
-from vtpu.utils import types
+from vtpu.utils import trace, types
 
 log = logging.getLogger(__name__)
 
@@ -267,6 +267,14 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
 
     def Allocate(self, request, context):  # noqa: N802
         """ref plugin.go:318-392 + §3.3 call stack."""
+        with trace.span(
+            "allocate",
+            family=self.cfg.device_family,
+            devices=sum(len(c.devicesIDs) for c in request.container_requests),
+        ):
+            return self._allocate_inner(request, context)
+
+    def _allocate_inner(self, request, context):
         if len(request.container_requests) != 1:
             # exactly one container per Allocate (ref :320-322)
             context.abort(

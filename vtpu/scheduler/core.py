@@ -20,7 +20,7 @@ from vtpu.scheduler import score as score_mod
 from vtpu.scheduler.config import SchedulerConfig
 from vtpu.scheduler.score import DeviceUsage, NodeUsage
 from vtpu.scheduler.state import NodeManager, PodManager
-from vtpu.utils import codec
+from vtpu.utils import codec, trace
 from vtpu.utils.nodelock import lock_node, release_node_lock
 from vtpu.utils.resources import resource_reqs
 from vtpu.utils.types import (
@@ -221,8 +221,16 @@ class Scheduler:
             # not a vtpu pod — pass through unfiltered (ref :453-460)
             return FilterResult(node=None, failed={}, error="")
         pod_annos = get_annotations(pod)
-        with self._filter_lock:
-            return self._filter_locked(pod, node_names, reqs, pod_annos, node_objs)
+        with trace.span(
+            "filter",
+            pod=pod.get("metadata", {}).get("name", ""),
+            nodes=len(node_names),
+        ) as sp:
+            with self._filter_lock:
+                res = self._filter_locked(pod, node_names, reqs, pod_annos, node_objs)
+            sp["node"] = res.node
+            sp["failed"] = len(res.failed)
+            return res
 
     def _filter_locked(
         self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs=None
@@ -288,6 +296,14 @@ class Scheduler:
         """Returns error string or None on success.  ``pod_uid`` (from
         ExtenderBindingArgs) lets the failure path unbook a pod that has
         already vanished from the API."""
+        with trace.span("bind", pod=name, node=node) as sp:
+            err = self._bind_inner(namespace, name, node, pod_uid)
+            sp["error"] = err or ""
+            return err
+
+    def _bind_inner(
+        self, namespace: str, name: str, node: str, pod_uid: str = ""
+    ) -> Optional[str]:
         try:
             lock_node(self.client, node)
         except Exception as e:  # noqa: BLE001

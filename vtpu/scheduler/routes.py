@@ -93,6 +93,10 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 — http.server API
         if self.path == "/healthz":
             self._send(200, b"ok", "text/plain")
+        elif self.path == "/spans":
+            from vtpu.utils import trace
+
+            self._send(200, json.dumps(trace.recent_spans()).encode())
         elif self.path == "/metrics":
             try:
                 body = render_metrics(self.scheduler).encode()

@@ -1,0 +1,78 @@
+"""Lightweight trace spans for the control-plane hot paths.
+
+The reference has no tracing at all — log lines only (SURVEY.md §5
+"Tracing / profiling: none ... Rebuild: add optional trace spans around
+Filter/Bind/Allocate").  This is that rebuild: zero-dependency spans with
+a ring buffer for inspection (the /spans debug endpoint) and structured
+log emission.  Disabled by default; enable with VTPU_TRACE=1 or
+``tracing(True)``.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import os
+import threading
+import time
+from typing import Deque, Dict, Iterator, Optional
+
+log = logging.getLogger("vtpu.trace")
+
+_RING_SIZE = 512
+_lock = threading.Lock()
+_spans: Deque[dict] = collections.deque(maxlen=_RING_SIZE)
+_enabled: Optional[bool] = None  # None ⇒ read env lazily
+
+
+def tracing(on: Optional[bool] = None) -> bool:
+    """Get (no arg) or set the global trace switch."""
+    global _enabled
+    if on is not None:
+        _enabled = bool(on)
+    if _enabled is None:
+        _enabled = os.environ.get("VTPU_TRACE", "") not in ("", "0", "false")
+    return _enabled
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: object) -> Iterator[Dict[str, object]]:
+    """Context manager: times the block, records outcome + attributes.
+
+    The yielded dict is live — handlers may add attributes mid-span
+    (e.g. ``sp["node"] = picked``).  Exceptions are recorded and
+    re-raised; recording failures never break the traced path.
+    """
+    if not tracing():
+        yield {}
+        return
+    sp: Dict[str, object] = {"name": name, "start": time.time(), **attrs}
+    t0 = time.monotonic()
+    try:
+        yield sp
+        sp["ok"] = True
+    except BaseException as e:
+        sp["ok"] = False
+        sp["error"] = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        sp["dur_ms"] = round((time.monotonic() - t0) * 1e3, 3)
+        try:
+            with _lock:
+                _spans.append(sp)
+            log.info("span %s dur=%.2fms ok=%s %s", name, sp["dur_ms"],
+                     sp.get("ok"), {k: v for k, v in sp.items()
+                                    if k not in ("name", "start", "dur_ms", "ok")})
+        except Exception:  # noqa: BLE001 — tracing must never break the path
+            pass
+
+
+def recent_spans(n: int = 100) -> list:
+    with _lock:
+        return list(_spans)[-n:]
+
+
+def clear() -> None:
+    with _lock:
+        _spans.clear()
