@@ -163,6 +163,54 @@ def test_shim_runtime_oversubscribe(tmp_path):
     rt.close()
 
 
+def test_shim_runtime_host_swap_tier(tmp_path):
+    """Over-quota device_put with oversubscribe lands in HOST memory (the
+    virtual-device-memory analog) and is tracked separately."""
+    import jax
+    import numpy as np
+
+    rt = ShimRuntime(
+        limits_bytes=[1 << 20],
+        region_path=str(tmp_path / "sw.cache"),
+        uuids=["tpu-0"],
+        oversubscribe=True,
+    )
+    small = rt.device_put(np.ones((64,), np.float32))  # fits → device tier
+    big = rt.device_put(np.ones((1 << 19,), np.float32))  # 2 MiB > 1 MiB quota
+    assert small is not None and big is not None
+    cpu = jax.devices("cpu")[0]
+    assert list(big.devices()) == [cpu]
+    stats = rt.memory_stats()
+    assert stats["bytes_host_swapped"] == (1 << 19) * 4
+    assert stats["bytes_in_use"] <= 1 << 20  # device tier stayed under quota
+    # computation consuming the host-tier array still works
+    assert float(jnp := (big + 1).sum()) == (1 << 19) * 2  # noqa: F841
+    # release() undoes whichever tier each put landed in
+    rt.release(big)
+    assert rt.memory_stats()["bytes_host_swapped"] == 0
+    used_before = rt.device_usage(0)
+    rt.release(small)
+    assert rt.device_usage(0) == used_before - 64 * 4
+    rt.release(small)  # double release is a no-op
+    rt.close()
+
+
+def test_shim_runtime_device_put_strict_without_oversubscribe(tmp_path):
+    """Without oversubscribe, an over-quota device_put rejects (no silent
+    host tier), and the tier check-and-add is the atomic region path."""
+    import numpy as np
+
+    rt = ShimRuntime(
+        limits_bytes=[1 << 10],
+        region_path=str(tmp_path / "st.cache"),
+        uuids=["tpu-0"],
+        oversubscribe=False,
+    )
+    with pytest.raises(QuotaExceeded):
+        rt.device_put(np.ones((1 << 10,), np.float32))
+    rt.close()
+
+
 def test_shim_runtime_throttle_paces(tmp_path):
     rt = ShimRuntime(
         limits_bytes=[], core_limit=25, region_path=str(tmp_path / "t.cache")

@@ -271,8 +271,19 @@ def test_http_metrics_and_health(http_sched):
     _post(base + "/filter", {"pod": pod, "nodenames": ["n1"]})
     with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
         text = r.read().decode()
-    assert "vtpu_device_memory_limit_bytes" in text
-    assert "vtpu_pod_memory_allocated_bytes" in text
+    # all eight reference gauge families (cmd/scheduler/metrics.go:73-204)
+    for family in (
+        "vtpu_device_memory_limit_bytes",    # GPUDeviceMemoryLimit
+        "vtpu_device_memory_allocated_bytes",  # GPUDeviceMemoryAllocated
+        "vtpu_device_shared_num",            # GPUDeviceSharedNum
+        "vtpu_device_core_allocated",        # GPUDeviceCoreAllocated
+        "vtpu_node_overview",                # nodeGPUOverview
+        "vtpu_node_memory_percentage",       # nodeGPUMemoryPercentage
+        "vtpu_pod_memory_allocated_bytes",   # vGPUPodsDeviceAllocated
+        "vtpu_pod_memory_percentage",        # vGPUMemoryPercentage
+        "vtpu_pod_core_percentage",          # vGPUCorePercentage
+    ):
+        assert family in text, family
     with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
         assert r.read() == b"ok"
 

@@ -31,7 +31,8 @@ def render_metrics(sched: Scheduler) -> str:
 
     usage = sched.inspect_usage()
 
-    dev_limit, dev_alloc, dev_shared, dev_cores, node_mem_pct = [], [], [], [], []
+    dev_limit, dev_alloc, dev_shared, dev_cores = [], [], [], []
+    node_mem_pct, node_overview = [], []
     for name, nu in sorted(usage.items()):
         total, used = 0, 0
         for d in nu.devices:
@@ -43,6 +44,16 @@ def render_metrics(sched: Scheduler) -> str:
             total += d.totalmem
             used += d.usedmem
         node_mem_pct.append(({"node": name}, (used / total) if total else 0.0))
+        node_overview.append(
+            (
+                {
+                    "node": name,
+                    "devicecount": len(nu.devices),
+                    "totalmem_bytes": total * _MB,
+                },
+                used * _MB,
+            )
+        )
 
     gauge(
         "vtpu_device_memory_limit_bytes",
@@ -69,8 +80,21 @@ def render_metrics(sched: Scheduler) -> str:
         "Allocated fraction of node HBM (ref nodeGPUMemoryPercentage)",
         node_mem_pct,
     )
+    gauge(
+        "vtpu_node_overview",
+        "Allocated HBM with chip count + capacity labels per node "
+        "(ref nodeGPUOverview)",
+        node_overview,
+    )
 
-    pod_mem, pod_cores = [], []
+    # keyed by (node, uuid): uuids are per-node enumerations, so the same
+    # uuid on two nodes must not share a capacity denominator
+    chip_mem = {
+        (node, d.uuid): d.totalmem
+        for node, nu in usage.items()
+        for d in nu.devices
+    }
+    pod_mem, pod_mem_pct, pod_cores = [], [], []
     for pi in sched.pods.all_pods().values():
         for ci, ctr in enumerate(pi.devices):
             for cd in ctr:
@@ -82,11 +106,21 @@ def render_metrics(sched: Scheduler) -> str:
                     "deviceuuid": cd.uuid,
                 }
                 pod_mem.append((labels, cd.usedmem * _MB))
+                total = chip_mem.get((pi.node, cd.uuid), 0)
+                pod_mem_pct.append(
+                    (labels, (cd.usedmem / total) if total else 0.0)
+                )
                 pod_cores.append((labels, cd.usedcores))
     gauge(
         "vtpu_pod_memory_allocated_bytes",
         "Per-pod per-device scheduled HBM (ref vGPUPodsDeviceAllocated)",
         pod_mem,
+    )
+    gauge(
+        "vtpu_pod_memory_percentage",
+        "Per-pod per-device scheduled HBM as a fraction of the chip "
+        "(ref vGPUMemoryPercentage)",
+        pod_mem_pct,
     )
     gauge(
         "vtpu_pod_core_percentage",

@@ -96,7 +96,13 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/spans":
             from vtpu.utils import trace
 
-            self._send(200, json.dumps(trace.recent_spans()).encode())
+            try:
+                # default=str: span attrs are arbitrary objects by contract
+                body = json.dumps(trace.recent_spans(), default=str).encode()
+                self._send(200, body)
+            except Exception as e:  # noqa: BLE001
+                log.exception("spans render failed")
+                self._send(500, str(e).encode(), "text/plain")
         elif self.path == "/metrics":
             try:
                 body = render_metrics(self.scheduler).encode()

@@ -90,6 +90,10 @@ class ShimRuntime:
             self.region.register_proc(self.pid, self.priority)
         # local (per-tenant) accounting mirrors the region
         self._local: Dict[int, int] = {}
+        # bytes placed in the host tier past quota (oversubscribe)
+        self._swapped: Dict[int, int] = {}
+        # id(arr) → (dev, nbytes, tier) for release() (device_put pairing)
+        self._placements: Dict[int, tuple] = {}
 
     # ------------------------------------------------------------------
     def limit_for(self, dev: int) -> int:
@@ -131,14 +135,62 @@ class ShimRuntime:
         self._local[dev] = max(0, self._local.get(dev, 0) - nbytes)
 
     # ------------------------------------------------------------------
+    def _try_alloc_device_tier(self, nbytes: int, dev: int) -> bool:
+        """Strict check-and-add into the device tier (no oversubscribe
+        bypass) — atomic under the region flock, so two tenants racing the
+        last bytes cannot both be admitted."""
+        limit = self.limit_for(dev)
+        if self.region is not None:
+            ok = self.region.try_add(
+                self.pid, dev, nbytes, "buffer", limit=limit, oversubscribe=False
+            )
+            if ok:
+                self._local[dev] = self._local.get(dev, 0) + nbytes
+            return ok
+        if limit and self._local.get(dev, 0) + nbytes > limit:
+            return False
+        self._local[dev] = self._local.get(dev, 0) + nbytes
+        return True
+
     def device_put(self, x, dev: int = 0):
-        """jax.device_put through the quota (accounts the array bytes)."""
+        """jax.device_put through the quota (accounts the array bytes).
+
+        Over-quota with oversubscribe on: the array lands in HOST memory
+        instead (the virtual-device-memory tier — ref CUDA_OVERSUBSCRIBE's
+        host-RAM swap, README.md:236-240); XLA streams it back over PCIe
+        when a computation consumes it.  The tier decision is atomic
+        against other tenants, recorded per array, and undone by
+        ``release(arr)`` — callers must pair device_put with release, not
+        raw ``free``, or the tiers' accounting would drift."""
         import jax
         import numpy as np
 
         nbytes = int(np.asarray(x).nbytes) if not hasattr(x, "nbytes") else int(x.nbytes)
-        self.try_alloc(nbytes, dev)
-        return jax.device_put(x)
+        if self._try_alloc_device_tier(nbytes, dev):
+            out = jax.device_put(x)
+            self._placements[id(out)] = (dev, nbytes, "device")
+            return out
+        if not self.oversubscribe:
+            raise QuotaExceeded(
+                f"vtpu: device {dev} quota {self.limit_for(dev)} B exceeded "
+                f"(in use {self.device_usage(dev)}, want {nbytes})"
+            )
+        out = jax.device_put(x, jax.devices("cpu")[0])
+        self._swapped[dev] = self._swapped.get(dev, 0) + nbytes
+        self._placements[id(out)] = (dev, nbytes, "host")
+        return out
+
+    def release(self, arr) -> None:
+        """Undo a device_put: frees the device tier or shrinks the swap
+        counter, whichever tier the array landed in."""
+        rec = self._placements.pop(id(arr), None)
+        if rec is None:
+            return
+        dev, nbytes, tier = rec
+        if tier == "device":
+            self.free(nbytes, dev)
+        else:
+            self._swapped[dev] = max(0, self._swapped.get(dev, 0) - nbytes)
 
     def throttled(self, fn: Callable) -> Callable:
         """Wrap a (jitted) callable with core-percentage pacing — the
@@ -175,6 +227,7 @@ class ShimRuntime:
         return {
             "bytes_limit": self.limit_for(dev),
             "bytes_in_use": self.device_usage(dev),
+            "bytes_host_swapped": self._swapped.get(dev, 0),
         }
 
     def close(self) -> None:
