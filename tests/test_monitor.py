@@ -195,6 +195,57 @@ def test_shim_runtime_host_swap_tier(tmp_path):
     rt.close()
 
 
+def test_shim_runtime_re_put_and_gc_release(tmp_path):
+    """A re-put of an already-committed array returns the same object —
+    both charges must be tracked and released; dropping an array without
+    release() auto-releases via the GC finalizer."""
+    import gc
+
+    import jax
+    import numpy as np
+
+    rt = ShimRuntime(
+        limits_bytes=[1 << 20],
+        region_path=str(tmp_path / "rp.cache"),
+        uuids=["tpu-0"],
+    )
+    a = rt.device_put(np.ones((64,), np.float32))
+    b = rt.device_put(a)  # re-put of a committed array (may alias a)
+    assert rt.device_usage(0) == 2 * 64 * 4  # both puts charged
+    # release works whether or not device_put aliased: LIFO per object id
+    rt.release(b)
+    rt.release(a)
+    assert rt.device_usage(0) == 0
+    # GC path: put and drop without release
+    c = rt.device_put(np.ones((32,), np.float32))
+    assert rt.device_usage(0) == 32 * 4
+    del c
+    gc.collect()
+    jax.clear_caches() if False else None
+    assert rt.device_usage(0) == 0, "finalizer did not release"
+    rt.close()
+
+
+def test_shim_runtime_dispatch_counts_and_paces(tmp_path):
+    """dispatch() records kernel launches in the region and rate-limits
+    dispatch to the core percentage without blocking on results."""
+    rt = ShimRuntime(
+        limits_bytes=[],
+        core_limit=25,
+        region_path=str(tmp_path / "dp.cache"),
+        uuids=["tpu-0"],
+    )
+    rt.observe_step(0.01)
+    t0 = time.monotonic()
+    for _ in range(4):
+        rt.dispatch(lambda: None)
+    dt = time.monotonic() - t0
+    assert rt.region.region.recent_kernel == 4
+    # 10ms step at 25% → ≥30ms sleep per dispatch → ≥120ms total
+    assert dt >= 0.1, dt
+    rt.close()
+
+
 def test_shim_runtime_device_put_strict_without_oversubscribe(tmp_path):
     """Without oversubscribe, an over-quota device_put rejects (no silent
     host tier), and the tier check-and-add is the atomic region path."""

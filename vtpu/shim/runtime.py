@@ -92,8 +92,10 @@ class ShimRuntime:
         self._local: Dict[int, int] = {}
         # bytes placed in the host tier past quota (oversubscribe)
         self._swapped: Dict[int, int] = {}
-        # id(arr) → (dev, nbytes, tier) for release() (device_put pairing)
-        self._placements: Dict[int, tuple] = {}
+        # id(arr) → stack of (dev, nbytes, tier) for release()
+        self._placements: Dict[int, list] = {}
+        # pacing estimate for dispatch() (seconds per step)
+        self._last_step_s = 0.0
 
     # ------------------------------------------------------------------
     def limit_for(self, dev: int) -> int:
@@ -167,8 +169,12 @@ class ShimRuntime:
 
         nbytes = int(np.asarray(x).nbytes) if not hasattr(x, "nbytes") else int(x.nbytes)
         if self._try_alloc_device_tier(nbytes, dev):
-            out = jax.device_put(x)
-            self._placements[id(out)] = (dev, nbytes, "device")
+            try:
+                target = jax.local_devices()[dev]
+            except (IndexError, RuntimeError):
+                target = None  # single-device / no accelerator: default place
+            out = jax.device_put(x, target) if target is not None else jax.device_put(x)
+            self._record_placement(out, dev, nbytes, "device")
             return out
         if not self.oversubscribe:
             raise QuotaExceeded(
@@ -177,20 +183,77 @@ class ShimRuntime:
             )
         out = jax.device_put(x, jax.devices("cpu")[0])
         self._swapped[dev] = self._swapped.get(dev, 0) + nbytes
-        self._placements[id(out)] = (dev, nbytes, "host")
+        self._record_placement(out, dev, nbytes, "host")
         return out
 
-    def release(self, arr) -> None:
-        """Undo a device_put: frees the device tier or shrinks the swap
-        counter, whichever tier the array landed in."""
-        rec = self._placements.pop(id(arr), None)
-        if rec is None:
-            return
-        dev, nbytes, tier = rec
+    def _record_placement(self, out, dev: int, nbytes: int, tier: str) -> None:
+        """Track a put for release().  Records stack per object id (a
+        re-put of an already-committed array returns the SAME object, so
+        one id can owe several charges), and a weakref finalizer
+        auto-releases whatever is still owed when the array is collected
+        — dropped arrays cannot leak region accounting or dict entries."""
+        import weakref
+
+        key = id(out)
+        stack = self._placements.setdefault(key, [])
+        stack.append((dev, nbytes, tier))
+        if len(stack) == 1:
+            try:
+                weakref.finalize(out, self._release_all_for, key)
+            except TypeError:
+                pass  # non-weakref-able object: explicit release only
+
+    def _release_one(self, key: int) -> bool:
+        stack = self._placements.get(key)
+        if not stack:
+            return False
+        dev, nbytes, tier = stack.pop()
+        if not stack:
+            self._placements.pop(key, None)
         if tier == "device":
             self.free(nbytes, dev)
         else:
             self._swapped[dev] = max(0, self._swapped.get(dev, 0) - nbytes)
+        return True
+
+    def _release_all_for(self, key: int) -> None:
+        while self._release_one(key):
+            pass
+
+    def release(self, arr) -> None:
+        """Undo a device_put: frees the device tier or shrinks the swap
+        counter, whichever tier the array landed in (LIFO when the same
+        object was put more than once)."""
+        self._release_one(id(arr))
+
+    def dispatch(self, fn: Callable, *args, **kwargs):
+        """Execute through the shim WITHOUT blocking on the result — the
+        pipelined serving-loop variant of :meth:`throttled`.  Records the
+        kernel launch in the shared region (the utilization-watcher
+        counter the monitor's feedback arbiter decays) and applies
+        core-percentage pacing as a dispatch-rate limit using the
+        observed steady-state step time; callers retire results
+        themselves (jax.block_until_ready)."""
+        if self.region is not None:
+            self.region.region.recent_kernel += 1
+            suspended = self.region.region.utilization_switch == 1
+        else:
+            suspended = False
+        q = self.core_limit
+        if 0 < q < 100 and not suspended and self._last_step_s > 0:
+            time.sleep(self._last_step_s * (100 - q) / q)
+        t0 = time.monotonic()
+        out = fn(*args, **kwargs)
+        # dispatch time is a lower bound on step time; observe_step()
+        # refines it with retirement timing when the caller provides it
+        self._last_step_s = max(self._last_step_s, time.monotonic() - t0)
+        return out
+
+    def observe_step(self, seconds: float) -> None:
+        """Feed the measured per-step device time back into dispatch()'s
+        pacing estimate."""
+        if seconds > 0:
+            self._last_step_s = seconds
 
     def throttled(self, fn: Callable) -> Callable:
         """Wrap a (jitted) callable with core-percentage pacing — the
