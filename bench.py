@@ -49,6 +49,17 @@ def build_forward(platform: str):
     rng = jax.random.PRNGKey(0)
     x = jnp.ones((batch, size, size, 3), jnp.float32)
     variables = model.init(rng, x)
+    if platform != "cpu":
+        # bf16 weights/activations: the MXU's native format — the compute
+        # path any production TPU serving stack runs (logits stay f32 via
+        # the model's final-layer upcast)
+        variables = jax.tree.map(
+            lambda v: v.astype(jnp.bfloat16)
+            if v.dtype == jnp.float32
+            else v,
+            variables,
+        )
+        x = x.astype(jnp.bfloat16)
 
     @jax.jit
     def forward(images):
@@ -83,6 +94,7 @@ def run_streams(forward, x, batch, seconds: float, n_streams: int = 4,
 
     counts = [0] * n_streams
     violations = [0] * n_streams
+    errors = []
     stop_at = time.monotonic() + seconds
     t0 = time.monotonic()
 
@@ -101,11 +113,13 @@ def run_streams(forward, x, batch, seconds: float, n_streams: int = 4,
                     before_step(i)
                 except MemoryError:
                     # quota full: retire the in-flight step (freeing its
-                    # bytes) rather than busy-spinning on the flock
+                    # bytes); with nothing in flight, back off instead of
+                    # hammering the cross-process flock
                     if pending:
                         retire()
                     else:
                         violations[i] += 1
+                        time.sleep(0.001)
                     continue
             out = (
                 dispatch(i, forward, x) if dispatch is not None else forward(x)
@@ -116,11 +130,20 @@ def run_streams(forward, x, batch, seconds: float, n_streams: int = 4,
         while pending:
             retire()
 
-    threads = [threading.Thread(target=stream, args=(i,)) for i in range(n_streams)]
+    def guarded(i):
+        try:
+            stream(i)
+        except BaseException as e:  # noqa: BLE001 — surfaced after join
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=guarded, args=(i,)) for i in range(n_streams)]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
+    if errors:
+        # a dead stream means partial counts — the ratio would be garbage
+        raise RuntimeError(f"stream(s) failed: {errors}") from errors[0][1]
     elapsed = time.monotonic() - t0
     return [c / elapsed for c in counts], sum(violations)
 

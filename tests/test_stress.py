@@ -153,6 +153,23 @@ def test_health_flap_propagates_to_cache():
         cache.stop()
 
 
+def test_health_checks_disable_env(monkeypatch):
+    """VTPU_DISABLE_HEALTHCHECKS set ⇒ the poll loop never starts
+    (ref DP_DISABLE_HEALTHCHECKS, nvidia.go:173-244)."""
+    monkeypatch.setenv("VTPU_DISABLE_HEALTHCHECKS", "all")
+    provider = FakeProvider({"model": "TPU-v5e", "topology": "2x1x1"})
+    cache = DeviceCache(provider, poll_interval_s=0.01)
+    cache.start()
+    try:
+        assert cache._thread is None
+        provider.set_health("fake-tpu-0", False)
+        time.sleep(0.1)
+        # startup snapshot unchanged: no poll ran
+        assert all(c.healthy for c in cache.chips())
+    finally:
+        cache.stop()
+
+
 def test_handshake_expiry_expels_devices():
     """Plugin death fault: a node that stops re-reporting is expelled after
     the 60 s handshake timeout (simulated via a stale Requesting ts;

@@ -168,6 +168,13 @@ class RegionFile:
             if r.procs[p].status == 1 and r.procs[p].pid == pid:
                 r.procs[p].hostpid = hostpid
 
+    def incr_recent_kernel(self, n: int = 1) -> None:
+        """Locked kernel-launch count (shim dispatch path): the counter is
+        contended by every tenant's dispatch AND the monitor's decay, so a
+        bare += would lose increments."""
+        with self._locked():
+            self.region.recent_kernel += n
+
     def decay_recent_kernel(self) -> int:
         """ref Observe (feedback.go): halve the activity counter, return the
         pre-decay value."""

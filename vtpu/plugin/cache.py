@@ -11,12 +11,18 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 from typing import Callable, Dict, List
 
 from vtpu.device.chip import Chip
 
 log = logging.getLogger(__name__)
+
+# ref DP_DISABLE_HEALTHCHECKS (nvidia.go:173-244: "xids" skips the XID
+# watcher; "all" disables health monitoring entirely).  Any value here
+# disables the poll loop — chips stay at their startup health.
+ENV_DISABLE_HEALTHCHECKS = "VTPU_DISABLE_HEALTHCHECKS"
 
 
 def _snap(chips: List[Chip]) -> List[Chip]:
@@ -70,6 +76,12 @@ class DeviceCache:
                     log.exception("health subscriber failed")
 
     def start(self) -> None:
+        if os.environ.get(ENV_DISABLE_HEALTHCHECKS, "") not in ("", "0"):
+            log.warning(
+                "health checks disabled (%s set)", ENV_DISABLE_HEALTHCHECKS
+            )
+            return
+
         def loop() -> None:
             while not self._stop.wait(self.poll_interval_s):
                 try:

@@ -235,7 +235,7 @@ class ShimRuntime:
         observed steady-state step time; callers retire results
         themselves (jax.block_until_ready)."""
         if self.region is not None:
-            self.region.region.recent_kernel += 1
+            self.region.incr_recent_kernel()
             suspended = self.region.region.utilization_switch == 1
         else:
             suspended = False
@@ -244,9 +244,13 @@ class ShimRuntime:
             time.sleep(self._last_step_s * (100 - q) / q)
         t0 = time.monotonic()
         out = fn(*args, **kwargs)
-        # dispatch time is a lower bound on step time; observe_step()
-        # refines it with retirement timing when the caller provides it
-        self._last_step_s = max(self._last_step_s, time.monotonic() - t0)
+        # EMA of dispatch time as the step-time estimate: converges down
+        # after a one-off spike (first-call compile) instead of ratcheting;
+        # observe_step() refines it with real retirement timing
+        obs = time.monotonic() - t0
+        self._last_step_s = (
+            obs if self._last_step_s == 0 else 0.8 * self._last_step_s + 0.2 * obs
+        )
         return out
 
     def observe_step(self, seconds: float) -> None:
@@ -274,7 +278,7 @@ class ShimRuntime:
                 pass
             dt = time.monotonic() - t0
             if self.region is not None:
-                self.region.region.recent_kernel += 1
+                self.region.incr_recent_kernel()
                 suspended = self.region.region.utilization_switch == 1
             else:
                 suspended = False
