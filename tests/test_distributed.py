@@ -1,0 +1,33 @@
+"""Multi-host bootstrap helper tests (single-host behavior; the
+multi-process path is exercised on real gangs where the chart sets the
+VTPU_COORDINATOR env contract)."""
+
+from vtpu.parallel import distributed
+
+
+def test_single_host_noop(monkeypatch):
+    monkeypatch.delenv("VTPU_COORDINATOR", raising=False)
+    monkeypatch.delenv("VTPU_NUM_PROCESSES", raising=False)
+    assert distributed.ensure_initialized() is False
+
+
+def test_num_processes_one_is_noop(monkeypatch):
+    monkeypatch.setenv("VTPU_COORDINATOR", "host:1234")
+    monkeypatch.setenv("VTPU_NUM_PROCESSES", "1")
+    assert distributed.ensure_initialized() is False
+
+
+def test_missing_process_id_fails_fast(monkeypatch):
+    import pytest
+
+    monkeypatch.setenv("VTPU_COORDINATOR", "host:1234")
+    monkeypatch.setenv("VTPU_NUM_PROCESSES", "4")
+    monkeypatch.delenv("VTPU_PROCESS_ID", raising=False)
+    with pytest.raises(RuntimeError, match="VTPU_PROCESS_ID"):
+        distributed.ensure_initialized()
+
+
+def test_device_counts():
+    # conftest forces the 8-device virtual CPU platform
+    assert distributed.global_device_count() >= 1
+    assert distributed.local_device_count() >= 1

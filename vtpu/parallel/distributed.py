@@ -1,0 +1,84 @@
+"""Multi-host runtime initialization.
+
+The reference's multi-node story is NCCL/MPI wiring done by the user's
+framework; on TPU the equivalent is ``jax.distributed.initialize`` +
+XLA collectives over ICI within a slice and DCN between hosts/slices
+(SURVEY.md §5 "Distributed communication backend").  This module is the
+vtpu-native bootstrap: it derives the coordinator/process layout from the
+environment the device plugin and chart set up, so a multi-host JAX job
+in a vtpu gang needs exactly one call::
+
+    from vtpu.parallel import distributed
+    distributed.ensure_initialized()   # no-op on single host
+    mesh = make_hybrid_mesh(...)       # then shard as usual
+
+Env contract (all optional — absent means single-host):
+  VTPU_COORDINATOR        host:port of process 0 (the gang leader)
+  VTPU_NUM_PROCESSES      total number of host processes in the gang
+  VTPU_PROCESS_ID         this host's rank
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def ensure_initialized(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize jax.distributed from args or the VTPU_* env contract.
+
+    Returns True when a multi-host runtime was initialized, False for the
+    single-host no-op.  Safe to call more than once."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = coordinator or os.environ.get("VTPU_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("VTPU_NUM_PROCESSES", "0") or 0)
+    if not coordinator or num_processes <= 1:
+        log.debug("single-host run; jax.distributed not initialized")
+        return False
+    if process_id is None:
+        raw = os.environ.get("VTPU_PROCESS_ID")
+        if raw is None:
+            # defaulting to 0 would make every worker claim rank 0 and
+            # deadlock the gang with an opaque barrier timeout
+            raise RuntimeError(
+                "VTPU_PROCESS_ID is required when VTPU_COORDINATOR is set "
+                f"with VTPU_NUM_PROCESSES={num_processes}"
+            )
+        process_id = int(raw)
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    log.info(
+        "jax.distributed up: rank %d/%d via %s",
+        process_id, num_processes, coordinator,
+    )
+    return True
+
+
+def global_device_count() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+def local_device_count() -> int:
+    import jax
+
+    return len(jax.local_devices())
