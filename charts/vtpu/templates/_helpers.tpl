@@ -1,0 +1,30 @@
+{{/*
+Shared fragments for the per-family device-plugin daemonsets
+(ref charts/vgpu: one daemonset per vendor, same image/sidecar shape).
+Keeping the postStart shim copy and the monitor sidecar in one place
+stops the two families' daemonsets drifting apart.
+*/}}
+
+{{- define "vtpu.shimCopyCommand" -}}
+["/bin/sh", "-c", "mkdir -p {{ .Values.devicePlugin.shimHostDir }} && cp -f /app/cpp/build/libvtpu_shim.so /app/shim/ld.so.preload /app/cpp/build/vtpu-prestart {{ .Values.devicePlugin.shimHostDir }}/ 2>/dev/null || true"]
+{{- end }}
+
+{{- define "vtpu.monitorContainer" -}}
+- name: monitor
+  image: "{{ .Values.image.repository }}:{{ .Values.image.tag }}"
+  imagePullPolicy: {{ .Values.image.pullPolicy }}
+  command:
+    - python3
+    - /app/cmd/vtpu_monitor.py
+    - --containers-root={{ .Values.devicePlugin.cacheHostRoot }}
+    - --metrics-bind=0.0.0.0:{{ .Values.monitor.metricsPort }}
+    - --noderpc-bind=0.0.0.0:{{ .Values.monitor.noderpcPort }}
+    - --feedback-interval={{ .Values.monitor.feedbackInterval }}
+  env:
+    - name: NODE_NAME
+      valueFrom: {fieldRef: {fieldPath: spec.nodeName}}
+  ports:
+    - {containerPort: {{ .Values.monitor.metricsPort }}, name: metrics}
+  volumeMounts:
+    - {name: vtpu-host, mountPath: /usr/local/vtpu}
+{{- end }}

@@ -40,6 +40,8 @@ struct MockBuffer {
 
 struct MockExecutable {
   int64_t code_size;
+  int num_outputs;
+  uint64_t out_bytes; /* per output buffer, 0 = produce no outputs */
 };
 
 int env_int(const char* k, int def) {
@@ -129,8 +131,17 @@ PJRT_Error* buffer_destroy(PJRT_Buffer_Destroy_Args* a) {
 }
 
 PJRT_Error* client_compile(PJRT_Client_Compile_Args* a) {
-  auto* e = new MockExecutable{env_int("MOCK_PJRT_CODE_BYTES", 1 << 20)};
+  auto* e = new MockExecutable{
+      env_int("MOCK_PJRT_CODE_BYTES", 1 << 20),
+      env_int("MOCK_PJRT_NUM_OUTPUTS", 1),
+      (uint64_t)env_int("MOCK_PJRT_OUT_BYTES", 0)};
   a->executable = reinterpret_cast<PJRT_LoadedExecutable*>(e);
+  return nullptr;
+}
+
+PJRT_Error* exec_num_outputs(PJRT_Executable_NumOutputs_Args* a) {
+  a->num_outputs =
+      (size_t)reinterpret_cast<MockExecutable*>(a->executable)->num_outputs;
   return nullptr;
 }
 
@@ -151,10 +162,19 @@ PJRT_Error* loaded_destroy(PJRT_LoadedExecutable_Destroy_Args* a) {
 }
 
 PJRT_Error* loaded_execute(PJRT_LoadedExecutable_Execute_Args* a) {
-  (void)a;
   long us = env_int("MOCK_PJRT_EXEC_US", 1000);
   struct timespec ts = {us / 1000000L, (us % 1000000L) * 1000L};
   nanosleep(&ts, nullptr);
+  /* populate caller-allocated output_lists like the real runtime */
+  auto* e = reinterpret_cast<MockExecutable*>(a->executable);
+  if (e->out_bytes > 0 && a->output_lists) {
+    for (size_t d = 0; d < a->num_devices; d++) {
+      if (!a->output_lists[d]) continue;
+      for (int i = 0; i < e->num_outputs; i++)
+        a->output_lists[d][i] = reinterpret_cast<PJRT_Buffer*>(
+            new MockBuffer{e->out_bytes, nullptr});
+    }
+  }
   return nullptr;
 }
 
@@ -186,6 +206,7 @@ extern "C" const PJRT_Api* GetPjrtApi() {
   g_mock_api.PJRT_Client_Compile = client_compile;
   g_mock_api.PJRT_LoadedExecutable_GetExecutable = loaded_get_executable;
   g_mock_api.PJRT_Executable_SizeOfGeneratedCodeInBytes = exec_code_size;
+  g_mock_api.PJRT_Executable_NumOutputs = exec_num_outputs;
   g_mock_api.PJRT_LoadedExecutable_Destroy = loaded_destroy;
   g_mock_api.PJRT_LoadedExecutable_Execute = loaded_execute;
   g_mock_api.PJRT_Device_MemoryStats = device_memstats;

@@ -145,6 +145,90 @@ int main(int argc, char** argv) {
   CHECK(per >= 3.0, "execute paced to ~25% duty cycle");
 
   printf("# per-execute %.2f ms (mock work 1 ms, quota 25%%)\n", per);
+
+  /* execute OUTPUT accounting (check_oom for computation results).
+   * Live at this point: b3 = 40 MiB + ~1 MiB program on a 64 MiB quota. */
+  setenv("MOCK_PJRT_NUM_OUTPUTS", "2", 1);
+  setenv("MOCK_PJRT_OUT_BYTES", "8388608", 1); /* 8 MiB each */
+  PJRT_Client_Compile_Args cc2;
+  memset(&cc2, 0, sizeof(cc2));
+  cc2.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  cc2.client = ca.client;
+  CHECK(api->PJRT_Client_Compile(&cc2) == nullptr, "compile (with outputs)");
+
+  /* snapshot AFTER compile: program bytes are accounted at compile */
+  PJRT_Device_MemoryStats_Args ms0;
+  memset(&ms0, 0, sizeof(ms0));
+  ms0.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms0.device = dev0;
+  api->PJRT_Device_MemoryStats(&ms0);
+
+  PJRT_Buffer* outrow[2] = {nullptr, nullptr};
+  PJRT_Buffer** outlists[1] = {outrow};
+  PJRT_LoadedExecutable_Execute_Args ea2;
+  memset(&ea2, 0, sizeof(ea2));
+  ea2.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea2.executable = cc2.executable;
+  ea2.num_devices = 1;
+  ea2.output_lists = outlists;
+  ea2.execute_device = dev0;
+  CHECK(api->PJRT_LoadedExecutable_Execute(&ea2) == nullptr,
+        "execute with outputs under quota");
+  PJRT_Device_MemoryStats_Args ms1;
+  memset(&ms1, 0, sizeof(ms1));
+  ms1.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms1.device = dev0;
+  api->PJRT_Device_MemoryStats(&ms1);
+  CHECK(ms1.bytes_in_use == ms0.bytes_in_use + 2 * 8388608LL,
+        "both output buffers accounted");
+  for (int i = 0; i < 2; i++) {
+    PJRT_Buffer_Destroy_Args bd2;
+    memset(&bd2, 0, sizeof(bd2));
+    bd2.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+    bd2.buffer = outrow[i];
+    CHECK(api->PJRT_Buffer_Destroy(&bd2) == nullptr, "destroy output");
+  }
+  api->PJRT_Device_MemoryStats(&ms1);
+  CHECK(ms1.bytes_in_use == ms0.bytes_in_use, "output destroy frees quota");
+
+  /* over-quota outputs: 2 × 30 MiB on top of ~41 MiB used > 64 MiB */
+  setenv("MOCK_PJRT_OUT_BYTES", "31457280", 1);
+  PJRT_Client_Compile_Args cc3;
+  memset(&cc3, 0, sizeof(cc3));
+  cc3.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  cc3.client = ca.client;
+  CHECK(api->PJRT_Client_Compile(&cc3) == nullptr, "compile (big outputs)");
+  PJRT_Device_MemoryStats_Args ms_pre3;
+  memset(&ms_pre3, 0, sizeof(ms_pre3));
+  ms_pre3.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms_pre3.device = dev0;
+  api->PJRT_Device_MemoryStats(&ms_pre3);
+  PJRT_Buffer* outrow3[2] = {nullptr, nullptr};
+  PJRT_Buffer** outlists3[1] = {outrow3};
+  PJRT_LoadedExecutable_Execute_Args ea3;
+  memset(&ea3, 0, sizeof(ea3));
+  ea3.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea3.executable = cc3.executable;
+  ea3.num_devices = 1;
+  ea3.output_lists = outlists3;
+  ea3.execute_device = dev0;
+  err = api->PJRT_LoadedExecutable_Execute(&ea3);
+  CHECK(err != nullptr, "over-quota outputs rejected");
+  if (err) {
+    PJRT_Error_GetCode_Args gc3;
+    memset(&gc3, 0, sizeof(gc3));
+    gc3.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+    gc3.error = err;
+    api->PJRT_Error_GetCode(&gc3);
+    CHECK(gc3.code == PJRT_Error_Code_RESOURCE_EXHAUSTED,
+          "output rejection code is RESOURCE_EXHAUSTED");
+    destroy_error(err);
+  }
+  ms1.device = dev0;
+  api->PJRT_Device_MemoryStats(&ms1);
+  CHECK(ms1.bytes_in_use == ms_pre3.bytes_in_use,
+        "rejected outputs fully unwound");
+
   printf("all shim tests passed\n");
   return 0;
 }

@@ -71,6 +71,10 @@ const PJRT_Api* g_real = nullptr;
 PJRT_Api g_api; /* our copy with wrapped entries */
 pthread_mutex_t g_mu = PTHREAD_MUTEX_INITIALIZER;
 
+/* loaded executable → output arity (cached at compile; avoids a
+ * GetExecutable round-trip — and a wrapper-object leak — per execute) */
+std::unordered_map<void*, size_t> g_num_outputs;
+
 /* buffer/executable → accounted bytes (+device index for buffers) */
 struct Acct {
   uint64_t bytes;
@@ -230,11 +234,10 @@ uint64_t dtype_width(PJRT_Buffer_Type t) {
 /* account the real on-device size; returns 0 ok, -1 if the buffer busts the
  * quota (caller destroys it and surfaces the error — the exact-size
  * equivalent of check_oom, covering dtypes the pre-check can't size) */
-int account_buffer(PJRT_Buffer* buf, PJRT_Device* dev_hint) {
+int account_buffer_idx(PJRT_Buffer* buf, int dev) {
   if (!buf || !g_region) return 0;
   uint64_t sz = buffer_size(buf);
   if (sz == 0) return 0;
-  int dev = device_index(dev_hint);
   if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0, sz,
                           g_cfg.oversubscribe) != 0)
     return -1;
@@ -242,6 +245,10 @@ int account_buffer(PJRT_Buffer* buf, PJRT_Device* dev_hint) {
   g_buffers[buf] = {sz, dev};
   pthread_mutex_unlock(&g_mu);
   return 0;
+}
+
+int account_buffer(PJRT_Buffer* buf, PJRT_Device* dev_hint) {
+  return account_buffer_idx(buf, device_index(dev_hint));
 }
 
 /* pre-flight quota check for a known size (the reject path) */
@@ -402,6 +409,27 @@ PJRT_Error* wrap_Client_Compile(PJRT_Client_Compile_Args* args) {
         g_programs[args->executable] = {(uint64_t)sa.size_in_bytes, 0};
         pthread_mutex_unlock(&g_mu);
       }
+      /* cache output arity for the execute hot path */
+      if (g_real->PJRT_Executable_NumOutputs) {
+        PJRT_Executable_NumOutputs_Args na;
+        memset(&na, 0, sizeof(na));
+        na.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+        na.executable = ga.executable;
+        if (g_real->PJRT_Executable_NumOutputs(&na) == nullptr) {
+          pthread_mutex_lock(&g_mu);
+          g_num_outputs[args->executable] = na.num_outputs;
+          pthread_mutex_unlock(&g_mu);
+        }
+      }
+      /* the unloaded-executable wrapper is caller-owned (pjrt_c_api.h:
+       * "should be freed by the caller with PJRT_Executable_Destroy") */
+      if (g_real->PJRT_Executable_Destroy) {
+        PJRT_Executable_Destroy_Args da;
+        memset(&da, 0, sizeof(da));
+        da.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+        da.executable = ga.executable;
+        g_real->PJRT_Executable_Destroy(&da);
+      }
     }
   }
   return nullptr;
@@ -410,6 +438,7 @@ PJRT_Error* wrap_Client_Compile(PJRT_Client_Compile_Args* args) {
 PJRT_Error* wrap_LoadedExecutable_Destroy(
     PJRT_LoadedExecutable_Destroy_Args* args) {
   pthread_mutex_lock(&g_mu);
+  g_num_outputs.erase(args->executable);
   auto it = g_programs.find(args->executable);
   Acct acct{0, 0};
   bool found = it != g_programs.end();
@@ -436,15 +465,60 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
   clock_gettime(CLOCK_MONOTONIC, &t1);
   if (g_region) {
     __sync_fetch_and_add(&g_region->recent_kernel, 1);
-    /* account output buffers */
+    /* account output buffers (the check_oom analog for computation
+     * results: outputs consume HBM too).  Over-quota without
+     * oversubscribe ⇒ destroy this call's outputs and fail the execute. */
     if (!err && args->output_lists) {
-      for (size_t d = 0; d < args->num_devices; d++) {
+      size_t n_out = 0;
+      pthread_mutex_lock(&g_mu);
+      auto nit = g_num_outputs.find(args->executable);
+      if (nit != g_num_outputs.end()) n_out = nit->second;
+      pthread_mutex_unlock(&g_mu);
+      int over_quota = 0;
+      for (size_t d = 0; d < args->num_devices && !over_quota; d++) {
         PJRT_Buffer** outs = args->output_lists[d];
         if (!outs) continue;
-        /* num_outputs is implicit; rely on Buffer_Destroy pairing — account
-         * only the first device row's buffers individually as they are
-         * destroyed through the wrapped path anyway */
-        (void)outs;
+        int row_dev = args->execute_device
+                          ? device_index(args->execute_device)
+                          : (int)d;
+        for (size_t i = 0; i < n_out; i++) {
+          if (!outs[i]) continue;
+          /* attribute to the buffer's OWN device when queryable (JAX
+           * often leaves execute_device null; the row index is only the
+           * last-resort guess) */
+          int dev = row_dev;
+          if (g_real->PJRT_Buffer_Device) {
+            PJRT_Buffer_Device_Args bda;
+            memset(&bda, 0, sizeof(bda));
+            bda.struct_size = PJRT_Buffer_Device_Args_STRUCT_SIZE;
+            bda.buffer = outs[i];
+            if (g_real->PJRT_Buffer_Device(&bda) == nullptr && bda.device)
+              dev = device_index(bda.device);
+          }
+          if (account_buffer_idx(outs[i], dev) != 0) {
+            over_quota = 1;
+            break;
+          }
+        }
+      }
+      if (over_quota) {
+        /* unwind: destroy every output of this call (accounted ones are
+         * released through the wrapped Buffer_Destroy path) */
+        for (size_t d = 0; d < args->num_devices; d++) {
+          PJRT_Buffer** outs = args->output_lists[d];
+          if (!outs) continue;
+          for (size_t i = 0; i < n_out; i++) {
+            if (!outs[i]) continue;
+            PJRT_Buffer_Destroy_Args bd;
+            memset(&bd, 0, sizeof(bd));
+            bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+            bd.buffer = outs[i];
+            wrap_Buffer_Destroy(&bd);
+            outs[i] = nullptr;
+          }
+        }
+        return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
+                          "vtpu: HBM quota exceeded (execute outputs)");
       }
     }
   }
