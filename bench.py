@@ -33,6 +33,12 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def hard_sync(out):
+    from vtpu.utils.sync import hard_sync as _hs
+
+    return _hs(out)
+
+
 def build_forward(platform: str):
     import jax
     import jax.numpy as jnp
@@ -66,7 +72,7 @@ def build_forward(platform: str):
         logits, _ = model.apply(variables, images, mutable=["batch_stats"])
         return logits
 
-    forward(x).block_until_ready()  # compile
+    hard_sync(forward(x))  # compile + true completion
     param_bytes = sum(
         int(v.size * v.dtype.itemsize) for v in jax.tree.leaves(variables)
     )
@@ -102,7 +108,7 @@ def run_streams(forward, x, batch, seconds: float, n_streams: int = 4,
         pending = collections.deque()
 
         def retire():
-            jax.block_until_ready(pending.popleft())
+            hard_sync(pending.popleft())
             if after_step is not None:
                 after_step(i)
             counts[i] += batch

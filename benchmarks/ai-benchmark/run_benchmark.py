@@ -103,20 +103,21 @@ def build_step(name: str, batch: int, mode: str):
     return step, state, x
 
 
-def timed_imgs_per_s(step, state, x, batch, mode, seconds, shim=None):
-    import jax
+from vtpu.utils.sync import hard_sync  # noqa: E402  (after sys.path setup)
 
+
+def timed_imgs_per_s(step, state, x, batch, mode, seconds, shim=None):
     paced = shim.throttled(step) if shim is not None else step
     # warmup/compile
     out = paced(state, x)
-    jax.block_until_ready(out)
+    hard_sync(out)
     if mode == "training":
         state = out[0]
     n = 0
     t0 = time.monotonic()
     while time.monotonic() - t0 < seconds:
         out = paced(state, x)
-        jax.block_until_ready(out)
+        hard_sync(out)
         if mode == "training":
             state = out[0]
         n += batch

@@ -42,6 +42,10 @@ struct MockExecutable {
   int64_t code_size;
   int num_outputs;
   uint64_t out_bytes; /* per output buffer, 0 = produce no outputs */
+  /* shape metadata storage for OutputElementTypes/OutputDimensions */
+  std::vector<PJRT_Buffer_Type> out_types;
+  std::vector<int64_t> out_dims;
+  std::vector<size_t> out_dim_sizes;
 };
 
 int env_int(const char* k, int def) {
@@ -131,10 +135,16 @@ PJRT_Error* buffer_destroy(PJRT_Buffer_Destroy_Args* a) {
 }
 
 PJRT_Error* client_compile(PJRT_Client_Compile_Args* a) {
-  auto* e = new MockExecutable{
-      env_int("MOCK_PJRT_CODE_BYTES", 1 << 20),
-      env_int("MOCK_PJRT_NUM_OUTPUTS", 1),
-      (uint64_t)env_int("MOCK_PJRT_OUT_BYTES", 0)};
+  auto* e = new MockExecutable;
+  e->code_size = env_int("MOCK_PJRT_CODE_BYTES", 1 << 20);
+  e->num_outputs = env_int("MOCK_PJRT_NUM_OUTPUTS", 1);
+  e->out_bytes = (uint64_t)env_int("MOCK_PJRT_OUT_BYTES", 0);
+  /* expose each output as a 1-D U8 array of out_bytes elements */
+  for (int i = 0; i < e->num_outputs && e->out_bytes > 0; i++) {
+    e->out_types.push_back(PJRT_Buffer_Type_U8);
+    e->out_dims.push_back((int64_t)e->out_bytes);
+    e->out_dim_sizes.push_back(1);
+  }
   a->executable = reinterpret_cast<PJRT_LoadedExecutable*>(e);
   return nullptr;
 }
@@ -142,6 +152,21 @@ PJRT_Error* client_compile(PJRT_Client_Compile_Args* a) {
 PJRT_Error* exec_num_outputs(PJRT_Executable_NumOutputs_Args* a) {
   a->num_outputs =
       (size_t)reinterpret_cast<MockExecutable*>(a->executable)->num_outputs;
+  return nullptr;
+}
+
+PJRT_Error* exec_out_types(PJRT_Executable_OutputElementTypes_Args* a) {
+  auto* e = reinterpret_cast<MockExecutable*>(a->executable);
+  a->output_types = e->out_types.data();
+  a->num_output_types = e->out_types.size();
+  return nullptr;
+}
+
+PJRT_Error* exec_out_dims(PJRT_Executable_OutputDimensions_Args* a) {
+  auto* e = reinterpret_cast<MockExecutable*>(a->executable);
+  a->num_outputs = e->out_dim_sizes.size();
+  a->dims = e->out_dims.data();
+  a->dim_sizes = e->out_dim_sizes.data();
   return nullptr;
 }
 
@@ -207,6 +232,8 @@ extern "C" const PJRT_Api* GetPjrtApi() {
   g_mock_api.PJRT_LoadedExecutable_GetExecutable = loaded_get_executable;
   g_mock_api.PJRT_Executable_SizeOfGeneratedCodeInBytes = exec_code_size;
   g_mock_api.PJRT_Executable_NumOutputs = exec_num_outputs;
+  g_mock_api.PJRT_Executable_OutputElementTypes = exec_out_types;
+  g_mock_api.PJRT_Executable_OutputDimensions = exec_out_dims;
   g_mock_api.PJRT_LoadedExecutable_Destroy = loaded_destroy;
   g_mock_api.PJRT_LoadedExecutable_Execute = loaded_execute;
   g_mock_api.PJRT_Device_MemoryStats = device_memstats;

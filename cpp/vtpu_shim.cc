@@ -74,6 +74,11 @@ pthread_mutex_t g_mu = PTHREAD_MUTEX_INITIALIZER;
 /* loaded executable → output arity (cached at compile; avoids a
  * GetExecutable round-trip — and a wrapper-object leak — per execute) */
 std::unordered_map<void*, size_t> g_num_outputs;
+/* loaded executable → total output bytes per device row, from compile-time
+ * shape metadata.  Enables a CLEAN pre-execute quota reject (no unwinding
+ * of an already-run execute, which would leak the caller's completion
+ * events and invalidate donated inputs). */
+std::unordered_map<void*, uint64_t> g_out_bytes;
 
 /* buffer/executable → accounted bytes (+device index for buffers) */
 struct Acct {
@@ -251,6 +256,18 @@ int account_buffer(PJRT_Buffer* buf, PJRT_Device* dev_hint) {
   return account_buffer_idx(buf, device_index(dev_hint));
 }
 
+/* accounting that can never reject (post-hoc paths where the buffer
+ * already exists): force-admit via the oversubscribe flag */
+void account_buffer_idx_forced(PJRT_Buffer* buf, int dev) {
+  if (!buf || !g_region) return;
+  uint64_t sz = buffer_size(buf);
+  if (sz == 0) return;
+  vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0, sz, 1);
+  pthread_mutex_lock(&g_mu);
+  g_buffers[buf] = {sz, dev};
+  pthread_mutex_unlock(&g_mu);
+}
+
 /* pre-flight quota check for a known size (the reject path) */
 bool quota_allows(int dev, uint64_t want) {
   if (g_cfg.oversubscribe || !g_region) return true;
@@ -409,7 +426,7 @@ PJRT_Error* wrap_Client_Compile(PJRT_Client_Compile_Args* args) {
         g_programs[args->executable] = {(uint64_t)sa.size_in_bytes, 0};
         pthread_mutex_unlock(&g_mu);
       }
-      /* cache output arity for the execute hot path */
+      /* cache output arity + total output bytes for the execute hot path */
       if (g_real->PJRT_Executable_NumOutputs) {
         PJRT_Executable_NumOutputs_Args na;
         memset(&na, 0, sizeof(na));
@@ -419,6 +436,38 @@ PJRT_Error* wrap_Client_Compile(PJRT_Client_Compile_Args* args) {
           pthread_mutex_lock(&g_mu);
           g_num_outputs[args->executable] = na.num_outputs;
           pthread_mutex_unlock(&g_mu);
+        }
+      }
+      if (g_real->PJRT_Executable_OutputElementTypes &&
+          g_real->PJRT_Executable_OutputDimensions) {
+        PJRT_Executable_OutputElementTypes_Args ta;
+        memset(&ta, 0, sizeof(ta));
+        ta.struct_size = PJRT_Executable_OutputElementTypes_Args_STRUCT_SIZE;
+        ta.executable = ga.executable;
+        PJRT_Executable_OutputDimensions_Args oa;
+        memset(&oa, 0, sizeof(oa));
+        oa.struct_size = PJRT_Executable_OutputDimensions_Args_STRUCT_SIZE;
+        oa.executable = ga.executable;
+        if (g_real->PJRT_Executable_OutputElementTypes(&ta) == nullptr &&
+            g_real->PJRT_Executable_OutputDimensions(&oa) == nullptr &&
+            oa.dims && oa.dim_sizes) {
+          uint64_t total = 0;
+          size_t cursor = 0;
+          int sizable = 1;
+          for (size_t o = 0; o < ta.num_output_types; o++) {
+            uint64_t w = dtype_width(ta.output_types[o]);
+            if (w == 0) { sizable = 0; break; }
+            uint64_t elems = 1;
+            for (size_t k = 0; k < oa.dim_sizes[o]; k++)
+              elems *= (uint64_t)oa.dims[cursor + k];
+            cursor += oa.dim_sizes[o];
+            total += w * elems;
+          }
+          if (sizable && total > 0) {
+            pthread_mutex_lock(&g_mu);
+            g_out_bytes[args->executable] = total;
+            pthread_mutex_unlock(&g_mu);
+          }
         }
       }
       /* the unloaded-executable wrapper is caller-owned (pjrt_c_api.h:
@@ -439,6 +488,7 @@ PJRT_Error* wrap_LoadedExecutable_Destroy(
     PJRT_LoadedExecutable_Destroy_Args* args) {
   pthread_mutex_lock(&g_mu);
   g_num_outputs.erase(args->executable);
+  g_out_bytes.erase(args->executable);
   auto it = g_programs.find(args->executable);
   Acct acct{0, 0};
   bool found = it != g_programs.end();
@@ -457,25 +507,83 @@ PJRT_Error* wrap_LoadedExecutable_Destroy(
  * call (the utilization-watcher analog; coarse but monotone).  The
  * monitor can suspend throttling for high-priority procs by setting
  * utilization_switch=1 (ref feedback.go CheckPriority/Observe). */
+/* n_out / out_bytes with a fallback query for executables that did not
+ * come through wrap_Client_Compile (e.g. deserialized from a persistent
+ * compilation cache) */
+static size_t exec_num_outputs(PJRT_LoadedExecutable* le) {
+  pthread_mutex_lock(&g_mu);
+  auto it = g_num_outputs.find(le);
+  if (it != g_num_outputs.end()) {
+    size_t n = it->second;
+    pthread_mutex_unlock(&g_mu);
+    return n;
+  }
+  pthread_mutex_unlock(&g_mu);
+  size_t n = 0;
+  if (g_real->PJRT_LoadedExecutable_GetExecutable &&
+      g_real->PJRT_Executable_NumOutputs) {
+    PJRT_LoadedExecutable_GetExecutable_Args ga;
+    memset(&ga, 0, sizeof(ga));
+    ga.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
+    ga.loaded_executable = le;
+    if (g_real->PJRT_LoadedExecutable_GetExecutable(&ga) == nullptr) {
+      PJRT_Executable_NumOutputs_Args na;
+      memset(&na, 0, sizeof(na));
+      na.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+      na.executable = ga.executable;
+      if (g_real->PJRT_Executable_NumOutputs(&na) == nullptr)
+        n = na.num_outputs;
+      if (g_real->PJRT_Executable_Destroy) {
+        PJRT_Executable_Destroy_Args da;
+        memset(&da, 0, sizeof(da));
+        da.struct_size = PJRT_Executable_Destroy_Args_STRUCT_SIZE;
+        da.executable = ga.executable;
+        g_real->PJRT_Executable_Destroy(&da);
+      }
+    }
+  }
+  pthread_mutex_lock(&g_mu);
+  g_num_outputs[le] = n;
+  pthread_mutex_unlock(&g_mu);
+  return n;
+}
+
 PJRT_Error* wrap_LoadedExecutable_Execute(
     PJRT_LoadedExecutable_Execute_Args* args) {
+  /* PRE-execute quota check from compile-time output metadata: rejecting
+   * before the real call avoids unwinding a completed execute (which
+   * would leak the caller's completion events and consume donated
+   * inputs behind its back — the reason there is no post-hoc reject) */
+  if (g_region && args->output_lists && !g_cfg.oversubscribe) {
+    uint64_t per_row = 0;
+    pthread_mutex_lock(&g_mu);
+    auto bit = g_out_bytes.find(args->executable);
+    if (bit != g_out_bytes.end()) per_row = bit->second;
+    pthread_mutex_unlock(&g_mu);
+    if (per_row > 0) {
+      for (size_t d = 0; d < args->num_devices; d++) {
+        if (!args->output_lists[d]) continue;
+        int dev = args->execute_device ? device_index(args->execute_device)
+                                       : (int)d;
+        if (!quota_allows(dev, per_row))
+          return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
+                            "vtpu: HBM quota exceeded (execute outputs)");
+      }
+    }
+  }
   struct timespec t0, t1;
   clock_gettime(CLOCK_MONOTONIC, &t0);
   PJRT_Error* err = g_real->PJRT_LoadedExecutable_Execute(args);
   clock_gettime(CLOCK_MONOTONIC, &t1);
   if (g_region) {
     __sync_fetch_and_add(&g_region->recent_kernel, 1);
-    /* account output buffers (the check_oom analog for computation
-     * results: outputs consume HBM too).  Over-quota without
-     * oversubscribe ⇒ destroy this call's outputs and fail the execute. */
+    /* post-hoc accounting of the outputs that DID materialize: always
+     * admitted (the reject already happened pre-execute when metadata
+     * allowed), so the monitor's usage numbers stay truthful even for
+     * executables whose output sizes were unknowable up front */
     if (!err && args->output_lists) {
-      size_t n_out = 0;
-      pthread_mutex_lock(&g_mu);
-      auto nit = g_num_outputs.find(args->executable);
-      if (nit != g_num_outputs.end()) n_out = nit->second;
-      pthread_mutex_unlock(&g_mu);
-      int over_quota = 0;
-      for (size_t d = 0; d < args->num_devices && !over_quota; d++) {
+      size_t n_out = exec_num_outputs(args->executable);
+      for (size_t d = 0; d < args->num_devices; d++) {
         PJRT_Buffer** outs = args->output_lists[d];
         if (!outs) continue;
         int row_dev = args->execute_device
@@ -495,30 +603,8 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
             if (g_real->PJRT_Buffer_Device(&bda) == nullptr && bda.device)
               dev = device_index(bda.device);
           }
-          if (account_buffer_idx(outs[i], dev) != 0) {
-            over_quota = 1;
-            break;
-          }
+          account_buffer_idx_forced(outs[i], dev);
         }
-      }
-      if (over_quota) {
-        /* unwind: destroy every output of this call (accounted ones are
-         * released through the wrapped Buffer_Destroy path) */
-        for (size_t d = 0; d < args->num_devices; d++) {
-          PJRT_Buffer** outs = args->output_lists[d];
-          if (!outs) continue;
-          for (size_t i = 0; i < n_out; i++) {
-            if (!outs[i]) continue;
-            PJRT_Buffer_Destroy_Args bd;
-            memset(&bd, 0, sizeof(bd));
-            bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
-            bd.buffer = outs[i];
-            wrap_Buffer_Destroy(&bd);
-            outs[i] = nullptr;
-          }
-        }
-        return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
-                          "vtpu: HBM quota exceeded (execute outputs)");
       }
     }
   }

@@ -1,0 +1,21 @@
+"""True device-completion barrier shared by the benchmarks.
+
+``jax.block_until_ready`` is advisory on some remote/tunneled platforms:
+it can return once the dispatch is acknowledged rather than when the chip
+finishes, silently turning a throughput benchmark into a dispatch-rate
+benchmark (and flooding the device queue with unbounded in-flight work).
+Fetching a derived scalar to the host cannot complete before the
+computation has, on any platform."""
+
+from __future__ import annotations
+
+
+def hard_sync(out):
+    """Block until `out`'s computation has TRULY completed; returns a
+    host scalar derived from its first leaf."""
+    import jax
+
+    leaves = jax.tree.leaves(out)
+    if not leaves:
+        return None
+    return jax.device_get(leaves[0].ravel()[0])
