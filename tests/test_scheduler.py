@@ -298,6 +298,38 @@ def test_http_bad_json(http_sched):
     assert ei.value.code == 400
 
 
+def test_multi_node_spread_eight_pods_cores30():
+    """BASELINE.json config 3: 8 pods × cores=30 across 2 nodes (2 chips
+    each).  cores cap ⇒ ≤3 pods per chip; all 8 must schedule, and the
+    spread policy must actually use both nodes."""
+    from vtpu.utils.nodelock import release_node_lock
+
+    client = FakeClient()
+    for n in ("n1", "n2"):
+        register_node(client, n, n_chips=2, topology="2x1x1")
+    sched = Scheduler(
+        client, SchedulerConfig(node_scheduler_policy="spread")
+    )
+    sched.register_from_node_annotations()
+    placed = []
+    for i in range(12):  # 4 chips × ⌊100/30⌋ = full cluster capacity
+        p = client.create_pod(tpu_pod(f"p{i}", cores=30, mem=1024))
+        res = sched.filter(p, ["n1", "n2"])
+        assert res.node in ("n1", "n2"), (i, res.error, res.failed)
+        placed.append(res.node)
+        err = sched.bind("default", f"p{i}", res.node)
+        assert err is None
+        # the device plugin's Allocate releases the node lock after the
+        # handshake (pod_allocation_try_success); emulate that here
+        release_node_lock(client, res.node)
+    # the original config-3 shape: the first 8 pods span both nodes
+    assert set(placed[:8]) == {"n1", "n2"}, placed
+    # 13th pod: every chip already carries 3×30 cores — no fit anywhere
+    p13 = client.create_pod(tpu_pod("p13", cores=30, mem=1024))
+    res13 = sched.filter(p13, ["n1", "n2"])
+    assert res13.node is None and res13.error, res13
+
+
 def test_serve_tls(tmp_path):
     """The webhook listener speaks TLS when given cert/key (the chart's
     certgen secret; ref extender TLS flags cmd/scheduler/main.go:51-58)."""
