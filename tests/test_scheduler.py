@@ -322,12 +322,13 @@ def test_multi_node_spread_eight_pods_cores30():
         # the device plugin's Allocate releases the node lock after the
         # handshake (pod_allocation_try_success); emulate that here
         release_node_lock(client, res.node)
-    # the original config-3 shape: the first 8 pods span both nodes
-    assert set(placed[:8]) == {"n1", "n2"}, placed
+    # spread must alternate from the start (binpack would fill n1's two
+    # chips with six pods before touching n2)
+    assert set(placed[:2]) == {"n1", "n2"}, placed
     # 13th pod: every chip already carries 3×30 cores — no fit anywhere
-    p13 = client.create_pod(tpu_pod("p13", cores=30, mem=1024))
-    res13 = sched.filter(p13, ["n1", "n2"])
-    assert res13.node is None and res13.error, res13
+    p12 = client.create_pod(tpu_pod("p12", cores=30, mem=1024))
+    res12 = sched.filter(p12, ["n1", "n2"])
+    assert res12.node is None and res12.error, res12
 
 
 def test_serve_tls(tmp_path):

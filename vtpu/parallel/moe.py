@@ -1,0 +1,73 @@
+"""Expert parallelism: all-to-all token routing over a mesh axis.
+
+A mixture-of-experts FFN sharded the TPU way: each chip holds one (or
+more) experts; a router scores tokens, tokens travel to their expert's
+chip with ONE `all_to_all`, the expert FFN runs as a dense batched matmul
+on the MXU, and a second `all_to_all` brings results home.  Capacity is
+static (XLA needs static shapes): each expert takes at most
+``capacity`` tokens per source shard; overflow tokens fall through with a
+zero update (standard capacity-factor semantics).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
+            capacity: int = 0):
+    """x: [batch_shard_tokens, d] sharded on ``axis``; one expert per
+    mesh-axis entry.  router_w: [d, n_experts]; w_in: [n_experts, d, h];
+    w_out: [n_experts, h, d] (expert dims sharded on ``axis``).
+    Returns the combined expert outputs, same sharding as x."""
+    n_exp = mesh.shape[axis]
+    tokens = x.shape[0] // n_exp if x.shape[0] % n_exp == 0 else x.shape[0]
+    del tokens
+    if capacity <= 0:
+        capacity = max(1, x.shape[0] // n_exp)
+
+    def shard_fn(x_s, rw, wi, wo):
+        # local expert weights: [1, d, h] → [d, h]
+        wi = jnp.squeeze(wi, axis=0)
+        wo = jnp.squeeze(wo, axis=0)
+        t, d = x_s.shape
+        # route: top-1 expert per token
+        logits = x_s @ rw                              # [t, n_exp]
+        expert = jnp.argmax(logits, axis=-1)           # [t]
+        gate = jax.nn.softmax(logits, axis=-1)
+        gate = jnp.take_along_axis(gate, expert[:, None], axis=1)[:, 0]
+        # position of each token within its expert's capacity buffer
+        onehot = jax.nn.one_hot(expert, n_exp, dtype=jnp.int32)  # [t, e]
+        pos = jnp.cumsum(onehot, axis=0) * onehot
+        pos = jnp.sum(pos, axis=-1) - 1                # [t], 0-based
+        keep = pos < capacity
+        # scatter tokens into [n_exp, capacity, d] send buffer
+        send = jnp.zeros((n_exp, capacity, d), x_s.dtype)
+        idx_e = jnp.where(keep, expert, 0)
+        idx_p = jnp.where(keep, pos, 0)
+        send = send.at[idx_e, idx_p].add(
+            jnp.where(keep[:, None], x_s, 0.0)
+        )
+        # all-to-all: [n_exp, capacity, d] → gather my expert's tokens
+        # from every source shard: [n_src=n_exp, capacity, d]
+        recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+        # dense expert FFN on the MXU
+        h = jax.nn.relu(recv.reshape(-1, d) @ wi)
+        y = (h @ wo).reshape(n_exp, capacity, d)
+        # route results back
+        back = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)  # [n_exp, capacity, d]
+        # gather each token's result from its (expert, pos) slot
+        out = back[idx_e, idx_p]
+        out = jnp.where(keep[:, None], out * gate[:, None], 0.0)
+        return out
+
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(axis), P(axis)),
+        out_specs=P(axis),
+    )(x, router_w, w_in, w_out)

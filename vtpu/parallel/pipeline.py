@@ -1,0 +1,85 @@
+"""Pipeline parallelism over a mesh axis.
+
+GPipe-style schedule expressed the XLA way: the layer stack is sharded
+across the ``pp`` axis (each chip holds one stage's weights), microbatches
+stream through with `lax.scan` over shifted activations — every scan step
+each stage computes its microbatch then `ppermute`s activations one hop
+to the next stage.  No data-dependent control flow; the whole schedule is
+one compiled program (steady-state bubbles only at fill/drain, the GPipe
+shape).
+
+Layout contract: ``xs`` [n_micro, micro_batch, d] replicated per stage
+shard entry; stage weights sharded over ``axis``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(stage_fn, params, xs, mesh: Mesh, axis: str = "pp"):
+    """Run ``xs`` microbatches through the pipeline.
+
+    stage_fn(stage_params, x) -> y     one stage's computation
+    params: pytree whose leaves have a leading stage dim sharded on ``axis``
+    xs: [n_micro, micro, d] (replicated); returns [n_micro, micro, d]
+    outputs produced by the LAST stage, in microbatch order.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = xs.shape[0]
+    if n_micro < n_stages:
+        raise ValueError(
+            f"need at least {n_stages} microbatches to fill the pipeline, "
+            f"got {n_micro}"
+        )
+    fwd_perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+    def shard_fn(stage_params, xs_local):
+        stage_params = jax.tree.map(
+            lambda p: jnp.squeeze(p, axis=0), stage_params
+        )
+        sidx = jax.lax.axis_index(axis)
+        total_steps = n_micro + n_stages - 1
+        # outputs land here as the last stage finishes each microbatch
+        out0 = jnp.zeros_like(xs_local)
+
+        def step(carry, t):
+            acts, outs = carry
+            # stage 0 injects microbatch t (others receive from the ring)
+            inject = jnp.where(t < n_micro, t, 0)
+            acts = jnp.where(sidx == 0, xs_local[inject], acts)
+            y = stage_fn(stage_params, acts)
+            # last stage emits microbatch (t - n_stages + 1)
+            emit = t - (n_stages - 1)
+            do_emit = jnp.logical_and(sidx == n_stages - 1, emit >= 0)
+            outs = jnp.where(
+                do_emit,
+                outs.at[jnp.maximum(emit, 0)].set(y),
+                outs,
+            )
+            # activations advance one stage per step
+            acts = jax.lax.ppermute(y, axis, fwd_perm)
+            return (acts, outs), None
+
+        # carries become device-varying inside the loop (ppermute/axis_index)
+        # — mark the initial values varying too or scan rejects the carry
+        acts0 = jax.lax.pvary(jnp.zeros_like(xs_local[0]), (axis,))
+        out0 = jax.lax.pvary(out0, (axis,))
+        (acts, outs), _ = jax.lax.scan(
+            step, (acts0, out0), jnp.arange(total_steps)
+        )
+        # broadcast the last stage's outputs to every shard (replicated out)
+        outs = jax.lax.psum(
+            jnp.where(sidx == n_stages - 1, outs, jnp.zeros_like(outs)), axis
+        )
+        return outs
+
+    pspec = P(axis)
+    return jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: pspec, params), P()),
+        out_specs=P(),
+    )(params, xs)
