@@ -154,11 +154,72 @@ def run_streams(forward, x, batch, seconds: float, n_streams: int = 4,
     return [c / elapsed for c in counts], sum(violations)
 
 
+def init_devices(retries: int = 4, backoff_s: float = 15.0):
+    """``jax.devices()`` with bounded retry — the TPU tunnel backend can
+    be transiently UNAVAILABLE (BENCH_r01 failure mode).  Between
+    attempts the failed backend set is cleared so JAX actually re-probes
+    instead of returning the cached failure."""
+    last = None
+    for attempt in range(retries):
+        try:
+            import jax
+
+            return jax.devices()
+        except Exception as e:  # noqa: BLE001 — init errors vary by backend
+            last = e
+            log(f"backend init attempt {attempt + 1}/{retries} failed: {e}")
+            try:
+                from jax.extend.backend import clear_backends
+
+                clear_backends()
+            except Exception:  # noqa: BLE001
+                pass
+            if attempt + 1 < retries:
+                time.sleep(backoff_s * (attempt + 1))
+    raise last
+
+
+def rerun_on_cpu() -> int:
+    """Re-exec this benchmark pinned to the CPU platform (fallback when
+    the real-chip backend stays unavailable) and forward its stdout."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # skip tunnel registration
+    env["VTPU_BENCH_NO_FALLBACK"] = "1"
+    log("falling back to CPU platform (real chip unavailable)")
+    return subprocess.run(
+        [sys.executable, os.path.abspath(__file__)], env=env
+    ).returncode
+
+
 def main() -> None:
+    try:
+        devices = init_devices()
+    except Exception as e:  # noqa: BLE001
+        if os.environ.get("VTPU_BENCH_NO_FALLBACK") != "1":
+            if rerun_on_cpu() == 0:
+                return
+        # still emit the one parseable line the driver records
+        print(
+            json.dumps(
+                {
+                    "metric": "resnet50_4way_share_efficiency",
+                    "value": 0.0,
+                    "unit": "shared_sum_img_per_s / exclusive_img_per_s",
+                    "vs_baseline": 0.0,
+                    "error": f"backend init failed: {e}",
+                }
+            ),
+            flush=True,
+        )
+        return
+
     import jax
 
-    platform = jax.devices()[0].platform
-    log(f"bench platform: {platform} ({jax.devices()[0]})")
+    platform = devices[0].platform
+    log(f"bench platform: {platform} ({devices[0]})")
     window = 10.0 if platform != "cpu" else 3.0
 
     forward, x, batch, param_bytes = build_forward(platform)
