@@ -553,7 +553,17 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
   /* PRE-execute quota check from compile-time output metadata: rejecting
    * before the real call avoids unwinding a completed execute (which
    * would leak the caller's completion events and consume donated
-   * inputs behind its back — the reason there is no post-hoc reject) */
+   * inputs behind its back — the reason there is no post-hoc reject).
+   *
+   * The predicted bytes are RESERVED (atomic check-and-add under the
+   * region lock, accumulated per device across multi-device rows), not
+   * merely compared against headroom: two concurrent executes racing the
+   * last bytes cannot both be admitted.  The reservation is released
+   * after the real outputs are accounted (or on any failure), so the
+   * transient state is conservative (reservation + actuals), never
+   * under-counted. */
+  uint64_t reserved[VTPU_MAX_DEVICES] = {0};
+  bool have_reservation = false;
   if (g_region && args->output_lists && !g_cfg.oversubscribe) {
     uint64_t per_row = 0;
     pthread_mutex_lock(&g_mu);
@@ -561,13 +571,25 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
     if (bit != g_out_bytes.end()) per_row = bit->second;
     pthread_mutex_unlock(&g_mu);
     if (per_row > 0) {
+      uint64_t want[VTPU_MAX_DEVICES] = {0};
       for (size_t d = 0; d < args->num_devices; d++) {
         if (!args->output_lists[d]) continue;
         int dev = args->execute_device ? device_index(args->execute_device)
                                        : (int)d;
-        if (!quota_allows(dev, per_row))
+        if (dev >= 0 && dev < VTPU_MAX_DEVICES) want[dev] += per_row;
+      }
+      for (int dev = 0; dev < VTPU_MAX_DEVICES; dev++) {
+        if (want[dev] == 0) continue;
+        if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0,
+                                want[dev], /*oversubscribe=*/0) != 0) {
+          for (int u = 0; u < dev; u++)
+            if (reserved[u])
+              vtpu_region_sub(g_region, (int32_t)getpid(), u, 0, reserved[u]);
           return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
                             "vtpu: HBM quota exceeded (execute outputs)");
+        }
+        reserved[dev] = want[dev];
+        have_reservation = true;
       }
     }
   }
@@ -607,6 +629,13 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
         }
       }
     }
+    /* swap the reservation for the actual output accounting (or drop it
+     * on execute failure) — only after the actuals land, so a racing
+     * execute never sees a window with neither counted */
+    if (have_reservation)
+      for (int dev = 0; dev < VTPU_MAX_DEVICES; dev++)
+        if (reserved[dev])
+          vtpu_region_sub(g_region, (int32_t)getpid(), dev, 0, reserved[dev]);
   }
   int q = g_cfg.core_limit;
   int suspended = g_region && g_region->utilization_switch == 1;

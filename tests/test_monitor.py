@@ -226,21 +226,61 @@ def test_shim_runtime_re_put_and_gc_release(tmp_path):
 
 def test_shim_runtime_dispatch_counts_and_paces(tmp_path):
     """dispatch() records kernel launches in the region and rate-limits
-    dispatch to the core percentage without blocking on results."""
+    dispatch to the core percentage."""
     rt = ShimRuntime(
         limits_bytes=[],
         core_limit=25,
         region_path=str(tmp_path / "dp.cache"),
         uuids=["tpu-0"],
     )
-    rt.observe_step(0.01)
     t0 = time.monotonic()
-    for _ in range(4):
+    for _ in range(6):
         rt.dispatch(lambda: time.sleep(0.01))  # steady 10ms steps
     dt = time.monotonic() - t0
-    assert rt.region.region.recent_kernel == 4
-    # 10ms step at 25% → ~30ms pacing sleep per dispatch → ≥120ms total
-    assert dt >= 0.1, dt
+    assert rt.region.region.recent_kernel == 6
+    # warmup + calibrate ≈ 20ms; then 4 paced steps: 10ms step at 25% →
+    # ~30ms pacing sleep each → ≥ 120ms more
+    assert dt >= 0.12, dt
+    # the calibration learned the true step time
+    assert 0.005 <= rt._last_step_s <= 0.05, rt._last_step_s
+    rt.close()
+
+
+def test_shim_runtime_dispatch_paces_async_dispatch(tmp_path):
+    """The closed loop survives ASYNC dispatch (the JAX reality): fn
+    returns instantly, device work completes later.  Enqueue-latency
+    pacing would collapse to a no-op here; the drain+calibrate cycle must
+    learn the true ~10ms step time from completion instead."""
+
+    class FakeAsyncResult:
+        def __init__(self, done_at):
+            self.done_at = done_at
+
+        def block_until_ready(self):
+            d = self.done_at - time.monotonic()
+            if d > 0:
+                time.sleep(d)
+
+    state = {"tail": time.monotonic()}
+
+    def enqueue():  # instant return; device busy 10ms per step, in order
+        state["tail"] = max(time.monotonic(), state["tail"]) + 0.01
+        return FakeAsyncResult(state["tail"])
+
+    rt = ShimRuntime(
+        limits_bytes=[],
+        core_limit=50,
+        region_path=str(tmp_path / "ap.cache"),
+        uuids=["tpu-0"],
+    )
+    for _ in range(6):  # warmup, calibrate, 4 paced
+        rt.dispatch(enqueue)
+    assert 0.008 <= rt._last_step_s <= 0.03, rt._last_step_s
+    # paced steps sleep ≈ T×(100−50)/50 = T each → dispatch rate halves
+    t0 = time.monotonic()
+    for _ in range(5):
+        rt.dispatch(enqueue)
+    assert time.monotonic() - t0 >= 0.04
     rt.close()
 
 

@@ -230,18 +230,20 @@ def test_pjrt_provider_cpu_enumeration():
 
 
 def test_pjrt_provider_health_reprobe():
-    """health_check re-derives liveness each call: a uuid that vanishes
-    from fresh discovery flips unhealthy and recovers when it returns."""
+    """health_check re-derives liveness each call through a per-device
+    runtime probe (NOT jax's cached device list — a dead chip stays in
+    that forever): a failing probe flips unhealthy, a succeeding one
+    recovers."""
     prov = PjrtProvider(platform="cpu")
     chips = prov.enumerate()
     assert chips and all(c.healthy for c in chips)
     victim = chips[0].uuid
-    real_discover = prov._discover
-    prov._discover = lambda: [c for c in real_discover() if c.uuid != victim]
+    victim_dev = prov._jax_dev[victim]
+    prov._probe_alive = lambda dev: dev is not victim_dev  # wedged runtime
     after = prov.health_check()
     assert [c for c in after if c.uuid == victim][0].healthy is False
     # device set stays pinned (kubelet identity stability)
     assert {c.uuid for c in after} == {c.uuid for c in chips}
-    prov._discover = real_discover
+    del prov.__dict__["_probe_alive"]
     recovered = prov.health_check()
     assert [c for c in recovered if c.uuid == victim][0].healthy is True

@@ -73,6 +73,52 @@ def test_moe_all_tokens_processed():
     np.testing.assert_allclose(np.asarray(out), want, rtol=1e-3, atol=1e-3)
 
 
+def test_moe_multiple_local_experts():
+    """n_experts = 2 × mesh axis size: each shard hosts a contiguous block
+    of two experts; results must still match the dense oracle."""
+    devs = np.array(jax.devices())
+    n_shards = len(devs)
+    n_exp = 2 * n_shards
+    mesh = Mesh(devs, ("ep",))
+    d, h = 8, 16
+    tokens = 4 * n_shards
+    x = jax.random.normal(jax.random.PRNGKey(0), (tokens, d))
+    router_w = jax.random.normal(jax.random.PRNGKey(1), (d, n_exp))
+    w_in = jax.random.normal(jax.random.PRNGKey(2), (n_exp, d, h)) * 0.1
+    w_out = jax.random.normal(jax.random.PRNGKey(3), (n_exp, h, d)) * 0.1
+    out = moe_ffn(x, router_w, w_in, w_out, mesh, axis="ep", capacity=tokens)
+    assert out.shape == x.shape
+
+    logits = np.asarray(x) @ np.asarray(router_w)
+    expert = logits.argmax(-1)
+    gate = np.take_along_axis(
+        np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1)),
+        expert[:, None], 1,
+    )[:, 0]
+    want = np.zeros_like(np.asarray(x))
+    for t in range(tokens):
+        e = expert[t]
+        hdd = np.maximum(np.asarray(x)[t] @ np.asarray(w_in)[e], 0)
+        want[t] = (hdd @ np.asarray(w_out)[e]) * gate[t]
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-3, atol=1e-3)
+
+
+def test_moe_indivisible_experts_rejected():
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("ep",))
+    n_exp = len(devs) + 1  # not a multiple of the axis size
+    d, h = 4, 4
+    with pytest.raises(ValueError, match="not divisible"):
+        moe_ffn(
+            jnp.ones((4 * len(devs), d)),
+            jnp.ones((d, n_exp)),
+            jnp.ones((n_exp, d, h)),
+            jnp.ones((n_exp, h, d)),
+            mesh,
+            axis="ep",
+        )
+
+
 def test_moe_capacity_overflow_drops_to_zero():
     """Tokens past an expert's capacity fall through with a zero update
     (static-shape capacity-factor semantics)."""

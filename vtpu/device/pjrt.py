@@ -31,6 +31,7 @@ class PjrtProvider:
         self._platform = platform or os.environ.get(ENV_PJRT_PLATFORM)
         self._hostname = os.uname().nodename
         self._chips: Optional[List[Chip]] = None
+        self._jax_dev = {}  # uuid → jax device handle, pinned at discovery
 
     def _discover(self) -> List[Chip]:
         try:
@@ -58,16 +59,40 @@ class PjrtProvider:
                 pass
             hbm_bytes = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
             model = f"PJRT-{d.platform}"
+            uuid = f"{model}-{self._hostname}-{d.id}"
+            self._jax_dev[uuid] = d
             chips.append(
                 Chip(
                     index=len(chips),
-                    uuid=f"{model}-{self._hostname}-{d.id}",
+                    uuid=uuid,
                     model=model,
                     hbm_mb=int(hbm_bytes // 2**20) if hbm_bytes else default_mb,
                     coords=None,
                 )
             )
         return chips
+
+    @staticmethod
+    def _probe_alive(dev) -> bool:
+        """Liveness through an actual runtime call, NOT the cached device
+        list — JAX caches the backend process-wide, so a chip that dies
+        after first enumeration still *appears* in jax.local_devices()
+        forever.  memory_stats() is an RPC into the PJRT client and fails
+        on a wedged runtime; devices without stats (cpu) get a tiny
+        round-trip transfer instead."""
+        try:
+            stats = dev.memory_stats()
+            if stats:
+                return True
+        except Exception:  # noqa: BLE001 — wedged runtime surfaces here
+            return False
+        try:
+            import jax  # noqa: PLC0415
+
+            jax.device_put(0, dev).block_until_ready()
+            return True
+        except Exception:  # noqa: BLE001
+            return False
 
     # -- DeviceProvider ----------------------------------------------------
     def enumerate(self) -> List[Chip]:
@@ -83,19 +108,22 @@ class PjrtProvider:
         """Re-probe liveness each poll (DeviceCache contract; the libtpu
         provider re-probes /dev nodes the same way).  The device *set* is
         pinned at first enumeration — kubelet identity must stay stable —
-        but each chip's health is re-derived: a uuid missing from a fresh
-        PJRT enumeration (died/hot-unplugged/runtime wedged) flips
-        unhealthy, and recovers when it reappears (the CNDEV recovery
-        semantics, cambricon.go:188-224)."""
+        but each chip's health is re-derived with a per-device runtime
+        probe (:meth:`_probe_alive`), so a chip that wedges after first
+        enumeration flips unhealthy even though JAX's cached device list
+        still shows it, and recovers when the probe succeeds again (the
+        CNDEV recovery semantics, cambricon.go:188-224)."""
         import dataclasses
 
         base = self.enumerate()
-        alive = {c.uuid for c in self._discover()}
-        out = [
-            dataclasses.replace(c, healthy=(c.uuid in alive))
-            if (c.uuid in alive) != c.healthy
-            else c
-            for c in base
-        ]
+        out = []
+        for c in base:
+            dev = self._jax_dev.get(c.uuid)
+            alive = self._probe_alive(dev) if dev is not None else False
+            out.append(
+                dataclasses.replace(c, healthy=alive)
+                if alive != c.healthy
+                else c
+            )
         self._chips = out
         return list(out)

@@ -1,12 +1,12 @@
 """Expert parallelism: all-to-all token routing over a mesh axis.
 
-A mixture-of-experts FFN sharded the TPU way: each chip holds one (or
-more) experts; a router scores tokens, tokens travel to their expert's
-chip with ONE `all_to_all`, the expert FFN runs as a dense batched matmul
-on the MXU, and a second `all_to_all` brings results home.  Capacity is
-static (XLA needs static shapes): each expert takes at most
-``capacity`` tokens per source shard; overflow tokens fall through with a
-zero update (standard capacity-factor semantics).
+A mixture-of-experts FFN sharded the TPU way: each chip holds one or more
+experts; a router scores tokens, tokens travel to their expert's chip with
+ONE `all_to_all`, the expert FFNs run as dense batched matmuls on the MXU,
+and a second `all_to_all` brings results home.  Capacity is static (XLA
+needs static shapes): each expert takes at most ``capacity`` tokens per
+source shard; overflow tokens fall through with a zero update (standard
+capacity-factor semantics).
 """
 
 from __future__ import annotations
@@ -18,22 +18,31 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
             capacity: int = 0):
-    """x: [batch_shard_tokens, d] sharded on ``axis``; one expert per
-    mesh-axis entry.  router_w: [d, n_experts]; w_in: [n_experts, d, h];
-    w_out: [n_experts, h, d] (expert dims sharded on ``axis``).
-    Returns the combined expert outputs, same sharding as x."""
-    n_exp = mesh.shape[axis]
-    tokens = x.shape[0] // n_exp if x.shape[0] % n_exp == 0 else x.shape[0]
-    del tokens
+    """x: [batch_shard_tokens, d] sharded on ``axis``.  router_w:
+    [d, n_experts]; w_in: [n_experts, d, h]; w_out: [n_experts, h, d]
+    (expert dims sharded on ``axis``).  ``n_experts`` must be a multiple
+    of the mesh axis size; shard ``s`` owns the contiguous expert block
+    ``[s*e_local, (s+1)*e_local)``.  Returns the combined expert outputs,
+    same sharding as x."""
+    n_shards = mesh.shape[axis]
+    n_exp = w_in.shape[0]
+    if n_exp % n_shards != 0:
+        raise ValueError(
+            f"n_experts={n_exp} not divisible by mesh axis "
+            f"'{axis}' size {n_shards}"
+        )
+    if router_w.shape[-1] != n_exp:
+        raise ValueError(
+            f"router_w maps to {router_w.shape[-1]} experts, weights have {n_exp}"
+        )
+    e_local = n_exp // n_shards
     if capacity <= 0:
         capacity = max(1, x.shape[0] // n_exp)
 
     def shard_fn(x_s, rw, wi, wo):
-        # local expert weights: [1, d, h] → [d, h]
-        wi = jnp.squeeze(wi, axis=0)
-        wo = jnp.squeeze(wo, axis=0)
+        # local expert weights: [e_local, d, h] / [e_local, h, d]
         t, d = x_s.shape
-        # route: top-1 expert per token
+        # route: top-1 expert per token (global expert id)
         logits = x_s @ rw                              # [t, n_exp]
         expert = jnp.argmax(logits, axis=-1)           # [t]
         gate = jax.nn.softmax(logits, axis=-1)
@@ -50,16 +59,22 @@ def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
         send = send.at[idx_e, idx_p].add(
             jnp.where(keep[:, None], x_s, 0.0)
         )
-        # all-to-all: [n_exp, capacity, d] → gather my expert's tokens
-        # from every source shard: [n_src=n_exp, capacity, d]
+        # group the contiguous e_local experts of each destination shard,
+        # then all-to-all: recv[s] = this shard's expert block from source s
+        send = send.reshape(n_shards, e_local * capacity, d)
         recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
-                                  tiled=True)
-        # dense expert FFN on the MXU
-        h = jax.nn.relu(recv.reshape(-1, d) @ wi)
-        y = (h @ wo).reshape(n_exp, capacity, d)
-        # route results back
+                                  tiled=True)  # [n_src, e_local*capacity, d]
+        # dense expert FFNs on the MXU: batch over the local expert dim
+        recv = recv.reshape(n_shards, e_local, capacity, d)
+        recv = recv.transpose(1, 0, 2, 3).reshape(e_local, -1, d)
+        h = jax.nn.relu(jnp.einsum("ltd,ldh->lth", recv, wi))
+        y = jnp.einsum("lth,lhd->ltd", h, wo)          # [e_local, n_src*cap, d]
+        # route results back (inverse of the forward grouping)
+        y = y.reshape(e_local, n_shards, capacity, d).transpose(1, 0, 2, 3)
+        y = y.reshape(n_shards, e_local * capacity, d)
         back = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0,
-                                  tiled=True)  # [n_exp, capacity, d]
+                                  tiled=True)
+        back = back.reshape(n_exp, capacity, d)
         # gather each token's result from its (expert, pos) slot
         out = back[idx_e, idx_p]
         out = jnp.where(keep[:, None], out * gate[:, None], 0.0)

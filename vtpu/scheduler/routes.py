@@ -75,6 +75,10 @@ def bind_handler(sched: Scheduler, args: dict) -> dict:
 
 class _Handler(BaseHTTPRequestHandler):
     scheduler: Scheduler  # injected via serve()
+    # debug endpoints (/spans) are served only on the plain in-cluster
+    # listener — the TLS webhook port is exposed cluster-wide via the
+    # Service, and pod/node names + scheduling timings must not leak there
+    allow_debug: bool = True
 
     def _send(self, code: int, body: bytes, ctype: str = "application/json") -> None:
         self.send_response(code)
@@ -93,7 +97,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 — http.server API
         if self.path == "/healthz":
             self._send(200, b"ok", "text/plain")
-        elif self.path == "/spans":
+        elif self.path == "/spans" and self.allow_debug:
             from vtpu.utils import trace
 
             try:
@@ -151,7 +155,11 @@ def serve(
     if bool(cert_file) != bool(key_file):
         raise ValueError("TLS needs both cert_file and key_file (got one)")
     host, _, port = (bind or sched.config.http_bind).rpartition(":")
-    handler = type("BoundHandler", (_Handler,), {"scheduler": sched})
+    handler = type(
+        "BoundHandler",
+        (_Handler,),
+        {"scheduler": sched, "allow_debug": not (cert_file and key_file)},
+    )
     srv = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler)
     if cert_file and key_file:
         import ssl

@@ -96,6 +96,15 @@ class ShimRuntime:
         self._placements: Dict[int, list] = {}
         # pacing estimate for dispatch() (seconds per step)
         self._last_step_s = 0.0
+        # closed-loop calibration: every N dispatches, drain the pipeline
+        # and time ONE synchronous step — the TRUE device-resident step
+        # time (JAX dispatch is async — enqueue latency alone collapses
+        # toward 0 and would make core-percentage pacing a no-op)
+        self._sync_every = max(
+            1, int(os.environ.get("VTPU_PACE_SYNC_EVERY", "8") or 8)
+        )
+        self._since_sync = 0
+        self._pace_state = "warmup"  # warmup → calibrate → run
 
     # ------------------------------------------------------------------
     def limit_for(self, dev: int) -> int:
@@ -231,27 +240,72 @@ class ShimRuntime:
         pipelined serving-loop variant of :meth:`throttled`.  Records the
         kernel launch in the shared region (the utilization-watcher
         counter the monitor's feedback arbiter decays) and applies
-        core-percentage pacing as a dispatch-rate limit using the
-        observed steady-state step time; callers retire results
-        themselves (jax.block_until_ready)."""
+        core-percentage pacing as a dispatch-rate limit.
+
+        The pacing estimate is CLOSED-LOOP: JAX dispatch is asynchronous,
+        so enqueue latency says nothing about device time.  While a core
+        limit is active, every ``VTPU_PACE_SYNC_EVERY``-th step drains the
+        pipeline (blocks on its own result), and the step AFTER the drain
+        runs synchronously against an empty queue — its wall time is the
+        true device-resident step time T.  Sleeping T×(100−q)/q between
+        subsequent launches then holds the device duty cycle at q%
+        regardless of how deep the caller pipelines.  ``observe_step``
+        remains as an explicit override for callers that measure
+        retirement themselves."""
         if self.region is not None:
             self.region.incr_recent_kernel()
             suspended = self.region.region.utilization_switch == 1
         else:
             suspended = False
         q = self.core_limit
-        if 0 < q < 100 and not suspended and self._last_step_s > 0:
+        if not (0 < q < 100) or suspended:
+            return fn(*args, **kwargs)
+        if self._pace_state == "warmup":
+            # first paced step: retire it but DISCARD the timing — it
+            # includes jit compilation — then calibrate on the next step
+            out = fn(*args, **kwargs)
+            self._retire(out)
+            self._pace_state = "calibrate"
+            return out
+        if self._pace_state == "calibrate":
+            # queue is empty (previous step was retired synchronously):
+            # one synchronous step = enqueue + device + sync, the real T
+            t0 = time.monotonic()
+            out = fn(*args, **kwargs)
+            self._retire(out)
+            self._last_step_s = time.monotonic() - t0
+            self._pace_state = "run"
+            self._since_sync = 0
+            return out
+        if self._last_step_s > 0:
             time.sleep(self._last_step_s * (100 - q) / q)
-        t0 = time.monotonic()
         out = fn(*args, **kwargs)
-        # EMA of dispatch time as the step-time estimate: converges down
-        # after a one-off spike (first-call compile) instead of ratcheting;
-        # observe_step() refines it with real retirement timing
-        obs = time.monotonic() - t0
-        self._last_step_s = (
-            obs if self._last_step_s == 0 else 0.8 * self._last_step_s + 0.2 * obs
-        )
+        self._since_sync += 1
+        if self._since_sync >= self._sync_every:
+            # drain so the next step can re-calibrate against an idle queue
+            self._retire(out)
+            self._pace_state = "calibrate"
         return out
+
+    @staticmethod
+    def _retire(out) -> None:
+        """Block until `out` is complete.  Prefers the object's own
+        block_until_ready (covers fakes in tests and non-Array results
+        with completion semantics), falling back to jax.block_until_ready
+        for pytrees."""
+        bur = getattr(out, "block_until_ready", None)
+        if callable(bur):
+            try:
+                bur()
+                return
+            except Exception:  # noqa: BLE001 — completion errors ≠ pacing errors
+                return
+        try:
+            import jax
+
+            jax.block_until_ready(out)
+        except Exception:  # noqa: BLE001 — non-jax return values
+            pass
 
     def observe_step(self, seconds: float) -> None:
         """Feed the measured per-step device time back into dispatch()'s
