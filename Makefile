@@ -29,7 +29,19 @@ test-native: shim
 	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
 	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/shim.cache \
 	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
-	  ./build/test_shim && rm -rf /tmp/vtpu-make-test
+	  ./build/test_shim
+	cd cpp && TPU_DEVICE_MEMORY_LIMIT_0=64 VTPU_OVERSUBSCRIBE=true \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/swap.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
+	  ./build/test_shim build/libvtpu_shim.so swap
+	cd cpp && TPU_DEVICE_MEMORY_LIMIT_0=64 VTPU_ACTIVE_OOM_KILLER=true \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/oom.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
+	  sh -c './build/test_shim build/libvtpu_shim.so oomkill; test $$? -eq 137' \
+	  && echo "ok - ACTIVE_OOM_KILLER killed the over-quota tenant (137)" \
+	  && rm -rf /tmp/vtpu-make-test
 
 bench:
 	$(PY) bench.py

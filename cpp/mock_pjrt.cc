@@ -25,8 +25,19 @@ struct MockError {
   PJRT_Error_Code code;
 };
 
+struct MockMemory {
+  std::string kind;
+};
+
 struct MockDevice {
   int index;
+  MockMemory mem_device{"device"};
+  MockMemory mem_host{"pinned_host"};
+  PJRT_Memory* memories[2];
+  MockDevice(int i) : index(i) {
+    memories[0] = reinterpret_cast<PJRT_Memory*>(&mem_device);
+    memories[1] = reinterpret_cast<PJRT_Memory*>(&mem_host);
+  }
 };
 
 struct MockClient {
@@ -36,6 +47,7 @@ struct MockClient {
 struct MockBuffer {
   uint64_t size;
   MockDevice* device;
+  MockMemory* memory; /* where it landed (null = device default) */
 };
 
 struct MockExecutable {
@@ -115,11 +127,54 @@ PJRT_Error* buffer_from_host(PJRT_Client_BufferFromHostBuffer_Args* a) {
   uint64_t n = 1;
   for (size_t i = 0; i < a->num_dims; i++) n *= (uint64_t)a->dims[i];
   auto* b = new MockBuffer{n * dtype_bytes(a->type),
-                           reinterpret_cast<MockDevice*>(a->device)};
+                           reinterpret_cast<MockDevice*>(a->device),
+                           reinterpret_cast<MockMemory*>(a->memory)};
   a->buffer = reinterpret_cast<PJRT_Buffer*>(b);
   /* done_with_host_buffer event: callers in tests pass nullptr-tolerant
    * paths; leave null. */
   a->done_with_host_buffer = nullptr;
+  return nullptr;
+}
+
+PJRT_Error* device_memories(PJRT_Device_AddressableMemories_Args* a) {
+  auto* d = reinterpret_cast<MockDevice*>(a->device);
+  a->memories = d->memories;
+  a->num_memories = 2;
+  return nullptr;
+}
+
+PJRT_Error* memory_kind(PJRT_Memory_Kind_Args* a) {
+  auto* m = reinterpret_cast<MockMemory*>(a->memory);
+  a->kind = m->kind.c_str();
+  a->kind_size = m->kind.size();
+  return nullptr;
+}
+
+/* events: the mock's execute is synchronous, so a buffer's ready event
+ * is always already ready — OnReady fires the callback inline */
+struct MockEvent {
+  int ready = 1;
+};
+
+PJRT_Error* buffer_ready_event(PJRT_Buffer_ReadyEvent_Args* a) {
+  a->event = reinterpret_cast<PJRT_Event*>(new MockEvent());
+  return nullptr;
+}
+
+PJRT_Error* event_on_ready(PJRT_Event_OnReady_Args* a) {
+  a->callback(nullptr, a->user_arg);
+  return nullptr;
+}
+
+PJRT_Error* event_destroy(PJRT_Event_Destroy_Args* a) {
+  delete reinterpret_cast<MockEvent*>(a->event);
+  return nullptr;
+}
+
+PJRT_Error* buffer_memory(PJRT_Buffer_Memory_Args* a) {
+  auto* b = reinterpret_cast<MockBuffer*>(a->buffer);
+  a->memory = reinterpret_cast<PJRT_Memory*>(
+      b->memory ? b->memory : (b->device ? &b->device->mem_device : nullptr));
   return nullptr;
 }
 
@@ -187,6 +242,9 @@ PJRT_Error* loaded_destroy(PJRT_LoadedExecutable_Destroy_Args* a) {
 }
 
 PJRT_Error* loaded_execute(PJRT_LoadedExecutable_Execute_Args* a) {
+  if (env_int("MOCK_PJRT_EXEC_FAIL", 0))
+    return reinterpret_cast<PJRT_Error*>(
+        new MockError{"mock: induced device failure", PJRT_Error_Code_INTERNAL});
   long us = env_int("MOCK_PJRT_EXEC_US", 1000);
   struct timespec ts = {us / 1000000L, (us % 1000000L) * 1000L};
   nanosleep(&ts, nullptr);
@@ -197,7 +255,7 @@ PJRT_Error* loaded_execute(PJRT_LoadedExecutable_Execute_Args* a) {
       if (!a->output_lists[d]) continue;
       for (int i = 0; i < e->num_outputs; i++)
         a->output_lists[d][i] = reinterpret_cast<PJRT_Buffer*>(
-            new MockBuffer{e->out_bytes, nullptr});
+            new MockBuffer{e->out_bytes, nullptr, nullptr});
     }
   }
   return nullptr;
@@ -226,6 +284,12 @@ extern "C" const PJRT_Api* GetPjrtApi() {
   g_mock_api.PJRT_Client_Destroy = client_destroy;
   g_mock_api.PJRT_Client_AddressableDevices = client_devices;
   g_mock_api.PJRT_Client_BufferFromHostBuffer = buffer_from_host;
+  g_mock_api.PJRT_Device_AddressableMemories = device_memories;
+  g_mock_api.PJRT_Memory_Kind = memory_kind;
+  g_mock_api.PJRT_Buffer_Memory = buffer_memory;
+  g_mock_api.PJRT_Buffer_ReadyEvent = buffer_ready_event;
+  g_mock_api.PJRT_Event_OnReady = event_on_ready;
+  g_mock_api.PJRT_Event_Destroy = event_destroy;
   g_mock_api.PJRT_Buffer_OnDeviceSizeInBytes = buffer_size;
   g_mock_api.PJRT_Buffer_Destroy = buffer_destroy;
   g_mock_api.PJRT_Client_Compile = client_compile;

@@ -4,7 +4,7 @@
  *
  * Usage:
  *   region_tool init   <path> <uuid:limit_mb:cores> [...]
- *   region_tool add    <path> <pid> <dev> <kind:buffer|program> <bytes> [--oversubscribe]
+ *   region_tool add    <path> <pid> <dev> <kind:buffer|program|swap> <bytes> [--oversubscribe]
  *   region_tool sub    <path> <pid> <dev> <kind> <bytes>
  *   region_tool reap   <path>
  *   region_tool dump   <path>          # JSON to stdout
@@ -50,7 +50,11 @@ static int cmd_init(const char* path, int argc, char** argv) {
   return 0;
 }
 
-static int kind_of(const char* s) { return strcmp(s, "program") == 0 ? 1 : 0; }
+static int kind_of(const char* s) {
+  if (strcmp(s, "program") == 0) return 1;
+  if (strcmp(s, "swap") == 0) return 2;
+  return 0;
+}
 
 static int cmd_dump(const char* path) {
   vtpu_shared_region* r = vtpu_region_open(path);
@@ -80,9 +84,10 @@ static int cmd_dump(const char* path) {
            r->procs[p].pid, r->procs[p].priority);
     for (int i = 0; i < r->num_devices; i++) {
       printf("%s{\"buffer\":%" PRIu64 ",\"program\":%" PRIu64
-             ",\"total\":%" PRIu64 "}",
+             ",\"swap\":%" PRIu64 ",\"total\":%" PRIu64 "}",
              i ? "," : "", r->procs[p].used[i].buffer_bytes,
              r->procs[p].used[i].program_bytes,
+             r->procs[p].used[i].swap_bytes,
              r->procs[p].used[i].total_bytes);
     }
     printf("]}");

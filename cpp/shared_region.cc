@@ -213,6 +213,18 @@ uint64_t vtpu_region_device_usage(vtpu_shared_region* r, int dev) {
   return v;
 }
 
+void vtpu_region_exec_result(vtpu_shared_region* r, int ok) {
+  if (!r) return;
+  if (ok) {
+    /* atomic clear — a plain store could lose against concurrent
+     * failure increments from other dispatch threads */
+    __sync_fetch_and_and(&r->error_streak, 0);
+  } else {
+    __sync_fetch_and_add(&r->error_streak, 1);
+    __sync_fetch_and_add(&r->exec_errors, 1);
+  }
+}
+
 int vtpu_region_try_add(vtpu_shared_region* r, int32_t pid, int dev, int kind,
                         uint64_t bytes, int oversubscribe) {
   if (dev < 0 || dev >= VTPU_MAX_DEVICES) return -1;
@@ -220,7 +232,7 @@ int vtpu_region_try_add(vtpu_shared_region* r, int32_t pid, int dev, int kind,
   if (slot < 0) return -1;
   vtpu_region_lock(r);
   uint64_t limit = r->limit_bytes[dev];
-  if (!oversubscribe && limit > 0 &&
+  if (kind != 2 && !oversubscribe && limit > 0 &&
       device_usage_nolock(r, dev) + bytes > limit) {
     vtpu_region_unlock(r); /* check_oom: reject (ref add_gpu_device_memory_usage) */
     return -1;
@@ -228,6 +240,8 @@ int vtpu_region_try_add(vtpu_shared_region* r, int32_t pid, int dev, int kind,
   vtpu_device_usage* u = &r->procs[slot].used[dev];
   if (kind == 1)
     u->program_bytes += bytes;
+  else if (kind == 2)
+    u->swap_bytes += bytes; /* host tier: unlimited by the device quota */
   else
     u->buffer_bytes += bytes;
   u->total_bytes = u->program_bytes + u->buffer_bytes;
@@ -242,7 +256,9 @@ void vtpu_region_sub(vtpu_shared_region* r, int32_t pid, int dev, int kind,
   for (int i = 0; i < VTPU_MAX_PROCS; i++) {
     if (r->procs[i].status == 1 && r->procs[i].pid == pid) {
       vtpu_device_usage* u = &r->procs[i].used[dev];
-      uint64_t* field = (kind == 1) ? &u->program_bytes : &u->buffer_bytes;
+      uint64_t* field = (kind == 1)   ? &u->program_bytes
+                        : (kind == 2) ? &u->swap_bytes
+                                      : &u->buffer_bytes;
       *field = (*field >= bytes) ? *field - bytes : 0;
       u->total_bytes = u->program_bytes + u->buffer_bytes;
       break;

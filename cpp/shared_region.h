@@ -23,7 +23,7 @@ extern "C" {
 #endif
 
 #define VTPU_REGION_MAGIC 0x76545055u /* "vTPU" */
-#define VTPU_REGION_VERSION 1
+#define VTPU_REGION_VERSION 2
 #define VTPU_MAX_DEVICES 16
 #define VTPU_MAX_PROCS 64
 #define VTPU_UUID_LEN 64
@@ -34,6 +34,11 @@ typedef struct vtpu_device_usage {
   uint64_t program_bytes; /* compiled executables resident in HBM */
   uint64_t buffer_bytes;  /* live device buffers */
   uint64_t total_bytes;   /* program + buffer (denormalised for readers) */
+  uint64_t swap_bytes;    /* buffers offloaded to the HOST tier past quota
+                             (oversubscribe — ref CUDA_OVERSUBSCRIBE's
+                             host-RAM swap, README.md:236-240); NOT part
+                             of total_bytes: swap never counts against the
+                             device HBM quota */
 } vtpu_device_usage;
 
 typedef struct vtpu_proc_slot {
@@ -57,6 +62,14 @@ typedef struct vtpu_shared_region {
                                  1 suspend (priority arbitration,
                                  ref feedback.go CheckPriority) */
   int32_t recent_kernel; /* decayed activity counter (ref Observe) */
+  /* device-error telemetry written by the shim's execute path — the
+   * TPU-native analog of the XID critical-event stream
+   * (nvidia.go:173-244): consecutive device-side execute failures with
+   * no intervening success.  The device plugin's health probe flips a
+   * chip Unhealthy when any tenant's streak crosses its threshold and
+   * recovers when a success resets it. */
+  int32_t error_streak; /* consecutive execute errors (0 on success) */
+  int32_t exec_errors;  /* cumulative execute errors (observability) */
   char uuids[VTPU_MAX_DEVICES][VTPU_UUID_LEN];
   uint64_t limit_bytes[VTPU_MAX_DEVICES];   /* HBM quota per device */
   int32_t core_limit[VTPU_MAX_DEVICES];     /* percent per device */
@@ -93,15 +106,20 @@ void vtpu_region_unregister_proc(vtpu_shared_region* r, int32_t pid);
 void vtpu_region_reap_dead(vtpu_shared_region* r);
 
 /* ---- accounting ---- */
-/* attempt to add `bytes` of `kind` (0=buffer, 1=program) for pid on device
- * dev; returns 0 on success, -1 if it would exceed limit_bytes[dev]
- * (the check_oom analog). Oversubscribe mode skips the reject. */
+/* attempt to add `bytes` of `kind` (0=buffer, 1=program, 2=host-swap) for
+ * pid on device dev; returns 0 on success, -1 if it would exceed
+ * limit_bytes[dev] (the check_oom analog). Oversubscribe mode skips the
+ * reject; kind 2 is the host tier and never checks the device quota. */
 int vtpu_region_try_add(vtpu_shared_region* r, int32_t pid, int dev, int kind,
                         uint64_t bytes, int oversubscribe);
 void vtpu_region_sub(vtpu_shared_region* r, int32_t pid, int dev, int kind,
                      uint64_t bytes);
 /* total usage across procs for device dev (ref get_gpu_memory_usage). */
 uint64_t vtpu_region_device_usage(vtpu_shared_region* r, int dev);
+
+/* record an execute outcome: ok=1 resets the error streak, ok=0 bumps
+ * streak + cumulative count (the XID-analog health feed). */
+void vtpu_region_exec_result(vtpu_shared_region* r, int ok);
 
 #ifdef __cplusplus
 }

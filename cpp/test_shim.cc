@@ -53,6 +53,126 @@ static void destroy_error(PJRT_Error* e) {
   api->PJRT_Error_Destroy(&d);
 }
 
+static const char* buffer_kind(PJRT_Buffer* b) {
+  PJRT_Buffer_Memory_Args ba;
+  memset(&ba, 0, sizeof(ba));
+  ba.struct_size = PJRT_Buffer_Memory_Args_STRUCT_SIZE;
+  ba.buffer = b;
+  if (api->PJRT_Buffer_Memory(&ba) != nullptr || !ba.memory) return "";
+  PJRT_Memory_Kind_Args ka;
+  memset(&ka, 0, sizeof(ka));
+  ka.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
+  ka.memory = ba.memory;
+  if (api->PJRT_Memory_Kind(&ka) != nullptr) return "";
+  return ka.kind;
+}
+
+/* oversubscribe mode (VTPU_OVERSUBSCRIBE=true in the env): over-quota
+ * allocations land in the HOST memory space — the swap tier — instead of
+ * being force-admitted to the device (ref virtual device memory,
+ * README.md:236-240) */
+static int run_swap_mode() {
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == nullptr, "client create (swap)");
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = ca.client;
+  CHECK(api->PJRT_Client_AddressableDevices(&da) == nullptr, "devices (swap)");
+  PJRT_Device* dev0 = da.addressable_devices[0];
+
+  PJRT_Error* err = nullptr;
+  PJRT_Buffer* b1 = make_buffer(ca.client, dev0, 40, &err);
+  CHECK(err == nullptr && b1 != nullptr, "under-quota buffer allowed (swap)");
+  CHECK(strcmp(buffer_kind(b1), "device") == 0,
+        "under-quota buffer stays on device");
+
+  PJRT_Buffer* b2 = make_buffer(ca.client, dev0, 40, &err);
+  CHECK(err == nullptr && b2 != nullptr,
+        "over-quota buffer admitted under oversubscribe");
+  CHECK(strcmp(buffer_kind(b2), "pinned_host") == 0,
+        "over-quota buffer offloaded to the host tier");
+
+  /* device usage must NOT include the host-tier buffer */
+  PJRT_Device_MemoryStats_Args ms;
+  memset(&ms, 0, sizeof(ms));
+  ms.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms.device = dev0;
+  CHECK(api->PJRT_Device_MemoryStats(&ms) == nullptr, "memory stats (swap)");
+  CHECK(ms.bytes_in_use == 40LL * 1024 * 1024,
+        "host-tier bytes not counted against the device quota");
+
+  /* destroying the host-tier buffer releases swap accounting cleanly */
+  PJRT_Buffer_Destroy_Args bd;
+  memset(&bd, 0, sizeof(bd));
+  bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  bd.buffer = b2;
+  CHECK(api->PJRT_Buffer_Destroy(&bd) == nullptr, "destroy host-tier buffer");
+  PJRT_Buffer* b3 = make_buffer(ca.client, dev0, 20, &err);
+  CHECK(err == nullptr && strcmp(buffer_kind(b3), "device") == 0,
+        "device headroom still usable after swap release");
+  printf("all swap-mode tests passed\n");
+  return 0;
+}
+
+/* ACTIVE_OOM_KILLER mode (VTPU_ACTIVE_OOM_KILLER=true in the env): the
+ * over-quota allocation must KILL this process (SIGKILL) instead of
+ * returning an error — the runner asserts the 137 exit. */
+static int run_oomkill_mode() {
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == nullptr, "client create (oomkill)");
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = ca.client;
+  CHECK(api->PJRT_Client_AddressableDevices(&da) == nullptr,
+        "devices (oomkill)");
+  PJRT_Error* err = nullptr;
+  make_buffer(ca.client, da.addressable_devices[0], 40, &err);
+  CHECK(err == nullptr, "under-quota buffer allowed (oomkill)");
+  make_buffer(ca.client, da.addressable_devices[0], 40, &err);
+  /* unreachable when the killer works */
+  printf("not ok - process survived an over-quota allocation\n");
+  return 1;
+}
+
+/* execute-error telemetry mode: run executes with MOCK_PJRT_EXEC_FAIL
+ * toggled so the region's error_streak/exec_errors fields (the XID-analog
+ * health feed) can be inspected by the pytest driver. */
+static int run_execfail_mode() {
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == nullptr, "client create (execfail)");
+  PJRT_Client_Compile_Args cc;
+  memset(&cc, 0, sizeof(cc));
+  cc.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  cc.client = ca.client;
+  CHECK(api->PJRT_Client_Compile(&cc) == nullptr, "compile (execfail)");
+  PJRT_LoadedExecutable_Execute_Args ea;
+  memset(&ea, 0, sizeof(ea));
+  ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea.executable = cc.executable;
+  setenv("MOCK_PJRT_EXEC_FAIL", "1", 1);
+  for (int i = 0; i < 4; i++) {
+    PJRT_Error* e = api->PJRT_LoadedExecutable_Execute(&ea);
+    CHECK(e != nullptr, "induced execute failure surfaces");
+    destroy_error(e);
+  }
+  /* optional recovery leg: one success resets the streak */
+  if (getenv("TEST_SHIM_RECOVER")) {
+    setenv("MOCK_PJRT_EXEC_FAIL", "0", 1);
+    CHECK(api->PJRT_LoadedExecutable_Execute(&ea) == nullptr,
+          "execute recovers");
+  }
+  printf("all execfail-mode tests passed\n");
+  return 0;
+}
+
 int main(int argc, char** argv) {
   const char* shim = argc > 1 ? argv[1] : "build/libvtpu_shim.so";
   void* h = dlopen(shim, RTLD_NOW);
@@ -64,6 +184,9 @@ int main(int argc, char** argv) {
   CHECK(get != nullptr, "shim exports GetPjrtApi");
   api = get();
   CHECK(api != nullptr, "GetPjrtApi returns table");
+  if (argc > 2 && strcmp(argv[2], "swap") == 0) return run_swap_mode();
+  if (argc > 2 && strcmp(argv[2], "oomkill") == 0) return run_oomkill_mode();
+  if (argc > 2 && strcmp(argv[2], "execfail") == 0) return run_execfail_mode();
 
   PJRT_Client_Create_Args ca;
   memset(&ca, 0, sizeof(ca));

@@ -35,6 +35,7 @@
  */
 #include <dlfcn.h>
 #include <pthread.h>
+#include <signal.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
@@ -44,6 +45,7 @@
 #include <unistd.h>
 
 #include <cstdint>
+#include <string>
 #include <unordered_map>
 
 #include "pjrt_c_api.h"
@@ -60,6 +62,8 @@ struct ShimConfig {
   int oversubscribe = 0;
   int priority = 0;
   int core_policy_disable = 0;
+  int active_oom_killer = 0; /* kill the tenant on quota reject (ref
+                                ACTIVE_OOM_KILLER, docs/config.md) */
   const char* region_path = nullptr;
   const char* real_plugin = nullptr;
   const char* env_prefix = "TPU"; /* "TPU" | "PJRT" (VTPU_SHIM_FAMILY) */
@@ -80,14 +84,19 @@ std::unordered_map<void*, size_t> g_num_outputs;
  * events and invalidate donated inputs). */
 std::unordered_map<void*, uint64_t> g_out_bytes;
 
-/* buffer/executable → accounted bytes (+device index for buffers) */
+/* buffer/executable → accounted bytes (+device index, accounting kind:
+ * 0 = device buffer, 1 = program, 2 = host-swap tier) */
 struct Acct {
   uint64_t bytes;
   int dev;
+  int kind;
 };
 std::unordered_map<void*, Acct> g_buffers;
 std::unordered_map<void*, Acct> g_programs;
 std::unordered_map<void*, int> g_device_index; /* PJRT_Device* → local idx */
+/* per-device host memory space (pinned_host) for the oversubscribe swap
+ * tier; null when the plugin exposes none */
+PJRT_Memory* g_host_mem[VTPU_MAX_DEVICES] = {nullptr};
 
 void load_config() {
   /* family-scoped env namespace: primary family is TPU_*, the second
@@ -117,6 +126,8 @@ void load_config() {
   if (c) g_cfg.core_limit = atoi(c);
   const char* o = getenv("VTPU_OVERSUBSCRIBE");
   g_cfg.oversubscribe = (o && strcmp(o, "true") == 0);
+  const char* ok = getenv("VTPU_ACTIVE_OOM_KILLER");
+  g_cfg.active_oom_killer = (ok && strcmp(ok, "true") == 0);
   snprintf(key, sizeof(key), "%s_TASK_PRIORITY", pfx);
   const char* p = getenv(key);
   if (!p) p = getenv("TPU_TASK_PRIORITY");
@@ -149,6 +160,21 @@ PJRT_Error* make_error(PJRT_Error_Code code, const char* msg) {
   snprintf(e->msg, sizeof(e->msg), "%s", msg);
   e->code = code;
   return reinterpret_cast<PJRT_Error*>(e);
+}
+
+/* the reject exit for quota violations: with VTPU_ACTIVE_OOM_KILLER the
+ * tenant is terminated instead of handed an error it may ignore and
+ * retry forever (ref libvgpu.so's ACTIVE_OOM_KILLER, docs/config.md
+ * container envs).  SIGKILL, not exit(): the tenant may be mid-JAX with
+ * arbitrary threads — the same choice the reference makes. */
+PJRT_Error* quota_reject(const char* msg) {
+  if (g_cfg.active_oom_killer) {
+    fprintf(stderr, "vtpu_shim: ACTIVE_OOM_KILLER: %s — killing pid %d\n",
+            msg, (int)getpid());
+    fflush(stderr);
+    kill(getpid(), SIGKILL);
+  }
+  return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED, msg);
 }
 
 bool is_ours(const PJRT_Error* err) {
@@ -247,9 +273,21 @@ int account_buffer_idx(PJRT_Buffer* buf, int dev) {
                           g_cfg.oversubscribe) != 0)
     return -1;
   pthread_mutex_lock(&g_mu);
-  g_buffers[buf] = {sz, dev};
+  g_buffers[buf] = {sz, dev, 0};
   pthread_mutex_unlock(&g_mu);
   return 0;
+}
+
+/* account a buffer that was placed in the HOST memory space (the
+ * oversubscribe swap tier): kind 2, never limited by the device quota */
+void account_buffer_idx_swap(PJRT_Buffer* buf, int dev) {
+  if (!buf || !g_region) return;
+  uint64_t sz = buffer_size(buf);
+  if (sz == 0) return;
+  vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/2, sz, 1);
+  pthread_mutex_lock(&g_mu);
+  g_buffers[buf] = {sz, dev, 2};
+  pthread_mutex_unlock(&g_mu);
 }
 
 int account_buffer(PJRT_Buffer* buf, PJRT_Device* dev_hint) {
@@ -264,13 +302,14 @@ void account_buffer_idx_forced(PJRT_Buffer* buf, int dev) {
   if (sz == 0) return;
   vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0, sz, 1);
   pthread_mutex_lock(&g_mu);
-  g_buffers[buf] = {sz, dev};
+  g_buffers[buf] = {sz, dev, 0};
   pthread_mutex_unlock(&g_mu);
 }
 
-/* pre-flight quota check for a known size (the reject path) */
+/* pre-flight headroom check for a known size (the reject path); pure
+ * check — oversubscribe policy is decided at the call sites */
 bool quota_allows(int dev, uint64_t want) {
-  if (g_cfg.oversubscribe || !g_region) return true;
+  if (!g_region) return true;
   uint64_t limit = g_region->limit_bytes[dev];
   if (limit == 0) return true;
   return vtpu_region_device_usage(g_region, dev) + want <= limit;
@@ -333,7 +372,8 @@ PJRT_Error* wrap_Client_Create(PJRT_Client_Create_Args* args) {
     vtpu_region_set_devices(g_region, n, uuids, limits, cores);
     vtpu_region_register_proc(g_region, (int32_t)getpid(), g_cfg.priority);
   }
-  /* build PJRT_Device* → local index map */
+  /* build PJRT_Device* → local index map + discover each device's host
+   * memory space (the oversubscribe swap tier target) */
   PJRT_Client_AddressableDevices_Args da;
   memset(&da, 0, sizeof(da));
   da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
@@ -343,6 +383,30 @@ PJRT_Error* wrap_Client_Create(PJRT_Client_Create_Args* args) {
     for (size_t i = 0; i < da.num_addressable_devices; i++)
       g_device_index[da.addressable_devices[i]] = (int)i;
     pthread_mutex_unlock(&g_mu);
+    if (g_real->PJRT_Device_AddressableMemories && g_real->PJRT_Memory_Kind) {
+      for (size_t i = 0;
+           i < da.num_addressable_devices && i < VTPU_MAX_DEVICES; i++) {
+        PJRT_Device_AddressableMemories_Args ma;
+        memset(&ma, 0, sizeof(ma));
+        ma.struct_size = PJRT_Device_AddressableMemories_Args_STRUCT_SIZE;
+        ma.device = da.addressable_devices[i];
+        if (g_real->PJRT_Device_AddressableMemories(&ma) != nullptr) continue;
+        for (size_t m = 0; m < ma.num_memories; m++) {
+          PJRT_Memory_Kind_Args ka;
+          memset(&ka, 0, sizeof(ka));
+          ka.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
+          ka.memory = ma.memories[m];
+          if (g_real->PJRT_Memory_Kind(&ka) != nullptr || !ka.kind) continue;
+          /* "pinned_host" (TPU/GPU) or anything *host*; first match wins,
+           * pinned preferred (DMA-able without a staging copy) */
+          std::string kind(ka.kind, ka.kind_size);
+          bool is_host = kind.find("host") != std::string::npos;
+          bool is_pinned = kind.find("pinned") != std::string::npos;
+          if (is_host && (is_pinned || g_host_mem[i] == nullptr))
+            g_host_mem[i] = ma.memories[m];
+        }
+      }
+    }
   }
   return nullptr;
 }
@@ -351,7 +415,14 @@ PJRT_Error* wrap_BufferFromHostBuffer(
     PJRT_Client_BufferFromHostBuffer_Args* args) {
   /* pre-check with the exact host-side size where the dtype is sizable
    * (device layout may pad; the post-hoc account uses the true on-device
-   * size and is authoritative) */
+   * size and is authoritative).  Over quota:
+   *   - oversubscribe + host memory space → place the buffer in HOST
+   *     memory instead (the swap tier: XLA streams it to the chip on
+   *     demand — the virtual-device-memory behavior, ref
+   *     README.md:236-240), accounted as kind 2;
+   *   - oversubscribe, no host space exposed → force-admit (legacy);
+   *   - otherwise → RESOURCE_EXHAUSTED (check_oom). */
+  bool host_placed = false;
   if (g_region) {
     uint64_t width = dtype_width(args->type);
     if (width > 0) {
@@ -359,18 +430,27 @@ PJRT_Error* wrap_BufferFromHostBuffer(
       uint64_t want = width;
       for (size_t i = 0; i < args->num_dims; i++)
         want *= (uint64_t)args->dims[i];
-      if (!quota_allows(dev, want))
-        return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
-                          "vtpu: HBM quota exceeded (BufferFromHostBuffer)");
+      if (!quota_allows(dev, want)) {
+        if (g_cfg.oversubscribe && args->memory == nullptr &&
+            dev < VTPU_MAX_DEVICES && g_host_mem[dev] != nullptr) {
+          args->memory = g_host_mem[dev];
+          host_placed = true;
+        } else if (!g_cfg.oversubscribe) {
+          return quota_reject("vtpu: HBM quota exceeded (BufferFromHostBuffer)");
+        }
+      }
     }
   }
   PJRT_Error* err = g_real->PJRT_Client_BufferFromHostBuffer(args);
   if (err) return err;
+  if (host_placed) {
+    account_buffer_idx_swap(args->buffer, device_index(args->device));
+    return nullptr;
+  }
   if (account_buffer(args->buffer, args->device) != 0) {
     destroy_real_buffer(args->buffer);
     args->buffer = nullptr;
-    return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
-                      "vtpu: HBM quota exceeded (on-device size)");
+    return quota_reject("vtpu: HBM quota exceeded (on-device size)");
   }
   return nullptr;
 }
@@ -382,8 +462,7 @@ PJRT_Error* wrap_CreateUninitializedBuffer(
   if (account_buffer(args->buffer, args->device) != 0) {
     destroy_real_buffer(args->buffer);
     args->buffer = nullptr;
-    return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
-                      "vtpu: HBM quota exceeded (uninitialized buffer)");
+    return quota_reject("vtpu: HBM quota exceeded (uninitialized buffer)");
   }
   return nullptr;
 }
@@ -391,7 +470,7 @@ PJRT_Error* wrap_CreateUninitializedBuffer(
 PJRT_Error* wrap_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
   pthread_mutex_lock(&g_mu);
   auto it = g_buffers.find(args->buffer);
-  Acct acct{0, 0};
+  Acct acct{0, 0, 0};
   bool found = it != g_buffers.end();
   if (found) {
     acct = it->second;
@@ -399,7 +478,8 @@ PJRT_Error* wrap_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
   }
   pthread_mutex_unlock(&g_mu);
   if (found && g_region)
-    vtpu_region_sub(g_region, (int32_t)getpid(), acct.dev, 0, acct.bytes);
+    vtpu_region_sub(g_region, (int32_t)getpid(), acct.dev, acct.kind,
+                    acct.bytes);
   return g_real->PJRT_Buffer_Destroy(args);
 }
 
@@ -423,7 +503,7 @@ PJRT_Error* wrap_Client_Compile(PJRT_Client_Compile_Args* args) {
         vtpu_region_try_add(g_region, (int32_t)getpid(), 0, /*kind=*/1,
                             (uint64_t)sa.size_in_bytes, 1);
         pthread_mutex_lock(&g_mu);
-        g_programs[args->executable] = {(uint64_t)sa.size_in_bytes, 0};
+        g_programs[args->executable] = {(uint64_t)sa.size_in_bytes, 0, 1};
         pthread_mutex_unlock(&g_mu);
       }
       /* cache output arity + total output bytes for the execute hot path */
@@ -490,7 +570,7 @@ PJRT_Error* wrap_LoadedExecutable_Destroy(
   g_num_outputs.erase(args->executable);
   g_out_bytes.erase(args->executable);
   auto it = g_programs.find(args->executable);
-  Acct acct{0, 0};
+  Acct acct{0, 0, 1};
   bool found = it != g_programs.end();
   if (found) {
     acct = it->second;
@@ -502,11 +582,92 @@ PJRT_Error* wrap_LoadedExecutable_Destroy(
   return g_real->PJRT_LoadedExecutable_Destroy(args);
 }
 
-/* core-percentage pacing: keep the submitted-work duty cycle at
- * core_limit% by sleeping (100-q)/q × the host-side cost of each execute
- * call (the utilization-watcher analog; coarse but monotone).  The
- * monitor can suspend throttling for high-priority procs by setting
- * utilization_switch=1 (ref feedback.go CheckPriority/Observe). */
+/* core-percentage pacing: keep the device duty cycle at core_limit% by
+ * sleeping (100-q)/q × the measured DEVICE-RESIDENT time of each execute
+ * before the next submit (the utilization-watcher analog, closed on
+ * completion).  PJRT execute returns at ENQUEUE, so host-side duration
+ * says nothing about device time; instead each execute registers an
+ * OnReady callback on its first output buffer's ready event and the
+ * callback derives per-step device time as
+ *   completion − max(submit, previous completion)
+ * (device work within one client is queue-ordered).  Executables with no
+ * outputs (or plugins without event support) fall back to the host-side
+ * duration.  The monitor can suspend throttling for high-priority procs
+ * by setting utilization_switch=1 (ref feedback.go CheckPriority/Observe). */
+struct PaceState {
+  double t_ema_s = 0;       /* device-resident seconds per execute */
+  double last_complete = 0; /* CLOCK_MONOTONIC seconds */
+};
+PaceState g_pace;
+pthread_mutex_t g_pace_mu = PTHREAD_MUTEX_INITIALIZER;
+
+double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + (double)ts.tv_nsec * 1e-9;
+}
+
+void pace_observe(double t_submit, double t_complete) {
+  pthread_mutex_lock(&g_pace_mu);
+  double start = t_submit > g_pace.last_complete ? t_submit
+                                                 : g_pace.last_complete;
+  double dt = t_complete - start;
+  /* guard absurd samples (clock jumps, first-call compile) */
+  if (dt > 0 && dt < 10.0)
+    g_pace.t_ema_s =
+        g_pace.t_ema_s == 0 ? dt : 0.8 * g_pace.t_ema_s + 0.2 * dt;
+  if (t_complete > g_pace.last_complete) g_pace.last_complete = t_complete;
+  pthread_mutex_unlock(&g_pace_mu);
+}
+
+struct CompleteCtx {
+  double t_submit;
+};
+
+void on_exec_complete(PJRT_Error* err, void* arg) {
+  CompleteCtx* c = static_cast<CompleteCtx*>(arg);
+  pace_observe(c->t_submit, now_s());
+  delete c;
+  if (err) {
+    PJRT_Error_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = err;
+    g_real->PJRT_Error_Destroy(&d);
+  }
+}
+
+/* register the completion observer on the row's first output buffer;
+ * returns true when the event path is wired up */
+bool track_completion(PJRT_Buffer* out0, double t_submit) {
+  if (!out0 || !g_real->PJRT_Buffer_ReadyEvent || !g_real->PJRT_Event_OnReady ||
+      !g_real->PJRT_Event_Destroy)
+    return false;
+  PJRT_Buffer_ReadyEvent_Args ra;
+  memset(&ra, 0, sizeof(ra));
+  ra.struct_size = PJRT_Buffer_ReadyEvent_Args_STRUCT_SIZE;
+  ra.buffer = out0;
+  if (g_real->PJRT_Buffer_ReadyEvent(&ra) != nullptr || !ra.event)
+    return false;
+  PJRT_Event_OnReady_Args oa;
+  memset(&oa, 0, sizeof(oa));
+  oa.struct_size = PJRT_Event_OnReady_Args_STRUCT_SIZE;
+  oa.event = ra.event;
+  oa.callback = on_exec_complete;
+  oa.user_arg = new CompleteCtx{t_submit};
+  if (g_real->PJRT_Event_OnReady(&oa) != nullptr) {
+    delete static_cast<CompleteCtx*>(oa.user_arg);
+    return false;
+  }
+  /* the callback lives on the underlying future; the wrapper can go */
+  PJRT_Event_Destroy_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Event_Destroy_Args_STRUCT_SIZE;
+  da.event = ra.event;
+  g_real->PJRT_Event_Destroy(&da);
+  return true;
+}
+
 /* n_out / out_bytes with a fallback query for executables that did not
  * come through wrap_Client_Compile (e.g. deserialized from a persistent
  * compilation cache) */
@@ -585,18 +746,54 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
           for (int u = 0; u < dev; u++)
             if (reserved[u])
               vtpu_region_sub(g_region, (int32_t)getpid(), u, 0, reserved[u]);
-          return make_error(PJRT_Error_Code_RESOURCE_EXHAUSTED,
-                            "vtpu: HBM quota exceeded (execute outputs)");
+          return quota_reject("vtpu: HBM quota exceeded (execute outputs)");
         }
         reserved[dev] = want[dev];
         have_reservation = true;
       }
     }
   }
-  struct timespec t0, t1;
-  clock_gettime(CLOCK_MONOTONIC, &t0);
+  int q = g_cfg.core_limit;
+  bool pace_active = q > 0 && q < 100 && !g_cfg.core_policy_disable &&
+                     !(g_region && g_region->utilization_switch == 1);
+  if (pace_active) {
+    /* duty-cycle pacing at SUBMIT from the measured device step time */
+    pthread_mutex_lock(&g_pace_mu);
+    double t_ema = g_pace.t_ema_s;
+    pthread_mutex_unlock(&g_pace_mu);
+    if (t_ema > 0) {
+      double delay = t_ema * (double)(100 - q) / (double)q;
+      struct timespec ts;
+      ts.tv_sec = (time_t)delay;
+      ts.tv_nsec = (long)((delay - (double)ts.tv_sec) * 1e9);
+      nanosleep(&ts, nullptr);
+    }
+  }
+  double t_submit = now_s();
   PJRT_Error* err = g_real->PJRT_LoadedExecutable_Execute(args);
-  clock_gettime(CLOCK_MONOTONIC, &t1);
+  double t_return = now_s();
+  bool completion_tracked = false;
+  if (g_region) {
+    /* only DEVICE-side failure codes feed the health streak — a
+     * tenant's own bad program (INVALID_ARGUMENT etc.) must not mark
+     * the chip Unhealthy (the ref XID watcher skips app-level XIDs) */
+    if (err == nullptr) {
+      vtpu_region_exec_result(g_region, 1);
+    } else {
+      PJRT_Error_GetCode_Args gc;
+      memset(&gc, 0, sizeof(gc));
+      gc.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+      gc.error = err;
+      PJRT_Error_Code code = PJRT_Error_Code_UNKNOWN;
+      if (wrap_Error_GetCode(&gc) == nullptr) code = gc.code;
+      if (code == PJRT_Error_Code_INTERNAL ||
+          code == PJRT_Error_Code_UNAVAILABLE ||
+          code == PJRT_Error_Code_DATA_LOSS ||
+          code == PJRT_Error_Code_DEADLINE_EXCEEDED ||
+          code == PJRT_Error_Code_ABORTED)
+        vtpu_region_exec_result(g_region, 0);
+    }
+  }
   if (g_region) {
     __sync_fetch_and_add(&g_region->recent_kernel, 1);
     /* post-hoc accounting of the outputs that DID materialize: always
@@ -626,6 +823,8 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
               dev = device_index(bda.device);
           }
           account_buffer_idx_forced(outs[i], dev);
+          if (pace_active && !completion_tracked)
+            completion_tracked = track_completion(outs[i], t_submit);
         }
       }
     }
@@ -637,15 +836,11 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
         if (reserved[dev])
           vtpu_region_sub(g_region, (int32_t)getpid(), dev, 0, reserved[dev]);
   }
-  int q = g_cfg.core_limit;
-  int suspended = g_region && g_region->utilization_switch == 1;
-  if (!err && q > 0 && q < 100 && !g_cfg.core_policy_disable && !suspended) {
-    long ns = (t1.tv_sec - t0.tv_sec) * 1000000000L + (t1.tv_nsec - t0.tv_nsec);
-    long delay_ns = ns * (100 - q) / q;
-    if (delay_ns > 0) {
-      struct timespec ts = {delay_ns / 1000000000L, delay_ns % 1000000000L};
-      nanosleep(&ts, nullptr);
-    }
+  if (!err && pace_active && !completion_tracked) {
+    /* no output buffer to observe (or no event support): fall back to
+     * the host-side call duration — the old open-loop estimate, still
+     * better than pacing nothing */
+    pace_observe(t_submit, t_return);
   }
   return err;
 }

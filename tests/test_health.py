@@ -1,0 +1,100 @@
+"""Device-error health feed: shim execute-error streaks → unhealthy chips
+(the XID critical-event analog, ref nvidia.go:173-244) with CNDEV-style
+recovery (cambricon.go:188-224)."""
+
+import os
+import subprocess
+
+import pytest
+
+from vtpu.device.health import region_unhealthy_uuids
+from vtpu.device.libtpu import LibtpuProvider
+from vtpu.monitor.pathmonitor import REGION_FILENAME
+from vtpu.monitor.shared_region import RegionFile
+
+CPP = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "cpp")
+
+
+def make_region(root, ctr, uuids, streak=0):
+    d = os.path.join(root, ctr)
+    os.makedirs(d, exist_ok=True)
+    r = RegionFile(os.path.join(d, REGION_FILENAME), create=True)
+    r.set_devices(list(uuids), [1 << 30] * len(uuids), [100] * len(uuids))
+    for _ in range(streak):
+        r.record_exec_result(False)
+    return r
+
+
+def test_region_unhealthy_uuids_threshold(tmp_path):
+    root = str(tmp_path)
+    r = make_region(root, "pod-a_0", ["chip-1"], streak=2)
+    assert region_unhealthy_uuids(root, threshold=3) == set()
+    r.record_exec_result(False)  # streak hits 3
+    assert region_unhealthy_uuids(root, threshold=3) == {"chip-1"}
+    r.record_exec_result(True)  # one success resets (recovery)
+    assert region_unhealthy_uuids(root, threshold=3) == set()
+    r.close()
+
+
+def test_libtpu_provider_flips_on_error_streak(tmp_path, monkeypatch):
+    """A wedged-but-present chip (device node intact, every execute
+    failing) must go Unhealthy through the region feed — and recover."""
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-1")
+    monkeypatch.setenv("TPU_TOPOLOGY", "1x1x1")
+    monkeypatch.setenv("VTPU_CONTAINERS_ROOT", str(tmp_path))
+    prov = LibtpuProvider(hostname="hosty")
+    chips = prov.enumerate()
+    assert len(chips) == 1
+    uuid = chips[0].uuid
+    assert prov.health_check()[0].healthy is True
+    r = make_region(str(tmp_path), "pod-w_0", [uuid], streak=3)
+    assert prov.health_check()[0].healthy is False
+    r.record_exec_result(True)
+    assert prov.health_check()[0].healthy is True
+    r.close()
+
+
+@pytest.fixture(scope="module")
+def native():
+    shim = os.path.join(CPP, "build", "libvtpu_shim.so")
+    if not os.path.exists(shim):
+        rc = subprocess.run(["make", "-C", CPP], capture_output=True)
+        if rc.returncode != 0:
+            pytest.skip("native build unavailable")
+    return CPP
+
+
+def test_native_shim_records_error_streak(native, tmp_path):
+    """The native interposer feeds the same telemetry: induced device
+    failures bump error_streak; a success resets it."""
+    region = str(tmp_path / "ef.cache")
+    env = dict(
+        os.environ,
+        TPU_DEVICE_MEMORY_LIMIT_0="64",
+        VTPU_VISIBLE_UUIDS="chip-ef",
+        TPU_DEVICE_MEMORY_SHARED_CACHE=region,
+        VTPU_REAL_PJRT_PLUGIN="./build/libmock_pjrt.so",
+    )
+    proc = subprocess.run(
+        ["./build/test_shim", "build/libvtpu_shim.so", "execfail"],
+        cwd=native, env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    r = RegionFile(region)
+    assert r.region.error_streak == 4
+    assert r.region.exec_errors == 4
+    assert region_unhealthy_uuids(str(tmp_path), threshold=3) == set()  # wrong layout dir
+    r.close()
+
+    # recovery leg: a successful execute resets the streak
+    region2 = str(tmp_path / "ef2.cache")
+    env2 = dict(env, TPU_DEVICE_MEMORY_SHARED_CACHE=region2, TEST_SHIM_RECOVER="1")
+    proc2 = subprocess.run(
+        ["./build/test_shim", "build/libvtpu_shim.so", "execfail"],
+        cwd=native, env=env2, capture_output=True, text=True, timeout=60,
+    )
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    r2 = RegionFile(region2)
+    assert r2.region.error_streak == 0
+    assert r2.region.exec_errors == 4
+    r2.close()

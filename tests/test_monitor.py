@@ -338,3 +338,28 @@ def test_noderpc_serves_usage(tmp_path):
     assert c.devices[0].limit_bytes == 64 << 20
     server.stop(grace=None)
     pm.close()
+
+
+def test_shim_runtime_active_oom_killer(tmp_path):
+    """VTPU_ACTIVE_OOM_KILLER kills the tenant process on a quota reject
+    (SIGKILL — ref ACTIVE_OOM_KILLER container env) instead of raising an
+    error the tenant could swallow."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "from vtpu.shim import ShimRuntime\n"
+        f"rt = ShimRuntime(limits_bytes=[1024], region_path={str(tmp_path / 'k.cache')!r}, uuids=['t'])\n"
+        "rt.try_alloc(2048, 0)\n"
+        "print('survived')\n"
+    )
+    env = dict(os.environ, VTPU_ACTIVE_OOM_KILLER="true", JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.getcwd()
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=60,
+    )
+    assert proc.returncode == -9, (proc.returncode, proc.stdout, proc.stderr)
+    assert "survived" not in proc.stdout
+    assert "ACTIVE_OOM_KILLER" in proc.stderr

@@ -39,7 +39,9 @@ def test_region_python_create_and_read(tmp_path):
     r.add_usage(1234, 0, 1 << 20)
     r.add_usage(1234, 0, 2 << 20, kind="program")
     assert r.device_uuids() == ["tpu-a", "tpu-b"]
-    assert r.usage()[0] == {"buffer": 1 << 20, "program": 2 << 20, "total": 3 << 20}
+    assert r.usage()[0] == {
+        "buffer": 1 << 20, "program": 2 << 20, "total": 3 << 20, "swap": 0,
+    }
     procs = r.live_procs()
     assert procs[0]["pid"] == 1234 and procs[0]["priority"] == 1
     r.sub_usage(1234, 0, 1 << 20)
@@ -87,6 +89,30 @@ def test_cross_language_layout(native, tmp_path):
     assert dev1["used_bytes"] == (7 << 20) + (3 << 20)
     pids = {p["pid"] for p in data["procs"]}
     assert pids == {4242, 777}
+
+
+def test_cross_language_swap_tier(native, tmp_path):
+    """Host-swap accounting (kind 2) round-trips C↔Python: never limited
+    by the device quota, never part of the device total."""
+    tool = os.path.join(native, "region_tool")
+    path = str(tmp_path / "s.cache")
+    subprocess.run([tool, "init", path, "tpu-S:10:100"], check=True, timeout=30)
+    # 64 MiB of swap on a 10 MiB quota: admitted (host tier)
+    subprocess.run([tool, "add", path, "9", "0", "swap", str(64 << 20)],
+                   check=True, timeout=30)
+    r = RegionFile(path)
+    u = r.usage()[0]
+    assert u["swap"] == 64 << 20
+    assert u["total"] == 0, "swap must not count against the device total"
+    # Python side adds more swap; C dump agrees
+    r.register_proc(9)
+    r.add_usage(9, 0, 1 << 20, kind="swap")
+    r.sub_usage(9, 0, 65 << 20, kind="swap")
+    assert r.usage()[0]["swap"] == 0
+    r.close()
+    out = subprocess.run([tool, "dump", path], capture_output=True, check=True,
+                         timeout=30)
+    assert json.loads(out.stdout)["procs"][0]["used"][0]["swap"] == 0
 
 
 def test_native_quota_over_limit_rejected(native, tmp_path):

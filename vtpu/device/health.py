@@ -1,0 +1,63 @@
+"""Device-error health feed: region error streaks → unhealthy chips.
+
+The TPU-native analog of the reference's XID critical-event watcher
+(pkg/device-plugin/nvidiadevice/nvidia.go:173-244).  On TPU there is no
+host-side event stream for a wedged chip — device errors surface inside
+the tenant's PJRT calls.  The enforcement shim therefore records every
+execute outcome in its shared region (``error_streak`` /
+``exec_errors``, cpp/vtpu_shim.cc execute path), and the device plugin's
+health probe reads those regions here: a tenant accumulating
+``VTPU_HEALTH_ERROR_STREAK`` consecutive device-side failures flips its
+chips Unhealthy; one success resets the streak and the chip recovers
+(the CNDEV recovery semantics, cambricon.go:188-224 — not NVIDIA's
+sticky-unhealthy).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Set
+
+log = logging.getLogger(__name__)
+
+ENV_CONTAINERS_ROOT = "VTPU_CONTAINERS_ROOT"
+ENV_ERROR_STREAK = "VTPU_HEALTH_ERROR_STREAK"
+DEFAULT_CONTAINERS_ROOT = "/usr/local/vtpu/containers"
+DEFAULT_ERROR_STREAK = 3
+
+
+def region_unhealthy_uuids(
+    root: Optional[str] = None, threshold: Optional[int] = None
+) -> Set[str]:
+    """Chip uuids whose tenant regions show a device-error streak at or
+    past the threshold.  Missing root / unreadable regions are normal
+    (no tenants yet) and yield an empty set."""
+    from vtpu.monitor.pathmonitor import REGION_FILENAME
+    from vtpu.monitor.shared_region import open_region
+
+    root = root or os.environ.get(ENV_CONTAINERS_ROOT, DEFAULT_CONTAINERS_ROOT)
+    if threshold is None:
+        threshold = int(
+            os.environ.get(ENV_ERROR_STREAK, str(DEFAULT_ERROR_STREAK))
+            or DEFAULT_ERROR_STREAK
+        )
+    out: Set[str] = set()
+    if not root or not os.path.isdir(root):
+        return out
+    for entry in sorted(os.listdir(root)):
+        path = os.path.join(root, entry, REGION_FILENAME)
+        rf = open_region(path)
+        if rf is None:
+            continue
+        try:
+            if rf.region.error_streak >= threshold:
+                uuids = rf.device_uuids()
+                log.warning(
+                    "region %s: execute-error streak %d (>=%d) — marking %s unhealthy",
+                    entry, rf.region.error_streak, threshold, uuids,
+                )
+                out.update(uuids)
+        finally:
+            rf.close()
+    return out

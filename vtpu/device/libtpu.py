@@ -169,16 +169,23 @@ class LibtpuProvider:
         return self._topo or Topology((max(len(self._chips or []), 1), 1, 1))
 
     def health_check(self) -> List[Chip]:
-        """Device-node presence is the health probe (no XID-event analog on
-        TPU VMs; a wedged chip drops its /dev/accel node or PJRT init fails).
-        Chips recover when the node returns (CNDEV-style recovery,
-        cambricon.go:188-224, not NVIDIA's sticky-unhealthy)."""
+        """Two health feeds, both recoverable (CNDEV-style recovery,
+        cambricon.go:188-224, not NVIDIA's sticky-unhealthy):
+
+        1. device-node presence — a hot-unplugged chip drops /dev/accel*;
+        2. tenant execute-error streaks from the enforcement shim's
+           shared regions (vtpu.device.health) — the XID-event analog: a
+           wedged-but-present chip keeps its device node, but every
+           tenant execute fails, and those failures are recorded in the
+           region this probe reads."""
+        from vtpu.device.health import region_unhealthy_uuids
+
         chips = self.enumerate()
         paths = set(_dev_paths())
-        if paths:
-            for c in chips:
-                if c.devpath:
-                    c.healthy = c.devpath in paths
+        erroring = region_unhealthy_uuids()
+        for c in chips:
+            node_ok = (c.devpath in paths) if (c.devpath and paths) else True
+            c.healthy = node_ok and c.uuid not in erroring
         return list(chips)
 
 

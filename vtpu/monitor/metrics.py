@@ -71,8 +71,10 @@ def render_node_metrics(
             }
             usage_s.append((labels, usage[i]["total"]))
             limit_s.append((labels, limits[i]))
-            for kind in ("buffer", "program"):
-                breakdown_s.append((dict(labels, kind=kind), usage[i][kind]))
+            for kind in ("buffer", "program", "swap"):
+                breakdown_s.append(
+                    (dict(labels, kind=kind), usage[i].get(kind, 0))
+                )
             violation_s.append(
                 (labels, 1 if limits[i] and usage[i]["total"] > limits[i] else 0)
             )

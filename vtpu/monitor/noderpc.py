@@ -37,6 +37,7 @@ def _container_usage(entry) -> pb.ContainerUsage:
                 used_bytes=usage[i]["total"],
                 buffer_bytes=usage[i]["buffer"],
                 program_bytes=usage[i]["program"],
+                swap_bytes=usage[i].get("swap", 0),
                 core_limit=cores[i],
             )
         )

@@ -17,7 +17,7 @@ import os
 from typing import Dict, List, Optional
 
 VTPU_REGION_MAGIC = 0x76545055
-VTPU_REGION_VERSION = 1
+VTPU_REGION_VERSION = 2
 MAX_DEVICES = 16
 MAX_PROCS = 64
 UUID_LEN = 64
@@ -28,6 +28,8 @@ class DeviceUsage(ctypes.Structure):
         ("program_bytes", ctypes.c_uint64),
         ("buffer_bytes", ctypes.c_uint64),
         ("total_bytes", ctypes.c_uint64),
+        # host-tier bytes past quota (oversubscribe); not part of total
+        ("swap_bytes", ctypes.c_uint64),
     ]
 
 
@@ -51,6 +53,10 @@ class SharedRegion(ctypes.Structure):
         ("num_devices", ctypes.c_int32),
         ("utilization_switch", ctypes.c_int32),
         ("recent_kernel", ctypes.c_int32),
+        # device-error telemetry (XID-analog): consecutive execute errors
+        # + cumulative count, written by the shim's execute path
+        ("error_streak", ctypes.c_int32),
+        ("exec_errors", ctypes.c_int32),
         ("uuids", (ctypes.c_char * UUID_LEN) * MAX_DEVICES),
         ("limit_bytes", ctypes.c_uint64 * MAX_DEVICES),
         ("core_limit", ctypes.c_int32 * MAX_DEVICES),
@@ -128,12 +134,16 @@ class RegionFile:
         r = self.region
         out = []
         for d in range(r.num_devices):
-            buf = prog = 0
+            buf = prog = swap = 0
             for p in range(MAX_PROCS):
                 if r.procs[p].status == 1:
                     buf += r.procs[p].used[d].buffer_bytes
                     prog += r.procs[p].used[d].program_bytes
-            out.append({"buffer": buf, "program": prog, "total": buf + prog})
+                    swap += r.procs[p].used[d].swap_bytes
+            out.append(
+                {"buffer": buf, "program": prog, "total": buf + prog,
+                 "swap": swap}
+            )
         return out
 
     def live_procs(self) -> List[Dict[str, int]]:
@@ -174,6 +184,17 @@ class RegionFile:
         bare += would lose increments."""
         with self._locked():
             self.region.recent_kernel += n
+
+    def record_exec_result(self, ok: bool) -> None:
+        """Execute outcome feed (the XID-analog health stream): a success
+        resets the consecutive-error streak, a failure bumps it plus the
+        cumulative error count."""
+        with self._locked():
+            if ok:
+                self.region.error_streak = 0
+            else:
+                self.region.error_streak += 1
+                self.region.exec_errors += 1
 
     def decay_recent_kernel(self) -> int:
         """ref Observe (feedback.go): halve the activity counter, return the
@@ -242,6 +263,8 @@ class RegionFile:
                 u = r.procs[p].used[dev]
                 if kind == "program":
                     u.program_bytes += bytes_
+                elif kind == "swap":
+                    u.swap_bytes += bytes_
                 else:
                     u.buffer_bytes += bytes_
                 u.total_bytes = u.program_bytes + u.buffer_bytes
@@ -258,6 +281,8 @@ class RegionFile:
                 u = r.procs[p].used[dev]
                 if kind == "program":
                     u.program_bytes = max(0, u.program_bytes - bytes_)
+                elif kind == "swap":
+                    u.swap_bytes = max(0, u.swap_bytes - bytes_)
                 else:
                     u.buffer_bytes = max(0, u.buffer_bytes - bytes_)
                 u.total_bytes = u.program_bytes + u.buffer_bytes

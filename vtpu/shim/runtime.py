@@ -26,6 +26,20 @@ class QuotaExceeded(MemoryError):
     """HBM quota exhausted (the check_oom reject, ref libvgpu.so)."""
 
 
+def _oom_reject(runtime: "ShimRuntime", msg: str) -> "QuotaExceeded":
+    """Build the quota-reject outcome: normally an exception, but with
+    ACTIVE_OOM_KILLER the tenant process is terminated — SIGKILL, like
+    the reference — so a tenant that ignores RESOURCE_EXHAUSTED cannot
+    spin forever."""
+    if runtime.active_oom_killer:
+        import signal
+
+        log.error("ACTIVE_OOM_KILLER: %s — killing pid %d", msg, os.getpid())
+        logging.shutdown()
+        os.kill(os.getpid(), signal.SIGKILL)
+    return QuotaExceeded(msg)
+
+
 def _env_limits() -> List[int]:
     out = []
     i = 0
@@ -66,6 +80,12 @@ class ShimRuntime:
             oversubscribe
             if oversubscribe is not None
             else os.environ.get("VTPU_OVERSUBSCRIBE") == "true"
+        )
+        # kill the tenant on quota reject instead of raising an error it
+        # may swallow and retry forever (ref ACTIVE_OOM_KILLER,
+        # docs/config.md container envs; enforced in libvgpu.so)
+        self.active_oom_killer = (
+            os.environ.get("VTPU_ACTIVE_OOM_KILLER") == "true"
         )
         self.priority = (
             priority
@@ -129,14 +149,15 @@ class ShimRuntime:
                 oversubscribe=self.oversubscribe,
             )
             if not ok:
-                raise QuotaExceeded(
+                raise _oom_reject(
+                    self,
                     f"vtpu: device {dev} quota {limit} B exceeded "
-                    f"(in use {self.device_usage(dev)}, want {nbytes})"
+                    f"(in use {self.device_usage(dev)}, want {nbytes})",
                 )
         elif limit and not self.oversubscribe:
             if self._local.get(dev, 0) + nbytes > limit:
-                raise QuotaExceeded(
-                    f"vtpu: device {dev} quota {limit} B exceeded"
+                raise _oom_reject(
+                    self, f"vtpu: device {dev} quota {limit} B exceeded"
                 )
         self._local[dev] = self._local.get(dev, 0) + nbytes
 
@@ -186,12 +207,17 @@ class ShimRuntime:
             self._record_placement(out, dev, nbytes, "device")
             return out
         if not self.oversubscribe:
-            raise QuotaExceeded(
+            raise _oom_reject(
+                self,
                 f"vtpu: device {dev} quota {self.limit_for(dev)} B exceeded "
-                f"(in use {self.device_usage(dev)}, want {nbytes})"
+                f"(in use {self.device_usage(dev)}, want {nbytes})",
             )
         out = jax.device_put(x, jax.devices("cpu")[0])
         self._swapped[dev] = self._swapped.get(dev, 0) + nbytes
+        if self.region is not None:
+            # publish the host tier so the monitor's breakdown shows it
+            # (kind 2/"swap" in the region — same as the native shim)
+            self.region.add_usage(self.pid, dev, nbytes, "swap")
         self._record_placement(out, dev, nbytes, "host")
         return out
 
@@ -223,6 +249,8 @@ class ShimRuntime:
             self.free(nbytes, dev)
         else:
             self._swapped[dev] = max(0, self._swapped.get(dev, 0) - nbytes)
+            if self.region is not None:
+                self.region.sub_usage(self.pid, dev, nbytes, "swap")
         return True
 
     def _release_all_for(self, key: int) -> None:
@@ -259,11 +287,11 @@ class ShimRuntime:
             suspended = False
         q = self.core_limit
         if not (0 < q < 100) or suspended:
-            return fn(*args, **kwargs)
+            return self._run_fn(fn, args, kwargs)
         if self._pace_state == "warmup":
             # first paced step: retire it but DISCARD the timing — it
             # includes jit compilation — then calibrate on the next step
-            out = fn(*args, **kwargs)
+            out = self._run_fn(fn, args, kwargs)
             self._retire(out)
             self._pace_state = "calibrate"
             return out
@@ -271,7 +299,7 @@ class ShimRuntime:
             # queue is empty (previous step was retired synchronously):
             # one synchronous step = enqueue + device + sync, the real T
             t0 = time.monotonic()
-            out = fn(*args, **kwargs)
+            out = self._run_fn(fn, args, kwargs)
             self._retire(out)
             self._last_step_s = time.monotonic() - t0
             self._pace_state = "run"
@@ -279,12 +307,41 @@ class ShimRuntime:
             return out
         if self._last_step_s > 0:
             time.sleep(self._last_step_s * (100 - q) / q)
-        out = fn(*args, **kwargs)
+        out = self._run_fn(fn, args, kwargs)
         self._since_sync += 1
         if self._since_sync >= self._sync_every:
             # drain so the next step can re-calibrate against an idle queue
             self._retire(out)
             self._pace_state = "calibrate"
+        return out
+
+    @staticmethod
+    def _is_device_error(e: BaseException) -> bool:
+        """Only DEVICE-side failures feed the health streak — a tenant's
+        own bad program (INVALID_ARGUMENT, shape TypeError, quota
+        rejects) must never mark the chip Unhealthy.  Mirrors the
+        reference XID watcher skipping application-level XIDs
+        (nvidia.go skips 31/43/45)."""
+        text = f"{type(e).__name__}: {e}"
+        return any(
+            tag in text
+            for tag in ("INTERNAL", "UNAVAILABLE", "DATA_LOSS", "ABORTED",
+                        "DEADLINE_EXCEEDED")
+        )
+
+    def _run_fn(self, fn, args, kwargs):
+        """Run one launch, feeding the outcome into the region's
+        device-error telemetry (the XID-analog health stream).  Success
+        only takes the region lock when it must clear a streak, keeping
+        the hot path at one flock per dispatch."""
+        try:
+            out = fn(*args, **kwargs)
+        except Exception as e:
+            if self.region is not None and self._is_device_error(e):
+                self.region.record_exec_result(False)
+            raise
+        if self.region is not None and self.region.region.error_streak != 0:
+            self.region.record_exec_result(True)
         return out
 
     @staticmethod
