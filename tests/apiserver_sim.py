@@ -1,0 +1,245 @@
+"""In-process Kubernetes apiserver simulator (REST subset, real HTTP).
+
+Exists to exercise ``vtpu.k8s.client.Client`` — the one component the
+fake-clientset tests cannot reach — against genuine wire semantics:
+
+- Bearer-token auth (401 without it)
+- ``application/merge-patch+json`` deep merge where ``null`` deletes keys
+- ``application/json-patch+json`` with the leading resourceVersion
+  ``test`` op returning 409 on mismatch (the node-lock conflict path)
+- resourceVersion bumped on every successful mutation
+- pod ``binding`` subresource setting ``spec.nodeName``
+- ``fieldSelector=spec.nodeName=...`` on pod list
+
+This mirrors the reference's operational reality (annotations are the
+RPC bus, SURVEY.md §3.4) one rung below a kind cluster: same verbs, same
+status codes, no kubelet.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+def _deep_merge(dst: dict, patch: dict) -> dict:
+    for k, v in patch.items():
+        if v is None:
+            dst.pop(k, None)
+        elif isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+    return dst
+
+
+class _Store:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.rv = 0
+        self.nodes: Dict[str, dict] = {}
+        self.pods: Dict[Tuple[str, str], dict] = {}
+
+    def bump(self, obj: dict) -> None:
+        self.rv += 1
+        obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+
+
+class ApiServerSim:
+    """Serve on 127.0.0.1:<ephemeral>; ``base_url`` after start()."""
+
+    def __init__(self, token: Optional[str] = None) -> None:
+        self.store = _Store()
+        self.token = token
+        self._srv: Optional[ThreadingHTTPServer] = None
+
+    # -- test seeding ------------------------------------------------------
+    def seed_node(self, node: dict) -> None:
+        with self.store.lock:
+            self.store.bump(node)
+            self.store.nodes[node["metadata"]["name"]] = node
+
+    def seed_pod(self, pod: dict) -> None:
+        with self.store.lock:
+            self.store.bump(pod)
+            key = (pod["metadata"].get("namespace", "default"), pod["metadata"]["name"])
+            self.store.pods[key] = pod
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> str:
+        sim = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: N802
+                pass
+
+            def _reply(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _status(self, code: int, reason: str, message: str) -> None:
+                self._reply(code, {
+                    "kind": "Status", "apiVersion": "v1", "status": "Failure",
+                    "reason": reason, "message": message, "code": code,
+                })
+
+            def _authed(self) -> bool:
+                if sim.token is None:
+                    return True
+                if self.headers.get("Authorization") == f"Bearer {sim.token}":
+                    return True
+                self._status(401, "Unauthorized", "bad or missing bearer token")
+                return False
+
+            def _body(self):
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n) or b"{}")
+
+            # -- verbs ----------------------------------------------------
+            def do_GET(self):  # noqa: N802
+                if not self._authed():
+                    return
+                path, _, query = self.path.partition("?")
+                with sim.store.lock:
+                    if path == "/api/v1/nodes":
+                        return self._reply(200, {"items": list(sim.store.nodes.values())})
+                    m = re.fullmatch(r"/api/v1/nodes/([^/]+)", path)
+                    if m:
+                        node = sim.store.nodes.get(m.group(1))
+                        if node is None:
+                            return self._status(404, "NotFound", f"node {m.group(1)}")
+                        return self._reply(200, node)
+                    if path == "/api/v1/pods":
+                        items = list(sim.store.pods.values())
+                        fm = re.search(r"fieldSelector=spec\.nodeName%3D([^&]+)", query) or \
+                            re.search(r"fieldSelector=spec\.nodeName=([^&]+)", query)
+                        if fm:
+                            items = [
+                                p for p in items
+                                if p.get("spec", {}).get("nodeName") == fm.group(1)
+                            ]
+                        return self._reply(200, {"items": items})
+                    m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods/([^/]+)", path)
+                    if m:
+                        pod = sim.store.pods.get((m.group(1), m.group(2)))
+                        if pod is None:
+                            return self._status(404, "NotFound", f"pod {m.group(2)}")
+                        return self._reply(200, pod)
+                self._status(404, "NotFound", path)
+
+            def do_PATCH(self):  # noqa: N802
+                if not self._authed():
+                    return
+                ctype = self.headers.get("Content-Type", "")
+                patch = self._body()
+                with sim.store.lock:
+                    m = re.fullmatch(r"/api/v1/nodes/([^/]+)", self.path)
+                    obj = None
+                    if m:
+                        obj = sim.store.nodes.get(m.group(1))
+                    else:
+                        m = re.fullmatch(
+                            r"/api/v1/namespaces/([^/]+)/pods/([^/]+)", self.path
+                        )
+                        if m:
+                            obj = sim.store.pods.get((m.group(1), m.group(2)))
+                    if obj is None:
+                        return self._status(404, "NotFound", self.path)
+                    if ctype == "application/merge-patch+json":
+                        _deep_merge(obj, patch)
+                        sim.store.bump(obj)
+                        return self._reply(200, obj)
+                    if ctype == "application/json-patch+json":
+                        try:
+                            self._apply_json_patch(obj, patch)
+                        except _PatchConflict as e:
+                            return self._status(409, "Conflict", str(e))
+                        except Exception as e:  # noqa: BLE001 — bad patch
+                            return self._status(422, "Invalid", str(e))
+                        sim.store.bump(obj)
+                        return self._reply(200, obj)
+                    return self._status(415, "UnsupportedMediaType", ctype)
+
+            @staticmethod
+            def _apply_json_patch(obj: dict, ops) -> None:
+                def resolve(path):
+                    parts = [
+                        p.replace("~1", "/").replace("~0", "~")
+                        for p in path.lstrip("/").split("/")
+                    ]
+                    parent = obj
+                    for p in parts[:-1]:
+                        parent = parent[p]
+                    return parent, parts[-1]
+
+                for op in ops:
+                    parent, leaf = resolve(op["path"])
+                    if op["op"] == "test":
+                        if parent.get(leaf) != op["value"]:
+                            raise _PatchConflict(
+                                f"test failed at {op['path']}: "
+                                f"{parent.get(leaf)!r} != {op['value']!r}"
+                            )
+                    elif op["op"] == "add" or op["op"] == "replace":
+                        parent[leaf] = op["value"]
+                    elif op["op"] == "remove":
+                        if leaf not in parent:
+                            raise KeyError(op["path"])
+                        del parent[leaf]
+                    else:
+                        raise ValueError(f"unsupported op {op['op']}")
+
+            def do_POST(self):  # noqa: N802
+                if not self._authed():
+                    return
+                body = self._body()
+                with sim.store.lock:
+                    m = re.fullmatch(
+                        r"/api/v1/namespaces/([^/]+)/pods/([^/]+)/binding", self.path
+                    )
+                    if m:
+                        pod = sim.store.pods.get((m.group(1), m.group(2)))
+                        if pod is None:
+                            return self._status(404, "NotFound", m.group(2))
+                        pod.setdefault("spec", {})["nodeName"] = body["target"]["name"]
+                        sim.store.bump(pod)
+                        return self._reply(201, {"kind": "Status", "status": "Success"})
+                    m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods", self.path)
+                    if m:
+                        body["metadata"].setdefault("namespace", m.group(1))
+                        sim.store.bump(body)
+                        key = (m.group(1), body["metadata"]["name"])
+                        sim.store.pods[key] = body
+                        return self._reply(201, body)
+                self._status(404, "NotFound", self.path)
+
+            def do_DELETE(self):  # noqa: N802
+                if not self._authed():
+                    return
+                with sim.store.lock:
+                    m = re.fullmatch(
+                        r"/api/v1/namespaces/([^/]+)/pods/([^/]+)", self.path
+                    )
+                    if m and sim.store.pods.pop((m.group(1), m.group(2)), None):
+                        return self._reply(200, {"kind": "Status", "status": "Success"})
+                self._status(404, "NotFound", self.path)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+        return f"http://127.0.0.1:{self._srv.server_address[1]}"
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv = None
+
+
+class _PatchConflict(Exception):
+    pass

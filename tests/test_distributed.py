@@ -31,3 +31,58 @@ def test_device_counts():
     # conftest forces the 8-device virtual CPU platform
     assert distributed.global_device_count() >= 1
     assert distributed.local_device_count() >= 1
+
+
+def test_two_process_gang_over_dcn(tmp_path):
+    """The real multi-host path (VERDICT r1 #8): two host processes ×
+    4 virtual CPU devices each bootstrap through ensure_initialized()
+    (the chart's VTPU_* env contract), form one 8-device global mesh,
+    and run a cross-host psum — the DCN-tier collective a v5p gang
+    performs, minus the chips."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    worker = (
+        "import jax, numpy as np\n"
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "from vtpu.parallel import distributed\n"
+        "assert distributed.ensure_initialized() is True\n"
+        "assert distributed.global_device_count() == 8\n"
+        "assert distributed.local_device_count() == 4\n"
+        "mesh = Mesh(np.array(jax.devices()).reshape(2, 4), ('host', 'chip'))\n"
+        "def allsum(x):\n"
+        "    return jax.lax.psum(jax.lax.psum(x, 'chip'), 'host')\n"
+        "f = jax.jit(jax.shard_map(allsum, mesh=mesh,\n"
+        "    in_specs=P(('host', 'chip')), out_specs=P()))\n"
+        "import jax.numpy as jnp\n"
+        "out = f(jnp.ones((8,)))\n"
+        "assert float(out[0]) == 8.0, out\n"
+        "print('gang ok', distributed.process_index())\n"
+    )
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            VTPU_COORDINATOR=f"127.0.0.1:{port}",
+            VTPU_NUM_PROCESSES="2",
+            VTPU_PROCESS_ID=str(rank),
+            PYTHONPATH=os.getcwd(),
+        )
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"rank failed:\n{out}\n{err[-2000:]}"
+        assert "gang ok" in out

@@ -72,6 +72,12 @@ def ensure_initialized(
     return True
 
 
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
 def global_device_count() -> int:
     import jax
 
