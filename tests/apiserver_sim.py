@@ -39,13 +39,21 @@ def _deep_merge(dst: dict, patch: dict) -> dict:
 class _Store:
     def __init__(self) -> None:
         self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
         self.rv = 0
         self.nodes: Dict[str, dict] = {}
         self.pods: Dict[Tuple[str, str], dict] = {}
+        # pod watch event log: (rv, type, deep-copied object)
+        self.events: list = []
 
     def bump(self, obj: dict) -> None:
         self.rv += 1
         obj.setdefault("metadata", {})["resourceVersion"] = str(self.rv)
+
+    def emit(self, etype: str, pod: dict) -> None:
+        """Record a pod watch event (caller holds the lock)."""
+        self.events.append((self.rv, etype, json.loads(json.dumps(pod))))
+        self.cond.notify_all()
 
 
 class ApiServerSim:
@@ -67,6 +75,7 @@ class ApiServerSim:
             self.store.bump(pod)
             key = (pod["metadata"].get("namespace", "default"), pod["metadata"]["name"])
             self.store.pods[key] = pod
+            self.store.emit("ADDED", pod)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> str:
@@ -107,6 +116,8 @@ class ApiServerSim:
                 if not self._authed():
                     return
                 path, _, query = self.path.partition("?")
+                if path == "/api/v1/pods" and "watch=true" in query:
+                    return self._watch_pods(query)
                 with sim.store.lock:
                     if path == "/api/v1/nodes":
                         return self._reply(200, {"items": list(sim.store.nodes.values())})
@@ -125,7 +136,10 @@ class ApiServerSim:
                                 p for p in items
                                 if p.get("spec", {}).get("nodeName") == fm.group(1)
                             ]
-                        return self._reply(200, {"items": items})
+                        return self._reply(200, {
+                            "items": items,
+                            "metadata": {"resourceVersion": str(sim.store.rv)},
+                        })
                     m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods/([^/]+)", path)
                     if m:
                         pod = sim.store.pods.get((m.group(1), m.group(2)))
@@ -133,6 +147,37 @@ class ApiServerSim:
                             return self._status(404, "NotFound", f"pod {m.group(2)}")
                         return self._reply(200, pod)
                 self._status(404, "NotFound", path)
+
+            def _watch_pods(self, query: str) -> None:
+                """Streamed pod watch: newline-delimited JSON events with
+                rv > resourceVersion, until timeoutSeconds elapses
+                (HTTP/1.0 close-delimited, like the real chunked watch)."""
+                import time as _t
+
+                m = re.search(r"resourceVersion=(\d+)", query)
+                last = int(m.group(1)) if m else 0
+                m = re.search(r"timeoutSeconds=(\d+)", query)
+                timeout = int(m.group(1)) if m else 30
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                deadline = _t.monotonic() + timeout
+                while _t.monotonic() < deadline:
+                    with sim.store.cond:
+                        pending = [e for e in sim.store.events if e[0] > last]
+                        if not pending:
+                            sim.store.cond.wait(
+                                min(0.5, max(0.0, deadline - _t.monotonic()))
+                            )
+                            continue
+                    for rv, etype, obj in pending:
+                        line = json.dumps({"type": etype, "object": obj}) + "\n"
+                        try:
+                            self.wfile.write(line.encode())
+                            self.wfile.flush()
+                        except (BrokenPipeError, ConnectionResetError):
+                            return
+                        last = rv
 
             def do_PATCH(self):  # noqa: N802
                 if not self._authed():
@@ -152,9 +197,12 @@ class ApiServerSim:
                             obj = sim.store.pods.get((m.group(1), m.group(2)))
                     if obj is None:
                         return self._status(404, "NotFound", self.path)
+                    is_pod = "/pods/" in self.path
                     if ctype == "application/merge-patch+json":
                         _deep_merge(obj, patch)
                         sim.store.bump(obj)
+                        if is_pod:
+                            sim.store.emit("MODIFIED", obj)
                         return self._reply(200, obj)
                     if ctype == "application/json-patch+json":
                         try:
@@ -164,6 +212,8 @@ class ApiServerSim:
                         except Exception as e:  # noqa: BLE001 — bad patch
                             return self._status(422, "Invalid", str(e))
                         sim.store.bump(obj)
+                        if is_pod:
+                            sim.store.emit("MODIFIED", obj)
                         return self._reply(200, obj)
                     return self._status(415, "UnsupportedMediaType", ctype)
 
@@ -210,6 +260,7 @@ class ApiServerSim:
                             return self._status(404, "NotFound", m.group(2))
                         pod.setdefault("spec", {})["nodeName"] = body["target"]["name"]
                         sim.store.bump(pod)
+                        sim.store.emit("MODIFIED", pod)
                         return self._reply(201, {"kind": "Status", "status": "Success"})
                     m = re.fullmatch(r"/api/v1/namespaces/([^/]+)/pods", self.path)
                     if m:
@@ -217,6 +268,7 @@ class ApiServerSim:
                         sim.store.bump(body)
                         key = (m.group(1), body["metadata"]["name"])
                         sim.store.pods[key] = body
+                        sim.store.emit("ADDED", body)
                         return self._reply(201, body)
                 self._status(404, "NotFound", self.path)
 
@@ -227,8 +279,14 @@ class ApiServerSim:
                     m = re.fullmatch(
                         r"/api/v1/namespaces/([^/]+)/pods/([^/]+)", self.path
                     )
-                    if m and sim.store.pods.pop((m.group(1), m.group(2)), None):
-                        return self._reply(200, {"kind": "Status", "status": "Success"})
+                    if m:
+                        pod = sim.store.pods.pop((m.group(1), m.group(2)), None)
+                        if pod:
+                            sim.store.rv += 1
+                            sim.store.emit("DELETED", pod)
+                            return self._reply(
+                                200, {"kind": "Status", "status": "Success"}
+                            )
                 self._status(404, "NotFound", self.path)
 
         self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)

@@ -131,3 +131,89 @@ def test_full_handshake_over_http(sim, client):
     sched2.ingest_pods()
     usage = sched2.nodes_usage()
     assert "node-a" in usage  # node present, usage rebuilt from the pod
+
+
+def test_watch_pods_stream(sim, client):
+    """Client watch yields ADDED/MODIFIED/DELETED incrementally — the
+    informer path replacing the full re-list poll."""
+    import threading
+
+    sim.seed_node(new_node("n1"))
+    raw = client.list_pods_raw()
+    rv = raw["metadata"]["resourceVersion"]
+    got = []
+    done = threading.Event()
+
+    def consume():
+        for etype, pod in client.watch_pods(resource_version=rv, timeout_s=5):
+            got.append((etype, pod["metadata"]["name"]))
+            if len(got) >= 3:
+                break
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    sim.seed_pod(new_pod("w1"))
+    client.patch_pod_annotations("default", "w1", {"k": "v"})
+    client.delete_pod("default", "w1")
+    assert done.wait(15), f"watch incomplete: {got}"
+    assert got == [("ADDED", "w1"), ("MODIFIED", "w1"), ("DELETED", "w1")]
+
+
+def test_scheduler_watch_ingest(sim, client):
+    """The scheduler's watch loop keeps pod assignment state current
+    without re-listing: a pod bound with assignment annotations appears
+    in usage; its deletion removes the booking."""
+    import threading
+    import time
+
+    sim.seed_node(new_node("node-a"))
+    chips = [ChipInfo(uuid="tpu-0", count=4, hbm_mb=16384, cores=100,
+                      type="TPU-v5e", health=True, coords=None)]
+    client.patch_node_annotations("node-a", {
+        A.NODE_HANDSHAKE: f"Reported {_now()}",
+        A.NODE_REGISTER: codec.encode_node_devices(chips),
+    })
+    sched = Scheduler(client, SchedulerConfig())
+    sched.register_from_node_annotations()
+    t = threading.Thread(target=sched.watch_pods_loop, daemon=True)
+    t.start()
+    try:
+        pod = new_pod("wp", containers=[{"name": "c0", "resources": {"limits": {
+            "google.com/tpu": 1, "google.com/tpumem": 2048}}}])
+        sim.seed_pod(pod)
+        res = sched.filter(pod, ["node-a"])
+        assert res.node == "node-a"
+        assert not sched.bind("default", "wp", "node-a",
+                              pod_uid=pod["metadata"]["uid"])
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            usage = sched.nodes_usage()
+            if usage.get("node-a") and any(
+                d.usedmem for d in usage["node-a"].devices
+            ):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("watch never surfaced the bound pod's usage")
+        client.delete_pod("default", "wp")
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            usage = sched.nodes_usage()
+            if not any(d.usedmem for d in usage["node-a"].devices):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("watch never dropped the deleted pod")
+    finally:
+        sched.stop()
+
+
+def test_apply_pod_event_error_forces_relist(sim, client):
+    """A watch ERROR (410 Gone after etcd compaction) must not be
+    ingested as a pod; it signals the caller to re-list."""
+    sched = Scheduler(client, SchedulerConfig())
+    status = {"kind": "Status", "code": 410, "reason": "Expired"}
+    assert sched.apply_pod_event("ERROR", status) is False
+    assert sched.apply_pod_event("BOOKMARK", {"metadata": {}}) is True
+    assert not sched.pods.all_pods()

@@ -66,6 +66,9 @@ def test_two_process_gang_over_dcn(tmp_path):
         "assert float(out[0]) == 8.0, out\n"
         "print('gang ok', distributed.process_index())\n"
     )
+    import pathlib
+
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
     procs = []
     for rank in range(2):
         env = dict(os.environ)
@@ -75,14 +78,19 @@ def test_two_process_gang_over_dcn(tmp_path):
             VTPU_COORDINATOR=f"127.0.0.1:{port}",
             VTPU_NUM_PROCESSES="2",
             VTPU_PROCESS_ID=str(rank),
-            PYTHONPATH=os.getcwd(),
+            PYTHONPATH=repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
         )
         env.pop("PALLAS_AXON_POOL_IPS", None)
         procs.append(subprocess.Popen(
             [sys.executable, "-c", worker], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         ))
-    for p in procs:
-        out, err = p.communicate(timeout=300)
-        assert p.returncode == 0, f"rank failed:\n{out}\n{err[-2000:]}"
-        assert "gang ok" in out
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"rank failed:\n{out}\n{err[-2000:]}"
+            assert "gang ok" in out
+    finally:
+        for p in procs:  # a failed rank must not leak its sibling
+            if p.poll() is None:
+                p.kill()

@@ -150,6 +150,38 @@ class Client:
             params["fieldSelector"] = f"spec.nodeName={node_name}"
         return self._request("GET", "/api/v1/pods", params=params or None).get("items", [])
 
+    def list_pods_raw(self) -> dict:
+        """Full list response incl. ``metadata.resourceVersion`` — the
+        point to resume a watch from."""
+        return self._request("GET", "/api/v1/pods")
+
+    def watch_pods(self, resource_version: Optional[str] = None,
+                   timeout_s: float = 30.0):
+        """Stream pod change events (the informer path, replacing the
+        O(cluster) full re-list every poll): yields ``(type, pod)`` for
+        ADDED / MODIFIED / DELETED until the server closes the watch
+        window.  Callers re-list + re-watch on exhaustion or error."""
+        params = {"watch": "true", "timeoutSeconds": str(int(timeout_s))}
+        if resource_version:
+            params["resourceVersion"] = str(resource_version)
+        url = self.base_url + "/api/v1/pods?" + urllib.parse.urlencode(params)
+        req = urllib.request.Request(url, method="GET")
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(
+                req, context=self._ctx, timeout=timeout_s + 30
+            ) as resp:
+                for line in resp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    ev = json.loads(line)
+                    yield ev["type"], ev["object"]
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")) from e
+
     def patch_pod_annotations(
         self, namespace: str, name: str, annotations: Dict[str, Optional[str]]
     ) -> dict:

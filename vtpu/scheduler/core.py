@@ -119,16 +119,70 @@ class Scheduler:
                 elif hs.startswith(HandshakeState.DELETED):
                     continue
 
-    def ingest_pods(self) -> None:
-        """Informer-lite: rebuild pod assignment state (ref onAddPod/onDelPod
-        scheduler.go:75-113)."""
+    def _sync_pods(self, pods: list) -> None:
+        """Full reconcile from a complete pod list (shared by the poll
+        path and the informer's re-list)."""
         seen = set()
-        for pod in self.client.list_pods():
+        for pod in pods:
             seen.add(pod_uid(pod))
             self.pods.ingest(pod)
         for uid in list(self.pods.all_pods()):
             if uid not in seen:
                 self.pods.rm_pod(uid)
+
+    def ingest_pods(self) -> None:
+        """Informer-lite: rebuild pod assignment state (ref onAddPod/onDelPod
+        scheduler.go:75-113)."""
+        self._sync_pods(self.client.list_pods())
+
+    def apply_pod_event(self, etype: str, pod: dict) -> bool:
+        """Incremental informer update from a watch event.  Returns False
+        when the event is not a pod mutation (ERROR — e.g. the server's
+        410 Gone after etcd compaction — or an unknown type): the caller
+        must fall back to a full re-list rather than ingest a Status
+        object as a pod."""
+        if etype == "DELETED":
+            self.pods.rm_pod(pod_uid(pod))
+        elif etype in ("ADDED", "MODIFIED"):
+            self.pods.ingest(pod)
+        elif etype == "BOOKMARK":
+            pass  # progress marker only; nothing to apply
+        else:
+            log.warning("pod watch: non-pod event %s: %.200s", etype, pod)
+            return False
+        return True
+
+    def watch_pods_loop(self) -> None:
+        """The informer path: one full list (capturing resourceVersion),
+        then server-side watches applied incrementally.  A closed watch
+        window re-watches from the last delivered event's
+        resourceVersion — the full O(cluster) re-list happens only on
+        startup, watch errors, or an ERROR event (410 Gone).  Requires a
+        client with ``watch_pods``/``list_pods_raw`` (the real REST
+        client); ``run_background_loops`` falls back to the polling
+        re-list for clients without it."""
+        rv: Optional[str] = None
+        while not self._stop.is_set():
+            try:
+                if rv is None:
+                    raw = self.client.list_pods_raw()
+                    self._sync_pods(raw.get("items", []))
+                    rv = raw.get("metadata", {}).get("resourceVersion")
+                for etype, pod in self.client.watch_pods(
+                    resource_version=rv, timeout_s=30
+                ):
+                    if not self.apply_pod_event(etype, pod):
+                        rv = None  # ERROR → clean re-list
+                        break
+                    ev_rv = pod.get("metadata", {}).get("resourceVersion")
+                    if ev_rv:
+                        rv = ev_rv
+                    if self._stop.is_set():
+                        return
+            except Exception:  # noqa: BLE001 — keep the informer alive
+                log.exception("pod watch error; re-listing")
+                rv = None
+                self._stop.wait(2)
 
     def legacy_register_servicer(self):
         """Legacy gRPC DeviceService.Register consumer (ref Register
@@ -149,11 +203,22 @@ class Scheduler:
         )
 
     def run_background_loops(self) -> None:
+        # pods: watch-based informer when the client supports it (one
+        # list + incremental events); polling re-list otherwise
+        watching = hasattr(self.client, "watch_pods") and hasattr(
+            self.client, "list_pods_raw"
+        )
+        if watching:
+            threading.Thread(
+                target=self.watch_pods_loop, name="vtpu-pod-watch", daemon=True
+            ).start()
+
         def loop() -> None:
             while not self._stop.is_set():
                 try:
                     self.register_from_node_annotations()
-                    self.ingest_pods()
+                    if not watching:
+                        self.ingest_pods()
                 except Exception:  # noqa: BLE001 — keep the loop alive
                     log.exception("registry loop error")
                 self._stop.wait(REGISTRY_POLL_INTERVAL_S)
