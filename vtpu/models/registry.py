@@ -9,9 +9,11 @@ import jax.numpy as jnp
 from vtpu.models.deeplab import DeepLabV3
 from vtpu.models.lstm import LSTMClassifier
 from vtpu.models.resnet import ResNetV2_50, ResNetV2_101, ResNetV2_152
+from vtpu.models.transformer import TransformerLM
 from vtpu.models.vgg import VGG16
 
-# name -> (ctor, example input shape fn(batch))  (shapes from README.md:193-206)
+# name -> (ctor, example input shape fn(batch))  (shapes from README.md:193-206;
+# "transformer" is the long-context family beyond the reference's set)
 MODELS: Dict[str, Tuple[Callable, Callable[[int], tuple], Any]] = {
     "resnet50": (ResNetV2_50, lambda b: (b, 346, 346, 3), jnp.float32),
     "resnet101": (ResNetV2_101, lambda b: (b, 256, 256, 3), jnp.float32),
@@ -19,6 +21,7 @@ MODELS: Dict[str, Tuple[Callable, Callable[[int], tuple], Any]] = {
     "vgg16": (VGG16, lambda b: (b, 224, 224, 3), jnp.float32),
     "deeplab": (DeepLabV3, lambda b: (b, 512, 512, 3), jnp.float32),
     "lstm": (LSTMClassifier, lambda b: (b, 300), jnp.int32),
+    "transformer": (TransformerLM, lambda b: (b, 512), jnp.int32),
 }
 
 

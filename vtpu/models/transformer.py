@@ -1,0 +1,126 @@
+"""Decoder-only transformer LM — the long-context workload family.
+
+Beyond the reference's ai-benchmark set (conv/LSTM era): this is the
+model shape the framework's long-context machinery exists for.  The
+block uses the repo's own TPU hot ops — the Pallas flash-attention
+kernel (vtpu.ops.attention; online softmax, no [S,S] score matrix in
+HBM) and the fused LayerNorm — and its axes are laid out for SPMD:
+
+- heads on a ``tp`` mesh axis (attention + MLP hidden sharded by
+  PartitionSpec on the parameter dims; XLA inserts the collectives),
+- sequence on an ``sp`` axis via ring attention or Ulysses
+  (vtpu.parallel.{ring,ulysses}) when sequences outgrow one chip,
+- batch on ``dp``.
+
+Static shapes throughout; the scan over blocks is a Python loop over a
+static depth (unrolled by jit) — no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from vtpu.ops.attention import flash_attention, reference_attention, _on_tpu
+from vtpu.ops.layernorm import fused_layernorm
+
+
+class _LayerNorm(nn.Module):
+    """LayerNorm backed by the fused Pallas kernel on TPU."""
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        gamma = self.param("scale", nn.initializers.ones, (d,))
+        beta = self.param("bias", nn.initializers.zeros, (d,))
+        return fused_layernorm(x, gamma, beta)
+
+
+class Attention(nn.Module):
+    num_heads: int
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        assert d % self.num_heads == 0, "num_heads must divide d_model"
+        hd = d // self.num_heads
+        qkv = nn.Dense(3 * d, use_bias=False, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, s, self.num_heads, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if _on_tpu():
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            o = reference_attention(q, k, v, causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+        return nn.Dense(d, use_bias=False, name="out")(o)
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        x = x + Attention(self.num_heads, name="attn")(_LayerNorm(name="ln1")(x))
+        h = nn.Dense(self.mlp_ratio * d, name="mlp_in")(_LayerNorm(name="ln2")(x))
+        x = x + nn.Dense(d, name="mlp_out")(nn.gelu(h))
+        return x
+
+
+class TransformerLM(nn.Module):
+    """GPT-style causal LM.  tokens: [batch, seq] int32 → logits
+    [batch, seq, vocab] (f32 — the final-layer upcast keeps the loss
+    numerically sane under bf16 weights)."""
+
+    vocab: int = 32000
+    d_model: int = 512
+    depth: int = 8
+    num_heads: int = 8
+    max_seq: int = 2048
+
+    @nn.compact
+    def __call__(self, tokens):
+        b, s = tokens.shape
+        assert s <= self.max_seq, f"seq {s} > max_seq {self.max_seq}"
+        x = nn.Embed(self.vocab, self.d_model, name="wte")(tokens)
+        pos = nn.Embed(self.max_seq, self.d_model, name="wpe")(
+            jnp.arange(s)[None, :]
+        )
+        x = x + pos
+        for i in range(self.depth):
+            x = Block(self.num_heads, name=f"h{i}")(x)
+        x = _LayerNorm(name="ln_f")(x)
+        logits = nn.Dense(self.vocab, use_bias=False, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+def lm_loss(logits, tokens) -> jax.Array:
+    """Next-token cross entropy (shifted); tokens: [b, s]."""
+    logp = jax.nn.log_softmax(logits[:, :-1])
+    tgt = tokens[:, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def tp_param_specs(axis: str = "tp"):
+    """PartitionSpec tree hints for tensor parallelism: qkv/mlp_in shard
+    their OUTPUT feature dim, out/mlp_out their INPUT dim — the
+    Megatron-style column/row split; XLA inserts the psums."""
+    from jax.sharding import PartitionSpec as P
+
+    def match(path: str) -> Optional[object]:
+        if path.endswith(("qkv/kernel", "mlp_in/kernel")):
+            return P(None, axis)
+        if path.endswith(("out/kernel", "mlp_out/kernel")):
+            return P(axis, None)
+        return P()
+
+    return match

@@ -73,10 +73,36 @@ def reference_attention(q, k, v, causal: bool = False):
     return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
                     block_k: int = 128):
-    """q,k,v: [batch, heads, seq, d] (or [seq, d]).  Static shapes only."""
+    """q,k,v: [batch, heads, seq, d] (or [seq, d]).  Static shapes only.
+
+    Differentiable: the forward is the Pallas online-softmax kernel; the
+    backward differentiates the reference formulation (scores
+    rematerialized by XLA — O(S²) in the backward only; a fused backward
+    kernel is the known next optimization)."""
+    return _flash_impl(q, k, v, causal, block_q, block_k)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    return _flash_impl(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, res, ct):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: reference_attention(a, b, c, causal), q, k, v
+    )
+    return vjp(ct)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def _flash_impl(q, k, v, causal: bool = False, block_q: int = 128,
+                block_k: int = 128):
     if q.ndim == 2:
         return _flash_2d(q, k, v, causal, block_q, block_k)
     batch_shape = q.shape[:-2]

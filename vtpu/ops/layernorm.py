@@ -32,9 +32,38 @@ def _on_tpu() -> bool:
         return False
 
 
-@functools.partial(jax.jit, static_argnames=("eps", "block_rows"))
+def _reference_ln(x, gamma, beta, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    return y.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def fused_layernorm(x, gamma, beta, eps: float = 1e-6, block_rows: int = 256):
-    """LayerNorm over the last axis.  x: [..., d]; gamma/beta: [d]."""
+    """LayerNorm over the last axis.  x: [..., d]; gamma/beta: [d].
+
+    Differentiable: the forward runs the fused Pallas kernel; the
+    backward is the exact layernorm gradient derived from the reference
+    formulation (pallas_call has no autodiff rule of its own)."""
+    return _fused_layernorm_impl(x, gamma, beta, eps, block_rows)
+
+
+def _ln_fwd(x, gamma, beta, eps, block_rows):
+    return _fused_layernorm_impl(x, gamma, beta, eps, block_rows), (x, gamma, beta)
+
+
+def _ln_bwd(eps, block_rows, res, ct):
+    x, gamma, beta = res
+    _, vjp = jax.vjp(lambda a, g, b: _reference_ln(a, g, b, eps), x, gamma, beta)
+    return vjp(ct)
+
+
+fused_layernorm.defvjp(_ln_fwd, _ln_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "block_rows"))
+def _fused_layernorm_impl(x, gamma, beta, eps: float = 1e-6, block_rows: int = 256):
     import math
 
     orig_shape = x.shape
