@@ -17,9 +17,11 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                 sm_scale: float):
-    # q_ref: [block_q, d]; k_ref/v_ref: [S, d]; grid dim 0 walks q blocks
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                 causal: bool, sm_scale: float):
+    # q_ref: [block_q, d]; k_ref/v_ref: [S, d]; grid dim 0 walks q blocks.
+    # Also emits the per-row logsumexp (lse) the backward kernels need to
+    # rematerialize p without a second online-softmax pass.
     q = q_ref[:].astype(jnp.float32) * sm_scale
     seq_len = k_ref.shape[0]
     block_q = q.shape[0]
@@ -49,10 +51,103 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(
-        0, seq_len // block_k, body, (acc0, m0, l0)
-    )
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    n_blocks = seq_len // block_k
+    if causal:
+        # kv blocks fully above the diagonal contribute nothing — skip
+        hi = jnp.minimum(
+            n_blocks, ((q_idx + 1) * block_q + block_k - 1) // block_k
+        )
+    else:
+        hi = n_blocks
+    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[:] = m + jnp.log(l_safe)
+
+
+def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, *, block_k: int, causal: bool,
+                        sm_scale: float):
+    """dq for one q block: recompute p from (scores − lse), accumulate
+    ds @ k over kv blocks.  delta = rowsum(do * o), precomputed."""
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:].astype(jnp.float32)
+    delta = delta_ref[:].astype(jnp.float32)
+    seq_len = k_ref.shape[0]
+    block_q = q.shape[0]
+    q_idx = pl.program_id(0)
+
+    def body(start, dq):
+        k = k_ref[pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(start * block_k, block_k), :].astype(jnp.float32)
+        s = (q @ k.T) * sm_scale
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = start * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = do @ v.T
+        ds = p * (dp - delta) * sm_scale
+        return dq + ds @ k
+
+    dq0 = jnp.zeros_like(q)
+    n_blocks = seq_len // block_k
+    if causal:
+        # kv blocks entirely above the diagonal are all-zero after the
+        # mask — skip them (≈2× less MXU work on average)
+        hi = jnp.minimum(
+            n_blocks, ((q_idx + 1) * block_q + block_k - 1) // block_k
+        )
+    else:
+        hi = n_blocks
+    dq = jax.lax.fori_loop(0, hi, body, dq0)
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _attn_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                         dk_ref, dv_ref, *, block_q: int, causal: bool,
+                         sm_scale: float):
+    """dk/dv for one kv block: loop over q blocks, transposed products."""
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    seq_len = q_ref.shape[0]
+    block_k = k.shape[0]
+    k_idx = pl.program_id(0)
+
+    def body(start, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(start * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(start * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(start * block_q, block_q), :].astype(jnp.float32)
+        delta = delta_ref[pl.ds(start * block_q, block_q), :].astype(jnp.float32)
+        s = (q @ k.T) * sm_scale
+        if causal:
+            q_pos = start * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv = dv + p.T @ do
+        dp = do @ v.T
+        ds = p * (dp - delta) * sm_scale
+        dk = dk + ds.T @ q
+        return dk, dv
+
+    z = jnp.zeros_like(k)
+    # q blocks entirely left of the diagonal see only masked-out scores
+    # for this kv block — start at the first block that can attend here
+    lo = (k_idx * block_k) // block_q if causal else 0
+    dk, dv = jax.lax.fori_loop(lo, seq_len // block_q, body, (z, z))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
 def _on_tpu() -> bool:
@@ -73,31 +168,49 @@ def reference_attention(q, k, v, causal: bool = False):
     return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _kernel_ok(q, k, block_q, block_k) -> bool:
+    return not (q.shape[-2] % block_q or k.shape[-2] % block_k)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
                     block_k: int = 128):
     """q,k,v: [batch, heads, seq, d] (or [seq, d]).  Static shapes only.
 
-    Differentiable: the forward is the Pallas online-softmax kernel; the
-    backward differentiates the reference formulation (scores
-    rematerialized by XLA — O(S²) in the backward only; a fused backward
-    kernel is the known next optimization)."""
-    return _flash_impl(q, k, v, causal, block_q, block_k)
+    Fully fused autodiff: the forward is the Pallas online-softmax
+    kernel (emitting per-row logsumexp), and the backward is a pair of
+    Pallas kernels (dq; dk+dv) that rematerialize p blockwise from the
+    saved lse — the [S,S] score matrix never hits HBM in either
+    direction.  Ragged shapes fall back to the XLA reference both ways."""
+    return _flash_impl(q, k, v, causal, block_q, block_k)[0]
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
-    return _flash_impl(q, k, v, causal, block_q, block_k), (q, k, v)
+    o, lse = _flash_impl(q, k, v, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, res, ct):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda a, b, c: reference_attention(a, b, c, causal), q, k, v
-    )
-    return vjp(ct)
+    q, k, v, o, lse = res
+    if not _kernel_ok(q, k, block_q, block_k):
+        _, vjp = jax.vjp(
+            lambda a, b, c: reference_attention(a, b, c, causal), q, k, v
+        )
+        return vjp(ct)
+    return _flash_bwd_impl(q, k, v, o, lse, ct, causal, block_q, block_k)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _map_batched(fn, *arrays, out_rank=2):
+    """vmap a 2D-op over flattened leading dims ([..., s, x] inputs)."""
+    batch_shape = arrays[0].shape[:-out_rank]
+    flat = [a.reshape((-1,) + a.shape[-out_rank:]) for a in arrays]
+    out = jax.vmap(fn)(*flat)
+    if isinstance(out, tuple):
+        return tuple(o.reshape(batch_shape + o.shape[1:]) for o in out)
+    return out.reshape(batch_shape + out.shape[1:])
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
@@ -105,33 +218,102 @@ def _flash_impl(q, k, v, causal: bool = False, block_q: int = 128,
                 block_k: int = 128):
     if q.ndim == 2:
         return _flash_2d(q, k, v, causal, block_q, block_k)
-    batch_shape = q.shape[:-2]
-    flat_q = q.reshape((-1,) + q.shape[-2:])
-    flat_k = k.reshape((-1,) + k.shape[-2:])
-    flat_v = v.reshape((-1,) + v.shape[-2:])
-    out = jax.vmap(
-        lambda a, b, c: _flash_2d(a, b, c, causal, block_q, block_k)
-    )(flat_q, flat_k, flat_v)
-    return out.reshape(batch_shape + q.shape[-2:])
+    return _map_batched(
+        lambda a, b, c: _flash_2d(a, b, c, causal, block_q, block_k),
+        q, k, v,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def _flash_bwd_impl(q, k, v, o, lse, ct, causal, block_q, block_k):
+    if q.ndim == 2:
+        return _flash_bwd_2d(q, k, v, o, lse, ct, causal, block_q, block_k)
+    return _map_batched(
+        lambda a, b, c, oo, ll, cc: _flash_bwd_2d(
+            a, b, c, oo, ll, cc, causal, block_q, block_k
+        ),
+        q, k, v, o, lse, ct,
+    )
 
 
 def _flash_2d(q, k, v, causal, block_q, block_k):
     seq_q, d = q.shape
     seq_k = k.shape[0]
     if seq_q % block_q or seq_k % block_k:
-        return reference_attention(q, k, v, causal)
+        o = reference_attention(q, k, v, causal)
+        # lse unused on this path (backward falls back too)
+        return o, jnp.zeros((seq_q, 1), jnp.float32)
     sm_scale = d**-0.5
     return pl.pallas_call(
         functools.partial(
             _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale
         ),
-        out_shape=jax.ShapeDtypeStruct((seq_q, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((seq_q, 1), jnp.float32),
+        ],
         grid=(seq_q // block_q,),
         in_specs=[
             pl.BlockSpec((block_q, d), lambda i: (i, 0)),
             pl.BlockSpec((seq_k, d), lambda i: (0, 0)),
             pl.BlockSpec((seq_k, d), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((block_q, d), lambda i: (i, 0)),
+        out_specs=[
+            pl.BlockSpec((block_q, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_q, 1), lambda i: (i, 0)),
+        ],
         interpret=not _on_tpu(),
     )(q, k, v)
+
+
+def _flash_bwd_2d(q, k, v, o, lse, do, causal, block_q, block_k):
+    seq_q, d = q.shape
+    seq_k = k.shape[0]
+    sm_scale = d**-0.5
+    # delta_i = do_i · o_i — one cheap fused XLA reduction
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )
+    dq = pl.pallas_call(
+        functools.partial(
+            _attn_bwd_dq_kernel, block_k=block_k, causal=causal,
+            sm_scale=sm_scale,
+        ),
+        out_shape=jax.ShapeDtypeStruct((seq_q, d), q.dtype),
+        grid=(seq_q // block_q,),
+        in_specs=[
+            pl.BlockSpec((block_q, d), lambda i: (i, 0)),   # q
+            pl.BlockSpec((seq_k, d), lambda i: (0, 0)),     # k
+            pl.BlockSpec((seq_k, d), lambda i: (0, 0)),     # v
+            pl.BlockSpec((block_q, d), lambda i: (i, 0)),   # do
+            pl.BlockSpec((block_q, 1), lambda i: (i, 0)),   # lse
+            pl.BlockSpec((block_q, 1), lambda i: (i, 0)),   # delta
+        ],
+        out_specs=pl.BlockSpec((block_q, d), lambda i: (i, 0)),
+        interpret=not _on_tpu(),
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _attn_bwd_dkv_kernel, block_q=block_q, causal=causal,
+            sm_scale=sm_scale,
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((seq_k, d), k.dtype),
+            jax.ShapeDtypeStruct((seq_k, d), v.dtype),
+        ],
+        grid=(seq_k // block_k,),
+        in_specs=[
+            pl.BlockSpec((block_k, d), lambda i: (i, 0)),   # k
+            pl.BlockSpec((block_k, d), lambda i: (i, 0)),   # v
+            pl.BlockSpec((seq_q, d), lambda i: (0, 0)),     # q
+            pl.BlockSpec((seq_q, d), lambda i: (0, 0)),     # do
+            pl.BlockSpec((seq_q, 1), lambda i: (0, 0)),     # lse
+            pl.BlockSpec((seq_q, 1), lambda i: (0, 0)),     # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((block_k, d), lambda i: (i, 0)),
+            pl.BlockSpec((block_k, d), lambda i: (i, 0)),
+        ],
+        interpret=not _on_tpu(),
+    )(k, v, q, do, lse, delta)
+    return dq, dk, dv
