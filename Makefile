@@ -40,7 +40,13 @@ test-native: shim
 	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/oom.cache \
 	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
 	  sh -c './build/test_shim build/libvtpu_shim.so oomkill; test $$? -eq 137' \
-	  && echo "ok - ACTIVE_OOM_KILLER killed the over-quota tenant (137)" \
+	  && echo "ok - ACTIVE_OOM_KILLER killed the over-quota tenant (137)"
+	cd cpp && MOCK_PJRT_DEVICES=2 \
+	  TPU_DEVICE_MEMORY_LIMIT_0=64 TPU_DEVICE_MEMORY_LIMIT_1=32 \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0,mock-tpu-1 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/multi.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
+	  ./build/test_shim build/libvtpu_shim.so multidev \
 	  && rm -rf /tmp/vtpu-make-test
 
 bench:

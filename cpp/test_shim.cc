@@ -173,6 +173,54 @@ static int run_execfail_mode() {
   return 0;
 }
 
+/* multi-device mode (MOCK_PJRT_DEVICES=2, per-device quota envs): each
+ * chip's quota is independent — filling device 1 must not affect
+ * device 0's headroom, and destroys release the right device. */
+static int run_multidev_mode() {
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == nullptr, "client create (multidev)");
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = ca.client;
+  CHECK(api->PJRT_Client_AddressableDevices(&da) == nullptr, "devices");
+  CHECK(da.num_addressable_devices == 2, "two mock devices");
+  PJRT_Device* d0 = da.addressable_devices[0];
+  PJRT_Device* d1 = da.addressable_devices[1];
+
+  PJRT_Error* err = nullptr;
+  /* quotas: dev0 = 64 MiB, dev1 = 32 MiB (set by the runner) */
+  PJRT_Buffer* a = make_buffer(ca.client, d1, 30, &err);
+  CHECK(err == nullptr && a != nullptr, "30MiB on dev1 under its 32MiB quota");
+  make_buffer(ca.client, d1, 30, &err);
+  CHECK(err != nullptr, "second 30MiB on dev1 rejected");
+  destroy_error(err);
+  err = nullptr;
+  PJRT_Buffer* b = make_buffer(ca.client, d0, 60, &err);
+  CHECK(err == nullptr && b != nullptr,
+        "60MiB on dev0 unaffected by dev1's full quota");
+
+  PJRT_Device_MemoryStats_Args ms;
+  memset(&ms, 0, sizeof(ms));
+  ms.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms.device = d1;
+  CHECK(api->PJRT_Device_MemoryStats(&ms) == nullptr, "stats dev1");
+  CHECK(ms.bytes_limit == 32LL * 1024 * 1024, "dev1 reports ITS quota");
+  CHECK(ms.bytes_in_use == 30LL * 1024 * 1024, "dev1 usage isolated");
+
+  PJRT_Buffer_Destroy_Args bd;
+  memset(&bd, 0, sizeof(bd));
+  bd.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  bd.buffer = a;
+  CHECK(api->PJRT_Buffer_Destroy(&bd) == nullptr, "destroy dev1 buffer");
+  PJRT_Buffer* c = make_buffer(ca.client, d1, 30, &err);
+  CHECK(err == nullptr && c != nullptr, "dev1 headroom restored after free");
+  printf("all multidev-mode tests passed\n");
+  return 0;
+}
+
 int main(int argc, char** argv) {
   const char* shim = argc > 1 ? argv[1] : "build/libvtpu_shim.so";
   void* h = dlopen(shim, RTLD_NOW);
@@ -187,6 +235,7 @@ int main(int argc, char** argv) {
   if (argc > 2 && strcmp(argv[2], "swap") == 0) return run_swap_mode();
   if (argc > 2 && strcmp(argv[2], "oomkill") == 0) return run_oomkill_mode();
   if (argc > 2 && strcmp(argv[2], "execfail") == 0) return run_execfail_mode();
+  if (argc > 2 && strcmp(argv[2], "multidev") == 0) return run_multidev_mode();
 
   PJRT_Client_Create_Args ca;
   memset(&ca, 0, sizeof(ca));

@@ -1,0 +1,44 @@
+"""Entrypoint smoke: every daemon binary parses --help without importing
+half-broken modules (catches import-time and argparse regressions the
+unit suites can't, since they import library modules directly)."""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+CMDS = [
+    "cmd/vtpu_scheduler.py",
+    "cmd/vtpu_device_plugin.py",
+    "cmd/vtpu_monitor.py",
+    "cmd/testcollector.py",
+]
+
+
+def _run(cmd, *args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no accidental chip grabs
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, cmd), *args],
+        env=env, capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+
+
+@pytest.mark.parametrize("cmd", CMDS)
+def test_cmd_help(cmd):
+    proc = _run(cmd, "--help")
+    assert proc.returncode == 0, f"{cmd}: rc={proc.returncode}\n{proc.stderr[-1500:]}"
+    assert "usage" in proc.stdout.lower() or "usage" in proc.stderr.lower()
+
+
+def test_oci_runtime_forwards_argv():
+    """The OCI wrapper has no flags of its own — it must pass everything
+    (incl. --help) through to the real runtime via exec."""
+    proc = _run("cmd/vtpu_oci_runtime.py", "--help")
+    # the exec target (runc) doesn't exist in this sandbox: the forward
+    # attempt itself is the assertion
+    assert proc.returncode != 0
+    assert "runc" in proc.stderr

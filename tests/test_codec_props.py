@@ -1,0 +1,85 @@
+"""Property-based fuzz of the annotation wire codecs — the cross-process
+contract everything rides on (ref util.go:82-172).  The reference ships
+two hand-picked cases; these generate thousands."""
+
+import string
+
+from hypothesis import given, settings, strategies as st
+
+from vtpu.utils import codec
+from vtpu.utils.types import ChipInfo, ContainerDevice
+
+# wire-safe identifier: the codecs delimit with "," ":" ";" — uuids/types
+# come from device enumeration which never contains those
+_ident = st.text(
+    alphabet=string.ascii_letters + string.digits + "-._",
+    min_size=1, max_size=32,
+)
+
+_chips = st.lists(
+    st.builds(
+        ChipInfo,
+        uuid=_ident,
+        count=st.integers(0, 1000),
+        hbm_mb=st.integers(0, 1 << 20),
+        cores=st.integers(0, 100),
+        type=_ident,
+        health=st.booleans(),
+        coords=st.one_of(
+            st.none(),
+            st.tuples(st.integers(0, 15), st.integers(0, 15), st.integers(0, 15)),
+        ),
+    ),
+    max_size=8,
+)
+
+
+@settings(max_examples=300, deadline=None)
+@given(_chips)
+def test_node_devices_round_trip(chips):
+    enc = codec.encode_node_devices(chips)
+    got = codec.decode_node_devices(enc)
+    assert len(got) == len(chips)
+    for a, b in zip(got, chips):
+        assert (a.uuid, a.count, a.hbm_mb, a.type, a.health) == (
+            b.uuid, b.count, b.hbm_mb, b.type, b.health
+        )
+        assert a.coords == b.coords
+
+
+_ctr_devices = st.lists(
+    st.lists(
+        st.builds(
+            ContainerDevice,
+            uuid=_ident,
+            type=_ident,
+            usedmem=st.integers(0, 1 << 20),
+            usedcores=st.integers(0, 100),
+        ),
+        max_size=4,
+    ),
+    max_size=4,
+)
+
+
+@settings(max_examples=300, deadline=None)
+@given(_ctr_devices)
+def test_pod_devices_round_trip(ctrs):
+    enc = codec.encode_pod_devices(ctrs)
+    got = codec.decode_pod_devices(enc)
+    # trailing empty containers collapse on the wire (the reference's
+    # format cannot distinguish [] from [[]]); non-empty content survives
+    assert [c for c in got if c] == [c for c in ctrs if c]
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.text(max_size=64))
+def test_decode_never_crashes_on_garbage(blob):
+    """Decoders reject or tolerate arbitrary annotation garbage without
+    raising anything but ValueError (a k8s user can write any string)."""
+    for fn in (codec.decode_node_devices, codec.decode_pod_devices,
+               codec.decode_container_devices):
+        try:
+            fn(blob)
+        except ValueError:
+            pass

@@ -17,6 +17,20 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
+def _causal_mask(s, q_start, k_start):
+    """Mask scores s: [bq, bk] so position q attends only to k <= q."""
+    bq, bk = s.shape
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
+def _causal_hi(q_idx, block_q, block_k, n_blocks):
+    """First kv-block index past the diagonal for q block q_idx — blocks
+    at or beyond it are fully masked and can be skipped."""
+    return jnp.minimum(n_blocks, ((q_idx + 1) * block_q + block_k - 1) // block_k)
+
+
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                  causal: bool, sm_scale: float):
     # q_ref: [block_q, d]; k_ref/v_ref: [S, d]; grid dim 0 walks q blocks.
@@ -33,13 +47,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         v = v_ref[pl.ds(start * block_k, block_k), :].astype(jnp.float32)
         s = q @ k.T  # [block_q, block_k] on the MXU
         if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = start * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, q_idx * block_q, start * block_k)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
@@ -52,13 +60,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     n_blocks = seq_len // block_k
-    if causal:
-        # kv blocks fully above the diagonal contribute nothing — skip
-        hi = jnp.minimum(
-            n_blocks, ((q_idx + 1) * block_q + block_k - 1) // block_k
-        )
-    else:
-        hi = n_blocks
+    # kv blocks fully above the diagonal contribute nothing — skip them
+    hi = _causal_hi(q_idx, block_q, block_k, n_blocks) if causal else n_blocks
     acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
@@ -83,13 +86,7 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[pl.ds(start * block_k, block_k), :].astype(jnp.float32)
         s = (q @ k.T) * sm_scale
         if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = start * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, q_idx * block_q, start * block_k)
         p = jnp.exp(s - lse)
         dp = do @ v.T
         ds = p * (dp - delta) * sm_scale
@@ -97,14 +94,8 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     dq0 = jnp.zeros_like(q)
     n_blocks = seq_len // block_k
-    if causal:
-        # kv blocks entirely above the diagonal are all-zero after the
-        # mask — skip them (≈2× less MXU work on average)
-        hi = jnp.minimum(
-            n_blocks, ((q_idx + 1) * block_q + block_k - 1) // block_k
-        )
-    else:
-        hi = n_blocks
+    # kv blocks above the diagonal are all-zero after the mask — skip
+    hi = _causal_hi(q_idx, block_q, block_k, n_blocks) if causal else n_blocks
     dq = jax.lax.fori_loop(0, hi, body, dq0)
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
@@ -127,13 +118,7 @@ def _attn_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[pl.ds(start * block_q, block_q), :].astype(jnp.float32)
         s = (q @ k.T) * sm_scale
         if causal:
-            q_pos = start * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, start * block_q, k_idx * block_k)
         p = jnp.exp(s - lse)
         dv = dv + p.T @ do
         dp = do @ v.T
