@@ -18,8 +18,8 @@ CMDS = [
 ]
 
 
-def _run(cmd, *args):
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+def _run(cmd, *args, **env_extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO, **env_extra)
     env.pop("PALLAS_AXON_POOL_IPS", None)  # no accidental chip grabs
     return subprocess.run(
         [sys.executable, os.path.join(REPO, cmd), *args],
@@ -36,9 +36,12 @@ def test_cmd_help(cmd):
 
 def test_oci_runtime_forwards_argv():
     """The OCI wrapper has no flags of its own — it must pass everything
-    (incl. --help) through to the real runtime via exec."""
-    proc = _run("cmd/vtpu_oci_runtime.py", "--help")
-    # the exec target (runc) doesn't exist in this sandbox: the forward
-    # attempt itself is the assertion
+    (incl. --help) through to the real runtime via exec.  Point it at a
+    guaranteed-nonexistent runtime so the test never execs a real runc
+    that may be installed on the host."""
+    proc = _run(
+        "cmd/vtpu_oci_runtime.py", "--help",
+        VTPU_OCI_RUNTIME="/nonexistent/vtpu-test-runc",
+    )
     assert proc.returncode != 0
-    assert "runc" in proc.stderr
+    assert "vtpu-test-runc" in proc.stderr

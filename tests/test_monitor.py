@@ -401,16 +401,23 @@ def test_oversubscribed_training_completes_with_swap_accounting(tmp_path):
     assert stats["bytes_host_swapped"] > 0, "nothing used the host tier"
     assert rt.region.usage()[0]["swap"] == stats["bytes_host_swapped"]
 
+    from vtpu.shim import stream_to_device
+
     def loss_fn(p, xb, yb):
         h = jnp.tanh(xb @ p["w1"])
         h = jnp.tanh(h @ p["w2"])
         return jnp.mean((h @ p["w3"] - yb) ** 2)
 
     opt = optax.sgd(1e-2)
-    opt_state = opt.init(params)
+    opt_state = opt.init(jax.eval_shape(lambda p: p, params))
 
     @jax.jit
     def step(p, s, xb, yb):
+        # host-tier tensors stream back to device memory at the top of
+        # the jitted step (the explicit stream-in of the host-offload
+        # pattern; XLA overlaps the copies with compute)
+        p = stream_to_device(p)
+        xb, yb = stream_to_device((xb, yb))
         loss, g = jax.value_and_grad(loss_fn)(p, xb, yb)
         updates, s = opt.update(g, s)
         return optax.apply_updates(p, updates), s, loss

@@ -9,4 +9,8 @@ Two tiers, same env ABI (emitted by the device plugin's Allocate):
    engine behind bench.py's multi-tenant sharing run.
 """
 
-from vtpu.shim.runtime import ShimRuntime, QuotaExceeded  # noqa: F401
+from vtpu.shim.runtime import (  # noqa: F401
+    QuotaExceeded,
+    ShimRuntime,
+    stream_to_device,
+)

@@ -26,6 +26,20 @@ class QuotaExceeded(MemoryError):
     """HBM quota exhausted (the check_oom reject, ref libvgpu.so)."""
 
 
+def stream_to_device(tree, dev: int = 0):
+    """Bring swap-tier (host-memory-space) arrays back to the chip's
+    default memory — the explicit stream-in of the host-offload pattern.
+    Call it on offloaded params inside the jitted step; XLA overlaps the
+    transfer with compute.  No-op for arrays already on device."""
+    import jax
+
+    try:
+        sharding = jax.sharding.SingleDeviceSharding(jax.local_devices()[dev])
+    except (IndexError, RuntimeError):
+        return tree
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
+
+
 def _oom_reject(runtime: "ShimRuntime", msg: str) -> "QuotaExceeded":
     """Build the quota-reject outcome: normally an exception, but with
     ACTIVE_OOM_KILLER the tenant process is terminated — SIGKILL, like
@@ -212,7 +226,7 @@ class ShimRuntime:
                 f"vtpu: device {dev} quota {self.limit_for(dev)} B exceeded "
                 f"(in use {self.device_usage(dev)}, want {nbytes})",
             )
-        out = jax.device_put(x, jax.devices("cpu")[0])
+        out = jax.device_put(x, self._host_tier_target(dev))
         self._swapped[dev] = self._swapped.get(dev, 0) + nbytes
         if self.region is not None:
             # publish the host tier so the monitor's breakdown shows it
@@ -220,6 +234,26 @@ class ShimRuntime:
             self.region.add_usage(self.pid, dev, nbytes, "swap")
         self._record_placement(out, dev, nbytes, "host")
         return out
+
+    @staticmethod
+    def _host_tier_target(dev: int):
+        """Where swap-tier arrays live: the accelerator's own pinned_host
+        memory space when the platform exposes one (DMA-able — the same
+        target the native shim uses), else the cpu backend."""
+        import jax
+
+        try:
+            device = jax.local_devices()[dev]
+            for mem in device.addressable_memories():
+                # exactly pinned_host — unpinned_host is pageable and
+                # would stage every stream-back transfer
+                if mem.kind == "pinned_host":
+                    return jax.sharding.SingleDeviceSharding(
+                        device, memory_kind=mem.kind
+                    )
+        except Exception:  # noqa: BLE001 — cpu-only platforms have no memories API
+            pass
+        return jax.devices("cpu")[0]
 
     def _record_placement(self, out, dev: int, nbytes: int, tier: str) -> None:
         """Track a put for release().  Records stack per object id (a
