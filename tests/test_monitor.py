@@ -326,7 +326,11 @@ def test_noderpc_serves_usage(tmp_path):
     from vtpu.monitor.noderpc import NodeVtpuStub, serve_noderpc
 
     root = str(tmp_path)
-    make_container_region(root, "pod-rpc", used_mb=12, limit_mb=64)
+    d = make_container_region(root, "pod-rpc", used_mb=12, limit_mb=64)
+    # host-tier (swap) bytes must cross the RPC too
+    r = RegionFile(os.path.join(d, REGION_FILENAME))
+    r.add_usage(100, 0, 5 << 20, kind="swap")
+    r.close()
     pm = PathMonitor(root)
     server, port = serve_noderpc(pm, bind="127.0.0.1:0")
     with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
@@ -336,6 +340,7 @@ def test_noderpc_serves_usage(tmp_path):
     assert c.pod_uid == "pod-rpc"
     assert c.devices[0].used_bytes == 12 << 20
     assert c.devices[0].limit_bytes == 64 << 20
+    assert c.devices[0].swap_bytes == 5 << 20
     server.stop(grace=None)
     pm.close()
 

@@ -1,0 +1,63 @@
+"""Sharded checkpoint save/restore for the workload layer.
+
+The reference has no model state to checkpoint (its crash-safety story
+is "annotations are the database", which the scheduler implements in
+vtpu/scheduler/core.py).  The workload layer vtpu adds does have state —
+sharded params/opt trees on a Mesh — and this module wraps orbax so a
+gang job checkpoints and resumes with shardings intact:
+
+    ckpt = Checkpointer("/ckpts/run1")
+    ckpt.save(step, {"params": params, "opt": opt_state})
+    restored = ckpt.restore({"params": params_like, "opt": opt_like})
+
+Restore takes a target tree of like-sharded arrays (or ShapeDtypeStructs
++ shardings) so each host loads only its shards — the multi-host story:
+every process calls save/restore collectively, orbax coordinates via
+jax.distributed (vtpu.parallel.distributed.ensure_initialized()).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class Checkpointer:
+    """Thin orbax CheckpointManager wrapper with retention."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = directory
+        self.manager = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, tree: Any, wait: bool = True) -> None:
+        self.manager.save(
+            step, args=self._ocp.args.StandardSave(tree)
+        )
+        if wait:
+            self.manager.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, target: Any, step: Optional[int] = None) -> Any:
+        """Restore ``step`` (default latest) into the structure/shardings
+        of ``target`` — pass the current (even freshly-initialized) tree
+        so every leaf comes back on its own devices with its own
+        PartitionSpec."""
+        if step is None:
+            step = self.manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        return self.manager.restore(
+            step, args=self._ocp.args.StandardRestore(target)
+        )
+
+    def close(self) -> None:
+        self.manager.close()
