@@ -160,3 +160,68 @@ def test_pipeline_apply_is_differentiable():
     assert np.isfinite(float(val))
     gn = float(jnp.sum(jnp.abs(grads["w"])))
     assert gn > 0, "no gradient reached the pipeline stage weights"
+
+
+def test_moe_top2_matches_dense_oracle():
+    """top_k=2 (GShard-style): each token's output is the gate-weighted
+    sum of its two best experts' FFNs."""
+    devs = np.array(jax.devices())
+    n_exp = len(devs)
+    mesh = Mesh(devs, ("ep",))
+    d, h = 8, 16
+    tokens = 4 * n_exp
+    x = jax.random.normal(jax.random.PRNGKey(0), (tokens, d))
+    router_w = jax.random.normal(jax.random.PRNGKey(1), (d, n_exp))
+    w_in = jax.random.normal(jax.random.PRNGKey(2), (n_exp, d, h)) * 0.1
+    w_out = jax.random.normal(jax.random.PRNGKey(3), (n_exp, h, d)) * 0.1
+    out = moe_ffn(x, router_w, w_in, w_out, mesh, axis="ep",
+                  capacity=2 * tokens, top_k=2)
+    assert out.shape == x.shape
+
+    logits = np.asarray(x) @ np.asarray(router_w)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    want = np.zeros_like(np.asarray(x))
+    for t in range(tokens):
+        for e in np.argsort(logits[t])[-2:]:
+            hdd = np.maximum(np.asarray(x)[t] @ np.asarray(w_in)[e], 0)
+            want[t] += (hdd @ np.asarray(w_out)[e]) * probs[t, e]
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-3, atol=1e-3)
+
+
+def test_moe_top2_renormalized_gates():
+    """renormalize=True: gates divide by the chosen pair's probability
+    mass, so the two weights sum to 1 per token."""
+    devs = np.array(jax.devices())
+    n_exp = len(devs)
+    mesh = Mesh(devs, ("ep",))
+    d, h = 8, 16
+    tokens = 4 * n_exp
+    x = jax.random.normal(jax.random.PRNGKey(0), (tokens, d))
+    router_w = jax.random.normal(jax.random.PRNGKey(1), (d, n_exp))
+    w_in = jax.random.normal(jax.random.PRNGKey(2), (n_exp, d, h)) * 0.1
+    w_out = jax.random.normal(jax.random.PRNGKey(3), (n_exp, h, d)) * 0.1
+    out = moe_ffn(x, router_w, w_in, w_out, mesh, axis="ep",
+                  capacity=2 * tokens, top_k=2, renormalize=True)
+
+    logits = np.asarray(x) @ np.asarray(router_w)
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+    want = np.zeros_like(np.asarray(x))
+    for t in range(tokens):
+        top2 = np.argsort(logits[t])[-2:]
+        mass = probs[t, top2].sum()
+        for e in top2:
+            hdd = np.maximum(np.asarray(x)[t] @ np.asarray(w_in)[e], 0)
+            want[t] += (hdd @ np.asarray(w_out)[e]) * (probs[t, e] / mass)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-3, atol=1e-3)
+
+
+def test_moe_top_k_validation():
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("ep",))
+    n_exp = len(devs)
+    with pytest.raises(ValueError, match="top_k"):
+        moe_ffn(
+            jnp.ones((8, 4)), jnp.ones((4, n_exp)),
+            jnp.ones((n_exp, 4, 4)), jnp.ones((n_exp, 4, 4)),
+            mesh, axis="ep", top_k=n_exp + 1,
+        )

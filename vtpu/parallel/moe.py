@@ -17,13 +17,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
-            capacity: int = 0):
+            capacity: int = 0, top_k: int = 1, renormalize: bool = False):
     """x: [batch_shard_tokens, d] sharded on ``axis``.  router_w:
     [d, n_experts]; w_in: [n_experts, d, h]; w_out: [n_experts, h, d]
     (expert dims sharded on ``axis``).  ``n_experts`` must be a multiple
     of the mesh axis size; shard ``s`` owns the contiguous expert block
-    ``[s*e_local, (s+1)*e_local)``.  Returns the combined expert outputs,
-    same sharding as x."""
+    ``[s*e_local, (s+1)*e_local)``.
+
+    ``top_k`` experts per token (1 = Switch-style, 2 = GShard-style);
+    gates are the FULL-softmax probabilities of the chosen experts, or
+    renormalized over the chosen set when ``renormalize``.  Returns the
+    combined expert outputs, same sharding as x."""
     n_shards = mesh.shape[axis]
     n_exp = w_in.shape[0]
     if n_exp % n_shards != 0:
@@ -35,29 +39,43 @@ def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
         raise ValueError(
             f"router_w maps to {router_w.shape[-1]} experts, weights have {n_exp}"
         )
+    if not 1 <= top_k <= n_exp:
+        raise ValueError(f"top_k={top_k} out of range for {n_exp} experts")
     e_local = n_exp // n_shards
     if capacity <= 0:
-        capacity = max(1, x.shape[0] // n_exp)
+        # per-SOURCE-shard per-expert slots: x.shape[0] is the global
+        # token count (P(axis) shards it), so the expected load per shard
+        # per expert is top_k * tokens_per_shard / n_exp (capacity
+        # factor 1; pass `capacity` explicitly for headroom)
+        tokens_per_shard = max(1, x.shape[0] // n_shards)
+        capacity = max(1, -(-top_k * tokens_per_shard // n_exp))
 
     def shard_fn(x_s, rw, wi, wo):
         # local expert weights: [e_local, d, h] / [e_local, h, d]
         t, d = x_s.shape
-        # route: top-1 expert per token (global expert id)
+        # route: top-k experts per token (global expert ids)
         logits = x_s @ rw                              # [t, n_exp]
-        expert = jnp.argmax(logits, axis=-1)           # [t]
-        gate = jax.nn.softmax(logits, axis=-1)
-        gate = jnp.take_along_axis(gate, expert[:, None], axis=1)[:, 0]
-        # position of each token within its expert's capacity buffer
-        onehot = jax.nn.one_hot(expert, n_exp, dtype=jnp.int32)  # [t, e]
+        probs = jax.nn.softmax(logits, axis=-1)
+        _, expert = jax.lax.top_k(logits, top_k)       # [t, k]
+        gate = jnp.take_along_axis(probs, expert, axis=1)  # [t, k]
+        if renormalize:
+            gate = gate / jnp.maximum(
+                jnp.sum(gate, axis=-1, keepdims=True), 1e-9
+            )
+        # one dispatch slot per (token, k); token order preserved so the
+        # capacity cumsum stays deterministic
+        ef = expert.reshape(-1)                        # [t*k]
+        onehot = jax.nn.one_hot(ef, n_exp, dtype=jnp.int32)  # [t*k, e]
         pos = jnp.cumsum(onehot, axis=0) * onehot
-        pos = jnp.sum(pos, axis=-1) - 1                # [t], 0-based
+        pos = jnp.sum(pos, axis=-1) - 1                # [t*k], 0-based
         keep = pos < capacity
-        # scatter tokens into [n_exp, capacity, d] send buffer
+        # scatter slots into [n_exp, capacity, d] send buffer
         send = jnp.zeros((n_exp, capacity, d), x_s.dtype)
-        idx_e = jnp.where(keep, expert, 0)
+        idx_e = jnp.where(keep, ef, 0)
         idx_p = jnp.where(keep, pos, 0)
+        xk = jnp.repeat(x_s, top_k, axis=0)            # slot → its token
         send = send.at[idx_e, idx_p].add(
-            jnp.where(keep[:, None], x_s, 0.0)
+            jnp.where(keep[:, None], xk, 0.0)
         )
         # group the contiguous e_local experts of each destination shard,
         # then all-to-all: recv[s] = this shard's expert block from source s
@@ -75,10 +93,11 @@ def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
         back = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0,
                                   tiled=True)
         back = back.reshape(n_exp, capacity, d)
-        # gather each token's result from its (expert, pos) slot
-        out = back[idx_e, idx_p]
-        out = jnp.where(keep[:, None], out * gate[:, None], 0.0)
-        return out
+        # gather each slot's result, weight by its gate, sum a token's k
+        slots = back[idx_e, idx_p]                     # [t*k, d]
+        slots = jnp.where(keep[:, None], slots, 0.0)
+        slots = slots * gate.reshape(-1)[:, None]
+        return slots.reshape(t, top_k, d).sum(axis=1)
 
     return jax.shard_map(
         shard_fn,
