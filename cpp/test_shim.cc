@@ -221,6 +221,48 @@ static int run_multidev_mode() {
   return 0;
 }
 
+/* ABI contract mode: the runner passes the EXACT env block the device
+ * plugin's Allocate emitted plus TEST_SHIM_EXPECT_LIMIT_MB; the shim
+ * must enforce that quota — MemoryStats reports it, an allocation half
+ * the quota fits, one past it is RESOURCE_EXHAUSTED. */
+static int run_contract_mode() {
+  const char* want = getenv("TEST_SHIM_EXPECT_LIMIT_MB");
+  CHECK(want != nullptr, "TEST_SHIM_EXPECT_LIMIT_MB set");
+  long want_mb = atol(want);
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == nullptr, "client create (contract)");
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = ca.client;
+  CHECK(api->PJRT_Client_AddressableDevices(&da) == nullptr, "devices");
+  PJRT_Device* dev0 = da.addressable_devices[0];
+  PJRT_Device_MemoryStats_Args ms;
+  memset(&ms, 0, sizeof(ms));
+  ms.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms.device = dev0;
+  CHECK(api->PJRT_Device_MemoryStats(&ms) == nullptr, "stats (contract)");
+  CHECK(ms.bytes_limit == want_mb * 1024LL * 1024LL,
+        "bytes_limit equals the Allocate-emitted quota");
+  PJRT_Error* err = nullptr;
+  PJRT_Buffer* ok = make_buffer(ca.client, dev0, want_mb / 2, &err);
+  CHECK(err == nullptr && ok != nullptr, "half-quota allocation admitted");
+  make_buffer(ca.client, dev0, want_mb, &err);
+  CHECK(err != nullptr, "over-quota allocation rejected");
+  PJRT_Error_GetCode_Args gc;
+  memset(&gc, 0, sizeof(gc));
+  gc.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+  gc.error = err;
+  api->PJRT_Error_GetCode(&gc);
+  CHECK(gc.code == PJRT_Error_Code_RESOURCE_EXHAUSTED,
+        "rejection is RESOURCE_EXHAUSTED (the documented contract)");
+  destroy_error(err);
+  printf("all contract-mode tests passed\n");
+  return 0;
+}
+
 int main(int argc, char** argv) {
   const char* shim = argc > 1 ? argv[1] : "build/libvtpu_shim.so";
   void* h = dlopen(shim, RTLD_NOW);
@@ -236,6 +278,7 @@ int main(int argc, char** argv) {
   if (argc > 2 && strcmp(argv[2], "oomkill") == 0) return run_oomkill_mode();
   if (argc > 2 && strcmp(argv[2], "execfail") == 0) return run_execfail_mode();
   if (argc > 2 && strcmp(argv[2], "multidev") == 0) return run_multidev_mode();
+  if (argc > 2 && strcmp(argv[2], "contract") == 0) return run_contract_mode();
 
   PJRT_Client_Create_Args ca;
   memset(&ca, 0, sizeof(ca));

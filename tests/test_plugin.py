@@ -326,3 +326,52 @@ def test_preferred_allocation_multi_share_one_chip(rig):
     # pinned-chip shares preferred before spilling to another chip
     same_chip = [i for i in ids if fake_id_to_uuid(i) == "fake-tpu-0"]
     assert len(same_chip) == 3
+
+
+def test_allocate_env_abi_drives_native_shim(rig, tmp_path):
+    """Cross-layer contract: the EXACT env block Allocate emits must make
+    the native interposer enforce that quota (MemoryStats reports it,
+    over-quota allocations reject) — the Go→C env ABI of the reference
+    (plugin.go:353-392 → libvgpu.so), tested end to end."""
+    import os
+    import pathlib
+    import subprocess
+
+    cpp = pathlib.Path(__file__).resolve().parents[1] / "cpp"
+    needed = ("libvtpu_shim.so", "libmock_pjrt.so", "test_shim")
+    if not all((cpp / "build" / n).exists() for n in needed):
+        pytest.skip("native build unavailable")
+
+    client, provider, cfg, cache, servicer, srv, stub = rig
+    register_once(client, cache, cfg)
+    sched = Scheduler(client)
+    sched.register_from_node_annotations()
+    pod = client.create_pod(tpu_pod_spec("abi-pod", pct=25))
+    assert sched.filter(pod, ["tpu-node"]).node == "tpu-node"
+    assert sched.bind("default", "abi-pod", "tpu-node") is None
+    assigned = codec.decode_pod_devices(
+        get_annotations(client.get_pod("default", "abi-pod"))[
+            annotations.DEVICES_TO_ALLOCATE
+        ]
+    )
+    fake_ids = [split_device_ids(assigned[0][0].uuid, cfg.device_split_count)[0]]
+    req = pb.AllocateRequest()
+    req.container_requests.append(pb.ContainerAllocateRequest(devicesIDs=fake_ids))
+    resp = stub.Allocate(req, timeout=5)
+    envs = dict(resp.container_responses[0].envs)
+
+    child_env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("TPU_", "VTPU_", "PJRT_"))
+    }
+    child_env.update(envs)
+    # the env's shared-cache value is the CONTAINER path; remap into tmp
+    child_env["TPU_DEVICE_MEMORY_SHARED_CACHE"] = str(tmp_path / "abi.cache")
+    child_env["VTPU_REAL_PJRT_PLUGIN"] = "./build/libmock_pjrt.so"
+    child_env["TEST_SHIM_EXPECT_LIMIT_MB"] = envs["TPU_DEVICE_MEMORY_LIMIT_0"]
+    proc = subprocess.run(
+        ["./build/test_shim", "build/libvtpu_shim.so", "contract"],
+        cwd=str(cpp), env=child_env, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "all contract-mode tests passed" in proc.stdout

@@ -44,11 +44,12 @@ def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
     e_local = n_exp // n_shards
     if capacity <= 0:
         # per-SOURCE-shard per-expert slots: x.shape[0] is the global
-        # token count (P(axis) shards it), so the expected load per shard
-        # per expert is top_k * tokens_per_shard / n_exp (capacity
-        # factor 1; pass `capacity` explicitly for headroom)
+        # token count (P(axis) shards it), so the expected balanced load
+        # per shard per expert is top_k * tokens_per_shard / n_exp;
+        # default capacity factor 2 absorbs routing imbalance (pass
+        # `capacity` explicitly for exact control)
         tokens_per_shard = max(1, x.shape[0] // n_shards)
-        capacity = max(1, -(-top_k * tokens_per_shard // n_exp))
+        capacity = max(1, -(-2 * top_k * tokens_per_shard // n_exp))
 
     def shard_fn(x_s, rw, wi, wo):
         # local expert weights: [e_local, d, h] / [e_local, h, d]
