@@ -42,6 +42,10 @@ ROWS = [
     ("vgg16", 2, "training"),
     ("deeplab", 1, "training"),
     ("lstm", 10, "training"),
+    # beyond the reference matrix: the long-context family (seq 512,
+    # flash-attention + fused-LN path); samples/s semantics unchanged
+    ("transformer", 8, "inference"),
+    ("transformer", 4, "training"),
 ]
 
 

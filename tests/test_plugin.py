@@ -165,31 +165,37 @@ def tpu_pod_spec(name, pct=25, cores=0, n=1):
     return new_pod(name, containers=[{"name": "main", "resources": {"limits": limits}}])
 
 
+def allocate_via_handshake(rig, pod_name, pct=25, cores=0):
+    """The full register→filter→bind→Allocate dance (§3.2+§3.3); returns
+    the kubelet AllocateResponse for the pod's first container."""
+    client, provider, cfg, cache, servicer, srv, stub = rig
+    register_once(client, cache, cfg)
+    sched = Scheduler(client)
+    sched.register_from_node_annotations()
+    pod = client.create_pod(tpu_pod_spec(pod_name, pct=pct, cores=cores))
+    res = sched.filter(pod, ["tpu-node"])
+    assert res.node == "tpu-node", res.error
+    assert sched.bind("default", pod_name, "tpu-node") is None
+    assigned = codec.decode_pod_devices(
+        get_annotations(client.get_pod("default", pod_name))[
+            annotations.DEVICES_TO_ALLOCATE
+        ]
+    )
+    req = pb.AllocateRequest()
+    req.container_requests.append(pb.ContainerAllocateRequest(
+        devicesIDs=[
+            split_device_ids(assigned[0][0].uuid, cfg.device_split_count)[0]
+        ]
+    ))
+    return stub.Allocate(req, timeout=5), assigned, pod
+
+
+
 def test_full_handshake_e2e(rig):
     """register → scheduler filter/bind → kubelet Allocate → env ABI out,
     lock released, bind-phase success (the whole §3.2+§3.3 call stack)."""
     client, provider, cfg, cache, servicer, srv, stub = rig
-
-    # node side: registrar reports chips
-    register_once(client, cache, cfg)
-    # control plane: scheduler ingests + schedules
-    sched = Scheduler(client)
-    sched.register_from_node_annotations()
-    pod = client.create_pod(tpu_pod_spec("workload", pct=25, cores=30))
-    res = sched.filter(pod, ["tpu-node"])
-    assert res.node == "tpu-node", res.error
-    assert sched.bind("default", "workload", "tpu-node") is None
-
-    # kubelet side: Allocate with one fake device ID
-    assigned = codec.decode_pod_devices(
-        get_annotations(client.get_pod("default", "workload"))[
-            annotations.DEVICES_TO_ALLOCATE
-        ]
-    )
-    fake_ids = [split_device_ids(assigned[0][0].uuid, cfg.device_split_count)[0]]
-    req = pb.AllocateRequest()
-    req.container_requests.append(pb.ContainerAllocateRequest(devicesIDs=fake_ids))
-    resp = stub.Allocate(req, timeout=5)
+    resp, assigned, pod = allocate_via_handshake(rig, "workload", pct=25, cores=30)
 
     envs = dict(resp.container_responses[0].envs)
     assert envs["TPU_DEVICE_MEMORY_LIMIT_0"] == "4096"  # 25% of 16384
@@ -256,25 +262,9 @@ def test_allocate_empty_request_invalid(rig):
 
 
 def test_allocate_creates_host_dirs(rig):
-    client, provider, cfg, cache, servicer, srv, stub = rig
     import os
 
-    register_once(client, cache, cfg)
-    sched = Scheduler(client)
-    sched.register_from_node_annotations()
-    pod = client.create_pod(tpu_pod_spec("dirs"))
-    sched.filter(pod, ["tpu-node"])
-    sched.bind("default", "dirs", "tpu-node")
-    assigned = codec.decode_pod_devices(
-        get_annotations(client.get_pod("default", "dirs"))[annotations.DEVICES_TO_ALLOCATE]
-    )
-    req = pb.AllocateRequest()
-    req.container_requests.append(
-        pb.ContainerAllocateRequest(
-            devicesIDs=[split_device_ids(assigned[0][0].uuid, cfg.device_split_count)[0]]
-        )
-    )
-    resp = stub.Allocate(req, timeout=5)
+    resp, assigned, pod = allocate_via_handshake(rig, "dirs")
     mounts = {m.container_path: m.host_path for m in resp.container_responses[0].mounts}
     host_cache = mounts["/tmp/vtpu"]
     assert os.path.isdir(host_cache)  # exists before kubelet bind-mounts
@@ -342,22 +332,7 @@ def test_allocate_env_abi_drives_native_shim(rig, tmp_path):
     if not all((cpp / "build" / n).exists() for n in needed):
         pytest.skip("native build unavailable")
 
-    client, provider, cfg, cache, servicer, srv, stub = rig
-    register_once(client, cache, cfg)
-    sched = Scheduler(client)
-    sched.register_from_node_annotations()
-    pod = client.create_pod(tpu_pod_spec("abi-pod", pct=25))
-    assert sched.filter(pod, ["tpu-node"]).node == "tpu-node"
-    assert sched.bind("default", "abi-pod", "tpu-node") is None
-    assigned = codec.decode_pod_devices(
-        get_annotations(client.get_pod("default", "abi-pod"))[
-            annotations.DEVICES_TO_ALLOCATE
-        ]
-    )
-    fake_ids = [split_device_ids(assigned[0][0].uuid, cfg.device_split_count)[0]]
-    req = pb.AllocateRequest()
-    req.container_requests.append(pb.ContainerAllocateRequest(devicesIDs=fake_ids))
-    resp = stub.Allocate(req, timeout=5)
+    resp, assigned, pod = allocate_via_handshake(rig, "abi-pod")
     envs = dict(resp.container_responses[0].envs)
 
     child_env = {
