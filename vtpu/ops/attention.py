@@ -153,6 +153,41 @@ def reference_attention(q, k, v, causal: bool = False):
     return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _ref_with_lse(q, k, v):
+    """Reference (o, lse) — the backward formulation for
+    flash_attention_with_lse (both cotangents handled)."""
+    sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    o = (jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)) / l)
+    return o, m + jnp.log(l)
+
+
+@jax.custom_vjp
+def flash_attention_with_lse(q, k, v):
+    """Non-causal attention returning (o_f32, lse) — the per-shard inner
+    op of ring attention: normalized output + per-row logsumexp form a
+    valid online-softmax partial.  Forward is the Pallas kernel (bf16
+    matmuls, f32 partial output so merging never rounds); backward
+    differentiates the reference formulation for BOTH outputs."""
+    return _flash_impl(q, k, v, False, 128, 128, jnp.float32)
+
+
+def _fwl_fwd(q, k, v):
+    return _flash_impl(q, k, v, False, 128, 128, jnp.float32), (q, k, v)
+
+
+def _fwl_bwd(res, ct):
+    q, k, v = res
+    _, vjp = jax.vjp(_ref_with_lse, q, k, v)
+    return vjp(ct)
+
+
+flash_attention_with_lse.defvjp(_fwl_fwd, _fwl_bwd)
+
+
 def _kernel_ok(q, k, block_q, block_k) -> bool:
     return not (q.shape[-2] % block_q or k.shape[-2] % block_k)
 
@@ -198,13 +233,15 @@ def _map_batched(fn, *arrays, out_rank=2):
     return out.reshape(batch_shape + out.shape[1:])
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "out_dtype")
+)
 def _flash_impl(q, k, v, causal: bool = False, block_q: int = 128,
-                block_k: int = 128):
+                block_k: int = 128, out_dtype=None):
     if q.ndim == 2:
-        return _flash_2d(q, k, v, causal, block_q, block_k)
+        return _flash_2d(q, k, v, causal, block_q, block_k, out_dtype)
     return _map_batched(
-        lambda a, b, c: _flash_2d(a, b, c, causal, block_q, block_k),
+        lambda a, b, c: _flash_2d(a, b, c, causal, block_q, block_k, out_dtype),
         q, k, v,
     )
 
@@ -221,20 +258,20 @@ def _flash_bwd_impl(q, k, v, o, lse, ct, causal, block_q, block_k):
     )
 
 
-def _flash_2d(q, k, v, causal, block_q, block_k):
+def _flash_2d(q, k, v, causal, block_q, block_k, out_dtype=None):
     seq_q, d = q.shape
     seq_k = k.shape[0]
     if seq_q % block_q or seq_k % block_k:
         o = reference_attention(q, k, v, causal)
         # lse unused on this path (backward falls back too)
-        return o, jnp.zeros((seq_q, 1), jnp.float32)
+        return o.astype(out_dtype or q.dtype), jnp.zeros((seq_q, 1), jnp.float32)
     sm_scale = d**-0.5
     return pl.pallas_call(
         functools.partial(
             _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((seq_q, d), out_dtype or q.dtype),
             jax.ShapeDtypeStruct((seq_q, 1), jnp.float32),
         ],
         grid=(seq_q // block_q,),

@@ -20,11 +20,30 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from vtpu.ops.attention import NEG_INF, reference_attention
+from vtpu.ops.attention import (
+    NEG_INF,
+    _on_tpu,
+    flash_attention_with_lse,
+    reference_attention,
+)
 
 
-def _partial_attention(q, k, v, sm_scale):
-    """Blockwise partials for one KV shard: returns (acc, m, l)."""
+def _partial_attention(q, k, v, sm_scale, use_kernel: Optional[bool] = None):
+    """Blockwise partials for one KV shard: returns (acc, m, l).
+
+    On TPU (kernel-divisible shapes, default 1/sqrt(d) scale) the partial
+    comes from the Pallas flash kernel: its normalized f32 output o and
+    per-row logsumexp form the valid online-softmax triple (o, lse, 1) —
+    merging weights it by exp(lse − m_max), recovering the unnormalized
+    accumulator exactly.  Differentiable (flash_attention_with_lse
+    carries a custom VJP for both outputs)."""
+    if use_kernel is None:
+        use_kernel = _on_tpu()
+    default_scale = q.shape[-1] ** -0.5
+    if (use_kernel and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0
+            and abs(sm_scale - default_scale) < 1e-12):
+        o, lse = flash_attention_with_lse(q, k, v)
+        return o, lse, jnp.ones_like(lse)
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
@@ -41,9 +60,11 @@ def _merge(acc1, m1, l1, acc2, m2, l2):
     return acc1 * a1 + acc2 * a2, m, l1 * a1 + l2 * a2
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp"):
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+                   use_kernel: Optional[bool] = None):
     """q,k,v: [batch, heads, seq, d] with seq sharded over mesh axis
-    ``axis``.  Returns attention output with the same sharding."""
+    ``axis``.  Returns attention output with the same sharding.
+    ``use_kernel`` forces the Pallas inner op on/off (default: on TPU)."""
     n_shards = mesh.shape[axis]
     sm_scale = q.shape[-1] ** -0.5
 
@@ -52,13 +73,13 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp"):
     def shard_fn(q_s, k_s, v_s):
         # first hop outside the loop so the carry is data-derived (its
         # sharding/vma type then matches across loop iterations)
-        acc, m, l = _partial_attention(q_s, k_s, v_s, sm_scale)
+        acc, m, l = _partial_attention(q_s, k_s, v_s, sm_scale, use_kernel)
         k_cur = jax.lax.ppermute(k_s, axis, perm)
         v_cur = jax.lax.ppermute(v_s, axis, perm)
 
         def hop(i, carry):
             acc, m, l, k_c, v_c = carry
-            a, mm, ll = _partial_attention(q_s, k_c, v_c, sm_scale)
+            a, mm, ll = _partial_attention(q_s, k_c, v_c, sm_scale, use_kernel)
             acc, m, l = _merge(acc, m, l, a, mm, ll)
             # rotate KV one hop around the ring (neighbor ICI transfer)
             k_n = jax.lax.ppermute(k_c, axis, perm)
@@ -70,7 +91,12 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp"):
         )
         return (acc / jnp.maximum(l, 1e-30)).astype(q_s.dtype)
 
+    kernel_on = use_kernel if use_kernel is not None else _on_tpu()
     spec = P(None, None, axis, None)
+    # check_vma stays ON for the pure-XLA path; only the kernel path must
+    # disable it (pallas_call out_shapes carry no vma annotation) — the
+    # explicit in/out specs still pin the sharding there
     return jax.shard_map(
-        shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=not kernel_on,
     )(q, k, v)
