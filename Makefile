@@ -46,8 +46,51 @@ test-native: shim
 	  VTPU_VISIBLE_UUIDS=mock-tpu-0,mock-tpu-1 \
 	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/multi.cache \
 	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
-	  ./build/test_shim build/libvtpu_shim.so multidev \
+	  ./build/test_shim build/libvtpu_shim.so multidev
+	cd cpp && TPU_DEVICE_MEMORY_LIMIT_0=64 TPU_DEVICE_CORES_LIMIT=25 \
+	  TPU_CORE_UTILIZATION_POLICY=force \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/force.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
+	  ./build/test_shim build/libvtpu_shim.so force
+	cd cpp && TPU_DEVICE_MEMORY_LIMIT_0=64 TPU_DEVICE_CORES_LIMIT=25 \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/suspend.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
+	  ./build/test_shim build/libvtpu_shim.so suspend
+	cd cpp && TPU_DEVICE_MEMORY_LIMIT_0=1024 MOCK_PJRT_EXEC_US=0 \
+	  MOCK_PJRT_OUT_BYTES=1048576 \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/threads.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
+	  ./build/test_shim build/libvtpu_shim.so threads
+	cd cpp && TPU_DEVICE_MEMORY_LIMIT_0=1024 MOCK_PJRT_EXEC_US=0 \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/procs.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
+	  ./build/test_shim build/libvtpu_shim.so procs \
 	  && rm -rf /tmp/vtpu-make-test
+
+# sanitizer proof for the native shim's concurrency (SURVEY §5 names the
+# reference's missing -race/-fsanitize coverage; we close it): the full
+# default suite plus the pthread hammer run under ThreadSanitizer.
+test-native-tsan:
+	$(MAKE) -C cpp tsan
+	mkdir -p /tmp/vtpu-tsan-test
+	cd cpp && TSAN_OPTIONS="halt_on_error=1" \
+	  TPU_DEVICE_MEMORY_LIMIT_0=64 TPU_DEVICE_CORES_LIMIT=25 \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-tsan-test/shim.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/tsan/libmock_pjrt.so \
+	  ./build/tsan/test_shim build/tsan/libvtpu_shim.so
+	cd cpp && TSAN_OPTIONS="halt_on_error=1" \
+	  TPU_DEVICE_MEMORY_LIMIT_0=1024 MOCK_PJRT_EXEC_US=0 \
+	  MOCK_PJRT_OUT_BYTES=1048576 \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-tsan-test/threads.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/tsan/libmock_pjrt.so \
+	  ./build/tsan/test_shim build/tsan/libvtpu_shim.so threads \
+	  && rm -rf /tmp/vtpu-tsan-test
 
 bench:
 	$(PY) bench.py

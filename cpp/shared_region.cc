@@ -22,6 +22,8 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <pthread.h>
+
 /* per-process region→fd registry so lock/unlock can flock the file the
  * region was mapped from (fds are per-process; they cannot live in the
  * shared mapping itself) */
@@ -30,6 +32,14 @@ static struct {
   vtpu_shared_region* r;
   int fd;
 } g_open[VTPU_MAX_OPEN];
+
+/* flock serialises PROCESSES but not threads: on one open file
+ * description a second LOCK_EX from another thread of the same process
+ * succeeds immediately (flock is per-ofd, conversion semantics).  The
+ * process-local mutex closes that hole — JAX dispatches PJRT calls from
+ * several threads, so two try_adds in one tenant would otherwise race
+ * the slot fields.  Lock order: local mutex, then flock. */
+static pthread_mutex_t g_local_mu = PTHREAD_MUTEX_INITIALIZER;
 
 static int fd_for(vtpu_shared_region* r) {
   for (int i = 0; i < VTPU_MAX_OPEN; i++)
@@ -129,9 +139,10 @@ static int pid_alive(int32_t pid) {
 }
 
 void vtpu_region_lock(vtpu_shared_region* r) {
+  pthread_mutex_lock(&g_local_mu); /* thread exclusion within the process */
   int fd = fd_for(r);
   if (fd >= 0) flock(fd, LOCK_EX); /* released by the kernel if we die */
-  r->lock = 1; /* observability only; flock is the real exclusion */
+  r->lock = 1; /* observability only; mutex+flock are the real exclusion */
   r->owner_pid = (int32_t)getpid();
   __sync_synchronize();
 }
@@ -142,6 +153,7 @@ void vtpu_region_unlock(vtpu_shared_region* r) {
   r->lock = 0;
   int fd = fd_for(r);
   if (fd >= 0) flock(fd, LOCK_UN);
+  pthread_mutex_unlock(&g_local_mu);
 }
 
 int vtpu_region_register_proc(vtpu_shared_region* r, int32_t pid,

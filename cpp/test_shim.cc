@@ -8,12 +8,16 @@
  * Exits 0 on success; prints TAP-ish lines.
  */
 #include <dlfcn.h>
+#include <pthread.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/wait.h>
 #include <time.h>
+#include <unistd.h>
 
 #include "pjrt_c_api.h"
+#include "shared_region.h"
 
 #define CHECK(cond, name)                          \
   do {                                             \
@@ -263,6 +267,220 @@ static int run_contract_mode() {
   return 0;
 }
 
+/* thread-safe buffer helper for the concurrency modes (make_buffer uses
+ * static storage — fine single-threaded, racy under pthreads) */
+static PJRT_Buffer* make_buffer_mt(PJRT_Client* client, PJRT_Device* dev,
+                                   int64_t mib, PJRT_Error** err_out) {
+  int64_t dims[1] = {mib * 1024 * 1024};
+  char byte = 0;
+  PJRT_Client_BufferFromHostBuffer_Args a;
+  memset(&a, 0, sizeof(a));
+  a.struct_size = PJRT_Client_BufferFromHostBuffer_Args_STRUCT_SIZE;
+  a.client = client;
+  a.data = &byte;
+  a.type = PJRT_Buffer_Type_U8;
+  a.dims = dims;
+  a.num_dims = 1;
+  a.device = dev;
+  *err_out = api->PJRT_Client_BufferFromHostBuffer(&a);
+  return a.buffer;
+}
+
+static void destroy_buffer(PJRT_Buffer* b) {
+  PJRT_Buffer_Destroy_Args d;
+  memset(&d, 0, sizeof(d));
+  d.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  d.buffer = b;
+  api->PJRT_Buffer_Destroy(&d);
+}
+
+static int64_t stats_in_use(PJRT_Device* dev) {
+  PJRT_Device_MemoryStats_Args ms;
+  memset(&ms, 0, sizeof(ms));
+  ms.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  ms.device = dev;
+  if (api->PJRT_Device_MemoryStats(&ms) != nullptr) return -1;
+  return ms.bytes_in_use;
+}
+
+struct HammerCtx {
+  PJRT_Client* client;
+  PJRT_Device* dev;
+  PJRT_LoadedExecutable* exe;
+  int iters;
+  int fails;
+};
+
+static void* hammer(void* arg) {
+  HammerCtx* c = (HammerCtx*)arg;
+  for (int i = 0; i < c->iters; i++) {
+    PJRT_Error* err = nullptr;
+    PJRT_Buffer* b = make_buffer_mt(c->client, c->dev, 1, &err);
+    if (err) {
+      destroy_error(err);
+      c->fails++;
+      continue;
+    }
+    PJRT_Buffer* outrow[1] = {nullptr};
+    PJRT_Buffer** outlists[1] = {outrow};
+    PJRT_LoadedExecutable_Execute_Args ea;
+    memset(&ea, 0, sizeof(ea));
+    ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+    ea.executable = c->exe;
+    ea.num_devices = 1;
+    ea.output_lists = outlists;
+    ea.execute_device = c->dev;
+    err = api->PJRT_LoadedExecutable_Execute(&ea);
+    if (err) {
+      destroy_error(err);
+      c->fails++;
+    } else if (outrow[0]) {
+      destroy_buffer(outrow[0]);
+    }
+    destroy_buffer(b);
+  }
+  return nullptr;
+}
+
+/* threads mode: N pthreads × alloc/execute/free against ONE region —
+ * the race the r2 verdict called untested (try_add/sub/execute
+ * concurrency).  With a roomy quota every iteration must be admitted and
+ * the accounting must return exactly to baseline; lost updates (the
+ * flock-is-not-thread-exclusion hole) would leave it drifted.  Run it
+ * under TSAN via `make test-native-tsan` for the sanitizer proof. */
+static int run_threads_mode() {
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == nullptr, "client create (threads)");
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = ca.client;
+  CHECK(api->PJRT_Client_AddressableDevices(&da) == nullptr,
+        "devices (threads)");
+  PJRT_Device* dev0 = da.addressable_devices[0];
+  PJRT_Client_Compile_Args cc;
+  memset(&cc, 0, sizeof(cc));
+  cc.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  cc.client = ca.client;
+  CHECK(api->PJRT_Client_Compile(&cc) == nullptr, "compile (threads)");
+  int64_t base = stats_in_use(dev0);
+  CHECK(base >= 0, "baseline stats (threads)");
+  enum { kThreads = 8, kIters = 200 };
+  pthread_t tids[kThreads];
+  HammerCtx ctxs[kThreads];
+  for (int t = 0; t < kThreads; t++) {
+    ctxs[t] = {ca.client, dev0, cc.executable, kIters, 0};
+    CHECK(pthread_create(&tids[t], nullptr, hammer, &ctxs[t]) == 0,
+          "spawn hammer thread");
+  }
+  int fails = 0;
+  for (int t = 0; t < kThreads; t++) {
+    pthread_join(tids[t], nullptr);
+    fails += ctxs[t].fails;
+  }
+  CHECK(fails == 0, "no spurious rejects under a roomy quota");
+  CHECK(stats_in_use(dev0) == base,
+        "accounting returns to baseline after 8x200 concurrent iterations");
+  printf("all threads-mode tests passed\n");
+  return 0;
+}
+
+/* procs mode: TWO processes on one region file — cross-process flock
+ * exclusion under load.  Parent forks; both hammer alloc/free; after the
+ * child exits the region's usage must equal the parent's baseline. */
+static int run_procs_mode() {
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == nullptr, "client create (procs)");
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = ca.client;
+  CHECK(api->PJRT_Client_AddressableDevices(&da) == nullptr, "devices (procs)");
+  PJRT_Device* dev0 = da.addressable_devices[0];
+  int64_t base = stats_in_use(dev0);
+  pid_t child = fork();
+  if (child == 0) {
+    /* child: own pid → own region slot (registered on first try_add) */
+    for (int i = 0; i < 300; i++) {
+      PJRT_Error* err = nullptr;
+      PJRT_Buffer* b = make_buffer_mt(ca.client, dev0, 2, &err);
+      if (err) {
+        destroy_error(err);
+        _exit(2);
+      }
+      destroy_buffer(b);
+    }
+    _exit(0);
+  }
+  CHECK(child > 0, "fork");
+  for (int i = 0; i < 300; i++) {
+    PJRT_Error* err = nullptr;
+    PJRT_Buffer* b = make_buffer_mt(ca.client, dev0, 3, &err);
+    CHECK(err == nullptr, "parent alloc under contention");
+    destroy_buffer(b);
+  }
+  int st = 0;
+  CHECK(waitpid(child, &st, 0) == child, "waitpid");
+  CHECK(WIFEXITED(st) && WEXITSTATUS(st) == 0, "child clean exit");
+  CHECK(stats_in_use(dev0) == base,
+        "two-process hammering returns accounting to baseline");
+  printf("all procs-mode tests passed\n");
+  return 0;
+}
+
+/* core-policy modes: the monitor's feedback arbiter suspends throttling
+ * by setting utilization_switch=1 in the shared region (ref
+ * CheckPriority/Observe).  TPU_CORE_UTILIZATION_POLICY=default honors
+ * the suspend (mode "suspend": executes run unpaced); =force keeps
+ * throttling anyway (mode "force": still paced to the 25% duty cycle).
+ * The runner picks the policy env; expect_paced selects the assert. */
+static int run_policy_mode(int expect_paced) {
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == nullptr, "client create (policy)");
+  PJRT_Client_Compile_Args cc;
+  memset(&cc, 0, sizeof(cc));
+  cc.struct_size = PJRT_Client_Compile_Args_STRUCT_SIZE;
+  cc.client = ca.client;
+  CHECK(api->PJRT_Client_Compile(&cc) == nullptr, "compile (policy)");
+  PJRT_LoadedExecutable_Execute_Args ea;
+  memset(&ea, 0, sizeof(ea));
+  ea.struct_size = PJRT_LoadedExecutable_Execute_Args_STRUCT_SIZE;
+  ea.executable = cc.executable;
+  /* warm the pacing EMA while the arbiter switch is still 0 */
+  for (int i = 0; i < 2; i++)
+    CHECK(api->PJRT_LoadedExecutable_Execute(&ea) == nullptr,
+          "warmup execute (policy)");
+  /* flip the arbiter switch the way the monitor would */
+  const char* path = getenv("TPU_DEVICE_MEMORY_SHARED_CACHE");
+  CHECK(path != nullptr, "cache path set (policy)");
+  vtpu_shared_region* r = vtpu_region_open(path);
+  CHECK(r != nullptr, "region opened (policy)");
+  r->utilization_switch = 1;
+  struct timespec t0, t1;
+  clock_gettime(CLOCK_MONOTONIC, &t0);
+  const int kIters = 5;
+  for (int i = 0; i < kIters; i++)
+    CHECK(api->PJRT_LoadedExecutable_Execute(&ea) == nullptr,
+          "execute (policy)");
+  clock_gettime(CLOCK_MONOTONIC, &t1);
+  double per = ((t1.tv_sec - t0.tv_sec) * 1e3 +
+                (t1.tv_nsec - t0.tv_nsec) / 1e6) /
+               kIters;
+  printf("# per-execute %.2f ms under utilization_switch=1\n", per);
+  if (expect_paced)
+    CHECK(per >= 3.0, "force policy keeps throttling under arbiter suspend");
+  else
+    CHECK(per < 3.0, "default policy honors the arbiter suspend");
+  printf("all policy-mode tests passed\n");
+  return 0;
+}
+
 int main(int argc, char** argv) {
   const char* shim = argc > 1 ? argv[1] : "build/libvtpu_shim.so";
   void* h = dlopen(shim, RTLD_NOW);
@@ -279,6 +497,10 @@ int main(int argc, char** argv) {
   if (argc > 2 && strcmp(argv[2], "execfail") == 0) return run_execfail_mode();
   if (argc > 2 && strcmp(argv[2], "multidev") == 0) return run_multidev_mode();
   if (argc > 2 && strcmp(argv[2], "contract") == 0) return run_contract_mode();
+  if (argc > 2 && strcmp(argv[2], "force") == 0) return run_policy_mode(1);
+  if (argc > 2 && strcmp(argv[2], "suspend") == 0) return run_policy_mode(0);
+  if (argc > 2 && strcmp(argv[2], "threads") == 0) return run_threads_mode();
+  if (argc > 2 && strcmp(argv[2], "procs") == 0) return run_procs_mode();
 
   PJRT_Client_Create_Args ca;
   memset(&ca, 0, sizeof(ca));

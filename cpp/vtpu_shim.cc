@@ -44,9 +44,11 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cstdint>
 #include <string>
 #include <unordered_map>
+#include <vector>
 
 #include "pjrt_c_api.h"
 #include "shared_region.h"
@@ -61,7 +63,11 @@ struct ShimConfig {
   int core_limit = 100;     /* percent */
   int oversubscribe = 0;
   int priority = 0;
-  int core_policy_disable = 0;
+  /* TPU_CORE_UTILIZATION_POLICY (ref docs/config.md container envs):
+   * 0 = default (throttle; the monitor's utilization_switch may suspend),
+   * 1 = force   (throttle even when the arbiter suspends),
+   * 2 = disable (never throttle) */
+  int core_policy = 0;
   int active_oom_killer = 0; /* kill the tenant on quota reject (ref
                                 ACTIVE_OOM_KILLER, docs/config.md) */
   const char* region_path = nullptr;
@@ -75,14 +81,81 @@ const PJRT_Api* g_real = nullptr;
 PJRT_Api g_api; /* our copy with wrapped entries */
 pthread_mutex_t g_mu = PTHREAD_MUTEX_INITIALIZER;
 
-/* loaded executable → output arity (cached at compile; avoids a
- * GetExecutable round-trip — and a wrapper-object leak — per execute) */
-std::unordered_map<void*, size_t> g_num_outputs;
-/* loaded executable → total output bytes per device row, from compile-time
- * shape metadata.  Enables a CLEAN pre-execute quota reject (no unwinding
- * of an already-run execute, which would leak the caller's completion
- * events and invalidate donated inputs). */
-std::unordered_map<void*, uint64_t> g_out_bytes;
+/* loaded executable → output metadata, captured once at compile time (or
+ * learned on the executable's FIRST execute when compile-time shapes are
+ * unavailable).  This is the load-bearing cache of the whole shim: the
+ * execute hot path must issue ZERO extra PJRT calls, because through a
+ * networked PJRT transport (this image reaches its TPU via a relay; the
+ * same holds for any proxied plugin) every added call is a round trip —
+ * a model with K outputs paying 2 size/device queries per output costs
+ * 2K RTTs per step, which measured as ~73% per-tenant overhead in round
+ * 2.  The compile-time sizes also enable a CLEAN pre-execute quota
+ * reject (no unwinding of an already-run execute, which would leak the
+ * caller's completion events and invalidate donated inputs). */
+struct ExecMeta {
+  size_t n_out = 0;
+  uint64_t out_total = 0;          /* Σ out_sizes; 0 = not sizable yet */
+  std::vector<uint64_t> out_sizes; /* per-output bytes: logical
+                                      (dims×dtype) at compile time,
+                                      upgraded to actual on-device sizes
+                                      once learned */
+  std::vector<int> row_dev;        /* execute row → local device index,
+                                      from the loaded executable's
+                                      addressable-device list (PJRT:
+                                      output_lists[d] belongs to that
+                                      list's d-th device) — cached so the
+                                      hot path never queries per-buffer
+                                      devices */
+};
+std::unordered_map<void*, ExecMeta> g_exec_meta;
+
+/* per-wrapper telemetry, dumped at exit when VTPU_SHIM_STATS is set —
+ * the proof instrument for interposer overhead (shim_ns counts only
+ * time ADDED by the wrapper, excluding the forwarded real call) */
+struct ShimStats {
+  std::atomic<uint64_t> h2d_calls{0}, h2d_shim_ns{0};
+  std::atomic<uint64_t> exec_calls{0}, exec_shim_ns{0};
+  std::atomic<uint64_t> destroy_calls{0}, destroy_shim_ns{0};
+  std::atomic<uint64_t> size_rtts{0};      /* extra PJRT size queries */
+  std::atomic<uint64_t> pace_sleep_ns{0};
+  std::atomic<uint64_t> quota_rejects{0};
+};
+ShimStats g_stats;
+
+uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+void dump_stats() {
+  const char* dst = getenv("VTPU_SHIM_STATS");
+  if (!dst || !*dst || strcmp(dst, "0") == 0) return;
+  FILE* f = stderr;
+  if (dst[0] == '/') {
+    FILE* ff = fopen(dst, "a");
+    if (ff) f = ff;
+  }
+  fprintf(f,
+          "{\"vtpu_shim_stats\": {\"pid\": %d, "
+          "\"h2d\": {\"calls\": %llu, \"shim_ms\": %.3f}, "
+          "\"exec\": {\"calls\": %llu, \"shim_ms\": %.3f}, "
+          "\"destroy\": {\"calls\": %llu, \"shim_ms\": %.3f}, "
+          "\"size_rtts\": %llu, \"pace_sleep_ms\": %.3f, "
+          "\"quota_rejects\": %llu}}\n",
+          (int)getpid(),
+          (unsigned long long)g_stats.h2d_calls.load(),
+          g_stats.h2d_shim_ns.load() / 1e6,
+          (unsigned long long)g_stats.exec_calls.load(),
+          g_stats.exec_shim_ns.load() / 1e6,
+          (unsigned long long)g_stats.destroy_calls.load(),
+          g_stats.destroy_shim_ns.load() / 1e6,
+          (unsigned long long)g_stats.size_rtts.load(),
+          g_stats.pace_sleep_ns.load() / 1e6,
+          (unsigned long long)g_stats.quota_rejects.load());
+  if (f != stderr) fclose(f);
+  else fflush(f);
+}
 
 /* buffer/executable → accounted bytes (+device index, accounting kind:
  * 0 = device buffer, 1 = program, 2 = host-swap tier) */
@@ -134,7 +207,10 @@ void load_config() {
   if (p) g_cfg.priority = atoi(p);
   snprintf(key, sizeof(key), "%s_CORE_UTILIZATION_POLICY", pfx);
   const char* pol = getenv(key);
-  if (pol && strcmp(pol, "disable") == 0) g_cfg.core_policy_disable = 1;
+  if (pol && strcmp(pol, "disable") == 0)
+    g_cfg.core_policy = 2;
+  else if (pol && strcmp(pol, "force") == 0)
+    g_cfg.core_policy = 1;
   snprintf(key, sizeof(key), "%s_DEVICE_MEMORY_SHARED_CACHE", pfx);
   g_cfg.region_path = getenv(key);
   if (!g_cfg.region_path) g_cfg.region_path = "/tmp/vtpu/vtpu.cache";
@@ -168,6 +244,7 @@ PJRT_Error* make_error(PJRT_Error_Code code, const char* msg) {
  * container envs).  SIGKILL, not exit(): the tenant may be mid-JAX with
  * arbitrary threads — the same choice the reference makes. */
 PJRT_Error* quota_reject(const char* msg) {
+  g_stats.quota_rejects++;
   if (g_cfg.active_oom_killer) {
     fprintf(stderr, "vtpu_shim: ACTIVE_OOM_KILLER: %s — killing pid %d\n",
             msg, (int)getpid());
@@ -211,6 +288,7 @@ PJRT_Error* wrap_Error_GetCode(PJRT_Error_GetCode_Args* args) {
 /* helpers                                                             */
 /* ------------------------------------------------------------------ */
 uint64_t buffer_size(PJRT_Buffer* buf) {
+  g_stats.size_rtts++;
   PJRT_Buffer_OnDeviceSizeInBytes_Args a;
   memset(&a, 0, sizeof(a));
   a.struct_size = PJRT_Buffer_OnDeviceSizeInBytes_Args_STRUCT_SIZE;
@@ -278,41 +356,8 @@ int account_buffer_idx(PJRT_Buffer* buf, int dev) {
   return 0;
 }
 
-/* account a buffer that was placed in the HOST memory space (the
- * oversubscribe swap tier): kind 2, never limited by the device quota */
-void account_buffer_idx_swap(PJRT_Buffer* buf, int dev) {
-  if (!buf || !g_region) return;
-  uint64_t sz = buffer_size(buf);
-  if (sz == 0) return;
-  vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/2, sz, 1);
-  pthread_mutex_lock(&g_mu);
-  g_buffers[buf] = {sz, dev, 2};
-  pthread_mutex_unlock(&g_mu);
-}
-
 int account_buffer(PJRT_Buffer* buf, PJRT_Device* dev_hint) {
   return account_buffer_idx(buf, device_index(dev_hint));
-}
-
-/* accounting that can never reject (post-hoc paths where the buffer
- * already exists): force-admit via the oversubscribe flag */
-void account_buffer_idx_forced(PJRT_Buffer* buf, int dev) {
-  if (!buf || !g_region) return;
-  uint64_t sz = buffer_size(buf);
-  if (sz == 0) return;
-  vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0, sz, 1);
-  pthread_mutex_lock(&g_mu);
-  g_buffers[buf] = {sz, dev, 0};
-  pthread_mutex_unlock(&g_mu);
-}
-
-/* pre-flight headroom check for a known size (the reject path); pure
- * check — oversubscribe policy is decided at the call sites */
-bool quota_allows(int dev, uint64_t want) {
-  if (!g_region) return true;
-  uint64_t limit = g_region->limit_bytes[dev];
-  if (limit == 0) return true;
-  return vtpu_region_device_usage(g_region, dev) + want <= limit;
 }
 
 void destroy_real_buffer(PJRT_Buffer* buf) {
@@ -413,53 +458,117 @@ PJRT_Error* wrap_Client_Create(PJRT_Client_Create_Args* args) {
 
 PJRT_Error* wrap_BufferFromHostBuffer(
     PJRT_Client_BufferFromHostBuffer_Args* args) {
-  /* pre-check with the exact host-side size where the dtype is sizable
-   * (device layout may pad; the post-hoc account uses the true on-device
-   * size and is authoritative).  Over quota:
+  /* quota admission with the host-side logical size (dims×dtype) in ONE
+   * atomic region transaction — no on-device size query, which through a
+   * proxied plugin is a network round trip per allocation.  Device
+   * layout may pad beyond the logical size; the whole accounting fabric
+   * consistently charges logical bytes (same math the execute path's
+   * compile-time metadata uses), so the quota semantics stay uniform.
+   * Over quota:
    *   - oversubscribe + host memory space → place the buffer in HOST
    *     memory instead (the swap tier: XLA streams it to the chip on
    *     demand — the virtual-device-memory behavior, ref
    *     README.md:236-240), accounted as kind 2;
    *   - oversubscribe, no host space exposed → force-admit (legacy);
    *   - otherwise → RESOURCE_EXHAUSTED (check_oom). */
-  bool host_placed = false;
+  uint64_t t0 = now_ns();
+  g_stats.h2d_calls++;
+  uint64_t want = 0;
+  int dev = 0;
+  bool host_placed = false, accounted = false;
   if (g_region) {
     uint64_t width = dtype_width(args->type);
     if (width > 0) {
-      int dev = device_index(args->device);
-      uint64_t want = width;
+      dev = device_index(args->device);
+      want = width;
       for (size_t i = 0; i < args->num_dims; i++)
         want *= (uint64_t)args->dims[i];
-      if (!quota_allows(dev, want)) {
+      if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0,
+                              want, /*oversubscribe=*/0) != 0) {
         if (g_cfg.oversubscribe && args->memory == nullptr &&
             dev < VTPU_MAX_DEVICES && g_host_mem[dev] != nullptr) {
           args->memory = g_host_mem[dev];
           host_placed = true;
         } else if (!g_cfg.oversubscribe) {
           return quota_reject("vtpu: HBM quota exceeded (BufferFromHostBuffer)");
+        } else {
+          /* legacy oversubscribe without a host tier: force-admit */
+          vtpu_region_try_add(g_region, (int32_t)getpid(), dev, 0, want, 1);
+          accounted = true;
         }
+      } else {
+        accounted = true;
       }
     }
   }
+  uint64_t t1 = now_ns();
   PJRT_Error* err = g_real->PJRT_Client_BufferFromHostBuffer(args);
-  if (err) return err;
+  uint64_t t2 = now_ns();
+  if (err) {
+    if (accounted)
+      vtpu_region_sub(g_region, (int32_t)getpid(), dev, 0, want);
+    g_stats.h2d_shim_ns += (t1 - t0) + (now_ns() - t2);
+    return err;
+  }
   if (host_placed) {
-    account_buffer_idx_swap(args->buffer, device_index(args->device));
-    return nullptr;
+    /* dev resolved in the pre-check — args->device may legitimately be
+     * null (memory-space placement), which must not lose the swap bytes */
+    if (want > 0 && g_region) {
+      vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/2, want,
+                          1);
+      pthread_mutex_lock(&g_mu);
+      g_buffers[args->buffer] = {want, dev, 2};
+      pthread_mutex_unlock(&g_mu);
+    }
+  } else if (accounted) {
+    pthread_mutex_lock(&g_mu);
+    g_buffers[args->buffer] = {want, dev, 0};
+    pthread_mutex_unlock(&g_mu);
+  } else if (g_region) {
+    /* unsizable dtype (sub-byte / opaque): fall back to the on-device
+     * size query — rare, and the only remaining RTT on this path */
+    if (account_buffer(args->buffer, args->device) != 0) {
+      destroy_real_buffer(args->buffer);
+      args->buffer = nullptr;
+      g_stats.h2d_shim_ns += (t1 - t0) + (now_ns() - t2);
+      return quota_reject("vtpu: HBM quota exceeded (on-device size)");
+    }
   }
-  if (account_buffer(args->buffer, args->device) != 0) {
-    destroy_real_buffer(args->buffer);
-    args->buffer = nullptr;
-    return quota_reject("vtpu: HBM quota exceeded (on-device size)");
-  }
+  g_stats.h2d_shim_ns += (t1 - t0) + (now_ns() - t2);
   return nullptr;
 }
 
 PJRT_Error* wrap_CreateUninitializedBuffer(
     PJRT_Client_CreateUninitializedBuffer_Args* args) {
+  /* same local-size admission as BufferFromHostBuffer: the args carry
+   * the shape, so the quota check needs no PJRT round trip */
+  uint64_t want = 0;
+  int dev = 0;
+  bool accounted = false;
+  if (g_region) {
+    uint64_t width = dtype_width(args->shape_element_type);
+    if (width > 0) {
+      dev = device_index(args->device);
+      want = width;
+      for (size_t i = 0; i < args->shape_num_dims; i++)
+        want *= (uint64_t)args->shape_dims[i];
+      if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0,
+                              want, g_cfg.oversubscribe) != 0)
+        return quota_reject("vtpu: HBM quota exceeded (uninitialized buffer)");
+      accounted = true;
+    }
+  }
   PJRT_Error* err = g_real->PJRT_Client_CreateUninitializedBuffer(args);
-  if (err) return err;
-  if (account_buffer(args->buffer, args->device) != 0) {
+  if (err) {
+    if (accounted)
+      vtpu_region_sub(g_region, (int32_t)getpid(), dev, 0, want);
+    return err;
+  }
+  if (accounted) {
+    pthread_mutex_lock(&g_mu);
+    g_buffers[args->buffer] = {want, dev, 0};
+    pthread_mutex_unlock(&g_mu);
+  } else if (account_buffer(args->buffer, args->device) != 0) {
     destroy_real_buffer(args->buffer);
     args->buffer = nullptr;
     return quota_reject("vtpu: HBM quota exceeded (uninitialized buffer)");
@@ -468,6 +577,8 @@ PJRT_Error* wrap_CreateUninitializedBuffer(
 }
 
 PJRT_Error* wrap_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
+  uint64_t t0 = now_ns();
+  g_stats.destroy_calls++;
   pthread_mutex_lock(&g_mu);
   auto it = g_buffers.find(args->buffer);
   Acct acct{0, 0, 0};
@@ -480,7 +591,72 @@ PJRT_Error* wrap_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
   if (found && g_region)
     vtpu_region_sub(g_region, (int32_t)getpid(), acct.dev, acct.kind,
                     acct.bytes);
+  g_stats.destroy_shim_ns += now_ns() - t0;
   return g_real->PJRT_Buffer_Destroy(args);
+}
+
+/* query output arity + per-output logical sizes from an (unloaded)
+ * executable's compile-time metadata.  Runs once per compile — the only
+ * place the shim is allowed to spend PJRT round trips on sizing. */
+void fill_exec_meta(PJRT_Executable* exe, ExecMeta* meta) {
+  if (g_real->PJRT_Executable_NumOutputs) {
+    PJRT_Executable_NumOutputs_Args na;
+    memset(&na, 0, sizeof(na));
+    na.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
+    na.executable = exe;
+    if (g_real->PJRT_Executable_NumOutputs(&na) == nullptr)
+      meta->n_out = na.num_outputs;
+  }
+  if (g_real->PJRT_Executable_OutputElementTypes &&
+      g_real->PJRT_Executable_OutputDimensions) {
+    PJRT_Executable_OutputElementTypes_Args ta;
+    memset(&ta, 0, sizeof(ta));
+    ta.struct_size = PJRT_Executable_OutputElementTypes_Args_STRUCT_SIZE;
+    ta.executable = exe;
+    PJRT_Executable_OutputDimensions_Args oa;
+    memset(&oa, 0, sizeof(oa));
+    oa.struct_size = PJRT_Executable_OutputDimensions_Args_STRUCT_SIZE;
+    oa.executable = exe;
+    if (g_real->PJRT_Executable_OutputElementTypes(&ta) == nullptr &&
+        g_real->PJRT_Executable_OutputDimensions(&oa) == nullptr &&
+        oa.dims && oa.dim_sizes) {
+      uint64_t total = 0;
+      size_t cursor = 0;
+      int sizable = 1;
+      std::vector<uint64_t> sizes;
+      for (size_t o = 0; o < ta.num_output_types; o++) {
+        uint64_t w = dtype_width(ta.output_types[o]);
+        if (w == 0) {
+          sizable = 0;
+          break;
+        }
+        uint64_t elems = 1;
+        for (size_t k = 0; k < oa.dim_sizes[o]; k++)
+          elems *= (uint64_t)oa.dims[cursor + k];
+        cursor += oa.dim_sizes[o];
+        sizes.push_back(w * elems);
+        total += w * elems;
+      }
+      if (sizable && total > 0) {
+        meta->out_total = total;
+        meta->out_sizes = std::move(sizes);
+        if (meta->n_out == 0) meta->n_out = meta->out_sizes.size();
+      }
+    }
+  }
+}
+
+/* row → device-index map from the loaded executable's addressable
+ * devices (the devices its execute rows target, in order) */
+void fill_row_devs(PJRT_LoadedExecutable* le, ExecMeta* meta) {
+  if (!g_real->PJRT_LoadedExecutable_AddressableDevices) return;
+  PJRT_LoadedExecutable_AddressableDevices_Args aa;
+  memset(&aa, 0, sizeof(aa));
+  aa.struct_size = PJRT_LoadedExecutable_AddressableDevices_Args_STRUCT_SIZE;
+  aa.executable = le;
+  if (g_real->PJRT_LoadedExecutable_AddressableDevices(&aa) != nullptr) return;
+  for (size_t i = 0; i < aa.num_addressable_devices; i++)
+    meta->row_dev.push_back(device_index(aa.addressable_devices[i]));
 }
 
 PJRT_Error* wrap_Client_Compile(PJRT_Client_Compile_Args* args) {
@@ -506,49 +682,15 @@ PJRT_Error* wrap_Client_Compile(PJRT_Client_Compile_Args* args) {
         g_programs[args->executable] = {(uint64_t)sa.size_in_bytes, 0, 1};
         pthread_mutex_unlock(&g_mu);
       }
-      /* cache output arity + total output bytes for the execute hot path */
-      if (g_real->PJRT_Executable_NumOutputs) {
-        PJRT_Executable_NumOutputs_Args na;
-        memset(&na, 0, sizeof(na));
-        na.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
-        na.executable = ga.executable;
-        if (g_real->PJRT_Executable_NumOutputs(&na) == nullptr) {
-          pthread_mutex_lock(&g_mu);
-          g_num_outputs[args->executable] = na.num_outputs;
-          pthread_mutex_unlock(&g_mu);
-        }
-      }
-      if (g_real->PJRT_Executable_OutputElementTypes &&
-          g_real->PJRT_Executable_OutputDimensions) {
-        PJRT_Executable_OutputElementTypes_Args ta;
-        memset(&ta, 0, sizeof(ta));
-        ta.struct_size = PJRT_Executable_OutputElementTypes_Args_STRUCT_SIZE;
-        ta.executable = ga.executable;
-        PJRT_Executable_OutputDimensions_Args oa;
-        memset(&oa, 0, sizeof(oa));
-        oa.struct_size = PJRT_Executable_OutputDimensions_Args_STRUCT_SIZE;
-        oa.executable = ga.executable;
-        if (g_real->PJRT_Executable_OutputElementTypes(&ta) == nullptr &&
-            g_real->PJRT_Executable_OutputDimensions(&oa) == nullptr &&
-            oa.dims && oa.dim_sizes) {
-          uint64_t total = 0;
-          size_t cursor = 0;
-          int sizable = 1;
-          for (size_t o = 0; o < ta.num_output_types; o++) {
-            uint64_t w = dtype_width(ta.output_types[o]);
-            if (w == 0) { sizable = 0; break; }
-            uint64_t elems = 1;
-            for (size_t k = 0; k < oa.dim_sizes[o]; k++)
-              elems *= (uint64_t)oa.dims[cursor + k];
-            cursor += oa.dim_sizes[o];
-            total += w * elems;
-          }
-          if (sizable && total > 0) {
-            pthread_mutex_lock(&g_mu);
-            g_out_bytes[args->executable] = total;
-            pthread_mutex_unlock(&g_mu);
-          }
-        }
+      /* cache output arity + per-output sizes + row→device map for the
+       * execute hot path */
+      {
+        ExecMeta meta;
+        fill_exec_meta(ga.executable, &meta);
+        fill_row_devs(args->executable, &meta);
+        pthread_mutex_lock(&g_mu);
+        g_exec_meta[args->executable] = std::move(meta);
+        pthread_mutex_unlock(&g_mu);
       }
       /* the unloaded-executable wrapper is caller-owned (pjrt_c_api.h:
        * "should be freed by the caller with PJRT_Executable_Destroy") */
@@ -567,8 +709,7 @@ PJRT_Error* wrap_Client_Compile(PJRT_Client_Compile_Args* args) {
 PJRT_Error* wrap_LoadedExecutable_Destroy(
     PJRT_LoadedExecutable_Destroy_Args* args) {
   pthread_mutex_lock(&g_mu);
-  g_num_outputs.erase(args->executable);
-  g_out_bytes.erase(args->executable);
+  g_exec_meta.erase(args->executable);
   auto it = g_programs.find(args->executable);
   Acct acct{0, 0, 1};
   bool found = it != g_programs.end();
@@ -668,32 +809,28 @@ bool track_completion(PJRT_Buffer* out0, double t_submit) {
   return true;
 }
 
-/* n_out / out_bytes with a fallback query for executables that did not
- * come through wrap_Client_Compile (e.g. deserialized from a persistent
- * compilation cache) */
-static size_t exec_num_outputs(PJRT_LoadedExecutable* le) {
+/* metadata lookup with a ONE-TIME fallback query for executables that
+ * did not come through wrap_Client_Compile (e.g. deserialized from a
+ * persistent compilation cache) — after the first execute every lookup
+ * is a map hit, zero PJRT calls */
+static ExecMeta exec_meta_for(PJRT_LoadedExecutable* le) {
   pthread_mutex_lock(&g_mu);
-  auto it = g_num_outputs.find(le);
-  if (it != g_num_outputs.end()) {
-    size_t n = it->second;
+  auto it = g_exec_meta.find(le);
+  if (it != g_exec_meta.end()) {
+    ExecMeta m = it->second;
     pthread_mutex_unlock(&g_mu);
-    return n;
+    return m;
   }
   pthread_mutex_unlock(&g_mu);
-  size_t n = 0;
-  if (g_real->PJRT_LoadedExecutable_GetExecutable &&
-      g_real->PJRT_Executable_NumOutputs) {
+  ExecMeta m;
+  if (g_real->PJRT_LoadedExecutable_GetExecutable) {
     PJRT_LoadedExecutable_GetExecutable_Args ga;
     memset(&ga, 0, sizeof(ga));
     ga.struct_size = PJRT_LoadedExecutable_GetExecutable_Args_STRUCT_SIZE;
     ga.loaded_executable = le;
     if (g_real->PJRT_LoadedExecutable_GetExecutable(&ga) == nullptr) {
-      PJRT_Executable_NumOutputs_Args na;
-      memset(&na, 0, sizeof(na));
-      na.struct_size = PJRT_Executable_NumOutputs_Args_STRUCT_SIZE;
-      na.executable = ga.executable;
-      if (g_real->PJRT_Executable_NumOutputs(&na) == nullptr)
-        n = na.num_outputs;
+      fill_exec_meta(ga.executable, &m);
+      fill_row_devs(le, &m);
       if (g_real->PJRT_Executable_Destroy) {
         PJRT_Executable_Destroy_Args da;
         memset(&da, 0, sizeof(da));
@@ -704,58 +841,76 @@ static size_t exec_num_outputs(PJRT_LoadedExecutable* le) {
     }
   }
   pthread_mutex_lock(&g_mu);
-  g_num_outputs[le] = n;
+  g_exec_meta[le] = m;
   pthread_mutex_unlock(&g_mu);
-  return n;
+  return m;
 }
 
 PJRT_Error* wrap_LoadedExecutable_Execute(
     PJRT_LoadedExecutable_Execute_Args* args) {
-  /* PRE-execute quota check from compile-time output metadata: rejecting
-   * before the real call avoids unwinding a completed execute (which
-   * would leak the caller's completion events and consume donated
+  /* PRE-execute quota admission from compile-time output metadata:
+   * rejecting before the real call avoids unwinding a completed execute
+   * (which would leak the caller's completion events and consume donated
    * inputs behind its back — the reason there is no post-hoc reject).
    *
    * The predicted bytes are RESERVED (atomic check-and-add under the
    * region lock, accumulated per device across multi-device rows), not
    * merely compared against headroom: two concurrent executes racing the
-   * last bytes cannot both be admitted.  The reservation is released
-   * after the real outputs are accounted (or on any failure), so the
-   * transient state is conservative (reservation + actuals), never
-   * under-counted. */
+   * last bytes cannot both be admitted.  On success the reservation
+   * simply BECOMES the output accounting — each output buffer is mapped
+   * to its compile-time size so Buffer_Destroy releases the right bytes.
+   * Net cost of the whole path: one region transaction per device row
+   * and ZERO extra PJRT calls (per-output size/device queries would be
+   * one network round trip EACH through a proxied plugin — with K
+   * outputs, 2K RTTs per step: the round-2 ~73% overhead).  Under
+   * oversubscribe the reservation is force-admitted rather than skipped,
+   * keeping the monitor's usage truthful on the same single-transaction
+   * path. */
+  uint64_t t0 = now_ns();
+  g_stats.exec_calls++;
+  ExecMeta meta = exec_meta_for(args->executable);
+  /* row→device resolution: an explicit execute_device wins; otherwise
+   * the loaded executable's addressable-device order (cached in meta)
+   * maps each output row to its true device — the row INDEX alone is
+   * only the final fallback (wrong whenever the executable targets a
+   * device other than 0) */
+  int exec_dev = args->execute_device ? device_index(args->execute_device)
+                                      : -1;
+  auto row_device = [&](size_t d) -> int {
+    if (exec_dev >= 0) return exec_dev;
+    if (d < meta.row_dev.size()) return meta.row_dev[d];
+    return (int)d;
+  };
   uint64_t reserved[VTPU_MAX_DEVICES] = {0};
   bool have_reservation = false;
-  if (g_region && args->output_lists && !g_cfg.oversubscribe) {
-    uint64_t per_row = 0;
-    pthread_mutex_lock(&g_mu);
-    auto bit = g_out_bytes.find(args->executable);
-    if (bit != g_out_bytes.end()) per_row = bit->second;
-    pthread_mutex_unlock(&g_mu);
-    if (per_row > 0) {
-      uint64_t want[VTPU_MAX_DEVICES] = {0};
-      for (size_t d = 0; d < args->num_devices; d++) {
-        if (!args->output_lists[d]) continue;
-        int dev = args->execute_device ? device_index(args->execute_device)
-                                       : (int)d;
-        if (dev >= 0 && dev < VTPU_MAX_DEVICES) want[dev] += per_row;
+  if (g_region && args->output_lists && meta.out_total > 0) {
+    uint64_t want[VTPU_MAX_DEVICES] = {0};
+    for (size_t d = 0; d < args->num_devices; d++) {
+      if (!args->output_lists[d]) continue;
+      int dev = row_device(d);
+      if (dev >= 0 && dev < VTPU_MAX_DEVICES) want[dev] += meta.out_total;
+    }
+    for (int dev = 0; dev < VTPU_MAX_DEVICES; dev++) {
+      if (want[dev] == 0) continue;
+      if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0,
+                              want[dev], g_cfg.oversubscribe) != 0) {
+        for (int u = 0; u < dev; u++)
+          if (reserved[u])
+            vtpu_region_sub(g_region, (int32_t)getpid(), u, 0, reserved[u]);
+        g_stats.exec_shim_ns += now_ns() - t0;
+        return quota_reject("vtpu: HBM quota exceeded (execute outputs)");
       }
-      for (int dev = 0; dev < VTPU_MAX_DEVICES; dev++) {
-        if (want[dev] == 0) continue;
-        if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0,
-                                want[dev], /*oversubscribe=*/0) != 0) {
-          for (int u = 0; u < dev; u++)
-            if (reserved[u])
-              vtpu_region_sub(g_region, (int32_t)getpid(), u, 0, reserved[u]);
-          return quota_reject("vtpu: HBM quota exceeded (execute outputs)");
-        }
-        reserved[dev] = want[dev];
-        have_reservation = true;
-      }
+      reserved[dev] = want[dev];
+      have_reservation = true;
     }
   }
   int q = g_cfg.core_limit;
-  bool pace_active = q > 0 && q < 100 && !g_cfg.core_policy_disable &&
-                     !(g_region && g_region->utilization_switch == 1);
+  /* policy: force keeps throttling even when the monitor's arbiter
+   * suspends it for a high-priority neighbor (utilization_switch);
+   * disable never throttles (ref GPU_CORE_UTILIZATION_POLICY) */
+  bool suspended = g_region && g_region->utilization_switch == 1 &&
+                   g_cfg.core_policy != 1;
+  bool pace_active = q > 0 && q < 100 && g_cfg.core_policy != 2 && !suspended;
   if (pace_active) {
     /* duty-cycle pacing at SUBMIT from the measured device step time */
     pthread_mutex_lock(&g_pace_mu);
@@ -767,10 +922,13 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
       ts.tv_sec = (time_t)delay;
       ts.tv_nsec = (long)((delay - (double)ts.tv_sec) * 1e9);
       nanosleep(&ts, nullptr);
+      g_stats.pace_sleep_ns += (uint64_t)(delay * 1e9);
     }
   }
   double t_submit = now_s();
+  uint64_t t1 = now_ns();
   PJRT_Error* err = g_real->PJRT_LoadedExecutable_Execute(args);
+  uint64_t t2 = now_ns();
   double t_return = now_s();
   bool completion_tracked = false;
   if (g_region) {
@@ -793,45 +951,76 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
           code == PJRT_Error_Code_ABORTED)
         vtpu_region_exec_result(g_region, 0);
     }
-  }
-  if (g_region) {
     __sync_fetch_and_add(&g_region->recent_kernel, 1);
-    /* post-hoc accounting of the outputs that DID materialize: always
-     * admitted (the reject already happened pre-execute when metadata
-     * allowed), so the monitor's usage numbers stay truthful even for
-     * executables whose output sizes were unknowable up front */
-    if (!err && args->output_lists) {
-      size_t n_out = exec_num_outputs(args->executable);
+    if (!err && args->output_lists && meta.out_sizes.size() > 0) {
+      /* sized path: attribute the already-reserved bytes to the concrete
+       * output buffers — map inserts only, no region or PJRT traffic */
+      uint64_t unclaimed[VTPU_MAX_DEVICES] = {0};
+      /* row devices were resolved BEFORE this g_mu section (device_index
+       * locks g_mu; row_device only reads meta/exec_dev) */
+      pthread_mutex_lock(&g_mu);
       for (size_t d = 0; d < args->num_devices; d++) {
         PJRT_Buffer** outs = args->output_lists[d];
         if (!outs) continue;
-        int row_dev = args->execute_device
-                          ? device_index(args->execute_device)
-                          : (int)d;
-        for (size_t i = 0; i < n_out; i++) {
-          if (!outs[i]) continue;
-          /* attribute to the buffer's OWN device when queryable (JAX
-           * often leaves execute_device null; the row index is only the
-           * last-resort guess) */
-          int dev = row_dev;
-          if (g_real->PJRT_Buffer_Device) {
-            PJRT_Buffer_Device_Args bda;
-            memset(&bda, 0, sizeof(bda));
-            bda.struct_size = PJRT_Buffer_Device_Args_STRUCT_SIZE;
-            bda.buffer = outs[i];
-            if (g_real->PJRT_Buffer_Device(&bda) == nullptr && bda.device)
-              dev = device_index(bda.device);
-          }
-          account_buffer_idx_forced(outs[i], dev);
-          if (pace_active && !completion_tracked)
-            completion_tracked = track_completion(outs[i], t_submit);
+        int dev = row_device(d);
+        if (dev < 0 || dev >= VTPU_MAX_DEVICES) dev = 0;
+        for (size_t i = 0; i < meta.out_sizes.size(); i++) {
+          if (outs[i])
+            g_buffers[outs[i]] = {meta.out_sizes[i], dev, 0};
+          else
+            unclaimed[dev] += meta.out_sizes[i];
         }
       }
+      pthread_mutex_unlock(&g_mu);
+      have_reservation = false; /* transferred to the buffers */
+      for (int dev = 0; dev < VTPU_MAX_DEVICES; dev++)
+        if (unclaimed[dev]) /* reserved slots the runtime left null */
+          vtpu_region_sub(g_region, (int32_t)getpid(), dev, 0, unclaimed[dev]);
+      if (pace_active)
+        for (size_t d = 0; d < args->num_devices && !completion_tracked; d++)
+          if (args->output_lists[d] && args->output_lists[d][0])
+            completion_tracked =
+                track_completion(args->output_lists[d][0], t_submit);
+    } else if (!err && args->output_lists && meta.n_out > 0) {
+      /* sizes unknowable from compile-time metadata (opaque dtypes):
+       * LEARN the actual on-device sizes once — per-output queries on
+       * the first row only — then promote the executable to the sized
+       * path so every later execute is RTT-free */
+      std::vector<uint64_t> learned;
+      uint64_t row_total = 0;
+      for (size_t d = 0; d < args->num_devices; d++) {
+        PJRT_Buffer** outs = args->output_lists[d];
+        if (!outs) continue;
+        int dev = row_device(d);
+        if (dev < 0 || dev >= VTPU_MAX_DEVICES) dev = 0;
+        if (learned.empty()) {
+          for (size_t i = 0; i < meta.n_out; i++) {
+            uint64_t sz = outs[i] ? buffer_size(outs[i]) : 0;
+            learned.push_back(sz);
+            row_total += sz;
+          }
+        }
+        if (row_total > 0) {
+          vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0,
+                              row_total, /*oversubscribe=*/1);
+          pthread_mutex_lock(&g_mu);
+          for (size_t i = 0; i < meta.n_out && i < learned.size(); i++)
+            if (outs[i] && learned[i] > 0)
+              g_buffers[outs[i]] = {learned[i], dev, 0};
+          pthread_mutex_unlock(&g_mu);
+        }
+        if (pace_active && !completion_tracked && outs[0])
+          completion_tracked = track_completion(outs[0], t_submit);
+      }
+      if (row_total > 0) {
+        meta.out_sizes = std::move(learned);
+        meta.out_total = row_total;
+        pthread_mutex_lock(&g_mu);
+        g_exec_meta[args->executable] = std::move(meta);
+        pthread_mutex_unlock(&g_mu);
+      }
     }
-    /* swap the reservation for the actual output accounting (or drop it
-     * on execute failure) — only after the actuals land, so a racing
-     * execute never sees a window with neither counted */
-    if (have_reservation)
+    if (have_reservation) /* execute failed (or no outputs): roll back */
       for (int dev = 0; dev < VTPU_MAX_DEVICES; dev++)
         if (reserved[dev])
           vtpu_region_sub(g_region, (int32_t)getpid(), dev, 0, reserved[dev]);
@@ -842,6 +1031,7 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
      * better than pacing nothing */
     pace_observe(t_submit, t_return);
   }
+  g_stats.exec_shim_ns += (t1 - t0) + (now_ns() - t2);
   return err;
 }
 
@@ -850,10 +1040,25 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
  * nvidia-smi-equivalence property (ref README.md:135) */
 PJRT_Error* wrap_Device_MemoryStats(PJRT_Device_MemoryStats_Args* args) {
   PJRT_Error* err = g_real->PJRT_Device_MemoryStats(args);
-  if (err) return err;
   int dev = device_index(args->device);
-  if (g_region && dev < g_region->num_devices &&
-      g_region->limit_bytes[dev] > 0) {
+  bool have_quota = g_region && dev < g_region->num_devices &&
+                    g_region->limit_bytes[dev] > 0;
+  if (err) {
+    /* some transports don't implement MemoryStats — with a quota we can
+     * still answer from our own accounting (the cap must stay visible) */
+    if (!have_quota) return err;
+    PJRT_Error_Destroy_Args d;
+    memset(&d, 0, sizeof(d));
+    d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
+    d.error = err;
+    wrap_Error_Destroy(&d);
+    /* zero the output fields the failed call left undefined */
+    size_t head = offsetof(PJRT_Device_MemoryStats_Args, bytes_in_use);
+    size_t len = args->struct_size < sizeof(*args) ? args->struct_size
+                                                   : sizeof(*args);
+    if (len > head) memset(((char*)args) + head, 0, len - head);
+  }
+  if (have_quota) {
     args->bytes_limit = (int64_t)g_region->limit_bytes[dev];
     args->bytes_limit_is_set = true;
     args->bytes_in_use = (int64_t)vtpu_region_device_usage(g_region, dev);
@@ -867,6 +1072,7 @@ extern "C" const PJRT_Api* GetPjrtApi() {
   pthread_mutex_lock(&g_mu);
   if (g_real == nullptr) {
     load_config();
+    atexit(dump_stats);
     void* h = dlopen(g_cfg.real_plugin, RTLD_NOW | RTLD_LOCAL);
     if (!h) {
       fprintf(stderr, "vtpu_shim: cannot dlopen %s: %s\n", g_cfg.real_plugin,

@@ -119,6 +119,77 @@ def test_feedback_suspends_high_priority_throttle(tmp_path):
     pm.close()
 
 
+# -- hostpid mapping ------------------------------------------------------
+
+
+def _fake_host_proc(proc_root, hostpid, nspid_chain, cgroup_line):
+    d = os.path.join(proc_root, str(hostpid))
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "status"), "w") as f:
+        f.write("Name:\tpython3\n")
+        f.write("NSpid:\t" + "\t".join(str(p) for p in nspid_chain) + "\n")
+    with open(os.path.join(d, "cgroup"), "w") as f:
+        f.write(cgroup_line + "\n")
+
+
+def test_hostpid_mapping_from_nspid(tmp_path):
+    """fill_hostpids joins host /proc NSpid chains with the pod UID from
+    the cgroup file and writes each slot's hostpid (ref setHostPid,
+    feedback.go:83-162 — the reference walks cgroupfs tasks files; with
+    hostPID the NSpid chain carries the same join)."""
+    from vtpu.monitor.hostpid import fill_hostpids
+
+    uid_a = "11111111-2222-3333-4444-555555555555"
+    uid_b = "aaaaaaaa-bbbb-cccc-dddd-eeeeeeeeeeee"
+    root = str(tmp_path / "containers")
+    make_container_region(root, uid_a, pid=17)
+    make_container_region(root, uid_b, pid=17)  # SAME container pid
+    proc_root = str(tmp_path / "proc")
+    # systemd-escaped cgroup path for pod A; plain cgroupfs for pod B
+    _fake_host_proc(
+        proc_root, 4242, [4242, 17],
+        "0::/kubepods.slice/kubepods-besteffort.slice/"
+        f"kubepods-besteffort-pod{uid_a.replace('-', '_')}.slice/cri.scope",
+    )
+    _fake_host_proc(
+        proc_root, 5151, [5151, 17], f"0::/kubepods/burstable/pod{uid_b}/ctr"
+    )
+    # a host-native process (no namespace chain) must never match
+    _fake_host_proc(proc_root, 6000, [6000], "0::/system.slice/sshd.service")
+
+    pm = PathMonitor(root)
+    pm.scan()
+    assert fill_hostpids(pm, proc_root=proc_root) == 2
+    hp = {
+        e.pod_uid: e.region.live_procs()[0]["hostpid"]
+        for e in pm.entries.values()
+    }
+    assert hp[uid_a] == 4242
+    assert hp[uid_b] == 5151
+    # idempotent: already-resolved slots are not re-written
+    assert fill_hostpids(pm, proc_root=proc_root) == 0
+    pm.close()
+
+
+def test_hostpid_ambiguous_left_unresolved(tmp_path):
+    """Two candidate host processes with the same container pid and no
+    pod evidence: the mapper must not guess."""
+    from vtpu.monitor.hostpid import fill_hostpids
+
+    uid = "99999999-8888-7777-6666-555555555555"
+    root = str(tmp_path / "containers")
+    make_container_region(root, uid, pid=31)
+    proc_root = str(tmp_path / "proc")
+    _fake_host_proc(proc_root, 700, [700, 31], "0::/user.slice")
+    _fake_host_proc(proc_root, 701, [701, 31], "0::/user.slice")
+    pm = PathMonitor(root)
+    pm.scan()
+    assert fill_hostpids(pm, proc_root=proc_root) == 0
+    entry = next(iter(pm.entries.values()))
+    assert entry.region.live_procs()[0]["hostpid"] == 0
+    pm.close()
+
+
 # -- cooperative shim runtime ---------------------------------------------
 
 
@@ -314,6 +385,34 @@ def test_shim_runtime_throttle_paces(tmp_path):
     assert paced() == 42
     dt = time.monotonic() - t0
     assert dt >= 0.035
+
+
+def test_dispatch_force_policy_ignores_arbiter_suspend(tmp_path, monkeypatch):
+    """TPU_CORE_UTILIZATION_POLICY=force keeps throttling even when the
+    monitor's arbiter suspends it (utilization_switch=1); default policy
+    honors the suspend (ref GPU_CORE_UTILIZATION_POLICY, docs/config.md
+    container envs)."""
+
+    def run(policy):
+        monkeypatch.setenv("TPU_CORE_UTILIZATION_POLICY", policy)
+        rt = ShimRuntime(
+            limits_bytes=[],
+            core_limit=25,
+            region_path=str(tmp_path / f"{policy}.cache"),
+            uuids=["tpu-0"],
+        )
+        rt.region.region.utilization_switch = 1  # arbiter: suspend
+        t0 = time.monotonic()
+        for _ in range(6):
+            rt.dispatch(lambda: time.sleep(0.01))  # 10ms steps
+        dt = time.monotonic() - t0
+        rt.close()
+        return dt
+
+    # suspended default: 6 × 10ms unpaced steps, no pacing sleeps
+    assert run("default") < 0.12
+    # force: warmup+calibrate then 4 paced steps at 25% (≥30ms sleep each)
+    assert run("force") >= 0.12
 
 
 # -- node RPC -------------------------------------------------------------

@@ -184,7 +184,11 @@ class LibtpuProvider:
         paths = set(_dev_paths())
         erroring = region_unhealthy_uuids()
         for c in chips:
-            node_ok = (c.devpath in paths) if (c.devpath and paths) else True
+            # a chip WITH a known devpath is healthy only while that node
+            # exists; an empty path set then means total device-node loss
+            # (driver wedge), not "assume healthy".  Only chips that never
+            # had a devpath (PJRT-only discovery) skip the node feed.
+            node_ok = (c.devpath in paths) if c.devpath else True
             c.healthy = node_ok and c.uuid not in erroring
         return list(chips)
 

@@ -32,6 +32,9 @@ class PjrtProvider:
         self._hostname = os.uname().nodename
         self._chips: Optional[List[Chip]] = None
         self._jax_dev = {}  # uuid → jax device handle, pinned at discovery
+        # uuid → in-flight probe thread: a wedged runtime parks its probe
+        # forever; the NEXT poll must not stack another thread on top
+        self._probes = {}
 
     def _discover(self) -> List[Chip]:
         try:
@@ -72,27 +75,56 @@ class PjrtProvider:
             )
         return chips
 
-    @staticmethod
-    def _probe_alive(dev) -> bool:
+    def _probe_alive(self, dev, timeout_s: float | None = None,
+                     key: str | None = None) -> bool:
         """Liveness through an actual runtime call, NOT the cached device
         list — JAX caches the backend process-wide, so a chip that dies
         after first enumeration still *appears* in jax.local_devices()
         forever.  memory_stats() is an RPC into the PJRT client and fails
         on a wedged runtime; devices without stats (cpu) get a tiny
-        round-trip transfer instead."""
-        try:
-            stats = dev.memory_stats()
-            if stats:
-                return True
-        except Exception:  # noqa: BLE001 — wedged runtime surfaces here
-            return False
-        try:
-            import jax  # noqa: PLC0415
+        round-trip transfer instead.
 
-            jax.device_put(0, dev).block_until_ready()
-            return True
-        except Exception:  # noqa: BLE001
-            return False
+        The probe runs under a deadline: a wedged runtime frequently
+        HANGS rather than errors, and an unbounded probe would freeze
+        health reporting for every chip — the exact failure this probe
+        exists to detect.  A timed-out probe counts as unhealthy.  At
+        most ONE probe thread exists per chip: while a previous probe is
+        still parked on the dead RPC, later polls report unhealthy
+        immediately instead of stacking a new thread every tick (and the
+        parked thread doubles as the recovery detector — when the RPC
+        finally completes, the next poll probes fresh)."""
+        import threading
+
+        if timeout_s is None:
+            timeout_s = float(os.environ.get("VTPU_PROBE_TIMEOUT_S", "5") or 5)
+        prev = self._probes.get(key) if key is not None else None
+        if prev is not None and prev.is_alive():
+            return False  # still wedged; don't stack another probe
+        verdict: list = []
+
+        def probe() -> None:
+            try:
+                stats = dev.memory_stats()
+                if stats:
+                    verdict.append(True)
+                    return
+            except Exception:  # noqa: BLE001 — wedged runtime surfaces here
+                verdict.append(False)
+                return
+            try:
+                import jax  # noqa: PLC0415
+
+                jax.device_put(0, dev).block_until_ready()
+                verdict.append(True)
+            except Exception:  # noqa: BLE001
+                verdict.append(False)
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        if key is not None:
+            self._probes[key] = t
+        return bool(verdict) and verdict[0]
 
     # -- DeviceProvider ----------------------------------------------------
     def enumerate(self) -> List[Chip]:
@@ -119,7 +151,9 @@ class PjrtProvider:
         out = []
         for c in base:
             dev = self._jax_dev.get(c.uuid)
-            alive = self._probe_alive(dev) if dev is not None else False
+            alive = (
+                self._probe_alive(dev, key=c.uuid) if dev is not None else False
+            )
             out.append(
                 dataclasses.replace(c, healthy=alive)
                 if alive != c.healthy

@@ -51,11 +51,16 @@ class FeedbackLoop:
         self._stop = threading.Event()
 
     def start(self) -> None:
+        from vtpu.monitor.hostpid import fill_hostpids
+
         def loop() -> None:
             while not self._stop.wait(self.interval_s):
                 try:
                     self.pathmon.scan()
                     observe_once(self.pathmon)
+                    # resolve container→host pids for new slots each tick
+                    # (ref setHostPid runs inside the feedback loop too)
+                    fill_hostpids(self.pathmon)
                 except Exception:  # noqa: BLE001
                     log.exception("feedback pass failed")
 

@@ -1,0 +1,123 @@
+"""Container-pid → host-pid mapping (ref cmd/vGPUmonitor/feedback.go:83-162).
+
+The reference's ``setHostPid`` joins NVML's running-process list against
+cgroupfs ``tasks`` files to fill each shared-region slot's ``hostpid``.
+The monitor daemonset runs with hostPID (charts/vtpu daemonsets), so the
+TPU-native equivalent needs no device library: every tenant process is
+visible in the host ``/proc``, where
+
+  * ``/proc/<hostpid>/status`` carries ``NSpid:`` — the pid-namespace
+    chain, host pid first, the pid *inside the container's namespace*
+    last; and
+  * ``/proc/<hostpid>/cgroup`` names the owning pod
+    (``...pod<UID>...``), which disambiguates identical in-container
+    pids across pods.
+
+``fill_hostpids`` walks the scanned container regions and writes the
+resolved host pid into each live proc slot via the region's
+``set_hostpid`` (shared_region.h:46 — the field the shim leaves for the
+monitor to fill), so node-side tooling (noderpc, metrics, operators) can
+correlate region procs with host processes.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+# pod UID inside a cgroup path: plain cgroupfs ("/kubepods/burstable/
+# pod<uid>/...") or systemd-escaped ("kubepods-burstable-pod<uid with _
+# for ->.slice")
+_POD_RE = re.compile(r"pod([0-9a-fA-F_\-]{36})")
+
+
+def _nspid_chain(status_text: str) -> List[int]:
+    """NSpid line of /proc/<pid>/status → [host, ..., innermost]."""
+    for line in status_text.splitlines():
+        if line.startswith("NSpid:"):
+            try:
+                return [int(t) for t in line.split()[1:]]
+            except ValueError:
+                return []
+    return []
+
+
+def _cgroup_pod_uid(cgroup_text: str) -> Optional[str]:
+    m = _POD_RE.search(cgroup_text)
+    if not m:
+        return None
+    return m.group(1).replace("_", "-").lower()
+
+
+def scan_host_procs(proc_root: str = "/proc") -> List[Tuple[int, int, Optional[str]]]:
+    """Enumerate host processes → (hostpid, container_pid, pod_uid).
+
+    Only processes in a child pid namespace are returned (NSpid chain
+    length > 1) — host-native processes cannot be region tenants."""
+    out: List[Tuple[int, int, Optional[str]]] = []
+    try:
+        names = os.listdir(proc_root)
+    except OSError:
+        return out
+    for name in names:
+        if not name.isdigit():
+            continue
+        base = os.path.join(proc_root, name)
+        try:
+            with open(os.path.join(base, "status")) as f:
+                chain = _nspid_chain(f.read())
+        except OSError:
+            continue
+        if len(chain) < 2:
+            continue
+        pod_uid = None
+        try:
+            with open(os.path.join(base, "cgroup")) as f:
+                pod_uid = _cgroup_pod_uid(f.read())
+        except OSError:
+            pass
+        out.append((int(name), chain[-1], pod_uid))
+    return out
+
+
+def fill_hostpids(pathmon, proc_root: str = "/proc") -> int:
+    """Resolve and write hostpid for every live region slot that lacks
+    one.  A slot matches a host process when the in-container pids agree
+    AND the pod UIDs agree (when the cgroup names one); an in-container
+    pid with several candidate host processes — whether across pods or
+    between two containers of the SAME pod (each container has its own
+    pid namespace, so sibling containers routinely share pid 1) — is
+    left unresolved rather than guessed.  Returns the number of slots
+    filled."""
+    host = scan_host_procs(proc_root)
+    by_cpid: Dict[int, List[Tuple[int, Optional[str]]]] = {}
+    for hostpid, cpid, pod_uid in host:
+        by_cpid.setdefault(cpid, []).append((hostpid, pod_uid))
+    filled = 0
+    for entry in pathmon.entries.values():
+        region = entry.region
+        if region is None:
+            continue
+        pod_uid = entry.pod_uid.lower()
+        for proc in region.live_procs():
+            if proc.get("hostpid"):
+                continue
+            cands = by_cpid.get(proc["pid"], [])
+            with_pod = [h for h, p in cands if p == pod_uid]
+            if len(with_pod) == 1:
+                chosen = with_pod[0]
+            elif not with_pod and len(cands) == 1 and cands[0][1] is None:
+                chosen = cands[0][0]
+            else:
+                continue
+            region.set_hostpid(proc["pid"], chosen)
+            filled += 1
+            log.debug(
+                "hostpid: %s pid %d → host pid %d",
+                entry.dirname, proc["pid"], chosen,
+            )
+    return filled

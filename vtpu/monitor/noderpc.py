@@ -41,7 +41,12 @@ def _container_usage(entry) -> pb.ContainerUsage:
                 core_limit=cores[i],
             )
         )
-    cu.proc_num = len(r.live_procs())
+    procs = r.live_procs()
+    cu.proc_num = len(procs)
+    for p in procs:
+        cu.procs.append(
+            pb.ProcInfo(pid=p["pid"], hostpid=p.get("hostpid", 0))
+        )
     return cu
 
 

@@ -171,11 +171,20 @@ def flash_attention_with_lse(q, k, v):
     op of ring attention: normalized output + per-row logsumexp form a
     valid online-softmax partial.  Forward is the Pallas kernel (bf16
     matmuls, f32 partial output so merging never rounds); backward
-    differentiates the reference formulation for BOTH outputs."""
+    differentiates the reference formulation for BOTH outputs.
+
+    Ragged sequence lengths (not divisible by the 128 block) route
+    through the reference formulation so the returned lse is ALWAYS a
+    real logsumexp — the kernel's ragged fallback would return lse=0,
+    silently breaking any caller that merges partials from this API."""
+    if q.shape[-2] % 128 or k.shape[-2] % 128:
+        return _ref_with_lse(q, k, v)
     return _flash_impl(q, k, v, False, 128, 128, jnp.float32)
 
 
 def _fwl_fwd(q, k, v):
+    if q.shape[-2] % 128 or k.shape[-2] % 128:
+        return _ref_with_lse(q, k, v), (q, k, v)
     return _flash_impl(q, k, v, False, 128, 128, jnp.float32), (q, k, v)
 
 

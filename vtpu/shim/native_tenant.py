@@ -130,31 +130,61 @@ def main() -> None:
 
     _barrier()
 
+    # the measured loop matches the exclusive baseline's shape (bench.py
+    # run_streams): N dispatch threads, each keeping 2 steps in flight —
+    # what a real serving pod runs.  A single stream would confound
+    # interposer overhead with dispatch-latency underutilization (each
+    # PJRT call through a relayed chip has RTT latency that pipelining
+    # hides), so the tenant must pipeline exactly like the baseline.
+    import threading
+
     seconds = float(os.environ.get("VTPU_TENANT_SECONDS", "10") or 10)
-    violations = 0
-    count = 0
-    pending = []
+    n_streams = int(os.environ.get("VTPU_TENANT_STREAMS", "4") or 4)
+    counts = [0] * n_streams
+    viols = [0] * n_streams
+    errors = []
     t0 = time.monotonic()
     stop_at = t0 + seconds
-    while time.monotonic() < stop_at:
-        try:
-            pending.append(forward(x))
-        except Exception as e:  # noqa: BLE001 — quota rejects surface here
-            if "RESOURCE_EXHAUSTED" in str(e) or "quota" in str(e):
-                violations += 1
-                if pending:
-                    jax.block_until_ready(pending.pop(0))
-                    count += batch
-                else:
-                    time.sleep(0.001)
-                continue
-            raise
-        if len(pending) >= 2:
+
+    def stream(i):
+        pending = []
+        while time.monotonic() < stop_at:
+            try:
+                pending.append(forward(x))
+            except Exception as e:  # noqa: BLE001 — quota rejects surface here
+                if "RESOURCE_EXHAUSTED" in str(e) or "quota" in str(e):
+                    viols[i] += 1
+                    if pending:
+                        jax.block_until_ready(pending.pop(0))
+                        counts[i] += batch
+                    else:
+                        time.sleep(0.001)
+                    continue
+                raise
+            if len(pending) >= 2:
+                jax.block_until_ready(pending.pop(0))
+                counts[i] += batch
+        while pending:
             jax.block_until_ready(pending.pop(0))
-            count += batch
-    while pending:
-        jax.block_until_ready(pending.pop(0))
-        count += batch
+            counts[i] += batch
+
+    def guarded(i):
+        try:
+            stream(i)
+        except BaseException as e:  # noqa: BLE001 — surfaced after join
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=guarded, args=(i,)) for i in range(n_streams)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    count = sum(counts)
+    violations = sum(viols)
     elapsed = time.monotonic() - t0
 
     stats = {}

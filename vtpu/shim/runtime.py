@@ -88,7 +88,13 @@ class ShimRuntime:
             if core_limit is not None
             else int(os.environ.get("TPU_DEVICE_CORES_LIMIT", "100") or 100)
         )
-        if os.environ.get("TPU_CORE_UTILIZATION_POLICY") == "disable":
+        # TPU_CORE_UTILIZATION_POLICY (ref docs/config.md container envs):
+        # default → throttle, the monitor's arbiter may suspend;
+        # force   → throttle even when utilization_switch suspends;
+        # disable → never throttle
+        policy = os.environ.get("TPU_CORE_UTILIZATION_POLICY", "default")
+        self.core_policy = policy if policy in ("force", "disable") else "default"
+        if self.core_policy == "disable":
             self.core_limit = 100
         self.oversubscribe = (
             oversubscribe
@@ -316,7 +322,10 @@ class ShimRuntime:
         retirement themselves."""
         if self.region is not None:
             self.region.incr_recent_kernel()
-            suspended = self.region.region.utilization_switch == 1
+            suspended = (
+                self.region.region.utilization_switch == 1
+                and self.core_policy != "force"
+            )
         else:
             suspended = False
         q = self.core_limit
@@ -378,25 +387,30 @@ class ShimRuntime:
             self.region.record_exec_result(True)
         return out
 
-    @staticmethod
-    def _retire(out) -> None:
+    def _retire(self, out) -> None:
         """Block until `out` is complete.  Prefers the object's own
         block_until_ready (covers fakes in tests and non-Array results
         with completion semantics), falling back to jax.block_until_ready
-        for pytrees."""
+        for pytrees.  Completion errors are suppressed (they are not
+        pacing errors — the caller sees them when it consumes the value)
+        but DEVICE-side failures surfacing at the drain still feed the
+        region's health streak, matching the native shim's execute path."""
         bur = getattr(out, "block_until_ready", None)
         if callable(bur):
             try:
                 bur()
                 return
-            except Exception:  # noqa: BLE001 — completion errors ≠ pacing errors
+            except Exception as e:  # noqa: BLE001 — completion ≠ pacing errors
+                if self.region is not None and self._is_device_error(e):
+                    self.region.record_exec_result(False)
                 return
         try:
             import jax
 
             jax.block_until_ready(out)
-        except Exception:  # noqa: BLE001 — non-jax return values
-            pass
+        except Exception as e:  # noqa: BLE001 — non-jax return values
+            if self.region is not None and self._is_device_error(e):
+                self.region.record_exec_result(False)
 
     def observe_step(self, seconds: float) -> None:
         """Feed the measured per-step device time back into dispatch()'s
@@ -424,7 +438,10 @@ class ShimRuntime:
             dt = time.monotonic() - t0
             if self.region is not None:
                 self.region.incr_recent_kernel()
-                suspended = self.region.region.utilization_switch == 1
+                suspended = (
+                    self.region.region.utilization_switch == 1
+                    and self.core_policy != "force"
+                )
             else:
                 suspended = False
             q = self.core_limit
