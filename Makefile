@@ -68,7 +68,13 @@ test-native: shim
 	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
 	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/procs.cache \
 	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
-	  ./build/test_shim build/libvtpu_shim.so procs \
+	  ./build/test_shim build/libvtpu_shim.so procs
+	cd cpp && TPU_DEVICE_MEMORY_LIMIT_0=1024 TPU_DEVICE_CORES_LIMIT=25 \
+	  MOCK_PJRT_NO_EVENTS=1 MOCK_PJRT_OUT_BYTES=4096 \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/noev.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
+	  ./build/test_shim build/libvtpu_shim.so noevents \
 	  && rm -rf /tmp/vtpu-make-test
 
 # sanitizer proof for the native shim's concurrency (SURVEY §5 names the

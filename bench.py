@@ -1,21 +1,26 @@
 """vtpu benchmark — 4-way chip sharing efficiency (BASELINE.json target).
 
 Measures ResNet-V2-50 inference (the ai-benchmark headline row) on the real
-chip twice:
+chip twice, with IDENTICAL process/stream shape in both arms so the ratio
+isolates the interposer:
 
-  exclusive   one tenant, no quotas — the "stock device plugin" row
-              (a 4-stream serving loop, what a real serving pod runs)
-  4-way share four tenant PROCESSES on ONE chip, each hard-capped at 25%
-              HBM by the NATIVE PJRT interposer (cpp/vtpu_shim.cc): every
-              tenant registers libvtpu_shim.so as its JAX plugin with the
-              real plugin loaded underneath, all four coordinating through
-              one shared region — the reference's libvgpu.so-preloaded
-              benchmark shape (ref README.md:212-225)
+  exclusive   4 processes × 4 pipelined streams, REAL plugin loaded
+              directly, no quotas — the "stock device plugin" saturated
+              chip (process-level parallelism is required to saturate a
+              chip behind a relayed dispatch path; a 1-process baseline
+              would understate exclusive and flatter the ratio)
+  4-way share the same 4 processes, each registering the NATIVE PJRT
+              interposer (cpp/vtpu_shim.cc) with the real plugin loaded
+              underneath and a hard 25%-HBM quota, all four coordinating
+              through one shared region — the reference's
+              libvgpu.so-preloaded benchmark shape (ref README.md:212-225)
 
 and reports summed-share throughput / exclusive throughput.  The
 BASELINE.json acceptance bar is ≥ 0.95 ("within 5% of an exclusive chip"),
 mirroring the reference's published ≈0-8% interception overhead
 (BASELINE.md).  vs_baseline = efficiency / 0.95, so ≥ 1.0 beats the bar.
+extra.per_tenant_vs_exclusive_tenant is the per-instance comparison the
+reference's README table makes (stock column vs vGPU column).
 
 When the native path is unavailable (no shim built, no real plugin, CPU
 run), the share phase falls back to four thread-tenants in one process on
@@ -39,6 +44,20 @@ import time
 os.environ.setdefault("XLA_FLAGS", "")
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+
+T_START = time.monotonic()
+
+# every phase attempt (exclusive / native share / fallback share) records
+# its outcome here; emitted in the final JSON's extra.phase_log so a
+# CPU-fallback artifact explains ITSELF (the r02 artifact did not — the
+# relay died and only the stderr tail showed why)
+PHASE_LOG: list = []
+
+
+def phase_note(phase: str, **kw) -> None:
+    entry = {"phase": phase, **kw}
+    PHASE_LOG.append(entry)
+    log(f"phase[{phase}]: {kw}")
 SHIM_SO = os.environ.get(
     "VTPU_SHIM_SO", os.path.join(REPO, "cpp", "build", "libvtpu_shim.so")
 )
@@ -292,7 +311,7 @@ def run_exclusive_child() -> dict | None:
     initializes the TPU backend (each tenant process needs its own
     session).  Falls back to a CPU-pinned child when the chip backend is
     unavailable."""
-    for env_tweak in (None, None, "cpu"):
+    for attempt, env_tweak in enumerate((None, None, "cpu")):
         env = dict(os.environ)
         if env_tweak == "cpu":
             env["JAX_PLATFORMS"] = "cpu"
@@ -303,17 +322,24 @@ def run_exclusive_child() -> dict | None:
                 [sys.executable, os.path.abspath(__file__), "--worker", "exclusive"],
                 env=env, cwd=REPO, capture_output=True, text=True, timeout=900,
             )
-        except subprocess.TimeoutExpired as e:
-            log(f"exclusive child timed out: {e}")
+        except subprocess.TimeoutExpired:
+            phase_note("exclusive", attempt=attempt, rc="timeout-900s",
+                       platform=env_tweak or "tpu")
             continue
         sys.stderr.write(proc.stderr[-2000:])
         if proc.returncode == 0:
             for line in reversed(proc.stdout.strip().splitlines()):
                 try:
-                    return json.loads(line)
+                    out = json.loads(line)
+                    phase_note("exclusive", attempt=attempt, rc=0,
+                               platform=out.get("platform"))
+                    return out
                 except json.JSONDecodeError:
                     continue
-        log(f"exclusive child rc={proc.returncode}")
+        phase_note("exclusive", attempt=attempt, rc=proc.returncode,
+                   platform=env_tweak or "tpu",
+                   stderr_tail=proc.stderr.strip().splitlines()[-1:]
+                   if proc.stderr.strip() else [])
         if proc.returncode == 11:
             time.sleep(30)  # stale sessions draining; give the pool air
     return None
@@ -327,11 +353,60 @@ def native_available() -> bool:
     return os.path.exists(SHIM_SO) and os.path.exists(REAL_PLUGIN)
 
 
-def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4):
+def wait_backend_ready(max_wait_s: float = 300.0) -> bool:
+    """Session-drain gate: backend slots behind a relayed transport are a
+    finite pool that killed/finished tenants release asynchronously —
+    launching the next phase while the pool is exhausted hangs every
+    tenant at init and burns a whole barrier window (the r3 failure
+    mode).  Probe with a tiny child (jax.devices() only) and wait until
+    one initializes promptly."""
+    deadline = time.monotonic() + max_wait_s
+    probe_env = dict(os.environ)
+    probe_env.pop("PALLAS_AXON_POOL_IPS", None)
+    probe_env["VTPU_TENANT_AXON"] = (
+        "1" if "axon" in os.path.basename(REAL_PLUGIN) else "0"
+    )
+    probe_env["VTPU_REAL_PJRT_PLUGIN"] = REAL_PLUGIN
+    probe_env["VTPU_TENANT_SHIM"] = "0"
+    probe_env["PYTHONPATH"] = REPO + os.pathsep + probe_env.get("PYTHONPATH", "")
+    code = (
+        "from vtpu.shim.native_tenant import _register_backend;"
+        "_register_backend();"
+        "import jax; print(jax.devices()[0].platform)"
+    )
+    attempt = 0
+    while time.monotonic() < deadline:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", code], env=probe_env, cwd=REPO,
+                capture_output=True, text=True, timeout=60,
+            )
+            if proc.returncode == 0:
+                if attempt:
+                    phase_note("backend_gate", rc=0, waited_attempts=attempt)
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        attempt += 1
+        log(f"backend gate: init not ready (attempt {attempt}); draining…")
+        time.sleep(20)
+    phase_note("backend_gate", rc="timeout", waited_attempts=attempt)
+    return False
+
+
+def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
+                     shim: bool = True, extra_env: dict | None = None):
     """Spawn ``n_tenants`` processes, each loading the real PJRT plugin
     THROUGH the interposer with a 1/n HBM quota, sharing one region; a
-    file barrier aligns their measurement windows.  Returns
-    (per_tenant_img_s, violations, region_info) or None on any failure."""
+    file barrier aligns their measurement windows.  ``shim=False`` is
+    the control arm: identical process/stream shape with the REAL plugin
+    loaded directly and no quotas — the saturated-chip exclusive
+    baseline (a single process cannot saturate a TPU through a relayed
+    dispatch path, so a 1-process baseline would understate "exclusive"
+    and flatter the share ratio).  Returns (tenant_dicts, region_info)
+    or None on any failure."""
+    if not wait_backend_ready():
+        return None
     tmp = tempfile.mkdtemp(prefix="vtpu-bench-native-")
     region = os.path.join(tmp, "vtpu.cache")
     env_base = dict(os.environ)
@@ -341,23 +416,46 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4):
     via_axon = "axon" in os.path.basename(REAL_PLUGIN)
     env_base.update(
         VTPU_TENANT_AXON="1" if via_axon else "0",
+        VTPU_TENANT_SHIM="1" if shim else "0",
         VTPU_SHIM_SO=SHIM_SO,
         VTPU_REAL_PJRT_PLUGIN=REAL_PLUGIN,
-        TPU_DEVICE_MEMORY_LIMIT_0=str(quota_mb),
-        TPU_DEVICE_MEMORY_SHARED_CACHE=region,
-        VTPU_VISIBLE_UUIDS="bench-tpu-0",
         VTPU_TENANT_SECONDS=str(window_s),
         VTPU_TENANT_BARRIER=tmp,
         PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        # all tenants compile the SAME program: the persistent cache lets
+        # tenant 2..n (and the share arm after the exclusive arm) reuse
+        # tenant 1's compile instead of queueing n remote compiles — the
+        # barrier-timeout failure mode when the transport is contended.
+        # The shim handles cache-deserialized executables (exec_meta_for
+        # fallback learns their output metadata on first execute).
+        JAX_COMPILATION_CACHE_DIR=os.environ.get(
+            "VTPU_JAX_CACHE_DIR", "/tmp/vtpu-jax-cache"
+        ),
     )
-    procs = [
-        subprocess.Popen(
+    if shim:
+        env_base.update(
+            TPU_DEVICE_MEMORY_LIMIT_0=str(quota_mb),
+            TPU_DEVICE_MEMORY_SHARED_CACHE=region,
+            VTPU_VISIBLE_UUIDS="bench-tpu-0",
+        )
+    else:
+        for k in ("TPU_DEVICE_MEMORY_LIMIT_0", "TPU_DEVICE_MEMORY_SHARED_CACHE",
+                  "VTPU_VISIBLE_UUIDS"):
+            env_base.pop(k, None)
+    if extra_env:
+        env_base.update(extra_env)
+    def spawn():
+        return subprocess.Popen(
             [sys.executable, "-m", "vtpu.shim.native_tenant"],
             env=env_base, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
-        for _ in range(n_tenants)
-    ]
+
+    # tenant 1 goes FIRST and populates the persistent compile cache;
+    # the rest then deserialize instead of racing n concurrent remote
+    # compiles (which queue behind each other on a contended transport
+    # and blow the barrier window)
+    procs = [spawn()]
     # orphaned tenants keep chip sessions claimed and starve every later
     # run — make sure they die with the orchestrator, whatever kills it
     import atexit
@@ -368,18 +466,22 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4):
                 p.kill()
 
     atexit.register(_reap)
-    try:
-        # all tenants compiled and waiting → open the gate
-        deadline = time.monotonic() + 900
+
+    def wait_ready(n, deadline):
         while time.monotonic() < deadline:
             ready = [f for f in os.listdir(tmp) if f.startswith("ready_")]
-            if len(ready) >= n_tenants:
-                break
+            if len(ready) >= n:
+                return
             if any(p.poll() not in (None, 0) for p in procs):
                 raise RuntimeError("tenant died before the barrier")
             time.sleep(0.5)
-        else:
-            raise TimeoutError("tenants never reached the barrier")
+        raise TimeoutError("tenants never reached the barrier")
+
+    try:
+        deadline = time.monotonic() + 900
+        wait_ready(1, deadline)
+        procs.extend(spawn() for _ in range(n_tenants - 1))
+        wait_ready(n_tenants, deadline)
         open(os.path.join(tmp, "go"), "w").close()
         outs = []
         for p in procs:
@@ -389,25 +491,26 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4):
                 raise RuntimeError(f"tenant rc={p.returncode}")
             outs.append(json.loads(stdout.strip().splitlines()[-1]))
     except Exception as e:  # noqa: BLE001 — fall back to the legacy path
-        log(f"native share failed: {e}")
+        phase_note("native_share", rc="error", error=str(e)[:300])
         for p in procs:
             if p.poll() is None:
                 p.kill()
         return None
     info = {}
-    try:
-        from vtpu.monitor.shared_region import open_region
+    if shim:
+        try:
+            from vtpu.monitor.shared_region import open_region
 
-        rf = open_region(region)
-        if rf is not None:
-            info = {
-                "region_procs": len(rf.live_procs()),
-                "region_limit_bytes": rf.limits()[0] if rf.limits() else 0,
-            }
-            rf.close()
-    except Exception:  # noqa: BLE001 — diagnostics only
-        pass
-    return [o["img_s"] for o in outs], sum(o["violations"] for o in outs), info
+            rf = open_region(region)
+            if rf is not None:
+                info = {
+                    "region_procs": len(rf.live_procs()),
+                    "region_limit_bytes": rf.limits()[0] if rf.limits() else 0,
+                }
+                rf.close()
+        except Exception:  # noqa: BLE001 — diagnostics only
+            pass
+    return outs, info
 
 
 # ---------------------------------------------------------------------------
@@ -449,6 +552,56 @@ def run_inprocess_share(platform: str, window: float, quota: int):
     return per_tenant, violations
 
 
+def run_oversubscribe_probe(window_s: float = 8.0) -> dict | None:
+    """The virtual-device-memory artifact on the real chip (ref
+    README.md:236-240, the vGPU+vm column): a training tenant whose
+    frozen backbone exceeds its HBM quota runs three arms —
+
+      oversub     quota 384 MiB + VTPU_OVERSUBSCRIBE → overflow layers
+                  live in the pinned_host swap tier, training proceeds
+      hard        same quota, no oversubscribe → RESOURCE_EXHAUSTED
+      all-device  no quota → the physically-fits comparison throughput
+
+    Returns the dict for bench extra, or None when the probe cannot run."""
+    quota_mb = int(os.environ.get("VTPU_OVERSUB_QUOTA_MB", "384"))
+    arms = {}
+    ok = 0
+    for arm, (q, osub) in {
+        "oversub": (quota_mb, "true"),
+        "hard": (quota_mb, ""),
+        "all_device": (0, ""),
+    }.items():
+        env = {"VTPU_TENANT_MODE": "oversub", "VTPU_OVERSUBSCRIBE": osub}
+        res = run_native_share(
+            quota_mb=q, window_s=window_s, n_tenants=1, extra_env=env
+        )
+        if res is None:
+            # keep the arms already measured — each costs minutes of
+            # real-chip time; a later transient failure must not discard
+            # them
+            phase_note("oversub_probe", arm=arm, rc="error")
+            arms[arm] = {"error": "arm failed (see phase_log)"}
+            continue
+        outs, _ = res
+        arms[arm] = outs[0]
+        ok += 1
+        phase_note("oversub_probe", arm=arm, rc=0)
+    if ok == 0:
+        return None
+    out = {"quota_mb": quota_mb, "arms_ok": ok}
+    if "error" not in arms["oversub"]:
+        out.update(
+            params_mb=arms["oversub"].get("params_mb"),
+            oversub_img_s=round(arms["oversub"].get("img_s", 0), 2),
+            swap_bytes=arms["oversub"].get("swap_bytes", 0),
+        )
+    if "error" not in arms["hard"]:
+        out["hard_quota_rejected"] = bool(arms["hard"].get("hard_reject"))
+    if "error" not in arms["all_device"]:
+        out["all_device_img_s"] = round(arms["all_device"].get("img_s", 0), 2)
+    return out
+
+
 def emit(efficiency: float, extra: dict) -> None:
     target = 0.95  # BASELINE.json: within 5% of exclusive
     print(
@@ -477,22 +630,62 @@ def main() -> None:
 
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
 
-    excl = run_exclusive_child()
-    if excl is None:
-        emit(0.0, {"error": "exclusive baseline failed on tpu and cpu"})
-        return
-    platform = excl["platform"]
-    exclusive = excl["exclusive_img_s"]
-    window = excl["window_s"]
-    quota = int(excl["hbm_bytes"]) // 4
-    log(f"exclusive: {exclusive:.2f} img/s ({platform}, 4-stream loop)")
+    # -- exclusive baseline -------------------------------------------------
+    # Preferred: 4 unshimmed PROCESSES (a chip fed through a relayed
+    # dispatch path saturates only with process-level parallelism — a
+    # 1-process baseline understates "exclusive" and flatters the share
+    # ratio).  Fallback: the legacy single-process child (also the CPU
+    # path).
+    window = 10.0
+    exclusive, platform, excl_mode = None, None, None
+    excl_per_proc: list = []
+    hbm = 16 * 1024**3
+    if native_available():
+        res = run_native_share(quota_mb=0, window_s=window, shim=False)
+        if res is not None:
+            outs, _ = res
+            excl_per_proc = [o["img_s"] for o in outs]
+            exclusive = sum(excl_per_proc)
+            platform = outs[0].get("platform", "tpu")
+            hbm = max(int(o.get("bytes_limit") or 0) for o in outs) or hbm
+            excl_mode = "4proc_noshim"
+            phase_note("exclusive", rc=0, mode=excl_mode, platform=platform)
+        else:
+            phase_note("exclusive", rc="error", mode="4proc_noshim")
+    if exclusive is None:
+        excl = run_exclusive_child()
+        if excl is None:
+            emit(0.0, {"error": "exclusive baseline failed on tpu and cpu",
+                       "phase_log": PHASE_LOG})
+            return
+        platform = excl["platform"]
+        exclusive = excl["exclusive_img_s"]
+        window = excl["window_s"]
+        hbm = int(excl["hbm_bytes"])
+        excl_mode = "1proc_4stream"
+    quota = int(hbm) // 4
+    log(f"exclusive: {exclusive:.2f} img/s ({platform}, {excl_mode})")
 
     per_tenant, violations, native, info = None, 0, False, {}
     if platform != "cpu" and native_available():
-        res = run_native_share(quota_mb=quota >> 20, window_s=window)
-        if res is not None:
-            per_tenant, violations, info = res
-            native = True
+        # the native 4-process share is the measured path; a relay flap is
+        # transient (sessions drain in ~30 s), so retry before giving up
+        for attempt in range(2):
+            res = run_native_share(quota_mb=quota >> 20, window_s=window)
+            if res is not None:
+                outs, info = res
+                per_tenant = [o["img_s"] for o in outs]
+                violations = sum(o["violations"] for o in outs)
+                native = True
+                phase_note("native_share", attempt=attempt, rc=0)
+                break
+            if attempt == 0:
+                log("native share retrying after backoff")
+                time.sleep(90)  # sessions drain in minutes, not seconds
+    elif platform != "cpu":
+        phase_note("native_share", rc="unavailable",
+                   shim=os.path.exists(SHIM_SO),
+                   real_plugin=os.path.exists(REAL_PLUGIN))
     if per_tenant is None:
         # fallback share runs in a child too: a wedged backend must
         # never hang the orchestrator (it still owes the driver a JSON)
@@ -503,27 +696,63 @@ def main() -> None:
                 "platform": platform,
                 "exclusive_img_s": round(exclusive, 2),
                 "error": "share phase failed (native and fallback)",
+                "phase_log": PHASE_LOG,
             })
             return
         per_tenant, violations = share["per_tenant_img_s"], share["violations"]
+        phase_note("fallback_share", rc=0, platform=share.get("platform"))
 
     shared_sum = sum(per_tenant)
     log(f"4-way share: sum {shared_sum:.2f} img/s, per-tenant {per_tenant}")
     log(f"quota violations: {violations} (native_shim={native})")
     efficiency = shared_sum / exclusive if exclusive > 0 else 0.0
-    emit(
-        efficiency,
-        {
-            "platform": platform,
-            "exclusive_img_s": round(exclusive, 2),
-            "shared_sum_img_s": round(shared_sum, 2),
-            "per_tenant_img_s": [round(r, 2) for r in per_tenant],
-            "quota_violations": violations,
-            "hbm_quota_bytes": int(quota),
-            "native_shim": native,
-            **info,
-        },
-    )
+    fallback_reason = None
+    if platform == "cpu":
+        fallback_reason = "tpu backend unavailable (see phase_log)"
+    elif not native:
+        fallback_reason = "native share failed; cooperative runtime used"
+    extra = {
+        "platform": platform,
+        "exclusive_img_s": round(exclusive, 2),
+        "exclusive_mode": excl_mode,
+        "shared_sum_img_s": round(shared_sum, 2),
+        "per_tenant_img_s": [round(r, 2) for r in per_tenant],
+        "quota_violations": violations,
+        "hbm_quota_bytes": int(quota),
+        "native_shim": native,
+        "fallback_reason": fallback_reason,
+        "phase_log": PHASE_LOG,
+        **info,
+    }
+    # the oversubscribe artifact is additive — never let it cost the main
+    # metric: bounded by remaining wall budget and a blanket try/except
+    budget_s = float(os.environ.get("VTPU_BENCH_BUDGET_S", "2400"))
+    elapsed_s = time.monotonic() - T_START
+    if (
+        native
+        and os.environ.get("VTPU_BENCH_OVERSUB", "1") != "0"
+        and elapsed_s < budget_s - 600
+    ):
+        try:
+            probe = run_oversubscribe_probe()
+        except Exception as e:  # noqa: BLE001 — additive artifact only
+            phase_note("oversub_probe", rc="error", error=str(e)[:200])
+            probe = None
+        if probe is not None:
+            extra["oversubscribe"] = probe
+            log(f"oversubscribe probe: {probe}")
+    if excl_per_proc:
+        extra["exclusive_per_proc_img_s"] = [round(r, 2) for r in excl_per_proc]
+    if excl_per_proc and native:
+        # like-for-like interposer cost: a shimmed+quota'd tenant vs an
+        # unshimmed tenant of identical shape (the reference's stock-vs-
+        # vGPU per-instance comparison, README.md:197-206).  Only
+        # meaningful when BOTH arms are native processes — a cooperative
+        # fallback share would compare unlike shapes.
+        mean_ex = exclusive / max(1, len(excl_per_proc))
+        mean_sh = shared_sum / max(1, len(per_tenant))
+        extra["per_tenant_vs_exclusive_tenant"] = round(mean_sh / mean_ex, 4)
+    emit(efficiency, extra)
 
 
 if __name__ == "__main__":

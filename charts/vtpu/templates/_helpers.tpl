@@ -28,3 +28,38 @@ stops the two families' daemonsets drifting apart.
   volumeMounts:
     - {name: vtpu-host, mountPath: /usr/local/vtpu}
 {{- end }}
+
+{{/*
+Resource-name prefix: .Release.Name by default (stable rendered names),
+nameOverride appends, fullnameOverride replaces outright (the operator
+knob surface of ref charts/vgpu/values.yaml:1-20, vtpu naming kept).
+*/}}
+{{- define "vtpu.fullname" -}}
+{{- if .Values.fullnameOverride -}}
+{{- .Values.fullnameOverride | trunc 63 | trimSuffix "-" -}}
+{{- else if .Values.nameOverride -}}
+{{- printf "%s-%s" .Release.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- else -}}
+{{- .Release.Name -}}
+{{- end -}}
+{{- end }}
+
+{{/* cluster-wide operator labels/annotations, merged into workloads */}}
+{{- define "vtpu.globalLabels" -}}
+{{- with .Values.global.labels }}
+{{ toYaml . }}
+{{- end }}
+{{- end }}
+
+{{- define "vtpu.globalAnnotations" -}}
+{{- with .Values.global.annotations }}
+{{ toYaml . }}
+{{- end }}
+{{- end }}
+
+{{- define "vtpu.imagePullSecrets" -}}
+{{- with .Values.imagePullSecrets }}
+imagePullSecrets:
+{{ toYaml . }}
+{{- end }}
+{{- end }}

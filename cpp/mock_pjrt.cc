@@ -287,9 +287,13 @@ extern "C" const PJRT_Api* GetPjrtApi() {
   g_mock_api.PJRT_Device_AddressableMemories = device_memories;
   g_mock_api.PJRT_Memory_Kind = memory_kind;
   g_mock_api.PJRT_Buffer_Memory = buffer_memory;
-  g_mock_api.PJRT_Buffer_ReadyEvent = buffer_ready_event;
-  g_mock_api.PJRT_Event_OnReady = event_on_ready;
-  g_mock_api.PJRT_Event_Destroy = event_destroy;
+  /* MOCK_PJRT_NO_EVENTS=1 models a plugin without the event API — the
+   * shim's pacing must then fall back to host-side call duration */
+  if (!env_int("MOCK_PJRT_NO_EVENTS", 0)) {
+    g_mock_api.PJRT_Buffer_ReadyEvent = buffer_ready_event;
+    g_mock_api.PJRT_Event_OnReady = event_on_ready;
+    g_mock_api.PJRT_Event_Destroy = event_destroy;
+  }
   g_mock_api.PJRT_Buffer_OnDeviceSizeInBytes = buffer_size;
   g_mock_api.PJRT_Buffer_Destroy = buffer_destroy;
   g_mock_api.PJRT_Client_Compile = client_compile;

@@ -1,0 +1,118 @@
+"""Helm-chart structural tests (ref charts/vgpu; VERDICT r2 #8).
+
+No helm binary is baked into the CI image, so the always-on checks are
+structural: every ``.Values.*`` path a template references must exist in
+values.yaml (a rendered-manifest golden test catches the same typo class
+— a knob that silently renders to nothing), block opens/ends must
+balance, resource names must go through the ``vtpu.fullname`` helper,
+and the operator-knob surface (imagePullSecrets, global
+labels/annotations, nameOverride, extraArgs, tolerations,
+podSecurityPolicy) must be wired into the workload templates.  When a
+helm binary IS present, ``helm lint`` + ``helm template`` run too.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+
+import pytest
+import yaml
+
+CHART = os.path.join(os.path.dirname(os.path.dirname(__file__)), "charts", "vtpu")
+
+
+def _templates():
+    out = []
+    for root, _dirs, files in os.walk(os.path.join(CHART, "templates")):
+        for f in files:
+            if f.endswith((".yaml", ".tpl")):
+                p = os.path.join(root, f)
+                out.append((os.path.relpath(p, CHART), open(p).read()))
+    return out
+
+
+@pytest.fixture(scope="module")
+def values():
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        return yaml.safe_load(f)
+
+
+def test_operator_knobs_present(values):
+    assert values["imagePullSecrets"] == []
+    assert values["nameOverride"] == "" and values["fullnameOverride"] == ""
+    assert values["global"] == {"labels": {}, "annotations": {}}
+    assert values["podSecurityPolicy"] == {"enabled": False}
+    assert values["scheduler"]["extraArgs"] == []
+    assert values["devicePlugin"]["extraArgs"] == []
+    tol = values["devicePlugin"]["tolerations"]
+    assert tol and tol[0]["key"] == "google.com/tpu"
+
+
+def test_values_paths_exist(values):
+    """Every .Values.a.b.c reference in every template resolves in
+    values.yaml — the knob-typo class a golden render would catch."""
+    pat = re.compile(r"\.Values\.([A-Za-z0-9_.]+)")
+    missing = []
+    for name, text in _templates():
+        for path in set(pat.findall(text)):
+            node = values
+            for part in path.split("."):
+                if isinstance(node, dict) and part in node:
+                    node = node[part]
+                else:
+                    missing.append(f"{name}: .Values.{path}")
+                    break
+    assert not missing, missing
+
+
+def test_template_blocks_balanced():
+    open_pat = re.compile(r"\{\{-?\s*(?:if|range|with|define)\b")
+    end_pat = re.compile(r"\{\{-?\s*end\b")
+    for name, text in _templates():
+        opens = len(open_pat.findall(text))
+        ends = len(end_pat.findall(text))
+        assert opens == ends, f"{name}: {opens} opens vs {ends} ends"
+
+
+def test_resource_names_use_fullname_helper():
+    """nameOverride/fullnameOverride only work if resource names go
+    through the helper — a bare .Release.Name in a name: line bypasses
+    them."""
+    for name, text in _templates():
+        if name.endswith(".tpl"):
+            continue
+        for line in text.splitlines():
+            if re.search(r"^\s*name:", line) and ".Release.Name" in line:
+                raise AssertionError(f"{name}: bare Release.Name in {line!r}")
+
+
+def test_knobs_wired_into_workloads():
+    by_name = dict(_templates())
+    dep = by_name["templates/scheduler/deployment.yaml"]
+    ds = by_name["templates/device-plugin/daemonset.yaml"]
+    ds_pjrt = by_name["templates/device-plugin/daemonset-pjrt.yaml"]
+    for t in (dep, ds, ds_pjrt):
+        assert "vtpu.imagePullSecrets" in t
+        assert "vtpu.globalLabels" in t
+        assert "global.annotations" in t
+    assert ".Values.scheduler.extraArgs" in dep
+    assert ".Values.devicePlugin.extraArgs" in ds
+    assert ".Values.devicePlugin.tolerations" in ds
+    assert ".Values.devicePluginPjrt.tolerations" in ds_pjrt
+    assert "podSecurityPolicy.enabled" in by_name["templates/scheduler/psp.yaml"]
+
+
+@pytest.mark.skipif(shutil.which("helm") is None, reason="no helm binary")
+def test_helm_lint_and_render():
+    assert subprocess.run(["helm", "lint", CHART]).returncode == 0
+    out = subprocess.run(
+        ["helm", "template", "rel", CHART, "--set",
+         "imagePullSecrets[0].name=regcred,nameOverride=alt,"
+         "global.labels.team=ml"],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "regcred" in out.stdout
+    assert "rel-alt-scheduler" in out.stdout
+    assert "team: ml" in out.stdout

@@ -239,7 +239,9 @@ def test_pjrt_provider_health_reprobe():
     assert chips and all(c.healthy for c in chips)
     victim = chips[0].uuid
     victim_dev = prov._jax_dev[victim]
-    prov._probe_alive = lambda dev: dev is not victim_dev  # wedged runtime
+    prov._probe_alive = (
+        lambda dev, **kw: dev is not victim_dev  # wedged runtime
+    )
     after = prov.health_check()
     assert [c for c in after if c.uuid == victim][0].healthy is False
     # device set stays pinned (kubelet identity stability)

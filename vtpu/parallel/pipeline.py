@@ -19,13 +19,20 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def pipeline_apply(stage_fn, params, xs, mesh: Mesh, axis: str = "pp"):
+def pipeline_apply(stage_fn, params, xs, mesh: Mesh, axis: str = "pp",
+                   param_specs=None):
     """Run ``xs`` microbatches through the pipeline.
 
     stage_fn(stage_params, x) -> y     one stage's computation
     params: pytree whose leaves have a leading stage dim sharded on ``axis``
     xs: [n_micro, micro, d] (replicated); returns [n_micro, micro, d]
     outputs produced by the LAST stage, in microbatch order.
+
+    ``param_specs`` (optional pytree of PartitionSpec, same structure as
+    ``params``) lets individual leaves shard over FURTHER mesh axes
+    besides the leading stage dim — e.g. expert weights P(axis, "ep") on
+    a pp×ep mesh, composing pipeline with expert parallelism in one
+    compiled program.  Default: every leaf P(axis).
     """
     n_stages = mesh.shape[axis]
     n_micro = xs.shape[0]
@@ -77,9 +84,11 @@ def pipeline_apply(stage_fn, params, xs, mesh: Mesh, axis: str = "pp"):
         return outs
 
     pspec = P(axis)
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: pspec, params)
     return jax.shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(jax.tree.map(lambda _: pspec, params), P()),
+        in_specs=(param_specs, P()),
         out_specs=P(),
     )(params, xs)
