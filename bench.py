@@ -431,6 +431,11 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
         JAX_COMPILATION_CACHE_DIR=os.environ.get(
             "VTPU_JAX_CACHE_DIR", "/tmp/vtpu-jax-cache"
         ),
+        # fuse k forwards per dispatch (lax.fori_loop) so BOTH arms are
+        # device-bound: a relayed dispatch path caps a process at a few
+        # thousand img/s, and a dispatch-bound ratio measures dispatch
+        # sharing, not chip sharing
+        VTPU_TENANT_SCAN_STEPS=os.environ.get("VTPU_BENCH_SCAN_STEPS", "8"),
     )
     if shim:
         env_base.update(

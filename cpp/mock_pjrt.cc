@@ -189,6 +189,13 @@ PJRT_Error* buffer_destroy(PJRT_Buffer_Destroy_Args* a) {
   return nullptr;
 }
 
+PJRT_Error* buffer_copy_to_device(PJRT_Buffer_CopyToDevice_Args* a) {
+  auto* src = reinterpret_cast<MockBuffer*>(a->buffer);
+  a->dst_buffer = reinterpret_cast<PJRT_Buffer*>(new MockBuffer{
+      src->size, reinterpret_cast<MockDevice*>(a->dst_device), nullptr});
+  return nullptr;
+}
+
 PJRT_Error* client_compile(PJRT_Client_Compile_Args* a) {
   auto* e = new MockExecutable;
   e->code_size = env_int("MOCK_PJRT_CODE_BYTES", 1 << 20);
@@ -296,6 +303,7 @@ extern "C" const PJRT_Api* GetPjrtApi() {
   }
   g_mock_api.PJRT_Buffer_OnDeviceSizeInBytes = buffer_size;
   g_mock_api.PJRT_Buffer_Destroy = buffer_destroy;
+  g_mock_api.PJRT_Buffer_CopyToDevice = buffer_copy_to_device;
   g_mock_api.PJRT_Client_Compile = client_compile;
   g_mock_api.PJRT_LoadedExecutable_GetExecutable = loaded_get_executable;
   g_mock_api.PJRT_Executable_SizeOfGeneratedCodeInBytes = exec_code_size;

@@ -432,6 +432,63 @@ static int run_procs_mode() {
   return 0;
 }
 
+/* copy mode: on-device copies (PJRT_Buffer_CopyToDevice) create buffers
+ * without passing BufferFromHostBuffer — unwrapped they would be a
+ * quota bypass.  Quota 64 MiB: 30 + copy(30) fits, a second copy is
+ * rejected, destroying a copy restores headroom. */
+static int run_copy_mode() {
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == nullptr, "client create (copy)");
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = ca.client;
+  CHECK(api->PJRT_Client_AddressableDevices(&da) == nullptr, "devices (copy)");
+  PJRT_Device* dev0 = da.addressable_devices[0];
+  PJRT_Error* err = nullptr;
+  PJRT_Buffer* src = make_buffer(ca.client, dev0, 30, &err);
+  CHECK(err == nullptr && src != nullptr, "30MiB source admitted");
+
+  PJRT_Buffer_CopyToDevice_Args cd;
+  memset(&cd, 0, sizeof(cd));
+  cd.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+  cd.buffer = src;
+  cd.dst_device = dev0;
+  CHECK(api->PJRT_Buffer_CopyToDevice(&cd) == nullptr,
+        "first copy fits (60/64 MiB)");
+  PJRT_Buffer* copy1 = cd.dst_buffer;
+  CHECK(stats_in_use(dev0) == 60LL * 1024 * 1024, "copy is accounted");
+
+  memset(&cd, 0, sizeof(cd));
+  cd.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+  cd.buffer = src;
+  cd.dst_device = dev0;
+  err = api->PJRT_Buffer_CopyToDevice(&cd);
+  CHECK(err != nullptr, "second copy rejected past quota");
+  PJRT_Error_GetCode_Args gc;
+  memset(&gc, 0, sizeof(gc));
+  gc.struct_size = PJRT_Error_GetCode_Args_STRUCT_SIZE;
+  gc.error = err;
+  api->PJRT_Error_GetCode(&gc);
+  CHECK(gc.code == PJRT_Error_Code_RESOURCE_EXHAUSTED,
+        "copy rejection is RESOURCE_EXHAUSTED");
+  destroy_error(err);
+
+  destroy_buffer(copy1);
+  CHECK(stats_in_use(dev0) == 30LL * 1024 * 1024,
+        "destroying the copy releases its quota");
+  memset(&cd, 0, sizeof(cd));
+  cd.struct_size = PJRT_Buffer_CopyToDevice_Args_STRUCT_SIZE;
+  cd.buffer = src;
+  cd.dst_device = dev0;
+  CHECK(api->PJRT_Buffer_CopyToDevice(&cd) == nullptr,
+        "copy fits again after free");
+  printf("all copy-mode tests passed\n");
+  return 0;
+}
+
 /* noevents mode: the plugin exposes no ReadyEvent/OnReady (the r2
  * advisor's degenerate case) — pacing must still engage via the
  * host-side duration fallback.  Runner sets MOCK_PJRT_NO_EVENTS=1,
@@ -552,6 +609,7 @@ int main(int argc, char** argv) {
   if (argc > 2 && strcmp(argv[2], "threads") == 0) return run_threads_mode();
   if (argc > 2 && strcmp(argv[2], "procs") == 0) return run_procs_mode();
   if (argc > 2 && strcmp(argv[2], "noevents") == 0) return run_noevents_mode();
+  if (argc > 2 && strcmp(argv[2], "copy") == 0) return run_copy_mode();
 
   PJRT_Client_Create_Args ca;
   memset(&ca, 0, sizeof(ca));

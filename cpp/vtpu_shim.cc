@@ -168,6 +168,25 @@ struct Acct {
 std::unordered_map<void*, Acct> g_buffers;
 std::unordered_map<void*, Acct> g_programs;
 std::unordered_map<void*, int> g_device_index; /* PJRT_Device* → local idx */
+/* PJRT_Memory* → owning device + host-tier flag, captured at client
+ * create so CopyToMemory / async-transfer accounting never needs a
+ * device query */
+struct MemInfo {
+  int dev;
+  int is_host;
+};
+std::unordered_map<void*, MemInfo> g_mem_info;
+/* async host→device transfer managers: the reservation is taken at
+ * manager creation (shape specs carry the sizes) and handed to the
+ * concrete buffers as they are retrieved; unclaimed slices are released
+ * when the manager is destroyed */
+struct AsyncMgr {
+  std::vector<uint64_t> sizes;
+  std::vector<uint8_t> claimed;
+  int dev;
+  int kind;
+};
+std::unordered_map<void*, AsyncMgr> g_async_mgrs;
 /* per-device host memory space (pinned_host) for the oversubscribe swap
  * tier; null when the plugin exposes none */
 PJRT_Memory* g_host_mem[VTPU_MAX_DEVICES] = {nullptr};
@@ -450,6 +469,9 @@ PJRT_Error* wrap_Client_Create(PJRT_Client_Create_Args* args) {
           bool is_pinned = kind.find("pinned") != std::string::npos;
           if (is_host && (is_pinned || g_host_mem[i] == nullptr))
             g_host_mem[i] = ma.memories[m];
+          pthread_mutex_lock(&g_mu);
+          g_mem_info[ma.memories[m]] = {(int)i, is_host ? 1 : 0};
+          pthread_mutex_unlock(&g_mu);
         }
       }
     }
@@ -575,6 +597,185 @@ PJRT_Error* wrap_CreateUninitializedBuffer(
     return quota_reject("vtpu: HBM quota exceeded (uninitialized buffer)");
   }
   return nullptr;
+}
+
+/* size of a buffer the shim already accounts (map hit, zero PJRT calls)
+ * with a one-time size query for foreign buffers */
+uint64_t tracked_size(PJRT_Buffer* buf) {
+  pthread_mutex_lock(&g_mu);
+  auto it = g_buffers.find(buf);
+  uint64_t sz = it != g_buffers.end() ? it->second.bytes : 0;
+  pthread_mutex_unlock(&g_mu);
+  if (sz == 0) sz = buffer_size(buf);
+  return sz;
+}
+
+MemInfo mem_info_for(PJRT_Memory* mem, int fallback_dev) {
+  pthread_mutex_lock(&g_mu);
+  auto it = g_mem_info.find(mem);
+  MemInfo mi = it != g_mem_info.end() ? it->second
+                                      : MemInfo{fallback_dev, 0};
+  pthread_mutex_unlock(&g_mu);
+  return mi;
+}
+
+/* on-device copies create buffers WITHOUT passing BufferFromHostBuffer —
+ * unwrapped they would be a quota bypass (copy a buffer N times and use
+ * N× the quota while the region shows 1×) */
+PJRT_Error* wrap_Buffer_CopyToDevice(PJRT_Buffer_CopyToDevice_Args* args) {
+  uint64_t sz = g_region ? tracked_size(args->buffer) : 0;
+  int dev = device_index(args->dst_device);
+  bool accounted = false;
+  if (g_region && sz > 0) {
+    if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0, sz,
+                            g_cfg.oversubscribe) != 0)
+      return quota_reject("vtpu: HBM quota exceeded (CopyToDevice)");
+    accounted = true;
+  }
+  PJRT_Error* err = g_real->PJRT_Buffer_CopyToDevice(args);
+  if (err) {
+    if (accounted)
+      vtpu_region_sub(g_region, (int32_t)getpid(), dev, 0, sz);
+    return err;
+  }
+  if (accounted) {
+    pthread_mutex_lock(&g_mu);
+    g_buffers[args->dst_buffer] = {sz, dev, 0};
+    pthread_mutex_unlock(&g_mu);
+  }
+  return nullptr;
+}
+
+PJRT_Error* wrap_Buffer_CopyToMemory(PJRT_Buffer_CopyToMemory_Args* args) {
+  uint64_t sz = g_region ? tracked_size(args->buffer) : 0;
+  /* source device is the best fallback when the dst memory is unknown */
+  int src_dev = 0;
+  pthread_mutex_lock(&g_mu);
+  auto it = g_buffers.find(args->buffer);
+  if (it != g_buffers.end()) src_dev = it->second.dev;
+  pthread_mutex_unlock(&g_mu);
+  MemInfo mi = mem_info_for(args->dst_memory, src_dev);
+  int kind = mi.is_host ? 2 : 0; /* host-tier copies are swap-accounted */
+  bool accounted = false;
+  if (g_region && sz > 0) {
+    if (vtpu_region_try_add(g_region, (int32_t)getpid(), mi.dev, kind, sz,
+                            g_cfg.oversubscribe) != 0)
+      return quota_reject("vtpu: HBM quota exceeded (CopyToMemory)");
+    accounted = true;
+  }
+  PJRT_Error* err = g_real->PJRT_Buffer_CopyToMemory(args);
+  if (err) {
+    if (accounted)
+      vtpu_region_sub(g_region, (int32_t)getpid(), mi.dev, kind, sz);
+    return err;
+  }
+  if (accounted) {
+    pthread_mutex_lock(&g_mu);
+    g_buffers[args->dst_buffer] = {sz, mi.dev, kind};
+    pthread_mutex_unlock(&g_mu);
+  }
+  return nullptr;
+}
+
+/* async host→device path (newer JAX device_put): shape specs carry the
+ * sizes, so the whole transfer is admitted as ONE reservation at
+ * manager creation and attributed buffer-by-buffer at retrieval */
+PJRT_Error* wrap_CreateBuffersForAsyncHostToDevice(
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args* args) {
+  std::vector<uint64_t> sizes;
+  uint64_t total = 0;
+  for (size_t i = 0; i < args->num_shape_specs; i++) {
+    const PJRT_ShapeSpec& s = args->shape_specs[i];
+    uint64_t w = dtype_width(s.element_type);
+    uint64_t sz = w;
+    for (size_t k = 0; w > 0 && k < s.num_dims; k++)
+      sz *= (uint64_t)s.dims[k];
+    sizes.push_back(w > 0 ? sz : 0);
+    total += w > 0 ? sz : 0;
+  }
+  MemInfo mi = mem_info_for(args->memory, 0);
+  int kind = mi.is_host ? 2 : 0;
+  bool accounted = false;
+  if (g_region && total > 0) {
+    if (vtpu_region_try_add(g_region, (int32_t)getpid(), mi.dev, kind, total,
+                            g_cfg.oversubscribe) != 0)
+      return quota_reject("vtpu: HBM quota exceeded (async h2d)");
+    accounted = true;
+  }
+  PJRT_Error* err = g_real->PJRT_Client_CreateBuffersForAsyncHostToDevice(args);
+  if (err) {
+    if (accounted)
+      vtpu_region_sub(g_region, (int32_t)getpid(), mi.dev, kind, total);
+    return err;
+  }
+  /* track the manager even when no spec was sizable (total==0): the
+   * retrieve path then closes the gap with an on-device size query,
+   * mirroring BufferFromHostBuffer's unsizable-dtype fallback */
+  if (g_region && args->num_shape_specs > 0) {
+    pthread_mutex_lock(&g_mu);
+    g_async_mgrs[args->transfer_manager] = {
+        std::move(sizes), std::vector<uint8_t>(args->num_shape_specs, 0),
+        mi.dev, kind};
+    pthread_mutex_unlock(&g_mu);
+  }
+  return nullptr;
+}
+
+PJRT_Error* wrap_AsyncH2D_RetrieveBuffer(
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args* args) {
+  PJRT_Error* err =
+      g_real->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer(args);
+  if (err) return err;
+  uint64_t sz = 0;
+  int dev = 0, kind = 0;
+  bool claimed_now = false;
+  pthread_mutex_lock(&g_mu);
+  auto it = g_async_mgrs.find(args->transfer_manager);
+  if (it != g_async_mgrs.end() && args->buffer_index >= 0 &&
+      (size_t)args->buffer_index < it->second.sizes.size() &&
+      !it->second.claimed[args->buffer_index] && args->buffer_out) {
+    sz = it->second.sizes[args->buffer_index];
+    dev = it->second.dev;
+    kind = it->second.kind;
+    it->second.claimed[args->buffer_index] = 1;
+    claimed_now = true;
+    if (sz > 0)
+      g_buffers[args->buffer_out] = {sz, dev, kind};
+  }
+  pthread_mutex_unlock(&g_mu);
+  if (claimed_now && sz == 0 && g_region) {
+    /* spec was unsizable (sub-byte/opaque dtype): one on-device size
+     * query, force-admitted (the buffer already exists) so the quota
+     * and monitor stay truthful — the same fallback the h2d path has */
+    uint64_t real_sz = buffer_size(args->buffer_out);
+    if (real_sz > 0) {
+      vtpu_region_try_add(g_region, (int32_t)getpid(), dev, kind, real_sz, 1);
+      pthread_mutex_lock(&g_mu);
+      g_buffers[args->buffer_out] = {real_sz, dev, kind};
+      pthread_mutex_unlock(&g_mu);
+    }
+  }
+  return nullptr;
+}
+
+PJRT_Error* wrap_AsyncH2D_Destroy(
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args* args) {
+  /* release reservation slices never handed to a buffer */
+  uint64_t unclaimed = 0;
+  int dev = 0, kind = 0;
+  pthread_mutex_lock(&g_mu);
+  auto it = g_async_mgrs.find(args->transfer_manager);
+  if (it != g_async_mgrs.end()) {
+    for (size_t i = 0; i < it->second.sizes.size(); i++)
+      if (!it->second.claimed[i]) unclaimed += it->second.sizes[i];
+    dev = it->second.dev;
+    kind = it->second.kind;
+    g_async_mgrs.erase(it);
+  }
+  pthread_mutex_unlock(&g_mu);
+  if (unclaimed > 0 && g_region)
+    vtpu_region_sub(g_region, (int32_t)getpid(), dev, kind, unclaimed);
+  return g_real->PJRT_AsyncHostToDeviceTransferManager_Destroy(args);
 }
 
 PJRT_Error* wrap_Buffer_Destroy(PJRT_Buffer_Destroy_Args* args) {
@@ -1138,6 +1339,20 @@ extern "C" const PJRT_Api* GetPjrtApi() {
     g_api.PJRT_Client_BufferFromHostBuffer = wrap_BufferFromHostBuffer;
     g_api.PJRT_Client_CreateUninitializedBuffer = wrap_CreateUninitializedBuffer;
     g_api.PJRT_Buffer_Destroy = wrap_Buffer_Destroy;
+    if (g_real->PJRT_Buffer_CopyToDevice)
+      g_api.PJRT_Buffer_CopyToDevice = wrap_Buffer_CopyToDevice;
+    if (g_real->PJRT_Buffer_CopyToMemory)
+      g_api.PJRT_Buffer_CopyToMemory = wrap_Buffer_CopyToMemory;
+    if (g_real->PJRT_Client_CreateBuffersForAsyncHostToDevice &&
+        g_real->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer &&
+        g_real->PJRT_AsyncHostToDeviceTransferManager_Destroy) {
+      g_api.PJRT_Client_CreateBuffersForAsyncHostToDevice =
+          wrap_CreateBuffersForAsyncHostToDevice;
+      g_api.PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer =
+          wrap_AsyncH2D_RetrieveBuffer;
+      g_api.PJRT_AsyncHostToDeviceTransferManager_Destroy =
+          wrap_AsyncH2D_Destroy;
+    }
     g_api.PJRT_Client_Compile = wrap_Client_Compile;
     if (g_real->PJRT_Executable_DeserializeAndLoad)
       g_api.PJRT_Executable_DeserializeAndLoad = wrap_DeserializeAndLoad;

@@ -222,10 +222,42 @@ def main() -> None:
         )
         x = x.astype(jnp.bfloat16)
 
-    @jax.jit
-    def forward(images):
-        logits, _ = model.apply(variables, images, mutable=["batch_stats"])
-        return logits
+    # VTPU_TENANT_SCAN_STEPS=k fuses k sequential forward passes into ONE
+    # executable (lax.fori_loop — compiled once, no unroll).  Through a
+    # relayed dispatch path a single process is dispatch-bound at a few
+    # thousand img/s regardless of chip speed; step-fusion moves the
+    # bottleneck back onto the device, so the benchmark's share ratio
+    # measures CHIP sharing, not dispatch sharing.  The loop carry feeds
+    # each iteration (images scaled by a ~0 term) so XLA cannot hoist the
+    # loop-invariant network out of the loop.
+    scan_k = int(os.environ.get("VTPU_TENANT_SCAN_STEPS", "1") or 1)
+    if scan_k > 1:
+
+        @jax.jit
+        def forward(images):
+            def body(_i, acc):
+                # cast the carry-derived scale to the image dtype: a bare
+                # f32 scalar would promote the whole network to f32 and
+                # benchmark the wrong (non-bf16) workload.  The value is
+                # ~1.0 but structurally depends on acc, which is all
+                # hoisting prevention needs.
+                scale = (1 + acc * 1e-9).astype(images.dtype)
+                logits, _ = model.apply(
+                    variables, images * scale, mutable=["batch_stats"]
+                )
+                return logits.astype(jnp.float32).mean()
+
+            return jax.lax.fori_loop(0, scan_k, body, jnp.float32(0))
+
+        imgs_per_step = batch * scan_k
+    else:
+
+        @jax.jit
+        def forward(images):
+            logits, _ = model.apply(variables, images, mutable=["batch_stats"])
+            return logits
+
+        imgs_per_step = batch
 
     jax.block_until_ready(forward(x))  # compile outside the window
 
@@ -257,17 +289,17 @@ def main() -> None:
                     viols[i] += 1
                     if pending:
                         jax.block_until_ready(pending.pop(0))
-                        counts[i] += batch
+                        counts[i] += imgs_per_step
                     else:
                         time.sleep(0.001)
                     continue
                 raise
             if len(pending) >= 2:
                 jax.block_until_ready(pending.pop(0))
-                counts[i] += batch
+                counts[i] += imgs_per_step
         while pending:
             jax.block_until_ready(pending.pop(0))
-            counts[i] += batch
+            counts[i] += imgs_per_step
 
     def guarded(i):
         try:
