@@ -79,7 +79,12 @@ test-native: shim
 	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
 	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/copy.cache \
 	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
-	  ./build/test_shim build/libvtpu_shim.so copy \
+	  ./build/test_shim build/libvtpu_shim.so copy
+	cd cpp && TPU_DEVICE_MEMORY_LIMIT_0=64 \
+	  VTPU_VISIBLE_UUIDS=mock-tpu-0 \
+	  TPU_DEVICE_MEMORY_SHARED_CACHE=/tmp/vtpu-make-test/async.cache \
+	  VTPU_REAL_PJRT_PLUGIN=./build/libmock_pjrt.so \
+	  ./build/test_shim build/libvtpu_shim.so asynch2d \
 	  && rm -rf /tmp/vtpu-make-test
 
 # sanitizer proof for the native shim's concurrency (SURVEY §5 names the

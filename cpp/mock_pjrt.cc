@@ -196,6 +196,55 @@ PJRT_Error* buffer_copy_to_device(PJRT_Buffer_CopyToDevice_Args* a) {
   return nullptr;
 }
 
+/* async host→device transfer manager: buffers sized from shape specs,
+ * handed out at retrieve (caller owns them from then on) */
+struct MockXferMgr {
+  std::vector<MockBuffer*> bufs;
+  std::vector<bool> retrieved;
+};
+
+PJRT_Error* create_async_h2d(
+    PJRT_Client_CreateBuffersForAsyncHostToDevice_Args* a) {
+  auto* m = new MockXferMgr;
+  for (size_t i = 0; i < a->num_shape_specs; i++) {
+    uint64_t n = dtype_bytes(a->shape_specs[i].element_type);
+    for (size_t k = 0; k < a->shape_specs[i].num_dims; k++)
+      n *= (uint64_t)a->shape_specs[i].dims[k];
+    m->bufs.push_back(new MockBuffer{
+        n, nullptr, reinterpret_cast<MockMemory*>(a->memory)});
+    m->retrieved.push_back(false);
+  }
+  a->transfer_manager =
+      reinterpret_cast<PJRT_AsyncHostToDeviceTransferManager*>(m);
+  return nullptr;
+}
+
+PJRT_Error* async_h2d_retrieve(
+    PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args* a) {
+  auto* m = reinterpret_cast<MockXferMgr*>(a->transfer_manager);
+  if (a->buffer_index < 0 || (size_t)a->buffer_index >= m->bufs.size())
+    return reinterpret_cast<PJRT_Error*>(
+        new MockError{"bad index", PJRT_Error_Code_INVALID_ARGUMENT});
+  if (m->retrieved[a->buffer_index]) /* real PJRT refuses re-retrieval —
+                                        double ownership double-frees */
+    return reinterpret_cast<PJRT_Error*>(new MockError{
+        "buffer already retrieved", PJRT_Error_Code_FAILED_PRECONDITION});
+  a->buffer_out = reinterpret_cast<PJRT_Buffer*>(m->bufs[a->buffer_index]);
+  m->retrieved[a->buffer_index] = true;
+  return nullptr;
+}
+
+PJRT_Error* async_h2d_destroy(
+    PJRT_AsyncHostToDeviceTransferManager_Destroy_Args* a) {
+  auto* m = reinterpret_cast<MockXferMgr*>(a->transfer_manager);
+  if (m) {
+    for (size_t i = 0; i < m->bufs.size(); i++)
+      if (!m->retrieved[i]) delete m->bufs[i]; /* caller owns retrieved */
+    delete m;
+  }
+  return nullptr;
+}
+
 PJRT_Error* client_compile(PJRT_Client_Compile_Args* a) {
   auto* e = new MockExecutable;
   e->code_size = env_int("MOCK_PJRT_CODE_BYTES", 1 << 20);
@@ -304,6 +353,10 @@ extern "C" const PJRT_Api* GetPjrtApi() {
   g_mock_api.PJRT_Buffer_OnDeviceSizeInBytes = buffer_size;
   g_mock_api.PJRT_Buffer_Destroy = buffer_destroy;
   g_mock_api.PJRT_Buffer_CopyToDevice = buffer_copy_to_device;
+  g_mock_api.PJRT_Client_CreateBuffersForAsyncHostToDevice = create_async_h2d;
+  g_mock_api.PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer =
+      async_h2d_retrieve;
+  g_mock_api.PJRT_AsyncHostToDeviceTransferManager_Destroy = async_h2d_destroy;
   g_mock_api.PJRT_Client_Compile = client_compile;
   g_mock_api.PJRT_LoadedExecutable_GetExecutable = loaded_get_executable;
   g_mock_api.PJRT_Executable_SizeOfGeneratedCodeInBytes = exec_code_size;

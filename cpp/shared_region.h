@@ -23,7 +23,7 @@ extern "C" {
 #endif
 
 #define VTPU_REGION_MAGIC 0x76545055u /* "vTPU" */
-#define VTPU_REGION_VERSION 2
+#define VTPU_REGION_VERSION 3
 #define VTPU_MAX_DEVICES 16
 #define VTPU_MAX_PROCS 64
 #define VTPU_UUID_LEN 64
@@ -46,6 +46,13 @@ typedef struct vtpu_proc_slot {
   int32_t hostpid; /* host pid (filled by monitor feedback, ref setHostPid) */
   int32_t status;  /* 0 free, 1 live */
   int32_t priority; /* TPU_TASK_PRIORITY of this proc (0 high, 1 low) */
+  /* interposer telemetry published for the monitor (v3): execute count
+   * and wrapper-ADDED nanoseconds (excludes forwarded-call and pacing
+   * time).  Written only by the owning process but by SEVERAL of its
+   * dispatch threads — atomic adds; the monitor reads without the lock
+   * and tolerates cross-field skew. */
+  uint64_t exec_calls;
+  uint64_t exec_shim_ns;
   vtpu_device_usage used[VTPU_MAX_DEVICES];
 } vtpu_proc_slot;
 

@@ -489,6 +489,86 @@ static int run_copy_mode() {
   return 0;
 }
 
+/* asynch2d mode: the async host→device transfer-manager path (newer
+ * device_put) must admit against the quota at manager creation, hand
+ * the reservation to retrieved buffers, reject over-quota managers,
+ * and release unclaimed slices at manager destroy. */
+static int run_asynch2d_mode() {
+  PJRT_Client_Create_Args ca;
+  memset(&ca, 0, sizeof(ca));
+  ca.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+  CHECK(api->PJRT_Client_Create(&ca) == nullptr, "client create (async)");
+  PJRT_Client_AddressableDevices_Args da;
+  memset(&da, 0, sizeof(da));
+  da.struct_size = PJRT_Client_AddressableDevices_Args_STRUCT_SIZE;
+  da.client = ca.client;
+  CHECK(api->PJRT_Client_AddressableDevices(&da) == nullptr, "devices (async)");
+  PJRT_Device* dev0 = da.addressable_devices[0];
+  /* the device memory space (first of the mock's two) */
+  PJRT_Device_AddressableMemories_Args ma;
+  memset(&ma, 0, sizeof(ma));
+  ma.struct_size = PJRT_Device_AddressableMemories_Args_STRUCT_SIZE;
+  ma.device = dev0;
+  CHECK(api->PJRT_Device_AddressableMemories(&ma) == nullptr,
+        "memories (async)");
+  PJRT_Memory* dev_mem = ma.memories[0];
+
+  int64_t dims24[1] = {24LL * 1024 * 1024};
+  PJRT_ShapeSpec specs[2];
+  for (int i = 0; i < 2; i++) {
+    memset(&specs[i], 0, sizeof(specs[i]));
+    specs[i].struct_size = PJRT_ShapeSpec_STRUCT_SIZE;
+    specs[i].dims = dims24;
+    specs[i].num_dims = 1;
+    specs[i].element_type = PJRT_Buffer_Type_U8;
+  }
+  PJRT_Client_CreateBuffersForAsyncHostToDevice_Args aa;
+  memset(&aa, 0, sizeof(aa));
+  aa.struct_size = PJRT_Client_CreateBuffersForAsyncHostToDevice_Args_STRUCT_SIZE;
+  aa.client = ca.client;
+  aa.shape_specs = specs;
+  aa.num_shape_specs = 2;
+  aa.memory = dev_mem;
+  CHECK(api->PJRT_Client_CreateBuffersForAsyncHostToDevice(&aa) == nullptr,
+        "2x24MiB manager admitted under 64MiB quota");
+  CHECK(stats_in_use(dev0) == 48LL * 1024 * 1024,
+        "manager reservation visible");
+
+  /* over-quota manager rejected while the first's reservation holds */
+  PJRT_Client_CreateBuffersForAsyncHostToDevice_Args ab = aa;
+  ab.transfer_manager = nullptr;
+  ab.num_shape_specs = 1;
+  PJRT_Error* err = api->PJRT_Client_CreateBuffersForAsyncHostToDevice(&ab);
+  CHECK(err != nullptr, "24MiB more rejected (48+24 > 64)");
+  destroy_error(err);
+
+  /* retrieve one buffer: reservation transfers, destroy releases it */
+  PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args ra;
+  memset(&ra, 0, sizeof(ra));
+  ra.struct_size =
+      PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer_Args_STRUCT_SIZE;
+  ra.transfer_manager = aa.transfer_manager;
+  ra.buffer_index = 0;
+  CHECK(api->PJRT_AsyncHostToDeviceTransferManager_RetrieveBuffer(&ra) ==
+            nullptr,
+        "retrieve buffer 0");
+  destroy_buffer(ra.buffer_out);
+  CHECK(stats_in_use(dev0) == 24LL * 1024 * 1024,
+        "destroying a retrieved buffer releases its slice");
+
+  /* destroying the manager releases the UNCLAIMED slice (index 1) */
+  PJRT_AsyncHostToDeviceTransferManager_Destroy_Args dd;
+  memset(&dd, 0, sizeof(dd));
+  dd.struct_size =
+      PJRT_AsyncHostToDeviceTransferManager_Destroy_Args_STRUCT_SIZE;
+  dd.transfer_manager = aa.transfer_manager;
+  CHECK(api->PJRT_AsyncHostToDeviceTransferManager_Destroy(&dd) == nullptr,
+        "manager destroy");
+  CHECK(stats_in_use(dev0) == 0, "unclaimed slice released at destroy");
+  printf("all asynch2d-mode tests passed\n");
+  return 0;
+}
+
 /* noevents mode: the plugin exposes no ReadyEvent/OnReady (the r2
  * advisor's degenerate case) — pacing must still engage via the
  * host-side duration fallback.  Runner sets MOCK_PJRT_NO_EVENTS=1,
@@ -610,6 +690,7 @@ int main(int argc, char** argv) {
   if (argc > 2 && strcmp(argv[2], "procs") == 0) return run_procs_mode();
   if (argc > 2 && strcmp(argv[2], "noevents") == 0) return run_noevents_mode();
   if (argc > 2 && strcmp(argv[2], "copy") == 0) return run_copy_mode();
+  if (argc > 2 && strcmp(argv[2], "asynch2d") == 0) return run_asynch2d_mode();
 
   PJRT_Client_Create_Args ca;
   memset(&ca, 0, sizeof(ca));

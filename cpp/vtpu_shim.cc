@@ -77,6 +77,7 @@ struct ShimConfig {
 
 ShimConfig g_cfg;
 vtpu_shared_region* g_region = nullptr;
+int g_slot = -1; /* this process's region slot (register_proc) */
 const PJRT_Api* g_real = nullptr;
 PJRT_Api g_api; /* our copy with wrapped entries */
 pthread_mutex_t g_mu = PTHREAD_MUTEX_INITIALIZER;
@@ -435,7 +436,8 @@ PJRT_Error* wrap_Client_Create(PJRT_Client_Create_Args* args) {
     uint64_t limits[VTPU_MAX_DEVICES];
     for (int i = 0; i < VTPU_MAX_DEVICES; i++) limits[i] = g_cfg.limit_bytes[i];
     vtpu_region_set_devices(g_region, n, uuids, limits, cores);
-    vtpu_region_register_proc(g_region, (int32_t)getpid(), g_cfg.priority);
+    g_slot =
+        vtpu_region_register_proc(g_region, (int32_t)getpid(), g_cfg.priority);
   }
   /* build PJRT_Device* → local index map + discover each device's host
    * memory space (the oversubscribe swap tier target) */
@@ -1262,7 +1264,16 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
      * better than pacing nothing */
     pace_observe(t_submit, t_return);
   }
-  g_stats.exec_shim_ns += (t1 - t0 - paced_ns) + (now_ns() - t2);
+  uint64_t shim_ns = (t1 - t0 - paced_ns) + (now_ns() - t2);
+  g_stats.exec_shim_ns += shim_ns;
+  /* publish per-tenant interposer telemetry into this proc's slot —
+   * atomically: multiple dispatch THREADS of this process race here
+   * (the single-writer story only holds at process granularity) */
+  if (g_region && g_slot >= 0 && g_slot < VTPU_MAX_PROCS &&
+      g_region->procs[g_slot].pid == (int32_t)getpid()) {
+    __sync_fetch_and_add(&g_region->procs[g_slot].exec_calls, 1);
+    __sync_fetch_and_add(&g_region->procs[g_slot].exec_shim_ns, shim_ns);
+  }
   return err;
 }
 

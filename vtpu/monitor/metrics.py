@@ -31,9 +31,10 @@ def render_node_metrics(
 ) -> str:
     lines: List[str] = []
 
-    def gauge(name: str, help_: str, samples: List[Tuple[dict, float]]) -> None:
+    def gauge(name: str, help_: str, samples: List[Tuple[dict, float]],
+              typ: str = "gauge") -> None:
         lines.append(f"# HELP {name} {help_}")
-        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"# TYPE {name} {typ}")
         for labels, value in samples:
             lbl = ",".join(f'{k}="{_esc(str(v))}"' for k, v in labels.items())
             lines.append(f"{name}{{{lbl}}} {value}")
@@ -49,6 +50,7 @@ def render_node_metrics(
     gauge("vtpu_host_device_memory_bytes", "Physical HBM per local chip", host_mem)
 
     usage_s, limit_s, breakdown_s, violation_s = [], [], [], []
+    exec_calls_s, exec_shim_s = [], []
     entries = pathmon.scan(
         set(pods_by_uid) if pods_by_uid is not None else None
     )
@@ -78,6 +80,15 @@ def render_node_metrics(
             violation_s.append(
                 (labels, 1 if limits[i] and usage[i]["total"] > limits[i] else 0)
             )
+        for proc in entry.region.live_procs():
+            plabels = {
+                "ctr": name, "podname": podname, "podnamespace": podns,
+                "pid": proc["pid"],
+            }
+            exec_calls_s.append((plabels, proc.get("exec_calls", 0)))
+            exec_shim_s.append(
+                (plabels, proc.get("exec_shim_ns", 0) / 1e9)
+            )
     gauge(
         "vtpu_container_device_memory_usage_bytes",
         "Real per-container per-vdevice HBM usage (ref vGPU_device_memory_usage_in_bytes)",
@@ -97,6 +108,20 @@ def render_node_metrics(
         "vtpu_container_quota_violation",
         "1 when a container exceeds its HBM quota (BASELINE acceptance metric)",
         violation_s,
+    )
+    # interposer telemetry (beyond the reference): quantifies what the
+    # enforcement layer itself costs each tenant, straight from the shim
+    gauge(
+        "vtpu_proc_executes_total",
+        "Executes dispatched through the shim per tenant process",
+        exec_calls_s,
+        typ="counter",  # _total + monotonic: rate()/increase() need this
+    )
+    gauge(
+        "vtpu_proc_shim_overhead_seconds_total",
+        "Wrapper-added time (excl. pacing) per tenant process",
+        exec_shim_s,
+        typ="counter",
     )
     return "\n".join(lines) + "\n"
 

@@ -45,7 +45,12 @@ def _container_usage(entry) -> pb.ContainerUsage:
     cu.proc_num = len(procs)
     for p in procs:
         cu.procs.append(
-            pb.ProcInfo(pid=p["pid"], hostpid=p.get("hostpid", 0))
+            pb.ProcInfo(
+                pid=p["pid"],
+                hostpid=p.get("hostpid", 0),
+                exec_calls=p.get("exec_calls", 0),
+                exec_shim_ns=p.get("exec_shim_ns", 0),
+            )
         )
     return cu
 

@@ -17,7 +17,7 @@ import os
 from typing import Dict, List, Optional
 
 VTPU_REGION_MAGIC = 0x76545055
-VTPU_REGION_VERSION = 2
+VTPU_REGION_VERSION = 3
 MAX_DEVICES = 16
 MAX_PROCS = 64
 UUID_LEN = 64
@@ -39,6 +39,10 @@ class ProcSlot(ctypes.Structure):
         ("hostpid", ctypes.c_int32),
         ("status", ctypes.c_int32),
         ("priority", ctypes.c_int32),
+        # interposer telemetry (v3): execute count + wrapper-added ns,
+        # written lock-free by the owning tenant process
+        ("exec_calls", ctypes.c_uint64),
+        ("exec_shim_ns", ctypes.c_uint64),
         ("used", DeviceUsage * MAX_DEVICES),
     ]
 
@@ -157,6 +161,8 @@ class RegionFile:
                         "pid": slot.pid,
                         "hostpid": slot.hostpid,
                         "priority": slot.priority,
+                        "exec_calls": slot.exec_calls,
+                        "exec_shim_ns": slot.exec_shim_ns,
                         "total_bytes": sum(
                             slot.used[d].total_bytes for d in range(r.num_devices)
                         ),
