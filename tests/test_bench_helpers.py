@@ -1,0 +1,71 @@
+"""Unit tests for bench.py's orchestration helpers — the logic that must
+hold when the chip transport misbehaves (session exhaustion, partial arm
+failures), exercised without any backend."""
+
+import subprocess
+
+import bench
+
+
+def test_wait_backend_ready_retries_until_init(monkeypatch):
+    """The session-drain gate keeps probing while backend init hangs and
+    passes as soon as a probe child initializes."""
+    calls = []
+
+    class Ok:
+        returncode = 0
+
+    def fake_run(*_a, **_kw):
+        calls.append(1)
+        if len(calls) < 3:
+            raise subprocess.TimeoutExpired(cmd="probe", timeout=60)
+        return Ok()
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda _s: None)
+    assert bench.wait_backend_ready(max_wait_s=10_000)
+    assert len(calls) == 3
+
+
+def test_wait_backend_ready_times_out(monkeypatch):
+    def fake_run(*_a, **_kw):
+        raise subprocess.TimeoutExpired(cmd="probe", timeout=60)
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda _s: None)
+    # monotonic() advances past the deadline after a few probes
+    t = [0.0]
+
+    def fake_monotonic():
+        t[0] += 50.0
+        return t[0]
+
+    monkeypatch.setattr(bench.time, "monotonic", fake_monotonic)
+    assert not bench.wait_backend_ready(max_wait_s=120)
+
+
+def test_oversub_probe_keeps_partial_arms(monkeypatch):
+    """A late arm failure must not discard arms already measured — each
+    costs minutes of real-chip time."""
+
+    def fake_share(quota_mb, window_s, n_tenants=4, shim=True, extra_env=None):
+        if quota_mb == 0:  # the all_device arm flakes
+            return None
+        if (extra_env or {}).get("VTPU_OVERSUBSCRIBE") == "true":
+            return ([{"img_s": 100.0, "params_mb": 512, "swap_bytes": 7}], {})
+        return ([{"hard_reject": True}], {})
+
+    monkeypatch.setattr(bench, "run_native_share", fake_share)
+    out = bench.run_oversubscribe_probe()
+    assert out is not None
+    assert out["arms_ok"] == 2
+    assert out["oversub_img_s"] == 100.0 and out["swap_bytes"] == 7
+    assert out["hard_quota_rejected"] is True
+    assert "all_device_img_s" not in out
+
+
+def test_oversub_probe_none_when_everything_fails(monkeypatch):
+    monkeypatch.setattr(
+        bench, "run_native_share", lambda *a, **k: None
+    )
+    assert bench.run_oversubscribe_probe() is None

@@ -387,6 +387,33 @@ def test_shim_runtime_throttle_paces(tmp_path):
     assert dt >= 0.035
 
 
+def test_dispatch_calibration_backoff_and_reset(tmp_path):
+    """A stable workload stops paying the calibration drain: each
+    calibration within 20% of the last doubles the sync interval (capped);
+    a workload shift resets it to the base cadence."""
+    rt = ShimRuntime(
+        limits_bytes=[], core_limit=50,
+        region_path=str(tmp_path / "cb.cache"), uuids=["tpu-0"],
+    )
+    rt._sync_base = rt._sync_every = 2
+    rt._sync_max = 16
+    seen = set()
+    for _ in range(24):
+        rt.dispatch(lambda: time.sleep(0.01))  # 10ms: jitter ≪ 20% window
+        seen.add(rt._sync_every)
+    assert max(seen) > rt._sync_base  # backed off under a steady load
+    grown = rt._sync_every
+    # workload shifts (5x slower steps): the next calibration resets the
+    # cadence to base — track the minimum so later re-doubling (the slow
+    # workload is itself stable) can't mask the reset
+    post = []
+    for _ in range(grown + 2):
+        rt.dispatch(lambda: time.sleep(0.05))
+        post.append(rt._sync_every)
+    assert min(post) == rt._sync_base
+    rt.close()
+
+
 def test_dispatch_force_policy_ignores_arbiter_suspend(tmp_path, monkeypatch):
     """TPU_CORE_UTILIZATION_POLICY=force keeps throttling even when the
     monitor's arbiter suspends it (utilization_switch=1); default policy

@@ -140,9 +140,22 @@ class ShimRuntime:
         # and time ONE synchronous step — the TRUE device-resident step
         # time (JAX dispatch is async — enqueue latency alone collapses
         # toward 0 and would make core-percentage pacing a no-op)
-        self._sync_every = max(
+        self._sync_base = max(
             1, int(os.environ.get("VTPU_PACE_SYNC_EVERY", "8") or 8)
         )
+        # adaptive interval: a STABLE workload stops paying the drain —
+        # each calibration that lands within 20% of the previous one
+        # doubles the interval (up to VTPU_PACE_SYNC_MAX, default 8×
+        # base); any shift in the measured step time resets it, so phase
+        # changes re-calibrate quickly
+        self._sync_max = max(
+            self._sync_base,
+            int(
+                os.environ.get("VTPU_PACE_SYNC_MAX", str(8 * self._sync_base))
+                or 8 * self._sync_base
+            ),
+        )
+        self._sync_every = self._sync_base
         self._since_sync = 0
         self._pace_state = "warmup"  # warmup → calibrate → run
 
@@ -312,14 +325,21 @@ class ShimRuntime:
 
         The pacing estimate is CLOSED-LOOP: JAX dispatch is asynchronous,
         so enqueue latency says nothing about device time.  While a core
-        limit is active, every ``VTPU_PACE_SYNC_EVERY``-th step drains the
-        pipeline (blocks on its own result), and the step AFTER the drain
-        runs synchronously against an empty queue — its wall time is the
-        true device-resident step time T.  Sleeping T×(100−q)/q between
+        limit is active, the loop periodically drains the pipeline
+        (blocks on its own result), and the step AFTER the drain runs
+        synchronously against an empty queue — its wall time is the true
+        device-resident step time T.  Sleeping T×(100−q)/q between
         subsequent launches then holds the device duty cycle at q%
-        regardless of how deep the caller pipelines.  ``observe_step``
-        remains as an explicit override for callers that measure
-        retirement themselves."""
+        regardless of how deep the caller pipelines.
+
+        The drain cadence is ADAPTIVE: it starts at every
+        ``VTPU_PACE_SYNC_EVERY``-th step (default 8) and doubles after
+        each calibration that lands within 20% of the previous one, up
+        to ``VTPU_PACE_SYNC_MAX`` (default 8× base) — a steady workload
+        stops paying the drain, while any shift in the measured step
+        time resets the cadence to base.  ``observe_step`` remains as an
+        explicit override for callers that measure retirement
+        themselves."""
         if self.region is not None:
             self.region.incr_recent_kernel()
             suspended = (
@@ -344,7 +364,16 @@ class ShimRuntime:
             t0 = time.monotonic()
             out = self._run_fn(fn, args, kwargs)
             self._retire(out)
-            self._last_step_s = time.monotonic() - t0
+            measured = time.monotonic() - t0
+            prev = self._last_step_s
+            self._last_step_s = measured
+            # stable estimate → back off the drain cadence; a shifted
+            # workload (new program, contention change) → re-calibrate
+            # at the base cadence
+            if prev > 0 and abs(measured - prev) <= 0.2 * prev:
+                self._sync_every = min(self._sync_max, self._sync_every * 2)
+            else:
+                self._sync_every = self._sync_base
             self._pace_state = "run"
             self._since_sync = 0
             return out
