@@ -394,6 +394,45 @@ def wait_backend_ready(max_wait_s: float = 300.0) -> bool:
     return False
 
 
+def tenant_env(shim: bool, quota_mb: int, region_path: str | None,
+               window_s: float, extra_env: dict | None = None) -> dict:
+    """The single source of the tenant-process env contract (shim/real
+    plugin selection, relay detection, compile cache, quota trio) — used
+    by the share bench AND the ai-benchmark matrix driver so the two
+    cannot drift apart."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # child registers itself
+    # tenants go through the axon relay only when the real plugin IS the
+    # relay; on a bare TPU host they use PJRT_NAMES_AND_LIBRARY_PATHS
+    env.update(
+        VTPU_TENANT_AXON="1" if "axon" in os.path.basename(REAL_PLUGIN)
+        else "0",
+        VTPU_TENANT_SHIM="1" if shim else "0",
+        VTPU_SHIM_SO=SHIM_SO,
+        VTPU_REAL_PJRT_PLUGIN=REAL_PLUGIN,
+        VTPU_TENANT_SECONDS=str(window_s),
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        # all tenants compile the SAME programs: the persistent cache lets
+        # later tenants deserialize instead of queueing remote compiles
+        JAX_COMPILATION_CACHE_DIR=os.environ.get(
+            "VTPU_JAX_CACHE_DIR", "/tmp/vtpu-jax-cache"
+        ),
+    )
+    if shim and region_path:
+        env.update(
+            TPU_DEVICE_MEMORY_LIMIT_0=str(quota_mb),
+            TPU_DEVICE_MEMORY_SHARED_CACHE=region_path,
+            VTPU_VISIBLE_UUIDS="bench-tpu-0",
+        )
+    else:
+        for k in ("TPU_DEVICE_MEMORY_LIMIT_0", "TPU_DEVICE_MEMORY_SHARED_CACHE",
+                  "VTPU_VISIBLE_UUIDS"):
+            env.pop(k, None)
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
 def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
                      shim: bool = True, extra_env: dict | None = None):
     """Spawn ``n_tenants`` processes, each loading the real PJRT plugin
@@ -409,46 +448,20 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
         return None
     tmp = tempfile.mkdtemp(prefix="vtpu-bench-native-")
     region = os.path.join(tmp, "vtpu.cache")
-    env_base = dict(os.environ)
-    env_base.pop("PALLAS_AXON_POOL_IPS", None)  # child registers itself
-    # tenants go through the axon relay only when the real plugin IS the
-    # relay; on a bare TPU host they use PJRT_NAMES_AND_LIBRARY_PATHS
-    via_axon = "axon" in os.path.basename(REAL_PLUGIN)
-    env_base.update(
-        VTPU_TENANT_AXON="1" if via_axon else "0",
-        VTPU_TENANT_SHIM="1" if shim else "0",
-        VTPU_SHIM_SO=SHIM_SO,
-        VTPU_REAL_PJRT_PLUGIN=REAL_PLUGIN,
-        VTPU_TENANT_SECONDS=str(window_s),
-        VTPU_TENANT_BARRIER=tmp,
-        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
-        # all tenants compile the SAME program: the persistent cache lets
-        # tenant 2..n (and the share arm after the exclusive arm) reuse
-        # tenant 1's compile instead of queueing n remote compiles — the
-        # barrier-timeout failure mode when the transport is contended.
-        # The shim handles cache-deserialized executables (exec_meta_for
-        # fallback learns their output metadata on first execute).
-        JAX_COMPILATION_CACHE_DIR=os.environ.get(
-            "VTPU_JAX_CACHE_DIR", "/tmp/vtpu-jax-cache"
-        ),
-        # fuse k forwards per dispatch (lax.fori_loop) so BOTH arms are
-        # device-bound: a relayed dispatch path caps a process at a few
-        # thousand img/s, and a dispatch-bound ratio measures dispatch
-        # sharing, not chip sharing
-        VTPU_TENANT_SCAN_STEPS=os.environ.get("VTPU_BENCH_SCAN_STEPS", "8"),
+    env_base = tenant_env(
+        shim, quota_mb, region, window_s,
+        {
+            "VTPU_TENANT_BARRIER": tmp,
+            # fuse k forwards per dispatch (lax.fori_loop) so BOTH arms
+            # are device-bound: a relayed dispatch path caps a process at
+            # a few thousand img/s, and a dispatch-bound ratio measures
+            # dispatch sharing, not chip sharing
+            "VTPU_TENANT_SCAN_STEPS": os.environ.get(
+                "VTPU_BENCH_SCAN_STEPS", "8"
+            ),
+            **(extra_env or {}),
+        },
     )
-    if shim:
-        env_base.update(
-            TPU_DEVICE_MEMORY_LIMIT_0=str(quota_mb),
-            TPU_DEVICE_MEMORY_SHARED_CACHE=region,
-            VTPU_VISIBLE_UUIDS="bench-tpu-0",
-        )
-    else:
-        for k in ("TPU_DEVICE_MEMORY_LIMIT_0", "TPU_DEVICE_MEMORY_SHARED_CACHE",
-                  "VTPU_VISIBLE_UUIDS"):
-            env_base.pop(k, None)
-    if extra_env:
-        env_base.update(extra_env)
     def spawn():
         return subprocess.Popen(
             [sys.executable, "-m", "vtpu.shim.native_tenant"],

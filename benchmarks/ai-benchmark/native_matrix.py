@@ -1,0 +1,145 @@
+#!/usr/bin/env python3
+"""The reference's full benchmark table, reproduced on the real chip
+THROUGH the native interposer (ref README.md:176-225: stock column vs
+vGPU column, ai-benchmark matrix).
+
+For every row of the matrix (model:batch:mode — the same rows
+run_benchmark.py runs cooperatively) this driver measures two arms with
+identical process shape:
+
+  stock  the tenant loads the REAL PJRT plugin directly, no quotas
+  vtpu   the tenant loads libvtpu_shim.so with a hard HBM quota and a
+         shared region (the measured enforcement path)
+
+and emits JSONL rows plus a markdown table mirroring the reference's —
+the per-instance stock-vs-shared comparison its README publishes.
+
+Usage (on a TPU host / via the relay):
+  python benchmarks/ai-benchmark/native_matrix.py \
+      --rows resnet50:50:inference,vgg16:20:inference \
+      --seconds 8 --quota-mb 4096 --out matrix.jsonl
+
+Rows default to the reference's published matrix.  Runs are resumable:
+rows already present in --out are skipped.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402 — session gate + plugin paths
+
+DEFAULT_ROWS = [
+    "resnet50:50:inference", "resnet152:10:inference", "vgg16:20:inference",
+    "deeplab:2:inference", "lstm:100:inference",
+    "resnet50:20:training", "resnet152:10:training", "vgg16:2:training",
+    "deeplab:1:training", "lstm:10:training",
+    "transformer:8:inference", "transformer:4:training",
+]
+
+
+def run_arm(spec: str, shim: bool, seconds: float, quota_mb: int,
+            timeout_s: float) -> dict | None:
+    if not bench.wait_backend_ready():
+        return None
+    tmp = tempfile.mkdtemp(prefix="vtpu-matrix-") if shim else None
+    env = bench.tenant_env(
+        shim, quota_mb,
+        os.path.join(tmp, "vtpu.cache") if tmp else None,
+        seconds, {"VTPU_TENANT_MATRIX_SPEC": spec},
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "vtpu.shim.native_tenant"],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"  arm timed out ({spec}, shim={shim})", file=sys.stderr)
+        return None
+    finally:
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr[-1500:])
+        return None
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rows", default=",".join(DEFAULT_ROWS))
+    p.add_argument("--seconds", type=float, default=8.0)
+    p.add_argument("--quota-mb", type=int, default=4096)
+    p.add_argument("--arm-timeout", type=float, default=600.0)
+    p.add_argument("--out", default="native_matrix.jsonl")
+    args = p.parse_args(argv)
+
+    done = set()
+    if os.path.exists(args.out):
+        with open(args.out) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                    if r.get("img_s") is not None:  # failed arms RE-run
+                        done.add((r["spec"], r["arm"]))
+                except (json.JSONDecodeError, KeyError):
+                    continue
+
+    results: dict = {}
+    for spec in [r for r in args.rows.split(",") if r]:
+        for arm, shim in (("stock", False), ("vtpu", True)):
+            if (spec, arm) in done:
+                print(f"skip {spec} {arm} (already in {args.out})")
+                continue
+            t0 = time.monotonic()
+            out = run_arm(spec, shim, args.seconds, args.quota_mb,
+                          args.arm_timeout)
+            dt = time.monotonic() - t0
+            row = {
+                "spec": spec, "arm": arm,
+                "img_s": round(out["img_s"], 2) if out else None,
+                "platform": (out or {}).get("platform"),
+                "wall_s": round(dt, 1),
+            }
+            with open(args.out, "a") as f:
+                f.write(json.dumps(row) + "\n")
+            print(f"{spec:26s} {arm:5s} "
+                  f"{row['img_s'] if row['img_s'] is not None else 'FAIL'}")
+
+    # markdown summary (include rows loaded from a previous run)
+    with open(args.out) as f:
+        for line in f:
+            try:
+                r = json.loads(line)
+                if r.get("img_s") is not None:
+                    results.setdefault(r["spec"], {})[r["arm"]] = r["img_s"]
+            except json.JSONDecodeError:
+                continue
+    print("\n| test | stock img/s | vtpu img/s | ratio |")
+    print("|---|---|---|---|")
+    for spec in [r for r in args.rows.split(",") if r]:
+        row = results.get(spec, {})
+        s, v = row.get("stock"), row.get("vtpu")
+        ratio = f"{v / s:.3f}" if s and v else "—"
+        print(f"| {spec} | {s or '—'} | {v or '—'} | {ratio} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -64,7 +64,9 @@ def build_step(name: str, batch: int, mode: str):
         if in_dtype != jnp.int32
         else jnp.zeros(shape, in_dtype)
     )
-    variables = model.init(rng, x)
+    # jit the init: one compiled program instead of hundreds of eager
+    # dispatches (which crawl when the chip sits behind a relay)
+    variables = jax.jit(model.init)(rng, x)
 
     if mode == "inference":
 

@@ -176,6 +176,62 @@ def _oversub_main(dev, platform: str) -> None:
     }), flush=True)
 
 
+def _matrix_main(dev, platform: str) -> None:
+    """One row of the reference's benchmark table (README.md:193-206) on
+    the real chip: VTPU_TENANT_MATRIX_SPEC="<model>:<batch>:<mode>"
+    builds the exact ai-benchmark step (benchmarks/ai-benchmark/
+    run_benchmark.py build_step — same models, shapes, and training
+    losses as the cooperative matrix) and measures img/s through
+    whatever plugin this process registered (shim or real).  Emits one
+    JSON line."""
+    import importlib.util
+
+    import vtpu
+
+    name, batch_s, mode = os.environ["VTPU_TENANT_MATRIX_SPEC"].split(":")
+    batch = int(batch_s)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(vtpu.__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "aibench", os.path.join(repo, "benchmarks", "ai-benchmark",
+                                "run_benchmark.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    seconds = float(os.environ.get("VTPU_TENANT_SECONDS", "10") or 10)
+    violations = 0
+    rate = 0.0
+    try:
+        step, state, x = mod.build_step(name, batch, mode)
+        # compile OUTSIDE the window and before the barrier (like the
+        # serve path): concurrent tenants must not measure each other's
+        # remote compiles
+        out = step(state, x)
+        mod.hard_sync(out)
+        if mode == "training":
+            state = out[0]
+        _barrier()
+        rate = mod.timed_imgs_per_s(step, state, x, batch, mode, seconds)
+    except Exception as e:  # noqa: BLE001 — quota rejects degrade, not die
+        if "RESOURCE_EXHAUSTED" in str(e) or "quota" in str(e):
+            # the row does not fit its quota: report the violation the
+            # way the streams path does instead of failing the arm
+            violations = 1
+        else:
+            raise
+    stats = {}
+    try:
+        stats = dev.memory_stats() or {}
+    except Exception:  # noqa: BLE001
+        pass
+    print(json.dumps({
+        "model": name, "batch": batch, "mode": mode,
+        "img_s": rate, "violations": violations,
+        "bytes_limit": int(stats.get("bytes_limit", 0)),
+        "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+        "platform": platform,
+    }), flush=True)
+
+
 def main() -> None:
     # backend init can hang forever when the chip's sessions are
     # saturated; die loudly instead so the orchestrator can retry
@@ -203,6 +259,9 @@ def main() -> None:
     if os.environ.get("VTPU_TENANT_MODE") == "oversub":
         _barrier()
         _oversub_main(dev, platform)
+        return
+    if os.environ.get("VTPU_TENANT_MATRIX_SPEC"):
+        _matrix_main(dev, platform)
         return
     if platform == "cpu":
         model = ResNetV2(stage_sizes=(1, 1, 1, 1), num_classes=100)
