@@ -70,6 +70,19 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def last_json_line(text: str):
+    """Last parseable JSON object in a child's stdout (workers print
+    diagnostics before their one result line)."""
+    for line in reversed(text.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    return None
+
+
 def hard_sync(out):
     from vtpu.utils.sync import hard_sync as _hs
 
@@ -269,12 +282,7 @@ def run_share_child(window: float, quota: int, cpu: bool) -> dict | None:
     if proc.returncode != 0:
         log(f"share child rc={proc.returncode}")
         return None
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            return json.loads(line)
-        except json.JSONDecodeError:
-            continue
-    return None
+    return last_json_line(proc.stdout)
 
 
 def worker_exclusive() -> None:
@@ -306,12 +314,14 @@ def worker_exclusive() -> None:
     )
 
 
-def run_exclusive_child() -> dict | None:
+def run_exclusive_child(tpu_ok: bool = True) -> dict | None:
     """Measure the exclusive baseline in a child so the orchestrator never
     initializes the TPU backend (each tenant process needs its own
     session).  Falls back to a CPU-pinned child when the chip backend is
-    unavailable."""
-    for attempt, env_tweak in enumerate((None, None, "cpu")):
+    unavailable; ``tpu_ok=False`` (the session gate already timed out)
+    skips straight to CPU instead of burning two more watchdog windows."""
+    attempts = (None, None, "cpu") if tpu_ok else ("cpu",)
+    for attempt, env_tweak in enumerate(attempts):
         env = dict(os.environ)
         if env_tweak == "cpu":
             env["JAX_PLATFORMS"] = "cpu"
@@ -328,14 +338,11 @@ def run_exclusive_child() -> dict | None:
             continue
         sys.stderr.write(proc.stderr[-2000:])
         if proc.returncode == 0:
-            for line in reversed(proc.stdout.strip().splitlines()):
-                try:
-                    out = json.loads(line)
-                    phase_note("exclusive", attempt=attempt, rc=0,
-                               platform=out.get("platform"))
-                    return out
-                except json.JSONDecodeError:
-                    continue
+            out = last_json_line(proc.stdout)
+            if out is not None:
+                phase_note("exclusive", attempt=attempt, rc=0,
+                           platform=out.get("platform"))
+                return out
         phase_note("exclusive", attempt=attempt, rc=proc.returncode,
                    platform=env_tweak or "tpu",
                    stderr_tail=proc.stderr.strip().splitlines()[-1:]
@@ -353,13 +360,15 @@ def native_available() -> bool:
     return os.path.exists(SHIM_SO) and os.path.exists(REAL_PLUGIN)
 
 
-def wait_backend_ready(max_wait_s: float = 300.0) -> bool:
+def wait_backend_ready(max_wait_s: float | None = None) -> bool:
     """Session-drain gate: backend slots behind a relayed transport are a
     finite pool that killed/finished tenants release asynchronously —
     launching the next phase while the pool is exhausted hangs every
     tenant at init and burns a whole barrier window (the r3 failure
     mode).  Probe with a tiny child (jax.devices() only) and wait until
     one initializes promptly."""
+    if max_wait_s is None:
+        max_wait_s = float(os.environ.get("VTPU_BENCH_GATE_S", "300") or 300)
     deadline = time.monotonic() + max_wait_s
     probe_env = dict(os.environ)
     probe_env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -434,7 +443,8 @@ def tenant_env(shim: bool, quota_mb: int, region_path: str | None,
 
 
 def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
-                     shim: bool = True, extra_env: dict | None = None):
+                     shim: bool = True, extra_env: dict | None = None,
+                     pre_gated: bool = False):
     """Spawn ``n_tenants`` processes, each loading the real PJRT plugin
     THROUGH the interposer with a 1/n HBM quota, sharing one region; a
     file barrier aligns their measurement windows.  ``shim=False`` is
@@ -444,7 +454,7 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
     dispatch path, so a 1-process baseline would understate "exclusive"
     and flatter the share ratio).  Returns (tenant_dicts, region_info)
     or None on any failure."""
-    if not wait_backend_ready():
+    if not pre_gated and not wait_backend_ready():
         return None
     tmp = tempfile.mkdtemp(prefix="vtpu-bench-native-")
     region = os.path.join(tmp, "vtpu.cache")
@@ -658,8 +668,15 @@ def main() -> None:
     exclusive, platform, excl_mode = None, None, None
     excl_per_proc: list = []
     hbm = 16 * 1024**3
+    backend_up = False
     if native_available():
-        res = run_native_share(quota_mb=0, window_s=window, shim=False)
+        backend_up = wait_backend_ready()
+        res = (
+            run_native_share(quota_mb=0, window_s=window, shim=False,
+                             pre_gated=True)
+            if backend_up
+            else None
+        )
         if res is not None:
             outs, _ = res
             excl_per_proc = [o["img_s"] for o in outs]
@@ -669,9 +686,15 @@ def main() -> None:
             excl_mode = "4proc_noshim"
             phase_note("exclusive", rc=0, mode=excl_mode, platform=platform)
         else:
-            phase_note("exclusive", rc="error", mode="4proc_noshim")
+            phase_note("exclusive", rc="error", mode="4proc_noshim",
+                       backend_up=backend_up)
     if exclusive is None:
-        excl = run_exclusive_child()
+        # without shim artifacts the gate never probed — the child must
+        # still try TPU itself (the pre-r3 behavior); only a gate that
+        # actually timed out skips the doomed attempts
+        excl = run_exclusive_child(
+            tpu_ok=backend_up or not native_available()
+        )
         if excl is None:
             emit(0.0, {"error": "exclusive baseline failed on tpu and cpu",
                        "phase_log": PHASE_LOG})

@@ -73,12 +73,7 @@ def run_arm(spec: str, shim: bool, seconds: float, quota_mb: int,
     if proc.returncode != 0:
         sys.stderr.write(proc.stderr[-1500:])
         return None
-    for line in reversed(proc.stdout.strip().splitlines()):
-        try:
-            return json.loads(line)
-        except json.JSONDecodeError:
-            continue
-    return None
+    return bench.last_json_line(proc.stdout)
 
 
 def main(argv=None) -> int:
@@ -114,6 +109,9 @@ def main(argv=None) -> int:
             row = {
                 "spec": spec, "arm": arm,
                 "img_s": round(out["img_s"], 2) if out else None,
+                # img_s 0 + violations ≥1 = "does not fit the quota" — a
+                # real result, distinct from an arm that failed to run
+                "violations": (out or {}).get("violations", 0),
                 "platform": (out or {}).get("platform"),
                 "wall_s": round(dt, 1),
             }
@@ -128,16 +126,26 @@ def main(argv=None) -> int:
             try:
                 r = json.loads(line)
                 if r.get("img_s") is not None:
-                    results.setdefault(r["spec"], {})[r["arm"]] = r["img_s"]
+                    results.setdefault(r["spec"], {})[r["arm"]] = r
             except json.JSONDecodeError:
                 continue
+
+    def cell(row):
+        if row is None or row.get("img_s") is None:
+            return "—"
+        if row.get("violations") and not row["img_s"]:
+            return "OOM(quota)"  # measured outcome, not a failed arm
+        return str(row["img_s"])
+
     print("\n| test | stock img/s | vtpu img/s | ratio |")
     print("|---|---|---|---|")
     for spec in [r for r in args.rows.split(",") if r]:
         row = results.get(spec, {})
-        s, v = row.get("stock"), row.get("vtpu")
+        s = (row.get("stock") or {}).get("img_s")
+        v = (row.get("vtpu") or {}).get("img_s")
         ratio = f"{v / s:.3f}" if s and v else "—"
-        print(f"| {spec} | {s or '—'} | {v or '—'} | {ratio} |")
+        print(f"| {spec} | {cell(row.get('stock'))} | "
+              f"{cell(row.get('vtpu'))} | {ratio} |")
     return 0
 
 

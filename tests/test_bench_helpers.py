@@ -69,3 +69,45 @@ def test_oversub_probe_none_when_everything_fails(monkeypatch):
         bench, "run_native_share", lambda *a, **k: None
     )
     assert bench.run_oversubscribe_probe() is None
+
+
+def test_native_matrix_driver_resume_and_table(monkeypatch, tmp_path, capsys):
+    """The matrix driver measures both arms per row, resumes past
+    completed arms, retries failed ones, and renders the reference-style
+    table."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "native_matrix",
+        os.path.join(os.path.dirname(bench.__file__), "benchmarks",
+                     "ai-benchmark", "native_matrix.py"),
+    )
+    nm = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(nm)
+
+    out = tmp_path / "m.jsonl"
+    # pre-seed: one finished arm (skipped) and one FAILED arm (retried)
+    out.write_text(
+        '{"spec": "lstm:8:inference", "arm": "stock", "img_s": 50.0}\n'
+        '{"spec": "lstm:8:inference", "arm": "vtpu", "img_s": null}\n'
+    )
+    ran = []
+
+    def fake_run_arm(spec_s, shim, seconds, quota_mb, timeout_s):
+        ran.append((spec_s, shim))
+        return {"img_s": 42.0, "platform": "cpu"}
+
+    monkeypatch.setattr(nm, "run_arm", fake_run_arm)
+    rc = nm.main([
+        "--rows", "lstm:8:inference,vgg16:2:inference",
+        "--out", str(out),
+    ])
+    assert rc == 0
+    # stock lstm was done → skipped; failed vtpu lstm re-ran; both vgg arms ran
+    assert ("lstm:8:inference", False) not in ran
+    assert ("lstm:8:inference", True) in ran
+    assert ("vgg16:2:inference", False) in ran and (
+        "vgg16:2:inference", True) in ran
+    text = capsys.readouterr().out
+    assert "| lstm:8:inference | 50.0 | 42.0 | 0.840 |" in text
