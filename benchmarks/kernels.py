@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Workload-layer kernel microbenchmark: the Pallas flash-attention
+path vs plain-XLA reference attention, forward and training
+(value_and_grad), on serving/training shapes.
+
+The reference framework has no kernel layer (SURVEY.md §2.9) — this
+measures where vtpu goes beyond it: the fused attention never
+materializes the [S,S] score matrix, so long-context shapes keep HBM
+flat and the MXU busy.
+
+Usage (real chip; CPU falls back to interpret mode and only checks
+numerics):
+  python benchmarks/kernels.py --seconds 5 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SHAPES = [
+    # (batch, heads, seq, head_dim)
+    (4, 8, 1024, 64),
+    (2, 8, 2048, 64),
+    (1, 8, 4096, 128),
+]
+
+
+def timed(fn, *args, seconds: float) -> float:
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile
+    n = 0
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < seconds:
+        out = fn(*args)
+        jax.block_until_ready(out)
+        n += 1
+    return n / (time.monotonic() - t0)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seconds", type=float, default=5.0)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--causal", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from vtpu.ops.attention import flash_attention, reference_attention
+
+    platform = jax.devices()[0].platform
+    rows = []
+    for b, h, s, d in SHAPES:
+        q = jax.random.normal(
+            jax.random.PRNGKey(0), (b, h, s, d), jnp.bfloat16
+        )
+
+        @jax.jit
+        def fwd_flash(q):
+            return flash_attention(q, q, q, causal=args.causal)
+
+        @jax.jit
+        def fwd_ref(q):
+            return reference_attention(q, q, q, causal=args.causal)
+
+        @jax.jit
+        def train_flash(q):
+            return jax.grad(
+                lambda t: flash_attention(t, t, t, causal=args.causal)
+                .astype(jnp.float32).mean()
+            )(q)
+
+        @jax.jit
+        def train_ref(q):
+            return jax.grad(
+                lambda t: reference_attention(t, t, t, causal=args.causal)
+                .astype(jnp.float32).mean()
+            )(q)
+
+        row = {"shape": f"{b}x{h}x{s}x{d}", "platform": platform}
+        # numerics first — a fast wrong kernel is worthless
+        import numpy as np
+
+        o_f = np.asarray(fwd_flash(q), np.float32)
+        o_r = np.asarray(fwd_ref(q), np.float32)
+        row["max_abs_err"] = float(np.abs(o_f - o_r).max())
+        assert row["max_abs_err"] < 0.05, row
+        if platform != "cpu":
+            row["fwd_flash_it_s"] = round(
+                timed(fwd_flash, q, seconds=args.seconds), 2
+            )
+            row["fwd_ref_it_s"] = round(
+                timed(fwd_ref, q, seconds=args.seconds), 2
+            )
+            row["train_flash_it_s"] = round(
+                timed(train_flash, q, seconds=args.seconds), 2
+            )
+            row["train_ref_it_s"] = round(
+                timed(train_ref, q, seconds=args.seconds), 2
+            )
+            row["fwd_speedup"] = round(
+                row["fwd_flash_it_s"] / max(row["fwd_ref_it_s"], 1e-9), 3
+            )
+            row["train_speedup"] = round(
+                row["train_flash_it_s"] / max(row["train_ref_it_s"], 1e-9), 3
+            )
+        rows.append(row)
+        if not args.json:
+            print(row)
+    if args.json:
+        print(json.dumps({"kernel_bench": rows}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

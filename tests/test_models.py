@@ -129,6 +129,26 @@ def test_ring_attention_matches_full():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3)
 
 
+def test_ring_attention_composed_with_tp():
+    """SP×TP composition on a 2-D mesh: heads sharded over tp, sequence
+    ringing over sp — numerics must match unsharded attention (heads are
+    independent, so tp needs no collectives)."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()).reshape(4, 2)
+    mesh = Mesh(devs, ("sp", "tp"))
+    rng = jax.random.PRNGKey(5)
+    q, k, v = (
+        jax.random.normal(r, (2, 2, 16 * 4, 32), jnp.float32)
+        for r in jax.random.split(rng, 3)
+    )
+    got = ring_attention(q, k, v, mesh, axis="sp", head_axis="tp")
+    want = reference_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3
+    )
+
+
 def test_hybrid_mesh_axes_and_psum():
     """dcn-outer hybrid mesh: 2 slices × 4-chip ICI; psum over both tiers
     sums all shards."""

@@ -60,11 +60,20 @@ def _merge(acc1, m1, l1, acc2, m2, l2):
     return acc1 * a1 + acc2 * a2, m, l1 * a1 + l2 * a2
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", *,
+                   head_axis: Optional[str] = None,
                    use_kernel: Optional[bool] = None):
     """q,k,v: [batch, heads, seq, d] with seq sharded over mesh axis
     ``axis``.  Returns attention output with the same sharding.
-    ``use_kernel`` forces the Pallas inner op on/off (default: on TPU)."""
+    ``use_kernel`` forces the Pallas inner op on/off (default: on TPU).
+
+    ``head_axis`` composes sequence parallelism with TENSOR parallelism
+    on a 2-D mesh (e.g. sp×tp): heads shard over ``head_axis`` while the
+    sequence rings over ``axis``.  Heads are independent in attention,
+    so the tp dimension needs no collectives — each (sp, tp) shard runs
+    the same ring schedule on its local heads, KV hops stay
+    neighbor-to-neighbor on the sp ring, and the surrounding
+    Megatron-style projections keep their usual tp layout."""
     n_shards = mesh.shape[axis]
     sm_scale = q.shape[-1] ** -0.5
 
@@ -92,7 +101,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         return (acc / jnp.maximum(l, 1e-30)).astype(q_s.dtype)
 
     kernel_on = use_kernel if use_kernel is not None else _on_tpu()
-    spec = P(None, None, axis, None)
+    spec = P(None, head_axis, axis, None)
     # check_vma stays ON for the pure-XLA path; only the kernel path must
     # disable it (pallas_call out_shapes carry no vma annotation) — the
     # explicit in/out specs still pin the sharding there
