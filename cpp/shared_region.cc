@@ -156,12 +156,21 @@ void vtpu_region_unlock(vtpu_shared_region* r) {
   pthread_mutex_unlock(&g_local_mu);
 }
 
-int vtpu_region_register_proc(vtpu_shared_region* r, int32_t pid,
-                              int32_t priority) {
+static int register_proc_impl(vtpu_shared_region* r, int32_t pid,
+                              int32_t priority, int fresh) {
   vtpu_region_lock(r);
   int free_slot = -1;
   for (int i = 0; i < VTPU_MAX_PROCS; i++) {
     if (r->procs[i].status == 1 && r->procs[i].pid == pid) {
+      if (fresh) {
+        /* pid recycled from a dead predecessor (fresh caller cannot
+         * have accounted anything yet): drop its phantom usage */
+        memset(r->procs[i].used, 0, sizeof(r->procs[i].used));
+        r->procs[i].exec_calls = 0;
+        r->procs[i].exec_shim_ns = 0;
+        r->procs[i].hostpid = 0;
+        r->procs[i].priority = priority;
+      }
       vtpu_region_unlock(r);
       return i;
     }
@@ -185,6 +194,16 @@ int vtpu_region_register_proc(vtpu_shared_region* r, int32_t pid,
   }
   vtpu_region_unlock(r);
   return free_slot;
+}
+
+int vtpu_region_register_proc(vtpu_shared_region* r, int32_t pid,
+                              int32_t priority) {
+  return register_proc_impl(r, pid, priority, 0);
+}
+
+int vtpu_region_register_proc_fresh(vtpu_shared_region* r, int32_t pid,
+                                    int32_t priority) {
+  return register_proc_impl(r, pid, priority, 1);
 }
 
 void vtpu_region_unregister_proc(vtpu_shared_region* r, int32_t pid) {

@@ -108,6 +108,13 @@ void vtpu_region_unlock(vtpu_shared_region* r);
 /* find-or-create the slot for `pid`; returns slot index or -1. */
 int vtpu_region_register_proc(vtpu_shared_region* r, int32_t pid,
                               int32_t priority);
+/* like register_proc, but for a process KNOWN to be newly started (first
+ * client create): a pid-matching slot left by a dead predecessor whose
+ * container pid was recycled to us gets its usage/telemetry cleared
+ * instead of inherited (phantom quota).  Ordinary register_proc keeps
+ * the accounting (the caller may be a later call of the same process). */
+int vtpu_region_register_proc_fresh(vtpu_shared_region* r, int32_t pid,
+                                    int32_t priority);
 void vtpu_region_unregister_proc(vtpu_shared_region* r, int32_t pid);
 /* reap slots whose pid is gone (ref clear_proc_slot_nolock). */
 void vtpu_region_reap_dead(vtpu_shared_region* r);

@@ -436,8 +436,20 @@ PJRT_Error* wrap_Client_Create(PJRT_Client_Create_Args* args) {
     uint64_t limits[VTPU_MAX_DEVICES];
     for (int i = 0; i < VTPU_MAX_DEVICES; i++) limits[i] = g_cfg.limit_bytes[i];
     vtpu_region_set_devices(g_region, n, uuids, limits, cores);
-    g_slot =
-        vtpu_region_register_proc(g_region, (int32_t)getpid(), g_cfg.priority);
+    /* FIRST registration of this process is "fresh": a dead predecessor
+     * whose container pid was recycled to us must not hand us its
+     * phantom usage.  Later client creates in the same process register
+     * normally (their accounting is real). */
+    g_slot = (g_slot < 0)
+                 ? vtpu_region_register_proc_fresh(g_region, (int32_t)getpid(),
+                                                   g_cfg.priority)
+                 : vtpu_region_register_proc(g_region, (int32_t)getpid(),
+                                             g_cfg.priority);
+    /* free slots of dead predecessors (same pid namespace, so kill(0)
+     * is authoritative here) — a crashed tenant's quota bytes must not
+     * outlive it (ref clear_proc_slot_nolock).  The monitor reaps
+     * hostpid-resolved slots from the host side too. */
+    vtpu_region_reap_dead(g_region);
   }
   /* build PJRT_Device* → local index map + discover each device's host
    * memory space (the oversubscribe swap tier target) */

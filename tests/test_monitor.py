@@ -190,6 +190,76 @@ def test_hostpid_ambiguous_left_unresolved(tmp_path):
     pm.close()
 
 
+def test_reap_dead_by_hostpid(tmp_path):
+    """A tenant whose HOST process died gets its slot (and quota bytes)
+    freed on the monitor tick; slots with no hostpid resolution are kept
+    (the in-container shim reaps those instead)."""
+    from vtpu.monitor.hostpid import reap_dead_by_hostpid
+
+    uid = "facefeed-1111-2222-3333-444455556666"
+    root = str(tmp_path / "containers")
+    d = make_container_region(root, uid, pid=41, used_mb=30)
+    r = RegionFile(os.path.join(d, REGION_FILENAME))
+    r.register_proc(42)           # second proc, unresolved hostpid
+    r.add_usage(42, 0, 20 << 20)
+    r.set_hostpid(41, 90001)      # resolved → dead (no /proc entry)
+    r.close()
+    proc_root = str(tmp_path / "proc")
+    os.makedirs(proc_root, exist_ok=True)  # empty: hostpid 90001 is gone
+
+    pm = PathMonitor(root)
+    pm.scan()
+    assert reap_dead_by_hostpid(pm, proc_root=proc_root) == 1
+    region = next(iter(pm.entries.values())).region
+    procs = region.live_procs()
+    assert [p["pid"] for p in procs] == [42]  # unresolved slot kept
+    assert region.usage()[0]["total"] == 20 << 20  # dead proc's 30MB freed
+    # a LIVE resolved proc (hostpid still mapping to the container pid)
+    # is kept
+    _fake_host_proc(proc_root, 90002, [90002, 42], "0::/kubepods/x")
+    region.set_hostpid(42, 90002)
+    assert reap_dead_by_hostpid(pm, proc_root=proc_root) == 0
+    pm.close()
+
+
+def test_reap_dead_hostpid_recycled(tmp_path):
+    """/proc/<hostpid> existing is NOT liveness: a recycled host pid
+    (NSpid no longer mapping to the slot's container pid) must still
+    reap — otherwise a crashed tenant pins quota forever."""
+    from vtpu.monitor.hostpid import reap_dead_by_hostpid
+
+    uid = "0badc0de-aaaa-bbbb-cccc-ddddeeeeffff"
+    root = str(tmp_path / "containers")
+    d = make_container_region(root, uid, pid=55, used_mb=25)
+    r = RegionFile(os.path.join(d, REGION_FILENAME))
+    r.set_hostpid(55, 90003)
+    r.close()
+    proc_root = str(tmp_path / "proc")
+    # hostpid 90003 now belongs to an unrelated host-native process
+    _fake_host_proc(proc_root, 90003, [90003], "0::/system.slice/cron")
+    pm = PathMonitor(root)
+    pm.scan()
+    assert reap_dead_by_hostpid(pm, proc_root=proc_root) == 1
+    assert next(iter(pm.entries.values())).region.usage()[0]["total"] == 0
+    pm.close()
+
+
+def test_register_proc_fresh_clears_recycled_usage(tmp_path):
+    """A fresh registration with a recycled container pid must not
+    inherit the dead predecessor's usage (phantom quota)."""
+    r = RegionFile(str(tmp_path / "fr.cache"), create=True)
+    r.set_devices(["tpu-0"], [1 << 30], [100])
+    r.register_proc(7)
+    r.add_usage(7, 0, 100 << 20)
+    # ordinary re-registration keeps accounting (same live process)
+    r.register_proc(7)
+    assert r.usage()[0]["total"] == 100 << 20
+    # fresh registration (new process, recycled pid) clears it
+    r.register_proc(7, fresh=True)
+    assert r.usage()[0]["total"] == 0
+    r.close()
+
+
 # -- cooperative shim runtime ---------------------------------------------
 
 

@@ -6,6 +6,7 @@ against the mock PJRT plugin — the reference's mock-library testing trick
 import json
 import os
 import subprocess
+import sys
 
 import pytest
 
@@ -131,6 +132,74 @@ def test_native_quota_over_limit_rejected(native, tmp_path):
         timeout=30,
     )
     assert sub.returncode == 0
+
+
+def test_native_shim_reaps_dead_predecessor(native, tmp_path):
+    """A crashed tenant's slot must not pin its quota: the shim reaps
+    dead procs at client create (ref clear_proc_slot_nolock).  Pre-seed
+    the region with a DEAD pid holding 40 of the 64 MiB quota — without
+    the reap, the suite's first 40 MiB allocation would be rejected."""
+    path = str(tmp_path / "reap.cache")
+    r = RegionFile(path, create=True)
+    r.set_devices(["mock-tpu-0"], [64 << 20], [100])
+    dead_pid = 999_999_99  # beyond pid_max: guaranteed dead
+    r.register_proc(dead_pid)
+    r.add_usage(dead_pid, 0, 40 << 20)
+    r.close()
+    env = dict(
+        os.environ,
+        TPU_DEVICE_MEMORY_LIMIT_0="64",
+        TPU_DEVICE_CORES_LIMIT="25",
+        VTPU_VISIBLE_UUIDS="mock-tpu-0",
+        TPU_DEVICE_MEMORY_SHARED_CACHE=path,
+        VTPU_REAL_PJRT_PLUGIN=os.path.join(native, "libmock_pjrt.so"),
+    )
+    out = subprocess.run(
+        [os.path.join(native, "test_shim"),
+         os.path.join(native, "libvtpu_shim.so")],
+        capture_output=True, env=env, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout.decode() + out.stderr.decode()
+    r = RegionFile(path)
+    assert dead_pid not in [p["pid"] for p in r.live_procs()]
+    r.close()
+
+
+def test_native_shim_fresh_registration_drops_recycled_usage(native, tmp_path):
+    """Container-pid recycling: a new tenant that gets the SAME pid as a
+    dead predecessor must not inherit its usage.  The seeder runs under
+    `sh -c`, registers $$ (the shell's pid) with 40 of the 64 MiB quota,
+    then `exec`s test_shim — which keeps that pid, so the shim's fresh
+    registration at client create must clear the phantom bytes or the
+    suite's first 40 MiB allocation fails."""
+    path = str(tmp_path / "recycled.cache")
+    seeder = (
+        "import sys; sys.path.insert(0, %r); "
+        "from vtpu.monitor.shared_region import RegionFile; "
+        "r = RegionFile(%r, create=True); "
+        "r.set_devices(['mock-tpu-0'], [64 << 20], [100]); "
+        "pid = int(sys.argv[1]); r.register_proc(pid); "
+        "r.add_usage(pid, 0, 40 << 20); r.close()"
+    ) % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))), path)
+    env = dict(
+        os.environ,
+        TPU_DEVICE_MEMORY_LIMIT_0="64",
+        TPU_DEVICE_CORES_LIMIT="25",
+        VTPU_VISIBLE_UUIDS="mock-tpu-0",
+        TPU_DEVICE_MEMORY_SHARED_CACHE=path,
+        VTPU_REAL_PJRT_PLUGIN=os.path.join(native, "libmock_pjrt.so"),
+    )
+    script = (
+        f"{sys.executable} -c \"$SEEDER\" $$ && "
+        f"exec {os.path.join(native, 'test_shim')} "
+        f"{os.path.join(native, 'libvtpu_shim.so')}"
+    )
+    env["SEEDER"] = seeder
+    out = subprocess.run(
+        ["sh", "-c", script], capture_output=True, env=env, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout.decode() + out.stderr.decode()
+    assert b"all shim tests passed" in out.stdout
 
 
 def test_native_shim_full_suite(native, tmp_path):

@@ -51,7 +51,7 @@ class FeedbackLoop:
         self._stop = threading.Event()
 
     def start(self) -> None:
-        from vtpu.monitor.hostpid import fill_hostpids
+        from vtpu.monitor.hostpid import fill_hostpids, reap_dead_by_hostpid
 
         def loop() -> None:
             while not self._stop.wait(self.interval_s):
@@ -59,8 +59,13 @@ class FeedbackLoop:
                     self.pathmon.scan()
                     observe_once(self.pathmon)
                     # resolve container→host pids for new slots each tick
-                    # (ref setHostPid runs inside the feedback loop too)
+                    # (ref setHostPid runs inside the feedback loop too),
+                    # then free slots whose host process died — a crashed
+                    # tenant must not pin its quota bytes
                     fill_hostpids(self.pathmon)
+                    reaped = reap_dead_by_hostpid(self.pathmon)
+                    if reaped:
+                        log.info("reaped %d dead tenant slot(s)", reaped)
                 except Exception:  # noqa: BLE001
                     log.exception("feedback pass failed")
 

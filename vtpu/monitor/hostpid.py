@@ -84,6 +84,39 @@ def scan_host_procs(proc_root: str = "/proc") -> List[Tuple[int, int, Optional[s
     return out
 
 
+def reap_dead_by_hostpid(pathmon, proc_root: str = "/proc") -> int:
+    """Free region slots whose HOST process is gone (ref
+    clear_proc_slot_nolock — the reference's C library reaps dead procs;
+    on the host side only hostpid-resolved slots are verifiable, so
+    unresolved ones are left alone; the in-container shim reaps those on
+    its next client create).  Returns slots freed across all regions."""
+    freed = 0
+    for entry in pathmon.entries.values():
+        region = entry.region
+        if region is None:
+            continue
+
+        def host_alive(slot):
+            hp = slot.get("hostpid")
+            if not hp:
+                return None  # unverifiable from the host namespace
+            # bare /proc/<hostpid> existence is NOT liveness: the kernel
+            # recycles pids, and a recycled hostpid would pin a dead
+            # tenant's quota forever.  The slot is alive only if that
+            # host process still maps to the recorded in-container pid.
+            try:
+                with open(os.path.join(proc_root, str(hp), "status")) as f:
+                    chain = _nspid_chain(f.read())
+            except OSError:
+                return False  # process gone
+            if len(chain) < 2 or chain[-1] != slot["pid"]:
+                return False  # hostpid recycled to an unrelated process
+            return True
+
+        freed += region.reap_dead(host_alive)
+    return freed
+
+
 def fill_hostpids(pathmon, proc_root: str = "/proc") -> int:
     """Resolve and write hostpid for every live region slot that lacks
     one.  A slot matches a host process when the in-container pids agree

@@ -225,14 +225,29 @@ class RegionFile:
                 r.limit_bytes[i] = limits[i]
                 r.core_limit[i] = cores[i]
 
-    def register_proc(self, pid: int, priority: int = 0) -> int:
+    def register_proc(self, pid: int, priority: int = 0,
+                      fresh: bool = False) -> int:
+        """``fresh=True`` is for a process KNOWN to be newly started: a
+        pid-matching slot left by a dead predecessor (container pid
+        recycled) gets its usage/telemetry cleared instead of inherited
+        (mirrors vtpu_region_register_proc_fresh)."""
         with self._locked():
-            return self._register_proc_nolock(pid, priority)
+            return self._register_proc_nolock(pid, priority, fresh)
 
-    def _register_proc_nolock(self, pid: int, priority: int = 0) -> int:
+    def _register_proc_nolock(self, pid: int, priority: int = 0,
+                              fresh: bool = False) -> int:
         r = self.region
         for p in range(MAX_PROCS):
             if r.procs[p].status == 1 and r.procs[p].pid == pid:
+                if fresh:
+                    ctypes.memset(
+                        ctypes.byref(r.procs[p].used), 0,
+                        ctypes.sizeof(r.procs[p].used),
+                    )
+                    r.procs[p].exec_calls = 0
+                    r.procs[p].exec_shim_ns = 0
+                    r.procs[p].hostpid = 0
+                    r.procs[p].priority = priority
                 return p
         for p in range(MAX_PROCS):
             if r.procs[p].status == 0:
@@ -243,6 +258,31 @@ class RegionFile:
                 r.proc_num += 1
                 return p
         return -1
+
+    def reap_dead(self, alive) -> int:
+        """Free slots whose process is gone (ref clear_proc_slot_nolock /
+        fix_lock_shrreg cleanup): a crashed tenant must not pin its quota
+        bytes forever.  ``alive(slot_dict)`` returns True (keep), False
+        (reap), or None (unknown — keep; e.g. the monitor cannot verify
+        an in-container pid whose hostpid is unresolved).  Returns the
+        number of slots freed."""
+        freed = 0
+        with self._locked():
+            r = self.region
+            for p in range(MAX_PROCS):
+                if r.procs[p].status != 1:
+                    continue
+                verdict = alive(
+                    {"pid": r.procs[p].pid, "hostpid": r.procs[p].hostpid}
+                )
+                if verdict is False:
+                    ctypes.memset(
+                        ctypes.byref(r.procs[p]), 0, ctypes.sizeof(ProcSlot)
+                    )
+                    if r.proc_num > 0:
+                        r.proc_num -= 1
+                    freed += 1
+        return freed
 
     def try_add(self, pid: int, dev: int, bytes_: int, kind: str = "buffer",
                 limit: int = 0, oversubscribe: bool = False) -> bool:

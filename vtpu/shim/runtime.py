@@ -127,7 +127,9 @@ class ShimRuntime:
                 (self.limits + [0] * len(names))[: len(names)],
                 [self.core_limit] * len(names),
             )
-            self.region.register_proc(self.pid, self.priority)
+            # fresh: this runtime is starting up — a dead predecessor's
+            # recycled pid must not hand it phantom usage
+            self.region.register_proc(self.pid, self.priority, fresh=True)
         # local (per-tenant) accounting mirrors the region
         self._local: Dict[int, int] = {}
         # bytes placed in the host tier past quota (oversubscribe)
