@@ -200,6 +200,27 @@ def test_ulysses_attention_matches_full():
     )
 
 
+def test_ulysses_composed_with_dp():
+    """dp×sp composition: batch over dp, head↔seq all-to-alls confined
+    to sp — numerics match unsharded attention."""
+    from jax.sharding import Mesh
+
+    from vtpu.parallel.ulysses import ulysses_attention
+
+    devs = np.array(jax.devices()).reshape(2, 4)
+    mesh = Mesh(devs, ("dp", "sp"))
+    rng = jax.random.PRNGKey(6)
+    q, k, v = (
+        jax.random.normal(r, (2, 4, 8 * 4, 32), jnp.float32)
+        for r in jax.random.split(rng, 3)
+    )
+    got = ulysses_attention(q, k, v, mesh, axis="sp", batch_axis="dp")
+    want = reference_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3
+    )
+
+
 def test_ulysses_rejects_indivisible_heads():
     from jax.sharding import Mesh
 

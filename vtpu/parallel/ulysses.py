@@ -34,9 +34,15 @@ def _local_attention(q, k, v, causal: bool):
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
-                      causal: bool = False):
+                      causal: bool = False, *, batch_axis: str | None = None):
     """q,k,v: [batch, heads, seq, d], seq sharded over ``axis``; returns
-    output with identical sharding."""
+    output with identical sharding.
+
+    ``batch_axis`` composes the scheme with DATA parallelism on a 2-D
+    mesh (dp×sp): the batch dim shards over ``batch_axis`` while the
+    head↔seq all-to-alls stay confined to ``axis`` — each dp replica
+    runs an independent Ulysses exchange on its own batch shard (heads
+    can't shard over dp, so the two axes never interact)."""
     n = mesh.shape[axis]
     if q.shape[1] % n:
         raise ValueError(
@@ -59,7 +65,7 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp",
         oh = _local_attention(qh, kh, vh, causal)
         return heads_to_seq(oh)
 
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, None, axis, None)
     return jax.shard_map(
         shard_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
