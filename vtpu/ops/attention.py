@@ -153,11 +153,14 @@ def reference_attention(q, k, v, causal: bool = False):
     return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _ref_with_lse(q, k, v):
+def _ref_with_lse(q, k, v, causal: bool = False):
     """Reference (o, lse) — the backward formulation for
     flash_attention_with_lse (both cotangents handled)."""
     sm_scale = q.shape[-1] ** -0.5
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
+        s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
@@ -165,12 +168,13 @@ def _ref_with_lse(q, k, v):
     return o, m + jnp.log(l)
 
 
-@jax.custom_vjp
-def flash_attention_with_lse(q, k, v):
-    """Non-causal attention returning (o_f32, lse) — the per-shard inner
-    op of ring attention: normalized output + per-row logsumexp form a
-    valid online-softmax partial.  Forward is the Pallas kernel (bf16
-    matmuls, f32 partial output so merging never rounds); backward
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention_with_lse(q, k, v, causal: bool = False):
+    """Attention returning (o_f32, lse) — the per-shard inner op of ring
+    attention: normalized output + per-row logsumexp form a valid
+    online-softmax partial.  Forward is the Pallas kernel (bf16 matmuls,
+    f32 partial output so merging never rounds; causal uses the
+    block-skipping causal kernel, never an [S,S] mask); backward
     differentiates the reference formulation for BOTH outputs.
 
     Ragged sequence lengths (not divisible by the 128 block) route
@@ -178,19 +182,21 @@ def flash_attention_with_lse(q, k, v):
     real logsumexp — the kernel's ragged fallback would return lse=0,
     silently breaking any caller that merges partials from this API."""
     if q.shape[-2] % 128 or k.shape[-2] % 128:
-        return _ref_with_lse(q, k, v)
-    return _flash_impl(q, k, v, False, 128, 128, jnp.float32)
+        return _ref_with_lse(q, k, v, causal)
+    return _flash_impl(q, k, v, causal, 128, 128, jnp.float32)
 
 
-def _fwl_fwd(q, k, v):
+def _fwl_fwd(q, k, v, causal):
     if q.shape[-2] % 128 or k.shape[-2] % 128:
-        return _ref_with_lse(q, k, v), (q, k, v)
-    return _flash_impl(q, k, v, False, 128, 128, jnp.float32), (q, k, v)
+        return _ref_with_lse(q, k, v, causal), (q, k, v)
+    return _flash_impl(q, k, v, causal, 128, 128, jnp.float32), (q, k, v)
 
 
-def _fwl_bwd(res, ct):
+def _fwl_bwd(causal, res, ct):
     q, k, v = res
-    _, vjp = jax.vjp(_ref_with_lse, q, k, v)
+    _, vjp = jax.vjp(
+        lambda a, b, c: _ref_with_lse(a, b, c, causal), q, k, v
+    )
     return vjp(ct)
 
 

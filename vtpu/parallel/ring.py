@@ -28,7 +28,8 @@ from vtpu.ops.attention import (
 )
 
 
-def _partial_attention(q, k, v, sm_scale, use_kernel: Optional[bool] = None):
+def _partial_attention(q, k, v, sm_scale, use_kernel: Optional[bool] = None,
+                       causal_local: bool = False):
     """Blockwise partials for one KV shard: returns (acc, m, l).
 
     On TPU (kernel-divisible shapes, default 1/sqrt(d) scale) the partial
@@ -36,15 +37,23 @@ def _partial_attention(q, k, v, sm_scale, use_kernel: Optional[bool] = None):
     per-row logsumexp form the valid online-softmax triple (o, lse, 1) —
     merging weights it by exp(lse − m_max), recovering the unnormalized
     accumulator exactly.  Differentiable (flash_attention_with_lse
-    carries a custom VJP for both outputs)."""
+    carries a custom VJP for both outputs).
+
+    ``causal_local`` applies the triangular mask WITHIN this q/kv pair
+    (the diagonal block of causal ring attention).  On TPU it uses the
+    causal flash kernel — block-skipping, never an [L, L] mask — so the
+    diagonal costs the same O(L) memory as every other hop."""
     if use_kernel is None:
         use_kernel = _on_tpu()
     default_scale = q.shape[-1] ** -0.5
     if (use_kernel and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0
             and abs(sm_scale - default_scale) < 1e-12):
-        o, lse = flash_attention_with_lse(q, k, v)
+        o, lse = flash_attention_with_lse(q, k, v, causal_local)
         return o, lse, jnp.ones_like(lse)
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
+    if causal_local:
+        mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
+        s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -61,6 +70,7 @@ def _merge(acc1, m1, l1, acc2, m2, l2):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", *,
+                   causal: bool = False,
                    head_axis: Optional[str] = None,
                    use_kernel: Optional[bool] = None):
     """q,k,v: [batch, heads, seq, d] with seq sharded over mesh axis
@@ -73,22 +83,44 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", *,
     so the tp dimension needs no collectives — each (sp, tp) shard runs
     the same ring schedule on its local heads, KV hops stay
     neighbor-to-neighbor on the sp ring, and the surrounding
-    Megatron-style projections keep their usual tp layout."""
+    Megatron-style projections keep their usual tp layout.
+
+    ``causal``: the sequence is sharded contiguously, so q-shard r
+    attends kv-shard s fully when s < r, triangularly when s == r (the
+    diagonal block, masked locally), and not at all when s > r — those
+    hops still run (uniform compute under jit) but their partials are
+    gated out of the merge with m = −inf.  The known cost is load skew:
+    early shards do less real work than late ones (the zigzag/striped
+    layout that balances it is future work)."""
     n_shards = mesh.shape[axis]
     sm_scale = q.shape[-1] ** -0.5
 
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 
     def shard_fn(q_s, k_s, v_s):
+        r = jax.lax.axis_index(axis)
         # first hop outside the loop so the carry is data-derived (its
-        # sharding/vma type then matches across loop iterations)
-        acc, m, l = _partial_attention(q_s, k_s, v_s, sm_scale, use_kernel)
+        # sharding/vma type then matches across loop iterations); the
+        # h=0 pair is (r, r) — the diagonal block — so causal masks it
+        # locally
+        acc, m, l = _partial_attention(
+            q_s, k_s, v_s, sm_scale, use_kernel, causal_local=causal
+        )
         k_cur = jax.lax.ppermute(k_s, axis, perm)
         v_cur = jax.lax.ppermute(v_s, axis, perm)
 
         def hop(i, carry):
             acc, m, l, k_c, v_c = carry
             a, mm, ll = _partial_attention(q_s, k_c, v_c, sm_scale, use_kernel)
+            if causal:
+                # KV at hop h (= i+1) originated at shard (r − h) mod n;
+                # it precedes this q-shard iff s < r — otherwise gate the
+                # partial out (m = −inf zeroes its merge weight)
+                s_idx = jnp.mod(r - (i + 1), n_shards)
+                valid = s_idx < r
+                mm = jnp.where(valid, mm, NEG_INF)
+                ll = jnp.where(valid, ll, 0.0)
+                a = jnp.where(valid, a, 0.0)
             acc, m, l = _merge(acc, m, l, a, mm, ll)
             # rotate KV one hop around the ring (neighbor ICI transfer)
             k_n = jax.lax.ppermute(k_c, axis, perm)
