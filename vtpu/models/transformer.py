@@ -41,9 +41,10 @@ class _LayerNorm(nn.Module):
 
 class Attention(nn.Module):
     num_heads: int
+    max_seq: int = 2048
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False, pos0=None):
         b, s, d = x.shape
         assert d % self.num_heads == 0, "num_heads must divide d_model"
         hd = d // self.num_heads
@@ -54,7 +55,37 @@ class Attention(nn.Module):
             return t.reshape(b, s, self.num_heads, hd).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        if _on_tpu():
+        if decode:
+            # KV-cache serving path (static shapes: the cache is
+            # max_seq-long, masked by position — no dynamic shapes under
+            # jit).  Works for prefill (s = prompt len) and incremental
+            # steps (s = 1) alike.  ``pos0`` (this block's first global
+            # position) comes down from the model's SINGLE position
+            # counter — per-layer counters could drift from it.
+            assert pos0 is not None, "decode=True requires pos0"
+            ck = self.variable(
+                "cache", "k", jnp.zeros,
+                (b, self.num_heads, self.max_seq, hd), k.dtype,
+            )
+            cv = self.variable(
+                "cache", "v", jnp.zeros,
+                (b, self.num_heads, self.max_seq, hd), v.dtype,
+            )
+            i0 = pos0
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, i0, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, i0, 0))
+            kpos = jnp.arange(self.max_seq)
+            qpos = i0 + jnp.arange(s)
+            mask = kpos[None, :] <= qpos[:, None]       # [s, max_seq]
+            scores = jnp.einsum(
+                "bhqd,bhkd->bhqk", q, ck.value
+            ).astype(jnp.float32) * (hd ** -0.5)
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum(
+                "bhqk,bhkd->bhqd", probs, cv.value.astype(jnp.float32)
+            ).astype(q.dtype)
+        elif _on_tpu():
             o = flash_attention(q, k, v, causal=True)
         else:
             o = reference_attention(q, k, v, causal=True)
@@ -65,11 +96,14 @@ class Attention(nn.Module):
 class Block(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
+    max_seq: int = 2048
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False, pos0=None):
         d = x.shape[-1]
-        x = x + Attention(self.num_heads, name="attn")(_LayerNorm(name="ln1")(x))
+        x = x + Attention(self.num_heads, self.max_seq, name="attn")(
+            _LayerNorm(name="ln1")(x), decode=decode, pos0=pos0
+        )
         h = nn.Dense(self.mlp_ratio * d, name="mlp_in")(_LayerNorm(name="ln2")(x))
         x = x + nn.Dense(d, name="mlp_out")(nn.gelu(h))
         return x
@@ -87,19 +121,89 @@ class TransformerLM(nn.Module):
     max_seq: int = 2048
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, decode: bool = False):
         b, s = tokens.shape
         assert s <= self.max_seq, f"seq {s} > max_seq {self.max_seq}"
         x = nn.Embed(self.vocab, self.d_model, name="wte")(tokens)
-        pos = nn.Embed(self.max_seq, self.d_model, name="wpe")(
-            jnp.arange(s)[None, :]
+        pos0 = None
+        if decode:
+            # the ONE position counter — layers receive it, none keep
+            # their own (drift-proof)
+            pos_var = self.variable(
+                "cache", "pos", lambda: jnp.zeros((), jnp.int32)
+            )
+            pos0 = pos_var.value
+            pos_ids = pos0 + jnp.arange(s)
+            pos_var.value = pos0 + s
+        else:
+            pos_ids = jnp.arange(s)
+        x = x + nn.Embed(self.max_seq, self.d_model, name="wpe")(
+            pos_ids[None, :]
         )
-        x = x + pos
         for i in range(self.depth):
-            x = Block(self.num_heads, name=f"h{i}")(x)
+            x = Block(self.num_heads, max_seq=self.max_seq, name=f"h{i}")(
+                x, decode=decode, pos0=pos0
+            )
         x = _LayerNorm(name="ln_f")(x)
         logits = nn.Dense(self.vocab, use_bias=False, name="lm_head")(x)
         return logits.astype(jnp.float32)
+
+
+def generate(model: TransformerLM, params, prompt, num_new: int,
+             temperature: float = 0.0, rng=None):
+    """Autoregressive serving: prefill the KV cache with ``prompt``
+    [b, s], then decode ``num_new`` tokens with one length-1 step each —
+    the whole loop is one compiled program (lax.scan, static shapes,
+    cache updated in place via flax's mutable "cache" collection).
+    temperature 0 = greedy; otherwise softmax sampling with ``rng``.
+    Returns [b, num_new] int32."""
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng")
+    if prompt.shape[1] + num_new > model.max_seq:
+        raise ValueError(
+            f"prompt ({prompt.shape[1]}) + num_new ({num_new}) exceeds "
+            f"max_seq ({model.max_seq}) — the cache would silently clamp"
+        )
+    # cache SHAPES only — eval_shape traces without materializing
+    # throwaway params or running a real forward
+    cache_shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros_like(prompt), decode=True
+        )["cache"]
+    )
+    cache = jax.tree.map(
+        lambda sh: jnp.zeros(sh.shape, sh.dtype), cache_shapes
+    )
+
+    def pick(logits_last, key):
+        if temperature <= 0:
+            return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits_last / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    logits, mut = model.apply(
+        {"params": params, "cache": cache}, prompt, decode=True,
+        mutable=["cache"],
+    )
+    key0 = rng if rng is not None else jax.random.PRNGKey(0)
+    keys = jax.random.split(key0, num_new)
+    tok = pick(logits[:, -1], keys[0])
+
+    def step(carry, key):
+        cache, tok = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok[:, None], decode=True,
+            mutable=["cache"],
+        )
+        ntok = pick(logits[:, -1], key)
+        return (mut["cache"], ntok), tok
+
+    (cache, last), toks = jax.lax.scan(
+        step, (mut["cache"], tok), keys[1:], length=num_new - 1
+    )
+    out = jnp.concatenate([toks.T, last[:, None]], axis=1)
+    return out
 
 
 def lm_loss(logits, tokens) -> jax.Array:

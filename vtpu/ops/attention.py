@@ -142,13 +142,20 @@ def _on_tpu() -> bool:
         return False
 
 
+def apply_causal_mask(s):
+    """Lower-triangular mask on a [..., q, k] score tensor (the single
+    place the mask idiom lives — sliding-window/bias variants extend
+    here)."""
+    mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
+    return jnp.where(mask, s, NEG_INF)
+
+
 def reference_attention(q, k, v, causal: bool = False):
     """Plain XLA attention (correctness oracle + fallback)."""
     sm_scale = q.shape[-1] ** -0.5
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
     if causal:
-        mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
-        s = jnp.where(mask, s, NEG_INF)
+        s = apply_causal_mask(s)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
 
@@ -159,8 +166,7 @@ def _ref_with_lse(q, k, v, causal: bool = False):
     sm_scale = q.shape[-1] ** -0.5
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
     if causal:
-        mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
-        s = jnp.where(mask, s, NEG_INF)
+        s = apply_causal_mask(s)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)

@@ -52,8 +52,9 @@ def _partial_attention(q, k, v, sm_scale, use_kernel: Optional[bool] = None,
         return o, lse, jnp.ones_like(lse)
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
     if causal_local:
-        mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
-        s = jnp.where(mask, s, NEG_INF)
+        from vtpu.ops.attention import apply_causal_mask
+
+        s = apply_causal_mask(s)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
