@@ -32,7 +32,7 @@ def _causal_hi(q_idx, block_q, block_k, n_blocks):
 
 
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                 causal: bool, sm_scale: float):
+                 causal: bool, sm_scale: float, shift: int = 0):
     # q_ref: [block_q, d]; k_ref/v_ref: [S, d]; grid dim 0 walks q blocks.
     # Also emits the per-row logsumexp (lse) the backward kernels need to
     # rematerialize p without a second online-softmax pass.
@@ -47,7 +47,10 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
         v = v_ref[pl.ds(start * block_k, block_k), :].astype(jnp.float32)
         s = q @ k.T  # [block_q, block_k] on the MXU
         if causal:
-            s = _causal_mask(s, q_idx * block_q, start * block_k)
+            # shift=-1 is the STRICT mask (k < q) striped ring attention
+            # needs for later-shard pairs; rows with no valid key
+            # self-gate (lse → −inf → zero merge weight)
+            s = _causal_mask(s, q_idx * block_q + shift, start * block_k)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
@@ -70,7 +73,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
 def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         dq_ref, *, block_k: int, causal: bool,
-                        sm_scale: float):
+                        sm_scale: float, shift: int = 0):
     """dq for one q block: recompute p from (scores − lse), accumulate
     ds @ k over kv blocks.  delta = rowsum(do * o), precomputed."""
     q = q_ref[:].astype(jnp.float32)
@@ -86,8 +89,13 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[pl.ds(start * block_k, block_k), :].astype(jnp.float32)
         s = (q @ k.T) * sm_scale
         if causal:
-            s = _causal_mask(s, q_idx * block_q, start * block_k)
+            s = _causal_mask(s, q_idx * block_q + shift, start * block_k)
         p = jnp.exp(s - lse)
+        if causal:
+            # a FULLY-masked row's own lse is ~NEG_INF, so exp(s − lse)
+            # would rematerialize 1/L per masked key instead of 0 — zero
+            # masked positions explicitly (matters under shift=−1)
+            p = jnp.where(s <= NEG_INF * 0.5, 0.0, p)
         dp = do @ v.T
         ds = p * (dp - delta) * sm_scale
         return dq + ds @ k
@@ -102,7 +110,7 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _attn_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                          dk_ref, dv_ref, *, block_q: int, causal: bool,
-                         sm_scale: float):
+                         sm_scale: float, shift: int = 0):
     """dk/dv for one kv block: loop over q blocks, transposed products."""
     k = k_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
@@ -118,8 +126,11 @@ def _attn_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[pl.ds(start * block_q, block_q), :].astype(jnp.float32)
         s = (q @ k.T) * sm_scale
         if causal:
-            s = _causal_mask(s, start * block_q, k_idx * block_k)
+            s = _causal_mask(s, start * block_q + shift, k_idx * block_k)
         p = jnp.exp(s - lse)
+        if causal:
+            # see _attn_bwd_dq_kernel: masked rows must not rematerialize
+            p = jnp.where(s <= NEG_INF * 0.5, 0.0, p)
         dv = dv + p.T @ do
         dp = do @ v.T
         ds = p * (dp - delta) * sm_scale
@@ -142,31 +153,34 @@ def _on_tpu() -> bool:
         return False
 
 
-def apply_causal_mask(s):
-    """Lower-triangular mask on a [..., q, k] score tensor (the single
-    place the mask idiom lives — sliding-window/bias variants extend
-    here)."""
-    mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
+def apply_causal_mask(s, shift: int = 0):
+    """Triangular mask on a [..., q, k] score tensor (the single place
+    the mask idiom lives — sliding-window/bias variants extend here).
+    ``shift`` moves the diagonal: 0 keeps k <= q, −1 is the STRICT mask
+    (k < q) striped ring attention uses for later-shard pairs.  Rows
+    with no valid key become all-NEG_INF; callers that merge partials
+    rely on the resulting −inf row max to zero their weight."""
+    mask = jnp.tril(jnp.ones(s.shape[-2:], bool), k=shift)
     return jnp.where(mask, s, NEG_INF)
 
 
-def reference_attention(q, k, v, causal: bool = False):
+def reference_attention(q, k, v, causal: bool = False, *, shift: int = 0):
     """Plain XLA attention (correctness oracle + fallback)."""
     sm_scale = q.shape[-1] ** -0.5
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
     if causal:
-        s = apply_causal_mask(s)
+        s = apply_causal_mask(s, shift)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-def _ref_with_lse(q, k, v, causal: bool = False):
+def _ref_with_lse(q, k, v, causal: bool = False, shift: int = 0):
     """Reference (o, lse) — the backward formulation for
     flash_attention_with_lse (both cotangents handled)."""
     sm_scale = q.shape[-1] ** -0.5
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
     if causal:
-        s = apply_causal_mask(s)
+        s = apply_causal_mask(s, shift)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
@@ -174,8 +188,9 @@ def _ref_with_lse(q, k, v, causal: bool = False):
     return o, m + jnp.log(l)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def flash_attention_with_lse(q, k, v, causal: bool = False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             shift: int = 0):
     """Attention returning (o_f32, lse) — the per-shard inner op of ring
     attention: normalized output + per-row logsumexp form a valid
     online-softmax partial.  Forward is the Pallas kernel (bf16 matmuls,
@@ -188,20 +203,23 @@ def flash_attention_with_lse(q, k, v, causal: bool = False):
     real logsumexp — the kernel's ragged fallback would return lse=0,
     silently breaking any caller that merges partials from this API."""
     if q.shape[-2] % 128 or k.shape[-2] % 128:
-        return _ref_with_lse(q, k, v, causal)
-    return _flash_impl(q, k, v, causal, 128, 128, jnp.float32)
+        return _ref_with_lse(q, k, v, causal, shift)
+    return _flash_impl(q, k, v, causal, 128, 128, jnp.float32, shift)
 
 
-def _fwl_fwd(q, k, v, causal):
+def _fwl_fwd(q, k, v, causal, shift):
     if q.shape[-2] % 128 or k.shape[-2] % 128:
-        return _ref_with_lse(q, k, v, causal), (q, k, v)
-    return _flash_impl(q, k, v, causal, 128, 128, jnp.float32), (q, k, v)
+        return _ref_with_lse(q, k, v, causal, shift), (q, k, v)
+    return (
+        _flash_impl(q, k, v, causal, 128, 128, jnp.float32, shift),
+        (q, k, v),
+    )
 
 
-def _fwl_bwd(causal, res, ct):
+def _fwl_bwd(causal, shift, res, ct):
     q, k, v = res
     _, vjp = jax.vjp(
-        lambda a, b, c: _ref_with_lse(a, b, c, causal), q, k, v
+        lambda a, b, c: _ref_with_lse(a, b, c, causal, shift), q, k, v
     )
     return vjp(ct)
 
@@ -255,41 +273,50 @@ def _map_batched(fn, *arrays, out_rank=2):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "out_dtype")
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "out_dtype", "shift"),
 )
 def _flash_impl(q, k, v, causal: bool = False, block_q: int = 128,
-                block_k: int = 128, out_dtype=None):
+                block_k: int = 128, out_dtype=None, shift: int = 0):
     if q.ndim == 2:
-        return _flash_2d(q, k, v, causal, block_q, block_k, out_dtype)
+        return _flash_2d(q, k, v, causal, block_q, block_k, out_dtype, shift)
     return _map_batched(
-        lambda a, b, c: _flash_2d(a, b, c, causal, block_q, block_k, out_dtype),
+        lambda a, b, c: _flash_2d(
+            a, b, c, causal, block_q, block_k, out_dtype, shift
+        ),
         q, k, v,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
-def _flash_bwd_impl(q, k, v, o, lse, ct, causal, block_q, block_k):
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "shift")
+)
+def _flash_bwd_impl(q, k, v, o, lse, ct, causal, block_q, block_k,
+                    shift: int = 0):
     if q.ndim == 2:
-        return _flash_bwd_2d(q, k, v, o, lse, ct, causal, block_q, block_k)
+        return _flash_bwd_2d(q, k, v, o, lse, ct, causal, block_q, block_k,
+                             shift)
     return _map_batched(
         lambda a, b, c, oo, ll, cc: _flash_bwd_2d(
-            a, b, c, oo, ll, cc, causal, block_q, block_k
+            a, b, c, oo, ll, cc, causal, block_q, block_k, shift
         ),
         q, k, v, o, lse, ct,
     )
 
 
-def _flash_2d(q, k, v, causal, block_q, block_k, out_dtype=None):
+def _flash_2d(q, k, v, causal, block_q, block_k, out_dtype=None,
+              shift: int = 0):
     seq_q, d = q.shape
     seq_k = k.shape[0]
     if seq_q % block_q or seq_k % block_k:
-        o = reference_attention(q, k, v, causal)
+        o = reference_attention(q, k, v, causal, shift=shift)
         # lse unused on this path (backward falls back too)
         return o.astype(out_dtype or q.dtype), jnp.zeros((seq_q, 1), jnp.float32)
     sm_scale = d**-0.5
     return pl.pallas_call(
         functools.partial(
-            _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale
+            _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale,
+            shift=shift,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((seq_q, d), out_dtype or q.dtype),
@@ -309,7 +336,8 @@ def _flash_2d(q, k, v, causal, block_q, block_k, out_dtype=None):
     )(q, k, v)
 
 
-def _flash_bwd_2d(q, k, v, o, lse, do, causal, block_q, block_k):
+def _flash_bwd_2d(q, k, v, o, lse, do, causal, block_q, block_k,
+                  shift: int = 0):
     seq_q, d = q.shape
     seq_k = k.shape[0]
     sm_scale = d**-0.5
@@ -320,7 +348,7 @@ def _flash_bwd_2d(q, k, v, o, lse, do, causal, block_q, block_k):
     dq = pl.pallas_call(
         functools.partial(
             _attn_bwd_dq_kernel, block_k=block_k, causal=causal,
-            sm_scale=sm_scale,
+            sm_scale=sm_scale, shift=shift,
         ),
         out_shape=jax.ShapeDtypeStruct((seq_q, d), q.dtype),
         grid=(seq_q // block_q,),
@@ -338,7 +366,7 @@ def _flash_bwd_2d(q, k, v, o, lse, do, causal, block_q, block_k):
     dk, dv = pl.pallas_call(
         functools.partial(
             _attn_bwd_dkv_kernel, block_q=block_q, causal=causal,
-            sm_scale=sm_scale,
+            sm_scale=sm_scale, shift=shift,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((seq_k, d), k.dtype),

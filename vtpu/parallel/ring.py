@@ -29,7 +29,7 @@ from vtpu.ops.attention import (
 
 
 def _partial_attention(q, k, v, sm_scale, use_kernel: Optional[bool] = None,
-                       causal_local: bool = False):
+                       causal_local: bool = False, shift: int = 0):
     """Blockwise partials for one KV shard: returns (acc, m, l).
 
     On TPU (kernel-divisible shapes, default 1/sqrt(d) scale) the partial
@@ -48,13 +48,13 @@ def _partial_attention(q, k, v, sm_scale, use_kernel: Optional[bool] = None,
     default_scale = q.shape[-1] ** -0.5
     if (use_kernel and q.shape[-2] % 128 == 0 and k.shape[-2] % 128 == 0
             and abs(sm_scale - default_scale) < 1e-12):
-        o, lse = flash_attention_with_lse(q, k, v, causal_local)
+        o, lse = flash_attention_with_lse(q, k, v, causal_local, shift)
         return o, lse, jnp.ones_like(lse)
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
     if causal_local:
         from vtpu.ops.attention import apply_causal_mask
 
-        s = apply_causal_mask(s)
+        s = apply_causal_mask(s, shift)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
@@ -70,8 +70,35 @@ def _merge(acc1, m1, l1, acc2, m2, l2):
     return acc1 * a1 + acc2 * a2, m, l1 * a1 + l2 * a2
 
 
+def stripe_sequence(x, n_shards: int):
+    """Contiguous → STRIPED sequence layout on dim −2: shard r of a
+    P(..., axis, None)-sharded striped array holds global tokens
+    r, r+n, r+2n, … — the round-robin layout that balances causal ring
+    attention (every shard then owns an even mix of early and late
+    positions, so no shard's hops are mostly masked)."""
+    *lead, s, d = x.shape
+    ell = s // n_shards
+    return (
+        x.reshape(*lead, ell, n_shards, d)
+        .swapaxes(-3, -2)
+        .reshape(*lead, s, d)
+    )
+
+
+def unstripe_sequence(x, n_shards: int):
+    """Inverse of :func:`stripe_sequence`."""
+    *lead, s, d = x.shape
+    ell = s // n_shards
+    return (
+        x.reshape(*lead, n_shards, ell, d)
+        .swapaxes(-3, -2)
+        .reshape(*lead, s, d)
+    )
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", *,
                    causal: bool = False,
+                   layout: str = "contiguous",
                    head_axis: Optional[str] = None,
                    use_kernel: Optional[bool] = None):
     """q,k,v: [batch, heads, seq, d] with seq sharded over mesh axis
@@ -86,13 +113,23 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", *,
     neighbor-to-neighbor on the sp ring, and the surrounding
     Megatron-style projections keep their usual tp layout.
 
-    ``causal``: the sequence is sharded contiguously, so q-shard r
-    attends kv-shard s fully when s < r, triangularly when s == r (the
-    diagonal block, masked locally), and not at all when s > r — those
-    hops still run (uniform compute under jit) but their partials are
-    gated out of the merge with m = −inf.  The known cost is load skew:
-    early shards do less real work than late ones (the zigzag/striped
-    layout that balances it is future work)."""
+    ``causal`` + ``layout``:
+
+    - ``"contiguous"`` (default): shard r holds tokens [rL, (r+1)L), so
+      it attends kv-shard s fully when s < r, triangularly when s == r
+      (the diagonal block, masked locally), and not at all when s > r —
+      those hops still run (uniform compute under jit) but their
+      partials are gated out of the merge with m = −inf.  Cost: load
+      skew (early shards do less real work).
+    - ``"striped"``: inputs pre-permuted with :func:`stripe_sequence`
+      (shard r holds tokens r, r+n, 2n+r, …).  Every hop then does the
+      SAME amount of real work — pair (r, s) masks with the triangular
+      mask when s <= r and the strict (k < q) mask when s > r — which
+      balances the ring (the Striped Attention observation).  Output
+      comes back striped; :func:`unstripe_sequence` restores order."""
+    if layout not in ("contiguous", "striped"):
+        raise ValueError(f"unknown layout {layout!r}")
+    striped = layout == "striped" and causal  # non-causal striping is a no-op
     n_shards = mesh.shape[axis]
     sm_scale = q.shape[-1] ** -0.5
 
@@ -103,7 +140,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", *,
         # first hop outside the loop so the carry is data-derived (its
         # sharding/vma type then matches across loop iterations); the
         # h=0 pair is (r, r) — the diagonal block — so causal masks it
-        # locally
+        # locally (both layouts: s==r means j<=i)
         acc, m, l = _partial_attention(
             q_s, k_s, v_s, sm_scale, use_kernel, causal_local=causal
         )
@@ -112,16 +149,34 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", *,
 
         def hop(i, carry):
             acc, m, l, k_c, v_c = carry
-            a, mm, ll = _partial_attention(q_s, k_c, v_c, sm_scale, use_kernel)
-            if causal:
-                # KV at hop h (= i+1) originated at shard (r − h) mod n;
-                # it precedes this q-shard iff s < r — otherwise gate the
-                # partial out (m = −inf zeroes its merge weight)
-                s_idx = jnp.mod(r - (i + 1), n_shards)
-                valid = s_idx < r
-                mm = jnp.where(valid, mm, NEG_INF)
-                ll = jnp.where(valid, ll, 0.0)
-                a = jnp.where(valid, a, 0.0)
+            # KV at hop h (= i+1) originated at shard s = (r − h) mod n
+            s_idx = jnp.mod(r - (i + 1), n_shards)
+            if striped:
+                # striped global positions: q = i·n + r, k = j·n + s ⇒
+                # causal (k ≤ q) is j ≤ i when s ≤ r, j < i when s > r
+                a, mm, ll = jax.lax.cond(
+                    s_idx > r,
+                    lambda kc, vc: _partial_attention(
+                        q_s, kc, vc, sm_scale, use_kernel,
+                        causal_local=True, shift=-1,
+                    ),
+                    lambda kc, vc: _partial_attention(
+                        q_s, kc, vc, sm_scale, use_kernel,
+                        causal_local=True, shift=0,
+                    ),
+                    k_c, v_c,
+                )
+            else:
+                a, mm, ll = _partial_attention(
+                    q_s, k_c, v_c, sm_scale, use_kernel
+                )
+                if causal:
+                    # contiguous: kv-shard s precedes this q-shard iff
+                    # s < r — otherwise gate the partial out
+                    valid = s_idx < r
+                    mm = jnp.where(valid, mm, NEG_INF)
+                    ll = jnp.where(valid, ll, 0.0)
+                    a = jnp.where(valid, a, 0.0)
             acc, m, l = _merge(acc, m, l, a, mm, ll)
             # rotate KV one hop around the ring (neighbor ICI transfer)
             k_n = jax.lax.ppermute(k_c, axis, perm)
