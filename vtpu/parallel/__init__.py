@@ -7,4 +7,8 @@ ring attention (sequence parallelism over ICI via ppermute).
 """
 
 from vtpu.parallel.mesh import mesh_from_rectangle, make_mesh  # noqa: F401
-from vtpu.parallel.ring import ring_attention  # noqa: F401
+from vtpu.parallel.ring import (  # noqa: F401
+    ring_attention,
+    stripe_sequence,
+    unstripe_sequence,
+)
