@@ -446,3 +446,48 @@ def test_striped_ring_attention_kernel_path():
     )(q)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gw), rtol=5e-3,
                                atol=5e-3)
+
+
+def test_flash_attention_gqa_matches_repeated_kv():
+    """GQA: 8 query heads sharing 2 KV heads equals attention with the
+    KV explicitly repeated; MQA (1 KV head) too; gradients flow."""
+    from vtpu.ops.attention import flash_attention_gqa
+
+    rng = jax.random.PRNGKey(3)
+    q = jax.random.normal(rng, (2, 8, 128, 32), jnp.float32)
+    k = jax.random.normal(jax.random.PRNGKey(4), (2, 2, 128, 32))
+    v = jax.random.normal(jax.random.PRNGKey(5), (2, 2, 128, 32))
+    want = reference_attention(
+        q, jnp.repeat(k, 4, axis=1), jnp.repeat(v, 4, axis=1), causal=True
+    )
+    # both paths: grouped XLA reference AND the vmapped Pallas kernel
+    # (interpret mode off-TPU)
+    for uk in (False, True):
+        got = flash_attention_gqa(q, k, v, causal=True, use_kernel=uk)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3
+        )
+    # MQA
+    k1, v1 = k[:, :1], v[:, :1]
+    got1 = flash_attention_gqa(q, k1, v1)
+    want1 = reference_attention(
+        q, jnp.repeat(k1, 8, axis=1), jnp.repeat(v1, 8, axis=1)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got1), np.asarray(want1), rtol=2e-3, atol=2e-3
+    )
+    # grads wrt the SHARED kv accumulate over the group
+    gk = jax.grad(
+        lambda t: flash_attention_gqa(q, t, v).astype(jnp.float32).mean()
+    )(k)
+    gk_want = jax.grad(
+        lambda t: reference_attention(
+            q, jnp.repeat(t, 4, axis=1), jnp.repeat(v, 4, axis=1)
+        ).astype(jnp.float32).mean()
+    )(k)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gk_want),
+                               rtol=5e-3, atol=5e-3)
+    # indivisible heads rejected
+    k3 = jnp.concatenate([k, k[:, :1]], axis=1)  # 3 kv heads vs 8 q heads
+    with pytest.raises(ValueError):
+        flash_attention_gqa(q, k3, k3)

@@ -24,7 +24,12 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from vtpu.ops.attention import flash_attention, reference_attention, _on_tpu
+from vtpu.ops.attention import (
+    _on_tpu,
+    flash_attention,
+    flash_attention_gqa,
+    reference_attention,
+)
 from vtpu.ops.layernorm import fused_layernorm
 
 
@@ -42,19 +47,30 @@ class _LayerNorm(nn.Module):
 class Attention(nn.Module):
     num_heads: int
     max_seq: int = 2048
+    num_kv_heads: int = 0  # 0 ⇒ = num_heads (MHA); fewer = GQA, 1 = MQA
 
     @nn.compact
     def __call__(self, x, decode: bool = False, pos0=None):
         b, s, d = x.shape
         assert d % self.num_heads == 0, "num_heads must divide d_model"
         hd = d // self.num_heads
-        qkv = nn.Dense(3 * d, use_bias=False, name="qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        n_kv = self.num_kv_heads or self.num_heads
+        assert self.num_heads % n_kv == 0, "kv heads must divide q heads"
+        if n_kv == self.num_heads:
+            qkv = nn.Dense(3 * d, use_bias=False, name="qkv")(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            # GQA: q keeps all heads, k/v project to the smaller head
+            # count — the KV cache (the serving memory cost) shrinks by
+            # num_heads/num_kv_heads
+            q = nn.Dense(d, use_bias=False, name="q")(x)
+            kv = nn.Dense(2 * n_kv * hd, use_bias=False, name="kv")(x)
+            k, v = jnp.split(kv, 2, axis=-1)
 
-        def heads(t):
-            return t.reshape(b, s, self.num_heads, hd).transpose(0, 2, 1, 3)
+        def heads(t, n):
+            return t.reshape(b, s, n, hd).transpose(0, 2, 1, 3)
 
-        q, k, v = heads(q), heads(k), heads(v)
+        q, k, v = heads(q, self.num_heads), heads(k, n_kv), heads(v, n_kv)
         if decode:
             # KV-cache serving path (static shapes: the cache is
             # max_seq-long, masked by position — no dynamic shapes under
@@ -65,11 +81,11 @@ class Attention(nn.Module):
             assert pos0 is not None, "decode=True requires pos0"
             ck = self.variable(
                 "cache", "k", jnp.zeros,
-                (b, self.num_heads, self.max_seq, hd), k.dtype,
+                (b, n_kv, self.max_seq, hd), k.dtype,
             )
             cv = self.variable(
                 "cache", "v", jnp.zeros,
-                (b, self.num_heads, self.max_seq, hd), v.dtype,
+                (b, n_kv, self.max_seq, hd), v.dtype,
             )
             i0 = pos0
             ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, i0, 0))
@@ -77,14 +93,20 @@ class Attention(nn.Module):
             kpos = jnp.arange(self.max_seq)
             qpos = i0 + jnp.arange(s)
             mask = kpos[None, :] <= qpos[:, None]       # [s, max_seq]
+            # grouped einsum: each kv head serves its group of q heads
+            # directly from the SMALL cache — no head repetition
+            g = self.num_heads // n_kv
+            qg = q.reshape(b, n_kv, g, s, hd)
             scores = jnp.einsum(
-                "bhqd,bhkd->bhqk", q, ck.value
+                "bngqd,bnkd->bngqk", qg, ck.value
             ).astype(jnp.float32) * (hd ** -0.5)
-            scores = jnp.where(mask[None, None], scores, -1e30)
+            scores = jnp.where(mask[None, None, None], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             o = jnp.einsum(
-                "bhqk,bhkd->bhqd", probs, cv.value.astype(jnp.float32)
-            ).astype(q.dtype)
+                "bngqk,bnkd->bngqd", probs, cv.value.astype(jnp.float32)
+            ).astype(q.dtype).reshape(b, self.num_heads, s, hd)
+        elif n_kv != self.num_heads:
+            o = flash_attention_gqa(q, k, v, causal=True)
         elif _on_tpu():
             o = flash_attention(q, k, v, causal=True)
         else:
@@ -97,11 +119,13 @@ class Block(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     max_seq: int = 2048
+    num_kv_heads: int = 0
 
     @nn.compact
     def __call__(self, x, decode: bool = False, pos0=None):
         d = x.shape[-1]
-        x = x + Attention(self.num_heads, self.max_seq, name="attn")(
+        x = x + Attention(self.num_heads, self.max_seq, self.num_kv_heads,
+                          name="attn")(
             _LayerNorm(name="ln1")(x), decode=decode, pos0=pos0
         )
         h = nn.Dense(self.mlp_ratio * d, name="mlp_in")(_LayerNorm(name="ln2")(x))
@@ -119,6 +143,7 @@ class TransformerLM(nn.Module):
     depth: int = 8
     num_heads: int = 8
     max_seq: int = 2048
+    num_kv_heads: int = 0  # 0 = MHA; fewer = GQA (smaller KV cache)
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False):
@@ -141,7 +166,8 @@ class TransformerLM(nn.Module):
             pos_ids[None, :]
         )
         for i in range(self.depth):
-            x = Block(self.num_heads, max_seq=self.max_seq, name=f"h{i}")(
+            x = Block(self.num_heads, max_seq=self.max_seq,
+                      num_kv_heads=self.num_kv_heads, name=f"h{i}")(
                 x, decode=decode, pos0=pos0
             )
         x = _LayerNorm(name="ln_f")(x)
@@ -221,7 +247,9 @@ def tp_param_specs(axis: str = "tp"):
     from jax.sharding import PartitionSpec as P
 
     def match(path: str) -> Optional[object]:
-        if path.endswith(("qkv/kernel", "mlp_in/kernel")):
+        # q/kv are the GQA split projections (column-parallel like qkv)
+        if path.endswith(("qkv/kernel", "q/kernel", "kv/kernel",
+                          "mlp_in/kernel")):
             return P(None, axis)
         if path.endswith(("out/kernel", "mlp_out/kernel")):
             return P(axis, None)

@@ -244,6 +244,46 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     return _flash_impl(q, k, v, causal, block_q, block_k)[0]
 
 
+def flash_attention_gqa(q, k, v, causal: bool = False,
+                        use_kernel: bool | None = None):
+    """Grouped-query attention: q [b, Hq, s, d] with k/v [b, Hkv, s, d],
+    Hkv dividing Hq (MQA is Hkv=1).  Each group of Hq/Hkv query heads
+    shares one KV head — the KV cache shrinks by the group factor, the
+    dominant serving memory cost.  The shared KV is vmapped-broadcast
+    into the flash kernel, never materialized per query head; off-TPU
+    (or with ``use_kernel=False``) a grouped XLA reference runs instead,
+    matching the MHA path's platform fallback."""
+    b, hq, s, d = q.shape
+    hk = k.shape[1]
+    if use_kernel is None:
+        use_kernel = _on_tpu()
+    if hq == hk:
+        return flash_attention(q, k, v, causal=causal)
+    if hq % hk:
+        raise ValueError(f"q heads ({hq}) must divide by kv heads ({hk})")
+    g = hq // hk
+    qg = q.reshape(b, hk, g, s, d)
+    if not use_kernel:
+        # grouped XLA reference (same fallback the MHA path takes
+        # off-TPU): einsum over the group dim, KV never repeated
+        sm = d ** -0.5
+        sc = jnp.einsum("bngqd,bnkd->bngqk", qg, k).astype(jnp.float32) * sm
+        if causal:
+            sc = apply_causal_mask(sc)
+        p = jax.nn.softmax(sc, axis=-1)
+        o = jnp.einsum("bngqk,bnkd->bngqd", p, v.astype(jnp.float32))
+        return o.astype(q.dtype).reshape(b, hq, s, d)
+
+    def one(qq, kk, vv):  # [s, d] each
+        return flash_attention(qq, kk, vv, causal=causal)
+
+    per_group = jax.vmap(one, in_axes=(0, None, None))   # group dim
+    per_kv = jax.vmap(per_group, in_axes=(0, 0, 0))      # kv-head dim
+    per_batch = jax.vmap(per_kv, in_axes=(0, 0, 0))      # batch dim
+    o = per_batch(qg, k, v)                              # [b, hk, g, s, d]
+    return o.reshape(b, hq, s, d)
+
+
 def _flash_fwd(q, k, v, causal, block_q, block_k):
     o, lse = _flash_impl(q, k, v, causal, block_q, block_k)
     return o, (q, k, v, o, lse)
