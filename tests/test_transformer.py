@@ -13,6 +13,23 @@ from vtpu.models.transformer import TransformerLM, lm_loss, tp_param_specs
 TINY = dict(vocab=128, d_model=64, depth=2, num_heads=4, max_seq=64)
 
 
+def assert_greedy_decode_matches(model, params, prompt, n):
+    """Shared contract check: generate() must equal n cache-less greedy
+    forwards, token-exactly."""
+    from vtpu.models.transformer import generate
+
+    out = generate(model, params, prompt, num_new=n)
+    seq = prompt
+    for _ in range(n):
+        lg = model.apply({"params": params}, seq)
+        nt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nt[:, None]], axis=1)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(seq[:, prompt.shape[1]:])
+    )
+    return out
+
+
 @pytest.fixture(scope="module")
 def tiny():
     model = TransformerLM(**TINY)
@@ -104,15 +121,8 @@ def test_kv_cache_decode_matches_full_forward():
     prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 64)
     params = model.init(rng, prompt)["params"]
 
-    out = generate(model, params, prompt, num_new=6)
+    out = assert_greedy_decode_matches(model, params, prompt, 6)
     assert out.shape == (2, 6)
-
-    seq = prompt
-    for _ in range(6):
-        logits = model.apply({"params": params}, seq)
-        nt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        seq = jnp.concatenate([seq, nt[:, None]], axis=1)
-    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq[:, 5:]))
 
 
 def test_kv_cache_decode_sampling_shape():
@@ -184,10 +194,31 @@ def test_gqa_transformer_decode_and_cache_size():
     )["cache"]
     assert cache["h0"]["attn"]["k"].shape == (2, 2, 32, 4)
 
-    out = generate(model, params, prompt, num_new=5)
-    seq = prompt
-    for _ in range(5):
-        lg = model.apply({"params": params}, seq)
-        nt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
-        seq = jnp.concatenate([seq, nt[:, None]], axis=1)
-    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq[:, 5:]))
+    assert_greedy_decode_matches(model, params, prompt, 5)
+
+
+def test_rope_lm_decode_and_relative_property():
+    """RoPE LM: scores depend on relative distance (shifting all
+    positions leaves q·k unchanged), and greedy KV-cache decode stays
+    token-exact against cache-less forwards."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from vtpu.models.transformer import TransformerLM, generate, rope
+
+    # relative-distance invariance of the rotation
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 8, 16))
+    y = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 8, 16))
+    p = jnp.arange(8)
+    s0 = jnp.einsum("bhqd,bhkd->bhqk", rope(x, p), rope(y, p))
+    s7 = jnp.einsum("bhqd,bhkd->bhqk", rope(x, p + 7), rope(y, p + 7))
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s7), rtol=1e-4,
+                               atol=1e-4)
+
+    model = TransformerLM(vocab=64, d_model=32, depth=2, num_heads=4,
+                          max_seq=32, pos_embedding="rope")
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    assert "wpe" not in params  # no learned position table under RoPE
+    assert_greedy_decode_matches(model, params, prompt, 5)

@@ -33,6 +33,26 @@ from vtpu.ops.attention import (
 from vtpu.ops.layernorm import fused_layernorm
 
 
+def rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding on the head dim: x [..., s, d] (d even)
+    rotated by per-position angles — attention scores then depend only
+    on RELATIVE distance, the long-context-friendly property (no learned
+    table, extrapolates past training length).  ``positions`` [s] are
+    ABSOLUTE token positions, which makes the same function correct for
+    full forwards, ring/striped sequence shards (pass the shard's global
+    positions), and KV-cache decode (pass pos0 + arange)."""
+    assert x.shape[-1] % 2 == 0, "RoPE needs an even head dim"
+    half = x.shape[-1] // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions[:, None].astype(jnp.float32) * freqs  # [s, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
 class _LayerNorm(nn.Module):
     """LayerNorm backed by the fused Pallas kernel on TPU."""
 
@@ -48,6 +68,7 @@ class Attention(nn.Module):
     num_heads: int
     max_seq: int = 2048
     num_kv_heads: int = 0  # 0 ⇒ = num_heads (MHA); fewer = GQA, 1 = MQA
+    use_rope: bool = False
 
     @nn.compact
     def __call__(self, x, decode: bool = False, pos0=None):
@@ -71,6 +92,13 @@ class Attention(nn.Module):
             return t.reshape(b, s, n, hd).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q, self.num_heads), heads(k, n_kv), heads(v, n_kv)
+        if self.use_rope:
+            # rotate with ABSOLUTE positions; the cache then holds
+            # rotated keys, so decode needs no re-rotation of history
+            start = pos0 if (decode and pos0 is not None) else 0
+            positions = start + jnp.arange(s)
+            q = rope(q, positions)
+            k = rope(k, positions)
         if decode:
             # KV-cache serving path (static shapes: the cache is
             # max_seq-long, masked by position — no dynamic shapes under
@@ -120,12 +148,13 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     max_seq: int = 2048
     num_kv_heads: int = 0
+    use_rope: bool = False
 
     @nn.compact
     def __call__(self, x, decode: bool = False, pos0=None):
         d = x.shape[-1]
         x = x + Attention(self.num_heads, self.max_seq, self.num_kv_heads,
-                          name="attn")(
+                          self.use_rope, name="attn")(
             _LayerNorm(name="ln1")(x), decode=decode, pos0=pos0
         )
         h = nn.Dense(self.mlp_ratio * d, name="mlp_in")(_LayerNorm(name="ln2")(x))
@@ -144,6 +173,7 @@ class TransformerLM(nn.Module):
     num_heads: int = 8
     max_seq: int = 2048
     num_kv_heads: int = 0  # 0 = MHA; fewer = GQA (smaller KV cache)
+    pos_embedding: str = "learned"  # "learned" (wpe table) | "rope"
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False):
@@ -162,12 +192,20 @@ class TransformerLM(nn.Module):
             pos_var.value = pos0 + s
         else:
             pos_ids = jnp.arange(s)
-        x = x + nn.Embed(self.max_seq, self.d_model, name="wpe")(
-            pos_ids[None, :]
-        )
+        if self.pos_embedding not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_embedding must be 'learned' or 'rope', "
+                f"got {self.pos_embedding!r}"
+            )
+        use_rope = self.pos_embedding == "rope"
+        if not use_rope:
+            x = x + nn.Embed(self.max_seq, self.d_model, name="wpe")(
+                pos_ids[None, :]
+            )
         for i in range(self.depth):
             x = Block(self.num_heads, max_seq=self.max_seq,
-                      num_kv_heads=self.num_kv_heads, name=f"h{i}")(
+                      num_kv_heads=self.num_kv_heads, use_rope=use_rope,
+                      name=f"h{i}")(
                 x, decode=decode, pos0=pos0
             )
         x = _LayerNorm(name="ln_f")(x)
