@@ -5,6 +5,47 @@ VTPU_COORDINATOR env contract)."""
 from vtpu.parallel import distributed
 
 
+def _run_two_process_gang(worker: str, timeout: float = 300) -> None:
+    """Spawn two host processes x 4 virtual devices with the chart's
+    VTPU_* env contract and assert both ranks print 'gang ok'."""
+    import os
+    import pathlib
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            VTPU_COORDINATOR=f"127.0.0.1:{port}",
+            VTPU_NUM_PROCESSES="2",
+            VTPU_PROCESS_ID=str(rank),
+            PYTHONPATH=repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        )
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        ))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"rank failed:\n{out}\n{err[-2000:]}"
+            assert "gang ok" in out
+    finally:
+        for p in procs:  # a failed rank must not leak its sibling
+            if p.poll() is None:
+                p.kill()
+
+
 def test_single_host_noop(monkeypatch):
     monkeypatch.delenv("VTPU_COORDINATOR", raising=False)
     monkeypatch.delenv("VTPU_NUM_PROCESSES", raising=False)
@@ -39,16 +80,6 @@ def test_two_process_gang_over_dcn(tmp_path):
     (the chart's VTPU_* env contract), form one 8-device global mesh,
     and run a cross-host psum — the DCN-tier collective a v5p gang
     performs, minus the chips."""
-    import os
-    import socket
-    import subprocess
-    import sys
-
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-
     worker = (
         "import jax, numpy as np\n"
         "from jax.sharding import Mesh, PartitionSpec as P\n"
@@ -66,31 +97,37 @@ def test_two_process_gang_over_dcn(tmp_path):
         "assert float(out[0]) == 8.0, out\n"
         "print('gang ok', distributed.process_index())\n"
     )
-    import pathlib
+    _run_two_process_gang(worker)
 
-    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
-    procs = []
-    for rank in range(2):
-        env = dict(os.environ)
-        env.update(
-            JAX_PLATFORMS="cpu",
-            XLA_FLAGS="--xla_force_host_platform_device_count=4",
-            VTPU_COORDINATOR=f"127.0.0.1:{port}",
-            VTPU_NUM_PROCESSES="2",
-            VTPU_PROCESS_ID=str(rank),
-            PYTHONPATH=repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
-        )
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", worker], env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        ))
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=300)
-            assert p.returncode == 0, f"rank failed:\n{out}\n{err[-2000:]}"
-            assert "gang ok" in out
-    finally:
-        for p in procs:  # a failed rank must not leak its sibling
-            if p.poll() is None:
-                p.kill()
+
+def test_two_process_ring_attention_over_dcn():
+    """Ring attention ACROSS host processes: the sequence shards over
+    all 8 global devices (4 per host), KV hops ppermute across the
+    process boundary, and the allgathered result matches the unsharded
+    reference on every rank — multi-host sequence parallelism end to
+    end."""
+    worker = (
+        "import jax, numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "from jax.experimental import multihost_utils\n"
+        "from vtpu.parallel import distributed\n"
+        "from vtpu.parallel.ring import ring_attention\n"
+        "from vtpu.ops.attention import reference_attention\n"
+        "assert distributed.ensure_initialized() is True\n"
+        "mesh = Mesh(np.array(jax.devices()), ('sp',))\n"
+        "rng = np.random.default_rng(0)\n"
+        "qkv = [rng.standard_normal((1, 2, 64, 16)).astype(np.float32)\n"
+        "       for _ in range(3)]\n"
+        "sh = NamedSharding(mesh, P(None, None, 'sp', None))\n"
+        "gq, gk, gv = (jax.make_array_from_callback(\n"
+        "    a.shape, sh, lambda idx, a=a: a[idx]) for a in qkv)\n"
+        "out = ring_attention(gq, gk, gv, mesh, axis='sp', causal=True)\n"
+        "full = multihost_utils.process_allgather(out, tiled=True)\n"
+        "want = reference_attention(*[jnp.asarray(a) for a in qkv],\n"
+        "                           causal=True)\n"
+        "np.testing.assert_allclose(np.asarray(full), np.asarray(want),\n"
+        "                           rtol=2e-3, atol=2e-3)\n"
+        "print('gang ok', distributed.process_index())\n"
+    )
+    _run_two_process_gang(worker)
