@@ -491,3 +491,36 @@ def test_flash_attention_gqa_matches_repeated_kv():
     k3 = jnp.concatenate([k, k[:, :1]], axis=1)  # 3 kv heads vs 8 q heads
     with pytest.raises(ValueError):
         flash_attention_gqa(q, k3, k3)
+
+
+def test_sliding_window_attention_matches_reference():
+    """window=W keeps only the last W keys per position — kernel
+    (block-skipping band) vs masked XLA reference, forward and grads,
+    including a window that crosses block boundaries."""
+    rng = jax.random.PRNGKey(12)
+    q, k, v = (
+        jax.random.normal(r, (1, 2, 512, 32), jnp.float32)
+        for r in jax.random.split(rng, 3)
+    )
+    for w in (128, 200):
+        got = flash_attention(q, k, v, causal=True, window=w)
+        want = reference_attention(q, k, v, causal=True, window=w)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-3, atol=2e-3,
+            err_msg=f"window={w}",
+        )
+    g = jax.grad(
+        lambda t: flash_attention(t, k, v, causal=True, window=200)
+        .astype(jnp.float32).mean()
+    )(q)
+    gw = jax.grad(
+        lambda t: reference_attention(t, k, v, causal=True, window=200)
+        .astype(jnp.float32).mean()
+    )(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gw), rtol=5e-3,
+                               atol=5e-3)
+    # window only narrows: with W >= S it equals plain causal
+    full = flash_attention(q, k, v, causal=True, window=512)
+    plain = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(plain),
+                               rtol=2e-3, atol=2e-3)

@@ -222,3 +222,25 @@ def test_rope_lm_decode_and_relative_property():
     params = model.init(jax.random.PRNGKey(0), prompt)["params"]
     assert "wpe" not in params  # no learned position table under RoPE
     assert_greedy_decode_matches(model, params, prompt, 5)
+
+
+def test_sliding_window_lm_decode_matches_full():
+    """attn_window LM: training forward masks beyond the window
+    (changing a token OUTSIDE every later position's window leaves those
+    logits unchanged), and greedy KV-cache decode stays token-exact."""
+    from vtpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab=64, d_model=32, depth=2, num_heads=4,
+                          max_seq=32, attn_window=4, pos_embedding="rope")
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    base = model.apply({"params": params}, prompt)
+    # token 0 is outside the 4-wide window of positions >= 5... but depth-2
+    # attention extends reach to 2*(W-1); positions >= 1 + 2*(4-1) = 7 are
+    # unaffected by token 0
+    mutated = prompt.at[:, 0].set((prompt[:, 0] + 1) % 64)
+    out = model.apply({"params": params}, mutated)
+    np.testing.assert_allclose(
+        np.asarray(base[:, 7:]), np.asarray(out[:, 7:]), rtol=1e-4, atol=1e-4
+    )
+    assert_greedy_decode_matches(model, params, prompt, 5)

@@ -69,6 +69,7 @@ class Attention(nn.Module):
     max_seq: int = 2048
     num_kv_heads: int = 0  # 0 ⇒ = num_heads (MHA); fewer = GQA, 1 = MQA
     use_rope: bool = False
+    window: int = 0  # > 0: sliding-window attention (last W keys only)
 
     @nn.compact
     def __call__(self, x, decode: bool = False, pos0=None):
@@ -121,6 +122,10 @@ class Attention(nn.Module):
             kpos = jnp.arange(self.max_seq)
             qpos = i0 + jnp.arange(s)
             mask = kpos[None, :] <= qpos[:, None]       # [s, max_seq]
+            if self.window > 0:
+                mask = jnp.logical_and(
+                    mask, kpos[None, :] > qpos[:, None] - self.window
+                )
             # grouped einsum: each kv head serves its group of q heads
             # directly from the SMALL cache — no head repetition
             g = self.num_heads // n_kv
@@ -134,11 +139,11 @@ class Attention(nn.Module):
                 "bngqk,bnkd->bngqd", probs, cv.value.astype(jnp.float32)
             ).astype(q.dtype).reshape(b, self.num_heads, s, hd)
         elif n_kv != self.num_heads:
-            o = flash_attention_gqa(q, k, v, causal=True)
+            o = flash_attention_gqa(q, k, v, causal=True, window=self.window)
         elif _on_tpu():
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, k, v, causal=True, window=self.window)
         else:
-            o = reference_attention(q, k, v, causal=True)
+            o = reference_attention(q, k, v, causal=True, window=self.window)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
         return nn.Dense(d, use_bias=False, name="out")(o)
 
@@ -149,12 +154,13 @@ class Block(nn.Module):
     max_seq: int = 2048
     num_kv_heads: int = 0
     use_rope: bool = False
+    window: int = 0
 
     @nn.compact
     def __call__(self, x, decode: bool = False, pos0=None):
         d = x.shape[-1]
         x = x + Attention(self.num_heads, self.max_seq, self.num_kv_heads,
-                          self.use_rope, name="attn")(
+                          self.use_rope, self.window, name="attn")(
             _LayerNorm(name="ln1")(x), decode=decode, pos0=pos0
         )
         h = nn.Dense(self.mlp_ratio * d, name="mlp_in")(_LayerNorm(name="ln2")(x))
@@ -174,6 +180,7 @@ class TransformerLM(nn.Module):
     max_seq: int = 2048
     num_kv_heads: int = 0  # 0 = MHA; fewer = GQA (smaller KV cache)
     pos_embedding: str = "learned"  # "learned" (wpe table) | "rope"
+    attn_window: int = 0  # > 0: sliding-window attention (Mistral-style)
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False):
@@ -205,7 +212,7 @@ class TransformerLM(nn.Module):
         for i in range(self.depth):
             x = Block(self.num_heads, max_seq=self.max_seq,
                       num_kv_heads=self.num_kv_heads, use_rope=use_rope,
-                      name=f"h{i}")(
+                      window=self.attn_window, name=f"h{i}")(
                 x, decode=decode, pos0=pos0
             )
         x = _LayerNorm(name="ln_f")(x)

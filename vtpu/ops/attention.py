@@ -17,12 +17,17 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _causal_mask(s, q_start, k_start):
-    """Mask scores s: [bq, bk] so position q attends only to k <= q."""
+def _causal_mask(s, q_start, k_start, window: int = 0):
+    """Mask scores s: [bq, bk] so position q attends only to k <= q —
+    and, with ``window`` > 0, only to k > q − window (sliding-window
+    attention: O(S·W) work instead of O(S²))."""
     bq, bk = s.shape
     q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+    keep = q_pos >= k_pos
+    if window > 0:
+        keep = jnp.logical_and(keep, k_pos > q_pos - window)
+    return jnp.where(keep, s, NEG_INF)
 
 
 def _causal_hi(q_idx, block_q, block_k, n_blocks):
@@ -31,8 +36,18 @@ def _causal_hi(q_idx, block_q, block_k, n_blocks):
     return jnp.minimum(n_blocks, ((q_idx + 1) * block_q + block_k - 1) // block_k)
 
 
+def _window_lo(q_idx, block_q, block_k, window: int):
+    """First kv block that can still be inside the window for q block
+    q_idx — earlier blocks are fully below q − window and skippable."""
+    if window <= 0:
+        return 0
+    earliest_k = q_idx * block_q - window + 1
+    return jnp.maximum(0, earliest_k // block_k)
+
+
 def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
-                 causal: bool, sm_scale: float, shift: int = 0):
+                 causal: bool, sm_scale: float, shift: int = 0,
+                 window: int = 0):
     # q_ref: [block_q, d]; k_ref/v_ref: [S, d]; grid dim 0 walks q blocks.
     # Also emits the per-row logsumexp (lse) the backward kernels need to
     # rematerialize p without a second online-softmax pass.
@@ -50,7 +65,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
             # shift=-1 is the STRICT mask (k < q) striped ring attention
             # needs for later-shard pairs; rows with no valid key
             # self-gate (lse → −inf → zero merge weight)
-            s = _causal_mask(s, q_idx * block_q + shift, start * block_k)
+            s = _causal_mask(s, q_idx * block_q + shift, start * block_k,
+                             window)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
@@ -65,7 +81,8 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
     n_blocks = seq_len // block_k
     # kv blocks fully above the diagonal contribute nothing — skip them
     hi = _causal_hi(q_idx, block_q, block_k, n_blocks) if causal else n_blocks
-    acc, m, l = jax.lax.fori_loop(0, hi, body, (acc0, m0, l0))
+    lo = _window_lo(q_idx, block_q, block_k, window) if causal else 0
+    acc, m, l = jax.lax.fori_loop(lo, hi, body, (acc0, m0, l0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[:] = m + jnp.log(l_safe)
@@ -73,7 +90,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
 def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         dq_ref, *, block_k: int, causal: bool,
-                        sm_scale: float, shift: int = 0):
+                        sm_scale: float, shift: int = 0, window: int = 0):
     """dq for one q block: recompute p from (scores − lse), accumulate
     ds @ k over kv blocks.  delta = rowsum(do * o), precomputed."""
     q = q_ref[:].astype(jnp.float32)
@@ -89,7 +106,8 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[pl.ds(start * block_k, block_k), :].astype(jnp.float32)
         s = (q @ k.T) * sm_scale
         if causal:
-            s = _causal_mask(s, q_idx * block_q + shift, start * block_k)
+            s = _causal_mask(s, q_idx * block_q + shift, start * block_k,
+                             window)
         p = jnp.exp(s - lse)
         if causal:
             # a FULLY-masked row's own lse is ~NEG_INF, so exp(s − lse)
@@ -104,13 +122,14 @@ def _attn_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     n_blocks = seq_len // block_k
     # kv blocks above the diagonal are all-zero after the mask — skip
     hi = _causal_hi(q_idx, block_q, block_k, n_blocks) if causal else n_blocks
-    dq = jax.lax.fori_loop(0, hi, body, dq0)
+    lo = _window_lo(q_idx, block_q, block_k, window) if causal else 0
+    dq = jax.lax.fori_loop(lo, hi, body, dq0)
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
 def _attn_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                          dk_ref, dv_ref, *, block_q: int, causal: bool,
-                         sm_scale: float, shift: int = 0):
+                         sm_scale: float, shift: int = 0, window: int = 0):
     """dk/dv for one kv block: loop over q blocks, transposed products."""
     k = k_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
@@ -126,7 +145,8 @@ def _attn_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[pl.ds(start * block_q, block_q), :].astype(jnp.float32)
         s = (q @ k.T) * sm_scale
         if causal:
-            s = _causal_mask(s, start * block_q + shift, k_idx * block_k)
+            s = _causal_mask(s, start * block_q + shift, k_idx * block_k,
+                             window)
         p = jnp.exp(s - lse)
         if causal:
             # see _attn_bwd_dq_kernel: masked rows must not rematerialize
@@ -141,7 +161,16 @@ def _attn_bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
     # q blocks entirely left of the diagonal see only masked-out scores
     # for this kv block — start at the first block that can attend here
     lo = (k_idx * block_k) // block_q if causal else 0
-    dk, dv = jax.lax.fori_loop(lo, seq_len // block_q, body, (z, z))
+    # window upper bound: q blocks beyond kv_end + window - 1 see only
+    # out-of-window scores for this kv block
+    if causal and window > 0:
+        hi_q = jnp.minimum(
+            seq_len // block_q,
+            ((k_idx + 1) * block_k - 1 + window) // block_q + 1,
+        )
+    else:
+        hi_q = seq_len // block_q
+    dk, dv = jax.lax.fori_loop(lo, hi_q, body, (z, z))
     dk_ref[:] = dk.astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
@@ -153,23 +182,31 @@ def _on_tpu() -> bool:
         return False
 
 
-def apply_causal_mask(s, shift: int = 0):
+def apply_causal_mask(s, shift: int = 0, window: int = 0):
     """Triangular mask on a [..., q, k] score tensor (the single place
-    the mask idiom lives — sliding-window/bias variants extend here).
-    ``shift`` moves the diagonal: 0 keeps k <= q, −1 is the STRICT mask
-    (k < q) striped ring attention uses for later-shard pairs.  Rows
-    with no valid key become all-NEG_INF; callers that merge partials
-    rely on the resulting −inf row max to zero their weight."""
-    mask = jnp.tril(jnp.ones(s.shape[-2:], bool), k=shift)
+    the mask idiom lives).  ``shift`` moves the diagonal: 0 keeps
+    k <= q, −1 is the STRICT mask (k < q) striped ring attention uses
+    for later-shard pairs.  ``window`` > 0 additionally keeps only
+    k > q − window (sliding-window attention); window and shift are not
+    combined by any caller.  Rows with no valid key become all-NEG_INF;
+    callers that merge partials rely on the resulting −inf row max to
+    zero their weight."""
+    nq, nk = s.shape[-2:]
+    mask = jnp.tril(jnp.ones((nq, nk), bool), k=shift)
+    if window > 0:
+        mask = mask & jnp.triu(jnp.ones((nq, nk), bool), k=-(window - 1))
     return jnp.where(mask, s, NEG_INF)
 
 
-def reference_attention(q, k, v, causal: bool = False, *, shift: int = 0):
+def reference_attention(q, k, v, causal: bool = False, *, shift: int = 0,
+                        window: int = 0):
     """Plain XLA attention (correctness oracle + fallback)."""
+    if window > 0 and not causal:
+        raise ValueError("window > 0 requires causal=True")
     sm_scale = q.shape[-1] ** -0.5
     s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * sm_scale
     if causal:
-        s = apply_causal_mask(s, shift)
+        s = apply_causal_mask(s, shift, window)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
 
@@ -231,21 +268,27 @@ def _kernel_ok(q, k, block_q, block_k) -> bool:
     return not (q.shape[-2] % block_q or k.shape[-2] % block_k)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128):
+                    block_k: int = 128, window: int = 0):
     """q,k,v: [batch, heads, seq, d] (or [seq, d]).  Static shapes only.
 
     Fully fused autodiff: the forward is the Pallas online-softmax
     kernel (emitting per-row logsumexp), and the backward is a pair of
     Pallas kernels (dq; dk+dv) that rematerialize p blockwise from the
     saved lse — the [S,S] score matrix never hits HBM in either
-    direction.  Ragged shapes fall back to the XLA reference both ways."""
-    return _flash_impl(q, k, v, causal, block_q, block_k)[0]
+    direction.  Ragged shapes fall back to the XLA reference both ways.
+
+    ``window`` > 0 (requires ``causal``) is SLIDING-WINDOW attention:
+    each position attends its last ``window`` keys only; the kernels
+    skip kv blocks outside the band, so work is O(S·W) not O(S²)."""
+    if window > 0 and not causal:
+        raise ValueError("window > 0 requires causal=True")
+    return _flash_impl(q, k, v, causal, block_q, block_k, None, 0, window)[0]
 
 
 def flash_attention_gqa(q, k, v, causal: bool = False,
-                        use_kernel: bool | None = None):
+                        use_kernel: bool | None = None, window: int = 0):
     """Grouped-query attention: q [b, Hq, s, d] with k/v [b, Hkv, s, d],
     Hkv dividing Hq (MQA is Hkv=1).  Each group of Hq/Hkv query heads
     shares one KV head — the KV cache shrinks by the group factor, the
@@ -255,10 +298,12 @@ def flash_attention_gqa(q, k, v, causal: bool = False,
     matching the MHA path's platform fallback."""
     b, hq, s, d = q.shape
     hk = k.shape[1]
+    if window > 0 and not causal:
+        raise ValueError("window > 0 requires causal=True")
     if use_kernel is None:
         use_kernel = _on_tpu()
     if hq == hk:
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, window=window)
     if hq % hk:
         raise ValueError(f"q heads ({hq}) must divide by kv heads ({hk})")
     g = hq // hk
@@ -269,13 +314,13 @@ def flash_attention_gqa(q, k, v, causal: bool = False,
         sm = d ** -0.5
         sc = jnp.einsum("bngqd,bnkd->bngqk", qg, k).astype(jnp.float32) * sm
         if causal:
-            sc = apply_causal_mask(sc)
+            sc = apply_causal_mask(sc, 0, window)
         p = jax.nn.softmax(sc, axis=-1)
         o = jnp.einsum("bngqk,bnkd->bngqd", p, v.astype(jnp.float32))
         return o.astype(q.dtype).reshape(b, hq, s, d)
 
     def one(qq, kk, vv):  # [s, d] each
-        return flash_attention(qq, kk, vv, causal=causal)
+        return flash_attention(qq, kk, vv, causal=causal, window=window)
 
     per_group = jax.vmap(one, in_axes=(0, None, None))   # group dim
     per_kv = jax.vmap(per_group, in_axes=(0, 0, 0))      # kv-head dim
@@ -284,19 +329,23 @@ def flash_attention_gqa(q, k, v, causal: bool = False,
     return o.reshape(b, hq, s, d)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k):
-    o, lse = _flash_impl(q, k, v, causal, block_q, block_k)
+def _flash_fwd(q, k, v, causal, block_q, block_k, window):
+    o, lse = _flash_impl(q, k, v, causal, block_q, block_k, None, 0, window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, res, ct):
+def _flash_bwd(causal, block_q, block_k, window, res, ct):
     q, k, v, o, lse = res
     if not _kernel_ok(q, k, block_q, block_k):
         _, vjp = jax.vjp(
-            lambda a, b, c: reference_attention(a, b, c, causal), q, k, v
+            lambda a, b, c: reference_attention(
+                a, b, c, causal, window=window
+            ),
+            q, k, v,
         )
         return vjp(ct)
-    return _flash_bwd_impl(q, k, v, o, lse, ct, causal, block_q, block_k)
+    return _flash_bwd_impl(q, k, v, o, lse, ct, causal, block_q, block_k,
+                           0, window)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -314,49 +363,53 @@ def _map_batched(fn, *arrays, out_rank=2):
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k", "out_dtype", "shift"),
+    static_argnames=("causal", "block_q", "block_k", "out_dtype", "shift",
+                     "window"),
 )
 def _flash_impl(q, k, v, causal: bool = False, block_q: int = 128,
-                block_k: int = 128, out_dtype=None, shift: int = 0):
+                block_k: int = 128, out_dtype=None, shift: int = 0,
+                window: int = 0):
     if q.ndim == 2:
-        return _flash_2d(q, k, v, causal, block_q, block_k, out_dtype, shift)
+        return _flash_2d(q, k, v, causal, block_q, block_k, out_dtype, shift,
+                         window)
     return _map_batched(
         lambda a, b, c: _flash_2d(
-            a, b, c, causal, block_q, block_k, out_dtype, shift
+            a, b, c, causal, block_q, block_k, out_dtype, shift, window
         ),
         q, k, v,
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "shift")
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "shift", "window"),
 )
 def _flash_bwd_impl(q, k, v, o, lse, ct, causal, block_q, block_k,
-                    shift: int = 0):
+                    shift: int = 0, window: int = 0):
     if q.ndim == 2:
         return _flash_bwd_2d(q, k, v, o, lse, ct, causal, block_q, block_k,
-                             shift)
+                             shift, window)
     return _map_batched(
         lambda a, b, c, oo, ll, cc: _flash_bwd_2d(
-            a, b, c, oo, ll, cc, causal, block_q, block_k, shift
+            a, b, c, oo, ll, cc, causal, block_q, block_k, shift, window
         ),
         q, k, v, o, lse, ct,
     )
 
 
 def _flash_2d(q, k, v, causal, block_q, block_k, out_dtype=None,
-              shift: int = 0):
+              shift: int = 0, window: int = 0):
     seq_q, d = q.shape
     seq_k = k.shape[0]
     if seq_q % block_q or seq_k % block_k:
-        o = reference_attention(q, k, v, causal, shift=shift)
+        o = reference_attention(q, k, v, causal, shift=shift, window=window)
         # lse unused on this path (backward falls back too)
         return o.astype(out_dtype or q.dtype), jnp.zeros((seq_q, 1), jnp.float32)
     sm_scale = d**-0.5
     return pl.pallas_call(
         functools.partial(
             _attn_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale,
-            shift=shift,
+            shift=shift, window=window,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((seq_q, d), out_dtype or q.dtype),
@@ -377,7 +430,7 @@ def _flash_2d(q, k, v, causal, block_q, block_k, out_dtype=None,
 
 
 def _flash_bwd_2d(q, k, v, o, lse, do, causal, block_q, block_k,
-                  shift: int = 0):
+                  shift: int = 0, window: int = 0):
     seq_q, d = q.shape
     seq_k = k.shape[0]
     sm_scale = d**-0.5
@@ -388,7 +441,7 @@ def _flash_bwd_2d(q, k, v, o, lse, do, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(
             _attn_bwd_dq_kernel, block_k=block_k, causal=causal,
-            sm_scale=sm_scale, shift=shift,
+            sm_scale=sm_scale, shift=shift, window=window,
         ),
         out_shape=jax.ShapeDtypeStruct((seq_q, d), q.dtype),
         grid=(seq_q // block_q,),
@@ -406,7 +459,7 @@ def _flash_bwd_2d(q, k, v, o, lse, do, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(
             _attn_bwd_dkv_kernel, block_q=block_q, causal=causal,
-            sm_scale=sm_scale, shift=shift,
+            sm_scale=sm_scale, shift=shift, window=window,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((seq_k, d), k.dtype),
