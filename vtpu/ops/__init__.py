@@ -3,4 +3,9 @@ pallas_guide.md).  Each op has a pure-XLA fallback; kernels auto-switch to
 interpret mode off-TPU so the test suite runs on the CPU mesh."""
 
 from vtpu.ops.layernorm import fused_layernorm  # noqa: F401
-from vtpu.ops.attention import flash_attention  # noqa: F401
+from vtpu.ops.attention import (  # noqa: F401
+    flash_attention,
+    flash_attention_gqa,
+    flash_attention_with_lse,
+    reference_attention,
+)
