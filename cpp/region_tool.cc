@@ -80,8 +80,12 @@ static int cmd_dump(const char* path) {
   int first = 1;
   for (int p = 0; p < VTPU_MAX_PROCS; p++) {
     if (r->procs[p].status != 1) continue;
-    printf("%s{\"pid\":%d,\"priority\":%d,\"used\":[", first ? "" : ",",
-           r->procs[p].pid, r->procs[p].priority);
+    printf("%s{\"pid\":%d,\"hostpid\":%d,\"priority\":%d,"
+           "\"exec_calls\":%" PRIu64 ",\"exec_shim_ns\":%" PRIu64
+           ",\"used\":[",
+           first ? "" : ",", r->procs[p].pid, r->procs[p].hostpid,
+           r->procs[p].priority, r->procs[p].exec_calls,
+           r->procs[p].exec_shim_ns);
     for (int i = 0; i < r->num_devices; i++) {
       printf("%s{\"buffer\":%" PRIu64 ",\"program\":%" PRIu64
              ",\"swap\":%" PRIu64 ",\"total\":%" PRIu64 "}",
