@@ -225,3 +225,29 @@ def test_moe_top_k_validation():
             jnp.ones((n_exp, 4, 4)), jnp.ones((n_exp, 4, 4)),
             mesh, axis="ep", top_k=n_exp + 1,
         )
+
+
+def test_moe_local_matches_sharded():
+    """moe_ffn_local (no collectives) equals the 8-shard sharded path on
+    identical inputs when capacity is roomy — the routing/dispatch/
+    combine math is shared, so this pins the all-to-all plumbing."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from vtpu.parallel.moe import moe_ffn, moe_ffn_local
+
+    n = len(jax.devices())
+    d, h, n_exp, t = 16, 32, 8, 4 * n
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((t, d)), jnp.float32)
+    rw = jnp.asarray(rng.standard_normal((d, n_exp)), jnp.float32)
+    wi = jnp.asarray(rng.standard_normal((n_exp, d, h)) * 0.1, jnp.float32)
+    wo = jnp.asarray(rng.standard_normal((n_exp, h, d)) * 0.1, jnp.float32)
+    mesh = Mesh(np.array(jax.devices()), ("ep",))
+    cap = t * 2  # roomy: nothing drops, so local and sharded agree exactly
+    got_sharded = moe_ffn(x, rw, wi, wo, mesh, axis="ep", capacity=cap,
+                          top_k=2)
+    got_local = moe_ffn_local(x, rw, wi, wo, capacity=cap, top_k=2)
+    np.testing.assert_allclose(
+        np.asarray(got_sharded), np.asarray(got_local), rtol=2e-4, atol=2e-4
+    )

@@ -244,3 +244,38 @@ def test_sliding_window_lm_decode_matches_full():
         np.asarray(base[:, 7:]), np.asarray(out[:, 7:]), rtol=1e-4, atol=1e-4
     )
     assert_greedy_decode_matches(model, params, prompt, 5)
+
+
+def test_moe_lm_trains_and_decodes():
+    """mlp="moe": the LM carries routed expert FFNs (params present),
+    training reduces loss, and KV-cache decode stays token-exact."""
+    import optax
+
+    from vtpu.models.transformer import TransformerLM, lm_loss
+
+    model = TransformerLM(vocab=64, d_model=32, depth=2, num_heads=4,
+                          max_seq=32, mlp="moe", n_experts=4, moe_top_k=2)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    assert params["h0"]["moe"]["w_in"].shape == (4, 32, 128)
+    assert params["h0"]["moe"]["router"].shape == (32, 4)
+
+    opt = optax.adam(1e-3)
+    st = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(
+            lambda p_: lm_loss(model.apply({"params": p_}, tokens), tokens)
+        )(p)
+        up, s = opt.update(g, s)
+        return optax.apply_updates(p, up), s, loss
+
+    losses = []
+    p = params
+    for _ in range(8):
+        p, st, loss = step(p, st)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    assert_greedy_decode_matches(model, params, tokens[:, :5], 4)

@@ -148,6 +148,39 @@ class Attention(nn.Module):
         return nn.Dense(d, use_bias=False, name="out")(o)
 
 
+class MoeMlp(nn.Module):
+    """Mixture-of-experts FFN block: top-k routed, static capacity — the
+    same routing/dispatch math the ep-sharded path uses
+    (vtpu.parallel.moe; the two share _route/_dispatch/_combine), run
+    locally.  For expert-parallel meshes, tenants call
+    vtpu.parallel.moe_ffn with these params sharded P("ep")."""
+
+    n_experts: int
+    top_k: int = 2
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        from vtpu.parallel.moe import moe_ffn_local
+
+        b, s, d = x.shape
+        h = self.mlp_ratio * d
+        rw = self.param(
+            "router", nn.initializers.lecun_normal(), (d, self.n_experts)
+        )
+        wi = self.param(
+            "w_in", nn.initializers.lecun_normal(),
+            (self.n_experts, d, h),
+        )
+        wo = self.param(
+            "w_out", nn.initializers.lecun_normal(),
+            (self.n_experts, h, d),
+        )
+        out = moe_ffn_local(x.reshape(b * s, d), rw, wi, wo,
+                            top_k=self.top_k)
+        return out.reshape(b, s, d)
+
+
 class Block(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
@@ -155,6 +188,9 @@ class Block(nn.Module):
     num_kv_heads: int = 0
     use_rope: bool = False
     window: int = 0
+    mlp: str = "dense"  # "dense" | "moe"
+    n_experts: int = 8
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, x, decode: bool = False, pos0=None):
@@ -163,6 +199,10 @@ class Block(nn.Module):
                           self.use_rope, self.window, name="attn")(
             _LayerNorm(name="ln1")(x), decode=decode, pos0=pos0
         )
+        if self.mlp == "moe":
+            x = x + MoeMlp(self.n_experts, self.moe_top_k, self.mlp_ratio,
+                           name="moe")(_LayerNorm(name="ln2")(x))
+            return x
         h = nn.Dense(self.mlp_ratio * d, name="mlp_in")(_LayerNorm(name="ln2")(x))
         x = x + nn.Dense(d, name="mlp_out")(nn.gelu(h))
         return x
@@ -181,6 +221,9 @@ class TransformerLM(nn.Module):
     num_kv_heads: int = 0  # 0 = MHA; fewer = GQA (smaller KV cache)
     pos_embedding: str = "learned"  # "learned" (wpe table) | "rope"
     attn_window: int = 0  # > 0: sliding-window attention (Mistral-style)
+    mlp: str = "dense"  # "dense" | "moe" (top-k routed expert FFNs)
+    n_experts: int = 8
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False):
@@ -204,6 +247,10 @@ class TransformerLM(nn.Module):
                 f"pos_embedding must be 'learned' or 'rope', "
                 f"got {self.pos_embedding!r}"
             )
+        if self.mlp not in ("dense", "moe"):
+            raise ValueError(
+                f"mlp must be 'dense' or 'moe', got {self.mlp!r}"
+            )
         use_rope = self.pos_embedding == "rope"
         if not use_rope:
             x = x + nn.Embed(self.max_seq, self.d_model, name="wpe")(
@@ -212,7 +259,9 @@ class TransformerLM(nn.Module):
         for i in range(self.depth):
             x = Block(self.num_heads, max_seq=self.max_seq,
                       num_kv_heads=self.num_kv_heads, use_rope=use_rope,
-                      window=self.attn_window, name=f"h{i}")(
+                      window=self.attn_window, mlp=self.mlp,
+                      n_experts=self.n_experts, moe_top_k=self.moe_top_k,
+                      name=f"h{i}")(
                 x, decode=decode, pos0=pos0
             )
         x = _LayerNorm(name="ln_f")(x)

@@ -16,6 +16,73 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _route(x, router_w, top_k: int, renormalize: bool):
+    """Top-k routing: returns (slot expert ids [t*k], keep-eligible gate
+    weights [t, k]).  Shared by the sharded and local MoE paths so the
+    two cannot diverge."""
+    logits = x @ router_w
+    probs = jax.nn.softmax(logits, axis=-1)
+    _, expert = jax.lax.top_k(logits, top_k)
+    gate = jnp.take_along_axis(probs, expert, axis=1)
+    if renormalize:
+        gate = gate / jnp.maximum(jnp.sum(gate, axis=-1, keepdims=True), 1e-9)
+    return expert.reshape(-1), gate
+
+
+def _dispatch(x, ef, n_exp: int, capacity: int, top_k: int):
+    """Scatter token slots into the per-expert send buffer.  Returns
+    (send [n_exp, capacity, d], idx_e, idx_p, keep)."""
+    onehot = jax.nn.one_hot(ef, n_exp, dtype=jnp.int32)
+    pos = jnp.cumsum(onehot, axis=0) * onehot
+    pos = jnp.sum(pos, axis=-1) - 1
+    keep = pos < capacity
+    send = jnp.zeros((n_exp, capacity, x.shape[-1]), x.dtype)
+    idx_e = jnp.where(keep, ef, 0)
+    idx_p = jnp.where(keep, pos, 0)
+    xk = jnp.repeat(x, top_k, axis=0)
+    send = send.at[idx_e, idx_p].add(jnp.where(keep[:, None], xk, 0.0))
+    return send, idx_e, idx_p, keep
+
+
+def _combine(back, idx_e, idx_p, keep, gate, t: int, top_k: int, d: int):
+    """Gather each slot's expert output, gate it, sum a token's k slots."""
+    slots = back[idx_e, idx_p]
+    slots = jnp.where(keep[:, None], slots, 0.0)
+    slots = slots * gate.reshape(-1)[:, None]
+    return slots.reshape(t, top_k, d).sum(axis=1)
+
+
+def _check_moe_args(router_w, n_exp: int, top_k: int) -> None:
+    if router_w.shape[-1] != n_exp:
+        raise ValueError(
+            f"router_w maps to {router_w.shape[-1]} experts, "
+            f"weights have {n_exp}"
+        )
+    if not 1 <= top_k <= n_exp:
+        raise ValueError(f"top_k={top_k} out of range for {n_exp} experts")
+
+
+def moe_ffn_local(x, router_w, w_in, w_out, capacity: int = 0,
+                  top_k: int = 1, renormalize: bool = False):
+    """Single-shard MoE FFN — the same routing/capacity/combine math as
+    :func:`moe_ffn` with the all-to-alls gone (model-level MoE blocks on
+    one chip; the sharded path is for ep meshes).  x: [t, d].
+
+    capacity <= 0 defaults to LOSSLESS (t × top_k slots per expert —
+    nothing can drop, so outputs are independent of what else shares the
+    batch); pass an explicit capacity for capacity-factor semantics."""
+    t, d = x.shape
+    n_exp = w_in.shape[0]
+    _check_moe_args(router_w, n_exp, top_k)
+    if capacity <= 0:
+        capacity = t * top_k
+    ef, gate = _route(x, router_w, top_k, renormalize)
+    send, idx_e, idx_p, keep = _dispatch(x, ef, n_exp, capacity, top_k)
+    h = jax.nn.relu(jnp.einsum("etd,edh->eth", send, w_in))
+    back = jnp.einsum("eth,ehd->etd", h, w_out)
+    return _combine(back, idx_e, idx_p, keep, gate, t, top_k, d)
+
+
 def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
             capacity: int = 0, top_k: int = 1, renormalize: bool = False):
     """x: [batch_shard_tokens, d] sharded on ``axis``.  router_w:
@@ -35,12 +102,7 @@ def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
             f"n_experts={n_exp} not divisible by mesh axis "
             f"'{axis}' size {n_shards}"
         )
-    if router_w.shape[-1] != n_exp:
-        raise ValueError(
-            f"router_w maps to {router_w.shape[-1]} experts, weights have {n_exp}"
-        )
-    if not 1 <= top_k <= n_exp:
-        raise ValueError(f"top_k={top_k} out of range for {n_exp} experts")
+    _check_moe_args(router_w, n_exp, top_k)
     e_local = n_exp // n_shards
     if capacity <= 0:
         # per-SOURCE-shard per-expert slots: x.shape[0] is the global
@@ -54,30 +116,10 @@ def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
     def shard_fn(x_s, rw, wi, wo):
         # local expert weights: [e_local, d, h] / [e_local, h, d]
         t, d = x_s.shape
-        # route: top-k experts per token (global expert ids)
-        logits = x_s @ rw                              # [t, n_exp]
-        probs = jax.nn.softmax(logits, axis=-1)
-        _, expert = jax.lax.top_k(logits, top_k)       # [t, k]
-        gate = jnp.take_along_axis(probs, expert, axis=1)  # [t, k]
-        if renormalize:
-            gate = gate / jnp.maximum(
-                jnp.sum(gate, axis=-1, keepdims=True), 1e-9
-            )
-        # one dispatch slot per (token, k); token order preserved so the
-        # capacity cumsum stays deterministic
-        ef = expert.reshape(-1)                        # [t*k]
-        onehot = jax.nn.one_hot(ef, n_exp, dtype=jnp.int32)  # [t*k, e]
-        pos = jnp.cumsum(onehot, axis=0) * onehot
-        pos = jnp.sum(pos, axis=-1) - 1                # [t*k], 0-based
-        keep = pos < capacity
-        # scatter slots into [n_exp, capacity, d] send buffer
-        send = jnp.zeros((n_exp, capacity, d), x_s.dtype)
-        idx_e = jnp.where(keep, ef, 0)
-        idx_p = jnp.where(keep, pos, 0)
-        xk = jnp.repeat(x_s, top_k, axis=0)            # slot → its token
-        send = send.at[idx_e, idx_p].add(
-            jnp.where(keep[:, None], xk, 0.0)
-        )
+        # route + dispatch (shared with moe_ffn_local; slot order is
+        # token order so the capacity cumsum stays deterministic)
+        ef, gate = _route(x_s, rw, top_k, renormalize)
+        send, idx_e, idx_p, keep = _dispatch(x_s, ef, n_exp, capacity, top_k)
         # group the contiguous e_local experts of each destination shard,
         # then all-to-all: recv[s] = this shard's expert block from source s
         send = send.reshape(n_shards, e_local * capacity, d)
@@ -94,11 +136,7 @@ def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
         back = jax.lax.all_to_all(y, axis, split_axis=0, concat_axis=0,
                                   tiled=True)
         back = back.reshape(n_exp, capacity, d)
-        # gather each slot's result, weight by its gate, sum a token's k
-        slots = back[idx_e, idx_p]                     # [t*k, d]
-        slots = jnp.where(keep[:, None], slots, 0.0)
-        slots = slots * gate.reshape(-1)[:, None]
-        return slots.reshape(t, top_k, d).sum(axis=1)
+        return _combine(back, idx_e, idx_p, keep, gate, t, top_k, d)
 
     return jax.shard_map(
         shard_fn,
