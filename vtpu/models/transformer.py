@@ -153,31 +153,50 @@ class MoeMlp(nn.Module):
     same routing/dispatch math the ep-sharded path uses
     (vtpu.parallel.moe; the two share _route/_dispatch/_combine), run
     locally.  For expert-parallel meshes, tenants call
-    vtpu.parallel.moe_ffn with these params sharded P("ep")."""
+    vtpu.parallel.moe_ffn with these params sharded P("ep").
+
+    ``capacity`` 0 = LOSSLESS (decode-exact, but every expert allocates
+    t×top_k slots — fine for serving-sized t, heavy for big training
+    batches); trainers should pass a finite capacity (e.g.
+    2·top_k·t/n_experts) and pay the standard drop semantics."""
 
     n_experts: int
     top_k: int = 2
     mlp_ratio: int = 4
+    capacity: int = 0
 
     @nn.compact
     def __call__(self, x):
-        from vtpu.parallel.moe import moe_ffn_local
+        from vtpu.parallel.moe import load_balance_loss, moe_ffn_local
 
         b, s, d = x.shape
         h = self.mlp_ratio * d
+        # batch_axis=0: the expert dim is a BATCH of independent FFNs —
+        # fan-in must be d, not n_experts×d (default variance scaling
+        # would shrink every expert by sqrt(n_experts))
         rw = self.param(
             "router", nn.initializers.lecun_normal(), (d, self.n_experts)
         )
         wi = self.param(
-            "w_in", nn.initializers.lecun_normal(),
+            "w_in", nn.initializers.lecun_normal(batch_axis=0),
             (self.n_experts, d, h),
         )
         wo = self.param(
-            "w_out", nn.initializers.lecun_normal(),
+            "w_out", nn.initializers.lecun_normal(batch_axis=0),
             (self.n_experts, h, d),
         )
-        out = moe_ffn_local(x.reshape(b * s, d), rw, wi, wo,
-                            top_k=self.top_k)
+        flat = x.reshape(b * s, d)
+        # gelu matches the dense Block path — a dense-vs-moe ablation
+        # must not silently change the activation
+        out, (logits, ef) = moe_ffn_local(
+            flat, rw, wi, wo, capacity=self.capacity, top_k=self.top_k,
+            act=nn.gelu, return_aux=True,
+        )
+        # sow the Switch load-balance aux loss for the trainer to read
+        # out of intermediates (scaled there, typically 1e-2):
+        # mutable=["intermediates"] on apply surfaces it
+        self.sow("intermediates", "load_balance_loss",
+                 load_balance_loss(logits, ef, self.n_experts))
         return out.reshape(b, s, d)
 
 

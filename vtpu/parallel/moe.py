@@ -52,6 +52,20 @@ def _combine(back, idx_e, idx_p, keep, gate, t: int, top_k: int, d: int):
     return slots.reshape(t, top_k, d).sum(axis=1)
 
 
+def load_balance_loss(router_logits, expert_ids, n_exp: int):
+    """Switch-style auxiliary loss: n_exp × Σ_e f_e · P_e, where f_e is
+    the fraction of slot assignments to expert e and P_e the mean router
+    probability — minimized when routing is uniform.  Add it (scaled,
+    typically 1e-2) to the task loss when training MoE models; without
+    it routers collapse onto few experts and capacity drops explode."""
+    probs = jax.nn.softmax(router_logits, axis=-1)        # [t, e]
+    p_mean = probs.mean(axis=0)                           # [e]
+    assign = jax.nn.one_hot(expert_ids, n_exp).mean(axis=0)
+    if assign.ndim > 1:                                   # [t*k, e] → [e]
+        assign = assign.mean(axis=0)
+    return n_exp * jnp.sum(assign * p_mean)
+
+
 def _check_moe_args(router_w, n_exp: int, top_k: int) -> None:
     if router_w.shape[-1] != n_exp:
         raise ValueError(
@@ -63,7 +77,8 @@ def _check_moe_args(router_w, n_exp: int, top_k: int) -> None:
 
 
 def moe_ffn_local(x, router_w, w_in, w_out, capacity: int = 0,
-                  top_k: int = 1, renormalize: bool = False):
+                  top_k: int = 1, renormalize: bool = False,
+                  act=jax.nn.relu, return_aux: bool = False):
     """Single-shard MoE FFN — the same routing/capacity/combine math as
     :func:`moe_ffn` with the all-to-alls gone (model-level MoE blocks on
     one chip; the sharded path is for ep meshes).  x: [t, d].
@@ -78,13 +93,19 @@ def moe_ffn_local(x, router_w, w_in, w_out, capacity: int = 0,
         capacity = t * top_k
     ef, gate = _route(x, router_w, top_k, renormalize)
     send, idx_e, idx_p, keep = _dispatch(x, ef, n_exp, capacity, top_k)
-    h = jax.nn.relu(jnp.einsum("etd,edh->eth", send, w_in))
+    h = act(jnp.einsum("etd,edh->eth", send, w_in))
     back = jnp.einsum("eth,ehd->etd", h, w_out)
-    return _combine(back, idx_e, idx_p, keep, gate, t, top_k, d)
+    out = _combine(back, idx_e, idx_p, keep, gate, t, top_k, d)
+    if return_aux:
+        # the EXACT routing used above — callers computing aux losses
+        # must not re-derive it (they would desynchronize)
+        return out, (x @ router_w, ef)
+    return out
 
 
 def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
-            capacity: int = 0, top_k: int = 1, renormalize: bool = False):
+            capacity: int = 0, top_k: int = 1, renormalize: bool = False,
+            act=jax.nn.relu):
     """x: [batch_shard_tokens, d] sharded on ``axis``.  router_w:
     [d, n_experts]; w_in: [n_experts, d, h]; w_out: [n_experts, h, d]
     (expert dims sharded on ``axis``).  ``n_experts`` must be a multiple
@@ -128,7 +149,7 @@ def moe_ffn(x, router_w, w_in, w_out, mesh: Mesh, axis: str = "ep",
         # dense expert FFNs on the MXU: batch over the local expert dim
         recv = recv.reshape(n_shards, e_local, capacity, d)
         recv = recv.transpose(1, 0, 2, 3).reshape(e_local, -1, d)
-        h = jax.nn.relu(jnp.einsum("ltd,ldh->lth", recv, wi))
+        h = act(jnp.einsum("ltd,ldh->lth", recv, wi))
         y = jnp.einsum("lth,lhd->ltd", h, wo)          # [e_local, n_src*cap, d]
         # route results back (inverse of the forward grouping)
         y = y.reshape(e_local, n_shards, capacity, d).transpose(1, 0, 2, 3)
