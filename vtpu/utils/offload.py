@@ -1,0 +1,77 @@
+"""Host-memory offload for big training state (the tenant-side
+complement of the shim's swap tier).
+
+The enforcement layer's oversubscribe path moves OVER-QUOTA allocations
+to pinned_host behind the tenant's back; these helpers are the
+cooperative version — a tenant deliberately parks cold state (optimizer
+moments, frozen weights) in the chip's pinned_host memory space and
+streams it in per step, trading HBM for PCIe/DMA bandwidth.  Classic
+use: Adam moments live on host (2× params saved), the update step
+consumes and re-produces them host-resident via out_shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+
+def host_sharding(dev_index: int = 0) -> Optional[jax.sharding.Sharding]:
+    """The device's pinned_host single-device sharding, or None when the
+    platform exposes no host memory space (plain CPU runs)."""
+    try:
+        device = jax.local_devices()[dev_index]
+    except (IndexError, RuntimeError):
+        return None
+    # the CPU backend lists a pinned_host space but cannot execute
+    # device-placement annotations under jit — only accelerators have a
+    # real two-tier memory
+    if device.platform not in ("tpu", "gpu"):
+        return None
+    try:
+        for mem in device.addressable_memories():
+            if mem.kind == "pinned_host":
+                return jax.sharding.SingleDeviceSharding(
+                    device, memory_kind=mem.kind
+                )
+    except Exception:  # noqa: BLE001 — memories API varies by backend
+        return None
+    return None
+
+
+def offload_to_host(tree: Any, dev_index: int = 0) -> Any:
+    """Move every array in ``tree`` to the pinned_host tier.  No-op
+    (returns the tree unchanged) when the platform has no host space —
+    callers stay portable across cpu tests and real chips."""
+    sh = host_sharding(dev_index)
+    if sh is None:
+        return tree
+    return jax.tree.map(lambda a: jax.device_put(a, sh), tree)
+
+
+def to_device(tree: Any, dev_index: int = 0) -> Any:
+    """Stream a (possibly host-resident) tree back to the chip's default
+    memory.  Inside a jitted step XLA overlaps the transfer with
+    compute."""
+    try:
+        device = jax.local_devices()[dev_index]
+    except (IndexError, RuntimeError):
+        return tree
+    sharding = jax.sharding.SingleDeviceSharding(device)
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
+
+
+def host_out_shardings(tree: Any, dev_index: int = 0):
+    """out_shardings pytree pinning a jitted function's outputs to the
+    host tier — the pattern that keeps UPDATED optimizer state
+    host-resident instead of bouncing through HBM:
+
+        step = jax.jit(update_fn,
+                       out_shardings=(None, host_out_shardings(opt_state)))
+
+    Returns None (jit's 'let XLA decide') when no host space exists."""
+    sh = host_sharding(dev_index)
+    if sh is None:
+        return None
+    return jax.tree.map(lambda _: sh, tree)
