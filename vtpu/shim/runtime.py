@@ -30,14 +30,11 @@ def stream_to_device(tree, dev: int = 0):
     """Bring swap-tier (host-memory-space) arrays back to the chip's
     default memory — the explicit stream-in of the host-offload pattern.
     Call it on offloaded params inside the jitted step; XLA overlaps the
-    transfer with compute.  No-op for arrays already on device."""
-    import jax
+    transfer with compute.  No-op for arrays already on device.
+    (Canonical implementation: vtpu.utils.offload.to_device.)"""
+    from vtpu.utils.offload import to_device
 
-    try:
-        sharding = jax.sharding.SingleDeviceSharding(jax.local_devices()[dev])
-    except (IndexError, RuntimeError):
-        return tree
-    return jax.tree.map(lambda a: jax.device_put(a, sharding), tree)
+    return to_device(tree, dev)
 
 
 def _oom_reject(runtime: "ShimRuntime", msg: str) -> "QuotaExceeded":
@@ -260,20 +257,15 @@ class ShimRuntime:
     def _host_tier_target(dev: int):
         """Where swap-tier arrays live: the accelerator's own pinned_host
         memory space when the platform exposes one (DMA-able — the same
-        target the native shim uses), else the cpu backend."""
+        target the native shim uses), else the cpu backend.  The
+        discovery lives in vtpu.utils.offload.host_sharding (one copy)."""
         import jax
 
-        try:
-            device = jax.local_devices()[dev]
-            for mem in device.addressable_memories():
-                # exactly pinned_host — unpinned_host is pageable and
-                # would stage every stream-back transfer
-                if mem.kind == "pinned_host":
-                    return jax.sharding.SingleDeviceSharding(
-                        device, memory_kind=mem.kind
-                    )
-        except Exception:  # noqa: BLE001 — cpu-only platforms have no memories API
-            pass
+        from vtpu.utils.offload import host_sharding
+
+        sh = host_sharding(dev)
+        if sh is not None:
+            return sh
         return jax.devices("cpu")[0]
 
     def _record_placement(self, out, dev: int, nbytes: int, tier: str) -> None:

@@ -16,6 +16,9 @@ from typing import Any, Optional
 
 import jax
 
+# the ONE stream-in primitive (shared with the cooperative shim runtime,
+# which re-exports it as vtpu.shim.stream_to_device)
+
 
 def host_sharding(dev_index: int = 0) -> Optional[jax.sharding.Sharding]:
     """The device's pinned_host single-device sharding, or None when the
@@ -53,7 +56,8 @@ def offload_to_host(tree: Any, dev_index: int = 0) -> Any:
 def to_device(tree: Any, dev_index: int = 0) -> Any:
     """Stream a (possibly host-resident) tree back to the chip's default
     memory.  Inside a jitted step XLA overlaps the transfer with
-    compute."""
+    compute.  (Same primitive as vtpu.shim.stream_to_device — one
+    implementation, imported there.)"""
     try:
         device = jax.local_devices()[dev_index]
     except (IndexError, RuntimeError):
