@@ -308,3 +308,21 @@ def test_moe_load_balance_loss_surfaces():
     losses = jax.tree.leaves(inter["intermediates"])
     assert len(losses) == 2  # one per MoE block
     assert all(float(v) > 0 for v in losses)
+
+
+def test_chunked_prefill_matches_one_shot():
+    """Chunked prefill (incl. a ragged tail chunk) produces the same
+    cache state and therefore the same greedy tokens as one-shot
+    prefill, across RoPE + GQA + window configs."""
+    from vtpu.models.transformer import TransformerLM, generate
+
+    model = TransformerLM(vocab=64, d_model=32, depth=2, num_heads=8,
+                          num_kv_heads=2, max_seq=64, pos_embedding="rope",
+                          attn_window=8)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 13), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    want = generate(model, params, prompt, num_new=6)
+    for chunk in (4, 5, 13):
+        got = generate(model, params, prompt, num_new=6,
+                       prefill_chunk=chunk)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -289,7 +289,8 @@ class TransformerLM(nn.Module):
 
 
 def generate(model: TransformerLM, params, prompt, num_new: int,
-             temperature: float = 0.0, rng=None):
+             temperature: float = 0.0, rng=None,
+             prefill_chunk: int = 0):
     """Autoregressive serving: prefill the KV cache with ``prompt``
     [b, s], then decode ``num_new`` tokens with one length-1 step each —
     the whole loop is one compiled program (lax.scan, static shapes,
@@ -321,10 +322,25 @@ def generate(model: TransformerLM, params, prompt, num_new: int,
             key, logits_last / temperature, axis=-1
         ).astype(jnp.int32)
 
-    logits, mut = model.apply(
-        {"params": params, "cache": cache}, prompt, decode=True,
-        mutable=["cache"],
-    )
+    if prefill_chunk > 0:
+        # long prompts: feed the cache in chunks so prefill activation
+        # memory is O(chunk), not O(prompt) — the decode path advances
+        # its position counter by each chunk's length, so this is
+        # exactly equivalent to one-shot prefill
+        s = prompt.shape[1]
+        mut = {"cache": cache}
+        logits = None
+        for lo in range(0, s, prefill_chunk):
+            logits, mut = model.apply(
+                {"params": params, "cache": mut["cache"]},
+                prompt[:, lo:lo + prefill_chunk], decode=True,
+                mutable=["cache"],
+            )
+    else:
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, prompt, decode=True,
+            mutable=["cache"],
+        )
     key0 = rng if rng is not None else jax.random.PRNGKey(0)
     keys = jax.random.split(key0, num_new)
     tok = pick(logits[:, -1], keys[0])
