@@ -326,3 +326,28 @@ def test_chunked_prefill_matches_one_shot():
         got = generate(model, params, prompt, num_new=6,
                        prefill_chunk=chunk)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_generate_topk_and_eos():
+    """top_k restricts sampling to the k best tokens; eos_id freezes a
+    finished row for the rest of the scan."""
+    from vtpu.models.transformer import TransformerLM, generate
+
+    model = TransformerLM(vocab=32, d_model=32, depth=1, num_heads=4,
+                          max_seq=32)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0, 32)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+    # top_k=1 sampling == greedy, regardless of temperature/rng
+    greedy = generate(model, params, prompt, num_new=6)
+    top1 = generate(model, params, prompt, num_new=6, temperature=1.7,
+                    rng=jax.random.PRNGKey(5), top_k=1)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(top1))
+
+    # pick the greedy first token as the "eos": rows must emit it and
+    # then repeat it to the end
+    eos = int(np.asarray(greedy)[0, 0])
+    out = generate(model, params, prompt, num_new=6, eos_id=eos)
+    row = np.asarray(out)[0]
+    first = int(np.argmax(row == eos))
+    assert (row[first:] == eos).all()

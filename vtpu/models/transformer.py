@@ -290,12 +290,17 @@ class TransformerLM(nn.Module):
 
 def generate(model: TransformerLM, params, prompt, num_new: int,
              temperature: float = 0.0, rng=None,
-             prefill_chunk: int = 0):
+             prefill_chunk: int = 0, top_k: int = 0,
+             eos_id: int | None = None):
     """Autoregressive serving: prefill the KV cache with ``prompt``
     [b, s], then decode ``num_new`` tokens with one length-1 step each —
     the whole loop is one compiled program (lax.scan, static shapes,
     cache updated in place via flax's mutable "cache" collection).
-    temperature 0 = greedy; otherwise softmax sampling with ``rng``.
+    temperature 0 = greedy; otherwise softmax sampling with ``rng``,
+    restricted to the ``top_k`` highest-probability tokens when set.
+    ``eos_id``: once a row samples it, the row FREEZES — every later
+    position repeats eos (static shapes forbid a ragged stop, so the
+    scan keeps running but the finished row's tokens stop changing).
     Returns [b, num_new] int32."""
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")
@@ -318,9 +323,14 @@ def generate(model: TransformerLM, params, prompt, num_new: int,
     def pick(logits_last, key):
         if temperature <= 0:
             return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits_last / temperature, axis=-1
-        ).astype(jnp.int32)
+        scaled = logits_last / temperature
+        if top_k > 0:
+            # lax.top_k: O(V log k) per step, not a full-vocab sort;
+            # clamp so top_k >= vocab degrades to plain sampling
+            kk = min(top_k, scaled.shape[-1])
+            kth = jax.lax.top_k(scaled, kk)[0][:, -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
     if prefill_chunk > 0:
         # long prompts: feed the cache in chunks so prefill activation
@@ -344,18 +354,25 @@ def generate(model: TransformerLM, params, prompt, num_new: int,
     key0 = rng if rng is not None else jax.random.PRNGKey(0)
     keys = jax.random.split(key0, num_new)
     tok = pick(logits[:, -1], keys[0])
+    done = (
+        tok == eos_id if eos_id is not None
+        else jnp.zeros(tok.shape, bool)
+    )
 
     def step(carry, key):
-        cache, tok = carry
+        cache, tok, done = carry
         logits, mut = model.apply(
             {"params": params, "cache": cache}, tok[:, None], decode=True,
             mutable=["cache"],
         )
         ntok = pick(logits[:, -1], key)
-        return (mut["cache"], ntok), tok
+        if eos_id is not None:
+            ntok = jnp.where(done, eos_id, ntok)
+            done = jnp.logical_or(done, ntok == eos_id)
+        return (mut["cache"], ntok, done), tok
 
-    (cache, last), toks = jax.lax.scan(
-        step, (mut["cache"], tok), keys[1:], length=num_new - 1
+    (cache, last, done), toks = jax.lax.scan(
+        step, (mut["cache"], tok, done), keys[1:], length=num_new - 1
     )
     out = jnp.concatenate([toks.T, last[:, None]], axis=1)
     return out
