@@ -351,3 +351,38 @@ def test_generate_topk_and_eos():
     row = np.asarray(out)[0]
     first = int(np.argmax(row == eos))
     assert (row[first:] == eos).all()
+
+
+def test_speculative_decode_exactness():
+    """Speculative greedy decoding returns EXACTLY the target's greedy
+    tokens — with a self-draft (full acceptance) and with an unrelated
+    draft model (mostly rejected drafts)."""
+    from vtpu.models.transformer import (
+        TransformerLM,
+        generate,
+        generate_speculative,
+    )
+
+    target = TransformerLM(vocab=48, d_model=32, depth=2, num_heads=4,
+                           max_seq=64)
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 6), 0, 48)
+    tp = target.init(jax.random.PRNGKey(0), prompt)["params"]
+    want = generate(target, tp, prompt, num_new=10)
+
+    # self-draft: every draft token accepted, still exact — AND the
+    # speedup property holds: 9 post-prefill tokens at k+1=4 per verify
+    # forward = 3 verify forwards (a draft-cache hole would collapse
+    # acceptance and inflate this)
+    got_self, stats = generate_speculative(target, tp, target, tp, prompt,
+                                           num_new=10, k=3,
+                                           return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got_self), np.asarray(want))
+    assert stats["verify_forwards"] == 3, stats
+
+    # disagreeing draft (different init, shallower): exactness must hold
+    draft = TransformerLM(vocab=48, d_model=16, depth=1, num_heads=2,
+                          max_seq=64)
+    dp = draft.init(jax.random.PRNGKey(9), prompt)["params"]
+    got = generate_speculative(target, tp, draft, dp, prompt,
+                               num_new=10, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

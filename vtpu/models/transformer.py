@@ -288,6 +288,17 @@ class TransformerLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def _zero_cache(model: TransformerLM, prompt):
+    """Pristine decode cache for ``model`` (shapes via eval_shape — no
+    throwaway params, no real forward)."""
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros_like(prompt), decode=True
+        )["cache"]
+    )
+    return jax.tree.map(lambda sh: jnp.zeros(sh.shape, sh.dtype), shapes)
+
+
 def generate(model: TransformerLM, params, prompt, num_new: int,
              temperature: float = 0.0, rng=None,
              prefill_chunk: int = 0, top_k: int = 0,
@@ -309,16 +320,7 @@ def generate(model: TransformerLM, params, prompt, num_new: int,
             f"prompt ({prompt.shape[1]}) + num_new ({num_new}) exceeds "
             f"max_seq ({model.max_seq}) — the cache would silently clamp"
         )
-    # cache SHAPES only — eval_shape traces without materializing
-    # throwaway params or running a real forward
-    cache_shapes = jax.eval_shape(
-        lambda: model.init(
-            jax.random.PRNGKey(0), jnp.zeros_like(prompt), decode=True
-        )["cache"]
-    )
-    cache = jax.tree.map(
-        lambda sh: jnp.zeros(sh.shape, sh.dtype), cache_shapes
-    )
+    cache = _zero_cache(model, prompt)
 
     def pick(logits_last, key):
         if temperature <= 0:
@@ -376,6 +378,99 @@ def generate(model: TransformerLM, params, prompt, num_new: int,
     )
     out = jnp.concatenate([toks.T, last[:, None]], axis=1)
     return out
+
+
+def generate_speculative(model: TransformerLM, params,
+                         draft_model: TransformerLM, draft_params,
+                         prompt, num_new: int, k: int = 4,
+                         return_stats: bool = False):
+    """Speculative GREEDY decoding: a cheap draft model proposes ``k``
+    tokens per iteration, the target verifies all of them in ONE
+    (k+1)-token decode forward, and the longest matching prefix plus the
+    target's own next token are accepted — ≥1 token per target forward,
+    up to k+1 on full agreement.  Output is EXACTLY the target's greedy
+    decode (speculation changes latency, never tokens).
+
+    Cache rewind is free in this design: both models keep ONE position
+    counter and mask reads by position, so rejecting draft tokens is
+    just setting the counter back — stale K/V beyond it are never read
+    and get overwritten on the next advance."""
+    b, s0 = prompt.shape
+    for m, who in ((model, "target"), (draft_model, "draft")):
+        if s0 + num_new + k + 1 > m.max_seq:
+            raise ValueError(
+                f"prompt ({s0}) + num_new ({num_new}) + draft window "
+                f"({k + 1}) exceeds the {who} model's max_seq ({m.max_seq})"
+            )
+
+    def set_pos(cache, pos):
+        c = dict(cache)
+        c["pos"] = jnp.asarray(pos, cache["pos"].dtype)
+        return c
+
+    @jax.jit
+    def target_apply(cache, toks):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, toks, decode=True,
+            mutable=["cache"],
+        )
+        return logits, mut["cache"]
+
+    @jax.jit
+    def draft_apply(cache, toks):
+        logits, mut = draft_model.apply(
+            {"params": draft_params, "cache": cache}, toks, decode=True,
+            mutable=["cache"],
+        )
+        return logits, mut["cache"]
+
+    def draft_step(cache, tok):
+        logits, cache = draft_apply(cache, tok[:, None])
+        return jnp.argmax(logits[:, -1], -1).astype(jnp.int32), cache
+
+    # prefill both models; the prompt's last position supplies the first
+    # pending token
+    t_logits, t_cache = target_apply(_zero_cache(model, prompt), prompt)
+    pending = jnp.argmax(t_logits[:, -1], -1).astype(jnp.int32)
+    _, d_cache = draft_apply(_zero_cache(draft_model, prompt), prompt)
+
+    out = [pending]  # pending IS the first generated token (greedy)
+    n_done = 1
+    pos = s0  # both caches hold exactly the prompt
+    verify_forwards = 0
+    while n_done < num_new:
+        verify_forwards += 1
+        # draft k tokens from the pending one; one EXTRA step feeds the
+        # last proposal so its K/V lands in the draft cache — without it,
+        # a fully-accepted window leaves a hole the next round's mask
+        # reads as zeros (acceptance silently collapses after round 1)
+        d_cache = set_pos(d_cache, pos)
+        drafts = []
+        tok = pending
+        for _ in range(k + 1):
+            tok, d_cache = draft_step(d_cache, tok)
+            drafts.append(tok)
+        d_stack = jnp.stack(drafts[:k], axis=1)        # [b, k]
+        # ONE target forward verifies pending + all drafts
+        t_cache = set_pos(t_cache, pos)
+        block = jnp.concatenate([pending[:, None], d_stack], axis=1)
+        logits, t_cache = target_apply(t_cache, block)  # [b, k+1, v]
+        greedy = jnp.argmax(logits, -1).astype(jnp.int32)  # g_0..g_k
+        # accept the longest prefix where draft_i == target's g_{i-1}
+        match = d_stack == greedy[:, :-1]               # [b, k]
+        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        n_min = int(jnp.min(n_acc))  # batch lockstep: host-side min
+        accepted = [d_stack[:, i] for i in range(n_min)]
+        nxt = greedy[jnp.arange(b), n_min]              # target's own token
+        out.extend(accepted)
+        out.append(nxt)
+        n_done += n_min + 1
+        pos = pos + n_min + 1
+        pending = nxt
+    toks = jnp.stack(out[:num_new], axis=1)
+    if return_stats:
+        return toks, {"verify_forwards": verify_forwards}
+    return toks
 
 
 def lm_loss(logits, tokens) -> jax.Array:
