@@ -58,6 +58,8 @@ def phase_note(phase: str, **kw) -> None:
     entry = {"phase": phase, **kw}
     PHASE_LOG.append(entry)
     log(f"phase[{phase}]: {kw}")
+
+
 SHIM_SO = os.environ.get(
     "VTPU_SHIM_SO", os.path.join(REPO, "cpp", "build", "libvtpu_shim.so")
 )
@@ -68,6 +70,23 @@ REAL_PLUGIN = os.environ.get(
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def parse_shim_stats(stderr_text: str):
+    """Pull the native shim's exit telemetry line (VTPU_SHIM_STATS=1)
+    out of a tenant's stderr: {"vtpu_shim_stats": {...}} → the inner
+    dict, or None.  Lets the bench artifact carry the interposer's OWN
+    overhead numbers (wrapper-added ms, size round-trips, rejects)."""
+    for line in reversed(stderr_text.strip().splitlines()):
+        if '"vtpu_shim_stats"' not in line:
+            continue
+        try:
+            st = json.loads(line)["vtpu_shim_stats"]
+        except (json.JSONDecodeError, KeyError, TypeError):
+            continue
+        if isinstance(st, dict):
+            return st
+    return None
 
 
 def last_json_line(text: str):
@@ -426,6 +445,9 @@ def tenant_env(shim: bool, quota_mb: int, region_path: str | None,
         JAX_COMPILATION_CACHE_DIR=os.environ.get(
             "VTPU_JAX_CACHE_DIR", "/tmp/vtpu-jax-cache"
         ),
+        # shim tenants dump wrapper telemetry at exit; the orchestrator
+        # folds it into the artifact (proof the interposer cost is ~0)
+        VTPU_SHIM_STATS="1" if shim else "0",
     )
     if shim and region_path:
         env.update(
@@ -512,12 +534,16 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
         wait_ready(n_tenants, deadline)
         open(os.path.join(tmp, "go"), "w").close()
         outs = []
+        shim_stats = []
         for p in procs:
             stdout, stderr = p.communicate(timeout=600)
             if p.returncode != 0:
                 sys.stderr.write(stderr[-2000:])
                 raise RuntimeError(f"tenant rc={p.returncode}")
             outs.append(json.loads(stdout.strip().splitlines()[-1]))
+            st = parse_shim_stats(stderr)
+            if st is not None:
+                shim_stats.append(st)
     except Exception as e:  # noqa: BLE001 — fall back to the legacy path
         phase_note("native_share", rc="error", error=str(e)[:300])
         for p in procs:
@@ -525,6 +551,14 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
                 p.kill()
         return None
     info = {}
+    if shim and shim_stats:
+        execs = sum(s.get("exec", {}).get("calls", 0) for s in shim_stats)
+        shim_ms = sum(s.get("exec", {}).get("shim_ms", 0) for s in shim_stats)
+        info["shim_exec_calls"] = execs
+        info["shim_added_us_per_exec"] = (
+            round(1000.0 * shim_ms / execs, 2) if execs else None
+        )
+        info["shim_size_rtts"] = sum(s.get("size_rtts", 0) for s in shim_stats)
     if shim:
         try:
             from vtpu.monitor.shared_region import open_region

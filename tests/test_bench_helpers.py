@@ -111,3 +111,16 @@ def test_native_matrix_driver_resume_and_table(monkeypatch, tmp_path, capsys):
         "vgg16:2:inference", True) in ran
     text = capsys.readouterr().out
     assert "| lstm:8:inference | 50.0 | 42.0 | 0.840 |" in text
+
+
+def test_parse_shim_stats():
+    err = (
+        "some warning\n"
+        '{"vtpu_shim_stats": {"pid": 7, "exec": {"calls": 10, '
+        '"shim_ms": 0.5}, "size_rtts": 0}}\n'
+        "trailing noise"
+    )
+    st = bench.parse_shim_stats(err)
+    assert st["exec"]["calls"] == 10 and st["size_rtts"] == 0
+    assert bench.parse_shim_stats("no stats here") is None
+    assert bench.parse_shim_stats('{"vtpu_shim_stats": 3}') is None
