@@ -386,3 +386,30 @@ def test_speculative_decode_exactness():
     got = generate_speculative(target, tp, draft, dp, prompt,
                                num_new=10, k=3)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_flagship_serving_config_under_tp_mesh():
+    """The full modern serving config at once — RoPE + GQA + sliding
+    window + chunked prefill — decodes token-exactly under
+    Megatron-sharded params on the dp×tp mesh."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from vtpu.models.transformer import TransformerLM, generate, tp_param_specs
+
+    model = TransformerLM(vocab=64, d_model=32, depth=2, num_heads=8,
+                          num_kv_heads=2, max_seq=64, pos_embedding="rope",
+                          attn_window=8)
+    prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 9), 0, 64)
+    params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+    want = generate(model, params, prompt, num_new=6)
+
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "tp"))
+    spec_of = tp_param_specs(axis="tp")
+
+    def shard_leaf(path, leaf):
+        p = "/".join(getattr(k, "key", str(k)) for k in path)
+        return jax.device_put(leaf, NamedSharding(mesh, spec_of(p)))
+
+    sharded = jax.tree_util.tree_map_with_path(shard_leaf, params)
+    got = generate(model, sharded, prompt, num_new=6, prefill_chunk=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
