@@ -380,6 +380,71 @@ def generate(model: TransformerLM, params, prompt, num_new: int,
     return out
 
 
+def generate_beam(model: TransformerLM, params, prompt, num_new: int,
+                  beam: int = 4):
+    """Beam-search decoding with the KV cache: beams ride the batch dim
+    ([b·beam] rows), and when a step reorders beams the per-layer K/V
+    arrays are gathered along batch to follow their parent hypotheses
+    (the single position counter is beam-invariant, so it needs no
+    fix-up).  Pure log-prob objective, no length penalty.  Returns the
+    best beam per batch row, [b, num_new] int32."""
+    b, s0 = prompt.shape
+    if num_new < 1:
+        raise ValueError(f"num_new must be >= 1, got {num_new}")
+    if s0 + num_new > model.max_seq:
+        raise ValueError(
+            f"prompt ({s0}) + num_new ({num_new}) exceeds max_seq "
+            f"({model.max_seq})"
+        )
+    vocab = model.vocab
+
+    logits, mut = model.apply(
+        {"params": params, "cache": _zero_cache(model, prompt)}, prompt,
+        decode=True, mutable=["cache"],
+    )
+    logp0 = jax.nn.log_softmax(logits[:, -1])            # [b, V]
+    scores, toks0 = jax.lax.top_k(logp0, beam)           # [b, beam]
+    # fixed-size history buffer: step compiles ONCE (a growing hist
+    # would change shapes and retrace every iteration)
+    hist = jnp.zeros((b, beam, num_new), jnp.int32)
+    hist = hist.at[:, :, 0].set(toks0)
+    # tile each batch row's cache to its beam copies: [b, ...] → [b·beam]
+    cache = jax.tree.map(
+        lambda a: jnp.repeat(a, beam, axis=0) if a.ndim > 0 else a,
+        mut["cache"],
+    )
+    tok = toks0.reshape(b * beam)
+
+    @jax.jit
+    def step(cache, tok, scores, hist, t):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok[:, None], decode=True,
+            mutable=["cache"],
+        )
+        logp = jax.nn.log_softmax(logits[:, -1]).reshape(b, beam, vocab)
+        total = scores[:, :, None] + logp                # [b, beam, V]
+        scores, idx = jax.lax.top_k(total.reshape(b, beam * vocab), beam)
+        parent = idx // vocab                            # [b, beam]
+        ntok = (idx % vocab).astype(jnp.int32)
+        # beams reorder: gather caches and histories to follow parents
+        sel = (jnp.arange(b)[:, None] * beam + parent).reshape(-1)
+        cache = jax.tree.map(
+            lambda a: a[sel] if a.ndim > 0 else a, mut["cache"]
+        )
+        hist = jnp.take_along_axis(hist, parent[:, :, None], axis=1)
+        hist = hist.at[:, :, t].set(ntok)  # traced t: no retrace
+        return cache, ntok.reshape(b * beam), scores, hist
+
+    for t in range(1, num_new):
+        cache, tok, scores, hist = step(
+            cache, tok, scores, hist, jnp.asarray(t)
+        )
+    best = jnp.argmax(scores, axis=1)                    # [b]
+    return jnp.take_along_axis(
+        hist, best[:, None, None], axis=1
+    )[:, 0].astype(jnp.int32)
+
+
 def generate_speculative(model: TransformerLM, params,
                          draft_model: TransformerLM, draft_params,
                          prompt, num_new: int, k: int = 4,
