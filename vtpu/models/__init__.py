@@ -10,3 +10,10 @@ control flow under jit.
 """
 
 from vtpu.models.registry import MODELS, create_model  # noqa: F401
+from vtpu.models.transformer import (  # noqa: F401
+    TransformerLM,
+    generate,
+    generate_beam,
+    generate_speculative,
+    lm_loss,
+)
