@@ -31,8 +31,9 @@
 
 static const PJRT_Api* api;
 
-static PJRT_Buffer* make_buffer(PJRT_Client* client, PJRT_Device* dev,
-                                int64_t mib, PJRT_Error** err_out) {
+static PJRT_Buffer* make_buffer_placed(PJRT_Client* client, PJRT_Device* dev,
+                                       PJRT_Memory* mem, int64_t mib,
+                                       PJRT_Error** err_out) {
   static int64_t dims[1];
   dims[0] = mib * 1024 * 1024; /* U8 → bytes */
   PJRT_Client_BufferFromHostBuffer_Args a;
@@ -45,8 +46,14 @@ static PJRT_Buffer* make_buffer(PJRT_Client* client, PJRT_Device* dev,
   a.dims = dims;
   a.num_dims = 1;
   a.device = dev;
+  a.memory = mem; /* non-null = explicit memory-space placement */
   *err_out = api->PJRT_Client_BufferFromHostBuffer(&a);
   return a.buffer;
+}
+
+static PJRT_Buffer* make_buffer(PJRT_Client* client, PJRT_Device* dev,
+                                int64_t mib, PJRT_Error** err_out) {
+  return make_buffer_placed(client, dev, nullptr, mib, err_out);
 }
 
 static void destroy_error(PJRT_Error* e) {
@@ -55,6 +62,23 @@ static void destroy_error(PJRT_Error* e) {
   d.struct_size = PJRT_Error_Destroy_Args_STRUCT_SIZE;
   d.error = e;
   api->PJRT_Error_Destroy(&d);
+}
+
+static PJRT_Memory* host_memory_of(PJRT_Device* dev) {
+  PJRT_Device_AddressableMemories_Args ma;
+  memset(&ma, 0, sizeof(ma));
+  ma.struct_size = PJRT_Device_AddressableMemories_Args_STRUCT_SIZE;
+  ma.device = dev;
+  if (api->PJRT_Device_AddressableMemories(&ma) != nullptr) return nullptr;
+  for (size_t m = 0; m < ma.num_memories; m++) {
+    PJRT_Memory_Kind_Args ka;
+    memset(&ka, 0, sizeof(ka));
+    ka.struct_size = PJRT_Memory_Kind_Args_STRUCT_SIZE;
+    ka.memory = ma.memories[m];
+    if (api->PJRT_Memory_Kind(&ka) != nullptr || !ka.kind) continue;
+    if (strstr(ka.kind, "host")) return ma.memories[m];
+  }
+  return nullptr;
 }
 
 static const char* buffer_kind(PJRT_Buffer* b) {
@@ -747,6 +771,31 @@ int main(int argc, char** argv) {
   CHECK(ms.bytes_limit == 64LL * 1024 * 1024,
         "bytes_limit reports the 64MiB quota");
   CHECK(ms.bytes_in_use >= 40LL * 1024 * 1024, "bytes_in_use tracks usage");
+
+  /* explicit host-space placement (cooperative offload, sync h2d path):
+   * bigger than remaining device headroom, yet must be admitted — it is
+   * swap-accounted (kind 2) on the host tier, NOT charged against the
+   * device HBM quota (advisor r3 medium: BufferFromHostBuffer must
+   * resolve args->memory the way CopyToMemory does) */
+  PJRT_Memory* hostmem = host_memory_of(dev0);
+  CHECK(hostmem != nullptr, "mock exposes a host memory space");
+  PJRT_Buffer* bh = make_buffer_placed(ca.client, nullptr, hostmem, 40, &err);
+  CHECK(err == nullptr && bh != nullptr,
+        "explicit host placement admitted past device quota");
+  CHECK(strcmp(buffer_kind(bh), "pinned_host") == 0,
+        "explicitly placed buffer lands in the host space");
+  PJRT_Device_MemoryStats_Args msh;
+  memset(&msh, 0, sizeof(msh));
+  msh.struct_size = PJRT_Device_MemoryStats_Args_STRUCT_SIZE;
+  msh.device = dev0;
+  CHECK(api->PJRT_Device_MemoryStats(&msh) == nullptr, "memory stats (host)");
+  CHECK(msh.bytes_in_use == 40LL * 1024 * 1024,
+        "host placement not charged to the device quota");
+  PJRT_Buffer_Destroy_Args bdh;
+  memset(&bdh, 0, sizeof(bdh));
+  bdh.struct_size = PJRT_Buffer_Destroy_Args_STRUCT_SIZE;
+  bdh.buffer = bh;
+  CHECK(api->PJRT_Buffer_Destroy(&bdh) == nullptr, "destroy host buffer");
 
   /* compile registers program bytes; execute is paced to the core limit */
   PJRT_Client_Compile_Args cc;

@@ -177,6 +177,7 @@ struct MemInfo {
   int is_host;
 };
 std::unordered_map<void*, MemInfo> g_mem_info;
+MemInfo mem_info_for(PJRT_Memory* mem, int fallback_dev);
 /* async host→device transfer managers: the reservation is taken at
  * manager creation (shape specs carry the sizes) and handed to the
  * concrete buffers as they are retrieved; unclaimed slices are released
@@ -364,17 +365,21 @@ uint64_t dtype_width(PJRT_Buffer_Type t) {
 /* account the real on-device size; returns 0 ok, -1 if the buffer busts the
  * quota (caller destroys it and surfaces the error — the exact-size
  * equivalent of check_oom, covering dtypes the pre-check can't size) */
-int account_buffer_idx(PJRT_Buffer* buf, int dev) {
+int account_buffer_kind(PJRT_Buffer* buf, int dev, int kind) {
   if (!buf || !g_region) return 0;
   uint64_t sz = buffer_size(buf);
   if (sz == 0) return 0;
-  if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0, sz,
+  if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, kind, sz,
                           g_cfg.oversubscribe) != 0)
     return -1;
   pthread_mutex_lock(&g_mu);
-  g_buffers[buf] = {sz, dev, 0};
+  g_buffers[buf] = {sz, dev, kind};
   pthread_mutex_unlock(&g_mu);
   return 0;
+}
+
+int account_buffer_idx(PJRT_Buffer* buf, int dev) {
+  return account_buffer_kind(buf, dev, /*kind=*/0);
 }
 
 int account_buffer(PJRT_Buffer* buf, PJRT_Device* dev_hint) {
@@ -512,16 +517,28 @@ PJRT_Error* wrap_BufferFromHostBuffer(
   g_stats.h2d_calls++;
   uint64_t want = 0;
   int dev = 0;
+  int kind = 0;
   bool host_placed = false, accounted = false;
   if (g_region) {
+    if (args->memory != nullptr) {
+      /* caller targets an explicit memory space — resolve it the way
+       * CopyToMemory does: a host space is swap-accounted (kind 2) on
+       * the memory's owning device, never the execute-device HBM quota
+       * (cooperative offload, vtpu/utils/offload.py, must not trip
+       * RESOURCE_EXHAUSTED on the sync h2d path) */
+      MemInfo mi = mem_info_for(args->memory, device_index(args->device));
+      dev = mi.dev;
+      kind = mi.is_host ? 2 : 0;
+    } else {
+      dev = device_index(args->device);
+    }
     uint64_t width = dtype_width(args->type);
     if (width > 0) {
-      dev = device_index(args->device);
       want = width;
       for (size_t i = 0; i < args->num_dims; i++)
         want *= (uint64_t)args->dims[i];
-      if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0,
-                              want, /*oversubscribe=*/0) != 0) {
+      if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, kind, want,
+                              /*oversubscribe=*/0) != 0) {
         if (g_cfg.oversubscribe && args->memory == nullptr &&
             dev < VTPU_MAX_DEVICES && g_host_mem[dev] != nullptr) {
           args->memory = g_host_mem[dev];
@@ -530,7 +547,7 @@ PJRT_Error* wrap_BufferFromHostBuffer(
           return quota_reject("vtpu: HBM quota exceeded (BufferFromHostBuffer)");
         } else {
           /* legacy oversubscribe without a host tier: force-admit */
-          vtpu_region_try_add(g_region, (int32_t)getpid(), dev, 0, want, 1);
+          vtpu_region_try_add(g_region, (int32_t)getpid(), dev, kind, want, 1);
           accounted = true;
         }
       } else {
@@ -543,7 +560,7 @@ PJRT_Error* wrap_BufferFromHostBuffer(
   uint64_t t2 = now_ns();
   if (err) {
     if (accounted)
-      vtpu_region_sub(g_region, (int32_t)getpid(), dev, 0, want);
+      vtpu_region_sub(g_region, (int32_t)getpid(), dev, kind, want);
     g_stats.h2d_shim_ns += (t1 - t0) + (now_ns() - t2);
     return err;
   }
@@ -559,12 +576,14 @@ PJRT_Error* wrap_BufferFromHostBuffer(
     }
   } else if (accounted) {
     pthread_mutex_lock(&g_mu);
-    g_buffers[args->buffer] = {want, dev, 0};
+    g_buffers[args->buffer] = {want, dev, kind};
     pthread_mutex_unlock(&g_mu);
   } else if (g_region) {
     /* unsizable dtype (sub-byte / opaque): fall back to the on-device
-     * size query — rare, and the only remaining RTT on this path */
-    if (account_buffer(args->buffer, args->device) != 0) {
+     * size query — rare, and the only remaining RTT on this path; keeps
+     * the kind/device resolved above so explicit host placements stay
+     * swap-accounted here too */
+    if (account_buffer_kind(args->buffer, dev, kind) != 0) {
       destroy_real_buffer(args->buffer);
       args->buffer = nullptr;
       g_stats.h2d_shim_ns += (t1 - t0) + (now_ns() - t2);
@@ -578,18 +597,26 @@ PJRT_Error* wrap_BufferFromHostBuffer(
 PJRT_Error* wrap_CreateUninitializedBuffer(
     PJRT_Client_CreateUninitializedBuffer_Args* args) {
   /* same local-size admission as BufferFromHostBuffer: the args carry
-   * the shape, so the quota check needs no PJRT round trip */
+   * the shape, so the quota check needs no PJRT round trip; explicit
+   * host-space placements are swap-accounted (kind 2), same as there */
   uint64_t want = 0;
   int dev = 0;
+  int kind = 0;
   bool accounted = false;
   if (g_region) {
+    if (args->memory != nullptr) {
+      MemInfo mi = mem_info_for(args->memory, device_index(args->device));
+      dev = mi.dev;
+      kind = mi.is_host ? 2 : 0;
+    } else {
+      dev = device_index(args->device);
+    }
     uint64_t width = dtype_width(args->shape_element_type);
     if (width > 0) {
-      dev = device_index(args->device);
       want = width;
       for (size_t i = 0; i < args->shape_num_dims; i++)
         want *= (uint64_t)args->shape_dims[i];
-      if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, /*kind=*/0,
+      if (vtpu_region_try_add(g_region, (int32_t)getpid(), dev, kind,
                               want, g_cfg.oversubscribe) != 0)
         return quota_reject("vtpu: HBM quota exceeded (uninitialized buffer)");
       accounted = true;
@@ -598,14 +625,14 @@ PJRT_Error* wrap_CreateUninitializedBuffer(
   PJRT_Error* err = g_real->PJRT_Client_CreateUninitializedBuffer(args);
   if (err) {
     if (accounted)
-      vtpu_region_sub(g_region, (int32_t)getpid(), dev, 0, want);
+      vtpu_region_sub(g_region, (int32_t)getpid(), dev, kind, want);
     return err;
   }
   if (accounted) {
     pthread_mutex_lock(&g_mu);
-    g_buffers[args->buffer] = {want, dev, 0};
+    g_buffers[args->buffer] = {want, dev, kind};
     pthread_mutex_unlock(&g_mu);
-  } else if (account_buffer(args->buffer, args->device) != 0) {
+  } else if (account_buffer_kind(args->buffer, dev, kind) != 0) {
     destroy_real_buffer(args->buffer);
     args->buffer = nullptr;
     return quota_reject("vtpu: HBM quota exceeded (uninitialized buffer)");

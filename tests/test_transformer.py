@@ -458,3 +458,36 @@ def test_beam_search_properties():
 
     with _pytest.raises(ValueError):
         generate_beam(model, params, prompt, num_new=0)
+
+
+def test_moe_capacity_plumbed_and_generate_validates_num_new():
+    """moe_capacity reaches MoeMlp through Block/TransformerLM (advisor
+    r3: without the plumbing every public-API model ran lossless
+    t*top_k slots with no opt-out), and generate() rejects num_new < 1
+    like generate_beam does."""
+    from vtpu.models.transformer import generate
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, 64)
+    capped = TransformerLM(vocab=64, d_model=32, depth=1, num_heads=4,
+                           max_seq=32, mlp="moe", n_experts=4, moe_top_k=2,
+                           moe_capacity=4)
+    params = capped.init(jax.random.PRNGKey(0), tokens)["params"]
+    out = capped.apply({"params": params}, tokens)
+    assert out.shape == (2, 12, 64)
+
+    # a capacity of t*top_k slots per expert can never drop a token, so
+    # it must match the capacity=0 (lossless) path on the same params
+    lossless = TransformerLM(vocab=64, d_model=32, depth=1, num_heads=4,
+                             max_seq=32, mlp="moe", n_experts=4,
+                             moe_top_k=2)
+    full = TransformerLM(vocab=64, d_model=32, depth=1, num_heads=4,
+                         max_seq=32, mlp="moe", n_experts=4, moe_top_k=2,
+                         moe_capacity=48)  # t(=2*12) * top_k(=2)
+    np.testing.assert_allclose(
+        np.asarray(lossless.apply({"params": params}, tokens)),
+        np.asarray(full.apply({"params": params}, tokens)),
+        rtol=2e-5, atol=2e-5,
+    )
+
+    with pytest.raises(ValueError, match="num_new"):
+        generate(capped, params, tokens[:, :4], num_new=0)

@@ -210,6 +210,7 @@ class Block(nn.Module):
     mlp: str = "dense"  # "dense" | "moe"
     n_experts: int = 8
     moe_top_k: int = 2
+    moe_capacity: int = 0  # 0 = lossless; trainers pass a finite cap
 
     @nn.compact
     def __call__(self, x, decode: bool = False, pos0=None):
@@ -220,6 +221,7 @@ class Block(nn.Module):
         )
         if self.mlp == "moe":
             x = x + MoeMlp(self.n_experts, self.moe_top_k, self.mlp_ratio,
+                           self.moe_capacity,
                            name="moe")(_LayerNorm(name="ln2")(x))
             return x
         h = nn.Dense(self.mlp_ratio * d, name="mlp_in")(_LayerNorm(name="ln2")(x))
@@ -243,6 +245,7 @@ class TransformerLM(nn.Module):
     mlp: str = "dense"  # "dense" | "moe" (top-k routed expert FFNs)
     n_experts: int = 8
     moe_top_k: int = 2
+    moe_capacity: int = 0  # per-expert slots; 0 = lossless t·top_k
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False):
@@ -280,6 +283,7 @@ class TransformerLM(nn.Module):
                       num_kv_heads=self.num_kv_heads, use_rope=use_rope,
                       window=self.attn_window, mlp=self.mlp,
                       n_experts=self.n_experts, moe_top_k=self.moe_top_k,
+                      moe_capacity=self.moe_capacity,
                       name=f"h{i}")(
                 x, decode=decode, pos0=pos0
             )
@@ -313,6 +317,8 @@ def generate(model: TransformerLM, params, prompt, num_new: int,
     position repeats eos (static shapes forbid a ragged stop, so the
     scan keeps running but the finished row's tokens stop changing).
     Returns [b, num_new] int32."""
+    if num_new < 1:
+        raise ValueError(f"num_new must be >= 1, got {num_new}")
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")
     if prompt.shape[1] + num_new > model.max_seq:
