@@ -27,6 +27,15 @@ run), the share phase falls back to four thread-tenants in one process on
 the cooperative Python runtime (vtpu/shim/runtime.py) and reports
 "native_shim": false.
 
+Outage-proofing: every TPU-measured arm (exclusive / share / oversub)
+persists its result under docs/artifacts/bench_state/ the moment it
+completes, and a later invocation stitches fresh cached arms instead of
+re-measuring (extra.arm_sources says which is which).  A transport
+outage between a measurement and the driver's end-of-round run can no
+longer reduce the round's evidence to a CPU fallback (the r3 failure).
+VTPU_BENCH_FRESH=1 ignores the cache; VTPU_BENCH_STATE_MAX_AGE_S bounds
+staleness (default 48 h).
+
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 """
@@ -58,6 +67,87 @@ def phase_note(phase: str, **kw) -> None:
     entry = {"phase": phase, **kw}
     PHASE_LOG.append(entry)
     log(f"phase[{phase}]: {kw}")
+
+
+# ---------------------------------------------------------------------------
+# arm persistence — the outage-proofing layer
+# ---------------------------------------------------------------------------
+# The r3 lesson: the relayed PJRT transport died for 8 h mid-round AFTER
+# the morning's real-chip measurements, and the end-of-round bench run
+# could only produce a CPU-fallback artifact — the whole round's TPU
+# evidence lived in hand-preserved files.  Now every arm persists its
+# result IMMEDIATELY on completion, and a later invocation stitches
+# fresh TPU-measured arms instead of re-measuring, so any single TPU
+# window during the round yields a complete driver-visible artifact,
+# even across process restarts.
+
+STATE_DIR = os.environ.get(
+    "VTPU_BENCH_STATE_DIR",
+    os.path.join(REPO, "docs", "artifacts", "bench_state"),
+)
+STATE_MAX_AGE_S = float(
+    os.environ.get("VTPU_BENCH_STATE_MAX_AGE_S", str(48 * 3600))
+)
+
+
+def save_arm(name: str, payload: dict) -> None:
+    """Persist a completed arm's result atomically under STATE_DIR."""
+    os.makedirs(STATE_DIR, exist_ok=True)
+    rec = {"measured_unix": time.time(), "host": os.uname().nodename,
+           **payload}
+    path = os.path.join(STATE_DIR, f"arm_{name}.json")
+    tmp_path = path + ".tmp"
+    with open(tmp_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    os.replace(tmp_path, path)
+    log(f"arm[{name}] persisted to {path}")
+
+
+# keys an arm record must carry to be stitchable — a hand-edited or
+# older-schema file that parses but lacks them must fall back to live
+# measurement, not crash main() before it owes the driver its JSON line
+ARM_REQUIRED_KEYS = {
+    "exclusive": ("platform", "exclusive_img_s"),
+    "share": ("platform", "per_tenant_img_s"),
+    "oversub": ("platform", "probe"),
+}
+
+
+def load_arm(name: str) -> dict | None:
+    """A fresh, TPU-measured arm from a previous invocation ON THIS
+    HOST.  CPU results are never reused: they are cheap to recompute
+    and a stale one must not mask a live chip window."""
+    if os.environ.get("VTPU_BENCH_FRESH") == "1":
+        return None
+    path = os.path.join(STATE_DIR, f"arm_{name}.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+    if not isinstance(rec, dict) or any(
+        k not in rec for k in ARM_REQUIRED_KEYS.get(name, ())
+    ):
+        phase_note(name, rc="invalid_cache")
+        return None
+    age = time.time() - float(rec.get("measured_unix", 0))
+    if age > STATE_MAX_AGE_S:
+        phase_note(name, rc="stale_cache", age_s=int(age))
+        return None
+    if rec.get("platform") == "cpu":
+        return None
+    host = rec.get("host")
+    if host is not None and host != os.uname().nodename:
+        # a record that traveled with the repo (copied checkout, CI)
+        # must not replay another machine's chip numbers
+        phase_note(name, rc="foreign_cache", host=host)
+        return None
+    phase_note(name, rc="cached", age_s=int(age))
+    return rec
+
+
+def arm_stamp(rec: dict) -> str:
+    return f"cached@{int(rec.get('measured_unix', 0))}"
 
 
 SHIM_SO = os.environ.get(
@@ -402,6 +492,8 @@ def wait_backend_ready(max_wait_s: float | None = None) -> bool:
         "_register_backend();"
         "import jax; print(jax.devices()[0].platform)"
     )
+    import random
+
     attempt = 0
     while time.monotonic() < deadline:
         try:
@@ -416,8 +508,14 @@ def wait_backend_ready(max_wait_s: float | None = None) -> bool:
         except subprocess.TimeoutExpired:
             pass
         attempt += 1
-        log(f"backend gate: init not ready (attempt {attempt}); draining…")
-        time.sleep(20)
+        # jittered, slowly-lengthening backoff: a relay recovering from
+        # an outage drains sessions unevenly — fixed-period probes can
+        # resonate with the drain and miss the recovery for the whole
+        # gate window (r3: 4 fixed attempts never caught it)
+        pause = min(60.0, 15.0 + 5.0 * attempt) * random.uniform(0.7, 1.3)
+        log(f"backend gate: init not ready (attempt {attempt}); "
+            f"retrying in {pause:.0f}s…")
+        time.sleep(pause)
     phase_note("backend_gate", rc="timeout", waited_attempts=attempt)
     return False
 
@@ -703,7 +801,18 @@ def main() -> None:
     excl_per_proc: list = []
     hbm = 16 * 1024**3
     backend_up = False
-    if native_available():
+    arm_sources: dict = {}
+
+    cached_excl = load_arm("exclusive")
+    if cached_excl is not None:
+        platform = cached_excl["platform"]
+        exclusive = cached_excl["exclusive_img_s"]
+        excl_per_proc = list(cached_excl.get("per_proc", []))
+        hbm = int(cached_excl.get("hbm_bytes") or hbm)
+        window = float(cached_excl.get("window_s", window))
+        excl_mode = cached_excl.get("mode", "4proc_noshim")
+        arm_sources["exclusive"] = arm_stamp(cached_excl)
+    elif native_available():
         backend_up = wait_backend_ready()
         res = (
             run_native_share(quota_mb=0, window_s=window, shim=False,
@@ -738,11 +847,29 @@ def main() -> None:
         window = excl["window_s"]
         hbm = int(excl["hbm_bytes"])
         excl_mode = "1proc_4stream"
+        excl_per_proc = []
+    if platform != "cpu" and "exclusive" not in arm_sources:
+        save_arm("exclusive", {
+            "platform": platform, "exclusive_img_s": exclusive,
+            "per_proc": excl_per_proc, "hbm_bytes": int(hbm),
+            "window_s": window, "mode": excl_mode,
+        })
+        arm_sources["exclusive"] = "live"
     quota = int(hbm) // 4
     log(f"exclusive: {exclusive:.2f} img/s ({platform}, {excl_mode})")
 
     per_tenant, violations, native, info = None, 0, False, {}
-    if platform != "cpu" and native_available():
+    cached_share = load_arm("share") if platform != "cpu" else None
+    if cached_share is not None:
+        per_tenant = list(cached_share["per_tenant_img_s"])
+        violations = int(cached_share.get("violations", 0))
+        native = bool(cached_share.get("native_shim", True))
+        info = dict(cached_share.get("info", {}))
+        # the quota the cached tenants actually ran under, not one
+        # recomputed from THIS run's exclusive arm
+        quota = int(cached_share.get("quota_bytes") or quota)
+        arm_sources["share"] = arm_stamp(cached_share)
+    elif platform != "cpu" and native_available():
         # the native 4-process share is the measured path; a relay flap is
         # transient (sessions drain in ~30 s), so retry before giving up
         for attempt in range(2):
@@ -753,6 +880,13 @@ def main() -> None:
                 violations = sum(o["violations"] for o in outs)
                 native = True
                 phase_note("native_share", attempt=attempt, rc=0)
+                save_arm("share", {
+                    "platform": platform,
+                    "per_tenant_img_s": per_tenant,
+                    "violations": violations, "native_shim": True,
+                    "info": info, "quota_bytes": int(quota),
+                })
+                arm_sources["share"] = "live"
                 break
             if attempt == 0:
                 log("native share retrying after backoff")
@@ -796,6 +930,7 @@ def main() -> None:
         "hbm_quota_bytes": int(quota),
         "native_shim": native,
         "fallback_reason": fallback_reason,
+        "arm_sources": arm_sources,
         "phase_log": PHASE_LOG,
         **info,
     }
@@ -803,7 +938,11 @@ def main() -> None:
     # metric: bounded by remaining wall budget and a blanket try/except
     budget_s = float(os.environ.get("VTPU_BENCH_BUDGET_S", "2400"))
     elapsed_s = time.monotonic() - T_START
-    if (
+    cached_oversub = load_arm("oversub") if platform != "cpu" else None
+    if cached_oversub is not None:
+        extra["oversubscribe"] = cached_oversub.get("probe", {})
+        arm_sources["oversub"] = arm_stamp(cached_oversub)
+    elif (
         native
         and os.environ.get("VTPU_BENCH_OVERSUB", "1") != "0"
         and elapsed_s < budget_s - 600
@@ -816,6 +955,9 @@ def main() -> None:
         if probe is not None:
             extra["oversubscribe"] = probe
             log(f"oversubscribe probe: {probe}")
+            if probe.get("arms_ok"):
+                save_arm("oversub", {"platform": platform, "probe": probe})
+                arm_sources["oversub"] = "live"
     if excl_per_proc:
         extra["exclusive_per_proc_img_s"] = [round(r, 2) for r in excl_per_proc]
     if excl_per_proc and native:

@@ -1,7 +1,9 @@
 #!/usr/bin/env python3
 """Workload-layer kernel microbenchmark: the Pallas flash-attention
 path vs plain-XLA reference attention, forward and training
-(value_and_grad), on serving/training shapes.
+(value_and_grad), on serving/training shapes — plus a bf16 matmul
+roofline point that anchors what MFU this chip/transport can reach at
+all, so the attention numbers have a ceiling to be read against.
 
 The reference framework has no kernel layer (SURVEY.md §2.9) — this
 measures where vtpu goes beyond it: the fused attention never
@@ -31,6 +33,10 @@ SHAPES = [
     (1, 8, 4096, 128),
 ]
 
+# dense bf16 peak TFLOP/s per chip, public spec sheets; the MFU
+# denominator (PALLAS_AXON_TPU_GEN selects the generation)
+PEAK_BF16_TFLOPS = {"v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0}
+
 
 def timed(fn, *args, seconds: float) -> float:
     import jax
@@ -46,6 +52,31 @@ def timed(fn, *args, seconds: float) -> float:
     return n / (time.monotonic() - t0)
 
 
+def peak_tflops() -> float:
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
+    return PEAK_BF16_TFLOPS.get(gen, PEAK_BF16_TFLOPS["v5e"])
+
+
+def matmul_roofline(seconds: float, n: int = 4096) -> dict:
+    """One large bf16 matmul: the achievable-MFU anchor.  If attention
+    MFU looks low, this row says whether the kernel or the
+    chip/transport ceiling is to blame."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jax.random.normal(jax.random.PRNGKey(1), (n, n), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(2), (n, n), jnp.bfloat16)
+    f = jax.jit(lambda x, y: x @ y)
+    it_s = timed(f, a, b, seconds=seconds)
+    tflops = 2.0 * n ** 3 * it_s / 1e12
+    return {
+        "matmul_n": n,
+        "matmul_it_s": round(it_s, 2),
+        "matmul_tflops": round(tflops, 2),
+        "matmul_mfu": round(tflops / peak_tflops(), 4),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--seconds", type=float, default=5.0)
@@ -53,13 +84,20 @@ def main(argv=None) -> int:
     p.add_argument("--causal", action="store_true")
     args = p.parse_args(argv)
 
+    import bench  # repo root: watchdog + retrying backend init
+
+    cancel = bench._init_watchdog(240.0, 11)
+    devices = bench.init_devices()
+    cancel()
+
     import jax
     import jax.numpy as jnp
 
     from vtpu.ops.attention import flash_attention, reference_attention
 
-    platform = jax.devices()[0].platform
+    platform = devices[0].platform
     rows = []
+    roofline = matmul_roofline(args.seconds) if platform != "cpu" else {}
     for b, h, s, d in SHAPES:
         q = jax.random.normal(
             jax.random.PRNGKey(0), (b, h, s, d), jnp.bfloat16
@@ -114,11 +152,28 @@ def main(argv=None) -> int:
             row["train_speedup"] = round(
                 row["train_flash_it_s"] / max(row["train_ref_it_s"], 1e-9), 3
             )
+            # attention matmul FLOPs: QK^T + PV = 4*b*h*s²*d (causal
+            # halves the useful work); MFU is for the FORWARD kernel —
+            # the apples-to-apples number against the matmul roofline
+            flops_fwd = 4.0 * b * h * s * s * d * (0.5 if args.causal else 1)
+            row["fwd_flash_tflops"] = round(
+                flops_fwd * row["fwd_flash_it_s"] / 1e12, 2
+            )
+            row["fwd_flash_mfu"] = round(
+                row["fwd_flash_tflops"] / peak_tflops(), 4
+            )
         rows.append(row)
         if not args.json:
             print(row)
+    out = {
+        "kernel_bench": rows,
+        "peak_bf16_tflops": peak_tflops(),
+        **roofline,
+    }
     if args.json:
-        print(json.dumps({"kernel_bench": rows}))
+        print(json.dumps(out))
+    elif roofline:
+        print(roofline)
     return 0
 
 

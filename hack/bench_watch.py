@@ -1,0 +1,99 @@
+"""Seize the next TPU window: wait (with long jittered backoff) until the
+relayed PJRT backend accepts sessions, then run the bench suite once —
+``bench.py`` (persists per-arm state under docs/artifacts/bench_state/)
+followed by ``benchmarks/kernels.py --json`` (the on-chip kernel/MFU
+artifact).  Outputs land under docs/artifacts/; each completed piece is
+durable on its own, so a transport outage mid-suite keeps whatever was
+already measured (the r3 failure mode this tool exists for).
+
+Usage:  python hack/bench_watch.py [--max-wait-hours H]
+Writes: docs/artifacts/bench_watch_status.json   (heartbeat + outcome)
+        docs/artifacts/bench_state/arm_*.json    (via bench.py)
+        docs/artifacts/kernels_tpu.json          (via kernels.py)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ART = os.path.join(REPO, "docs", "artifacts")
+STATUS = os.path.join(ART, "bench_watch_status.json")
+
+
+def note(state: str, **kw) -> None:
+    os.makedirs(ART, exist_ok=True)
+    rec = {"state": state, "unix": time.time(),
+           "t": time.strftime("%Y-%m-%d %H:%M:%S"), **kw}
+    with open(STATUS + ".tmp", "w") as f:
+        json.dump(rec, f, indent=1)
+    os.replace(STATUS + ".tmp", STATUS)
+    print(f"[bench_watch] {rec['t']} {state} {kw}", flush=True)
+
+
+def run_step(name: str, cmd: list, timeout: float, out_path: str | None):
+    note(f"{name}:start")
+    try:
+        proc = subprocess.run(
+            cmd, cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        note(f"{name}:timeout", timeout_s=timeout)
+        return False
+    tail = proc.stderr[-1500:] if proc.stderr else ""
+    if proc.returncode != 0:
+        note(f"{name}:failed", rc=proc.returncode, stderr_tail=tail)
+        return False
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(proc.stdout)
+    note(f"{name}:done", rc=0)
+    sys.stdout.write(proc.stdout[-2000:])
+    return True
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-wait-hours", type=float, default=11.0)
+    args = ap.parse_args()
+
+    import bench  # the gate + arm helpers live there
+
+    deadline = time.monotonic() + args.max_wait_hours * 3600
+    cycle = 0
+    while time.monotonic() < deadline:
+        cycle += 1
+        note("probing", cycle=cycle)
+        # one gate call = up to ~5 min of jittered probes; between gate
+        # calls sleep longer so a dead transport isn't hammered all day
+        if bench.wait_backend_ready(max_wait_s=300):
+            note("backend_up", cycle=cycle)
+            ok_bench = run_step(
+                "bench", [sys.executable, "bench.py"], 3000,
+                os.path.join(ART, "bench_watch_bench.json"),
+            )
+            run_step(
+                "kernels",
+                [sys.executable, os.path.join("benchmarks", "kernels.py"),
+                 "--json"],
+                1800,
+                os.path.join(ART, "kernels_tpu.json"),
+            )
+            if ok_bench:
+                note("complete", cycle=cycle)
+                return
+            # bench failed though the gate passed (flap mid-run): the
+            # persisted arms keep partial progress; retry next window
+        time.sleep(240)
+    note("gave_up", cycles=cycle)
+
+
+if __name__ == "__main__":
+    main()

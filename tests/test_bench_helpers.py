@@ -124,3 +124,66 @@ def test_parse_shim_stats():
     assert st["exec"]["calls"] == 10 and st["size_rtts"] == 0
     assert bench.parse_shim_stats("no stats here") is None
     assert bench.parse_shim_stats('{"vtpu_shim_stats": 3}') is None
+
+
+def test_arm_persistence_roundtrip(monkeypatch, tmp_path):
+    """Arms persist atomically and reload while fresh; CPU arms and
+    stale arms are never reused; VTPU_BENCH_FRESH bypasses the cache."""
+    monkeypatch.setattr(bench, "STATE_DIR", str(tmp_path))
+    bench.save_arm("exclusive", {"platform": "tpu", "exclusive_img_s": 123.0})
+    rec = bench.load_arm("exclusive")
+    assert rec is not None and rec["exclusive_img_s"] == 123.0
+    assert rec["measured_unix"] > 0
+
+    bench.save_arm("share", {"platform": "cpu", "per_tenant_img_s": [1.0]})
+    assert bench.load_arm("share") is None  # CPU results never stitch
+
+    monkeypatch.setattr(bench, "STATE_MAX_AGE_S", 0.0)
+    assert bench.load_arm("exclusive") is None  # stale
+    monkeypatch.setattr(bench, "STATE_MAX_AGE_S", 3600.0)
+    monkeypatch.setenv("VTPU_BENCH_FRESH", "1")
+    assert bench.load_arm("exclusive") is None  # explicit fresh run
+
+
+def test_main_stitches_cached_arms(monkeypatch, tmp_path, capsys):
+    """With all three arms cached from an earlier TPU window, main()
+    emits a complete platform=tpu artifact WITHOUT touching any backend
+    — the r3 outage scenario (transport dead at round end) now still
+    yields the round's TPU evidence."""
+    import json
+
+    monkeypatch.setattr(bench, "STATE_DIR", str(tmp_path))
+    bench.save_arm("exclusive", {
+        "platform": "tpu", "exclusive_img_s": 11000.0,
+        "per_proc": [2750.0] * 4, "hbm_bytes": 16 * 1024**3,
+        "window_s": 10.0, "mode": "4proc_noshim",
+    })
+    bench.save_arm("share", {
+        "platform": "tpu", "per_tenant_img_s": [2712.0] * 4,
+        "violations": 0, "native_shim": True,
+        "info": {"region_procs": 4}, "quota_bytes": 4 * 1024**3,
+    })
+    bench.save_arm("oversub", {
+        "platform": "tpu",
+        "probe": {"quota_mb": 384, "arms_ok": 3, "swap_bytes": 123},
+    })
+
+    def boom(*_a, **_kw):
+        raise AssertionError("backend touched despite cached arms")
+
+    monkeypatch.setattr(bench, "wait_backend_ready", boom)
+    monkeypatch.setattr(bench, "run_native_share", boom)
+    monkeypatch.setattr(bench, "run_exclusive_child", boom)
+    monkeypatch.setattr(bench, "run_share_child", boom)
+    monkeypatch.setattr(bench.sys, "argv", ["bench.py"])
+    bench.main()
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "resnet50_4way_share_efficiency"
+    assert out["extra"]["platform"] == "tpu"
+    assert out["extra"]["native_shim"] is True
+    assert out["extra"]["exclusive_mode"] == "4proc_noshim"
+    assert 0.98 < out["value"] < 0.99  # 4*2712 / 11000
+    assert out["extra"]["oversubscribe"]["swap_bytes"] == 123
+    srcs = out["extra"]["arm_sources"]
+    assert set(srcs) == {"exclusive", "share", "oversub"}
+    assert all(s.startswith("cached@") for s in srcs.values())
