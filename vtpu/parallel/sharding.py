@@ -34,6 +34,29 @@ def shard_params(params, mesh: Mesh, tp_axis: str = "tp"):
     return jax.tree_util.tree_map_with_path(place, params)
 
 
+def place_global(x, mesh: Mesh, spec: P):
+    """Place host-local data (same values on every process) onto a
+    global mesh sharding.  ``jax.device_put`` cannot target
+    non-addressable devices, so multi-host code paths build the global
+    array from each host's local shards instead; single-process runs
+    get the identical result."""
+    import numpy as np
+
+    arr = np.asarray(x)
+    sh = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(arr.shape, sh, lambda idx: arr[idx])
+
+
+def shard_params_global(params, mesh: Mesh, tp_axis: str = "tp"):
+    """Multi-host-safe :func:`shard_params`: requires every process to
+    hold identical param values (same init rng), which flax init
+    guarantees."""
+    def place(path, x):
+        return place_global(x, mesh, param_spec(path, x, mesh, tp_axis))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
 def make_train_step(
     model, mesh: Mesh, optimizer=None, dp_axis: str = "dp", tp_axis: str = "tp"
 ) -> Callable:
