@@ -6,7 +6,8 @@ VERSION ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMG ?= vtpu/vtpu
 PY ?= python3
 
-.PHONY: all build shim proto test test-native bench image chart clean tidy
+.PHONY: all build shim proto test test-slow test-all test-native bench \
+	image chart clean tidy
 
 all: build
 
@@ -18,8 +19,16 @@ shim:
 proto:
 	$(MAKE) -C protos
 
+# fast lane (default via pytest.ini addopts): control-plane tests, < 60 s
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# JAX workload lane: CPU-mesh compiles (minutes)
+test-slow:
+	$(PY) -m pytest tests/ -x -q -m slow
+
+test-all:
+	$(PY) -m pytest tests/ -x -q -m ""
 
 # native unit tests: shim against the mock PJRT plugin (same env the
 # pytest runner in tests/test_region.py uses)

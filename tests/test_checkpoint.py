@@ -1,6 +1,10 @@
 """Sharded checkpoint/resume over the virtual CPU mesh: save a sharded
 train state, restore into fresh shardings, shardings and values intact."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # JAX workload lane (CPU-mesh compiles)
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -2,6 +2,10 @@
 multi-process path is exercised on real gangs where the chart sets the
 VTPU_COORDINATOR env contract)."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # JAX workload lane (CPU-mesh compiles)
+
 from vtpu.parallel import distributed
 
 

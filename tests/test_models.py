@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # JAX workload lane (CPU-mesh compiles)
+
 from vtpu.models import MODELS, create_model
 from vtpu.ops import flash_attention, fused_layernorm
 from vtpu.ops.attention import reference_attention

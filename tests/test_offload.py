@@ -1,6 +1,11 @@
 """Host-offload helpers (vtpu.utils.offload): tiered training state
 round-trips and the offloaded-optimizer update pattern."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # JAX workload lane (CPU-mesh compiles)
+
+
 
 def test_host_offload_roundtrip_and_update_pattern():
     """Offload helpers: tree round-trips host<->device with values

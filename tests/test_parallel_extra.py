@@ -10,6 +10,9 @@ from jax.sharding import Mesh
 from vtpu.parallel.moe import moe_ffn
 from vtpu.parallel.pipeline import pipeline_apply
 
+pytestmark = pytest.mark.slow  # JAX workload lane (CPU-mesh compiles)
+
+
 
 def test_pipeline_matches_sequential():
     devs = np.array(jax.devices())

@@ -12,6 +12,9 @@ from vtpu.models.transformer import TransformerLM, lm_loss, tp_param_specs
 
 TINY = dict(vocab=128, d_model=64, depth=2, num_heads=4, max_seq=64)
 
+pytestmark = pytest.mark.slow  # JAX workload lane (CPU-mesh compiles)
+
+
 
 def assert_greedy_decode_matches(model, params, prompt, n):
     """Shared contract check: generate() must equal n cache-less greedy
