@@ -26,7 +26,9 @@ def _templates():
     out = []
     for root, _dirs, files in os.walk(os.path.join(CHART, "templates")):
         for f in files:
-            if f.endswith((".yaml", ".tpl")):
+            # NOTES.txt is a template too — its .Values typos render as
+            # "<no value>" at install time just like yaml ones
+            if f.endswith((".yaml", ".tpl", ".txt")):
                 p = os.path.join(root, f)
                 out.append((os.path.relpath(p, CHART), open(p).read()))
     return out

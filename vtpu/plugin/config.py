@@ -109,6 +109,8 @@ class PluginConfig:
                             cfg.device_memory_scaling = float(entry["devicememoryscaling"])
                         if "devicesplitcount" in entry:
                             cfg.device_split_count = int(entry["devicesplitcount"])
+                        if "devicecoresscaling" in entry:
+                            cfg.device_cores_scaling = float(entry["devicecoresscaling"])
                         if "partitionstrategy" in entry:
                             cfg.partition_strategy = str(entry["partitionstrategy"])
                         log.info("applied per-node config overrides for %s", cfg.node_name)
