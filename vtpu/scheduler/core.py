@@ -315,14 +315,18 @@ class Scheduler:
             if nu is None:
                 failed[name] = "no vtpu devices registered"
                 continue
-            snap = score_mod.snapshot(name, nu.devices, nu.topology)
+            # nodes_usage() built nu fresh for THIS filter call, so
+            # fit_pod may book into it directly — a second defensive
+            # snapshot copy per node doubled the hot loop's copy cost
+            # (each node is evaluated once; a rejected node's partial
+            # bookings are never read again)
             placement = score_mod.fit_pod(
-                snap, reqs, pod_annos, self.config.node_scheduler_policy, ici_policy
+                nu, reqs, pod_annos, self.config.node_scheduler_policy, ici_policy
             )
             if placement is None:
                 failed[name] = "insufficient vtpu resources"
                 continue
-            s = score_mod.score_node(snap, self.config.node_scheduler_policy)
+            s = score_mod.score_node(nu, self.config.node_scheduler_policy)
             if best is None or s > best[0]:
                 best = (s, name, placement)
         if best is None:

@@ -21,6 +21,7 @@ chips free for gangs; "spread" maximises headroom per share.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 from typing import Dict, List, Optional, Tuple
 
@@ -70,6 +71,14 @@ class DeviceUsage:
             coords=ci.coords,
         )
 
+    def clone(self) -> "DeviceUsage":
+        """Fast field copy (used by :func:`snapshot` for callers that
+        need an isolated view, e.g. tests; the filter hot loop books
+        directly into its own per-call usage objects instead)."""
+        new = object.__new__(DeviceUsage)
+        new.__dict__.update(self.__dict__)
+        return new
+
 
 @dataclasses.dataclass
 class NodeUsage:
@@ -78,22 +87,33 @@ class NodeUsage:
     topology: str = ""
 
 
+@functools.lru_cache(maxsize=4096)
+def _type_allowed(dev_type: str, req_type: str, use: str, nouse: str) -> bool:
+    """The string work of check_type, memoized: a cluster has a handful
+    of distinct (device type, request type, selector) combinations but
+    the filter walk evaluates one per device per node per pod."""
+    if not dev_type.upper().startswith(req_type.upper()):
+        return False
+    if use:
+        wanted = [w.strip() for w in use.split(",") if w.strip()]
+        if wanted and not any(w.lower() in dev_type.lower() for w in wanted):
+            return False
+    if nouse:
+        banned = [w.strip() for w in nouse.split(",") if w.strip()]
+        if any(b.lower() in dev_type.lower() for b in banned):
+            return False
+    return True
+
+
 def check_type(pod_annos: Dict[str, str], dev: DeviceUsage, req: ContainerDeviceRequest) -> bool:
     """Vendor prefix + use/nouse selector annotations (ref checkType
     score.go:135-154, checkGPUtype :67-99)."""
-    if not dev.type.upper().startswith(req.type.upper()):
-        return False
-    use = pod_annos.get(annotations.USE_TPUTYPE, "")
-    if use:
-        wanted = [w.strip() for w in use.split(",") if w.strip()]
-        if wanted and not any(w.lower() in dev.type.lower() for w in wanted):
-            return False
-    nouse = pod_annos.get(annotations.NOUSE_TPUTYPE, "")
-    if nouse:
-        banned = [w.strip() for w in nouse.split(",") if w.strip()]
-        if any(b.lower() in dev.type.lower() for b in banned):
-            return False
-    return True
+    return _type_allowed(
+        dev.type,
+        req.type,
+        pod_annos.get(annotations.USE_TPUTYPE, ""),
+        pod_annos.get(annotations.NOUSE_TPUTYPE, ""),
+    )
 
 
 def _mem_for(dev: DeviceUsage, req: ContainerDeviceRequest) -> int:
@@ -110,15 +130,17 @@ def _mem_for(dev: DeviceUsage, req: ContainerDeviceRequest) -> int:
 def fits_device(
     dev: DeviceUsage, req: ContainerDeviceRequest, pod_annos: Dict[str, str]
 ) -> bool:
-    """One chip share fit check (ref score.go:188-231)."""
+    """One chip share fit check (ref score.go:188-231).  Numeric gates
+    run before the (memoized) string check — they reject most devices
+    on busy clusters at a fraction of the cost."""
     if not dev.health:
-        return False
-    if not check_type(pod_annos, dev, req):
         return False
     if dev.used >= dev.count:
         return False
     if dev.usedcores >= 100:
         return False  # exclusive occupant blocks all comers (:203-209)
+    if not check_type(pod_annos, dev, req):
+        return False
     if req.coresreq >= 100 and (dev.used > 0 or dev.usedcores > 0 or dev.usedmem > 0):
         return False  # exclusive request needs a virgin chip
     if dev.totalmem - dev.usedmem < _mem_for(dev, req):
@@ -192,8 +214,13 @@ def fit_pod(
 ) -> Optional[PodDevices]:
     """Simulate placing every container of the pod on this node, booking
     usage as it goes (ref calcScore's container walk, score.go:156-250).
-    Mutates ``node`` (callers pass a snapshot copy).  Returns per-container
-    assignments or None."""
+
+    MUTATES ``node`` — the caller hands over exclusive ownership.  On a
+    None return the node may hold PARTIAL bookings (earlier containers
+    booked before a later one failed); it must be discarded, never read
+    again (the filter loop builds fresh usage objects per call; other
+    callers pass a :func:`snapshot`).  Returns per-container assignments
+    or None."""
     result: PodDevices = []
     for ctr_reqs in requests:
         ctr_devs: List[ContainerDevice] = []
@@ -221,5 +248,5 @@ def score_node(node: NodeUsage, policy: str = "binpack") -> float:
 
 def snapshot(node_name: str, devices: List[DeviceUsage], topology: str) -> NodeUsage:
     return NodeUsage(
-        node=node_name, devices=[dataclasses.replace(d) for d in devices], topology=topology
+        node=node_name, devices=[d.clone() for d in devices], topology=topology
     )
