@@ -37,14 +37,23 @@ def rope(x, positions, base: float = 10000.0):
     """Rotary position embedding on the head dim: x [..., s, d] (d even)
     rotated by per-position angles — attention scores then depend only
     on RELATIVE distance, the long-context-friendly property (no learned
-    table, extrapolates past training length).  ``positions`` [s] are
-    ABSOLUTE token positions, which makes the same function correct for
+    table, extrapolates past training length).  ``positions`` are
+    ABSOLUTE token positions, [s] (shared by every batch row) or [b, s]
+    (per-row, the continuous-batching decode case where slots sit at
+    different depths); either way the same function stays correct for
     full forwards, ring/striped sequence shards (pass the shard's global
-    positions), and KV-cache decode (pass pos0 + arange)."""
+    positions), and KV-cache decode (pass pos + arange)."""
     assert x.shape[-1] % 2 == 0, "RoPE needs an even head dim"
     half = x.shape[-1] // 2
     freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = positions[:, None].astype(jnp.float32) * freqs  # [s, half]
+    ang = positions[..., :, None].astype(jnp.float32) * freqs  # [..., s, half]
+    if ang.ndim == 3:
+        # per-row positions: align [b, s, half] with x [b, ..., s, d] by
+        # inserting singleton axes for whatever sits between batch and
+        # seq (heads for [b, h, s, d]; nothing for [b, s, d])
+        ang = ang.reshape(
+            ang.shape[0], *([1] * (x.ndim - 3)), ang.shape[1], ang.shape[2]
+        )
     cos, sin = jnp.cos(ang), jnp.sin(ang)
     x1, x2 = x[..., :half], x[..., half:]
     out = jnp.concatenate(
@@ -95,9 +104,14 @@ class Attention(nn.Module):
         q, k, v = heads(q, self.num_heads), heads(k, n_kv), heads(v, n_kv)
         if self.use_rope:
             # rotate with ABSOLUTE positions; the cache then holds
-            # rotated keys, so decode needs no re-rotation of history
-            start = pos0 if (decode and pos0 is not None) else 0
-            positions = start + jnp.arange(s)
+            # rotated keys, so decode needs no re-rotation of history.
+            # pos0 may be per-row [b] (continuous batching) — positions
+            # then become [b, s] and rope broadcasts per row.
+            if decode and pos0 is not None:
+                start = jnp.broadcast_to(jnp.asarray(pos0), (b,))
+                positions = start[:, None] + jnp.arange(s)[None]
+            else:
+                positions = jnp.arange(s)
             q = rope(q, positions)
             k = rope(k, positions)
         if decode:
@@ -105,8 +119,9 @@ class Attention(nn.Module):
             # max_seq-long, masked by position — no dynamic shapes under
             # jit).  Works for prefill (s = prompt len) and incremental
             # steps (s = 1) alike.  ``pos0`` (this block's first global
-            # position) comes down from the model's SINGLE position
-            # counter — per-layer counters could drift from it.
+            # position, scalar or per-row [b]) comes down from the
+            # model's SINGLE position counter — per-layer counters could
+            # drift from it.
             assert pos0 is not None, "decode=True requires pos0"
             ck = self.variable(
                 "cache", "k", jnp.zeros,
@@ -116,15 +131,21 @@ class Attention(nn.Module):
                 "cache", "v", jnp.zeros,
                 (b, n_kv, self.max_seq, hd), v.dtype,
             )
-            i0 = pos0
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, 0, i0, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, 0, i0, 0))
+            pos_b = jnp.broadcast_to(jnp.asarray(pos0), (b,))
+
+            def upd(cache_row, new_row, p):
+                return jax.lax.dynamic_update_slice(
+                    cache_row, new_row, (0, p, 0)
+                )
+
+            ck.value = jax.vmap(upd)(ck.value, k, pos_b)
+            cv.value = jax.vmap(upd)(cv.value, v, pos_b)
             kpos = jnp.arange(self.max_seq)
-            qpos = i0 + jnp.arange(s)
-            mask = kpos[None, :] <= qpos[:, None]       # [s, max_seq]
+            qpos = pos_b[:, None] + jnp.arange(s)[None]  # [b, s]
+            mask = kpos[None, None, :] <= qpos[:, :, None]  # [b, s, max_seq]
             if self.window > 0:
                 mask = jnp.logical_and(
-                    mask, kpos[None, :] > qpos[:, None] - self.window
+                    mask, kpos[None, None, :] > qpos[:, :, None] - self.window
                 )
             # grouped einsum: each kv head serves its group of q heads
             # directly from the SMALL cache — no head repetition
@@ -133,7 +154,7 @@ class Attention(nn.Module):
             scores = jnp.einsum(
                 "bngqd,bnkd->bngqk", qg, ck.value
             ).astype(jnp.float32) * (hd ** -0.5)
-            scores = jnp.where(mask[None, None, None], scores, -1e30)
+            scores = jnp.where(mask[:, None, None], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             o = jnp.einsum(
                 "bngqk,bnkd->bngqd", probs, cv.value.astype(jnp.float32)
@@ -255,12 +276,15 @@ class TransformerLM(nn.Module):
         pos0 = None
         if decode:
             # the ONE position counter — layers receive it, none keep
-            # their own (drift-proof)
+            # their own (drift-proof).  Per-ROW [b], so slots of a
+            # continuously-batched decode can sit at different depths;
+            # lockstep callers just see every row advance together.
             pos_var = self.variable(
-                "cache", "pos", lambda: jnp.zeros((), jnp.int32)
+                "cache", "pos", lambda: jnp.zeros((b,), jnp.int32)
             )
-            pos0 = pos_var.value
-            pos_ids = pos0 + jnp.arange(s)
+            pos0 = pos_var.value                      # [b] (or scalar
+            pos0 = jnp.broadcast_to(jnp.asarray(pos0), (b,))  # legacy)
+            pos_ids = pos0[:, None] + jnp.arange(s)[None]     # [b, s]
             pos_var.value = pos0 + s
         else:
             pos_ids = jnp.arange(s)
@@ -275,9 +299,10 @@ class TransformerLM(nn.Module):
             )
         use_rope = self.pos_embedding == "rope"
         if not use_rope:
-            x = x + nn.Embed(self.max_seq, self.d_model, name="wpe")(
-                pos_ids[None, :]
-            )
+            wpe = nn.Embed(self.max_seq, self.d_model, name="wpe")
+            # decode: per-row positions [b, s]; full forward: shared [s]
+            x = x + (wpe(pos_ids) if pos_ids.ndim == 2
+                     else wpe(pos_ids[None, :]))
         for i in range(self.depth):
             x = Block(self.num_heads, max_seq=self.max_seq,
                       num_kv_heads=self.num_kv_heads, use_rope=use_rope,
@@ -476,7 +501,8 @@ def generate_speculative(model: TransformerLM, params,
 
     def set_pos(cache, pos):
         c = dict(cache)
-        c["pos"] = jnp.asarray(pos, cache["pos"].dtype)
+        # full_like keeps the counter's shape ([b] per-row vector)
+        c["pos"] = jnp.full_like(cache["pos"], pos)
         return c
 
     @jax.jit

@@ -301,6 +301,10 @@ class Scheduler:
         self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs=None
     ) -> FilterResult:
         usage = self.nodes_usage(exclude_uid=pod_uid(pod))
+        # fit_pod books into the per-call usage objects, so each node
+        # must be evaluated at most once — a duplicate entry would see
+        # (and double-count) the first evaluation's bookings
+        node_names = list(dict.fromkeys(node_names))
         ici_policy = pod_annos.get("vtpu.io/ici-policy", self.config.ici_policy)
         best: Optional[Tuple[float, str, object]] = None
         failed: Dict[str, str] = {}

@@ -1,0 +1,5 @@
+"""Serving tier: continuous batching over the LM family's KV cache."""
+
+from vtpu.serving.batcher import ContinuousBatcher
+
+__all__ = ["ContinuousBatcher"]

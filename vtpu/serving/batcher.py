@@ -1,0 +1,190 @@
+"""Continuous batching for the TransformerLM serving path.
+
+The static-shape, TPU-first take on vLLM-style continuous batching: ONE
+compiled decode step over a fixed ``[max_batch]`` slot array, where each
+slot is an independent request at its own depth (the per-row position
+counter added to TransformerLM makes rows independent).  Requests join
+mid-flight — a finished slot is freed and the next queued request's
+prefill is scattered into it while every other slot keeps decoding —
+so the chip never drains the whole batch to admit new work.
+
+Why this shape on TPU:
+- the step function compiles ONCE ([max_batch, 1] tokens, [b] positions;
+  no dynamic shapes), so admission/retirement never retraces;
+- prefill compiles per distinct prompt length (pad prompts client-side
+  to a few buckets to bound compile count);
+- inactive slots still run the decode math on garbage rows — uniform
+  compute is the price of static shapes, and it is MXU-cheap at s=1.
+
+Greedy decoding (the exactness contract: every request's output is
+token-identical to a solo ``generate()`` call — test-pinned).
+
+Typical use::
+
+    eng = ContinuousBatcher(model, params, max_batch=8, eos_id=2)
+    eng.submit("a", prompt_a, num_new=16)
+    eng.submit("b", prompt_b, num_new=7)
+    ...
+    outs = eng.run()          # {"a": [16 tokens], "b": [7 tokens]}
+
+The reference framework has no serving layer at all (SURVEY.md §2.9) —
+this rides the vtpu workload tier's KV-cache machinery
+(vtpu/models/transformer.py decode path)."""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vtpu.models.transformer import TransformerLM, _zero_cache
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: str
+    prompt: np.ndarray  # [s] int32
+    num_new: int
+
+
+class ContinuousBatcher:
+    """Slot-based continuous batching over the shared KV cache."""
+
+    def __init__(self, model: TransformerLM, params, max_batch: int,
+                 eos_id: Optional[int] = None):
+        self.model = model
+        self.params = params
+        self.max_batch = max_batch
+        self.eos_id = eos_id
+        # batch cache: max_batch rows, each row an independent request
+        dummy = jnp.zeros((max_batch, 1), jnp.int32)
+        self.cache = _zero_cache(model, dummy)
+        self.tok = jnp.zeros((max_batch,), jnp.int32)  # last token per slot
+        # host-side slot state (drives admission/retirement; the device
+        # never sees it — no dynamic shapes)
+        self.active = [False] * max_batch
+        self.remaining = [0] * max_batch
+        self.done_frozen = [False] * max_batch
+        self.rid: List[Optional[str]] = [None] * max_batch
+        self.out: Dict[str, List[int]] = {}
+        self.queue: collections.deque[_Request] = collections.deque()
+        self.steps = 0  # decode forwards executed (batch-wide)
+
+        @jax.jit
+        def _step(params, cache, tok):
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, tok[:, None],
+                decode=True, mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return nxt, mut["cache"]
+
+        self._step = _step
+
+        @jax.jit  # caches one program per distinct prompt length
+        def _prefill(params, cache, prompt):
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, prompt,
+                decode=True, mutable=["cache"],
+            )
+            return logits, mut["cache"]
+
+        self._prefill = _prefill
+
+        @jax.jit
+        def _scatter(batch_cache, row_cache, slot):
+            """Write a b=1 prefill cache into row ``slot`` of the batch
+            cache (whole-row replace: stale K/V from the slot's previous
+            tenant must go, masking only protects positions >= pos)."""
+            def put(b_leaf, r_leaf):
+                return jax.lax.dynamic_update_slice(
+                    b_leaf, r_leaf.astype(b_leaf.dtype),
+                    (slot,) + (0,) * (b_leaf.ndim - 1),
+                )
+            return jax.tree.map(put, batch_cache, row_cache)
+
+        self._scatter = _scatter
+
+    # ------------------------------------------------------------------
+    def submit(self, rid: str, prompt, num_new: int) -> None:
+        """Queue a request; admitted as soon as a slot frees up."""
+        if num_new < 1:
+            raise ValueError(f"num_new must be >= 1, got {num_new}")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size + num_new > self.model.max_seq:
+            raise ValueError(
+                f"prompt ({prompt.size}) + num_new ({num_new}) exceeds "
+                f"max_seq ({self.model.max_seq})"
+            )
+        if rid in self.out or any(r.rid == rid for r in self.queue):
+            raise ValueError(f"duplicate request id {rid!r}")
+        self.queue.append(_Request(rid, prompt, num_new))
+        self._admit_pending()
+
+    def _free_slots(self) -> List[int]:
+        return [i for i in range(self.max_batch) if not self.active[i]]
+
+    def _admit_pending(self) -> None:
+        for slot in self._free_slots():
+            if not self.queue:
+                return
+            req = self.queue.popleft()
+            self._admit(slot, req)
+
+    def _admit(self, slot: int, req: _Request) -> None:
+        # b=1 prefill in a fresh single-row cache (jitted: compiles once
+        # per prompt length), then scatter the row into the batch cache
+        prompt = jnp.asarray(req.prompt)[None, :]
+        logits, row_cache = self._prefill(
+            self.params, _zero_cache(self.model, prompt), prompt
+        )
+        self.cache = self._scatter(self.cache, row_cache, slot)
+        first = int(jnp.argmax(logits[0, -1]))
+        self.tok = self.tok.at[slot].set(first)
+        self.rid[slot] = req.rid
+        self.out[req.rid] = [first]
+        self.active[slot] = True
+        self.done_frozen[slot] = (
+            self.eos_id is not None and first == self.eos_id
+        )
+        self.remaining[slot] = req.num_new - 1
+        self._maybe_retire(slot)
+
+    def _maybe_retire(self, slot: int) -> None:
+        if self.remaining[slot] <= 0:
+            self.active[slot] = False
+            self.rid[slot] = None
+            self._admit_pending()
+
+    # ------------------------------------------------------------------
+    def step(self) -> None:
+        """One decode forward for EVERY slot; harvest active rows."""
+        if not any(self.active):
+            return
+        self.tok, self.cache = self._step(self.params, self.cache, self.tok)
+        self.steps += 1
+        toks = np.asarray(self.tok)
+        for i in range(self.max_batch):
+            if not self.active[i]:
+                continue
+            t = int(toks[i])
+            if self.done_frozen[i]:
+                # eos reached earlier: the row freezes (same static-shape
+                # semantics as generate()'s eos_id contract)
+                t = self.eos_id
+                self.tok = self.tok.at[i].set(t)
+            elif self.eos_id is not None and t == self.eos_id:
+                self.done_frozen[i] = True
+            self.out[self.rid[i]].append(t)
+            self.remaining[i] -= 1
+            self._maybe_retire(i)
+
+    def run(self) -> Dict[str, List[int]]:
+        """Drive until every submitted request has finished."""
+        while any(self.active) or self.queue:
+            self.step()
+        return self.out
