@@ -114,3 +114,10 @@ def test_submit_validation():
     eng.submit("x", np.zeros(4, np.int32), num_new=2)
     with pytest.raises(ValueError, match="duplicate"):
         eng.submit("x", np.zeros(4, np.int32), num_new=2)
+
+
+def test_empty_prompt_rejected():
+    model, params = make_model()
+    eng = ContinuousBatcher(model, params, max_batch=2)
+    with pytest.raises(ValueError, match="at least one token"):
+        eng.submit("x", np.zeros(0, np.int32), num_new=2)

@@ -138,8 +138,11 @@ class Attention(nn.Module):
                     cache_row, new_row, (0, p, 0)
                 )
 
-            ck.value = jax.vmap(upd)(ck.value, k, pos_b)
-            cv.value = jax.vmap(upd)(cv.value, v, pos_b)
+            # cast to the cache's dtype: a cache allocated under fp32
+            # init params must accept K/V computed under bf16 serving
+            # params (e.g. dequantized int8 weights) — upcast is exact
+            ck.value = jax.vmap(upd)(ck.value, k.astype(ck.value.dtype), pos_b)
+            cv.value = jax.vmap(upd)(cv.value, v.astype(cv.value.dtype), pos_b)
             kpos = jnp.arange(self.max_seq)
             qpos = pos_b[:, None] + jnp.arange(s)[None]  # [b, s]
             mask = kpos[None, None, :] <= qpos[:, :, None]  # [b, s, max_seq]

@@ -9,3 +9,10 @@ from vtpu.ops.attention import (  # noqa: F401
     flash_attention_with_lse,
     reference_attention,
 )
+from vtpu.ops.quant import (  # noqa: F401
+    dequantize_tree,
+    is_quantized,
+    quantize_int8,
+    quantize_tree,
+    tree_bytes,
+)

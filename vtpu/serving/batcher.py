@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from vtpu.models.transformer import TransformerLM, _zero_cache
+from vtpu.ops.quant import dequantize_tree
 
 
 @dataclasses.dataclass
@@ -73,12 +74,19 @@ class ContinuousBatcher:
         self.out: Dict[str, List[int]] = {}
         self.queue: collections.deque[_Request] = collections.deque()
         self.steps = 0  # decode forwards executed (batch-wide)
+        # zero-cache template per prompt length: building one is a full
+        # eval_shape trace of model.init — memoized so admission churn
+        # (the workload this engine exists for) doesn't re-trace
+        self._row_cache_tmpl: Dict[int, object] = {}
 
         @jax.jit
         def _step(params, cache, tok):
+            # dequantize INSIDE jit: a weight-only int8 tree
+            # (vtpu.ops.quant.quantize_tree) stays int8 at rest; XLA
+            # fuses the dequant into the matmuls.  No-op on fp params.
             logits, mut = model.apply(
-                {"params": params, "cache": cache}, tok[:, None],
-                decode=True, mutable=["cache"],
+                {"params": dequantize_tree(params), "cache": cache},
+                tok[:, None], decode=True, mutable=["cache"],
             )
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
             return nxt, mut["cache"]
@@ -88,8 +96,8 @@ class ContinuousBatcher:
         @jax.jit  # caches one program per distinct prompt length
         def _prefill(params, cache, prompt):
             logits, mut = model.apply(
-                {"params": params, "cache": cache}, prompt,
-                decode=True, mutable=["cache"],
+                {"params": dequantize_tree(params), "cache": cache},
+                prompt, decode=True, mutable=["cache"],
             )
             return logits, mut["cache"]
 
@@ -115,6 +123,8 @@ class ContinuousBatcher:
         if num_new < 1:
             raise ValueError(f"num_new must be >= 1, got {num_new}")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
         if prompt.size + num_new > self.model.max_seq:
             raise ValueError(
                 f"prompt ({prompt.size}) + num_new ({num_new}) exceeds "
@@ -139,8 +149,11 @@ class ContinuousBatcher:
         # b=1 prefill in a fresh single-row cache (jitted: compiles once
         # per prompt length), then scatter the row into the batch cache
         prompt = jnp.asarray(req.prompt)[None, :]
+        n = int(prompt.shape[1])
+        if n not in self._row_cache_tmpl:
+            self._row_cache_tmpl[n] = _zero_cache(self.model, prompt)
         logits, row_cache = self._prefill(
-            self.params, _zero_cache(self.model, prompt), prompt
+            self.params, self._row_cache_tmpl[n], prompt
         )
         self.cache = self._scatter(self.cache, row_cache, slot)
         first = int(jnp.argmax(logits[0, -1]))
@@ -167,7 +180,11 @@ class ContinuousBatcher:
             return
         self.tok, self.cache = self._step(self.params, self.cache, self.tok)
         self.steps += 1
-        toks = np.asarray(self.tok)
+        toks = np.array(self.tok)  # writable copy (asarray is read-only)
+        # pass 1: harvest + eos handling, batched into ONE device write
+        # (per-row .at[i].set would pay a dispatch per frozen row)
+        eos_fix = False
+        finished = []
         for i in range(self.max_batch):
             if not self.active[i]:
                 continue
@@ -176,12 +193,23 @@ class ContinuousBatcher:
                 # eos reached earlier: the row freezes (same static-shape
                 # semantics as generate()'s eos_id contract)
                 t = self.eos_id
-                self.tok = self.tok.at[i].set(t)
+                toks[i] = t
+                eos_fix = True
             elif self.eos_id is not None and t == self.eos_id:
                 self.done_frozen[i] = True
             self.out[self.rid[i]].append(t)
             self.remaining[i] -= 1
-            self._maybe_retire(i)
+            if self.remaining[i] <= 0:
+                finished.append(i)
+        if eos_fix:
+            self.tok = jnp.asarray(toks)
+        # pass 2: retire AFTER the tok fix-up — an admission scatters the
+        # new request's first token into self.tok, which a later
+        # wholesale write would clobber
+        for i in finished:
+            self.active[i] = False
+            self.rid[i] = None
+        self._admit_pending()
 
     def run(self) -> Dict[str, List[int]]:
         """Drive until every submitted request has finished."""
