@@ -121,3 +121,44 @@ def test_empty_prompt_rejected():
     eng = ContinuousBatcher(model, params, max_batch=2)
     with pytest.raises(ValueError, match="at least one token"):
         eng.submit("x", np.zeros(0, np.int32), num_new=2)
+
+
+def test_chunked_prefill_interleaves_and_stays_exact():
+    """prefill_chunk > 0: a long admission prefills one chunk per
+    step() while already-running slots keep decoding — tokens identical
+    to the non-chunked engine AND to solo generate()."""
+    model, params = make_model()
+    p_short, p_long = prompts_for(model, 2, [3, 12], seed=11)
+    want_short = np.asarray(
+        generate(model, params, jnp.asarray(p_short)[None], num_new=10)
+    )[0].tolist()
+    want_long = np.asarray(
+        generate(model, params, jnp.asarray(p_long)[None], num_new=6)
+    )[0].tolist()
+
+    eng = ContinuousBatcher(model, params, max_batch=4, prefill_chunk=3)
+    eng.submit("short", p_short, num_new=10)
+    for _ in range(2):
+        eng.step()  # "short" is decoding when the long prompt arrives
+    eng.submit("long", p_long, num_new=6)
+    assert eng.prefilling, "long prompt should be in chunked admission"
+    # interleaving: decode steps happen while the long slot prefills
+    decoded_during_prefill = 0
+    while eng.prefilling:
+        before = len(eng.out["short"])
+        eng.step()
+        decoded_during_prefill += len(eng.out["short"]) - before
+    assert decoded_during_prefill > 0, "prefill stalled running decode"
+    out = eng.run()
+    assert out["short"] == want_short
+    assert out["long"] == want_long
+
+
+def test_duplicate_rid_rejected_during_chunked_prefill():
+    model, params = make_model()
+    (p,) = prompts_for(model, 1, [10], seed=13)
+    eng = ContinuousBatcher(model, params, max_batch=2, prefill_chunk=3)
+    eng.submit("x", p, num_new=2)
+    assert eng.prefilling  # mid-admission
+    with pytest.raises(ValueError, match="duplicate"):
+        eng.submit("x", p, num_new=2)

@@ -56,11 +56,16 @@ class ContinuousBatcher:
     """Slot-based continuous batching over the shared KV cache."""
 
     def __init__(self, model: TransformerLM, params, max_batch: int,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None, prefill_chunk: int = 0):
         self.model = model
         self.params = params
         self.max_batch = max_batch
         self.eos_id = eos_id
+        # > 0: long prompts prefill in chunks INTERLEAVED with decode
+        # steps of the other slots (one chunk per step), so a long
+        # admission never stalls running requests' token latency
+        self.prefill_chunk = prefill_chunk
+        self.prefilling: Dict[int, dict] = {}  # slot → progress state
         # batch cache: max_batch rows, each row an independent request
         dummy = jnp.zeros((max_batch, 1), jnp.int32)
         self.cache = _zero_cache(model, dummy)
@@ -74,10 +79,11 @@ class ContinuousBatcher:
         self.out: Dict[str, List[int]] = {}
         self.queue: collections.deque[_Request] = collections.deque()
         self.steps = 0  # decode forwards executed (batch-wide)
-        # zero-cache template per prompt length: building one is a full
-        # eval_shape trace of model.init — memoized so admission churn
-        # (the workload this engine exists for) doesn't re-trace
-        self._row_cache_tmpl: Dict[int, object] = {}
+        # ONE zero-cache template for every admission: the cache's
+        # shapes ([1, n_kv, max_seq, hd] K/V, [1] pos) don't depend on
+        # prompt length, and building it is a full eval_shape trace of
+        # model.init — admission churn must not re-trace
+        self._row_tmpl = _zero_cache(model, jnp.zeros((1, 1), jnp.int32))
 
         @jax.jit
         def _step(params, cache, tok):
@@ -130,13 +136,18 @@ class ContinuousBatcher:
                 f"prompt ({prompt.size}) + num_new ({num_new}) exceeds "
                 f"max_seq ({self.model.max_seq})"
             )
-        if rid in self.out or any(r.rid == rid for r in self.queue):
+        if (
+            rid in self.out
+            or any(r.rid == rid for r in self.queue)
+            or any(st["req"].rid == rid for st in self.prefilling.values())
+        ):
             raise ValueError(f"duplicate request id {rid!r}")
         self.queue.append(_Request(rid, prompt, num_new))
         self._admit_pending()
 
     def _free_slots(self) -> List[int]:
-        return [i for i in range(self.max_batch) if not self.active[i]]
+        return [i for i in range(self.max_batch)
+                if not self.active[i] and i not in self.prefilling]
 
     def _admit_pending(self) -> None:
         for slot in self._free_slots():
@@ -146,15 +157,21 @@ class ContinuousBatcher:
             self._admit(slot, req)
 
     def _admit(self, slot: int, req: _Request) -> None:
+        if 0 < self.prefill_chunk < req.prompt.size:
+            # long prompt: reserve the slot and prefill chunk-by-chunk
+            # from step() so running slots keep decoding in between
+            self.prefilling[slot] = {"req": req, "cache": self._row_tmpl,
+                                     "done": 0}
+            return
         # b=1 prefill in a fresh single-row cache (jitted: compiles once
         # per prompt length), then scatter the row into the batch cache
         prompt = jnp.asarray(req.prompt)[None, :]
-        n = int(prompt.shape[1])
-        if n not in self._row_cache_tmpl:
-            self._row_cache_tmpl[n] = _zero_cache(self.model, prompt)
-        logits, row_cache = self._prefill(
-            self.params, self._row_cache_tmpl[n], prompt
-        )
+        logits, row_cache = self._prefill(self.params, self._row_tmpl, prompt)
+        self._activate(slot, req, logits, row_cache)
+
+    def _activate(self, slot: int, req: _Request, logits, row_cache) -> None:
+        """Common admission tail: scatter the prefilled row into the
+        batch cache and put the slot into decode rotation."""
         self.cache = self._scatter(self.cache, row_cache, slot)
         first = int(jnp.argmax(logits[0, -1]))
         self.tok = self.tok.at[slot].set(first)
@@ -167,6 +184,25 @@ class ContinuousBatcher:
         self.remaining[slot] = req.num_new - 1
         self._maybe_retire(slot)
 
+    def _advance_prefill(self) -> None:
+        """One prefill chunk for the longest-waiting prefilling slot.
+        Chunked prefill is exactly equivalent to one-shot (the decode
+        path advances its position counter by each chunk's length), so
+        interleaving changes no tokens — only latency."""
+        if not self.prefilling:
+            return
+        slot = next(iter(self.prefilling))
+        st = self.prefilling[slot]
+        req, lo = st["req"], st["done"]
+        chunk = req.prompt[lo:lo + self.prefill_chunk]
+        logits, st["cache"] = self._prefill(
+            self.params, st["cache"], jnp.asarray(chunk)[None, :]
+        )
+        st["done"] += len(chunk)
+        if st["done"] >= req.prompt.size:
+            del self.prefilling[slot]
+            self._activate(slot, req, logits, st["cache"])
+
     def _maybe_retire(self, slot: int) -> None:
         if self.remaining[slot] <= 0:
             self.active[slot] = False
@@ -175,7 +211,9 @@ class ContinuousBatcher:
 
     # ------------------------------------------------------------------
     def step(self) -> None:
-        """One decode forward for EVERY slot; harvest active rows."""
+        """One prefill chunk (if a slot is admitting) + one decode
+        forward for EVERY active slot; harvest active rows."""
+        self._advance_prefill()
         if not any(self.active):
             return
         self.tok, self.cache = self._step(self.params, self.cache, self.tok)
@@ -213,6 +251,6 @@ class ContinuousBatcher:
 
     def run(self) -> Dict[str, List[int]]:
         """Drive until every submitted request has finished."""
-        while any(self.active) or self.queue:
+        while any(self.active) or self.queue or self.prefilling:
             self.step()
         return self.out
