@@ -31,6 +31,7 @@ from vtpu.ops.attention import (
     reference_attention,
 )
 from vtpu.ops.layernorm import fused_layernorm
+from vtpu.ops.quant import quantize_int8
 
 
 def rope(x, positions, base: float = 10000.0):
@@ -79,6 +80,7 @@ class Attention(nn.Module):
     num_kv_heads: int = 0  # 0 ⇒ = num_heads (MHA); fewer = GQA, 1 = MQA
     use_rope: bool = False
     window: int = 0  # > 0: sliding-window attention (last W keys only)
+    kv_cache_dtype: str = "native"  # "native" | "int8" (quantized cache)
 
     @nn.compact
     def __call__(self, x, decode: bool = False, pos0=None):
@@ -123,13 +125,15 @@ class Attention(nn.Module):
             # model's SINGLE position counter — per-layer counters could
             # drift from it.
             assert pos0 is not None, "decode=True requires pos0"
+            quant = self.kv_cache_dtype == "int8"
+            store = jnp.int8 if quant else k.dtype
             ck = self.variable(
                 "cache", "k", jnp.zeros,
-                (b, n_kv, self.max_seq, hd), k.dtype,
+                (b, n_kv, self.max_seq, hd), store,
             )
             cv = self.variable(
                 "cache", "v", jnp.zeros,
-                (b, n_kv, self.max_seq, hd), v.dtype,
+                (b, n_kv, self.max_seq, hd), store,
             )
             pos_b = jnp.broadcast_to(jnp.asarray(pos0), (b,))
 
@@ -138,11 +142,47 @@ class Attention(nn.Module):
                     cache_row, new_row, (0, p, 0)
                 )
 
-            # cast to the cache's dtype: a cache allocated under fp32
-            # init params must accept K/V computed under bf16 serving
-            # params (e.g. dequantized int8 weights) — upcast is exact
-            ck.value = jax.vmap(upd)(ck.value, k.astype(ck.value.dtype), pos_b)
-            cv.value = jax.vmap(upd)(cv.value, v.astype(cv.value.dtype), pos_b)
+            if quant:
+                # int8 KV cache: the cache IS the serving memory cost —
+                # absmax-quantize per written (position, kv-head) vector
+                # over hd; dequant on read is fused into the score
+                # matmuls, so the bf16 copy is transient
+                cks = self.variable(
+                    "cache", "k_scale", jnp.zeros,
+                    (b, n_kv, self.max_seq, 1), jnp.float32,
+                )
+                cvs = self.variable(
+                    "cache", "v_scale", jnp.zeros,
+                    (b, n_kv, self.max_seq, 1), jnp.float32,
+                )
+
+                def q8(x):
+                    # ONE quantization contract for the whole repo:
+                    # same absmax math as the weight path
+                    qt = quantize_int8(x, axis=x.ndim - 1)
+                    return qt.q, qt.scale
+
+                kq, ks = q8(k)
+                vq, vs = q8(v)
+                ck.value = jax.vmap(upd)(ck.value, kq, pos_b)
+                cv.value = jax.vmap(upd)(cv.value, vq, pos_b)
+                cks.value = jax.vmap(upd)(cks.value, ks, pos_b)
+                cvs.value = jax.vmap(upd)(cvs.value, vs, pos_b)
+                k_read = ck.value.astype(jnp.float32) * cks.value
+                v_read = cv.value.astype(jnp.float32) * cvs.value
+            else:
+                # cast to the cache's dtype: a cache allocated under
+                # fp32 init params must accept K/V computed under bf16
+                # serving params (e.g. dequantized int8 weights) —
+                # upcast is exact
+                ck.value = jax.vmap(upd)(
+                    ck.value, k.astype(ck.value.dtype), pos_b
+                )
+                cv.value = jax.vmap(upd)(
+                    cv.value, v.astype(cv.value.dtype), pos_b
+                )
+                k_read = ck.value
+                v_read = cv.value.astype(jnp.float32)
             kpos = jnp.arange(self.max_seq)
             qpos = pos_b[:, None] + jnp.arange(s)[None]  # [b, s]
             mask = kpos[None, None, :] <= qpos[:, :, None]  # [b, s, max_seq]
@@ -155,12 +195,12 @@ class Attention(nn.Module):
             g = self.num_heads // n_kv
             qg = q.reshape(b, n_kv, g, s, hd)
             scores = jnp.einsum(
-                "bngqd,bnkd->bngqk", qg, ck.value
+                "bngqd,bnkd->bngqk", qg, k_read
             ).astype(jnp.float32) * (hd ** -0.5)
             scores = jnp.where(mask[:, None, None], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             o = jnp.einsum(
-                "bngqk,bnkd->bngqd", probs, cv.value.astype(jnp.float32)
+                "bngqk,bnkd->bngqd", probs, v_read
             ).astype(q.dtype).reshape(b, self.num_heads, s, hd)
         elif n_kv != self.num_heads:
             o = flash_attention_gqa(q, k, v, causal=True, window=self.window)
@@ -235,12 +275,14 @@ class Block(nn.Module):
     n_experts: int = 8
     moe_top_k: int = 2
     moe_capacity: int = 0  # 0 = lossless; trainers pass a finite cap
+    kv_cache_dtype: str = "native"
 
     @nn.compact
     def __call__(self, x, decode: bool = False, pos0=None):
         d = x.shape[-1]
         x = x + Attention(self.num_heads, self.max_seq, self.num_kv_heads,
-                          self.use_rope, self.window, name="attn")(
+                          self.use_rope, self.window,
+                          kv_cache_dtype=self.kv_cache_dtype, name="attn")(
             _LayerNorm(name="ln1")(x), decode=decode, pos0=pos0
         )
         if self.mlp == "moe":
@@ -270,6 +312,7 @@ class TransformerLM(nn.Module):
     n_experts: int = 8
     moe_top_k: int = 2
     moe_capacity: int = 0  # per-expert slots; 0 = lossless t·top_k
+    kv_cache_dtype: str = "native"  # "native" | "int8" serving cache
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False):
@@ -300,6 +343,11 @@ class TransformerLM(nn.Module):
             raise ValueError(
                 f"mlp must be 'dense' or 'moe', got {self.mlp!r}"
             )
+        if self.kv_cache_dtype not in ("native", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype must be 'native' or 'int8', "
+                f"got {self.kv_cache_dtype!r}"
+            )
         use_rope = self.pos_embedding == "rope"
         if not use_rope:
             wpe = nn.Embed(self.max_seq, self.d_model, name="wpe")
@@ -312,6 +360,7 @@ class TransformerLM(nn.Module):
                       window=self.attn_window, mlp=self.mlp,
                       n_experts=self.n_experts, moe_top_k=self.moe_top_k,
                       moe_capacity=self.moe_capacity,
+                      kv_cache_dtype=self.kv_cache_dtype,
                       name=f"h{i}")(
                 x, decode=decode, pos0=pos0
             )
