@@ -1,0 +1,131 @@
+"""Paged KV cache + PagedBatcher: dense-equivalence of the block-pool
+attention, engine-to-engine token exactness, block-lease backpressure,
+and validation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # JAX workload lane (CPU-mesh compiles)
+
+from vtpu.models.transformer import TransformerLM, generate
+from vtpu.serving import ContinuousBatcher
+from vtpu.serving.paged import PagedBatcher
+
+KW = dict(vocab=64, d_model=32, depth=2, num_heads=4, max_seq=32)
+
+
+def params_for(model):
+    return model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+
+
+def test_paged_identity_decode_matches_dense():
+    """With the dense-equivalent identity table, paged generate() is
+    token-exact against the dense cache — same batch, same schedule, so
+    the block indirection is the only difference."""
+    dense = TransformerLM(**KW)
+    paged = TransformerLM(**KW, kv_cache_layout="paged", kv_block_size=8)
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 5), 0, 64)
+    params = params_for(dense)
+    want = np.asarray(generate(dense, params, prompt, num_new=8))
+    got = np.asarray(generate(paged, params, prompt, num_new=8))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_paged_batcher_matches_dense_batcher():
+    """A HALF-size shared pool (4 slots x 4 logical blocks = 16; pool =
+    8 leasable) serves the same schedule token-identically to the dense
+    engine."""
+    dense_m = TransformerLM(**KW)
+    paged_m = TransformerLM(**KW, kv_cache_layout="paged", kv_block_size=8,
+                            kv_pool_blocks=9)
+    params = params_for(dense_m)
+    rng = np.random.default_rng(0)
+    reqs = [(f"r{i}", rng.integers(0, 64, size=ln).astype(np.int32), n)
+            for i, (ln, n) in enumerate([(5, 8), (6, 9), (4, 10), (7, 6)])]
+
+    outs = {}
+    for name, eng in [
+        ("dense", ContinuousBatcher(dense_m, params, max_batch=4)),
+        ("paged", PagedBatcher(paged_m, params, max_batch=4)),
+    ]:
+        for rid, p, n in reqs:
+            eng.submit(rid, p, num_new=n)
+        outs[name] = eng.run()
+    assert outs["paged"] == outs["dense"]
+
+
+def test_block_lease_backpressure():
+    """A pool too small for every request at once makes later
+    admissions WAIT for freed blocks instead of failing — and the
+    waiting request still completes token-exactly vs the dense engine."""
+    dense_m = TransformerLM(**KW)
+    # 5 leasable blocks; each request needs 2 → only 2 concurrent
+    paged_m = TransformerLM(**KW, kv_cache_layout="paged", kv_block_size=8,
+                            kv_pool_blocks=6)
+    params = params_for(dense_m)
+    rng = np.random.default_rng(3)
+    reqs = [(f"r{i}", rng.integers(0, 64, size=5).astype(np.int32), 8)
+            for i in range(3)]
+
+    eng = PagedBatcher(paged_m, params, max_batch=4)
+    for rid, p, n in reqs:
+        eng.submit(rid, p, num_new=n)
+    # the third request cannot lease (2+2 blocks out, 1 free < 2 needed)
+    # even though slots are free
+    assert len(eng.queue) == 1
+    assert eng.pool_stats()["free"] == 1
+    out = eng.run()
+    assert eng.pool_stats()["leased"] == 0  # everything returned
+
+    ref = ContinuousBatcher(dense_m, params, max_batch=4)
+    # reproduce the SAME slot/batch composition: dense admits all three
+    # immediately, but r2's tokens only depend on its own row, so the
+    # comparison stays valid
+    for rid, p, n in reqs:
+        ref.submit(rid, p, num_new=n)
+    want = ref.run()
+    assert out == want
+
+
+def test_paged_validation():
+    dense_m = TransformerLM(**KW)
+    with pytest.raises(ValueError, match="paged"):
+        PagedBatcher(dense_m, params_for(dense_m), max_batch=2)
+    with pytest.raises(ValueError, match="divide"):
+        TransformerLM(**KW, kv_cache_layout="paged", kv_block_size=7).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32), decode=True
+        )
+    with pytest.raises(ValueError, match="int8"):
+        TransformerLM(**KW, kv_cache_layout="paged",
+                      kv_cache_dtype="int8").init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32), decode=True
+        )
+
+
+def test_paged_misuse_rejected():
+    """Silent-garbage paths are closed: explicit pools without an
+    engine, beam on paged, dense engine on paged, and a request the
+    pool can never serve."""
+    from vtpu.models.transformer import generate_beam
+
+    pool_m = TransformerLM(**KW, kv_cache_layout="paged", kv_block_size=8,
+                           kv_pool_blocks=9)
+    ident_m = TransformerLM(**KW, kv_cache_layout="paged", kv_block_size=8)
+    params = params_for(TransformerLM(**KW))
+    prompt = jnp.zeros((2, 4), jnp.int32)
+
+    with pytest.raises(ValueError, match="serving engine"):
+        generate(pool_m, params, prompt, num_new=2)
+    with pytest.raises(ValueError, match="beam"):
+        generate_beam(ident_m, params, prompt, num_new=2)
+    with pytest.raises(ValueError, match="PagedBatcher"):
+        ContinuousBatcher(pool_m, params, max_batch=2)
+    tiny_m = TransformerLM(**KW, kv_cache_layout="paged", kv_block_size=8,
+                           kv_pool_blocks=3)  # leases at most 2 blocks
+    eng = PagedBatcher(tiny_m, params, max_batch=2)
+    with pytest.raises(ValueError, match="lease"):
+        eng.submit("x", np.zeros(20, np.int32), num_new=4)  # needs 3

@@ -81,9 +81,71 @@ class Attention(nn.Module):
     use_rope: bool = False
     window: int = 0  # > 0: sliding-window attention (last W keys only)
     kv_cache_dtype: str = "native"  # "native" | "int8" (quantized cache)
+    kv_cache_layout: str = "dense"  # "dense" | "paged" (block pool)
+    kv_block_size: int = 16         # paged: tokens per block
+    kv_pool_blocks: int = 0         # paged: pool size; 0 = b*(max_seq/bs)
+
+    @staticmethod
+    def _upd(cache_row, new_row, p):
+        return jax.lax.dynamic_update_slice(cache_row, new_row, (0, p, 0))
+
+    def _dense_cache_rw(self, k, v, pos_b, b, n_kv, hd):
+        """Dense [b, n_kv, max_seq, hd] cache: write at pos, read all.
+        Casts to the cache's dtype — a cache allocated under fp32 init
+        params must accept K/V computed under bf16 serving params (e.g.
+        dequantized int8 weights); upcast is exact."""
+        ck = self.variable(
+            "cache", "k", jnp.zeros, (b, n_kv, self.max_seq, hd), k.dtype
+        )
+        cv = self.variable(
+            "cache", "v", jnp.zeros, (b, n_kv, self.max_seq, hd), v.dtype
+        )
+        ck.value = jax.vmap(self._upd)(
+            ck.value, k.astype(ck.value.dtype), pos_b
+        )
+        cv.value = jax.vmap(self._upd)(
+            cv.value, v.astype(cv.value.dtype), pos_b
+        )
+        return ck.value, cv.value.astype(jnp.float32)
+
+    def _int8_cache_rw(self, k, v, pos_b, b, n_kv, hd):
+        """int8 KV cache: the cache IS the serving memory cost —
+        absmax-quantize per written (position, kv-head) vector over hd;
+        dequant on read is fused into the score matmuls, so the bf16
+        copy is transient."""
+        ck = self.variable(
+            "cache", "k", jnp.zeros, (b, n_kv, self.max_seq, hd), jnp.int8
+        )
+        cv = self.variable(
+            "cache", "v", jnp.zeros, (b, n_kv, self.max_seq, hd), jnp.int8
+        )
+        cks = self.variable(
+            "cache", "k_scale", jnp.zeros,
+            (b, n_kv, self.max_seq, 1), jnp.float32,
+        )
+        cvs = self.variable(
+            "cache", "v_scale", jnp.zeros,
+            (b, n_kv, self.max_seq, 1), jnp.float32,
+        )
+
+        def q8(x):
+            # ONE quantization contract for the whole repo: same absmax
+            # math as the weight path
+            qt = quantize_int8(x, axis=x.ndim - 1)
+            return qt.q, qt.scale
+
+        kq, ks = q8(k)
+        vq, vs = q8(v)
+        ck.value = jax.vmap(self._upd)(ck.value, kq, pos_b)
+        cv.value = jax.vmap(self._upd)(cv.value, vq, pos_b)
+        cks.value = jax.vmap(self._upd)(cks.value, ks, pos_b)
+        cvs.value = jax.vmap(self._upd)(cvs.value, vs, pos_b)
+        return (ck.value.astype(jnp.float32) * cks.value,
+                cv.value.astype(jnp.float32) * cvs.value)
 
     @nn.compact
-    def __call__(self, x, decode: bool = False, pos0=None):
+    def __call__(self, x, decode: bool = False, pos0=None,
+                 block_table=None):
         b, s, d = x.shape
         assert d % self.num_heads == 0, "num_heads must divide d_model"
         hd = d // self.num_heads
@@ -126,63 +188,59 @@ class Attention(nn.Module):
             # drift from it.
             assert pos0 is not None, "decode=True requires pos0"
             quant = self.kv_cache_dtype == "int8"
-            store = jnp.int8 if quant else k.dtype
-            ck = self.variable(
-                "cache", "k", jnp.zeros,
-                (b, n_kv, self.max_seq, hd), store,
-            )
-            cv = self.variable(
-                "cache", "v", jnp.zeros,
-                (b, n_kv, self.max_seq, hd), store,
-            )
             pos_b = jnp.broadcast_to(jnp.asarray(pos0), (b,))
-
-            def upd(cache_row, new_row, p):
-                return jax.lax.dynamic_update_slice(
-                    cache_row, new_row, (0, p, 0)
+            if self.kv_cache_layout == "paged":
+                # paged KV: K/V live in a BLOCK POOL shared by all rows;
+                # block_table [b, max_seq/bs] maps each row's logical
+                # block to a physical pool block.  Pool smaller than
+                # b*max_seq/bs = true cache sharing (the vLLM idea, done
+                # the static-shape way: table indirection, no dynamic
+                # shapes).  The serving engine (vtpu.serving.paged)
+                # allocates/frees blocks host-side between steps.
+                assert block_table is not None, "paged cache needs a table"
+                bs_blk = self.kv_block_size
+                nb_max = self.max_seq // bs_blk
+                pool = self.kv_pool_blocks or b * nb_max
+                ckp = self.variable(
+                    "cache", "k_pool", jnp.zeros,
+                    (pool, bs_blk, n_kv, hd), k.dtype,
                 )
-
-            if quant:
-                # int8 KV cache: the cache IS the serving memory cost —
-                # absmax-quantize per written (position, kv-head) vector
-                # over hd; dequant on read is fused into the score
-                # matmuls, so the bf16 copy is transient
-                cks = self.variable(
-                    "cache", "k_scale", jnp.zeros,
-                    (b, n_kv, self.max_seq, 1), jnp.float32,
+                cvp = self.variable(
+                    "cache", "v_pool", jnp.zeros,
+                    (pool, bs_blk, n_kv, hd), v.dtype,
                 )
-                cvs = self.variable(
-                    "cache", "v_scale", jnp.zeros,
-                    (b, n_kv, self.max_seq, 1), jnp.float32,
+                # write each (row, token) into its physical (block, off)
+                flat_pos = (pos_b[:, None] + jnp.arange(s)[None]).reshape(-1)
+                rows = jnp.repeat(jnp.arange(b), s)
+                bidx = block_table[rows, flat_pos // bs_blk]
+                off = flat_pos % bs_blk
+                kv_shape = (b * s, n_kv, hd)
+                ckp.value = ckp.value.at[bidx, off].set(
+                    k.transpose(0, 2, 1, 3).reshape(kv_shape)
+                    .astype(ckp.value.dtype)
                 )
-
-                def q8(x):
-                    # ONE quantization contract for the whole repo:
-                    # same absmax math as the weight path
-                    qt = quantize_int8(x, axis=x.ndim - 1)
-                    return qt.q, qt.scale
-
-                kq, ks = q8(k)
-                vq, vs = q8(v)
-                ck.value = jax.vmap(upd)(ck.value, kq, pos_b)
-                cv.value = jax.vmap(upd)(cv.value, vq, pos_b)
-                cks.value = jax.vmap(upd)(cks.value, ks, pos_b)
-                cvs.value = jax.vmap(upd)(cvs.value, vs, pos_b)
-                k_read = ck.value.astype(jnp.float32) * cks.value
-                v_read = cv.value.astype(jnp.float32) * cvs.value
+                cvp.value = cvp.value.at[bidx, off].set(
+                    v.transpose(0, 2, 1, 3).reshape(kv_shape)
+                    .astype(cvp.value.dtype)
+                )
+                # read: gather each row's pages back into [b,n_kv,L,hd];
+                # the masked-attention tail below is SHARED with the
+                # dense layouts (same shapes after the gather)
+                k_read = (
+                    ckp.value[block_table]          # [b, nb, bs, n_kv, hd]
+                    .reshape(b, self.max_seq, n_kv, hd)
+                    .transpose(0, 2, 1, 3)
+                )
+                v_read = (
+                    cvp.value[block_table]
+                    .reshape(b, self.max_seq, n_kv, hd)
+                    .transpose(0, 2, 1, 3)
+                    .astype(jnp.float32)
+                )
+            elif quant:
+                k_read, v_read = self._int8_cache_rw(k, v, pos_b, b, n_kv, hd)
             else:
-                # cast to the cache's dtype: a cache allocated under
-                # fp32 init params must accept K/V computed under bf16
-                # serving params (e.g. dequantized int8 weights) —
-                # upcast is exact
-                ck.value = jax.vmap(upd)(
-                    ck.value, k.astype(ck.value.dtype), pos_b
-                )
-                cv.value = jax.vmap(upd)(
-                    cv.value, v.astype(cv.value.dtype), pos_b
-                )
-                k_read = ck.value
-                v_read = cv.value.astype(jnp.float32)
+                k_read, v_read = self._dense_cache_rw(k, v, pos_b, b, n_kv, hd)
             kpos = jnp.arange(self.max_seq)
             qpos = pos_b[:, None] + jnp.arange(s)[None]  # [b, s]
             mask = kpos[None, None, :] <= qpos[:, :, None]  # [b, s, max_seq]
@@ -276,14 +334,23 @@ class Block(nn.Module):
     moe_top_k: int = 2
     moe_capacity: int = 0  # 0 = lossless; trainers pass a finite cap
     kv_cache_dtype: str = "native"
+    kv_cache_layout: str = "dense"
+    kv_block_size: int = 16
+    kv_pool_blocks: int = 0
 
     @nn.compact
-    def __call__(self, x, decode: bool = False, pos0=None):
+    def __call__(self, x, decode: bool = False, pos0=None,
+                 block_table=None):
         d = x.shape[-1]
         x = x + Attention(self.num_heads, self.max_seq, self.num_kv_heads,
                           self.use_rope, self.window,
-                          kv_cache_dtype=self.kv_cache_dtype, name="attn")(
-            _LayerNorm(name="ln1")(x), decode=decode, pos0=pos0
+                          kv_cache_dtype=self.kv_cache_dtype,
+                          kv_cache_layout=self.kv_cache_layout,
+                          kv_block_size=self.kv_block_size,
+                          kv_pool_blocks=self.kv_pool_blocks,
+                          name="attn")(
+            _LayerNorm(name="ln1")(x), decode=decode, pos0=pos0,
+            block_table=block_table,
         )
         if self.mlp == "moe":
             x = x + MoeMlp(self.n_experts, self.moe_top_k, self.mlp_ratio,
@@ -313,6 +380,9 @@ class TransformerLM(nn.Module):
     moe_top_k: int = 2
     moe_capacity: int = 0  # per-expert slots; 0 = lossless t·top_k
     kv_cache_dtype: str = "native"  # "native" | "int8" serving cache
+    kv_cache_layout: str = "dense"  # "dense" | "paged" (block-pool cache)
+    kv_block_size: int = 16         # paged: tokens per block
+    kv_pool_blocks: int = 0         # paged: pool size; 0 = dense-equiv
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False):
@@ -320,6 +390,7 @@ class TransformerLM(nn.Module):
         assert s <= self.max_seq, f"seq {s} > max_seq {self.max_seq}"
         x = nn.Embed(self.vocab, self.d_model, name="wte")(tokens)
         pos0 = None
+        block_table = None
         if decode:
             # the ONE position counter — layers receive it, none keep
             # their own (drift-proof).  Per-ROW [b], so slots of a
@@ -332,6 +403,21 @@ class TransformerLM(nn.Module):
             pos0 = jnp.broadcast_to(jnp.asarray(pos0), (b,))  # legacy)
             pos_ids = pos0[:, None] + jnp.arange(s)[None]     # [b, s]
             pos_var.value = pos0 + s
+            if self.kv_cache_layout == "paged":
+                # ONE table for every layer (the allocation unit is a
+                # block across all layers, vLLM-style).  Default init is
+                # the identity map — row i owns blocks [i*nb, (i+1)*nb)
+                # — which makes generate()/tests dense-equivalent; a
+                # serving engine overwrites rows with real allocations.
+                nb_max = self.max_seq // self.kv_block_size
+                table_var = self.variable(
+                    "cache", "block_table",
+                    lambda: (jnp.arange(b)[:, None] * nb_max
+                             + jnp.arange(nb_max)[None, :]).astype(jnp.int32)
+                    if self.kv_pool_blocks == 0
+                    else jnp.zeros((b, nb_max), jnp.int32),
+                )
+                block_table = table_var.value
         else:
             pos_ids = jnp.arange(s)
         if self.pos_embedding not in ("learned", "rope"):
@@ -348,6 +434,22 @@ class TransformerLM(nn.Module):
                 f"kv_cache_dtype must be 'native' or 'int8', "
                 f"got {self.kv_cache_dtype!r}"
             )
+        if self.kv_cache_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"kv_cache_layout must be 'dense' or 'paged', "
+                f"got {self.kv_cache_layout!r}"
+            )
+        if self.kv_cache_layout == "paged":
+            if self.max_seq % self.kv_block_size != 0:
+                raise ValueError(
+                    f"kv_block_size {self.kv_block_size} must divide "
+                    f"max_seq {self.max_seq}"
+                )
+            if self.kv_cache_dtype != "native":
+                raise ValueError(
+                    "paged cache composes with the native dtype only "
+                    "(int8 pool quantization: not yet)"
+                )
         use_rope = self.pos_embedding == "rope"
         if not use_rope:
             wpe = nn.Embed(self.max_seq, self.d_model, name="wpe")
@@ -361,8 +463,11 @@ class TransformerLM(nn.Module):
                       n_experts=self.n_experts, moe_top_k=self.moe_top_k,
                       moe_capacity=self.moe_capacity,
                       kv_cache_dtype=self.kv_cache_dtype,
+                      kv_cache_layout=self.kv_cache_layout,
+                      kv_block_size=self.kv_block_size,
+                      kv_pool_blocks=self.kv_pool_blocks,
                       name=f"h{i}")(
-                x, decode=decode, pos0=pos0
+                x, decode=decode, pos0=pos0, block_table=block_table
             )
         x = _LayerNorm(name="ln_f")(x)
         logits = nn.Dense(self.vocab, use_bias=False, name="lm_head")(x)
@@ -377,7 +482,20 @@ def _zero_cache(model: TransformerLM, prompt):
             jax.random.PRNGKey(0), jnp.zeros_like(prompt), decode=True
         )["cache"]
     )
-    return jax.tree.map(lambda sh: jnp.zeros(sh.shape, sh.dtype), shapes)
+    cache = jax.tree.map(lambda sh: jnp.zeros(sh.shape, sh.dtype), shapes)
+    if (
+        getattr(model, "kv_cache_layout", "dense") == "paged"
+        and model.kv_pool_blocks == 0
+    ):
+        # dense-equivalent pool: the table is the IDENTITY map (row i
+        # owns blocks [i*nb, (i+1)*nb)), not zeros — an all-zero table
+        # would alias every row onto physical block 0
+        b = prompt.shape[0]
+        nb = model.max_seq // model.kv_block_size
+        cache["block_table"] = (
+            jnp.arange(b)[:, None] * nb + jnp.arange(nb)[None, :]
+        ).astype(jnp.int32)
+    return cache
 
 
 def generate(model: TransformerLM, params, prompt, num_new: int,
@@ -396,6 +514,13 @@ def generate(model: TransformerLM, params, prompt, num_new: int,
     Returns [b, num_new] int32."""
     if num_new < 1:
         raise ValueError(f"num_new must be >= 1, got {num_new}")
+    if model.kv_cache_layout == "paged" and model.kv_pool_blocks > 0:
+        raise ValueError(
+            "a paged model with an explicit pool needs a serving engine "
+            "(vtpu.serving.paged.PagedBatcher) to allocate its block "
+            "table; generate() supports the dense-equivalent pool only "
+            "(kv_pool_blocks=0)"
+        )
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng")
     if prompt.shape[1] + num_new > model.max_seq:
@@ -474,6 +599,12 @@ def generate_beam(model: TransformerLM, params, prompt, num_new: int,
     b, s0 = prompt.shape
     if num_new < 1:
         raise ValueError(f"num_new must be >= 1, got {num_new}")
+    if model.kv_cache_layout == "paged":
+        raise ValueError(
+            "beam search tiles and gathers the cache along the batch "
+            "dim, which has no meaning for a pool-indexed paged cache — "
+            "use the dense layout for beam decoding"
+        )
     if s0 + num_new > model.max_seq:
         raise ValueError(
             f"prompt ({s0}) + num_new ({num_new}) exceeds max_seq "
@@ -545,6 +676,12 @@ def generate_speculative(model: TransformerLM, params,
     and get overwritten on the next advance."""
     b, s0 = prompt.shape
     for m, who in ((model, "target"), (draft_model, "draft")):
+        if m.kv_cache_layout == "paged" and m.kv_pool_blocks > 0:
+            raise ValueError(
+                f"the {who} model's explicit paged pool needs a serving "
+                "engine to allocate its block table (kv_pool_blocks=0 "
+                "is the dense-equivalent form speculative decode supports)"
+            )
         if s0 + num_new + k + 1 > m.max_seq:
             raise ValueError(
                 f"prompt ({s0}) + num_new ({num_new}) + draft window "

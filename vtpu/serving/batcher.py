@@ -57,6 +57,14 @@ class ContinuousBatcher:
 
     def __init__(self, model: TransformerLM, params, max_batch: int,
                  eos_id: Optional[int] = None, prefill_chunk: int = 0):
+        if (model.kv_cache_layout == "paged"
+                and type(self) is ContinuousBatcher):
+            # the dense engine's row scatter treats cache axis 0 as the
+            # batch — meaningless for a pool-indexed paged cache; it
+            # would silently scramble blocks
+            raise ValueError(
+                "paged models need vtpu.serving.paged.PagedBatcher"
+            )
         self.model = model
         self.params = params
         self.max_batch = max_batch
@@ -169,10 +177,19 @@ class ContinuousBatcher:
         logits, row_cache = self._prefill(self.params, self._row_tmpl, prompt)
         self._activate(slot, req, logits, row_cache)
 
+    def _merge_row(self, slot: int, row_cache) -> None:
+        """Write a prefilled b=1 row cache into the batch cache
+        (overridden by the paged engine, whose pool isn't row-shaped)."""
+        self.cache = self._scatter(self.cache, row_cache, slot)
+
+    def _on_retire(self, slot: int) -> None:
+        """Hook: a slot left decode rotation (paged engine frees its
+        blocks here)."""
+
     def _activate(self, slot: int, req: _Request, logits, row_cache) -> None:
         """Common admission tail: scatter the prefilled row into the
         batch cache and put the slot into decode rotation."""
-        self.cache = self._scatter(self.cache, row_cache, slot)
+        self._merge_row(slot, row_cache)
         first = int(jnp.argmax(logits[0, -1]))
         self.tok = self.tok.at[slot].set(first)
         self.rid[slot] = req.rid
@@ -207,6 +224,7 @@ class ContinuousBatcher:
         if self.remaining[slot] <= 0:
             self.active[slot] = False
             self.rid[slot] = None
+            self._on_retire(slot)
             self._admit_pending()
 
     # ------------------------------------------------------------------
@@ -247,6 +265,7 @@ class ContinuousBatcher:
         for i in finished:
             self.active[i] = False
             self.rid[i] = None
+            self._on_retire(i)
         self._admit_pending()
 
     def run(self) -> Dict[str, List[int]]:
