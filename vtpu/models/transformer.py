@@ -84,6 +84,7 @@ class Attention(nn.Module):
     kv_cache_layout: str = "dense"  # "dense" | "paged" (block pool)
     kv_block_size: int = 16         # paged: tokens per block
     kv_pool_blocks: int = 0         # paged: pool size; 0 = b*(max_seq/bs)
+    paged_kernel: str = "auto"      # "auto" (TPU) | "on" | "off"
 
     @staticmethod
     def _upd(cache_row, new_row, p):
@@ -201,40 +202,65 @@ class Attention(nn.Module):
                 bs_blk = self.kv_block_size
                 nb_max = self.max_seq // bs_blk
                 pool = self.kv_pool_blocks or b * nb_max
+                # pool layout [P, n_kv, bs, hd]: the token dim rides the
+                # SUBLANE axis and hd the lanes, so a kernel block
+                # (1, 1, bs, hd) is a clean TPU tile
                 ckp = self.variable(
                     "cache", "k_pool", jnp.zeros,
-                    (pool, bs_blk, n_kv, hd), k.dtype,
+                    (pool, n_kv, bs_blk, hd), k.dtype,
                 )
                 cvp = self.variable(
                     "cache", "v_pool", jnp.zeros,
-                    (pool, bs_blk, n_kv, hd), v.dtype,
+                    (pool, n_kv, bs_blk, hd), v.dtype,
                 )
-                # write each (row, token) into its physical (block, off)
+                # write each (row, token) into its physical (block, off);
+                # bidx/off are advanced indices separated by the n_kv
+                # slice, so the result batches them in front: [b*s,
+                # n_kv, hd] values land per (block, :, offset)
                 flat_pos = (pos_b[:, None] + jnp.arange(s)[None]).reshape(-1)
                 rows = jnp.repeat(jnp.arange(b), s)
                 bidx = block_table[rows, flat_pos // bs_blk]
                 off = flat_pos % bs_blk
                 kv_shape = (b * s, n_kv, hd)
-                ckp.value = ckp.value.at[bidx, off].set(
+                ckp.value = ckp.value.at[bidx, :, off].set(
                     k.transpose(0, 2, 1, 3).reshape(kv_shape)
                     .astype(ckp.value.dtype)
                 )
-                cvp.value = cvp.value.at[bidx, off].set(
+                cvp.value = cvp.value.at[bidx, :, off].set(
                     v.transpose(0, 2, 1, 3).reshape(kv_shape)
                     .astype(cvp.value.dtype)
                 )
+                use_kernel = (
+                    s == 1 and self.window == 0
+                    and (self.paged_kernel == "on"
+                         or (self.paged_kernel == "auto" and _on_tpu()))
+                )
+                if use_kernel:
+                    # the Pallas paged decode kernel streams pool blocks
+                    # via the scalar-prefetched table — no [b, L] gather
+                    # materialization (vtpu/ops/paged_attention.py)
+                    from vtpu.ops.paged_attention import (
+                        paged_attention_decode,
+                    )
+
+                    o = paged_attention_decode(
+                        q[:, :, 0], ckp.value, cvp.value, block_table,
+                        pos_b, interpret=not _on_tpu(),
+                    )[:, :, None, :]            # [b, heads, 1, hd]
+                    o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
+                    return nn.Dense(d, use_bias=False, name="out")(o)
                 # read: gather each row's pages back into [b,n_kv,L,hd];
                 # the masked-attention tail below is SHARED with the
                 # dense layouts (same shapes after the gather)
                 k_read = (
-                    ckp.value[block_table]          # [b, nb, bs, n_kv, hd]
-                    .reshape(b, self.max_seq, n_kv, hd)
-                    .transpose(0, 2, 1, 3)
+                    ckp.value[block_table]          # [b, nb, n_kv, bs, hd]
+                    .transpose(0, 2, 1, 3, 4)
+                    .reshape(b, n_kv, self.max_seq, hd)
                 )
                 v_read = (
                     cvp.value[block_table]
-                    .reshape(b, self.max_seq, n_kv, hd)
-                    .transpose(0, 2, 1, 3)
+                    .transpose(0, 2, 1, 3, 4)
+                    .reshape(b, n_kv, self.max_seq, hd)
                     .astype(jnp.float32)
                 )
             elif quant:
@@ -337,6 +363,7 @@ class Block(nn.Module):
     kv_cache_layout: str = "dense"
     kv_block_size: int = 16
     kv_pool_blocks: int = 0
+    paged_kernel: str = "auto"
 
     @nn.compact
     def __call__(self, x, decode: bool = False, pos0=None,
@@ -348,6 +375,7 @@ class Block(nn.Module):
                           kv_cache_layout=self.kv_cache_layout,
                           kv_block_size=self.kv_block_size,
                           kv_pool_blocks=self.kv_pool_blocks,
+                          paged_kernel=self.paged_kernel,
                           name="attn")(
             _LayerNorm(name="ln1")(x), decode=decode, pos0=pos0,
             block_table=block_table,
@@ -383,6 +411,7 @@ class TransformerLM(nn.Module):
     kv_cache_layout: str = "dense"  # "dense" | "paged" (block-pool cache)
     kv_block_size: int = 16         # paged: tokens per block
     kv_pool_blocks: int = 0         # paged: pool size; 0 = dense-equiv
+    paged_kernel: str = "auto"      # paged decode kernel: auto|on|off
 
     @nn.compact
     def __call__(self, tokens, decode: bool = False):
@@ -439,7 +468,18 @@ class TransformerLM(nn.Module):
                 f"kv_cache_layout must be 'dense' or 'paged', "
                 f"got {self.kv_cache_layout!r}"
             )
+        if self.paged_kernel not in ("auto", "on", "off"):
+            raise ValueError(
+                f"paged_kernel must be 'auto', 'on' or 'off', "
+                f"got {self.paged_kernel!r}"
+            )
         if self.kv_cache_layout == "paged":
+            if self.paged_kernel == "on" and self.attn_window > 0:
+                raise ValueError(
+                    "the paged decode kernel does not implement "
+                    "sliding-window masking; attn_window needs "
+                    "paged_kernel='off' (the gather path)"
+                )
             if self.max_seq % self.kv_block_size != 0:
                 raise ValueError(
                     f"kv_block_size {self.kv_block_size} must divide "
@@ -466,6 +506,7 @@ class TransformerLM(nn.Module):
                       kv_cache_layout=self.kv_cache_layout,
                       kv_block_size=self.kv_block_size,
                       kv_pool_blocks=self.kv_pool_blocks,
+                      paged_kernel=self.paged_kernel,
                       name=f"h{i}")(
                 x, decode=decode, pos0=pos0, block_table=block_table
             )

@@ -1,0 +1,150 @@
+"""Paged attention decode kernel (Pallas TPU).
+
+The paged serving cache (vtpu/models/transformer.py, layout="paged")
+reads K/V through a block table.  The plain-XLA path gathers every
+row's pages into a dense [b, L, n_kv, hd] tensor per step — correct,
+but it materializes the whole logical cache in HBM each decode step.
+This kernel instead streams pool blocks straight into VMEM using
+SCALAR-PREFETCHED block tables (pltpu.PrefetchScalarGridSpec): the
+grid walks (row, kv-head, logical-block), the BlockSpec index_map
+looks the physical block id up in the prefetched table, and Pallas'
+pipeline fetches exactly the blocks each row owns — zero gather
+materialization, one online-softmax accumulation in VMEM scratch.
+
+Decode only (one query token per row); prefill uses the dense flash
+kernel on the prompt.  Off-TPU the pallas_call runs in interpret mode,
+so numerics are CPU-testable (tests/test_paged.py pins it against the
+gather reference).
+
+Layout notes (TPU tiling): hd rides the 128-lane dim, block_size the
+sublane dim — keep block_size a multiple of 8 (f32) / 16 (bf16).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from vtpu.ops.attention import _on_tpu
+
+NEG_INF = -1e30
+
+
+def _kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+            acc_ref, m_ref, l_ref, *, bs_blk: int, nb_max: int,
+            sm_scale: float):
+    """One (row, kv-head, logical-block) grid step: accumulate this
+    block's contribution to the row's online softmax."""
+    i = pl.program_id(0)   # batch row
+    t = pl.program_id(2)   # logical block
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32)         # [g, hd]
+    k = k_ref[0, 0].astype(jnp.float32)         # [bs_blk, hd]
+    v = v_ref[0, 0].astype(jnp.float32)         # [bs_blk, hd]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale                                # [g, bs_blk]
+    # causal/validity mask: global position of slot j in this block is
+    # t*bs + j; valid while <= the row's current query position
+    qpos = lengths_ref[i]
+    kpos = t * bs_blk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(kpos <= qpos, s, NEG_INF)
+
+    m_prev = m_ref[...]                         # [g, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)                      # [g, bs_blk]
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    m_ref[...] = m_new
+
+    @pl.when(t == nb_max - 1)
+    def _finish():
+        o_ref[0, 0] = (
+            acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_attention_decode(q, k_pool, v_pool, block_tables, lengths,
+                           *, interpret: bool | None = None):
+    """q: [b, n_heads, hd] (the single decode token per row);
+    k_pool/v_pool: [P, n_kv, bs_blk, hd] (tokens on the sublane axis —
+    clean TPU tiles per block); block_tables: [b, nb_max] int32;
+    lengths: [b] int32 — the CURRENT query position per row (keys at
+    positions <= lengths[i] are attended).  Returns [b, n_heads, hd]."""
+    b, n_heads, hd = q.shape
+    _p, n_kv, bs_blk, _hd = k_pool.shape
+    nb_max = block_tables.shape[1]
+    g = n_heads // n_kv
+    if interpret is None:
+        interpret = not _on_tpu()
+    # kv head j serves q heads [j*g, (j+1)*g): regroup q accordingly
+    qg = q.reshape(b, n_kv, g, hd)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # block_tables, lengths
+        grid=(b, n_kv, nb_max),
+        in_specs=[
+            # q: one (row, kv-head) group per grid step
+            pl.BlockSpec((1, 1, g, hd), lambda i, j, t, tables, lens:
+                         (i, j, 0, 0)),
+            # k/v: THE paged fetch — physical block id from the
+            # prefetched table selects the pool slice
+            pl.BlockSpec((1, 1, bs_blk, hd), lambda i, j, t, tables, lens:
+                         (tables[i, t], j, 0, 0)),
+            pl.BlockSpec((1, 1, bs_blk, hd), lambda i, j, t, tables, lens:
+                         (tables[i, t], j, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, hd), lambda i, j, t, tables, lens:
+                               (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, hd), jnp.float32),   # acc
+            pltpu.VMEM((g, 1), jnp.float32),    # m
+            pltpu.VMEM((g, 1), jnp.float32),    # l
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, bs_blk=bs_blk, nb_max=nb_max, sm_scale=hd ** -0.5
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n_kv, g, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(block_tables, lengths, qg, k_pool, v_pool)
+    return out.reshape(b, n_heads, hd)
+
+
+def paged_attention_reference(q, k_pool, v_pool, block_tables, lengths):
+    """The gather-based oracle (same math the model's XLA path runs)."""
+    b, n_heads, hd = q.shape
+    _p, n_kv, bs_blk, _ = k_pool.shape
+    nb_max = block_tables.shape[1]
+    L = nb_max * bs_blk
+    g = n_heads // n_kv
+    k = (k_pool[block_tables].transpose(0, 2, 1, 3, 4)
+         .reshape(b, n_kv, L, hd))
+    v = (v_pool[block_tables].transpose(0, 2, 1, 3, 4)
+         .reshape(b, n_kv, L, hd))
+    qg = q.reshape(b, n_kv, g, hd)
+    s = jnp.einsum("bngd,bnkd->bngk", qg.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (hd ** -0.5)
+    kpos = jnp.arange(L)
+    s = jnp.where(kpos[None, None, None] <= lengths[:, None, None, None],
+                  s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bngk,bnkd->bngd", p, v.astype(jnp.float32))
+    return o.reshape(b, n_heads, hd).astype(q.dtype)

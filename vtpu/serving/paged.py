@@ -137,7 +137,7 @@ class PagedBatcher(ContinuousBatcher):
         assigned_dev = jnp.asarray(assigned, jnp.int32)
 
         def merge(b_leaf, r_leaf):
-            if b_leaf.ndim == 4:  # k_pool/v_pool [P, bs, n_kv, hd]
+            if b_leaf.ndim == 4:  # k_pool/v_pool [P, n_kv, bs, hd]
                 return b_leaf.at[assigned_dev].set(
                     r_leaf[1:need + 1].astype(b_leaf.dtype)
                 )
