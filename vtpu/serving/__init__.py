@@ -1,5 +1,6 @@
 """Serving tier: continuous batching over the LM family's KV cache."""
 
 from vtpu.serving.batcher import ContinuousBatcher
+from vtpu.serving.paged import PagedBatcher
 
-__all__ = ["ContinuousBatcher"]
+__all__ = ["ContinuousBatcher", "PagedBatcher"]
