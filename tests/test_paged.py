@@ -180,3 +180,39 @@ def test_paged_kernel_knob_validated():
                       attn_window=8, paged_kernel="on").init(
             jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32),
             decode=True)
+
+
+def test_paged_chunked_prefill_interleaves_and_matches_dense():
+    """prefill_chunk on the paged engine: a long admission prefills
+    chunk-by-chunk in its transient pool while running slots decode,
+    and the outputs match the dense engine under the same chunking."""
+    dense_m = TransformerLM(**KW)
+    paged_m = TransformerLM(**KW, kv_cache_layout="paged", kv_block_size=8,
+                            kv_pool_blocks=9)
+    params = params_for(dense_m)
+    rng = np.random.default_rng(5)
+    p_short = rng.integers(0, 64, size=3).astype(np.int32)
+    p_long = rng.integers(0, 64, size=12).astype(np.int32)
+
+    outs = {}
+    interleaved = {}
+    for name, eng in [
+        ("dense", ContinuousBatcher(dense_m, params, max_batch=4,
+                                    prefill_chunk=3)),
+        ("paged", PagedBatcher(paged_m, params, max_batch=4,
+                               prefill_chunk=3)),
+    ]:
+        eng.submit("short", p_short, num_new=10)
+        for _ in range(2):
+            eng.step()
+        eng.submit("long", p_long, num_new=6)
+        assert eng.prefilling, name
+        decoded = 0
+        while eng.prefilling:
+            before = len(eng.out["short"])
+            eng.step()
+            decoded += len(eng.out["short"]) - before
+        interleaved[name] = decoded
+        outs[name] = eng.run()
+    assert interleaved["paged"] > 0
+    assert outs["paged"] == outs["dense"]

@@ -205,20 +205,28 @@ class ContinuousBatcher:
         """One prefill chunk for the longest-waiting prefilling slot.
         Chunked prefill is exactly equivalent to one-shot (the decode
         path advances its position counter by each chunk's length), so
-        interleaving changes no tokens — only latency."""
+        interleaving changes no tokens — only latency.  A subclass may
+        stash its own prefill fn in the slot state ("pf") and hook
+        :meth:`_pre_activate` for lease bookkeeping."""
         if not self.prefilling:
             return
         slot = next(iter(self.prefilling))
         st = self.prefilling[slot]
         req, lo = st["req"], st["done"]
         chunk = req.prompt[lo:lo + self.prefill_chunk]
-        logits, st["cache"] = self._prefill(
+        pf = st.get("pf", self._prefill)
+        logits, st["cache"] = pf(
             self.params, st["cache"], jnp.asarray(chunk)[None, :]
         )
         st["done"] += len(chunk)
         if st["done"] >= req.prompt.size:
             del self.prefilling[slot]
+            self._pre_activate(slot, st)
             self._activate(slot, req, logits, st["cache"])
+
+    def _pre_activate(self, slot: int, st: dict) -> None:
+        """Hook: a chunked admission is about to activate (paged engine
+        records the lease here)."""
 
     def _maybe_retire(self, slot: int) -> None:
         if self.remaining[slot] <= 0:

@@ -46,13 +46,14 @@ class PagedBatcher(ContinuousBatcher):
     """Continuous batching over a leased-block KV pool."""
 
     def __init__(self, model: TransformerLM, params, max_batch: int,
-                 eos_id=None):
+                 eos_id=None, prefill_chunk: int = 0):
         if model.kv_cache_layout != "paged" or model.kv_pool_blocks <= 1:
             raise ValueError(
                 "PagedBatcher needs kv_cache_layout='paged' and a real "
                 "pool (kv_pool_blocks > 1)"
             )
-        super().__init__(model, params, max_batch, eos_id=eos_id)
+        super().__init__(model, params, max_batch, eos_id=eos_id,
+                         prefill_chunk=prefill_chunk)
         self.block_size = model.kv_block_size
         self.nb_max = model.max_seq // model.kv_block_size
         # block 0 is the garbage block for inactive rows — never leased
@@ -119,12 +120,28 @@ class PagedBatcher(ContinuousBatcher):
         assigned = [self.free.popleft() for _ in range(need)]
         self._slot_blocks[slot] = assigned
         pf, tmpl = self._prefill_fn(need)
+        if 0 < self.prefill_chunk < req.prompt.size:
+            # chunked admission: blocks are leased now (reserved), the
+            # transient-pool prefill advances one chunk per step()
+            # between the running slots' decodes (same interleave
+            # contract as the dense engine)
+            self.prefilling[slot] = {
+                "req": req, "cache": tmpl, "done": 0,
+                "assigned": assigned, "need": need, "pf": pf,
+            }
+            return
         prompt = jnp.asarray(req.prompt)[None, :]
         logits, row_cache = pf(self.params, tmpl, prompt)
         # _activate (the shared admission tail) calls back into
         # _merge_row, which needs this lease's mapping
         self._pending_lease = (assigned, need)
         self._activate(slot, req, logits, row_cache)
+
+    def _pre_activate(self, slot: int, st: dict) -> None:
+        # the base _advance_prefill drives the chunks (it picks up our
+        # per-need prefill fn from st["pf"]); we only record the lease
+        # for _merge_row before activation
+        self._pending_lease = (st["assigned"], st["need"])
 
     def _merge_row(self, slot: int, row_cache) -> None:
         assigned, need = self._pending_lease
