@@ -281,3 +281,19 @@ class ContinuousBatcher:
         while any(self.active) or self.queue or self.prefilling:
             self.step()
         return self.out
+
+    def stats(self) -> dict:
+        """Operational snapshot (scrape-friendly): slot occupancy, queue
+        depth, admissions in flight, decode forwards so far."""
+        return {
+            "max_batch": self.max_batch,
+            "active_slots": sum(self.active),
+            "prefilling_slots": len(self.prefilling),
+            "queued": len(self.queue),
+            "decode_steps": self.steps,
+            # every rid in out is either finished or bound to an active
+            # slot (rid[i] set exactly while active[i]); queued requests
+            # are not in out yet — simple arithmetic, O(max_batch), and
+            # immune to falsy rids
+            "completed": len(self.out) - sum(self.active),
+        }

@@ -187,3 +187,6 @@ class PagedBatcher(ContinuousBatcher):
         leased = sum(len(v) for v in self._slot_blocks.values())
         return {"pool_blocks": self.model.kv_pool_blocks,
                 "leased": leased, "free": len(self.free)}
+
+    def stats(self) -> dict:
+        return {**super().stats(), **self.pool_stats()}
