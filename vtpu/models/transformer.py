@@ -205,14 +205,32 @@ class Attention(nn.Module):
                 # pool layout [P, n_kv, bs, hd]: the token dim rides the
                 # SUBLANE axis and hd the lanes, so a kernel block
                 # (1, 1, bs, hd) is a clean TPU tile
+                store = jnp.int8 if quant else k.dtype
                 ckp = self.variable(
                     "cache", "k_pool", jnp.zeros,
-                    (pool, n_kv, bs_blk, hd), k.dtype,
+                    (pool, n_kv, bs_blk, hd), store,
                 )
                 cvp = self.variable(
                     "cache", "v_pool", jnp.zeros,
-                    (pool, n_kv, bs_blk, hd), v.dtype,
+                    (pool, n_kv, bs_blk, hd), store,
                 )
+                ckps = cvps = None
+                if quant:
+                    # int8 pool: scales per (block, kv-head, token)
+                    ckps = self.variable(
+                        "cache", "k_pool_scale", jnp.zeros,
+                        (pool, n_kv, bs_blk, 1), jnp.float32,
+                    )
+                    cvps = self.variable(
+                        "cache", "v_pool_scale", jnp.zeros,
+                        (pool, n_kv, bs_blk, 1), jnp.float32,
+                    )
+                    kq = quantize_int8(k, axis=k.ndim - 1)
+                    vq = quantize_int8(v, axis=v.ndim - 1)
+                    k_store, v_store = kq.q, vq.q
+                    k_sc, v_sc = kq.scale, vq.scale
+                else:
+                    k_store, v_store = k, v
                 # write each (row, token) into its physical (block, off);
                 # bidx/off are advanced indices separated by the n_kv
                 # slice, so the result batches them in front: [b*s,
@@ -223,13 +241,21 @@ class Attention(nn.Module):
                 off = flat_pos % bs_blk
                 kv_shape = (b * s, n_kv, hd)
                 ckp.value = ckp.value.at[bidx, :, off].set(
-                    k.transpose(0, 2, 1, 3).reshape(kv_shape)
+                    k_store.transpose(0, 2, 1, 3).reshape(kv_shape)
                     .astype(ckp.value.dtype)
                 )
                 cvp.value = cvp.value.at[bidx, :, off].set(
-                    v.transpose(0, 2, 1, 3).reshape(kv_shape)
+                    v_store.transpose(0, 2, 1, 3).reshape(kv_shape)
                     .astype(cvp.value.dtype)
                 )
+                if quant:
+                    sc_shape = (b * s, n_kv, 1)
+                    ckps.value = ckps.value.at[bidx, :, off].set(
+                        k_sc.transpose(0, 2, 1, 3).reshape(sc_shape)
+                    )
+                    cvps.value = cvps.value.at[bidx, :, off].set(
+                        v_sc.transpose(0, 2, 1, 3).reshape(sc_shape)
+                    )
                 use_kernel = (
                     s == 1 and self.window == 0
                     and (self.paged_kernel == "on"
@@ -238,31 +264,42 @@ class Attention(nn.Module):
                 if use_kernel:
                     # the Pallas paged decode kernel streams pool blocks
                     # via the scalar-prefetched table — no [b, L] gather
-                    # materialization (vtpu/ops/paged_attention.py)
+                    # materialization (vtpu/ops/paged_attention.py);
+                    # int8 pools dequantize in VMEM via the scale pools
                     from vtpu.ops.paged_attention import (
                         paged_attention_decode,
                     )
 
                     o = paged_attention_decode(
                         q[:, :, 0], ckp.value, cvp.value, block_table,
-                        pos_b, interpret=not _on_tpu(),
+                        pos_b,
+                        ckps.value if quant else None,
+                        cvps.value if quant else None,
+                        interpret=not _on_tpu(),
                     )[:, :, None, :]            # [b, heads, 1, hd]
                     o = o.transpose(0, 2, 1, 3).reshape(b, s, d)
                     return nn.Dense(d, use_bias=False, name="out")(o)
                 # read: gather each row's pages back into [b,n_kv,L,hd];
                 # the masked-attention tail below is SHARED with the
                 # dense layouts (same shapes after the gather)
-                k_read = (
-                    ckp.value[block_table]          # [b, nb, n_kv, bs, hd]
-                    .transpose(0, 2, 1, 3, 4)
-                    .reshape(b, n_kv, self.max_seq, hd)
-                )
-                v_read = (
-                    cvp.value[block_table]
-                    .transpose(0, 2, 1, 3, 4)
-                    .reshape(b, n_kv, self.max_seq, hd)
-                    .astype(jnp.float32)
-                )
+                def page_read(pool_var):
+                    return (
+                        pool_var.value[block_table]  # [b, nb, n_kv, bs, hd]
+                        .transpose(0, 2, 1, 3, 4)
+                        .reshape(b, n_kv, self.max_seq, -1)
+                    )
+
+                if quant:
+                    k_read = page_read(ckp).astype(jnp.float32) \
+                        * page_read(ckps)
+                    v_read = page_read(cvp).astype(jnp.float32) \
+                        * page_read(cvps)
+                else:
+                    # dtypes mirror the dense path exactly (k native,
+                    # v f32) so paged==dense stays bitwise for every
+                    # cache dtype
+                    k_read = page_read(ckp)
+                    v_read = page_read(cvp).astype(jnp.float32)
             elif quant:
                 k_read, v_read = self._int8_cache_rw(k, v, pos_b, b, n_kv, hd)
             else:
@@ -484,11 +521,6 @@ class TransformerLM(nn.Module):
                 raise ValueError(
                     f"kv_block_size {self.kv_block_size} must divide "
                     f"max_seq {self.max_seq}"
-                )
-            if self.kv_cache_dtype != "native":
-                raise ValueError(
-                    "paged cache composes with the native dtype only "
-                    "(int8 pool quantization: not yet)"
                 )
         use_rope = self.pos_embedding == "rope"
         if not use_rope:

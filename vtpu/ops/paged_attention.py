@@ -34,23 +34,15 @@ from vtpu.ops.attention import _on_tpu
 NEG_INF = -1e30
 
 
-def _kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_ref, m_ref, l_ref, *, bs_blk: int, nb_max: int,
-            sm_scale: float):
-    """One (row, kv-head, logical-block) grid step: accumulate this
-    block's contribution to the row's online softmax."""
-    i = pl.program_id(0)   # batch row
-    t = pl.program_id(2)   # logical block
-
+def _accumulate(i, t, q, k, v, o_ref, acc_ref, m_ref, l_ref, lengths_ref,
+                *, bs_blk: int, nb_max: int, sm_scale: float):
+    """Shared online-softmax core for one (row, kv-head, block) step."""
     @pl.when(t == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    q = q_ref[0, 0].astype(jnp.float32)         # [g, hd]
-    k = k_ref[0, 0].astype(jnp.float32)         # [bs_blk, hd]
-    v = v_ref[0, 0].astype(jnp.float32)         # [bs_blk, hd]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -79,38 +71,80 @@ def _kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
         ).astype(o_ref.dtype)
 
 
+def _kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+            acc_ref, m_ref, l_ref, *, bs_blk: int, nb_max: int,
+            sm_scale: float):
+    i = pl.program_id(0)
+    t = pl.program_id(2)
+    _accumulate(
+        i, t, q_ref[0, 0].astype(jnp.float32),
+        k_ref[0, 0].astype(jnp.float32), v_ref[0, 0].astype(jnp.float32),
+        o_ref, acc_ref, m_ref, l_ref, lengths_ref,
+        bs_blk=bs_blk, nb_max=nb_max, sm_scale=sm_scale,
+    )
+
+
+def _kernel_q8(tables_ref, lengths_ref, q_ref, k_ref, v_ref, ks_ref,
+               vs_ref, o_ref, acc_ref, m_ref, l_ref, *, bs_blk: int,
+               nb_max: int, sm_scale: float):
+    """int8-pool variant: dequantize the fetched block in VMEM (scales
+    are per (block, kv-head, token) vectors)."""
+    i = pl.program_id(0)
+    t = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0].astype(jnp.float32)
+    _accumulate(
+        i, t, q_ref[0, 0].astype(jnp.float32), k, v,
+        o_ref, acc_ref, m_ref, l_ref, lengths_ref,
+        bs_blk=bs_blk, nb_max=nb_max, sm_scale=sm_scale,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_attention_decode(q, k_pool, v_pool, block_tables, lengths,
+                           k_scale=None, v_scale=None,
                            *, interpret: bool | None = None):
     """q: [b, n_heads, hd] (the single decode token per row);
     k_pool/v_pool: [P, n_kv, bs_blk, hd] (tokens on the sublane axis —
     clean TPU tiles per block); block_tables: [b, nb_max] int32;
     lengths: [b] int32 — the CURRENT query position per row (keys at
-    positions <= lengths[i] are attended).  Returns [b, n_heads, hd]."""
+    positions <= lengths[i] are attended); k_scale/v_scale: optional
+    [P, n_kv, bs_blk, 1] f32 dequant scales for int8 pools.
+    Returns [b, n_heads, hd]."""
     b, n_heads, hd = q.shape
     _p, n_kv, bs_blk, _hd = k_pool.shape
     nb_max = block_tables.shape[1]
     g = n_heads // n_kv
     if interpret is None:
         interpret = not _on_tpu()
+    quant = k_scale is not None
     # kv head j serves q heads [j*g, (j+1)*g): regroup q accordingly
     qg = q.reshape(b, n_kv, g, hd)
+
+    def q_map(i, j, t, tables, lens):
+        return (i, j, 0, 0)
+
+    def pool_map(i, j, t, tables, lens):
+        # THE paged fetch — physical block id from the prefetched table
+        return (tables[i, t], j, 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, g, hd), q_map),
+        pl.BlockSpec((1, 1, bs_blk, hd), pool_map),
+        pl.BlockSpec((1, 1, bs_blk, hd), pool_map),
+    ]
+    operands = [qg, k_pool, v_pool]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, bs_blk, 1), pool_map),
+            pl.BlockSpec((1, 1, bs_blk, 1), pool_map),
+        ]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # block_tables, lengths
         grid=(b, n_kv, nb_max),
-        in_specs=[
-            # q: one (row, kv-head) group per grid step
-            pl.BlockSpec((1, 1, g, hd), lambda i, j, t, tables, lens:
-                         (i, j, 0, 0)),
-            # k/v: THE paged fetch — physical block id from the
-            # prefetched table selects the pool slice
-            pl.BlockSpec((1, 1, bs_blk, hd), lambda i, j, t, tables, lens:
-                         (tables[i, t], j, 0, 0)),
-            pl.BlockSpec((1, 1, bs_blk, hd), lambda i, j, t, tables, lens:
-                         (tables[i, t], j, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, g, hd), lambda i, j, t, tables, lens:
-                               (i, j, 0, 0)),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, g, hd), q_map),
         scratch_shapes=[
             pltpu.VMEM((g, hd), jnp.float32),   # acc
             pltpu.VMEM((g, 1), jnp.float32),    # m
@@ -119,12 +153,13 @@ def paged_attention_decode(q, k_pool, v_pool, block_tables, lengths,
     )
     out = pl.pallas_call(
         functools.partial(
-            _kernel, bs_blk=bs_blk, nb_max=nb_max, sm_scale=hd ** -0.5
+            _kernel_q8 if quant else _kernel,
+            bs_blk=bs_blk, nb_max=nb_max, sm_scale=hd ** -0.5,
         ),
         out_shape=jax.ShapeDtypeStruct((b, n_kv, g, hd), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(block_tables, lengths, qg, k_pool, v_pool)
+    )(block_tables, lengths, *operands)
     return out.reshape(b, n_heads, hd)
 
 
