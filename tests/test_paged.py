@@ -249,8 +249,8 @@ def test_int8_paged_composes_and_serves():
     assert nbytes(gather8) < 0.35 * nbytes(fp)
 
     # engine parity: the paged int8 engine must produce the SAME tokens
-    # as the dense int8 engine on the same schedule (guards the scale-
-    # pool merge in _merge_paged, not just that decoding ran)
+    # as the dense int8 engine on the same schedule (guards the int8
+    # pool write/read paths end to end, not just that decoding ran)
     pool8 = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=8,
                           kv_cache_dtype="int8", kv_pool_blocks=9,
                           paged_kernel="off")
@@ -263,3 +263,98 @@ def test_int8_paged_composes_and_serves():
         eng.submit("b", np.asarray(prompt[1][:4]), num_new=5)
         outs[name] = eng.run()
     assert outs["paged"] == outs["dense"]
+
+
+def test_prefix_caching_shares_blocks_and_stays_exact():
+    """prefix_cache: requests sharing a block-aligned system prompt
+    reuse its K/V blocks — fewer leases, same tokens as the dense
+    engine serving the same schedule without sharing."""
+    kw = dict(KW, max_seq=64)
+    dense_m = TransformerLM(**kw)
+    paged_m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=8,
+                            kv_pool_blocks=20)
+    params = params_for(dense_m)
+    rng = np.random.default_rng(9)
+    system = rng.integers(0, 64, size=16).astype(np.int32)  # 2 blocks
+    reqs = [(f"r{i}",
+             np.concatenate([system,
+                             rng.integers(0, 64, size=3 + i).astype(np.int32)]),
+             6) for i in range(3)]
+
+    eng = PagedBatcher(paged_m, params, max_batch=4, prefix_cache=4)
+    ref = ContinuousBatcher(dense_m, params, max_batch=4)
+    for rid, p, n in reqs:
+        eng.submit(rid, p, num_new=n)
+        ref.submit(rid, p, num_new=n)
+    # r0 leased ceil((19+6)/8)=4 blocks; r1/r2 match the 2-block system
+    # prefix and lease only their suffix+decode blocks
+    st = eng.pool_stats()
+    assert st["registered_prefixes"] >= 1
+    # 3 requests x 4 blocks = 12 unshared; sharing must use fewer
+    assert st["leased"] < 12, st
+    out = eng.run()
+    want = ref.run()
+    assert out == want
+    # registry keeps the prefix blocks alive after all slots retire
+    st = eng.pool_stats()
+    assert st["leased"] == 2 and st["registered_prefixes"] >= 1, st
+
+
+def test_prefix_cache_eviction_frees_blocks():
+    """FIFO eviction beyond the cap unrefs the evicted prefix's
+    blocks."""
+    kw = dict(KW, max_seq=64)
+    paged_m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=8,
+                            kv_pool_blocks=20)
+    params = params_for(TransformerLM(**kw))
+    rng = np.random.default_rng(11)
+    eng = PagedBatcher(paged_m, params, max_batch=2, prefix_cache=1)
+    for i in range(3):
+        p = rng.integers(0, 64, size=10).astype(np.int32)  # 1-block prefix
+        eng.submit(f"r{i}", p, num_new=4)
+        eng.run()
+    st = eng.pool_stats()
+    assert st["registered_prefixes"] == 1
+    # only the latest registered prefix's single block stays leased
+    assert st["leased"] == 1, st
+
+
+def test_prefix_match_admission_uses_post_match_need():
+    """Deadlock regression (review r4): a request that FITS via prefix
+    sharing must be admitted even when its full unshared need exceeds
+    the free blocks."""
+    kw = dict(KW, max_seq=64)
+    paged_m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=8,
+                            kv_pool_blocks=5)  # 4 leasable
+    params = params_for(TransformerLM(**kw))
+    rng = np.random.default_rng(13)
+    system = rng.integers(0, 64, size=16).astype(np.int32)  # 2 blocks
+    eng = PagedBatcher(paged_m, params, max_batch=2, prefix_cache=2)
+    eng.submit("a", system, num_new=8)        # 3 blocks; registers prefix
+    eng.run()
+    # full need = ceil(24/8) = 3 > free 2 (registry pins 2), but the
+    # match shares 2 blocks -> leases only 1
+    p2 = np.concatenate([system, rng.integers(0, 64, size=1).astype(np.int32)])
+    eng.submit("b", p2, num_new=7)
+    out = eng.run()
+    assert len(out["b"]) == 7
+
+
+def test_starved_head_evicts_idle_prefixes():
+    """Deadlock regression (review r4): an UNMATCHED request starved by
+    registry-pinned blocks evicts idle prefixes instead of waiting
+    forever."""
+    kw = dict(KW, max_seq=64)
+    paged_m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=8,
+                            kv_pool_blocks=5)  # 4 leasable
+    params = params_for(TransformerLM(**kw))
+    rng = np.random.default_rng(17)
+    eng = PagedBatcher(paged_m, params, max_batch=2, prefix_cache=2)
+    eng.submit("a", rng.integers(0, 64, size=16).astype(np.int32), num_new=8)
+    eng.run()
+    assert eng.pool_stats()["registered_prefixes"] == 1  # pins 2 blocks
+    # unrelated request needing 3 blocks: must evict the idle prefix
+    eng.submit("b", rng.integers(0, 64, size=20).astype(np.int32), num_new=4)
+    out = eng.run()
+    assert len(out["b"]) == 4
+    assert eng.pool_stats()["registered_prefixes"] <= 2

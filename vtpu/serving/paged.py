@@ -10,19 +10,26 @@ and table never change shape).  When the pool can't cover the next
 request, admission waits for blocks instead of OOMing — backpressure,
 not failure.
 
+Prefill runs DIRECTLY against the live pool: a b=1 apply whose
+[1, nb_max] table row points at the request's leased blocks (donated
+buffers, so the pool updates in place) — no transient pool, no block
+copies, and one compile per prompt length.
+
+Prefix caching (``prefix_cache=N``): the block-aligned prefix of every
+admitted prompt is registered; a later prompt that starts with the same
+tokens REFERENCES those blocks instead of re-prefilling them — its
+suffix prefill attends to the shared K/V through its own table.  Blocks
+are refcounted; a shared block is freed only when every referencing
+slot has retired and the registry entry has been evicted (FIFO beyond
+N entries).  The system-prompt case: one prefill, every request after
+pays only its suffix.
+
 Block 0 is sacrificial: inactive slots still run the decode math
 (uniform compute under jit) and their writes land there via an all-zero
 table row; it is never leased.
 
-Build the model with a pool smaller than ``max_batch × max_seq/bs`` to
-actually share::
-
-    model = TransformerLM(..., kv_cache_layout="paged",
-                          kv_block_size=16, kv_pool_blocks=33)
-    eng = PagedBatcher(model, params, max_batch=8)
-
 Greedy outputs stay token-identical to the DENSE ContinuousBatcher on
-the same request schedule (test-pinned; the paged gather computes the
+the same request schedule (test-pinned; the paged read computes the
 same values the dense layout reads directly).  Comparisons against a
 solo b=1 ``generate()`` can differ on argmax ties — batched matmuls
 reduce in a different order, a property of batching itself, not of
@@ -31,13 +38,14 @@ paging."""
 from __future__ import annotations
 
 import collections
-from typing import Dict, List
+import functools
+from typing import Dict, List, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from vtpu.models.transformer import TransformerLM, _zero_cache
+from vtpu.models.transformer import TransformerLM
 from vtpu.ops.quant import dequantize_tree
 from vtpu.serving.batcher import ContinuousBatcher, _Request
 
@@ -46,7 +54,8 @@ class PagedBatcher(ContinuousBatcher):
     """Continuous batching over a leased-block KV pool."""
 
     def __init__(self, model: TransformerLM, params, max_batch: int,
-                 eos_id=None, prefill_chunk: int = 0):
+                 eos_id=None, prefill_chunk: int = 0,
+                 prefix_cache: int = 0):
         if model.kv_cache_layout != "paged" or model.kv_pool_blocks <= 1:
             raise ValueError(
                 "PagedBatcher needs kv_cache_layout='paged' and a real "
@@ -60,17 +69,58 @@ class PagedBatcher(ContinuousBatcher):
         self.free: collections.deque[int] = collections.deque(
             range(1, model.kv_pool_blocks)
         )
+        self._block_refs: Dict[int, int] = {}
         self._slot_blocks: Dict[int, List[int]] = {}
-        self._prefill_by_need: Dict[int, tuple] = {}
+        # prefix registry: token-tuple (block-aligned) → block ids; FIFO
+        # eviction beyond ``prefix_cache`` entries
+        self.prefix_cache = prefix_cache
+        self._prefixes: "collections.OrderedDict[tuple, List[int]]" = (
+            collections.OrderedDict()
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _pf_pool(params, pools, pos, table_row, tokens):
+            """b=1 prefill against the LIVE pool: pools are donated via
+            the caller contract (self.cache's pool leaves are replaced
+            by the result), table_row [1, nb] points at this request's
+            blocks, pos [1] is its start offset (0, or the shared
+            prefix length under prefix caching)."""
+            cache = dict(pools, pos=pos, block_table=table_row)
+            logits, mut = model.apply(
+                {"params": dequantize_tree(params), "cache": cache},
+                tokens, decode=True, mutable=["cache"],
+            )
+            out = dict(mut["cache"])
+            out.pop("pos")
+            out.pop("block_table")
+            return logits, out
+
+        self._pf_pool = _pf_pool
+
+    # -- block accounting ----------------------------------------------
+    def _lease(self, n: int) -> List[int]:
+        blocks = [self.free.popleft() for _ in range(n)]
+        for b in blocks:
+            self._block_refs[b] = 1
+        return blocks
+
+    def _ref(self, blocks: List[int]) -> None:
+        for b in blocks:
+            self._block_refs[b] += 1
+
+    def _unref(self, blocks: List[int]) -> None:
+        for b in blocks:
+            self._block_refs[b] -= 1
+            if self._block_refs[b] == 0:
+                del self._block_refs[b]
+                self.free.append(b)
 
     # -- admission ------------------------------------------------------
     def _blocks_needed(self, req: _Request) -> int:
         return -(-(req.prompt.size + req.num_new) // self.block_size)
 
     def submit(self, rid: str, prompt, num_new: int) -> None:
-        import numpy as _np
-
-        p = _np.asarray(prompt, _np.int32).reshape(-1)
+        p = np.asarray(prompt, np.int32).reshape(-1)
         need = -(-(p.size + num_new) // self.block_size)
         leasable = self.model.kv_pool_blocks - 1
         if need > leasable:
@@ -87,91 +137,153 @@ class PagedBatcher(ContinuousBatcher):
             if not self.queue:
                 return
             # head-of-line: the oldest request waits for blocks rather
-            # than being overtaken (starvation-proof, FIFO completion)
-            if self._blocks_needed(self.queue[0]) > len(self.free):
+            # than being overtaken (starvation-proof, FIFO completion).
+            # The admissibility check must mirror what _admit actually
+            # leases — the POST-match need — or a request that fits via
+            # sharing waits forever on its full need
+            req = self.queue[0]
+            shared, shared_tok = self._match_prefix(req.prompt)
+            need_new = self._blocks_needed(req) - len(shared)
+            # starved head: evict idle registry prefixes (oldest first,
+            # never the head's own match) — registry-pinned blocks must
+            # yield to real work or an unmatched head waits forever on
+            # blocks nobody is using
+            while need_new > len(self.free) and self._evict_prefix(
+                keep=shared
+            ):
+                pass
+            if need_new > len(self.free):
                 return
-            self._admit(slot, self.queue.popleft())
+            self._admit(slot, self.queue.popleft(), shared, shared_tok)
 
-    def _prefill_fn(self, need: int):
-        """Jitted b=1 prefill against a TRANSIENT pool of exactly
-        ``need`` blocks (identity table) — one compile per distinct
-        lease size, and the transient never scales with the real pool."""
-        if need not in self._prefill_by_need:
-            variant = self.model.clone(kv_pool_blocks=need + 1, parent=None)
-            tmpl = _zero_cache(variant, jnp.zeros((1, 1), jnp.int32))
-            # logical block j → transient block j+1 (0 stays garbage)
-            row = np.zeros((1, self.nb_max), np.int32)
-            row[0, :need] = np.arange(1, need + 1)
-            tmpl = dict(tmpl, block_table=jnp.asarray(row))
+    def _match_prefix(self, prompt: np.ndarray) -> Tuple[List[int], int]:
+        """Longest registered block-aligned prefix of ``prompt``,
+        leaving at least one suffix token to prefill (the admission
+        needs last-token logits).  Returns (shared block ids, shared
+        token count)."""
+        if not self.prefix_cache:
+            return [], 0
+        best: List[int] = []
+        best_len = 0
+        for key, blocks in self._prefixes.items():
+            klen = len(key)
+            if (
+                klen > best_len and klen < prompt.size
+                and np.array_equal(prompt[:klen], np.asarray(key))
+            ):
+                best, best_len = blocks, klen
+        return list(best), best_len
 
-            @jax.jit
-            def _pf(params, cache, prompt):
-                logits, mut = variant.apply(
-                    {"params": dequantize_tree(params), "cache": cache},
-                    prompt, decode=True, mutable=["cache"],
-                )
-                return logits, mut["cache"]
+    def _evict_prefix(self, keep: List[int]) -> bool:
+        """Evict the oldest registry entry whose blocks are not
+        ``keep`` (the head request's own match).  Returns True if one
+        was evicted.  Freeing only happens when no slot still holds the
+        blocks — evicting an in-use prefix loses reuse, never data."""
+        for key, blocks in self._prefixes.items():
+            if blocks != keep:
+                del self._prefixes[key]
+                self._unref(blocks)
+                return True
+        return False
 
-            self._prefill_by_need[need] = (_pf, tmpl)
-        return self._prefill_by_need[need]
-
-    def _admit(self, slot: int, req: _Request) -> None:
-        need = self._blocks_needed(req)
-        assigned = [self.free.popleft() for _ in range(need)]
-        self._slot_blocks[slot] = assigned
-        pf, tmpl = self._prefill_fn(need)
-        if 0 < self.prefill_chunk < req.prompt.size:
-            # chunked admission: blocks are leased now (reserved), the
-            # transient-pool prefill advances one chunk per step()
-            # between the running slots' decodes (same interleave
-            # contract as the dense engine)
-            self.prefilling[slot] = {
-                "req": req, "cache": tmpl, "done": 0,
-                "assigned": assigned, "need": need, "pf": pf,
-            }
+    def _register_prefix(self, prompt: np.ndarray,
+                         table_blocks: List[int]) -> None:
+        aligned = (prompt.size // self.block_size) * self.block_size
+        if not self.prefix_cache or aligned < self.block_size:
             return
-        prompt = jnp.asarray(req.prompt)[None, :]
-        logits, row_cache = pf(self.params, tmpl, prompt)
-        # _activate (the shared admission tail) calls back into
-        # _merge_row, which needs this lease's mapping
-        self._pending_lease = (assigned, need)
-        self._activate(slot, req, logits, row_cache)
+        key = tuple(int(t) for t in prompt[:aligned])
+        if key in self._prefixes:
+            return
+        blocks = table_blocks[:aligned // self.block_size]
+        self._ref(blocks)
+        self._prefixes[key] = blocks
+        while len(self._prefixes) > self.prefix_cache:
+            _old_key, old_blocks = self._prefixes.popitem(last=False)
+            self._unref(old_blocks)
+
+    def _admit(self, slot: int, req: _Request,
+               shared: List[int] = None, shared_tok: int = 0) -> None:
+        if shared is None:
+            shared, shared_tok = self._match_prefix(req.prompt)
+        new_needed = self._blocks_needed(req) - len(shared)
+        assigned = self._lease(new_needed)
+        self._ref(shared)
+        table_blocks = shared + assigned
+        self._slot_blocks[slot] = table_blocks  # all unref'd at retire
+        row = np.zeros((1, self.nb_max), np.int32)
+        row[0, :len(table_blocks)] = table_blocks
+        if 0 < self.prefill_chunk < req.prompt.size - shared_tok:
+            # chunked admission: the suffix prefills one chunk per
+            # step() between the running slots' decodes; pools always
+            # live in self.cache between chunks (pf absorbs them back)
+            st = {"req": req, "cache": None, "done": shared_tok,
+                  "row": jnp.asarray(row)}
+            st["pf"] = self._make_chunk_pf(st)
+            self.prefilling[slot] = st
+            return
+        suffix = jnp.asarray(req.prompt[shared_tok:])[None, :]
+        logits = self._run_pool_prefill(row, shared_tok, suffix)
+        # register only once the prefix K/V are actually WRITTEN — a
+        # match against an unfinished prefill would read zeros
+        self._register_prefix(req.prompt, table_blocks)
+        self._pending_lease = (table_blocks, req.prompt.size)
+        self._activate(slot, req, logits, None)
+
+    def _split_cache(self) -> Tuple[dict, jnp.ndarray, jnp.ndarray]:
+        pools = dict(self.cache)
+        pos = pools.pop("pos")
+        table = pools.pop("block_table")
+        return pools, pos, table
+
+    def _run_pool_prefill(self, row, start_tok: int, tokens):
+        """One prefill segment against the live pool; the updated pools
+        replace self.cache's (in-place spirit — the old pool buffers
+        are dead after this)."""
+        pools, pos, table = self._split_cache()
+        logits, new_pools = self._pf_pool(
+            self.params, pools, jnp.full((1,), start_tok, jnp.int32),
+            row, tokens,
+        )
+        self.cache = dict(new_pools, pos=pos, block_table=table)
+        return logits
+
+    def _make_chunk_pf(self, st: dict):
+        """Per-slot adapter for the base chunk driver, closed over ITS
+        state (re-deriving "the" prefilling slot from self.prefilling
+        would break the moment the base picks slots differently)."""
+        def pf(_params, _cache_unused, chunk):
+            logits = self._run_pool_prefill(st["row"], st["done"], chunk)
+            return logits, None
+
+        return pf
 
     def _pre_activate(self, slot: int, st: dict) -> None:
-        # the base _advance_prefill drives the chunks (it picks up our
-        # per-need prefill fn from st["pf"]); we only record the lease
-        # for _merge_row before activation
-        self._pending_lease = (st["assigned"], st["need"])
+        # chunked prefill just finished writing its last chunk — the
+        # prefix is complete and safe to register now
+        self._register_prefix(st["req"].prompt, self._slot_blocks[slot])
+        self._pending_lease = (
+            self._slot_blocks[slot], st["req"].prompt.size
+        )
 
     def _merge_row(self, slot: int, row_cache) -> None:
-        assigned, need = self._pending_lease
-        self._merge_paged(slot, row_cache, assigned, need)
-
-    def _merge_paged(self, slot: int, row_cache, assigned: List[int],
-                     need: int) -> None:
-        """Copy the leased blocks out of the transient prefill pool into
-        the shared pool, and point the slot's table row at them."""
-        assigned_dev = jnp.asarray(assigned, jnp.int32)
-
-        def merge(b_leaf, r_leaf):
-            if b_leaf.ndim == 4:  # k_pool/v_pool [P, n_kv, bs, hd]
-                return b_leaf.at[assigned_dev].set(
-                    r_leaf[1:need + 1].astype(b_leaf.dtype)
-                )
-            if b_leaf.ndim == 2:  # block_table [max_batch, nb_max]
-                row = np.zeros((self.nb_max,), np.int32)
-                row[:need] = assigned
-                return b_leaf.at[slot].set(jnp.asarray(row))
-            # pos [max_batch] ← the row's advanced counter
-            return b_leaf.at[slot].set(r_leaf[0])
-
-        self.cache = jax.tree.map(merge, self.cache, row_cache)
+        """Prefill already wrote the pool in place; only the slot's
+        table row and position remain to publish."""
+        table_blocks, pos_val = self._pending_lease
+        row = np.zeros((self.nb_max,), np.int32)
+        row[:len(table_blocks)] = table_blocks
+        self.cache = dict(
+            self.cache,
+            block_table=self.cache["block_table"].at[slot].set(
+                jnp.asarray(row)
+            ),
+            pos=self.cache["pos"].at[slot].set(pos_val),
+        )
 
     # -- retirement -----------------------------------------------------
     def _on_retire(self, slot: int) -> None:
         blocks = self._slot_blocks.pop(slot, None)
         if blocks:
-            self.free.extend(blocks)
+            self._unref(blocks)
         # the slot keeps decoding as an inactive row: point its writes
         # at the garbage block and rewind its position so a freed block
         # reassigned to a NEW tenant is never clobbered
@@ -184,9 +296,10 @@ class PagedBatcher(ContinuousBatcher):
         )
 
     def pool_stats(self) -> dict:
-        leased = sum(len(v) for v in self._slot_blocks.values())
+        leased = len(self._block_refs)
         return {"pool_blocks": self.model.kv_pool_blocks,
-                "leased": leased, "free": len(self.free)}
+                "leased": leased, "free": len(self.free),
+                "registered_prefixes": len(self._prefixes)}
 
     def stats(self) -> dict:
         return {**super().stats(), **self.pool_stats()}
