@@ -162,3 +162,30 @@ def test_duplicate_rid_rejected_during_chunked_prefill():
     assert eng.prefilling  # mid-admission
     with pytest.raises(ValueError, match="duplicate"):
         eng.submit("x", p, num_new=2)
+
+
+def test_instant_retirement_does_not_clobber_nested_admissions():
+    """Regression (review r4 high): an admission with num_new=1 retires
+    instantly and re-enters admission, filling slots the outer loop's
+    snapshot still lists as free — a later iteration must NOT admit
+    into them (it would clobber the nested admission's request)."""
+    model, params = make_model()
+    prompts = prompts_for(model, 6, [4, 4, 3, 3, 3, 3], seed=21)
+
+    want = {}
+    for i, (p, n) in enumerate(zip(prompts, [4, 4, 1, 3, 3, 3])):
+        want[f"r{i}"] = np.asarray(
+            generate(model, params, jnp.asarray(p)[None], num_new=n)
+        )[0].tolist()
+
+    eng = ContinuousBatcher(model, params, max_batch=2)
+    # fill both slots, then queue: an instant-retire request followed
+    # by three normal ones
+    eng.submit("r0", prompts[0], num_new=4)
+    eng.submit("r1", prompts[1], num_new=4)
+    eng.submit("r2", prompts[2], num_new=1)   # retires at admission
+    eng.submit("r3", prompts[3], num_new=3)
+    eng.submit("r4", prompts[4], num_new=3)
+    eng.submit("r5", prompts[5], num_new=3)
+    out = eng.run()
+    assert out == want

@@ -186,8 +186,9 @@ def test_paged_kernel_knob_validated():
 
 def test_paged_chunked_prefill_interleaves_and_matches_dense():
     """prefill_chunk on the paged engine: a long admission prefills
-    chunk-by-chunk in its transient pool while running slots decode,
-    and the outputs match the dense engine under the same chunking."""
+    chunk-by-chunk directly against the live pool (pools stay in
+    self.cache between chunks) while running slots decode, and the
+    outputs match the dense engine under the same chunking."""
     dense_m = TransformerLM(**KW)
     paged_m = TransformerLM(**KW, kv_cache_layout="paged", kv_block_size=8,
                             kv_pool_blocks=9)
@@ -358,3 +359,30 @@ def test_starved_head_evicts_idle_prefixes():
     out = eng.run()
     assert len(out["b"]) == 4
     assert eng.pool_stats()["registered_prefixes"] <= 2
+
+
+def test_instant_retirement_no_clobber_and_no_block_leak():
+    """Regression (review r4 high): same double-admission hazard on the
+    paged engine — plus its lease accounting: the clobbered slot's
+    blocks must not leak."""
+    kw = dict(KW, max_seq=64)
+    dense_m = TransformerLM(**kw)
+    paged_m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=8,
+                            kv_pool_blocks=20)
+    params = params_for(dense_m)
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(0, 64, size=ln).astype(np.int32)
+               for ln in (4, 4, 3, 3, 3, 3)]
+    nums = [4, 4, 1, 3, 3, 3]
+
+    outs = {}
+    for name, eng in [
+        ("dense", ContinuousBatcher(dense_m, params, max_batch=2)),
+        ("paged", PagedBatcher(paged_m, params, max_batch=2)),
+    ]:
+        for i, (p, n) in enumerate(zip(prompts, nums)):
+            eng.submit(f"r{i}", p, num_new=n)
+        outs[name] = eng.run()
+    assert outs["paged"] == outs["dense"]
+    assert all(len(outs["paged"][f"r{i}"]) == nums[i] for i in range(6))
+    assert eng.pool_stats()["leased"] == 0  # nothing leaked

@@ -87,11 +87,7 @@ class ContinuousBatcher:
         self.out: Dict[str, List[int]] = {}
         self.queue: collections.deque[_Request] = collections.deque()
         self.steps = 0  # decode forwards executed (batch-wide)
-        # ONE zero-cache template for every admission: the cache's
-        # shapes ([1, n_kv, max_seq, hd] K/V, [1] pos) don't depend on
-        # prompt length, and building it is a full eval_shape trace of
-        # model.init — admission churn must not re-trace
-        self._row_tmpl = _zero_cache(model, jnp.zeros((1, 1), jnp.int32))
+        self._row_tmpl = None  # lazy; see _row_template()
 
         @jax.jit
         def _step(params, cache, tok):
@@ -157,24 +153,47 @@ class ContinuousBatcher:
         return [i for i in range(self.max_batch)
                 if not self.active[i] and i not in self.prefilling]
 
+    def _slot_is_free(self, slot: int) -> bool:
+        return not self.active[slot] and slot not in self.prefilling
+
     def _admit_pending(self) -> None:
         for slot in self._free_slots():
             if not self.queue:
                 return
+            # re-check: an admission with num_new=1 retires instantly
+            # and RE-ENTERS this method, which may have filled slots the
+            # snapshot above still lists as free — admitting into one
+            # would clobber the nested admission's request
+            if not self._slot_is_free(slot):
+                continue
             req = self.queue.popleft()
             self._admit(slot, req)
+
+    def _row_template(self):
+        """Zero b=1 cache template, built on first use: its shapes
+        don't depend on prompt length (one eval_shape trace total), and
+        the paged engine never needs it — eager construction there
+        would duplicate the whole block pool."""
+        if self._row_tmpl is None:
+            self._row_tmpl = _zero_cache(
+                self.model, jnp.zeros((1, 1), jnp.int32)
+            )
+        return self._row_tmpl
 
     def _admit(self, slot: int, req: _Request) -> None:
         if 0 < self.prefill_chunk < req.prompt.size:
             # long prompt: reserve the slot and prefill chunk-by-chunk
             # from step() so running slots keep decoding in between
-            self.prefilling[slot] = {"req": req, "cache": self._row_tmpl,
+            self.prefilling[slot] = {"req": req,
+                                     "cache": self._row_template(),
                                      "done": 0}
             return
         # b=1 prefill in a fresh single-row cache (jitted: compiles once
         # per prompt length), then scatter the row into the batch cache
         prompt = jnp.asarray(req.prompt)[None, :]
-        logits, row_cache = self._prefill(self.params, self._row_tmpl, prompt)
+        logits, row_cache = self._prefill(
+            self.params, self._row_template(), prompt
+        )
         self._activate(slot, req, logits, row_cache)
 
     def _merge_row(self, slot: int, row_cache) -> None:

@@ -121,7 +121,7 @@ class PagedBatcher(ContinuousBatcher):
 
     def submit(self, rid: str, prompt, num_new: int) -> None:
         p = np.asarray(prompt, np.int32).reshape(-1)
-        need = -(-(p.size + num_new) // self.block_size)
+        need = self._blocks_needed(_Request(rid, p, num_new))
         leasable = self.model.kv_pool_blocks - 1
         if need > leasable:
             # a request the pool can NEVER serve must fail loudly now —
@@ -136,6 +136,8 @@ class PagedBatcher(ContinuousBatcher):
         for slot in self._free_slots():
             if not self.queue:
                 return
+            if not self._slot_is_free(slot):
+                continue  # a nested admission filled it (see base)
             # head-of-line: the oldest request waits for blocks rather
             # than being overtaken (starvation-proof, FIFO completion).
             # The admissibility check must mirror what _admit actually
@@ -144,10 +146,11 @@ class PagedBatcher(ContinuousBatcher):
             req = self.queue[0]
             shared, shared_tok = self._match_prefix(req.prompt)
             need_new = self._blocks_needed(req) - len(shared)
-            # starved head: evict idle registry prefixes (oldest first,
-            # never the head's own match) — registry-pinned blocks must
-            # yield to real work or an unmatched head waits forever on
-            # blocks nobody is using
+            # starved head: evict IDLE registry prefixes (oldest
+            # first, never the head's own match, only entries whose
+            # blocks actually free) — registry-pinned blocks must yield
+            # to real work, but evicting a prefix still referenced by
+            # an active slot frees nothing and just loses future reuse
             while need_new > len(self.free) and self._evict_prefix(
                 keep=shared
             ):
@@ -176,11 +179,13 @@ class PagedBatcher(ContinuousBatcher):
 
     def _evict_prefix(self, keep: List[int]) -> bool:
         """Evict the oldest registry entry whose blocks are not
-        ``keep`` (the head request's own match).  Returns True if one
-        was evicted.  Freeing only happens when no slot still holds the
-        blocks — evicting an in-use prefix loses reuse, never data."""
+        ``keep`` (the head request's own match) AND are held only by
+        the registry (refcount 1 ⇒ eviction genuinely frees blocks).
+        Returns True if one was evicted."""
         for key, blocks in self._prefixes.items():
-            if blocks != keep:
+            if blocks != keep and all(
+                self._block_refs.get(b, 0) == 1 for b in blocks
+            ):
                 del self._prefixes[key]
                 self._unref(blocks)
                 return True
