@@ -120,6 +120,14 @@ test-native-tsan:
 bench:
 	$(PY) bench.py
 
+# (Re)arm the detached TPU-window watcher.  Safe to run unconditionally at
+# the start of every session: a live watcher keeps its lock and the new
+# launch exits immediately.  Logs → docs/artifacts/bench_watch.log.
+bench-watch:
+	@mkdir -p docs/artifacts
+	nohup $(PY) hack/bench_watch.py >> docs/artifacts/bench_watch.log 2>&1 &
+	@sleep 2 && cat docs/artifacts/bench_watch_status.json
+
 image:
 	docker build -t $(IMG):$(VERSION) -f docker/Dockerfile .
 
