@@ -126,7 +126,7 @@ bench:
 bench-watch:
 	@mkdir -p docs/artifacts
 	nohup $(PY) hack/bench_watch.py >> docs/artifacts/bench_watch.log 2>&1 &
-	@sleep 2 && cat docs/artifacts/bench_watch_status.json
+	@sleep 2 && cat docs/artifacts/bench_watch_status.json 2>/dev/null || true
 
 image:
 	docker build -t $(IMG):$(VERSION) -f docker/Dockerfile .

@@ -110,6 +110,7 @@ ARM_REQUIRED_KEYS = {
     "exclusive": ("platform", "exclusive_img_s"),
     "share": ("platform", "per_tenant_img_s"),
     "oversub": ("platform", "probe"),
+    "pacing": ("platform", "probe"),
 }
 
 
@@ -564,7 +565,8 @@ def tenant_env(shim: bool, quota_mb: int, region_path: str | None,
 
 def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
                      shim: bool = True, extra_env: dict | None = None,
-                     pre_gated: bool = False):
+                     pre_gated: bool = False,
+                     per_tenant_env: list | None = None):
     """Spawn ``n_tenants`` processes, each loading the real PJRT plugin
     THROUGH the interposer with a 1/n HBM quota, sharing one region; a
     file barrier aligns their measurement windows.  ``shim=False`` is
@@ -592,10 +594,14 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
             **(extra_env or {}),
         },
     )
-    def spawn():
+    def spawn(idx: int = 0):
+        # per_tenant_env[i] overlays tenant i's env (the pacing probe's
+        # differing TPU_DEVICE_CORES_LIMIT quotas ride this)
+        env = (dict(env_base, **per_tenant_env[idx])
+               if per_tenant_env else env_base)
         return subprocess.Popen(
             [sys.executable, "-m", "vtpu.shim.native_tenant"],
-            env=env_base, cwd=REPO,
+            env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         )
 
@@ -603,7 +609,7 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
     # the rest then deserialize instead of racing n concurrent remote
     # compiles (which queue behind each other on a contended transport
     # and blow the barrier window)
-    procs = [spawn()]
+    procs = [spawn(0)]
     # orphaned tenants keep chip sessions claimed and starve every later
     # run — make sure they die with the orchestrator, whatever kills it
     import atexit
@@ -628,7 +634,7 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
     try:
         deadline = time.monotonic() + 900
         wait_ready(1, deadline)
-        procs.extend(spawn() for _ in range(n_tenants - 1))
+        procs.extend(spawn(i) for i in range(1, n_tenants))
         wait_ready(n_tenants, deadline)
         open(os.path.join(tmp, "go"), "w").close()
         outs = []
@@ -657,16 +663,21 @@ def run_native_share(quota_mb: int, window_s: float, n_tenants: int = 4,
             round(1000.0 * shim_ms / execs, 2) if execs else None
         )
         info["shim_size_rtts"] = sum(s.get("size_rtts", 0) for s in shim_stats)
+        pace_ms = sum(s.get("pace_sleep_ms", 0) for s in shim_stats)
+        if pace_ms:
+            # the execute-pacer's total sleep: the drain/duty overhead
+            # the pacing probe reports alongside its throughput ratios
+            info["shim_pace_sleep_ms"] = round(pace_ms, 1)
     if shim:
         try:
             from vtpu.monitor.shared_region import open_region
 
             rf = open_region(region)
             if rf is not None:
-                info = {
-                    "region_procs": len(rf.live_procs()),
-                    "region_limit_bytes": rf.limits()[0] if rf.limits() else 0,
-                }
+                info.update(
+                    region_procs=len(rf.live_procs()),
+                    region_limit_bytes=rf.limits()[0] if rf.limits() else 0,
+                )
                 rf.close()
         except Exception:  # noqa: BLE001 — diagnostics only
             pass
@@ -726,12 +737,18 @@ def run_oversubscribe_probe(window_s: float = 8.0) -> dict | None:
     quota_mb = int(os.environ.get("VTPU_OVERSUB_QUOTA_MB", "384"))
     arms = {}
     ok = 0
-    for arm, (q, osub) in {
-        "oversub": (quota_mb, "true"),
-        "hard": (quota_mb, ""),
-        "all_device": (0, ""),
+    for arm, (q, env2) in {
+        "oversub": (quota_mb, {"VTPU_OVERSUBSCRIBE": "true"}),
+        "hard": (quota_mb, {"VTPU_OVERSUBSCRIBE": ""}),
+        # the WIN comparison (ref README.md:198 stock-vs-vm row): the
+        # same over-quota training run via the stock workaround —
+        # manual per-step host shuttling of the non-resident layers.
+        # win_vs_manual = transparent-swap img/s / manual img/s.
+        "manual_stream": (quota_mb, {"VTPU_OVERSUBSCRIBE": "",
+                                     "VTPU_OVERSUB_MANUAL": "1"}),
+        "all_device": (0, {"VTPU_OVERSUBSCRIBE": ""}),
     }.items():
-        env = {"VTPU_TENANT_MODE": "oversub", "VTPU_OVERSUBSCRIBE": osub}
+        env = {"VTPU_TENANT_MODE": "oversub", **env2}
         res = run_native_share(
             quota_mb=q, window_s=window_s, n_tenants=1, extra_env=env
         )
@@ -757,20 +774,114 @@ def run_oversubscribe_probe(window_s: float = 8.0) -> dict | None:
         )
     if "error" not in arms["hard"]:
         out["hard_quota_rejected"] = bool(arms["hard"].get("hard_reject"))
+    if "error" not in arms["manual_stream"]:
+        out["manual_stream_img_s"] = round(
+            arms["manual_stream"].get("img_s", 0), 2
+        )
+        out["manual_resident_layers"] = arms["manual_stream"].get(
+            "resident_layers"
+        )
+        if out.get("oversub_img_s") and out["manual_stream_img_s"]:
+            out["win_vs_manual"] = round(
+                out["oversub_img_s"] / out["manual_stream_img_s"], 3
+            )
     if "error" not in arms["all_device"]:
         out["all_device_img_s"] = round(arms["all_device"].get("img_s", 0), 2)
     return out
 
 
+def run_pacing_probe(window_s: float = 10.0) -> dict | None:
+    """Core-percentage enforcement proof on the real chip (the ref's SM
+    throttling, SURVEY §2.5 CUDA_DEVICE_SM_LIMIT semantics):
+
+      solo   a q=50 tenant ALONE should reach ~half the q=100 solo rate
+             — only the shim's execute pacing can cause that (no
+             contention in the arm), so the ratio is the duty cycle
+      trio   q=30/60/100 tenants CONCURRENTLY sharing the chip — rates
+             must order with quota and roughly track the 30:60:100
+             shape (contention makes exact proportionality soft)
+
+    Also records the pacer's own cost: summed pace-sleep ms and the
+    shim's added us/exec (drain overhead of the adaptive calibrator).
+    Returns the dict for bench extra, or None when nothing ran."""
+    quota_mb = int(os.environ.get("VTPU_PACING_QUOTA_MB", "3072"))
+    out: dict = {"solo": {}, "trio": {}}
+    ok = 0
+    for q in (100, 50):  # q=100 first: seeds the compile cache fastest
+        res = run_native_share(
+            quota_mb=quota_mb, window_s=window_s, n_tenants=1,
+            extra_env={"TPU_DEVICE_CORES_LIMIT": str(q)},
+        )
+        if res is None:
+            phase_note("pacing_probe", arm=f"solo{q}", rc="error")
+            continue
+        outs, info = res
+        out["solo"][str(q)] = {
+            "img_s": round(outs[0]["img_s"], 2),
+            "pace_sleep_ms": info.get("shim_pace_sleep_ms", 0),
+            "shim_added_us_per_exec": info.get("shim_added_us_per_exec"),
+        }
+        ok += 1
+        phase_note("pacing_probe", arm=f"solo{q}", rc=0)
+    qs = (100, 60, 30)
+    res = run_native_share(
+        quota_mb=quota_mb, window_s=window_s, n_tenants=3,
+        per_tenant_env=[{"TPU_DEVICE_CORES_LIMIT": str(q)} for q in qs],
+    )
+    if res is not None:
+        outs, info = res
+        rates = {str(q): round(o["img_s"], 2) for q, o in zip(qs, outs)}
+        out["trio"] = {
+            "rates_img_s": rates,
+            "pace_sleep_ms": info.get("shim_pace_sleep_ms", 0),
+        }
+        if rates.get("100"):
+            out["trio"]["ratio_30_vs_100"] = round(
+                rates["30"] / rates["100"], 3
+            )
+            out["trio"]["ratio_60_vs_100"] = round(
+                rates["60"] / rates["100"], 3
+            )
+        ok += 1
+        phase_note("pacing_probe", arm="trio", rc=0)
+    else:
+        phase_note("pacing_probe", arm="trio", rc="error")
+    if ok == 0:
+        return None
+    solo = out["solo"]
+    if "50" in solo and solo.get("100", {}).get("img_s"):
+        out["solo_duty_50"] = round(
+            solo["50"]["img_s"] / solo["100"]["img_s"], 3
+        )
+    # only a probe that produced BOTH headline numbers may be cached —
+    # stitching a flap-truncated probe for 48 h would permanently
+    # suppress re-measuring the enforcement ratios
+    out["complete"] = (
+        "solo_duty_50" in out and "ratio_30_vs_100" in out["trio"]
+    )
+    return out
+
+
 def emit(efficiency: float, extra: dict) -> None:
     target = 0.95  # BASELINE.json: within 5% of exclusive
+    # the headline value is only real when BOTH arms ran the measured
+    # path (native shim on a real chip); a CPU/cooperative fallback
+    # nulls it so nobody quotes GIL arithmetic as the product number
+    # (VERDICT r4 weak #7) — the fallback ratio stays readable in extra
+    measured = (
+        extra.get("platform") not in (None, "cpu")
+        and bool(extra.get("native_shim"))
+    )
+    if not measured:
+        extra = dict(extra, fallback_ratio=round(efficiency, 4))
     print(
         json.dumps(
             {
                 "metric": "resnet50_4way_share_efficiency",
-                "value": round(efficiency, 4),
+                "value": round(efficiency, 4) if measured else None,
                 "unit": "shared_sum_img_per_s / exclusive_img_per_s",
-                "vs_baseline": round(efficiency / target, 4),
+                "vs_baseline": round(efficiency / target, 4)
+                if measured else None,
                 "extra": extra,
             }
         ),
@@ -958,6 +1069,27 @@ def main() -> None:
             if probe.get("arms_ok"):
                 save_arm("oversub", {"platform": platform, "probe": probe})
                 arm_sources["oversub"] = "live"
+    # core-percentage pacing proof — additive, same budget discipline
+    cached_pacing = load_arm("pacing") if platform != "cpu" else None
+    if cached_pacing is not None:
+        extra["pacing"] = cached_pacing.get("probe", {})
+        arm_sources["pacing"] = arm_stamp(cached_pacing)
+    elif (
+        native
+        and os.environ.get("VTPU_BENCH_PACING", "1") != "0"
+        and time.monotonic() - T_START < budget_s - 600
+    ):
+        try:
+            probe = run_pacing_probe()
+        except Exception as e:  # noqa: BLE001 — additive artifact only
+            phase_note("pacing_probe", rc="error", error=str(e)[:200])
+            probe = None
+        if probe is not None:
+            extra["pacing"] = probe
+            log(f"pacing probe: {probe}")
+            if probe.get("complete"):
+                save_arm("pacing", {"platform": platform, "probe": probe})
+                arm_sources["pacing"] = "live"
     if excl_per_proc:
         extra["exclusive_per_proc_img_s"] = [round(r, 2) for r in excl_per_proc]
     if excl_per_proc and native:

@@ -64,7 +64,10 @@ def acquire_lock(force: bool) -> bool:
             old = int(open(PIDFILE).read().strip() or 0)
         except ValueError:
             old = 0
-        if old and _pid_alive(old):
+        # old == our own pid happens after the deadline re-exec (execv
+        # keeps the pid): killing it would be suicide, and the lock is
+        # already ours
+        if old and old != os.getpid() and _pid_alive(old):
             if not force:
                 print(f"[bench_watch] live watcher pid={old} holds the "
                       "lock; exiting (use --force to replace)", flush=True)

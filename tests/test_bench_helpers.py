@@ -51,16 +51,24 @@ def test_oversub_probe_keeps_partial_arms(monkeypatch):
     def fake_share(quota_mb, window_s, n_tenants=4, shim=True, extra_env=None):
         if quota_mb == 0:  # the all_device arm flakes
             return None
-        if (extra_env or {}).get("VTPU_OVERSUBSCRIBE") == "true":
+        env = extra_env or {}
+        if env.get("VTPU_OVERSUB_MANUAL") == "1":
+            return ([{"img_s": 25.0, "manual_stream": True,
+                      "resident_layers": 13}], {})
+        if env.get("VTPU_OVERSUBSCRIBE") == "true":
             return ([{"img_s": 100.0, "params_mb": 512, "swap_bytes": 7}], {})
         return ([{"hard_reject": True}], {})
 
     monkeypatch.setattr(bench, "run_native_share", fake_share)
     out = bench.run_oversubscribe_probe()
     assert out is not None
-    assert out["arms_ok"] == 2
+    assert out["arms_ok"] == 3
     assert out["oversub_img_s"] == 100.0 and out["swap_bytes"] == 7
     assert out["hard_quota_rejected"] is True
+    # the win row: transparent swap vs the stock manual-shuttle workaround
+    assert out["manual_stream_img_s"] == 25.0
+    assert out["win_vs_manual"] == 4.0
+    assert out["manual_resident_layers"] == 13
     assert "all_device_img_s" not in out
 
 
@@ -167,6 +175,11 @@ def test_main_stitches_cached_arms(monkeypatch, tmp_path, capsys):
         "platform": "tpu",
         "probe": {"quota_mb": 384, "arms_ok": 3, "swap_bytes": 123},
     })
+    bench.save_arm("pacing", {
+        "platform": "tpu",
+        "probe": {"solo_duty_50": 0.52,
+                  "trio": {"ratio_30_vs_100": 0.33}},
+    })
 
     def boom(*_a, **_kw):
         raise AssertionError("backend touched despite cached arms")
@@ -184,6 +197,63 @@ def test_main_stitches_cached_arms(monkeypatch, tmp_path, capsys):
     assert out["extra"]["exclusive_mode"] == "4proc_noshim"
     assert 0.98 < out["value"] < 0.99  # 4*2712 / 11000
     assert out["extra"]["oversubscribe"]["swap_bytes"] == 123
+    assert out["extra"]["pacing"]["solo_duty_50"] == 0.52
     srcs = out["extra"]["arm_sources"]
-    assert set(srcs) == {"exclusive", "share", "oversub"}
+    assert set(srcs) == {"exclusive", "share", "oversub", "pacing"}
     assert all(s.startswith("cached@") for s in srcs.values())
+
+
+def test_pacing_probe_partial_and_ratios(monkeypatch):
+    """The pacing probe survives a failed arm and computes duty/ratio
+    numbers from whatever ran; per-tenant core quotas ride
+    per_tenant_env."""
+    calls = []
+
+    def fake_share(quota_mb, window_s, n_tenants=4, shim=True,
+                   extra_env=None, per_tenant_env=None, **_kw):
+        calls.append((n_tenants, extra_env, per_tenant_env))
+        if per_tenant_env is not None:  # the trio
+            assert [e["TPU_DEVICE_CORES_LIMIT"] for e in per_tenant_env] \
+                == ["100", "60", "30"]
+            return ([{"img_s": 900.0}, {"img_s": 540.0}, {"img_s": 290.0}],
+                    {"shim_pace_sleep_ms": 1234.5})
+        q = extra_env["TPU_DEVICE_CORES_LIMIT"]
+        if q == "50":
+            return None  # solo50 flakes; probe must keep going
+        return ([{"img_s": 1000.0}], {"shim_pace_sleep_ms": 0})
+
+    monkeypatch.setattr(bench, "run_native_share", fake_share)
+    out = bench.run_pacing_probe()
+    assert out is not None
+    assert out["solo"]["100"]["img_s"] == 1000.0
+    assert "50" not in out["solo"] and "solo_duty_50" not in out
+    assert out["trio"]["ratio_30_vs_100"] == round(290.0 / 900.0, 3)
+    assert out["trio"]["ratio_60_vs_100"] == round(540.0 / 900.0, 3)
+    assert out["trio"]["pace_sleep_ms"] == 1234.5
+    # a flap-truncated probe must NOT be cacheable (it would suppress
+    # re-measuring the ratios for the whole state TTL)
+    assert out["complete"] is False
+
+    monkeypatch.setattr(bench, "run_native_share", lambda *a, **k: None)
+    assert bench.run_pacing_probe() is None
+
+
+def test_emit_nulls_value_on_fallback(capsys):
+    """A CPU/cooperative-fallback artifact must not carry a quotable
+    top-level value (VERDICT r4 weak #7); the measured path keeps it."""
+    import json
+
+    bench.emit(0.99, {"platform": "cpu", "native_shim": False})
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] is None and out["vs_baseline"] is None
+    assert out["extra"]["fallback_ratio"] == 0.99
+
+    bench.emit(0.99, {"platform": "tpu", "native_shim": False})
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] is None  # cooperative fallback on tpu: also null
+
+    bench.emit(0.986, {"platform": "tpu", "native_shim": True})
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["value"] == 0.986
+    assert out["vs_baseline"] == round(0.986 / 0.95, 4)
+    assert "fallback_ratio" not in out["extra"]
