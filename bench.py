@@ -787,6 +787,13 @@ def run_oversubscribe_probe(window_s: float = 8.0) -> dict | None:
             )
     if "error" not in arms["all_device"]:
         out["all_device_img_s"] = round(arms["all_device"].get("img_s", 0), 2)
+    # cache-worthiness mirrors the pacing probe: a flap-truncated probe
+    # (headline win or swap evidence missing) must re-measure next
+    # window instead of stitching for the whole TTL
+    out["complete"] = bool(
+        out.get("oversub_img_s") and out.get("win_vs_manual")
+        and "all_device_img_s" in out and "hard_quota_rejected" in out
+    )
     return out
 
 
@@ -1066,7 +1073,7 @@ def main() -> None:
         if probe is not None:
             extra["oversubscribe"] = probe
             log(f"oversubscribe probe: {probe}")
-            if probe.get("arms_ok"):
+            if probe.get("complete"):
                 save_arm("oversub", {"platform": platform, "probe": probe})
                 arm_sources["oversub"] = "live"
     # core-percentage pacing proof — additive, same budget discipline

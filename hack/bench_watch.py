@@ -45,40 +45,53 @@ def note(state: str, **kw) -> None:
     print(f"[bench_watch] {rec['t']} {state} {kw}", flush=True)
 
 
-def _pid_alive(pid: int) -> bool:
-    try:
-        os.kill(pid, 0)
-    except (ProcessLookupError, PermissionError):
-        return False
-    try:  # only count it if it is actually a bench_watch, not a recycled pid
-        with open(f"/proc/{pid}/cmdline", "rb") as f:
-            return b"bench_watch" in f.read()
-    except OSError:
-        return True
+_LOCK_FD = None  # kept open for the process lifetime (flock holder)
 
 
 def acquire_lock(force: bool) -> bool:
+    """Single-instance guard via flock on the pidfile: the OS drops the
+    lock when the holder dies, so there is no stale-pid or pid-recycling
+    state to reason about, and two concurrent launches cannot both win
+    (the check and the claim are one atomic flock).  The deadline
+    re-exec is safe too: Python fds are CLOEXEC (PEP 446), so execv
+    releases the lock and the re-exec'd process simply re-acquires it —
+    a handoff to itself, never a self-kill."""
+    global _LOCK_FD
+    import fcntl
+
     os.makedirs(ART, exist_ok=True)
-    if os.path.exists(PIDFILE):
+    fd = os.open(PIDFILE, os.O_RDWR | os.O_CREAT, 0o644)
+    deadline = time.monotonic() + (15.0 if force else 0.0)
+    while True:
         try:
-            old = int(open(PIDFILE).read().strip() or 0)
-        except ValueError:
-            old = 0
-        # old == our own pid happens after the deadline re-exec (execv
-        # keeps the pid): killing it would be suicide, and the lock is
-        # already ours
-        if old and old != os.getpid() and _pid_alive(old):
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            break
+        except OSError:
+            try:
+                os.lseek(fd, 0, os.SEEK_SET)
+                old = int(os.read(fd, 32).decode().strip() or 0)
+            except ValueError:
+                old = 0
             if not force:
+                os.close(fd)
                 print(f"[bench_watch] live watcher pid={old} holds the "
                       "lock; exiting (use --force to replace)", flush=True)
                 return False
-            try:
-                os.kill(old, 15)
-                time.sleep(2)
-            except ProcessLookupError:
-                pass
-    with open(PIDFILE, "w") as f:
-        f.write(str(os.getpid()))
+            if time.monotonic() > deadline:
+                os.close(fd)
+                print(f"[bench_watch] pid={old} did not release the lock "
+                      "within 15s; exiting", flush=True)
+                return False
+            if old and old != os.getpid():
+                try:
+                    os.kill(old, 15)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            time.sleep(0.5)
+    os.ftruncate(fd, 0)
+    os.lseek(fd, 0, os.SEEK_SET)
+    os.write(fd, str(os.getpid()).encode())
+    _LOCK_FD = fd  # keep open: closing would release the flock
     return True
 
 

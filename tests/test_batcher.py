@@ -189,3 +189,93 @@ def test_instant_retirement_does_not_clobber_nested_admissions():
     eng.submit("r5", prompts[5], num_new=3)
     out = eng.run()
     assert out == want
+
+
+@pytest.mark.parametrize("k", [4, 8])
+def test_windowed_harvest_token_exact(k):
+    """harvest_every=k fuses k decode steps into one scan + one host
+    transfer; outputs must be token-identical to the per-step engine on
+    the same schedule — including EOS freezing and requests finishing
+    mid-window."""
+    model, params = make_model()
+    prompts = prompts_for(model, 4, [3, 5, 4, 6])
+    budgets = [7, 4, 6, 3]  # none a multiple of k: mid-window finishes
+
+    ref = ContinuousBatcher(model, params, max_batch=2)
+    win = ContinuousBatcher(model, params, max_batch=2, harvest_every=k)
+    for i, (p, n) in enumerate(zip(prompts, budgets)):
+        ref.submit(f"r{i}", p, num_new=n)
+        win.submit(f"r{i}", p, num_new=n)
+    assert win.run() == ref.run()
+
+
+def test_windowed_harvest_eos_freeze_exact():
+    """A row that hits EOS mid-window keeps emitting eos_id for the
+    rest of its budget, exactly like the per-step engine (the device
+    feedback chain differs, but every post-EOS token is host-forced)."""
+    model, params = make_model()
+    p = prompts_for(model, 1, [4])[0]
+    solo = np.asarray(
+        generate(model, params, jnp.asarray(p)[None], num_new=1)
+    )[0]
+    eos = int(solo[0])  # first greedy token → freezes immediately
+
+    ref = ContinuousBatcher(model, params, max_batch=2, eos_id=eos)
+    win = ContinuousBatcher(model, params, max_batch=2, eos_id=eos,
+                            harvest_every=8)
+    for eng in (ref, win):
+        eng.submit("x", p, num_new=6)
+        eng.submit("y", prompts_for(model, 1, [5], seed=3)[0], num_new=9)
+    assert win.run() == ref.run()
+    assert win.out["x"] == [eos] * 6
+
+
+def test_windowed_harvest_with_chunked_prefill_exact():
+    """Chunked prefill forces window=1 while admitting (latency
+    semantics preserved); once prefill drains, windows resume — tokens
+    identical throughout."""
+    model, params = make_model()
+    prompts = prompts_for(model, 3, [9, 3, 8])
+    budgets = [5, 8, 6]
+    ref = ContinuousBatcher(model, params, max_batch=2, prefill_chunk=4)
+    win = ContinuousBatcher(model, params, max_batch=2, prefill_chunk=4,
+                            harvest_every=4)
+    for i, (p, n) in enumerate(zip(prompts, budgets)):
+        ref.submit(f"r{i}", p, num_new=n)
+        win.submit(f"r{i}", p, num_new=n)
+    assert win.run() == ref.run()
+
+
+def test_windowed_harvest_fewer_syncs():
+    """The point of the window: far fewer device→host round trips for
+    the same tokens.  Count _step/_step_k invocations via the steps
+    counter — a k=8 engine must retire the same work in ~1/8 the
+    dispatches (each dispatch = one harvest transfer)."""
+    model, params = make_model()
+    p = prompts_for(model, 1, [4])[0]
+
+    ref = ContinuousBatcher(model, params, max_batch=1)
+    win = ContinuousBatcher(model, params, max_batch=1, harvest_every=8)
+    dispatches = []
+    for eng in (ref, win):
+        orig_1, orig_k = eng._step, eng._step_k
+        count = {"n": 0}
+        dispatches.append(count)
+
+        def step1(params, cache, tok, _orig=orig_1, _c=count):
+            _c["n"] += 1
+            return _orig(params, cache, tok)
+
+        def stepk(params, cache, tok, k, _orig=orig_k, _c=count):
+            _c["n"] += 1
+            return _orig(params, cache, tok, k)
+
+        eng._step, eng._step_k = step1, stepk
+    ref.submit("a", p, num_new=16)
+    win.submit("a", p, num_new=16)
+    assert ref.run() == win.run()
+    # ref: one dispatch+harvest per token (the first token comes from
+    # the prefill, so 15 decode steps); win: one per fused window —
+    # 15 tokens in power-of-two windows of ≤8 → at most 4 dispatches
+    assert dispatches[0]["n"] == 15
+    assert dispatches[1]["n"] <= 4, dispatches[1]

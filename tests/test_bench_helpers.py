@@ -70,6 +70,25 @@ def test_oversub_probe_keeps_partial_arms(monkeypatch):
     assert out["win_vs_manual"] == 4.0
     assert out["manual_resident_layers"] == 13
     assert "all_device_img_s" not in out
+    # a truncated probe (all_device missing) must not be cacheable
+    assert out["complete"] is False
+
+
+def test_oversub_probe_complete_when_all_arms_land(monkeypatch):
+    def fake_share(quota_mb, window_s, n_tenants=4, shim=True, extra_env=None):
+        env = extra_env or {}
+        if env.get("VTPU_OVERSUB_MANUAL") == "1":
+            return ([{"img_s": 25.0, "resident_layers": 13}], {})
+        if env.get("VTPU_OVERSUBSCRIBE") == "true":
+            return ([{"img_s": 100.0, "params_mb": 512, "swap_bytes": 7}], {})
+        if quota_mb == 0:
+            return ([{"img_s": 140.0}], {})
+        return ([{"hard_reject": True}], {})
+
+    monkeypatch.setattr(bench, "run_native_share", fake_share)
+    out = bench.run_oversubscribe_probe()
+    assert out["arms_ok"] == 4 and out["complete"] is True
+    assert out["all_device_img_s"] == 140.0
 
 
 def test_oversub_probe_none_when_everything_fails(monkeypatch):

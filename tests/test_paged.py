@@ -386,3 +386,51 @@ def test_instant_retirement_no_clobber_and_no_block_leak():
     assert outs["paged"] == outs["dense"]
     assert all(len(outs["paged"][f"r{i}"]) == nums[i] for i in range(6))
     assert eng.pool_stats()["leased"] == 0  # nothing leaked
+
+
+def test_paged_windowed_harvest_token_exact():
+    """harvest_every on the paged engine: fused windows over the block
+    pool must match the per-step paged engine — including overshoot
+    writes from finished rows (they fall off the leased table into the
+    garbage block, never into a peer's blocks)."""
+    kw = dict(KW, max_seq=64)
+    paged_m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=8,
+                            kv_pool_blocks=24)
+    params = params_for(TransformerLM(**kw))
+    rng = np.random.default_rng(11)
+    reqs = [(f"r{i}", rng.integers(0, 64, size=3 + 2 * i).astype(np.int32),
+             [7, 4, 6, 3][i]) for i in range(4)]
+
+    ref = PagedBatcher(paged_m, params, max_batch=2)
+    win = PagedBatcher(paged_m, params, max_batch=2, harvest_every=8)
+    for rid, p, n in reqs:
+        ref.submit(rid, p, num_new=n)
+        win.submit(rid, p, num_new=n)
+    assert win.run() == ref.run()
+    # no lease leaks from window-boundary retirement
+    assert win.pool_stats()["leased"] == 0
+
+
+def test_paged_windowed_with_prefix_cache_exact():
+    """Windows + shared prefix blocks: a finished row's overshoot
+    writes must never corrupt the registered prefix other rows read."""
+    kw = dict(KW, max_seq=64)
+    paged_m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=8,
+                            kv_pool_blocks=20)
+    params = params_for(TransformerLM(**kw))
+    rng = np.random.default_rng(9)
+    system = rng.integers(0, 64, size=16).astype(np.int32)
+    reqs = [(f"r{i}",
+             np.concatenate([system,
+                             rng.integers(0, 64, size=3 + i).astype(np.int32)]),
+             [3, 9, 6][i]) for i in range(3)]
+
+    ref = PagedBatcher(paged_m, params, max_batch=4, prefix_cache=4)
+    win = PagedBatcher(paged_m, params, max_batch=4, prefix_cache=4,
+                       harvest_every=8)
+    for rid, p, n in reqs:
+        ref.submit(rid, p, num_new=n)
+        win.submit(rid, p, num_new=n)
+    assert win.run() == ref.run()
+    st = win.pool_stats()
+    assert st["leased"] == 2 and st["registered_prefixes"] >= 1, st

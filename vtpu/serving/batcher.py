@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import functools
 from typing import Dict, List, Optional
 
 import jax
@@ -56,7 +57,8 @@ class ContinuousBatcher:
     """Slot-based continuous batching over the shared KV cache."""
 
     def __init__(self, model: TransformerLM, params, max_batch: int,
-                 eos_id: Optional[int] = None, prefill_chunk: int = 0):
+                 eos_id: Optional[int] = None, prefill_chunk: int = 0,
+                 harvest_every: int = 1):
         if (model.kv_cache_layout == "paged"
                 and type(self) is ContinuousBatcher):
             # the dense engine's row scatter treats cache axis 0 as the
@@ -86,6 +88,15 @@ class ContinuousBatcher:
         self.rid: List[Optional[str]] = [None] * max_batch
         self.out: Dict[str, List[int]] = {}
         self.queue: collections.deque[_Request] = collections.deque()
+        # > 1: run k decode steps as ONE compiled lax.scan and harvest
+        # the [k, max_batch] token matrix in a single device→host
+        # transfer.  Per-step harvest (k=1) costs one host sync per
+        # generated token — behind a relayed transport that sync is the
+        # dominant decode cost.  Token-exact vs k=1 (rows are
+        # independent; post-EOS tokens are host-forced to eos_id either
+        # way) — only retirement/admission granularity coarsens to the
+        # window boundary.
+        self.harvest_every = max(1, int(harvest_every))
         self.steps = 0  # decode forwards executed (batch-wide)
         self._row_tmpl = None  # lazy; see _row_template()
 
@@ -102,6 +113,32 @@ class ContinuousBatcher:
             return nxt, mut["cache"]
 
         self._step = _step
+
+        @functools.partial(jax.jit, static_argnums=(3,))
+        def _step_k(params, cache, tok, k):
+            """k fused decode steps (lax.scan): same per-step math as
+            _step, one dispatch, one [k, b] token harvest.  Finished
+            rows overshoot harmlessly: dense writes clamp into the dead
+            row, paged writes fall off the leased table into the
+            garbage block (shared prefix blocks sit at the FRONT of a
+            table row, so overshoot never reaches them)."""
+            p = dequantize_tree(params)
+
+            def body(carry, _):
+                tok, cache = carry
+                logits, mut = model.apply(
+                    {"params": p, "cache": cache},
+                    tok[:, None], decode=True, mutable=["cache"],
+                )
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (nxt, mut["cache"]), nxt
+
+            (tok, cache), toks = jax.lax.scan(
+                body, (tok, cache), None, length=k
+            )
+            return tok, cache, toks
+
+        self._step_k = _step_k
 
         @jax.jit  # caches one program per distinct prompt length
         def _prefill(params, cache, prompt):
@@ -255,45 +292,78 @@ class ContinuousBatcher:
             self._admit_pending()
 
     # ------------------------------------------------------------------
-    def step(self) -> None:
-        """One prefill chunk (if a slot is admitting) + one decode
-        forward for EVERY active slot; harvest active rows."""
-        self._advance_prefill()
-        if not any(self.active):
-            return
-        self.tok, self.cache = self._step(self.params, self.cache, self.tok)
-        self.steps += 1
-        toks = np.array(self.tok)  # writable copy (asarray is read-only)
-        # pass 1: harvest + eos handling, batched into ONE device write
-        # (per-row .at[i].set would pay a dispatch per frozen row)
-        eos_fix = False
+    def _window(self) -> int:
+        """Decode steps to fuse this round.  1 while a chunked prefill
+        is in flight (preserves prefill/decode interleaving latency);
+        otherwise min(harvest_every, longest remaining budget), rounded
+        DOWN to a power of two so the number of compiled window
+        programs is bounded at log2(harvest_every)+1."""
+        if self.harvest_every <= 1 or self.prefilling:
+            return 1
+        rem = max(
+            (self.remaining[i] for i in range(self.max_batch)
+             if self.active[i]),
+            default=0,
+        )
+        k = min(self.harvest_every, max(1, rem))
+        return 1 << (k.bit_length() - 1)
+
+    def _harvest_window(self, toks_np) -> None:
+        """Append a [k, b] window of harvested tokens to each active
+        request, applying the same EOS-freeze and budget accounting the
+        per-step path does.  A row that finishes mid-window simply has
+        its overshoot tokens dropped (truncation to num_new), and no
+        EOS write-back to the device is needed: every post-EOS token is
+        forced to eos_id right here, so the device-side feedback chain
+        is unobservable."""
+        k = toks_np.shape[0]
         finished = []
         for i in range(self.max_batch):
             if not self.active[i]:
                 continue
-            t = int(toks[i])
-            if self.done_frozen[i]:
-                # eos reached earlier: the row freezes (same static-shape
-                # semantics as generate()'s eos_id contract)
-                t = self.eos_id
-                toks[i] = t
-                eos_fix = True
-            elif self.eos_id is not None and t == self.eos_id:
-                self.done_frozen[i] = True
-            self.out[self.rid[i]].append(t)
-            self.remaining[i] -= 1
+            rid = self.rid[i]
+            for j in range(k):
+                if self.remaining[i] <= 0:
+                    break
+                t = int(toks_np[j, i])
+                if self.done_frozen[i]:
+                    t = self.eos_id
+                elif self.eos_id is not None and t == self.eos_id:
+                    self.done_frozen[i] = True
+                self.out[rid].append(t)
+                self.remaining[i] -= 1
             if self.remaining[i] <= 0:
                 finished.append(i)
-        if eos_fix:
-            self.tok = jnp.asarray(toks)
-        # pass 2: retire AFTER the tok fix-up — an admission scatters the
-        # new request's first token into self.tok, which a later
-        # wholesale write would clobber
         for i in finished:
             self.active[i] = False
             self.rid[i] = None
             self._on_retire(i)
         self._admit_pending()
+
+    def step(self) -> None:
+        """One prefill chunk (if a slot is admitting) + one decode
+        forward (or a fused ``harvest_every`` window of them) for EVERY
+        active slot; harvest active rows."""
+        self._advance_prefill()
+        if not any(self.active):
+            return
+        k = self._window()
+        if k > 1:
+            self.tok, self.cache, toks = self._step_k(
+                self.params, self.cache, self.tok, k
+            )
+            self.steps += k
+            self._harvest_window(np.asarray(toks))
+            return
+        # k == 1 is just a [1, b] window: one copy of the EOS-freeze/
+        # budget/retire rules lives in _harvest_window.  (The old
+        # per-step path also wrote eos_id back into self.tok for frozen
+        # rows; that device write is unobservable — every post-EOS
+        # OUTPUT token is host-forced — so it is dropped, saving one
+        # host→device transfer per frozen-row step.)
+        self.tok, self.cache = self._step(self.params, self.cache, self.tok)
+        self.steps += 1
+        self._harvest_window(np.asarray(self.tok)[None, :])
 
     def run(self) -> Dict[str, List[int]]:
         """Drive until every submitted request has finished."""

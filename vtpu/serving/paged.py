@@ -55,14 +55,15 @@ class PagedBatcher(ContinuousBatcher):
 
     def __init__(self, model: TransformerLM, params, max_batch: int,
                  eos_id=None, prefill_chunk: int = 0,
-                 prefix_cache: int = 0):
+                 prefix_cache: int = 0, harvest_every: int = 1):
         if model.kv_cache_layout != "paged" or model.kv_pool_blocks <= 1:
             raise ValueError(
                 "PagedBatcher needs kv_cache_layout='paged' and a real "
                 "pool (kv_pool_blocks > 1)"
             )
         super().__init__(model, params, max_batch, eos_id=eos_id,
-                         prefill_chunk=prefill_chunk)
+                         prefill_chunk=prefill_chunk,
+                         harvest_every=harvest_every)
         self.block_size = model.kv_block_size
         self.nb_max = model.max_seq // model.kv_block_size
         # block 0 is the garbage block for inactive rows — never leased
