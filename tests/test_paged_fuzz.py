@@ -1,0 +1,205 @@
+"""Adversarial paged-pool accounting: randomized interleavings of
+submit / step / retire / prefix-hit / eviction over a deliberately tiny
+block pool, with the full refcount-conservation invariant re-checked
+after EVERY engine step.
+
+The 363bce6 bug class (nested admission clobbering a just-leased slot,
+eviction freeing blocks still referenced) produced states where a block
+was simultaneously free and referenced, or a refcount disagreed with
+the set of actual holders.  These tests assert, at every quiescent
+point, that such states are impossible:
+
+  * partition    — every leasable block is in exactly one of
+                   ``free`` / ``_block_refs``;
+  * holder count — ``_block_refs[b]`` equals the number of slots plus
+                   registry entries that actually hold ``b``;
+  * table truth  — an active slot's on-device table row names exactly
+                   its leased blocks;
+  * no leak      — once drained and the registry emptied, every
+                   leasable block is free again;
+  * exactness    — the fuzzed schedule still produces token-identical
+                   output to the dense engine.
+
+Analog of the reference's allocator stress surface (scheduler_test.go's
+random pod churn); there is no upstream counterpart for the block pool
+itself because the reference has no paged KV allocator.
+"""
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # JAX workload lane (CPU-mesh compiles)
+
+from vtpu.models.transformer import TransformerLM
+from vtpu.serving import ContinuousBatcher
+from vtpu.serving.paged import PagedBatcher
+
+KW = dict(vocab=64, d_model=32, depth=2, num_heads=4, max_seq=32)
+BLOCK = 8
+
+
+def params_for(model):
+    return model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+
+
+def check_pool_invariants(eng: PagedBatcher) -> None:
+    """The full accounting contract, checked between steps."""
+    leasable = set(range(1, eng.model.kv_pool_blocks))
+    free = list(eng.free)
+    # free list holds no duplicates and only leasable ids
+    assert len(free) == len(set(free)), f"dup in free list: {free}"
+    assert set(free) <= leasable
+    leased = set(eng._block_refs)
+    # partition: a block is free XOR leased, and nothing is lost
+    assert set(free) | leased == leasable, (
+        f"lost blocks: {leasable - set(free) - leased}"
+    )
+    assert not (set(free) & leased), (
+        f"free AND leased: {set(free) & leased}"
+    )
+    assert all(c >= 1 for c in eng._block_refs.values())
+    # refcounts equal the actual holder census (slots + registry)
+    census: collections.Counter = collections.Counter()
+    for blocks in eng._slot_blocks.values():
+        census.update(blocks)
+    for blocks in eng._prefixes.values():
+        census.update(blocks)
+    assert dict(census) == eng._block_refs, (
+        f"refcount drift: counted {dict(census)} "
+        f"vs recorded {eng._block_refs}"
+    )
+    # slot leases only for occupied slots
+    for slot in eng._slot_blocks:
+        assert eng.active[slot] or slot in eng.prefilling, (
+            f"slot {slot} holds blocks but is neither active nor "
+            "prefilling"
+        )
+    # an active decoding slot's device table row is exactly its lease
+    table = np.asarray(eng.cache["block_table"])
+    for slot, blocks in eng._slot_blocks.items():
+        if slot in eng.prefilling:
+            continue  # row publishes at activation
+        row = table[slot]
+        np.testing.assert_array_equal(
+            row[:len(blocks)], np.asarray(blocks, np.int32)
+        )
+        assert not row[len(blocks):].any(), (
+            f"slot {slot} row points past its lease: {row}"
+        )
+
+
+def fuzz_schedule(seed: int, n_reqs: int):
+    """Requests drawn from two shared-prefix families plus fresh
+    prompts, with few distinct lengths (bounds compile count)."""
+    rng = np.random.default_rng(seed)
+    fam = {
+        "A": rng.integers(0, 64, size=BLOCK).astype(np.int32),
+        "B": rng.integers(0, 64, size=BLOCK).astype(np.int32),
+    }
+    reqs = []
+    for i in range(n_reqs):
+        kind = rng.choice(["A", "B", "fresh"])
+        tail_len = int(rng.choice([1, 4]))
+        tail = rng.integers(0, 64, size=tail_len).astype(np.int32)
+        if kind == "fresh":
+            prompt = rng.integers(
+                0, 64, size=BLOCK + tail_len
+            ).astype(np.int32)
+        else:
+            prompt = np.concatenate([fam[kind], tail])
+        num_new = int(rng.choice([4, 7]))
+        reqs.append((f"r{i}", prompt, num_new))
+    return reqs
+
+
+def drive_fuzzed(eng: PagedBatcher, reqs, seed: int):
+    """Interleave submissions and steps randomly; check invariants
+    after every operation."""
+    rng = np.random.default_rng(seed + 1000)
+    pending = list(reqs)
+    while pending or eng.queue or eng.prefilling or any(eng.active):
+        ops = []
+        if pending:
+            ops.append("submit")
+        if eng.queue or eng.prefilling or any(eng.active):
+            ops.append("step")
+        op = rng.choice(ops)
+        if op == "submit":
+            # bursty: 1-3 submissions at once stresses admission order
+            for _ in range(int(rng.integers(1, 4))):
+                if not pending:
+                    break
+                rid, p, n = pending.pop(0)
+                eng.submit(rid, p, num_new=n)
+        else:
+            eng.step()
+        check_pool_invariants(eng)
+    return dict(eng.out)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize(
+    "cfg",
+    [
+        dict(prefix_cache=2, prefill_chunk=0, harvest_every=1),
+        dict(prefix_cache=2, prefill_chunk=4, harvest_every=4),
+    ],
+    ids=["plain", "chunked_windowed"],
+)
+def test_fuzzed_interleavings_conserve_blocks(seed, cfg):
+    dense_m = TransformerLM(**KW)
+    # 7 leasable blocks, 3 slots, requests need 2-3 blocks each → the
+    # pool is the contended resource (registry + 3 slots can exceed it)
+    paged_m = TransformerLM(**KW, kv_cache_layout="paged",
+                            kv_block_size=BLOCK, kv_pool_blocks=8)
+    params = params_for(dense_m)
+    reqs = fuzz_schedule(seed, n_reqs=10)
+
+    eng = PagedBatcher(paged_m, params, max_batch=3, **cfg)
+    got = drive_fuzzed(eng, reqs, seed)
+
+    # quiescence: only the registry may still pin blocks; empty it and
+    # every leasable block must come home
+    while eng._evict_prefix(keep=[]):
+        check_pool_invariants(eng)
+    assert not eng._block_refs, f"leaked refs: {eng._block_refs}"
+    assert set(eng.free) == set(range(1, paged_m.kv_pool_blocks))
+
+    # the fuzzed schedule is still token-exact vs the dense engine
+    # (same submission order — the dense engine has no pool, so any
+    # divergence is a paging bug, not batching nondeterminism)
+    dense = ContinuousBatcher(
+        dense_m, params, max_batch=3,
+        prefill_chunk=cfg["prefill_chunk"],
+        harvest_every=cfg["harvest_every"],
+    )
+    for rid, p, n in reqs:
+        dense.submit(rid, p, num_new=n)
+    assert got == dense.run()
+
+
+def test_refcount_drift_is_caught():
+    """The invariant checker itself must fail on a 363bce6-style state
+    (a block freed while a registry entry still names it) — guards
+    against the checker silently weakening."""
+    paged_m = TransformerLM(**KW, kv_cache_layout="paged",
+                            kv_block_size=BLOCK, kv_pool_blocks=8)
+    params = params_for(paged_m)
+    eng = PagedBatcher(paged_m, params, max_batch=2, prefix_cache=2)
+    eng.submit("r0", np.arange(BLOCK + 1, dtype=np.int32) % 64, 4)
+    out = eng.run()
+    assert list(out) == ["r0"]
+    check_pool_invariants(eng)
+    # simulate the bug: registry keeps naming a block whose ref is gone
+    assert eng._prefixes, "prefix should have been registered"
+    key = next(iter(eng._prefixes))
+    blocks = eng._prefixes[key]
+    eng._unref(blocks)  # now free AND named by the registry
+    with pytest.raises(AssertionError):
+        check_pool_invariants(eng)
