@@ -74,6 +74,17 @@ def check_pool_invariants(eng: PagedBatcher) -> None:
         f"refcount drift: counted {dict(census)} "
         f"vs recorded {eng._block_refs}"
     )
+    # prefix trie index names exactly the registry's keys
+    indexed = set()
+    stack = [eng._trie]
+    while stack:
+        node = stack.pop()
+        if node[0] is not None:
+            indexed.add(node[0])
+        stack.extend(node[1].values())
+    assert indexed == set(eng._prefixes), (
+        f"trie/registry drift: {indexed ^ set(eng._prefixes)}"
+    )
     # slot leases only for occupied slots
     for slot in eng._slot_blocks:
         assert eng.active[slot] or slot in eng.prefilling, (

@@ -41,6 +41,12 @@ def test_quantize_tree_selects_big_matrices_and_shrinks():
     assert qleaves, "no leaf was quantized"
     # norm scales/biases stay fp
     assert not is_quantized(qparams["ln_f"]["scale"])
+    # embedding tables stay fp even above the size bar: [vocab, d_model]
+    # lookups would get one scale per column across the whole vocab —
+    # useless granularity for per-row reads (ADVICE r4)
+    assert qparams["wte"]["embedding"].size >= 16384
+    assert not is_quantized(qparams["wte"]["embedding"])
+    assert not is_quantized(qparams["wpe"]["embedding"])
     # at-rest bytes shrink by ~4x on the quantized fraction
     assert tree_bytes(qparams) < 0.45 * tree_bytes(params)
     # dequantize_tree restores a same-structure fp tree

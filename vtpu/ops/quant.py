@@ -72,11 +72,22 @@ def dequantize(qt: QuantizedTensor, dtype=jnp.bfloat16):
     return (qt.q.astype(jnp.float32) * qt.scale).astype(dtype)
 
 
-def quantize_tree(params, min_elems: int = 16384, dtype_out=jnp.bfloat16):
+def quantize_tree(params, min_elems: int = 16384):
     """Quantize every float matrix leaf with >= ``min_elems`` elements
-    (the big projection kernels); small leaves (norms, biases,
-    embeddings under the bar) stay in their original dtype."""
-    def maybe(leaf):
+    (the big projection kernels); small leaves (norms, biases) and
+    embedding tables stay in their original dtype.
+
+    Embedding tables ([vocab, d_model] lookups, not matmul operands)
+    are excluded by path: axis=ndim-2 scales would put one scale per
+    feature column ACROSS the whole vocab — the coarsest possible
+    granularity for a per-row lookup — and a realistic wte clears any
+    size bar."""
+    def maybe(path, leaf):
+        names = "/".join(
+            str(getattr(k, "key", k)) for k in path
+        ).lower()
+        if "embed" in names or "wte" in names or "wpe" in names:
+            return leaf
         if (
             hasattr(leaf, "ndim") and leaf.ndim >= 2
             and leaf.size >= min_elems
@@ -87,7 +98,7 @@ def quantize_tree(params, min_elems: int = 16384, dtype_out=jnp.bfloat16):
             return quantize_int8(leaf, axis=leaf.ndim - 2)
         return leaf
 
-    return jax.tree.map(maybe, params)
+    return jax.tree_util.tree_map_with_path(maybe, params)
 
 
 def dequantize_tree(params, dtype=jnp.bfloat16):

@@ -78,6 +78,12 @@ class PagedBatcher(ContinuousBatcher):
         self._prefixes: "collections.OrderedDict[tuple, List[int]]" = (
             collections.OrderedDict()
         )
+        # secondary index: trie over block-sized token chunks, so
+        # matching costs O(prompt_len / block_size) dict walks instead
+        # of comparing every registry entry against the prompt (ADVICE
+        # r4 — the linear scan re-ran on every admission retry).  Node:
+        # [terminal key or None, {chunk-tuple: child node}].
+        self._trie: list = [None, {}]
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _pf_pool(params, pools, pos, table_row, tokens):
@@ -160,23 +166,52 @@ class PagedBatcher(ContinuousBatcher):
                 return
             self._admit(slot, self.queue.popleft(), shared, shared_tok)
 
+    def _chunks(self, key: tuple):
+        bs = self.block_size
+        return [key[i:i + bs] for i in range(0, len(key), bs)]
+
+    def _index_add(self, key: tuple) -> None:
+        node = self._trie
+        for ch in self._chunks(key):
+            node = node[1].setdefault(ch, [None, {}])
+        node[0] = key
+
+    def _index_remove(self, key: tuple) -> None:
+        chunks = self._chunks(key)
+        path = [self._trie]
+        for ch in chunks:
+            path.append(path[-1][1][ch])
+        path[-1][0] = None
+        # prune now-empty nodes so dead chunks don't accumulate
+        for i in range(len(path) - 1, 0, -1):
+            node = path[i]
+            if node[0] is None and not node[1]:
+                del path[i - 1][1][chunks[i - 1]]
+
     def _match_prefix(self, prompt: np.ndarray) -> Tuple[List[int], int]:
         """Longest registered block-aligned prefix of ``prompt``,
         leaving at least one suffix token to prefill (the admission
         needs last-token logits).  Returns (shared block ids, shared
-        token count)."""
+        token count).  One trie descent: O(prompt_len / block_size)
+        dict lookups, independent of registry size."""
         if not self.prefix_cache:
             return [], 0
-        best: List[int] = []
-        best_len = 0
-        for key, blocks in self._prefixes.items():
-            klen = len(key)
-            if (
-                klen > best_len and klen < prompt.size
-                and np.array_equal(prompt[:klen], np.asarray(key))
-            ):
-                best, best_len = blocks, klen
-        return list(best), best_len
+        bs = self.block_size
+        max_tok = prompt.size - 1  # must leave >= 1 suffix token
+        node = self._trie
+        best_key = None
+        depth_tok = 0
+        while depth_tok + bs <= max_tok:
+            ch = tuple(int(t) for t in prompt[depth_tok:depth_tok + bs])
+            node = node[1].get(ch)
+            if node is None:
+                break
+            depth_tok += bs
+            if node[0] is not None:
+                best_key = node[0]
+        if best_key is None:
+            return [], 0
+        return list(self._prefixes[best_key]), len(best_key)
 
     def _evict_prefix(self, keep: List[int]) -> bool:
         """Evict the oldest registry entry whose blocks are not
@@ -188,6 +223,7 @@ class PagedBatcher(ContinuousBatcher):
                 self._block_refs.get(b, 0) == 1 for b in blocks
             ):
                 del self._prefixes[key]
+                self._index_remove(key)
                 self._unref(blocks)
                 return True
         return False
@@ -203,8 +239,10 @@ class PagedBatcher(ContinuousBatcher):
         blocks = table_blocks[:aligned // self.block_size]
         self._ref(blocks)
         self._prefixes[key] = blocks
+        self._index_add(key)
         while len(self._prefixes) > self.prefix_cache:
-            _old_key, old_blocks = self._prefixes.popitem(last=False)
+            old_key, old_blocks = self._prefixes.popitem(last=False)
+            self._index_remove(old_key)
             self._unref(old_blocks)
 
     def _admit(self, slot: int, req: _Request,
