@@ -1,0 +1,391 @@
+"""Render charts/vtpu the way `helm template` would, with a deliberately
+SMALL Go-template subset — exactly the constructs the chart uses, and a
+hard error on anything else.
+
+Why this exists: the CI image has no helm binary, but VERDICT r4 asked
+for a rendered-manifest golden so the knob-typo class (a value that
+silently renders to nothing) is caught in the fast lane.  This renderer
+produces `charts/vtpu/rendered_default.golden.yaml`; where a real helm
+exists (the chart CI job), `helm template` output is compared against
+the same golden, which keeps this subset honest — if the two renderers
+ever disagree, the authoritative one wins and the golden is regenerated
+from it.
+
+Supported: {{ }} / {{- -}} trimming, comments, .Values/.Release/.Chart/
+.Capabilities paths, `.` rebinding via with/range, if/else if/else,
+define/include, and the pipe functions the chart uses (quote, toJson,
+toYaml, nindent, indent, default, trunc, trimSuffix, printf, and/Has).
+Anything unrecognized raises — silent mis-rendering would make the
+golden worse than no golden.
+
+Usage: python hack/render_chart.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHART = os.path.join(REPO, "charts", "vtpu")
+
+TAG = re.compile(r"\{\{.*?\}\}", re.S)
+
+
+class Node:
+    """AST node: kind in {text, expr, if, range, with, define}."""
+
+    def __init__(self, kind, **kw):
+        self.kind = kind
+        self.__dict__.update(kw)
+
+
+def lex(src: str):
+    """(is_tag, payload) stream with helm whitespace-control applied."""
+    parts = []
+    pos = 0
+    for m in TAG.finditer(src):
+        parts.append([False, src[pos:m.start()]])
+        parts.append([True, m.group(0)])
+        pos = m.end()
+    parts.append([False, src[pos:]])
+    out = []
+    for i, (is_tag, text) in enumerate(parts):
+        if not is_tag:
+            out.append([False, text])
+            continue
+        body = text[2:-2]
+        if body.startswith("-"):
+            body = body[1:]
+            if out and not out[-1][0]:
+                out[-1][1] = out[-1][1].rstrip()
+        trim_next = body.endswith("-")
+        if trim_next:
+            body = body[:-1]
+        out.append([True, body.strip(), trim_next])
+    # apply right-trims to following text parts
+    res = []
+    trim = False
+    for part in out:
+        if not part[0]:
+            res.append(("text", part[1].lstrip() if trim else part[1]))
+            trim = False
+        else:
+            res.append(("tag", part[1]))
+            trim = part[2]
+    return res
+
+
+def parse(tokens, i=0, stop=None):
+    """Parse token list into node list; returns (nodes, next_index,
+    stop_tag) where stop_tag is the 'else'/'end' that ended us."""
+    nodes = []
+    while i < len(tokens):
+        kind, payload = tokens[i][0], tokens[i][1]
+        if kind == "text":
+            nodes.append(Node("text", text=payload))
+            i += 1
+            continue
+        tag = payload
+        if tag.startswith("/*"):
+            i += 1
+            continue
+        word = tag.split(None, 1)[0] if tag else ""
+        if stop and (word == "end" or word == "else"):
+            return nodes, i, tag
+        if word == "if":
+            body, i, ended = parse(tokens, i + 1, stop=True)
+            arms = [(tag[3:].strip(), body)]
+            while ended.startswith("else"):
+                cond = ended[4:].strip()
+                cond = cond[3:].strip() if cond.startswith("if") else None
+                body, i, ended = parse(tokens, i + 1, stop=True)
+                arms.append((cond, body))
+                if cond is None:
+                    break
+            if not ended.startswith("end"):
+                _, i, ended = parse(tokens, i + 1, stop=True)
+            nodes.append(Node("if", arms=arms))
+            i += 1
+            continue
+        if word in ("range", "with"):
+            expr = tag[len(word):].strip()
+            body, i, ended = parse(tokens, i + 1, stop=True)
+            alt = []
+            if ended == "else":
+                alt, i, ended = parse(tokens, i + 1, stop=True)
+            assert ended.startswith("end"), f"unclosed {word}"
+            nodes.append(Node(word, expr=expr, body=body, alt=alt))
+            i += 1
+            continue
+        if word == "define":
+            name = tag.split(None, 1)[1].strip().strip('"')
+            body, i, ended = parse(tokens, i + 1, stop=True)
+            assert ended.startswith("end"), "unclosed define"
+            nodes.append(Node("define", name=name, body=body))
+            i += 1
+            continue
+        nodes.append(Node("expr", expr=tag))
+        i += 1
+    return nodes, i, None
+
+
+SPLIT_ARGS = re.compile(r'"(?:[^"\\]|\\.)*"|\(|\)|[^\s()]+')
+
+
+def tokenize_expr(e: str):
+    return SPLIT_ARGS.findall(e)
+
+
+class Renderer:
+    def __init__(self, values, release, capabilities, defines=None):
+        self.root = {
+            "Values": values,
+            "Release": release,
+            "Chart": {"Name": "vtpu", "Version": "dev"},
+            "Capabilities": capabilities,
+        }
+        self.defines = defines if defines is not None else {}
+
+    # -- expression evaluation -----------------------------------------
+    def path(self, dotted: str, ctx):
+        if dotted == ".":
+            return ctx
+        node = self.root if dotted.startswith(".") else ctx
+        for part in dotted.strip(".").split("."):
+            if part == "":
+                continue
+            if isinstance(node, dict) and part in node:
+                node = node[part]
+            elif hasattr(node, part):
+                node = getattr(node, part)
+            else:
+                raise KeyError(f"unresolved path {dotted!r} at {part!r}")
+        return node
+
+    def atom(self, tok: str, ctx):
+        if tok.startswith('"'):
+            return json.loads(tok)
+        if re.fullmatch(r"-?\d+", tok):
+            return int(tok)
+        if tok in ("true", "false"):
+            return tok == "true"
+        if tok.startswith("."):
+            return self.path(tok, ctx)
+        raise ValueError(f"unknown atom {tok!r}")
+
+    def call(self, fn: str, args: list, ctx):
+        if fn == "include":
+            name, dot = args
+            return self.render_nodes(self.defines[name], dot)
+        if fn == "printf":
+            fmt, rest = args[0], args[1:]
+            return fmt % tuple(rest)
+        if fn == "and":
+            val = True
+            for a in args:
+                val = a
+                if not a:
+                    return a
+            return val
+        if fn == "or":
+            for a in args:
+                if a:
+                    return a
+            return args[-1] if args else False
+        if fn == "not":
+            return not args[0]
+        if fn == "quote":
+            return '"%s"' % str(args[0]).replace('"', '\\"')
+        if fn == "toJson":
+            return json.dumps(args[0])
+        if fn == "toYaml":
+            return yaml.safe_dump(args[0], default_flow_style=False,
+                                  sort_keys=False).rstrip("\n")
+        if fn == "nindent":
+            n, s = args
+            pad = " " * n
+            return "\n" + "\n".join(
+                pad + ln if ln else ln for ln in str(s).splitlines())
+        if fn == "indent":
+            n, s = args
+            pad = " " * n
+            return "\n".join(
+                pad + ln if ln else ln for ln in str(s).splitlines())
+        if fn == "default":
+            dflt, val = args
+            return val if val not in ("", None, [], {}, 0, False) else dflt
+        if fn == "trunc":
+            n, s = args
+            return str(s)[:n]
+        if fn == "trimSuffix":
+            suf, s = args
+            return str(s)[:-len(suf)] if str(s).endswith(suf) else str(s)
+        raise ValueError(f"unsupported function {fn!r}")
+
+    def eval_segment(self, toks: list, ctx, piped=None):
+        """One pipe segment: an atom, a dotted method call
+        (.Capabilities.APIVersions.Has "x"), or fn arg arg...
+        Tokens may be pre-resolved values (from parenthesized
+        sub-expressions); a trailing None is the piped value."""
+        if piped is not None:
+            toks = toks + [None]  # sentinel: piped value is last arg
+        head = toks[0]
+        rest = toks[1:]
+
+        def val(t):
+            if t is None:
+                return piped
+            return self.atom(t, ctx) if isinstance(t, str) else t
+
+        if not isinstance(head, str):
+            assert not rest, "value cannot be called"
+            return head
+        if head.startswith(".") or head.startswith('"') or re.fullmatch(
+            r"-?\d+", head
+        ):
+            if rest:
+                # dotted method call: .X.Y.Has "arg"
+                if head.startswith(".") and head.endswith(".Has"):
+                    obj = self.path(head[: -len(".Has")], ctx)
+                    return obj.Has(val(rest[0]))
+                raise ValueError(f"unexpected args after {head!r}")
+            return val(head)
+        return self.call(head, [val(t) for t in rest], ctx)
+
+    def eval_expr(self, expr: str, ctx):
+        segments = [s.strip() for s in expr.split("|")]
+        value = None
+        for i, seg in enumerate(segments):
+            toks = tokenize_expr(seg)
+            # parenthesized sub-expressions: evaluate innermost-first
+            while "(" in toks:
+                close = toks.index(")")
+                open_ = max(j for j in range(close) if toks[j] == "(")
+                sub = self.eval_segment(toks[open_ + 1:close], ctx)
+                toks[open_:close + 1] = [sub]
+            value = self.eval_segment(toks, ctx, piped=value if i else None)
+        return value
+
+    # -- node rendering -------------------------------------------------
+    def render_nodes(self, nodes, ctx) -> str:
+        out = []
+        for n in nodes:
+            if n.kind == "text":
+                out.append(n.text)
+            elif n.kind == "define":
+                self.defines[n.name] = n.body
+            elif n.kind == "expr":
+                v = self.eval_expr(n.expr, ctx)
+                out.append("" if v is None else str(v))
+            elif n.kind == "if":
+                for cond, body in n.arms:
+                    if cond is None or self.eval_expr(cond, ctx):
+                        out.append(self.render_nodes(body, ctx))
+                        break
+            elif n.kind == "with":
+                v = self.eval_expr(n.expr, ctx)
+                if v:
+                    out.append(self.render_nodes(n.body, v))
+                elif n.alt:
+                    out.append(self.render_nodes(n.alt, ctx))
+            elif n.kind == "range":
+                v = self.eval_expr(n.expr, ctx)
+                if v:
+                    # helm ranges a map over its VALUES in key order
+                    items = (
+                        [v[k] for k in sorted(v)] if isinstance(v, dict)
+                        else v
+                    )
+                    for item in items:
+                        out.append(self.render_nodes(n.body, item))
+                elif n.alt:
+                    out.append(self.render_nodes(n.alt, ctx))
+        return "".join(out)
+
+
+class _APIVersions:
+    def __init__(self, versions):
+        self._v = set(versions)
+
+    def Has(self, v):  # noqa: N802 — helm calls it .Has
+        return v in self._v
+
+
+def render_chart(values=None, release_name="release-name",
+                 namespace="default", api_versions=()):
+    with open(os.path.join(CHART, "values.yaml")) as f:
+        vals = yaml.safe_load(f)
+
+    def deep_merge(base, over):  # helm merges --set/values deeply
+        for k, v in over.items():
+            if isinstance(v, dict) and isinstance(base.get(k), dict):
+                deep_merge(base[k], v)
+            else:
+                base[k] = v
+
+    if values:
+        deep_merge(vals, values)
+    caps = {
+        "KubeVersion": {"Version": "v1.29.0"},
+        "APIVersions": _APIVersions(api_versions),
+    }
+    release = {"Name": release_name, "Namespace": namespace,
+               "Service": "Helm"}
+    r = Renderer(vals, release, caps)
+    # pass 1: helpers (defines) — helm loads _*.tpl first
+    tpl_files, yaml_files = [], []
+    for root, _dirs, files in os.walk(os.path.join(CHART, "templates")):
+        for f in sorted(files):
+            p = os.path.join(root, f)
+            rel = os.path.relpath(p, CHART)
+            if f.endswith(".tpl"):
+                tpl_files.append((rel, p))
+            elif f.endswith(".yaml"):
+                yaml_files.append((rel, p))
+    for _rel, p in tpl_files:
+        nodes, _, _ = parse(lex(open(p).read()))
+        r.render_nodes(nodes, r.root)  # registers defines
+    sections = []
+    for rel, p in yaml_files:
+        nodes, _, _ = parse(lex(open(p).read()))
+        text = r.render_nodes(nodes, r.root).strip("\n")
+        if not text.strip():
+            continue  # feature-gated template, disabled by values
+        for doc in re.split(r"^---\s*$", text, flags=re.M):
+            body = "\n".join(
+                ln for ln in doc.splitlines()
+                if ln.strip() and not ln.lstrip().startswith("#")
+            )
+            if not body.strip():
+                continue  # comment-only doc: helm drops these too
+            sections.append(f"---\n# Source: vtpu/{rel}\n{doc.strip()}\n")
+    return "".join(sections)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        CHART, "rendered_default.golden.yaml"))
+    ap.add_argument("--stdout", action="store_true")
+    args = ap.parse_args(argv)
+    out = render_chart()
+    # every rendered doc must be valid YAML — catches indentation rot
+    for doc in yaml.safe_load_all(out):
+        assert doc is None or isinstance(doc, dict), type(doc)
+    if args.stdout:
+        sys.stdout.write(out)
+    else:
+        with open(args.out, "w") as f:
+            f.write(out)
+        print(f"wrote {args.out} ({out.count('# Source:')} docs)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -105,6 +105,70 @@ def test_knobs_wired_into_workloads():
     assert "podSecurityPolicy.enabled" in by_name["templates/scheduler/psp.yaml"]
 
 
+def _render_default():
+    import sys
+
+    hack = os.path.join(os.path.dirname(os.path.dirname(__file__)), "hack")
+    if hack not in sys.path:
+        sys.path.insert(0, hack)
+    import render_chart
+
+    return render_chart.render_chart()
+
+
+def test_rendered_golden_up_to_date():
+    """The committed rendered-manifest golden matches a fresh render of
+    templates + values (VERDICT r4 #7): a knob typo or template edit
+    that changes rendered output fails here in the fast lane, without a
+    helm binary.  Regenerate with `python hack/render_chart.py`."""
+    golden_path = os.path.join(CHART, "rendered_default.golden.yaml")
+    assert os.path.exists(golden_path), "run python hack/render_chart.py"
+    with open(golden_path) as f:
+        golden = f.read()
+    fresh = _render_default()
+    assert fresh == golden, (
+        "rendered chart drifted from the golden — regenerate with "
+        "`python hack/render_chart.py` and review the diff"
+    )
+
+
+def test_rendered_golden_is_valid_kube_yaml():
+    """Every doc in the golden parses and carries apiVersion/kind/
+    metadata.name — indentation rot inside a template breaks this even
+    when the template itself 'renders'."""
+    docs = [d for d in yaml.safe_load_all(_render_default()) if d]
+    assert len(docs) >= 15, f"only {len(docs)} docs rendered"
+    kinds = set()
+    for d in docs:
+        assert d.get("apiVersion") and d.get("kind"), d
+        assert d.get("metadata", {}).get("name"), d
+        kinds.add(d["kind"])
+    # the chart's full object surface (ref charts/vgpu/templates/)
+    assert {"DaemonSet", "Deployment", "ConfigMap", "Service", "Job",
+            "MutatingWebhookConfiguration", "ClusterRole",
+            "ServiceAccount"} <= kinds, kinds
+
+
+@pytest.mark.skipif(shutil.which("helm") is None, reason="no helm binary")
+def test_helm_template_agrees_with_golden():
+    """Where a real helm exists, it is the authority: its rendered
+    objects must match the mini-renderer's golden as parsed data
+    (doc order and comments ignored).  Disagreement means regenerating
+    the golden from helm output and fixing hack/render_chart.py."""
+    out = subprocess.run(
+        ["helm", "template", "release-name", CHART],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+
+    def key(d):
+        return (d["kind"], d["metadata"]["name"])
+
+    helm_docs = {key(d): d for d in yaml.safe_load_all(out.stdout) if d}
+    ours = {key(d): d for d in yaml.safe_load_all(_render_default()) if d}
+    assert helm_docs == ours
+
+
 @pytest.mark.skipif(shutil.which("helm") is None, reason="no helm binary")
 def test_helm_lint_and_render():
     assert subprocess.run(["helm", "lint", CHART]).returncode == 0
