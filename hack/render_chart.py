@@ -36,6 +36,9 @@ CHART = os.path.join(REPO, "charts", "vtpu")
 
 TAG = re.compile(r"\{\{.*?\}\}", re.S)
 
+_NO_PIPE = object()  # "no piped value yet" — None is a REAL pipeable value
+_PIPED = object()    # token marker: substitute the piped value here
+
 
 class Node:
     """AST node: kind in {text, expr, if, range, with, define}."""
@@ -154,9 +157,12 @@ class Renderer:
 
     # -- expression evaluation -----------------------------------------
     def path(self, dotted: str, ctx):
+        """Resolve a dotted path from the CURRENT context — Go template
+        semantics: inside with/range the dot is rebound, and `.Values.x`
+        there is an error (helm rejects it), not a root lookup."""
         if dotted == ".":
             return ctx
-        node = self.root if dotted.startswith(".") else ctx
+        node = ctx
         for part in dotted.strip(".").split("."):
             if part == "":
                 continue
@@ -228,18 +234,19 @@ class Renderer:
             return str(s)[:-len(suf)] if str(s).endswith(suf) else str(s)
         raise ValueError(f"unsupported function {fn!r}")
 
-    def eval_segment(self, toks: list, ctx, piped=None):
+    def eval_segment(self, toks: list, ctx, piped=_NO_PIPE):
         """One pipe segment: an atom, a dotted method call
         (.Capabilities.APIVersions.Has "x"), or fn arg arg...
         Tokens may be pre-resolved values (from parenthesized
-        sub-expressions); a trailing None is the piped value."""
-        if piped is not None:
-            toks = toks + [None]  # sentinel: piped value is last arg
+        sub-expressions); the piped value (which may legitimately be
+        None — helm pipes nulls) is appended as the last argument."""
+        if piped is not _NO_PIPE:
+            toks = toks + [_PIPED]  # marker: piped value is last arg
         head = toks[0]
         rest = toks[1:]
 
         def val(t):
-            if t is None:
+            if t is _PIPED:
                 return piped
             return self.atom(t, ctx) if isinstance(t, str) else t
 
@@ -260,8 +267,8 @@ class Renderer:
 
     def eval_expr(self, expr: str, ctx):
         segments = [s.strip() for s in expr.split("|")]
-        value = None
-        for i, seg in enumerate(segments):
+        value = _NO_PIPE
+        for seg in segments:
             toks = tokenize_expr(seg)
             # parenthesized sub-expressions: evaluate innermost-first
             while "(" in toks:
@@ -269,7 +276,7 @@ class Renderer:
                 open_ = max(j for j in range(close) if toks[j] == "(")
                 sub = self.eval_segment(toks[open_ + 1:close], ctx)
                 toks[open_:close + 1] = [sub]
-            value = self.eval_segment(toks, ctx, piped=value if i else None)
+            value = self.eval_segment(toks, ctx, piped=value)
         return value
 
     # -- node rendering -------------------------------------------------
