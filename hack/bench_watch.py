@@ -150,6 +150,20 @@ def main() -> None:
                 1800,
                 os.path.join(ART, "kernels_tpu.json"),
             )
+            # the reference's full published matrix, stock-vs-shim per
+            # row (ref README.md:176-225).  Resumable: completed rows
+            # persist in the JSONL, so partial windows accumulate and
+            # a rerun only measures what's missing.
+            run_step(
+                "matrix",
+                [sys.executable,
+                 os.path.join("benchmarks", "ai-benchmark",
+                              "native_matrix.py"),
+                 "--seconds", "6",
+                 "--out", os.path.join(ART, "native_matrix_r5.jsonl")],
+                2700,
+                None,  # the script writes/appends its own --out
+            )
             if ok_bench:
                 note("complete", cycle=cycle)
                 return
