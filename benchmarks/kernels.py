@@ -196,6 +196,9 @@ def serving_bench(seconds: float, platform: str) -> dict:
     rng = np.random.default_rng(0)
     prompt_len = 64 if on_tpu else 4
     num_new = kw["max_seq"] - prompt_len - 8
+    from vtpu.ops.quant import quantize_tree
+
+    qparams = quantize_tree(params)  # int8 projections, fp embeddings
     engines = {
         "serving_dense_k1": lambda: ContinuousBatcher(
             dense_m, params, max_batch=n_rows),
@@ -203,6 +206,10 @@ def serving_bench(seconds: float, platform: str) -> dict:
             dense_m, params, max_batch=n_rows, harvest_every=8),
         "serving_paged_k8": lambda: PagedBatcher(
             paged_m, params, max_batch=n_rows, harvest_every=8),
+        # the full memory story: int8 weights over the paged pool —
+        # the config a 4x-tenant-density quota deployment would run
+        "serving_paged_k8_int8": lambda: PagedBatcher(
+            paged_m, qparams, max_batch=n_rows, harvest_every=8),
     }
     rows: dict = {}
     for name, make in engines.items():
