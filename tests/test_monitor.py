@@ -387,6 +387,42 @@ def test_shim_runtime_dispatch_counts_and_paces(tmp_path):
     rt.close()
 
 
+def test_cooperative_pacing_accuracy(tmp_path):
+    """Numeric duty-cycle accuracy for the Python twin, mirroring the
+    native shim's duty-mode bound (tests/test_native_pacing.py):
+    rate(q)/rate(100) within +-0.15 of q/100 over steady 10 ms steps.
+    The cooperative drain pacer re-runs a calibration step every
+    _sync_every steps, so its overhead rides inside the measured per-
+    step time — the bound covers calibration cost too."""
+    step_s = 0.01
+    iters = 24
+
+    def run(q):
+        rt = ShimRuntime(
+            limits_bytes=[],
+            core_limit=q,
+            region_path=str(tmp_path / f"acc{q}.cache"),
+            uuids=["tpu-0"],
+        )
+        for _ in range(4):  # warmup + calibrate outside the window
+            rt.dispatch(lambda: time.sleep(step_s))
+        t0 = time.monotonic()
+        for _ in range(iters):
+            rt.dispatch(lambda: time.sleep(step_s))
+        dt = time.monotonic() - t0
+        rt.close()
+        return dt / iters
+
+    per = {q: run(q) for q in (100, 60, 30)}
+    assert per[100] < step_s * 2, per  # unpaced runs at ~step time
+    for q in (60, 30):
+        ratio = per[100] / per[q]
+        assert abs(ratio - q / 100) <= 0.15, (
+            f"q={q}: rate ratio {ratio:.3f} vs {q / 100} ({per})"
+        )
+    assert per[30] > per[60] > per[100], per
+
+
 def test_shim_runtime_dispatch_paces_async_dispatch(tmp_path):
     """The closed loop survives ASYNC dispatch (the JAX reality): fn
     returns instantly, device work completes later.  Enqueue-latency
