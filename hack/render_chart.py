@@ -40,6 +40,51 @@ _NO_PIPE = object()  # "no piped value yet" — None is a REAL pipeable value
 _PIPED = object()    # token marker: substitute the piped value here
 
 
+def _gostr(v) -> str:
+    """Render a value the way Go templates print it: true/false for
+    bools, empty for nil — not Python's True/False/None."""
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if v is None:
+        return ""
+    return str(v)
+
+
+def _split_pipes(expr: str):
+    """Split a template expression on TOP-LEVEL pipes only — a '|'
+    inside a quoted string or parentheses is payload, not a pipe."""
+    out, buf, depth, in_q = [], [], 0, False
+    i = 0
+    while i < len(expr):
+        c = expr[i]
+        if in_q:
+            buf.append(c)
+            if c == "\\" and i + 1 < len(expr):
+                buf.append(expr[i + 1])
+                i += 1
+            elif c == '"':
+                in_q = False
+        elif c == '"':
+            in_q = True
+            buf.append(c)
+        elif c == "(":
+            depth += 1
+            buf.append(c)
+        elif c == ")":
+            depth -= 1
+            buf.append(c)
+        elif c == "|" and depth == 0:
+            out.append("".join(buf).strip())
+            buf = []
+        else:
+            buf.append(c)
+        i += 1
+    out.append("".join(buf).strip())
+    return out
+
+
 class Node:
     """AST node: kind in {text, expr, if, range, with, define}."""
 
@@ -207,7 +252,8 @@ class Renderer:
         if fn == "not":
             return not args[0]
         if fn == "quote":
-            return '"%s"' % str(args[0]).replace('"', '\\"')
+            s = _gostr(args[0])
+            return '"%s"' % s.replace("\\", "\\\\").replace('"', '\\"')
         if fn == "toJson":
             return json.dumps(args[0])
         if fn == "toYaml":
@@ -266,7 +312,7 @@ class Renderer:
         return self.call(head, [val(t) for t in rest], ctx)
 
     def eval_expr(self, expr: str, ctx):
-        segments = [s.strip() for s in expr.split("|")]
+        segments = _split_pipes(expr)
         value = _NO_PIPE
         for seg in segments:
             toks = tokenize_expr(seg)
@@ -288,8 +334,7 @@ class Renderer:
             elif n.kind == "define":
                 self.defines[n.name] = n.body
             elif n.kind == "expr":
-                v = self.eval_expr(n.expr, ctx)
-                out.append("" if v is None else str(v))
+                out.append(_gostr(self.eval_expr(n.expr, ctx)))
             elif n.kind == "if":
                 for cond, body in n.arms:
                     if cond is None or self.eval_expr(cond, ctx):
@@ -347,7 +392,8 @@ def render_chart(values=None, release_name="release-name",
     r = Renderer(vals, release, caps)
     # pass 1: helpers (defines) — helm loads _*.tpl first
     tpl_files, yaml_files = [], []
-    for root, _dirs, files in os.walk(os.path.join(CHART, "templates")):
+    for root, dirs, files in os.walk(os.path.join(CHART, "templates")):
+        dirs.sort()  # deterministic section order across filesystems
         for f in sorted(files):
             p = os.path.join(root, f)
             rel = os.path.relpath(p, CHART)

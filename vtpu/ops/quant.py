@@ -83,10 +83,14 @@ def quantize_tree(params, min_elems: int = 16384):
     granularity for a per-row lookup — and a realistic wte clears any
     size bar."""
     def maybe(path, leaf):
-        names = "/".join(
-            str(getattr(k, "key", k)) for k in path
-        ).lower()
-        if "embed" in names or "wte" in names or "wpe" in names:
+        parts = [str(getattr(k, "key", k)).lower() for k in path]
+        # exact component match: flax embedding tables are leaves NAMED
+        # 'embedding' (nn.Embed) under modules like wte/wpe — a
+        # substring match would silently exempt projections that merely
+        # live under an 'embed*'-named ancestor
+        if parts and (
+            parts[-1] == "embedding" or any(p in ("wte", "wpe") for p in parts)
+        ):
             return leaf
         if (
             hasattr(leaf, "ndim") and leaf.ndim >= 2
