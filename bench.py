@@ -470,15 +470,29 @@ def native_available() -> bool:
     return os.path.exists(SHIM_SO) and os.path.exists(REAL_PLUGIN)
 
 
+_GATE_TIMEOUTS = 0  # latch: a down transport shrinks later gates
+
+
 def wait_backend_ready(max_wait_s: float | None = None) -> bool:
     """Session-drain gate: backend slots behind a relayed transport are a
     finite pool that killed/finished tenants release asynchronously —
     launching the next phase while the pool is exhausted hangs every
     tenant at init and burns a whole barrier window (the r3 failure
     mode).  Probe with a tiny child (jax.devices() only) and wait until
-    one initializes promptly."""
+    one initializes promptly.
+
+    A transport that timed out on TWO full gates this run is down, not
+    draining (the r3 slow-drain mode recovers within one 300 s gate) —
+    later gates shrink to ~60 s so a multi-arm run against a dead relay
+    finishes in minutes, not the 7×300 s worst case that risks outliving
+    the driver's own timeout (r5 observation: the full probe suite took
+    87 min against a dead transport).  One timeout alone never shrinks:
+    a single slow drain must keep the full multi-attempt backoff."""
+    global _GATE_TIMEOUTS
     if max_wait_s is None:
         max_wait_s = float(os.environ.get("VTPU_BENCH_GATE_S", "300") or 300)
+        if _GATE_TIMEOUTS >= 2:
+            max_wait_s = min(max_wait_s, 60.0)
     deadline = time.monotonic() + max_wait_s
     probe_env = dict(os.environ)
     probe_env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -503,6 +517,7 @@ def wait_backend_ready(max_wait_s: float | None = None) -> bool:
                 capture_output=True, text=True, timeout=60,
             )
             if proc.returncode == 0:
+                _GATE_TIMEOUTS = 0  # transport recovered: full gates again
                 if attempt:
                     phase_note("backend_gate", rc=0, waited_attempts=attempt)
                 return True
@@ -517,6 +532,7 @@ def wait_backend_ready(max_wait_s: float | None = None) -> bool:
         log(f"backend gate: init not ready (attempt {attempt}); "
             f"retrying in {pause:.0f}s…")
         time.sleep(pause)
+    _GATE_TIMEOUTS += 1
     phase_note("backend_gate", rc="timeout", waited_attempts=attempt)
     return False
 

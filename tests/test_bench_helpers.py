@@ -4,7 +4,18 @@ failures), exercised without any backend."""
 
 import subprocess
 
+import pytest
+
 import bench
+
+
+@pytest.fixture(autouse=True)
+def _reset_gate_latch():
+    """wait_backend_ready's down-transport latch is module state; tests
+    that trip it must not shrink later tests' gates."""
+    bench._GATE_TIMEOUTS = 0
+    yield
+    bench._GATE_TIMEOUTS = 0
 
 
 def test_wait_backend_ready_retries_until_init(monkeypatch):
