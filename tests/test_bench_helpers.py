@@ -132,8 +132,9 @@ def test_native_matrix_driver_resume_and_table(monkeypatch, tmp_path, capsys):
     )
     ran = []
 
-    def fake_run_arm(spec_s, shim, seconds, quota_mb, timeout_s):
-        ran.append((spec_s, shim))
+    def fake_run_arm(spec_s, shim, seconds, quota_mb, timeout_s,
+                     gate=True):
+        ran.append((spec_s, shim, gate))
         return {"img_s": 42.0, "platform": "cpu"}
 
     monkeypatch.setattr(nm, "run_arm", fake_run_arm)
@@ -142,11 +143,15 @@ def test_native_matrix_driver_resume_and_table(monkeypatch, tmp_path, capsys):
         "--out", str(out),
     ])
     assert rc == 0
+    arms = [(s, sh) for s, sh, _g in ran]
     # stock lstm was done → skipped; failed vtpu lstm re-ran; both vgg arms ran
-    assert ("lstm:8:inference", False) not in ran
-    assert ("lstm:8:inference", True) in ran
-    assert ("vgg16:2:inference", False) in ran and (
-        "vgg16:2:inference", True) in ran
+    assert ("lstm:8:inference", False) not in arms
+    assert ("lstm:8:inference", True) in arms
+    assert ("vgg16:2:inference", False) in arms and (
+        "vgg16:2:inference", True) in arms
+    # first attempted arm gates; arms after a success skip the gate
+    assert ran[0][2] is True
+    assert all(g is False for _s, _sh, g in ran[1:])
     text = capsys.readouterr().out
     assert "| lstm:8:inference | 50.0 | 42.0 | 0.840 |" in text
 
