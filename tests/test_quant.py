@@ -47,11 +47,18 @@ def test_quantize_tree_selects_big_matrices_and_shrinks():
     assert qparams["wte"]["embedding"].size >= 16384
     assert not is_quantized(qparams["wte"]["embedding"])
     assert not is_quantized(qparams["wpe"]["embedding"])
-    # the exclusion is exact-component, not substring: a projection that
-    # merely LIVES under an embed*-named module must still quantize
-    tree = {"embed_proj": {"kernel": jnp.ones((256, 128), jnp.float32)}}
+    # the exclusion keys on the LEAF name, not path substrings: a
+    # projection that merely LIVES under an embed*-named module still
+    # quantizes, while haiku/torch-style embedding tables stay fp
+    tree = {
+        "embed_proj": {"kernel": jnp.ones((256, 128), jnp.float32)},
+        "embed": {"embeddings": jnp.ones((256, 128), jnp.float32)},
+        "tok_embeddings": {"weight": jnp.ones((256, 128), jnp.float32)},
+    }
     qt = quantize_tree(tree, min_elems=1024)
     assert is_quantized(qt["embed_proj"]["kernel"])
+    assert not is_quantized(qt["embed"]["embeddings"])
+    assert not is_quantized(qt["tok_embeddings"]["weight"])
     # at-rest bytes shrink by ~4x on the quantized fraction
     assert tree_bytes(qparams) < 0.45 * tree_bytes(params)
     # dequantize_tree restores a same-structure fp tree

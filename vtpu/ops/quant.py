@@ -84,13 +84,27 @@ def quantize_tree(params, min_elems: int = 16384):
     size bar."""
     def maybe(path, leaf):
         parts = [str(getattr(k, "key", k)).lower() for k in path]
-        # exact component match: flax embedding tables are leaves NAMED
-        # 'embedding' (nn.Embed) under modules like wte/wpe — a
-        # substring match would silently exempt projections that merely
-        # live under an 'embed*'-named ancestor
-        if parts and (
-            parts[-1] == "embedding" or any(p in ("wte", "wpe") for p in parts)
-        ):
+        # Embedding detection across naming conventions without the
+        # substring trap (ADVICE r4 + review): the LEAF name decides.
+        #   flax nn.Embed      .../wte/embedding
+        #   haiku hk.Embed     .../embed/embeddings
+        #   torch-converted    .../tok_embeddings/weight
+        # A projection under an embed*-named module keeps a kernel-like
+        # leaf name ('kernel') and still quantizes.
+        leaf_name = parts[-1] if parts else ""
+        parent = parts[-2] if len(parts) > 1 else ""
+        is_embedding = (
+            leaf_name in ("embedding", "embeddings")
+            or any(p in ("wte", "wpe") for p in parts)
+            or (
+                # torch-style: generic 'weight' leaf, embedding-named
+                # module (conservative: mis-detection keeps fp, which
+                # costs memory, never numerics)
+                leaf_name in ("weight", "w")
+                and ("embedding" in parent or "embed" in parent.split("_"))
+            )
+        )
+        if is_embedding:
             return leaf
         if (
             hasattr(leaf, "ndim") and leaf.ndim >= 2
