@@ -69,12 +69,30 @@ def matmul_roofline(seconds: float, n: int = 4096) -> dict:
     f = jax.jit(lambda x, y: x @ y)
     it_s = timed(f, a, b, seconds=seconds)
     tflops = 2.0 * n ** 3 * it_s / 1e12
-    return {
+    row = {
         "matmul_n": n,
         "matmul_it_s": round(it_s, 2),
         "matmul_tflops": round(tflops, 2),
         "matmul_mfu": round(tflops / peak_tflops(), 4),
     }
+    # int8 MXU rate (2x bf16 peak on v5e) — the serving int8 path's
+    # compute ceiling; int32 accumulate is the native MXU mode
+    try:
+        a8 = jnp.clip(jnp.round(a.astype(jnp.float32) * 8), -127,
+                      127).astype(jnp.int8)
+        b8 = jnp.clip(jnp.round(b.astype(jnp.float32) * 8), -127,
+                      127).astype(jnp.int8)
+        f8 = jax.jit(lambda x, y: jax.lax.dot_general(
+            x, y, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32))
+        it8 = timed(f8, a8, b8, seconds=seconds)
+        tops8 = 2.0 * n ** 3 * it8 / 1e12
+        row["matmul_int8_it_s"] = round(it8, 2)
+        row["matmul_int8_tops"] = round(tops8, 2)
+        row["matmul_int8_vs_bf16"] = round(it8 / it_s, 3) if it_s else None
+    except Exception as e:  # additive row only
+        row["matmul_int8_error"] = str(e)[:200]
+    return row
 
 
 def paged_decode_bench(seconds: float, platform: str) -> dict:
