@@ -149,6 +149,50 @@ def test_rendered_golden_is_valid_kube_yaml():
             "ServiceAccount"} <= kinds, kinds
 
 
+def test_renderer_expression_semantics():
+    """The Go-template corners that bit in review: top-level-only pipe
+    splitting, Go-style bool/nil rendering, backslash-safe quote, null
+    through a pipe hitting default, rebound-dot strictness."""
+    import sys
+
+    hack = os.path.join(os.path.dirname(os.path.dirname(__file__)), "hack")
+    if hack not in sys.path:
+        sys.path.insert(0, hack)
+    import render_chart as rc
+
+    assert rc._split_pipes('a | default "x|y" | quote') == [
+        "a", 'default "x|y"', "quote"]
+    assert rc._gostr(True) == "true" and rc._gostr(False) == "false"
+    assert rc._gostr(None) == ""
+    r = rc.Renderer({"flag": True, "nil": None, "s": "a\\b"}, {}, {})
+    assert r.eval_expr('.Values.flag | quote', r.root) == '"true"'
+    assert r.eval_expr('.Values.s | quote', r.root) == '"a\\\\b"'
+    assert r.eval_expr('.Values.nil | default "d"', r.root) == "d"
+    assert r.eval_expr('printf "%s|%s" "a" "b"', r.root) == "a|b"
+    with pytest.raises(KeyError):
+        r.eval_expr(".Values.flag", {"rebound": 1})  # Go rejects this too
+
+
+def test_renderer_deep_merge_and_map_range():
+    import sys
+
+    hack = os.path.join(os.path.dirname(os.path.dirname(__file__)), "hack")
+    if hack not in sys.path:
+        sys.path.insert(0, hack)
+    import render_chart as rc
+
+    # nested override must not wipe sibling keys (helm deep-merges)
+    out = rc.render_chart(values={"devicePlugin": {"healthErrorStreak": 9}})
+    assert '"9"' in out or ": 9" in out
+    assert "deviceSplitCount" in open(
+        os.path.join(CHART, "values.yaml")).read()
+    # map range iterates VALUES in key order like helm
+    r = rc.Renderer({"m": {"b": "2", "a": "1"}}, {}, {})
+    nodes, _, _ = rc.parse(rc.lex(
+        "{{ range .Values.m }}[{{ . }}]{{ end }}"))
+    assert r.render_nodes(nodes, r.root) == "[1][2]"
+
+
 @pytest.mark.skipif(shutil.which("helm") is None, reason="no helm binary")
 def test_helm_template_agrees_with_golden():
     """Where a real helm exists, it is the authority: its rendered
