@@ -18,6 +18,13 @@ def _reset_gate_latch():
     bench._GATE_TIMEOUTS = 0
 
 
+@pytest.fixture(autouse=True)
+def _isolated_state_dir(tmp_path, monkeypatch):
+    """Probes persist sub-arms via save_arm; a test must never write
+    into (or stitch from) the repo's real docs/artifacts/bench_state."""
+    monkeypatch.setattr(bench, "STATE_DIR", str(tmp_path / "bench_state"))
+
+
 def test_wait_backend_ready_retries_until_init(monkeypatch):
     """The session-drain gate keeps probing while backend init hangs and
     passes as soon as a probe child initializes."""
@@ -83,6 +90,24 @@ def test_oversub_probe_keeps_partial_arms(monkeypatch):
     assert "all_device_img_s" not in out
     # a truncated probe (all_device missing) must not be cacheable
     assert out["complete"] is False
+
+    # sub-arm stitching (r5): the next window re-measures ONLY the
+    # missing all_device arm; the three landed arms come from cache
+    calls = []
+
+    def fake_share2(quota_mb, window_s, n_tenants=4, shim=True,
+                    extra_env=None):
+        calls.append(quota_mb)
+        if quota_mb == 0:
+            return ([{"img_s": 140.0}], {})
+        raise AssertionError("cached arm was re-measured")
+
+    monkeypatch.setattr(bench, "run_native_share", fake_share2)
+    out2 = bench.run_oversubscribe_probe()
+    assert calls == [0]  # only the all_device arm ran
+    assert out2["arms_ok"] == 4 and out2["all_device_img_s"] == 140.0
+    assert out2["oversub_img_s"] == 100.0 and out2["win_vs_manual"] == 4.0
+    assert out2["complete"] is True
 
 
 def test_oversub_probe_complete_when_all_arms_land(monkeypatch):
@@ -269,7 +294,19 @@ def test_pacing_probe_partial_and_ratios(monkeypatch):
     # re-measuring the ratios for the whole state TTL)
     assert out["complete"] is False
 
+    # sub-arm stitching (r5): the arms phase 1 measured persist, so a
+    # dead transport now returns the CACHED solo100+trio instead of
+    # nothing — only solo50 (never measured) stays missing
     monkeypatch.setattr(bench, "run_native_share", lambda *a, **k: None)
+    out2 = bench.run_pacing_probe()
+    assert out2 is not None
+    assert out2["solo"]["100"]["img_s"] == 1000.0
+    assert out2["trio"]["rates_img_s"] == out["trio"]["rates_img_s"]
+    assert "50" not in out2["solo"] and out2["complete"] is False
+
+    # with NO cached sub-arms, a dead transport still yields None
+    bench_state2 = bench.STATE_DIR + "-empty"
+    monkeypatch.setattr(bench, "STATE_DIR", bench_state2)
     assert bench.run_pacing_probe() is None
 
 

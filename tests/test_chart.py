@@ -105,7 +105,8 @@ def test_knobs_wired_into_workloads():
     assert "podSecurityPolicy.enabled" in by_name["templates/scheduler/psp.yaml"]
 
 
-def _render_default():
+def _rc():
+    """Import hack/render_chart (not a package — path-injected)."""
     import sys
 
     hack = os.path.join(os.path.dirname(os.path.dirname(__file__)), "hack")
@@ -113,7 +114,11 @@ def _render_default():
         sys.path.insert(0, hack)
     import render_chart
 
-    return render_chart.render_chart()
+    return render_chart
+
+
+def _render_default():
+    return _rc().render_chart()
 
 
 def test_rendered_golden_up_to_date():
@@ -153,12 +158,7 @@ def test_renderer_expression_semantics():
     """The Go-template corners that bit in review: top-level-only pipe
     splitting, Go-style bool/nil rendering, backslash-safe quote, null
     through a pipe hitting default, rebound-dot strictness."""
-    import sys
-
-    hack = os.path.join(os.path.dirname(os.path.dirname(__file__)), "hack")
-    if hack not in sys.path:
-        sys.path.insert(0, hack)
-    import render_chart as rc
+    rc = _rc()
 
     assert rc._split_pipes('a | default "x|y" | quote') == [
         "a", 'default "x|y"', "quote"]
@@ -174,12 +174,7 @@ def test_renderer_expression_semantics():
 
 
 def test_renderer_deep_merge_and_map_range():
-    import sys
-
-    hack = os.path.join(os.path.dirname(os.path.dirname(__file__)), "hack")
-    if hack not in sys.path:
-        sys.path.insert(0, hack)
-    import render_chart as rc
+    rc = _rc()
 
     # nested override must not wipe sibling keys (helm deep-merges)
     out = rc.render_chart(values={"devicePlugin": {"healthErrorStreak": 9}})
