@@ -310,6 +310,21 @@ def test_pacing_probe_partial_and_ratios(monkeypatch):
     assert bench.run_pacing_probe() is None
 
 
+def test_sub_arm_freshness_gate():
+    """Merged saves keep per-arm stamps: an entry past STATE_MAX_AGE_S
+    is not stitchable even when the FILE-level stamp is fresh (the
+    immortal-sub-arm bug class), and malformed entries never stitch."""
+    import time
+
+    fresh = bench._stamp({"img_s": 1.0})
+    assert bench._sub_arm_fresh(fresh)
+    stale = {"data": {"img_s": 1.0},
+             "measured_unix": time.time() - bench.STATE_MAX_AGE_S - 10}
+    assert not bench._sub_arm_fresh(stale)
+    for bad in (None, 123, {"img_s": 1.0}, {"data": 5}, {"data": None}):
+        assert not bench._sub_arm_fresh(bad), bad
+
+
 def test_emit_nulls_value_on_fallback(capsys):
     """A CPU/cooperative-fallback artifact must not carry a quotable
     top-level value (VERDICT r4 weak #7); the measured path keeps it."""
