@@ -375,6 +375,7 @@ def main(argv=None) -> int:
     except Exception as e:  # noqa: BLE001 — additive row only
         serving = {"serving_error": str(e)[:200]}
     out = {
+        "platform": platform,  # consumers gate on tpu vs cpu fallback
         "kernel_bench": rows,
         "peak_bf16_tflops": peak_tflops(),
         **roofline,

@@ -95,6 +95,15 @@ def acquire_lock(force: bool) -> bool:
     return True
 
 
+def _tpu_artifact(path: str) -> bool:
+    """True when ``path`` holds a JSON artifact measured on TPU."""
+    try:
+        with open(path) as f:
+            return json.load(f).get("platform") == "tpu"
+    except (OSError, json.JSONDecodeError, ValueError, AttributeError):
+        return False
+
+
 def run_step(name: str, cmd: list, timeout: float, out_path: str | None):
     note(f"{name}:start")
     try:
@@ -142,8 +151,10 @@ def main() -> None:
             # VERDICT r4 priority (a) share is banked; (b) kernel MFU
             # comes BEFORE (c) the oversub/pacing-heavy bench — a short
             # window must land the judge's single-chip perf axis first.
-            # Skip kernels only when its artifact already exists.
-            if not os.path.exists(os.path.join(ART, "kernels_tpu.json")):
+            # Skip kernels only when a REAL TPU artifact exists — a
+            # CPU-fallback file (mid-window flap) must never block the
+            # on-chip capture on later windows.
+            if not _tpu_artifact(os.path.join(ART, "kernels_tpu.json")):
                 run_step(
                     "kernels",
                     [sys.executable,
