@@ -750,12 +750,13 @@ def _sub_arm_fresh(entry) -> bool:
     """A stitchable sub-arm: well-formed (a hand-edited or older-schema
     entry falls back to live measurement, not a crash) and within the
     same TTL load_arm applies to whole arms."""
-    return (
-        isinstance(entry, dict)
-        and isinstance(entry.get("data"), dict)
-        and time.time() - float(entry.get("measured_unix") or 0)
-        <= STATE_MAX_AGE_S
-    )
+    if not (isinstance(entry, dict) and isinstance(entry.get("data"), dict)):
+        return False
+    try:
+        age = time.time() - float(entry.get("measured_unix") or 0)
+    except (TypeError, ValueError):
+        return False
+    return age <= STATE_MAX_AGE_S
 
 
 def run_oversubscribe_probe(window_s: float = 8.0) -> dict | None:
@@ -824,6 +825,13 @@ def run_oversubscribe_probe(window_s: float = 8.0) -> dict | None:
     if ok == 0:
         return None
     out = {"quota_mb": quota_mb, "arms_ok": ok}
+    # a probe completed FROM stitched cache must not be re-stamped fresh
+    # at the whole-arm layer (the immortalize bug, one level up): carry
+    # the oldest sub-arm time so the whole-arm TTL covers the data's age
+    if stamped:
+        out["oldest_measured_unix"] = min(
+            float(v.get("measured_unix") or 0) for v in stamped.values()
+        )
     if "error" not in arms["oversub"]:
         out.update(
             params_mb=arms["oversub"].get("params_mb"),
@@ -960,6 +968,15 @@ def run_pacing_probe(window_s: float = 10.0) -> dict | None:
     out["complete"] = (
         "solo_duty_50" in out and "ratio_30_vs_100" in out["trio"]
     )
+    # oldest sub-arm time rides along so the whole-arm save's TTL covers
+    # the data's true age (see run_oversubscribe_probe)
+    stamps = [
+        float(v.get("measured_unix") or 0) for v in stamped_solo.values()
+    ]
+    if trio_entry is not None:
+        stamps.append(float(trio_entry.get("measured_unix") or 0))
+    if stamps:
+        out["oldest_measured_unix"] = min(stamps)
     return out
 
 
@@ -1168,7 +1185,12 @@ def main() -> None:
             extra["oversubscribe"] = probe
             log(f"oversubscribe probe: {probe}")
             if probe.get("complete"):
-                save_arm("oversub", {"platform": platform, "probe": probe})
+                rec = {"platform": platform, "probe": probe}
+                if probe.get("oldest_measured_unix"):
+                    # payload overrides save_arm's fresh stamp: stitched
+                    # cached sub-arms keep their true age in the TTL
+                    rec["measured_unix"] = probe["oldest_measured_unix"]
+                save_arm("oversub", rec)
                 arm_sources["oversub"] = "live"
     # core-percentage pacing proof — additive, same budget discipline
     cached_pacing = load_arm("pacing") if platform != "cpu" else None
@@ -1189,7 +1211,10 @@ def main() -> None:
             extra["pacing"] = probe
             log(f"pacing probe: {probe}")
             if probe.get("complete"):
-                save_arm("pacing", {"platform": platform, "probe": probe})
+                rec = {"platform": platform, "probe": probe}
+                if probe.get("oldest_measured_unix"):
+                    rec["measured_unix"] = probe["oldest_measured_unix"]
+                save_arm("pacing", rec)
                 arm_sources["pacing"] = "live"
     if excl_per_proc:
         extra["exclusive_per_proc_img_s"] = [round(r, 2) for r in excl_per_proc]

@@ -103,11 +103,15 @@ def test_oversub_probe_keeps_partial_arms(monkeypatch):
         raise AssertionError("cached arm was re-measured")
 
     monkeypatch.setattr(bench, "run_native_share", fake_share2)
+    t_between = __import__("time").time()
     out2 = bench.run_oversubscribe_probe()
     assert calls == [0]  # only the all_device arm ran
     assert out2["arms_ok"] == 4 and out2["all_device_img_s"] == 140.0
     assert out2["oversub_img_s"] == 100.0 and out2["win_vs_manual"] == 4.0
     assert out2["complete"] is True
+    # the stitched probe reports its OLDEST sub-arm time so a whole-arm
+    # save cannot re-stamp phase-1 data fresh (TTL immortalize bug)
+    assert out2["oldest_measured_unix"] <= t_between
 
 
 def test_oversub_probe_complete_when_all_arms_land(monkeypatch):
@@ -321,7 +325,9 @@ def test_sub_arm_freshness_gate():
     stale = {"data": {"img_s": 1.0},
              "measured_unix": time.time() - bench.STATE_MAX_AGE_S - 10}
     assert not bench._sub_arm_fresh(stale)
-    for bad in (None, 123, {"img_s": 1.0}, {"data": 5}, {"data": None}):
+    for bad in (None, 123, {"img_s": 1.0}, {"data": 5}, {"data": None},
+                {"data": {}, "measured_unix": "2026-07-30"},
+                {"data": {}, "measured_unix": {}}):
         assert not bench._sub_arm_fresh(bad), bad
 
 
