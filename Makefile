@@ -7,7 +7,7 @@ IMG ?= vtpu/vtpu
 PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
-	image chart clean tidy
+	bench-sched image chart clean tidy
 
 all: build
 
@@ -119,6 +119,13 @@ test-native-tsan:
 
 bench:
 	$(PY) bench.py
+
+# scheduler hot-path proof: refreshes docs/artifacts/scheduler_scale.json
+# (preserves the artifact's pre-usage-cache baseline block; add
+# --save-baseline after a hardware change).  docs/scheduler_perf.md
+# explains how to read the before/after numbers.
+bench-sched:
+	$(PY) benchmarks/scheduler_scale.py --nodes 1000 --pods 200
 
 # (Re)arm the detached TPU-window watcher.  Safe to run unconditionally at
 # the start of every session: a live watcher keeps its lock and the new

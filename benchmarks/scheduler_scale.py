@@ -16,7 +16,14 @@ Artifact: docs/artifacts/scheduler_scale.json (committed — the judge-
 visible record); the regression assertion lives in
 tests/test_scale.py, which runs a smaller instance of the same code.
 
+The artifact carries a ``baseline`` block (the pre-usage-cache numbers,
+measured on the same machine) so before/after stays visible across
+re-runs: a normal run preserves the existing baseline and reports
+``filter_p99_speedup_vs_baseline``; ``--save-baseline`` stamps the
+current run as the new baseline (use after a hardware change).
+
 Usage: python benchmarks/scheduler_scale.py [--nodes 1000] [--pods 200]
+       [--save-baseline]
 """
 
 from __future__ import annotations
@@ -132,6 +139,8 @@ def main(argv=None) -> int:
     ap.add_argument("--pods", type=int, default=200)
     ap.add_argument("--out", default=os.path.join(
         REPO, "docs", "artifacts", "scheduler_scale.json"))
+    ap.add_argument("--save-baseline", action="store_true",
+                    help="stamp this run as the artifact's baseline block")
     args = ap.parse_args(argv)
 
     res = {
@@ -139,6 +148,31 @@ def main(argv=None) -> int:
         "ici": bench_ici(),
         "measured": time.strftime("%Y-%m-%d %H:%M:%S"),
     }
+    baseline = None
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                baseline = json.load(f).get("baseline")
+        except (ValueError, OSError):
+            baseline = None
+    if args.save_baseline or baseline is None:
+        baseline = {
+            "filter": res["filter"],
+            "measured": res["measured"],
+            # self-stamped: distinguishes this from a genuine pre-change
+            # measurement so a fresh-checkout run cannot masquerade as a
+            # before/after record (speedup vs itself is ~1.0 by
+            # construction until a real baseline replaces this block)
+            "note": "baseline auto-stamped from the CURRENT code "
+                    "(no prior artifact or --save-baseline given); not a "
+                    "pre-change measurement",
+        }
+    res["baseline"] = baseline
+    base_p99 = baseline.get("filter", {}).get("filter_p99_ms", 0)
+    if base_p99 and res["filter"]["filter_p99_ms"]:
+        res["filter_p99_speedup_vs_baseline"] = round(
+            base_p99 / res["filter"]["filter_p99_ms"], 2
+        )
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(res, f, indent=1)

@@ -10,9 +10,15 @@ from benchmarks.scheduler_scale import bench_filter, bench_ici
 def test_filter_latency_bounded_at_300_nodes():
     res = bench_filter(n_nodes=300, n_pods=30)
     assert res["pods_placed"] == 30
-    # measured ~15 ms p50 at 300 nodes on a dev box; 10x headroom for CI
-    assert res["filter_p50_ms"] < 150, res
-    assert res["filter_p99_ms"] < 400, res
+    # post-usage-cache budget (docs/scheduler_perf.md): measured ~0.6 ms
+    # p50 / ~12 ms p99 at 300 nodes on a 2-vCPU dev box.  The p50 (median
+    # of 30 calls) is the robust regression guard — the pre-cache
+    # rebuild-per-filter shape measured ~15 ms p50 here, so 10 ms fails
+    # it decisively.  p99 is effectively the single worst call (the cold
+    # first filter rebuilds every cache entry) and rides on scheduler
+    # noise, so it keeps ~5× headroom over the measurement.
+    assert res["filter_p50_ms"] < 10, res
+    assert res["filter_p99_ms"] < 60, res
 
 
 def test_v5p128_rectangle_search_bounded():

@@ -1,0 +1,532 @@
+"""Incremental usage cache: oracle equivalence + concurrency.
+
+The cache (vtpu/scheduler/usage_cache.py) is an event-sourced materialized
+view of what ``Scheduler.nodes_usage()`` recomputes from scratch; these
+tests drive randomized event storms through both and require them to stay
+field-for-field identical, and hammer the lock-shrunk ``filter()`` with
+threads to prove no chip is ever double-booked.
+"""
+
+import random
+import threading
+
+import pytest
+
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.scheduler import Scheduler
+from vtpu.scheduler import score as score_mod
+from vtpu.utils import codec
+from vtpu.utils.types import (
+    ChipInfo,
+    ContainerDevice,
+    ContainerDeviceRequest,
+    HandshakeState,
+    MEM_PERCENTAGE_UNSET,
+    annotations,
+    resources,
+)
+
+NODE_NAMES = ["n0", "n1", "n2", "n3", "n4"]
+POD_NAMES = [f"p{i}" for i in range(16)]
+SOURCES = ["tpu", "pjrt"]
+
+
+def mk_chips(rng, node):
+    n = rng.randint(1, 6)
+    return [
+        ChipInfo(
+            uuid=f"{node}-chip-{i}",
+            count=rng.choice([1, 4, 10]),
+            hbm_mb=rng.choice([8192, 16384]),
+            cores=100,
+            type=rng.choice(["TPU-v5e", "TPU-v4"]),
+            health=rng.random() > 0.1,
+            coords=(i % 2, i // 2, 0),
+        )
+        for i in range(n)
+    ]
+
+
+def mk_pod_dict(rng, name, with_assignment=True):
+    uid = f"uid-{name}"
+    annos = {}
+    if with_assignment:
+        node = rng.choice(NODE_NAMES + ["ghost-node"])
+        devices = [
+            [
+                ContainerDevice(
+                    uuid=rng.choice(
+                        [f"{node}-chip-{rng.randint(0, 5)}", "no-such-uuid"]
+                    ),
+                    type="TPU-v5e",
+                    usedmem=rng.choice([1024, 4096]),
+                    usedcores=rng.choice([0, 25, 100]),
+                )
+            ]
+            for _ in range(rng.randint(1, 2))
+        ]
+        annos[annotations.ASSIGNED_IDS] = codec.encode_pod_devices(devices)
+        annos[annotations.ASSIGNED_NODE] = node
+    if rng.random() < 0.1:
+        annos[annotations.BIND_PHASE] = "failed"
+    pod = {
+        "metadata": {
+            "name": name,
+            "namespace": "default",
+            "uid": uid,
+            "annotations": annos,
+        }
+    }
+    if rng.random() < 0.1:
+        pod["status"] = {"phase": rng.choice(["Succeeded", "Failed", "Running"])}
+    return pod
+
+
+def assert_cache_equals_oracle(sched):
+    cache_view = sched.usage_cache.inspect()
+    oracle = sched.nodes_usage()
+    assert set(cache_view) == set(oracle), (
+        set(cache_view) ^ set(oracle),
+        sched.usage_cache.stats(),
+    )
+    for name, want in oracle.items():
+        got = cache_view[name]
+        assert got.topology == want.topology, name
+        assert len(got.devices) == len(want.devices), name
+        for da, db in zip(got.devices, want.devices):
+            # DeviceUsage is a dataclass: == is a full field-wise compare
+            assert da == db, (name, da, db)
+
+
+def test_oracle_equivalence_randomized_event_sequences():
+    """≥1000 randomized sequences of pod ingest / rm / bind-fail / node
+    add / expel events: after each, the incremental cache must equal a
+    fresh nodes_usage() rebuild field-for-field."""
+    rng = random.Random(0xC0FFEE)
+    sched = Scheduler(client=None)
+    for seq in range(1000):
+        for _ in range(rng.randint(2, 8)):
+            ev = rng.random()
+            if ev < 0.30:  # pod ingest with (usually) an assignment
+                sched.pods.ingest(
+                    mk_pod_dict(rng, rng.choice(POD_NAMES),
+                                with_assignment=rng.random() > 0.15)
+                )
+            elif ev < 0.40:  # pod removed (informer DELETED)
+                sched.pods.rm_pod(f"uid-{rng.choice(POD_NAMES)}")
+            elif ev < 0.50:  # bind failure path unbooks via rm_pod
+                sched.pods.rm_pod(f"uid-{rng.choice(POD_NAMES)}")
+            elif ev < 0.80:  # node (re)registration, per-source
+                node = rng.choice(NODE_NAMES)
+                sched.nodes.add_node(
+                    node,
+                    mk_chips(rng, node),
+                    topology=rng.choice(["", "2x2x1", "2x4x1"]),
+                    source=rng.choice(SOURCES),
+                )
+            elif ev < 0.90:  # expel one family's devices
+                sched.nodes.rm_node_devices(
+                    rng.choice(NODE_NAMES), source=rng.choice(SOURCES)
+                )
+            else:  # expel the whole node
+                sched.nodes.rm_node_devices(rng.choice(NODE_NAMES), source=None)
+        assert_cache_equals_oracle(sched)
+    stats = sched.usage_cache.stats()
+    assert stats["delta_updates"] > 0  # the deltas actually ran
+
+
+def register_node(client, name, n_chips=1, hbm=16384):
+    chips = [
+        ChipInfo(f"{name}-chip-{i}", 10, hbm, 100, "TPU-v5e", True,
+                 (i % 2, i // 2, 0))
+        for i in range(n_chips)
+    ]
+    client.create_node(new_node(name))
+    client.patch_node_annotations(
+        name,
+        {
+            annotations.NODE_REGISTER: codec.encode_node_devices(chips),
+            annotations.NODE_TOPOLOGY: "2x2x1",
+            annotations.NODE_HANDSHAKE:
+                f"{HandshakeState.REPORTED} 2026-01-01T00:00:00Z",
+        },
+    )
+
+
+def tpu_pod(name, pct=None, mem=None, cores=None):
+    limits = {resources.chip: 1}
+    if pct is not None:
+        limits[resources.memory_percentage] = pct
+    if mem is not None:
+        limits[resources.memory] = mem
+    if cores is not None:
+        limits[resources.cores] = cores
+    return new_pod(
+        name, containers=[{"name": "main", "resources": {"limits": limits}}]
+    )
+
+
+def test_filter_and_failed_bind_keep_cache_equal_to_oracle():
+    """End-to-end: filter bookings, a failed bind's unbook, and the ingest
+    sweep all flow through the cache deltas."""
+    c = FakeClient()
+    for n in ("a1", "a2"):
+        register_node(c, n, n_chips=2)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    for i in range(5):
+        pod = c.create_pod(tpu_pod(f"w{i}", pct=40))
+        res = s.filter(pod, ["a1", "a2"])
+        assert res.node in ("a1", "a2"), res.error
+    assert_cache_equals_oracle(s)
+    # failed bind: pod vanished between filter and bind → unbook
+    gone = c.create_pod(tpu_pod("gone", pct=40))
+    assert s.filter(gone, ["a1", "a2"]).node is not None
+    c.delete_pod("default", "gone")
+    assert s.bind("default", "gone", "a1", pod_uid=gone["metadata"]["uid"]) is not None
+    assert_cache_equals_oracle(s)
+    s.ingest_pods()
+    assert_cache_equals_oracle(s)
+
+
+def test_concurrent_filters_never_double_book_chip():
+    """16 threads race pct=60 pods at 4 single-chip nodes through the
+    lock-shrunk filter: exactly 4 may win (60+60 > 100 per chip), and no
+    chip may end over its capacity."""
+    c = FakeClient()
+    for i in range(4):
+        register_node(c, f"c{i}", n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    names = [f"c{i}" for i in range(4)]
+    pods = [c.create_pod(tpu_pod(f"r{i}", pct=60)) for i in range(16)]
+    results = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(16)
+
+    def run(p):
+        barrier.wait()
+        r = s.filter(p, names)
+        with lock:
+            results.append(r)
+
+    ts = [threading.Thread(target=run, args=(p,)) for p in pods]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    winners = [r for r in results if r.node is not None]
+    assert len(winners) == 4, [r.error for r in results]
+    # no double booking: every chip within capacity, cache == oracle
+    for nu in s.nodes_usage().values():
+        for d in nu.devices:
+            assert d.usedmem <= d.totalmem, d
+            assert d.used <= 1, d
+    assert_cache_equals_oracle(s)
+
+
+def test_fast_path_matches_general_path():
+    """score.evaluate_single (non-mutating fast path) must choose the same
+    device, memory grant, and score as fit_pod + score_node."""
+    rng = random.Random(42)
+    for policy in ("binpack", "spread"):
+        for _ in range(300):
+            devices = []
+            for i in range(rng.randint(1, 8)):
+                d = score_mod.DeviceUsage(
+                    uuid=f"chip-{i}",
+                    type=rng.choice(["TPU-v5e", "TPU-v4"]),
+                    health=rng.random() > 0.1,
+                    count=rng.choice([1, 10]),
+                    used=rng.randint(0, 2),
+                    totalmem=16384,
+                    usedmem=rng.choice([0, 4096, 12288, 16384]),
+                    totalcores=100,
+                    usedcores=rng.choice([0, 30, 100]),
+                    coords=None,
+                )
+                devices.append(d)
+            node = score_mod.NodeUsage(node="x", devices=devices)
+            req = ContainerDeviceRequest(
+                nums=1,
+                type="TPU",
+                memreq=rng.choice([0, 2048, 8192]),
+                mem_percentage=rng.choice([MEM_PERCENTAGE_UNSET, 25, 50]),
+                coresreq=rng.choice([0, 25, 100]),
+            )
+            annos = {}
+            fast_node = score_mod.NodeUsage(
+                node="x", devices=[d.clone() for d in devices]
+            )
+            ev = score_mod.evaluate_single(fast_node, req, annos, policy)
+            slow_node = score_mod.NodeUsage(
+                node="x", devices=[d.clone() for d in devices]
+            )
+            placement = score_mod.fit_pod(slow_node, [[req]], annos, policy)
+            if placement is None:
+                assert ev is None
+                continue
+            assert ev is not None
+            dev, mem, s = ev
+            assert dev.uuid == placement[0][0].uuid
+            assert mem == placement[0][0].usedmem
+            assert s == pytest.approx(
+                score_mod.score_node(slow_node, policy), rel=1e-9
+            )
+            # fast path never mutates its node
+            assert fast_node.devices == devices
+
+
+def test_pending_booking_survives_ingest_sweep():
+    """A filter's local booking whose annotation patch has not landed yet
+    must survive an informer sweep that sees the pod without
+    ASSIGNED_IDS (the lock-shrink window), then expire after the grace."""
+    s = Scheduler(client=None)
+    s.nodes.add_node("n1", [ChipInfo("n1-chip-0", 10, 16384, 100, "TPU-v5e", True)])
+    pod = {
+        "metadata": {"name": "pend", "namespace": "default", "uid": "uid-pend",
+                     "annotations": {}}
+    }
+    devices = [[ContainerDevice("n1-chip-0", "TPU", 4096, 25)]]
+    s.pods.add_pod(pod, "n1", devices, pending=True)
+    # sweep sees the bare pod (no assignment annos yet): booking survives
+    s.pods.ingest(pod)
+    assert "uid-pend" in s.pods.all_pods()
+    assert_cache_equals_oracle(s)
+    # after the grace expires the sweep reconciles the phantom away
+    s.pods.all_pods()["uid-pend"]  # still there
+    with s.pods._lock:
+        s.pods._pods["uid-pend"].pending_since -= 10_000
+    s.pods.ingest(pod)
+    assert "uid-pend" not in s.pods.all_pods()
+    assert_cache_equals_oracle(s)
+
+
+def test_failed_assignment_patch_unbooks():
+    """If the out-of-lock annotation patch fails, the local booking must
+    be reversed so the capacity is visible again."""
+
+    class FlakyClient(FakeClient):
+        def patch_pod_annotations(self, namespace, name, annos):
+            if name.startswith("doomed") and annotations.ASSIGNED_IDS in annos:
+                raise RuntimeError("apiserver unavailable")
+            return super().patch_pod_annotations(namespace, name, annos)
+
+    c = FlakyClient()
+    register_node(c, "f1", n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    doomed = c.create_pod(tpu_pod("doomed", pct=100))
+    res = s.filter(doomed, ["f1"])
+    assert res.node is None and "assignment patch" in res.error
+    assert_cache_equals_oracle(s)
+    # capacity is free again: the next pod takes the whole chip
+    nxt = c.create_pod(tpu_pod("next", pct=100))
+    assert s.filter(nxt, ["f1"]).node == "f1"
+    assert_cache_equals_oracle(s)
+
+
+def test_refilter_after_bind_failure_survives_ingest_sweep():
+    """A re-filter's assignment patch clears the stale bind-phase=failed
+    marker, so the informer sweep keeps the fresh booking instead of
+    dropping it until the bind retry."""
+    from vtpu.k8s.objects import get_annotations
+    from vtpu.utils.types import BindPhase
+
+    c = FakeClient()
+    register_node(c, "s1", n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    pod = c.create_pod(tpu_pod("retry", pct=100))
+    uid = pod["metadata"]["uid"]
+    assert s.filter(pod, ["s1"]).node == "s1"
+    # bind failure: failed marker lands on the wire, booking is dropped
+    c.patch_pod_annotations(
+        "default", "retry", {annotations.BIND_PHASE: BindPhase.FAILED}
+    )
+    s.pods.rm_pod(uid)
+    # kube-scheduler retries the filter
+    res = s.filter(c.get_pod("default", "retry"), ["s1"])
+    assert res.node == "s1", res.error
+    assert annotations.BIND_PHASE not in get_annotations(
+        c.get_pod("default", "retry")
+    )
+    s.ingest_pods()
+    assert uid in s.pods.all_pods()  # booking survived the sweep
+    assert_cache_equals_oracle(s)
+
+
+def test_rm_pod_if_pending_is_conditional():
+    """The patch-failure unbook must not delete a booking that a
+    concurrent re-filter superseded (different node, or confirmed)."""
+    s = Scheduler(client=None)
+    s.nodes.add_node("nB", [ChipInfo("nB-chip-0", 10, 16384, 100, "TPU-v5e", True)])
+    s.nodes.add_node("nC", [ChipInfo("nC-chip-0", 10, 16384, 100, "TPU-v5e", True)])
+    pod = {"metadata": {"name": "ha", "namespace": "default", "uid": "uid-ha",
+                        "annotations": {}}}
+    dev_c = [[ContainerDevice("nC-chip-0", "TPU", 4096, 25)]]
+    # the newer booking (node C) is live; a stale failure handler for the
+    # node-B attempt must be a no-op
+    s.pods.add_pod(pod, "nC", dev_c, pending=True)
+    s.pods.rm_pod_if_pending("uid-ha", "nB")
+    assert "uid-ha" in s.pods.all_pods()
+    # confirm is node-conditional too: a stale confirmation for node B
+    # must not clear the node-C booking's pending protection
+    s.pods.confirm_pod("uid-ha", "nB")
+    assert s.pods.all_pods()["uid-ha"].pending
+    # confirmed booking: even a same-node stale handler must not remove it
+    s.pods.confirm_pod("uid-ha", "nC")
+    s.pods.rm_pod_if_pending("uid-ha", "nC")
+    assert "uid-ha" in s.pods.all_pods()
+    # the genuine case: still pending on the same node → removed
+    s.pods.add_pod(pod, "nC", dev_c, pending=True)
+    s.pods.rm_pod_if_pending("uid-ha", "nC")
+    assert "uid-ha" not in s.pods.all_pods()
+    assert_cache_equals_oracle(s)
+
+
+def test_util_sum_fed_scoring_matches_recompute():
+    """The production fast path feeds evaluate_single the cache's
+    incrementally maintained util_sum (peek_entry's third element); after
+    a storm of bookings and reversals it must score identically to the
+    recompute-base fallback (base_util=None)."""
+    rng = random.Random(7)
+    s = Scheduler(client=None)
+    s.nodes.add_node(
+        "u1",
+        [ChipInfo(f"u1-chip-{i}", 10, 16384, 100, "TPU-v5e", True) for i in range(4)],
+    )
+    live_uids = []
+    for step in range(200):
+        if live_uids and rng.random() < 0.4:
+            s.pods.rm_pod(live_uids.pop(rng.randrange(len(live_uids))))
+        else:
+            uid = f"uid-u{step}"
+            pod = {"metadata": {"name": uid, "namespace": "default", "uid": uid,
+                                "annotations": {}}}
+            devs = [[ContainerDevice(f"u1-chip-{rng.randint(0, 3)}", "TPU",
+                                     rng.choice([512, 2048]), rng.choice([0, 10]))]]
+            s.pods.add_pod(pod, "u1", devs)
+            live_uids.append(uid)
+        req = ContainerDeviceRequest(
+            nums=1, type="TPU", memreq=1024,
+            mem_percentage=MEM_PERCENTAGE_UNSET, coresreq=5,
+        )
+        with s.usage_cache.locked():
+            nu, _gen, util_sum = s.usage_cache.peek_entry("u1")
+            fed = score_mod.evaluate_single(nu, req, {}, "binpack", util_sum)
+            recomputed = score_mod.evaluate_single(nu, req, {}, "binpack")
+        if fed is None:
+            assert recomputed is None
+            continue
+        assert fed[0].uuid == recomputed[0].uuid and fed[1] == recomputed[1]
+        assert fed[2] == pytest.approx(recomputed[2], rel=1e-9, abs=1e-12)
+
+
+def test_sync_pods_keeps_fresh_pending_booking():
+    """A booking made after the re-list snapshot was taken (absent from
+    the listed pods) must survive the full-reconcile sweep until its
+    patch grace expires."""
+    c = FakeClient()
+    register_node(c, "y1", n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    pod = {"metadata": {"name": "late", "namespace": "default", "uid": "uid-late",
+                        "annotations": {}}}
+    devices = [[ContainerDevice("y1-chip-0", "TPU", 4096, 25)]]
+    s.pods.add_pod(pod, "y1", devices, pending=True)
+    s.ingest_pods()  # re-list does not contain the pod
+    assert "uid-late" in s.pods.all_pods()
+    with s.pods._lock:
+        s.pods._pods["uid-late"].pending_since -= 10_000
+    s.ingest_pods()
+    assert "uid-late" not in s.pods.all_pods()
+    assert_cache_equals_oracle(s)
+
+
+def test_superseded_filter_does_not_patch_wire():
+    """Two filters of the same pod with out-of-lock patches: the one whose
+    booking was superseded must not write the wire — annotations always
+    converge to the latest local booking (same-pod patches serialise on
+    the per-uid lock; only booking_current patches)."""
+    from vtpu.k8s.objects import get_annotations
+
+    patch_started = threading.Event()
+    release_patch = threading.Event()
+
+    class SlowPatchClient(FakeClient):
+        def patch_pod_annotations(self, namespace, name, annos):
+            if name == "race" and annotations.ASSIGNED_IDS in annos and not release_patch.is_set():
+                patch_started.set()
+                release_patch.wait(10)
+            return super().patch_pod_annotations(namespace, name, annos)
+
+    c = SlowPatchClient()
+    register_node(c, "z1", n_chips=1)
+    register_node(c, "z2", n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    pod = c.create_pod(tpu_pod("race", pct=100))
+    uid = pod["metadata"]["uid"]
+    results = {}
+
+    def first():
+        results["t1"] = s.filter(pod, ["z1"])  # books z1, patch stalls
+
+    t1 = threading.Thread(target=first)
+    t1.start()
+    assert patch_started.wait(10)
+    # t1 is parked inside its patch, holding the per-pod patch lock with
+    # its booking still current.  Supersede it: drop the booking (bind
+    # failure path) and re-book via a second filter restricted to z2 —
+    # which must queue behind t1's patch, see t1's patch already landed,
+    # and then land its own LAST.
+    def second():
+        s.pods.rm_pod(uid)
+        results["t2"] = s.filter(c.get_pod("default", "race"), ["z2"])
+
+    t2 = threading.Thread(target=second)
+    t2.start()
+    release_patch.set()
+    t1.join(10)
+    t2.join(10)
+    assert results["t2"].node == "z2", results["t2"].error
+    # wire state converged to the latest booking (t2's), never t1's
+    annos = get_annotations(c.get_pod("default", "race"))
+    assert annos[annotations.ASSIGNED_NODE] == "z2"
+    pi = s.pods.all_pods()[uid]
+    assert pi.node == "z2" and not pi.pending
+    assert_cache_equals_oracle(s)
+
+
+def test_inspect_usage_served_from_cache_is_isolated():
+    """Metrics scrapes get clones — mutating the scrape result must not
+    corrupt the cache."""
+    c = FakeClient()
+    register_node(c, "m1", n_chips=2)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    view = s.inspect_usage()
+    view["m1"].devices[0].usedmem += 12345
+    assert_cache_equals_oracle(s)
+
+
+def test_bind_phase_failed_constant_drops_booking():
+    """state.py must compare against BindPhase.FAILED (satellite bugfix):
+    an ingested pod with bind-phase=failed holds no devices."""
+    s = Scheduler(client=None)
+    s.nodes.add_node("n1", [ChipInfo("n1-chip-0", 10, 16384, 100, "TPU-v5e", True)])
+    devices = [[ContainerDevice("n1-chip-0", "TPU", 4096, 25)]]
+    pod = {
+        "metadata": {
+            "name": "bf", "namespace": "default", "uid": "uid-bf",
+            "annotations": {
+                annotations.ASSIGNED_IDS: codec.encode_pod_devices(devices),
+                annotations.ASSIGNED_NODE: "n1",
+            },
+        }
+    }
+    s.pods.ingest(pod)
+    assert "uid-bf" in s.pods.all_pods()
+    pod["metadata"]["annotations"][annotations.BIND_PHASE] = "failed"
+    s.pods.ingest(pod)
+    assert "uid-bf" not in s.pods.all_pods()
+    assert_cache_equals_oracle(s)

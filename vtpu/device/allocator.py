@@ -23,6 +23,7 @@ NonConflictRingNum then compactness analogues):
 
 from __future__ import annotations
 
+import functools
 import logging
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
@@ -119,6 +120,37 @@ def _connected_greedy(
     return best
 
 
+@functools.lru_cache(maxsize=4096)
+def _best_rectangle(
+    topo: Topology,
+    size: int,
+    avail: FrozenSet[Coord],
+    must: FrozenSet[Coord],
+) -> Optional[FrozenSet[Coord]]:
+    """The winning ICI-contiguous rectangle for ``size`` chips out of
+    ``avail`` (containing every ``must`` coord), or None when no rectangle
+    fits.  Memoized on the full decision inputs — repeated gang filters
+    against an unchanged free-set (the common case while pods queue) stop
+    re-enumerating the torus.  The ICI policy is deliberately NOT part of
+    the key: policies only gate the *fallback* when no rectangle exists;
+    the rectangle ranking itself is policy-independent."""
+    candidates: List[Tuple[tuple, FrozenSet[Coord]]] = []
+    for offset, shape, coords in enumerate_rectangles(topo, size, avail):
+        if not must <= coords:
+            continue  # rectangle must contain every pinned chip
+        key = (
+            -ring_count(shape),
+            -compactness(shape),
+            -_frag_score(topo, avail - coords),
+            offset,
+        )
+        candidates.append((key, coords))
+    if not candidates:
+        return None
+    candidates.sort(key=lambda kc: kc[0])
+    return candidates[0][1]
+
+
 class IciAllocator:
     """Chooses which free chips a multi-chip container gets
     (ref: allocator.New dispatch, allocator.go:27-36)."""
@@ -162,21 +194,8 @@ class IciAllocator:
             return (must + sorted(coordless, key=lambda c: c.index))[:size]
 
         avail_coords = frozenset(by_coord)
-        candidates: List[Tuple[tuple, FrozenSet[Coord]]] = []
-        for offset, shape, coords in enumerate_rectangles(self.topo, size, avail_coords):
-            if not must_coords <= coords:
-                continue  # rectangle must contain every pinned chip
-            remaining = avail_coords - coords
-            key = (
-                -ring_count(shape),
-                -compactness(shape),
-                -_frag_score(self.topo, remaining),
-                offset,
-            )
-            candidates.append((key, coords))
-        if candidates:
-            candidates.sort(key=lambda kc: kc[0])
-            chosen = candidates[0][1]
+        chosen = _best_rectangle(self.topo, size, avail_coords, must_coords)
+        if chosen is not None:
             return [by_coord[c] for c in sorted(chosen)]
 
         # no rectangle fits

@@ -20,6 +20,7 @@ is what makes psum/all-gather ride ICI instead of DCN.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import itertools
 from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Tuple
 
@@ -72,12 +73,14 @@ class Topology:
 
     @classmethod
     def from_spec(cls, spec: str, wrap: Optional[Sequence[bool]] = None) -> "Topology":
-        dims = parse_topology(spec)
-        if wrap is None:
-            # torus links when a dim is large enough that Google closes the
-            # loop (full-pod dims); conservative default: no wrap
-            wrap = (False, False, False)
-        return cls(dims, tuple(wrap))  # type: ignore[arg-type]
+        # torus links when a dim is large enough that Google closes the
+        # loop (full-pod dims); conservative default: no wrap
+        wrap_t = (False, False, False) if wrap is None else tuple(wrap)
+        if cls is Topology:
+            # memoized: the scheduler parses the same handful of node
+            # topology specs once per node per filter (score._select_devices)
+            return _from_spec_cached(spec, wrap_t)  # type: ignore[arg-type]
+        return cls(parse_topology(spec), wrap_t)  # type: ignore[arg-type]
 
     @property
     def num_chips(self) -> int:
@@ -129,8 +132,16 @@ class Topology:
         return seen == todo
 
 
+@functools.lru_cache(maxsize=1024)
+def _from_spec_cached(spec: str, wrap: Tuple[bool, bool, bool]) -> "Topology":
+    return Topology(parse_topology(spec), wrap)
+
+
+@functools.lru_cache(maxsize=4096)
 def box_shapes(size: int, dims: Tuple[int, int, int]) -> List[Tuple[int, int, int]]:
-    """All (a,b,c) with a*b*c == size fitting inside ``dims``."""
+    """All (a,b,c) with a*b*c == size fitting inside ``dims``.  Memoized —
+    pure arithmetic on two small hashables, hit on every rectangle
+    enumeration."""
     shapes = set()
     for a in range(1, size + 1):
         if size % a:

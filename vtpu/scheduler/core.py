@@ -20,11 +20,13 @@ from vtpu.scheduler import score as score_mod
 from vtpu.scheduler.config import SchedulerConfig
 from vtpu.scheduler.score import DeviceUsage, NodeUsage
 from vtpu.scheduler.state import NodeManager, PodManager
+from vtpu.scheduler.usage_cache import UsageCache
 from vtpu.utils import codec, trace
 from vtpu.utils.nodelock import lock_node, release_node_lock
 from vtpu.utils.resources import resource_reqs
 from vtpu.utils.types import (
     BindPhase,
+    ContainerDevice,
     HANDSHAKE_TIMEOUT_S,
     HandshakeState,
     KNOWN_DEVICES,
@@ -68,11 +70,39 @@ class Scheduler:
         self.config = config or SchedulerConfig()
         self.nodes = NodeManager()
         self.pods = PodManager()
+        # incremental usage aggregates: every node/pod mutation is pushed
+        # as a delta, so the filter never re-aggregates the whole cluster
+        # (the old nodes_usage() walk is kept below as the slow oracle)
+        self.usage_cache = UsageCache()
+        self.nodes.add_listener(self.usage_cache)
+        self.pods.add_listener(self.usage_cache)
         self._stop = threading.Event()
-        # serialises the snapshot→select→book critical section: concurrent
-        # /filter requests (HA schedulers, parallel binds) must not both see
-        # the same chip as free
+        # serialises the select→book critical section: concurrent /filter
+        # requests (HA schedulers, parallel binds) must not both see the
+        # same chip as free.  The assignment-annotation PATCH (an API
+        # round-trip) runs OUTSIDE this lock — booking happens locally
+        # first, and a failed patch unbooks.
         self._filter_lock = threading.Lock()
+        # commits that re-ran selection because a background registry/pod
+        # event changed the chosen node mid-filter (exported on /metrics)
+        self.filter_gen_retries = 0
+        # serialises the out-of-lock assignment patch PER POD: concurrent
+        # re-filters of the same pod must land their patches in booking
+        # order (different pods patch in parallel — the perf point of the
+        # lock shrink).  {uid: [lock, refcount]}; entries are reclaimed
+        # when the last holder releases.
+        self._patch_locks: Dict[str, list] = {}
+        self._patch_locks_guard = threading.Lock()
+        # per-request-shape memo over single-chip evaluations:
+        # {request key: {node: (generation, (uuid, mem, score) | None)}}.
+        # A deployment burst submits identical pods; between two filters
+        # only the booked node's generation moves, so the other N-1
+        # candidate evaluations replay as dict lookups.  Generations are
+        # cache-wide unique (never reused), which makes gen-equality a
+        # sound validity test.  Serialised by _filter_lock (the outer-dict
+        # lookup/eviction runs before the cache lock is taken): any new
+        # consumer must hold _filter_lock, not just the cache lock.
+        self._single_eval_memo: Dict[tuple, Dict[str, tuple]] = {}
         # node objects cached by the 15 s registry poll — node-validity
         # checks read these instead of issuing per-Filter API GETs
         self._node_objs: Dict[str, dict] = {}
@@ -126,9 +156,10 @@ class Scheduler:
         for pod in pods:
             seen.add(pod_uid(pod))
             self.pods.ingest(pod)
-        for uid in list(self.pods.all_pods()):
-            if uid not in seen:
-                self.pods.rm_pod(uid)
+        # grace-aware: a booking made by a filter after this re-list
+        # snapshot was taken is absent from `seen` but must survive
+        # until its assignment patch lands
+        self.pods.prune_absent(seen)
 
     def ingest_pods(self) -> None:
         """Informer-lite: rebuild pod assignment state (ref onAddPod/onDelPod
@@ -235,7 +266,13 @@ class Scheduler:
         """Aggregate registry totals minus per-pod bookings.  ``exclude_uid``
         drops one pod's own booking — a pod being *re*-filtered after a bind
         failure must not see its previous assignment as occupancy, or it can
-        never be rescheduled."""
+        never be rescheduled.
+
+        This is the SLOW REFERENCE path (O(nodes × chips + pods ×
+        devices), ref getNodesUsage scheduler.go:348-400).  The filter and
+        metrics serve from ``self.usage_cache`` instead; this rebuild is
+        kept as the equivalence oracle the cache is tested against
+        (tests/test_usage_cache.py)."""
         usage: Dict[str, NodeUsage] = {}
         for name, info in self.nodes.all_nodes().items():
             usage[name] = NodeUsage(
@@ -261,10 +298,14 @@ class Scheduler:
         return usage
 
     def inspect_usage(self) -> Dict[str, NodeUsage]:
-        """Fresh aggregation for metrics scrapes (ref InspectAllNodesUsage).
-        Always recomputed: a cached snapshot taken mid-filter (with a pod's
-        own booking excluded) would under-report until the next filter."""
-        return self.nodes_usage()
+        """Usage view for metrics scrapes (ref InspectAllNodesUsage),
+        served from the incremental cache: an O(nodes × chips) clone of
+        the maintained aggregates, never the O(cluster × pods)
+        re-aggregation — a Prometheus scrape must not contend with
+        /filter for seconds at 1000 nodes.  The cache never holds
+        mid-filter exclusions (``exclude_uid`` is applied to per-call
+        clones only), so the view cannot under-report."""
+        return self.usage_cache.inspect()
 
     # ------------------------------------------------------------------
     # Filter (ref Filter scheduler.go:444-492 + calcScore walk)
@@ -292,73 +333,232 @@ class Scheduler:
             nodes=len(node_names),
         ) as sp:
             with self._filter_lock:
-                res = self._filter_locked(pod, node_names, reqs, pod_annos, node_objs)
+                res, enc = self._select_and_book(
+                    pod, node_names, reqs, pod_annos, node_objs
+                )
+            if res.node is not None and enc is not None:
+                # the API round-trip runs OUTSIDE the filter lock: the
+                # booking is already visible locally, so concurrent
+                # filters see the usage while this patch is in flight.
+                # Same-pod patches serialise on a per-uid lock and only
+                # the still-current booking writes the wire, so annotation
+                # state always converges to the latest local booking.
+                uid = pod_uid(pod)
+                plock = self._acquire_patch_lock(uid)
+                try:
+                    if not self.pods.booking_current(uid, res.node):
+                        pi = self.pods.all_pods().get(uid)
+                        if pi is not None and pi.node == res.node:
+                            # an ingest replay of the wire's own assignment
+                            # state replaced the pending booking for the
+                            # same node: already durable, nothing to patch
+                            pass
+                        else:
+                            # a concurrent re-filter superseded this
+                            # booking; its patch (behind the same lock) is
+                            # the valid one
+                            res = FilterResult(
+                                None,
+                                res.failed,
+                                "assignment superseded by concurrent re-filter",
+                            )
+                    else:
+                        try:
+                            self.client.patch_pod_annotations(
+                                pod["metadata"].get("namespace", "default"),
+                                pod["metadata"]["name"],
+                                {
+                                    annotations.ASSIGNED_NODE: res.node,
+                                    annotations.ASSIGNED_TIME: _now_ts(),
+                                    annotations.ASSIGNED_IDS: enc,
+                                    annotations.DEVICES_TO_ALLOCATE: enc,
+                                    # a fresh assignment supersedes any stale
+                                    # bind-phase from a previous failed
+                                    # attempt — left in place it would make
+                                    # the ingest sweep drop this booking
+                                    # (merge-patch null deletes)
+                                    annotations.BIND_PHASE: None,
+                                },
+                            )
+                        except Exception as e:  # noqa: BLE001 — unbook
+                            log.exception(
+                                "filter: assignment patch failed for %s; "
+                                "unbooking",
+                                pod["metadata"]["name"],
+                            )
+                            # conditional: only the booking THIS filter
+                            # made (still pending, same node)
+                            self.pods.rm_pod_if_pending(uid, res.node)
+                            res = FilterResult(
+                                None, res.failed, f"assignment patch: {e}"
+                            )
+                        else:
+                            self.pods.confirm_pod(uid, res.node)
+                finally:
+                    self._release_patch_lock(uid, plock)
             sp["node"] = res.node
             sp["failed"] = len(res.failed)
             return res
 
-    def _filter_locked(
+    def _acquire_patch_lock(self, uid: str):
+        with self._patch_locks_guard:
+            ent = self._patch_locks.get(uid)
+            if ent is None:
+                ent = self._patch_locks[uid] = [threading.Lock(), 0]
+            ent[1] += 1
+        ent[0].acquire()
+        return ent
+
+    def _release_patch_lock(self, uid: str, ent) -> None:
+        ent[0].release()
+        with self._patch_locks_guard:
+            ent[1] -= 1
+            if ent[1] <= 0:
+                self._patch_locks.pop(uid, None)
+
+    def _select_and_book(
         self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs=None
-    ) -> FilterResult:
-        usage = self.nodes_usage(exclude_uid=pod_uid(pod))
-        # fit_pod books into the per-call usage objects, so each node
-        # must be evaluated at most once — a duplicate entry would see
-        # (and double-count) the first evaluation's bookings
+    ) -> Tuple[FilterResult, Optional[str]]:
+        """Candidate walk over the incremental usage cache + local booking.
+        Holds only in-memory locks; returns (result, encoded placement —
+        None unless a booking was made).  Caller patches the assignment
+        annotations outside the filter lock and unbooks on patch failure."""
+        uid = pod_uid(pod)
+        # each node must be evaluated at most once — a duplicate entry
+        # would see (and double-count) the first evaluation's bookings
         node_names = list(dict.fromkeys(node_names))
         ici_policy = pod_annos.get("vtpu.io/ici-policy", self.config.ici_policy)
-        best: Optional[Tuple[float, str, object]] = None
-        failed: Dict[str, str] = {}
-        for name in node_names:
-            if self.config.node_validity_check:
-                node_obj = (node_objs or {}).get(name) or self._node_objs.get(name)
-                reason = nodecheck.check_node_validity(pod, node_obj)
-                if reason is not None:
-                    failed[name] = reason
-                    continue
-            nu = usage.get(name)
-            if nu is None:
-                failed[name] = "no vtpu devices registered"
-                continue
-            # nodes_usage() built nu fresh for THIS filter call, so
-            # fit_pod may book into it directly — a second defensive
-            # snapshot copy per node doubled the hot loop's copy cost
-            # (each node is evaluated once; a rejected node's partial
-            # bookings are never read again)
-            placement = score_mod.fit_pod(
-                nu, reqs, pod_annos, self.config.node_scheduler_policy, ici_policy
+        policy = self.config.node_scheduler_policy
+        # fast path: one container, one chip share — the dominant request
+        # shape — is evaluated against the LIVE cache aggregates without
+        # per-node clones (score.evaluate_single never mutates)
+        single = len(reqs) == 1 and len(reqs[0]) == 1 and reqs[0][0].nums == 1
+        cache = self.usage_cache
+        memo: Optional[Dict[str, tuple]] = None
+        if single:
+            req0 = reqs[0][0]
+            req_key = (
+                policy,
+                req0.type,
+                req0.memreq,
+                req0.mem_percentage,
+                req0.coresreq,
+                pod_annos.get(annotations.USE_TPUTYPE, ""),
+                pod_annos.get(annotations.NOUSE_TPUTYPE, ""),
             )
-            if placement is None:
-                failed[name] = "insufficient vtpu resources"
-                continue
-            s = score_mod.score_node(nu, self.config.node_scheduler_policy)
-            if best is None or s > best[0]:
-                best = (s, name, placement)
-        if best is None:
-            return FilterResult(None, failed, "no node fits vtpu request")
-        s, chosen, placement = best
-        enc = codec.encode_pod_devices(placement)  # type: ignore[arg-type]
-        self.client.patch_pod_annotations(
-            pod["metadata"].get("namespace", "default"),
-            pod["metadata"]["name"],
-            {
-                annotations.ASSIGNED_NODE: chosen,
-                annotations.ASSIGNED_TIME: _now_ts(),
-                annotations.ASSIGNED_IDS: enc,
-                annotations.DEVICES_TO_ALLOCATE: enc,
-            },
+            memo = self._single_eval_memo.get(req_key)
+            if memo is None:
+                if len(self._single_eval_memo) >= 8:
+                    # bounded: drop the oldest request shape (dict order)
+                    self._single_eval_memo.pop(
+                        next(iter(self._single_eval_memo))
+                    )
+                memo = self._single_eval_memo[req_key] = {}
+        check = (
+            nodecheck.make_checker(pod) if self.config.node_validity_check else None
         )
+        node_objs = node_objs or {}
+        poll_objs = self._node_objs
+        # best: (score, node, placement-or-(device, mem), generation)
+        best: Optional[Tuple[float, str, object, int]] = None
+        failed: Dict[str, str] = {}
+        for attempt in (0, 1):
+            best = None
+            failed = {}
+            with cache.locked():
+                # the pod's own node (re-filter after a bind failure) must
+                # not see its previous assignment as occupancy — that one
+                # node takes the clone-with-exclusion path
+                own_node = cache.pod_node(uid)
+                for name in node_names:
+                    if check is not None:
+                        reason = check(node_objs.get(name) or poll_objs.get(name))
+                        if reason is not None:
+                            failed[name] = reason
+                            continue
+                    if single and name != own_node:
+                        entry = cache.peek_entry(name)
+                        if entry is None:
+                            failed[name] = "no vtpu devices registered"
+                            continue
+                        nu, gen, base_util = entry
+                        m = memo.get(name)  # type: ignore[union-attr]
+                        if m is not None and m[0] == gen:
+                            res = m[1]
+                        else:
+                            ev = score_mod.evaluate_single(
+                                nu, reqs[0][0], pod_annos, policy, base_util
+                            )
+                            res = (
+                                None
+                                if ev is None
+                                else (ev[0].uuid, ev[1], ev[2])
+                            )
+                            memo[name] = (gen, res)  # type: ignore[index]
+                        if res is None:
+                            failed[name] = "insufficient vtpu resources"
+                            continue
+                        dev_uuid, mem, s = res
+                        payload: object = (dev_uuid, mem)
+                    else:
+                        nu, gen = cache.clone_node(name, exclude_uid=uid)
+                        if nu is None:
+                            failed[name] = "no vtpu devices registered"
+                            continue
+                        payload = score_mod.fit_pod(
+                            nu, reqs, pod_annos, policy, ici_policy
+                        )
+                        if payload is None:
+                            failed[name] = "insufficient vtpu resources"
+                            continue
+                        s = score_mod.score_node(nu, policy)
+                    if best is None or s > best[0]:
+                        best = (s, name, payload, gen)
+            if best is None:
+                return FilterResult(None, failed, "no node fits vtpu request"), None
+            # generation check: a background registry/pod event may have
+            # changed the chosen node between evaluation and now (the
+            # cache lock is released before booking to keep lock order
+            # manager→cache everywhere).  On mismatch, re-run selection
+            # once; a second mismatch books anyway — the filter lock
+            # serialises peers, and the annotation bus reconciles.
+            if attempt == 0 and cache.generation(best[1]) != best[3]:
+                self.filter_gen_retries += 1
+                continue
+            break
+        s, chosen, payload, _gen = best  # type: ignore[misc]
+        if isinstance(payload, tuple):
+            # fast path defers placement construction to the winner —
+            # loser candidates never allocate
+            dev_uuid, mem = payload
+            req0 = reqs[0][0]
+            placement = [
+                [
+                    ContainerDevice(
+                        uuid=dev_uuid,
+                        type=req0.type,
+                        usedmem=mem,
+                        usedcores=req0.coresreq,
+                    )
+                ]
+            ]
+        else:
+            placement = payload
+        enc = codec.encode_pod_devices(placement)  # type: ignore[arg-type]
         # pessimistic booking so concurrent filters see the usage
-        # (ref score.go writes assignment then books usage)
+        # (ref score.go writes assignment then books usage); pending=True
+        # keeps the booking alive through informer sweeps until the
+        # annotation patch lands (state.PENDING_PATCH_GRACE_S)
         fresh = dict(pod)
         fresh_annos = dict(get_annotations(pod))
         fresh_annos[annotations.ASSIGNED_IDS] = enc
         fresh_annos[annotations.ASSIGNED_NODE] = chosen
         fresh["metadata"] = dict(pod["metadata"], annotations=fresh_annos)
-        self.pods.add_pod(fresh, chosen, placement)  # type: ignore[arg-type]
+        self.pods.add_pod(fresh, chosen, placement, pending=True)  # type: ignore[arg-type]
         log.info(
             "filter: pod %s → node %s (score %.3f)", pod["metadata"]["name"], chosen, s
         )
-        return FilterResult(node=chosen, failed=failed, error="")
+        return FilterResult(node=chosen, failed=failed, error=""), enc
 
     # ------------------------------------------------------------------
     # Bind (ref Bind scheduler.go:402-442)

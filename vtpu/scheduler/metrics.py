@@ -127,4 +127,50 @@ def render_metrics(sched: Scheduler) -> str:
         "Per-pod per-device scheduled core share (ref vGPUCorePercentage)",
         pod_cores,
     )
+
+    # incremental usage-cache health (docs/scheduler_perf.md): a rising
+    # fallback/dirty-rebuild rate means deltas are being invalidated and
+    # filters are paying rebuild cost again
+    def counter(name: str, help_: str, value) -> None:
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {value}")
+
+    cache = sched.usage_cache.stats()
+    counter(
+        "vtpu_usage_cache_hits_total",
+        "Filter/metrics reads served from a clean cached node aggregate",
+        cache["hits"],
+    )
+    counter(
+        "vtpu_usage_cache_dirty_rebuilds_total",
+        "Lazy per-node rebuilds after a registry change or delta fallback",
+        cache["dirty_rebuilds"],
+    )
+    counter(
+        "vtpu_usage_cache_delta_updates_total",
+        "O(delta) booking applications/reversals on cached aggregates",
+        cache["delta_updates"],
+    )
+    counter(
+        "vtpu_usage_cache_fallbacks_total",
+        "Events that forced a node dirty (e.g. booking on an unknown uuid)",
+        cache["fallbacks"],
+    )
+    counter(
+        "vtpu_usage_cache_misses_total",
+        "Usage lookups for nodes the cache does not track",
+        cache["misses"],
+    )
+    gauge(
+        "vtpu_usage_cache_tracked",
+        "Entities tracked by the usage cache",
+        [({"kind": "nodes"}, cache["nodes"]),
+         ({"kind": "bookings"}, cache["bookings"])],
+    )
+    counter(
+        "vtpu_filter_generation_retries_total",
+        "Filter selections re-run because the chosen node changed mid-walk",
+        sched.filter_gen_retries,
+    )
     return "\n".join(lines) + "\n"

@@ -120,6 +120,47 @@ def tolerates_node_taints(pod: dict, node: dict) -> bool:
     return True
 
 
+def make_checker(pod: dict):
+    """Precompiled :func:`check_node_validity` for one pod.  The filter
+    runs the validity check once per candidate node per pending pod, but
+    the pod-side inputs (selector / affinity / tolerations) never change
+    within a call — hoist them so the common pod (no selector, no
+    affinity) costs two dict lookups per node instead of the full walk.
+    Must stay behaviourally identical to :func:`check_node_validity`."""
+    spec = pod.get("spec") or {}
+    selector = spec.get("nodeSelector") or {}
+    affinity = ((spec.get("affinity") or {}).get("nodeAffinity") or {}).get(
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    )
+    terms = (affinity.get("nodeSelectorTerms") or []) if affinity else []
+    tolerations = spec.get("tolerations") or []
+
+    def check(node: Optional[dict]) -> Optional[str]:
+        if node is None:
+            return None  # unknown node passes, as in check_node_validity
+        node_spec = node.get("spec") or {}
+        if node_spec.get("unschedulable", False):
+            return "node is unschedulable (cordoned)"
+        if selector or terms:
+            labels = (node.get("metadata") or {}).get("labels") or {}
+            if selector and not all(
+                labels.get(k) == v for k, v in selector.items()
+            ):
+                return "pod nodeSelector does not match node labels"
+            if terms and not any(
+                _match_selector_term(labels, t, node) for t in terms
+            ):
+                return "pod nodeAffinity does not match node"
+        for taint in node_spec.get("taints") or []:
+            if taint.get("effect") not in ("NoSchedule", "NoExecute"):
+                continue
+            if not _tolerates(tolerations, taint):
+                return "pod does not tolerate node taints"
+        return None
+
+    return check
+
+
 def check_node_validity(pod: dict, node: Optional[dict]) -> Optional[str]:
     """Returns a failure reason, or None when the node passes.  A missing
     node object passes — the extender may know nodes only from the

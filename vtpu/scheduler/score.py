@@ -205,6 +205,86 @@ def _select_devices(
     return fitting[: req.nums]
 
 
+def evaluate_single(
+    node: NodeUsage,
+    req: ContainerDeviceRequest,
+    pod_annos: Dict[str, str],
+    policy: str = "binpack",
+    base_util: Optional[float] = None,
+) -> Optional[Tuple[DeviceUsage, int, float]]:
+    """Single-container single-chip fast path: the common request shape
+    (one container, one chip share) needs no booking simulation, so the
+    filter can evaluate it against the LIVE usage-cache aggregate without
+    cloning a NodeUsage per candidate node.  Returns ``(device, mem MiB,
+    post-booking score)`` — the same choice and score the ``fit_pod`` +
+    ``score_node`` pair would produce — and never mutates ``node``.
+
+    ``base_util`` is the node's pre-booking utilisation sum
+    (Σ usedmem/totalmem + usedcores/totalcores over devices), maintained
+    incrementally by the usage cache; when None it is recomputed here.
+    The device gates are ``fits_device`` inlined (hot loop: one call per
+    device per candidate node per pending pod) — keep the two in sync.
+
+    Must stay behaviourally identical to ``_select_devices`` (nums == 1
+    branch) + ``_book`` + ``score_node`` — tests/test_usage_cache.py
+    cross-checks the two paths."""
+    sign = -1 if policy == "binpack" else 1
+    use = pod_annos.get(annotations.USE_TPUTYPE, "")
+    nouse = pod_annos.get(annotations.NOUSE_TPUTYPE, "")
+    req_type = req.type
+    coresreq = req.coresreq
+    exclusive = coresreq >= 100
+    memreq = req.memreq
+    pct = req.mem_percentage
+    if pct == MEM_PERCENTAGE_UNSET:
+        pct = 100
+    type_ok: Dict[str, bool] = {}
+    best: Optional[DeviceUsage] = None
+    best_key: Optional[tuple] = None
+    best_mem = 0
+    compute_base = base_util is None
+    base = 0.0 if compute_base else base_util
+    for d in node.devices:
+        totalmem = d.totalmem
+        usedmem = d.usedmem
+        usedcores = d.usedcores
+        if compute_base:
+            base += (usedmem / max(totalmem, 1)) + (
+                usedcores / max(d.totalcores, 1)
+            )
+        # fits_device, inlined in the same gate order
+        if not d.health:
+            continue
+        if d.used >= d.count:
+            continue
+        if usedcores >= 100:
+            continue
+        ok = type_ok.get(d.type)
+        if ok is None:
+            ok = _type_allowed(d.type, req_type, use, nouse)
+            type_ok[d.type] = ok
+        if not ok:
+            continue
+        if exclusive and (d.used > 0 or usedcores > 0 or usedmem > 0):
+            continue
+        mem = memreq if memreq > 0 else totalmem * pct // 100
+        if totalmem - usedmem < mem:
+            continue
+        if d.totalcores - usedcores < coresreq:
+            continue
+        key = (sign * (usedmem / max(totalmem, 1)), sign * d.used, d.uuid)
+        if best_key is None or key < best_key:
+            best, best_key, best_mem = d, key, mem
+    if best is None:
+        return None
+    util = (
+        base
+        + (best_mem / max(best.totalmem, 1))
+        + (coresreq / max(best.totalcores, 1))
+    ) / (2 * len(node.devices))
+    return best, best_mem, (util if policy == "binpack" else 1.0 - util)
+
+
 def fit_pod(
     node: NodeUsage,
     requests: List[List[ContainerDeviceRequest]],

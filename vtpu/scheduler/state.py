@@ -1,15 +1,31 @@
 """Node and pod state managers (ref: pkg/scheduler/nodes.go, pods.go —
-mutex-guarded maps rebuilt from the annotation bus)."""
+mutex-guarded maps rebuilt from the annotation bus).
+
+Both managers accept *listeners* (the incremental usage cache,
+vtpu/scheduler/usage_cache.py): every mutation is pushed as a delta while
+the manager lock is held, so the listener observes events in exactly the
+order the manager state changed.  Listeners must treat their own lock as
+innermost (never call back into a manager from a notification).
+"""
 
 from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Dict, List, Optional
 
 from vtpu.k8s.objects import get_annotations, pod_uid
 from vtpu.utils import codec
-from vtpu.utils.types import ChipInfo, PodDevices, annotations
+from vtpu.utils.types import BindPhase, ChipInfo, PodDevices, annotations
+
+# A filter books locally before the assignment-annotation patch lands on
+# the API server (the patch runs outside the filter lock).  Until the
+# patch is visible, an informer re-list would see the pod without
+# ASSIGNED_IDS and wrongly drop the local booking — the pending grace
+# keeps it alive for the in-flight window (a crashed patch is reconciled
+# once the grace expires).
+PENDING_PATCH_GRACE_S = 30.0
 
 
 @dataclasses.dataclass
@@ -30,6 +46,9 @@ class PodInfo:
     uid: str
     node: str
     devices: PodDevices
+    # True while the filter's local booking awaits its annotation patch
+    pending: bool = False
+    pending_since: float = 0.0
 
 
 class NodeManager:
@@ -38,6 +57,13 @@ class NodeManager:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._nodes: Dict[str, NodeInfo] = {}
+        self._listeners: list = []
+
+    def add_listener(self, listener) -> None:
+        """``listener`` gets on_node_changed(name, devices, topology) /
+        on_node_removed(name) calls under the manager lock."""
+        with self._lock:
+            self._listeners.append(listener)
 
     def add_node(
         self,
@@ -54,6 +80,7 @@ class NodeManager:
             if info is None:
                 info = NodeInfo(name, [], topology)
                 self._nodes[name] = info
+            old_devices, old_topology = info.devices, info.topology
             if topology:
                 info.topology = topology
             info.by_source[source] = [d.clone() for d in devices]
@@ -72,13 +99,22 @@ class NodeManager:
                 if not kept:
                     info.by_source.pop(src, None)
             info.devices = [d for devs in info.by_source.values() for d in devs]
+            # plugins re-report every 30 s; an unchanged registration must
+            # not dirty the usage cache entry (ChipInfo is a dataclass, so
+            # == is a field-wise compare)
+            if info.devices == old_devices and info.topology == old_topology:
+                return
+            for li in self._listeners:
+                li.on_node_changed(name, info.devices, info.topology)
 
     def rm_node_devices(self, name: str, source: Optional[str] = None) -> None:
         """Expel one family's devices (handshake timeout is per-vendor) or
         the whole node when ``source`` is None."""
         with self._lock:
             if source is None:
-                self._nodes.pop(name, None)
+                if self._nodes.pop(name, None) is not None:
+                    for li in self._listeners:
+                        li.on_node_removed(name)
                 return
             info = self._nodes.get(name)
             if info is None:
@@ -87,6 +123,11 @@ class NodeManager:
             info.devices = [d for devs in info.by_source.values() for d in devs]
             if not info.devices:
                 self._nodes.pop(name, None)
+                for li in self._listeners:
+                    li.on_node_removed(name)
+            else:
+                for li in self._listeners:
+                    li.on_node_changed(name, info.devices, info.topology)
 
     def get(self, name: str) -> Optional[NodeInfo]:
         with self._lock:
@@ -105,20 +146,88 @@ class PodManager:
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._pods: Dict[str, PodInfo] = {}
+        self._listeners: list = []
 
-    def add_pod(self, pod: dict, node: str, devices: PodDevices) -> None:
+    def add_listener(self, listener) -> None:
+        """``listener`` gets on_pod_changed(uid, node, devices) /
+        on_pod_removed(uid) calls under the manager lock."""
         with self._lock:
-            self._pods[pod_uid(pod)] = PodInfo(
+            self._listeners.append(listener)
+
+    def add_pod(
+        self, pod: dict, node: str, devices: PodDevices, pending: bool = False
+    ) -> None:
+        with self._lock:
+            uid = pod_uid(pod)
+            prev = self._pods.get(uid)
+            self._pods[uid] = PodInfo(
                 namespace=pod["metadata"].get("namespace", "default"),
                 name=pod["metadata"]["name"],
-                uid=pod_uid(pod),
+                uid=uid,
                 node=node,
                 devices=devices,
+                pending=pending,
+                pending_since=time.monotonic() if pending else 0.0,
             )
+            # the steady-state poll re-ingests every pod each sweep; an
+            # unchanged booking needs no cache delta
+            if prev is not None and prev.node == node and prev.devices == devices:
+                return
+            for li in self._listeners:
+                li.on_pod_changed(uid, node, devices)
+
+    def confirm_pod(self, uid: str, node: str) -> None:
+        """The filter's assignment patch for ``node`` landed: that booking
+        is durable on the annotation bus, so the ingest guard no longer
+        applies.  Conditional like :meth:`rm_pod_if_pending`: a concurrent
+        re-filter may have superseded the booking with one (for another
+        node) whose own patch is still in flight — its pending protection
+        must not be cleared by this filter's confirmation."""
+        with self._lock:
+            pi = self._pods.get(uid)
+            if pi is not None and pi.node == node:
+                pi.pending = False
+
+    def prune_absent(self, seen_uids) -> None:
+        """Full-reconcile sweep: drop every tracked pod not in
+        ``seen_uids``, except fresh pending bookings — a pod booked by a
+        filter after the re-list snapshot was taken must survive until
+        its assignment patch lands (same grace as :meth:`ingest`)."""
+        with self._lock:
+            now = time.monotonic()
+            for uid in list(self._pods):
+                if uid in seen_uids:
+                    continue
+                pi = self._pods[uid]
+                if pi.pending and now - pi.pending_since < PENDING_PATCH_GRACE_S:
+                    continue
+                self.rm_pod(uid)
 
     def rm_pod(self, uid: str) -> None:
         with self._lock:
-            self._pods.pop(uid, None)
+            if self._pods.pop(uid, None) is not None:
+                for li in self._listeners:
+                    li.on_pod_removed(uid)
+
+    def booking_current(self, uid: str, node: str) -> bool:
+        """Whether the pending booking for ``node`` is still the pod's
+        live one.  The filter re-checks this under its per-pod patch lock
+        before writing assignment annotations: a booking superseded by a
+        concurrent re-filter must not patch the wire (the superseding
+        filter's own patch — serialized behind the same per-pod lock —
+        is the one that has to land last)."""
+        with self._lock:
+            pi = self._pods.get(uid)
+            return pi is not None and pi.pending and pi.node == node
+
+    def rm_pod_if_pending(self, uid: str, node: str) -> None:
+        """Remove the booking only if it is still the pending one made for
+        ``node`` — the filter's patch-failure path must not delete a newer
+        booking from a concurrent re-filter whose own patch succeeded."""
+        with self._lock:
+            pi = self._pods.get(uid)
+            if pi is not None and pi.pending and pi.node == node:
+                self.rm_pod(uid)
 
     def all_pods(self) -> Dict[str, PodInfo]:
         with self._lock:
@@ -134,16 +243,36 @@ class PodManager:
         )
         phase = pod.get("status", {}).get("phase", "")
         bind_phase = annos.get(annotations.BIND_PHASE, "")
-        # bind-failed pods hold no devices — keeping their booking would
-        # phantom-occupy the node while kube-scheduler backs the pod off
-        if not enc or not node or phase in ("Succeeded", "Failed") or (
-            bind_phase == "failed"
+        # bind-failed and terminal pods hold no devices — keeping their
+        # booking would phantom-occupy the node while kube-scheduler backs
+        # the pod off
+        devices = None
+        if (
+            enc
+            and node
+            and phase not in ("Succeeded", "Failed")
+            and bind_phase != BindPhase.FAILED
         ):
-            self.rm_pod(pod_uid(pod))
-            return
-        try:
-            devices = codec.decode_pod_devices(enc)
-        except ValueError:
-            self.rm_pod(pod_uid(pod))
+            try:
+                devices = codec.decode_pod_devices(enc)
+            except ValueError:
+                devices = None
+        if devices is None:
+            # the wire says no booking — but a fresh local booking whose
+            # assignment patch is still in flight must survive the sweep
+            # (the observed pod object may predate the patch, including a
+            # stale bind-phase=failed from a previous attempt that the
+            # patch clears).  Check and removal stay under one lock hold:
+            # a booking made between them would otherwise be deleted
+            # despite the grace.
+            with self._lock:
+                pi = self._pods.get(pod_uid(pod))
+                if (
+                    pi is not None
+                    and pi.pending
+                    and time.monotonic() - pi.pending_since < PENDING_PATCH_GRACE_S
+                ):
+                    return
+                self.rm_pod(pod_uid(pod))
             return
         self.add_pod(pod, node, devices)

@@ -1,0 +1,270 @@
+"""Incrementally maintained per-node usage aggregates.
+
+The reference recomputes the whole ``getNodesUsage`` view on every Filter
+call (scheduler.go:348-400) — O(nodes × chips + pods × devices) inside the
+filter lock.  This module replaces that rebuild with a materialized view:
+
+- ``NodeManager``/``PodManager`` push every mutation into the cache
+  (``on_node_changed``/``on_node_removed``/``on_pod_changed``/
+  ``on_pod_removed``), so the aggregates are maintained by O(delta) work at
+  event time instead of O(cluster) work at filter time.
+- The cache is *event-sourced*: it keeps its own copy of each node's
+  registered chips and each pod's bookings, and never reads back into the
+  managers — notifications fire while the manager lock is held, which
+  guarantees the event order matches the manager state without any
+  cross-lock ordering between managers and cache (the cache lock is always
+  innermost).
+- Per-node **generation counter**: bumped on every mutation that touches
+  the node.  Registry changes (device totals) mark the node **dirty**
+  (``usage = None``); the aggregate is lazily rebuilt from the cache's own
+  chip list + booking replay on next access.  A booking that references an
+  unknown device uuid also marks the node dirty — the rebuild then skips
+  the orphan exactly like the slow-path oracle (``Scheduler.nodes_usage``),
+  so the two stay field-for-field equal (tests/test_usage_cache.py).
+- ``clone_node`` hands the filter an isolated copy (clone-on-first-touch —
+  only candidate nodes the filter actually evaluates are copied);
+  ``peek_entry`` exposes the live aggregate for the non-mutating
+  single-request fast path (vtpu/scheduler/score.py:evaluate_single).
+
+Counters (hits / dirty rebuilds / delta updates / fallbacks) are exported
+through /metrics (vtpu/scheduler/metrics.py) — docs/scheduler_perf.md
+describes how to read them.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from vtpu.scheduler.score import DeviceUsage, NodeUsage
+from vtpu.utils.types import ChipInfo, PodDevices
+
+__all__ = ["UsageCache"]
+
+
+class _PodBooking:
+    __slots__ = ("node", "devices")
+
+    def __init__(self, node: str, devices: PodDevices) -> None:
+        self.node = node
+        self.devices = devices
+
+
+class _NodeEntry:
+    __slots__ = ("chips", "topology", "gen", "usage", "by_uuid", "util_sum")
+
+    def __init__(self, chips: List[ChipInfo], topology: str) -> None:
+        self.chips = chips
+        self.topology = topology
+        self.gen = 0
+        # usage is None while dirty; rebuilt lazily from chips + bookings
+        self.usage: Optional[NodeUsage] = None
+        self.by_uuid: Dict[str, DeviceUsage] = {}
+        # incrementally maintained Σ (usedmem/totalmem + usedcores/totalcores)
+        # over devices — the pre-booking base score.evaluate_single needs,
+        # kept here so scoring does not re-walk every device per candidate
+        self.util_sum = 0.0
+
+
+class UsageCache:
+    """Materialized ``{node: NodeUsage}`` view, maintained by deltas."""
+
+    def __init__(self) -> None:
+        # RLock: the filter holds the lock across evaluate→book, and the
+        # book path re-enters via PodManager.add_pod's notification
+        self._lock = threading.RLock()
+        self._entries: Dict[str, _NodeEntry] = {}
+        self._bookings: Dict[str, _PodBooking] = {}
+        # cache-wide monotonic generation source: generations are unique
+        # across ALL nodes and never reused, so a node that is expelled
+        # and re-added can never alias a stale (node, gen)-keyed memo
+        # entry held by a consumer (core._single_eval_memo)
+        self._gen = 0
+        # perf counters (read via stats(); exported on /metrics)
+        self.hits = 0            # nodes served from a clean aggregate
+        self.dirty_rebuilds = 0  # lazy full rebuilds of one node
+        self.delta_updates = 0   # O(delta) booking applications/reversals
+        self.fallbacks = 0       # events that forced a dirty mark
+        self.misses = 0          # lookups of unknown nodes
+
+    # -- locking ------------------------------------------------------
+    def locked(self):
+        """The cache lock, for callers that batch several reads (the
+        filter's candidate walk).  Always the innermost lock: never call
+        into NodeManager/PodManager while holding it."""
+        return self._lock
+
+    # -- manager notifications (fired under the manager's lock) -------
+    def on_node_changed(self, name: str, chips: List[ChipInfo], topology: str) -> None:
+        """Registry totals changed → new baseline, bookings replayed lazily."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                entry = _NodeEntry(list(chips), topology)
+                self._entries[name] = entry
+            else:
+                entry.chips = list(chips)
+                entry.topology = topology
+            self._gen += 1
+            entry.gen = self._gen
+            entry.usage = None  # dirty: rebuild replays current bookings
+            entry.by_uuid = {}
+
+    def on_node_removed(self, name: str) -> None:
+        with self._lock:
+            self._entries.pop(name, None)
+
+    def on_pod_changed(self, uid: str, node: str, devices: PodDevices) -> None:
+        with self._lock:
+            self._reverse_booking(uid)
+            self._bookings[uid] = _PodBooking(node, devices)
+            self._apply_delta(node, devices, sign=1)
+
+    def on_pod_removed(self, uid: str) -> None:
+        with self._lock:
+            self._reverse_booking(uid)
+            self._bookings.pop(uid, None)
+
+    # -- delta machinery ----------------------------------------------
+    def _reverse_booking(self, uid: str) -> None:
+        prev = self._bookings.get(uid)
+        if prev is not None:
+            self._apply_delta(prev.node, prev.devices, sign=-1)
+
+    def _apply_delta(self, node: str, devices: PodDevices, sign: int) -> None:
+        entry = self._entries.get(node)
+        if entry is None:
+            return  # pod on an unknown node: ignored, like nodes_usage()
+        if entry.usage is None:
+            return  # dirty: the lazy rebuild replays current bookings
+        self._gen += 1
+        entry.gen = self._gen
+        for ctr in devices:
+            for cd in ctr:
+                d = entry.by_uuid.get(cd.uuid)
+                if d is None:
+                    # booking references a chip the registry no longer
+                    # advertises — fall back to a full rebuild so the
+                    # orphan is skipped exactly like the oracle path
+                    self.fallbacks += 1
+                    entry.usage = None
+                    entry.by_uuid = {}
+                    return
+                d.used += sign
+                d.usedmem += sign * cd.usedmem
+                d.usedcores += sign * cd.usedcores
+                entry.util_sum += sign * (
+                    cd.usedmem / max(d.totalmem, 1)
+                    + cd.usedcores / max(d.totalcores, 1)
+                )
+                self.delta_updates += 1
+
+    def _rebuilt(self, name: str, entry: _NodeEntry) -> NodeUsage:
+        """Return the clean aggregate, rebuilding from chips + booking
+        replay when dirty.  Caller holds the lock."""
+        if entry.usage is not None:
+            self.hits += 1
+            return entry.usage
+        self.dirty_rebuilds += 1
+        self._gen += 1
+        entry.gen = self._gen
+        devices = [DeviceUsage.from_chip_info(ci) for ci in entry.chips]
+        by_uuid = {d.uuid: d for d in devices}
+        for booking in self._bookings.values():
+            if booking.node != name:
+                continue
+            for ctr in booking.devices:
+                for cd in ctr:
+                    d = by_uuid.get(cd.uuid)
+                    if d is None:
+                        continue  # orphan booking: skip, as the oracle does
+                    d.used += 1
+                    d.usedmem += cd.usedmem
+                    d.usedcores += cd.usedcores
+        entry.usage = NodeUsage(node=name, devices=devices, topology=entry.topology)
+        entry.by_uuid = by_uuid
+        entry.util_sum = sum(
+            (d.usedmem / max(d.totalmem, 1)) + (d.usedcores / max(d.totalcores, 1))
+            for d in devices
+        )
+        return entry.usage
+
+    # -- read API ------------------------------------------------------
+    def generation(self, name: str) -> int:
+        with self._lock:
+            entry = self._entries.get(name)
+            return -1 if entry is None else entry.gen
+
+    def pod_node(self, uid: str) -> Optional[str]:
+        """Node a pod is currently booked on, or None."""
+        with self._lock:
+            b = self._bookings.get(uid)
+            return b.node if b is not None else None
+
+    def peek_entry(
+        self, name: str
+    ) -> Optional[Tuple[NodeUsage, int, float]]:
+        """(live usage, generation, pre-booking utilisation sum) — the
+        filter fast path's working set.  Caller holds :meth:`locked`."""
+        entry = self._entries.get(name)
+        if entry is None:
+            self.misses += 1
+            return None
+        usage = self._rebuilt(name, entry)
+        return usage, entry.gen, entry.util_sum
+
+    def clone_node(
+        self, name: str, exclude_uid: Optional[str] = None
+    ) -> Tuple[Optional[NodeUsage], int]:
+        """Isolated copy of one node's usage (for fit_pod, which mutates),
+        with ``exclude_uid``'s own booking subtracted — a pod being
+        re-filtered after a bind failure must not see its previous
+        assignment as occupancy.  Returns (usage, generation)."""
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                self.misses += 1
+                return None, -1
+            base = self._rebuilt(name, entry)
+            devices = [d.clone() for d in base.devices]
+            nu = NodeUsage(node=name, devices=devices, topology=entry.topology)
+            if exclude_uid is not None:
+                prev = self._bookings.get(exclude_uid)
+                if prev is not None and prev.node == name:
+                    by_uuid = {d.uuid: d for d in devices}
+                    for ctr in prev.devices:
+                        for cd in ctr:
+                            d = by_uuid.get(cd.uuid)
+                            if d is None:
+                                continue
+                            d.used -= 1
+                            d.usedmem -= cd.usedmem
+                            d.usedcores -= cd.usedcores
+            return nu, entry.gen
+
+    def inspect(self) -> Dict[str, NodeUsage]:
+        """Cloned full view for metrics scrapes — O(nodes × chips) copy,
+        never the O(cluster × pods) re-aggregation, so a Prometheus scrape
+        cannot contend with /filter for seconds at 1000 nodes."""
+        with self._lock:
+            out: Dict[str, NodeUsage] = {}
+            for name, entry in self._entries.items():
+                base = self._rebuilt(name, entry)
+                out[name] = NodeUsage(
+                    node=name,
+                    devices=[d.clone() for d in base.devices],
+                    topology=entry.topology,
+                )
+            return out
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "dirty_rebuilds": self.dirty_rebuilds,
+                "delta_updates": self.delta_updates,
+                "fallbacks": self.fallbacks,
+                "misses": self.misses,
+                "nodes": len(self._entries),
+                "bookings": len(self._bookings),
+            }
