@@ -7,7 +7,7 @@ IMG ?= vtpu/vtpu
 PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
-	bench-sched image chart clean tidy
+	bench-sched obs-lint image chart clean tidy
 
 all: build
 
@@ -116,6 +116,13 @@ test-native-tsan:
 	  VTPU_REAL_PJRT_PLUGIN=./build/tsan/libmock_pjrt.so \
 	  ./build/tsan/test_shim build/tsan/libvtpu_shim.so threads \
 	  && rm -rf /tmp/vtpu-tsan-test
+
+# observability hygiene: registered metric names vs the naming convention
+# (vtpu_ prefix, unit suffix, _total counters) + the exposition-format
+# conformance tests against every renderer (docs/observability.md)
+obs-lint:
+	JAX_PLATFORMS=cpu $(PY) hack/obs_lint.py
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py -q -k "conformance or golden"
 
 bench:
 	$(PY) bench.py

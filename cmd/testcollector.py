@@ -53,26 +53,35 @@ def render_fake_metrics() -> str:
             for i in range(4)
         ],
     )
-    for pod in ("demo-a", "demo-b"):
-        dev = {"podnamespace": "default", "podname": pod, "ctrname": "main",
-               "vdeviceid": "0", "deviceuuid": "fake-tpu-0"}
-        gauge(
-            "vTPU_device_memory_usage_in_bytes",
-            "Per-container vTPU HBM usage (fake).",
-            [(dev, rng.randint(0, hbm_total // 4))],
-        )
-        gauge(
-            "vTPU_device_memory_limit_in_bytes",
-            "Per-container vTPU HBM quota (fake).",
-            [(dev, hbm_total // 4)],
-        )
+    # one HELP/TYPE block per family with every pod's samples — emitting
+    # the block per pod duplicates the family header, which the
+    # exposition-format conformance test (tests/test_obs.py) rejects
+    devs = [
+        {"podnamespace": "default", "podname": pod, "ctrname": "main",
+         "vdeviceid": "0", "deviceuuid": "fake-tpu-0"}
+        for pod in ("demo-a", "demo-b")
+    ]
+    gauge(
+        "vTPU_device_memory_usage_in_bytes",
+        "Per-container vTPU HBM usage (fake).",
+        [(dev, rng.randint(0, hbm_total // 4)) for dev in devs],
+    )
+    gauge(
+        "vTPU_device_memory_limit_in_bytes",
+        "Per-container vTPU HBM quota (fake).",
+        [(dev, hbm_total // 4) for dev in devs],
+    )
     return "\n".join(lines) + "\n"
 
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--bind", default="0.0.0.0:9394")
+    p.add_argument("--debug", action="store_true")
     args = p.parse_args(argv)
+    from vtpu.obs.logsetup import setup_logging
+
+    setup_logging(debug=args.debug)
     host, port = args.bind.rsplit(":", 1)
 
     class Handler(BaseHTTPRequestHandler):
@@ -92,7 +101,11 @@ def main(argv=None) -> int:
             pass
 
     srv = ThreadingHTTPServer((host, int(port)), Handler)
-    print(f"testcollector: fake metrics on http://{args.bind}/metrics")
+    import logging
+
+    logging.getLogger("testcollector").info(
+        "fake metrics on http://%s/metrics", args.bind
+    )
     srv.serve_forever()
     return 0
 

@@ -39,13 +39,19 @@ def main(argv=None) -> int:
     p.add_argument("--device-family", default="tpu", choices=["tpu", "pjrt"],
                    help="accelerator family to serve (pjrt = second family, "
                         "the MLU-daemon analog)")
+    p.add_argument("--debug-bind", default="0.0.0.0:9397",
+                   help="observability listener (/healthz /metrics /spans "
+                        "/timeline); empty string disables")
+    p.add_argument("--span-sink", default=os.environ.get("VTPU_SPAN_SINK", ""),
+                   help="collector URL to POST this daemon's trace-span "
+                        "ring to (the scheduler's /spans/ingest; env "
+                        "VTPU_SPAN_SINK)")
     p.add_argument("--debug", action="store_true")
     args = p.parse_args(argv)
 
-    logging.basicConfig(
-        level=logging.DEBUG if args.debug else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    from vtpu.obs.logsetup import setup_logging
+
+    setup_logging(debug=args.debug)
     log = logging.getLogger("vtpu-device-plugin")
 
     from vtpu.device.libtpu import new_provider
@@ -83,6 +89,22 @@ def main(argv=None) -> int:
         log.error("no TPU chips discovered; exiting")
         return 1
     log.info("discovered %d chips: %s", len(chips), [c.uuid for c in chips])
+
+    debug_srv = None
+    if args.debug_bind:
+        # the plugin is otherwise a pure gRPC daemon — this is its only
+        # HTTP surface: Allocate-latency histograms + the span ring
+        from vtpu.obs.http import serve_debug
+
+        debug_srv, _ = serve_debug(args.debug_bind, registries=("plugin",))
+        log.info("observability listener on %s", args.debug_bind)
+    if args.span_sink:
+        from vtpu.obs.http import start_span_pusher
+
+        start_span_pusher(args.span_sink)
+        # Allocate forwards the sink into tenant containers via the env
+        # ABI, so the shim's spans reach the same collector
+        os.environ["VTPU_SPAN_SINK"] = args.span_sink
 
     client = new_client()
     cache = DeviceCache(provider)
@@ -160,6 +182,8 @@ def main(argv=None) -> int:
     stop_all()
     registrar.stop()
     cache.stop()
+    if debug_srv is not None:
+        debug_srv.shutdown()
     return 0
 
 

@@ -29,13 +29,16 @@ def main(argv=None) -> int:
     p.add_argument("--noderpc-bind", default="0.0.0.0:9396")
     p.add_argument("--feedback-interval", type=float, default=5.0)
     p.add_argument("--disable-feedback", action="store_true")
+    p.add_argument("--span-sink", default=os.environ.get("VTPU_SPAN_SINK", ""),
+                   help="collector URL to POST this daemon's trace-span "
+                        "ring to (the scheduler's /spans/ingest; env "
+                        "VTPU_SPAN_SINK)")
     p.add_argument("--debug", action="store_true")
     args = p.parse_args(argv)
 
-    logging.basicConfig(
-        level=logging.DEBUG if args.debug else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    from vtpu.obs.logsetup import setup_logging
+
+    setup_logging(debug=args.debug)
     from vtpu.monitor.feedback import FeedbackLoop
     from vtpu.monitor.metrics import serve_metrics
     from vtpu.monitor.noderpc import serve_noderpc
@@ -57,6 +60,10 @@ def main(argv=None) -> int:
         logging.info("no cluster access; running without pod join/GC")
 
     pm = PathMonitor(args.containers_root)
+    if args.span_sink:
+        from vtpu.obs.http import start_span_pusher
+
+        start_span_pusher(args.span_sink)
     metrics_srv, _ = serve_metrics(pm, pods_fn=pods_fn, bind=args.metrics_bind)
     rpc_srv, _ = serve_noderpc(pm, bind=args.noderpc_bind)
     fb = None

@@ -56,10 +56,11 @@ def main(argv=None) -> int:
         # background loops patch node annotations as soon as they start)
         p.error("--cert-file and --key-file must be given together")
 
-    logging.basicConfig(
-        level=logging.DEBUG if args.debug else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    # shared bootstrap (vtpu/obs/logsetup.py): VTPU_LOG_FORMAT=json opts
+    # into structured lines carrying trace_id inside spans
+    from vtpu.obs.logsetup import setup_logging
+
+    setup_logging(debug=args.debug)
     from vtpu.k8s.client import new_client
     from vtpu.scheduler import Scheduler, SchedulerConfig
     from vtpu.scheduler.routes import serve

@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Regenerate tests/golden/*.txt — the byte-exact /metrics expositions the
+golden tests (tests/test_obs.py) compare against.
+
+Run after an INTENTIONAL metric-family change only; the whole point of the
+goldens is to catch accidental drift in the pre-existing families
+(dashboards key on the exact names/labels).  New families appended after
+the golden block do not require regeneration — the tests compare by
+prefix.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GOLDEN_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "golden",
+)
+
+
+def main() -> int:
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    from tests.golden_scenarios import build_monitor, build_scheduler
+    from vtpu.monitor.metrics import render_node_metrics
+    from vtpu.scheduler.metrics import render_metrics
+
+    sched = build_scheduler()
+    # include_obs=False: goldens hold ONLY the legacy families — the obs
+    # histogram buckets are timing-dependent and must never be baked in
+    sched_text = render_metrics(sched, include_obs=False)
+    with open(os.path.join(GOLDEN_DIR, "scheduler_metrics.txt"), "w") as f:
+        f.write(sched_text)
+
+    with tempfile.TemporaryDirectory() as root:
+        pm, pods = build_monitor(root)
+        mon_text = render_node_metrics(
+            pm, provider=None, pods_by_uid=pods, include_obs=False
+        )
+        pm.close()
+    with open(os.path.join(GOLDEN_DIR, "monitor_metrics.txt"), "w") as f:
+        f.write(mon_text)
+
+    print(f"wrote {GOLDEN_DIR}/scheduler_metrics.txt "
+          f"({len(sched_text)} bytes) and monitor_metrics.txt "
+          f"({len(mon_text)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

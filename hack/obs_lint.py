@@ -1,0 +1,52 @@
+#!/usr/bin/env python3
+"""obs-lint — metric naming-convention check (make obs-lint).
+
+Imports every component that registers instruments into vtpu.obs, then
+verifies each registered name against the convention:
+
+  - prefix ``vtpu_``
+  - counters end in ``_total``
+  - other instruments end in a unit suffix (``_seconds``, ``_bytes``, …)
+
+Exit 1 with one line per violation.  The exposition-format conformance
+tests (tests/test_obs.py -k conformance) run from the same make target.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main() -> int:
+    # importing the modules is what populates the registries
+    import vtpu.plugin.server  # noqa: F401 — plugin Allocate histogram
+    import vtpu.scheduler.core  # noqa: F401 — filter/patch/bind histograms
+    import vtpu.serving.batcher  # noqa: F401 — queue-to-first-token
+    import vtpu.shim.runtime  # noqa: F401 — pacing/quota histograms
+    from vtpu.obs import all_registries, lint_names
+
+    names = {
+        reg.name: reg.names() for reg in all_registries().values()
+    }
+    total = sum(len(v) for v in names.values())
+    problems = lint_names()
+    for p in problems:
+        print(f"obs-lint: {p}", file=sys.stderr)
+    if problems:
+        print(f"obs-lint: {len(problems)} violation(s) across "
+              f"{total} registered metric(s)", file=sys.stderr)
+        return 1
+    for reg, metric_names in sorted(names.items()):
+        for n in metric_names:
+            print(f"ok {reg}: {n}")
+    print(f"obs-lint: {total} registered metric name(s) conform")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,72 @@
+"""Deterministic fixtures for the /metrics golden tests.
+
+The observability refactor (vtpu/obs) must keep both components' existing
+metric families byte-identical for the same state — these builders pin
+"the same state": fixed uids, pids, sizes, and one fixed call sequence
+(counters such as the usage-cache stats advance per call, so the render
+must happen exactly once, right after the build).
+
+``hack/gen_obs_goldens.py`` regenerates tests/golden/*.txt from the same
+builders; tests/test_obs.py compares against them.
+"""
+
+from __future__ import annotations
+
+import os
+
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.utils import codec
+from vtpu.utils.types import ChipInfo, annotations as A, resources as R
+
+
+def build_scheduler():
+    """One 2-chip node, one single-chip pod filtered onto it."""
+    from vtpu.scheduler import Scheduler, SchedulerConfig
+
+    client = FakeClient()
+    client.create_node(new_node("n1"))
+    enc = codec.encode_node_devices([
+        ChipInfo(uuid="c0", count=4, hbm_mb=16384, cores=100,
+                 type="TPU-v5e", health=True),
+        ChipInfo(uuid="c1", count=4, hbm_mb=16384, cores=100,
+                 type="TPU-v5e", health=True),
+    ])
+    client.patch_node_annotations(
+        "n1", {A.NODE_HANDSHAKE: "Reported 2026-07-29T00:00:00Z",
+               A.NODE_REGISTER: enc},
+    )
+    sched = Scheduler(client, SchedulerConfig(http_bind="127.0.0.1:0"))
+    sched.register_from_node_annotations()
+    pod = client.create_pod(new_pod(
+        "golden-pod", uid="golden-uid-1",
+        containers=[{"name": "main", "resources": {
+            "limits": {R.chip: 1, R.memory: 2048, R.cores: 10}}}],
+    ))
+    res = sched.filter(pod, ["n1"])
+    assert res.node == "n1", (res.failed, res.error)
+    return sched
+
+
+def build_monitor(root: str):
+    """Two container regions — one inside quota, one in violation."""
+    from vtpu.monitor.pathmonitor import REGION_FILENAME, PathMonitor
+    from vtpu.monitor.shared_region import RegionFile
+
+    for uid, n, used_mb, limit_mb, pid in (
+        ("golden-pod-1", "0", 10, 100, 100),
+        ("golden-pod-2", "1", 120, 100, 200),
+    ):
+        d = os.path.join(root, f"{uid}_{n}")
+        os.makedirs(d, exist_ok=True)
+        r = RegionFile(os.path.join(d, REGION_FILENAME), create=True)
+        r.set_devices(["tpu-0"], [limit_mb << 20], [50])
+        r.register_proc(pid, 0)
+        r.add_usage(pid, 0, used_mb << 20)
+        r.close()
+    pods = {
+        "golden-pod-1": {"metadata": {
+            "name": "w1", "namespace": "ns", "uid": "golden-pod-1"}},
+        "golden-pod-2": {"metadata": {
+            "name": "w2", "namespace": "ns", "uid": "golden-pod-2"}},
+    }
+    return PathMonitor(root), pods

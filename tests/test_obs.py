@@ -1,0 +1,371 @@
+"""Observability-layer tests: histogram bucket math, exposition-format
+conformance (run against EVERY renderer), the byte-identity goldens for
+the pre-obs metric families, the /spans query filters on all three HTTP
+surfaces, and the shared logging bootstrap."""
+
+import json
+import logging
+import os
+import re
+import tempfile
+import urllib.request
+
+import pytest
+
+from tests.golden_scenarios import build_monitor, build_scheduler
+from vtpu.obs.registry import Histogram, Registry, lint_names, registry
+from vtpu.utils import trace
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+@pytest.fixture(autouse=True)
+def _tracing_on():
+    trace.clear()
+    trace.tracing(True)
+    yield
+    trace.tracing(False)
+    trace.clear()
+
+
+# -- histogram bucket math ------------------------------------------------
+
+
+def test_histogram_boundary_values_land_in_le_bucket():
+    h = Histogram("vtpu_x_seconds", "t", buckets=(0.1, 1.0, 10.0))
+    # le is ≤: a value exactly on a bound belongs in that bound's bucket
+    h.observe(0.1)
+    h.observe(1.0)
+    h.observe(0.05)
+    snap = h.snapshot()
+    # cumulative: ≤0.1 → {0.05, 0.1}; ≤1.0 adds 1.0; ≤10 and +Inf same
+    assert snap["buckets"] == [2, 3, 3, 3]
+    assert snap["count"] == 3
+    assert snap["sum"] == pytest.approx(1.15)
+
+
+def test_histogram_overflow_goes_to_inf_only():
+    h = Histogram("vtpu_x_seconds", "t", buckets=(0.1, 1.0))
+    h.observe(5.0)
+    snap = h.snapshot()
+    assert snap["buckets"] == [0, 0, 1]  # only the +Inf bucket
+    assert snap["count"] == 1 and snap["sum"] == 5.0
+
+
+def test_histogram_sum_count_invariants_and_monotonicity():
+    h = Histogram("vtpu_x_seconds", "t", buckets=(0.001, 0.01, 0.1, 1.0))
+    vals = [0.0005, 0.002, 0.02, 0.2, 2.0, 0.0009, 0.05]
+    for v in vals:
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == len(vals)
+    assert snap["sum"] == pytest.approx(sum(vals))
+    # cumulative bucket counts are monotone and end at count (+Inf)
+    assert snap["buckets"] == sorted(snap["buckets"])
+    assert snap["buckets"][-1] == snap["count"]
+
+
+def test_histogram_labels_are_independent_series():
+    h = Histogram("vtpu_x_seconds", "t", buckets=(1.0,))
+    h.observe(0.5, path="fast")
+    h.observe(2.0, path="general")
+    assert h.snapshot(path="fast")["count"] == 1
+    assert h.snapshot(path="general")["buckets"] == [0, 1]
+    assert h.snapshot(path="missing") is None
+
+
+def test_histogram_rejects_inf_bucket():
+    with pytest.raises(ValueError):
+        Histogram("vtpu_x_seconds", "t", buckets=(1.0, float("inf")))
+
+
+def test_counter_and_gauge_basics():
+    r = Registry("t")
+    c = r.counter("vtpu_things_total", "t")
+    c.inc()
+    c.inc(2, kind="a")
+    assert c.value() == 1 and c.value(kind="a") == 2
+    g = r.gauge("vtpu_depth_bytes", "t")
+    g.set(5)
+    g.add(-2)
+    assert g.value() == 3
+    text = r.render()
+    assert "vtpu_things_total 1" in text
+    assert 'vtpu_things_total{kind="a"} 2' in text
+    assert "vtpu_depth_bytes 3" in text
+    # same name re-registered as another type is a programming error
+    with pytest.raises(TypeError):
+        r.gauge("vtpu_things_total", "t")
+
+
+def test_lint_names_flags_convention_violations():
+    r = registry("lint-probe")
+    r.counter("vtpu_good_total", "t")
+    r.counter("vtpu_bad_counter", "t")          # counter without _total
+    r.histogram("bad_prefix_seconds", "t")      # missing vtpu_ prefix
+    r.gauge("vtpu_no_unit", "t")                # no unit suffix
+    problems = "\n".join(lint_names())
+    assert "vtpu_bad_counter" in problems
+    assert "bad_prefix_seconds" in problems
+    assert "vtpu_no_unit" in problems
+    assert "vtpu_good_total" not in problems
+
+
+# -- exposition-format conformance (every renderer) -----------------------
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*",?)*)\})?'
+    r' (?P<value>[^ ]+)$'
+)
+
+
+def check_exposition(text: str) -> None:
+    """Prometheus text-format conformance: HELP precedes TYPE precedes
+    samples per family, every sample parses (label escaping), counters
+    end in _total, histograms keep the bucket/sum/count contract."""
+    helped, typed = set(), {}
+    hist_state = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in typed, f"HELP after TYPE for {name}"
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split(None, 3)
+            assert name in helped, f"TYPE without preceding HELP: {name}"
+            assert name not in typed, f"duplicate TYPE for {name}"
+            assert typ in ("gauge", "counter", "histogram", "summary"), typ
+            typed[name] = typ
+            if typ == "counter":
+                assert name.endswith("_total"), \
+                    f"counter {name} missing _total suffix"
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        sample = m.group("name")
+        family = sample
+        for suffix in ("_bucket", "_sum", "_count"):
+            if sample.endswith(suffix) and sample[: -len(suffix)] in typed:
+                family = sample[: -len(suffix)]
+        assert family in typed, f"sample {sample} with no TYPE header"
+        float(m.group("value"))  # numeric
+        if typed[family] == "histogram":
+            st = hist_state.setdefault(
+                (family, _strip_le(m.group("labels") or "")),
+                {"buckets": [], "sum": None, "count": None},
+            )
+            if sample.endswith("_bucket"):
+                st["buckets"].append(
+                    (_le_of(m.group("labels") or ""), float(m.group("value")))
+                )
+            elif sample.endswith("_sum"):
+                st["sum"] = float(m.group("value"))
+            elif sample.endswith("_count"):
+                st["count"] = float(m.group("value"))
+    for (family, _lbl), st in hist_state.items():
+        counts = [c for _, c in st["buckets"]]
+        assert counts == sorted(counts), f"{family}: non-cumulative buckets"
+        assert st["buckets"][-1][0] == float("inf"), f"{family}: no +Inf"
+        assert st["count"] is not None and st["sum"] is not None
+        assert st["buckets"][-1][1] == st["count"], \
+            f"{family}: +Inf bucket != count"
+
+
+def _le_of(labels: str) -> float:
+    m = re.search(r'le="([^"]+)"', labels)
+    assert m, f"bucket sample without le label: {labels}"
+    return float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+
+
+def _strip_le(labels: str) -> str:
+    return re.sub(r'(^|,)le="[^"]+"', "", labels)
+
+
+def test_conformance_obs_registry_renderer():
+    r = Registry("conf")
+    r.counter("vtpu_conf_total", "c").inc(3, q='we"ird\nlabel')
+    r.gauge("vtpu_conf_bytes", "g").set(7, node="n1")
+    h = r.histogram("vtpu_conf_seconds", "h", buckets=(0.1, 1.0))
+    h.observe(0.05, path="fast")
+    h.observe(3.0, path="fast")
+    check_exposition(r.render())
+
+
+def test_conformance_scheduler_renderer():
+    from vtpu.scheduler.metrics import render_metrics
+
+    check_exposition(render_metrics(build_scheduler()))
+
+
+def test_conformance_monitor_renderer():
+    from vtpu.monitor.metrics import render_node_metrics
+
+    with tempfile.TemporaryDirectory() as root:
+        pm, pods = build_monitor(root)
+        text = render_node_metrics(pm, provider=None, pods_by_uid=pods)
+        pm.close()
+    check_exposition(text)
+
+
+def test_conformance_testcollector_renderer():
+    import importlib.util
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parents[1] / "cmd" / "testcollector.py"
+    spec = importlib.util.spec_from_file_location("testcollector", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    check_exposition(mod.render_fake_metrics())
+
+
+# -- golden byte-identity (dashboard compatibility) ------------------------
+
+
+def test_scheduler_metrics_golden_prefix():
+    """The pre-obs exposition must be a byte-exact prefix of the new one
+    (new histogram families append strictly after)."""
+    from vtpu.scheduler.metrics import render_metrics
+
+    with open(os.path.join(GOLDEN_DIR, "scheduler_metrics.txt")) as f:
+        golden = f.read()
+    text = render_metrics(build_scheduler())
+    assert text.startswith(golden), (
+        "legacy scheduler metric families drifted from "
+        "tests/golden/scheduler_metrics.txt — if intentional, regenerate "
+        "with hack/gen_obs_goldens.py"
+    )
+
+
+def test_monitor_metrics_golden_prefix():
+    from vtpu.monitor.metrics import render_node_metrics
+
+    with open(os.path.join(GOLDEN_DIR, "monitor_metrics.txt")) as f:
+        golden = f.read()
+    with tempfile.TemporaryDirectory() as root:
+        pm, pods = build_monitor(root)
+        text = render_node_metrics(pm, provider=None, pods_by_uid=pods)
+        pm.close()
+    assert text.startswith(golden), (
+        "legacy monitor metric families drifted from "
+        "tests/golden/monitor_metrics.txt — if intentional, regenerate "
+        "with hack/gen_obs_goldens.py"
+    )
+
+
+# -- /spans query filters on every HTTP surface ----------------------------
+
+
+def _emit_spans():
+    for i in range(5):
+        with trace.span("alpha", i=i):
+            pass
+    for i in range(3):
+        with trace.span("beta", i=i):
+            pass
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_scheduler_spans_filters():
+    from vtpu.scheduler.routes import serve
+
+    sched = build_scheduler()
+    _emit_spans()
+    srv, _ = serve(sched)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        assert len(_get_json(base + "/spans?name=beta")) == 3
+        out = _get_json(base + "/spans?n=2&name=alpha")
+        assert len(out) == 2 and all(s["name"] == "alpha" for s in out)
+        assert len(_get_json(base + "/spans?n=4")) == 4
+    finally:
+        srv.shutdown()
+
+
+def test_monitor_spans_endpoint(tmp_path):
+    from vtpu.monitor.metrics import serve_metrics
+    from vtpu.monitor.pathmonitor import PathMonitor
+
+    pm = PathMonitor(str(tmp_path))
+    srv, _ = serve_metrics(pm, bind="127.0.0.1:0")
+    _emit_spans()
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        assert len(_get_json(base + "/spans?name=alpha&n=2")) == 2
+        assert _get_json(base + "/spans?name=nope") == []
+    finally:
+        srv.shutdown()
+        pm.close()
+
+
+def test_plugin_debug_server_spans_and_metrics():
+    from vtpu.obs.http import serve_debug
+
+    registry("plugin").histogram(
+        "vtpu_plugin_allocate_seconds", "x"
+    ).observe(0.01)
+    _emit_spans()
+    srv, _ = serve_debug("127.0.0.1:0", registries=("plugin",))
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        assert len(_get_json(base + "/spans?name=beta")) == 3
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "vtpu_plugin_allocate_seconds_bucket" in text
+        check_exposition(text)
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert r.read() == b"ok"
+    finally:
+        srv.shutdown()
+
+
+# -- shared logging bootstrap ---------------------------------------------
+
+
+def test_json_logging_carries_trace_id(capsys):
+    from vtpu.obs.logsetup import setup_logging
+
+    root = logging.getLogger()
+    before = list(root.handlers)
+    try:
+        setup_logging(fmt="json")
+        log = logging.getLogger("vtpu.obs-test")
+        with trace.span("ctx-span", trace_id="trace-xyz"):
+            log.info("inside %s", "span")
+        log.info("outside")
+        err = capsys.readouterr().err
+    finally:
+        for h in list(root.handlers):
+            if h not in before:
+                root.removeHandler(h)
+    lines = [json.loads(l) for l in err.strip().splitlines()
+             if l.startswith("{")]
+    inside = [l for l in lines if l["msg"] == "inside span"]
+    outside = [l for l in lines if l["msg"] == "outside"]
+    assert inside and inside[0]["trace_id"] == "trace-xyz"
+    assert "span_id" in inside[0] and inside[0]["level"] == "INFO"
+    assert outside and "trace_id" not in outside[0]
+
+
+def test_text_logging_still_works(capsys):
+    from vtpu.obs.logsetup import setup_logging
+
+    root = logging.getLogger()
+    before = list(root.handlers)
+    try:
+        setup_logging(fmt="text")
+        logging.getLogger("vtpu.obs-test").info("plain line")
+        err = capsys.readouterr().err
+    finally:
+        for h in list(root.handlers):
+            if h not in before:
+                root.removeHandler(h)
+    assert "plain line" in err and "INFO" in err

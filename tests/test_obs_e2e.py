@@ -1,0 +1,248 @@
+"""End-to-end pod-lifecycle tracing: one pod scheduled through
+FakeClient + scheduler + device plugin + shim runtime must leave a
+filter → assign_patch → allocate → shim.init span chain sharing a single
+trace id (= the pod UID), reconstructable via trace.timeline and the
+scheduler's /timeline endpoint, exportable as Chrome trace-event JSON,
+and mergeable across processes through POST /spans/ingest."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.k8s.objects import get_annotations
+from vtpu.plugin import v1beta1_pb2 as pb
+from vtpu.plugin.cache import DeviceCache
+from vtpu.plugin.config import PluginConfig
+from vtpu.plugin.server import VtpuDevicePlugin, split_device_ids
+from vtpu.scheduler import Scheduler, SchedulerConfig
+from vtpu.scheduler.routes import serve
+from vtpu.utils import codec, trace
+from vtpu.utils.types import annotations as A, resources as R
+
+
+@pytest.fixture(autouse=True)
+def _tracing_on():
+    trace.clear()
+    trace.tracing(True)
+    yield
+    trace.tracing(False)
+    trace.clear()
+
+
+class _FakeGrpcContext:
+    """Just enough of grpc.ServicerContext for direct Allocate calls."""
+
+    def abort(self, code, details):
+        raise RuntimeError(f"grpc abort {code}: {details}")
+
+
+def _schedule_and_allocate(tmp_path, trace_on=True):
+    """FakeClient cluster → filter → bind → plugin Allocate; returns
+    (client, pod, allocate-env dict)."""
+    client = FakeClient()
+    client.create_node(new_node("n1"))
+    from vtpu.utils.types import ChipInfo
+
+    enc = codec.encode_node_devices([
+        ChipInfo(uuid="fake-tpu-0", count=4, hbm_mb=16384, cores=100,
+                 type="TPU-v5e", health=True),
+    ])
+    client.patch_node_annotations(
+        "n1", {A.NODE_HANDSHAKE: "Reported 2026-07-29T00:00:00Z",
+               A.NODE_REGISTER: enc},
+    )
+    sched = Scheduler(client, SchedulerConfig(http_bind="127.0.0.1:0"))
+    sched.register_from_node_annotations()
+    pod = client.create_pod(new_pod(
+        "traced", uid="trace-e2e-uid",
+        containers=[{"name": "main", "resources": {
+            "limits": {R.chip: 1, R.memory: 1024}}}],
+    ))
+    res = sched.filter(pod, ["n1"])
+    assert res.node == "n1", (res.failed, res.error)
+    assert sched.bind("default", "traced", "n1",
+                      pod_uid=pod["metadata"]["uid"]) is None
+
+    cfg = PluginConfig(
+        node_name="n1",
+        socket_dir=str(tmp_path),
+        shim_host_dir=str(tmp_path / "shim"),
+        cache_host_root=str(tmp_path / "containers"),
+    )
+    from vtpu.device import FakeProvider
+
+    cache = DeviceCache(FakeProvider(
+        {"model": "TPU-v5e", "topology": "1x1x1", "hbm_mb": 16384}
+    ))
+    servicer = VtpuDevicePlugin(client, cache, cfg)
+    assigned = codec.decode_pod_devices(
+        get_annotations(client.get_pod("default", "traced"))[
+            A.DEVICES_TO_ALLOCATE]
+    )
+    req = pb.AllocateRequest()
+    req.container_requests.append(pb.ContainerAllocateRequest(
+        devicesIDs=[split_device_ids(assigned[0][0].uuid,
+                                     cfg.device_split_count)[0]]
+    ))
+    resp = servicer.Allocate(req, _FakeGrpcContext())
+    envs = dict(resp.container_responses[0].envs)
+    return client, sched, pod, envs
+
+
+def test_trace_context_annotation_stamped(tmp_path):
+    client, sched, pod, envs = _schedule_and_allocate(tmp_path)
+    annos = get_annotations(client.get_pod("default", "traced"))
+    ctx = annos[A.TRACE_CONTEXT]
+    trace_id, parent = trace.parse_context(ctx)
+    assert trace_id == "trace-e2e-uid" and isinstance(parent, int)
+    # the filter span is the root the annotation points at
+    (fspan,) = trace.recent_spans(name="filter")
+    assert fspan["span_id"] == parent and fspan["parent"] is None
+
+
+def test_e2e_lifecycle_spans_share_trace_in_causal_order(
+    tmp_path, monkeypatch
+):
+    client, sched, pod, envs = _schedule_and_allocate(tmp_path)
+    # the env ABI carries the allocate span's context into the container;
+    # the shim runtime (same process in the harness) picks it up.  The
+    # tracing switch rides along — without it a real tenant (fresh env)
+    # would never record the shim leg
+    assert "VTPU_TRACE_CONTEXT" in envs
+    assert envs.get("VTPU_TRACE") == "1"
+    monkeypatch.setenv("VTPU_TRACE_CONTEXT", envs["VTPU_TRACE_CONTEXT"])
+    from vtpu.shim import ShimRuntime
+
+    rt = ShimRuntime(
+        limits_bytes=[64 << 20],
+        region_path=str(tmp_path / "regions" / "vtpu.cache"),
+        uuids=["fake-tpu-0"],
+    )
+    rt.close()
+
+    tl = trace.timeline("trace-e2e-uid")
+    names = [s["name"] for s in tl]
+    for needed in ("filter", "assign_patch", "allocate", "shim.init"):
+        assert needed in names, (needed, names)
+    # causal order: every ancestor precedes its descendants
+    assert names.index("filter") < names.index("assign_patch")
+    assert names.index("filter") < names.index("allocate")
+    assert names.index("allocate") < names.index("shim.init")
+    # one trace id across all four components
+    assert {s["trace_id"] for s in tl} == {"trace-e2e-uid"}
+    by_name = {s["name"]: s for s in tl}
+    assert by_name["assign_patch"]["parent"] == by_name["filter"]["span_id"]
+    assert by_name["allocate"]["parent"] == by_name["filter"]["span_id"]
+    assert by_name["shim.init"]["parent"] == by_name["allocate"]["span_id"]
+
+
+def test_timeline_http_endpoint(tmp_path):
+    _client, sched, pod, _envs = _schedule_and_allocate(tmp_path)
+    srv, _ = serve(sched)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        with urllib.request.urlopen(
+            base + "/timeline?pod=trace-e2e-uid", timeout=10
+        ) as r:
+            body = json.loads(r.read())
+        assert body["trace_id"] == "trace-e2e-uid"
+        names = [s["name"] for s in body["spans"]]
+        assert "filter" in names and "allocate" in names
+        # missing param is a client error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/timeline", timeout=10)
+        assert ei.value.code == 400
+    finally:
+        srv.shutdown()
+
+
+def test_spans_ingest_merges_remote_feeds(tmp_path):
+    """A 'remote' component's ring POSTs into the scheduler and lands in
+    the merged timeline; re-pushing is idempotent (pid/span_id dedup)."""
+    _client, sched, pod, _envs = _schedule_and_allocate(tmp_path)
+    remote = [
+        {"name": "remote.leg", "start": 1e9, "dur_ms": 2.0,
+         "trace_id": "trace-e2e-uid", "span_id": 1, "parent": None,
+         "pid": 99999, "tid": 1, "ok": True},
+    ]
+    srv, _ = serve(sched)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+
+        def post():
+            req = urllib.request.Request(
+                base + "/spans/ingest", json.dumps(remote).encode(),
+                {"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read())
+
+        assert post() == {"ingested": 1}
+        assert post() == {"ingested": 0}  # idempotent re-push
+        with urllib.request.urlopen(
+            base + "/timeline?pod=trace-e2e-uid", timeout=10
+        ) as r:
+            names = [s["name"] for s in json.loads(r.read())["spans"]]
+        assert "remote.leg" in names and "filter" in names
+    finally:
+        srv.shutdown()
+
+
+def test_ingest_keeps_distinct_processes_with_same_pid():
+    """Two daemons on different nodes are both pid 1 with span ids from 1;
+    the per-process ``proc`` token must keep their spans distinct."""
+    node_a = [{"name": "allocate", "start": 1.0, "dur_ms": 1.0,
+               "trace_id": "t1", "span_id": 1, "parent": None,
+               "proc": "1-aaaa", "pid": 1, "tid": 1, "ok": True}]
+    node_b = [{"name": "allocate", "start": 2.0, "dur_ms": 1.0,
+               "trace_id": "t2", "span_id": 1, "parent": None,
+               "proc": "1-bbbb", "pid": 1, "tid": 1, "ok": True}]
+    assert trace.ingest(node_a) == 1
+    assert trace.ingest(node_b) == 1  # not shadowed by node A's (1, 1)
+    assert trace.ingest(node_b) == 0  # same node re-push still dedups
+    assert len(trace.timeline("t1")) == 1
+    assert len(trace.timeline("t2")) == 1
+
+
+def test_push_spans_roundtrip(tmp_path):
+    """trace.push_spans POSTs this process's ring into a collector."""
+    _client, sched, pod, _envs = _schedule_and_allocate(tmp_path)
+    local_count = len(trace.recent_spans(10_000))
+    srv, _ = serve(sched)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        # same-process collector: everything is already in the shared
+        # ring, so the push must dedup to zero additions
+        assert trace.push_spans(base + "/spans/ingest") == 200
+        assert len(trace.recent_spans(10_000)) == local_count
+    finally:
+        srv.shutdown()
+
+
+def test_export_chrome_is_valid_trace_event_json(tmp_path):
+    _schedule_and_allocate(tmp_path)
+    out = trace.export_chrome()
+    doc = json.loads(out)
+    events = doc["traceEvents"]
+    assert events, "no events exported"
+    for ev in events:
+        assert ev["ph"] == "X"
+        assert isinstance(ev["ts"], (int, float)) and ev["ts"] > 0
+        assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        assert isinstance(ev["pid"], int)
+        assert "tid" in ev and "name" in ev
+    filt = [e for e in events if e["name"] == "filter"]
+    assert filt and filt[0]["args"]["trace_id"] == "trace-e2e-uid"
+
+
+def test_disabled_tracing_stamps_nothing(tmp_path):
+    trace.tracing(False)
+    client, sched, pod, envs = _schedule_and_allocate(tmp_path)
+    annos = get_annotations(client.get_pod("default", "traced"))
+    assert A.TRACE_CONTEXT not in annos
+    assert "VTPU_TRACE_CONTEXT" not in envs
+    assert "VTPU_TRACE" not in envs
+    assert trace.recent_spans() == []

@@ -4,6 +4,11 @@ Serves :9394/metrics — host chip stats from the device provider plus
 per-container real usage read from the shared regions.  This is where the
 BASELINE "HBM-quota violations" metric comes from: usage > limit in any
 region is a violation.
+
+Exposition built on the shared vtpu.obs renderer; the legacy families are
+byte-identical to the pre-obs output (tests/golden/monitor_metrics.txt)
+with the obs registry's families appended, and the HTTP server also
+mounts the shared /spans + /timeline debug surface.
 """
 
 from __future__ import annotations
@@ -13,6 +18,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from vtpu import obs
+from vtpu.obs import render_family
 from vtpu.monitor.pathmonitor import PathMonitor
 
 log = logging.getLogger(__name__)
@@ -20,24 +27,19 @@ log = logging.getLogger(__name__)
 _MB = 1024 * 1024
 
 
-def _esc(s: str) -> str:
-    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
-
-
 def render_node_metrics(
     pathmon: PathMonitor,
     provider=None,
     pods_by_uid: Optional[Dict[str, dict]] = None,
+    include_obs: bool = True,
 ) -> str:
+    """``include_obs=False`` stops after the legacy families (golden
+    regeneration must not bake in timing-dependent histogram counts)."""
     lines: List[str] = []
 
     def gauge(name: str, help_: str, samples: List[Tuple[dict, float]],
               typ: str = "gauge") -> None:
-        lines.append(f"# HELP {name} {help_}")
-        lines.append(f"# TYPE {name} {typ}")
-        for labels, value in samples:
-            lbl = ",".join(f'{k}="{_esc(str(v))}"' for k, v in labels.items())
-            lines.append(f"{name}{{{lbl}}} {value}")
+        render_family(lines, name, help_, typ, samples)
 
     # host-level chip inventory (ref HostGPUMemoryUsage/HostCoreUtilization)
     host_mem = []
@@ -123,7 +125,15 @@ def render_node_metrics(
         exec_shim_s,
         typ="counter",
     )
-    return "\n".join(lines) + "\n"
+    # obs-registry families (in-process shim histograms when tenants run
+    # embedded, monitor-side instruments) — appended AFTER the legacy
+    # families so the pre-obs exposition stays a byte-exact prefix
+    legacy = "\n".join(lines) + "\n"
+    if not include_obs:
+        return legacy
+    return (legacy
+            + obs.registry("monitor").render()
+            + obs.registry("shim").render())
 
 
 def serve_metrics(
@@ -135,7 +145,22 @@ def serve_metrics(
     """ref metrics.go — :9394/metrics endpoint."""
 
     class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802
+            if self.path.split("?", 1)[0] in ("/spans", "/timeline",
+                                              "/trace.json"):
+                # shared debug surface (vtpu/obs/http.py)
+                from vtpu.obs.http import handle_debug_get
+
+                if not handle_debug_get(self, self._send):
+                    self._send(404, b"not found", "text/plain")
+                return
             if self.path == "/healthz":
                 body = b"ok"
                 ctype = "text/plain"

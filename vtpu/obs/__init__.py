@@ -1,0 +1,39 @@
+"""vtpu.obs — the shared observability layer.
+
+- :mod:`vtpu.obs.registry` — zero-dependency counters/gauges/histograms
+  with the single Prometheus text renderer every component uses;
+- :mod:`vtpu.obs.http` — the /spans, /timeline, /trace.json debug
+  surface + the span-push feed;
+- :mod:`vtpu.obs.logsetup` — shared logging bootstrap for cmd/
+  entrypoints (``VTPU_LOG_FORMAT=json``).
+
+Trace spans themselves live in :mod:`vtpu.utils.trace` (zero-dep layer —
+obs builds on utils, never the reverse).  docs/observability.md is the
+operator-facing catalog.
+"""
+
+from vtpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS,
+    Registry,
+    all_registries,
+    escape_label,
+    lint_names,
+    registry,
+    render_family,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "LATENCY_BUCKETS",
+    "Registry",
+    "all_registries",
+    "escape_label",
+    "lint_names",
+    "registry",
+    "render_family",
+]

@@ -1,0 +1,158 @@
+"""Shared debug/observability HTTP surface.
+
+One implementation of the ``/spans`` (+ ``?n=`` / ``?name=`` filters),
+``/timeline?pod=<uid>``, ``/trace.json`` (Chrome export) and registry
+``/metrics`` endpoints, used three ways:
+
+- the scheduler extender's listener (vtpu/scheduler/routes.py) delegates
+  its GET debug routes here and adds ``POST /spans/ingest`` (the merged
+  span feed);
+- the node monitor's metrics server (vtpu/monitor/metrics.py) mounts the
+  span routes next to its exposition;
+- the device plugin — a pure gRPC daemon otherwise — gets a standalone
+  ``serve_debug`` listener (cmd/vtpu_device_plugin.py --debug-bind).
+
+``start_span_pusher`` is the companion feed: a daemon thread that
+periodically POSTs this process's span ring to a collector URL
+(``VTPU_SPAN_SINK``, normally the scheduler), making /timeline the
+cross-component view.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence, Tuple
+
+from vtpu.obs.registry import registry
+from vtpu.utils import trace
+
+log = logging.getLogger(__name__)
+
+SPAN_PUSH_INTERVAL_S = 10.0
+
+
+def split_query(path: str) -> Tuple[str, dict]:
+    """``/spans?n=5&name=filter`` → (``/spans``, {"n": "5", ...})."""
+    parsed = urllib.parse.urlsplit(path)
+    params = {k: v[-1] for k, v in
+              urllib.parse.parse_qs(parsed.query).items()}
+    return parsed.path, params
+
+
+def spans_body(params: dict) -> bytes:
+    """JSON for /spans honoring ``?n=`` (count) and ``?name=`` (exact
+    span-name filter)."""
+    try:
+        n = int(params.get("n", 100))
+    except ValueError:
+        n = 100
+    name = params.get("name") or None
+    # default=str: span attrs are arbitrary objects by contract
+    return json.dumps(trace.recent_spans(n=n, name=name), default=str).encode()
+
+
+def timeline_body(params: dict) -> Optional[bytes]:
+    """JSON for /timeline?pod=<uid> (trace id = pod UID); None when the
+    required ``pod`` param is missing."""
+    pod = params.get("pod") or params.get("trace")
+    if not pod:
+        return None
+    spans = trace.timeline(pod)
+    return json.dumps(
+        {"trace_id": pod, "spans": spans, "count": len(spans)}, default=str
+    ).encode()
+
+
+def handle_debug_get(handler, send, registries: Sequence[str] = ()) -> bool:
+    """Serve one debug GET on any BaseHTTPRequestHandler.
+
+    ``send(code, body, ctype)`` is the host handler's writer.  Returns
+    True when the path was a debug route (handled, possibly with an
+    error response), False to let the host handler continue."""
+    route, params = split_query(handler.path)
+    try:
+        if route == "/spans":
+            send(200, spans_body(params), "application/json")
+        elif route == "/timeline":
+            body = timeline_body(params)
+            if body is None:
+                send(400, b'{"error": "missing ?pod=<uid>"}',
+                     "application/json")
+            else:
+                send(200, body, "application/json")
+        elif route == "/trace.json":
+            send(200, trace.export_chrome().encode(), "application/json")
+        elif route == "/metrics" and registries:
+            text = "".join(registry(r).render() for r in registries)
+            send(200, text.encode(), "text/plain; version=0.0.4")
+        else:
+            return False
+    except Exception as e:  # noqa: BLE001 — debug routes must not kill serving
+        log.exception("debug route %s failed", route)
+        send(500, str(e).encode(), "text/plain")
+    return True
+
+
+def serve_debug(
+    bind: str, registries: Sequence[str] = ()
+) -> Tuple[ThreadingHTTPServer, threading.Thread]:
+    """Standalone debug listener: /healthz, /spans, /timeline,
+    /trace.json, and /metrics rendered from the named obs registries
+    (for daemons with no HTTP server of their own — the device plugin)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, body: bytes,
+                  ctype: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path == "/healthz":
+                self._send(200, b"ok", "text/plain")
+                return
+            if not handle_debug_get(self, self._send, registries):
+                self._send(404, b"not found", "text/plain")
+
+        def log_message(self, fmt, *args):  # quiet
+            log.debug("debug http: " + fmt, *args)
+
+    host, _, port = bind.rpartition(":")
+    srv = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)
+    t = threading.Thread(
+        target=srv.serve_forever, name="vtpu-debug-http", daemon=True
+    )
+    t.start()
+    return srv, t
+
+
+def start_span_pusher(
+    url: str,
+    interval_s: float = SPAN_PUSH_INTERVAL_S,
+    stop: Optional[threading.Event] = None,
+) -> threading.Thread:
+    """Daemon thread POSTing the local span ring to ``url`` (the
+    scheduler's /spans/ingest) every ``interval_s``.  Push failures are
+    logged and retried next tick — the collector being down must never
+    affect the pushing daemon.  Receiving side dedups on (pid, span_id),
+    so re-pushing the whole ring is idempotent."""
+    stop = stop or threading.Event()
+
+    def loop() -> None:
+        while not stop.wait(interval_s):
+            try:
+                trace.push_spans(url)
+            except Exception:  # noqa: BLE001 — keep pushing
+                log.debug("span push to %s failed; will retry", url,
+                          exc_info=True)
+
+    t = threading.Thread(target=loop, name="vtpu-span-push", daemon=True)
+    t.stop_event = stop  # type: ignore[attr-defined]
+    t.start()
+    return t

@@ -1,0 +1,73 @@
+"""Shared logging bootstrap for the cmd/ entrypoints.
+
+Every daemon used to call ``logging.basicConfig`` with its own copy of
+the format string; this is the one copy.  Opt-in structured output:
+``VTPU_LOG_FORMAT=json`` switches every record to one JSON object per
+line — machine-shippable, and carrying ``trace_id`` whenever the record
+was emitted inside an active trace span (the log/trace join: grep a pod
+UID in the logs, paste it into /timeline?pod=).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+from vtpu.utils import trace
+
+_TEXT_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+
+
+class TraceContextFilter(logging.Filter):
+    """Stamps ``record.trace_ctx`` from the innermost active span."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.trace_ctx = trace.current_context()
+        return True
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(record.created, 6),
+            "time": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)
+            ) + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        ctx = getattr(record, "trace_ctx", None)
+        if ctx:
+            trace_id, span_id = trace.parse_context(ctx)
+            out["trace_id"] = trace_id
+            if span_id is not None:
+                out["span_id"] = span_id
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def setup_logging(debug: bool = False, fmt: Optional[str] = None) -> None:
+    """Root-logger setup for a daemon process.
+
+    ``fmt``: "json" or "text"; default from ``VTPU_LOG_FORMAT`` (json
+    opt-in, text otherwise).  Idempotent enough for tests: replaces the
+    root handlers it installed before."""
+    fmt = (fmt or os.environ.get("VTPU_LOG_FORMAT", "text")).lower()
+    root = logging.getLogger()
+    root.setLevel(logging.DEBUG if debug else logging.INFO)
+    for h in list(root.handlers):
+        if getattr(h, "_vtpu_obs", False):
+            root.removeHandler(h)
+    handler = logging.StreamHandler()
+    handler._vtpu_obs = True  # type: ignore[attr-defined]
+    if fmt == "json":
+        handler.setFormatter(JsonFormatter())
+        handler.addFilter(TraceContextFilter())
+    else:
+        handler.setFormatter(logging.Formatter(_TEXT_FORMAT))
+    root.addHandler(handler)

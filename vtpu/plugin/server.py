@@ -33,15 +33,24 @@ from typing import Dict, List, Optional
 
 import grpc
 
+from vtpu import obs
 from vtpu.device.allocator import AllocationError, IciAllocator
+from vtpu.k8s.objects import get_annotations
 from vtpu.plugin import api
 from vtpu.plugin import v1beta1_pb2 as pb
 from vtpu.plugin.cache import DeviceCache
 from vtpu.plugin.config import PluginConfig
 from vtpu.utils import allocate as alloc_util
 from vtpu.utils import trace, types
+from vtpu.utils.types import annotations
 
 log = logging.getLogger(__name__)
+
+_ALLOC_HIST = obs.registry("plugin").histogram(
+    "vtpu_plugin_allocate_seconds",
+    "kubelet Allocate latency: pending-pod lookup + annotation pop + "
+    "env/mount injection",
+)
 
 
 def split_device_ids(uuid: str, split_count: int) -> List[str]:
@@ -176,7 +185,7 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
 
     # ------------------------------------------------------------------
     def _container_response(
-        self, devs, pod: dict
+        self, devs, pod: dict, trace_ctx: Optional[str] = None
     ) -> pb.ContainerAllocateResponse:
         """Build env/mount/device injection (ref plugin.go:353-392)."""
         cfg = self.cfg
@@ -207,6 +216,17 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
         resp.envs[f"{pfx}_DEVICE_MEMORY_SHARED_CACHE"] = (
             f"{cfg.container_cache_dir}/vtpu.cache"
         )
+        if trace_ctx:
+            # continue the pod's lifecycle trace inside the container:
+            # the shim runtime roots its shim.init span on this token.
+            # The switch + sink ride along — the token alone is useless
+            # if the tenant's tracing is off or its ring never leaves
+            # the container
+            resp.envs["VTPU_TRACE_CONTEXT"] = trace_ctx
+            resp.envs["VTPU_TRACE"] = "1"
+            sink = os.environ.get("VTPU_SPAN_SINK")
+            if sink:
+                resp.envs["VTPU_SPAN_SINK"] = sink
         if cfg.device_memory_scaling > 1.0:
             resp.envs["VTPU_OVERSUBSCRIBE"] = "true"
         if cfg.core_utilization_policy != "default":
@@ -267,14 +287,18 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
 
     def Allocate(self, request, context):  # noqa: N802
         """ref plugin.go:318-392 + §3.3 call stack."""
+        t0 = time.perf_counter()
         with trace.span(
             "allocate",
             family=self.cfg.device_family,
             devices=sum(len(c.devicesIDs) for c in request.container_requests),
-        ):
-            return self._allocate_inner(request, context)
+        ) as sp:
+            try:
+                return self._allocate_inner(request, context, sp)
+            finally:
+                _ALLOC_HIST.observe(time.perf_counter() - t0)
 
-    def _allocate_inner(self, request, context):
+    def _allocate_inner(self, request, context, sp=None):
         if len(request.container_requests) != 1:
             # exactly one container per Allocate (ref :320-322)
             context.abort(
@@ -289,6 +313,13 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
                 grpc.StatusCode.FAILED_PRECONDITION,
                 "no pod pending allocation on this node",
             )
+        # adopt the trace context the scheduler stamped at filter time, so
+        # this span (and the shim leg it forwards to) join the pod's trace
+        pod_ctx = get_annotations(pending).get(annotations.TRACE_CONTEXT)
+        if sp and pod_ctx:
+            sp["trace_id"], sp["parent"] = trace.parse_context(pod_ctx)
+            sp["pod"] = pending["metadata"].get("name", "")
+        shim_ctx = trace.context_of(sp or {})
         try:
             devs = alloc_util.get_next_device_request(self.cfg.device_type, pending)
             if len(devs) != len(creq.devicesIDs):
@@ -300,7 +331,9 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
                 self.client, self.cfg.device_type, pending
             )
             resp = pb.AllocateResponse()
-            resp.container_responses.append(self._container_response(devs, pending))
+            resp.container_responses.append(
+                self._container_response(devs, pending, trace_ctx=shim_ctx)
+            )
         except Exception as e:  # noqa: BLE001 — any failure must unwind the handshake
             log.exception("Allocate failed")
             alloc_util.pod_allocation_failed(self.client, pending)

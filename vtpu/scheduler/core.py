@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from vtpu import obs
 from vtpu.k8s.objects import get_annotations, pod_uid
 from vtpu.scheduler import nodecheck
 from vtpu.scheduler import score as score_mod
@@ -35,6 +36,24 @@ from vtpu.utils.types import (
 )
 
 log = logging.getLogger(__name__)
+
+# hot-path latency histograms (docs/observability.md metric catalog);
+# always on — one bisect + three adds per observation, invisible next to
+# the paths they time (guarded by make bench-sched)
+_REG = obs.registry("scheduler")
+_FILTER_HIST = _REG.histogram(
+    "vtpu_filter_seconds",
+    "Filter latency by path (fast = live-aggregate single-chip walk, "
+    "general = clone-and-fit)",
+)
+_PATCH_HIST = _REG.histogram(
+    "vtpu_assignment_patch_seconds",
+    "Assignment-annotation PATCH round-trip (runs outside the filter lock)",
+)
+_BIND_HIST = _REG.histogram(
+    "vtpu_bind_seconds",
+    "Bind latency: node lock + bind-phase patch + Binding post",
+)
 
 
 def _now_ts() -> str:
@@ -327,8 +346,21 @@ class Scheduler:
             # not a vtpu pod — pass through unfiltered (ref :453-460)
             return FilterResult(node=None, failed={}, error="")
         pod_annos = get_annotations(pod)
+        uid = pod_uid(pod)
+        # the dominant single-chip shape takes the live-aggregate fast
+        # path inside _select_and_book; label the latency accordingly
+        path = (
+            "fast"
+            if len(reqs) == 1 and len(reqs[0]) == 1 and reqs[0][0].nums == 1
+            else "general"
+        )
+        t_filter = time.perf_counter()
+        # trace root for the pod lifecycle: trace id = pod UID, so the
+        # plugin/shim legs join by reading the propagated context and
+        # /timeline?pod=<uid> reconstructs the whole chain
         with trace.span(
             "filter",
+            trace_id=uid,
             pod=pod.get("metadata", {}).get("name", ""),
             nodes=len(node_names),
         ) as sp:
@@ -343,7 +375,6 @@ class Scheduler:
                 # Same-pod patches serialise on a per-uid lock and only
                 # the still-current booking writes the wire, so annotation
                 # state always converges to the latest local booking.
-                uid = pod_uid(pod)
                 plock = self._acquire_patch_lock(uid)
                 try:
                     if not self.pods.booking_current(uid, res.node):
@@ -363,23 +394,35 @@ class Scheduler:
                                 "assignment superseded by concurrent re-filter",
                             )
                     else:
+                        patch = {
+                            annotations.ASSIGNED_NODE: res.node,
+                            annotations.ASSIGNED_TIME: _now_ts(),
+                            annotations.ASSIGNED_IDS: enc,
+                            annotations.DEVICES_TO_ALLOCATE: enc,
+                            # a fresh assignment supersedes any stale
+                            # bind-phase from a previous failed
+                            # attempt — left in place it would make
+                            # the ingest sweep drop this booking
+                            # (merge-patch null deletes)
+                            annotations.BIND_PHASE: None,
+                        }
+                        ctx = trace.context_of(sp)
+                        if ctx is not None:
+                            # propagate the trace so the plugin's Allocate
+                            # continues this pod's lifecycle trace
+                            patch[annotations.TRACE_CONTEXT] = ctx
+                        t_patch = time.perf_counter()
                         try:
-                            self.client.patch_pod_annotations(
-                                pod["metadata"].get("namespace", "default"),
-                                pod["metadata"]["name"],
-                                {
-                                    annotations.ASSIGNED_NODE: res.node,
-                                    annotations.ASSIGNED_TIME: _now_ts(),
-                                    annotations.ASSIGNED_IDS: enc,
-                                    annotations.DEVICES_TO_ALLOCATE: enc,
-                                    # a fresh assignment supersedes any stale
-                                    # bind-phase from a previous failed
-                                    # attempt — left in place it would make
-                                    # the ingest sweep drop this booking
-                                    # (merge-patch null deletes)
-                                    annotations.BIND_PHASE: None,
-                                },
-                            )
+                            with trace.span(
+                                "assign_patch",
+                                pod=pod["metadata"]["name"],
+                                node=res.node,
+                            ):
+                                self.client.patch_pod_annotations(
+                                    pod["metadata"].get("namespace", "default"),
+                                    pod["metadata"]["name"],
+                                    patch,
+                                )
                         except Exception as e:  # noqa: BLE001 — unbook
                             log.exception(
                                 "filter: assignment patch failed for %s; "
@@ -394,10 +437,13 @@ class Scheduler:
                             )
                         else:
                             self.pods.confirm_pod(uid, res.node)
+                        finally:
+                            _PATCH_HIST.observe(time.perf_counter() - t_patch)
                 finally:
                     self._release_patch_lock(uid, plock)
             sp["node"] = res.node
             sp["failed"] = len(res.failed)
+            _FILTER_HIST.observe(time.perf_counter() - t_filter, path=path)
             return res
 
     def _acquire_patch_lock(self, uid: str):
@@ -569,8 +615,15 @@ class Scheduler:
         """Returns error string or None on success.  ``pod_uid`` (from
         ExtenderBindingArgs) lets the failure path unbook a pod that has
         already vanished from the API."""
-        with trace.span("bind", pod=name, node=node) as sp:
-            err = self._bind_inner(namespace, name, node, pod_uid)
+        t0 = time.perf_counter()
+        # join the pod's lifecycle trace rooted at filter time (trace id
+        # is the pod UID; parentage reconstructs via /timeline)
+        with trace.span("bind", trace_id=pod_uid or None,
+                        pod=name, node=node) as sp:
+            try:
+                err = self._bind_inner(namespace, name, node, pod_uid)
+            finally:
+                _BIND_HIST.observe(time.perf_counter() - t0)
             sp["error"] = err or ""
             return err
 

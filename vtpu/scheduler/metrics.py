@@ -1,33 +1,33 @@
 """Scheduler Prometheus metrics (ref: cmd/scheduler/metrics.go:73-249).
 
-Text exposition format written by hand — the gauge families mirror the
-reference's: per-device limit/allocated/share-count, node overview, and
-per-pod allocations.
+Exposition built on the shared vtpu.obs renderer — the gauge families
+mirror the reference's (per-device limit/allocated/share-count, node
+overview, per-pod allocations) and are byte-identical to the pre-obs
+hand-rolled output (tests/golden/scheduler_metrics.txt); the obs
+registry's hot-path latency histograms are appended after them.
 """
 
 from __future__ import annotations
 
 from typing import List
 
+from vtpu import obs
+from vtpu.obs import render_family
 from vtpu.scheduler.core import Scheduler
 
 _MB = 1024 * 1024
 
 
-def _esc(s: str) -> str:
-    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+def render_metrics(sched: Scheduler, include_obs: bool = True) -> str:
+    """Render the full exposition (ref Collect metrics.go:73-204).
 
-
-def render_metrics(sched: Scheduler) -> str:
-    """Render the full exposition (ref Collect metrics.go:73-204)."""
+    ``include_obs=False`` stops after the legacy families — the golden
+    generator uses it so regenerated goldens never bake in the
+    timing-dependent histogram bucket counts."""
     lines: List[str] = []
 
     def gauge(name: str, help_: str, samples: List[tuple]) -> None:
-        lines.append(f"# HELP {name} {help_}")
-        lines.append(f"# TYPE {name} gauge")
-        for labels, value in samples:
-            lbl = ",".join(f'{k}="{_esc(str(v))}"' for k, v in labels.items())
-            lines.append(f"{name}{{{lbl}}} {value}")
+        render_family(lines, name, help_, "gauge", samples)
 
     usage = sched.inspect_usage()
 
@@ -132,9 +132,7 @@ def render_metrics(sched: Scheduler) -> str:
     # fallback/dirty-rebuild rate means deltas are being invalidated and
     # filters are paying rebuild cost again
     def counter(name: str, help_: str, value) -> None:
-        lines.append(f"# HELP {name} {help_}")
-        lines.append(f"# TYPE {name} counter")
-        lines.append(f"{name} {value}")
+        render_family(lines, name, help_, "counter", [({}, value)])
 
     cache = sched.usage_cache.stats()
     counter(
@@ -173,4 +171,10 @@ def render_metrics(sched: Scheduler) -> str:
         "Filter selections re-run because the chosen node changed mid-walk",
         sched.filter_gen_retries,
     )
-    return "\n".join(lines) + "\n"
+    # hot-path latency histograms (vtpu_filter_seconds & friends,
+    # vtpu/scheduler/core.py) — appended AFTER the legacy families so the
+    # pre-obs exposition stays a byte-exact prefix for dashboards
+    legacy = "\n".join(lines) + "\n"
+    if not include_obs:
+        return legacy
+    return legacy + obs.registry("scheduler").render()

@@ -97,16 +97,16 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 — http.server API
         if self.path == "/healthz":
             self._send(200, b"ok", "text/plain")
-        elif self.path == "/spans" and self.allow_debug:
-            from vtpu.utils import trace
+        elif self.allow_debug and self.path.split("?", 1)[0] in (
+            "/spans", "/timeline", "/trace.json"
+        ):
+            # shared debug surface (vtpu/obs/http.py): /spans?n=&name=,
+            # /timeline?pod=<uid> (the merged pod-lifecycle view), and
+            # the Chrome trace-event export
+            from vtpu.obs.http import handle_debug_get
 
-            try:
-                # default=str: span attrs are arbitrary objects by contract
-                body = json.dumps(trace.recent_spans(), default=str).encode()
-                self._send(200, body)
-            except Exception as e:  # noqa: BLE001
-                log.exception("spans render failed")
-                self._send(500, str(e).encode(), "text/plain")
+            if not handle_debug_get(self, self._send):
+                self._send(404, b"not found", "text/plain")
         elif self.path == "/metrics":
             try:
                 body = render_metrics(self.scheduler).encode()
@@ -129,6 +129,13 @@ class _Handler(BaseHTTPRequestHandler):
                 out = bind_handler(self.scheduler, body)
             elif self.path == "/webhook":
                 out = handle_admission_review(body, self.scheduler.config)
+            elif self.path == "/spans/ingest" and self.allow_debug:
+                # merged span feed: plugin/monitor push their ring
+                # buffers here so /timeline sees the whole pod lifecycle
+                from vtpu.utils import trace
+
+                spans = body if isinstance(body, list) else body.get("spans", [])
+                out = {"ingested": trace.ingest(spans)}
             else:
                 self._send(404, b"not found", "text/plain")
                 return

@@ -36,14 +36,23 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import time
 from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vtpu import obs
 from vtpu.models.transformer import TransformerLM, _zero_cache
 from vtpu.ops.quant import dequantize_tree
+
+# queue-to-first-token: submit() → the request's first harvested token
+# (covers queue wait + prefill), the serving-tier latency SLO input
+_QTFT_HIST = obs.registry("serving").histogram(
+    "vtpu_batcher_queue_to_first_token_seconds",
+    "Latency from submit() to the request's first generated token",
+)
 
 
 @dataclasses.dataclass
@@ -51,6 +60,7 @@ class _Request:
     rid: str
     prompt: np.ndarray  # [s] int32
     num_new: int
+    submitted: float = 0.0  # perf_counter at submit()
 
 
 class ContinuousBatcher:
@@ -183,7 +193,8 @@ class ContinuousBatcher:
             or any(st["req"].rid == rid for st in self.prefilling.values())
         ):
             raise ValueError(f"duplicate request id {rid!r}")
-        self.queue.append(_Request(rid, prompt, num_new))
+        self.queue.append(_Request(rid, prompt, num_new,
+                                   submitted=time.perf_counter()))
         self._admit_pending()
 
     def _free_slots(self) -> List[int]:
@@ -247,6 +258,8 @@ class ContinuousBatcher:
         batch cache and put the slot into decode rotation."""
         self._merge_row(slot, row_cache)
         first = int(jnp.argmax(logits[0, -1]))
+        if req.submitted:
+            _QTFT_HIST.observe(time.perf_counter() - req.submitted)
         self.tok = self.tok.at[slot].set(first)
         self.rid[slot] = req.rid
         self.out[req.rid] = [first]

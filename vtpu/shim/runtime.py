@@ -17,9 +17,21 @@ import os
 import time
 from typing import Callable, Dict, List, Optional
 
+from vtpu import obs
 from vtpu.monitor.shared_region import RegionFile, open_region
+from vtpu.utils import trace
 
 log = logging.getLogger(__name__)
+
+_SHIM_REG = obs.registry("shim")
+_PACE_HIST = _SHIM_REG.histogram(
+    "vtpu_shim_pace_sleep_seconds",
+    "Core-percentage pacing sleeps injected per dispatch",
+)
+_QUOTA_HIST = _SHIM_REG.histogram(
+    "vtpu_shim_quota_check_seconds",
+    "HBM-quota check-and-add latency (region flock + accounting)",
+)
 
 
 class QuotaExceeded(MemoryError):
@@ -113,20 +125,32 @@ class ShimRuntime:
         path = region_path or os.environ.get(
             "TPU_DEVICE_MEMORY_SHARED_CACHE", "/tmp/vtpu/vtpu.cache"
         )
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self.region: Optional[RegionFile] = open_region(path, create=True)
-        if self.region is not None:
-            names = uuids or (
-                os.environ.get("VTPU_VISIBLE_UUIDS", "tpu-0").split(",")
-            )
-            self.region.set_devices(
-                names,
-                (self.limits + [0] * len(names))[: len(names)],
-                [self.core_limit] * len(names),
-            )
-            # fresh: this runtime is starting up — a dead predecessor's
-            # recycled pid must not hand it phantom usage
-            self.region.register_proc(self.pid, self.priority, fresh=True)
+        # the final leg of the pod-lifecycle trace: the plugin's Allocate
+        # forwarded its span context through the env ABI, so shim startup
+        # shows up on /timeline under the same trace id as filter/bind
+        with trace.span(
+            "shim.init", ctx=os.environ.get("VTPU_TRACE_CONTEXT"),
+            tenant_pid=self.pid,
+        ):
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self.region: Optional[RegionFile] = open_region(path, create=True)
+            if self.region is not None:
+                names = uuids or (
+                    os.environ.get("VTPU_VISIBLE_UUIDS", "tpu-0").split(",")
+                )
+                self.region.set_devices(
+                    names,
+                    (self.limits + [0] * len(names))[: len(names)],
+                    [self.core_limit] * len(names),
+                )
+                # fresh: this runtime is starting up — a dead predecessor's
+                # recycled pid must not hand it phantom usage
+                self.region.register_proc(self.pid, self.priority, fresh=True)
+        # span feed out of the container: the plugin's Allocate forwards
+        # VTPU_SPAN_SINK alongside the trace context, so the shim.init
+        # span (and everything later) reaches /timeline on the collector
+        self._span_sink = os.environ.get("VTPU_SPAN_SINK", "")
+        self._push_spans()
         # local (per-tenant) accounting mirrors the region
         self._local: Dict[int, int] = {}
         # bytes placed in the host tier past quota (oversubscribe)
@@ -175,11 +199,13 @@ class ShimRuntime:
         cross-process flock — two tenants racing for the last bytes cannot
         both be admitted."""
         limit = self.limit_for(dev)
+        t0 = time.perf_counter()
         if self.region is not None:
             ok = self.region.try_add(
                 self.pid, dev, nbytes, kind, limit=limit,
                 oversubscribe=self.oversubscribe,
             )
+            _QUOTA_HIST.observe(time.perf_counter() - t0)
             if not ok:
                 raise _oom_reject(
                     self,
@@ -187,7 +213,9 @@ class ShimRuntime:
                     f"(in use {self.device_usage(dev)}, want {nbytes})",
                 )
         elif limit and not self.oversubscribe:
-            if self._local.get(dev, 0) + nbytes > limit:
+            over = self._local.get(dev, 0) + nbytes > limit
+            _QUOTA_HIST.observe(time.perf_counter() - t0)
+            if over:
                 raise _oom_reject(
                     self, f"vtpu: device {dev} quota {limit} B exceeded"
                 )
@@ -205,9 +233,11 @@ class ShimRuntime:
         last bytes cannot both be admitted."""
         limit = self.limit_for(dev)
         if self.region is not None:
+            t0 = time.perf_counter()
             ok = self.region.try_add(
                 self.pid, dev, nbytes, "buffer", limit=limit, oversubscribe=False
             )
+            _QUOTA_HIST.observe(time.perf_counter() - t0)
             if ok:
                 self._local[dev] = self._local.get(dev, 0) + nbytes
             return ok
@@ -372,7 +402,9 @@ class ShimRuntime:
             self._since_sync = 0
             return out
         if self._last_step_s > 0:
-            time.sleep(self._last_step_s * (100 - q) / q)
+            pause = self._last_step_s * (100 - q) / q
+            time.sleep(pause)
+            _PACE_HIST.observe(pause)
         out = self._run_fn(fn, args, kwargs)
         self._since_sync += 1
         if self._since_sync >= self._sync_every:
@@ -469,7 +501,9 @@ class ShimRuntime:
                 suspended = False
             q = self.core_limit
             if 0 < q < 100 and not suspended:
-                time.sleep(dt * (100 - q) / q)
+                pause = dt * (100 - q) / q
+                time.sleep(pause)
+                _PACE_HIST.observe(pause)
             return out
 
         return wrapper
@@ -482,7 +516,18 @@ class ShimRuntime:
             "bytes_host_swapped": self._swapped.get(dev, 0),
         }
 
+    def _push_spans(self) -> None:
+        """Best-effort ring push to the collector (idempotent server-side
+        dedup); a missing/down collector never affects the tenant."""
+        if self._span_sink and trace.tracing():
+            try:
+                trace.push_spans(self._span_sink, timeout=2.0)
+            except Exception:  # noqa: BLE001 — telemetry must not break tenants
+                log.debug("span push to %s failed", self._span_sink,
+                          exc_info=True)
+
     def close(self) -> None:
+        self._push_spans()
         if self.region is not None:
             self.region.close()
             self.region = None

@@ -30,6 +30,10 @@ class annotations:
     # -- pod: bind handshake
     BIND_PHASE = "vtpu.io/bind-phase"              # allocating | success | failed
     BIND_TIME = "vtpu.io/bind-time"
+    # -- pod: trace-context propagation (rebuild addition, no ref analog):
+    # "<trace_id>:<span_id>" stamped by the scheduler's Filter, continued
+    # by the plugin's Allocate and the shim (docs/observability.md)
+    TRACE_CONTEXT = "vtpu.io/trace-context"
     # -- pod: chip-type selectors (ref nvidia.com/use-gputype, nouse-gputype)
     USE_TPUTYPE = "vtpu.io/use-tputype"
     NOUSE_TPUTYPE = "vtpu.io/nouse-tputype"
