@@ -7,7 +7,7 @@ IMG ?= vtpu/vtpu
 PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
-	bench-sched obs-lint image chart clean tidy
+	bench-sched bench-serve obs-lint image chart clean tidy
 
 all: build
 
@@ -133,6 +133,15 @@ bench:
 # explains how to read the before/after numbers.
 bench-sched:
 	$(PY) benchmarks/scheduler_scale.py --nodes 1000 --pods 200
+
+# serving decode-loop proof: paired pipeline_depth=0 vs pipelined runs
+# of both continuous-batching engines, locally and behind the simulated
+# relayed transport; refreshes docs/artifacts/serving_pipeline.json.
+# CPU-runnable (falls back to JAX_PLATFORMS=cpu when no PJRT plugin
+# initializes and records the measured platform in the artifact).
+# docs/perf.md#serving-pipeline explains how to read the numbers.
+bench-serve:
+	$(PY) benchmarks/serving_pipeline.py
 
 # (Re)arm the detached TPU-window watcher.  Safe to run unconditionally at
 # the start of every session: a live watcher keeps its lock and the new

@@ -307,29 +307,52 @@ def run_streams(forward, x, batch, seconds: float, n_streams: int = 4,
     return [c / elapsed for c in counts], sum(violations)
 
 
+def _probe_devices(platform: str | None):
+    """jax.devices(), optionally pinned to ``platform`` (module-level so
+    tests can stub the backend without importing jax)."""
+    import jax
+
+    if platform is not None:
+        os.environ["JAX_PLATFORMS"] = platform
+        jax.config.update("jax_platforms", platform)
+    return jax.devices()
+
+
+def _clear_backends():
+    try:
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def init_devices(retries: int = 4, backoff_s: float = 15.0):
     """``jax.devices()`` with bounded retry — the TPU tunnel backend can
     be transiently UNAVAILABLE (BENCH_r01 failure mode).  Between
     attempts the failed backend set is cleared so JAX actually re-probes
-    instead of returning the cached failure."""
+    instead of returning the cached failure.  When every attempt fails
+    (no TPU/axon PJRT plugin present at all), fall back to the CPU
+    platform instead of dying with the raw ``Unable to initialize
+    backend`` traceback — the bench still owes the driver a JSON line,
+    and the artifact records the platform it actually measured."""
     last = None
     for attempt in range(retries):
         try:
-            import jax
-
-            return jax.devices()
+            return _probe_devices(None)
         except Exception as e:  # noqa: BLE001 — init errors vary by backend
             last = e
             log(f"backend init attempt {attempt + 1}/{retries} failed: {e}")
-            try:
-                from jax.extend.backend import clear_backends
-
-                clear_backends()
-            except Exception:  # noqa: BLE001
-                pass
+            _clear_backends()
             if attempt + 1 < retries:
                 time.sleep(backoff_s * (attempt + 1))
-    raise last
+    phase_note("backend_init", rc="fallback_cpu", error=str(last)[:200])
+    log("backend init exhausted retries; falling back to JAX_PLATFORMS=cpu")
+    _clear_backends()
+    try:
+        return _probe_devices("cpu")
+    except Exception:  # noqa: BLE001 — surface the ORIGINAL failure
+        raise last
 
 
 # ---------------------------------------------------------------------------

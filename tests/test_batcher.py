@@ -191,6 +191,130 @@ def test_instant_retirement_does_not_clobber_nested_admissions():
     assert out == want
 
 
+@pytest.mark.parametrize("pipeline_depth", [0, 1, 2])
+@pytest.mark.parametrize("harvest_every", [1, 4])
+def test_pipelined_token_exact(pipeline_depth, harvest_every):
+    """The pipelined decode loop (windows in flight while the host
+    harvests) stays token-identical to solo generate() across depths —
+    including depth 0, the synchronous escape hatch."""
+    model, params = make_model()
+    prompts = prompts_for(model, 4, [3, 5, 4, 6])
+    budgets = [7, 4, 6, 3]
+    want = {
+        f"r{i}": np.asarray(
+            generate(model, params, jnp.asarray(p)[None], num_new=n)
+        )[0].tolist()
+        for i, (p, n) in enumerate(zip(prompts, budgets))
+    }
+    eng = ContinuousBatcher(model, params, max_batch=2,
+                            harvest_every=harvest_every,
+                            pipeline_depth=pipeline_depth)
+    for i, (p, n) in enumerate(zip(prompts, budgets)):
+        eng.submit(f"r{i}", p, num_new=n)
+    assert eng.run() == want
+
+
+def test_bucketing_off_matches_on():
+    """bucket_prefill pads prompts to power-of-two lengths; padding is
+    exact (position-rewind contract) so outputs must not change."""
+    model, params = make_model()
+    prompts = prompts_for(model, 4, [3, 5, 4, 6], seed=17)
+    budgets = [5, 6, 4, 7]
+    outs = []
+    for bucket in (True, False):
+        eng = ContinuousBatcher(model, params, max_batch=2,
+                                bucket_prefill=bucket)
+        for i, (p, n) in enumerate(zip(prompts, budgets)):
+            eng.submit(f"r{i}", p, num_new=n)
+        outs.append(eng.run())
+    assert outs[0] == outs[1]
+
+
+def test_bucketed_prefill_compile_count_bounded():
+    """The point of the buckets: admission prefill compiles are bounded
+    by (length buckets × row buckets), not one program per distinct
+    prompt length."""
+    model, params = make_model()
+    eng = ContinuousBatcher(model, params, max_batch=4, harvest_every=4)
+    lens = [3, 4, 5, 6, 7, 8, 9, 10, 11, 3, 5, 9]
+    prompts = prompts_for(model, len(lens), lens, seed=23)
+    for i, p in enumerate(prompts):
+        eng.submit(f"r{i}", p, num_new=4)
+    eng.run()
+    size = getattr(eng._admit_prog, "_cache_size", None)
+    if size is None:
+        pytest.skip("jit cache introspection unavailable")
+    len_buckets = {eng._bucket_len(n) for n in lens}     # {4, 8, 16}
+    row_buckets = {1, 2, 4}                              # pow2 ≤ max_batch
+    assert size() <= len(len_buckets) * len(row_buckets), (
+        f"{size()} admission programs for {len(set(lens))} distinct "
+        f"prompt lengths — bucketing is not bounding the compile cache"
+    )
+
+
+def test_rerun_after_run_with_donated_cache():
+    """Regression: donation must not break a second batch of requests
+    on the SAME engine after run() completes (a stale reference to a
+    donated cache/token buffer would fail loudly here)."""
+    model, params = make_model()
+    prompts = prompts_for(model, 4, [3, 5, 4, 6])
+    budgets = [7, 4, 6, 3]
+    want = {
+        f"r{i}": np.asarray(
+            generate(model, params, jnp.asarray(p)[None], num_new=n)
+        )[0].tolist()
+        for i, (p, n) in enumerate(zip(prompts, budgets))
+    }
+    eng = ContinuousBatcher(model, params, max_batch=2, harvest_every=4)
+    for i, (p, n) in enumerate(zip(prompts[:2], budgets[:2])):
+        eng.submit(f"r{i}", p, num_new=n)
+    eng.run()
+    for i, (p, n) in enumerate(zip(prompts[2:], budgets[2:]), start=2):
+        eng.submit(f"r{i}", p, num_new=n)
+    assert eng.run() == want
+
+
+def test_chunked_tail_padding_never_spills_past_max_seq():
+    """Regression: a padded TAIL chunk whose end would cross max_seq
+    must be capped — an uncapped pad's dense write clamps its start
+    backward over real prompt K/V (dynamic_update_slice semantics) and
+    silently corrupts tokens.  max_seq=16, prefill_chunk=6, prompt 13:
+    the tail chunk at lo=12 may pad to at most 16-12=4 tokens."""
+    model, params = make_model(max_seq=16)
+    (p,) = prompts_for(model, 1, [13], seed=31)
+    want = np.asarray(
+        generate(model, params, jnp.asarray(p)[None], num_new=3)
+    )[0].tolist()
+    eng = ContinuousBatcher(model, params, max_batch=2, prefill_chunk=6)
+    eng.submit("x", p, num_new=3)
+    assert eng.run()["x"] == want
+
+
+def test_duplicate_rid_rejected_after_completion():
+    """The O(1) rid set is append-only: a finished rid stays taken (its
+    transcript stays in out), exactly like the old full-scan check."""
+    model, params = make_model()
+    (p,) = prompts_for(model, 1, [4])
+    eng = ContinuousBatcher(model, params, max_batch=2)
+    eng.submit("x", p, num_new=2)
+    eng.run()
+    with pytest.raises(ValueError, match="duplicate"):
+        eng.submit("x", p, num_new=2)
+
+
+def test_instant_retire_without_any_decode_window():
+    """num_new=1 retires at admission; its (deferred) first token must
+    still land in out even though no decode window ever runs."""
+    model, params = make_model()
+    (p,) = prompts_for(model, 1, [5], seed=9)
+    want = np.asarray(
+        generate(model, params, jnp.asarray(p)[None], num_new=1)
+    )[0].tolist()
+    eng = ContinuousBatcher(model, params, max_batch=2)
+    eng.submit("only", p, num_new=1)
+    assert eng.run() == {"only": want}
+
+
 @pytest.mark.parametrize("k", [4, 8])
 def test_windowed_harvest_token_exact(k):
     """harvest_every=k fuses k decode steps into one scan + one host
@@ -258,19 +382,15 @@ def test_windowed_harvest_fewer_syncs():
     win = ContinuousBatcher(model, params, max_batch=1, harvest_every=8)
     dispatches = []
     for eng in (ref, win):
-        orig_1, orig_k = eng._step, eng._step_k
+        orig_k = eng._step_k
         count = {"n": 0}
         dispatches.append(count)
-
-        def step1(params, cache, tok, _orig=orig_1, _c=count):
-            _c["n"] += 1
-            return _orig(params, cache, tok)
 
         def stepk(params, cache, tok, k, _orig=orig_k, _c=count):
             _c["n"] += 1
             return _orig(params, cache, tok, k)
 
-        eng._step, eng._step_k = step1, stepk
+        eng._step_k = stepk
     ref.submit("a", p, num_new=16)
     win.submit("a", p, num_new=16)
     assert ref.run() == win.run()

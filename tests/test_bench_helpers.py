@@ -350,3 +350,39 @@ def test_emit_nulls_value_on_fallback(capsys):
     assert out["value"] == 0.986
     assert out["vs_baseline"] == round(0.986 / 0.95, 4)
     assert "fallback_ratio" not in out["extra"]
+
+
+def test_init_devices_falls_back_to_cpu(monkeypatch):
+    """The BENCH_r01 failure mode: no TPU/axon PJRT plugin initializes —
+    init_devices must fall back to JAX_PLATFORMS=cpu (recording a phase
+    note) instead of dying with the raw backend traceback."""
+    calls = []
+
+    def fake_probe(platform):
+        calls.append(platform)
+        if platform is None:
+            raise RuntimeError(
+                "Unable to initialize backend 'axon': UNAVAILABLE"
+            )
+        return [f"fake-{platform}-device"]
+
+    monkeypatch.setattr(bench, "_probe_devices", fake_probe)
+    monkeypatch.setattr(bench, "_clear_backends", lambda: None)
+    monkeypatch.setattr(bench.time, "sleep", lambda _s: None)
+    bench.PHASE_LOG.clear()
+    devs = bench.init_devices(retries=2)
+    assert devs == ["fake-cpu-device"]
+    assert calls == [None, None, "cpu"]
+    assert any(e.get("phase") == "backend_init"
+               and e.get("rc") == "fallback_cpu" for e in bench.PHASE_LOG)
+
+
+def test_init_devices_reraises_original_when_cpu_also_fails(monkeypatch):
+    def fake_probe(platform):
+        raise RuntimeError(f"no backend for {platform}")
+
+    monkeypatch.setattr(bench, "_probe_devices", fake_probe)
+    monkeypatch.setattr(bench, "_clear_backends", lambda: None)
+    monkeypatch.setattr(bench.time, "sleep", lambda _s: None)
+    with pytest.raises(RuntimeError, match="no backend for None"):
+        bench.init_devices(retries=2)

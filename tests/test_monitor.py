@@ -469,6 +469,28 @@ def test_shim_runtime_dispatch_paces_async_dispatch(tmp_path):
     rt.close()
 
 
+def test_nbytes_from_shape_dtype_without_materializing():
+    """The quota check must size an array-like from shape×dtype when it
+    lacks ``nbytes`` — the old np.asarray fallback was a full
+    device→host transfer inside the hot path."""
+    import numpy as np
+
+    from vtpu.shim.runtime import _nbytes_of
+
+    class Deviceish:
+        """Has shape/dtype but no nbytes; materializing it explodes."""
+        shape = (4, 8)
+        dtype = np.dtype(np.float32)
+
+        def __array__(self, *a, **kw):
+            raise AssertionError("quota check materialized the array")
+
+    assert _nbytes_of(Deviceish()) == 4 * 8 * 4
+    # plain nbytes carriers and nested lists still size correctly
+    assert _nbytes_of(np.ones((3, 2), np.int16)) == 12
+    assert _nbytes_of([[1.0, 2.0], [3.0, 4.0]]) == 32
+
+
 def test_shim_runtime_device_put_strict_without_oversubscribe(tmp_path):
     """Without oversubscribe, an over-quota device_put rejects (no silent
     host tier), and the tier check-and-add is the atomic region path."""

@@ -134,11 +134,12 @@ def drive_fuzzed(eng: PagedBatcher, reqs, seed: int):
     after every operation."""
     rng = np.random.default_rng(seed + 1000)
     pending = list(reqs)
-    while pending or eng.queue or eng.prefilling or any(eng.active):
+    while (pending or eng.queue or eng.prefilling or any(eng.active)
+           or eng._inflight):
         ops = []
         if pending:
             ops.append("submit")
-        if eng.queue or eng.prefilling or any(eng.active):
+        if eng.queue or eng.prefilling or any(eng.active) or eng._inflight:
             ops.append("step")
         op = rng.choice(ops)
         if op == "submit":
@@ -151,6 +152,8 @@ def drive_fuzzed(eng: PagedBatcher, reqs, seed: int):
         else:
             eng.step()
         check_pool_invariants(eng)
+    # deferred first tokens of never-decoded admissions (num_new=1)
+    eng._flush_first_tokens()
     return dict(eng.out)
 
 
@@ -158,10 +161,20 @@ def drive_fuzzed(eng: PagedBatcher, reqs, seed: int):
 @pytest.mark.parametrize(
     "cfg",
     [
+        # the pipelined default (depth=1, bucketed) and the synchronous
+        # escape hatch, crossed with fused windows, chunked prefill,
+        # and bucketing off — every engine mode the serving tier ships
         dict(prefix_cache=2, prefill_chunk=0, harvest_every=1),
         dict(prefix_cache=2, prefill_chunk=4, harvest_every=4),
+        dict(prefix_cache=2, prefill_chunk=0, harvest_every=4,
+             pipeline_depth=0, bucket_prefill=False),
+        dict(prefix_cache=2, prefill_chunk=0, harvest_every=8,
+             pipeline_depth=2),
+        dict(prefix_cache=2, prefill_chunk=4, harvest_every=1,
+             pipeline_depth=2, bucket_prefill=False),
     ],
-    ids=["plain", "chunked_windowed"],
+    ids=["pipelined", "chunked_windowed", "sync_unbucketed",
+         "deep_pipeline", "chunked_deep_unbucketed"],
 )
 def test_fuzzed_interleavings_conserve_blocks(seed, cfg):
     dense_m = TransformerLM(**KW)
@@ -187,12 +200,37 @@ def test_fuzzed_interleavings_conserve_blocks(seed, cfg):
     # divergence is a paging bug, not batching nondeterminism)
     dense = ContinuousBatcher(
         dense_m, params, max_batch=3,
-        prefill_chunk=cfg["prefill_chunk"],
-        harvest_every=cfg["harvest_every"],
+        **{k: v for k, v in cfg.items() if k != "prefix_cache"},
     )
     for rid, p, n in reqs:
         dense.submit(rid, p, num_new=n)
     assert got == dense.run()
+
+
+def test_rerun_after_run_with_donated_pool():
+    """Regression: the donated pool/admission buffers must survive a
+    second batch of requests on the SAME engine after run() completes —
+    a stale reference to a donated buffer would fail loudly here."""
+    paged_m = TransformerLM(**KW, kv_cache_layout="paged",
+                            kv_block_size=BLOCK, kv_pool_blocks=8)
+    dense_m = TransformerLM(**KW)
+    params = params_for(dense_m)
+    reqs = fuzz_schedule(3, n_reqs=6)
+    eng = PagedBatcher(paged_m, params, max_batch=3, prefix_cache=2,
+                       harvest_every=4)
+    dense = ContinuousBatcher(dense_m, params, max_batch=3,
+                              harvest_every=4)
+    for rid, p, n in reqs[:3]:
+        eng.submit(rid, p, num_new=n)
+        dense.submit(rid, p, num_new=n)
+    eng.run()
+    dense.run()
+    check_pool_invariants(eng)
+    for rid, p, n in reqs[3:]:
+        eng.submit(rid, p, num_new=n)
+        dense.submit(rid, p, num_new=n)
+    assert eng.run() == dense.run()
+    check_pool_invariants(eng)
 
 
 def test_refcount_drift_is_caught():

@@ -1,0 +1,42 @@
+"""bench-serve harness smoke: the paired pipeline bench must produce a
+schema-complete artifact on the CPU backend (tiny workload — this pins
+the harness, not the performance numbers; those live in the committed
+docs/artifacts/serving_pipeline.json)."""
+
+import json
+
+import pytest
+
+pytestmark = pytest.mark.slow  # JAX workload lane (CPU-mesh compiles)
+
+
+def test_bench_serve_artifact_schema(tmp_path):
+    from benchmarks import serving_pipeline
+
+    out = tmp_path / "serving_pipeline.json"
+    rc = serving_pipeline.main([
+        "--requests", "4", "--repeats", "1", "--engines", "dense",
+        "--harvest-every", "2", "--sync-latency-us", "0,200",
+        "--max-batch", "2", "--out", str(out),
+    ])
+    assert rc == 0
+    res = json.loads(out.read_text())
+    assert res["platform"]  # the measured platform is recorded
+    assert isinstance(res["backend_fallback"], bool)
+    assert len(res["benches"]) == 2  # dense × {local, relayed-sim}
+    for b in res["benches"]:
+        for arm in ("pipeline_off", "pipeline_on"):
+            a = b[arm]
+            assert a["tokens"] > 0
+            assert a["wall_s"] > 0
+            assert a["device_busy_s"] > 0
+            assert "host_overhead_us_per_token" in a
+            assert "transport_stall_s" in a
+        assert "host_overhead_reduction" in b
+    # headline comes from the relayed-transport dense pair
+    assert "host_overhead_reduction" in res
+    # both arms produced the SAME tokens (exactness is pinned elsewhere;
+    # this guards the harness against arm drift)
+    off, on = res["benches"][0]["pipeline_off"], res["benches"][0][
+        "pipeline_on"]
+    assert off["tokens"] == on["tokens"]

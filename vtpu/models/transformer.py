@@ -547,6 +547,31 @@ class TransformerLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def bucket_length(n: int, max_seq: int) -> int:
+    """Smallest power of two >= ``n``, clamped to ``max_seq`` — the
+    serving tier's prefill padding buckets.  Padding a prompt on the
+    RIGHT to a bucket length is exact under the decode path: real
+    positions never attend to the padding (causal mask), and the
+    garbage K/V the padding writes beyond the true prompt length sit at
+    positions >= the rewound counter, so position-masked reads never
+    see them and decode overwrites them before advancing past.  Bounds
+    the prefill compile cache at log2(max_seq)+1 programs instead of
+    one per distinct prompt length."""
+    return min(1 << (max(1, int(n)) - 1).bit_length(), max_seq)
+
+
+def set_cache_pos(cache, pos):
+    """Return ``cache`` with the model's single position counter set to
+    ``pos`` (shape-preserving: the counter is a per-row [b] vector).
+    This is the rewind half of the bucketed-prefill contract above and
+    of speculative decoding's rejection path: K/V beyond the counter
+    are never read (position-masked) and get overwritten on the next
+    advance, so moving the counter is free."""
+    c = dict(cache)
+    c["pos"] = jnp.full_like(cache["pos"], pos)
+    return c
+
+
 def _zero_cache(model: TransformerLM, prompt):
     """Pristine decode cache for ``model`` (shapes via eval_shape — no
     throwaway params, no real forward)."""
@@ -761,11 +786,7 @@ def generate_speculative(model: TransformerLM, params,
                 f"({k + 1}) exceeds the {who} model's max_seq ({m.max_seq})"
             )
 
-    def set_pos(cache, pos):
-        c = dict(cache)
-        # full_like keeps the counter's shape ([b] per-row vector)
-        c["pos"] = jnp.full_like(cache["pos"], pos)
-        return c
+    set_pos = set_cache_pos  # one copy of the rewind contract
 
     @jax.jit
     def target_apply(cache, toks):

@@ -11,10 +11,32 @@ so the chip never drains the whole batch to admit new work.
 Why this shape on TPU:
 - the step function compiles ONCE ([max_batch, 1] tokens, [b] positions;
   no dynamic shapes), so admission/retirement never retraces;
-- prefill compiles per distinct prompt length (pad prompts client-side
-  to a few buckets to bound compile count);
+- prefill pads prompts to power-of-two BUCKETS (bucket_prefill), so the
+  compile cache is bounded at log2(max_seq)+1 length programs instead
+  of one per distinct prompt length;
 - inactive slots still run the decode math on garbage rows — uniform
   compute is the price of static shapes, and it is MXU-cheap at s=1.
+
+The decode loop is PIPELINED (pipeline_depth, default 1): window k+1 is
+dispatched the moment window k returns its (unmaterialized) token
+array, and window k's tokens are harvested on the host WHILE the device
+runs k+1 — JAX's async dispatch makes the device never wait for
+host-side bookkeeping.  Retirement/admission decisions therefore lag by
+up to ``pipeline_depth`` windows, which is the same semantics fused
+windows already have: overshoot tokens past a request's budget are
+dropped, post-EOS tokens are host-forced, and each in-flight window
+carries the slot→rid snapshot it was dispatched under so a slot
+re-tenanted mid-flight can never mis-attribute tokens.
+``pipeline_depth=0`` is the synchronous escape hatch for debugging.
+
+Admission is BATCHED: every free slot drains one queued request per
+round, the group's prompts are padded into shared buckets and prefilled
+in ONE multi-row forward, and all new rows land in the batch cache via
+one fused scatter — instead of a blocking b=1 prefill + scatter per
+request.  The fused decode step and the row scatter DONATE the dense
+cache (and token) buffers, so XLA updates the ``[max_batch, max_seq]``
+K/V in place rather than copying it every step (the paged engine
+already donates its pool).
 
 Greedy decoding (the exactness contract: every request's output is
 token-identical to a solo ``generate()`` call — test-pinned).
@@ -29,7 +51,8 @@ Typical use::
 
 The reference framework has no serving layer at all (SURVEY.md §2.9) —
 this rides the vtpu workload tier's KV-cache machinery
-(vtpu/models/transformer.py decode path)."""
+(vtpu/models/transformer.py decode path).  docs/perf.md#serving-pipeline
+explains what overlaps with what and how to read the histograms."""
 
 from __future__ import annotations
 
@@ -37,21 +60,51 @@ import collections
 import dataclasses
 import functools
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from vtpu import obs
-from vtpu.models.transformer import TransformerLM, _zero_cache
+from vtpu.models.transformer import TransformerLM, _zero_cache, bucket_length
 from vtpu.ops.quant import dequantize_tree
+
+_REG = obs.registry("serving")
 
 # queue-to-first-token: submit() → the request's first harvested token
 # (covers queue wait + prefill), the serving-tier latency SLO input
-_QTFT_HIST = obs.registry("serving").histogram(
+_QTFT_HIST = _REG.histogram(
     "vtpu_batcher_queue_to_first_token_seconds",
     "Latency from submit() to the request's first generated token",
+)
+# per-window host cost: the wait-for-tokens + python harvest/admission
+# work.  overlapped=yes means a newer window was already running on the
+# device while this harvest happened — the pipelining win; under
+# pipeline_depth=0 every observation is overlapped=no and this IS the
+# serial host overhead per window.
+_HARVEST_HIST = _REG.histogram(
+    "vtpu_batcher_harvest_overlap_seconds",
+    "Host time to materialize and harvest one decode window's tokens",
+)
+_DISPATCH_HIST = _REG.histogram(
+    "vtpu_batcher_window_dispatch_seconds",
+    "Host time to enqueue one fused decode window (async dispatch)",
+)
+# pipeline occupancy: in-flight windows / max(1, pipeline_depth).  1.0
+# means the configured lookahead is full (the device never starves);
+# persistently < 1 means the host can't keep the pipe fed.
+_DEPTH_GAUGE = _REG.gauge(
+    "vtpu_batcher_dispatch_depth_ratio",
+    "In-flight decode windows over the configured pipeline_depth",
+)
+_ACTIVE_GAUGE = _REG.gauge(
+    "vtpu_batcher_slots_active_ratio",
+    "Active decode slots over max_batch",
+)
+_WINDOWS_TOTAL = _REG.counter(
+    "vtpu_batcher_windows_dispatched_total",
+    "Fused decode windows dispatched to the device",
 )
 
 
@@ -68,7 +121,8 @@ class ContinuousBatcher:
 
     def __init__(self, model: TransformerLM, params, max_batch: int,
                  eos_id: Optional[int] = None, prefill_chunk: int = 0,
-                 harvest_every: int = 1):
+                 harvest_every: int = 1, pipeline_depth: int = 1,
+                 bucket_prefill: bool = True):
         if (model.kv_cache_layout == "paged"
                 and type(self) is ContinuousBatcher):
             # the dense engine's row scatter treats cache axis 0 as the
@@ -85,6 +139,10 @@ class ContinuousBatcher:
         # steps of the other slots (one chunk per step), so a long
         # admission never stalls running requests' token latency
         self.prefill_chunk = prefill_chunk
+        # pad prompts (and tail chunks) to power-of-two buckets: bounds
+        # the prefill compile cache; exact by the position-rewind
+        # contract (transformer.bucket_length)
+        self.bucket_prefill = bool(bucket_prefill)
         self.prefilling: Dict[int, dict] = {}  # slot → progress state
         # batch cache: max_batch rows, each row an independent request
         dummy = jnp.zeros((max_batch, 1), jnp.int32)
@@ -98,6 +156,11 @@ class ContinuousBatcher:
         self.rid: List[Optional[str]] = [None] * max_batch
         self.out: Dict[str, List[int]] = {}
         self.queue: collections.deque[_Request] = collections.deque()
+        # every rid ever submitted (queued, in flight, or finished) —
+        # duplicate detection is one set lookup, not a queue scan.
+        # Append-only on purpose: a finished rid stays taken, because
+        # its transcript stays in ``out``
+        self._rids: Set[str] = set()
         # > 1: run k decode steps as ONE compiled lax.scan and harvest
         # the [k, max_batch] token matrix in a single device→host
         # transfer.  Per-step harvest (k=1) costs one host sync per
@@ -107,31 +170,47 @@ class ContinuousBatcher:
         # way) — only retirement/admission granularity coarsens to the
         # window boundary.
         self.harvest_every = max(1, int(harvest_every))
+        # >= 1: keep up to this many dispatched windows in flight and
+        # harvest the oldest while the device runs the newest.  Each
+        # entry carries (token array, slot→rid snapshot, k).  0 = the
+        # synchronous debug path (dispatch, wait, harvest).
+        self.pipeline_depth = max(0, int(pipeline_depth))
+        self._inflight: collections.deque[Tuple[jax.Array, list, int, float]] = (
+            collections.deque()
+        )
+        # admissions whose FIRST token is still an unmaterialized device
+        # array: (firsts [n] device, [(slot, req), …], issue time).
+        # Admission never syncs the host — the tokens materialize at
+        # the next harvest (one tiny transfer that by then waits on
+        # nothing), or at run()'s drain.  Entries resolve in FIFO
+        # order, always before any window token is appended for those
+        # rids.
+        self._pending_first: collections.deque = collections.deque()
+        # device→host materialization hook: (device array, issue time)
+        # → np.ndarray.  The default is a plain copy; a transport layer
+        # (or the bench's relayed-transport simulation) can override it
+        # to model/amortize round-trip latency.  Paired with the
+        # copy_to_host_async() issued at dispatch, this is the "double
+        # buffer": the transfer rides along behind the NEXT window's
+        # compute and the harvest finds it already local.
+        self._fetch = lambda arr, issued: np.asarray(arr)
         self.steps = 0  # decode forwards executed (batch-wide)
-        self._row_tmpl = None  # lazy; see _row_template()
+        self._row_tmpls: Dict[int, dict] = {}  # rows → zero prefill cache
 
-        @jax.jit
-        def _step(params, cache, tok):
-            # dequantize INSIDE jit: a weight-only int8 tree
-            # (vtpu.ops.quant.quantize_tree) stays int8 at rest; XLA
-            # fuses the dequant into the matmuls.  No-op on fp params.
-            logits, mut = model.apply(
-                {"params": dequantize_tree(params), "cache": cache},
-                tok[:, None], decode=True, mutable=["cache"],
-            )
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            return nxt, mut["cache"]
-
-        self._step = _step
-
-        @functools.partial(jax.jit, static_argnums=(3,))
+        @functools.partial(jax.jit, static_argnums=(3,),
+                           donate_argnums=(1, 2))
         def _step_k(params, cache, tok, k):
-            """k fused decode steps (lax.scan): same per-step math as
-            _step, one dispatch, one [k, b] token harvest.  Finished
-            rows overshoot harmlessly: dense writes clamp into the dead
-            row, paged writes fall off the leased table into the
-            garbage block (shared prefix blocks sit at the FRONT of a
-            table row, so overshoot never reaches them)."""
+            """k fused decode steps (lax.scan; k == 1 is the plain
+            per-token window): one dispatch, one [k, b] token harvest.
+            Dequantization happens INSIDE jit — a weight-only int8 tree
+            (vtpu.ops.quant.quantize_tree) stays int8 at rest; XLA
+            fuses the dequant into the matmuls (no-op on fp params).
+            Finished rows overshoot harmlessly: dense writes clamp into
+            the dead row, paged writes fall off the leased table into
+            the garbage block (shared prefix blocks sit at the FRONT of
+            a table row, so overshoot never reaches them).  cache and
+            tok are DONATED — the [max_batch, max_seq] K/V updates in
+            place instead of being copied every window."""
             p = dequantize_tree(params)
 
             def body(carry, _):
@@ -150,7 +229,7 @@ class ContinuousBatcher:
 
         self._step_k = _step_k
 
-        @jax.jit  # caches one program per distinct prompt length
+        @jax.jit  # one program per (row bucket, length bucket)
         def _prefill(params, cache, prompt):
             logits, mut = model.apply(
                 {"params": dequantize_tree(params), "cache": cache},
@@ -160,19 +239,58 @@ class ContinuousBatcher:
 
         self._prefill = _prefill
 
-        @jax.jit
-        def _scatter(batch_cache, row_cache, slot):
-            """Write a b=1 prefill cache into row ``slot`` of the batch
-            cache (whole-row replace: stale K/V from the slot's previous
-            tenant must go, masking only protects positions >= pos)."""
-            def put(b_leaf, r_leaf):
-                return jax.lax.dynamic_update_slice(
-                    b_leaf, r_leaf.astype(b_leaf.dtype),
-                    (slot,) + (0,) * (b_leaf.ndim - 1),
-                )
-            return jax.tree.map(put, batch_cache, row_cache)
+        @functools.partial(jax.jit, donate_argnums=(4, 5))
+        def _admit_prog(params, tmpl, toks, lens, batch_cache, tok, slots):
+            """The WHOLE batched admission as one program: prefill the
+            padded group in a zero row cache, take each row's logits at
+            its TRUE last prompt token (padding past it is causally
+            invisible), argmax the first tokens, and scatter rows,
+            true positions, and first tokens into the batch state.  One
+            dispatch and ZERO host syncs per admission round — the
+            per-request eager-op chain (gather, argmax, scatter, tok
+            write) was the dominant host cost of the decode loop.
+            ``slots`` may carry out-of-bounds padding (= max_batch);
+            scatter drops those rows.  batch_cache and tok are donated
+            (in-place update, no [max_batch, max_seq] copy)."""
+            logits, mut = model.apply(
+                {"params": dequantize_tree(params), "cache": tmpl},
+                toks, decode=True, mutable=["cache"],
+            )
+            sel = jnp.take_along_axis(
+                logits, (lens - 1)[:, None, None], axis=1
+            )[:, 0]
+            firsts = jnp.argmax(sel, axis=-1).astype(jnp.int32)
 
-        self._scatter = _scatter
+            def put(b_leaf, r_leaf):
+                return b_leaf.at[slots].set(r_leaf.astype(b_leaf.dtype))
+
+            out = dict(jax.tree.map(put, batch_cache, mut["cache"]))
+            out["pos"] = out["pos"].at[slots].set(lens)
+            return firsts, out, tok.at[slots].set(firsts)
+
+        self._admit_prog = _admit_prog
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _scatter_rows(batch_cache, rows_cache, slots, pos):
+            """Write an admission group's prefilled row caches into the
+            batch cache in ONE fused update (whole-row replace: stale
+            K/V from each slot's previous tenant must go, masking only
+            protects positions >= pos).  ``slots`` may carry
+            out-of-bounds padding entries (= max_batch) — scatter drops
+            them, so the program count stays bounded by the row
+            buckets.  ``pos`` carries each row's TRUE prompt length,
+            overriding whatever padded position the bucketed prefill
+            advanced to.  The batch cache is donated (the row-shaped
+            prefill leaves can't alias the [max_batch] outputs, so
+            donating them would only warn)."""
+            def put(b_leaf, r_leaf):
+                return b_leaf.at[slots].set(r_leaf.astype(b_leaf.dtype))
+
+            out = dict(jax.tree.map(put, batch_cache, rows_cache))
+            out["pos"] = out["pos"].at[slots].set(pos)
+            return out
+
+        self._scatter_rows = _scatter_rows
 
     # ------------------------------------------------------------------
     def submit(self, rid: str, prompt, num_new: int) -> None:
@@ -187,12 +305,9 @@ class ContinuousBatcher:
                 f"prompt ({prompt.size}) + num_new ({num_new}) exceeds "
                 f"max_seq ({self.model.max_seq})"
             )
-        if (
-            rid in self.out
-            or any(r.rid == rid for r in self.queue)
-            or any(st["req"].rid == rid for st in self.prefilling.values())
-        ):
+        if rid in self._rids:
             raise ValueError(f"duplicate request id {rid!r}")
+        self._rids.add(rid)
         self.queue.append(_Request(rid, prompt, num_new,
                                    submitted=time.perf_counter()))
         self._admit_pending()
@@ -205,77 +320,173 @@ class ContinuousBatcher:
         return not self.active[slot] and slot not in self.prefilling
 
     def _admit_pending(self) -> None:
-        for slot in self._free_slots():
-            if not self.queue:
-                return
-            # re-check: an admission with num_new=1 retires instantly
-            # and RE-ENTERS this method, which may have filled slots the
-            # snapshot above still lists as free — admitting into one
-            # would clobber the nested admission's request
-            if not self._slot_is_free(slot):
-                continue
-            req = self.queue.popleft()
-            self._admit(slot, req)
+        """Drain the queue into every free slot, one fused prefill per
+        prompt-length bucket.  Loops because a batch may retire
+        instantly (num_new=1 + EOS at prefill) and free its slots for
+        the next group — the loop re-snapshots free slots instead of
+        the old re-entrant recursion."""
+        progress = True
+        while progress and self.queue:
+            progress = False
+            group: List[Tuple[int, _Request]] = []
+            for slot in self._free_slots():
+                if not self.queue:
+                    break
+                if not self._slot_is_free(slot):
+                    continue
+                req = self.queue.popleft()
+                if 0 < self.prefill_chunk < req.prompt.size:
+                    # long prompt: reserve the slot and prefill
+                    # chunk-by-chunk from step() so running slots keep
+                    # decoding in between
+                    self.prefilling[slot] = {"req": req,
+                                             "cache": self._row_template(),
+                                             "done": 0}
+                    progress = True
+                    continue
+                group.append((slot, req))
+            if group:
+                self._admit_batch(group)
+                progress = True
 
-    def _row_template(self):
-        """Zero b=1 cache template, built on first use: its shapes
-        don't depend on prompt length (one eval_shape trace total), and
-        the paged engine never needs it — eager construction there
+    def _bucket_len(self, n: int) -> int:
+        if not self.bucket_prefill:
+            return n
+        return bucket_length(n, self.model.max_seq)
+
+    def _bucket_rows(self, n: int) -> int:
+        """Row-count bucket for a fused admission prefill: padding the
+        group to a power of two bounds the prefill program count at
+        length-buckets × row-buckets.  Padding rows are garbage and
+        their scatter indices are out-of-bounds (dropped)."""
+        if not self.bucket_prefill:
+            return n
+        return 1 << (n - 1).bit_length()
+
+    def _row_template(self, rows: int = 1):
+        """Zero prefill cache for a ``rows``-request group, cached per
+        row count: its shapes don't depend on prompt length (one
+        eval_shape trace per row bucket), never donated (prefill does
+        not donate its cache operand precisely so these stay live).
+        The paged engine never calls this — eager construction there
         would duplicate the whole block pool."""
-        if self._row_tmpl is None:
-            self._row_tmpl = _zero_cache(
-                self.model, jnp.zeros((1, 1), jnp.int32)
+        tmpl = self._row_tmpls.get(rows)
+        if tmpl is None:
+            tmpl = self._row_tmpls[rows] = _zero_cache(
+                self.model, jnp.zeros((rows, 1), jnp.int32)
             )
-        return self._row_tmpl
+        return tmpl
 
-    def _admit(self, slot: int, req: _Request) -> None:
-        if 0 < self.prefill_chunk < req.prompt.size:
-            # long prompt: reserve the slot and prefill chunk-by-chunk
-            # from step() so running slots keep decoding in between
-            self.prefilling[slot] = {"req": req,
-                                     "cache": self._row_template(),
-                                     "done": 0}
-            return
-        # b=1 prefill in a fresh single-row cache (jitted: compiles once
-        # per prompt length), then scatter the row into the batch cache
-        prompt = jnp.asarray(req.prompt)[None, :]
-        logits, row_cache = self._prefill(
-            self.params, self._row_template(), prompt
+    def _admit_batch(self, group: List[Tuple[int, _Request]]) -> None:
+        """Prefill and activate an admission group: ONE fused program
+        per length bucket (prefill + first-token argmax + row/pos/tok
+        scatter) and zero host syncs — the first tokens stay on device
+        until the next harvest flushes them."""
+        by_bucket: Dict[int, List[Tuple[int, _Request]]] = {}
+        for slot, req in group:
+            by_bucket.setdefault(
+                self._bucket_len(req.prompt.size), []
+            ).append((slot, req))
+        for blen, sub in by_bucket.items():
+            n = len(sub)
+            rows = self._bucket_rows(n)
+            toks = np.zeros((rows, blen), np.int32)
+            lens = np.ones((rows,), np.int32)  # pad rows index token 0
+            slots = np.full((rows,), self.max_batch, np.int32)  # OOB pad
+            for r, (slot, req) in enumerate(sub):
+                toks[r, :req.prompt.size] = req.prompt
+                lens[r] = req.prompt.size
+                slots[r] = slot
+            firsts, self.cache, self.tok = self._admit_prog(
+                self.params, self._row_template(rows), toks, lens,
+                self.cache, self.tok, slots,
+            )
+            self._queue_first(firsts, sub)
+
+    def _merge_rows(self, slots: np.ndarray, rows_cache,
+                    pos: np.ndarray) -> None:
+        """Write prefilled row caches into the batch cache (overridden
+        by the paged engine, whose pool was written in place and only
+        needs table/position publishing)."""
+        self.cache = self._scatter_rows(
+            self.cache, rows_cache,
+            jnp.asarray(slots, jnp.int32), jnp.asarray(pos, jnp.int32),
         )
-        self._activate(slot, req, logits, row_cache)
-
-    def _merge_row(self, slot: int, row_cache) -> None:
-        """Write a prefilled b=1 row cache into the batch cache
-        (overridden by the paged engine, whose pool isn't row-shaped)."""
-        self.cache = self._scatter(self.cache, row_cache, slot)
 
     def _on_retire(self, slot: int) -> None:
         """Hook: a slot left decode rotation (paged engine frees its
         blocks here)."""
 
+    def _retire_rows(self, slots: List[int]) -> None:
+        """Batched retirement hook — a harvest window can retire
+        several slots at once, and the paged engine folds their
+        table-row/position resets into one device update instead of
+        two per slot."""
+        for slot in slots:
+            self._on_retire(slot)
+
     def _activate(self, slot: int, req: _Request, logits, row_cache) -> None:
-        """Common admission tail: scatter the prefilled row into the
-        batch cache and put the slot into decode rotation."""
-        self._merge_row(slot, row_cache)
-        first = int(jnp.argmax(logits[0, -1]))
-        if req.submitted:
-            _QTFT_HIST.observe(time.perf_counter() - req.submitted)
-        self.tok = self.tok.at[slot].set(first)
-        self.rid[slot] = req.rid
-        self.out[req.rid] = [first]
-        self.active[slot] = True
-        self.done_frozen[slot] = (
-            self.eos_id is not None and first == self.eos_id
+        """Single-row activation tail (chunked-prefill admissions):
+        merge the finished row, then do the host bookkeeping.
+        ``logits`` must already be sliced to the request's true last
+        prompt token at index -1."""
+        self._merge_rows(
+            np.asarray([slot], np.int32), row_cache,
+            np.asarray([req.prompt.size], np.int32),
         )
-        self.remaining[slot] = req.num_new - 1
-        self._maybe_retire(slot)
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)  # [1]
+        self.tok = self.tok.at[slot].set(first[0])
+        self._queue_first(first, [(slot, req)])
+
+    def _queue_first(self, firsts, items) -> None:
+        """Host-side slot bookkeeping shared by batched and chunked
+        admission.  ``firsts`` stays an unmaterialized device array —
+        the transcript slot for each rid opens empty and the token
+        lands at the next harvest's flush, so admission costs zero host
+        syncs.  Budget accounting doesn't need the token's VALUE: the
+        first token is spent either way, and the EOS-freeze decision is
+        made at flush time, before any window token for these rids is
+        processed."""
+        getattr(firsts, "copy_to_host_async", lambda: None)()
+        self._pending_first.append((firsts, list(items),
+                                    time.perf_counter()))
+        for slot, req in items:
+            self.rid[slot] = req.rid
+            self.out[req.rid] = []
+            self.active[slot] = True
+            self.done_frozen[slot] = False
+            self.remaining[slot] = req.num_new - 1
+            self._maybe_retire(slot)
+
+    def _flush_first_tokens(self) -> None:
+        """Materialize every pending admission's first token (FIFO).
+        Called at the head of each harvest — the prefills precede the
+        harvested window in device order, so this transfer waits on
+        nothing extra — and at run()'s drain."""
+        while self._pending_first:
+            firsts, items, issued = self._pending_first.popleft()
+            vals = self._fetch(firsts, issued)
+            for (slot, req), v in zip(items, vals):
+                first = int(v)
+                self.out[req.rid].append(first)
+                if req.submitted:
+                    _QTFT_HIST.observe(time.perf_counter() - req.submitted)
+                # freeze only if the rid still owns the slot (an
+                # instant retirement may have re-tenanted it)
+                if (self.rid[slot] == req.rid and self.eos_id is not None
+                        and first == self.eos_id):
+                    self.done_frozen[slot] = True
 
     def _advance_prefill(self) -> None:
         """One prefill chunk for the longest-waiting prefilling slot.
         Chunked prefill is exactly equivalent to one-shot (the decode
         path advances its position counter by each chunk's length), so
-        interleaving changes no tokens — only latency.  A subclass may
-        stash its own prefill fn in the slot state ("pf") and hook
+        interleaving changes no tokens — only latency.  Under
+        bucket_prefill the TAIL chunk is padded to the full chunk
+        length (one compiled program instead of one per distinct tail);
+        the padding is exact by the position-rewind contract — the
+        activation merge publishes the TRUE prompt length.  A subclass
+        may stash its own prefill fn in the slot state ("pf") and hook
         :meth:`_pre_activate` for lease bookkeeping."""
         if not self.prefilling:
             return
@@ -283,15 +494,29 @@ class ContinuousBatcher:
         st = self.prefilling[slot]
         req, lo = st["req"], st["done"]
         chunk = req.prompt[lo:lo + self.prefill_chunk]
+        real = len(chunk)
+        if self.bucket_prefill and real < self.prefill_chunk:
+            # cap the pad so writes never spill past max_seq: a spilled
+            # dense write would CLAMP its start backward over real
+            # prompt K/V (dynamic_update_slice), and a spilled paged
+            # write would clamp its table gather into the lease's last
+            # real block — both silent token corruption
+            pad_to = min(self.prefill_chunk, self.model.max_seq - lo)
+            if pad_to > real:
+                chunk = np.concatenate(
+                    [chunk, np.zeros(pad_to - real, np.int32)]
+                )
         pf = st.get("pf", self._prefill)
         logits, st["cache"] = pf(
             self.params, st["cache"], jnp.asarray(chunk)[None, :]
         )
-        st["done"] += len(chunk)
+        st["done"] += real
         if st["done"] >= req.prompt.size:
             del self.prefilling[slot]
             self._pre_activate(slot, st)
-            self._activate(slot, req, logits, st["cache"])
+            # slice to the true last prompt token (padding after it is
+            # causally invisible to the real tokens)
+            self._activate(slot, req, logits[:, real - 1:real], st["cache"])
 
     def _pre_activate(self, slot: int, st: dict) -> None:
         """Hook: a chunked admission is about to activate (paged engine
@@ -302,39 +527,74 @@ class ContinuousBatcher:
             self.active[slot] = False
             self.rid[slot] = None
             self._on_retire(slot)
-            self._admit_pending()
 
     # ------------------------------------------------------------------
+    def _inflight_tokens(self) -> int:
+        return sum(k for _, _, k, _t in self._inflight)
+
     def _window(self) -> int:
-        """Decode steps to fuse this round.  1 while a chunked prefill
-        is in flight (preserves prefill/decode interleaving latency);
-        otherwise min(harvest_every, longest remaining budget), rounded
-        DOWN to a power of two so the number of compiled window
-        programs is bounded at log2(harvest_every)+1."""
-        if self.harvest_every <= 1 or self.prefilling:
-            return 1
+        """Decode steps to fuse this round, net of windows already in
+        flight (their tokens haven't been harvested, but they WILL
+        consume budget — dispatching past every active budget would run
+        entirely on dead rows).  0 = nothing left to dispatch, harvest
+        instead.  1 while a chunked prefill is in flight (preserves
+        prefill/decode interleaving latency); otherwise
+        min(harvest_every, remaining budget), rounded DOWN to a power
+        of two so the number of compiled window programs is bounded at
+        log2(harvest_every)+1."""
         rem = max(
             (self.remaining[i] for i in range(self.max_batch)
              if self.active[i]),
             default=0,
-        )
-        k = min(self.harvest_every, max(1, rem))
+        ) - self._inflight_tokens()
+        if rem <= 0:
+            return 0
+        if self.harvest_every <= 1 or self.prefilling:
+            return 1
+        k = min(self.harvest_every, rem)
         return 1 << (k.bit_length() - 1)
 
-    def _harvest_window(self, toks_np) -> None:
-        """Append a [k, b] window of harvested tokens to each active
-        request, applying the same EOS-freeze and budget accounting the
-        per-step path does.  A row that finishes mid-window simply has
-        its overshoot tokens dropped (truncation to num_new), and no
-        EOS write-back to the device is needed: every post-EOS token is
-        forced to eos_id right here, so the device-side feedback chain
-        is unobservable."""
+    def _harvest_oldest(self) -> None:
+        """Materialize and account the OLDEST in-flight window.  The
+        np.asarray is the one device→host sync of the decode loop;
+        while it (and the python bookkeeping after it) runs, any newer
+        in-flight window keeps the device busy — that overlap is the
+        pipelining win, and the histogram records it."""
+        if not self._inflight:
+            return
+        toks, rids, _k, issued = self._inflight.popleft()
+        overlapped = bool(self._inflight)
+        t0 = time.perf_counter()
+        self._harvest_window(self._fetch(toks, issued), rids)
+        _HARVEST_HIST.observe(
+            time.perf_counter() - t0,
+            overlapped="yes" if overlapped else "no",
+        )
+        _DEPTH_GAUGE.set(
+            len(self._inflight) / max(1, self.pipeline_depth)
+        )
+        _ACTIVE_GAUGE.set(sum(self.active) / max(1, self.max_batch))
+
+    def _harvest_window(self, toks_np, rids) -> None:
+        """Append a [k, b] window of harvested tokens to each request
+        active in ``rids`` — the slot→rid snapshot taken when the
+        window was DISPATCHED, not the current assignment: with
+        pipelining a slot can retire and be re-tenanted while this
+        window was in flight, and the stale window's tokens belong to
+        nobody (the old tenant's budget is spent, the new tenant's
+        tokens start in the first window dispatched after its
+        admission).  Applies the same EOS-freeze and budget accounting
+        the per-step path does: a row that finishes mid-window has its
+        overshoot tokens dropped, and every post-EOS token is forced to
+        eos_id right here, so the device-side feedback chain is
+        unobservable."""
+        self._flush_first_tokens()
         k = toks_np.shape[0]
         finished = []
         for i in range(self.max_batch):
-            if not self.active[i]:
-                continue
-            rid = self.rid[i]
+            rid = rids[i]
+            if rid is None or self.rid[i] != rid:
+                continue  # slot retired (maybe re-tenanted) mid-flight
             for j in range(k):
                 if self.remaining[i] <= 0:
                     break
@@ -350,38 +610,62 @@ class ContinuousBatcher:
         for i in finished:
             self.active[i] = False
             self.rid[i] = None
-            self._on_retire(i)
+        if finished:
+            self._retire_rows(finished)
         self._admit_pending()
 
     def step(self) -> None:
         """One prefill chunk (if a slot is admitting) + one decode
-        forward (or a fused ``harvest_every`` window of them) for EVERY
-        active slot; harvest active rows."""
+        window dispatch for EVERY active slot; harvest the oldest
+        in-flight window once more than ``pipeline_depth`` windows are
+        outstanding.  With the default depth of 1 the device starts
+        window k+1 before the host has seen window k's tokens."""
         self._advance_prefill()
         if not any(self.active):
+            if self._inflight:
+                self._harvest_oldest()
+            elif self.queue:
+                self._admit_pending()
+            else:
+                self._flush_first_tokens()
             return
         k = self._window()
-        if k > 1:
-            self.tok, self.cache, toks = self._step_k(
-                self.params, self.cache, self.tok, k
-            )
-            self.steps += k
-            self._harvest_window(np.asarray(toks))
+        if k == 0:
+            # every active budget is covered by in-flight windows —
+            # dispatching more would decode dead rows; drain instead
+            self._harvest_oldest()
             return
+        t0 = time.perf_counter()
         # k == 1 is just a [1, b] window: one copy of the EOS-freeze/
-        # budget/retire rules lives in _harvest_window.  (The old
-        # per-step path also wrote eos_id back into self.tok for frozen
-        # rows; that device write is unobservable — every post-EOS
-        # OUTPUT token is host-forced — so it is dropped, saving one
-        # host→device transfer per frozen-row step.)
-        self.tok, self.cache = self._step(self.params, self.cache, self.tok)
-        self.steps += 1
-        self._harvest_window(np.asarray(self.tok)[None, :])
+        # budget/retire rules lives in _harvest_window, and the token
+        # matrix comes out of the SAME program (an eager host-side
+        # slice of self.tok would cost more than the whole dispatch)
+        self.tok, self.cache, toks = self._step_k(
+            self.params, self.cache, self.tok, k
+        )
+        _DISPATCH_HIST.observe(time.perf_counter() - t0)
+        _WINDOWS_TOTAL.inc()
+        self.steps += k
+        # double-buffered harvest: issue the token transfer NOW so it
+        # rides behind the next window's compute — by harvest time the
+        # data is already host-side (no round trip on the critical
+        # path; a no-op where the backend has no async D2H)
+        getattr(toks, "copy_to_host_async", lambda: None)()
+        self._inflight.append((toks, list(self.rid), k,
+                               time.perf_counter()))
+        _DEPTH_GAUGE.set(
+            len(self._inflight) / max(1, self.pipeline_depth)
+        )
+        while len(self._inflight) > self.pipeline_depth:
+            self._harvest_oldest()
 
     def run(self) -> Dict[str, List[int]]:
-        """Drive until every submitted request has finished."""
-        while any(self.active) or self.queue or self.prefilling:
+        """Drive until every submitted request has finished and every
+        in-flight window is drained."""
+        while (any(self.active) or self.queue or self.prefilling
+               or self._inflight):
             self.step()
+        self._flush_first_tokens()
         return self.out
 
     def stats(self) -> dict:
@@ -393,6 +677,12 @@ class ContinuousBatcher:
             "prefilling_slots": len(self.prefilling),
             "queued": len(self.queue),
             "decode_steps": self.steps,
+            "inflight_windows": len(self._inflight),
+            # admissions whose first token hasn't materialized yet — a
+            # step()-driven caller is only fully drained when this is 0
+            # too (one more idle step(), or run(), flushes them)
+            "pending_first_tokens": len(self._pending_first),
+            "pipeline_depth": self.pipeline_depth,
             # every rid in out is either finished or bound to an active
             # slot (rid[i] set exactly while active[i]); queued requests
             # are not in out yet — simple arithmetic, O(max_batch), and

@@ -10,10 +10,13 @@ and table never change shape).  When the pool can't cover the next
 request, admission waits for blocks instead of OOMing — backpressure,
 not failure.
 
-Prefill runs DIRECTLY against the live pool: a b=1 apply whose
-[1, nb_max] table row points at the request's leased blocks (donated
-buffers, so the pool updates in place) — no transient pool, no block
-copies, and one compile per prompt length.
+Prefill runs DIRECTLY against the live pool: one batched apply per
+admission round whose ``[n, nb_max]`` table rows point at each
+request's leased blocks (donated buffers, so the pool updates in
+place) — no transient pool, no block copies, and suffixes padded to
+power-of-two buckets so the compile cache is bounded (the padding
+writes land in each lease's not-yet-decoded tail or the garbage block,
+never in read positions — see transformer.bucket_length).
 
 Prefix caching (``prefix_cache=N``): the block-aligned prefix of every
 admitted prompt is registered; a later prompt that starts with the same
@@ -22,7 +25,10 @@ suffix prefill attends to the shared K/V through its own table.  Blocks
 are refcounted; a shared block is freed only when every referencing
 slot has retired and the registry entry has been evicted (FIFO beyond
 N entries).  The system-prompt case: one prefill, every request after
-pays only its suffix.
+pays only its suffix.  (Two requests admitted in the SAME batched
+round don't share a prefix registered within that round — registration
+happens once the K/V are written; the second request simply leases its
+own blocks, or waits a round if the pool is tight.)
 
 Block 0 is sacrificial: inactive slots still run the decode math
 (uniform compute under jit) and their writes land there via an all-zero
@@ -30,7 +36,8 @@ table row; it is never leased.
 
 Greedy outputs stay token-identical to the DENSE ContinuousBatcher on
 the same request schedule (test-pinned; the paged read computes the
-same values the dense layout reads directly).  Comparisons against a
+same values the dense layout reads directly) — including under
+pipelined dispatch and bucketed admission.  Comparisons against a
 solo b=1 ``generate()`` can differ on argmax ties — batched matmuls
 reduce in a different order, a property of batching itself, not of
 paging."""
@@ -45,7 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from vtpu.models.transformer import TransformerLM
+from vtpu.models.transformer import TransformerLM, bucket_length
 from vtpu.ops.quant import dequantize_tree
 from vtpu.serving.batcher import ContinuousBatcher, _Request
 
@@ -55,7 +62,8 @@ class PagedBatcher(ContinuousBatcher):
 
     def __init__(self, model: TransformerLM, params, max_batch: int,
                  eos_id=None, prefill_chunk: int = 0,
-                 prefix_cache: int = 0, harvest_every: int = 1):
+                 prefix_cache: int = 0, harvest_every: int = 1,
+                 pipeline_depth: int = 1, bucket_prefill: bool = True):
         if model.kv_cache_layout != "paged" or model.kv_pool_blocks <= 1:
             raise ValueError(
                 "PagedBatcher needs kv_cache_layout='paged' and a real "
@@ -63,7 +71,9 @@ class PagedBatcher(ContinuousBatcher):
             )
         super().__init__(model, params, max_batch, eos_id=eos_id,
                          prefill_chunk=prefill_chunk,
-                         harvest_every=harvest_every)
+                         harvest_every=harvest_every,
+                         pipeline_depth=pipeline_depth,
+                         bucket_prefill=bucket_prefill)
         self.block_size = model.kv_block_size
         self.nb_max = model.max_seq // model.kv_block_size
         # block 0 is the garbage block for inactive rows — never leased
@@ -86,13 +96,15 @@ class PagedBatcher(ContinuousBatcher):
         self._trie: list = [None, {}]
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def _pf_pool(params, pools, pos, table_row, tokens):
-            """b=1 prefill against the LIVE pool: pools are donated via
-            the caller contract (self.cache's pool leaves are replaced
-            by the result), table_row [1, nb] points at this request's
-            blocks, pos [1] is its start offset (0, or the shared
-            prefix length under prefix caching)."""
-            cache = dict(pools, pos=pos, block_table=table_row)
+        def _pf_pool(params, pools, pos, table, tokens):
+            """Admission-group prefill against the LIVE pool: pools are
+            donated via the caller contract (self.cache's pool leaves
+            are replaced by the result), table [n, nb] points at each
+            request's blocks, pos [n] is each row's start offset (0, or
+            the shared prefix length under prefix caching).  Padding
+            rows carry an all-zero table row — their writes land in the
+            garbage block."""
+            cache = dict(pools, pos=pos, block_table=table)
             logits, mut = model.apply(
                 {"params": dequantize_tree(params), "cache": cache},
                 tokens, decode=True, mutable=["cache"],
@@ -103,6 +115,34 @@ class PagedBatcher(ContinuousBatcher):
             return logits, out
 
         self._pf_pool = _pf_pool
+
+        @functools.partial(jax.jit, donate_argnums=(1, 6, 7, 8))
+        def _admit_pool(params, pools, pos0, table, toks, lens,
+                        batch_pos, batch_table, tok, slots, sizes):
+            """The WHOLE batched paged admission as one program:
+            suffix prefill against the live pool (donated — written in
+            place), first-token argmax at each row's true last suffix
+            token, and the table-row/position/token publish for every
+            admitted slot.  One dispatch, zero host syncs — mirrors the
+            dense engine's _admit_prog."""
+            cache = dict(pools, pos=pos0, block_table=table)
+            logits, mut = model.apply(
+                {"params": dequantize_tree(params), "cache": cache},
+                toks, decode=True, mutable=["cache"],
+            )
+            out = dict(mut["cache"])
+            out.pop("pos")
+            out.pop("block_table")
+            sel = jnp.take_along_axis(
+                logits, (lens - 1)[:, None, None], axis=1
+            )[:, 0]
+            firsts = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+            return (firsts, out,
+                    batch_table.at[slots].set(table),
+                    batch_pos.at[slots].set(sizes),
+                    tok.at[slots].set(firsts))
+
+        self._admit_pool = _admit_pool
 
     # -- block accounting ----------------------------------------------
     def _lease(self, n: int) -> List[int]:
@@ -140,31 +180,109 @@ class PagedBatcher(ContinuousBatcher):
         super().submit(rid, prompt, num_new)
 
     def _admit_pending(self) -> None:
-        for slot in self._free_slots():
-            if not self.queue:
-                return
-            if not self._slot_is_free(slot):
-                continue  # a nested admission filled it (see base)
-            # head-of-line: the oldest request waits for blocks rather
-            # than being overtaken (starvation-proof, FIFO completion).
-            # The admissibility check must mirror what _admit actually
-            # leases — the POST-match need — or a request that fits via
-            # sharing waits forever on its full need
-            req = self.queue[0]
-            shared, shared_tok = self._match_prefix(req.prompt)
-            need_new = self._blocks_needed(req) - len(shared)
-            # starved head: evict IDLE registry prefixes (oldest
-            # first, never the head's own match, only entries whose
-            # blocks actually free) — registry-pinned blocks must yield
-            # to real work, but evicting a prefix still referenced by
-            # an active slot frees nothing and just loses future reuse
-            while need_new > len(self.free) and self._evict_prefix(
-                keep=shared
-            ):
-                pass
-            if need_new > len(self.free):
-                return
-            self._admit(slot, self.queue.popleft(), shared, shared_tok)
+        """Head-of-line admission into every free slot: the oldest
+        request waits for blocks rather than being overtaken
+        (starvation-proof, FIFO completion).  Leases are taken
+        host-side as each request is popped — so later candidates in
+        the same round see the true free list — and the whole group
+        prefills in ONE pool forward per suffix-length bucket."""
+        progress = True
+        while progress:
+            progress = False
+            group: List[Tuple[int, _Request, int, np.ndarray]] = []
+            for slot in self._free_slots():
+                if not self.queue:
+                    break
+                if not self._slot_is_free(slot):
+                    continue
+                # the admissibility check must mirror what is actually
+                # leased — the POST-match need — or a request that fits
+                # via sharing waits forever on its full need
+                req = self.queue[0]
+                shared, shared_tok = self._match_prefix(req.prompt)
+                need_new = self._blocks_needed(req) - len(shared)
+                # starved head: evict IDLE registry prefixes (oldest
+                # first, never the head's own match, only entries whose
+                # blocks actually free) — registry-pinned blocks must
+                # yield to real work, but evicting a prefix still
+                # referenced by an active slot frees nothing and just
+                # loses future reuse
+                while need_new > len(self.free) and self._evict_prefix(
+                    keep=shared
+                ):
+                    pass
+                if need_new > len(self.free):
+                    break  # head-of-line: the oldest waits for blocks
+                self.queue.popleft()
+                assigned = self._lease(need_new)
+                self._ref(shared)
+                table_blocks = shared + assigned
+                self._slot_blocks[slot] = table_blocks
+                row = np.zeros((self.nb_max,), np.int32)
+                row[:len(table_blocks)] = table_blocks
+                if 0 < self.prefill_chunk < req.prompt.size - shared_tok:
+                    # chunked admission: the suffix prefills one chunk
+                    # per step() between the running slots' decodes;
+                    # pools always live in self.cache between chunks
+                    # (pf absorbs them back)
+                    st = {"req": req, "cache": None, "done": shared_tok,
+                          "row": jnp.asarray(row[None, :])}
+                    st["pf"] = self._make_chunk_pf(st)
+                    self.prefilling[slot] = st
+                    progress = True
+                    continue
+                group.append((slot, req, shared_tok, row))
+            if group:
+                self._admit_batch_paged(group)
+                progress = True
+
+    def _admit_batch_paged(
+        self, group: List[Tuple[int, _Request, int, np.ndarray]]
+    ) -> None:
+        """ONE fused program per suffix-length bucket for the whole
+        admission group (pool prefill + first-token argmax +
+        table/position/token publish) and zero host syncs — the first
+        tokens stay on device until the next harvest flushes them."""
+        by_bucket: Dict[int, list] = {}
+        for slot, req, shared_tok, row in group:
+            suffix_len = req.prompt.size - shared_tok
+            # cap the bucket so padded writes never spill past max_seq:
+            # a spilled position's table gather would CLAMP into the
+            # lease's last real block and corrupt written K/V
+            blen = (bucket_length(suffix_len,
+                                  self.model.max_seq - shared_tok)
+                    if self.bucket_prefill else suffix_len)
+            by_bucket.setdefault(blen, []).append(
+                (slot, req, shared_tok, row, suffix_len)
+            )
+        for blen, sub in by_bucket.items():
+            n = len(sub)
+            rows = self._bucket_rows(n)
+            toks = np.zeros((rows, blen), np.int32)
+            table = np.zeros((rows, self.nb_max), np.int32)
+            pos0 = np.zeros((rows,), np.int32)
+            lens = np.ones((rows,), np.int32)  # pad rows index token 0
+            slots = np.full((rows,), self.max_batch, np.int32)  # OOB pad
+            sizes = np.zeros((rows,), np.int32)
+            for r, (slot, req, shared_tok, row, suffix_len) in enumerate(sub):
+                toks[r, :suffix_len] = req.prompt[shared_tok:]
+                table[r] = row
+                pos0[r] = shared_tok
+                lens[r] = suffix_len
+                slots[r] = slot
+                sizes[r] = req.prompt.size
+            # register only once the prefix K/V write is ENQUEUED —
+            # device program order guarantees a later matching suffix
+            # prefill reads the written blocks, never zeros
+            for slot, req, *_ in sub:
+                self._register_prefix(req.prompt, self._slot_blocks[slot])
+            pools, bpos, btab = self._split_cache()
+            firsts, new_pools, btab, bpos, self.tok = self._admit_pool(
+                self.params, pools, pos0, table, toks, lens,
+                bpos, btab, self.tok, slots, sizes,
+            )
+            self.cache = dict(new_pools, pos=bpos, block_table=btab)
+            self._queue_first(firsts, [(s, r) for s, r, *_ in sub])
 
     def _chunks(self, key: tuple):
         bs = self.block_size
@@ -245,34 +363,6 @@ class PagedBatcher(ContinuousBatcher):
             self._index_remove(old_key)
             self._unref(old_blocks)
 
-    def _admit(self, slot: int, req: _Request,
-               shared: List[int] = None, shared_tok: int = 0) -> None:
-        if shared is None:
-            shared, shared_tok = self._match_prefix(req.prompt)
-        new_needed = self._blocks_needed(req) - len(shared)
-        assigned = self._lease(new_needed)
-        self._ref(shared)
-        table_blocks = shared + assigned
-        self._slot_blocks[slot] = table_blocks  # all unref'd at retire
-        row = np.zeros((1, self.nb_max), np.int32)
-        row[0, :len(table_blocks)] = table_blocks
-        if 0 < self.prefill_chunk < req.prompt.size - shared_tok:
-            # chunked admission: the suffix prefills one chunk per
-            # step() between the running slots' decodes; pools always
-            # live in self.cache between chunks (pf absorbs them back)
-            st = {"req": req, "cache": None, "done": shared_tok,
-                  "row": jnp.asarray(row)}
-            st["pf"] = self._make_chunk_pf(st)
-            self.prefilling[slot] = st
-            return
-        suffix = jnp.asarray(req.prompt[shared_tok:])[None, :]
-        logits = self._run_pool_prefill(row, shared_tok, suffix)
-        # register only once the prefix K/V are actually WRITTEN — a
-        # match against an unfinished prefill would read zeros
-        self._register_prefix(req.prompt, table_blocks)
-        self._pending_lease = (table_blocks, req.prompt.size)
-        self._activate(slot, req, logits, None)
-
     def _split_cache(self) -> Tuple[dict, jnp.ndarray, jnp.ndarray]:
         pools = dict(self.cache)
         pos = pools.pop("pos")
@@ -280,9 +370,9 @@ class PagedBatcher(ContinuousBatcher):
         return pools, pos, table
 
     def _run_pool_prefill(self, row, start_tok: int, tokens):
-        """One prefill segment against the live pool; the updated pools
-        replace self.cache's (in-place spirit — the old pool buffers
-        are dead after this)."""
+        """One prefill segment against the live pool (the chunked
+        path); the updated pools replace self.cache's (in-place spirit
+        — the old pool buffers are dead after this)."""
         pools, pos, table = self._split_cache()
         logits, new_pools = self._pf_pool(
             self.params, pools, jnp.full((1,), start_tok, jnp.int32),
@@ -305,38 +395,53 @@ class PagedBatcher(ContinuousBatcher):
         # chunked prefill just finished writing its last chunk — the
         # prefix is complete and safe to register now
         self._register_prefix(st["req"].prompt, self._slot_blocks[slot])
-        self._pending_lease = (
-            self._slot_blocks[slot], st["req"].prompt.size
-        )
 
-    def _merge_row(self, slot: int, row_cache) -> None:
-        """Prefill already wrote the pool in place; only the slot's
-        table row and position remain to publish."""
-        table_blocks, pos_val = self._pending_lease
-        row = np.zeros((self.nb_max,), np.int32)
-        row[:len(table_blocks)] = table_blocks
+    def _publish_rows(self, slots, rows_np, pos_vals) -> None:
+        """Publish a group's table rows and positions (the pool itself
+        was written in place by the donated prefill)."""
+        idx = jnp.asarray(slots, jnp.int32)
         self.cache = dict(
             self.cache,
-            block_table=self.cache["block_table"].at[slot].set(
-                jnp.asarray(row)
+            block_table=self.cache["block_table"].at[idx].set(
+                jnp.asarray(rows_np, jnp.int32)
             ),
-            pos=self.cache["pos"].at[slot].set(pos_val),
+            pos=self.cache["pos"].at[idx].set(
+                jnp.asarray(pos_vals, jnp.int32)
+            ),
         )
+
+    def _merge_rows(self, slots, rows_cache, pos) -> None:
+        """Single-row merge for the chunked-prefill activation path:
+        prefill already wrote the pool in place (``rows_cache`` is
+        None); only the slot's table row and position remain to
+        publish, both derived from the slot's own lease — no
+        side-channel state between prefill and activation."""
+        slot = int(slots[0])
+        table_blocks = self._slot_blocks[slot]
+        row = np.zeros((1, self.nb_max), np.int32)
+        row[0, :len(table_blocks)] = table_blocks
+        self._publish_rows(np.asarray(slots[:1]), row, np.asarray(pos[:1]))
 
     # -- retirement -----------------------------------------------------
     def _on_retire(self, slot: int) -> None:
-        blocks = self._slot_blocks.pop(slot, None)
-        if blocks:
-            self._unref(blocks)
-        # the slot keeps decoding as an inactive row: point its writes
-        # at the garbage block and rewind its position so a freed block
-        # reassigned to a NEW tenant is never clobbered
+        self._retire_rows([slot])
+
+    def _retire_rows(self, slots: List[int]) -> None:
+        """Free every retiring slot's lease, then point their writes at
+        the garbage block and rewind their positions in ONE device
+        update (the slots keep decoding as inactive rows; a freed block
+        reassigned to a NEW tenant must never be clobbered)."""
+        for slot in slots:
+            blocks = self._slot_blocks.pop(slot, None)
+            if blocks:
+                self._unref(blocks)
+        idx = jnp.asarray(slots, jnp.int32)
         self.cache = dict(
             self.cache,
-            block_table=self.cache["block_table"].at[slot].set(
-                jnp.zeros((self.nb_max,), jnp.int32)
+            block_table=self.cache["block_table"].at[idx].set(
+                jnp.zeros((len(slots), self.nb_max), jnp.int32)
             ),
-            pos=self.cache["pos"].at[slot].set(0),
+            pos=self.cache["pos"].at[idx].set(0),
         )
 
     def pool_stats(self) -> dict:

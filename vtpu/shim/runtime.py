@@ -63,6 +63,28 @@ def _oom_reject(runtime: "ShimRuntime", msg: str) -> "QuotaExceeded":
     return QuotaExceeded(msg)
 
 
+def _nbytes_of(x) -> int:
+    """Byte size of an array-like WITHOUT materializing it.  A device
+    array missing ``nbytes`` is still sized from shape × dtype — the old
+    ``np.asarray(x)`` fallback was a full device→host transfer inside
+    the quota check, which is the hot path of every tracked put.  Only
+    an object exposing neither nbytes nor shape/dtype (a nested list,
+    a scalar) pays the materialization."""
+    import numpy as np
+
+    nb = getattr(x, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        size = 1
+        for d in shape:
+            size *= int(d)
+        return size * int(np.dtype(dtype).itemsize)
+    return int(np.asarray(x).nbytes)
+
+
 def _env_limits() -> List[int]:
     out = []
     i = 0
@@ -257,9 +279,8 @@ class ShimRuntime:
         ``release(arr)`` — callers must pair device_put with release, not
         raw ``free``, or the tiers' accounting would drift."""
         import jax
-        import numpy as np
 
-        nbytes = int(np.asarray(x).nbytes) if not hasattr(x, "nbytes") else int(x.nbytes)
+        nbytes = _nbytes_of(x)
         if self._try_alloc_device_tier(nbytes, dev):
             try:
                 target = jax.local_devices()[dev]
