@@ -29,6 +29,13 @@ def main(argv=None) -> int:
     p.add_argument("--noderpc-bind", default="0.0.0.0:9396")
     p.add_argument("--feedback-interval", type=float, default=5.0)
     p.add_argument("--disable-feedback", action="store_true")
+    p.add_argument("--util-interval", type=float, default=None,
+                   help="duty-cycle sampling interval in seconds "
+                        "(default: env VTPU_UTIL_SAMPLE_INTERVAL, else 5)")
+    p.add_argument("--disable-util-sampler", action="store_true")
+    p.add_argument("--disable-writeback", action="store_true",
+                   help="never patch the vtpu.io/node-utilization "
+                        "annotation (sampling + /utilization still run)")
     p.add_argument("--span-sink", default=os.environ.get("VTPU_SPAN_SINK", ""),
                    help="collector URL to POST this daemon's trace-span "
                         "ring to (the scheduler's /spans/ingest; env "
@@ -45,15 +52,17 @@ def main(argv=None) -> int:
     from vtpu.monitor.pathmonitor import PathMonitor
 
     pods_fn = None
+    client = None
+    node = os.environ.get("NODE_NAME", "")
     try:
         from vtpu.k8s.client import new_client
 
         client = new_client()
-        node = __import__("os").environ.get("NODE_NAME")
 
         def pods_fn():  # noqa: F811 — deliberate rebind
             return {
-                p["metadata"]["uid"]: p for p in client.list_pods(node_name=node)
+                p["metadata"]["uid"]: p
+                for p in client.list_pods(node_name=node or None)
             }
 
     except Exception:  # noqa: BLE001 — monitor works standalone too
@@ -64,7 +73,21 @@ def main(argv=None) -> int:
         from vtpu.obs.http import start_span_pusher
 
         start_span_pusher(args.span_sink)
-    metrics_srv, _ = serve_metrics(pm, pods_fn=pods_fn, bind=args.metrics_bind)
+    sampler = None
+    if not args.disable_util_sampler:
+        from vtpu.monitor.sampler import UtilizationSampler
+
+        sampler = UtilizationSampler(
+            pm,
+            interval_s=args.util_interval,
+            pods_fn=pods_fn,
+            writeback_client=None if args.disable_writeback else client,
+            node_name=node,
+        )
+        sampler.start()
+    metrics_srv, _ = serve_metrics(
+        pm, pods_fn=pods_fn, bind=args.metrics_bind, sampler=sampler
+    )
     rpc_srv, _ = serve_noderpc(pm, bind=args.noderpc_bind)
     fb = None
     if not args.disable_feedback:
@@ -80,6 +103,8 @@ def main(argv=None) -> int:
     stop.wait()
     metrics_srv.shutdown()
     rpc_srv.stop(grace=1)
+    if sampler:
+        sampler.stop()
     if fb:
         fb.stop()
     pm.close()

@@ -68,13 +68,20 @@ static int cmd_dump(const char* path) {
   printf("\"utilization_switch\":%d,\"recent_kernel\":%d,\"devices\":[",
          r->utilization_switch, r->recent_kernel);
   for (int i = 0; i < r->num_devices; i++) {
-    uint64_t used = 0;
+    uint64_t used = 0, busy = 0, launches = 0, peak = 0;
     for (int p = 0; p < VTPU_MAX_PROCS; p++)
-      if (r->procs[p].status == 1) used += r->procs[p].used[i].total_bytes;
+      if (r->procs[p].status == 1) {
+        used += r->procs[p].used[i].total_bytes;
+        busy += r->procs[p].used[i].busy_ns;
+        launches += r->procs[p].used[i].launches;
+        peak += r->procs[p].used[i].hbm_peak_bytes;
+      }
     printf("%s{\"uuid\":\"%s\",\"limit_bytes\":%" PRIu64
-           ",\"core_limit\":%d,\"used_bytes\":%" PRIu64 "}",
+           ",\"core_limit\":%d,\"used_bytes\":%" PRIu64
+           ",\"busy_ns\":%" PRIu64 ",\"launches\":%" PRIu64
+           ",\"hbm_peak_bytes\":%" PRIu64 "}",
            i ? "," : "", r->uuids[i], r->limit_bytes[i], r->core_limit[i],
-           used);
+           used, busy, launches, peak);
   }
   printf("],\"procs\":[");
   int first = 1;
@@ -88,11 +95,16 @@ static int cmd_dump(const char* path) {
            r->procs[p].exec_shim_ns);
     for (int i = 0; i < r->num_devices; i++) {
       printf("%s{\"buffer\":%" PRIu64 ",\"program\":%" PRIu64
-             ",\"swap\":%" PRIu64 ",\"total\":%" PRIu64 "}",
+             ",\"swap\":%" PRIu64 ",\"total\":%" PRIu64
+             ",\"busy_ns\":%" PRIu64 ",\"launches\":%" PRIu64
+             ",\"hbm_peak\":%" PRIu64 "}",
              i ? "," : "", r->procs[p].used[i].buffer_bytes,
              r->procs[p].used[i].program_bytes,
              r->procs[p].used[i].swap_bytes,
-             r->procs[p].used[i].total_bytes);
+             r->procs[p].used[i].total_bytes,
+             r->procs[p].used[i].busy_ns,
+             r->procs[p].used[i].launches,
+             r->procs[p].used[i].hbm_peak_bytes);
     }
     printf("]}");
     first = 0;

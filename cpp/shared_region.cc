@@ -62,6 +62,20 @@ vtpu_shared_region* vtpu_region_open(const char* path) {
     return NULL;
   }
   int fresh = st.st_size < (off_t)sizeof(vtpu_shared_region);
+  if (fresh && st.st_size >= (off_t)(2 * sizeof(uint32_t))) {
+    /* the v4 struct GREW: an old-version region written by a pre-v4
+     * shim is smaller than sizeof(vtpu_shared_region) but is NOT fresh —
+     * truncate+memset would wipe live tenants' quota state out from
+     * under them.  Peek the header and refuse it like any other
+     * version mismatch (the Python monitor keeps the read path). */
+    uint32_t hdr[2] = {0, 0};
+    if (pread(fd, hdr, sizeof(hdr), 0) == (ssize_t)sizeof(hdr) &&
+        hdr[0] == VTPU_REGION_MAGIC && hdr[1] != VTPU_REGION_VERSION) {
+      flock(fd, LOCK_UN);
+      close(fd);
+      return NULL;
+    }
+  }
   if (fresh && ftruncate(fd, sizeof(vtpu_shared_region)) != 0) {
     flock(fd, LOCK_UN);
     close(fd);
@@ -256,6 +270,21 @@ void vtpu_region_exec_result(vtpu_shared_region* r, int ok) {
   }
 }
 
+void vtpu_region_record_launch(vtpu_shared_region* r, int32_t pid, int dev,
+                               uint64_t busy_ns, uint32_t launches) {
+  if (!r || dev < 0 || dev >= VTPU_MAX_DEVICES) return;
+  vtpu_region_lock(r);
+  r->recent_kernel += (int32_t)launches;
+  for (int i = 0; i < VTPU_MAX_PROCS; i++) {
+    if (r->procs[i].status == 1 && r->procs[i].pid == pid) {
+      r->procs[i].used[dev].busy_ns += busy_ns;
+      r->procs[i].used[dev].launches += launches;
+      break;
+    }
+  }
+  vtpu_region_unlock(r);
+}
+
 int vtpu_region_try_add(vtpu_shared_region* r, int32_t pid, int dev, int kind,
                         uint64_t bytes, int oversubscribe) {
   if (dev < 0 || dev >= VTPU_MAX_DEVICES) return -1;
@@ -276,6 +305,8 @@ int vtpu_region_try_add(vtpu_shared_region* r, int32_t pid, int dev, int kind,
   else
     u->buffer_bytes += bytes;
   u->total_bytes = u->program_bytes + u->buffer_bytes;
+  if (u->total_bytes > u->hbm_peak_bytes) /* v4 high-watermark ratchet */
+    u->hbm_peak_bytes = u->total_bytes;
   vtpu_region_unlock(r);
   return 0;
 }

@@ -23,7 +23,7 @@ extern "C" {
 #endif
 
 #define VTPU_REGION_MAGIC 0x76545055u /* "vTPU" */
-#define VTPU_REGION_VERSION 3
+#define VTPU_REGION_VERSION 4
 #define VTPU_MAX_DEVICES 16
 #define VTPU_MAX_PROCS 64
 #define VTPU_UUID_LEN 64
@@ -39,6 +39,14 @@ typedef struct vtpu_device_usage {
                              host-RAM swap, README.md:236-240); NOT part
                              of total_bytes: swap never counts against the
                              device HBM quota */
+  /* utilization profiling (v4): monotonic counters the monitor's
+   * UtilizationSampler diffs into duty-cycle ratios.  Written by the
+   * owning process only (atomic adds from its dispatch threads); the
+   * monitor reads without the lock and tolerates cross-field skew. */
+  uint64_t busy_ns;        /* cumulative device-busy nanoseconds */
+  uint64_t launches;       /* cumulative kernel/execute launches */
+  uint64_t hbm_peak_bytes; /* high-watermark of total_bytes (ratchets up
+                              on add, never down on sub) */
 } vtpu_device_usage;
 
 typedef struct vtpu_proc_slot {
@@ -134,6 +142,12 @@ uint64_t vtpu_region_device_usage(vtpu_shared_region* r, int dev);
 /* record an execute outcome: ok=1 resets the error streak, ok=0 bumps
  * streak + cumulative count (the XID-analog health feed). */
 void vtpu_region_exec_result(vtpu_shared_region* r, int ok);
+
+/* utilization profiling (v4): bump the launch count and cumulative
+ * device-busy estimate for pid's slot on device dev, plus the shared
+ * recent_kernel activity counter, under one lock acquisition. */
+void vtpu_region_record_launch(vtpu_shared_region* r, int32_t pid, int dev,
+                               uint64_t busy_ns, uint32_t launches);
 
 #ifdef __cplusplus
 }

@@ -1312,6 +1312,20 @@ PJRT_Error* wrap_LoadedExecutable_Execute(
       g_region->procs[g_slot].pid == (int32_t)getpid()) {
     __sync_fetch_and_add(&g_region->procs[g_slot].exec_calls, 1);
     __sync_fetch_and_add(&g_region->procs[g_slot].exec_shim_ns, shim_ns);
+    /* utilization profiling (region v4): per-device launch count plus a
+     * device-busy estimate — the pacer's measured step-time EMA when the
+     * closed loop has calibrated, else the host-side call duration (the
+     * open-loop floor).  The monitor's UtilizationSampler diffs these
+     * monotonic counters into duty-cycle ratios. */
+    int busy_dev = exec_dev >= 0 ? exec_dev
+                                 : (!meta.row_dev.empty() ? meta.row_dev[0] : 0);
+    if (busy_dev < 0 || busy_dev >= VTPU_MAX_DEVICES) busy_dev = 0;
+    pthread_mutex_lock(&g_pace_mu);
+    double t_ema = g_pace.t_ema_s;
+    pthread_mutex_unlock(&g_pace_mu);
+    uint64_t busy = t_ema > 0 ? (uint64_t)(t_ema * 1e9) : (t2 - t1);
+    __sync_fetch_and_add(&g_region->procs[g_slot].used[busy_dev].launches, 1);
+    __sync_fetch_and_add(&g_region->procs[g_slot].used[busy_dev].busy_ns, busy);
   }
   return err;
 }

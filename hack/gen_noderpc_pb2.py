@@ -1,0 +1,190 @@
+#!/usr/bin/env python3
+"""Regenerate vtpu/monitor/noderpc_pb2.py WITHOUT protoc.
+
+The container image has the protobuf runtime but no protoc / grpcio-tools,
+so the generated module is produced from a FileDescriptorProto built here
+programmatically.  Keep the message/field tables below in lockstep with
+protos/noderpc/noderpc.proto (the human-readable source of truth); run
+
+    python hack/gen_noderpc_pb2.py
+
+after editing either, and commit both.  The emitted module uses the same
+``_builder.AddSerializedFile`` shape protoc emits, including the
+``_serialized_start/_end`` offsets (computed by scanning the serialized
+file descriptor), so it behaves identically under both the C and pure-
+Python protobuf backends.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+from google.protobuf import descriptor_pb2
+
+OUT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "vtpu", "monitor", "noderpc_pb2.py",
+)
+
+T = descriptor_pb2.FieldDescriptorProto
+
+# (name, number, type, type_name) — proto3 optional scalars
+MESSAGES = [
+    ("GetNodeVtpuRequest", [
+        ("ctr_id", 1, T.TYPE_STRING, None),
+    ]),
+    ("DeviceUsage", [
+        ("uuid", 1, T.TYPE_STRING, None),
+        ("limit_bytes", 2, T.TYPE_UINT64, None),
+        ("used_bytes", 3, T.TYPE_UINT64, None),
+        ("buffer_bytes", 4, T.TYPE_UINT64, None),
+        ("program_bytes", 5, T.TYPE_UINT64, None),
+        ("core_limit", 6, T.TYPE_INT32, None),
+        ("swap_bytes", 7, T.TYPE_UINT64, None),
+        # utilization profiling (region v4)
+        ("busy_ns", 8, T.TYPE_UINT64, None),
+        ("launches", 9, T.TYPE_UINT64, None),
+        ("hbm_peak_bytes", 10, T.TYPE_UINT64, None),
+    ]),
+    ("ProcInfo", [
+        ("pid", 1, T.TYPE_INT32, None),
+        ("hostpid", 2, T.TYPE_INT32, None),
+        ("exec_calls", 3, T.TYPE_UINT64, None),
+        ("exec_shim_ns", 4, T.TYPE_UINT64, None),
+        ("busy_ns", 5, T.TYPE_UINT64, None),
+        ("launches", 6, T.TYPE_UINT64, None),
+    ]),
+    ("ContainerUsage", [
+        ("ctr_id", 1, T.TYPE_STRING, None),
+        ("pod_uid", 2, T.TYPE_STRING, None),
+        ("devices", 3, T.TYPE_MESSAGE, ".vtpunoderpc.DeviceUsage"),
+        ("proc_num", 4, T.TYPE_INT32, None),
+        ("procs", 5, T.TYPE_MESSAGE, ".vtpunoderpc.ProcInfo"),
+    ]),
+    ("NodeVtpuReply", [
+        ("containers", 1, T.TYPE_MESSAGE, ".vtpunoderpc.ContainerUsage"),
+    ]),
+]
+
+REPEATED = {"devices", "procs", "containers"}
+
+
+def build_fdp() -> descriptor_pb2.FileDescriptorProto:
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "noderpc.proto"
+    fdp.package = "vtpunoderpc"
+    fdp.syntax = "proto3"
+    for msg_name, fields in MESSAGES:
+        m = fdp.message_type.add()
+        m.name = msg_name
+        for fname, num, ftype, type_name in fields:
+            f = m.field.add()
+            f.name = fname
+            f.number = num
+            f.type = ftype
+            f.label = (
+                T.LABEL_REPEATED if fname in REPEATED else T.LABEL_OPTIONAL
+            )
+            if type_name:
+                f.type_name = type_name
+    svc = fdp.service.add()
+    svc.name = "NodeVtpuInfo"
+    meth = svc.method.add()
+    meth.name = "GetNodeVtpu"
+    meth.input_type = ".vtpunoderpc.GetNodeVtpuRequest"
+    meth.output_type = ".vtpunoderpc.NodeVtpuReply"
+    meth.options.SetInParent()  # protoc emits empty options for `{}` bodies
+    return fdp
+
+
+def _read_varint(buf: bytes, i: int) -> tuple:
+    shift = 0
+    val = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+
+
+def payload_spans(serialized: bytes, field_no: int) -> list:
+    """(start, end) byte ranges of every length-delimited occurrence of
+    ``field_no`` at the top level of the serialized message — how protoc's
+    _serialized_start/_end offsets are defined."""
+    spans = []
+    i = 0
+    n = len(serialized)
+    while i < n:
+        key, i = _read_varint(serialized, i)
+        fno, wt = key >> 3, key & 7
+        if wt == 0:
+            _, i = _read_varint(serialized, i)
+        elif wt == 1:
+            i += 8
+        elif wt == 2:
+            ln, i = _read_varint(serialized, i)
+            if fno == field_no:
+                spans.append((i, i + ln))
+            i += ln
+        elif wt == 5:
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+    return spans
+
+
+def main() -> int:
+    fdp = build_fdp()
+    ser = fdp.SerializeToString()
+    msg_spans = payload_spans(ser, 4)   # FileDescriptorProto.message_type
+    svc_spans = payload_spans(ser, 6)   # FileDescriptorProto.service
+    assert len(msg_spans) == len(MESSAGES) and len(svc_spans) == 1
+
+    offsets = []
+    for (msg_name, _), (start, end) in zip(MESSAGES, msg_spans):
+        offsets.append((f"_{msg_name.upper()}", start, end))
+    offsets.append(("_NODEVTPUINFO", svc_spans[0][0], svc_spans[0][1]))
+
+    lines = [
+        "# -*- coding: utf-8 -*-",
+        "# Generated by hack/gen_noderpc_pb2.py (no protoc in the image).",
+        "# DO NOT EDIT — edit protos/noderpc/noderpc.proto + the generator",
+        "# and re-run it.",
+        "# source: noderpc.proto",
+        '"""Generated protocol buffer code."""',
+        "from google.protobuf.internal import builder as _builder",
+        "from google.protobuf import descriptor as _descriptor",
+        "from google.protobuf import descriptor_pool as _descriptor_pool",
+        "from google.protobuf import symbol_database as _symbol_database",
+        "# @@protoc_insertion_point(imports)",
+        "",
+        "_sym_db = _symbol_database.Default()",
+        "",
+        "",
+        "",
+        "",
+        "DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile("
+        + repr(ser) + ")",
+        "",
+        "_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())",
+        "_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'noderpc_pb2',"
+        " globals())",
+        "if _descriptor._USE_C_DESCRIPTORS == False:",
+        "",
+        "  DESCRIPTOR._options = None",
+    ]
+    for name, start, end in offsets:
+        lines.append(f"  {name}._serialized_start={start}")
+        lines.append(f"  {name}._serialized_end={end}")
+    lines.append("# @@protoc_insertion_point(module_scope)")
+    with open(OUT, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {OUT} ({len(ser)} serialized descriptor bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""obs-lint — metric naming-convention check (make obs-lint).
+"""obs-lint — metric naming-convention + docs-drift check (make obs-lint).
 
 Imports every component that registers instruments into vtpu.obs, then
 verifies each registered name against the convention:
@@ -7,6 +7,11 @@ verifies each registered name against the convention:
   - prefix ``vtpu_``
   - counters end in ``_total``
   - other instruments end in a unit suffix (``_seconds``, ``_bytes``, …)
+
+and that every registered family name appears in docs/observability.md —
+a family you can scrape but cannot look up is drift, and so is a doc
+promising a family no component registers anymore (new names must land
+with their catalog entry in the same change).
 
 Exit 1 with one line per violation.  The exposition-format conformance
 tests (tests/test_obs.py -k conformance) run from the same make target.
@@ -24,8 +29,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 def main() -> int:
     # importing the modules is what populates the registries
+    import vtpu.monitor.feedback  # noqa: F401 — arbiter pass instruments
+    import vtpu.monitor.pathmonitor  # noqa: F401 — scan/GC counters
+    import vtpu.monitor.sampler  # noqa: F401 — duty-cycle families
     import vtpu.plugin.server  # noqa: F401 — plugin Allocate histogram
     import vtpu.scheduler.core  # noqa: F401 — filter/patch/bind histograms
+    import vtpu.scheduler.decisions  # noqa: F401 — audit-log counter
+    import vtpu.scheduler.metrics  # noqa: F401 — fragmentation gauges
     import vtpu.serving.batcher  # noqa: F401 — queue-to-first-token
     import vtpu.shim.runtime  # noqa: F401 — pacing/quota histograms
     from vtpu.obs import all_registries, lint_names
@@ -35,6 +45,18 @@ def main() -> int:
     }
     total = sum(len(v) for v in names.values())
     problems = lint_names()
+    # docs drift: every registered family must be documented in the
+    # metric catalog (docs/observability.md)
+    doc_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "observability.md")
+    with open(doc_path) as f:
+        doc = f.read()
+    for reg, metric_names in sorted(names.items()):
+        for n in metric_names:
+            if n not in doc:
+                problems.append(
+                    f"{reg}: {n}: not documented in docs/observability.md"
+                )
     for p in problems:
         print(f"obs-lint: {p}", file=sys.stderr)
     if problems:
@@ -44,7 +66,8 @@ def main() -> int:
     for reg, metric_names in sorted(names.items()):
         for n in metric_names:
             print(f"ok {reg}: {n}")
-    print(f"obs-lint: {total} registered metric name(s) conform")
+    print(f"obs-lint: {total} registered metric name(s) conform "
+          f"(naming + docs catalog)")
     return 0
 
 

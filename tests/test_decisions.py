@@ -1,0 +1,218 @@
+"""Placement-decision audit log: bounded ring under a 10k soak, per-node
+verdicts recorded by the filter, GET /decisions through the in-process
+extender, /timeline cross-link, and the fragmentation gauges."""
+
+import json
+import urllib.request
+
+import pytest
+
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.scheduler.config import SchedulerConfig
+from vtpu.scheduler.core import Scheduler
+from vtpu.scheduler.decisions import DecisionLog
+from vtpu.scheduler.routes import serve
+from vtpu.utils import codec
+from vtpu.utils.types import ChipInfo, annotations as A, resources as R
+
+
+def _cluster(chips_per_node=(4, 0), topology=""):
+    """FakeClient with n1 (chips) and n2 (maybe none) registered."""
+    client = FakeClient()
+    for i, n in enumerate(chips_per_node, start=1):
+        name = f"n{i}"
+        client.create_node(new_node(name))
+        if n:
+            enc = codec.encode_node_devices([
+                ChipInfo(
+                    uuid=f"{name}-tpu-{j}", count=4, hbm_mb=16384, cores=100,
+                    type="TPU-v5e", health=True,
+                    coords=(j, 0, 0) if topology else None,
+                )
+                for j in range(n)
+            ])
+            annos = {A.NODE_HANDSHAKE: "Reported 2026-08-01T00:00:00Z",
+                     A.NODE_REGISTER: enc}
+            if topology:
+                annos[A.NODE_TOPOLOGY] = topology
+            client.patch_node_annotations(name, annos)
+    sched = Scheduler(client, SchedulerConfig(http_bind="127.0.0.1:0"))
+    sched.register_from_node_annotations()
+    return client, sched
+
+
+def _chip_pod(name, uid=None, mem=1024, chips=1):
+    return new_pod(
+        name, uid=uid or f"uid-{name}",
+        containers=[{"name": "main", "resources": {
+            "limits": {R.chip: chips, R.memory: mem}}}],
+    )
+
+
+# -- bounded ring ---------------------------------------------------------
+
+
+def test_decision_log_cap_enforced_under_soak():
+    log = DecisionLog(cap=100)
+    for i in range(10_000):
+        log.record(pod=f"p{i}", pod_uid=f"u{i}", node="n1", verdicts={})
+    assert len(log) == 100
+    recs = log.query(n=10_000)
+    assert len(recs) == 100
+    # newest last, seq monotonic, oldest retained is 9901
+    assert recs[0]["seq"] == 9901 and recs[-1]["seq"] == 10_000
+    # pod filter + count cut
+    assert log.query(pod="u9999")[-1]["pod"] == "p9999"
+    assert log.query(pod="not-there") == []
+
+
+def test_decision_log_cap_env(monkeypatch):
+    monkeypatch.setenv("VTPU_DECISION_LOG_CAP", "7")
+    log = DecisionLog()
+    for i in range(50):
+        log.record(pod=f"p{i}")
+    assert log.cap == 7 and len(log) == 7
+    monkeypatch.setenv("VTPU_DECISION_LOG_CAP", "garbage")
+    assert DecisionLog().cap == 512  # default on a bad value
+
+
+# -- filter records verdicts ----------------------------------------------
+
+
+def test_filter_records_per_node_verdicts():
+    client, sched = _cluster((4, 0))
+    pod = client.create_pod(_chip_pod("audited", uid="uid-audited"))
+    res = sched.filter(pod, ["n1", "n2"])
+    assert res.node == "n1"
+    recs = sched.decisions.query(pod="uid-audited")
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["pod"] == "audited" and rec["node"] == "n1"
+    assert rec["path"] == "fast" and rec["elapsed_ms"] >= 0
+    v = rec["verdicts"]
+    assert v["n2"] == {"fit": False, "reason": "no vtpu devices registered"}
+    assert v["n1"]["fit"] is True and v["n1"]["chosen"] is True
+    assert "score" in v["n1"]
+    # the chosen placement (topology rectangle for gangs) is recorded
+    placement = v["n1"]["placement"]
+    assert placement[0][0]["uuid"].startswith("n1-tpu-")
+    assert placement[0][0]["mem"] == 1024
+
+
+def test_filter_records_no_fit_decision():
+    client, sched = _cluster((1, 0))
+    pod = client.create_pod(_chip_pod("toobig", uid="uid-toobig",
+                                      mem=999_999))
+    res = sched.filter(pod, ["n1", "n2"])
+    assert res.node is None and res.error
+    rec = sched.decisions.query(pod="uid-toobig")[-1]
+    assert rec["node"] is None
+    assert rec["verdicts"]["n1"] == {
+        "fit": False, "reason": "insufficient vtpu resources"
+    }
+
+
+def test_gang_decision_records_rectangle():
+    client, sched = _cluster((4,), topology="4x1x1")
+    pod = client.create_pod(_chip_pod("gang", uid="uid-gang", chips=2))
+    res = sched.filter(pod, ["n1"])
+    assert res.node == "n1"
+    rec = sched.decisions.query(pod="uid-gang")[-1]
+    placement = rec["verdicts"]["n1"]["placement"]
+    assert len(placement[0]) == 2  # two chips = the chosen rectangle
+    assert rec["path"] == "general"
+
+
+def test_decision_includes_utilization_snapshot():
+    client, sched = _cluster((4, 0))
+    client.patch_node_annotations("n1", {
+        A.NODE_UTILIZATION: json.dumps(
+            {"v": 1, "ts": 123, "devices": {"n1-tpu-0": {"duty": 0.37}}}
+        )
+    })
+    sched.register_from_node_annotations()
+    pod = client.create_pod(_chip_pod("snap", uid="uid-snap"))
+    assert sched.filter(pod, ["n1", "n2"]).node == "n1"
+    rec = sched.decisions.query(pod="uid-snap")[-1]
+    assert rec["utilization"]["n1"]["devices"]["n1-tpu-0"]["duty"] == 0.37
+    assert "n2" not in rec["utilization"]  # no write-back for n2
+
+
+# -- HTTP surface ---------------------------------------------------------
+
+
+def test_decisions_endpoint_through_extender():
+    client, sched = _cluster((4, 0))
+    srv, _ = serve(sched)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        # schedule THROUGH the extender wire, not sched.filter directly
+        pod = client.create_pod(_chip_pod("wired", uid="uid-wired"))
+        args = json.dumps({"pod": pod, "nodenames": ["n1", "n2"]}).encode()
+        req = urllib.request.Request(
+            f"{base}/filter", args, {"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert out["nodenames"] == ["n1"]
+
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/decisions?pod=uid-wired", timeout=10).read())
+        assert doc["count"] == 1
+        rec = doc["decisions"][0]
+        assert rec["node"] == "n1"
+        assert rec["verdicts"]["n2"]["fit"] is False
+        assert rec["verdicts"]["n1"]["chosen"] is True
+
+        # ?n= caps the answer
+        for i in range(5):
+            p = client.create_pod(_chip_pod(f"more{i}"))
+            sched.filter(p, ["n1"])
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/decisions?n=3", timeout=10).read())
+        assert doc["count"] == 3
+
+        # /timeline cross-links the audit trail
+        tl = json.loads(urllib.request.urlopen(
+            f"{base}/timeline?pod=uid-wired", timeout=10).read())
+        assert tl["decisions"] == "/decisions?pod=uid-wired"
+    finally:
+        srv.shutdown()
+
+
+# -- fragmentation gauges -------------------------------------------------
+
+
+def test_fragmentation_gauges_exported():
+    from vtpu.scheduler.metrics import render_metrics
+
+    client, sched = _cluster((4,), topology="4x1x1")
+    # book one chip: 3 free chips remain, largest free line = 3
+    pod = client.create_pod(_chip_pod("frag", uid="uid-frag"))
+    assert sched.filter(pod, ["n1"]).node == "n1"
+    text = render_metrics(sched)
+    assert 'vtpu_node_free_chips_ratio{node="n1"} 0.75' in text
+    assert 'vtpu_node_largest_free_rectangle_ratio{node="n1"} 0.75' in text
+    assert 'vtpu_nodes_by_free_chips_total{free_chips="3"} 1' in text
+    assert "vtpu_decisions_recorded_total 1" in text
+
+
+def test_measured_duty_gauge_exported():
+    from vtpu.scheduler.metrics import render_metrics
+
+    client, sched = _cluster((2,))
+    client.patch_node_annotations("n1", {
+        A.NODE_UTILIZATION: json.dumps(
+            {"v": 1, "ts": 1, "devices": {"n1-tpu-0": {"duty": 0.62}}}
+        )
+    })
+    sched.register_from_node_annotations()
+    text = render_metrics(sched)
+    assert ('vtpu_node_measured_duty_cycle_ratio'
+            '{node="n1",deviceuuid="n1-tpu-0"} 0.62') in text
+
+
+def test_decisions_query_n_zero_returns_nothing():
+    log = DecisionLog(cap=10)
+    for i in range(5):
+        log.record(pod=f"p{i}")
+    assert log.query(n=0) == []
+    assert len(log.query(n=-3)) == 0

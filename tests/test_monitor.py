@@ -798,3 +798,143 @@ def test_dispatch_pacing_converges_30_70(tmp_path):
     # tenant (observed 0.20 on a contended 2-vCPU runner); keep it just
     # high enough to catch a dead a30 tenant.
     assert 0.05 <= ratio <= 0.65, (counts, ratio)
+
+
+# -- utilization counters over the node RPC (region v4) -------------------
+
+
+def test_noderpc_roundtrips_utilization_counters(tmp_path):
+    """The new busy-ns/launch/high-watermark fields cross the wire from a
+    LIVE region: write through the Python shim API, read through a real
+    gRPC round trip."""
+    import grpc
+
+    from vtpu.monitor import noderpc_pb2 as pb
+    from vtpu.monitor.noderpc import NodeVtpuStub, serve_noderpc
+
+    root = str(tmp_path)
+    d = make_container_region(root, "pod-util", used_mb=20, limit_mb=64)
+    r = RegionFile(os.path.join(d, REGION_FILENAME))
+    r.record_launch(100, 0, 7_000_000, n=3)
+    r.sub_usage(100, 0, 15 << 20)  # watermark must survive the shrink
+    r.close()
+    pm = PathMonitor(root)
+    server, port = serve_noderpc(pm, bind="127.0.0.1:0")
+    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+        reply = NodeVtpuStub(ch).GetNodeVtpu(pb.GetNodeVtpuRequest(), timeout=10)
+    c = reply.containers[0]
+    assert c.devices[0].busy_ns == 7_000_000
+    assert c.devices[0].launches == 3
+    assert c.devices[0].hbm_peak_bytes == 20 << 20
+    assert c.devices[0].used_bytes == 5 << 20
+    p = c.procs[0]
+    assert p.busy_ns == 7_000_000 and p.launches == 3
+    server.stop(grace=None)
+    pm.close()
+
+
+# -- pathmonitor scan hardening -------------------------------------------
+
+
+def test_scan_survives_dir_vanishing_mid_pass(tmp_path, monkeypatch):
+    """A dir removed between listdir and the per-dir work must not abort
+    the pass: the surviving sibling is still scanned and the failure is
+    counted."""
+    import shutil
+
+    from vtpu import obs
+
+    root = str(tmp_path)
+    make_container_region(root, "pod-a")
+    make_container_region(root, "pod-b")
+    pm = PathMonitor(root)
+
+    failures = obs.registry("monitor")._instruments[
+        "vtpu_pathmonitor_scan_failures_total"]
+    before = failures.value()
+
+    real_getmtime = os.path.getmtime
+
+    def racing_getmtime(path):
+        if "pod-a_0" in path:
+            # simulate kubelet deleting the dir right under the GC check
+            shutil.rmtree(os.path.join(root, "pod-a_0"), ignore_errors=True)
+            raise FileNotFoundError(path)
+        return real_getmtime(path)
+
+    monkeypatch.setattr(os.path, "getmtime", racing_getmtime)
+    old = time.time() - 1000
+    os.utime(os.path.join(root, "pod-b_0"), (old, old))
+    entries = pm.scan(known_pod_uids=set())
+    # pod-a dropped without aborting; pod-b still GC'd by the same pass
+    assert "pod-a_0" not in entries
+    assert not os.path.exists(os.path.join(root, "pod-b_0"))
+    assert failures.value() == before + 1
+    pm.close()
+
+
+def test_scan_counts_gc_and_survives_root_vanishing(tmp_path):
+    from vtpu import obs
+
+    root = str(tmp_path / "containers")
+    os.makedirs(root)
+    d = make_container_region(root, "pod-gc")
+    old = time.time() - 1000
+    os.utime(d, (old, old))
+    pm = PathMonitor(root)
+    gcs = obs.registry("monitor")._instruments["vtpu_pathmonitor_gc_dirs_total"]
+    before = gcs.value()
+    pm.scan(known_pod_uids=set())
+    assert gcs.value() == before + 1
+    # root itself vanishing returns the cached entries, no raise
+    import shutil
+
+    shutil.rmtree(root)
+    assert pm.scan() == pm.entries
+    pm.close()
+
+
+# -- feedback loop lifecycle ----------------------------------------------
+
+
+def test_feedback_loop_double_start_and_joining_stop(tmp_path):
+    import threading
+
+    from vtpu.monitor.feedback import FeedbackLoop
+
+    pm = PathMonitor(str(tmp_path))
+    fb = FeedbackLoop(pm, interval_s=0.05)
+    assert fb.start() is True
+    assert fb.start() is False  # no second arbiter thread
+    alive = [t for t in threading.enumerate() if t.name == "vtpu-feedback"]
+    assert len(alive) == 1
+    thread = fb._thread
+    fb.stop(timeout=5.0)
+    assert thread is not None and not thread.is_alive()  # joined, not leaked
+    # restart after stop works (the stop event is re-armed)
+    assert fb.start() is True
+    fb.stop(timeout=5.0)
+    assert not fb._thread.is_alive()
+    pm.close()
+
+
+def test_feedback_pass_instrumented(tmp_path):
+    from vtpu import obs
+    from vtpu.monitor.feedback import FeedbackLoop
+
+    pm = PathMonitor(str(tmp_path))
+    make_container_region(str(tmp_path), "pod-fb")
+    fb = FeedbackLoop(pm, interval_s=999)
+    hist = obs.registry("monitor")._instruments["vtpu_feedback_pass_seconds"]
+    before = (hist.snapshot() or {"count": 0})["count"]
+    fb._pass_once()
+    assert hist.snapshot()["count"] == before + 1
+
+    fails = obs.registry("monitor")._instruments[
+        "vtpu_feedback_failures_total"]
+    fbefore = fails.value()
+    pm.entries["boom"] = type("E", (), {"region": object(), "dirname": "boom"})()
+    fb._pass_once()  # the bogus entry raises inside the pass
+    assert fails.value() == fbefore + 1
+    pm.entries.pop("boom", None)
+    pm.close()

@@ -42,6 +42,7 @@ def test_region_python_create_and_read(tmp_path):
     assert r.device_uuids() == ["tpu-a", "tpu-b"]
     assert r.usage()[0] == {
         "buffer": 1 << 20, "program": 2 << 20, "total": 3 << 20, "swap": 0,
+        "busy_ns": 0, "launches": 0, "hbm_peak": 3 << 20,
     }
     procs = r.live_procs()
     assert procs[0]["pid"] == 1234 and procs[0]["priority"] == 1
@@ -223,4 +224,37 @@ def test_native_shim_full_suite(native, tmp_path):
     r = RegionFile(str(tmp_path / "shim.cache"))
     assert r.device_uuids() == ["mock-tpu-0"]
     assert r.limits()[0] == 64 << 20
+    r.close()
+
+
+def test_native_open_refuses_legacy_v3_region(native, tmp_path):
+    """The C side must REFUSE a smaller old-version region rather than
+    classify it as fresh and memset live tenant state (the Python monitor
+    keeps the v3 read path; writers do not)."""
+    from vtpu.monitor import shared_region as sr
+
+    path = str(tmp_path / "old.cache")
+    buf = bytearray(sr.REGION_SIZE_V3)
+    reg = sr._SharedRegionV3.from_buffer(buf)
+    reg.magic = sr.VTPU_REGION_MAGIC
+    reg.version = 3
+    reg.initialized = 1
+    reg.num_devices = 1
+    reg.uuids[0].value = b"tpu-old"
+    reg.procs[0].pid = 77
+    reg.procs[0].status = 1
+    reg.procs[0].used[0].buffer_bytes = 9 << 20
+    reg.procs[0].used[0].total_bytes = 9 << 20
+    reg.proc_num = 1
+    del reg
+    with open(path, "wb") as f:
+        f.write(buf)
+    tool = os.path.join(native, "region_tool")
+    out = subprocess.run([tool, "add", path, "1", "0", "buffer", "1024"],
+                         capture_output=True, timeout=30)
+    assert out.returncode != 0  # refused, not truncated+wiped
+    # the v3 content survived untouched
+    assert os.path.getsize(path) == sr.REGION_SIZE_V3
+    r = sr.RegionFile(path)
+    assert r.version == 3 and r.usage()[0]["total"] == 9 << 20
     r.close()

@@ -1,0 +1,419 @@
+"""Per-pod utilization profiling: duty-cycle oracle (fake clock, no
+sleeps), region v4 counters + v3 legacy read path, sampler time series +
+bounds, node write-back gating, scheduler ingest, and the /utilization +
+/trace.json HTTP surface."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from vtpu.k8s import FakeClient, new_node
+from vtpu.monitor import shared_region as sr
+from vtpu.monitor.pathmonitor import REGION_FILENAME, PathMonitor
+from vtpu.monitor.sampler import UtilizationSampler
+from vtpu.monitor.shared_region import RegionFile
+from vtpu.shim import ShimRuntime
+from vtpu.utils.types import annotations as A
+
+
+class FakeClock:
+    """Monotonic + wall clock + sleep, advanced only by the code under
+    test — the duty-cycle oracle runs with ZERO real sleeps."""
+
+    def __init__(self, t0: float = 100.0) -> None:
+        self.t = t0
+
+    def monotonic(self) -> float:
+        return self.t
+
+    def time(self) -> float:
+        return 1.7e9 + self.t
+
+    def sleep(self, dt: float) -> None:
+        self.t += max(0.0, dt)
+
+
+class _Done:
+    def block_until_ready(self):
+        return self
+
+
+def _paced_runtime(root, clk, quota=30, pod_uid="pod-duty", limit_mb=256):
+    d = os.path.join(root, f"{pod_uid}_0")
+    os.makedirs(d, exist_ok=True)
+    return ShimRuntime(
+        limits_bytes=[limit_mb << 20],
+        core_limit=quota,
+        region_path=os.path.join(d, REGION_FILENAME),
+        uuids=["tpu-0"],
+        clock=clk,
+    )
+
+
+def _last_duty(sampler, ctr="pod-duty_0", uuid="tpu-0"):
+    series = sampler.series()["containers"]
+    return series[ctr]["devices"][uuid][-1]["duty"]
+
+
+# -- the duty-cycle oracle ------------------------------------------------
+
+
+def test_duty_cycle_oracle_tracks_pacing_quota(tmp_path):
+    """A tenant paced at q% must SAMPLE at ≈q% duty: each fake-clock step
+    is device-bound for T, pacing sleeps T×(100−q)/q between launches, and
+    the sampler diffs the region's busy-ns counter over the same clock."""
+    q = 30
+    clk = FakeClock()
+    rt = _paced_runtime(str(tmp_path), clk, quota=q)
+    pm = PathMonitor(str(tmp_path))
+    sampler = UtilizationSampler(
+        pm, clock=clk.monotonic, wallclock=clk.time
+    )
+    sampler.sample_once()  # baseline
+    T = 0.01
+    for _ in range(300):
+        rt.dispatch(lambda: (clk.sleep(T), _Done())[1])
+    sampler.sample_once()
+    duty = _last_duty(sampler)
+    assert duty == pytest.approx(q / 100, abs=0.05), duty
+    # headroom of the same window: ≈0 (the tenant used its whole quota)
+    series = sampler.series()["containers"]["pod-duty_0"]["devices"]["tpu-0"]
+    assert series[-1]["headroom"] == pytest.approx(0.0, abs=0.2)
+    rt.close()
+    pm.close()
+
+
+def test_duty_cycle_rises_above_quota_on_priority_suspend(tmp_path):
+    """utilization_switch=1 (the feedback arbiter's priority suspend)
+    lifts the throttle: the sampled duty must climb clear of the quota."""
+    q = 30
+    clk = FakeClock()
+    rt = _paced_runtime(str(tmp_path), clk, quota=q, pod_uid="pod-duty")
+    pm = PathMonitor(str(tmp_path))
+    sampler = UtilizationSampler(pm, clock=clk.monotonic, wallclock=clk.time)
+    T = 0.01
+    for _ in range(20):  # calibrate the step-time estimate while paced
+        rt.dispatch(lambda: (clk.sleep(T), _Done())[1])
+    rt.region.set_utilization_switch(1)
+    sampler.sample_once()  # baseline after the paced warm-up
+    for _ in range(50):
+        rt.dispatch(lambda: (clk.sleep(T), _Done())[1])
+    sampler.sample_once()
+    duty = _last_duty(sampler)
+    assert duty > q / 100 + 0.2, duty  # unthrottled ≈ 1.0
+    rt.close()
+    pm.close()
+
+
+# -- region v4 counters ---------------------------------------------------
+
+
+def test_hbm_high_watermark_ratchets(tmp_path):
+    r = RegionFile(str(tmp_path / "w.cache"), create=True)
+    r.set_devices(["tpu-0"], [100 << 20], [100])
+    r.register_proc(7)
+    r.add_usage(7, 0, 30 << 20)
+    r.add_usage(7, 0, 20 << 20)
+    r.sub_usage(7, 0, 45 << 20)
+    u = r.usage()[0]
+    assert u["total"] == 5 << 20
+    assert u["hbm_peak"] == 50 << 20  # never comes down on sub
+    r.add_usage(7, 0, 10 << 20)
+    assert r.usage()[0]["hbm_peak"] == 50 << 20  # below peak: no move
+    r.close()
+
+
+def test_record_launch_accumulates_per_device(tmp_path):
+    r = RegionFile(str(tmp_path / "l.cache"), create=True)
+    r.set_devices(["tpu-0", "tpu-1"], [0, 0], [100, 100])
+    r.register_proc(9)
+    r.record_launch(9, 0, 5_000_000)
+    r.record_launch(9, 0, 7_000_000)
+    r.record_launch(9, 1, 1_000_000, n=2)
+    usage = r.usage()
+    assert usage[0]["busy_ns"] == 12_000_000 and usage[0]["launches"] == 2
+    assert usage[1]["busy_ns"] == 1_000_000 and usage[1]["launches"] == 2
+    assert r.region.recent_kernel == 4
+    procs = r.live_procs()
+    assert procs[0]["busy_ns"] == 13_000_000 and procs[0]["launches"] == 4
+    r.close()
+
+
+def test_legacy_v3_region_read_path(tmp_path):
+    """A region written by a pre-v4 shim still opens: usage reads work,
+    the new counters report 0, and the write paths that touch v4 fields
+    degrade gracefully (record_launch only bumps the activity counter)."""
+    path = str(tmp_path / "v3.cache")
+    buf = bytearray(sr.REGION_SIZE_V3)
+    reg = sr._SharedRegionV3.from_buffer(buf)
+    reg.magic = sr.VTPU_REGION_MAGIC
+    reg.version = 3
+    reg.initialized = 1
+    reg.num_devices = 1
+    reg.uuids[0].value = b"tpu-old"
+    reg.limit_bytes[0] = 64 << 20
+    reg.core_limit[0] = 50
+    reg.procs[0].pid = 11
+    reg.procs[0].status = 1
+    reg.procs[0].used[0].buffer_bytes = 12 << 20
+    reg.procs[0].used[0].total_bytes = 12 << 20
+    reg.proc_num = 1
+    del reg  # release the ctypes view before writing
+    with open(path, "wb") as f:
+        f.write(buf)
+    # create=True must NOT grow/clobber the old region into a v4 layout
+    r = RegionFile(path, create=True)
+    assert r.version == 3
+    u = r.usage()[0]
+    assert u["total"] == 12 << 20
+    assert u["busy_ns"] == 0 and u["launches"] == 0 and u["hbm_peak"] == 0
+    r.record_launch(11, 0, 999)       # v4 counters silently skipped
+    assert r.region.recent_kernel == 1
+    r.add_usage(11, 0, 1 << 20)       # no hbm_peak field to ratchet
+    assert r.usage()[0]["total"] == 13 << 20
+    assert r.live_procs()[0]["busy_ns"] == 0
+    r.close()
+    assert os.path.getsize(path) == sr.REGION_SIZE_V3
+
+
+# -- sampler series -------------------------------------------------------
+
+
+def test_series_ring_bounded_and_windowed(tmp_path):
+    clk = FakeClock()
+    d = tmp_path / "pod-ring_0"
+    d.mkdir()
+    r = RegionFile(str(d / REGION_FILENAME), create=True)
+    r.set_devices(["tpu-0"], [0], [100])
+    r.register_proc(5)
+    pm = PathMonitor(str(tmp_path))
+    sampler = UtilizationSampler(
+        pm, clock=clk.monotonic, wallclock=clk.time, series_cap=16
+    )
+    for _ in range(60):
+        r.record_launch(5, 0, int(0.5e9))
+        clk.sleep(1.0)
+        sampler.sample_once()
+    ring = sampler.series()["containers"]["pod-ring_0"]["devices"]["tpu-0"]
+    assert len(ring) == 16  # bounded at the cap despite 59 diff samples
+    assert all(p["duty"] == pytest.approx(0.5, abs=0.01) for p in ring)
+    # window filter: only points within the last 5 s (inclusive cutoff)
+    windowed = sampler.series(window_s=5.0)
+    pts = windowed["containers"]["pod-ring_0"]["devices"]["tpu-0"]
+    assert 0 < len(pts) <= 6 < len(ring)
+    # pod filter by UID prefix of the dirname
+    assert sampler.series(pod="pod-ring")["count"] == 1
+    assert sampler.series(pod="nope")["count"] == 0
+    r.close()
+    pm.close()
+
+
+def test_sampler_rebaselines_on_counter_reset(tmp_path):
+    """A tenant restart zeroes the monotonic counters; the diff must be
+    dropped (re-baseline), never reported as a negative/huge duty."""
+    clk = FakeClock()
+    d = tmp_path / "pod-rst_0"
+    d.mkdir()
+    r = RegionFile(str(d / REGION_FILENAME), create=True)
+    r.set_devices(["tpu-0"], [0], [100])
+    r.register_proc(5)
+    pm = PathMonitor(str(tmp_path))
+    sampler = UtilizationSampler(pm, clock=clk.monotonic, wallclock=clk.time)
+    r.record_launch(5, 0, int(3e9))
+    sampler.sample_once()
+    clk.sleep(1.0)
+    # restart: fresh registration clears the slot counters
+    r.register_proc(5, fresh=True)
+    sampler.sample_once()
+    assert "pod-rst_0" not in sampler.series()["containers"]
+    clk.sleep(2.0)
+    r.record_launch(5, 0, int(1e9))
+    sampler.sample_once()
+    pts = sampler.series()["containers"]["pod-rst_0"]["devices"]["tpu-0"]
+    assert pts[-1]["duty"] == pytest.approx(0.5, abs=0.01)
+    r.close()
+    pm.close()
+
+
+# -- node write-back + scheduler ingest -----------------------------------
+
+
+def _writeback_sampler(tmp_path, clk, client):
+    d = tmp_path / "pod-wb_0"
+    d.mkdir()
+    r = RegionFile(str(d / REGION_FILENAME), create=True)
+    r.set_devices(["tpu-0"], [0], [100])
+    r.register_proc(5)
+    pm = PathMonitor(str(tmp_path))
+    sampler = UtilizationSampler(
+        pm, clock=clk.monotonic, wallclock=clk.time,
+        writeback_client=client, node_name="n1",
+        writeback_min_interval_s=30.0, writeback_min_delta=0.05,
+    )
+    return r, pm, sampler
+
+
+def test_writeback_rate_limited_and_delta_gated(tmp_path):
+    clk = FakeClock()
+    client = FakeClient()
+    client.create_node(new_node("n1"))
+    r, pm, sampler = _writeback_sampler(tmp_path, clk, client)
+
+    sampler.sample_once()
+    assert sampler.writeback_once() == "written"  # first write always lands
+    anno = client.get_node("n1")["metadata"]["annotations"]
+    payload = json.loads(anno[A.NODE_UTILIZATION])
+    assert payload["v"] == 1 and "tpu-0" in payload["devices"]
+
+    # inside the min interval: gated regardless of delta
+    clk.sleep(1.0)
+    r.record_launch(5, 0, int(0.9e9))
+    sampler.sample_once()
+    assert sampler.writeback_once() == "skipped_interval"
+
+    # past the interval but duty barely moved: delta gate
+    clk.sleep(30.0)
+    r.record_launch(5, 0, int(0.0e9))
+    sampler.sample_once()
+    first_duty = json.loads(
+        client.get_node("n1")["metadata"]["annotations"][A.NODE_UTILIZATION]
+    )["devices"]["tpu-0"]["duty"]
+    summary = sampler.sample_once()
+    assert abs(summary["tpu-0"]["duty"] - first_duty) < 0.05
+    assert sampler.writeback_once() == "skipped_delta"
+
+    # past the interval AND a real change: written, annotation updated
+    clk.sleep(31.0)
+    r.record_launch(5, 0, int(25e9))
+    sampler.sample_once()
+    assert sampler.writeback_once() == "written"
+    updated = json.loads(
+        client.get_node("n1")["metadata"]["annotations"][A.NODE_UTILIZATION]
+    )
+    assert updated["devices"]["tpu-0"]["duty"] > first_duty
+    r.close()
+    pm.close()
+
+
+def test_scheduler_ingests_node_utilization_annotation(tmp_path):
+    from vtpu.scheduler.config import SchedulerConfig
+    from vtpu.scheduler.core import Scheduler
+
+    clk = FakeClock()
+    client = FakeClient()
+    client.create_node(new_node("n1"))
+    r, pm, sampler = _writeback_sampler(tmp_path, clk, client)
+    sampler.sample_once()
+    clk.sleep(10.0)
+    r.record_launch(5, 0, int(4e9))
+    sampler.sample_once()
+    assert sampler.writeback_once() == "written"
+
+    sched = Scheduler(client, SchedulerConfig())
+    sched.register_from_node_annotations()
+    measured = sched.usage_cache.measured_utilization("n1")
+    assert measured is not None
+    assert measured["devices"]["tpu-0"]["duty"] == pytest.approx(0.4, abs=0.01)
+    # full-snapshot form too
+    assert "n1" in sched.usage_cache.measured_utilization()
+    r.close()
+    pm.close()
+
+
+# -- HTTP surface ---------------------------------------------------------
+
+
+def test_utilization_endpoint_and_trace_merge(tmp_path):
+    from vtpu.monitor.metrics import serve_metrics
+
+    clk = FakeClock()
+    d = tmp_path / "pod-http_0"
+    d.mkdir()
+    r = RegionFile(str(d / REGION_FILENAME), create=True)
+    r.set_devices(["tpu-0"], [0], [100])
+    r.register_proc(5)
+    pm = PathMonitor(str(tmp_path))
+    sampler = UtilizationSampler(pm, clock=clk.monotonic, wallclock=clk.time)
+    sampler.sample_once()
+    clk.sleep(2.0)
+    r.record_launch(5, 0, int(1e9))
+    sampler.sample_once()
+
+    srv, _ = serve_metrics(pm, bind="127.0.0.1:0", sampler=sampler)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/utilization?pod=pod-http", timeout=10).read())
+        assert doc["count"] == 1
+        pts = doc["containers"]["pod-http_0"]["devices"]["tpu-0"]
+        assert pts[-1]["duty"] == pytest.approx(0.5, abs=0.01)
+        # window= filters: advance the clock past every sample point
+        clk.sleep(100.0)
+        doc2 = json.loads(urllib.request.urlopen(
+            f"{base}/utilization?window=5", timeout=10).read())
+        assert doc2["count"] == 0
+        # duty-cycle counter events merged into the Chrome export
+        trace_doc = json.loads(urllib.request.urlopen(
+            f"{base}/trace.json", timeout=10).read())
+        counters = [e for e in trace_doc["traceEvents"] if e["ph"] == "C"]
+        assert counters and counters[-1]["args"]["duty"] == pytest.approx(
+            0.5, abs=0.01)
+        assert "duty pod-http_0/tpu-0" in {e["name"] for e in counters}
+        # the duty gauges ride the monitor registry on /metrics
+        body = urllib.request.urlopen(f"{base}/metrics", timeout=10).read()
+        assert b"vtpu_pod_duty_cycle_ratio" in body
+        assert b"vtpu_pod_kernel_launches_total" in body
+        assert b"vtpu_pod_hbm_high_watermark_bytes" in body
+        assert b"vtpu_pod_quota_headroom_ratio" in body
+    finally:
+        srv.shutdown()
+    r.close()
+    pm.close()
+
+
+def test_sampler_prunes_vanished_containers(tmp_path):
+    from vtpu import obs
+
+    clk = FakeClock()
+    d = tmp_path / "pod-gone_0"
+    d.mkdir()
+    r = RegionFile(str(d / REGION_FILENAME), create=True)
+    r.set_devices(["tpu-0"], [0], [100])
+    r.register_proc(5)
+    pm = PathMonitor(str(tmp_path))
+    sampler = UtilizationSampler(pm, clock=clk.monotonic, wallclock=clk.time)
+    sampler.sample_once()
+    clk.sleep(1.0)
+    r.record_launch(5, 0, int(1e9))
+    sampler.sample_once()
+    duty = obs.registry("monitor")._instruments["vtpu_pod_duty_cycle_ratio"]
+    labels = dict(ctr="pod-gone_0", podname="", podnamespace="",
+                  deviceuuid="tpu-0")
+    assert duty.value(**labels) == pytest.approx(1.0, abs=0.01)
+    r.close()
+    import shutil
+
+    shutil.rmtree(d)
+    sampler.sample_once()
+    assert sampler.series()["count"] == 0
+    assert duty.value(**labels) == 0  # label set pruned from exposition
+    pm.close()
+
+
+def test_unpaced_tenant_still_reports_duty(tmp_path):
+    """core_limit=100 (no pacing) must not freeze duty at 0: the shim
+    falls back to the host-side call duration per launch."""
+    clk = FakeClock()
+    rt = _paced_runtime(str(tmp_path), clk, quota=100, pod_uid="pod-duty")
+    pm = PathMonitor(str(tmp_path))
+    sampler = UtilizationSampler(pm, clock=clk.monotonic, wallclock=clk.time)
+    sampler.sample_once()
+    T = 0.01
+    for _ in range(50):
+        rt.dispatch(lambda: (clk.sleep(T), _Done())[1])
+    sampler.sample_once()
+    assert _last_duty(sampler) == pytest.approx(1.0, abs=0.05)
+    rt.close()
+    pm.close()

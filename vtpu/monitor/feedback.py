@@ -13,13 +13,25 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Iterable
+import time
+from typing import Iterable, Optional
 
+from vtpu import obs
 from vtpu.monitor.pathmonitor import PathMonitor
 
 log = logging.getLogger(__name__)
 
 ACTIVITY_THRESHOLD = 1  # recent_kernel above this = "recently active"
+
+_MON = obs.registry("monitor")
+_PASS_HIST = _MON.histogram(
+    "vtpu_feedback_pass_seconds",
+    "One feedback-arbiter pass: scan + decay/arbitrate + hostpid fill + reap",
+)
+_FAILURES = _MON.counter(
+    "vtpu_feedback_failures_total",
+    "Feedback passes that raised (logged and retried next tick)",
+)
 
 
 def observe_once(pathmon: PathMonitor) -> None:
@@ -45,31 +57,58 @@ def observe_once(pathmon: PathMonitor) -> None:
 
 
 class FeedbackLoop:
+    """Lifecycle-safe wrapper around the arbiter thread: ``start()`` is
+    idempotent while the thread is alive (a double start must not spawn a
+    second arbiter racing the first over utilization_switch), the thread
+    handle is retained, and ``stop()`` joins with a timeout."""
+
     def __init__(self, pathmon: PathMonitor, interval_s: float = 5.0) -> None:
         self.pathmon = pathmon
         self.interval_s = interval_s
         self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
 
-    def start(self) -> None:
+    def _pass_once(self) -> None:
         from vtpu.monitor.hostpid import fill_hostpids, reap_dead_by_hostpid
+
+        t0 = time.perf_counter()
+        try:
+            self.pathmon.scan()
+            observe_once(self.pathmon)
+            # resolve container→host pids for new slots each tick
+            # (ref setHostPid runs inside the feedback loop too),
+            # then free slots whose host process died — a crashed
+            # tenant must not pin its quota bytes
+            fill_hostpids(self.pathmon)
+            reaped = reap_dead_by_hostpid(self.pathmon)
+            if reaped:
+                log.info("reaped %d dead tenant slot(s)", reaped)
+        except Exception:  # noqa: BLE001
+            _FAILURES.inc()
+            log.exception("feedback pass failed")
+        finally:
+            _PASS_HIST.observe(time.perf_counter() - t0)
+
+    def start(self) -> bool:
+        """Start the loop; returns False (no-op) when already running."""
+        if self._thread is not None and self._thread.is_alive():
+            log.warning("feedback loop already running; start() ignored")
+            return False
+        self._stop.clear()
 
         def loop() -> None:
             while not self._stop.wait(self.interval_s):
-                try:
-                    self.pathmon.scan()
-                    observe_once(self.pathmon)
-                    # resolve container→host pids for new slots each tick
-                    # (ref setHostPid runs inside the feedback loop too),
-                    # then free slots whose host process died — a crashed
-                    # tenant must not pin its quota bytes
-                    fill_hostpids(self.pathmon)
-                    reaped = reap_dead_by_hostpid(self.pathmon)
-                    if reaped:
-                        log.info("reaped %d dead tenant slot(s)", reaped)
-                except Exception:  # noqa: BLE001
-                    log.exception("feedback pass failed")
+                self._pass_once()
 
-        threading.Thread(target=loop, name="vtpu-feedback", daemon=True).start()
+        self._thread = threading.Thread(
+            target=loop, name="vtpu-feedback", daemon=True
+        )
+        self._thread.start()
+        return True
 
-    def stop(self) -> None:
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        """Signal the loop and join the thread (bounded by ``timeout``)."""
         self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)

@@ -141,8 +141,13 @@ def serve_metrics(
     provider=None,
     pods_fn=None,
     bind: str = "0.0.0.0:9394",
+    sampler=None,
 ) -> Tuple[ThreadingHTTPServer, threading.Thread]:
-    """ref metrics.go — :9394/metrics endpoint."""
+    """ref metrics.go — :9394/metrics endpoint.  With a
+    ``UtilizationSampler`` attached the server also serves
+    ``GET /utilization?pod=&window=`` (JSON duty-cycle time series) and
+    merges the sampler's counter events into ``/trace.json`` so duty
+    cycle renders beside the span feed."""
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, body: bytes, ctype: str) -> None:
@@ -153,8 +158,33 @@ def serve_metrics(
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802
-            if self.path.split("?", 1)[0] in ("/spans", "/timeline",
-                                              "/trace.json"):
+            route = self.path.split("?", 1)[0]
+            if route == "/utilization":
+                from vtpu.obs.http import split_query
+
+                if sampler is None:
+                    self._send(404, b'{"error": "no sampler attached"}',
+                               "application/json")
+                    return
+                _, params = split_query(self.path)
+                try:
+                    body = sampler.utilization_body(params)
+                except Exception as e:  # noqa: BLE001
+                    log.exception("utilization render failed")
+                    self._send(500, str(e).encode(), "text/plain")
+                    return
+                self._send(200, body, "application/json")
+                return
+            if route == "/trace.json" and sampler is not None:
+                try:
+                    body = sampler.merged_chrome().encode()
+                except Exception as e:  # noqa: BLE001
+                    log.exception("trace merge failed")
+                    self._send(500, str(e).encode(), "text/plain")
+                    return
+                self._send(200, body, "application/json")
+                return
+            if route in ("/spans", "/timeline", "/trace.json"):
                 # shared debug surface (vtpu/obs/http.py)
                 from vtpu.obs.http import handle_debug_get
 

@@ -39,6 +39,11 @@ def _container_usage(entry) -> pb.ContainerUsage:
                 program_bytes=usage[i]["program"],
                 swap_bytes=usage[i].get("swap", 0),
                 core_limit=cores[i],
+                # utilization profiling (region v4): monotonic counters
+                # summed across live procs + the HBM high-watermark
+                busy_ns=usage[i].get("busy_ns", 0),
+                launches=usage[i].get("launches", 0),
+                hbm_peak_bytes=usage[i].get("hbm_peak", 0),
             )
         )
     procs = r.live_procs()
@@ -50,6 +55,8 @@ def _container_usage(entry) -> pb.ContainerUsage:
                 hostpid=p.get("hostpid", 0),
                 exec_calls=p.get("exec_calls", 0),
                 exec_shim_ns=p.get("exec_shim_ns", 0),
+                busy_ns=p.get("busy_ns", 0),
+                launches=p.get("launches", 0),
             )
         )
     return cu

@@ -3,6 +3,13 @@
 Walks /usr/local/vtpu/containers/<podUID>_<n>/, mmaps each vtpu.cache into
 a RegionFile, validates the owning pod still exists, and GCs dirs whose pod
 is gone and whose mtime is stale (300 s).
+
+Hardened against scan races: kubelet (or the GC of a peer monitor) can
+remove a container dir between ``listdir`` and the per-dir stat/open/GC —
+one vanished dir must never abort the whole pass.  Per-dir failures are
+swallowed, counted on ``vtpu_pathmonitor_scan_failures_total``, and the
+entry retries next tick; GC'd dirs count on
+``vtpu_pathmonitor_gc_dirs_total``.
 """
 
 from __future__ import annotations
@@ -13,12 +20,27 @@ import shutil
 import time
 from typing import Dict, Optional
 
+from vtpu import obs
 from vtpu.monitor.shared_region import RegionFile, open_region
 
 log = logging.getLogger(__name__)
 
 GC_GRACE_S = 300  # ref pathmonitor.go:83-92
 REGION_FILENAME = "vtpu.cache"
+
+_MON = obs.registry("monitor")
+_SCANS = _MON.counter(
+    "vtpu_pathmonitor_scans_total", "Pathmonitor scan passes completed"
+)
+_SCAN_FAILURES = _MON.counter(
+    "vtpu_pathmonitor_scan_failures_total",
+    "Per-dir scan steps that failed (dir vanished mid-pass, unreadable "
+    "region, stat error) — the pass continues past each one",
+)
+_GC_DIRS = _MON.counter(
+    "vtpu_pathmonitor_gc_dirs_total",
+    "Stale container dirs garbage-collected (pod gone + mtime past grace)",
+)
 
 
 class ContainerEntry:
@@ -40,42 +62,67 @@ class PathMonitor:
     def scan(self, known_pod_uids: Optional[set] = None) -> Dict[str, ContainerEntry]:
         """One monitorpath pass (ref :72-114): pick up new dirs, drop+GC
         stale ones.  ``known_pod_uids`` of None skips pod validation."""
-        if not os.path.isdir(self.root):
-            return self.entries
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return self.entries  # root missing / unreadable: nothing to do
         seen = set()
-        for name in sorted(os.listdir(self.root)):
+        for name in names:
             d = os.path.join(self.root, name)
-            if not os.path.isdir(d):
-                continue
-            seen.add(name)
-            if name not in self.entries:
-                cache = os.path.join(d, REGION_FILENAME)
-                region = open_region(cache) if os.path.exists(cache) else None
-                self.entries[name] = ContainerEntry(name, d, region)
-                if region:
-                    log.info("monitoring new container region %s", name)
-            elif self.entries[name].region is None:
-                # region file may appear after the dir (mount then first touch)
-                cache = os.path.join(d, REGION_FILENAME)
-                if os.path.exists(cache):
-                    self.entries[name].region = open_region(cache)
-            if known_pod_uids is not None:
-                entry = self.entries[name]
-                if entry.pod_uid not in known_pod_uids:
-                    age = time.time() - os.path.getmtime(d)
-                    if age > GC_GRACE_S:
-                        log.info("GC stale container dir %s (age %.0fs)", name, age)
-                        if entry.region:
-                            entry.region.close()
-                        shutil.rmtree(d, ignore_errors=True)
-                        self.entries.pop(name, None)
-                        seen.discard(name)
+            try:
+                self._scan_one(name, d, known_pod_uids, seen)
+            except OSError:
+                # dir vanished (or turned unreadable) between listdir and
+                # the per-dir work — skip it, keep the pass alive
+                _SCAN_FAILURES.inc()
+                log.debug("scan: %s failed mid-pass", name, exc_info=True)
         for name in list(self.entries):
             if name not in seen:
                 e = self.entries.pop(name)
                 if e.region:
                     e.region.close()
+        _SCANS.inc()
         return self.entries
+
+    def _scan_one(
+        self, name: str, d: str, known_pod_uids: Optional[set], seen: set
+    ) -> None:
+        if not os.path.isdir(d):
+            return
+        seen.add(name)
+        if name not in self.entries:
+            cache = os.path.join(d, REGION_FILENAME)
+            region = open_region(cache) if os.path.exists(cache) else None
+            self.entries[name] = ContainerEntry(name, d, region)
+            if region:
+                log.info("monitoring new container region %s", name)
+        elif self.entries[name].region is None:
+            # region file may appear after the dir (mount then first touch)
+            cache = os.path.join(d, REGION_FILENAME)
+            if os.path.exists(cache):
+                self.entries[name].region = open_region(cache)
+        if known_pod_uids is not None:
+            entry = self.entries[name]
+            if entry.pod_uid not in known_pod_uids:
+                try:
+                    age = time.time() - os.path.getmtime(d)
+                except OSError:
+                    # dir vanished between isdir and getmtime: treat as
+                    # already gone — drop the entry, no GC needed
+                    _SCAN_FAILURES.inc()
+                    if entry.region:
+                        entry.region.close()
+                    self.entries.pop(name, None)
+                    seen.discard(name)
+                    return
+                if age > GC_GRACE_S:
+                    log.info("GC stale container dir %s (age %.0fs)", name, age)
+                    if entry.region:
+                        entry.region.close()
+                    shutil.rmtree(d, ignore_errors=True)
+                    self.entries.pop(name, None)
+                    seen.discard(name)
+                    _GC_DIRS.inc()
 
     def close(self) -> None:
         for e in self.entries.values():

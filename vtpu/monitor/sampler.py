@@ -1,0 +1,431 @@
+"""Per-pod utilization profiling: the duty-cycle sampler.
+
+The reference's vGPUmonitor exports instantaneous byte totals only; its
+per-pod *usage* story (metrics.go Collect + the decayed recentKernel
+counter) never answers "what fraction of its core quota did pod X actually
+use?".  This sampler closes that gap: every tick it diffs the region-v4
+monotonic counters (cumulative device-busy ns + kernel-launch count,
+written by the shim's pacing path) into per-pod per-device **duty-cycle
+ratios**, tracks the HBM high-watermark, retains a bounded ring-buffer
+time series per (container, device), and publishes:
+
+- Prometheus families through the shared ``vtpu/obs`` monitor registry
+  (``vtpu_pod_duty_cycle_ratio``, ``vtpu_pod_hbm_high_watermark_bytes``,
+  ``vtpu_pod_kernel_launches_total``, ``vtpu_pod_quota_headroom_ratio``);
+- ``GET /utilization?pod=&window=`` JSON time series (mounted by
+  vtpu/monitor/metrics.py);
+- Chrome trace counter events merged into ``/trace.json`` so duty cycle
+  renders as a track beside the pod-lifecycle spans;
+- a rate-limited, delta-gated ``vtpu.io/node-utilization`` node
+  annotation summarizing per-device duty — the write-back the scheduler's
+  UsageCache ingests (the feedback loop the reference sketched in
+  feedback.go but shipped disabled).
+
+Clocks are injectable (``clock`` = monotonic seconds for diffing,
+``wallclock`` = epoch seconds for series/trace timestamps) so the
+duty-cycle oracle tests run on a fake clock with zero sleeps.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from typing import Deque, Dict, List, Optional, Tuple
+
+from vtpu import obs
+from vtpu.monitor.pathmonitor import PathMonitor
+from vtpu.utils import trace
+from vtpu.utils.types import annotations
+
+log = logging.getLogger(__name__)
+
+_MON = obs.registry("monitor")
+_DUTY = _MON.gauge(
+    "vtpu_pod_duty_cycle_ratio",
+    "Measured per-pod per-device duty cycle over the last sample window "
+    "(Δbusy_ns / Δwall; 1.0 = the device ran this pod's work the whole "
+    "window)",
+)
+_HBM_PEAK = _MON.gauge(
+    "vtpu_pod_hbm_high_watermark_bytes",
+    "Per-pod per-device HBM high-watermark (ratchets on allocation, "
+    "summed across the pod's processes)",
+)
+_HEADROOM = _MON.gauge(
+    "vtpu_pod_quota_headroom_ratio",
+    "Unused fraction of the pod's core quota ((quota - duty) / quota; "
+    "negative = overrun, e.g. priority suspend lifted the throttle)",
+)
+_LAUNCHES = _MON.counter(
+    "vtpu_pod_kernel_launches_total",
+    "Kernel/execute launches per pod per device (diffed from the region's "
+    "monotonic counter)",
+)
+_SAMPLES = _MON.counter(
+    "vtpu_util_samples_total",
+    "Utilization sampler passes completed",
+)
+_WRITEBACK = _MON.counter(
+    "vtpu_util_writeback_total",
+    "Node-utilization annotation write-back attempts by result "
+    "(written / skipped_interval / skipped_delta / error)",
+)
+
+# env knobs (docs/config.md — monitor envs)
+DEFAULT_INTERVAL_S = 5.0
+DEFAULT_SERIES_CAP = 720          # 1 h of history at the 5 s default
+DEFAULT_WRITEBACK_MIN_INTERVAL_S = 30.0
+DEFAULT_WRITEBACK_MIN_DELTA = 0.05
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class UtilizationSampler:
+    """Continuous duty-cycle profiler over a PathMonitor's regions."""
+
+    def __init__(
+        self,
+        pathmon: PathMonitor,
+        interval_s: Optional[float] = None,
+        series_cap: Optional[int] = None,
+        pods_fn=None,
+        clock=time.monotonic,
+        wallclock=time.time,
+        writeback_client=None,
+        node_name: str = "",
+        writeback_min_interval_s: Optional[float] = None,
+        writeback_min_delta: Optional[float] = None,
+    ) -> None:
+        self.pathmon = pathmon
+        self.interval_s = (
+            interval_s
+            if interval_s is not None
+            else _env_float("VTPU_UTIL_SAMPLE_INTERVAL", DEFAULT_INTERVAL_S)
+        )
+        cap = (
+            series_cap
+            if series_cap is not None
+            else int(_env_float("VTPU_UTIL_SERIES_CAP", DEFAULT_SERIES_CAP))
+        )
+        self.series_cap = max(1, cap)
+        self._pods_fn = pods_fn
+        self._clock = clock
+        self._wallclock = wallclock
+        # node write-back (gating state lives here, not in the loop, so
+        # tests can drive writeback_once directly)
+        self.writeback_client = writeback_client
+        self.node_name = node_name or os.environ.get("NODE_NAME", "")
+        self.writeback_min_interval_s = (
+            writeback_min_interval_s
+            if writeback_min_interval_s is not None
+            else _env_float(
+                "VTPU_UTIL_WRITEBACK_MIN_INTERVAL_S",
+                DEFAULT_WRITEBACK_MIN_INTERVAL_S,
+            )
+        )
+        self.writeback_min_delta = (
+            writeback_min_delta
+            if writeback_min_delta is not None
+            else _env_float(
+                "VTPU_UTIL_WRITEBACK_MIN_DELTA", DEFAULT_WRITEBACK_MIN_DELTA
+            )
+        )
+        self._lock = threading.Lock()
+        # (ctr dirname, dev index) → (mono_t, busy_ns, launches)
+        self._prev: Dict[Tuple[str, int], Tuple[float, int, int]] = {}
+        # ctr dirname → dev index → ring of sample points
+        self._series: Dict[str, Dict[int, Deque[dict]]] = {}
+        # ctr dirname → (pod_uid, podname, podns, [uuids])
+        self._meta: Dict[str, Tuple[str, str, str, List[str]]] = {}
+        self._node_summary: Dict[str, dict] = {}  # uuid → {"duty", "hbm_peak"}
+        self._last_writeback_t: Optional[float] = None
+        self._last_writeback_duty: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- sampling ------------------------------------------------------
+    def sample_once(self, scan: bool = True) -> Dict[str, dict]:
+        """One sampler pass.  Returns the fresh per-device node summary
+        (uuid → duty/hbm_peak) for callers that chain a write-back."""
+        now = self._clock()
+        wall = self._wallclock()
+        entries = self.pathmon.scan() if scan else self.pathmon.entries
+        pods = {}
+        if self._pods_fn is not None:
+            try:
+                pods = self._pods_fn() or {}
+            except Exception:  # noqa: BLE001 — sampling works without pods
+                log.debug("pods_fn failed; sampling without pod names",
+                          exc_info=True)
+        live: set = set()
+        node_duty: Dict[str, float] = {}
+        node_peak: Dict[str, int] = {}
+        with self._lock:
+            for name, entry in sorted(entries.items()):
+                region = entry.region
+                if region is None:
+                    continue
+                try:
+                    uuids = region.device_uuids()
+                    cores = region.core_limits()
+                    usage = region.usage()
+                except (OSError, ValueError):
+                    continue  # region vanished mid-pass
+                pod = pods.get(entry.pod_uid, {})
+                podname = pod.get("metadata", {}).get("name", "")
+                podns = pod.get("metadata", {}).get("namespace", "")
+                prev_meta = self._meta.get(name)
+                if not pod and prev_meta is not None:
+                    # sticky labels: a transient pods_fn failure (or the
+                    # pod vanishing inside the GC grace) must not flip
+                    # podname→"" and strand the old-label gauge series
+                    podname, podns = prev_meta[1], prev_meta[2]
+                elif prev_meta is not None and (
+                    (prev_meta[1], prev_meta[2]) != (podname, podns)
+                ):
+                    # labels really changed: drop the old series so they
+                    # do not export their last value forever
+                    for old_uuid in prev_meta[3]:
+                        old = {
+                            "ctr": name, "podname": prev_meta[1],
+                            "podnamespace": prev_meta[2],
+                            "deviceuuid": old_uuid,
+                        }
+                        _DUTY.remove(**old)
+                        _HBM_PEAK.remove(**old)
+                        _HEADROOM.remove(**old)
+                self._meta[name] = (entry.pod_uid, podname, podns, uuids)
+                for i, u in enumerate(usage):
+                    if i >= len(uuids):
+                        break
+                    key = (name, i)
+                    live.add(key)
+                    prev = self._prev.get(key)
+                    self._prev[key] = (now, u["busy_ns"], u["launches"])
+                    uuid = uuids[i]
+                    node_peak[uuid] = node_peak.get(uuid, 0) + u["hbm_peak"]
+                    if prev is None:
+                        continue
+                    dt = now - prev[0]
+                    dbusy = u["busy_ns"] - prev[1]
+                    dlaunch = u["launches"] - prev[2]
+                    if dt <= 0 or dbusy < 0 or dlaunch < 0:
+                        # counter went backwards: tenant restarted between
+                        # samples — re-baseline instead of reporting noise
+                        continue
+                    duty = dbusy / 1e9 / dt
+                    core = cores[i] if i < len(cores) else 0
+                    quota = core / 100.0 if 0 < core < 100 else 1.0
+                    headroom = (quota - duty) / quota
+                    labels = {
+                        "ctr": name, "podname": podname,
+                        "podnamespace": podns, "deviceuuid": uuid,
+                    }
+                    _DUTY.set(duty, **labels)
+                    _HBM_PEAK.set(u["hbm_peak"], **labels)
+                    _HEADROOM.set(headroom, **labels)
+                    if dlaunch:
+                        _LAUNCHES.inc(dlaunch, **labels)
+                    ring = self._series.setdefault(name, {}).setdefault(
+                        i, collections.deque(maxlen=self.series_cap)
+                    )
+                    ring.append({
+                        "t": wall,
+                        "duty": duty,
+                        "headroom": headroom,
+                        "hbm_peak": u["hbm_peak"],
+                        "launches": dlaunch,
+                        "busy_ns": u["busy_ns"],
+                    })
+                    node_duty[uuid] = node_duty.get(uuid, 0.0) + duty
+            self._prune_locked(live)
+            self._node_summary = {
+                uuid: {
+                    "duty": round(node_duty.get(uuid, 0.0), 4),
+                    "hbm_peak": node_peak.get(uuid, 0),
+                }
+                for uuid in set(node_duty) | set(node_peak)
+            }
+            summary = dict(self._node_summary)
+        _SAMPLES.inc()
+        return summary
+
+    def _prune_locked(self, live: set) -> None:
+        """Forget state (and exported gauge series) for vanished
+        containers — a dead pod must not export its last duty forever."""
+        for key in [k for k in self._prev if k not in live]:
+            name, i = key
+            self._prev.pop(key, None)
+            devs = self._series.get(name)
+            if devs is not None:
+                devs.pop(i, None)
+                if not devs:
+                    self._series.pop(name, None)
+            meta = self._meta.get(name)
+            if meta is not None and i < len(meta[3]):
+                labels = {
+                    "ctr": name, "podname": meta[1],
+                    "podnamespace": meta[2], "deviceuuid": meta[3][i],
+                }
+                _DUTY.remove(**labels)
+                _HBM_PEAK.remove(**labels)
+                _HEADROOM.remove(**labels)
+            if not any(k[0] == name for k in self._prev):
+                self._meta.pop(name, None)
+
+    # -- query surface (GET /utilization) ------------------------------
+    def series(
+        self, pod: Optional[str] = None, window_s: Optional[float] = None
+    ) -> dict:
+        """Time-series view: ``pod`` matches the pod UID or the container
+        dirname; ``window_s`` keeps only points newer than now-window."""
+        cutoff = (
+            self._wallclock() - window_s if window_s and window_s > 0 else None
+        )
+        out: Dict[str, dict] = {}
+        with self._lock:
+            for name, devs in self._series.items():
+                meta = self._meta.get(name, ("", "", "", []))
+                pod_uid = meta[0] or name.rsplit("_", 1)[0]
+                if pod and pod not in (pod_uid, name):
+                    continue
+                uuids = meta[3]
+                per_dev = {}
+                for i, ring in sorted(devs.items()):
+                    points = [
+                        p for p in ring
+                        if cutoff is None or p["t"] >= cutoff
+                    ]
+                    if points:
+                        uuid = uuids[i] if i < len(uuids) else str(i)
+                        per_dev[uuid] = points
+                if per_dev:
+                    out[name] = {
+                        "pod_uid": pod_uid,
+                        "podname": meta[1],
+                        "podnamespace": meta[2],
+                        "devices": per_dev,
+                    }
+        return {"containers": out, "count": len(out)}
+
+    def utilization_body(self, params: dict) -> bytes:
+        """JSON body for GET /utilization?pod=&window= (window seconds)."""
+        try:
+            window = float(params["window"]) if params.get("window") else None
+        except ValueError:
+            window = None
+        return json.dumps(
+            self.series(pod=params.get("pod") or None, window_s=window),
+            default=str,
+        ).encode()
+
+    # -- Chrome trace merge (/trace.json) ------------------------------
+    def chrome_events(self) -> List[dict]:
+        """Counter events (ph="C") so duty cycle renders as a per-device
+        track beside the span feed in chrome://tracing / Perfetto."""
+        events: List[dict] = []
+        with self._lock:
+            for name, devs in self._series.items():
+                meta = self._meta.get(name, ("", "", "", []))
+                uuids = meta[3]
+                for i, ring in sorted(devs.items()):
+                    uuid = uuids[i] if i < len(uuids) else str(i)
+                    track = f"duty {name}/{uuid}"
+                    for p in ring:
+                        events.append({
+                            "name": track,
+                            "ph": "C",
+                            "ts": round(p["t"] * 1e6, 3),
+                            "pid": os.getpid(),
+                            "cat": "vtpu",
+                            "args": {"duty": round(p["duty"], 4)},
+                        })
+        return events
+
+    def merged_chrome(self) -> str:
+        """trace.export_chrome() with this sampler's counter events
+        appended — the /trace.json the monitor serves."""
+        doc = json.loads(trace.export_chrome())
+        doc["traceEvents"].extend(self.chrome_events())
+        return json.dumps(doc, default=str)
+
+    # -- node write-back ------------------------------------------------
+    def writeback_once(self, summary: Optional[Dict[str, dict]] = None) -> str:
+        """Patch the ``vtpu.io/node-utilization`` annotation, gated on a
+        minimum interval AND a minimum per-device duty delta (both also
+        bypassed when the device set changes).  Returns the outcome
+        ("written" / "skipped_interval" / "skipped_delta" / "error" /
+        "disabled") — also counted on vtpu_util_writeback_total."""
+        if self.writeback_client is None or not self.node_name:
+            return "disabled"
+        if summary is None:
+            with self._lock:
+                summary = dict(self._node_summary)
+        now = self._clock()
+        duties = {u: d["duty"] for u, d in summary.items()}
+        if self._last_writeback_t is not None:
+            if now - self._last_writeback_t < self.writeback_min_interval_s:
+                _WRITEBACK.inc(result="skipped_interval")
+                return "skipped_interval"
+            if set(duties) == set(self._last_writeback_duty):
+                delta = max(
+                    (abs(duties[u] - self._last_writeback_duty[u])
+                     for u in duties),
+                    default=0.0,
+                )
+                if delta < self.writeback_min_delta:
+                    _WRITEBACK.inc(result="skipped_delta")
+                    return "skipped_delta"
+        value = json.dumps(
+            {"v": 1, "ts": int(self._wallclock()), "devices": summary},
+            sort_keys=True,
+        )
+        try:
+            self.writeback_client.patch_node_annotations(
+                self.node_name, {annotations.NODE_UTILIZATION: value}
+            )
+        except Exception:  # noqa: BLE001 — telemetry must not kill the loop
+            log.exception("node-utilization write-back failed")
+            _WRITEBACK.inc(result="error")
+            return "error"
+        self._last_writeback_t = now
+        self._last_writeback_duty = duties
+        _WRITEBACK.inc(result="written")
+        return "written"
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> bool:
+        """Start the sampling loop; a second call while the thread is
+        alive is a no-op (returns False)."""
+        if self._thread is not None and self._thread.is_alive():
+            return False
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    summary = self.sample_once()
+                    self.writeback_once(summary)
+                except Exception:  # noqa: BLE001 — keep sampling
+                    log.exception("utilization sample failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="vtpu-util-sampler", daemon=True
+        )
+        self._thread.start()
+        return True
+
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)

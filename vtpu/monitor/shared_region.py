@@ -14,10 +14,11 @@ import ctypes
 import fcntl
 import mmap
 import os
+import struct
 from typing import Dict, List, Optional
 
 VTPU_REGION_MAGIC = 0x76545055
-VTPU_REGION_VERSION = 3
+VTPU_REGION_VERSION = 4
 MAX_DEVICES = 16
 MAX_PROCS = 64
 UUID_LEN = 64
@@ -30,6 +31,12 @@ class DeviceUsage(ctypes.Structure):
         ("total_bytes", ctypes.c_uint64),
         # host-tier bytes past quota (oversubscribe); not part of total
         ("swap_bytes", ctypes.c_uint64),
+        # utilization profiling (v4): monotonic counters the monitor's
+        # UtilizationSampler diffs into duty-cycle ratios, plus the
+        # HBM high-watermark (ratchets up on add, never down on sub)
+        ("busy_ns", ctypes.c_uint64),
+        ("launches", ctypes.c_uint64),
+        ("hbm_peak_bytes", ctypes.c_uint64),
     ]
 
 
@@ -74,6 +81,25 @@ class SharedRegion(ctypes.Structure):
 REGION_SIZE = ctypes.sizeof(SharedRegion)
 
 
+# -- legacy v3 layout (read path for regions written by pre-v4 shims; a
+# long-running tenant keeps its region across a monitor upgrade, so the
+# monitor must keep reading it — the new counters read as 0 there) -------
+
+class _DeviceUsageV3(ctypes.Structure):
+    _fields_ = DeviceUsage._fields_[:4]
+
+
+class _ProcSlotV3(ctypes.Structure):
+    _fields_ = ProcSlot._fields_[:6] + [("used", _DeviceUsageV3 * MAX_DEVICES)]
+
+
+class _SharedRegionV3(ctypes.Structure):
+    _fields_ = SharedRegion._fields_[:16] + [("procs", _ProcSlotV3 * MAX_PROCS)]
+
+
+REGION_SIZE_V3 = ctypes.sizeof(_SharedRegionV3)
+
+
 class RegionFile:
     """mmap a region file read-write (ref mmapcachefile cudevshr.go:112-127).
     The monitor only mutates utilization_switch / hostpid fields."""
@@ -83,11 +109,22 @@ class RegionFile:
         flags = os.O_RDWR | (os.O_CREAT if create else 0)
         fd = os.open(path, flags, 0o666)
         try:
-            if os.fstat(fd).st_size < REGION_SIZE:
-                if not create:
+            size = os.fstat(fd).st_size
+            # sniff magic+version BEFORE sizing: a v3 region written by a
+            # pre-v4 shim is smaller than the current layout and must be
+            # mapped with the legacy struct, not grown and misread
+            header = os.pread(fd, 8, 0) if size >= 8 else b""
+            magic0, version0 = (
+                struct.unpack("=II", header) if len(header) == 8 else (0, 0)
+            )
+            self._legacy = magic0 == VTPU_REGION_MAGIC and version0 == 3
+            layout = _SharedRegionV3 if self._legacy else SharedRegion
+            region_size = ctypes.sizeof(layout)
+            if size < region_size:
+                if not create or self._legacy:
                     raise ValueError(f"{path}: too small for a vtpu region")
-                os.ftruncate(fd, REGION_SIZE)
-            self._mm = mmap.mmap(fd, REGION_SIZE)
+                os.ftruncate(fd, region_size)
+            self._mm = mmap.mmap(fd, region_size)
         except BaseException:
             os.close(fd)
             raise
@@ -95,7 +132,7 @@ class RegionFile:
         # mirror and the C library (cpp/shared_region.cc) take around every
         # mutation — same file, same lock, released by the kernel on death
         self._fd = fd
-        self.region = SharedRegion.from_buffer(self._mm)
+        self.region = layout.from_buffer(self._mm)
         if create and self.region.magic == 0:
             self.region.magic = VTPU_REGION_MAGIC
             self.region.version = VTPU_REGION_VERSION
@@ -104,9 +141,10 @@ class RegionFile:
         if magic != VTPU_REGION_MAGIC:
             self.close()
             raise ValueError(f"{path}: bad magic {magic:#x}")
-        if version != VTPU_REGION_VERSION:
+        if version != (3 if self._legacy else VTPU_REGION_VERSION):
             self.close()
             raise ValueError(f"{path}: region version {version}")
+        self.version = version
 
     @contextlib.contextmanager
     def _locked(self):
@@ -136,23 +174,34 @@ class RegionFile:
 
     def _usage_nolock(self) -> List[Dict[str, int]]:
         r = self.region
+        legacy = self._legacy
         out = []
         for d in range(r.num_devices):
-            buf = prog = swap = 0
+            buf = prog = swap = busy = launches = peak = 0
             for p in range(MAX_PROCS):
                 if r.procs[p].status == 1:
-                    buf += r.procs[p].used[d].buffer_bytes
-                    prog += r.procs[p].used[d].program_bytes
-                    swap += r.procs[p].used[d].swap_bytes
+                    u = r.procs[p].used[d]
+                    buf += u.buffer_bytes
+                    prog += u.program_bytes
+                    swap += u.swap_bytes
+                    if not legacy:
+                        busy += u.busy_ns
+                        launches += u.launches
+                        # summed per-proc peaks: an upper bound on the
+                        # container's true simultaneous peak (procs may
+                        # peak at different times), monotone like them
+                        peak += u.hbm_peak_bytes
             out.append(
                 {"buffer": buf, "program": prog, "total": buf + prog,
-                 "swap": swap}
+                 "swap": swap, "busy_ns": busy, "launches": launches,
+                 "hbm_peak": peak}
             )
         return out
 
     def live_procs(self) -> List[Dict[str, int]]:
         r = self.region
         out = []
+        legacy = self._legacy
         for p in range(MAX_PROCS):
             slot = r.procs[p]
             if slot.status == 1:
@@ -165,6 +214,12 @@ class RegionFile:
                         "exec_shim_ns": slot.exec_shim_ns,
                         "total_bytes": sum(
                             slot.used[d].total_bytes for d in range(r.num_devices)
+                        ),
+                        "busy_ns": 0 if legacy else sum(
+                            slot.used[d].busy_ns for d in range(r.num_devices)
+                        ),
+                        "launches": 0 if legacy else sum(
+                            slot.used[d].launches for d in range(r.num_devices)
                         ),
                     }
                 )
@@ -190,6 +245,23 @@ class RegionFile:
         bare += would lose increments."""
         with self._locked():
             self.region.recent_kernel += n
+
+    def record_launch(self, pid: int, dev: int, busy_ns: int, n: int = 1) -> None:
+        """One dispatch's utilization record under a single flock: the
+        shared ``recent_kernel`` activity counter (what incr_recent_kernel
+        bumps) plus the v4 per-proc per-device monotonic launch count and
+        device-busy estimate the monitor's UtilizationSampler diffs.  On a
+        legacy v3 region only the activity counter moves."""
+        with self._locked():
+            r = self.region
+            r.recent_kernel += n
+            if self._legacy or not (0 <= dev < MAX_DEVICES):
+                return
+            for p in range(MAX_PROCS):
+                if r.procs[p].status == 1 and r.procs[p].pid == pid:
+                    r.procs[p].used[dev].busy_ns += max(0, int(busy_ns))
+                    r.procs[p].used[dev].launches += n
+                    return
 
     def record_exec_result(self, ok: bool) -> None:
         """Execute outcome feed (the XID-analog health stream): a success
@@ -314,6 +386,8 @@ class RegionFile:
                 else:
                     u.buffer_bytes += bytes_
                 u.total_bytes = u.program_bytes + u.buffer_bytes
+                if not self._legacy and u.total_bytes > u.hbm_peak_bytes:
+                    u.hbm_peak_bytes = u.total_bytes  # v4 watermark ratchet
                 return
 
     def sub_usage(self, pid: int, dev: int, bytes_: int, kind: str = "buffer") -> None:

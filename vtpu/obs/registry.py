@@ -145,6 +145,13 @@ class Gauge(_Instrument):
             ent = self._values.get(_label_key(labels))
             return ent[1] if ent else 0
 
+    def remove(self, **labels: str) -> None:
+        """Drop one label set from the exposition — for per-entity gauges
+        (per-pod duty cycle, per-node fragmentation) whose entity is gone;
+        without this a dead pod's last value would be exported forever."""
+        with self._lock:
+            self._values.pop(_label_key(labels), None)
+
     def render(self, lines: List[str]) -> None:
         with self._lock:
             samples = [(dict(lbl), v) for lbl, v in self._values.values()]

@@ -9,6 +9,7 @@ annotations + pod assignment annotations), which is the crash-safety story
 from __future__ import annotations
 
 import datetime
+import json
 import logging
 import threading
 import time
@@ -19,6 +20,7 @@ from vtpu.k8s.objects import get_annotations, pod_uid
 from vtpu.scheduler import nodecheck
 from vtpu.scheduler import score as score_mod
 from vtpu.scheduler.config import SchedulerConfig
+from vtpu.scheduler.decisions import DecisionLog
 from vtpu.scheduler.score import DeviceUsage, NodeUsage
 from vtpu.scheduler.state import NodeManager, PodManager
 from vtpu.scheduler.usage_cache import UsageCache
@@ -95,6 +97,9 @@ class Scheduler:
         self.usage_cache = UsageCache()
         self.nodes.add_listener(self.usage_cache)
         self.pods.add_listener(self.usage_cache)
+        # placement-decision audit log (GET /decisions?pod=): every filter
+        # run's per-node verdicts, bounded by VTPU_DECISION_LOG_CAP
+        self.decisions = DecisionLog()
         self._stop = threading.Event()
         # serialises the select→book critical section: concurrent /filter
         # requests (HA schedulers, parallel binds) must not both see the
@@ -135,6 +140,15 @@ class Scheduler:
         for node in nodes:
             name = node["metadata"]["name"]
             annos = node.get("metadata", {}).get("annotations") or {}
+            # measured utilization write-back (monitor's UtilizationSampler)
+            util = annos.get(annotations.NODE_UTILIZATION)
+            if util:
+                try:
+                    payload = json.loads(util)
+                    if isinstance(payload, dict):
+                        self.usage_cache.note_node_utilization(name, payload)
+                except ValueError:
+                    log.debug("node %s: bad node-utilization annotation", name)
             for handshake_anno, register_anno in KNOWN_DEVICES.items():
                 hs = annos.get(handshake_anno)
                 if hs is None:
@@ -365,7 +379,7 @@ class Scheduler:
             nodes=len(node_names),
         ) as sp:
             with self._filter_lock:
-                res, enc = self._select_and_book(
+                res, enc, verdicts = self._select_and_book(
                     pod, node_names, reqs, pod_annos, node_objs
                 )
             if res.node is not None and enc is not None:
@@ -444,6 +458,22 @@ class Scheduler:
             sp["node"] = res.node
             sp["failed"] = len(res.failed)
             _FILTER_HIST.observe(time.perf_counter() - t_filter, path=path)
+            # audit log: the full per-node verdict set plus the measured-
+            # utilization snapshot that was current at decision time
+            measured = self.usage_cache.measured_utilization()
+            self.decisions.record(
+                pod=pod.get("metadata", {}).get("name", ""),
+                namespace=pod.get("metadata", {}).get("namespace", "default"),
+                pod_uid=uid,
+                path=path,
+                node=res.node,
+                error=res.error,
+                verdicts=verdicts,
+                utilization={
+                    n: measured[n] for n in verdicts if n in measured
+                },
+                elapsed_ms=round((time.perf_counter() - t_filter) * 1e3, 3),
+            )
             return res
 
     def _acquire_patch_lock(self, uid: str):
@@ -464,11 +494,12 @@ class Scheduler:
 
     def _select_and_book(
         self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs=None
-    ) -> Tuple[FilterResult, Optional[str]]:
+    ) -> Tuple[FilterResult, Optional[str], Dict[str, dict]]:
         """Candidate walk over the incremental usage cache + local booking.
         Holds only in-memory locks; returns (result, encoded placement —
-        None unless a booking was made).  Caller patches the assignment
-        annotations outside the filter lock and unbooks on patch failure."""
+        None unless a booking was made, per-node verdicts for the decision
+        audit log).  Caller patches the assignment annotations outside the
+        filter lock and unbooks on patch failure."""
         uid = pod_uid(pod)
         # each node must be evaluated at most once — a duplicate entry
         # would see (and double-count) the first evaluation's bookings
@@ -508,9 +539,13 @@ class Scheduler:
         # best: (score, node, placement-or-(device, mem), generation)
         best: Optional[Tuple[float, str, object, int]] = None
         failed: Dict[str, str] = {}
+        # per-node verdicts for the decision audit log: reject reason or
+        # score breakdown; the chosen node later gets its placement added
+        verdicts: Dict[str, dict] = {}
         for attempt in (0, 1):
             best = None
             failed = {}
+            verdicts = {}
             with cache.locked():
                 # the pod's own node (re-filter after a bind failure) must
                 # not see its previous assignment as occupancy — that one
@@ -521,11 +556,15 @@ class Scheduler:
                         reason = check(node_objs.get(name) or poll_objs.get(name))
                         if reason is not None:
                             failed[name] = reason
+                            verdicts[name] = {"fit": False, "reason": reason}
                             continue
                     if single and name != own_node:
                         entry = cache.peek_entry(name)
                         if entry is None:
                             failed[name] = "no vtpu devices registered"
+                            verdicts[name] = {
+                                "fit": False, "reason": failed[name],
+                            }
                             continue
                         nu, gen, base_util = entry
                         m = memo.get(name)  # type: ignore[union-attr]
@@ -543,25 +582,43 @@ class Scheduler:
                             memo[name] = (gen, res)  # type: ignore[index]
                         if res is None:
                             failed[name] = "insufficient vtpu resources"
+                            verdicts[name] = {
+                                "fit": False, "reason": failed[name],
+                            }
                             continue
                         dev_uuid, mem, s = res
                         payload: object = (dev_uuid, mem)
+                        verdicts[name] = {
+                            "fit": True, "score": round(s, 6),
+                            "device": dev_uuid, "mem": mem,
+                        }
                     else:
                         nu, gen = cache.clone_node(name, exclude_uid=uid)
                         if nu is None:
                             failed[name] = "no vtpu devices registered"
+                            verdicts[name] = {
+                                "fit": False, "reason": failed[name],
+                            }
                             continue
                         payload = score_mod.fit_pod(
                             nu, reqs, pod_annos, policy, ici_policy
                         )
                         if payload is None:
                             failed[name] = "insufficient vtpu resources"
+                            verdicts[name] = {
+                                "fit": False, "reason": failed[name],
+                            }
                             continue
                         s = score_mod.score_node(nu, policy)
+                        verdicts[name] = {"fit": True, "score": round(s, 6)}
                     if best is None or s > best[0]:
                         best = (s, name, payload, gen)
             if best is None:
-                return FilterResult(None, failed, "no node fits vtpu request"), None
+                return (
+                    FilterResult(None, failed, "no node fits vtpu request"),
+                    None,
+                    verdicts,
+                )
             # generation check: a background registry/pod event may have
             # changed the chosen node between evaluation and now (the
             # cache lock is released before booking to keep lock order
@@ -601,10 +658,25 @@ class Scheduler:
         fresh_annos[annotations.ASSIGNED_NODE] = chosen
         fresh["metadata"] = dict(pod["metadata"], annotations=fresh_annos)
         self.pods.add_pod(fresh, chosen, placement, pending=True)  # type: ignore[arg-type]
+        # the winner's verdict carries the concrete placement — for gangs
+        # this is the chosen topology rectangle (the device-uuid set)
+        verdicts.setdefault(chosen, {"fit": True, "score": round(s, 6)})
+        verdicts[chosen] = dict(
+            verdicts[chosen],
+            chosen=True,
+            placement=[
+                [
+                    {"uuid": cd.uuid, "mem": cd.usedmem,
+                     "cores": cd.usedcores}
+                    for cd in ctr
+                ]
+                for ctr in placement
+            ],
+        )
         log.info(
             "filter: pod %s → node %s (score %.3f)", pod["metadata"]["name"], chosen, s
         )
-        return FilterResult(node=chosen, failed=failed, error=""), enc
+        return FilterResult(node=chosen, failed=failed, error=""), enc, verdicts
 
     # ------------------------------------------------------------------
     # Bind (ref Bind scheduler.go:402-442)

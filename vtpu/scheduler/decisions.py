@@ -1,0 +1,81 @@
+"""Bounded in-memory placement-decision audit log.
+
+The reference logs a single line per Filter and keeps nothing — "why did
+the scheduler pick node N for pod X?" (or "why was every node rejected?")
+is unanswerable five minutes later.  This log records every filter run's
+full verdict set — per-node reject reason or score breakdown, the chosen
+node and its placement (device uuids = the topology rectangle for gangs),
+and the measured-utilization snapshot the write-back annotation provided
+at decision time — in a capped ring (``VTPU_DECISION_LOG_CAP``, default
+512), served at ``GET /decisions?pod=<uid>`` on the extender's debug
+listener and cross-linked from ``/timeline``.
+
+Deliberately in-memory and bounded: this is a flight recorder, not an
+event store — a 10k-decision soak holds exactly ``cap`` records.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Deque, List, Optional
+
+from vtpu import obs
+
+_REG = obs.registry("scheduler")
+_RECORDED = _REG.counter(
+    "vtpu_decisions_recorded_total",
+    "Placement decisions recorded in the audit log (the log itself is a "
+    "capped ring; this counts every decision ever taken)",
+)
+
+DEFAULT_CAP = 512
+
+
+class DecisionLog:
+    """Capped ring of placement-decision records, newest last."""
+
+    def __init__(
+        self, cap: Optional[int] = None, wallclock=time.time
+    ) -> None:
+        if cap is None:
+            try:
+                cap = int(os.environ.get("VTPU_DECISION_LOG_CAP", "")
+                          or DEFAULT_CAP)
+            except ValueError:
+                cap = DEFAULT_CAP
+        self.cap = max(1, cap)
+        self._dq: Deque[dict] = collections.deque(maxlen=self.cap)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._wallclock = wallclock
+
+    def record(self, **fields) -> dict:
+        """Append one decision; assigns a monotonic ``seq`` and ``ts``."""
+        with self._lock:
+            self._seq += 1
+            rec = {"seq": self._seq, "ts": self._wallclock(), **fields}
+            self._dq.append(rec)
+        _RECORDED.inc()
+        return rec
+
+    def query(
+        self, pod: Optional[str] = None, n: int = 50
+    ) -> List[dict]:
+        """Newest-last records; ``pod`` matches pod UID or pod name,
+        filtered before the count cut (like /spans?name=)."""
+        with self._lock:
+            recs = list(self._dq)
+        if pod:
+            recs = [
+                r for r in recs
+                if pod in (r.get("pod_uid"), r.get("pod"))
+            ]
+        n = max(0, n)
+        return recs[-n:] if n else []
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._dq)

@@ -9,13 +9,106 @@ registry's hot-path latency histograms are appended after them.
 
 from __future__ import annotations
 
-from typing import List
+import threading
+from typing import Dict, List, Set, Tuple
 
 from vtpu import obs
 from vtpu.obs import render_family
+from vtpu.device.topology import Topology, enumerate_rectangles
 from vtpu.scheduler.core import Scheduler
+from vtpu.scheduler.score import NodeUsage
 
 _MB = 1024 * 1024
+
+# fragmentation + measured-utilization gauges (obs registry, appended
+# after the golden-guarded legacy families).  Updated at render time from
+# the usage-cache view; vanished label sets are pruned so an expelled
+# node does not export stale values forever.
+_REG = obs.registry("scheduler")
+_FRAG_RECT = _REG.gauge(
+    "vtpu_node_largest_free_rectangle_ratio",
+    "Largest ICI-contiguous fully-free rectangle as a fraction of the "
+    "node's chips (low ratio with free chips left = fragmented node)",
+)
+_FREE_RATIO = _REG.gauge(
+    "vtpu_node_free_chips_ratio",
+    "Fully-free chips (no share, no memory, no cores booked) as a "
+    "fraction of the node's chips",
+)
+_FREE_HIST = _REG.gauge(
+    "vtpu_nodes_by_free_chips_total",
+    "Free-chip histogram: number of nodes having exactly this many "
+    "fully-free chips",
+)
+_MEASURED_DUTY = _REG.gauge(
+    "vtpu_node_measured_duty_cycle_ratio",
+    "Per-device duty cycle reported by the node monitor's "
+    "vtpu.io/node-utilization write-back annotation",
+)
+_gauge_lock = threading.Lock()
+_prev_frag: Set[Tuple[str, ...]] = set()
+_prev_hist: Set[str] = set()
+_prev_duty: Set[Tuple[str, str]] = set()
+
+
+def _largest_free_rectangle(nu: NodeUsage) -> int:
+    """Chip count of the biggest axis-aligned all-free rectangle; without
+    coords/topology the free chips count as one contiguous block."""
+    free = [
+        d for d in nu.devices
+        if d.used == 0 and d.usedmem == 0 and d.usedcores == 0
+    ]
+    if not free:
+        return 0
+    if nu.topology and all(d.coords is not None for d in free):
+        topo = Topology.from_spec(nu.topology)
+        avail = frozenset(tuple(d.coords) for d in free)  # type: ignore[arg-type]
+        for size in range(len(free), 0, -1):
+            if next(enumerate_rectangles(topo, size, avail), None) is not None:
+                return size
+        return 0
+    return len(free)
+
+
+def _update_capacity_gauges(sched: Scheduler, usage: Dict[str, NodeUsage]) -> None:
+    """Refresh fragmentation + measured-duty gauges from the cache view."""
+    frag_now: Set[Tuple[str, ...]] = set()
+    hist: Dict[str, int] = {}
+    for name, nu in usage.items():
+        total = len(nu.devices)
+        free = sum(
+            1 for d in nu.devices
+            if d.used == 0 and d.usedmem == 0 and d.usedcores == 0
+        )
+        rect = _largest_free_rectangle(nu)
+        _FRAG_RECT.set(rect / total if total else 0.0, node=name)
+        _FREE_RATIO.set(free / total if total else 0.0, node=name)
+        frag_now.add((name,))
+        hist[str(free)] = hist.get(str(free), 0) + 1
+    duty_now: Set[Tuple[str, str]] = set()
+    for name, payload in sched.usage_cache.measured_utilization().items():
+        devices = payload.get("devices") if isinstance(payload, dict) else None
+        if not isinstance(devices, dict):
+            continue
+        for uuid, rec in devices.items():
+            try:
+                duty = float(rec.get("duty", 0.0))
+            except (AttributeError, TypeError, ValueError):
+                continue
+            _MEASURED_DUTY.set(duty, node=name, deviceuuid=uuid)
+            duty_now.add((name, uuid))
+    with _gauge_lock:
+        global _prev_frag, _prev_hist, _prev_duty
+        for (name,) in _prev_frag - frag_now:
+            _FRAG_RECT.remove(node=name)
+            _FREE_RATIO.remove(node=name)
+        for bucket in _prev_hist - set(hist):
+            _FREE_HIST.remove(free_chips=bucket)
+        for bucket, count in hist.items():
+            _FREE_HIST.set(count, free_chips=bucket)
+        for name, uuid in _prev_duty - duty_now:
+            _MEASURED_DUTY.remove(node=name, deviceuuid=uuid)
+        _prev_frag, _prev_hist, _prev_duty = frag_now, set(hist), duty_now
 
 
 def render_metrics(sched: Scheduler, include_obs: bool = True) -> str:
@@ -172,9 +265,11 @@ def render_metrics(sched: Scheduler, include_obs: bool = True) -> str:
         sched.filter_gen_retries,
     )
     # hot-path latency histograms (vtpu_filter_seconds & friends,
-    # vtpu/scheduler/core.py) — appended AFTER the legacy families so the
-    # pre-obs exposition stays a byte-exact prefix for dashboards
+    # vtpu/scheduler/core.py) plus the fragmentation/measured-duty gauges
+    # — appended AFTER the legacy families so the pre-obs exposition
+    # stays a byte-exact prefix for dashboards
     legacy = "\n".join(lines) + "\n"
     if not include_obs:
         return legacy
+    _update_capacity_gauges(sched, usage)
     return legacy + obs.registry("scheduler").render()

@@ -95,14 +95,43 @@ class _Handler(BaseHTTPRequestHandler):
             return None
 
     def do_GET(self) -> None:  # noqa: N802 — http.server API
+        route = self.path.split("?", 1)[0]
         if self.path == "/healthz":
             self._send(200, b"ok", "text/plain")
-        elif self.allow_debug and self.path.split("?", 1)[0] in (
-            "/spans", "/timeline", "/trace.json"
-        ):
-            # shared debug surface (vtpu/obs/http.py): /spans?n=&name=,
-            # /timeline?pod=<uid> (the merged pod-lifecycle view), and
-            # the Chrome trace-event export
+        elif self.allow_debug and route == "/decisions":
+            # placement-decision audit log: per-node verdicts (reject
+            # reason or score breakdown + chosen placement) for every
+            # filter run, newest last (vtpu/scheduler/decisions.py)
+            from vtpu.obs.http import split_query
+
+            _, params = split_query(self.path)
+            try:
+                n = int(params.get("n", 50))
+            except ValueError:
+                n = 50
+            recs = self.scheduler.decisions.query(
+                pod=params.get("pod") or None, n=n
+            )
+            self._send(200, json.dumps(
+                {"decisions": recs, "count": len(recs)}, default=str
+            ).encode())
+        elif self.allow_debug and route == "/timeline":
+            # the shared timeline view, cross-linked to this pod's audit
+            # trail so span feed and placement verdicts are one click apart
+            from vtpu.obs.http import split_query, timeline_body
+
+            _, params = split_query(self.path)
+            body = timeline_body(params)
+            if body is None:
+                self._send(400, b'{"error": "missing ?pod=<uid>"}')
+                return
+            doc = json.loads(body)
+            pod = params.get("pod") or params.get("trace")
+            doc["decisions"] = f"/decisions?pod={pod}"
+            self._send(200, json.dumps(doc, default=str).encode())
+        elif self.allow_debug and route in ("/spans", "/trace.json"):
+            # shared debug surface (vtpu/obs/http.py): /spans?n=&name=
+            # and the Chrome trace-event export
             from vtpu.obs.http import handle_debug_get
 
             if not handle_debug_get(self, self._send):

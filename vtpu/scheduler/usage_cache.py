@@ -80,6 +80,12 @@ class UsageCache:
         # and re-added can never alias a stale (node, gen)-keyed memo
         # entry held by a consumer (core._single_eval_memo)
         self._gen = 0
+        # measured utilization from the monitor's node write-back
+        # annotation (vtpu.io/node-utilization): node → decoded payload
+        # {"ts": ..., "devices": {uuid: {"duty": ..., "hbm_peak": ...}}}.
+        # Observability-side state: never part of the booking aggregates,
+        # so it cannot perturb oracle equivalence with nodes_usage().
+        self._measured: Dict[str, dict] = {}
         # perf counters (read via stats(); exported on /metrics)
         self.hits = 0            # nodes served from a clean aggregate
         self.dirty_rebuilds = 0  # lazy full rebuilds of one node
@@ -113,6 +119,22 @@ class UsageCache:
     def on_node_removed(self, name: str) -> None:
         with self._lock:
             self._entries.pop(name, None)
+            self._measured.pop(name, None)
+
+    # -- measured utilization (monitor write-back ingest) --------------
+    def note_node_utilization(self, name: str, payload: dict) -> None:
+        """Ingest one node's decoded ``vtpu.io/node-utilization``
+        annotation (the registry poll calls this on every pass)."""
+        with self._lock:
+            self._measured[name] = payload
+
+    def measured_utilization(self, name: Optional[str] = None):
+        """One node's measured-utilization payload (None when the monitor
+        has not written back), or a {node: payload} snapshot of all."""
+        with self._lock:
+            if name is not None:
+                return self._measured.get(name)
+            return dict(self._measured)
 
     def on_pod_changed(self, uid: str, node: str, devices: PodDevices) -> None:
         with self._lock:

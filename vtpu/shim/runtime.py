@@ -112,7 +112,13 @@ class ShimRuntime:
         pid: Optional[int] = None,
         priority: Optional[int] = None,
         oversubscribe: Optional[bool] = None,
+        clock=None,
     ) -> None:
+        # injectable time source for pacing + duty accounting: anything
+        # with .monotonic() and .sleep() (default: the time module).  The
+        # duty-cycle oracle test drives dispatch() through a fake clock,
+        # so pacing semantics are testable without real sleeps.
+        self._clock = clock if clock is not None else time
         self.limits = limits_bytes if limits_bytes is not None else _env_limits()
         self.core_limit = (
             core_limit
@@ -384,9 +390,14 @@ class ShimRuntime:
         stops paying the drain, while any shift in the measured step
         time resets the cadence to base.  ``observe_step`` remains as an
         explicit override for callers that measure retirement
-        themselves."""
+        themselves.
+
+        Every dispatch also publishes a utilization record into the
+        shared region (region v4): the launch count plus a device-busy
+        estimate — the calibrated measurement on calibrate steps, the
+        current step-time estimate otherwise — which the monitor's
+        UtilizationSampler diffs into the per-pod duty-cycle ratio."""
         if self.region is not None:
-            self.region.incr_recent_kernel()
             suspended = (
                 self.region.region.utilization_switch == 1
                 and self.core_policy != "force"
@@ -395,10 +406,21 @@ class ShimRuntime:
             suspended = False
         q = self.core_limit
         if not (0 < q < 100) or suspended:
-            return self._run_fn(fn, args, kwargs)
+            if self._last_step_s > 0:
+                self._note_launch(self._last_step_s)
+                return self._run_fn(fn, args, kwargs)
+            # no calibrated estimate (pacing never active): fall back to
+            # the host-side call duration — the open-loop floor the native
+            # shim uses too — so an unthrottled tenant never reads duty 0
+            t0 = self._clock.monotonic()
+            out = self._run_fn(fn, args, kwargs)
+            self._note_launch(self._clock.monotonic() - t0)
+            return out
         if self._pace_state == "warmup":
             # first paced step: retire it but DISCARD the timing — it
-            # includes jit compilation — then calibrate on the next step
+            # includes jit compilation — then calibrate on the next step.
+            # Busy attribution is likewise skipped (compile ≠ duty).
+            self._note_launch(0.0)
             out = self._run_fn(fn, args, kwargs)
             self._retire(out)
             self._pace_state = "calibrate"
@@ -406,10 +428,11 @@ class ShimRuntime:
         if self._pace_state == "calibrate":
             # queue is empty (previous step was retired synchronously):
             # one synchronous step = enqueue + device + sync, the real T
-            t0 = time.monotonic()
+            t0 = self._clock.monotonic()
             out = self._run_fn(fn, args, kwargs)
             self._retire(out)
-            measured = time.monotonic() - t0
+            measured = self._clock.monotonic() - t0
+            self._note_launch(measured)
             prev = self._last_step_s
             self._last_step_s = measured
             # stable estimate → back off the drain cadence; a shifted
@@ -422,9 +445,10 @@ class ShimRuntime:
             self._pace_state = "run"
             self._since_sync = 0
             return out
+        self._note_launch(self._last_step_s)
         if self._last_step_s > 0:
             pause = self._last_step_s * (100 - q) / q
-            time.sleep(pause)
+            self._clock.sleep(pause)
             _PACE_HIST.observe(pause)
         out = self._run_fn(fn, args, kwargs)
         self._since_sync += 1
@@ -433,6 +457,12 @@ class ShimRuntime:
             self._retire(out)
             self._pace_state = "calibrate"
         return out
+
+    def _note_launch(self, busy_s: float, dev: int = 0) -> None:
+        """Publish one launch + busy-ns estimate to the region (single
+        flock, shared with the recent_kernel activity bump)."""
+        if self.region is not None:
+            self.region.record_launch(self.pid, dev, int(busy_s * 1e9))
 
     @staticmethod
     def _is_device_error(e: BaseException) -> bool:
@@ -502,7 +532,7 @@ class ShimRuntime:
 
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
-            t0 = time.monotonic()
+            t0 = self._clock.monotonic()
             out = fn(*args, **kwargs)
             # block_until_ready so the measured time covers device work
             try:
@@ -511,9 +541,10 @@ class ShimRuntime:
                 out = jax.block_until_ready(out)
             except Exception:  # noqa: BLE001 — non-jax return values
                 pass
-            dt = time.monotonic() - t0
+            dt = self._clock.monotonic() - t0
             if self.region is not None:
-                self.region.incr_recent_kernel()
+                # synchronous path: the blocked call time IS the busy time
+                self._note_launch(dt)
                 suspended = (
                     self.region.region.utilization_switch == 1
                     and self.core_policy != "force"
@@ -523,7 +554,7 @@ class ShimRuntime:
             q = self.core_limit
             if 0 < q < 100 and not suspended:
                 pause = dt * (100 - q) / q
-                time.sleep(pause)
+                self._clock.sleep(pause)
                 _PACE_HIST.observe(pause)
             return out
 

@@ -46,6 +46,12 @@ class annotations:
     # 4pd.io/node-handshake-mlu + node-mlu-register, types.go:79-83)
     NODE_HANDSHAKE_PJRT = "vtpu.io/node-handshake-pjrt"
     NODE_REGISTER_PJRT = "vtpu.io/node-pjrt-register"
+    # -- node: measured utilization write-back (rebuild addition — the
+    # monitor→scheduler feedback loop the reference sketched but shipped
+    # disabled): JSON {"v":1,"ts":...,"devices":{uuid:{"duty":...,
+    # "hbm_peak":...}}}, patched rate-limited + delta-gated by the
+    # monitor's UtilizationSampler, ingested by the scheduler's UsageCache
+    NODE_UTILIZATION = "vtpu.io/node-utilization"
     # -- node: distributed mutex (ref 4pd.io/mutex.lock, pkg/util/nodelock.go)
     NODE_LOCK = "vtpu.io/mutex.lock"
     # -- webhook escape hatch (ref charts/.../webhook.yaml:16-29 label)
