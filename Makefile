@@ -7,7 +7,7 @@ IMG ?= vtpu/vtpu
 PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
-	bench-sched bench-serve obs-lint image chart clean tidy
+	bench-sched bench-serve obs-lint audit-check image chart clean tidy
 
 all: build
 
@@ -123,6 +123,12 @@ test-native-tsan:
 obs-lint:
 	JAX_PLATFORMS=cpu $(PY) hack/obs_lint.py
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py -q -k "conformance or golden"
+
+# reconciliation golden: one auditor pass over the seeded fake cluster
+# (all four drift classes), fetched through GET /audit and diffed against
+# tests/golden/audit_report.json (regen: hack/audit_check.py --regen)
+audit-check:
+	JAX_PLATFORMS=cpu $(PY) hack/audit_check.py
 
 bench:
 	$(PY) bench.py

@@ -86,13 +86,22 @@ def main(argv=None) -> int:
 
     class Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802
-            if self.path != "/metrics":
+            if self.path.split("?", 1)[0] == "/readyz":
+                # same probe shape as the real daemons (vtpu/obs/ready);
+                # the sandbox registers no checks, so it is always ready
+                from vtpu.obs.ready import readyz_body
+
+                code, body = readyz_body(("testcollector",))
+                ctype = "application/json"
+            elif self.path == "/metrics":
+                body = render_fake_metrics().encode()
+                code, ctype = 200, "text/plain; version=0.0.4"
+            else:
                 self.send_response(404)
                 self.end_headers()
                 return
-            body = render_fake_metrics().encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

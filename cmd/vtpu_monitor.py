@@ -93,6 +93,18 @@ def main(argv=None) -> int:
     if not args.disable_feedback:
         fb = FeedbackLoop(pm, args.feedback_interval)
         fb.start()
+
+        from vtpu.obs.ready import readiness
+
+        def feedback_alive(fb=fb):
+            t = fb._thread
+            return (
+                t is not None and t.is_alive(),
+                "arbiter loop running" if t is not None and t.is_alive()
+                else "arbiter thread dead",
+            )
+
+        readiness("monitor").register("feedback", feedback_alive)
     logging.info(
         "vtpu-monitor: metrics %s, noderpc %s", args.metrics_bind, args.noderpc_bind
     )

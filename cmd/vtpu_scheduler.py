@@ -49,6 +49,16 @@ def main(argv=None) -> int:
                         "when --cert/key are set; the main --http_bind "
                         "listener stays plain HTTP for the kube-scheduler "
                         "extender calls and metrics scrapes")
+    p.add_argument("--audit-interval", type=float, default=None,
+                   help="seconds between cluster-state reconciliation "
+                        "passes (default: env VTPU_AUDIT_INTERVAL_S, else "
+                        "60; <= 0 disables the loop — GET /audit still "
+                        "runs a pass on demand)")
+    p.add_argument("--event-jsonl",
+                   default=os.environ.get("VTPU_EVENT_JSONL", ""),
+                   help="append every journal event as one JSON line to "
+                        "this file (env VTPU_EVENT_JSONL); empty disables "
+                        "the mirror — the in-memory ring always runs")
     p.add_argument("--debug", action="store_true")
     args = p.parse_args(argv)
     if bool(args.cert_file) != bool(args.key_file):
@@ -61,6 +71,10 @@ def main(argv=None) -> int:
     from vtpu.obs.logsetup import setup_logging
 
     setup_logging(debug=args.debug)
+    if args.event_jsonl:
+        from vtpu.obs import events as obs_events
+
+        obs_events.configure(jsonl_path=args.event_jsonl)
     from vtpu.k8s.client import new_client
     from vtpu.scheduler import Scheduler, SchedulerConfig
     from vtpu.scheduler.routes import serve
@@ -79,6 +93,8 @@ def main(argv=None) -> int:
         ici_policy=args.ici_policy,
     )
     sched = Scheduler(client, cfg)
+    if args.audit_interval is not None:
+        sched.auditor.interval_s = args.audit_interval
     sched.run_background_loops()
     # main listener: plain HTTP — the kube-scheduler sidecar's extender
     # config (urlPrefix http://127.0.0.1:<port>) and Prometheus scrape it

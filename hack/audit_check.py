@@ -1,0 +1,85 @@
+#!/usr/bin/env python3
+"""audit-check — reconciliation-auditor golden (make audit-check).
+
+Builds the seeded fake cluster from tests/golden_scenarios.py (one node
+per drift class: leaked booking, orphaned region, overcommit, stale
+heartbeat, all under a pinned wallclock), fetches ``GET /audit`` through
+the real extender listener, and diffs the normalized report against
+``tests/golden/audit_report.json``.
+
+A change to the auditor's verdict shape or drift classification must
+land with a regenerated golden (``--regen``) in the same change —
+exactly the contract the /metrics goldens enforce for exposition.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import argparse
+import difflib
+import json
+import urllib.request
+
+GOLDEN = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "golden", "audit_report.json",
+)
+
+
+def fetch_report() -> str:
+    """The /audit body off a seeded cluster, normalized for diffing."""
+    from tests.golden_scenarios import build_audit_cluster
+    from vtpu.scheduler.routes import serve
+
+    _client, sched = build_audit_cluster()
+    srv, _ = serve(sched)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        body = urllib.request.urlopen(f"{base}/audit", timeout=10).read()
+    finally:
+        srv.shutdown()
+        sched.stop()
+    return json.dumps(json.loads(body), indent=2, sort_keys=True) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--regen", action="store_true",
+                   help="rewrite the golden from the current auditor")
+    args = p.parse_args(argv)
+    got = fetch_report()
+    if args.regen:
+        with open(GOLDEN, "w") as f:
+            f.write(got)
+        print(f"audit-check: regenerated {GOLDEN}")
+        return 0
+    try:
+        with open(GOLDEN) as f:
+            want = f.read()
+    except FileNotFoundError:
+        print(f"audit-check: golden missing; run with --regen first: {GOLDEN}",
+              file=sys.stderr)
+        return 1
+    if got == want:
+        doc = json.loads(got)
+        drifts = sum(len(n["drifts"]) for n in doc["nodes"].values())
+        print(f"audit-check: /audit report matches golden "
+              f"({len(doc['nodes'])} nodes, {drifts} seeded drifts)")
+        return 0
+    sys.stderr.writelines(difflib.unified_diff(
+        want.splitlines(keepends=True), got.splitlines(keepends=True),
+        fromfile="tests/golden/audit_report.json", tofile="GET /audit",
+    ))
+    print("audit-check: /audit report drifted from the golden "
+          "(intended? rerun with --regen)", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

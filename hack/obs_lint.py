@@ -13,6 +13,10 @@ a family you can scrape but cannot look up is drift, and so is a doc
 promising a family no component registers anymore (new names must land
 with their catalog entry in the same change).
 
+The same catalog rule applies to the event journal's vocabulary: every
+type in vtpu.obs.events.EVENT_TYPES must appear in the docs — an event
+you can see on /events but cannot look up is the same drift.
+
 Exit 1 with one line per violation.  The exposition-format conformance
 tests (tests/test_obs.py -k conformance) run from the same make target.
 """
@@ -29,16 +33,29 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 def main() -> int:
     # importing the modules is what populates the registries
+    import vtpu.audit.auditor  # noqa: F401 — reconciliation gauges
     import vtpu.monitor.feedback  # noqa: F401 — arbiter pass instruments
     import vtpu.monitor.pathmonitor  # noqa: F401 — scan/GC counters
     import vtpu.monitor.sampler  # noqa: F401 — duty-cycle families
+    import vtpu.plugin.cache  # noqa: F401 — device-poll failure counter
+    import vtpu.plugin.register  # noqa: F401 — registration counters
     import vtpu.plugin.server  # noqa: F401 — plugin Allocate histogram
     import vtpu.scheduler.core  # noqa: F401 — filter/patch/bind histograms
     import vtpu.scheduler.decisions  # noqa: F401 — audit-log counter
     import vtpu.scheduler.metrics  # noqa: F401 — fragmentation gauges
     import vtpu.serving.batcher  # noqa: F401 — queue-to-first-token
     import vtpu.shim.runtime  # noqa: F401 — pacing/quota histograms
-    from vtpu.obs import all_registries, lint_names
+    from vtpu.obs import all_registries, lint_names, registry
+    from vtpu.obs.events import EVENT_TYPES
+    from vtpu.obs.ready import readiness
+
+    # the cross-component "obs" families (vtpu_events_total,
+    # vtpu_ready_check_ok_ratio) register lazily on first emit/report —
+    # instantiate them so the naming/docs checks cover them too
+    registry("obs").counter(
+        "vtpu_events_total", "Journal events emitted by component and type"
+    )
+    readiness("scheduler")
 
     names = {
         reg.name: reg.names() for reg in all_registries().values()
@@ -57,6 +74,13 @@ def main() -> int:
                 problems.append(
                     f"{reg}: {n}: not documented in docs/observability.md"
                 )
+    # event-vocabulary drift: every registered journal event type must be
+    # in the catalog (docs/observability.md § Event journal & audit)
+    for ev in sorted(EVENT_TYPES):
+        if ev not in doc:
+            problems.append(
+                f"events: {ev}: not documented in docs/observability.md"
+            )
     for p in problems:
         print(f"obs-lint: {p}", file=sys.stderr)
     if problems:
@@ -66,7 +90,10 @@ def main() -> int:
     for reg, metric_names in sorted(names.items()):
         for n in metric_names:
             print(f"ok {reg}: {n}")
-    print(f"obs-lint: {total} registered metric name(s) conform "
+    for ev in sorted(EVENT_TYPES):
+        print(f"ok events: {ev}")
+    print(f"obs-lint: {total} registered metric name(s) and "
+          f"{len(EVENT_TYPES)} event type(s) conform "
           f"(naming + docs catalog)")
     return 0
 

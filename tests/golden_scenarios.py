@@ -47,6 +47,80 @@ def build_scheduler():
     return sched
 
 
+AUDIT_NOW = 1785738400.0  # fixed audit wallclock: 2026-08-03T06:26:40Z
+
+
+def build_audit_cluster():
+    """Seeded fake cluster exhibiting all four drift classes, one per
+    node, under a pinned wallclock — shared by tests/test_audit.py and
+    ``make audit-check`` (hack/audit_check.py vs
+    tests/golden/audit_report.json).
+
+    - n1: a pod filtered on, then deleted behind the scheduler's back
+      (**leaked booking**) + a measured region whose tenant is dead
+      (**orphaned region**);
+    - n2: handshake annotation stuck >1 h in the past
+      (**stale heartbeat**);
+    - n3: a booking replayed from annotations that promises more HBM
+      than the chip has (**overcommit**).
+
+    Returns (client, sched); ``sched.auditor`` is pinned to AUDIT_NOW.
+    """
+    from vtpu.scheduler import Scheduler, SchedulerConfig
+    from vtpu.utils.types import ContainerDevice
+
+    client = FakeClient()
+    fresh_ts = "2026-08-03T06:26:00Z"   # 40 s before AUDIT_NOW
+    stale_ts = "2026-08-03T05:00:00Z"   # >1 h before AUDIT_NOW
+    for name, n_chips, hs_ts in (
+        ("n1", 2, fresh_ts), ("n2", 1, stale_ts), ("n3", 1, fresh_ts),
+    ):
+        client.create_node(new_node(name))
+        enc = codec.encode_node_devices([
+            ChipInfo(uuid=f"{name}-tpu-{j}", count=4, hbm_mb=16384,
+                     cores=100, type="TPU-v5e", health=True)
+            for j in range(n_chips)
+        ])
+        client.patch_node_annotations(
+            name, {A.NODE_HANDSHAKE: f"Reported {hs_ts}",
+                   A.NODE_REGISTER: enc},
+        )
+    sched = Scheduler(client, SchedulerConfig(http_bind="127.0.0.1:0"))
+    sched.register_from_node_annotations()
+    sched.auditor._wallclock = lambda: AUDIT_NOW
+
+    # n1 leaked booking: schedule, then delete the pod out from under
+    # the ledger (a missed DELETE event) — the booking stays
+    leaked = client.create_pod(new_pod(
+        "leaky", uid="uid-leaky",
+        containers=[{"name": "main", "resources": {
+            "limits": {R.chip: 1, R.memory: 2048, R.cores: 10}}}],
+    ))
+    res = sched.filter(leaked, ["n1"])
+    assert res.node == "n1", (res.failed, res.error)
+    client.delete_pod("default", "leaky")
+
+    # n1 orphaned region: the monitor's write-back still carries a dead
+    # tenant's region (GC blocked past the grace)
+    sched.usage_cache.note_node_utilization("n1", {
+        "v": 1, "ts": AUDIT_NOW - 30,
+        "devices": {"n1-tpu-0": {"duty": 0.25, "hbm_peak": 536870912}},
+        "pods": {"uid-orphan": {"hbm_peak": 536870912}},
+    })
+
+    # n3 overcommit: a booking replayed off stale annotations promises
+    # more HBM than the chip's (scaled) capacity
+    over = client.create_pod(new_pod(
+        "overbooked", uid="uid-overbooked",
+        containers=[{"name": "main", "resources": {
+            "limits": {R.chip: 1, R.memory: 20000}}}],
+    ))
+    sched.pods.add_pod(over, "n3", [[ContainerDevice(
+        uuid="n3-tpu-0", type="TPU-v5e", usedmem=20000, usedcores=50,
+    )]])
+    return client, sched
+
+
 def build_monitor(root: str):
     """Two container regions — one inside quota, one in violation."""
     from vtpu.monitor.pathmonitor import REGION_FILENAME, PathMonitor

@@ -1,0 +1,188 @@
+"""Event-journal tests: ring bound under soak, typed-vocabulary
+enforcement, the JSONL mirror, per-component counting, and the wire-level
+/events query surface (filters + /timeline + Chrome merge) through the
+real extender listener."""
+
+import json
+import urllib.request
+
+import pytest
+
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.obs import events as ev
+from vtpu.obs import registry
+from vtpu.obs.events import EVENT_TYPES, EventJournal, EventType
+from vtpu.scheduler.config import SchedulerConfig
+from vtpu.scheduler.core import Scheduler
+from vtpu.scheduler.routes import serve
+from vtpu.utils import codec
+from vtpu.utils.types import ChipInfo, annotations as A, resources as R
+
+
+def _cluster(chips=2):
+    client = FakeClient()
+    client.create_node(new_node("n1"))
+    enc = codec.encode_node_devices([
+        ChipInfo(uuid=f"tpu-{j}", count=4, hbm_mb=16384, cores=100,
+                 type="TPU-v5e", health=True)
+        for j in range(chips)
+    ])
+    client.patch_node_annotations(
+        "n1", {A.NODE_HANDSHAKE: "Reported 2026-08-01T00:00:00Z",
+               A.NODE_REGISTER: enc},
+    )
+    sched = Scheduler(client, SchedulerConfig(http_bind="127.0.0.1:0"))
+    sched.register_from_node_annotations()
+    return client, sched
+
+
+def _chip_pod(name, uid=None, mem=1024):
+    return new_pod(
+        name, uid=uid or f"uid-{name}",
+        containers=[{"name": "main", "resources": {
+            "limits": {R.chip: 1, R.memory: mem}}}],
+    )
+
+
+# -- the journal itself ---------------------------------------------------
+
+
+def test_ring_bound_under_soak():
+    j = EventJournal(cap=128)
+    for i in range(10_000):
+        j.emit(EventType.POD_FILTERED, "scheduler", pod=f"u{i}")
+    assert len(j) == 128
+    recs = j.query(n=10_000)
+    assert len(recs) == 128
+    # newest survive, and seq keeps counting past the ring
+    assert recs[-1]["pod"] == "u9999"
+    assert recs[-1]["seq"] == 10_000
+
+
+def test_cap_from_env(monkeypatch):
+    monkeypatch.setenv(ev.ENV_CAP, "7")
+    j = EventJournal()
+    assert j.cap == 7
+    monkeypatch.setenv(ev.ENV_CAP, "junk")
+    assert EventJournal().cap == ev.DEFAULT_CAP
+
+
+def test_unregistered_type_rejected():
+    j = EventJournal(cap=4)
+    with pytest.raises(ValueError):
+        j.emit("NotAThing", "scheduler")
+    assert len(j) == 0
+    assert "PodBound" in EVENT_TYPES
+
+
+def test_jsonl_mirror(tmp_path):
+    sink = tmp_path / "events.jsonl"
+    j = EventJournal(cap=8, jsonl_path=str(sink))
+    j.emit(EventType.POD_BOUND, "scheduler", pod="u1", node="n1")
+    j.emit(EventType.REGION_GC, "monitor", pod="u2", age_s=301)
+    j.close()
+    lines = [json.loads(line) for line in sink.read_text().splitlines()]
+    assert [ln["type"] for ln in lines] == ["PodBound", "RegionGC"]
+    assert lines[0]["node"] == "n1"
+    assert lines[1]["age_s"] == 301
+
+
+def test_jsonl_sink_failure_does_not_break_emit(tmp_path):
+    j = EventJournal(cap=8, jsonl_path=str(tmp_path))  # a dir: open() fails
+    j.emit(EventType.POD_BOUND, "scheduler", pod="u1")
+    j.emit(EventType.POD_BOUND, "scheduler", pod="u2")
+    assert len(j) == 2  # ring unaffected; mirror disabled after one warning
+    assert j._sink_dead
+
+
+def test_query_filters():
+    j = EventJournal(cap=64, wallclock=iter(range(100)).__next__)
+    j.emit(EventType.POD_FILTERED, "scheduler", pod="a")   # ts 0
+    j.emit(EventType.POD_BOUND, "scheduler", pod="a")      # ts 1
+    j.emit(EventType.POD_FILTERED, "scheduler", pod="b")   # ts 2
+    assert [r["ts"] for r in j.query(pod="a")] == [0, 1]
+    assert [r["pod"] for r in j.query(type=EventType.POD_FILTERED)] == ["a", "b"]
+    assert [r["ts"] for r in j.query(since=2)] == [2]
+    assert len(j.query(pod="a", n=1)) == 1
+
+
+def test_emit_counts_by_component_and_type():
+    ctr = registry("obs").counter("vtpu_events_total", "t")
+    before = ctr.value(component="monitor", type=EventType.REGION_ATTACHED)
+    ev.emit(EventType.REGION_ATTACHED, "monitor", pod="u-count")
+    assert ctr.value(
+        component="monitor", type=EventType.REGION_ATTACHED) == before + 1
+
+
+# -- wire level through the extender --------------------------------------
+
+
+def test_events_endpoint_filtering_through_extender():
+    client, sched = _cluster()
+    srv, _ = serve(sched)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        pod = client.create_pod(_chip_pod("wired-ev", uid="uid-wired-ev"))
+        args = json.dumps({"pod": pod, "nodenames": ["n1"]}).encode()
+        req = urllib.request.Request(
+            f"{base}/filter", args, {"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert out["nodenames"] == ["n1"]
+        err = sched.bind("default", "wired-ev", "n1", pod_uid="uid-wired-ev")
+        assert err is None
+
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/events?pod=uid-wired-ev", timeout=10).read())
+        types = [e["type"] for e in doc["events"]]
+        assert types == ["PodFiltered", "PodBound"]
+        assert doc["events"][0]["node"] == "n1"
+
+        # type filter composes with the pod filter
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/events?pod=uid-wired-ev&type=PodBound", timeout=10
+        ).read())
+        assert [e["type"] for e in doc["events"]] == ["PodBound"]
+
+        # since= cuts on the ts field
+        cut = doc["events"][0]["ts"] + 1
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/events?pod=uid-wired-ev&since={cut}", timeout=10
+        ).read())
+        assert doc["count"] == 0
+
+        # /timeline carries the pod's events beside its spans
+        tl = json.loads(urllib.request.urlopen(
+            f"{base}/timeline?pod=uid-wired-ev", timeout=10).read())
+        assert [e["type"] for e in tl["events"]] == ["PodFiltered", "PodBound"]
+
+        # /trace.json renders journal events as instant marks
+        tr = json.loads(urllib.request.urlopen(
+            f"{base}/trace.json", timeout=10).read())
+        marks = [e for e in tr["traceEvents"]
+                 if e.get("ph") == "i" and e["args"].get("pod") == "uid-wired-ev"]
+        assert {m["name"] for m in marks} == {"PodFiltered", "PodBound"}
+    finally:
+        srv.shutdown()
+
+
+def test_bind_failure_event():
+    client, sched = _cluster()
+    pod = client.create_pod(_chip_pod("doomed", uid="uid-doomed"))
+    assert sched.filter(pod, ["n1"]).node == "n1"
+    client.delete_pod("default", "doomed")  # bind will 404
+    err = sched.bind("default", "doomed", "n1", pod_uid="uid-doomed")
+    assert err
+    recs = ev.journal().query(pod="uid-doomed", type=EventType.BIND_FAILED)
+    assert recs and "bind" in recs[-1]["error"]
+
+
+def test_node_lifecycle_events():
+    _client, sched = _cluster()
+    n1 = ev.journal().query(type=EventType.NODE_REGISTERED)
+    assert any(r["node"] == "n1" for r in n1)
+    before = len(ev.journal().query(type=EventType.NODE_REGISTERED))
+    sched.register_from_node_annotations()  # unchanged re-report: no event
+    assert len(ev.journal().query(type=EventType.NODE_REGISTERED)) == before
+    sched.nodes.rm_node_devices("n1")
+    gone = ev.journal().query(type=EventType.NODE_EXPELLED)
+    assert any(r["node"] == "n1" for r in gone)

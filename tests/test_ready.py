@@ -1,0 +1,334 @@
+"""Deep-readiness tests: the /readyz framework itself, the scheduler's
+stale-registry-poll flip, the monitor's dead/stale-sampler flips, the
+plugin's registration + device-poll checks (with the poll-loop
+hardening: last-good snapshot + failure counter), all probed wire-level
+through the real listeners."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from vtpu.k8s import FakeClient, new_node
+from vtpu.monitor.pathmonitor import PathMonitor
+from vtpu.monitor.sampler import UtilizationSampler
+from vtpu.obs import registry
+from vtpu.obs.ready import readiness, readyz_body
+from vtpu.plugin.cache import DeviceCache
+from vtpu.plugin.config import PluginConfig
+from vtpu.plugin.register import Registrar
+from vtpu.scheduler.config import SchedulerConfig
+from vtpu.scheduler.core import Scheduler
+from vtpu.scheduler.routes import serve
+
+
+@pytest.fixture(autouse=True)
+def _isolated_checks():
+    """Readiness registries are process-global; each test starts from a
+    clean check set and leftovers never leak into other tests."""
+    saved = {}
+    for comp in ("scheduler", "monitor", "plugin", "shim"):
+        reg = readiness(comp)
+        with reg._lock:
+            saved[comp] = dict(reg._checks)
+            reg._checks.clear()
+    yield
+    for comp, checks in saved.items():
+        reg = readiness(comp)
+        with reg._lock:
+            reg._checks.clear()
+            reg._checks.update(checks)
+
+
+def _get(url):
+    try:
+        resp = urllib.request.urlopen(url, timeout=10)
+        return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# -- the framework --------------------------------------------------------
+
+
+def test_no_checks_is_trivially_ready():
+    code, body = readyz_body(("scheduler",))
+    doc = json.loads(body)
+    assert code == 200 and doc["ok"] is True
+    assert doc["components"]["scheduler"]["checks"] == {}
+
+
+def test_check_outcomes_and_gauge():
+    reg = readiness("shim")
+    reg.register("good", lambda: True)
+    reg.register("detailed", lambda: (False, "broken leg"))
+    reg.register("crashes", lambda: 1 / 0)
+    code, body = readyz_body(("shim",))
+    doc = json.loads(body)
+    assert code == 503 and doc["ok"] is False
+    checks = doc["components"]["shim"]["checks"]
+    assert checks["good"] == {"ok": True}
+    assert checks["detailed"] == {"ok": False, "detail": "broken leg"}
+    assert checks["crashes"]["ok"] is False
+    assert "ZeroDivisionError" in checks["crashes"]["detail"]
+    g = registry("obs").gauge("vtpu_ready_check_ok_ratio", "t")
+    assert g.value(component="shim", check="good") == 1.0
+    assert g.value(component="shim", check="detailed") == 0.0
+    # unregister prunes the exported label set
+    reg.unregister("detailed")
+    lines = []
+    g.render(lines)
+    assert not any(
+        'component="shim"' in line and 'check="detailed"' in line
+        for line in lines
+    )
+    reg.unregister("good")
+    reg.unregister("crashes")
+
+
+# -- scheduler: stale registry poll --------------------------------------
+
+
+def test_scheduler_readyz_flips_on_stale_registry_poll():
+    client = FakeClient()
+    client.create_node(new_node("n1"))
+    sched = Scheduler(client, SchedulerConfig(http_bind="127.0.0.1:0"))
+    srv, _ = serve(sched)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        # never polled → not ready
+        code, doc = _get(f"{base}/readyz")
+        assert code == 503
+        check = doc["components"]["scheduler"]["checks"]["registry_poll"]
+        assert check["ok"] is False and "no registry poll" in check["detail"]
+        # one successful poll → ready
+        sched.register_from_node_annotations()
+        code, doc = _get(f"{base}/readyz")
+        assert code == 200 and doc["ok"] is True
+        # poll goes stale (wedged loop) → flips back before any expiry
+        sched.last_registry_poll_t = time.monotonic() - 1000
+        code, doc = _get(f"{base}/readyz")
+        assert code == 503
+        check = doc["components"]["scheduler"]["checks"]["registry_poll"]
+        assert "ago" in check["detail"]
+    finally:
+        srv.shutdown()
+
+
+# -- monitor: dead + stale sampler ----------------------------------------
+
+
+def test_monitor_readyz_flips_on_dead_sampler_thread(tmp_path):
+    from vtpu.monitor.metrics import serve_metrics
+
+    pm = PathMonitor(str(tmp_path))
+    sampler = UtilizationSampler(pm, interval_s=60.0)
+    srv, _ = serve_metrics(pm, bind="127.0.0.1:0", sampler=sampler)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        assert sampler.start() is True
+        code, doc = _get(f"{base}/readyz")
+        assert code == 200  # alive, inside the first-sample grace
+        check = doc["components"]["monitor"]["checks"]["util_sampler"]
+        assert check["ok"] is True
+        # the loop thread dies without a clean stop()
+        sampler._stop.set()
+        sampler._thread.join(5)
+        sampler._stop.clear()
+        code, doc = _get(f"{base}/readyz")
+        assert code == 503
+        check = doc["components"]["monitor"]["checks"]["util_sampler"]
+        assert check == {"ok": False, "detail": "sampler thread dead"}
+    finally:
+        sampler.stop(timeout=1)
+        srv.shutdown()
+
+
+def test_sampler_staleness_flip_on_fake_clock(tmp_path):
+    clk = {"t": 100.0}
+    pm = PathMonitor(str(tmp_path))
+    sampler = UtilizationSampler(
+        pm, interval_s=50.0, clock=lambda: clk["t"], wallclock=lambda: clk["t"]
+    )
+    assert sampler.start() is True
+    try:
+        sampler.sample_once()
+        ok, detail = sampler.sampler_status()
+        assert ok, detail
+        clk["t"] += 1000.0  # > 3 × interval with no new sample
+        ok, detail = sampler.sampler_status()
+        assert not ok and "last sample" in detail
+        sampler.sample_once()
+        ok, _ = sampler.sampler_status()
+        assert ok
+    finally:
+        sampler.stop(timeout=1)
+
+
+# -- plugin: registration + device poll ----------------------------------
+
+
+class _Topo:
+    dims = (1, 1, 1)
+
+
+class _Provider:
+    def __init__(self, chips):
+        self._chips = chips
+        self.fail = False
+
+    def enumerate(self):
+        return list(self._chips)
+
+    def health_check(self):
+        if self.fail:
+            raise RuntimeError("driver wedged")
+        return list(self._chips)
+
+    def topology(self):
+        return _Topo()
+
+
+def _chip(uuid="mock-0"):
+    from vtpu.device.chip import Chip
+
+    return Chip(uuid=uuid, index=0, model="TPU-v5e", hbm_mb=16384, cores=100)
+
+
+def test_device_poll_survives_provider_exceptions_and_counts():
+    provider = _Provider([_chip()])
+    cache = DeviceCache(provider, poll_interval_s=3600)
+    ctr = registry("plugin").counter(
+        "vtpu_plugin_device_poll_failures_total", "t")
+    before = ctr.value()
+    provider.fail = True
+    for _ in range(5):
+        cache._poll_once()  # must not raise
+    assert ctr.value() == before + 5
+    assert [c.uuid for c in cache.chips()] == ["mock-0"]  # last-good kept
+    cache.start()  # loop sleeps; checks registered
+    try:
+        ok, detail = cache.poll_status()
+        assert not ok and "5 consecutive poll failures" in detail
+        provider.fail = False
+        cache._poll_once()
+        ok, detail = cache.poll_status()
+        assert ok, detail
+    finally:
+        cache.stop()
+
+
+def test_device_poll_failure_streak_journals_once():
+    from vtpu.obs import events as ev
+
+    provider = _Provider([_chip("mock-ev")])
+    cache = DeviceCache(provider, poll_interval_s=3600)
+    before = len(ev.journal().query(type="DevicePollFailed", n=10_000))
+    provider.fail = True
+    for _ in range(4):
+        cache._poll_once()
+    after = len(ev.journal().query(type="DevicePollFailed", n=10_000))
+    assert after == before + 1  # streak start only, not once per tick
+
+
+def test_registrar_counters_and_readyz_flip():
+    client = FakeClient()
+    client.create_node(new_node("plug-n1"))
+    cfg = PluginConfig(node_name="plug-n1")
+    provider = _Provider([_chip()])
+    cache = DeviceCache(provider, poll_interval_s=3600)
+    reg = Registrar(client, cache, cfg)
+    attempts = registry("plugin").counter(
+        "vtpu_plugin_register_attempts_total", "t")
+    failures = registry("plugin").counter(
+        "vtpu_plugin_register_failures_total", "t")
+    a0, f0 = attempts.value(), failures.value()
+    # not running yet
+    ok, detail = reg.registration_status()
+    assert not ok and "not running" in detail
+    # a failing client counts and records the error
+    client_patch = client.patch_node_annotations
+    client.patch_node_annotations = lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("apiserver down"))
+    with pytest.raises(RuntimeError):
+        reg.register_once()
+    assert attempts.value() == a0 + 1 and failures.value() == f0 + 1
+    client.patch_node_annotations = client_patch
+    reg.register_once()
+    assert attempts.value() == a0 + 2 and failures.value() == f0 + 1
+    assert registry("plugin").gauge(
+        "vtpu_plugin_register_last_success_timestamp_seconds", "t"
+    ).value() > 0
+    reg.start()  # loop + check registration
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            ok, detail = reg.registration_status()
+            if ok:
+                break
+            time.sleep(0.01)
+        assert ok, detail
+        # success goes stale → flips (the scheduler expels at ~60 s)
+        reg._last_success_t = time.monotonic() - 1000
+        ok, detail = reg.registration_status()
+        assert not ok and "ago" in detail
+    finally:
+        reg.stop()
+
+
+def test_plugin_readyz_wire_level_through_serve_debug():
+    from vtpu.obs.http import serve_debug
+
+    client = FakeClient()
+    client.create_node(new_node("plug-n2"))
+    cfg = PluginConfig(node_name="plug-n2")
+    provider = _Provider([_chip()])
+    cache = DeviceCache(provider, poll_interval_s=3600)
+    reg = Registrar(client, cache, cfg)
+    cache.start()
+    reg.start()
+    srv, _ = serve_debug("127.0.0.1:0", registries=("plugin",))
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        deadline = time.monotonic() + 5
+        code, doc = 0, {}
+        while time.monotonic() < deadline:
+            code, doc = _get(f"{base}/readyz")
+            if code == 200:
+                break
+            time.sleep(0.01)
+        assert code == 200, doc
+        checks = doc["components"]["plugin"]["checks"]
+        assert set(checks) == {"registration", "device_poll"}
+        assert all(c["ok"] for c in checks.values())
+        # a dead registrar flips the probe
+        reg._last_success_t = time.monotonic() - 1000
+        code, doc = _get(f"{base}/readyz")
+        assert code == 503
+        assert doc["components"]["plugin"]["checks"]["registration"]["ok"] is False
+    finally:
+        reg.stop()
+        cache.stop()
+        srv.shutdown()
+
+
+def test_shim_component_served_by_generic_debug_listener():
+    """The fourth component surface: an embedded-shim harness serves
+    /readyz for its registered shim checks off the generic listener."""
+    from vtpu.obs.http import serve_debug
+
+    readiness("shim").register("region", lambda: (True, "region mapped"))
+    srv, _ = serve_debug("127.0.0.1:0", registries=("shim",))
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        code, doc = _get(f"{base}/readyz")
+        assert code == 200
+        assert doc["components"]["shim"]["checks"]["region"] == {
+            "ok": True, "detail": "region mapped"}
+        readiness("shim").register("region", lambda: (False, "region lost"))
+        code, doc = _get(f"{base}/readyz")
+        assert code == 503
+    finally:
+        srv.shutdown()

@@ -297,6 +297,35 @@ def test_writeback_rate_limited_and_delta_gated(tmp_path):
     pm.close()
 
 
+def test_writeback_max_age_forces_heartbeat_on_idle_node(tmp_path):
+    """Past the max-age ceiling the delta gate is bypassed: an idle
+    node's annotation ts must keep advancing — the scheduler-side
+    auditor reads it as a heartbeat (stale_heartbeat at 120 s)."""
+    clk = FakeClock()
+    client = FakeClient()
+    client.create_node(new_node("n1"))
+    r, pm, sampler = _writeback_sampler(tmp_path, clk, client)
+    sampler.sample_once()
+    assert sampler.writeback_once() == "written"
+    ts0 = json.loads(
+        client.get_node("n1")["metadata"]["annotations"][A.NODE_UTILIZATION]
+    )["ts"]
+    # duty unchanged, inside max age: delta-gated as before
+    clk.sleep(31.0)
+    sampler.sample_once()
+    assert sampler.writeback_once() == "skipped_delta"
+    # duty still unchanged, but past the 60 s ceiling: forced rewrite
+    clk.sleep(30.0)
+    sampler.sample_once()
+    assert sampler.writeback_once() == "written"
+    ts1 = json.loads(
+        client.get_node("n1")["metadata"]["annotations"][A.NODE_UTILIZATION]
+    )["ts"]
+    assert ts1 > ts0
+    r.close()
+    pm.close()
+
+
 def test_scheduler_ingests_node_utilization_annotation(tmp_path):
     from vtpu.scheduler.config import SchedulerConfig
     from vtpu.scheduler.core import Scheduler

@@ -1,0 +1,19 @@
+"""vtpu.audit — cluster state reconciliation.
+
+The stack keeps three views of truth: the scheduler's booked ledger
+(UsageCache/PodManager), the plugin's served allocations (the
+DEVICES_TO_ALLOCATE handshake), and the monitor's measured shared
+regions (the node-utilization write-back).  :class:`ClusterAuditor`
+periodically diffs them per node, classifies drift (leaked bookings,
+orphaned regions, overcommit, stale heartbeats), emits ``DriftDetected``
+events and ``vtpu_audit_*`` gauges, and serves the per-node verdict
+report at ``GET /audit``.
+"""
+
+from vtpu.audit.auditor import (  # noqa: F401
+    ClusterAuditor,
+    DRIFT_CLASSES,
+    DriftClass,
+)
+
+__all__ = ["ClusterAuditor", "DRIFT_CLASSES", "DriftClass"]

@@ -1,0 +1,453 @@
+"""Reconciliation auditor: booked vs measured vs allocated, per node.
+
+Annotation-bus systems keep no database — the scheduler's ledger is
+rebuilt from annotations, the monitor measures regions on disk, and
+nothing ever cross-checks the two against the live pod set.  The failure
+modes are all silent until a node wedges:
+
+- **leaked booking** — the ledger books devices for a pod that no longer
+  exists (missed DELETE event, crashed ingest sweep): capacity is gone
+  but nobody is using it;
+- **orphaned region** — the monitor still counts a shared region whose
+  tenant pod is dead (GC blocked, grace misconfigured): measured HBM
+  that no booking explains;
+- **overcommit** — the sum of booked quotas on a chip exceeds its
+  (scaled) capacity: stale annotations replayed after a registry change
+  can book more than exists;
+- **stale heartbeat** — a node's handshake or utilization write-back
+  annotation stopped advancing: the plugin/monitor on that node is dead
+  or partitioned, so every other view of the node is suspect.
+
+Each pass produces a per-node verdict report (``GET /audit``), emits one
+``DriftDetected`` journal event per finding, and exports gauges
+(``vtpu_audit_leaked_bookings_total``, ``vtpu_audit_orphaned_region_bytes``,
+``vtpu_audit_overcommit_ratio``, ``vtpu_audit_last_pass_timestamp_seconds``)
+with per-node label pruning.  The auditor only *reads* — reconciliation
+actions stay with the components that own the state (the ingest sweep,
+the pathmonitor GC); the auditor makes the skew visible.
+
+State sources are duck-typed off the Scheduler (``usage_cache``,
+``pods``, ``nodes``, ``node_objects()``, ``client``), so the whole thing
+runs against a FakeClient-seeded cluster in tests and in
+``make audit-check``.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from vtpu import obs
+from vtpu.obs.events import EventType, emit
+from vtpu.scheduler.state import PENDING_PATCH_GRACE_S
+from vtpu.utils.types import HANDSHAKE_TIMEOUT_S, KNOWN_DEVICES, annotations
+
+log = logging.getLogger(__name__)
+
+ENV_INTERVAL = "VTPU_AUDIT_INTERVAL_S"
+DEFAULT_INTERVAL_S = 60.0
+# a handshake older than two timeouts means the registry poll ALSO
+# failed to expel it — both sides of the bus are stuck
+DEFAULT_STALE_HEARTBEAT_S = 2.0 * HANDSHAKE_TIMEOUT_S
+_EPS = 1e-9
+
+_REG = obs.registry("scheduler")
+_LEAKED = _REG.gauge(
+    "vtpu_audit_leaked_bookings_total",
+    "Bookings whose pod no longer exists (per node; the ledger holds "
+    "capacity nobody uses)",
+)
+_ORPHANED = _REG.gauge(
+    "vtpu_audit_orphaned_region_bytes",
+    "Measured shared-region HBM whose tenant pod is dead (per node)",
+)
+_OVERCOMMIT = _REG.gauge(
+    "vtpu_audit_overcommit_ratio",
+    "Worst booked/capacity ratio across a node's chips (memory or "
+    "cores; > 1.0 = the ledger promises more than the chip has)",
+)
+_LAST_PASS = _REG.gauge(
+    "vtpu_audit_last_pass_timestamp_seconds",
+    "Wall time of the last completed reconciliation pass",
+)
+_DRIFTS = _REG.counter(
+    "vtpu_audit_drift_total",
+    "Drift findings by class across all reconciliation passes",
+)
+
+
+class DriftClass:
+    LEAKED_BOOKING = "leaked_booking"
+    ORPHANED_REGION = "orphaned_region"
+    OVERCOMMIT = "overcommit"
+    STALE_HEARTBEAT = "stale_heartbeat"
+
+
+DRIFT_CLASSES = (
+    DriftClass.LEAKED_BOOKING,
+    DriftClass.ORPHANED_REGION,
+    DriftClass.OVERCOMMIT,
+    DriftClass.STALE_HEARTBEAT,
+)
+
+
+def _parse_handshake_ts(value: str) -> Optional[datetime.datetime]:
+    """Timestamp out of ``Reported <ts>`` / ``Requesting_<ts>`` /
+    ``Deleted_<ts>`` (both separators tolerated)."""
+    for sep in (" ", "_"):
+        _, found, rest = value.partition(sep)
+        if found:
+            try:
+                return datetime.datetime.strptime(
+                    rest, "%Y-%m-%dT%H:%M:%SZ"
+                ).replace(tzinfo=datetime.timezone.utc)
+            except ValueError:
+                continue
+    return None
+
+
+class ClusterAuditor:
+    """Periodic booked/measured/allocated reconciliation over one
+    Scheduler's state."""
+
+    def __init__(
+        self,
+        sched,
+        interval_s: Optional[float] = None,
+        stale_heartbeat_s: float = DEFAULT_STALE_HEARTBEAT_S,
+        wallclock=time.time,
+    ) -> None:
+        self.sched = sched
+        if interval_s is None:
+            try:
+                interval_s = float(
+                    os.environ.get(ENV_INTERVAL, "") or DEFAULT_INTERVAL_S
+                )
+            except ValueError:
+                interval_s = DEFAULT_INTERVAL_S
+        self.interval_s = interval_s
+        self.stale_heartbeat_s = stale_heartbeat_s
+        self._wallclock = wallclock
+        self._lock = threading.Lock()
+        self._pass_lock = threading.Lock()  # one pass at a time (loop + GET)
+        self._passes = 0
+        self._last_report: Optional[dict] = None
+        self._last_pass_t: Optional[float] = None  # monotonic
+        self._prev_nodes: Set[str] = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- state collection ----------------------------------------------
+    def _live_pods(self) -> Optional[Dict[str, dict]]:
+        """uid → pod for pods that can legitimately hold devices
+        (terminal phases hold none, like the ingest sweep).  None on an
+        API failure — callers must SKIP the pod-based detectors then: an
+        empty dict would read as "every pod is dead" and storm
+        false leaked/orphaned findings off one apiserver blip."""
+        out: Dict[str, dict] = {}
+        try:
+            pods = self.sched.client.list_pods()
+        except Exception:  # noqa: BLE001 — audit must survive API blips
+            log.exception("audit: pod list failed; skipping pod checks")
+            return None
+        for pod in pods:
+            uid = pod.get("metadata", {}).get("uid", "")
+            if not uid:
+                continue
+            if pod.get("status", {}).get("phase", "") in ("Succeeded", "Failed"):
+                continue
+            out[uid] = pod
+        return out
+
+    # -- drift detectors -----------------------------------------------
+    def _leaked_bookings(
+        self, live_uids, drifts: Dict[str, List[dict]]
+    ) -> Dict[str, int]:
+        bookings = self.sched.usage_cache.bookings_snapshot()
+        pods = self.sched.pods.all_pods()
+        now = time.monotonic()
+        leaked: Dict[str, int] = {}
+        for uid, (node, _devices) in sorted(bookings.items()):
+            if uid in live_uids:
+                continue
+            pi = pods.get(uid)
+            if (
+                pi is not None
+                and pi.pending
+                and now - pi.pending_since < PENDING_PATCH_GRACE_S
+            ):
+                continue  # fresh local booking: its patch may still be in flight
+            leaked[node] = leaked.get(node, 0) + 1
+            drifts.setdefault(node, []).append({
+                "class": DriftClass.LEAKED_BOOKING,
+                "pod": uid,
+                "detail": f"pod {uid} gone but still booked on {node}",
+            })
+        return leaked
+
+    def _orphaned_regions(
+        self, live_uids, drifts: Dict[str, List[dict]]
+    ) -> Dict[str, int]:
+        """Regions the monitor still measures for dead tenants — read
+        from the node-utilization write-back's per-pod map (absent on
+        pre-v2 monitors: then this class is undetectable, not clean)."""
+        orphaned: Dict[str, int] = {}
+        measured = self.sched.usage_cache.measured_utilization()
+        for node, payload in sorted(measured.items()):
+            pods_map = payload.get("pods") if isinstance(payload, dict) else None
+            if not isinstance(pods_map, dict):
+                continue
+            for uid, rec in sorted(pods_map.items()):
+                if uid in live_uids:
+                    continue
+                try:
+                    nbytes = int(rec.get("hbm_peak", 0))
+                except (AttributeError, TypeError, ValueError):
+                    nbytes = 0
+                orphaned[node] = orphaned.get(node, 0) + nbytes
+                drifts.setdefault(node, []).append({
+                    "class": DriftClass.ORPHANED_REGION,
+                    "pod": uid,
+                    "bytes": nbytes,
+                    "detail": f"region of dead pod {uid} still measured "
+                              f"on {node} ({nbytes} bytes)",
+                })
+        return orphaned
+
+    def _overcommit(self, drifts: Dict[str, List[dict]]) -> Dict[str, float]:
+        """Worst booked/capacity ratio per node (memory MiB and core
+        percent, per chip); > 1 means the ledger promises more than the
+        registry advertises — even after oversubscription scaling."""
+        ratios: Dict[str, float] = {}
+        nodes = self.sched.nodes.all_nodes()
+        booked_mem: Dict[str, Dict[str, int]] = {}
+        booked_cores: Dict[str, Dict[str, int]] = {}
+        for _uid, (node, devices) in self.sched.usage_cache.bookings_snapshot().items():
+            for ctr in devices:
+                for cd in ctr:
+                    booked_mem.setdefault(node, {})[cd.uuid] = (
+                        booked_mem.get(node, {}).get(cd.uuid, 0) + cd.usedmem
+                    )
+                    booked_cores.setdefault(node, {})[cd.uuid] = (
+                        booked_cores.get(node, {}).get(cd.uuid, 0) + cd.usedcores
+                    )
+        for name, info in sorted(nodes.items()):
+            worst = 0.0
+            for chip in info.devices:
+                mem = booked_mem.get(name, {}).get(chip.uuid, 0)
+                cores = booked_cores.get(name, {}).get(chip.uuid, 0)
+                mem_ratio = mem / chip.hbm_mb if chip.hbm_mb else 0.0
+                core_ratio = cores / chip.cores if chip.cores else 0.0
+                ratio = max(mem_ratio, core_ratio)
+                if ratio > worst:
+                    worst = ratio
+                if ratio > 1.0 + _EPS:
+                    drifts.setdefault(name, []).append({
+                        "class": DriftClass.OVERCOMMIT,
+                        "uuid": chip.uuid,
+                        "ratio": round(ratio, 4),
+                        "detail": f"chip {chip.uuid} booked at "
+                                  f"{ratio:.2f}x capacity "
+                                  f"(mem {mem}/{chip.hbm_mb} MiB, "
+                                  f"cores {cores}/{chip.cores})",
+                    })
+            ratios[name] = round(worst, 4)
+        return ratios
+
+    def _stale_heartbeats(self, drifts: Dict[str, List[dict]]) -> Set[str]:
+        """Handshake annotations whose embedded timestamp (or whose
+        utilization write-back ``ts``) stopped advancing."""
+        stale: Set[str] = set()
+        now = self._wallclock()
+        node_objs = self.sched.node_objects()
+        measured = self.sched.usage_cache.measured_utilization()
+        for name in sorted(self.sched.nodes.all_nodes()):
+            annos = (
+                node_objs.get(name, {}).get("metadata", {}).get("annotations")
+                or {}
+            )
+            for handshake_anno in KNOWN_DEVICES:
+                hs = annos.get(handshake_anno)
+                if not hs or hs.startswith("Deleted"):
+                    continue
+                ts = _parse_handshake_ts(hs)
+                if ts is None:
+                    continue
+                age = now - ts.timestamp()
+                if age > self.stale_heartbeat_s:
+                    stale.add(name)
+                    drifts.setdefault(name, []).append({
+                        "class": DriftClass.STALE_HEARTBEAT,
+                        "annotation": handshake_anno,
+                        "age_s": round(age, 1),
+                        "detail": f"{handshake_anno} stuck at "
+                                  f"{hs.split()[0].split('_')[0]} for "
+                                  f"{age:.0f}s on {name}",
+                    })
+            payload = measured.get(name)
+            if isinstance(payload, dict) and "ts" in payload:
+                try:
+                    age = now - float(payload["ts"])
+                except (TypeError, ValueError):
+                    age = 0.0
+                if age > self.stale_heartbeat_s:
+                    stale.add(name)
+                    drifts.setdefault(name, []).append({
+                        "class": DriftClass.STALE_HEARTBEAT,
+                        "annotation": annotations.NODE_UTILIZATION,
+                        "age_s": round(age, 1),
+                        "detail": f"utilization write-back {age:.0f}s "
+                                  f"stale on {name}",
+                    })
+        return stale
+
+    # -- the pass -------------------------------------------------------
+    def audit_once(self) -> dict:
+        """One reconciliation pass: collect, classify, publish (report +
+        events + gauges).  Returns the report served at GET /audit.
+        Serialized: the periodic loop and on-demand GET /audit must not
+        interleave their gauge set/prune phases."""
+        with self._pass_lock:
+            return self._audit_once_locked()
+
+    def _audit_once_locked(self) -> dict:
+        live = self._live_pods()
+        drifts: Dict[str, List[dict]] = {}
+        if live is not None:
+            leaked = self._leaked_bookings(live, drifts)
+            orphaned = self._orphaned_regions(live, drifts)
+        else:
+            leaked, orphaned = {}, {}  # pod list failed: detectors skipped
+        ratios = self._overcommit(drifts)
+        stale = self._stale_heartbeats(drifts)
+
+        node_names = set(self.sched.nodes.all_nodes()) | set(drifts)
+        nodes_out: Dict[str, dict] = {}
+        for name in sorted(node_names):
+            found = sorted(
+                drifts.get(name, []),
+                key=lambda d: (d["class"], d.get("pod", d.get("uuid", ""))),
+            )
+            nodes_out[name] = {"ok": not found, "drifts": found}
+            for d in found:
+                _DRIFTS.inc(**{"class": d["class"]})
+                emit(
+                    EventType.DRIFT_DETECTED, "scheduler",
+                    pod=d.get("pod", ""), node=name,
+                    drift=d["class"], detail=d["detail"],
+                )
+            # gauges, including explicit zeros: "audited clean" and
+            # "never audited" must be distinguishable per node.  On a
+            # degraded pass (pod list failed) the leak/orphan gauges
+            # keep their last honest values instead of lying 0.
+            if live is not None:
+                _LEAKED.set(leaked.get(name, 0), node=name)
+                _ORPHANED.set(orphaned.get(name, 0), node=name)
+            _OVERCOMMIT.set(ratios.get(name, 0.0), node=name)
+
+        ts = self._wallclock()
+        with self._lock:
+            self._passes += 1
+            for gone in self._prev_nodes - node_names:
+                _LEAKED.remove(node=gone)
+                _ORPHANED.remove(node=gone)
+                _OVERCOMMIT.remove(node=gone)
+            self._prev_nodes = set(node_names)
+            report = {
+                "pass": self._passes,
+                "ts": ts,
+                "ok": all(v["ok"] for v in nodes_out.values()),
+                "degraded": live is None,  # pod-based detectors skipped
+                "nodes": nodes_out,
+                "summary": {
+                    "leaked_bookings": sum(leaked.values()),
+                    "orphaned_region_bytes": sum(orphaned.values()),
+                    "overcommit_nodes": sum(
+                        1 for r in ratios.values() if r > 1.0 + _EPS
+                    ),
+                    "stale_nodes": len(stale),
+                },
+            }
+            self._last_report = report
+            self._last_pass_t = time.monotonic()
+        _LAST_PASS.set(ts)
+        return report
+
+    # -- query surface (GET /audit) -------------------------------------
+    def report_body(self, params: dict) -> bytes:
+        """JSON for ``GET /audit``.  Serves the last report while it is
+        younger than the audit interval and runs a fresh pass otherwise
+        — so a dashboard polling every few seconds costs at most one
+        pass (each pass LISTs pods and re-emits DriftDetected events)
+        per interval.  ``?refresh=1`` forces a pass; ``?cached=1`` never
+        runs one unless no pass has ever completed."""
+        force = bool(params.get("refresh"))
+        with self._lock:
+            report = self._last_report
+            age = (
+                None if self._last_pass_t is None
+                else time.monotonic() - self._last_pass_t
+            )
+        if params.get("cached") and report is not None:
+            return json.dumps(report, default=str).encode()
+        max_age = self.interval_s if self.interval_s > 0 else DEFAULT_INTERVAL_S
+        if force or report is None or age is None or age > max_age:
+            report = self.audit_once()
+        return json.dumps(report, default=str).encode()
+
+    def last_pass_age_s(self) -> Optional[float]:
+        with self._lock:
+            if self._last_pass_t is None:
+                return None
+            return time.monotonic() - self._last_pass_t
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> bool:
+        """Start the periodic loop (no-op when already running or the
+        interval is 0/negative = disabled) and register the scheduler's
+        ``audit_pass`` readiness check."""
+        if self.interval_s <= 0:
+            return False
+        if self._thread is not None and self._thread.is_alive():
+            return False
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.audit_once()
+                except Exception:  # noqa: BLE001 — keep auditing
+                    log.exception("audit pass failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="vtpu-auditor", daemon=True
+        )
+        self._thread.start()
+
+        from vtpu.obs.ready import readiness
+
+        def check():
+            age = self.last_pass_age_s()
+            if age is None:
+                t = self._thread
+                return (
+                    t is not None and t.is_alive(),
+                    "no audit pass completed yet",
+                )
+            if age > 3 * self.interval_s:
+                return False, f"last audit pass {age:.0f}s ago"
+            return True, f"last audit pass {age:.0f}s ago"
+
+        readiness("scheduler").register("audit_pass", check)
+        return True
+
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)

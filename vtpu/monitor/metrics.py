@@ -131,9 +131,13 @@ def render_node_metrics(
     legacy = "\n".join(lines) + "\n"
     if not include_obs:
         return legacy
+    # "obs" carries the cross-component families (event counts, readiness
+    # breakdown) — one registry so the monitor+shim concatenation can
+    # never repeat a family name
     return (legacy
             + obs.registry("monitor").render()
-            + obs.registry("shim").render())
+            + obs.registry("shim").render()
+            + obs.registry("obs").render())
 
 
 def serve_metrics(
@@ -184,11 +188,14 @@ def serve_metrics(
                     return
                 self._send(200, body, "application/json")
                 return
-            if route in ("/spans", "/timeline", "/trace.json"):
-                # shared debug surface (vtpu/obs/http.py)
+            if route in ("/spans", "/timeline", "/trace.json", "/events",
+                         "/readyz"):
+                # shared debug surface (vtpu/obs/http.py): span feed,
+                # event journal, and the deep-readiness probe
                 from vtpu.obs.http import handle_debug_get
 
-                if not handle_debug_get(self, self._send):
+                if not handle_debug_get(self, self._send,
+                                        ready_components=("monitor",)):
                     self._send(404, b"not found", "text/plain")
                 return
             if self.path == "/healthz":

@@ -22,6 +22,7 @@ from typing import Dict, Optional
 
 from vtpu import obs
 from vtpu.monitor.shared_region import RegionFile, open_region
+from vtpu.obs.events import EventType, emit
 
 log = logging.getLogger(__name__)
 
@@ -93,14 +94,18 @@ class PathMonitor:
         if name not in self.entries:
             cache = os.path.join(d, REGION_FILENAME)
             region = open_region(cache) if os.path.exists(cache) else None
-            self.entries[name] = ContainerEntry(name, d, region)
+            entry = self.entries[name] = ContainerEntry(name, d, region)
             if region:
                 log.info("monitoring new container region %s", name)
+                emit(EventType.REGION_ATTACHED, "monitor",
+                     pod=entry.pod_uid, ctr=name)
         elif self.entries[name].region is None:
             # region file may appear after the dir (mount then first touch)
             cache = os.path.join(d, REGION_FILENAME)
             if os.path.exists(cache):
                 self.entries[name].region = open_region(cache)
+                emit(EventType.REGION_ATTACHED, "monitor",
+                     pod=self.entries[name].pod_uid, ctr=name)
         if known_pod_uids is not None:
             entry = self.entries[name]
             if entry.pod_uid not in known_pod_uids:
@@ -123,6 +128,8 @@ class PathMonitor:
                     self.entries.pop(name, None)
                     seen.discard(name)
                     _GC_DIRS.inc()
+                    emit(EventType.REGION_GC, "monitor",
+                         pod=entry.pod_uid, ctr=name, age_s=round(age, 1))
 
     def close(self) -> None:
         for e in self.entries.values():

@@ -80,6 +80,10 @@ DEFAULT_INTERVAL_S = 5.0
 DEFAULT_SERIES_CAP = 720          # 1 h of history at the 5 s default
 DEFAULT_WRITEBACK_MIN_INTERVAL_S = 30.0
 DEFAULT_WRITEBACK_MIN_DELTA = 0.05
+# delta-gate ceiling: past this age the annotation is rewritten even
+# with unchanged duties, so its ts keeps advancing on idle nodes — the
+# scheduler-side auditor reads the ts as a heartbeat (stale at 120 s)
+DEFAULT_WRITEBACK_MAX_AGE_S = 60.0
 
 
 def _env_float(name: str, default: float) -> float:
@@ -139,7 +143,14 @@ class UtilizationSampler:
                 "VTPU_UTIL_WRITEBACK_MIN_DELTA", DEFAULT_WRITEBACK_MIN_DELTA
             )
         )
+        self.writeback_max_age_s = _env_float(
+            "VTPU_UTIL_WRITEBACK_MAX_AGE_S", DEFAULT_WRITEBACK_MAX_AGE_S
+        )
         self._lock = threading.Lock()
+        # sampler health, read by the monitor's /readyz "util_sampler"
+        # check (monotonic clock so fake-clock tests stay deterministic)
+        self._last_sample_t: Optional[float] = None
+        self._started_t: Optional[float] = None
         # (ctr dirname, dev index) → (mono_t, busy_ns, launches)
         self._prev: Dict[Tuple[str, int], Tuple[float, int, int]] = {}
         # ctr dirname → dev index → ring of sample points
@@ -147,6 +158,9 @@ class UtilizationSampler:
         # ctr dirname → (pod_uid, podname, podns, [uuids])
         self._meta: Dict[str, Tuple[str, str, str, List[str]]] = {}
         self._node_summary: Dict[str, dict] = {}  # uuid → {"duty", "hbm_peak"}
+        # pod_uid → {"hbm_peak": bytes}: rides the write-back so the
+        # scheduler's auditor can spot orphaned regions cluster-wide
+        self._pods_summary: Dict[str, dict] = {}
         self._last_writeback_t: Optional[float] = None
         self._last_writeback_duty: Dict[str, float] = {}
         self._stop = threading.Event()
@@ -169,6 +183,7 @@ class UtilizationSampler:
         live: set = set()
         node_duty: Dict[str, float] = {}
         node_peak: Dict[str, int] = {}
+        pods_peak: Dict[str, int] = {}
         with self._lock:
             for name, entry in sorted(entries.items()):
                 region = entry.region
@@ -213,6 +228,9 @@ class UtilizationSampler:
                     self._prev[key] = (now, u["busy_ns"], u["launches"])
                     uuid = uuids[i]
                     node_peak[uuid] = node_peak.get(uuid, 0) + u["hbm_peak"]
+                    pods_peak[entry.pod_uid] = (
+                        pods_peak.get(entry.pod_uid, 0) + u["hbm_peak"]
+                    )
                     if prev is None:
                         continue
                     dt = now - prev[0]
@@ -255,7 +273,11 @@ class UtilizationSampler:
                 }
                 for uuid in set(node_duty) | set(node_peak)
             }
+            self._pods_summary = {
+                uid: {"hbm_peak": peak} for uid, peak in sorted(pods_peak.items())
+            }
             summary = dict(self._node_summary)
+            self._last_sample_t = now
         _SAMPLES.inc()
         return summary
 
@@ -352,10 +374,14 @@ class UtilizationSampler:
         return events
 
     def merged_chrome(self) -> str:
-        """trace.export_chrome() with this sampler's counter events
-        appended — the /trace.json the monitor serves."""
+        """trace.export_chrome() with this sampler's counter events and
+        the journal's instant marks appended — the /trace.json the
+        monitor serves."""
+        from vtpu.obs import events as events_mod
+
         doc = json.loads(trace.export_chrome())
         doc["traceEvents"].extend(self.chrome_events())
+        doc["traceEvents"].extend(events_mod.journal().chrome_events())
         return json.dumps(doc, default=str)
 
     # -- node write-back ------------------------------------------------
@@ -373,10 +399,18 @@ class UtilizationSampler:
         now = self._clock()
         duties = {u: d["duty"] for u, d in summary.items()}
         if self._last_writeback_t is not None:
-            if now - self._last_writeback_t < self.writeback_min_interval_s:
+            age = now - self._last_writeback_t
+            if age < self.writeback_min_interval_s:
                 _WRITEBACK.inc(result="skipped_interval")
                 return "skipped_interval"
-            if set(duties) == set(self._last_writeback_duty):
+            # the delta gate only applies below the max-age ceiling: on
+            # an idle node the annotation's ts must still advance, or
+            # the auditor reads a healthy node as stale_heartbeat (and a
+            # GC'd region would sit in the stale "pods" map forever)
+            if (
+                age < self.writeback_max_age_s
+                and set(duties) == set(self._last_writeback_duty)
+            ):
                 delta = max(
                     (abs(duties[u] - self._last_writeback_duty[u])
                      for u in duties),
@@ -385,8 +419,14 @@ class UtilizationSampler:
                 if delta < self.writeback_min_delta:
                     _WRITEBACK.inc(result="skipped_delta")
                     return "skipped_delta"
+        with self._lock:
+            pods = dict(self._pods_summary)
         value = json.dumps(
-            {"v": 1, "ts": int(self._wallclock()), "devices": summary},
+            # "pods" (per-pod region HBM peaks) feeds the scheduler-side
+            # reconciliation auditor's orphaned-region check; readers of
+            # v1 ignore unknown keys, so the version stays 1
+            {"v": 1, "ts": int(self._wallclock()), "devices": summary,
+             "pods": pods},
             sort_keys=True,
         )
         try:
@@ -402,13 +442,38 @@ class UtilizationSampler:
         _WRITEBACK.inc(result="written")
         return "written"
 
+    # -- readiness ------------------------------------------------------
+    def sampler_status(self) -> tuple:
+        """(ok, detail) for the monitor's ``util_sampler`` /readyz
+        check: the loop thread must be alive and a sample must have
+        landed within ~3 intervals (startup gets the same grace)."""
+        t = self._thread
+        if t is None or not t.is_alive():
+            if self._stop.is_set():
+                return False, "sampler stopped"
+            return False, "sampler thread dead"
+        grace = max(3 * self.interval_s, 1.0)
+        with self._lock:
+            last = self._last_sample_t
+        if last is None:
+            started = self._started_t
+            if started is not None and self._clock() - started > grace:
+                return False, "no sample since start"
+            return True, "waiting for first sample"
+        age = self._clock() - last
+        if age > grace:
+            return False, f"last sample {age:.0f}s ago"
+        return True, f"last sample {age:.0f}s ago"
+
     # -- lifecycle ------------------------------------------------------
     def start(self) -> bool:
         """Start the sampling loop; a second call while the thread is
-        alive is a no-op (returns False)."""
+        alive is a no-op (returns False).  Registers the monitor's
+        ``util_sampler`` readiness check."""
         if self._thread is not None and self._thread.is_alive():
             return False
         self._stop.clear()
+        self._started_t = self._clock()
 
         def loop() -> None:
             while not self._stop.wait(self.interval_s):
@@ -422,6 +487,9 @@ class UtilizationSampler:
             target=loop, name="vtpu-util-sampler", daemon=True
         )
         self._thread.start()
+        from vtpu.obs.ready import readiness
+
+        readiness("monitor").register("util_sampler", self.sampler_status)
         return True
 
     def stop(self, timeout: Optional[float] = 5.0) -> None:

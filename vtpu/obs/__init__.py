@@ -2,8 +2,12 @@
 
 - :mod:`vtpu.obs.registry` — zero-dependency counters/gauges/histograms
   with the single Prometheus text renderer every component uses;
-- :mod:`vtpu.obs.http` — the /spans, /timeline, /trace.json debug
-  surface + the span-push feed;
+- :mod:`vtpu.obs.events` — the typed, bounded cross-component event
+  journal (``GET /events``, ``vtpu_events_total``);
+- :mod:`vtpu.obs.ready` — named per-component readiness checks behind
+  the shared ``GET /readyz`` probe;
+- :mod:`vtpu.obs.http` — the /spans, /timeline, /trace.json, /events,
+  /readyz debug surface + the span-push feed;
 - :mod:`vtpu.obs.logsetup` — shared logging bootstrap for cmd/
   entrypoints (``VTPU_LOG_FORMAT=json``).
 

@@ -1,0 +1,282 @@
+"""Typed, bounded, cross-component event journal.
+
+Tracing (PR 2) answers "how long did each leg take" and the decision log
+(PR 4) answers "why this node" — but neither leaves a durable record of
+*what happened* to a pod or a node: a booking, an Allocate, a region
+attach, a GC, a drift verdict.  This journal is that record: a process-
+wide capped ring (``VTPU_EVENT_LOG_CAP``, default 2048) of typed events,
+optionally mirrored to a JSONL file (``VTPU_EVENT_JSONL``) for post-
+mortems that outlive the process.
+
+Every event carries a registered type (``EVENT_TYPES`` — emit() rejects
+unknown ones so the catalog in docs/observability.md stays complete,
+enforced by ``make obs-lint``), the emitting component, the subject pod
+uid / node, a wall timestamp, and the active trace context when the
+emitter runs inside a span (trace id = pod UID, so ``/events?pod=`` and
+``/timeline?pod=`` join on the same key).
+
+Counting rides the shared metrics layer: each emit increments
+``vtpu_events_total{component=,type=}`` in the cross-cutting ``obs``
+registry (rendered by every /metrics listener after its own families —
+one registry, because a listener that concatenates two component
+registries must never see the same family twice).
+
+Query surface: ``GET /events?pod=&type=&since=&n=`` on every debug
+listener (vtpu/obs/http.py), merged into ``/timeline`` responses, and
+exported into the Chrome trace as instant events so journal marks render
+between the spans.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from typing import Deque, List, Optional
+
+from vtpu.obs.registry import registry
+from vtpu.utils import trace
+
+log = logging.getLogger(__name__)
+
+ENV_CAP = "VTPU_EVENT_LOG_CAP"
+ENV_JSONL = "VTPU_EVENT_JSONL"
+DEFAULT_CAP = 2048
+
+
+class EventType:
+    """The registered event vocabulary.  Every name here must be
+    documented in docs/observability.md — ``make obs-lint`` fails on a
+    type missing from the catalog."""
+
+    # scheduler
+    POD_FILTERED = "PodFiltered"        # filter decided (node chosen or no-fit)
+    POD_BOUND = "PodBound"              # bind succeeded
+    BIND_FAILED = "BindFailed"          # bind failed; booking rolled back
+    NODE_REGISTERED = "NodeRegistered"  # registry gained/changed a node's devices
+    NODE_EXPELLED = "NodeExpelled"      # a node's devices left the registry
+    NODE_STALE = "NodeStale"            # handshake/heartbeat past its deadline
+    # plugin
+    ALLOCATE_SERVED = "AllocateServed"  # kubelet Allocate answered with devices
+    ALLOCATE_FAILED = "AllocateFailed"  # Allocate unwound the handshake
+    DEVICE_POLL_FAILED = "DevicePollFailed"  # provider health poll broke (streak start)
+    # monitor
+    REGION_ATTACHED = "RegionAttached"  # pathmonitor started tracking a region
+    REGION_GC = "RegionGC"              # stale container dir garbage-collected
+    # auditor
+    DRIFT_DETECTED = "DriftDetected"    # reconciliation found booked/measured skew
+
+
+EVENT_TYPES = frozenset(
+    v for k, v in vars(EventType).items() if not k.startswith("_")
+)
+
+
+class EventJournal:
+    """Capped ring of typed events + optional JSONL mirror."""
+
+    def __init__(
+        self,
+        cap: Optional[int] = None,
+        jsonl_path: Optional[str] = None,
+        wallclock=time.time,
+    ) -> None:
+        if cap is None:
+            try:
+                cap = int(os.environ.get(ENV_CAP, "") or DEFAULT_CAP)
+            except ValueError:
+                cap = DEFAULT_CAP
+        self.cap = max(1, cap)
+        self.jsonl_path = (
+            jsonl_path
+            if jsonl_path is not None
+            else os.environ.get(ENV_JSONL, "")
+        ) or None
+        self._wallclock = wallclock
+        self._lock = threading.Lock()
+        self._dq: Deque[dict] = collections.deque(maxlen=self.cap)
+        self._seq = 0
+        # the sink has its own lock so emitters on the scheduler's hot
+        # path never queue behind another thread's disk flush on the
+        # ring lock; under contention file lines may land out of seq
+        # order — every record carries "seq", consumers sort on it
+        self._sink_lock = threading.Lock()
+        self._sink = None          # lazily opened append handle
+        self._sink_dead = False    # one warning, then the mirror stays off
+
+    # -- emit -----------------------------------------------------------
+    def emit(
+        self,
+        type: str,
+        component: str,
+        pod: str = "",
+        node: str = "",
+        **fields: object,
+    ) -> dict:
+        """Record one event.  ``type`` must be a registered EventType;
+        ``pod`` is the pod UID when the event concerns one.  The active
+        trace context (if any) is captured so journal entries join the
+        span feed.  Never raises past the type check — a broken sink or
+        counter must not break the emitting hot path."""
+        if type not in EVENT_TYPES:
+            raise ValueError(f"unregistered event type: {type!r}")
+        with self._lock:
+            self._seq += 1
+            rec = {
+                "seq": self._seq,
+                "ts": self._wallclock(),
+                "type": type,
+                "component": component,
+            }
+            if pod:
+                rec["pod"] = pod
+            if node:
+                rec["node"] = node
+            ctx = trace.current_context()
+            if ctx:
+                rec["trace"] = ctx
+            rec.update(fields)
+            self._dq.append(rec)
+        self._write_sink(rec)  # disk I/O stays off the ring lock
+        try:
+            registry("obs").counter(
+                "vtpu_events_total",
+                "Journal events emitted by component and type (the ring "
+                "itself is capped by VTPU_EVENT_LOG_CAP)",
+            ).inc(component=component, type=type)
+        except Exception:  # noqa: BLE001 — counting must not break emitters
+            log.debug("event counter failed", exc_info=True)
+        return rec
+
+    def _write_sink(self, rec: dict) -> None:
+        if self.jsonl_path is None or self._sink_dead:
+            return
+        line = json.dumps(rec, default=str) + "\n"
+        with self._sink_lock:
+            try:
+                if self._sink is None:
+                    self._sink = open(self.jsonl_path, "a", encoding="utf-8")
+                self._sink.write(line)
+                self._sink.flush()
+            except OSError:
+                # one warning, then stop trying: a full disk must not
+                # turn every event emit into a failing syscall
+                self._sink_dead = True
+                log.warning("event JSONL sink %s failed; disabling mirror",
+                            self.jsonl_path, exc_info=True)
+
+    # -- query (GET /events) --------------------------------------------
+    def query(
+        self,
+        pod: Optional[str] = None,
+        type: Optional[str] = None,
+        since: Optional[float] = None,
+        n: int = 100,
+    ) -> List[dict]:
+        """Newest-last matching events.  Filters apply before the count
+        cut (like /spans?name=): ``pod`` matches the pod uid, ``type``
+        the event type, ``since`` keeps events with ts >= since."""
+        with self._lock:
+            recs = list(self._dq)
+        if pod:
+            recs = [r for r in recs if r.get("pod") == pod]
+        if type:
+            recs = [r for r in recs if r.get("type") == type]
+        if since is not None:
+            recs = [r for r in recs if r.get("ts", 0) >= since]
+        n = max(0, n)
+        return recs[-n:] if n else []
+
+    def events_body(self, params: dict) -> bytes:
+        """JSON body for ``GET /events?pod=&type=&since=&n=``."""
+        try:
+            n = int(params.get("n", 100))
+        except ValueError:
+            n = 100
+        since: Optional[float] = None
+        if params.get("since"):
+            try:
+                since = float(params["since"])
+            except ValueError:
+                since = None
+        recs = self.query(
+            pod=params.get("pod") or None,
+            type=params.get("type") or None,
+            since=since,
+            n=n,
+        )
+        return json.dumps(
+            {"events": recs, "count": len(recs)}, default=str
+        ).encode()
+
+    # -- Chrome trace merge ---------------------------------------------
+    def chrome_events(self) -> List[dict]:
+        """Instant events (ph="i", global scope) so journal marks render
+        between the spans in chrome://tracing / Perfetto."""
+        with self._lock:
+            recs = list(self._dq)
+        out = []
+        for r in recs:
+            args = {
+                k: v for k, v in r.items() if k not in ("ts", "type")
+            }
+            out.append({
+                "name": r["type"],
+                "ph": "i",
+                "s": "g",
+                "ts": round(float(r["ts"]) * 1e6, 3),
+                "pid": os.getpid(),
+                "cat": "vtpu-event",
+                "args": args,
+            })
+        return out
+
+    def close(self) -> None:
+        with self._sink_lock:
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                except OSError:
+                    pass
+                self._sink = None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._dq)
+
+
+_journal: Optional[EventJournal] = None
+_journal_lock = threading.Lock()
+
+
+def journal() -> EventJournal:
+    """The process-wide journal (created on first use from the env)."""
+    global _journal
+    with _journal_lock:
+        if _journal is None:
+            _journal = EventJournal()
+        return _journal
+
+
+def configure(
+    cap: Optional[int] = None, jsonl_path: Optional[str] = None
+) -> EventJournal:
+    """Replace the process journal (entrypoints with explicit flags, and
+    tests that need a private cap/sink).  The old journal's sink is
+    closed; its ring is dropped."""
+    global _journal
+    with _journal_lock:
+        if _journal is not None:
+            _journal.close()
+        _journal = EventJournal(cap=cap, jsonl_path=jsonl_path)
+        return _journal
+
+
+def emit(
+    type: str, component: str, pod: str = "", node: str = "", **fields
+) -> dict:
+    """Module-level convenience: ``journal().emit(...)``."""
+    return journal().emit(type, component, pod=pod, node=node, **fields)

@@ -1,8 +1,9 @@
 """Shared debug/observability HTTP surface.
 
 One implementation of the ``/spans`` (+ ``?n=`` / ``?name=`` filters),
-``/timeline?pod=<uid>``, ``/trace.json`` (Chrome export) and registry
-``/metrics`` endpoints, used three ways:
+``/timeline?pod=<uid>``, ``/events?pod=&type=&since=`` (the typed event
+journal), ``/readyz`` (deep readiness), ``/trace.json`` (Chrome export)
+and registry ``/metrics`` endpoints, used three ways:
 
 - the scheduler extender's listener (vtpu/scheduler/routes.py) delegates
   its GET debug routes here and adds ``POST /spans/ingest`` (the merged
@@ -57,17 +58,38 @@ def spans_body(params: dict) -> bytes:
 
 def timeline_body(params: dict) -> Optional[bytes]:
     """JSON for /timeline?pod=<uid> (trace id = pod UID); None when the
-    required ``pod`` param is missing."""
+    required ``pod`` param is missing.  The pod's journal events ride
+    along so the span feed and the what-happened record are one view."""
     pod = params.get("pod") or params.get("trace")
     if not pod:
         return None
+    from vtpu.obs import events as events_mod
+
     spans = trace.timeline(pod)
+    evs = events_mod.journal().query(pod=pod, n=events_mod.journal().cap)
     return json.dumps(
-        {"trace_id": pod, "spans": spans, "count": len(spans)}, default=str
+        {"trace_id": pod, "spans": spans, "count": len(spans),
+         "events": evs},
+        default=str,
     ).encode()
 
 
-def handle_debug_get(handler, send, registries: Sequence[str] = ()) -> bool:
+def trace_chrome_body() -> bytes:
+    """/trace.json body: the span export with the event journal's
+    instant marks merged in."""
+    from vtpu.obs import events as events_mod
+
+    doc = json.loads(trace.export_chrome())
+    doc["traceEvents"].extend(events_mod.journal().chrome_events())
+    return json.dumps(doc, default=str).encode()
+
+
+def handle_debug_get(
+    handler,
+    send,
+    registries: Sequence[str] = (),
+    ready_components: Sequence[str] = (),
+) -> bool:
     """Serve one debug GET on any BaseHTTPRequestHandler.
 
     ``send(code, body, ctype)`` is the host handler's writer.  Returns
@@ -84,10 +106,23 @@ def handle_debug_get(handler, send, registries: Sequence[str] = ()) -> bool:
                      "application/json")
             else:
                 send(200, body, "application/json")
+        elif route == "/events":
+            from vtpu.obs import events as events_mod
+
+            send(200, events_mod.journal().events_body(params),
+                 "application/json")
+        elif route == "/readyz" and ready_components:
+            from vtpu.obs.ready import readyz_body
+
+            code, body = readyz_body(ready_components, params)
+            send(code, body, "application/json")
         elif route == "/trace.json":
-            send(200, trace.export_chrome().encode(), "application/json")
+            send(200, trace_chrome_body(), "application/json")
         elif route == "/metrics" and registries:
-            text = "".join(registry(r).render() for r in registries)
+            # the cross-component "obs" registry (event counts, readiness
+            # breakdown) renders once after the named components'
+            names = [r for r in registries if r != "obs"] + ["obs"]
+            text = "".join(registry(r).render() for r in names)
             send(200, text.encode(), "text/plain; version=0.0.4")
         else:
             return False
@@ -98,11 +133,18 @@ def handle_debug_get(handler, send, registries: Sequence[str] = ()) -> bool:
 
 
 def serve_debug(
-    bind: str, registries: Sequence[str] = ()
+    bind: str,
+    registries: Sequence[str] = (),
+    ready_components: Optional[Sequence[str]] = None,
 ) -> Tuple[ThreadingHTTPServer, threading.Thread]:
-    """Standalone debug listener: /healthz, /spans, /timeline,
-    /trace.json, and /metrics rendered from the named obs registries
-    (for daemons with no HTTP server of their own — the device plugin)."""
+    """Standalone debug listener: /healthz, /readyz, /spans, /timeline,
+    /events, /trace.json, and /metrics rendered from the named obs
+    registries (for daemons with no HTTP server of their own — the
+    device plugin).  ``ready_components`` defaults to ``registries`` —
+    the same component names key both the metrics and readiness
+    registries."""
+    if ready_components is None:
+        ready_components = registries
 
     class Handler(BaseHTTPRequestHandler):
         def _send(self, code: int, body: bytes,
@@ -117,7 +159,8 @@ def serve_debug(
             if self.path == "/healthz":
                 self._send(200, b"ok", "text/plain")
                 return
-            if not handle_debug_get(self, self._send, registries):
+            if not handle_debug_get(self, self._send, registries,
+                                    ready_components=ready_components):
                 self._send(404, b"not found", "text/plain")
 
         def log_message(self, fmt, *args):  # quiet

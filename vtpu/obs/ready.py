@@ -1,0 +1,115 @@
+"""Deep readiness: named per-component checks behind one /readyz.
+
+``/healthz`` answers "is the process accepting connections" — which is
+true for a scheduler whose registry poll died an hour ago and a monitor
+whose sampler thread crashed.  This module is the deeper probe: each
+component registers *named* checks (registry-poll age, sampler
+freshness, plugin registration state, thread liveness), ``/readyz`` runs
+them all and answers 200 only when every check passes, and every check's
+state is exported as ``vtpu_ready_check_ok_ratio{check=}`` so a failing
+probe is visible in Prometheus *before* kubelet restarts anything.
+
+A check is a zero-arg callable returning ``True``/``False`` or
+``(ok, detail)``; an exception counts as failing with the exception text
+as detail.  Components register at wiring time (the scheduler in
+``__init__``, the sampler/registrar in ``start()``); registering the
+same name again replaces the check (restart-safe).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from vtpu.obs.registry import registry
+
+__all__ = ["ReadyRegistry", "readiness", "readyz_body"]
+
+Check = Callable[[], object]
+
+
+class ReadyRegistry:
+    """Named readiness checks for one component.
+
+    The per-check gauge lives in the cross-cutting ``obs`` metrics
+    registry keyed by a ``component`` label — one family process-wide,
+    because listeners that concatenate several component registries
+    (the monitor renders ``monitor`` + ``shim``) must never see the
+    same family name twice."""
+
+    def __init__(self, component: str) -> None:
+        self.component = component
+        self._lock = threading.Lock()
+        self._checks: Dict[str, Check] = {}
+        self._gauge = registry("obs").gauge(
+            "vtpu_ready_check_ok_ratio",
+            "1 when the named readiness check passes, 0 when it fails "
+            "(the per-check breakdown behind /readyz)",
+        )
+
+    def register(self, name: str, fn: Check) -> None:
+        with self._lock:
+            self._checks[name] = fn
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            if self._checks.pop(name, None) is not None:
+                self._gauge.remove(component=self.component, check=name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._checks)
+
+    def report(self) -> dict:
+        """Run every check; update the per-check gauges.  A component
+        with no registered checks is trivially ready (matches the old
+        /healthz contract for listeners nobody wired up yet)."""
+        with self._lock:
+            checks = list(self._checks.items())
+        results: Dict[str, dict] = {}
+        all_ok = True
+        for name, fn in sorted(checks):
+            try:
+                out = fn()
+            except Exception as e:  # noqa: BLE001 — a broken check = failing
+                out = (False, f"{type(e).__name__}: {e}")
+            if isinstance(out, tuple):
+                ok, detail = bool(out[0]), str(out[1])
+            else:
+                ok, detail = bool(out), ""
+            results[name] = {"ok": ok}
+            if detail:
+                results[name]["detail"] = detail
+            self._gauge.set(1.0 if ok else 0.0,
+                            component=self.component, check=name)
+            all_ok = all_ok and ok
+        return {"component": self.component, "ok": all_ok, "checks": results}
+
+
+_registries: Dict[str, ReadyRegistry] = {}
+_registries_lock = threading.Lock()
+
+
+def readiness(component: str) -> ReadyRegistry:
+    """The process-wide readiness registry for one component."""
+    with _registries_lock:
+        reg = _registries.get(component)
+        if reg is None:
+            reg = _registries[component] = ReadyRegistry(component)
+        return reg
+
+
+def readyz_body(
+    components: Sequence[str], params: Optional[dict] = None
+) -> Tuple[int, bytes]:
+    """(status code, JSON body) for ``GET /readyz``: 200 when every named
+    check of every listed component passes, 503 otherwise.
+    ``?verbose=`` is accepted but the body is always the full per-check
+    breakdown — kubelet reads the code, humans read the JSON."""
+    reports = {c: readiness(c).report() for c in components}
+    ok = all(r["ok"] for r in reports.values())
+    body = json.dumps(
+        {"ok": ok, "components": reports}, default=str
+    ).encode()
+    return (200 if ok else 503), body

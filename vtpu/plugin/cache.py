@@ -5,6 +5,13 @@ pkg/device-plugin/mlu/cache.go (CNDEV 1 Hz poll, recovers).  We poll the
 provider and notify subscribers on any health transition — recovery
 included, the CNDEV behavior, which the NVIDIA path lacks (FIXME at
 plugin.go:271-272).
+
+Poll-loop hardening: a provider that starts throwing (driver wedged,
+transient PJRT error) must not kill the loop or blank the device list —
+the cache keeps the last-good snapshot, counts the failure on
+``vtpu_plugin_device_poll_failures_total``, journals the start of each
+failure streak (``DevicePollFailed``), and reports the streak through
+the plugin's ``/readyz`` ``device_poll`` check.
 """
 
 from __future__ import annotations
@@ -13,9 +20,12 @@ import dataclasses
 import logging
 import os
 import threading
+import time
 from typing import Callable, Dict, List
 
+from vtpu import obs
 from vtpu.device.chip import Chip
+from vtpu.obs.events import EventType, emit
 
 log = logging.getLogger(__name__)
 
@@ -23,6 +33,16 @@ log = logging.getLogger(__name__)
 # watcher; "all" disables health monitoring entirely).  Any value here
 # disables the poll loop — chips stay at their startup health.
 ENV_DISABLE_HEALTHCHECKS = "VTPU_DISABLE_HEALTHCHECKS"
+
+# consecutive provider failures before the /readyz device_poll check
+# flips: one transient hiccup is not "not ready", a streak is
+FAILURE_STREAK_NOT_READY = 5
+
+_POLL_FAILURES = obs.registry("plugin").counter(
+    "vtpu_plugin_device_poll_failures_total",
+    "Provider health-check calls that raised (the poll loop keeps the "
+    "last-good snapshot and retries next tick)",
+)
 
 
 def _snap(chips: List[Chip]) -> List[Chip]:
@@ -40,6 +60,10 @@ class DeviceCache:
         self._subs: Dict[str, Callable[[List[Chip]], None]] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # poll health, read by the /readyz device_poll check
+        self._consecutive_failures = 0
+        self._last_poll_ok_t: float | None = None
+        self._disabled = False
 
     def chips(self) -> List[Chip]:
         with self._lock:
@@ -56,8 +80,24 @@ class DeviceCache:
             self._subs.pop(name, None)
 
     def _poll_once(self) -> None:
-        fresh = _snap(self.provider.health_check())
+        try:
+            fresh = _snap(self.provider.health_check())
+        except Exception as e:  # noqa: BLE001 — keep the last-good snapshot
+            with self._lock:
+                self._consecutive_failures += 1
+                streak = self._consecutive_failures
+            _POLL_FAILURES.inc()
+            if streak == 1:
+                # journal the streak START only: a dead provider at a 1 s
+                # poll must not write an event per second
+                emit(EventType.DEVICE_POLL_FAILED, "plugin",
+                     error=f"{type(e).__name__}: {e}")
+            log.warning("device health poll failed (streak %d): %s",
+                        streak, e, exc_info=True)
+            return
         with self._lock:
+            self._consecutive_failures = 0
+            self._last_poll_ok_t = time.monotonic()
             old = {c.uuid: c.healthy for c in self._chips}
             changed = [
                 c for c in fresh if old.get(c.uuid) is not None and old[c.uuid] != c.healthy
@@ -75,22 +115,49 @@ class DeviceCache:
                 except Exception:  # noqa: BLE001
                     log.exception("health subscriber failed")
 
+    def poll_status(self) -> tuple:
+        """(ok, detail) for the plugin's ``device_poll`` readiness check."""
+        if self._disabled:
+            return True, "health checks disabled"
+        with self._lock:
+            streak = self._consecutive_failures
+            last_ok = self._last_poll_ok_t
+        t = self._thread
+        if t is None or not t.is_alive():
+            if self._stop.is_set():
+                return False, "poll loop stopped"
+            return False, "poll loop not running"
+        if streak >= FAILURE_STREAK_NOT_READY:
+            return False, f"{streak} consecutive poll failures"
+        if last_ok is None:
+            return True, "no poll completed yet"
+        return True, f"last good poll {time.monotonic() - last_ok:.0f}s ago"
+
     def start(self) -> None:
         if os.environ.get(ENV_DISABLE_HEALTHCHECKS, "") not in ("", "0"):
             log.warning(
                 "health checks disabled (%s set)", ENV_DISABLE_HEALTHCHECKS
             )
+            self._disabled = True
+            self._register_ready_check()
             return
 
         def loop() -> None:
             while not self._stop.wait(self.poll_interval_s):
                 try:
                     self._poll_once()
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — belt-and-braces: the
+                    # per-call guard above already counts provider errors
                     log.exception("health poll failed")
 
         self._thread = threading.Thread(target=loop, name="vtpu-health", daemon=True)
         self._thread.start()
+        self._register_ready_check()
+
+    def _register_ready_check(self) -> None:
+        from vtpu.obs.ready import readiness
+
+        readiness("plugin").register("device_poll", self.poll_status)
 
     def stop(self) -> None:
         self._stop.set()

@@ -13,8 +13,10 @@ from __future__ import annotations
 import datetime
 import logging
 import threading
-from typing import List
+import time
+from typing import List, Optional
 
+from vtpu import obs
 from vtpu.plugin.cache import DeviceCache
 from vtpu.plugin.config import PluginConfig
 from vtpu.utils import codec
@@ -27,6 +29,21 @@ from vtpu.utils.types import (
 )
 
 log = logging.getLogger(__name__)
+
+_REG = obs.registry("plugin")
+_ATTEMPTS = _REG.counter(
+    "vtpu_plugin_register_attempts_total",
+    "Node-annotation registration attempts (the 30 s WatchAndRegister loop)",
+)
+_FAILURES = _REG.counter(
+    "vtpu_plugin_register_failures_total",
+    "Registration attempts that raised (retried after the 5 s backoff)",
+)
+_LAST_SUCCESS = _REG.gauge(
+    "vtpu_plugin_register_last_success_timestamp_seconds",
+    "Wall time of the last successful node-annotation registration "
+    "(flat = the scheduler is expelling this node in ~60 s)",
+)
 
 
 def build_device_infos(
@@ -72,7 +89,12 @@ def register_once(
 
 
 class Registrar:
-    """ref WatchAndRegister register.go:104-115 (30 s loop, 5 s on error)."""
+    """ref WatchAndRegister register.go:104-115 (30 s loop, 5 s on error).
+
+    Instrumented: attempt/failure counters, a last-success wall
+    timestamp gauge, and a ``registration`` /readyz check — a node whose
+    registrar silently stopped re-reporting gets expelled by the
+    scheduler ~60 s later, so the probe must flip *before* that."""
 
     def __init__(
         self, client, cache: DeviceCache, cfg: PluginConfig, chip_filter=None
@@ -83,12 +105,46 @@ class Registrar:
         self.chip_filter = chip_filter
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._last_success_t: Optional[float] = None  # monotonic
+        self._last_error: str = ""
+
+    def register_once(self) -> None:
+        """One counted registration attempt (the loop's body; also the
+        unit tests' direct entrypoint)."""
+        _ATTEMPTS.inc()
+        try:
+            register_once(self.client, self.cache, self.cfg, self.chip_filter)
+        except Exception as e:  # noqa: BLE001 — recorded, then re-raised
+            self._last_error = f"{type(e).__name__}: {e}"
+            _FAILURES.inc()
+            raise
+        self._last_success_t = time.monotonic()
+        self._last_error = ""
+        _LAST_SUCCESS.set(time.time())
+
+    def registration_status(self) -> tuple:
+        """(ok, detail) for the plugin's ``registration`` readiness
+        check: a success within ~2 registration intervals."""
+        t = self._thread
+        if t is None or not t.is_alive():
+            if self._stop.is_set():
+                return False, "registrar stopped"
+            return False, "registrar not running"
+        if self._last_success_t is None:
+            return False, self._last_error or "no registration succeeded yet"
+        age = time.monotonic() - self._last_success_t
+        if age > 2 * REGISTER_INTERVAL_S:
+            return False, (
+                f"last successful registration {age:.0f}s ago"
+                + (f" ({self._last_error})" if self._last_error else "")
+            )
+        return True, f"last successful registration {age:.0f}s ago"
 
     def start(self) -> None:
         def loop() -> None:
             while not self._stop.is_set():
                 try:
-                    register_once(self.client, self.cache, self.cfg, self.chip_filter)
+                    self.register_once()
                     delay = REGISTER_INTERVAL_S
                 except Exception:  # noqa: BLE001
                     log.exception("node registration failed; retrying")
@@ -97,6 +153,9 @@ class Registrar:
 
         self._thread = threading.Thread(target=loop, name="vtpu-registrar", daemon=True)
         self._thread.start()
+        from vtpu.obs.ready import readiness
+
+        readiness("plugin").register("registration", self.registration_status)
 
     def stop(self) -> None:
         self._stop.set()

@@ -36,6 +36,7 @@ import grpc
 from vtpu import obs
 from vtpu.device.allocator import AllocationError, IciAllocator
 from vtpu.k8s.objects import get_annotations
+from vtpu.obs.events import EventType, emit
 from vtpu.plugin import api
 from vtpu.plugin import v1beta1_pb2 as pb
 from vtpu.plugin.cache import DeviceCache
@@ -337,8 +338,17 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
         except Exception as e:  # noqa: BLE001 — any failure must unwind the handshake
             log.exception("Allocate failed")
             alloc_util.pod_allocation_failed(self.client, pending)
+            emit(EventType.ALLOCATE_FAILED, "plugin",
+                 pod=pending["metadata"].get("uid", ""),
+                 node=self.cfg.node_name,
+                 name=pending["metadata"].get("name", ""), error=str(e))
             context.abort(grpc.StatusCode.INTERNAL, f"vtpu allocate: {e}")
         alloc_util.pod_allocation_try_success(self.client, pending)
+        emit(EventType.ALLOCATE_SERVED, "plugin",
+             pod=pending["metadata"].get("uid", ""),
+             node=self.cfg.node_name,
+             name=pending["metadata"].get("name", ""),
+             devices=[cd.uuid for cd in devs])
         return resp
 
     def stop(self) -> None:

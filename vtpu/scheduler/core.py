@@ -17,6 +17,8 @@ from typing import Dict, List, Optional, Tuple
 
 from vtpu import obs
 from vtpu.k8s.objects import get_annotations, pod_uid
+from vtpu.obs.events import EventType, emit
+from vtpu.obs.ready import readiness
 from vtpu.scheduler import nodecheck
 from vtpu.scheduler import score as score_mod
 from vtpu.scheduler.config import SchedulerConfig
@@ -130,6 +132,35 @@ class Scheduler:
         # node objects cached by the 15 s registry poll — node-validity
         # checks read these instead of issuing per-Filter API GETs
         self._node_objs: Dict[str, dict] = {}
+        # monotonic time of the last *successful* registry poll — the
+        # /readyz "registry_poll" check compares it against the poll
+        # interval (a wedged poll leaves the whole scheduler blind)
+        self.last_registry_poll_t: Optional[float] = None
+        # reconciliation auditor (vtpu/audit): GET /audit runs a pass on
+        # demand; run_background_loops starts the periodic loop
+        from vtpu.audit import ClusterAuditor
+
+        self.auditor = ClusterAuditor(self)
+        self._register_ready_checks()
+
+    def _register_ready_checks(self) -> None:
+        """Deep-readiness checks behind GET /readyz (vtpu/obs/ready)."""
+
+        def registry_poll_check():
+            t = self.last_registry_poll_t
+            if t is None:
+                return False, "no registry poll completed yet"
+            age = time.monotonic() - t
+            if age > 3 * REGISTRY_POLL_INTERVAL_S:
+                return False, f"last registry poll {age:.0f}s ago"
+            return True, f"last registry poll {age:.0f}s ago"
+
+        readiness("scheduler").register("registry_poll", registry_poll_check)
+
+    def node_objects(self) -> Dict[str, dict]:
+        """The registry poll's cached Node objects (annotations incl.
+        handshake timestamps) — read by the auditor's staleness checks."""
+        return dict(self._node_objs)
 
     # ------------------------------------------------------------------
     # Registry: node annotations → device state (ref scheduler.go:143-229)
@@ -174,6 +205,9 @@ class Scheduler:
                     if ts is None or (now - ts).total_seconds() > HANDSHAKE_TIMEOUT_S:
                         # plugin stopped re-reporting → expel devices
                         log.warning("node %s: handshake timeout; expelling devices", name)
+                        emit(EventType.NODE_STALE, "scheduler", node=name,
+                             annotation=handshake_anno,
+                             detail="handshake timeout; expelling devices")
                         self.nodes.rm_node_devices(name, source=handshake_anno)
                         self.client.patch_node_annotations(
                             name,
@@ -181,6 +215,7 @@ class Scheduler:
                         )
                 elif hs.startswith(HandshakeState.DELETED):
                     continue
+        self.last_registry_poll_t = time.monotonic()
 
     def _sync_pods(self, pods: list) -> None:
         """Full reconcile from a complete pod list (shared by the poll
@@ -288,9 +323,12 @@ class Scheduler:
                 self._stop.wait(REGISTRY_POLL_INTERVAL_S)
 
         threading.Thread(target=loop, name="vtpu-registry", daemon=True).start()
+        # periodic reconciliation (VTPU_AUDIT_INTERVAL_S; ≤ 0 disables)
+        self.auditor.start()
 
     def stop(self) -> None:
         self._stop.set()
+        self.auditor.stop(timeout=0.1)
 
     # ------------------------------------------------------------------
     # Usage aggregation (ref getNodesUsage scheduler.go:348-400)
@@ -473,6 +511,12 @@ class Scheduler:
                     n: measured[n] for n in verdicts if n in measured
                 },
                 elapsed_ms=round((time.perf_counter() - t_filter) * 1e3, 3),
+            )
+            emit(
+                EventType.POD_FILTERED, "scheduler",
+                pod=uid, node=res.node or "",
+                name=pod.get("metadata", {}).get("name", ""),
+                path=path, error=res.error, rejected=len(res.failed),
             )
             return res
 
@@ -697,6 +741,12 @@ class Scheduler:
             finally:
                 _BIND_HIST.observe(time.perf_counter() - t0)
             sp["error"] = err or ""
+            if err:
+                emit(EventType.BIND_FAILED, "scheduler", pod=pod_uid,
+                     node=node, name=name, error=err)
+            else:
+                emit(EventType.POD_BOUND, "scheduler", pod=pod_uid,
+                     node=node, name=name)
             return err
 
     def _bind_inner(

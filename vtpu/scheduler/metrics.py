@@ -272,4 +272,8 @@ def render_metrics(sched: Scheduler, include_obs: bool = True) -> str:
     if not include_obs:
         return legacy
     _update_capacity_gauges(sched, usage)
-    return legacy + obs.registry("scheduler").render()
+    # "obs" carries the cross-component families (event counts, readiness
+    # breakdown) — rendered once, after this component's own registry
+    return (legacy
+            + obs.registry("scheduler").render()
+            + obs.registry("obs").render())

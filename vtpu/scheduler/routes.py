@@ -8,6 +8,11 @@ wire contract:
   POST /webhook  AdmissionReview                     → AdmissionReview
   GET  /metrics  Prometheus text (ref cmd/scheduler/metrics.go)
   GET  /healthz
+  GET  /readyz   deep readiness (named checks, vtpu/obs/ready)
+
+plus the debug surface on the plain listener: /spans, /timeline,
+/trace.json, /decisions, /events (the typed journal) and /audit (the
+reconciliation verdict report, vtpu/audit).
 
 Served by a stdlib ThreadingHTTPServer; the extender is pure
 request/response over in-memory state, so no framework is needed.
@@ -98,6 +103,35 @@ class _Handler(BaseHTTPRequestHandler):
         route = self.path.split("?", 1)[0]
         if self.path == "/healthz":
             self._send(200, b"ok", "text/plain")
+        elif route == "/readyz":
+            # deep readiness (vtpu/obs/ready): named checks, 503 on any
+            # failure — served on every listener like /healthz (kubelet
+            # probes whichever port the chart wires)
+            from vtpu.obs.http import split_query
+            from vtpu.obs.ready import readyz_body
+
+            _, params = split_query(self.path)
+            code, body = readyz_body(("scheduler",), params)
+            self._send(code, body)
+        elif self.allow_debug and route == "/audit":
+            # reconciliation verdicts (vtpu/audit): per-node drift report
+            from vtpu.obs.http import split_query
+
+            _, params = split_query(self.path)
+            try:
+                body = self.scheduler.auditor.report_body(params)
+            except Exception as e:  # noqa: BLE001
+                log.exception("audit pass failed")
+                self._send(500, str(e).encode(), "text/plain")
+                return
+            self._send(200, body)
+        elif self.allow_debug and route == "/events":
+            # the typed event journal (vtpu/obs/events)
+            from vtpu.obs.events import journal
+            from vtpu.obs.http import split_query
+
+            _, params = split_query(self.path)
+            self._send(200, journal().events_body(params))
         elif self.allow_debug and route == "/decisions":
             # placement-decision audit log: per-node verdicts (reject
             # reason or score breakdown + chosen placement) for every

@@ -16,6 +16,7 @@ import time
 from typing import Dict, List, Optional
 
 from vtpu.k8s.objects import get_annotations, pod_uid
+from vtpu.obs.events import EventType, emit
 from vtpu.utils import codec
 from vtpu.utils.types import BindPhase, ChipInfo, PodDevices, annotations
 
@@ -106,6 +107,10 @@ class NodeManager:
                 return
             for li in self._listeners:
                 li.on_node_changed(name, info.devices, info.topology)
+            # journaled only on REAL changes (the 30 s re-report dedups
+            # above), so the ring records registry churn, not heartbeats
+            emit(EventType.NODE_REGISTERED, "scheduler", node=name,
+                 source=source, devices=len(info.devices))
 
     def rm_node_devices(self, name: str, source: Optional[str] = None) -> None:
         """Expel one family's devices (handshake timeout is per-vendor) or
@@ -115,10 +120,14 @@ class NodeManager:
                 if self._nodes.pop(name, None) is not None:
                     for li in self._listeners:
                         li.on_node_removed(name)
+                    emit(EventType.NODE_EXPELLED, "scheduler", node=name,
+                         source="all")
                 return
             info = self._nodes.get(name)
             if info is None:
                 return
+            if source not in info.by_source:
+                return  # nothing registered from this family: no event
             info.by_source.pop(source, None)
             info.devices = [d for devs in info.by_source.values() for d in devs]
             if not info.devices:
@@ -128,6 +137,8 @@ class NodeManager:
             else:
                 for li in self._listeners:
                     li.on_node_changed(name, info.devices, info.topology)
+            emit(EventType.NODE_EXPELLED, "scheduler", node=name,
+                 source=source, devices=len(info.devices))
 
     def get(self, name: str) -> Optional[NodeInfo]:
         with self._lock:

@@ -223,6 +223,16 @@ class UsageCache:
             b = self._bookings.get(uid)
             return b.node if b is not None else None
 
+    def bookings_snapshot(self) -> Dict[str, Tuple[str, PodDevices]]:
+        """``{pod uid: (node, devices)}`` — the cache's booking ledger,
+        as the reconciliation auditor (vtpu/audit) cross-checks it
+        against the live pod set.  Shallow copies: callers read, never
+        mutate the ContainerDevice entries."""
+        with self._lock:
+            return {
+                uid: (b.node, b.devices) for uid, b in self._bookings.items()
+            }
+
     def peek_entry(
         self, name: str
     ) -> Optional[Tuple[NodeUsage, int, float]]:

@@ -316,13 +316,35 @@ def _matrix_main(dev, platform: str) -> None:
 def main() -> None:
     # backend init can hang forever when the chip's sessions are
     # saturated; die loudly instead so the orchestrator can retry
+    import logging
     import threading
 
+    # shared obs bootstrap: the watchdog line must come out through the
+    # same (optionally JSON) pipeline as every other daemon's logs, not
+    # a bare stderr print nobody's shipper parses
+    from vtpu.obs.logsetup import setup_logging
+
+    setup_logging()
+    log = logging.getLogger("vtpu.shim.native_tenant")
     inited = threading.Event()
 
     def watchdog():
-        if not inited.wait(float(os.environ.get("VTPU_TENANT_INIT_TIMEOUT", "300"))):
-            print("native_tenant: backend init watchdog fired", file=sys.stderr)
+        timeout = float(os.environ.get("VTPU_TENANT_INIT_TIMEOUT", "300"))
+        if not inited.wait(timeout):
+            from vtpu import obs
+
+            # the log line is the durable record — the process dies
+            # before any scrape; the counter only surfaces when a
+            # harness drives this worker in-process (bench/test rigs)
+            obs.registry("shim").counter(
+                "vtpu_shim_init_watchdog_fired_total",
+                "Backend-init watchdogs that fired (tenant exited 12: "
+                "PJRT init hung past VTPU_TENANT_INIT_TIMEOUT)",
+            ).inc()
+            log.error(
+                "backend init watchdog fired after %.0fs; exiting 12",
+                timeout,
+            )
             os._exit(12)
 
     threading.Thread(target=watchdog, daemon=True).start()
