@@ -7,7 +7,8 @@ IMG ?= vtpu/vtpu
 PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
-	bench-sched bench-serve obs-lint audit-check image chart clean tidy
+	bench-sched bench-serve bench-churn obs-lint audit-check image chart \
+	clean tidy
 
 all: build
 
@@ -139,6 +140,19 @@ bench:
 # explains how to read the before/after numbers.
 bench-sched:
 	$(PY) benchmarks/scheduler_scale.py --nodes 1000 --pods 200
+
+# control-plane churn proof: 10k nodes, open-loop pod arrival under node
+# churn, global-lock vs optimistic-CAS vs 1/2/4 sharded-replica-process
+# arms, zero-drift audit of every end state → docs/artifacts/
+# scheduler_churn.json (docs/scheduler_perf.md §Sharded replicas explains
+# the numbers).  SMOKE=1 runs a seconds-long ≤200-node schema/SLO sanity
+# pass (tier-1 safe; also exercised by tests/test_churn.py).
+bench-churn:
+ifdef SMOKE
+	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_churn.py --smoke
+else
+	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_churn.py
+endif
 
 # serving decode-loop proof: paired pipeline_depth=0 vs pipelined runs
 # of both continuous-batching engines, locally and behind the simulated

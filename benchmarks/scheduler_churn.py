@@ -1,0 +1,674 @@
+#!/usr/bin/env python3
+"""Control-plane churn harness (``make bench-churn``): 10k nodes, open-loop
+pod arrival from M concurrent filter threads, nodes joining/dying mid-run,
+and four control-plane arms measured at the SAME target arrival rate:
+
+  global_lock   the pre-CAS escape hatch: every select→book serialised
+                under one global lock (SchedulerConfig(optimistic_booking
+                =False)) — the baseline the acceptance SLO compares against
+  cas           one replica, lock-free selection + per-node CAS commit
+                (UsageCache.try_book).  Same single-process capacity as
+                the baseline (the walk is Python; one process = one core)
+                but conflicts now retry/abort instead of force-booking —
+                the correctness substrate sharding needs.
+  shard_N       N extender replica PROCESSES with consistent-hash node
+                ownership (vtpu/scheduler/shard.py HashRing): the driver
+                is the merge layer — fan out subset evaluation, merge,
+                CAS-commit at the winner's owner, write the assignment
+                annotation to the authoritative bus.  True parallelism:
+                each replica walks only its ~nodes/N subset.
+
+Load model is OPEN-LOOP: a fixed arrival schedule (rate calibrated from a
+solo filter walk, default 1.5× one replica's capacity) and latency
+measured from *scheduled arrival* to completion — saturation shows up
+honestly as queueing in p99 instead of being hidden by closed-loop
+back-pressure.  The committed SLO record (docs/artifacts/
+scheduler_churn.json): p50/p99 filter latency, CAS conflict/retry/abort
+counts, bind-success ratio, and a ZERO-DRIFT verdict from the cluster
+auditor over the end state — for the sharded arms the audit runs on a
+FRESH scheduler cold-started from the annotation bus, which is exactly
+the failover-rebuild story (a failed-over replica converges to the
+ledger the run left behind).
+
+Usage: python benchmarks/scheduler_churn.py [--nodes 10000] [--threads 4]
+       [--duration 20] [--rate-factor 1.5] [--arms ...] [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc
+import json
+import multiprocessing as mp
+import multiprocessing.connection as mpc
+import os
+import statistics
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks.scheduler_scale import (  # noqa: E402
+    node_chips,
+    pct,
+    register_bench_node,
+)
+from vtpu.k8s import FakeClient, new_pod  # noqa: E402
+from vtpu.scheduler import Scheduler, SchedulerConfig  # noqa: E402
+from vtpu.scheduler.shard import HashRing  # noqa: E402
+from vtpu.utils.types import annotations, resources  # noqa: E402
+
+SCHEMA = "vtpu.scheduler_churn.v1"
+CHIPS_PER_NODE = 8
+CHURN_INTERVAL_S = 0.05   # one node join/death per 50 ms
+CHURN_POOL_FRACTION = 0.05
+KEEP_PODS_PER_THREAD = 50  # older placed pods are deleted (pod churn)
+COMMIT_RETRIES = 8
+
+
+def pod_for(tag: str, i: int) -> dict:
+    return new_pod(
+        f"churn-{tag}-{i:06d}",
+        containers=[{"name": "main", "resources": {"limits": {
+            resources.chip: 1,
+            resources.memory: 4096,
+            resources.cores: 25,
+        }}}],
+    )
+
+
+def build_client(n_nodes: int) -> FakeClient:
+    client = FakeClient()
+    for n in range(n_nodes):
+        register_bench_node(client, f"node-{n:04d}", CHIPS_PER_NODE)
+    return client
+
+
+def node_names(n_nodes: int):
+    return [f"node-{n:04d}" for n in range(n_nodes)]
+
+
+def calibrate_solo_ms(n_nodes: int) -> float:
+    """Median latency of one warm filter walk on an idle single replica —
+    the unit the open-loop arrival rate is derived from."""
+    client = build_client(n_nodes)
+    sched = Scheduler(client)
+    sched.register_from_node_annotations()
+    names = node_names(n_nodes)
+    lat = []
+    for i in range(12):
+        t0 = time.perf_counter()
+        pod = client.create_pod(pod_for("cal", i))
+        res = sched.filter(pod, names)
+        if i >= 2:  # skip cold-cache rebuild calls
+            lat.append((time.perf_counter() - t0) * 1e3)
+        assert res.node is not None, res.error
+    return statistics.median(lat)
+
+
+def _freeze_heap() -> None:
+    """Move the setup-time object graph (a 10k-node registry is millions
+    of objects) out of the cyclic GC's reach: without this, periodic
+    gen-2 collections freeze a serving process for hundreds of ms and
+    show up as multi-second p99 spikes that have nothing to do with the
+    control-plane design under test.  Request-time garbage stays
+    refcounted/young-gen as usual — standard long-lived-server hygiene."""
+    gc.collect()
+    gc.freeze()
+
+
+def audit_summary(sched: Scheduler) -> dict:
+    rep = sched.auditor.audit_once()
+    return {
+        "ok": bool(rep["ok"]) and not rep.get("degraded"),
+        "summary": rep["summary"],
+    }
+
+
+class _ArrivalSchedule:
+    """Open-loop arrivals: thread k owns arrivals k, k+M, k+2M … at the
+    common rate; latency is measured from the scheduled instant."""
+
+    def __init__(self, rate_fps: float, threads: int, duration_s: float):
+        self.interval = threads / rate_fps
+        self.threads = threads
+        self.duration = duration_s
+
+
+def _drive_open_loop(schedule: _ArrivalSchedule, one_filter, tag: str):
+    """Run the open-loop load; ``one_filter(thread_idx, j) -> bool``
+    returns placement success.  Returns (latencies_ms, attempts, placed,
+    dropped).  A saturated arm accumulates backlog (lateness IS the p99
+    story); the runtime cap at 3× duration bounds the run, and arrivals
+    it never got to are reported as ``dropped`` (they are unserved load,
+    not failures)."""
+    lat_ms = []
+    lock = threading.Lock()
+    attempts = [0]
+    placed = [0]
+    dropped = [0]
+    cap_s = schedule.duration * 3 + 5.0
+
+    def worker(k: int) -> None:
+        t_start = time.perf_counter()
+        j = 0
+        my_lat = []
+        my_attempts = 0
+        my_placed = 0
+        my_dropped = 0
+        while True:
+            t_sched = j * schedule.interval
+            if t_sched >= schedule.duration:
+                break
+            now = time.perf_counter() - t_start
+            if now > cap_s:
+                # runtime cap: everything still scheduled is backlog the
+                # arm never served at this arrival rate
+                my_dropped += int(
+                    (schedule.duration - t_sched) / schedule.interval
+                ) + 1
+                break
+            if now < t_sched:
+                time.sleep(t_sched - now)
+            ok = one_filter(k, j)
+            my_lat.append(((time.perf_counter() - t_start) - t_sched) * 1e3)
+            my_attempts += 1
+            my_placed += ok
+            j += 1
+        with lock:
+            lat_ms.extend(my_lat)
+            attempts[0] += my_attempts
+            placed[0] += my_placed
+            dropped[0] += my_dropped
+
+    threads = [
+        threading.Thread(target=worker, args=(k,), name=f"drive-{tag}-{k}")
+        for k in range(schedule.threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return lat_ms, attempts[0], placed[0], dropped[0]
+
+
+def _lat_stats(
+    lat_ms, attempts: int, placed: int, elapsed_s: float, dropped: int = 0
+) -> dict:
+    return {
+        "attempts": attempts,
+        "placed": placed,
+        "dropped_backlog": dropped,
+        "bind_success_ratio": round(placed / attempts, 5) if attempts else 0.0,
+        "filter_p50_ms": round(pct(lat_ms, 0.50), 2) if lat_ms else 0.0,
+        "filter_p99_ms": round(pct(lat_ms, 0.99), 2) if lat_ms else 0.0,
+        "filter_mean_ms": round(statistics.fmean(lat_ms), 2) if lat_ms else 0.0,
+        "throughput_fps": round(attempts / elapsed_s, 1) if elapsed_s else 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Single-process arms (global_lock baseline + cas)
+# ---------------------------------------------------------------------------
+
+def run_single_arm(
+    arm: str, n_nodes: int, threads: int, duration_s: float, rate_fps: float,
+) -> dict:
+    optimistic = arm != "global_lock"
+    client = build_client(n_nodes)
+    sched = Scheduler(client, SchedulerConfig(optimistic_booking=optimistic))
+    sched.register_from_node_annotations()
+    _freeze_heap()
+    names = node_names(n_nodes)
+    pool = names[-max(2, int(n_nodes * CHURN_POOL_FRACTION)):]
+    stop_churn = threading.Event()
+    churn_events = [0]
+
+    def churn() -> None:
+        alive = {n: True for n in pool}
+        i = 0
+        while not stop_churn.wait(CHURN_INTERVAL_S):
+            name = pool[i % len(pool)]
+            i += 1
+            if alive[name]:
+                sched.nodes.rm_node_devices(name, source=None)
+                client.delete_node(name)
+            else:
+                register_bench_node(client, name, CHIPS_PER_NODE)
+                sched.nodes.add_node(
+                    name, node_chips(name, CHIPS_PER_NODE), topology="2x4x1",
+                    source=annotations.NODE_HANDSHAKE,
+                )
+            alive[name] = not alive[name]
+            churn_events[0] += 1
+
+    retired = [list() for _ in range(threads)]
+
+    def one_filter(k: int, j: int) -> bool:
+        pod = client.create_pod(pod_for(f"{arm}-t{k}", j))
+        res = sched.filter(pod, names)
+        if res.node is not None:
+            mine = retired[k]
+            mine.append((pod["metadata"]["uid"], pod["metadata"]["name"]))
+            if len(mine) > KEEP_PODS_PER_THREAD:
+                uid, name = mine.pop(0)
+                client.delete_pod("default", name)
+                sched.pods.rm_pod(uid)
+            return True
+        return False
+
+    churn_t = threading.Thread(target=churn, name=f"churn-{arm}")
+    churn_t.start()
+    t0 = time.perf_counter()
+    lat_ms, attempts, placed, dropped = _drive_open_loop(
+        _ArrivalSchedule(rate_fps, threads, duration_s), one_filter, arm
+    )
+    elapsed = time.perf_counter() - t0
+    stop_churn.set()
+    churn_t.join()
+    stats = sched.usage_cache.stats()
+    out = _lat_stats(lat_ms, attempts, placed, elapsed, dropped)
+    out.update({
+        "arm": arm,
+        "replicas": 1,
+        "optimistic_booking": optimistic,
+        "churn_events": churn_events[0],
+        "cas_conflicts": stats["cas_conflicts"],
+        "cas_retries": sched.filter_gen_retries,
+        "cas_conflict_rate": round(
+            stats["cas_conflicts"] / attempts, 5) if attempts else 0.0,
+        "patch_locks": sched.patch_lock_stats(),
+        "audit": audit_summary(sched),
+    })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sharded arms: N replica processes, the driver is the merge layer
+# ---------------------------------------------------------------------------
+
+class _NullPatchClient:
+    """Replica-side client: assignment durability is the DRIVER's job (it
+    owns the authoritative annotation bus), so the replica's patch is a
+    local no-op — mirroring an owner whose patch path is mocked out."""
+
+    def patch_pod_annotations(self, namespace, name, annos):
+        return {}
+
+
+def _replica_main(node_specs, conn_list) -> None:
+    sched = Scheduler(_NullPatchClient())
+    for name in node_specs:
+        sched.nodes.add_node(
+            name, node_chips(name, CHIPS_PER_NODE), topology="2x4x1"
+        )
+    _freeze_heap()
+    open_conns = list(conn_list)
+    # commit-priority event loop: subset evals are the long operations
+    # (tens of ms at 10k nodes) and the loop is serial, so a commit (a
+    # single-node re-evaluation) queued behind three other clients' evals
+    # would double every filter's latency.  Cheap ops (commit, churn,
+    # pod deletes) run immediately; evals park in a queue and run one at
+    # a time, re-polling the pipes between each.
+    pending_evals = []
+    while open_conns:
+        try:
+            ready = mpc.wait(open_conns, timeout=0 if pending_evals else 5.0)
+        except OSError:
+            return
+        for conn in ready:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                open_conns.remove(conn)
+                continue
+            op = msg[0]
+            if op == "eval":
+                pending_evals.append((conn, msg))
+            elif op == "commit":
+                conn.send(sched.shard_commit(msg[1], msg[2], msg[3]))
+            elif op == "add_node":
+                sched.nodes.add_node(
+                    msg[1], node_chips(msg[1], CHIPS_PER_NODE),
+                    topology="2x4x1",
+                )
+                conn.send(("ok",))
+            elif op == "rm_node":
+                sched.nodes.rm_node_devices(msg[1], source=None)
+                conn.send(("ok",))
+            elif op == "rm_pod":
+                sched.pods.rm_pod(msg[1])
+                conn.send(("ok",))
+            elif op == "stats":
+                st = sched.usage_cache.stats()
+                st["patch_locks"] = sched.patch_lock_stats()
+                conn.send(st)
+            elif op == "stop":
+                conn.send(("bye",))
+                open_conns.remove(conn)
+                if not open_conns:
+                    return
+        if pending_evals:
+            conn, msg = pending_evals.pop(0)
+            if conn in open_conns:
+                conn.send(sched.shard_evaluate(msg[1], None))
+
+
+def run_sharded_arm(
+    replicas: int, n_nodes: int, threads: int, duration_s: float,
+    rate_fps: float,
+) -> dict:
+    arm = f"shard_{replicas}"
+    client = build_client(n_nodes)
+    names = node_names(n_nodes)
+    rids = [f"r{i}" for i in range(replicas)]
+    ring = HashRing(rids)
+    owned = {rid: [] for rid in rids}
+    for n in names:
+        owned[ring.owner(n)].append(n)
+
+    # one pipe per (client thread, replica): the replica event loop is
+    # serial per process; client threads never share a connection
+    n_clients = threads + 1  # +1 for the churn thread
+    conns = [[None] * replicas for _ in range(n_clients)]
+    replica_conns = [[] for _ in range(replicas)]
+    for c in range(n_clients):
+        for r in range(replicas):
+            a, b = mp.Pipe()
+            conns[c][r] = a
+            replica_conns[r].append(b)
+    procs = [
+        mp.Process(
+            target=_replica_main, args=(owned[rids[r]], replica_conns[r]),
+            name=f"vtpu-replica-{rids[r]}", daemon=True,
+        )
+        for r in range(replicas)
+    ]
+    for p in procs:
+        p.start()
+    for r in range(replicas):
+        for b in replica_conns[r]:
+            b.close()  # driver side: children own them now
+    _freeze_heap()  # the driver holds the 10k-node authoritative client
+
+    pool = names[-max(2, int(n_nodes * CHURN_POOL_FRACTION)):]
+    stop_churn = threading.Event()
+    churn_events = [0]
+
+    def churn() -> None:
+        my = conns[threads]
+        alive = {n: True for n in pool}
+        i = 0
+        while not stop_churn.wait(CHURN_INTERVAL_S):
+            name = pool[i % len(pool)]
+            i += 1
+            r = rids.index(ring.owner(name))
+            if alive[name]:
+                my[r].send(("rm_node", name))
+                my[r].recv()
+                client.delete_node(name)
+            else:
+                register_bench_node(client, name, CHIPS_PER_NODE)
+                my[r].send(("add_node", name))
+                my[r].recv()
+            alive[name] = not alive[name]
+            churn_events[0] += 1
+
+    conflicts = [0]
+    conflicts_lock = threading.Lock()
+    retired = [list() for _ in range(threads)]
+
+    def one_filter(k: int, j: int) -> bool:
+        my = conns[k]
+        pod = client.create_pod(pod_for(f"{arm}-t{k}", j))
+        for c in my:
+            c.send(("eval", pod))
+        bests = {}
+        for r, c in enumerate(my):
+            rep = c.recv()
+            b = rep.get("best")
+            if b:
+                bests[r] = b
+        retries = 0
+        while bests and retries <= COMMIT_RETRIES:
+            r = max(bests, key=lambda x: (bests[x]["score"], bests[x]["node"]))
+            b = bests[r]
+            my[r].send(("commit", pod, b["node"], b["gen"]))
+            rep = my[r].recv()
+            if rep.get("status") == "ok":
+                if rep.get("stale_gen"):
+                    # the owner absorbed a stale generation (re-evaluated
+                    # fresh and CAS-committed) — count it as a conflict
+                    with conflicts_lock:
+                        conflicts[0] += 1
+                # the merge layer writes the assignment to the
+                # authoritative bus — the record the failover audit reads
+                client.patch_pod_annotations(
+                    "default", pod["metadata"]["name"], {
+                        annotations.ASSIGNED_NODE: rep["node"],
+                        annotations.ASSIGNED_IDS: rep["enc"],
+                        annotations.DEVICES_TO_ALLOCATE: rep["enc"],
+                    },
+                )
+                mine = retired[k]
+                mine.append(
+                    (pod["metadata"]["uid"], pod["metadata"]["name"], r)
+                )
+                if len(mine) > KEEP_PODS_PER_THREAD:
+                    uid, name, owner_r = mine.pop(0)
+                    client.delete_pod("default", name)
+                    my[owner_r].send(("rm_pod", uid))
+                    my[owner_r].recv()
+                return True
+            retries += 1
+            with conflicts_lock:
+                conflicts[0] += 1
+            my[r].send(("eval", pod))
+            rep = my[r].recv()
+            b = rep.get("best")
+            if b:
+                bests[r] = b
+            else:
+                bests.pop(r, None)
+        return False
+
+    churn_t = threading.Thread(target=churn, name=f"churn-{arm}")
+    churn_t.start()
+    t0 = time.perf_counter()
+    lat_ms, attempts, placed, dropped = _drive_open_loop(
+        _ArrivalSchedule(rate_fps, threads, duration_s), one_filter, arm
+    )
+    elapsed = time.perf_counter() - t0
+    stop_churn.set()
+    churn_t.join()
+    replica_stats = []
+    for r in range(replicas):
+        conns[0][r].send(("stats",))
+        replica_stats.append(conns[0][r].recv())
+    for c in range(n_clients):
+        for r in range(replicas):
+            try:
+                conns[c][r].send(("stop",))
+            except (BrokenPipeError, OSError):
+                pass
+    for p in procs:
+        p.join(timeout=5.0)
+        if p.is_alive():
+            p.terminate()
+
+    # failover oracle: a FRESH scheduler cold-starts from the annotation
+    # bus the run left behind and the auditor must find zero drift
+    rebuilt = Scheduler(client)
+    rebuilt.register_from_node_annotations()
+    rebuilt.ingest_pods()
+    out = _lat_stats(lat_ms, attempts, placed, elapsed, dropped)
+    total_conflicts = (
+        sum(s["cas_conflicts"] for s in replica_stats) + conflicts[0]
+    )
+    out.update({
+        "arm": arm,
+        "replicas": replicas,
+        "optimistic_booking": True,
+        "churn_events": churn_events[0],
+        "cas_conflicts": total_conflicts,
+        "cas_retries": conflicts[0],
+        "cas_conflict_rate": round(
+            total_conflicts / attempts, 5
+        ) if attempts else 0.0,
+        "owned_nodes": {rids[r]: len(owned[rids[r]]) for r in range(replicas)},
+        "audit": audit_summary(rebuilt),
+    })
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+
+def git_rev() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def run_bench(
+    n_nodes: int, threads: int, duration_s: float, rate_factor: float,
+    arms, out_path=None,
+) -> dict:
+    solo_ms = calibrate_solo_ms(n_nodes)
+    # phase 0: measure the BASELINE's churn-loaded capacity directly —
+    # a short saturation run (arrival far above anything it can serve)
+    # whose throughput IS the capacity.  The idle solo walk is too noisy
+    # a proxy: under churn + M threads a single process serves ~0.7x of
+    # it, and a rate that misses the window between the single-process
+    # and sharded capacities tells no story at all.
+    probe_s = max(2.0, min(6.0, duration_s))
+    print("[bench-churn] probing global-lock capacity …", flush=True)
+    probe = run_single_arm(
+        "global_lock", n_nodes, threads, probe_s, 3.0 / (solo_ms / 1e3)
+    )
+    base_capacity = probe["throughput_fps"]
+    rate_fps = rate_factor * base_capacity
+    res = {
+        "schema": SCHEMA,
+        "meta": {
+            "commit": git_rev(),
+            "measured": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "nodes": n_nodes,
+            "chips_per_node": CHIPS_PER_NODE,
+            "threads": threads,
+            "duration_s": duration_s,
+            "rate_factor": rate_factor,
+            "rate_fps": round(rate_fps, 1),
+            "solo_filter_ms": round(solo_ms, 2),
+            "base_capacity_fps": round(base_capacity, 1),
+            "cpus": os.cpu_count(),
+            "replica_arms": [a for a in arms if a.startswith("shard_")],
+            "note": (
+                "open-loop arrival at rate_factor x the global-lock "
+                "baseline's measured churn-loaded capacity; latency "
+                "measured from scheduled arrival, so an arm that cannot "
+                "sustain the rate shows its backlog in p99 (the "
+                "production-honest view of saturation)"
+            ),
+        },
+        "arms": {},
+    }
+    for arm in arms:
+        print(f"[bench-churn] arm {arm} …", flush=True)
+        if arm.startswith("shard_"):
+            r = int(arm.split("_", 1)[1])
+            res["arms"][arm] = run_sharded_arm(
+                r, n_nodes, threads, duration_s, rate_fps
+            )
+        else:
+            res["arms"][arm] = run_single_arm(
+                arm, n_nodes, threads, duration_s, rate_fps
+            )
+        print(f"[bench-churn]   {json.dumps(res['arms'][arm])}", flush=True)
+    shard_arms = {
+        a: v for a, v in res["arms"].items() if a.startswith("shard_")
+    }
+    # the SLO block scores the PROPOSED deployment (the sharded/CAS
+    # arms); the single-process arms are the baseline and an ablation
+    # deliberately driven past their capacity — their per-arm numbers
+    # stay visible above, and _all_arms records the overall minimum
+    slo_arms = shard_arms or res["arms"]
+    slo = {
+        "bind_success_min": min(
+            v["bind_success_ratio"] for v in slo_arms.values()
+        ),
+        "bind_success_min_all_arms": min(
+            v["bind_success_ratio"] for v in res["arms"].values()
+        ),
+        "audit_zero_drift": all(
+            v["audit"]["ok"] for v in res["arms"].values()
+        ),
+    }
+    if shard_arms and "global_lock" in res["arms"]:
+        best = min(shard_arms.values(), key=lambda v: v["filter_p99_ms"])
+        base_p99 = res["arms"]["global_lock"]["filter_p99_ms"]
+        slo["best_shard_arm"] = best["arm"]
+        slo["p99_improvement_best_shard_vs_global_lock"] = round(
+            base_p99 / best["filter_p99_ms"], 2
+        ) if best["filter_p99_ms"] else 0.0
+    res["slo"] = slo
+    if out_path:
+        os.makedirs(os.path.dirname(out_path), exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(res, f, indent=1)
+    return res
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=10000)
+    ap.add_argument("--threads", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=40.0)
+    ap.add_argument("--rate-factor", type=float, default=1.25,
+                    help="arrival rate as a multiple of the global-lock "
+                         "baseline's MEASURED churn-loaded capacity "
+                         "(phase-0 saturation probe) — above what the "
+                         "single-process arms can serve, below the "
+                         "sharded arms' parallel capacity")
+    ap.add_argument("--arms", default="global_lock,cas,shard_1,shard_2,shard_4")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-long 200-node sanity pass (schema + SLO "
+                         "fields), tier-1 safe; writes no artifact unless "
+                         "--out is given explicitly")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    if args.smoke:
+        nodes, duration = min(args.nodes, 200), min(args.duration, 2.0)
+        arms = ["global_lock", "cas", "shard_2"]
+        out = args.out
+    else:
+        nodes, duration = args.nodes, args.duration
+        arms = [a.strip() for a in args.arms.split(",") if a.strip()]
+        out = args.out or os.path.join(
+            REPO, "docs", "artifacts", "scheduler_churn.json"
+        )
+    res = run_bench(nodes, args.threads, duration, args.rate_factor, arms, out)
+    print(json.dumps(res, indent=1))
+    if args.smoke:
+        # sanity-assert the artifact schema + SLO fields (the CI smoke)
+        assert res["schema"] == SCHEMA
+        for arm in arms:
+            v = res["arms"][arm]
+            for key in ("filter_p50_ms", "filter_p99_ms",
+                        "bind_success_ratio", "cas_conflicts", "audit"):
+                assert key in v, (arm, key)
+        assert "bind_success_min" in res["slo"]
+        assert "audit_zero_drift" in res["slo"]
+        print("[bench-churn] smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,22 +47,40 @@ from vtpu.utils import codec  # noqa: E402
 from vtpu.utils.types import ChipInfo, HandshakeState, annotations, resources  # noqa: E402
 
 
+def handshake_now() -> str:
+    """A fresh REPORTED handshake value — benches that audit their end
+    state must not fabricate stale heartbeats."""
+    import datetime
+
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+    return f"{HandshakeState.REPORTED} {ts}"
+
+
+def node_chips(name: str, chips_per_node: int = 8):
+    return [
+        ChipInfo(f"{name}-chip-{i}", 10, 16384, 100, "TPU-v5e", True,
+                 (i % 2, i // 2, 0))
+        for i in range(chips_per_node)
+    ]
+
+
+def register_bench_node(client, name: str, chips_per_node: int = 8) -> None:
+    """Create one annotated bench node (shared with scheduler_churn.py)."""
+    client.create_node(new_node(name))
+    client.patch_node_annotations(name, {
+        annotations.NODE_REGISTER:
+            codec.encode_node_devices(node_chips(name, chips_per_node)),
+        annotations.NODE_TOPOLOGY: "2x4x1",
+        annotations.NODE_HANDSHAKE: handshake_now(),
+    })
+
+
 def build_cluster(n_nodes: int, chips_per_node: int = 8) -> Scheduler:
     client = FakeClient()
     for n in range(n_nodes):
-        name = f"node-{n:04d}"
-        chips = [
-            ChipInfo(f"{name}-chip-{i}", 10, 16384, 100, "TPU-v5e", True,
-                     (i % 2, i // 2, 0))
-            for i in range(chips_per_node)
-        ]
-        client.create_node(new_node(name))
-        client.patch_node_annotations(name, {
-            annotations.NODE_REGISTER: codec.encode_node_devices(chips),
-            annotations.NODE_TOPOLOGY: "2x4x1",
-            annotations.NODE_HANDSHAKE:
-                f"{HandshakeState.REPORTED} 2026-01-01T00:00:00Z",
-        })
+        register_bench_node(client, f"node-{n:04d}", chips_per_node)
     sched = Scheduler(client)
     sched.register_from_node_annotations()
     return sched

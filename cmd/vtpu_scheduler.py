@@ -49,6 +49,28 @@ def main(argv=None) -> int:
                         "when --cert/key are set; the main --http_bind "
                         "listener stays plain HTTP for the kube-scheduler "
                         "extender calls and metrics scrapes")
+    p.add_argument("--replica-id", default=os.environ.get("VTPU_REPLICA_ID", ""),
+                   help="this extender replica's id in a sharded deployment "
+                        "(env VTPU_REPLICA_ID; defaults to r0)")
+    p.add_argument("--shard-peers",
+                   default=os.environ.get("VTPU_SHARD_PEERS", ""),
+                   help="comma list of PEER replicas as id=http://host:port "
+                        "(env VTPU_SHARD_PEERS).  Enables sharded filtering: "
+                        "consistent-hash node ownership, subset fan-out over "
+                        "POST /shard/evaluate, owner-side CAS commit "
+                        "(docs/scheduler_perf.md §Sharded replicas)")
+    p.add_argument("--leader-election", action="store_true",
+                   help="run annotation-lease leader election; only the "
+                        "leader advances handshake annotations and runs the "
+                        "periodic audit loop (required when N replicas run)")
+    try:
+        lease_default = float(os.environ.get("VTPU_LEADER_LEASE_S", "")
+                              or 15.0)
+    except ValueError:
+        lease_default = 15.0  # malformed env must not kill the entrypoint
+    p.add_argument("--leader-lease-s", type=float, default=lease_default,
+                   help="leader lease duration in seconds "
+                        "(env VTPU_LEADER_LEASE_S)")
     p.add_argument("--audit-interval", type=float, default=None,
                    help="seconds between cluster-state reconciliation "
                         "passes (default: env VTPU_AUDIT_INTERVAL_S, else "
@@ -95,6 +117,31 @@ def main(argv=None) -> int:
     sched = Scheduler(client, cfg)
     if args.audit_interval is not None:
         sched.auditor.interval_s = args.audit_interval
+    replica_id = args.replica_id or "r0"
+    if args.leader_election:
+        from vtpu.scheduler.shard import LeaderElector
+
+        sched.elector = LeaderElector(
+            client, holder=replica_id, lease_s=args.leader_lease_s
+        )
+        sched.elector.start()
+    if args.shard_peers:
+        from vtpu.scheduler.shard import HttpPeer, ShardCoordinator
+
+        peers = {}
+        for ent in args.shard_peers.split(","):
+            ent = ent.strip()
+            if not ent:
+                continue
+            pid, _, url = ent.partition("=")
+            if not pid or not url:
+                p.error(f"--shard-peers entry not id=url: {ent!r}")
+            peers[pid] = HttpPeer(url)
+        sched.shard = ShardCoordinator(sched, replica_id, peers)
+        logging.info(
+            "sharded filtering on: replica %s with peers %s",
+            replica_id, sorted(peers),
+        )
     sched.run_background_loops()
     # main listener: plain HTTP — the kube-scheduler sidecar's extender
     # config (urlPrefix http://127.0.0.1:<port>) and Prometheus scrape it

@@ -43,6 +43,7 @@ def main() -> int:
     import vtpu.scheduler.core  # noqa: F401 — filter/patch/bind histograms
     import vtpu.scheduler.decisions  # noqa: F401 — audit-log counter
     import vtpu.scheduler.metrics  # noqa: F401 — fragmentation gauges
+    import vtpu.scheduler.shard  # noqa: F401 — shard/leader families
     import vtpu.serving.batcher  # noqa: F401 — queue-to-first-token
     import vtpu.shim.runtime  # noqa: F401 — pacing/quota histograms
     from vtpu.obs import all_registries, lint_names, registry

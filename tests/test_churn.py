@@ -1,0 +1,379 @@
+"""Optimistic booking under churn: CAS conflict semantics, the
+multi-threaded churn soak (filters racing registry expel/re-add and pod
+deletes), memo/patch-lock hygiene, and the bench-churn smoke harness.
+
+The invariants the soak asserts are the ones the lock removal must not
+break: no chip ever over capacity (no double-book), no booking lost, the
+incremental cache field-for-field equal to the nodes_usage() oracle, and
+a zero-drift auditor verdict over the end state."""
+
+import random
+import threading
+
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.scheduler import Scheduler, SchedulerConfig
+from vtpu.utils import codec
+from vtpu.utils.types import ChipInfo, HandshakeState, annotations, resources
+
+from tests.test_usage_cache import assert_cache_equals_oracle
+
+
+def _handshake_now():
+    import datetime
+
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+    return f"{HandshakeState.REPORTED} {ts}"
+
+
+def _chips(name, n_chips, hbm=16384):
+    return [
+        ChipInfo(f"{name}-chip-{i}", 10, hbm, 100, "TPU-v5e", True,
+                 (i % 2, i // 2, 0))
+        for i in range(n_chips)
+    ]
+
+
+def register_node(client, name, n_chips=2, hbm=16384):
+    client.create_node(new_node(name))
+    client.patch_node_annotations(name, {
+        annotations.NODE_REGISTER:
+            codec.encode_node_devices(_chips(name, n_chips, hbm)),
+        annotations.NODE_TOPOLOGY: "2x2x1",
+        annotations.NODE_HANDSHAKE: _handshake_now(),
+    })
+
+
+def tpu_pod(name, pct=None, mem=None, cores=None):
+    limits = {resources.chip: 1}
+    if pct is not None:
+        limits[resources.memory_percentage] = pct
+    if mem is not None:
+        limits[resources.memory] = mem
+    if cores is not None:
+        limits[resources.cores] = cores
+    return new_pod(
+        name, containers=[{"name": "main", "resources": {"limits": limits}}]
+    )
+
+
+# ---------------------------------------------------------------------------
+# CAS unit semantics
+# ---------------------------------------------------------------------------
+
+def test_try_book_cas_stale_generation_loses():
+    """The forced mid-selection generation bump: a booking landing between
+    evaluation and commit must make the stale committer lose — exactly
+    one winner at the CAS layer, deterministically."""
+    from vtpu.utils.types import ContainerDevice
+
+    s = Scheduler(client=None)
+    s.nodes.add_node("cas1", _chips("cas1", 1))
+    with s.usage_cache.locked():
+        _nu, gen, _util = s.usage_cache.peek_entry("cas1")
+    # two racers evaluated at the same generation; racer A commits first
+    dev_a = [[ContainerDevice("cas1-chip-0", "TPU", 4096, 0)]]
+    dev_b = [[ContainerDevice("cas1-chip-0", "TPU", 4096, 0)]]
+    assert s.usage_cache.try_book("uid-a", "cas1", gen, dev_a) is True
+    # racer B's expected generation is now stale → CAS rejects, no side
+    # effects, and the conflict is counted
+    assert s.usage_cache.try_book("uid-b", "cas1", gen, dev_b) is False
+    assert s.usage_cache.stats()["cas_conflicts"] == 1
+    assert "uid-b" not in s.usage_cache.bookings_snapshot()
+    # at the fresh generation the commit lands
+    fresh_gen = s.usage_cache.generation("cas1")
+    assert s.usage_cache.try_book("uid-b", "cas1", fresh_gen, dev_b) is True
+    # registering the same bookings with the PodManager (what
+    # _commit_booking does right after try_book) is a recognised no-op
+    # replay for the cache, and the two views converge field-for-field
+    for uid, devs in (("uid-a", dev_a), ("uid-b", dev_b)):
+        s.pods.add_pod(
+            {"metadata": {"name": uid, "namespace": "default", "uid": uid,
+                          "annotations": {}}},
+            "cas1", devs, pending=True,
+        )
+    assert_cache_equals_oracle(s)
+
+
+def test_filter_level_exactly_one_winner_on_forced_bump():
+    """Drive the same race through the filter machinery: both pods
+    evaluate at generation G; the first commit wins; the second's commit
+    conflicts, its re-validation finds the chip full, and the filter
+    honestly reports no-fit — never a double-book."""
+    c = FakeClient()
+    register_node(c, "w1", n_chips=1)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    pod_a = c.create_pod(tpu_pod("winner", pct=100))
+    pod_b = c.create_pod(tpu_pod("loser", pct=100))
+    from vtpu.k8s.objects import get_annotations
+    from vtpu.utils.resources import resource_reqs
+
+    reqs_a = resource_reqs(pod_a, 0, 0)
+    reqs_b = resource_reqs(pod_b, 0, 0)
+    best_a, _, _ = s._evaluate_candidates(
+        pod_a, ["w1"], reqs_a, get_annotations(pod_a), None
+    )
+    best_b, _, _ = s._evaluate_candidates(
+        pod_b, ["w1"], reqs_b, get_annotations(pod_b), None
+    )
+    assert best_a[3] == best_b[3]  # same generation stamp
+    st_a, _enc, _pl = s._commit_booking(
+        pod_a, best_a[1], best_a[3], best_a[2], reqs_a
+    )
+    assert st_a == "ok"
+    st_b, _enc, _pl = s._commit_booking(
+        pod_b, best_b[1], best_b[3], best_b[2], reqs_b
+    )
+    assert st_b == "conflict"
+    # the full filter path for B retries and lands on honest no-fit
+    res = s.filter(pod_b, ["w1"])
+    assert res.node is None and "no node fits" in res.error
+    assert len(s.pods.all_pods()) == 1
+    assert_cache_equals_oracle(s)
+
+
+def test_filter_aborts_after_exhausting_cas_retries(monkeypatch):
+    c = FakeClient()
+    register_node(c, "ab1")
+    s = Scheduler(c, SchedulerConfig(cas_max_retries=2))
+    s.register_from_node_annotations()
+    calls = [0]
+
+    def always_conflict(uid, node, gen, devices):
+        calls[0] += 1
+        s.usage_cache.cas_conflicts += 1
+        return False
+
+    monkeypatch.setattr(s.usage_cache, "try_book", always_conflict)
+    pod = c.create_pod(tpu_pod("doomed", pct=40))
+    res = s.filter(pod, ["ab1"])
+    assert res.node is None
+    assert "exhausted retries" in res.error
+    assert calls[0] == 3  # initial attempt + cas_max_retries
+    assert not s.pods.all_pods()
+
+
+def test_concurrent_filters_one_chip_exactly_one_winner_threaded():
+    """Two exclusive pods racing one chip through the lock-free path:
+    exactly one wins, whatever the interleaving."""
+    for trial in range(5):
+        c = FakeClient()
+        register_node(c, "x1", n_chips=1)
+        s = Scheduler(c)
+        s.register_from_node_annotations()
+        pods = [c.create_pod(tpu_pod(f"t{trial}-p{i}", pct=100))
+                for i in range(2)]
+        results = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(2)
+
+        def run(p):
+            barrier.wait()
+            r = s.filter(p, ["x1"])
+            with lock:
+                results.append(r)
+
+        ts = [threading.Thread(target=run, args=(p,)) for p in pods]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        winners = [r for r in results if r.node is not None]
+        assert len(winners) == 1, [r.error for r in results]
+        assert_cache_equals_oracle(s)
+
+
+# ---------------------------------------------------------------------------
+# Satellites: memo pruning + patch-lock hygiene
+# ---------------------------------------------------------------------------
+
+def test_single_eval_memo_pruned_when_node_expelled():
+    c = FakeClient()
+    for n in ("m1", "m2"):
+        register_node(c, n)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    pod = c.create_pod(tpu_pod("memo-pod", pct=30))
+    assert s.filter(pod, ["m1", "m2"]).node is not None
+    assert any(
+        "m1" in inner or "m2" in inner
+        for inner in s._single_eval_memo.values()
+    )
+    # full expel → the pruner listener evicts the node from every shape
+    s.nodes.rm_node_devices("m1", source=None)
+    for inner in s._single_eval_memo.values():
+        assert "m1" not in inner
+    # the surviving node's entries stay
+    assert any("m2" in inner for inner in s._single_eval_memo.values())
+    # partial (per-source) expel that leaves the node registered keeps
+    # keys; generation bump invalidates them on next lookup instead
+    s.nodes.add_node("m2b", _chips("m2b", 1), source="other")
+    s.nodes.rm_node_devices("m2b", source="other")
+    for inner in s._single_eval_memo.values():
+        assert "m2b" not in inner
+
+
+def test_patch_lock_map_drains_and_tracks_hwm():
+    c = FakeClient()
+    register_node(c, "pl1", n_chips=4)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    for i in range(12):
+        pod = c.create_pod(tpu_pod(f"pl-{i}", mem=512))
+        assert s.filter(pod, ["pl1"]).node is not None
+    stats = s.patch_lock_stats()
+    assert stats["tracked"] == 0, "patch-lock map leaked entries"
+    assert stats["hwm"] >= 1
+
+
+def test_patch_lock_sweep_guard_drops_dead_entries():
+    import threading as _t
+
+    from vtpu.scheduler import core as core_mod
+
+    s = Scheduler(client=None)
+    # simulate leaked zero-refcount entries beyond the sweep threshold
+    with s._patch_locks_guard:
+        for i in range(core_mod.PATCH_LOCK_SWEEP_THRESHOLD + 1):
+            s._patch_locks[f"dead-{i}"] = [_t.Lock(), 0]
+    ent = s._acquire_patch_lock("live-uid")
+    try:
+        stats = s.patch_lock_stats()
+        assert stats["tracked"] == 1  # only the live holder survived
+    finally:
+        s._release_patch_lock("live-uid", ent)
+    assert s.patch_lock_stats()["tracked"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The churn soak
+# ---------------------------------------------------------------------------
+
+def test_multithreaded_churn_soak_no_double_book_and_audit_clean():
+    """Filters racing registry expel/re-add and pod deletes for ~2s:
+    no chip over capacity, no lost booking, cache == oracle, memo and
+    patch-lock maps drained, and a zero-drift auditor verdict."""
+    c = FakeClient()
+    node_names = [f"s{i:02d}" for i in range(8)]
+    for n in node_names:
+        register_node(c, n, n_chips=2)
+    s = Scheduler(c)
+    s.register_from_node_annotations()
+    stop = threading.Event()
+    errors = []
+    placed = {}  # uid -> pod name (live, as far as this test knows)
+    placed_lock = threading.Lock()
+    churn_pool = node_names[-3:]
+
+    def filter_loop(k):
+        rng = random.Random(1000 + k)
+        i = 0
+        while not stop.is_set():
+            name = f"soak-{k}-{i}"
+            i += 1
+            pod = c.create_pod(tpu_pod(name, mem=2048, cores=10))
+            res = s.filter(pod, node_names)
+            if res.node is not None:
+                with placed_lock:
+                    placed[pod["metadata"]["uid"]] = name
+            if rng.random() < 0.3:
+                with placed_lock:
+                    if placed:
+                        uid = rng.choice(list(placed))
+                        pname = placed.pop(uid)
+                    else:
+                        uid = None
+                if uid:
+                    c.delete_pod("default", pname)
+                    s.pods.rm_pod(uid)
+
+    def churn_loop():
+        rng = random.Random(7)
+        alive = {n: True for n in churn_pool}
+        while not stop.is_set():
+            n = rng.choice(churn_pool)
+            if alive[n]:
+                s.nodes.rm_node_devices(n, source=None)
+            else:
+                s.nodes.add_node(
+                    n, _chips(n, 2), topology="2x2x1",
+                    source=annotations.NODE_HANDSHAKE,
+                )
+            alive[n] = not alive[n]
+            stop.wait(0.005)
+        for n in churn_pool:  # leave every pool node registered
+            if not alive[n]:
+                s.nodes.add_node(
+                    n, _chips(n, 2), topology="2x2x1",
+                    source=annotations.NODE_HANDSHAKE,
+                )
+                alive[n] = True
+
+    def wrapped(fn, *a):
+        try:
+            fn(*a)
+        except Exception as e:  # noqa: BLE001 — surface in the main thread
+            errors.append(e)
+            stop.set()
+
+    threads = [
+        threading.Thread(target=wrapped, args=(filter_loop, k))
+        for k in range(4)
+    ] + [threading.Thread(target=wrapped, args=(churn_loop,))]
+    [t.start() for t in threads]
+    threads[0].join(2.0)
+    stop.set()
+    [t.join(10.0) for t in threads]
+    assert not errors, errors
+
+    # no double-book: every chip within its capacity on both views
+    for nu in s.nodes_usage().values():
+        for d in nu.devices:
+            assert d.usedmem <= d.totalmem, d
+            assert d.usedcores <= d.totalcores, d
+            assert d.used <= d.count, d
+    assert_cache_equals_oracle(s)
+    # no lost booking: every pod this test believes is placed is either
+    # still ledgered or was on a churned-away node (registry truth wins)
+    pods_now = s.pods.all_pods()
+    with placed_lock:
+        for uid in placed:
+            assert uid in pods_now, f"booking lost for {uid}"
+    # hygiene: the per-uid patch-lock map drained; expelled nodes do not
+    # linger in the memo beyond the final re-adds
+    assert s.patch_lock_stats()["tracked"] == 0
+    # auditor end-state verdict: zero drift
+    rep = s.auditor.audit_once()
+    assert rep["ok"], rep
+    assert rep["summary"]["leaked_bookings"] == 0
+    assert rep["summary"]["overcommit_nodes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# bench-churn smoke (artifact schema + SLO fields, tier-1 sized)
+# ---------------------------------------------------------------------------
+
+def test_bench_churn_smoke_schema_and_slos():
+    from benchmarks import scheduler_churn as bench
+
+    res = bench.run_bench(
+        n_nodes=60, threads=2, duration_s=0.6, rate_factor=1.2,
+        arms=["global_lock", "cas", "shard_2"],
+    )
+    assert res["schema"] == bench.SCHEMA
+    meta = res["meta"]
+    for key in ("nodes", "threads", "duration_s", "rate_fps",
+                "solo_filter_ms", "commit", "replica_arms"):
+        assert key in meta, key
+    for arm in ("global_lock", "cas", "shard_2"):
+        v = res["arms"][arm]
+        for key in ("filter_p50_ms", "filter_p99_ms", "bind_success_ratio",
+                    "cas_conflicts", "cas_retries", "throughput_fps",
+                    "churn_events", "audit"):
+            assert key in v, (arm, key)
+        assert v["audit"]["ok"], (arm, v["audit"])
+        assert v["attempts"] > 0
+    assert res["arms"]["shard_2"]["replicas"] == 2
+    assert "bind_success_min" in res["slo"]
+    assert "audit_zero_drift" in res["slo"]
+    assert "p99_improvement_best_shard_vs_global_lock" in res["slo"]

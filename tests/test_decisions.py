@@ -3,6 +3,7 @@ verdicts recorded by the filter, GET /decisions through the in-process
 extender, /timeline cross-link, and the fragmentation gauges."""
 
 import json
+import re
 import urllib.request
 
 import pytest
@@ -192,7 +193,10 @@ def test_fragmentation_gauges_exported():
     assert 'vtpu_node_free_chips_ratio{node="n1"} 0.75' in text
     assert 'vtpu_node_largest_free_rectangle_ratio{node="n1"} 0.75' in text
     assert 'vtpu_nodes_by_free_chips_total{free_chips="3"} 1' in text
-    assert "vtpu_decisions_recorded_total 1" in text
+    # process-wide counter: other suites' filters may have incremented it
+    # before this test runs — assert it renders with a positive count
+    m = re.search(r"^vtpu_decisions_recorded_total (\d+)$", text, re.M)
+    assert m and int(m.group(1)) >= 1, text[-500:]
 
 
 def test_measured_duty_gauge_exported():

@@ -1,0 +1,424 @@
+"""Sharded extender replicas (vtpu/scheduler/shard.py): consistent-hash
+ownership, the merge layer, owner-side CAS commits, HTTP peer transport,
+leader election, and the cold-start failover rebuild — with the cluster
+auditor as the convergence oracle."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.scheduler import Scheduler, SchedulerConfig
+from vtpu.scheduler.shard import (
+    HashRing,
+    HttpPeer,
+    LeaderElector,
+    LocalPeer,
+    ShardCoordinator,
+)
+from vtpu.utils import codec
+from vtpu.utils.types import ChipInfo, HandshakeState, annotations, resources
+
+
+def _handshake_now():
+    import datetime
+
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+    return f"{HandshakeState.REPORTED} {ts}"
+
+
+def register_node(client, name, n_chips=2, hbm=16384):
+    chips = [
+        ChipInfo(f"{name}-chip-{i}", 10, hbm, 100, "TPU-v5e", True,
+                 (i % 2, i // 2, 0))
+        for i in range(n_chips)
+    ]
+    client.create_node(new_node(name))
+    client.patch_node_annotations(name, {
+        annotations.NODE_REGISTER: codec.encode_node_devices(chips),
+        annotations.NODE_TOPOLOGY: "2x1x1",
+        annotations.NODE_HANDSHAKE: _handshake_now(),
+    })
+
+
+def tpu_pod(name, mem=4096):
+    return new_pod(name, containers=[{"name": "main", "resources": {
+        "limits": {resources.chip: 1, resources.memory: mem},
+    }}])
+
+
+def make_pair(node_count=12):
+    """Two replicas over one FakeClient, cross-wired with LocalPeers."""
+    c = FakeClient()
+    names = [f"n{i:02d}" for i in range(node_count)]
+    for n in names:
+        register_node(c, n)
+    a, b = Scheduler(c), Scheduler(c)
+    a.register_from_node_annotations()
+    b.register_from_node_annotations()
+    a.shard = ShardCoordinator(a, "rA", {"rB": LocalPeer(b)})
+    b.shard = ShardCoordinator(b, "rB", {"rA": LocalPeer(a)})
+    return c, a, b, names
+
+
+# ---------------------------------------------------------------------------
+# HashRing
+# ---------------------------------------------------------------------------
+
+def test_ring_is_deterministic_and_balanced():
+    r1 = HashRing(["r0", "r1", "r2", "r3"])
+    r2 = HashRing(["r3", "r2", "r1", "r0"])  # order must not matter
+    names = [f"node-{i:05d}" for i in range(4000)]
+    counts = {}
+    for n in names:
+        assert r1.owner(n) == r2.owner(n)
+        counts[r1.owner(n)] = counts.get(r1.owner(n), 0) + 1
+    assert set(counts) == {"r0", "r1", "r2", "r3"}
+    for rid, c in counts.items():
+        # md5 vnodes: each replica within a loose 2x band of fair share
+        assert 4000 / 8 < c < 4000 / 2, (rid, counts)
+
+
+def test_ring_removal_only_remaps_the_removed_replicas_nodes():
+    full = HashRing(["r0", "r1", "r2", "r3"])
+    reduced = HashRing(["r0", "r1", "r2"])
+    for i in range(4000):
+        n = f"node-{i:05d}"
+        if full.owner(n) != "r3":
+            assert reduced.owner(n) == full.owner(n)
+        else:
+            assert reduced.owner(n) in ("r0", "r1", "r2")
+
+
+def test_ring_partition_preserves_order_and_covers():
+    ring = HashRing(["rA", "rB"])
+    names = [f"n{i:02d}" for i in range(40)]
+    parts = ring.partition(names)
+    assert sorted(x for p in parts.values() for x in p) == sorted(names)
+    for rid, part in parts.items():
+        assert part == [n for n in names if ring.owner(n) == rid]
+
+
+# ---------------------------------------------------------------------------
+# Coordinator over LocalPeers (one shared annotation bus)
+# ---------------------------------------------------------------------------
+
+def test_sharded_filter_places_and_converges_over_the_bus():
+    c, a, b, names = make_pair()
+    ring = a.shard.ring
+    placed = {}
+    for i in range(10):
+        pod = c.create_pod(tpu_pod(f"p{i}"))
+        res = a.filter(pod, names)
+        assert res.node is not None, res.error
+        placed[pod["metadata"]["uid"]] = res.node
+    # every booked node was booked at its OWNER — ownership partitions
+    # the booking space, so the per-node CAS needs no cross-replica lock
+    for uid, node in placed.items():
+        owner = ring.owner(node)
+        owner_sched = a if owner == "rA" else b
+        assert uid in owner_sched.pods.all_pods(), (uid, node, owner)
+    # bus convergence: both replicas ingest all assignments, then the
+    # auditor (the PR-5 correctness oracle) must report zero drift
+    a.ingest_pods()
+    b.ingest_pods()
+    for sched in (a, b):
+        rep = sched.auditor.audit_once()
+        assert rep["ok"], json.dumps(rep, indent=1, default=str)
+
+
+def test_sharded_filter_remote_winner_commits_at_owner():
+    c, a, b, names = make_pair()
+    remote_only = [n for n in names if a.shard.ring.owner(n) == "rB"]
+    assert remote_only, "ring degenerated: rB owns nothing"
+    pod = c.create_pod(tpu_pod("remote-pod"))
+    res = a.filter(pod, remote_only)
+    assert res.node in remote_only, res.error
+    uid = pod["metadata"]["uid"]
+    # the booking lives at the owner (B), not the coordinator (A)
+    assert uid in b.pods.all_pods()
+    assert uid not in a.pods.all_pods()
+    # the owner wrote the assignment annotations to the bus
+    got = c.get_pod("default", "remote-pod")
+    annos = got["metadata"]["annotations"]
+    assert annos[annotations.ASSIGNED_NODE] == res.node
+    assert annos[annotations.ASSIGNED_IDS]
+
+
+def test_sharded_filter_no_fit_merges_failures_from_all_replicas():
+    c, a, b, names = make_pair(node_count=4)
+    # exhaust every chip with exclusive pods via the coordinator
+    for i in range(8):
+        pod = c.create_pod(tpu_pod(f"fill-{i}", mem=16384))
+        assert a.filter(pod, names).node is not None
+    pod = c.create_pod(tpu_pod("overflow", mem=16384))
+    res = a.filter(pod, names)
+    assert res.node is None
+    assert res.error == "no node fits vtpu request"
+    assert set(res.failed) == set(names)  # both replicas' rejects merged
+
+
+def test_owner_commit_absorbs_stale_generation():
+    """A stale expected_gen (bookings landed mid-flight) must NOT bounce
+    back to the coordinator when the node still fits: the owner
+    re-evaluates fresh and CAS-commits, reporting stale_gen."""
+    c, a, b, names = make_pair()
+    b_nodes = [n for n in names if a.shard.ring.owner(n) == "rB"]
+    node = b_nodes[0]
+    ev = b.shard_evaluate(tpu_pod("probe"), [node])
+    gen = ev["best"]["gen"]
+    # land a booking that bumps the node's generation
+    filler = c.create_pod(tpu_pod("filler"))
+    assert b.filter(filler, [node]).node == node
+    pod = c.create_pod(tpu_pod("stale-commit"))
+    rep = b.shard_commit(pod, node, gen)
+    assert rep["status"] == "ok" and rep["stale_gen"] is True
+    assert b.usage_cache.stats()["cas_conflicts"] == 0  # fresh-gen commit
+    # and the conflict was counted at the filter CAS family
+    from vtpu.scheduler.core import _CAS_CONFLICTS
+
+    assert _CAS_CONFLICTS.value() >= 1
+
+
+def test_owner_commit_no_fit_when_capacity_gone():
+    c, a, b, names = make_pair(node_count=4)
+    b_nodes = [n for n in names if a.shard.ring.owner(n) == "rB"]
+    node = b_nodes[0]
+    ev = b.shard_evaluate(tpu_pod("probe"), [node])
+    gen = ev["best"]["gen"]
+    big = c.create_pod(tpu_pod("big", mem=16384))
+    assert b.filter(big, [node]).node == node
+    big2 = c.create_pod(tpu_pod("big2", mem=16384))
+    assert b.filter(big2, [node]).node == node  # second chip
+    pod = c.create_pod(tpu_pod("loser", mem=16384))
+    rep = b.shard_commit(pod, node, gen)
+    assert rep["status"] == "no_fit"
+
+
+def test_coordinator_retries_through_peer_conflicts():
+    """A peer that answers conflict-then-ok exercises the merge layer's
+    bounded retry path."""
+
+    class FlakyPeer:
+        def __init__(self, real, conflicts):
+            self.real = real
+            self.conflicts = conflicts
+
+        def evaluate(self, pod, nodes):
+            return self.real.evaluate(pod, nodes)
+
+        def commit(self, pod, node, gen):
+            if self.conflicts > 0:
+                self.conflicts -= 1
+                return {"status": "conflict", "gen": gen + 1}
+            return self.real.commit(pod, node, gen)
+
+    c, a, b, names = make_pair()
+    b_nodes = [n for n in names if a.shard.ring.owner(n) == "rB"]
+    a.shard = ShardCoordinator(a, "rA", {"rB": FlakyPeer(LocalPeer(b), 2)})
+    pod = c.create_pod(tpu_pod("flaky"))
+    res = a.filter(pod, b_nodes)
+    assert res.node in b_nodes, res.error
+
+
+def test_coordinator_survives_dead_peer():
+    """An unreachable peer fails its subset, not the whole filter — the
+    coordinator places on its own nodes."""
+
+    class DeadPeer:
+        def evaluate(self, pod, nodes):
+            raise ConnectionError("replica down")
+
+        def commit(self, pod, node, gen):
+            raise ConnectionError("replica down")
+
+    c, a, b, names = make_pair()
+    a.shard = ShardCoordinator(a, "rA", {"rB": DeadPeer()})
+    pod = c.create_pod(tpu_pod("survivor"))
+    res = a.filter(pod, names)
+    assert res.node is not None and a.shard.ring.owner(res.node) == "rA"
+    dead = [n for n in names if a.shard.ring.owner(n) == "rB"]
+    assert all("unreachable" in res.failed[n] for n in dead)
+
+
+def test_cold_start_failover_rebuild_is_audit_clean():
+    """Kill the coordinator after placements; a fresh replica rebuilds
+    from the annotation bus alone and the auditor finds zero drift — the
+    failover story the sharding design rests on."""
+    c, a, b, names = make_pair()
+    for i in range(6):
+        pod = c.create_pod(tpu_pod(f"fo-{i}"))
+        assert a.filter(pod, names).node is not None
+    del a, b  # both replicas "crash"
+    fresh = Scheduler(c)
+    fresh.register_from_node_annotations()
+    fresh.ingest_pods()
+    rep = fresh.auditor.audit_once()
+    assert rep["ok"], json.dumps(rep, indent=1, default=str)
+    assert len(fresh.pods.all_pods()) == 6
+    # and the failed-over replica keeps scheduling
+    pod = c.create_pod(tpu_pod("post-failover"))
+    assert fresh.filter(pod, names).node is not None
+
+
+# ---------------------------------------------------------------------------
+# HTTP peer transport (wire level)
+# ---------------------------------------------------------------------------
+
+def test_http_peer_round_trip_and_shard_status():
+    from vtpu.scheduler.routes import serve
+
+    c = FakeClient()
+    names = [f"h{i:02d}" for i in range(6)]
+    for n in names:
+        register_node(c, n)
+    a, b = Scheduler(c), Scheduler(c)
+    a.register_from_node_annotations()
+    b.register_from_node_annotations()
+    b.config.http_bind = "127.0.0.1:0"
+    srv, _ = serve(b, bind="127.0.0.1:0")
+    try:
+        port = srv.server_address[1]
+        a.shard = ShardCoordinator(
+            a, "rA", {"rB": HttpPeer(f"http://127.0.0.1:{port}")}
+        )
+        b.shard = ShardCoordinator(b, "rB", {})  # so /shard reports a ring
+        pod = c.create_pod(tpu_pod("wire"))
+        res = a.filter(pod, names)
+        assert res.node is not None, res.error
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/shard", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["enabled"] and doc["replica"] == "rB"
+        assert doc["registry_nodes"] == len(names)
+        assert doc["leader"] is True  # no elector: always write leader
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Leader election
+# ---------------------------------------------------------------------------
+
+def test_leader_election_exactly_one_leader_and_takeover():
+    clock = [1000.0]
+    c = FakeClient()
+    e1 = LeaderElector(c, "repl-1", lease_s=10.0, wallclock=lambda: clock[0])
+    e2 = LeaderElector(c, "repl-2", lease_s=10.0, wallclock=lambda: clock[0])
+    assert e1.try_acquire() is True
+    assert e2.try_acquire() is False
+    assert e1.is_leader() and not e2.is_leader()
+    assert e2.current_holder() == "repl-1"
+    # renewal keeps the lease
+    clock[0] += 6
+    assert e1.try_acquire() is True
+    assert e2.try_acquire() is False
+    # the holder dies (stops renewing): past the lease the peer takes over
+    clock[0] += 11
+    assert not e1.is_leader()  # self-demotion without renewal
+    assert e2.try_acquire() is True
+    assert e2.is_leader()
+    assert e1.try_acquire() is False  # fresh foreign lease now
+
+
+def test_leader_election_concurrent_acquire_single_winner():
+    clock = [0.0]
+    c = FakeClient()
+    electors = [
+        LeaderElector(c, f"r{i}", lease_s=30.0, wallclock=lambda: clock[0])
+        for i in range(4)
+    ]
+    barrier = threading.Barrier(4)
+    results = {}
+
+    def race(e):
+        barrier.wait()
+        results[e.holder] = e.try_acquire()
+
+    ts = [threading.Thread(target=race, args=(e,)) for e in electors]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert sum(results.values()) == 1, results
+
+
+def test_follower_does_not_advance_handshakes_leader_does():
+    clock = [0.0]
+    c = FakeClient()
+    register_node(c, "hs1")
+    leader = Scheduler(c)
+    follower = Scheduler(c)
+    e_lead = LeaderElector(c, "lead", lease_s=30.0,
+                           wallclock=lambda: clock[0])
+    e_foll = LeaderElector(c, "foll", lease_s=30.0,
+                           wallclock=lambda: clock[0])
+    leader.elector, follower.elector = e_lead, e_foll
+    assert e_lead.try_acquire() and not e_foll.try_acquire()
+    # follower polls first: state rebuilt, wire untouched
+    follower.register_from_node_annotations()
+    hs = c.get_node("hs1")["metadata"]["annotations"][
+        annotations.NODE_HANDSHAKE]
+    assert hs.startswith(HandshakeState.REPORTED)
+    assert "hs1" in follower.nodes.all_nodes()  # read-only rebuild worked
+    # leader polls: handshake advances to Requesting
+    leader.register_from_node_annotations()
+    hs = c.get_node("hs1")["metadata"]["annotations"][
+        annotations.NODE_HANDSHAKE]
+    assert hs.startswith(HandshakeState.REQUESTING)
+
+
+def test_follower_audit_readiness_reports_ok():
+    """A follower's audit_pass readiness must not fail just because the
+    leader owns the periodic passes."""
+    c = FakeClient()
+    sched = Scheduler(c)
+    sched.auditor.interval_s = 0.05
+    sched.elector = LeaderElector(c, "me", lease_s=30.0)
+    # someone else holds the lease
+    other = LeaderElector(c, "other", lease_s=30.0)
+    assert other.try_acquire()
+    assert not sched.is_write_leader()
+    assert sched.auditor.start()
+    try:
+        time.sleep(0.15)
+        from vtpu.obs.ready import readiness
+
+        report = readiness("scheduler").report()
+        assert report["checks"]["audit_pass"]["ok"], report
+    finally:
+        sched.auditor.stop(timeout=1.0)
+
+
+def test_scheduler_config_legacy_lock_mode_still_places():
+    """optimistic_booking=False (the rollback knob and the bench-churn
+    baseline) keeps the full old behaviour."""
+    c = FakeClient()
+    for n in ("l1", "l2"):
+        register_node(c, n)
+    s = Scheduler(c, SchedulerConfig(optimistic_booking=False))
+    s.register_from_node_annotations()
+    for i in range(4):
+        pod = c.create_pod(tpu_pod(f"legacy-{i}"))
+        res = s.filter(pod, ["l1", "l2"])
+        assert res.node in ("l1", "l2"), res.error
+    rep = s.auditor.audit_once()
+    assert rep["ok"], rep
+
+
+def test_shard_wire_endpoints_reject_on_tls_webhook_listener():
+    """The peer API must never be served on the TLS webhook port."""
+    from vtpu.scheduler.routes import _Handler
+
+    assert _Handler.allow_debug is True  # plain listener default
+    # serve() flips allow_debug off when TLS material is given — the
+    # /shard POST branches are gated on it (see routes.do_POST)
+    import inspect
+
+    src = inspect.getsource(_Handler.do_POST)
+    assert '"/shard/evaluate" and self.allow_debug' in src
+    assert '"/shard/commit" and self.allow_debug' in src

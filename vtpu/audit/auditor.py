@@ -133,6 +133,11 @@ class ClusterAuditor:
         self.interval_s = interval_s
         self.stale_heartbeat_s = stale_heartbeat_s
         self._wallclock = wallclock
+        # sharded deployments set this to Scheduler.is_write_leader: the
+        # periodic loop runs passes only on the elected leader (N replicas
+        # re-emitting identical DriftDetected storms is noise, not safety);
+        # on-demand GET /audit still runs everywhere.  None = always run.
+        self.leader_gate = None
         self._lock = threading.Lock()
         self._pass_lock = threading.Lock()  # one pass at a time (loop + GET)
         self._passes = 0
@@ -419,6 +424,8 @@ class ClusterAuditor:
 
         def loop() -> None:
             while not self._stop.wait(self.interval_s):
+                if self.leader_gate is not None and not self.leader_gate():
+                    continue  # follower: the leader runs the passes
                 try:
                     self.audit_once()
                 except Exception:  # noqa: BLE001 — keep auditing
@@ -432,6 +439,10 @@ class ClusterAuditor:
         from vtpu.obs.ready import readiness
 
         def check():
+            if self.leader_gate is not None and not self.leader_gate():
+                # a follower's passes are deferred to the leader — a stale
+                # local pass age must not fail its readiness
+                return True, "follower (audit passes run on the leader)"
             age = self.last_pass_age_s()
             if age is None:
                 t = self._thread

@@ -93,6 +93,12 @@ class Client:
     def get_node(self, name: str) -> dict:
         return self._request("GET", f"/api/v1/nodes/{name}")
 
+    def create_node(self, node: dict) -> dict:
+        """POST a Node object — the leader elector creates its dedicated
+        election Node on demand (vtpu/scheduler/shard.py); a kubelet-less
+        virtual Node is a legal API object."""
+        return self._request("POST", "/api/v1/nodes", body=node)
+
     def list_nodes(self) -> List[dict]:
         return self._request("GET", "/api/v1/nodes").get("items", [])
 

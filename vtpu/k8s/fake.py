@@ -58,6 +58,13 @@ class FakeClient:
         with self._lock:
             return [copy.deepcopy(n) for n in self._nodes.values()]
 
+    def delete_node(self, name: str) -> None:
+        """Remove a node (cluster-scale churn: nodes die mid-run)."""
+        with self._lock:
+            node = self._nodes.pop(name, None)
+            if node is not None:
+                self._notify("NodeDeleted", node)
+
     def patch_node_annotations(
         self,
         name: str,

@@ -4,6 +4,14 @@ cmd/scheduler/main.go:51-58 flags)."""
 from __future__ import annotations
 
 import dataclasses
+import os
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 @dataclasses.dataclass
@@ -25,3 +33,23 @@ class SchedulerConfig:
     # — the scheduler-framework-shim analog the reference keeps bypassed
     # (checkNodeValidity, scheduler.go:358-364); vtpu ships it enabled
     node_validity_check: bool = True
+    # optimistic booking (docs/scheduler_perf.md §Optimistic booking):
+    # True = lock-free selection over generation-stamped snapshots with a
+    # per-node CAS commit (UsageCache.try_book) and bounded retries; False
+    # = the pre-CAS escape hatch that serialises every select→book under
+    # one global lock (the bench-churn baseline arm, and a rollback knob)
+    optimistic_booking: bool = True
+    # selection re-runs allowed after a CAS generation conflict before the
+    # filter aborts with an error (kube-scheduler retries the pod); each
+    # retry re-evaluates against fresh snapshots, so a conflict storm can
+    # only come from genuinely contended nodes (env VTPU_FILTER_CAS_RETRIES)
+    cas_max_retries: int = dataclasses.field(
+        default_factory=lambda: _env_int("VTPU_FILTER_CAS_RETRIES", 8)
+    )
+    # candidate-walk chunk size: the lock-free walk takes the cache lock
+    # per chunk (not across the whole node list), so concurrent filters
+    # and churn events interleave instead of queueing behind a 10k-node
+    # walk (env VTPU_FILTER_CHUNK)
+    filter_chunk: int = dataclasses.field(
+        default_factory=lambda: _env_int("VTPU_FILTER_CHUNK", 256)
+    )

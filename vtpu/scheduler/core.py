@@ -35,6 +35,7 @@ from vtpu.utils.types import (
     HANDSHAKE_TIMEOUT_S,
     HandshakeState,
     KNOWN_DEVICES,
+    PodDevices,
     REGISTRY_POLL_INTERVAL_S,
     annotations,
 )
@@ -58,6 +59,28 @@ _BIND_HIST = _REG.histogram(
     "vtpu_bind_seconds",
     "Bind latency: node lock + bind-phase patch + Binding post",
 )
+# optimistic-booking health (docs/scheduler_perf.md §Optimistic booking):
+# conflicts = try_book CAS commits lost to a stale generation; retries =
+# selection re-runs after a conflict; aborts = filters that exhausted
+# cas_max_retries and returned an error (kube-scheduler re-queues the pod)
+_CAS_CONFLICTS = _REG.counter(
+    "vtpu_filter_cas_conflicts_total",
+    "Optimistic booking commits rejected because the chosen node's "
+    "generation moved between evaluation and try_book",
+)
+_CAS_RETRIES = _REG.counter(
+    "vtpu_filter_cas_retries_total",
+    "Filter selections re-run against fresh snapshots after a CAS conflict",
+)
+_CAS_ABORTS = _REG.counter(
+    "vtpu_filter_cas_aborts_total",
+    "Filters aborted after exhausting cas_max_retries (the pod is "
+    "re-queued by kube-scheduler)",
+)
+
+# per-uid patch-lock map hygiene: entries must be reclaimed when the last
+# holder releases — a leak here grows without bound under sustained arrival
+PATCH_LOCK_SWEEP_THRESHOLD = 4096
 
 
 def _now_ts() -> str:
@@ -87,6 +110,29 @@ class FilterResult:
         self.error = error
 
 
+class _MemoPruner:
+    """NodeManager listener that evicts an expelled node's keys from every
+    per-request-shape memo — without it, expelled-node entries live forever
+    inside every memoized shape (they can never be *looked up* again, the
+    cache-wide unique generations guarantee that, but they also can never
+    be reclaimed).  Runs under the manager lock; takes the cache lock (the
+    memo's guard) — the global manager→cache lock order."""
+
+    def __init__(self, sched: "Scheduler") -> None:
+        self._sched = sched
+
+    def on_node_changed(self, name, chips, topology) -> None:
+        # a registry change bumps the node's generation, which already
+        # invalidates its memo entries on next lookup — nothing to evict
+        pass
+
+    def on_node_removed(self, name: str) -> None:
+        s = self._sched
+        with s.usage_cache.locked():
+            for inner in s._single_eval_memo.values():
+                inner.pop(name, None)
+
+
 class Scheduler:
     def __init__(self, client, config: Optional[SchedulerConfig] = None) -> None:
         self.client = client
@@ -99,35 +145,54 @@ class Scheduler:
         self.usage_cache = UsageCache()
         self.nodes.add_listener(self.usage_cache)
         self.pods.add_listener(self.usage_cache)
+        self.nodes.add_listener(_MemoPruner(self))
         # placement-decision audit log (GET /decisions?pod=): every filter
         # run's per-node verdicts, bounded by VTPU_DECISION_LOG_CAP
         self.decisions = DecisionLog()
         self._stop = threading.Event()
-        # serialises the select→book critical section: concurrent /filter
-        # requests (HA schedulers, parallel binds) must not both see the
-        # same chip as free.  The assignment-annotation PATCH (an API
-        # round-trip) runs OUTSIDE this lock — booking happens locally
-        # first, and a failed patch unbooks.
+        # the pre-CAS escape hatch (config.optimistic_booking=False):
+        # serialises every select→book under one global lock.  The default
+        # path never takes it — concurrent filters select lock-free
+        # against generation-stamped snapshots and commit via the
+        # per-node CAS in UsageCache.try_book.
         self._filter_lock = threading.Lock()
         # commits that re-ran selection because a background registry/pod
-        # event changed the chosen node mid-filter (exported on /metrics)
+        # event (or a concurrent filter's booking) changed the chosen node
+        # mid-filter (exported on /metrics; cas counters carry the detail).
+        # Bumped via note_gen_retry(): concurrent filters increment it
+        # without any shared lock otherwise, and a bare += would lose
+        # counts exactly under the contention it is meant to measure.
         self.filter_gen_retries = 0
+        self._gen_retry_lock = threading.Lock()
+        # sharded deployment (vtpu/scheduler/shard.py): when set, filter()
+        # fans the candidate walk out to the replica that owns each node
+        # and commits at the owner; None = this replica owns everything
+        self.shard = None
+        # leader elector for write-back consumers (handshake patches, the
+        # audit loop); None = single replica, always the write leader
+        self.elector = None
         # serialises the out-of-lock assignment patch PER POD: concurrent
         # re-filters of the same pod must land their patches in booking
         # order (different pods patch in parallel — the perf point of the
         # lock shrink).  {uid: [lock, refcount]}; entries are reclaimed
-        # when the last holder releases.
+        # when the last holder releases — patch_lock_stats() exposes the
+        # live size + high-water mark, and a defensive sweep drops any
+        # zero-refcount straggler should the map ever cross the threshold
+        # (a leaked entry under sustained arrival would otherwise grow the
+        # map one dead pod at a time, forever).
         self._patch_locks: Dict[str, list] = {}
         self._patch_locks_guard = threading.Lock()
+        self._patch_locks_hwm = 0
         # per-request-shape memo over single-chip evaluations:
         # {request key: {node: (generation, (uuid, mem, score) | None)}}.
         # A deployment burst submits identical pods; between two filters
         # only the booked node's generation moves, so the other N-1
         # candidate evaluations replay as dict lookups.  Generations are
         # cache-wide unique (never reused), which makes gen-equality a
-        # sound validity test.  Serialised by _filter_lock (the outer-dict
-        # lookup/eviction runs before the cache lock is taken): any new
-        # consumer must hold _filter_lock, not just the cache lock.
+        # sound validity test.  Guarded by the CACHE lock (the candidate
+        # walk resolves and fills it per chunk while holding
+        # usage_cache.locked()); expelled nodes are evicted by the
+        # _MemoPruner listener above.
         self._single_eval_memo: Dict[tuple, Dict[str, tuple]] = {}
         # node objects cached by the 15 s registry poll — node-validity
         # checks read these instead of issuing per-Filter API GETs
@@ -141,7 +206,24 @@ class Scheduler:
         from vtpu.audit import ClusterAuditor
 
         self.auditor = ClusterAuditor(self)
+        # in a sharded deployment only the elected leader runs periodic
+        # audit passes (N replicas re-emitting the same DriftDetected
+        # storm would be noise); GET /audit on demand works everywhere
+        self.auditor.leader_gate = self.is_write_leader
         self._register_ready_checks()
+
+    def note_gen_retry(self) -> None:
+        """Count one CAS-conflict selection re-run (thread-safe — the
+        legacy /metrics counter and the obs family stay in step)."""
+        with self._gen_retry_lock:
+            self.filter_gen_retries += 1
+        _CAS_RETRIES.inc()
+
+    def is_write_leader(self) -> bool:
+        """Whether this replica may run write-back consumers: handshake
+        annotation patches and the periodic audit loop.  Always True
+        without an elector (single-replica deployment)."""
+        return self.elector is None or self.elector.is_leader()
 
     def _register_ready_checks(self) -> None:
         """Deep-readiness checks behind GET /readyz (vtpu/obs/ready)."""
@@ -168,6 +250,10 @@ class Scheduler:
     def register_from_node_annotations(self) -> None:
         nodes = self.client.list_nodes()
         self._node_objs = {n["metadata"]["name"]: n for n in nodes}
+        # followers rebuild state from the bus read-only; only the write
+        # leader advances the handshake state machine on the wire (N
+        # replicas racing the same ack patches would be churn, not safety)
+        may_write = self.is_write_leader()
         for node in nodes:
             name = node["metadata"]["name"]
             annos = node.get("metadata", {}).get("annotations") or {}
@@ -195,10 +281,12 @@ class Scheduler:
                     self.nodes.add_node(
                         name, devices, topology, source=handshake_anno
                     )
-                    self.client.patch_node_annotations(
-                        name,
-                        {handshake_anno: f"{HandshakeState.REQUESTING}_{_now_ts()}"},
-                    )
+                    if may_write:
+                        self.client.patch_node_annotations(
+                            name,
+                            {handshake_anno:
+                             f"{HandshakeState.REQUESTING}_{_now_ts()}"},
+                        )
                 elif hs.startswith(HandshakeState.REQUESTING):
                     ts = _parse_ts(hs.split("_", 1)[-1])
                     now = datetime.datetime.now(datetime.timezone.utc)
@@ -209,10 +297,35 @@ class Scheduler:
                              annotation=handshake_anno,
                              detail="handshake timeout; expelling devices")
                         self.nodes.rm_node_devices(name, source=handshake_anno)
-                        self.client.patch_node_annotations(
-                            name,
-                            {handshake_anno: f"{HandshakeState.DELETED}_{_now_ts()}"},
-                        )
+                        if may_write:
+                            self.client.patch_node_annotations(
+                                name,
+                                {handshake_anno:
+                                 f"{HandshakeState.DELETED}_{_now_ts()}"},
+                            )
+                    else:
+                        # mid-cycle (ack sent, plugin not yet re-reported):
+                        # the register annotation still describes the
+                        # node's devices.  A replica that polls here — a
+                        # cold-starting failover, or a follower whose
+                        # leader consumed the Reported state — must ingest
+                        # it or it stays blind until the next 30 s plugin
+                        # re-report.  add_node dedups an unchanged
+                        # registration, so steady-state re-polls cost
+                        # nothing.
+                        enc = annos.get(register_anno, "")
+                        if enc:
+                            try:
+                                devices = codec.decode_node_devices(enc)
+                            except ValueError:
+                                log.warning(
+                                    "node %s: bad register annotation", name
+                                )
+                                continue
+                            topology = annos.get(annotations.NODE_TOPOLOGY, "")
+                            self.nodes.add_node(
+                                name, devices, topology, source=handshake_anno
+                            )
                 elif hs.startswith(HandshakeState.DELETED):
                     continue
         self.last_registry_poll_t = time.monotonic()
@@ -329,6 +442,8 @@ class Scheduler:
     def stop(self) -> None:
         self._stop.set()
         self.auditor.stop(timeout=0.1)
+        if self.elector is not None:
+            self.elector.stop(timeout=0.1)
 
     # ------------------------------------------------------------------
     # Usage aggregation (ref getNodesUsage scheduler.go:348-400)
@@ -416,83 +531,35 @@ class Scheduler:
             pod=pod.get("metadata", {}).get("name", ""),
             nodes=len(node_names),
         ) as sp:
-            with self._filter_lock:
+            # each node must be evaluated at most once — a duplicate entry
+            # would double-count the first evaluation's bookings
+            node_names = list(dict.fromkeys(node_names))
+            committed_remote = False
+            if self.shard is not None:
+                # sharded deployment: this replica coordinates — its own
+                # subset evaluates locally, peers evaluate theirs, the
+                # winner's owner CAS-commits (and patches, when remote)
+                res, enc, verdicts, committed_remote = self.shard.coordinate(
+                    pod, node_names, reqs, pod_annos, node_objs
+                )
+            elif self.config.optimistic_booking:
                 res, enc, verdicts = self._select_and_book(
                     pod, node_names, reqs, pod_annos, node_objs
                 )
-            if res.node is not None and enc is not None:
-                # the API round-trip runs OUTSIDE the filter lock: the
+            else:
+                # escape hatch / bench baseline: the pre-CAS behaviour —
+                # every select→book serialised under one global lock
+                with self._filter_lock:
+                    res, enc, verdicts = self._select_and_book(
+                        pod, node_names, reqs, pod_annos, node_objs
+                    )
+            if res.node is not None and enc is not None and not committed_remote:
+                # the API round-trip runs outside every booking lock: the
                 # booking is already visible locally, so concurrent
                 # filters see the usage while this patch is in flight.
-                # Same-pod patches serialise on a per-uid lock and only
-                # the still-current booking writes the wire, so annotation
-                # state always converges to the latest local booking.
-                plock = self._acquire_patch_lock(uid)
-                try:
-                    if not self.pods.booking_current(uid, res.node):
-                        pi = self.pods.all_pods().get(uid)
-                        if pi is not None and pi.node == res.node:
-                            # an ingest replay of the wire's own assignment
-                            # state replaced the pending booking for the
-                            # same node: already durable, nothing to patch
-                            pass
-                        else:
-                            # a concurrent re-filter superseded this
-                            # booking; its patch (behind the same lock) is
-                            # the valid one
-                            res = FilterResult(
-                                None,
-                                res.failed,
-                                "assignment superseded by concurrent re-filter",
-                            )
-                    else:
-                        patch = {
-                            annotations.ASSIGNED_NODE: res.node,
-                            annotations.ASSIGNED_TIME: _now_ts(),
-                            annotations.ASSIGNED_IDS: enc,
-                            annotations.DEVICES_TO_ALLOCATE: enc,
-                            # a fresh assignment supersedes any stale
-                            # bind-phase from a previous failed
-                            # attempt — left in place it would make
-                            # the ingest sweep drop this booking
-                            # (merge-patch null deletes)
-                            annotations.BIND_PHASE: None,
-                        }
-                        ctx = trace.context_of(sp)
-                        if ctx is not None:
-                            # propagate the trace so the plugin's Allocate
-                            # continues this pod's lifecycle trace
-                            patch[annotations.TRACE_CONTEXT] = ctx
-                        t_patch = time.perf_counter()
-                        try:
-                            with trace.span(
-                                "assign_patch",
-                                pod=pod["metadata"]["name"],
-                                node=res.node,
-                            ):
-                                self.client.patch_pod_annotations(
-                                    pod["metadata"].get("namespace", "default"),
-                                    pod["metadata"]["name"],
-                                    patch,
-                                )
-                        except Exception as e:  # noqa: BLE001 — unbook
-                            log.exception(
-                                "filter: assignment patch failed for %s; "
-                                "unbooking",
-                                pod["metadata"]["name"],
-                            )
-                            # conditional: only the booking THIS filter
-                            # made (still pending, same node)
-                            self.pods.rm_pod_if_pending(uid, res.node)
-                            res = FilterResult(
-                                None, res.failed, f"assignment patch: {e}"
-                            )
-                        else:
-                            self.pods.confirm_pod(uid, res.node)
-                        finally:
-                            _PATCH_HIST.observe(time.perf_counter() - t_patch)
-                finally:
-                    self._release_patch_lock(uid, plock)
+                err = self._patch_assignment(pod, uid, res.node, enc, sp)
+                if err is not None:
+                    res = FilterResult(None, res.failed, err)
             sp["node"] = res.node
             sp["failed"] = len(res.failed)
             _FILTER_HIST.observe(time.perf_counter() - t_filter, path=path)
@@ -520,14 +587,105 @@ class Scheduler:
             )
             return res
 
+    def _patch_assignment(
+        self, pod: dict, uid: str, node: str, enc: str, sp=None
+    ) -> Optional[str]:
+        """Write the assignment annotations for a booking this process just
+        made.  Returns None on success (the booking stands) or an error
+        string (the caller clears the chosen node).  Same-pod patches
+        serialise on a per-uid lock and only the still-current booking
+        writes the wire, so annotation state always converges to the
+        latest local booking.  Shared by the local filter path and the
+        sharded owner-side commit (shard_commit)."""
+        plock = self._acquire_patch_lock(uid)
+        try:
+            if not self.pods.booking_current(uid, node):
+                pi = self.pods.all_pods().get(uid)
+                if pi is not None and pi.node == node:
+                    # an ingest replay of the wire's own assignment state
+                    # replaced the pending booking for the same node:
+                    # already durable, nothing to patch
+                    return None
+                # a concurrent re-filter superseded this booking; its
+                # patch (behind the same lock) is the valid one
+                return "assignment superseded by concurrent re-filter"
+            patch = {
+                annotations.ASSIGNED_NODE: node,
+                annotations.ASSIGNED_TIME: _now_ts(),
+                annotations.ASSIGNED_IDS: enc,
+                annotations.DEVICES_TO_ALLOCATE: enc,
+                # a fresh assignment supersedes any stale bind-phase from
+                # a previous failed attempt — left in place it would make
+                # the ingest sweep drop this booking (merge-patch null
+                # deletes)
+                annotations.BIND_PHASE: None,
+            }
+            ctx = trace.context_of(sp) if sp is not None else None
+            if ctx is not None:
+                # propagate the trace so the plugin's Allocate continues
+                # this pod's lifecycle trace
+                patch[annotations.TRACE_CONTEXT] = ctx
+            t_patch = time.perf_counter()
+            try:
+                with trace.span(
+                    "assign_patch",
+                    pod=pod["metadata"]["name"],
+                    node=node,
+                ):
+                    self.client.patch_pod_annotations(
+                        pod["metadata"].get("namespace", "default"),
+                        pod["metadata"]["name"],
+                        patch,
+                    )
+            except Exception as e:  # noqa: BLE001 — unbook
+                log.exception(
+                    "filter: assignment patch failed for %s; unbooking",
+                    pod["metadata"]["name"],
+                )
+                # conditional: only the booking THIS filter made (still
+                # pending, same node)
+                self.pods.rm_pod_if_pending(uid, node)
+                return f"assignment patch: {e}"
+            else:
+                self.pods.confirm_pod(uid, node)
+                return None
+            finally:
+                _PATCH_HIST.observe(time.perf_counter() - t_patch)
+        finally:
+            self._release_patch_lock(uid, plock)
+
     def _acquire_patch_lock(self, uid: str):
         with self._patch_locks_guard:
             ent = self._patch_locks.get(uid)
             if ent is None:
                 ent = self._patch_locks[uid] = [threading.Lock(), 0]
             ent[1] += 1
+            if len(self._patch_locks) > self._patch_locks_hwm:
+                self._patch_locks_hwm = len(self._patch_locks)
+            if len(self._patch_locks) > PATCH_LOCK_SWEEP_THRESHOLD:
+                # defensive: by construction every entry has refcount ≥ 1
+                # (the eager pop below reclaims on last release), so a map
+                # this large means a leak — sweep the dead weight and say so
+                dead = [u for u, e in self._patch_locks.items() if e[1] <= 0]
+                for u in dead:
+                    self._patch_locks.pop(u, None)
+                if dead:
+                    log.warning(
+                        "patch-lock map swept %d zero-refcount entries "
+                        "(leak guard; map had %d)",
+                        len(dead), len(self._patch_locks) + len(dead),
+                    )
         ent[0].acquire()
         return ent
+
+    def patch_lock_stats(self) -> Dict[str, int]:
+        """Live per-uid patch-lock map size + high-water mark — rendered
+        on /metrics; the soak tests assert the map drains to empty."""
+        with self._patch_locks_guard:
+            return {
+                "tracked": len(self._patch_locks),
+                "hwm": self._patch_locks_hwm,
+            }
 
     def _release_patch_lock(self, uid: str, ent) -> None:
         ent[0].release()
@@ -536,18 +694,44 @@ class Scheduler:
             if ent[1] <= 0:
                 self._patch_locks.pop(uid, None)
 
-    def _select_and_book(
-        self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs=None
-    ) -> Tuple[FilterResult, Optional[str], Dict[str, dict]]:
-        """Candidate walk over the incremental usage cache + local booking.
-        Holds only in-memory locks; returns (result, encoded placement —
-        None unless a booking was made, per-node verdicts for the decision
-        audit log).  Caller patches the assignment annotations outside the
-        filter lock and unbooks on patch failure."""
+    def _memo_for(self, req_key: tuple) -> Dict[str, tuple]:
+        """Resolve (or create) the per-request-shape memo.  Caller holds
+        the cache lock — the memo's guard under concurrent filters."""
+        memo = self._single_eval_memo.get(req_key)
+        if memo is None:
+            if len(self._single_eval_memo) >= 8:
+                # bounded: drop the oldest request shape (dict order)
+                self._single_eval_memo.pop(
+                    next(iter(self._single_eval_memo))
+                )
+            memo = self._single_eval_memo[req_key] = {}
+        return memo
+
+    def _evaluate_candidates(
+        self, pod: dict, node_names: List[str], reqs, pod_annos,
+        node_objs=None, collect_verdicts: bool = True,
+    ) -> Tuple[
+        Optional[Tuple[float, str, object, int]],
+        Dict[str, str],
+        Dict[str, dict],
+    ]:
+        """Lock-free candidate walk over generation-stamped snapshots.
+
+        Never books: returns (best = (score, node, payload, generation) or
+        None, per-node failure reasons, per-node verdicts for the decision
+        audit log).  The cache lock is taken per CHUNK of nodes, not
+        across the whole list — concurrent filters and churn events
+        interleave with a 10k-node walk instead of queueing behind it.
+        Mid-walk mutations are tolerated: the returned generation stamps
+        what the evaluation saw, and the commit's per-node CAS
+        (UsageCache.try_book) rejects anything stale.
+
+        ``collect_verdicts=False`` (the peer-replica evaluate path) skips
+        building the per-node verdict dicts — at 10k nodes that is 10k
+        dict allocations per walk serving nobody: the coordinator's
+        decision log only records its own subset's verdicts plus the
+        winner."""
         uid = pod_uid(pod)
-        # each node must be evaluated at most once — a duplicate entry
-        # would see (and double-count) the first evaluation's bookings
-        node_names = list(dict.fromkeys(node_names))
         ici_policy = pod_annos.get("vtpu.io/ici-policy", self.config.ici_policy)
         policy = self.config.node_scheduler_policy
         # fast path: one container, one chip share — the dominant request
@@ -555,7 +739,7 @@ class Scheduler:
         # per-node clones (score.evaluate_single never mutates)
         single = len(reqs) == 1 and len(reqs[0]) == 1 and reqs[0][0].nums == 1
         cache = self.usage_cache
-        memo: Optional[Dict[str, tuple]] = None
+        req_key: Optional[tuple] = None
         if single:
             req0 = reqs[0][0]
             req_key = (
@@ -567,14 +751,6 @@ class Scheduler:
                 pod_annos.get(annotations.USE_TPUTYPE, ""),
                 pod_annos.get(annotations.NOUSE_TPUTYPE, ""),
             )
-            memo = self._single_eval_memo.get(req_key)
-            if memo is None:
-                if len(self._single_eval_memo) >= 8:
-                    # bounded: drop the oldest request shape (dict order)
-                    self._single_eval_memo.pop(
-                        next(iter(self._single_eval_memo))
-                    )
-                memo = self._single_eval_memo[req_key] = {}
         check = (
             nodecheck.make_checker(pod) if self.config.node_validity_check else None
         )
@@ -586,29 +762,32 @@ class Scheduler:
         # per-node verdicts for the decision audit log: reject reason or
         # score breakdown; the chosen node later gets its placement added
         verdicts: Dict[str, dict] = {}
-        for attempt in (0, 1):
-            best = None
-            failed = {}
-            verdicts = {}
+        # the pod's own node (re-filter after a bind failure) must not see
+        # its previous assignment as occupancy — that one node takes the
+        # clone-with-exclusion path (clone_node reads live bookings, so a
+        # stale own_node can only cost a clone, never correctness)
+        own_node = cache.pod_node(uid)
+        chunk = max(1, self.config.filter_chunk)
+        for start in range(0, len(node_names), chunk):
+            part = node_names[start:start + chunk]
             with cache.locked():
-                # the pod's own node (re-filter after a bind failure) must
-                # not see its previous assignment as occupancy — that one
-                # node takes the clone-with-exclusion path
-                own_node = cache.pod_node(uid)
-                for name in node_names:
+                memo = self._memo_for(req_key) if single else None
+                for name in part:
                     if check is not None:
                         reason = check(node_objs.get(name) or poll_objs.get(name))
                         if reason is not None:
                             failed[name] = reason
-                            verdicts[name] = {"fit": False, "reason": reason}
+                            if collect_verdicts:
+                                verdicts[name] = {"fit": False, "reason": reason}
                             continue
                     if single and name != own_node:
                         entry = cache.peek_entry(name)
                         if entry is None:
                             failed[name] = "no vtpu devices registered"
-                            verdicts[name] = {
-                                "fit": False, "reason": failed[name],
-                            }
+                            if collect_verdicts:
+                                verdicts[name] = {
+                                    "fit": False, "reason": failed[name],
+                                }
                             continue
                         nu, gen, base_util = entry
                         m = memo.get(name)  # type: ignore[union-attr]
@@ -626,60 +805,59 @@ class Scheduler:
                             memo[name] = (gen, res)  # type: ignore[index]
                         if res is None:
                             failed[name] = "insufficient vtpu resources"
-                            verdicts[name] = {
-                                "fit": False, "reason": failed[name],
-                            }
+                            if collect_verdicts:
+                                verdicts[name] = {
+                                    "fit": False, "reason": failed[name],
+                                }
                             continue
                         dev_uuid, mem, s = res
                         payload: object = (dev_uuid, mem)
-                        verdicts[name] = {
-                            "fit": True, "score": round(s, 6),
-                            "device": dev_uuid, "mem": mem,
-                        }
+                        if collect_verdicts:
+                            verdicts[name] = {
+                                "fit": True, "score": round(s, 6),
+                                "device": dev_uuid, "mem": mem,
+                            }
                     else:
                         nu, gen = cache.clone_node(name, exclude_uid=uid)
                         if nu is None:
                             failed[name] = "no vtpu devices registered"
-                            verdicts[name] = {
-                                "fit": False, "reason": failed[name],
-                            }
+                            if collect_verdicts:
+                                verdicts[name] = {
+                                    "fit": False, "reason": failed[name],
+                                }
                             continue
                         payload = score_mod.fit_pod(
                             nu, reqs, pod_annos, policy, ici_policy
                         )
                         if payload is None:
                             failed[name] = "insufficient vtpu resources"
-                            verdicts[name] = {
-                                "fit": False, "reason": failed[name],
-                            }
+                            if collect_verdicts:
+                                verdicts[name] = {
+                                    "fit": False, "reason": failed[name],
+                                }
                             continue
                         s = score_mod.score_node(nu, policy)
-                        verdicts[name] = {"fit": True, "score": round(s, 6)}
+                        if collect_verdicts:
+                            verdicts[name] = {"fit": True, "score": round(s, 6)}
                     if best is None or s > best[0]:
                         best = (s, name, payload, gen)
-            if best is None:
-                return (
-                    FilterResult(None, failed, "no node fits vtpu request"),
-                    None,
-                    verdicts,
-                )
-            # generation check: a background registry/pod event may have
-            # changed the chosen node between evaluation and now (the
-            # cache lock is released before booking to keep lock order
-            # manager→cache everywhere).  On mismatch, re-run selection
-            # once; a second mismatch books anyway — the filter lock
-            # serialises peers, and the annotation bus reconciles.
-            if attempt == 0 and cache.generation(best[1]) != best[3]:
-                self.filter_gen_retries += 1
-                continue
-            break
-        s, chosen, payload, _gen = best  # type: ignore[misc]
+        return best, failed, verdicts
+
+    def _commit_booking(
+        self, pod: dict, chosen: str, gen: int, payload, reqs
+    ) -> Tuple[str, Optional[str], Optional[PodDevices]]:
+        """CAS-commit one selected candidate: build the placement, book it
+        through UsageCache.try_book against the generation the selection
+        saw, and register the pending booking with the PodManager.
+        Returns ("ok", encoded placement, placement) or
+        ("conflict", None, None) when the generation moved — the caller
+        re-runs selection against fresh snapshots."""
         if isinstance(payload, tuple):
             # fast path defers placement construction to the winner —
             # loser candidates never allocate
             dev_uuid, mem = payload
             req0 = reqs[0][0]
-            placement = [
+            placement: PodDevices = [
                 [
                     ContainerDevice(
                         uuid=dev_uuid,
@@ -691,20 +869,35 @@ class Scheduler:
             ]
         else:
             placement = payload
-        enc = codec.encode_pod_devices(placement)  # type: ignore[arg-type]
-        # pessimistic booking so concurrent filters see the usage
-        # (ref score.go writes assignment then books usage); pending=True
-        # keeps the booking alive through informer sweeps until the
-        # annotation patch lands (state.PENDING_PATCH_GRACE_S)
+        uid = pod_uid(pod)
+        # the per-node CAS: atomically (re)book only if nothing on the
+        # node changed since this filter's evaluation — the lock-free
+        # analog of the old global-lock critical section
+        if not self.usage_cache.try_book(uid, chosen, gen, placement):
+            _CAS_CONFLICTS.inc()
+            return "conflict", None, None
+        enc = codec.encode_pod_devices(placement)
+        # register the booking with the pod manager so informer sweeps,
+        # grace handling, and the patch machinery see it; the cache
+        # recognises the identical booking and skips the no-op replay.
+        # pending=True keeps it alive until the annotation patch lands
+        # (state.PENDING_PATCH_GRACE_S).
         fresh = dict(pod)
         fresh_annos = dict(get_annotations(pod))
         fresh_annos[annotations.ASSIGNED_IDS] = enc
         fresh_annos[annotations.ASSIGNED_NODE] = chosen
         fresh["metadata"] = dict(pod["metadata"], annotations=fresh_annos)
-        self.pods.add_pod(fresh, chosen, placement, pending=True)  # type: ignore[arg-type]
-        # the winner's verdict carries the concrete placement — for gangs
-        # this is the chosen topology rectangle (the device-uuid set)
-        verdicts.setdefault(chosen, {"fit": True, "score": round(s, 6)})
+        self.pods.add_pod(fresh, chosen, placement, pending=True)
+        return "ok", enc, placement
+
+    @staticmethod
+    def decorate_winner(
+        verdicts: Dict[str, dict], chosen: str, score: float,
+        placement: PodDevices,
+    ) -> None:
+        """Attach the concrete placement to the winner's verdict — for
+        gangs this is the chosen topology rectangle (the device-uuid set)."""
+        verdicts.setdefault(chosen, {"fit": True, "score": round(score, 6)})
         verdicts[chosen] = dict(
             verdicts[chosen],
             chosen=True,
@@ -717,10 +910,178 @@ class Scheduler:
                 for ctr in placement
             ],
         )
-        log.info(
-            "filter: pod %s → node %s (score %.3f)", pod["metadata"]["name"], chosen, s
+
+    def _select_and_book(
+        self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs=None
+    ) -> Tuple[FilterResult, Optional[str], Dict[str, dict]]:
+        """Optimistic select→book: lock-free candidate walk, per-node CAS
+        commit, bounded retry.  Returns (result, encoded placement — None
+        unless a booking was made, per-node verdicts for the decision
+        audit log).  Caller patches the assignment annotations afterwards
+        and unbooks on patch failure.
+
+        A CAS conflict means a concurrent filter's booking (or a registry/
+        pod event) changed the chosen node between evaluation and commit.
+        The retry is two-tier: first RE-VALIDATE just the conflicted node
+        (a microseconds-scale single-node evaluation — under a binpack
+        burst every thread chases the same most-loaded target, and paying
+        a full cluster re-walk per conflict would leave a walk-sized
+        window for the next conflict: a livelock at 10k nodes); only when
+        the node no longer fits does selection re-run over the whole
+        candidate list.  Both tiers are bounded together by
+        config.cas_max_retries; exhaustion aborts with an error (the real
+        retry/abort path that replaced the old "second mismatch books
+        anyway" escape hatch) and kube-scheduler re-queues the pod."""
+        # node_names arrives deduplicated from filter() — the only caller
+        best, failed, verdicts = self._evaluate_candidates(
+            pod, node_names, reqs, pod_annos, node_objs
         )
-        return FilterResult(node=chosen, failed=failed, error=""), enc, verdicts
+        for _attempt in range(max(0, self.config.cas_max_retries) + 1):
+            if best is None:
+                return (
+                    FilterResult(None, failed, "no node fits vtpu request"),
+                    None,
+                    verdicts,
+                )
+            s, chosen, payload, gen = best
+            status, enc, placement = self._commit_booking(
+                pod, chosen, gen, payload, reqs
+            )
+            if status == "ok":
+                self.decorate_winner(verdicts, chosen, s, placement)
+                log.info(
+                    "filter: pod %s → node %s (score %.3f)",
+                    pod["metadata"]["name"], chosen, s,
+                )
+                return (
+                    FilterResult(node=chosen, failed=failed, error=""),
+                    enc,
+                    verdicts,
+                )
+            # conflict: the chosen node changed under us
+            self.note_gen_retry()
+            # tier 1: cheap re-validation of the same node at its fresh
+            # generation (ranking staleness is bounded by the bookings
+            # that landed mid-flight — the snapshot staleness any
+            # extender-based scheduler already tolerates)
+            best, _f2, _v2 = self._evaluate_candidates(
+                pod, [chosen], reqs, pod_annos, node_objs,
+                collect_verdicts=False,
+            )
+            if best is None:
+                # tier 2: the node filled up — re-select over everything
+                # (the fresh walk re-evaluates the conflicted node too,
+                # so failed/verdicts are simply rebound)
+                best, failed, verdicts = self._evaluate_candidates(
+                    pod, node_names, reqs, pod_annos, node_objs
+                )
+        _CAS_ABORTS.inc()
+        log.warning(
+            "filter: pod %s aborted after %d CAS conflicts (contended "
+            "nodes); kube-scheduler will retry",
+            pod["metadata"]["name"], self.config.cas_max_retries + 1,
+        )
+        return (
+            FilterResult(
+                None, failed,
+                "optimistic booking: generation conflicts exhausted retries",
+            ),
+            None,
+            verdicts,
+        )
+
+    # ------------------------------------------------------------------
+    # Sharded-replica surface (vtpu/scheduler/shard.py + routes)
+    # ------------------------------------------------------------------
+    def owned_node_names(self) -> List[str]:
+        """Registry nodes this replica owns under the shard ring (all of
+        them when unsharded) — the default evaluate subset for peers."""
+        names = list(self.nodes.all_nodes())
+        if self.shard is None:
+            return names
+        return self.shard.owned(names)
+
+    def shard_evaluate(self, pod: dict, node_names=None) -> dict:
+        """Peer-facing subset evaluation (POST /shard/evaluate): run the
+        lock-free candidate walk over ``node_names`` (default: every
+        registry node this replica owns) and return a wire-friendly
+        summary — the best candidate with its generation stamp plus the
+        per-node failure map.  Never books."""
+        reqs = resource_reqs(
+            pod, self.config.default_mem, self.config.default_cores
+        )
+        if sum(r.nums for ctr in reqs for r in ctr) == 0:
+            return {"failed": {}, "fits": 0}
+        pod_annos = get_annotations(pod)
+        if node_names is None:
+            node_names = self.owned_node_names()
+        node_names = list(dict.fromkeys(node_names))
+        best, failed, _verdicts = self._evaluate_candidates(
+            pod, node_names, reqs, pod_annos, None, collect_verdicts=False
+        )
+        out: dict = {
+            "failed": failed,
+            "fits": len(node_names) - len(failed),
+        }
+        if best is not None:
+            out["best"] = {
+                "score": best[0], "node": best[1], "gen": best[3],
+            }
+        return out
+
+    def shard_commit(self, pod: dict, node: str, expected_gen: int) -> dict:
+        """Owner-side commit (POST /shard/commit): re-evaluate ``node``
+        FRESH, CAS-commit at the fresh generation, and write the
+        assignment annotations.  Returns {"status": "ok" | "conflict" |
+        "no_fit" | "error", ...}.
+
+        Staleness policy: ``expected_gen`` (what the coordinator's merge
+        saw) going stale is the COMMON case under a same-shape arrival
+        burst — every booking on a popular binpack target bumps its
+        generation.  Bouncing each of those back to the coordinator would
+        be a conflict storm, so the owner absorbs benign staleness: if
+        the node still fits after a fresh evaluation it commits anyway
+        (reported as ``stale_gen: true`` and counted in
+        vtpu_filter_cas_conflicts_total).  Safety never rests on
+        expected_gen — try_book's internal CAS against the FRESH
+        generation is what prevents double-booking; ranking staleness is
+        bounded by the bookings that landed mid-flight, the same snapshot
+        staleness any extender-based scheduler already tolerates.  A
+        "conflict" return (concurrent commit raced the fresh evaluation,
+        twice) sends the coordinator back to re-merge."""
+        uid = pod_uid(pod)
+        reqs = resource_reqs(
+            pod, self.config.default_mem, self.config.default_cores
+        )
+        pod_annos = get_annotations(pod)
+        with trace.span("shard_commit", trace_id=uid, node=node) as sp:
+            stale = False
+            for _ in range(2):  # fresh eval + one internal CAS retry
+                best, failed, _verdicts = self._evaluate_candidates(
+                    pod, [node], reqs, pod_annos, None,
+                    collect_verdicts=False,
+                )
+                if best is None:
+                    return {"status": "no_fit", "failed": failed}
+                s, chosen, payload, gen = best
+                if gen != expected_gen and not stale:
+                    stale = True
+                    _CAS_CONFLICTS.inc()
+                status, enc, _placement = self._commit_booking(
+                    pod, chosen, gen, payload, reqs
+                )
+                if status == "ok":
+                    err = self._patch_assignment(pod, uid, chosen, enc, sp)
+                    if err is not None:
+                        return {"status": "error", "error": err}
+                    return {
+                        "status": "ok", "node": chosen, "enc": enc,
+                        "score": s, "stale_gen": stale,
+                    }
+            return {
+                "status": "conflict",
+                "gen": self.usage_cache.generation(node),
+            }
 
     # ------------------------------------------------------------------
     # Bind (ref Bind scheduler.go:402-442)

@@ -45,6 +45,14 @@ _MEASURED_DUTY = _REG.gauge(
     "Per-device duty cycle reported by the node monitor's "
     "vtpu.io/node-utilization write-back annotation",
 )
+# per-uid patch-lock map hygiene (docs/scheduler_perf.md §Optimistic
+# booking): tracked must hover near the live filter concurrency and drain
+# to 0 when arrival stops — a monotonically growing value is a leak
+_PATCH_LOCKS = _REG.gauge(
+    "vtpu_filter_patch_locks_total",
+    "Per-pod assignment-patch lock entries (kind=tracked: live now, "
+    "kind=hwm: high-water mark since start)",
+)
 _gauge_lock = threading.Lock()
 _prev_frag: Set[Tuple[str, ...]] = set()
 _prev_hist: Set[str] = set()
@@ -272,6 +280,9 @@ def render_metrics(sched: Scheduler, include_obs: bool = True) -> str:
     if not include_obs:
         return legacy
     _update_capacity_gauges(sched, usage)
+    plocks = sched.patch_lock_stats()
+    _PATCH_LOCKS.set(plocks["tracked"], kind="tracked")
+    _PATCH_LOCKS.set(plocks["hwm"], kind="hwm")
     # "obs" carries the cross-component families (event counts, readiness
     # breakdown) — rendered once, after this component's own registry
     return (legacy

@@ -11,8 +11,11 @@ wire contract:
   GET  /readyz   deep readiness (named checks, vtpu/obs/ready)
 
 plus the debug surface on the plain listener: /spans, /timeline,
-/trace.json, /decisions, /events (the typed journal) and /audit (the
-reconciliation verdict report, vtpu/audit).
+/trace.json, /decisions, /events (the typed journal), /audit (the
+reconciliation verdict report, vtpu/audit), and the sharded-replica
+surface (vtpu/scheduler/shard.py): GET /shard (ring/ownership status),
+POST /shard/evaluate and /shard/commit (peer-replica subset evaluation
+and owner-side CAS commit — plain listener only, never the TLS port).
 
 Served by a stdlib ThreadingHTTPServer; the extender is pure
 request/response over in-memory state, so no framework is needed.
@@ -125,6 +128,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(500, str(e).encode(), "text/plain")
                 return
             self._send(200, body)
+        elif self.allow_debug and route == "/shard":
+            # sharded-replica status: ring ownership, peers, leadership
+            # (vtpu/scheduler/shard.py)
+            shard = getattr(self.scheduler, "shard", None)
+            body: dict = {"enabled": shard is not None}
+            if shard is not None:
+                body.update(shard.status())
+            elector = getattr(self.scheduler, "elector", None)
+            if elector is not None:
+                body["leader"] = elector.is_leader()
+                body["holder"] = elector.current_holder()
+            else:
+                body["leader"] = True  # single replica: always write leader
+            self._send(200, json.dumps(body, default=str).encode())
         elif self.allow_debug and route == "/events":
             # the typed event journal (vtpu/obs/events)
             from vtpu.obs.events import journal
@@ -190,6 +207,20 @@ class _Handler(BaseHTTPRequestHandler):
                 out = filter_handler(self.scheduler, body)
             elif self.path == "/bind":
                 out = bind_handler(self.scheduler, body)
+            elif self.path == "/shard/evaluate" and self.allow_debug:
+                # peer-replica subset evaluation (vtpu/scheduler/shard.py):
+                # lock-free walk over the nodes this replica owns; never
+                # books.  Served on the plain in-cluster listener only.
+                out = self.scheduler.shard_evaluate(
+                    body.get("pod") or {}, body.get("nodes")
+                )
+            elif self.path == "/shard/commit" and self.allow_debug:
+                # owner-side CAS commit for a coordinator-chosen node
+                out = self.scheduler.shard_commit(
+                    body.get("pod") or {},
+                    body.get("node", ""),
+                    int(body.get("gen", -1)),
+                )
             elif self.path == "/webhook":
                 out = handle_admission_review(body, self.scheduler.config)
             elif self.path == "/spans/ingest" and self.allow_debug:

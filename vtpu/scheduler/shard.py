@@ -1,0 +1,500 @@
+"""Sharded extender replicas: consistent-hash node ownership, the thin
+merge layer, peer transports, and annotation-lease leader election.
+
+A single extender process is both the throughput ceiling and the SPOF of
+the control plane.  This module makes it horizontal:
+
+- **Ownership** (``HashRing``): every node name hashes onto a ring of
+  replica vnodes; exactly one replica *owns* each node.  Only the owner
+  evaluates and books a node, so the per-node CAS in
+  ``UsageCache.try_book`` needs no cross-replica coordination — ownership
+  partitions the booking space.  The ring is deterministic (md5, never
+  the salted builtin ``hash``), and removing a replica only remaps the
+  nodes it owned (consistent hashing's point: failover does not reshuffle
+  the cluster).
+- **Merge layer** (``ShardCoordinator``): any replica can receive the
+  kube-scheduler's ``POST /filter``.  The receiver partitions the
+  candidate list by ownership, evaluates its own subset in-process,
+  fans ``POST /shard/evaluate`` out to peers for theirs, merges the
+  per-replica best candidates, and CAS-commits at the winner's owner
+  (locally, or via ``POST /shard/commit``).  A commit conflict re-runs
+  only the conflicted owner's evaluation — bounded by
+  ``config.cas_max_retries`` like the local path.
+- **State**: every replica rebuilds the full registry and booking ledger
+  from the annotation bus (node register annotations + pod assignment
+  annotations) exactly like a restarted single scheduler — cold-start
+  failover needs no handoff, and the cluster auditor (vtpu/audit) is the
+  oracle that a failed-over replica converged.
+- **Leader election** (``LeaderElector``): write-back consumers — the
+  handshake state-machine patches and the periodic audit loop — run on
+  one elected replica.  The lease is an annotation on a dedicated
+  election Node object, acquired with a resourceVersion-conditional
+  patch (the same optimistic-concurrency primitive as the node lock,
+  vtpu/utils/nodelock.py): "annotations are the database", including for
+  the control plane's own coordination.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+import time
+import urllib.request
+from bisect import bisect_right
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
+
+from vtpu import obs
+from vtpu.k8s.errors import Conflict, NotFound
+from vtpu.scheduler.core import FilterResult
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "HashRing",
+    "HttpPeer",
+    "LeaderElector",
+    "LocalPeer",
+    "ShardCoordinator",
+]
+
+_REG = obs.registry("scheduler")
+_EVAL_HIST = _REG.histogram(
+    "vtpu_shard_evaluate_seconds",
+    "Per-peer subset evaluation during a sharded filter (label peer: "
+    "local = this replica's own walk, else the peer replica id)",
+)
+_COMMIT_TOTAL = _REG.counter(
+    "vtpu_shard_commit_total",
+    "Owner-side CAS commits by result (ok / conflict / no_fit / error)",
+)
+_OWNED_NODES = _REG.gauge(
+    "vtpu_shard_owned_nodes_total",
+    "Registry nodes owned by this replica under the consistent-hash ring",
+)
+_LEADER_INFO = _REG.gauge(
+    "vtpu_shard_leader_info",
+    "1 when this replica currently holds the write-back leader lease "
+    "(label holder = this replica's id)",
+)
+
+DEFAULT_VNODES = 64
+LEASE_NODE = "vtpu-scheduler-election"
+LEASE_ANNO = "vtpu.io/scheduler-leader"
+DEFAULT_LEASE_S = 15.0
+
+
+class HashRing:
+    """Consistent-hash ring over replica ids (md5-based: stable across
+    processes and restarts, unlike the salted builtin hash)."""
+
+    def __init__(self, replicas: List[str], vnodes: int = DEFAULT_VNODES) -> None:
+        if not replicas:
+            raise ValueError("HashRing needs at least one replica")
+        self.replicas = sorted(set(replicas))
+        self.vnodes = max(1, vnodes)
+        points: List[Tuple[int, str]] = []
+        for rid in self.replicas:
+            for v in range(self.vnodes):
+                points.append((self._hash(f"{rid}#{v}"), rid))
+        points.sort()
+        self._keys = [p[0] for p in points]
+        self._owners = [p[1] for p in points]
+        # node → owner memo: the ring is immutable per instance and the
+        # coordinator asks for the same 10k names on every filter — an
+        # md5 + bisect per name per call would be pure recomputation on
+        # the hot path.  Bounded defensively: synthetic name storms
+        # (churn benches, fuzzers) must not grow it without limit.
+        self._memo: Dict[str, str] = {}
+        self._memo_cap = 262144
+
+    @staticmethod
+    def _hash(s: str) -> int:
+        return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "big")
+
+    def owner(self, node_name: str) -> str:
+        """The replica owning ``node_name`` (first vnode clockwise)."""
+        got = self._memo.get(node_name)
+        if got is not None:
+            return got
+        h = self._hash(node_name)
+        idx = bisect_right(self._keys, h)
+        if idx == len(self._keys):
+            idx = 0
+        rid = self._owners[idx]
+        if len(self._memo) >= self._memo_cap:
+            self._memo.clear()
+        self._memo[node_name] = rid
+        return rid
+
+    def partition(self, node_names: List[str]) -> Dict[str, List[str]]:
+        """Split a candidate list by owning replica (order-preserving)."""
+        parts: Dict[str, List[str]] = {}
+        for name in node_names:
+            parts.setdefault(self.owner(name), []).append(name)
+        return parts
+
+
+class LocalPeer:
+    """In-process peer transport — a replica living in the same process
+    (tests, the churn bench's single-process arms)."""
+
+    def __init__(self, sched) -> None:
+        self.sched = sched
+
+    def evaluate(self, pod: dict, node_names: Optional[List[str]]) -> dict:
+        return self.sched.shard_evaluate(pod, node_names)
+
+    def commit(self, pod: dict, node: str, gen: int) -> dict:
+        return self.sched.shard_commit(pod, node, gen)
+
+
+class HttpPeer:
+    """HTTP peer transport against another replica's plain listener
+    (POST /shard/evaluate, /shard/commit — vtpu/scheduler/routes.py)."""
+
+    def __init__(self, base_url: str, timeout_s: float = 5.0) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _post(self, path: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def evaluate(self, pod: dict, node_names: Optional[List[str]]) -> dict:
+        return self._post("/shard/evaluate", {"pod": pod, "nodes": node_names})
+
+    def commit(self, pod: dict, node: str, gen: int) -> dict:
+        return self._post(
+            "/shard/commit", {"pod": pod, "node": node, "gen": gen}
+        )
+
+
+class ShardCoordinator:
+    """The thin merge layer a replica runs when it receives a filter
+    request: partition by ownership, fan out, merge, commit at the owner.
+    Attached to a Scheduler as ``sched.shard``."""
+
+    def __init__(
+        self,
+        sched,
+        replica_id: str,
+        peers: Optional[Dict[str, object]] = None,
+        vnodes: int = DEFAULT_VNODES,
+    ) -> None:
+        self.sched = sched
+        self.replica_id = replica_id
+        self.peers: Dict[str, object] = dict(peers or {})
+        self.ring = HashRing([replica_id, *self.peers], vnodes)
+        # persistent fan-out workers: coordinate() runs on the /filter hot
+        # path, and spawning+joining a Thread per peer per pod would pay
+        # OS thread churn at every request
+        self._pool = (
+            ThreadPoolExecutor(
+                max_workers=len(self.peers),
+                thread_name_prefix=f"vtpu-shard-{replica_id}",
+            )
+            if self.peers else None
+        )
+
+    def owned(self, node_names: List[str]) -> List[str]:
+        """This replica's subset of ``node_names`` under the ring."""
+        me = self.replica_id
+        return [n for n in node_names if self.ring.owner(n) == me]
+
+    def status(self) -> dict:
+        """GET /shard body: ownership + ring shape (refreshes the
+        owned-nodes gauge as a side effect)."""
+        names = list(self.sched.nodes.all_nodes())
+        owned = self.owned(names)
+        _OWNED_NODES.set(len(owned))
+        return {
+            "replica": self.replica_id,
+            "peers": sorted(self.peers),
+            "ring_vnodes": self.ring.vnodes,
+            "registry_nodes": len(names),
+            "owned_nodes": len(owned),
+        }
+
+    # -- one sharded filter --------------------------------------------
+    def _eval_one(
+        self, rid: str, pod: dict, names: List[str], out: Dict[str, dict]
+    ) -> None:
+        t0 = time.perf_counter()
+        try:
+            out[rid] = self.peers[rid].evaluate(pod, names)
+        except Exception as e:  # noqa: BLE001 — a dead peer fails its subset
+            log.warning("shard: peer %s evaluate failed: %s", rid, e)
+            out[rid] = {
+                "failed": {n: f"shard peer {rid} unreachable" for n in names},
+                "fits": 0,
+            }
+        finally:
+            _EVAL_HIST.observe(time.perf_counter() - t0, peer=rid)
+
+    def coordinate(
+        self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs
+    ) -> Tuple[FilterResult, Optional[str], Dict[str, dict], bool]:
+        """Returns (result, enc — None when committed remotely or no
+        booking, verdicts, committed_remote).  When committed_remote is
+        True the owner replica already wrote the assignment annotations;
+        the caller must not patch again."""
+        sched = self.sched
+        parts = self.ring.partition(node_names)
+        local_names = parts.pop(self.replica_id, [])
+        remote: Dict[str, dict] = {}
+        futures = [
+            self._pool.submit(self._eval_one, rid, pod, names, remote)
+            for rid, names in parts.items()
+        ] if self._pool is not None else []
+        # the local subset evaluates on this thread while peers work
+        t0 = time.perf_counter()
+        local_best, failed, verdicts = sched._evaluate_candidates(
+            pod, local_names, reqs, pod_annos, node_objs
+        )
+        _EVAL_HIST.observe(time.perf_counter() - t0, peer="local")
+        for f in futures:
+            f.result()
+        for rep in remote.values():
+            failed.update(rep.get("failed", {}))
+        # candidates: replica id → (score, node, gen, payload-or-None)
+        candidates: Dict[str, Tuple[float, str, int, object]] = {}
+        if local_best is not None:
+            s, node, payload, gen = local_best
+            candidates[self.replica_id] = (s, node, gen, payload)
+        for rid, rep in remote.items():
+            b = rep.get("best")
+            if b:
+                candidates[rid] = (b["score"], b["node"], b["gen"], None)
+        for _attempt in range(max(0, sched.config.cas_max_retries) + 1):
+            if not candidates:
+                return (
+                    FilterResult(None, failed, "no node fits vtpu request"),
+                    None, verdicts, False,
+                )
+            # highest score wins; node-name tiebreak keeps it deterministic
+            rid = max(candidates, key=lambda r: (candidates[r][0],
+                                                 candidates[r][1]))
+            s, node, gen, payload = candidates[rid]
+            if rid == self.replica_id:
+                status, enc, placement = sched._commit_booking(
+                    pod, node, gen, payload, reqs
+                )
+                _COMMIT_TOTAL.inc(result=status)
+                if status == "ok":
+                    # a node that failed an EARLIER round but won after a
+                    # retry must not appear in failedNodes too — the
+                    # extender response would contradict itself
+                    failed.pop(node, None)
+                    sched.decorate_winner(verdicts, node, s, placement)
+                    return (
+                        FilterResult(node=node, failed=failed, error=""),
+                        enc, verdicts, False,
+                    )
+            else:
+                try:
+                    rep = self.peers[rid].commit(pod, node, gen)
+                except Exception as e:  # noqa: BLE001 — owner died mid-commit
+                    log.warning("shard: peer %s commit failed: %s", rid, e)
+                    rep = {"status": "error",
+                           "error": f"shard peer {rid} unreachable"}
+                status = rep.get("status", "error")
+                _COMMIT_TOTAL.inc(result=status)
+                if status == "ok":
+                    failed.pop(node, None)
+                    verdicts[node] = {
+                        "fit": True, "score": round(s, 6), "chosen": True,
+                        "remote": rid,
+                    }
+                    return (
+                        FilterResult(node=node, failed=failed, error=""),
+                        rep.get("enc"), verdicts, True,
+                    )
+                if status == "error":
+                    return (
+                        FilterResult(
+                            None, failed,
+                            rep.get("error", "shard commit error"),
+                        ),
+                        None, verdicts, True,
+                    )
+            # conflict (or owner-side no_fit): that owner's view changed —
+            # re-evaluate only its subset, re-merge, retry
+            sched.note_gen_retry()
+            candidates.pop(rid, None)
+            if rid == self.replica_id:
+                fresh_best, f2, v2 = sched._evaluate_candidates(
+                    pod, local_names, reqs, pod_annos, node_objs
+                )
+                failed.update(f2)
+                verdicts.update(v2)
+                if fresh_best is not None:
+                    fs, fn, fp, fg = fresh_best
+                    candidates[rid] = (fs, fn, fg, fp)
+            else:
+                self._eval_one(rid, pod, parts[rid], remote)
+                rep = remote[rid]
+                failed.update(rep.get("failed", {}))
+                b = rep.get("best")
+                if b:
+                    candidates[rid] = (b["score"], b["node"], b["gen"], None)
+        from vtpu.scheduler import core as core_mod
+
+        core_mod._CAS_ABORTS.inc()
+        return (
+            FilterResult(
+                None, failed,
+                "optimistic booking: generation conflicts exhausted retries",
+            ),
+            None, verdicts, False,
+        )
+
+
+class LeaderElector:
+    """Annotation-lease leader election for the write-back consumers.
+
+    The lease lives in ``vtpu.io/scheduler-leader`` on a dedicated
+    election Node object (created on demand): ``{"holder": id, "ts":
+    epoch}``.  Acquisition and renewal are resourceVersion-conditional
+    patches — two replicas racing the same lease serialize on the
+    apiserver exactly like the distributed node lock.  A lease older than
+    ``lease_s`` is up for grabs; the holder renews every ``lease_s / 3``.
+    """
+
+    def __init__(
+        self,
+        client,
+        holder: str,
+        lease_s: float = DEFAULT_LEASE_S,
+        wallclock: Callable[[], float] = time.time,
+        lease_node: str = LEASE_NODE,
+    ) -> None:
+        self.client = client
+        self.holder = holder
+        self.lease_s = lease_s
+        self.lease_node = lease_node
+        self._wallclock = wallclock
+        self._lock = threading.Lock()
+        self._leader = False
+        self._last_renew = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_lease_obj(self) -> Optional[dict]:
+        try:
+            return self.client.get_node(self.lease_node)
+        except NotFound:
+            if not hasattr(self.client, "create_node"):
+                log.warning(
+                    "leader election: no %s object and the client cannot "
+                    "create it; staying follower", self.lease_node,
+                )
+                return None
+            try:
+                self.client.create_node(
+                    {"metadata": {"name": self.lease_node, "annotations": {}}}
+                )
+                return self.client.get_node(self.lease_node)
+            except Exception:  # noqa: BLE001 — lost a creation race is fine
+                try:
+                    return self.client.get_node(self.lease_node)
+                except Exception:  # noqa: BLE001
+                    return None
+
+    def try_acquire(self) -> bool:
+        """One acquisition/renewal attempt.  Returns the resulting
+        leadership state."""
+        node = self._ensure_lease_obj()
+        now = self._wallclock()
+        if node is None:
+            return self._set_leader(False, now)
+        annos = node.get("metadata", {}).get("annotations") or {}
+        try:
+            rec = json.loads(annos.get(LEASE_ANNO) or "{}")
+        except ValueError:
+            rec = {}
+        held_by = rec.get("holder", "")
+        try:
+            held_ts = float(rec.get("ts", 0.0))
+        except (TypeError, ValueError):
+            held_ts = 0.0
+        if held_by and held_by != self.holder and now - held_ts < self.lease_s:
+            return self._set_leader(False, now)  # fresh foreign lease
+        try:
+            self.client.patch_node_annotations(
+                self.lease_node,
+                {LEASE_ANNO: json.dumps({"holder": self.holder, "ts": now})},
+                resource_version=node["metadata"].get("resourceVersion"),
+            )
+        except (Conflict, NotFound):
+            return self._set_leader(False, now)  # lost the CAS race
+        except Exception:  # noqa: BLE001 — apiserver blip: drop leadership
+            log.exception("leader election: lease patch failed")
+            return self._set_leader(False, now)
+        return self._set_leader(True, now)
+
+    def _set_leader(self, leader: bool, now: float) -> bool:
+        with self._lock:
+            transition = leader != self._leader
+            self._leader = leader
+            if leader:
+                self._last_renew = now
+        _LEADER_INFO.set(1.0 if leader else 0.0, holder=self.holder)
+        if transition:
+            log.info(
+                "leader election: %s is now %s",
+                self.holder, "LEADER" if leader else "follower",
+            )
+        return leader
+
+    def is_leader(self) -> bool:
+        """Leadership with a freshness guard: a holder that failed to
+        renew within the lease window demotes itself — two replicas never
+        both believe they lead past one lease period."""
+        with self._lock:
+            return (
+                self._leader
+                and self._wallclock() - self._last_renew < self.lease_s
+            )
+
+    def current_holder(self) -> str:
+        node = self._ensure_lease_obj()
+        if node is None:
+            return ""
+        annos = node.get("metadata", {}).get("annotations") or {}
+        try:
+            return json.loads(annos.get(LEASE_ANNO) or "{}").get("holder", "")
+        except ValueError:
+            return ""
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self.try_acquire()
+
+        def loop() -> None:
+            while not self._stop.wait(self.lease_s / 3.0):
+                try:
+                    self.try_acquire()
+                except Exception:  # noqa: BLE001 — keep electing
+                    log.exception("leader election loop error")
+
+        self._thread = threading.Thread(
+            target=loop, name="vtpu-leader-elector", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: Optional[float] = 2.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)

@@ -25,6 +25,13 @@ filter lock.  This module replaces that rebuild with a materialized view:
   only candidate nodes the filter actually evaluates are copied);
   ``peek_entry`` exposes the live aggregate for the non-mutating
   single-request fast path (vtpu/scheduler/score.py:evaluate_single).
+- ``try_book`` is the optimistic-concurrency commit: the filter evaluates
+  against generation-stamped snapshots without any global lock and books
+  with a per-node compare-and-swap — the booking lands only if the node's
+  generation still matches the one the selection saw.  Any mutation
+  (booking, reversal, registry change) bumps the generation first, so a
+  matching generation proves nothing changed since evaluation and two
+  concurrent filters can never both book the same free capacity.
 
 Counters (hits / dirty rebuilds / delta updates / fallbacks) are exported
 through /metrics (vtpu/scheduler/metrics.py) — docs/scheduler_perf.md
@@ -92,6 +99,7 @@ class UsageCache:
         self.delta_updates = 0   # O(delta) booking applications/reversals
         self.fallbacks = 0       # events that forced a dirty mark
         self.misses = 0          # lookups of unknown nodes
+        self.cas_conflicts = 0   # try_book commits lost to a stale generation
 
     # -- locking ------------------------------------------------------
     def locked(self):
@@ -138,9 +146,44 @@ class UsageCache:
 
     def on_pod_changed(self, uid: str, node: str, devices: PodDevices) -> None:
         with self._lock:
+            prev = self._bookings.get(uid)
+            if prev is not None and prev.node == node and prev.devices == devices:
+                # already applied by a try_book CAS commit — the manager
+                # notification that follows it is a no-op replay; skipping
+                # it keeps the generation stable so memoized evaluations of
+                # untouched state stay valid
+                return
             self._reverse_booking(uid)
             self._bookings[uid] = _PodBooking(node, devices)
             self._apply_delta(node, devices, sign=1)
+
+    def try_book(
+        self, uid: str, node: str, expected_gen: int, devices: PodDevices
+    ) -> bool:
+        """Optimistic-CAS booking commit: atomically verify ``node``'s
+        generation still equals ``expected_gen`` (the one the lock-free
+        selection evaluated against) and apply the booking.  Returns False
+        — without side effects — when any mutation bumped the generation
+        since evaluation; the caller re-runs selection against fresh
+        snapshots (bounded retries, vtpu/scheduler/core.py).
+
+        Correctness: every mutation path (booking delta, reversal, registry
+        change, lazy rebuild) bumps the generation under this same lock, so
+        gen equality proves the aggregate is unchanged AND clean since the
+        caller's read — two racing filters that both saw generation G on
+        the same node serialize here, and exactly one wins."""
+        with self._lock:
+            entry = self._entries.get(node)
+            if entry is None or entry.gen != expected_gen or entry.usage is None:
+                self.cas_conflicts += 1
+                return False
+            # a re-filtered pod replaces its previous booking (possibly on
+            # another node) in the same atomic step — the reversal and the
+            # new delta both bump generations, invalidating stale readers
+            self._reverse_booking(uid)
+            self._bookings[uid] = _PodBooking(node, devices)
+            self._apply_delta(node, devices, sign=1)
+            return True
 
     def on_pod_removed(self, uid: str) -> None:
         with self._lock:
@@ -297,6 +340,7 @@ class UsageCache:
                 "delta_updates": self.delta_updates,
                 "fallbacks": self.fallbacks,
                 "misses": self.misses,
+                "cas_conflicts": self.cas_conflicts,
                 "nodes": len(self._entries),
                 "bookings": len(self._bookings),
             }
