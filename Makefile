@@ -7,8 +7,8 @@ IMG ?= vtpu/vtpu
 PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
-	bench-sched bench-serve bench-churn obs-lint audit-check image chart \
-	clean tidy
+	bench-sched bench-serve bench-churn bench-disagg obs-lint audit-check \
+	image chart clean tidy
 
 all: build
 
@@ -162,6 +162,22 @@ endif
 # docs/perf.md#serving-pipeline explains how to read the numbers.
 bench-serve:
 	$(PY) benchmarks/serving_pipeline.py
+
+# prefill/decode disaggregation proof: real-topology token-exactness +
+# zero-host-copy handoff check, then monolithic vs 1/2/4-decode-replica
+# arms on per-role virtual device clocks charged with measured costs of
+# the real compiled programs; refreshes docs/artifacts/serving_disagg.json
+# (docs/serving.md#benchmark explains the numbers).  SMOKE=1 runs a
+# seconds-long schema/exactness sanity pass (tier-1 safe; also exercised
+# by tests/test_disagg.py).  The new serving test modules
+# (tests/test_handoff.py, tests/test_router.py) ride the default `make
+# test` lane; tests/test_disagg.py rides the JAX workload lane.
+bench-disagg:
+ifdef SMOKE
+	$(PY) benchmarks/serving_disagg.py --smoke
+else
+	$(PY) benchmarks/serving_disagg.py
+endif
 
 # (Re)arm the detached TPU-window watcher.  Safe to run unconditionally at
 # the start of every session: a live watcher keeps its lock and the new

@@ -45,6 +45,8 @@ def main() -> int:
     import vtpu.scheduler.metrics  # noqa: F401 — fragmentation gauges
     import vtpu.scheduler.shard  # noqa: F401 — shard/leader families
     import vtpu.serving.batcher  # noqa: F401 — queue-to-first-token
+    import vtpu.serving.kvpool  # noqa: F401 — K/V handoff counters
+    import vtpu.serving.router  # noqa: F401 — front-door families
     import vtpu.shim.runtime  # noqa: F401 — pacing/quota histograms
     from vtpu.obs import all_registries, lint_names, registry
     from vtpu.obs.events import EVENT_TYPES

@@ -1,0 +1,222 @@
+"""Prefill/decode disaggregation (vtpu/serving/disagg.py): token-exact
+equivalence of the role-split topology against the monolithic
+PagedBatcher over a fuzz matrix of prompt/bucket shapes, handle
+round-trips across two pools, stale-stamp rejection on live engines,
+and the zero-host-copy guarantee of the adopt hot path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # JAX workload lane (CPU-mesh compiles)
+
+from vtpu.models.transformer import TransformerLM
+from vtpu.serving import kvpool
+from vtpu.serving.disagg import DecodeEngine, PrefillEngine
+from vtpu.serving.kvpool import KVHandle, PoolMismatchError, StaleHandleError
+from vtpu.serving.paged import PagedBatcher
+from vtpu.serving.router import Router
+
+KW = dict(vocab=64, d_model=32, depth=2, num_heads=4, max_seq=32)
+BS = 8
+POOL = 33  # 32 leasable blocks — roomy; backpressure has its own test
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    m = TransformerLM(**KW, kv_cache_layout="paged", kv_block_size=BS,
+                      kv_pool_blocks=POOL)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"]
+    return m, params
+
+
+def fuzz_requests(seed=3, n=10):
+    """Prompt lengths crossing bucket boundaries (3..24 over pow-2
+    buckets at max_seq=32), budgets from instant-retire (1) up, all
+    within max_seq."""
+    rng = np.random.default_rng(seed)
+    lens = [3, 4, 5, 7, 8, 9, 12, 16, 17, 24]
+    news = [1, 2, 5, 8, 3, 6, 4, 7, 2, 5]
+    return [(f"r{i}", rng.integers(0, 64, lens[i % len(lens)]).astype(
+        np.int32), news[i % len(news)]) for i in range(n)]
+
+
+def run_monolithic(m, params, reqs, **kw):
+    eng = PagedBatcher(m, params, max_batch=4, eos_id=2, **kw)
+    for rid, p, n in reqs:
+        eng.submit(rid, p, num_new=n)
+    return eng.run()
+
+
+def run_disagg(m, params, reqs, shared: bool, **kw):
+    dec = DecodeEngine(m, params, max_batch=4, eos_id=2, **kw)
+    pf = PrefillEngine(m, params, shared_with=dec if shared else None)
+    for rid, p, n in reqs:
+        pf.submit(rid, p, num_new=n)
+    src = None if shared else pf
+    while pf.queue or dec.queue or any(dec.active) or dec._inflight:
+        for res in pf.step():
+            dec.submit_handle(res.rid, res.handle, res.first_token,
+                              res.num_new, source=src)
+        dec.step()
+    return dec.out
+
+
+@pytest.mark.parametrize("shared", [True, False],
+                         ids=["shared-pool", "cross-pool"])
+@pytest.mark.parametrize("pipeline_depth,harvest_every",
+                         [(0, 1), (1, 4)])
+def test_disagg_token_exact_fuzz_matrix(model_and_params, shared,
+                                        pipeline_depth, harvest_every):
+    """The acceptance contract: disaggregated output is token-exact vs
+    monolithic for identical request streams (greedy decode), across
+    both adoption modes, the sync escape hatch, and windowed pipelined
+    harvest."""
+    m, params = model_and_params
+    reqs = fuzz_requests()
+    want = run_monolithic(m, params, reqs)
+    host0 = kvpool.HANDOFF_HOST_BYTES.value()
+    got = run_disagg(m, params, reqs, shared,
+                     pipeline_depth=pipeline_depth,
+                     harvest_every=harvest_every)
+    assert got == want
+    # the adopt hot path never copied cache contents through host numpy
+    assert kvpool.HANDOFF_HOST_BYTES.value() == host0
+
+
+def test_handle_round_trip_across_two_pools(model_and_params):
+    """serialize → adopt across two pools: the handle crosses a wire
+    format boundary between the prefill engine's pool and the decode
+    replica's, and decoding continues exactly."""
+    m, params = model_and_params
+    reqs = fuzz_requests(seed=11, n=6)
+    want = run_monolithic(m, params, reqs)
+    pf = PrefillEngine(m, params)
+    dec = DecodeEngine(m, params, max_batch=4, eos_id=2)
+    for rid, p, n in reqs:
+        pf.submit(rid, p, num_new=n)
+    while pf.queue or dec.queue or any(dec.active) or dec._inflight:
+        for res in pf.step():
+            rebuilt = KVHandle.from_wire(res.handle.to_wire())
+            assert rebuilt == res.handle
+            dec.submit_handle(res.rid, rebuilt, res.first_token,
+                              res.num_new, source=pf)
+        dec.step()
+    assert dec.out == want
+    # both pools fully drained: nothing leaked through the handoff
+    assert pf.pool.stats()["leased"] == 0
+    assert dec.pool_stats()["leased"] == 0
+
+
+def test_stale_handle_rejected_on_live_engines(model_and_params):
+    m, params = model_and_params
+    pf = PrefillEngine(m, params)
+    a = DecodeEngine(m, params, max_batch=2, eos_id=2)
+    b = DecodeEngine(m, params, max_batch=2, eos_id=2)
+    pf.submit("x", np.array([1, 2, 3], np.int32), 3)
+    res = pf.step()[0]
+    stale0 = kvpool.HANDOFF_STALE.value()
+    a.submit_handle("x", res.handle, res.first_token, res.num_new, source=pf)
+    # the same handle at a second replica: typed rejection, counted
+    with pytest.raises(StaleHandleError):
+        b.submit_handle("x", res.handle, res.first_token, res.num_new,
+                        source=pf)
+    assert kvpool.HANDOFF_STALE.value() == stale0 + 1
+    while any(a.active) or a.queue or a._inflight:
+        a.step()
+    assert len(a.out["x"]) == 3
+
+
+def test_cross_pool_adopt_requires_the_source(model_and_params):
+    m, params = model_and_params
+    pf = PrefillEngine(m, params)
+    dec = DecodeEngine(m, params, max_batch=2)
+    pf.submit("y", np.array([1, 2], np.int32), 2)
+    res = pf.step()[0]
+    with pytest.raises(PoolMismatchError):
+        dec.submit_handle("y", res.handle, res.first_token, res.num_new)
+    # the failed adopt did not consume the handle
+    dec.submit_handle("y", res.handle, res.first_token, res.num_new,
+                      source=pf)
+
+
+def test_decode_engine_rejects_raw_prompts(model_and_params):
+    m, params = model_and_params
+    dec = DecodeEngine(m, params, max_batch=2)
+    with pytest.raises(TypeError):
+        dec.submit("r", np.array([1, 2], np.int32), 2)
+
+
+def test_adoption_backpressure_waits_for_blocks(model_and_params):
+    """A decode replica with a tiny pool adopts head-of-line as its
+    blocks free — backpressure, not failure, exactly like monolithic
+    admission."""
+    m, params = model_and_params
+    tight = TransformerLM(**KW, kv_cache_layout="paged", kv_block_size=BS,
+                          kv_pool_blocks=5)  # 4 leasable = 2 requests
+    tp = params  # same shapes except pool dim — params are pool-free
+    pf = PrefillEngine(m, params)
+    dec = DecodeEngine(tight, tp, max_batch=4, eos_id=2)
+    # 9-token prompts + 3 new = 12 tokens = 2 blocks each: four requests
+    # want 8 blocks, the tight pool leases 4 → two must wait
+    reqs = [(f"b{i}", np.arange(1, 10, dtype=np.int32) + i, 3)
+            for i in range(4)]
+    want = run_monolithic(m, params, reqs)
+    for rid, p, n in reqs:
+        pf.submit(rid, p, num_new=n)
+    for res in pf.run():
+        dec.submit_handle(res.rid, res.handle, res.first_token,
+                          res.num_new, source=pf)
+    assert len(dec.queue) > 0 or sum(dec.active) < 4  # somebody waited
+    while any(dec.active) or dec.queue or dec._inflight:
+        dec.step()
+    assert dec.out == want
+    assert dec.pool_stats()["leased"] == 0
+
+
+def test_router_end_to_end_multi_replica_exact(model_and_params):
+    """The full front-door topology on real engines: 1 prefill + 2
+    decode replicas behind session-affinity routing, token-exact vs
+    monolithic, nothing leaked."""
+    m, params = model_and_params
+    reqs = fuzz_requests(seed=23, n=8)
+    want = run_monolithic(m, params, reqs)
+    pf = PrefillEngine(m, params)
+    reps = {
+        f"d{i}": DecodeEngine(m, params, max_batch=4, eos_id=2,
+                              replica_id=f"d{i}")
+        for i in range(2)
+    }
+    router = Router(pf, reps)
+    for i, (rid, p, n) in enumerate(reqs):
+        router.submit(f"sess{i % 3}", rid, p, num_new=n)
+    got = router.drain()
+    assert got == want
+    assert pf.pool.stats()["leased"] == 0
+    for eng in reps.values():
+        assert eng.pool_stats()["leased"] == 0
+
+
+def test_bench_disagg_smoke_artifact_schema(tmp_path):
+    """SMOKE=1 bench contract: schema-complete artifact, real-topology
+    exactness check inside the bench, and the zero-host-bytes assertion
+    (the committed artifact's numbers come from the full run)."""
+    from benchmarks import serving_disagg
+
+    out = tmp_path / "serving_disagg.json"
+    rc = serving_disagg.main(["--smoke", "--out", str(out)])
+    assert rc == 0
+    import json
+
+    res = json.loads(out.read_text())
+    assert res["exactness"]["token_exact"] is True
+    assert res["exactness"]["handoff_host_bytes"] == 0
+    assert res["exactness"]["handoffs"] > 0
+    arms = res["arms"]
+    assert "monolithic" in arms and "disagg_4" in arms
+    for arm in arms.values():
+        assert arm["tokens_per_s"] > 0
+        assert arm["decode_itl_p99_ms"] >= arm["decode_itl_p50_ms"] >= 0
+    assert res["headline"]["tokens_per_s_x_disagg_4"] > 0

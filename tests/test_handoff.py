@@ -1,0 +1,155 @@
+"""BlockPool accounting and the transferable K/V lease protocol
+(vtpu/serving/kvpool.py): wire round-trips, refcounts, and the typed
+double-release / stale-stamp failure paths.  Pure host-side — this is
+the fast control-plane lane; the device-side adoption programs are
+covered by tests/test_disagg.py (JAX workload lane)."""
+
+import pytest
+
+from vtpu.serving.kvpool import (
+    BlockPool,
+    DoubleReleaseError,
+    KVHandle,
+    KVHandoffError,
+    PoolMismatchError,
+    StaleHandleError,
+)
+
+
+def test_handle_wire_round_trip():
+    h = KVHandle("pool-x", (3, 7, 9), seq_len=21, stamp=4)
+    doc = h.to_wire()
+    assert doc == {"pool": "pool-x", "blocks": [3, 7, 9],
+                   "seq_len": 21, "stamp": 4}
+    assert KVHandle.from_wire(doc) == h
+    # wire docs survive JSON (ints stay ints, tuple rebuilt)
+    import json
+
+    assert KVHandle.from_wire(json.loads(json.dumps(doc))) == h
+
+
+def test_malformed_wire_handle_is_typed():
+    with pytest.raises(KVHandoffError):
+        KVHandle.from_wire({"pool": "p", "blocks": [1]})  # missing fields
+
+
+def test_lease_refcount_and_free_list():
+    pool = BlockPool(9, 8)
+    assert pool.leasable() == 8
+    a = pool.lease(3)
+    b = pool.lease(2)
+    assert pool.free_blocks() == 3
+    assert 0 not in a + b  # block 0 is sacrificial, never leased
+    pool.ref(a)            # shared prefix style second holder
+    pool.release(a)
+    assert pool.free_blocks() == 3  # still held once
+    pool.release(a)
+    pool.release(b)
+    assert pool.free_blocks() == 8
+    assert pool.stats()["leased"] == 0
+
+
+def test_double_release_raises_typed_and_corrupts_nothing():
+    pool = BlockPool(5, 8)
+    blocks = pool.lease(2)
+    pool.release(blocks)
+    free_before = list(pool.free)
+    with pytest.raises(DoubleReleaseError):
+        pool.release(blocks)
+    # the free list did NOT gain duplicate entries
+    assert list(pool.free) == free_before
+    with pytest.raises(DoubleReleaseError):
+        pool.ref(blocks)
+
+
+def test_partial_double_release_fails_before_mutating():
+    """A release batch mixing live and dead blocks must fail atomically
+    — no half-applied decrement that strands the live block."""
+    pool = BlockPool(6, 8)
+    live = pool.lease(1)
+    dead = pool.lease(1)
+    pool.release(dead)
+    with pytest.raises(DoubleReleaseError):
+        pool.release(live + dead)
+    assert pool._refs[live[0]] == 1  # untouched
+    pool.release(live)
+
+
+def test_detach_adopt_moves_ownership_once():
+    pool = BlockPool(9, 8)
+    blocks = pool.lease(3)
+    h = pool.detach(blocks, seq_len=20)
+    assert h.pool_id == pool.pool_id and h.blocks == tuple(blocks)
+    assert pool.stats()["detached_handles"] == 1
+    got = pool.adopt(h)
+    assert got == blocks
+    assert pool.stats()["detached_handles"] == 0
+    # the refs moved through intact: release works exactly once
+    pool.release(got)
+    assert pool.free_blocks() == 8
+    with pytest.raises(StaleHandleError):
+        pool.adopt(h)  # second adoption: stamp is gone
+
+
+def test_stale_handle_after_release_handle():
+    pool = BlockPool(9, 8)
+    h = pool.detach(pool.lease(2), seq_len=10)
+    pool.release_handle(h)  # abandoned prefill: blocks freed
+    assert pool.free_blocks() == 8
+    with pytest.raises(StaleHandleError):
+        pool.adopt(h)
+    with pytest.raises(StaleHandleError):
+        pool.release_handle(h)
+
+
+def test_handle_from_wire_adopts_like_the_original():
+    """Adoption is stamp-based, not object-identity-based — a handle
+    rebuilt from its wire form is as good as the original (the
+    cross-process story)."""
+    pool = BlockPool(9, 8)
+    h = pool.detach(pool.lease(2), seq_len=9)
+    rebuilt = KVHandle.from_wire(h.to_wire())
+    assert pool.adopt(rebuilt) == list(h.blocks)
+
+
+def test_foreign_pool_handle_rejected():
+    a, b = BlockPool(5, 8), BlockPool(5, 8)
+    h = a.detach(a.lease(1), seq_len=4)
+    with pytest.raises(PoolMismatchError):
+        b.adopt(h)
+    a.adopt(h)  # unharmed by the failed foreign adoption
+
+
+def test_lease_overdraw_is_typed():
+    pool = BlockPool(4, 8)
+    with pytest.raises(KVHandoffError):
+        pool.lease(4)  # only 3 leasable
+    assert pool.free_blocks() == 3
+
+
+def test_pool_ids_are_unique():
+    assert BlockPool(3, 8).pool_id != BlockPool(3, 8).pool_id
+
+
+def test_double_detach_of_same_blocks_rejected():
+    """One lease → one adoptable handle: detaching the same blocks
+    twice would mint two claim tickets over one physical block."""
+    pool = BlockPool(9, 8)
+    blocks = pool.lease(2)
+    h = pool.detach(blocks, seq_len=8)
+    with pytest.raises(KVHandoffError):
+        pool.detach(blocks, seq_len=8)
+    got = pool.adopt(h)  # adoption returns ownership…
+    h2 = pool.detach(got, seq_len=8)  # …and the new owner may re-detach
+    pool.release_handle(h2)
+    assert pool.free_blocks() == 8
+
+
+def test_try_lease_is_atomic_backoff():
+    pool = BlockPool(4, 8)
+    assert pool.try_lease(5) is None  # never enough: no partial pop
+    got = pool.try_lease(3)
+    assert got is not None
+    assert pool.try_lease(1) is None
+    pool.release(got)
+    assert pool.free_blocks() == 3

@@ -1,0 +1,222 @@
+"""The serving front door (vtpu/serving/router.py): session affinity,
+admission control / typed load shedding, and health-driven drain &
+restore — exercised against fake replicas (the router is duck-typed
+and JAX-free on purpose, so every policy runs in the fast lane; the
+real-engine topology is covered by tests/test_disagg.py)."""
+
+import pytest
+
+from vtpu.obs.events import EventType, journal
+from vtpu.serving.kvpool import BlockPool
+from vtpu.serving.router import Router, RouterReject
+
+
+class FakePrefill:
+    """Prefill-role stand-in: queues submits, 'prefills' them on step()
+    by leasing real pool blocks and detaching real handles."""
+
+    def __init__(self, blocks=64, block_size=8):
+        self.pool = BlockPool(blocks, block_size)
+        self.queue = []
+
+    def submit(self, rid, prompt, num_new):
+        self.queue.append((rid, list(prompt), num_new))
+
+    def step(self):
+        from vtpu.serving.disagg import PrefillResult
+
+        out = []
+        for rid, prompt, num_new in self.queue:
+            need = -(-(len(prompt) + num_new) // self.pool.block_size)
+            handle = self.pool.detach(self.pool.lease(need),
+                                      seq_len=len(prompt))
+            out.append(PrefillResult(rid, 7, handle, num_new))
+        self.queue = []
+        return out
+
+    def stats(self):
+        return {"queued": len(self.queue), **self.pool.stats()}
+
+
+class FakeReplica:
+    """Decode-role stand-in: records adoptions, answers pings from a
+    scripted health flag, exposes scriptable load."""
+
+    def __init__(self, max_batch=4):
+        self.max_batch = max_batch
+        self.adopted = []
+        self.healthy = True
+        self.active = 0
+        self.queued = 0
+        self.fail_handoffs = False
+
+    def ping(self):
+        if not self.healthy:
+            raise ConnectionError("replica gone")
+        return True
+
+    def submit_handle(self, rid, handle, first_token, num_new,
+                      source=None, submitted=0.0):
+        if self.fail_handoffs:
+            raise ConnectionError("replica died mid-handoff")
+        if source is not None:
+            source.pool.release_handle(handle)  # 'copied' the blocks
+        self.adopted.append(rid)
+
+    def step(self):
+        pass
+
+    def stats(self):
+        return {"max_batch": self.max_batch, "active_slots": self.active,
+                "queued": self.queued, "inflight_windows": 0,
+                "prefilling_slots": 0}
+
+
+def make_router(n=3, **kw):
+    pf = FakePrefill()
+    reps = {f"d{i}": FakeReplica() for i in range(n)}
+    return Router(pf, reps, **kw), pf, reps
+
+
+def test_session_affinity_is_sticky_and_spread():
+    router, pf, reps = make_router(n=3)
+    picks = {}
+    for i in range(60):
+        sess = f"s{i % 12}"
+        rid = f"r{i}"
+        got = router.submit(sess, rid, [1, 2, 3], 4)
+        picks.setdefault(sess, set()).add(got)
+        router.pump()
+    # every session saw exactly one replica…
+    assert all(len(v) == 1 for v in picks.values())
+    # …and the 12 sessions actually spread over the ring
+    used = {next(iter(v)) for v in picks.values()}
+    assert len(used) >= 2
+    assert sum(len(r.adopted) for r in reps.values()) == 60
+
+
+def test_admission_control_sheds_with_typed_429():
+    router, pf, reps = make_router(n=1, max_backlog=2)
+    # replica reports a full slot array and deep queue
+    reps["d0"].active = 4
+    reps["d0"].queued = 3
+    with pytest.raises(RouterReject) as ei:
+        router.submit("s", "r0", [1, 2], 2)
+    assert ei.value.reason == "replica_saturated"
+    assert ei.value.status == 429
+    assert router.stats()["shed"] == 1
+    # capacity back → admits again
+    reps["d0"].active = 0
+    reps["d0"].queued = 0
+    assert router.submit("s", "r1", [1, 2], 2) == "d0"
+
+
+def test_router_counts_its_own_uncollected_backlog():
+    """Admission control must see requests the router has accepted but
+    not yet handed off — not only the replica's own view."""
+    router, pf, reps = make_router(n=1, max_backlog=2)
+    for i in range(6):  # limit = max_batch 4 + backlog 2
+        router.submit("s", f"r{i}", [1], 1)
+    with pytest.raises(RouterReject):
+        router.submit("s", "r-over", [1], 1)
+    router.pump()  # handoffs drain the pending ledger
+    assert router.submit("s", "r-after", [1], 1) == "d0"
+
+
+def test_drain_after_failed_pings_and_restore(monkeypatch):
+    router, pf, reps = make_router(n=2, fail_threshold=3)
+    j0 = len(journal().query(type=EventType.REPLICA_DRAINED, n=0) or [])
+    dead = "d0"
+    reps[dead].healthy = False
+    router.check_health()
+    router.check_health()
+    assert dead in router.stats()["healthy"]  # below the threshold
+    router.check_health()
+    assert dead not in router.stats()["healthy"]
+    drains = journal().query(type=EventType.REPLICA_DRAINED, n=10)
+    assert any(e.get("node") == dead for e in drains)
+    # new sessions only land on the healthy replica
+    for i in range(8):
+        assert router.submit(f"fresh{i}", f"fr{i}", [1], 1) == "d1"
+    # recovery: one good ping restores and journals it
+    reps[dead].healthy = True
+    router.check_health()
+    assert dead in router.stats()["healthy"]
+    restored = journal().query(type=EventType.REPLICA_RESTORED, n=10)
+    assert any(e.get("node") == dead for e in restored)
+
+
+def test_pinned_session_finishes_on_drained_replica():
+    """Drain is graceful: sessions already pinned keep routing to the
+    drained replica (their K/V and transcript live there); only NEW
+    sessions re-hash."""
+    router, pf, reps = make_router(n=2, fail_threshold=1)
+    # pin sessions until both replicas hold at least one
+    pins = {}
+    i = 0
+    while len(set(pins.values())) < 2:
+        pins[f"s{i}"] = router.submit(f"s{i}", f"p{i}", [1], 1)
+        i += 1
+    drained = pins[f"s0"]
+    reps[drained].healthy = False
+    router.check_health()
+    assert drained not in router.stats()["healthy"]
+    # the pinned session still goes to its replica…
+    assert router.submit("s0", "p-more", [1], 1) == drained
+    # …while a brand-new session avoids it
+    other = router.submit("brand-new", "p-new", [1], 1)
+    assert other != drained
+
+
+def test_all_replicas_drained_sheds_new_sessions():
+    router, pf, reps = make_router(n=2, fail_threshold=1)
+    for r in reps.values():
+        r.healthy = False
+    router.check_health()
+    with pytest.raises(RouterReject) as ei:
+        router.submit("nobody-home", "r0", [1], 1)
+    assert ei.value.reason == "no_healthy_replica"
+
+
+def test_handoff_falls_back_when_target_dies_mid_flight():
+    """A replica that accepts the submit but dies before the handoff:
+    the prefilled K/V re-routes to a healthy replica instead of being
+    lost (the handle is replica-agnostic)."""
+    router, pf, reps = make_router(n=2)
+    victim = router.submit("sx", "rx", [1, 2], 2)
+    reps[victim].fail_handoffs = True
+    router.pump()
+    survivor = next(r for r in reps if r != victim)
+    assert "rx" in reps[survivor].adopted
+    assert pf.pool.stats()["detached_handles"] == 0  # nothing leaked
+
+
+def test_abandoned_prefill_releases_blocks_when_nobody_can_take_it():
+    router, pf, reps = make_router(n=1, fail_threshold=1)
+    router.submit("s", "r0", [1, 2, 3], 2)
+    reps["d0"].healthy = False
+    reps["d0"].fail_handoffs = True
+    router.check_health()
+    router.pump()  # prefill finishes; handoff has nowhere to go
+    st = pf.pool.stats()
+    assert st["detached_handles"] == 0 and st["leased"] == 0
+    assert router.stats()["shed"] >= 1
+
+
+def test_router_requires_a_replica():
+    with pytest.raises(ValueError):
+        Router(FakePrefill(), {})
+
+
+def test_shared_pool_prefill_requires_its_host_replica():
+    """A co-located (shared_with=) prefill writes into its host decode
+    engine's pool — no other replica can adopt those handles, so the
+    Router refuses the misconfiguration at construction."""
+    pf = FakePrefill()
+    host = FakeReplica()
+    pf._host = host
+    Router(pf, {"d0": host})  # the valid single-replica topology
+    with pytest.raises(ValueError):
+        Router(pf, {"d0": host, "d1": FakeReplica()})
+    with pytest.raises(ValueError):
+        Router(pf, {"d0": FakeReplica()})  # host not among the replicas

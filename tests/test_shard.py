@@ -422,3 +422,79 @@ def test_shard_wire_endpoints_reject_on_tls_webhook_listener():
     src = inspect.getsource(_Handler.do_POST)
     assert '"/shard/evaluate" and self.allow_debug' in src
     assert '"/shard/commit" and self.allow_debug' in src
+
+
+# ---------------------------------------------------------------------------
+# HttpPeer keep-alive pool
+# ---------------------------------------------------------------------------
+
+def test_http_peer_keeps_connection_alive_across_calls():
+    """Two sequential peer calls ride ONE persistent connection: after
+    the first call the connection parks in the idle pool, and the second
+    call reuses that same object (no per-call TCP churn — ROADMAP item
+    5's one-request-per-subset-call fix)."""
+    from vtpu.scheduler.routes import serve
+    from vtpu.scheduler.shard import _PEER_RECONNECTS
+
+    c = FakeClient()
+    for n in ("k1", "k2"):
+        register_node(c, n)
+    b = Scheduler(c)
+    b.register_from_node_annotations()
+    srv, _ = serve(b, bind="127.0.0.1:0")
+    try:
+        port = srv.server_address[1]
+        peer = HttpPeer(f"http://127.0.0.1:{port}")
+        before = _PEER_RECONNECTS.value(peer=peer.base_url)
+        pod = c.create_pod(tpu_pod("ka-pod"))
+        rep1 = peer.evaluate(pod, ["k1", "k2"])
+        assert rep1.get("best"), rep1
+        assert len(peer._idle) == 1
+        conn1 = peer._idle[0]
+        rep2 = peer.evaluate(pod, ["k1", "k2"])
+        assert rep2.get("best"), rep2
+        assert len(peer._idle) == 1
+        assert peer._idle[0] is conn1  # the SAME connection served both
+        assert _PEER_RECONNECTS.value(peer=peer.base_url) == before
+        peer.close()
+        assert not peer._idle
+    finally:
+        srv.shutdown()
+
+
+def test_http_peer_reconnects_on_stale_connection_and_counts_it():
+    """A pooled connection whose socket died (peer restart, idle
+    timeout) is replaced transparently for the read-only evaluate call,
+    and the replacement lands in vtpu_shard_peer_reconnects_total."""
+    from vtpu.scheduler.routes import serve
+    from vtpu.scheduler.shard import _PEER_RECONNECTS
+
+    c = FakeClient()
+    register_node(c, "kr1")
+    b = Scheduler(c)
+    b.register_from_node_annotations()
+    srv, _ = serve(b, bind="127.0.0.1:0")
+    try:
+        port = srv.server_address[1]
+        peer = HttpPeer(f"http://127.0.0.1:{port}")
+        pod = c.create_pod(tpu_pod("kr-pod"))
+        assert peer.evaluate(pod, ["kr1"]).get("best")
+        # sabotage the parked keep-alive socket: the next call must
+        # detect the stale connection, reconnect, and still succeed
+        peer._idle[0].sock.close()
+        before = _PEER_RECONNECTS.value(peer=peer.base_url)
+        assert peer.evaluate(pod, ["kr1"]).get("best")
+        assert _PEER_RECONNECTS.value(peer=peer.base_url) == before + 1
+        peer.close()
+    finally:
+        srv.shutdown()
+
+
+def test_http_peer_commit_never_replays_on_send_error():
+    """commit is a CAS write: a transport error must surface, not be
+    retried on a fresh connection (the request may have been applied;
+    replaying could double-book — the coordinator's dead-peer path owns
+    the failure)."""
+    peer = HttpPeer("http://127.0.0.1:1")  # nothing listens here
+    with pytest.raises(OSError):
+        peer.commit({"metadata": {"uid": "x"}}, "n0", 1)

@@ -68,6 +68,9 @@ class EventType:
     REGION_GC = "RegionGC"              # stale container dir garbage-collected
     # auditor
     DRIFT_DETECTED = "DriftDetected"    # reconciliation found booked/measured skew
+    # serving router
+    REPLICA_DRAINED = "ReplicaDrained"    # decode replica failed health pings; out of the ring
+    REPLICA_RESTORED = "ReplicaRestored"  # drained replica answers again; back in the ring
 
 
 EVENT_TYPES = frozenset(

@@ -82,6 +82,10 @@ def bind_handler(sched: Scheduler, args: dict) -> dict:
 
 
 class _Handler(BaseHTTPRequestHandler):
+    # speak HTTP/1.1 so peer replicas (HttpPeer's persistent pool) and
+    # scrapers can keep connections alive — every response goes through
+    # _send, which always sets Content-Length, the 1.1 prerequisite
+    protocol_version = "HTTP/1.1"
     scheduler: Scheduler  # injected via serve()
     # debug endpoints (/spans) are served only on the plain in-cluster
     # listener — the TLS webhook port is exposed cluster-wide via the
@@ -198,6 +202,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, b"not found", "text/plain")
 
     def do_POST(self) -> None:  # noqa: N802
+        if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+            # _read_json only honors Content-Length; under keep-alive an
+            # unread chunked body would desync the persistent connection
+            # (the next request line would parse chunk framing) — answer
+            # 411 and close instead
+            self.close_connection = True
+            self._send(411, b'{"Error": "chunked bodies not supported; '
+                            b'send Content-Length"}')
+            return
         body = self._read_json()
         if body is None:
             self._send(400, b'{"Error": "bad json"}')
@@ -273,14 +286,19 @@ def serve(
         srv.socket = ctx.wrap_socket(
             srv.socket, server_side=True, do_handshake_on_connect=False
         )
-        real_get_request = srv.get_request
+    real_get_request = srv.get_request
 
-        def get_request():
-            sock, addr = real_get_request()
-            sock.settimeout(30.0)
-            return sock, addr
+    def get_request():
+        # every connection gets an idle timeout: under HTTP/1.1
+        # keep-alive each persistent connection parks a handler thread
+        # in readline(), and a peer that dies without FIN must not pin
+        # that thread forever — the timeout closes the connection and
+        # the peer's pool reconnects (counted)
+        sock, addr = real_get_request()
+        sock.settimeout(30.0)
+        return sock, addr
 
-        srv.get_request = get_request  # type: ignore[method-assign]
+    srv.get_request = get_request  # type: ignore[method-assign]
     t = threading.Thread(target=srv.serve_forever, name="vtpu-http", daemon=True)
     t.start()
     return srv, t

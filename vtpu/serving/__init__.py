@@ -1,6 +1,39 @@
-"""Serving tier: continuous batching over the LM family's KV cache."""
+"""Serving tier: continuous batching over the LM family's KV cache,
+prefill/decode disaggregation, and the multi-replica front door.
 
-from vtpu.serving.batcher import ContinuousBatcher
-from vtpu.serving.paged import PagedBatcher
+Exports resolve lazily (PEP 562): the engines pull in JAX, but the
+host-side pieces — :mod:`vtpu.serving.kvpool` (block accounting,
+transferable K/V handles) and :mod:`vtpu.serving.router` (session
+affinity, admission control, load shedding) — stay importable without
+it, so the control-plane test lane and the router never pay a JAX
+import.
+"""
 
-__all__ = ["ContinuousBatcher", "PagedBatcher"]
+_LAZY = {
+    "ContinuousBatcher": ("vtpu.serving.batcher", "ContinuousBatcher"),
+    "PagedBatcher": ("vtpu.serving.paged", "PagedBatcher"),
+    "PrefillEngine": ("vtpu.serving.disagg", "PrefillEngine"),
+    "DecodeEngine": ("vtpu.serving.disagg", "DecodeEngine"),
+    "Router": ("vtpu.serving.router", "Router"),
+    "RouterReject": ("vtpu.serving.router", "RouterReject"),
+    "BlockPool": ("vtpu.serving.kvpool", "BlockPool"),
+    "KVHandle": ("vtpu.serving.kvpool", "KVHandle"),
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    try:
+        mod_name, attr = _LAZY[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(mod_name), attr)
+
+
+def __dir__():
+    return __all__

@@ -1,0 +1,510 @@
+"""Prefill/decode disaggregation: the role-split serving engines.
+
+PR 3 pipelined a single engine's decode loop; this module splits the
+engine into two separately driven roles (ROADMAP item 2, FlexNPU's
+prefill-decode co-location as the blueprint):
+
+- :class:`PrefillEngine` runs ONLY the bucketed fused-admission path:
+  one compiled program per (row-bucket, length-bucket) that prefills a
+  group of prompts into leased pool blocks and argmaxes each row's
+  first token.  Instead of decoding, it **detaches** each lease into a
+  transferable :class:`~vtpu.serving.kvpool.KVHandle` and emits
+  ``(rid, first_token, handle)`` — prefill bursts never touch a decode
+  engine's token cadence.
+- :class:`DecodeEngine` is today's :class:`~vtpu.serving.paged.
+  PagedBatcher` decode loop (pipelined harvest, fused windows, donated
+  pool — ``pipeline_depth=0`` stays the sync escape hatch), but it
+  admits via **handle adoption** instead of raw prompts: the slot
+  opens with the prefill's first token and position, and decoding
+  continues exactly where the prefill engine left off.
+
+Adoption has two modes, chosen by the handle's pool id:
+
+- **shared** (same pool — prefill co-located with this decode engine,
+  ``PrefillEngine(shared_with=decode)``): zero-copy; the handle's
+  blocks are rebound into the slot's table row in one fused scatter.
+- **copy** (cross-pool — the multi-replica topology): the decode
+  engine leases its own blocks and ONE fused program gathers the
+  source pool's blocks, scatters them into the leased blocks, and
+  publishes table row / position / first token.  The cache bytes move
+  device-side only — nothing materializes in host numpy
+  (``vtpu_kv_handoff_host_bytes_total`` stays 0; the disagg bench
+  asserts it).
+
+Token-exactness: greedy decode of an adopted request is token-identical
+to the monolithic ``PagedBatcher`` serving the same request (rows are
+independent; the adopted slot opens with exactly the state monolithic
+admission would have published) — pinned by tests/test_disagg.py's
+fuzz matrix.  docs/serving.md describes the full topology.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from vtpu.models.transformer import TransformerLM, _zero_cache, bucket_length
+from vtpu.ops.quant import dequantize_tree
+from vtpu.serving import batcher as _batcher
+from vtpu.serving.kvpool import (
+    HANDOFF_BLOCKS,
+    HANDOFF_DEVICE_BYTES,
+    HANDOFF_TOTAL,
+    BlockPool,
+    KVHandle,
+    PoolMismatchError,
+)
+from vtpu.serving.paged import PagedBatcher
+
+__all__ = ["DecodeEngine", "PrefillEngine", "PrefillResult"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefillResult:
+    """One finished prefill: the first generated token plus the claim
+    ticket for the K/V the prefill wrote."""
+
+    rid: str
+    first_token: int
+    handle: KVHandle
+    num_new: int
+    submitted: float = 0.0
+
+
+@dataclasses.dataclass
+class _PendingAdopt:
+    """A handle whose blocks are claimed but still waiting for a slot
+    (and, in copy mode, for destination blocks)."""
+
+    rid: str
+    blocks: List[int]     # claimed from the handle (ownership moved here)
+    seq_len: int
+    first: int
+    num_new: int
+    mode: str             # "shared" | "copy"
+    source: object        # the source engine (copy mode), else None
+    submitted: float
+
+
+def _pow2(n: int) -> int:
+    return 1 << (n - 1).bit_length()
+
+
+class PrefillEngine:
+    """The prefill role: bucketed fused admission only, emitting
+    (first token, K/V handle) per request.
+
+    Standalone by default (its own :class:`BlockPool` and pool device
+    buffers — the cross-pool topology, one handoff copy per request),
+    or co-located via ``shared_with=<DecodeEngine>`` (borrows the
+    decode engine's pool and cache leaves; handoff is a zero-copy
+    rebind).  Admission is head-of-line FIFO on block backpressure,
+    like the monolithic engine."""
+
+    def __init__(self, model: TransformerLM, params, *,
+                 shared_with: Optional["DecodeEngine"] = None,
+                 bucket_prefill: bool = True) -> None:
+        if model.kv_cache_layout != "paged" or model.kv_pool_blocks <= 1:
+            raise ValueError(
+                "PrefillEngine needs kv_cache_layout='paged' and a real "
+                "pool (kv_pool_blocks > 1)"
+            )
+        self.model = model
+        self.params = params
+        self.bucket_prefill = bool(bucket_prefill)
+        self.block_size = model.kv_block_size
+        self.nb_max = model.max_seq // model.kv_block_size
+        self._host = shared_with
+        if shared_with is not None:
+            if shared_with.pool.block_size != self.block_size:
+                raise PoolMismatchError(
+                    "shared prefill/decode need the same block size"
+                )
+            self.pool = shared_with.pool
+            self._pools: Optional[dict] = None
+        else:
+            self.pool = BlockPool(model.kv_pool_blocks, model.kv_block_size)
+            pools = _zero_cache(model, jnp.zeros((1, 1), jnp.int32))
+            pools.pop("pos")
+            pools.pop("block_table")
+            self._pools = pools
+        self._host_ctx: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
+        self.queue: collections.deque = collections.deque()
+        self._rids: set = set()
+        self.prefills = 0  # finished prefills (scrape-friendly)
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def _pf(params, pools, pos0, table, toks, lens):
+            """One admission group against the live pool (donated —
+            written in place): prefill + first-token argmax, exactly
+            the compute half of PagedBatcher._admit_pool minus the
+            batch-state publish (there is no batch here)."""
+            cache = dict(pools, pos=pos0, block_table=table)
+            logits, mut = model.apply(
+                {"params": dequantize_tree(params), "cache": cache},
+                toks, decode=True, mutable=["cache"],
+            )
+            out = dict(mut["cache"])
+            out.pop("pos")
+            out.pop("block_table")
+            sel = jnp.take_along_axis(
+                logits, (lens - 1)[:, None, None], axis=1
+            )[:, 0]
+            firsts = jnp.argmax(sel, axis=-1).astype(jnp.int32)
+            return firsts, out
+
+        self._pf = _pf
+
+    # ------------------------------------------------------------------
+    def _blocks_needed(self, prompt_len: int, num_new: int) -> int:
+        # the lease covers prompt + decode budget so the SAME blocks
+        # serve the whole request after adoption (shared mode hands the
+        # physical blocks over; copy mode mirrors the count)
+        return -(-(prompt_len + num_new) // self.block_size)
+
+    def submit(self, rid: str, prompt, num_new: int) -> None:
+        if num_new < 1:
+            raise ValueError(f"num_new must be >= 1, got {num_new}")
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        if p.size < 1:
+            raise ValueError("prompt must have at least one token")
+        if p.size + num_new > self.model.max_seq:
+            raise ValueError(
+                f"prompt ({p.size}) + num_new ({num_new}) exceeds "
+                f"max_seq ({self.model.max_seq})"
+            )
+        if self._blocks_needed(p.size, num_new) > self.pool.leasable():
+            raise ValueError(
+                "request needs more blocks than the pool can ever lease"
+            )
+        if rid in self._rids:
+            raise ValueError(f"duplicate request id {rid!r}")
+        self._rids.add(rid)
+        self.queue.append((rid, p, num_new, time.perf_counter()))
+
+    def pool_leaves(self) -> dict:
+        """The device pool buffers a cross-pool adoption reads from."""
+        if self._pools is None:
+            raise PoolMismatchError(
+                "shared-mode prefill has no pool of its own — adoption "
+                "is the zero-copy rebind, not a copy"
+            )
+        return self._pools
+
+    def _borrow_pools(self) -> dict:
+        if self._host is None:
+            assert self._pools is not None
+            return self._pools
+        pools, pos, table = self._host._split_cache()
+        self._host_ctx = (pos, table)
+        return pools
+
+    def _restore_pools(self, new_pools: dict) -> None:
+        if self._host is None:
+            self._pools = new_pools
+        else:
+            assert self._host_ctx is not None
+            pos, table = self._host_ctx
+            self._host.cache = dict(new_pools, pos=pos, block_table=table)
+            self._host_ctx = None
+
+    def step(self) -> List[PrefillResult]:
+        """One admission round: drain as many queued prompts as the
+        pool can lease (head-of-line FIFO on backpressure), prefill
+        them in ONE fused program per length bucket, and detach every
+        lease into a handle.  The [rows] first-token transfer is the
+        only host materialization — tokens, never cache contents."""
+        taken: List[Tuple[str, np.ndarray, int, float, List[int]]] = []
+        while self.queue:
+            rid, p, num_new, t0 = self.queue[0]
+            need = self._blocks_needed(p.size, num_new)
+            # atomic check-and-lease: a co-located decode engine may be
+            # leasing from the same pool on another thread
+            blocks = self.pool.try_lease(need)
+            if blocks is None:
+                break  # the oldest waits for blocks; FIFO completion
+            self.queue.popleft()
+            taken.append((rid, p, num_new, t0, blocks))
+        if not taken:
+            return []
+        by_bucket: Dict[int, list] = {}
+        for item in taken:
+            p = item[1]
+            blen = (bucket_length(p.size, self.model.max_seq)
+                    if self.bucket_prefill else p.size)
+            by_bucket.setdefault(blen, []).append(item)
+        out: List[PrefillResult] = []
+        for blen, sub in by_bucket.items():
+            n = len(sub)
+            rows = _pow2(n) if self.bucket_prefill else n
+            toks = np.zeros((rows, blen), np.int32)
+            table = np.zeros((rows, self.nb_max), np.int32)
+            pos0 = np.zeros((rows,), np.int32)
+            lens = np.ones((rows,), np.int32)  # pad rows index token 0
+            for r, (rid, p, num_new, t0, blocks) in enumerate(sub):
+                toks[r, :p.size] = p
+                table[r, :len(blocks)] = blocks
+                lens[r] = p.size
+            firsts, new_pools = self._pf(
+                self.params, self._borrow_pools(), pos0, table, toks, lens,
+            )
+            self._restore_pools(new_pools)
+            vals = np.asarray(firsts)
+            for r, (rid, p, num_new, t0, blocks) in enumerate(sub):
+                handle = self.pool.detach(blocks, seq_len=int(p.size))
+                out.append(PrefillResult(rid, int(vals[r]), handle,
+                                         num_new, t0))
+        self.prefills += len(out)
+        return out
+
+    def run(self) -> List[PrefillResult]:
+        """Drain the whole queue (blocks permitting each round)."""
+        out: List[PrefillResult] = []
+        while self.queue:
+            got = self.step()
+            if not got:
+                break  # backpressure with nothing in flight to free blocks
+            out.extend(got)
+        return out
+
+    def stats(self) -> dict:
+        return {"queued": len(self.queue), "prefills": self.prefills,
+                **self.pool.stats()}
+
+
+class DecodeEngine(PagedBatcher):
+    """The decode role: the PagedBatcher decode loop, admitting via
+    handle adoption instead of raw prompts.  ``self.queue`` holds
+    :class:`_PendingAdopt` records (claimed handles waiting for a
+    slot), so the base class's drive loop (``run``/``step``/stats
+    queue-depth accounting) works unchanged."""
+
+    def __init__(self, model: TransformerLM, params, max_batch: int,
+                 replica_id: str = "decode0", **kw) -> None:
+        super().__init__(model, params, max_batch, **kw)
+        self.replica_id = replica_id
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def _adopt_bind(btab, bpos, tok, slots, rows, sizes, firsts):
+            """Shared-pool adoption: rebind a group of handles' blocks
+            into their slots' table rows, positions, and first tokens
+            in ONE fused scatter — no cache bytes move at all.
+            ``slots`` may carry out-of-bounds padding (dropped)."""
+            return (btab.at[slots].set(rows),
+                    bpos.at[slots].set(sizes),
+                    tok.at[slots].set(firsts))
+
+        self._adopt_bind = _adopt_bind
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+        def _adopt_copy(src_pools, pools, btab, bpos, tok,
+                        src_idx, dst_idx, slots, rows, sizes, firsts):
+            """Cross-pool adoption: gather the source pool's blocks,
+            scatter them into this engine's leased blocks (donated —
+            in place), and publish table/position/token, all in ONE
+            program.  Padding index rows point both sides at block 0
+            (the garbage block) and their slots out of bounds."""
+            def cp(dst, src):
+                return dst.at[dst_idx].set(src[src_idx].astype(dst.dtype))
+
+            out = jax.tree.map(cp, pools, src_pools)
+            return (out,
+                    btab.at[slots].set(rows),
+                    bpos.at[slots].set(sizes),
+                    tok.at[slots].set(firsts))
+
+        self._adopt_copy = _adopt_copy
+
+    # ------------------------------------------------------------------
+    def ping(self) -> bool:
+        """Health probe for the router (a live in-process engine is
+        always healthy; remote transports override)."""
+        return True
+
+    def submit(self, rid: str, prompt, num_new: int) -> None:
+        raise TypeError(
+            "DecodeEngine admits finished prefills — use submit_handle() "
+            "(raw prompts go to the PrefillEngine or a monolithic "
+            "PagedBatcher)"
+        )
+
+    def submit_handle(self, rid: str, handle: KVHandle, first_token: int,
+                      num_new: int, source=None, submitted: float = 0.0,
+                      admit: bool = True) -> None:
+        """Adopt a detached K/V lease: claim it now (stale stamps fail
+        HERE, loudly), queue it for a slot, and admit as capacity
+        frees.  ``source`` is the engine owning the handle's pool when
+        it is not this engine's own (the cross-pool copy mode).
+        ``admit=False`` defers the admission scatter so a caller
+        delivering a batch of handles (the router's pump) gets ONE
+        fused adoption group instead of one program per handle — call
+        :meth:`admit_pending` once after the batch."""
+        if num_new < 1:
+            raise ValueError(f"num_new must be >= 1, got {num_new}")
+        if handle.seq_len + num_new > self.model.max_seq:
+            raise ValueError(
+                f"seq_len ({handle.seq_len}) + num_new ({num_new}) "
+                f"exceeds max_seq ({self.model.max_seq})"
+            )
+        if rid in self._rids:
+            raise ValueError(f"duplicate request id {rid!r}")
+        if handle.pool_id == self.pool.pool_id:
+            blocks = self.pool.adopt(handle)  # StaleHandleError on reuse
+            mode, src = "shared", None
+        else:
+            if source is None or getattr(source, "pool", None) is None \
+                    or source.pool.pool_id != handle.pool_id:
+                raise PoolMismatchError(
+                    f"handle from pool {handle.pool_id!r} needs its source "
+                    f"engine to copy from"
+                )
+            if len(handle.blocks) > self.pool.leasable():
+                raise ValueError(
+                    "handle needs more blocks than this pool can ever lease"
+                )
+            blocks = source.pool.adopt(handle)  # claim the src references
+            mode, src = "copy", source
+        self._rids.add(rid)
+        self.queue.append(_PendingAdopt(
+            rid, blocks, handle.seq_len, int(first_token), num_new,
+            mode, src, submitted,
+        ))
+        if admit:
+            self._admit_pending()
+
+    def admit_pending(self) -> None:
+        """Public admission kick for batched ``submit_handle(...,
+        admit=False)`` deliveries: ONE fused adoption group for
+        everything queued (slots permitting)."""
+        self._admit_pending()
+
+    # -- admission: drain claimed handles into free slots ---------------
+    def _admit_pending(self) -> None:
+        progress = True
+        while progress:
+            progress = False
+            group: List[Tuple[int, _PendingAdopt, List[int]]] = []
+            for slot in self._free_slots():
+                if not self.queue:
+                    break
+                if not self._slot_is_free(slot):
+                    continue
+                pa: _PendingAdopt = self.queue[0]
+                if pa.mode == "copy":
+                    # atomic check-and-lease (a shared-pool prefill may
+                    # lease concurrently); head-of-line: the oldest
+                    # adoption waits for blocks
+                    dst = self.pool.try_lease(len(pa.blocks))
+                    if dst is None:
+                        break
+                else:
+                    dst = list(pa.blocks)
+                self.queue.popleft()
+                self._slot_blocks[slot] = dst
+                group.append((slot, pa, dst))
+            if group:
+                self._adopt_group(group)
+                progress = True
+
+    def _adopt_group(
+        self, group: List[Tuple[int, _PendingAdopt, List[int]]]
+    ) -> None:
+        shared = [e for e in group if e[1].mode == "shared"]
+        by_src: Dict[int, list] = {}
+        for e in group:
+            if e[1].mode == "copy":
+                by_src.setdefault(id(e[1].source), []).append(e)
+        if shared:
+            self._bind_rows(shared)
+            HANDOFF_TOTAL.inc(len(shared), mode="shared")
+            HANDOFF_BLOCKS.inc(sum(len(d) for _, _, d in shared))
+        for sub in by_src.values():
+            self._copy_rows(sub)
+        # host bookkeeping mirrors _queue_first, except the first token
+        # is already a known int (prefill materialized it as a token —
+        # tokens cross the host, cache contents never do)
+        for slot, pa, _dst in group:
+            self.rid[slot] = pa.rid
+            self.out[pa.rid] = [pa.first]
+            self.active[slot] = True
+            self.done_frozen[slot] = (self.eos_id is not None
+                                      and pa.first == self.eos_id)
+            self.remaining[slot] = pa.num_new - 1
+            if pa.submitted:
+                _batcher._QTFT_HIST.observe(
+                    time.perf_counter() - pa.submitted
+                )
+            self._maybe_retire(slot)
+
+    def _adopt_arrays(self, entries):
+        """Shared scatter operands for an adoption group, row-padded to
+        a power of two (bounded program count; pad slots are
+        out-of-bounds and dropped by the scatter)."""
+        n = len(entries)
+        rows_n = _pow2(n) if self.bucket_prefill else n
+        rows = np.zeros((rows_n, self.nb_max), np.int32)
+        slots = np.full((rows_n,), self.max_batch, np.int32)  # OOB pad
+        sizes = np.zeros((rows_n,), np.int32)
+        firsts = np.zeros((rows_n,), np.int32)
+        for r, (slot, pa, dst) in enumerate(entries):
+            rows[r, :len(dst)] = dst
+            slots[r] = slot
+            sizes[r] = pa.seq_len
+            firsts[r] = pa.first
+        return rows, slots, sizes, firsts
+
+    def _bind_rows(self, entries) -> None:
+        rows, slots, sizes, firsts = self._adopt_arrays(entries)
+        pools, bpos, btab = self._split_cache()
+        btab, bpos, self.tok = self._adopt_bind(
+            btab, bpos, self.tok, slots, rows, sizes, firsts,
+        )
+        self.cache = dict(pools, pos=bpos, block_table=btab)
+
+    def _copy_rows(self, entries) -> None:
+        src_engine = entries[0][1].source
+        src_pools = src_engine.pool_leaves()
+        rows, slots, sizes, firsts = self._adopt_arrays(entries)
+        rows_n = rows.shape[0]
+        m = _pow2(max(len(e[1].blocks) for e in entries))
+        src_idx = np.zeros((rows_n, m), np.int32)  # pad → garbage block
+        dst_idx = np.zeros((rows_n, m), np.int32)
+        for r, (_slot, pa, dst) in enumerate(entries):
+            src_idx[r, :len(pa.blocks)] = pa.blocks
+            dst_idx[r, :len(dst)] = dst
+        pools, bpos, btab = self._split_cache()
+        new_pools, btab, bpos, self.tok = self._adopt_copy(
+            src_pools, pools, btab, bpos, self.tok,
+            src_idx, dst_idx, slots, rows, sizes, firsts,
+        )
+        self.cache = dict(new_pools, pos=bpos, block_table=btab)
+        # the copy is enqueued; program order guarantees it reads the
+        # source blocks before any later source-pool prefill can touch
+        # them, so the host-side free is safe now
+        nblocks = 0
+        for _slot, pa, _dst in entries:
+            src_engine.pool.release(pa.blocks)
+            nblocks += len(pa.blocks)
+        per_block = sum(
+            int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(src_pools)
+        )
+        HANDOFF_TOTAL.inc(len(entries), mode="copy")
+        HANDOFF_BLOCKS.inc(nblocks)
+        HANDOFF_DEVICE_BYTES.inc(nblocks * per_block)
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out["replica"] = self.replica_id
+        # the router's admission-control inputs, precomputed
+        out["slots_active_ratio"] = out["active_slots"] / max(
+            1, self.max_batch
+        )
+        return out

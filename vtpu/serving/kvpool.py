@@ -1,0 +1,285 @@
+"""Host-side block-pool accounting and transferable K/V leases.
+
+``PagedBatcher`` (vtpu/serving/paged.py) used to keep its free list and
+refcounts inline; this module factors that accounting into a
+:class:`BlockPool` so a lease can outlive the engine that took it —
+the primitive behind prefill/decode disaggregation (ROADMAP item 2,
+FlexNPU's prefill-decode co-location): a prefill engine writes a
+request's K/V into leased blocks, **detaches** the lease into a
+serializable :class:`KVHandle`, and a decode engine **adopts** the
+handle — either zero-copy (same pool: the blocks are simply rebound
+into the decode slot's table row) or via one fused device-side
+gather/scatter into its own pool (cross-pool: the bytes never
+materialize on the host; ``vtpu_kv_handoff_host_bytes_total`` is the
+regression tripwire that stays at 0).
+
+Wire format (``KVHandle.to_wire``): ``{"pool": <pool id>, "blocks":
+[ints], "seq_len": <tokens written>, "stamp": <generation>}``.  The
+stamp is the pool's monotonically increasing detach generation; a
+handle is valid for exactly one adoption.  Adopting a stale handle
+(already adopted, or released) raises :class:`StaleHandleError`;
+releasing blocks that hold no live reference raises
+:class:`DoubleReleaseError` — both are typed, loud failures where the
+old inline accounting would have silently corrupted the free list.
+
+This module is deliberately JAX-free: the device-side copy programs
+live in vtpu/serving/disagg.py, the accounting here is pure host
+bookkeeping (importable by the router and the fast test lane).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import uuid
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from vtpu import obs
+
+_REG = obs.registry("serving")
+
+# K/V handoff instrumentation (docs/observability.md §Serving): adopt
+# outcomes by mode (shared = same-pool zero-copy rebind, copy = fused
+# cross-pool device scatter), blocks moved, and the two byte counters —
+# device bytes ride the fused program, host bytes MUST stay 0 (the
+# disagg bench asserts it; any increment means a handoff regressed into
+# a host-numpy round trip).
+HANDOFF_TOTAL = _REG.counter(
+    "vtpu_kv_handoff_total",
+    "K/V handle adoptions by mode (shared = zero-copy rebind, "
+    "copy = fused cross-pool device transfer)",
+)
+HANDOFF_BLOCKS = _REG.counter(
+    "vtpu_kv_handoff_blocks_total",
+    "Pool blocks moved (or rebound) by K/V handle adoptions",
+)
+HANDOFF_DEVICE_BYTES = _REG.counter(
+    "vtpu_kv_handoff_device_bytes_total",
+    "K/V bytes moved device-side by cross-pool handle adoptions",
+)
+HANDOFF_HOST_BYTES = _REG.counter(
+    "vtpu_kv_handoff_host_bytes_total",
+    "K/V cache bytes that crossed the host on an adopt path — the "
+    "regression tripwire: the fused adopt never materializes cache "
+    "contents in host numpy, so this stays 0",
+)
+HANDOFF_STALE = _REG.counter(
+    "vtpu_kv_handoff_stale_total",
+    "Handle adoptions rejected because the generation stamp was stale",
+)
+
+class KVHandoffError(RuntimeError):
+    """Base class for lease/handle protocol violations."""
+
+
+class DoubleReleaseError(KVHandoffError):
+    """A lease was released twice (or never held): honoring it would
+    push its blocks onto the free list a second time and hand the same
+    physical block to two tenants."""
+
+
+class StaleHandleError(KVHandoffError):
+    """A handle's generation stamp no longer matches the pool — it was
+    already adopted, or its lease was released underneath it."""
+
+
+class PoolMismatchError(KVHandoffError):
+    """A handle was presented to (or with) a pool it does not belong to."""
+
+
+@dataclasses.dataclass(frozen=True)
+class KVHandle:
+    """Transferable K/V lease: the serializable claim ticket a prefill
+    engine detaches and a decode engine adopts.  Carries no cache
+    contents — only the pool coordinates of the blocks that hold them."""
+
+    pool_id: str
+    blocks: Tuple[int, ...]
+    seq_len: int   # tokens actually written (the prompt length)
+    stamp: int     # pool detach generation; valid for ONE adoption
+
+    def to_wire(self) -> dict:
+        return {
+            "pool": self.pool_id,
+            "blocks": list(self.blocks),
+            "seq_len": self.seq_len,
+            "stamp": self.stamp,
+        }
+
+    @classmethod
+    def from_wire(cls, doc: dict) -> "KVHandle":
+        try:
+            return cls(
+                pool_id=str(doc["pool"]),
+                blocks=tuple(int(b) for b in doc["blocks"]),
+                seq_len=int(doc["seq_len"]),
+                stamp=int(doc["stamp"]),
+            )
+        except (KeyError, TypeError, ValueError) as e:
+            raise KVHandoffError(f"malformed KV handle: {doc!r}") from e
+
+
+class BlockPool:
+    """Refcounted free-list accounting for one physical block pool.
+
+    Block 0 is sacrificial (the garbage block inactive rows write into)
+    and is never leased.  All methods are thread-safe: the router may
+    adopt into a decode engine on one thread while the prefill engine
+    leases on another.
+
+    The detach registry maps a handle's stamp to the block list it was
+    detached with; ``adopt`` consumes the entry — a second adoption (or
+    a release racing an adoption) finds the stamp gone and raises
+    :class:`StaleHandleError` instead of silently double-binding blocks.
+    """
+
+    def __init__(self, total_blocks: int, block_size: int,
+                 pool_id: str = "") -> None:
+        if total_blocks < 2:
+            raise ValueError(
+                f"BlockPool needs at least 2 blocks (block 0 is the "
+                f"garbage block), got {total_blocks}"
+            )
+        # globally unique by default: the handle wire format crosses
+        # process boundaries, and adoption mode (shared vs copy) is
+        # selected by pool-id equality — a colliding id would mis-adopt
+        self.pool_id = pool_id or f"pool-{uuid.uuid4().hex[:12]}"
+        self.total_blocks = total_blocks
+        self.block_size = block_size
+        self._lock = threading.RLock()
+        self.free: collections.deque[int] = collections.deque(
+            range(1, total_blocks)
+        )
+        self._refs: Dict[int, int] = {}
+        self._stamp = 0
+        self._detached: Dict[int, Tuple[int, ...]] = {}
+        self._detached_blocks: Set[int] = set()
+
+    # -- leases ---------------------------------------------------------
+    def leasable(self) -> int:
+        return self.total_blocks - 1
+
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self.free)
+
+    def try_lease(self, n: int) -> Optional[List[int]]:
+        """Atomically lease ``n`` blocks, or ``None`` when fewer are
+        free — the race-free form of check-then-lease for callers that
+        back off (engine admission under a concurrently-leased shared
+        pool)."""
+        with self._lock:
+            if n > len(self.free):
+                return None
+            blocks = [self.free.popleft() for _ in range(n)]
+            for b in blocks:
+                self._refs[b] = 1
+            return blocks
+
+    def lease(self, n: int) -> List[int]:
+        """Take ``n`` blocks off the free list (refcount 1 each).
+        Caller must have checked ``free_blocks()`` — an empty pop is a
+        programming error, not backpressure."""
+        blocks = self.try_lease(n)
+        if blocks is None:
+            raise KVHandoffError(
+                f"pool {self.pool_id}: lease of {n} blocks exceeds "
+                f"{self.free_blocks()} free"
+            )
+        return blocks
+
+    def ref(self, blocks: Sequence[int]) -> None:
+        with self._lock:
+            for b in blocks:
+                if b not in self._refs:
+                    raise DoubleReleaseError(
+                        f"pool {self.pool_id}: ref on unleased block {b}"
+                    )
+                self._refs[b] += 1
+
+    def release(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block; a block reaching 0 returns to
+        the free list.  Raises :class:`DoubleReleaseError` (before
+        touching anything) when any block holds no live reference —
+        the old inline accounting would have KeyErrored halfway or
+        pushed a free block onto the free list twice."""
+        with self._lock:
+            for b in blocks:
+                if self._refs.get(b, 0) < 1:
+                    raise DoubleReleaseError(
+                        f"pool {self.pool_id}: release of block {b} which "
+                        f"holds no live reference (double release?)"
+                    )
+            for b in blocks:
+                self._refs[b] -= 1
+                if self._refs[b] == 0:
+                    del self._refs[b]
+                    self.free.append(b)
+
+    # -- transferable handles -------------------------------------------
+    def detach(self, blocks: Sequence[int], seq_len: int) -> KVHandle:
+        """Turn a live lease into a transferable handle: the lease's
+        references move to the handle (no refcount change) and the pool
+        records the detach generation the handle must present back."""
+        with self._lock:
+            for b in blocks:
+                if b not in self._refs:
+                    raise DoubleReleaseError(
+                        f"pool {self.pool_id}: detach of unleased block {b}"
+                    )
+                if b in self._detached_blocks:
+                    # two adoptable handles over one block would be the
+                    # silent double-bind this protocol exists to stop
+                    raise KVHandoffError(
+                        f"pool {self.pool_id}: block {b} already belongs "
+                        f"to a detached handle"
+                    )
+            self._stamp += 1
+            handle = KVHandle(self.pool_id, tuple(blocks), seq_len,
+                              self._stamp)
+            self._detached[self._stamp] = handle.blocks
+            self._detached_blocks.update(handle.blocks)
+            return handle
+
+    def _claim(self, handle: KVHandle) -> Tuple[int, ...]:
+        if handle.pool_id != self.pool_id:
+            raise PoolMismatchError(
+                f"handle belongs to pool {handle.pool_id!r}, "
+                f"not {self.pool_id!r}"
+            )
+        with self._lock:
+            blocks = self._detached.pop(handle.stamp, None)
+            if blocks is None or blocks != handle.blocks:
+                if blocks is not None:  # stamp reused with other blocks
+                    self._detached[handle.stamp] = blocks
+                HANDOFF_STALE.inc()
+                raise StaleHandleError(
+                    f"pool {self.pool_id}: handle stamp {handle.stamp} is "
+                    f"stale (already adopted or released)"
+                )
+            self._detached_blocks.difference_update(blocks)
+            return blocks
+
+    def adopt(self, handle: KVHandle) -> List[int]:
+        """Consume a detached handle: the blocks (and their references)
+        now belong to the caller — same-pool zero-copy adoption.  One
+        adoption per handle; a second raises :class:`StaleHandleError`."""
+        return list(self._claim(handle))
+
+    def release_handle(self, handle: KVHandle) -> None:
+        """Consume a detached handle and free its blocks — the source
+        side of a cross-pool adoption (after the device copy), or an
+        abandoned prefill."""
+        self.release(self._claim(handle))
+
+    # -- introspection --------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "pool_id": self.pool_id,
+                "pool_blocks": self.total_blocks,
+                "leased": len(self._refs),
+                "free": len(self.free),
+                "detached_handles": len(self._detached),
+            }

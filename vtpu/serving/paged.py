@@ -55,6 +55,7 @@ import numpy as np
 from vtpu.models.transformer import TransformerLM, bucket_length
 from vtpu.ops.quant import dequantize_tree
 from vtpu.serving.batcher import ContinuousBatcher, _Request
+from vtpu.serving.kvpool import BlockPool
 
 
 class PagedBatcher(ContinuousBatcher):
@@ -76,11 +77,12 @@ class PagedBatcher(ContinuousBatcher):
                          bucket_prefill=bucket_prefill)
         self.block_size = model.kv_block_size
         self.nb_max = model.max_seq // model.kv_block_size
-        # block 0 is the garbage block for inactive rows — never leased
-        self.free: collections.deque[int] = collections.deque(
-            range(1, model.kv_pool_blocks)
-        )
-        self._block_refs: Dict[int, int] = {}
+        # host-side block accounting lives in a BlockPool (block 0 is
+        # the garbage block for inactive rows — never leased).  The pool
+        # is a separate object so leases can OUTLIVE this engine as
+        # transferable K/V handles (vtpu/serving/kvpool.py: the
+        # prefill/decode disaggregation substrate)
+        self.pool = BlockPool(model.kv_pool_blocks, model.kv_block_size)
         self._slot_blocks: Dict[int, List[int]] = {}
         # prefix registry: token-tuple (block-aligned) → block ids; FIFO
         # eviction beyond ``prefix_cache`` entries
@@ -144,23 +146,25 @@ class PagedBatcher(ContinuousBatcher):
 
         self._admit_pool = _admit_pool
 
-    # -- block accounting ----------------------------------------------
+    # -- block accounting (delegated to the BlockPool) ------------------
+    @property
+    def free(self) -> "collections.deque[int]":
+        return self.pool.free
+
+    @property
+    def _block_refs(self) -> Dict[int, int]:
+        return self.pool._refs
+
     def _lease(self, n: int) -> List[int]:
-        blocks = [self.free.popleft() for _ in range(n)]
-        for b in blocks:
-            self._block_refs[b] = 1
-        return blocks
+        return self.pool.lease(n)
 
     def _ref(self, blocks: List[int]) -> None:
-        for b in blocks:
-            self._block_refs[b] += 1
+        self.pool.ref(blocks)
 
     def _unref(self, blocks: List[int]) -> None:
-        for b in blocks:
-            self._block_refs[b] -= 1
-            if self._block_refs[b] == 0:
-                del self._block_refs[b]
-                self.free.append(b)
+        # raises DoubleReleaseError on an unheld block instead of
+        # silently corrupting the free list (vtpu/serving/kvpool.py)
+        self.pool.release(blocks)
 
     # -- admission ------------------------------------------------------
     def _blocks_needed(self, req: _Request) -> int:
@@ -445,9 +449,7 @@ class PagedBatcher(ContinuousBatcher):
         )
 
     def pool_stats(self) -> dict:
-        leased = len(self._block_refs)
-        return {"pool_blocks": self.model.kv_pool_blocks,
-                "leased": leased, "free": len(self.free),
+        return {**self.pool.stats(),
                 "registered_prefixes": len(self._prefixes)}
 
     def stats(self) -> dict:

@@ -1,0 +1,392 @@
+"""The serving front door: session affinity, admission control, and
+load shedding over N decode replicas.
+
+One batcher was the serving ceiling (ROADMAP item 2); the router makes
+the decode tier horizontal.  It owns one :class:`~vtpu.serving.disagg.
+PrefillEngine` (prefill is throughput work — bursts queue here, never
+in a decode engine's token cadence) and N decode replicas, and drives
+the handoff between them:
+
+- **Session affinity**: sessions hash onto replicas via the SAME
+  consistent-hash ring the sharded scheduler extender uses
+  (:class:`vtpu.scheduler.shard.HashRing`) — a drained replica only
+  remaps its own sessions.  A session seen once is PINNED: all its
+  requests land on the same replica (its K/V prefixes and transcript
+  live there), until the session's replica is drained, at which point
+  *new* sessions (and new sessions only) re-hash — in-flight sessions
+  finish where they are.
+- **Admission control**: each submit consults the target replica's
+  live ``slots_active_ratio`` and queue depth (claimed handles waiting
+  for slots + this router's prefill backlog bound for it).  A replica
+  past ``max_backlog`` sheds with a typed :class:`RouterReject`
+  (HTTP 429 semantics — the caller retries elsewhere/later; nothing
+  is silently dropped).
+- **Health**: replicas answer ``ping()``.  ``fail_threshold``
+  consecutive failures drain a replica — removed from the ring for
+  new sessions while in-flight sessions finish — and a successful
+  ping restores it; both transitions land in the event journal
+  (``ReplicaDrained`` / ``ReplicaRestored``) and the
+  ``vtpu_router_*`` metric families (docs/observability.md).
+
+The router is deliberately JAX-free (duck-typed replicas), so the
+control-plane test lane exercises every policy with fake replicas.
+docs/serving.md describes the full topology; ``make bench-disagg``
+measures it.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import time
+from typing import Dict, List, Optional
+
+from vtpu import obs
+from vtpu.obs.events import EventType, emit
+from vtpu.scheduler.shard import HashRing
+from vtpu.serving.kvpool import KVHandoffError
+
+log = logging.getLogger(__name__)
+
+__all__ = ["Router", "RouterReject"]
+
+_REG = obs.registry("serving")
+
+_REQS_TOTAL = _REG.counter(
+    "vtpu_router_requests_total",
+    "Requests entering the front door by outcome (routed / shed)",
+)
+_SHED_TOTAL = _REG.counter(
+    "vtpu_router_sheds_total",
+    "Requests shed by the admission controller, by typed reason",
+)
+_HEALTHY_INFO = _REG.gauge(
+    "vtpu_router_replica_healthy_info",
+    "1 while the labelled decode replica is in the ring, 0 while drained",
+)
+_TRANSITIONS = _REG.counter(
+    "vtpu_router_replica_transitions_total",
+    "Replica health transitions (to=drained / restored)",
+)
+_BACKLOG = _REG.gauge(
+    "vtpu_router_backlog_total",
+    "Requests admitted but not yet adopted by a decode replica "
+    "(prefill queue + in-flight handoffs), by replica",
+)
+
+
+class RouterReject(Exception):
+    """Typed load-shed rejection (HTTP 429 semantics).  ``reason`` is
+    machine-readable; the request was NOT admitted and the caller may
+    retry later or elsewhere."""
+
+    status = 429
+
+    def __init__(self, reason: str, detail: str = "") -> None:
+        super().__init__(detail or reason)
+        self.reason = reason
+
+
+class Router:
+    """Front door over one prefill engine and N decode replicas.
+
+    ``replicas`` maps replica id → decode engine (anything with
+    ``submit_handle`` / ``step`` / ``stats`` / ``ping``).  The caller
+    drives :meth:`pump` (one prefill round + one decode window per
+    replica) or :meth:`drain` (run to completion)."""
+
+    def __init__(
+        self,
+        prefill,
+        replicas: Dict[str, object],
+        *,
+        max_backlog: Optional[int] = None,
+        fail_threshold: int = 3,
+        ping_interval_s: float = 0.0,
+        clock=time.monotonic,
+    ) -> None:
+        if not replicas:
+            raise ValueError("Router needs at least one decode replica")
+        self.prefill = prefill
+        self.replicas = dict(replicas)
+        host = getattr(prefill, "_host", None)
+        if host is not None and (
+            len(self.replicas) > 1
+            or not any(eng is host for eng in self.replicas.values())
+        ):
+            # a shared-pool prefill writes straight into its host decode
+            # engine's pool; no other replica can adopt those handles
+            # (there is no source pool to copy from)
+            raise ValueError(
+                "a shared-pool (co-located) prefill serves exactly its "
+                "host decode engine — construct the Router with that "
+                "single replica, or give the prefill its own pool for "
+                "multi-replica topologies"
+            )
+        # shed when a replica's uncollected work (active slots + claimed
+        # handles waiting + our own prefill backlog for it) reaches
+        # max_batch + max_backlog; default backlog = 2× the largest
+        # replica's slot count (an explicit 0 = shed the moment every
+        # slot is taken)
+        self.max_backlog = max_backlog if max_backlog is not None else (
+            2 * max(int(r.stats().get("max_batch", 1))
+                    for r in replicas.values())
+        )
+        self.fail_threshold = max(1, fail_threshold)
+        self.ping_interval_s = ping_interval_s
+        self._clock = clock
+        self._last_ping = 0.0
+        self._healthy = set(self.replicas)
+        self._fails: Dict[str, int] = {rid: 0 for rid in self.replicas}
+        self._ring = HashRing(sorted(self._healthy))
+        # session → pinned replica, LRU-bounded: a front door sees an
+        # unbounded stream of session ids and a pin is only best-effort
+        # affinity — evicting the coldest pin just re-hashes that
+        # session (same defensive cap discipline as HashRing._memo)
+        self._sessions: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
+        self._session_cap = 65536
+        self._target: Dict[str, str] = {}       # rid → replica id
+        self._pending: Dict[str, int] = {rid: 0 for rid in self.replicas}
+        self.shed = 0
+        for rid in self.replicas:
+            _HEALTHY_INFO.set(1.0, replica=rid)
+
+    # -- routing --------------------------------------------------------
+    def _route(self, session: str) -> str:
+        pinned = self._sessions.get(session)
+        if pinned is not None:
+            # in-flight sessions finish where they are, even on a
+            # drained replica (it still answers; it just takes no new
+            # sessions); the replica set itself is fixed for the
+            # router's lifetime
+            self._sessions.move_to_end(session)
+            return pinned
+        if not self._healthy:
+            raise RouterReject(
+                "no_healthy_replica",
+                "every decode replica is drained",
+            )
+        rid = self._ring.owner(session)
+        self._sessions[session] = rid
+        while len(self._sessions) > self._session_cap:
+            self._sessions.popitem(last=False)
+        return rid
+
+    def submit(self, session: str, rid: str, prompt, num_new: int) -> str:
+        """Admit one request: pick the session's replica, check its
+        live load (active slots + handles claimed but not yet in a slot
+        + our own uncollected prefill backlog for it), and queue the
+        prefill.  Returns the chosen replica id; raises
+        :class:`RouterReject` on shed."""
+        try:
+            replica = self._route(session)
+            st = self.replicas[replica].stats()
+            load = (int(st.get("active_slots", 0))
+                    + int(st.get("queued", 0))
+                    + self._pending.get(replica, 0))
+            limit = int(st.get("max_batch", 1)) + self.max_backlog
+            if load >= limit:
+                raise RouterReject(
+                    "replica_saturated",
+                    f"replica {replica} at {load} (≥ {limit})",
+                )
+        except RouterReject as e:
+            self.shed += 1
+            _REQS_TOTAL.inc(outcome="shed")
+            _SHED_TOTAL.inc(reason=e.reason)
+            raise
+        self.prefill.submit(rid, prompt, num_new)
+        self._target[rid] = replica
+        self._pending[replica] = self._pending.get(replica, 0) + 1
+        _REQS_TOTAL.inc(outcome="routed")
+        _BACKLOG.set(self._pending[replica], replica=replica)
+        return replica
+
+    # -- health ---------------------------------------------------------
+    def check_health(self) -> None:
+        """Ping every replica; drain after ``fail_threshold``
+        consecutive failures, restore on the first success."""
+        self._last_ping = self._clock()
+        for rid, eng in self.replicas.items():
+            try:
+                ok = bool(eng.ping())
+            except Exception:  # noqa: BLE001 — a dead replica is a failed ping
+                ok = False
+            if ok:
+                self._fails[rid] = 0
+                if rid not in self._healthy:
+                    self._restore(rid)
+            else:
+                self._fails[rid] += 1
+                if (rid in self._healthy
+                        and self._fails[rid] >= self.fail_threshold):
+                    self._drain(rid)
+
+    def _drain(self, rid: str) -> None:
+        self._healthy.discard(rid)
+        self._rebuild_ring()
+        _HEALTHY_INFO.set(0.0, replica=rid)
+        _TRANSITIONS.inc(replica=rid, to="drained")
+        emit(EventType.REPLICA_DRAINED, "router", node=rid,
+             consecutive_failures=self._fails[rid])
+        log.warning("router: replica %s drained after %d failed pings",
+                    rid, self._fails[rid])
+
+    def _restore(self, rid: str) -> None:
+        self._healthy.add(rid)
+        self._rebuild_ring()
+        _HEALTHY_INFO.set(1.0, replica=rid)
+        _TRANSITIONS.inc(replica=rid, to="restored")
+        emit(EventType.REPLICA_RESTORED, "router", node=rid)
+        log.info("router: replica %s restored", rid)
+
+    def _rebuild_ring(self) -> None:
+        # new sessions re-hash over the healthy set; pinned sessions on
+        # a drained replica keep finishing there (session affinity is
+        # the point — their K/V lives on that replica), so the pin map
+        # is NOT touched here
+        self._ring = (HashRing(sorted(self._healthy))
+                      if self._healthy else None)
+
+    def _route_fallback(self, rid_req: str,
+                        exclude: Optional[str] = None) -> Optional[str]:
+        """A handoff whose target stopped accepting re-hashes over the
+        healthy set minus the replica that just failed (the prefill K/V
+        is replica-agnostic — only the session pin is lost)."""
+        cands = sorted(self._healthy - ({exclude} if exclude else set()))
+        if not cands:
+            return None
+        if len(cands) == 1:
+            return cands[0]
+        return HashRing(cands).owner(rid_req)
+
+    # -- drive ----------------------------------------------------------
+    def pump(self) -> int:
+        """One cooperative round: health (if due), one prefill step,
+        adopt every finished prefill into its replica, one decode step
+        per replica.  Returns the number of handoffs performed."""
+        if (self.ping_interval_s
+                and self._clock() - self._last_ping >= self.ping_interval_s):
+            self.check_health()
+        handoffs = 0
+        src = None if getattr(self.prefill, "_host", None) is not None \
+            else self.prefill
+        # deliveries are batched per replica: every handle lands with
+        # admit=False and the replica admits ONCE after the batch — one
+        # fused adoption group instead of one device program per handle
+        touched = set()
+
+        def deliver(rep_id: str, res) -> None:
+            eng = self.replicas[rep_id]
+            if hasattr(eng, "admit_pending"):
+                eng.submit_handle(
+                    res.rid, res.handle, res.first_token, res.num_new,
+                    source=src, submitted=res.submitted, admit=False,
+                )
+                touched.add(rep_id)
+            else:
+                eng.submit_handle(
+                    res.rid, res.handle, res.first_token, res.num_new,
+                    source=src, submitted=res.submitted,
+                )
+
+        for res in self.prefill.step():
+            orig = self._target.pop(res.rid, None)
+            if orig is not None:  # the uncollected-backlog ledger entry
+                self._pending[orig] = max(0, self._pending.get(orig, 1) - 1)
+                _BACKLOG.set(self._pending[orig], replica=orig)
+            target = orig if orig in self.replicas \
+                else self._route_fallback(res.rid)
+            delivered = False
+            if target is not None:
+                try:
+                    deliver(target, res)
+                    delivered = True
+                except Exception:  # noqa: BLE001 — died mid-handoff
+                    log.exception("router: handoff to %s failed", target)
+                    fb = self._route_fallback(res.rid, exclude=target)
+                    if fb is not None:
+                        try:
+                            deliver(fb, res)
+                            delivered = True
+                        except Exception:  # noqa: BLE001
+                            log.exception(
+                                "router: fallback handoff to %s failed", fb
+                            )
+            if delivered:
+                handoffs += 1
+            else:
+                # nobody can take it: abandon the prefill so its blocks
+                # free instead of leaking, and account the loss loudly.
+                # The claim may already be consumed (a replica accepted
+                # the handle, then its admission program died) — in
+                # that case there is nothing left to free here
+                try:
+                    self.prefill.pool.release_handle(res.handle)
+                except KVHandoffError:
+                    log.warning(
+                        "router: handle for %s already claimed by a "
+                        "failed replica; its blocks follow that "
+                        "replica's queue", res.rid,
+                    )
+                self.shed += 1
+                _SHED_TOTAL.inc(reason=("no_healthy_replica"
+                                        if target is None
+                                        else "handoff_failed"))
+        for rep_id in touched:
+            try:
+                self.replicas[rep_id].admit_pending()
+            except Exception:  # noqa: BLE001 — one replica must not
+                # abort the round; its claimed handles stay queued and
+                # a failing replica stops answering pings soon after
+                log.exception("router: admit_pending on %s failed", rep_id)
+        for rid, eng in self.replicas.items():
+            try:
+                eng.step()
+            except Exception:  # noqa: BLE001 — a dead replica fails pings next
+                log.debug("router: replica %s step failed", rid,
+                          exc_info=True)
+        return handoffs
+
+    def idle(self) -> bool:
+        """True when nothing is queued or in flight anywhere."""
+        if self.prefill.stats()["queued"]:
+            return False
+        for eng in self.replicas.values():
+            st = eng.stats()
+            if (st.get("active_slots", 0) or st.get("queued", 0)
+                    or st.get("inflight_windows", 0)
+                    or st.get("prefilling_slots", 0)):
+                return False
+        return True
+
+    def drain(self, max_rounds: int = 100000) -> Dict[str, List[int]]:
+        """Pump until idle; returns the merged per-rid transcripts."""
+        rounds = 0
+        while not self.idle():
+            self.pump()
+            rounds += 1
+            if rounds > max_rounds:
+                raise RuntimeError("router drain did not converge")
+        return self.results()
+
+    def results(self) -> Dict[str, List[int]]:
+        out: Dict[str, List[int]] = {}
+        for eng in self.replicas.values():
+            flush = getattr(eng, "_flush_first_tokens", None)
+            if flush is not None:
+                flush()
+            out.update(eng.out)
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "replicas": sorted(self.replicas),
+            "healthy": sorted(self._healthy),
+            "sessions": len(self._sessions),
+            "shed": self.shed,
+            "prefill_queued": self.prefill.stats()["queued"],
+            "pending_handoffs": dict(self._pending),
+        }
