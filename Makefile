@@ -7,8 +7,8 @@ IMG ?= vtpu/vtpu
 PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
-	bench-sched bench-serve bench-churn bench-disagg obs-lint audit-check \
-	image chart clean tidy
+	bench-sched bench-serve bench-churn bench-disagg bench-gang obs-lint \
+	audit-check image chart clean tidy
 
 all: build
 
@@ -162,6 +162,20 @@ endif
 # docs/perf.md#serving-pipeline explains how to read the numbers.
 bench-serve:
 	$(PY) benchmarks/serving_pipeline.py
+
+# gang scheduling proof: two-phase all-or-nothing admission vs naive
+# sequential bind under mixed gang/singleton arrival — admission latency,
+# abort rate, bind-success (must be 1.0 for admitted gangs), and
+# fragmentation (largest-free-rectangle ratio) → docs/artifacts/
+# scheduler_gang.json (docs/gang.md#benchmark explains the numbers).
+# SMOKE=1 runs a seconds-long schema/SLO sanity pass (tier-1 safe; also
+# exercised by tests/test_gang.py).
+bench-gang:
+ifdef SMOKE
+	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_gang.py --smoke
+else
+	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_gang.py
+endif
 
 # prefill/decode disaggregation proof: real-topology token-exactness +
 # zero-host-copy handoff check, then monolithic vs 1/2/4-decode-replica

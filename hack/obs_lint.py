@@ -42,6 +42,7 @@ def main() -> int:
     import vtpu.plugin.server  # noqa: F401 — plugin Allocate histogram
     import vtpu.scheduler.core  # noqa: F401 — filter/patch/bind histograms
     import vtpu.scheduler.decisions  # noqa: F401 — audit-log counter
+    import vtpu.scheduler.gang  # noqa: F401 — gang admission families
     import vtpu.scheduler.metrics  # noqa: F401 — fragmentation gauges
     import vtpu.scheduler.shard  # noqa: F401 — shard/leader families
     import vtpu.serving.batcher  # noqa: F401 — queue-to-first-token

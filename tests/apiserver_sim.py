@@ -70,6 +70,20 @@ class ApiServerSim:
             self.store.bump(node)
             self.store.nodes[node["metadata"]["name"]] = node
 
+    def seed_node_group(self, n: int, **kwargs) -> list:
+        """Seed an N-node homogeneous node group in one call: every node
+        arrives pre-registered (handshake + register + topology +
+        host-coord annotations), so a Scheduler pointed at this sim sees
+        a ready multi-host slice after one registry poll.  Keyword args
+        and the node-dict builder live in tests/golden_scenarios.py
+        (``node_group_nodes``); returns the node names."""
+        from tests.golden_scenarios import node_group_nodes
+
+        nodes = node_group_nodes(n, **kwargs)
+        for node in nodes:
+            self.seed_node(node)
+        return [node["metadata"]["name"] for node in nodes]
+
     def seed_pod(self, pod: dict) -> None:
         with self.store.lock:
             self.store.bump(pod)

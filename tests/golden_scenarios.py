@@ -47,6 +47,69 @@ def build_scheduler():
     return sched
 
 
+def node_group_nodes(
+    n: int,
+    prefix: str = "host",
+    topology: str = "2x2x1",
+    hbm_mb: int = 16384,
+    split: int = 4,
+    model: str = "TPU-v5e",
+    host_grid_width: int = 0,
+    handshake_ts: str = "",
+):
+    """Node dicts for an N-node homogeneous TPU node group, each
+    pre-registered on the annotation bus (handshake Reported + register
+    + topology) and placed on the host grid via ``vtpu.io/host-coord``
+    (``host_grid_width`` hosts per row; 0 = one linear row).  Shared by
+    ``ApiServerSim.seed_node_group`` and :func:`seed_fake_node_group` so
+    the gang tests, the e2e socket test, and the bench harness all build
+    the same cluster one call deep."""
+    import datetime
+
+    from vtpu.device.slice import HOST_COORD_ANNOTATION
+    from vtpu.device.topology import parse_topology
+
+    if not handshake_ts:
+        # default to "now" so freshly-seeded groups audit heartbeat-clean
+        handshake_ts = datetime.datetime.now(
+            datetime.timezone.utc
+        ).strftime("%Y-%m-%dT%H:%M:%SZ")
+    dims = parse_topology(topology)
+    per_host = dims[0] * dims[1] * dims[2]
+    width = host_grid_width if host_grid_width > 0 else n
+    nodes = []
+    for i in range(n):
+        name = f"{prefix}-{i}"
+        chips = [
+            ChipInfo(
+                uuid=f"{name}-tpu-{j}", count=split, hbm_mb=hbm_mb,
+                cores=100, type=model, health=True,
+                coords=(j % dims[0], (j // dims[0]) % dims[1],
+                        j // (dims[0] * dims[1])),
+            )
+            for j in range(per_host)
+        ]
+        nodes.append(new_node(name, annotations={
+            A.NODE_HANDSHAKE: f"Reported {handshake_ts}",
+            A.NODE_REGISTER: codec.encode_node_devices(chips),
+            A.NODE_TOPOLOGY: topology,
+            HOST_COORD_ANNOTATION: f"{i % width},{i // width}",
+        }))
+    return nodes
+
+
+def seed_fake_node_group(client, n: int, **kwargs):
+    """FakeClient flavour of ``ApiServerSim.seed_node_group``; returns
+    the node names."""
+    names = []
+    for node in node_group_nodes(n, **kwargs):
+        annos = node["metadata"].pop("annotations")
+        client.create_node(node)
+        client.patch_node_annotations(node["metadata"]["name"], annos)
+        names.append(node["metadata"]["name"])
+    return names
+
+
 AUDIT_NOW = 1785738400.0  # fixed audit wallclock: 2026-08-03T06:26:40Z
 
 

@@ -1,0 +1,989 @@
+"""Multi-host gang scheduling (vtpu/scheduler/gang.py +
+vtpu/device/slice.py): spec parsing and webhook validation, cross-host
+slice planning, the two-phase all-or-nothing admission (including the
+deterministic mid-reserve conflict proof and the threaded soak with a
+shard arm), the partial_gang auditor drift class, decision-log gang
+verdicts, and the bench-gang smoke schema."""
+
+import threading
+import time
+
+import pytest
+
+from tests.golden_scenarios import node_group_nodes, seed_fake_node_group
+from vtpu.device.slice import (
+    HOST_COORD_ANNOTATION,
+    HostView,
+    assign_host_coords,
+    parse_host_coord,
+    plan_slice,
+)
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.obs import events as ev
+from vtpu.scheduler import Scheduler, SchedulerConfig
+from vtpu.scheduler.gang import (
+    GANG_MESH,
+    GANG_NAME,
+    GANG_SIZE,
+    GangRegistry,
+    GangSpec,
+    parse_gang_spec,
+)
+from vtpu.scheduler.score import slice_affinity
+from vtpu.scheduler.shard import LocalPeer, ShardCoordinator
+from vtpu.utils.types import ContainerDevice, annotations as A, resources as R
+
+from tests.test_usage_cache import assert_cache_equals_oracle
+
+
+def gang_pod(name, gang, size, chips=4, uid=None, mesh=None, pct=100,
+             cores=100):
+    annos = {GANG_NAME: gang, GANG_SIZE: str(size)}
+    if mesh:
+        annos[GANG_MESH] = mesh
+    return new_pod(
+        name, uid=uid or f"uid-{name}", annotations=annos,
+        containers=[{"name": "main", "resources": {"limits": {
+            R.chip: chips, R.memory_percentage: pct, R.cores: cores,
+        }}}],
+    )
+
+
+def solo_pod(name, pct=25, cores=25, uid=None):
+    return new_pod(
+        name, uid=uid or f"uid-{name}",
+        containers=[{"name": "main", "resources": {"limits": {
+            R.chip: 1, R.memory_percentage: pct, R.cores: cores,
+        }}}],
+    )
+
+
+def group_scheduler(n=4, **kw):
+    c = FakeClient()
+    names = seed_fake_node_group(c, n, **kw)
+    s = Scheduler(c, SchedulerConfig(http_bind="127.0.0.1:0"))
+    s.register_from_node_annotations()
+    return c, s, names
+
+
+# ---------------------------------------------------------------------------
+# Spec parsing + webhook validation
+# ---------------------------------------------------------------------------
+
+def test_parse_gang_spec():
+    assert parse_gang_spec({}) is None
+    assert parse_gang_spec({"other": "x"}) is None
+    spec = parse_gang_spec({GANG_NAME: "t", GANG_SIZE: "4"})
+    assert spec == GangSpec("t", 4, None)
+    spec = parse_gang_spec({GANG_NAME: "t", GANG_SIZE: "2", GANG_MESH: "4x2"})
+    assert spec.mesh == (4, 2, 1)
+    for bad in (
+        {GANG_SIZE: "2"},                       # size without name
+        {GANG_NAME: "t"},                       # name without size
+        {GANG_NAME: "t", GANG_SIZE: "zero"},    # non-int size
+        {GANG_NAME: "t", GANG_SIZE: "0"},       # size < 1
+        {GANG_NAME: "t", GANG_SIZE: "2", GANG_MESH: "4x-2"},  # bad mesh
+    ):
+        with pytest.raises(ValueError):
+            parse_gang_spec(bad)
+
+
+def test_webhook_normalizes_gang_mesh_and_warns_on_bad_spec():
+    import base64
+    import json
+
+    from vtpu.scheduler.webhook import handle_admission_review
+
+    cfg = SchedulerConfig()
+
+    def review(pod):
+        return handle_admission_review(
+            {"request": {"uid": "w1", "object": pod}}, cfg
+        )["response"]
+
+    pod = gang_pod("w", "train", 2, mesh="4x2")
+    resp = review(pod)
+    ops = json.loads(base64.b64decode(resp["patch"]))
+    mesh_ops = [o for o in ops if o["path"].endswith("gang-mesh")]
+    assert mesh_ops == [{
+        "op": "replace",
+        "path": "/metadata/annotations/vtpu.io~1gang-mesh",
+        "value": "4x2x1",
+    }]
+    # already-canonical mesh: no gang op
+    pod = gang_pod("w2", "train", 2, mesh="4x2x1")
+    resp = review(pod)
+    ops = json.loads(base64.b64decode(resp.get("patch", "") or "W10="))
+    assert not [o for o in ops if o["path"].endswith("gang-mesh")]
+    # malformed spec: admitted with a warning, never blocked
+    pod = gang_pod("w3", "train", 2)
+    pod["metadata"]["annotations"][GANG_SIZE] = "banana"
+    resp = review(pod)
+    assert resp["allowed"] is True
+    assert any("gang spec invalid" in w for w in resp["warnings"])
+
+
+# ---------------------------------------------------------------------------
+# Host coords + slice planning (vtpu/device/slice.py)
+# ---------------------------------------------------------------------------
+
+def test_parse_and_assign_host_coords():
+    assert parse_host_coord("3,1") == (3, 1)
+    with pytest.raises(ValueError):
+        parse_host_coord("3")
+    with pytest.raises(ValueError):
+        parse_host_coord("-1,0")
+    # annotated grid kept; unannotated (and colliding) nodes chain a full
+    # GAP row below it — their links to the annotated hosts are unknown,
+    # so they must never plan as ICI-adjacent to the grid
+    got = assign_host_coords(
+        ["a", "b", "c", "d"],
+        {"a": "0,0", "b": "1,0", "c": "0,0", "d": ""},
+    )
+    assert got["a"] == (0, 0) and got["b"] == (1, 0)
+    assert got["c"][1] == 2 and got["d"][1] == 2  # gap row, not adjacent
+    assert got["c"] != got["d"]
+    # pure-fallback cluster: plain linear chain at y=0, unchanged
+    chain = assign_host_coords(["n1", "n0"], {})
+    assert chain == {"n0": (0, 0), "n1": (1, 0)}
+
+
+def _views(n, topology="2x2x1", free=None, row=0):
+    full = frozenset(
+        (x, y, 0) for x in range(int(topology[0]))
+        for y in range(int(topology[2]))
+    )
+    out = []
+    for i in range(n):
+        out.append(HostView(
+            node=f"h{i}", host_coord=(i, row), topology=topology,
+            free=free[i] if free is not None else full, generation=i,
+        ))
+    return out
+
+
+def test_plan_slice_stitches_adjacent_full_hosts():
+    views = _views(4)  # 4 hosts in a row, each a full 2x2
+    plan = plan_slice(views, 2, 4)
+    assert plan is not None
+    # two ADJACENT hosts, each contributing its full 2x2 → global 4x2
+    assert plan.host_shape == (2, 1)
+    assert plan.global_shape == (4, 2, 1)
+    nodes = [m.node for m in plan.members]
+    assert nodes == ["h0", "h1"]  # deterministic lowest offset
+    for m in plan.members:
+        assert m.shape == (2, 2, 1)
+
+
+def test_plan_slice_respects_cross_host_contiguity_rule():
+    # 2 hosts side by side, member needs 2 chips: a 1x2 column does NOT
+    # span the host's x extent, so stitching 2 hosts along x with it is
+    # illegal; planner must fall back to a single... no single host can
+    # take 2 members, so the only legal shape is the full-x 2x1 row.
+    views = _views(2)
+    plan = plan_slice(views, 2, 2)
+    assert plan is not None
+    for m in plan.members:
+        assert m.shape[0] == 2, "stitched axis must span the host"
+    assert plan.global_shape == (4, 1, 1)
+
+
+def test_plan_slice_desired_mesh_filters_shapes():
+    views = _views(4)
+    plan = plan_slice(views, 2, 4, desired_mesh=(4, 2, 1))
+    assert plan is not None and plan.global_shape == (4, 2, 1)
+    # an impossible desired mesh: nothing stitches to 8x1
+    assert plan_slice(views, 2, 4, desired_mesh=(8, 1, 1)) is None
+
+
+def test_plan_slice_skips_busy_hosts_and_respects_free_sets():
+    full = frozenset((x, y, 0) for x in range(2) for y in range(2))
+    free = [full, frozenset({(0, 0, 0)}), full, full]  # h1 nearly busy
+    views = _views(4, free=free)
+    plan = plan_slice(views, 2, 4)
+    assert plan is not None
+    assert [m.node for m in plan.members] == ["h2", "h3"]
+
+
+def test_plan_slice_none_when_too_few_hosts_fit():
+    views = _views(2)
+    assert plan_slice(views, 3, 4) is None  # only 2 hosts exist
+    assert plan_slice(views, 2, 5) is None  # 5 chips never box into 2x2
+
+
+def test_plan_slice_partitions_heterogeneous_topologies():
+    # mixed cluster: two 2x2 hosts + two 2x4 hosts (different TPU gen).
+    # a slice never stitches across topologies, but the homogeneous 2x2
+    # group must still plan — heterogeneity is partitioned, not a no_fit
+    small = _views(2, topology="2x2x1")
+    big_full = frozenset((x, y, 0) for x in range(2) for y in range(4))
+    big = [
+        HostView(node=f"b{i}", host_coord=(i, 2), topology="2x4x1",
+                 free=big_full, generation=10 + i)
+        for i in range(2)
+    ]
+    plan = plan_slice(list(small) + big, 2, 4)
+    assert plan is not None
+    nodes = {m.node for m in plan.members}
+    topos = {m.node[0] for m in plan.members}
+    assert len(topos) == 1, f"plan stitched across topologies: {nodes}"
+    # the 2x4 group can take 2×4 chips with better affinity headroom;
+    # what matters here is that SOME homogeneous group admitted
+    assert nodes in ({"h0", "h1"}, {"b0", "b1"})
+
+
+def test_slice_affinity_prefers_isolated_blocks():
+    # free: a 2x2 block + an isolated far pair on a 4x4 grid
+    block = {(0, 0, 0), (1, 0, 0), (0, 1, 0), (1, 1, 0)}
+    pair = {(3, 3, 0), (3, 2, 0)}
+    free = frozenset(block | pair)
+    # consuming the isolated pair keeps the 2x2 intact: better than
+    # carving two chips out of the block (shatters it + strands chips)
+    a_pair = slice_affinity("4x4x1", free, frozenset(pair))
+    a_carve = slice_affinity(
+        "4x4x1", free, frozenset({(0, 0, 0), (1, 0, 0)})
+    )
+    assert a_pair > a_carve
+
+
+# ---------------------------------------------------------------------------
+# End-to-end admission through Scheduler.filter
+# ---------------------------------------------------------------------------
+
+def test_gang_gathers_then_binds_all_members():
+    c, s, names = group_scheduler(4)
+    m0 = c.create_pod(gang_pod("g-m0", "train", 2))
+    m1 = c.create_pod(gang_pod("g-m1", "train", 2))
+
+    r0 = s.filter(m0, names)
+    assert r0.node is None and "waiting" in r0.error
+    assert not s.usage_cache.bookings_snapshot(), "gathering must hold nothing"
+
+    r1 = s.filter(m1, names)
+    assert r1.node is not None, r1.error
+    bookings = s.usage_cache.bookings_snapshot()
+    assert set(bookings) == {"uid-g-m0", "uid-g-m1"}
+    booked_nodes = {b[0] for b in bookings.values()}
+    assert len(booked_nodes) == 2, "one member per host"
+    # assignment annotations patched for BOTH members (incl. the waiter)
+    for pname in ("g-m0", "g-m1"):
+        annos = c.get_pod("default", pname)["metadata"]["annotations"]
+        assert annos[A.ASSIGNED_NODE] in booked_nodes
+        assert annos[A.ASSIGNED_IDS]
+    # each member got a full 2x2 host rectangle (4 distinct chips)
+    for uid, (node, devices) in bookings.items():
+        uuids = {cd.uuid for ctr in devices for cd in ctr}
+        assert len(uuids) == 4 and all(u.startswith(node) for u in uuids)
+    # events: Reserved then Bound
+    types = [e["type"] for e in ev.journal().query(n=10_000)]
+    assert types.index("GangReserved") < types.index("GangBound")
+    # replay: the waiter re-filtered returns its reserved node, no re-book
+    r0b = s.filter(m0, names)
+    assert r0b.node == bookings["uid-g-m0"][0]
+    assert s.usage_cache.bookings_snapshot() == bookings
+    assert_cache_equals_oracle(s)
+    # bind proceeds per member through the normal path
+    assert s.bind("default", "g-m1", r1.node, pod_uid="uid-g-m1") is None
+
+
+def test_gang_admit_adopts_externally_bound_placement():
+    # a SECOND coordinator (peer replica, or this process restarted with
+    # a cold registry) whose registry poll ingested the first
+    # coordinator's phase-2 patches must ADOPT that placement — never
+    # re-plan and re-book the uids over the live one
+    c, s, names = group_scheduler(4)
+    m0 = c.create_pod(gang_pod("a-m0", "adopt", 2))
+    m1 = c.create_pod(gang_pod("a-m1", "adopt", 2))
+    s.filter(m0, names)
+    assert s.filter(m1, names).node is not None
+    bookings = s.usage_cache.bookings_snapshot()
+
+    s2 = Scheduler(c, SchedulerConfig(http_bind="127.0.0.1:0"))
+    s2.register_from_node_annotations()
+    s2.ingest_pods()
+    assert set(s2.usage_cache.bookings_snapshot()) == set(bookings)
+    # members re-filter at the cold coordinator: each one's live ingested
+    # booking is adopted directly — no re-gather, no re-plan
+    r0 = s2.filter(c.get_pod("default", "a-m0"), names)
+    assert r0.node == bookings["uid-a-m0"][0], r0.error
+    r1 = s2.filter(c.get_pod("default", "a-m1"), names)
+    assert r1.node == bookings["uid-a-m1"][0], r1.error
+    # nothing was re-planned or re-booked: cluster exactly as s placed it
+    assert s2.usage_cache.bookings_snapshot() == bookings
+    assert_cache_equals_oracle(s2)
+
+
+def test_gang_decision_log_records_reserve_outcomes_and_rectangle():
+    c, s, names = group_scheduler(4)
+    m0 = c.create_pod(gang_pod("d-m0", "dec", 2))
+    m1 = c.create_pod(gang_pod("d-m1", "dec", 2))
+    s.filter(m0, names)
+    s.filter(m1, names)
+    recs = s.decisions.query(gang="default/dec", n=10)
+    assert recs, "gang records must be queryable by gang name"
+    waiting = [r for r in recs if r["gang"]["status"] == "waiting"]
+    bound = [r for r in recs if r["gang"]["status"] == "bound"]
+    assert waiting and bound
+    g = bound[-1]["gang"]
+    # the chosen global rectangle + per-member-node reserve outcomes
+    assert g["slice"]["global_shape"] == "4x2x1"
+    assert set(g["members"].values()) == set(
+        n for n, v in bound[-1]["verdicts"].items() if v.get("reserve") == "ok"
+    )
+    assert all(
+        v["reserve"] == "ok" for v in bound[-1]["verdicts"].values()
+    )
+
+
+def test_gang_no_fit_holds_nothing_and_admits_after_capacity_frees():
+    c, s, names = group_scheduler(2)
+    # occupy one host entirely → a 2-member exclusive gang cannot fit
+    blocker = c.create_pod(gang_pod("blk", "blocker", 1, chips=4))
+    rb = s.filter(blocker, names)
+    assert rb.node is not None
+    m0 = c.create_pod(gang_pod("n-m0", "nf", 2))
+    m1 = c.create_pod(gang_pod("n-m1", "nf", 2))
+    s.filter(m0, names)
+    r = s.filter(m1, names)
+    assert r.node is None and "no ICI-contiguous" in r.error
+    assert set(s.usage_cache.bookings_snapshot()) == {"uid-blk"}
+    # capacity frees → the next member filter re-plans and binds
+    c.delete_pod("default", "blk")
+    s.pods.rm_pod("uid-blk")
+    r = s.filter(m0, names)
+    assert r.node is not None, r.error
+    assert set(s.usage_cache.bookings_snapshot()) == {"uid-n-m0", "uid-n-m1"}
+
+
+def test_gang_conflicting_spec_rejected():
+    c, s, names = group_scheduler(2)
+    m0 = c.create_pod(gang_pod("c-m0", "conf", 2))
+    m1 = c.create_pod(gang_pod("c-m1", "conf", 3))  # size disagrees
+    s.filter(m0, names)
+    r = s.filter(m1, names)
+    assert r.node is None and "conflicting spec" in r.error
+
+
+def test_gang_heterogeneous_member_chips_rejected():
+    c, s, names = group_scheduler(4)
+    m0 = c.create_pod(gang_pod("h-m0", "het", 2, chips=4))
+    m1 = c.create_pod(gang_pod("h-m1", "het", 2, chips=2))
+    s.filter(m0, names)
+    r = s.filter(m1, names)
+    assert r.node is None and "heterogeneous" in r.error
+    assert not s.usage_cache.bookings_snapshot()
+
+
+def test_gang_ttl_expires_partial_gangs():
+    clock = [0.0]
+    reg = GangRegistry(ttl_s=5.0, clock=lambda: clock[0])
+    c, s, names = group_scheduler(2)
+    s.gang.registry = reg
+    m0 = c.create_pod(gang_pod("t-m0", "ttl", 2))
+    r = s.filter(m0, names)
+    assert "waiting" in r.error
+    assert reg.get("default/ttl") is not None
+    clock[0] = 6.0
+    expired = reg.expire_stale()
+    assert expired == ["default/ttl"]
+    assert reg.get("default/ttl") is None
+    assert any(
+        e["type"] == "GangAborted"
+        and e.get("reason") == "ttl_expired_while_gathering"
+        for e in ev.journal().query(n=10_000)
+    )
+    # no capacity was ever held
+    assert not s.usage_cache.bookings_snapshot()
+
+
+def test_malformed_gang_spec_is_a_filter_error():
+    c, s, names = group_scheduler(2)
+    pod = c.create_pod(gang_pod("bad", "x", 2))
+    pod["metadata"]["annotations"][GANG_SIZE] = "NaN"
+    r = s.filter(pod, names)
+    assert r.node is None and "bad gang spec" in r.error
+
+
+# ---------------------------------------------------------------------------
+# All-or-nothing: deterministic mid-reserve conflict
+# ---------------------------------------------------------------------------
+
+def test_mid_reserve_conflict_rolls_back_to_zero_residual():
+    """Kill one member's reservation mid-phase-1 (a singleton booking
+    lands on its planned node between plan and CAS): with retries
+    exhausted the WHOLE gang aborts — zero residual bookings, cache ==
+    oracle, and a GangAborted event."""
+    c, s, names = group_scheduler(4)
+    s.gang.retries = 0
+    m0 = c.create_pod(gang_pod("a-m0", "abt", 2))
+    m1 = c.create_pod(gang_pod("a-m1", "abt", 2))
+    s.filter(m0, names)
+
+    intruded = {}
+
+    def intrude(member_uid, node):
+        if intruded or member_uid != "uid-a-m1":
+            return  # conflict exactly the SECOND member's reserve
+        with s.usage_cache.locked():
+            _nu, gen, _ = s.usage_cache.peek_entry(node)
+        devs = [[ContainerDevice(f"{node}-tpu-0", "TPU", 1024, 10)]]
+        assert s.usage_cache.try_book("uid-intruder", node, gen, devs)
+        s.pods.add_pod(
+            {"metadata": {"name": "intruder", "namespace": "default",
+                          "uid": "uid-intruder", "annotations": {}}},
+            node, devs, pending=True,
+        )
+        intruded["node"] = node
+
+    s.gang._pre_reserve_hook = intrude
+    r = s.filter(m1, names)
+    assert intruded, "conflict hook never fired"
+    assert r.node is None and "conflict" in r.error
+    # the all-or-nothing proof: ONLY the intruder's booking survives
+    snap = s.usage_cache.bookings_snapshot()
+    assert set(snap) == {"uid-intruder"}, snap
+    assert_cache_equals_oracle(s)
+    assert any(
+        e["type"] == "GangAborted" and e.get("reason") == "reserve_conflicts"
+        for e in ev.journal().query(n=10_000)
+    )
+    # member annotations never reached the wire
+    for pname in ("a-m0", "a-m1"):
+        annos = c.get_pod("default", pname)["metadata"]["annotations"]
+        assert A.ASSIGNED_NODE not in annos
+    # with retries allowed, a fresh attempt re-plans around the intruder
+    s.gang._pre_reserve_hook = None
+    s.gang.retries = 2
+    r = s.filter(m0, names)
+    assert r.node is not None, r.error
+    snap = s.usage_cache.bookings_snapshot()
+    assert set(snap) == {"uid-intruder", "uid-a-m0", "uid-a-m1"}
+    assert_cache_equals_oracle(s)
+
+
+def test_phase2_patch_failure_rolls_back_and_nulls_annotations():
+    c, s, names = group_scheduler(4)
+    m0 = c.create_pod(gang_pod("p-m0", "pf", 2))
+    m1 = c.create_pod(gang_pod("p-m1", "pf", 2))
+    s.filter(m0, names)
+
+    real_patch = c.patch_pod_annotations
+    fails = {"armed": True}
+
+    def flaky_patch(ns, name, annos):
+        # fail the SECOND member's assignment patch (first succeeds);
+        # null-patches (rollback) must keep working
+        if (
+            fails["armed"] and name == "p-m1"
+            and annos.get(A.ASSIGNED_NODE) is not None
+        ):
+            raise RuntimeError("apiserver down")
+        return real_patch(ns, name, annos)
+
+    c.patch_pod_annotations = flaky_patch
+    r = s.filter(m1, names)
+    assert r.node is None and "patch failed" in r.error
+    assert not s.usage_cache.bookings_snapshot()
+    assert_cache_equals_oracle(s)
+    # the first member WAS patched, then rolled back to null
+    annos = c.get_pod("default", "p-m0")["metadata"]["annotations"]
+    assert A.ASSIGNED_NODE not in annos and A.ASSIGNED_IDS not in annos
+    assert any(
+        e["type"] == "GangAborted" and e.get("reason") == "patch_failed"
+        for e in ev.journal().query(n=10_000)
+    )
+    # heal the client → the failing member was PRUNED (self-healing:
+    # a deleted pod must not wedge the gang), so the survivors re-gather
+    # and the gang admits on the next full round
+    fails["armed"] = False
+    r = s.filter(m0, names)
+    assert r.node is None and "waiting" in r.error
+    r = s.filter(m1, names)  # pruned member re-registers
+    assert r.node is not None, r.error
+    assert set(s.usage_cache.bookings_snapshot()) == {"uid-p-m0", "uid-p-m1"}
+
+
+def test_gangs_with_same_name_in_different_namespaces_never_merge():
+    c, s, names = group_scheduler(4)
+    a0 = c.create_pod(gang_pod("nsa-m0", "train", 2))
+    b0 = new_pod(
+        "nsb-m0", namespace="team-b", uid="uid-nsb-m0",
+        annotations={GANG_NAME: "train", GANG_SIZE: "2"},
+        containers=[{"name": "main", "resources": {"limits": {
+            R.chip: 4, R.memory_percentage: 100, R.cores: 100}}}],
+    )
+    c.create_pod(b0)
+    r = s.filter(a0, names)
+    assert "waiting" in r.error
+    # a same-named member from ANOTHER namespace must not complete it
+    r = s.filter(b0, names)
+    assert r.node is None and "waiting" in r.error, r.error
+    assert not s.usage_cache.bookings_snapshot()
+    assert s.gang.registry.get("default/train") is not None
+    assert s.gang.registry.get("team-b/train") is not None
+
+
+def test_gang_rejects_extra_member_beyond_size():
+    c, s, names = group_scheduler(2)
+    # fill the cluster so the gang gathers fully but CANNOT admit —
+    # it stays GATHERING at exactly size members
+    blocker = c.create_pod(gang_pod("x-blk", "xblocker", 1, chips=4))
+    assert s.filter(blocker, names).node is not None
+    m0 = c.create_pod(gang_pod("x-m0", "cap", 2))
+    m1 = c.create_pod(gang_pod("x-m1", "cap", 2))
+    s.filter(m0, names)
+    r = s.filter(m1, names)
+    assert "no ICI-contiguous" in r.error
+    # a recreated member (new uid) joining the full gathering gang: the
+    # size+1'th distinct uid is rejected loudly, never silently zipped
+    extra = c.create_pod(gang_pod("x-extra", "cap", 2))
+    r = s.filter(extra, names)
+    assert r.node is None and "cannot join" in r.error, r.error
+    assert not any(
+        u.startswith("uid-x-") and u != "uid-x-blk"
+        for u in s.usage_cache.bookings_snapshot()
+    )
+
+
+# ---------------------------------------------------------------------------
+# Sharded replicas: reserve through /shard/commit, abort releases
+# ---------------------------------------------------------------------------
+
+def _sharded_pair(n=6):
+    c = FakeClient()
+    names = seed_fake_node_group(c, n)
+    a = Scheduler(c, SchedulerConfig(http_bind="127.0.0.1:0"))
+    b = Scheduler(c, SchedulerConfig(http_bind="127.0.0.1:0"))
+    a.register_from_node_annotations()
+    b.register_from_node_annotations()
+    a.shard = ShardCoordinator(a, "rA", {"rB": LocalPeer(b)})
+    b.shard = ShardCoordinator(b, "rB", {"rA": LocalPeer(a)})
+    return c, a, b, names
+
+
+def _planned_uuid_sets(sched, gang_name):
+    """node → uuid set the PLAN promised, from the bound decision record
+    (node_group_nodes uuid layout: j = x + 2y + 4z on a 2x2x1 host)."""
+    recs = sched.decisions.query(gang=gang_name, n=10)
+    bound = [r for r in recs if r["gang"]["status"] == "bound"]
+    assert bound, recs
+    out = {}
+    for node, m in bound[-1]["gang"]["slice"]["members"].items():
+        ox, oy, oz = m["offset"]
+        dims = [int(d) for d in m["shape"].split("x")]
+        out[node] = {
+            f"{node}-tpu-{(ox + dx) + 2 * (oy + dy) + 4 * (oz + dz)}"
+            for dx in range(dims[0])
+            for dy in range(dims[1])
+            for dz in range(dims[2])
+        }
+    return out
+
+
+def test_gang_spans_shard_owners_via_shard_commit():
+    c, a, b, names = _sharded_pair()
+    m0 = c.create_pod(gang_pod("s-m0", "sh", 2))
+    m1 = c.create_pod(gang_pod("s-m1", "sh", 2))
+    a.filter(m0, names)
+    r = a.filter(m1, names)
+    assert r.node is not None, r.error
+    # every member is booked at its node's OWNER: local members in a's
+    # ledger, remote members in b's (reserved through /shard/commit) —
+    # and, the cross-host contiguity guarantee, with EXACTLY the devices
+    # the coordinator's plan pinned, not the owner's own pick
+    planned = _planned_uuid_sets(a, "default/sh")
+    a_bookings = a.usage_cache.bookings_snapshot()
+    b_bookings = b.usage_cache.bookings_snapshot()
+    remote_nodes = []
+    for uid in ("uid-s-m0", "uid-s-m1"):
+        entry = a_bookings.get(uid) or b_bookings.get(uid)
+        assert entry is not None, (uid, a_bookings, b_bookings)
+        node, devs = entry
+        booked = {cd.uuid for ctr in devs for cd in ctr}
+        assert booked == planned[node], (node, booked, planned[node])
+        if a.shard.ring.owner(node) == "rB":
+            remote_nodes.append(node)
+            assert uid in b_bookings and b_bookings[uid][0] == node
+    # both members' assignment annotations landed regardless of owner
+    for pname in ("s-m0", "s-m1"):
+        annos = c.get_pod("default", pname)["metadata"]["annotations"]
+        assert A.ASSIGNED_NODE in annos
+    # admission metrics recorded the split when it happened
+    if remote_nodes:
+        from vtpu.obs import registry as obs_registry
+
+        ctr = obs_registry("scheduler").counter(
+            "vtpu_gang_member_reserves_total", "t"
+        )
+        assert ctr.value(result="remote_ok") >= 1
+
+
+def test_gang_abort_releases_remote_reservations_owner_side():
+    from vtpu.obs import registry as obs_registry
+
+    c, a, b, names = _sharded_pair()
+    a.gang.retries = 0
+    m0 = c.create_pod(gang_pod("r-m0", "rel", 2))
+    m1 = c.create_pod(gang_pod("r-m1", "rel", 2))
+    a.filter(m0, names)
+
+    remote_ctr = obs_registry("scheduler").counter(
+        "vtpu_gang_member_reserves_total", "t"
+    )
+    remote_before = remote_ctr.value(result="remote_ok")
+    state = {}
+
+    def poison_second(member_uid, node):
+        # let the FIRST member reserve (remotely, on this ring), then
+        # occupy the SECOND member's planned chips at their owner —
+        # rollback must release member 1's reservation owner-side
+        if "first" not in state:
+            state["first"] = (member_uid, node)
+            return
+        if "poisoned" in state:
+            return
+        state["poisoned"] = node
+        owner = b if a.shard.ring.owner(node) == "rB" else a
+        with owner.usage_cache.locked():
+            _nu, gen, _ = owner.usage_cache.peek_entry(node)
+        devs = [[ContainerDevice(f"{node}-tpu-0", "TPU", 1024, 100)]]
+        assert owner.usage_cache.try_book("uid-x", node, gen, devs)
+        owner.pods.add_pod(
+            {"metadata": {"name": "x", "namespace": "default",
+                          "uid": "uid-x", "annotations": {}}},
+            node, devs, pending=True,
+        )
+
+    a.gang._pre_reserve_hook = poison_second
+    r = a.filter(m1, names)
+    assert "poisoned" in state
+    assert r.node is None
+    # the first member DID reserve through /shard/commit before the abort
+    # (this ring owns the plan's first hosts at rB)
+    assert remote_ctr.value(result="remote_ok") > remote_before
+    # zero residual GANG bookings on either replica, local or remote
+    for sched in (a, b):
+        snap = sched.usage_cache.bookings_snapshot()
+        assert "uid-r-m0" not in snap and "uid-r-m1" not in snap, snap
+    # any owner-side patch was nulled again (released via /shard/release)
+    for pname in ("r-m0", "r-m1"):
+        annos = c.get_pod("default", pname)["metadata"]["annotations"]
+        assert A.ASSIGNED_NODE not in annos
+
+
+def test_gang_remote_reserve_error_after_landed_commit_is_released():
+    # the wire can die AFTER the owner booked + patched but BEFORE the
+    # coordinator reads the response: the coordinator must release the
+    # failing member owner-side (idempotent) or the booking is stranded
+    # beyond every rollback leg
+    c, a, b, names = _sharded_pair()
+    a.gang.retries = 0
+    calls = []
+
+    class CutPeer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def commit(self, *args):
+            rep = self._inner.commit(*args)
+            calls.append(rep)
+            raise OSError("connection reset mid-response")
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    a.shard.peers["rB"] = CutPeer(LocalPeer(b))
+    m0 = c.create_pod(gang_pod("c-m0", "cut", 2))
+    m1 = c.create_pod(gang_pod("c-m1", "cut", 2))
+    a.filter(m0, names)
+    r = a.filter(m1, names)
+    assert calls, "plan never crossed to rB — premise broken"
+    assert r.node is None
+    # the landed owner-side booking was released despite the 'error'
+    for sched in (a, b):
+        snap = sched.usage_cache.bookings_snapshot()
+        assert "uid-c-m0" not in snap and "uid-c-m1" not in snap, snap
+    for pname in ("c-m0", "c-m1"):
+        annos = c.get_pod("default", pname)["metadata"]["annotations"]
+        assert A.ASSIGNED_NODE not in annos, (pname, annos)
+
+
+def test_shard_commit_pinned_placement_books_exact_devices():
+    from vtpu.utils import codec
+
+    c, s, names = group_scheduler(2)
+    node = names[0]
+    pod = c.create_pod(gang_pod("pin", "pinned", 1, chips=2))
+    # pin an UNUSUAL pair the owner's own ranking would not pick first
+    want = [[
+        ContainerDevice(f"{node}-tpu-2", "TPU", 4096, 50),
+        ContainerDevice(f"{node}-tpu-3", "TPU", 4096, 50),
+    ]]
+    enc = codec.encode_pod_devices(want)
+    rep = s.shard_commit(pod, node, -1, enc)
+    assert rep["status"] == "ok", rep
+    booked = s.usage_cache.bookings_snapshot()["uid-pin"]
+    assert booked[0] == node
+    assert {cd.uuid for ctr in booked[1] for cd in ctr} == {
+        f"{node}-tpu-2", f"{node}-tpu-3"
+    }
+    # pinned device now occupied at cores=50 + another 60 → no_fit
+    pod2 = c.create_pod(gang_pod("pin2", "pinned2", 1, chips=1))
+    clash = [[ContainerDevice(f"{node}-tpu-2", "TPU", 4096, 60)]]
+    rep = s.shard_commit(pod2, node, -1, codec.encode_pod_devices(clash))
+    assert rep["status"] == "no_fit", rep
+    # a pinned device the registry does not advertise → no_fit
+    ghost = [[ContainerDevice(f"{node}-tpu-99", "TPU", 1024, 0)]]
+    rep = s.shard_commit(pod2, node, -1, codec.encode_pod_devices(ghost))
+    assert rep["status"] == "no_fit", rep
+
+
+def test_shard_release_is_idempotent():
+    c, s, names = group_scheduler(2)
+    assert s.shard_release("nope", names[0]) == {"status": "absent"}
+    solo = c.create_pod(solo_pod("rl"))
+    r = s.filter(solo, names)
+    assert r.node is not None
+    assert s.shard_release("uid-rl", "wrong-node") == {"status": "absent"}
+    assert s.shard_release("uid-rl", r.node)["status"] == "ok"
+    assert "uid-rl" not in s.usage_cache.bookings_snapshot()
+    annos = c.get_pod("default", "rl")["metadata"]["annotations"]
+    assert A.ASSIGNED_NODE not in annos
+    # released again: no-op
+    assert s.shard_release("uid-rl", r.node) == {"status": "absent"}
+
+
+# ---------------------------------------------------------------------------
+# Auditor: the partial_gang drift class
+# ---------------------------------------------------------------------------
+
+def test_auditor_flags_partial_gang_and_clears_when_whole():
+    from vtpu.audit.auditor import DriftClass
+
+    c, s, names = group_scheduler(4)
+    m0 = c.create_pod(gang_pod("pg-m0", "pg", 2))
+    m1 = c.create_pod(gang_pod("pg-m1", "pg", 2))
+    s.filter(m0, names)
+    r = s.filter(m1, names)
+    assert r.node is not None
+    # whole gang: clean audit (bound gang is not partial)
+    s.gang.registry.drop("default/pg")  # no in-flight grace left
+    rep = s.auditor.audit_once()
+    assert rep["ok"], rep
+    assert rep["summary"]["partial_gang_bookings"] == 0
+    # break the invariant: one member's booking vanishes (simulated
+    # crashed rollback — remove the booking but keep the pod live)
+    s.pods.rm_pod("uid-pg-m0")
+    rep = s.auditor.audit_once()
+    assert rep["ok"] is False
+    assert rep["summary"]["partial_gang_bookings"] == 1
+    flagged = [
+        d for node in rep["nodes"].values() for d in node["drifts"]
+        if d["class"] == DriftClass.PARTIAL_GANG
+    ]
+    assert len(flagged) == 1 and flagged[0]["pod"] == "uid-pg-m1"
+    assert flagged[0]["gang"] == "default/pg"
+
+
+def test_auditor_grace_for_inflight_gangs():
+    c, s, names = group_scheduler(4)
+    m0 = c.create_pod(gang_pod("if-m0", "ifl", 2))
+    m1 = c.create_pod(gang_pod("if-m1", "ifl", 2))
+    s.filter(m0, names)
+    assert s.filter(m1, names).node is not None
+    s.pods.rm_pod("uid-if-m0")
+    # the registry still tracks the gang (TTL-fresh): grace applies
+    rep = s.auditor.audit_once()
+    assert rep["summary"]["partial_gang_bookings"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Threaded soak: gangs x singletons x churn, local and shard arms
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("arm", ["local", "shard"])
+def test_threaded_gang_soak_all_or_nothing_and_zero_drift(arm):
+    import random
+
+    if arm == "shard":
+        c, s, b, names = _sharded_pair(8)
+        scheds = [s, b]
+    else:
+        c, s, names = group_scheduler(8)
+        scheds = [s]
+    stop = threading.Event()
+    errors = []
+
+    def gang_loop(tid):
+        rng = random.Random(100 + tid)
+        k = 0
+        while not stop.is_set():
+            k += 1
+            gname = f"sg{tid}-{k}"
+            members = [
+                gang_pod(f"{gname}-m{j}", gname, 2, chips=2, pct=50,
+                         cores=0)
+                for j in range(2)
+            ]
+            for p in members:
+                c.create_pod(p)
+            for p in members:
+                s.filter(p, list(names))
+            uids = [p["metadata"]["uid"] for p in members]
+            # a bound gang's remote members are ledgered at their OWNER
+            # replica — the all-or-nothing check spans both ledgers
+            snap = {}
+            for sc in scheds:
+                snap.update(sc.usage_cache.bookings_snapshot())
+            booked = [u for u in uids if u in snap]
+            if len(booked) not in (0, len(uids)):
+                errors.append(f"partial gang {gname}: {booked}")
+                stop.set()
+            for p in members:
+                c.delete_pod("default", p["metadata"]["name"])
+                for sc in scheds:
+                    sc.pods.rm_pod(p["metadata"]["uid"])
+            stop.wait(rng.random() * 0.002)
+
+    def solo_loop(tid):
+        rng = random.Random(200 + tid)
+        i = 0
+        live = []
+        while not stop.is_set():
+            i += 1
+            p = solo_pod(f"ss{tid}-{i}")
+            c.create_pod(p)
+            res = s.filter(p, list(names))
+            if res.node is not None:
+                live.append(p)
+            if live and rng.random() < 0.5:
+                victim = live.pop(rng.randrange(len(live)))
+                c.delete_pod("default", victim["metadata"]["name"])
+                for sc in scheds:
+                    sc.pods.rm_pod(victim["metadata"]["uid"])
+        for p in live:
+            c.delete_pod("default", p["metadata"]["name"])
+            for sc in scheds:
+                sc.pods.rm_pod(p["metadata"]["uid"])
+
+    def churn_loop():
+        from tests.golden_scenarios import node_group_nodes as _ngn
+        from vtpu.utils import codec as _codec
+
+        rng = random.Random(7)
+        target = names[-1]
+        node = _ngn(1)[0]
+        enc = node["metadata"]["annotations"][A.NODE_REGISTER]
+        chips = _codec.decode_node_devices(enc)
+        alive = True
+        while not stop.is_set():
+            for sc in scheds:
+                if alive:
+                    sc.nodes.rm_node_devices(target, source=None)
+                else:
+                    sc.nodes.add_node(
+                        target, [ch.clone() for ch in chips],
+                        topology="2x2x1", source=A.NODE_HANDSHAKE,
+                    )
+            alive = not alive
+            stop.wait(0.004)
+        for sc in scheds:  # leave it registered
+            if not alive:
+                sc.nodes.add_node(
+                    target, [ch.clone() for ch in chips],
+                    topology="2x2x1", source=A.NODE_HANDSHAKE,
+                )
+
+    def wrapped(fn, *a):
+        try:
+            fn(*a)
+        except Exception as e:  # noqa: BLE001
+            errors.append(repr(e))
+            stop.set()
+
+    threads = (
+        [threading.Thread(target=wrapped, args=(gang_loop, k))
+         for k in range(2)]
+        + [threading.Thread(target=wrapped, args=(solo_loop, k))
+           for k in range(2)]
+        + [threading.Thread(target=wrapped, args=(churn_loop,))]
+    )
+    [t.start() for t in threads]
+    time.sleep(1.5)
+    stop.set()
+    [t.join(10.0) for t in threads]
+    assert not errors, errors
+
+    # end state: nothing booked (every pod deleted), no chip over
+    # capacity at any point would have tripped the oracle below
+    for sc in scheds:
+        for nu in sc.nodes_usage().values():
+            for d in nu.devices:
+                assert d.usedmem <= d.totalmem and d.used <= d.count
+        assert_cache_equals_oracle(sc)
+        assert not sc.usage_cache.bookings_snapshot()
+    rep = s.auditor.audit_once()
+    assert rep["ok"], rep
+    assert rep["summary"]["partial_gang_bookings"] == 0
+    assert rep["summary"]["leaked_bookings"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Seed helpers + bench smoke
+# ---------------------------------------------------------------------------
+
+def test_seed_node_group_builders():
+    nodes = node_group_nodes(3, host_grid_width=2)
+    assert [n["metadata"]["name"] for n in nodes] == [
+        "host-0", "host-1", "host-2"
+    ]
+    coords = [
+        n["metadata"]["annotations"][HOST_COORD_ANNOTATION] for n in nodes
+    ]
+    assert coords == ["0,0", "1,0", "0,1"]
+    c, s, names = group_scheduler(3)
+    assert set(s.nodes.all_nodes()) == set(names)
+    info = s.nodes.get(names[0])
+    assert len(info.devices) == 4 and info.topology == "2x2x1"
+
+
+def test_apiserver_sim_seed_node_group():
+    from tests.apiserver_sim import ApiServerSim
+    from vtpu.k8s.client import Client
+
+    sim = ApiServerSim(token="t")
+    base = sim.start()
+    try:
+        names = sim.seed_node_group(2, prefix="sim")
+        client = Client(base_url=base, token="t")
+        s = Scheduler(client, SchedulerConfig(http_bind="127.0.0.1:0"))
+        s.register_from_node_annotations()
+        assert set(s.nodes.all_nodes()) == set(names)
+        # a gang lands over the sim exactly like over the FakeClient
+        m0 = gang_pod("sim-m0", "simg", 2)
+        m1 = gang_pod("sim-m1", "simg", 2)
+        sim.seed_pod(m0)
+        sim.seed_pod(m1)
+        s.filter(m0, names)
+        r = s.filter(m1, names)
+        assert r.node is not None, r.error
+        annos = client.get_pod("default", "sim-m0")["metadata"]["annotations"]
+        assert A.ASSIGNED_NODE in annos
+    finally:
+        sim.stop()
+
+
+def test_bench_gang_smoke_schema_and_slos():
+    from benchmarks import scheduler_gang as bench
+
+    res = bench.run(smoke=True)
+    assert res["bench"] == "scheduler_gang" and res["smoke"] is True
+    for arm in ("two_phase", "sequential"):
+        v = res["arms"][arm]
+        for key in ("gangs", "outcomes", "abort_or_no_fit_rate",
+                    "bind_success_admitted", "admission_latency_ms",
+                    "frag_largest_free_rect_ratio_mean", "partial_gangs"):
+            assert key in v, (arm, key)
+    assert res["arms"]["two_phase"]["bind_success_admitted"] == 1.0
+    assert res["arms"]["two_phase"]["partial_gangs"] == 0
+    assert res["comparison"]["two_phase_partial_gangs"] == 0

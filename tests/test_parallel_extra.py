@@ -14,6 +14,61 @@ pytestmark = pytest.mark.slow  # JAX workload lane (CPU-mesh compiles)
 
 
 
+def test_mesh_from_rectangle_host_split_hybrid_dp_tp():
+    """A bound gang's placement — a list of per-host sub-rectangles —
+    maps onto a hybrid mesh: outer dp axis across hosts, inner tp axis
+    inside one host's rectangle."""
+    from vtpu.parallel.mesh import mesh_from_rectangle
+
+    mesh = mesh_from_rectangle([(2, 1, 1)] * 4)  # 4 hosts x 2 chips
+    assert mesh.devices.shape == (4, 2)
+    assert mesh.axis_names == ("dp", "tp")
+    # inner-axis neighbours are enumeration-adjacent devices (same host
+    # under the gang contract); outer-axis stride spans a host
+    flat = list(mesh.devices.flat)
+    assert [d.id for d in flat] == [d.id for d in jax.devices()[:8]]
+
+    # the hybrid mesh actually computes: psum over tp sums within a
+    # host's pair, dp stays independent
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    x = jnp.arange(8.0).reshape(4, 2)
+    f = jax.jit(shard_map(
+        lambda v: jax.lax.psum(v, "tp"),
+        mesh=mesh, in_specs=P("dp", "tp"), out_specs=P("dp", None),
+    ))
+    got = np.asarray(f(x))
+    want = x.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(got, np.asarray(want))
+
+
+def test_mesh_from_rectangle_host_split_multi_inner_axis():
+    from vtpu.parallel.mesh import mesh_from_rectangle
+
+    mesh = mesh_from_rectangle([(2, 2, 1)] * 2)  # 2 hosts x 2x2 chips
+    assert mesh.devices.shape == (2, 2, 2)
+    assert mesh.axis_names == ("dp", "ici0", "ici1")
+    # explicit axis names must match the mesh rank
+    mesh = mesh_from_rectangle([(2, 2, 1)] * 2, axis_names=("dcn", "x", "y"))
+    assert mesh.axis_names == ("dcn", "x", "y")
+
+
+def test_mesh_from_rectangle_host_split_validation():
+    from vtpu.parallel.mesh import mesh_from_rectangle
+
+    with pytest.raises(ValueError, match="homogeneous"):
+        mesh_from_rectangle([(2, 1, 1), (1, 2, 1)])
+    with pytest.raises(ValueError, match="devices"):
+        mesh_from_rectangle([(2, 2, 1)] * 4)  # wants 16, virtual mesh has 8
+    with pytest.raises(ValueError, match="axis names"):
+        mesh_from_rectangle([(2, 1, 1)] * 4, axis_names=("dp",))
+    # the single-rectangle form is unchanged
+    mesh = mesh_from_rectangle((2, 4, 1))
+    assert mesh.devices.shape == (4, 2)
+    assert mesh.axis_names == ("ici0", "ici1")
+
+
 def test_pipeline_matches_sequential():
     devs = np.array(jax.devices())
     n_stages = len(devs)

@@ -174,6 +174,103 @@ def test_coordless_chips_first_n():
     assert [c.uuid for c in got] == ["c0", "c1"]
 
 
+# -- best-effort non-rectangular growth (allocator._connected_greedy) -----
+
+
+def l_shape_fixture():
+    """3x3 grid where only an L of 5 chips is free — 5 never boxes into
+    3x3, so any 5-gang MUST take the non-rectangular growth path."""
+    free = {(0, 0, 0), (1, 0, 0), (2, 0, 0), (2, 1, 0), (2, 2, 0)}
+    busy = [(x, y, 0) for x in range(3) for y in range(3)
+            if (x, y, 0) not in free]
+    return chips_from_fixture("3x3x1", busy=busy)
+
+
+def test_best_effort_nonrectangular_growth_stays_connected():
+    p, avail = l_shape_fixture()
+    alloc = IciAllocator(p.topology(), POLICY_BEST_EFFORT)
+    got = alloc.allocate(avail, 5)
+    coords = [tuple(c.coords) for c in got]
+    assert len(coords) == 5
+    assert p.topology().is_connected(coords), coords
+    # the same request under guaranteed policy must refuse
+    with pytest.raises(AllocationError):
+        IciAllocator(p.topology(), POLICY_GUARANTEED).allocate(avail, 5)
+
+
+def test_best_effort_growth_maximizes_internal_links():
+    # free: a plus-shape (dense center) AND a disconnected far column;
+    # the grower must pick the plus (4 internal links), never mix in the
+    # far chips
+    free = {(1, 0, 0), (0, 1, 0), (1, 1, 0), (2, 1, 0), (1, 2, 0)}
+    busy = [(x, y, 0) for x in range(4) for y in range(3)
+            if (x, y, 0) not in free | {(3, 0, 0), (3, 2, 0)}]
+    p, avail = chips_from_fixture("4x3x1", busy=busy)
+    alloc = IciAllocator(p.topology(), POLICY_BEST_EFFORT)
+    got = alloc.allocate(avail, 5)
+    assert {tuple(c.coords) for c in got} == free
+
+
+def test_best_effort_growth_pads_isolated_pinned_chips():
+    # a pinned must-include chip with NO free neighbours: the grower
+    # cannot reach it, so the pad branch (allocator.py) completes the
+    # set with the nearest remaining coords — never fails best-effort
+    busy = [(1, 0, 0), (0, 1, 0)]  # isolate (0,0)
+    p, avail = chips_from_fixture("3x3x1", busy=busy)
+    by_coord = {tuple(c.coords): c for c in avail}
+    pinned = by_coord[(0, 0, 0)]
+    alloc = IciAllocator(p.topology(), POLICY_BEST_EFFORT)
+    got = alloc.allocate(avail, 3, must_include=[pinned])
+    assert pinned in got and len(got) == 3
+    assert len({c.uuid for c in got}) == 3
+
+
+# -- stranded-singleton avoidance (allocator._frag_score) -----------------
+
+
+def test_frag_score_counts_only_rectangle_coverable_chips():
+    from vtpu.device.allocator import _frag_score
+
+    topo = Topology((4, 1, 1))
+    # {0,1} form a 2-rectangle; {3} is a stranded singleton
+    assert _frag_score(topo, frozenset({(0, 0, 0), (1, 0, 0), (3, 0, 0)})) == 2
+    # a lone chip is never coverable
+    assert _frag_score(topo, frozenset({(3, 0, 0)})) == 0
+    assert _frag_score(topo, frozenset()) == 0
+
+
+def test_rectangle_choice_avoids_stranding_singletons():
+    """On a free 4x1 line, a pinned middle chip admits two 2-rectangles:
+    {1,2} (strands BOTH ends) and {2,3} (leaves a healthy {0,1} pair).
+    The offset tiebreak alone would pick {1,2}; the fragmentation term
+    must override it and pick {2,3}."""
+    p, avail = chips_from_fixture("4x1x1")
+    by_coord = {tuple(c.coords): c for c in avail}
+    alloc = IciAllocator(p.topology(), POLICY_BEST_EFFORT)
+    got = alloc.allocate(avail, 2, must_include=[by_coord[(2, 0, 0)]])
+    assert {tuple(c.coords) for c in got} == {(2, 0, 0), (3, 0, 0)}
+
+
+def test_best_rectangle_of_shape_places_and_ranks():
+    from vtpu.device.allocator import best_rectangle_of_shape
+
+    topo = Topology((4, 2, 1))
+    full = frozenset((x, y, 0) for x in range(4) for y in range(2))
+    # exact-shape placement, deterministic lowest-offset on a clean grid
+    offset, coords = best_rectangle_of_shape(topo, (2, 2, 1), full)
+    assert offset == (0, 0, 0) and len(coords) == 4
+    # the shape must fit EXACTLY — a 3x2 never fits in the leftover
+    assert best_rectangle_of_shape(
+        topo, (3, 2, 1), full - coords
+    ) is None
+    # among placements, the least-fragmenting offset wins: with column
+    # x=1 busy, a 1x2 column at x=0 would strand nothing extra vs x=2
+    # splitting {2,3}; lowest-offset x=0 also leaves the 2x2 at x=2..3
+    avail = full - {(1, 0, 0), (1, 1, 0)}
+    offset, coords = best_rectangle_of_shape(topo, (1, 2, 1), avail)
+    assert {c[0] for c in coords} == {0}
+
+
 # -- fake provider --------------------------------------------------------
 
 

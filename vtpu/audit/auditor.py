@@ -16,7 +16,12 @@ modes are all silent until a node wedges:
   can book more than exists;
 - **stale heartbeat** — a node's handshake or utilization write-back
   annotation stopped advancing: the plugin/monitor on that node is dead
-  or partitioned, so every other view of the node is suspect.
+  or partitioned, so every other view of the node is suspect;
+- **partial gang** — a gang (vtpu/scheduler/gang.py) with SOME members
+  holding bookings and no admission in flight: the all-or-nothing
+  protocol's invariant is broken (a crashed coordinator mid-rollback, a
+  member pod deleted out from under a bound gang), and the surviving
+  members strand capacity behind a job that can never make progress.
 
 Each pass produces a per-node verdict report (``GET /audit``), emits one
 ``DriftDetected`` journal event per finding, and exports gauges
@@ -79,6 +84,11 @@ _DRIFTS = _REG.counter(
     "vtpu_audit_drift_total",
     "Drift findings by class across all reconciliation passes",
 )
+_PARTIAL_GANGS = _REG.gauge(
+    "vtpu_audit_partial_gangs_total",
+    "Bookings held by members of partially-admitted gangs (per node; "
+    "the all-or-nothing invariant of vtpu/scheduler/gang.py is broken)",
+)
 
 
 class DriftClass:
@@ -86,6 +96,7 @@ class DriftClass:
     ORPHANED_REGION = "orphaned_region"
     OVERCOMMIT = "overcommit"
     STALE_HEARTBEAT = "stale_heartbeat"
+    PARTIAL_GANG = "partial_gang"
 
 
 DRIFT_CLASSES = (
@@ -93,6 +104,7 @@ DRIFT_CLASSES = (
     DriftClass.ORPHANED_REGION,
     DriftClass.OVERCOMMIT,
     DriftClass.STALE_HEARTBEAT,
+    DriftClass.PARTIAL_GANG,
 )
 
 
@@ -224,6 +236,54 @@ class ClusterAuditor:
                 })
         return orphaned
 
+    def _partial_gangs(
+        self, live_uids: Dict[str, dict], drifts: Dict[str, List[dict]]
+    ) -> Dict[str, int]:
+        """Gangs whose live members are only PARTIALLY booked with no
+        admission in flight — the leak the two-phase protocol exists to
+        prevent, flagged per booked member's node.  A gang the registry
+        still tracks (TTL-fresh) gets grace: its admission or rollback
+        may be mid-flight."""
+        from vtpu.scheduler.gang import GANG_NAME, GANG_SIZE
+
+        bookings = self.sched.usage_cache.bookings_snapshot()
+        gang_coord = getattr(self.sched, "gang", None)
+        gangs: Dict[str, dict] = {}
+        for uid, pod in live_uids.items():
+            annos = pod.get("metadata", {}).get("annotations") or {}
+            raw = (annos.get(GANG_NAME) or "").strip()
+            if not raw:
+                continue
+            # namespace-scoped identity, matching the registry's keys —
+            # same-named gangs in different namespaces are different gangs
+            ns = pod.get("metadata", {}).get("namespace", "default")
+            name = f"{ns}/{raw}"
+            try:
+                size = int(annos.get(GANG_SIZE, "0"))
+            except (TypeError, ValueError):
+                continue
+            g = gangs.setdefault(name, {"size": size, "booked": {}})
+            b = bookings.get(uid)
+            if b is not None:
+                g["booked"][uid] = b[0]
+        partial: Dict[str, int] = {}
+        for name, g in sorted(gangs.items()):
+            booked = g["booked"]
+            if not booked or len(booked) >= g["size"]:
+                continue  # nothing held, or fully admitted
+            if gang_coord is not None and gang_coord.registry.is_active(name):
+                continue  # admission/rollback may still be in flight
+            for uid, node in sorted(booked.items()):
+                partial[node] = partial.get(node, 0) + 1
+                drifts.setdefault(node, []).append({
+                    "class": DriftClass.PARTIAL_GANG,
+                    "pod": uid,
+                    "gang": name,
+                    "detail": f"gang {name}: {len(booked)}/{g['size']} "
+                              f"members booked; {uid} strands {node}",
+                })
+        return partial
+
     def _overcommit(self, drifts: Dict[str, List[dict]]) -> Dict[str, float]:
         """Worst booked/capacity ratio per node (memory MiB and core
         percent, per chip); > 1 means the ledger promises more than the
@@ -326,8 +386,10 @@ class ClusterAuditor:
         if live is not None:
             leaked = self._leaked_bookings(live, drifts)
             orphaned = self._orphaned_regions(live, drifts)
+            partial = self._partial_gangs(live, drifts)
         else:
-            leaked, orphaned = {}, {}  # pod list failed: detectors skipped
+            # pod list failed: detectors skipped
+            leaked, orphaned, partial = {}, {}, {}
         ratios = self._overcommit(drifts)
         stale = self._stale_heartbeats(drifts)
 
@@ -353,6 +415,7 @@ class ClusterAuditor:
             if live is not None:
                 _LEAKED.set(leaked.get(name, 0), node=name)
                 _ORPHANED.set(orphaned.get(name, 0), node=name)
+                _PARTIAL_GANGS.set(partial.get(name, 0), node=name)
             _OVERCOMMIT.set(ratios.get(name, 0.0), node=name)
 
         ts = self._wallclock()
@@ -362,6 +425,7 @@ class ClusterAuditor:
                 _LEAKED.remove(node=gone)
                 _ORPHANED.remove(node=gone)
                 _OVERCOMMIT.remove(node=gone)
+                _PARTIAL_GANGS.remove(node=gone)
             self._prev_nodes = set(node_names)
             report = {
                 "pass": self._passes,
@@ -376,6 +440,7 @@ class ClusterAuditor:
                         1 for r in ratios.values() if r > 1.0 + _EPS
                     ),
                     "stale_nodes": len(stale),
+                    "partial_gang_bookings": sum(partial.values()),
                 },
             }
             self._last_report = report

@@ -120,6 +120,22 @@ def _connected_greedy(
     return best
 
 
+def _rect_rank_key(
+    topo: Topology, avail: FrozenSet[Coord], offset: tuple,
+    shape: Tuple[int, int, int], coords: FrozenSet[Coord],
+) -> tuple:
+    """Rectangle ranking (lower wins): ring count, compactness, leftover
+    fragmentation, then offset for determinism — shared by the per-size
+    and per-shape selectors so single-node and cross-host gang placement
+    rank identically."""
+    return (
+        -ring_count(shape),
+        -compactness(shape),
+        -_frag_score(topo, avail - coords),
+        offset,
+    )
+
+
 @functools.lru_cache(maxsize=4096)
 def _best_rectangle(
     topo: Topology,
@@ -138,17 +154,42 @@ def _best_rectangle(
     for offset, shape, coords in enumerate_rectangles(topo, size, avail):
         if not must <= coords:
             continue  # rectangle must contain every pinned chip
-        key = (
-            -ring_count(shape),
-            -compactness(shape),
-            -_frag_score(topo, avail - coords),
-            offset,
-        )
-        candidates.append((key, coords))
+        candidates.append((_rect_rank_key(topo, avail, offset, shape, coords), coords))
     if not candidates:
         return None
     candidates.sort(key=lambda kc: kc[0])
     return candidates[0][1]
+
+
+@functools.lru_cache(maxsize=8192)
+def best_rectangle_of_shape(
+    topo: Topology,
+    shape: Tuple[int, int, int],
+    avail: FrozenSet[Coord],
+) -> Optional[Tuple[Coord, FrozenSet[Coord]]]:
+    """The winning placement of one EXACT box shape out of ``avail`` —
+    (offset, coords), or None when that shape does not fit anywhere.
+
+    The cross-host stitcher (vtpu/device/slice.py) must place the SAME
+    per-host sub-rectangle shape on every member node (so the stitched
+    global box is ICI-contiguous), which makes the decision per-shape
+    rather than per-size; among placements the ranking reuses
+    :func:`_rect_rank_key`, so a node carves the least-fragmenting
+    offset exactly like the single-node allocator would.  Memoized on
+    (topology, shape, free-set) — the gang filter re-asks every
+    candidate node the same question until a booking changes it."""
+    best: Optional[Tuple[tuple, Coord, FrozenSet[Coord]]] = None
+    for offset, got_shape, coords in enumerate_rectangles(
+        topo, shape[0] * shape[1] * shape[2], avail
+    ):
+        if got_shape != shape:
+            continue
+        key = _rect_rank_key(topo, avail, offset, got_shape, coords)
+        if best is None or key < best[0]:
+            best = (key, offset, coords)
+    if best is None:
+        return None
+    return best[1], best[2]
 
 
 class IciAllocator:

@@ -1,0 +1,328 @@
+"""Cross-host slice topology: stitching per-node chip rectangles into one
+ICI-contiguous global rectangle over a host grid.
+
+Single-node placement (vtpu/device/allocator.py) answers "which chips on
+THIS node"; real TPU workloads span hosts — a v5e-64 slice is an 8×8 chip
+grid carved into 4×4 per-host sub-grids whose boundary chips link to the
+neighbouring host's boundary chips over ICI.  This module models that
+second tier:
+
+- hosts occupy coordinates in a 2-D **host grid** (node annotation
+  ``vtpu.io/host-coord`` = ``"x,y"``; hosts without one are laid out as a
+  linear chain in sorted-name order, which degrades to "any contiguous
+  run of hosts" — correct for racks cabled as a ring/line);
+- a **gang** of N member pods, each requesting the same chip count, is
+  placed by choosing (1) an N-host axis-aligned rectangle of the host
+  grid and (2) ONE per-host sub-rectangle shape placed on every member —
+  the stitched global box is then ``(hosts_x·chips_x, hosts_y·chips_y,
+  chips_z)``;
+- **cross-host contiguity rule**: along any host-grid axis with more
+  than one host, the per-host sub-rectangle must span the host's full
+  chip extent on that axis — otherwise the stitched box has interior
+  gaps and the inter-host ICI links land on chips the gang does not own.
+  For the same reason, a multi-host plan uses ONE COMMON offset on every
+  member (inter-host links connect equal-(y,z) boundary chips, so
+  members carving different rows would link into chips the gang does not
+  own); only a single-host "gang" may place its rectangle per-host;
+- candidate plans are ranked by the global box's ring count and
+  compactness (the allocator's own rectangle ranking, lifted one tier
+  up) plus the summed per-node slice-affinity
+  (vtpu/scheduler/score.py:slice_affinity — prefer carvings that do not
+  shatter a node's largest contiguous free block), ties broken by host
+  offset then node names for determinism.
+
+The per-host placement reuses the allocator's memoized rectangle
+machinery (``best_rectangle_of_shape``), so a gang filter replayed
+against unchanged free-sets costs dictionary lookups, not torus
+enumeration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from vtpu.device.allocator import best_rectangle_of_shape
+from vtpu.device.topology import (
+    Coord,
+    Topology,
+    box_shapes,
+    compactness,
+    enumerate_rectangles,
+    ring_count,
+)
+
+HOST_COORD_ANNOTATION = "vtpu.io/host-coord"
+
+
+def parse_host_coord(value: str) -> Tuple[int, int]:
+    """``"x,y"`` → (x, y); raises ValueError on garbage."""
+    parts = [p.strip() for p in value.split(",")]
+    if len(parts) != 2:
+        raise ValueError(f"bad host coord {value!r}; want 'x,y'")
+    x, y = int(parts[0]), int(parts[1])
+    if x < 0 or y < 0:
+        raise ValueError(f"bad host coord {value!r}; coords must be >= 0")
+    return x, y
+
+
+@dataclasses.dataclass(frozen=True)
+class HostView:
+    """One candidate node's placement inputs, snapshotted at plan time."""
+
+    node: str
+    host_coord: Tuple[int, int]
+    topology: str                 # per-host chip grid spec, e.g. "2x2x1"
+    free: FrozenSet[Coord]        # chip coords that fit the member request
+    generation: int = -1          # usage-cache generation at snapshot
+
+
+@dataclasses.dataclass(frozen=True)
+class MemberPlacement:
+    """One gang member's carve on one host."""
+
+    node: str
+    host_coord: Tuple[int, int]
+    offset: Coord
+    shape: Tuple[int, int, int]
+    coords: Tuple[Coord, ...]     # sorted chip coords of the sub-rectangle
+    generation: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SlicePlan:
+    """An all-members placement: per-host sub-rectangles stitched into
+    one global ICI rectangle."""
+
+    members: Tuple[MemberPlacement, ...]
+    host_offset: Tuple[int, int]
+    host_shape: Tuple[int, int]
+    global_shape: Tuple[int, int, int]
+    score: float
+
+    def describe(self) -> dict:
+        """Wire/JSON form for the decision audit log and /decisions."""
+        return {
+            "global_shape": "x".join(str(d) for d in self.global_shape),
+            "host_shape": "x".join(str(d) for d in self.host_shape),
+            "host_offset": list(self.host_offset),
+            "score": round(self.score, 6),
+            "members": {
+                m.node: {
+                    "host": list(m.host_coord),
+                    "offset": list(m.offset),
+                    "shape": "x".join(str(d) for d in m.shape),
+                }
+                for m in self.members
+            },
+        }
+
+
+def assign_host_coords(
+    nodes: Sequence[str], annotated: Dict[str, str]
+) -> Dict[str, Tuple[int, int]]:
+    """Resolve each node's host-grid coordinate: the ``vtpu.io/host-coord``
+    annotation when present and well-formed, else a linear chain in
+    sorted-name order.  In a mixed cluster the chain goes a full GAP row
+    below the annotated grid: an unannotated (or malformed/colliding)
+    host's links to the annotated hosts are unknown, so it must never be
+    treated as ICI-adjacent to them — only the chain's own sorted-name
+    adjacency (the documented line/ring fallback) is assumed."""
+    out: Dict[str, Tuple[int, int]] = {}
+    taken = set()
+    unplaced: List[str] = []
+    for name in sorted(nodes):
+        raw = annotated.get(name, "")
+        try:
+            coord = parse_host_coord(raw) if raw else None
+        except ValueError:
+            coord = None
+        if coord is not None and coord not in taken:
+            out[name] = coord
+            taken.add(coord)
+        else:
+            unplaced.append(name)
+    next_y = 2 + max((c[1] for c in taken), default=-2)
+    for i, name in enumerate(unplaced):
+        out[name] = (i, next_y)
+    return out
+
+
+def _host_grid(views: Sequence[HostView]) -> Tuple[Topology, Dict[Coord, HostView]]:
+    """Bounding host-grid Topology over the candidate hosts + the
+    coord → view map (host grid is 2-D; z is always 1)."""
+    max_x = max(v.host_coord[0] for v in views)
+    max_y = max(v.host_coord[1] for v in views)
+    topo = Topology((max_x + 1, max_y + 1, 1))
+    by_coord = {(v.host_coord[0], v.host_coord[1], 0): v for v in views}
+    return topo, by_coord
+
+
+def stitched_shape(
+    host_shape: Tuple[int, int], chip_shape: Tuple[int, int, int]
+) -> Tuple[int, int, int]:
+    """Global chip-grid dims of ``host_shape`` hosts each contributing a
+    ``chip_shape`` sub-rectangle."""
+    return (
+        host_shape[0] * chip_shape[0],
+        host_shape[1] * chip_shape[1],
+        chip_shape[2],
+    )
+
+
+def _shape_placements(
+    topo: Topology, shape: Tuple[int, int, int]
+) -> List[Tuple[Coord, FrozenSet[Coord]]]:
+    """Every placement (offset, coords) of one exact box shape on the
+    per-host grid, offset-ordered."""
+    out = []
+    for offset, got_shape, coords in enumerate_rectangles(
+        topo, shape[0] * shape[1] * shape[2], None
+    ):
+        if got_shape == shape:
+            out.append((offset, coords))
+    return out
+
+
+def _best_common_offset(
+    topo: Topology, shape: Tuple[int, int, int],
+    views: Sequence[HostView], affinity,
+) -> Optional[Tuple[Coord, FrozenSet[Coord], float]]:
+    """The best single (offset, coords) of ``shape`` free on EVERY
+    member host — ranked by summed per-member affinity, ties to the
+    lowest offset.  Returns (offset, coords, affinity sum) or None."""
+    best: Optional[Tuple[tuple, Coord, FrozenSet[Coord], float]] = None
+    for offset, coords in _shape_placements(topo, shape):
+        if not all(coords <= v.free for v in views):
+            continue
+        aff = (
+            sum(affinity(v, coords) for v in views)
+            if affinity is not None else 0.0
+        )
+        key = (-aff, offset)
+        if best is None or key < best[0]:
+            best = (key, offset, coords, aff)
+    if best is None:
+        return None
+    return best[1], best[2], best[3]
+
+
+def plan_slice(
+    views: Sequence[HostView],
+    gang_size: int,
+    chips_per_member: int,
+    desired_mesh: Optional[Tuple[int, int, int]] = None,
+    affinity=None,
+) -> Optional[SlicePlan]:
+    """Choose ``gang_size`` member hosts and one per-host sub-rectangle
+    shape forming the best ICI-contiguous global slice, or None.
+
+    A stitched slice only spans hosts of ONE per-host topology (chips at
+    mismatched coordinates cannot link), but mixed clusters are fine:
+    heterogeneous ``views`` are partitioned by topology and each
+    homogeneous group planned independently, best plan wins.
+    ``desired_mesh`` pins the stitched global shape (dims compared as a
+    sorted multiset, so "4x2" accepts a 2×4 placement).  ``affinity`` is
+    an optional ``(view, coords) -> float`` scored per member carve
+    (higher = better; vtpu/scheduler/score.py:slice_affinity).
+    """
+    if gang_size <= 0 or chips_per_member <= 0 or len(views) < gang_size:
+        return None
+    topologies = sorted({v.topology for v in views})
+    if len(topologies) > 1:
+        best_mixed: Optional[SlicePlan] = None
+        for t in topologies:
+            group = [v for v in views if v.topology == t]
+            plan = plan_slice(
+                group, gang_size, chips_per_member, desired_mesh, affinity
+            )
+            if plan is None:
+                continue
+            if best_mixed is None or (
+                (-plan.score, tuple(m.node for m in plan.members))
+                < (-best_mixed.score,
+                   tuple(m.node for m in best_mixed.members))
+            ):
+                best_mixed = plan
+        return best_mixed
+    per_host_topo = Topology.from_spec(views[0].topology)
+    host_topo, by_coord = _host_grid(views)
+    avail_hosts = frozenset(by_coord)
+    want_dims = (
+        tuple(sorted(desired_mesh)) if desired_mesh is not None else None
+    )
+    best: Optional[Tuple[tuple, SlicePlan]] = None
+    for host_off, host_shape3, host_coords in enumerate_rectangles(
+        host_topo, gang_size, avail_hosts
+    ):
+        host_shape = (host_shape3[0], host_shape3[1])
+        for chip_shape in box_shapes(chips_per_member, per_host_topo.dims):
+            # cross-host contiguity: a stitched axis must consume the
+            # host's full chip extent on that axis, or the global box has
+            # interior gaps where the inter-host ICI links land on chips
+            # the gang does not own
+            if host_shape[0] > 1 and chip_shape[0] != per_host_topo.dims[0]:
+                continue
+            if host_shape[1] > 1 and chip_shape[1] != per_host_topo.dims[1]:
+                continue
+            gshape = stitched_shape(host_shape, chip_shape)
+            if want_dims is not None and tuple(sorted(gshape)) != want_dims:
+                continue
+            if gang_size == 1:
+                # single host: no seams, the rectangle may sit anywhere
+                v = by_coord[next(iter(host_coords))]
+                got = best_rectangle_of_shape(
+                    per_host_topo, chip_shape, v.free
+                )
+                if got is None:
+                    continue
+                offset, coords = got
+                members = [MemberPlacement(
+                    node=v.node, host_coord=v.host_coord, offset=offset,
+                    shape=chip_shape, coords=tuple(sorted(coords)),
+                    generation=v.generation,
+                )]
+                aff_sum = affinity(v, coords) if affinity is not None else 0.0
+            else:
+                # multi-host: ONE COMMON offset on every member — the
+                # inter-host ICI links connect equal-coordinate boundary
+                # chips, so members carving different offsets along a
+                # non-stitched axis would link into chips the gang does
+                # not own (a seam gap in the declared rectangle)
+                got2 = _best_common_offset(
+                    per_host_topo, chip_shape,
+                    [by_coord[hc] for hc in sorted(host_coords)], affinity,
+                )
+                if got2 is None:
+                    continue
+                offset, coords, aff_sum = got2
+                members = [
+                    MemberPlacement(
+                        node=by_coord[hc].node,
+                        host_coord=by_coord[hc].host_coord,
+                        offset=offset,
+                        shape=chip_shape,
+                        coords=tuple(sorted(coords)),
+                        generation=by_coord[hc].generation,
+                    )
+                    for hc in sorted(host_coords)
+                ]
+            score = (
+                ring_count(gshape)
+                + compactness(gshape)
+                + (aff_sum / gang_size if affinity is not None else 0.0)
+            )
+            key = (
+                -score,
+                host_off,
+                tuple(m.node for m in members),
+            )
+            if best is None or key < best[0]:
+                best = (key, SlicePlan(
+                    members=tuple(members),
+                    host_offset=(host_off[0], host_off[1]),
+                    host_shape=host_shape,
+                    global_shape=gshape,
+                    score=score,
+                ))
+    return best[1] if best is not None else None

@@ -180,6 +180,21 @@ def enumerate_rectangles(
                         yield (ox, oy, oz), shape, coords
 
 
+@functools.lru_cache(maxsize=4096)
+def largest_rectangle(topo: Topology, avail: FrozenSet[Coord]) -> int:
+    """Chip count of the biggest axis-aligned all-free sub-box of
+    ``avail``.  The fragmentation primitive: the scheduler's gauges and
+    the gang slice-affinity score both ask "how big a gang could this
+    node still take?" — memoized on the free-set because repeated
+    filters against an unchanged node re-ask it verbatim."""
+    if not avail:
+        return 0
+    for size in range(len(avail), 0, -1):
+        if next(enumerate_rectangles(topo, size, avail), None) is not None:
+            return size
+    return 0
+
+
 def ring_count(shape: Tuple[int, int, int]) -> int:
     """Number of independent ICI ring embeddings of a rectangle — the analog
     of cntopo's NonConflictRingNum used by policy gates (spider.go:84-90).

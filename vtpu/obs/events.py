@@ -59,6 +59,10 @@ class EventType:
     NODE_REGISTERED = "NodeRegistered"  # registry gained/changed a node's devices
     NODE_EXPELLED = "NodeExpelled"      # a node's devices left the registry
     NODE_STALE = "NodeStale"            # handshake/heartbeat past its deadline
+    # gang scheduling (vtpu/scheduler/gang.py two-phase protocol)
+    GANG_RESERVED = "GangReserved"      # phase 1: every member node CAS-booked
+    GANG_BOUND = "GangBound"            # phase 2: every member's assignment patched
+    GANG_ABORTED = "GangAborted"        # any member failed; all reservations rolled back
     # plugin
     ALLOCATE_SERVED = "AllocateServed"  # kubelet Allocate answered with devices
     ALLOCATE_FAILED = "AllocateFailed"  # Allocate unwound the handshake

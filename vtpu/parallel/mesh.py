@@ -84,14 +84,46 @@ def make_hybrid_mesh(
     return Mesh(arr, (dcn_axis_name,) + tuple(ici_axis_names))
 
 
-def mesh_from_rectangle(shape: Tuple[int, ...],
+def mesh_from_rectangle(shape,
                         axis_names: Optional[Sequence[str]] = None,
                         devices=None) -> Mesh:
     """Mesh whose axes mirror a gang rectangle's non-trivial dims, largest
-    first (vtpu.device.topology.mesh_axes_for)."""
-    dims = sorted([d for d in shape if d > 1], reverse=True) or [1]
-    if axis_names is None:
-        axis_names = [f"ici{i}" for i in range(len(dims))]
+    first (vtpu.device.topology.mesh_axes_for).
+
+    ``shape`` may also be a HOST-SPLIT global rectangle: a sequence of
+    per-host sub-rectangle shapes (what a bound gang's placement is —
+    one entry per member, e.g. ``[(2, 2, 1)] * 4``; the per-member
+    ``shape`` fields of vtpu.device.slice.SlicePlan).  The mesh is then
+    hybrid: the OUTER axis runs across hosts (gradient/data traffic —
+    the axis whose neighbours sit over the host boundary) and the inner
+    axes lie within one host's sub-rectangle (the all-ICI axes for
+    tensor-parallel collectives).  Default axis names become
+    ``("dp", "tp")`` when the sub-rectangle is effectively 1-D, else
+    ``("dp", "ici0", ...)``.  All sub-rectangles must be congruent — a
+    heterogeneous split cannot reshape into one dense mesh.
+    """
+    if shape and isinstance(shape[0], (tuple, list)):
+        subs = [tuple(s) for s in shape]
+        if any(s != subs[0] for s in subs):
+            raise ValueError(
+                f"host-split rectangle must be homogeneous, got {subs}"
+            )
+        inner = sorted([d for d in subs[0] if d > 1], reverse=True) or [1]
+        dims = [len(subs)] + inner
+        if axis_names is None:
+            axis_names = (
+                ("dp", "tp") if len(inner) == 1
+                else ("dp", *[f"ici{i}" for i in range(len(inner))])
+            )
+        if len(axis_names) != len(dims):
+            raise ValueError(
+                f"host-split mesh {dims} needs {len(dims)} axis names, "
+                f"got {list(axis_names)}"
+            )
+    else:
+        dims = sorted([d for d in shape if d > 1], reverse=True) or [1]
+        if axis_names is None:
+            axis_names = [f"ici{i}" for i in range(len(dims))]
     devs = list(devices if devices is not None else jax.devices())
     want = int(np.prod(dims))
     if len(devs) < want:

@@ -32,6 +32,7 @@ from vtpu.utils.resources import resource_reqs
 from vtpu.utils.types import (
     BindPhase,
     ContainerDevice,
+    ContainerDeviceRequest,
     HANDSHAKE_TIMEOUT_S,
     HandshakeState,
     KNOWN_DEVICES,
@@ -41,6 +42,16 @@ from vtpu.utils.types import (
 )
 
 log = logging.getLogger(__name__)
+
+# the full set of assignment annotations a rollback must null — shared by
+# every abort leg (shard_release, gang rollback) so adding an assignment
+# key cannot leave one path re-ingesting a stale ghost booking
+ASSIGNMENT_CLEAR_PATCH = {
+    annotations.ASSIGNED_NODE: None,
+    annotations.ASSIGNED_TIME: None,
+    annotations.ASSIGNED_IDS: None,
+    annotations.DEVICES_TO_ALLOCATE: None,
+}
 
 # hot-path latency histograms (docs/observability.md metric catalog);
 # always on — one bisect + three adds per observation, invisible next to
@@ -206,6 +217,12 @@ class Scheduler:
         from vtpu.audit import ClusterAuditor
 
         self.auditor = ClusterAuditor(self)
+        # gang scheduling (vtpu/scheduler/gang.py): all-or-nothing slice
+        # admission for pod groups carrying vtpu.io/gang-* annotations —
+        # imported lazily (gang.py imports FilterResult from this module)
+        from vtpu.scheduler.gang import GangCoordinator
+
+        self.gang = GangCoordinator(self)
         # in a sharded deployment only the elected leader runs periodic
         # audit passes (N replicas re-emitting the same DriftDetected
         # storm would be noise); GET /audit on demand works everywhere
@@ -431,6 +448,9 @@ class Scheduler:
                     self.register_from_node_annotations()
                     if not watching:
                         self.ingest_pods()
+                    # TTL sweep for partial gangs (access-driven expiry
+                    # otherwise needs gang traffic to fire)
+                    self.gang.registry.expire_stale()
                 except Exception:  # noqa: BLE001 — keep the loop alive
                     log.exception("registry loop error")
                 self._stop.wait(REGISTRY_POLL_INTERVAL_S)
@@ -514,10 +534,28 @@ class Scheduler:
             return FilterResult(node=None, failed={}, error="")
         pod_annos = get_annotations(pod)
         uid = pod_uid(pod)
+        # gang members take the all-or-nothing admission path
+        # (vtpu/scheduler/gang.py); a malformed spec is an explicit
+        # filter error, never a silent fall-through to singleton booking
+        from vtpu.scheduler import gang as gang_mod
+
+        try:
+            gang_spec = gang_mod.parse_gang_spec(pod_annos)
+        except ValueError as e:
+            res = FilterResult(None, {}, f"bad gang spec: {e}")
+            self.decisions.record(
+                pod=pod.get("metadata", {}).get("name", ""),
+                namespace=pod.get("metadata", {}).get("namespace", "default"),
+                pod_uid=uid, path="gang", node=None, error=res.error,
+                verdicts={}, utilization={}, elapsed_ms=0.0,
+            )
+            return res
         # the dominant single-chip shape takes the live-aggregate fast
         # path inside _select_and_book; label the latency accordingly
         path = (
-            "fast"
+            "gang"
+            if gang_spec is not None
+            else "fast"
             if len(reqs) == 1 and len(reqs[0]) == 1 and reqs[0][0].nums == 1
             else "general"
         )
@@ -535,7 +573,16 @@ class Scheduler:
             # would double-count the first evaluation's bookings
             node_names = list(dict.fromkeys(node_names))
             committed_remote = False
-            if self.shard is not None:
+            gang_rec = None
+            if gang_spec is not None:
+                # all-or-nothing gang admission: the coordinator patches
+                # every member's assignment itself (phase 2), so the
+                # common patch path below must not run again
+                res, verdicts, gang_rec = self.gang.filter_member(
+                    pod, node_names, reqs, gang_spec, pod_annos, node_objs
+                )
+                enc, committed_remote = None, True
+            elif self.shard is not None:
                 # sharded deployment: this replica coordinates — its own
                 # subset evaluates locally, peers evaluate theirs, the
                 # winner's owner CAS-commits (and patches, when remote)
@@ -566,7 +613,7 @@ class Scheduler:
             # audit log: the full per-node verdict set plus the measured-
             # utilization snapshot that was current at decision time
             measured = self.usage_cache.measured_utilization()
-            self.decisions.record(
+            rec_fields = dict(
                 pod=pod.get("metadata", {}).get("name", ""),
                 namespace=pod.get("metadata", {}).get("namespace", "default"),
                 pod_uid=uid,
@@ -579,6 +626,11 @@ class Scheduler:
                 },
                 elapsed_ms=round((time.perf_counter() - t_filter) * 1e3, 3),
             )
+            if gang_rec is not None:
+                # gang verdicts: per-member-node reserve outcomes + the
+                # chosen global rectangle (GET /decisions?pod= / ?gang=)
+                rec_fields["gang"] = gang_rec
+            self.decisions.record(**rec_fields)
             emit(
                 EventType.POD_FILTERED, "scheduler",
                 pod=uid, node=res.node or "",
@@ -1029,11 +1081,23 @@ class Scheduler:
             }
         return out
 
-    def shard_commit(self, pod: dict, node: str, expected_gen: int) -> dict:
+    def shard_commit(
+        self, pod: dict, node: str, expected_gen: int,
+        placement_enc: Optional[str] = None,
+    ) -> dict:
         """Owner-side commit (POST /shard/commit): re-evaluate ``node``
         FRESH, CAS-commit at the fresh generation, and write the
         assignment annotations.  Returns {"status": "ok" | "conflict" |
         "no_fit" | "error", ...}.
+
+        ``placement_enc`` (encoded PodDevices) pins the EXACT devices to
+        book instead of letting the owner's evaluation choose — the gang
+        coordinator's planned sub-rectangle must survive the remote leg
+        or the stitched cross-host slice silently loses its ICI
+        contiguity.  The owner still validates every pinned device
+        against its fresh view and CAS-books at its own generation, so
+        safety is unchanged; a pinned device that no longer fits returns
+        "no_fit" and the coordinator re-plans.
 
         Staleness policy: ``expected_gen`` (what the coordinator's merge
         saw) going stale is the COMMON case under a same-shape arrival
@@ -1054,6 +1118,10 @@ class Scheduler:
             pod, self.config.default_mem, self.config.default_cores
         )
         pod_annos = get_annotations(pod)
+        if placement_enc is not None:
+            return self._shard_commit_pinned(
+                pod, uid, node, pod_annos, placement_enc
+            )
         with trace.span("shard_commit", trace_id=uid, node=node) as sp:
             stale = False
             for _ in range(2):  # fresh eval + one internal CAS retry
@@ -1082,6 +1150,89 @@ class Scheduler:
                 "status": "conflict",
                 "gen": self.usage_cache.generation(node),
             }
+
+    def _shard_commit_pinned(
+        self, pod: dict, uid: str, node: str, pod_annos, placement_enc: str
+    ) -> dict:
+        """The pinned-placement leg of :meth:`shard_commit`: validate
+        each requested device against the fresh view and CAS-book that
+        exact set."""
+        try:
+            placement = codec.decode_pod_devices(placement_enc)
+        except ValueError as e:
+            return {"status": "error", "error": f"bad placement: {e}"}
+        with trace.span("shard_commit", trace_id=uid, node=node,
+                        pinned=True) as sp:
+            for _ in range(2):  # fresh eval + one internal CAS retry
+                nu, gen = self.usage_cache.clone_node(node, exclude_uid=uid)
+                if nu is None:
+                    return {
+                        "status": "no_fit",
+                        "failed": {node: "no vtpu devices registered"},
+                    }
+                by_uuid = {d.uuid: d for d in nu.devices}
+                ok = True
+                for ctr in placement:
+                    for cd in ctr:
+                        dev = by_uuid.get(cd.uuid)
+                        # per-device fit with the pinned concrete quota
+                        req = ContainerDeviceRequest(
+                            nums=1, type=cd.type, memreq=cd.usedmem,
+                            mem_percentage=0, coresreq=cd.usedcores,
+                        )
+                        if dev is None or not score_mod.fits_device(
+                            dev, req, pod_annos
+                        ):
+                            ok = False
+                            break
+                    if not ok:
+                        break
+                if not ok:
+                    return {
+                        "status": "no_fit",
+                        "failed": {node: "pinned placement no longer fits"},
+                    }
+                if self.usage_cache.try_book(uid, node, gen, placement):
+                    enc = codec.encode_pod_devices(placement)
+                    fresh = dict(pod)
+                    fresh_annos = dict(get_annotations(pod))
+                    fresh_annos[annotations.ASSIGNED_IDS] = enc
+                    fresh_annos[annotations.ASSIGNED_NODE] = node
+                    fresh["metadata"] = dict(
+                        pod["metadata"], annotations=fresh_annos
+                    )
+                    self.pods.add_pod(fresh, node, placement, pending=True)
+                    err = self._patch_assignment(pod, uid, node, enc, sp)
+                    if err is not None:
+                        return {"status": "error", "error": err}
+                    return {"status": "ok", "node": node, "enc": enc}
+                _CAS_CONFLICTS.inc()
+            return {
+                "status": "conflict",
+                "gen": self.usage_cache.generation(node),
+            }
+
+    def shard_release(self, uid: str, node: str) -> dict:
+        """Owner-side reservation release (POST /shard/release) — the
+        abort leg of a cross-replica gang: a coordinator whose gang
+        failed mid-reserve tells each member node's owner to drop the
+        booking shard_commit made and null the assignment annotations it
+        patched (left in place they would be re-ingested as a booking on
+        the next sweep).  Idempotent: releasing an absent or re-routed
+        booking is a no-op."""
+        pi = self.pods.all_pods().get(uid)
+        if pi is None or pi.node != node:
+            return {"status": "absent"}
+        self.pods.rm_pod(uid)
+        try:
+            self.client.patch_pod_annotations(
+                pi.namespace, pi.name, dict(ASSIGNMENT_CLEAR_PATCH)
+            )
+        except Exception:  # noqa: BLE001 — booking is gone; annos best-effort
+            log.exception("shard release: could not null assignment "
+                          "annotations of %s", uid)
+            return {"status": "ok", "patched": False}
+        return {"status": "ok"}
 
     # ------------------------------------------------------------------
     # Bind (ref Bind scheduler.go:402-442)

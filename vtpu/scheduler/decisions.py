@@ -62,16 +62,24 @@ class DecisionLog:
         return rec
 
     def query(
-        self, pod: Optional[str] = None, n: int = 50
+        self, pod: Optional[str] = None, n: int = 50,
+        gang: Optional[str] = None,
     ) -> List[dict]:
         """Newest-last records; ``pod`` matches pod UID or pod name,
-        filtered before the count cut (like /spans?name=)."""
+        ``gang`` matches the gang name of records carrying a gang
+        verdict (vtpu/scheduler/gang.py) — both filtered before the
+        count cut (like /spans?name=)."""
         with self._lock:
             recs = list(self._dq)
         if pod:
             recs = [
                 r for r in recs
                 if pod in (r.get("pod_uid"), r.get("pod"))
+            ]
+        if gang:
+            recs = [
+                r for r in recs
+                if (r.get("gang") or {}).get("name") == gang
             ]
         n = max(0, n)
         return recs[-n:] if n else []

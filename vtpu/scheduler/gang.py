@@ -1,0 +1,800 @@
+"""Multi-host gang scheduling: all-or-nothing slice admission.
+
+A gang is a pod *group* that must land atomically across a node group:
+training jobs and sharded large-model inference need every member placed
+on an ICI-contiguous cross-host slice or not placed at all — partial
+admission strands capacity behind members that can never start
+(`vtpu/parallel/` has the model-side mesh machinery; this is the
+cluster-side placement for it).
+
+Protocol (docs/gang.md):
+
+1. **Spec** — pods carry ``vtpu.io/gang-name``, ``vtpu.io/gang-size``
+   and optionally ``vtpu.io/gang-mesh`` (the desired stitched global
+   mesh shape, e.g. ``"4x4"``).  The webhook validates/normalizes the
+   spec at admission; the filter parses it per pod.
+2. **Gather** — members arrive one filter call at a time and park in a
+   ``GangRegistry`` (TTL'd: a gang that never completes is forgotten and
+   its members keep getting "waiting" filter errors → kube-scheduler
+   backoff).  No capacity is held while gathering.
+3. **Plan** — when the last member arrives, the coordinator snapshots
+   every candidate node's free chips + usage-cache generation under ONE
+   cache lock hold and asks ``vtpu.device.slice.plan_slice`` for the
+   best cross-host rectangle (per-node sub-rectangles via the
+   allocator's memoized rectangle machinery; ranking = global ring
+   count + compactness + per-node slice affinity).
+4. **Phase 1: reserve** — every member node is CAS-booked via
+   ``UsageCache.try_book`` against the generation the plan saw (member
+   order deterministic).  Nodes owned by a peer replica (PR 6 sharding)
+   reserve through the existing ``/shard/commit`` CAS path instead.
+   ANY conflict rolls back every prior reservation and re-plans against
+   fresh generations, bounded by ``VTPU_GANG_RETRIES``; exhaustion
+   aborts the whole gang (``GangAborted``) with zero residual bookings.
+5. **Phase 2: commit** — every member's assignment annotations are
+   patched (``GangReserved`` between the phases, ``GangBound`` after the
+   last patch).  A patch failure aborts: local bookings are removed,
+   already-patched members get their assignment annotations nulled,
+   remote members release owner-side via ``POST /shard/release``.
+
+The auditor (vtpu/audit) closes the loop: a gang with SOME members
+booked and no in-flight admission is the ``partial_gang`` drift class —
+the leak this protocol exists to prevent, made visible if it ever
+happens anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from vtpu import obs
+from vtpu.device.slice import (
+    HOST_COORD_ANNOTATION,
+    HostView,
+    SlicePlan,
+    assign_host_coords,
+    plan_slice,
+)
+from vtpu.device.topology import parse_topology
+from vtpu.k8s.objects import get_annotations, pod_uid
+from vtpu.obs.events import EventType, emit
+from vtpu.scheduler import score as score_mod
+from vtpu.scheduler.core import ASSIGNMENT_CLEAR_PATCH, FilterResult
+from vtpu.utils import codec
+from vtpu.utils.resources import resource_reqs
+from vtpu.utils.types import ContainerDevice, PodDevices, annotations
+
+log = logging.getLogger(__name__)
+
+GANG_NAME = "vtpu.io/gang-name"
+GANG_SIZE = "vtpu.io/gang-size"
+GANG_MESH = "vtpu.io/gang-mesh"
+
+ENV_TTL = "VTPU_GANG_TTL_S"
+DEFAULT_TTL_S = 30.0
+ENV_RETRIES = "VTPU_GANG_RETRIES"
+DEFAULT_RETRIES = 2
+
+_REG = obs.registry("scheduler")
+_ADMISSIONS = _REG.counter(
+    "vtpu_gang_admissions_total",
+    "Gang admission outcomes (result: bound = all members reserved and "
+    "patched, aborted = rolled back after conflicts/patch failure, "
+    "no_fit = no cross-host slice currently fits, expired = TTL hit "
+    "while gathering, rejected = malformed/conflicting spec)",
+)
+_RESERVE_HIST = _REG.histogram(
+    "vtpu_gang_reserve_seconds",
+    "Full gang admission latency: plan + per-member CAS reserves + "
+    "assignment patches, measured at the completing member's filter",
+)
+_WAITING = _REG.gauge(
+    "vtpu_gang_waiting_total",
+    "Gangs currently gathering members (registered but incomplete)",
+)
+_MEMBER_RESERVES = _REG.counter(
+    "vtpu_gang_member_reserves_total",
+    "Per-member-node reservation attempts during gang admission "
+    "(result: ok / conflict / remote_ok / remote_fail)",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GangSpec:
+    name: str
+    size: int
+    mesh: Optional[Tuple[int, int, int]]  # desired stitched global shape
+
+
+def parse_gang_spec(pod_annos: Dict[str, str]) -> Optional[GangSpec]:
+    """Gang spec out of pod annotations; None when the pod is not a gang
+    member, ValueError when the spec is present but malformed."""
+    name = (pod_annos.get(GANG_NAME) or "").strip()
+    size_raw = (pod_annos.get(GANG_SIZE) or "").strip()
+    mesh_raw = (pod_annos.get(GANG_MESH) or "").strip()
+    if not name and not size_raw:
+        return None
+    if not name:
+        raise ValueError(f"{GANG_SIZE} without {GANG_NAME}")
+    if not size_raw:
+        raise ValueError(f"gang {name}: missing {GANG_SIZE}")
+    try:
+        size = int(size_raw)
+    except ValueError:
+        raise ValueError(f"gang {name}: bad {GANG_SIZE} {size_raw!r}")
+    if size < 1:
+        raise ValueError(f"gang {name}: {GANG_SIZE} must be >= 1")
+    mesh = None
+    if mesh_raw:
+        try:
+            mesh = parse_topology(mesh_raw)
+        except ValueError:
+            raise ValueError(f"gang {name}: bad {GANG_MESH} {mesh_raw!r}")
+    return GangSpec(name=name, size=size, mesh=mesh)
+
+
+def gang_key(pod: dict, spec: GangSpec) -> str:
+    """Namespace-scoped gang identity: two teams naming their gangs
+    ``train`` in different namespaces must never merge into one gang."""
+    ns = pod.get("metadata", {}).get("namespace", "default")
+    return f"{ns}/{spec.name}"
+
+
+def canonical_mesh(mesh_raw: str) -> str:
+    """Canonical ``AxBxC`` form of a gang-mesh annotation (the webhook
+    normalizes so the registry's spec compare is string-stable)."""
+    return "x".join(str(d) for d in parse_topology(mesh_raw))
+
+
+class _Gang:
+    __slots__ = ("spec", "members", "reserved", "state", "touched_t")
+
+    GATHERING = "gathering"
+    BOUND = "bound"
+
+    def __init__(self, spec: GangSpec, now: float) -> None:
+        self.spec = spec
+        self.members: Dict[str, dict] = {}   # uid → pod dict (latest seen)
+        self.reserved: Dict[str, str] = {}   # uid → node, once bound
+        self.state = self.GATHERING
+        self.touched_t = now
+
+
+class GangRegistry:
+    """TTL'd partial-gang store.  Gathering gangs hold NO capacity —
+    expiry is pure bookkeeping (the members keep getting "waiting"
+    filter errors and back off in kube-scheduler)."""
+
+    def __init__(
+        self, ttl_s: Optional[float] = None, clock=time.monotonic
+    ) -> None:
+        if ttl_s is None:
+            try:
+                ttl_s = float(os.environ.get(ENV_TTL, "") or DEFAULT_TTL_S)
+            except ValueError:
+                ttl_s = DEFAULT_TTL_S
+        self.ttl_s = ttl_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._gangs: Dict[str, _Gang] = {}
+        self.expired_total = 0
+
+    def note_member(
+        self, spec: GangSpec, pod: dict
+    ) -> Tuple[Optional[_Gang], Optional[str]]:
+        """Register (or refresh) one member.  Returns (gang, None) or
+        (None, error) on a spec conflict with the registered gang."""
+        now = self._clock()
+        with self._lock:
+            g = self._gangs.get(spec.name)
+            if g is None:
+                g = self._gangs[spec.name] = _Gang(spec, now)
+            elif g.state == _Gang.GATHERING and (
+                g.spec.size != spec.size or g.spec.mesh != spec.mesh
+            ):
+                return None, (
+                    f"gang {spec.name}: conflicting spec "
+                    f"(registered size={g.spec.size} mesh={g.spec.mesh}, "
+                    f"pod says size={spec.size} mesh={spec.mesh})"
+                )
+            g.touched_t = now
+            if g.state == _Gang.GATHERING:
+                uid = pod_uid(pod)
+                if uid not in g.members and len(g.members) >= spec.size:
+                    # a size+1'th DISTINCT uid (e.g. a member pod deleted
+                    # and recreated while the old uid still gathers):
+                    # admitting it would silently truncate someone in the
+                    # member↔placement pairing — reject loudly instead
+                    return None, (
+                        f"gang {spec.name}: already gathered "
+                        f"{len(g.members)} members for size {spec.size}; "
+                        f"member {uid} cannot join"
+                    )
+                g.members[uid] = pod
+            self._refresh_waiting_locked()
+            return g, None
+
+    def get(self, name: str) -> Optional[_Gang]:
+        with self._lock:
+            return self._gangs.get(name)
+
+    def is_active(self, name: str) -> bool:
+        """Whether an admission for this gang may still be in flight —
+        the auditor's grace check before flagging a partial gang."""
+        with self._lock:
+            g = self._gangs.get(name)
+            return g is not None and (
+                self._clock() - g.touched_t < self.ttl_s
+            )
+
+    def drop(self, name: str) -> None:
+        with self._lock:
+            self._gangs.pop(name, None)
+            self._refresh_waiting_locked()
+
+    def refresh_waiting(self) -> None:
+        with self._lock:
+            self._refresh_waiting_locked()
+
+    def expire_stale(self) -> List[str]:
+        """Forget gangs untouched for a TTL; returns the expired
+        GATHERING gang names (bound gangs age out silently — their
+        bookings live on as ordinary pod state)."""
+        now = self._clock()
+        expired: List[str] = []
+        with self._lock:
+            for name in list(self._gangs):
+                g = self._gangs[name]
+                if now - g.touched_t < self.ttl_s:
+                    continue
+                del self._gangs[name]
+                if g.state == _Gang.GATHERING and g.members:
+                    expired.append(name)
+            if expired:
+                self.expired_total += len(expired)
+            self._refresh_waiting_locked()
+        for name in expired:
+            _ADMISSIONS.inc(result="expired")
+            emit(EventType.GANG_ABORTED, "scheduler", gang=name,
+                 reason="ttl_expired_while_gathering")
+        return expired
+
+    def _refresh_waiting_locked(self) -> None:
+        _WAITING.set(sum(
+            1 for g in self._gangs.values()
+            if g.state == _Gang.GATHERING and len(g.members) < g.spec.size
+        ))
+
+
+class _MemberReservation:
+    __slots__ = ("uid", "pod", "node", "devices", "enc", "remote", "patched")
+
+    def __init__(self, uid, pod, node, devices, enc, remote) -> None:
+        self.uid = uid
+        self.pod = pod
+        self.node = node
+        self.devices: PodDevices = devices
+        self.enc = enc
+        self.remote = remote
+        self.patched = False
+
+
+class GangCoordinator:
+    """Gang filter path + the two-phase all-or-nothing bind, attached to
+    a Scheduler as ``sched.gang``."""
+
+    def __init__(self, sched, registry: Optional[GangRegistry] = None) -> None:
+        self.sched = sched
+        self.registry = registry or GangRegistry()
+        try:
+            self.retries = int(
+                os.environ.get(ENV_RETRIES, "") or DEFAULT_RETRIES
+            )
+        except ValueError:
+            self.retries = DEFAULT_RETRIES
+        # serializes admissions PER GANG (striped by gang key): two
+        # members completing the same gang concurrently must not both
+        # run phase 1, but one gang mid-admission — remote commits, N
+        # assignment patches — must not head-of-line-block every other
+        # gang's filter.  Different gangs planning concurrently may pick
+        # overlapping nodes; the loser's try_book CAS conflicts and it
+        # re-plans, the same optimistic model singleton filters use.
+        self._admit_stripes = [threading.RLock() for _ in range(32)]
+        # test hook: called as fn(member_uid, node) immediately before
+        # each member's CAS reserve — deterministic conflict injection
+        # for the all-or-nothing proof (tests/test_gang.py)
+        self._pre_reserve_hook = None
+
+    # -- filter entry ---------------------------------------------------
+    def filter_member(
+        self, pod: dict, node_names: List[str], reqs, spec: GangSpec,
+        pod_annos, node_objs=None,
+    ) -> Tuple[FilterResult, Dict[str, dict], dict]:
+        """The gang branch of Scheduler.filter: returns (result,
+        per-node verdicts, gang record for the decision audit log).
+        Assignment patches for EVERY member happen in here (phase 2) —
+        the caller must not patch again."""
+        uid = pod_uid(pod)
+        # namespace-scope the gang identity before it touches any state
+        spec = dataclasses.replace(spec, name=gang_key(pod, spec))
+        stripe = int.from_bytes(
+            hashlib.md5(spec.name.encode()).digest()[:4], "big"
+        ) % len(self._admit_stripes)
+        with self._admit_stripes[stripe]:
+            self.registry.expire_stale()
+            g, err = self.registry.note_member(spec, pod)
+            if err is not None:
+                _ADMISSIONS.inc(result="rejected")
+                return (
+                    FilterResult(None, {}, err),
+                    {},
+                    {"name": spec.name, "status": "rejected", "error": err},
+                )
+            node = g.reserved.get(uid)
+            if node is not None:
+                # idempotent replay: this member was reserved+patched by
+                # the completing member's admission; hand back its node
+                return (
+                    FilterResult(node=node, failed={}, error=""),
+                    {node: {"fit": True, "gang_member": uid,
+                            "reserve": "replay"}},
+                    {"name": spec.name, "status": "bound",
+                     "members": dict(g.reserved)},
+                )
+            if g.state == _Gang.BOUND:
+                # bound without this uid: a member re-created after the
+                # gang bound (new uid) cannot join retroactively
+                err = f"gang {spec.name} already bound without member {uid}"
+                return (
+                    FilterResult(None, {}, err), {},
+                    {"name": spec.name, "status": "rejected", "error": err},
+                )
+            if len(g.members) < spec.size:
+                # a member re-filtered AFTER its bound gang aged out of
+                # the registry (e.g. a late bind retry > TTL later) would
+                # wedge at "waiting" forever — its gang-mates are Running
+                # and will never gather again.  A live non-pending booking
+                # for this uid IS the gang's placement: adopt it.
+                pi = self.sched.pods.all_pods().get(uid)
+                if pi is not None and not pi.pending:
+                    g.reserved[uid] = pi.node
+                    return (
+                        FilterResult(node=pi.node, failed={}, error=""),
+                        {pi.node: {"fit": True, "gang_member": uid,
+                                   "reserve": "adopted"}},
+                        {"name": spec.name, "status": "bound",
+                         "members": {uid: pi.node}, "adopted": True},
+                    )
+                err = (
+                    f"gang {spec.name} waiting for members "
+                    f"({len(g.members)}/{spec.size})"
+                )
+                return (
+                    FilterResult(None, {}, err),
+                    {},
+                    {"name": spec.name, "status": "waiting",
+                     "gathered": len(g.members), "size": spec.size},
+                )
+            return self._admit(g, uid, list(dict.fromkeys(node_names)),
+                               node_objs)
+
+    # -- admission ------------------------------------------------------
+    def _member_requests(self, g: _Gang):
+        """Per-member parsed chip requests; error string when the gang is
+        not admissible (multi-request members, heterogeneous sizes)."""
+        cfg = self.sched.config
+        out: Dict[str, object] = {}
+        for muid, mpod in sorted(g.members.items()):
+            mreqs = resource_reqs(mpod, cfg.default_mem, cfg.default_cores)
+            flat = [r for ctr in mreqs for r in ctr]
+            if len(flat) != 1:
+                return None, (
+                    f"gang {g.spec.name}: member {muid} must carry exactly "
+                    f"one chip request (got {len(flat)})"
+                )
+            out[muid] = flat[0]
+        sizes = {r.nums for r in out.values()}
+        if len(sizes) != 1:
+            return None, (
+                f"gang {g.spec.name}: heterogeneous member chip counts "
+                f"{sorted(sizes)}"
+            )
+        return out, None
+
+    def _snapshot_views(
+        self, node_names: List[str], req, pod_annos, node_objs
+    ) -> Tuple[List[HostView], Dict[str, dict]]:
+        """Per-node free-set + generation snapshots (one cache lock hold)
+        and each node's coord → DeviceUsage map for placement building."""
+        cache = self.sched.usage_cache
+        host_annos: Dict[str, str] = {}
+        objs = dict(self.sched._node_objs)
+        if node_objs:
+            objs.update(node_objs)
+        for name in node_names:
+            annos = (
+                (objs.get(name) or {}).get("metadata", {}).get("annotations")
+                or {}
+            )
+            host_annos[name] = annos.get(HOST_COORD_ANNOTATION, "")
+        views: List[HostView] = []
+        dev_maps: Dict[str, dict] = {}
+        usable: List[str] = []
+        raw: Dict[str, tuple] = {}
+        with cache.locked():
+            for name in node_names:
+                entry = cache.peek_entry(name)
+                if entry is None:
+                    continue
+                nu, gen, _util = entry
+                if not nu.topology:
+                    continue
+                raw[name] = (nu, gen)
+                usable.append(name)
+        coords = assign_host_coords(
+            usable, {n: host_annos.get(n, "") for n in usable}
+        )
+        for name in usable:
+            nu, gen = raw[name]
+            by_coord = {}
+            free = set()
+            for d in nu.devices:
+                if d.coords is None:
+                    continue
+                c = tuple(d.coords)
+                by_coord[c] = d
+                if score_mod.fits_device(d, req, pod_annos):
+                    free.add(c)
+            if not free:
+                continue
+            views.append(HostView(
+                node=name,
+                host_coord=coords[name],
+                topology=nu.topology,
+                free=frozenset(free),
+                generation=gen,
+            ))
+            dev_maps[name] = by_coord
+        return views, dev_maps
+
+    def _placement_devices(
+        self, placement, dev_map, req
+    ) -> PodDevices:
+        devs: List[ContainerDevice] = []
+        for c in placement.coords:
+            d = dev_map[c]
+            devs.append(ContainerDevice(
+                uuid=d.uuid,
+                type=req.type,
+                usedmem=score_mod._mem_for(d, req),
+                usedcores=req.coresreq,
+            ))
+        return [devs]
+
+    def _node_owner_remote(self, node: str):
+        """The peer transport owning ``node``, or None when this replica
+        owns it (or sharding is off)."""
+        shard = self.sched.shard
+        if shard is None:
+            return None
+        rid = shard.ring.owner(node)
+        if rid == shard.replica_id:
+            return None
+        return shard.peers.get(rid)
+
+    def _admit(
+        self, g: _Gang, trigger_uid: str, node_names: List[str], node_objs
+    ) -> Tuple[FilterResult, Dict[str, dict], dict]:
+        t0 = time.perf_counter()
+        spec = g.spec
+        member_reqs, err = self._member_requests(g)
+        if err is not None:
+            self.registry.drop(spec.name)
+            _ADMISSIONS.inc(result="rejected")
+            emit(EventType.GANG_ABORTED, "scheduler", gang=spec.name,
+                 reason="bad_member_requests", detail=err)
+            return (
+                FilterResult(None, {}, err), {},
+                {"name": spec.name, "status": "rejected", "error": err},
+            )
+        member_uids = sorted(member_reqs)
+        if len(member_uids) != spec.size:
+            # defensive: the registry caps gathering at size, so this
+            # means registry state was tampered with mid-flight — never
+            # silently truncate the member ↔ placement pairing
+            err = (
+                f"gang {spec.name}: gathered {len(member_uids)} members "
+                f"for size {spec.size}"
+            )
+            return (
+                FilterResult(None, {}, err), {},
+                {"name": spec.name, "status": "rejected", "error": err},
+            )
+        # a gang already admitted by ANOTHER coordinator — a peer replica
+        # whose phase-2 patches this replica's registry poll ingested, or
+        # a pre-restart admission replayed after this process lost its
+        # registry — must not be re-planned: re-booking the same uids
+        # would double-place the gang (try_book replaces a uid's booking,
+        # clobbering the live placement).  Adopt the external placement.
+        allp = self.sched.pods.all_pods()
+        external = {
+            muid: allp[muid].node
+            for muid in member_uids
+            if muid in allp and not allp[muid].pending
+        }
+        if external:
+            g.reserved = dict(external)
+            if len(external) == len(member_uids):
+                g.state = _Gang.BOUND
+                self.registry.refresh_waiting()
+            node = external.get(trigger_uid)
+            if node is not None:
+                return (
+                    FilterResult(node=node, failed={}, error=""),
+                    {node: {"fit": True, "gang_member": trigger_uid,
+                            "reserve": "adopted"}},
+                    {"name": spec.name, "status": "bound",
+                     "members": dict(external), "adopted": True},
+                )
+            err = (
+                f"gang {spec.name}: bound by another coordinator; waiting "
+                f"to ingest this member's assignment "
+                f"({len(external)}/{len(member_uids)} ingested)"
+            )
+            return (
+                FilterResult(None, {}, err), {},
+                {"name": spec.name, "status": "waiting_ingest",
+                 "members": dict(external)},
+            )
+        req0 = member_reqs[member_uids[0]]
+        # any member's annotations work for the type selectors — gang
+        # members are homogeneous by construction (same chart template)
+        annos0 = get_annotations(g.members[member_uids[0]])
+        verdicts: Dict[str, dict] = {}
+        attempts = 0
+        for attempt in range(max(0, self.retries) + 1):
+            attempts = attempt + 1
+            views, dev_maps = self._snapshot_views(
+                node_names, req0, annos0, node_objs
+            )
+            plan = plan_slice(
+                views, spec.size, req0.nums, spec.mesh,
+                affinity=lambda v, coords: score_mod.slice_affinity(
+                    v.topology, v.free, coords,
+                    compact_shape=score_mod.bounding_shape(coords),
+                ),
+            )
+            if plan is None:
+                _ADMISSIONS.inc(result="no_fit")
+                err = (
+                    f"gang {spec.name}: no ICI-contiguous cross-host slice "
+                    f"for {spec.size} x {req0.nums} chips"
+                    + (f" (mesh {'x'.join(map(str, spec.mesh))})"
+                       if spec.mesh else "")
+                )
+                return (
+                    FilterResult(None, {}, err),
+                    verdicts,
+                    {"name": spec.name, "status": "no_fit",
+                     "candidates": len(views), "attempts": attempts},
+                )
+            status, reservations = self._reserve_all(
+                g, member_uids, member_reqs, plan, dev_maps, verdicts
+            )
+            if status == "ok":
+                emit(EventType.GANG_RESERVED, "scheduler", gang=spec.name,
+                     nodes=",".join(r.node for r in reservations),
+                     shape="x".join(map(str, plan.global_shape)))
+                perr, failed_uid = self._commit_all(g, reservations)
+                if perr is not None:
+                    self._rollback(reservations)
+                    if failed_uid is not None:
+                        # self-healing: drop the member whose patch
+                        # failed (commonly a deleted pod — 404s forever);
+                        # live members re-register on their next filter,
+                        # a recreated member can now take the slot
+                        g.members.pop(failed_uid, None)
+                    _ADMISSIONS.inc(result="aborted")
+                    emit(EventType.GANG_ABORTED, "scheduler",
+                         gang=spec.name, reason="patch_failed", detail=perr)
+                    return (
+                        FilterResult(None, {}, perr), verdicts,
+                        {"name": spec.name, "status": "aborted",
+                         "error": perr, "attempts": attempts},
+                    )
+                g.reserved = {r.uid: r.node for r in reservations}
+                g.state = _Gang.BOUND
+                self.registry.refresh_waiting()
+                _ADMISSIONS.inc(result="bound")
+                _RESERVE_HIST.observe(time.perf_counter() - t0)
+                emit(EventType.GANG_BOUND, "scheduler", gang=spec.name,
+                     nodes=",".join(r.node for r in reservations),
+                     members=len(reservations))
+                log.info(
+                    "gang %s bound: %d members on %s (global %s)",
+                    spec.name, len(reservations),
+                    ",".join(r.node for r in reservations),
+                    "x".join(map(str, plan.global_shape)),
+                )
+                gang_rec = {
+                    "name": spec.name, "status": "bound",
+                    "attempts": attempts,
+                    "slice": plan.describe(),
+                    "members": {r.uid: r.node for r in reservations},
+                }
+                return (
+                    FilterResult(
+                        node=g.reserved[trigger_uid], failed={}, error=""
+                    ),
+                    verdicts, gang_rec,
+                )
+            # conflict: some member's node moved under the plan — every
+            # prior reservation is already rolled back; re-plan fresh
+            self.sched.note_gen_retry()
+        _ADMISSIONS.inc(result="aborted")
+        err = (
+            f"gang {spec.name}: reservation conflicts exhausted "
+            f"{self.retries + 1} attempts"
+        )
+        emit(EventType.GANG_ABORTED, "scheduler", gang=spec.name,
+             reason="reserve_conflicts", detail=err)
+        return (
+            FilterResult(None, {}, err), verdicts,
+            {"name": spec.name, "status": "aborted", "error": err,
+             "attempts": attempts},
+        )
+
+    # -- phase 1: all-member CAS reserve --------------------------------
+    def _reserve_all(
+        self, g: _Gang, member_uids, member_reqs, plan: SlicePlan,
+        dev_maps, verdicts,
+    ):
+        """CAS-book every member node; on any conflict roll back every
+        prior reservation and return ("conflict", []).  Deterministic
+        member → placement pairing: sorted uids onto the plan's members
+        (already host-coord sorted)."""
+        sched = self.sched
+        reservations: List[_MemberReservation] = []
+        for muid, placement in zip(member_uids, plan.members):
+            req = member_reqs[muid]
+            mpod = g.members[muid]
+            devices = self._placement_devices(
+                placement, dev_maps[placement.node], req
+            )
+            enc = codec.encode_pod_devices(devices)
+            if self._pre_reserve_hook is not None:
+                self._pre_reserve_hook(muid, placement.node)
+            peer = self._node_owner_remote(placement.node)
+            if peer is not None:
+                try:
+                    # the planned sub-rectangle is PINNED: the owner
+                    # validates and books exactly these devices, or the
+                    # stitched slice would lose its cross-host contiguity
+                    rep = peer.commit(mpod, placement.node,
+                                      placement.generation, enc)
+                except Exception as e:  # noqa: BLE001 — owner unreachable
+                    log.warning("gang %s: remote reserve on %s failed: %s",
+                                g.spec.name, placement.node, e)
+                    rep = {"status": "error"}
+                ok = rep.get("status") == "ok"
+                _MEMBER_RESERVES.inc(
+                    result="remote_ok" if ok else "remote_fail"
+                )
+                verdicts[placement.node] = {
+                    "fit": ok, "gang_member": muid,
+                    "reserve": "remote_ok" if ok else "remote_fail",
+                }
+                if not ok:
+                    # the commit may have LANDED owner-side even though we
+                    # saw an error (socket cut after the owner booked +
+                    # patched; commit never auto-replays — CAS).  Release
+                    # is idempotent, so always send it for the failing
+                    # member before rolling back the prior ones, or the
+                    # owner strands a booking no abort leg covers.
+                    self._release_remote(muid, placement.node)
+                    self._rollback(reservations)
+                    return "conflict", []
+                res = _MemberReservation(
+                    muid, mpod, placement.node, devices,
+                    rep.get("enc", enc), remote=True,
+                )
+                res.patched = True  # shard_commit patches owner-side
+                reservations.append(res)
+                continue
+            if not sched.usage_cache.try_book(
+                muid, placement.node, placement.generation, devices
+            ):
+                _MEMBER_RESERVES.inc(result="conflict")
+                verdicts[placement.node] = {
+                    "fit": False, "gang_member": muid, "reserve": "conflict",
+                }
+                self._rollback(reservations)
+                return "conflict", []
+            _MEMBER_RESERVES.inc(result="ok")
+            verdicts[placement.node] = {
+                "fit": True, "gang_member": muid, "reserve": "ok",
+                "shape": "x".join(map(str, placement.shape)),
+            }
+            # register with the pod manager exactly like _commit_booking:
+            # pending=True until the phase-2 patch lands; the annotations
+            # copy makes the eventual ingest replay a recognised no-op
+            fresh = dict(mpod)
+            fresh_annos = dict(get_annotations(mpod))
+            fresh_annos[annotations.ASSIGNED_IDS] = enc
+            fresh_annos[annotations.ASSIGNED_NODE] = placement.node
+            fresh["metadata"] = dict(
+                mpod["metadata"], annotations=fresh_annos
+            )
+            sched.pods.add_pod(fresh, placement.node, devices, pending=True)
+            reservations.append(_MemberReservation(
+                muid, mpod, placement.node, devices, enc, remote=False,
+            ))
+        return "ok", reservations
+
+    # -- phase 2: assignment patches ------------------------------------
+    def _commit_all(
+        self, g: _Gang, reservations
+    ) -> Tuple[Optional[str], Optional[str]]:
+        """Patch every local member's assignment annotations (remote
+        members were patched owner-side by shard_commit).  Returns
+        (error, failing member uid) on the first failure — the caller
+        rolls back and prunes the failing member."""
+        for r in reservations:
+            if r.remote:
+                continue
+            err = self.sched._patch_assignment(r.pod, r.uid, r.node, r.enc)
+            if err is not None:
+                return (
+                    f"gang {g.spec.name}: member {r.uid} assignment "
+                    f"patch failed: {err}"
+                ), r.uid
+            r.patched = True
+        return None, None
+
+    # -- rollback --------------------------------------------------------
+    def _rollback(self, reservations) -> None:
+        """Undo every reservation in reverse order: local bookings are
+        removed (unbooked via the pod-manager listener), patched members
+        get their assignment annotations nulled, remote members release
+        owner-side."""
+        sched = self.sched
+        for r in reversed(reservations):
+            if r.remote:
+                self._release_remote(r.uid, r.node)
+                continue
+            if r.patched:
+                sched.pods.rm_pod(r.uid)
+                try:
+                    sched.client.patch_pod_annotations(
+                        r.pod["metadata"].get("namespace", "default"),
+                        r.pod["metadata"]["name"],
+                        dict(ASSIGNMENT_CLEAR_PATCH),
+                    )
+                except Exception:  # noqa: BLE001 — auditor catches leftovers
+                    log.exception(
+                        "gang rollback: could not null assignment "
+                        "annotations of %s", r.uid,
+                    )
+            else:
+                sched.pods.rm_pod_if_pending(r.uid, r.node)
+
+    def _release_remote(self, uid: str, node: str) -> None:
+        shard = self.sched.shard
+        if shard is None:
+            return
+        rid = shard.ring.owner(node)
+        peer = shard.peers.get(rid)
+        if peer is None:
+            self.sched.shard_release(uid, node)
+            return
+        try:
+            peer.release(uid, node)
+        except Exception:  # noqa: BLE001 — auditor catches the leak
+            log.exception(
+                "gang rollback: remote release of %s on %s failed", uid, node
+            )

@@ -14,7 +14,7 @@ from typing import Dict, List, Set, Tuple
 
 from vtpu import obs
 from vtpu.obs import render_family
-from vtpu.device.topology import Topology, enumerate_rectangles
+from vtpu.device.topology import Topology, largest_rectangle
 from vtpu.scheduler.core import Scheduler
 from vtpu.scheduler.score import NodeUsage
 
@@ -71,10 +71,7 @@ def _largest_free_rectangle(nu: NodeUsage) -> int:
     if nu.topology and all(d.coords is not None for d in free):
         topo = Topology.from_spec(nu.topology)
         avail = frozenset(tuple(d.coords) for d in free)  # type: ignore[arg-type]
-        for size in range(len(free), 0, -1):
-            if next(enumerate_rectangles(topo, size, avail), None) is not None:
-                return size
-        return 0
+        return largest_rectangle(topo, avail)
     return len(free)
 
 

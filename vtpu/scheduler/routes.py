@@ -14,8 +14,9 @@ plus the debug surface on the plain listener: /spans, /timeline,
 /trace.json, /decisions, /events (the typed journal), /audit (the
 reconciliation verdict report, vtpu/audit), and the sharded-replica
 surface (vtpu/scheduler/shard.py): GET /shard (ring/ownership status),
-POST /shard/evaluate and /shard/commit (peer-replica subset evaluation
-and owner-side CAS commit — plain listener only, never the TLS port).
+POST /shard/evaluate, /shard/commit and /shard/release (peer-replica
+subset evaluation, owner-side CAS commit, and the gang-abort release —
+plain listener only, never the TLS port).
 
 Served by a stdlib ThreadingHTTPServer; the extender is pure
 request/response over in-memory state, so no framework is needed.
@@ -165,7 +166,8 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 n = 50
             recs = self.scheduler.decisions.query(
-                pod=params.get("pod") or None, n=n
+                pod=params.get("pod") or None,
+                gang=params.get("gang") or None, n=n,
             )
             self._send(200, json.dumps(
                 {"decisions": recs, "count": len(recs)}, default=str
@@ -233,6 +235,13 @@ class _Handler(BaseHTTPRequestHandler):
                     body.get("pod") or {},
                     body.get("node", ""),
                     int(body.get("gen", -1)),
+                    body.get("placement"),
+                )
+            elif self.path == "/shard/release" and self.allow_debug:
+                # owner-side reservation release: the abort leg of a
+                # cross-replica gang (vtpu/scheduler/gang.py rollback)
+                out = self.scheduler.shard_release(
+                    body.get("uid", ""), body.get("node", "")
                 )
             elif self.path == "/webhook":
                 out = handle_admission_review(body, self.scheduler.config)

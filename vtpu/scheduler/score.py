@@ -27,7 +27,7 @@ from typing import Dict, List, Optional, Tuple
 
 from vtpu.device.allocator import AllocationError, IciAllocator
 from vtpu.device.chip import Chip
-from vtpu.device.topology import Topology
+from vtpu.device.topology import Topology, largest_rectangle
 from vtpu.utils.types import (
     ChipInfo,
     ContainerDevice,
@@ -324,6 +324,61 @@ def score_node(node: NodeUsage, policy: str = "binpack") -> float:
         for d in node.devices
     ) / (2 * len(node.devices))
     return util if policy == "binpack" else 1.0 - util
+
+
+def bounding_shape(coords) -> Tuple[int, int, int]:
+    """Axis-aligned bounding-box dims of a coord set — for a rectangular
+    carve this IS its shape, which is what ``slice_affinity`` wants as
+    ``compact_shape``."""
+    xs, ys, zs = zip(*(tuple(c) for c in coords))
+    return (
+        max(xs) - min(xs) + 1,
+        max(ys) - min(ys) + 1,
+        max(zs) - min(zs) + 1,
+    )
+
+
+def slice_affinity(
+    topology_spec: str, free, chosen, compact_shape=None
+) -> float:
+    """Slice-affinity term for gang placement (higher wins, ≤ 1.0):
+    prefers compact low-hop carvings and penalizes fragmenting a node's
+    large contiguous free blocks.
+
+    Two penalties against the pre-carve free-set:
+
+    - **shatter**: how much the node's largest contiguous free rectangle
+      shrinks (``before − after``, clamped at 0) — carving chips out of
+      the only big block scores worse than consuming an already-isolated
+      block of the same size, which is the multi-objective
+      fragmentation-vs-affinity trade-off shape (PAPERS.md, MIG
+      placement).  Exact-fit consumption of a big block is penalized
+      too, but the ranking is only ever *between* candidate carvings of
+      the same size, where the block-preserving alternative wins;
+    - **strand**: free chips left ICI-isolated (no free neighbour) —
+      stranded singletons can never serve a future gang.
+
+    ``compact_shape`` (the carve's box dims) adds the low-hop preference:
+    its normalized compactness is averaged in, so among equal-
+    fragmentation carvings the squarer rectangle wins.
+    """
+    from vtpu.device.topology import compactness as _compactness
+
+    topo = Topology.from_spec(topology_spec)
+    free_set = frozenset(tuple(c) for c in free)
+    chosen_set = frozenset(tuple(c) for c in chosen)
+    after = free_set - chosen_set
+    before_rect = largest_rectangle(topo, free_set)
+    after_rect = largest_rectangle(topo, after)
+    shatter = max(0, before_rect - after_rect)
+    stranded = sum(
+        1 for c in after if not any(n in after for n in topo.neighbors(c))
+    )
+    n = max(1, topo.num_chips)
+    score = 1.0 - (shatter + stranded) / n
+    if compact_shape is not None:
+        score = (score + _compactness(tuple(compact_shape))) / 2.0
+    return score
 
 
 def snapshot(node_name: str, devices: List[DeviceUsage], topology: str) -> NodeUsage:

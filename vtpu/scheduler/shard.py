@@ -154,8 +154,12 @@ class LocalPeer:
     def evaluate(self, pod: dict, node_names: Optional[List[str]]) -> dict:
         return self.sched.shard_evaluate(pod, node_names)
 
-    def commit(self, pod: dict, node: str, gen: int) -> dict:
-        return self.sched.shard_commit(pod, node, gen)
+    def commit(self, pod: dict, node: str, gen: int,
+               placement_enc: Optional[str] = None) -> dict:
+        return self.sched.shard_commit(pod, node, gen, placement_enc)
+
+    def release(self, uid: str, node: str) -> dict:
+        return self.sched.shard_release(uid, node)
 
 
 class HttpPeer:
@@ -270,10 +274,21 @@ class HttpPeer:
         return self._post("/shard/evaluate",
                           {"pod": pod, "nodes": node_names}, idempotent=True)
 
-    def commit(self, pod: dict, node: str, gen: int) -> dict:
+    def commit(self, pod: dict, node: str, gen: int,
+               placement_enc: Optional[str] = None) -> dict:
+        body = {"pod": pod, "node": node, "gen": gen}
+        if placement_enc is not None:
+            # gang reserve: the coordinator pins the exact planned
+            # sub-rectangle; the owner validates and CAS-books it
+            body["placement"] = placement_enc
+        return self._post("/shard/commit", body, idempotent=False)
+
+    def release(self, uid: str, node: str) -> dict:
+        """Gang-abort release at the owner (POST /shard/release).
+        Idempotent by design — releasing an absent booking is a no-op —
+        so a stale-connection retry is safe, unlike commit."""
         return self._post(
-            "/shard/commit", {"pod": pod, "node": node, "gen": gen},
-            idempotent=False,
+            "/shard/release", {"uid": uid, "node": node}, idempotent=True
         )
 
 

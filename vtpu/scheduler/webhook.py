@@ -1,7 +1,13 @@
 """Mutating admission webhook (ref: pkg/scheduler/webhook.go:53-116).
 
 Steers vtpu pods to the extender's scheduler profile and injects the
-priority env.  Emits an AdmissionReview response with a base64 JSON patch.
+priority env.  Gang specs (vtpu.io/gang-* annotations,
+vtpu/scheduler/gang.py) are validated here at admission — a malformed
+spec gets a warning the author sees at `kubectl apply` time instead of a
+silent filter error — and the desired-mesh annotation is normalized to
+canonical ``AxBxC`` form so the registry's spec compare is
+string-stable.  Emits an AdmissionReview response with a base64 JSON
+patch.
 """
 
 from __future__ import annotations
@@ -32,6 +38,37 @@ from vtpu.utils.types import PRESTART_PROGRAM  # noqa: E402  (re-export)
 
 def _container_is_privileged(ctr: dict) -> bool:
     return bool((ctr.get("securityContext") or {}).get("privileged"))
+
+
+def _json_pointer_escape(key: str) -> str:
+    """RFC 6901 escaping for annotation keys in JSON-patch paths."""
+    return key.replace("~", "~0").replace("/", "~1")
+
+
+def gang_ops(pod: dict) -> List[dict]:
+    """JSON-patch ops normalizing a pod's gang annotations: the desired
+    mesh shape is rewritten to canonical ``AxBxC`` (``"4x4"`` →
+    ``"4x4x1"``).  Raises ValueError on a malformed spec — the caller
+    surfaces it as an admission warning (never a block: the filter
+    re-validates and rejects with the same message at schedule time)."""
+    from vtpu.scheduler import gang as gang_mod
+
+    annos = pod.get("metadata", {}).get("annotations") or {}
+    spec = gang_mod.parse_gang_spec(annos)  # ValueError on malformed
+    if spec is None:
+        return []
+    ops: List[dict] = []
+    mesh_raw = (annos.get(gang_mod.GANG_MESH) or "").strip()
+    if mesh_raw:
+        canon = gang_mod.canonical_mesh(mesh_raw)
+        if canon != mesh_raw:
+            ops.append({
+                "op": "replace",
+                "path": "/metadata/annotations/"
+                        + _json_pointer_escape(gang_mod.GANG_MESH),
+                "value": canon,
+            })
+    return ops
 
 
 def mutate_pod(pod: dict, config: SchedulerConfig) -> List[dict]:
@@ -113,12 +150,20 @@ def handle_admission_review(body: dict, config: SchedulerConfig) -> dict:
     try:
         if pod.get("kind", "Pod") == "Pod" and pod_requests_any(pod):
             ops = mutate_pod(pod, config)
+            try:
+                ops += gang_ops(pod)
+            except ValueError as e:
+                # malformed gang spec: admit (the filter rejects it with
+                # the same message) but warn at apply time
+                response.setdefault("warnings", []).append(
+                    f"vtpu gang spec invalid: {e}"
+                )
             if ops:
                 response["patchType"] = "JSONPatch"
                 response["patch"] = base64.b64encode(json.dumps(ops).encode()).decode()
     except Exception as e:  # noqa: BLE001 — admission must not block pod creation
         log.exception("webhook mutation failed; admitting unmodified")
-        response["warnings"] = [f"vtpu webhook error: {e}"]
+        response.setdefault("warnings", []).append(f"vtpu webhook error: {e}")
     return {
         "apiVersion": body.get("apiVersion", "admission.k8s.io/v1"),
         "kind": "AdmissionReview",
