@@ -7,8 +7,8 @@ IMG ?= vtpu/vtpu
 PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
-	bench-sched bench-serve bench-churn bench-disagg bench-gang obs-lint \
-	audit-check image chart clean tidy
+	bench-sched bench-serve bench-churn bench-disagg bench-gang \
+	bench-goodput obs-lint config-lint audit-check image chart clean tidy
 
 all: build
 
@@ -125,6 +125,11 @@ obs-lint:
 	JAX_PLATFORMS=cpu $(PY) hack/obs_lint.py
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py -q -k "conformance or golden"
 
+# env-var docs drift: every quoted VTPU_* literal under vtpu/ must be
+# documented in docs/config.md (the env surface grows every PR)
+config-lint:
+	$(PY) hack/config_lint.py
+
 # reconciliation golden: one auditor pass over the seeded fake cluster
 # (all four drift classes), fetched through GET /audit and diffed against
 # tests/golden/audit_report.json (regen: hack/audit_check.py --regen)
@@ -175,6 +180,21 @@ ifdef SMOKE
 	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_gang.py --smoke
 else
 	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_gang.py
+endif
+
+# utilization-loop goodput proof: mixed guaranteed/best-effort open-loop
+# workload at 1.5–2× booked oversubscription, three arms
+# (guaranteed_solo / static_partition / utilization_loop) through the
+# real filter + overlay + arbiter + eviction reconciler →
+# docs/artifacts/scheduler_goodput.json (docs/scheduler_perf.md
+# §Utilization-aware scoring explains the numbers).  SMOKE=1 runs a
+# seconds-long schema sanity pass (tier-1 safe; also exercised by
+# tests/test_score_measured.py).
+bench-goodput:
+ifdef SMOKE
+	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_goodput.py --smoke
+else
+	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_goodput.py
 endif
 
 # prefill/decode disaggregation proof: real-topology token-exactness +

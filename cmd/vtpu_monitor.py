@@ -91,7 +91,9 @@ def main(argv=None) -> int:
     rpc_srv, _ = serve_noderpc(pm, bind=args.noderpc_bind)
     fb = None
     if not args.disable_feedback:
-        fb = FeedbackLoop(pm, args.feedback_interval)
+        fb = FeedbackLoop(
+            pm, args.feedback_interval, client=client, pods_fn=pods_fn
+        )
         fb.start()
 
         from vtpu.obs.ready import readiness

@@ -42,6 +42,7 @@ def test_seeded_cluster_flags_all_four_classes():
         "overcommit_nodes": 1,
         "stale_nodes": 1,
         "partial_gang_bookings": 0,
+        "leaked_overlay_bookings": 0,
     }
     # every finding journals a DriftDetected event
     recs = ev.journal().query(type="DriftDetected", n=10_000)
@@ -120,6 +121,7 @@ def test_clean_cluster_audits_clean():
         "leaked_bookings": 0, "orphaned_region_bytes": 0,
         "overcommit_nodes": 0, "stale_nodes": 0,
         "partial_gang_bookings": 0,
+        "leaked_overlay_bookings": 0,
     }
     reg = registry("scheduler")
     assert reg.gauge("vtpu_audit_leaked_bookings_total", "t").value(node="clean1") == 0

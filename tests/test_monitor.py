@@ -938,3 +938,234 @@ def test_feedback_pass_instrumented(tmp_path):
     assert fails.value() == fbefore + 1
     pm.entries.pop("boom", None)
     pm.close()
+
+
+# -- feedback arbiter: squeeze ladder + eviction requests -----------------
+
+
+def _contention_setup(root):
+    """One guaranteed (prio 1) + one best-effort (prio 2) region."""
+    make_container_region(root, "pod-g", pid=11, priority=1)
+    make_container_region(root, "pod-be", n="1", pid=22, priority=2)
+    pm = PathMonitor(root)
+    pm.scan()
+    return pm, pm.entries["pod-g_0"], pm.entries["pod-be_1"]
+
+
+def _mark_active(*entries):
+    for e in entries:
+        e.region.region.recent_kernel = 10
+
+
+def test_arbiter_walks_besteffort_down_the_squeeze_ladder(tmp_path):
+    from vtpu.monitor.feedback import ContentionArbiter
+    from vtpu.monitor.shared_region import THROTTLE_LEVEL_MAX
+
+    pm, g, be = _contention_setup(str(tmp_path))
+    t = [100.0]
+    arb = ContentionArbiter(evict_after_s=1e9, clock=lambda: t[0])
+    levels = []
+    for _ in range(4):
+        _mark_active(g, be)  # sustained contention
+        arb.observe(pm)
+        levels.append(be.region.region.utilization_switch)
+        t[0] += 5
+    assert levels == [2, 3, 4, 4]  # graduated, capped at the max level
+    assert g.region.region.utilization_switch == 0  # guaranteed untouched
+    # contention clears (guaranteed gone quiet; best-effort alone):
+    # full restore, streak reset
+    g.region.region.recent_kernel = 0
+    _mark_active(be)
+    arb.observe(pm)
+    assert be.region.region.utilization_switch == 0
+    assert arb._contention_since == {}
+    pm.close()
+
+
+def test_arbiter_requests_eviction_after_sustained_contention(tmp_path):
+    from vtpu import obs
+    from vtpu.k8s import FakeClient, new_pod
+    from vtpu.monitor.feedback import ContentionArbiter
+    from vtpu.obs import events as ev
+    from vtpu.utils.types import annotations as A
+
+    pm, g, be = _contention_setup(str(tmp_path))
+    client = FakeClient()
+    client.create_pod(new_pod("be-pod", uid="pod-be",
+                              annotations={A.QOS: "best-effort"}))
+    pods_fn = lambda: {  # noqa: E731
+        p["metadata"]["uid"]: p for p in client.list_pods()
+    }
+    t = [100.0]
+    arb = ContentionArbiter(client=client, pods_fn=pods_fn, evict_after_s=10,
+                            clock=lambda: t[0])
+    reqs = obs.registry("monitor")._instruments[
+        "vtpu_preempt_evict_requests_total"]
+    before = reqs.value()
+    for _ in range(4):  # 15 s of contention > evict_after_s=10
+        _mark_active(g, be)
+        arb.observe(pm)
+        t[0] += 5
+    annos = client.list_pods()[0]["metadata"]["annotations"]
+    assert annos[A.EVICT_REQUESTED].startswith("besteffort_contention_")
+    # one-shot per episode: 4 passes, ONE patch + counter bump + event
+    assert reqs.value() == before + 1
+    recs = ev.journal().query(type="EvictRequested", n=50)
+    assert any(r["pod"] == "pod-be" and r["patched"] for r in recs)
+    pm.close()
+
+
+def test_arbiter_flips_are_journaled_and_counted(tmp_path):
+    from vtpu import obs
+    from vtpu.monitor.feedback import ContentionArbiter
+    from vtpu.obs import events as ev
+
+    pm, g, be = _contention_setup(str(tmp_path))
+    flips = obs.registry("monitor")._instruments[
+        "vtpu_preempt_throttle_transitions_total"]
+    before_sq = flips.value(to="squeeze_2")
+    before_re = flips.value(to="enforce")
+    arb = ContentionArbiter(evict_after_s=1e9, clock=lambda: 100.0)
+    _mark_active(g, be)
+    arb.observe(pm)          # 0 → squeeze_2
+    g.region.region.recent_kernel = 0
+    be.region.region.recent_kernel = 0
+    arb.observe(pm)          # activity gone: contention over → 2 → 0
+    assert flips.value(to="squeeze_2") == before_sq + 1
+    assert flips.value(to="enforce") == before_re + 1
+    recs = ev.journal().query(type="ThrottleChanged", n=50)
+    ours = [r for r in recs if r["pod"] == "pod-be"]
+    assert [(r["prev"], r["now"]) for r in ours[-2:]] == [
+        ("enforce", "squeeze_2"), ("squeeze_2", "enforce"),
+    ]
+    pm.close()
+
+
+def test_arbiter_spares_idle_besteffort_cotenant(tmp_path):
+    """Contention is global but consequences are per-tenant: a best-effort
+    region that is ITSELF idle is neither squeezed nor put on the
+    eviction clock just because a sibling suppressed the guaranteed
+    tier."""
+    from vtpu.monitor.feedback import ContentionArbiter
+
+    root = str(tmp_path)
+    make_container_region(root, "pod-g", pid=11, priority=1)
+    make_container_region(root, "pod-be-busy", n="1", pid=22, priority=2)
+    make_container_region(root, "pod-be-idle", n="2", pid=33, priority=2)
+    pm = PathMonitor(root)
+    pm.scan()
+    g = pm.entries["pod-g_0"]
+    busy = pm.entries["pod-be-busy_1"]
+    idle = pm.entries["pod-be-idle_2"]
+    t = [100.0]
+    arb = ContentionArbiter(evict_after_s=10, clock=lambda: t[0])
+    for _ in range(4):  # 15 s > evict_after_s, idle tenant stays idle
+        _mark_active(g, busy)
+        arb.observe(pm)
+        t[0] += 5
+    assert busy.region.region.utilization_switch >= 2   # squeezed
+    assert idle.region.region.utilization_switch == 0   # untouched
+    assert "pod-be-idle_2" not in arb._contention_since
+    assert "pod-be-idle" not in arb._evict_requested
+    assert "pod-be-busy" in arb._evict_requested        # the real culprit
+    pm.close()
+
+
+def test_arbiter_oneshot_survives_idle_sibling_region(tmp_path):
+    """A pod with one busy and one idle best-effort region: the idle
+    sibling must not clear the pod-level eviction one-shot, or the busy
+    region would re-patch the API every pass."""
+    from vtpu import obs
+    from vtpu.k8s import FakeClient, new_pod
+    from vtpu.monitor.feedback import ContentionArbiter
+    from vtpu.utils.types import annotations as A
+
+    root = str(tmp_path)
+    make_container_region(root, "pod-g", pid=11, priority=1)
+    make_container_region(root, "pod-be", n="1", pid=22, priority=2)  # busy
+    make_container_region(root, "pod-be", n="2", pid=23, priority=2)  # idle
+    pm = PathMonitor(root)
+    pm.scan()
+    g = pm.entries["pod-g_0"]
+    busy = pm.entries["pod-be_1"]
+    client = FakeClient()
+    client.create_pod(new_pod("be-pod", uid="pod-be",
+                              annotations={A.QOS: "best-effort"}))
+    pods_fn = lambda: {  # noqa: E731
+        p["metadata"]["uid"]: p for p in client.list_pods()
+    }
+    t = [100.0]
+    arb = ContentionArbiter(client=client, pods_fn=pods_fn, evict_after_s=10,
+                            clock=lambda: t[0])
+    reqs = obs.registry("monitor")._instruments[
+        "vtpu_preempt_evict_requests_total"]
+    before = reqs.value()
+    for _ in range(6):  # idle sibling observed on every pass
+        _mark_active(g, busy)
+        arb.observe(pm)
+        t[0] += 5
+    assert reqs.value() == before + 1  # still one-shot, no patch churn
+    assert arb._evict_requested.get("pod-be") == "pod-be_1"
+    pm.close()
+
+
+def test_arbiter_retries_evict_patch_on_transient_list_miss(tmp_path):
+    """A pods_fn snapshot that transiently misses the pod must not burn
+    the episode's one-shot: no counter/event/annotation on the miss, and
+    the patch lands on the next pass once the pod shows up."""
+    from vtpu import obs
+    from vtpu.k8s import FakeClient, new_pod
+    from vtpu.monitor.feedback import ContentionArbiter
+    from vtpu.utils.types import annotations as A
+
+    pm, g, be = _contention_setup(str(tmp_path))
+    client = FakeClient()
+    client.create_pod(new_pod("be-pod", uid="pod-be",
+                              annotations={A.QOS: "best-effort"}))
+    snapshots = [{}]  # first lookup: API lag, pod missing
+
+    def pods_fn():
+        if snapshots:
+            return snapshots.pop()
+        return {p["metadata"]["uid"]: p for p in client.list_pods()}
+
+    t = [100.0]
+    arb = ContentionArbiter(client=client, pods_fn=pods_fn, evict_after_s=10,
+                            clock=lambda: t[0])
+    reqs = obs.registry("monitor")._instruments[
+        "vtpu_preempt_evict_requests_total"]
+    before = reqs.value()
+    for _ in range(3):  # pass 3 crosses evict_after_s → hits the empty snapshot
+        _mark_active(g, be)
+        arb.observe(pm)
+        t[0] += 5
+    annos = client.list_pods()[0]["metadata"]["annotations"]
+    assert A.EVICT_REQUESTED not in annos and reqs.value() == before
+    assert "pod-be" not in arb._evict_requested  # retry armed
+    _mark_active(g, be)
+    arb.observe(pm)  # snapshot now sees the pod: patch lands
+    annos = client.list_pods()[0]["metadata"]["annotations"]
+    assert annos[A.EVICT_REQUESTED].startswith("besteffort_contention_")
+    assert reqs.value() == before + 1
+    # the evicted tenant's region vanishing purges the one-shot mark
+    # (no unbounded uid accumulation under best-effort churn)
+    import shutil
+
+    shutil.rmtree(os.path.join(str(tmp_path), "pod-be_1"))
+    pm.scan()
+    arb.observe(pm)
+    assert "pod-be" not in arb._evict_requested
+    pm.close()
+
+
+def test_activity_threshold_env_override(tmp_path, monkeypatch):
+    from vtpu.monitor.feedback import ContentionArbiter
+
+    monkeypatch.setenv("VTPU_FEEDBACK_ACTIVITY_THRESHOLD", "50")
+    pm, g, be = _contention_setup(str(tmp_path))
+    arb = ContentionArbiter(evict_after_s=1e9, clock=lambda: 100.0)
+    assert arb.activity_threshold == 50
+    _mark_active(g, be)  # recent_kernel 10 < 50: NOT "recently active"
+    arb.observe(pm)
+    assert be.region.region.utilization_switch == 0  # no contention seen
+    pm.close()

@@ -1,0 +1,585 @@
+"""The utilization loop, scheduler side (docs/scheduler_perf.md
+§Utilization-aware scoring / §Best-effort oversubscription):
+
+- measured-headroom blending in the score path — table-tested across
+  binpack/spread × fresh/stale/absent ``vtpu.io/node-utilization``
+  snapshots, pinning that a STALE annotation never changes the
+  booked-only ranking;
+- best-effort overlay admission: every gate (freshness, sustained idle,
+  overlay capacity), strict ledger separation from guaranteed booking
+  math (cache == oracle throughout), auditor classification, and the
+  eviction reconciler;
+- the acceptance soak: threaded best-effort admissions × idle-streak
+  breaks × evictions × guaranteed churn ends with cache == oracle and
+  ZERO residual overlay entries.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tests.golden_scenarios import seed_fake_node_group
+from tests.test_usage_cache import assert_cache_equals_oracle
+from vtpu.k8s import FakeClient, new_pod
+from vtpu.scheduler import Scheduler, SchedulerConfig
+from vtpu.scheduler.score import blend_measured, measured_headroom
+from vtpu.scheduler.webhook import qos_ops, validate_qos
+from vtpu.utils.types import QosClass, annotations as A, resources as R
+
+
+def _payload(uuids, duty, ts):
+    return {
+        "v": 1, "ts": ts,
+        "devices": {u: {"duty": duty, "hbm_peak": 0} for u in uuids},
+        "pods": {},
+    }
+
+
+def _sched(nodes=2, **cfg):
+    client = FakeClient()
+    names = seed_fake_node_group(client, nodes)
+    cfg.setdefault("http_bind", "127.0.0.1:0")
+    s = Scheduler(client, SchedulerConfig(**cfg))
+    s.register_from_node_annotations()
+    return client, s, names
+
+
+def _chip_uuids(s, node):
+    return [d.uuid for d in s.inspect_usage()[node].devices]
+
+
+def _mark_idle(s, node, now, duty=0.05, window=40.0):
+    """Two write-backs ``window`` apart: the second is fresh at ``now``
+    and the idle streak is long enough for the default 30 s gate."""
+    uuids = _chip_uuids(s, node)
+    s.usage_cache.note_node_utilization(node, _payload(uuids, duty, now - window))
+    s.usage_cache.note_node_utilization(node, _payload(uuids, duty, now))
+
+
+def _be_pod(name, chips=1, mem_pct=25, cores=25):
+    return new_pod(
+        name, uid=f"uid-{name}", annotations={A.QOS: QosClass.BEST_EFFORT},
+        containers=[{"name": "m", "resources": {"limits": {
+            R.chip: chips, R.memory_percentage: mem_pct, R.cores: cores,
+        }}}],
+    )
+
+
+def _g_pod(name, chips=1, mem_pct=25, cores=25):
+    return new_pod(
+        name, uid=f"uid-{name}",
+        containers=[{"name": "m", "resources": {"limits": {
+            R.chip: chips, R.memory_percentage: mem_pct, R.cores: cores,
+        }}}],
+    )
+
+
+# -- blend_measured / measured_headroom unit behaviour --------------------
+
+
+def test_measured_headroom_mean_and_malformed():
+    assert measured_headroom(None) is None
+    assert measured_headroom({"devices": {}}) is None
+    assert measured_headroom({"devices": {"a": {"duty": "bogus"}}}) is None
+    p = {"devices": {"a": {"duty": 0.25}, "b": {"duty": 0.75}}}
+    assert measured_headroom(p) == pytest.approx(0.5)
+    # clamped: duty past 1.0 (suspend overrun) cannot go negative
+    assert measured_headroom({"devices": {"a": {"duty": 1.7}}}) == 0.0
+
+
+def test_blend_weight_zero_and_absent_payload_are_booked_only():
+    assert blend_measured(0.42, None, 100.0, 60.0, 0.5) == (0.42, None)
+    assert blend_measured(0.42, {"devices": {}}, 100.0, 60.0, 0.0) == (
+        0.42, None,
+    )
+    # unusable ts → booked-only, no audit record
+    s, info = blend_measured(0.42, {"devices": {"a": {"duty": 0}}},
+                             100.0, 60.0, 0.5)
+    assert s == 0.42 and info is None
+
+
+def test_blend_is_decayed_and_staleness_gated():
+    payload = {"ts": 100.0, "devices": {"a": {"duty": 0.0}}}  # headroom 1.0
+    # fresh (age 0): full weight pulls toward headroom
+    s, info = blend_measured(0.0, payload, 100.0, 60.0, 0.5)
+    assert s == pytest.approx(0.5) and info["stale"] is False
+    # half-aged: weight decays linearly → 0.25
+    s, _ = blend_measured(0.0, payload, 130.0, 60.0, 0.5)
+    assert s == pytest.approx(0.25)
+    # at/past the gate: booked-only, recorded as stale with weight 0
+    s, info = blend_measured(0.0, payload, 160.0, 60.0, 0.5)
+    assert s == 0.0 and info == {"stale": True, "age_s": 60.0, "weight": 0.0}
+
+
+# -- score-policy table test: fresh/stale/absent never break booked-only --
+
+
+@pytest.mark.parametrize("policy", ["binpack", "spread"])
+@pytest.mark.parametrize("snapshot", ["fresh", "stale", "absent"])
+def test_policy_ranking_vs_measured_snapshots(policy, snapshot):
+    """Two nodes, one partially booked.  Booked-only ranking: binpack
+    prefers the loaded node, spread the empty one.  A FRESH snapshot
+    saying the booked-preferred node is actually flat-out busy (duty 1)
+    while the other sat idle flips the choice; a STALE or ABSENT
+    snapshot must leave the booked-only ranking untouched."""
+    client, s, names = _sched(
+        nodes=2, node_scheduler_policy=policy, score_measured_weight=0.8,
+    )
+    loader = _g_pod("loader", chips=1, mem_pct=50, cores=50)
+    client.create_pod(loader)
+    assert s.filter(loader, [names[0]]).node == names[0]
+
+    booked_pick = names[0] if policy == "binpack" else names[1]
+    flip_pick = names[1] if policy == "binpack" else names[0]
+    now = time.time()
+    if snapshot != "absent":
+        ts = now if snapshot == "fresh" else now - 3600.0
+        s.usage_cache.note_node_utilization(
+            booked_pick, _payload(_chip_uuids(s, booked_pick), 1.0, ts))
+        s.usage_cache.note_node_utilization(
+            flip_pick, _payload(_chip_uuids(s, flip_pick), 0.0, ts))
+    probe = _g_pod(f"probe-{policy}-{snapshot}")
+    client.create_pod(probe)
+    res = s.filter(probe, names)
+    want = flip_pick if snapshot == "fresh" else booked_pick
+    assert res.node == want, (policy, snapshot, res)
+    # the decision audit log records what the blend consumed
+    rec = s.decisions.query(pod=f"uid-probe-{policy}-{snapshot}")[0]
+    minfo = rec["verdicts"][res.node].get("measured")
+    if snapshot == "fresh":
+        assert minfo is not None and minfo["stale"] is False
+        assert minfo["weight"] > 0
+    elif snapshot == "stale":
+        assert minfo is not None and minfo["stale"] is True
+        assert minfo["weight"] == 0.0
+    else:
+        assert minfo is None
+
+
+# -- best-effort overlay admission gates ----------------------------------
+
+
+def test_besteffort_rejected_without_measurement_or_stale():
+    client, s, names = _sched(nodes=1)
+    pod = _be_pod("be-nomeas")
+    client.create_pod(pod)
+    res = s.filter(pod, names)
+    assert res.node is None
+    assert res.failed[names[0]] == "no utilization measurement"
+    # a stale measurement is just as disqualifying
+    _mark_idle(s, names[0], now=time.time() - 3600.0)
+    res = s.filter(pod, names)
+    assert res.node is None
+    assert "stale" in res.failed[names[0]]
+
+
+def test_besteffort_requires_sustained_idle_window():
+    client, s, names = _sched(nodes=1)
+    now = time.time()
+    uuids = _chip_uuids(s, names[0])
+    # busy until 5 s ago, idle only since then: streak too short
+    s.usage_cache.note_node_utilization(names[0], _payload(uuids, 0.9, now - 5))
+    s.usage_cache.note_node_utilization(names[0], _payload(uuids, 0.05, now))
+    pod = _be_pod("be-short")
+    client.create_pod(pod)
+    res = s.filter(pod, names)
+    assert res.node is None
+    assert "idle" in res.failed[names[0]]
+    # a busy chip above the duty threshold never qualifies at all
+    s.usage_cache.note_node_utilization(
+        names[0], _payload(uuids, 0.9, now + 40))
+    res = s.filter(pod, names)
+    assert res.node is None
+
+
+def test_besteffort_admits_above_booked_capacity_and_ledgers_stay_separate():
+    """The whole point: a node whose chips are fully BOOKED but measured
+    idle still admits a best-effort pod — into the overlay ledger only,
+    leaving the guaranteed aggregates and the oracle untouched."""
+    client, s, names = _sched(nodes=1)
+    # fully book every chip with exclusive guaranteed pods
+    usage = s.inspect_usage()[names[0]]
+    for i in range(len(usage.devices)):
+        g = _g_pod(f"full-{i}", chips=1, mem_pct=100, cores=100)
+        client.create_pod(g)
+        assert s.filter(g, names).node == names[0]
+    # a further guaranteed pod no longer fits
+    g_extra = _g_pod("g-extra")
+    client.create_pod(g_extra)
+    assert s.filter(g_extra, names).node is None
+    # ... but a best-effort pod rides the overlay on the measured-idle chips
+    _mark_idle(s, names[0], now=time.time())
+    be = _be_pod("be-over", chips=2, mem_pct=25, cores=25)
+    client.create_pod(be)
+    res = s.filter(be, names)
+    assert res.node == names[0], res
+    overlay = s.usage_cache.overlay_snapshot()
+    assert set(overlay) == {"uid-be-over"}
+    assert "uid-be-over" not in s.usage_cache.bookings_snapshot()
+    assert_cache_equals_oracle(s)
+    # decision log took the besteffort path and recorded measured inputs
+    rec = s.decisions.query(pod="uid-be-over")[0]
+    assert rec["path"] == "besteffort" and rec["qos"] == "best-effort"
+    assert rec["verdicts"][names[0]]["measured"]["headroom"] > 0.9
+    # the auditor classifies a live overlay booking as clean — and never
+    # as overcommit, even with every chip at 100% booked + overlay on top
+    report = s.auditor.audit_once()
+    classes = [d["class"] for d in report["nodes"][names[0]]["drifts"]]
+    assert "overcommit" not in classes and "leaked_booking" not in classes
+
+
+def test_besteffort_overlay_capacity_cap_is_enforced():
+    client, s, names = _sched(nodes=1)
+    _mark_idle(s, names[0], now=time.time())
+    n_chips = len(_chip_uuids(s, names[0]))
+    # overlay cores cap: 2 × 50% per chip → the (2n+1)-th 50% share
+    # cannot fit anywhere
+    for i in range(2 * n_chips):
+        pod = _be_pod(f"be-cap-{i}", cores=50, mem_pct=10)
+        client.create_pod(pod)
+        assert s.filter(pod, names).node == names[0], i
+    last = _be_pod("be-cap-last", cores=50, mem_pct=10)
+    client.create_pod(last)
+    res = s.filter(last, names)
+    assert res.node is None
+    assert len(s.usage_cache.overlay_snapshot()) == 2 * n_chips
+    assert_cache_equals_oracle(s)
+
+
+def test_besteffort_refilter_replaces_own_overlay_booking():
+    """A re-filtered best-effort pod whose request exceeds half a chip's
+    overlay capacity must not be rejected by its OWN previous booking:
+    planning and commit both exclude it, and the replacement is atomic."""
+    client, s, names = _sched(nodes=1)
+    _mark_idle(s, names[0], now=time.time())
+    be = _be_pod("be-big", chips=1, mem_pct=80, cores=80)
+    client.create_pod(be)
+    assert s.filter(be, names).node == names[0]
+    first = s.usage_cache.overlay_snapshot()["uid-be-big"]
+    # re-filter (e.g. re-queued before the bind-failure ingest lands):
+    # 80% + 80% > 100% of the chip, so counting itself would reject
+    s.usage_cache.note_node_utilization(
+        names[0], _payload(_chip_uuids(s, names[0]), 0.05, time.time())
+    )
+    res = s.filter(be, names)
+    assert res.node == names[0], res
+    overlay = s.usage_cache.overlay_snapshot()
+    assert set(overlay) == {"uid-be-big"}  # replaced, not duplicated
+    assert_cache_equals_oracle(s)
+    # and a rejected re-filter restores the previous booking instead of
+    # dropping it: break the idle streak so every gate fails
+    s.usage_cache.note_node_utilization(
+        names[0], _payload(_chip_uuids(s, names[0]), 0.9, time.time())
+    )
+    assert s.filter(be, names).node is None
+    assert s.usage_cache.overlay_snapshot()["uid-be-big"] == first
+    assert_cache_equals_oracle(s)
+
+
+def test_qos_flip_keeps_one_ledger_per_pod():
+    """A pod re-ingested under the other tier moves ledgers atomically —
+    never holds both a guaranteed booking and an overlay entry."""
+    client, s, names = _sched(nodes=1)
+    _mark_idle(s, names[0], now=time.time())
+    be = _be_pod("be-flip")
+    client.create_pod(be)
+    assert s.filter(be, names).node == names[0]
+    assert "uid-be-flip" in s.usage_cache.overlay_snapshot()
+    # same uid replayed as guaranteed (annotation dropped, e.g. operator
+    # edit): the overlay entry must die with the tier change
+    devices = s.usage_cache.overlay_snapshot()["uid-be-flip"][1]
+    s.usage_cache.on_pod_changed("uid-be-flip", names[0], devices,
+                                 qos="guaranteed")
+    assert "uid-be-flip" not in s.usage_cache.overlay_snapshot()
+    assert "uid-be-flip" in s.usage_cache.bookings_snapshot()
+    # and back: booking guaranteed→best-effort clears the guaranteed leg
+    s.usage_cache.on_pod_changed("uid-be-flip", names[0], devices,
+                                 qos="best-effort")
+    assert "uid-be-flip" in s.usage_cache.overlay_snapshot()
+    assert "uid-be-flip" not in s.usage_cache.bookings_snapshot()
+    assert_cache_equals_oracle(s)
+
+
+# -- eviction reconciler --------------------------------------------------
+
+
+def test_eviction_reconciler_deletes_and_releases_overlay():
+    from vtpu.obs import events as ev
+
+    client, s, names = _sched(nodes=1)
+    _mark_idle(s, names[0], now=time.time())
+    be = _be_pod("be-evict")
+    client.create_pod(be)
+    assert s.filter(be, names).node == names[0]
+    client.patch_pod_annotations(
+        "default", "be-evict",
+        {A.EVICT_REQUESTED: "besteffort_contention_1785738400"},
+    )
+    assert s.reconcile_evictions() == 1
+    assert s.usage_cache.overlay_snapshot() == {}
+    assert all(
+        p["metadata"]["name"] != "be-evict" for p in client.list_pods()
+    )
+    recs = ev.journal().query(type="PodEvicted", n=50)
+    assert any(r["pod"] == "uid-be-evict" for r in recs)
+    # idempotent: a second pass finds nothing
+    assert s.reconcile_evictions() == 0
+
+
+def test_eviction_request_on_guaranteed_pod_is_ignored():
+    client, s, names = _sched(nodes=1)
+    g = _g_pod("g-keep")
+    client.create_pod(g)
+    assert s.filter(g, names).node == names[0]
+    client.patch_pod_annotations(
+        "default", "g-keep", {A.EVICT_REQUESTED: "besteffort_contention_1"})
+    assert s.reconcile_evictions() == 0
+    assert any(p["metadata"]["name"] == "g-keep" for p in client.list_pods())
+    assert "uid-g-keep" in s.usage_cache.bookings_snapshot()
+
+
+def test_leaked_overlay_is_its_own_audit_class():
+    client, s, names = _sched(nodes=1)
+    _mark_idle(s, names[0], now=time.time())
+    be = _be_pod("be-leak")
+    client.create_pod(be)
+    assert s.filter(be, names).node == names[0]
+    s.pods.confirm_pod("uid-be-leak", names[0])  # patch landed: no grace
+    client.delete_pod("default", "be-leak")  # vanishes without an ingest
+    report = s.auditor.audit_once()
+    classes = [d["class"] for d in report["nodes"][names[0]]["drifts"]]
+    assert classes == ["leaked_overlay"]
+    assert report["summary"]["leaked_overlay_bookings"] == 1
+    assert report["summary"]["leaked_bookings"] == 0
+
+
+# -- webhook qos parsing --------------------------------------------------
+
+
+def test_webhook_validates_and_normalizes_qos():
+    assert validate_qos({"metadata": {}}) == QosClass.GUARANTEED
+    pod = {"metadata": {"annotations": {A.QOS: " Best-Effort "}}}
+    assert validate_qos(pod) == QosClass.BEST_EFFORT
+    with pytest.raises(ValueError):
+        validate_qos({"metadata": {"annotations": {A.QOS: "bursty"}}})
+
+
+def test_webhook_injects_besteffort_priority_env():
+    pod = {
+        "metadata": {"annotations": {A.QOS: QosClass.BEST_EFFORT}},
+        "spec": {"containers": [
+            {"name": "m", "resources": {"limits": {R.chip: 1}}},
+            {"name": "has-env",
+             "env": [{"name": "TPU_TASK_PRIORITY", "value": "3"}]},
+        ]},
+    }
+    ops = qos_ops(pod)
+    # container 0 gains the env list; an explicit best-effort-tier
+    # priority (>= 2) is left alone
+    assert ops == [{
+        "op": "add", "path": "/spec/containers/0/env",
+        "value": [{"name": "TPU_TASK_PRIORITY", "value": "2"}],
+    }]
+    # guaranteed pods get nothing
+    assert qos_ops({"metadata": {}, "spec": {"containers": [{}]}}) == []
+
+
+def test_webhook_rejects_contradictory_besteffort_specs():
+    """A best-effort pod may not smuggle in a guaranteed-tier priority
+    (it would be exempt from the squeeze/evict loop) or a gang spec (the
+    gang reserve books guaranteed quota, not overlay)."""
+    import pytest
+
+    prio = {
+        "metadata": {"annotations": {A.QOS: QosClass.BEST_EFFORT}},
+        "spec": {"containers": [
+            {"name": "m",
+             "env": [{"name": "TPU_TASK_PRIORITY", "value": "1"}]},
+        ]},
+    }
+    with pytest.raises(ValueError, match="priority 1"):
+        qos_ops(prio)
+    gang = {
+        "metadata": {"annotations": {
+            A.QOS: QosClass.BEST_EFFORT, A.GANG_NAME: "train",
+            "vtpu.io/gang-size": "2",
+        }},
+        "spec": {"containers": [{"name": "m"}]},
+    }
+    with pytest.raises(ValueError, match="gang"):
+        qos_ops(gang)
+
+
+def test_filter_rejects_contradictory_besteffort_specs():
+    """Filter-side enforcement of the same contradictions the webhook
+    warns about — and pod_qos masks gang members to guaranteed so a
+    replayed/externally created pod can never route a live gang booking
+    into the overlay ledger."""
+    from vtpu.utils.types import pod_qos
+
+    client, s, names = _sched(nodes=1)
+    _mark_idle(s, names[0], now=time.time())
+    # explicit guaranteed priority on a best-effort pod: explicit error
+    be = _be_pod("be-prio")
+    be["spec"]["containers"][0]["env"] = [
+        {"name": "TPU_TASK_PRIORITY", "value": "0"}
+    ]
+    client.create_pod(be)
+    res = s.filter(be, names)
+    assert res.node is None and "priority 0" in res.error
+    # gang member annotated best-effort: explicit error, nothing booked
+    gang = _g_pod("gang-be")
+    gang["metadata"]["annotations"] = {
+        A.QOS: QosClass.BEST_EFFORT, A.GANG_NAME: "train",
+        "vtpu.io/gang-size": "2", "vtpu.io/gang-mesh": "2x1x1",
+    }
+    client.create_pod(gang)
+    res = s.filter(gang, names)
+    assert res.node is None and "gang" in res.error
+    assert not s.usage_cache.overlay_snapshot()
+    assert_cache_equals_oracle(s)
+    # the qos resolver itself masks the combination (ingest/replay guard)
+    assert pod_qos(gang["metadata"]["annotations"]) == QosClass.GUARANTEED
+
+
+# -- the acceptance soak --------------------------------------------------
+
+
+def test_soak_besteffort_x_squeeze_x_evict_x_churn_zero_residual():
+    """Threaded: best-effort admissions, idle-streak breaks (the
+    scheduler-visible face of a squeeze: measured duty rising under
+    contention), monitor-style eviction requests + the reconciler, and
+    guaranteed pod churn — all concurrent.  Ends with cache == oracle
+    and ZERO residual overlay entries once every best-effort pod is
+    gone (the acceptance criterion)."""
+    import random
+
+    client, s, names = _sched(nodes=3)
+    now = time.time()
+    for n in names:
+        _mark_idle(s, n, now=now)
+    stop = threading.Event()
+    errors = []
+
+    def admit_besteffort():
+        rng = random.Random(1)
+        i = 0
+        while not stop.is_set():
+            i += 1
+            pod = _be_pod(f"be-soak-{i}", cores=rng.choice([10, 25]),
+                          mem_pct=10)
+            try:
+                client.create_pod(pod)
+                s.filter(pod, names)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    def churn_guaranteed():
+        rng = random.Random(2)
+        i = 0
+        while not stop.is_set():
+            i += 1
+            pod = _g_pod(f"g-soak-{i}", cores=rng.choice([25, 50]))
+            try:
+                client.create_pod(pod)
+                res = s.filter(pod, names)
+                if res.node is not None and rng.random() < 0.7:
+                    client.delete_pod("default", f"g-soak-{i}")
+                    s.pods.rm_pod(f"uid-g-soak-{i}")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    def squeeze_and_measure():
+        # measured duty rises and falls: streak breaks disqualify chips
+        # mid-admission (racing try_book_besteffort's re-validation)
+        rng = random.Random(3)
+        t = [now]
+        while not stop.is_set():
+            t[0] += 1.0
+            n = rng.choice(names)
+            duty = rng.choice([0.0, 0.05, 0.8])
+            s.usage_cache.note_node_utilization(
+                n, _payload(_chip_uuids(s, n), duty, t[0]))
+
+    def evict():
+        rng = random.Random(4)
+        while not stop.is_set():
+            overlay = s.usage_cache.overlay_snapshot()
+            for uid in list(overlay):
+                if rng.random() < 0.5:
+                    name = uid[len("uid-"):]
+                    try:
+                        client.patch_pod_annotations(
+                            "default", name,
+                            {A.EVICT_REQUESTED: "besteffort_contention_0"},
+                        )
+                    except Exception:  # noqa: BLE001 — already deleted
+                        pass
+            try:
+                s.reconcile_evictions()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    threads = [
+        threading.Thread(target=f)
+        for f in (admit_besteffort, churn_guaranteed, squeeze_and_measure,
+                  evict)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(2.0)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    assert not errors, errors[:3]
+    # drain: delete every remaining best-effort pod, reconcile, re-ingest
+    for pod in client.list_pods():
+        name = pod["metadata"]["name"]
+        if name.startswith("be-soak-"):
+            client.patch_pod_annotations(
+                "default", name,
+                {A.EVICT_REQUESTED: "besteffort_contention_drain"},
+            )
+    while s.reconcile_evictions():
+        pass
+    assert s.usage_cache.overlay_snapshot() == {}, "residual overlay entries"
+    assert s.usage_cache.stats()["overlay_bookings"] == 0
+    assert_cache_equals_oracle(s)
+    # the auditor agrees: no overlay drift, no guaranteed-ledger drift
+    report = s.auditor.audit_once()
+    assert report["summary"]["leaked_overlay_bookings"] == 0
+    assert report["summary"]["leaked_bookings"] == 0
+
+
+# -- bench smoke (make bench-goodput SMOKE=1) -----------------------------
+
+
+def test_bench_goodput_smoke_schema():
+    """Schema-checked smoke pass of the goodput harness — no timing or
+    ratio asserts (the full run's SLOs live in benchmarks/
+    scheduler_goodput.py run()); overlay hygiene is asserted in every
+    mode by run() itself."""
+    from benchmarks import scheduler_goodput as bench
+
+    res = bench.run(smoke=True)
+    assert res["bench"] == "scheduler_goodput" and res["smoke"] is True
+    for arm in ("guaranteed_solo", "static_partition", "utilization_loop"):
+        v = res["arms"][arm]
+        for key in ("cluster_goodput_chip_s_per_s",
+                    "guaranteed_goodput_chip_s_per_s",
+                    "besteffort_goodput_chip_s_per_s",
+                    "besteffort_jobs_completed", "besteffort_jobs_evicted",
+                    "guaranteed_duty_protection",
+                    "oversubscription_ratio_mean", "audit_summary",
+                    "residual_overlay_bookings"):
+            assert key in v, (arm, key)
+        assert v["residual_overlay_bookings"] == 0
+    # the static partition cannot place a 50-core job in 40-core leftovers
+    assert res["arms"]["static_partition"]["besteffort_jobs_completed"] == 0
+    # ... and the loop arm demonstrably can (schema-level sanity, not an SLO)
+    assert res["arms"]["utilization_loop"]["besteffort_jobs_completed"] > 0
+    for key in ("goodput_ratio_vs_static",
+                "guaranteed_duty_degradation_vs_solo",
+                "oversubscription_ratio_mean"):
+        assert key in res["comparison"], key

@@ -446,3 +446,69 @@ def test_unpaced_tenant_still_reports_duty(tmp_path):
     assert _last_duty(sampler) == pytest.approx(1.0, abs=0.05)
     rt.close()
     pm.close()
+
+
+# -- throttle ladder (tiered preemption, docs/scheduler_perf.md) ----------
+
+
+def test_effective_quota_resolves_the_squeeze_ladder(tmp_path):
+    """_effective_quota: switch 0 enforces the configured quota, 1
+    suspends (unless policy=force), 2..4 halve per level — imposing a
+    quota even on unthrottled tenants — and policy=disable opts out."""
+    clk = FakeClock()
+    rt = _paced_runtime(str(tmp_path), clk, quota=40)
+    try:
+        assert rt._effective_quota() == (40, False)
+        rt.region.set_utilization_switch(1)
+        assert rt._effective_quota() == (40, True)
+        rt.core_policy = "force"
+        assert rt._effective_quota() == (40, False)
+        rt.core_policy = "default"
+        for switch, want in ((2, 20), (3, 10), (4, 5)):
+            rt.region.set_utilization_switch(switch)
+            assert rt._effective_quota() == (want, False), switch
+        # an UNTHROTTLED tenant squeezes from the whole-chip baseline
+        rt.core_limit = 100
+        rt.region.set_utilization_switch(2)
+        assert rt._effective_quota() == (50, False)
+        rt.region.set_utilization_switch(4)
+        assert rt._effective_quota() == (12, False)
+        # disable: the ladder cannot touch this tenant (eviction is the
+        # arbiter's backstop for opted-out best-effort tenants)
+        rt.core_policy = "disable"
+        assert rt._effective_quota() == (100, False)
+    finally:
+        rt.close()
+        # region file shared with other tests' dir layout: nothing to GC
+
+
+def test_squeeze_ladder_halves_sampled_duty(tmp_path):
+    """An unthrottled tenant squeezed to level 2 must SAMPLE at ≈50%
+    duty — the throttle ladder is enforced by the same pacing path the
+    duty oracle measures."""
+    clk = FakeClock()
+    rt = _paced_runtime(str(tmp_path), clk, quota=100)  # no quota of its own
+    pm = PathMonitor(str(tmp_path))
+    sampler = UtilizationSampler(pm, clock=clk.monotonic, wallclock=clk.time)
+    T = 0.01
+    for _ in range(20):  # unthrottled warm-up
+        rt.dispatch(lambda: (clk.sleep(T), _Done())[1])
+    rt.region.set_utilization_switch(2)  # arbiter: squeeze level 2
+    for _ in range(20):  # paced warm-up + calibration under the squeeze
+        rt.dispatch(lambda: (clk.sleep(T), _Done())[1])
+    sampler.sample_once()  # baseline
+    for _ in range(200):
+        rt.dispatch(lambda: (clk.sleep(T), _Done())[1])
+    sampler.sample_once()
+    duty = _last_duty(sampler)
+    assert duty == pytest.approx(0.5, abs=0.07), duty
+    # restore: the same tenant climbs back toward full duty
+    rt.region.set_utilization_switch(0)
+    sampler.sample_once()
+    for _ in range(100):
+        rt.dispatch(lambda: (clk.sleep(T), _Done())[1])
+    sampler.sample_once()
+    duty = _last_duty(sampler)
+    assert duty > 0.9, duty
+    rt.close()
+    pm.close()

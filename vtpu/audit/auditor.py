@@ -89,6 +89,15 @@ _PARTIAL_GANGS = _REG.gauge(
     "Bookings held by members of partially-admitted gangs (per node; "
     "the all-or-nothing invariant of vtpu/scheduler/gang.py is broken)",
 )
+_LEAKED_OVERLAY = _REG.gauge(
+    "vtpu_audit_leaked_overlay_total",
+    "Best-effort OVERLAY bookings whose pod no longer exists (per node). "
+    "Distinct from leaked_booking/overcommit by design: the overlay rides "
+    "above booked capacity (docs/scheduler_perf.md §Best-effort "
+    "oversubscription), so its bookings must never be read as guaranteed-"
+    "ledger drift — but a residual overlay entry still throttles future "
+    "best-effort admission on those chips",
+)
 
 
 class DriftClass:
@@ -97,6 +106,9 @@ class DriftClass:
     OVERCOMMIT = "overcommit"
     STALE_HEARTBEAT = "stale_heartbeat"
     PARTIAL_GANG = "partial_gang"
+    # best-effort overlay ledger drift — NEVER reported as overcommit or
+    # leaked_booking (the overlay is not part of the guaranteed ledger)
+    LEAKED_OVERLAY = "leaked_overlay"
 
 
 DRIFT_CLASSES = (
@@ -105,6 +117,7 @@ DRIFT_CLASSES = (
     DriftClass.OVERCOMMIT,
     DriftClass.STALE_HEARTBEAT,
     DriftClass.PARTIAL_GANG,
+    DriftClass.LEAKED_OVERLAY,
 )
 
 
@@ -204,6 +217,36 @@ class ClusterAuditor:
                 "class": DriftClass.LEAKED_BOOKING,
                 "pod": uid,
                 "detail": f"pod {uid} gone but still booked on {node}",
+            })
+        return leaked
+
+    def _leaked_overlay(
+        self, live_uids, drifts: Dict[str, List[dict]]
+    ) -> Dict[str, int]:
+        """Best-effort overlay bookings whose pod is gone — the overlay
+        analog of leaked_booking, kept a DISTINCT class so overlay rides
+        above booked capacity never masquerade as guaranteed-ledger
+        drift.  Same pending-patch grace as the guaranteed detector."""
+        overlay = self.sched.usage_cache.overlay_snapshot()
+        pods = self.sched.pods.all_pods()
+        now = time.monotonic()
+        leaked: Dict[str, int] = {}
+        for uid, (node, _devices) in sorted(overlay.items()):
+            if uid in live_uids:
+                continue
+            pi = pods.get(uid)
+            if (
+                pi is not None
+                and pi.pending
+                and now - pi.pending_since < PENDING_PATCH_GRACE_S
+            ):
+                continue  # fresh overlay admission: patch may be in flight
+            leaked[node] = leaked.get(node, 0) + 1
+            drifts.setdefault(node, []).append({
+                "class": DriftClass.LEAKED_OVERLAY,
+                "pod": uid,
+                "detail": f"pod {uid} gone but still holds a best-effort "
+                          f"overlay booking on {node}",
             })
         return leaked
 
@@ -387,9 +430,10 @@ class ClusterAuditor:
             leaked = self._leaked_bookings(live, drifts)
             orphaned = self._orphaned_regions(live, drifts)
             partial = self._partial_gangs(live, drifts)
+            overlay_leaked = self._leaked_overlay(live, drifts)
         else:
             # pod list failed: detectors skipped
-            leaked, orphaned, partial = {}, {}, {}
+            leaked, orphaned, partial, overlay_leaked = {}, {}, {}, {}
         ratios = self._overcommit(drifts)
         stale = self._stale_heartbeats(drifts)
 
@@ -416,6 +460,7 @@ class ClusterAuditor:
                 _LEAKED.set(leaked.get(name, 0), node=name)
                 _ORPHANED.set(orphaned.get(name, 0), node=name)
                 _PARTIAL_GANGS.set(partial.get(name, 0), node=name)
+                _LEAKED_OVERLAY.set(overlay_leaked.get(name, 0), node=name)
             _OVERCOMMIT.set(ratios.get(name, 0.0), node=name)
 
         ts = self._wallclock()
@@ -426,6 +471,7 @@ class ClusterAuditor:
                 _ORPHANED.remove(node=gone)
                 _OVERCOMMIT.remove(node=gone)
                 _PARTIAL_GANGS.remove(node=gone)
+                _LEAKED_OVERLAY.remove(node=gone)
             self._prev_nodes = set(node_names)
             report = {
                 "pass": self._passes,
@@ -441,6 +487,7 @@ class ClusterAuditor:
                     ),
                     "stale_nodes": len(stale),
                     "partial_gang_bookings": sum(partial.values()),
+                    "leaked_overlay_bookings": sum(overlay_leaked.values()),
                 },
             }
             self._last_report = report

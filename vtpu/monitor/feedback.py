@@ -7,6 +7,21 @@ when any HIGH-priority (priority 0) process was recently active, LOW-priority
 regions get their core throttling *tightened* (switch stays 0 = enforce) and
 high-priority regions get their throttle suspended (switch 1).  When no
 high-priority work is active, everyone's limits enforce normally.
+
+Tiered preemption (docs/scheduler_perf.md §Tiered preemption) extends the
+binary switch into a throttle LADDER for best-effort tenants
+(``TPU_TASK_PRIORITY >= 2``, injected by the webhook for
+``vtpu.io/qos: best-effort`` pods): while a guaranteed-tier tenant
+(priority 0/1) is active alongside an active best-effort tenant, the
+arbiter walks each best-effort region's switch up one squeeze level per
+pass (2 → 3 → 4; the shim's pacing path halves the effective core quota
+per level via ``effective_core_limit``), and restores it to 0 the pass
+contention clears.  If contention persists past ``VTPU_EVICT_AFTER_S``,
+the arbiter marks the best-effort pod with ``vtpu.io/evict-requested`` —
+the scheduler's eviction reconciler turns that into a delete and releases
+the overlay booking.  Squeeze-first-evict-last: oversubscribed tenants
+degrade gracefully before any is killed, and guaranteed tenants never
+degrade for long.
 """
 
 from __future__ import annotations
@@ -14,14 +29,22 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Iterable, Optional
+from typing import Callable, Dict, Optional
 
 from vtpu import obs
 from vtpu.monitor.pathmonitor import PathMonitor
+from vtpu.monitor.shared_region import THROTTLE_LEVEL_MAX, THROTTLE_LEVEL_MIN
+from vtpu.obs.events import EventType, emit
+from vtpu.utils.envs import env_float as _env_float
+from vtpu.utils.types import BEST_EFFORT_PRIORITY, annotations
 
 log = logging.getLogger(__name__)
 
 ACTIVITY_THRESHOLD = 1  # recent_kernel above this = "recently active"
+ENV_ACTIVITY_THRESHOLD = "VTPU_FEEDBACK_ACTIVITY_THRESHOLD"
+# contention older than this asks for eviction (docs/config.md)
+ENV_EVICT_AFTER = "VTPU_EVICT_AFTER_S"
+DEFAULT_EVICT_AFTER_S = 60.0
 
 _MON = obs.registry("monitor")
 _PASS_HIST = _MON.histogram(
@@ -32,28 +55,201 @@ _FAILURES = _MON.counter(
     "vtpu_feedback_failures_total",
     "Feedback passes that raised (logged and retried next tick)",
 )
+_THROTTLE_FLIPS = _MON.counter(
+    "vtpu_preempt_throttle_transitions_total",
+    "utilization_switch transitions written by the arbiter, by target "
+    "state (suspend / enforce / squeeze level)",
+)
+_EVICT_REQS = _MON.counter(
+    "vtpu_preempt_evict_requests_total",
+    "Best-effort pods marked vtpu.io/evict-requested after contention "
+    "outlasted VTPU_EVICT_AFTER_S",
+)
 
 
-def observe_once(pathmon: PathMonitor) -> None:
-    """One arbitration pass (ref Observe + CheckPriority feedback.go:164-222)."""
-    entries = [e for e in pathmon.entries.values() if e.region is not None]
-    # classify regions by the min priority of their live procs (0 = high)
-    high_active = False
-    activity = {}
-    for e in entries:
-        act = e.region.decay_recent_kernel()
-        procs = e.region.live_procs()
-        prio = min((p["priority"] for p in procs), default=1)
-        activity[e.dirname] = (act, prio)
-        if prio == 0 and act > ACTIVITY_THRESHOLD:
-            high_active = True
-    for e in entries:
-        act, prio = activity[e.dirname]
-        if prio == 0 and high_active:
-            # high-priority task running: it gets unthrottled
-            e.region.set_utilization_switch(1)
-        else:
-            e.region.set_utilization_switch(0)
+def _activity_threshold(explicit: Optional[int] = None) -> int:
+    if explicit is not None:
+        return explicit
+    return int(_env_float(ENV_ACTIVITY_THRESHOLD, ACTIVITY_THRESHOLD))
+
+
+def _switch_label(value: int) -> str:
+    if value == 0:
+        return "enforce"
+    if value == 1:
+        return "suspend"
+    return f"squeeze_{value}"
+
+
+class ContentionArbiter:
+    """Stateful side of the feedback pass: per-region squeeze levels,
+    contention clocks, and the one-shot eviction requests.
+
+    ``client``/``pods_fn`` are optional — without them the ladder still
+    squeezes (it lives in the shared region), but eviction requests are
+    only journaled, not annotated (the pod-side patch needs the API)."""
+
+    def __init__(
+        self,
+        client=None,
+        pods_fn: Optional[Callable[[], dict]] = None,
+        evict_after_s: Optional[float] = None,
+        activity_threshold: Optional[int] = None,
+        clock=time.monotonic,
+        wallclock=time.time,
+    ) -> None:
+        self.client = client
+        self.pods_fn = pods_fn
+        if evict_after_s is None:
+            evict_after_s = _env_float(ENV_EVICT_AFTER, DEFAULT_EVICT_AFTER_S)
+        self.evict_after_s = evict_after_s
+        self.activity_threshold = _activity_threshold(activity_threshold)
+        self._clock = clock
+        self._wallclock = wallclock
+        # dirname → monotonic ts contention FIRST held (uninterrupted)
+        self._contention_since: Dict[str, float] = {}
+        # pod uid → region dirname, for uids already marked (one patch
+        # per contention episode; purged when the region vanishes)
+        self._evict_requested: Dict[str, str] = {}
+
+    def _set_switch(self, entry, value: int) -> None:
+        """Write the switch only on change, making the transition visible:
+        ThrottleChanged journal event + transitions counter — squeeze and
+        restore flips show up on /timeline next to the pod's spans."""
+        region = entry.region
+        cur = region.region.utilization_switch
+        if cur == value:
+            return
+        region.set_utilization_switch(value)
+        _THROTTLE_FLIPS.inc(to=_switch_label(value))
+        emit(
+            EventType.THROTTLE_CHANGED, "monitor",
+            pod=entry.pod_uid, ctr=entry.dirname,
+            prev=_switch_label(cur), now=_switch_label(value),
+        )
+
+    def _request_eviction(self, entry) -> None:
+        uid = entry.pod_uid
+        if uid in self._evict_requested:
+            return
+        self._evict_requested[uid] = entry.dirname
+        reason = f"besteffort_contention_{int(self._wallclock())}"
+        patched = False
+        if self.client is not None and self.pods_fn is not None:
+            try:
+                pod = (self.pods_fn() or {}).get(uid)
+                if pod is None:
+                    # transient list miss (API/informer lag): don't burn
+                    # the episode's one-shot on a no-op — retried while
+                    # the contention clock stays over the threshold
+                    self._evict_requested.pop(uid, None)
+                    log.warning(
+                        "evict-request: pod %s not in API snapshot yet; "
+                        "will retry next pass", uid,
+                    )
+                    return
+                meta = pod.get("metadata", {})
+                self.client.patch_pod_annotations(
+                    meta.get("namespace", "default"), meta.get("name", ""),
+                    {annotations.EVICT_REQUESTED: reason},
+                )
+                patched = True
+            except Exception:  # noqa: BLE001 — retried next pass
+                log.exception("evict-request patch for pod %s failed", uid)
+                self._evict_requested.pop(uid, None)
+                return
+        _EVICT_REQS.inc()
+        emit(
+            EventType.EVICT_REQUESTED, "monitor",
+            pod=uid, ctr=entry.dirname, reason=reason, patched=patched,
+        )
+        log.warning(
+            "best-effort pod %s kept guaranteed tier suppressed > %.0fs: "
+            "eviction requested (%s)", uid, self.evict_after_s, reason,
+        )
+
+    def observe(self, pathmon: PathMonitor) -> None:
+        """One arbitration pass (ref Observe + CheckPriority
+        feedback.go:164-222, plus the squeeze ladder)."""
+        entries = [e for e in pathmon.entries.values() if e.region is not None]
+        threshold = self.activity_threshold
+        high_active = False          # priority-0 work recently ran
+        guaranteed_active = False    # any guaranteed-tier (0/1) work ran
+        besteffort_active = False
+        activity = {}
+        for e in entries:
+            act = e.region.decay_recent_kernel()
+            procs = e.region.live_procs()
+            prio = min((p["priority"] for p in procs), default=1)
+            if not procs:
+                # no registered tenant: residual decaying activity from an
+                # exited process is not work — without this, a dead region
+                # (default prio 1) reads as guaranteed-active and squeezes
+                # best-effort co-tenants on a node with no guaranteed work
+                act = 0.0
+            activity[e.dirname] = (act, prio)
+            if act > threshold:
+                if prio == 0:
+                    high_active = True
+                if prio <= 1:
+                    guaranteed_active = True
+                elif prio >= BEST_EFFORT_PRIORITY:
+                    besteffort_active = True
+        # contention: a guaranteed tenant is burning cycles while a
+        # best-effort co-tenant is too — squeeze the opportunistic tier
+        contention = guaranteed_active and besteffort_active
+        now = self._clock()
+        live_dirs = set()
+        for e in entries:
+            act, prio = activity[e.dirname]
+            live_dirs.add(e.dirname)
+            if prio >= BEST_EFFORT_PRIORITY:
+                # only a best-effort tenant that is ITSELF burning cycles
+                # is part of the contention — an idle co-tenant keeps its
+                # quota and never accrues an eviction clock just because
+                # a sibling suppressed the guaranteed tier
+                if contention and act > threshold:
+                    since = self._contention_since.setdefault(e.dirname, now)
+                    cur = e.region.region.utilization_switch
+                    nxt = (
+                        THROTTLE_LEVEL_MIN
+                        if cur < THROTTLE_LEVEL_MIN
+                        else min(THROTTLE_LEVEL_MAX, cur + 1)
+                    )
+                    self._set_switch(e, nxt)
+                    if now - since >= self.evict_after_s:
+                        self._request_eviction(e)
+                else:
+                    self._contention_since.pop(e.dirname, None)
+                    # clear the pod-level one-shot only if THIS region
+                    # requested it — an idle sibling region of the same
+                    # pod must not re-arm the request every pass
+                    if self._evict_requested.get(e.pod_uid) == e.dirname:
+                        self._evict_requested.pop(e.pod_uid, None)
+                    self._set_switch(e, 0)
+            elif prio == 0 and high_active:
+                # high-priority task running: it gets unthrottled
+                self._set_switch(e, 1)
+            else:
+                self._set_switch(e, 0)
+        # forget state for vanished regions (evicted/retired tenants) —
+        # including their one-shot eviction marks, or the uid set grows
+        # for the life of the daemon under best-effort churn
+        for gone in [d for d in self._contention_since if d not in live_dirs]:
+            self._contention_since.pop(gone, None)
+        for uid in [
+            u for u, d in self._evict_requested.items() if d not in live_dirs
+        ]:
+            self._evict_requested.pop(uid, None)
+
+
+def observe_once(
+    pathmon: PathMonitor, arbiter: Optional[ContentionArbiter] = None
+) -> None:
+    """One arbitration pass.  Stateless callers (tests, one-shot tools)
+    get a transient arbiter: the binary suspend behaviour is identical;
+    squeeze escalation/eviction clocks simply restart each call."""
+    (arbiter or ContentionArbiter()).observe(pathmon)
 
 
 class FeedbackLoop:
@@ -62,9 +258,23 @@ class FeedbackLoop:
     second arbiter racing the first over utilization_switch), the thread
     handle is retained, and ``stop()`` joins with a timeout."""
 
-    def __init__(self, pathmon: PathMonitor, interval_s: float = 5.0) -> None:
+    def __init__(
+        self,
+        pathmon: PathMonitor,
+        interval_s: float = 5.0,
+        client=None,
+        pods_fn: Optional[Callable[[], dict]] = None,
+        evict_after_s: Optional[float] = None,
+        activity_threshold: Optional[int] = None,
+    ) -> None:
         self.pathmon = pathmon
         self.interval_s = interval_s
+        self.arbiter = ContentionArbiter(
+            client=client,
+            pods_fn=pods_fn,
+            evict_after_s=evict_after_s,
+            activity_threshold=activity_threshold,
+        )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -74,7 +284,7 @@ class FeedbackLoop:
         t0 = time.perf_counter()
         try:
             self.pathmon.scan()
-            observe_once(self.pathmon)
+            self.arbiter.observe(self.pathmon)
             # resolve container→host pids for new slots each tick
             # (ref setHostPid runs inside the feedback loop too),
             # then free slots whose host process died — a crashed

@@ -86,11 +86,7 @@ DEFAULT_WRITEBACK_MIN_DELTA = 0.05
 DEFAULT_WRITEBACK_MAX_AGE_S = 60.0
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+from vtpu.utils.envs import env_float as _env_float  # noqa: E402
 
 
 class UtilizationSampler:

@@ -23,6 +23,33 @@ MAX_DEVICES = 16
 MAX_PROCS = 64
 UUID_LEN = 64
 
+# utilization_switch throttle ladder (layout-compatible extension of the
+# original binary switch — same int32 field, new value range):
+#   0           enforce the configured core quota (the original default)
+#   1           suspend throttling (priority arbitration, the original 1)
+#   2..MAX      graduated SQUEEZE: the effective core quota halves per
+#               level (level 2 = 1/2, 3 = 1/4, 4 = 1/8) — the monitor's
+#               contention arbiter walks best-effort tenants down this
+#               ladder before asking for eviction.  Shims that predate
+#               the ladder read any value != 1 as "enforce", so a mixed
+#               fleet degrades to plain enforcement, never to suspend.
+THROTTLE_LEVEL_MIN = 2
+THROTTLE_LEVEL_MAX = 4
+
+
+def effective_core_limit(core_limit: int, switch: int) -> int:
+    """Resolve the core quota a pacing path must enforce under the
+    throttle ladder.  ``switch`` values below the ladder leave the quota
+    alone (0 = enforce, 1 = suspend is the CALLER's branch — suspension
+    must stay policy-aware).  An unthrottled tenant (quota 0 or 100)
+    squeezes from a whole-chip baseline: the ladder imposes a quota on
+    tenants that never had one."""
+    if switch < THROTTLE_LEVEL_MIN:
+        return core_limit
+    level = min(switch, THROTTLE_LEVEL_MAX)
+    base = core_limit if 0 < core_limit < 100 else 100
+    return max(1, base >> (level - 1))
+
 
 class DeviceUsage(ctypes.Structure):
     _fields_ = [

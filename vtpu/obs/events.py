@@ -70,6 +70,10 @@ class EventType:
     # monitor
     REGION_ATTACHED = "RegionAttached"  # pathmonitor started tracking a region
     REGION_GC = "RegionGC"              # stale container dir garbage-collected
+    # tiered preemption (monitor arbiter ↔ scheduler reconciler)
+    THROTTLE_CHANGED = "ThrottleChanged"  # arbiter moved a region's throttle ladder
+    EVICT_REQUESTED = "EvictRequested"    # contention outlasted VTPU_EVICT_AFTER_S
+    POD_EVICTED = "PodEvicted"            # scheduler deleted the best-effort pod
     # auditor
     DRIFT_DETECTED = "DriftDetected"    # reconciliation found booked/measured skew
     # serving router

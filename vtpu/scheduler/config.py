@@ -4,14 +4,9 @@ cmd/scheduler/main.go:51-58 flags)."""
 from __future__ import annotations
 
 import dataclasses
-import os
 
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+from vtpu.utils.envs import env_float as _env_float
+from vtpu.utils.envs import env_int as _env_int
 
 
 @dataclasses.dataclass
@@ -52,4 +47,36 @@ class SchedulerConfig:
     # walk (env VTPU_FILTER_CHUNK)
     filter_chunk: int = dataclasses.field(
         default_factory=lambda: _env_int("VTPU_FILTER_CHUNK", 256)
+    )
+    # measured-headroom scoring (docs/scheduler_perf.md §Utilization-aware
+    # scoring): blend weight between the booked score and the node's
+    # measured headroom from the vtpu.io/node-utilization write-back.
+    # 0 = booked-only (the pre-utilization-loop behaviour); the weight is
+    # further decayed by snapshot age so a nearly-stale measurement pulls
+    # less than a fresh one (env VTPU_SCORE_MEASURED_WEIGHT)
+    score_measured_weight: float = dataclasses.field(
+        default_factory=lambda: _env_float("VTPU_SCORE_MEASURED_WEIGHT", 0.3)
+    )
+    # staleness gate for measured inputs: a node-utilization snapshot
+    # older than this falls back to booked-only scoring AND disqualifies
+    # the node from best-effort overlay admission.  Shares the sampler's
+    # write-back ceiling env (VTPU_UTIL_WRITEBACK_MAX_AGE_S, default 60):
+    # a healthy monitor refreshes the annotation at least that often, so
+    # anything older means the measurement pipeline is broken
+    measured_max_age_s: float = dataclasses.field(
+        default_factory=lambda: _env_float("VTPU_UTIL_WRITEBACK_MAX_AGE_S", 60.0)
+    )
+    # best-effort overlay admission gates (docs/scheduler_perf.md
+    # §Best-effort oversubscription): a chip qualifies for overlay
+    # bookings only while its measured duty stays at or under the
+    # threshold, and has stayed there for the sustained window
+    besteffort_duty_threshold: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "VTPU_BESTEFFORT_DUTY_THRESHOLD", 0.3
+        )
+    )
+    besteffort_idle_window_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "VTPU_BESTEFFORT_IDLE_WINDOW_S", 30.0
+        )
     )

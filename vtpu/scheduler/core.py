@@ -30,6 +30,7 @@ from vtpu.utils import codec, trace
 from vtpu.utils.nodelock import lock_node, release_node_lock
 from vtpu.utils.resources import resource_reqs
 from vtpu.utils.types import (
+    BEST_EFFORT_PRIORITY,
     BindPhase,
     ContainerDevice,
     ContainerDeviceRequest,
@@ -37,8 +38,10 @@ from vtpu.utils.types import (
     HandshakeState,
     KNOWN_DEVICES,
     PodDevices,
+    QosClass,
     REGISTRY_POLL_INTERVAL_S,
     annotations,
+    pod_qos,
 )
 
 log = logging.getLogger(__name__)
@@ -87,6 +90,19 @@ _CAS_ABORTS = _REG.counter(
     "vtpu_filter_cas_aborts_total",
     "Filters aborted after exhausting cas_max_retries (the pod is "
     "re-queued by kube-scheduler)",
+)
+# best-effort oversubscription + tiered preemption (docs/scheduler_perf.md
+# §Best-effort oversubscription)
+_BE_ADMISSIONS = _REG.counter(
+    "vtpu_besteffort_admissions_total",
+    "Best-effort overlay admission attempts by result (admitted / "
+    "rejected — a reject means no chip passed the sustained-idle and "
+    "overlay-capacity gates)",
+)
+_PREEMPT_EVICTIONS = _REG.counter(
+    "vtpu_preempt_evictions_total",
+    "Best-effort pods deleted by the eviction reconciler after the "
+    "monitor's arbiter requested preemption (vtpu.io/evict-requested)",
 )
 
 # per-uid patch-lock map hygiene: entries must be reclaimed when the last
@@ -154,12 +170,21 @@ class Scheduler:
         # as a delta, so the filter never re-aggregates the whole cluster
         # (the old nodes_usage() walk is kept below as the slow oracle)
         self.usage_cache = UsageCache()
+        # the cache tracks per-chip sustained-idle streaks at write-back
+        # ingest; the threshold is scheduler policy (config)
+        self.usage_cache.idle_duty_threshold = (
+            self.config.besteffort_duty_threshold
+        )
         self.nodes.add_listener(self.usage_cache)
         self.pods.add_listener(self.usage_cache)
         self.nodes.add_listener(_MemoPruner(self))
         # placement-decision audit log (GET /decisions?pod=): every filter
         # run's per-node verdicts, bounded by VTPU_DECISION_LOG_CAP
         self.decisions = DecisionLog()
+        # uids of non-best-effort pods carrying a stray evict-requested
+        # annotation we already warned about (reconcile_evictions runs
+        # every registry poll; one warning per pod, not per poll)
+        self._evict_ignored_warned: set = set()
         self._stop = threading.Event()
         # the pre-CAS escape hatch (config.optimistic_booking=False):
         # serialises every select→book under one global lock.  The default
@@ -451,6 +476,8 @@ class Scheduler:
                     # TTL sweep for partial gangs (access-driven expiry
                     # otherwise needs gang traffic to fire)
                     self.gang.registry.expire_stale()
+                    # monitor-requested best-effort preemptions → deletes
+                    self.reconcile_evictions()
                 except Exception:  # noqa: BLE001 — keep the loop alive
                     log.exception("registry loop error")
                 self._stop.wait(REGISTRY_POLL_INTERVAL_S)
@@ -488,6 +515,11 @@ class Scheduler:
             )
         for uid, pi in self.pods.all_pods().items():
             if uid == exclude_uid:
+                continue
+            if pi.qos == QosClass.BEST_EFFORT:
+                # overlay tier: never part of the guaranteed aggregates
+                # (the cache routes these to its overlay ledger, so the
+                # oracle must skip them for field-for-field equality)
                 continue
             nu = usage.get(pi.node)
             if nu is None:
@@ -538,6 +570,7 @@ class Scheduler:
         # (vtpu/scheduler/gang.py); a malformed spec is an explicit
         # filter error, never a silent fall-through to singleton booking
         from vtpu.scheduler import gang as gang_mod
+        from vtpu.scheduler import webhook as webhook_mod
 
         try:
             gang_spec = gang_mod.parse_gang_spec(pod_annos)
@@ -551,10 +584,49 @@ class Scheduler:
             )
             return res
         # the dominant single-chip shape takes the live-aggregate fast
-        # path inside _select_and_book; label the latency accordingly
+        # path inside _select_and_book; label the latency accordingly.
+        # best-effort pods take the overlay admission path (gang members
+        # are always guaranteed — the all-or-nothing reserve books real
+        # quota, which the overlay deliberately does not)
+        qos = pod_qos(pod_annos)
+        # contradictory best-effort specs are explicit filter errors, like
+        # a malformed gang spec (the webhook already warned at apply time):
+        # a best-effort gang member would route the gang's guaranteed
+        # booking into the overlay on ingest (pod_qos masks the combo to
+        # guaranteed — check the raw annotation), and an explicit
+        # guaranteed priority would exempt the tenant from the monitor's
+        # squeeze/evict arbitration that makes overlay admission safe
+        raw_qos = pod_annos.get(annotations.QOS, "").strip().lower()
+        if raw_qos == QosClass.BEST_EFFORT:
+            err = ""
+            if gang_spec is not None:
+                err = (
+                    f"{annotations.QOS}=best-effort on a gang member: "
+                    "gang admission books guaranteed quota"
+                )
+            else:
+                prio = webhook_mod.declared_task_priority(pod)
+                if prio is not None and prio < BEST_EFFORT_PRIORITY:
+                    err = (
+                        f"{annotations.QOS}=best-effort with explicit "
+                        f"task priority {prio} (< {BEST_EFFORT_PRIORITY})"
+                    )
+            if err:
+                res = FilterResult(None, {}, err)
+                self.decisions.record(
+                    pod=pod.get("metadata", {}).get("name", ""),
+                    namespace=pod.get("metadata", {}).get(
+                        "namespace", "default"
+                    ),
+                    pod_uid=uid, path="besteffort", node=None,
+                    error=err, verdicts={}, utilization={}, elapsed_ms=0.0,
+                )
+                return res
         path = (
             "gang"
             if gang_spec is not None
+            else "besteffort"
+            if qos == QosClass.BEST_EFFORT
             else "fast"
             if len(reqs) == 1 and len(reqs[0]) == 1 and reqs[0][0].nums == 1
             else "general"
@@ -582,6 +654,15 @@ class Scheduler:
                     pod, node_names, reqs, gang_spec, pod_annos, node_objs
                 )
                 enc, committed_remote = None, True
+            elif qos == QosClass.BEST_EFFORT:
+                # opportunistic overlay admission above booked capacity —
+                # always decided by the replica that received the filter:
+                # the overlay never touches the guaranteed CAS ledger, so
+                # there is no owner to coordinate with, and the annotation
+                # bus re-ingests the booking on every replica's next sweep
+                res, enc, verdicts = self._select_and_book_besteffort(
+                    pod, node_names, reqs, pod_annos, node_objs
+                )
             elif self.shard is not None:
                 # sharded deployment: this replica coordinates — its own
                 # subset evaluates locally, peers evaluate theirs, the
@@ -611,8 +692,10 @@ class Scheduler:
             sp["failed"] = len(res.failed)
             _FILTER_HIST.observe(time.perf_counter() - t_filter, path=path)
             # audit log: the full per-node verdict set plus the measured-
-            # utilization snapshot that was current at decision time
-            measured = self.usage_cache.measured_utilization()
+            # utilization snapshot that was current at decision time —
+            # fetched as a names= subset so the copy is O(verdict nodes),
+            # not O(cluster)
+            measured = self.usage_cache.measured_utilization(names=verdicts)
             rec_fields = dict(
                 pod=pod.get("metadata", {}).get("name", ""),
                 namespace=pod.get("metadata", {}).get("namespace", "default"),
@@ -620,10 +703,9 @@ class Scheduler:
                 path=path,
                 node=res.node,
                 error=res.error,
+                qos=qos,
                 verdicts=verdicts,
-                utilization={
-                    n: measured[n] for n in verdicts if n in measured
-                },
+                utilization=measured,
                 elapsed_ms=round((time.perf_counter() - t_filter) * 1e3, 3),
             )
             if gang_rec is not None:
@@ -808,6 +890,19 @@ class Scheduler:
         )
         node_objs = node_objs or {}
         poll_objs = self._node_objs
+        # measured-headroom blend inputs, resolved once per walk: the
+        # booked score stays what the memo caches (measured payloads move
+        # without bumping node generations), the blend runs after lookup
+        m_weight = self.config.score_measured_weight
+        m_max_age = self.config.measured_max_age_s
+        m_now = time.time() if m_weight > 0 else 0.0
+        # one bulk snapshot (one lock hold), not one cache call per
+        # candidate — payloads staying fixed across the walk is already
+        # the contract the memo relies on
+        m_measured: Dict[str, dict] = (
+            cache.measured_utilization(names=node_names)
+            if m_weight > 0 else {}
+        )
         # best: (score, node, placement-or-(device, mem), generation)
         best: Optional[Tuple[float, str, object, int]] = None
         failed: Dict[str, str] = {}
@@ -863,12 +958,20 @@ class Scheduler:
                                 }
                             continue
                         dev_uuid, mem, s = res
+                        minfo = None
+                        if m_weight > 0:
+                            s, minfo = score_mod.blend_measured(
+                                s, m_measured.get(name),
+                                m_now, m_max_age, m_weight,
+                            )
                         payload: object = (dev_uuid, mem)
                         if collect_verdicts:
                             verdicts[name] = {
                                 "fit": True, "score": round(s, 6),
                                 "device": dev_uuid, "mem": mem,
                             }
+                            if minfo is not None:
+                                verdicts[name]["measured"] = minfo
                     else:
                         nu, gen = cache.clone_node(name, exclude_uid=uid)
                         if nu is None:
@@ -889,8 +992,16 @@ class Scheduler:
                                 }
                             continue
                         s = score_mod.score_node(nu, policy)
+                        minfo = None
+                        if m_weight > 0:
+                            s, minfo = score_mod.blend_measured(
+                                s, m_measured.get(name),
+                                m_now, m_max_age, m_weight,
+                            )
                         if collect_verdicts:
                             verdicts[name] = {"fit": True, "score": round(s, 6)}
+                            if minfo is not None:
+                                verdicts[name]["measured"] = minfo
                     if best is None or s > best[0]:
                         best = (s, name, payload, gen)
         return best, failed, verdicts
@@ -1041,6 +1152,278 @@ class Scheduler:
             None,
             verdicts,
         )
+
+    # ------------------------------------------------------------------
+    # Best-effort overlay admission (docs/scheduler_perf.md
+    # §Best-effort oversubscription)
+    # ------------------------------------------------------------------
+    def _plan_besteffort(self, name: str, uid: str, reqs, pod_annos, now: float):
+        """Plan a best-effort placement on one node, or return a reject
+        reason string.  Books nothing — try_book_besteffort re-validates
+        every gate atomically at commit time, which is why the planning
+        walk (including per-node topology/ICI work) runs on ISOLATED
+        snapshots with no cache lock held: a multi-chip best-effort plan
+        must never queue the guaranteed filters behind it.
+
+        Chip choice deliberately ignores BOOKED usage (the overlay rides
+        above the static partition — that is the whole point); the gates
+        are measurement freshness, per-chip sustained idleness, overlay
+        capacity caps, health, and the type selectors.  Chips are ranked
+        most-idle-first so the opportunistic tier lands where the most
+        real headroom was measured."""
+        cfg = self.config
+        cache = self.usage_cache
+        # four snapshot reads, each internally consistent; commit-time
+        # CAS validation makes cross-read races harmless
+        nu, _gen = cache.clone_node(name)
+        if nu is None:
+            return "no vtpu devices registered"
+        payload = cache.measured_utilization(name)
+        if not isinstance(payload, dict):
+            return "no utilization measurement"
+        try:
+            ts = float(payload.get("ts"))
+        except (TypeError, ValueError):
+            return "no utilization measurement"
+        age = now - ts
+        if age >= cfg.measured_max_age_s:
+            return "utilization measurement stale"
+        duties: Dict[str, float] = {}
+        devices_map = payload.get("devices")
+        if isinstance(devices_map, dict):
+            for uuid, rec in devices_map.items():
+                try:
+                    duties[uuid] = float(rec.get("duty", 0.0))
+                except (AttributeError, TypeError, ValueError):
+                    continue
+        idle_since = cache.idle_since_map(name)
+        # planned overlay adds on top of the live sums, per chip — minus
+        # this pod's own previous booking (re-filter replaces it)
+        overlay = cache.overlay_usage(name, exclude_uid=uid)
+        planned: Dict[str, list] = {
+            uuid: [mem, cores] for uuid, (mem, cores, _n) in overlay.items()
+        }
+        placement: PodDevices = []
+        chosen_duties: List[float] = []
+        for ctr_reqs in reqs:
+            ctr_devs: List[ContainerDevice] = []
+            for req in ctr_reqs:
+                fitting = []
+                for d in nu.devices:
+                    if not d.health:
+                        continue
+                    if not score_mod.check_type(pod_annos, d, req):
+                        continue
+                    idle_t = idle_since.get(d.uuid)
+                    if idle_t is None or ts - idle_t < cfg.besteffort_idle_window_s:
+                        continue
+                    mem = score_mod._mem_for(d, req)
+                    have = planned.get(d.uuid, [0, 0])
+                    if have[0] + mem > d.totalmem:
+                        continue
+                    if have[1] + req.coresreq > d.totalcores:
+                        continue
+                    fitting.append((duties.get(d.uuid, 0.0), d.uuid, d, mem))
+                if len(fitting) < req.nums:
+                    return "not enough sustained-idle chips"
+                fitting.sort(key=lambda t: (t[0], t[1]))  # most idle first
+                chosen = self._besteffort_chip_set(nu, fitting, req.nums)
+                for duty, uuid, d, mem in chosen:
+                    ent = planned.setdefault(uuid, [0, 0])
+                    ent[0] += mem
+                    ent[1] += req.coresreq
+                    chosen_duties.append(duty)
+                    ctr_devs.append(ContainerDevice(
+                        uuid=uuid, type=req.type, usedmem=mem,
+                        usedcores=req.coresreq,
+                    ))
+            placement.append(ctr_devs)
+        headroom = (
+            sum(1.0 - min(1.0, max(0.0, d)) for d in chosen_duties)
+            / max(1, len(chosen_duties))
+        )
+        minfo = {
+            "age_s": round(age, 1),
+            "headroom": round(headroom, 4),
+            "idle_window_s": cfg.besteffort_idle_window_s,
+            "duty_threshold": cache.idle_duty_threshold,
+        }
+        return placement, headroom, minfo
+
+    @staticmethod
+    def _besteffort_chip_set(nu, fitting, nums: int):
+        """Choose ``nums`` chips from the idle-ranked fitting list.  A
+        multi-chip best-effort pod still wants ICI locality, so the
+        choice goes through the device allocator's existing best-effort
+        plumbing (IciAllocator POLICY_BEST_EFFORT: prefer rectangles,
+        fall back to maximally-connected sets, never fail while enough
+        chips exist); single-chip requests and topology-less nodes keep
+        the plain most-idle-first pick."""
+        if nums <= 1 or not nu.topology:
+            return fitting[:nums]
+        by_uuid = {uuid: (duty, uuid, d, mem) for duty, uuid, d, mem in fitting}
+        if any(t[2].coords is None for t in fitting):
+            return fitting[:nums]
+        from vtpu.device.allocator import (
+            AllocationError,
+            IciAllocator,
+            POLICY_BEST_EFFORT,
+        )
+        from vtpu.device.chip import Chip
+        from vtpu.device.topology import Topology
+
+        topo = Topology.from_spec(nu.topology)
+        chips = [
+            Chip(index=i, uuid=t[1], model=t[2].type, hbm_mb=t[2].totalmem,
+                 coords=t[2].coords)
+            for i, t in enumerate(fitting)
+        ]
+        try:
+            chosen = IciAllocator(topo, POLICY_BEST_EFFORT).allocate(chips, nums)
+        except AllocationError:
+            return fitting[:nums]
+        return [by_uuid[c.uuid] for c in chosen]
+
+    def _select_and_book_besteffort(
+        self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs=None
+    ) -> Tuple[FilterResult, Optional[str], Dict[str, dict]]:
+        """Overlay admission for ``vtpu.io/qos: best-effort`` pods: rank
+        candidate nodes by the measured headroom of their sustained-idle
+        chips and book the winner into the usage cache's overlay ledger —
+        ABOVE booked capacity, without ever touching the guaranteed
+        booking aggregates or their CAS generations.  The monitor's
+        squeeze ladder and the eviction reconciler are what protect the
+        guaranteed tier at runtime."""
+        uid = pod_uid(pod)
+        cfg = self.config
+        now = time.time()
+        check = (
+            nodecheck.make_checker(pod) if cfg.node_validity_check else None
+        )
+        node_objs = node_objs or {}
+        poll_objs = self._node_objs
+        failed: Dict[str, str] = {}
+        verdicts: Dict[str, dict] = {}
+        candidates: List[Tuple[float, str, PodDevices]] = []
+        for name in node_names:
+            if check is not None:
+                reason = check(node_objs.get(name) or poll_objs.get(name))
+                if reason is not None:
+                    failed[name] = reason
+                    verdicts[name] = {"fit": False, "reason": reason}
+                    continue
+            plan = self._plan_besteffort(name, uid, reqs, pod_annos, now)
+            if isinstance(plan, str):
+                failed[name] = plan
+                verdicts[name] = {"fit": False, "reason": plan}
+                continue
+            placement, score, minfo = plan
+            verdicts[name] = {
+                "fit": True, "score": round(score, 6), "measured": minfo,
+            }
+            candidates.append((score, name, placement))
+        candidates.sort(key=lambda t: (-t[0], t[1]))
+        for score, name, placement in candidates:
+            reason = self.usage_cache.try_book_besteffort(
+                uid, name, placement,
+                now=now,
+                idle_window_s=cfg.besteffort_idle_window_s,
+                max_age_s=cfg.measured_max_age_s,
+            )
+            if reason is not None:
+                # lost a race (another overlay admission filled the chip,
+                # or the idle streak broke mid-filter): try the runner-up
+                failed[name] = reason
+                verdicts[name] = {"fit": False, "reason": reason}
+                continue
+            enc = codec.encode_pod_devices(placement)
+            fresh = dict(pod)
+            fresh_annos = dict(get_annotations(pod))
+            fresh_annos[annotations.ASSIGNED_IDS] = enc
+            fresh_annos[annotations.ASSIGNED_NODE] = name
+            fresh["metadata"] = dict(pod["metadata"], annotations=fresh_annos)
+            # pending=True reuses the guaranteed tier's whole patch
+            # machinery (per-uid patch lock, grace, unbook-on-failure);
+            # the pod's own vtpu.io/qos annotation routes every ingest
+            # replay back to the overlay ledger
+            self.pods.add_pod(fresh, name, placement, pending=True)
+            self.decorate_winner(verdicts, name, score, placement)
+            _BE_ADMISSIONS.inc(result="admitted")
+            log.info(
+                "filter: best-effort pod %s → node %s (headroom %.3f)",
+                pod["metadata"]["name"], name, score,
+            )
+            return FilterResult(node=name, failed=failed, error=""), enc, verdicts
+        _BE_ADMISSIONS.inc(result="rejected")
+        return (
+            FilterResult(
+                None, failed,
+                "no chip passed best-effort admission gates",
+            ),
+            None,
+            verdicts,
+        )
+
+    def reconcile_evictions(self, pods: Optional[list] = None) -> int:
+        """Turn the monitor arbiter's ``vtpu.io/evict-requested``
+        annotations into pod deletes (the API sim / real API server both
+        expose delete_pod) and release the overlay booking immediately.
+        Leader-only in sharded deployments (N replicas racing the same
+        DELETE is churn).  Returns the number of pods evicted."""
+        if not self.is_write_leader():
+            return 0
+        if pods is None:
+            try:
+                pods = self.client.list_pods()
+            except Exception:  # noqa: BLE001 — next poll retries
+                log.exception("eviction reconcile: pod list failed")
+                return 0
+        evicted = 0
+        ignored_now: set = set()
+        for pod in pods:
+            annos = get_annotations(pod)
+            req = annos.get(annotations.EVICT_REQUESTED)
+            if not req:
+                continue
+            if pod_qos(annos) != QosClass.BEST_EFFORT:
+                # only the opportunistic tier is preemptible — a stray
+                # annotation on a guaranteed pod is ignored loudly, but
+                # only ONCE per pod: this runs every registry poll and
+                # the annotation never clears itself
+                uid = pod_uid(pod)
+                ignored_now.add(uid)
+                if uid not in self._evict_ignored_warned:
+                    self._evict_ignored_warned.add(uid)
+                    log.warning(
+                        "eviction requested on non-best-effort pod %s; "
+                        "ignoring", pod["metadata"]["name"],
+                    )
+                continue
+            if pod.get("status", {}).get("phase") in ("Succeeded", "Failed"):
+                continue
+            ns = pod["metadata"].get("namespace", "default")
+            name = pod["metadata"]["name"]
+            uid = pod_uid(pod)
+            try:
+                self.client.delete_pod(ns, name)
+            except Exception:  # noqa: BLE001 — pod may already be gone
+                log.exception("eviction reconcile: delete of %s/%s failed",
+                              ns, name)
+                continue
+            # prompt release: the overlay booking (and any patch-machinery
+            # state) goes now, not at the next ingest sweep
+            self.pods.rm_pod(uid)
+            _PREEMPT_EVICTIONS.inc()
+            emit(
+                EventType.POD_EVICTED, "scheduler",
+                pod=uid, node=annos.get(annotations.ASSIGNED_NODE, ""),
+                name=name, reason=req,
+            )
+            evicted += 1
+        # forget pods whose stray annotation (or the pod itself) is gone,
+        # so the set stays bounded and a re-marked pod warns again
+        self._evict_ignored_warned &= ignored_now
+        return evicted
 
     # ------------------------------------------------------------------
     # Sharded-replica surface (vtpu/scheduler/shard.py + routes)

@@ -71,7 +71,7 @@ from vtpu.utils.types import ContainerDevice, PodDevices, annotations
 
 log = logging.getLogger(__name__)
 
-GANG_NAME = "vtpu.io/gang-name"
+GANG_NAME = annotations.GANG_NAME
 GANG_SIZE = "vtpu.io/gang-size"
 GANG_MESH = "vtpu.io/gang-mesh"
 

@@ -53,6 +53,15 @@ _PATCH_LOCKS = _REG.gauge(
     "Per-pod assignment-patch lock entries (kind=tracked: live now, "
     "kind=hwm: high-water mark since start)",
 )
+# best-effort overlay ledger size (docs/scheduler_perf.md §Best-effort
+# oversubscription): bookings admitted ABOVE booked capacity — kept out
+# of the golden-guarded legacy vtpu_usage_cache_tracked family so the
+# pre-overlay exposition stays byte-identical
+_OVERLAY_BOOKINGS = _REG.gauge(
+    "vtpu_besteffort_overlay_bookings_total",
+    "Live best-effort overlay bookings (admitted above booked capacity; "
+    "strictly outside the guaranteed booking aggregates)",
+)
 _gauge_lock = threading.Lock()
 _prev_frag: Set[Tuple[str, ...]] = set()
 _prev_hist: Set[str] = set()
@@ -91,7 +100,10 @@ def _update_capacity_gauges(sched: Scheduler, usage: Dict[str, NodeUsage]) -> No
         frag_now.add((name,))
         hist[str(free)] = hist.get(str(free), 0) + 1
     duty_now: Set[Tuple[str, str]] = set()
-    for name, payload in sched.usage_cache.measured_utilization().items():
+    # names= subset: only nodes in the rendered usage view — the copy is
+    # O(tracked nodes we are exporting), never O(every payload ingested)
+    measured = sched.usage_cache.measured_utilization(names=usage)
+    for name, payload in measured.items():
         devices = payload.get("devices") if isinstance(payload, dict) else None
         if not isinstance(devices, dict):
             continue
@@ -280,6 +292,7 @@ def render_metrics(sched: Scheduler, include_obs: bool = True) -> str:
     plocks = sched.patch_lock_stats()
     _PATCH_LOCKS.set(plocks["tracked"], kind="tracked")
     _PATCH_LOCKS.set(plocks["hwm"], kind="hwm")
+    _OVERLAY_BOOKINGS.set(cache["overlay_bookings"])
     # "obs" carries the cross-component families (event counts, readiness
     # breakdown) — rendered once, after this component's own registry
     return (legacy

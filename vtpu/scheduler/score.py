@@ -326,6 +326,76 @@ def score_node(node: NodeUsage, policy: str = "binpack") -> float:
     return util if policy == "binpack" else 1.0 - util
 
 
+def measured_headroom(payload: Optional[dict]) -> Optional[float]:
+    """Mean measured headroom across a node's devices from a decoded
+    ``vtpu.io/node-utilization`` payload: ``mean(clamp(1 - duty, 0, 1))``
+    — 1.0 when every chip sat idle over the last sample window, 0.0 when
+    all of them ran flat out.  None when the payload carries no usable
+    device duties (never written back, or malformed)."""
+    if not isinstance(payload, dict):
+        return None
+    devices = payload.get("devices")
+    if not isinstance(devices, dict) or not devices:
+        return None
+    total, n = 0.0, 0
+    for rec in devices.values():
+        try:
+            duty = float(rec.get("duty", 0.0))
+        except (AttributeError, TypeError, ValueError):
+            continue
+        total += min(1.0, max(0.0, 1.0 - duty))
+        n += 1
+    if n == 0:
+        return None
+    return total / n
+
+
+def blend_measured(
+    booked_score: float,
+    payload: Optional[dict],
+    now: float,
+    max_age_s: float,
+    weight: float,
+) -> Tuple[float, Optional[dict]]:
+    """Blend a node's booked score with its measured headroom (both
+    policies: scores are "higher wins" in binpack and spread alike, and
+    real idle capacity makes a node better under either).
+
+    Decayed and staleness-gated: the effective weight is
+    ``weight × (1 − age/max_age)`` — a fresh snapshot pulls the full
+    weight, one approaching ``max_age_s`` barely registers, and anything
+    at or past the gate (or absent/unusable) falls back to booked-only.
+    Returns ``(score, inputs)`` where ``inputs`` records what the blend
+    consumed for the decision audit log (None = booked-only with no
+    measurement at all)."""
+    if weight <= 0:
+        return booked_score, None
+    if not isinstance(payload, dict):
+        return booked_score, None
+    try:
+        ts = float(payload.get("ts"))
+    except (TypeError, ValueError):
+        return booked_score, None
+    age = now - ts
+    if age >= max_age_s:
+        return booked_score, {
+            "stale": True, "age_s": round(age, 1), "weight": 0.0,
+        }
+    headroom = measured_headroom(payload)
+    if headroom is None:
+        return booked_score, None
+    decay = 1.0 - max(0.0, age) / max_age_s
+    w = min(1.0, max(0.0, weight)) * decay
+    blended = (1.0 - w) * booked_score + w * headroom
+    return blended, {
+        "stale": False,
+        "age_s": round(age, 1),
+        "weight": round(w, 4),
+        "headroom": round(headroom, 4),
+        "booked_score": round(booked_score, 6),
+    }
+
+
 def bounding_shape(coords) -> Tuple[int, int, int]:
     """Axis-aligned bounding-box dims of a coord set — for a rectangular
     carve this IS its shape, which is what ``slice_affinity`` wants as

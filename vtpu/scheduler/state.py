@@ -18,7 +18,14 @@ from typing import Dict, List, Optional
 from vtpu.k8s.objects import get_annotations, pod_uid
 from vtpu.obs.events import EventType, emit
 from vtpu.utils import codec
-from vtpu.utils.types import BindPhase, ChipInfo, PodDevices, annotations
+from vtpu.utils.types import (
+    BindPhase,
+    ChipInfo,
+    PodDevices,
+    QosClass,
+    annotations,
+    pod_qos,
+)
 
 # A filter books locally before the assignment-annotation patch lands on
 # the API server (the patch runs outside the filter lock).  Until the
@@ -50,6 +57,9 @@ class PodInfo:
     # True while the filter's local booking awaits its annotation patch
     pending: bool = False
     pending_since: float = 0.0
+    # QoS tier (vtpu.io/qos): best-effort pods live in the usage cache's
+    # overlay ledger, not the guaranteed booking aggregates
+    qos: str = QosClass.GUARANTEED
 
 
 class NodeManager:
@@ -170,6 +180,7 @@ class PodManager:
     ) -> None:
         with self._lock:
             uid = pod_uid(pod)
+            qos = pod_qos(get_annotations(pod))
             prev = self._pods.get(uid)
             self._pods[uid] = PodInfo(
                 namespace=pod["metadata"].get("namespace", "default"),
@@ -179,13 +190,19 @@ class PodManager:
                 devices=devices,
                 pending=pending,
                 pending_since=time.monotonic() if pending else 0.0,
+                qos=qos,
             )
             # the steady-state poll re-ingests every pod each sweep; an
             # unchanged booking needs no cache delta
-            if prev is not None and prev.node == node and prev.devices == devices:
+            if (
+                prev is not None
+                and prev.node == node
+                and prev.devices == devices
+                and prev.qos == qos
+            ):
                 return
             for li in self._listeners:
-                li.on_pod_changed(uid, node, devices)
+                li.on_pod_changed(uid, node, devices, qos=qos)
 
     def confirm_pod(self, uid: str, node: str) -> None:
         """The filter's assignment patch for ``node`` landed: that booking

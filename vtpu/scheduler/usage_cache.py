@@ -93,6 +93,22 @@ class UsageCache:
         # Observability-side state: never part of the booking aggregates,
         # so it cannot perturb oracle equivalence with nodes_usage().
         self._measured: Dict[str, dict] = {}
+        # sustained-idle tracking for best-effort overlay admission:
+        # node → {uuid: write-back ts at which the device's reported duty
+        # FIRST stayed at/under idle_duty_threshold without interruption}.
+        # Maintained at ingest so the filter's gate is a dict lookup.
+        self.idle_duty_threshold = 0.3
+        self._idle_since: Dict[str, Dict[str, float]] = {}
+        # best-effort overlay ledger (docs/scheduler_perf.md §Best-effort
+        # oversubscription): bookings admitted ABOVE booked capacity on
+        # measured-idle chips.  Strictly separate from the guaranteed
+        # ledger — never applied to the node aggregates, never visible to
+        # try_book/the CAS generations, never part of bookings_snapshot()
+        # — so guaranteed booking math and oracle equivalence stay exact.
+        self._overlay: Dict[str, _PodBooking] = {}
+        # derived per-node per-chip overlay sums {node: {uuid: [mem,
+        # cores, count]}} so admission caps are O(request), not O(pods)
+        self._overlay_agg: Dict[str, Dict[str, list]] = {}
         # perf counters (read via stats(); exported on /metrics)
         self.hits = 0            # nodes served from a clean aggregate
         self.dirty_rebuilds = 0  # lazy full rebuilds of one node
@@ -128,24 +144,87 @@ class UsageCache:
         with self._lock:
             self._entries.pop(name, None)
             self._measured.pop(name, None)
+            self._idle_since.pop(name, None)
 
     # -- measured utilization (monitor write-back ingest) --------------
     def note_node_utilization(self, name: str, payload: dict) -> None:
         """Ingest one node's decoded ``vtpu.io/node-utilization``
-        annotation (the registry poll calls this on every pass)."""
+        annotation (the registry poll calls this on every pass), and
+        advance the per-chip sustained-idle tracker: a device whose
+        reported duty is at/under ``idle_duty_threshold`` keeps (or
+        gains) its ``idle since`` stamp; one above it is reset — the
+        best-effort gate requires an UNINTERRUPTED idle run."""
         with self._lock:
             self._measured[name] = payload
+            devices = (
+                payload.get("devices") if isinstance(payload, dict) else None
+            )
+            try:
+                ts = float(payload.get("ts"))
+            except (AttributeError, TypeError, ValueError):
+                ts = None
+            if not isinstance(devices, dict) or ts is None:
+                self._idle_since.pop(name, None)
+                return
+            since = self._idle_since.setdefault(name, {})
+            for uuid, rec in devices.items():
+                try:
+                    duty = float(rec.get("duty", 0.0))
+                except (AttributeError, TypeError, ValueError):
+                    since.pop(uuid, None)
+                    continue
+                if duty <= self.idle_duty_threshold:
+                    since.setdefault(uuid, ts)
+                else:
+                    since.pop(uuid, None)
+            # devices that vanished from the write-back are unknown, not
+            # idle — drop their streak
+            for uuid in [u for u in since if u not in devices]:
+                since.pop(uuid, None)
 
-    def measured_utilization(self, name: Optional[str] = None):
+    def measured_utilization(
+        self, name: Optional[str] = None, names=None
+    ):
         """One node's measured-utilization payload (None when the monitor
-        has not written back), or a {node: payload} snapshot of all."""
+        has not written back), a ``names=`` subset ({node: payload} for
+        the nodes given that have one — the filter hot path's shape: the
+        per-decision snapshot copy is O(verdict nodes), not O(cluster)),
+        or a {node: payload} snapshot of all."""
         with self._lock:
             if name is not None:
                 return self._measured.get(name)
+            if names is not None:
+                m = self._measured
+                return {n: m[n] for n in names if n in m}
             return dict(self._measured)
 
-    def on_pod_changed(self, uid: str, node: str, devices: PodDevices) -> None:
+    def on_pod_changed(
+        self, uid: str, node: str, devices: PodDevices,
+        qos: str = "guaranteed",
+    ) -> None:
+        if qos == "best-effort":
+            # overlay adoption (ingest replay of a best-effort pod's
+            # assignment annotations, or the no-op replay after
+            # try_book_besteffort): unconditional — the admission gates
+            # ran at filter time; a booking already on the bus must be
+            # re-adopted after a restart regardless of current duty
+            with self._lock:
+                self._reverse_booking(uid)
+                self._bookings.pop(uid, None)
+                prev = self._overlay.get(uid)
+                if (
+                    prev is not None
+                    and prev.node == node
+                    and prev.devices == devices
+                ):
+                    return
+                self._overlay_remove_locked(uid)
+                self._overlay_add_locked(uid, node, devices)
+            return
         with self._lock:
+            # a pod re-ingested as guaranteed cannot keep an overlay
+            # booking (one ledger per pod)
+            self._overlay_remove_locked(uid)
             prev = self._bookings.get(uid)
             if prev is not None and prev.node == node and prev.devices == devices:
                 # already applied by a try_book CAS commit — the manager
@@ -180,6 +259,8 @@ class UsageCache:
             # a re-filtered pod replaces its previous booking (possibly on
             # another node) in the same atomic step — the reversal and the
             # new delta both bump generations, invalidating stale readers
+            # (and a pod booking guaranteed cannot keep an overlay entry)
+            self._overlay_remove_locked(uid)
             self._reverse_booking(uid)
             self._bookings[uid] = _PodBooking(node, devices)
             self._apply_delta(node, devices, sign=1)
@@ -189,6 +270,161 @@ class UsageCache:
         with self._lock:
             self._reverse_booking(uid)
             self._bookings.pop(uid, None)
+            self._overlay_remove_locked(uid)
+
+    # -- best-effort overlay ledger ------------------------------------
+    def _overlay_add_locked(
+        self, uid: str, node: str, devices: PodDevices
+    ) -> None:
+        self._overlay[uid] = _PodBooking(node, devices)
+        agg = self._overlay_agg.setdefault(node, {})
+        for ctr in devices:
+            for cd in ctr:
+                ent = agg.setdefault(cd.uuid, [0, 0, 0])
+                ent[0] += cd.usedmem
+                ent[1] += cd.usedcores
+                ent[2] += 1
+
+    def _overlay_remove_locked(self, uid: str) -> None:
+        prev = self._overlay.pop(uid, None)
+        if prev is None:
+            return
+        agg = self._overlay_agg.get(prev.node)
+        if agg is None:
+            return
+        for ctr in prev.devices:
+            for cd in ctr:
+                ent = agg.get(cd.uuid)
+                if ent is None:
+                    continue
+                ent[0] -= cd.usedmem
+                ent[1] -= cd.usedcores
+                ent[2] -= 1
+                if ent[2] <= 0 and ent[0] <= 0 and ent[1] <= 0:
+                    agg.pop(cd.uuid, None)
+        if not agg:
+            self._overlay_agg.pop(prev.node, None)
+
+    def try_book_besteffort(
+        self,
+        uid: str,
+        node: str,
+        devices: PodDevices,
+        now: float,
+        idle_window_s: float,
+        max_age_s: float,
+    ) -> Optional[str]:
+        """Atomically validate + book a best-effort overlay placement.
+        Returns None on success or a human-readable reject reason.
+
+        Gates (all re-checked under the cache lock, so a racing admission
+        cannot over-fill the overlay):
+
+        - the node is registered and every requested uuid is a live chip;
+        - the node has a FRESH utilization write-back (ts within
+          ``max_age_s`` of ``now`` — measured admission must never run on
+          a dead monitor's last word);
+        - every requested chip's measured duty has stayed at/under
+          ``idle_duty_threshold`` for at least ``idle_window_s``
+          (sustained idle, tracked at ingest);
+        - the overlay tier itself stays within one chip's physical
+          capacity per chip (Σ overlay mem ≤ totalmem, Σ overlay cores ≤
+          totalcores) — the overlay rides ABOVE booked quota by design,
+          so this cap is what keeps it physically meaningful while the
+          squeeze/evict loop protects the guaranteed tier at runtime.
+        """
+        with self._lock:
+            entry = self._entries.get(node)
+            if entry is None:
+                return "no vtpu devices registered"
+            usage = self._rebuilt(node, entry)
+            by_uuid = {d.uuid: d for d in usage.devices}
+            payload = self._measured.get(node)
+            try:
+                ts = float(payload.get("ts"))  # type: ignore[union-attr]
+            except (AttributeError, TypeError, ValueError):
+                return "no utilization measurement"
+            if now - ts >= max_age_s:
+                return "utilization measurement stale"
+            # a re-filtered best-effort pod replaces its previous overlay
+            # booking atomically (and can never hold a guaranteed one):
+            # drop the old booking FIRST so its own sums don't fail the
+            # capacity gates, and restore it on any reject — the whole
+            # dance is under one lock hold, so nothing observes the gap
+            prev = self._overlay.get(uid)
+            if prev is not None:
+                self._overlay_remove_locked(uid)
+
+            def _reject(reason: str) -> str:
+                if prev is not None:
+                    self._overlay_add_locked(uid, prev.node, prev.devices)
+                return reason
+
+            since = self._idle_since.get(node, {})
+            agg = self._overlay_agg.get(node, {})
+            want: Dict[str, list] = {}
+            for ctr in devices:
+                for cd in ctr:
+                    ent = want.setdefault(cd.uuid, [0, 0])
+                    ent[0] += cd.usedmem
+                    ent[1] += cd.usedcores
+            for uuid, (mem, cores) in want.items():
+                dev = by_uuid.get(uuid)
+                if dev is None or not dev.health:
+                    return _reject(f"chip {uuid} not registered/healthy")
+                idle_t = since.get(uuid)
+                if idle_t is None:
+                    return _reject(f"chip {uuid} not measured idle")
+                if ts - idle_t < idle_window_s:
+                    return _reject(f"chip {uuid} idle run too short")
+                have = agg.get(uuid, [0, 0, 0])
+                if have[0] + mem > dev.totalmem:
+                    return _reject(f"chip {uuid} overlay memory exhausted")
+                if have[1] + cores > dev.totalcores:
+                    return _reject(f"chip {uuid} overlay cores exhausted")
+            self._overlay_add_locked(uid, node, devices)
+            return None
+
+    def overlay_snapshot(self) -> Dict[str, Tuple[str, PodDevices]]:
+        """``{pod uid: (node, devices)}`` of the best-effort overlay —
+        the auditor's ledger for its distinct overlay drift class."""
+        with self._lock:
+            return {
+                uid: (b.node, b.devices) for uid, b in self._overlay.items()
+            }
+
+    def overlay_usage(
+        self, node: str, exclude_uid: Optional[str] = None
+    ) -> Dict[str, Tuple[int, int, int]]:
+        """Per-chip overlay sums on one node: {uuid: (mem MiB, cores,
+        bookings)}.  ``exclude_uid``'s own booking is subtracted — a
+        re-filtered best-effort pod must not see its previous overlay
+        booking as occupancy (try_book_besteffort replaces it)."""
+        with self._lock:
+            sums = {
+                uuid: list(ent)
+                for uuid, ent in self._overlay_agg.get(node, {}).items()
+            }
+            prev = self._overlay.get(exclude_uid) if exclude_uid else None
+            if prev is not None and prev.node == node:
+                for ctr in prev.devices:
+                    for cd in ctr:
+                        ent = sums.get(cd.uuid)
+                        if ent is None:
+                            continue
+                        ent[0] -= cd.usedmem
+                        ent[1] -= cd.usedcores
+                        ent[2] -= 1
+                        if ent[2] <= 0 and ent[0] <= 0 and ent[1] <= 0:
+                            sums.pop(cd.uuid, None)
+            return {uuid: tuple(ent) for uuid, ent in sums.items()}
+
+    def idle_since_map(self, node: str) -> Dict[str, float]:
+        """One node's full {uuid: idle-since write-back ts} map — the
+        best-effort planner's bulk form of :meth:`idle_since` (one lock
+        hold instead of one per chip)."""
+        with self._lock:
+            return dict(self._idle_since.get(node, {}))
 
     # -- delta machinery ----------------------------------------------
     def _reverse_booking(self, uid: str) -> None:
@@ -343,4 +579,5 @@ class UsageCache:
                 "cas_conflicts": self.cas_conflicts,
                 "nodes": len(self._entries),
                 "bookings": len(self._bookings),
+                "overlay_bookings": len(self._overlay),
             }

@@ -19,7 +19,12 @@ from typing import List, Optional
 
 from vtpu.scheduler.config import SchedulerConfig
 from vtpu.utils.resources import _as_int, pod_requests_any
-from vtpu.utils.types import resources
+from vtpu.utils.types import (
+    BEST_EFFORT_PRIORITY,
+    QosClass,
+    annotations,
+    resources,
+)
 
 log = logging.getLogger(__name__)
 
@@ -67,6 +72,106 @@ def gang_ops(pod: dict) -> List[dict]:
                 "path": "/metadata/annotations/"
                         + _json_pointer_escape(gang_mod.GANG_MESH),
                 "value": canon,
+            })
+    return ops
+
+
+def declared_task_priority(pod: dict) -> Optional[int]:
+    """The most-privileged (lowest) task priority the pod EXPLICITLY
+    declares across its non-privileged containers — via the priority
+    resource limit or a preset ``TPU_TASK_PRIORITY`` env.  None when no
+    container declares one (the webhook/shim defaults apply)."""
+    lowest: Optional[int] = None
+    for ctr in pod.get("spec", {}).get("containers", []):
+        if _container_is_privileged(ctr):
+            continue
+        limits = (ctr.get("resources") or {}).get("limits") or {}
+        cands = [limits.get(resources.priority)]
+        cands += [
+            e.get("value") for e in (ctr.get("env") or [])
+            if e.get("name") == ENV_TASK_PRIORITY
+        ]
+        for raw in cands:
+            if raw is None:
+                continue
+            try:
+                val = _as_int(raw)
+            except (TypeError, ValueError):
+                continue
+            if lowest is None or val < lowest:
+                lowest = val
+    return lowest
+
+
+def validate_qos(pod: dict) -> str:
+    """Validate + normalize the pod's ``vtpu.io/qos`` annotation.
+    Returns the resolved tier; raises ValueError on an unknown value or
+    a contradictory best-effort spec — the caller surfaces it as an
+    admission warning (never a block: the filter re-validates and
+    rejects the contradictions, and treats unknown values as guaranteed,
+    so a typo degrades to the safe tier instead of silently
+    oversubscribing)."""
+    annos = pod.get("metadata", {}).get("annotations") or {}
+    raw = (annos.get(annotations.QOS) or "").strip()
+    if not raw:
+        return QosClass.GUARANTEED
+    qos = raw.lower()
+    if qos not in QosClass.ALL:
+        raise ValueError(
+            f"{annotations.QOS}={raw!r} (expected one of {QosClass.ALL})"
+        )
+    if qos == QosClass.BEST_EFFORT:
+        # contradictions the filter rejects outright: a gang member books
+        # real quota (no overlay), and an explicit guaranteed priority
+        # would exempt the tenant from the squeeze/evict loop that makes
+        # overlay admission safe
+        if (annos.get(annotations.GANG_NAME) or "").strip():
+            raise ValueError(
+                f"{annotations.QOS}=best-effort on a gang member "
+                f"({annotations.GANG_NAME} set): gang admission books "
+                "guaranteed quota; drop one of the two annotations"
+            )
+        prio = declared_task_priority(pod)
+        if prio is not None and prio < BEST_EFFORT_PRIORITY:
+            raise ValueError(
+                f"{annotations.QOS}=best-effort with explicit task "
+                f"priority {prio} (< {BEST_EFFORT_PRIORITY}): a "
+                "guaranteed-tier priority would exempt the tenant from "
+                "the monitor's squeeze/evict arbitration"
+            )
+    return qos
+
+
+def qos_ops(pod: dict) -> List[dict]:
+    """JSON-patch ops for the QoS tier: a best-effort pod's containers
+    get ``TPU_TASK_PRIORITY={BEST_EFFORT_PRIORITY}`` injected (unless the
+    pod sets a priority itself) so the monitor's contention arbiter can
+    tell the squeeze-first tier apart inside the shared region.  Raises
+    ValueError on an invalid qos value (warning at apply time)."""
+    if validate_qos(pod) != QosClass.BEST_EFFORT:
+        return []
+    ops: List[dict] = []
+    for i, ctr in enumerate(pod.get("spec", {}).get("containers", [])):
+        if _container_is_privileged(ctr):
+            continue
+        limits = (ctr.get("resources") or {}).get("limits") or {}
+        if limits.get(resources.priority) is not None:
+            continue  # explicit priority resource wins (mutate_pod injects)
+        env = ctr.get("env") or []
+        if any(e.get("name") == ENV_TASK_PRIORITY for e in env):
+            continue
+        env_entry = {
+            "name": ENV_TASK_PRIORITY, "value": str(BEST_EFFORT_PRIORITY)
+        }
+        if env:
+            ops.append({
+                "op": "add", "path": f"/spec/containers/{i}/env/-",
+                "value": env_entry,
+            })
+        else:
+            ops.append({
+                "op": "add", "path": f"/spec/containers/{i}/env",
+                "value": [env_entry],
             })
     return ops
 
@@ -157,6 +262,14 @@ def handle_admission_review(body: dict, config: SchedulerConfig) -> dict:
                 # the same message) but warn at apply time
                 response.setdefault("warnings", []).append(
                     f"vtpu gang spec invalid: {e}"
+                )
+            try:
+                ops += qos_ops(pod)
+            except ValueError as e:
+                # unknown qos value: admit as guaranteed, warn at apply
+                # time (the filter resolves unknown → guaranteed too)
+                response.setdefault("warnings", []).append(
+                    f"vtpu qos invalid: {e}"
                 )
             if ops:
                 response["patchType"] = "JSONPatch"

@@ -18,7 +18,11 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from vtpu import obs
-from vtpu.monitor.shared_region import RegionFile, open_region
+from vtpu.monitor.shared_region import (
+    RegionFile,
+    effective_core_limit,
+    open_region,
+)
 from vtpu.utils import trace
 
 log = logging.getLogger(__name__)
@@ -397,14 +401,7 @@ class ShimRuntime:
         estimate — the calibrated measurement on calibrate steps, the
         current step-time estimate otherwise — which the monitor's
         UtilizationSampler diffs into the per-pod duty-cycle ratio."""
-        if self.region is not None:
-            suspended = (
-                self.region.region.utilization_switch == 1
-                and self.core_policy != "force"
-            )
-        else:
-            suspended = False
-        q = self.core_limit
+        q, suspended = self._effective_quota()
         if not (0 < q < 100) or suspended:
             if self._last_step_s > 0:
                 self._note_launch(self._last_step_s)
@@ -457,6 +454,29 @@ class ShimRuntime:
             self._retire(out)
             self._pace_state = "calibrate"
         return out
+
+    def _effective_quota(self) -> tuple:
+        """Resolve ``(core quota %, suspended)`` for this dispatch from
+        the core limit, the policy, and the region's utilization_switch:
+
+        - switch 1 SUSPENDS throttling (priority arbitration) unless the
+          policy is ``force``;
+        - switch ≥ THROTTLE_LEVEL_MIN is the monitor arbiter's graduated
+          SQUEEZE (docs/scheduler_perf.md §Tiered preemption): the
+          effective quota halves per level via effective_core_limit —
+          imposed even on tenants with no quota of their own, since the
+          ladder exists to protect the guaranteed tier from best-effort
+          co-tenants.  Only policy ``disable`` opts out (the arbiter's
+          eviction path remains the backstop for such tenants)."""
+        if self.region is None:
+            return self.core_limit, False
+        switch = self.region.region.utilization_switch
+        if switch == 1:
+            return self.core_limit, self.core_policy != "force"
+        q = self.core_limit
+        if self.core_policy != "disable":
+            q = effective_core_limit(q, switch)
+        return q, False
 
     def _note_launch(self, busy_s: float, dev: int = 0) -> None:
         """Publish one launch + busy-ns estimate to the region (single
@@ -545,13 +565,7 @@ class ShimRuntime:
             if self.region is not None:
                 # synchronous path: the blocked call time IS the busy time
                 self._note_launch(dt)
-                suspended = (
-                    self.region.region.utilization_switch == 1
-                    and self.core_policy != "force"
-                )
-            else:
-                suspended = False
-            q = self.core_limit
+            q, suspended = self._effective_quota()
             if 0 < q < 100 and not suspended:
                 pause = dt * (100 - q) / q
                 self._clock.sleep(pause)

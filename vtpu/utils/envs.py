@@ -1,0 +1,23 @@
+"""Env-var parsing shared by scheduler and monitor config surfaces.
+
+One implementation so parsing semantics (empty string = default, bad
+value = default, never raise) cannot drift between daemons.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default

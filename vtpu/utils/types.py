@@ -37,6 +37,22 @@ class annotations:
     # -- pod: chip-type selectors (ref nvidia.com/use-gputype, nouse-gputype)
     USE_TPUTYPE = "vtpu.io/use-tputype"
     NOUSE_TPUTYPE = "vtpu.io/nouse-tputype"
+    # -- pod: QoS tier (rebuild addition — the utilization-loop tier).
+    # "guaranteed" (default when absent) books static quota; "best-effort"
+    # rides the overlay ledger: admitted above booked capacity on chips
+    # whose MEASURED duty stayed idle, squeezed by the monitor's throttle
+    # ladder under contention, and evicted last (docs/scheduler_perf.md
+    # §Utilization-aware scoring)
+    QOS = "vtpu.io/qos"
+    # -- pod: eviction request written by the monitor's feedback arbiter
+    # when a best-effort tenant kept a guaranteed tenant suppressed past
+    # VTPU_EVICT_AFTER_S; value "<reason>_<unix ts>".  The scheduler's
+    # reconciler turns it into a pod delete and releases the overlay.
+    EVICT_REQUESTED = "vtpu.io/evict-requested"
+    # -- pod: gang membership marker (full spec keys live in
+    # vtpu/scheduler/gang.py; the key is mirrored here so the QoS
+    # resolver below can see gang membership without importing it)
+    GANG_NAME = "vtpu.io/gang-name"
     # -- node: registry + handshake (per device vendor; TPU is the primary)
     NODE_HANDSHAKE = "vtpu.io/node-handshake-tpu"  # ref 4pd.io/node-handshake
     NODE_REGISTER = "vtpu.io/node-tpu-register"    # ref 4pd.io/node-nvidia-register
@@ -62,6 +78,42 @@ class BindPhase:
     ALLOCATING = "allocating"
     SUCCESS = "success"
     FAILED = "failed"
+
+
+class QosClass:
+    """Pod QoS tiers (annotation ``vtpu.io/qos``).  GUARANTEED is the
+    static-quota tier every pod had before the utilization loop;
+    BEST_EFFORT is the opportunistic tier living in the usage cache's
+    overlay ledger."""
+
+    GUARANTEED = "guaranteed"
+    BEST_EFFORT = "best-effort"
+
+    ALL = (GUARANTEED, BEST_EFFORT)
+
+
+def pod_qos(pod_annos) -> str:
+    """Resolve a pod's QoS tier from its annotations; unknown values fall
+    back to guaranteed (the webhook warns at admission time).
+
+    A gang member is ALWAYS guaranteed: the all-or-nothing reserve books
+    real quota, which the overlay tier deliberately does not.  The filter
+    rejects the combination outright; this override keeps ingest/replay
+    of an externally created pod from routing a live gang booking into
+    the overlay ledger (which would silently free its reserved chips)."""
+    annos = pod_annos or {}
+    qos = annos.get(annotations.QOS, "").strip().lower()
+    if qos not in QosClass.ALL:
+        return QosClass.GUARANTEED
+    if qos == QosClass.BEST_EFFORT and (annos.get(annotations.GANG_NAME) or "").strip():
+        return QosClass.GUARANTEED
+    return qos
+
+
+# shim task priority injected for best-effort tenants (TPU_TASK_PRIORITY):
+# 0 = high, 1 = low (both guaranteed), >= 2 = best-effort — the monitor's
+# contention arbiter squeezes these first via the throttle ladder
+BEST_EFFORT_PRIORITY = 2
 
 
 class HandshakeState:
