@@ -8,7 +8,8 @@ PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
 	bench-sched bench-serve bench-churn bench-disagg bench-gang \
-	bench-goodput obs-lint config-lint audit-check image chart clean tidy
+	bench-goodput bench-smoke obs-lint config-lint audit-check image \
+	chart clean tidy
 
 all: build
 
@@ -212,6 +213,13 @@ ifdef SMOKE
 else
 	$(PY) benchmarks/serving_disagg.py
 endif
+
+# every benchmark's smoke mode, artifacts redirected to scratch, each
+# emitted JSON structurally diffed against the committed docs/artifacts/
+# twin — a broken or silently reshaped bench fails HERE, minutes, not on
+# the next multi-minute full run (hack/bench_smoke.py; --only to subset)
+bench-smoke:
+	$(PY) hack/bench_smoke.py
 
 # (Re)arm the detached TPU-window watcher.  Safe to run unconditionally at
 # the start of every session: a live watcher keeps its lock and the new

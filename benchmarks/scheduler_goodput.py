@@ -442,15 +442,20 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true",
                     default=bool(os.environ.get("SMOKE")))
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default=None,
+                    help="write the full report here even in smoke mode "
+                         "(the bench-smoke aggregator's schema probe); "
+                         "default: the committed artifact, full runs only")
     args = ap.parse_args()
     report = run(smoke=args.smoke, seed=args.seed)
     print(json.dumps(report["comparison"], indent=2))
-    if not args.smoke:
-        os.makedirs(os.path.dirname(ARTIFACT), exist_ok=True)
-        with open(ARTIFACT, "w") as f:
+    out = args.out if args.out else (None if args.smoke else ARTIFACT)
+    if out:
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as f:
             json.dump(report, f, indent=2, sort_keys=True)
             f.write("\n")
-        print(f"wrote {ARTIFACT}")
+        print(f"wrote {out}")
     return 0
 
 

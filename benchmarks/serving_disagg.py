@@ -139,6 +139,250 @@ def run_exactness(n_requests: int) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Phase 1.5: the wire arm — real bytes over the chunked stream
+# ---------------------------------------------------------------------------
+
+def _overlap(lo, hi, spans):
+    got = 0.0
+    for a, b in spans:
+        got += max(0.0, min(hi, b) - max(lo, a))
+    return got
+
+
+def run_wire(n_requests: int, smoke: bool) -> dict:
+    """Real engines, real frames: a PrefillEngine feeds a DecodeEngine
+    through the chunked wire transport (loopback link — the same frames
+    HttpKVLink ships).  Measures (a) token-exactness vs monolithic,
+    (b) real payload bytes on the wire, (c) the HIDDEN FRACTION — how
+    much of each stream's open→FIN wall time overlaps prefill compute:
+    the stream opens right after its own prefill group, its D2H rides
+    behind the NEXT group's fused program, and its chunks push after
+    that program retires, so a healthy transport lives almost entirely
+    under compute.  Then the mid-stream-death fuzz matrix: torn links
+    (first chunk, mid-stream, every-frame/retries-exhausted) and a
+    receiver-side abort must leave BOTH pools leak-free."""
+    import threading
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from vtpu.models.transformer import TransformerLM
+    from vtpu.serving import kvpool
+    from vtpu.serving import transport as tp
+    from vtpu.serving.disagg import DecodeEngine, PrefillEngine
+    from vtpu.serving.paged import PagedBatcher
+
+    # wider than the sim model on purpose: prefill compute grows
+    # quadratically with width while cache bytes grow linearly, so this
+    # is the shape class where a transport EARNS its keep — the sim
+    # phases keep the small model for cheap calibration
+    kw = dict(vocab=128, d_model=192, depth=2, num_heads=4, max_seq=128)
+    m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=16,
+                      kv_pool_blocks=129)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"]
+    rng = np.random.default_rng(7)
+    lens = [112, 97, 116, 104, 88, 120, 93, 108]  # prefill-heavy prompts
+    news = [8, 6, 10, 4, 12, 6, 8, 5]
+    reqs = [(f"w{i}", rng.integers(0, 128, lens[i % len(lens)]).astype(
+        np.int32), news[i % len(news)]) for i in range(n_requests)]
+
+    mono = PagedBatcher(m, params, max_batch=8, eos_id=2)
+    for rid, p, n in reqs:
+        mono.submit(rid, p, num_new=n)
+    want = mono.run()
+
+    pf = PrefillEngine(m, params)
+    dec = DecodeEngine(m, params, max_batch=8, eos_id=2,
+                       replica_id="w0")
+    hub = tp.ReceiverHub(dec)
+    rep = tp.WireReplica(tp.LoopbackLink(hub), "w0", local=dec,
+                         chunk_blocks=4)
+
+    def drive(requests, per_round=1, measure=None):
+        """Open-loop drive, a few prompts per round: the overlap claim
+        is a STEADY-STATE property — each round's streams hide under
+        the NEXT round's fused prefill program.  A stream opens right
+        after its prefill group retires (the fused program's D2H for
+        its blocks is issued there and rides behind whatever runs
+        next), and a WRITER THREAD pushes its chunks while the next
+        group's prefill program computes — XLA releases the GIL, so on
+        this 2-vCPU box the frame pump and the compute genuinely
+        overlap, exactly the deployment shape (sender-side pump thread
+        vs the prefill engine's compute thread).  Decode runs inline
+        here only because the loopback bench hosts both roles in one
+        process; in a real topology it lives on another host, so the
+        loop keeps it OUTSIDE the measured stream lifetimes: streams
+        open after the decode window and FIN under the next prefill."""
+        staging = list(requests)
+        while (staging or pf.queue or rep.idle_senders() or dec.queue
+               or any(dec.active) or dec._inflight):
+            for rid, p, n in staging[:per_round]:
+                pf.submit(rid, p, num_new=n)
+            del staging[:per_round]
+            stop = threading.Event()
+
+            def _writer():
+                # pump until every open stream FINs (or the window ends
+                # and the residue drains below, counted as unhidden)
+                while not stop.is_set() and rep.idle_senders():
+                    try:
+                        rep.pump_streams()
+                    except tp.WireError:
+                        return
+                    if rep.idle_senders():
+                        time.sleep(50e-6)  # credit-starved: yield
+
+            w = None
+            t0 = time.perf_counter()
+            if rep.idle_senders():
+                # one main-thread pump FIRST: the senders' gather
+                # dispatches win the engine's dispatch fence while the
+                # device is idle, so the small gathers compute ahead of
+                # the fused prefill program and their D2H rides behind
+                # it — dispatched second, they'd queue behind the whole
+                # window and the chunks would drain unhidden
+                rep.pump_streams()
+                w = threading.Thread(target=_writer, daemon=True)
+                w.start()
+            results = pf.step()
+            t1 = time.perf_counter()
+            if results and measure is not None:
+                measure["busy"].append((t0, t1))
+            if w is not None:
+                stop.set()
+                w.join()
+            # a stream the window didn't cover drains here — wall time
+            # past the join counts AGAINST the hidden fraction
+            while rep.idle_senders():
+                before = tp.TRANSPORT_CHUNKS.value()
+                rep.pump_streams()
+                if (rep.idle_senders()
+                        and tp.TRANSPORT_CHUNKS.value() == before):
+                    dec.step()  # starved: retire slots → credits
+            dec.step()
+            for res in results:
+                rep.submit_handle(res.rid, res.handle, res.first_token,
+                                  res.num_new, source=pf,
+                                  submitted=res.submitted, admit=False)
+                if measure is not None:
+                    measure["streams"][res.rid] = rep._senders[-1]
+
+    # warmup: compile every program shape on the path (prefill buckets,
+    # the wire gather/put, adoption bind, decode window) so the overlap
+    # measurement sees steady-state costs, not one-time jit compiles
+    warm = [(f"warm{i}", rng.integers(0, 128, L).astype(np.int32), 3)
+            for i, L in enumerate([97, 104, 112, 120, 88, 116])]
+    drive(warm)
+
+    b0 = tp.TRANSPORT_BYTES.value()
+    c0 = tp.TRANSPORT_CHUNKS.value()
+    h0 = kvpool.HANDOFF_HOST_BYTES.value()
+    measure = {"busy": [], "streams": {}}
+    # two COOLDOWN prompts ride behind the measured set so the final
+    # measured streams still have a successor prefill window to hide
+    # under — the hidden fraction is a STEADY-STATE (prefill tier
+    # continuously fed) property, and a drained queue's last streams
+    # would otherwise measure the shutdown transient, not the transport
+    cool = [(f"cool{i}", rng.integers(0, 128, L).astype(np.int32), 3)
+            for i, L in enumerate([104, 112])]
+    measured_rids = {rid for rid, _p, _n in reqs}
+    t_start = time.perf_counter()
+    drive(list(reqs) + cool, measure=measure)
+    makespan = time.perf_counter() - t_start
+    prefill_busy = measure["busy"]
+    streams = {rid: s for rid, s in measure["streams"].items()
+               if rid in measured_rids}
+    dec._flush_first_tokens()
+    got = {rid: toks for rid, toks in dec.out.items()
+           if rid in measured_rids}
+    now = time.perf_counter()
+    durations, hidden = [], []
+    for rid, s in streams.items():
+        lo = s._t0                       # stamped at the OPEN frame
+        hi = s.finished_at or now        # stamped at the final ack
+        durations.append(hi - lo)
+        hidden.append(_overlap(lo, hi, prefill_busy))
+    total_d = sum(durations)
+    hidden_fraction = (sum(hidden) / total_d) if total_d > 0 else 0.0
+
+    def leak_free(pool):
+        st = pool.stats()
+        return (st["leased"] == 0 and st["detached_handles"] == 0
+                and st["free"] == st["pool_blocks"] - 1)
+
+    # -- mid-stream-death fuzz matrix ----------------------------------
+    def one_death(kind: str) -> bool:
+        """One request through a dying link; True = both pools clean."""
+        pfx = PrefillEngine(m, params)
+        decx = DecodeEngine(m, params, max_batch=4, eos_id=2)
+        hubx = tp.ReceiverHub(decx)
+        state = {"n": 0}
+
+        def fault(data):
+            fr = tp.decode_frame(data)
+            if fr.kind != tp.KIND_DATA or fr.seq == 0:
+                return
+            if kind == "first_chunk" and fr.seq == 1 and state["n"] == 0:
+                state["n"] += 1
+                raise OSError("torn")
+            if kind == "mid_stream" and fr.seq == 2 and state["n"] == 0:
+                state["n"] += 1
+                raise OSError("torn")
+            if kind == "every_frame":
+                raise OSError("torn")
+
+        repx = tp.WireReplica(tp.LoopbackLink(hubx, fault=fault), "wx",
+                              local=decx, chunk_blocks=1, retries=2)
+        pfx.submit("rx", rng.integers(0, 128, 40).astype(np.int32), 4)
+        res = pfx.step()[0]
+        try:
+            repx.submit_handle(res.rid, res.handle, res.first_token,
+                               res.num_new, source=pfx)
+            if kind == "receiver_abort":
+                hubx.abort_all()         # replica death mid-adoption
+                while repx.idle_senders():
+                    try:
+                        repx.step()
+                    except tp.WireError:
+                        break
+            else:
+                while repx.idle_senders():
+                    repx.step()
+        except tp.WireError:
+            pass
+        # drain whatever survived so slot-held blocks retire
+        while any(decx.active) or decx._inflight or decx.queue:
+            decx.step()
+        return leak_free(pfx.pool) and leak_free(decx.pool)
+
+    fuzz_kinds = ["first_chunk", "mid_stream", "every_frame",
+                  "receiver_abort"]
+    fuzz = {k: one_death(k) for k in fuzz_kinds}
+
+    bytes_moved = int(tp.TRANSPORT_BYTES.value() - b0)
+    res = {
+        "requests": n_requests,
+        "token_exact": got == want,
+        "bytes_on_wire": bytes_moved,
+        "chunks": int(tp.TRANSPORT_CHUNKS.value() - c0),
+        "streams": len(streams),
+        "host_bytes_accounted": int(
+            kvpool.HANDOFF_HOST_BYTES.value() - h0) == bytes_moved,
+        "hidden_fraction": round(hidden_fraction, 4),
+        "stream_ms_total": round(1e3 * total_d, 3),
+        "prefill_busy_ms_total": round(
+            1e3 * sum(b - a for a, b in prefill_busy), 3),
+        "makespan_ms": round(1e3 * makespan, 3),
+        "pools_leak_free": leak_free(pf.pool) and leak_free(dec.pool),
+        "death_fuzz": {**fuzz, "leak_free_all": all(fuzz.values())},
+    }
+    return res
+
+
+# ---------------------------------------------------------------------------
 # Phase 2a: unit calibration (the real compiled programs, timed)
 # ---------------------------------------------------------------------------
 
@@ -412,20 +656,100 @@ def _sim_prefill_device(reqs, units):
     return ready
 
 
+def _sim_prefill_dynamic(reqs, units, max_devices: int,
+                         high: int = 8, low: int = 2, cooldown: int = 2):
+    """A SHARED prefill tier scaling 1..max_devices on its own backlog
+    (the router's prefill-scaling policy on the virtual clock,
+    ``cooldown`` rounds between transitions like the router's
+    ``prefill_scale_cooldown``): each admission round partitions the
+    grabbed group round-robin over the active devices, which run in
+    parallel — elapsed time is the slowest device's bucketed program
+    chain.  Returns (ready list, scaling summary)."""
+    t = 0.0
+    idx = 0
+    ready = []
+    queue: list = []
+    n = len(reqs)
+    active = 1
+    transitions = 0
+    cool = 0
+    weighted_active = 0.0
+    last_t = 0.0
+    while idx < n or queue:
+        while idx < n and reqs[idx]["t"] <= t:
+            queue.append(reqs[idx])
+            idx += 1
+        if not queue:
+            if idx < n:
+                weighted_active += active * (reqs[idx]["t"] - t)
+                t = reqs[idx]["t"]
+                continue
+            break
+        backlog = len(queue)
+        if cool > 0:
+            cool -= 1
+        elif backlog > high * active and active < max_devices:
+            active += 1
+            transitions += 1
+            cool = cooldown
+        elif backlog < low * active and active > 1:
+            active -= 1
+            transitions += 1
+            cool = cooldown
+        group = queue[:MAX_BATCH * active]
+        del queue[:len(group)]
+        per_dev = [group[i::active] for i in range(active)]
+        elapsed = 0.0
+        for sub in per_dev:
+            if not sub:
+                continue
+            by_blen = {}
+            for r in sub:
+                by_blen.setdefault(r["blen"], []).append(r)
+            cost = sum(prefill_unit(units, _pow2(len(s)), blen)
+                       for blen, s in by_blen.items())
+            elapsed = max(elapsed, cost)
+        weighted_active += active * elapsed
+        t += elapsed
+        last_t = t
+        for r in group:
+            ready.append(dict(r, t=t))
+    return ready, {
+        "max_devices": max_devices,
+        "transitions": transitions,
+        "mean_active": round(weighted_active / max(1e-9, last_t), 2),
+    }
+
+
 def _hash_pick(sess: str, n: int) -> int:
     return int.from_bytes(hashlib.md5(sess.encode()).digest()[:4],
                           "big") % n
 
 
-def sim_arm(reqs, bursts, units, n_replicas: int) -> dict:
+def sim_arm(reqs, bursts, units, n_replicas: int,
+            dyn_prefill: int = 0) -> dict:
     """n_replicas == 0 → the monolithic arm (prefill interleaved with
     decode on one device); else the disaggregated arm (one prefill
-    device + n decode replicas behind session-affinity admission)."""
+    device per replica + n decode replicas behind session-affinity
+    admission).  ``dyn_prefill > 0`` replaces the per-replica prefill
+    devices with ONE shared tier autoscaling 1..dyn_prefill devices on
+    its backlog — the router-driven prefill-scaling policy."""
     cap = 3 * MAX_BATCH  # mirror the router's default backlog policy
+    scale = None
     if n_replicas == 0:
         tokens, last_t, gaps, shed = _sim_decode_unit(
             reqs, units, cap, adopt_mode=False)
         streams = [(tokens, last_t, gaps, shed)]
+    elif dyn_prefill > 0:
+        ready, scale = _sim_prefill_dynamic(reqs, units, dyn_prefill)
+        per_rep = [[] for _ in range(n_replicas)]
+        for r in ready:
+            per_rep[_hash_pick(r["sess"], n_replicas)].append(r)
+        streams = []
+        for sub in per_rep:
+            sub.sort(key=lambda r: r["t"])
+            streams.append(_sim_decode_unit(sub, units, cap,
+                                            adopt_mode=True))
     else:
         per_rep = [[] for _ in range(n_replicas)]
         for r in reqs:
@@ -444,7 +768,7 @@ def sim_arm(reqs, bursts, units, n_replicas: int) -> dict:
     burst_itl = [g for g, mid, kind in gaps
                  if kind == "steady"
                  and any(lo <= mid <= hi for lo, hi in bursts)]
-    return {
+    out = {
         "replicas": n_replicas,
         "requests": len(reqs),
         "shed": shed,
@@ -456,6 +780,9 @@ def sim_arm(reqs, bursts, units, n_replicas: int) -> dict:
         "burst_itl_p99_ms": round(1e3 * pct(burst_itl, 0.99), 3),
         "burst_itl_samples": len(burst_itl),
     }
+    if scale is not None:
+        out["prefill_scale"] = scale
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -502,6 +829,28 @@ def main(argv=None) -> int:
               "path", file=sys.stderr)
         return 1
 
+    print("[bench-disagg] phase 1.5: wire transport…",
+          file=sys.stderr, flush=True)
+    wire = run_wire(8 if smoke else 24, smoke)
+    if not wire["token_exact"]:
+        print("bench-disagg: wire transcripts diverged from monolithic",
+              file=sys.stderr)
+        return 1
+    if not wire["pools_leak_free"] or not wire["death_fuzz"][
+            "leak_free_all"]:
+        print("bench-disagg: wire transport leaked blocks",
+              file=sys.stderr)
+        return 1
+    if not wire["host_bytes_accounted"]:
+        print("bench-disagg: wire host bytes not accounted in the "
+              "handoff family", file=sys.stderr)
+        return 1
+    if not smoke and wire["hidden_fraction"] < 0.8:
+        print(f"bench-disagg: wire stream time only "
+              f"{wire['hidden_fraction']:.0%} hidden under prefill "
+              f"compute (< 80%)", file=sys.stderr)
+        return 1
+
     print("[bench-disagg] phase 2: calibrating program costs…",
           file=sys.stderr, flush=True)
     units = calibrate(ROWS_SMOKE if smoke else ROWS_FULL,
@@ -514,6 +863,8 @@ def main(argv=None) -> int:
         print(f"[bench-disagg] arm disagg_{n}…", file=sys.stderr,
               flush=True)
         arms[f"disagg_{n}"] = sim_arm(reqs, bursts, units, n)
+    print("[bench-disagg] arm disagg_dyn…", file=sys.stderr, flush=True)
+    arms["disagg_dyn"] = sim_arm(reqs, bursts, units, 4, dyn_prefill=4)
 
     mono, d4 = arms["monolithic"], arms["disagg_4"]
     headline = {
@@ -524,6 +875,10 @@ def main(argv=None) -> int:
         "burst_p99_within_mono_p50": (
             d4["burst_itl_p99_ms"] <= mono["decode_itl_p50_ms"]
         ),
+        "wire_hidden_fraction": wire["hidden_fraction"],
+        "wire_bytes": wire["bytes_on_wire"],
+        "dyn_mean_prefill_devices": arms["disagg_dyn"][
+            "prefill_scale"]["mean_active"],
     }
     res = {
         "metric": "serving_disaggregation",
@@ -545,6 +900,7 @@ def main(argv=None) -> int:
             "burst_size": args.burst_size,
         },
         "exactness": exact,
+        "wire": wire,
         "units": {k: round(v, 6) for k, v in units.items()},
         "arms": arms,
         "headline": headline,

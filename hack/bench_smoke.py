@@ -1,0 +1,216 @@
+#!/usr/bin/env python3
+"""`make bench-smoke`: run every benchmark's seconds-long smoke mode and
+fail on schema drift of the emitted artifact JSONs.
+
+Each full benchmark commits an artifact under docs/artifacts/; a code
+change that breaks a bench (crash, or a silently reshaped artifact the
+docs/EVIDENCE tables no longer describe) would otherwise surface only
+on the next multi-minute full run.  This aggregator is the tier-1
+tripwire: every bench runs in its smoke mode with the artifact
+redirected to a scratch dir (the committed artifacts are never
+touched), and the emitted JSON's *structure* is diffed against the
+committed one.
+
+Schema = the tree of dict keys and JSON value kinds (bool / number /
+string / null / list-of / dict).  Two tolerances keep the diff honest
+without hard-coding every bench's shape:
+
+- **Variable-keyed paths** (`VARIABLE_PATHS`): collections whose key
+  sets legitimately depend on run parameters (the churn bench's smoke
+  mode runs 3 of the 5 committed arms; the disagg bench calibrates a
+  reduced shape set).  Key sets may differ there, but the entries
+  present on both sides must still match structurally, and the
+  intersection must be non-empty.
+- Lists compare their first element's schema (element type drift is
+  caught; lengths are data).
+
+Usage: python hack/bench_smoke.py [--only sched,churn,...] [--keep]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACTS = os.path.join(REPO, "docs", "artifacts")
+
+# name → (committed artifact, argv tail, extra env).  Every command gets
+# the scratch artifact path appended after ``--out``.
+BENCHES = {
+    "sched": (
+        "scheduler_scale.json",
+        [sys.executable, "benchmarks/scheduler_scale.py",
+         "--nodes", "60", "--pods", "20"],
+        {},
+    ),
+    "churn": (
+        "scheduler_churn.json",
+        [sys.executable, "benchmarks/scheduler_churn.py", "--smoke"],
+        {"JAX_PLATFORMS": "cpu"},
+    ),
+    "gang": (
+        "scheduler_gang.json",
+        [sys.executable, "benchmarks/scheduler_gang.py", "--smoke"],
+        {"JAX_PLATFORMS": "cpu"},
+    ),
+    "goodput": (
+        "scheduler_goodput.json",
+        [sys.executable, "benchmarks/scheduler_goodput.py", "--smoke"],
+        {"JAX_PLATFORMS": "cpu"},
+    ),
+    "disagg": (
+        "serving_disagg.json",
+        [sys.executable, "benchmarks/serving_disagg.py", "--smoke"],
+        {},
+    ),
+}
+
+# paths (tuples of dict keys from the artifact root) whose KEY SETS are
+# run-parameter-dependent; "*" matches any key at that level
+VARIABLE_PATHS = {
+    ("arms",),                 # churn smoke runs a subset of arms
+    ("units",),                # disagg smoke calibrates fewer shapes
+    ("config", "model"),       # model kw dict is bench-internal
+}
+
+
+def _kind(x) -> str:
+    if isinstance(x, bool):
+        return "bool"
+    if isinstance(x, (int, float)):
+        return "num"
+    if isinstance(x, str):
+        return "str"
+    if x is None:
+        return "null"
+    if isinstance(x, list):
+        return "list"
+    if isinstance(x, dict):
+        return "dict"
+    return type(x).__name__
+
+
+def _variable(path) -> bool:
+    for pat in VARIABLE_PATHS:
+        if len(pat) == len(path) and all(
+            p == "*" or p == q for p, q in zip(pat, path)
+        ):
+            return True
+    return False
+
+
+def diff_schema(committed, emitted, path=()) -> list:
+    """Structural drift between the committed artifact and a freshly
+    emitted one, as a list of human-readable strings (empty = clean)."""
+    out = []
+    where = "/".join(map(str, path)) or "<root>"
+    ck, ek = _kind(committed), _kind(emitted)
+    if ck != ek:
+        # int vs float is not drift; anything else is
+        return [f"{where}: committed {ck} vs emitted {ek}"]
+    if ck == "dict":
+        cs, es = set(committed), set(emitted)
+        if _variable(path):
+            if cs and es and not (cs & es):
+                out.append(
+                    f"{where}: variable-keyed collection shares no keys "
+                    f"with the committed artifact"
+                )
+            common = cs & es
+        else:
+            for k in sorted(cs - es):
+                out.append(f"{where}: key '{k}' missing from emitted "
+                           f"artifact")
+            for k in sorted(es - cs):
+                out.append(f"{where}: emitted artifact adds key '{k}' "
+                           f"(regenerate the committed artifact)")
+            common = cs & es
+        for k in sorted(common):
+            out.extend(diff_schema(committed[k], emitted[k], path + (k,)))
+    elif ck == "list":
+        if committed and emitted:
+            out.extend(diff_schema(committed[0], emitted[0],
+                                   path + ("[]",)))
+    return out
+
+
+def run_one(name: str, scratch: str) -> list:
+    artifact, argv, env_extra = BENCHES[name]
+    committed_path = os.path.join(ARTIFACTS, artifact)
+    emitted_path = os.path.join(scratch, artifact)
+    env = dict(os.environ, **env_extra)
+    env.pop("SMOKE", None)  # the argv carries --smoke explicitly
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        argv + ["--out", emitted_path],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    dt = time.monotonic() - t0
+    tail = proc.stdout.decode(errors="replace").strip().splitlines()[-12:]
+    if proc.returncode != 0:
+        return [f"{name}: bench exited {proc.returncode} after {dt:.0f}s:"]\
+            + [f"  | {ln}" for ln in tail]
+    if not os.path.exists(emitted_path):
+        return [f"{name}: bench wrote no artifact at {emitted_path}"]
+    try:
+        emitted = json.load(open(emitted_path))
+    except ValueError as e:
+        return [f"{name}: emitted artifact is not JSON: {e}"]
+    if not os.path.exists(committed_path):
+        return [f"{name}: no committed artifact {committed_path} to "
+                f"diff against (run the full bench once and commit it)"]
+    committed = json.load(open(committed_path))
+    drift = diff_schema(committed, emitted)
+    if drift:
+        return [f"{name}: artifact schema drifted vs "
+                f"docs/artifacts/{artifact}:"] + [f"  {d}" for d in drift]
+    print(f"[bench-smoke] {name}: OK ({dt:.0f}s, schema matches "
+          f"docs/artifacts/{artifact})", flush=True)
+    return []
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--only", default="",
+                    help="comma list of bench names (default: all of "
+                         + ",".join(BENCHES) + ")")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch artifact dir (printed)")
+    args = ap.parse_args(argv)
+    names = ([n.strip() for n in args.only.split(",") if n.strip()]
+             or list(BENCHES))
+    unknown = [n for n in names if n not in BENCHES]
+    if unknown:
+        print(f"bench-smoke: unknown bench(es) {unknown}; have "
+              f"{sorted(BENCHES)}", file=sys.stderr)
+        return 2
+    scratch = tempfile.mkdtemp(prefix="vtpu-bench-smoke-")
+    failures = []
+    try:
+        for name in names:
+            print(f"[bench-smoke] running {name}…", flush=True)
+            failures.extend(run_one(name, scratch))
+    finally:
+        if args.keep:
+            print(f"[bench-smoke] scratch artifacts kept at {scratch}")
+        else:
+            shutil.rmtree(scratch, ignore_errors=True)
+    if failures:
+        print("\n".join(failures), file=sys.stderr)
+        print(f"bench-smoke: FAILED ({len(failures)} finding(s))",
+              file=sys.stderr)
+        return 1
+    print(f"[bench-smoke] all {len(names)} bench(es) green")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -220,3 +220,108 @@ def test_bench_disagg_smoke_artifact_schema(tmp_path):
         assert arm["tokens_per_s"] > 0
         assert arm["decode_itl_p99_ms"] >= arm["decode_itl_p50_ms"] >= 0
     assert res["headline"]["tokens_per_s_x_disagg_4"] > 0
+
+
+# ---------------------------------------------------------------------------
+# wire transport over real engines (vtpu/serving/transport.py)
+# ---------------------------------------------------------------------------
+
+def _leak_free(pool):
+    st = pool.stats()
+    return (st["leased"] == 0 and st["detached_handles"] == 0
+            and st["free"] == st["pool_blocks"] - 1)
+
+
+def test_wire_transport_token_exact_and_leak_free(model_and_params):
+    """Real bytes over the chunked stream: the wire topology (prefill →
+    WireReplica → ReceiverHub → DecodeEngine) must stay token-identical
+    to the monolithic engine, with both pools leak-free and the
+    transport counters showing real host-staged traffic."""
+    from vtpu.serving import transport as tp
+    from vtpu.serving.router import Router, RouterReject
+
+    m, params = model_and_params
+    reqs = fuzz_requests(seed=11, n=10)
+    want = run_monolithic(m, params, reqs)
+
+    pf = PrefillEngine(m, params)
+    dec = DecodeEngine(m, params, max_batch=4, eos_id=2,
+                       replica_id="w0")
+    hub = tp.ReceiverHub(dec)
+    rep = tp.WireReplica(tp.LoopbackLink(hub), "w0", local=dec,
+                         chunk_blocks=2)
+    router = Router(pf, {"w0": rep})
+    b0 = tp.TRANSPORT_BYTES.value()
+    h0 = kvpool.HANDOFF_HOST_BYTES.value()
+    for i, (rid, p, n) in enumerate(reqs):
+        while True:
+            try:
+                router.submit(f"s{i % 3}", rid, p, num_new=n)
+                break
+            except RouterReject:
+                router.pump()
+    got = router.drain()
+    assert got == want
+    moved = tp.TRANSPORT_BYTES.value() - b0
+    assert moved > 0
+    # the wire path accounts its host bytes in the handoff family too
+    assert kvpool.HANDOFF_HOST_BYTES.value() - h0 == moved
+    assert _leak_free(pf.pool) and _leak_free(dec.pool)
+
+
+def test_wire_mid_stream_death_releases_both_pools(model_and_params):
+    """A link that dies mid-stream: the sender exhausts its resume
+    budget, aborts, and BOTH pools come back leak-free — the receiver's
+    partial adoption released, the source blocks freed."""
+    from vtpu.serving import transport as tp
+
+    m, params = model_and_params
+    pf = PrefillEngine(m, params)
+    dec = DecodeEngine(m, params, max_batch=4, eos_id=2)
+    hub = tp.ReceiverHub(dec)
+
+    def fault(data):
+        fr = tp.decode_frame(data)
+        if fr.kind == tp.KIND_DATA and fr.seq >= 1:
+            raise OSError("wire cut")
+
+    rep = tp.WireReplica(tp.LoopbackLink(hub, fault=fault), "w0",
+                         local=dec, chunk_blocks=1, retries=2)
+    pf.submit("r0", np.arange(9, dtype=np.int32) % 64, 4)
+    res = pf.step()[0]
+    with pytest.raises(tp.StreamAbortedError):
+        rep.submit_handle(res.rid, res.handle, res.first_token,
+                          res.num_new, source=pf)
+    assert _leak_free(pf.pool) and _leak_free(dec.pool)
+    assert hub.open_streams() == 0
+
+
+def test_purge_pending_frees_claimed_entry(model_and_params):
+    """Satellite fix: a submit_handle(admit=False) entry whose session
+    was released router-side must not sit in the pending queue until
+    the next admit_pending() — purge frees the claim immediately and
+    no fused-adoption slot is consumed."""
+    m, params = model_and_params
+    pf = PrefillEngine(m, params)
+    dec = DecodeEngine(m, params, max_batch=4, eos_id=2)
+    pf.submit("r0", np.arange(7, dtype=np.int32) % 64, 3)
+    res = pf.step()[0]
+    dec.submit_handle(res.rid, res.handle, res.first_token,
+                      res.num_new, source=pf, admit=False)
+    assert len(dec.queue) == 1
+    assert dec.purge_pending("r0") is True
+    assert len(dec.queue) == 0
+    dec.admit_pending()
+    assert not any(dec.active)          # no slot consumed
+    assert _leak_free(pf.pool) and _leak_free(dec.pool)
+    # the rid is reusable at the decode engine after the purge (its
+    # duplicate set cleared; the prefill engine keeps its own history)
+    pf.submit("r0b", np.arange(5, dtype=np.int32) % 64, 2)
+    res2 = pf.step()[0]
+    dec.submit_handle("r0", res2.handle, res2.first_token,
+                      res2.num_new, source=pf)
+    while any(dec.active) or dec._inflight or dec.queue:
+        dec.step()
+    dec._flush_first_tokens()
+    assert len(dec.out["r0"]) >= 1
+    assert _leak_free(pf.pool)

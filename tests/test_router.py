@@ -220,3 +220,284 @@ def test_shared_pool_prefill_requires_its_host_replica():
         Router(pf, {"d0": host, "d1": FakeReplica()})
     with pytest.raises(ValueError):
         Router(pf, {"d0": FakeReplica()})  # host not among the replicas
+
+
+# ---------------------------------------------------------------------------
+# prefill tier: scaling, health, cancel, wire backpressure
+# ---------------------------------------------------------------------------
+
+class SlowPrefill(FakePrefill):
+    """Prefill stand-in whose work never finishes on its own — the
+    backlog persists, so scaling decisions are deterministic."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.healthy = True
+        self.release = False
+
+    def ping(self):
+        if not self.healthy:
+            raise ConnectionError("prefill gone")
+        return True
+
+    def purge(self, rid):
+        for i, item in enumerate(self.queue):
+            if item[0] == rid:
+                del self.queue[i]
+                return True
+        return False
+
+    def step(self):
+        if not self.release:
+            return []
+        return super().step()
+
+
+class SaturableReplica(FakeReplica):
+    """Decode stand-in modelling wire credit exhaustion: handoffs raise
+    ReplicaSaturatedError while ``saturated`` is set (the handle stays
+    adoptable — exactly the WireReplica contract)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.saturated = False
+
+    def submit_handle(self, rid, handle, first_token, num_new,
+                      source=None, submitted=0.0):
+        from vtpu.serving.transport import ReplicaSaturatedError
+
+        if self.saturated:
+            raise ReplicaSaturatedError("no credits")
+        super().submit_handle(rid, handle, first_token, num_new,
+                              source=source, submitted=submitted)
+
+
+class PendingReplica(FakeReplica):
+    """Decode stand-in with a claimed-pending queue + purge_pending."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.pending = {}
+        self.purged = []
+
+    def submit_handle(self, rid, handle, first_token, num_new,
+                      source=None, submitted=0.0):
+        if source is not None:
+            blocks = source.pool.adopt(handle)  # claim, like the engine
+            self.pending[rid] = (source.pool, blocks)
+
+    def purge_pending(self, rid):
+        ent = self.pending.pop(rid, None)
+        if ent is None:
+            return False
+        pool, blocks = ent
+        pool.release(blocks)
+        self.purged.append(rid)
+        return True
+
+
+def test_prefill_scaling_drains_idle_and_restores_on_backlog():
+    pfs = {"p0": SlowPrefill(), "p1": SlowPrefill()}
+    reps = {"d0": FakeReplica()}
+    router = Router(pfs, reps, prefill_scale_high=4,
+                    prefill_scale_low=2, prefill_scale_cooldown=0)
+    assert router.stats()["prefill_active"] == ["p0", "p1"]
+    router.pump()                       # empty backlog → scale down one
+    assert router.stats()["prefill_active"] == ["p0"]
+    router.pump()                       # min_active floor holds
+    assert router.stats()["prefill_active"] == ["p0"]
+    for i in range(10):                 # deep backlog → restore p1
+        router.submit(f"s{i}", f"r{i}", [1, 2], 2)
+    router.pump()
+    assert router.stats()["prefill_active"] == ["p0", "p1"]
+    # new submissions now spread onto the restored replica
+    router.submit("sx", "rx", [1, 2], 2)
+    assert pfs["p1"].stats()["queued"] >= 1
+
+
+def test_multi_prefill_shed_releases_against_the_right_pool():
+    """An undeliverable result prefilled by p1 must release its handle
+    against p1's pool — popping the rid→prefill mapping before the
+    release made it fall back to the primary prefill, raise a
+    swallowed PoolMismatchError, and leak p1's blocks forever."""
+    pfs = {"p0": FakePrefill(), "p1": FakePrefill()}
+    rep = FakeReplica()
+    router = Router(pfs, {"d0": rep}, fail_threshold=1,
+                    prefill_scale_low=0)
+    pfs["p0"].queue.append(("decoy", [1], 1))   # p1 is least-queued
+    router.submit("s0", "r0", [1, 2, 3], 2)
+    assert pfs["p1"].stats()["queued"] == 1
+    pfs["p0"].queue.clear()
+    free0 = pfs["p1"].pool.stats()["free"]
+    rep.fail_handoffs = True                    # only replica dies
+    router.pump()                               # result sheds
+    st = pfs["p1"].pool.stats()
+    assert st["free"] == free0 and st["detached_handles"] == 0, st
+    assert router.stats()["pending_handoffs"]["d0"] == 0
+
+
+def test_prefill_health_drain_releases_its_admission_ledger():
+    """rids queued on a prefill that dies may never produce results;
+    their uncollected-backlog entries must be released on the health
+    drain, or the target decode replica's admission capacity stays
+    pinned by ghosts forever."""
+    pfs = {"p0": SlowPrefill(), "p1": SlowPrefill()}
+    rep = FakeReplica(max_batch=1)
+    router = Router(pfs, {"d0": rep}, fail_threshold=1, max_backlog=2,
+                    prefill_scale_low=0)
+    for i in range(3):                          # fill d0's whole limit
+        router.submit(f"s{i}", f"r{i}", [1], 1)
+    with pytest.raises(RouterReject):
+        router.submit("s3", "rx", [1], 1)       # saturated by backlog
+    pfs["p0"].healthy = pfs["p1"].healthy = False
+    router.check_health()                       # both drained
+    assert router.stats()["pending_handoffs"]["d0"] == 0
+    # capacity is back; admission fails only on the (dead) prefill tier
+    with pytest.raises(RouterReject) as e:
+        router.submit("s4", "ry", [1], 1)
+    assert e.value.reason == "no_healthy_prefill"
+    # a recovered prefill's LATE result still delivers (no double
+    # decrement, fallback routing) — the rid→prefill map survived
+    pfs["p0"].healthy = pfs["p1"].healthy = True
+    pfs["p0"].release = pfs["p1"].release = True
+    router.check_health()
+    router.pump()
+    assert sorted(rep.adopted) == ["r0", "r1", "r2"]
+    assert router.stats()["pending_handoffs"]["d0"] == 0
+
+
+def test_dead_prefill_stats_never_wedges_the_router():
+    """A prefill whose process died raises from stats() too (not just
+    ping()); every router surface — pump, submit routing, scaling,
+    idle, stats — must route around it instead of propagating."""
+
+    class DeadStatsPrefill(SlowPrefill):
+        def stats(self):
+            if not self.healthy:
+                raise ConnectionError("prefill process gone")
+            return super().stats()
+
+    pfs = {"p0": DeadStatsPrefill(), "p1": DeadStatsPrefill()}
+    pfs["p0"].release = pfs["p1"].release = True
+    rep = FakeReplica()
+    router = Router(pfs, {"d0": rep}, prefill_scale_low=0)
+    pfs["p0"].healthy = False           # dies between pings
+    router.pump()                       # scaling + step walk survive
+    assert router.stats()["prefill_queued"] == 0   # stats survives
+    assert router.idle()                # idle survives
+    r = router.submit("s0", "r0", [1, 2], 2)       # routing skips p0
+    assert r == "d0"
+    assert pfs["p1"].stats()["queued"] == 1
+    router.pump()
+    assert rep.adopted == ["r0"]
+    # both prefills dead → typed shed, never a raw ConnectionError
+    pfs["p1"].healthy = False
+    with pytest.raises(RouterReject) as e:
+        router.submit("s1", "r1", [1], 1)
+    assert e.value.reason == "no_healthy_prefill"
+
+
+def test_parked_handoffs_do_not_scale_up_prefill():
+    """Parked handoffs are blocked on DECODE credits — more prefill
+    capacity cannot shrink them, so they must not count as prefill
+    backlog (the old behaviour restored prefill replicas exactly when
+    decode was the bottleneck)."""
+    pfs = {"p0": SlowPrefill(), "p1": SlowPrefill()}
+    router = Router(pfs, {"d0": FakeReplica()}, prefill_scale_high=2,
+                    prefill_scale_low=1, prefill_scale_cooldown=0)
+    router._scale_prefills()            # idle tier → down to the floor
+    assert router.stats()["prefill_active"] == ["p0"]
+    # a pile of parked (decode-credit-starved) handoffs is not a
+    # prefill signal: the tier stays at the floor
+    router._parked.extend(("d0", object(), None) for _ in range(16))
+    router._scale_prefills()
+    assert router.stats()["prefill_active"] == ["p0"]
+
+
+def test_prefill_drained_on_failed_pings_and_work_routes_around():
+    pfs = {"p0": SlowPrefill(), "p1": SlowPrefill()}
+    router = Router(pfs, {"d0": FakeReplica()}, fail_threshold=2,
+                    prefill_scale_low=0)   # scaling out of the way
+    pfs["p0"].healthy = False
+    router.check_health()
+    assert "p0" in router.stats()["prefill_active"]  # below threshold
+    router.check_health()
+    assert router.stats()["prefill_active"] == ["p1"]
+    for i in range(4):
+        router.submit(f"s{i}", f"r{i}", [1], 1)
+    assert pfs["p0"].stats()["queued"] == 0
+    assert pfs["p1"].stats()["queued"] == 4
+    # recovery: one good ping puts it back in rotation
+    pfs["p0"].healthy = True
+    router.check_health()
+    assert router.stats()["prefill_active"] == ["p0", "p1"]
+
+
+def test_all_prefills_drained_sheds_typed():
+    pfs = {"p0": SlowPrefill()}
+    router = Router(pfs, {"d0": FakeReplica()}, fail_threshold=1)
+    pfs["p0"].healthy = False
+    router.check_health()
+    with pytest.raises(RouterReject) as ei:
+        router.submit("s", "r0", [1], 1)
+    assert ei.value.reason == "no_healthy_prefill"
+
+
+def test_saturated_wire_handoff_parks_then_delivers():
+    pf = FakePrefill()
+    rep = SaturableReplica()
+    router = Router(pf, {"d0": rep})
+    rep.saturated = True
+    router.submit("s", "r0", [1, 2], 2)
+    router.pump()
+    st = router.stats()
+    assert st["parked_handoffs"] == 1
+    assert st["shed"] == 0              # backpressure, not loss
+    assert st["pending_handoffs"]["d0"] == 1  # admission still counts it
+    assert pf.pool.stats()["detached_handles"] == 1  # still adoptable
+    rep.saturated = False
+    router.pump()
+    assert rep.adopted == ["r0"]
+    assert router.stats()["parked_handoffs"] == 0
+    assert pf.pool.stats()["detached_handles"] == 0
+
+
+def test_cancel_in_prefill_queue_drops_before_prefill_runs():
+    pf = SlowPrefill()
+    router = Router(pf, {"d0": FakeReplica()})
+    router.submit("s", "r0", [1, 2], 2)
+    assert router.cancel("r0") is True
+    assert pf.stats()["queued"] == 0
+    assert router.stats()["pending_handoffs"]["d0"] == 0
+    router.pump()
+    assert pf.pool.stats()["leased"] == 0  # nothing ever leased
+
+
+def test_cancel_after_claim_purges_the_replica_pending_queue():
+    """The PR-7 leak: submit_handle(admit=False) claimed the handle,
+    then the session was cancelled router-side — the claimed entry sat
+    in the pending queue until the next admit_pending() and consumed a
+    fused-adoption slot.  purge_pending frees it immediately."""
+    pf = FakePrefill()
+    rep = PendingReplica()
+    router = Router(pf, {"d0": rep})
+    router.submit("s", "r0", [1, 2], 2)
+    router.pump()                       # handed off; claimed, pending
+    assert "r0" in rep.pending
+    assert router.cancel("r0") is True
+    assert rep.purged == ["r0"]
+    st = pf.pool.stats()
+    assert st["leased"] == 0 and st["detached_handles"] == 0
+
+
+def test_cancel_mid_prefill_releases_the_result_on_arrival():
+    pf = SlowPrefill()
+    pf.purge = lambda rid: False        # too late to purge the queue
+    router = Router(pf, {"d0": FakeReplica()})
+    router.submit("s", "r0", [1, 2], 2)
+    assert router.cancel("r0") is True
+    pf.release = True
+    router.pump()                       # result arrives → released
+    st = pf.pool.stats()
+    assert st["leased"] == 0 and st["detached_handles"] == 0
+    assert router.replicas["d0"].adopted == []

@@ -88,6 +88,34 @@ def test_measured_headroom_mean_and_malformed():
     assert measured_headroom({"devices": {"a": {"duty": 1.7}}}) == 0.0
 
 
+def test_measured_headroom_per_chip_narrowing_and_fallback():
+    from vtpu.scheduler.score import measured_headroom_scoped
+
+    p = {"devices": {"hot": {"duty": 0.9}, "idle": {"duty": 0.1}}}
+    # the candidate rectangle's OWN chips, not the node mean
+    assert measured_headroom(p, ["hot"]) == pytest.approx(0.1)
+    assert measured_headroom(p, ["idle"]) == pytest.approx(0.9)
+    assert measured_headroom(p, ["hot", "idle"]) == pytest.approx(0.5)
+    # unknown uuids (sampler restart) → node-mean fallback, not None
+    assert measured_headroom(p, ["gone-a", "gone-b"]) == pytest.approx(0.5)
+    # scoped variant reports how many chips the mean actually consumed
+    assert measured_headroom_scoped(p, ["hot"]) == (pytest.approx(0.1), 1)
+    assert measured_headroom_scoped(p, ["gone"]) == (pytest.approx(0.5), 0)
+    assert measured_headroom_scoped(None, ["hot"]) == (None, 0)
+
+
+def test_blend_audit_chips_only_when_narrowed():
+    p = {"ts": 100.0,
+         "devices": {"hot": {"duty": 0.9}, "idle": {"duty": 0.1}}}
+    s, info = blend_measured(0.5, p, 100.0, 60.0, 1.0,
+                             device_uuids=["hot"])
+    assert s == pytest.approx(0.1) and info["chips"] == 1
+    # fallback to the node mean must NOT claim a per-chip score
+    s, info = blend_measured(0.5, p, 100.0, 60.0, 1.0,
+                             device_uuids=["gone"])
+    assert s == pytest.approx(0.5) and "chips" not in info
+
+
 def test_blend_weight_zero_and_absent_payload_are_booked_only():
     assert blend_measured(0.42, None, 100.0, 60.0, 0.5) == (0.42, None)
     assert blend_measured(0.42, {"devices": {}}, 100.0, 60.0, 0.0) == (

@@ -1,0 +1,576 @@
+"""Wire-level K/V transport (vtpu/serving/transport.py): framing
+round-trips, credit-based flow control, chunk-level resume, and the
+adversarial wire-format suite — truncated chunk, out-of-order chunk,
+version-skewed header, duplicate resume, and mid-stream stamp reuse
+must each raise TYPED errors and leave both pools leak-free
+(ledger-verified via BlockPool.stats(), no sleeps).  The protocol state
+machines are JAX-free by design, so this whole module runs in the fast
+lane against fake engine sinks over real BlockPools; the real-engine
+wire topology rides tests/test_disagg.py."""
+
+import http.server
+import json
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from vtpu.serving import transport as tp
+from vtpu.serving.kvpool import (
+    BlockPool,
+    KVHandle,
+    PoolMismatchError,
+    StaleHandleError,
+)
+
+BS = 8
+LAYOUT = [{"shape": [4, 2], "dtype": "float32"}]
+PER_BLOCK = 4 * 2 * 4  # elements × itemsize
+
+
+class FakeSink:
+    """Receiver-side engine stand-in: implements the wire sink surface
+    over a real BlockPool and reassembles payload bytes for equality
+    checks."""
+
+    def __init__(self, blocks=33):
+        self.pool = BlockPool(blocks, BS)
+        self.layout_doc = list(LAYOUT)
+        self.finished = []
+        self.aborted = []
+        self.written = {}
+
+    def wire_layout(self):
+        return self.layout_doc
+
+    def wire_open(self, rid, total_blocks, layout, chunk_blocks):
+        if layout != self.layout_doc:
+            raise PoolMismatchError("layout mismatch")
+        dst = self.pool.lease_upto(total_blocks)
+        if not dst:
+            return None
+        return {"rid": rid, "dst": dst, "total": total_blocks,
+                "chunk_blocks": chunk_blocks, "closed": False}
+
+    def wire_credits(self, ctx):
+        return len(ctx["dst"])
+
+    def wire_top_up(self, ctx):
+        need = ctx["total"] - len(ctx["dst"])
+        if need > 0 and not ctx["closed"]:
+            ctx["dst"].extend(self.pool.lease_upto(need))
+        return len(ctx["dst"])
+
+    def wire_write(self, ctx, block_off, nblocks, payload):
+        if len(payload) != nblocks * PER_BLOCK:
+            raise ValueError("bad chunk size")
+        self.written[ctx["rid"]] = (
+            self.written.get(ctx["rid"], b"") + bytes(payload)
+        )
+
+    def wire_finish(self, ctx, meta):
+        ctx["closed"] = True
+        self.finished.append((ctx["rid"], list(ctx["dst"]), meta))
+
+    def wire_abort(self, ctx):
+        if ctx["closed"]:
+            return
+        ctx["closed"] = True
+        if ctx["dst"]:
+            self.pool.release(ctx["dst"])
+        self.aborted.append(ctx["rid"])
+
+    def stats(self):
+        return {"max_batch": 4, "active_slots": 0, "queued": 0,
+                **self.pool.stats()}
+
+    def ping(self):
+        return True
+
+
+class FakeExtract:
+    """Deterministic host bytes for n blocks; readiness is scripted (no
+    sleeps — the pump just returns not-done until flipped)."""
+
+    def __init__(self, nblocks, ready=True, seed=0):
+        self.nblocks = nblocks
+        self._ready = ready
+        rng = np.random.default_rng(seed)
+        self.blob = rng.integers(0, 255, nblocks * PER_BLOCK,
+                                 dtype=np.uint8).tobytes()
+
+    def layout(self):
+        return list(LAYOUT)
+
+    def ready_blocks(self):
+        return self.nblocks if self._ready else 0
+
+    def payload(self, lo, hi):
+        return self.blob[lo * PER_BLOCK:hi * PER_BLOCK]
+
+
+class FakeSource:
+    """Prefill-side stand-in: a real pool to lease/detach from, plus the
+    extract surface the WireReplica drives."""
+
+    def __init__(self, blocks=33):
+        self.pool = BlockPool(blocks, BS)
+        self.extracts = []
+
+    def wire_layout(self):
+        return list(LAYOUT)
+
+    def make_handle(self, n=5, seq_len=20):
+        return self.pool.detach(self.pool.lease(n), seq_len=seq_len)
+
+    def start_extract(self, blocks):
+        ex = FakeExtract(len(blocks))
+        self.extracts.append(ex)
+        return ex
+
+
+def leak_free(pool):
+    st = pool.stats()
+    return (st["leased"] == 0 and st["detached_handles"] == 0
+            and st["free"] == st["pool_blocks"] - 1)
+
+
+def mk_stream(n=5, sink=None, src=None, fault=None, chunk_blocks=2):
+    sink = sink or FakeSink()
+    src = src or FakeSource()
+    hub = tp.ReceiverHub(sink)
+    link = tp.LoopbackLink(hub, fault=fault)
+    handle = src.make_handle(n)
+    blocks = src.pool.adopt(handle)
+    ex = src.start_extract(blocks)
+    sender = tp.StreamSender(
+        link, "r0", handle, ex, layout=src.wire_layout(),
+        meta_extra={"first": 7, "num_new": 3, "submitted": 0.0},
+        chunk_blocks=chunk_blocks,
+        on_done=lambda ok: src.pool.release(blocks),
+    )
+    return sink, src, hub, link, handle, ex, sender
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+def test_frame_round_trip():
+    sid = b"s" * 16
+    data = tp.encode_frame(
+        tp.KIND_DATA, sid, seq=3, nchunks=9, block_off=4, nblocks=2,
+        flags=tp.FLAG_FIN, meta={"a": 1}, payload=b"\x00\x01\x02",
+    )
+    fr = tp.decode_frame(data)
+    assert (fr.kind, fr.seq, fr.nchunks) == (tp.KIND_DATA, 3, 9)
+    assert (fr.block_off, fr.nblocks) == (4, 2)
+    assert fr.flags & tp.FLAG_FIN
+    assert fr.sid == sid and fr.meta == {"a": 1}
+    assert bytes(fr.payload) == b"\x00\x01\x02"
+
+
+def test_happy_path_streams_bytes_exactly():
+    sink, src, hub, link, handle, ex, sender = mk_stream(n=5)
+    assert sender.pump() is True
+    assert sink.written["r0"] == ex.blob
+    assert len(sink.finished) == 1
+    rid, dst, meta = sink.finished[0]
+    assert len(dst) == 5 and meta["first"] == 7
+    assert leak_free(src.pool)          # source released on final ack
+    # destination blocks held by the finished adoption, not leaked
+    assert sink.pool.stats()["leased"] == 5
+    assert hub.open_streams() == 0
+
+
+# ---------------------------------------------------------------------------
+# the adversarial matrix: typed errors, leak-free both sides
+# ---------------------------------------------------------------------------
+
+def test_truncated_chunk_is_typed_and_leak_free():
+    sink, src, hub, link, handle, ex, sender = mk_stream(n=4)
+    sender.open()
+    frame = tp.encode_frame(
+        tp.KIND_DATA, sender.sid, seq=1, nchunks=sender.nchunks,
+        block_off=0, nblocks=2, payload=ex.payload(0, 2),
+    )
+    with pytest.raises(tp.TruncatedChunkError):
+        hub.handle(frame[:-5])
+    # a corrupt payload (crc mismatch) is typed the same way; both fail
+    # at decode, BEFORE touching stream state — a torn read must not
+    # kill a resumable stream
+    bad = bytearray(frame)
+    bad[-1] ^= 0xFF
+    with pytest.raises(tp.TruncatedChunkError):
+        hub.handle(bytes(bad))
+    assert hub.open_streams() == 1
+    # a SHORT payload that decodes fine but mismatches its block count
+    # is the sink-level truncation: that one tears the stream down
+    with pytest.raises(tp.TruncatedChunkError):
+        hub.handle(tp.encode_frame(
+            tp.KIND_DATA, sender.sid, seq=1, nchunks=sender.nchunks,
+            block_off=0, nblocks=2, payload=ex.payload(0, 1),
+        ))
+    assert hub.open_streams() == 0
+    sender.abort()
+    assert leak_free(sink.pool) and leak_free(src.pool)
+
+
+def test_out_of_order_chunk_is_typed_and_leak_free():
+    sink, src, hub, link, handle, ex, sender = mk_stream(n=4)
+    sender.open()
+    with pytest.raises(tp.OutOfOrderChunkError):
+        hub.handle(tp.encode_frame(
+            tp.KIND_DATA, sender.sid, seq=2, nchunks=sender.nchunks,
+            block_off=2, nblocks=2, payload=ex.payload(2, 4),
+        ))
+    # stream torn down: a follow-up chunk finds nothing
+    with pytest.raises(tp.StreamAbortedError):
+        hub.handle(tp.encode_frame(
+            tp.KIND_DATA, sender.sid, seq=1, nchunks=sender.nchunks,
+            block_off=0, nblocks=2, payload=ex.payload(0, 2),
+        ))
+    sender.abort()
+    assert leak_free(sink.pool) and leak_free(src.pool)
+    assert sink.aborted == ["r0"]
+
+
+def test_version_skewed_header_is_typed_and_leak_free():
+    sink, src, hub, link, handle, ex, sender = mk_stream(n=2)
+    sender.open()
+    frame = bytearray(tp.encode_frame(
+        tp.KIND_DATA, sender.sid, seq=1, nchunks=sender.nchunks,
+        block_off=0, nblocks=2, flags=tp.FLAG_FIN,
+        payload=ex.payload(0, 2),
+    ))
+    struct.pack_into("<H", frame, 4, tp.VERSION + 1)  # after 4s magic
+    with pytest.raises(tp.VersionSkewError):
+        hub.handle(bytes(frame))
+    # decode failed before any stream lookup: the stream is still open
+    # and completes fine — version skew must not corrupt peers
+    assert hub.open_streams() == 1
+    assert sender.pump() is True
+    assert leak_free(src.pool)
+
+
+def test_duplicate_resume_is_typed_and_leak_free():
+    sink, src, hub, link, handle, ex, sender = mk_stream(n=4)
+    sender.open()
+    chunk1 = tp.encode_frame(
+        tp.KIND_DATA, sender.sid, seq=1, nchunks=sender.nchunks,
+        block_off=0, nblocks=2, payload=ex.payload(0, 2),
+    )
+    assert hub.handle(chunk1)["status"] == "ok"
+    # a resume that ignores the receiver's next-expected seq and
+    # replays an applied chunk is rejected, typed
+    with pytest.raises(tp.DuplicateChunkError):
+        hub.handle(chunk1)
+    sender.abort()
+    assert leak_free(sink.pool) and leak_free(src.pool)
+
+
+def test_mid_stream_stamp_reuse_is_typed_and_leak_free():
+    sink, src, hub, link, handle, ex, sender = mk_stream(n=3)
+    sender.open()
+    # a second stream presenting the SAME (pool, stamp) while the first
+    # is mid-flight: the receiver's stamp registry rejects it loudly
+    dup = tp.StreamSender(
+        link, "r-dup", handle, FakeExtract(3),
+        layout=src.wire_layout(), chunk_blocks=2,
+    )
+    with pytest.raises(StaleHandleError):
+        dup.open()
+    # the original stream is untouched and completes
+    assert sender.pump() is True
+    assert len(sink.finished) == 1
+    assert leak_free(src.pool)
+    # ...and reuse AFTER completion is rejected the same way
+    late = tp.StreamSender(
+        link, "r-late", handle, FakeExtract(3),
+        layout=src.wire_layout(), chunk_blocks=2,
+    )
+    with pytest.raises(StaleHandleError):
+        late.open()
+
+
+def test_credit_overrun_is_typed_and_leak_free():
+    sink = FakeSink(blocks=4)  # 3 leasable — the grant caps at 3
+    src = FakeSource(blocks=33)
+    hub = tp.ReceiverHub(sink)
+    handle = src.make_handle(6)
+    ex = FakeExtract(6)
+    sender = tp.StreamSender(
+        tp.LoopbackLink(hub), "r0", handle, ex,
+        layout=src.wire_layout(), chunk_blocks=6,
+    )
+    sender.open()
+    assert sender._credits == 3
+    with pytest.raises(tp.CreditOverrunError):
+        hub.handle(tp.encode_frame(
+            tp.KIND_DATA, sender.sid, seq=1, nchunks=1, block_off=0,
+            nblocks=6, flags=tp.FLAG_FIN, payload=ex.payload(0, 6),
+        ))
+    assert leak_free(sink.pool)
+
+
+def test_malformed_open_meta_is_typed():
+    sink = FakeSink()
+    hub = tp.ReceiverHub(sink)
+    with pytest.raises(tp.WireError):
+        hub.handle(tp.encode_frame(
+            tp.KIND_DATA, b"x" * 16, seq=0, nchunks=1,
+            meta={"rid": "r0"},  # no handle/layout
+        ))
+    assert leak_free(sink.pool)
+
+
+# ---------------------------------------------------------------------------
+# flow control & resume
+# ---------------------------------------------------------------------------
+
+def test_credit_backpressure_tops_up_without_sleeps():
+    sink = FakeSink(blocks=4)  # 3 leasable now, more after a release
+    src = FakeSource()
+    hub = tp.ReceiverHub(sink)
+    # park 2 of the 3 free blocks elsewhere so the grant starts partial
+    held = sink.pool.lease(2)
+    handle = src.make_handle(3)
+    blocks = src.pool.adopt(handle)
+    ex = src.start_extract(blocks)
+    sender = tp.StreamSender(
+        tp.LoopbackLink(hub), "r0", handle, ex,
+        layout=src.wire_layout(), chunk_blocks=1,
+        on_done=lambda ok: src.pool.release(blocks),
+    )
+    assert sender.pump() is False      # 1 credit: chunk 1 only
+    assert sink.written["r0"] == ex.blob[:PER_BLOCK]
+    sink.pool.release(held)            # blocks free → credits top up
+    assert sender.pump() is True
+    assert sink.written["r0"] == ex.blob
+    assert leak_free(src.pool)
+
+
+def test_saturated_open_backpressures_and_keeps_handle_adoptable():
+    sink = FakeSink(blocks=4)
+    src = FakeSource()
+    hub = tp.ReceiverHub(sink)
+    held = sink.pool.lease(3)          # nothing leasable
+    rep = tp.WireReplica(tp.LoopbackLink(hub), "w0")
+    handle = src.make_handle(2)
+    with pytest.raises(tp.ReplicaSaturatedError):
+        rep.submit_handle("r0", handle, 7, 3, source=src)
+    # NOT claimed: the handle is still adoptable once credits free
+    sink.pool.release(held)
+    rep.submit_handle("r0", handle, 7, 3, source=src)
+    while rep.idle_senders():
+        rep.step()
+    assert len(sink.finished) == 1
+    assert leak_free(src.pool)
+
+
+def test_torn_connection_resumes_at_chunk_offset():
+    state = {"sent": 0, "torn": False}
+
+    def fault(data):
+        fr = tp.decode_frame(data)
+        if fr.kind == tp.KIND_DATA and fr.seq == 2 and not state["torn"]:
+            state["torn"] = True
+            raise OSError("connection reset")
+
+    sink, src, hub, link, handle, ex, sender = mk_stream(
+        n=6, fault=fault, chunk_blocks=2)
+    r0 = tp.TRANSPORT_RESUMES.value()
+    assert sender.pump() is True
+    assert tp.TRANSPORT_RESUMES.value() == r0 + 1
+    assert sink.written["r0"] == ex.blob   # no double-applied chunk
+    assert len(sink.finished) == 1
+    assert leak_free(src.pool)
+
+
+def test_torn_connection_after_apply_skips_the_applied_chunk():
+    """The response (not the request) is lost: the receiver applied the
+    chunk; resume must skip it, not replay it."""
+    state = {"torn": False}
+    sink = FakeSink()
+    src = FakeSource()
+    hub = tp.ReceiverHub(sink)
+
+    class LossyLink(tp.LoopbackLink):
+        def send(self, data, fresh=False):
+            rsp = super().send(data, fresh=fresh)
+            fr = tp.decode_frame(data)
+            if (fr.kind == tp.KIND_DATA and fr.seq == 1
+                    and not state["torn"]):
+                state["torn"] = True
+                raise OSError("response lost")
+            return rsp
+
+    link = LossyLink(hub)
+    handle = src.make_handle(4)
+    blocks = src.pool.adopt(handle)
+    ex = src.start_extract(blocks)
+    sender = tp.StreamSender(
+        link, "r0", handle, ex, layout=src.wire_layout(),
+        chunk_blocks=2, on_done=lambda ok: src.pool.release(blocks),
+    )
+    assert sender.pump() is True
+    assert sink.written["r0"] == ex.blob
+    assert leak_free(src.pool)
+
+
+def test_lost_fin_ack_resolves_finished_not_aborted():
+    """The FIN chunk applies but its RESPONSE is lost: the receiver's
+    finished-stream tombstone must answer the resume with "fin" so the
+    sender completes normally — answering "gone" would abort (and the
+    deployment would retry) a transfer that succeeded."""
+    state = {"torn": False}
+    sink = FakeSink()
+    src = FakeSource()
+    hub = tp.ReceiverHub(sink)
+
+    class FinLossLink(tp.LoopbackLink):
+        def send(self, data, fresh=False):
+            rsp = super().send(data, fresh=fresh)
+            fr = tp.decode_frame(data)
+            if (fr.kind == tp.KIND_DATA and fr.flags & tp.FLAG_FIN
+                    and not state["torn"]):
+                state["torn"] = True
+                raise OSError("FIN response lost")
+            return rsp
+
+    link = FinLossLink(hub)
+    handle = src.make_handle(4)
+    blocks = src.pool.adopt(handle)
+    ex = src.start_extract(blocks)
+    sender = tp.StreamSender(
+        link, "r0", handle, ex, layout=src.wire_layout(),
+        chunk_blocks=2, on_done=lambda ok: src.pool.release(blocks),
+    )
+    r0 = tp.TRANSPORT_RESUMES.value()
+    assert sender.pump() is True
+    assert sender.done and not sender.aborted
+    assert tp.TRANSPORT_RESUMES.value() == r0 + 1
+    assert sink.written["r0"] == ex.blob     # applied exactly once
+    assert len(sink.finished) == 1
+    assert not sink.aborted
+    assert leak_free(src.pool)
+
+
+def test_resume_gone_after_receiver_abort_is_typed():
+    sink, src, hub, link, handle, ex, sender = mk_stream(n=4)
+    sender.open()
+    hub.abort_all()                    # receiver-side death
+    with pytest.raises(tp.StreamAbortedError):
+        hub.handle(tp.encode_frame(
+            tp.KIND_DATA, sender.sid, seq=1, nchunks=sender.nchunks,
+            block_off=0, nblocks=2, payload=ex.payload(0, 2),
+        ))
+    sender.abort()
+    assert leak_free(sink.pool) and leak_free(src.pool)
+
+
+def test_extract_not_ready_defers_without_losing_order():
+    sink = FakeSink()
+    src = FakeSource()
+    hub = tp.ReceiverHub(sink)
+    handle = src.make_handle(4)
+    blocks = src.pool.adopt(handle)
+    ex = FakeExtract(4, ready=False)
+    sender = tp.StreamSender(
+        tp.LoopbackLink(hub), "r0", handle, ex,
+        layout=src.wire_layout(), chunk_blocks=2,
+        on_done=lambda ok: src.pool.release(blocks),
+    )
+    assert sender.pump() is False      # D2H still in flight
+    assert "r0" not in sink.written
+    ex._ready = True                   # the async copy landed
+    assert sender.pump() is True
+    assert sink.written["r0"] == ex.blob
+    assert leak_free(src.pool)
+
+
+def test_layout_mismatch_fails_open_typed():
+    sink = FakeSink()
+    src = FakeSource()
+    hub = tp.ReceiverHub(sink)
+    handle = src.make_handle(2)
+    sender = tp.StreamSender(
+        tp.LoopbackLink(hub), "r0", handle, FakeExtract(2),
+        layout=[{"shape": [16, 2], "dtype": "float32"}],  # wrong model
+    )
+    with pytest.raises(PoolMismatchError):
+        sender.open()
+    assert leak_free(sink.pool)
+
+
+# ---------------------------------------------------------------------------
+# wire-level HTTP link (persistent keep-alive pool, typed error mapping)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def kv_http_server():
+    sink = FakeSink()
+    hub = tp.ReceiverHub(sink)
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            status, doc = tp.handle_http_frame(hub, self.rfile.read(n))
+            body = json.dumps(doc).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield sink, hub, srv.server_address[1]
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_http_link_streams_and_maps_typed_errors(kv_http_server):
+    sink, hub, port = kv_http_server
+    src = FakeSource()
+    link = tp.HttpKVLink(f"http://127.0.0.1:{port}")
+    handle = src.make_handle(4)
+    blocks = src.pool.adopt(handle)
+    ex = src.start_extract(blocks)
+    sender = tp.StreamSender(
+        link, "r0", handle, ex, layout=src.wire_layout(),
+        chunk_blocks=2, on_done=lambda ok: src.pool.release(blocks),
+    )
+    assert sender.pump() is True
+    assert sink.written["r0"] == ex.blob
+    # typed error round trip: a duplicate chunk raises the SAME class
+    # client-side as the in-process hub raises
+    chunk1 = tp.encode_frame(
+        tp.KIND_DATA, sender.sid, seq=1, nchunks=2, block_off=0,
+        nblocks=2, payload=ex.payload(0, 2),
+    )
+    with pytest.raises(tp.StreamAbortedError):
+        link.send(chunk1)              # stream already finished
+    handle2 = src.make_handle(4)
+    tp.StreamSender(
+        link, "r1", handle2, FakeExtract(4),
+        layout=src.wire_layout(), chunk_blocks=2,
+    ).open()
+    with pytest.raises(StaleHandleError):
+        # stamp reuse over HTTP maps back to StaleHandleError too
+        tp.StreamSender(
+            link, "r1b", handle2, FakeExtract(4),
+            layout=src.wire_layout(), chunk_blocks=2,
+        ).open()
+    hub.abort_all()                    # tear down r1's open stream
+    assert sink.pool.stats()["leased"] == 4  # only r0's finished adopt
+    link.close()

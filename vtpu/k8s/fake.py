@@ -43,6 +43,14 @@ class FakeClient:
     def create_node(self, node: dict) -> dict:
         with self._lock:
             name = node["metadata"]["name"]
+            if name in self._nodes:
+                # apiserver semantics: create of an existing object is
+                # 409 AlreadyExists, never an upsert.  The silent
+                # overwrite clobbered concurrent mutations — a stale
+                # leader elector's lease-object bootstrap could destroy
+                # the winner's fresh lease annotation and elect two
+                # leaders (a race the real apiserver cannot produce).
+                raise Conflict(f"node {name} already exists")
             self._bump(node)
             self._nodes[name] = copy.deepcopy(node)
             self._notify("Node", node)

@@ -960,9 +960,13 @@ class Scheduler:
                         dev_uuid, mem, s = res
                         minfo = None
                         if m_weight > 0:
+                            # per-chip blend: the fast path books exactly
+                            # one device, so its duty (not the node mean)
+                            # is the headroom that matters
                             s, minfo = score_mod.blend_measured(
                                 s, m_measured.get(name),
                                 m_now, m_max_age, m_weight,
+                                device_uuids=(dev_uuid,),
                             )
                         payload: object = (dev_uuid, mem)
                         if collect_verdicts:
@@ -994,9 +998,15 @@ class Scheduler:
                         s = score_mod.score_node(nu, policy)
                         minfo = None
                         if m_weight > 0:
+                            # per-chip blend over the candidate
+                            # rectangle's chips (node-mean fallback
+                            # inside measured_headroom)
                             s, minfo = score_mod.blend_measured(
                                 s, m_measured.get(name),
                                 m_now, m_max_age, m_weight,
+                                device_uuids=[
+                                    d.uuid for ctr in payload for d in ctr
+                                ],
                             )
                         if collect_verdicts:
                             verdicts[name] = {"fit": True, "score": round(s, 6)}

@@ -326,28 +326,59 @@ def score_node(node: NodeUsage, policy: str = "binpack") -> float:
     return util if policy == "binpack" else 1.0 - util
 
 
-def measured_headroom(payload: Optional[dict]) -> Optional[float]:
-    """Mean measured headroom across a node's devices from a decoded
-    ``vtpu.io/node-utilization`` payload: ``mean(clamp(1 - duty, 0, 1))``
-    — 1.0 when every chip sat idle over the last sample window, 0.0 when
-    all of them ran flat out.  None when the payload carries no usable
-    device duties (never written back, or malformed)."""
-    if not isinstance(payload, dict):
-        return None
-    devices = payload.get("devices")
-    if not isinstance(devices, dict) or not devices:
-        return None
+def _headroom_mean(records) -> Tuple[Optional[float], int]:
+    """``(mean(clamp(1 - duty, 0, 1)), usable_count)`` over duty
+    records — the ONE implementation every headroom entry point
+    shares."""
     total, n = 0.0, 0
-    for rec in devices.values():
+    for rec in records:
         try:
             duty = float(rec.get("duty", 0.0))
         except (AttributeError, TypeError, ValueError):
             continue
         total += min(1.0, max(0.0, 1.0 - duty))
         n += 1
-    if n == 0:
-        return None
-    return total / n
+    return (total / n, n) if n else (None, 0)
+
+
+def measured_headroom_scoped(
+    payload: Optional[dict], device_uuids=None
+) -> Tuple[Optional[float], int]:
+    """Measured headroom from a decoded ``vtpu.io/node-utilization``
+    payload plus how it was computed: ``(headroom, chips)``.
+
+    ``device_uuids`` narrows the mean to the *candidate placement's*
+    chips (the annotation carries per-device duties, so the blend can
+    score the exact rectangle a pod would land on instead of diluting a
+    hot chip across an otherwise-idle node — ROADMAP item 1's per-chip
+    step); ``chips`` is the number of those devices the narrowed mean
+    actually consumed.  ``chips == 0`` means the node-mean fallback
+    (none of the named chips in the payload — sampler restarted with
+    fresh uuids, partial write-back), so the decision audit log can
+    distinguish a genuine per-chip score from a fallback that merely
+    *asked* per-chip.  ``(None, 0)`` when the payload carries no usable
+    device duties at all (never written back, or malformed)."""
+    if not isinstance(payload, dict):
+        return None, 0
+    devices = payload.get("devices")
+    if not isinstance(devices, dict) or not devices:
+        return None, 0
+    if device_uuids:
+        got, n = _headroom_mean(
+            devices[u] for u in device_uuids if u in devices
+        )
+        if got is not None:
+            return got, n
+    got, _n = _headroom_mean(devices.values())
+    return got, 0
+
+
+def measured_headroom(
+    payload: Optional[dict], device_uuids=None
+) -> Optional[float]:
+    """:func:`measured_headroom_scoped` without the chip count (the
+    metrics-export / simple callers' form)."""
+    return measured_headroom_scoped(payload, device_uuids)[0]
 
 
 def blend_measured(
@@ -356,6 +387,7 @@ def blend_measured(
     now: float,
     max_age_s: float,
     weight: float,
+    device_uuids=None,
 ) -> Tuple[float, Optional[dict]]:
     """Blend a node's booked score with its measured headroom (both
     policies: scores are "higher wins" in binpack and spread alike, and
@@ -365,6 +397,8 @@ def blend_measured(
     ``weight × (1 − age/max_age)`` — a fresh snapshot pulls the full
     weight, one approaching ``max_age_s`` barely registers, and anything
     at or past the gate (or absent/unusable) falls back to booked-only.
+    ``device_uuids`` scopes the headroom to the candidate placement's
+    chips (node-mean fallback — see :func:`measured_headroom`).
     Returns ``(score, inputs)`` where ``inputs`` records what the blend
     consumed for the decision audit log (None = booked-only with no
     measurement at all)."""
@@ -381,19 +415,25 @@ def blend_measured(
         return booked_score, {
             "stale": True, "age_s": round(age, 1), "weight": 0.0,
         }
-    headroom = measured_headroom(payload)
+    headroom, chips = measured_headroom_scoped(payload, device_uuids)
     if headroom is None:
         return booked_score, None
     decay = 1.0 - max(0.0, age) / max_age_s
     w = min(1.0, max(0.0, weight)) * decay
     blended = (1.0 - w) * booked_score + w * headroom
-    return blended, {
+    inputs = {
         "stale": False,
         "age_s": round(age, 1),
         "weight": round(w, 4),
         "headroom": round(headroom, 4),
         "booked_score": round(booked_score, 6),
     }
+    # chips records the PER-CHIP narrowing actually used; a candidate
+    # whose devices were absent from the payload scored on the node
+    # mean and the audit log must say so
+    if chips:
+        inputs["chips"] = chips
+    return blended, inputs
 
 
 def bounding_shape(coords) -> Tuple[int, int, int]:

@@ -43,6 +43,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -63,7 +64,66 @@ from vtpu.serving.kvpool import (
 )
 from vtpu.serving.paged import PagedBatcher
 
-__all__ = ["DecodeEngine", "PrefillEngine", "PrefillResult"]
+__all__ = ["DecodeEngine", "HostExtract", "PrefillEngine",
+           "PrefillResult", "pool_layout"]
+
+
+def pool_layout(pools: dict) -> list:
+    """Wire-layout digest of a pool's cache leaves (flatten order =
+    sorted dict keys, deterministic on both ends): per-block shape and
+    dtype per leaf.  The receiver validates the sender's digest against
+    its own pool before pre-leasing — mismatched models fail the stream
+    open loudly instead of scattering garbage."""
+    return [
+        {"shape": [int(d) for d in leaf.shape[1:]],
+         "dtype": str(jnp.asarray(leaf).dtype)}
+        for leaf in jax.tree_util.tree_leaves(pools)
+    ]
+
+
+class HostExtract:
+    """Async D2H of a claimed handle's blocks — the sender side of the
+    wire transport.  The fused gather is enqueued at construction and
+    ``copy_to_host_async`` issued immediately, so the bytes ride behind
+    whatever the prefill engine computes next (PR 3's double-buffering
+    idiom); ``ready_blocks()`` is the overlap driver: the stream sender
+    ships chunks only once the copy has landed, never blocking the
+    pump on a device sync."""
+
+    def __init__(self, gathered: list, nblocks: int) -> None:
+        self._dev = gathered          # per-leaf [padded_blocks, ...]
+        self.nblocks = nblocks
+        self._np: Optional[list] = None
+        self.per_block = sum(
+            int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize
+            for leaf in gathered
+        )
+
+    def layout(self) -> list:
+        return pool_layout(self._dev)
+
+    def ready_blocks(self) -> int:
+        """Blocks whose bytes have landed host-side (0 while the async
+        copy is still in flight)."""
+        if self._np is not None:
+            return self.nblocks
+        for leaf in self._dev:
+            ready = getattr(leaf, "is_ready", None)
+            if ready is not None and not ready():
+                return 0
+        return self.nblocks
+
+    def payload(self, lo: int, hi: int) -> bytes:
+        """Serialized bytes of blocks [lo, hi): per-leaf slices in
+        flatten order, concatenated."""
+        if self._np is None:
+            # the async copy was issued at construction; this is a
+            # cheap view by the time ready_blocks() said go
+            self._np = [np.asarray(leaf) for leaf in self._dev]
+        return b"".join(
+            np.ascontiguousarray(leaf[lo:hi]).tobytes()
+            for leaf in self._np
+        )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -136,6 +196,15 @@ class PrefillEngine:
             pools.pop("block_table")
             self._pools = pools
         self._host_ctx: Optional[Tuple[jnp.ndarray, jnp.ndarray]] = None
+        # dispatch fence between the donating admission program and a
+        # wire extract's gather: the sender pump runs on its own thread,
+        # and fetching pool leaves concurrently with the donation that
+        # replaces them reads a deleted buffer.  Claimed blocks are
+        # never re-leased, so gathering from the CURRENT leaves is
+        # value-correct at any time — only the dispatches need mutual
+        # exclusion, and both return async, so the fence costs dispatch
+        # time, never compute.
+        self._dispatch_lock = threading.Lock()
         self.queue: collections.deque = collections.deque()
         self._rids: set = set()
         self.prefills = 0  # finished prefills (scrape-friendly)
@@ -161,6 +230,40 @@ class PrefillEngine:
             return firsts, out
 
         self._pf = _pf
+
+        @jax.jit
+        def _wire_gather(pools, idx):
+            """Fused row gather of a handle's blocks out of the live
+            pool — the device half of a wire extract (the D2H is issued
+            async by the caller and rides behind the next window)."""
+            return jax.tree.map(lambda leaf: leaf[idx], pools)
+
+        self._wire_gather = _wire_gather
+
+    # -- wire transport (sender side) ----------------------------------
+    def wire_layout(self) -> list:
+        """Layout digest the receiver validates before pre-leasing."""
+        return pool_layout(self.pool_leaves())
+
+    def start_extract(self, blocks) -> HostExtract:
+        """Begin the async D2H of claimed blocks for a wire stream.
+        The gather enqueues behind any in-flight prefill program (the
+        blocks' K/V writes are program-ordered before the read), and
+        ``copy_to_host_async`` starts the transfer immediately — by the
+        time the sender's pump asks for payload, the bytes are host-side
+        without a blocking sync."""
+        blocks = list(blocks)
+        n = len(blocks)
+        padded = blocks + [0] * (_pow2(n) - n)  # pad → garbage block;
+        # pow-2 row buckets keep the gather's compile count bounded
+        idx = jnp.asarray(padded, jnp.int32)
+        with self._dispatch_lock:
+            gathered = jax.tree_util.tree_leaves(
+                self._wire_gather(self.pool_leaves(), idx)
+            )
+        for g in gathered:
+            getattr(g, "copy_to_host_async", lambda: None)()
+        return HostExtract(gathered, n)
 
     # ------------------------------------------------------------------
     def _blocks_needed(self, prompt_len: int, num_new: int) -> int:
@@ -252,10 +355,12 @@ class PrefillEngine:
                 toks[r, :p.size] = p
                 table[r, :len(blocks)] = blocks
                 lens[r] = p.size
-            firsts, new_pools = self._pf(
-                self.params, self._borrow_pools(), pos0, table, toks, lens,
-            )
-            self._restore_pools(new_pools)
+            with self._dispatch_lock:
+                firsts, new_pools = self._pf(
+                    self.params, self._borrow_pools(), pos0, table,
+                    toks, lens,
+                )
+                self._restore_pools(new_pools)
             vals = np.asarray(firsts)
             for r, (rid, p, num_new, t0, blocks) in enumerate(sub):
                 handle = self.pool.detach(blocks, seq_len=int(p.size))
@@ -263,6 +368,17 @@ class PrefillEngine:
                                          num_new, t0))
         self.prefills += len(out)
         return out
+
+    def purge(self, rid: str) -> bool:
+        """Drop a still-queued prompt (router-side cancel before the
+        prefill ran).  Nothing was leased yet, so there is nothing to
+        release."""
+        for i, item in enumerate(self.queue):
+            if item[0] == rid:
+                del self.queue[i]
+                self._rids.discard(rid)
+                return True
+        return False
 
     def run(self) -> List[PrefillResult]:
         """Drain the whole queue (blocks permitting each round)."""
@@ -321,6 +437,20 @@ class DecodeEngine(PagedBatcher):
                     tok.at[slots].set(firsts))
 
         self._adopt_copy = _adopt_copy
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _wire_put(pools, idx, chunk):
+            """Incremental wire adoption: scatter one received chunk's
+            host bytes into the pre-leased destination blocks (donated —
+            in place).  Padding rows point at block 0 (garbage).  One
+            program per chunk-block count; chunks are fixed-size so the
+            compile count is bounded."""
+            return jax.tree.map(
+                lambda dst, src: dst.at[idx].set(src.astype(dst.dtype)),
+                pools, chunk,
+            )
+
+        self._wire_put = _wire_put
 
     # ------------------------------------------------------------------
     def ping(self) -> bool:
@@ -385,6 +515,154 @@ class DecodeEngine(PagedBatcher):
         everything queued (slots permitting)."""
         self._admit_pending()
 
+    def purge_pending(self, rid: str) -> bool:
+        """Remove a claimed-but-unslotted adoption from the pending
+        queue and free its blocks — the release path for a cancelled
+        session.  Without this, a ``submit_handle(admit=False)`` entry
+        whose request was released router-side stayed queued until the
+        next ``admit_pending()`` and consumed a fused-adoption slot
+        (plus its blocks) for a session nobody would ever harvest."""
+        for i, pa in enumerate(self.queue):
+            if not isinstance(pa, _PendingAdopt) or pa.rid != rid:
+                continue
+            del self.queue[i]
+            if pa.mode == "copy":
+                # claimed references live in the SOURCE pool until the
+                # fused copy runs; hand them back there
+                pa.source.pool.release(pa.blocks)
+            else:
+                # shared (adopted from our pool) and wire (pre-leased
+                # from our pool) both own local references
+                self.pool.release(pa.blocks)
+            self._rids.discard(rid)
+            return True
+        return False
+
+    # -- wire transport (receiver sink) --------------------------------
+    # The ReceiverHub (vtpu/serving/transport.py) drives these: open
+    # pre-leases destination blocks (the credit grant), write scatters
+    # each received chunk incrementally, finish queues the final fused
+    # bind, abort releases a partial adoption leak-free.
+    #
+    # Threading contract: the sink must be driven from the SAME thread
+    # (or under the same external serialization) as the engine's step()
+    # — wire_write's donating _wire_put and the decode window's donating
+    # dispatch race on the live cache otherwise, the deleted-buffer
+    # hazard the PrefillEngine fences with _dispatch_lock.  The router
+    # pump, the bench drive loop, and an HTTP deployment's
+    # listener-hands-to-engine-thread queue all satisfy this.
+    def wire_layout(self) -> list:
+        return pool_layout(self._split_cache()[0])
+
+    def wire_open(self, rid: str, total_blocks: int, layout: list,
+                  chunk_blocks: int):
+        # typed-error contract: everything raised here must be a
+        # KVHandoffError subclass so an HTTP deployment maps it to the
+        # typed response doc instead of an opaque 500
+        from vtpu.serving.transport import WireError
+
+        if rid in self._rids:
+            raise WireError(f"duplicate request id {rid!r}")
+        if layout != self.wire_layout():
+            raise PoolMismatchError(
+                "wire stream layout does not match this engine's pool "
+                "(different model shapes or dtypes)"
+            )
+        if total_blocks > self.pool.leasable():
+            raise PoolMismatchError(
+                "handle needs more blocks than this pool can ever lease"
+            )
+        dst = self.pool.lease_upto(total_blocks)
+        if not dst:
+            return None  # saturated → credits 0 → router backpressure
+        self._rids.add(rid)
+        return {"rid": rid, "dst": dst, "total": total_blocks,
+                "chunk_blocks": int(chunk_blocks), "written": 0,
+                "closed": False}
+
+    def wire_credits(self, ctx) -> int:
+        return len(ctx["dst"])
+
+    def wire_top_up(self, ctx) -> int:
+        need = ctx["total"] - len(ctx["dst"])
+        if need > 0 and not ctx["closed"]:
+            ctx["dst"].extend(self.pool.lease_upto(need))
+        return len(ctx["dst"])
+
+    def _wire_leaf_meta(self):
+        """(treedef, [(n_elem, shape, dtype)], bytes_per_block) of the
+        pool leaves — invariant for the engine's lifetime, computed once
+        instead of per received chunk (the hot adoption path)."""
+        meta = getattr(self, "_wire_meta", None)
+        if meta is None:
+            pools, _bpos, _btab = self._split_cache()
+            leaves, treedef = jax.tree_util.tree_flatten(pools)
+            per_leaf = [
+                (int(np.prod(leaf.shape[1:])), leaf.shape[1:],
+                 np.dtype(leaf.dtype))
+                for leaf in leaves
+            ]
+            per_block = sum(n * dt.itemsize for n, _sh, dt in per_leaf)
+            meta = self._wire_meta = (treedef, per_leaf, per_block)
+        return meta
+
+    def wire_write(self, ctx, block_off: int, nblocks: int,
+                   payload) -> None:
+        pools, bpos, btab = self._split_cache()
+        treedef, per_leaf, per_block = self._wire_leaf_meta()
+        expect = nblocks * per_block
+        buf = memoryview(payload)
+        if len(buf) != expect:
+            raise ValueError(
+                f"chunk payload {len(buf)} bytes != expected {expect}"
+            )
+        cb = max(ctx["chunk_blocks"], nblocks)
+        dst_ids = ctx["dst"][block_off:block_off + nblocks]
+        idx = np.zeros((cb,), np.int32)  # pad rows → garbage block 0
+        idx[:nblocks] = dst_ids
+        chunk_leaves = []
+        off = 0
+        for n_elem, shape, dtype in per_leaf:
+            nbytes = nblocks * n_elem * dtype.itemsize
+            arr = np.frombuffer(buf[off:off + nbytes], dtype=dtype)
+            arr = arr.reshape((nblocks,) + tuple(shape))
+            if cb > nblocks:
+                pad = np.zeros((cb - nblocks,) + tuple(shape), dtype)
+                arr = np.concatenate([arr, pad], axis=0)
+            chunk_leaves.append(arr)
+            off += nbytes
+        chunk = jax.tree_util.tree_unflatten(treedef, chunk_leaves)
+        new_pools = self._wire_put(pools, jnp.asarray(idx), chunk)
+        self.cache = dict(new_pools, pos=bpos, block_table=btab)
+        ctx["written"] = block_off + nblocks
+
+    def wire_finish(self, ctx, meta: dict) -> None:
+        from vtpu.serving.transport import WireError
+
+        ctx["closed"] = True
+        try:
+            seq_len = int(meta["handle"]["seq_len"])
+            first = int(meta.get("first", 0))
+            num_new = int(meta.get("num_new", 1))
+            submitted = float(meta.get("submitted", 0.0))
+        except (KeyError, TypeError, ValueError) as e:
+            self.pool.release(ctx["dst"])
+            self._rids.discard(ctx["rid"])
+            raise WireError(f"malformed wire stream meta: {e}") from e
+        self.queue.append(_PendingAdopt(
+            ctx["rid"], list(ctx["dst"]), seq_len, first, num_new,
+            "wire", None, submitted,
+        ))
+        self._admit_pending()
+
+    def wire_abort(self, ctx) -> None:
+        if ctx["closed"]:
+            return
+        ctx["closed"] = True
+        if ctx["dst"]:
+            self.pool.release(ctx["dst"])
+        self._rids.discard(ctx["rid"])
+
     # -- admission: drain claimed handles into free slots ---------------
     def _admit_pending(self) -> None:
         progress = True
@@ -416,15 +694,22 @@ class DecodeEngine(PagedBatcher):
     def _adopt_group(
         self, group: List[Tuple[int, _PendingAdopt, List[int]]]
     ) -> None:
-        shared = [e for e in group if e[1].mode == "shared"]
+        # shared and wire adoptions are both bind-only by now (the
+        # blocks already live in this pool — rebound zero-copy, or
+        # written chunk-by-chunk as the stream arrived); one fused
+        # scatter covers the whole group
+        bindable = [e for e in group if e[1].mode in ("shared", "wire")]
         by_src: Dict[int, list] = {}
         for e in group:
             if e[1].mode == "copy":
                 by_src.setdefault(id(e[1].source), []).append(e)
-        if shared:
-            self._bind_rows(shared)
-            HANDOFF_TOTAL.inc(len(shared), mode="shared")
-            HANDOFF_BLOCKS.inc(sum(len(d) for _, _, d in shared))
+        if bindable:
+            self._bind_rows(bindable)
+            for mode in ("shared", "wire"):
+                sub = [e for e in bindable if e[1].mode == mode]
+                if sub:
+                    HANDOFF_TOTAL.inc(len(sub), mode=mode)
+                    HANDOFF_BLOCKS.inc(sum(len(d) for _, _, d in sub))
         for sub in by_src.values():
             self._copy_rows(sub)
         # host bookkeeping mirrors _queue_first, except the first token

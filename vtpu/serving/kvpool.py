@@ -60,9 +60,13 @@ HANDOFF_DEVICE_BYTES = _REG.counter(
 )
 HANDOFF_HOST_BYTES = _REG.counter(
     "vtpu_kv_handoff_host_bytes_total",
-    "K/V cache bytes that crossed the host on an adopt path — the "
-    "regression tripwire: the fused adopt never materializes cache "
-    "contents in host numpy, so this stays 0",
+    "K/V cache bytes that crossed the host on a handoff path.  The "
+    "in-process adopt modes (shared rebind, fused cross-pool copy) "
+    "never materialize cache contents in host numpy, so they keep this "
+    "at 0 (the disagg bench still asserts that); the WIRE transport "
+    "(vtpu/serving/transport.py) deliberately stages bytes through the "
+    "host and accounts them here, matching "
+    "vtpu_kv_transport_bytes_total",
 )
 HANDOFF_STALE = _REG.counter(
     "vtpu_kv_handoff_stale_total",
@@ -173,6 +177,19 @@ class BlockPool:
             if n > len(self.free):
                 return None
             blocks = [self.free.popleft() for _ in range(n)]
+            for b in blocks:
+                self._refs[b] = 1
+            return blocks
+
+    def lease_upto(self, n: int) -> List[int]:
+        """Lease as many of ``n`` blocks as are free (possibly none) —
+        the wire receiver's incremental credit grant: destination blocks
+        are pre-leased as they become available and advertised to the
+        sender as flow-control credits, so a tight decode pool
+        backpressures the stream instead of failing it."""
+        with self._lock:
+            take = min(n, len(self.free))
+            blocks = [self.free.popleft() for _ in range(take)]
             for b in blocks:
                 self._refs[b] = 1
             return blocks

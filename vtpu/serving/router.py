@@ -1,11 +1,12 @@
-"""The serving front door: session affinity, admission control, and
-load shedding over N decode replicas.
+"""The serving front door: session affinity, admission control, load
+shedding, and prefill-tier scaling over N decode replicas.
 
 One batcher was the serving ceiling (ROADMAP item 2); the router makes
-the decode tier horizontal.  It owns one :class:`~vtpu.serving.disagg.
-PrefillEngine` (prefill is throughput work — bursts queue here, never
-in a decode engine's token cadence) and N decode replicas, and drives
-the handoff between them:
+the decode tier horizontal.  It owns a prefill tier — one engine, or a
+pool of :class:`~vtpu.serving.disagg.PrefillEngine` replicas it scales
+with load (prefill is throughput work — bursts queue here, never in a
+decode engine's token cadence) — and N decode replicas, and drives the
+handoff between them:
 
 - **Session affinity**: sessions hash onto replicas via the SAME
   consistent-hash ring the sharded scheduler extender uses
@@ -21,12 +22,26 @@ the handoff between them:
   past ``max_backlog`` sheds with a typed :class:`RouterReject`
   (HTTP 429 semantics — the caller retries elsewhere/later; nothing
   is silently dropped).
-- **Health**: replicas answer ``ping()``.  ``fail_threshold``
-  consecutive failures drain a replica — removed from the ring for
-  new sessions while in-flight sessions finish — and a successful
-  ping restores it; both transitions land in the event journal
-  (``ReplicaDrained`` / ``ReplicaRestored``) and the
+- **Health**: replicas (decode AND prefill) answer ``ping()``.
+  ``fail_threshold`` consecutive failures drain a replica — removed
+  from the ring / submission rotation while in-flight work finishes —
+  and a successful ping restores it; transitions land in the event
+  journal (``ReplicaDrained`` / ``ReplicaRestored``) and the
   ``vtpu_router_*`` metric families (docs/observability.md).
+- **Prefill scaling**: with more than one prefill replica, the router
+  watches its own backlog ledger plus the decode tier's
+  ``slots_active_ratio`` and drains/restores prefill replicas through
+  the same machinery — a deep backlog (or decode slots starving while
+  prefill work queues) restores a scaled-down replica; a drained
+  backlog scales one down.  A scaled-down prefill finishes its queued
+  work in place; only NEW submissions skip it.
+- **Wire backpressure**: a decode replica reached over the wire
+  transport (:class:`vtpu.serving.transport.WireReplica`) whose pool
+  cannot pre-lease a single destination block raises
+  :class:`~vtpu.serving.transport.ReplicaSaturatedError` at handoff.
+  The router PARKS the finished prefill (the handle stays adoptable)
+  and retries on later pumps — credit-based flow control propagates as
+  admission backpressure, never as a decode-side OOM.
 
 The router is deliberately JAX-free (duck-typed replicas), so the
 control-plane test lane exercises every policy with fake replicas.
@@ -45,6 +60,7 @@ from vtpu import obs
 from vtpu.obs.events import EventType, emit
 from vtpu.scheduler.shard import HashRing
 from vtpu.serving.kvpool import KVHandoffError
+from vtpu.serving.transport import ReplicaSaturatedError
 
 log = logging.getLogger(__name__)
 
@@ -66,12 +82,19 @@ _HEALTHY_INFO = _REG.gauge(
 )
 _TRANSITIONS = _REG.counter(
     "vtpu_router_replica_transitions_total",
-    "Replica health transitions (to=drained / restored)",
+    "Replica transitions (to=drained / restored / prefill_drained / "
+    "prefill_restored — the prefill forms cover both health drains and "
+    "backlog-driven scaling)",
 )
 _BACKLOG = _REG.gauge(
     "vtpu_router_backlog_total",
     "Requests admitted but not yet adopted by a decode replica "
     "(prefill queue + in-flight handoffs), by replica",
+)
+_PREFILL_ACTIVE = _REG.gauge(
+    "vtpu_router_prefill_active_total",
+    "Prefill replicas currently accepting new submissions (healthy and "
+    "not scaled down)",
 )
 
 
@@ -88,12 +111,13 @@ class RouterReject(Exception):
 
 
 class Router:
-    """Front door over one prefill engine and N decode replicas.
+    """Front door over a prefill tier and N decode replicas.
 
-    ``replicas`` maps replica id → decode engine (anything with
-    ``submit_handle`` / ``step`` / ``stats`` / ``ping``).  The caller
-    drives :meth:`pump` (one prefill round + one decode window per
-    replica) or :meth:`drain` (run to completion)."""
+    ``prefill`` is one engine or a dict of prefill replica id → engine
+    (the scalable tier); ``replicas`` maps replica id → decode engine
+    (anything with ``submit_handle`` / ``step`` / ``stats`` / ``ping``).
+    The caller drives :meth:`pump` (one cooperative round) or
+    :meth:`drain` (run to completion)."""
 
     def __init__(
         self,
@@ -103,26 +127,37 @@ class Router:
         max_backlog: Optional[int] = None,
         fail_threshold: int = 3,
         ping_interval_s: float = 0.0,
+        prefill_scale_high: int = 8,
+        prefill_scale_low: int = 2,
+        prefill_min_active: int = 1,
+        prefill_scale_cooldown: int = 2,
         clock=time.monotonic,
     ) -> None:
         if not replicas:
             raise ValueError("Router needs at least one decode replica")
-        self.prefill = prefill
+        self.prefills: Dict[str, object] = (
+            dict(prefill) if isinstance(prefill, dict)
+            else {"p0": prefill}
+        )
+        if not self.prefills:
+            raise ValueError("Router needs at least one prefill engine")
         self.replicas = dict(replicas)
-        host = getattr(prefill, "_host", None)
-        if host is not None and (
-            len(self.replicas) > 1
-            or not any(eng is host for eng in self.replicas.values())
-        ):
-            # a shared-pool prefill writes straight into its host decode
-            # engine's pool; no other replica can adopt those handles
-            # (there is no source pool to copy from)
-            raise ValueError(
-                "a shared-pool (co-located) prefill serves exactly its "
-                "host decode engine — construct the Router with that "
-                "single replica, or give the prefill its own pool for "
-                "multi-replica topologies"
-            )
+        for pf in self.prefills.values():
+            host = getattr(pf, "_host", None)
+            if host is not None and (
+                len(self.prefills) > 1
+                or len(self.replicas) > 1
+                or not any(eng is host for eng in self.replicas.values())
+            ):
+                # a shared-pool prefill writes straight into its host
+                # decode engine's pool; no other replica can adopt those
+                # handles (there is no source pool to copy from)
+                raise ValueError(
+                    "a shared-pool (co-located) prefill serves exactly "
+                    "its host decode engine — construct the Router with "
+                    "that single prefill + single replica, or give the "
+                    "prefill its own pool for multi-replica topologies"
+                )
         # shed when a replica's uncollected work (active slots + claimed
         # handles waiting + our own prefill backlog for it) reaches
         # max_batch + max_backlog; default backlog = 2× the largest
@@ -134,10 +169,19 @@ class Router:
         )
         self.fail_threshold = max(1, fail_threshold)
         self.ping_interval_s = ping_interval_s
+        self.prefill_scale_high = max(1, prefill_scale_high)
+        self.prefill_scale_low = max(0, prefill_scale_low)
+        self.prefill_min_active = max(1, min(prefill_min_active,
+                                             len(self.prefills)))
+        self.prefill_scale_cooldown = max(0, prefill_scale_cooldown)
         self._clock = clock
         self._last_ping = 0.0
         self._healthy = set(self.replicas)
         self._fails: Dict[str, int] = {rid: 0 for rid in self.replicas}
+        self._pfails: Dict[str, int] = {pid: 0 for pid in self.prefills}
+        self._prefill_down: set = set()        # scaled down (healthy)
+        self._prefill_unhealthy: set = set()   # failed pings
+        self._scale_cooldown = 0
         self._ring = HashRing(sorted(self._healthy))
         # session → pinned replica, LRU-bounded: a front door sees an
         # unbounded stream of session ids and a pin is only best-effort
@@ -147,13 +191,41 @@ class Router:
             collections.OrderedDict()
         )
         self._session_cap = 65536
-        self._target: Dict[str, str] = {}       # rid → replica id
+        self._target: Dict[str, str] = {}       # rid → decode replica id
+        self._rid_prefill: Dict[str, str] = {}  # rid → prefill id (queued)
+        self._cancelled: set = set()            # rids released pre-handoff
+        # saturated wire handoffs waiting for receiver credits:
+        # (replica id, PrefillResult, source engine)
+        self._parked: collections.deque = collections.deque()
         self._pending: Dict[str, int] = {rid: 0 for rid in self.replicas}
         self.shed = 0
         for rid in self.replicas:
             _HEALTHY_INFO.set(1.0, replica=rid)
+        _PREFILL_ACTIVE.set(float(len(self._active_prefills())))
+
+    # -- compat ---------------------------------------------------------
+    @property
+    def prefill(self):
+        """The primary prefill engine (single-prefill topologies)."""
+        return next(iter(self.prefills.values()))
 
     # -- routing --------------------------------------------------------
+    @staticmethod
+    def _safe_stats(eng) -> dict:
+        """stats() from a replica that may be mid-death: a raising
+        replica reports nothing (the ping loop owns marking it
+        unhealthy) instead of wedging the whole router."""
+        try:
+            return eng.stats()
+        except Exception:  # noqa: BLE001 — dead replica, health owns it
+            return {}
+
+    def _active_prefills(self) -> List[str]:
+        return sorted(
+            set(self.prefills) - self._prefill_down
+            - self._prefill_unhealthy
+        )
+
     def _route(self, session: str) -> str:
         pinned = self._sessions.get(session)
         if pinned is not None:
@@ -174,15 +246,43 @@ class Router:
             self._sessions.popitem(last=False)
         return rid
 
+    def _pick_prefill(self) -> str:
+        active = self._active_prefills()
+        if not active:
+            raise RouterReject(
+                "no_healthy_prefill",
+                "every prefill replica is drained",
+            )
+        # least-queued active prefill, id tiebreak for determinism; a
+        # replica whose stats() raises (died since its last ping) is
+        # skipped rather than picked-as-empty
+        cands = []
+        for pid in active:
+            try:
+                q = int(self.prefills[pid].stats().get("queued", 0))
+            except Exception:  # noqa: BLE001 — health owns the drain
+                continue
+            cands.append((q, pid))
+        if not cands:
+            raise RouterReject(
+                "no_healthy_prefill",
+                "every prefill replica is drained or unreachable",
+            )
+        return min(cands)[1]
+
     def submit(self, session: str, rid: str, prompt, num_new: int) -> str:
         """Admit one request: pick the session's replica, check its
         live load (active slots + handles claimed but not yet in a slot
         + our own uncollected prefill backlog for it), and queue the
-        prefill.  Returns the chosen replica id; raises
-        :class:`RouterReject` on shed."""
+        prefill on the least-loaded active prefill replica.  Returns
+        the chosen decode replica id; raises :class:`RouterReject` on
+        shed."""
         try:
             replica = self._route(session)
-            st = self.replicas[replica].stats()
+            # a replica dying between pings must not crash admission:
+            # an empty stats doc admits, and the handoff's fallback leg
+            # (or the next ping) owns the failure
+            st = self._safe_stats(self.replicas[replica])
             load = (int(st.get("active_slots", 0))
                     + int(st.get("queued", 0))
                     + self._pending.get(replica, 0))
@@ -192,22 +292,72 @@ class Router:
                     "replica_saturated",
                     f"replica {replica} at {load} (≥ {limit})",
                 )
+            pid = self._pick_prefill()
         except RouterReject as e:
             self.shed += 1
             _REQS_TOTAL.inc(outcome="shed")
             _SHED_TOTAL.inc(reason=e.reason)
             raise
-        self.prefill.submit(rid, prompt, num_new)
+        self.prefills[pid].submit(rid, prompt, num_new)
+        self._rid_prefill[rid] = pid
         self._target[rid] = replica
         self._pending[replica] = self._pending.get(replica, 0) + 1
         _REQS_TOTAL.inc(outcome="routed")
         _BACKLOG.set(self._pending[replica], replica=replica)
         return replica
 
+    def cancel(self, rid: str) -> bool:
+        """Release a routed request wherever it currently lives: the
+        prefill queue (dropped before it runs), the parked-handoff
+        queue (handle released), or a decode replica's pending-adoption
+        queue (``purge_pending`` frees the claimed blocks so a
+        cancelled session can't consume a fused-adoption slot).
+        Returns True when something was cancelled."""
+        if rid in self._target:
+            pid = self._rid_prefill.get(rid)
+            eng = self.prefills.get(pid) if pid is not None else None
+            purge = getattr(eng, "purge", None)
+            purged = False
+            if purge is not None:
+                try:
+                    purged = bool(purge(rid))
+                except Exception:  # noqa: BLE001 — dead engine: fall
+                    # through to the release-on-arrival path
+                    log.debug("router: purge on prefill %s failed", pid,
+                              exc_info=True)
+            if purged:
+                self._rid_prefill.pop(rid, None)
+                self._clear_ledger(rid)
+                return True
+            # already inside the engine's admission round (or the
+            # engine cannot purge / is unreachable): release the result
+            # on arrival
+            self._cancelled.add(rid)
+            return True
+        for i, (target, res, _src) in enumerate(self._parked):
+            if res.rid == rid:
+                del self._parked[i]
+                self._dec_pending(target)
+                self._release_result(res)
+                return True
+        for rep_id, eng in self.replicas.items():
+            purge = getattr(eng, "purge_pending", None)
+            if purge is None:
+                continue
+            try:
+                if purge(rid):
+                    return True
+            except Exception:  # noqa: BLE001 — one dead replica must
+                # not stop the walk reaching a live replica's entry
+                log.debug("router: purge_pending on %s failed", rep_id,
+                          exc_info=True)
+        return False
+
     # -- health ---------------------------------------------------------
     def check_health(self) -> None:
-        """Ping every replica; drain after ``fail_threshold``
-        consecutive failures, restore on the first success."""
+        """Ping every replica (decode and prefill); drain after
+        ``fail_threshold`` consecutive failures, restore on the first
+        success."""
         self._last_ping = self._clock()
         for rid, eng in self.replicas.items():
             try:
@@ -223,6 +373,41 @@ class Router:
                 if (rid in self._healthy
                         and self._fails[rid] >= self.fail_threshold):
                     self._drain(rid)
+        for pid, eng in self.prefills.items():
+            ping = getattr(eng, "ping", None)
+            if ping is None:
+                continue  # an in-process engine with no probe is alive
+            try:
+                ok = bool(ping())
+            except Exception:  # noqa: BLE001
+                ok = False
+            if ok:
+                self._pfails[pid] = 0
+                if pid in self._prefill_unhealthy:
+                    self._prefill_unhealthy.discard(pid)
+                    self._prefill_transition(pid, "prefill_restored",
+                                             reason="ping")
+            else:
+                self._pfails[pid] += 1
+                if (pid not in self._prefill_unhealthy
+                        and self._pfails[pid] >= self.fail_threshold):
+                    self._prefill_unhealthy.add(pid)
+                    self._prefill_transition(pid, "prefill_drained",
+                                             reason="ping")
+                    self._shed_prefill_ledger(pid)
+
+    def _shed_prefill_ledger(self, pid: str) -> None:
+        """A health-drained prefill's queued rids may never produce
+        results — release their admission-ledger entries so the target
+        decode replicas' capacity is not pinned by ghosts.  The
+        rid→prefill map is KEPT: if the engine recovers and emits a
+        late result, pump finds no ledger entry (no double decrement),
+        re-routes over the healthy ring, and the mapping still names
+        the right pool for a release; a cancelled/shed late result
+        releases against the right engine."""
+        for rid, owner in self._rid_prefill.items():
+            if owner == pid and rid in self._target:
+                self._clear_ledger(rid)
 
     def _drain(self, rid: str) -> None:
         self._healthy.discard(rid)
@@ -241,6 +426,14 @@ class Router:
         _TRANSITIONS.inc(replica=rid, to="restored")
         emit(EventType.REPLICA_RESTORED, "router", node=rid)
         log.info("router: replica %s restored", rid)
+
+    def _prefill_transition(self, pid: str, to: str, reason: str) -> None:
+        _TRANSITIONS.inc(replica=pid, to=to)
+        _PREFILL_ACTIVE.set(float(len(self._active_prefills())))
+        ev = (EventType.REPLICA_DRAINED if to.endswith("drained")
+              else EventType.REPLICA_RESTORED)
+        emit(ev, "router", node=pid, role="prefill", reason=reason)
+        log.info("router: prefill %s → %s (%s)", pid, to, reason)
 
     def _rebuild_ring(self) -> None:
         # new sessions re-hash over the healthy set; pinned sessions on
@@ -262,23 +455,100 @@ class Router:
             return cands[0]
         return HashRing(cands).owner(rid_req)
 
+    # -- prefill scaling -------------------------------------------------
+    def _scale_prefills(self) -> None:
+        """Backlog-driven drain/restore of prefill replicas.  Restore a
+        scaled-down replica when the backlog per active prefill runs
+        deep — or when decode slots starve (low ``slots_active_ratio``)
+        while prefill work queues, the signature of an underpowered
+        prefill tier.  Scale one down when the backlog per active
+        prefill drains below the low watermark."""
+        if len(self.prefills) <= 1:
+            return
+        if self._scale_cooldown > 0:
+            self._scale_cooldown -= 1
+            return
+        eligible = set(self.prefills) - self._prefill_unhealthy
+        active = sorted(eligible - self._prefill_down)
+        if not active:
+            return
+        # parked handoffs are EXCLUDED on purpose: they are blocked on
+        # decode-pool credits, so more prefill capacity cannot shrink
+        # them — counting them here restored prefill replicas exactly
+        # when the bottleneck was decode
+        backlog = sum(
+            int(self._safe_stats(eng).get("queued", 0))
+            for eng in self.prefills.values()
+        )
+        ratios = []
+        for eng in self.replicas.values():
+            st = self._safe_stats(eng)
+            if not st:
+                continue
+            r = st.get("slots_active_ratio")
+            if r is None:
+                r = (int(st.get("active_slots", 0))
+                     / max(1, int(st.get("max_batch", 1))))
+            ratios.append(float(r))
+        mean_ratio = sum(ratios) / len(ratios) if ratios else 0.0
+        per = backlog / max(1, len(active))
+        starved = backlog > 0 and mean_ratio < 0.5
+        down_eligible = sorted(self._prefill_down & eligible)
+        if down_eligible and (per > self.prefill_scale_high
+                              or (starved and per > self.prefill_scale_low)):
+            pid = down_eligible[0]
+            self._prefill_down.discard(pid)
+            self._prefill_transition(pid, "prefill_restored",
+                                     reason="backlog")
+            self._scale_cooldown = self.prefill_scale_cooldown
+        elif (per < self.prefill_scale_low
+                and len(active) > self.prefill_min_active):
+            pid = active[-1]
+            self._prefill_down.add(pid)
+            self._prefill_transition(pid, "prefill_drained",
+                                     reason="backlog")
+            self._scale_cooldown = self.prefill_scale_cooldown
+
     # -- drive ----------------------------------------------------------
+    def _dec_pending(self, replica: str) -> None:
+        self._pending[replica] = max(0, self._pending.get(replica, 1) - 1)
+        _BACKLOG.set(self._pending[replica], replica=replica)
+
+    def _clear_ledger(self, rid: str) -> None:
+        orig = self._target.pop(rid, None)
+        if orig is not None:
+            self._dec_pending(orig)
+
+    def _release_result(self, res) -> None:
+        """Abandon a finished prefill: free its handle's blocks in the
+        source pool instead of leaking them."""
+        pid = self._rid_prefill.pop(res.rid, None)
+        eng = self.prefills.get(pid) if pid is not None else self.prefill
+        try:
+            eng.pool.release_handle(res.handle)
+        except KVHandoffError:
+            log.warning(
+                "router: handle for %s already claimed by a failed "
+                "replica; its blocks follow that replica's queue",
+                res.rid,
+            )
+
     def pump(self) -> int:
-        """One cooperative round: health (if due), one prefill step,
+        """One cooperative round: health (if due), prefill scaling,
+        parked-handoff retries, one step per prefill replica with work,
         adopt every finished prefill into its replica, one decode step
         per replica.  Returns the number of handoffs performed."""
         if (self.ping_interval_s
                 and self._clock() - self._last_ping >= self.ping_interval_s):
             self.check_health()
+        self._scale_prefills()
         handoffs = 0
-        src = None if getattr(self.prefill, "_host", None) is not None \
-            else self.prefill
         # deliveries are batched per replica: every handle lands with
         # admit=False and the replica admits ONCE after the batch — one
         # fused adoption group instead of one device program per handle
-        touched = set()
+        touched: set = set()
 
-        def deliver(rep_id: str, res) -> None:
+        def deliver(rep_id: str, res, src) -> None:
             eng = self.replicas[rep_id]
             if hasattr(eng, "admit_pending"):
                 eng.submit_handle(
@@ -292,49 +562,95 @@ class Router:
                     source=src, submitted=res.submitted,
                 )
 
-        for res in self.prefill.step():
-            orig = self._target.pop(res.rid, None)
-            if orig is not None:  # the uncollected-backlog ledger entry
-                self._pending[orig] = max(0, self._pending.get(orig, 1) - 1)
-                _BACKLOG.set(self._pending[orig], replica=orig)
-            target = orig if orig in self.replicas \
-                else self._route_fallback(res.rid)
-            delivered = False
-            if target is not None:
-                try:
-                    deliver(target, res)
-                    delivered = True
-                except Exception:  # noqa: BLE001 — died mid-handoff
-                    log.exception("router: handoff to %s failed", target)
-                    fb = self._route_fallback(res.rid, exclude=target)
-                    if fb is not None:
-                        try:
-                            deliver(fb, res)
-                            delivered = True
-                        except Exception:  # noqa: BLE001
-                            log.exception(
-                                "router: fallback handoff to %s failed", fb
-                            )
-            if delivered:
-                handoffs += 1
-            else:
-                # nobody can take it: abandon the prefill so its blocks
-                # free instead of leaking, and account the loss loudly.
-                # The claim may already be consumed (a replica accepted
-                # the handle, then its admission program died) — in
-                # that case there is nothing left to free here
-                try:
-                    self.prefill.pool.release_handle(res.handle)
-                except KVHandoffError:
-                    log.warning(
-                        "router: handle for %s already claimed by a "
-                        "failed replica; its blocks follow that "
-                        "replica's queue", res.rid,
-                    )
-                self.shed += 1
-                _SHED_TOTAL.inc(reason=("no_healthy_replica"
-                                        if target is None
-                                        else "handoff_failed"))
+        # saturated wire handoffs first: their credits may have freed
+        for _ in range(len(self._parked)):
+            target, res, src = self._parked.popleft()
+            if res.rid in self._cancelled:
+                self._cancelled.discard(res.rid)
+                self._dec_pending(target)
+                self._release_result(res)
+                continue
+            try:
+                deliver(target, res, src)
+            except ReplicaSaturatedError:
+                self._parked.append((target, res, src))
+                continue
+            except Exception:  # noqa: BLE001 — replica died while parked
+                log.exception("router: parked handoff to %s failed",
+                              target)
+                self._dec_pending(target)
+                delivered = self._dispatch_failed(res, src, target,
+                                                  deliver)
+                if delivered:  # fallback took it: the rid is handed off
+                    self._rid_prefill.pop(res.rid, None)
+                handoffs += delivered
+                continue
+            self._dec_pending(target)
+            self._rid_prefill.pop(res.rid, None)
+            handoffs += 1
+
+        for pid in sorted(self.prefills):
+            eng = self.prefills[pid]
+            if (pid not in self._active_prefills()
+                    and not int(self._safe_stats(eng).get("queued", 0))):
+                continue  # drained AND empty (or dead): nothing to finish
+            src = None if getattr(eng, "_host", None) is not None else eng
+            try:
+                results = eng.step()
+            except Exception:  # noqa: BLE001 — a dead prefill fails pings next
+                log.exception("router: prefill %s step failed", pid)
+                continue
+            for res in results:
+                orig = self._target.pop(res.rid, None)
+                if orig is not None:  # the uncollected-backlog entry
+                    self._dec_pending(orig)
+                if res.rid in self._cancelled:
+                    self._cancelled.discard(res.rid)
+                    self._release_result(res)
+                    continue
+                target = orig if orig in self.replicas \
+                    else self._route_fallback(res.rid)
+                delivered = False
+                if target is not None:
+                    try:
+                        deliver(target, res, src)
+                        delivered = True
+                    except ReplicaSaturatedError:
+                        # credit backpressure, not failure: the handle
+                        # stays adoptable; park and retry as the decode
+                        # pool frees.  The ledger entry stays so the
+                        # admission controller keeps counting it.
+                        self._parked.append((target, res, src))
+                        self._pending[target] = (
+                            self._pending.get(target, 0) + 1
+                        )
+                        _BACKLOG.set(self._pending[target],
+                                     replica=target)
+                        continue
+                    except Exception:  # noqa: BLE001 — died mid-handoff
+                        log.exception("router: handoff to %s failed",
+                                      target)
+                        delivered = bool(self._dispatch_failed(
+                            res, src, target, deliver
+                        ))
+                        if delivered:
+                            handoffs += 1
+                            self._rid_prefill.pop(res.rid, None)
+                        continue
+                if delivered:
+                    handoffs += 1
+                    self._rid_prefill.pop(res.rid, None)
+                else:
+                    # _release_result owns the _rid_prefill pop: it must
+                    # see the rid→prefill mapping to release the handle
+                    # against the RIGHT engine's pool (popping first
+                    # made a multi-prefill shed release against the
+                    # primary prefill and leak the real pool's blocks)
+                    self._release_result(res)
+                    self.shed += 1
+                    _SHED_TOTAL.inc(reason=("no_healthy_replica"
+                                            if target is None
+                                            else "handoff_failed"))
         for rep_id in touched:
             try:
                 self.replicas[rep_id].admit_pending()
@@ -350,15 +666,35 @@ class Router:
                           exc_info=True)
         return handoffs
 
+    def _dispatch_failed(self, res, src, failed_target, deliver) -> int:
+        """Fallback leg of a failed handoff: re-route to another healthy
+        replica, or abandon the prefill (blocks freed, loss accounted)."""
+        fb = self._route_fallback(res.rid, exclude=failed_target)
+        if fb is not None:
+            try:
+                deliver(fb, res, src)
+                return 1
+            except Exception:  # noqa: BLE001
+                log.exception("router: fallback handoff to %s failed", fb)
+        self._release_result(res)
+        self.shed += 1
+        _SHED_TOTAL.inc(reason=("no_healthy_replica" if fb is None
+                                else "handoff_failed"))
+        return 0
+
     def idle(self) -> bool:
         """True when nothing is queued or in flight anywhere."""
-        if self.prefill.stats()["queued"]:
+        if self._parked:
             return False
+        for eng in self.prefills.values():
+            if self._safe_stats(eng).get("queued", 0):
+                return False
         for eng in self.replicas.values():
-            st = eng.stats()
+            st = self._safe_stats(eng)
             if (st.get("active_slots", 0) or st.get("queued", 0)
                     or st.get("inflight_windows", 0)
-                    or st.get("prefilling_slots", 0)):
+                    or st.get("prefilling_slots", 0)
+                    or st.get("wire_senders", 0)):
                 return False
         return True
 
@@ -387,6 +723,12 @@ class Router:
             "healthy": sorted(self._healthy),
             "sessions": len(self._sessions),
             "shed": self.shed,
-            "prefill_queued": self.prefill.stats()["queued"],
+            "prefills": sorted(self.prefills),
+            "prefill_active": self._active_prefills(),
+            "prefill_queued": sum(
+                int(self._safe_stats(eng).get("queued", 0))
+                for eng in self.prefills.values()
+            ),
+            "parked_handoffs": len(self._parked),
             "pending_handoffs": dict(self._pending),
         }

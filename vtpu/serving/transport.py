@@ -1,0 +1,957 @@
+"""Wire-level K/V handoff: chunked streaming transport with credit-based
+flow control, resume, and a stale-stamp abort path.
+
+PR 7 made K/V leases transferable (``KVHandle``) but the adopt copy
+stayed an in-process device-side gather — the wire format existed, yet
+no bytes ever crossed a socket.  This module is the real transport
+(ROADMAP item 2's first-listed next step): a leased handle's blocks are
+serialized into fixed-size **chunks** over the existing ``KVHandle``
+wire format (versioned binary framing, crc-guarded), streamed over a
+link (in-process loopback, or persistent keep-alive HTTP connections —
+the same pooled-connection discipline as
+:class:`vtpu.scheduler.shard.HttpPeer`), and adopted **incrementally**
+into pre-leased destination pool blocks so the final fused bind fires
+on last-chunk arrival instead of after a full-handle copy.
+
+Overlap is the point: the prefill engine's D2H for a handle's blocks is
+issued asynchronously at extract time (``copy_to_host_async`` riding
+behind the next admission window — PR 3's double-buffering idiom), and
+the sender pushes chunks as those bytes land, so the stream hides under
+the *next* request's prefill compute.  ``make bench-disagg``'s ``wire``
+arm measures the hidden fraction (acceptance: ≥ 80%).
+
+Protocol (docs/serving.md §Wire transport has the full matrix):
+
+- **Framing**: every frame is ``header ‖ meta-JSON ‖ payload``.  The
+  header is fixed-layout (magic, version, kind, flags, seq, chunk
+  count, block offset, block count, lengths, payload crc32, 16-byte
+  stream id).  Frame 0 (``seq=0``) is the OPEN: it carries the handle
+  wire doc + pool layout digest as meta and no payload; data chunks are
+  ``seq 1..nchunks`` with the FIN flag on the last.
+- **Credits**: the receiver pre-leases destination blocks and
+  advertises the leased count as its credit grant; the sender never
+  ships a block past the grant, so a saturated decode pool backpressures
+  into the router (a shed with ``reason=replica_saturated``) instead of
+  an OOM.  Credits top up as the decode engine retires slots.
+- **Resume**: a torn connection is retried at chunk granularity — the
+  sender queries the receiver's next-expected seq (RESUME frame) on a
+  fresh connection and continues from there,
+  ``vtpu_kv_transport_resumes_total`` counting each.  A replayed chunk
+  the receiver already applied is a typed ``DuplicateChunkError``.
+- **Abort**: a stream that cannot finish (sender death, receiver
+  death, protocol violation) releases BOTH sides' blocks — partial
+  adoptions never leak (extends PR 7's ``StaleHandleError`` protocol:
+  the receiver remembers consumed ``(pool, stamp)`` pairs, so a
+  mid-stream stamp reuse is rejected loudly).
+
+This module is deliberately JAX-free: the device work (gather/D2H on
+the prefill side, incremental scatter + fused bind on the decode side)
+lives behind the engines' ``start_extract`` / ``wire_*`` surfaces in
+vtpu/serving/disagg.py, so the protocol state machines — and the
+adversarial wire-format test suite — run in the fast, JAX-less lane.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import json
+import logging
+import struct
+import threading
+import time
+import urllib.parse
+import uuid
+import zlib
+from typing import Callable, Dict, List, Optional, Tuple
+
+from vtpu import obs
+from vtpu.serving.kvpool import (
+    HANDOFF_HOST_BYTES,
+    HANDOFF_STALE,
+    KVHandle,
+    KVHandoffError,
+    PoolMismatchError,
+    StaleHandleError,
+)
+from vtpu.utils import trace
+from vtpu.utils.envs import env_int
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "CreditOverrunError",
+    "DuplicateChunkError",
+    "Frame",
+    "HttpKVLink",
+    "LoopbackLink",
+    "OutOfOrderChunkError",
+    "ReceiverHub",
+    "ReplicaSaturatedError",
+    "StreamAbortedError",
+    "StreamSender",
+    "TruncatedChunkError",
+    "VersionSkewError",
+    "WireError",
+    "WireReplica",
+    "decode_frame",
+    "encode_frame",
+]
+
+_REG = obs.registry("serving")
+
+# Wire-transport instrumentation (docs/observability.md §Serving).  The
+# byte counter is the companion of vtpu_kv_handoff_host_bytes_total:
+# cache bytes DO cross the host on the wire path — deliberately, and
+# accounted here — while the in-process adopt paths keep host_bytes
+# untouched (the original tripwire still holds for them).
+TRANSPORT_BYTES = _REG.counter(
+    "vtpu_kv_transport_bytes_total",
+    "K/V cache bytes shipped over the wire transport (payload bytes, "
+    "excluding frame headers)",
+)
+TRANSPORT_CHUNKS = _REG.counter(
+    "vtpu_kv_transport_chunks_total",
+    "Wire transport data chunks delivered",
+)
+TRANSPORT_CREDITS = _REG.gauge(
+    "vtpu_kv_transport_inflight_credits_total",
+    "Receiver-granted block credits not yet consumed by senders, "
+    "summed over live streams",
+)
+TRANSPORT_STREAM_HIST = _REG.histogram(
+    "vtpu_kv_transport_stream_seconds",
+    "Wall time of one K/V wire stream, open to final ack",
+)
+TRANSPORT_RESUMES = _REG.counter(
+    "vtpu_kv_transport_resumes_total",
+    "Streams resumed at a chunk offset after a torn connection",
+)
+TRANSPORT_STREAMS = _REG.counter(
+    "vtpu_kv_transport_streams_total",
+    "Wire streams by outcome (ok / aborted / saturated)",
+)
+
+MAGIC = b"VKVW"
+VERSION = 1
+
+KIND_DATA = 0
+KIND_RESUME = 1
+KIND_ABORT = 2
+KIND_STATS = 3
+KIND_PING = 4
+
+FLAG_FIN = 0x01
+
+# magic, version, kind, flags, seq, nchunks, block_off, nblocks,
+# meta_len, payload_len, payload crc32, stream id
+_HDR = struct.Struct("<4sHBBIIIHHQI16s")
+
+DEFAULT_CHUNK_BLOCKS = env_int("VTPU_KV_CHUNK_BLOCKS", 4)
+DEFAULT_STREAM_RETRIES = env_int("VTPU_KV_STREAM_RETRIES", 2)
+DEFAULT_STAMP_CAP = env_int("VTPU_KV_STAMP_CACHE_CAP", 4096)
+
+
+class WireError(KVHandoffError):
+    """Base class for wire-transport protocol violations."""
+
+
+class TruncatedChunkError(WireError):
+    """A frame shorter than its header claims (or failing its payload
+    crc, or FIN arriving before every block) — a torn or corrupt read."""
+
+
+class VersionSkewError(WireError):
+    """The frame's protocol version does not match this endpoint's."""
+
+
+class OutOfOrderChunkError(WireError):
+    """A data chunk arrived ahead of the receiver's expected sequence."""
+
+
+class DuplicateChunkError(WireError):
+    """A data chunk the receiver already applied was replayed (a resume
+    that ignored the receiver's next-expected offset)."""
+
+
+class CreditOverrunError(WireError):
+    """The sender shipped blocks past the receiver's credit grant."""
+
+
+class StreamAbortedError(WireError):
+    """The stream cannot continue (peer aborted, unknown stream after a
+    receiver-side abort, or retries exhausted)."""
+
+
+class ReplicaSaturatedError(WireError):
+    """The receiver could not pre-lease any destination blocks — the
+    decode pool is full.  Backpressure, not failure: the router parks
+    the handoff and retries once blocks free."""
+
+
+# typed-error round trip over non-raising links (HTTP): the server maps
+# a WireError to its class name, the client maps the name back
+_ERROR_TYPES: Dict[str, type] = {
+    cls.__name__: cls
+    for cls in (
+        TruncatedChunkError, VersionSkewError, OutOfOrderChunkError,
+        DuplicateChunkError, CreditOverrunError, StreamAbortedError,
+        ReplicaSaturatedError, StaleHandleError, PoolMismatchError,
+        WireError, KVHandoffError,
+    )
+}
+
+
+def raise_wire_error(doc: dict) -> None:
+    """Re-raise a typed error from a peer's error response doc."""
+    cls = _ERROR_TYPES.get(doc.get("error", ""), WireError)
+    raise cls(doc.get("detail", doc.get("error", "wire error")))
+
+
+class Frame:
+    """One decoded wire frame."""
+
+    __slots__ = ("kind", "flags", "seq", "nchunks", "block_off",
+                 "nblocks", "sid", "meta", "payload")
+
+    def __init__(self, kind, flags, seq, nchunks, block_off, nblocks,
+                 sid, meta, payload):
+        self.kind = kind
+        self.flags = flags
+        self.seq = seq
+        self.nchunks = nchunks
+        self.block_off = block_off
+        self.nblocks = nblocks
+        self.sid = sid
+        self.meta = meta
+        self.payload = payload
+
+
+def encode_frame(
+    kind: int,
+    sid: bytes,
+    *,
+    seq: int = 0,
+    nchunks: int = 0,
+    block_off: int = 0,
+    nblocks: int = 0,
+    flags: int = 0,
+    meta: Optional[dict] = None,
+    payload: bytes = b"",
+) -> bytes:
+    meta_b = json.dumps(meta, sort_keys=True).encode() if meta else b""
+    hdr = _HDR.pack(
+        MAGIC, VERSION, kind, flags, seq, nchunks, block_off, nblocks,
+        len(meta_b), len(payload), zlib.crc32(payload) & 0xFFFFFFFF, sid,
+    )
+    return hdr + meta_b + payload
+
+
+def decode_frame(data: bytes) -> Frame:
+    if len(data) < _HDR.size:
+        raise TruncatedChunkError(
+            f"frame shorter than the fixed header "
+            f"({len(data)} < {_HDR.size} bytes)"
+        )
+    (magic, version, kind, flags, seq, nchunks, block_off, nblocks,
+     meta_len, payload_len, crc, sid) = _HDR.unpack_from(data)
+    if magic != MAGIC:
+        raise WireError(f"not a K/V wire frame (magic {magic!r})")
+    if version != VERSION:
+        raise VersionSkewError(
+            f"peer speaks wire version {version}, this endpoint "
+            f"speaks {VERSION}"
+        )
+    if len(data) != _HDR.size + meta_len + payload_len:
+        raise TruncatedChunkError(
+            f"frame length {len(data)} != header-declared "
+            f"{_HDR.size + meta_len + payload_len}"
+        )
+    meta_b = data[_HDR.size:_HDR.size + meta_len]
+    payload = data[_HDR.size + meta_len:]
+    if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+        raise TruncatedChunkError("payload crc mismatch (corrupt chunk)")
+    meta = None
+    if meta_len:
+        try:
+            meta = json.loads(meta_b)
+        except ValueError as e:
+            raise WireError(f"malformed frame meta: {e}") from e
+    return Frame(kind, flags, seq, nchunks, block_off, nblocks, sid,
+                 meta, payload)
+
+
+# ---------------------------------------------------------------------------
+# Receiver side
+# ---------------------------------------------------------------------------
+
+class _RxStream:
+    __slots__ = ("sid", "rid", "meta", "ctx", "nchunks", "next_seq",
+                 "total_blocks", "received_blocks", "credits",
+                 "stamp_key", "opened")
+
+    def __init__(self, sid, rid, meta, ctx, nchunks, total_blocks,
+                 credits, stamp_key, opened):
+        self.sid = sid
+        self.rid = rid
+        self.meta = meta
+        self.ctx = ctx
+        self.nchunks = nchunks
+        self.next_seq = 1
+        self.total_blocks = total_blocks
+        self.received_blocks = 0
+        self.credits = credits
+        self.stamp_key = stamp_key
+        self.opened = opened
+
+
+class ReceiverHub:
+    """Decode-side endpoint: demultiplexes frames into per-stream state
+    against a wire *sink* — anything exposing the engine surface
+    ``wire_open / wire_write / wire_top_up / wire_finish / wire_abort``
+    plus ``stats()`` / ``ping()`` (:class:`vtpu.serving.disagg.
+    DecodeEngine` implements it; the adversarial tests use fakes).
+
+    Every protocol violation aborts the offending stream FIRST (both
+    pools leak-free) and then raises the typed error, so an in-process
+    caller gets the exception and an HTTP server wraps it into the
+    typed-error response doc."""
+
+    def __init__(self, sink, *, stamp_cap: int = 0) -> None:
+        self.sink = sink
+        self._streams: Dict[bytes, _RxStream] = {}
+        # consumed (pool, stamp) pairs: a handle is adoptable exactly
+        # once, across transports too — a second OPEN with a stamp this
+        # receiver has already seen is the mid-stream-reuse attack the
+        # StaleHandleError protocol exists to stop.  Bounded FIFO.
+        self._stamps: "collections.OrderedDict[Tuple[str, int], bytes]" = (
+            collections.OrderedDict()
+        )
+        # finished-stream tombstones (sid → nchunks): a sender whose
+        # FIN *response* was lost on a torn connection resumes and must
+        # learn "that stream completed" — answering "gone" (the abort
+        # reply) would make it abort a transfer that succeeded, and the
+        # deployment would retry an already-decoding request.  Bounded
+        # FIFO like the stamp cache.
+        self._fins: "collections.OrderedDict[bytes, int]" = (
+            collections.OrderedDict()
+        )
+        self._stamp_cap = stamp_cap or DEFAULT_STAMP_CAP
+        self._lock = threading.RLock()
+
+    # -- bookkeeping ----------------------------------------------------
+    def _set_credit_gauge(self) -> None:
+        TRANSPORT_CREDITS.set(float(sum(
+            max(0, s.credits - s.received_blocks)
+            for s in self._streams.values()
+        )))
+
+    def open_streams(self) -> int:
+        with self._lock:
+            return len(self._streams)
+
+    def _abort_stream(self, st: _RxStream) -> None:
+        self._streams.pop(st.sid, None)
+        try:
+            self.sink.wire_abort(st.ctx)
+        except Exception:  # noqa: BLE001 — abort must not mask the cause
+            log.exception("kv wire: sink abort failed for %s", st.rid)
+        self._set_credit_gauge()
+
+    def abort_all(self) -> None:
+        """Receiver-side teardown (replica shutdown): release every
+        partial adoption."""
+        with self._lock:
+            for st in list(self._streams.values()):
+                self._abort_stream(st)
+                TRANSPORT_STREAMS.inc(outcome="aborted")
+
+    # -- frame handling -------------------------------------------------
+    def handle(self, data: bytes) -> dict:
+        frame = decode_frame(data)
+        with self._lock:
+            if frame.kind == KIND_PING:
+                return {"status": "ok", "ping": bool(self.sink.ping())}
+            if frame.kind == KIND_STATS:
+                st = dict(self.sink.stats())
+                st["wire_streams"] = len(self._streams)
+                return {"status": "ok", "stats": st}
+            if frame.kind == KIND_ABORT:
+                st = self._streams.get(frame.sid)
+                if st is not None:
+                    self._abort_stream(st)
+                    TRANSPORT_STREAMS.inc(outcome="aborted")
+                return {"status": "ok"}
+            if frame.kind == KIND_RESUME:
+                st = self._streams.get(frame.sid)
+                if st is None:
+                    nchunks = self._fins.get(frame.sid)
+                    if nchunks is not None:
+                        return {"status": "fin", "next": nchunks + 1,
+                                "credits": 0}
+                    return {"status": "gone"}
+                # RESUME doubles as the credit poll: a starved sender
+                # re-asks here, so blocks freed since the last data
+                # frame become credits without an extra frame kind
+                if st.credits < st.total_blocks:
+                    st.credits = int(self.sink.wire_top_up(st.ctx))
+                    self._set_credit_gauge()
+                return {"status": "ok", "next": st.next_seq,
+                        "credits": st.credits}
+            if frame.kind != KIND_DATA:
+                raise WireError(f"unknown frame kind {frame.kind}")
+            if frame.seq == 0:
+                return self._open(frame)
+            return self._data(frame)
+
+    def _open(self, frame: Frame) -> dict:
+        meta = frame.meta or {}
+        try:
+            handle = KVHandle.from_wire(meta["handle"])
+            rid = str(meta["rid"])
+            layout = meta["layout"]
+            chunk_blocks = int(meta.get("chunk_blocks",
+                                        DEFAULT_CHUNK_BLOCKS))
+        except (KeyError, TypeError, KVHandoffError) as e:
+            raise WireError(f"malformed stream OPEN meta: {e}") from e
+        if frame.sid in self._streams:
+            raise DuplicateChunkError(
+                f"stream {frame.sid.hex()} already open"
+            )
+        stamp_key = (handle.pool_id, handle.stamp)
+        if stamp_key in self._stamps:
+            HANDOFF_STALE.inc()
+            raise StaleHandleError(
+                f"handle stamp {handle.stamp} from pool "
+                f"{handle.pool_id} was already streamed to this "
+                f"receiver (mid-stream stamp reuse)"
+            )
+        total = len(handle.blocks)
+        ctx = self.sink.wire_open(rid, total, layout, chunk_blocks)
+        if ctx is None:
+            TRANSPORT_STREAMS.inc(outcome="saturated")
+            return {"status": "saturated", "credits": 0}
+        credits = int(self.sink.wire_credits(ctx))
+        st = _RxStream(frame.sid, rid, meta, ctx, frame.nchunks, total,
+                       credits, stamp_key, time.perf_counter())
+        self._streams[frame.sid] = st
+        self._stamps[stamp_key] = frame.sid
+        while len(self._stamps) > self._stamp_cap:
+            self._stamps.popitem(last=False)
+        self._set_credit_gauge()
+        return {"status": "ok", "next": 1, "credits": credits}
+
+    def _data(self, frame: Frame) -> dict:
+        st = self._streams.get(frame.sid)
+        if st is None:
+            raise StreamAbortedError(
+                f"no such stream {frame.sid.hex()} (aborted, finished, "
+                f"or never opened)"
+            )
+        try:
+            if frame.seq < st.next_seq:
+                raise DuplicateChunkError(
+                    f"chunk {frame.seq} already applied "
+                    f"(next expected: {st.next_seq})"
+                )
+            if frame.seq > st.next_seq:
+                raise OutOfOrderChunkError(
+                    f"chunk {frame.seq} ahead of expected {st.next_seq}"
+                )
+            if frame.block_off != st.received_blocks:
+                raise OutOfOrderChunkError(
+                    f"chunk block offset {frame.block_off} != received "
+                    f"{st.received_blocks}"
+                )
+            end = frame.block_off + frame.nblocks
+            if end > st.total_blocks:
+                raise TruncatedChunkError(
+                    f"chunk spills past the handle "
+                    f"({end} > {st.total_blocks} blocks)"
+                )
+            if end > st.credits:
+                raise CreditOverrunError(
+                    f"chunk reaches block {end} past the credit grant "
+                    f"{st.credits}"
+                )
+            try:
+                self.sink.wire_write(st.ctx, frame.block_off,
+                                     frame.nblocks, frame.payload)
+            except WireError:
+                raise
+            except Exception as e:  # sink-side shape/size mismatch
+                raise TruncatedChunkError(
+                    f"chunk payload rejected by the pool sink: {e}"
+                ) from e
+            st.next_seq = frame.seq + 1
+            st.received_blocks = end
+            TRANSPORT_CHUNKS.inc()
+            TRANSPORT_BYTES.inc(len(frame.payload))
+            # the wire path is the ONE place cache bytes legitimately
+            # cross the host; account them in the handoff family too so
+            # the old tripwire becomes a ledger (docs/serving.md)
+            HANDOFF_HOST_BYTES.inc(len(frame.payload))
+            if frame.flags & FLAG_FIN:
+                if (frame.seq != st.nchunks
+                        or st.received_blocks != st.total_blocks):
+                    raise TruncatedChunkError(
+                        f"FIN at chunk {frame.seq}/{st.nchunks} with "
+                        f"{st.received_blocks}/{st.total_blocks} blocks"
+                    )
+                self._streams.pop(st.sid, None)
+                self.sink.wire_finish(st.ctx, st.meta)
+                self._fins[st.sid] = st.nchunks
+                while len(self._fins) > self._stamp_cap:
+                    self._fins.popitem(last=False)
+                TRANSPORT_STREAMS.inc(outcome="ok")
+                self._set_credit_gauge()
+                return {"status": "ok", "next": st.next_seq,
+                        "credits": st.credits, "fin": True}
+            if st.credits < st.total_blocks:
+                st.credits = int(self.sink.wire_top_up(st.ctx))
+            self._set_credit_gauge()
+            return {"status": "ok", "next": st.next_seq,
+                    "credits": st.credits}
+        except WireError:
+            # protocol violations tear the stream down leak-free BEFORE
+            # propagating — a half-adopted handle must never pin blocks
+            self._abort_stream(st)
+            TRANSPORT_STREAMS.inc(outcome="aborted")
+            raise
+
+    def top_up(self) -> None:
+        """Re-ask the sink for credits on every starved stream (the
+        decode engine's pump calls this as slots retire)."""
+        with self._lock:
+            for st in self._streams.values():
+                if st.credits < st.total_blocks:
+                    st.credits = int(self.sink.wire_top_up(st.ctx))
+            self._set_credit_gauge()
+
+
+# ---------------------------------------------------------------------------
+# Links
+# ---------------------------------------------------------------------------
+
+class LoopbackLink:
+    """In-process link: frames go straight into a :class:`ReceiverHub`.
+    ``fault`` (optional) is called with each outgoing frame's bytes and
+    may raise to simulate a torn connection — the sender's retry/resume
+    path is exercised without sockets or sleeps."""
+
+    def __init__(self, hub: ReceiverHub,
+                 fault: Optional[Callable[[bytes], None]] = None) -> None:
+        self.hub = hub
+        self.fault = fault
+
+    def send(self, data: bytes, fresh: bool = False) -> dict:
+        if self.fault is not None and not fresh:
+            self.fault(data)
+        return self.hub.handle(data)
+
+    def close(self) -> None:
+        pass
+
+
+class HttpKVLink:
+    """Persistent keep-alive HTTP link to a remote receiver endpoint
+    (``POST /kv/stream``, binary frame body → JSON response).  Same
+    pooled-connection discipline as the sharded extender's
+    :class:`~vtpu.scheduler.shard.HttpPeer`: a bounded idle pool of
+    ``http.client`` connections reused across frames; a stale keep-alive
+    failure closes the connection and surfaces to the sender, whose
+    chunk-level RESUME (on a ``fresh=True`` pooled-bypass connection)
+    owns the retry — the link itself never replays a frame, because a
+    data chunk whose response was lost may have been applied and a blind
+    replay would be the DuplicateChunkError the protocol rejects."""
+
+    def __init__(self, base_url: str, timeout_s: float = 5.0,
+                 pool_size: int = 2, path: str = "/kv/stream") -> None:
+        self.base_url = base_url.rstrip("/")
+        self.path = path
+        self.timeout_s = timeout_s
+        self.pool_size = max(1, pool_size)
+        u = urllib.parse.urlsplit(self.base_url)
+        if u.scheme != "http":
+            raise ValueError(
+                f"HttpKVLink speaks plain http in-cluster, got "
+                f"{self.base_url!r}"
+            )
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port or 80
+        self._lock = threading.Lock()
+        self._idle: collections.deque = collections.deque()
+
+    def _acquire(self, fresh: bool):
+        if not fresh:
+            with self._lock:
+                if self._idle:
+                    return self._idle.pop()
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=self.timeout_s
+        )
+
+    def _release(self, conn) -> None:
+        with self._lock:
+            if len(self._idle) < self.pool_size:
+                self._idle.append(conn)
+                return
+        conn.close()
+
+    def close(self) -> None:
+        with self._lock:
+            while self._idle:
+                self._idle.pop().close()
+
+    def send(self, data: bytes, fresh: bool = False) -> dict:
+        conn = self._acquire(fresh)
+        try:
+            conn.request("POST", self.path, data,
+                         {"Content-Type": "application/octet-stream"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.will_close:
+                conn.close()
+            else:
+                self._release(conn)
+        except (http.client.HTTPException, OSError):
+            conn.close()
+            raise
+        doc = json.loads(body or b"{}")
+        if doc.get("status") == "error":
+            raise_wire_error(doc)
+        return doc
+
+
+def handle_http_frame(hub: ReceiverHub, body: bytes) -> Tuple[int, dict]:
+    """Server-side glue for an HTTP listener: one frame in, one
+    ``(http status, response doc)`` out, typed errors mapped to the
+    error-doc form :func:`raise_wire_error` reverses."""
+    try:
+        return 200, hub.handle(body)
+    except WireError as e:
+        return 400, {"status": "error", "error": type(e).__name__,
+                     "detail": str(e)}
+    except KVHandoffError as e:
+        return 409, {"status": "error", "error": type(e).__name__,
+                     "detail": str(e)}
+
+
+# ---------------------------------------------------------------------------
+# Sender side
+# ---------------------------------------------------------------------------
+
+class StreamSender:
+    """One outbound K/V stream: chunks an extract's host bytes under the
+    receiver's credit grant, resumes at chunk granularity on a torn
+    connection, and aborts leak-free when retries exhaust.
+
+    ``extract`` is the prefill engine's async D2H handle
+    (:meth:`vtpu.serving.disagg.PrefillEngine.start_extract`):
+    ``ready_blocks()`` says how many leading blocks have landed on the
+    host (the overlap driver — chunks ship as the copy completes, behind
+    the next prefill window), ``payload(lo, hi)`` yields their bytes.
+    ``on_done(ok)`` releases the source pool's blocks either way."""
+
+    def __init__(
+        self,
+        link,
+        rid: str,
+        handle: KVHandle,
+        extract=None,
+        *,
+        layout: Optional[list] = None,
+        meta_extra: Optional[dict] = None,
+        chunk_blocks: int = 0,
+        retries: int = 0,
+        on_done: Optional[Callable[[bool], None]] = None,
+        extract_fn: Optional[Callable[[], object]] = None,
+    ) -> None:
+        self.link = link
+        self.rid = rid
+        self.handle = handle
+        # the extract may attach AFTER open(): the OPEN must precede the
+        # source-pool claim (a saturated receiver leaves the handle
+        # adoptable for a later retry), and the claim precedes the D2H.
+        # ``extract_fn`` defers even the gather DISPATCH to the first
+        # pump — the pump thread owns the device extract, so its cost
+        # lands under the next prefill window instead of serializing
+        # with the submit path (claimed blocks are never written by
+        # later pool programs, so the late gather reads stable rows)
+        self.extract = extract
+        self.extract_fn = extract_fn
+        self.chunk_blocks = chunk_blocks or DEFAULT_CHUNK_BLOCKS
+        self.retries = retries or DEFAULT_STREAM_RETRIES
+        self.on_done = on_done
+        self.sid = uuid.uuid4().bytes
+        total = len(handle.blocks)
+        self.nchunks = -(-total // self.chunk_blocks) if total else 0
+        self.meta = {
+            "rid": rid,
+            "handle": handle.to_wire(),
+            "layout": (layout if layout is not None
+                       else extract.layout() if extract is not None
+                       else []),
+            "chunk_blocks": self.chunk_blocks,
+            **(meta_extra or {}),
+        }
+        self._next = 0            # 0 = OPEN not yet acked
+        self._credits = 0
+        self._resumes = 0         # per-stream budget: retries total
+        self._t0 = 0.0
+        self.finished_at = 0.0    # perf_counter stamp of final ack/abort
+        self.done = False
+        self.aborted = False
+
+    # -- wire I/O with resume -------------------------------------------
+    def _send(self, data: bytes) -> dict:
+        """One frame with chunk-level resume: a torn connection re-syncs
+        to the receiver's next-expected seq on a fresh connection and
+        either skips (the lost response was applied) or re-raises for
+        the caller to retry the pump."""
+        try:
+            return self.link.send(data)
+        except (OSError, http.client.HTTPException) as e:
+            last: Exception = e
+        # the resume budget is PER STREAM, not per frame: a link that
+        # tears every data frame but still answers RESUME must not spin
+        # forever — after ``retries`` total resumes the stream aborts
+        while self._resumes < self.retries:
+            self._resumes += 1
+            TRANSPORT_RESUMES.inc()
+            try:
+                rsp = self.link.send(
+                    encode_frame(KIND_RESUME, self.sid), fresh=True
+                )
+            except (OSError, http.client.HTTPException) as e:
+                last = e
+                continue
+            if rsp.get("status") == "gone":
+                self.abort(notify=False)
+                raise StreamAbortedError(
+                    f"stream for {self.rid} gone at the receiver "
+                    f"(aborted remotely)"
+                )
+            # "fin": the torn frame WAS the FIN and it applied — the
+            # receiver's tombstone confirms completion, so the pump loop
+            # terminates and the stream finishes normally (no abort, no
+            # deployment-level retry of an already-decoding request)
+            self._next = int(rsp.get("next", self._next))
+            self._credits = int(rsp.get("credits", self._credits))
+            return rsp
+        self.abort()
+        raise StreamAbortedError(
+            f"stream for {self.rid}: resume retries exhausted"
+        ) from last
+
+    def open(self) -> None:
+        """Send the OPEN frame; raises :class:`ReplicaSaturatedError`
+        when the receiver cannot pre-lease a single block (the caller
+        parks the handoff — nothing was claimed or leaked)."""
+        self._t0 = time.perf_counter()
+        rsp = self._send(encode_frame(
+            KIND_DATA, self.sid, seq=0, nchunks=self.nchunks,
+            meta=self.meta,
+        ))
+        if rsp.get("status") == "saturated":
+            raise ReplicaSaturatedError(
+                f"receiver pool saturated for {self.rid}"
+            )
+        self._next = int(rsp.get("next", 1))
+        self._credits = int(rsp.get("credits", 0))
+
+    def pump(self) -> bool:
+        """Push every chunk the credit grant and the D2H readiness
+        allow.  Returns True when the stream finished this call."""
+        if self.done or self.aborted:
+            return self.done
+        if self._next == 0:
+            self.open()
+        if self.extract is None:
+            if self.extract_fn is None:
+                return False  # not yet extracted (caller's turn)
+            self.extract = self.extract_fn()
+            self.extract_fn = None
+        total = len(self.handle.blocks)
+        with trace.span("kv_wire_stream_pump", rid=self.rid):
+            while self._next <= self.nchunks:
+                lo = (self._next - 1) * self.chunk_blocks
+                hi = min(lo + self.chunk_blocks, total)
+                if hi > self._credits:
+                    # ask for a fresh grant (slots may have retired);
+                    # still starved → backpressure, try next pump
+                    rsp = self._send(encode_frame(KIND_RESUME, self.sid))
+                    status = rsp.get("status")
+                    if status == "gone":
+                        self.abort(notify=False)
+                        raise StreamAbortedError(
+                            f"stream for {self.rid} gone at the receiver"
+                        )
+                    if status == "fin":  # lost-FIN-ack resync: done
+                        self._next = self.nchunks + 1
+                        break
+                    self._credits = int(rsp.get("credits", self._credits))
+                    if hi > self._credits:
+                        return False
+                if self.extract.ready_blocks() < hi:
+                    return False  # D2H still in flight; ride next pump
+                payload = self.extract.payload(lo, hi)
+                flags = FLAG_FIN if self._next == self.nchunks else 0
+                rsp = self._send(encode_frame(
+                    KIND_DATA, self.sid, seq=self._next,
+                    nchunks=self.nchunks, block_off=lo, nblocks=hi - lo,
+                    flags=flags, payload=payload,
+                ))
+                self._next = int(rsp.get("next", self._next + 1))
+                self._credits = int(rsp.get("credits", self._credits))
+            self._finish()
+        return True
+
+    def _finish(self) -> None:
+        self.done = True
+        self.finished_at = time.perf_counter()
+        TRANSPORT_STREAM_HIST.observe(self.finished_at - self._t0)
+        if self.on_done is not None:
+            self.on_done(True)
+
+    def abort(self, notify: bool = True) -> None:
+        """Release the source side (and best-effort tell the receiver):
+        a stream that dies mid-flight leaks nothing on either pool."""
+        if self.done or self.aborted:
+            return
+        self.aborted = True
+        self.finished_at = time.perf_counter()
+        if notify:
+            try:
+                self.link.send(encode_frame(KIND_ABORT, self.sid),
+                               fresh=True)
+            except Exception:  # noqa: BLE001 — receiver may be dead too
+                log.debug("kv wire: abort notify failed for %s",
+                          self.rid, exc_info=True)
+        if self.on_done is not None:
+            self.on_done(False)
+
+
+# ---------------------------------------------------------------------------
+# The router-facing replica proxy
+# ---------------------------------------------------------------------------
+
+class WireReplica:
+    """A decode replica reached over the wire transport — duck-type
+    compatible with the router's replica surface (``submit_handle`` /
+    ``step`` / ``stats`` / ``ping``), so the front door needs no special
+    casing: a handoff to a WireReplica claims the handle from the source
+    pool, starts the async D2H extract, and streams chunks on subsequent
+    ``step()`` calls (the router's pump), overlapped with whatever the
+    prefill engine computes next.
+
+    ``local`` (loopback topologies: tests, the wire bench, co-located
+    processes) is the in-process decode engine behind the hub — its
+    ``step()``/transcripts are driven/read directly.  Over HTTP the
+    remote process drives its own engine and ``out`` is collected by the
+    deployment, not the router."""
+
+    def __init__(self, link, replica_id: str, *, local=None,
+                 chunk_blocks: int = 0, retries: int = 0) -> None:
+        self.link = link
+        self.replica_id = replica_id
+        self._local = local
+        self.chunk_blocks = chunk_blocks or DEFAULT_CHUNK_BLOCKS
+        self.retries = retries or DEFAULT_STREAM_RETRIES
+        self._senders: List[StreamSender] = []
+
+    # -- router surface -------------------------------------------------
+    def ping(self) -> bool:
+        rsp = self.link.send(encode_frame(KIND_PING, b"\0" * 16))
+        return bool(rsp.get("ping"))
+
+    def stats(self) -> dict:
+        rsp = self.link.send(encode_frame(KIND_STATS, b"\0" * 16))
+        st = dict(rsp.get("stats") or {})
+        st["wire_senders"] = len(self._senders)
+        # in-flight streams are uncollected work the admission
+        # controller must see, exactly like claimed-but-unslotted handles
+        st["queued"] = int(st.get("queued", 0)) + len(self._senders)
+        return st
+
+    def submit_handle(self, rid: str, handle: KVHandle, first_token: int,
+                      num_new: int, source=None, submitted: float = 0.0,
+                      admit: bool = True) -> None:
+        if source is None or getattr(source, "pool", None) is None \
+                or source.pool.pool_id != handle.pool_id:
+            raise PoolMismatchError(
+                f"wire handoff of a handle from pool {handle.pool_id!r} "
+                f"needs its source engine to extract from"
+            )
+        sender = StreamSender(
+            self.link, rid, handle,
+            layout=source.wire_layout(),
+            meta_extra={"first": int(first_token),
+                        "num_new": int(num_new),
+                        "submitted": float(submitted)},
+            chunk_blocks=self.chunk_blocks, retries=self.retries,
+        )
+        # OPEN before claiming: a saturated receiver must leave the
+        # handle adoptable so the router can park and re-deliver it once
+        # the decode pool frees — claiming first would consume the
+        # one-shot stamp on a handoff that never happened
+        sender.open()          # raises ReplicaSaturatedError, leak-free
+        blocks = source.pool.adopt(handle)   # claim AFTER the receiver
+        # the gather dispatch + D2H issue happen at the FIRST PUMP (the
+        # writer thread), overlapped with whatever the prefill engine
+        # computes next; the claim above keeps the blocks stable until
+        # then
+        sender.extract_fn = lambda: source.start_extract(blocks)
+
+        def _done(ok: bool, _blocks=blocks, _pool=source.pool) -> None:
+            # the D2H gather was enqueued before any later source-pool
+            # write, so the host-side free is safe now (same program-
+            # order argument as the fused cross-pool adopt)
+            _pool.release(_blocks)
+
+        sender.on_done = _done
+        self._senders.append(sender)
+        if admit:
+            self._pump_senders()
+
+    def admit_pending(self) -> None:
+        self._pump_senders()
+
+    def step(self) -> None:
+        self._pump_senders()
+        if self._local is not None:
+            self._local.step()
+
+    def pump_streams(self) -> None:
+        """Push chunks without stepping the local engine — the writer-
+        thread entry point: a deployment (and the wire bench) runs this
+        concurrently with the prefill engine's compute, which is where
+        the stream's wall time hides."""
+        self._pump_senders()
+
+    def _pump_senders(self) -> None:
+        keep: List[StreamSender] = []
+        for s in self._senders:
+            try:
+                s.pump()
+            except WireError:
+                if not s.aborted:
+                    s.abort()
+                raise
+            if not (s.done or s.aborted):
+                keep.append(s)
+        self._senders = keep
+
+    # -- loopback conveniences ------------------------------------------
+    @property
+    def out(self) -> dict:
+        return self._local.out if self._local is not None else {}
+
+    def _flush_first_tokens(self) -> None:
+        if self._local is not None:
+            flush = getattr(self._local, "_flush_first_tokens", None)
+            if flush is not None:
+                flush()
+
+    def idle_senders(self) -> int:
+        return len(self._senders)
