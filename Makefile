@@ -1,6 +1,8 @@
 # Build surface (ref: Makefile:1-34 — build/test/tidy/docker targets).
 # Components: native shim (cpp/), generated protos, python package, tests,
-# bench, docker image, helm chart lint.
+# bench, docker image, helm chart lint.  `make check` runs the unified
+# vtpu-check static-analysis suite (docs/static_analysis.md); obs-lint
+# and config-lint are aliases for two of its passes.
 
 VERSION ?= $(shell git describe --tags --always --dirty 2>/dev/null || echo dev)
 IMG ?= vtpu/vtpu
@@ -8,8 +10,8 @@ PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
 	bench-sched bench-serve bench-churn bench-disagg bench-gang \
-	bench-goodput bench-smoke obs-lint config-lint audit-check image \
-	chart clean tidy
+	bench-goodput bench-smoke check obs-lint config-lint audit-check \
+	image chart clean tidy
 
 all: build
 
@@ -119,17 +121,30 @@ test-native-tsan:
 	  ./build/tsan/test_shim build/tsan/libvtpu_shim.so threads \
 	  && rm -rf /tmp/vtpu-tsan-test
 
-# observability hygiene: registered metric names vs the naming convention
-# (vtpu_ prefix, unit suffix, _total counters) + the exposition-format
-# conformance tests against every renderer (docs/observability.md)
+# vtpu-check: the unified static-analysis suite (docs/static_analysis.md)
+# — one AST walk, six passes: lock-discipline (docs/scheduler_perf.md
+# §Lock-order rules + blocking-under-cache-lock), annotation-keys
+# (vtpu.io/* literals live in vtpu/utils/types.py), env-access (VTPU_*
+# reads go through vtpu/utils/envs.py), jax-hygiene (donated-buffer
+# reuse + host syncs in hot-path files), env-docs (config-lint), and
+# obs-docs (obs-lint).  Per-line suppression: `# vtpu: allow(<pass>)`.
+# The runtime side — the VTPU_LOCK_WITNESS=1 lock-order witness — runs
+# inside the threaded soak tests on every `make test`.
+check:
+	JAX_PLATFORMS=cpu $(PY) -m vtpu.analysis
+
+# observability hygiene (alias: the obs-docs pass of `make check`):
+# registered metric names vs the naming convention (vtpu_ prefix, unit
+# suffix, _total counters) + docs/observability.md catalog drift + the
+# exposition-format conformance tests against every renderer
 obs-lint:
-	JAX_PLATFORMS=cpu $(PY) hack/obs_lint.py
+	JAX_PLATFORMS=cpu $(PY) -m vtpu.analysis --only obs-docs
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_obs.py -q -k "conformance or golden"
 
-# env-var docs drift: every quoted VTPU_* literal under vtpu/ must be
-# documented in docs/config.md (the env surface grows every PR)
+# env-var docs drift (alias: the env-docs pass of `make check`): every
+# VTPU_* name referenced under vtpu/ must be documented in docs/config.md
 config-lint:
-	$(PY) hack/config_lint.py
+	$(PY) -m vtpu.analysis --only env-docs
 
 # reconciliation golden: one auditor pass over the seeded fake cluster
 # (all four drift classes), fetched through GET /audit and diffed against

@@ -24,6 +24,8 @@ import sys
 import threading
 import time
 
+from vtpu.utils.envs import env_str
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
@@ -42,7 +44,7 @@ def main(argv=None) -> int:
     p.add_argument("--debug-bind", default="0.0.0.0:9397",
                    help="observability listener (/healthz /metrics /spans "
                         "/timeline); empty string disables")
-    p.add_argument("--span-sink", default=os.environ.get("VTPU_SPAN_SINK", ""),
+    p.add_argument("--span-sink", default=env_str("VTPU_SPAN_SINK"),
                    help="collector URL to POST this daemon's trace-span "
                         "ring to (the scheduler's /spans/ingest; env "
                         "VTPU_SPAN_SINK)")

@@ -21,6 +21,8 @@ import signal
 import sys
 import threading
 
+from vtpu.utils.envs import env_str
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
@@ -36,7 +38,7 @@ def main(argv=None) -> int:
     p.add_argument("--disable-writeback", action="store_true",
                    help="never patch the vtpu.io/node-utilization "
                         "annotation (sampling + /utilization still run)")
-    p.add_argument("--span-sink", default=os.environ.get("VTPU_SPAN_SINK", ""),
+    p.add_argument("--span-sink", default=env_str("VTPU_SPAN_SINK"),
                    help="collector URL to POST this daemon's trace-span "
                         "ring to (the scheduler's /spans/ingest; env "
                         "VTPU_SPAN_SINK)")

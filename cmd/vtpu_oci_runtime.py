@@ -19,13 +19,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from vtpu.oci.runtime import SyscallExecRuntime
 from vtpu.oci.spec import FileSpec, inject_prestart_hook, spec_path_from_args
 from vtpu.utils.types import PRESTART_PROGRAM
+from vtpu.utils.envs import env_str
 
 DEFAULT_RUNTIME = "/usr/bin/runc"
 
 
 def main(argv=None) -> int:
     args = list(sys.argv if argv is None else argv)
-    real = os.environ.get("VTPU_OCI_RUNTIME", DEFAULT_RUNTIME)
+    real = env_str("VTPU_OCI_RUNTIME", DEFAULT_RUNTIME)
     if "create" in args[1:]:
         spec = FileSpec(spec_path_from_args(args[1:]))
         spec.load()

@@ -21,6 +21,8 @@ import signal
 import sys
 import threading
 
+from vtpu.utils.envs import env_float, env_str
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
@@ -49,11 +51,11 @@ def main(argv=None) -> int:
                         "when --cert/key are set; the main --http_bind "
                         "listener stays plain HTTP for the kube-scheduler "
                         "extender calls and metrics scrapes")
-    p.add_argument("--replica-id", default=os.environ.get("VTPU_REPLICA_ID", ""),
+    p.add_argument("--replica-id", default=env_str("VTPU_REPLICA_ID"),
                    help="this extender replica's id in a sharded deployment "
                         "(env VTPU_REPLICA_ID; defaults to r0)")
     p.add_argument("--shard-peers",
-                   default=os.environ.get("VTPU_SHARD_PEERS", ""),
+                   default=env_str("VTPU_SHARD_PEERS"),
                    help="comma list of PEER replicas as id=http://host:port "
                         "(env VTPU_SHARD_PEERS).  Enables sharded filtering: "
                         "consistent-hash node ownership, subset fan-out over "
@@ -63,11 +65,8 @@ def main(argv=None) -> int:
                    help="run annotation-lease leader election; only the "
                         "leader advances handshake annotations and runs the "
                         "periodic audit loop (required when N replicas run)")
-    try:
-        lease_default = float(os.environ.get("VTPU_LEADER_LEASE_S", "")
-                              or 15.0)
-    except ValueError:
-        lease_default = 15.0  # malformed env must not kill the entrypoint
+    # malformed env must not kill the entrypoint (env_float defaults)
+    lease_default = env_float("VTPU_LEADER_LEASE_S", 15.0)
     p.add_argument("--leader-lease-s", type=float, default=lease_default,
                    help="leader lease duration in seconds "
                         "(env VTPU_LEADER_LEASE_S)")
@@ -77,7 +76,7 @@ def main(argv=None) -> int:
                         "60; <= 0 disables the loop — GET /audit still "
                         "runs a pass on demand)")
     p.add_argument("--event-jsonl",
-                   default=os.environ.get("VTPU_EVENT_JSONL", ""),
+                   default=env_str("VTPU_EVENT_JSONL"),
                    help="append every journal event as one JSON line to "
                         "this file (env VTPU_EVENT_JSONL); empty disables "
                         "the mirror — the in-memory ring always runs")

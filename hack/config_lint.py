@@ -1,79 +1,26 @@
 #!/usr/bin/env python3
-"""config-lint — env-var docs-drift check (make config-lint).
+"""config-lint — alias for the unified runner's env-docs pass.
 
-Scans every Python module under ``vtpu/`` for quoted ``VTPU_*`` string
-literals (the env ABI: ``os.environ`` reads, ``ENV_*`` constants, and
-env names the plugin injects into containers) and fails when any of them
-is missing from docs/config.md — an env knob you can set but cannot look
-up is drift, the same rule obs-lint enforces for metric families.  The
-surface has grown every PR; this pins it to the catalog.
-
-Quoted-literal scanning is deliberate: indirection like
-``ENV_INTERVAL = "VTPU_AUDIT_INTERVAL_S"`` still declares the name as a
-string literal exactly once, so reads through constants are covered
-without tracing dataflow.  A ``VTPU_*`` literal that is NOT an env name
-would be a false positive — none exist today; if one ever appears,
-document it anyway (cheap) or rename it out of the env namespace.
-
-Exit 1 with one line per violation.
+The check itself (every VTPU_* env name referenced under vtpu/ must be
+documented in docs/config.md, tokenized matching) lives in
+vtpu/analysis/passes/env_docs.py since the vtpu-check consolidation,
+riding the shared AST walk instead of a private line scan.
+``make config-lint`` and ``make check`` both run it.  Exit 1 with one
+line per violation, exactly as before.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_LITERAL = re.compile(r"""["'](VTPU_[A-Z0-9_]+)["']""")
-
-
-def scan_env_names(pkg_root: str) -> dict:
-    """{env name: first "file:line" that mentions it} for every quoted
-    VTPU_* literal under ``pkg_root``."""
-    found: dict = {}
-    for dirpath, dirnames, filenames in os.walk(pkg_root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    for m in _LITERAL.finditer(line):
-                        name = m.group(1)
-                        rel = os.path.relpath(path, ROOT)
-                        found.setdefault(name, f"{rel}:{lineno}")
-    return found
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> int:
-    names = scan_env_names(os.path.join(ROOT, "vtpu"))
-    doc_path = os.path.join(ROOT, "docs", "config.md")
-    with open(doc_path, encoding="utf-8") as f:
-        doc = f.read()
-    # tokenize, don't substring-match: VTPU_FOO must not pass just
-    # because the doc mentions VTPU_FOO_TIMEOUT
-    documented = set(re.findall(r"VTPU_[A-Z0-9_]+", doc))
-    problems = [
-        f"{where}: {name}: not documented in docs/config.md"
-        for name, where in sorted(names.items())
-        if name not in documented
-    ]
-    for p in problems:
-        print(f"config-lint: {p}", file=sys.stderr)
-    if problems:
-        print(
-            f"config-lint: {len(problems)} undocumented env(s) of "
-            f"{len(names)} referenced under vtpu/",
-            file=sys.stderr,
-        )
-        return 1
-    print(
-        f"config-lint: {len(names)} VTPU_* env name(s) referenced under "
-        f"vtpu/ all documented in docs/config.md"
-    )
-    return 0
+    from vtpu.analysis.__main__ import main as check_main
+
+    return check_main(["--only", "env-docs"])
 
 
 if __name__ == "__main__":

@@ -1,24 +1,12 @@
 #!/usr/bin/env python3
-"""obs-lint — metric naming-convention + docs-drift check (make obs-lint).
+"""obs-lint — alias for the unified runner's obs-docs pass.
 
-Imports every component that registers instruments into vtpu.obs, then
-verifies each registered name against the convention:
-
-  - prefix ``vtpu_``
-  - counters end in ``_total``
-  - other instruments end in a unit suffix (``_seconds``, ``_bytes``, …)
-
-and that every registered family name appears in docs/observability.md —
-a family you can scrape but cannot look up is drift, and so is a doc
-promising a family no component registers anymore (new names must land
-with their catalog entry in the same change).
-
-The same catalog rule applies to the event journal's vocabulary: every
-type in vtpu.obs.events.EVENT_TYPES must appear in the docs — an event
-you can see on /events but cannot look up is the same drift.
-
-Exit 1 with one line per violation.  The exposition-format conformance
-tests (tests/test_obs.py -k conformance) run from the same make target.
+The check itself (metric naming convention + docs/observability.md
+catalog + event-vocabulary drift) lives in
+vtpu/analysis/passes/obs_docs.py since the vtpu-check consolidation;
+``make obs-lint`` (this script + the exposition-format conformance
+tests) and ``make check`` both run it.  Exit 1 with one line per
+violation, exactly as before.
 """
 
 from __future__ import annotations
@@ -32,74 +20,9 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
 def main() -> int:
-    # importing the modules is what populates the registries
-    import vtpu.audit.auditor  # noqa: F401 — reconciliation gauges
-    import vtpu.monitor.feedback  # noqa: F401 — arbiter pass instruments
-    import vtpu.monitor.pathmonitor  # noqa: F401 — scan/GC counters
-    import vtpu.monitor.sampler  # noqa: F401 — duty-cycle families
-    import vtpu.plugin.cache  # noqa: F401 — device-poll failure counter
-    import vtpu.plugin.register  # noqa: F401 — registration counters
-    import vtpu.plugin.server  # noqa: F401 — plugin Allocate histogram
-    import vtpu.scheduler.core  # noqa: F401 — filter/patch/bind histograms
-    import vtpu.scheduler.decisions  # noqa: F401 — audit-log counter
-    import vtpu.scheduler.gang  # noqa: F401 — gang admission families
-    import vtpu.scheduler.metrics  # noqa: F401 — fragmentation gauges
-    import vtpu.scheduler.shard  # noqa: F401 — shard/leader families
-    import vtpu.serving.batcher  # noqa: F401 — queue-to-first-token
-    import vtpu.serving.kvpool  # noqa: F401 — K/V handoff counters
-    import vtpu.serving.router  # noqa: F401 — front-door families
-    import vtpu.shim.runtime  # noqa: F401 — pacing/quota histograms
-    from vtpu.obs import all_registries, lint_names, registry
-    from vtpu.obs.events import EVENT_TYPES
-    from vtpu.obs.ready import readiness
+    from vtpu.analysis.__main__ import main as check_main
 
-    # the cross-component "obs" families (vtpu_events_total,
-    # vtpu_ready_check_ok_ratio) register lazily on first emit/report —
-    # instantiate them so the naming/docs checks cover them too
-    registry("obs").counter(
-        "vtpu_events_total", "Journal events emitted by component and type"
-    )
-    readiness("scheduler")
-
-    names = {
-        reg.name: reg.names() for reg in all_registries().values()
-    }
-    total = sum(len(v) for v in names.values())
-    problems = lint_names()
-    # docs drift: every registered family must be documented in the
-    # metric catalog (docs/observability.md)
-    doc_path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "docs", "observability.md")
-    with open(doc_path) as f:
-        doc = f.read()
-    for reg, metric_names in sorted(names.items()):
-        for n in metric_names:
-            if n not in doc:
-                problems.append(
-                    f"{reg}: {n}: not documented in docs/observability.md"
-                )
-    # event-vocabulary drift: every registered journal event type must be
-    # in the catalog (docs/observability.md § Event journal & audit)
-    for ev in sorted(EVENT_TYPES):
-        if ev not in doc:
-            problems.append(
-                f"events: {ev}: not documented in docs/observability.md"
-            )
-    for p in problems:
-        print(f"obs-lint: {p}", file=sys.stderr)
-    if problems:
-        print(f"obs-lint: {len(problems)} violation(s) across "
-              f"{total} registered metric(s)", file=sys.stderr)
-        return 1
-    for reg, metric_names in sorted(names.items()):
-        for n in metric_names:
-            print(f"ok {reg}: {n}")
-    for ev in sorted(EVENT_TYPES):
-        print(f"ok events: {ev}")
-    print(f"obs-lint: {total} registered metric name(s) and "
-          f"{len(EVENT_TYPES)} event type(s) conform "
-          f"(naming + docs catalog)")
-    return 0
+    return check_main(["--only", "obs-docs"])
 
 
 if __name__ == "__main__":

@@ -1,0 +1,587 @@
+"""vtpu-check framework tests: every pass against fixture trees with
+seeded violations (and clean twins), pragma suppression, the runtime
+lock-order witness on a deterministic two-thread ABBA interleave, and
+the committed tree staying clean (docs/static_analysis.md)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from vtpu.analysis import witness
+from vtpu.analysis.core import REPO_ROOT, Violation, load_file, run_checks
+from vtpu.analysis.passes.annotation_keys import AnnotationKeysPass
+from vtpu.analysis.passes.env_access import EnvAccessPass
+from vtpu.analysis.passes.env_docs import EnvDocsPass
+from vtpu.analysis.passes.jax_hygiene import JaxHygienePass
+from vtpu.analysis.passes.lock_discipline import LockDisciplinePass
+
+
+def write_tree(root, files):
+    for rel, content in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(content)
+
+
+def run_fixture(tmp_path, files, passes, docs=None):
+    """Run ``passes`` over a fixture repo rooted at tmp_path."""
+    write_tree(str(tmp_path), files)
+    if docs:
+        write_tree(str(tmp_path), docs)
+    return run_checks(roots=("vtpu", "cmd"), repo_root=str(tmp_path),
+                      passes=passes)
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline
+# ---------------------------------------------------------------------------
+
+LOCK_VIOLATION = '''
+import threading
+from vtpu.analysis.witness import make_lock
+
+class NodeManager:
+    def __init__(self):
+        self._lock = make_lock("manager.nodes", reentrant=True)
+
+class UsageCache:
+    def __init__(self):
+        self._lock = make_lock("cache.usage", reentrant=True)
+        self.mgr = NodeManager()
+
+    def bad_nesting(self):
+        with self._lock:
+            with self.mgr._lock:   # manager under cache — inverted
+                pass
+'''
+
+LOCK_CLEAN = '''
+import threading
+from vtpu.analysis.witness import make_lock
+
+class NodeManager:
+    def __init__(self):
+        self._lock = make_lock("manager.nodes", reentrant=True)
+        self.cache = UsageCache()
+
+    def good_nesting(self):
+        with self._lock:
+            with self.cache._lock:   # manager -> cache: documented order
+                pass
+
+class UsageCache:
+    def __init__(self):
+        self._lock = make_lock("cache.usage", reentrant=True)
+'''
+
+LOCK_ABBA = '''
+import threading
+
+class Pump:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def other(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+LOCK_BLOCKING = '''
+import time
+from vtpu.analysis.witness import make_lock
+
+class UsageCache:
+    def __init__(self):
+        self._lock = make_lock("cache.usage", reentrant=True)
+        self.client = None
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(1)
+
+    def bad_api(self):
+        with self._lock:
+            self.client.patch_node("n", {})
+
+    def locked(self):
+        return self._lock
+
+def bad_io(cache):
+    with cache.locked():
+        open("/tmp/x")
+'''
+
+
+def test_lock_discipline_order_inversion(tmp_path):
+    vs = run_fixture(tmp_path, {"vtpu/mod.py": LOCK_VIOLATION},
+                     [LockDisciplinePass()])
+    assert len(vs) == 1 and "lock order inversion" in vs[0].message
+    assert "cache.usage" in vs[0].message and "manager.nodes" in vs[0].message
+
+
+def test_lock_discipline_clean_twin(tmp_path):
+    assert run_fixture(tmp_path, {"vtpu/mod.py": LOCK_CLEAN},
+                       [LockDisciplinePass()]) == []
+
+
+def test_lock_discipline_static_abba_cycle(tmp_path):
+    vs = run_fixture(tmp_path, {"vtpu/mod.py": LOCK_ABBA},
+                     [LockDisciplinePass()])
+    assert len(vs) == 1 and "lock-nesting cycle" in vs[0].message
+    assert "Pump._a" in vs[0].message and "Pump._b" in vs[0].message
+
+
+def test_lock_discipline_blocking_in_with_item(tmp_path):
+    # `with open(...)` under the cache lock: the blocking call lives in
+    # the with-statement's context expression, not its body
+    src = '''
+from vtpu.analysis.witness import make_lock
+
+class UsageCache:
+    def __init__(self):
+        self._lock = make_lock("cache.usage", reentrant=True)
+
+    def bad(self, path):
+        with self._lock:
+            with open(path) as f:
+                return f.read()
+'''
+    vs = run_fixture(tmp_path, {"vtpu/mod.py": src},
+                     [LockDisciplinePass()])
+    assert len(vs) == 1 and "open" in vs[0].message, vs
+
+
+def test_lock_discipline_blocking_under_cache_lock(tmp_path):
+    vs = run_fixture(tmp_path, {"vtpu/mod.py": LOCK_BLOCKING},
+                     [LockDisciplinePass()])
+    msgs = "\n".join(v.message for v in vs)
+    assert len(vs) == 3, vs
+    assert "time.sleep" in msgs
+    assert ".patch_node" in msgs
+    assert "open" in msgs  # through the .locked() accessor convention
+
+
+def test_lock_discipline_lambda_body_not_under_lock(tmp_path):
+    # a lambda assigned under the cache lock runs LATER, outside it —
+    # the deferred-fetch idiom (batcher._fetch) must not false-positive
+    src = '''
+from vtpu.analysis.witness import make_lock
+
+class UsageCache:
+    def __init__(self):
+        self._lock = make_lock("cache.usage", reentrant=True)
+
+    def register(self):
+        with self._lock:
+            self._cb = lambda: open("/tmp/x")
+'''
+    assert run_fixture(tmp_path, {"vtpu/mod.py": src},
+                       [LockDisciplinePass()]) == []
+
+
+def test_lock_discipline_pragma_suppression(tmp_path):
+    seeded = LOCK_BLOCKING.replace(
+        "time.sleep(1)",
+        "time.sleep(1)  # vtpu: allow(lock-discipline)")
+    vs = run_fixture(tmp_path, {"vtpu/mod.py": seeded},
+                     [LockDisciplinePass()])
+    assert all("time.sleep" not in v.message for v in vs)
+    assert len(vs) == 2  # the other two still fire
+
+
+# ---------------------------------------------------------------------------
+# annotation-keys
+# ---------------------------------------------------------------------------
+
+def test_annotation_keys_flags_stray_literal(tmp_path):
+    vs = run_fixture(tmp_path, {
+        "vtpu/mod.py": 'KEY = "vtpu.io/some-key"\n',
+        "vtpu/utils/types.py": 'OK = "vtpu.io/tpu-node"\n',
+    }, [AnnotationKeysPass()])
+    assert len(vs) == 1
+    assert vs[0].path.endswith("mod.py")
+    assert "vtpu.io/some-key" in vs[0].message
+
+
+def test_annotation_keys_prose_mention_passes(tmp_path):
+    vs = run_fixture(tmp_path, {
+        "vtpu/mod.py":
+            'HELP = "the vtpu.io/node-utilization write-back annotation"\n',
+    }, [AnnotationKeysPass()])
+    assert vs == []
+
+
+def test_annotation_keys_flags_prefix_building(tmp_path):
+    vs = run_fixture(tmp_path, {
+        "vtpu/mod.py": 'key = "vtpu.io/" + name\n',
+    }, [AnnotationKeysPass()])
+    assert len(vs) == 1
+
+
+def test_annotation_keys_pragma(tmp_path):
+    vs = run_fixture(tmp_path, {
+        "vtpu/mod.py":
+            'KEY = "vtpu.io/x"  # vtpu: allow(annotation-keys)\n',
+    }, [AnnotationKeysPass()])
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# env-access
+# ---------------------------------------------------------------------------
+
+ENV_VIOLATIONS = '''
+import os
+ENV_KNOB = "VTPU_FIXTURE_KNOB"
+a = os.environ.get("VTPU_FIXTURE_DIRECT")
+b = os.environ[ENV_KNOB]
+c = os.getenv("VTPU_FIXTURE_GETENV", "x")
+os.environ["VTPU_FIXTURE_WRITE"] = "1"   # a write: not flagged
+d = os.environ.get("OTHER_NAMESPACE")    # not VTPU_*: not flagged
+'''
+
+ENV_CLEAN = '''
+from vtpu.utils.envs import env_int, env_str
+ENV_KNOB = "VTPU_FIXTURE_KNOB"
+a = env_str("VTPU_FIXTURE_DIRECT")
+b = env_int(ENV_KNOB, 3)
+'''
+
+
+def test_env_access_flags_raw_reads_not_writes(tmp_path):
+    vs = run_fixture(tmp_path, {"vtpu/mod.py": ENV_VIOLATIONS},
+                     [EnvAccessPass()])
+    assert len(vs) == 3, vs
+    names = "\n".join(v.message for v in vs)
+    assert "VTPU_FIXTURE_DIRECT" in names
+    assert "VTPU_FIXTURE_KNOB" in names      # through the ENV_ constant
+    assert "VTPU_FIXTURE_GETENV" in names
+    assert "VTPU_FIXTURE_WRITE" not in names
+
+
+def test_env_access_clean_twin(tmp_path):
+    assert run_fixture(tmp_path, {"vtpu/mod.py": ENV_CLEAN},
+                       [EnvAccessPass()]) == []
+
+
+# ---------------------------------------------------------------------------
+# jax-hygiene
+# ---------------------------------------------------------------------------
+
+DONATE_VIOLATION = '''
+import functools
+import jax
+
+class Engine:
+    def __init__(self, model):
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def _step(params, cache, tok):
+            return cache, tok
+        self._step = _step
+
+    def run(self):
+        out = self._step(self.params, self.cache, self.tok)
+        return self.cache["k"]        # read after donation
+'''
+
+DONATE_CLEAN = '''
+import functools
+import jax
+
+class Engine:
+    def __init__(self, model):
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def _step(params, cache, tok):
+            return cache, tok
+        self._step = _step
+
+    def run(self):
+        self.cache, self.tok = self._step(self.params, self.cache, self.tok)
+        return self.cache["k"]        # rebound by the call statement
+'''
+
+HOT_PATH_VIOLATION = '''# vtpu: hot-path
+import jax
+import numpy as np
+
+def harvest(arr):
+    jax.block_until_ready(arr)
+    vals = np.asarray(arr)
+    host = np.asarray(arr, np.int32)   # explicit dtype conversion: passes
+    return vals
+'''
+
+
+def test_jax_hygiene_donated_reuse(tmp_path):
+    vs = run_fixture(tmp_path, {"vtpu/mod.py": DONATE_VIOLATION},
+                     [JaxHygienePass()])
+    assert len(vs) == 1
+    assert "donated" in vs[0].message and "self.cache" in vs[0].message
+
+
+def test_jax_hygiene_donated_reuse_in_nested_block(tmp_path):
+    # the decode hot paths call donated jits inside loops/branches —
+    # reuse nested under if/for must flag exactly like top-level reuse
+    nested = DONATE_VIOLATION.replace(
+        '''    def run(self):
+        out = self._step(self.params, self.cache, self.tok)
+        return self.cache["k"]        # read after donation''',
+        '''    def run(self, n):
+        for _ in range(n):
+            if n:
+                out = self._step(self.params, self.cache, self.tok)
+                use(self.cache["k"])   # read after donation, nested''')
+    vs = run_fixture(tmp_path, {"vtpu/mod.py": nested},
+                     [JaxHygienePass()])
+    assert len(vs) == 1 and "donated" in vs[0].message, vs
+
+
+def test_jax_hygiene_rebinding_call_is_clean(tmp_path):
+    assert run_fixture(tmp_path, {"vtpu/mod.py": DONATE_CLEAN},
+                       [JaxHygienePass()]) == []
+
+
+def test_jax_hygiene_host_sync_needs_hot_path_marker(tmp_path):
+    vs = run_fixture(tmp_path, {"vtpu/mod.py": HOT_PATH_VIOLATION},
+                     [JaxHygienePass()])
+    assert len(vs) == 2, vs     # block_until_ready + bare np.asarray
+    # without the marker the same file passes (overwrite the fixture)
+    unmarked = HOT_PATH_VIOLATION.replace("# vtpu: hot-path\n", "")
+    vs2 = run_fixture(tmp_path, {"vtpu/mod.py": unmarked},
+                      [JaxHygienePass()])
+    assert vs2 == []
+
+
+def test_jax_hygiene_pragma(tmp_path):
+    seeded = HOT_PATH_VIOLATION.replace(
+        "vals = np.asarray(arr)",
+        "vals = np.asarray(arr)  # vtpu: allow(jax-hygiene)")
+    vs = run_fixture(tmp_path, {"vtpu/mod.py": seeded},
+                     [JaxHygienePass()])
+    assert len(vs) == 1 and "block_until_ready" in vs[0].message
+
+
+# ---------------------------------------------------------------------------
+# env-docs (the config-lint port)
+# ---------------------------------------------------------------------------
+
+def test_env_docs_flags_undocumented(tmp_path):
+    vs = run_fixture(
+        tmp_path,
+        {"vtpu/mod.py": 'K = "VTPU_FIXTURE_UNDOCUMENTED"\n'},
+        [EnvDocsPass()],
+        docs={"docs/config.md": "| `VTPU_FIXTURE_OTHER` | … |\n"},
+    )
+    assert len(vs) == 1 and "VTPU_FIXTURE_UNDOCUMENTED" in vs[0].message
+
+
+def test_env_docs_tokenized_not_substring(tmp_path):
+    # VTPU_FOO must not pass because VTPU_FOO_TIMEOUT is documented
+    vs = run_fixture(
+        tmp_path,
+        {"vtpu/mod.py": 'K = "VTPU_FOO"\n'},
+        [EnvDocsPass()],
+        docs={"docs/config.md": "`VTPU_FOO_TIMEOUT` is documented\n"},
+    )
+    assert len(vs) == 1
+
+
+def test_env_docs_pragma_suppresses_finalize_violation(tmp_path):
+    # finalize-produced violations honor the same per-line pragma (the
+    # "VTPU_* literal that is not an env name" escape hatch)
+    vs = run_fixture(
+        tmp_path,
+        {"vtpu/mod.py":
+            'K = "VTPU_NOT_AN_ENV"  # vtpu: allow(env-docs)\n'},
+        [EnvDocsPass()],
+        docs={"docs/config.md": ""},
+    )
+    assert vs == []
+
+
+def test_env_docs_clean_twin(tmp_path):
+    vs = run_fixture(
+        tmp_path,
+        {"vtpu/mod.py": 'K = "VTPU_FIXTURE_DOCD"\n'},
+        [EnvDocsPass()],
+        docs={"docs/config.md": "| `VTPU_FIXTURE_DOCD` | a knob |\n"},
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# runner plumbing
+# ---------------------------------------------------------------------------
+
+def test_runner_cli_nonzero_on_seeded_violation(tmp_path):
+    write_tree(str(tmp_path), {
+        "vtpu/mod.py": 'KEY = "vtpu.io/stray"\n',
+        "docs/config.md": "",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "vtpu.analysis",
+         "--only", "annotation-keys,env-access,jax-hygiene,"
+         "lock-discipline,env-docs",
+         "--repo-root", str(tmp_path)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 1
+    assert "vtpu.io/stray" in proc.stderr
+
+
+def test_runner_unknown_pass_rejected():
+    with pytest.raises(ValueError):
+        run_checks(only=["no-such-pass"],
+                   passes=[AnnotationKeysPass()])
+
+
+def test_violation_render_and_pragma_scan(tmp_path):
+    v = Violation("vtpu/x.py", 3, "env-access", "msg")
+    assert v.render() == "vtpu/x.py:3: [env-access] msg"
+    p = tmp_path / "f.py"
+    p.write_text("x = 1  # vtpu: allow(lock-discipline, env-access)\n"
+                 "# vtpu: hot-path\n")
+    ctx = load_file(str(p), str(tmp_path))
+    assert ctx.allowed(1, "env-access") and ctx.allowed(1, "lock-discipline")
+    assert not ctx.allowed(1, "jax-hygiene")
+    assert ctx.hot_path
+
+
+# ---------------------------------------------------------------------------
+# the runtime lock-order witness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def witness_on(monkeypatch):
+    monkeypatch.setenv(witness.ENV_WITNESS, "1")
+    witness.reset()
+    yield
+    witness.reset()
+
+
+def _run_serial(*fns):
+    """Each fn on its own (real) thread, strictly one after another —
+    deterministic interleave, zero sleeps."""
+    for fn in fns:
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join(10.0)
+        assert not t.is_alive()
+
+
+def test_witness_abba_cycle_detected(witness_on):
+    a = witness.make_lock("fix.a")
+    b = witness.make_lock("fix.b")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    _run_serial(t1, t2)
+    assert witness.edges() == {("fix.a", "fix.b"): 1,
+                               ("fix.b", "fix.a"): 1}
+    assert witness.cycles() == [["fix.a", "fix.b"]]
+    rep = witness.report()
+    assert "fix.a -> fix.b" in rep and "acquiring" in rep
+
+
+def test_witness_consistent_order_is_clean(witness_on):
+    a = witness.make_lock("fix.a")
+    b = witness.make_lock("fix.b")
+
+    def t(n):
+        def run():
+            for _ in range(n):
+                with a:
+                    with b:
+                        pass
+        return run
+
+    _run_serial(t(3), t(2))
+    assert witness.cycles() == []
+    assert witness.edges() == {("fix.a", "fix.b"): 5}
+
+
+def test_witness_reentry_with_intermediate_lock_no_phantom_cycle(witness_on):
+    # `with a: with b: with a:` on a reentrant lock is deadlock-free —
+    # the re-entry must not record a phantom b->a edge (and so a cycle)
+    a = witness.make_lock("fix.a", reentrant=True)
+    b = witness.make_lock("fix.b")
+
+    def t():
+        with a:
+            with b:
+                with a:
+                    pass
+
+    _run_serial(t)
+    assert witness.edges() == {("fix.a", "fix.b"): 1}
+    assert witness.cycles() == []
+
+
+def test_witness_same_name_reentrancy_skipped(witness_on):
+    stripes = [witness.make_lock("fix.stripe", reentrant=True)
+               for _ in range(2)]
+
+    def t():
+        with stripes[0]:
+            with stripes[0]:     # reentrant
+                with stripes[1]:  # sibling instance, same name
+                    pass
+
+    _run_serial(t)
+    assert witness.cycles() == []
+    assert witness.edges() == {}
+
+
+def test_witness_disabled_returns_plain_lock(monkeypatch):
+    monkeypatch.delenv(witness.ENV_WITNESS, raising=False)
+    lk = witness.make_lock("fix.plain")
+    assert not isinstance(lk, witness.WitnessLock)
+    assert lk.acquire() and (lk.release() is None)
+
+
+def test_witness_three_way_cycle(witness_on):
+    a, b, c = (witness.make_lock(f"fix.{x}") for x in "abc")
+
+    def mk(outer, inner):
+        def run():
+            with outer:
+                with inner:
+                    pass
+        return run
+
+    _run_serial(mk(a, b), mk(b, c), mk(c, a))
+    assert witness.cycles() == [["fix.a", "fix.b", "fix.c"]]
+
+
+# ---------------------------------------------------------------------------
+# the committed tree is clean
+# ---------------------------------------------------------------------------
+
+def test_real_tree_is_clean():
+    vs = run_checks(
+        roots=("vtpu", "cmd"), repo_root=REPO_ROOT,
+        passes=[LockDisciplinePass(), AnnotationKeysPass(),
+                EnvAccessPass(), JaxHygienePass(), EnvDocsPass()],
+    )
+    assert vs == [], "\n".join(v.render() for v in vs)

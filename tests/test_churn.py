@@ -16,6 +16,7 @@ from vtpu.utils import codec
 from vtpu.utils.types import ChipInfo, HandshakeState, annotations, resources
 
 from tests.test_usage_cache import assert_cache_equals_oracle
+from vtpu.analysis import witness
 
 
 def _handshake_now():
@@ -249,10 +250,17 @@ def test_patch_lock_sweep_guard_drops_dead_entries():
 # The churn soak
 # ---------------------------------------------------------------------------
 
-def test_multithreaded_churn_soak_no_double_book_and_audit_clean():
+def test_multithreaded_churn_soak_no_double_book_and_audit_clean(monkeypatch):
     """Filters racing registry expel/re-add and pod deletes for ~2s:
     no chip over capacity, no lost booking, cache == oracle, memo and
-    patch-lock maps drained, and a zero-drift auditor verdict."""
+    patch-lock maps drained, and a zero-drift auditor verdict.
+
+    Runs under the lock-order witness (VTPU_LOCK_WITNESS=1, set BEFORE
+    the scheduler constructs its locks) so the soak doubles as a
+    deadlock hunt: a cycle in the recorded acquisition graph fails the
+    test even if the losing interleave never fired."""
+    monkeypatch.setenv(witness.ENV_WITNESS, "1")
+    witness.reset()
     c = FakeClient()
     node_names = [f"s{i:02d}" for i in range(8)]
     for n in node_names:
@@ -347,6 +355,9 @@ def test_multithreaded_churn_soak_no_double_book_and_audit_clean():
     assert rep["ok"], rep
     assert rep["summary"]["leaked_bookings"] == 0
     assert rep["summary"]["overcommit_nodes"] == 0
+    # lock-order witness: the soak's whole acquisition graph is acyclic
+    assert witness.cycles() == [], witness.report()
+    assert witness.edges(), "witness recorded no edges — wiring broken?"
 
 
 # ---------------------------------------------------------------------------

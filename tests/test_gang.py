@@ -34,6 +34,7 @@ from vtpu.scheduler.shard import LocalPeer, ShardCoordinator
 from vtpu.utils.types import ContainerDevice, annotations as A, resources as R
 
 from tests.test_usage_cache import assert_cache_equals_oracle
+from vtpu.analysis import witness
 
 
 def gang_pod(name, gang, size, chips=4, uid=None, mesh=None, pct=100,
@@ -802,9 +803,12 @@ def test_auditor_grace_for_inflight_gangs():
 # ---------------------------------------------------------------------------
 
 @pytest.mark.parametrize("arm", ["local", "shard"])
-def test_threaded_gang_soak_all_or_nothing_and_zero_drift(arm):
+def test_threaded_gang_soak_all_or_nothing_and_zero_drift(arm, monkeypatch):
     import random
 
+    # lock-order witness on for the whole soak (docs/static_analysis.md)
+    monkeypatch.setenv(witness.ENV_WITNESS, "1")
+    witness.reset()
     if arm == "shard":
         c, s, b, names = _sharded_pair(8)
         scheds = [s, b]
@@ -926,6 +930,10 @@ def test_threaded_gang_soak_all_or_nothing_and_zero_drift(arm):
     assert rep["ok"], rep
     assert rep["summary"]["partial_gang_bookings"] == 0
     assert rep["summary"]["leaked_bookings"] == 0
+    # lock-order witness: gang striped admission + CAS booking + churn
+    # produced an acyclic acquisition graph (no potential ABBA)
+    assert witness.cycles() == [], witness.report()
+    assert witness.edges(), "witness recorded no edges — wiring broken?"
 
 
 # ---------------------------------------------------------------------------

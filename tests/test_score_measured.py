@@ -21,6 +21,7 @@ import pytest
 
 from tests.golden_scenarios import seed_fake_node_group
 from tests.test_usage_cache import assert_cache_equals_oracle
+from vtpu.analysis import witness
 from vtpu.k8s import FakeClient, new_pod
 from vtpu.scheduler import Scheduler, SchedulerConfig
 from vtpu.scheduler.score import blend_measured, measured_headroom
@@ -474,15 +475,18 @@ def test_filter_rejects_contradictory_besteffort_specs():
 # -- the acceptance soak --------------------------------------------------
 
 
-def test_soak_besteffort_x_squeeze_x_evict_x_churn_zero_residual():
+def test_soak_besteffort_x_squeeze_x_evict_x_churn_zero_residual(monkeypatch):
     """Threaded: best-effort admissions, idle-streak breaks (the
     scheduler-visible face of a squeeze: measured duty rising under
     contention), monitor-style eviction requests + the reconciler, and
     guaranteed pod churn — all concurrent.  Ends with cache == oracle
     and ZERO residual overlay entries once every best-effort pod is
-    gone (the acceptance criterion)."""
+    gone (the acceptance criterion).  Runs under the lock-order witness
+    (docs/static_analysis.md §Lock witness)."""
     import random
 
+    monkeypatch.setenv(witness.ENV_WITNESS, "1")
+    witness.reset()
     client, s, names = _sched(nodes=3)
     now = time.time()
     for n in names:
@@ -578,6 +582,10 @@ def test_soak_besteffort_x_squeeze_x_evict_x_churn_zero_residual():
     report = s.auditor.audit_once()
     assert report["summary"]["leaked_overlay_bookings"] == 0
     assert report["summary"]["leaked_bookings"] == 0
+    # lock-order witness: overlay CAS x eviction reconciler x churn
+    # produced an acyclic acquisition graph (no potential ABBA)
+    assert witness.cycles() == [], witness.report()
+    assert witness.edges(), "witness recorded no edges — wiring broken?"
 
 
 # -- bench smoke (make bench-goodput SMOKE=1) -----------------------------

@@ -1,0 +1,25 @@
+"""vtpu-check — unified static analysis + runtime lock-order witness.
+
+One AST walk over the tree, shared by every pass (docs/static_analysis.md):
+
+- ``lock-discipline``   lock-nesting graph vs the documented global order
+                        (docs/scheduler_perf.md §Lock-order rules) + blocking
+                        calls under the cache lock
+- ``annotation-keys``   every ``vtpu.io/*`` key literal must live in
+                        vtpu/utils/types.py
+- ``env-access``        ``VTPU_*`` environ reads go through vtpu/utils/envs.py
+- ``jax-hygiene``       donated-buffer reuse + host syncs in hot-path files
+- ``env-docs``          every VTPU_* env referenced under vtpu/ is documented
+                        in docs/config.md (the old config-lint)
+- ``obs-docs``          metric naming convention + docs catalog (the old
+                        obs-lint; imports the registries, not an AST pass)
+
+Per-line suppression: ``# vtpu: allow(<pass>[, <pass>…])``.
+Runtime side: ``vtpu.analysis.witness`` (VTPU_LOCK_WITNESS=1).
+
+This package is imported by hot modules for ``witness.make_lock`` — keep
+the top level free of heavy imports (the passes load lazily via
+``vtpu.analysis.core.load_passes``).
+"""
+
+from __future__ import annotations

@@ -1,0 +1,65 @@
+"""``python -m vtpu.analysis`` — the ``make check`` entry point.
+
+Exit 0 when the tree is clean, 1 with one line per violation otherwise.
+``--only`` subsets by pass name (the make obs-lint / config-lint
+aliases); ``--root`` overrides the scan roots (the fixture tests use
+this); ``--list`` prints the pass catalog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from vtpu.analysis.core import DEFAULT_ROOTS, REPO_ROOT, load_passes, \
+    run_checks
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="vtpu-check",
+        description="unified static analysis (docs/static_analysis.md)",
+    )
+    ap.add_argument("--only", action="append", default=None,
+                    metavar="PASS",
+                    help="run only these passes (repeatable or "
+                         "comma-separated)")
+    ap.add_argument("--root", action="append", default=None,
+                    metavar="DIR",
+                    help=f"scan roots (default: {', '.join(DEFAULT_ROOTS)})")
+    ap.add_argument("--repo-root", default=REPO_ROOT,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--list", action="store_true",
+                    help="print the pass catalog and exit")
+    args = ap.parse_args(argv)
+
+    passes = load_passes()
+    if args.list:
+        for p in passes:
+            doc = (sys.modules[type(p).__module__].__doc__ or
+                   "").strip().splitlines()[0]
+            print(f"{p.name:18s} {doc}")
+        return 0
+    only = None
+    if args.only:
+        only = [t.strip() for sel in args.only
+                for t in sel.split(",") if t.strip()]
+    violations = run_checks(
+        roots=args.root or DEFAULT_ROOTS,
+        repo_root=args.repo_root,
+        only=only,
+        passes=passes,
+    )
+    for v in violations:
+        print(f"vtpu-check: {v.render()}", file=sys.stderr)
+    if violations:
+        print(f"vtpu-check: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    ran = [p.name for p in passes] if only is None else only
+    print(f"vtpu-check: clean ({', '.join(ran)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

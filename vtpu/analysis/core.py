@@ -1,0 +1,194 @@
+"""vtpu-check framework: one AST walk, shared by every pass.
+
+The runner parses each Python file under the scan roots exactly once
+into a :class:`FileContext` (tree + source + pragma map), hands every
+AST pass each context via ``check_file``, then calls ``finalize`` with
+the full corpus for cross-file passes (env-docs needs every literal
+before it can diff against docs/config.md).  Project passes (obs-docs,
+which must *import* the metric registries) run once against the repo
+root instead.
+
+Suppression is per line: ``# vtpu: allow(<pass>[, <pass>…])`` on the
+line a violation is reported against silences that pass there.  File
+markers use the same channel: ``# vtpu: hot-path`` opts a file into the
+jax-hygiene host-sync rules (docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# default scan roots for the code passes, relative to the repo root
+DEFAULT_ROOTS = ("vtpu", "cmd")
+
+_PRAGMA = re.compile(r"#\s*vtpu:\s*allow\(([a-z0-9_,\s-]+)\)")
+_HOT_PATH = re.compile(r"#\s*vtpu:\s*hot-path\b")
+
+
+@dataclasses.dataclass
+class Violation:
+    path: str          # repo-relative
+    line: int
+    pass_name: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_name}] {self.message}"
+
+
+@dataclasses.dataclass
+class FileContext:
+    """One parsed file, shared by every AST pass."""
+
+    path: str                    # absolute
+    rel: str                     # repo-relative
+    tree: ast.Module
+    source: str
+    lines: List[str]
+    # line -> set of pass names allowed there
+    allows: Dict[int, Set[str]]
+    hot_path: bool
+
+    def allowed(self, line: int, pass_name: str) -> bool:
+        return pass_name in self.allows.get(line, ())
+
+
+class Pass:
+    """Base for AST passes.  ``name`` doubles as the pragma token."""
+
+    name = "base"
+
+    def check_file(self, ctx: FileContext) -> List[Violation]:
+        return []
+
+    def finalize(self, ctxs: Sequence[FileContext],
+                 repo_root: str) -> List[Violation]:
+        return []
+
+
+class ProjectPass:
+    """A pass that needs the live project rather than its AST (obs-docs
+    imports the metric registries).  Runs once per invocation."""
+
+    name = "project"
+
+    def run(self, repo_root: str) -> List[Violation]:
+        return []
+
+
+def _scan_pragmas(lines: List[str]):
+    allows: Dict[int, Set[str]] = {}
+    hot = False
+    for i, line in enumerate(lines, 1):
+        m = _PRAGMA.search(line)
+        if m:
+            allows[i] = {t.strip() for t in m.group(1).split(",") if t.strip()}
+        if _HOT_PATH.search(line):
+            hot = True
+    return allows, hot
+
+
+def load_file(path: str, repo_root: str = REPO_ROOT) -> Optional[FileContext]:
+    """Parse one file into a FileContext; None on syntax errors (the
+    tree is expected to at least parse — compileall guards that)."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return None
+    lines = source.splitlines()
+    allows, hot = _scan_pragmas(lines)
+    return FileContext(
+        path=path,
+        rel=os.path.relpath(path, repo_root),
+        tree=tree,
+        source=source,
+        lines=lines,
+        allows=allows,
+        hot_path=hot,
+    )
+
+
+def iter_py_files(roots: Iterable[str], repo_root: str = REPO_ROOT):
+    for root in roots:
+        base = root if os.path.isabs(root) else os.path.join(repo_root, root)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__"
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def load_corpus(roots: Iterable[str] = DEFAULT_ROOTS,
+                repo_root: str = REPO_ROOT) -> List[FileContext]:
+    out = []
+    for path in iter_py_files(roots, repo_root):
+        ctx = load_file(path, repo_root)
+        if ctx is not None:
+            out.append(ctx)
+    return out
+
+
+def load_passes() -> list:
+    """Every registered pass, AST passes first (stable order)."""
+    from vtpu.analysis.passes.annotation_keys import AnnotationKeysPass
+    from vtpu.analysis.passes.env_access import EnvAccessPass
+    from vtpu.analysis.passes.env_docs import EnvDocsPass
+    from vtpu.analysis.passes.jax_hygiene import JaxHygienePass
+    from vtpu.analysis.passes.lock_discipline import LockDisciplinePass
+    from vtpu.analysis.passes.obs_docs import ObsDocsPass
+
+    return [
+        LockDisciplinePass(),
+        AnnotationKeysPass(),
+        EnvAccessPass(),
+        JaxHygienePass(),
+        EnvDocsPass(),
+        ObsDocsPass(),
+    ]
+
+
+def run_checks(roots: Iterable[str] = DEFAULT_ROOTS,
+               repo_root: str = REPO_ROOT,
+               only: Optional[Iterable[str]] = None,
+               passes: Optional[list] = None) -> List[Violation]:
+    """Run the suite: one corpus parse, every pass over it.  ``only``
+    filters by pass name (the make obs-lint / config-lint aliases)."""
+    chosen = list(passes) if passes is not None else load_passes()
+    if only is not None:
+        wanted = set(only)
+        unknown = wanted - {p.name for p in chosen}
+        if unknown:
+            raise ValueError(f"unknown pass(es): {sorted(unknown)}")
+        chosen = [p for p in chosen if p.name in wanted]
+    ast_passes = [p for p in chosen if isinstance(p, Pass)]
+    project_passes = [p for p in chosen if isinstance(p, ProjectPass)]
+    violations: List[Violation] = []
+    if ast_passes:
+        ctxs = load_corpus(roots, repo_root)
+        by_rel = {ctx.rel: ctx for ctx in ctxs}
+        for p in ast_passes:
+            for ctx in ctxs:
+                for v in p.check_file(ctx):
+                    if not ctx.allowed(v.line, p.name):
+                        violations.append(v)
+            # finalize-produced violations honor the same per-line
+            # pragma contract (env-docs reports land here)
+            for v in p.finalize(ctxs, repo_root):
+                vctx = by_rel.get(v.path)
+                if vctx is None or not vctx.allowed(v.line, p.name):
+                    violations.append(v)
+    for p in project_passes:
+        violations.extend(p.run(repo_root))
+    violations.sort(key=lambda v: (v.path, v.line, v.pass_name))
+    return violations

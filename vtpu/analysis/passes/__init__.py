@@ -1,0 +1,1 @@
+"""vtpu-check passes (docs/static_analysis.md has the catalog)."""

@@ -1,0 +1,50 @@
+"""annotation-keys — every ``vtpu.io/*`` key literal lives in types.py.
+
+The annotation bus is the RPC fabric of the framework: a key typo'd in
+one component silently partitions the protocol (scheduler writes
+``vtpu.io/tpu-ids``, plugin reads ``vtpu.io/tpu-id`` — nothing fails,
+pods just never bind).  The shared constants in ``vtpu/utils/types.py``
+(class ``annotations``) are the single source of truth; any *key-shaped*
+string literal elsewhere is drift.
+
+Key-shaped means the whole literal is a key: ``vtpu.io/`` followed only
+by key characters.  Prose that merely mentions a key (metric help
+strings, docstrings) passes; f-string prefixes like ``"vtpu.io/"`` used
+to build keys dynamically are flagged too — build from the constant
+instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from vtpu.analysis.core import FileContext, Pass, Violation
+
+# whole-string key shape (also matches a bare "vtpu.io/" prefix literal)
+_KEY = re.compile(r"vtpu\.io/[A-Za-z0-9._/-]*$")
+
+# the one module allowed to spell keys out
+HOME = "vtpu/utils/types.py"
+
+
+class AnnotationKeysPass(Pass):
+    name = "annotation-keys"
+
+    def check_file(self, ctx: FileContext) -> List[Violation]:
+        if ctx.rel.replace("\\", "/") == HOME:
+            return []
+        out: List[Violation] = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            if _KEY.match(node.value):
+                out.append(Violation(
+                    ctx.rel, node.lineno, self.name,
+                    f"stray annotation key literal {node.value!r}: use "
+                    f"the shared constant in vtpu/utils/types.py "
+                    f"(class annotations)",
+                ))
+        return out

@@ -1,0 +1,105 @@
+"""env-access — VTPU_* environ reads go through vtpu/utils/envs.py.
+
+The helpers pin one parsing semantics (empty string = default, bad
+value = default, never raise) so daemons cannot drift; a raw
+``os.environ.get("VTPU_X")`` re-opens exactly the divergence PR 9
+closed.  Flagged:
+
+- ``os.environ.get(...)`` / ``os.environ[...]`` (Load) / ``os.getenv``
+  / ``environ.get`` where the name argument is a VTPU_* string literal
+  or a module-level constant bound to one (``ENV_TTL = "VTPU_…"``);
+- writes (``os.environ[k] = v``, ``setdefault``, ``pop``) are NOT
+  reads and pass — injecting env into a child is legitimate.
+
+``vtpu/utils/envs.py`` itself is exempt (it is the choke point).
+Dynamic names the AST cannot resolve are skipped, documented as a
+limitation in docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional
+
+from vtpu.analysis.core import FileContext, Pass, Violation
+
+_VTPU_NAME = re.compile(r"VTPU_[A-Z0-9_]+$")
+HOME = "vtpu/utils/envs.py"
+
+
+def _module_env_consts(tree: ast.Module) -> Dict[str, str]:
+    """Module-level NAME = "VTPU_…" constants."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str) and \
+                _VTPU_NAME.match(node.value.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value.value
+    return out
+
+
+def _env_name_of(arg: ast.AST, consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+            and _VTPU_NAME.match(arg.value):
+        return arg.value
+    if isinstance(arg, ast.Name) and arg.id in consts:
+        return consts[arg.id]
+    return None
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """os.environ or bare environ (from os import environ)."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ" and \
+            isinstance(node.value, ast.Name) and node.value.id == "os":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+class EnvAccessPass(Pass):
+    name = "env-access"
+
+    def check_file(self, ctx: FileContext) -> List[Violation]:
+        if ctx.rel.replace("\\", "/") == HOME:
+            return []
+        consts = _module_env_consts(ctx.tree)
+        out: List[Violation] = []
+
+        def flag(line: int, env: str, how: str) -> None:
+            out.append(Violation(
+                ctx.rel, line, self.name,
+                f"raw {how} read of {env}: route through "
+                f"vtpu/utils/envs.py (env_str/env_int/env_float/"
+                f"env_bool/env_require)",
+            ))
+
+        for node in ast.walk(ctx.tree):
+            # os.environ.get("VTPU_X") / os.getenv("VTPU_X") /
+            # environ.get(...)
+            if isinstance(node, ast.Call):
+                f = node.func
+                target = None
+                if isinstance(f, ast.Attribute) and f.attr == "get" and \
+                        _is_environ(f.value):
+                    target = "os.environ.get"
+                elif isinstance(f, ast.Attribute) and f.attr == "getenv" \
+                        and isinstance(f.value, ast.Name) and \
+                        f.value.id == "os":
+                    target = "os.getenv"
+                elif isinstance(f, ast.Name) and f.id == "getenv":
+                    target = "getenv"
+                if target and node.args:
+                    env = _env_name_of(node.args[0], consts)
+                    if env:
+                        flag(node.lineno, env, target)
+            # os.environ["VTPU_X"] in Load context
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    _is_environ(node.value):
+                env = _env_name_of(node.slice, consts)
+                if env:
+                    flag(node.lineno, env, "os.environ[]")
+        return out

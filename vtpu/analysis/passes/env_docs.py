@@ -1,0 +1,63 @@
+"""env-docs — every VTPU_* env referenced under vtpu/ is documented.
+
+The unified-runner port of ``hack/config_lint.py`` (make config-lint is
+now an alias): an env knob you can set but cannot look up in
+docs/config.md is drift, the same rule obs-docs enforces for metric
+families.  The scan rides the shared AST walk: any string constant that
+*is* a VTPU_* name (full match) declares the env — reads through
+``ENV_FOO = "VTPU_FOO"`` constants are covered without tracing
+dataflow.  docs/config.md is tokenized, not substring-matched, so a
+documented VTPU_FOO_TIMEOUT cannot mask an undocumented VTPU_FOO.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Sequence
+
+from vtpu.analysis.core import FileContext, Pass, Violation
+
+_VTPU_NAME = re.compile(r"VTPU_[A-Z0-9_]+$")
+_DOC_TOKEN = re.compile(r"VTPU_[A-Z0-9_]+")
+DOC = os.path.join("docs", "config.md")
+
+# the env surface is the vtpu/ package (cmd/ flags mirror it; hack/ and
+# tests/ mention envs they *drive*, which is not a declaration)
+SCOPE_PREFIX = "vtpu" + os.sep
+
+
+class EnvDocsPass(Pass):
+    name = "env-docs"
+
+    def __init__(self) -> None:
+        # env name -> first "rel:line" declaring it
+        self._found: Dict[str, str] = {}
+
+    def check_file(self, ctx: FileContext) -> List[Violation]:
+        if not ctx.rel.startswith(SCOPE_PREFIX):
+            return []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    _VTPU_NAME.match(node.value):
+                self._found.setdefault(
+                    node.value, f"{ctx.rel}:{node.lineno}")
+        return []
+
+    def finalize(self, ctxs: Sequence[FileContext],
+                 repo_root: str) -> List[Violation]:
+        found, self._found = self._found, {}
+        doc_path = os.path.join(repo_root, DOC)
+        with open(doc_path, encoding="utf-8") as f:
+            documented = set(_DOC_TOKEN.findall(f.read()))
+        out = []
+        for name, where in sorted(found.items()):
+            if name not in documented:
+                rel, line = where.rsplit(":", 1)
+                out.append(Violation(
+                    rel, int(line), self.name,
+                    f"{name}: not documented in {DOC}",
+                ))
+        return out

@@ -1,0 +1,235 @@
+"""jax-hygiene — donated buffers and host syncs on the serving hot path.
+
+Two hazard classes the decode-loop PRs fought by hand:
+
+**Donated-buffer reuse.**  A function jitted with ``donate_argnums``
+consumes the buffers at those positions — the caller's array is deleted
+the moment the call dispatches.  Reading it afterwards raises (at best)
+``RuntimeError: invalid buffer`` on device, or silently computes on a
+copy on backends that ignore donation — exactly the class of bug the
+PR 10 ``_dispatch_lock`` fence fixed at runtime.  The pass collects
+every ``donate_argnums`` jit in the module (decorated defs and their
+``self.X = fn`` aliases) and, at each call site, flags any later read
+of a name/attribute passed at a donated position before it is
+reassigned.  Intra-function and flow-insensitive across loop
+iterations — the witness for dynamic aliasing stays with the tests.
+
+**Host syncs in hot-path files.**  A file opting in with the
+``# vtpu: hot-path`` marker promises its decode/admission loops never
+sync the host.  Flagged there:
+
+- ``jax.block_until_ready(...)`` / ``<x>.block_until_ready()``
+- ``jax.device_get(...)``
+- one-positional-arg ``np.asarray(<name>)`` on a bare name — the shape
+  of a device fetch.  Explicit-dtype conversions (``np.asarray(x,
+  np.int32)``) and sliced host arrays pass.  The *deliberate* sync
+  points (the harvest fetch hook, D2H extract) carry
+  ``# vtpu: allow(jax-hygiene)`` so the next one added by accident
+  stands out in review.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from vtpu.analysis.core import FileContext, Pass, Violation
+from vtpu.analysis.passes.lock_discipline import _call_name
+
+HOST_SYNC_CALLS = {"jax.block_until_ready", "jax.device_get"}
+
+
+def _donate_positions(deco: ast.AST) -> Optional[Tuple[int, ...]]:
+    """donate_argnums of a ``functools.partial(jax.jit, …)`` or
+    ``jax.jit(…)`` decorator/call — None when not a donating jit."""
+    if not isinstance(deco, ast.Call):
+        return None
+    name = _call_name(deco.func)
+    if name not in ("functools.partial", "partial", "jax.jit", "jit"):
+        return None
+    if name in ("functools.partial", "partial"):
+        if not deco.args or _call_name(deco.args[0]) not in \
+                ("jax.jit", "jit"):
+            return None
+    for kw in deco.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            pos = []
+            for elt in v.elts:
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, int):
+                    pos.append(elt.value)
+            return tuple(pos)
+    return None
+
+
+def _key_of(expr: ast.AST) -> Optional[str]:
+    """Trackable identity of an argument expression: bare name or
+    self.attr."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return f"self.{expr.attr}"
+    return None
+
+
+class _DonatedFns(ast.NodeVisitor):
+    """{callable key: donated positions} — decorated def names and
+    their self.X aliases."""
+
+    def __init__(self) -> None:
+        self.donated: Dict[str, Tuple[int, ...]] = {}
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        for deco in node.decorator_list:
+            pos = _donate_positions(deco)
+            if pos:
+                self.donated[node.name] = pos
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # self._step_k = _step_k  (alias the donated def)
+        if isinstance(node.value, ast.Name) and \
+                node.value.id in self.donated:
+            for tgt in node.targets:
+                key = _key_of(tgt)
+                if key:
+                    self.donated[key] = self.donated[node.value.id]
+        # self._step = jax.jit(fn, donate_argnums=…)
+        pos = _donate_positions(node.value)
+        if pos:
+            for tgt in node.targets:
+                key = _key_of(tgt)
+                if key:
+                    self.donated[key] = pos
+        self.generic_visit(node)
+
+
+def _stores_in(node: ast.AST) -> set:
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)) and \
+                isinstance(sub.ctx, (ast.Store, ast.Del)):
+            key = _key_of(sub)
+            if key:
+                out.add(key)
+    return out
+
+
+class JaxHygienePass(Pass):
+    name = "jax-hygiene"
+
+    def check_file(self, ctx: FileContext) -> List[Violation]:
+        out: List[Violation] = []
+        donated = _DonatedFns()
+        donated.visit(ctx.tree)
+        if donated.donated:
+            self._check_donation(ctx, donated.donated, out)
+        if ctx.hot_path:
+            self._check_host_sync(ctx, out)
+        return out
+
+    # -- donated-buffer reuse -----------------------------------------
+    def _check_donation(self, ctx: FileContext,
+                        donated: Dict[str, Tuple[int, ...]],
+                        out: List[Violation]) -> None:
+        """Text-order scan over the whole function body (at any nesting
+        depth — the decode hot paths live inside loops and branches):
+        after a donated call, the first later event for the donated key
+        decides — a load flags, a store (rebinding) clears.  Events in
+        the call's own statement are the same-statement rebinding case
+        (``a, b = f(a, b)``) and never flag."""
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # nearest enclosing statement for every expression node: each
+            # statement owns the expression subtrees hanging directly off
+            # it (a compound stmt owns its test/iter/items, not the
+            # statements in its body — those own their own subtrees)
+            owner: Dict[ast.AST, ast.stmt] = {}
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, ast.stmt) or stmt is fn:
+                    continue
+                work = [c for c in ast.iter_child_nodes(stmt)
+                        if not isinstance(c, ast.stmt)]
+                while work:
+                    n = work.pop()
+                    owner[n] = stmt
+                    work.extend(c for c in ast.iter_child_nodes(n)
+                                if not isinstance(c, ast.stmt))
+            # events for every tracked key, in source order
+            events = []   # (lineno, kind, key)
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.Name, ast.Attribute)):
+                    key = _key_of(sub)
+                    if key is None:
+                        continue
+                    if isinstance(sub.ctx, ast.Load):
+                        events.append((sub.lineno, "load", key, sub))
+                    elif isinstance(sub.ctx, (ast.Store, ast.Del)):
+                        events.append((sub.lineno, "store", key, sub))
+            events.sort(key=lambda e: e[0])
+            for call in ast.walk(fn):
+                if not isinstance(call, ast.Call):
+                    continue
+                fkey = _key_of(call.func)
+                if fkey is None or fkey not in donated:
+                    continue
+                call_stmt = owner.get(call)
+                end = getattr(call_stmt or call, "end_lineno",
+                              call.lineno)
+                rebound = _stores_in(call_stmt) if call_stmt is not None \
+                    else set()
+                for pos in donated[fkey]:
+                    if pos >= len(call.args):
+                        continue
+                    akey = _key_of(call.args[pos])
+                    if akey is None or akey in rebound:
+                        continue
+                    for lineno, kind, key, _node in events:
+                        if lineno <= end or key != akey:
+                            continue
+                        if kind == "load":
+                            out.append(Violation(
+                                ctx.rel, lineno, self.name,
+                                f"read of {akey!r} after it was donated "
+                                f"to {fkey}() (donate_argnums) — the "
+                                f"buffer is deleted at dispatch",
+                            ))
+                        break
+
+    # -- host syncs in hot-path files ---------------------------------
+    def _check_host_sync(self, ctx: FileContext,
+                         out: List[Violation]) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = _call_name(node.func)
+            if cname in HOST_SYNC_CALLS:
+                out.append(Violation(
+                    ctx.rel, node.lineno, self.name,
+                    f"host sync {cname}() in a hot-path file",
+                ))
+                continue
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "block_until_ready":
+                out.append(Violation(
+                    ctx.rel, node.lineno, self.name,
+                    "host sync .block_until_ready() in a hot-path file",
+                ))
+                continue
+            # np.asarray(x) — one positional arg, bare name, no dtype
+            if cname in ("np.asarray", "numpy.asarray") and \
+                    len(node.args) == 1 and not node.keywords and \
+                    isinstance(node.args[0], ast.Name):
+                out.append(Violation(
+                    ctx.rel, node.lineno, self.name,
+                    f"np.asarray({node.args[0].id}) in a hot-path file "
+                    f"is a device->host sync; if deliberate, mark it "
+                    f"# vtpu: allow(jax-hygiene)",
+                ))

@@ -1,0 +1,337 @@
+"""lock-discipline — the documented global lock order, machine-checked.
+
+docs/scheduler_perf.md §Lock-order rules is the source of truth:
+
+1. manager locks (NodeManager / PodManager) →
+2. cache lock (UsageCache — always innermost for booking state) →
+3. never call back into a manager while holding the cache lock, and
+   never block (API round trips, HTTP, ``time.sleep``, file I/O) under
+   the cache lock.
+
+The pass reconstructs each module's lock-nesting graph from ``with
+<lock>:`` blocks.  A lock is anything assigned from
+``threading.Lock()`` / ``threading.RLock()`` or the witness factory
+``make_lock("<name>")`` — the witness name is the lock's identity and
+its leading segment is the tier (``manager.*`` outermost, ``cache.*``
+innermost).  Unnamed locks fall back to ``Class._attr`` identity and
+are tiered by class-name convention (``*Manager`` → manager,
+``UsageCache`` → cache).
+
+Checked:
+
+- **order**: a nested ``with`` acquiring a manager-tier lock while a
+  cache-tier lock is held (the documented inversion);
+- **cycles**: any cycle in the module's nesting graph (a static ABBA);
+- **blocking-under-cache**: calls matching the blocking list inside a
+  cache-tier ``with`` body.
+
+Resolution is best-effort and syntactic (``self._lock``,
+``obj.locked()``, module-level locks, ``self.attr._lock`` through
+constructor-tracked types); what cannot be resolved is ignored.  The
+runtime witness (vtpu/analysis/witness.py) covers the cross-function
+nesting this pass cannot see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from vtpu.analysis.core import FileContext, Pass, Violation
+from vtpu.analysis.witness import find_cycles
+
+# tier by witness-name prefix; lower acquires first (outermost)
+TIER_BY_PREFIX = {"manager": 0, "cache": 1}
+# the lock id `with <obj>.locked():` resolves to when the module does
+# not define its own unique locked() class (UsageCache's accessor)
+DEFAULT_LOCKED_ID = "cache.usage"
+# fallback tier by class-name convention for unnamed threading locks
+TIER_BY_CLASS = (("Manager", 0), ("UsageCache", 1), ("Cache", 1))
+
+# call patterns that block: sleeps, sockets/HTTP, processes, file I/O,
+# and Kubernetes API client round trips
+BLOCKING_CALLS = {
+    "time.sleep", "open", "subprocess.run", "subprocess.check_output",
+    "subprocess.check_call", "subprocess.Popen", "socket.create_connection",
+    "urllib.request.urlopen",
+}
+BLOCKING_ATTRS = {
+    # any-receiver method names that are API/network round trips
+    "urlopen", "getresponse", "create_connection", "sendall", "recv",
+    "patch_node", "patch_pod", "get_node", "get_pod", "list_nodes",
+    "list_pods", "create_node", "create_pod", "delete_pod", "request",
+}
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    """Dotted name of a call target, e.g. ``time.sleep`` — None when
+    the receiver is not a plain name chain."""
+    parts = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _lock_ctor(value: ast.AST) -> Optional[Tuple[str, Optional[str]]]:
+    """("threading"|"witness", witness name) when ``value`` constructs a
+    lock; handles list/comprehension wrappers (striped locks)."""
+    if isinstance(value, ast.ListComp):
+        return _lock_ctor(value.elt)
+    if isinstance(value, ast.List) and value.elts:
+        return _lock_ctor(value.elts[0])
+    if not isinstance(value, ast.Call):
+        return None
+    name = _call_name(value.func)
+    if name in ("threading.Lock", "threading.RLock"):
+        return ("threading", None)
+    if name is not None and name.split(".")[-1] == "make_lock":
+        if value.args and isinstance(value.args[0], ast.Constant) \
+                and isinstance(value.args[0].value, str):
+            return ("witness", value.args[0].value)
+        return ("witness", None)
+    return None
+
+
+class _ModuleLocks(ast.NodeVisitor):
+    """First sweep: every lock declaration in the module.
+
+    - ``self.X = <lock ctor>`` inside class C  → C.X is a lock
+    - ``NAME = <lock ctor>`` at module level    → NAME is a lock
+    - ``self.X = ClassName(...)`` inside C      → C.X has type ClassName
+    - a method ``def locked(self)`` in C        → C exposes its lock
+    """
+
+    def __init__(self) -> None:
+        self.class_locks: Dict[str, Dict[str, Optional[str]]] = {}
+        self.module_locks: Dict[str, Optional[str]] = {}
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        self.locked_classes: List[str] = []
+        self._class: List[str] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class.append(node.name)
+        self.class_locks.setdefault(node.name, {})
+        self.attr_types.setdefault(node.name, {})
+        for item in node.body:
+            if isinstance(item, ast.FunctionDef) and item.name == "locked":
+                self.locked_classes.append(node.name)
+        self.generic_visit(node)
+        self._class.pop()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        ctor = _lock_ctor(node.value)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self" and self._class:
+                cls = self._class[-1]
+                if ctor is not None:
+                    self.class_locks[cls][tgt.attr] = ctor[1]
+                elif isinstance(node.value, ast.Call):
+                    tname = _call_name(node.value.func)
+                    if tname is not None:
+                        self.attr_types[cls][tgt.attr] = \
+                            tname.split(".")[-1]
+            elif isinstance(tgt, ast.Name) and not self._class \
+                    and ctor is not None:
+                self.module_locks[tgt.id] = ctor[1]
+        self.generic_visit(node)
+
+
+class _Resolver:
+    """Resolve a with-item expression to a lock id, best-effort."""
+
+    def __init__(self, decls: _ModuleLocks) -> None:
+        self.decls = decls
+
+    def _lock_id(self, cls: str, attr: str) -> str:
+        witness = self.decls.class_locks.get(cls, {}).get(attr)
+        return witness if witness else f"{cls}.{attr}"
+
+    def resolve(self, expr: ast.AST, cur_class: Optional[str],
+                local_types: Dict[str, str]) -> Optional[str]:
+        # with self._lock:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base == "self" and cur_class is not None and \
+                    attr in self.decls.class_locks.get(cur_class, {}):
+                return self._lock_id(cur_class, attr)
+            # with obj._lock: where obj's type is known
+            t = local_types.get(base)
+            if t and attr in self.decls.class_locks.get(t, {}):
+                return self._lock_id(t, attr)
+            # with MODULE-level lock accessed bare
+            return None
+        # with self.cache._lock:  (self.X typed by constructor tracking)
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Attribute) and \
+                isinstance(expr.value.value, ast.Name) and \
+                expr.value.value.id == "self" and cur_class is not None:
+            t = self.decls.attr_types.get(cur_class, {}) \
+                .get(expr.value.attr)
+            if t and expr.attr in self.decls.class_locks.get(t, {}):
+                return self._lock_id(t, expr.attr)
+            return None
+        # with _module_lock:
+        if isinstance(expr, ast.Name):
+            if expr.id in self.decls.module_locks:
+                return self.decls.module_locks[expr.id] or \
+                    f"module.{expr.id}"
+            return None
+        # with <expr>.locked(): — in-tree, the only locked() accessor is
+        # UsageCache's ("the cache lock, always innermost"); resolve a
+        # local unique locked() class when the module defines one, else
+        # fall back to the cache lock id by convention
+        if isinstance(expr, ast.Call) and \
+                isinstance(expr.func, ast.Attribute) and \
+                expr.func.attr == "locked" and not expr.args:
+            if len(self.decls.locked_classes) == 1:
+                cls = self.decls.locked_classes[0]
+                locks = self.decls.class_locks.get(cls, {})
+                if "_lock" in locks:
+                    return self._lock_id(cls, "_lock")
+            return DEFAULT_LOCKED_ID
+        # with self._stripes[i]:
+        if isinstance(expr, ast.Subscript):
+            return self.resolve(expr.value, cur_class, local_types)
+        return None
+
+
+def tier_of(lock_id: str) -> Optional[int]:
+    head = lock_id.split(".", 1)[0]
+    if head in TIER_BY_PREFIX:
+        return TIER_BY_PREFIX[head]
+    for suffix, tier in TIER_BY_CLASS:
+        if head.endswith(suffix):
+            return tier
+    return None
+
+
+class LockDisciplinePass(Pass):
+    name = "lock-discipline"
+
+    def check_file(self, ctx: FileContext) -> List[Violation]:
+        decls = _ModuleLocks()
+        decls.visit(ctx.tree)
+        resolver = _Resolver(decls)
+        out: List[Violation] = []
+        # module nesting graph: (outer, inner) -> first line seen
+        edges: Dict[Tuple[str, str], int] = {}
+
+        def check_blocking(call: ast.Call, held: List[str]) -> None:
+            if not any(tier_of(h) == TIER_BY_PREFIX["cache"] for h in held):
+                return
+            cname = _call_name(call.func)
+            blocked = None
+            if cname in BLOCKING_CALLS:
+                blocked = cname
+            elif isinstance(call.func, ast.Attribute) and \
+                    call.func.attr in BLOCKING_ATTRS:
+                blocked = f".{call.func.attr}"
+            if blocked is not None:
+                out.append(Violation(
+                    ctx.rel, call.lineno, self.name,
+                    f"blocking call {blocked}() under the cache lock "
+                    f"(held: {[h for h in held if tier_of(h) == 1]})",
+                ))
+
+        def walk_fn(fn: ast.AST, cur_class: Optional[str]) -> None:
+            local_types: Dict[str, str] = {}
+
+            def visit(node: ast.AST, held: List[str]) -> None:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)) and node is not fn:
+                    return  # nested defs/lambdas run later, not under the lock
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, (ast.Attribute, ast.Call)):
+                    # track v = self.X / v = ClassName(...)
+                    for tgt in node.targets:
+                        if not isinstance(tgt, ast.Name):
+                            continue
+                        if isinstance(node.value, ast.Attribute) and \
+                                isinstance(node.value.value, ast.Name) and \
+                                node.value.value.id == "self" and cur_class:
+                            t = decls.attr_types.get(cur_class, {}) \
+                                .get(node.value.attr)
+                            if t:
+                                local_types[tgt.id] = t
+                        elif isinstance(node.value, ast.Call):
+                            tname = _call_name(node.value.func)
+                            if tname and tname.split(".")[-1] in \
+                                    decls.class_locks:
+                                local_types[tgt.id] = tname.split(".")[-1]
+                if isinstance(node, ast.With):
+                    acquired: List[str] = []
+                    for item in node.items:
+                        lock_id = resolver.resolve(
+                            item.context_expr, cur_class, local_types)
+                        if lock_id is None:
+                            # `with open(...)`/`with urlopen(...)` is the
+                            # idiomatic shape of file/network I/O — a
+                            # non-lock with-item still runs under every
+                            # lock already held at this point
+                            for sub in ast.walk(item.context_expr):
+                                if isinstance(sub, ast.Call):
+                                    check_blocking(sub, held + acquired)
+                            continue
+                        for holder in held + acquired:
+                            if holder == lock_id:
+                                continue
+                            key = (holder, lock_id)
+                            edges.setdefault(key, node.lineno)
+                            ht, lt = tier_of(holder), tier_of(lock_id)
+                            if ht is not None and lt is not None \
+                                    and lt < ht:
+                                out.append(Violation(
+                                    ctx.rel, node.lineno, self.name,
+                                    f"lock order inversion: acquiring "
+                                    f"{lock_id!r} while holding "
+                                    f"{holder!r} (documented order: "
+                                    f"manager -> cache, "
+                                    f"docs/scheduler_perf.md "
+                                    f"§Lock-order rules)",
+                                ))
+                        acquired.append(lock_id)
+                    for child in node.body:
+                        visit(child, held + acquired)
+                    return
+                if isinstance(node, ast.Call):
+                    check_blocking(node, held)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, held)
+
+            for stmt in ast.iter_child_nodes(fn):
+                visit(stmt, [])
+
+        def walk(node: ast.AST, cur_class: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    walk_fn(child, cur_class)
+                    walk(child, cur_class)
+                else:
+                    walk(child, cur_class)
+
+        walk(ctx.tree, None)
+
+        # static ABBA: cycles in this module's nesting graph, via the
+        # same SCC finder the runtime witness uses
+        for cyc in find_cycles(edges):
+            members = set(cyc)
+            lines = [ln for (a, b), ln in edges.items()
+                     if a in members and b in members]
+            out.append(Violation(
+                ctx.rel, min(lines), self.name,
+                f"lock-nesting cycle: {' -> '.join(cyc)} — acquired in "
+                f"inconsistent orders in this module (potential ABBA "
+                f"deadlock)",
+            ))
+        return out

@@ -1,0 +1,81 @@
+"""obs-docs — metric naming convention + docs catalog (the old obs-lint).
+
+Unlike the AST passes this one must *import* every component that
+registers instruments (registration happens at import time), so it runs
+as a project pass.  Checked, exactly as ``hack/obs_lint.py`` did (the
+hack script and ``make obs-lint`` are now aliases of this pass):
+
+- naming: ``vtpu_`` prefix, counters end ``_total``, other instruments
+  end in a unit suffix;
+- every registered family appears in docs/observability.md;
+- every journal event type in ``EVENT_TYPES`` appears there too.
+
+The exposition-format conformance tests still ride ``make obs-lint``
+(they are pytest, not lint).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from vtpu.analysis.core import ProjectPass, Violation
+
+DOC = os.path.join("docs", "observability.md")
+
+
+class ObsDocsPass(ProjectPass):
+    name = "obs-docs"
+
+    def run(self, repo_root: str) -> List[Violation]:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # importing the modules is what populates the registries
+        import vtpu.audit.auditor  # noqa: F401 — reconciliation gauges
+        import vtpu.monitor.feedback  # noqa: F401 — arbiter instruments
+        import vtpu.monitor.pathmonitor  # noqa: F401 — scan/GC counters
+        import vtpu.monitor.sampler  # noqa: F401 — duty-cycle families
+        import vtpu.plugin.cache  # noqa: F401 — device-poll failures
+        import vtpu.plugin.register  # noqa: F401 — registration counters
+        import vtpu.plugin.server  # noqa: F401 — Allocate histogram
+        import vtpu.scheduler.core  # noqa: F401 — filter/patch/bind
+        import vtpu.scheduler.decisions  # noqa: F401 — audit-log counter
+        import vtpu.scheduler.gang  # noqa: F401 — gang admission
+        import vtpu.scheduler.metrics  # noqa: F401 — fragmentation
+        import vtpu.scheduler.shard  # noqa: F401 — shard/leader
+        import vtpu.serving.batcher  # noqa: F401 — queue-to-first-token
+        import vtpu.serving.kvpool  # noqa: F401 — K/V handoff counters
+        import vtpu.serving.router  # noqa: F401 — front-door families
+        import vtpu.serving.transport  # noqa: F401 — wire transport
+        import vtpu.shim.runtime  # noqa: F401 — pacing/quota histograms
+        from vtpu.obs import all_registries, lint_names, registry
+        from vtpu.obs.events import EVENT_TYPES
+        from vtpu.obs.ready import readiness
+
+        # the cross-component "obs" families register lazily on first
+        # emit/report — instantiate them so the checks cover them too
+        registry("obs").counter(
+            "vtpu_events_total",
+            "Journal events emitted by component and type",
+        )
+        readiness("scheduler")
+
+        doc_rel = DOC
+        with open(os.path.join(repo_root, doc_rel), encoding="utf-8") as f:
+            doc = f.read()
+        out: List[Violation] = []
+        for p in lint_names():
+            out.append(Violation(doc_rel, 1, self.name, p))
+        for reg_name, reg in sorted(all_registries().items()):
+            for n in reg.names():
+                if n not in doc:
+                    out.append(Violation(
+                        doc_rel, 1, self.name,
+                        f"{reg_name}: {n}: not documented in {doc_rel}",
+                    ))
+        for ev in sorted(EVENT_TYPES):
+            if ev not in doc:
+                out.append(Violation(
+                    doc_rel, 1, self.name,
+                    f"events: {ev}: not documented in {doc_rel}",
+                ))
+        return out

@@ -1,0 +1,246 @@
+"""Runtime lock-order witness (VTPU_LOCK_WITNESS=1).
+
+Every concurrent component creates its locks through :func:`make_lock`
+with a stable dotted name (``"cache.usage"``, ``"manager.nodes"``, …).
+With the witness disabled (the default) that is a plain
+``threading.Lock``/``RLock`` — zero overhead on the hot paths.  With
+``VTPU_LOCK_WITNESS=1`` set *before the lock is created*, the lock is
+wrapped: each acquisition records, for the acquiring thread, an edge
+from every lock name it already holds to the new name, into one global
+order graph, together with both acquisition stacks the first time the
+edge is seen.  A cycle in that graph is a potential deadlock — two code
+paths that disagree about acquisition order — even if the interleaving
+that would actually deadlock never fired during the run.
+
+The threaded soak tests (churn, gang, best-effort) enable the witness
+and assert :func:`cycles` is empty at teardown, so every tier-1 run
+doubles as a deadlock hunt (docs/static_analysis.md §Lock witness).
+
+Conventions:
+
+- Lock identity is the *name*, not the instance: all 32 gang admit
+  stripes share ``"gang.stripe"``.  Same-name edges are therefore
+  skipped — they are either benign re-entrancy (RLocks) or a
+  sibling-instance order question this witness does not model.
+- Locks created while the witness is disabled stay plain.  Module-level
+  locks created at import time are only witnessed when the env is set
+  in the environment of the whole process (e.g. ``VTPU_LOCK_WITNESS=1
+  pytest …``); the soaks cover the instance locks they construct.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+from vtpu.utils.envs import env_str
+
+ENV_WITNESS = "VTPU_LOCK_WITNESS"
+
+# stack frames kept per first-seen edge endpoint (innermost last)
+_STACK_LIMIT = 16
+
+# (holder name, acquired name) -> (holder acquisition frames,
+# acquiring frames, count) — raw FrameSummary lists, formatted only in
+# report(); first witness wins, later identical edges are just counted
+_edges: Dict[Tuple[str, str], tuple] = {}
+# witness-internal lock; deliberately a bare threading.Lock (the witness
+# must not witness itself)
+_graph_lock = threading.Lock()
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    return env_str(ENV_WITNESS, "") not in ("", "0", "false")
+
+
+def _held() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _capture():
+    # raw FrameSummary list, formatted lazily in report() — string
+    # formatting on every acquisition would tax the witness-on soaks;
+    # drop the two witness-internal frames (acquire → _capture)
+    return traceback.extract_stack(limit=_STACK_LIMIT)[:-2]
+
+
+class WitnessLock:
+    """A named lock that reports its acquisition edges to the witness.
+
+    Supports the surface the tree actually uses: ``with``, ``acquire``
+    (blocking/timeout), ``release``; anything else falls through to the
+    wrapped lock.
+    """
+
+    __slots__ = ("name", "_base")
+
+    def __init__(self, name: str, base) -> None:
+        self.name = name
+        self._base = base
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._base.acquire(blocking, timeout)
+        if ok:
+            self._note_acquired()
+        return ok
+
+    def release(self) -> None:
+        self._base.release()
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] == self.name:
+                del held[i]
+                break
+
+    def __enter__(self) -> "WitnessLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __getattr__(self, attr):
+        return getattr(self._base, attr)
+
+    def _note_acquired(self) -> None:
+        held = _held()
+        stack = _capture()
+        # re-entrant acquisition (this name already held by this thread)
+        # adds no new ordering constraint — recording edges from locks
+        # acquired IN BETWEEN would manufacture a phantom B->A cycle for
+        # the deadlock-free `with a: with b: with a:` RLock pattern
+        if any(h[0] == self.name for h in held):
+            held.append((self.name, stack))
+            return
+        seen = set()
+        for holder_name, holder_stack in held:
+            if holder_name == self.name or holder_name in seen:
+                continue
+            seen.add(holder_name)
+            key = (holder_name, self.name)
+            with _graph_lock:
+                ent = _edges.get(key)
+                if ent is None:
+                    _edges[key] = (holder_stack, stack, 1)
+                else:
+                    _edges[key] = (ent[0], ent[1], ent[2] + 1)
+        held.append((self.name, stack))
+
+
+def make_lock(name: str, reentrant: bool = False):
+    """A named lock: plain ``threading.Lock``/``RLock`` unless the
+    witness env is set at creation time."""
+    base = threading.RLock() if reentrant else threading.Lock()
+    if not enabled():
+        return base
+    return WitnessLock(name, base)
+
+
+def reset() -> None:
+    """Drop every recorded edge (test isolation)."""
+    with _graph_lock:
+        _edges.clear()
+
+
+def edges() -> Dict[Tuple[str, str], int]:
+    """{(holder, acquired): times seen} — the raw order graph."""
+    with _graph_lock:
+        return {k: v[2] for k, v in _edges.items()}
+
+
+def find_cycles(edge_keys) -> List[List[str]]:
+    """Cycles in a directed graph given as (from, to) pairs, each as the
+    sorted list of node names on the cycle.  Shared by the runtime
+    witness and the static lock-discipline pass (same edge-key shape).
+    Iterative Tarjan SCC; every SCC with >1 node is a cycle (self edges
+    are not expected)."""
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in edge_keys:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    counter = [0]
+    out: List[List[str]] = []
+
+    def strongconnect(root: str) -> None:
+        work = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack[node] = True
+            advanced = False
+            for j in range(pi, len(adj[node])):
+                nxt = adj[node][j]
+                if nxt not in index:
+                    work[-1] = (node, j + 1)
+                    work.append((nxt, 0))
+                    advanced = True
+                    break
+                if on_stack.get(nxt):
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    scc.append(w)
+                    if w == node:
+                        break
+                if len(scc) > 1:
+                    out.append(sorted(scc))
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+
+    for n in adj:
+        if n not in index:
+            strongconnect(n)
+    return out
+
+
+def cycles() -> List[List[str]]:
+    """Cycles in the recorded order graph, each as the list of lock
+    names on the cycle.  A non-empty result is a potential deadlock."""
+    with _graph_lock:
+        keys = list(_edges)
+    return find_cycles(keys)
+
+
+def report(found: Optional[List[List[str]]] = None) -> str:
+    """Human-readable cycle report with both first-witness stacks per
+    participating edge."""
+    found = cycles() if found is None else found
+    if not found:
+        return "lock witness: no order-graph cycles"
+    lines = [f"lock witness: {len(found)} order-graph cycle(s)"]
+    with _graph_lock:
+        snapshot = dict(_edges)
+    for cyc in found:
+        members = set(cyc)
+        lines.append("cycle: " + " -> ".join(cyc))
+        for (a, b), (ha, hb, n) in sorted(snapshot.items()):
+            if a in members and b in members:
+                lines.append(f"  edge {a} -> {b} (seen {n}x)")
+                lines.append(f"    holding {a} since:")
+                lines.extend("      " + ln.rstrip()
+                             for fr in traceback.format_list(ha[-4:])
+                             for ln in fr.splitlines())
+                lines.append(f"    acquiring {b} at:")
+                lines.extend("      " + ln.rstrip()
+                             for fr in traceback.format_list(hb[-4:])
+                             for ln in fr.splitlines())
+    return "\n".join(lines)

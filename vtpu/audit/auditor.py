@@ -50,6 +50,8 @@ from typing import Dict, List, Optional, Set
 from vtpu import obs
 from vtpu.obs.events import EventType, emit
 from vtpu.scheduler.state import PENDING_PATCH_GRACE_S
+from vtpu.utils.envs import env_float
+from vtpu.analysis.witness import make_lock
 from vtpu.utils.types import HANDSHAKE_TIMEOUT_S, KNOWN_DEVICES, annotations
 
 log = logging.getLogger(__name__)
@@ -149,12 +151,7 @@ class ClusterAuditor:
     ) -> None:
         self.sched = sched
         if interval_s is None:
-            try:
-                interval_s = float(
-                    os.environ.get(ENV_INTERVAL, "") or DEFAULT_INTERVAL_S
-                )
-            except ValueError:
-                interval_s = DEFAULT_INTERVAL_S
+            interval_s = env_float(ENV_INTERVAL, DEFAULT_INTERVAL_S)
         self.interval_s = interval_s
         self.stale_heartbeat_s = stale_heartbeat_s
         self._wallclock = wallclock
@@ -163,8 +160,8 @@ class ClusterAuditor:
         # re-emitting identical DriftDetected storms is noise, not safety);
         # on-demand GET /audit still runs everywhere.  None = always run.
         self.leader_gate = None
-        self._lock = threading.Lock()
-        self._pass_lock = threading.Lock()  # one pass at a time (loop + GET)
+        self._lock = make_lock("audit.state")
+        self._pass_lock = make_lock("audit.pass")  # one pass at a time (loop + GET)
         self._passes = 0
         self._last_report: Optional[dict] = None
         self._last_pass_t: Optional[float] = None  # monotonic

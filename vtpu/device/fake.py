@@ -27,6 +27,7 @@ from typing import List, Optional, Union
 
 from vtpu.device.chip import Chip, tensorcores_for_model
 from vtpu.device.topology import Topology
+from vtpu.utils.envs import env_str
 
 ENV_MOCK_JSON = "VTPU_MOCK_JSON"
 
@@ -34,7 +35,7 @@ ENV_MOCK_JSON = "VTPU_MOCK_JSON"
 class FakeProvider:
     def __init__(self, fixture: Optional[Union[str, dict]] = None) -> None:
         if fixture is None:
-            fixture = os.environ.get(ENV_MOCK_JSON)
+            fixture = env_str(ENV_MOCK_JSON) or None
             if not fixture:
                 raise RuntimeError(f"FakeProvider needs a fixture (or ${ENV_MOCK_JSON})")
         if isinstance(fixture, str):

@@ -19,6 +19,8 @@ import logging
 import os
 from typing import Optional, Set
 
+from vtpu.utils.envs import env_int, env_str
+
 log = logging.getLogger(__name__)
 
 ENV_CONTAINERS_ROOT = "VTPU_CONTAINERS_ROOT"
@@ -36,12 +38,9 @@ def region_unhealthy_uuids(
     from vtpu.monitor.pathmonitor import REGION_FILENAME
     from vtpu.monitor.shared_region import open_region
 
-    root = root or os.environ.get(ENV_CONTAINERS_ROOT, DEFAULT_CONTAINERS_ROOT)
+    root = root or env_str(ENV_CONTAINERS_ROOT, DEFAULT_CONTAINERS_ROOT)
     if threshold is None:
-        threshold = int(
-            os.environ.get(ENV_ERROR_STREAK, str(DEFAULT_ERROR_STREAK))
-            or DEFAULT_ERROR_STREAK
-        )
+        threshold = env_int(ENV_ERROR_STREAK, DEFAULT_ERROR_STREAK)
     out: Set[str] = set()
     if not root or not os.path.isdir(root):
         return out

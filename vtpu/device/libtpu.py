@@ -24,6 +24,7 @@ from typing import List, Optional
 
 from vtpu.device.chip import Chip, tensorcores_for_model
 from vtpu.device.topology import KNOWN_SLICES, Topology
+from vtpu.utils.envs import env_int
 
 log = logging.getLogger(__name__)
 
@@ -90,7 +91,7 @@ class LibtpuProvider:
             else:
                 log.warning("unparseable topology %r; assuming 1 chip", spec)
                 self._topo = Topology((1, 1, 1))
-        hbm = int(os.environ.get(ENV_HBM_MB, HBM_MB_BY_MODEL.get(model, 16 * 1024)))
+        hbm = env_int(ENV_HBM_MB, HBM_MB_BY_MODEL.get(model, 16 * 1024))
         paths = _dev_paths()
         chips = []
         for i, coords in enumerate(self._topo.coords()):

@@ -17,6 +17,7 @@ from typing import List, Optional
 
 from vtpu.device.chip import Chip
 from vtpu.device.topology import Topology
+from vtpu.utils.envs import env_float, env_int, env_str
 
 log = logging.getLogger(__name__)
 
@@ -28,7 +29,7 @@ class PjrtProvider:
     """DeviceProvider over ``jax.local_devices()`` for non-TPU platforms."""
 
     def __init__(self, platform: Optional[str] = None) -> None:
-        self._platform = platform or os.environ.get(ENV_PJRT_PLATFORM)
+        self._platform = platform or env_str(ENV_PJRT_PLATFORM) or None
         self._hostname = os.uname().nodename
         self._chips: Optional[List[Chip]] = None
         self._jax_dev = {}  # uuid → jax device handle, pinned at discovery
@@ -47,7 +48,7 @@ class PjrtProvider:
         except Exception as e:  # noqa: BLE001 — no jax runtime is a normal miss
             log.info("PJRT discovery unavailable: %s", e)
             return []
-        default_mb = int(os.environ.get(ENV_PJRT_MEM_MB, 16 * 1024))
+        default_mb = env_int(ENV_PJRT_MEM_MB, 16 * 1024)
         chips = []
         for d in devices:
             if self._platform:
@@ -96,7 +97,7 @@ class PjrtProvider:
         import threading
 
         if timeout_s is None:
-            timeout_s = float(os.environ.get("VTPU_PROBE_TIMEOUT_S", "5") or 5)
+            timeout_s = env_float("VTPU_PROBE_TIMEOUT_S", 5.0)
         prev = self._probes.get(key) if key is not None else None
         if prev is not None and prev.is_alive():
             return False  # still wedged; don't stack another probe

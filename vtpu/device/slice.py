@@ -43,6 +43,7 @@ import dataclasses
 from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 from vtpu.device.allocator import best_rectangle_of_shape
+from vtpu.utils.types import annotations
 from vtpu.device.topology import (
     Coord,
     Topology,
@@ -52,7 +53,7 @@ from vtpu.device.topology import (
     ring_count,
 )
 
-HOST_COORD_ANNOTATION = "vtpu.io/host-coord"
+HOST_COORD_ANNOTATION = annotations.HOST_COORD
 
 
 def parse_host_coord(value: str) -> Tuple[int, int]:

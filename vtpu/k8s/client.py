@@ -18,6 +18,7 @@ import urllib.request
 from typing import Dict, List, Optional
 
 from vtpu.k8s.errors import Conflict  # noqa: E402
+from vtpu.utils.envs import env_str
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -223,17 +224,17 @@ def new_client() -> Client:
     same opt-in shape as kubectl's --insecure-skip-tls-verify)."""
     if os.environ.get("KUBERNETES_SERVICE_HOST"):
         return Client()
-    base = os.environ.get("VTPU_APISERVER")
+    base = env_str("VTPU_APISERVER")
     if not base:
         raise RuntimeError("set VTPU_APISERVER for out-of-cluster use")
-    insecure = os.environ.get("VTPU_INSECURE_SKIP_TLS_VERIFY", "").lower() in (
+    insecure = env_str("VTPU_INSECURE_SKIP_TLS_VERIFY").lower() in (
         "1",
         "true",
         "yes",
     )
     return Client(
         base_url=base,
-        token=os.environ.get("VTPU_TOKEN"),
-        ca_file=os.environ.get("VTPU_CA_FILE"),
+        token=env_str("VTPU_TOKEN") or None,
+        ca_file=env_str("VTPU_CA_FILE") or None,
         insecure=insecure,
     )

@@ -10,16 +10,16 @@ cluster.
 from __future__ import annotations
 
 import copy
-import threading
 from typing import Callable, Dict, List, Optional
 
 
+from vtpu.analysis.witness import make_lock
 from vtpu.k8s.errors import Conflict, NotFound  # noqa: F401  (re-export)
 
 
 class FakeClient:
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_lock("k8s.fake", reentrant=True)
         self._nodes: Dict[str, dict] = {}
         self._pods: Dict[str, dict] = {}  # key: ns/name
         self._rv = 0

@@ -39,6 +39,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 from vtpu import obs
 from vtpu.monitor.pathmonitor import PathMonitor
 from vtpu.utils import trace
+from vtpu.analysis.witness import make_lock
 from vtpu.utils.types import annotations
 
 log = logging.getLogger(__name__)
@@ -142,7 +143,7 @@ class UtilizationSampler:
         self.writeback_max_age_s = _env_float(
             "VTPU_UTIL_WRITEBACK_MAX_AGE_S", DEFAULT_WRITEBACK_MAX_AGE_S
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("monitor.sampler")
         # sampler health, read by the monitor's /readyz "util_sampler"
         # check (monotonic clock so fake-clock tests stay deterministic)
         self._last_sample_t: Optional[float] = None

@@ -33,12 +33,13 @@ import collections
 import json
 import logging
 import os
-import threading
 import time
 from typing import Deque, List, Optional
 
 from vtpu.obs.registry import registry
+from vtpu.analysis.witness import make_lock
 from vtpu.utils import trace
+from vtpu.utils.envs import env_int, env_str
 
 log = logging.getLogger(__name__)
 
@@ -96,25 +97,22 @@ class EventJournal:
         wallclock=time.time,
     ) -> None:
         if cap is None:
-            try:
-                cap = int(os.environ.get(ENV_CAP, "") or DEFAULT_CAP)
-            except ValueError:
-                cap = DEFAULT_CAP
+            cap = env_int(ENV_CAP, DEFAULT_CAP)
         self.cap = max(1, cap)
         self.jsonl_path = (
             jsonl_path
             if jsonl_path is not None
-            else os.environ.get(ENV_JSONL, "")
+            else env_str(ENV_JSONL)
         ) or None
         self._wallclock = wallclock
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.events_ring")
         self._dq: Deque[dict] = collections.deque(maxlen=self.cap)
         self._seq = 0
         # the sink has its own lock so emitters on the scheduler's hot
         # path never queue behind another thread's disk flush on the
         # ring lock; under contention file lines may land out of seq
         # order — every record carries "seq", consumers sort on it
-        self._sink_lock = threading.Lock()
+        self._sink_lock = make_lock("obs.events_sink")
         self._sink = None          # lazily opened append handle
         self._sink_dead = False    # one warning, then the mirror stays off
 
@@ -260,7 +258,7 @@ class EventJournal:
 
 
 _journal: Optional[EventJournal] = None
-_journal_lock = threading.Lock()
+_journal_lock = make_lock("obs.journal")
 
 
 def journal() -> EventJournal:

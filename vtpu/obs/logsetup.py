@@ -17,6 +17,7 @@ import time
 from typing import Optional
 
 from vtpu.utils import trace
+from vtpu.utils.envs import env_str
 
 _TEXT_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
 
@@ -57,7 +58,7 @@ def setup_logging(debug: bool = False, fmt: Optional[str] = None) -> None:
     ``fmt``: "json" or "text"; default from ``VTPU_LOG_FORMAT`` (json
     opt-in, text otherwise).  Idempotent enough for tests: replaces the
     root handlers it installed before."""
-    fmt = (fmt or os.environ.get("VTPU_LOG_FORMAT", "text")).lower()
+    fmt = (fmt or env_str("VTPU_LOG_FORMAT", "text")).lower()
     root = logging.getLogger()
     root.setLevel(logging.DEBUG if debug else logging.INFO)
     for h in list(root.handlers):

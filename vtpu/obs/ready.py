@@ -19,7 +19,7 @@ same name again replaces the check (restart-safe).
 from __future__ import annotations
 
 import json
-import threading
+from vtpu.analysis.witness import make_lock
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from vtpu.obs.registry import registry
@@ -40,7 +40,7 @@ class ReadyRegistry:
 
     def __init__(self, component: str) -> None:
         self.component = component
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.ready")
         self._checks: Dict[str, Check] = {}
         self._gauge = registry("obs").gauge(
             "vtpu_ready_check_ok_ratio",
@@ -88,7 +88,7 @@ class ReadyRegistry:
 
 
 _registries: Dict[str, ReadyRegistry] = {}
-_registries_lock = threading.Lock()
+_registries_lock = make_lock("obs.ready_registries")
 
 
 def readiness(component: str) -> ReadyRegistry:

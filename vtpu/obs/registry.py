@@ -22,8 +22,8 @@ other instruments end in a unit suffix (``_seconds``, ``_bytes``, …).
 from __future__ import annotations
 
 import bisect
-import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from vtpu.analysis.witness import make_lock
 
 __all__ = [
     "Counter",
@@ -92,7 +92,7 @@ class _Instrument:
     def __init__(self, name: str, help_: str) -> None:
         self.name = name
         self.help = help_
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.instrument")
 
     def render(self, lines: List[str]) -> None:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -248,7 +248,7 @@ class Registry:
 
     def __init__(self, name: str) -> None:
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.registry")
         self._instruments: Dict[str, _Instrument] = {}
 
     def _get_or_make(self, cls, name: str, help_: str, **kw):
@@ -289,7 +289,7 @@ class Registry:
 
 
 _registries: Dict[str, Registry] = {}
-_registries_lock = threading.Lock()
+_registries_lock = make_lock("obs.registries")
 
 
 def registry(name: str) -> Registry:

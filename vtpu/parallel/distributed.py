@@ -24,6 +24,8 @@ import logging
 import os
 from typing import Optional
 
+from vtpu.utils.envs import env_int, env_str
+
 log = logging.getLogger(__name__)
 
 _initialized = False
@@ -41,14 +43,14 @@ def ensure_initialized(
     global _initialized
     if _initialized:
         return True
-    coordinator = coordinator or os.environ.get("VTPU_COORDINATOR")
+    coordinator = coordinator or env_str("VTPU_COORDINATOR")
     if num_processes is None:
-        num_processes = int(os.environ.get("VTPU_NUM_PROCESSES", "0") or 0)
+        num_processes = env_int("VTPU_NUM_PROCESSES", 0)
     if not coordinator or num_processes <= 1:
         log.debug("single-host run; jax.distributed not initialized")
         return False
     if process_id is None:
-        raw = os.environ.get("VTPU_PROCESS_ID")
+        raw = env_str("VTPU_PROCESS_ID") or None
         if raw is None:
             # defaulting to 0 would make every worker claim rank 0 and
             # deadlock the gang with an opaque barrier timeout

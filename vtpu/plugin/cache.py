@@ -26,6 +26,8 @@ from typing import Callable, Dict, List
 from vtpu import obs
 from vtpu.device.chip import Chip
 from vtpu.obs.events import EventType, emit
+from vtpu.utils.envs import env_str
+from vtpu.analysis.witness import make_lock
 
 log = logging.getLogger(__name__)
 
@@ -55,7 +57,7 @@ class DeviceCache:
     def __init__(self, provider, poll_interval_s: float = 1.0) -> None:
         self.provider = provider
         self.poll_interval_s = poll_interval_s
-        self._lock = threading.RLock()
+        self._lock = make_lock("plugin.devcache", reentrant=True)
         self._chips: List[Chip] = _snap(provider.enumerate())
         self._subs: Dict[str, Callable[[List[Chip]], None]] = {}
         self._stop = threading.Event()
@@ -134,7 +136,7 @@ class DeviceCache:
         return True, f"last good poll {time.monotonic() - last_ok:.0f}s ago"
 
     def start(self) -> None:
-        if os.environ.get(ENV_DISABLE_HEALTHCHECKS, "") not in ("", "0"):
+        if env_str(ENV_DISABLE_HEALTHCHECKS) not in ("", "0"):
             log.warning(
                 "health checks disabled (%s set)", ENV_DISABLE_HEALTHCHECKS
             )

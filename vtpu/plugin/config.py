@@ -9,6 +9,8 @@ import logging
 import os
 from typing import Optional
 
+from vtpu.utils.envs import env_str
+
 log = logging.getLogger(__name__)
 
 
@@ -92,13 +94,12 @@ class PluginConfig:
             v = os.environ.get(env)
             if v:
                 setattr(cfg, field, type(getattr(cfg, field))(float(v)))
-        if os.environ.get("VTPU_RESOURCE_NAME"):
-            cfg.resource_name = os.environ["VTPU_RESOURCE_NAME"]
-        if os.environ.get("VTPU_PARTITION_STRATEGY"):
-            cfg.partition_strategy = os.environ["VTPU_PARTITION_STRATEGY"]
+        cfg.resource_name = env_str("VTPU_RESOURCE_NAME", cfg.resource_name)
+        cfg.partition_strategy = env_str(
+            "VTPU_PARTITION_STRATEGY", cfg.partition_strategy)
         # per-node overrides from a ConfigMap-mounted JSON file
         # (ref main.go:85-108: devicememoryscaling/devicesplitcount per node)
-        path = config_file or os.environ.get("VTPU_NODE_CONFIG", "/config/config.json")
+        path = config_file or env_str("VTPU_NODE_CONFIG", "/config/config.json")
         if os.path.exists(path):
             try:
                 with open(path) as f:

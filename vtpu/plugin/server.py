@@ -43,6 +43,7 @@ from vtpu.plugin.cache import DeviceCache
 from vtpu.plugin.config import PluginConfig
 from vtpu.utils import allocate as alloc_util
 from vtpu.utils import trace, types
+from vtpu.utils.envs import env_str
 from vtpu.utils.types import annotations
 
 log = logging.getLogger(__name__)
@@ -225,7 +226,7 @@ class VtpuDevicePlugin(api.DevicePluginServicer):
             # the container
             resp.envs["VTPU_TRACE_CONTEXT"] = trace_ctx
             resp.envs["VTPU_TRACE"] = "1"
-            sink = os.environ.get("VTPU_SPAN_SINK")
+            sink = env_str("VTPU_SPAN_SINK")
             if sink:
                 resp.envs["VTPU_SPAN_SINK"] = sink
         if cfg.device_memory_scaling > 1.0:

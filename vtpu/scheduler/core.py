@@ -27,6 +27,7 @@ from vtpu.scheduler.score import DeviceUsage, NodeUsage
 from vtpu.scheduler.state import NodeManager, PodManager
 from vtpu.scheduler.usage_cache import UsageCache
 from vtpu.utils import codec, trace
+from vtpu.analysis.witness import make_lock
 from vtpu.utils.nodelock import lock_node, release_node_lock
 from vtpu.utils.resources import resource_reqs
 from vtpu.utils.types import (
@@ -191,7 +192,7 @@ class Scheduler:
         # path never takes it — concurrent filters select lock-free
         # against generation-stamped snapshots and commit via the
         # per-node CAS in UsageCache.try_book.
-        self._filter_lock = threading.Lock()
+        self._filter_lock = make_lock("scheduler.filter")
         # commits that re-ran selection because a background registry/pod
         # event (or a concurrent filter's booking) changed the chosen node
         # mid-filter (exported on /metrics; cas counters carry the detail).
@@ -199,7 +200,7 @@ class Scheduler:
         # without any shared lock otherwise, and a bare += would lose
         # counts exactly under the contention it is meant to measure.
         self.filter_gen_retries = 0
-        self._gen_retry_lock = threading.Lock()
+        self._gen_retry_lock = make_lock("scheduler.gen_retry")
         # sharded deployment (vtpu/scheduler/shard.py): when set, filter()
         # fans the candidate walk out to the replica that owns each node
         # and commits at the owner; None = this replica owns everything
@@ -217,7 +218,7 @@ class Scheduler:
         # (a leaked entry under sustained arrival would otherwise grow the
         # map one dead pod at a time, forever).
         self._patch_locks: Dict[str, list] = {}
-        self._patch_locks_guard = threading.Lock()
+        self._patch_locks_guard = make_lock("scheduler.patch_guard")
         self._patch_locks_hwm = 0
         # per-request-shape memo over single-chip evaluations:
         # {request key: {node: (generation, (uuid, mem, score) | None)}}.
@@ -792,7 +793,7 @@ class Scheduler:
         with self._patch_locks_guard:
             ent = self._patch_locks.get(uid)
             if ent is None:
-                ent = self._patch_locks[uid] = [threading.Lock(), 0]
+                ent = self._patch_locks[uid] = [make_lock("scheduler.patch_uid"), 0]
             ent[1] += 1
             if len(self._patch_locks) > self._patch_locks_hwm:
                 self._patch_locks_hwm = len(self._patch_locks)
@@ -866,7 +867,8 @@ class Scheduler:
         decision log only records its own subset's verdicts plus the
         winner."""
         uid = pod_uid(pod)
-        ici_policy = pod_annos.get("vtpu.io/ici-policy", self.config.ici_policy)
+        ici_policy = pod_annos.get(
+            annotations.ICI_POLICY, self.config.ici_policy)
         policy = self.config.node_scheduler_policy
         # fast path: one container, one chip share — the dominant request
         # shape — is evaluated against the LIVE cache aggregates without

@@ -18,11 +18,12 @@ from __future__ import annotations
 
 import collections
 import os
-import threading
 import time
 from typing import Deque, List, Optional
 
 from vtpu import obs
+from vtpu.utils.envs import env_int
+from vtpu.analysis.witness import make_lock
 
 _REG = obs.registry("scheduler")
 _RECORDED = _REG.counter(
@@ -41,14 +42,10 @@ class DecisionLog:
         self, cap: Optional[int] = None, wallclock=time.time
     ) -> None:
         if cap is None:
-            try:
-                cap = int(os.environ.get("VTPU_DECISION_LOG_CAP", "")
-                          or DEFAULT_CAP)
-            except ValueError:
-                cap = DEFAULT_CAP
+            cap = env_int("VTPU_DECISION_LOG_CAP", DEFAULT_CAP)
         self.cap = max(1, cap)
         self._dq: Deque[dict] = collections.deque(maxlen=self.cap)
-        self._lock = threading.Lock()
+        self._lock = make_lock("scheduler.decisions")
         self._seq = 0
         self._wallclock = wallclock
 

@@ -67,13 +67,15 @@ from vtpu.scheduler import score as score_mod
 from vtpu.scheduler.core import ASSIGNMENT_CLEAR_PATCH, FilterResult
 from vtpu.utils import codec
 from vtpu.utils.resources import resource_reqs
+from vtpu.utils.envs import env_float, env_int
+from vtpu.analysis.witness import make_lock
 from vtpu.utils.types import ContainerDevice, PodDevices, annotations
 
 log = logging.getLogger(__name__)
 
 GANG_NAME = annotations.GANG_NAME
-GANG_SIZE = "vtpu.io/gang-size"
-GANG_MESH = "vtpu.io/gang-mesh"
+GANG_SIZE = annotations.GANG_SIZE
+GANG_MESH = annotations.GANG_MESH
 
 ENV_TTL = "VTPU_GANG_TTL_S"
 DEFAULT_TTL_S = 30.0
@@ -174,13 +176,10 @@ class GangRegistry:
         self, ttl_s: Optional[float] = None, clock=time.monotonic
     ) -> None:
         if ttl_s is None:
-            try:
-                ttl_s = float(os.environ.get(ENV_TTL, "") or DEFAULT_TTL_S)
-            except ValueError:
-                ttl_s = DEFAULT_TTL_S
+            ttl_s = env_float(ENV_TTL, DEFAULT_TTL_S)
         self.ttl_s = ttl_s
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = make_lock("gang.registry")
         self._gangs: Dict[str, _Gang] = {}
         self.expired_total = 0
 
@@ -291,12 +290,7 @@ class GangCoordinator:
     def __init__(self, sched, registry: Optional[GangRegistry] = None) -> None:
         self.sched = sched
         self.registry = registry or GangRegistry()
-        try:
-            self.retries = int(
-                os.environ.get(ENV_RETRIES, "") or DEFAULT_RETRIES
-            )
-        except ValueError:
-            self.retries = DEFAULT_RETRIES
+        self.retries = env_int(ENV_RETRIES, DEFAULT_RETRIES)
         # serializes admissions PER GANG (striped by gang key): two
         # members completing the same gang concurrently must not both
         # run phase 1, but one gang mid-admission — remote commits, N
@@ -304,7 +298,9 @@ class GangCoordinator:
         # gang's filter.  Different gangs planning concurrently may pick
         # overlapping nodes; the loser's try_book CAS conflicts and it
         # re-plans, the same optimistic model singleton filters use.
-        self._admit_stripes = [threading.RLock() for _ in range(32)]
+        self._admit_stripes = [
+            make_lock("gang.stripe", reentrant=True) for _ in range(32)
+        ]
         # test hook: called as fn(member_uid, node) immediately before
         # each member's CAS reserve — deterministic conflict injection
         # for the all-or-nothing proof (tests/test_gang.py)

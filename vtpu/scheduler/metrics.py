@@ -9,7 +9,6 @@ registry's hot-path latency histograms are appended after them.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Set, Tuple
 
 from vtpu import obs
@@ -17,6 +16,7 @@ from vtpu.obs import render_family
 from vtpu.device.topology import Topology, largest_rectangle
 from vtpu.scheduler.core import Scheduler
 from vtpu.scheduler.score import NodeUsage
+from vtpu.analysis.witness import make_lock
 
 _MB = 1024 * 1024
 
@@ -62,7 +62,7 @@ _OVERLAY_BOOKINGS = _REG.gauge(
     "Live best-effort overlay bookings (admitted above booked capacity; "
     "strictly outside the guaranteed booking aggregates)",
 )
-_gauge_lock = threading.Lock()
+_gauge_lock = make_lock("scheduler.frag_gauges")
 _prev_frag: Set[Tuple[str, ...]] = set()
 _prev_hist: Set[str] = set()
 _prev_duty: Set[Tuple[str, str]] = set()

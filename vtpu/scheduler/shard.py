@@ -51,6 +51,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 from vtpu import obs
 from vtpu.k8s.errors import Conflict, NotFound
 from vtpu.scheduler.core import FilterResult
+from vtpu.utils.types import annotations
+from vtpu.analysis.witness import make_lock
 
 log = logging.getLogger(__name__)
 
@@ -89,7 +91,7 @@ _PEER_RECONNECTS = _REG.counter(
 
 DEFAULT_VNODES = 64
 LEASE_NODE = "vtpu-scheduler-election"
-LEASE_ANNO = "vtpu.io/scheduler-leader"
+LEASE_ANNO = annotations.SCHEDULER_LEADER
 DEFAULT_LEASE_S = 15.0
 
 
@@ -190,7 +192,7 @@ class HttpPeer:
             )
         self._host = u.hostname or "127.0.0.1"
         self._port = u.port or 80
-        self._lock = threading.Lock()
+        self._lock = make_lock("shard.peer_pool")
         self._idle: collections.deque = collections.deque()
 
     def _acquire(self):
@@ -496,7 +498,7 @@ class LeaderElector:
         self.lease_s = lease_s
         self.lease_node = lease_node
         self._wallclock = wallclock
-        self._lock = threading.Lock()
+        self._lock = make_lock("shard.elector")
         self._leader = False
         self._last_renew = 0.0
         self._stop = threading.Event()

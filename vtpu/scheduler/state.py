@@ -11,11 +11,11 @@ innermost (never call back into a manager from a notification).
 from __future__ import annotations
 
 import dataclasses
-import threading
 import time
 from typing import Dict, List, Optional
 
 from vtpu.k8s.objects import get_annotations, pod_uid
+from vtpu.analysis.witness import make_lock
 from vtpu.obs.events import EventType, emit
 from vtpu.utils import codec
 from vtpu.utils.types import (
@@ -66,7 +66,7 @@ class NodeManager:
     """ref: nodes.go:59-121."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_lock("manager.nodes", reentrant=True)
         self._nodes: Dict[str, NodeInfo] = {}
         self._listeners: list = []
 
@@ -165,7 +165,7 @@ class PodManager:
     (scheduler.go:75-95)."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = make_lock("manager.pods", reentrant=True)
         self._pods: Dict[str, PodInfo] = {}
         self._listeners: list = []
 

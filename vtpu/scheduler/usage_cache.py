@@ -40,10 +40,10 @@ describes how to read them.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Tuple
 
 from vtpu.scheduler.score import DeviceUsage, NodeUsage
+from vtpu.analysis.witness import make_lock
 from vtpu.utils.types import ChipInfo, PodDevices
 
 __all__ = ["UsageCache"]
@@ -79,7 +79,7 @@ class UsageCache:
     def __init__(self) -> None:
         # RLock: the filter holds the lock across evaluate→book, and the
         # book path re-enters via PodManager.add_pod's notification
-        self._lock = threading.RLock()
+        self._lock = make_lock("cache.usage", reentrant=True)
         self._entries: Dict[str, _NodeEntry] = {}
         self._bookings: Dict[str, _PodBooking] = {}
         # cache-wide monotonic generation source: generations are unique

@@ -54,6 +54,9 @@ this rides the vtpu workload tier's KV-cache machinery
 (vtpu/models/transformer.py decode path).  docs/perf.md#serving-pipeline
 explains what overlaps with what and how to read the histograms."""
 
+# vtpu: hot-path — the decode/admission loops below promise zero host
+# syncs; make check (jax-hygiene) flags block_until_ready/device fetches
+# here, and the deliberate sync points carry vtpu: allow pragmas.
 from __future__ import annotations
 
 import collections
@@ -193,7 +196,7 @@ class ContinuousBatcher:
         # copy_to_host_async() issued at dispatch, this is the "double
         # buffer": the transfer rides along behind the NEXT window's
         # compute and the harvest finds it already local.
-        self._fetch = lambda arr, issued: np.asarray(arr)
+        self._fetch = lambda arr, issued: np.asarray(arr)  # vtpu: allow(jax-hygiene) — THE designated harvest sync
         self.steps = 0  # decode forwards executed (batch-wide)
         self._row_tmpls: Dict[int, dict] = {}  # rows → zero prefill cache
 

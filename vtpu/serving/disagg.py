@@ -38,6 +38,9 @@ admission would have published) — pinned by tests/test_disagg.py's
 fuzz matrix.  docs/serving.md describes the full topology.
 """
 
+# vtpu: hot-path — the decode/admission loops below promise zero host
+# syncs; make check (jax-hygiene) flags block_until_ready/device fetches
+# here, and the deliberate sync points carry vtpu: allow pragmas.
 from __future__ import annotations
 
 import collections
@@ -51,6 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from vtpu.analysis.witness import make_lock
 from vtpu.models.transformer import TransformerLM, _zero_cache, bucket_length
 from vtpu.ops.quant import dequantize_tree
 from vtpu.serving import batcher as _batcher
@@ -119,7 +123,7 @@ class HostExtract:
         if self._np is None:
             # the async copy was issued at construction; this is a
             # cheap view by the time ready_blocks() said go
-            self._np = [np.asarray(leaf) for leaf in self._dev]
+            self._np = [np.asarray(leaf) for leaf in self._dev]  # vtpu: allow(jax-hygiene) — extract's one D2H
         return b"".join(
             np.ascontiguousarray(leaf[lo:hi]).tobytes()
             for leaf in self._np
@@ -204,7 +208,7 @@ class PrefillEngine:
         # value-correct at any time — only the dispatches need mutual
         # exclusion, and both return async, so the fence costs dispatch
         # time, never compute.
-        self._dispatch_lock = threading.Lock()
+        self._dispatch_lock = make_lock("serving.dispatch")
         self.queue: collections.deque = collections.deque()
         self._rids: set = set()
         self.prefills = 0  # finished prefills (scrape-friendly)
@@ -361,7 +365,7 @@ class PrefillEngine:
                     toks, lens,
                 )
                 self._restore_pools(new_pools)
-            vals = np.asarray(firsts)
+            vals = np.asarray(firsts)  # vtpu: allow(jax-hygiene) — prefill first-token harvest
             for r, (rid, p, num_new, t0, blocks) in enumerate(sub):
                 handle = self.pool.detach(blocks, seq_len=int(p.size))
                 out.append(PrefillResult(rid, int(vals[r]), handle,

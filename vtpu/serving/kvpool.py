@@ -31,10 +31,10 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import threading
 import uuid
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from vtpu.analysis.witness import make_lock
 from vtpu import obs
 
 _REG = obs.registry("serving")
@@ -151,7 +151,7 @@ class BlockPool:
         self.pool_id = pool_id or f"pool-{uuid.uuid4().hex[:12]}"
         self.total_blocks = total_blocks
         self.block_size = block_size
-        self._lock = threading.RLock()
+        self._lock = make_lock("serving.kvpool", reentrant=True)
         self.free: collections.deque[int] = collections.deque(
             range(1, total_blocks)
         )

@@ -42,6 +42,9 @@ solo b=1 ``generate()`` can differ on argmax ties — batched matmuls
 reduce in a different order, a property of batching itself, not of
 paging."""
 
+# vtpu: hot-path — the decode/admission loops below promise zero host
+# syncs; make check (jax-hygiene) flags block_until_ready/device fetches
+# here, and the deliberate sync points carry vtpu: allow pragmas.
 from __future__ import annotations
 
 import collections

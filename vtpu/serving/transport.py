@@ -65,6 +65,7 @@ import uuid
 import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
+from vtpu.analysis.witness import make_lock
 from vtpu import obs
 from vtpu.serving.kvpool import (
     HANDOFF_HOST_BYTES,
@@ -337,7 +338,7 @@ class ReceiverHub:
             collections.OrderedDict()
         )
         self._stamp_cap = stamp_cap or DEFAULT_STAMP_CAP
-        self._lock = threading.RLock()
+        self._lock = make_lock("serving.receiver_hub", reentrant=True)
 
     # -- bookkeeping ----------------------------------------------------
     def _set_credit_gauge(self) -> None:
@@ -579,7 +580,7 @@ class HttpKVLink:
             )
         self._host = u.hostname or "127.0.0.1"
         self._port = u.port or 80
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.kvlink_pool")
         self._idle: collections.deque = collections.deque()
 
     def _acquire(self, fresh: bool):

@@ -33,6 +33,8 @@ import sys
 import time
 import uuid
 
+from vtpu.utils.envs import env_float, env_int, env_require, env_str
+
 
 def _register_backend() -> None:
     """Point JAX at the interposer BEFORE first backend touch.
@@ -40,11 +42,11 @@ def _register_backend() -> None:
     VTPU_TENANT_SHIM=0 loads the REAL plugin instead — the unshimmed
     control arm of the benchmark's exclusive baseline (same process
     shape, no interposer in the path)."""
-    if os.environ.get("VTPU_TENANT_SHIM") == "0":
-        shim = os.environ["VTPU_REAL_PJRT_PLUGIN"]
+    if env_str("VTPU_TENANT_SHIM") == "0":
+        shim = env_require("VTPU_REAL_PJRT_PLUGIN")
     else:
-        shim = os.environ["VTPU_SHIM_SO"]
-    if os.environ.get("VTPU_TENANT_AXON") == "1":
+        shim = env_require("VTPU_SHIM_SO")
+    if env_str("VTPU_TENANT_AXON") == "1":
         # this image reaches its TPU through the axon relay; re-run the
         # relay's registration with our shim as the library JAX loads —
         # the shim forwards the whole PJRT_Api (incl. create_options) to
@@ -59,7 +61,7 @@ def _register_backend() -> None:
             None,
             f"{gen}:1x1x1",
             so_path=shim,
-            session_id=os.environ.get("VTPU_TENANT_SESSION") or str(uuid.uuid4()),
+            session_id=env_str("VTPU_TENANT_SESSION") or str(uuid.uuid4()),
             remote_compile=os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1",
         )
     else:
@@ -71,14 +73,14 @@ def _register_backend() -> None:
 
 
 def _barrier() -> None:
-    bdir = os.environ.get("VTPU_TENANT_BARRIER")
+    bdir = env_str("VTPU_TENANT_BARRIER")
     if not bdir:
         return
     open(os.path.join(bdir, f"ready_{os.getpid()}"), "w").close()
     go = os.path.join(bdir, "go")
     # must outlast the orchestrator's all-tenants-ready window (900 s) —
     # peers may still be compiling long after this tenant is ready
-    limit = float(os.environ.get("VTPU_TENANT_BARRIER_TIMEOUT", "960") or 960)
+    limit = env_float("VTPU_TENANT_BARRIER_TIMEOUT", 960.0)
     deadline = time.monotonic() + limit
     while not os.path.exists(go):
         if time.monotonic() > deadline:
@@ -148,7 +150,7 @@ def _oversub_manual(platform: str, host_params, d: int, batch: int,
 
     head, loss = train_step(head)
     jax.block_until_ready(loss)  # compile outside the window
-    seconds = float(os.environ.get("VTPU_TENANT_SECONDS", "10") or 10)
+    seconds = env_float("VTPU_TENANT_SECONDS", 10.0)
     count = 0
     t0 = time.monotonic()
     while time.monotonic() - t0 < seconds:
@@ -177,8 +179,8 @@ def _oversub_main(dev, platform: str) -> None:
     import jax
     import jax.numpy as jnp
 
-    n_layers = int(os.environ.get("VTPU_OVERSUB_LAYERS", "32"))
-    d = int(os.environ.get("VTPU_OVERSUB_DIM", "2048"))
+    n_layers = env_int("VTPU_OVERSUB_LAYERS", 32)
+    d = env_int("VTPU_OVERSUB_DIM", 2048)
     batch = 256
     rng = np.random.default_rng(0)
     host_params = [
@@ -186,7 +188,7 @@ def _oversub_main(dev, platform: str) -> None:
         for _ in range(n_layers)
     ]
     params_mb = n_layers * d * d * 4 >> 20
-    if os.environ.get("VTPU_OVERSUB_MANUAL") == "1":
+    if env_str("VTPU_OVERSUB_MANUAL") == "1":
         _oversub_manual(platform, host_params, d, batch, params_mb)
         return
     try:
@@ -220,7 +222,7 @@ def _oversub_main(dev, platform: str) -> None:
     head, loss = train_step(head, frozen, x)
     jax.block_until_ready(loss)  # compile outside the window
 
-    seconds = float(os.environ.get("VTPU_TENANT_SECONDS", "10") or 10)
+    seconds = env_float("VTPU_TENANT_SECONDS", 10.0)
     count = 0
     t0 = time.monotonic()
     while time.monotonic() - t0 < seconds:
@@ -269,7 +271,7 @@ def _matrix_main(dev, platform: str) -> None:
 
     import vtpu
 
-    name, batch_s, mode = os.environ["VTPU_TENANT_MATRIX_SPEC"].split(":")
+    name, batch_s, mode = env_require("VTPU_TENANT_MATRIX_SPEC").split(":")
     batch = int(batch_s)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(vtpu.__file__)))
     spec = importlib.util.spec_from_file_location(
@@ -278,7 +280,7 @@ def _matrix_main(dev, platform: str) -> None:
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    seconds = float(os.environ.get("VTPU_TENANT_SECONDS", "10") or 10)
+    seconds = env_float("VTPU_TENANT_SECONDS", 10.0)
     violations = 0
     rate = 0.0
     try:
@@ -329,7 +331,7 @@ def main() -> None:
     inited = threading.Event()
 
     def watchdog():
-        timeout = float(os.environ.get("VTPU_TENANT_INIT_TIMEOUT", "300"))
+        timeout = env_float("VTPU_TENANT_INIT_TIMEOUT", 300.0)
         if not inited.wait(timeout):
             from vtpu import obs
 
@@ -359,11 +361,11 @@ def main() -> None:
     dev = jax.devices()[0]
     inited.set()
     platform = dev.platform
-    if os.environ.get("VTPU_TENANT_MODE") == "oversub":
+    if env_str("VTPU_TENANT_MODE") == "oversub":
         _barrier()
         _oversub_main(dev, platform)
         return
-    if os.environ.get("VTPU_TENANT_MATRIX_SPEC"):
+    if env_str("VTPU_TENANT_MATRIX_SPEC"):
         _matrix_main(dev, platform)
         return
     if platform == "cpu":
@@ -392,7 +394,7 @@ def main() -> None:
     # measures CHIP sharing, not dispatch sharing.  The loop carry feeds
     # each iteration (images scaled by a ~0 term) so XLA cannot hoist the
     # loop-invariant network out of the loop.
-    scan_k = int(os.environ.get("VTPU_TENANT_SCAN_STEPS", "1") or 1)
+    scan_k = env_int("VTPU_TENANT_SCAN_STEPS", 1)
     if scan_k > 1:
 
         @jax.jit
@@ -433,8 +435,8 @@ def main() -> None:
     # hides), so the tenant must pipeline exactly like the baseline.
     import threading
 
-    seconds = float(os.environ.get("VTPU_TENANT_SECONDS", "10") or 10)
-    n_streams = int(os.environ.get("VTPU_TENANT_STREAMS", "4") or 4)
+    seconds = env_float("VTPU_TENANT_SECONDS", 10.0)
+    n_streams = env_int("VTPU_TENANT_STREAMS", 4)
     counts = [0] * n_streams
     viols = [0] * n_streams
     errors = []

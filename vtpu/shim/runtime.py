@@ -24,6 +24,7 @@ from vtpu.monitor.shared_region import (
     open_region,
 )
 from vtpu.utils import trace
+from vtpu.utils.envs import env_int, env_str
 
 log = logging.getLogger(__name__)
 
@@ -140,14 +141,12 @@ class ShimRuntime:
         self.oversubscribe = (
             oversubscribe
             if oversubscribe is not None
-            else os.environ.get("VTPU_OVERSUBSCRIBE") == "true"
+            else env_str("VTPU_OVERSUBSCRIBE") == "true"
         )
         # kill the tenant on quota reject instead of raising an error it
         # may swallow and retry forever (ref ACTIVE_OOM_KILLER,
         # docs/config.md container envs; enforced in libvgpu.so)
-        self.active_oom_killer = (
-            os.environ.get("VTPU_ACTIVE_OOM_KILLER") == "true"
-        )
+        self.active_oom_killer = env_str("VTPU_ACTIVE_OOM_KILLER") == "true"
         self.priority = (
             priority
             if priority is not None
@@ -161,14 +160,14 @@ class ShimRuntime:
         # forwarded its span context through the env ABI, so shim startup
         # shows up on /timeline under the same trace id as filter/bind
         with trace.span(
-            "shim.init", ctx=os.environ.get("VTPU_TRACE_CONTEXT"),
+            "shim.init", ctx=env_str("VTPU_TRACE_CONTEXT") or None,
             tenant_pid=self.pid,
         ):
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             self.region: Optional[RegionFile] = open_region(path, create=True)
             if self.region is not None:
                 names = uuids or (
-                    os.environ.get("VTPU_VISIBLE_UUIDS", "tpu-0").split(",")
+                    env_str("VTPU_VISIBLE_UUIDS", "tpu-0").split(",")
                 )
                 self.region.set_devices(
                     names,
@@ -181,7 +180,7 @@ class ShimRuntime:
         # span feed out of the container: the plugin's Allocate forwards
         # VTPU_SPAN_SINK alongside the trace context, so the shim.init
         # span (and everything later) reaches /timeline on the collector
-        self._span_sink = os.environ.get("VTPU_SPAN_SINK", "")
+        self._span_sink = env_str("VTPU_SPAN_SINK")
         self._push_spans()
         # local (per-tenant) accounting mirrors the region
         self._local: Dict[int, int] = {}
@@ -195,9 +194,7 @@ class ShimRuntime:
         # and time ONE synchronous step — the TRUE device-resident step
         # time (JAX dispatch is async — enqueue latency alone collapses
         # toward 0 and would make core-percentage pacing a no-op)
-        self._sync_base = max(
-            1, int(os.environ.get("VTPU_PACE_SYNC_EVERY", "8") or 8)
-        )
+        self._sync_base = max(1, env_int("VTPU_PACE_SYNC_EVERY", 8))
         # adaptive interval: a STABLE workload stops paying the drain —
         # each calibration that lands within 20% of the previous one
         # doubles the interval (up to VTPU_PACE_SYNC_MAX, default 8×
@@ -205,10 +202,7 @@ class ShimRuntime:
         # changes re-calibrate quickly
         self._sync_max = max(
             self._sync_base,
-            int(
-                os.environ.get("VTPU_PACE_SYNC_MAX", str(8 * self._sync_base))
-                or 8 * self._sync_base
-            ),
+            env_int("VTPU_PACE_SYNC_MAX", 8 * self._sync_base),
         )
         self._sync_every = self._sync_base
         self._since_sync = 0

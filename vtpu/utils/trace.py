@@ -38,10 +38,13 @@ import threading
 import time
 from typing import Deque, Dict, Iterable, Iterator, List, Optional, Tuple
 
+from vtpu.utils.envs import env_str
+from vtpu.analysis.witness import make_lock
+
 log = logging.getLogger("vtpu.trace")
 
 _RING_SIZE = 2048
-_lock = threading.Lock()
+_lock = make_lock("obs.trace")
 _spans: Deque[dict] = collections.deque(maxlen=_RING_SIZE)
 _seen_ids: set = set()  # (proc, span_id) of everything in/through the ring
 _enabled: Optional[bool] = None  # None ⇒ read env lazily
@@ -73,7 +76,7 @@ def tracing(on: Optional[bool] = None) -> bool:
     if on is not None:
         _enabled = bool(on)
     if _enabled is None:
-        _enabled = os.environ.get("VTPU_TRACE", "") not in ("", "0", "false")
+        _enabled = env_str("VTPU_TRACE") not in ("", "0", "false")
     return _enabled
 
 

@@ -49,10 +49,15 @@ class annotations:
     # VTPU_EVICT_AFTER_S; value "<reason>_<unix ts>".  The scheduler's
     # reconciler turns it into a pod delete and releases the overlay.
     EVICT_REQUESTED = "vtpu.io/evict-requested"
-    # -- pod: gang membership marker (full spec keys live in
-    # vtpu/scheduler/gang.py; the key is mirrored here so the QoS
-    # resolver below can see gang membership without importing it)
+    # -- pod: gang spec (parsed by vtpu/scheduler/gang.py; the keys live
+    # here with every other annotation key — the annotation-keys pass of
+    # `make check` enforces that no component spells one out locally)
     GANG_NAME = "vtpu.io/gang-name"
+    GANG_SIZE = "vtpu.io/gang-size"
+    GANG_MESH = "vtpu.io/gang-mesh"
+    # -- pod: per-pod ICI allocation policy override (ring | compact |
+    # best-effort), read by the filter's rectangle chooser
+    ICI_POLICY = "vtpu.io/ici-policy"
     # -- node: registry + handshake (per device vendor; TPU is the primary)
     NODE_HANDSHAKE = "vtpu.io/node-handshake-tpu"  # ref 4pd.io/node-handshake
     NODE_REGISTER = "vtpu.io/node-tpu-register"    # ref 4pd.io/node-nvidia-register
@@ -68,6 +73,12 @@ class annotations:
     # "hbm_peak":...}}}, patched rate-limited + delta-gated by the
     # monitor's UtilizationSampler, ingested by the scheduler's UsageCache
     NODE_UTILIZATION = "vtpu.io/node-utilization"
+    # -- node: physical host-grid coordinate "x,y" for cross-host slice
+    # planning (consumed by vtpu/device/slice.py; absent = linear chain)
+    HOST_COORD = "vtpu.io/host-coord"
+    # -- node (election): the sharded extender's annotation lease,
+    # CAS-renewed on a dedicated election Node (vtpu/scheduler/shard.py)
+    SCHEDULER_LEADER = "vtpu.io/scheduler-leader"
     # -- node: distributed mutex (ref 4pd.io/mutex.lock, pkg/util/nodelock.go)
     NODE_LOCK = "vtpu.io/mutex.lock"
     # -- webhook escape hatch (ref charts/.../webhook.yaml:16-29 label)
