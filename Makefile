@@ -214,7 +214,11 @@ else
 endif
 
 # prefill/decode disaggregation proof: real-topology token-exactness +
-# zero-host-copy handoff check, then monolithic vs 1/2/4-decode-replica
+# zero-host-copy handoff check, the wire transport under BOTH chunk
+# codecs (fp32 + negotiated int8: ≥3.5× fewer wire bytes, hidden
+# fraction held), a high-fanout shared-prefix phase (speculative
+# adoption first-token latency + prefix-cache recompute skipping),
+# then monolithic vs 1/2/4-decode-replica
 # arms on per-role virtual device clocks charged with measured costs of
 # the real compiled programs; refreshes docs/artifacts/serving_disagg.json
 # (docs/serving.md#benchmark explains the numbers).  SMOKE=1 runs a

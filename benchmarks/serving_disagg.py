@@ -149,18 +149,23 @@ def _overlap(lo, hi, spans):
     return got
 
 
-def run_wire(n_requests: int, smoke: bool) -> dict:
+def run_wire(n_requests: int, smoke: bool, codec: str = "fp32") -> dict:
     """Real engines, real frames: a PrefillEngine feeds a DecodeEngine
     through the chunked wire transport (loopback link — the same frames
-    HttpKVLink ships).  Measures (a) token-exactness vs monolithic,
-    (b) real payload bytes on the wire, (c) the HIDDEN FRACTION — how
-    much of each stream's open→FIN wall time overlaps prefill compute:
-    the stream opens right after its own prefill group, its D2H rides
-    behind the NEXT group's fused program, and its chunks push after
-    that program retires, so a healthy transport lives almost entirely
-    under compute.  Then the mid-stream-death fuzz matrix: torn links
-    (first chunk, mid-stream, every-frame/retries-exhausted) and a
-    receiver-side abort must leave BOTH pools leak-free."""
+    HttpKVLink ships).  Measures (a) token-exactness vs monolithic
+    (the fp32 codec; the int8 codec reports a greedy token-match
+    fraction + the per-element error bound instead — quantized K/V is
+    close, not exact), (b) real payload bytes on the wire (the
+    fp32/int8 byte ratio is the codec's compression), (c) the HIDDEN
+    FRACTION — how much of each stream's open→FIN wall time overlaps
+    prefill compute: the stream opens right after its own prefill
+    group, its D2H rides behind the NEXT group's fused program, and its
+    chunks push after that program retires, so a healthy transport
+    lives almost entirely under compute.  Then the mid-stream-death
+    fuzz matrix: torn links (first chunk, mid-stream,
+    every-frame/retries-exhausted) and a receiver-side abort must leave
+    BOTH pools leak-free — including the speculative-adoption rollback
+    (slot freed, early first token retracted)."""
     import threading
 
     import numpy as np
@@ -171,6 +176,7 @@ def run_wire(n_requests: int, smoke: bool) -> dict:
     from vtpu.models.transformer import TransformerLM
     from vtpu.serving import kvpool
     from vtpu.serving import transport as tp
+    from vtpu.serving import wirecodec
     from vtpu.serving.disagg import DecodeEngine, PrefillEngine
     from vtpu.serving.paged import PagedBatcher
 
@@ -199,7 +205,7 @@ def run_wire(n_requests: int, smoke: bool) -> dict:
                        replica_id="w0")
     hub = tp.ReceiverHub(dec)
     rep = tp.WireReplica(tp.LoopbackLink(hub), "w0", local=dec,
-                         chunk_blocks=4)
+                         chunk_blocks=4, codec=codec)
 
     def drive(requests, per_round=1, measure=None):
         """Open-loop drive, a few prompts per round: the overlap claim
@@ -323,7 +329,8 @@ def run_wire(n_requests: int, smoke: bool) -> dict:
 
         def fault(data):
             fr = tp.decode_frame(data)
-            if fr.kind != tp.KIND_DATA or fr.seq == 0:
+            if fr.kind not in (tp.KIND_DATA, tp.KIND_DATA_QUANT) \
+                    or fr.seq == 0:
                 return
             if kind == "first_chunk" and fr.seq == 1 and state["n"] == 0:
                 state["n"] += 1
@@ -335,7 +342,8 @@ def run_wire(n_requests: int, smoke: bool) -> dict:
                 raise OSError("torn")
 
         repx = tp.WireReplica(tp.LoopbackLink(hubx, fault=fault), "wx",
-                              local=decx, chunk_blocks=1, retries=2)
+                              local=decx, chunk_blocks=1, retries=2,
+                              codec=codec)
         pfx.submit("rx", rng.integers(0, 128, 40).astype(np.int32), 4)
         res = pfx.step()[0]
         try:
@@ -356,16 +364,27 @@ def run_wire(n_requests: int, smoke: bool) -> dict:
         # drain whatever survived so slot-held blocks retire
         while any(decx.active) or decx._inflight or decx.queue:
             decx.step()
-        return leak_free(pfx.pool) and leak_free(decx.pool)
+        # a dead stream's speculative reservation must be fully rolled
+        # back too: no reserved slot survives the fuzz
+        return (leak_free(pfx.pool) and leak_free(decx.pool)
+                and not decx._spec_slots)
 
     fuzz_kinds = ["first_chunk", "mid_stream", "every_frame",
                   "receiver_abort"]
     fuzz = {k: one_death(k) for k in fuzz_kinds}
 
     bytes_moved = int(tp.TRANSPORT_BYTES.value() - b0)
+    matched = sum(
+        sum(a == b for a, b in zip(got.get(rid, []), toks))
+        for rid, toks in want.items()
+    )
+    total_toks = sum(len(t) for t in want.values())
     res = {
         "requests": n_requests,
+        "codec": codec,
         "token_exact": got == want,
+        "token_match_fraction": round(matched / max(1, total_toks), 4),
+        "quant_error_bound": round(wirecodec.error_bound(dec.wire_quant_max_scale), 6),
         "bytes_on_wire": bytes_moved,
         "chunks": int(tp.TRANSPORT_CHUNKS.value() - c0),
         "streams": len(streams),
@@ -380,6 +399,176 @@ def run_wire(n_requests: int, smoke: bool) -> dict:
         "death_fuzz": {**fuzz, "leak_free_all": all(fuzz.values())},
     }
     return res
+
+
+# ---------------------------------------------------------------------------
+# Phase 1.75: high-fanout shared-prefix workload (codec × prefix cache)
+# ---------------------------------------------------------------------------
+
+def run_shared_prefix(smoke: bool) -> dict:
+    """Real engines over the wire transport serving a high-fanout
+    shared-prefix stream: every session's prompt opens with the same
+    64-token system prefix (4 full blocks) plus a unique suffix.  Four
+    arms on identical request streams:
+
+    - ``fp32_nospec`` — the PR 10 baseline: raw chunks, first token
+      waits for FIN.
+    - ``fp32`` — speculative adoption + the prefix cache, token-exact.
+    - ``int8`` — quantized chunks + speculation (match fraction
+      reported with the per-element error bound).
+    - ``int8_prefix`` — the full stack: quantized wire + speculative
+      adoption + prefix-cache recompute skipping.
+
+    Per arm: wire bytes, first-token latency (submit → the token is
+    host-visible at the decode replica), aggregate tokens/s, prefix
+    hits / prompt tokens skipped, and exactness vs a monolithic
+    PagedBatcher that recomputes everything."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from vtpu.models.transformer import TransformerLM
+    from vtpu.serving import kvpool
+    from vtpu.serving import transport as tp
+    from vtpu.serving import wirecodec
+    from vtpu.serving.disagg import DecodeEngine, PrefillEngine
+    from vtpu.serving.paged import PagedBatcher
+
+    kw = dict(vocab=128, d_model=192, depth=2, num_heads=4, max_seq=128)
+    bs = 16
+    m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=bs,
+                      kv_pool_blocks=257)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"]
+    rng = np.random.default_rng(13)
+    prefix = rng.integers(0, 128, 64).astype(np.int32)  # 4 full blocks
+    n_sessions = 6 if smoke else 20
+    sufs = [5, 9, 13, 7, 11, 15]
+    reqs = []
+    for i in range(n_sessions):
+        suffix = rng.integers(0, 128, sufs[i % len(sufs)]).astype(
+            np.int32)
+        reqs.append((f"f{i}", np.concatenate([prefix, suffix]),
+                     4 + (i % 3)))
+
+    mono = PagedBatcher(m, params, max_batch=8, eos_id=2)
+    for rid, p, n in reqs:
+        mono.submit(rid, p, num_new=n)
+    want = mono.run()
+    total_toks = sum(len(t) for t in want.values())
+
+    arms_cfg = [
+        ("fp32_nospec", dict(codec="fp32", spec=False, prefix=False)),
+        ("fp32", dict(codec="fp32", spec=True, prefix=True)),
+        ("int8", dict(codec="int8", spec=True, prefix=False)),
+        ("int8_prefix", dict(codec="int8", spec=True, prefix=True)),
+    ]
+    arms = {}
+    for name, cfg in arms_cfg:
+        pf = PrefillEngine(m, params, prefix_cache=cfg["prefix"])
+        dec = DecodeEngine(m, params, max_batch=8, eos_id=2,
+                           replica_id="sp0", speculative=cfg["spec"])
+        hub = tp.ReceiverHub(dec)
+        rep = tp.WireReplica(tp.LoopbackLink(hub), "sp0", local=dec,
+                             chunk_blocks=4, codec=cfg["codec"])
+        t_submit, t_first = {}, {}
+
+        def check_first():
+            for rid in dec.out:
+                if rid in t_submit and rid not in t_first:
+                    t_first[rid] = time.perf_counter()
+
+        def drive(requests, measure):
+            staging = list(requests)
+            # the FIRST request drains alone so its prefix registers
+            # before the fanout arrives (same-round admissions can't
+            # share a registration made within their own round)
+            per_round = 1
+            while (staging or pf.queue or rep.idle_senders()
+                   or dec.queue or any(dec.active) or dec._inflight):
+                for rid, p, n in staging[:per_round]:
+                    pf.submit(rid, p, num_new=n)
+                    if measure:
+                        t_submit[rid] = time.perf_counter()
+                del staging[:per_round]
+                per_round = 2
+                for res in pf.step():
+                    rep.submit_handle(res.rid, res.handle,
+                                      res.first_token, res.num_new,
+                                      source=pf,
+                                      submitted=res.submitted,
+                                      admit=False)
+                    check_first()   # speculative arms publish at OPEN
+                stalls = 0
+                while rep.idle_senders():
+                    before = tp.TRANSPORT_CHUNKS.value()
+                    rep.pump_streams()
+                    check_first()
+                    if (rep.idle_senders()
+                            and tp.TRANSPORT_CHUNKS.value() == before):
+                        dec.step()   # starved: retire slots → credits
+                        stalls += 1
+                        if stalls > 10000:
+                            raise RuntimeError(
+                                "shared-prefix arm wedged")
+                dec.step()
+                check_first()
+
+        # warmup with a DIFFERENT prefix: mirrors the measured stream's
+        # round structure (seed alone, then pairs over the full suffix-
+        # length cycle) so every program shape on the arm's path
+        # (suffix buckets × row counts, wire put, adoption bind)
+        # compiles before the measured first-token latencies start
+        warm_prefix = rng.integers(0, 128, 64).astype(np.int32)
+        warm = [(f"warm{name}{i}",
+                 np.concatenate([warm_prefix, rng.integers(
+                     0, 128, sufs[i % len(sufs)]).astype(np.int32)]),
+                 4 + (i % 3)) for i in range(7)]
+        drive(warm, measure=False)
+        b0 = tp.TRANSPORT_BYTES.value()
+        s0 = kvpool.SPEC_ADOPTIONS.value()
+        h0, k0 = pf.prefix_hits, pf.prefix_tokens_skipped
+        t0_all = time.perf_counter()
+        drive(reqs, measure=True)
+        dec._flush_first_tokens()
+        makespan = time.perf_counter() - t0_all
+        got = {rid: toks for rid, toks in dec.out.items()
+               if rid in t_submit}
+        matched = sum(
+            sum(a == b for a, b in zip(got.get(rid, []), toks))
+            for rid, toks in want.items()
+        )
+        ftl = [1e3 * (t_first[rid] - t_submit[rid])
+               for rid in t_first]
+        arms[name] = {
+            **cfg,
+            "requests": len(reqs),
+            "token_exact": got == want,
+            "token_match_fraction": round(
+                matched / max(1, total_toks), 4),
+            "quant_error_bound": round(
+                wirecodec.error_bound(dec.wire_quant_max_scale), 6),
+            "bytes_on_wire": int(tp.TRANSPORT_BYTES.value() - b0),
+            "first_token_ms_mean": round(sum(ftl) / max(1, len(ftl)), 3),
+            "first_token_ms_p50": round(pct(ftl, 0.50), 3),
+            "first_token_ms_p99": round(pct(ftl, 0.99), 3),
+            "tokens_per_s": round(total_toks / max(1e-9, makespan), 1),
+            "speculative_adoptions": int(
+                kvpool.SPEC_ADOPTIONS.value() - s0),
+            "prefix_hits": pf.prefix_hits - h0,
+            "prefix_tokens_skipped": pf.prefix_tokens_skipped - k0,
+            "pools_leak_free": (
+                pf.pool.stats()["leased"]
+                == pf.pool.stats()["prefix_blocks"]
+                and dec.pool.stats()["leased"] == 0
+            ),
+        }
+    return {
+        "config": {"model": kw, "block_size": bs,
+                   "prefix_tokens": 64, "sessions": n_sessions},
+        "arms": arms,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -851,6 +1040,49 @@ def main(argv=None) -> int:
               f"compute (< 80%)", file=sys.stderr)
         return 1
 
+    print("[bench-disagg] phase 1.6: wire transport, int8 codec…",
+          file=sys.stderr, flush=True)
+    wire_int8 = run_wire(8 if smoke else 24, smoke, codec="int8")
+    if not wire_int8["pools_leak_free"] or not wire_int8["death_fuzz"][
+            "leak_free_all"]:
+        print("bench-disagg: int8 wire transport leaked blocks",
+              file=sys.stderr)
+        return 1
+    if not wire_int8["host_bytes_accounted"]:
+        print("bench-disagg: int8 wire host bytes not accounted",
+              file=sys.stderr)
+        return 1
+    reduction = (wire["bytes_on_wire"]
+                 / max(1, wire_int8["bytes_on_wire"]))
+    if reduction < 3.5:
+        print(f"bench-disagg: int8 codec wire-byte reduction only "
+              f"{reduction:.2f}x (< 3.5x)", file=sys.stderr)
+        return 1
+    if not smoke and wire_int8["hidden_fraction"] < 0.8:
+        print(f"bench-disagg: int8 wire hidden fraction "
+              f"{wire_int8['hidden_fraction']:.0%} regressed below 80%",
+              file=sys.stderr)
+        return 1
+
+    print("[bench-disagg] phase 1.75: shared-prefix fanout…",
+          file=sys.stderr, flush=True)
+    shared_prefix = run_shared_prefix(smoke)
+    spa = shared_prefix["arms"]
+    if not (spa["fp32"]["token_exact"]
+            and spa["fp32_nospec"]["token_exact"]):
+        print("bench-disagg: fp32 shared-prefix arm diverged from "
+              "monolithic", file=sys.stderr)
+        return 1
+    if (spa["fp32"]["prefix_hits"] < 1
+            or spa["fp32"]["prefix_tokens_skipped"] <= 0):
+        print("bench-disagg: prefix cache never hit in the "
+              "shared-prefix arm", file=sys.stderr)
+        return 1
+    if not all(a["pools_leak_free"] for a in spa.values()):
+        print("bench-disagg: shared-prefix arm leaked blocks",
+              file=sys.stderr)
+        return 1
+
     print("[bench-disagg] phase 2: calibrating program costs…",
           file=sys.stderr, flush=True)
     units = calibrate(ROWS_SMOKE if smoke else ROWS_FULL,
@@ -877,6 +1109,16 @@ def main(argv=None) -> int:
         ),
         "wire_hidden_fraction": wire["hidden_fraction"],
         "wire_bytes": wire["bytes_on_wire"],
+        "int8_wire_byte_reduction_x": round(reduction, 2),
+        "int8_hidden_fraction": wire_int8["hidden_fraction"],
+        "int8_token_match_fraction": wire_int8["token_match_fraction"],
+        "int8_quant_error_bound": wire_int8["quant_error_bound"],
+        "prefix_hits": spa["int8_prefix"]["prefix_hits"],
+        "prefix_tokens_skipped": spa["int8_prefix"][
+            "prefix_tokens_skipped"],
+        "ftl_ms_baseline_fp32_nospec": spa["fp32_nospec"][
+            "first_token_ms_mean"],
+        "ftl_ms_speculative_fp32": spa["fp32"]["first_token_ms_mean"],
         "dyn_mean_prefill_devices": arms["disagg_dyn"][
             "prefill_scale"]["mean_active"],
     }
@@ -901,6 +1143,8 @@ def main(argv=None) -> int:
         },
         "exactness": exact,
         "wire": wire,
+        "wire_int8": wire_int8,
+        "shared_prefix": shared_prefix,
         "units": {k: round(v, 6) for k, v in units.items()},
         "arms": arms,
         "headline": headline,

@@ -4,7 +4,14 @@ two hand-picked cases; these generate thousands."""
 
 import string
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis",
+    reason="property fuzz needs hypothesis; the example-based codec "
+           "suite (tests/test_codec.py) covers the wire contract",
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from vtpu.utils import codec
 from vtpu.utils.types import ChipInfo, ContainerDevice

@@ -325,3 +325,293 @@ def test_purge_pending_frees_claimed_entry(model_and_params):
     dec._flush_first_tokens()
     assert len(dec.out["r0"]) >= 1
     assert _leak_free(pf.pool)
+
+
+# ---------------------------------------------------------------------------
+# speculative adoption (wire streams bind their slot + first token at OPEN)
+# ---------------------------------------------------------------------------
+
+def test_speculative_adoption_publishes_first_token_before_fin(
+        model_and_params):
+    """The OPEN reserves a slot and publishes the prefill's first token
+    immediately — first-token latency stops waiting for the stream —
+    and the finished stream is token-exact vs monolithic."""
+    from vtpu.serving import transport as tp
+
+    m, params = model_and_params
+    reqs = fuzz_requests(seed=31, n=6)
+    want = run_monolithic(m, params, reqs)
+    pf = PrefillEngine(m, params)
+    dec = DecodeEngine(m, params, max_batch=4, eos_id=2)
+    hub = tp.ReceiverHub(dec)
+    rep = tp.WireReplica(tp.LoopbackLink(hub), "w0", local=dec,
+                         chunk_blocks=1)
+    s0 = kvpool.SPEC_ADOPTIONS.value()
+    pf.submit(*reqs[0][:2], reqs[0][2])
+    res = pf.step()[0]
+    rep.submit_handle(res.rid, res.handle, res.first_token,
+                      res.num_new, source=pf, admit=False)
+    # stream OPENed but not one chunk pumped: the token is already out
+    assert dec.out[res.rid] == [res.first_token]
+    assert kvpool.SPEC_ADOPTIONS.value() == s0 + 1
+    assert len(dec._spec_slots) == 1
+    # remaining requests flow through the same path to completion
+    for rid, p, n in reqs[1:]:
+        pf.submit(rid, p, n)
+    for r in pf.run():
+        rep.submit_handle(r.rid, r.handle, r.first_token, r.num_new,
+                          source=pf, admit=False)
+    while rep.idle_senders():
+        rep.step()
+    while any(dec.active) or dec._inflight or dec.queue:
+        dec.step()
+    dec._flush_first_tokens()
+    assert dec.out == want
+    assert not dec._spec_slots
+    assert _leak_free(pf.pool) and _leak_free(dec.pool)
+
+
+@pytest.mark.parametrize("torn", ["first_chunk", "mid_stream",
+                                  "every_frame"])
+@pytest.mark.parametrize("abort_timing", ["stream_death",
+                                          "receiver_abort"])
+def test_speculative_rollback_fuzz_leak_free(model_and_params, torn,
+                                             abort_timing):
+    """The acceptance fuzz: torn first/mid/every-frame × abort timing.
+    Every combination must roll the speculative reservation back —
+    first token retracted, slot freed, BOTH pools leak-free — and the
+    engine must keep serving afterwards."""
+    from vtpu.serving import transport as tp
+
+    m, params = model_and_params
+    pf = PrefillEngine(m, params)
+    dec = DecodeEngine(m, params, max_batch=4, eos_id=2)
+    hub = tp.ReceiverHub(dec)
+
+    def fault(data):
+        fr = tp.decode_frame(data)
+        if fr.kind not in (tp.KIND_DATA, tp.KIND_DATA_QUANT) \
+                or fr.seq == 0:
+            return
+        # PERSISTENT tears at the chosen offset: the resume budget
+        # (retries=2) exhausts and the stream must abort — a single
+        # transient tear just resumes, which the resume tests cover
+        if torn == "first_chunk" and fr.seq == 1:
+            raise OSError("torn")
+        if torn == "mid_stream" and fr.seq == 2:
+            raise OSError("torn")
+        if torn == "every_frame":
+            raise OSError("torn")
+
+    rep = tp.WireReplica(
+        tp.LoopbackLink(hub, fault=None if abort_timing
+                        == "receiver_abort" else fault),
+        "w0", local=dec, chunk_blocks=1, retries=2)
+    pf.submit("rx", np.arange(20, dtype=np.int32) % 64, 4)
+    res = pf.step()[0]
+    r0 = kvpool.SPEC_ROLLBACKS.value()
+    try:
+        rep.submit_handle(res.rid, res.handle, res.first_token,
+                          res.num_new, source=pf, admit=False)
+        assert "rx" in dec.out          # speculative publish at OPEN
+        if abort_timing == "receiver_abort":
+            hub.abort_all()             # replica death mid-adoption
+        while rep.idle_senders():
+            rep.pump_streams()
+    except tp.WireError:
+        pass
+    while any(dec.active) or dec._inflight or dec.queue:
+        dec.step()
+    assert "rx" not in dec.out          # the early token was retracted
+    assert not dec._spec_slots          # the reservation rolled back
+    assert kvpool.SPEC_ROLLBACKS.value() == r0 + 1
+    assert _leak_free(pf.pool) and _leak_free(dec.pool)
+    # the engine still serves: a fresh request decodes to completion
+    pf.submit("ry", np.arange(9, dtype=np.int32) % 64, 3)
+    res2 = pf.step()[0]
+    dec.submit_handle("ry", res2.handle, res2.first_token,
+                      res2.num_new, source=pf)
+    while any(dec.active) or dec._inflight or dec.queue:
+        dec.step()
+    dec._flush_first_tokens()
+    assert len(dec.out["ry"]) == 3
+    assert _leak_free(pf.pool) and _leak_free(dec.pool)
+
+
+# ---------------------------------------------------------------------------
+# quantized wire codec over real engines
+# ---------------------------------------------------------------------------
+
+def test_int8_wire_codec_end_to_end(model_and_params):
+    """int8-negotiated streams over real engines: fewer wire bytes than
+    the pool's raw encoding, the fused dequant-scatter adopts into real
+    slots, first tokens stay exact (they ride the handle, not the
+    codec), and pools come back leak-free.  Full-transcript exactness
+    is NOT claimed — the int8 arm reports a match fraction in the
+    bench, with the documented per-element error bound."""
+    from vtpu.serving import transport as tp
+
+    m, params = model_and_params
+    reqs = fuzz_requests(seed=17, n=8)
+    want = run_monolithic(m, params, reqs)
+    pf = PrefillEngine(m, params)
+    dec = DecodeEngine(m, params, max_batch=4, eos_id=2)
+    hub = tp.ReceiverHub(dec)
+    rep = tp.WireReplica(tp.LoopbackLink(hub), "w0", local=dec,
+                         chunk_blocks=2, codec="int8")
+    q0 = tp.CODEC_BYTES.value(codec="int8")
+    f0 = tp.CODEC_BYTES.value(codec="fp32")
+    for rid, p, n in reqs:
+        pf.submit(rid, p, n)
+    for r in pf.run():
+        rep.submit_handle(r.rid, r.handle, r.first_token, r.num_new,
+                          source=pf, admit=False)
+    while rep.idle_senders():
+        rep.step()
+    while any(dec.active) or dec._inflight or dec.queue:
+        dec.step()
+    dec._flush_first_tokens()
+    int8_bytes = tp.CODEC_BYTES.value(codec="int8") - q0
+    assert int8_bytes > 0
+    assert tp.CODEC_BYTES.value(codec="fp32") == f0   # nothing fp32
+    # every transcript has the right length and an exact first token
+    assert set(dec.out) == set(want)
+    matched = 0
+    for rid in want:
+        assert len(dec.out[rid]) == len(want[rid])
+        assert dec.out[rid][0] == want[rid][0]
+        matched += sum(a == b for a, b in zip(dec.out[rid], want[rid]))
+    total = sum(len(v) for v in want.values())
+    assert matched / total > 0.5   # int8 K/V stays close on this model
+    assert dec.wire_quant_max_scale > 0.0     # the error-bound input
+    assert _leak_free(pf.pool) and _leak_free(dec.pool)
+
+
+# ---------------------------------------------------------------------------
+# cluster-wide prefix cache (prefill recompute skipping)
+# ---------------------------------------------------------------------------
+
+def test_prefix_cache_skips_recompute_token_exact(model_and_params):
+    """Prompts sharing a block-aligned prefix: the second wave matches
+    the registry, prefills ONLY its suffix (position-rewind), and the
+    decoded transcripts stay token-exact vs a monolithic engine that
+    recomputes everything."""
+    m, params = model_and_params
+    rng = np.random.default_rng(41)
+    prefix = rng.integers(0, 64, 16).astype(np.int32)   # 2 full blocks
+    reqs = []
+    for i in range(6):
+        suffix = rng.integers(0, 64, 3 + (i % 3)).astype(np.int32)
+        reqs.append((f"s{i}", np.concatenate([prefix, suffix]), 3))
+    want = run_monolithic(m, params, reqs)
+    pf = PrefillEngine(m, params, prefix_cache=True)
+    dec = DecodeEngine(m, params, max_batch=4, eos_id=2)
+    h0 = kvpool.PREFIX_HITS.value()
+
+    def drive(batch):
+        for rid, p, n in batch:
+            pf.submit(rid, p, n)
+        while pf.queue or dec.queue or any(dec.active) or dec._inflight:
+            for res in pf.step():
+                dec.submit_handle(res.rid, res.handle, res.first_token,
+                                  res.num_new, source=pf)
+            dec.step()
+
+    # wave 1 registers the prefix; wave 2 (a later admission round)
+    # matches it — same-round prompts can't share a prefix registered
+    # within that round, exactly like the paged engine's matcher
+    drive(reqs[:2])
+    drive(reqs[2:])
+    dec._flush_first_tokens()
+    assert dec.out == want
+    # every wave-2 request hit the registry and skipped 2 blocks
+    assert pf.prefix_hits >= 4
+    assert pf.prefix_tokens_skipped >= 4 * 16
+    assert kvpool.PREFIX_HITS.value() > h0
+    assert pf.pool.stats()["prefix_runs"] >= 2   # both chain depths
+    # only the registry pins remain; per-request leases all released
+    st = pf.pool.stats()
+    assert st["leased"] == st["prefix_blocks"] == 2
+    assert dec.pool_stats()["leased"] == 0
+
+
+def test_prefix_registry_yields_under_lease_pressure(model_and_params):
+    """A tight pool with registry-pinned blocks: admission evicts LRU
+    runs instead of deadlocking on backpressure."""
+    m, params = model_and_params
+    tight = TransformerLM(**KW, kv_cache_layout="paged",
+                          kv_block_size=BS, kv_pool_blocks=9)
+    pf = PrefillEngine(tight, params, prefix_cache=True)
+    rng = np.random.default_rng(43)
+    e0 = kvpool.PREFIX_EVICTIONS.value()
+    outs = []
+    for i in range(4):  # distinct prompts: registry fills, then yields
+        p = rng.integers(0, 64, 17).astype(np.int32)
+        pf.submit(f"t{i}", p, 3)
+        res = pf.step()
+        assert len(res) == 1, "admission must not wedge on pinned blocks"
+        outs.append(res[0])
+        pf.pool.release_handle(res[0].handle)
+    assert kvpool.PREFIX_EVICTIONS.value() > e0
+    # the pool still honors the registry invariants
+    st = pf.pool.stats()
+    assert st["leased"] == st["prefix_blocks"]
+
+
+def test_disagg_witness_soak_speculative_edges(model_and_params,
+                                               monkeypatch):
+    """Wire adoption under the runtime lock-order witness: the
+    speculative-adoption lock participates (receiver hub → spec lock →
+    pool) and the acquisition graph stays acyclic."""
+    from vtpu.analysis import witness
+    from vtpu.serving import transport as tp
+
+    monkeypatch.setenv(witness.ENV_WITNESS, "1")
+    witness.reset()
+    try:
+        m, params = model_and_params
+        pf = PrefillEngine(m, params, prefix_cache=True)
+        dec = DecodeEngine(m, params, max_batch=4, eos_id=2)
+        hub = tp.ReceiverHub(dec)
+        rep = tp.WireReplica(tp.LoopbackLink(hub), "w0", local=dec,
+                             chunk_blocks=1)
+        reqs = fuzz_requests(seed=47, n=4)
+        for rid, p, n in reqs:
+            pf.submit(rid, p, n)
+        for r in pf.run():
+            rep.submit_handle(r.rid, r.handle, r.first_token,
+                              r.num_new, source=pf, admit=False)
+        while rep.idle_senders():
+            rep.step()
+        while any(dec.active) or dec._inflight or dec.queue:
+            dec.step()
+        got = set(witness.edges())
+        assert witness.cycles() == [], witness.report()
+        assert ("serving.receiver_hub", "serving.spec_adopt") in got
+        assert ("serving.receiver_hub", "serving.kvpool") in got
+    finally:
+        witness.reset()
+
+
+def test_oversized_wire_stream_refused_typed(model_and_params):
+    """Review fix: the wire path bypasses submit_handle, so the engine
+    enforces the max_seq budget bound at stream OPEN — typed, before a
+    single destination block is leased, handle still adoptable."""
+    from vtpu.serving import transport as tp
+
+    m, params = model_and_params
+    pf = PrefillEngine(m, params)
+    dec = DecodeEngine(m, params, max_batch=2, eos_id=2)
+    hub = tp.ReceiverHub(dec)
+    rep = tp.WireReplica(tp.LoopbackLink(hub), "w0", local=dec)
+    pf.submit("big", np.arange(20, dtype=np.int32) % 64, 4)
+    res = pf.step()[0]
+    with pytest.raises(tp.WireError):
+        # a lying/buggy caller inflates the decode budget past max_seq
+        rep.submit_handle(res.rid, res.handle, res.first_token,
+                          num_new=m.max_seq, source=pf)
+    assert "big" not in dec.out           # no speculative publish
+    assert not dec._spec_slots
+    # the OPEN refused before the claim: the handle is still adoptable
+    pf.pool.release_handle(res.handle)
+    assert _leak_free(pf.pool) and _leak_free(dec.pool)

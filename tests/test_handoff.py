@@ -153,3 +153,109 @@ def test_try_lease_is_atomic_backoff():
     assert pool.try_lease(1) is None
     pool.release(got)
     assert pool.free_blocks() == 3
+
+
+# ---------------------------------------------------------------------------
+# prefix registry (the cluster-wide prefix cache, pool half)
+# ---------------------------------------------------------------------------
+
+def _chain(tokens, bs=8):
+    from vtpu.serving.prefix import chain_digests
+
+    return chain_digests(tokens, bs)
+
+
+def test_prefix_register_match_and_ref():
+    pool = BlockPool(17, 8, prefix_cap=8)
+    chain = _chain(list(range(24)))          # 3 full blocks
+    blocks = pool.lease(4)                   # 3 prefix + 1 tail
+    pool.register_prefix(chain, blocks)
+    pool.release(blocks)                     # only the pins remain
+    st = pool.stats()
+    assert st["prefix_runs"] == 3            # every chain depth keyed
+    assert st["prefix_blocks"] == 3
+    # a prompt sharing 2 blocks (capped by its own suffix rule)
+    got, k = pool.match_and_ref(chain[:2], max_blocks=2)
+    assert k == 2 and got == blocks[:2]
+    # the match holds its own references: evicting everything now
+    # frees the third block only
+    assert pool.evict_prefixes_for(pool.leasable()) is False
+    assert pool.stats()["prefix_runs"] == 0
+    pool.release(got)
+    assert pool.free_blocks() == 16
+
+
+def test_prefix_match_miss_and_depth_probe():
+    pool = BlockPool(17, 8)
+    chain = _chain(list(range(16)))
+    assert pool.match_and_ref(chain, max_blocks=2) == ([], 0)
+    assert pool.prefix_match_depth(chain) == 0
+    blocks = pool.lease(2)
+    pool.register_prefix(chain, blocks)
+    assert pool.prefix_match_depth(chain) == 2
+    assert pool.prefix_match_depth(chain[:1]) == 1
+    assert pool.prefix_match_depth(_chain(list(range(99, 115)))) == 0
+
+
+def test_prefix_lru_cap_evicts_oldest():
+    pool = BlockPool(33, 8, prefix_cap=2)
+    a = pool.lease(1)
+    pool.register_prefix(_chain(list(range(8))), a)
+    b = pool.lease(1)
+    pool.register_prefix(_chain(list(range(50, 58))), b)
+    c = pool.lease(1)
+    pool.register_prefix(_chain(list(range(70, 78))), c)  # evicts a's
+    assert pool.stats()["prefix_runs"] == 2
+    assert pool.prefix_match_depth(_chain(list(range(8)))) == 0
+    pool.release(a + b + c)
+
+
+def test_shared_prefix_block_backs_multiple_handles():
+    """The refcounted detach rule: a prefix-shared block may belong to
+    several in-flight handles (one reference each), while one lease
+    still can't mint two claim tickets."""
+    pool = BlockPool(17, 8)
+    chain = _chain(list(range(16)))
+    base = pool.lease(3)
+    pool.register_prefix(chain, base)
+    # two sessions match the prefix and detach overlapping handles
+    s1, k1 = pool.match_and_ref(chain, max_blocks=2)
+    s2, k2 = pool.match_and_ref(chain, max_blocks=2)
+    assert s1 == s2 and k1 == k2 == 2
+    h_base = pool.detach(base, seq_len=20)
+    h1 = pool.detach(s1 + pool.lease(1), seq_len=20)
+    h2 = pool.detach(s2 + pool.lease(1), seq_len=20)
+    # all three adoptable; each consumes its own references
+    for h in (h_base, h1, h2):
+        pool.release_handle(h)
+    st = pool.stats()
+    # the 2-block chain's pins are all that survive; base's third
+    # (tail) block was never registered and is fully released
+    assert st["leased"] == st["prefix_blocks"] == 2
+    # and the original rule still holds: one lease, one ticket
+    solo = pool.lease(1)
+    pool.detach(solo, seq_len=4)
+    with pytest.raises(KVHandoffError):
+        pool.detach(solo, seq_len=4)
+
+
+def test_prefix_registration_requires_live_lease():
+    pool = BlockPool(9, 8)
+    blocks = pool.lease(2)
+    pool.release(blocks)
+    with pytest.raises(KVHandoffError):
+        pool.register_prefix(_chain(list(range(16))), blocks)
+
+
+def test_double_detach_rejected_even_when_blocks_are_registered():
+    """Review fix: registry pins are excluded from the claimable
+    budget — a lease whose blocks are also prefix-registered still
+    cannot mint two claim tickets."""
+    pool = BlockPool(17, 8)
+    blocks = pool.lease(2)
+    pool.register_prefix(_chain(list(range(16))), blocks)  # refs now 1+pins
+    h = pool.detach(blocks, seq_len=16)
+    with pytest.raises(KVHandoffError):
+        pool.detach(blocks, seq_len=16)       # second ticket: refused
+    pool.release_handle(h)
+    assert pool.stats()["leased"] == pool.stats()["prefix_blocks"] == 2

@@ -152,3 +152,37 @@ def test_kv_cache_dtype_validated():
                         max_seq=16, kv_cache_dtype="fp8")
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         bad.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def test_quantize_blockwise_roundtrip_bound_and_np_twin():
+    """The wire codec's blockwise quantizer: per-element error within
+    the documented scale/2 bound, and the JAX and numpy (host-side)
+    implementations agree bit-for-bit on q and scale."""
+    from vtpu.ops.quant import dequantize_blockwise, quantize_blockwise
+    from vtpu.serving.wirecodec import (
+        dequantize_blocks_np,
+        quantize_blocks_np,
+    )
+
+    x = jax.random.normal(jax.random.PRNGKey(3), (6, 4, 8), jnp.float32)
+    q, scale = quantize_blockwise(x)
+    assert q.dtype == jnp.int8 and scale.shape == (6, 1, 1)
+    back = dequantize_blockwise(q, scale, jnp.float32)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    bound = np.asarray(scale) / 2.0 + 1e-7
+    assert np.all(err <= bound)
+    qn, sn = quantize_blocks_np(np.asarray(x))
+    assert np.array_equal(qn, np.asarray(q))
+    assert np.allclose(sn, np.asarray(scale).reshape(-1))
+    backn = dequantize_blocks_np(qn, sn, np.float32)
+    assert np.allclose(backn, np.asarray(back))
+
+
+def test_quantize_blockwise_zero_block_is_exact():
+    from vtpu.ops.quant import dequantize_blockwise, quantize_blockwise
+
+    x = jnp.zeros((3, 5), jnp.float32)
+    q, scale = quantize_blockwise(x)
+    assert np.all(np.asarray(scale) == 1.0)   # guarded, no div-by-zero
+    assert np.all(np.asarray(
+        dequantize_blockwise(q, scale, jnp.float32)) == 0.0)

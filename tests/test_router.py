@@ -501,3 +501,123 @@ def test_cancel_mid_prefill_releases_the_result_on_arrival():
     st = pf.pool.stats()
     assert st["leased"] == 0 and st["detached_handles"] == 0
     assert router.replicas["d0"].adopted == []
+
+
+# ---------------------------------------------------------------------------
+# prefix-aware prefill routing (the cluster-wide prefix cache, router half)
+# ---------------------------------------------------------------------------
+
+class PrefixFakePrefill(FakePrefill):
+    """A prefill replica that opted into the pool prefix registry."""
+
+    prefix_cache = True
+
+    def __init__(self, blocks=64, block_size=8):
+        super().__init__(blocks=blocks, block_size=block_size)
+        self.block_size = block_size
+
+    def submit(self, rid, prompt, num_new, chain=None):
+        self.queue.append((rid, list(prompt), num_new))
+
+    def register(self, tokens):
+        from vtpu.serving.prefix import chain_digests
+
+        chain = chain_digests(tokens, self.pool.block_size)
+        blocks = self.pool.lease(len(chain))
+        self.pool.register_prefix(chain, blocks)
+        self.pool.release(blocks)   # registry pins keep them alive
+        return chain
+
+
+def test_prefix_routing_prefers_the_replica_holding_the_prefix():
+    from vtpu.serving.prefix import chain_digests
+
+    pfs = {"p0": PrefixFakePrefill(), "p1": PrefixFakePrefill()}
+    reps = {"d0": FakeReplica()}
+    router = Router(pfs, reps)
+    prompt = list(range(16)) + [99, 98]      # 2 full blocks + suffix
+    # p1 (NOT the least-queued tiebreak winner) holds the prefix
+    pfs["p1"].register(list(range(16)))
+    router._prefix_index.record(chain_digests(list(range(16)), 8), "p1")
+    router.submit("sessA", "r0", prompt, 4)
+    assert [r for r, *_ in pfs["p1"].queue] == ["r0"]
+    assert not pfs["p0"].queue
+    assert router.prefix_routed == 1
+    assert router.stats()["prefix_routed"] == 1
+
+
+def test_prefix_routing_unverified_hint_not_followed_but_kept():
+    """An index hint its pool cannot verify (not yet registered, or
+    evicted) is not FOLLOWED — the submit falls back to least-queued —
+    but the hint is KEPT: optimistic records land before the routed
+    prefill registers, and destroying them would scatter exactly the
+    fanout bursts the cache targets."""
+    pfs = {"p0": PrefixFakePrefill(), "p1": PrefixFakePrefill()}
+    reps = {"d0": FakeReplica()}
+    router = Router(pfs, reps)
+    from vtpu.serving.prefix import chain_digests
+
+    chain = chain_digests(list(range(16)), 8)
+    # hint at p1, but p1's pool never registered (≈ not yet / evicted)
+    router._prefix_index.record(chain, "p1")
+    pfs["p1"].queue.append(("busy", [1], 1))  # p1 is ALSO more loaded
+    router.submit("sessA", "r0", list(range(16)) + [5, 6], 4)
+    assert [r for r, *_ in pfs["p0"].queue] == ["r0"]
+    assert router.prefix_routed == 0
+    assert len(router._prefix_index) >= 1     # r0's own chain recorded
+    # r0 was routed to p0, whose engine then registers the run — the
+    # recorded hint now verifies and the next submit follows it
+    pfs["p0"].register(list(range(16)))
+    pfs["p0"].queue.clear()
+    router.submit("sessB", "r1", list(range(16)) + [9], 4)
+    assert [r for r, *_ in pfs["p0"].queue] == ["r1"]
+    assert router.prefix_routed == 1
+
+
+def test_prefix_hints_forgotten_on_prefill_health_drain():
+    """A health-drained prefill replica's hints are dropped — its pool
+    is gone with the process; a restored replica re-earns them."""
+    pfs = {"p0": PrefixFakePrefill(), "p1": PrefixFakePrefill()}
+    pings = {"p0": True, "p1": True}
+    for pid, pf in pfs.items():
+        pf.ping = (lambda p=pid: (_ for _ in ()).throw(
+            ConnectionError()) if not pings[p] else True)
+    reps = {"d0": FakeReplica()}
+    router = Router(pfs, reps, fail_threshold=2)
+    from vtpu.serving.prefix import chain_digests
+
+    chain = chain_digests(list(range(16)), 8)
+    router._prefix_index.record(chain, "p1")
+    other = chain_digests(list(range(40, 56)), 8)
+    router._prefix_index.record(other, "p0")
+    pings["p1"] = False
+    router.check_health()
+    router.check_health()                     # 2 fails → drained
+    assert "p1" not in router._active_prefills()
+    left = set(router._prefix_index._entries.values())
+    assert left == {"p0"}                     # p1's hints forgotten
+
+
+def test_prefix_routing_records_routed_chains():
+    """A second session with the same prefix follows the first — the
+    router records each routed chain so high-fanout traffic converges
+    onto the replica that will hold the prefix."""
+    pfs = {"p0": PrefixFakePrefill(), "p1": PrefixFakePrefill()}
+    reps = {"d0": FakeReplica()}
+    router = Router(pfs, reps)
+    shared = list(range(24))
+    router.submit("sessA", "r0", shared + [77], 4)
+    first_pid = "p0" if pfs["p0"].queue else "p1"
+    # the chosen replica 'prefills' and registers like the real engine
+    pfs[first_pid].register(shared)
+    pfs[first_pid].queue.clear()
+    router.submit("sessB", "r1", shared + [88, 89], 4)
+    assert [r for r, *_ in pfs[first_pid].queue] == ["r1"]
+    assert router.prefix_routed == 1
+
+
+def test_router_without_prefix_engines_skips_the_index():
+    router, pf, reps = make_router(n=2)     # plain FakePrefill
+    assert router._prefix_index is None
+    router.submit("s", "r0", [1, 2, 3], 2)
+    assert router.stats()["prefix_index_entries"] == 0

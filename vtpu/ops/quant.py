@@ -72,6 +72,28 @@ def dequantize(qt: QuantizedTensor, dtype=jnp.bfloat16):
     return (qt.q.astype(jnp.float32) * qt.scale).astype(dtype)
 
 
+def quantize_blockwise(x):
+    """Symmetric per-block int8 for the K/V wire codec: one f32 scale
+    per leading-axis slice (a pool *block*), absmax over every other
+    axis.  Returns ``(q int8 [b, ...], scale f32 [b, 1, ..., 1])``.
+    Jit-safe — the wire extract fuses this into the block gather so the
+    D2H moves ~4x fewer bytes.  Per-element reconstruction error is
+    bounded by ``scale/2 = absmax/254`` per block (round-to-nearest)."""
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(1, x.ndim))
+    amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_blockwise(q, scale, dtype=jnp.bfloat16):
+    """Inverse of :func:`quantize_blockwise`.  Call INSIDE jit so XLA
+    fuses the convert-multiply into the consuming scatter (the wire
+    receiver's incremental per-chunk adopt does exactly that)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
 def quantize_tree(params, min_elems: int = 16384):
     """Quantize every float matrix leaf with >= ``min_elems`` elements
     (the big projection kernels); small leaves (norms, biases) and

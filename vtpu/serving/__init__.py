@@ -18,6 +18,8 @@ _LAZY = {
     "RouterReject": ("vtpu.serving.router", "RouterReject"),
     "BlockPool": ("vtpu.serving.kvpool", "BlockPool"),
     "KVHandle": ("vtpu.serving.kvpool", "KVHandle"),
+    "PrefixIndex": ("vtpu.serving.prefix", "PrefixIndex"),
+    "chain_digests": ("vtpu.serving.prefix", "chain_digests"),
 }
 
 __all__ = sorted(_LAZY)

@@ -56,17 +56,27 @@ import numpy as np
 
 from vtpu.analysis.witness import make_lock
 from vtpu.models.transformer import TransformerLM, _zero_cache, bucket_length
-from vtpu.ops.quant import dequantize_tree
+from vtpu.ops.quant import (
+    dequantize_blockwise,
+    dequantize_tree,
+    quantize_blockwise,
+)
 from vtpu.serving import batcher as _batcher
+from vtpu.serving import wirecodec
 from vtpu.serving.kvpool import (
     HANDOFF_BLOCKS,
     HANDOFF_DEVICE_BYTES,
     HANDOFF_TOTAL,
+    PREFIX_HITS,
+    PREFIX_MISSES,
+    SPEC_ADOPTIONS,
+    SPEC_ROLLBACKS,
     BlockPool,
     KVHandle,
     PoolMismatchError,
 )
 from vtpu.serving.paged import PagedBatcher
+from vtpu.serving.prefix import chain_digests
 
 __all__ = ["DecodeEngine", "HostExtract", "PrefillEngine",
            "PrefillResult", "pool_layout"]
@@ -92,15 +102,33 @@ class HostExtract:
     whatever the prefill engine computes next (PR 3's double-buffering
     idiom); ``ready_blocks()`` is the overlap driver: the stream sender
     ships chunks only once the copy has landed, never blocking the
-    pump on a device sync."""
+    pump on a device sync.
 
-    def __init__(self, gathered: list, nblocks: int) -> None:
+    Under the ``int8`` wire codec the extract holds per-leaf
+    ``(q int8, scale f32)`` pairs instead of raw leaves — the blockwise
+    quantization fused into the device gather — and ``payload`` emits
+    the wirecodec chunk layout (per leaf: scales ‖ int8 data), so the
+    D2H itself already moves ~4x fewer bytes."""
+
+    def __init__(self, gathered: list, nblocks: int,
+                 codec: str = wirecodec.CODEC_FP32,
+                 scales: Optional[list] = None) -> None:
         self._dev = gathered          # per-leaf [padded_blocks, ...]
+        self._dev_scales = scales     # per-leaf f32 [padded_blocks]
+        self.codec = codec
         self.nblocks = nblocks
         self._np: Optional[list] = None
-        self.per_block = sum(
-            int(np.prod(leaf.shape[1:])) * leaf.dtype.itemsize
+        self._np_scales: Optional[list] = None
+        # one source of truth for the chunk byte layout: the wirecodec
+        # helpers the receiver's split_quant_payload validates against
+        per_leaf = [
+            (int(np.prod(leaf.shape[1:])), leaf.shape[1:], leaf.dtype)
             for leaf in gathered
+        ]
+        self.per_block = (
+            wirecodec.quant_block_bytes(per_leaf)
+            if codec == wirecodec.CODEC_INT8
+            else wirecodec.fp32_block_bytes(per_leaf)
         )
 
     def layout(self) -> list:
@@ -111,7 +139,7 @@ class HostExtract:
         copy is still in flight)."""
         if self._np is not None:
             return self.nblocks
-        for leaf in self._dev:
+        for leaf in self._dev + (self._dev_scales or []):
             ready = getattr(leaf, "is_ready", None)
             if ready is not None and not ready():
                 return 0
@@ -119,11 +147,23 @@ class HostExtract:
 
     def payload(self, lo: int, hi: int) -> bytes:
         """Serialized bytes of blocks [lo, hi): per-leaf slices in
-        flatten order, concatenated."""
+        flatten order, concatenated (int8 codec: per-leaf scale segment
+        then int8 data, the wirecodec chunk layout)."""
         if self._np is None:
             # the async copy was issued at construction; this is a
             # cheap view by the time ready_blocks() said go
             self._np = [np.asarray(leaf) for leaf in self._dev]  # vtpu: allow(jax-hygiene) — extract's one D2H
+            if self._dev_scales is not None:
+                self._np_scales = [
+                    np.asarray(s, dtype="<f4") for s in self._dev_scales  # vtpu: allow(jax-hygiene) — same D2H, landed
+                ]
+        if self.codec == wirecodec.CODEC_INT8:
+            assert self._np_scales is not None
+            return b"".join(
+                np.ascontiguousarray(s[lo:hi]).tobytes()
+                + np.ascontiguousarray(q[lo:hi]).tobytes()
+                for s, q in zip(self._np_scales, self._np)
+            )
         return b"".join(
             np.ascontiguousarray(leaf[lo:hi]).tobytes()
             for leaf in self._np
@@ -174,7 +214,8 @@ class PrefillEngine:
 
     def __init__(self, model: TransformerLM, params, *,
                  shared_with: Optional["DecodeEngine"] = None,
-                 bucket_prefill: bool = True) -> None:
+                 bucket_prefill: bool = True,
+                 prefix_cache: bool = False) -> None:
         if model.kv_cache_layout != "paged" or model.kv_pool_blocks <= 1:
             raise ValueError(
                 "PrefillEngine needs kv_cache_layout='paged' and a real "
@@ -212,6 +253,16 @@ class PrefillEngine:
         self.queue: collections.deque = collections.deque()
         self._rids: set = set()
         self.prefills = 0  # finished prefills (scrape-friendly)
+        # cluster-wide prefix cache (opt-in): prompts digest into
+        # chained block-granular content hashes at submit; admission
+        # matches them against the pool's registry and prefills ONLY
+        # the unmatched suffix (position-rewind via pos0, the same
+        # contract the bucketed admission path already honors).  The
+        # registry pins blocks across requests, so drained pools keep
+        # their hot prefixes — docs/serving.md §Prefix cache.
+        self.prefix_cache = bool(prefix_cache) and self.pool.prefix_cap > 0
+        self.prefix_hits = 0
+        self.prefix_tokens_skipped = 0
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def _pf(params, pools, pos0, table, toks, lens):
@@ -244,30 +295,55 @@ class PrefillEngine:
 
         self._wire_gather = _wire_gather
 
+        @jax.jit
+        def _wire_gather_quant(pools, idx):
+            """int8-codec extract: the same fused row gather with the
+            blockwise quantization (vtpu/ops/quant.py) fused in — one
+            f32 scale per (block, leaf), int8 payload — so the async
+            D2H itself moves ~4x fewer bytes than the raw gather."""
+            qs, scales = [], []
+            for leaf in jax.tree_util.tree_leaves(
+                jax.tree.map(lambda x: x[idx], pools)
+            ):
+                q, s = quantize_blockwise(leaf)
+                qs.append(q)
+                scales.append(s.reshape(-1).astype(jnp.float32))
+            return qs, scales
+
+        self._wire_gather_quant = _wire_gather_quant
+
     # -- wire transport (sender side) ----------------------------------
     def wire_layout(self) -> list:
         """Layout digest the receiver validates before pre-leasing."""
         return pool_layout(self.pool_leaves())
 
-    def start_extract(self, blocks) -> HostExtract:
+    def start_extract(self, blocks,
+                      codec: str = wirecodec.CODEC_FP32) -> HostExtract:
         """Begin the async D2H of claimed blocks for a wire stream.
         The gather enqueues behind any in-flight prefill program (the
         blocks' K/V writes are program-ordered before the read), and
         ``copy_to_host_async`` starts the transfer immediately — by the
         time the sender's pump asks for payload, the bytes are host-side
-        without a blocking sync."""
+        without a blocking sync.  ``codec`` is the stream's NEGOTIATED
+        codec: under ``int8`` the quantization fuses into the gather."""
         blocks = list(blocks)
         n = len(blocks)
         padded = blocks + [0] * (_pow2(n) - n)  # pad → garbage block;
         # pow-2 row buckets keep the gather's compile count bounded
         idx = jnp.asarray(padded, jnp.int32)
+        scales = None
         with self._dispatch_lock:
-            gathered = jax.tree_util.tree_leaves(
-                self._wire_gather(self.pool_leaves(), idx)
-            )
-        for g in gathered:
+            if codec == wirecodec.CODEC_INT8:
+                gathered, scales = self._wire_gather_quant(
+                    self.pool_leaves(), idx
+                )
+            else:
+                gathered = jax.tree_util.tree_leaves(
+                    self._wire_gather(self.pool_leaves(), idx)
+                )
+        for g in list(gathered) + list(scales or []):
             getattr(g, "copy_to_host_async", lambda: None)()
-        return HostExtract(gathered, n)
+        return HostExtract(gathered, n, codec=codec, scales=scales)
 
     # ------------------------------------------------------------------
     def _blocks_needed(self, prompt_len: int, num_new: int) -> int:
@@ -276,7 +352,11 @@ class PrefillEngine:
         # physical blocks over; copy mode mirrors the count)
         return -(-(prompt_len + num_new) // self.block_size)
 
-    def submit(self, rid: str, prompt, num_new: int) -> None:
+    def submit(self, rid: str, prompt, num_new: int, *,
+               chain: Optional[list] = None) -> None:
+        """Queue one prompt.  ``chain`` is an optional precomputed
+        digest chain (the router hands its own down so the prompt isn't
+        hashed twice); ignored when the prefix cache is off."""
         if num_new < 1:
             raise ValueError(f"num_new must be >= 1, got {num_new}")
         p = np.asarray(prompt, np.int32).reshape(-1)
@@ -294,7 +374,19 @@ class PrefillEngine:
         if rid in self._rids:
             raise ValueError(f"duplicate request id {rid!r}")
         self._rids.add(rid)
-        self.queue.append((rid, p, num_new, time.perf_counter()))
+        # the prompt's chained block digests travel with the request:
+        # matching happens at ADMISSION (the registry may gain entries
+        # while this prompt queues), registration after its prefill
+        if not self.prefix_cache:
+            chain = []
+        elif (chain is None
+              or len(chain) != p.size // self.block_size):
+            # absent, or handed down at a foreign block granularity
+            # (its digests would attest the wrong token spans): compute
+            # at OUR granularity
+            chain = chain_digests(p.tolist(), self.block_size)
+        self.queue.append((rid, p, num_new, time.perf_counter(),
+                           list(chain)))
 
     def pool_leaves(self) -> dict:
         """The device pool buffers a cross-pool adoption reads from."""
@@ -325,27 +417,61 @@ class PrefillEngine:
     def step(self) -> List[PrefillResult]:
         """One admission round: drain as many queued prompts as the
         pool can lease (head-of-line FIFO on backpressure), prefill
-        them in ONE fused program per length bucket, and detach every
-        lease into a handle.  The [rows] first-token transfer is the
-        only host materialization — tokens, never cache contents."""
-        taken: List[Tuple[str, np.ndarray, int, float, List[int]]] = []
+        them in ONE fused program per suffix-length bucket, and detach
+        every lease into a handle.  With the prefix cache on, each
+        prompt first matches its digest chain against the pool's
+        registry: matched blocks are referenced (shared, never copied)
+        and only the unmatched SUFFIX prefills, starting at the matched
+        position — the bucketed path's position-rewind contract.  The
+        [rows] first-token transfer is the only host materialization —
+        tokens, never cache contents."""
+        # taken rows: (rid, prompt, num_new, t0, chain, table_blocks,
+        #              shared_tok)
+        taken: List[Tuple] = []
         while self.queue:
-            rid, p, num_new, t0 = self.queue[0]
-            need = self._blocks_needed(p.size, num_new)
+            rid, p, num_new, t0, chain = self.queue[0]
+            shared: List[int] = []
+            shared_tok = 0
+            if chain:
+                # leave >= 1 suffix token: admission needs last-token
+                # logits, exactly like the paged engine's matcher
+                max_blocks = (p.size - 1) // self.block_size
+                shared, k = self.pool.match_and_ref(chain, max_blocks)
+                shared_tok = k * self.block_size
+            need = self._blocks_needed(p.size, num_new) - len(shared)
             # atomic check-and-lease: a co-located decode engine may be
-            # leasing from the same pool on another thread
+            # leasing from the same pool on another thread.  Under
+            # pressure, LRU registry entries yield their pins first —
+            # prefix reuse must never starve real work.
             blocks = self.pool.try_lease(need)
+            if blocks is None and self.pool.evict_prefixes_for(need):
+                blocks = self.pool.try_lease(need)
             if blocks is None:
+                if shared:
+                    self.pool.release(shared)  # un-ref the match
                 break  # the oldest waits for blocks; FIFO completion
+            # hit/miss accounting at ADMISSION only — a head-of-line
+            # request re-matching every backpressure round counts once
+            if shared:
+                self.prefix_hits += 1
+                self.prefix_tokens_skipped += shared_tok
+                PREFIX_HITS.inc()
+            elif chain:
+                PREFIX_MISSES.inc()
             self.queue.popleft()
-            taken.append((rid, p, num_new, t0, blocks))
+            taken.append((rid, p, num_new, t0, chain,
+                          shared + blocks, shared_tok))
         if not taken:
             return []
         by_bucket: Dict[int, list] = {}
         for item in taken:
-            p = item[1]
-            blen = (bucket_length(p.size, self.model.max_seq)
-                    if self.bucket_prefill else p.size)
+            p, shared_tok = item[1], item[6]
+            suffix = p.size - shared_tok
+            # cap the bucket at the remaining positions so padded
+            # writes never spill past max_seq (same clamp-corruption
+            # guard as the paged admission path)
+            blen = (bucket_length(suffix, self.model.max_seq - shared_tok)
+                    if self.bucket_prefill else suffix)
             by_bucket.setdefault(blen, []).append(item)
         out: List[PrefillResult] = []
         for blen, sub in by_bucket.items():
@@ -355,18 +481,27 @@ class PrefillEngine:
             table = np.zeros((rows, self.nb_max), np.int32)
             pos0 = np.zeros((rows,), np.int32)
             lens = np.ones((rows,), np.int32)  # pad rows index token 0
-            for r, (rid, p, num_new, t0, blocks) in enumerate(sub):
-                toks[r, :p.size] = p
+            for r, (rid, p, num_new, t0, chain, blocks,
+                    shared_tok) in enumerate(sub):
+                toks[r, :p.size - shared_tok] = p[shared_tok:]
                 table[r, :len(blocks)] = blocks
-                lens[r] = p.size
+                pos0[r] = shared_tok
+                lens[r] = p.size - shared_tok
             with self._dispatch_lock:
                 firsts, new_pools = self._pf(
                     self.params, self._borrow_pools(), pos0, table,
                     toks, lens,
                 )
                 self._restore_pools(new_pools)
+            # register AFTER the program is enqueued: device order then
+            # guarantees a later matching suffix prefill reads written
+            # blocks, never zeros (the paged engine's argument)
+            for (rid, p, num_new, t0, chain, blocks, shared_tok) in sub:
+                if chain:
+                    self.pool.register_prefix(chain, blocks)
             vals = np.asarray(firsts)  # vtpu: allow(jax-hygiene) — prefill first-token harvest
-            for r, (rid, p, num_new, t0, blocks) in enumerate(sub):
+            for r, (rid, p, num_new, t0, chain, blocks,
+                    shared_tok) in enumerate(sub):
                 handle = self.pool.detach(blocks, seq_len=int(p.size))
                 out.append(PrefillResult(rid, int(vals[r]), handle,
                                          num_new, t0))
@@ -396,6 +531,8 @@ class PrefillEngine:
 
     def stats(self) -> dict:
         return {"queued": len(self.queue), "prefills": self.prefills,
+                "prefix_hits": self.prefix_hits,
+                "prefix_tokens_skipped": self.prefix_tokens_skipped,
                 **self.pool.stats()}
 
 
@@ -407,9 +544,26 @@ class DecodeEngine(PagedBatcher):
     queue-depth accounting) works unchanged."""
 
     def __init__(self, model: TransformerLM, params, max_batch: int,
-                 replica_id: str = "decode0", **kw) -> None:
+                 replica_id: str = "decode0", speculative: bool = True,
+                 **kw) -> None:
         super().__init__(model, params, max_batch, **kw)
         self.replica_id = replica_id
+        # speculative wire adoption (docs/serving.md §Wire transport):
+        # at stream OPEN — behind the same credit/lease machinery — a
+        # free slot is RESERVED and the prefill's first token published
+        # immediately, so first-token latency stops waiting for the
+        # stream's FIN; the incremental chunk scatter proceeds as
+        # before, the fused bind fires the moment FIN lands (no queue
+        # wait — the slot is already this stream's), and the typed
+        # rollback on abort/torn-stream-exhaustion retracts the token,
+        # frees the slot, and releases both pools.
+        self.speculative = bool(speculative)
+        self._spec_lock = make_lock("serving.spec_adopt")
+        self._spec_slots: Dict[int, str] = {}   # reserved slot → rid
+        # largest quant scale applied by int8 wire chunks — max
+        # per-element reconstruction error is wire_quant_max_scale/2
+        # (the documented bound the bench reports)
+        self.wire_quant_max_scale = 0.0
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def _adopt_bind(btab, bpos, tok, slots, rows, sizes, firsts):
@@ -456,11 +610,37 @@ class DecodeEngine(PagedBatcher):
 
         self._wire_put = _wire_put
 
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _wire_put_quant(pools, idx, chunk_q, chunk_scale):
+            """int8-codec incremental adoption: the blockwise dequant
+            (vtpu/ops/quant.py) FUSED into the same donated scatter —
+            one program per chunk, no extra device round trip on the
+            hot adoption path.  ``chunk_scale`` leaves broadcast one
+            f32 scale per (block, leaf)."""
+            return jax.tree.map(
+                lambda dst, q, s: dst.at[idx].set(
+                    dequantize_blockwise(q, s, dst.dtype)
+                ),
+                pools, chunk_q, chunk_scale,
+            )
+
+        self._wire_put_quant = _wire_put_quant
+
     # ------------------------------------------------------------------
     def ping(self) -> bool:
         """Health probe for the router (a live in-process engine is
         always healthy; remote transports override)."""
         return True
+
+    # speculative reservations hold their slot against every other
+    # admission path until FIN binds it (or rollback frees it)
+    def _free_slots(self) -> List[int]:
+        return [s for s in super()._free_slots()
+                if s not in self._spec_slots]
+
+    def _slot_is_free(self, slot: int) -> bool:
+        return (super()._slot_is_free(slot)
+                and slot not in self._spec_slots)
 
     def submit(self, rid: str, prompt, num_new: int) -> None:
         raise TypeError(
@@ -552,14 +732,25 @@ class DecodeEngine(PagedBatcher):
     # (or under the same external serialization) as the engine's step()
     # — wire_write's donating _wire_put and the decode window's donating
     # dispatch race on the live cache otherwise, the deleted-buffer
-    # hazard the PrefillEngine fences with _dispatch_lock.  The router
-    # pump, the bench drive loop, and an HTTP deployment's
+    # hazard the PrefillEngine fences with _dispatch_lock.  The same
+    # serialization is what keeps a speculative slot reservation
+    # (wire_open) from racing _admit_pending's slot claims: _spec_lock
+    # protects the reservation BOOKKEEPING (and gives the lock witness
+    # an edge to watch), but slot assignment as a whole is serialized
+    # by this contract, not by that lock.  The router pump, the bench
+    # drive loop, and an HTTP deployment's
     # listener-hands-to-engine-thread queue all satisfy this.
     def wire_layout(self) -> list:
         return pool_layout(self._split_cache()[0])
 
+    def wire_codecs(self) -> tuple:
+        """Codecs this receiver accepts at OPEN negotiation (an old
+        receiver without this surface is fp32-only to the hub)."""
+        return (wirecodec.CODEC_FP32, wirecodec.CODEC_INT8)
+
     def wire_open(self, rid: str, total_blocks: int, layout: list,
-                  chunk_blocks: int):
+                  chunk_blocks: int, codec: str = wirecodec.CODEC_FP32,
+                  meta: Optional[dict] = None):
         # typed-error contract: everything raised here must be a
         # KVHandoffError subclass so an HTTP deployment maps it to the
         # typed response doc instead of an opaque 500
@@ -576,13 +767,47 @@ class DecodeEngine(PagedBatcher):
             raise PoolMismatchError(
                 "handle needs more blocks than this pool can ever lease"
             )
+        # the wire path bypasses submit_handle, so ITS budget bound
+        # must be enforced here: an over-long stream would otherwise
+        # decode past max_seq and clamp-scatter into wrong cache rows.
+        # Refused at OPEN — typed, before a single block is leased.
+        if meta is not None:
+            try:
+                seq_len = int(meta["handle"]["seq_len"])
+                num_new = int(meta.get("num_new", 1))
+            except (KeyError, TypeError, ValueError):
+                pass  # malformed meta fails typed at FIN
+            else:
+                if seq_len + num_new > self.model.max_seq:
+                    raise WireError(
+                        f"seq_len ({seq_len}) + num_new ({num_new}) "
+                        f"exceeds max_seq ({self.model.max_seq})"
+                    )
         dst = self.pool.lease_upto(total_blocks)
         if not dst:
             return None  # saturated → credits 0 → router backpressure
         self._rids.add(rid)
-        return {"rid": rid, "dst": dst, "total": total_blocks,
-                "chunk_blocks": int(chunk_blocks), "written": 0,
-                "closed": False}
+        ctx = {"rid": rid, "dst": dst, "total": total_blocks,
+               "chunk_blocks": int(chunk_blocks), "written": 0,
+               "closed": False, "codec": str(codec), "slot": None}
+        # speculative adoption: reserve a free slot NOW and publish the
+        # prefill's first token — the stream's wall time stops gating
+        # first-token latency.  Device state is untouched until FIN
+        # (the reserved slot stays inactive; decode windows write its
+        # row into the garbage block), so rollback is pure host work.
+        if self.speculative and meta is not None:
+            try:
+                first = int(meta["first"])
+            except (KeyError, TypeError, ValueError):
+                return ctx  # malformed meta fails at FIN, typed
+            with self._spec_lock:
+                slot = next(iter(self._free_slots()), None)
+                if slot is not None:
+                    self._spec_slots[slot] = rid
+                    ctx["slot"] = slot
+                    self.out[rid] = [first]
+                    SPEC_ADOPTIONS.inc()
+        return ctx
 
     def wire_credits(self, ctx) -> int:
         return len(ctx["dst"])
@@ -610,20 +835,63 @@ class DecodeEngine(PagedBatcher):
             meta = self._wire_meta = (treedef, per_leaf, per_block)
         return meta
 
+    def _wire_chunk_idx(self, ctx, block_off: int, nblocks: int):
+        cb = max(ctx["chunk_blocks"], nblocks)
+        idx = np.zeros((cb,), np.int32)  # pad rows → garbage block 0
+        idx[:nblocks] = ctx["dst"][block_off:block_off + nblocks]
+        return cb, idx
+
+    def _wire_write_quant(self, ctx, block_off: int, nblocks: int,
+                          payload) -> None:
+        """int8-codec chunk: per-leaf (scales, int8) pairs parsed
+        host-side, the dequant FUSED into the donated scatter — no
+        extra device program on the hot adoption path."""
+        pools, bpos, btab = self._split_cache()
+        treedef, per_leaf, _per_block = self._wire_leaf_meta()
+        cb, idx = self._wire_chunk_idx(ctx, block_off, nblocks)
+        parsed = wirecodec.split_quant_payload(
+            memoryview(payload), per_leaf, nblocks
+        )
+        q_leaves, s_leaves = [], []
+        for (scales, q), (n_elem, shape, _dt) in zip(parsed, per_leaf):
+            # error-bound input BEFORE padding: the 1.0 fill scales of
+            # a partial chunk are never applied to real data and must
+            # not inflate the reported bound
+            self.wire_quant_max_scale = max(
+                self.wire_quant_max_scale,
+                float(scales.max()) if scales.size else 0.0,
+            )
+            if cb > nblocks:
+                q = np.concatenate(
+                    [q, np.zeros((cb - nblocks,) + tuple(shape),
+                                 np.int8)], axis=0)
+                scales = np.concatenate(
+                    [scales, np.ones((cb - nblocks,), np.float32)])
+            q_leaves.append(q)
+            s_leaves.append(scales.astype(np.float32).reshape(
+                (cb,) + (1,) * len(shape)))
+        chunk_q = jax.tree_util.tree_unflatten(treedef, q_leaves)
+        chunk_s = jax.tree_util.tree_unflatten(treedef, s_leaves)
+        new_pools = self._wire_put_quant(
+            pools, jnp.asarray(idx), chunk_q, chunk_s,
+        )
+        self.cache = dict(new_pools, pos=bpos, block_table=btab)
+        ctx["written"] = block_off + nblocks
+
     def wire_write(self, ctx, block_off: int, nblocks: int,
                    payload) -> None:
+        if ctx.get("codec") == wirecodec.CODEC_INT8:
+            return self._wire_write_quant(ctx, block_off, nblocks,
+                                          payload)
         pools, bpos, btab = self._split_cache()
         treedef, per_leaf, per_block = self._wire_leaf_meta()
-        expect = nblocks * per_block
         buf = memoryview(payload)
+        expect = nblocks * per_block
         if len(buf) != expect:
             raise ValueError(
                 f"chunk payload {len(buf)} bytes != expected {expect}"
             )
-        cb = max(ctx["chunk_blocks"], nblocks)
-        dst_ids = ctx["dst"][block_off:block_off + nblocks]
-        idx = np.zeros((cb,), np.int32)  # pad rows → garbage block 0
-        idx[:nblocks] = dst_ids
+        cb, idx = self._wire_chunk_idx(ctx, block_off, nblocks)
         chunk_leaves = []
         off = 0
         for n_elem, shape, dtype in per_leaf:
@@ -650,19 +918,56 @@ class DecodeEngine(PagedBatcher):
             num_new = int(meta.get("num_new", 1))
             submitted = float(meta.get("submitted", 0.0))
         except (KeyError, TypeError, ValueError) as e:
+            self._spec_rollback(ctx)
             self.pool.release(ctx["dst"])
             self._rids.discard(ctx["rid"])
             raise WireError(f"malformed wire stream meta: {e}") from e
-        self.queue.append(_PendingAdopt(
+        if seq_len + num_new > self.model.max_seq:
+            # backstop of the wire_open check (a sender could mutate
+            # its meta between OPEN and FIN): never adopt past max_seq
+            self._spec_rollback(ctx)
+            self.pool.release(ctx["dst"])
+            self._rids.discard(ctx["rid"])
+            raise WireError(
+                f"seq_len ({seq_len}) + num_new ({num_new}) exceeds "
+                f"max_seq ({self.model.max_seq})"
+            )
+        pa = _PendingAdopt(
             ctx["rid"], list(ctx["dst"]), seq_len, first, num_new,
             "wire", None, submitted,
-        ))
+        )
+        slot = ctx.get("slot")
+        with self._spec_lock:
+            reserved = (slot is not None
+                        and self._spec_slots.pop(slot, None) == ctx["rid"])
+        if reserved:
+            # the slot was held for this stream since OPEN: the fused
+            # bind fires NOW, on last-chunk arrival, without queueing
+            # behind other pending adoptions for a free slot
+            self._slot_blocks[slot] = list(ctx["dst"])
+            self._adopt_group([(slot, pa, list(ctx["dst"]))])
+            return
+        self.queue.append(pa)
         self._admit_pending()
+
+    def _spec_rollback(self, ctx) -> None:
+        """Retract a speculative reservation: free the slot and
+        un-publish the early first token.  Host-only — the reserved
+        slot never touched device state before FIN."""
+        slot = ctx.get("slot")
+        if slot is None:
+            return
+        with self._spec_lock:
+            if self._spec_slots.pop(slot, None) == ctx["rid"]:
+                self.out.pop(ctx["rid"], None)
+                SPEC_ROLLBACKS.inc()
+        ctx["slot"] = None
 
     def wire_abort(self, ctx) -> None:
         if ctx["closed"]:
             return
         ctx["closed"] = True
+        self._spec_rollback(ctx)
         if ctx["dst"]:
             self.pool.release(ctx["dst"])
         self._rids.discard(ctx["rid"])

@@ -32,12 +32,15 @@ from __future__ import annotations
 import collections
 import dataclasses
 import uuid
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from vtpu.analysis.witness import make_lock
 from vtpu import obs
+from vtpu.utils.envs import env_int
 
 _REG = obs.registry("serving")
+
+DEFAULT_PREFIX_CAP = env_int("VTPU_PREFIX_CACHE_CAP", 512)
 
 # K/V handoff instrumentation (docs/observability.md §Serving): adopt
 # outcomes by mode (shared = same-pool zero-copy rebind, copy = fused
@@ -71,6 +74,43 @@ HANDOFF_HOST_BYTES = _REG.counter(
 HANDOFF_STALE = _REG.counter(
     "vtpu_kv_handoff_stale_total",
     "Handle adoptions rejected because the generation stamp was stale",
+)
+
+# Speculative wire adoption (docs/serving.md §Wire transport): streams
+# whose slot/first-token bind began before FIN, and the rollbacks that
+# un-published them on abort/torn-stream exhaustion.
+SPEC_ADOPTIONS = _REG.counter(
+    "vtpu_kv_speculative_adoptions_total",
+    "Wire streams speculatively adopted (slot reserved and first token "
+    "published at OPEN, before FIN)",
+)
+SPEC_ROLLBACKS = _REG.counter(
+    "vtpu_kv_speculative_rollbacks_total",
+    "Speculative wire adoptions rolled back (stream aborted or torn "
+    "past its resume budget before FIN) — slot freed, first token "
+    "retracted, destination blocks released",
+)
+
+# Cluster-wide prefix cache (docs/serving.md §Prefix cache): pool-
+# registry outcomes plus a per-pool gauge of registry-pinned blocks.
+PREFIX_HITS = _REG.counter(
+    "vtpu_prefix_cache_hits_total",
+    "Prompt-prefix registry matches (prefill recompute skipped for the "
+    "matched block run)",
+)
+PREFIX_MISSES = _REG.counter(
+    "vtpu_prefix_cache_misses_total",
+    "Prompt-prefix registry lookups that matched nothing",
+)
+PREFIX_EVICTIONS = _REG.counter(
+    "vtpu_prefix_cache_evictions_total",
+    "Prefix runs evicted from a pool registry (LRU cap or lease "
+    "pressure)",
+)
+PREFIX_BLOCKS = _REG.gauge(
+    "vtpu_prefix_cache_blocks_total",
+    "Distinct pool blocks currently pinned by the prefix registry, "
+    "per pool",
 )
 
 class KVHandoffError(RuntimeError):
@@ -139,7 +179,7 @@ class BlockPool:
     """
 
     def __init__(self, total_blocks: int, block_size: int,
-                 pool_id: str = "") -> None:
+                 pool_id: str = "", prefix_cap: Optional[int] = None) -> None:
         if total_blocks < 2:
             raise ValueError(
                 f"BlockPool needs at least 2 blocks (block 0 is the "
@@ -151,6 +191,8 @@ class BlockPool:
         self.pool_id = pool_id or f"pool-{uuid.uuid4().hex[:12]}"
         self.total_blocks = total_blocks
         self.block_size = block_size
+        self.prefix_cap = (DEFAULT_PREFIX_CAP if prefix_cap is None
+                           else prefix_cap)
         self._lock = make_lock("serving.kvpool", reentrant=True)
         self.free: collections.deque[int] = collections.deque(
             range(1, total_blocks)
@@ -158,7 +200,22 @@ class BlockPool:
         self._refs: Dict[int, int] = {}
         self._stamp = 0
         self._detached: Dict[int, Tuple[int, ...]] = {}
-        self._detached_blocks: Set[int] = set()
+        # outstanding detached CLAIMS per block.  A claim consumes one
+        # of the block's references on adoption, so the invariant is
+        # claims[b] <= refs[b] — a prefix-shared block (refcount > 1)
+        # may legitimately back several in-flight handles at once, but
+        # one lease can never mint two claim tickets over one block.
+        self._detached_claims: "collections.Counter[int]" = (
+            collections.Counter()
+        )
+        # prefix registry: chained content digest → pinned block run
+        # (LRU; each entry holds one reference per block in its run)
+        self._prefix_runs: "collections.OrderedDict[str, Tuple[int, ...]]" = (
+            collections.OrderedDict()
+        )
+        self._prefix_pins: "collections.Counter[int]" = (
+            collections.Counter()
+        )
 
     # -- leases ---------------------------------------------------------
     def leasable(self) -> int:
@@ -245,9 +302,17 @@ class BlockPool:
                     raise DoubleReleaseError(
                         f"pool {self.pool_id}: detach of unleased block {b}"
                     )
-                if b in self._detached_blocks:
-                    # two adoptable handles over one block would be the
-                    # silent double-bind this protocol exists to stop
+                if (self._detached_claims[b] + 1
+                        > self._refs[b] - self._prefix_pins[b]):
+                    # more claim tickets than live NON-PIN references
+                    # over one block would be the silent double-bind
+                    # this protocol exists to stop.  Registry pins are
+                    # excluded from the claimable budget: they belong
+                    # to the registry, not to any lease — a prefix-
+                    # shared block carries one real reference PER
+                    # sharing handle (match_and_ref), so shared runs
+                    # detach fine, while double-detaching a lease whose
+                    # blocks happen to be registered still fails loudly
                     raise KVHandoffError(
                         f"pool {self.pool_id}: block {b} already belongs "
                         f"to a detached handle"
@@ -256,7 +321,7 @@ class BlockPool:
             handle = KVHandle(self.pool_id, tuple(blocks), seq_len,
                               self._stamp)
             self._detached[self._stamp] = handle.blocks
-            self._detached_blocks.update(handle.blocks)
+            self._detached_claims.update(handle.blocks)
             return handle
 
     def _claim(self, handle: KVHandle) -> Tuple[int, ...]:
@@ -275,7 +340,10 @@ class BlockPool:
                     f"pool {self.pool_id}: handle stamp {handle.stamp} is "
                     f"stale (already adopted or released)"
                 )
-            self._detached_blocks.difference_update(blocks)
+            for b in blocks:
+                self._detached_claims[b] -= 1
+                if self._detached_claims[b] <= 0:
+                    del self._detached_claims[b]
             return blocks
 
     def adopt(self, handle: KVHandle) -> List[int]:
@@ -290,6 +358,106 @@ class BlockPool:
         abandoned prefill."""
         self.release(self._claim(handle))
 
+    # -- cluster-wide prefix registry -----------------------------------
+    # Keys are chained block-granular content digests
+    # (vtpu/serving/prefix.py:chain_digests): digest i names the whole
+    # token prefix through block i, so matching a prompt is a longest-
+    # first walk of ITS chain against the registry — O(blocks) lookups.
+    # Every registered run pins one reference per block, so a run
+    # survives its creating lease; eviction (LRU cap, or lease
+    # pressure via evict_prefixes_for) just drops the pins — blocks
+    # free when the last sharer releases.
+
+    def _prefix_gauge(self) -> None:
+        PREFIX_BLOCKS.set(float(len(self._prefix_pins)),
+                          pool=self.pool_id)
+
+    def _evict_prefix_entry(self) -> None:
+        _digest, run = self._prefix_runs.popitem(last=False)
+        for b in run:
+            self._prefix_pins[b] -= 1
+            if self._prefix_pins[b] <= 0:
+                del self._prefix_pins[b]
+        self.release(run)
+        PREFIX_EVICTIONS.inc()
+
+    def register_prefix(self, chain: Sequence[str],
+                        blocks: Sequence[int]) -> None:
+        """Register every depth of a freshly written prefix: entry ``i``
+        maps ``chain[i]`` → ``blocks[:i+1]`` and pins those blocks with
+        one reference each.  The caller must hold live references on
+        ``blocks`` (its lease) and must only register once the K/V
+        write is ENQUEUED — device program order then guarantees a
+        later matching suffix prefill reads written blocks."""
+        if self.prefix_cap <= 0 or not chain:
+            return
+        with self._lock:
+            for i, digest in enumerate(chain):
+                if i >= len(blocks):
+                    break
+                if digest in self._prefix_runs:
+                    self._prefix_runs.move_to_end(digest)
+                    continue
+                run = tuple(blocks[:i + 1])
+                for b in run:
+                    if b not in self._refs:
+                        raise DoubleReleaseError(
+                            f"pool {self.pool_id}: prefix registration "
+                            f"over unleased block {b}"
+                        )
+                for b in run:
+                    self._refs[b] += 1
+                    self._prefix_pins[b] += 1
+                self._prefix_runs[digest] = run
+            while len(self._prefix_runs) > self.prefix_cap:
+                self._evict_prefix_entry()
+            self._prefix_gauge()
+
+    def match_and_ref(self, chain: Sequence[str],
+                      max_blocks: int) -> Tuple[List[int], int]:
+        """Longest registered run matching the prompt's digest chain,
+        capped at ``max_blocks`` (the caller must leave at least one
+        suffix token to prefill).  On a hit the matched blocks are
+        REFERENCED for the caller (atomic with the lookup — a
+        concurrent eviction cannot free them underneath) and
+        ``(blocks, matched block count)`` returns; a miss is
+        ``([], 0)``.  Hit/miss accounting is the ADMITTING caller's job
+        (``PREFIX_HITS``/``PREFIX_MISSES``): a head-of-line request
+        re-matching every backpressure round must count once, not once
+        per retry."""
+        with self._lock:
+            for k in range(min(len(chain), max_blocks), 0, -1):
+                run = self._prefix_runs.get(chain[k - 1])
+                if run is None:
+                    continue
+                self._prefix_runs.move_to_end(chain[k - 1])
+                for b in run:
+                    self._refs[b] += 1
+                return list(run), k
+            return [], 0
+
+    def prefix_match_depth(self, chain: Sequence[str]) -> int:
+        """Read-only longest match depth (blocks) — the router's
+        PrefixIndex verification probe; takes no references."""
+        with self._lock:
+            for k in range(len(chain), 0, -1):
+                if chain[k - 1] in self._prefix_runs:
+                    return k
+            return 0
+
+    def evict_prefixes_for(self, need: int) -> bool:
+        """Lease pressure: drop LRU registry entries until ``need``
+        blocks are free or the registry empties.  Registry-pinned
+        blocks must yield to real work; an entry whose blocks are still
+        shared by active slots frees nothing by itself, but its pins
+        drop so the blocks free when the sharers retire.  Returns True
+        when ``need`` blocks are now free."""
+        with self._lock:
+            while len(self.free) < need and self._prefix_runs:
+                self._evict_prefix_entry()
+            self._prefix_gauge()
+            return len(self.free) >= need
+
     # -- introspection --------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
@@ -299,4 +467,6 @@ class BlockPool:
                 "leased": len(self._refs),
                 "free": len(self.free),
                 "detached_handles": len(self._detached),
+                "prefix_runs": len(self._prefix_runs),
+                "prefix_blocks": len(self._prefix_pins),
             }

@@ -60,6 +60,7 @@ from vtpu import obs
 from vtpu.obs.events import EventType, emit
 from vtpu.scheduler.shard import HashRing
 from vtpu.serving.kvpool import KVHandoffError
+from vtpu.serving.prefix import PrefixIndex, chain_digests
 from vtpu.serving.transport import ReplicaSaturatedError
 
 log = logging.getLogger(__name__)
@@ -193,6 +194,19 @@ class Router:
         self._session_cap = 65536
         self._target: Dict[str, str] = {}       # rid → decode replica id
         self._rid_prefill: Dict[str, str] = {}  # rid → prefill id (queued)
+        # cluster-wide prefix cache, router half: prompts digest into
+        # chained block hashes and the PrefixIndex routes each request
+        # to the prefill replica already holding its longest live
+        # prefix (verified against that replica's pool registry — a
+        # pool-evicted hint is pruned, not followed).  Active only when
+        # a prefill replica opted into its pool registry.
+        self._prefix_block = 0
+        for pf in self.prefills.values():
+            if getattr(pf, "prefix_cache", False):
+                self._prefix_block = int(getattr(pf, "block_size", 0))
+                break
+        self._prefix_index = PrefixIndex() if self._prefix_block else None
+        self.prefix_routed = 0
         self._cancelled: set = set()            # rids released pre-handoff
         # saturated wire handoffs waiting for receiver credits:
         # (replica id, PrefillResult, source engine)
@@ -246,13 +260,24 @@ class Router:
             self._sessions.popitem(last=False)
         return rid
 
-    def _pick_prefill(self) -> str:
+    def _pick_prefill(self, chain=()) -> str:
         active = self._active_prefills()
         if not active:
             raise RouterReject(
                 "no_healthy_prefill",
                 "every prefill replica is drained",
             )
+        # prefix affinity first: a replica whose pool still holds the
+        # prompt's longest registered prefix skips that much recompute,
+        # which beats a shorter queue (the index verifies liveness
+        # against the pool registry before routing on a hint)
+        if chain and self._prefix_index is not None:
+            pid, _depth = self._prefix_index.route(
+                chain, {p: self.prefills[p] for p in active}
+            )
+            if pid is not None:
+                self.prefix_routed += 1
+                return pid
         # least-queued active prefill, id tiebreak for determinism; a
         # replica whose stats() raises (died since its last ping) is
         # skipped rather than picked-as-empty
@@ -277,6 +302,7 @@ class Router:
         prefill on the least-loaded active prefill replica.  Returns
         the chosen decode replica id; raises :class:`RouterReject` on
         shed."""
+        chain: list = []
         try:
             replica = self._route(session)
             # a replica dying between pings must not crash admission:
@@ -292,13 +318,34 @@ class Router:
                     "replica_saturated",
                     f"replica {replica} at {load} (≥ {limit})",
                 )
-            pid = self._pick_prefill()
+            # digest only past the cheap reject checks — a shed request
+            # never pays sha256-per-block
+            chain = (chain_digests([int(t) for t in prompt],
+                                   self._prefix_block)
+                     if self._prefix_index is not None else [])
+            pid = self._pick_prefill(chain)
         except RouterReject as e:
             self.shed += 1
             _REQS_TOTAL.inc(outcome="shed")
             _SHED_TOTAL.inc(reason=e.reason)
             raise
-        self.prefills[pid].submit(rid, prompt, num_new)
+        if (chain
+                and getattr(self.prefills[pid], "prefix_cache", False)
+                and getattr(self.prefills[pid], "block_size", 0)
+                == self._prefix_block):
+            # the chain is only valid at the granularity it was
+            # digested at — a replica with a different kv_block_size
+            # computes its own (mixed-granularity digests never match
+            # each other, so routing hints stay safe either way)
+            # hand the digest chain down so the engine doesn't re-hash
+            # the prompt, and record optimistically: the replica
+            # registers the run once its prefill enqueues; until then a
+            # route on this hint verifies against the pool and just
+            # misses (the unverified hint is KEPT, not followed)
+            self.prefills[pid].submit(rid, prompt, num_new, chain=chain)
+            self._prefix_index.record(chain, pid)
+        else:
+            self.prefills[pid].submit(rid, prompt, num_new)
         self._rid_prefill[rid] = pid
         self._target[rid] = replica
         self._pending[replica] = self._pending.get(replica, 0) + 1
@@ -395,6 +442,11 @@ class Router:
                     self._prefill_transition(pid, "prefill_drained",
                                              reason="ping")
                     self._shed_prefill_ledger(pid)
+                    if self._prefix_index is not None:
+                        # hints to a dead replica's pool are useless
+                        # until it restores — and a restored process
+                        # re-earns them on its next routed submits
+                        self._prefix_index.forget_replica(pid)
 
     def _shed_prefill_ledger(self, pid: str) -> None:
         """A health-drained prefill's queued rids may never produce
@@ -731,4 +783,8 @@ class Router:
             ),
             "parked_handoffs": len(self._parked),
             "pending_handoffs": dict(self._pending),
+            "prefix_index_entries": (len(self._prefix_index)
+                                     if self._prefix_index is not None
+                                     else 0),
+            "prefix_routed": self.prefix_routed,
         }

@@ -67,6 +67,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from vtpu.analysis.witness import make_lock
 from vtpu import obs
+from vtpu.serving import wirecodec
 from vtpu.serving.kvpool import (
     HANDOFF_HOST_BYTES,
     HANDOFF_STALE,
@@ -81,6 +82,7 @@ from vtpu.utils.envs import env_int
 log = logging.getLogger(__name__)
 
 __all__ = [
+    "CodecMismatchError",
     "CreditOverrunError",
     "DuplicateChunkError",
     "Frame",
@@ -132,6 +134,12 @@ TRANSPORT_STREAMS = _REG.counter(
     "vtpu_kv_transport_streams_total",
     "Wire streams by outcome (ok / aborted / saturated)",
 )
+CODEC_BYTES = _REG.counter(
+    "vtpu_kv_wire_codec_bytes_total",
+    "Wire data-chunk payload bytes applied at receivers, by negotiated "
+    "codec (fp32 = raw pool bytes, int8 = blockwise-quantized payload "
+    "+ per-block scales)",
+)
 
 MAGIC = b"VKVW"
 VERSION = 1
@@ -141,6 +149,12 @@ KIND_RESUME = 1
 KIND_ABORT = 2
 KIND_STATS = 3
 KIND_PING = 4
+# additive (the framing versions kinds): a data chunk whose payload is
+# the blockwise-int8 encoding (vtpu/serving/wirecodec.py) instead of
+# raw pool bytes.  Negotiated at OPEN — an old receiver never sees one.
+KIND_DATA_QUANT = 5
+
+_DATA_KINDS = (KIND_DATA, KIND_DATA_QUANT)
 
 FLAG_FIN = 0x01
 
@@ -184,6 +198,13 @@ class StreamAbortedError(WireError):
     receiver-side abort, or retries exhausted)."""
 
 
+class CodecMismatchError(WireError):
+    """A data chunk's kind disagrees with the codec negotiated for its
+    stream at OPEN (e.g. a sender switching to fp32 frames mid-stream
+    after a resume, on a stream the receiver accepted as int8) —
+    applying it would scatter misparsed bytes into the pool."""
+
+
 class ReplicaSaturatedError(WireError):
     """The receiver could not pre-lease any destination blocks — the
     decode pool is full.  Backpressure, not failure: the router parks
@@ -197,8 +218,8 @@ _ERROR_TYPES: Dict[str, type] = {
     for cls in (
         TruncatedChunkError, VersionSkewError, OutOfOrderChunkError,
         DuplicateChunkError, CreditOverrunError, StreamAbortedError,
-        ReplicaSaturatedError, StaleHandleError, PoolMismatchError,
-        WireError, KVHandoffError,
+        ReplicaSaturatedError, CodecMismatchError, StaleHandleError,
+        PoolMismatchError, WireError, KVHandoffError,
     )
 }
 
@@ -289,10 +310,10 @@ def decode_frame(data: bytes) -> Frame:
 class _RxStream:
     __slots__ = ("sid", "rid", "meta", "ctx", "nchunks", "next_seq",
                  "total_blocks", "received_blocks", "credits",
-                 "stamp_key", "opened")
+                 "stamp_key", "opened", "codec")
 
     def __init__(self, sid, rid, meta, ctx, nchunks, total_blocks,
-                 credits, stamp_key, opened):
+                 credits, stamp_key, opened, codec):
         self.sid = sid
         self.rid = rid
         self.meta = meta
@@ -304,6 +325,7 @@ class _RxStream:
         self.credits = credits
         self.stamp_key = stamp_key
         self.opened = opened
+        self.codec = codec
 
 
 class ReceiverHub:
@@ -397,11 +419,19 @@ class ReceiverHub:
                 if st.credits < st.total_blocks:
                     st.credits = int(self.sink.wire_top_up(st.ctx))
                     self._set_credit_gauge()
+                # the negotiated codec rides every RESUME response so a
+                # re-synced sender can never drift onto the wrong chunk
+                # kind mid-stream
                 return {"status": "ok", "next": st.next_seq,
-                        "credits": st.credits}
-            if frame.kind != KIND_DATA:
+                        "credits": st.credits, "codec": st.codec}
+            if frame.kind not in _DATA_KINDS:
                 raise WireError(f"unknown frame kind {frame.kind}")
             if frame.seq == 0:
+                if frame.kind != KIND_DATA:
+                    raise WireError(
+                        "stream OPEN must be a KIND_DATA frame (codec "
+                        "selection is meta-negotiated, not kind 0)"
+                    )
                 return self._open(frame)
             return self._data(frame)
 
@@ -428,19 +458,31 @@ class ReceiverHub:
                 f"receiver (mid-stream stamp reuse)"
             )
         total = len(handle.blocks)
-        ctx = self.sink.wire_open(rid, total, layout, chunk_blocks)
+        # codec negotiation: accept the advertised codec when the sink
+        # supports it, else fall back to fp32.  An OLD sender (no codec
+        # key) gets fp32; an old RECEIVER never reaches here with quant
+        # state because it simply omits "codec" from its response and
+        # the sender falls back.
+        advertised = str(meta.get("codec", wirecodec.CODEC_FP32))
+        supported = tuple(getattr(
+            self.sink, "wire_codecs", lambda: (wirecodec.CODEC_FP32,)
+        )())
+        codec = wirecodec.negotiate(advertised, supported)
+        ctx = self.sink.wire_open(rid, total, layout, chunk_blocks,
+                                  codec=codec, meta=meta)
         if ctx is None:
             TRANSPORT_STREAMS.inc(outcome="saturated")
             return {"status": "saturated", "credits": 0}
         credits = int(self.sink.wire_credits(ctx))
         st = _RxStream(frame.sid, rid, meta, ctx, frame.nchunks, total,
-                       credits, stamp_key, time.perf_counter())
+                       credits, stamp_key, time.perf_counter(), codec)
         self._streams[frame.sid] = st
         self._stamps[stamp_key] = frame.sid
         while len(self._stamps) > self._stamp_cap:
             self._stamps.popitem(last=False)
         self._set_credit_gauge()
-        return {"status": "ok", "next": 1, "credits": credits}
+        return {"status": "ok", "next": 1, "credits": credits,
+                "codec": codec}
 
     def _data(self, frame: Frame) -> dict:
         st = self._streams.get(frame.sid)
@@ -450,6 +492,14 @@ class ReceiverHub:
                 f"or never opened)"
             )
         try:
+            want_kind = (KIND_DATA_QUANT
+                         if st.codec == wirecodec.CODEC_INT8
+                         else KIND_DATA)
+            if frame.kind != want_kind:
+                raise CodecMismatchError(
+                    f"chunk kind {frame.kind} on a stream that "
+                    f"negotiated codec {st.codec!r} at OPEN"
+                )
             if frame.seq < st.next_seq:
                 raise DuplicateChunkError(
                     f"chunk {frame.seq} already applied "
@@ -488,6 +538,7 @@ class ReceiverHub:
             st.received_blocks = end
             TRANSPORT_CHUNKS.inc()
             TRANSPORT_BYTES.inc(len(frame.payload))
+            CODEC_BYTES.inc(len(frame.payload), codec=st.codec)
             # the wire path is the ONE place cache bytes legitimately
             # cross the host; account them in the handoff family too so
             # the old tripwire becomes a ledger (docs/serving.md)
@@ -667,10 +718,18 @@ class StreamSender:
         retries: int = 0,
         on_done: Optional[Callable[[bool], None]] = None,
         extract_fn: Optional[Callable[[], object]] = None,
+        codec: str = "",
     ) -> None:
         self.link = link
         self.rid = rid
         self.handle = handle
+        # the codec this sender ADVERTISES in the OPEN meta; the
+        # receiver's answer (or its absence — an old receiver) settles
+        # self.codec before the first data chunk ships, and before the
+        # deferred extract_fn runs, so the extract encodes the codec
+        # the receiver actually accepted
+        self.advertise = codec or wirecodec.DEFAULT_CODEC
+        self.codec = wirecodec.CODEC_FP32
         # the extract may attach AFTER open(): the OPEN must precede the
         # source-pool claim (a saturated receiver leaves the handle
         # adoptable for a later retry), and the claim precedes the D2H.
@@ -694,6 +753,7 @@ class StreamSender:
                        else extract.layout() if extract is not None
                        else []),
             "chunk_blocks": self.chunk_blocks,
+            "codec": self.advertise,
             **(meta_extra or {}),
         }
         self._next = 0            # 0 = OPEN not yet acked
@@ -739,6 +799,10 @@ class StreamSender:
             # deployment-level retry of an already-decoding request)
             self._next = int(rsp.get("next", self._next))
             self._credits = int(rsp.get("credits", self._credits))
+            # re-sync to the NEGOTIATED codec: a resumed sender must
+            # never drift onto the other chunk kind mid-stream (the
+            # receiver would reject it as CodecMismatchError)
+            self.codec = str(rsp.get("codec", self.codec))
             return rsp
         self.abort()
         raise StreamAbortedError(
@@ -760,6 +824,10 @@ class StreamSender:
             )
         self._next = int(rsp.get("next", 1))
         self._credits = int(rsp.get("credits", 0))
+        # an old receiver answers without a codec key → fp32 fallback;
+        # a new one echoes what it accepted (the advertised codec, or
+        # its own fp32 fallback)
+        self.codec = str(rsp.get("codec", wirecodec.CODEC_FP32))
 
     def pump(self) -> bool:
         """Push every chunk the credit grant and the D2H readiness
@@ -798,8 +866,11 @@ class StreamSender:
                     return False  # D2H still in flight; ride next pump
                 payload = self.extract.payload(lo, hi)
                 flags = FLAG_FIN if self._next == self.nchunks else 0
+                kind = (KIND_DATA_QUANT
+                        if self.codec == wirecodec.CODEC_INT8
+                        else KIND_DATA)
                 rsp = self._send(encode_frame(
-                    KIND_DATA, self.sid, seq=self._next,
+                    kind, self.sid, seq=self._next,
                     nchunks=self.nchunks, block_off=lo, nblocks=hi - lo,
                     flags=flags, payload=payload,
                 ))
@@ -853,12 +924,16 @@ class WireReplica:
     deployment, not the router."""
 
     def __init__(self, link, replica_id: str, *, local=None,
-                 chunk_blocks: int = 0, retries: int = 0) -> None:
+                 chunk_blocks: int = 0, retries: int = 0,
+                 codec: str = "") -> None:
         self.link = link
         self.replica_id = replica_id
         self._local = local
         self.chunk_blocks = chunk_blocks or DEFAULT_CHUNK_BLOCKS
         self.retries = retries or DEFAULT_STREAM_RETRIES
+        # advertised to each stream's receiver; fp32 stays the token-
+        # exact default (VTPU_KV_WIRE_CODEC flips the fleet)
+        self.codec = codec or wirecodec.DEFAULT_CODEC
         self._senders: List[StreamSender] = []
 
     # -- router surface -------------------------------------------------
@@ -891,6 +966,7 @@ class WireReplica:
                         "num_new": int(num_new),
                         "submitted": float(submitted)},
             chunk_blocks=self.chunk_blocks, retries=self.retries,
+            codec=self.codec,
         )
         # OPEN before claiming: a saturated receiver must leave the
         # handle adoptable so the router can park and re-deliver it once
@@ -901,8 +977,11 @@ class WireReplica:
         # the gather dispatch + D2H issue happen at the FIRST PUMP (the
         # writer thread), overlapped with whatever the prefill engine
         # computes next; the claim above keeps the blocks stable until
-        # then
-        sender.extract_fn = lambda: source.start_extract(blocks)
+        # then.  The codec is settled by the OPEN ack above, so the
+        # deferred extract encodes what the receiver accepted.
+        sender.extract_fn = (
+            lambda: source.start_extract(blocks, codec=sender.codec)
+        )
 
         def _done(ok: bool, _blocks=blocks, _pool=source.pool) -> None:
             # the D2H gather was enqueued before any later source-pool

@@ -1,0 +1,142 @@
+"""Wire-chunk codecs for the K/V transport (ROADMAP item 2a).
+
+The PR 10 transport shipped raw pool bytes — fp32/bf16 cache leaves at
+their at-rest width.  This module defines the *additive* quantized
+codec the framing's versioned chunk kinds make possible:
+
+- ``fp32`` (``KIND_DATA``): the original payload — the blocks' bytes
+  per cache leaf in flatten order, token-exact by construction.  The
+  default (``VTPU_KV_WIRE_CODEC=fp32``).
+- ``int8`` (``KIND_DATA_QUANT``): per **block** symmetric int8 with one
+  f32 scale per (block, leaf) — ``vtpu/ops/quant.py``'s blockwise
+  quantizer, fused into the sender's device gather so the D2H itself
+  moves ~4x fewer bytes.  Chunk payload layout, per leaf in flatten
+  order:
+
+  ``f32-LE scales [nblocks] ‖ int8 payload [nblocks × n_elem]``
+
+  The receiver fuses the dequant (``convert · scale``) into the
+  existing incremental per-chunk scatter — no extra device round trip
+  lands on the hot adoption path.  Per-element reconstruction error is
+  bounded by ``scale/2 = absmax_block/254`` (round-to-nearest), so the
+  int8 arm of ``make bench-disagg`` reports a greedy token-match
+  fraction alongside that bound instead of claiming exactness.
+
+Negotiation is in the OPEN handshake: the sender *advertises* a codec
+in the OPEN meta, the receiver answers with the codec it accepted
+(``negotiate``: the advertised codec if its sink supports it, else
+``fp32``).  An old receiver that predates this module ignores the meta
+key and answers without one — the sender falls back to fp32 and the
+stream is byte-identical to PR 10.  The codec is fixed per stream at
+OPEN; every RESUME response echoes it so a re-synced sender can never
+switch codecs mid-stream (a wrong-kind data chunk is a typed
+``CodecMismatchError`` at the receiver).
+
+This module is deliberately JAX-free (host-side parsing + numpy only):
+the device halves live in vtpu/serving/disagg.py behind
+``PrefillEngine.start_extract(codec=...)`` and the decode engine's
+fused ``_wire_put_quant``.
+"""
+
+# vtpu: hot-path — payload split/validation runs once per received
+# chunk on the adoption path; keep it allocation-light and sync-free.
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from vtpu.utils.envs import env_str
+
+CODEC_FP32 = "fp32"
+CODEC_INT8 = "int8"
+SUPPORTED = (CODEC_FP32, CODEC_INT8)
+
+# the sender-side default advertisement (fp32 stays the token-exact
+# default; int8 opts into the quantized chunk kind)
+DEFAULT_CODEC = env_str("VTPU_KV_WIRE_CODEC", CODEC_FP32)
+
+_SCALE_DTYPE = np.dtype("<f4")
+
+
+def negotiate(advertised: str, supported: Sequence[str]) -> str:
+    """The receiver's half of the OPEN handshake: accept the advertised
+    codec when the sink supports it, else fall back to fp32 (always
+    supported — the PR 10 wire format)."""
+    if advertised in supported and advertised in SUPPORTED:
+        return advertised
+    return CODEC_FP32
+
+
+def fp32_block_bytes(per_leaf: Sequence[Tuple[int, tuple, np.dtype]]) -> int:
+    """Raw-payload bytes of ONE block: per-leaf element count × leaf
+    itemsize (``per_leaf`` rows are ``(n_elem, shape, dtype)``)."""
+    return sum(n * np.dtype(dt).itemsize for n, _sh, dt in per_leaf)
+
+
+def quant_block_bytes(per_leaf: Sequence[Tuple[int, tuple, np.dtype]]) -> int:
+    """int8-payload bytes of ONE block: one int8 per element plus one
+    f32 scale per (block, leaf)."""
+    return sum(n + _SCALE_DTYPE.itemsize for n, _sh, _dt in per_leaf)
+
+
+def split_quant_payload(
+    buf, per_leaf: Sequence[Tuple[int, tuple, np.dtype]], nblocks: int
+) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Parse one ``KIND_DATA_QUANT`` chunk payload into per-leaf
+    ``(scales f32 [nblocks], q int8 [nblocks, *leaf shape])`` pairs.
+
+    Validation is exact and typed: a payload whose total length
+    mismatches — including a truncated *scale* segment — raises
+    ``ValueError`` naming the segment, which the receiver hub maps to
+    the stream-aborting ``TruncatedChunkError``."""
+    buf = memoryview(buf)
+    expect = nblocks * quant_block_bytes(per_leaf)
+    if len(buf) != expect:
+        raise ValueError(
+            f"quant chunk payload {len(buf)} bytes != expected {expect} "
+            f"(truncated scale or data segment)"
+        )
+    out: List[Tuple[np.ndarray, np.ndarray]] = []
+    off = 0
+    for n_elem, shape, _dt in per_leaf:
+        sb = nblocks * _SCALE_DTYPE.itemsize
+        if off + sb > len(buf):
+            raise ValueError("truncated scale segment in quant chunk")
+        scales = np.frombuffer(buf[off:off + sb], dtype=_SCALE_DTYPE)
+        off += sb
+        qb = nblocks * n_elem
+        q = np.frombuffer(buf[off:off + qb], dtype=np.int8)
+        q = q.reshape((nblocks,) + tuple(shape))
+        off += qb
+        out.append((scales, q))
+    return out
+
+
+def quantize_blocks_np(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side twin of ``vtpu.ops.quant.quantize_blockwise`` (numpy,
+    for fakes/tests and host-resident extracts): one f32 scale per
+    leading-axis slice, absmax over the rest."""
+    xf = x.astype(np.float32)
+    axes = tuple(range(1, x.ndim))
+    amax = np.max(np.abs(xf), axis=axes) if axes else np.abs(xf)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    q = np.clip(np.round(xf / scale.reshape(bshape)), -127, 127)
+    return q.astype(np.int8), scale
+
+
+def dequantize_blocks_np(q: np.ndarray, scale: np.ndarray,
+                         dtype) -> np.ndarray:
+    bshape = (q.shape[0],) + (1,) * (q.ndim - 1)
+    return (q.astype(np.float32)
+            * scale.reshape(bshape).astype(np.float32)).astype(dtype)
+
+
+def error_bound(max_scale: float) -> float:
+    """The documented per-element reconstruction bound for a stream's
+    largest applied block scale: ``scale/2`` (symmetric
+    round-to-nearest) — the receiver tracks the running max
+    (``DecodeEngine.wire_quant_max_scale``) and the bench reports this
+    of it."""
+    return float(max_scale) / 2.0
