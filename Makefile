@@ -10,8 +10,8 @@ PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
 	bench-sched bench-serve bench-churn bench-disagg bench-gang \
-	bench-goodput bench-smoke check obs-lint config-lint audit-check \
-	image chart clean tidy
+	bench-goodput bench-migrate bench-smoke check obs-lint config-lint \
+	audit-check image chart clean tidy
 
 all: build
 
@@ -231,6 +231,21 @@ ifdef SMOKE
 	$(PY) benchmarks/serving_disagg.py --smoke
 else
 	$(PY) benchmarks/serving_disagg.py
+endif
+
+# live-session-migration proof: drain-via-migration vs finish-in-place
+# on an evicted decode replica (virtual clocks, real mover + transport
+# + pools) — session-completion latency, lost-work tokens, and the
+# suffix-only wire-bytes savings when the target already holds the
+# digest-matched prefix → docs/artifacts/serving_migrate.json
+# (docs/serving.md#session-migration explains the numbers).  SMOKE=1
+# runs a seconds-long schema pass (tier-1 safe; also exercised by
+# tests/test_migrate.py).
+bench-migrate:
+ifdef SMOKE
+	$(PY) benchmarks/serving_migrate.py --smoke
+else
+	$(PY) benchmarks/serving_migrate.py
 endif
 
 # every benchmark's smoke mode, artifacts redirected to scratch, each

@@ -1,0 +1,445 @@
+#!/usr/bin/env python3
+"""`make bench-migrate`: drain-via-migration vs finish-in-place on an
+evicted decode replica — session-completion latency, lost-work tokens,
+and suffix-only wire-bytes savings.
+
+The scenario is ROADMAP item 1's drain leg: a decode replica co-located
+as a best-effort tenant gets `vtpu.io/evict-requested` (the PR 9
+ContentionArbiter) while it holds live mid-decode sessions.  Before
+this PR the router's only move was finish-in-place: the squeezed
+replica limps its sessions along under the throttle ladder until the
+eviction deadline kills the pod — everything still decoding at that
+point is LOST and restarts from the prompt on a healthy replica.  The
+session mover (vtpu/serving/migrate.py) instead streams each live
+session's K/V + cursor + tail to a healthy replica over the wire
+transport and resumes token-exactly: zero lost work, full-speed decode.
+
+Virtual-clock idiom (PR 7): the REAL mover + transport + BlockPool
+protocol runs end to end — real frames, credits, digest matching — on
+fake decode replicas whose decode/step and wire costs charge a virtual
+clock, so the bench measures policy, not host speed, and runs in
+seconds.  Costs are order-of-magnitude serving numbers (see CONFIG).
+
+Phases:
+  1. **drain**: N sessions mid-decode on the victim when the evict
+     lands.  Arms: ``finish_in_place`` (throttle ×4 until the deadline,
+     then death + restart-from-prompt on the healthy replica) vs
+     ``migrate`` (mover streams every session out at evict time).
+     Reported: per-session completion latency (p50/p95), lost-work
+     tokens, wire bytes spent.
+  2. **suffix**: M sessions sharing a long system-prompt prefix migrate
+     one after another; the first registers the chain at the target,
+     the rest skip the digest-matched prefix.  Reported: wire bytes
+     with suffix-only vs chains stripped, and the savings factor.
+
+SMOKE=1 (`--smoke`) runs a seconds-long schema-complete pass — tier-1
+rides it via tests/test_migrate.py.  Artifact:
+docs/artifacts/serving_migrate.json (docs/serving.md#session-migration
+explains how to read the numbers).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from vtpu.serving import transport as tp                     # noqa: E402
+from vtpu.serving.kvpool import BlockPool                    # noqa: E402
+from vtpu.serving.migrate import (                           # noqa: E402
+    MigrationError,
+    SessionExport,
+    SessionGoneError,
+    SessionMover,
+)
+from vtpu.serving.prefix import chain_digests                # noqa: E402
+
+BS = 16                      # tokens per block
+BLOCK_BYTES = 16384          # wire payload bytes per block (fp32 K/V)
+LAYOUT = [{"shape": [BLOCK_BYTES // 4], "dtype": "float32"}]
+
+CONFIG = dict(
+    sessions=24,             # live sessions on the victim at evict time
+    prompt_tokens=96,        # per session
+    num_new=160,             # decode budget per session
+    decoded_at_evict=64,     # tokens already generated when evict lands
+    step_s=0.030,            # one decode window (all slots) at full speed
+    throttle=4.0,            # squeeze ladder factor on the evicted pod
+    deadline_s=5.0,          # evict-requested → pod death (the squeezed
+    # replica needs ~11.5 s to finish its tails: finish-in-place can't)
+    prefill_s=0.25,          # restart cost: re-prefill the prompt
+    wire_bw=2.0e9,           # bytes/s between replicas
+    suffix_sessions=20,
+    suffix_prefix_tokens=64,
+    suffix_tail_tokens=16,
+    seed=7,
+)
+
+SMOKE_CONFIG = dict(
+    CONFIG, sessions=6, num_new=40, decoded_at_evict=12, deadline_s=1.0,
+    suffix_sessions=5, prompt_tokens=48, suffix_prefix_tokens=32,
+)
+
+
+class VClock:
+    def __init__(self) -> None:
+        self.t = 0.0
+
+    def now(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class ChargingLink:
+    """LoopbackLink that charges frame bytes to the virtual clock —
+    the wire cost of a migration, measured in virtual seconds."""
+
+    def __init__(self, hub: tp.ReceiverHub, clock: VClock,
+                 bw: float) -> None:
+        self.hub = hub
+        self.clock = clock
+        self.bw = bw
+        self.bytes = 0
+
+    def send(self, data: bytes, fresh: bool = False) -> dict:
+        self.bytes += len(data)
+        self.clock.advance(len(data) / self.bw)
+        return self.hub.handle(data)
+
+    def close(self) -> None:
+        pass
+
+
+class _Extract:
+    def __init__(self, blobs):
+        self.blobs = blobs
+        self.nblocks = len(blobs)
+        self.per_block = BLOCK_BYTES
+
+    def layout(self):
+        return list(LAYOUT)
+
+    def ready_blocks(self):
+        return self.nblocks
+
+    def payload(self, lo, hi):
+        return b"".join(self.blobs[lo:hi])
+
+
+class VirtualReplica:
+    """Session-surface decode replica on a virtual clock: real
+    BlockPool + real wire sink (session OPEN docs, digest matching,
+    registration), deterministic byte contents, step() charges
+    ``step_s × throttle`` per decode window."""
+
+    def __init__(self, rid: str, clock: VClock, cfg: dict,
+                 blocks: int = 8193) -> None:
+        self.replica_id = rid
+        self.clock = clock
+        self.cfg = cfg
+        self.pool = BlockPool(blocks, BS)
+        self.block_size = BS
+        self.sessions = {}
+        self.content = {}          # block → BLOCK_BYTES bytes
+        self._rids = set()
+        self.throttle = 1.0
+        self.alive = True
+        self.completions = {}      # rid → virtual completion stamp
+        self.hub = tp.ReceiverHub(self)
+        self.link = ChargingLink(self.hub, clock, cfg["wire_bw"])
+
+    # -- seeding / decode ----------------------------------------------
+    def seed_session(self, rid, prompt, num_new, decoded, register):
+        need = -(-(len(prompt) + num_new) // BS)
+        blks = self.pool.lease(need)
+        for j, b in enumerate(blks):
+            self.content[b] = bytes(
+                [(hash((tuple(prompt[:(j + 1) * BS]), j)) >> s) & 0xFF
+                 for s in (0, 8, 16, 24)]) * (BLOCK_BYTES // 4)
+        chain = chain_digests(list(prompt), BS) if register else []
+        if chain:
+            self.pool.register_prefix(chain, blks)
+        st = {"blocks": blks, "base": len(prompt),
+              "tail": list(range(decoded)), "remaining":
+              num_new - decoded, "frozen": False, "chain": chain,
+              "prompt": list(prompt)}
+        self.sessions[rid] = st
+        self._rids.add(rid)
+        return st
+
+    def step(self):
+        if not self.alive or not self.sessions:
+            return
+        self.clock.advance(self.cfg["step_s"] * self.throttle)
+        for rid in list(self.sessions):
+            st = self.sessions[rid]
+            if st["remaining"] <= 0:
+                continue
+            st["tail"].append(len(st["tail"]))
+            st["remaining"] -= 1
+            if st["remaining"] <= 0:
+                self.completions[rid] = self.clock.now()
+                self.pool.release(st["blocks"])
+                del self.sessions[rid]
+
+    def kill(self):
+        """Pod death: every live session's generated work is lost."""
+        self.alive = False
+        lost = {}
+        for rid, st in self.sessions.items():
+            lost[rid] = (len(st["tail"]), st["prompt"], st["remaining"])
+            self.pool.release(st["blocks"])
+        self.sessions.clear()
+        return lost
+
+    # -- mover source surface ------------------------------------------
+    def exportable_sessions(self):
+        return sorted(self.sessions)
+
+    def export_session(self, rid):
+        st = self.sessions.get(rid)
+        if st is None:
+            raise SessionGoneError(f"{rid} not live")
+        cursor = st["base"] + len(st["tail"]) - 1
+        handle = self.pool.detach(st["blocks"], seq_len=cursor)
+        del self.sessions[rid]
+        self._rids.discard(rid)
+        return SessionExport(
+            rid=rid, handle=handle, cursor=cursor,
+            tail=tuple(st["tail"]), remaining=st["remaining"],
+            frozen=False, chain=tuple(st["chain"]), block_size=BS)
+
+    def adopt_session(self, export, *, blocks=None, submitted=0.0):
+        if blocks is None:
+            blocks = self.pool.adopt(export.handle)
+        tail = list(export.tail)
+        self.sessions[export.rid] = {
+            "blocks": list(blocks),
+            "base": export.cursor - (len(tail) - 1), "tail": tail,
+            "remaining": export.remaining, "frozen": export.frozen,
+            "chain": list(export.chain), "prompt": None}
+        self._rids.add(export.rid)
+
+    def wire_layout(self):
+        return list(LAYOUT)
+
+    def start_extract(self, blocks, codec="fp32"):
+        return _Extract([self.content[b] for b in blocks])
+
+    # -- wire sink ------------------------------------------------------
+    def wire_open(self, rid, total_blocks, layout, chunk_blocks,
+                  codec="fp32", meta=None):
+        sess = (meta or {}).get("session")
+        chain = (sess or {}).get("chain") or []
+        shared, skip = [], 0
+        if chain and total_blocks > 1:
+            shared, skip = self.pool.match_and_ref(
+                chain, min(len(chain), total_blocks - 1))
+        dst = self.pool.lease_upto(total_blocks - skip)
+        if not dst:
+            if shared:
+                self.pool.release(shared)
+            return None
+        self._rids.add(rid)
+        return {"rid": rid, "dst": dst, "total": total_blocks - skip,
+                "skip": skip, "shared": shared, "closed": False,
+                "codec": codec, "session": sess}
+
+    def wire_credits(self, ctx):
+        return len(ctx["dst"])
+
+    def wire_top_up(self, ctx):
+        need = ctx["total"] - len(ctx["dst"])
+        if need > 0 and not ctx["closed"]:
+            ctx["dst"].extend(self.pool.lease_upto(need))
+        return len(ctx["dst"])
+
+    def wire_write(self, ctx, block_off, nblocks, payload):
+        buf = bytes(payload)
+        for i in range(nblocks):
+            self.content[ctx["dst"][block_off + i]] = \
+                buf[i * BLOCK_BYTES:(i + 1) * BLOCK_BYTES]
+
+    def wire_finish(self, ctx, meta):
+        ctx["closed"] = True
+        sess = meta["session"]
+        blocks = list(ctx["shared"]) + list(ctx["dst"])
+        tail = [int(t) for t in sess["tail"]]
+        st = {"blocks": blocks,
+              "base": int(sess["cursor"]) - (len(tail) - 1),
+              "tail": tail, "remaining": int(sess["remaining"]),
+              "frozen": bool(sess.get("done")),
+              "chain": list(sess.get("chain") or []), "prompt": None}
+        self.sessions[ctx["rid"]] = st
+        if st["chain"] and int(sess.get("chain_bs", BS)) == BS:
+            self.pool.register_prefix(st["chain"][:len(blocks)], blocks)
+
+    def wire_abort(self, ctx):
+        if ctx["closed"]:
+            return
+        ctx["closed"] = True
+        blocks = list(ctx.get("shared") or []) + list(ctx["dst"])
+        if blocks:
+            self.pool.release(blocks)
+        self._rids.discard(ctx["rid"])
+
+    def ping(self):
+        return self.alive
+
+    def stats(self):
+        return {"max_batch": 64, "active_slots": len(self.sessions),
+                "queued": 0, **self.pool.stats()}
+
+
+def percentile(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def prompts(cfg, n, seed_off=0):
+    import random
+
+    rng = random.Random(cfg["seed"] + seed_off)
+    return [[rng.randrange(0, 32000) for _ in range(cfg["prompt_tokens"])]
+            for _ in range(n)]
+
+
+def run_drain_arm(cfg: dict, migrate: bool) -> dict:
+    clock = VClock()
+    victim = VirtualReplica("victim", clock, cfg)
+    healthy = VirtualReplica("healthy", clock, cfg)
+    for i, prompt in enumerate(prompts(cfg, cfg["sessions"])):
+        # heterogeneous budgets/progress: completion latency spreads
+        nn = cfg["num_new"] + (i % 5) * 8
+        dec = min(cfg["decoded_at_evict"] + (i % 7) * 4, nn - 4)
+        victim.seed_session(f"s{i}", prompt, nn, dec, register=False)
+    lost_tokens = 0
+    migrations = 0
+    wire_bytes0 = healthy.link.bytes
+    if migrate:
+        mover = SessionMover(clock=clock.now)
+        for rid in victim.exportable_sessions():
+            try:
+                mover.move(rid, victim, [("healthy", healthy)])
+                migrations += 1
+            except MigrationError:
+                pass  # finish-in-place fallback (restored)
+    else:
+        victim.throttle = cfg["throttle"]   # the squeeze ladder
+    deadline = clock.now() + cfg["deadline_s"]
+    while victim.sessions or healthy.sessions:
+        if victim.alive and not migrate and clock.now() >= deadline:
+            for rid, (done, prompt, rem) in victim.kill().items():
+                # restart from the prompt on the healthy replica: the
+                # generated tokens are lost work, re-decoded from 0
+                lost_tokens += done
+                clock.advance(cfg["prefill_s"])
+                healthy.seed_session(rid, prompt, done + rem, 1,
+                                     register=False)
+        if victim.sessions:
+            victim.step()
+        if healthy.sessions:
+            healthy.step()
+    completions = {**victim.completions, **healthy.completions}
+    lat = list(completions.values())
+    return {
+        "sessions": cfg["sessions"],
+        "migrations": migrations,
+        "completion_p50_s": round(percentile(lat, 0.50), 3),
+        "completion_p95_s": round(percentile(lat, 0.95), 3),
+        "completion_mean_s": round(sum(lat) / max(1, len(lat)), 3),
+        "lost_work_tokens": lost_tokens,
+        "wire_bytes": healthy.link.bytes - wire_bytes0,
+    }
+
+
+def run_suffix_phase(cfg: dict, suffix_only: bool) -> dict:
+    import random
+
+    clock = VClock()
+    victim = VirtualReplica("victim", clock, cfg)
+    healthy = VirtualReplica("healthy", clock, cfg)
+    rng = random.Random(cfg["seed"] + 99)
+    prefix = [rng.randrange(0, 32000)
+              for _ in range(cfg["suffix_prefix_tokens"])]
+    for i in range(cfg["suffix_sessions"]):
+        tail = [rng.randrange(0, 32000)
+                for _ in range(cfg["suffix_tail_tokens"])]
+        victim.seed_session(f"p{i}", prefix + tail, cfg["num_new"], 8,
+                            register=suffix_only)
+    mover = SessionMover(clock=clock.now)
+    skipped = 0
+    for rid in victim.exportable_sessions():
+        rep = mover.move(rid, victim, [("healthy", healthy)])
+        skipped += rep.blocks_skipped
+    return {"wire_bytes": healthy.link.bytes, "blocks_skipped": skipped,
+            "sessions": cfg["suffix_sessions"]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "artifacts", "serving_migrate.json"))
+    args = ap.parse_args(argv)
+    cfg = dict(SMOKE_CONFIG if args.smoke else CONFIG)
+
+    arms = {
+        "finish_in_place": run_drain_arm(cfg, migrate=False),
+        "migrate": run_drain_arm(cfg, migrate=True),
+    }
+    full = run_suffix_phase(cfg, suffix_only=False)
+    suf = run_suffix_phase(cfg, suffix_only=True)
+    fi, mi = arms["finish_in_place"], arms["migrate"]
+    result = {
+        "bench": "serving_migrate",
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime()),
+        "smoke": bool(args.smoke),
+        "config": cfg,
+        "arms": arms,
+        "suffix": {
+            "full_wire_bytes": full["wire_bytes"],
+            "suffix_wire_bytes": suf["wire_bytes"],
+            "blocks_skipped": suf["blocks_skipped"],
+            "savings_x": round(
+                full["wire_bytes"] / max(1, suf["wire_bytes"]), 3),
+        },
+        "headline": {
+            "lost_tokens_finish_in_place": fi["lost_work_tokens"],
+            "lost_tokens_migrate": mi["lost_work_tokens"],
+            "completion_p95_speedup_x": round(
+                fi["completion_p95_s"] / max(1e-9,
+                                             mi["completion_p95_s"]), 3),
+            "suffix_savings_x": round(
+                full["wire_bytes"] / max(1, suf["wire_bytes"]), 3),
+        },
+    }
+    # acceptance: migration strands no work; suffix-only measurably
+    # cheaper when the target already holds the prefix
+    assert mi["lost_work_tokens"] == 0
+    assert mi["migrations"] == cfg["sessions"]
+    assert fi["lost_work_tokens"] > 0
+    assert suf["wire_bytes"] < full["wire_bytes"]
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1, sort_keys=False)
+        f.write("\n")
+    print(json.dumps(result["headline"], indent=1))
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

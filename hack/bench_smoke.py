@@ -70,6 +70,11 @@ BENCHES = {
         [sys.executable, "benchmarks/serving_disagg.py", "--smoke"],
         {},
     ),
+    "migrate": (
+        "serving_migrate.json",
+        [sys.executable, "benchmarks/serving_migrate.py", "--smoke"],
+        {},
+    ),
 }
 
 # paths (tuples of dict keys from the artifact root) whose KEY SETS are

@@ -615,3 +615,172 @@ def test_oversized_wire_stream_refused_typed(model_and_params):
     # the OPEN refused before the claim: the handle is still adoptable
     pf.pool.release_handle(res.handle)
     assert _leak_free(pf.pool) and _leak_free(dec.pool)
+
+
+# ---------------------------------------------------------------------------
+# live session migration over real engines (vtpu/serving/migrate.py)
+# ---------------------------------------------------------------------------
+
+def _mig_reqs(seed=53, n=6, num_new=8):
+    rng = np.random.default_rng(seed)
+    lens = [5, 9, 12, 16, 7, 11]
+    return [(f"m{i}", rng.integers(0, 64, lens[i % len(lens)]).astype(
+        np.int32), num_new) for i in range(n)]
+
+
+def _drain_engine(eng):
+    while any(eng.active) or eng._inflight or eng.queue:
+        eng.step()
+    eng._flush_first_tokens()
+
+
+def test_migrate_mid_decode_token_exact_and_leak_free(model_and_params):
+    """The acceptance contract: a session migrated mid-decode produces
+    the IDENTICAL token sequence as the never-migrated control (fp32
+    path), with zero leaked blocks on source and target pools."""
+    from vtpu.serving.migrate import SessionMover
+
+    m, params = model_and_params
+    reqs = _mig_reqs()
+    want = run_monolithic(m, params, reqs)
+    pf = PrefillEngine(m, params)
+    A = DecodeEngine(m, params, max_batch=8, eos_id=2, replica_id="A")
+    B = DecodeEngine(m, params, max_batch=8, eos_id=2, replica_id="B")
+    for rid, p, n in reqs:
+        pf.submit(rid, p, num_new=n)
+    for res in pf.run():
+        A.submit_handle(res.rid, res.handle, res.first_token,
+                        res.num_new, source=pf)
+    for _ in range(3):
+        A.step()                        # a few windows into decode
+    mover = SessionMover()
+    moved = []
+    for rid in list(A.exportable_sessions())[:3]:
+        rep = mover.move(rid, A, [("B", B)])
+        assert rep.target == "B"
+        moved.append(rid)
+    assert moved
+    _drain_engine(A)
+    _drain_engine(B)
+    got = dict(A.out)
+    got.update(B.out)
+    assert got == want                  # token-exact, no lost work
+    for rid in moved:
+        assert rid in B.out and rid not in A.out
+    assert _leak_free(pf.pool) and _leak_free(A.pool) \
+        and _leak_free(B.pool)
+
+
+def test_migrate_suffix_only_real_engines(model_and_params):
+    """Sessions sharing a prompt prefix: the first migration ships every
+    block and registers the chain at the target; the second ships only
+    its suffix (digest-matched skip) and both stay token-exact."""
+    from vtpu.serving.migrate import SessionMover
+
+    m, params = model_and_params
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(0, 64, 16).astype(np.int32)   # 2 full blocks
+    reqs = [(f"s{i}", np.concatenate(
+        [prefix, rng.integers(0, 64, 3 + i).astype(np.int32)]), 8)
+        for i in range(3)]
+    want = run_monolithic(m, params, reqs)
+    pf = PrefillEngine(m, params, prefix_cache=True)
+    A = DecodeEngine(m, params, max_batch=4, eos_id=2, replica_id="A")
+    B = DecodeEngine(m, params, max_batch=4, eos_id=2, replica_id="B")
+    for rid, p, n in reqs:
+        pf.submit(rid, p, num_new=n)
+    for res in pf.run():
+        assert len(res.chain) == 2      # the prefill's digest chain
+        A.submit_handle(res.rid, res.handle, res.first_token,
+                        res.num_new, source=pf, chain=list(res.chain))
+    for _ in range(3):
+        A.step()
+    mover = SessionMover()
+    r1 = mover.move("s0", A, [("B", B)])
+    r2 = mover.move("s1", A, [("B", B)])
+    assert r1.blocks_skipped == 0       # cold target: everything ships
+    assert r2.blocks_skipped == 2       # suffix-only: prefix matched
+    assert r2.blocks_shipped == r1.blocks_shipped - 2
+    _drain_engine(A)
+    _drain_engine(B)
+    got = dict(A.out)
+    got.update(B.out)
+    assert got == want
+    # only registry pins survive on any pool (prefix caching is live
+    # on the prefill AND — via decode-side adoption — on both replicas)
+    for pool in (A.pool, B.pool, pf.pool):
+        st = pool.stats()
+        assert st["leased"] == st["prefix_blocks"]
+        assert st["detached_handles"] == 0
+
+
+def test_migrate_torn_stream_restores_on_source_real_engines(
+        model_and_params):
+    """A persistently torn migration stream: typed failure, the session
+    restored on the SOURCE continues token-exactly, both pools clean."""
+    from vtpu.serving import transport as tp
+    from vtpu.serving.migrate import MigrationError, SessionMover
+
+    m, params = model_and_params
+    reqs = _mig_reqs(seed=59, n=2)
+    want = run_monolithic(m, params, reqs)
+    pf = PrefillEngine(m, params)
+    A = DecodeEngine(m, params, max_batch=4, eos_id=2, replica_id="A")
+    B = DecodeEngine(m, params, max_batch=4, eos_id=2, replica_id="B")
+    for rid, p, n in reqs:
+        pf.submit(rid, p, num_new=n)
+    for res in pf.run():
+        A.submit_handle(res.rid, res.handle, res.first_token,
+                        res.num_new, source=pf)
+    for _ in range(2):
+        A.step()
+
+    def fault(data):
+        fr = tp.decode_frame(data)
+        if fr.kind in (tp.KIND_DATA, tp.KIND_DATA_QUANT) and fr.seq >= 1:
+            raise OSError("torn")
+
+    mover = SessionMover(chunk_blocks=1, retries=2)
+    mover._hubs[id(B)] = tp.LoopbackLink(tp.ReceiverHub(B), fault=fault)
+    with pytest.raises(MigrationError) as ei:
+        mover.move("m0", A, [("B", B)])
+    assert ei.value.restored is True
+    assert "m0" in A.exportable_sessions()
+    _drain_engine(A)
+    assert A.out == want                # finish-in-place, token-exact
+    assert _leak_free(pf.pool) and _leak_free(A.pool) \
+        and _leak_free(B.pool)
+
+
+def test_router_request_evict_migrates_real_engines(model_and_params):
+    """The full policy on real engines: an evict-requested replica's
+    pinned sessions migrate to the healthy replica and the merged
+    transcripts stay token-exact vs monolithic."""
+    from vtpu.serving.router import Router
+
+    m, params = model_and_params
+    reqs = _mig_reqs(seed=61, n=6)
+    want = run_monolithic(m, params, reqs)
+    pf = PrefillEngine(m, params)
+    reps = {
+        "A": DecodeEngine(m, params, max_batch=8, eos_id=2,
+                          replica_id="A"),
+        "B": DecodeEngine(m, params, max_batch=8, eos_id=2,
+                          replica_id="B"),
+    }
+    router = Router(pf, reps)
+    for i, (rid, p, n) in enumerate(reqs):
+        router.submit(f"sess{i}", rid, p, num_new=n)
+    for _ in range(3):
+        router.pump()                   # adopt everything, decode a bit
+    victims = reps["A"].exportable_sessions()
+    moved = router.request_evict("A")
+    assert moved == len(victims) > 0
+    assert not reps["A"].exportable_sessions()
+    assert router.stats()["sessions_pinned"]["A"] == 0
+    got = router.drain()
+    assert got == want
+    assert _leak_free(pf.pool)
+    for eng in reps.values():
+        assert eng.pool_stats()["leased"] == \
+            eng.pool_stats()["prefix_blocks"]

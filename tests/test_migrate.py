@@ -1,0 +1,934 @@
+"""Live session migration (vtpu/serving/migrate.py): the mover state
+machine, suffix-only negotiation, the mid-migration death-fuzz matrix
+(source dies / target dies / torn first-mid-every frame × fp32/int8 —
+both pools leak-free and token-exact continuation or typed failure),
+the router's migrate-on-drain policy, and a lock-witness soak over the
+new ``serving.session_mover`` locks.  JAX-free by design: fake decode
+replicas with deterministic token streams over real BlockPools drive
+the REAL mover + transport + pool protocol; the real-engine topology
+rides tests/test_disagg.py."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from vtpu.serving import transport as tp
+from vtpu.serving import wirecodec
+from vtpu.serving.kvpool import BlockPool, PoolMismatchError
+from vtpu.serving.migrate import (
+    MIGRATIONS_TOTAL,
+    MigrationAmbiguousError,
+    MigrationError,
+    NoMigrationTargetError,
+    SessionExport,
+    SessionGoneError,
+    SessionMover,
+)
+from vtpu.serving.prefix import chain_digests
+from vtpu.serving.router import Router
+
+BS = 8
+LAYOUT = [{"shape": [4, 2], "dtype": "float32"}]
+PER_LEAF = [(8, (4, 2), np.dtype("float32"))]
+PER_BLOCK = 8 * 4  # elements × itemsize
+
+
+def tok_at(pos: int) -> int:
+    """Deterministic 'decode': the token emitted at sequence position
+    ``pos`` depends only on the position — so a migrated session is
+    token-exact vs the never-migrated control iff its cursor and tail
+    survived the move intact."""
+    return (pos * 7 + 3) % 101
+
+
+def control(prompt_len: int, num_new: int):
+    return [tok_at(prompt_len + k) for k in range(num_new)]
+
+
+def block_content(prompt, j: int) -> np.ndarray:
+    """Deterministic per-block cache 'contents' derived from the prompt
+    (prefix-sharing sessions share leading block contents, like real
+    K/V), so byte-movement across a migration is checkable."""
+    seed = (hash((tuple(int(t) for t in prompt[:(j + 1) * BS]), j))
+            & 0x7FFFFFFF)
+    rng = np.random.default_rng(seed)
+    return rng.normal(size=(4, 2)).astype(np.float32)
+
+
+class FakeExtract:
+    """Codec-aware extract over content arrays (host-resident; no
+    device).  ``fail_after`` scripts a source death mid-stream: the
+    n-th payload call raises."""
+
+    def __init__(self, arrays, codec, fail_after=None):
+        self.codec = codec
+        self.nblocks = len(arrays)
+        self._calls = 0
+        self.fail_after = fail_after
+        x = (np.stack(arrays) if arrays
+             else np.zeros((0, 4, 2), np.float32))
+        if codec == wirecodec.CODEC_INT8:
+            self.q, self.scale = wirecodec.quantize_blocks_np(x)
+            self.per_block = 8 + 4
+        else:
+            self.raw = x
+            self.per_block = PER_BLOCK
+
+    def layout(self):
+        return list(LAYOUT)
+
+    def ready_blocks(self):
+        return self.nblocks
+
+    def payload(self, lo, hi):
+        self._calls += 1
+        if self.fail_after is not None and self._calls > self.fail_after:
+            raise RuntimeError("source engine died mid-extract")
+        if self.codec == wirecodec.CODEC_INT8:
+            return (np.ascontiguousarray(
+                        self.scale[lo:hi]).astype("<f4").tobytes()
+                    + np.ascontiguousarray(self.q[lo:hi]).tobytes())
+        return np.ascontiguousarray(self.raw[lo:hi]).tobytes()
+
+
+class FakeDecodeReplica:
+    """Deterministic decode replica with the full session surface the
+    mover and the router need: export/adopt, the wire sink (session
+    OPEN docs, suffix matching, registration), deterministic step(),
+    and a real BlockPool so leak checks are ledger-level."""
+
+    accepts_chain = True
+
+    def __init__(self, replica_id="f0", blocks=65, max_batch=8):
+        self.replica_id = replica_id
+        self.pool = BlockPool(blocks, BS)
+        self.block_size = BS
+        self.max_batch = max_batch
+        self.sessions = {}   # rid → state dict
+        self.out = {}        # rid → live tail (finished rids keep it)
+        self.content = {}    # block id → float32 [4, 2]
+        self._rids = set()
+        self.alive = True
+        self.export_dead = False   # export/adopt raise (source death)
+        self.extract_fail_after = None
+
+    # -- seeding / decode ----------------------------------------------
+    def seed_session(self, rid, prompt, num_new, decoded=1,
+                     register=True):
+        prompt = [int(t) for t in prompt]
+        need = -(-(len(prompt) + num_new) // BS)
+        blocks = self.pool.lease(need)
+        for j, b in enumerate(blocks):
+            self.content[b] = block_content(prompt, j)
+        chain = chain_digests(prompt, BS)
+        if register and chain:
+            self.pool.register_prefix(chain, blocks)
+        tail = control(len(prompt), decoded)
+        st = {"blocks": blocks, "base": len(prompt), "tail": tail,
+              "remaining": num_new - decoded, "frozen": False,
+              "chain": chain, "prompt": prompt}
+        self.sessions[rid] = st
+        self.out[rid] = st["tail"]
+        self._rids.add(rid)
+        return st
+
+    def step(self):
+        if not self.alive:
+            raise ConnectionError("replica dead")
+        for rid in list(self.sessions):
+            st = self.sessions[rid]
+            if st["remaining"] <= 0:
+                continue
+            cur = st["base"] + len(st["tail"]) - 1
+            st["tail"].append(99 if st["frozen"] else tok_at(cur + 1))
+            st["remaining"] -= 1
+            if st["remaining"] <= 0:
+                self._retire(rid)
+
+    def _retire(self, rid):
+        st = self.sessions.pop(rid)
+        self.pool.release(st["blocks"])
+
+    def run(self):
+        while any(s["remaining"] > 0 for s in self.sessions.values()):
+            self.step()
+
+    # -- session export / adopt ----------------------------------------
+    def exportable_sessions(self):
+        return sorted(self.sessions)
+
+    def export_session(self, rid):
+        if self.export_dead:
+            raise RuntimeError("source engine dead at export")
+        st = self.sessions.get(rid)
+        if st is None:
+            raise SessionGoneError(f"{rid} not live here")
+        cursor = st["base"] + len(st["tail"]) - 1
+        handle = self.pool.detach(st["blocks"], seq_len=cursor)
+        del self.sessions[rid]
+        del self.out[rid]
+        self._rids.discard(rid)
+        return SessionExport(
+            rid=rid, handle=handle, cursor=cursor,
+            tail=tuple(st["tail"]), remaining=st["remaining"],
+            frozen=st["frozen"], chain=tuple(st["chain"]),
+            block_size=BS,
+        )
+
+    def adopt_session(self, export, *, blocks=None, submitted=0.0):
+        if self.export_dead:
+            raise RuntimeError("engine dead at adopt")
+        if export.rid in self._rids:
+            raise tp.WireError(f"duplicate {export.rid!r}")
+        if blocks is None:
+            blocks = self.pool.adopt(export.handle)
+        tail = list(export.tail)
+        st = {"blocks": list(blocks),
+              "base": export.cursor - (len(tail) - 1), "tail": tail,
+              "remaining": export.remaining, "frozen": export.frozen,
+              "chain": list(export.chain), "prompt": None}
+        self.sessions[export.rid] = st
+        self.out[export.rid] = st["tail"]
+        self._rids.add(export.rid)
+        if st["remaining"] <= 0:
+            self._retire(export.rid)
+
+    # -- sender side ----------------------------------------------------
+    def wire_layout(self):
+        return list(LAYOUT)
+
+    def start_extract(self, blocks, codec=wirecodec.CODEC_FP32):
+        return FakeExtract([self.content[b] for b in blocks], codec,
+                           fail_after=self.extract_fail_after)
+
+    # -- receiver sink (session-aware) ----------------------------------
+    def wire_codecs(self):
+        return (wirecodec.CODEC_FP32, wirecodec.CODEC_INT8)
+
+    def wire_open(self, rid, total_blocks, layout, chunk_blocks,
+                  codec="fp32", meta=None):
+        if layout != LAYOUT:
+            raise PoolMismatchError("layout mismatch")
+        if rid in self._rids:
+            raise tp.WireError(f"duplicate {rid!r}")
+        sess = (meta or {}).get("session")
+        chain = ((sess or {}).get("chain")
+                 or (meta or {}).get("chain") or [])
+        shared, skip = [], 0
+        if chain and total_blocks > 1:
+            shared, skip = self.pool.match_and_ref(
+                chain, min(len(chain), total_blocks - 1))
+        dst = self.pool.lease_upto(total_blocks - skip)
+        if not dst:
+            if shared:
+                self.pool.release(shared)
+            return None
+        self._rids.add(rid)
+        return {"rid": rid, "dst": dst, "total": total_blocks - skip,
+                "skip": skip, "shared": shared, "closed": False,
+                "codec": codec, "session": sess}
+
+    def wire_credits(self, ctx):
+        return len(ctx["dst"])
+
+    def wire_top_up(self, ctx):
+        need = ctx["total"] - len(ctx["dst"])
+        if need > 0 and not ctx["closed"]:
+            ctx["dst"].extend(self.pool.lease_upto(need))
+        return len(ctx["dst"])
+
+    def wire_write(self, ctx, block_off, nblocks, payload):
+        if ctx.get("codec") == wirecodec.CODEC_INT8:
+            parsed = wirecodec.split_quant_payload(
+                memoryview(payload), PER_LEAF, nblocks)
+            scales, q = parsed[0]
+            arrs = wirecodec.dequantize_blocks_np(q, scales, np.float32)
+        else:
+            if len(payload) != nblocks * PER_BLOCK:
+                raise ValueError("bad chunk size")
+            arrs = np.frombuffer(bytes(payload), np.float32).reshape(
+                (nblocks, 4, 2))
+        for i in range(nblocks):
+            self.content[ctx["dst"][block_off + i]] = arrs[i]
+
+    def wire_finish(self, ctx, meta):
+        ctx["closed"] = True
+        sess = (meta or {}).get("session")
+        blocks = list(ctx["shared"]) + list(ctx["dst"])
+        if sess is None:   # plain handoff: open a fresh session
+            tail = [int(meta.get("first", 0))]
+            st = {"blocks": blocks,
+                  "base": int(meta["handle"]["seq_len"]), "tail": tail,
+                  "remaining": int(meta.get("num_new", 1)) - 1,
+                  "frozen": False, "chain": [], "prompt": None}
+        else:
+            tail = [int(t) for t in sess["tail"]]
+            st = {"blocks": blocks,
+                  "base": int(sess["cursor"]) - (len(tail) - 1),
+                  "tail": tail, "remaining": int(sess["remaining"]),
+                  "frozen": bool(sess.get("done")),
+                  "chain": list(sess.get("chain") or []), "prompt": None}
+            if st["chain"] and int(sess.get("chain_bs", BS)) == BS:
+                self.pool.register_prefix(
+                    st["chain"][:len(blocks)], blocks)
+        rid = ctx["rid"]
+        self.sessions[rid] = st
+        self.out[rid] = st["tail"]
+        if st["remaining"] <= 0:
+            self._retire(rid)
+
+    def wire_abort(self, ctx):
+        if ctx["closed"]:
+            return
+        ctx["closed"] = True
+        blocks = list(ctx.get("shared") or []) + list(ctx["dst"])
+        if blocks:
+            self.pool.release(blocks)
+        self._rids.discard(ctx["rid"])
+
+    # -- router surface --------------------------------------------------
+    def ping(self):
+        if not self.alive:
+            raise ConnectionError("replica gone")
+        return True
+
+    def submit_handle(self, rid, handle, first_token, num_new,
+                      source=None, submitted=0.0, chain=None):
+        # 'copy' adoption from a fake prefill: release the source claim,
+        # lease our own blocks, open the session at its prefill cursor
+        if source is not None:
+            source.pool.release_handle(handle)
+        need = len(handle.blocks)
+        blocks = self.pool.lease(need)
+        for j, b in enumerate(blocks):   # synthetic 'copied' cache
+            self.content[b] = np.full(
+                (4, 2), (hash((rid, j)) % 97) / 7.0, np.float32)
+        st = {"blocks": blocks, "base": int(handle.seq_len),
+              "tail": [int(first_token)], "remaining": num_new - 1,
+              "frozen": False, "chain": list(chain or []),
+              "prompt": None}
+        self.sessions[rid] = st
+        self.out[rid] = st["tail"]
+        self._rids.add(rid)
+        if chain:
+            self.pool.register_prefix(list(chain)[:need], blocks)
+        if st["remaining"] <= 0:
+            self._retire(rid)
+
+    def stats(self):
+        if not self.alive:
+            raise ConnectionError("replica gone")
+        return {"max_batch": self.max_batch,
+                "active_slots": len(self.sessions), "queued": 0,
+                "inflight_windows": 0, "prefilling_slots": 0,
+                **self.pool.stats()}
+
+
+def leak_free(pool, pinned_ok=True):
+    st = pool.stats()
+    if pinned_ok:
+        # registry pins may legitimately survive (prefix cache)
+        return (st["detached_handles"] == 0
+                and st["leased"] == st["prefix_blocks"])
+    return (st["leased"] == 0 and st["detached_handles"] == 0
+            and st["free"] == st["pool_blocks"] - 1)
+
+
+def session_blocks_leased(rep):
+    return sum(len(s["blocks"]) for s in rep.sessions.values())
+
+
+# ---------------------------------------------------------------------------
+# the move state machine
+# ---------------------------------------------------------------------------
+
+def test_move_token_exact_and_byte_exact():
+    src = FakeDecodeReplica("src")
+    dst = FakeDecodeReplica("dst")
+    prompt = list(range(20))
+    src.seed_session("r0", prompt, num_new=10, decoded=4)
+    mover = SessionMover()
+    rep = mover.move("r0", src, [("dst", dst)])
+    assert rep.target == "dst" and rep.blocks_shipped == 4
+    assert "r0" not in src.sessions and "r0" in dst.sessions
+    # cache bytes moved exactly (fp32): target content == source content
+    st = dst.sessions["r0"]
+    for j, b in enumerate(st["blocks"]):
+        np.testing.assert_array_equal(dst.content[b],
+                                      block_content(prompt, j))
+    dst.run()
+    assert dst.out["r0"] == control(20, 10)  # token-exact vs control
+    assert leak_free(src.pool) and leak_free(dst.pool)
+
+
+def test_move_is_suffix_only_when_target_holds_prefix():
+    src = FakeDecodeReplica("src")
+    dst = FakeDecodeReplica("dst")
+    shared_prefix = list(range(16))            # 2 full blocks
+    src.seed_session("a", shared_prefix + [30, 31, 32], 8, decoded=2)
+    src.seed_session("b", shared_prefix + [40, 41], 8, decoded=3)
+    mover = SessionMover()
+    r1 = mover.move("a", src, [("dst", dst)])
+    assert r1.blocks_skipped == 0              # cold target: all ship
+    r2 = mover.move("b", src, [("dst", dst)])
+    assert r2.blocks_skipped == 2              # prefix already there
+    assert r2.blocks_shipped == r1.blocks_shipped - 2
+    assert r2.wire_bytes < r1.wire_bytes
+    for rid, prompt in (("a", shared_prefix + [30, 31, 32]),
+                        ("b", shared_prefix + [40, 41])):
+        st = dst.sessions[rid]
+        for j, b in enumerate(st["blocks"]):
+            np.testing.assert_array_equal(dst.content[b],
+                                          block_content(prompt, j))
+    dst.run()
+    assert dst.out["a"] == control(19, 8)
+    assert dst.out["b"] == control(18, 8)
+    assert leak_free(src.pool) and leak_free(dst.pool)
+
+
+def test_move_int8_codec_ships_fewer_bytes_tokens_exact():
+    src = FakeDecodeReplica("src")
+    f32, i8 = FakeDecodeReplica("f32"), FakeDecodeReplica("i8")
+    prompt = list(range(24))
+    src.seed_session("x", prompt, 8, decoded=2, register=False)
+    src.seed_session("y", prompt, 8, decoded=2, register=False)
+    fp = SessionMover().move("x", src, [("f32", f32)])
+    q = SessionMover(codec="int8").move("y", src, [("i8", i8)])
+    assert q.codec == "int8" and fp.codec == "fp32"
+    assert q.wire_bytes < fp.wire_bytes
+    # the tail/cursor are HOST state: token continuation of the tail is
+    # exact under any codec (content is approximate under int8)
+    i8.run()
+    f32.run()
+    assert i8.out["y"] == f32.out["x"] == control(24, 8)
+    assert leak_free(src.pool) and leak_free(i8.pool)
+
+
+def test_frozen_session_migrates_with_its_eos_state():
+    src = FakeDecodeReplica("src")
+    dst = FakeDecodeReplica("dst")
+    st = src.seed_session("z", list(range(10)), 6, decoded=2)
+    st["frozen"] = True
+    SessionMover().move("z", src, [("dst", dst)])
+    assert dst.sessions["z"]["frozen"] is True
+    dst.run()
+    assert dst.out["z"][2:] == [99] * 4     # post-EOS padding continues
+    assert leak_free(src.pool) and leak_free(dst.pool)
+
+
+def test_export_of_unknown_session_is_session_gone():
+    src = FakeDecodeReplica("src")
+    with pytest.raises(SessionGoneError):
+        SessionMover().move("nope", src, [("t", FakeDecodeReplica())])
+    assert leak_free(src.pool)
+
+
+def test_saturated_targets_restore_finish_in_place():
+    src = FakeDecodeReplica("src")
+    full = FakeDecodeReplica("full", blocks=5)
+    full.pool.lease(4)                      # nothing leasable
+    src.seed_session("r0", list(range(12)), 6, decoded=2)
+    with pytest.raises(NoMigrationTargetError) as ei:
+        SessionMover().move("r0", src, [("full", full)])
+    assert ei.value.restored is True
+    assert "r0" in src.sessions             # finish-in-place fallback
+    src.run()
+    assert src.out["r0"] == control(12, 6)
+    assert leak_free(src.pool)
+
+
+def test_dead_target_open_falls_through_to_next():
+    src = FakeDecodeReplica("src")
+    dead = FakeDecodeReplica("dead")
+    dead.wire_open = None                   # OPEN explodes
+    ok = FakeDecodeReplica("ok")
+    src.seed_session("r0", list(range(12)), 6, decoded=2)
+    rep = SessionMover().move("r0", src, [("dead", dead), ("ok", ok)])
+    assert rep.target == "ok"
+    ok.run()
+    assert ok.out["r0"] == control(12, 6)
+    assert leak_free(src.pool) and leak_free(ok.pool)
+
+
+# ---------------------------------------------------------------------------
+# the death-fuzz matrix: torn first/mid/every frame × fp32/int8 ×
+# (link death / receiver abort / source death) — leak-free both pools,
+# token-exact continuation on the source or typed failure
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("codec", ["fp32", "int8"])
+@pytest.mark.parametrize("torn", ["first_chunk", "mid_stream",
+                                  "every_frame"])
+def test_death_fuzz_torn_stream_restores_on_source(torn, codec):
+    src = FakeDecodeReplica("src")
+    dst = FakeDecodeReplica("dst")
+    src.seed_session("r0", list(range(20)), 8, decoded=3)
+
+    def fault(data):
+        fr = tp.decode_frame(data)
+        if fr.kind not in (tp.KIND_DATA, tp.KIND_DATA_QUANT) \
+                or fr.seq == 0:
+            return
+        # PERSISTENT tears: the per-stream resume budget must exhaust
+        if torn == "first_chunk" and fr.seq == 1:
+            raise OSError("torn")
+        if torn == "mid_stream" and fr.seq == 2:
+            raise OSError("torn")
+        if torn == "every_frame":
+            raise OSError("torn")
+
+    mover = SessionMover(chunk_blocks=1, retries=2, codec=codec)
+    mover._hubs[id(dst)] = tp.LoopbackLink(tp.ReceiverHub(dst),
+                                           fault=fault)
+    with pytest.raises(MigrationError) as ei:
+        mover.move("r0", src, [("dst", dst)])
+    assert not isinstance(ei.value, MigrationAmbiguousError)
+    assert ei.value.restored is True
+    assert "r0" in src.sessions and "r0" not in dst.sessions
+    src.run()
+    assert src.out["r0"] == control(20, 8)  # continues token-exactly
+    assert leak_free(src.pool) and leak_free(dst.pool)
+
+
+@pytest.mark.parametrize("codec", ["fp32", "int8"])
+def test_death_fuzz_receiver_abort_restores_on_source(codec):
+    src = FakeDecodeReplica("src")
+    dst = FakeDecodeReplica("dst")
+    src.seed_session("r0", list(range(20)), 8, decoded=3)
+    mover = SessionMover(chunk_blocks=1, codec=codec)
+    hub = tp.ReceiverHub(dst)
+
+    class AbortingLink(tp.LoopbackLink):
+        def __init__(self):
+            super().__init__(hub)
+            self.n = 0
+
+        def send(self, data, fresh=False):
+            self.n += 1
+            if self.n == 3:                # receiver dies mid-adoption
+                hub.abort_all()
+            return super().send(data, fresh=fresh)
+
+    mover._hubs[id(dst)] = AbortingLink()
+    with pytest.raises(MigrationError) as ei:
+        mover.move("r0", src, [("dst", dst)])
+    assert ei.value.restored is True
+    assert "r0" in src.sessions
+    src.run()
+    assert src.out["r0"] == control(20, 8)
+    assert leak_free(src.pool) and leak_free(dst.pool)
+
+
+@pytest.mark.parametrize("codec", ["fp32", "int8"])
+def test_death_fuzz_source_death_is_typed_and_leak_free(codec):
+    src = FakeDecodeReplica("src")
+    dst = FakeDecodeReplica("dst")
+    src.seed_session("r0", list(range(20)), 8, decoded=3)
+    src.extract_fail_after = 1             # dies mid-extract...
+    src.export_dead = False
+    mover = SessionMover(chunk_blocks=1, codec=codec)
+
+    # ...and is too dead to take the session back
+    orig_adopt = src.adopt_session
+
+    def dying_adopt(export, **kw):
+        raise RuntimeError("source dead at restore")
+
+    src.adopt_session = dying_adopt
+    with pytest.raises(MigrationError) as ei:
+        mover.move("r0", src, [("dst", dst)])
+    assert ei.value.restored is False
+    # the mover released the claim when the restore failed: leak-free
+    assert leak_free(src.pool) and leak_free(dst.pool)
+    assert "r0" not in dst.sessions
+    src.adopt_session = orig_adopt
+
+
+def test_ambiguous_fin_fails_loudly_never_duplicates():
+    """The FIN applies but its response — and every resume probe — is
+    lost: the receiver holds the session, so restoring on the source
+    would duplicate it.  The mover must raise the typed ambiguous
+    error, release the source side, and leave exactly ONE live copy."""
+    src = FakeDecodeReplica("src")
+    dst = FakeDecodeReplica("dst")
+    src.seed_session("r0", list(range(20)), 8, decoded=3)
+    hub = tp.ReceiverHub(dst)
+
+    class FinBlackholeLink(tp.LoopbackLink):
+        def __init__(self):
+            super().__init__(hub)
+            self.dead = False
+
+        def send(self, data, fresh=False):
+            if self.dead:
+                raise OSError("network partitioned")
+            rsp = super().send(data, fresh=fresh)
+            fr = tp.decode_frame(data)
+            if fr.kind in (tp.KIND_DATA, tp.KIND_DATA_QUANT) \
+                    and fr.flags & tp.FLAG_FIN:
+                self.dead = True           # response lost, then silence
+                raise OSError("FIN response lost")
+            return rsp
+
+    mover = SessionMover(chunk_blocks=2, retries=2)
+    mover._hubs[id(dst)] = FinBlackholeLink()
+    a0 = MIGRATIONS_TOTAL.value(outcome="ambiguous")
+    with pytest.raises(MigrationAmbiguousError) as ei:
+        mover.move("r0", src, [("dst", dst)])
+    assert ei.value.tail == control(20, 3)
+    assert MIGRATIONS_TOTAL.value(outcome="ambiguous") == a0 + 1
+    # exactly one live copy — at the target — and no source leak
+    assert "r0" not in src.sessions and "r0" in dst.sessions
+    dst.run()
+    assert dst.out["r0"] == control(20, 8)
+    assert leak_free(src.pool) and leak_free(dst.pool)
+
+
+def test_lost_fin_ack_with_live_network_resolves_migrated():
+    """Contrast case: the FIN response is lost but the receiver still
+    answers resumes — the tombstone says "fin" and the move completes
+    normally (no ambiguity, no abort)."""
+    src = FakeDecodeReplica("src")
+    dst = FakeDecodeReplica("dst")
+    src.seed_session("r0", list(range(20)), 8, decoded=3)
+    hub = tp.ReceiverHub(dst)
+    state = {"torn": False}
+
+    class FinLossLink(tp.LoopbackLink):
+        def send(self, data, fresh=False):
+            rsp = super().send(data, fresh=fresh)
+            fr = tp.decode_frame(data)
+            if (fr.kind in (tp.KIND_DATA, tp.KIND_DATA_QUANT)
+                    and fr.flags & tp.FLAG_FIN and not state["torn"]):
+                state["torn"] = True
+                raise OSError("FIN response lost")
+            return rsp
+
+    mover = SessionMover(chunk_blocks=2, retries=2)
+    mover._hubs[id(dst)] = FinLossLink(hub)
+    rep = mover.move("r0", src, [("dst", dst)])
+    assert rep.target == "dst"
+    assert "r0" in dst.sessions and "r0" not in src.sessions
+    dst.run()
+    assert dst.out["r0"] == control(20, 8)
+    assert leak_free(src.pool) and leak_free(dst.pool)
+
+
+def test_resume_mid_suffix_stream_completes_exact():
+    """A single transient tear inside a suffix-only stream: RESUME
+    re-syncs (echoing codec + skip + session doc) and the move
+    completes with the skipped prefix intact."""
+    src = FakeDecodeReplica("src")
+    dst = FakeDecodeReplica("dst")
+    shared = list(range(16))
+    src.seed_session("a", shared + [30], 8, decoded=2)
+    src.seed_session("b", shared + [40], 8, decoded=2)
+    state = {"torn": False}
+
+    def fault(data):
+        fr = tp.decode_frame(data)
+        if fr.kind == tp.KIND_DATA and fr.seq == 1 and not state["torn"]:
+            state["torn"] = True
+            raise OSError("transient tear")
+
+    mover = SessionMover(chunk_blocks=1, retries=2)
+    mover.move("a", src, [("dst", dst)])   # seeds the prefix at dst
+    mover._hubs[id(dst)] = tp.LoopbackLink(tp.ReceiverHub(dst),
+                                           fault=fault)
+    rep = mover.move("b", src, [("dst", dst)])
+    assert rep.blocks_skipped == 2
+    dst.run()
+    assert dst.out["b"] == control(17, 8)
+    assert leak_free(src.pool) and leak_free(dst.pool)
+
+
+# ---------------------------------------------------------------------------
+# router policy: migrate-on-drain, evict hook, pinned gauge, targeting
+# ---------------------------------------------------------------------------
+
+class FakePrefill:
+    def __init__(self, blocks=128):
+        self.pool = BlockPool(blocks, BS)
+        self.queue = []
+
+    def submit(self, rid, prompt, num_new):
+        self.queue.append((rid, list(prompt), num_new))
+
+    def step(self):
+        from vtpu.serving.disagg import PrefillResult
+
+        out = []
+        for rid, prompt, num_new in self.queue:
+            need = -(-(len(prompt) + num_new) // BS)
+            handle = self.pool.detach(self.pool.lease(need),
+                                      seq_len=len(prompt))
+            out.append(PrefillResult(rid, tok_at(len(prompt)), handle,
+                                     num_new))
+        self.queue = []
+        return out
+
+    def stats(self):
+        return {"queued": len(self.queue), **self.pool.stats()}
+
+
+def make_router(n=3, **kw):
+    pf = FakePrefill()
+    reps = {f"d{i}": FakeDecodeReplica(f"d{i}") for i in range(n)}
+    return Router(pf, reps, **kw), pf, reps
+
+
+def drive_sessions(router, sessions, num_new=9):
+    placed = {}
+    for i, sess in enumerate(sessions):
+        rid = f"{sess}-r{i}"
+        placed[sess] = (rid, router.submit(sess, rid,
+                                           list(range(10 + i)), num_new))
+        router.pump()
+    return placed
+
+
+def test_drain_mass_migrates_pinned_sessions():
+    router, pf, reps = make_router(n=3, fail_threshold=1)
+    placed = drive_sessions(router, [f"s{i}" for i in range(6)])
+    victims = [s for s, (_r, rep) in placed.items() if rep == "d0"]
+    assert victims, "hash spread should pin something to d0"
+    n_before = len(reps["d0"].sessions)
+    assert n_before == len(victims)
+    m0 = MIGRATIONS_TOTAL.value(outcome="migrated")
+    reps["d0"].alive = False      # fails pings; sessions still live
+    reps["d0"].alive = True       # (the drain is health-driven below)
+    reps["d0"].ping = lambda: (_ for _ in ()).throw(
+        ConnectionError("gone"))
+    router.check_health()          # fail_threshold=1 → drain + migrate
+    assert MIGRATIONS_TOTAL.value(outcome="migrated") == m0 + n_before
+    assert not reps["d0"].sessions
+    # every victim lives elsewhere, tail intact, and its PIN moved
+    stats = router.stats()
+    for sess in victims:
+        rid, _ = placed[sess]
+        owner = [d for d in ("d1", "d2") if rid in reps[d].sessions]
+        assert len(owner) == 1
+        assert router._sessions[sess] == owner[0]
+    pinned = stats["sessions_pinned"]
+    assert pinned["d0"] == 0
+    assert sum(pinned.values()) == 6
+    # sessions finish token-exactly where they landed
+    for d in ("d1", "d2"):
+        reps[d].run()
+    for i, sess in enumerate(placed):
+        rid, _ = placed[sess]
+        d = next(d for d in reps if rid in reps[d].out)
+        assert reps[d].out[rid] == control(10 + i, 9)
+
+
+def test_request_evict_migrates_and_never_restores():
+    router, pf, reps = make_router(n=2, ping_interval_s=0.0)
+    placed = drive_sessions(router, [f"s{i}" for i in range(4)])
+    victims = [s for s, (_r, rep) in placed.items() if rep == "d0"]
+    moved = router.request_evict("d0")
+    assert moved == len(victims) == len(reps["d1"].sessions) - (
+        len(placed) - len(victims))
+    assert not reps["d0"].sessions
+    assert "d0" in router.stats()["evicted"]
+    # healthy pings do NOT bring an evicted replica back
+    router.check_health()
+    assert router.stats()["healthy"] == ["d1"]
+    # new sessions route to the survivor
+    assert router.submit("fresh", "fr0", [1, 2, 3], 3) == "d1"
+
+
+def test_migration_targets_least_pinned_with_credit():
+    router, pf, reps = make_router(n=3)
+    # pin counts: d1 ← 2 pins, d2 ← 0 pins (manufactured directly)
+    router._sessions["a"] = "d1"
+    router._sessions["b"] = "d1"
+    router._pinned["d1"] = 2
+    targets = router._migration_targets(exclude="d0")
+    assert [t for t, _ in targets] == ["d2", "d1"]   # least-pinned first
+    # a target without a single free pool block is not credit-holding
+    reps["d2"].pool.lease(reps["d2"].pool.free_blocks())
+    targets = router._migration_targets(exclude="d0")
+    assert [t for t, _ in targets] == ["d1"]
+
+
+def test_drain_with_no_credit_falls_back_finish_in_place():
+    router, pf, reps = make_router(n=2, fail_threshold=1)
+    placed = drive_sessions(router, [f"s{i}" for i in range(4)])
+    victims = [s for s, (_r, rep) in placed.items() if rep == "d0"]
+    assert victims
+    f0 = MIGRATIONS_TOTAL.value(outcome="fallback")
+    reps["d1"].pool.lease(reps["d1"].pool.free_blocks())  # no credit
+    reps["d0"].ping = lambda: (_ for _ in ()).throw(
+        ConnectionError("gone"))
+    router.check_health()
+    assert MIGRATIONS_TOTAL.value(outcome="fallback") == f0 + len(victims)
+    # finish-in-place: every victim still lives on d0 and completes
+    assert sorted(
+        rid for rid in (placed[s][0] for s in victims)
+        if rid in reps["d0"].sessions
+    ) == sorted(placed[s][0] for s in victims)
+    reps["d0"].run()
+    for i, sess in enumerate(placed):
+        if sess not in victims:
+            continue
+        rid, _ = placed[sess]
+        assert reps["d0"].out[rid] == control(10 + i, 9)
+
+
+def test_inflight_request_replays_on_the_target():
+    """A request still queued at the prefill when its session's replica
+    drains: after migration moves the pin, the finished prefill must
+    deliver to the TARGET, not the drain."""
+    router, pf, reps = make_router(n=2, fail_threshold=1)
+    # session gets a live decode on its pinned replica
+    pin = router.submit("sx", "sx-r0", list(range(10)), 9)
+    router.pump()
+    other = next(d for d in reps if d != pin)
+    # second request of the same session: queued at prefill, NOT pumped
+    assert router.submit("sx", "sx-r1", list(range(12)), 5) == pin
+    reps[pin].ping = lambda: (_ for _ in ()).throw(
+        ConnectionError("gone"))
+    router.check_health()          # drain → migrate → retarget
+    assert router._sessions["sx"] == other
+    assert router._target["sx-r1"] == other
+    router.pump()                  # prefill finishes → delivers
+    assert "sx-r1" in reps[other].sessions
+    assert "sx-r1" not in reps[pin].sessions
+
+
+def test_evicted_pin_rehashes_instead_of_routing_into_the_drain():
+    """Review fix: a session still pinned to an evict-requested replica
+    (idle at evict time, or its migration fell back) must NOT route its
+    next turn there — the pod is being deleted.  The stale pin drops
+    and the session re-pins over the healthy ring."""
+    router, pf, reps = make_router(n=2)
+    pin = router.submit("sticky", "st-r0", list(range(10)), 9)
+    router.pump()
+    other = next(d for d in reps if d != pin)
+    router.request_evict(pin)
+    # the live session migrated; now an IDLE session's pin: manufacture
+    # one left behind on the evicted replica
+    router._sessions["idle-sess"] = pin
+    router._pinned[pin] += 1
+    got = router.submit("idle-sess", "id-r1", [1, 2, 3], 3)
+    assert got == other                     # re-pinned, not the drain
+    assert router._sessions["idle-sess"] == other
+    assert router.stats()["sessions_pinned"][pin] == 0
+    # and the migrated sticky session's turns follow its moved pin too
+    assert router.submit("sticky", "st-r1", [1, 2], 2) == other
+
+
+def test_router_with_non_migratable_fakes_still_drains():
+    """Replicas without the session surface (old engines, plain fakes)
+    keep the pre-mover behavior: drain, finish in place, no crash."""
+    class Plain:
+        def __init__(self):
+            self.healthy = True
+
+        def ping(self):
+            if not self.healthy:
+                raise ConnectionError("gone")
+            return True
+
+        def submit_handle(self, rid, handle, first_token, num_new,
+                          source=None, submitted=0.0):
+            if source is not None:
+                source.pool.release_handle(handle)
+
+        def step(self):
+            pass
+
+        def stats(self):
+            return {"max_batch": 4, "active_slots": 0, "queued": 0}
+
+    pf = FakePrefill()
+    reps = {"p0": Plain(), "p1": Plain()}
+    router = Router(pf, reps, fail_threshold=1)
+    router.submit("s", "r0", [1, 2, 3], 3)
+    router.pump()
+    reps["p0"].healthy = False
+    router.check_health()
+    assert "p0" not in router.stats()["healthy"]
+
+
+# ---------------------------------------------------------------------------
+# lock-witness soak over the mover's locks
+# ---------------------------------------------------------------------------
+
+def test_migrate_witness_soak(monkeypatch):
+    """Concurrent session moves (two sources × two targets) under the
+    runtime lock-order witness: the acquisition graph over the new
+    ``serving.session_mover`` lock plus the transport/pool locks must
+    stay acyclic, and the hub→pool edge must be exercised."""
+    from vtpu.analysis import witness
+
+    monkeypatch.setenv(witness.ENV_WITNESS, "1")
+    witness.reset()
+    try:
+        sources = [FakeDecodeReplica(f"s{i}", blocks=257)
+                   for i in range(2)]
+        targets = [("t0", FakeDecodeReplica("t0", blocks=257)),
+                   ("t1", FakeDecodeReplica("t1", blocks=257))]
+        mover = SessionMover()
+        for i, src in enumerate(sources):
+            for j in range(8):
+                src.seed_session(f"m{i}-{j}",
+                                 list(range(16 + i + j)), 6, decoded=2)
+        errors = []
+
+        def worker(i):
+            try:
+                src = sources[i]
+                for j in range(8):
+                    mover.move(f"m{i}-{j}", src,
+                               [targets[(i + j) % 2],
+                                targets[(i + j + 1) % 2]])
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        assert sum(len(t.sessions) for _n, t in targets) == 16
+        got = set(witness.edges())
+        assert witness.cycles() == [], witness.report()
+        assert ("serving.receiver_hub", "serving.kvpool") in got
+    finally:
+        witness.reset()
+
+
+# ---------------------------------------------------------------------------
+# bench smoke: SMOKE=1 rides tier-1 through this module
+# ---------------------------------------------------------------------------
+
+def test_bench_migrate_smoke_artifact_schema(tmp_path):
+    """`make bench-migrate SMOKE=1` contract: schema-complete artifact
+    with the acceptance facts asserted inside the bench itself —
+    migration strands zero tokens, finish-in-place strands some, and
+    suffix-only ships measurably fewer wire bytes."""
+    import json
+
+    from benchmarks import serving_migrate
+
+    out = tmp_path / "serving_migrate.json"
+    rc = serving_migrate.main(["--smoke", "--out", str(out)])
+    assert rc == 0
+    res = json.loads(out.read_text())
+    assert res["headline"]["lost_tokens_migrate"] == 0
+    assert res["headline"]["lost_tokens_finish_in_place"] > 0
+    assert res["headline"]["completion_p95_speedup_x"] > 1.0
+    assert res["headline"]["suffix_savings_x"] > 1.0
+    arms = res["arms"]
+    assert arms["migrate"]["migrations"] == res["config"]["sessions"]
+    assert arms["migrate"]["wire_bytes"] > 0
+    assert arms["finish_in_place"]["wire_bytes"] == 0
+    assert (res["suffix"]["suffix_wire_bytes"]
+            < res["suffix"]["full_wire_bytes"])
+    assert res["suffix"]["blocks_skipped"] > 0
+    for arm in arms.values():
+        assert arm["completion_p95_s"] >= arm["completion_p50_s"] > 0

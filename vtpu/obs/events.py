@@ -80,6 +80,9 @@ class EventType:
     # serving router
     REPLICA_DRAINED = "ReplicaDrained"    # decode replica failed health pings; out of the ring
     REPLICA_RESTORED = "ReplicaRestored"  # drained replica answers again; back in the ring
+    # live session migration (vtpu/serving/migrate.py)
+    SESSION_MIGRATED = "SessionMigrated"  # a pinned session moved replicas token-exactly
+    SESSION_MIGRATION_FAILED = "SessionMigrationFailed"  # a move failed typed (restored on the source, or ambiguous)
 
 
 EVENT_TYPES = frozenset(

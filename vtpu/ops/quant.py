@@ -57,14 +57,30 @@ def is_quantized(x) -> bool:
     return isinstance(x, QuantizedTensor)
 
 
+def _nearest_int(xf, scale):
+    """The integer level whose f32 RECONSTRUCTION (``q * scale``) is
+    nearest to ``xf`` — not ``round(xf / scale)``.  The f32 division
+    can round a just-below-half ratio onto an exact ``.5`` tie, which
+    ``round()`` resolves upward and the reconstruction error breaches
+    the documented ``scale/2`` bound by an ulp; comparing the two
+    candidate reconstructions directly keeps the bound honest in the
+    arithmetic the caller actually reads back."""
+    lo = jnp.floor(xf / scale)
+    hi = lo + 1.0
+    q = jnp.where(jnp.abs(hi * scale - xf) < jnp.abs(lo * scale - xf),
+                  hi, lo)
+    return jnp.clip(q, -127, 127)
+
+
 def quantize_int8(w, axis: int = 0) -> QuantizedTensor:
     """Symmetric absmax quantization.  ``axis`` is the REDUCED (input)
     dim — for a Dense kernel [d_in, d_out], axis=0 gives one scale per
-    output channel, the standard weight-only layout."""
+    output channel, the standard weight-only layout.  Per-element
+    reconstruction error is bounded by ``scale/2 = absmax/254``."""
     w = jnp.asarray(w)
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    q = _nearest_int(w.astype(jnp.float32), scale)
     return QuantizedTensor(q.astype(jnp.int8), scale, axis)
 
 
@@ -83,7 +99,7 @@ def quantize_blockwise(x):
     axes = tuple(range(1, x.ndim))
     amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    q = _nearest_int(xf, scale).astype(jnp.int8)
     return q, scale
 
 

@@ -20,6 +20,9 @@ _LAZY = {
     "KVHandle": ("vtpu.serving.kvpool", "KVHandle"),
     "PrefixIndex": ("vtpu.serving.prefix", "PrefixIndex"),
     "chain_digests": ("vtpu.serving.prefix", "chain_digests"),
+    "SessionMover": ("vtpu.serving.migrate", "SessionMover"),
+    "SessionExport": ("vtpu.serving.migrate", "SessionExport"),
+    "MigrationError": ("vtpu.serving.migrate", "MigrationError"),
 }
 
 __all__ = sorted(_LAZY)

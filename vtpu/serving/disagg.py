@@ -73,8 +73,10 @@ from vtpu.serving.kvpool import (
     SPEC_ROLLBACKS,
     BlockPool,
     KVHandle,
+    KVHandoffError,
     PoolMismatchError,
 )
+from vtpu.serving.migrate import SessionExport, SessionGoneError
 from vtpu.serving.paged import PagedBatcher
 from vtpu.serving.prefix import chain_digests
 
@@ -173,19 +175,29 @@ class HostExtract:
 @dataclasses.dataclass(frozen=True)
 class PrefillResult:
     """One finished prefill: the first generated token plus the claim
-    ticket for the K/V the prefill wrote."""
+    ticket for the K/V the prefill wrote.  ``chain`` is the prompt's
+    chained block digests (prefix-cache runs only) — it rides the
+    handoff so the DECODE side can register the adopted prefix in its
+    own pool registry and later wire streams (repeat handoffs, session
+    migrations) ship only the unmatched suffix."""
 
     rid: str
     first_token: int
     handle: KVHandle
     num_new: int
     submitted: float = 0.0
+    chain: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass
 class _PendingAdopt:
     """A handle whose blocks are claimed but still waiting for a slot
-    (and, in copy mode, for destination blocks)."""
+    (and, in copy mode, for destination blocks).  ``tail`` is set for
+    MIGRATED sessions (vtpu/serving/migrate.py): the full generated-
+    token transcript so far — the slot resumes mid-decode with
+    ``seq_len`` as its cursor and ``first == tail[-1]`` as the next
+    step's input token — and ``frozen`` carries the EOS freeze across
+    the move."""
 
     rid: str
     blocks: List[int]     # claimed from the handle (ownership moved here)
@@ -195,10 +207,60 @@ class _PendingAdopt:
     mode: str             # "shared" | "copy"
     source: object        # the source engine (copy mode), else None
     submitted: float
+    tail: Optional[List[int]] = None
+    frozen: bool = False
+    chain: Optional[List[str]] = None  # registered after adoption
 
 
 def _pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
+
+
+def _make_wire_gathers():
+    """The two fused extract programs both engine roles share: a plain
+    row gather of pool blocks, and the int8 variant with the blockwise
+    quantization fused in (one f32 scale per (block, leaf)) so the
+    async D2H itself moves ~4x fewer bytes."""
+    @jax.jit
+    def _gather(pools, idx):
+        return jax.tree.map(lambda leaf: leaf[idx], pools)
+
+    @jax.jit
+    def _gather_quant(pools, idx):
+        qs, scales = [], []
+        for leaf in jax.tree_util.tree_leaves(
+            jax.tree.map(lambda x: x[idx], pools)
+        ):
+            q, s = quantize_blockwise(leaf)
+            qs.append(q)
+            scales.append(s.reshape(-1).astype(jnp.float32))
+        return qs, scales
+
+    return _gather, _gather_quant
+
+
+def _extract_blocks(pools, blocks, codec, gather, gather_quant
+                    ) -> "HostExtract":
+    """Shared extract body: fused gather (quantizing under the int8
+    codec), immediate async D2H, wrapped in a :class:`HostExtract`.
+    DISPATCH FENCING IS THE CALLER'S JOB — the prefill engine holds its
+    ``_dispatch_lock`` (its donating admission program races a pump
+    thread's gather); the decode engine's session-export extract runs
+    on the engine thread under the wire-sink serialization contract and
+    needs no lock."""
+    blocks = list(blocks)
+    n = len(blocks)
+    padded = blocks + [0] * (_pow2(n) - n)  # pad → garbage block;
+    # pow-2 row buckets keep the gather's compile count bounded
+    idx = jnp.asarray(padded, jnp.int32)
+    scales = None
+    if codec == wirecodec.CODEC_INT8:
+        gathered, scales = gather_quant(pools, idx)
+    else:
+        gathered = jax.tree_util.tree_leaves(gather(pools, idx))
+    for g in list(gathered) + list(scales or []):
+        getattr(g, "copy_to_host_async", lambda: None)()
+    return HostExtract(gathered, n, codec=codec, scales=scales)
 
 
 class PrefillEngine:
@@ -286,31 +348,9 @@ class PrefillEngine:
 
         self._pf = _pf
 
-        @jax.jit
-        def _wire_gather(pools, idx):
-            """Fused row gather of a handle's blocks out of the live
-            pool — the device half of a wire extract (the D2H is issued
-            async by the caller and rides behind the next window)."""
-            return jax.tree.map(lambda leaf: leaf[idx], pools)
-
-        self._wire_gather = _wire_gather
-
-        @jax.jit
-        def _wire_gather_quant(pools, idx):
-            """int8-codec extract: the same fused row gather with the
-            blockwise quantization (vtpu/ops/quant.py) fused in — one
-            f32 scale per (block, leaf), int8 payload — so the async
-            D2H itself moves ~4x fewer bytes than the raw gather."""
-            qs, scales = [], []
-            for leaf in jax.tree_util.tree_leaves(
-                jax.tree.map(lambda x: x[idx], pools)
-            ):
-                q, s = quantize_blockwise(leaf)
-                qs.append(q)
-                scales.append(s.reshape(-1).astype(jnp.float32))
-            return qs, scales
-
-        self._wire_gather_quant = _wire_gather_quant
+        # the device half of a wire extract (shared with the decode
+        # engine's session export — _make_wire_gathers)
+        self._wire_gather, self._wire_gather_quant = _make_wire_gathers()
 
     # -- wire transport (sender side) ----------------------------------
     def wire_layout(self) -> list:
@@ -326,24 +366,10 @@ class PrefillEngine:
         time the sender's pump asks for payload, the bytes are host-side
         without a blocking sync.  ``codec`` is the stream's NEGOTIATED
         codec: under ``int8`` the quantization fuses into the gather."""
-        blocks = list(blocks)
-        n = len(blocks)
-        padded = blocks + [0] * (_pow2(n) - n)  # pad → garbage block;
-        # pow-2 row buckets keep the gather's compile count bounded
-        idx = jnp.asarray(padded, jnp.int32)
-        scales = None
         with self._dispatch_lock:
-            if codec == wirecodec.CODEC_INT8:
-                gathered, scales = self._wire_gather_quant(
-                    self.pool_leaves(), idx
-                )
-            else:
-                gathered = jax.tree_util.tree_leaves(
-                    self._wire_gather(self.pool_leaves(), idx)
-                )
-        for g in list(gathered) + list(scales or []):
-            getattr(g, "copy_to_host_async", lambda: None)()
-        return HostExtract(gathered, n, codec=codec, scales=scales)
+            return _extract_blocks(self.pool_leaves(), blocks, codec,
+                                   self._wire_gather,
+                                   self._wire_gather_quant)
 
     # ------------------------------------------------------------------
     def _blocks_needed(self, prompt_len: int, num_new: int) -> int:
@@ -504,7 +530,8 @@ class PrefillEngine:
                     shared_tok) in enumerate(sub):
                 handle = self.pool.detach(blocks, seq_len=int(p.size))
                 out.append(PrefillResult(rid, int(vals[r]), handle,
-                                         num_new, t0))
+                                         num_new, t0,
+                                         chain=tuple(chain or ())))
         self.prefills += len(out)
         return out
 
@@ -564,6 +591,24 @@ class DecodeEngine(PagedBatcher):
         # per-element reconstruction error is wire_quant_max_scale/2
         # (the documented bound the bench reports)
         self.wire_quant_max_scale = 0.0
+        # per-slot "virtual prefill position": the device position the
+        # slot's FIRST published token corresponds to, i.e. cursor −
+        # (len(transcript) − 1).  Session export derives the live
+        # cursor from it without a device sync — after a full pipeline
+        # drain, every harvested token advanced the slot's position by
+        # exactly one (still-active slots never overshoot their budget)
+        self._slot_base: Dict[int, int] = {}
+        # per-slot prompt digest chain (when the handoff carried one):
+        # the content attestation for the slot's leading blocks.  An
+        # EXPORT re-ships it so the migration target can skip digest-
+        # matched prefix blocks — valid for the slot's lifetime because
+        # chain blocks are full PROMPT blocks and decode writes land
+        # strictly past them
+        self._slot_chain: Dict[int, List[str]] = {}
+
+        # the sender half of a session migration (shared with the
+        # prefill engine's wire extract — _make_wire_gathers)
+        self._mig_gather, self._mig_gather_quant = _make_wire_gathers()
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def _adopt_bind(btab, bpos, tok, slots, rows, sizes, firsts):
@@ -632,6 +677,10 @@ class DecodeEngine(PagedBatcher):
         always healthy; remote transports override)."""
         return True
 
+    # duck-typing feature flag: the router passes the prompt's digest
+    # chain down only to replicas that declare they can register it
+    accepts_chain = True
+
     # speculative reservations hold their slot against every other
     # admission path until FIN binds it (or rollback frees it)
     def _free_slots(self) -> List[int]:
@@ -651,7 +700,8 @@ class DecodeEngine(PagedBatcher):
 
     def submit_handle(self, rid: str, handle: KVHandle, first_token: int,
                       num_new: int, source=None, submitted: float = 0.0,
-                      admit: bool = True) -> None:
+                      admit: bool = True,
+                      chain: Optional[List[str]] = None) -> None:
         """Adopt a detached K/V lease: claim it now (stale stamps fail
         HERE, loudly), queue it for a slot, and admit as capacity
         frees.  ``source`` is the engine owning the handle's pool when
@@ -659,7 +709,14 @@ class DecodeEngine(PagedBatcher):
         ``admit=False`` defers the admission scatter so a caller
         delivering a batch of handles (the router's pump) gets ONE
         fused adoption group instead of one program per handle — call
-        :meth:`admit_pending` once after the batch."""
+        :meth:`admit_pending` once after the batch.  ``chain`` (the
+        prompt's chained block digests, prefix-cache runs) registers
+        the adopted prefix in THIS pool's registry after the bind, so
+        later wire streams and session migrations of siblings ship
+        only their suffix — decode-side prefix adoption."""
+        if chain and source is not None and getattr(
+                source, "block_size", None) != self.block_size:
+            chain = None  # foreign digest granularity: never register
         if num_new < 1:
             raise ValueError(f"num_new must be >= 1, got {num_new}")
         if handle.seq_len + num_new > self.model.max_seq:
@@ -689,6 +746,7 @@ class DecodeEngine(PagedBatcher):
         self.queue.append(_PendingAdopt(
             rid, blocks, handle.seq_len, int(first_token), num_new,
             mode, src, submitted,
+            chain=list(chain) if chain else None,
         ))
         if admit:
             self._admit_pending()
@@ -721,6 +779,124 @@ class DecodeEngine(PagedBatcher):
             self._rids.discard(rid)
             return True
         return False
+
+    # -- live session migration (vtpu/serving/migrate.py) ---------------
+    # The mover runs on this engine's driving thread — the same
+    # serialization contract as the wire sink below — so the export
+    # gather and the decode loop's donating dispatches order by program
+    # sequence, never by a lock.
+    def exportable_sessions(self) -> List[str]:
+        """Rids of the live decode slots a mover can export."""
+        return [r for r in self.rid if r is not None]
+
+    def _retire_rows(self, slots: List[int]) -> None:
+        for slot in slots:
+            self._slot_base.pop(slot, None)
+            self._slot_chain.pop(slot, None)
+        super()._retire_rows(slots)
+
+    def export_session(self, rid: str) -> SessionExport:
+        """Detach a live slot into a transferable session export: drain
+        the in-flight windows (≤ ``pipeline_depth`` — sibling slots are
+        never frozen longer than one window), snapshot the cursor /
+        tail / budget, detach the blocks into a one-adoption
+        :class:`~vtpu.serving.kvpool.KVHandle`, and free the slot.  The
+        session stops existing HERE: it lives on in the export, which
+        either adopts at a target or restores via
+        :meth:`adopt_session` — raises :class:`~vtpu.serving.migrate.
+        SessionGoneError` when the rid finished during the drain (its
+        transcript is complete; nothing to move)."""
+        while self._inflight:
+            self._harvest_oldest()
+        self._flush_first_tokens()
+        slot = next((i for i in range(self.max_batch)
+                     if self.rid[i] == rid), None)
+        if slot is None:
+            raise SessionGoneError(
+                f"session {rid!r} is not live on replica "
+                f"{self.replica_id} (finished, queued, or never here)"
+            )
+        tail = [int(t) for t in self.out[rid]]
+        base = self._slot_base[slot]
+        cursor = base + len(tail) - 1
+        remaining = int(self.remaining[slot])
+        frozen = bool(self.done_frozen[slot])
+        blocks = self._slot_blocks.pop(slot)
+        # content attestation for the suffix-only leg: the chain that
+        # rode in with the adoption (per-slot), else whatever run the
+        # pool registry attests for exactly these blocks
+        chain = tuple(self._slot_chain.pop(slot, None)
+                      or self.pool.digests_for_run(blocks))
+        handle = self.pool.detach(blocks, seq_len=cursor)
+        # free the slot WITHOUT releasing the blocks (their references
+        # moved into the handle): host bookkeeping mirrors retirement,
+        # and the device row is pointed at the garbage block so the
+        # slot's future inactive decode writes land nowhere real
+        self.active[slot] = False
+        self.rid[slot] = None
+        self.done_frozen[slot] = False
+        self.remaining[slot] = 0
+        self._slot_base.pop(slot, None)
+        self._rids.discard(rid)
+        del self.out[rid]
+        idx = jnp.asarray([slot], jnp.int32)
+        self.cache = dict(
+            self.cache,
+            block_table=self.cache["block_table"].at[idx].set(
+                jnp.zeros((1, self.nb_max), jnp.int32)
+            ),
+            pos=self.cache["pos"].at[idx].set(0),
+        )
+        return SessionExport(rid=rid, handle=handle, cursor=cursor,
+                             tail=tuple(tail), remaining=remaining,
+                             frozen=frozen, chain=chain,
+                             block_size=self.block_size)
+
+    def adopt_session(self, export: SessionExport, *,
+                      blocks: Optional[List[int]] = None,
+                      submitted: float = 0.0) -> None:
+        """Adopt a same-pool session export: the restore leg of a
+        failed move, or an in-process move between engines sharing one
+        pool.  ``blocks`` carries a claim the caller already took from
+        the handle (the mover's post-OPEN failure path); otherwise the
+        handle is claimed here (stale stamps fail loudly).  Cross-pool
+        adoption goes over the wire sink path instead — the OPEN doc's
+        ``session`` sub-document."""
+        if export.rid in self._rids:
+            raise KVHandoffError(f"duplicate request id {export.rid!r}")
+        if not export.tail:
+            raise KVHandoffError(
+                f"session export for {export.rid!r} has an empty tail"
+            )
+        if export.cursor + export.remaining + 1 > self.model.max_seq:
+            raise ValueError(
+                f"cursor ({export.cursor}) + remaining "
+                f"({export.remaining}) exceeds max_seq "
+                f"({self.model.max_seq})"
+            )
+        if blocks is None:
+            blocks = self.pool.adopt(export.handle)  # StaleHandleError
+        self._rids.add(export.rid)
+        self.queue.append(_PendingAdopt(
+            export.rid, list(blocks), int(export.cursor),
+            int(export.tail[-1]), int(export.remaining) + 1,
+            "shared", None, submitted,
+            tail=[int(t) for t in export.tail],
+            frozen=bool(export.frozen),
+        ))
+        self._admit_pending()
+
+    def start_extract(self, blocks,
+                      codec: str = wirecodec.CODEC_FP32) -> HostExtract:
+        """Async D2H of exported-session blocks — the sender half of a
+        migration stream, mirroring the prefill engine's wire extract.
+        Runs on the engine's driving thread (the wire-sink contract),
+        so the gather's dispatch orders after any in-flight decode
+        window and before the next one; detached blocks are never
+        re-leased or re-written, so gathering the CURRENT pool leaves
+        is value-correct whenever the copy lands."""
+        return _extract_blocks(self._split_cache()[0], blocks, codec,
+                               self._mig_gather, self._mig_gather_quant)
 
     # -- wire transport (receiver sink) --------------------------------
     # The ReceiverHub (vtpu/serving/transport.py) drives these: open
@@ -783,18 +959,42 @@ class DecodeEngine(PagedBatcher):
                         f"seq_len ({seq_len}) + num_new ({num_new}) "
                         f"exceeds max_seq ({self.model.max_seq})"
                     )
-        dst = self.pool.lease_upto(total_blocks)
+        # suffix-only leg (plain handoffs AND session migrations): the
+        # OPEN doc's chain (the prompt's chained block digests) is
+        # matched against this pool's registry — every matched leading
+        # block is REFERENCED for the incoming stream instead of
+        # shipped, and the skip count rides the OPEN ack back to the
+        # sender.  Foreign-granularity digests simply never match
+        # (they attest different token spans), so no block-size check
+        # gates the MATCH; registration below does check.  Capped at
+        # total − 1 so at least one block always streams (the FIN
+        # carries the adoption).
+        sess = (meta or {}).get("session")
+        shared: list = []
+        skip = 0
+        chain = ((sess or {}).get("chain")
+                 or (meta or {}).get("chain") or [])
+        if chain and total_blocks > 1:
+            shared, skip = self.pool.match_and_ref(
+                chain, min(len(chain), total_blocks - 1)
+            )
+        dst = self.pool.lease_upto(total_blocks - skip)
         if not dst:
+            if shared:
+                self.pool.release(shared)
             return None  # saturated → credits 0 → router backpressure
         self._rids.add(rid)
-        ctx = {"rid": rid, "dst": dst, "total": total_blocks,
+        ctx = {"rid": rid, "dst": dst, "total": total_blocks - skip,
                "chunk_blocks": int(chunk_blocks), "written": 0,
-               "closed": False, "codec": str(codec), "slot": None}
+               "closed": False, "codec": str(codec), "slot": None,
+               "skip": skip, "shared": shared}
         # speculative adoption: reserve a free slot NOW and publish the
         # prefill's first token — the stream's wall time stops gating
-        # first-token latency.  Device state is untouched until FIN
-        # (the reserved slot stays inactive; decode windows write its
-        # row into the garbage block), so rollback is pure host work.
+        # first-token latency.  A migrated session publishes its whole
+        # TAIL instead, so the deployment's transcript is whole the
+        # moment the move is underway.  Device state is untouched until
+        # FIN (the reserved slot stays inactive; decode windows write
+        # its row into the garbage block), so rollback is pure host work.
         if self.speculative and meta is not None:
             try:
                 first = int(meta["first"])
@@ -805,7 +1005,11 @@ class DecodeEngine(PagedBatcher):
                 if slot is not None:
                     self._spec_slots[slot] = rid
                     ctx["slot"] = slot
-                    self.out[rid] = [first]
+                    try:
+                        self.out[rid] = ([int(t) for t in sess["tail"]]
+                                         if sess else [first])
+                    except (KeyError, TypeError, ValueError):
+                        self.out[rid] = [first]  # malformed: FIN decides
                     SPEC_ADOPTIONS.inc()
         return ctx
 
@@ -908,33 +1112,66 @@ class DecodeEngine(PagedBatcher):
         self.cache = dict(new_pools, pos=bpos, block_table=btab)
         ctx["written"] = block_off + nblocks
 
+    def _wire_release(self, ctx) -> None:
+        """Release EVERY pool reference a wire stream's ctx holds: the
+        pre-leased destination blocks plus any registry-matched shared
+        prefix blocks a session OPEN referenced (suffix-only)."""
+        blocks = list(ctx.get("shared") or []) + list(ctx["dst"])
+        if blocks:
+            self.pool.release(blocks)
+
     def wire_finish(self, ctx, meta: dict) -> None:
         from vtpu.serving.transport import WireError
 
         ctx["closed"] = True
+        sess = (meta or {}).get("session")
         try:
             seq_len = int(meta["handle"]["seq_len"])
             first = int(meta.get("first", 0))
             num_new = int(meta.get("num_new", 1))
             submitted = float(meta.get("submitted", 0.0))
+            tail = None
+            frozen = False
+            if sess is not None:
+                tail = [int(t) for t in sess["tail"]]
+                if not tail:
+                    raise ValueError("empty session tail")
+                frozen = bool(sess.get("done"))
+                first = tail[-1]  # the next decode step's input token
         except (KeyError, TypeError, ValueError) as e:
             self._spec_rollback(ctx)
-            self.pool.release(ctx["dst"])
+            self._wire_release(ctx)
             self._rids.discard(ctx["rid"])
             raise WireError(f"malformed wire stream meta: {e}") from e
         if seq_len + num_new > self.model.max_seq:
             # backstop of the wire_open check (a sender could mutate
             # its meta between OPEN and FIN): never adopt past max_seq
             self._spec_rollback(ctx)
-            self.pool.release(ctx["dst"])
+            self._wire_release(ctx)
             self._rids.discard(ctx["rid"])
             raise WireError(
                 f"seq_len ({seq_len}) + num_new ({num_new}) exceeds "
                 f"max_seq ({self.model.max_seq})"
             )
+        # suffix-only sessions resume over shared-prefix + streamed
+        # blocks in table order; the shared refs now belong to the slot
+        blocks = list(ctx.get("shared") or []) + list(ctx["dst"])
+        # the adopted prefix registers through pa.chain in _adopt_group
+        # — gated on matching digest granularity (a foreign block size
+        # would attest the wrong token spans in this pool)
+        if sess is not None:
+            chain = sess.get("chain") or []
+            bs = int(sess.get("chain_bs", 0) or 0)
+        else:
+            chain = meta.get("chain") or []
+            bs = int(meta.get("chain_bs", 0) or 0)
+        # absent/zero granularity NEVER registers (same safe default on
+        # both paths): an unattested chain could name wrong token spans
         pa = _PendingAdopt(
-            ctx["rid"], list(ctx["dst"]), seq_len, first, num_new,
-            "wire", None, submitted,
+            ctx["rid"], blocks, seq_len, first, num_new,
+            "wire", None, submitted, tail=tail, frozen=frozen,
+            chain=(list(chain)[:len(blocks)]
+                   if chain and bs == self.block_size else None),
         )
         slot = ctx.get("slot")
         with self._spec_lock:
@@ -944,11 +1181,11 @@ class DecodeEngine(PagedBatcher):
             # the slot was held for this stream since OPEN: the fused
             # bind fires NOW, on last-chunk arrival, without queueing
             # behind other pending adoptions for a free slot
-            self._slot_blocks[slot] = list(ctx["dst"])
-            self._adopt_group([(slot, pa, list(ctx["dst"]))])
-            return
-        self.queue.append(pa)
-        self._admit_pending()
+            self._slot_blocks[slot] = list(blocks)
+            self._adopt_group([(slot, pa, list(blocks))])
+        else:
+            self.queue.append(pa)
+            self._admit_pending()
 
     def _spec_rollback(self, ctx) -> None:
         """Retract a speculative reservation: free the slot and
@@ -968,8 +1205,7 @@ class DecodeEngine(PagedBatcher):
             return
         ctx["closed"] = True
         self._spec_rollback(ctx)
-        if ctx["dst"]:
-            self.pool.release(ctx["dst"])
+        self._wire_release(ctx)
         self._rids.discard(ctx["rid"])
 
     # -- admission: drain claimed handles into free slots ---------------
@@ -1023,14 +1259,30 @@ class DecodeEngine(PagedBatcher):
             self._copy_rows(sub)
         # host bookkeeping mirrors _queue_first, except the first token
         # is already a known int (prefill materialized it as a token —
-        # tokens cross the host, cache contents never do)
+        # tokens cross the host, cache contents never do).  A migrated
+        # session (pa.tail) resumes its FULL transcript and EOS state;
+        # its budget accounting is identical (num_new = remaining + 1).
         for slot, pa, _dst in group:
+            tail = pa.tail if pa.tail is not None else [pa.first]
             self.rid[slot] = pa.rid
-            self.out[pa.rid] = [pa.first]
+            self.out[pa.rid] = list(tail)
             self.active[slot] = True
-            self.done_frozen[slot] = (self.eos_id is not None
-                                      and pa.first == self.eos_id)
+            self.done_frozen[slot] = pa.frozen or (
+                self.eos_id is not None and pa.first == self.eos_id
+            )
             self.remaining[slot] = pa.num_new - 1
+            # cursor bookkeeping for a future export of THIS slot
+            self._slot_base[slot] = pa.seq_len - (len(tail) - 1)
+            self._slot_chain.pop(slot, None)
+            if pa.chain:
+                # decode-side prefix adoption: the slot's leading blocks
+                # now hold the digest-attested prompt prefix (bind/copy
+                # enqueued above — program order covers later readers);
+                # registering makes the NEXT handoff or migration of a
+                # sibling prompt suffix-only at this replica, and the
+                # slot keeps its chain so an export re-ships it
+                self.pool.register_prefix(pa.chain[:len(_dst)], _dst)
+                self._slot_chain[slot] = list(pa.chain)
             if pa.submitted:
                 _batcher._QTFT_HIST.observe(
                     time.perf_counter() - pa.submitted

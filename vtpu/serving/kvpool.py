@@ -436,6 +436,34 @@ class BlockPool:
                 return list(run), k
             return [], 0
 
+    def digests_for_run(self, blocks: Sequence[int]) -> List[str]:
+        """The longest CONTIGUOUS digest chain the registry attests for
+        the leading blocks of ``blocks``: entry ``i`` is the digest
+        registered for exactly ``blocks[:i+1]``.  The session mover's
+        suffix-only negotiation input — the returned chain travels in
+        the migration OPEN doc, and a receiver already holding any of
+        its depths skips those blocks on the wire.  Empty when the
+        prefix was never registered here (the stream just ships every
+        block).  Cold-path fallback — exporters prefer the per-slot
+        chain recorded at adoption; one registry scan with prefix
+        compares, no inverse map built under the pool lock."""
+        with self._lock:
+            if not self._prefix_runs:
+                return []
+            want = tuple(blocks)
+            by_depth: Dict[int, str] = {}
+            for d, run in self._prefix_runs.items():
+                k = len(run)
+                if k <= len(want) and run == want[:k]:
+                    by_depth[k] = d
+            out: List[str] = []
+            for k in range(1, len(want) + 1):
+                d = by_depth.get(k)
+                if d is None:
+                    break  # chains must be contiguous from depth 1
+                out.append(d)
+            return out
+
     def prefix_match_depth(self, chain: Sequence[str]) -> int:
         """Read-only longest match depth (blocks) — the router's
         PrefixIndex verification probe; takes no references."""

@@ -1,0 +1,443 @@
+"""Live session migration: the session-mover plane (ROADMAP item 2c).
+
+A drained or evict-requested decode replica used to finish its pinned
+sessions in place (vtpu/serving/router.py) — every drain stranded live
+work on a replica that was leaving, and the ContentionArbiter's
+preemption path (PR 9) could not wait for it.  This module composes the
+machinery that already exists — the RESUME-capable credit-flow
+transport (PR 10), the negotiated int8 codec and chained-digest prefix
+registry (PR 13) — into moving a *live* session between replicas:
+
+- the source engine **exports** a pinned session
+  (:meth:`~vtpu.serving.disagg.DecodeEngine.export_session`): the
+  slot's K/V blocks detach into a transferable
+  :class:`~vtpu.serving.kvpool.KVHandle`, and the host cursor state
+  (sequence position, generated-token tail, remaining budget, EOS
+  freeze) rides a :class:`SessionExport`;
+- the mover streams the blocks over the existing chunked transport —
+  the OPEN doc carries a ``session`` sub-document (cursor, tail,
+  remaining, done, chain; every RESUME response echoes it) and the
+  receiver adopts into a reserved slot via the existing wire sink
+  path, resuming decode **token-exactly**: no regeneration, no lost
+  work;
+- migration is **suffix-only when possible**: the OPEN chain (the
+  prompt's chained block digests, PR 13) lets the receiver skip every
+  leading block its pool registry already holds — only the unmatched
+  suffix ships (``skip_blocks`` in the OPEN ack), and the receiver
+  registers the chain after adoption so the *next* migrated sibling
+  session skips it too.
+
+Failure is typed and leak-free on both pools at every phase
+(:class:`MigrationError` hierarchy): a session either continues on the
+source (restored via :meth:`~vtpu.serving.disagg.DecodeEngine.
+adopt_session`) or fails loudly — **never silently duplicated on two
+replicas**.  The one genuinely ambiguous window is a FIN chunk whose
+response was lost AND whose resume probes all failed: the receiver may
+have adopted.  The sender tracks that window
+(``StreamSender.fin_unacked``) and the mover refuses to restore there,
+raising :class:`MigrationAmbiguousError` with the transcript tail for
+the deployment to reconcile (docs/serving.md §Session migration has
+the full failure matrix).
+
+This module is deliberately JAX-free (duck-typed engines/replicas), so
+the fast test lane drives the whole state machine — including the
+death-fuzz matrix — on fakes; ``make bench-migrate`` measures
+drain-via-migration against finish-in-place on virtual clocks.
+
+Threading: a mover runs on the target engine's driving thread (the
+same serialization contract as the wire sink — the router's pump loop
+satisfies it); ``serving.session_mover`` only guards the mover's own
+hub cache and participates in the lock-order witness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from vtpu.analysis.witness import make_lock
+from vtpu import obs
+from vtpu.serving.kvpool import KVHandle, KVHandoffError
+from vtpu.serving.transport import (
+    LoopbackLink,
+    ReceiverHub,
+    ReplicaSaturatedError,
+    StreamSender,
+)
+from vtpu.utils.envs import env_int
+
+log = logging.getLogger(__name__)
+
+__all__ = [
+    "MigrationAmbiguousError",
+    "MigrationError",
+    "MoveReport",
+    "NoMigrationTargetError",
+    "SessionExport",
+    "SessionGoneError",
+    "SessionMover",
+]
+
+_REG = obs.registry("serving")
+
+MIGRATIONS_TOTAL = _REG.counter(
+    "vtpu_session_migrations_total",
+    "Session moves by outcome: migrated (resumed on the target), "
+    "fallback (no target with credit — restored on the source to "
+    "finish in place), failed (typed mid-move failure — restored on "
+    "the source when it still lives), ambiguous (lost FIN ack with "
+    "resume probes exhausted — failed loudly, never restored)",
+)
+MIGRATE_HIST = _REG.histogram(
+    "vtpu_session_migrate_seconds",
+    "Wall time of one session move, export to resumed-on-target",
+)
+MIGRATE_BLOCKS = _REG.counter(
+    "vtpu_session_migrate_blocks_total",
+    "Session-migration pool blocks by kind: shipped (streamed over the "
+    "wire) vs skipped (suffix-only — the receiver's registry already "
+    "held the digest-matched prefix)",
+)
+
+DEFAULT_MAX_PUMPS = env_int("VTPU_MIGRATE_MAX_PUMPS", 1024)
+
+
+class MigrationError(KVHandoffError):
+    """Typed session-move failure.  ``phase`` names the state the move
+    failed in (``export`` / ``open`` / ``stream`` / ``fin`` /
+    ``restore``); ``restored`` is True when the session was re-adopted
+    on the source and continues there (finish-in-place)."""
+
+    def __init__(self, detail: str, phase: str = "move",
+                 restored: bool = False) -> None:
+        super().__init__(detail)
+        self.phase = phase
+        self.restored = restored
+
+
+class SessionGoneError(MigrationError):
+    """The session finished (or never lived) on the source — nothing to
+    move.  Raised by ``export_session`` after its pipeline drain; not a
+    failure, there is no work to strand."""
+
+    def __init__(self, detail: str) -> None:
+        super().__init__(detail, phase="export")
+
+
+class NoMigrationTargetError(MigrationError):
+    """No candidate target accepted the OPEN (all saturated, dead, or
+    pool-mismatched).  The session was restored on the source — the
+    documented finish-in-place fallback."""
+
+    def __init__(self, detail: str, restored: bool = True) -> None:
+        super().__init__(detail, phase="open", restored=restored)
+
+
+class MigrationAmbiguousError(MigrationError):
+    """The FIN chunk's response was lost and every resume probe failed:
+    the receiver MAY have adopted the session.  The mover released the
+    source blocks and did NOT restore — restoring could duplicate the
+    session on two replicas, the one outcome this plane must never
+    produce.  ``tail`` carries the transcript so the deployment can
+    reconcile against the target once it answers again."""
+
+    def __init__(self, detail: str, tail: Optional[List[int]] = None) -> None:
+        super().__init__(detail, phase="fin", restored=False)
+        self.tail = list(tail or [])
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionExport:
+    """A live session detached from its decode slot: the claim ticket
+    for its K/V blocks plus the host cursor state that makes resumption
+    token-exact.  ``cursor`` is the device sequence position of the
+    slot at export (the next decode step writes K/V there), ``tail``
+    the generated tokens so far (the last one is the next step's input
+    token), ``remaining`` the budget still to generate, ``frozen``
+    whether EOS was already seen (the tail pads with ``eos_id``), and
+    ``chain`` the prompt's chained block digests as far as the source
+    pool's registry attests them (suffix-only negotiation input — may
+    be empty)."""
+
+    rid: str
+    handle: KVHandle
+    cursor: int
+    tail: Tuple[int, ...]
+    remaining: int
+    frozen: bool
+    chain: Tuple[str, ...] = ()
+    block_size: int = 0   # digest granularity of ``chain``
+
+    def session_doc(self) -> dict:
+        """The OPEN doc's ``session`` sub-document (echoed by every
+        RESUME response)."""
+        return {
+            "cursor": int(self.cursor),
+            "tail": [int(t) for t in self.tail],
+            "remaining": int(self.remaining),
+            "done": bool(self.frozen),
+            "chain": list(self.chain),
+            "chain_bs": int(self.block_size),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class MoveReport:
+    """Outcome record of one successful move."""
+
+    rid: str
+    target: str
+    blocks_shipped: int
+    blocks_skipped: int
+    wire_bytes: int
+    codec: str
+    duration_s: float
+
+
+class SessionMover:
+    """Drives live session moves between decode replicas over the wire
+    transport.  Duck-typed on both ends:
+
+    - the **source** must expose ``export_session`` / ``adopt_session``
+      (the restore leg) / ``start_extract`` / ``wire_layout`` /
+      ``pool`` — :class:`~vtpu.serving.disagg.DecodeEngine` does; a
+      :class:`~vtpu.serving.transport.WireReplica` is unwrapped to its
+      ``_local`` engine (a purely remote source cannot export from this
+      process and is reported non-exportable);
+    - the **target** is reached through its existing link when it is a
+      ``WireReplica``, else wrapped in a per-engine
+      :class:`~vtpu.serving.transport.ReceiverHub` +
+      :class:`~vtpu.serving.transport.LoopbackLink` (cached, so stamp
+      replay protection spans moves).
+    """
+
+    def __init__(self, *, chunk_blocks: int = 0, retries: int = 0,
+                 codec: str = "", max_pumps: int = 0,
+                 clock=time.perf_counter) -> None:
+        self.chunk_blocks = chunk_blocks
+        self.retries = retries
+        self.codec = codec
+        self.max_pumps = max_pumps or DEFAULT_MAX_PUMPS
+        self._clock = clock
+        self._lock = make_lock("serving.session_mover")
+        self._hubs: Dict[int, LoopbackLink] = {}
+
+    # -- topology -------------------------------------------------------
+    @staticmethod
+    def engine_of(replica):
+        """The exportable engine behind a router replica (a WireReplica
+        proxies its in-process ``_local`` engine; a remote-only replica
+        has none and cannot be a migration SOURCE from here)."""
+        local = getattr(replica, "_local", None)
+        return local if local is not None else replica
+
+    def exportable(self, replica) -> List[str]:
+        """Rids of the live sessions the replica can export (empty for
+        engines without the session surface — fakes, remote-only
+        proxies — so callers need no special casing)."""
+        eng = self.engine_of(replica)
+        fn = getattr(eng, "exportable_sessions", None)
+        if fn is None:
+            return []
+        try:
+            return list(fn())
+        except Exception:  # noqa: BLE001 — a dying source exports nothing
+            log.debug("mover: exportable_sessions failed", exc_info=True)
+            return []
+
+    def _link_for(self, replica):
+        link = getattr(replica, "link", None)
+        if link is not None:
+            return link  # WireReplica: reuse its transport
+        with self._lock:
+            lk = self._hubs.get(id(replica))
+            if lk is None:
+                lk = LoopbackLink(ReceiverHub(replica))
+                self._hubs[id(replica)] = lk
+            return lk
+
+    # -- the move state machine -----------------------------------------
+    def move(self, rid: str, source,
+             targets: Sequence[Tuple[str, object]]) -> MoveReport:
+        """Move one live session: export → OPEN at the first target
+        with credit → stream (suffix-only when the target's registry
+        matches the chain) → resume on the target.  Raises the typed
+        :class:`MigrationError` hierarchy; on every failure except the
+        ambiguous-FIN window the session is restored on the source
+        (finish-in-place) before the error propagates."""
+        src = self.engine_of(source)
+        t0 = self._clock()
+        try:
+            export = src.export_session(rid)  # SessionGoneError through
+        except MigrationError:
+            raise
+        except Exception as e:  # noqa: BLE001 — a dying source, typed
+            MIGRATIONS_TOTAL.inc(outcome="failed")
+            raise MigrationError(
+                f"export of {rid} failed on the source: {e}",
+                phase="export",
+            ) from e
+        sender = None
+        picked = None
+        target_rep = None
+        try:
+            layout = src.wire_layout()
+        except Exception as e:  # noqa: BLE001 — dying source, typed;
+            # nothing claimed yet, so the handle restores cleanly
+            restored = self._restore(src, export, None)
+            MIGRATIONS_TOTAL.inc(outcome="failed")
+            raise MigrationError(
+                f"source layout for {rid} failed: {e}",
+                phase="export", restored=restored,
+            ) from e
+        for tid, rep in targets:
+            s = StreamSender(
+                self._link_for(rep), rid, export.handle,
+                layout=layout,
+                meta_extra={
+                    "first": int(export.tail[-1]),
+                    "num_new": int(export.remaining) + 1,
+                    "submitted": 0.0,
+                    "session": export.session_doc(),
+                },
+                chunk_blocks=self.chunk_blocks, retries=self.retries,
+                codec=self.codec,
+            )
+            try:
+                s.open()
+            except ReplicaSaturatedError:
+                continue  # no credit there — try the next target
+            except Exception:  # noqa: BLE001 — dead or mismatched
+                # target (typed wire error, torn socket, or an
+                # in-process engine dying mid-call): the router's
+                # health loop owns draining it; this move looks further
+                log.debug("mover: OPEN for %s at %s failed", rid, tid,
+                          exc_info=True)
+                continue
+            sender, picked, target_rep = s, tid, rep
+            break
+        if sender is None:
+            restored = self._restore(src, export, None)
+            MIGRATIONS_TOTAL.inc(outcome="fallback")
+            raise NoMigrationTargetError(
+                f"no migration target with credit for {rid} "
+                f"({len(list(targets))} candidates)", restored=restored,
+            )
+        # claim AFTER the accepted OPEN (the WireReplica discipline): a
+        # saturated/failed OPEN leaves the handle detached so the
+        # restore leg re-adopts it without a fresh export
+        try:
+            blocks = src.pool.adopt(export.handle)
+        except Exception as e:  # noqa: BLE001 — e.g. a stale stamp:
+            # typed, restore (release_handle inside _restore's failure
+            # leg keeps it leak-free either way), and tell the receiver
+            try:
+                sender.abort()
+            except Exception:  # noqa: BLE001
+                log.debug("mover: abort after failed claim failed",
+                          exc_info=True)
+            restored = self._restore(src, export, None)
+            MIGRATIONS_TOTAL.inc(outcome="failed")
+            raise MigrationError(
+                f"claim for {rid} failed: {e}", phase="claim",
+                restored=restored,
+            ) from e
+        skip = sender.skip
+        shipped = list(blocks[skip:])
+        sender.extract_fn = (
+            lambda: src.start_extract(shipped, codec=sender.codec)
+        )
+        try:
+            pumps = 0
+            while not sender.pump():
+                pumps += 1
+                if pumps > self.max_pumps:
+                    sender.abort()
+                    restored = self._restore(src, export, blocks)
+                    MIGRATIONS_TOTAL.inc(outcome="failed")
+                    raise MigrationError(
+                        f"stream for {rid} stalled after "
+                        f"{self.max_pumps} pumps (credits never freed)",
+                        phase="stream", restored=restored,
+                    )
+                # let the target retire slots / free blocks so starved
+                # credits top up (loopback topologies; a WireReplica
+                # step also pumps its own senders)
+                step = getattr(target_rep, "step", None)
+                if step is not None:
+                    try:
+                        step()
+                    except Exception:  # noqa: BLE001 — a dying target
+                        # surfaces through the stream itself
+                        log.debug("mover: target %s step failed", picked,
+                                  exc_info=True)
+        except MigrationError:
+            raise
+        except Exception as e:  # noqa: BLE001 — typed below
+            if not (sender.done or sender.aborted):
+                try:
+                    sender.abort()
+                except Exception:  # noqa: BLE001
+                    log.debug("mover: abort notify failed", exc_info=True)
+            if sender.fin_unacked and not sender.receiver_gone:
+                # the receiver MAY hold the session (lost final ack):
+                # restoring would risk two live copies.  Release the
+                # source side (leak-free) and fail loudly with the
+                # transcript for the deployment to reconcile.
+                try:
+                    src.pool.release(blocks)
+                except KVHandoffError:
+                    log.exception("mover: ambiguous-FIN release failed")
+                MIGRATIONS_TOTAL.inc(outcome="ambiguous")
+                raise MigrationAmbiguousError(
+                    f"FIN for {rid} sent but unacknowledged and every "
+                    f"resume probe failed — the target may hold the "
+                    f"session; not restoring on the source",
+                    tail=list(export.tail),
+                ) from e
+            restored = self._restore(src, export, blocks)
+            MIGRATIONS_TOTAL.inc(outcome="failed")
+            raise MigrationError(
+                f"stream for {rid} to {picked} failed: {e}",
+                phase="stream", restored=restored,
+            ) from e
+        # the target holds the session; the source's claim is spent
+        src.pool.release(blocks)
+        dur = self._clock() - t0
+        MIGRATIONS_TOTAL.inc(outcome="migrated")
+        MIGRATE_HIST.observe(dur)
+        MIGRATE_BLOCKS.inc(len(shipped), kind="shipped")
+        if skip:
+            MIGRATE_BLOCKS.inc(skip, kind="skipped")
+        per_block = int(getattr(sender.extract, "per_block", 0) or 0)
+        return MoveReport(
+            rid=rid, target=picked, blocks_shipped=len(shipped),
+            blocks_skipped=skip, wire_bytes=len(shipped) * per_block,
+            codec=sender.codec, duration_s=dur,
+        )
+
+    def _restore(self, src, export: SessionExport,
+                 blocks: Optional[List[int]]) -> bool:
+        """Finish-in-place leg: re-adopt the exported session on the
+        source so it continues decoding exactly where it left off.
+        ``blocks`` is the mover's claim when the handle was already
+        consumed (post-OPEN failures), else the handle itself is
+        re-adopted.  Returns False — with both claims released, never
+        leaked — when the source itself is too dead to take it back."""
+        try:
+            src.adopt_session(export, blocks=blocks)
+            return True
+        except Exception:  # noqa: BLE001 — source died mid-move
+            log.exception("mover: restore of %s on the source failed",
+                          export.rid)
+            try:
+                if blocks is None:
+                    src.pool.release_handle(export.handle)
+                else:
+                    src.pool.release(blocks)
+            except Exception:  # noqa: BLE001 — pool gone with the engine
+                log.debug("mover: release after failed restore failed",
+                          exc_info=True)
+            return False

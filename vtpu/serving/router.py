@@ -60,6 +60,11 @@ from vtpu import obs
 from vtpu.obs.events import EventType, emit
 from vtpu.scheduler.shard import HashRing
 from vtpu.serving.kvpool import KVHandoffError
+from vtpu.serving.migrate import (
+    MigrationError,
+    SessionGoneError,
+    SessionMover,
+)
 from vtpu.serving.prefix import PrefixIndex, chain_digests
 from vtpu.serving.transport import ReplicaSaturatedError
 
@@ -97,6 +102,12 @@ _PREFILL_ACTIVE = _REG.gauge(
     "Prefill replicas currently accepting new submissions (healthy and "
     "not scaled down)",
 )
+_PINNED = _REG.gauge(
+    "vtpu_router_sessions_pinned_total",
+    "Sessions currently pinned to the labelled decode replica (session "
+    "affinity); the session mover targets the least-pinned "
+    "credit-holding healthy replica",
+)
 
 
 class RouterReject(Exception):
@@ -133,6 +144,8 @@ class Router:
         prefill_min_active: int = 1,
         prefill_scale_cooldown: int = 2,
         clock=time.monotonic,
+        migrate_on_drain: bool = True,
+        mover: Optional[SessionMover] = None,
     ) -> None:
         if not replicas:
             raise ValueError("Router needs at least one decode replica")
@@ -192,6 +205,21 @@ class Router:
             collections.OrderedDict()
         )
         self._session_cap = 65536
+        # per-replica pinned-session census (vtpu_router_sessions_pinned):
+        # maintained with the pin map, read by the session mover's
+        # least-pinned target selection and by stats()
+        self._pinned: "collections.Counter[str]" = collections.Counter()
+        # rid → session, for moving a migrated rid's pin and replaying
+        # its in-flight requests on the target (bounded with the pins)
+        self._rid_session: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
+        # live session migration (vtpu/serving/migrate.py): drains and
+        # evict requests move pinned sessions to healthy replicas
+        # instead of stranding them; finish-in-place stays the fallback
+        self._mover = (mover if mover is not None
+                       else SessionMover() if migrate_on_drain else None)
+        self._evicted: set = set()   # never ping-restored
         self._target: Dict[str, str] = {}       # rid → decode replica id
         self._rid_prefill: Dict[str, str] = {}  # rid → prefill id (queued)
         # cluster-wide prefix cache, router half: prompts digest into
@@ -215,6 +243,7 @@ class Router:
         self.shed = 0
         for rid in self.replicas:
             _HEALTHY_INFO.set(1.0, replica=rid)
+            _PINNED.set(0.0, replica=rid)
         _PREFILL_ACTIVE.set(float(len(self._active_prefills())))
 
     # -- compat ---------------------------------------------------------
@@ -242,6 +271,16 @@ class Router:
 
     def _route(self, session: str) -> str:
         pinned = self._sessions.get(session)
+        if pinned is not None and pinned in self._evicted:
+            # an evict-requested replica is LEAVING — unlike a health
+            # drain (which may restore), routing new turns there would
+            # hand work to a pod the reconciler is about to delete.
+            # Drop the stale pin (its live slots already migrated; an
+            # idle session has nothing to move) and re-pin below.
+            self._sessions.pop(session, None)
+            self._pinned[pinned] = max(0, self._pinned[pinned] - 1)
+            _PINNED.set(float(self._pinned[pinned]), replica=pinned)
+            pinned = None
         if pinned is not None:
             # in-flight sessions finish where they are, even on a
             # drained replica (it still answers; it just takes no new
@@ -256,8 +295,12 @@ class Router:
             )
         rid = self._ring.owner(session)
         self._sessions[session] = rid
+        self._pinned[rid] += 1
+        _PINNED.set(float(self._pinned[rid]), replica=rid)
         while len(self._sessions) > self._session_cap:
-            self._sessions.popitem(last=False)
+            _sess, old = self._sessions.popitem(last=False)
+            self._pinned[old] = max(0, self._pinned[old] - 1)
+            _PINNED.set(float(self._pinned[old]), replica=old)
         return rid
 
     def _pick_prefill(self, chain=()) -> str:
@@ -348,6 +391,9 @@ class Router:
             self.prefills[pid].submit(rid, prompt, num_new)
         self._rid_prefill[rid] = pid
         self._target[rid] = replica
+        self._rid_session[rid] = session
+        while len(self._rid_session) > self._session_cap:
+            self._rid_session.popitem(last=False)
         self._pending[replica] = self._pending.get(replica, 0) + 1
         _REQS_TOTAL.inc(outcome="routed")
         _BACKLOG.set(self._pending[replica], replica=replica)
@@ -413,7 +459,9 @@ class Router:
                 ok = False
             if ok:
                 self._fails[rid] = 0
-                if rid not in self._healthy:
+                if rid not in self._healthy and rid not in self._evicted:
+                    # an evict-requested replica is leaving for good:
+                    # answering pings must not put it back in the ring
                     self._restore(rid)
             else:
                 self._fails[rid] += 1
@@ -470,6 +518,137 @@ class Router:
              consecutive_failures=self._fails[rid])
         log.warning("router: replica %s drained after %d failed pings",
                     rid, self._fails[rid])
+        # a drain used to strand pinned sessions finishing in place;
+        # with the mover they migrate to healthy replicas token-exactly
+        # (finish-in-place stays the per-session fallback)
+        self._migrate_from(rid, reason="health-drain")
+
+    def request_evict(self, replica_id: str,
+                      reason: str = "evict-requested") -> int:
+        """Deployment hook for the arbiter's ``vtpu.io/evict-requested``
+        annotation (``types.annotations.EVICT_REQUESTED``): the replica
+        is leaving — drain it NOW (pings can never restore it) and
+        migrate its pinned sessions to healthy replicas so the eviction
+        strands no work.  Returns the number of sessions migrated."""
+        if replica_id not in self.replicas:
+            raise KeyError(f"unknown replica {replica_id!r}")
+        self._evicted.add(replica_id)
+        if replica_id in self._healthy:
+            self._healthy.discard(replica_id)
+            self._rebuild_ring()
+            _HEALTHY_INFO.set(0.0, replica=replica_id)
+            _TRANSITIONS.inc(replica=replica_id, to="drained")
+            emit(EventType.REPLICA_DRAINED, "router", node=replica_id,
+                 reason=reason)
+            log.info("router: replica %s drained (%s)", replica_id,
+                     reason)
+        return self._migrate_from(replica_id, reason=reason)
+
+    # -- live session migration (vtpu/serving/migrate.py) ---------------
+    def _migration_targets(self, exclude: str) -> List:
+        """Candidate targets ordered least-pinned first, restricted to
+        credit-holding (≥ 1 free pool block) healthy replicas — the
+        mover OPENs in this order and the receiver's own credit grant
+        has the final word."""
+        ranked = []
+        for tid in sorted(self._healthy - {exclude}):
+            st = self._safe_stats(self.replicas[tid])
+            if int(st.get("free", 0)) < 1:
+                continue  # pool can't pre-lease a single block
+            ranked.append((self._pinned.get(tid, 0), tid))
+        return [(tid, self.replicas[tid]) for _n, tid in sorted(ranked)]
+
+    def _migrate_from(self, source_id: str, reason: str) -> int:
+        """Mass-migrate every exportable pinned session off a draining
+        or evict-requested replica.  Per-session failures fall back to
+        finish-in-place (the mover restores the session on the source)
+        and never stop the sweep; pins move atomically with each
+        successful move, and in-flight requests re-aim at the target."""
+        if self._mover is None:
+            return 0
+        src_rep = self.replicas[source_id]
+        moved = 0
+        for rid in self._mover.exportable(src_rep):
+            try:
+                report = self._mover.move(
+                    rid, src_rep, self._migration_targets(source_id)
+                )
+            except SessionGoneError:
+                continue  # finished during the export drain
+            except MigrationError as e:
+                emit(EventType.SESSION_MIGRATION_FAILED, "router",
+                     node=source_id, rid=rid, phase=e.phase,
+                     restored=e.restored, reason=reason)
+                log.warning(
+                    "router: migration of %s off %s failed in phase "
+                    "%s (%s); %s", rid, source_id, e.phase, e,
+                    "finishing in place" if e.restored
+                    else "NOT restored",
+                )
+                continue
+            except Exception:  # noqa: BLE001 — the mover's contract is
+                # typed failure, but one surprise must not abort the
+                # sweep (and with it the whole pump) for the sessions
+                # still waiting to move
+                emit(EventType.SESSION_MIGRATION_FAILED, "router",
+                     node=source_id, rid=rid, phase="unknown",
+                     restored=False, reason=reason)
+                log.exception("router: migration of %s off %s raised "
+                              "untyped", rid, source_id)
+                continue
+            moved += 1
+            emit(EventType.SESSION_MIGRATED, "router", node=source_id,
+                 rid=rid, target=report.target,
+                 blocks_shipped=report.blocks_shipped,
+                 blocks_skipped=report.blocks_skipped, reason=reason)
+            sess = self._rid_session.get(rid)
+            if sess is not None and self._sessions.get(sess) == source_id:
+                # the pin moves with the session — atomically from the
+                # router's perspective: every later submit for this
+                # session routes to the target
+                self._sessions[sess] = report.target
+                self._pinned[source_id] = max(
+                    0, self._pinned[source_id] - 1)
+                self._pinned[report.target] += 1
+                _PINNED.set(float(self._pinned[source_id]),
+                            replica=source_id)
+                _PINNED.set(float(self._pinned[report.target]),
+                            replica=report.target)
+        self._retarget_inflight(source_id)
+        return moved
+
+    def _retarget_inflight(self, source_id: str) -> None:
+        """Requests admitted but not yet delivered (queued prefills,
+        parked handoffs) whose session moved: park them on the NEW pin
+        so the finished prefill replays on the target instead of
+        delivering into the drain."""
+        def new_pin(rid: str) -> Optional[str]:
+            sess = self._rid_session.get(rid)
+            new = self._sessions.get(sess) if sess is not None else None
+            if new is None or new == source_id or new not in self._healthy:
+                return None
+            return new
+
+        for rid, tgt in list(self._target.items()):
+            if tgt != source_id:
+                continue
+            new = new_pin(rid)
+            if new is None:
+                continue
+            self._target[rid] = new
+            self._dec_pending(source_id)
+            self._pending[new] = self._pending.get(new, 0) + 1
+            _BACKLOG.set(self._pending[new], replica=new)
+        for i, (tgt, res, src) in enumerate(self._parked):
+            if tgt != source_id:
+                continue
+            new = new_pin(res.rid)
+            if new is None:
+                continue
+            self._parked[i] = (new, res, src)
+            self._dec_pending(source_id)
+            self._pending[new] = self._pending.get(new, 0) + 1
+            _BACKLOG.set(self._pending[new], replica=new)
 
     def _restore(self, rid: str) -> None:
         self._healthy.add(rid)
@@ -602,16 +781,25 @@ class Router:
 
         def deliver(rep_id: str, res, src) -> None:
             eng = self.replicas[rep_id]
+            kw = {}
+            chain = getattr(res, "chain", ())
+            if chain and getattr(eng, "accepts_chain", False):
+                # decode-side prefix adoption: the replica registers
+                # the adopted prefix in its own pool so later handoffs
+                # and session migrations of sibling prompts go
+                # suffix-only (granularity re-checked engine-side)
+                kw["chain"] = list(chain)
             if hasattr(eng, "admit_pending"):
                 eng.submit_handle(
                     res.rid, res.handle, res.first_token, res.num_new,
                     source=src, submitted=res.submitted, admit=False,
+                    **kw,
                 )
                 touched.add(rep_id)
             else:
                 eng.submit_handle(
                     res.rid, res.handle, res.first_token, res.num_new,
-                    source=src, submitted=res.submitted,
+                    source=src, submitted=res.submitted, **kw,
                 )
 
         # saturated wire handoffs first: their credits may have freed
@@ -787,4 +975,7 @@ class Router:
                                      if self._prefix_index is not None
                                      else 0),
             "prefix_routed": self.prefix_routed,
+            "sessions_pinned": {rid: int(self._pinned.get(rid, 0))
+                                for rid in sorted(self.replicas)},
+            "evicted": sorted(self._evicted),
         }

@@ -310,22 +310,36 @@ def decode_frame(data: bytes) -> Frame:
 class _RxStream:
     __slots__ = ("sid", "rid", "meta", "ctx", "nchunks", "next_seq",
                  "total_blocks", "received_blocks", "credits",
-                 "stamp_key", "opened", "codec")
+                 "stamp_key", "opened", "codec", "skip")
 
     def __init__(self, sid, rid, meta, ctx, nchunks, total_blocks,
-                 credits, stamp_key, opened, codec):
+                 credits, stamp_key, opened, codec, skip=0):
         self.sid = sid
         self.rid = rid
         self.meta = meta
         self.ctx = ctx
         self.nchunks = nchunks
         self.next_seq = 1
+        # blocks the sender actually SHIPS: the handle total minus the
+        # skip count the sink negotiated at OPEN (suffix-only session
+        # migration — the receiver's pool already holds the prefix)
         self.total_blocks = total_blocks
         self.received_blocks = 0
         self.credits = credits
         self.stamp_key = stamp_key
         self.opened = opened
         self.codec = codec
+        self.skip = skip
+
+    def echo(self) -> dict:
+        """Stream facts every RESUME response re-states so a re-synced
+        sender can never drift off what OPEN negotiated: the codec, the
+        suffix skip, and (for session streams) the session doc."""
+        doc = {"codec": self.codec, "skip_blocks": self.skip}
+        sess = (self.meta or {}).get("session")
+        if sess is not None:
+            doc["session"] = sess
+        return doc
 
 
 class ReceiverHub:
@@ -419,11 +433,12 @@ class ReceiverHub:
                 if st.credits < st.total_blocks:
                     st.credits = int(self.sink.wire_top_up(st.ctx))
                     self._set_credit_gauge()
-                # the negotiated codec rides every RESUME response so a
-                # re-synced sender can never drift onto the wrong chunk
-                # kind mid-stream
+                # every RESUME response re-echoes what OPEN negotiated
+                # (codec, suffix skip, session doc) so a re-synced
+                # sender can never drift onto the wrong chunk kind or
+                # block offset mid-stream
                 return {"status": "ok", "next": st.next_seq,
-                        "credits": st.credits, "codec": st.codec}
+                        "credits": st.credits, **st.echo()}
             if frame.kind not in _DATA_KINDS:
                 raise WireError(f"unknown frame kind {frame.kind}")
             if frame.seq == 0:
@@ -474,15 +489,27 @@ class ReceiverHub:
             TRANSPORT_STREAMS.inc(outcome="saturated")
             return {"status": "saturated", "credits": 0}
         credits = int(self.sink.wire_credits(ctx))
-        st = _RxStream(frame.sid, rid, meta, ctx, frame.nchunks, total,
-                       credits, stamp_key, time.perf_counter(), codec)
+        # suffix-only negotiation (session migration): the sink may
+        # report that its pool already holds the handle's leading
+        # ``skip`` blocks (matched by chain digest) — only the suffix
+        # ships, so the hub's chunk accounting runs over the suffix and
+        # the sender is told to recompute its chunk plan from the same
+        # number.  A sink that never skips (skip 0) is byte-identical
+        # to the PR 10 protocol, frame for frame.
+        skip = int(ctx.get("skip", 0)) if isinstance(ctx, dict) else 0
+        skip = max(0, min(skip, total - 1)) if total else 0
+        suffix = total - skip
+        nchunks = -(-suffix // max(1, chunk_blocks)) if suffix else 0
+        st = _RxStream(frame.sid, rid, meta, ctx, nchunks, suffix,
+                       credits, stamp_key, time.perf_counter(), codec,
+                       skip=skip)
         self._streams[frame.sid] = st
         self._stamps[stamp_key] = frame.sid
         while len(self._stamps) > self._stamp_cap:
             self._stamps.popitem(last=False)
         self._set_credit_gauge()
         return {"status": "ok", "next": 1, "credits": credits,
-                "codec": codec}
+                **st.echo()}
 
     def _data(self, frame: Frame) -> dict:
         st = self._streams.get(frame.sid)
@@ -763,6 +790,22 @@ class StreamSender:
         self.finished_at = 0.0    # perf_counter stamp of final ack/abort
         self.done = False
         self.aborted = False
+        # suffix-only (session migration): leading handle blocks the
+        # receiver already holds — settled by the OPEN ack, before the
+        # deferred extract_fn runs, so the extract gathers only
+        # ``handle.blocks[skip:]`` and payload offsets are
+        # suffix-relative on both ends
+        self.skip = 0
+        # outcome disambiguation for the caller: ``fin_unacked`` is
+        # True exactly while a sent FIN chunk has no response — a
+        # stream that aborts in that window MAY have been applied by
+        # the receiver (the torn response could have carried the final
+        # ack), and a session mover must fail loudly instead of
+        # restoring the session on the source (never duplicate).
+        # ``receiver_gone`` means the receiver positively answered
+        # "gone" (its side aborted): the transfer did NOT apply.
+        self.fin_unacked = False
+        self.receiver_gone = False
 
     # -- wire I/O with resume -------------------------------------------
     def _send(self, data: bytes) -> dict:
@@ -788,6 +831,8 @@ class StreamSender:
                 last = e
                 continue
             if rsp.get("status") == "gone":
+                self.receiver_gone = True  # positively NOT applied
+                self.fin_unacked = False
                 self.abort(notify=False)
                 raise StreamAbortedError(
                     f"stream for {self.rid} gone at the receiver "
@@ -799,10 +844,28 @@ class StreamSender:
             # deployment-level retry of an already-decoding request)
             self._next = int(rsp.get("next", self._next))
             self._credits = int(rsp.get("credits", self._credits))
-            # re-sync to the NEGOTIATED codec: a resumed sender must
-            # never drift onto the other chunk kind mid-stream (the
-            # receiver would reject it as CodecMismatchError)
+            # re-sync to what OPEN negotiated: a resumed sender must
+            # never drift onto the other chunk kind (CodecMismatchError
+            # at the receiver) or block-offset base mid-stream
             self.codec = str(rsp.get("codec", self.codec))
+            self.skip = int(rsp.get("skip_blocks", self.skip))
+            # session streams: the echoed doc must be OURS — a receiver
+            # restart could have a different stream under this sid, and
+            # resuming chunks into a stranger's session scatters wrong
+            # K/V.  Drift aborts typed instead.
+            echoed = rsp.get("session")
+            mine = (self.meta or {}).get("session")
+            if (mine is not None and echoed is not None
+                    and echoed != mine):
+                self.abort()
+                raise StreamAbortedError(
+                    f"stream for {self.rid}: RESUME echoed a foreign "
+                    f"session doc (receiver state replaced?)"
+                )
+            if int(rsp.get("next", 0)) <= self.nchunks:
+                # the receiver's authoritative next-expected seq proves
+                # the FIN (if one was in flight) did NOT apply
+                self.fin_unacked = False
             return rsp
         self.abort()
         raise StreamAbortedError(
@@ -828,6 +891,25 @@ class StreamSender:
         # a new one echoes what it accepted (the advertised codec, or
         # its own fp32 fallback)
         self.codec = str(rsp.get("codec", wirecodec.CODEC_FP32))
+        # suffix-only ack: the receiver already holds the leading
+        # ``skip_blocks`` (digest-matched in its pool) — re-plan the
+        # chunk schedule over the suffix.  The caller's deferred
+        # extract_fn (which runs at the first pump, after this ack)
+        # must gather ``handle.blocks[self.skip:]``.
+        self.skip = int(rsp.get("skip_blocks", 0))
+        if self.skip:
+            if self.extract is not None:
+                # a preset extract covers EVERY block and would ship
+                # mis-offset payloads against the receiver's suffix
+                # plan — only extract_fn senders may carry a chain
+                self.abort()
+                raise WireError(
+                    f"stream for {self.rid}: suffix-only OPEN "
+                    f"(skip {self.skip}) needs a deferred extract_fn"
+                )
+            suffix = len(self.handle.blocks) - self.skip
+            self.nchunks = (-(-suffix // self.chunk_blocks)
+                            if suffix > 0 else 0)
 
     def pump(self) -> bool:
         """Push every chunk the credit grant and the D2H readiness
@@ -841,7 +923,10 @@ class StreamSender:
                 return False  # not yet extracted (caller's turn)
             self.extract = self.extract_fn()
             self.extract_fn = None
-        total = len(self.handle.blocks)
+        # suffix-relative plan: block offsets, payload slices, and the
+        # credit grant all count SHIPPED blocks (handle total − skip);
+        # with skip 0 this is byte-identical to the PR 10 sender
+        total = len(self.handle.blocks) - self.skip
         with trace.span("kv_wire_stream_pump", rid=self.rid):
             while self._next <= self.nchunks:
                 lo = (self._next - 1) * self.chunk_blocks
@@ -852,12 +937,14 @@ class StreamSender:
                     rsp = self._send(encode_frame(KIND_RESUME, self.sid))
                     status = rsp.get("status")
                     if status == "gone":
+                        self.receiver_gone = True
                         self.abort(notify=False)
                         raise StreamAbortedError(
                             f"stream for {self.rid} gone at the receiver"
                         )
                     if status == "fin":  # lost-FIN-ack resync: done
                         self._next = self.nchunks + 1
+                        self.fin_unacked = False
                         break
                     self._credits = int(rsp.get("credits", self._credits))
                     if hi > self._credits:
@@ -865,15 +952,22 @@ class StreamSender:
                 if self.extract.ready_blocks() < hi:
                     return False  # D2H still in flight; ride next pump
                 payload = self.extract.payload(lo, hi)
-                flags = FLAG_FIN if self._next == self.nchunks else 0
+                fin = self._next == self.nchunks
                 kind = (KIND_DATA_QUANT
                         if self.codec == wirecodec.CODEC_INT8
                         else KIND_DATA)
+                if fin:
+                    # from the send to the response, an abort is
+                    # AMBIGUOUS: the receiver may have applied the FIN
+                    # and lost only the ack (the caller must not assume
+                    # the transfer failed — see fin_unacked)
+                    self.fin_unacked = True
                 rsp = self._send(encode_frame(
                     kind, self.sid, seq=self._next,
                     nchunks=self.nchunks, block_off=lo, nblocks=hi - lo,
-                    flags=flags, payload=payload,
+                    flags=FLAG_FIN if fin else 0, payload=payload,
                 ))
+                self.fin_unacked = False
                 self._next = int(rsp.get("next", self._next + 1))
                 self._credits = int(rsp.get("credits", self._credits))
             self._finish()
@@ -950,21 +1044,35 @@ class WireReplica:
         st["queued"] = int(st.get("queued", 0)) + len(self._senders)
         return st
 
+    # the router hands digest chains to replicas that declare support
+    accepts_chain = True
+
     def submit_handle(self, rid: str, handle: KVHandle, first_token: int,
                       num_new: int, source=None, submitted: float = 0.0,
-                      admit: bool = True) -> None:
+                      admit: bool = True,
+                      chain: Optional[list] = None) -> None:
         if source is None or getattr(source, "pool", None) is None \
                 or source.pool.pool_id != handle.pool_id:
             raise PoolMismatchError(
                 f"wire handoff of a handle from pool {handle.pool_id!r} "
                 f"needs its source engine to extract from"
             )
+        meta_extra = {"first": int(first_token),
+                      "num_new": int(num_new),
+                      "submitted": float(submitted)}
+        if chain:
+            # decode-side prefix adoption over the wire: the receiver
+            # matches the chain against its pool registry at OPEN and
+            # answers with a skip count — only the unmatched suffix
+            # ships.  chain_bs gates REGISTRATION at the far end (a
+            # foreign granularity would attest the wrong token spans).
+            meta_extra["chain"] = [str(d) for d in chain]
+            meta_extra["chain_bs"] = int(
+                getattr(source, "block_size", 0) or 0)
         sender = StreamSender(
             self.link, rid, handle,
             layout=source.wire_layout(),
-            meta_extra={"first": int(first_token),
-                        "num_new": int(num_new),
-                        "submitted": float(submitted)},
+            meta_extra=meta_extra,
             chunk_blocks=self.chunk_blocks, retries=self.retries,
             codec=self.codec,
         )
@@ -977,10 +1085,12 @@ class WireReplica:
         # the gather dispatch + D2H issue happen at the FIRST PUMP (the
         # writer thread), overlapped with whatever the prefill engine
         # computes next; the claim above keeps the blocks stable until
-        # then.  The codec is settled by the OPEN ack above, so the
-        # deferred extract encodes what the receiver accepted.
+        # then.  The codec AND the suffix skip are settled by the OPEN
+        # ack above, so the deferred extract encodes what the receiver
+        # accepted and gathers only the blocks that will ship.
         sender.extract_fn = (
-            lambda: source.start_extract(blocks, codec=sender.codec)
+            lambda: source.start_extract(blocks[sender.skip:],
+                                         codec=sender.codec)
         )
 
         def _done(ok: bool, _blocks=blocks, _pool=source.pool) -> None:

@@ -122,7 +122,14 @@ def quantize_blocks_np(x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     amax = np.max(np.abs(xf), axis=axes) if axes else np.abs(xf)
     scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
     bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
-    q = np.clip(np.round(xf / scale.reshape(bshape)), -127, 127)
+    s = scale.reshape(bshape)
+    # nearest-RECONSTRUCTION level, bit-identical to the JAX twin
+    # (vtpu.ops.quant._nearest_int): round(xf/s) can land on a
+    # division-rounded .5 tie and breach the scale/2 bound by an ulp
+    lo = np.floor(xf / s)
+    hi = lo + 1.0
+    q = np.clip(np.where(np.abs(hi * s - xf) < np.abs(lo * s - xf),
+                         hi, lo), -127, 127)
     return q.astype(np.int8), scale
 
 
