@@ -10,8 +10,8 @@ PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
 	bench-sched bench-serve bench-churn bench-disagg bench-gang \
-	bench-goodput bench-migrate bench-smoke check obs-lint config-lint \
-	audit-check image chart clean tidy
+	bench-goodput bench-migrate bench-colo bench-smoke check obs-lint \
+	config-lint audit-check image chart clean tidy
 
 all: build
 
@@ -246,6 +246,23 @@ ifdef SMOKE
 	$(PY) benchmarks/serving_migrate.py --smoke
 else
 	$(PY) benchmarks/serving_migrate.py
+endif
+
+# FlexNPU co-location proof: ONE heterogeneous serving gang
+# (vtpu.io/gang-roles) admitted all-or-nothing, each role booted from
+# its vtpu.io/gang-placement annotation, best-effort decode tenants on
+# sustained-idle prefill chips through the real overlay + arbiter, and
+# the EvictBridge turning vtpu.io/evict-requested into
+# Router.request_evict so evictions migrate sessions (0 lost tokens) —
+# arms static_partition / colo_no_migrate / colo_full, cluster goodput
+# headline → docs/artifacts/serving_colo.json (docs/colo.md explains
+# the numbers).  SMOKE=1 runs a seconds-long schema pass (tier-1 safe;
+# also exercised by tests/test_colo.py).
+bench-colo:
+ifdef SMOKE
+	JAX_PLATFORMS=cpu $(PY) benchmarks/serving_colo.py --smoke
+else
+	JAX_PLATFORMS=cpu $(PY) benchmarks/serving_colo.py
 endif
 
 # every benchmark's smoke mode, artifacts redirected to scratch, each

@@ -75,6 +75,11 @@ BENCHES = {
         [sys.executable, "benchmarks/serving_migrate.py", "--smoke"],
         {},
     ),
+    "colo": (
+        "serving_colo.json",
+        [sys.executable, "benchmarks/serving_colo.py", "--smoke"],
+        {"JAX_PLATFORMS": "cpu"},
+    ),
 }
 
 # paths (tuples of dict keys from the artifact root) whose KEY SETS are
@@ -83,6 +88,9 @@ VARIABLE_PATHS = {
     ("arms",),                 # churn smoke runs a subset of arms
     ("units",),                # disagg smoke calibrates fewer shapes
     ("config", "model"),       # model kw dict is bench-internal
+    # colo smoke runs a smaller gang: member/role key sets shrink
+    ("arms", "*", "mesh_boot"),
+    ("arms", "*", "gang", "roles"),
 }
 
 

@@ -1,0 +1,488 @@
+"""The co-location composition layer (vtpu/serving/colo.py +
+benchmarks/serving_colo.py): placement-doc parsing and role boot,
+router_for_gang wiring, the reconciler→router EvictBridge (eviction →
+live session migration, zero lost tokens), the colo observability
+families, a threaded witness soak over the composed control plane, and
+the bench-colo smoke schema."""
+
+import json
+import threading
+
+import pytest
+
+from tests.golden_scenarios import seed_fake_node_group
+from vtpu.analysis import witness
+from vtpu.k8s import FakeClient, new_pod
+from vtpu.obs import events as ev
+from vtpu.scheduler import Scheduler, SchedulerConfig
+from vtpu.serving import colo
+from vtpu.serving.migrate import SessionMover
+from vtpu.serving.router import Router
+from vtpu.utils.types import (
+    QosClass,
+    annotations as A,
+    resources as R,
+)
+
+import benchmarks.serving_colo as bench
+
+
+# ---------------------------------------------------------------------------
+# Placement docs
+# ---------------------------------------------------------------------------
+
+def _placement_annos(role="prefill", shape="2x1x1", hosts=2, index=0,
+                     node="host-1", gang="default/serve"):
+    return {A.GANG_PLACEMENT: json.dumps({
+        "gang": gang, "role": role, "shape": shape, "hosts": hosts,
+        "index": index, "node": node,
+    })}
+
+
+def test_parse_placement_roundtrip():
+    pl = colo.parse_placement(_placement_annos())
+    assert pl.role == "prefill" and pl.shape == (2, 1, 1)
+    assert pl.hosts == 2 and pl.index == 0 and pl.chips == 2
+    assert pl.node == "host-1" and pl.gang == "default/serve"
+    assert pl.replica_id() == "prefill-0"
+    # the host-split form IS the mesh_from_rectangle argument
+    assert colo.host_split(pl) == [(2, 1, 1), (2, 1, 1)]
+    assert colo.parse_placement({}) is None
+    assert colo.parse_placement({"other": "x"}) is None
+
+
+def test_parse_placement_malformed_fails_loudly():
+    for doc in (
+        "{not json",
+        json.dumps({"role": "prefill"}),                   # missing keys
+        json.dumps({"gang": "g", "role": "p", "shape": "2x2",
+                    "hosts": 1, "index": 0}),              # 2-dim shape
+        json.dumps({"gang": "g", "role": "p", "shape": "2x0x1",
+                    "hosts": 1, "index": 0}),              # dim < 1
+        json.dumps({"gang": "g", "role": "p", "shape": "2x1x1",
+                    "hosts": 2, "index": 2}),              # index >= hosts
+        json.dumps({"gang": "g", "role": "p", "shape": "2x1x1",
+                    "hosts": 0, "index": 0}),              # hosts < 1
+    ):
+        with pytest.raises(ValueError):
+            colo.parse_placement({A.GANG_PLACEMENT: doc})
+
+
+def test_boot_role_engine_refuses_unknown_and_missing():
+    with pytest.raises(ValueError):
+        colo.boot_role_engine({}, None, None)          # no placement
+    with pytest.raises(ValueError):
+        colo.boot_role_engine(
+            _placement_annos(role="trainer"), None, None
+        )                                              # no engine for it
+
+
+def test_router_for_gang_wires_roles():
+    clock = bench.VClock()
+    cfg = dict(bench.SMOKE_CONFIG)
+    members = []
+    for role, hosts, cls in (("prefill", 2, None), ("decode", 1, None)):
+        for i in range(hosts):
+            pl = colo.parse_placement(_placement_annos(
+                role=role, hosts=hosts, index=i, node=f"host-{i}"
+            ))
+            eng = (bench.VirtualPrefill(pl.replica_id(), per_tick=2)
+                   if role == "prefill"
+                   else bench.VirtualDecode(pl.replica_id(), clock, cfg))
+            if role == "decode":
+                eng.alive = True
+            members.append((pl, eng))
+    router = colo.router_for_gang(members, ping_interval_s=0.0)
+    assert sorted(router.prefills) == ["prefill-0", "prefill-1"]
+    assert sorted(router.replicas) == ["decode-0"]
+    # a gang missing one of the two serving roles cannot form a router
+    with pytest.raises(ValueError):
+        colo.router_for_gang(members[:2])
+    with pytest.raises(ValueError):
+        colo.router_for_gang([(colo.parse_placement(
+            _placement_annos(role="trainer")), object())])
+
+
+# ---------------------------------------------------------------------------
+# End to end: gang admission → placement boot → bridge → migration
+# ---------------------------------------------------------------------------
+
+def _admit_role_gang(client, sched, names, roles, size, chips_per):
+    pods = []
+    for i in range(size):
+        p = new_pod(
+            f"gm-{i}", uid=f"uid-gm-{i}",
+            annotations={A.GANG_NAME: "serve", A.GANG_SIZE: str(size),
+                         A.GANG_ROLES: roles},
+            containers=[{"name": "m", "resources": {"limits": {
+                R.chip: chips_per, R.memory_percentage: 40,
+                R.cores: 60,
+            }}}],
+        )
+        client.create_pod(p)
+        pods.append(p)
+    for p in pods:
+        sched.filter(p, list(names))
+    out = []
+    for p in pods:
+        live = next(q for q in client.list_pods()
+                    if q["metadata"]["uid"] == p["metadata"]["uid"])
+        out.append((colo.parse_placement(
+            live["metadata"].get("annotations", {})
+        ), p["metadata"]["uid"]))
+    return out
+
+
+def _sid_for(ring_ids, want, start=0):
+    """A session id the router's hash ring pins to ``want``."""
+    from vtpu.scheduler.shard import HashRing
+
+    ring = HashRing(sorted(ring_ids))
+    i = start
+    while True:
+        sid = f"sess-{i}"
+        if ring.owner(sid) == want:
+            return sid, i + 1
+        i += 1
+
+
+def test_colo_e2e_evict_bridge_migrates_sessions():
+    """The full loop on one process: heterogeneous gang admitted for
+    real, members booted from their placement annotations, a
+    best-effort decode tenant admitted through the real overlay, then
+    `vtpu.io/evict-requested` → EvictBridge → Router.request_evict →
+    sessions migrate token-intact, and the reconciler's delete releases
+    the overlay — zero generated tokens lost."""
+    clock = bench.VClock()
+    cfg = dict(bench.SMOKE_CONFIG)
+    client = FakeClient()
+    names = seed_fake_node_group(client, 3)
+    sched = Scheduler(client, SchedulerConfig(
+        http_bind="127.0.0.1:0", besteffort_idle_window_s=2.0,
+    ))
+    sched.register_from_node_annotations()
+    members = _admit_role_gang(
+        client, sched, names, "prefill=2x2x2,decode=1x2x2", 3, 4
+    )
+    assert all(pl is not None for pl, _uid in members)
+    engines = []
+    for pl, _uid in members:
+        if pl.role == colo.ROLE_PREFILL:
+            engines.append((pl, bench.VirtualPrefill(pl.replica_id(),
+                                                     per_tick=8)))
+        else:
+            eng = bench.VirtualDecode(pl.replica_id(), clock, cfg)
+            eng.alive = True
+            engines.append((pl, eng))
+    be = bench.VirtualDecode("be-0", clock, cfg, besteffort=True)
+    router = colo.router_for_gang(
+        engines, fail_threshold=1, ping_interval_s=0.0,
+        migrate_on_drain=True, mover=SessionMover(clock=clock.now),
+        clock=clock.now,
+    )
+    router.replicas["be-0"] = be
+    router._fails["be-0"] = 0
+    router._pending["be-0"] = 0
+    router.check_health()   # be-0 dead → out of the ring
+
+    # best-effort tenant admitted through the real overlay ledger
+    now_ts = __import__("time").time()
+    for node in names:
+        usage = sched.inspect_usage()
+        sched.usage_cache.note_node_utilization(node, {
+            "v": 1, "ts": now_ts - 10.0,
+            "devices": {d.uuid: {"duty": 0.0, "hbm_peak": 0}
+                        for d in usage[node].devices},
+            "pods": {},
+        })
+        sched.usage_cache.note_node_utilization(node, dict(
+            {"v": 1, "ts": now_ts,
+             "devices": {d.uuid: {"duty": 0.0, "hbm_peak": 0}
+                         for d in usage[node].devices},
+             "pods": {}},
+        ))
+    bepod = new_pod(
+        "be-0", uid="uid-be-0",
+        annotations={A.QOS: QosClass.BEST_EFFORT},
+        containers=[{"name": "m", "resources": {"limits": {
+            R.chip: 2, R.memory_percentage: 20, R.cores: 60,
+        }}}],
+    )
+    client.create_pod(bepod)
+    res = sched.filter(bepod, list(names))
+    assert res.node, res.error
+    assert "uid-be-0" in sched.usage_cache.overlay_snapshot()
+    be.alive = True
+    router.check_health()   # restored into the ring
+
+    bridge = colo.EvictBridge(router)
+    bridge.register("uid-be-0", "be-0")
+    sched.add_evict_hook(bridge.hook)
+
+    # sessions pinned onto the best-effort replica (hash-probed ids)
+    nxt = 0
+    for _ in range(3):
+        sid, nxt = _sid_for(router._healthy, "be-0", nxt)
+        router.submit(sid, sid, [1] * 32, 300)
+    for _ in range(3):
+        router.pump()
+    assert be.sessions, "sessions must be running on the BE replica"
+    generated = {rid: len(st["tail"]) for rid, st in be.sessions.items()}
+    assert any(n > 1 for n in generated.values())
+
+    # the arbiter's annotation lands; the reconciler turns it into a
+    # delete — and the bridge migrates the replica's sessions FIRST
+    ev0 = colo.COLO_EVICTIONS_MIGRATED.value()
+    client.patch_pod_annotations(
+        "default", "be-0", {A.EVICT_REQUESTED: "besteffort_contention_1"}
+    )
+    evicted = sched.reconcile_evictions()
+    assert evicted == 1
+    assert bridge.evictions_bridged == 1
+    assert bridge.sessions_migrated == len(generated)
+    assert colo.COLO_EVICTIONS_MIGRATED.value() == ev0 + 1
+    assert not be.sessions          # everything moved off the replica
+    assert "uid-be-0" not in sched.usage_cache.overlay_snapshot()
+    assert be.kill() == {}          # the pod death loses NOTHING
+    # the moved sessions resumed with their full tails on the target
+    gang_decode = next(eng for pl, eng in engines
+                       if pl.role == colo.ROLE_DECODE)
+    for rid, n in generated.items():
+        assert rid in gang_decode.sessions
+        assert len(gang_decode.sessions[rid]["tail"]) >= n
+    assert any(e["type"] == "EvictMigrated" and e["pod"] == "uid-be-0"
+               for e in ev.journal().query(n=10_000))
+    # drive the moved sessions to completion on the target
+    for _ in range(60):
+        router.pump()
+    assert all(rid in gang_decode.completions for rid in generated)
+    # a second observe of the same pod is a one-shot no-op
+    assert bridge.observe_pod({
+        "metadata": {"uid": "uid-be-0", "name": "be-0",
+                     "annotations": {A.EVICT_REQUESTED: "again"}},
+    }) == 0
+
+
+def test_evict_bridge_defer_drains_on_the_serving_thread():
+    """defer=True: the hook only queues; drain() — the serving loop's
+    thread — performs the actual request_evict (the engine-thread
+    serialization contract for real engines)."""
+    clock = bench.VClock()
+    cfg = dict(bench.SMOKE_CONFIG)
+    dec = bench.VirtualDecode("d0", clock, cfg)
+    dec.alive = True
+    tgt = bench.VirtualDecode("d1", clock, cfg)
+    tgt.alive = True
+    router = Router(bench.VirtualPrefill("p0", per_tick=4),
+                    {"d0": dec, "d1": tgt}, ping_interval_s=0.0,
+                    migrate_on_drain=True,
+                    mover=SessionMover(clock=clock.now))
+    sid, _ = _sid_for(["d0", "d1"], "d0")
+    router.submit(sid, sid, [1] * 32, 200)
+    for _ in range(2):
+        router.pump()
+    assert dec.sessions
+    bridge = colo.EvictBridge(router, defer=True)
+    bridge.register("u1", "d0")
+    pod = {"metadata": {"uid": "u1", "name": "x", "annotations": {
+        A.EVICT_REQUESTED: "r"}}}
+    assert bridge.observe_pod(pod) == 0      # queued, not applied
+    assert "d0" not in router._evicted
+    assert dec.sessions                      # nothing moved yet
+    moved = bridge.drain()
+    assert moved == 1 and bridge.evictions_bridged == 1
+    assert "d0" in router._evicted and not dec.sessions
+    assert sid in tgt.sessions
+    assert bridge.drain() == 0               # queue drained
+
+
+def test_evict_bridge_retries_after_transient_router_failure():
+    """A transient request_evict failure must NOT burn the one-shot:
+    the reconciler retries the delete next poll and the bridge must
+    retry the migration with it."""
+    class FlakyRouter:
+        def __init__(self):
+            self.calls = 0
+
+        def request_evict(self, rid, reason=""):
+            self.calls += 1
+            if self.calls == 1:
+                raise RuntimeError("transient")
+            return 2
+
+    router = FlakyRouter()
+    bridge = colo.EvictBridge(router, replica_of=lambda p: "d0")
+    pod = {"metadata": {"uid": "u1", "name": "x", "annotations": {
+        A.EVICT_REQUESTED: "r"}}}
+    assert bridge.observe_pod(pod) == 0      # failed, one-shot released
+    assert bridge.observe_pod(pod) == 2      # retried and bridged
+    assert bridge.evictions_bridged == 1
+    assert bridge.observe_pod(pod) == 0      # now handled for good
+    assert router.calls == 2
+
+
+def test_evict_bridge_ignores_unmapped_and_survives_router_errors():
+    clock = bench.VClock()
+    cfg = dict(bench.SMOKE_CONFIG)
+    dec = bench.VirtualDecode("d0", clock, cfg)
+    dec.alive = True
+    router = Router(bench.VirtualPrefill("p0", per_tick=1), {"d0": dec},
+                    ping_interval_s=0.0)
+    bridge = colo.EvictBridge(router)
+    pod = {"metadata": {"uid": "u1", "name": "x", "annotations": {
+        A.EVICT_REQUESTED: "r"}}}
+    assert bridge.observe_pod(pod) == 0          # unmapped → ignored
+    bridge.register("u1", "nope")
+    assert bridge.observe_pod(pod) == 0          # unknown replica: warn
+    assert bridge.evictions_bridged == 0
+    # callable resolver form
+    bridge2 = colo.EvictBridge(router, replica_of=lambda p: "d0")
+    assert bridge2.observe_pods([pod]) == 0      # no sessions: 0 moved
+    assert bridge2.evictions_bridged == 1
+    assert "d0" in router._evicted
+
+
+# ---------------------------------------------------------------------------
+# witness soak: the composed plane under threads
+# ---------------------------------------------------------------------------
+
+def test_colo_witness_soak(monkeypatch):
+    """Scheduler filters, router pumps, and bridge observations racing
+    on threads with the lock-order witness armed: the acquisition graph
+    over the composed plane (gang stripes, usage cache, router locks,
+    serving.evict_bridge) must stay acyclic."""
+    monkeypatch.setenv(witness.ENV_WITNESS, "1")
+    witness.reset()
+    try:
+        clock = bench.VClock()
+        cfg = dict(bench.SMOKE_CONFIG)
+        client = FakeClient()
+        names = seed_fake_node_group(client, 3)
+        sched = Scheduler(client, SchedulerConfig(
+            http_bind="127.0.0.1:0", besteffort_idle_window_s=0.0,
+        ))
+        sched.register_from_node_annotations()
+        _admit_role_gang(client, sched, names,
+                         "prefill=2x2x2,decode=1x2x2", 3, 4)
+        decs = {}
+        for i in range(3):
+            d = bench.VirtualDecode(f"d{i}", clock, cfg)
+            d.alive = True
+            decs[f"d{i}"] = d
+        router = Router(bench.VirtualPrefill("p0", per_tick=8), decs,
+                        ping_interval_s=0.0, migrate_on_drain=True,
+                        mover=SessionMover(clock=clock.now))
+        bridge = colo.EvictBridge(router)
+        sched.add_evict_hook(bridge.hook)
+        stop = threading.Event()
+        errors = []
+
+        def guard(fn):
+            def run():
+                try:
+                    fn()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+            return run
+
+        @guard
+        def serve_loop():
+            k = 0
+            while not stop.is_set():
+                k += 1
+                try:
+                    router.submit(f"w{k}", f"w{k}", [1] * 24, 6)
+                except Exception:  # noqa: BLE001 — sheds are fine
+                    pass
+                router.pump()
+
+        @guard
+        def filter_loop():
+            k = 0
+            while not stop.is_set():
+                k += 1
+                p = new_pod(
+                    f"solo-{k}", uid=f"uid-solo-{k}",
+                    containers=[{"name": "m", "resources": {"limits": {
+                        R.chip: 1, R.memory_percentage: 5, R.cores: 0,
+                    }}}],
+                )
+                client.create_pod(p)
+                sched.filter(p, list(names))
+                client.delete_pod("default", f"solo-{k}")
+                sched.pods.rm_pod(f"uid-solo-{k}")
+
+        @guard
+        def bridge_loop():
+            while not stop.is_set():
+                bridge.observe_pods(client.list_pods())
+                sched.reconcile_evictions()
+
+        threads = [threading.Thread(target=t, daemon=True)
+                   for t in (serve_loop, filter_loop, bridge_loop)]
+        for t in threads:
+            t.start()
+        import time as _t
+        _t.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(5)
+        assert not errors, errors
+        assert witness.edges(), "witness armed but saw no acquisitions"
+        assert witness.cycles() == [], witness.report()
+    finally:
+        witness.reset()
+
+
+# ---------------------------------------------------------------------------
+# bench smoke: SMOKE=1 rides tier-1 through this module
+# ---------------------------------------------------------------------------
+
+def test_bench_colo_smoke_schema_and_invariants():
+    res = bench.run(smoke=True)
+    assert res["bench"] == "serving_colo" and res["smoke"] is True
+    for arm in ("static_partition", "colo_no_migrate", "colo_full"):
+        rep = res["arms"][arm]
+        for key in ("cluster_goodput_tokens_per_s", "sessions_completed",
+                    "tokens_lost_to_eviction", "besteffort_tokens_served",
+                    "guaranteed_duty_protection", "evictions",
+                    "sessions_migrated", "gang", "mesh_boot",
+                    "audit_summary", "residual_overlay_bookings"):
+            assert key in rep, (arm, key)
+        assert rep["gang"]["bind_success"] == 1.0
+        assert rep["gang"]["partial_gangs"] == 0
+        assert rep["residual_overlay_bookings"] == 0
+        # every role member's mesh derives from its annotation alone
+        for mb in rep["mesh_boot"].values():
+            assert mb["host_split"] == [
+                [int(d) for d in mb["shape"].split("x")]
+            ] * mb["hosts"]
+    assert res["arms"]["colo_full"]["tokens_lost_to_eviction"] == 0
+    assert res["arms"]["static_partition"]["besteffort_tokens_served"] == 0
+    assert res["arms"]["colo_full"]["besteffort_tokens_served"] > 0
+    comp = res["comparison"]
+    for key in ("goodput_ratio_colo_full_vs_static",
+                "guaranteed_duty_degradation_vs_solo",
+                "tokens_lost_no_migrate", "tokens_lost_colo_full",
+                "besteffort_tokens_colo_full"):
+        assert key in comp, key
+
+
+# ---------------------------------------------------------------------------
+# JAX lane: the real mesh boots from the placement doc alone
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_mesh_for_placement_real_mesh():
+    pl = colo.parse_placement(_placement_annos(
+        role="prefill", shape="2x1x1", hosts=2,
+    ))
+    mesh = colo.mesh_for_placement(pl)
+    assert mesh.devices.shape == (2, 2)
+    assert mesh.axis_names == ("dp", "tp")
+    pl2 = colo.parse_placement(_placement_annos(
+        role="decode", shape="2x2x1", hosts=2, index=1,
+    ))
+    mesh2 = colo.mesh_for_placement(pl2)
+    assert mesh2.devices.shape == (2, 2, 2)
+    assert mesh2.axis_names == ("dp", "ici0", "ici1")

@@ -784,3 +784,70 @@ def test_router_request_evict_migrates_real_engines(model_and_params):
     for eng in reps.values():
         assert eng.pool_stats()["leased"] == \
             eng.pool_stats()["prefix_blocks"]
+
+
+def test_migrate_queued_pending_adoption_token_exact(model_and_params):
+    """ROADMAP item 2 leftover, closed: a claimed-but-unslotted adoption
+    (a ``_PendingAdopt`` queue entry whose blocks live in this pool)
+    exports and migrates instead of finishing in place — token-exact vs
+    the never-migrated control, leak-free on every pool."""
+    from vtpu.serving.migrate import SessionMover
+
+    m, params = model_and_params
+    reqs = _mig_reqs(seed=71, n=4)
+    want = run_monolithic(m, params, reqs)
+    A = DecodeEngine(m, params, max_batch=8, eos_id=2, replica_id="A")
+    B = DecodeEngine(m, params, max_batch=8, eos_id=2, replica_id="B")
+    pf = PrefillEngine(m, params, shared_with=A)   # same-pool handles
+    for rid, p, n in reqs:
+        pf.submit(rid, p, num_new=n)
+    for res in pf.run():
+        # deliver WITHOUT admitting: every entry stays queued (the
+        # router's batched-delivery shape) — claimed, no slot yet
+        A.submit_handle(res.rid, res.handle, res.first_token,
+                        res.num_new, admit=False)
+    queued = [pa.rid for pa in A.queue]
+    assert len(queued) == 4
+    # queued shared/wire entries are exportable alongside live slots
+    assert set(A.exportable_sessions()) == set(queued)
+    mover = SessionMover()
+    rep = mover.move(queued[0], A, [("B", B)])
+    assert rep.target == "B"
+    assert all(pa.rid != queued[0] for pa in A.queue)
+    A.admit_pending()                   # the rest admit normally
+    _drain_engine(A)
+    _drain_engine(B)
+    got = dict(A.out)
+    got.update(B.out)
+    assert got == want                  # token-exact, nothing lost
+    assert queued[0] in B.out and queued[0] not in A.out
+    assert _leak_free(A.pool) and _leak_free(B.pool)
+
+
+def test_queued_cross_pool_adoption_finishes_in_place(model_and_params):
+    """A cross-pool (``copy``-mode) pending adoption cannot stream from
+    this engine's pool: the mover sees 'nothing to move' and the entry
+    finishes in place, token-exact."""
+    from vtpu.serving.migrate import SessionGoneError, SessionMover
+
+    m, params = model_and_params
+    reqs = _mig_reqs(seed=73, n=2)
+    want = run_monolithic(m, params, reqs)
+    pf = PrefillEngine(m, params)                  # its OWN pool
+    A = DecodeEngine(m, params, max_batch=8, eos_id=2, replica_id="A")
+    B = DecodeEngine(m, params, max_batch=8, eos_id=2, replica_id="B")
+    for rid, p, n in reqs:
+        pf.submit(rid, p, num_new=n)
+    for res in pf.run():
+        A.submit_handle(res.rid, res.handle, res.first_token,
+                        res.num_new, source=pf, admit=False)
+    rid0 = A.queue[0].rid
+    assert rid0 not in A.exportable_sessions()
+    with pytest.raises(SessionGoneError):
+        SessionMover().move(rid0, A, [("B", B)])
+    assert any(pa.rid == rid0 for pa in A.queue)   # still queued here
+    A.admit_pending()
+    _drain_engine(A)
+    assert dict(A.out) == want
+    assert _leak_free(pf.pool) and _leak_free(A.pool) \
+        and _leak_free(B.pool)

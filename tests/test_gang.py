@@ -24,9 +24,14 @@ from vtpu.scheduler import Scheduler, SchedulerConfig
 from vtpu.scheduler.gang import (
     GANG_MESH,
     GANG_NAME,
+    GANG_PLACEMENT,
+    GANG_ROLES,
     GANG_SIZE,
     GangRegistry,
     GangSpec,
+    RoleSpec,
+    canonical_roles,
+    parse_gang_roles,
     parse_gang_spec,
 )
 from vtpu.scheduler.score import slice_affinity
@@ -403,6 +408,228 @@ def test_malformed_gang_spec_is_a_filter_error():
     pod["metadata"]["annotations"][GANG_SIZE] = "NaN"
     r = s.filter(pod, names)
     assert r.node is None and "bad gang spec" in r.error
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous gangs: vtpu.io/gang-roles (per-role chip rectangles)
+# ---------------------------------------------------------------------------
+
+def role_pod(name, gang, size, roles, chips, uid=None, qos=None,
+             pct=40, cores=60):
+    annos = {GANG_NAME: gang, GANG_SIZE: str(size), GANG_ROLES: roles}
+    if qos:
+        annos[A.QOS] = qos
+    return new_pod(
+        name, uid=uid or f"uid-{name}", annotations=annos,
+        containers=[{"name": "main", "resources": {"limits": {
+            R.chip: chips, R.memory_percentage: pct, R.cores: cores,
+        }}}],
+    )
+
+
+def test_parse_gang_roles_forms_and_errors():
+    roles = parse_gang_roles("prefill=2x2,decode=1x1x2", 3)
+    # name-sorted canonical order; bare trailing mesh dims parse fully
+    assert roles == (
+        RoleSpec("decode", 1, (1, 2, 1)),
+        RoleSpec("prefill", 2, (2, 1, 1)),
+    )
+    assert roles[0].chips == 2 and roles[1].chips == 2
+    # a bare count means single-chip members
+    assert parse_gang_roles("a=3", 3) == (RoleSpec("a", 3, (1, 1, 1)),)
+    assert (canonical_roles("prefill=2x2,decode=1x1x2", 3)
+            == "decode=1x1x2x1,prefill=2x2x1x1")
+    for bad, size in (
+        ("prefill2x2", 3),              # no '='
+        ("prefill=", 3),                # empty dims
+        ("=2x2", 2),                    # empty role name
+        ("prefill=zero", 1),            # non-int count
+        ("prefill=0x2", 0),             # count < 1
+        ("prefill=2x-2", 2),            # bad member mesh
+        ("prefill=1,prefill=1", 2),     # duplicate role
+        ("prefill=2x2,decode=2", 3),    # counts sum 4 != size 3
+        ("", 1),                        # empty map
+    ):
+        with pytest.raises(ValueError):
+            parse_gang_roles(bad, size)
+
+
+def test_parse_gang_spec_roles_integration():
+    spec = parse_gang_spec({
+        GANG_NAME: "t", GANG_SIZE: "3",
+        GANG_ROLES: "prefill=2x2,decode=1x1x2",
+    })
+    assert spec.roles is not None and len(spec.roles) == 2
+    # roles without a gang identity
+    with pytest.raises(ValueError):
+        parse_gang_spec({GANG_ROLES: "prefill=1"})
+    # role counts vs gang size mismatch surfaces through the spec parse
+    with pytest.raises(ValueError):
+        parse_gang_spec({GANG_NAME: "t", GANG_SIZE: "4",
+                         GANG_ROLES: "prefill=2x2,decode=1x1x2"})
+    # a whole-gang mesh pin cannot describe per-role rectangles
+    with pytest.raises(ValueError):
+        parse_gang_spec({GANG_NAME: "t", GANG_SIZE: "3",
+                         GANG_MESH: "4x2",
+                         GANG_ROLES: "prefill=2x2,decode=1x1x2"})
+
+
+def test_webhook_normalizes_gang_roles_and_warns_on_bad_spec():
+    import base64
+    import json
+
+    from vtpu.scheduler.webhook import handle_admission_review
+
+    cfg = SchedulerConfig()
+
+    def review(pod):
+        return handle_admission_review(
+            {"request": {"uid": "w1", "object": pod}}, cfg
+        )["response"]
+
+    pod = role_pod("w", "serve", 3, "prefill=2x2,decode=1x1x2", chips=2)
+    resp = review(pod)
+    ops = json.loads(base64.b64decode(resp["patch"]))
+    role_ops = [o for o in ops if o["path"].endswith("gang-roles")]
+    assert role_ops == [{
+        "op": "replace",
+        "path": "/metadata/annotations/vtpu.io~1gang-roles",
+        "value": "decode=1x1x2x1,prefill=2x2x1x1",
+    }]
+    # counts vs size mismatch: admitted with a warning, never blocked
+    pod = role_pod("w2", "serve", 4, "prefill=2x2,decode=1x1x2", chips=2)
+    resp = review(pod)
+    assert resp["allowed"] is True
+    assert any("gang spec invalid" in w for w in resp["warnings"])
+
+
+def test_role_gang_admits_all_or_nothing_with_placement_docs():
+    import json
+
+    from vtpu.serving import colo
+
+    c, s, names = group_scheduler(4)
+    roles = "prefill=2x2,decode=1x1x2"
+    pods = [role_pod(f"rg-m{i}", "serve", 3, roles, chips=2)
+            for i in range(3)]
+    for p in pods:
+        c.create_pod(p)
+    results = [s.filter(p, names) for p in pods]
+    assert all(r.error == "" for r in results[-1:]), results[-1].error
+    snap = s.usage_cache.bookings_snapshot()
+    assert len(snap) == 3  # all-or-nothing: every member booked
+    placements = {}
+    for p in pods:
+        live = next(q for q in c.list_pods()
+                    if q["metadata"]["uid"] == p["metadata"]["uid"])
+        annos = live["metadata"].get("annotations", {})
+        assert GANG_PLACEMENT in annos, "role member must carry the doc"
+        pl = colo.parse_placement(annos)
+        placements[p["metadata"]["uid"]] = pl
+        # the doc alone determines the member's mesh: host-split form
+        assert colo.host_split(pl) == [pl.shape] * pl.hosts
+        # the booked chip count matches the role's rectangle volume
+        node, devs = snap[p["metadata"]["uid"]]
+        assert len([cd for ctr in devs for cd in ctr]) == pl.chips == 2
+        assert pl.node == node
+        doc = json.loads(annos[GANG_PLACEMENT])
+        assert doc["gang"] == "default/serve"
+    by_role = {}
+    for pl in placements.values():
+        by_role.setdefault(pl.role, []).append(pl)
+    assert len(by_role["prefill"]) == 2 and len(by_role["decode"]) == 1
+    assert {pl.index for pl in by_role["prefill"]} == {0, 1}
+    assert all(pl.hosts == 2 for pl in by_role["prefill"])
+    # role recorded in the decision audit log
+    recs = s.decisions.query(gang="default/serve", n=10)
+    bound = [r for r in recs if r["gang"]["status"] == "bound"]
+    assert bound
+    g = bound[-1]["gang"]
+    assert set(g["member_roles"].values()) == {"prefill", "decode"}
+    assert set(g["slice"]["roles"]) == {"prefill", "decode"}
+    assert s.auditor.audit_once()["summary"]["partial_gang_bookings"] == 0
+
+
+def test_role_gang_colocates_roles_on_one_node_disjoint_chips():
+    # 2 nodes x 4 chips; prefill=2x2 + decode=2x2 = 8 chips: each node
+    # must host one prefill AND one decode member — the same-node
+    # multi-member reserve (generation chaining) must not thrash
+    c, s, names = group_scheduler(2)
+    roles = "prefill=2x2,decode=2x2"
+    pods = [role_pod(f"co-m{i}", "co", 4, roles, chips=2, pct=25,
+                     cores=25) for i in range(4)]
+    for p in pods:
+        c.create_pod(p)
+    for p in pods:
+        s.filter(p, names)
+    snap = s.usage_cache.bookings_snapshot()
+    assert len(snap) == 4
+    per_node = {}
+    for uid, (node, devs) in snap.items():
+        per_node.setdefault(node, []).extend(
+            cd.uuid for ctr in devs for cd in ctr
+        )
+    assert set(per_node) == set(names)
+    for node, uuids in per_node.items():
+        assert len(uuids) == 4 and len(set(uuids)) == 4, (node, uuids)
+    assert s.auditor.audit_once()["summary"]["partial_gang_bookings"] == 0
+
+
+def test_role_gang_member_chip_counts_must_match_roles():
+    c, s, names = group_scheduler(4)
+    roles = "prefill=2x2,decode=1x1x2"
+    # every member asks 4 chips, but the roles declare 2-chip members
+    pods = [role_pod(f"mm-m{i}", "mm", 3, roles, chips=4)
+            for i in range(3)]
+    for p in pods:
+        c.create_pod(p)
+    results = [s.filter(p, names) for p in pods]
+    assert results[-1].node is None
+    assert "role" in results[-1].error or "chip" in results[-1].error
+    assert not s.usage_cache.bookings_snapshot()
+
+
+def test_role_gang_heterogeneous_per_chip_resources_rejected():
+    # the candidate free sets are snapshotted against ONE member's
+    # per-chip request: a role demanding more mem per chip could be
+    # planned onto chips that don't fit it — rejected up front
+    c, s, names = group_scheduler(4)
+    roles = "prefill=2x2,decode=1x1x2"
+    pods = [role_pod(f"pc-m{i}", "pc", 3, roles, chips=2,
+                     pct=40 if i < 2 else 90) for i in range(3)]
+    for p in pods:
+        c.create_pod(p)
+    results = [s.filter(p, names) for p in pods]
+    assert results[-1].node is None
+    assert "identical per-chip resources" in results[-1].error
+    assert not s.usage_cache.bookings_snapshot()
+
+
+def test_role_gang_besteffort_decode_member_rejected():
+    # gang x best-effort stays contradictory for ROLE members too: the
+    # decode-role member books guaranteed quota via the all-or-nothing
+    # reserve; opportunistic decode capacity rides separate BE pods
+    c, s, names = group_scheduler(4)
+    pod = c.create_pod(role_pod(
+        "be-m0", "bes", 3, "prefill=2x2,decode=1x1x2", chips=2,
+        qos="best-effort",
+    ))
+    r = s.filter(pod, names)
+    assert r.node is None and "best-effort" in r.error
+    assert not s.usage_cache.bookings_snapshot()
+
+
+def test_role_gang_no_fit_books_nothing():
+    c, s, names = group_scheduler(2)  # 8 chips total
+    roles = "prefill=2x2x2,decode=2x2x2"  # needs 16 chips
+    pods = [role_pod(f"nf-m{i}", "nf", 4, roles, chips=4, pct=25,
+                     cores=25) for i in range(4)]
+    for p in pods:
+        c.create_pod(p)
+    results = [s.filter(p, names) for p in pods]
+    assert results[-1].node is None
+    assert "no per-role sub-rectangles" in results[-1].error
+    assert not s.usage_cache.bookings_snapshot()
 
 
 # ---------------------------------------------------------------------------

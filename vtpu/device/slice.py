@@ -214,6 +214,7 @@ def plan_slice(
     chips_per_member: int,
     desired_mesh: Optional[Tuple[int, int, int]] = None,
     affinity=None,
+    member_shape: Optional[Tuple[int, int, int]] = None,
 ) -> Optional[SlicePlan]:
     """Choose ``gang_size`` member hosts and one per-host sub-rectangle
     shape forming the best ICI-contiguous global slice, or None.
@@ -226,6 +227,9 @@ def plan_slice(
     sorted multiset, so "4x2" accepts a 2×4 placement).  ``affinity`` is
     an optional ``(view, coords) -> float`` scored per member carve
     (higher = better; vtpu/scheduler/score.py:slice_affinity).
+    ``member_shape`` pins the PER-HOST sub-rectangle instead (same
+    sorted-multiset compare) — the heterogeneous-gang role planner uses
+    it so every member of a role carves exactly its declared rectangle.
     """
     if gang_size <= 0 or chips_per_member <= 0 or len(views) < gang_size:
         return None
@@ -235,7 +239,8 @@ def plan_slice(
         for t in topologies:
             group = [v for v in views if v.topology == t]
             plan = plan_slice(
-                group, gang_size, chips_per_member, desired_mesh, affinity
+                group, gang_size, chips_per_member, desired_mesh, affinity,
+                member_shape,
             )
             if plan is None:
                 continue
@@ -251,6 +256,9 @@ def plan_slice(
     avail_hosts = frozenset(by_coord)
     want_dims = (
         tuple(sorted(desired_mesh)) if desired_mesh is not None else None
+    )
+    want_member = (
+        tuple(sorted(member_shape)) if member_shape is not None else None
     )
     best: Optional[Tuple[tuple, SlicePlan]] = None
     for host_off, host_shape3, host_coords in enumerate_rectangles(
@@ -268,6 +276,9 @@ def plan_slice(
                 continue
             gshape = stitched_shape(host_shape, chip_shape)
             if want_dims is not None and tuple(sorted(gshape)) != want_dims:
+                continue
+            if (want_member is not None
+                    and tuple(sorted(chip_shape)) != want_member):
                 continue
             if gang_size == 1:
                 # single host: no seams, the rectangle may sit anywhere

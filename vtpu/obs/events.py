@@ -83,6 +83,8 @@ class EventType:
     # live session migration (vtpu/serving/migrate.py)
     SESSION_MIGRATED = "SessionMigrated"  # a pinned session moved replicas token-exactly
     SESSION_MIGRATION_FAILED = "SessionMigrationFailed"  # a move failed typed (restored on the source, or ambiguous)
+    # co-location bridge (vtpu/serving/colo.py)
+    EVICT_MIGRATED = "EvictMigrated"  # an evict-requested annotation became Router.request_evict; the replica's sessions migrated
 
 
 EVENT_TYPES = frozenset(

@@ -186,6 +186,12 @@ class Scheduler:
         # annotation we already warned about (reconcile_evictions runs
         # every registry poll; one warning per pod, not per poll)
         self._evict_ignored_warned: set = set()
+        # reconciler→serving bridge: callables invoked with the pod dict
+        # BEFORE an evict-requested pod is deleted, so a serving plane
+        # co-located with this scheduler (vtpu/serving/colo.py
+        # EvictBridge) can migrate the replica's pinned sessions out
+        # instead of letting the delete strand them
+        self._evict_hooks: List = []
         self._stop = threading.Event()
         # the pre-CAS escape hatch (config.optimistic_booking=False):
         # serialises every select→book under one global lock.  The default
@@ -723,7 +729,8 @@ class Scheduler:
             return res
 
     def _patch_assignment(
-        self, pod: dict, uid: str, node: str, enc: str, sp=None
+        self, pod: dict, uid: str, node: str, enc: str, sp=None,
+        extra: Optional[dict] = None,
     ) -> Optional[str]:
         """Write the assignment annotations for a booking this process just
         made.  Returns None on success (the booking stands) or an error
@@ -755,6 +762,11 @@ class Scheduler:
                 # deletes)
                 annotations.BIND_PHASE: None,
             }
+            if extra:
+                # caller-supplied companion annotations riding the same
+                # round trip (the gang coordinator's per-member
+                # vtpu.io/gang-placement doc)
+                patch.update(extra)
             ctx = trace.context_of(sp) if sp is not None else None
             if ctx is not None:
                 # propagate the trace so the plugin's Allocate continues
@@ -1376,6 +1388,15 @@ class Scheduler:
             verdicts,
         )
 
+    def add_evict_hook(self, fn) -> None:
+        """Register a callable invoked with each evict-requested pod
+        dict right before :meth:`reconcile_evictions` deletes it — the
+        reconciler→router bridge (vtpu/serving/colo.py) turns the
+        annotation into ``Router.request_evict`` here, so the evicted
+        decode replica's pinned sessions migrate instead of dying with
+        the pod."""
+        self._evict_hooks.append(fn)
+
     def reconcile_evictions(self, pods: Optional[list] = None) -> int:
         """Turn the monitor arbiter's ``vtpu.io/evict-requested``
         annotations into pod deletes (the API sim / real API server both
@@ -1416,6 +1437,18 @@ class Scheduler:
             ns = pod["metadata"].get("namespace", "default")
             name = pod["metadata"]["name"]
             uid = pod_uid(pod)
+            for hook in self._evict_hooks:
+                # the bridge migrates the evicted replica's sessions
+                # BEFORE the delete lands; a hook failure must never
+                # block the preemption itself (finish-in-place is the
+                # documented fallback)
+                try:
+                    hook(pod)
+                except Exception:  # noqa: BLE001 — eviction proceeds
+                    log.exception(
+                        "evict hook failed for pod %s; deleting anyway",
+                        name,
+                    )
             try:
                 self.client.delete_pod(ns, name)
             except Exception:  # noqa: BLE001 — pod may already be gone

@@ -76,6 +76,8 @@ log = logging.getLogger(__name__)
 GANG_NAME = annotations.GANG_NAME
 GANG_SIZE = annotations.GANG_SIZE
 GANG_MESH = annotations.GANG_MESH
+GANG_ROLES = annotations.GANG_ROLES
+GANG_PLACEMENT = annotations.GANG_PLACEMENT
 
 ENV_TTL = "VTPU_GANG_TTL_S"
 DEFAULT_TTL_S = 30.0
@@ -107,10 +109,88 @@ _MEMBER_RESERVES = _REG.counter(
 
 
 @dataclasses.dataclass(frozen=True)
+class RoleSpec:
+    """One role of a heterogeneous serving gang: ``count`` members, each
+    carving a ``shape`` chip rectangle on its host."""
+
+    name: str
+    count: int
+    shape: Tuple[int, int, int]   # per-member chip rectangle
+
+    @property
+    def chips(self) -> int:
+        return self.shape[0] * self.shape[1] * self.shape[2]
+
+    def spec_str(self) -> str:
+        return (f"{self.name}={self.count}x"
+                + "x".join(str(d) for d in self.shape))
+
+
+@dataclasses.dataclass(frozen=True)
 class GangSpec:
     name: str
     size: int
     mesh: Optional[Tuple[int, int, int]]  # desired stitched global shape
+    roles: Optional[Tuple[RoleSpec, ...]] = None  # heterogeneous gangs
+
+
+def parse_gang_roles(raw: str, size: int) -> Tuple[RoleSpec, ...]:
+    """Parse a ``vtpu.io/gang-roles`` value: comma-separated
+    ``<role>=<count>x<member mesh>`` entries (``prefill=2x2,decode=1x1x2``
+    = 2 prefill members of 2 chips each + 1 decode member on a 1x2
+    rectangle; a bare count — ``decode=2`` — means single-chip members).
+    Role counts must sum to the gang size.  Returns the roles sorted by
+    name (the canonical, string-stable order); raises ValueError on any
+    malformed entry."""
+    roles: List[RoleSpec] = []
+    seen = set()
+    for entry in raw.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, eq, dims = entry.partition("=")
+        name = name.strip()
+        if not eq or not name or "=" in dims:
+            raise ValueError(f"bad {GANG_ROLES} entry {entry!r}; "
+                             f"want '<role>=<count>x<member mesh>'")
+        if name in seen:
+            raise ValueError(f"duplicate role {name!r} in {GANG_ROLES}")
+        seen.add(name)
+        parts = [p.strip() for p in dims.strip().split("x")]
+        try:
+            count = int(parts[0])
+        except (ValueError, IndexError):
+            raise ValueError(
+                f"role {name}: bad member count in {dims.strip()!r}"
+            )
+        if count < 1:
+            raise ValueError(f"role {name}: member count must be >= 1")
+        if len(parts) > 1:
+            try:
+                shape = parse_topology("x".join(parts[1:]))
+            except ValueError:
+                raise ValueError(
+                    f"role {name}: bad member mesh {dims.strip()!r}"
+                )
+        else:
+            shape = (1, 1, 1)
+        roles.append(RoleSpec(name=name, count=count, shape=shape))
+    if not roles:
+        raise ValueError(f"{GANG_ROLES} is empty")
+    total = sum(r.count for r in roles)
+    if total != size:
+        raise ValueError(
+            f"{GANG_ROLES} member counts sum to {total}, "
+            f"but {GANG_SIZE} is {size}"
+        )
+    return tuple(sorted(roles, key=lambda r: r.name))
+
+
+def canonical_roles(raw: str, size: int) -> str:
+    """Canonical string form of a gang-roles annotation (name-sorted,
+    full ``count x AxBxC`` entries) — the webhook normalizes so the
+    registry's spec compare is string-stable."""
+    return ",".join(r.spec_str() for r in parse_gang_roles(raw, size))
 
 
 def parse_gang_spec(pod_annos: Dict[str, str]) -> Optional[GangSpec]:
@@ -119,7 +199,10 @@ def parse_gang_spec(pod_annos: Dict[str, str]) -> Optional[GangSpec]:
     name = (pod_annos.get(GANG_NAME) or "").strip()
     size_raw = (pod_annos.get(GANG_SIZE) or "").strip()
     mesh_raw = (pod_annos.get(GANG_MESH) or "").strip()
+    roles_raw = (pod_annos.get(GANG_ROLES) or "").strip()
     if not name and not size_raw:
+        if roles_raw:
+            raise ValueError(f"{GANG_ROLES} without {GANG_NAME}")
         return None
     if not name:
         raise ValueError(f"{GANG_SIZE} without {GANG_NAME}")
@@ -137,7 +220,20 @@ def parse_gang_spec(pod_annos: Dict[str, str]) -> Optional[GangSpec]:
             mesh = parse_topology(mesh_raw)
         except ValueError:
             raise ValueError(f"gang {name}: bad {GANG_MESH} {mesh_raw!r}")
-    return GangSpec(name=name, size=size, mesh=mesh)
+    roles = None
+    if roles_raw:
+        if mesh is not None:
+            # a role gang has one stitched rectangle PER ROLE — a single
+            # whole-gang mesh pin cannot describe it
+            raise ValueError(
+                f"gang {name}: {GANG_MESH} and {GANG_ROLES} are mutually "
+                f"exclusive (each role pins its own member rectangle)"
+            )
+        try:
+            roles = parse_gang_roles(roles_raw, size)
+        except ValueError as e:
+            raise ValueError(f"gang {name}: {e}")
+    return GangSpec(name=name, size=size, mesh=mesh, roles=roles)
 
 
 def gang_key(pod: dict, spec: GangSpec) -> str:
@@ -195,11 +291,13 @@ class GangRegistry:
                 g = self._gangs[spec.name] = _Gang(spec, now)
             elif g.state == _Gang.GATHERING and (
                 g.spec.size != spec.size or g.spec.mesh != spec.mesh
+                or g.spec.roles != spec.roles
             ):
                 return None, (
                     f"gang {spec.name}: conflicting spec "
-                    f"(registered size={g.spec.size} mesh={g.spec.mesh}, "
-                    f"pod says size={spec.size} mesh={spec.mesh})"
+                    f"(registered size={g.spec.size} mesh={g.spec.mesh} "
+                    f"roles={g.spec.roles}, pod says size={spec.size} "
+                    f"mesh={spec.mesh} roles={spec.roles})"
                 )
             g.touched_t = now
             if g.state == _Gang.GATHERING:
@@ -271,9 +369,11 @@ class GangRegistry:
 
 
 class _MemberReservation:
-    __slots__ = ("uid", "pod", "node", "devices", "enc", "remote", "patched")
+    __slots__ = ("uid", "pod", "node", "devices", "enc", "remote", "patched",
+                 "role", "role_index", "shape")
 
-    def __init__(self, uid, pod, node, devices, enc, remote) -> None:
+    def __init__(self, uid, pod, node, devices, enc, remote,
+                 role=None, role_index=0, shape=None) -> None:
         self.uid = uid
         self.pod = pod
         self.node = node
@@ -281,6 +381,23 @@ class _MemberReservation:
         self.enc = enc
         self.remote = remote
         self.patched = False
+        self.role: Optional[RoleSpec] = role
+        self.role_index = role_index
+        self.shape = shape            # per-host sub-rectangle (role gangs)
+
+    def placement_doc(self, gang_name: str) -> dict:
+        """The ``vtpu.io/gang-placement`` value: everything a bound
+        member needs to boot its role's mesh — mesh_from_rectangle's
+        host-split form is ``[shape] * hosts`` — with no out-of-band
+        topology config (vtpu/serving/colo.py consumes it)."""
+        return {
+            "gang": gang_name,
+            "role": self.role.name if self.role is not None else "",
+            "shape": "x".join(str(d) for d in (self.shape or ())),
+            "hosts": self.role.count if self.role is not None else 1,
+            "index": self.role_index,
+            "node": self.node,
+        }
 
 
 class GangCoordinator:
@@ -382,7 +499,8 @@ class GangCoordinator:
     # -- admission ------------------------------------------------------
     def _member_requests(self, g: _Gang):
         """Per-member parsed chip requests; error string when the gang is
-        not admissible (multi-request members, heterogeneous sizes)."""
+        not admissible (multi-request members, heterogeneous sizes in a
+        role-less gang — role gangs validate counts in _assign_roles)."""
         cfg = self.sched.config
         out: Dict[str, object] = {}
         for muid, mpod in sorted(g.members.items()):
@@ -395,12 +513,61 @@ class GangCoordinator:
                 )
             out[muid] = flat[0]
         sizes = {r.nums for r in out.values()}
-        if len(sizes) != 1:
+        if g.spec.roles is None and len(sizes) != 1:
             return None, (
                 f"gang {g.spec.name}: heterogeneous member chip counts "
                 f"{sorted(sizes)}"
             )
+        if g.spec.roles is not None:
+            # roles differ in RECTANGLE, never in per-chip resources:
+            # the candidate free sets are snapshotted once against one
+            # member's per-chip request (fits_device(req0)), so a role
+            # demanding more mem/cores per chip could be planned onto
+            # chips that don't fit it and booked without a fit re-check
+            per_chip = {
+                (r.type, r.memreq, r.mem_percentage, r.coresreq)
+                for r in out.values()
+            }
+            if len(per_chip) != 1:
+                return None, (
+                    f"gang {g.spec.name}: role-gang members must request "
+                    f"identical per-chip resources (type/mem/cores); got "
+                    f"{len(per_chip)} distinct shapes"
+                )
         return out, None
+
+    @staticmethod
+    def _assign_roles(spec: GangSpec, member_reqs):
+        """Deterministic member → role pairing for a heterogeneous gang:
+        members are matched to roles BY CHIP COUNT (a role of ``AxB``
+        members takes members requesting exactly A·B chips), roles in
+        name order, member uids sorted within each chip-count group.
+        The bound member learns which role it got from the placement
+        annotation — pods of equal chip count are interchangeable at
+        admission time.  Returns (uid → RoleSpec, None) or (None,
+        error) when the request multiset does not match the role map."""
+        by_chips: Dict[int, List[str]] = {}
+        for muid in sorted(member_reqs):
+            by_chips.setdefault(member_reqs[muid].nums, []).append(muid)
+        assignment: Dict[str, RoleSpec] = {}
+        for role in spec.roles:
+            group = by_chips.get(role.chips, [])
+            if len(group) < role.count:
+                return None, (
+                    f"gang {spec.name}: role {role.name} needs "
+                    f"{role.count} member(s) requesting {role.chips} "
+                    f"chip(s), got {len(group)}"
+                )
+            for muid in group[:role.count]:
+                assignment[muid] = role
+            del group[:role.count]
+        stranded = [u for grp in by_chips.values() for u in grp]
+        if stranded:
+            return None, (
+                f"gang {spec.name}: member(s) {sorted(stranded)} request "
+                f"chip counts no role declares"
+            )
+        return assignment, None
 
     def _snapshot_views(
         self, node_names: List[str], req, pod_annos, node_objs
@@ -547,10 +714,26 @@ class GangCoordinator:
                 {"name": spec.name, "status": "waiting_ingest",
                  "members": dict(external)},
             )
+        assignment = None
+        if spec.roles is not None:
+            assignment, err = self._assign_roles(spec, member_reqs)
+            if err is not None:
+                self.registry.drop(spec.name)
+                _ADMISSIONS.inc(result="rejected")
+                emit(EventType.GANG_ABORTED, "scheduler", gang=spec.name,
+                     reason="bad_member_requests", detail=err)
+                return (
+                    FilterResult(None, {}, err), {},
+                    {"name": spec.name, "status": "rejected", "error": err},
+                )
         req0 = member_reqs[member_uids[0]]
         # any member's annotations work for the type selectors — gang
         # members are homogeneous by construction (same chart template)
         annos0 = get_annotations(g.members[member_uids[0]])
+        affinity = lambda v, coords: score_mod.slice_affinity(  # noqa: E731
+            v.topology, v.free, coords,
+            compact_shape=score_mod.bounding_shape(coords),
+        )
         verdicts: Dict[str, dict] = {}
         attempts = 0
         for attempt in range(max(0, self.retries) + 1):
@@ -558,34 +741,71 @@ class GangCoordinator:
             views, dev_maps = self._snapshot_views(
                 node_names, req0, annos0, node_objs
             )
-            plan = plan_slice(
-                views, spec.size, req0.nums, spec.mesh,
-                affinity=lambda v, coords: score_mod.slice_affinity(
-                    v.topology, v.free, coords,
-                    compact_shape=score_mod.bounding_shape(coords),
-                ),
-            )
-            if plan is None:
-                _ADMISSIONS.inc(result="no_fit")
-                err = (
-                    f"gang {spec.name}: no ICI-contiguous cross-host slice "
-                    f"for {spec.size} x {req0.nums} chips"
-                    + (f" (mesh {'x'.join(map(str, spec.mesh))})"
-                       if spec.mesh else "")
+            if spec.roles is None:
+                plan = plan_slice(
+                    views, spec.size, req0.nums, spec.mesh,
+                    affinity=affinity,
                 )
-                return (
-                    FilterResult(None, {}, err),
-                    verdicts,
-                    {"name": spec.name, "status": "no_fit",
-                     "candidates": len(views), "attempts": attempts},
+                if plan is None:
+                    _ADMISSIONS.inc(result="no_fit")
+                    err = (
+                        f"gang {spec.name}: no ICI-contiguous cross-host "
+                        f"slice for {spec.size} x {req0.nums} chips"
+                        + (f" (mesh {'x'.join(map(str, spec.mesh))})"
+                           if spec.mesh else "")
+                    )
+                    return (
+                        FilterResult(None, {}, err),
+                        verdicts,
+                        {"name": spec.name, "status": "no_fit",
+                         "candidates": len(views), "attempts": attempts},
+                    )
+                pairs = [
+                    (muid, placement, None, 0)
+                    for muid, placement in zip(member_uids, plan.members)
+                ]
+                slice_desc = plan.describe()
+                shape_str = "x".join(map(str, plan.global_shape))
+            else:
+                role_plans = self._plan_roles(views, spec, affinity)
+                if role_plans is None:
+                    _ADMISSIONS.inc(result="no_fit")
+                    err = (
+                        f"gang {spec.name}: no per-role sub-rectangles "
+                        f"fit all of "
+                        + ",".join(r.spec_str() for r in spec.roles)
+                    )
+                    return (
+                        FilterResult(None, {}, err),
+                        verdicts,
+                        {"name": spec.name, "status": "no_fit",
+                         "candidates": len(views), "attempts": attempts},
+                    )
+                pairs = []
+                for role, plan in role_plans:
+                    uids = sorted(
+                        u for u, r in assignment.items()
+                        if r.name == role.name
+                    )
+                    for i, (muid, placement) in enumerate(
+                        zip(uids, plan.members)
+                    ):
+                        pairs.append((muid, placement, role, i))
+                slice_desc = {"roles": {
+                    role.name: plan.describe()
+                    for role, plan in role_plans
+                }}
+                shape_str = ",".join(
+                    f"{role.name}:" + "x".join(map(str, plan.global_shape))
+                    for role, plan in role_plans
                 )
             status, reservations = self._reserve_all(
-                g, member_uids, member_reqs, plan, dev_maps, verdicts
+                g, pairs, member_reqs, dev_maps, verdicts
             )
             if status == "ok":
                 emit(EventType.GANG_RESERVED, "scheduler", gang=spec.name,
                      nodes=",".join(r.node for r in reservations),
-                     shape="x".join(map(str, plan.global_shape)))
+                     shape=shape_str)
                 perr, failed_uid = self._commit_all(g, reservations)
                 if perr is not None:
                     self._rollback(reservations)
@@ -615,14 +835,21 @@ class GangCoordinator:
                     "gang %s bound: %d members on %s (global %s)",
                     spec.name, len(reservations),
                     ",".join(r.node for r in reservations),
-                    "x".join(map(str, plan.global_shape)),
+                    shape_str,
                 )
                 gang_rec = {
                     "name": spec.name, "status": "bound",
                     "attempts": attempts,
-                    "slice": plan.describe(),
+                    "slice": slice_desc,
                     "members": {r.uid: r.node for r in reservations},
                 }
+                if spec.roles is not None:
+                    # role recorded per member — GET /decisions?gang=
+                    # shows which member became prefill vs decode
+                    gang_rec["member_roles"] = {
+                        r.uid: r.role.name for r in reservations
+                        if r.role is not None
+                    }
                 return (
                     FilterResult(
                         node=g.reserved[trigger_uid], failed={}, error=""
@@ -645,18 +872,72 @@ class GangCoordinator:
              "attempts": attempts},
         )
 
+    # -- role planning ---------------------------------------------------
+    @staticmethod
+    def _plan_roles(views, spec: GangSpec, affinity):
+        """Per-role sub-rectangles within ONE all-or-nothing admission:
+        each role plans its own stitched slice (its member count × its
+        declared per-host rectangle) and the next role plans against
+        the REMAINING free chips, so two roles may co-locate on one
+        host without overlapping.  Roles with more chips plan first
+        (the hardest rectangle gets first pick); any role failing to
+        fit fails the whole gang.  Returns [(role, SlicePlan)] in
+        planning order, or None."""
+        order = sorted(spec.roles, key=lambda r: (-r.chips, r.name))
+        cur_views = list(views)
+        out = []
+        for role in order:
+            plan = plan_slice(
+                cur_views, role.count, role.chips, None, affinity,
+                member_shape=role.shape,
+            )
+            if plan is None:
+                return None
+            out.append((role, plan))
+            used = {m.node: set(m.coords) for m in plan.members}
+            cur_views = [
+                dataclasses.replace(
+                    v, free=frozenset(set(v.free) - used[v.node])
+                ) if v.node in used else v
+                for v in cur_views
+            ]
+        return out
+
+    @staticmethod
+    def _record_verdict(verdicts: Dict[str, dict], node: str, muid: str,
+                        doc: dict) -> None:
+        """One verdict per MEMBER: co-located role members share a
+        node, and a plain node key would drop all but the last
+        member's reserve outcome from the decision audit log.  The
+        first member on a node keeps the bare node key (the shape
+        homogeneous-gang consumers know); same-node siblings land
+        under ``"<node>#<uid>"`` with the node recorded inside."""
+        if node in verdicts and verdicts[node].get("gang_member") != muid:
+            verdicts[f"{node}#{muid}"] = dict(doc, node=node)
+        else:
+            verdicts[node] = doc
+
     # -- phase 1: all-member CAS reserve --------------------------------
-    def _reserve_all(
-        self, g: _Gang, member_uids, member_reqs, plan: SlicePlan,
-        dev_maps, verdicts,
-    ):
+    def _reserve_all(self, g: _Gang, pairs, member_reqs, dev_maps,
+                     verdicts):
         """CAS-book every member node; on any conflict roll back every
-        prior reservation and return ("conflict", []).  Deterministic
-        member → placement pairing: sorted uids onto the plan's members
-        (already host-coord sorted)."""
+        prior reservation and return ("conflict", []).  ``pairs`` is the
+        deterministic member → placement pairing: (uid, MemberPlacement,
+        role | None, index-within-role).  Role gangs may place several
+        members on ONE node (co-located roles): each successful local
+        book bumps that node's generation, so later same-node members
+        CAS against a refreshed generation — the plans' coords are
+        disjoint by construction, and any FOREIGN mutation between the
+        refresh and the book still conflicts and re-plans."""
         sched = self.sched
         reservations: List[_MemberReservation] = []
-        for muid, placement in zip(member_uids, plan.members):
+        node_multiplicity: Dict[str, int] = {}
+        for _muid, placement, _role, _ri in pairs:
+            node_multiplicity[placement.node] = (
+                node_multiplicity.get(placement.node, 0) + 1
+            )
+        gen_overrides: Dict[str, int] = {}
+        for muid, placement, role, role_index in pairs:
             req = member_reqs[muid]
             mpod = g.members[muid]
             devices = self._placement_devices(
@@ -681,10 +962,10 @@ class GangCoordinator:
                 _MEMBER_RESERVES.inc(
                     result="remote_ok" if ok else "remote_fail"
                 )
-                verdicts[placement.node] = {
+                self._record_verdict(verdicts, placement.node, muid, {
                     "fit": ok, "gang_member": muid,
                     "reserve": "remote_ok" if ok else "remote_fail",
-                }
+                })
                 if not ok:
                     # the commit may have LANDED owner-side even though we
                     # saw an error (socket cut after the owner booked +
@@ -698,24 +979,39 @@ class GangCoordinator:
                 res = _MemberReservation(
                     muid, mpod, placement.node, devices,
                     rep.get("enc", enc), remote=True,
+                    role=role, role_index=role_index,
+                    shape=placement.shape,
                 )
                 res.patched = True  # shard_commit patches owner-side
                 reservations.append(res)
                 continue
-            if not sched.usage_cache.try_book(
-                muid, placement.node, placement.generation, devices
-            ):
+            expected_gen = gen_overrides.get(
+                placement.node, placement.generation
+            )
+            new_gen = sched.usage_cache.try_book_chained(
+                muid, placement.node, expected_gen, devices
+            )
+            if new_gen is None:
                 _MEMBER_RESERVES.inc(result="conflict")
-                verdicts[placement.node] = {
-                    "fit": False, "gang_member": muid, "reserve": "conflict",
-                }
+                self._record_verdict(verdicts, placement.node, muid, {
+                    "fit": False, "gang_member": muid,
+                    "reserve": "conflict",
+                })
                 self._rollback(reservations)
                 return "conflict", []
+            if node_multiplicity[placement.node] > 1:
+                # a later member books this node too: its CAS must see
+                # exactly the generation OUR book produced — captured
+                # atomically with the book (a separate peek would
+                # absorb a foreign mutation that landed in between and
+                # defeat the CAS for the next member)
+                gen_overrides[placement.node] = new_gen
             _MEMBER_RESERVES.inc(result="ok")
-            verdicts[placement.node] = {
+            self._record_verdict(verdicts, placement.node, muid, {
                 "fit": True, "gang_member": muid, "reserve": "ok",
                 "shape": "x".join(map(str, placement.shape)),
-            }
+                **({"role": role.name} if role is not None else {}),
+            })
             # register with the pod manager exactly like _commit_booking:
             # pending=True until the phase-2 patch lands; the annotations
             # copy makes the eventual ingest replay a recognised no-op
@@ -729,6 +1025,7 @@ class GangCoordinator:
             sched.pods.add_pod(fresh, placement.node, devices, pending=True)
             reservations.append(_MemberReservation(
                 muid, mpod, placement.node, devices, enc, remote=False,
+                role=role, role_index=role_index, shape=placement.shape,
             ))
         return "ok", reservations
 
@@ -737,13 +1034,36 @@ class GangCoordinator:
         self, g: _Gang, reservations
     ) -> Tuple[Optional[str], Optional[str]]:
         """Patch every local member's assignment annotations (remote
-        members were patched owner-side by shard_commit).  Returns
+        members were patched owner-side by shard_commit).  Role-gang
+        members additionally get the ``vtpu.io/gang-placement`` doc —
+        folded into the local assignment patch (one API round trip), a
+        separate annotation patch for remote members (the owner patched
+        the assignment; placement is coordinator metadata).  Returns
         (error, failing member uid) on the first failure — the caller
         rolls back and prunes the failing member."""
+        import json as _json
+
         for r in reservations:
+            extra = None
+            if r.role is not None:
+                extra = {GANG_PLACEMENT: _json.dumps(
+                    r.placement_doc(g.spec.name), sort_keys=True
+                )}
             if r.remote:
+                if extra is not None:
+                    try:
+                        self.sched.client.patch_pod_annotations(
+                            r.pod["metadata"].get("namespace", "default"),
+                            r.pod["metadata"]["name"], extra,
+                        )
+                    except Exception as e:  # noqa: BLE001 — abort the gang
+                        return (
+                            f"gang {g.spec.name}: member {r.uid} placement "
+                            f"patch failed: {e}"
+                        ), r.uid
                 continue
-            err = self.sched._patch_assignment(r.pod, r.uid, r.node, r.enc)
+            err = self.sched._patch_assignment(r.pod, r.uid, r.node, r.enc,
+                                               extra=extra)
             if err is not None:
                 return (
                     f"gang {g.spec.name}: member {r.uid} assignment "
@@ -769,7 +1089,11 @@ class GangCoordinator:
                     sched.client.patch_pod_annotations(
                         r.pod["metadata"].get("namespace", "default"),
                         r.pod["metadata"]["name"],
-                        dict(ASSIGNMENT_CLEAR_PATCH),
+                        # the placement doc rolls back with the
+                        # assignment (merge-patch null deletes; a no-op
+                        # for role-less members that never carried one)
+                        dict(ASSIGNMENT_CLEAR_PATCH,
+                             **{GANG_PLACEMENT: None}),
                     )
                 except Exception:  # noqa: BLE001 — auditor catches leftovers
                     log.exception(

@@ -251,11 +251,25 @@ class UsageCache:
         gen equality proves the aggregate is unchanged AND clean since the
         caller's read — two racing filters that both saw generation G on
         the same node serialize here, and exactly one wins."""
+        return self.try_book_chained(uid, node, expected_gen,
+                                     devices) is not None
+
+    def try_book_chained(
+        self, uid: str, node: str, expected_gen: int, devices: PodDevices
+    ) -> Optional[int]:
+        """:meth:`try_book` that also returns the node's POST-commit
+        generation (None on conflict), captured inside the SAME lock
+        hold as the booking.  The gang coordinator's same-node
+        multi-member reserve chains CAS generations through this: the
+        next member's CAS must expect exactly the generation OUR book
+        produced — a later ``peek_entry`` would silently absorb any
+        foreign mutation that landed in between, and gen equality is
+        the entire correctness proof."""
         with self._lock:
             entry = self._entries.get(node)
             if entry is None or entry.gen != expected_gen or entry.usage is None:
                 self.cas_conflicts += 1
-                return False
+                return None
             # a re-filtered pod replaces its previous booking (possibly on
             # another node) in the same atomic step — the reversal and the
             # new delta both bump generations, invalidating stale readers
@@ -264,7 +278,7 @@ class UsageCache:
             self._reverse_booking(uid)
             self._bookings[uid] = _PodBooking(node, devices)
             self._apply_delta(node, devices, sign=1)
-            return True
+            return self._entries[node].gen
 
     def on_pod_removed(self, uid: str) -> None:
         with self._lock:

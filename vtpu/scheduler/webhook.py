@@ -73,6 +73,18 @@ def gang_ops(pod: dict) -> List[dict]:
                         + _json_pointer_escape(gang_mod.GANG_MESH),
                 "value": canon,
             })
+    roles_raw = (annos.get(gang_mod.GANG_ROLES) or "").strip()
+    if roles_raw:
+        # name-sorted, full count x AxBxC entries — parse_gang_spec above
+        # already validated (counts sum to size, no duplicates)
+        canon = gang_mod.canonical_roles(roles_raw, spec.size)
+        if canon != roles_raw:
+            ops.append({
+                "op": "replace",
+                "path": "/metadata/annotations/"
+                        + _json_pointer_escape(gang_mod.GANG_ROLES),
+                "value": canon,
+            })
     return ops
 
 

@@ -23,6 +23,10 @@ _LAZY = {
     "SessionMover": ("vtpu.serving.migrate", "SessionMover"),
     "SessionExport": ("vtpu.serving.migrate", "SessionExport"),
     "MigrationError": ("vtpu.serving.migrate", "MigrationError"),
+    "EvictBridge": ("vtpu.serving.colo", "EvictBridge"),
+    "RolePlacement": ("vtpu.serving.colo", "RolePlacement"),
+    "boot_role_engine": ("vtpu.serving.colo", "boot_role_engine"),
+    "router_for_gang": ("vtpu.serving.colo", "router_for_gang"),
 }
 
 __all__ = sorted(_LAZY)

@@ -786,8 +786,54 @@ class DecodeEngine(PagedBatcher):
     # gather and the decode loop's donating dispatches order by program
     # sequence, never by a lock.
     def exportable_sessions(self) -> List[str]:
-        """Rids of the live decode slots a mover can export."""
-        return [r for r in self.rid if r is not None]
+        """Rids a mover can export: live decode slots PLUS queued-but-
+        unslotted adoptions whose blocks live in THIS pool (shared and
+        wire mode — a cross-pool ``copy`` entry's claimed blocks still
+        sit in the source engine's pool, so it finishes in place)."""
+        live = [r for r in self.rid if r is not None]
+        queued = [pa.rid for pa in self.queue
+                  if isinstance(pa, _PendingAdopt)
+                  and pa.mode in ("shared", "wire")]
+        return live + queued
+
+    def _export_pending(self, rid: str) -> SessionExport:
+        """Detach a queued-but-unslotted adoption into a session export
+        (the eviction path used to finish these in place: only LIVE
+        slots exported).  The pending record already holds everything a
+        slot would have published — blocks, cursor, tail (or the single
+        first token), budget — so the export is pure host bookkeeping:
+        no slot was ever bound, no device state exists to clear."""
+        for i, pa in enumerate(self.queue):
+            if not isinstance(pa, _PendingAdopt) or pa.rid != rid:
+                continue
+            if pa.mode == "copy":
+                # blocks are claimed references in the SOURCE pool —
+                # this engine cannot stream them; the entry stays
+                # queued and finishes in place (the documented
+                # fallback), which to the mover is "nothing to move"
+                raise SessionGoneError(
+                    f"session {rid!r} is a cross-pool pending adoption "
+                    f"on replica {self.replica_id}; it finishes in place"
+                )
+            del self.queue[i]
+            tail = [int(t) for t in
+                    (pa.tail if pa.tail is not None else [pa.first])]
+            chain = tuple(pa.chain or
+                          self.pool.digests_for_run(pa.blocks))
+            handle = self.pool.detach(pa.blocks, seq_len=int(pa.seq_len))
+            self._rids.discard(rid)
+            frozen = pa.frozen or (
+                self.eos_id is not None and pa.first == self.eos_id
+            )
+            return SessionExport(
+                rid=rid, handle=handle, cursor=int(pa.seq_len),
+                tail=tuple(tail), remaining=int(pa.num_new) - 1,
+                frozen=frozen, chain=chain, block_size=self.block_size,
+            )
+        raise SessionGoneError(
+            f"session {rid!r} is not live on replica "
+            f"{self.replica_id} (finished, mid-stream, or never here)"
+        )
 
     def _retire_rows(self, slots: List[int]) -> None:
         for slot in slots:
@@ -812,10 +858,9 @@ class DecodeEngine(PagedBatcher):
         slot = next((i for i in range(self.max_batch)
                      if self.rid[i] == rid), None)
         if slot is None:
-            raise SessionGoneError(
-                f"session {rid!r} is not live on replica "
-                f"{self.replica_id} (finished, queued, or never here)"
-            )
+            # queued-but-unslotted adoptions export too (they used to
+            # finish in place; ROADMAP item 2 leftover closed here)
+            return self._export_pending(rid)
         tail = [int(t) for t in self.out[rid]]
         base = self._slot_base[slot]
         cursor = base + len(tail) - 1

@@ -55,6 +55,20 @@ class annotations:
     GANG_NAME = "vtpu.io/gang-name"
     GANG_SIZE = "vtpu.io/gang-size"
     GANG_MESH = "vtpu.io/gang-mesh"
+    # -- pod: heterogeneous gang role map (the FlexNPU serving-gang
+    # shape): comma-separated "<role>=<count>x<member mesh>" entries,
+    # e.g. "prefill=2x2,decode=1x1x2" = two prefill members on a 2-chip
+    # rectangle each plus one decode member on a 1x2 rectangle.  Counts
+    # must sum to gang-size; each member's chip request must match its
+    # role's rectangle volume (docs/colo.md)
+    GANG_ROLES = "vtpu.io/gang-roles"
+    # -- pod: per-member placement doc written by the gang coordinator's
+    # phase-2 commit for role-bearing gangs: JSON {"gang", "role",
+    # "shape" ("AxBxC" per-host sub-rectangle), "hosts" (member count of
+    # the role), "index" (this member's rank within the role), "node"}.
+    # A bound member boots its role's mesh from THIS annotation alone
+    # (vtpu/serving/colo.py → mesh_from_rectangle's host-split form)
+    GANG_PLACEMENT = "vtpu.io/gang-placement"
     # -- pod: per-pod ICI allocation policy override (ring | compact |
     # best-effort), read by the filter's rectangle chooser
     ICI_POLICY = "vtpu.io/ici-policy"
