@@ -10,7 +10,8 @@ PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
 	bench-sched bench-serve bench-churn bench-disagg bench-gang \
-	bench-goodput bench-migrate bench-colo bench-smoke check obs-lint \
+	bench-goodput bench-migrate bench-colo bench-planet bench-smoke \
+	check obs-lint \
 	config-lint audit-check image chart clean tidy
 
 all: build
@@ -173,6 +174,21 @@ ifdef SMOKE
 	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_churn.py --smoke
 else
 	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_churn.py
+endif
+
+# planet-scale proof: a 100k-node trace-driven simulator on virtual
+# clocks over the REAL CAS ledger/HashRing/ShardAutoscaler — one diurnal
+# period replayed through static_shard_{1,4,16} vs autoscale arms, with
+# majority-owner-forwarding RPC accounting and a cold-start zero-drift
+# audit per arm → docs/artifacts/scheduler_planet.json
+# (docs/scheduler_perf.md §Planet scale explains the numbers).  SMOKE=1
+# runs a seconds-long 2k-node schema/SLO sanity pass (tier-1 safe; also
+# exercised by tests/test_planet.py).
+bench-planet:
+ifdef SMOKE
+	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_planet.py --smoke
+else
+	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_planet.py
 endif
 
 # serving decode-loop proof: paired pipeline_depth=0 vs pipelined runs

@@ -62,9 +62,24 @@ def main(argv=None) -> int:
                         "POST /shard/evaluate, owner-side CAS commit "
                         "(docs/scheduler_perf.md §Sharded replicas)")
     p.add_argument("--leader-election", action="store_true",
-                   help="run annotation-lease leader election; only the "
-                        "leader advances handshake annotations and runs the "
-                        "periodic audit loop (required when N replicas run)")
+                   help="run leader election (coordination.k8s.io Lease "
+                        "objects; VTPU_LEADER_ANNOTATION_LEASE=1 rolls back "
+                        "to the annotation lease); only the leader advances "
+                        "handshake annotations and runs the periodic audit "
+                        "loop (required when N replicas run)")
+    p.add_argument("--shard-autoscale", action="store_true",
+                   help="let the elected leader activate/retire --shard-peers "
+                        "replicas on the hash ring by filter backlog and "
+                        "evaluate-time saturation (watermarks: "
+                        "VTPU_SHARD_SCALE_HIGH/LOW, VTPU_SHARD_MIN/"
+                        "MAX_REPLICAS, VTPU_SHARD_SCALE_COOLDOWN, "
+                        "VTPU_SHARD_BUSY_HIGH; docs/scheduler_perf.md "
+                        "§Planet scale)")
+    autoscale_default = env_float("VTPU_SHARD_AUTOSCALE_INTERVAL_S", 5.0)
+    p.add_argument("--shard-autoscale-interval", type=float,
+                   default=autoscale_default,
+                   help="seconds between autoscaler decisions "
+                        "(env VTPU_SHARD_AUTOSCALE_INTERVAL_S)")
     # malformed env must not kill the entrypoint (env_float defaults)
     lease_default = env_float("VTPU_LEADER_LEASE_S", 15.0)
     p.add_argument("--leader-lease-s", type=float, default=lease_default,
@@ -141,6 +156,26 @@ def main(argv=None) -> int:
             "sharded filtering on: replica %s with peers %s",
             replica_id, sorted(peers),
         )
+        if args.shard_autoscale:
+            from vtpu.scheduler.shard import ShardAutoscaler
+
+            # only the elected leader makes scaling decisions (every
+            # replica would otherwise fight over the ring); without
+            # election this replica is the sole writer and scales alone
+            elector = sched.elector
+            sched.shard_autoscaler = ShardAutoscaler(
+                sched.shard,
+                queue_depth=sched.filters_inflight,
+                leader_gate=(elector.is_leader if elector is not None
+                             else None),
+            )
+            sched.shard_autoscaler.start(args.shard_autoscale_interval)
+            logging.info(
+                "shard autoscaler on: pool of %d replicas, pump every %ss",
+                1 + len(peers), args.shard_autoscale_interval,
+            )
+    elif args.shard_autoscale:
+        p.error("--shard-autoscale needs --shard-peers (the pool to scale)")
     sched.run_background_loops()
     # main listener: plain HTTP — the kube-scheduler sidecar's extender
     # config (urlPrefix http://127.0.0.1:<port>) and Prometheus scrape it
@@ -191,6 +226,9 @@ def main(argv=None) -> int:
         webhook_srv.shutdown()
     if grpc_server is not None:
         grpc_server.stop(grace=1)
+    autoscaler = getattr(sched, "shard_autoscaler", None)
+    if autoscaler is not None:
+        autoscaler.stop()
     sched.stop()
     return 0
 

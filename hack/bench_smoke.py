@@ -55,6 +55,11 @@ BENCHES = {
         [sys.executable, "benchmarks/scheduler_churn.py", "--smoke"],
         {"JAX_PLATFORMS": "cpu"},
     ),
+    "planet": (
+        "scheduler_planet.json",
+        [sys.executable, "benchmarks/scheduler_planet.py", "--smoke"],
+        {"JAX_PLATFORMS": "cpu"},
+    ),
     "gang": (
         "scheduler_gang.json",
         [sys.executable, "benchmarks/scheduler_gang.py", "--smoke"],

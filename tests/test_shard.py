@@ -498,3 +498,357 @@ def test_http_peer_commit_never_replays_on_send_error():
     peer = HttpPeer("http://127.0.0.1:1")  # nothing listens here
     with pytest.raises(OSError):
         peer.commit({"metadata": {"uid": "x"}}, "n0", 1)
+
+# ---------------------------------------------------------------------------
+# Majority-owner forwarding (docs/scheduler_perf.md §Planet scale)
+# ---------------------------------------------------------------------------
+
+def test_majority_owner_forward_commits_at_owner_with_one_rpc():
+    """A candidate set wholly owned by a peer ships as ONE /shard/filter
+    forward instead of an evaluate+commit fan-out; the owner books and
+    patches like any local filter."""
+    from vtpu.scheduler.shard import _FORWARDS
+
+    c, a, b, names = make_pair()
+    remote_only = [n for n in names if a.shard.ring.owner(n) == "rB"]
+    assert remote_only
+
+    calls = {"evaluate": 0, "commit": 0, "forward": 0}
+    real = LocalPeer(b)
+
+    class CountingPeer:
+        def evaluate(self, pod, nodes):
+            calls["evaluate"] += 1
+            return real.evaluate(pod, nodes)
+
+        def commit(self, pod, node, gen, placement_enc=None):
+            calls["commit"] += 1
+            return real.commit(pod, node, gen, placement_enc)
+
+        def filter_forward(self, pod, nodes):
+            calls["forward"] += 1
+            return real.filter_forward(pod, nodes)
+
+    a.shard = ShardCoordinator(a, "rA", {"rB": CountingPeer()})
+    before = _FORWARDS.value(peer="rB")
+    pod = c.create_pod(tpu_pod("fwd-pod"))
+    res = a.filter(pod, remote_only)
+    assert res.node in remote_only, res.error
+    assert calls == {"evaluate": 0, "commit": 0, "forward": 1}
+    assert _FORWARDS.value(peer="rB") == before + 1
+    uid = pod["metadata"]["uid"]
+    assert uid in b.pods.all_pods() and uid not in a.pods.all_pods()
+    # the owner patched the assignment annotations (committed remotely)
+    got = c.get_pod("default", "fwd-pod")
+    assert got["metadata"]["annotations"][annotations.ASSIGNED_NODE] == res.node
+
+
+def test_forward_below_threshold_coordinates_normally():
+    """When no peer owns config.shard_forward_threshold of the set, the
+    normal partition → evaluate fan-out → owner commit path runs."""
+    from vtpu.scheduler.shard import _FORWARDS
+
+    c, a, b, names = make_pair()
+    parts = a.shard.ring.partition(names)
+    assert len(parts) == 2, "ring degenerated: one replica owns everything"
+    frac = max(len(v) for v in parts.values()) / len(names)
+    assert frac < a.config.shard_forward_threshold, (
+        "fixture ring too skewed for this test"
+    )
+    before = _FORWARDS.value(peer="rB")
+    pod = c.create_pod(tpu_pod("coord-pod"))
+    res = a.filter(pod, names)
+    assert res.node is not None, res.error
+    assert _FORWARDS.value(peer="rB") == before
+
+
+def test_forward_disabled_by_threshold_above_one():
+    from vtpu.scheduler.shard import _FORWARDS
+
+    c, a, b, names = make_pair()
+    a.config.shard_forward_threshold = 1.5  # > 1 disables forwarding
+    remote_only = [n for n in names if a.shard.ring.owner(n) == "rB"]
+    before = _FORWARDS.value(peer="rB")
+    pod = c.create_pod(tpu_pod("nofwd-pod"))
+    res = a.filter(pod, remote_only)
+    assert res.node in remote_only, res.error
+    assert _FORWARDS.value(peer="rB") == before
+
+
+def test_forward_failure_before_dispatch_falls_back_to_coordination():
+    """A forward that provably never reached the peer (connect refused)
+    must not fail the filter: the coordinator falls back to the normal
+    evaluate/commit path against the same peer."""
+    real_holder = {}
+
+    class NoForwardPeer:
+        def evaluate(self, pod, nodes):
+            return real_holder["p"].evaluate(pod, nodes)
+
+        def commit(self, pod, node, gen, placement_enc=None):
+            return real_holder["p"].commit(pod, node, gen, placement_enc)
+
+        def filter_forward(self, pod, nodes):
+            raise ConnectionRefusedError("peer listener not up yet")
+
+    c, a, b, names = make_pair()
+    real_holder["p"] = LocalPeer(b)
+    a.shard = ShardCoordinator(a, "rA", {"rB": NoForwardPeer()})
+    remote_only = [n for n in names if a.shard.ring.owner(n) == "rB"]
+    pod = c.create_pod(tpu_pod("fb-pod"))
+    res = a.filter(pod, remote_only)
+    assert res.node in remote_only, res.error
+    assert pod["metadata"]["uid"] in b.pods.all_pods()
+
+
+def test_forward_indeterminate_fails_filter_never_rebooks():
+    """A forward whose response was lost AFTER the send may have booked
+    at the owner — falling back to coordination could double-book the
+    pod, so the filter must fail and let kube-scheduler retry."""
+    from vtpu.scheduler.shard import PeerIndeterminate
+
+    class LostResponsePeer:
+        def evaluate(self, pod, nodes):
+            raise AssertionError("must not coordinate after indeterminate")
+
+        commit = evaluate
+
+        def filter_forward(self, pod, nodes):
+            raise PeerIndeterminate("response lost after send")
+
+    c, a, b, names = make_pair()
+    a.shard = ShardCoordinator(a, "rA", {"rB": LostResponsePeer()})
+    remote_only = [n for n in names if a.shard.ring.owner(n) == "rB"]
+    pod = c.create_pod(tpu_pod("lost-pod"))
+    res = a.filter(pod, remote_only)
+    assert res.node is None
+    assert "forward" in res.error and "rB" in res.error
+    assert pod["metadata"]["uid"] not in a.pods.all_pods()
+
+
+def test_forward_target_never_reforwards():
+    """allow_forward=False at the forward target: even when the
+    forwarded candidate set is majority-owned by a THIRD replica from
+    the target's view, the target coordinates — depth is one hop."""
+    c = FakeClient()
+    names = [f"n{i:02d}" for i in range(12)]
+    for n in names:
+        register_node(c, n)
+    a, b = Scheduler(c), Scheduler(c)
+    a.register_from_node_annotations()
+    b.register_from_node_annotations()
+
+    class BoomPeer:
+        def evaluate(self, pod, nodes):
+            return {"failed": {n: "third replica down" for n in nodes},
+                    "fits": 0}
+
+        def commit(self, pod, node, gen, placement_enc=None):
+            return {"status": "error", "error": "down"}
+
+        def filter_forward(self, pod, nodes):
+            raise AssertionError("forward target re-forwarded (depth > 1)")
+
+    # b's ring: itself + a third replica rC that owns plenty
+    b.shard = ShardCoordinator(b, "rB", {"rC": BoomPeer()})
+    a.shard = ShardCoordinator(a, "rA", {"rB": LocalPeer(b)})
+    rb_owned_at_a = [n for n in names if a.shard.ring.owner(n) == "rB"]
+    pod = c.create_pod(tpu_pod("hop-pod"))
+    res = a.filter(pod, rb_owned_at_a)  # forwards rA → rB
+    # rB resolved it WITHOUT calling rC.filter_forward (BoomPeer would
+    # raise): either a placement on an rB-owned node or a merged failure
+    if res.node is not None:
+        assert b.shard.ring.owner(res.node) == "rB"
+
+
+def test_http_peer_filter_forward_wire_round_trip():
+    from vtpu.scheduler.routes import serve
+
+    c = FakeClient()
+    names = [f"w{i:02d}" for i in range(4)]
+    for n in names:
+        register_node(c, n)
+    b = Scheduler(c)
+    b.register_from_node_annotations()
+    srv, _ = serve(b, bind="127.0.0.1:0")
+    try:
+        port = srv.server_address[1]
+        peer = HttpPeer(f"http://127.0.0.1:{port}")
+        pod = c.create_pod(tpu_pod("wirefwd"))
+        rep = peer.filter_forward(pod, names)
+        assert rep.get("node") in names, rep
+        assert pod["metadata"]["uid"] in b.pods.all_pods()
+    finally:
+        srv.shutdown()
+
+
+def test_shard_filter_endpoint_rejects_on_tls_webhook_listener():
+    """The forward endpoint books — it must stay off the TLS port like
+    the other /shard wire routes."""
+    import inspect
+
+    from vtpu.scheduler.routes import _Handler
+
+    src = inspect.getsource(_Handler.do_POST)
+    assert '"/shard/filter" and self.allow_debug' in src
+
+
+# ---------------------------------------------------------------------------
+# Membership: activation, two-phase retirement, draining
+# ---------------------------------------------------------------------------
+
+def test_set_active_validates_and_only_remaps_removed_vnodes():
+    c, a, b, names = make_pair()
+    coord = ShardCoordinator(a, "rA",
+                             {"rB": LocalPeer(b), "rC": LocalPeer(b)})
+    assert coord.active_ids() == ["rA", "rB", "rC"]
+    with pytest.raises(ValueError):
+        coord.set_active(["rA", "rZ"])  # not in the configured pool
+    probe = [f"node-{i:05d}" for i in range(3000)]
+    before = {n: coord.ring.owner(n) for n in probe}
+    coord.set_active(["rA", "rB"])  # drop rC
+    assert coord.active_ids() == ["rA", "rB"]
+    for n in probe:
+        if before[n] != "rC":
+            assert coord.ring.owner(n) == before[n]
+        else:
+            assert coord.ring.owner(n) in ("rA", "rB")
+
+
+def test_two_phase_retire_drains_before_ring_drop():
+    c, a, b, names = make_pair()
+    with pytest.raises(ValueError):
+        a.shard.begin_retire("rA")  # never self
+    a.shard.begin_retire("rB")
+    # phase 1: ring unchanged, but new filters shed rB's nodes
+    assert "rB" in a.shard.active_ids()
+    rb_nodes = [n for n in names if a.shard.ring.owner(n) == "rB"]
+    pod = c.create_pod(tpu_pod("drain-pod"))
+    res = a.filter(pod, names)
+    assert res.node is not None and a.shard.ring.owner(res.node) == "rA"
+    for n in rb_nodes:
+        assert "draining" in res.failed[n]
+    # phase 2: ring drop — rB's nodes now route to rA
+    assert a.shard.inflight("rB") == 0
+    a.shard.finish_retire("rB")
+    assert a.shard.active_ids() == ["rA"]
+    pod2 = c.create_pod(tpu_pod("post-retire"))
+    res2 = a.filter(pod2, rb_nodes)
+    assert res2.node in rb_nodes, res2.error
+
+
+def test_retire_prunes_per_replica_metric_labels():
+    from vtpu.scheduler.shard import (
+        _EVAL_HIST,
+        _FORWARDS,
+        _PEER_RECONNECTS,
+        prune_replica_metrics,
+    )
+
+    c, a, b, names = make_pair()
+    peer = HttpPeer("http://127.0.0.1:9")  # transport only; never called
+    coord = ShardCoordinator(a, "rA", {"rDead": peer})
+    _EVAL_HIST.observe(0.01, peer="rDead")
+    _FORWARDS.inc(peer="rDead")
+    _PEER_RECONNECTS.inc(peer=peer.base_url)
+    assert _EVAL_HIST.snapshot(peer="rDead") is not None
+    prune_replica_metrics(coord, "rDead")
+    assert _EVAL_HIST.snapshot(peer="rDead") is None
+    assert _FORWARDS.value(peer="rDead") == 0
+    assert _PEER_RECONNECTS.value(peer=peer.base_url) == 0
+
+
+# ---------------------------------------------------------------------------
+# Lease-object leader election (coordination.k8s.io/v1)
+# ---------------------------------------------------------------------------
+
+def test_lease_election_writes_lease_objects_and_counts_transitions():
+    clock = [1000.0]
+    c = FakeClient()
+    e1 = LeaderElector(c, "repl-1", lease_s=10.0, wallclock=lambda: clock[0])
+    e2 = LeaderElector(c, "repl-2", lease_s=10.0, wallclock=lambda: clock[0])
+    assert e1.use_lease and e2.use_lease  # kube-native path is the default
+    assert e1.try_acquire() is True
+    lease = c.get_lease("vtpu-scheduler", "vtpu-system")
+    assert lease["spec"]["holderIdentity"] == "repl-1"
+    assert lease["spec"]["leaseDurationSeconds"] == 10
+    assert lease["spec"]["leaseTransitions"] == 0
+    assert e2.try_acquire() is False
+    assert e2.current_holder() == "repl-1"
+    clock[0] += 11  # repl-1 stops renewing
+    assert e2.try_acquire() is True
+    lease = c.get_lease("vtpu-scheduler", "vtpu-system")
+    assert lease["spec"]["holderIdentity"] == "repl-2"
+    assert lease["spec"]["leaseTransitions"] == 1
+    # the election Node of the annotation path was never created
+    with pytest.raises(Exception):
+        c.get_node("vtpu-scheduler-election")
+
+
+def test_lease_election_update_is_resource_version_conditional():
+    """A concurrent takeover between this elector's read and write must
+    surface as a Conflict (follower), never a clobber."""
+    clock = [0.0]
+    c = FakeClient()
+    e1 = LeaderElector(c, "fast", lease_s=5.0, wallclock=lambda: clock[0])
+    e2 = LeaderElector(c, "slow", lease_s=5.0, wallclock=lambda: clock[0])
+    assert e1.try_acquire()
+    clock[0] += 6  # lease expired: both may take it
+    # interleave: e2 reads the expired lease, then e1 renews, then e2
+    # writes against the now-stale resourceVersion
+    real_update = c.update_lease
+
+    def racing_update(name, lease, namespace="vtpu-system"):
+        if lease["spec"]["holderIdentity"] == "slow":
+            e1.try_acquire()  # the fast elector renews first
+        return real_update(name, lease, namespace)
+
+    c.update_lease = racing_update
+    assert e2.try_acquire() is False  # lost the CAS race
+    assert e1.is_leader() and not e2.is_leader()
+
+
+def test_annotation_lease_rollback_flag_still_elects():
+    clock = [0.0]
+    c = FakeClient()
+    e1 = LeaderElector(c, "old-1", lease_s=10.0,
+                       wallclock=lambda: clock[0], use_lease=False)
+    e2 = LeaderElector(c, "old-2", lease_s=10.0,
+                       wallclock=lambda: clock[0], use_lease=False)
+    assert not e1.use_lease
+    assert e1.try_acquire() is True
+    assert e2.try_acquire() is False
+    assert e2.current_holder() == "old-1"
+    # the bespoke annotation lease is what got written
+    node = c.get_node("vtpu-scheduler-election")
+    rec = json.loads(node["metadata"]["annotations"][
+        annotations.SCHEDULER_LEADER])
+    assert rec["holder"] == "old-1"
+    clock[0] += 11
+    assert e2.try_acquire() is True
+
+
+def test_lease_election_degrades_to_annotation_without_lease_verbs():
+    """A client without the coordination.k8s.io verbs (restricted RBAC,
+    older fake) silently keeps the annotation path."""
+
+    class NodeOnlyClient:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def get_node(self, name):
+            return self._inner.get_node(name)
+
+        def create_node(self, node):
+            return self._inner.create_node(node)
+
+        def patch_node_annotations(self, name, annos, resource_version=None):
+            return self._inner.patch_node_annotations(
+                name, annos, resource_version
+            )
+
+    c = FakeClient()
+    e = LeaderElector(NodeOnlyClient(c), "legacy", lease_s=10.0)
+    assert not e.use_lease
+    assert e.try_acquire() is True
+    assert e.current_holder() == "legacy"

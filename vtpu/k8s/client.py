@@ -17,7 +17,7 @@ import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional
 
-from vtpu.k8s.errors import Conflict  # noqa: E402
+from vtpu.k8s.errors import Conflict, NotFound  # noqa: E402
 from vtpu.utils.envs import env_str
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
@@ -144,6 +144,52 @@ class Client:
             )
         except ApiError as e:
             if e.status in (409, 422):
+                raise Conflict(str(e)) from e
+            raise
+
+    # -- coordination.k8s.io/v1 Lease objects -----------------------------
+    # The kube-native leader-election primitive.  update_lease is a PUT, so
+    # the apiserver rejects a stale metadata.resourceVersion with 409 — the
+    # same optimistic CAS the annotation-lease elector built by hand.
+    def get_lease(self, name: str, namespace: str = "vtpu-system") -> dict:
+        try:
+            return self._request(
+                "GET",
+                f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases/{name}",
+            )
+        except ApiError as e:
+            if e.status == 404:
+                raise NotFound(f"lease {namespace}/{name}") from e
+            raise
+
+    def create_lease(self, lease: dict) -> dict:
+        ns = lease["metadata"].get("namespace", "vtpu-system")
+        try:
+            return self._request(
+                "POST",
+                f"/apis/coordination.k8s.io/v1/namespaces/{ns}/leases",
+                body=lease,
+            )
+        except ApiError as e:
+            if e.status == 409:
+                # AlreadyExists — the loser of a creation race becomes a
+                # follower, exactly like the fake client
+                raise Conflict(str(e)) from e
+            raise
+
+    def update_lease(
+        self, name: str, lease: dict, namespace: str = "vtpu-system"
+    ) -> dict:
+        try:
+            return self._request(
+                "PUT",
+                f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases/{name}",
+                body=lease,
+            )
+        except ApiError as e:
+            if e.status == 404:
+                raise NotFound(f"lease {namespace}/{name}") from e
+            if e.status == 409:
                 raise Conflict(str(e)) from e
             raise
 

@@ -22,6 +22,7 @@ class FakeClient:
         self._lock = make_lock("k8s.fake", reentrant=True)
         self._nodes: Dict[str, dict] = {}
         self._pods: Dict[str, dict] = {}  # key: ns/name
+        self._leases: Dict[str, dict] = {}  # key: ns/name
         self._rv = 0
         # hooks for tests: called after each mutation with (kind, obj)
         self.on_mutate: Optional[Callable[[str, dict], None]] = None
@@ -102,6 +103,53 @@ class FakeClient:
             self._bump(node)
             self._notify("Node", node)
             return copy.deepcopy(node)
+
+    # -- coordination.k8s.io/v1 Lease objects -----------------------------
+    # The kube-native leader-election primitive (the object client-go's
+    # leaderelection package CASes on).  Update() is ALWAYS
+    # resourceVersion-conditional — apiserver PUT semantics: a stale rv
+    # in the submitted object is 409 Conflict — which is exactly the
+    # optimistic-concurrency the annotation-lease elector relied on.
+
+    def get_lease(self, name: str, namespace: str = "vtpu-system") -> dict:
+        with self._lock:
+            k = self._key(namespace, name)
+            if k not in self._leases:
+                raise NotFound(f"lease {k}")
+            return copy.deepcopy(self._leases[k])
+
+    def create_lease(self, lease: dict) -> dict:
+        with self._lock:
+            md = lease["metadata"]
+            k = self._key(md.get("namespace", "vtpu-system"), md["name"])
+            if k in self._leases:
+                # apiserver semantics: create of an existing object is
+                # 409 AlreadyExists — the loser of a creation race must
+                # become a follower, never silently overwrite the winner
+                raise Conflict(f"lease {k} already exists")
+            self._bump(lease)
+            self._leases[k] = copy.deepcopy(lease)
+            self._notify("Lease", lease)
+            return copy.deepcopy(lease)
+
+    def update_lease(
+        self, name: str, lease: dict, namespace: str = "vtpu-system"
+    ) -> dict:
+        with self._lock:
+            k = self._key(namespace, name)
+            if k not in self._leases:
+                raise NotFound(f"lease {k}")
+            current = self._leases[k]
+            sent_rv = lease.get("metadata", {}).get("resourceVersion")
+            if sent_rv != current["metadata"].get("resourceVersion"):
+                raise Conflict(f"lease {k}: resourceVersion mismatch")
+            fresh = copy.deepcopy(lease)
+            fresh["metadata"]["name"] = name
+            fresh["metadata"]["namespace"] = namespace
+            self._bump(fresh)
+            self._leases[k] = copy.deepcopy(fresh)
+            self._notify("Lease", fresh)
+            return copy.deepcopy(fresh)
 
     # -- pods -------------------------------------------------------------
     def create_pod(self, pod: dict) -> dict:

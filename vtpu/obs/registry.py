@@ -117,6 +117,15 @@ class Counter(_Instrument):
             ent = self._values.get(_label_key(labels))
             return ent[1] if ent else 0
 
+    def remove(self, **labels: str) -> None:
+        """Drop one label set from the exposition — for per-entity
+        counters (per-peer reconnects, per-replica shard families) whose
+        entity was retired; without this a dead replica's series would be
+        exported forever.  Prometheus treats the disappearance as a
+        series end, same as a restarted target."""
+        with self._lock:
+            self._values.pop(_label_key(labels), None)
+
     def render(self, lines: List[str]) -> None:
         with self._lock:
             samples = [(dict(lbl), v) for lbl, v in self._values.values()]
@@ -200,6 +209,14 @@ class Histogram(_Instrument):
             s.counts[idx] += 1
             s.sum += value
             s.count += 1
+
+    def remove(self, **labels: str) -> None:
+        """Drop one label set (all its buckets) from the exposition —
+        the per-entity pruning counters and gauges already have, for
+        per-replica histogram series (``vtpu_shard_evaluate_seconds``)
+        when the autoscaler retires the replica."""
+        with self._lock:
+            self._series.pop(_label_key(labels), None)
 
     def snapshot(self, **labels: str) -> Optional[dict]:
         """(cumulative bucket counts, sum, count) for tests/debugging."""

@@ -66,6 +66,18 @@ class SchedulerConfig:
     measured_max_age_s: float = dataclasses.field(
         default_factory=lambda: _env_float("VTPU_UTIL_WRITEBACK_MAX_AGE_S", 60.0)
     )
+    # majority-owner forwarding (docs/scheduler_perf.md §Planet scale):
+    # when a single PEER replica owns at least this fraction of a
+    # filter's candidate set (a node-selector-narrowed or gang-local
+    # request), the coordinator forwards the WHOLE request to that owner
+    # instead of coordinating — the common case drops from N RPCs to 1.
+    # > 1 disables forwarding (always coordinate); the owner never
+    # re-forwards (depth is capped at one hop by construction)
+    shard_forward_threshold: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "VTPU_SHARD_FORWARD_THRESHOLD", 0.8
+        )
+    )
     # best-effort overlay admission gates (docs/scheduler_perf.md
     # §Best-effort oversubscription): a chip qualifies for overlay
     # bookings only while its measured duty stays at or under the

@@ -207,6 +207,11 @@ class Scheduler:
         # counts exactly under the contention it is meant to measure.
         self.filter_gen_retries = 0
         self._gen_retry_lock = make_lock("scheduler.gen_retry")
+        # filters currently executing (each parks one HTTP handler
+        # thread): the control-plane backlog signal the shard autoscaler
+        # reads as its queue depth (vtpu/scheduler/shard.py)
+        self._filters_inflight = 0
+        self._filters_inflight_lock = make_lock("scheduler.filter_inflight")
         # sharded deployment (vtpu/scheduler/shard.py): when set, filter()
         # fans the candidate walk out to the replica that owns each node
         # and commits at the owner; None = this replica owns everything
@@ -560,10 +565,37 @@ class Scheduler:
         pod: dict,
         node_names: List[str],
         node_objs: Optional[Dict[str, dict]] = None,
+        allow_forward: bool = True,
     ) -> FilterResult:
         """``node_objs``: full Node objects when the caller has them
         (nodeCacheCapable=false extenders send them in nodes.items) —
-        otherwise validity checks fall back to the registry poll's cache."""
+        otherwise validity checks fall back to the registry poll's cache.
+
+        ``allow_forward=False`` marks this replica as the target of a
+        majority-owner forward (shard_filter_forwarded): it must resolve
+        the filter here — coordinate, commit — never re-forward."""
+        with self._filters_inflight_lock:
+            self._filters_inflight += 1
+        try:
+            return self._filter_inner(pod, node_names, node_objs, allow_forward)
+        finally:
+            with self._filters_inflight_lock:
+                self._filters_inflight -= 1
+
+    def filters_inflight(self) -> int:
+        """Filters executing right now — the shard autoscaler's
+        queue-depth signal (a saturated replica set shows up as handler
+        threads parked inside filter())."""
+        with self._filters_inflight_lock:
+            return self._filters_inflight
+
+    def _filter_inner(
+        self,
+        pod: dict,
+        node_names: List[str],
+        node_objs: Optional[Dict[str, dict]],
+        allow_forward: bool,
+    ) -> FilterResult:
         reqs = resource_reqs(
             pod, self.config.default_mem, self.config.default_cores
         )
@@ -675,7 +707,8 @@ class Scheduler:
                 # subset evaluates locally, peers evaluate theirs, the
                 # winner's owner CAS-commits (and patches, when remote)
                 res, enc, verdicts, committed_remote = self.shard.coordinate(
-                    pod, node_names, reqs, pod_annos, node_objs
+                    pod, node_names, reqs, pod_annos, node_objs,
+                    allow_forward=allow_forward,
                 )
             elif self.config.optimistic_booking:
                 res, enc, verdicts = self._select_and_book(
@@ -1507,6 +1540,22 @@ class Scheduler:
             out["best"] = {
                 "score": best[0], "node": best[1], "gen": best[3],
             }
+        return out
+
+    def shard_filter_forwarded(self, pod: dict, node_names=None) -> dict:
+        """Majority-owner forward target (POST /shard/filter): run the
+        WHOLE filter here — evaluate, CAS-commit, assignment patch — and
+        answer with the chosen node.  The coordinator sends this instead
+        of fanning out when this replica owns most of the candidate set;
+        ``allow_forward=False`` keeps the hop count at one (this replica
+        coordinates the minority remainder normally, it never
+        re-forwards)."""
+        res = self.filter(pod, list(node_names or []), allow_forward=False)
+        out: dict = {"failed": res.failed}
+        if res.node is not None:
+            out["node"] = res.node
+        if res.error:
+            out["error"] = res.error
         return out
 
     def shard_commit(

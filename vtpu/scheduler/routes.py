@@ -14,9 +14,10 @@ plus the debug surface on the plain listener: /spans, /timeline,
 /trace.json, /decisions, /events (the typed journal), /audit (the
 reconciliation verdict report, vtpu/audit), and the sharded-replica
 surface (vtpu/scheduler/shard.py): GET /shard (ring/ownership status),
-POST /shard/evaluate, /shard/commit and /shard/release (peer-replica
-subset evaluation, owner-side CAS commit, and the gang-abort release —
-plain listener only, never the TLS port).
+POST /shard/evaluate, /shard/filter, /shard/commit and /shard/release
+(peer-replica subset evaluation, the majority-owner whole-filter
+forward, owner-side CAS commit, and the gang-abort release — plain
+listener only, never the TLS port).
 
 Served by a stdlib ThreadingHTTPServer; the extender is pure
 request/response over in-memory state, so no framework is needed.
@@ -227,6 +228,15 @@ class _Handler(BaseHTTPRequestHandler):
                 # lock-free walk over the nodes this replica owns; never
                 # books.  Served on the plain in-cluster listener only.
                 out = self.scheduler.shard_evaluate(
+                    body.get("pod") or {}, body.get("nodes")
+                )
+            elif self.path == "/shard/filter" and self.allow_debug:
+                # majority-owner forward: this replica owns most of the
+                # candidate set, so the coordinator ships the WHOLE
+                # filter here — evaluate, CAS-commit, assignment patch —
+                # one RPC instead of a fan-out.  Never re-forwarded
+                # (allow_forward=False inside).
+                out = self.scheduler.shard_filter_forwarded(
                     body.get("pod") or {}, body.get("nodes")
                 )
             elif self.path == "/shard/commit" and self.allow_debug:

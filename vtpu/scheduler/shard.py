@@ -25,18 +25,38 @@ the control plane.  This module makes it horizontal:
   annotations) exactly like a restarted single scheduler — cold-start
   failover needs no handoff, and the cluster auditor (vtpu/audit) is the
   oracle that a failed-over replica converged.
+- **Routing** (docs/scheduler_perf.md §Planet scale): the coordinator
+  only ever RPCs replicas that own candidates (the partition is the
+  routing table), and when a single peer owns at least
+  ``config.shard_forward_threshold`` of the candidate set — a
+  node-selector-narrowed or gang-local request — it forwards the WHOLE
+  request to that owner (``POST /shard/filter``) instead of
+  coordinating: the common case drops from N RPCs to 1.  The owner
+  never re-forwards, so forwarding depth is one hop by construction.
+- **Autoscaling** (``ShardAutoscaler``): the elected leader watches
+  evaluate-time saturation and filter queue depth through the same
+  high/low-watermark + cooldown + min-floor machinery as the router's
+  prefill tier, activating configured peers into the ring under load
+  and retiring them when idle.  Retirement is two-phase: the retiree
+  first DRAINS (new filters stop routing to it while in-flight
+  coordinations finish against the unchanged ring) and only then drops
+  off the ring — so an in-flight CAS commit can never double-book
+  against the node's next owner.  Consistent hashing guarantees only
+  the retiree's vnodes remap.
 - **Leader election** (``LeaderElector``): write-back consumers — the
   handshake state-machine patches and the periodic audit loop — run on
-  one elected replica.  The lease is an annotation on a dedicated
-  election Node object, acquired with a resourceVersion-conditional
-  patch (the same optimistic-concurrency primitive as the node lock,
-  vtpu/utils/nodelock.py): "annotations are the database", including for
-  the control plane's own coordination.
+  one elected replica.  The lease is a ``coordination.k8s.io/v1``
+  Lease object updated with resourceVersion-conditional PUTs (the
+  kube-native primitive client-go's leaderelection package uses); the
+  original annotation-on-an-election-Node lease remains behind
+  ``VTPU_LEADER_ANNOTATION_LEASE=1`` as the rollback path, with the
+  same optimistic-concurrency semantics either way.
 """
 
 from __future__ import annotations
 
 import collections
+import datetime
 import hashlib
 import http.client
 import json
@@ -51,6 +71,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from vtpu import obs
 from vtpu.k8s.errors import Conflict, NotFound
 from vtpu.scheduler.core import FilterResult
+from vtpu.utils.envs import env_bool, env_float, env_int, env_str
 from vtpu.utils.types import annotations
 from vtpu.analysis.witness import make_lock
 
@@ -61,7 +82,9 @@ __all__ = [
     "HttpPeer",
     "LeaderElector",
     "LocalPeer",
+    "ShardAutoscaler",
     "ShardCoordinator",
+    "prune_replica_metrics",
 ]
 
 _REG = obs.registry("scheduler")
@@ -87,6 +110,34 @@ _PEER_RECONNECTS = _REG.counter(
     "vtpu_shard_peer_reconnects_total",
     "Persistent peer connections re-established after an error or a "
     "server-side close (label peer = the peer base URL)",
+)
+_FORWARDS = _REG.counter(
+    "vtpu_shard_forwards_total",
+    "Whole filter requests forwarded to a majority-owner peer instead "
+    "of coordinated (label peer = the owner replica id)",
+)
+# candidate-count buckets (nodes, not seconds): the scatter width of one
+# sharded filter — how many candidate nodes this replica shipped to
+# remote owners.  0 for a forwarded or fully-local filter.  The _total
+# suffix satisfies obs-lint's unit-suffix rule for non-counters (same
+# compromise as the vtpu_shard_owned_nodes_total gauge).
+_FANOUT_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048,
+                   4096, 8192, 16384, 32768, 65536)
+_FANOUT_NODES = _REG.histogram(
+    "vtpu_shard_fanout_nodes_total",
+    "Candidate nodes shipped to remote owners per sharded filter "
+    "(0 = forwarded whole, or every candidate was locally owned)",
+    buckets=_FANOUT_BUCKETS,
+)
+_AUTOSCALE = _REG.counter(
+    "vtpu_shard_autoscale_total",
+    "Autoscaler transitions (label action: up / retire_begin / "
+    "retire_finish)",
+)
+_ACTIVE_REPLICAS = _REG.gauge(
+    "vtpu_shard_active_replicas_total",
+    "Replicas currently on the consistent-hash ring (drainers still "
+    "count until their in-flight coordinations finish)",
 )
 
 DEFAULT_VNODES = 64
@@ -162,6 +213,17 @@ class LocalPeer:
 
     def release(self, uid: str, node: str) -> dict:
         return self.sched.shard_release(uid, node)
+
+    def filter_forward(self, pod: dict, node_names: List[str]) -> dict:
+        return self.sched.shard_filter_forwarded(pod, node_names)
+
+
+class PeerIndeterminate(RuntimeError):
+    """A non-idempotent peer call whose request was FULLY SENT but whose
+    response was lost: the peer may or may not have applied it.  The
+    coordinator must not fall back to acting locally (a forwarded filter
+    the owner did book plus a local re-book would double-book the pod) —
+    it fails the filter and lets kube-scheduler retry the pod."""
 
 
 class HttpPeer:
@@ -293,6 +355,56 @@ class HttpPeer:
             "/shard/release", {"uid": uid, "node": node}, idempotent=True
         )
 
+    def filter_forward(self, pod: dict, node_names: List[str]) -> dict:
+        """Majority-owner forwarding (POST /shard/filter): the peer runs
+        the whole filter — evaluate, CAS-commit, assignment patch — and
+        answers with the chosen node.  NOT idempotent (it books), so like
+        commit it runs on a fresh connection and never replays.  Failure
+        before the request finished sending raises the underlying error
+        (the peer never dispatched it — the routes.py handler only runs
+        after reading the full Content-Length body, so the coordinator
+        may safely coordinate instead); failure AFTER the send raises
+        :class:`PeerIndeterminate` (the peer may have booked)."""
+        body = json.dumps({"pod": pod, "nodes": node_names}).encode()
+        conn = http.client.HTTPConnection(
+            self._host, self._port, timeout=self.timeout_s
+        )
+        sent = False
+        try:
+            conn.request("POST", "/shard/filter", body,
+                         {"Content-Type": "application/json"})
+            sent = True
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status >= 400:
+                conn.close()
+                if resp.status >= 500:
+                    # the handler ran and died — it may have booked
+                    # before raising
+                    raise PeerIndeterminate(
+                        f"peer {self.base_url}/shard/filter "
+                        f"returned {resp.status}"
+                    )
+                # 4xx: rejected before dispatch (unknown route on an old
+                # replica, bad request) — nothing was booked
+                raise RuntimeError(
+                    f"peer {self.base_url}/shard/filter "
+                    f"returned {resp.status}"
+                )
+            if resp.will_close:
+                conn.close()
+            else:
+                self._release(conn)
+            return json.loads(data or b"{}")
+        except (http.client.HTTPException, OSError) as e:
+            conn.close()
+            if sent:
+                raise PeerIndeterminate(
+                    f"peer {self.base_url}/shard/filter: "
+                    f"response lost after send ({e})"
+                ) from e
+            raise
+
 
 class ShardCoordinator:
     """The thin merge layer a replica runs when it receives a filter
@@ -309,7 +421,16 @@ class ShardCoordinator:
         self.sched = sched
         self.replica_id = replica_id
         self.peers: Dict[str, object] = dict(peers or {})
+        self.vnodes = vnodes
         self.ring = HashRing([replica_id, *self.peers], vnodes)
+        # membership state (autoscaler-mutated): the ring above is the
+        # ACTIVE set; ``self.peers`` is the configured pool it scales
+        # within.  coordinate() snapshots ring+draining once per filter
+        # under this lock and never holds it across evaluation.
+        self._members_lock = make_lock("shard.members")
+        self._draining: frozenset = frozenset()
+        self._inflight: Dict[str, int] = {}
+        _ACTIVE_REPLICAS.set(float(len(self.ring.replicas)))
         # persistent fan-out workers: coordinate() runs on the /filter hot
         # path, and spawning+joining a Thread per peer per pod would pay
         # OS thread churn at every request
@@ -325,6 +446,67 @@ class ShardCoordinator:
         """This replica's subset of ``node_names`` under the ring."""
         me = self.replica_id
         return [n for n in node_names if self.ring.owner(n) == me]
+
+    # -- membership (autoscaler surface) --------------------------------
+    def active_ids(self) -> List[str]:
+        """Replica ids currently on the ring (sorted)."""
+        with self._members_lock:
+            return list(self.ring.replicas)
+
+    def set_active(self, rids: List[str]) -> None:
+        """Replace the active set — a wholesale ring rebuild.  Always
+        includes this replica; every other id must name a configured
+        peer (the autoscaler activates within the pool, it cannot
+        invent transports)."""
+        want = set(rids) | {self.replica_id}
+        unknown = want - {self.replica_id} - set(self.peers)
+        if unknown:
+            raise ValueError(f"unknown shard replicas: {sorted(unknown)}")
+        with self._members_lock:
+            self.ring = HashRing(sorted(want), self.vnodes)
+            self._draining = self._draining & want
+            _ACTIVE_REPLICAS.set(float(len(self.ring.replicas)))
+
+    def begin_retire(self, rid: str) -> None:
+        """Phase 1 of retirement: stop routing NEW filters to ``rid``
+        while the ring (and therefore any in-flight coordination's
+        commit targets) stays unchanged.  Phase 2 (:meth:`finish_retire`)
+        may run only once :meth:`inflight` drops to zero — dropping the
+        ring first would let an in-flight CAS commit at the retiree race
+        a new booking at the node's next owner."""
+        if rid == self.replica_id:
+            raise ValueError("a replica cannot retire itself from its own ring")
+        with self._members_lock:
+            if rid not in self.ring.replicas:
+                raise ValueError(f"{rid} is not active")
+            self._draining = self._draining | {rid}
+
+    def finish_retire(self, rid: str) -> None:
+        """Phase 2: drop the drained replica off the ring.  Only its
+        vnodes remap (consistent hashing)."""
+        with self._members_lock:
+            active = [r for r in self.ring.replicas if r != rid]
+        self.set_active(active)
+
+    def inflight(self, rid: str) -> int:
+        """Filters currently coordinating against ``rid`` (evaluate,
+        commit, or forward in flight)."""
+        with self._members_lock:
+            return self._inflight.get(rid, 0)
+
+    def _inflight_inc(self, rids: List[str]) -> None:
+        with self._members_lock:
+            for r in rids:
+                self._inflight[r] = self._inflight.get(r, 0) + 1
+
+    def _inflight_dec(self, rids: List[str]) -> None:
+        with self._members_lock:
+            for r in rids:
+                left = self._inflight.get(r, 0) - 1
+                if left > 0:
+                    self._inflight[r] = left
+                else:
+                    self._inflight.pop(r, None)
 
     def status(self) -> dict:
         """GET /shard body: ownership + ring shape (refreshes the
@@ -356,16 +538,113 @@ class ShardCoordinator:
         finally:
             _EVAL_HIST.observe(time.perf_counter() - t0, peer=rid)
 
+    def _try_forward(
+        self, rid: str, pod: dict, node_names: List[str]
+    ) -> Optional[Tuple[FilterResult, Optional[str], Dict[str, dict], bool]]:
+        """Forward the whole filter to majority-owner ``rid``.  Returns
+        the completed filter tuple, or None when the peer provably never
+        dispatched the request (safe to coordinate instead).  An
+        indeterminate loss fails the filter — see PeerIndeterminate."""
+        peer = self.peers[rid]
+        self._inflight_inc([rid])
+        try:
+            rep = peer.filter_forward(pod, list(node_names))
+        except PeerIndeterminate as e:
+            log.warning("shard: forward to %s indeterminate: %s", rid, e)
+            _FORWARDS.inc(peer=rid)
+            _FANOUT_NODES.observe(0)
+            return (
+                FilterResult(None, {}, f"shard forward to {rid}: {e}"),
+                None, {}, True,
+            )
+        except Exception as e:  # noqa: BLE001 — never sent: coordinate
+            log.warning(
+                "shard: forward to %s failed before dispatch (%s); "
+                "falling back to coordination", rid, e,
+            )
+            return None
+        finally:
+            self._inflight_dec([rid])
+        _FORWARDS.inc(peer=rid)
+        _FANOUT_NODES.observe(0)
+        failed = dict(rep.get("failed") or {})
+        node = rep.get("node")
+        if node:
+            verdicts = {node: {"fit": True, "chosen": True,
+                               "forwarded": rid}}
+            return (
+                FilterResult(node=node, failed=failed, error=""),
+                None, verdicts, True,
+            )
+        return (
+            FilterResult(
+                None, failed, rep.get("error") or "no node fits vtpu request"
+            ),
+            None, {}, True,
+        )
+
     def coordinate(
-        self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs
+        self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs,
+        allow_forward: bool = True,
     ) -> Tuple[FilterResult, Optional[str], Dict[str, dict], bool]:
         """Returns (result, enc — None when committed remotely or no
         booking, verdicts, committed_remote).  When committed_remote is
         True the owner replica already wrote the assignment annotations;
-        the caller must not patch again."""
+        the caller must not patch again.
+
+        ``allow_forward=False`` marks this replica as the TARGET of a
+        majority-owner forward: it must coordinate here and now, never
+        re-forward — forwarding depth is one hop by construction."""
         sched = self.sched
-        parts = self.ring.partition(node_names)
+        # one membership snapshot per filter: the autoscaler may rebuild
+        # the ring mid-flight, but THIS filter's routing, commits, and
+        # inflight accounting all run against the snapshot — and
+        # finish_retire waits for inflight==0, so the snapshot's commit
+        # targets stay valid until we are done
+        with self._members_lock:
+            ring = self.ring
+            draining = self._draining
+        parts = ring.partition(node_names)
+        failed: Dict[str, str] = {}
+        for rid in [r for r in parts if r in draining and r != self.replica_id]:
+            # a draining replica takes no new work; its nodes sit out
+            # this filter (they become schedulable again one ring-rebuild
+            # later, under their next owner)
+            for n in parts.pop(rid):
+                failed[n] = f"shard replica {rid} draining"
+        # majority-owner forwarding: when one PEER owns at least
+        # config.shard_forward_threshold of the candidates, ship the
+        # whole request there — 1 RPC instead of a fan-out + commit
+        thr = getattr(sched.config, "shard_forward_threshold", 2.0)
+        if allow_forward and node_names and 0 < thr <= 1.0:
+            peer_parts = [r for r in parts if r != self.replica_id]
+            if peer_parts:
+                big = max(peer_parts, key=lambda r: (len(parts[r]), r))
+                if (
+                    len(parts[big]) >= thr * len(node_names)
+                    and hasattr(self.peers.get(big), "filter_forward")
+                ):
+                    fwd = self._try_forward(big, pod, node_names)
+                    if fwd is not None:
+                        res, enc, verdicts, committed = fwd
+                        res.failed.update(failed)
+                        return res, enc, verdicts, committed
+        touched = [r for r in parts if r != self.replica_id]
+        self._inflight_inc(touched)
+        try:
+            return self._coordinate_inner(
+                pod, node_names, reqs, pod_annos, node_objs, parts, failed
+            )
+        finally:
+            self._inflight_dec(touched)
+
+    def _coordinate_inner(
+        self, pod: dict, node_names: List[str], reqs, pod_annos, node_objs,
+        parts: Dict[str, List[str]], failed: Dict[str, str],
+    ) -> Tuple[FilterResult, Optional[str], Dict[str, dict], bool]:
+        sched = self.sched
         local_names = parts.pop(self.replica_id, [])
+        _FANOUT_NODES.observe(float(sum(len(v) for v in parts.values())))
         remote: Dict[str, dict] = {}
         futures = [
             self._pool.submit(self._eval_one, rid, pod, names, remote)
@@ -373,9 +652,10 @@ class ShardCoordinator:
         ] if self._pool is not None else []
         # the local subset evaluates on this thread while peers work
         t0 = time.perf_counter()
-        local_best, failed, verdicts = sched._evaluate_candidates(
+        local_best, local_failed, verdicts = sched._evaluate_candidates(
             pod, local_names, reqs, pod_annos, node_objs
         )
+        failed.update(local_failed)
         _EVAL_HIST.observe(time.perf_counter() - t0, peer="local")
         for f in futures:
             f.result()
@@ -474,15 +754,237 @@ class ShardCoordinator:
         )
 
 
-class LeaderElector:
-    """Annotation-lease leader election for the write-back consumers.
+def prune_replica_metrics(coord: "ShardCoordinator", rid: str) -> None:
+    """Drop a retired replica's per-replica label sets from the
+    exposition — the stale-label pruning the frag/audit gauges already
+    do for dead nodes, applied to the shard families.  Without it a
+    replica retired an hour ago still exports its last evaluate
+    histogram and reconnect counter forever."""
+    _EVAL_HIST.remove(peer=rid)
+    _FORWARDS.remove(peer=rid)
+    peer = coord.peers.get(rid)
+    url = getattr(peer, "base_url", "")
+    if url:
+        _PEER_RECONNECTS.remove(peer=url)
 
-    The lease lives in ``vtpu.io/scheduler-leader`` on a dedicated
-    election Node object (created on demand): ``{"holder": id, "ts":
-    epoch}``.  Acquisition and renewal are resourceVersion-conditional
-    patches — two replicas racing the same lease serialize on the
-    apiserver exactly like the distributed node lock.  A lease older than
-    ``lease_s`` is up for grabs; the holder renews every ``lease_s / 3``.
+
+class ShardAutoscaler:
+    """Leader-driven replica autoscaling over a configured peer pool.
+
+    The same high/low-watermark + cooldown + min-floor machinery as the
+    router's prefill tier (vtpu/router — PR 10), pointed at the
+    scheduler's own replicas: *queue depth per active replica* is the
+    primary signal (the filter backlog the control plane is failing to
+    absorb), *evaluate-time saturation* from the
+    ``vtpu_shard_evaluate_seconds`` sums is the confirmation signal (a
+    deep queue with idle evaluators is a downstream stall, not a
+    capacity shortage — don't scale on it).
+
+    One transition per ``pump()``, then a cooldown: scale-up activates
+    the first inactive pool peer; scale-down begins a two-phase
+    retirement of the highest-id active peer (never this replica) and
+    finishes it — ring drop + metric-label pruning — on a later pump
+    once the retiree's in-flight coordinations drain."""
+
+    def __init__(
+        self,
+        coord: ShardCoordinator,
+        *,
+        queue_depth: Callable[[], int],
+        leader_gate: Optional[Callable[[], bool]] = None,
+        scale_high: Optional[float] = None,
+        scale_low: Optional[float] = None,
+        min_active: Optional[int] = None,
+        max_active: Optional[int] = None,
+        cooldown: Optional[int] = None,
+        busy_high: Optional[float] = None,
+        wallclock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.coord = coord
+        self.queue_depth = queue_depth
+        self.leader_gate = leader_gate
+        self.scale_high = (
+            env_float("VTPU_SHARD_SCALE_HIGH", 4.0)
+            if scale_high is None else scale_high
+        )
+        self.scale_low = (
+            env_float("VTPU_SHARD_SCALE_LOW", 1.0)
+            if scale_low is None else scale_low
+        )
+        self.min_active = max(1, (
+            env_int("VTPU_SHARD_MIN_REPLICAS", 1)
+            if min_active is None else min_active
+        ))
+        pool = 1 + len(coord.peers)
+        self.max_active = min(pool, (
+            env_int("VTPU_SHARD_MAX_REPLICAS", 16)
+            if max_active is None else max_active
+        ))
+        self.cooldown = max(0, (
+            env_int("VTPU_SHARD_SCALE_COOLDOWN", 3)
+            if cooldown is None else cooldown
+        ))
+        self.busy_high = (
+            env_float("VTPU_SHARD_BUSY_HIGH", 0.8)
+            if busy_high is None else busy_high
+        )
+        self._wallclock = wallclock
+        self._cooldown_left = 0
+        self._busy_prev: Dict[str, float] = {}
+        self._busy_prev_t: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- signals --------------------------------------------------------
+    def _eval_label(self, rid: str) -> str:
+        return "local" if rid == self.coord.replica_id else rid
+
+    def busy_ratio(self) -> float:
+        """Mean evaluator duty over the interval since the last call:
+        Δ(sum of vtpu_shard_evaluate_seconds) across active replicas,
+        divided by (interval × active count).  First call primes the
+        deltas and reports 0."""
+        now = self._wallclock()
+        active = self.coord.active_ids()
+        sums: Dict[str, float] = {}
+        for rid in active:
+            snap = _EVAL_HIST.snapshot(peer=self._eval_label(rid))
+            sums[rid] = snap["sum"] if snap else 0.0
+        prev_t, prev = self._busy_prev_t, self._busy_prev
+        self._busy_prev_t, self._busy_prev = now, sums
+        if prev_t is None or now <= prev_t:
+            return 0.0
+        delta = sum(
+            max(0.0, sums[rid] - prev.get(rid, 0.0)) for rid in active
+        )
+        return delta / ((now - prev_t) * max(1, len(active)))
+
+    # -- one decision ---------------------------------------------------
+    def pump(self) -> dict:
+        """One autoscaling step; returns the action taken (for the
+        bench's event journal and tests)."""
+        coord = self.coord
+        # finishing a drained retirement is not gated on leadership or
+        # cooldown — it completes a transition already decided, and
+        # holding a drained replica on the ring is pure staleness
+        for rid in sorted(coord._draining):
+            if coord.inflight(rid) == 0:
+                coord.finish_retire(rid)
+                prune_replica_metrics(coord, rid)
+                _AUTOSCALE.inc(action="retire_finish")
+                return {"action": "retire_finish", "replica": rid}
+        if self.leader_gate is not None and not self.leader_gate():
+            return {"action": "follower"}
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            return {"action": "cooldown", "left": self._cooldown_left}
+        active = coord.active_ids()
+        n = len(active)
+        depth = self.queue_depth()
+        per = depth / max(1, n)
+        busy = self.busy_ratio()
+        draining = set(coord._draining)
+        if n - len(draining) < self.max_active and (
+            per > self.scale_high
+            or (busy >= self.busy_high and per > self.scale_low)
+        ):
+            inactive = [
+                r for r in sorted(coord.peers)
+                if r not in active and r not in draining
+            ]
+            if inactive:
+                rid = inactive[0]
+                coord.set_active(active + [rid])
+                self._cooldown_left = self.cooldown
+                _AUTOSCALE.inc(action="up")
+                return {"action": "up", "replica": rid,
+                        "per": per, "busy": busy}
+        elif (
+            n - len(draining) > self.min_active
+            and per < self.scale_low
+            and busy < self.busy_high
+        ):
+            victims = [
+                r for r in reversed(active)
+                if r != coord.replica_id and r not in draining
+            ]
+            if victims:
+                rid = victims[0]
+                coord.begin_retire(rid)
+                self._cooldown_left = self.cooldown
+                _AUTOSCALE.inc(action="retire_begin")
+                return {"action": "retire_begin", "replica": rid,
+                        "per": per, "busy": busy}
+        return {"action": "hold", "per": per, "busy": busy}
+
+    # -- background loop (cmd/vtpu_scheduler.py) ------------------------
+    def start(self, interval_s: float = 5.0) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(interval_s):
+                try:
+                    self.pump()
+                except Exception:  # noqa: BLE001 — keep scaling
+                    log.exception("shard autoscaler pump error")
+
+        self._thread = threading.Thread(
+            target=loop, name="vtpu-shard-autoscaler", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: Optional[float] = 2.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+
+def _rfc3339(ts: float) -> str:
+    """Epoch seconds → the MicroTime form Lease spec fields carry.
+    Built from an explicit timestamp (never ``now()``) so injected
+    test/bench wallclocks serialize faithfully."""
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _parse_rfc3339(s: Optional[str]) -> Optional[float]:
+    if not s:
+        return None
+    raw = s[:-1] if s.endswith("Z") else s
+    fmt = "%Y-%m-%dT%H:%M:%S.%f" if "." in raw else "%Y-%m-%dT%H:%M:%S"
+    try:
+        return datetime.datetime.strptime(raw, fmt).replace(
+            tzinfo=datetime.timezone.utc
+        ).timestamp()
+    except ValueError:
+        return None
+
+
+class LeaderElector:
+    """Leader election for the write-back consumers.
+
+    Default path: a ``coordination.k8s.io/v1`` Lease object
+    (``vtpu-system/vtpu-scheduler``) — the primitive client-go's
+    leaderelection package CASes on.  Updates are resourceVersion-
+    conditional PUTs, so two replicas racing the same lease serialize on
+    the apiserver; a foreign lease whose ``renewTime`` is older than its
+    ``leaseDurationSeconds`` is up for grabs.
+
+    Rollback path (``VTPU_LEADER_ANNOTATION_LEASE=1``, or a client
+    without Lease verbs): the original bespoke lease —
+    ``vtpu.io/scheduler-leader`` annotation ``{"holder": id, "ts":
+    epoch}`` on a dedicated election Node, acquired with a
+    resourceVersion-conditional patch.  Identical freshness and CAS
+    semantics; only the storage object differs.
+
+    Either way the holder renews every ``lease_s / 3`` and
+    :meth:`is_leader` self-demotes when a renewal is older than the
+    lease window — two replicas never both believe they lead past one
+    lease period.
     """
 
     def __init__(
@@ -492,11 +994,24 @@ class LeaderElector:
         lease_s: float = DEFAULT_LEASE_S,
         wallclock: Callable[[], float] = time.time,
         lease_node: str = LEASE_NODE,
+        use_lease: Optional[bool] = None,
+        lease_name: str = "vtpu-scheduler",
+        lease_namespace: Optional[str] = None,
     ) -> None:
         self.client = client
         self.holder = holder
         self.lease_s = lease_s
         self.lease_node = lease_node
+        if use_lease is None:
+            use_lease = not env_bool("VTPU_LEADER_ANNOTATION_LEASE", False)
+        # graceful degrade: a client without the coordination.k8s.io
+        # verbs (older fake, restricted RBAC) falls back to the
+        # annotation lease instead of never electing anyone
+        self.use_lease = bool(use_lease) and hasattr(client, "get_lease")
+        self.lease_name = lease_name
+        self.lease_namespace = lease_namespace or env_str(
+            "VTPU_LEADER_LEASE_NAMESPACE", "vtpu-system"
+        )
         self._wallclock = wallclock
         self._lock = make_lock("shard.elector")
         self._leader = False
@@ -528,6 +1043,87 @@ class LeaderElector:
     def try_acquire(self) -> bool:
         """One acquisition/renewal attempt.  Returns the resulting
         leadership state."""
+        if self.use_lease:
+            return self._try_acquire_lease()
+        return self._try_acquire_annotation()
+
+    def _new_lease_body(self, now: float) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {
+                "name": self.lease_name,
+                "namespace": self.lease_namespace,
+            },
+            "spec": {
+                "holderIdentity": self.holder,
+                "leaseDurationSeconds": max(1, int(self.lease_s)),
+                "acquireTime": _rfc3339(now),
+                "renewTime": _rfc3339(now),
+                "leaseTransitions": 0,
+            },
+        }
+
+    def _try_acquire_lease(self) -> bool:
+        now = self._wallclock()
+        try:
+            lease = self.client.get_lease(
+                self.lease_name, self.lease_namespace
+            )
+        except NotFound:
+            try:
+                self.client.create_lease(self._new_lease_body(now))
+            except Conflict:
+                # lost the creation race — the winner holds a fresh lease
+                return self._set_leader(False, now)
+            except Exception:  # noqa: BLE001 — apiserver blip
+                log.exception("leader election: lease create failed")
+                return self._set_leader(False, now)
+            return self._set_leader(True, now)
+        except Exception:  # noqa: BLE001 — apiserver blip: drop leadership
+            log.exception("leader election: lease get failed")
+            return self._set_leader(False, now)
+        spec = lease.get("spec") or {}
+        held_by = spec.get("holderIdentity") or ""
+        try:
+            dur = float(spec.get("leaseDurationSeconds") or self.lease_s)
+        except (TypeError, ValueError):
+            dur = self.lease_s
+        renew_ts = _parse_rfc3339(spec.get("renewTime"))
+        if (
+            held_by
+            and held_by != self.holder
+            and renew_ts is not None
+            and now - renew_ts < dur
+        ):
+            return self._set_leader(False, now)  # fresh foreign lease
+        new_spec = dict(spec)
+        new_spec["holderIdentity"] = self.holder
+        new_spec["leaseDurationSeconds"] = max(1, int(self.lease_s))
+        new_spec["renewTime"] = _rfc3339(now)
+        if held_by != self.holder:
+            new_spec["acquireTime"] = _rfc3339(now)
+            try:
+                transitions = int(spec.get("leaseTransitions") or 0)
+            except (TypeError, ValueError):
+                transitions = 0
+            new_spec["leaseTransitions"] = transitions + 1
+        lease["spec"] = new_spec
+        try:
+            # resourceVersion-conditional PUT: the metadata carried from
+            # the read pins the exact lease we examined — a concurrent
+            # renewal/takeover turns this into a Conflict, not a clobber
+            self.client.update_lease(
+                self.lease_name, lease, self.lease_namespace
+            )
+        except (Conflict, NotFound):
+            return self._set_leader(False, now)  # lost the CAS race
+        except Exception:  # noqa: BLE001
+            log.exception("leader election: lease update failed")
+            return self._set_leader(False, now)
+        return self._set_leader(True, now)
+
+    def _try_acquire_annotation(self) -> bool:
         node = self._ensure_lease_obj()
         now = self._wallclock()
         if node is None:
@@ -582,6 +1178,14 @@ class LeaderElector:
             )
 
     def current_holder(self) -> str:
+        if self.use_lease:
+            try:
+                lease = self.client.get_lease(
+                    self.lease_name, self.lease_namespace
+                )
+            except Exception:  # noqa: BLE001 — absent or unreachable
+                return ""
+            return (lease.get("spec") or {}).get("holderIdentity") or ""
         node = self._ensure_lease_obj()
         if node is None:
             return ""
