@@ -10,7 +10,8 @@ PY ?= python3
 
 .PHONY: all build shim proto test test-slow test-all test-native bench \
 	bench-sched bench-serve bench-churn bench-disagg bench-gang \
-	bench-goodput bench-migrate bench-colo bench-planet bench-smoke \
+	bench-goodput bench-migrate bench-colo bench-planet bench-replay \
+	bench-smoke \
 	check obs-lint \
 	config-lint audit-check image chart clean tidy
 
@@ -189,6 +190,22 @@ ifdef SMOKE
 	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_planet.py --smoke
 else
 	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_planet.py
+endif
+
+# decision-trace replay regression gate: re-run the committed incident
+# bundle (tests/fixtures/incident_bundle, written by the real
+# IncidentRecorder via --record-fixture) through the real admission walk
+# and assert replayed-vs-recorded verdict agreement ≥ 0.99 →
+# docs/artifacts/scheduler_replay.json (docs/observability.md §Incident
+# bundles).  SMOKE=1 adds the assertion pass (tier-1 safe; also
+# exercised by tests/test_flight.py).
+bench-replay:
+ifdef SMOKE
+	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_planet.py \
+		--trace tests/fixtures/incident_bundle --smoke
+else
+	JAX_PLATFORMS=cpu $(PY) benchmarks/scheduler_planet.py \
+		--trace tests/fixtures/incident_bundle
 endif
 
 # serving decode-loop proof: paired pipeline_depth=0 vs pipelined runs

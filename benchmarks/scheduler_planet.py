@@ -73,15 +73,22 @@ from benchmarks.scheduler_churn import (  # noqa: E402
     node_names,
     pod_for,
 )
-from benchmarks.scheduler_scale import pct  # noqa: E402
+from benchmarks.scheduler_scale import pct, register_bench_node  # noqa: E402
+from vtpu.k8s import FakeClient, new_pod  # noqa: E402
 from vtpu.scheduler import Scheduler  # noqa: E402
 from vtpu.scheduler.shard import (  # noqa: E402
     _EVAL_HIST,
     ShardAutoscaler,
     ShardCoordinator,
 )
+from vtpu.utils.types import (  # noqa: E402
+    DEVICE_TYPE_PJRT,
+    MEM_PERCENTAGE_UNSET,
+    resources,
+)
 
 SCHEMA = "vtpu.scheduler_planet.v1"
+REPLAY_SCHEMA = "vtpu.scheduler_replay.v1"
 
 # -- virtual-time cost model (milliseconds) ---------------------------------
 # eval_us_per_node is seeded from the committed churn artifact's measured
@@ -513,6 +520,290 @@ def run_bench(n_nodes: int, pool: int, period_s: float,
     return res
 
 
+# ---------------------------------------------------------------------------
+# Decision-trace replay (--trace): the flight recorder's other half.  A
+# recorded decision journal — the VTPU_DECISION_JSONL mirror, or the
+# decisions.jsonl inside an incident bundle (vtpu/obs/incident.py) —
+# carries, per filter, the compact resource requests, the candidate set,
+# and every per-node verdict.  Replay rebuilds the arrival sequence and
+# drives it through a REAL Scheduler (real UsageCache CAS booking, real
+# candidate walk) while a shadow ShardAutoscaler rides the recorded
+# arrival curve on the virtual clock; the artifact reports replayed-vs-
+# recorded verdict and placement agreement.  The committed fixture
+# (tests/fixtures/incident_bundle, generated by --record-fixture against
+# the same synthetic geometry) must replay at agreement 1.0 — a drop is
+# a behaviour change in the admission walk.  For a production trace the
+# agreement ratio IS the diagnostic: it localises which verdicts the
+# current code would decide differently.
+# ---------------------------------------------------------------------------
+
+REPLAY_PATHS = ("fast", "general")   # singleton admission paths replayed
+REPLAY_POOL = 4                      # shadow autoscaler's replica pool
+
+
+def load_trace(path: str):
+    """Decision records from a bundle dir or a bare JSONL mirror.
+
+    A bundle carries its decision log as ``decisions.jsonl``; a bare
+    path is the ``VTPU_DECISION_JSONL`` mirror itself.  The rotation
+    predecessor (``<file>.1``) is read first when present, records are
+    deduped on ``seq`` (the sink serialises on its own lock, so lines
+    may interleave under contention) and returned seq-sorted."""
+    base = os.path.join(path, "decisions.jsonl") if os.path.isdir(path) \
+        else path
+    files = [f for f in (base + ".1", base) if os.path.exists(f)]
+    if not files:
+        raise FileNotFoundError(f"no decision journal at {path}")
+    by_seq: dict = {}
+    for fname in files:
+        with open(fname, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rec = json.loads(line)
+                    by_seq[rec.get("seq", len(by_seq))] = rec
+    return [by_seq[s] for s in sorted(by_seq)]
+
+
+def pod_from_record(rec: dict) -> dict:
+    """Invert the record's compact ``requests`` shape back into a pod
+    spec that ``resource_reqs`` parses to the identical request tuple
+    (vtpu/utils/resources.py is the round-trip contract)."""
+    containers = []
+    for ci, ctr in enumerate(rec["requests"]):
+        limits: dict = {}
+        for r in ctr:
+            if r["type"] == DEVICE_TYPE_PJRT:
+                limits[resources.pjrt_chip] = r["nums"]
+                if r["mem"] > 0:
+                    limits[resources.pjrt_memory] = r["mem"]
+            else:
+                limits[resources.chip] = r["nums"]
+                if r["mem"] > 0:
+                    limits[resources.memory] = r["mem"]
+                elif r["mem_pct"] != MEM_PERCENTAGE_UNSET:
+                    limits[resources.memory_percentage] = r["mem_pct"]
+                if r["cores"]:
+                    limits[resources.cores] = r["cores"]
+        containers.append({"name": f"c{ci}",
+                           "resources": {"limits": limits}})
+    return new_pod(
+        rec.get("pod") or f"replay-{rec['seq']}",
+        namespace=rec.get("namespace", "default"),
+        uid=rec.get("pod_uid") or f"replay-uid-{rec['seq']}",
+        containers=containers,
+    )
+
+
+def run_replay(trace_path: str, chips_per_node: int,
+               pump_interval: float) -> dict:
+    records = load_trace(trace_path)
+    replayable, skipped = [], Counter()
+    for rec in records:
+        if rec.get("path") not in REPLAY_PATHS:
+            # gang/besteffort admission and error-path records are not
+            # singleton walks; count them so truncation is never silent
+            skipped["path"] += 1
+        elif not rec.get("requests"):
+            skipped["no_requests"] += 1
+        elif not rec.get("verdicts"):
+            skipped["no_verdicts"] += 1
+        else:
+            replayable.append(rec)
+
+    # node universe: every node any recorded verdict touched, in first-
+    # seen order, rebuilt with the bench geometry
+    nodes: list = []
+    seen = set()
+    for rec in replayable:
+        for nm in rec["verdicts"]:
+            if nm not in seen:
+                seen.add(nm)
+                nodes.append(nm)
+    client = FakeClient()
+    for nm in nodes:
+        register_bench_node(client, nm, chips_per_node)
+    sched = Scheduler(client)
+    sched.register_from_node_annotations()
+    print(f"[replay] {len(replayable)}/{len(records)} records over "
+          f"{len(nodes)} nodes ({dict(skipped) or 'none skipped'})",
+          flush=True)
+
+    # virtual clock: the recorded inter-arrival times when the trace
+    # spans real time, an even synthetic pace when it was generated in
+    # one burst (the fixture) — either way the shadow autoscaler pumps
+    # on a meaningful timeline
+    ts0 = replayable[0]["ts"] if replayable else 0.0
+    span = (replayable[-1]["ts"] - ts0) if replayable else 0.0
+    synth = span < 1.0
+
+    rids = [f"r{i:02d}" for i in range(REPLAY_POOL)]
+    coord = ShardCoordinator(sched, rids[0],
+                             {r: _InertPeer() for r in rids[1:]})
+    coord.set_active(rids[:1])
+    vnow = [0.0]
+    arrivals: list = []
+    autoscaler = ShardAutoscaler(
+        coord,
+        queue_depth=lambda: sum(1 for a in arrivals if a > vnow[0] - 1.0),
+        leader_gate=None, scale_high=AS_SCALE_HIGH, scale_low=AS_SCALE_LOW,
+        min_active=1, max_active=REPLAY_POOL, cooldown=AS_COOLDOWN,
+        busy_high=AS_BUSY_HIGH, wallclock=lambda: vnow[0],
+    )
+    next_pump = pump_interval
+    pumps = 0
+    scale_events: list = []
+
+    vmatch = vtotal = pmatch = 0
+    mismatches: list = []
+    created: dict = {}
+    for i, rec in enumerate(replayable):
+        t = (i * 0.02) if synth else (rec["ts"] - ts0)
+        while next_pump <= t:
+            vnow[0] = next_pump
+            act = autoscaler.pump()
+            pumps += 1
+            if act["action"] not in ("hold", "cooldown", "follower"):
+                if len(scale_events) < 20:
+                    scale_events.append({
+                        "t": round(next_pump, 2), "action": act["action"],
+                        "replica": act.get("replica", ""),
+                    })
+            next_pump += pump_interval
+        vnow[0] = t
+        arrivals.append(t)
+        uid = rec.get("pod_uid") or f"replay-uid-{rec['seq']}"
+        pod = created.get(uid)
+        if pod is None:
+            # a re-filter of the same pod reuses the object the first
+            # record created, exactly like the live informer would
+            pod = client.create_pod(pod_from_record(rec))
+            created[uid] = pod
+        res = sched.filter(pod, list(rec["verdicts"]))
+        new = sched.decisions.query(pod=uid, n=1)
+        new_verdicts = new[-1].get("verdicts", {}) if new else {}
+        _EVAL_HIST.observe(
+            (new[-1].get("elapsed_ms", 0.0) if new else 0.0) / 1e3,
+            peer="local")
+        for nm, v in rec["verdicts"].items():
+            vtotal += 1
+            rv = new_verdicts.get(nm)
+            if rv is not None and bool(rv.get("fit")) == bool(v.get("fit")):
+                vmatch += 1
+            elif len(mismatches) < 10:
+                mismatches.append({
+                    "seq": rec["seq"], "node": nm,
+                    "recorded_fit": bool(v.get("fit")),
+                    "replayed_fit":
+                        None if rv is None else bool(rv.get("fit")),
+                })
+        if (res.node or None) == (rec.get("node") or None):
+            pmatch += 1
+        elif len(mismatches) < 10:
+            mismatches.append({
+                "seq": rec["seq"], "recorded_node": rec.get("node"),
+                "replayed_node": res.node,
+            })
+    for rid in rids:
+        _EVAL_HIST.remove(peer=rid)
+    _EVAL_HIST.remove(peer="local")
+
+    # same failover oracle as the synthetic arms: a fresh scheduler
+    # cold-starts off the annotation bus the replay left behind
+    rebuilt = Scheduler(client)
+    rebuilt.register_from_node_annotations()
+    rebuilt.ingest_pods()
+    audit = audit_summary(rebuilt)
+
+    n = len(replayable)
+    trace_rel = os.path.relpath(trace_path, REPO)
+    return {
+        "schema": REPLAY_SCHEMA,
+        "meta": {
+            "commit": git_rev(),
+            "measured": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "trace": trace_rel if not trace_rel.startswith("..")
+            else trace_path,
+            "chips_per_node": chips_per_node,
+            "nodes": len(nodes),
+            "records_total": len(records),
+            "replayed": n,
+            "skipped": {
+                "path": skipped["path"],
+                "no_requests": skipped["no_requests"],
+                "no_verdicts": skipped["no_verdicts"],
+            },
+        },
+        "agreement": {
+            "verdict_ratio": round(vmatch / vtotal, 5) if vtotal else 1.0,
+            "placement_ratio": round(pmatch / n, 5) if n else 1.0,
+            "verdicts_compared": vtotal,
+            "mismatches": mismatches,
+        },
+        "shadow_autoscaler": {
+            "pumps": pumps,
+            "scale_events": scale_events,
+            "final_active": len(coord.active_ids()),
+        },
+        "audit": audit,
+    }
+
+
+def record_fixture(out_dir: str) -> int:
+    """Generate the committed regression bundle: a deterministic 4-node
+    admission sequence recorded through a real Scheduler + DecisionLog,
+    frozen by the real IncidentRecorder — so the fixture's layout is
+    byte-for-byte what a production trigger writes, and ``--trace``
+    exercises the same loader a real incident would."""
+    import shutil
+
+    from vtpu.obs import slo as slo_mod
+    from vtpu.obs.flight import FlightRecorder
+    from vtpu.obs.incident import IncidentRecorder
+
+    client = build_client(4)
+    sched = Scheduler(client)
+    sched.register_from_node_annotations()
+    names = node_names(4)
+    fr = FlightRecorder(interval_s=1.0, window=64)
+    fr.sample_now()
+    # 96 single-chip pods at half a chip's HBM each: 64 admit (two per
+    # chip across 4 nodes × 8 chips), 32 reject — both verdict polarities
+    # are in the fixture.  Every third pod pins a 2-node candidate
+    # subset, so replay also covers narrowed candidate sets.
+    for i in range(96):
+        pod = client.create_pod(new_pod(
+            f"fix-{i:04d}", uid=f"fix-uid-{i:04d}",
+            containers=[{"name": "main", "resources": {"limits": {
+                resources.chip: 1,
+                resources.memory: 8192,
+                resources.cores: 25,
+            }}}]))
+        cand = ([names[i % 4], names[(i + 1) % 4]] if i % 3 == 0
+                else list(names))
+        sched.filter(pod, cand)
+        if i % 24 == 23:
+            fr.sample_now()
+    eng = slo_mod.activate(fr)
+    eng.evaluate()
+    staging = out_dir.rstrip("/") + ".staging"
+    rec = IncidentRecorder(directory=staging, cooldown_s=0.0,
+                           max_bundles=0)
+    rec.flight = fr
+    rec.add_source("decisions", sched.decisions.snapshot)
+    bundle = rec.trigger("fixture", {"records": len(sched.decisions)})
+    slo_mod.deactivate()
+    assert bundle, "fixture bundle write failed"
+    if os.path.isdir(out_dir):
+        shutil.rmtree(out_dir)
+    os.makedirs(os.path.dirname(out_dir) or ".", exist_ok=True)
+    shutil.move(bundle, out_dir)
+    shutil.rmtree(staging, ignore_errors=True)
+    print(f"[replay] fixture bundle at {out_dir} "
+          f"({len(sched.decisions)} decisions)")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="planet-scale trace-driven control-plane simulator")
@@ -527,11 +818,49 @@ def main(argv=None) -> int:
                    help="comma list (default: static_shard_1,static_shard_4,"
                         "static_shard_16,autoscale)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--trace", default="",
+                   help="replay a recorded decision journal instead of the "
+                        "synthetic diurnal trace: an incident bundle dir "
+                        "(VTPU_INCIDENT_DIR) or a VTPU_DECISION_JSONL "
+                        "mirror.  Writes the agreement artifact "
+                        "(default docs/artifacts/scheduler_replay.json)")
+    p.add_argument("--trace-chips", type=int, default=8,
+                   help="chips per replayed node in --trace mode (the "
+                        "committed fixture was recorded at 8)")
+    p.add_argument("--record-fixture", default="", metavar="DIR",
+                   help="generate the deterministic regression bundle that "
+                        "--trace replays (tests/fixtures/incident_bundle)")
     p.add_argument("--smoke", action="store_true",
                    help="seconds-long run: 2000 nodes, pool 4, 10s period")
     p.add_argument("--out", default=os.path.join(
         REPO, "docs", "artifacts", "scheduler_planet.json"))
     args = p.parse_args(argv)
+
+    if args.record_fixture:
+        return record_fixture(args.record_fixture)
+    if args.trace:
+        out = args.out
+        if out == os.path.join(REPO, "docs", "artifacts",
+                               "scheduler_planet.json"):
+            out = os.path.join(REPO, "docs", "artifacts",
+                               "scheduler_replay.json")
+        res = run_replay(args.trace, args.trace_chips, args.pump_interval)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(res, f, indent=1)
+            f.write("\n")
+        ag = res["agreement"]
+        print(f"[replay] wrote {out}: verdict agreement "
+              f"{ag['verdict_ratio']} placement {ag['placement_ratio']} "
+              f"audit-ok {res['audit']['ok']}")
+        if args.smoke:
+            assert res["schema"] == REPLAY_SCHEMA
+            assert res["meta"]["replayed"] > 0
+            assert ag["verdict_ratio"] >= 0.99, ag
+            assert ag["placement_ratio"] >= 0.99, ag
+            assert res["audit"]["ok"], res["audit"]
+            print("[replay] smoke assertions passed")
+        return 0
 
     if args.smoke:
         args.nodes = min(args.nodes, 2000)

@@ -21,7 +21,7 @@ import signal
 import sys
 import threading
 
-from vtpu.utils.envs import env_str
+from vtpu.utils.envs import env_float, env_str
 
 
 def main(argv=None) -> int:
@@ -42,6 +42,12 @@ def main(argv=None) -> int:
                    help="collector URL to POST this daemon's trace-span "
                         "ring to (the scheduler's /spans/ingest; env "
                         "VTPU_SPAN_SINK)")
+    flight_default = env_float("VTPU_FLIGHT_SAMPLE_S", 0.0)
+    p.add_argument("--flight-sample", type=float, default=flight_default,
+                   help="flight-recorder sampling interval in seconds "
+                        "(env VTPU_FLIGHT_SAMPLE_S; <= 0 disables the "
+                        "plane).  The monitor's recorder feeds /slo and "
+                        "incident bundles on this node's debug listener")
     p.add_argument("--debug", action="store_true")
     args = p.parse_args(argv)
 
@@ -75,6 +81,12 @@ def main(argv=None) -> int:
         from vtpu.obs.http import start_span_pusher
 
         start_span_pusher(args.span_sink)
+    if args.flight_sample > 0:
+        from vtpu.obs import flight as obs_flight
+
+        obs_flight.start_plane("monitor", interval_s=args.flight_sample)
+        logging.info("flight plane on: sampling every %ss",
+                     args.flight_sample)
     sampler = None
     if not args.disable_util_sampler:
         from vtpu.monitor.sampler import UtilizationSampler
@@ -123,6 +135,10 @@ def main(argv=None) -> int:
         sampler.stop()
     if fb:
         fb.stop()
+    if args.flight_sample > 0:
+        from vtpu.obs import flight as obs_flight
+
+        obs_flight.stop_plane()
     pm.close()
     return 0
 

@@ -94,7 +94,22 @@ def main(argv=None) -> int:
                    default=env_str("VTPU_EVENT_JSONL"),
                    help="append every journal event as one JSON line to "
                         "this file (env VTPU_EVENT_JSONL); empty disables "
-                        "the mirror — the in-memory ring always runs")
+                        "the mirror — the in-memory ring always runs. "
+                        "VTPU_EVENT_JSONL_MAX_BYTES caps the file with "
+                        "keep-one-previous rotation")
+    p.add_argument("--decision-jsonl",
+                   default=env_str("VTPU_DECISION_JSONL"),
+                   help="mirror every placement decision (full per-node "
+                        "verdicts + placement + utilization snapshot) as "
+                        "one JSON line to this file (env "
+                        "VTPU_DECISION_JSONL); the mirror is what "
+                        "benchmarks/scheduler_planet.py --trace replays")
+    flight_default = env_float("VTPU_FLIGHT_SAMPLE_S", 0.0)
+    p.add_argument("--flight-sample", type=float, default=flight_default,
+                   help="flight-recorder sampling interval in seconds "
+                        "(env VTPU_FLIGHT_SAMPLE_S; <= 0 disables the "
+                        "whole plane — recorder, SLO engine, incident "
+                        "triggers).  Bundles land under VTPU_INCIDENT_DIR")
     p.add_argument("--debug", action="store_true")
     args = p.parse_args(argv)
     if bool(args.cert_file) != bool(args.key_file):
@@ -131,6 +146,23 @@ def main(argv=None) -> int:
     sched = Scheduler(client, cfg)
     if args.audit_interval is not None:
         sched.auditor.interval_s = args.audit_interval
+    if args.decision_jsonl:
+        from vtpu.scheduler.decisions import DecisionLog
+
+        sched.decisions = DecisionLog(jsonl_path=args.decision_jsonl)
+    if args.flight_sample > 0:
+        # flight recorder + SLO burn-rate engine + incident triggers, one
+        # bootstrap (vtpu/obs/flight.start_plane); the decision log rides
+        # along as a bundle source so incidents replay via --trace
+        from vtpu.obs import flight as obs_flight
+
+        obs_flight.start_plane(
+            "scheduler",
+            sources={"decisions": sched.decisions.snapshot},
+            interval_s=args.flight_sample,
+        )
+        logging.info("flight plane on: sampling every %ss",
+                     args.flight_sample)
     replica_id = args.replica_id or "r0"
     if args.leader_election:
         from vtpu.scheduler.shard import LeaderElector
@@ -229,6 +261,10 @@ def main(argv=None) -> int:
     autoscaler = getattr(sched, "shard_autoscaler", None)
     if autoscaler is not None:
         autoscaler.stop()
+    if args.flight_sample > 0:
+        from vtpu.obs import flight as obs_flight
+
+        obs_flight.stop_plane()
     sched.stop()
     return 0
 

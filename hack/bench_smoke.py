@@ -60,6 +60,12 @@ BENCHES = {
         [sys.executable, "benchmarks/scheduler_planet.py", "--smoke"],
         {"JAX_PLATFORMS": "cpu"},
     ),
+    "replay": (
+        "scheduler_replay.json",
+        [sys.executable, "benchmarks/scheduler_planet.py", "--trace",
+         "tests/fixtures/incident_bundle", "--smoke"],
+        {"JAX_PLATFORMS": "cpu"},
+    ),
     "gang": (
         "scheduler_gang.json",
         [sys.executable, "benchmarks/scheduler_gang.py", "--smoke"],

@@ -1,0 +1,484 @@
+"""Flight recorder plane: JSONL rotation, ring-overwrite counters, the
+flight sampling ring, SLO burn-rate evaluation with edge-triggered
+breaches, triggered incident bundles (cooldown + pruning + default
+trigger wiring), deep readiness for the sampler/engine threads, the
+/slo + /incidents + format=jsonl query surfaces, and the decision-trace
+replay round-trip (the bench-replay smoke twin over the committed
+fixture bundle)."""
+
+import json
+import os
+import urllib.request
+
+from benchmarks.scheduler_planet import (
+    REPLAY_SCHEMA,
+    load_trace,
+    main as planet_main,
+    record_fixture,
+    run_replay,
+)
+from vtpu.k8s import FakeClient, new_node, new_pod
+from vtpu.obs import registry
+from vtpu.obs.events import EventJournal, EventType
+from vtpu.obs import flight as flight_mod
+from vtpu.obs import slo as slo_mod
+from vtpu.obs.flight import DEFAULT_FAMILIES, FlightRecorder, family_key
+from vtpu.obs.incident import IncidentRecorder, install_default_triggers
+from vtpu.obs.jsonl import RotatingJsonlSink
+from vtpu.obs.ready import readiness
+from vtpu.obs.slo import SLOEngine
+from vtpu.scheduler.config import SchedulerConfig
+from vtpu.scheduler.core import Scheduler
+from vtpu.scheduler.decisions import DecisionLog
+from vtpu.scheduler.routes import serve
+from vtpu.utils import codec
+from vtpu.utils.types import ChipInfo, annotations as A, resources as R
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "incident_bundle")
+
+
+def _cluster(chips=2):
+    client = FakeClient()
+    client.create_node(new_node("n1"))
+    enc = codec.encode_node_devices([
+        ChipInfo(uuid=f"tpu-{j}", count=4, hbm_mb=16384, cores=100,
+                 type="TPU-v5e", health=True)
+        for j in range(chips)
+    ])
+    client.patch_node_annotations(
+        "n1", {A.NODE_HANDSHAKE: "Reported 2026-08-01T00:00:00Z",
+               A.NODE_REGISTER: enc},
+    )
+    sched = Scheduler(client, SchedulerConfig(http_bind="127.0.0.1:0"))
+    sched.register_from_node_annotations()
+    return client, sched
+
+
+def _chip_pod(name, uid=None, mem=1024):
+    return new_pod(
+        name, uid=uid or f"uid-{name}",
+        containers=[{"name": "main", "resources": {
+            "limits": {R.chip: 1, R.memory: mem}}}],
+    )
+
+
+def _clock(start=1000.0, step=1.0):
+    """Deterministic wallclock: start, start+step, ..."""
+    state = {"t": start - step}
+
+    def tick():
+        state["t"] += step
+        return state["t"]
+
+    return tick
+
+
+# -- RotatingJsonlSink ----------------------------------------------------
+
+
+def test_sink_rotates_at_max_bytes(tmp_path):
+    path = tmp_path / "j.jsonl"
+    sink = RotatingJsonlSink(str(path), max_bytes=200)
+    for i in range(20):
+        sink.write({"seq": i, "pad": "x" * 40})
+    sink.close()
+    assert path.exists() and os.path.exists(str(path) + ".1")
+    assert sink.rotations >= 1
+    # keep-one-previous: current + .1 together hold a contiguous tail
+    recs = []
+    for f in (str(path) + ".1", str(path)):
+        recs += [json.loads(ln) for ln in open(f).read().splitlines()]
+    seqs = [r["seq"] for r in recs]
+    assert seqs == sorted(seqs) and seqs[-1] == 19
+    assert os.path.getsize(path) <= 200
+
+
+def test_sink_dead_after_oserror(tmp_path):
+    sink = RotatingJsonlSink(str(tmp_path))  # a dir: open() fails
+    sink.write({"a": 1})
+    assert sink.dead
+    sink.write({"a": 2})  # no raise
+    sink.close()
+
+
+def test_event_jsonl_rotation_via_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("VTPU_EVENT_JSONL_MAX_BYTES", "300")
+    sink = tmp_path / "ev.jsonl"
+    j = EventJournal(cap=512, jsonl_path=str(sink))
+    for i in range(30):
+        j.emit(EventType.POD_FILTERED, "scheduler", pod=f"u{i:04d}",
+               pad="y" * 30)
+    j.close()
+    assert os.path.exists(str(sink) + ".1")
+    assert os.path.getsize(sink) <= 300
+
+
+# -- ring-overwrite counters ---------------------------------------------
+
+
+def test_events_overwritten_counter():
+    ctr = registry("obs").counter("vtpu_events_overwritten_total", "t")
+    before = ctr.value()
+    j = EventJournal(cap=4)
+    for i in range(10):
+        j.emit(EventType.POD_FILTERED, "scheduler", pod=f"o{i}")
+    assert ctr.value() == before + 6
+
+
+def test_decisions_overwritten_counter():
+    ctr = registry("scheduler").counter(
+        "vtpu_decisions_overwritten_total", "t")
+    before = ctr.value()
+    log = DecisionLog(cap=4)
+    for i in range(10):
+        log.record(pod=f"p{i}", verdicts={})
+    assert ctr.value() == before + 6
+    assert len(log) == 4
+
+
+# -- decision JSONL mirror + query surface --------------------------------
+
+
+def test_decision_jsonl_mirror_and_since(tmp_path):
+    sink = tmp_path / "dec.jsonl"
+    clock = _clock(start=100.0)
+    log = DecisionLog(cap=8, jsonl_path=str(sink), wallclock=clock)
+    for i in range(5):
+        log.record(pod=f"d{i}", pod_uid=f"ud{i}", verdicts={"n1": {}})
+    log.close()
+    lines = [json.loads(ln) for ln in sink.read_text().splitlines()]
+    assert [ln["pod"] for ln in lines] == [f"d{i}" for i in range(5)]
+    assert lines[0]["seq"] == 1 and lines[0]["ts"] == 100.0
+    # since= filters on ts before the count cut
+    assert [r["pod"] for r in log.query(since=103.0)] == ["d3", "d4"]
+    body = log.decisions_body({"since": "103.0", "format": "jsonl"})
+    recs = [json.loads(ln) for ln in body.decode().splitlines()]
+    assert [r["pod"] for r in recs] == ["d3", "d4"]
+    # default shape unchanged
+    doc = json.loads(log.decisions_body({"n": "2"}))
+    assert doc["count"] == 2
+
+
+def test_decisions_endpoint_since_and_jsonl_wire():
+    client, sched = _cluster()
+    srv, _ = serve(sched)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        for i in range(3):
+            pod = client.create_pod(_chip_pod(f"dw{i}"))
+            assert sched.filter(pod, ["n1"]).node == "n1"
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/decisions?n=50", timeout=10).read())
+        cut = doc["decisions"][-1]["ts"]
+        doc2 = json.loads(urllib.request.urlopen(
+            f"{base}/decisions?since={cut}", timeout=10).read())
+        assert doc2["count"] == 1
+        req = urllib.request.urlopen(
+            f"{base}/decisions?format=jsonl&n=2", timeout=10)
+        assert req.headers["Content-Type"].startswith(
+            "application/x-ndjson")
+        recs = [json.loads(ln) for ln in req.read().decode().splitlines()]
+        assert len(recs) == 2 and recs[-1]["requests"][0][0]["nums"] == 1
+    finally:
+        srv.shutdown()
+
+
+def test_decision_records_carry_requests_shape():
+    client, sched = _cluster()
+    pod = client.create_pod(_chip_pod("shape", mem=2048))
+    sched.filter(pod, ["n1"])
+    rec = sched.decisions.query(pod="uid-shape", n=1)[0]
+    assert rec["requests"] == [[{
+        "nums": 1, "type": "TPU", "mem": 2048, "mem_pct": 101,
+        "cores": 0,
+    }]]
+
+
+# -- flight recorder ------------------------------------------------------
+
+
+def test_flight_ring_bounded_and_self_describing():
+    clock = _clock(start=0.0, step=5.0)
+    fr = FlightRecorder(interval_s=5.0, window=4, wallclock=clock)
+    assert fr.enabled
+    ctr = registry("obs").counter("vtpu_flight_samples_total", "t")
+    before = ctr.value()
+    for _ in range(10):
+        fr.sample_now()
+    assert len(fr) == 4 and ctr.value() == before + 10
+    series = fr.series()
+    assert series[0]["ts"] < series[-1]["ts"]
+    # declared families that exist in-process are captured with kinds
+    key = family_key("scheduler", "vtpu_filter_seconds")
+    assert series[-1]["families"][key]["kind"] == "histogram"
+    # at_or_before: exact, between, and before-the-ring lookups
+    assert fr.at_or_before(series[0]["ts"])["ts"] == series[0]["ts"]
+    assert fr.at_or_before(series[0]["ts"] - 100)["ts"] == series[0]["ts"]
+    assert fr.at_or_before(series[-1]["ts"] + 1)["ts"] == series[-1]["ts"]
+
+
+def test_flight_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("VTPU_FLIGHT_SAMPLE_S", raising=False)
+    fr = FlightRecorder()
+    assert not fr.enabled
+    assert fr.start("scheduler") is False  # no thread, no readiness check
+    assert flight_mod.start_plane("scheduler") is None
+    assert flight_mod.recorder() is None
+    # /slo reports the plane off instead of erroring
+    doc = json.loads(slo_mod.slo_body({}))
+    assert doc == {"enabled": False,
+                   "detail": "flight plane off (set VTPU_FLIGHT_SAMPLE_S "
+                             "> 0)"}
+
+
+# -- SLO engine -----------------------------------------------------------
+
+
+def _drift_breach_setup(clock):
+    """A flight+engine pair where bumping the audit-drift counter between
+    samples breaches the zero-tolerance objective."""
+    fr = FlightRecorder(interval_s=5.0, window=64, wallclock=clock)
+    eng = SLOEngine(fr, fast_window_s=10.0, slow_window_s=20.0,
+                    burn_threshold=1.0, eval_interval_s=5.0,
+                    wallclock=clock)
+    drift = registry("scheduler").counter("vtpu_audit_drift_total", "t")
+    return fr, eng, drift
+
+
+def test_slo_breach_is_edge_triggered():
+    clock = _clock(start=0.0, step=5.0)
+    fr, eng, drift = _drift_breach_setup(clock)
+    for _ in range(6):
+        fr.sample_now()
+    rep = eng.evaluate()
+    assert rep["objectives"]["audit_zero_drift"]["breached"] is False
+
+    breaches = registry("obs").counter("vtpu_slo_breaches_total", "t")
+    before = breaches.value(slo="audit_zero_drift")
+    fired = []
+    eng.on_breach.append(lambda name, entry: fired.append(name))
+    drift.inc(2)
+    fr.sample_now()
+    rep = eng.evaluate()
+    obj = rep["objectives"]["audit_zero_drift"]
+    assert obj["breached"] and obj["windows"]["fast"]["bad"] == 2.0
+    assert fired == ["audit_zero_drift"]
+    assert breaches.value(slo="audit_zero_drift") == before + 1
+    burn = registry("obs").gauge("vtpu_slo_burn_rate_ratio", "t")
+    assert burn.value(slo="audit_zero_drift", window="fast") >= 1.0
+    # sustained breach: no second increment until it clears
+    fr.sample_now()
+    eng.evaluate()
+    assert breaches.value(slo="audit_zero_drift") == before + 1
+
+
+def test_slo_burn_rate_latency_objective():
+    clock = _clock(start=0.0, step=5.0)
+    fr = FlightRecorder(interval_s=5.0, window=64, wallclock=clock)
+    eng = SLOEngine(fr, fast_window_s=10.0, slow_window_s=20.0,
+                    eval_interval_s=5.0, wallclock=clock)
+    hist = registry("scheduler").histogram("vtpu_filter_seconds", "t")
+    fr.sample_now()
+    for _ in range(100):
+        hist.observe(0.001, path="fast")   # all good: burn 0
+    fr.sample_now()
+    rep = eng.evaluate()
+    obj = rep["objectives"]["filter_p99"]
+    assert obj["windows"]["fast"]["burn"] == 0.0
+    for _ in range(50):
+        hist.observe(10.0, path="fast")    # half bad: burn ≫ 1
+    fr.sample_now()
+    rep = eng.evaluate()
+    assert rep["objectives"]["filter_p99"]["windows"]["fast"]["burn"] > 1.0
+
+
+# -- incident bundles -----------------------------------------------------
+
+
+def _bundle_files(path):
+    return sorted(os.listdir(path))
+
+
+def test_trigger_writes_complete_bundle(tmp_path):
+    clock = _clock(start=0.0, step=5.0)
+    fr = FlightRecorder(interval_s=5.0, window=8, wallclock=clock)
+    fr.sample_now()
+    log = DecisionLog(cap=8)
+    log.record(pod="inc-p", verdicts={"n1": {"fit": True}})
+    rec = IncidentRecorder(directory=str(tmp_path / "inc"),
+                           cooldown_s=300.0, wallclock=clock)
+    rec.flight = fr
+    rec.add_source("decisions", log.snapshot)
+    path = rec.trigger("unit_test", {"why": "test"})
+    assert path and os.path.isdir(path)
+    assert _bundle_files(path) == [
+        "decisions.jsonl", "events.jsonl", "meta.json", "series.json",
+        "slo.json", "spans.json",
+    ]
+    meta = json.load(open(os.path.join(path, "meta.json")))
+    assert meta["reason"] == "unit_test" and meta["detail"] == {"why": "test"}
+    assert "git_rev" in meta and isinstance(meta["env"], dict)
+    series = json.load(open(os.path.join(path, "series.json")))
+    assert len(series) == 1 and "families" in series[0]
+    dec = [json.loads(ln) for ln in
+           open(os.path.join(path, "decisions.jsonl")).read().splitlines()]
+    assert dec[0]["pod"] == "inc-p"
+    # the bundle announces itself in the journal
+    from vtpu.obs import events as ev
+    recs = ev.journal().query(type=EventType.INCIDENT_RECORDED, n=5)
+    assert any(r.get("bundle") == path for r in recs)
+
+    # cooldown: the next trigger is suppressed and counted
+    sup = registry("obs").counter("vtpu_incident_suppressed_total", "t")
+    before = sup.value()
+    assert rec.trigger("unit_test") is None
+    assert sup.value() == before + 1
+    # past the cooldown the next excursion is captured again
+    for _ in range(70):
+        clock()
+    assert rec.trigger("unit_test_2") is not None
+    assert len(rec.list()) == 2
+    body = json.loads(rec.list_body({}))
+    assert body["count"] == 2 and body["enabled"]
+
+
+def test_incident_pruning_and_disabled(tmp_path):
+    clock = _clock(start=0.0, step=400.0)
+    rec = IncidentRecorder(directory=str(tmp_path / "cap"), cooldown_s=0.0,
+                           max_bundles=2, wallclock=clock)
+    paths = [rec.trigger(f"r{i}") for i in range(4)]
+    assert all(paths)
+    left = rec.list()
+    assert len(left) == 2
+    assert [b["reason"] for b in left] == ["r2", "r3"]
+    # unset dir = disabled: no write, no cooldown state
+    off = IncidentRecorder(directory=None)
+    assert not off.enabled and off.trigger("nope") is None
+
+
+def test_default_triggers_slo_and_cas_spike(tmp_path, monkeypatch):
+    monkeypatch.setenv("VTPU_INCIDENT_CAS_ABORT_SPIKE", "5")
+    clock = _clock(start=0.0, step=5.0)
+    fr, eng, drift = _drift_breach_setup(clock)
+    rec = IncidentRecorder(directory=str(tmp_path / "auto"),
+                           cooldown_s=0.0, wallclock=clock)
+    install_default_triggers(fr, eng, rec)
+    assert rec.flight is fr
+    fr.sample_now()
+    drift.inc(3)
+    fr.sample_now()
+    eng.evaluate()   # breach → on_breach → bundle
+    reasons = [b["reason"] for b in rec.list()]
+    assert "slo:audit_zero_drift" in reasons
+
+    aborts = registry("scheduler").counter(
+        "vtpu_filter_cas_aborts_total", "t")
+    aborts.inc(7)    # ≥ spike threshold between consecutive samples
+    fr.sample_now()
+    reasons = [b["reason"] for b in rec.list()]
+    assert "cas_abort_spike" in reasons
+
+
+# -- deep readiness -------------------------------------------------------
+
+
+def test_flight_and_slo_readiness_checks():
+    comp = "flighttest"
+    fr = FlightRecorder(interval_s=0.05, window=8)
+    eng = SLOEngine(fr, eval_interval_s=0.05)
+    try:
+        assert fr.start(comp) and eng.start(comp)
+        deadline = __import__("time").time() + 5.0
+        while __import__("time").time() < deadline:
+            rep = readiness(comp).report()
+            if rep["ok"]:
+                break
+            __import__("time").sleep(0.05)
+        assert rep["ok"], rep
+        assert set(rep["checks"]) == {"flight_sampler", "slo_engine"}
+    finally:
+        fr.stop()
+        eng.stop()
+    # degraded path: dead threads fail their checks (503 on /readyz)
+    rep = readiness(comp).report()
+    assert not rep["ok"]
+    assert not rep["checks"]["flight_sampler"]["ok"]
+    assert not rep["checks"]["slo_engine"]["ok"]
+    readiness(comp).unregister("flight_sampler")
+    readiness(comp).unregister("slo_engine")
+
+
+# -- /slo and /incidents on the extender wire -----------------------------
+
+
+def test_slo_and_incidents_endpoints(tmp_path):
+    from vtpu.obs import incident as incident_mod
+
+    _client, sched = _cluster()
+    srv, _ = serve(sched)
+    clock = _clock(start=0.0, step=5.0)
+    fr = FlightRecorder(interval_s=5.0, window=8, wallclock=clock)
+    fr.sample_now()
+    try:
+        eng = slo_mod.activate(fr, eval_interval_s=5.0, wallclock=clock)
+        eng.evaluate()
+        incident_mod.configure(directory=str(tmp_path / "wire"),
+                               cooldown_s=0.0)
+        incident_mod.recorder().flight = fr
+        incident_mod.recorder().trigger("wire_test")
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/slo", timeout=10).read())
+        assert "objectives" in doc and "filter_p99" in doc["objectives"]
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/incidents", timeout=10).read())
+        assert doc["count"] == 1
+        assert doc["incidents"][0]["reason"] == "wire_test"
+    finally:
+        slo_mod.deactivate()
+        incident_mod.configure(directory=None)
+        srv.shutdown()
+
+
+# -- decision-trace replay ------------------------------------------------
+
+
+def test_committed_fixture_is_a_real_bundle():
+    names = _bundle_files(FIXTURE)
+    assert names == [
+        "decisions.jsonl", "events.jsonl", "meta.json", "series.json",
+        "slo.json", "spans.json",
+    ]
+    recs = load_trace(FIXTURE)
+    assert len(recs) == 96
+    assert [r["seq"] for r in recs] == list(range(1, 97))
+    fits = sum(1 for r in recs if r["node"])
+    assert 0 < fits < 96  # both verdict polarities are in the fixture
+
+
+def test_replay_round_trip(tmp_path):
+    out_dir = str(tmp_path / "bundle")
+    record_fixture(out_dir)
+    res = run_replay(out_dir, chips_per_node=8, pump_interval=0.25)
+    assert res["schema"] == REPLAY_SCHEMA
+    assert res["meta"]["replayed"] == 96
+    assert res["agreement"]["verdict_ratio"] == 1.0
+    assert res["agreement"]["placement_ratio"] == 1.0
+    assert res["agreement"]["mismatches"] == []
+    assert res["audit"]["ok"]
+    assert res["shadow_autoscaler"]["pumps"] > 0
+
+
+def test_bench_replay_smoke_twin(tmp_path):
+    """`make bench-replay SMOKE=1` twin over the COMMITTED fixture — a
+    behaviour change in the admission walk fails here first."""
+    out = str(tmp_path / "scheduler_replay.json")
+    assert planet_main(["--trace", FIXTURE, "--smoke", "--out", out]) == 0
+    res = json.load(open(out))
+    committed = json.load(open(os.path.join(
+        os.path.dirname(FIXTURE), "..", "..", "docs", "artifacts",
+        "scheduler_replay.json")))
+    assert res["schema"] == committed["schema"] == REPLAY_SCHEMA
+    assert res["agreement"]["verdict_ratio"] >= 0.99
+    assert set(res) == set(committed)

@@ -49,7 +49,10 @@ class ObsDocsPass(ProjectPass):
         import vtpu.shim.runtime  # noqa: F401 — pacing/quota histograms
         from vtpu.obs import all_registries, lint_names, registry
         from vtpu.obs.events import EVENT_TYPES
+        from vtpu.obs.flight import FlightRecorder
+        from vtpu.obs.incident import IncidentRecorder
         from vtpu.obs.ready import readiness
+        from vtpu.obs.slo import SLOEngine
 
         # the cross-component "obs" families register lazily on first
         # emit/report — instantiate them so the checks cover them too
@@ -57,6 +60,14 @@ class ObsDocsPass(ProjectPass):
             "vtpu_events_total",
             "Journal events emitted by component and type",
         )
+        registry("obs").counter(
+            "vtpu_events_overwritten_total",
+            "Events evicted from the capped ring by newer emits",
+        )
+        # the flight plane's families register when an entrypoint starts
+        # it; throwaway disabled instances register the same names
+        SLOEngine(FlightRecorder(interval_s=0.0))
+        IncidentRecorder(directory=None)
         readiness("scheduler")
 
         doc_rel = DOC

@@ -36,6 +36,7 @@ import os
 import time
 from typing import Deque, List, Optional
 
+from vtpu.obs.jsonl import RotatingJsonlSink
 from vtpu.obs.registry import registry
 from vtpu.analysis.witness import make_lock
 from vtpu.utils import trace
@@ -85,6 +86,8 @@ class EventType:
     SESSION_MIGRATION_FAILED = "SessionMigrationFailed"  # a move failed typed (restored on the source, or ambiguous)
     # co-location bridge (vtpu/serving/colo.py)
     EVICT_MIGRATED = "EvictMigrated"  # an evict-requested annotation became Router.request_evict; the replica's sessions migrated
+    # flight recorder (vtpu/obs/incident.py)
+    INCIDENT_RECORDED = "IncidentRecorded"  # a trigger fired and a bundle was written under VTPU_INCIDENT_DIR
 
 
 EVENT_TYPES = frozenset(
@@ -116,10 +119,13 @@ class EventJournal:
         # the sink has its own lock so emitters on the scheduler's hot
         # path never queue behind another thread's disk flush on the
         # ring lock; under contention file lines may land out of seq
-        # order — every record carries "seq", consumers sort on it
-        self._sink_lock = make_lock("obs.events_sink")
-        self._sink = None          # lazily opened append handle
-        self._sink_dead = False    # one warning, then the mirror stays off
+        # order — every record carries "seq", consumers sort on it.
+        # Rotation (VTPU_EVENT_JSONL_MAX_BYTES, keep-one-previous) and
+        # the first-OSError disable live in the shared RotatingJsonlSink.
+        self._sink: Optional[RotatingJsonlSink] = (
+            RotatingJsonlSink(self.jsonl_path, lock_name="obs.events_sink")
+            if self.jsonl_path else None
+        )
 
     # -- emit -----------------------------------------------------------
     def emit(
@@ -153,8 +159,21 @@ class EventJournal:
             if ctx:
                 rec["trace"] = ctx
             rec.update(fields)
+            overwrote = len(self._dq) == self.cap
             self._dq.append(rec)
-        self._write_sink(rec)  # disk I/O stays off the ring lock
+        if overwrote:
+            # the ring silently dropped its oldest event — count it so a
+            # post-mortem knows when VTPU_EVENT_LOG_CAP was too small
+            try:
+                registry("obs").counter(
+                    "vtpu_events_overwritten_total",
+                    "Events evicted from the capped ring by newer emits "
+                    "(the window was smaller than the incident)",
+                ).inc()
+            except Exception:  # noqa: BLE001
+                log.debug("overwrite counter failed", exc_info=True)
+        if self._sink is not None:
+            self._sink.write(rec)  # disk I/O stays off the ring lock
         try:
             registry("obs").counter(
                 "vtpu_events_total",
@@ -164,23 +183,6 @@ class EventJournal:
         except Exception:  # noqa: BLE001 — counting must not break emitters
             log.debug("event counter failed", exc_info=True)
         return rec
-
-    def _write_sink(self, rec: dict) -> None:
-        if self.jsonl_path is None or self._sink_dead:
-            return
-        line = json.dumps(rec, default=str) + "\n"
-        with self._sink_lock:
-            try:
-                if self._sink is None:
-                    self._sink = open(self.jsonl_path, "a", encoding="utf-8")
-                self._sink.write(line)
-                self._sink.flush()
-            except OSError:
-                # one warning, then stop trying: a full disk must not
-                # turn every event emit into a failing syscall
-                self._sink_dead = True
-                log.warning("event JSONL sink %s failed; disabling mirror",
-                            self.jsonl_path, exc_info=True)
 
     # -- query (GET /events) --------------------------------------------
     def query(
@@ -205,7 +207,11 @@ class EventJournal:
         return recs[-n:] if n else []
 
     def events_body(self, params: dict) -> bytes:
-        """JSON body for ``GET /events?pod=&type=&since=&n=``."""
+        """Body for ``GET /events?pod=&type=&since=&n=&format=``.
+
+        Default is one JSON document; ``format=jsonl`` yields one record
+        per line (NDJSON) so external scrapers can tail the surface with
+        the same parser they use on the VTPU_EVENT_JSONL mirror."""
         try:
             n = int(params.get("n", 100))
         except ValueError:
@@ -222,6 +228,10 @@ class EventJournal:
             since=since,
             n=n,
         )
+        if params.get("format") == "jsonl":
+            return b"".join(
+                json.dumps(r, default=str).encode() + b"\n" for r in recs
+            )
         return json.dumps(
             {"events": recs, "count": len(recs)}, default=str
         ).encode()
@@ -248,14 +258,18 @@ class EventJournal:
             })
         return out
 
+    def snapshot(self) -> List[dict]:
+        """The full ring, oldest-first — the incident bundler's freeze."""
+        with self._lock:
+            return list(self._dq)
+
+    @property
+    def _sink_dead(self) -> bool:
+        return self._sink is not None and self._sink.dead
+
     def close(self) -> None:
-        with self._sink_lock:
-            if self._sink is not None:
-                try:
-                    self._sink.close()
-                except OSError:
-                    pass
-                self._sink = None
+        if self._sink is not None:
+            self._sink.close()
 
     def __len__(self) -> int:
         with self._lock:

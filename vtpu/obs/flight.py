@@ -1,0 +1,275 @@
+"""Flight recorder: a bounded time-series ring over the metric registries.
+
+Prometheus families answer "what is the value NOW"; nothing in-process
+remembers what the value was thirty seconds ago, so the SLO engine
+(vtpu/obs/slo.py) would have no window to compute burn rates over and an
+incident bundle (vtpu/obs/incident.py) would carry a single point instead
+of the curve that led to the trigger.  The FlightRecorder closes that
+gap: every ``VTPU_FLIGHT_SAMPLE_S`` seconds (≤ 0 = off, the default — off
+means no thread, no lock traffic, zero hot-path cost) it snapshots a
+*declared* set of families — filter/bind latency histograms, CAS/shed/
+audit counters, free-rectangle gauges — into a ring of
+``VTPU_FLIGHT_WINDOW`` samples.
+
+Each sample is self-describing::
+
+    {"ts": …, "families": {
+        "scheduler/vtpu_filter_seconds": {
+            "kind": "histogram", "bounds": […],
+            "samples": [{"labels": {…}, "buckets": [cumulative…],
+                         "sum": …, "count": …}]},
+        "serving/vtpu_router_sheds_total": {
+            "kind": "counter",
+            "samples": [{"labels": {…}, "value": …}]}}}
+
+so a bundle's ``series.json`` replays into any offline tool without the
+registry objects.  ``start_plane`` is the entrypoint bootstrap: recorder
++ SLO engine + incident triggers in one call, each gated on its env.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Callable, Deque, List, Optional, Sequence, Tuple
+
+from vtpu.analysis.witness import make_lock
+from vtpu.obs.ready import readiness
+from vtpu.obs.registry import Counter, Gauge, Histogram, registry
+from vtpu.utils.envs import env_float, env_int
+
+log = logging.getLogger(__name__)
+
+ENV_SAMPLE_S = "VTPU_FLIGHT_SAMPLE_S"
+ENV_WINDOW = "VTPU_FLIGHT_WINDOW"
+DEFAULT_WINDOW = 720  # e.g. 1 h of 5 s samples
+
+# The declared sampling set: every family an SLO objective or incident
+# trigger reads.  Families that do not exist yet in this process (the
+# monitor has no scheduler registry) are skipped per sample — declaring
+# a family here never creates it.
+DEFAULT_FAMILIES: Tuple[Tuple[str, str], ...] = (
+    ("scheduler", "vtpu_filter_seconds"),
+    ("scheduler", "vtpu_bind_seconds"),
+    ("scheduler", "vtpu_filter_cas_conflicts_total"),
+    ("scheduler", "vtpu_filter_cas_retries_total"),
+    ("scheduler", "vtpu_filter_cas_aborts_total"),
+    ("scheduler", "vtpu_audit_drift_total"),
+    ("scheduler", "vtpu_node_largest_free_rectangle_ratio"),
+    ("serving", "vtpu_router_requests_total"),
+    ("serving", "vtpu_router_sheds_total"),
+    ("serving", "vtpu_session_migrations_total"),
+    ("obs", "vtpu_events_total"),
+)
+
+
+def family_key(reg_name: str, family: str) -> str:
+    return f"{reg_name}/{family}"
+
+
+class FlightRecorder:
+    """Samples declared metric families into a bounded ring."""
+
+    def __init__(
+        self,
+        interval_s: Optional[float] = None,
+        window: Optional[int] = None,
+        families: Sequence[Tuple[str, str]] = DEFAULT_FAMILIES,
+        wallclock=time.time,
+    ) -> None:
+        if interval_s is None:
+            interval_s = env_float(ENV_SAMPLE_S, 0.0)
+        if window is None:
+            window = env_int(ENV_WINDOW, DEFAULT_WINDOW)
+        self.interval_s = interval_s
+        self.window = max(2, window)
+        self.families = tuple(families)
+        self._wallclock = wallclock
+        self._lock = make_lock("obs.flight_ring")
+        self._ring: Deque[dict] = collections.deque(maxlen=self.window)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._last_sample_t: Optional[float] = None
+        # on_sample(sample, prev_sample_or_None) — the incident plane's
+        # delta triggers (CAS-abort spikes, fresh DriftDetected events)
+        self.on_sample: List[Callable[[dict, Optional[dict]], None]] = []
+        self._samples_total = registry("obs").counter(
+            "vtpu_flight_samples_total",
+            "Flight-recorder samples taken (the ring itself is capped by "
+            "VTPU_FLIGHT_WINDOW)",
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.interval_s > 0
+
+    # -- sampling -------------------------------------------------------
+    def sample_now(self) -> dict:
+        """Take one sample synchronously (the loop body; also the test
+        and bundle-fixture surface — no thread required)."""
+        fams = {}
+        for reg_name, fam in self.families:
+            inst = registry(reg_name).get(fam)
+            if inst is None:
+                continue
+            if isinstance(inst, Histogram):
+                fams[family_key(reg_name, fam)] = {
+                    "kind": "histogram",
+                    "bounds": list(inst.bounds),
+                    "samples": inst.series_snapshot(),
+                }
+            elif isinstance(inst, (Counter, Gauge)):
+                fams[family_key(reg_name, fam)] = {
+                    "kind": (
+                        "counter" if isinstance(inst, Counter) else "gauge"
+                    ),
+                    "samples": [
+                        {"labels": lbl, "value": v}
+                        for lbl, v in inst.samples()
+                    ],
+                }
+        sample = {"ts": self._wallclock(), "families": fams}
+        with self._lock:
+            prev = self._ring[-1] if self._ring else None
+            self._ring.append(sample)
+            self._last_sample_t = sample["ts"]
+        self._samples_total.inc()
+        for cb in list(self.on_sample):
+            try:
+                cb(sample, prev)
+            except Exception:  # noqa: BLE001 — a trigger must not kill the loop
+                log.warning("flight on_sample callback failed", exc_info=True)
+        return sample
+
+    # -- query ----------------------------------------------------------
+    def series(self) -> List[dict]:
+        """The full ring, oldest-first (bundle ``series.json``)."""
+        with self._lock:
+            return list(self._ring)
+
+    def latest(self) -> Optional[dict]:
+        with self._lock:
+            return self._ring[-1] if self._ring else None
+
+    def at_or_before(self, ts: float) -> Optional[dict]:
+        """Newest sample with ``sample.ts <= ts``, else the oldest sample
+        (the burn-rate baseline when the ring is younger than the
+        window), else None on an empty ring."""
+        with self._lock:
+            ring = list(self._ring)
+        best = None
+        for s in ring:
+            if s["ts"] <= ts:
+                best = s
+            else:
+                break
+        if best is None and ring:
+            return ring[0]
+        return best
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, component: str = "scheduler") -> bool:
+        """Start the sampling thread (no-op when interval ≤ 0) and
+        register the ``flight_sampler`` deep-readiness check: thread
+        alive + a sample within 3 intervals."""
+        if not self.enabled or self._thread is not None:
+            return False
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="vtpu-flight", daemon=True
+        )
+        self._thread.start()
+        readiness(component).register("flight_sampler", self._ready_check)
+        return True
+
+    def _ready_check(self):
+        t = self._thread
+        if t is None or not t.is_alive():
+            return False, "sampler thread not running"
+        with self._lock:
+            last = self._last_sample_t
+        if last is None:
+            return False, "no sample yet"
+        age = self._wallclock() - last
+        if age > 3 * self.interval_s:
+            return False, f"last sample {age:.1f}s ago (interval {self.interval_s}s)"
+        return True, f"last sample {age:.1f}s ago"
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sample_now()
+            except Exception:  # noqa: BLE001 — keep sampling
+                log.warning("flight sample failed", exc_info=True)
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+
+# -- process-wide plane bootstrap ---------------------------------------
+
+_recorder: Optional[FlightRecorder] = None
+_plane_lock = make_lock("obs.flight_plane")
+
+
+def recorder() -> Optional[FlightRecorder]:
+    """The process flight recorder, or None when the plane never started."""
+    with _plane_lock:
+        return _recorder
+
+
+def start_plane(
+    component: str = "scheduler",
+    sources: Optional[dict] = None,
+    interval_s: Optional[float] = None,
+    families: Sequence[Tuple[str, str]] = DEFAULT_FAMILIES,
+) -> Optional[FlightRecorder]:
+    """Entrypoint bootstrap: flight recorder + SLO engine + incident
+    triggers, each gated on its env.  Returns None (and starts nothing)
+    when ``VTPU_FLIGHT_SAMPLE_S`` ≤ 0 — the off-by-default contract.
+
+    ``sources`` maps bundle section names to zero-arg callables returning
+    record lists (e.g. ``{"decisions": sched.decisions.snapshot}``) and is
+    forwarded to the incident recorder."""
+    from vtpu.obs import incident as incident_mod
+    from vtpu.obs import slo as slo_mod
+
+    global _recorder
+    with _plane_lock:
+        if _recorder is not None:
+            return _recorder
+        rec = FlightRecorder(interval_s=interval_s, families=families)
+        if not rec.enabled:
+            return None
+        _recorder = rec
+    engine = slo_mod.activate(rec, component=component)
+    bundler = incident_mod.recorder()
+    for name, fn in (sources or {}).items():
+        bundler.add_source(name, fn)
+    incident_mod.install_default_triggers(rec, engine, bundler)
+    rec.start(component)
+    engine.start(component)
+    return rec
+
+
+def stop_plane() -> None:
+    """Tear the plane down (tests and entrypoint shutdown)."""
+    from vtpu.obs import slo as slo_mod
+
+    global _recorder
+    with _plane_lock:
+        rec, _recorder = _recorder, None
+    if rec is not None:
+        rec.stop()
+    slo_mod.deactivate()

@@ -1,8 +1,9 @@
 """Shared debug/observability HTTP surface.
 
 One implementation of the ``/spans`` (+ ``?n=`` / ``?name=`` filters),
-``/timeline?pod=<uid>``, ``/events?pod=&type=&since=`` (the typed event
-journal), ``/readyz`` (deep readiness), ``/trace.json`` (Chrome export)
+``/timeline?pod=<uid>``, ``/events?pod=&type=&since=&format=`` (the typed
+event journal), ``/slo`` (burn-rate report), ``/incidents`` (recorded
+bundles), ``/readyz`` (deep readiness), ``/trace.json`` (Chrome export)
 and registry ``/metrics`` endpoints, used three ways:
 
 - the scheduler extender's listener (vtpu/scheduler/routes.py) delegates
@@ -109,7 +110,19 @@ def handle_debug_get(
         elif route == "/events":
             from vtpu.obs import events as events_mod
 
-            send(200, events_mod.journal().events_body(params),
+            ctype = (
+                "application/x-ndjson" if params.get("format") == "jsonl"
+                else "application/json"
+            )
+            send(200, events_mod.journal().events_body(params), ctype)
+        elif route == "/slo":
+            from vtpu.obs import slo as slo_mod
+
+            send(200, slo_mod.slo_body(params), "application/json")
+        elif route == "/incidents":
+            from vtpu.obs import incident as incident_mod
+
+            send(200, incident_mod.incidents_body(params),
                  "application/json")
         elif route == "/readyz" and ready_components:
             from vtpu.obs.ready import readyz_body

@@ -1,0 +1,340 @@
+"""Triggered incident bundles: freeze every ring into one directory.
+
+When the control plane misbehaves, the diagnosis window is exactly as
+long as the in-memory rings — by the time a human attaches, the spans,
+events, decisions, and metric history that explain the excursion have
+been overwritten.  This module writes them out *at trigger time*: one
+self-contained bundle directory under ``VTPU_INCIDENT_DIR`` (unset =
+disabled) holding
+
+- ``meta.json`` — timestamp, trigger reason + detail, git revision, pid,
+  and a snapshot of every ``VTPU_*`` env var (the config that produced
+  the behaviour),
+- ``events.jsonl`` — the event-journal ring,
+- ``series.json`` — the flight recorder's metric time-series window,
+- ``spans.json`` — the span ring,
+- ``slo.json`` — the SLO engine's last burn-rate report,
+- one ``<name>.jsonl`` per registered source (the scheduler registers
+  ``decisions`` → the decision log, so a bundle replays straight through
+  ``benchmarks/scheduler_planet.py --trace <bundle>``).
+
+Triggers (``install_default_triggers``): an SLO burn-rate breach, a
+fresh ``DriftDetected`` event between flight samples, or a CAS-abort
+spike (``VTPU_INCIDENT_CAS_ABORT_SPIKE`` aborts between consecutive
+samples).  ``VTPU_INCIDENT_COOLDOWN_S`` (default 300 s) rate-limits
+bundle writes — a sustained breach produces one bundle per cooldown, not
+one per evaluation — and ``VTPU_INCIDENT_MAX_BUNDLES`` (default 16)
+prunes the oldest so the directory is bounded.  ``GET /incidents`` lists
+what was captured.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import shutil
+import subprocess
+import time
+from typing import Callable, Dict, List, Optional
+
+from vtpu.analysis.witness import make_lock
+from vtpu.obs import events as events_mod
+from vtpu.obs.registry import registry
+from vtpu.utils import trace
+from vtpu.utils.envs import env_float, env_int, env_str
+
+log = logging.getLogger(__name__)
+
+ENV_DIR = "VTPU_INCIDENT_DIR"
+ENV_COOLDOWN_S = "VTPU_INCIDENT_COOLDOWN_S"
+ENV_CAS_ABORT_SPIKE = "VTPU_INCIDENT_CAS_ABORT_SPIKE"
+ENV_MAX_BUNDLES = "VTPU_INCIDENT_MAX_BUNDLES"
+
+_CAS_ABORTS_KEY = "scheduler/vtpu_filter_cas_aborts_total"
+_EVENTS_KEY = "obs/vtpu_events_total"
+
+
+def _git_rev() -> str:
+    try:
+        return subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stderr=subprocess.DEVNULL,
+        ).decode().strip()
+    except Exception:  # noqa: BLE001 — prod containers ship no .git
+        return "unknown"
+
+
+def _sanitize(reason: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]+", "-", reason).strip("-") or "trigger"
+
+
+class IncidentRecorder:
+    """Writes trigger-time bundles under one bounded directory."""
+
+    def __init__(
+        self,
+        directory: Optional[str] = None,
+        cooldown_s: Optional[float] = None,
+        max_bundles: Optional[int] = None,
+        wallclock=time.time,
+    ) -> None:
+        self.directory = (
+            directory if directory is not None else env_str(ENV_DIR)
+        ) or None
+        self.cooldown_s = (
+            cooldown_s if cooldown_s is not None
+            else env_float(ENV_COOLDOWN_S, 300.0)
+        )
+        self.max_bundles = (
+            max_bundles if max_bundles is not None
+            else env_int(ENV_MAX_BUNDLES, 16)
+        )
+        self._wallclock = wallclock
+        self._lock = make_lock("obs.incident")
+        self._last_trigger_t: Optional[float] = None
+        # bundle section name -> zero-arg callable returning record list
+        self._sources: Dict[str, Callable[[], List[dict]]] = {}
+        # the flight recorder whose ring becomes series.json (set by
+        # start_plane; falls back to the module global when unset)
+        self.flight = None
+        reg = registry("obs")
+        self._bundles = reg.counter(
+            "vtpu_incident_bundles_total",
+            "Incident bundles written, by trigger reason",
+        )
+        self._suppressed = reg.counter(
+            "vtpu_incident_suppressed_total",
+            "Incident triggers suppressed by the VTPU_INCIDENT_COOLDOWN_S "
+            "rate limit (the excursion was already captured)",
+        )
+
+    @property
+    def enabled(self) -> bool:
+        return self.directory is not None
+
+    def add_source(self, name: str, fn: Callable[[], List[dict]]) -> None:
+        """Register a bundle section: ``fn()`` returns the records written
+        to ``<name>.jsonl`` at trigger time (e.g. the decision log's
+        ``snapshot``).  Re-registering a name replaces it."""
+        self._sources[_sanitize(name)] = fn
+
+    # -- trigger --------------------------------------------------------
+    def trigger(self, reason: str, detail: Optional[dict] = None,
+                ) -> Optional[str]:
+        """Freeze the rings into one bundle.  Returns the bundle path, or
+        None when disabled / inside the cooldown / the write failed."""
+        if not self.enabled:
+            return None
+        now = self._wallclock()
+        with self._lock:
+            if (
+                self._last_trigger_t is not None
+                and now - self._last_trigger_t < self.cooldown_s
+            ):
+                self._suppressed.inc()
+                return None
+            self._last_trigger_t = now
+        try:
+            path = self._write_bundle(now, reason, detail)
+        except OSError:
+            log.warning("incident bundle write failed", exc_info=True)
+            return None
+        self._bundles.inc(trigger=_sanitize(reason))
+        try:
+            events_mod.emit(
+                events_mod.EventType.INCIDENT_RECORDED, "obs",
+                reason=reason, bundle=path,
+            )
+        except Exception:  # noqa: BLE001 — the bundle already exists
+            log.debug("IncidentRecorded emit failed", exc_info=True)
+        return path
+
+    def _write_bundle(
+        self, now: float, reason: str, detail: Optional[dict]
+    ) -> str:
+        name = f"incident-{int(now * 1000)}-{_sanitize(reason)}"
+        path = os.path.join(self.directory, name)
+        os.makedirs(path, exist_ok=True)
+
+        def dump(fname: str, obj: object) -> None:
+            with open(os.path.join(path, fname), "w", encoding="utf-8") as f:
+                json.dump(obj, f, default=str, indent=1)
+
+        def dump_jsonl(fname: str, recs: List[dict]) -> None:
+            with open(os.path.join(path, fname), "w", encoding="utf-8") as f:
+                for r in recs:
+                    f.write(json.dumps(r, default=str) + "\n")
+
+        dump("meta.json", {
+            "ts": now,
+            "reason": reason,
+            "detail": detail,
+            "git_rev": _git_rev(),
+            "pid": os.getpid(),
+            "env": {
+                k: v for k, v in sorted(os.environ.items())
+                if k.startswith("VTPU_")
+            },
+        })
+        dump_jsonl("events.jsonl", events_mod.journal().snapshot())
+        flight = self.flight
+        if flight is None:
+            from vtpu.obs import flight as flight_mod
+            flight = flight_mod.recorder()
+        dump("series.json", flight.series() if flight is not None else [])
+        dump("spans.json", trace.recent_spans(n=0))  # n=0 = the full ring
+        from vtpu.obs import slo as slo_mod
+        eng = slo_mod.engine()
+        dump("slo.json", eng.last_report() if eng is not None else None)
+        for sname, fn in self._sources.items():
+            try:
+                dump_jsonl(f"{sname}.jsonl", list(fn()))
+            except Exception:  # noqa: BLE001 — one dead source must not lose the rest
+                log.warning("incident source %s failed", sname, exc_info=True)
+        self._prune()
+        return path
+
+    @staticmethod
+    def _bundle_order(name: str):
+        """Sort key: the millisecond timestamp embedded in the bundle
+        name, numerically (lexicographic order breaks when prefixes have
+        different digit counts — synthetic test clocks)."""
+        try:
+            return (0, int(name.split("-", 2)[1]), name)
+        except (IndexError, ValueError):
+            return (1, 0, name)
+
+    def _prune(self) -> None:
+        """Keep the newest ``max_bundles`` bundle dirs (ordered by the
+        millisecond timestamp in the name)."""
+        if self.max_bundles <= 0:
+            return
+        bundles = self.list()
+        for b in bundles[: max(0, len(bundles) - self.max_bundles)]:
+            shutil.rmtree(
+                os.path.join(self.directory, b["name"]), ignore_errors=True
+            )
+
+    # -- query (GET /incidents) -----------------------------------------
+    def list(self) -> List[dict]:
+        """Bundles on disk, oldest-first: name + parsed meta summary."""
+        if not self.enabled or not os.path.isdir(self.directory):
+            return []
+        out = []
+        for name in sorted(os.listdir(self.directory),
+                           key=self._bundle_order):
+            if not name.startswith("incident-"):
+                continue
+            entry = {"name": name}
+            try:
+                with open(
+                    os.path.join(self.directory, name, "meta.json"),
+                    encoding="utf-8",
+                ) as f:
+                    meta = json.load(f)
+                entry["ts"] = meta.get("ts")
+                entry["reason"] = meta.get("reason")
+                entry["git_rev"] = meta.get("git_rev")
+            except (OSError, ValueError):
+                entry["reason"] = "unreadable"
+            out.append(entry)
+        return out
+
+    def list_body(self, params: dict) -> bytes:
+        recs = self.list()
+        return json.dumps({
+            "enabled": self.enabled,
+            "dir": self.directory,
+            "cooldown_s": self.cooldown_s,
+            "incidents": recs,
+            "count": len(recs),
+        }, default=str).encode()
+
+
+# -- process-wide recorder ----------------------------------------------
+
+_recorder: Optional[IncidentRecorder] = None
+_recorder_lock = make_lock("obs.incident_global")
+
+
+def recorder() -> IncidentRecorder:
+    """The process incident recorder (created on first use from the env)."""
+    global _recorder
+    with _recorder_lock:
+        if _recorder is None:
+            _recorder = IncidentRecorder()
+        return _recorder
+
+
+def configure(
+    directory: Optional[str] = None,
+    cooldown_s: Optional[float] = None,
+    max_bundles: Optional[int] = None,
+) -> IncidentRecorder:
+    """Replace the process recorder (entrypoints with explicit flags,
+    tests that need a private dir/cooldown)."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = IncidentRecorder(
+            directory=directory, cooldown_s=cooldown_s,
+            max_bundles=max_bundles,
+        )
+        return _recorder
+
+
+def incidents_body(params: dict) -> bytes:
+    """Body for ``GET /incidents`` on any debug listener."""
+    return recorder().list_body(params)
+
+
+# -- default trigger wiring ---------------------------------------------
+
+def install_default_triggers(flight, slo_engine, rec: IncidentRecorder,
+                             ) -> None:
+    """Wire the three trigger families into one recorder:
+
+    - SLO burn-rate breach (edge-triggered by the engine),
+    - a fresh ``DriftDetected`` event between consecutive flight samples,
+    - a CAS-abort spike: ≥ ``VTPU_INCIDENT_CAS_ABORT_SPIKE`` aborts
+      between consecutive samples."""
+    rec.flight = flight
+    spike = env_int(ENV_CAS_ABORT_SPIKE, 10)
+
+    def on_breach(name: str, entry: dict) -> None:
+        rec.trigger(f"slo:{name}", entry)
+
+    def _counter_total(sample: Optional[dict], key: str,
+                       flt: Optional[dict] = None) -> float:
+        if sample is None:
+            return 0.0
+        fam = sample["families"].get(key)
+        if fam is None:
+            return 0.0
+        total = 0.0
+        for s in fam["samples"]:
+            if flt and any(s["labels"].get(k) != v for k, v in flt.items()):
+                continue
+            total += s["value"]
+        return total
+
+    def on_sample(sample: dict, prev: Optional[dict]) -> None:
+        if prev is None:
+            return
+        aborts = _counter_total(sample, _CAS_ABORTS_KEY) - _counter_total(
+            prev, _CAS_ABORTS_KEY
+        )
+        if spike > 0 and aborts >= spike:
+            rec.trigger("cas_abort_spike", {"aborts": aborts,
+                                            "threshold": spike})
+            return
+        drift_type = {"type": events_mod.EventType.DRIFT_DETECTED}
+        drifts = _counter_total(sample, _EVENTS_KEY, drift_type) - \
+            _counter_total(prev, _EVENTS_KEY, drift_type)
+        if drifts > 0:
+            rec.trigger("drift_detected", {"new_drift_events": drifts})
+
+    slo_engine.on_breach.append(on_breach)
+    flight.on_sample.append(on_sample)

@@ -126,6 +126,11 @@ class Counter(_Instrument):
         with self._lock:
             self._values.pop(_label_key(labels), None)
 
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        """Every (labels, value) pair — the flight recorder's scrape."""
+        with self._lock:
+            return [(dict(lbl), v) for lbl, v in self._values.values()]
+
     def render(self, lines: List[str]) -> None:
         with self._lock:
             samples = [(dict(lbl), v) for lbl, v in self._values.values()]
@@ -160,6 +165,11 @@ class Gauge(_Instrument):
         without this a dead pod's last value would be exported forever."""
         with self._lock:
             self._values.pop(_label_key(labels), None)
+
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        """Every (labels, value) pair — the flight recorder's scrape."""
+        with self._lock:
+            return [(dict(lbl), v) for lbl, v in self._values.values()]
 
     def render(self, lines: List[str]) -> None:
         with self._lock:
@@ -230,6 +240,27 @@ class Histogram(_Instrument):
                 cum.append(acc)
             return {"buckets": cum, "sum": s.sum, "count": s.count}
 
+    def series_snapshot(self) -> List[dict]:
+        """Every label set's cumulative state — the flight recorder's
+        scrape.  ``buckets`` are cumulative counts aligned with
+        ``self.bounds`` + the implicit +Inf."""
+        with self._lock:
+            series = [
+                (dict(s.labels), list(s.counts), s.sum, s.count)
+                for s in self._series.values()
+            ]
+        out = []
+        for labels, counts, total, count in series:
+            cum, acc = [], 0
+            for c in counts:
+                acc += c
+                cum.append(acc)
+            out.append({
+                "labels": labels, "buckets": cum,
+                "sum": total, "count": count,
+            })
+        return out
+
     def render(self, lines: List[str]) -> None:
         lines.append(f"# HELP {self.name} {self.help}")
         lines.append(f"# TYPE {self.name} histogram")
@@ -294,6 +325,12 @@ class Registry:
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._instruments)
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        """The registered instrument, or None — so the flight recorder
+        can sample declared families without creating empty ones."""
+        with self._lock:
+            return self._instruments.get(name)
 
     def render(self) -> str:
         """Exposition for every instrument, name-sorted (deterministic)."""

@@ -1,0 +1,379 @@
+"""SLO burn-rate engine over the flight-recorder ring.
+
+A point-in-time metric cannot say "we are eating the error budget 10×
+faster than sustainable"; the standard answer (Google SRE workbook's
+multi-window multi-burn-rate alerts) needs history, which is exactly what
+the FlightRecorder keeps.  This engine declares the stack's objectives —
+
+- ``filter_p99``: filter latency ≤ ``VTPU_SLO_FILTER_P99_S`` for 99 % of
+  runs (over ``scheduler/vtpu_filter_seconds``, all paths),
+- ``bind_success``: ≥ 99 % of bind attempts succeed
+  (``PodBound`` vs ``BindFailed`` journal counters),
+- ``router_shed``: ≥ 99 % of router requests are admitted, not shed,
+- ``migration_failure``: ≥ 95 % of session migrations land
+  (``migrated``/``fallback`` vs ``failed``/``ambiguous`` outcomes),
+- ``audit_zero_drift``: the reconciliation auditor finds **zero** drift
+  (any ``vtpu_audit_drift_total`` delta is a breach) —
+
+and evaluates each as a burn rate over a fast (``VTPU_SLO_FAST_WINDOW_S``,
+default 60 s) and a slow (``VTPU_SLO_SLOW_WINDOW_S``, default 300 s)
+window: ``burn = bad_fraction / (1 - target)``, so burn 1.0 means "spending
+budget exactly as fast as the SLO allows".  A breach — both windows at or
+past ``VTPU_SLO_BURN_THRESHOLD`` — is edge-triggered: one
+``vtpu_slo_breaches_total{slo=}`` increment and one ``on_breach`` callback
+(the incident plane's bundle trigger) per excursion, not per evaluation.
+
+Exported as ``vtpu_slo_burn_rate_ratio{slo=,window=}`` gauges in the
+shared ``obs`` registry and served at ``GET /slo`` on every debug
+listener.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from vtpu.analysis.witness import make_lock
+from vtpu.obs.flight import FlightRecorder, family_key
+from vtpu.obs.ready import readiness
+from vtpu.obs.registry import registry
+from vtpu.utils.envs import env_float
+
+log = logging.getLogger(__name__)
+
+ENV_FAST_WINDOW_S = "VTPU_SLO_FAST_WINDOW_S"
+ENV_SLOW_WINDOW_S = "VTPU_SLO_SLOW_WINDOW_S"
+ENV_BURN_THRESHOLD = "VTPU_SLO_BURN_THRESHOLD"
+ENV_EVAL_S = "VTPU_SLO_EVAL_S"
+ENV_FILTER_P99_S = "VTPU_SLO_FILTER_P99_S"
+
+# selector = (family key, label filter or None); a counter's contribution
+# is the sum over label sets matching every filter entry
+Selector = Tuple[str, Optional[Dict[str, str]]]
+
+
+def default_objectives() -> List[dict]:
+    """The declared objective set (a function, not a constant, because
+    the filter-latency threshold is env-tunable)."""
+    return [
+        {
+            "name": "filter_p99", "kind": "latency", "target": 0.99,
+            "family": family_key("scheduler", "vtpu_filter_seconds"),
+            "threshold_s": env_float(ENV_FILTER_P99_S, 0.25),
+        },
+        {
+            "name": "bind_success", "kind": "ratio", "target": 0.99,
+            "bad": [(family_key("obs", "vtpu_events_total"),
+                     {"type": "BindFailed"})],
+            "good": [(family_key("obs", "vtpu_events_total"),
+                      {"type": "PodBound"})],
+        },
+        {
+            "name": "router_shed", "kind": "share", "target": 0.99,
+            "bad": [(family_key("serving", "vtpu_router_sheds_total"), None)],
+            "total": [(family_key("serving", "vtpu_router_requests_total"),
+                       None)],
+        },
+        {
+            "name": "migration_failure", "kind": "ratio", "target": 0.95,
+            "bad": [
+                (family_key("serving", "vtpu_session_migrations_total"),
+                 {"outcome": "failed"}),
+                (family_key("serving", "vtpu_session_migrations_total"),
+                 {"outcome": "ambiguous"}),
+            ],
+            "good": [
+                (family_key("serving", "vtpu_session_migrations_total"),
+                 {"outcome": "migrated"}),
+                (family_key("serving", "vtpu_session_migrations_total"),
+                 {"outcome": "fallback"}),
+            ],
+        },
+        {
+            # zero-tolerance objective: burn = raw drift delta, so any
+            # drift ≥ the (default 1.0) threshold breaches immediately
+            "name": "audit_zero_drift", "kind": "zero", "target": 1.0,
+            "bad": [(family_key("scheduler", "vtpu_audit_drift_total"),
+                     None)],
+        },
+    ]
+
+
+def _counter_sum(sample: Optional[dict], selectors: Sequence[Selector]) -> float:
+    """Sum a counter family's values across label sets matching the
+    selector filters, over one flight sample.  Missing family → 0."""
+    if sample is None:
+        return 0.0
+    total = 0.0
+    for key, flt in selectors:
+        fam = sample["families"].get(key)
+        if fam is None or fam["kind"] not in ("counter", "gauge"):
+            continue
+        for s in fam["samples"]:
+            if flt and any(s["labels"].get(k) != v for k, v in flt.items()):
+                continue
+            total += s["value"]
+    return total
+
+
+def _hist_totals(
+    sample: Optional[dict], key: str, threshold_s: float
+) -> Tuple[float, float]:
+    """(total observations, observations ≤ threshold) summed across a
+    histogram family's label sets in one flight sample."""
+    if sample is None:
+        return 0.0, 0.0
+    fam = sample["families"].get(key)
+    if fam is None or fam["kind"] != "histogram":
+        return 0.0, 0.0
+    bounds = fam["bounds"]
+    idx = bisect.bisect_left(bounds, threshold_s)
+    total = good = 0.0
+    for s in fam["samples"]:
+        total += s["count"]
+        # buckets are cumulative and aligned with bounds + implicit +Inf:
+        # buckets[i] = observations ≤ bounds[i]; past the last bound every
+        # observation counts as good (the threshold is off the scale)
+        good += s["count"] if idx >= len(bounds) else s["buckets"][idx]
+    return total, good
+
+
+def _delta(now: float, then: float) -> float:
+    """Counter delta, clamped at 0 (a restarted registry resets)."""
+    return max(0.0, now - then)
+
+
+class SLOEngine:
+    """Evaluates declared objectives as fast+slow-window burn rates."""
+
+    def __init__(
+        self,
+        flight: FlightRecorder,
+        objectives: Optional[List[dict]] = None,
+        fast_window_s: Optional[float] = None,
+        slow_window_s: Optional[float] = None,
+        burn_threshold: Optional[float] = None,
+        eval_interval_s: Optional[float] = None,
+        wallclock=time.time,
+    ) -> None:
+        self.flight = flight
+        self.objectives = (
+            objectives if objectives is not None else default_objectives()
+        )
+        self.fast_window_s = (
+            fast_window_s if fast_window_s is not None
+            else env_float(ENV_FAST_WINDOW_S, 60.0)
+        )
+        self.slow_window_s = (
+            slow_window_s if slow_window_s is not None
+            else env_float(ENV_SLOW_WINDOW_S, 300.0)
+        )
+        self.burn_threshold = (
+            burn_threshold if burn_threshold is not None
+            else env_float(ENV_BURN_THRESHOLD, 1.0)
+        )
+        ev = (
+            eval_interval_s if eval_interval_s is not None
+            else env_float(ENV_EVAL_S, 0.0)
+        )
+        self.eval_interval_s = ev if ev > 0 else max(flight.interval_s, 1.0)
+        self._wallclock = wallclock
+        self._lock = make_lock("obs.slo")
+        self._breached: Dict[str, bool] = {}
+        self._last_report: Optional[dict] = None
+        self._last_eval_t: Optional[float] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # on_breach(slo_name, detail) — the incident plane's trigger
+        self.on_breach: List[Callable[[str, dict], None]] = []
+        reg = registry("obs")
+        self._burn_gauge = reg.gauge(
+            "vtpu_slo_burn_rate_ratio",
+            "Error-budget burn rate per SLO and window (1.0 = spending "
+            "budget exactly as fast as the objective allows)",
+        )
+        self._breaches = reg.counter(
+            "vtpu_slo_breaches_total",
+            "Edge-triggered SLO breaches (fast AND slow window burn at or "
+            "past VTPU_SLO_BURN_THRESHOLD)",
+        )
+
+    # -- evaluation -----------------------------------------------------
+    def _burn(self, obj: dict, latest: dict, baseline: Optional[dict]) -> dict:
+        kind = obj["kind"]
+        if kind == "latency":
+            t_now, g_now = _hist_totals(latest, obj["family"],
+                                        obj["threshold_s"])
+            t_then, g_then = _hist_totals(baseline, obj["family"],
+                                          obj["threshold_s"])
+            total = _delta(t_now, t_then)
+            bad = max(0.0, total - _delta(g_now, g_then))
+        elif kind == "zero":
+            bad = _delta(_counter_sum(latest, obj["bad"]),
+                         _counter_sum(baseline, obj["bad"]))
+            return {"bad": bad, "total": bad, "burn": bad}
+        elif kind == "share":
+            bad = _delta(_counter_sum(latest, obj["bad"]),
+                         _counter_sum(baseline, obj["bad"]))
+            total = _delta(_counter_sum(latest, obj["total"]),
+                           _counter_sum(baseline, obj["total"]))
+        else:  # ratio: bad vs good event counters
+            bad = _delta(_counter_sum(latest, obj["bad"]),
+                         _counter_sum(baseline, obj["bad"]))
+            good = _delta(_counter_sum(latest, obj["good"]),
+                          _counter_sum(baseline, obj["good"]))
+            total = bad + good
+        budget = 1.0 - obj["target"]
+        frac = (bad / total) if total > 0 else 0.0
+        burn = (frac / budget) if budget > 0 else (0.0 if bad == 0 else frac)
+        return {"bad": bad, "total": total, "burn": burn}
+
+    def evaluate(self) -> dict:
+        """One evaluation pass over the flight ring; returns (and stores)
+        the report ``GET /slo`` serves."""
+        latest = self.flight.latest()
+        report = {
+            "ts": self._wallclock(),
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "burn_threshold": self.burn_threshold,
+            "objectives": {},
+        }
+        if latest is not None:
+            windows = (
+                ("fast", self.fast_window_s), ("slow", self.slow_window_s)
+            )
+            for obj in self.objectives:
+                name = obj["name"]
+                entry = {"target": obj["target"], "kind": obj["kind"],
+                         "windows": {}}
+                burns = {}
+                for wname, wsec in windows:
+                    baseline = self.flight.at_or_before(latest["ts"] - wsec)
+                    res = self._burn(obj, latest, baseline)
+                    entry["windows"][wname] = res
+                    burns[wname] = res["burn"]
+                    self._burn_gauge.set(
+                        round(res["burn"], 6), slo=name, window=wname
+                    )
+                breached = all(
+                    b >= self.burn_threshold for b in burns.values()
+                )
+                entry["breached"] = breached
+                report["objectives"][name] = entry
+                with self._lock:
+                    was = self._breached.get(name, False)
+                    self._breached[name] = breached
+                if breached and not was:
+                    self._breaches.inc(slo=name)
+                    for cb in list(self.on_breach):
+                        try:
+                            cb(name, entry)
+                        except Exception:  # noqa: BLE001
+                            log.warning("on_breach callback failed",
+                                        exc_info=True)
+        with self._lock:
+            self._last_report = report
+            self._last_eval_t = report["ts"]
+        return report
+
+    # -- query (GET /slo) -----------------------------------------------
+    def last_report(self) -> Optional[dict]:
+        with self._lock:
+            return self._last_report
+
+    def report_body(self) -> bytes:
+        rep = self.last_report()
+        if rep is None:
+            rep = {"ts": None, "objectives": {},
+                   "detail": "no evaluation yet"}
+        return json.dumps(rep, default=str).encode()
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self, component: str = "scheduler") -> bool:
+        if self._thread is not None:
+            return False
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="vtpu-slo", daemon=True
+        )
+        self._thread.start()
+        readiness(component).register("slo_engine", self._ready_check)
+        return True
+
+    def _ready_check(self):
+        t = self._thread
+        if t is None or not t.is_alive():
+            return False, "slo thread not running"
+        with self._lock:
+            last = self._last_eval_t
+        if last is None:
+            return False, "no evaluation yet"
+        age = self._wallclock() - last
+        if age > 3 * self.eval_interval_s:
+            return False, (
+                f"last evaluation {age:.1f}s ago "
+                f"(interval {self.eval_interval_s}s)"
+            )
+        return True, f"last evaluation {age:.1f}s ago"
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.evaluate()
+            except Exception:  # noqa: BLE001 — keep evaluating
+                log.warning("slo evaluation failed", exc_info=True)
+            self._stop.wait(self.eval_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+
+# -- process-wide engine (routes read it; start_plane writes it) --------
+
+_engine: Optional[SLOEngine] = None
+_engine_lock = make_lock("obs.slo_engine")
+
+
+def engine() -> Optional[SLOEngine]:
+    with _engine_lock:
+        return _engine
+
+
+def activate(flight: FlightRecorder, component: str = "scheduler",
+             **kw) -> SLOEngine:
+    """Create (or return) the process SLO engine bound to ``flight``."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            _engine = SLOEngine(flight, **kw)
+        return _engine
+
+
+def deactivate() -> None:
+    global _engine
+    with _engine_lock:
+        eng, _engine = _engine, None
+    if eng is not None:
+        eng.stop()
+
+
+def slo_body(params: dict) -> bytes:
+    """Body for ``GET /slo`` on any debug listener."""
+    eng = engine()
+    if eng is None:
+        return json.dumps(
+            {"enabled": False,
+             "detail": "flight plane off (set VTPU_FLIGHT_SAMPLE_S > 0)"}
+        ).encode()
+    if params.get("refresh"):
+        eng.evaluate()
+    return eng.report_body()

@@ -744,6 +744,19 @@ class Scheduler:
                 node=res.node,
                 error=res.error,
                 qos=qos,
+                # the compact resource shape, per container — enough for
+                # benchmarks/scheduler_planet.py --trace to rebuild an
+                # equivalent pod spec and replay this exact admission
+                requests=[
+                    [
+                        {
+                            "nums": r.nums, "type": r.type, "mem": r.memreq,
+                            "mem_pct": r.mem_percentage, "cores": r.coresreq,
+                        }
+                        for r in ctr
+                    ]
+                    for ctr in reqs
+                ],
                 verdicts=verdicts,
                 utilization=measured,
                 elapsed_ms=round((time.perf_counter() - t_filter) * 1e3, 3),

@@ -1,4 +1,4 @@
-"""Bounded in-memory placement-decision audit log.
+"""Bounded placement-decision audit log with a durable JSONL mirror.
 
 The reference logs a single line per Filter and keeps nothing — "why did
 the scheduler pick node N for pod X?" (or "why was every node rejected?")
@@ -10,19 +10,25 @@ at decision time — in a capped ring (``VTPU_DECISION_LOG_CAP``, default
 512), served at ``GET /decisions?pod=<uid>`` on the extender's debug
 listener and cross-linked from ``/timeline``.
 
-Deliberately in-memory and bounded: this is a flight recorder, not an
-event store — a 10k-decision soak holds exactly ``cap`` records.
+The ring is the fast query surface; durability is the optional JSONL
+mirror (``VTPU_DECISION_JSONL``, same pattern and rotation policy as the
+event journal's ``VTPU_EVENT_JSONL`` — shared RotatingJsonlSink, capped by
+``VTPU_EVENT_JSONL_MAX_BYTES``).  A mirrored decision journal is exactly
+what ``benchmarks/scheduler_planet.py --trace`` replays: each record
+carries the compact resource requests, the candidate set, and every
+verdict, so a production incident becomes a regression fixture.
 """
 
 from __future__ import annotations
 
 import collections
-import os
+import json
 import time
 from typing import Deque, List, Optional
 
 from vtpu import obs
-from vtpu.utils.envs import env_int
+from vtpu.obs.jsonl import RotatingJsonlSink
+from vtpu.utils.envs import env_int, env_str
 from vtpu.analysis.witness import make_lock
 
 _REG = obs.registry("scheduler")
@@ -31,41 +37,68 @@ _RECORDED = _REG.counter(
     "Placement decisions recorded in the audit log (the log itself is a "
     "capped ring; this counts every decision ever taken)",
 )
+_OVERWRITTEN = _REG.counter(
+    "vtpu_decisions_overwritten_total",
+    "Decisions evicted from the capped ring by newer records (the "
+    "VTPU_DECISION_LOG_CAP window was smaller than the incident)",
+)
 
 DEFAULT_CAP = 512
+ENV_JSONL = "VTPU_DECISION_JSONL"
 
 
 class DecisionLog:
     """Capped ring of placement-decision records, newest last."""
 
     def __init__(
-        self, cap: Optional[int] = None, wallclock=time.time
+        self,
+        cap: Optional[int] = None,
+        jsonl_path: Optional[str] = None,
+        wallclock=time.time,
     ) -> None:
         if cap is None:
             cap = env_int("VTPU_DECISION_LOG_CAP", DEFAULT_CAP)
         self.cap = max(1, cap)
+        self.jsonl_path = (
+            jsonl_path if jsonl_path is not None else env_str(ENV_JSONL)
+        ) or None
         self._dq: Deque[dict] = collections.deque(maxlen=self.cap)
         self._lock = make_lock("scheduler.decisions")
         self._seq = 0
         self._wallclock = wallclock
+        # disk I/O stays off the ring lock (the filter hot path records
+        # under it); the sink serialises on its own lock, so mirrored
+        # lines may land out of seq order under contention — consumers
+        # (the replay loader) sort on "seq"
+        self._sink: Optional[RotatingJsonlSink] = (
+            RotatingJsonlSink(self.jsonl_path,
+                              lock_name="scheduler.decisions_sink")
+            if self.jsonl_path else None
+        )
 
     def record(self, **fields) -> dict:
         """Append one decision; assigns a monotonic ``seq`` and ``ts``."""
         with self._lock:
             self._seq += 1
             rec = {"seq": self._seq, "ts": self._wallclock(), **fields}
+            overwrote = len(self._dq) == self.cap
             self._dq.append(rec)
+        if overwrote:
+            _OVERWRITTEN.inc()
+        if self._sink is not None:
+            self._sink.write(rec)
         _RECORDED.inc()
         return rec
 
     def query(
         self, pod: Optional[str] = None, n: int = 50,
-        gang: Optional[str] = None,
+        gang: Optional[str] = None, since: Optional[float] = None,
     ) -> List[dict]:
         """Newest-last records; ``pod`` matches pod UID or pod name,
         ``gang`` matches the gang name of records carrying a gang
-        verdict (vtpu/scheduler/gang.py) — both filtered before the
-        count cut (like /spans?name=)."""
+        verdict (vtpu/scheduler/gang.py), ``since`` keeps records with
+        ts >= since — all filtered before the count cut (like
+        /spans?name=)."""
         with self._lock:
             recs = list(self._dq)
         if pod:
@@ -78,8 +111,49 @@ class DecisionLog:
                 r for r in recs
                 if (r.get("gang") or {}).get("name") == gang
             ]
+        if since is not None:
+            recs = [r for r in recs if r.get("ts", 0) >= since]
         n = max(0, n)
         return recs[-n:] if n else []
+
+    def decisions_body(self, params: dict) -> bytes:
+        """Body for ``GET /decisions?pod=&gang=&since=&n=&format=``.
+
+        Mirrors the event journal's query surface exactly: default is one
+        JSON document, ``format=jsonl`` is NDJSON so external scrapers
+        tail either surface with the same parser."""
+        try:
+            n = int(params.get("n", 50))
+        except ValueError:
+            n = 50
+        since: Optional[float] = None
+        if params.get("since"):
+            try:
+                since = float(params["since"])
+            except ValueError:
+                since = None
+        recs = self.query(
+            pod=params.get("pod") or None,
+            gang=params.get("gang") or None,
+            since=since,
+            n=n,
+        )
+        if params.get("format") == "jsonl":
+            return b"".join(
+                json.dumps(r, default=str).encode() + b"\n" for r in recs
+            )
+        return json.dumps(
+            {"decisions": recs, "count": len(recs)}, default=str
+        ).encode()
+
+    def snapshot(self) -> List[dict]:
+        """The full ring, oldest-first — the incident bundler's freeze."""
+        with self._lock:
+            return list(self._dq)
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
 
     def __len__(self) -> int:
         with self._lock:

@@ -11,7 +11,8 @@ wire contract:
   GET  /readyz   deep readiness (named checks, vtpu/obs/ready)
 
 plus the debug surface on the plain listener: /spans, /timeline,
-/trace.json, /decisions, /events (the typed journal), /audit (the
+/trace.json, /decisions, /events (the typed journal), /slo (burn-rate
+report), /incidents (recorded bundles), /audit (the
 reconciliation verdict report, vtpu/audit), and the sharded-replica
 surface (vtpu/scheduler/shard.py): GET /shard (ring/ownership status),
 POST /shard/evaluate, /shard/filter, /shard/commit and /shard/release
@@ -154,25 +155,41 @@ class _Handler(BaseHTTPRequestHandler):
             from vtpu.obs.http import split_query
 
             _, params = split_query(self.path)
-            self._send(200, journal().events_body(params))
+            ctype = (
+                "application/x-ndjson" if params.get("format") == "jsonl"
+                else "application/json"
+            )
+            self._send(200, journal().events_body(params), ctype)
         elif self.allow_debug and route == "/decisions":
             # placement-decision audit log: per-node verdicts (reject
             # reason or score breakdown + chosen placement) for every
-            # filter run, newest last (vtpu/scheduler/decisions.py)
+            # filter run, newest last (vtpu/scheduler/decisions.py) —
+            # same ?since=/&format=jsonl tail surface as /events
             from vtpu.obs.http import split_query
 
             _, params = split_query(self.path)
-            try:
-                n = int(params.get("n", 50))
-            except ValueError:
-                n = 50
-            recs = self.scheduler.decisions.query(
-                pod=params.get("pod") or None,
-                gang=params.get("gang") or None, n=n,
+            ctype = (
+                "application/x-ndjson" if params.get("format") == "jsonl"
+                else "application/json"
             )
-            self._send(200, json.dumps(
-                {"decisions": recs, "count": len(recs)}, default=str
-            ).encode())
+            self._send(
+                200, self.scheduler.decisions.decisions_body(params), ctype
+            )
+        elif self.allow_debug and route == "/slo":
+            # SLO burn-rate report (vtpu/obs/slo); explains itself when
+            # the flight plane is off
+            from vtpu.obs import slo as slo_mod
+            from vtpu.obs.http import split_query
+
+            _, params = split_query(self.path)
+            self._send(200, slo_mod.slo_body(params))
+        elif self.allow_debug and route == "/incidents":
+            # recorded incident bundles (vtpu/obs/incident)
+            from vtpu.obs import incident as incident_mod
+            from vtpu.obs.http import split_query
+
+            _, params = split_query(self.path)
+            self._send(200, incident_mod.incidents_body(params))
         elif self.allow_debug and route == "/timeline":
             # the shared timeline view, cross-linked to this pod's audit
             # trail so span feed and placement verdicts are one click apart
