@@ -11,7 +11,7 @@ PY ?= python3
 .PHONY: all build shim proto test test-slow test-all test-native bench \
 	bench-sched bench-serve bench-churn bench-disagg bench-gang \
 	bench-goodput bench-migrate bench-colo bench-planet bench-replay \
-	bench-smoke \
+	bench-kv bench-smoke \
 	check obs-lint \
 	config-lint audit-check image chart clean tidy
 
@@ -264,6 +264,23 @@ ifdef SMOKE
 	$(PY) benchmarks/serving_disagg.py --smoke
 else
 	$(PY) benchmarks/serving_disagg.py
+endif
+
+# K/V memory-hierarchy proof: the per-codec wire tradeoff curve
+# (fp32/int8/fp8/int4 chunk codecs: ≥6× fewer wire bytes at int4, with
+# each codec's token-match fraction + per-element error bound), the
+# host-DRAM spill tier (registered-prefix working set LARGER than the
+# device pool; spilled-hit first-token latency ≤2× device-resident),
+# prefix persistence across a rolling restart (rehydrated onload ≥3×
+# better first-hit FTL than cold recompute), and the torn-journal
+# fuzz → docs/artifacts/serving_kv.json (docs/serving.md#memory-
+# hierarchy explains the numbers).  SMOKE=1 runs a seconds-long
+# schema/exactness pass (also exercised by tests/test_kvspill.py).
+bench-kv:
+ifdef SMOKE
+	$(PY) benchmarks/serving_disagg.py --kv --smoke
+else
+	$(PY) benchmarks/serving_disagg.py --kv
 endif
 
 # live-session-migration proof: drain-via-migration vs finish-in-place

@@ -40,6 +40,14 @@ decode path.
 
 Usage: python benchmarks/serving_disagg.py [--smoke] [--sim-seconds 20]
        [--repeats 3] [--out docs/artifacts/serving_disagg.json]
+
+``--kv`` (``make bench-kv``) runs the K/V memory-hierarchy phases
+instead, writing docs/artifacts/serving_kv.json: the per-codec wire
+tradeoff curve (fp32/int8/fp8/int4 bytes vs token match), the
+host-DRAM spill tier (working set > device pool; spilled-hit vs
+device-hit first-token latency), prefix persistence across a rolling
+restart (rehydrated onload vs cold recompute), and the torn-journal
+fuzz.
 """
 
 from __future__ import annotations
@@ -329,8 +337,7 @@ def run_wire(n_requests: int, smoke: bool, codec: str = "fp32") -> dict:
 
         def fault(data):
             fr = tp.decode_frame(data)
-            if fr.kind not in (tp.KIND_DATA, tp.KIND_DATA_QUANT) \
-                    or fr.seq == 0:
+            if fr.kind not in tp._DATA_KINDS or fr.seq == 0:
                 return
             if kind == "first_chunk" and fr.seq == 1 and state["n"] == 0:
                 state["n"] += 1
@@ -384,7 +391,9 @@ def run_wire(n_requests: int, smoke: bool, codec: str = "fp32") -> dict:
         "codec": codec,
         "token_exact": got == want,
         "token_match_fraction": round(matched / max(1, total_toks), 4),
-        "quant_error_bound": round(wirecodec.error_bound(dec.wire_quant_max_scale), 6),
+        "quant_error_bound": round(wirecodec.error_bound(
+            dec.wire_quant_max_scale,
+            getattr(dec, "wire_quant_codec", wirecodec.CODEC_INT8)), 6),
         "bytes_on_wire": bytes_moved,
         "chunks": int(tp.TRANSPORT_CHUNKS.value() - c0),
         "streams": len(streams),
@@ -547,8 +556,10 @@ def run_shared_prefix(smoke: bool) -> dict:
             "token_exact": got == want,
             "token_match_fraction": round(
                 matched / max(1, total_toks), 4),
-            "quant_error_bound": round(
-                wirecodec.error_bound(dec.wire_quant_max_scale), 6),
+            "quant_error_bound": round(wirecodec.error_bound(
+                dec.wire_quant_max_scale,
+                getattr(dec, "wire_quant_codec",
+                        wirecodec.CODEC_INT8)), 6),
             "bytes_on_wire": int(tp.TRANSPORT_BYTES.value() - b0),
             "first_token_ms_mean": round(sum(ftl) / max(1, len(ftl)), 3),
             "first_token_ms_p50": round(pct(ftl, 0.50), 3),
@@ -569,6 +580,520 @@ def run_shared_prefix(smoke: bool) -> dict:
                    "prefix_tokens": 64, "sessions": n_sessions},
         "arms": arms,
     }
+
+
+# ---------------------------------------------------------------------------
+# K/V memory-hierarchy phases (`make bench-kv`): per-codec wire tradeoff
+# curve, host-DRAM spill tier, prefix persistence across restarts
+# ---------------------------------------------------------------------------
+
+KV_CODECS = ("fp32", "int8", "fp8", "int4")
+
+
+def _mean(vals):
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+def _kv_stack(m, params, codec="fp32", **engine_kw):
+    """One sequential serving stack: prefill + speculative decode behind
+    the loopback wire.  Speculative adoption publishes the first token
+    at OPEN, so the measured first-token latency is the prefill-side
+    story (device-resident hit vs spill onload vs cold recompute) —
+    exactly the axis the memory-hierarchy phases compare."""
+    from vtpu.serving import transport as tp
+    from vtpu.serving.disagg import DecodeEngine, PrefillEngine
+
+    pf = PrefillEngine(m, params, prefix_cache=True, **engine_kw)
+    dec = DecodeEngine(m, params, max_batch=4, eos_id=2,
+                       replica_id="kv0", speculative=True)
+    hub = tp.ReceiverHub(dec)
+    rep = tp.WireReplica(tp.LoopbackLink(hub), "kv0", local=dec,
+                         chunk_blocks=2, codec=codec)
+    return pf, dec, rep
+
+
+def _kv_drive_one(pf, dec, rep, rid, prompt, num_new):
+    """Serve ONE request to completion; returns submit→first-token ms
+    (the token host-visible at the decode replica)."""
+    from vtpu.serving import transport as tp
+
+    t0 = time.perf_counter()
+    t_first = [None]
+
+    def check_first():
+        if t_first[0] is None and rid in dec.out:
+            t_first[0] = time.perf_counter()
+
+    pf.submit(rid, prompt, num_new=num_new)
+    while (pf.queue or rep.idle_senders() or dec.queue
+           or any(dec.active) or dec._inflight):
+        for res in pf.step():
+            rep.submit_handle(res.rid, res.handle, res.first_token,
+                              res.num_new, source=pf,
+                              submitted=res.submitted, admit=False)
+            check_first()
+        stalls = 0
+        while rep.idle_senders():
+            before = tp.TRANSPORT_CHUNKS.value()
+            rep.pump_streams()
+            check_first()
+            if (rep.idle_senders()
+                    and tp.TRANSPORT_CHUNKS.value() == before):
+                dec.step()   # starved: retire slots → credits
+                stalls += 1
+                if stalls > 10000:
+                    raise RuntimeError("kv arm wedged")
+        dec.step()
+        check_first()
+    return 1e3 * ((t_first[0] or time.perf_counter()) - t0)
+
+
+def run_kv_spill(smoke: bool) -> dict:
+    """Working set of registered prefixes LARGER than the device pool:
+    lease pressure demotes cold prefixes to quantized host buffers and
+    a later hit onloads them back through the dequantizing scatter.
+    Measures first-token latency of spilled-prefix hits vs
+    device-resident hits on identical request shapes (same suffix
+    bucket — the onload is the only delta), classified post-hoc from
+    the engine's hit/onload counters."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from vtpu.models.transformer import TransformerLM
+    from vtpu.serving.paged import PagedBatcher
+
+    kw = dict(vocab=128, d_model=128, depth=2, num_heads=4, max_seq=192)
+    bs = 16
+    pool_blocks = 18           # 17 leasable: device fits ~3 prefixes
+    n_pfx = 5 if smoke else 8  # 4-block prefixes: 20/32-block working set
+    m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=bs,
+                      kv_pool_blocks=pool_blocks)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"]
+    m_big = TransformerLM(**kw, kv_cache_layout="paged",
+                          kv_block_size=bs, kv_pool_blocks=257)
+    rng = np.random.default_rng(31)
+    prefixes = [rng.integers(0, 128, 64).astype(np.int32)
+                for _ in range(n_pfx)]
+    suf_len, num_new = 11, 4
+
+    def mk(tag, i):
+        suffix = rng.integers(0, 128, suf_len).astype(np.int32)
+        return (f"{tag}{i}", np.concatenate([prefixes[i], suffix]),
+                num_new)
+
+    pop_reqs = [mk("p", i) for i in range(n_pfx)]
+    meas_reqs = {i: mk("m", i) for i in range(n_pfx)}
+
+    mono = PagedBatcher(m_big, params, max_batch=4, eos_id=2)
+    for rid, p, n in meas_reqs.values():
+        mono.submit(rid, p, num_new=n)
+    want = mono.run()
+
+    pf, dec, rep = _kv_stack(m, params, host_spill=True)
+    # warm every program on the path INCLUDING demote + onload: two
+    # throwaway prefixes, force-demote, then hit one of them (same
+    # 4-block run bucket as the measured prefixes)
+    warm_pfx = [rng.integers(0, 128, 64).astype(np.int32)
+                for _ in range(2)]
+    for i, wp in enumerate(warm_pfx):
+        suffix = rng.integers(0, 128, suf_len).astype(np.int32)
+        _kv_drive_one(pf, dec, rep, f"kwarm{i}",
+                      np.concatenate([wp, suffix]), num_new)
+    pf._demote_for(pf.pool.leasable())
+    suffix = rng.integers(0, 128, suf_len).astype(np.int32)
+    _kv_drive_one(pf, dec, rep, "kwarmhit",
+                  np.concatenate([warm_pfx[0], suffix]), num_new)
+    # drop the warm residents so the measured LRU order is clean
+    pf.pool.evict_prefixes_for(pf.pool.leasable())
+
+    d0, o0 = pf.spill_demotions, pf.spill_onloads
+    for r in pop_reqs:
+        _kv_drive_one(pf, dec, rep, *r)
+    # newest-first: device-resident prefixes measure before the spilled
+    # tail (touching a spilled one onloads it, demoting an LRU victim
+    # that has already been measured)
+    samples = {"device": [], "spilled": [], "miss": []}
+    for i in range(n_pfx - 1, -1, -1):
+        h0, on0 = pf.prefix_hits, pf.spill_onloads
+        ms = _kv_drive_one(pf, dec, rep, *meas_reqs[i])
+        if pf.spill_onloads > on0:
+            samples["spilled"].append(ms)
+        elif pf.prefix_hits > h0:
+            samples["device"].append(ms)
+        else:
+            samples["miss"].append(ms)
+
+    dec._flush_first_tokens()
+    want = {rid: list(t) for rid, t in want.items()}
+    got = {rid: list(dec.out.get(rid, [])) for rid in want}
+    total = sum(len(t) for t in want.values())
+    matched = sum(sum(a == b for a, b in zip(got[rid], toks))
+                  for rid, toks in want.items())
+    st = pf.pool.stats()
+    ratio = (round(_mean(samples["spilled"])
+                   / max(1e-9, _mean(samples["device"])), 2)
+             if samples["spilled"] and samples["device"] else None)
+    return {
+        "config": {"model": kw, "block_size": bs,
+                   "pool_blocks": pool_blocks, "prefixes": n_pfx,
+                   "prefix_blocks_each": 4,
+                   "spill_codec": pf._spill_codec},
+        "working_set_blocks": n_pfx * 4,
+        "device_leasable_blocks": pf.pool.leasable(),
+        "overcommit": n_pfx * 4 > pf.pool.leasable(),
+        "demotions": pf.spill_demotions - d0,
+        "onloads": pf.spill_onloads - o0,
+        "spilled_runs": st["spilled_runs"],
+        "spilled_blocks": st["spilled_blocks"],
+        "token_exact": got == want,
+        "token_match_fraction": round(matched / max(1, total), 4),
+        "ftl_ms_device_hit": [round(v, 3) for v in samples["device"]],
+        "ftl_ms_spilled_hit": [round(v, 3) for v in samples["spilled"]],
+        "ftl_ms_miss": [round(v, 3) for v in samples["miss"]],
+        "spilled_vs_device_ftl_x": ratio,
+        "pools_leak_free": (st["leased"] == st["prefix_blocks"]
+                            and dec.pool.stats()["leased"] == 0),
+    }
+
+
+def run_kv_restart(smoke: bool) -> dict:
+    """Rolling-restart story: generation 1 registers a 6-block system
+    prefix, demotes it (journaling chain + quantized payload to disk),
+    and dies; generation 2 rehydrates the journal at boot and serves a
+    fanout of requests sharing that prefix — its FIRST hit onloads from
+    the rehydrated host tier instead of recomputing.  The cold arm is
+    the same fanout on a fresh engine with no persistence: its first
+    request pays the full prefix prefill.  Headline is the first-hit
+    first-token-latency ratio (cold recompute / rehydrated onload)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from vtpu.models.transformer import TransformerLM
+    from vtpu.serving.paged import PagedBatcher
+
+    # wide model + long system prefix ON PURPOSE: the cold arm recomputes
+    # the whole prefix (compute ~ tokens × width²) while the rehydrated
+    # arm pays one host→device scatter (bytes ~ tokens × width) plus the
+    # suffix prefill — this is the shape class where persistence earns
+    # its keep
+    kw = dict(vocab=128, d_model=256, depth=3, num_heads=4, max_seq=256)
+    bs = 16
+    m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=bs,
+                      kv_pool_blocks=65)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"]
+    rng = np.random.default_rng(47)
+    prefix = rng.integers(0, 128, 192).astype(np.int32)       # 12 blocks
+    warm_prefix = rng.integers(0, 128, 192).astype(np.int32)  # same shape
+    suf_len, num_new = 11, 4
+    fanout = 4 if smoke else 8
+
+    def fan(tag, pfx, k=None):
+        out = []
+        for i in range(k if k is not None else fanout):
+            suffix = rng.integers(0, 128, suf_len).astype(np.int32)
+            out.append((f"{tag}{i}", np.concatenate([pfx, suffix]),
+                        num_new))
+        return out
+
+    meas_reqs = fan("r", prefix)
+    cold_reqs = fan("c", prefix)
+    mono = PagedBatcher(m, params, max_batch=4, eos_id=2)
+    for rid, p, n in meas_reqs + cold_reqs:
+        mono.submit(rid, p, num_new=n)
+    want = {rid: list(t) for rid, t in mono.run().items()}
+
+    d = tempfile.mkdtemp(prefix="vtpu-kv-restart-")
+    try:
+        # generation 1: register the prefix, demote it into the journal
+        pf1, dec1, rep1 = _kv_stack(m, params, host_spill=True,
+                                    persist_dir=d)
+        seed_suffix = rng.integers(0, 128, suf_len).astype(np.int32)
+        _kv_drive_one(pf1, dec1, rep1, "seed",
+                      np.concatenate([prefix, seed_suffix]), num_new)
+        pf1._demote_for(pf1.pool.leasable())
+        journaled_blocks = pf1._persist.blocks_journaled
+        pf1._persist.close()
+        leak1 = (pf1.pool.stats()["leased"]
+                 == pf1.pool.stats()["prefix_blocks"]
+                 and dec1.pool.stats()["leased"] == 0)
+
+        # generation 2 ("restarted replica"): rehydrates at boot
+        pf2, dec2, rep2 = _kv_stack(m, params, host_spill=True,
+                                    persist_dir=d)
+        st0 = pf2.pool.stats()
+        rehydrated_runs = st0["spilled_runs"]
+        rehydrated_blocks = st0["spilled_blocks"]
+        # warm gen 2 on a DIFFERENT prefix through the SAME path the
+        # measured fanout takes — register, demote, onload-hit — so the
+        # scatter/gather/prefill programs compile before measurement
+        for rid, p, n in fan("w", warm_prefix, k=2):
+            _kv_drive_one(pf2, dec2, rep2, rid, p, n)
+        pf2._demote_for(pf2.pool.leasable())
+        wsuf = rng.integers(0, 128, suf_len).astype(np.int32)
+        _kv_drive_one(pf2, dec2, rep2, "whot",
+                      np.concatenate([warm_prefix, wsuf]), num_new)
+        o0 = pf2.spill_onloads
+        ftl_rehydrated = [_kv_drive_one(pf2, dec2, rep2, rid, p, n)
+                          for rid, p, n in meas_reqs]
+        onloaded = pf2.spill_onloads - o0
+        dec2._flush_first_tokens()
+
+        # cold arm: fresh engine, no persistence — first request pays
+        # the full prefix recompute (same warmed program shapes)
+        pf3, dec3, rep3 = _kv_stack(m, params)
+        for rid, p, n in fan("v", warm_prefix, k=2):
+            _kv_drive_one(pf3, dec3, rep3, rid, p, n)
+        ftl_cold = [_kv_drive_one(pf3, dec3, rep3, rid, p, n)
+                    for rid, p, n in cold_reqs]
+        dec3._flush_first_tokens()
+
+        got2 = {rid: list(dec2.out.get(rid, []))
+                for rid, _p, _n in meas_reqs}
+        got3 = {rid: list(dec3.out.get(rid, []))
+                for rid, _p, _n in cold_reqs}
+        w2 = {rid: want[rid] for rid in got2}
+        w3 = {rid: want[rid] for rid in got3}
+        total2 = sum(len(t) for t in w2.values())
+        matched2 = sum(sum(a == b for a, b in zip(got2[rid], toks))
+                       for rid, toks in w2.items())
+        leak = all(
+            p_.pool.stats()["leased"] == p_.pool.stats()["prefix_blocks"]
+            and d_.pool.stats()["leased"] == 0
+            for p_, d_ in ((pf2, dec2), (pf3, dec3))
+        ) and leak1
+        return {
+            "config": {"model": kw, "block_size": bs,
+                       "prefix_blocks": 12, "fanout": fanout,
+                       "spill_codec": pf2._spill_codec},
+            "journaled_blocks": journaled_blocks,
+            "rehydrated_runs": rehydrated_runs,
+            "rehydrated_blocks": rehydrated_blocks,
+            "rehydrated_onloads": onloaded,
+            "ftl_ms_rehydrated": [round(v, 3) for v in ftl_rehydrated],
+            "ftl_ms_cold": [round(v, 3) for v in ftl_cold],
+            "first_hit_ftl_ms_rehydrated": round(ftl_rehydrated[0], 3),
+            "first_hit_ftl_ms_cold": round(ftl_cold[0], 3),
+            "restart_ftl_speedup_x": round(
+                ftl_cold[0] / max(1e-9, ftl_rehydrated[0]), 2),
+            "fanout_ftl_ms_mean_rehydrated": round(
+                _mean(ftl_rehydrated), 3),
+            "fanout_ftl_ms_mean_cold": round(_mean(ftl_cold), 3),
+            "token_match_fraction_rehydrated": round(
+                matched2 / max(1, total2), 4),
+            "token_exact_cold": got3 == w3,
+            "pools_leak_free": leak,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def run_kv_torn_journal() -> dict:
+    """Death-fuzz for the persistence tier: a crash mid-append leaves a
+    truncated segment tail and a garbage index line.  The restarted
+    replica must rehydrate exactly the valid subset (never deserialize
+    garbage K/V), onload a surviving run, and stay leak-free."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from vtpu.models.transformer import TransformerLM
+    from vtpu.serving import kvpersist
+
+    kw = dict(vocab=128, d_model=64, depth=2, num_heads=4, max_seq=128)
+    bs = 16
+    m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=bs,
+                      kv_pool_blocks=33)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"]
+    rng = np.random.default_rng(53)
+    prefixes = [rng.integers(0, 128, 32).astype(np.int32)  # 2 blocks
+                for _ in range(3)]
+    d = tempfile.mkdtemp(prefix="vtpu-kv-torn-")
+    try:
+        pf1, dec1, rep1 = _kv_stack(m, params, host_spill=True,
+                                    persist_dir=d)
+        for i, pfx in enumerate(prefixes):
+            suffix = rng.integers(0, 128, 9).astype(np.int32)
+            _kv_drive_one(pf1, dec1, rep1, f"t{i}",
+                          np.concatenate([pfx, suffix]), 3)
+        pf1._demote_for(pf1.pool.leasable())
+        pf1._persist.close()
+        idx = os.path.join(d, kvpersist.INDEX_NAME)
+        seg = os.path.join(d, kvpersist.SEGMENTS_NAME)
+        with open(idx) as f:
+            journaled_runs = sum(1 for _ in f)
+        # the torn write: segment loses its tail mid-record, index
+        # gains a half-flushed garbage line
+        with open(seg, "r+b") as f:
+            f.truncate(max(0, os.path.getsize(seg) - 100))
+        with open(idx, "a") as f:
+            f.write('{"torn index line\n')
+
+        pf2, dec2, rep2 = _kv_stack(m, params, host_spill=True,
+                                    persist_dir=d)
+        rehydrated = pf2.pool.stats()["spilled_runs"]
+        o0 = pf2.spill_onloads
+        suffix = rng.integers(0, 128, 9).astype(np.int32)
+        _kv_drive_one(pf2, dec2, rep2, "survivor",
+                      np.concatenate([prefixes[0], suffix]), 3)
+        leak = (pf2.pool.stats()["leased"]
+                == pf2.pool.stats()["prefix_blocks"]
+                and dec2.pool.stats()["leased"] == 0)
+        ok = (journaled_runs == 3
+              and rehydrated == journaled_runs - 1
+              and pf2.spill_onloads == o0 + 1
+              and leak)
+        return {
+            "journaled_runs": journaled_runs,
+            "rehydrated_runs": rehydrated,
+            "expected_rehydrated": journaled_runs - 1,
+            "survivor_onloads": pf2.spill_onloads - o0,
+            "pools_leak_free": leak,
+            "ok": ok,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def kv_main(args, smoke: bool, platform, fell_back, note) -> int:
+    from vtpu.serving import wirecodec  # noqa: F401 (artifact schema)
+
+    n = 6 if smoke else 16
+    curve = {}
+    for c in KV_CODECS:
+        print(f"[bench-kv] codec curve: {c} wire…", file=sys.stderr,
+              flush=True)
+        curve[c] = run_wire(n, smoke, codec=c)
+    fp32_bytes = curve["fp32"]["bytes_on_wire"]
+    for c, r in curve.items():
+        r["wire_byte_reduction_x"] = round(
+            fp32_bytes / max(1, r["bytes_on_wire"]), 2)
+    if not curve["fp32"]["token_exact"]:
+        print("bench-kv: fp32 wire diverged from monolithic",
+              file=sys.stderr)
+        return 1
+    for c, r in curve.items():
+        if (not r["pools_leak_free"]
+                or not r["death_fuzz"]["leak_free_all"]):
+            print(f"bench-kv: {c} wire leaked blocks", file=sys.stderr)
+            return 1
+        if not r["host_bytes_accounted"]:
+            print(f"bench-kv: {c} wire host bytes not accounted",
+                  file=sys.stderr)
+            return 1
+    for c, floor in (("int8", 3.5), ("fp8", 3.5), ("int4", 6.0)):
+        if curve[c]["wire_byte_reduction_x"] < floor:
+            print(f"bench-kv: {c} wire-byte reduction only "
+                  f"{curve[c]['wire_byte_reduction_x']:.2f}x "
+                  f"(< {floor}x)", file=sys.stderr)
+            return 1
+
+    print("[bench-kv] host-DRAM spill tier…", file=sys.stderr,
+          flush=True)
+    spill = run_kv_spill(smoke)
+    if not spill["overcommit"]:
+        print("bench-kv: spill working set fits the device pool — "
+              "arm proves nothing", file=sys.stderr)
+        return 1
+    if spill["demotions"] < 1 or spill["onloads"] < 1:
+        print("bench-kv: spill arm never demoted/onloaded",
+              file=sys.stderr)
+        return 1
+    if not spill["ftl_ms_spilled_hit"] or not spill["ftl_ms_device_hit"]:
+        print("bench-kv: spill arm missing a hit class "
+              f"(device={len(spill['ftl_ms_device_hit'])}, "
+              f"spilled={len(spill['ftl_ms_spilled_hit'])})",
+              file=sys.stderr)
+        return 1
+    if not spill["pools_leak_free"]:
+        print("bench-kv: spill arm leaked blocks", file=sys.stderr)
+        return 1
+    if spill["token_match_fraction"] < 0.9:
+        print(f"bench-kv: spill arm token match "
+              f"{spill['token_match_fraction']} (< 0.9)",
+              file=sys.stderr)
+        return 1
+    if not smoke and spill["spilled_vs_device_ftl_x"] > 2.0:
+        print(f"bench-kv: spilled-hit FTL "
+              f"{spill['spilled_vs_device_ftl_x']:.2f}x device-resident "
+              f"(> 2x)", file=sys.stderr)
+        return 1
+
+    print("[bench-kv] prefix persistence across restart…",
+          file=sys.stderr, flush=True)
+    restart = run_kv_restart(smoke)
+    if restart["rehydrated_runs"] < 1 or restart["rehydrated_onloads"] < 1:
+        print("bench-kv: restart arm never rehydrated/onloaded",
+              file=sys.stderr)
+        return 1
+    if not restart["pools_leak_free"]:
+        print("bench-kv: restart arm leaked blocks", file=sys.stderr)
+        return 1
+    if not restart["token_exact_cold"]:
+        print("bench-kv: cold restart arm diverged from monolithic",
+              file=sys.stderr)
+        return 1
+    if not smoke and restart["restart_ftl_speedup_x"] < 3.0:
+        print(f"bench-kv: rehydrated first-hit FTL only "
+              f"{restart['restart_ftl_speedup_x']:.2f}x better than "
+              f"cold recompute (< 3x)", file=sys.stderr)
+        return 1
+
+    print("[bench-kv] torn-journal fuzz…", file=sys.stderr, flush=True)
+    torn = run_kv_torn_journal()
+    if not torn["ok"]:
+        print(f"bench-kv: torn-journal fuzz failed: {torn}",
+              file=sys.stderr)
+        return 1
+
+    headline = {
+        "codec_curve": {
+            c: {"bytes_on_wire": r["bytes_on_wire"],
+                "wire_byte_reduction_x": r["wire_byte_reduction_x"],
+                "token_match_fraction": r["token_match_fraction"],
+                "quant_error_bound": r["quant_error_bound"]}
+            for c, r in curve.items()
+        },
+        "int4_wire_byte_reduction_x": curve["int4"][
+            "wire_byte_reduction_x"],
+        "spilled_vs_device_ftl_x": spill["spilled_vs_device_ftl_x"],
+        "restart_ftl_speedup_x": restart["restart_ftl_speedup_x"],
+        "first_hit_ftl_ms_rehydrated": restart[
+            "first_hit_ftl_ms_rehydrated"],
+        "first_hit_ftl_ms_cold": restart["first_hit_ftl_ms_cold"],
+        "torn_journal_ok": torn["ok"],
+    }
+    res = {
+        "metric": "serving_kv_hierarchy",
+        "platform": platform,
+        "backend_fallback": fell_back,
+        "backend_probe": note,
+        "smoke": smoke,
+        "codec_curve": curve,
+        "spill": spill,
+        "restart": restart,
+        "torn_journal": torn,
+        "headline": headline,
+        "measured": time.strftime("%Y-%m-%d %H:%M:%S"),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(res, f, indent=1)
+    print(json.dumps({"headline": headline}))
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -988,9 +1513,20 @@ def main(argv=None) -> int:
                          "engine's decode token capacity")
     ap.add_argument("--burst-period", type=float, default=2.0)
     ap.add_argument("--burst-size", type=int, default=24)
-    ap.add_argument("--out", default=os.path.join(
-        REPO, "docs", "artifacts", "serving_disagg.json"))
+    ap.add_argument("--kv", action="store_true",
+                    help="run the K/V memory-hierarchy phases instead "
+                         "(per-codec wire tradeoff curve, host-DRAM "
+                         "spill tier, prefix persistence across "
+                         "restart, torn-journal fuzz) — `make bench-kv`")
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: "
+                         "docs/artifacts/serving_disagg.json, or "
+                         "serving_kv.json with --kv)")
     args = ap.parse_args(argv)
+    if args.out is None:
+        args.out = os.path.join(
+            REPO, "docs", "artifacts",
+            "serving_kv.json" if args.kv else "serving_disagg.json")
 
     platform, fell_back, note = probe_backend()
     if platform == "cpu":
@@ -1005,6 +1541,8 @@ def main(argv=None) -> int:
     platform = jax.devices()[0].platform
 
     smoke = bool(args.smoke)
+    if args.kv:
+        return kv_main(args, smoke, platform, fell_back, note)
     sim_s = 1.5 if smoke else args.sim_seconds
     print("[bench-disagg] phase 1: real-topology exactness…",
           file=sys.stderr, flush=True)

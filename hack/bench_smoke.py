@@ -81,6 +81,12 @@ BENCHES = {
         [sys.executable, "benchmarks/serving_disagg.py", "--smoke"],
         {},
     ),
+    "kv": (
+        "serving_kv.json",
+        [sys.executable, "benchmarks/serving_disagg.py", "--kv",
+         "--smoke"],
+        {},
+    ),
     "migrate": (
         "serving_migrate.json",
         [sys.executable, "benchmarks/serving_migrate.py", "--smoke"],
@@ -99,6 +105,8 @@ VARIABLE_PATHS = {
     ("arms",),                 # churn smoke runs a subset of arms
     ("units",),                # disagg smoke calibrates fewer shapes
     ("config", "model"),       # model kw dict is bench-internal
+    ("spill", "config", "model"),    # kv bench arm-local model kw
+    ("restart", "config", "model"),
     # colo smoke runs a smaller gang: member/role key sets shrink
     ("arms", "*", "mesh_boot"),
     ("arms", "*", "gang", "roles"),

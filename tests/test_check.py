@@ -374,6 +374,31 @@ def test_jax_hygiene_pragma(tmp_path):
     assert len(vs) == 1 and "block_until_ready" in vs[0].message
 
 
+SPILL_SCATTER_VIOLATION = '''# vtpu: hot-path
+"""Seeded twin of the K/V spill tier's onload scatter (disagg.py
+``_spill_scatter``): the dequantizing put must stay async — a bare
+device→host materialization on this path stalls every admission behind
+the D2H."""
+import numpy as np
+
+def onload_scatter(pools, payload_q, idx):
+    q = np.asarray(payload_q)          # bare one-arg: D2H sync, flagged
+    host = np.asarray(payload_q, np.int8)   # explicit dtype: passes
+    return pools, q, host, idx
+'''
+
+
+def test_jax_hygiene_spill_scatter_seeded_violation(tmp_path):
+    """The spill onload/demote paths are `# vtpu: hot-path` marked
+    (vtpu/serving/disagg.py): a bare device→host materialization seeded
+    into a scatter-shaped file must flag, so the marker on the real
+    module keeps meaning something."""
+    vs = run_fixture(tmp_path, {"vtpu/spill.py": SPILL_SCATTER_VIOLATION},
+                     [JaxHygienePass()])
+    assert len(vs) == 1, vs
+    assert "asarray" in vs[0].message and "spill.py" in vs[0].path
+
+
 # ---------------------------------------------------------------------------
 # env-docs (the config-lint port)
 # ---------------------------------------------------------------------------

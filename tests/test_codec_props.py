@@ -79,6 +79,121 @@ def test_pod_devices_round_trip(ctrs):
     assert [c for c in got if c] == [c for c in ctrs if c]
 
 
+# ---------------------------------------------------------------------------
+# K/V block-quant wire codecs (int8 / fp8 / int4): the host-side numpy
+# twins must be BIT-identical to the JAX halves (a fake receiver and a
+# real device receiver must reconstruct the same K/V), and the
+# documented per-element error bound must hold with NO epsilon.
+# ---------------------------------------------------------------------------
+
+import numpy as np  # noqa: E402
+
+from vtpu.serving import wirecodec  # noqa: E402
+
+
+@st.composite
+def _block_arrays(draw):
+    """Rectangular [nblocks, ...] f32 arrays — the shape class every
+    pool-block leaf slice takes — including subnormals and exact
+    boundary values (the absmax element always sits at the grid edge)."""
+    nblocks = draw(st.integers(1, 4))
+    ndim = draw(st.integers(1, 3))
+    shape = (nblocks,) + tuple(
+        draw(st.integers(1, 9)) for _ in range(ndim))
+    n = int(np.prod(shape))
+    vals = draw(st.lists(
+        st.floats(min_value=-1e6, max_value=1e6, width=32,
+                  allow_nan=False, allow_infinity=False),
+        min_size=n, max_size=n))
+    return np.asarray(vals, np.float32).reshape(shape)
+
+
+def _bits(a):
+    return np.asarray(a, np.float32).reshape(-1).view(np.int32)
+
+
+@settings(max_examples=50, deadline=None)
+@given(_block_arrays())
+def test_kv_quant_error_bound_per_codec(x):
+    """|x - dequantize(quantize(x))| ≤ the documented bound, per BLOCK
+    from that block's own scale, with no epsilon: int8/int4 scale/2
+    (reconstruction-nearest uniform grid), fp8 scale·16 (half the
+    widest e4m3 level gap).  ``error_bound`` of the max scale must
+    cover every element."""
+    bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    for codec in wirecodec.QUANT_CODECS:
+        q, s = wirecodec.quantize_blocks_for(x, codec)
+        deq = wirecodec.dequantize_blocks_for(q, s, np.float32, codec)
+        half = (s * np.float32(16.0) if codec == wirecodec.CODEC_FP8
+                else (s / 2.0)).astype(np.float32)
+        err = np.abs(deq - x)
+        assert np.all(err <= half.reshape(bshape)), codec
+        assert float(err.max(initial=0.0)) <= wirecodec.error_bound(
+            float(s.max(initial=0.0)), codec), codec
+
+
+@settings(max_examples=40, deadline=None)
+@given(_block_arrays())
+def test_kv_quant_twins_bit_identical(x):
+    """The JAX (device) and numpy (host/fake) halves of every quant
+    codec agree bit-for-bit: q arrays equal, scales identical down to
+    the f32 bit pattern (XLA's reciprocal folds, f16 double-rounding on
+    the e4m3 cast, and subnormal flushes are all designed out)."""
+    import jax.numpy as jnp
+
+    from vtpu.ops import quant
+
+    xj = jnp.asarray(x)
+    pairs = [
+        ("int8", quant.quantize_blockwise, wirecodec.quantize_blocks_np),
+        ("int4", quant.quantize_blockwise_int4,
+         wirecodec.quantize_blocks_int4_np),
+        ("fp8", quant.quantize_blockwise_fp8,
+         wirecodec.quantize_blocks_fp8_np),
+    ]
+    for codec, jax_fn, np_fn in pairs:
+        qj, sj = jax_fn(xj)
+        qn, sn = np_fn(x)
+        assert np.array_equal(np.asarray(qj), qn), codec
+        assert np.array_equal(_bits(sj), _bits(sn)), codec
+    # the nibble packer is part of the int4 wire format: twin it too
+    q4, _ = quant.quantize_blockwise_int4(xj)
+    assert np.array_equal(np.asarray(quant.pack_int4(q4)),
+                          wirecodec.pack_int4_np(np.asarray(q4)))
+
+
+@settings(max_examples=50, deadline=None)
+@given(_block_arrays())
+def test_kv_int4_pack_round_trip(x):
+    """Nibble pack/unpack is lossless over the ±7 grid, odd element
+    counts padded."""
+    q, _s = wirecodec.quantize_blocks_int4_np(x)
+    b = q.shape[0]
+    flat = q.reshape(b, -1)
+    n = flat.shape[1]
+    packed = wirecodec.pack_int4_np(q)
+    assert packed.shape == (b, (n + 1) // 2)
+    assert np.array_equal(wirecodec.unpack_int4_np(packed, n), flat)
+
+
+def test_e4m3_bytes_round_trip_exhaustive():
+    """decode→encode is the identity over every valid e4m3fn byte (the
+    two nan codes excluded), and the JAX encoder agrees byte-for-byte —
+    the integer-ops encode can't drift from the table the numpy twin
+    decodes."""
+    import jax.numpy as jnp
+
+    from vtpu.ops import quant
+
+    valid = np.array(
+        [b for b in range(256) if (b & 0x7F) <= wirecodec._E4M3_MAX_BYTE],
+        dtype=np.uint8)
+    f = wirecodec._e4m3_to_f32_np(valid)
+    assert np.array_equal(wirecodec._f32_to_e4m3_np(f), valid)
+    assert np.array_equal(
+        np.asarray(quant._f32_to_e4m3(jnp.asarray(f))), valid)
+
+
 @settings(max_examples=200, deadline=None)
 @given(st.text(max_size=64))
 def test_decode_never_crashes_on_garbage(blob):

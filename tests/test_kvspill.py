@@ -1,0 +1,293 @@
+"""K/V memory hierarchy (docs/serving.md §Memory hierarchy): the
+BlockPool host spill tier's JAX-free accounting (demotion candidates,
+eviction preference, byte cap, tier gauges), the on-disk PrefixStore
+journal (round trip, pair rotation, torn-journal tolerance), and —
+in the slow JAX lane — the engine-level demote/onload/rehydrate paths
+plus the `make bench-kv SMOKE=1` artifact contract."""
+
+import os
+
+import pytest
+
+from vtpu.serving import kvpool
+from vtpu.serving.kvpersist import PrefixStore
+from vtpu.serving.kvpool import BlockPool
+
+
+def _register(pool, chain, payload_blocks):
+    """Lease, register, release — the engine's lifecycle for a prefix
+    run; the registry pins keep the blocks live after the lease."""
+    blocks = pool.try_lease(payload_blocks)
+    assert blocks is not None
+    pool.register_prefix(chain, blocks)
+    pool.release(blocks)
+    return blocks
+
+
+# ---------------------------------------------------------------------------
+# BlockPool host tier (fast lane, JAX-free)
+# ---------------------------------------------------------------------------
+
+def test_demotion_candidate_lru_maximal():
+    pool = BlockPool(17, 8, pool_id="t-demote")
+    _register(pool, ["a", "b", "c"], 3)
+    _register(pool, ["x", "y"], 2)
+    chain, run = pool.demotion_candidate()
+    assert chain == ["a", "b", "c"] and len(run) == 3  # LRU first
+    pool.store_spilled(chain, b"\x01" * 24, "int8")
+    chain2, run2 = pool.demotion_candidate()
+    assert chain2 == ["x", "y"] and len(run2) == 2
+    pool.store_spilled(chain2, b"\x02" * 16, "int8")
+    assert pool.demotion_candidate() is None
+
+
+def test_store_spilled_frees_blocks_and_serves_matches():
+    pool = BlockPool(17, 8, pool_id="t-match")
+    _register(pool, ["a", "b", "c"], 3)
+    assert pool.free_blocks() == 13
+    pool.store_spilled(["a", "b", "c"], b"\x07" * 24, "int8")
+    assert pool.free_blocks() == 16          # device pins dropped
+    hit = pool.match_spilled(["a", "b", "c", "d"], max_blocks=8)
+    assert hit is not None
+    chain, payload, codec, k = hit
+    assert (tuple(chain), payload, codec, k) == (
+        ("a", "b", "c"), b"\x07" * 24, "int8", 3)
+    # an onload COPIES — the host entry keeps serving later matches
+    assert pool.match_spilled(["a", "b", "c"], 8) is not None
+    assert pool.prefix_match_depth(["a", "b", "c"]) == 3
+    assert pool.prefix_match_depth(["a", "b", "c"],
+                                   include_spilled=False) == 0
+
+
+def test_evict_prefers_spilled_backed_over_lru():
+    pool = BlockPool(17, 8, pool_id="t-evict")
+    _register(pool, ["x", "y", "z"], 3)      # older, NOT spilled
+    _register(pool, ["a", "b", "c"], 3)
+    pool.store_spilled(["a", "b", "c"], b"\x03" * 24, "int8")
+    _register(pool, ["a", "b", "c"], 3)      # re-registered (onload)
+    # 6 pinned, free 10; freeing 13 needs ONE entry dropped — the
+    # spilled-backed newcomer must yield before the truly-cold LRU
+    assert pool.evict_prefixes_for(13)
+    assert pool.prefix_match_depth(["x", "y", "z"],
+                                   include_spilled=False) == 3
+    assert pool.prefix_match_depth(["a", "b", "c"],
+                                   include_spilled=False) == 0
+    assert pool.prefix_match_depth(["a", "b", "c"]) == 3  # host copy
+
+
+def test_spill_byte_cap_lru_eviction_and_replace():
+    pool = BlockPool(5, 8, pool_id="t-cap", spill_max_bytes=100)
+    assert pool.rehydrate_spilled(["a"], b"\x01" * 60, "int8")
+    assert pool.rehydrate_spilled(["b"], b"\x02" * 60, "int8")
+    st = pool.stats()
+    assert st["spilled_runs"] == 1 and st["spilled_bytes"] == 60
+    assert pool.match_spilled(["b"], 8) is not None   # LRU 'a' evicted
+    assert pool.match_spilled(["a"], 8) is None
+    # replace-by-key never double-counts bytes
+    assert pool.rehydrate_spilled(["b"], b"\x04" * 80, "int8")
+    st = pool.stats()
+    assert st["spilled_runs"] == 1 and st["spilled_bytes"] == 80
+    # one oversized entry is kept (keep >= 1: spill must not wedge)
+    assert pool.rehydrate_spilled(["c"], b"\x05" * 500, "int8")
+    assert pool.stats()["spilled_runs"] == 1
+    assert pool.match_spilled(["c"], 8) is not None
+
+
+def test_known_chains_tier_gauge_and_close_prunes_labels():
+    pool = BlockPool(17, 8, pool_id="t-gauge")
+    _register(pool, ["d1", "d2"], 2)
+    pool.rehydrate_spilled(["s1", "s2", "s3"], b"\x09" * 24, "int8")
+    chains = pool.known_chains()
+    assert ("s1", "s2", "s3") in chains and ("d1", "d2") in chains
+    g = kvpool.POOL_TIER_BLOCKS
+    assert g.value(pool="t-gauge", tier="device") == 17.0
+    assert g.value(pool="t-gauge", tier="host") == 3.0
+    pool.set_disk_blocks(5)
+    assert g.value(pool="t-gauge", tier="disk") == 5.0
+    pool.close()
+    for tier in ("device", "host", "disk"):
+        assert g.value(pool="t-gauge", tier=tier) == 0.0
+    pool.close()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# PrefixStore journal (fast lane, disk only)
+# ---------------------------------------------------------------------------
+
+def test_prefix_store_round_trip_and_last_wins(tmp_path):
+    store = PrefixStore(str(tmp_path / "d"), sig="s1")
+    store.append(["a", "b"], b"\x01" * 40, "int8", 16)
+    store.append(["x"], b"\x02" * 20, "int4", 16)
+    store.append(["a", "b"], b"\x03" * 40, "int8", 16)  # same digest
+    assert not store.dead
+    store.close()
+    got = {c[-1]: (c, p, co, bs)
+           for c, p, co, bs in PrefixStore(str(tmp_path / "d"),
+                                           sig="s1").load()}
+    assert set(got) == {"b", "x"}
+    assert got["b"] == (("a", "b"), b"\x03" * 40, "int8", 16)
+    assert got["x"] == (("x",), b"\x02" * 20, "int4", 16)
+
+
+def test_prefix_store_foreign_sig_dropped(tmp_path):
+    store = PrefixStore(str(tmp_path / "d"), sig="s1")
+    store.append(["a"], b"\x01" * 8, "int8", 16)
+    store.close()
+    assert PrefixStore(str(tmp_path / "d"), sig="OTHER").load() == []
+    assert len(PrefixStore(str(tmp_path / "d"), sig="s1").load()) == 1
+
+
+def test_prefix_store_torn_tail_and_garbage_index(tmp_path):
+    store = PrefixStore(str(tmp_path / "d"), sig="")
+    for i in range(3):
+        store.append([f"c{i}"], bytes([i]) * 64, "int8", 16)
+    store.close()
+    seg = os.path.join(str(tmp_path / "d"), "prefix_segments.bin")
+    idx = os.path.join(str(tmp_path / "d"), "prefix_index.jsonl")
+    with open(seg, "r+b") as f:
+        f.truncate(os.path.getsize(seg) - 10)   # torn last record
+    with open(idx, "a") as f:
+        f.write('{"half a reco\n')              # torn index append
+    got = PrefixStore(str(tmp_path / "d")).load()
+    assert sorted(c[-1] for c, _p, _co, _bs in got) == ["c0", "c1"]
+
+
+def test_prefix_store_pair_rotation(tmp_path):
+    store = PrefixStore(str(tmp_path / "d"), sig="", max_bytes=200)
+    store.append(["r0"], b"\x00" * 120, "int8", 16)
+    store.append(["r1"], b"\x01" * 120, "int8", 16)  # rotates the pair
+    store.close()
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "d"), "prefix_segments.bin.1"))
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "d"), "prefix_index.jsonl.1"))
+    got = PrefixStore(str(tmp_path / "d")).load()
+    assert sorted(c[-1] for c, _p, _co, _bs in got) == ["r0", "r1"]
+
+
+# ---------------------------------------------------------------------------
+# Engine paths (slow JAX lane) + the bench-kv SMOKE contract
+# ---------------------------------------------------------------------------
+
+def _small_setup(pool_blocks):
+    import jax
+    import jax.numpy as jnp
+
+    from vtpu.models.transformer import TransformerLM
+
+    kw = dict(vocab=64, d_model=32, depth=2, num_heads=4, max_seq=64)
+    m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=8,
+                      kv_pool_blocks=pool_blocks)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"]
+    m_big = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=8,
+                          kv_pool_blocks=65)
+    return m, m_big, params
+
+
+@pytest.mark.slow
+def test_engine_spill_demote_onload_token_exact():
+    """Working set > device pool: the engine demotes under lease
+    pressure, onloads on revisit, and every transcript still matches
+    the monolithic batcher token-for-token."""
+    import numpy as np
+
+    from benchmarks.serving_disagg import _kv_drive_one, _kv_stack
+    from vtpu.serving.paged import PagedBatcher
+
+    m, m_big, params = _small_setup(13)   # 12 leasable
+    rng = np.random.default_rng(5)
+    prefixes = [rng.integers(0, 64, 24).astype(np.int32)  # 3 blocks
+                for _ in range(4)]
+    reqs = [(f"r{i}", np.concatenate(
+        [prefixes[i], rng.integers(0, 64, 5).astype(np.int32)]), 3)
+        for i in range(4)]
+    revisit = ("rv0", np.concatenate(
+        [prefixes[0], rng.integers(0, 64, 5).astype(np.int32)]), 3)
+
+    mono = PagedBatcher(m_big, params, max_batch=4, eos_id=2)
+    for rid, p, n in reqs + [revisit]:
+        mono.submit(rid, p, num_new=n)
+    want = {rid: list(t) for rid, t in mono.run().items()}
+
+    pf, dec, rep = _kv_stack(m, params, host_spill=True)
+    for r in reqs:
+        _kv_drive_one(pf, dec, rep, *r)
+    assert pf.spill_demotions >= 1       # 4x3 prefix blocks > capacity
+    o0 = pf.spill_onloads
+    _kv_drive_one(pf, dec, rep, *revisit)
+    assert pf.spill_onloads == o0 + 1    # revisit hit the host tier
+    dec._flush_first_tokens()
+    got = {rid: list(dec.out[rid]) for rid in want}
+    assert got == want
+    # full teardown leaves the pool leak-free (spilled-backed entries
+    # drop without losing the host copies)
+    assert pf.pool.evict_prefixes_for(pf.pool.leasable())
+    st = pf.pool.stats()
+    assert st["leased"] == 0 and st["free"] == st["pool_blocks"] - 1
+    assert dec.pool.stats()["leased"] == 0
+
+
+@pytest.mark.slow
+def test_engine_persist_restart_rehydrates(tmp_path):
+    """Generation 2 rehydrates generation 1's journal and serves the
+    persisted prefix via an onload — token-exact vs monolithic."""
+    import numpy as np
+
+    from benchmarks.serving_disagg import _kv_drive_one, _kv_stack
+    from vtpu.serving.paged import PagedBatcher
+
+    m, m_big, params = _small_setup(33)
+    d = str(tmp_path / "persist")
+    rng = np.random.default_rng(11)
+    prefix = rng.integers(0, 64, 24).astype(np.int32)
+    req = ("f0", np.concatenate(
+        [prefix, rng.integers(0, 64, 5).astype(np.int32)]), 3)
+    mono = PagedBatcher(m_big, params, max_batch=4, eos_id=2)
+    mono.submit(*req[:2], num_new=req[2])
+    want = {req[0]: list(mono.run()[req[0]])}
+
+    r0 = kvpool.SPILL_REHYDRATIONS.value()
+    pf1, dec1, rep1 = _kv_stack(m, params, host_spill=True,
+                                persist_dir=d)
+    seed = ("seed", np.concatenate(
+        [prefix, rng.integers(0, 64, 5).astype(np.int32)]), 3)
+    _kv_drive_one(pf1, dec1, rep1, *seed)
+    assert pf1._demote_for(pf1.pool.leasable())
+    assert pf1._persist.blocks_journaled == 3
+    pf1._persist.close()
+
+    pf2, dec2, rep2 = _kv_stack(m, params, host_spill=True,
+                                persist_dir=d)
+    st = pf2.pool.stats()
+    assert st["spilled_runs"] == 1 and st["spilled_blocks"] == 3
+    assert kvpool.SPILL_REHYDRATIONS.value() == r0 + 1
+    o0 = pf2.spill_onloads
+    _kv_drive_one(pf2, dec2, rep2, *req)
+    assert pf2.spill_onloads == o0 + 1
+    dec2._flush_first_tokens()
+    assert {req[0]: list(dec2.out[req[0]])} == want
+
+
+@pytest.mark.slow
+def test_bench_kv_smoke_artifact_schema(tmp_path):
+    """`make bench-kv SMOKE=1` contract: schema-complete artifact, the
+    codec curve's byte floors, spill/restart/torn-journal arms all
+    enforced inside the bench (the committed artifact's numbers come
+    from the full run)."""
+    import json
+
+    from benchmarks import serving_disagg
+
+    out = tmp_path / "serving_kv.json"
+    rc = serving_disagg.main(["--kv", "--smoke", "--out", str(out)])
+    assert rc == 0
+    res = json.loads(out.read_text())
+    assert set(res["codec_curve"]) == set(serving_disagg.KV_CODECS)
+    assert res["codec_curve"]["fp32"]["token_exact"] is True
+    assert res["headline"]["int4_wire_byte_reduction_x"] >= 6.0
+    assert res["spill"]["overcommit"] is True
+    assert res["spill"]["demotions"] >= 1
+    assert res["spill"]["onloads"] >= 1
+    assert res["restart"]["rehydrated_onloads"] >= 1
+    assert res["torn_journal"]["ok"] is True

@@ -57,19 +57,20 @@ def is_quantized(x) -> bool:
     return isinstance(x, QuantizedTensor)
 
 
-def _nearest_int(xf, scale):
+def _nearest_int(xf, scale, max_q: int = 127):
     """The integer level whose f32 RECONSTRUCTION (``q * scale``) is
     nearest to ``xf`` — not ``round(xf / scale)``.  The f32 division
     can round a just-below-half ratio onto an exact ``.5`` tie, which
     ``round()`` resolves upward and the reconstruction error breaches
     the documented ``scale/2`` bound by an ulp; comparing the two
     candidate reconstructions directly keeps the bound honest in the
-    arithmetic the caller actually reads back."""
+    arithmetic the caller actually reads back.  ``max_q`` selects the
+    grid: 127 for int8, 7 for the packed int4 wire codec."""
     lo = jnp.floor(xf / scale)
     hi = lo + 1.0
     q = jnp.where(jnp.abs(hi * scale - xf) < jnp.abs(lo * scale - xf),
                   hi, lo)
-    return jnp.clip(q, -127, 127)
+    return jnp.clip(q, -max_q, max_q)
 
 
 def quantize_int8(w, axis: int = 0) -> QuantizedTensor:
@@ -108,6 +109,142 @@ def dequantize_blockwise(q, scale, dtype=jnp.bfloat16):
     fuses the convert-multiply into the consuming scatter (the wire
     receiver's incremental per-chunk adopt does exactly that)."""
     return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+def quantize_blockwise_int4(x):
+    """Per-block symmetric int4 (15 levels, ``q in [-7, 7]``) with one
+    f32 scale per leading-axis block — the sub-byte K/V wire codec's
+    device half.  Returns UNPACKED ``(q int8 [b, ...], scale f32
+    [b, 1, ..])``; :func:`pack_int4` nibble-packs inside the same jit so
+    the D2H moves ~8x fewer bytes than fp32.  Per-element
+    reconstruction error is bounded by ``scale/2 = absmax/14``
+    (reconstruction-nearest, same grid discipline as int8)."""
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(1, x.ndim))
+    amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+    # explicit reciprocal-multiply, not division: XLA folds constant
+    # divisors into a reciprocal multiply whose result can sit one ulp
+    # off IEEE division, and the numpy twin must match bit-for-bit.
+    # The guard is on the PRODUCT and floors it at the smallest f32
+    # NORMAL: a subnormal scale would hit XLA's flush-to-zero and
+    # diverge from numpy, and scale=1 keeps the bound trivially true
+    # (error = |x| <= absmax, far under scale/2).
+    s0 = amax * jnp.float32(1.0 / 7.0)
+    scale = jnp.where(s0 >= jnp.float32(2.0 ** -126), s0, 1.0)
+    q = _nearest_int(xf, scale, max_q=7).astype(jnp.int8)
+    return q, scale
+
+
+def pack_int4(q):
+    """Nibble-pack int4-valued int8 ``[b, ...]`` to ``uint8
+    [b, ceil(n/2)]`` (low nibble = even flat index).  Jit-safe; the
+    numpy twin is ``vtpu.serving.wirecodec.pack_int4_np``."""
+    b = q.shape[0]
+    flat = q.reshape(b, -1)
+    n = flat.shape[1]
+    if n % 2:
+        flat = jnp.pad(flat, ((0, 0), (0, 1)))
+    u = (flat & 0x0F).astype(jnp.uint8)
+    return u[:, 0::2] | (u[:, 1::2] << 4)
+
+
+# --- fp8 (e4m3fn) codec — explicit integer-ops encode/decode ----------
+#
+# XLA's f32→f8e4m3fn convert double-rounds through f16 on some
+# backends (observed on CPU), so a dtype cast cannot be bit-identical
+# to the ml_dtypes/numpy twin.  Both halves are therefore written as
+# pure integer/bitcast arithmetic — deterministic on every backend and
+# duplicated op-for-op in wirecodec's numpy twin.
+
+_E4M3_MAX = 448.0          # largest finite e4m3fn magnitude
+_E4M3_MAX_BYTE = 0x7E      # its encoding (exp field 15, mantissa 6)
+
+
+def _f32_to_e4m3(y):
+    """Round-to-nearest-even f32 → e4m3fn byte (sign-magnitude uint8).
+    ``y`` must already be clipped to ``[-448, 448]``; saturates any
+    post-rounding overflow to ±448 (e4m3fn has no inf)."""
+    u = jax.lax.bitcast_convert_type(y.astype(jnp.float32), jnp.int32)
+    sign = jnp.where(u < 0, jnp.int32(0x80), jnp.int32(0))
+    a = u & 0x7FFFFFFF
+    exp = a >> 23
+    man = a & 0x7FFFFF
+    # normal range (f32 exp >= 121 ⇔ |y| >= 2^-6): RN-even the 23-bit
+    # mantissa down to 3 bits, carrying into the exponent on overflow
+    keep = man >> 20
+    rest = man & 0xFFFFF
+    carry = ((rest > 0x80000)
+             | ((rest == 0x80000) & ((keep & 1) == 1))).astype(jnp.int32)
+    m = keep + carry
+    exp2 = jnp.where(m == 8, exp + 1, exp)
+    m2 = jnp.where(m == 8, 0, m)
+    norm = ((exp2 - 120) << 3) | m2
+    norm = jnp.where((exp2 > 135) | ((exp2 == 135) & (m2 == 7)),
+                     _E4M3_MAX_BYTE, norm)
+    # subnormal range (|y| < 2^-6): RN-even onto the 2^-9 grid.  The
+    # shift clamp at 5 keeps every intermediate in int32; anything that
+    # small rounds to zero through the same arithmetic.
+    shift = jnp.clip(121 - exp, 0, 5)
+    k = 20 + shift
+    sig = man | (1 << 23)
+    rem = sig & ((1 << k) - 1)
+    half = 1 << (k - 1)
+    keep_s = sig >> k
+    sub = keep_s + ((rem > half)
+                    | ((rem == half) & ((keep_s & 1) == 1))).astype(jnp.int32)
+    byte = jnp.where(a == 0, 0, jnp.where(exp < 121, sub, norm))
+    return (sign | byte).astype(jnp.uint8)
+
+
+def _e4m3_to_f32(b):
+    """Exact e4m3fn byte → f32 (bit construction; no rounding)."""
+    bi = b.astype(jnp.int32)
+    s = bi >> 7
+    f = (bi >> 3) & 0xF
+    m = bi & 7
+    normbits = ((f + 120) << 23) | (m << 20)
+    norm = jax.lax.bitcast_convert_type(normbits, jnp.float32)
+    sub = m.astype(jnp.float32) * jnp.float32(2.0 ** -9)
+    mag = jnp.where(f == 0, sub, norm)
+    return jnp.where(s == 1, -mag, mag)
+
+
+def quantize_blockwise_fp8(x):
+    """Per-block e4m3fn fp8 with one f32 scale per leading-axis block
+    (``scale = absmax/448`` maps each block's absmax onto the largest
+    finite e4m3 magnitude).  Returns ``(q uint8 [b, ...], scale f32
+    [b, 1, ..])``.  Like the int grids, the emitted byte is the
+    candidate whose f32 RECONSTRUCTION (``decode(q) * scale``) is
+    nearest to ``x`` — the e4m3 byte ordering is monotone in magnitude,
+    so the two neighbouring bytes are the only other candidates.
+    Per-element reconstruction error is bounded by ``scale * 16`` (half
+    the widest e4m3 level gap, in the top binade [256, 448])."""
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(1, x.ndim))
+    amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+    # reciprocal-multiply + product-side zero guard: see
+    # quantize_blockwise_int4 (the numpy twin must match bit-for-bit)
+    s0 = amax * jnp.float32(1.0 / _E4M3_MAX)
+    scale = jnp.where(s0 >= jnp.float32(2.0 ** -126), s0, 1.0)
+    y = jnp.clip(xf / scale, -_E4M3_MAX, _E4M3_MAX)
+    q0 = _f32_to_e4m3(y).astype(jnp.int32)
+    sign = q0 & 0x80
+    mag = q0 & 0x7F
+    lo = jnp.maximum(mag - 1, 0)
+    hi = jnp.minimum(mag + 1, _E4M3_MAX_BYTE)
+    err = jnp.abs(_e4m3_to_f32((sign | mag).astype(jnp.uint8)) * scale - xf)
+    e_lo = jnp.abs(_e4m3_to_f32((sign | lo).astype(jnp.uint8)) * scale - xf)
+    e_hi = jnp.abs(_e4m3_to_f32((sign | hi).astype(jnp.uint8)) * scale - xf)
+    best = jnp.where(e_lo < err, lo, mag)
+    berr = jnp.minimum(e_lo, err)
+    best = jnp.where(e_hi < berr, hi, best)
+    return (sign | best).astype(jnp.uint8), scale
+
+
+def dequantize_blockwise_fp8(q, scale, dtype=jnp.bfloat16):
+    """Inverse of :func:`quantize_blockwise_fp8`; call INSIDE jit so the
+    bit-decode and scale multiply fuse into the consuming scatter."""
+    return (_e4m3_to_f32(q) * scale).astype(dtype)
 
 
 def quantize_tree(params, min_elems: int = 16384):

@@ -46,6 +46,8 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import hashlib
+import json
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -58,8 +60,12 @@ from vtpu.analysis.witness import make_lock
 from vtpu.models.transformer import TransformerLM, _zero_cache, bucket_length
 from vtpu.ops.quant import (
     dequantize_blockwise,
+    dequantize_blockwise_fp8,
     dequantize_tree,
+    pack_int4,
     quantize_blockwise,
+    quantize_blockwise_fp8,
+    quantize_blockwise_int4,
 )
 from vtpu.serving import batcher as _batcher
 from vtpu.serving import wirecodec
@@ -71,6 +77,7 @@ from vtpu.serving.kvpool import (
     PREFIX_MISSES,
     SPEC_ADOPTIONS,
     SPEC_ROLLBACKS,
+    SPILL_ONLOADS,
     BlockPool,
     KVHandle,
     KVHandoffError,
@@ -106,11 +113,13 @@ class HostExtract:
     ships chunks only once the copy has landed, never blocking the
     pump on a device sync.
 
-    Under the ``int8`` wire codec the extract holds per-leaf
-    ``(q int8, scale f32)`` pairs instead of raw leaves — the blockwise
-    quantization fused into the device gather — and ``payload`` emits
-    the wirecodec chunk layout (per leaf: scales ‖ int8 data), so the
-    D2H itself already moves ~4x fewer bytes."""
+    Under the quantized wire codecs (``int8``, ``fp8``, ``int4``) the
+    extract holds per-leaf ``(q, scale f32)`` pairs instead of raw
+    leaves — the blockwise quantization fused into the device gather
+    (int4 additionally nibble-packed on device) — and ``payload`` emits
+    the wirecodec chunk layout (per leaf: scales ‖ quantized data), so
+    the D2H itself already moves ~4x (int8/fp8) to ~8x (int4) fewer
+    bytes."""
 
     def __init__(self, gathered: list, nblocks: int,
                  codec: str = wirecodec.CODEC_FP32,
@@ -121,17 +130,18 @@ class HostExtract:
         self.nblocks = nblocks
         self._np: Optional[list] = None
         self._np_scales: Optional[list] = None
-        # one source of truth for the chunk byte layout: the wirecodec
-        # helpers the receiver's split_quant_payload validates against
-        per_leaf = [
-            (int(np.prod(leaf.shape[1:])), leaf.shape[1:], leaf.dtype)
+        # chunk byte layout computed from the GATHERED arrays themselves
+        # (int4 arrives nibble-packed, so its leaf widths already differ
+        # from the pool's): payload elements at their wire itemsize,
+        # plus one f32 scale per (block, leaf) under a quantized codec —
+        # matching wirecodec.block_bytes(per_leaf, codec) on the pool's
+        # per-leaf meta, which the receiver validates against
+        self.per_block = sum(
+            int(np.prod(leaf.shape[1:])) * np.dtype(leaf.dtype).itemsize
             for leaf in gathered
-        ]
-        self.per_block = (
-            wirecodec.quant_block_bytes(per_leaf)
-            if codec == wirecodec.CODEC_INT8
-            else wirecodec.fp32_block_bytes(per_leaf)
         )
+        if codec in wirecodec.QUANT_CODECS:
+            self.per_block += 4 * len(gathered)
 
     def layout(self) -> list:
         return pool_layout(self._dev)
@@ -149,8 +159,8 @@ class HostExtract:
 
     def payload(self, lo: int, hi: int) -> bytes:
         """Serialized bytes of blocks [lo, hi): per-leaf slices in
-        flatten order, concatenated (int8 codec: per-leaf scale segment
-        then int8 data, the wirecodec chunk layout)."""
+        flatten order, concatenated (quantized codecs: per-leaf scale
+        segment then quantized data, the wirecodec chunk layout)."""
         if self._np is None:
             # the async copy was issued at construction; this is a
             # cheap view by the time ready_blocks() said go
@@ -159,7 +169,7 @@ class HostExtract:
                 self._np_scales = [
                     np.asarray(s, dtype="<f4") for s in self._dev_scales  # vtpu: allow(jax-hygiene) — same D2H, landed
                 ]
-        if self.codec == wirecodec.CODEC_INT8:
+        if self.codec in wirecodec.QUANT_CODECS:
             assert self._np_scales is not None
             return b"".join(
                 np.ascontiguousarray(s[lo:hi]).tobytes()
@@ -216,33 +226,43 @@ def _pow2(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
-def _make_wire_gathers():
-    """The two fused extract programs both engine roles share: a plain
-    row gather of pool blocks, and the int8 variant with the blockwise
-    quantization fused in (one f32 scale per (block, leaf)) so the
-    async D2H itself moves ~4x fewer bytes."""
+def _make_wire_gathers() -> dict:
+    """The fused extract programs both engine roles share, one per wire
+    codec: a plain row gather of pool blocks (fp32), and the quantized
+    variants with the blockwise codec fused in (one f32 scale per
+    (block, leaf); int4 additionally nibble-packs on device) so the
+    async D2H itself moves ~4x–8x fewer bytes."""
     @jax.jit
     def _gather(pools, idx):
         return jax.tree.map(lambda leaf: leaf[idx], pools)
 
-    @jax.jit
-    def _gather_quant(pools, idx):
-        qs, scales = [], []
-        for leaf in jax.tree_util.tree_leaves(
-            jax.tree.map(lambda x: x[idx], pools)
-        ):
-            q, s = quantize_blockwise(leaf)
-            qs.append(q)
-            scales.append(s.reshape(-1).astype(jnp.float32))
-        return qs, scales
+    def _quant_gather(quantize, post=None):
+        @jax.jit
+        def _g(pools, idx):
+            qs, scales = [], []
+            for leaf in jax.tree_util.tree_leaves(
+                jax.tree.map(lambda x: x[idx], pools)
+            ):
+                q, s = quantize(leaf)
+                qs.append(post(q) if post is not None else q)
+                scales.append(s.reshape(-1).astype(jnp.float32))
+            return qs, scales
+        return _g
 
-    return _gather, _gather_quant
+    return {
+        wirecodec.CODEC_FP32: _gather,
+        wirecodec.CODEC_INT8: _quant_gather(quantize_blockwise),
+        wirecodec.CODEC_FP8: _quant_gather(quantize_blockwise_fp8),
+        wirecodec.CODEC_INT4: _quant_gather(quantize_blockwise_int4,
+                                            post=pack_int4),
+    }
 
 
-def _extract_blocks(pools, blocks, codec, gather, gather_quant
+def _extract_blocks(pools, blocks, codec, gathers: dict
                     ) -> "HostExtract":
-    """Shared extract body: fused gather (quantizing under the int8
-    codec), immediate async D2H, wrapped in a :class:`HostExtract`.
+    """Shared extract body: fused gather (quantizing under the int8/
+    fp8/int4 codecs), immediate async D2H, wrapped in a
+    :class:`HostExtract`.
     DISPATCH FENCING IS THE CALLER'S JOB — the prefill engine holds its
     ``_dispatch_lock`` (its donating admission program races a pump
     thread's gather); the decode engine's session-export extract runs
@@ -254,10 +274,12 @@ def _extract_blocks(pools, blocks, codec, gather, gather_quant
     # pow-2 row buckets keep the gather's compile count bounded
     idx = jnp.asarray(padded, jnp.int32)
     scales = None
-    if codec == wirecodec.CODEC_INT8:
-        gathered, scales = gather_quant(pools, idx)
+    if codec in wirecodec.QUANT_CODECS:
+        gathered, scales = gathers[codec](pools, idx)
     else:
-        gathered = jax.tree_util.tree_leaves(gather(pools, idx))
+        gathered = jax.tree_util.tree_leaves(
+            gathers[wirecodec.CODEC_FP32](pools, idx)
+        )
     for g in list(gathered) + list(scales or []):
         getattr(g, "copy_to_host_async", lambda: None)()
     return HostExtract(gathered, n, codec=codec, scales=scales)
@@ -277,7 +299,9 @@ class PrefillEngine:
     def __init__(self, model: TransformerLM, params, *,
                  shared_with: Optional["DecodeEngine"] = None,
                  bucket_prefill: bool = True,
-                 prefix_cache: bool = False) -> None:
+                 prefix_cache: bool = False,
+                 host_spill: Optional[bool] = None,
+                 persist_dir: Optional[str] = None) -> None:
         if model.kv_cache_layout != "paged" or model.kv_pool_blocks <= 1:
             raise ValueError(
                 "PrefillEngine needs kv_cache_layout='paged' and a real "
@@ -349,8 +373,85 @@ class PrefillEngine:
         self._pf = _pf
 
         # the device half of a wire extract (shared with the decode
-        # engine's session export — _make_wire_gathers)
-        self._wire_gather, self._wire_gather_quant = _make_wire_gathers()
+        # engine's session export — _make_wire_gathers): one fused
+        # gather per wire codec
+        self._wire_gathers = _make_wire_gathers()
+
+        # host-DRAM spill tier (docs/serving.md §Memory hierarchy):
+        # opt-in (VTPU_KV_HOST_SPILL or host_spill=True), standalone
+        # pools only — a shared pool's decode engine donates the same
+        # leaves the spill scatter would, and the co-located topology
+        # already keeps its prefixes in the one device pool.  Demotion
+        # quantizes through the wire gather (VTPU_KV_SPILL_CODEC);
+        # onload scatters back through the dequantizing adoption put.
+        from vtpu.utils.envs import env_bool, env_str
+        spill = (env_bool("VTPU_KV_HOST_SPILL", False)
+                 if host_spill is None else bool(host_spill))
+        self.host_spill = bool(
+            spill and self._pools is not None and self.prefix_cache
+        )
+        self._spill_codec = env_str("VTPU_KV_SPILL_CODEC",
+                                    wirecodec.CODEC_INT8)
+        if self._spill_codec not in wirecodec.QUANT_CODECS:
+            self._spill_codec = wirecodec.CODEC_INT8
+        self.spill_demotions = 0
+        self.spill_onloads = 0
+        self._spill_meta = None
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _spill_put(pools, idx, chunk_q, chunk_s):
+            """Spill-tier onload (int-grid half — int8 and unpacked
+            int4 share the same dequant): scatter a demoted run's
+            payload into freshly leased blocks, the blockwise dequant
+            fused into the donated scatter."""
+            return jax.tree.map(
+                lambda dst, q, s: dst.at[idx].set(
+                    dequantize_blockwise(q, s, dst.dtype)
+                ),
+                pools, chunk_q, chunk_s,
+            )
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _spill_put_fp8(pools, idx, chunk_q, chunk_s):
+            """fp8 half of the onload scatter: raw e4m3 bytes up, the
+            bit-decode and scale multiply transient inside the fused
+            program."""
+            return jax.tree.map(
+                lambda dst, q, s: dst.at[idx].set(
+                    dequantize_blockwise_fp8(q, s, dst.dtype)
+                ),
+                pools, chunk_q, chunk_s,
+            )
+
+        self._spill_put = _spill_put
+        self._spill_put_fp8 = _spill_put_fp8
+
+        # prefix persistence (tier three — vtpu/serving/kvpersist.py):
+        # demotions journal to VTPU_KV_PERSIST_DIR; a restarted replica
+        # rehydrates its host tier (and, via the router, the cluster's
+        # PrefixIndex) instead of recomputing the fleet's shared
+        # prompts.  Requires the spill tier (the journal's payloads ARE
+        # spill payloads).
+        pdir = persist_dir
+        if pdir is None:
+            pdir = env_str("VTPU_KV_PERSIST_DIR", "")
+        self._persist = None
+        if pdir and self.host_spill:
+            from vtpu.serving.kvpersist import PrefixStore
+            self._persist = PrefixStore(pdir, sig=self._persist_sig())
+            for chain, payload, codec, bs in self._persist.load():
+                if bs != self.block_size:
+                    continue
+                if codec not in wirecodec.QUANT_CODECS:
+                    continue
+                _treedef, per_leaf = self._spill_leaf_meta()
+                if len(payload) != len(chain) * wirecodec.block_bytes(
+                        per_leaf, codec):
+                    continue  # stale geometry despite matching sig
+                if len(chain) > self.pool.leasable():
+                    continue  # could never onload here
+                self.pool.rehydrate_spilled(chain, payload, codec)
+            self.pool.set_disk_blocks(self._persist.blocks_journaled)
 
     # -- wire transport (sender side) ----------------------------------
     def wire_layout(self) -> list:
@@ -365,11 +466,135 @@ class PrefillEngine:
         ``copy_to_host_async`` starts the transfer immediately — by the
         time the sender's pump asks for payload, the bytes are host-side
         without a blocking sync.  ``codec`` is the stream's NEGOTIATED
-        codec: under ``int8`` the quantization fuses into the gather."""
+        codec: under int8/fp8/int4 the quantization fuses into the
+        gather."""
         with self._dispatch_lock:
             return _extract_blocks(self.pool_leaves(), blocks, codec,
-                                   self._wire_gather,
-                                   self._wire_gather_quant)
+                                   self._wire_gathers)
+
+    # -- host-DRAM spill tier (docs/serving.md §Memory hierarchy) ------
+    def _spill_leaf_meta(self):
+        """(treedef, [(n_elem, shape, dtype)]) of the pool leaves —
+        invariant for the engine's lifetime (the onload scatter's parse
+        input, mirroring the decode engine's _wire_leaf_meta)."""
+        meta = self._spill_meta
+        if meta is None:
+            leaves, treedef = jax.tree_util.tree_flatten(
+                self.pool_leaves()
+            )
+            per_leaf = [
+                (int(np.prod(leaf.shape[1:])), leaf.shape[1:],
+                 np.dtype(leaf.dtype))
+                for leaf in leaves
+            ]
+            meta = self._spill_meta = (treedef, per_leaf)
+        return meta
+
+    def _persist_sig(self) -> str:
+        """Layout signature journaled with every persisted run: pool
+        leaf shapes/dtypes + block size.  A restarted replica with a
+        different model or pool geometry must never scatter a foreign
+        journal's payloads, so load drops records whose sig differs."""
+        doc = {"layout": pool_layout(self.pool_leaves()),
+               "block_size": self.block_size}
+        return hashlib.sha256(
+            json.dumps(doc, sort_keys=True).encode()
+        ).hexdigest()[:16]
+
+    def _demote_for(self, need: int) -> bool:
+        """Lease pressure, demotion before eviction: gather + quantize
+        the LRU maximal registered run into the host tier (and the
+        persistence journal), drop its device pins, repeat until
+        ``need`` blocks are free or no candidate remains.  The blocking
+        D2H here is deliberate — this path runs only when the pool is
+        out of blocks, and host bytes are the whole point."""
+        if not self.host_spill:
+            return False
+        progressed = False
+        while self.pool.free_blocks() < need:
+            cand = self.pool.demotion_candidate()
+            if cand is None:
+                break
+            chain, run = cand
+            ex = self.start_extract(run, codec=self._spill_codec)
+            payload = ex.payload(0, len(run))  # sync: waits for the D2H
+            self.pool.store_spilled(chain, payload, self._spill_codec)
+            self.spill_demotions += 1
+            progressed = True
+            if self._persist is not None:
+                self._persist.append(chain, payload, self._spill_codec,
+                                     self.block_size)
+                self.pool.set_disk_blocks(self._persist.blocks_journaled)
+        return progressed and self.pool.free_blocks() >= need
+
+    def _maybe_onload(self, chain: List[str], max_blocks: int) -> None:
+        """Host-tier hit: when the spill tier holds a deeper run than
+        the device registry, lease blocks, scatter the dequantized
+        payload back (the adoption scatter), and re-register the chain
+        — the admission loop's ``match_and_ref`` right after then hits
+        device-side.  Under steady overcommit the pool rarely has ``k``
+        blocks free, so lease pressure here demotes LRU residents first
+        (``demotion_candidate`` never picks the run being onloaded — it
+        is spilled, not registered — so a hot/cold pair can't
+        ping-pong); only when demotion can't make room does the prompt
+        fall back to prefilling from scratch."""
+        if not self.host_spill or not chain:
+            return
+        hit = self.pool.match_spilled(chain, max_blocks)
+        if hit is None:
+            return
+        sub_chain, payload, codec, k = hit
+        if k <= self.pool.prefix_match_depth(chain,
+                                             include_spilled=False):
+            return  # device registry already serves this depth or more
+        _treedef, per_leaf = self._spill_leaf_meta()
+        if len(payload) != k * wirecodec.block_bytes(per_leaf, codec):
+            return  # corrupt host entry: fall back to recompute
+        blocks = self.pool.try_lease(k)
+        if blocks is None and self._demote_for(k):
+            blocks = self.pool.try_lease(k)
+        if blocks is None:
+            return
+        self._spill_scatter(blocks, payload, codec, k)
+        self.pool.register_prefix(sub_chain, blocks)
+        # the registry's pins keep the blocks live; the lease hands off
+        self.pool.release(blocks)
+        self.spill_onloads += 1
+        SPILL_ONLOADS.inc()
+
+    def _spill_scatter(self, blocks: List[int], payload: bytes,
+                       codec: str, k: int) -> None:
+        """The device half of an onload: parse the spill payload
+        host-side (int4 nibbles sign-extend to the int8 grid there) and
+        scatter it into ``blocks`` with the dequant fused into the
+        donated put — one program per pow-2 block count."""
+        treedef, per_leaf = self._spill_leaf_meta()
+        parsed = wirecodec.split_payload(
+            memoryview(payload), per_leaf, k, codec
+        )
+        cb = _pow2(k)
+        idx = np.zeros((cb,), np.int32)  # pad rows → garbage block 0
+        idx[:k] = blocks
+        pad_dt = (np.uint8 if codec == wirecodec.CODEC_FP8
+                  else np.int8)
+        q_leaves, s_leaves = [], []
+        for (scales, q), (_n, shape, _dt) in zip(parsed, per_leaf):
+            if cb > k:
+                q = np.concatenate(
+                    [q, np.zeros((cb - k,) + tuple(shape), pad_dt)],
+                    axis=0)
+                scales = np.concatenate(
+                    [scales, np.ones((cb - k,), np.float32)])
+            q_leaves.append(q)
+            s_leaves.append(scales.astype(np.float32).reshape(
+                (cb,) + (1,) * len(shape)))
+        chunk_q = jax.tree_util.tree_unflatten(treedef, q_leaves)
+        chunk_s = jax.tree_util.tree_unflatten(treedef, s_leaves)
+        put = (self._spill_put_fp8 if codec == wirecodec.CODEC_FP8
+               else self._spill_put)
+        with self._dispatch_lock:
+            self._pools = put(self._pools, jnp.asarray(idx),
+                              chunk_q, chunk_s)
 
     # ------------------------------------------------------------------
     def _blocks_needed(self, prompt_len: int, num_new: int) -> int:
@@ -462,14 +687,22 @@ class PrefillEngine:
                 # leave >= 1 suffix token: admission needs last-token
                 # logits, exactly like the paged engine's matcher
                 max_blocks = (p.size - 1) // self.block_size
+                # host-tier hit first: a spilled run deeper than the
+                # device registry onloads back into leased blocks so
+                # the match below hits device-side
+                self._maybe_onload(chain, max_blocks)
                 shared, k = self.pool.match_and_ref(chain, max_blocks)
                 shared_tok = k * self.block_size
             need = self._blocks_needed(p.size, num_new) - len(shared)
             # atomic check-and-lease: a co-located decode engine may be
             # leasing from the same pool on another thread.  Under
-            # pressure, LRU registry entries yield their pins first —
-            # prefix reuse must never starve real work.
+            # pressure, demotion to the host spill tier goes first
+            # (nothing is lost — the quantized payload keeps serving),
+            # then LRU registry entries yield their pins — prefix reuse
+            # must never starve real work.
             blocks = self.pool.try_lease(need)
+            if blocks is None and self._demote_for(need):
+                blocks = self.pool.try_lease(need)
             if blocks is None and self.pool.evict_prefixes_for(need):
                 blocks = self.pool.try_lease(need)
             if blocks is None:
@@ -560,6 +793,8 @@ class PrefillEngine:
         return {"queued": len(self.queue), "prefills": self.prefills,
                 "prefix_hits": self.prefix_hits,
                 "prefix_tokens_skipped": self.prefix_tokens_skipped,
+                "spill_demotions": self.spill_demotions,
+                "spill_onloads": self.spill_onloads,
                 **self.pool.stats()}
 
 
@@ -587,10 +822,13 @@ class DecodeEngine(PagedBatcher):
         self.speculative = bool(speculative)
         self._spec_lock = make_lock("serving.spec_adopt")
         self._spec_slots: Dict[int, str] = {}   # reserved slot → rid
-        # largest quant scale applied by int8 wire chunks — max
-        # per-element reconstruction error is wire_quant_max_scale/2
-        # (the documented bound the bench reports)
+        # largest quant scale applied by quantized wire chunks — the
+        # max per-element reconstruction error is
+        # wirecodec.error_bound(wire_quant_max_scale, wire_quant_codec)
+        # (scale/2 for the int grids, scale*16 for fp8 — the documented
+        # bound the bench reports per codec)
         self.wire_quant_max_scale = 0.0
+        self.wire_quant_codec = wirecodec.CODEC_INT8
         # per-slot "virtual prefill position": the device position the
         # slot's FIRST published token corresponds to, i.e. cursor −
         # (len(transcript) − 1).  Session export derives the live
@@ -608,7 +846,7 @@ class DecodeEngine(PagedBatcher):
 
         # the sender half of a session migration (shared with the
         # prefill engine's wire extract — _make_wire_gathers)
-        self._mig_gather, self._mig_gather_quant = _make_wire_gathers()
+        self._mig_gathers = _make_wire_gathers()
 
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
         def _adopt_bind(btab, bpos, tok, slots, rows, sizes, firsts):
@@ -670,6 +908,21 @@ class DecodeEngine(PagedBatcher):
             )
 
         self._wire_put_quant = _wire_put_quant
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def _wire_put_fp8(pools, idx, chunk_q, chunk_scale):
+            """fp8-codec incremental adoption: the e4m3 bit-decode and
+            scale multiply (vtpu/ops/quant.py) fused into the same
+            donated scatter — raw e4m3 bytes ship to the device, the
+            f32 expansion stays transient inside the program."""
+            return jax.tree.map(
+                lambda dst, q, s: dst.at[idx].set(
+                    dequantize_blockwise_fp8(q, s, dst.dtype)
+                ),
+                pools, chunk_q, chunk_scale,
+            )
+
+        self._wire_put_fp8 = _wire_put_fp8
 
     # ------------------------------------------------------------------
     def ping(self) -> bool:
@@ -941,7 +1194,7 @@ class DecodeEngine(PagedBatcher):
         re-leased or re-written, so gathering the CURRENT pool leaves
         is value-correct whenever the copy lands."""
         return _extract_blocks(self._split_cache()[0], blocks, codec,
-                               self._mig_gather, self._mig_gather_quant)
+                               self._mig_gathers)
 
     # -- wire transport (receiver sink) --------------------------------
     # The ReceiverHub (vtpu/serving/transport.py) drives these: open
@@ -967,7 +1220,7 @@ class DecodeEngine(PagedBatcher):
     def wire_codecs(self) -> tuple:
         """Codecs this receiver accepts at OPEN negotiation (an old
         receiver without this surface is fp32-only to the hub)."""
-        return (wirecodec.CODEC_FP32, wirecodec.CODEC_INT8)
+        return wirecodec.SUPPORTED
 
     def wire_open(self, rid: str, total_blocks: int, layout: list,
                   chunk_blocks: int, codec: str = wirecodec.CODEC_FP32,
@@ -1091,16 +1344,20 @@ class DecodeEngine(PagedBatcher):
         return cb, idx
 
     def _wire_write_quant(self, ctx, block_off: int, nblocks: int,
-                          payload) -> None:
-        """int8-codec chunk: per-leaf (scales, int8) pairs parsed
-        host-side, the dequant FUSED into the donated scatter — no
-        extra device program on the hot adoption path."""
+                          payload, codec: str) -> None:
+        """Quantized-codec chunk (int8/fp8/int4): per-leaf (scales,
+        data) pairs parsed host-side (int4 nibbles sign-extend to the
+        int8 grid there; fp8 stays raw e4m3 bytes), the dequant FUSED
+        into the donated scatter — no extra device program on the hot
+        adoption path."""
         pools, bpos, btab = self._split_cache()
         treedef, per_leaf, _per_block = self._wire_leaf_meta()
         cb, idx = self._wire_chunk_idx(ctx, block_off, nblocks)
-        parsed = wirecodec.split_quant_payload(
-            memoryview(payload), per_leaf, nblocks
+        parsed = wirecodec.split_payload(
+            memoryview(payload), per_leaf, nblocks, codec
         )
+        pad_dt = (np.uint8 if codec == wirecodec.CODEC_FP8
+                  else np.int8)
         q_leaves, s_leaves = [], []
         for (scales, q), (n_elem, shape, _dt) in zip(parsed, per_leaf):
             # error-bound input BEFORE padding: the 1.0 fill scales of
@@ -1113,7 +1370,7 @@ class DecodeEngine(PagedBatcher):
             if cb > nblocks:
                 q = np.concatenate(
                     [q, np.zeros((cb - nblocks,) + tuple(shape),
-                                 np.int8)], axis=0)
+                                 pad_dt)], axis=0)
                 scales = np.concatenate(
                     [scales, np.ones((cb - nblocks,), np.float32)])
             q_leaves.append(q)
@@ -1121,17 +1378,19 @@ class DecodeEngine(PagedBatcher):
                 (cb,) + (1,) * len(shape)))
         chunk_q = jax.tree_util.tree_unflatten(treedef, q_leaves)
         chunk_s = jax.tree_util.tree_unflatten(treedef, s_leaves)
-        new_pools = self._wire_put_quant(
-            pools, jnp.asarray(idx), chunk_q, chunk_s,
-        )
+        self.wire_quant_codec = codec
+        put = (self._wire_put_fp8 if codec == wirecodec.CODEC_FP8
+               else self._wire_put_quant)
+        new_pools = put(pools, jnp.asarray(idx), chunk_q, chunk_s)
         self.cache = dict(new_pools, pos=bpos, block_table=btab)
         ctx["written"] = block_off + nblocks
 
     def wire_write(self, ctx, block_off: int, nblocks: int,
                    payload) -> None:
-        if ctx.get("codec") == wirecodec.CODEC_INT8:
+        codec = ctx.get("codec")
+        if codec in wirecodec.QUANT_CODECS:
             return self._wire_write_quant(ctx, block_off, nblocks,
-                                          payload)
+                                          payload, codec)
         pools, bpos, btab = self._split_cache()
         treedef, per_leaf, per_block = self._wire_leaf_meta()
         buf = memoryview(payload)

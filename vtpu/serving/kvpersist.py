@@ -1,0 +1,203 @@
+"""On-disk prefix persistence: tier three of the K/V memory hierarchy
+(docs/serving.md §Memory hierarchy).
+
+A prefill replica's host spill tier makes the prefix cache host-memory-
+sized, but both device and host tiers die with the process — a rolling
+restart used to cold-start the fleet's hottest shared object (the
+system-prompt prefix) on every replica.  :class:`PrefixStore` journals
+each demoted run (digest chain + quantized payload) to local disk so
+the restarted replica rehydrates its host tier — and, through the
+router's ``rehydrate_prefix_index``, the cluster's ``PrefixIndex`` —
+instead of recomputing.
+
+Two files per store directory:
+
+- ``prefix_index.jsonl`` — one JSON record per journaled run: digest
+  chain, codec, segment offset/length, payload crc32, block size, and
+  the pool-layout signature.  Written through
+  :class:`vtpu.obs.jsonl.RotatingJsonlSink` (append-only, best-effort:
+  a full disk degrades to no-persistence with one warning, never an
+  engine crash).
+- ``prefix_segments.bin`` — the quantized payloads, each behind a
+  ``<u32 len, u32 crc32>`` header so a torn tail is detected, not
+  deserialized.
+
+Rotation is pair-wise: when the segment file would exceed the byte cap
+(``VTPU_KV_PERSIST_MAX_BYTES``) BOTH files rename to ``.1`` together
+(the sink's keep-one-previous ``os.replace`` policy), keeping index
+offsets and segment bytes in lockstep.  A crash between the two
+renames leaves records whose offsets miss their crc — torn, skipped.
+
+Load validation is strict: an index line that fails to parse, points
+outside its segment file, disagrees with the segment header, fails the
+crc, or carries a foreign layout signature / block size is skipped —
+a torn journal yields the valid subset, never garbage K/V (the
+``make bench-kv`` torn-journal fuzz pins this).  Last record per
+deepest digest wins, matching the host tier's keying.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import zlib
+from typing import Iterator, List, Sequence, Tuple
+
+from vtpu.analysis.witness import make_lock
+from vtpu.obs.jsonl import RotatingJsonlSink
+from vtpu.utils.envs import env_int
+
+log = logging.getLogger(__name__)
+
+INDEX_NAME = "prefix_index.jsonl"
+SEGMENTS_NAME = "prefix_segments.bin"
+_SEG_HEADER = struct.Struct("<II")  # payload length, crc32
+
+DEFAULT_PERSIST_MAX_BYTES = env_int("VTPU_KV_PERSIST_MAX_BYTES", 1 << 30)
+
+
+class PrefixStore:
+    """Durable journal of demoted prefix runs for one prefill replica.
+
+    ``sig`` is the owner pool's layout signature (leaf shapes/dtypes +
+    block size, hashed by the engine): a journal written by a replica
+    with a different model or pool geometry must not scatter into this
+    one, so ``load`` drops records whose signature differs.
+
+    Append is best-effort and never raises (the RotatingJsonlSink
+    failure policy): the first OSError disables the store with one
+    warning — persistence is an optimization, not a correctness
+    dependency."""
+
+    def __init__(self, path: str, sig: str = "",
+                 max_bytes: int = 0) -> None:
+        self.dir = path
+        self.sig = str(sig)
+        self.max_bytes = int(max_bytes) or DEFAULT_PERSIST_MAX_BYTES
+        self._lock = make_lock("serving.kvpersist")
+        self._dead = False
+        self.blocks_journaled = 0  # blocks' worth of valid records
+        os.makedirs(path, exist_ok=True)
+        self._index_path = os.path.join(path, INDEX_NAME)
+        self._seg_path = os.path.join(path, SEGMENTS_NAME)
+        # unlimited: pair-wise rotation is driven here, by segment size
+        self._sink = RotatingJsonlSink(
+            self._index_path, max_bytes=0,
+            lock_name="serving.kvpersist_index",
+        )
+
+    @property
+    def dead(self) -> bool:
+        return self._dead or self._sink.dead
+
+    # -- write path ------------------------------------------------------
+    def append(self, chain: Sequence[str], payload: bytes, codec: str,
+               block_size: int) -> None:
+        """Journal one demoted run (best-effort; never raises)."""
+        if self.dead or not chain:
+            return
+        payload = bytes(payload)
+        crc = zlib.crc32(payload) & 0xFFFFFFFF
+        with self._lock:
+            try:
+                size = (os.path.getsize(self._seg_path)
+                        if os.path.exists(self._seg_path) else 0)
+                need = _SEG_HEADER.size + len(payload)
+                if size > 0 and size + need > self.max_bytes:
+                    self._rotate_pair()
+                with open(self._seg_path, "ab") as f:
+                    off = f.tell()
+                    f.write(_SEG_HEADER.pack(len(payload), crc))
+                    f.write(payload)
+            except OSError:
+                self._dead = True
+                log.warning("prefix store %s failed; disabling "
+                            "persistence", self.dir, exc_info=True)
+                return
+        self._sink.write({
+            "digest": chain[-1],
+            "chain": list(chain),
+            "codec": str(codec),
+            "off": off,
+            "len": len(payload),
+            "crc": crc,
+            "blocks": len(chain),
+            "block_size": int(block_size),
+            "sig": self.sig,
+        })
+        self.blocks_journaled += len(chain)
+
+    def _rotate_pair(self) -> None:
+        """Rename BOTH files to ``.1`` together (keep-one-previous).
+        The sink's handle is closed first so the index rename is clean;
+        a crash between the two renames leaves index records whose
+        offsets miss their crc in the mismatched segment — torn,
+        skipped on load."""
+        self._sink.close()
+        for p in (self._seg_path, self._index_path):
+            if os.path.exists(p):
+                os.replace(p, p + ".1")
+
+    def close(self) -> None:
+        self._sink.close()
+
+    # -- read path -------------------------------------------------------
+    def _iter_valid(self, suffix: str,
+                    ) -> Iterator[Tuple[Tuple[str, ...], bytes, str, int]]:
+        idx_path = self._index_path + suffix
+        seg_path = self._seg_path + suffix
+        if not os.path.exists(idx_path) or not os.path.exists(seg_path):
+            return
+        try:
+            seg_size = os.path.getsize(seg_path)
+            with open(idx_path, "r", encoding="utf-8") as idx, \
+                    open(seg_path, "rb") as seg:
+                for line in idx:
+                    try:
+                        rec = json.loads(line)
+                        chain = tuple(str(d) for d in rec["chain"])
+                        codec = str(rec["codec"])
+                        off = int(rec["off"])
+                        length = int(rec["len"])
+                        crc = int(rec["crc"])
+                        block_size = int(rec["block_size"])
+                        sig = str(rec.get("sig", ""))
+                    except (ValueError, KeyError, TypeError):
+                        continue  # torn/garbage index line
+                    if self.sig and sig != self.sig:
+                        continue  # foreign pool layout
+                    if (not chain or length < 0 or off < 0
+                            or off + _SEG_HEADER.size + length > seg_size):
+                        continue  # points past a torn segment tail
+                    seg.seek(off)
+                    header = seg.read(_SEG_HEADER.size)
+                    if len(header) != _SEG_HEADER.size:
+                        continue
+                    hlen, hcrc = _SEG_HEADER.unpack(header)
+                    if hlen != length or hcrc != crc:
+                        continue  # index/segment disagree (torn pair)
+                    payload = seg.read(length)
+                    if (len(payload) != length
+                            or (zlib.crc32(payload) & 0xFFFFFFFF) != crc):
+                        continue  # bit rot or torn write
+                    yield chain, payload, codec, block_size
+        except OSError:
+            log.warning("prefix store %s unreadable; skipping %s",
+                        self.dir, idx_path, exc_info=True)
+
+    def load(self) -> List[Tuple[Tuple[str, ...], bytes, str, int]]:
+        """Every valid journaled run as ``(chain, payload, codec,
+        block_size)``, last record per deepest digest winning; the
+        rotated pair is read before the current one so recency wins.
+        Strictly validating — see the module docstring."""
+        out = {}
+        with self._lock:
+            for suffix in (".1", ""):
+                for chain, payload, codec, bs in self._iter_valid(suffix):
+                    out[chain[-1]] = (chain, payload, codec, bs)
+        self.blocks_journaled = sum(
+            len(c) for c, _p, _co, _b in out.values()
+        )
+        return list(out.values())

@@ -113,6 +113,37 @@ PREFIX_BLOCKS = _REG.gauge(
     "per pool",
 )
 
+# K/V memory hierarchy (docs/serving.md §Memory hierarchy): per-pool
+# block residency by tier, plus the demote/onload/rehydrate flow
+# counters.  device = the physical pool; host = blocks' worth of
+# demoted (quantized) prefix payloads held in host buffers; disk =
+# blocks' worth journaled by the persistence store
+# (vtpu/serving/kvpersist.py).  ``BlockPool.close()`` prunes a pool's
+# series so churned pools don't grow the registry without bound.
+POOL_TIER_BLOCKS = _REG.gauge(
+    "vtpu_kv_pool_blocks_total",
+    "Pool blocks resident per memory tier (device = physical pool, "
+    "host = demoted quantized prefix payloads, disk = journaled by the "
+    "persistence store), per pool",
+)
+SPILL_DEMOTIONS = _REG.counter(
+    "vtpu_kv_spill_demotions_total",
+    "Registered prefix runs demoted from device blocks to the host "
+    "spill tier (gathered and quantized at demotion)",
+)
+SPILL_ONLOADS = _REG.counter(
+    "vtpu_kv_spill_onloads_total",
+    "Spilled prefix runs onloaded back into device blocks on a prompt "
+    "match (dequantizing adoption scatter)",
+)
+SPILL_REHYDRATIONS = _REG.counter(
+    "vtpu_kv_spill_rehydrations_total",
+    "Prefix runs rehydrated into the host tier from the on-disk "
+    "persistence journal after a restart",
+)
+
+DEFAULT_SPILL_MAX_BYTES = env_int("VTPU_KV_SPILL_MAX_BYTES", 1 << 30)
+
 class KVHandoffError(RuntimeError):
     """Base class for lease/handle protocol violations."""
 
@@ -130,6 +161,19 @@ class StaleHandleError(KVHandoffError):
 
 class PoolMismatchError(KVHandoffError):
     """A handle was presented to (or with) a pool it does not belong to."""
+
+
+@dataclasses.dataclass
+class SpilledPrefix:
+    """One demoted prefix run in the host spill tier: its digest chain
+    (entry ``i`` attests blocks ``[:i+1]``), the quantized wire-layout
+    payload covering all ``len(chain)`` blocks, and the codec that
+    encoded it.  The pool stores opaque bytes — the device-side
+    gather/scatter halves live in vtpu/serving/disagg.py."""
+
+    chain: Tuple[str, ...]
+    payload: bytes
+    codec: str
 
 
 @dataclasses.dataclass(frozen=True)
@@ -179,7 +223,8 @@ class BlockPool:
     """
 
     def __init__(self, total_blocks: int, block_size: int,
-                 pool_id: str = "", prefix_cap: Optional[int] = None) -> None:
+                 pool_id: str = "", prefix_cap: Optional[int] = None,
+                 spill_max_bytes: Optional[int] = None) -> None:
         if total_blocks < 2:
             raise ValueError(
                 f"BlockPool needs at least 2 blocks (block 0 is the "
@@ -216,6 +261,23 @@ class BlockPool:
         self._prefix_pins: "collections.Counter[int]" = (
             collections.Counter()
         )
+        # host spill tier (docs/serving.md §Memory hierarchy): deepest
+        # digest of a demoted run → its quantized payload.  LRU, byte-
+        # capped (VTPU_KV_SPILL_MAX_BYTES); read-mostly — an onload
+        # copies out, it does not consume, so the host copy keeps
+        # serving later evictions of the re-registered device run.
+        self.spill_max_bytes = (DEFAULT_SPILL_MAX_BYTES
+                                if spill_max_bytes is None
+                                else int(spill_max_bytes))
+        self._spilled: "collections.OrderedDict[str, SpilledPrefix]" = (
+            collections.OrderedDict()
+        )
+        self._spill_bytes = 0
+        # union of every spilled run's chain digests: the O(1) "is this
+        # registry entry safe to drop first?" probe for eviction
+        self._spilled_digests: set = set()
+        self._disk_blocks = 0
+        self._tier_gauge()
 
     # -- leases ---------------------------------------------------------
     def leasable(self) -> int:
@@ -372,13 +434,26 @@ class BlockPool:
         PREFIX_BLOCKS.set(float(len(self._prefix_pins)),
                           pool=self.pool_id)
 
-    def _evict_prefix_entry(self) -> None:
-        _digest, run = self._prefix_runs.popitem(last=False)
+    def _tier_gauge(self) -> None:
+        POOL_TIER_BLOCKS.set(float(self.total_blocks),
+                             pool=self.pool_id, tier="device")
+        POOL_TIER_BLOCKS.set(
+            float(sum(len(e.chain) for e in self._spilled.values())),
+            pool=self.pool_id, tier="host",
+        )
+        POOL_TIER_BLOCKS.set(float(self._disk_blocks),
+                             pool=self.pool_id, tier="disk")
+
+    def _drop_prefix_entry(self, digest: str) -> None:
+        run = self._prefix_runs.pop(digest)
         for b in run:
             self._prefix_pins[b] -= 1
             if self._prefix_pins[b] <= 0:
                 del self._prefix_pins[b]
         self.release(run)
+
+    def _evict_prefix_entry(self) -> None:
+        self._drop_prefix_entry(next(iter(self._prefix_runs)))
         PREFIX_EVICTIONS.inc()
 
     def register_prefix(self, chain: Sequence[str],
@@ -464,27 +539,172 @@ class BlockPool:
                 out.append(d)
             return out
 
-    def prefix_match_depth(self, chain: Sequence[str]) -> int:
+    def prefix_match_depth(self, chain: Sequence[str],
+                           include_spilled: bool = True) -> int:
         """Read-only longest match depth (blocks) — the router's
-        PrefixIndex verification probe; takes no references."""
+        PrefixIndex verification probe; takes no references.  Covers
+        BOTH tiers by default: a spilled depth counts as a match
+        because the engine can onload it on arrival (how rehydrated-
+        but-not-yet-onloaded prefixes stay routable after a restart);
+        ``include_spilled=False`` restricts to device-resident runs
+        (the engine's own should-I-onload probe)."""
         with self._lock:
             for k in range(len(chain), 0, -1):
                 if chain[k - 1] in self._prefix_runs:
                     return k
+                if include_spilled and chain[k - 1] in self._spilled:
+                    # digest equality of chained digests implies the
+                    # identical token prefix, so the entry's depth IS k
+                    return k
             return 0
 
     def evict_prefixes_for(self, need: int) -> bool:
-        """Lease pressure: drop LRU registry entries until ``need``
-        blocks are free or the registry empties.  Registry-pinned
-        blocks must yield to real work; an entry whose blocks are still
-        shared by active slots frees nothing by itself, but its pins
-        drop so the blocks free when the sharers retire.  Returns True
-        when ``need`` blocks are now free."""
+        """Lease pressure: drop registry entries until ``need`` blocks
+        are free or the registry empties.  Entries whose digest the
+        host spill tier already covers yield first — dropping them
+        loses nothing (the payload survives in host memory); the rest
+        go truly-cold-first (LRU order).  Registry-pinned blocks must
+        yield to real work; an entry whose blocks are still shared by
+        active slots frees nothing by itself, but its pins drop so the
+        blocks free when the sharers retire.  Returns True when
+        ``need`` blocks are now free."""
         with self._lock:
             while len(self.free) < need and self._prefix_runs:
-                self._evict_prefix_entry()
+                spilled_backed = next(
+                    (d for d in self._prefix_runs
+                     if d in self._spilled_digests), None,
+                )
+                if spilled_backed is not None:
+                    self._drop_prefix_entry(spilled_backed)
+                    PREFIX_EVICTIONS.inc()
+                else:
+                    self._evict_prefix_entry()
             self._prefix_gauge()
             return len(self.free) >= need
+
+    # -- host spill tier -------------------------------------------------
+    # The pool side of the memory hierarchy: opaque quantized payloads
+    # keyed by the run's deepest digest.  The device halves (fused
+    # gather at demotion, dequantizing scatter at onload) live in
+    # vtpu/serving/disagg.py — this accounting stays JAX-free.
+
+    def demotion_candidate(
+            self) -> Optional[Tuple[List[str], List[int]]]:
+        """``(chain, run)`` of the least-recently-used MAXIMAL
+        registered run not already spilled — the engine picks its
+        demotion victim here.  Maximal = no registered run strictly
+        extends it (demoting a covered shallow entry frees nothing);
+        a run whose chain is not contiguously registered from depth 1
+        is skipped (a shallow depth was evicted underneath it — plain
+        eviction handles those).  ``None`` when nothing qualifies."""
+        with self._lock:
+            for digest, run in self._prefix_runs.items():  # LRU order
+                if digest in self._spilled_digests:
+                    continue
+                k = len(run)
+                if any(len(r2) > k and r2[:k] == run
+                       for r2 in self._prefix_runs.values()):
+                    continue
+                chain = self.digests_for_run(run)
+                if len(chain) == len(run):
+                    return list(chain), list(run)
+            return None
+
+    def _insert_spilled(self, entry: SpilledPrefix) -> None:
+        old = self._spilled.pop(entry.chain[-1], None)
+        if old is not None:
+            self._spill_bytes -= len(old.payload)
+        self._spilled[entry.chain[-1]] = entry
+        self._spill_bytes += len(entry.payload)
+        while (self._spill_bytes > self.spill_max_bytes
+               and len(self._spilled) > 1):
+            _d, ev = self._spilled.popitem(last=False)
+            self._spill_bytes -= len(ev.payload)
+        self._spilled_digests = set()
+        for e in self._spilled.values():
+            self._spilled_digests.update(e.chain)
+
+    def store_spilled(self, chain: Sequence[str], payload: bytes,
+                      codec: str) -> None:
+        """Install a demoted run in the host tier and drop every device
+        registry entry along its chain — the blocks free once no lease
+        shares them.  The engine performed the gather/quantize; the
+        pool owns the accounting (LRU + VTPU_KV_SPILL_MAX_BYTES cap)."""
+        chain = tuple(chain)
+        if not chain:
+            return
+        with self._lock:
+            for d in chain:
+                if d in self._prefix_runs:
+                    self._drop_prefix_entry(d)
+            self._insert_spilled(
+                SpilledPrefix(chain, bytes(payload), str(codec))
+            )
+            SPILL_DEMOTIONS.inc()
+            self._prefix_gauge()
+            self._tier_gauge()
+
+    def rehydrate_spilled(self, chain: Sequence[str], payload: bytes,
+                          codec: str) -> bool:
+        """Install a journaled run straight into the host tier — the
+        restart path (no device state existed, so nothing demotes).
+        Returns False for an empty chain."""
+        chain = tuple(chain)
+        if not chain:
+            return False
+        with self._lock:
+            self._insert_spilled(
+                SpilledPrefix(chain, bytes(payload), str(codec))
+            )
+            SPILL_REHYDRATIONS.inc()
+            self._tier_gauge()
+            return True
+
+    def match_spilled(self, chain: Sequence[str], max_blocks: int,
+                      ) -> Optional[Tuple[List[str], bytes, str, int]]:
+        """Longest host-tier run matching the prompt's digest chain
+        (depth-capped like ``match_and_ref``), or ``None``.  The hit is
+        LRU-touched but NOT removed: the engine onloads a copy into
+        leased blocks and re-registers the chain; the host copy keeps
+        serving later evictions.  Returns ``(chain, payload, codec,
+        depth)``."""
+        with self._lock:
+            for k in range(min(len(chain), max_blocks), 0, -1):
+                e = self._spilled.get(chain[k - 1])
+                if e is not None and len(e.chain) == k:
+                    self._spilled.move_to_end(chain[k - 1])
+                    return list(e.chain), e.payload, e.codec, k
+            return None
+
+    def known_chains(self) -> List[Tuple[str, ...]]:
+        """Every digest chain this pool can serve a prefix for:
+        contiguously registered device runs plus spilled host-tier runs
+        — the router's PrefixIndex rehydration source after a restart."""
+        with self._lock:
+            out = [e.chain for e in self._spilled.values()]
+            for run in self._prefix_runs.values():
+                chain = self.digests_for_run(run)
+                if len(chain) == len(run):
+                    out.append(tuple(chain))
+            return out
+
+    def set_disk_blocks(self, n: int) -> None:
+        """Report the persistence journal's block count for the disk-
+        tier gauge — the engine's store calls this; the pool itself
+        never touches disk."""
+        with self._lock:
+            self._disk_blocks = int(n)
+            self._tier_gauge()
+
+    def close(self) -> None:
+        """Teardown label hygiene: prune this pool's per-pool gauge
+        series so a long-lived process churning pools doesn't grow the
+        metric registry without bound.  Idempotent; the pool stays
+        usable (series reappear on the next mutation)."""
+        with self._lock:
+            PREFIX_BLOCKS.remove(pool=self.pool_id)
+            for tier in ("device", "host", "disk"):
+                POOL_TIER_BLOCKS.remove(pool=self.pool_id, tier=tier)
 
     # -- introspection --------------------------------------------------
     def stats(self) -> dict:
@@ -497,4 +717,9 @@ class BlockPool:
                 "detached_handles": len(self._detached),
                 "prefix_runs": len(self._prefix_runs),
                 "prefix_blocks": len(self._prefix_pins),
+                "spilled_runs": len(self._spilled),
+                "spilled_blocks": sum(
+                    len(e.chain) for e in self._spilled.values()
+                ),
+                "spilled_bytes": self._spill_bytes,
             }

@@ -338,6 +338,30 @@ class Router:
             )
         return min(cands)[1]
 
+    def rehydrate_prefix_index(self) -> int:
+        """Restart path of the K/V memory hierarchy (docs/serving.md
+        §Memory hierarchy): re-seed the PrefixIndex from every prefill
+        replica's pool — registered device runs plus host-tier spilled
+        runs, including those a replica just rehydrated from its
+        persistence journal (vtpu/serving/kvpersist.py).  Entries are
+        recorded as hints; ``route`` verifies depth against the pool
+        before following one, so a stale chain is pruned, never
+        trusted.  Returns the number of chains recorded."""
+        if self._prefix_index is None:
+            return 0
+        n = 0
+        for pid, pf in self.prefills.items():
+            pool = getattr(pf, "pool", None)
+            chains = getattr(pool, "known_chains", None)
+            if (chains is None or getattr(pf, "block_size", 0)
+                    != self._prefix_block):
+                continue  # foreign granularity never seeds hints
+            for chain in chains():
+                if chain:
+                    self._prefix_index.record(list(chain), pid)
+                    n += 1
+        return n
+
     def submit(self, session: str, rid: str, prompt, num_new: int) -> str:
         """Admit one request: pick the session's replica, check its
         live load (active slots + handles claimed but not yet in a slot

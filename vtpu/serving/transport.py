@@ -137,8 +137,8 @@ TRANSPORT_STREAMS = _REG.counter(
 CODEC_BYTES = _REG.counter(
     "vtpu_kv_wire_codec_bytes_total",
     "Wire data-chunk payload bytes applied at receivers, by negotiated "
-    "codec (fp32 = raw pool bytes, int8 = blockwise-quantized payload "
-    "+ per-block scales)",
+    "codec (fp32 = raw pool bytes; int8/fp8/int4 = blockwise-quantized "
+    "payload + per-block scales, int4 nibble-packed two per byte)",
 )
 
 MAGIC = b"VKVW"
@@ -153,8 +153,23 @@ KIND_PING = 4
 # the blockwise-int8 encoding (vtpu/serving/wirecodec.py) instead of
 # raw pool bytes.  Negotiated at OPEN — an old receiver never sees one.
 KIND_DATA_QUANT = 5
+# sub-byte codecs (same negotiation, same fallback): fp8 payloads are
+# e4m3 bytes + per-block f32 scales; int4 payloads are nibble-packed
+# two-per-byte + per-block f32 scales
+KIND_DATA_FP8 = 6
+KIND_DATA_INT4 = 7
 
-_DATA_KINDS = (KIND_DATA, KIND_DATA_QUANT)
+_DATA_KINDS = (KIND_DATA, KIND_DATA_QUANT, KIND_DATA_FP8, KIND_DATA_INT4)
+
+# the single source of truth for codec → data-chunk kind: both the
+# receiver's expected-kind check and the sender's frame emission look
+# here, so a new codec cannot drift the two ends apart
+KIND_FOR_CODEC = {
+    wirecodec.CODEC_FP32: KIND_DATA,
+    wirecodec.CODEC_INT8: KIND_DATA_QUANT,
+    wirecodec.CODEC_FP8: KIND_DATA_FP8,
+    wirecodec.CODEC_INT4: KIND_DATA_INT4,
+}
 
 FLAG_FIN = 0x01
 
@@ -519,9 +534,7 @@ class ReceiverHub:
                 f"or never opened)"
             )
         try:
-            want_kind = (KIND_DATA_QUANT
-                         if st.codec == wirecodec.CODEC_INT8
-                         else KIND_DATA)
+            want_kind = KIND_FOR_CODEC.get(st.codec, KIND_DATA)
             if frame.kind != want_kind:
                 raise CodecMismatchError(
                     f"chunk kind {frame.kind} on a stream that "
@@ -953,9 +966,7 @@ class StreamSender:
                     return False  # D2H still in flight; ride next pump
                 payload = self.extract.payload(lo, hi)
                 fin = self._next == self.nchunks
-                kind = (KIND_DATA_QUANT
-                        if self.codec == wirecodec.CODEC_INT8
-                        else KIND_DATA)
+                kind = KIND_FOR_CODEC.get(self.codec, KIND_DATA)
                 if fin:
                     # from the send to the response, an abort is
                     # AMBIGUOUS: the receiver may have applied the FIN
