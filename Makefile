@@ -124,12 +124,13 @@ test-native-tsan:
 	  && rm -rf /tmp/vtpu-tsan-test
 
 # vtpu-check: the unified static-analysis suite (docs/static_analysis.md)
-# — one AST walk, six passes: lock-discipline (docs/scheduler_perf.md
+# — one AST walk, seven passes: lock-discipline (docs/scheduler_perf.md
 # §Lock-order rules + blocking-under-cache-lock), annotation-keys
 # (vtpu.io/* literals live in vtpu/utils/types.py), env-access (VTPU_*
 # reads go through vtpu/utils/envs.py), jax-hygiene (donated-buffer
-# reuse + host syncs in hot-path files), env-docs (config-lint), and
-# obs-docs (obs-lint).  Per-line suppression: `# vtpu: allow(<pass>)`.
+# reuse + host syncs in hot-path files), env-docs (config-lint),
+# span-docs (trace span names vs the docs/observability.md catalog),
+# and obs-docs (obs-lint).  Per-line suppression: `# vtpu: allow(<pass>)`.
 # The runtime side — the VTPU_LOCK_WITNESS=1 lock-order witness — runs
 # inside the threaded soak tests on every `make test`.
 check:

@@ -582,6 +582,165 @@ def run_shared_prefix(smoke: bool) -> dict:
     }
 
 
+def run_trace_overhead(smoke: bool) -> dict:
+    """Paired tracing-off / tracing-on arms over an identical
+    shared-prefix fanout drive (the full int8 + speculation + prefix
+    stack).  The off arm prices the dark hot path — request tracing
+    disabled must cost nothing, so its tokens/s is the no-regression
+    baseline; the on arm proves the attribution ledger's telescope:
+    every completed request's five stage segments must sum to the
+    bench-measured TTFT (within 5%), and the per-request span trees /
+    attribution records actually materialize."""
+    import collections as _collections
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from vtpu.models.transformer import TransformerLM
+    from vtpu.serving import transport as tp
+    from vtpu.serving.disagg import DecodeEngine, PrefillEngine
+    from vtpu.serving.reqtrace import LEDGER, STAGES
+    from vtpu.utils import trace
+
+    kw = dict(vocab=128, d_model=192, depth=2, num_heads=4, max_seq=128)
+    bs = 16
+    m = TransformerLM(**kw, kv_cache_layout="paged", kv_block_size=bs,
+                      kv_pool_blocks=257)
+    params = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))[
+        "params"]
+    rng = np.random.default_rng(29)
+    prefix = rng.integers(0, 128, 64).astype(np.int32)
+    n_sessions = 6 if smoke else 20
+    sufs = [5, 9, 13, 7, 11, 15]
+    telescope = STAGES[:5]
+
+    def mk_reqs(tag):
+        out = []
+        for i in range(n_sessions):
+            suffix = rng.integers(0, 128, sufs[i % len(sufs)]).astype(
+                np.int32)
+            out.append((f"{tag}{i}", np.concatenate([prefix, suffix]),
+                        4 + (i % 3)))
+        return out
+
+    arms = {}
+    attribution = None
+    was_on = trace.tracing()
+    try:
+        for name, on in (("tracing_off", False), ("tracing_on", True)):
+            trace.tracing(on)
+            trace.clear()
+            LEDGER.clear()
+            pf = PrefillEngine(m, params, prefix_cache=True)
+            dec = DecodeEngine(m, params, max_batch=8, eos_id=2,
+                               replica_id="tr0", speculative=True)
+            hub = tp.ReceiverHub(dec)
+            rep = tp.WireReplica(tp.LoopbackLink(hub), "tr0", local=dec,
+                                 chunk_blocks=4, codec="int8")
+            t_submit, t_first = {}, {}
+
+            def check_first():
+                for rid in dec.out:
+                    if rid in t_submit and rid not in t_first:
+                        t_first[rid] = time.perf_counter()
+
+            def drive(requests, measure):
+                staging = list(requests)
+                per_round = 1
+                while (staging or pf.queue or rep.idle_senders()
+                       or dec.queue or any(dec.active) or dec._inflight):
+                    for rid, p, n in staging[:per_round]:
+                        pf.submit(rid, p, num_new=n)
+                        if measure:
+                            t_submit[rid] = time.perf_counter()
+                    del staging[:per_round]
+                    per_round = 2
+                    for res in pf.step():
+                        rep.submit_handle(res.rid, res.handle,
+                                          res.first_token, res.num_new,
+                                          source=pf,
+                                          submitted=res.submitted,
+                                          admit=False)
+                        check_first()
+                    stalls = 0
+                    while rep.idle_senders():
+                        before = tp.TRANSPORT_CHUNKS.value()
+                        rep.pump_streams()
+                        check_first()
+                        if (rep.idle_senders()
+                                and tp.TRANSPORT_CHUNKS.value() == before):
+                            dec.step()
+                            stalls += 1
+                            if stalls > 10000:
+                                raise RuntimeError("trace arm wedged")
+                    dec.step()
+                    check_first()
+
+            warm_prefix = rng.integers(0, 128, 64).astype(np.int32)
+            warm = [(f"warm{name}{i}",
+                     np.concatenate([warm_prefix, rng.integers(
+                         0, 128, sufs[i % len(sufs)]).astype(np.int32)]),
+                     4 + (i % 3)) for i in range(7)]
+            drive(warm, measure=False)
+            reqs = mk_reqs(f"tr_{name}_")
+            t0 = time.perf_counter()
+            drive(reqs, measure=True)
+            dec._flush_first_tokens()
+            makespan = time.perf_counter() - t0
+            total = sum(len(dec.out[rid]) for rid in t_submit
+                        if rid in dec.out)
+            arms[name] = {
+                "requests": len(reqs),
+                "tokens": total,
+                "tokens_per_s": round(total / max(1e-9, makespan), 1),
+                "makespan_s": round(makespan, 4),
+            }
+            if on:
+                errs, docs = [], 0
+                for rid, ts in t_submit.items():
+                    doc = LEDGER.get(rid)
+                    if doc is None or doc["ttft_s"] is None \
+                            or rid not in t_first:
+                        continue
+                    docs += 1
+                    measured = t_first[rid] - ts
+                    ssum = sum(doc["stages"][s] for s in telescope)
+                    errs.append(abs(ssum - measured)
+                                / max(1e-9, measured))
+                counts = _collections.Counter(
+                    s["name"] for s in trace.recent_spans(n=2048))
+                attribution = {
+                    "requests_attributed": docs,
+                    "stage_sum_max_rel_err": round(max(errs), 4)
+                    if errs else None,
+                    "stage_sum_mean_rel_err": round(
+                        sum(errs) / len(errs), 4) if errs else None,
+                    "span_counts": dict(counts),
+                    "ledger": LEDGER.stats(),
+                }
+            else:
+                arms[name]["spans_recorded"] = len(trace.recent_spans(
+                    n=2048))
+            trace.tracing(False)
+            trace.clear()
+            LEDGER.clear()
+    finally:
+        trace.tracing(was_on)
+    off, on_ = arms["tracing_off"], arms["tracing_on"]
+    return {
+        "config": {"model": kw, "block_size": bs, "prefix_tokens": 64,
+                   "sessions": n_sessions},
+        "arms": arms,
+        "attribution": attribution,
+        # > 1.0 means tracing-on ran slower; CPU timing noise dominates
+        # at smoke scale, so this is reported, not gated
+        "overhead_x": round(
+            on_["makespan_s"] / max(1e-9, off["makespan_s"]), 3),
+    }
+
+
 # ---------------------------------------------------------------------------
 # K/V memory-hierarchy phases (`make bench-kv`): per-codec wire tradeoff
 # curve, host-DRAM spill tier, prefix persistence across restarts
@@ -1621,6 +1780,24 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
 
+    print("[bench-disagg] phase 1.8: request-tracing overhead…",
+          file=sys.stderr, flush=True)
+    trace_res = run_trace_overhead(smoke)
+    attr = trace_res["attribution"]
+    if trace_res["arms"]["tracing_off"]["spans_recorded"] != 0:
+        print("bench-disagg: tracing-off arm recorded spans — the dark "
+              "hot path is not a no-op", file=sys.stderr)
+        return 1
+    if not attr or not attr["requests_attributed"]:
+        print("bench-disagg: tracing-on arm produced no attribution "
+              "records", file=sys.stderr)
+        return 1
+    if attr["stage_sum_max_rel_err"] > 0.05:
+        print(f"bench-disagg: stage segments sum to within "
+              f"{attr['stage_sum_max_rel_err']:.1%} of measured TTFT "
+              f"(> 5%)", file=sys.stderr)
+        return 1
+
     print("[bench-disagg] phase 2: calibrating program costs…",
           file=sys.stderr, flush=True)
     units = calibrate(ROWS_SMOKE if smoke else ROWS_FULL,
@@ -1659,6 +1836,12 @@ def main(argv=None) -> int:
         "ftl_ms_speculative_fp32": spa["fp32"]["first_token_ms_mean"],
         "dyn_mean_prefill_devices": arms["disagg_dyn"][
             "prefill_scale"]["mean_active"],
+        "trace_off_tokens_per_s": trace_res["arms"]["tracing_off"][
+            "tokens_per_s"],
+        "trace_on_tokens_per_s": trace_res["arms"]["tracing_on"][
+            "tokens_per_s"],
+        "trace_overhead_x": trace_res["overhead_x"],
+        "trace_stage_sum_max_rel_err": attr["stage_sum_max_rel_err"],
     }
     res = {
         "metric": "serving_disaggregation",
@@ -1683,6 +1866,7 @@ def main(argv=None) -> int:
         "wire": wire,
         "wire_int8": wire_int8,
         "shared_prefix": shared_prefix,
+        "trace": trace_res,
         "units": {k: round(v, 6) for k, v in units.items()},
         "arms": arms,
         "headline": headline,

@@ -107,6 +107,10 @@ VARIABLE_PATHS = {
     ("config", "model"),       # model kw dict is bench-internal
     ("spill", "config", "model"),    # kv bench arm-local model kw
     ("restart", "config", "model"),
+    ("trace", "config", "model"),    # disagg trace-phase model kw
+    # span-name histogram: which span names land in the ring is
+    # run-shape dependent (smoke drives fewer windows)
+    ("trace", "attribution", "span_counts"),
     # colo smoke runs a smaller gang: member/role key sets shrink
     ("arms", "*", "mesh_boot"),
     ("arms", "*", "gang", "roles"),

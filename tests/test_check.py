@@ -19,6 +19,7 @@ from vtpu.analysis.passes.env_access import EnvAccessPass
 from vtpu.analysis.passes.env_docs import EnvDocsPass
 from vtpu.analysis.passes.jax_hygiene import JaxHygienePass
 from vtpu.analysis.passes.lock_discipline import LockDisciplinePass
+from vtpu.analysis.passes.span_docs import SpanDocsPass
 
 
 def write_tree(root, files):
@@ -443,6 +444,89 @@ def test_env_docs_clean_twin(tmp_path):
         {"vtpu/mod.py": 'K = "VTPU_FIXTURE_DOCD"\n'},
         [EnvDocsPass()],
         docs={"docs/config.md": "| `VTPU_FIXTURE_DOCD` | a knob |\n"},
+    )
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# span-docs (the span-catalog port of env-docs)
+# ---------------------------------------------------------------------------
+
+SPAN_EMITTERS = '''
+from vtpu.utils import trace
+
+def f():
+    with trace.span("fixture_traced_op", rid="r"):
+        pass
+    sp = trace.start_span("fixture_started_op")
+    trace.end_span(sp)
+    name = "dyn"
+    with trace.span(name):      # non-literal: not a declaration
+        pass
+'''
+
+
+def test_span_docs_flags_uncatalogued(tmp_path):
+    vs = run_fixture(
+        tmp_path,
+        {"vtpu/mod.py": SPAN_EMITTERS},
+        [SpanDocsPass()],
+        docs={"docs/observability.md": "| `fixture_started_op` | … |\n"},
+    )
+    assert len(vs) == 1 and "fixture_traced_op" in vs[0].message
+    assert vs[0].path == "vtpu/mod.py"
+
+
+def test_span_docs_backticked_not_prose(tmp_path):
+    # a prose mention is not a catalog entry — only `backticked` names
+    # count (names like bind/filter would trivially appear in prose)
+    vs = run_fixture(
+        tmp_path,
+        {"vtpu/mod.py": SPAN_EMITTERS},
+        [SpanDocsPass()],
+        docs={"docs/observability.md":
+              "fixture_traced_op and fixture_started_op in prose\n"},
+    )
+    assert len(vs) == 2
+
+
+def test_span_docs_scope_is_vtpu_only(tmp_path):
+    # cmd/ (and tests/hack, which aren't scanned at all) construct
+    # ad-hoc spans the catalog need not cover
+    vs = run_fixture(
+        tmp_path,
+        {"cmd/tool.py": SPAN_EMITTERS},
+        [SpanDocsPass()],
+        docs={"docs/observability.md": ""},
+    )
+    assert vs == []
+
+
+def test_span_docs_pragma_suppresses(tmp_path):
+    src = (
+        "from vtpu.utils import trace\n"
+        "def f():\n"
+        "    with trace.span('fixture_secret_op'):"
+        "  # vtpu: allow(span-docs)\n"
+        "        pass\n"
+    )
+    vs = run_fixture(
+        tmp_path,
+        {"vtpu/mod.py": src},
+        [SpanDocsPass()],
+        docs={"docs/observability.md": ""},
+    )
+    assert vs == []
+
+
+def test_span_docs_clean_twin(tmp_path):
+    vs = run_fixture(
+        tmp_path,
+        {"vtpu/mod.py": SPAN_EMITTERS},
+        [SpanDocsPass()],
+        docs={"docs/observability.md":
+              "| `fixture_traced_op` | … |\n"
+              "| `fixture_started_op` | … |\n"},
     )
     assert vs == []
 

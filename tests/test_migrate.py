@@ -932,3 +932,82 @@ def test_bench_migrate_smoke_artifact_schema(tmp_path):
     assert res["suffix"]["blocks_skipped"] > 0
     for arm in arms.values():
         assert arm["completion_p95_s"] >= arm["completion_p50_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# request-scoped tracing across a migration (docs/observability.md
+# §Request tracing): the session_migrate span joins the request's
+# trace, its wire legs nest under it, and the pause lands in the
+# ledger's migration_pause stage whether the move succeeds or fails
+# ---------------------------------------------------------------------------
+
+from vtpu.serving.reqtrace import LEDGER  # noqa: E402
+from vtpu.utils import trace  # noqa: E402
+
+
+@pytest.fixture()
+def _move_tracing():
+    trace.clear()
+    trace.tracing(True)
+    LEDGER.clear()
+    yield
+    trace.tracing(False)
+    trace.clear()
+    LEDGER.clear()
+
+
+def _spans(name):
+    return [s for s in trace.recent_spans(n=1000) if s["name"] == name]
+
+
+def test_session_migrate_span_joins_request_trace(_move_tracing):
+    src = FakeDecodeReplica("src")
+    dst = FakeDecodeReplica("dst")
+    src.seed_session("r0", list(range(20)), num_new=10, decoded=4)
+    LEDGER.admit("r0")
+    rep = SessionMover().move("r0", src, [("dst", dst)])
+    (mig,) = _spans("session_migrate")
+    assert mig["trace_id"] == "r0" and mig["ok"]
+    assert mig["parent"] is not None            # child of the request span
+    assert mig["target"] == "dst"
+    assert mig["blocks_shipped"] == rep.blocks_shipped == 4
+    # the migration's wire legs nest under the migrate span, so the
+    # timeline shows WHERE inside the pause the time went
+    (tx,) = _spans("kv_wire_stream")
+    assert tx["trace_id"] == "r0" and tx["parent"] == mig["span_id"]
+    # the ledger accumulated the pause outside the TTFT telescope
+    stages = LEDGER.get("r0")["stages"]
+    assert stages["migration_pause"] == pytest.approx(rep.duration_s)
+    assert stages["migration_pause"] > 0
+    # migrated continuation still token-exact with tracing on
+    dst.run()
+    assert dst.out["r0"] == control(20, 10)
+    assert leak_free(src.pool) and leak_free(dst.pool)
+
+
+def test_failed_move_span_errors_and_pause_still_counts(_move_tracing):
+    src = FakeDecodeReplica("src")
+    full = FakeDecodeReplica("full", blocks=5)
+    full.pool.lease(4)
+    src.seed_session("r0", list(range(12)), 6, decoded=2)
+    LEDGER.admit("r0")
+    with pytest.raises(NoMigrationTargetError):
+        SessionMover().move("r0", src, [("full", full)])
+    (mig,) = _spans("session_migrate")
+    assert mig["ok"] is False
+    assert "NoMigrationTargetError" in mig["error"]
+    # the request still paid for the attempt — the pause is booked even
+    # though the move restored and the session finishes in place
+    assert LEDGER.get("r0")["stages"]["migration_pause"] > 0
+    src.run()
+    assert src.out["r0"] == control(12, 6)
+
+
+def test_move_emits_no_spans_while_tracing_off():
+    src = FakeDecodeReplica("src")
+    dst = FakeDecodeReplica("dst")
+    src.seed_session("r0", list(range(20)), num_new=10, decoded=4)
+    SessionMover().move("r0", src, [("dst", dst)])
+    assert trace.recent_spans() == []
+    dst.run()
+    assert dst.out["r0"] == control(20, 10)     # exactness unchanged

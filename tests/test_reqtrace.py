@@ -1,0 +1,248 @@
+"""Request-ledger tests: the TTFT telescope must tile exactly, marks
+must be first-write-wins, and every mutator must be a no-op while
+tracing is off (the hot-path contract of vtpu/serving/reqtrace.py)."""
+
+import json
+
+import pytest
+
+from vtpu.serving import reqtrace
+from vtpu.serving.reqtrace import (
+    LEDGER,
+    STAGES,
+    RequestLedger,
+    requests_body,
+    tenant_of,
+)
+from vtpu.utils import trace
+
+
+@pytest.fixture(autouse=True)
+def _tracing_on():
+    trace.clear()
+    trace.tracing(True)
+    LEDGER.clear()
+    yield
+    trace.tracing(False)
+    trace.clear()
+    LEDGER.clear()
+
+
+TELESCOPE = STAGES[:5]
+
+
+def test_admit_mints_context_and_root_span():
+    ctx = LEDGER.admit("r1", session="acme/chat-7", prompt_tokens=4)
+    # trace id = rid; span id is a process-global counter, so only its
+    # shape is pinned (the suite may have minted spans before this test)
+    tid, _, sid = ctx.partition(":")
+    assert tid == "r1" and sid.isdigit() and int(sid) >= 1
+    assert LEDGER.ctx("r1") == ctx
+    doc = LEDGER.get("r1")
+    assert doc["tenant"] == "acme" and doc["session"] == "acme/chat-7"
+    assert reqtrace.TENANT_TOKENS.value(tenant="acme", kind="prompt") >= 4
+
+
+def test_telescope_tiles_ttft_exactly():
+    L = RequestLedger(cap=16)
+    L.admit("r1")
+    L._active["r1"].marks["submit"] = 10.0
+    L.mark("r1", "prefill_start", t=10.5)
+    L.mark("r1", "prefill_done", t=11.5)
+    L.mark("r1", "handoff_done", t=11.7)
+    L.mark("r1", "adopted", t=11.8)
+    L.first_token("r1", t=12.0)
+    doc = L.get("r1")
+    st = doc["stages"]
+    assert st["router_queue"] == pytest.approx(0.5)
+    assert st["prefill_compute"] == pytest.approx(1.0)
+    assert st["wire_transfer"] == pytest.approx(0.2)
+    assert st["adoption"] == pytest.approx(0.1)
+    assert st["decode_window"] == pytest.approx(0.2)
+    assert sum(st[s] for s in TELESCOPE) == pytest.approx(doc["ttft_s"])
+    assert doc["ttft_s"] == pytest.approx(2.0)
+
+
+def test_marks_after_first_token_clamp_to_it():
+    # speculative adoption: first token published before the wire FIN
+    # lands handoff_done/adopted — late marks clamp so the telescope
+    # still sums exactly to TTFT
+    L = RequestLedger(cap=16)
+    L.admit("r1")
+    L._active["r1"].marks["submit"] = 0.0
+    L.mark("r1", "prefill_start", t=0.5)
+    L.mark("r1", "prefill_done", t=1.5)
+    L.first_token("r1", t=2.0)
+    L.mark("r1", "handoff_done", t=3.0)
+    L.mark("r1", "adopted", t=3.2)
+    st = L.get("r1")["stages"]
+    assert sum(st[s] for s in TELESCOPE) == pytest.approx(2.0)
+    assert st["decode_window"] == pytest.approx(0.0)
+    assert st["wire_transfer"] == pytest.approx(0.5)
+
+
+def test_missing_marks_collapse_to_zero_width():
+    # a cross-process receiver never sees prefill marks: the stages they
+    # close go zero-width, the next present mark absorbs the interval
+    L = RequestLedger(cap=16)
+    L.admit("r1")
+    L._active["r1"].marks["submit"] = 0.0
+    L.mark("r1", "handoff_done", t=1.0)
+    L.first_token("r1", t=1.5)
+    st = L.get("r1")["stages"]
+    assert st["router_queue"] == 0.0 and st["prefill_compute"] == 0.0
+    assert st["wire_transfer"] == pytest.approx(1.0)
+    assert st["decode_window"] == pytest.approx(0.5)
+    assert sum(st[s] for s in TELESCOPE) == pytest.approx(1.5)
+
+
+def test_marks_are_first_write_wins():
+    L = RequestLedger(cap=16)
+    L.admit("r1")
+    L.mark("r1", "prefill_start", t=1.0)
+    L.mark("r1", "prefill_start", t=9.0)  # retried hop must not move it
+    assert L._active["r1"].marks["prefill_start"] == 1.0
+
+
+def test_first_token_idempotent():
+    L = RequestLedger(cap=16)
+    L.admit("r1")
+    L._active["r1"].marks["submit"] = 0.0
+    L.first_token("r1", t=1.0)
+    L.first_token("r1", t=5.0)  # harvest publish after speculative one
+    doc = L.get("r1")
+    assert doc["ttft_s"] == pytest.approx(1.0)
+    assert doc["tokens_out"] == 1
+
+
+def test_token_itl_accounting():
+    L = RequestLedger(cap=16)
+    L.admit("r1", session="acme/s")
+    L._active["r1"].marks["submit"] = 0.0
+    L.first_token("r1", t=1.0)
+    L.token("r1", t=1.2)
+    L.token("r1", t=1.5)
+    doc = L.get("r1")
+    assert doc["tokens_out"] == 3
+    assert doc["itl_n"] == 2
+    assert doc["itl_mean_s"] == pytest.approx(0.25)
+
+
+def test_pause_accumulates_outside_telescope():
+    L = RequestLedger(cap=16)
+    L.admit("r1")
+    L._active["r1"].marks["submit"] = 0.0
+    L.first_token("r1", t=1.0)
+    L.pause("r1", "migration_pause", 0.3)
+    L.pause("r1", "migration_pause", 0.2)
+    L.pause("r1", "spill_onload", 0.1)
+    st = L.get("r1")["stages"]
+    assert st["migration_pause"] == pytest.approx(0.5)
+    assert st["spill_onload"] == pytest.approx(0.1)
+    # pauses ride outside the telescope: TTFT tiling is untouched
+    assert sum(st[s] for s in TELESCOPE) == pytest.approx(1.0)
+    snap = reqtrace.STAGE_HIST.snapshot(stage="migration_pause")
+    assert snap is not None and snap["count"] >= 2
+
+
+def test_finish_retires_and_closes_root_span():
+    LEDGER.admit("r1")
+    LEDGER.finish("r1", ok=False, error="cancelled")
+    doc = LEDGER.get("r1")
+    assert doc["done"] and doc["ok"] is False and doc["error"] == "cancelled"
+    assert LEDGER.stats() == {"active": 0, "completed": 1, "dropped": 0}
+    (sp,) = [s for s in trace.recent_spans() if s["name"] == "request"]
+    assert sp["ok"] is False and sp["error"] == "cancelled"
+    # double-finish and unknown rids are no-ops
+    LEDGER.finish("r1")
+    LEDGER.finish("ghost")
+    assert LEDGER.stats()["completed"] == 1
+
+
+def test_jsonl_mirror(tmp_path, monkeypatch):
+    path = tmp_path / "requests.jsonl"
+    monkeypatch.setenv(reqtrace.ENV_JSONL, str(path))
+    L = RequestLedger(cap=16)
+    L.admit("r1", session="acme/s")
+    L._active["r1"].marks["submit"] = 0.0
+    L.first_token("r1", t=1.0)
+    L.finish("r1")
+    (line,) = path.read_text().splitlines()
+    rec = json.loads(line)
+    assert rec["rid"] == "r1" and rec["done"] and rec["ok"]
+    assert rec["ttft_s"] == pytest.approx(1.0)
+    assert set(rec["stages"]) >= set(TELESCOPE)
+
+
+def test_everything_noop_while_tracing_off():
+    trace.tracing(False)
+    assert LEDGER.admit("r1") is None
+    LEDGER.ensure("r1")
+    LEDGER.mark("r1", "prefill_start")
+    LEDGER.pause("r1", "migration_pause", 1.0)
+    LEDGER.first_token("r1")
+    LEDGER.wire_bytes("r1", 100)
+    assert LEDGER.stats() == {"active": 0, "completed": 0, "dropped": 0}
+    assert trace.recent_spans() == []
+
+
+def test_ensure_is_idempotent():
+    LEDGER.admit("r1", session="acme/s")
+    LEDGER.ensure("r1")
+    assert LEDGER.stats()["active"] == 1
+    assert LEDGER.get("r1")["tenant"] == "acme"  # admit record kept
+    LEDGER.ensure("r2")
+    assert LEDGER.stats()["active"] == 2
+
+
+def test_wire_bytes_accounts_to_tenant():
+    LEDGER.admit("r1", session="acme/s")
+    before = reqtrace.TENANT_WIRE_BYTES.value(tenant="acme")
+    LEDGER.wire_bytes("r1", 1024)
+    LEDGER.wire_bytes("r1", 0)  # ignored
+    assert reqtrace.TENANT_WIRE_BYTES.value(tenant="acme") == before + 1024
+
+
+def test_requests_body_forms():
+    LEDGER.admit("r1")
+    LEDGER.finish("r1")
+    doc = json.loads(requests_body({"rid": "r1"}))
+    assert doc["rid"] == "r1" and doc["done"]
+    miss = json.loads(requests_body({"rid": "ghost"}))
+    assert miss == {"rid": "ghost", "found": False}
+    LEDGER.admit("r2")
+    body = json.loads(requests_body({}))
+    assert body["count"] == 2 and body["active"] == 1
+    assert {d["rid"] for d in body["requests"]} == {"r1", "r2"}
+    capped = json.loads(requests_body({"n": "1"}))
+    assert capped["count"] == 1
+
+
+def test_tenant_of():
+    assert tenant_of("acme/chat-7") == "acme"
+    assert tenant_of("solo") == "default"
+    assert tenant_of("") == "default"
+
+
+def test_active_cap_evicts_oldest():
+    L = RequestLedger(cap=2)  # active cap = 4 * cap = 8
+    for i in range(10):
+        L.admit(f"r{i}")
+    st = L.stats()
+    assert st["active"] == 8 and st["dropped"] == 2
+    assert L.get("r0") is None and L.get("r9") is not None
+    # completed ring keeps only cap records
+    for i in range(2, 10):
+        L.finish(f"r{i}")
+    assert L.stats()["completed"] == 2
+
+
+def test_timeline_rid_alias():
+    from vtpu.obs.http import timeline_body
+
+    LEDGER.admit("r1")
+    LEDGER.finish("r1")
+    body = json.loads(timeline_body({"rid": "r1"}))
+    assert body["trace_id"] == "r1"
+    assert any(s["name"] == "request" for s in body["spans"])
+    assert timeline_body({}) is None

@@ -621,3 +621,35 @@ def test_router_without_prefix_engines_skips_the_index():
     assert router._prefix_index is None
     router.submit("s", "r0", [1, 2, 3], 2)
     assert router.stats()["prefix_index_entries"] == 0
+
+
+def test_evicted_replica_series_are_pruned_from_exposition():
+    """Metric hygiene: request_evict must PRUNE the leaving replica's
+    replica-labelled series (healthy_info / pinned / backlog) rather
+    than exporting a dead replica's last values forever.  A health
+    drain, by contrast, keeps them — the replica may restore.  (The
+    transport layer was audited for the same hazard and has no per-peer
+    labelled families; the router gauges are the whole surface.)"""
+    from vtpu.serving.router import _BACKLOG, _HEALTHY_INFO, _PINNED
+
+    router, pf, reps = make_router(n=3)
+    for i in range(12):  # pin sessions so d0 plausibly holds some
+        router.submit(f"s{i}", f"r{i}", [1, 2, 3], 2)
+        router.pump()
+
+    def replicas_of(gauge):
+        return {lbl.get("replica") for lbl, _v in gauge.samples()}
+
+    assert "d0" in replicas_of(_HEALTHY_INFO)
+    assert "d0" in replicas_of(_PINNED)
+
+    router.request_evict("d0")
+
+    for gauge in (_HEALTHY_INFO, _PINNED, _BACKLOG):
+        assert "d0" not in replicas_of(gauge)
+    # the survivors' series are untouched
+    assert {"d1", "d2"} <= replicas_of(_HEALTHY_INFO)
+    assert {"d1", "d2"} <= replicas_of(_PINNED)
+    # …and new work still routes (to the survivors)
+    got = router.submit("fresh", "r99", [1, 2], 2)
+    assert got in {"d1", "d2"}

@@ -886,3 +886,131 @@ def test_oversized_wire_stream_refused_typed_at_open():
         sender.open()
     sender.abort()
     assert leak_free(sink.pool)                  # nothing was leased
+
+
+# ---------------------------------------------------------------------------
+# request-scoped trace propagation over the wire (docs/observability.md
+# §Request tracing): OPEN meta carries the trace-context token, the
+# receiver's kv_wire_recv span joins the request's tree even across a
+# real socket, and both wire spans close EXACTLY once — ok on FIN,
+# error-status on abort — with token exactness untouched
+# ---------------------------------------------------------------------------
+
+from vtpu.serving.reqtrace import LEDGER  # noqa: E402
+from vtpu.utils import trace  # noqa: E402
+
+
+@pytest.fixture()
+def _wire_tracing():
+    trace.clear()
+    trace.tracing(True)
+    LEDGER.clear()
+    yield
+    trace.tracing(False)
+    trace.clear()
+    LEDGER.clear()
+
+
+def spans_named(name):
+    return [s for s in trace.recent_spans(n=1000) if s["name"] == name]
+
+
+def test_trace_context_joins_across_real_http_socket(
+        kv_http_server, _wire_tracing):
+    sink, hub, port = kv_http_server
+    src = FakeSource()
+    link = tp.HttpKVLink(f"http://127.0.0.1:{port}")
+    LEDGER.admit("r0", session="acme/s")
+    tctx = LEDGER.ctx("r0")
+    handle = src.make_handle(4)
+    blocks = src.pool.adopt(handle)
+    ex = src.start_extract(blocks)
+    sender = tp.StreamSender(
+        link, "r0", handle, ex, layout=src.wire_layout(),
+        meta_extra={"trace": tctx}, chunk_blocks=2,
+        on_done=lambda ok: src.pool.release(blocks),
+    )
+    try:
+        assert sender.pump() is True
+        assert sink.written["r0"] == ex.blob     # payload untouched
+    finally:
+        link.close()
+    (tx,) = spans_named("kv_wire_stream")
+    (rx,) = spans_named("kv_wire_recv")
+    # both legs joined the request's trace (trace id = rid) through the
+    # OPEN frame's meta — the same join works cross-process because the
+    # token rides the wire, not process memory
+    assert tx["trace_id"] == "r0" and rx["trace_id"] == "r0"
+    assert tx["parent"] is not None and rx["parent"] is not None
+    assert tx["ok"] and rx["ok"]
+    assert rx["chunks"] == tx["resumes"] + 2     # 2 data chunks, no tears
+    # the pump span nests under the stream span
+    (pump,) = spans_named("kv_wire_stream_pump")
+    assert pump["parent"] == tx["span_id"]
+    # the ledger booked the wire bytes against the session's tenant
+    from vtpu.serving.reqtrace import TENANT_WIRE_BYTES
+    assert TENANT_WIRE_BYTES.value(tenant="acme") >= len(ex.blob)
+    # no span leaks: everything in the ring is closed (dur stamped)
+    assert all(s.get("dur_ms") is not None
+               for s in trace.recent_spans(n=1000))
+
+
+def test_wire_spans_survive_torn_stream_resume(_wire_tracing):
+    state = {"torn": False}
+
+    def fault(data):
+        fr = tp.decode_frame(data)
+        if fr.kind == tp.KIND_DATA and fr.seq == 2 and not state["torn"]:
+            state["torn"] = True
+            raise OSError("connection reset")
+
+    sink, src, hub, link, handle, ex, sender = mk_stream(
+        n=6, fault=fault, chunk_blocks=2)
+    assert sender.pump() is True
+    assert sink.written["r0"] == ex.blob         # exactness unchanged
+    # one stream → ONE span per side, RESUME or not; the tear shows up
+    # as an attribute, not a second span
+    (tx,) = spans_named("kv_wire_stream")
+    (rx,) = spans_named("kv_wire_recv")
+    assert tx["ok"] and rx["ok"]
+    assert tx["resumes"] == 1
+    assert leak_free(src.pool)
+
+
+def test_receiver_abort_closes_both_spans_once_with_error(_wire_tracing):
+    sink, src, hub, link, handle, ex, sender = mk_stream(n=4)
+    sender.open()
+    hub.abort_all()                              # receiver-side death
+    hub.abort_all()                              # idempotent: no re-close
+    sender.abort()
+    sender.abort()                               # idempotent too
+    (tx,) = spans_named("kv_wire_stream")        # exactly once each
+    (rx,) = spans_named("kv_wire_recv")
+    assert rx["ok"] is False and rx["error"] == "receiver shutdown"
+    assert tx["ok"] is False and tx["error"] == "aborted"
+    assert leak_free(sink.pool) and leak_free(src.pool)
+
+
+def test_wire_error_abort_span_carries_typed_error(_wire_tracing):
+    sink, src, hub, link, handle, ex, sender = mk_stream(n=4)
+    sender.open()
+    # out-of-order chunk: the receiver funnel tears the stream down and
+    # the recv span must close with the TYPED error, not a generic one
+    with pytest.raises(tp.WireError):
+        hub.handle(tp.encode_frame(
+            tp.KIND_DATA, sender.sid, seq=2, nchunks=sender.nchunks,
+            block_off=2, nblocks=2, payload=ex.payload(2, 4),
+        ))
+    sender.abort()
+    (rx,) = spans_named("kv_wire_recv")
+    assert rx["ok"] is False and "OutOfOrderChunkError" in rx["error"]
+    (tx,) = spans_named("kv_wire_stream")
+    assert tx["ok"] is False
+    assert leak_free(sink.pool) and leak_free(src.pool)
+
+
+def test_wire_spans_absent_when_tracing_off():
+    sink, src, hub, link, handle, ex, sender = mk_stream(n=4)
+    assert sender.pump() is True
+    assert sink.written["r0"] == ex.blob
+    assert trace.recent_spans() == []            # hot path stayed dark

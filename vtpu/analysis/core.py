@@ -147,6 +147,7 @@ def load_passes() -> list:
     from vtpu.analysis.passes.jax_hygiene import JaxHygienePass
     from vtpu.analysis.passes.lock_discipline import LockDisciplinePass
     from vtpu.analysis.passes.obs_docs import ObsDocsPass
+    from vtpu.analysis.passes.span_docs import SpanDocsPass
 
     return [
         LockDisciplinePass(),
@@ -154,6 +155,7 @@ def load_passes() -> list:
         EnvAccessPass(),
         JaxHygienePass(),
         EnvDocsPass(),
+        SpanDocsPass(),
         ObsDocsPass(),
     ]
 

@@ -1,0 +1,85 @@
+"""span-docs — every span name emitted via the trace layer is catalogued.
+
+The request-tracing plane (vtpu/serving/reqtrace.py and friends) made
+span names an operator-facing vocabulary: ``GET /spans?name=`` filters
+on them, the Chrome export groups by them, and docs/observability.md's
+span catalog is how an on-call reader decodes a timeline.  A span name
+you can emit but cannot look up in the catalog is drift — the same rule
+obs-docs enforces for metric families and env-docs for VTPU_* knobs.
+
+The scan rides the shared AST walk: any call whose callee is named
+``span`` or ``start_span`` (bare or attribute — ``trace.span(...)``,
+``trace.start_span(...)``) with a literal first argument declares that
+span name.  docs/observability.md is matched on backticked tokens, not
+substrings — names like ``bind`` and ``filter`` would trivially appear
+in prose, so only a literal `` `name` `` catalog entry counts.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Sequence
+
+from vtpu.analysis.core import FileContext, Pass, Violation
+
+DOC = os.path.join("docs", "observability.md")
+
+# backticked tokens are the catalog entries; prose mentions don't count
+_DOC_TOKEN = re.compile(r"`([^`\n]+)`")
+
+# the span surface is the vtpu/ package (tests/hack construct ad-hoc
+# spans for fixtures, which is not an emission the catalog must cover)
+SCOPE_PREFIX = "vtpu" + os.sep
+
+_CALLEES = ("span", "start_span")
+
+
+def _callee_name(func: ast.expr) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+class SpanDocsPass(Pass):
+    name = "span-docs"
+
+    def __init__(self) -> None:
+        # span name -> first "rel:line" emitting it
+        self._found: Dict[str, str] = {}
+
+    def check_file(self, ctx: FileContext) -> List[Violation]:
+        if not ctx.rel.startswith(SCOPE_PREFIX):
+            return []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _callee_name(node.func) not in _CALLEES:
+                continue
+            if not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and \
+                    isinstance(first.value, str) and first.value:
+                self._found.setdefault(
+                    first.value, f"{ctx.rel}:{node.lineno}")
+        return []
+
+    def finalize(self, ctxs: Sequence[FileContext],
+                 repo_root: str) -> List[Violation]:
+        found, self._found = self._found, {}
+        doc_path = os.path.join(repo_root, DOC)
+        with open(doc_path, encoding="utf-8") as f:
+            documented = set(_DOC_TOKEN.findall(f.read()))
+        out = []
+        for name, where in sorted(found.items()):
+            if name not in documented:
+                rel, line = where.rsplit(":", 1)
+                out.append(Violation(
+                    rel, int(line), self.name,
+                    f"span {name!r}: not catalogued in {DOC}",
+                ))
+        return out

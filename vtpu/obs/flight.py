@@ -61,6 +61,9 @@ DEFAULT_FAMILIES: Tuple[Tuple[str, str], ...] = (
     ("serving", "vtpu_router_requests_total"),
     ("serving", "vtpu_router_sheds_total"),
     ("serving", "vtpu_session_migrations_total"),
+    ("serving", "vtpu_request_stage_seconds"),
+    ("serving", "vtpu_request_ttft_seconds"),
+    ("serving", "vtpu_request_itl_seconds"),
     ("obs", "vtpu_events_total"),
 )
 

@@ -1,7 +1,9 @@
 """Shared debug/observability HTTP surface.
 
 One implementation of the ``/spans`` (+ ``?n=`` / ``?name=`` filters),
-``/timeline?pod=<uid>``, ``/events?pod=&type=&since=&format=`` (the typed
+``/timeline?pod=<uid>`` (or ``?rid=`` for request traces),
+``/requests?rid=`` (per-request latency attribution),
+``/events?pod=&type=&since=&format=`` (the typed
 event journal), ``/slo`` (burn-rate report), ``/incidents`` (recorded
 bundles), ``/readyz`` (deep readiness), ``/trace.json`` (Chrome export)
 and registry ``/metrics`` endpoints, used three ways:
@@ -58,10 +60,12 @@ def spans_body(params: dict) -> bytes:
 
 
 def timeline_body(params: dict) -> Optional[bytes]:
-    """JSON for /timeline?pod=<uid> (trace id = pod UID); None when the
-    required ``pod`` param is missing.  The pod's journal events ride
-    along so the span feed and the what-happened record are one view."""
-    pod = params.get("pod") or params.get("trace")
+    """JSON for /timeline?pod=<uid> (trace id = pod UID; ``?rid=`` is
+    the request-trace alias — a request span tree's trace id is its
+    rid); None when the required param is missing.  The trace's journal
+    events ride along so the span feed and the what-happened record are
+    one view."""
+    pod = params.get("pod") or params.get("trace") or params.get("rid")
     if not pod:
         return None
     from vtpu.obs import events as events_mod
@@ -103,10 +107,14 @@ def handle_debug_get(
         elif route == "/timeline":
             body = timeline_body(params)
             if body is None:
-                send(400, b'{"error": "missing ?pod=<uid>"}',
+                send(400, b'{"error": "missing ?pod=<uid> or ?rid="}',
                      "application/json")
             else:
                 send(200, body, "application/json")
+        elif route == "/requests":
+            from vtpu.serving.reqtrace import requests_body
+
+            send(200, requests_body(params), "application/json")
         elif route == "/events":
             from vtpu.obs import events as events_mod
 

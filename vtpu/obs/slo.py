@@ -7,6 +7,10 @@ the FlightRecorder keeps.  This engine declares the stack's objectives —
 
 - ``filter_p99``: filter latency ≤ ``VTPU_SLO_FILTER_P99_S`` for 99 % of
   runs (over ``scheduler/vtpu_filter_seconds``, all paths),
+- ``ttft_p99`` / ``itl_p99``: serving time-to-first-token ≤
+  ``VTPU_SLO_TTFT_P99_S`` and inter-token latency ≤ ``VTPU_SLO_ITL_P99_S``
+  for 99 % of requests (over the request-attribution histograms in
+  vtpu/serving/reqtrace.py — populated only while tracing is on),
 - ``bind_success``: ≥ 99 % of bind attempts succeed
   (``PodBound`` vs ``BindFailed`` journal counters),
 - ``router_shed``: ≥ 99 % of router requests are admitted, not shed,
@@ -50,6 +54,8 @@ ENV_SLOW_WINDOW_S = "VTPU_SLO_SLOW_WINDOW_S"
 ENV_BURN_THRESHOLD = "VTPU_SLO_BURN_THRESHOLD"
 ENV_EVAL_S = "VTPU_SLO_EVAL_S"
 ENV_FILTER_P99_S = "VTPU_SLO_FILTER_P99_S"
+ENV_TTFT_P99_S = "VTPU_SLO_TTFT_P99_S"
+ENV_ITL_P99_S = "VTPU_SLO_ITL_P99_S"
 
 # selector = (family key, label filter or None); a counter's contribution
 # is the sum over label sets matching every filter entry
@@ -64,6 +70,20 @@ def default_objectives() -> List[dict]:
             "name": "filter_p99", "kind": "latency", "target": 0.99,
             "family": family_key("scheduler", "vtpu_filter_seconds"),
             "threshold_s": env_float(ENV_FILTER_P99_S, 0.25),
+        },
+        {
+            # serving-plane latency objectives over the request-
+            # attribution histograms (vtpu/serving/reqtrace.py); they
+            # observe only while tracing is on, so with tracing off the
+            # windows are empty and the burn is 0 — never a false breach
+            "name": "ttft_p99", "kind": "latency", "target": 0.99,
+            "family": family_key("serving", "vtpu_request_ttft_seconds"),
+            "threshold_s": env_float(ENV_TTFT_P99_S, 1.0),
+        },
+        {
+            "name": "itl_p99", "kind": "latency", "target": 0.99,
+            "family": family_key("serving", "vtpu_request_itl_seconds"),
+            "threshold_s": env_float(ENV_ITL_P99_S, 0.25),
         },
         {
             "name": "bind_success", "kind": "ratio", "target": 0.99,

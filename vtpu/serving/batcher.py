@@ -72,6 +72,8 @@ import numpy as np
 from vtpu import obs
 from vtpu.models.transformer import TransformerLM, _zero_cache, bucket_length
 from vtpu.ops.quant import dequantize_tree
+from vtpu.serving.reqtrace import LEDGER
+from vtpu.utils import trace
 
 _REG = obs.registry("serving")
 
@@ -311,6 +313,7 @@ class ContinuousBatcher:
         if rid in self._rids:
             raise ValueError(f"duplicate request id {rid!r}")
         self._rids.add(rid)
+        LEDGER.ensure(rid)  # direct-submit topologies skip the router
         self.queue.append(_Request(rid, prompt, num_new,
                                    submitted=time.perf_counter()))
         self._admit_pending()
@@ -390,6 +393,7 @@ class ContinuousBatcher:
             by_bucket.setdefault(
                 self._bucket_len(req.prompt.size), []
             ).append((slot, req))
+        tr = trace.tracing()
         for blen, sub in by_bucket.items():
             n = len(sub)
             rows = self._bucket_rows(n)
@@ -400,10 +404,18 @@ class ContinuousBatcher:
                 toks[r, :req.prompt.size] = req.prompt
                 lens[r] = req.prompt.size
                 slots[r] = slot
+            if tr:
+                for slot, req in sub:
+                    LEDGER.mark(req.rid, "prefill_start")
             firsts, self.cache, self.tok = self._admit_prog(
                 self.params, self._row_template(rows), toks, lens,
                 self.cache, self.tok, slots,
             )
+            if tr:
+                # dispatch boundary (the compute is async; the residue
+                # shows up in decode_window at the harvest sync)
+                for slot, req in sub:
+                    LEDGER.mark(req.rid, "prefill_done")
             self._queue_first(firsts, sub)
 
     def _merge_rows(self, slots: np.ndarray, rows_cache,
@@ -466,6 +478,7 @@ class ContinuousBatcher:
         Called at the head of each harvest — the prefills precede the
         harvested window in device order, so this transfer waits on
         nothing extra — and at run()'s drain."""
+        tr = trace.tracing()
         while self._pending_first:
             firsts, items, issued = self._pending_first.popleft()
             vals = self._fetch(firsts, issued)
@@ -474,6 +487,13 @@ class ContinuousBatcher:
                 self.out[req.rid].append(first)
                 if req.submitted:
                     _QTFT_HIST.observe(time.perf_counter() - req.submitted)
+                if tr:
+                    LEDGER.first_token(req.rid)
+                    if len(self.out[req.rid]) >= req.num_new:
+                        # num_new == 1: the transcript completed right
+                        # here (the slot was retired at admission time,
+                        # before this flush could see the token)
+                        LEDGER.finish(req.rid)
                 # freeze only if the rid still owns the slot (an
                 # instant retirement may have re-tenanted it)
                 if (self.rid[slot] == req.rid and self.eos_id is not None
@@ -527,9 +547,17 @@ class ContinuousBatcher:
 
     def _maybe_retire(self, slot: int) -> None:
         if self.remaining[slot] <= 0:
+            rid = self.rid[slot]
             self.active[slot] = False
             self.rid[slot] = None
             self._on_retire(slot)
+            # an instant retirement whose transcript already holds its
+            # tokens (adoption published the first token before calling
+            # here) closes its ledger record now; a pending-first
+            # admission's record closes at the flush instead, once the
+            # token has actually been published
+            if rid is not None and self.out.get(rid) and trace.tracing():
+                LEDGER.finish(rid)
 
     # ------------------------------------------------------------------
     def _inflight_tokens(self) -> int:
@@ -592,6 +620,7 @@ class ContinuousBatcher:
         eos_id right here, so the device-side feedback chain is
         unobservable."""
         self._flush_first_tokens()
+        tr = trace.tracing()  # once per window, not per token
         k = toks_np.shape[0]
         finished = []
         for i in range(self.max_batch):
@@ -608,6 +637,8 @@ class ContinuousBatcher:
                     self.done_frozen[i] = True
                 self.out[rid].append(t)
                 self.remaining[i] -= 1
+                if tr:
+                    LEDGER.token(rid)
             if self.remaining[i] <= 0:
                 finished.append(i)
         for i in finished:
@@ -615,6 +646,9 @@ class ContinuousBatcher:
             self.rid[i] = None
         if finished:
             self._retire_rows(finished)
+            if tr:
+                for i in finished:
+                    LEDGER.finish(rids[i])
         self._admit_pending()
 
     def step(self) -> None:
@@ -639,6 +673,12 @@ class ContinuousBatcher:
             self._harvest_oldest()
             return
         t0 = time.perf_counter()
+        # decode_window spans record the async DISPATCH cost only (the
+        # device time is invisible without a sync); start_span returns
+        # the empty dict while tracing is off, so this is one branch
+        # per window on the tracing-off path
+        sp = trace.start_span("decode_window", k=k,
+                              active=sum(self.active))
         # k == 1 is just a [1, b] window: one copy of the EOS-freeze/
         # budget/retire rules lives in _harvest_window, and the token
         # matrix comes out of the SAME program (an eager host-side
@@ -646,6 +686,7 @@ class ContinuousBatcher:
         self.tok, self.cache, toks = self._step_k(
             self.params, self.cache, self.tok, k
         )
+        trace.end_span(sp)
         _DISPATCH_HIST.observe(time.perf_counter() - t0)
         _WINDOWS_TOTAL.inc()
         self.steps += k

@@ -86,6 +86,8 @@ from vtpu.serving.kvpool import (
 from vtpu.serving.migrate import SessionExport, SessionGoneError
 from vtpu.serving.paged import PagedBatcher
 from vtpu.serving.prefix import chain_digests
+from vtpu.serving.reqtrace import LEDGER
+from vtpu.utils import trace
 
 __all__ = ["DecodeEngine", "HostExtract", "PrefillEngine",
            "PrefillResult", "pool_layout"]
@@ -516,18 +518,23 @@ class PrefillEngine:
             if cand is None:
                 break
             chain, run = cand
-            ex = self.start_extract(run, codec=self._spill_codec)
-            payload = ex.payload(0, len(run))  # sync: waits for the D2H
-            self.pool.store_spilled(chain, payload, self._spill_codec)
-            self.spill_demotions += 1
-            progressed = True
-            if self._persist is not None:
-                self._persist.append(chain, payload, self._spill_codec,
-                                     self.block_size)
-                self.pool.set_disk_blocks(self._persist.blocks_journaled)
+            with trace.span("kv_spill_demote", blocks=len(run),
+                            codec=self._spill_codec):
+                ex = self.start_extract(run, codec=self._spill_codec)
+                payload = ex.payload(0, len(run))  # sync: waits for the D2H
+                self.pool.store_spilled(chain, payload, self._spill_codec)
+                self.spill_demotions += 1
+                progressed = True
+                if self._persist is not None:
+                    self._persist.append(chain, payload,
+                                         self._spill_codec,
+                                         self.block_size)
+                    self.pool.set_disk_blocks(
+                        self._persist.blocks_journaled)
         return progressed and self.pool.free_blocks() >= need
 
-    def _maybe_onload(self, chain: List[str], max_blocks: int) -> None:
+    def _maybe_onload(self, chain: List[str], max_blocks: int,
+                      rid: Optional[str] = None) -> None:
         """Host-tier hit: when the spill tier holds a deeper run than
         the device registry, lease blocks, scatter the dequantized
         payload back (the adoption scatter), and re-register the chain
@@ -555,12 +562,20 @@ class PrefillEngine:
             blocks = self.pool.try_lease(k)
         if blocks is None:
             return
-        self._spill_scatter(blocks, payload, codec, k)
-        self.pool.register_prefix(sub_chain, blocks)
-        # the registry's pins keep the blocks live; the lease hands off
-        self.pool.release(blocks)
+        t_sp = time.perf_counter()
+        with trace.span("kv_spill_onload", blocks=k, codec=codec,
+                        ctx=(LEDGER.ctx(rid) if rid is not None
+                             else None)):
+            self._spill_scatter(blocks, payload, codec, k)
+            self.pool.register_prefix(sub_chain, blocks)
+            # the registry's pins keep the blocks live; the lease
+            # hands off
+            self.pool.release(blocks)
         self.spill_onloads += 1
         SPILL_ONLOADS.inc()
+        if rid is not None:
+            LEDGER.pause(rid, "spill_onload",
+                         time.perf_counter() - t_sp)
 
     def _spill_scatter(self, blocks: List[int], payload: bytes,
                        codec: str, k: int) -> None:
@@ -638,6 +653,10 @@ class PrefillEngine:
             chain = chain_digests(p.tolist(), self.block_size)
         self.queue.append((rid, p, num_new, time.perf_counter(),
                            list(chain)))
+        # attribution record for direct-submit topologies (the router
+        # already minted one; ensure() is idempotent and a tracing-off
+        # no-op)
+        LEDGER.ensure(rid)
 
     def pool_leaves(self) -> dict:
         """The device pool buffers a cross-pool adoption reads from."""
@@ -678,6 +697,7 @@ class PrefillEngine:
         tokens, never cache contents."""
         # taken rows: (rid, prompt, num_new, t0, chain, table_blocks,
         #              shared_tok)
+        tr = trace.tracing()
         taken: List[Tuple] = []
         while self.queue:
             rid, p, num_new, t0, chain = self.queue[0]
@@ -690,7 +710,8 @@ class PrefillEngine:
                 # host-tier hit first: a spilled run deeper than the
                 # device registry onloads back into leased blocks so
                 # the match below hits device-side
-                self._maybe_onload(chain, max_blocks)
+                self._maybe_onload(chain, max_blocks,
+                                   rid=rid if tr else None)
                 shared, k = self.pool.match_and_ref(chain, max_blocks)
                 shared_tok = k * self.block_size
             need = self._blocks_needed(p.size, num_new) - len(shared)
@@ -722,6 +743,17 @@ class PrefillEngine:
                           shared + blocks, shared_tok))
         if not taken:
             return []
+        # per-request prefill spans: router_queue ends (the dispatch
+        # mark) and prefill_compute begins for every taken prompt
+        pf_spans: Dict[str, dict] = {}
+        if tr:
+            for item in taken:
+                rid = item[0]
+                LEDGER.mark(rid, "prefill_start")
+                pf_spans[rid] = trace.start_span(
+                    "prefill", ctx=LEDGER.ctx(rid), rid=rid,
+                    prompt_tokens=int(item[1].size),
+                )
         by_bucket: Dict[int, list] = {}
         for item in taken:
             p, shared_tok = item[1], item[6]
@@ -765,6 +797,9 @@ class PrefillEngine:
                 out.append(PrefillResult(rid, int(vals[r]), handle,
                                          num_new, t0,
                                          chain=tuple(chain or ())))
+                if tr:
+                    LEDGER.mark(rid, "prefill_done")
+                    trace.end_span(pf_spans.pop(rid, {}))
         self.prefills += len(out)
         return out
 
@@ -1001,6 +1036,9 @@ class DecodeEngine(PagedBatcher):
             mode, src, submitted,
             chain=list(chain) if chain else None,
         ))
+        # in-process handoff: the wire_transfer stage is zero-width
+        # (wire streams mark this from wire_finish instead)
+        LEDGER.mark(rid, "handoff_done")
         if admit:
             self._admit_pending()
 
@@ -1309,6 +1347,10 @@ class DecodeEngine(PagedBatcher):
                     except (KeyError, TypeError, ValueError):
                         self.out[rid] = [first]  # malformed: FIN decides
                     SPEC_ADOPTIONS.inc()
+                    # speculative publish IS the first token (loopback
+                    # topologies share the sender's ledger; a remote
+                    # receiver has no record and this is a no-op)
+                    LEDGER.first_token(rid)
         return ctx
 
     def wire_credits(self, ctx) -> int:
@@ -1428,6 +1470,7 @@ class DecodeEngine(PagedBatcher):
         from vtpu.serving.transport import WireError
 
         ctx["closed"] = True
+        LEDGER.mark(ctx["rid"], "handoff_done")
         sess = (meta or {}).get("session")
         try:
             seq_len = int(meta["handle"]["seq_len"])
@@ -1566,6 +1609,7 @@ class DecodeEngine(PagedBatcher):
         # tokens cross the host, cache contents never do).  A migrated
         # session (pa.tail) resumes its FULL transcript and EOS state;
         # its budget accounting is identical (num_new = remaining + 1).
+        tr = trace.tracing()
         for slot, pa, _dst in group:
             tail = pa.tail if pa.tail is not None else [pa.first]
             self.rid[slot] = pa.rid
@@ -1591,6 +1635,12 @@ class DecodeEngine(PagedBatcher):
                 _batcher._QTFT_HIST.observe(
                     time.perf_counter() - pa.submitted
                 )
+            if tr:
+                # adoption ends here; for non-speculative streams this
+                # publish is also the first token (idempotent — the
+                # wire_open speculative publish wins when it happened)
+                LEDGER.mark(pa.rid, "adopted")
+                LEDGER.first_token(pa.rid)
             self._maybe_retire(slot)
 
     def _adopt_arrays(self, entries):

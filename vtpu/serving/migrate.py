@@ -60,12 +60,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from vtpu.analysis.witness import make_lock
 from vtpu import obs
 from vtpu.serving.kvpool import KVHandle, KVHandoffError
+from vtpu.serving.reqtrace import LEDGER
 from vtpu.serving.transport import (
     LoopbackLink,
     ReceiverHub,
     ReplicaSaturatedError,
     StreamSender,
 )
+from vtpu.utils import trace
 from vtpu.utils.envs import env_int
 
 log = logging.getLogger(__name__)
@@ -266,7 +268,35 @@ class SessionMover:
         matches the chain) → resume on the target.  Raises the typed
         :class:`MigrationError` hierarchy; on every failure except the
         ambiguous-FIN window the session is restored on the source
-        (finish-in-place) before the error propagates."""
+        (finish-in-place) before the error propagates.
+
+        The whole move is one ``session_migrate`` span under the
+        request's trace context, closed with error status on every
+        typed failure; its wall time accrues to the request's
+        ``migration_pause`` stage either way (the session was not
+        decoding while the move ran, success or not)."""
+        sp = trace.start_span("session_migrate", ctx=LEDGER.ctx(rid),
+                              rid=rid)
+        t0 = self._clock()
+        try:
+            report = self._move(rid, source, targets,
+                                trace.context_of(sp))
+        except BaseException as e:
+            trace.end_span(sp, ok=False,
+                           error=f"{type(e).__name__}: {e}")
+            LEDGER.pause(rid, "migration_pause", self._clock() - t0)
+            raise
+        if sp:
+            sp["target"] = report.target
+            sp["blocks_shipped"] = report.blocks_shipped
+            sp["blocks_skipped"] = report.blocks_skipped
+        trace.end_span(sp)
+        LEDGER.pause(rid, "migration_pause", report.duration_s)
+        return report
+
+    def _move(self, rid: str, source,
+              targets: Sequence[Tuple[str, object]],
+              tctx: Optional[str]) -> MoveReport:
         src = self.engine_of(source)
         t0 = self._clock()
         try:
@@ -301,6 +331,9 @@ class SessionMover:
                     "num_new": int(export.remaining) + 1,
                     "submitted": 0.0,
                     "session": export.session_doc(),
+                    # the migration leg's wire spans (and the remote
+                    # receiver's) nest under the session_migrate span
+                    **({"trace": tctx} if tctx else {}),
                 },
                 chunk_blocks=self.chunk_blocks, retries=self.retries,
                 codec=self.codec,

@@ -59,6 +59,8 @@ from vtpu.models.transformer import TransformerLM, bucket_length
 from vtpu.ops.quant import dequantize_tree
 from vtpu.serving.batcher import ContinuousBatcher, _Request
 from vtpu.serving.kvpool import BlockPool
+from vtpu.serving.reqtrace import LEDGER
+from vtpu.utils import trace
 
 
 class PagedBatcher(ContinuousBatcher):
@@ -283,12 +285,21 @@ class PagedBatcher(ContinuousBatcher):
             # prefill reads the written blocks, never zeros
             for slot, req, *_ in sub:
                 self._register_prefix(req.prompt, self._slot_blocks[slot])
+            tr = trace.tracing()
+            if tr:
+                for _slot, req, *_ in sub:
+                    LEDGER.mark(req.rid, "prefill_start")
             pools, bpos, btab = self._split_cache()
             firsts, new_pools, btab, bpos, self.tok = self._admit_pool(
                 self.params, pools, pos0, table, toks, lens,
                 bpos, btab, self.tok, slots, sizes,
             )
             self.cache = dict(new_pools, pos=bpos, block_table=btab)
+            if tr:
+                # dispatch boundary (the compute is async; the residue
+                # shows up in decode_window at the harvest sync)
+                for _slot, req, *_ in sub:
+                    LEDGER.mark(req.rid, "prefill_done")
             self._queue_first(firsts, [(s, r) for s, r, *_ in sub])
 
     def _chunks(self, key: tuple):

@@ -66,6 +66,7 @@ from vtpu.serving.migrate import (
     SessionMover,
 )
 from vtpu.serving.prefix import PrefixIndex, chain_digests
+from vtpu.serving.reqtrace import LEDGER
 from vtpu.serving.transport import ReplicaSaturatedError
 
 log = logging.getLogger(__name__)
@@ -252,6 +253,26 @@ class Router:
         """The primary prefill engine (single-prefill topologies)."""
         return next(iter(self.prefills.values()))
 
+    # -- metric hygiene --------------------------------------------------
+    def _set_pinned_gauge(self, rid: str) -> None:
+        """``vtpu_router_sessions_pinned_total`` for one replica.  An
+        evicted replica is leaving for good: its series is PRUNED from
+        the exposition, not left at a stale last value (Prometheus
+        treats the disappearance as the end of the series)."""
+        if rid in self._evicted:
+            _PINNED.remove(replica=rid)
+        else:
+            _PINNED.set(float(self._pinned.get(rid, 0)), replica=rid)
+
+    def _set_backlog_gauge(self, replica: str) -> None:
+        """``vtpu_router_backlog_total`` for one replica — pruned once an
+        evicted replica's in-flight work drains to zero (it may still be
+        finishing handoffs admitted before the evict)."""
+        if replica in self._evicted and not self._pending.get(replica, 0):
+            _BACKLOG.remove(replica=replica)
+        else:
+            _BACKLOG.set(self._pending.get(replica, 0), replica=replica)
+
     # -- routing --------------------------------------------------------
     @staticmethod
     def _safe_stats(eng) -> dict:
@@ -279,7 +300,7 @@ class Router:
             # idle session has nothing to move) and re-pin below.
             self._sessions.pop(session, None)
             self._pinned[pinned] = max(0, self._pinned[pinned] - 1)
-            _PINNED.set(float(self._pinned[pinned]), replica=pinned)
+            self._set_pinned_gauge(pinned)
             pinned = None
         if pinned is not None:
             # in-flight sessions finish where they are, even on a
@@ -296,11 +317,11 @@ class Router:
         rid = self._ring.owner(session)
         self._sessions[session] = rid
         self._pinned[rid] += 1
-        _PINNED.set(float(self._pinned[rid]), replica=rid)
+        self._set_pinned_gauge(rid)
         while len(self._sessions) > self._session_cap:
             _sess, old = self._sessions.popitem(last=False)
             self._pinned[old] = max(0, self._pinned[old] - 1)
-            _PINNED.set(float(self._pinned[old]), replica=old)
+            self._set_pinned_gauge(old)
         return rid
 
     def _pick_prefill(self, chain=()) -> str:
@@ -396,6 +417,10 @@ class Router:
             _REQS_TOTAL.inc(outcome="shed")
             _SHED_TOTAL.inc(reason=e.reason)
             raise
+        # admission passed: mint the request trace + attribution record
+        # (no-op while tracing is off) BEFORE the prefill submit so the
+        # engine's dispatch marks land on an existing record
+        LEDGER.admit(rid, session, prompt_tokens=len(prompt))
         if (chain
                 and getattr(self.prefills[pid], "prefix_cache", False)
                 and getattr(self.prefills[pid], "block_size", 0)
@@ -420,7 +445,7 @@ class Router:
             self._rid_session.popitem(last=False)
         self._pending[replica] = self._pending.get(replica, 0) + 1
         _REQS_TOTAL.inc(outcome="routed")
-        _BACKLOG.set(self._pending[replica], replica=replica)
+        self._set_backlog_gauge(replica)
         return replica
 
     def cancel(self, rid: str) -> bool:
@@ -445,17 +470,20 @@ class Router:
             if purged:
                 self._rid_prefill.pop(rid, None)
                 self._clear_ledger(rid)
+                LEDGER.finish(rid, ok=False, error="cancelled")
                 return True
             # already inside the engine's admission round (or the
             # engine cannot purge / is unreachable): release the result
             # on arrival
             self._cancelled.add(rid)
+            LEDGER.finish(rid, ok=False, error="cancelled")
             return True
         for i, (target, res, _src) in enumerate(self._parked):
             if res.rid == rid:
                 del self._parked[i]
                 self._dec_pending(target)
                 self._release_result(res)
+                LEDGER.finish(rid, ok=False, error="cancelled")
                 return True
         for rep_id, eng in self.replicas.items():
             purge = getattr(eng, "purge_pending", None)
@@ -463,6 +491,7 @@ class Router:
                 continue
             try:
                 if purge(rid):
+                    LEDGER.finish(rid, ok=False, error="cancelled")
                     return True
             except Exception:  # noqa: BLE001 — one dead replica must
                 # not stop the walk reaching a live replica's entry
@@ -560,13 +589,20 @@ class Router:
         if replica_id in self._healthy:
             self._healthy.discard(replica_id)
             self._rebuild_ring()
-            _HEALTHY_INFO.set(0.0, replica=replica_id)
             _TRANSITIONS.inc(replica=replica_id, to="drained")
             emit(EventType.REPLICA_DRAINED, "router", node=replica_id,
                  reason=reason)
             log.info("router: replica %s drained (%s)", replica_id,
                      reason)
-        return self._migrate_from(replica_id, reason=reason)
+        moved = self._migrate_from(replica_id, reason=reason)
+        # the replica is leaving for good: prune its replica-labelled
+        # series (healthy_info / pinned / drained backlog) instead of
+        # exporting a dead replica's gauges forever — a health drain, by
+        # contrast, keeps them (it may restore)
+        _HEALTHY_INFO.remove(replica=replica_id)
+        self._set_pinned_gauge(replica_id)
+        self._set_backlog_gauge(replica_id)
+        return moved
 
     # -- live session migration (vtpu/serving/migrate.py) ---------------
     def _migration_targets(self, exclude: str) -> List:
@@ -634,10 +670,8 @@ class Router:
                 self._pinned[source_id] = max(
                     0, self._pinned[source_id] - 1)
                 self._pinned[report.target] += 1
-                _PINNED.set(float(self._pinned[source_id]),
-                            replica=source_id)
-                _PINNED.set(float(self._pinned[report.target]),
-                            replica=report.target)
+                self._set_pinned_gauge(source_id)
+                self._set_pinned_gauge(report.target)
         self._retarget_inflight(source_id)
         return moved
 
@@ -662,7 +696,7 @@ class Router:
             self._target[rid] = new
             self._dec_pending(source_id)
             self._pending[new] = self._pending.get(new, 0) + 1
-            _BACKLOG.set(self._pending[new], replica=new)
+            self._set_backlog_gauge(new)
         for i, (tgt, res, src) in enumerate(self._parked):
             if tgt != source_id:
                 continue
@@ -672,7 +706,7 @@ class Router:
             self._parked[i] = (new, res, src)
             self._dec_pending(source_id)
             self._pending[new] = self._pending.get(new, 0) + 1
-            _BACKLOG.set(self._pending[new], replica=new)
+            self._set_backlog_gauge(new)
 
     def _restore(self, rid: str) -> None:
         self._healthy.add(rid)
@@ -767,7 +801,7 @@ class Router:
     # -- drive ----------------------------------------------------------
     def _dec_pending(self, replica: str) -> None:
         self._pending[replica] = max(0, self._pending.get(replica, 1) - 1)
-        _BACKLOG.set(self._pending[replica], replica=replica)
+        self._set_backlog_gauge(replica)
 
     def _clear_ledger(self, rid: str) -> None:
         orig = self._target.pop(rid, None)
@@ -779,6 +813,7 @@ class Router:
         source pool instead of leaking them."""
         pid = self._rid_prefill.pop(res.rid, None)
         eng = self.prefills.get(pid) if pid is not None else self.prefill
+        LEDGER.finish(res.rid, ok=False, error="shed")
         try:
             eng.pool.release_handle(res.handle)
         except KVHandoffError:
@@ -888,8 +923,7 @@ class Router:
                         self._pending[target] = (
                             self._pending.get(target, 0) + 1
                         )
-                        _BACKLOG.set(self._pending[target],
-                                     replica=target)
+                        self._set_backlog_gauge(target)
                         continue
                     except Exception:  # noqa: BLE001 — died mid-handoff
                         log.exception("router: handoff to %s failed",

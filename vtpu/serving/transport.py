@@ -76,6 +76,7 @@ from vtpu.serving.kvpool import (
     PoolMismatchError,
     StaleHandleError,
 )
+from vtpu.serving.reqtrace import LEDGER
 from vtpu.utils import trace
 from vtpu.utils.envs import env_int
 
@@ -325,7 +326,7 @@ def decode_frame(data: bytes) -> Frame:
 class _RxStream:
     __slots__ = ("sid", "rid", "meta", "ctx", "nchunks", "next_seq",
                  "total_blocks", "received_blocks", "credits",
-                 "stamp_key", "opened", "codec", "skip")
+                 "stamp_key", "opened", "codec", "skip", "span")
 
     def __init__(self, sid, rid, meta, ctx, nchunks, total_blocks,
                  credits, stamp_key, opened, codec, skip=0):
@@ -333,6 +334,11 @@ class _RxStream:
         self.rid = rid
         self.meta = meta
         self.ctx = ctx
+        # receiver-side trace span (kv_wire_recv), parented under the
+        # sender's trace context carried in the OPEN meta; closed ok at
+        # FIN, closed with error status by _abort_stream — exactly once
+        # either way (end_span double-closes are no-ops)
+        self.span: dict = {}
         self.nchunks = nchunks
         self.next_seq = 1
         # blocks the sender actually SHIPS: the handle total minus the
@@ -402,12 +408,14 @@ class ReceiverHub:
         with self._lock:
             return len(self._streams)
 
-    def _abort_stream(self, st: _RxStream) -> None:
+    def _abort_stream(self, st: _RxStream,
+                      error: str = "stream aborted") -> None:
         self._streams.pop(st.sid, None)
         try:
             self.sink.wire_abort(st.ctx)
         except Exception:  # noqa: BLE001 — abort must not mask the cause
             log.exception("kv wire: sink abort failed for %s", st.rid)
+        trace.end_span(st.span, ok=False, error=error)
         self._set_credit_gauge()
 
     def abort_all(self) -> None:
@@ -415,7 +423,7 @@ class ReceiverHub:
         partial adoption."""
         with self._lock:
             for st in list(self._streams.values()):
-                self._abort_stream(st)
+                self._abort_stream(st, error="receiver shutdown")
                 TRANSPORT_STREAMS.inc(outcome="aborted")
 
     # -- frame handling -------------------------------------------------
@@ -431,7 +439,7 @@ class ReceiverHub:
             if frame.kind == KIND_ABORT:
                 st = self._streams.get(frame.sid)
                 if st is not None:
-                    self._abort_stream(st)
+                    self._abort_stream(st, error="peer abort")
                     TRANSPORT_STREAMS.inc(outcome="aborted")
                 return {"status": "ok"}
             if frame.kind == KIND_RESUME:
@@ -518,6 +526,12 @@ class ReceiverHub:
         st = _RxStream(frame.sid, rid, meta, ctx, nchunks, suffix,
                        credits, stamp_key, time.perf_counter(), codec,
                        skip=skip)
+        # the sender's trace context crosses in the OPEN meta — the
+        # receiver span joins the request's tree even across HttpKVLink
+        st.span = trace.start_span(
+            "kv_wire_recv", ctx=meta.get("trace"), rid=rid,
+            blocks=suffix, codec=codec, skip=skip,
+        )
         self._streams[frame.sid] = st
         self._stamps[stamp_key] = frame.sid
         while len(self._stamps) > self._stamp_cap:
@@ -592,6 +606,9 @@ class ReceiverHub:
                     )
                 self._streams.pop(st.sid, None)
                 self.sink.wire_finish(st.ctx, st.meta)
+                if st.span:
+                    st.span["chunks"] = st.nchunks
+                trace.end_span(st.span)
                 self._fins[st.sid] = st.nchunks
                 while len(self._fins) > self._stamp_cap:
                     self._fins.popitem(last=False)
@@ -604,10 +621,10 @@ class ReceiverHub:
             self._set_credit_gauge()
             return {"status": "ok", "next": st.next_seq,
                     "credits": st.credits}
-        except WireError:
+        except WireError as e:
             # protocol violations tear the stream down leak-free BEFORE
             # propagating — a half-adopted handle must never pin blocks
-            self._abort_stream(st)
+            self._abort_stream(st, error=f"{type(e).__name__}: {e}")
             TRANSPORT_STREAMS.inc(outcome="aborted")
             raise
 
@@ -799,6 +816,11 @@ class StreamSender:
         self._next = 0            # 0 = OPEN not yet acked
         self._credits = 0
         self._resumes = 0         # per-stream budget: retries total
+        # sender-side trace span (kv_wire_stream), opened at OPEN under
+        # the request's context (meta["trace"]); _finish/abort close it
+        # exactly once (the done/aborted flags gate both, and end_span
+        # double-closes are no-ops)
+        self._span: dict = {}
         self._t0 = 0.0
         self.finished_at = 0.0    # perf_counter stamp of final ack/abort
         self.done = False
@@ -890,6 +912,10 @@ class StreamSender:
         when the receiver cannot pre-lease a single block (the caller
         parks the handoff — nothing was claimed or leaked)."""
         self._t0 = time.perf_counter()
+        self._span = trace.start_span(
+            "kv_wire_stream", ctx=self.meta.get("trace"), rid=self.rid,
+            blocks=len(self.handle.blocks),
+        )
         rsp = self._send(encode_frame(
             KIND_DATA, self.sid, seq=0, nchunks=self.nchunks,
             meta=self.meta,
@@ -940,7 +966,8 @@ class StreamSender:
         # credit grant all count SHIPPED blocks (handle total − skip);
         # with skip 0 this is byte-identical to the PR 10 sender
         total = len(self.handle.blocks) - self.skip
-        with trace.span("kv_wire_stream_pump", rid=self.rid):
+        with trace.span("kv_wire_stream_pump", rid=self.rid,
+                        ctx=trace.context_of(self._span)):
             while self._next <= self.nchunks:
                 lo = (self._next - 1) * self.chunk_blocks
                 hi = min(lo + self.chunk_blocks, total)
@@ -979,6 +1006,7 @@ class StreamSender:
                     flags=FLAG_FIN if fin else 0, payload=payload,
                 ))
                 self.fin_unacked = False
+                LEDGER.wire_bytes(self.rid, len(payload))
                 self._next = int(rsp.get("next", self._next + 1))
                 self._credits = int(rsp.get("credits", self._credits))
             self._finish()
@@ -988,6 +1016,14 @@ class StreamSender:
         self.done = True
         self.finished_at = time.perf_counter()
         TRANSPORT_STREAM_HIST.observe(self.finished_at - self._t0)
+        if self._span:
+            self._span["resumes"] = self._resumes
+            self._span["codec"] = self.codec
+        trace.end_span(self._span)
+        # sender-side handoff boundary: with a cross-process receiver
+        # this ledger holds the only record (first write wins, so the
+        # loopback sink's own wire_finish mark is not disturbed)
+        LEDGER.mark(self.rid, "handoff_done")
         if self.on_done is not None:
             self.on_done(True)
 
@@ -998,6 +1034,12 @@ class StreamSender:
             return
         self.aborted = True
         self.finished_at = time.perf_counter()
+        if self._span:
+            self._span["resumes"] = self._resumes
+        trace.end_span(
+            self._span, ok=False,
+            error="receiver_gone" if self.receiver_gone else "aborted",
+        )
         if notify:
             try:
                 self.link.send(encode_frame(KIND_ABORT, self.sid),
@@ -1071,6 +1113,12 @@ class WireReplica:
         meta_extra = {"first": int(first_token),
                       "num_new": int(num_new),
                       "submitted": float(submitted)}
+        # request trace context crosses the wire in the OPEN meta, so
+        # the receiver's kv_wire_recv span joins this request's tree
+        # even across HttpKVLink (None while tracing is off — omitted)
+        tctx = LEDGER.ctx(rid)
+        if tctx is not None:
+            meta_extra["trace"] = tctx
         if chain:
             # decode-side prefix adoption over the wire: the receiver
             # matches the chain against its pool registry at OPEN and

@@ -202,6 +202,78 @@ def span(
             pass
 
 
+def start_span(
+    name: str,
+    trace_id: Optional[str] = None,
+    ctx: Optional[str] = None,
+    **attrs: object,
+) -> Dict[str, object]:
+    """Begin-style counterpart to :func:`span` for long-lived work that
+    crosses threads or hops (a request span opened at router admission and
+    closed when the first token publishes; a wire stream span closed by
+    the pump's completion callback).
+
+    Unlike :func:`span` this does NOT push the thread-local stack —
+    unrelated spans opened on other threads must not accidentally parent
+    under it — so children join explicitly via ``ctx=context_of(sp)``.
+    Returns the live span dict ({} when tracing is off: every field
+    access stays ``sp.get(...)``-safe and ``end_span({})`` is a no-op).
+    """
+    if not tracing():
+        return {}
+    parent: Optional[int] = None
+    if ctx is not None:
+        ctx_trace, parent = parse_context(ctx)
+        if trace_id is None:
+            trace_id = ctx_trace
+    sp: Dict[str, object] = {
+        "name": name,
+        "start": time.time(),
+        "trace_id": trace_id,
+        "span_id": _alloc_span_id(),
+        "parent": parent,
+        "proc": _PROC_ID,
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+        "_t0": time.monotonic(),
+        **attrs,
+    }
+    return sp
+
+
+def end_span(
+    sp: Dict[str, object],
+    ok: bool = True,
+    error: Optional[str] = None,
+) -> None:
+    """Close a :func:`start_span` span: stamp duration/outcome and commit
+    it to the ring.  Exactly-once by construction — the monotonic anchor
+    ``_t0`` is popped on the first close, so double-closes (a stream that
+    both finishes and is aborted by a racing teardown) are no-ops, as is
+    closing the disabled-tracing ``{}`` span."""
+    if not sp:
+        return
+    t0 = sp.pop("_t0", None)
+    if t0 is None:
+        return
+    sp["ok"] = bool(ok)
+    if error is not None:
+        sp["error"] = str(error)
+    sp["dur_ms"] = round((time.monotonic() - t0) * 1e3, 3)
+    try:
+        with _lock:
+            _spans.append(sp)
+            _seen_ids.add(_span_key(sp))
+            _trim_seen_locked()
+        log.info("span %s dur=%.2fms ok=%s %s", sp.get("name"),
+                 sp["dur_ms"], sp.get("ok"),
+                 {k: v for k, v in sp.items()
+                  if k not in ("name", "start", "dur_ms", "ok",
+                               "pid", "tid")})
+    except Exception:  # noqa: BLE001 — tracing must never break the path
+        pass
+
+
 def recent_spans(n: int = 100, name: Optional[str] = None) -> list:
     """Last ``n`` spans, newest last; ``name`` filters before the count
     (the /spans?n=&name= debug query)."""
