@@ -11,7 +11,7 @@ PY ?= python3
 .PHONY: all build shim proto test test-slow test-all test-native bench \
 	bench-sched bench-serve bench-churn bench-disagg bench-gang \
 	bench-goodput bench-migrate bench-colo bench-planet bench-replay \
-	bench-kv bench-smoke \
+	bench-kv bench-smoke dataset \
 	check obs-lint \
 	config-lint audit-check image chart clean tidy
 
@@ -314,6 +314,22 @@ ifdef SMOKE
 	JAX_PLATFORMS=cpu $(PY) benchmarks/serving_colo.py --smoke
 else
 	JAX_PLATFORMS=cpu $(PY) benchmarks/serving_colo.py
+endif
+
+# placement-learning dataset (ROADMAP item 2): drive one goodput arm
+# with the decision/event/outcome JSONL mirrors live, join them offline
+# through vtpu/obs/dataset.py (rotation-stitched, torn-tail tolerant,
+# dedupe-on-seq) and verify the joined document — schema-version
+# round-trip, a logged shadow prediction on every record, ≥90% of
+# records joined to their decision half and to measured-duty samples →
+# docs/artifacts/placement_dataset.json (docs/observability.md §Outcome
+# attribution explains the columns).  SMOKE=1 runs the seconds-long twin
+# (tier-1 safe; bench-smoke diffs the artifact schema).
+dataset:
+ifdef SMOKE
+	JAX_PLATFORMS=cpu $(PY) hack/dataset.py --smoke
+else
+	JAX_PLATFORMS=cpu $(PY) hack/dataset.py
 endif
 
 # every benchmark's smoke mode, artifacts redirected to scratch, each

@@ -57,6 +57,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from tests.golden_scenarios import seed_fake_node_group  # noqa: E402
 from vtpu.k8s import FakeClient, new_pod  # noqa: E402
+from vtpu.obs import outcomes as outcomes_mod  # noqa: E402
 from vtpu.monitor.feedback import ContentionArbiter  # noqa: E402
 from vtpu.monitor.pathmonitor import REGION_FILENAME, PathMonitor  # noqa: E402
 from vtpu.monitor.shared_region import RegionFile, effective_core_limit  # noqa: E402
@@ -118,6 +119,8 @@ def run_arm(
     sched.register_from_node_annotations()
     regions_root = tempfile.mkdtemp(prefix="vtpu-goodput-")
     t0 = time.time()  # sim ts base: tick k writes back ts=t0+k (fresh)
+    placements = [0]  # successful filter results (the outcomes gate's
+    #                   denominator: every one should get a join record)
 
     # -- guaranteed tier: one 60-core tenant per chip, staggered bursts
     usage = sched.inspect_usage()
@@ -140,6 +143,7 @@ def run_arm(
             client.create_pod(p)
             res = sched.filter(p, [node])
             assert res.node == node, (node, res.error, res.failed)
+            placements[0] += 1
             booked = sched.usage_cache.bookings_snapshot()[uid][1]
             chip = booked[0][0].uuid
             pid += 1
@@ -250,6 +254,7 @@ def run_arm(
             res = sched.filter(pod, names)
             if not res.node:
                 break  # nothing admits this tick; retry next
+            placements[0] += 1
             queue.pop(0)
             j.node = res.node
             if be_qos:
@@ -373,10 +378,79 @@ def run_arm(
         ) if oversub_samples else 1.0,
         "squeeze_tenant_ticks": squeeze_ticks,
         "chips": chips_total,
+        "placements": placements[0],
         "audit_summary": audit["summary"],
         "residual_overlay_bookings": len(
             sched.usage_cache.overlay_snapshot()
         ),
+    }
+
+
+def _outcomes_probe(cfg: dict) -> dict:
+    """Paired-arm gate for the outcome-attribution plane
+    (vtpu/obs/outcomes.py): the utilization_loop arm runs once with the
+    plane force-disabled (must produce zero records — the no-op
+    contract, and its wall time is the overhead baseline) and once
+    enabled (≥95% of placements must close the loop: an OutcomeRecord
+    with joined measured-duty samples and a logged shadow prediction).
+    The block is always present in the artifact so the bench-smoke
+    schema probe stays stable across modes."""
+    outcomes_mod.configure(enabled=False)
+    t = time.perf_counter()
+    disabled_arm = run_arm("utilization_loop", **cfg)
+    disabled_s = time.perf_counter() - t
+    disabled_records = len(outcomes_mod.snapshot())
+
+    # cap above any placement count this bench produces: ring eviction
+    # would undercount coverage (the offline dataset tolerates eviction;
+    # the in-process gate should not have to)
+    outcomes_mod.configure(enabled=True, cap=8192)
+    t = time.perf_counter()
+    enabled_arm = run_arm("utilization_loop", **cfg)
+    enabled_s = time.perf_counter() - t
+    j = outcomes_mod.joiner()
+    assert j is not None
+    docs = j.snapshot()
+    # guaranteed tenants outlive the arm — mirror their open records so
+    # `make dataset` (which runs this bench with VTPU_OUTCOME_JSONL set)
+    # sees every placement, then tear the plane back down
+    j.flush()
+    outcomes_mod.configure(enabled=False)
+
+    n = len(docs)
+    placed = enabled_arm["placements"]
+    with_duty = sum(
+        1 for d in docs if (d.get("duty") or {}).get("samples"))
+    shadow_logged = sum(
+        1 for d in docs
+        if (d.get("shadow") or {}).get("prediction") is not None
+        or (d.get("shadow") or {}).get("error") is not None)
+    lags = sorted(
+        d["join"]["first_lag_s"] for d in docs
+        if (d.get("join") or {}).get("first_lag_s") is not None)
+    dispositions = {
+        k: 0 for k in outcomes_mod.TERMINAL_DISPOSITIONS
+        + ("dropped", "active")
+    }
+    for d in docs:
+        disp = d.get("disposition") or "active"
+        dispositions[disp] = dispositions.get(disp, 0) + 1
+    return {
+        "records": n,
+        "placements": placed,
+        "coverage_per_placement": round(n / placed, 4) if placed else None,
+        "duty_joined_ratio": round(with_duty / n, 4) if n else None,
+        "shadow_logged_ratio": round(shadow_logged / n, 4) if n else None,
+        "join_lag_mean_s": round(statistics.fmean(lags), 6) if lags else None,
+        "join_lag_max_s": round(lags[-1], 6) if lags else None,
+        "dispositions": dispositions,
+        "disabled": {
+            "records": disabled_records,
+            "placements": disabled_arm["placements"],
+            "elapsed_s": round(disabled_s, 3),
+        },
+        "enabled_elapsed_s": round(enabled_s, 3),
+        "overhead_ratio": round(enabled_s / max(1e-9, disabled_s), 4),
     }
 
 
@@ -397,6 +471,7 @@ def run(smoke: bool = False, seed: int = 7) -> dict:
         arm: run_arm(arm, **cfg)  # type: ignore[arg-type]
         for arm in ("guaranteed_solo", "static_partition", "utilization_loop")
     }
+    outcomes = _outcomes_probe(cfg)
     solo = arms["guaranteed_solo"]
     static = arms["static_partition"]
     loop = arms["utilization_loop"]
@@ -417,6 +492,7 @@ def run(smoke: bool = False, seed: int = 7) -> dict:
             be_work_chip_s=BE_WORK_CHIP_S,
         ),
         "arms": arms,
+        "outcomes": outcomes,
         "comparison": {
             "goodput_ratio_vs_static": round(ratio, 4),
             "guaranteed_duty_degradation_vs_solo": round(duty_degradation, 4),
@@ -427,6 +503,12 @@ def run(smoke: bool = False, seed: int = 7) -> dict:
     # with no leaked overlay entries (evicted/completed jobs released)
     assert loop["audit_summary"]["leaked_overlay_bookings"] == 0
     assert loop["audit_summary"]["leaked_bookings"] == 0
+    # the outcome plane's deterministic contracts hold in every mode:
+    # disabled means zero records, enabled logs a shadow prediction on
+    # every record (the erroring-scorer path still counts as logged)
+    assert outcomes["disabled"]["records"] == 0, outcomes["disabled"]
+    assert outcomes["records"] > 0, outcomes
+    assert outcomes["shadow_logged_ratio"] == 1.0, outcomes
     if not smoke:
         # the SLOs the artifact exists to prove
         assert ratio >= 1.3, ratio
@@ -434,6 +516,13 @@ def run(smoke: bool = False, seed: int = 7) -> dict:
         assert 1.5 <= loop["oversubscription_ratio_mean"] <= 2.0, (
             loop["oversubscription_ratio_mean"],
         )
+        # ISSUE 20 acceptance: ≥95% of bound placements carry an outcome
+        # record with at least one joined measured-duty sample, and the
+        # plane adds no measurable filter/bind overhead (paired arms —
+        # wall-clock bound is deliberately loose, CI boxes are noisy)
+        assert outcomes["coverage_per_placement"] >= 0.95, outcomes
+        assert outcomes["duty_joined_ratio"] >= 0.95, outcomes
+        assert outcomes["overhead_ratio"] < 1.5, outcomes
     return report
 
 

@@ -68,6 +68,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 from tests.golden_scenarios import seed_fake_node_group       # noqa: E402
 from vtpu.k8s import FakeClient, new_pod                      # noqa: E402
+from vtpu.obs import outcomes as outcomes_mod                 # noqa: E402
 from vtpu.monitor.feedback import ContentionArbiter           # noqa: E402
 from vtpu.monitor.pathmonitor import (                        # noqa: E402
     REGION_FILENAME,
@@ -499,6 +500,11 @@ def admit_gang(sched, client, names, cfg):
         "bound": len(bound),
         "bind_success": round(len(bound) / size, 4),
         "partial_gangs": 0 if len(bound) in (0, size) else 1,
+        # filters that returned a node (gang members deferred until the
+        # gang completes place through the committing filter) — the
+        # outcome plane opens one record per placed filter, so this is
+        # the coverage denominator, not `bound`
+        "placed_filters": sum(1 for r in results if r.node),
         "roles": by_role,
     }
     return members, census
@@ -521,6 +527,7 @@ def run_arm(arm: str, cfg: dict) -> dict:
     # -- the heterogeneous serving gang, admitted for real -------------
     members, census = admit_gang(sched, client, names, cfg)
     assert census["bind_success"] == 1.0, census
+    placements = [census["placed_filters"]]
     mesh_boot = {}
     replicas = {}
     prefills = {}
@@ -678,6 +685,7 @@ def run_arm(arm: str, cfg: dict) -> dict:
                 res = sched.filter(pod, list(names))
                 if not res.node:
                     continue
+                placements[0] += 1
                 chips = [
                     cd.uuid
                     for ctr in sched.usage_cache.overlay_snapshot()[uid][1]
@@ -861,6 +869,7 @@ def run_arm(arm: str, cfg: dict) -> dict:
         "oversubscription_ratio_mean": round(
             statistics.fmean(oversub), 4) if oversub else 1.0,
         "gang": census,
+        "placements": placements[0],
         "mesh_boot": mesh_boot,
         "audit_summary": audit["summary"],
         "residual_overlay_bookings": len(
@@ -870,9 +879,34 @@ def run_arm(arm: str, cfg: dict) -> dict:
 
 def run(smoke: bool = False) -> dict:
     cfg = dict(SMOKE_CONFIG if smoke else CONFIG)
-    arms = {
-        arm: run_arm(arm, cfg)
-        for arm in ("static_partition", "colo_no_migrate", "colo_full")
+    # the outcome-attribution plane rides the flagship arm only (the
+    # goodput bench owns the paired disabled/enabled overhead probe);
+    # every placed filter in colo_full must close the decision→outcome
+    # loop with joined duty samples and a logged shadow prediction
+    arms = {}
+    for arm in ("static_partition", "colo_no_migrate", "colo_full"):
+        if arm == "colo_full":
+            outcomes_mod.configure(enabled=True, cap=8192)
+        arms[arm] = run_arm(arm, cfg)
+    j = outcomes_mod.joiner()
+    assert j is not None
+    docs = j.snapshot()
+    j.flush()   # gang members stay open — mirror them for `make dataset`
+    outcomes_mod.configure(enabled=False)
+    n = len(docs)
+    placed = arms["colo_full"]["placements"]
+    outcomes = {
+        "records": n,
+        "placements": placed,
+        "coverage_per_placement": round(n / placed, 4) if placed else None,
+        "duty_joined_ratio": round(sum(
+            1 for d in docs if (d.get("duty") or {}).get("samples")
+        ) / n, 4) if n else None,
+        "shadow_logged_ratio": round(sum(
+            1 for d in docs
+            if (d.get("shadow") or {}).get("prediction") is not None
+            or (d.get("shadow") or {}).get("error") is not None
+        ) / n, 4) if n else None,
     }
     static = arms["static_partition"]
     nomig = arms["colo_no_migrate"]
@@ -891,6 +925,7 @@ def run(smoke: bool = False) -> dict:
                        g_burst_demand=G_BURST_DEMAND,
                        be_demand=BE_DEMAND),
         "arms": arms,
+        "outcomes": outcomes,
         "comparison": {
             "goodput_ratio_colo_full_vs_static": round(ratio, 4),
             "guaranteed_duty_degradation_vs_solo": round(duty_deg, 4),
@@ -913,6 +948,8 @@ def run(smoke: bool = False) -> dict:
                    if isinstance(v, int)), (arm, rep["audit_summary"])
         assert rep["residual_overlay_bookings"] == 0, arm
     assert full["tokens_lost_to_eviction"] == 0, full
+    assert outcomes["records"] > 0, outcomes
+    assert outcomes["shadow_logged_ratio"] == 1.0, outcomes
     if not smoke:
         # the SLOs the artifact exists to prove
         assert ratio >= 1.5, ratio
@@ -920,6 +957,10 @@ def run(smoke: bool = False) -> dict:
         assert nomig["tokens_lost_to_eviction"] > 0, nomig
         assert full["evictions_migrated"] > 0, full
         assert full["besteffort_tokens_served"] > 0, full
+        # ISSUE 20: outcome records cover the bound placements with
+        # joined measured-duty samples
+        assert outcomes["coverage_per_placement"] >= 0.95, outcomes
+        assert outcomes["duty_joined_ratio"] >= 0.95, outcomes
     return report
 
 

@@ -152,13 +152,18 @@ def main(argv=None) -> int:
         sched.decisions = DecisionLog(jsonl_path=args.decision_jsonl)
     if args.flight_sample > 0:
         # flight recorder + SLO burn-rate engine + incident triggers, one
-        # bootstrap (vtpu/obs/flight.start_plane); the decision log rides
-        # along as a bundle source so incidents replay via --trace
+        # bootstrap (vtpu/obs/flight.start_plane); the decision log and
+        # the outcome ledger ride along as bundle sources so incidents
+        # replay via --trace and carry outcomes.jsonl
         from vtpu.obs import flight as obs_flight
+        from vtpu.obs import outcomes as obs_outcomes
 
         obs_flight.start_plane(
             "scheduler",
-            sources={"decisions": sched.decisions.snapshot},
+            sources={
+                "decisions": sched.decisions.snapshot,
+                "outcomes": obs_outcomes.snapshot,
+            },
             interval_s=args.flight_sample,
         )
         logging.info("flight plane on: sampling every %ss",

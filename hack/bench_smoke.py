@@ -97,6 +97,11 @@ BENCHES = {
         [sys.executable, "benchmarks/serving_colo.py", "--smoke"],
         {"JAX_PLATFORMS": "cpu"},
     ),
+    "dataset": (
+        "placement_dataset.json",
+        [sys.executable, "hack/dataset.py", "--smoke"],
+        {"JAX_PLATFORMS": "cpu"},
+    ),
 }
 
 # paths (tuples of dict keys from the artifact root) whose KEY SETS are
@@ -114,6 +119,9 @@ VARIABLE_PATHS = {
     # colo smoke runs a smaller gang: member/role key sets shrink
     ("arms", "*", "mesh_boot"),
     ("arms", "*", "gang", "roles"),
+    # the dataset example's decision half carries the measured-blend
+    # utilization snapshot keyed by node name (run-shape dependent)
+    ("dataset", "examples", "[]", "decision", "utilization"),
 }
 
 

@@ -1,0 +1,163 @@
+#!/usr/bin/env python3
+"""`make dataset`: generate and verify the placement-learning dataset.
+
+Drives ONE utilization_loop arm of the goodput bench
+(benchmarks/scheduler_goodput.py) in-process with all three JSONL
+mirrors pointed at a scratch dir — decisions (``VTPU_DECISION_JSONL``),
+events (``VTPU_EVENT_JSONL``) and outcome records
+(``VTPU_OUTCOME_JSONL``) — then joins them offline through
+:mod:`vtpu.obs.dataset` into the versioned decision→outcome dataset
+(ROADMAP item 2's training input) and asserts its contracts:
+
+- the joined document round-trips its schema version (plain JSON end
+  to end);
+- every outcome record logs a shadow prediction;
+- ≥90% of records join their decision half and ≥90% carry measured-duty
+  samples (the in-process ≥95% acceptance gate lives in the bench
+  itself, where the join is exact; the offline join additionally
+  tolerates mirror rotation and torn tails, hence the looser bound).
+
+A single arm is driven deliberately: each Scheduler restarts the
+decision mirror's seq counter, so multi-arm runs interleave generations
+in one file and the dedupe-on-seq join would mix arms.  One arm → one
+generation → exact joins.
+
+Artifact: docs/artifacts/placement_dataset.json (full mode) — the
+bench-smoke aggregator diffs its structure on every `make bench-smoke`.
+SMOKE=1 / --smoke runs the seconds-long twin (tier-1 rides it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+ARTIFACT = os.path.join(REPO, "docs", "artifacts",
+                        "placement_dataset.json")
+
+# the goodput bench's arm configs (benchmarks/scheduler_goodput.py run())
+FULL_CFG = dict(nodes=6, duration_s=240, evict_after_s=10.0,
+                idle_window_s=10.0, arrival_every_s=2.0,
+                be_cap_per_node=3, hog_burst_s=20.0, seed=7)
+SMOKE_CFG = dict(nodes=2, duration_s=40, evict_after_s=10.0,
+                 idle_window_s=5.0, arrival_every_s=2.0,
+                 be_cap_per_node=3, hog_burst_s=12.0, seed=7)
+
+
+def _load_goodput():
+    spec = importlib.util.spec_from_file_location(
+        "scheduler_goodput",
+        os.path.join(REPO, "benchmarks", "scheduler_goodput.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def generate(scratch: str, smoke: bool) -> dict:
+    """Run the arm with the mirrors live, return the joined dataset."""
+    paths = {
+        "decisions": os.path.join(scratch, "decisions.jsonl"),
+        "events": os.path.join(scratch, "events.jsonl"),
+        "outcomes": os.path.join(scratch, "outcomes.jsonl"),
+    }
+    # the mirrors construct lazily from the env at first use — set it
+    # BEFORE the bench module (and with it the journal) spins up
+    os.environ["VTPU_DECISION_JSONL"] = paths["decisions"]
+    os.environ["VTPU_EVENT_JSONL"] = paths["events"]
+    os.environ["VTPU_OUTCOME_JSONL"] = paths["outcomes"]
+
+    from vtpu.obs import dataset as ds
+    from vtpu.obs import events as events_mod
+    from vtpu.obs import outcomes as outcomes_mod
+
+    # the journal is a process singleton: (re)configure it so its mirror
+    # lands in the scratch dir even if something touched it earlier
+    events_mod.configure(jsonl_path=paths["events"])
+    goodput = _load_goodput()
+    outcomes_mod.configure(enabled=True, cap=8192)
+    cfg = dict(SMOKE_CFG if smoke else FULL_CFG)
+    arm = goodput.run_arm("utilization_loop", **cfg)
+    j = outcomes_mod.joiner()
+    assert j is not None
+    j.flush()   # guaranteed tenants stay open — mirror their records
+    outcomes_mod.configure(enabled=False)
+
+    doc = ds.round_trip(ds.join_files(
+        paths["decisions"], paths["events"], paths["outcomes"]))
+    cov = doc["coverage"]
+    counts = doc["counts"]
+    assert counts["outcomes"] > 0, counts
+    assert counts["examples"] == counts["outcomes"], counts
+    assert cov["shadow_logged"] == 1.0, cov
+    assert cov["decision_joined"] is not None \
+        and cov["decision_joined"] >= 0.90, cov
+    assert cov["duty_joined"] is not None \
+        and cov["duty_joined"] >= 0.90, cov
+    assert cov["outcome_per_placement"] is not None \
+        and cov["outcome_per_placement"] >= 0.90, cov
+    return {"dataset": doc, "arm_placements": arm["placements"]}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    default=bool(os.environ.get("SMOKE")))
+    ap.add_argument("--out", default=None,
+                    help="write the dataset artifact here (default: the "
+                         "committed docs/artifacts twin, full runs only)")
+    ap.add_argument("--dataset-out", default=None,
+                    help="also write the FULL joined dataset (every "
+                         "example) here — the artifact embeds only a "
+                         "bounded sample to stay committable")
+    args = ap.parse_args(argv)
+    scratch = tempfile.mkdtemp(prefix="vtpu-dataset-")
+    try:
+        res = generate(scratch, smoke=args.smoke)
+    finally:
+        shutil.rmtree(scratch, ignore_errors=True)
+    doc = res["dataset"]
+    if args.dataset_out:
+        os.makedirs(os.path.dirname(args.dataset_out) or ".",
+                    exist_ok=True)
+        with open(args.dataset_out, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote full dataset to {args.dataset_out}")
+    # the committed artifact embeds a bounded example sample (the full
+    # run joins hundreds; the fixture exists for schema diffing, and the
+    # counts/coverage blocks carry the run-level evidence)
+    embedded = dict(doc, examples=doc["examples"][:8])
+    report = {
+        "bench": "placement_dataset",
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                      time.gmtime()),
+        "smoke": args.smoke,
+        "arm_placements": res["arm_placements"],
+        "examples_embedded": len(embedded["examples"]),
+        "dataset": embedded,
+    }
+    print(json.dumps({"counts": doc["counts"],
+                      "coverage": doc["coverage"]}, indent=2))
+    out = args.out if args.out else (None if args.smoke else ARTIFACT)
+    if out:
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,675 @@
+"""Outcome attribution plane (docs/observability.md §Outcome
+attribution): the decision→outcome joiner's lifecycle (open → duty
+joins → journal events → terminal disposition), the shadow-scoring
+hook's record-never-act contract, the JSONL mirror's open-stamp +
+close-rewrite dedupe, the offline dataset join's rotation/torn-tail
+paranoia, the disabled-plane no-op, the /outcomes wire surface on both
+the extender and the monitor debug listener, and the rotating JSONL
+sink under concurrent writers racing rotation."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tests.golden_scenarios import seed_fake_node_group
+from vtpu.k8s import FakeClient, new_pod
+from vtpu.obs import dataset as ds
+from vtpu.obs import events as ev
+from vtpu.obs import outcomes
+from vtpu.obs.events import EventType
+from vtpu.obs.jsonl import RotatingJsonlSink
+from vtpu.obs.registry import registry
+from vtpu.scheduler import Scheduler, SchedulerConfig
+from vtpu.scheduler.routes import serve
+from vtpu.utils.types import QosClass, annotations as A, resources as R
+
+
+@pytest.fixture(autouse=True)
+def _plane_teardown():
+    """Every test owns the process plane; leave it disabled so the rest
+    of the suite keeps its zero-overhead no-op hooks."""
+    yield
+    outcomes.configure(enabled=False)
+
+
+def _ticker(start=1000.0, step=1.0):
+    """Deterministic wallclock: 1000, 1001, 1002, …"""
+    t = [start - step]
+
+    def clock():
+        t[0] += step
+        return t[0]
+
+    return clock
+
+
+def _decision(seq=1, uid="u1", pod="p1", node="n1", qos="best-effort",
+              **kw):
+    d = {
+        "seq": seq, "pod_uid": uid, "pod": pod, "node": node,
+        "namespace": "default", "path": "filter", "qos": qos,
+        "requests": [[{"chips": 1, "cores": 50, "nums": 1}]],
+    }
+    d.update(kw)
+    return d
+
+
+def _util(duties, pods=None, ts=0.0):
+    return {"v": 1, "ts": ts,
+            "devices": {u: {"duty": d, "hbm_peak": 0}
+                        for u, d in duties.items()},
+            "pods": pods or {}}
+
+
+# -- joiner lifecycle -----------------------------------------------------
+
+
+def test_decision_opens_record_with_shadow_and_baseline():
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    snap = {"n1": _util({"c1": 0.2, "c2": 0.4})}
+    doc = j.observe_decision(_decision(), chips=["c1", "c2"],
+                             snapshot=snap)
+    assert doc["disposition"] == "active"
+    assert doc["decision_seq"] == 1
+    assert doc["chips"] == ["c1", "c2"]
+    # co-tenant baseline = mean measured duty on the rectangle
+    assert doc["cotenant"]["baseline"] == pytest.approx(0.3)
+    # baseline predictor: share 0.5 × (1 − 0.5·load 0.3) = 0.425
+    assert doc["shadow"]["scorer"] == "baseline"
+    assert doc["shadow"]["prediction"]["achieved_duty_ratio"] == \
+        pytest.approx(0.425)
+    assert doc["shadow"]["error"] is None
+
+
+def test_unplaced_or_anonymous_decision_is_ignored():
+    j = outcomes.configure(enabled=True)
+    assert j.observe_decision(_decision(node="")) is None
+    assert j.observe_decision(_decision(uid="")) is None
+    assert j.stats()["open"] == 0
+
+
+def test_duty_joins_fold_into_open_record():
+    clk = _ticker()
+    j = outcomes.configure(enabled=True, wallclock=clk)
+    j.observe_decision(_decision(), chips=["c1", "c2"])
+    j.observe_utilization("n1", _util({"c1": 0.5, "c2": 0.7},
+                                      pods={"u1": {"hbm_peak": 123}}))
+    j.observe_utilization("n1", _util({"c1": 0.3, "c2": 0.3}))
+    j.observe_utilization("other-node", _util({"c1": 0.9}))  # not ours
+    (doc,) = j.query(pod="u1")
+    assert doc["duty"]["samples"] == 2
+    assert doc["duty"]["mean"] == pytest.approx((0.6 + 0.3) / 2)
+    assert doc["duty"]["max"] == pytest.approx(0.6)
+    assert doc["duty"]["last"] == pytest.approx(0.3)
+    assert doc["hbm_peak"] == 123
+    # ticker advances 1 s per call: decision at t, join at t+1
+    assert doc["join"]["first_lag_s"] == pytest.approx(1.0)
+
+
+def test_event_close_dispositions():
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    for i, (etype, want) in enumerate([
+        ("PodEvicted", "evicted"),
+        ("EvictMigrated", "migrated"),
+        ("BindFailed", "bind_failed"),
+    ]):
+        uid = f"u-{want}"
+        j.observe_decision(_decision(seq=10 + i, uid=uid, pod=uid))
+        j.observe_event({"type": etype, "pod": uid, "seq": 100 + i,
+                         "ts": 1.0})
+        (doc,) = j.query(pod=uid)
+        assert doc["disposition"] == want
+        assert doc["closed_ts"] is not None
+        assert doc["events"]["counts"] == {etype: 1}
+    assert j.stats() == {"open": 0, "closed": 3, "dropped": 0}
+    ctr = registry("obs").get("vtpu_outcome_records_total")
+    assert ctr.value(disposition="evicted") >= 1
+
+
+def test_bound_and_throttle_events_annotate_without_closing():
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    j.observe_decision(_decision())
+    j.observe_event({"type": "PodBound", "pod": "u1", "seq": 5,
+                     "ts": 42.5})
+    j.observe_event({"type": "ThrottleChanged", "pod": "u1", "seq": 6,
+                     "ts": 43.0, "now": "half", "was": "full"})
+    (doc,) = j.query(pod="u1")
+    assert doc["disposition"] == "active"
+    assert doc["bound_ts"] == 42.5
+    assert doc["events"]["throttle_last"] == "half"
+    assert doc["events"]["first_seq"] == 5
+    assert doc["events"]["last_seq"] == 6
+
+
+def test_drift_disposition_survives_removal():
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    j.observe_decision(_decision())
+    j.observe_event({"type": "DriftDetected", "pod": "u1", "seq": 1,
+                     "ts": 1.0})
+    (doc,) = j.query(pod="u1")
+    assert doc["disposition"] == "drifted"
+    assert doc["closed_ts"] is None  # the pod keeps running
+    j.on_pod_removed("u1")
+    (doc,) = j.query(pod="u1")
+    assert doc["disposition"] == "drifted"
+    assert doc["closed_ts"] is not None
+
+
+def test_plain_removal_closes_as_completed():
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    j.observe_decision(_decision())
+    j.on_pod_removed("u1")
+    (doc,) = j.query(pod="u1")
+    assert doc["disposition"] == "completed"
+    j.on_pod_removed("u1")  # idempotent: already closed
+    assert j.stats() == {"open": 0, "closed": 1, "dropped": 0}
+
+
+def test_redecision_supersedes_prior_open_record():
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    j.observe_decision(_decision(seq=1, node="n1"))
+    j.observe_decision(_decision(seq=2, node="n2"))
+    docs = j.query(pod="u1")
+    assert [d["disposition"] for d in docs] == ["superseded", "active"]
+    assert [d["decision_seq"] for d in docs] == [1, 2]
+    # duty joins follow the pod to its new node
+    j.observe_utilization("n1", _util({"c1": 0.9}))
+    j.observe_utilization("n2", _util({"c1": 0.4}))
+    live = j.query(pod="u1")[-1]
+    assert live["duty"]["samples"] == 0  # no chips booked in this test
+    assert live["node"] == "n2"
+
+
+def test_on_pod_changed_moves_node_and_rectangle():
+    class _CD:
+        def __init__(self, uuid):
+            self.uuid = uuid
+
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    j.observe_decision(_decision(), chips=["c1"])
+    j.on_pod_changed("u1", "n2", [[_CD("c9")]])
+    j.observe_utilization("n1", _util({"c1": 0.9}))  # stale node: no join
+    j.observe_utilization("n2", _util({"c9": 0.6}))
+    (doc,) = j.query(pod="u1")
+    assert doc["node"] == "n2"
+    assert doc["chips"] == ["c9"]
+    assert doc["duty"]["samples"] == 1
+    assert doc["duty"]["last"] == pytest.approx(0.6)
+
+
+def test_open_overflow_drops_oldest():
+    j = outcomes.configure(enabled=True, cap=2, wallclock=_ticker())
+    for i in range(2 * 4 + 3):
+        j.observe_decision(_decision(seq=i + 1, uid=f"u{i}",
+                                     pod=f"p{i}"))
+    st = j.stats()
+    assert st["dropped"] == 3
+    assert st["open"] == 8  # 4 × cap
+    assert any(d["disposition"] == "dropped" for d in j.snapshot())
+
+
+def test_request_attribution_joins_on_tenant():
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    j.observe_decision(_decision())
+    # tenant == pod name resolves through the name index to the uid
+    j.observe_request({"tenant": "p1", "ok": True, "ttft_s": 0.2,
+                       "itl_mean_s": 0.05, "itl_n": 4, "tokens_out": 5})
+    j.observe_request({"tenant": "u1", "ok": False, "ttft_s": 0.4,
+                       "itl_mean_s": 0.1, "itl_n": 4, "tokens_out": 3})
+    j.observe_request({"tenant": "someone-else", "ok": True})
+    (doc,) = j.query(pod="u1")
+    attr = doc["requests_attr"]
+    assert attr["count"] == 2
+    assert attr["errors"] == 1
+    assert attr["ttft_mean_s"] == pytest.approx(0.3)
+    assert attr["itl_mean_s"] == pytest.approx(0.075)
+    assert attr["tokens_out"] == 8
+
+
+# -- shadow scoring -------------------------------------------------------
+
+
+def test_shadow_error_is_recorded_never_raised():
+    def bomb(decision, snapshot):
+        raise RuntimeError("model exploded")
+
+    ctr = registry("obs").get("vtpu_outcome_shadow_errors_total")
+    before = ctr.value()
+    j = outcomes.configure(enabled=True, shadow=bomb,
+                           shadow_name="bomb", wallclock=_ticker())
+    doc = j.observe_decision(_decision())
+    assert doc is not None  # scheduling path unaffected
+    assert doc["shadow"]["scorer"] == "bomb"
+    assert doc["shadow"]["prediction"] is None
+    assert "RuntimeError: model exploded" in doc["shadow"]["error"]
+    assert ctr.value() == before + 1
+
+
+def test_set_shadow_scorer_swaps_and_restores():
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    outcomes.set_shadow_scorer(lambda d, s: {"x": 1.0}, name="learned-v2")
+    doc = j.observe_decision(_decision(seq=1, uid="ua", pod="pa"))
+    assert doc["shadow"] == {"scorer": "learned-v2",
+                             "prediction": {"x": 1.0}, "error": None}
+    outcomes.set_shadow_scorer(None)
+    doc = j.observe_decision(_decision(seq=2, uid="ub", pod="pb"))
+    assert doc["shadow"]["scorer"] == "baseline"
+    assert "achieved_duty_ratio" in doc["shadow"]["prediction"]
+
+
+def test_default_shadow_scorer_bounds():
+    # empty decision/snapshot: share defaults to 1, load to 0
+    assert outcomes.default_shadow_scorer({}, {}) == \
+        {"achieved_duty_ratio": 1.0}
+    dec = _decision(requests=[[{"cores": 200, "nums": 1}]])
+    snap = {"n1": _util({"c1": 1.0, "c2": 1.0})}
+    pred = outcomes.default_shadow_scorer(dec, snap)
+    assert pred["achieved_duty_ratio"] == pytest.approx(0.5)
+
+
+# -- gauge hygiene --------------------------------------------------------
+
+
+def test_achieved_gauge_series_pruned_on_close():
+    g = registry("obs").get("vtpu_outcome_achieved_duty_ratio")
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    j.observe_decision(_decision(uid="u-gauge", pod="p-gauge"),
+                       chips=["c1"])
+    j.observe_utilization("n1", _util({"c1": 0.42}))
+    assert g.value(pod="u-gauge") == pytest.approx(0.42)
+    j.on_pod_removed("u-gauge")
+    labelsets = [labels for labels, _ in g.samples()]
+    assert {"pod": "u-gauge"} not in labelsets
+
+
+# -- the JSONL mirror -----------------------------------------------------
+
+
+def test_mirror_writes_open_stamp_and_close_rewrite(tmp_path):
+    path = str(tmp_path / "outcomes.jsonl")
+    j = outcomes.configure(enabled=True, jsonl_path=path,
+                           wallclock=_ticker())
+    j.observe_decision(_decision())
+    j.observe_event({"type": "PodEvicted", "pod": "u1", "seq": 9,
+                     "ts": 2.0})
+    j.close()
+    lines = [json.loads(ln) for ln in
+             open(path).read().splitlines()]
+    assert [ln["disposition"] for ln in lines] == ["active", "evicted"]
+    assert lines[0]["seq"] == lines[1]["seq"]
+    # the offline reader dedupes on seq keeping the close rewrite
+    recs, skipped = ds.read_jsonl_rotated(path)
+    assert skipped == 0
+    assert [r["disposition"] for r in recs] == ["evicted"]
+
+
+def test_flush_mirrors_still_open_records(tmp_path):
+    path = str(tmp_path / "outcomes.jsonl")
+    j = outcomes.configure(enabled=True, jsonl_path=path,
+                           wallclock=_ticker())
+    j.observe_decision(_decision())
+    j.flush()
+    j.close()
+    recs, _ = ds.read_jsonl_rotated(path)
+    assert [r["disposition"] for r in recs] == ["active"]
+
+
+# -- disabled plane -------------------------------------------------------
+
+
+def test_disabled_plane_is_a_noop(monkeypatch):
+    monkeypatch.delenv(outcomes.ENV_ENABLED, raising=False)
+    monkeypatch.delenv(outcomes.ENV_JSONL, raising=False)
+    outcomes.configure(enabled=False)
+    assert outcomes.joiner() is None
+    assert outcomes.observe_decision(_decision()) is None
+    outcomes.observe_utilization("n1", _util({"c1": 0.5}))  # no throw
+    assert outcomes.snapshot() == []
+    body = json.loads(outcomes.outcomes_body({}))
+    assert body == {"outcomes": [], "count": 0, "enabled": False}
+    assert outcomes.outcomes_body({"format": "jsonl"}) == b""
+
+
+def test_env_resolution_enables_plane(monkeypatch, tmp_path):
+    # reset the resolved global, then let joiner() resolve from the env
+    outcomes.configure(enabled=False)
+    monkeypatch.setenv(outcomes.ENV_JSONL,
+                       str(tmp_path / "outcomes.jsonl"))
+    outcomes._resolved = False
+    outcomes._joiner = None
+    try:
+        j = outcomes.joiner()
+        assert j is not None
+        assert j.jsonl_path == str(tmp_path / "outcomes.jsonl")
+    finally:
+        outcomes.configure(enabled=False)
+
+
+# -- query grammar + wire surface -----------------------------------------
+
+
+def test_outcomes_body_query_grammar():
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    j.observe_decision(_decision(seq=1, uid="ua", pod="pa"))   # t=1000
+    j.observe_decision(_decision(seq=2, uid="ub", pod="pb"))   # t=1001
+    body = json.loads(outcomes.outcomes_body({}))
+    assert body["enabled"] is True
+    assert body["count"] == 2
+    assert body["open"] == 2
+    body = json.loads(outcomes.outcomes_body({"pod": "pa"}))
+    assert [d["pod_uid"] for d in body["outcomes"]] == ["ua"]
+    body = json.loads(outcomes.outcomes_body({"since": "1001"}))
+    assert [d["pod_uid"] for d in body["outcomes"]] == ["ub"]
+    body = json.loads(outcomes.outcomes_body({"n": "1"}))
+    assert [d["pod_uid"] for d in body["outcomes"]] == ["ub"]
+    # junk params fall back, never raise
+    body = json.loads(outcomes.outcomes_body({"n": "junk",
+                                              "since": "junk"}))
+    assert body["count"] == 2
+    nd = outcomes.outcomes_body({"format": "jsonl"}).decode()
+    rows = [json.loads(ln) for ln in nd.splitlines()]
+    assert [r["pod_uid"] for r in rows] == ["ua", "ub"]
+
+
+def _be_pod(name, chips=1, mem_pct=25, cores=25):
+    return new_pod(
+        name, uid=f"uid-{name}", annotations={A.QOS: QosClass.BEST_EFFORT},
+        containers=[{"name": "m", "resources": {"limits": {
+            R.chip: chips, R.memory_percentage: mem_pct, R.cores: cores,
+        }}}],
+    )
+
+
+def _util_payload(uuids, duty, ts):
+    return {"v": 1, "ts": ts,
+            "devices": {u: {"duty": duty, "hbm_peak": 0} for u in uuids},
+            "pods": {}}
+
+
+def _sched(nodes=1):
+    client = FakeClient()
+    names = seed_fake_node_group(client, nodes)
+    s = Scheduler(client, SchedulerConfig(http_bind="127.0.0.1:0"))
+    s.register_from_node_annotations()
+    return client, s, names
+
+
+def _mark_idle(s, node, now, duty=0.05, window=40.0):
+    uuids = [d.uuid for d in s.inspect_usage()[node].devices]
+    s.usage_cache.note_node_utilization(
+        node, _util_payload(uuids, duty, now - window))
+    s.usage_cache.note_node_utilization(
+        node, _util_payload(uuids, duty, now))
+
+
+def test_scheduler_filter_opens_record_and_writeback_joins():
+    outcomes.configure(enabled=True)
+    client, s, names = _sched(nodes=1)
+    now = time.time()
+    _mark_idle(s, names[0], now=now)
+    be = _be_pod("be-outcome")
+    client.create_pod(be)
+    assert s.filter(be, names).node == names[0]
+    (doc,) = outcomes.joiner().query(pod="uid-be-outcome")
+    assert doc["disposition"] == "active"
+    assert doc["qos"] == "best-effort"
+    assert doc["node"] == names[0]
+    assert doc["chips"]  # the booked rectangle came from the cache
+    assert doc["shadow"]["prediction"] is not None
+    # the next utilization write-back joins achieved duty
+    uuids = [d.uuid for d in s.inspect_usage()[names[0]].devices]
+    s.usage_cache.note_node_utilization(
+        names[0], _util_payload(uuids, 0.33, now + 1))
+    (doc,) = outcomes.joiner().query(pod="uid-be-outcome")
+    assert doc["duty"]["samples"] >= 1
+    assert doc["duty"]["last"] == pytest.approx(0.33)
+
+
+def test_eviction_reconcile_closes_record_as_evicted():
+    """PodEvicted must reach the joiner BEFORE the registry removal
+    (core.py emits, then rm_pod) — else every eviction would close as
+    'completed'."""
+    outcomes.configure(enabled=True)
+    client, s, names = _sched(nodes=1)
+    _mark_idle(s, names[0], now=time.time())
+    be = _be_pod("be-evd")
+    client.create_pod(be)
+    assert s.filter(be, names).node == names[0]
+    client.patch_pod_annotations(
+        "default", "be-evd",
+        {A.EVICT_REQUESTED: "besteffort_contention_1785738400"},
+    )
+    assert s.reconcile_evictions() == 1
+    (doc,) = outcomes.joiner().query(pod="uid-be-evd")
+    assert doc["disposition"] == "evicted"
+    assert doc["events"]["counts"].get("PodEvicted") == 1
+
+
+def test_outcomes_endpoint_through_extender():
+    outcomes.configure(enabled=True)
+    client, s, names = _sched(nodes=1)
+    _mark_idle(s, names[0], now=time.time())
+    be = _be_pod("be-wire")
+    client.create_pod(be)
+    assert s.filter(be, names).node == names[0]
+    srv, _ = serve(s)
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/outcomes?pod=uid-be-wire", timeout=10).read())
+        assert doc["enabled"] is True
+        assert doc["count"] == 1
+        assert doc["outcomes"][0]["pod"] == "be-wire"
+        nd = urllib.request.urlopen(
+            f"{base}/outcomes?format=jsonl", timeout=10).read().decode()
+        rows = [json.loads(ln) for ln in nd.splitlines()]
+        assert any(r["pod_uid"] == "uid-be-wire" for r in rows)
+    finally:
+        srv.shutdown()
+
+
+def test_outcomes_endpoint_on_monitor_debug_listener():
+    from vtpu.obs.http import serve_debug
+
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    j.observe_decision(_decision(uid="u-mon", pod="p-mon"))
+    srv, _ = serve_debug("127.0.0.1:0", registries=("obs",))
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        doc = json.loads(urllib.request.urlopen(
+            f"{base}/outcomes?pod=u-mon", timeout=10).read())
+        assert doc["count"] == 1
+        assert doc["outcomes"][0]["pod_uid"] == "u-mon"
+    finally:
+        srv.shutdown()
+
+
+def test_journal_listener_feeds_joiner_through_emit():
+    """The module-level events.emit trampoline reaches whatever joiner
+    is current — the wiring the scheduler/monitor rely on."""
+    j = outcomes.configure(enabled=True, wallclock=_ticker())
+    j.observe_decision(_decision(uid="u-tramp", pod="p-tramp"))
+    ev.emit(EventType.POD_BOUND, "scheduler", pod="u-tramp", node="n1")
+    (doc,) = j.query(pod="u-tramp")
+    assert doc["events"]["counts"].get("PodBound") == 1
+    assert doc["bound_ts"] is not None
+
+
+# -- the offline dataset join ---------------------------------------------
+
+
+def _write_jsonl(path, recs):
+    with open(path, "w") as fh:
+        for r in recs:
+            fh.write(json.dumps(r) + "\n")
+
+
+def test_dataset_join_rotation_torn_tail_and_ring_eviction(tmp_path):
+    dpath = str(tmp_path / "decisions.jsonl")
+    epath = str(tmp_path / "events.jsonl")
+    opath = str(tmp_path / "outcomes.jsonl")
+    # decisions: seq 1 in the rotated generation, seq 2 current; seq 3's
+    # line was lost to ring eviction before the mirror caught it
+    _write_jsonl(dpath + ".1", [
+        {"seq": 1, "ts": 10.0, "node": "n1", "pod_uid": "ua",
+         "path": "filter", "qos": "best-effort",
+         "verdicts": {"n1": "fits"}},
+    ])
+    _write_jsonl(dpath, [
+        {"seq": 2, "ts": 20.0, "node": "n1", "pod_uid": "ub",
+         "path": "filter", "qos": "guaranteed", "verdicts": {}},
+    ])
+    # events: one in-window, one after close (cut), one torn tail
+    _write_jsonl(epath, [
+        {"seq": 7, "ts": 11.0, "type": "PodBound", "pod": "ua"},
+        {"seq": 8, "ts": 99.0, "type": "RegionGC", "pod": "ua"},
+    ])
+    with open(epath, "a") as fh:
+        fh.write('{"seq": 9, "ts": 12.0, "type": "Torn')  # mid-crash
+    # outcomes: ua open stamp + close rewrite (dedupe keeps the close);
+    # uc joins decision_seq 3 which never made the mirror
+    _write_jsonl(opath, [
+        {"v": 1, "seq": 1, "pod_uid": "ua", "pod": "pa",
+         "decision_seq": 1, "opened_ts": 10.5, "closed_ts": None,
+         "disposition": "active",
+         "shadow": {"scorer": "baseline",
+                    "prediction": {"achieved_duty_ratio": 0.4},
+                    "error": None},
+         "duty": {"samples": 0}},
+        {"v": 1, "seq": 1, "pod_uid": "ua", "pod": "pa",
+         "decision_seq": 1, "opened_ts": 10.5, "closed_ts": 15.0,
+         "disposition": "completed",
+         "shadow": {"scorer": "baseline",
+                    "prediction": {"achieved_duty_ratio": 0.4},
+                    "error": None},
+         "duty": {"samples": 3, "mean": 0.5}},
+        {"v": 1, "seq": 2, "pod_uid": "uc", "pod": "pc",
+         "decision_seq": 3, "opened_ts": 30.0, "closed_ts": None,
+         "disposition": "active",
+         "shadow": {"scorer": "baseline", "prediction": None,
+                    "error": "RuntimeError: x"},
+         "duty": {"samples": 0}},
+    ])
+    doc = ds.round_trip(ds.join_files(dpath, epath, opath))
+    assert doc["counts"] == {
+        "decisions": 2, "placed_decisions": 2, "events": 2,
+        "outcomes": 2, "examples": 2, "skipped_lines": 1,
+    }
+    cov = doc["coverage"]
+    assert cov["decision_joined"] == pytest.approx(0.5)
+    assert cov["duty_joined"] == pytest.approx(0.5)
+    assert cov["shadow_logged"] == 1.0  # an error still counts as logged
+    ex_a, ex_c = doc["examples"]
+    # dedupe kept the close rewrite, not the open stamp
+    assert ex_a["outcome"]["disposition"] == "completed"
+    # the rotated generation's decision joined across the stitch
+    assert ex_a["decision"]["seq"] == 1
+    assert ex_a["decision"]["verdict_count"] == 1
+    # event window: in-window PodBound kept, post-close RegionGC cut
+    assert [e["type"] for e in ex_a["events"]] == ["PodBound"]
+    # ring-evicted decision: example survives with a None decision half
+    assert ex_c["decision"] is None
+
+
+def test_dataset_round_trip_rejects_version_loss():
+    doc = ds.build_dataset([], [], [])
+    assert ds.round_trip(doc)["v"] == ds.DATASET_VERSION
+    doc["v"] = 99
+    with pytest.raises(ValueError):
+        ds.round_trip(doc)
+
+
+def test_dataset_cli_writes_out_file(tmp_path):
+    dpath = tmp_path / "d.jsonl"
+    epath = tmp_path / "e.jsonl"
+    opath = tmp_path / "o.jsonl"
+    for p in (dpath, epath, opath):
+        p.write_text("")
+    out = tmp_path / "dataset.json"
+    rc = ds.main(["--decisions", str(dpath), "--events", str(epath),
+                  "--outcomes", str(opath), "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["v"] == ds.DATASET_VERSION
+    assert doc["counts"]["examples"] == 0
+
+
+def test_live_mirror_feeds_dataset_end_to_end(tmp_path):
+    """Joiner mirror → offline join: the `make dataset` pipeline in
+    miniature."""
+    opath = str(tmp_path / "outcomes.jsonl")
+    j = outcomes.configure(enabled=True, jsonl_path=opath,
+                           wallclock=_ticker())
+    j.observe_decision(_decision(), chips=["c1"])
+    j.observe_utilization("n1", _util({"c1": 0.5}))
+    j.on_pod_removed("u1")
+    j.close()
+    doc = ds.round_trip(ds.join_files(
+        str(tmp_path / "d.jsonl"), str(tmp_path / "e.jsonl"), opath))
+    assert doc["counts"]["outcomes"] == 1
+    assert doc["coverage"]["duty_joined"] == 1.0
+    assert doc["coverage"]["shadow_logged"] == 1.0
+    ex = doc["examples"][0]
+    assert ex["outcome"]["disposition"] == "completed"
+    assert ex["outcome"]["duty"]["samples"] == 1
+
+
+# -- RotatingJsonlSink under concurrency ----------------------------------
+
+
+def test_sink_concurrent_writers_racing_rotation(tmp_path):
+    """N threads hammer one sink sized to rotate every few records: both
+    generations together must hold only intact JSON lines (no
+    interleaving, no torn records — the sink serialises on its lock),
+    and nothing written is silently lost beyond the one rotated-out
+    generation."""
+    path = str(tmp_path / "race.jsonl")
+    sink = RotatingJsonlSink(path, max_bytes=512)
+    n_threads, n_each = 8, 200
+    errs = []
+
+    def hammer(tid):
+        try:
+            for i in range(n_each):
+                sink.write({"tid": tid, "i": i,
+                            "pad": "x" * 40})  # ~70 B/line → rotations
+        except Exception as e:  # noqa: BLE001 — the sink must not raise
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sink.close()
+    assert errs == []
+    assert not sink.dead
+    assert sink.rotations > 0
+    recs = []
+    for p in (path + ".1", path):
+        assert os.path.exists(p)
+        assert os.path.getsize(p) <= 512 + 128  # cap honoured ± one line
+        for line in open(p).read().splitlines():
+            recs.append(json.loads(line))  # every line parses intact
+    # per-thread order survives within the surviving window, and the
+    # current generation ends with the newest records
+    by_tid = {}
+    for r in recs:
+        assert set(r) == {"tid", "i", "pad"}
+        by_tid.setdefault(r["tid"], []).append(r["i"])
+    for seq in by_tid.values():
+        assert seq == sorted(seq)
+    assert max(max(s) for s in by_tid.values()) == n_each - 1
+
+
+def test_sink_first_oserror_disables_once(tmp_path):
+    sink = RotatingJsonlSink(str(tmp_path))  # a directory: open() fails
+    sink.write({"a": 1})
+    assert sink.dead
+    sink.write({"a": 2})  # no throw, still dead
+    assert sink.dead

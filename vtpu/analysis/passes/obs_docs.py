@@ -34,6 +34,7 @@ class ObsDocsPass(ProjectPass):
         import vtpu.monitor.feedback  # noqa: F401 — arbiter instruments
         import vtpu.monitor.pathmonitor  # noqa: F401 — scan/GC counters
         import vtpu.monitor.sampler  # noqa: F401 — duty-cycle families
+        import vtpu.obs.outcomes  # noqa: F401 — decision→outcome joins
         import vtpu.plugin.cache  # noqa: F401 — device-poll failures
         import vtpu.plugin.register  # noqa: F401 — registration counters
         import vtpu.plugin.server  # noqa: F401 — Allocate histogram

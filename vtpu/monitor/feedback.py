@@ -126,6 +126,10 @@ class ContentionArbiter:
             EventType.THROTTLE_CHANGED, "monitor",
             pod=entry.pod_uid, ctr=entry.dirname,
             prev=_switch_label(cur), now=_switch_label(value),
+            # raw ladder level rides along so outcome records (and any
+            # offline join) get the squeeze depth as a number, not just
+            # the label (vtpu/obs/outcomes.py)
+            level=value,
         )
 
     def _request_eviction(self, entry) -> None:

@@ -189,9 +189,10 @@ def serve_metrics(
                 self._send(200, body, "application/json")
                 return
             if route in ("/spans", "/timeline", "/trace.json", "/events",
-                         "/readyz"):
+                         "/outcomes", "/readyz"):
                 # shared debug surface (vtpu/obs/http.py): span feed,
-                # event journal, and the deep-readiness probe
+                # event journal, decision→outcome join records, and the
+                # deep-readiness probe
                 from vtpu.obs.http import handle_debug_get
 
                 if not handle_debug_get(self, self._send,

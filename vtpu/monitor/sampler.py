@@ -37,6 +37,7 @@ import time
 from typing import Deque, Dict, List, Optional, Tuple
 
 from vtpu import obs
+from vtpu.obs import outcomes
 from vtpu.monitor.pathmonitor import PathMonitor
 from vtpu.utils import trace
 from vtpu.analysis.witness import make_lock
@@ -274,8 +275,17 @@ class UtilizationSampler:
                 uid: {"hbm_peak": peak} for uid, peak in sorted(pods_peak.items())
             }
             summary = dict(self._node_summary)
+            pods_out = dict(self._pods_summary)
             self._last_sample_t = now
         _SAMPLES.inc()
+        # outcome plane (monitor-side): the same payload shape the
+        # write-back annotation carries, joined locally so a co-located
+        # joiner sees duty without the apiserver round-trip
+        if outcomes.joiner() is not None:
+            outcomes.observe_utilization(
+                self.node_name or "",
+                {"v": 1, "ts": wall, "devices": summary, "pods": pods_out},
+            )
         return summary
 
     def _prune_locked(self, live: set) -> None:

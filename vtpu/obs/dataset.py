@@ -1,0 +1,259 @@
+"""Offline decision→outcome dataset join (`make dataset`).
+
+The live joiner (:mod:`vtpu.obs.outcomes`) folds outcome signals into
+records in-process; this module is the durable twin: it joins the three
+JSONL mirrors — decisions (``VTPU_DECISION_JSONL``), events
+(``VTPU_EVENT_JSONL``) and outcomes (``VTPU_OUTCOME_JSONL``) — into one
+versioned placement-learning dataset, ROADMAP item 2's training input.
+
+The mirrors are written by hot paths under churn, so the reader is
+deliberately paranoid:
+
+- **rotation**: each mirror keeps one previous generation (``<path>.1``,
+  vtpu/obs/jsonl.py) — both generations are stitched before the join;
+- **torn tails / garbage**: a line that does not parse as a JSON object
+  is skipped and counted, never fatal (a crash mid-write leaves exactly
+  one torn tail per generation);
+- **out-of-order and duplicate lines**: sinks serialise on their own
+  lock off the ring locks, so lines may land out of order, and the
+  outcome mirror intentionally writes each record twice (open stamp +
+  close rewrite) — records are deduped on ``seq`` keeping the *last*
+  occurrence in file order, then sorted;
+- **ring eviction**: a decision evicted from the capped ring before its
+  mirror line landed simply yields an example without a decision half —
+  counted in ``coverage``, never fatal.
+
+Usage: ``python -m vtpu.obs.dataset --decisions d.jsonl --events
+e.jsonl --outcomes o.jsonl --out dataset.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from vtpu.obs.outcomes import SCHEMA_VERSION as OUTCOME_SCHEMA_VERSION
+
+#: version of the joined-dataset document (bump on any shape change —
+#: consumers assert it round-trips, see :func:`round_trip`)
+DATASET_VERSION = 1
+
+
+def read_jsonl_rotated(path: str) -> Tuple[List[dict], int]:
+    """Records from ``<path>.1`` + ``<path>`` (rotation-stitched),
+    deduped on ``seq`` (last occurrence wins — the outcome mirror's
+    close rewrite supersedes its open stamp) and sorted by seq.
+    Returns (records, skipped-line count); a missing file is just
+    zero records."""
+    raw: List[dict] = []
+    skipped = 0
+    for p in (path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        try:
+            with open(p, "r", encoding="utf-8", errors="replace") as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        skipped += 1  # torn tail / partial write
+                        continue
+                    if isinstance(rec, dict):
+                        raw.append(rec)
+                    else:
+                        skipped += 1
+        except OSError:
+            skipped += 1
+    by_seq: Dict[object, dict] = {}
+    unseqed: List[dict] = []
+    for rec in raw:
+        seq = rec.get("seq")
+        if isinstance(seq, int):
+            by_seq[seq] = rec  # last occurrence wins
+        else:
+            unseqed.append(rec)
+    out = sorted(by_seq.values(), key=lambda r: r["seq"])
+    out.extend(unseqed)
+    return out, skipped
+
+
+def _compact_decision(dec: dict) -> dict:
+    """The decision half of one example: everything a cost model trains
+    on, minus the per-node verdict bulk (kept as a count — the full
+    verdict set stays queryable in the decision mirror by seq)."""
+    return {
+        "seq": dec.get("seq"),
+        "ts": dec.get("ts"),
+        "node": dec.get("node"),
+        "path": dec.get("path"),
+        "qos": dec.get("qos"),
+        "requests": dec.get("requests"),
+        "utilization": dec.get("utilization"),
+        "gang": dec.get("gang"),
+        "verdict_count": len(dec.get("verdicts") or {}),
+        "elapsed_ms": dec.get("elapsed_ms"),
+    }
+
+
+def build_dataset(
+    decisions: List[dict],
+    events: List[dict],
+    outcomes: List[dict],
+    skipped: int = 0,
+) -> dict:
+    """Join the three mirrors into the versioned dataset document.
+
+    Join keys: outcome ``decision_seq`` → decision ``seq``; outcome
+    ``pod_uid`` + [opened_ts, closed_ts] window → event ``pod`` + ``ts``.
+    Every example carries the shadow prediction next to the measured
+    outcome — the logged-prediction-vs-outcome eval rig."""
+    dec_by_seq = {
+        d["seq"]: d for d in decisions if isinstance(d.get("seq"), int)
+    }
+    events_by_pod: Dict[str, List[dict]] = {}
+    for ev in events:
+        pod = ev.get("pod")
+        if pod:
+            events_by_pod.setdefault(pod, []).append(ev)
+
+    examples: List[dict] = []
+    with_decision = 0
+    with_duty = 0
+    for rec in outcomes:
+        uid = rec.get("pod_uid") or ""
+        dec = dec_by_seq.get(rec.get("decision_seq"))
+        if dec is not None:
+            with_decision += 1
+        duty = rec.get("duty") or {}
+        if duty.get("samples"):
+            with_duty += 1
+        opened = rec.get("opened_ts") or 0.0
+        closed = rec.get("closed_ts")
+        evs = []
+        for ev in events_by_pod.get(uid, ()):
+            ts = ev.get("ts", 0.0)
+            if ts < opened:
+                continue
+            if closed is not None and ts > closed:
+                continue
+            evs.append({"seq": ev.get("seq"), "ts": ts,
+                        "type": ev.get("type")})
+        examples.append({
+            "key": {
+                "pod_uid": uid,
+                "pod": rec.get("pod"),
+                "join_seq": rec.get("seq"),
+                "decision_seq": rec.get("decision_seq"),
+            },
+            "decision": _compact_decision(dec) if dec is not None else None,
+            "outcome": {
+                "disposition": rec.get("disposition"),
+                "duty": duty,
+                "hbm_peak": rec.get("hbm_peak"),
+                "cotenant": rec.get("cotenant"),
+                "requests_attr": rec.get("requests_attr"),
+                "join": rec.get("join"),
+                "chips": rec.get("chips"),
+                "node": rec.get("node"),
+            },
+            "shadow": rec.get("shadow"),
+            "events": evs,
+        })
+
+    placed = sum(1 for d in decisions if d.get("node"))
+    n_out = len(outcomes)
+    return {
+        "v": DATASET_VERSION,
+        "schema": {
+            "dataset_v": DATASET_VERSION,
+            "outcome_v": OUTCOME_SCHEMA_VERSION,
+        },
+        "counts": {
+            "decisions": len(decisions),
+            "placed_decisions": placed,
+            "events": len(events),
+            "outcomes": n_out,
+            "examples": len(examples),
+            "skipped_lines": skipped,
+        },
+        "coverage": {
+            # placements that got an outcome record (the bench gate's
+            # ≥0.95 acceptance bound rides on outcome_per_placement)
+            "outcome_per_placement": (
+                round(min(1.0, n_out / placed), 6) if placed else None
+            ),
+            "decision_joined": (
+                round(with_decision / n_out, 6) if n_out else None
+            ),
+            "duty_joined": (
+                round(with_duty / n_out, 6) if n_out else None
+            ),
+            "shadow_logged": (
+                round(sum(
+                    1 for r in outcomes
+                    if (r.get("shadow") or {}).get("prediction") is not None
+                    or (r.get("shadow") or {}).get("error") is not None
+                ) / n_out, 6) if n_out else None
+            ),
+        },
+        "examples": examples,
+    }
+
+
+def round_trip(doc: dict) -> dict:
+    """Serialise + re-parse the dataset and assert its schema version
+    survives — the `make dataset` acceptance check that the document is
+    plain JSON end to end (no stray objects leaking via default=str)."""
+    clone = json.loads(json.dumps(doc))
+    if clone.get("v") != DATASET_VERSION:
+        raise ValueError(
+            f"dataset round-trip lost its version: {clone.get('v')!r} "
+            f"!= {DATASET_VERSION}"
+        )
+    if (clone.get("schema") or {}).get("outcome_v") != OUTCOME_SCHEMA_VERSION:
+        raise ValueError("dataset round-trip lost its outcome schema "
+                         "version")
+    return clone
+
+
+def join_files(
+    decisions_path: str, events_path: str, outcomes_path: str
+) -> dict:
+    """File-level convenience: rotation-stitched reads + the join."""
+    decisions, s1 = read_jsonl_rotated(decisions_path)
+    events, s2 = read_jsonl_rotated(events_path)
+    outcomes, s3 = read_jsonl_rotated(outcomes_path)
+    return build_dataset(decisions, events, outcomes,
+                         skipped=s1 + s2 + s3)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--decisions", required=True,
+                    help="decision JSONL mirror (VTPU_DECISION_JSONL)")
+    ap.add_argument("--events", required=True,
+                    help="event JSONL mirror (VTPU_EVENT_JSONL)")
+    ap.add_argument("--outcomes", required=True,
+                    help="outcome JSONL mirror (VTPU_OUTCOME_JSONL)")
+    ap.add_argument("--out", default="",
+                    help="write the joined dataset here (default stdout)")
+    args = ap.parse_args(argv)
+    doc = round_trip(join_files(args.decisions, args.events,
+                                args.outcomes))
+    text = json.dumps(doc, indent=2, sort_keys=True)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(text + "\n")
+    else:
+        sys.stdout.write(text + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

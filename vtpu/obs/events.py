@@ -174,6 +174,13 @@ class EventJournal:
                 log.debug("overwrite counter failed", exc_info=True)
         if self._sink is not None:
             self._sink.write(rec)  # disk I/O stays off the ring lock
+        for fn in list(_listeners):
+            # on-record taps (the outcome joiner's disposition feed) run
+            # off the ring lock and must never break the emitting path
+            try:
+                fn(rec)
+            except Exception:  # noqa: BLE001
+                log.debug("event listener failed", exc_info=True)
         try:
             registry("obs").counter(
                 "vtpu_events_total",
@@ -274,6 +281,25 @@ class EventJournal:
     def __len__(self) -> int:
         with self._lock:
             return len(self._dq)
+
+
+#: module-level on-record listeners, invoked by every journal's emit()
+#: AFTER the ring/sink writes — module-level (not per-instance) so a
+#: configure() swap never drops a registered tap (the outcome joiner)
+_listeners: List = []
+
+
+def add_listener(fn) -> None:
+    """Register an on-record callback ``fn(rec)`` — idempotent."""
+    if fn not in _listeners:
+        _listeners.append(fn)
+
+
+def remove_listener(fn) -> None:
+    try:
+        _listeners.remove(fn)
+    except ValueError:
+        pass
 
 
 _journal: Optional[EventJournal] = None

@@ -65,6 +65,10 @@ DEFAULT_FAMILIES: Tuple[Tuple[str, str], ...] = (
     ("serving", "vtpu_request_ttft_seconds"),
     ("serving", "vtpu_request_itl_seconds"),
     ("obs", "vtpu_events_total"),
+    # outcome attribution plane (vtpu/obs/outcomes.py): record closes by
+    # disposition and the decision→first-duty-join feedback delay
+    ("obs", "vtpu_outcome_records_total"),
+    ("obs", "vtpu_outcome_join_lag_seconds"),
 )
 
 

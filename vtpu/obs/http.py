@@ -4,7 +4,8 @@ One implementation of the ``/spans`` (+ ``?n=`` / ``?name=`` filters),
 ``/timeline?pod=<uid>`` (or ``?rid=`` for request traces),
 ``/requests?rid=`` (per-request latency attribution),
 ``/events?pod=&type=&since=&format=`` (the typed
-event journal), ``/slo`` (burn-rate report), ``/incidents`` (recorded
+event journal), ``/outcomes?pod=&since=&format=`` (the decision→outcome
+join records), ``/slo`` (burn-rate report), ``/incidents`` (recorded
 bundles), ``/readyz`` (deep readiness), ``/trace.json`` (Chrome export)
 and registry ``/metrics`` endpoints, used three ways:
 
@@ -115,6 +116,14 @@ def handle_debug_get(
             from vtpu.serving.reqtrace import requests_body
 
             send(200, requests_body(params), "application/json")
+        elif route == "/outcomes":
+            from vtpu.obs.outcomes import outcomes_body
+
+            ctype = (
+                "application/x-ndjson" if params.get("format") == "jsonl"
+                else "application/json"
+            )
+            send(200, outcomes_body(params), ctype)
         elif route == "/events":
             from vtpu.obs import events as events_mod
 

@@ -1,0 +1,770 @@
+"""Outcome attribution plane: decision→outcome joins for placement
+learning.
+
+The decision log (PR 4) records what the scheduler *decided* — per-node
+verdicts, the chosen node, the measured-blend snapshot current at
+decision time.  The utilization write-back (PR 9), the event journal
+(PR 5) and the request ledger (PR 19) record what *happened* — achieved
+duty, throttles, evictions, migrations, TTFT/ITL.  Nothing joined them:
+"did the placement the scheduler chose actually perform?" required
+hand-correlating four surfaces on timestamps.  This module is that join,
+done live: an :class:`OutcomeJoiner` opens one typed
+:class:`OutcomeRecord` per bound placement (keyed pod uid + a monotonic
+join ``seq``) and folds every downstream signal into it —
+
+- **achieved duty / HBM watermark** from the utilization write-back
+  (:meth:`observe_utilization`, fed by ``UsageCache.note_node_utilization``
+  on the scheduler and by the sampler on the monitor);
+- **co-tenant interference**: the duty delta on the placement's chips
+  after bind, against the measured baseline the decision saw;
+- **throttle / evict / migration / drift events** from the journal
+  (a module-level listener on :func:`vtpu.obs.events.emit`);
+- **request-level TTFT/ITL attribution** from the request ledger,
+  joined on the reqtrace tenant (session prefix == pod name/uid);
+- **terminal disposition**: completed / evicted / migrated / drifted
+  (plus bind_failed and superseded), closed by journal events or the
+  PodManager removal listener.
+
+Shadow scoring: a pluggable ``score_shadow(decision, snapshot)``
+callback runs at decision time and its prediction is *recorded, never
+acted on* in the record — logged-prediction-vs-measured-outcome is
+ROADMAP item 2's eval rig.  The built-in baseline predictor keeps every
+record populated even before a learned model is registered.
+
+Surfaces: ``GET /outcomes?pod=&since=&n=&format=jsonl`` on every debug
+listener, a ``RotatingJsonlSink`` mirror (``VTPU_OUTCOME_JSONL``, open
+stamp + final record per placement — offline readers dedupe on ``seq``
+keeping the last), an incident-bundle source (``outcomes.jsonl``), and
+``make dataset`` (:mod:`vtpu.obs.dataset`) which joins the decision,
+event and outcome JSONL mirrors offline into the versioned
+placement-learning dataset.
+
+The whole plane is a no-op unless enabled (``VTPU_OUTCOMES=1`` or a
+``VTPU_OUTCOME_JSONL`` path, or an explicit :func:`configure`): every
+hook is one resolved-global check, exactly like the trace plane.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import time
+from typing import Callable, Deque, Dict, List, Optional, Set
+
+from vtpu.analysis.witness import make_lock
+from vtpu.obs.jsonl import RotatingJsonlSink
+from vtpu.obs.registry import registry
+from vtpu.utils.envs import env_bool, env_int, env_str
+
+SCHEMA_VERSION = 1
+
+ENV_ENABLED = "VTPU_OUTCOMES"
+ENV_JSONL = "VTPU_OUTCOME_JSONL"
+ENV_CAP = "VTPU_OUTCOME_LOG_CAP"
+DEFAULT_CAP = 512
+
+_REG = registry("obs")
+_RECORDS = _REG.counter(
+    "vtpu_outcome_records_total",
+    "Outcome records closed, by terminal disposition (completed / "
+    "evicted / migrated / drifted / bind_failed / superseded / dropped)",
+)
+# join lag spans the monitor's write-back cadence (default 30 s), far
+# past the request-latency buckets — own scale up to 5 min
+_JOIN_LAG = _REG.histogram(
+    "vtpu_outcome_join_lag_seconds",
+    "Wall seconds from a placement decision to its first joined "
+    "measured-duty sample (the decision→outcome feedback delay)",
+    buckets=(0.05, 0.25, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0),
+)
+_DUTY_SAMPLES = _REG.counter(
+    "vtpu_outcome_duty_samples_total",
+    "Measured-duty write-back samples joined into open outcome records",
+)
+_SHADOW_ERRORS = _REG.counter(
+    "vtpu_outcome_shadow_errors_total",
+    "score_shadow callbacks that raised (the error is recorded in the "
+    "OutcomeRecord; scheduling is never affected)",
+)
+_ACHIEVED = _REG.gauge(
+    "vtpu_outcome_achieved_duty_ratio",
+    "Latest joined duty cycle per open placement (label series pruned "
+    "when the record closes)",
+)
+
+#: dispositions that end a record (``active`` is the open state)
+TERMINAL_DISPOSITIONS = (
+    "completed", "evicted", "migrated", "drifted", "bind_failed",
+    "superseded",
+)
+
+ShadowScorer = Callable[[dict, dict], object]
+
+
+def default_shadow_scorer(decision: dict, snapshot: dict) -> dict:
+    """Baseline predictor: achieved duty ≈ the requested core share
+    discounted by the chosen node's measured load — the same
+    measured-blend inputs a learned model would see at decision time.
+    Exists so every record carries a logged prediction before ROADMAP
+    item 2's model is plugged in via :func:`set_shadow_scorer`."""
+    cores = 0.0
+    for ctr in decision.get("requests") or []:
+        for r in ctr:
+            try:
+                cores += float(r.get("cores") or 0.0) * float(
+                    r.get("nums") or 1)
+            except (TypeError, ValueError):
+                continue
+    share = min(1.0, cores / 100.0) if cores > 0 else 1.0
+    payload = (snapshot or {}).get(decision.get("node")) or {}
+    devices = payload.get("devices") if isinstance(payload, dict) else None
+    duties: List[float] = []
+    if isinstance(devices, dict):
+        for rec in devices.values():
+            try:
+                duties.append(float(rec.get("duty", 0.0)))
+            except (AttributeError, TypeError, ValueError):
+                continue
+    load = sum(duties) / len(duties) if duties else 0.0
+    pred = max(0.0, min(1.0, share * (1.0 - 0.5 * load)))
+    return {"achieved_duty_ratio": round(pred, 6)}
+
+
+class OutcomeRecord:
+    """One bound placement's decision→outcome join (mutated only by the
+    owning joiner, under its lock; readers get :meth:`doc` copies)."""
+
+    __slots__ = (
+        "seq", "uid", "pod", "namespace", "node", "path", "qos",
+        "decision_seq", "gang", "chips", "opened_ts", "bound_ts",
+        "closed_ts", "disposition", "shadow", "duty_n", "duty_sum",
+        "duty_max", "duty_last", "hbm_peak", "baseline_duty",
+        "cotenant_last", "event_counts", "event_first_seq",
+        "event_last_seq", "throttle_last", "req_n", "req_errors",
+        "ttft_sum", "ttft_n", "itl_sum", "itl_n", "tokens_out",
+        "first_join_lag_s",
+    )
+
+    def __init__(self, seq: int, decision: dict, chips: List[str],
+                 baseline_duty: Optional[float], shadow: dict,
+                 now: float) -> None:
+        self.seq = seq
+        self.uid = decision.get("pod_uid") or ""
+        self.pod = decision.get("pod") or ""
+        self.namespace = decision.get("namespace") or ""
+        self.node = decision.get("node") or ""
+        self.path = decision.get("path") or ""
+        self.qos = decision.get("qos") or ""
+        self.decision_seq = decision.get("seq")
+        gang = decision.get("gang")
+        self.gang = (
+            {"name": gang.get("name"), "role": gang.get("role")}
+            if isinstance(gang, dict) else None
+        )
+        self.chips = list(chips)
+        self.opened_ts = now
+        self.bound_ts: Optional[float] = None
+        self.closed_ts: Optional[float] = None
+        self.disposition = "active"
+        self.shadow = shadow
+        self.duty_n = 0
+        self.duty_sum = 0.0
+        self.duty_max = 0.0
+        self.duty_last: Optional[float] = None
+        self.hbm_peak = 0
+        self.baseline_duty = baseline_duty
+        self.cotenant_last: Optional[float] = None
+        self.event_counts: Dict[str, int] = {}
+        self.event_first_seq: Optional[int] = None
+        self.event_last_seq: Optional[int] = None
+        self.throttle_last: Optional[str] = None
+        self.req_n = 0
+        self.req_errors = 0
+        self.ttft_sum = 0.0
+        self.ttft_n = 0
+        self.itl_sum = 0.0
+        self.itl_n = 0
+        self.tokens_out = 0
+        self.first_join_lag_s: Optional[float] = None
+
+    def doc(self) -> dict:
+        cot_delta = (
+            round(self.cotenant_last - self.baseline_duty, 6)
+            if self.cotenant_last is not None
+            and self.baseline_duty is not None else None
+        )
+        return {
+            "v": SCHEMA_VERSION,
+            "seq": self.seq,
+            "ts": self.opened_ts,
+            "pod": self.pod,
+            "pod_uid": self.uid,
+            "namespace": self.namespace,
+            "node": self.node,
+            "path": self.path,
+            "qos": self.qos,
+            "decision_seq": self.decision_seq,
+            "gang": self.gang,
+            "chips": list(self.chips),
+            "opened_ts": self.opened_ts,
+            "bound_ts": self.bound_ts,
+            "closed_ts": self.closed_ts,
+            "disposition": self.disposition,
+            "shadow": dict(self.shadow),
+            "duty": {
+                "samples": self.duty_n,
+                "mean": (round(self.duty_sum / self.duty_n, 6)
+                         if self.duty_n else None),
+                "max": round(self.duty_max, 6) if self.duty_n else None,
+                "last": (round(self.duty_last, 6)
+                         if self.duty_last is not None else None),
+            },
+            "hbm_peak": self.hbm_peak,
+            "cotenant": {
+                "baseline": self.baseline_duty,
+                "last": self.cotenant_last,
+                "delta": cot_delta,
+            },
+            "events": {
+                "counts": dict(self.event_counts),
+                "first_seq": self.event_first_seq,
+                "last_seq": self.event_last_seq,
+                "throttle_last": self.throttle_last,
+            },
+            "requests_attr": {
+                "count": self.req_n,
+                "errors": self.req_errors,
+                "ttft_mean_s": (round(self.ttft_sum / self.ttft_n, 9)
+                                if self.ttft_n else None),
+                "itl_mean_s": (round(self.itl_sum / self.itl_n, 9)
+                               if self.itl_n else None),
+                "tokens_out": self.tokens_out,
+            },
+            "join": {"first_lag_s": self.first_join_lag_s},
+        }
+
+
+class OutcomeJoiner:
+    """uid-keyed live joins: open records fold signals in place, closed
+    records land in a capped ring + the JSONL mirror."""
+
+    def __init__(
+        self,
+        cap: Optional[int] = None,
+        jsonl_path: Optional[str] = None,
+        shadow: Optional[ShadowScorer] = None,
+        shadow_name: Optional[str] = None,
+        wallclock=time.time,
+    ) -> None:
+        if cap is None:
+            cap = env_int(ENV_CAP, DEFAULT_CAP)
+        self.cap = max(1, cap)
+        self.jsonl_path = (
+            jsonl_path if jsonl_path is not None else env_str(ENV_JSONL)
+        ) or None
+        self._wallclock = wallclock
+        self._lock = make_lock("obs.outcomes")
+        self._seq = 0
+        self._open: Dict[str, OutcomeRecord] = {}
+        self._by_node: Dict[str, Set[str]] = {}
+        self._by_name: Dict[str, str] = {}
+        self._closed: Deque[OutcomeRecord] = collections.deque(
+            maxlen=self.cap)
+        self.dropped = 0
+        # same off-ring-lock policy as the decision/event journals: the
+        # sink serialises on its own lock, consumers sort/dedupe on "seq"
+        self._sink: Optional[RotatingJsonlSink] = (
+            RotatingJsonlSink(self.jsonl_path,
+                              lock_name="obs.outcomes_sink")
+            if self.jsonl_path else None
+        )
+        if shadow is None:
+            shadow = default_shadow_scorer
+            shadow_name = shadow_name or "baseline"
+        self._shadow = shadow
+        self._shadow_name = shadow_name or getattr(
+            shadow, "__name__", "custom")
+
+    # -- taps -----------------------------------------------------------
+    def observe_decision(
+        self,
+        decision: dict,
+        chips: Optional[List[str]] = None,
+        snapshot: Optional[dict] = None,
+    ) -> Optional[dict]:
+        """Open a record for one placed decision (the decision-log record
+        returned by ``DecisionLog.record``; no-op unless it chose a
+        node).  ``chips`` is the booked device-uuid rectangle, ``snapshot``
+        the ``{node: payload}`` measured-utilization subset the decision
+        saw — both feed the co-tenant baseline and the shadow scorer."""
+        if not decision.get("node") or not decision.get("pod_uid"):
+            return None
+        chips = list(chips or [])
+        # the shadow callback runs OUTSIDE the joiner lock: predictions
+        # are recorded, never acted on, and a slow model must not stall
+        # the join plane
+        shadow = {"scorer": self._shadow_name, "prediction": None,
+                  "error": None}
+        try:
+            shadow["prediction"] = self._shadow(decision, snapshot or {})
+        except Exception as e:  # noqa: BLE001 — shadow must never bite
+            shadow["error"] = f"{type(e).__name__}: {e}"
+            _SHADOW_ERRORS.inc()
+        baseline = self._baseline_duty(decision.get("node"), chips,
+                                       snapshot or {})
+        now = self._wallclock()
+        uid = decision["pod_uid"]
+        superseded: Optional[OutcomeRecord] = None
+        evicted: Optional[OutcomeRecord] = None
+        with self._lock:
+            prev = self._open.get(uid)
+            if prev is not None:
+                superseded = self._close_locked(prev, "superseded", now)
+            self._seq += 1
+            rec = OutcomeRecord(self._seq, decision, chips, baseline,
+                                shadow, now)
+            self._open[uid] = rec
+            self._by_node.setdefault(rec.node, set()).add(uid)
+            if rec.pod:
+                self._by_name[rec.pod] = uid
+            if len(self._open) > 4 * self.cap:
+                old_uid, old = next(iter(self._open.items()))
+                evicted = self._close_locked(old, "dropped", now)
+                self._open.pop(old_uid, None)
+                self.dropped += 1
+            open_doc = rec.doc()
+        for closed in (superseded, evicted):
+            if closed is not None:
+                self._flush_closed(closed)
+        # open stamp: the mirror carries the record even if the process
+        # dies before the close rewrite (readers dedupe on seq, last wins)
+        if self._sink is not None:
+            self._sink.write(open_doc)
+        return open_doc
+
+    def observe_utilization(self, node: str, payload: dict) -> None:
+        """Fold one utilization write-back into every open record on
+        ``node``: per-chip duty (achieved + co-tenant) and the pod's HBM
+        watermark."""
+        devices = payload.get("devices") if isinstance(payload, dict) else None
+        if not isinstance(devices, dict):
+            return
+        pods = payload.get("pods")
+        if not isinstance(pods, dict):
+            pods = {}
+        now = self._wallclock()
+        gauge_sets: List[tuple] = []
+        lags: List[float] = []
+        joined = 0
+        with self._lock:
+            for uid in self._by_node.get(node, ()):
+                rec = self._open.get(uid)
+                if rec is None:
+                    continue
+                duties: List[float] = []
+                for uuid in rec.chips:
+                    dev = devices.get(uuid)
+                    if not isinstance(dev, dict):
+                        continue
+                    try:
+                        duties.append(float(dev.get("duty", 0.0)))
+                    except (TypeError, ValueError):
+                        continue
+                pod_rec = pods.get(uid)
+                if isinstance(pod_rec, dict):
+                    try:
+                        rec.hbm_peak = max(
+                            rec.hbm_peak, int(pod_rec.get("hbm_peak", 0)))
+                    except (TypeError, ValueError):
+                        pass
+                if not duties:
+                    continue
+                mean = sum(duties) / len(duties)
+                if rec.duty_n == 0:
+                    rec.first_join_lag_s = round(
+                        max(0.0, now - rec.opened_ts), 6)
+                    lags.append(rec.first_join_lag_s)
+                rec.duty_n += 1
+                rec.duty_sum += mean
+                rec.duty_max = max(rec.duty_max, mean)
+                rec.duty_last = mean
+                rec.cotenant_last = mean
+                joined += 1
+                gauge_sets.append((mean, uid))
+        # metrics off the joiner lock (each instrument has its own)
+        for lag in lags:
+            _JOIN_LAG.observe(lag)
+        if joined:
+            _DUTY_SAMPLES.inc(joined)
+        for mean, uid in gauge_sets:
+            _ACHIEVED.set(mean, pod=uid)
+
+    #: journal event type → terminal disposition
+    _EVENT_DISPOSITIONS = {
+        "PodEvicted": "evicted",
+        "EvictMigrated": "migrated",
+        "BindFailed": "bind_failed",
+    }
+
+    def observe_event(self, event: dict) -> None:
+        """Journal listener: count the event against its pod's open
+        record; bind stamps ``bound_ts``, evict/migrate/bind-fail close
+        the record, drift marks the disposition without closing (the
+        pod keeps running — removal preserves the drifted verdict)."""
+        uid = event.get("pod")
+        etype = event.get("type")
+        if not uid or not etype:
+            return
+        closed: Optional[OutcomeRecord] = None
+        with self._lock:
+            rec = self._open.get(uid)
+            if rec is None:
+                return
+            rec.event_counts[etype] = rec.event_counts.get(etype, 0) + 1
+            seq = event.get("seq")
+            if isinstance(seq, int):
+                if rec.event_first_seq is None:
+                    rec.event_first_seq = seq
+                rec.event_last_seq = seq
+            if etype == "PodBound" and rec.bound_ts is None:
+                rec.bound_ts = event.get("ts")
+            elif etype == "ThrottleChanged":
+                now_label = event.get("now")
+                if isinstance(now_label, str):
+                    rec.throttle_last = now_label
+            elif etype == "DriftDetected":
+                rec.disposition = "drifted"
+            term = self._EVENT_DISPOSITIONS.get(etype)
+            if term is not None:
+                closed = self._close_locked(rec, term, self._wallclock())
+                self._open.pop(uid, None)
+        if closed is not None:
+            self._flush_closed(closed)
+
+    def observe_request(self, doc: dict) -> None:
+        """Request-ledger completion listener: join the attribution doc
+        on its reqtrace tenant (session ``/``-prefix == pod name or
+        uid)."""
+        tenant = doc.get("tenant")
+        if not tenant:
+            return
+        with self._lock:
+            uid = (tenant if tenant in self._open
+                   else self._by_name.get(tenant))
+            rec = self._open.get(uid) if uid else None
+            if rec is None:
+                return
+            rec.req_n += 1
+            if not doc.get("ok", True):
+                rec.req_errors += 1
+            ttft = doc.get("ttft_s")
+            if isinstance(ttft, (int, float)):
+                rec.ttft_sum += float(ttft)
+                rec.ttft_n += 1
+            itl = doc.get("itl_mean_s")
+            itl_n = doc.get("itl_n") or 0
+            if isinstance(itl, (int, float)) and itl_n:
+                rec.itl_sum += float(itl) * int(itl_n)
+                rec.itl_n += int(itl_n)
+            try:
+                rec.tokens_out += int(doc.get("tokens_out") or 0)
+            except (TypeError, ValueError):
+                pass
+
+    # -- PodManager listener interface ---------------------------------
+    def on_pod_changed(self, uid: str, node: str, devices,
+                       qos: str = "guaranteed") -> None:
+        """Keep the node index and chip rectangle current when a booking
+        is (re)adopted off the annotation bus."""
+        chips: List[str] = []
+        try:
+            for ctr in devices or []:
+                for cd in ctr:
+                    chips.append(cd.uuid)
+        except (AttributeError, TypeError):
+            chips = []
+        with self._lock:
+            rec = self._open.get(uid)
+            if rec is None:
+                return
+            if node and node != rec.node:
+                peers = self._by_node.get(rec.node)
+                if peers is not None:
+                    peers.discard(uid)
+                    if not peers:
+                        self._by_node.pop(rec.node, None)
+                rec.node = node
+                self._by_node.setdefault(node, set()).add(uid)
+            if chips:
+                rec.chips = chips
+
+    def on_pod_removed(self, uid: str) -> None:
+        """Pod reaped: close its record.  A disposition already decided
+        by the journal (drifted) survives; otherwise the pod ran to
+        completion."""
+        closed: Optional[OutcomeRecord] = None
+        with self._lock:
+            rec = self._open.pop(uid, None)
+            if rec is not None:
+                disposition = (
+                    rec.disposition if rec.disposition != "active"
+                    else "completed"
+                )
+                closed = self._close_locked(rec, disposition,
+                                            self._wallclock())
+        if closed is not None:
+            self._flush_closed(closed)
+
+    # -- close plumbing -------------------------------------------------
+    def _close_locked(self, rec: OutcomeRecord, disposition: str,
+                      now: float) -> OutcomeRecord:
+        """Caller holds the lock and removes ``rec`` from ``_open``
+        itself when needed; index cleanup + ring append happen here."""
+        rec.disposition = disposition
+        rec.closed_ts = now
+        self._closed.append(rec)
+        peers = self._by_node.get(rec.node)
+        if peers is not None:
+            peers.discard(rec.uid)
+            if not peers:
+                self._by_node.pop(rec.node, None)
+        if rec.pod and self._by_name.get(rec.pod) == rec.uid:
+            self._by_name.pop(rec.pod, None)
+        return rec
+
+    def _flush_closed(self, rec: OutcomeRecord) -> None:
+        """Off-lock side of a close: counter, gauge-series prune (a
+        reaped pod must not export its last duty forever), final mirror
+        line."""
+        _RECORDS.inc(disposition=rec.disposition)
+        _ACHIEVED.remove(pod=rec.uid)
+        if self._sink is not None:
+            self._sink.write(rec.doc())
+
+    # -- read side ------------------------------------------------------
+    def query(
+        self,
+        pod: Optional[str] = None,
+        since: Optional[float] = None,
+        n: int = 100,
+    ) -> List[dict]:
+        """Newest-last record docs (closed then open, both ordered by
+        join seq); ``pod`` matches uid or name, ``since`` keeps records
+        opened at/after it — filters apply before the count cut."""
+        with self._lock:
+            docs = [r.doc() for r in self._closed]
+            docs.extend(r.doc() for r in self._open.values())
+        docs.sort(key=lambda d: d["seq"])
+        if pod:
+            docs = [d for d in docs if pod in (d["pod_uid"], d["pod"])]
+        if since is not None:
+            docs = [d for d in docs if d["opened_ts"] >= since]
+        n = max(0, n)
+        return docs[-n:] if n else []
+
+    def snapshot(self) -> List[dict]:
+        """Every record doc oldest-first — the incident bundler's freeze
+        (``outcomes.jsonl`` in the bundle)."""
+        return self.query(n=self.cap * 8)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "open": len(self._open),
+                "closed": len(self._closed),
+                "dropped": self.dropped,
+            }
+
+    def flush(self) -> None:
+        """Mirror the current state of every still-open record (the
+        bench/dataset drain before exit — readers dedupe on seq)."""
+        if self._sink is None:
+            return
+        with self._lock:
+            docs = [r.doc() for r in self._open.values()]
+        for doc in docs:
+            self._sink.write(doc)
+
+    def close(self) -> None:
+        if self._sink is not None:
+            self._sink.close()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._open) + len(self._closed)
+
+    # -- internals ------------------------------------------------------
+    @staticmethod
+    def _baseline_duty(node: Optional[str], chips: List[str],
+                       snapshot: dict) -> Optional[float]:
+        """Mean measured duty on the placement's chips at decision time
+        — the co-tenant interference baseline."""
+        payload = snapshot.get(node) if node else None
+        devices = (
+            payload.get("devices") if isinstance(payload, dict) else None
+        )
+        if not isinstance(devices, dict):
+            return None
+        duties: List[float] = []
+        for uuid in chips:
+            dev = devices.get(uuid)
+            if not isinstance(dev, dict):
+                continue
+            try:
+                duties.append(float(dev.get("duty", 0.0)))
+            except (TypeError, ValueError):
+                continue
+        if not duties:
+            return None
+        return round(sum(duties) / len(duties), 6)
+
+
+# -- the process-wide plane (resolved once from the env) ----------------
+
+_plane_lock = make_lock("obs.outcomes_plane")
+_joiner: Optional[OutcomeJoiner] = None
+_resolved = False
+
+
+def _enabled_by_env() -> bool:
+    return env_bool(ENV_ENABLED, False) or bool(env_str(ENV_JSONL))
+
+
+def _dispatch_event(rec: dict) -> None:
+    j = _joiner
+    if j is not None:
+        j.observe_event(rec)
+
+
+def _dispatch_request(doc: dict) -> None:
+    j = _joiner
+    if j is not None:
+        j.observe_request(doc)
+
+
+def _register_listeners() -> None:
+    """Idempotent: the trampolines dispatch to whatever joiner is
+    current, so configure() swaps never leak stale registrations."""
+    from vtpu.obs import events as events_mod
+
+    events_mod.add_listener(_dispatch_event)
+    try:
+        from vtpu.serving import reqtrace
+        reqtrace.add_completion_listener(_dispatch_request)
+    except Exception:  # noqa: BLE001 — serving plane optional
+        pass
+
+
+def joiner() -> Optional[OutcomeJoiner]:
+    """The process joiner, or None while the plane is disabled.  First
+    call resolves the env; afterwards this is one global read — the
+    hot-path gate."""
+    global _joiner, _resolved
+    if _resolved:
+        return _joiner
+    with _plane_lock:
+        if not _resolved:
+            if _enabled_by_env():
+                _joiner = OutcomeJoiner()
+                _register_listeners()
+            _resolved = True
+    return _joiner
+
+
+def configure(
+    enabled: bool = True,
+    cap: Optional[int] = None,
+    jsonl_path: Optional[str] = None,
+    shadow: Optional[ShadowScorer] = None,
+    shadow_name: Optional[str] = None,
+    wallclock=time.time,
+) -> Optional[OutcomeJoiner]:
+    """Replace the process plane (entrypoints with explicit flags,
+    benches, tests).  ``enabled=False`` tears it down — every hook goes
+    back to the one-global-read no-op."""
+    global _joiner, _resolved
+    with _plane_lock:
+        old = _joiner
+        if old is not None:
+            old.close()
+        if enabled:
+            _joiner = OutcomeJoiner(
+                cap=cap, jsonl_path=jsonl_path, shadow=shadow,
+                shadow_name=shadow_name, wallclock=wallclock,
+            )
+            _register_listeners()
+        else:
+            _joiner = None
+        _resolved = True
+        return _joiner
+
+
+def set_shadow_scorer(fn: Optional[ShadowScorer],
+                      name: Optional[str] = None) -> None:
+    """Swap the shadow-scoring callback on the live joiner (None
+    restores the baseline predictor).  Predictions are recorded in each
+    OutcomeRecord and never influence scheduling."""
+    j = joiner()
+    if j is None:
+        return
+    if fn is None:
+        j._shadow = default_shadow_scorer
+        j._shadow_name = "baseline"
+    else:
+        j._shadow = fn
+        j._shadow_name = name or getattr(fn, "__name__", "custom")
+
+
+# -- module-level taps (cheap no-ops while disabled) --------------------
+
+def observe_decision(decision: dict, chips: Optional[List[str]] = None,
+                     snapshot: Optional[dict] = None) -> Optional[dict]:
+    j = joiner()
+    if j is None:
+        return None
+    return j.observe_decision(decision, chips=chips, snapshot=snapshot)
+
+
+def observe_utilization(node: str, payload: dict) -> None:
+    j = joiner()
+    if j is not None:
+        j.observe_utilization(node, payload)
+
+
+def snapshot() -> List[dict]:
+    """Incident-bundle / flight source: every record doc, [] while the
+    plane is disabled."""
+    j = joiner()
+    return j.snapshot() if j is not None else []
+
+
+def outcomes_body(params: dict) -> bytes:
+    """Body for ``GET /outcomes?pod=&since=&n=&format=``: the decision→
+    outcome join records, same query grammar as /decisions and /events
+    (``format=jsonl`` is NDJSON for external scrapers)."""
+    j = joiner()
+    try:
+        n = int(params.get("n", 100))
+    except ValueError:
+        n = 100
+    since: Optional[float] = None
+    if params.get("since"):
+        try:
+            since = float(params["since"])
+        except ValueError:
+            since = None
+    recs = (
+        j.query(pod=params.get("pod") or None, since=since, n=n)
+        if j is not None else []
+    )
+    if params.get("format") == "jsonl":
+        return b"".join(
+            json.dumps(r, default=str).encode() + b"\n" for r in recs
+        )
+    body = {
+        "outcomes": recs,
+        "count": len(recs),
+        "enabled": j is not None,
+        **(j.stats() if j is not None else {}),
+    }
+    return json.dumps(body, default=str).encode()

@@ -56,6 +56,7 @@ ENV_EVAL_S = "VTPU_SLO_EVAL_S"
 ENV_FILTER_P99_S = "VTPU_SLO_FILTER_P99_S"
 ENV_TTFT_P99_S = "VTPU_SLO_TTFT_P99_S"
 ENV_ITL_P99_S = "VTPU_SLO_ITL_P99_S"
+ENV_JOIN_LAG_P95_S = "VTPU_SLO_JOIN_LAG_P95_S"
 
 # selector = (family key, label filter or None); a counter's contribution
 # is the sum over label sets matching every filter entry
@@ -84,6 +85,16 @@ def default_objectives() -> List[dict]:
             "name": "itl_p99", "kind": "latency", "target": 0.99,
             "family": family_key("serving", "vtpu_request_itl_seconds"),
             "threshold_s": env_float(ENV_ITL_P99_S, 0.25),
+        },
+        {
+            # outcome plane feedback delay: a placement decision whose
+            # first measured-duty sample takes longer than the threshold
+            # to join means the write-back loop (or the joiner) is
+            # lagging.  The histogram only observes while the plane is
+            # enabled, so disabled → empty window → burn 0
+            "name": "join_lag_p95", "kind": "latency", "target": 0.95,
+            "family": family_key("obs", "vtpu_outcome_join_lag_seconds"),
+            "threshold_s": env_float(ENV_JOIN_LAG_P95_S, 60.0),
         },
         {
             "name": "bind_success", "kind": "ratio", "target": 0.99,

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 from vtpu import obs
 from vtpu.k8s.objects import get_annotations, pod_uid
+from vtpu.obs import outcomes
 from vtpu.obs.events import EventType, emit
 from vtpu.obs.ready import readiness
 from vtpu.scheduler import nodecheck
@@ -179,6 +180,12 @@ class Scheduler:
         self.nodes.add_listener(self.usage_cache)
         self.pods.add_listener(self.usage_cache)
         self.nodes.add_listener(_MemoPruner(self))
+        # outcome plane (vtpu/obs/outcomes.py): pod removal closes the
+        # decision→outcome join record (terminal disposition) and prunes
+        # its gauge series; no-op while the plane is disabled
+        _oj = outcomes.joiner()
+        if _oj is not None:
+            self.pods.add_listener(_oj)
         # placement-decision audit log (GET /decisions?pod=): every filter
         # run's per-node verdicts, bounded by VTPU_DECISION_LOG_CAP
         self.decisions = DecisionLog()
@@ -765,7 +772,16 @@ class Scheduler:
                 # gang verdicts: per-member-node reserve outcomes + the
                 # chosen global rectangle (GET /decisions?pod= / ?gang=)
                 rec_fields["gang"] = gang_rec
-            self.decisions.record(**rec_fields)
+            decision_rec = self.decisions.record(**rec_fields)
+            if res.node is not None and outcomes.joiner() is not None:
+                # outcome plane: open the decision→outcome join at
+                # decision time (the node is booked here — bind() only
+                # re-stamps bound_ts via the journal listener)
+                outcomes.observe_decision(
+                    decision_rec,
+                    chips=self.usage_cache.pod_devices(uid),
+                    snapshot=measured,
+                )
             emit(
                 EventType.POD_FILTERED, "scheduler",
                 pod=uid, node=res.node or "",
@@ -1501,15 +1517,19 @@ class Scheduler:
                 log.exception("eviction reconcile: delete of %s/%s failed",
                               ns, name)
                 continue
-            # prompt release: the overlay booking (and any patch-machinery
-            # state) goes now, not at the next ingest sweep
-            self.pods.rm_pod(uid)
-            _PREEMPT_EVICTIONS.inc()
+            # the event precedes the registry removal: listeners keyed
+            # on the open pod (the outcome joiner closes its record with
+            # the evicted disposition) must see PodEvicted before the
+            # removal listener fires
             emit(
                 EventType.POD_EVICTED, "scheduler",
                 pod=uid, node=annos.get(annotations.ASSIGNED_NODE, ""),
                 name=name, reason=req,
             )
+            # prompt release: the overlay booking (and any patch-machinery
+            # state) goes now, not at the next ingest sweep
+            self.pods.rm_pod(uid)
+            _PREEMPT_EVICTIONS.inc()
             evicted += 1
         # forget pods whose stray annotation (or the pod itself) is gone,
         # so the set stays bounded and a re-marked pod warns again

@@ -11,7 +11,8 @@ wire contract:
   GET  /readyz   deep readiness (named checks, vtpu/obs/ready)
 
 plus the debug surface on the plain listener: /spans, /timeline,
-/trace.json, /decisions, /events (the typed journal), /slo (burn-rate
+/trace.json, /decisions, /events (the typed journal), /outcomes (the
+decision→outcome join records, vtpu/obs/outcomes.py), /slo (burn-rate
 report), /incidents (recorded bundles), /audit (the
 reconciliation verdict report, vtpu/audit), and the sharded-replica
 surface (vtpu/scheduler/shard.py): GET /shard (ring/ownership status),
@@ -175,6 +176,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(
                 200, self.scheduler.decisions.decisions_body(params), ctype
             )
+        elif self.allow_debug and route == "/outcomes":
+            # decision→outcome join records (vtpu/obs/outcomes.py):
+            # achieved duty / events / request attribution per placement,
+            # same ?pod=&since=&format=jsonl tail surface as /decisions
+            from vtpu.obs.http import split_query
+            from vtpu.obs.outcomes import outcomes_body
+
+            _, params = split_query(self.path)
+            ctype = (
+                "application/x-ndjson" if params.get("format") == "jsonl"
+                else "application/json"
+            )
+            self._send(200, outcomes_body(params), ctype)
         elif self.allow_debug and route == "/slo":
             # SLO burn-rate report (vtpu/obs/slo); explains itself when
             # the flight plane is off

@@ -42,6 +42,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from vtpu.obs import outcomes
 from vtpu.scheduler.score import DeviceUsage, NodeUsage
 from vtpu.analysis.witness import make_lock
 from vtpu.utils.types import ChipInfo, PodDevices
@@ -165,22 +166,27 @@ class UsageCache:
                 ts = None
             if not isinstance(devices, dict) or ts is None:
                 self._idle_since.pop(name, None)
-                return
-            since = self._idle_since.setdefault(name, {})
-            for uuid, rec in devices.items():
-                try:
-                    duty = float(rec.get("duty", 0.0))
-                except (AttributeError, TypeError, ValueError):
+            else:
+                since = self._idle_since.setdefault(name, {})
+                for uuid, rec in devices.items():
+                    try:
+                        duty = float(rec.get("duty", 0.0))
+                    except (AttributeError, TypeError, ValueError):
+                        since.pop(uuid, None)
+                        continue
+                    if duty <= self.idle_duty_threshold:
+                        since.setdefault(uuid, ts)
+                    else:
+                        since.pop(uuid, None)
+                # devices that vanished from the write-back are unknown,
+                # not idle — drop their streak
+                for uuid in [u for u in since if u not in devices]:
                     since.pop(uuid, None)
-                    continue
-                if duty <= self.idle_duty_threshold:
-                    since.setdefault(uuid, ts)
-                else:
-                    since.pop(uuid, None)
-            # devices that vanished from the write-back are unknown, not
-            # idle — drop their streak
-            for uuid in [u for u in since if u not in devices]:
-                since.pop(uuid, None)
+        # outcome plane: join the measured duty into open decision→
+        # outcome records — off the cache lock (the joiner has its own,
+        # and a no-op gate while the plane is disabled)
+        if outcomes.joiner() is not None:
+            outcomes.observe_utilization(name, payload)
 
     def measured_utilization(
         self, name: Optional[str] = None, names=None
@@ -515,6 +521,17 @@ class UsageCache:
         with self._lock:
             b = self._bookings.get(uid)
             return b.node if b is not None else None
+
+    def pod_devices(self, uid: str) -> List[str]:
+        """Flat device-uuid list of a pod's current booking — guaranteed
+        ledger first, best-effort overlay second; [] when unknown.  The
+        outcome joiner's chip rectangle (O(pod devices), one lock
+        hold)."""
+        with self._lock:
+            b = self._bookings.get(uid) or self._overlay.get(uid)
+            if b is None:
+                return []
+            return [cd.uuid for ctr in b.devices for cd in ctr]
 
     def bookings_snapshot(self) -> Dict[str, Tuple[str, PodDevices]]:
         """``{pod uid: (node, devices)}`` — the cache's booking ledger,

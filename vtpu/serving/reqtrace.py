@@ -39,6 +39,7 @@ degrade to partial records rather than wrong ones.
 from __future__ import annotations
 
 import collections
+import logging
 import time
 from typing import Dict, List, Optional
 
@@ -52,8 +53,31 @@ __all__ = [
     "LEDGER",
     "STAGES",
     "RequestLedger",
+    "add_completion_listener",
+    "remove_completion_listener",
     "requests_body",
 ]
+
+log = logging.getLogger(__name__)
+
+#: module-level completion taps, invoked by finish() with the finished
+#: attribution doc — module-level so they survive ledger swaps in tests
+#: (the outcome joiner's request-level TTFT/ITL join registers here)
+_completion_listeners: List = []
+
+
+def add_completion_listener(fn) -> None:
+    """Register an on-completion callback ``fn(doc)`` — idempotent."""
+    if fn not in _completion_listeners:
+        _completion_listeners.append(fn)
+
+
+def remove_completion_listener(fn) -> None:
+    try:
+        _completion_listeners.remove(fn)
+    except ValueError:
+        pass
+
 
 _REG = obs.registry("serving")
 
@@ -123,7 +147,7 @@ class _Record:
     __slots__ = (
         "rid", "session", "tenant", "ctx", "span", "marks", "pauses",
         "ttft_s", "tokens_out", "last_token_at", "itl_sum", "itl_n",
-        "done", "ok", "error", "wall_start",
+        "done", "ok", "error", "wall_start", "seq",
     )
 
     def __init__(self, rid: str, session: str, tenant: str,
@@ -144,6 +168,10 @@ class _Record:
         self.ok = True
         self.error: Optional[str] = None
         self.wall_start = time.time()
+        # monotonic completion sequence (assigned by finish()): the
+        # JSONL mirror's ordering key — offsets break across rotation,
+        # seq survives it (same contract as the decision/event journals)
+        self.seq: Optional[int] = None
 
     def stages(self) -> Dict[str, float]:
         """The telescope deltas up to the latest present mark, plus the
@@ -167,6 +195,7 @@ class _Record:
 
     def doc(self) -> dict:
         return {
+            "seq": self.seq,
             "rid": self.rid,
             "session": self.session,
             "tenant": self.tenant,
@@ -201,6 +230,7 @@ class RequestLedger:
         self._jsonl: Optional[RotatingJsonlSink] = None
         self._jsonl_checked = False
         self.dropped = 0
+        self._seq = 0  # completion sequence (see _Record.seq)
 
     # -- sink -----------------------------------------------------------
     def _sink(self) -> Optional[RotatingJsonlSink]:
@@ -342,11 +372,21 @@ class RequestLedger:
             rec.done = True
             rec.ok = bool(ok)
             rec.error = error
+            self._seq += 1
+            rec.seq = self._seq
             self._completed.append(rec)
         trace.end_span(rec.span, ok=ok, error=error)
+        doc = rec.doc()
         sink = self._sink()
         if sink is not None:
-            sink.write(rec.doc())
+            sink.write(doc)
+        for fn in list(_completion_listeners):
+            # attribution taps (the outcome joiner's TTFT/ITL join) run
+            # off the ledger lock and must never break the finish path
+            try:
+                fn(doc)
+            except Exception:  # noqa: BLE001
+                log.debug("completion listener failed", exc_info=True)
 
     # -- read side ------------------------------------------------------
     def get(self, rid: str) -> Optional[dict]:
